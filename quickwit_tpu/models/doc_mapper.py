@@ -1,0 +1,262 @@
+"""Doc mapping: JSON documents → typed docs, and the schema they obey.
+
+Role of the reference's `quickwit-doc-mapper` (`doc_mapper_impl.rs`,
+`mapping_tree.rs`, `field_mapping_entry.rs`): the per-index schema that
+ - validates and types incoming JSON documents,
+ - declares which fields are indexed (inverted), fast (columnar), stored,
+ - names the timestamp field used for split pruning,
+ - declares tag fields and default search fields,
+ - is the context against which a QueryAst is lowered.
+
+TPU-first divergence: fields are a *flat* list of dot-separated paths (the
+reference flattens its mapping tree the same way at tantivy-schema build
+time), and fast fields are laid out as dense HBM-friendly columns
+(see `index/columns.py`). Dynamic (schemaless) JSON fields are handled by a
+catch-all `_dynamic` text field (tokenized `path.segments:value` pairs),
+a simplification of the reference's dynamic mapping.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from enum import Enum
+from typing import Any, Iterator, Optional, Sequence
+
+from ..query.tokenizers import get_tokenizer
+from ..utils.datetime_utils import parse_datetime_to_micros
+
+
+class DocParsingError(ValueError):
+    pass
+
+
+class FieldType(str, Enum):
+    TEXT = "text"
+    I64 = "i64"
+    U64 = "u64"
+    F64 = "f64"
+    BOOL = "bool"
+    DATETIME = "datetime"
+    IP = "ip"
+    BYTES = "bytes"
+    JSON = "json"
+
+
+@dataclass(frozen=True)
+class FieldMapping:
+    """One field of the schema (reference: `FieldMappingEntry`)."""
+    name: str  # dot-separated path, e.g. "resource.service"
+    type: FieldType
+    tokenizer: str = "default"      # for TEXT
+    record: str = "basic"           # "basic" (doc,tf) | "position" (phrase-capable)
+    indexed: bool = True
+    fast: bool = False
+    stored: bool = True
+    input_formats: tuple[str, ...] = ("rfc3339", "unix_timestamp")  # DATETIME
+    output_format: str = "rfc3339"
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name, "type": self.type.value, "tokenizer": self.tokenizer,
+            "record": self.record, "indexed": self.indexed, "fast": self.fast,
+            "stored": self.stored, "input_formats": list(self.input_formats),
+            "output_format": self.output_format,
+        }
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "FieldMapping":
+        return FieldMapping(
+            name=d["name"], type=FieldType(d["type"]),
+            tokenizer=d.get("tokenizer", "default"), record=d.get("record", "basic"),
+            indexed=d.get("indexed", True), fast=d.get("fast", False),
+            stored=d.get("stored", True),
+            input_formats=tuple(d.get("input_formats", ("rfc3339", "unix_timestamp"))),
+            output_format=d.get("output_format", "rfc3339"),
+        )
+
+
+def _iter_path(doc: Any, path: Sequence[str]) -> Iterator[Any]:
+    """Yield all values at `path` in a (possibly nested/array) JSON doc."""
+    if not path:
+        if isinstance(doc, list):
+            yield from doc
+        elif doc is not None:
+            yield doc
+        return
+    if isinstance(doc, list):
+        for item in doc:
+            yield from _iter_path(item, path)
+    elif isinstance(doc, dict):
+        key = path[0]
+        if key in doc:
+            yield from _iter_path(doc[key], path[1:])
+
+
+@dataclass
+class TypedDoc:
+    """A validated document: per-field typed values + the raw source."""
+    fields: dict[str, list[Any]]
+    source: dict[str, Any]
+
+    def timestamp_micros(self, timestamp_field: Optional[str]) -> Optional[int]:
+        if timestamp_field is None:
+            return None
+        values = self.fields.get(timestamp_field)
+        return values[0] if values else None
+
+
+@dataclass
+class DocMapper:
+    """Schema + conversion + (via search/plan.py) query lowering context.
+
+    Reference parity: `DocMapper::doc_from_json` → `validate/convert`;
+    `DocMapper::query` is implemented in `search/plan.py::lower_ast` against
+    this object.
+    """
+    doc_mapping_uid: str = "default"
+    field_mappings: list[FieldMapping] = dc_field(default_factory=list)
+    timestamp_field: Optional[str] = None
+    tag_fields: tuple[str, ...] = ()
+    default_search_fields: tuple[str, ...] = ()
+    store_source: bool = True
+    mode: str = "lenient"  # "lenient" | "strict": unknown fields ignored/rejected
+
+    def __post_init__(self) -> None:
+        self._by_name = {fm.name: fm for fm in self.field_mappings}
+        if self.timestamp_field is not None:
+            ts = self._by_name.get(self.timestamp_field)
+            if ts is None or ts.type is not FieldType.DATETIME or not ts.fast:
+                raise ValueError(
+                    f"timestamp_field {self.timestamp_field!r} must be a fast datetime field")
+
+    def field(self, name: str) -> Optional[FieldMapping]:
+        return self._by_name.get(name)
+
+    @property
+    def fast_fields(self) -> list[FieldMapping]:
+        return [fm for fm in self.field_mappings if fm.fast]
+
+    @property
+    def indexed_fields(self) -> list[FieldMapping]:
+        return [fm for fm in self.field_mappings if fm.indexed]
+
+    # ------------------------------------------------------------------
+    def doc_from_json(self, doc: dict[str, Any]) -> TypedDoc:
+        if not isinstance(doc, dict):
+            raise DocParsingError(f"document must be a JSON object, got {type(doc).__name__}")
+        fields: dict[str, list[Any]] = {}
+        for fm in self.field_mappings:
+            raw_values = list(_iter_path(doc, fm.name.split(".")))
+            if not raw_values:
+                continue
+            try:
+                fields[fm.name] = [self._convert(fm, v) for v in raw_values]
+            except (ValueError, TypeError) as exc:
+                raise DocParsingError(f"field {fm.name!r}: {exc}") from exc
+        if self.mode == "strict":
+            known_roots = {fm.name.split(".")[0] for fm in self.field_mappings}
+            for key in doc:
+                if key not in known_roots:
+                    raise DocParsingError(f"unknown field {key!r} in strict mapping")
+        return TypedDoc(fields=fields, source=doc if self.store_source else {})
+
+    def _convert(self, fm: FieldMapping, value: Any) -> Any:
+        t = fm.type
+        if t is FieldType.TEXT:
+            if not isinstance(value, str):
+                value = str(value)
+            return value
+        if t is FieldType.I64:
+            if isinstance(value, bool) or not isinstance(value, (int, float, str)):
+                raise ValueError(f"expected i64, got {value!r}")
+            return int(value)
+        if t is FieldType.U64:
+            if isinstance(value, bool):
+                raise ValueError(f"expected u64, got {value!r}")
+            iv = int(value)
+            if iv < 0:
+                raise ValueError(f"expected u64, got {value!r}")
+            return iv
+        if t is FieldType.F64:
+            if isinstance(value, bool):
+                raise ValueError(f"expected f64, got {value!r}")
+            return float(value)
+        if t is FieldType.BOOL:
+            if isinstance(value, bool):
+                return value
+            if isinstance(value, str) and value.lower() in ("true", "false"):
+                return value.lower() == "true"
+            raise ValueError(f"expected bool, got {value!r}")
+        if t is FieldType.DATETIME:
+            return parse_datetime_to_micros(value, fm.input_formats)
+        if t is FieldType.IP:
+            import ipaddress
+            return int(ipaddress.ip_address(value))
+        if t is FieldType.BYTES:
+            import base64
+            if isinstance(value, str):
+                return base64.b64decode(value)
+            raise ValueError(f"expected base64 string, got {value!r}")
+        if t is FieldType.JSON:
+            return value
+        raise ValueError(f"unhandled field type {t}")
+
+    # ------------------------------------------------------------------
+    def tokens_for_field(self, fm: FieldMapping, value: Any) -> list:
+        """Index tokens for one value of one field."""
+        if fm.type is FieldType.TEXT:
+            return get_tokenizer(fm.tokenizer)(value)
+        # non-text indexed fields index their canonical string form as a raw term
+        from ..query.tokenizers import Token
+        return [Token(canonical_term(fm, value), 0)]
+
+    def tags(self, tdoc: TypedDoc) -> set[str]:
+        """`tag_field:value` strings recorded in split metadata for pruning
+        (reference: `tag_pruning.rs`)."""
+        out: set[str] = set()
+        for tag_field in self.tag_fields:
+            for v in tdoc.fields.get(tag_field, []):
+                out.add(f"{tag_field}:{v}")
+        return out
+
+    # ------------------------------------------------------------------
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "doc_mapping_uid": self.doc_mapping_uid,
+            "field_mappings": [fm.to_dict() for fm in self.field_mappings],
+            "timestamp_field": self.timestamp_field,
+            "tag_fields": list(self.tag_fields),
+            "default_search_fields": list(self.default_search_fields),
+            "store_source": self.store_source,
+            "mode": self.mode,
+        }
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "DocMapper":
+        return DocMapper(
+            doc_mapping_uid=d.get("doc_mapping_uid", "default"),
+            field_mappings=[FieldMapping.from_dict(f) for f in d["field_mappings"]],
+            timestamp_field=d.get("timestamp_field"),
+            tag_fields=tuple(d.get("tag_fields", ())),
+            default_search_fields=tuple(d.get("default_search_fields", ())),
+            store_source=d.get("store_source", True),
+            mode=d.get("mode", "lenient"),
+        )
+
+
+def canonical_term(fm: FieldMapping, value: Any) -> str:
+    """Canonical index-term string for a non-text value.
+
+    Numeric/datetime/bool/ip values are indexed under a canonical string so
+    query-side Term("field","42") matches; mirrors tantivy's typed terms.
+    """
+    if fm.type is FieldType.BOOL:
+        return "true" if value else "false"
+    if fm.type in (FieldType.I64, FieldType.U64, FieldType.DATETIME, FieldType.IP):
+        return str(int(value))
+    if fm.type is FieldType.F64:
+        return repr(float(value))
+    if fm.type is FieldType.BYTES:
+        import base64
+        return base64.b64encode(value).decode()
+    return str(value)

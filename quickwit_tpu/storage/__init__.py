@@ -1,0 +1,9 @@
+from .base import Storage, StorageError, StorageResolver
+from .local import LocalFileStorage
+from .ram import RamStorage
+from .cache import ByteRangeCache, MemorySizedCache, CachingStorage
+
+__all__ = [
+    "Storage", "StorageError", "StorageResolver", "LocalFileStorage",
+    "RamStorage", "ByteRangeCache", "MemorySizedCache", "CachingStorage",
+]

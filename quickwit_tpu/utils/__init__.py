@@ -1,0 +1,3 @@
+from .datetime_utils import parse_datetime_to_micros, format_micros_rfc3339
+
+__all__ = ["parse_datetime_to_micros", "format_micros_rfc3339"]

@@ -1,0 +1,13 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual devices so multi-chip sharding
+(`quickwit_tpu.parallel`) is exercised without TPU hardware, per the
+driver's dry-run model. Must run before any jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,64 @@
+from quickwit_tpu.common import EventBroker, Uri, sort_by_rendezvous_hash
+from quickwit_tpu.common.uri import Protocol
+
+
+def test_uri_parse_roundtrip():
+    uri = Uri.parse("s3://bucket/indexes/hdfs-logs")
+    assert uri.protocol is Protocol.S3
+    assert str(uri) == "s3://bucket/indexes/hdfs-logs"
+    assert str(uri.join("splits", "abc.split")) == "s3://bucket/indexes/hdfs-logs/splits/abc.split"
+    assert str(uri.parent()) == "s3://bucket/indexes"
+
+
+def test_uri_bare_path_is_file():
+    uri = Uri.parse("/tmp/idx/")
+    assert uri.protocol is Protocol.FILE
+    assert uri.file_path == "/tmp/idx"
+
+
+def test_rendezvous_stability_and_minimal_reshuffle():
+    nodes = [f"node-{i}" for i in range(5)]
+    order1 = sort_by_rendezvous_hash("split-42", nodes)
+    order2 = sort_by_rendezvous_hash("split-42", list(reversed(nodes)))
+    assert order1 == order2
+    # removing a non-first node does not change the top choice
+    removed = [n for n in nodes if n != order1[1]]
+    assert sort_by_rendezvous_hash("split-42", removed)[0] == order1[0]
+    # different keys spread across nodes
+    firsts = {sort_by_rendezvous_hash(f"split-{i}", nodes)[0] for i in range(50)}
+    assert len(firsts) > 1
+
+
+def test_event_broker_typed_dispatch():
+    broker = EventBroker()
+    seen: list = []
+
+    class EventA:
+        pass
+
+    class EventB:
+        pass
+
+    handle = broker.subscribe(EventA, seen.append)
+    broker.publish(EventA())
+    broker.publish(EventB())
+    assert len(seen) == 1 and isinstance(seen[0], EventA)
+    handle.cancel()
+    broker.publish(EventA())
+    assert len(seen) == 1
+
+
+def test_event_broker_handler_exception_isolated():
+    broker = EventBroker()
+    seen = []
+
+    class Ev:
+        pass
+
+    def bad(_):
+        raise RuntimeError("boom")
+
+    broker.subscribe(Ev, bad)
+    broker.subscribe(Ev, seen.append)
+    broker.publish(Ev())
+    assert len(seen) == 1

@@ -1,0 +1,99 @@
+import pytest
+
+from quickwit_tpu.models import DocMapper, DocParsingError, FieldMapping, FieldType
+from quickwit_tpu.models.doc_mapper import canonical_term
+from quickwit_tpu.utils import parse_datetime_to_micros
+
+
+def hdfs_mapper():
+    """The hdfs-logs tutorial doc mapping (reference tutorial-hdfs-logs.md)."""
+    return DocMapper(
+        field_mappings=[
+            FieldMapping("timestamp", FieldType.DATETIME, fast=True,
+                         input_formats=("unix_timestamp",)),
+            FieldMapping("tenant_id", FieldType.U64, fast=True),
+            FieldMapping("severity_text", FieldType.TEXT, tokenizer="raw", fast=True),
+            FieldMapping("body", FieldType.TEXT, tokenizer="default", record="position"),
+            FieldMapping("resource.service", FieldType.TEXT, tokenizer="raw"),
+        ],
+        timestamp_field="timestamp",
+        tag_fields=("tenant_id",),
+        default_search_fields=("body",),
+    )
+
+
+def test_doc_from_json_typed():
+    mapper = hdfs_mapper()
+    doc = {
+        "timestamp": 1460530013,
+        "tenant_id": 22,
+        "severity_text": "INFO",
+        "body": "PacketResponder: BP-108841162 terminating",
+        "resource": {"service": "datanode/01"},
+    }
+    tdoc = mapper.doc_from_json(doc)
+    assert tdoc.fields["timestamp"] == [1460530013 * 1_000_000]
+    assert tdoc.fields["tenant_id"] == [22]
+    assert tdoc.fields["resource.service"] == ["datanode/01"]
+    assert tdoc.timestamp_micros("timestamp") == 1460530013 * 1_000_000
+    assert mapper.tags(tdoc) == {"tenant_id:22"}
+
+
+def test_doc_from_json_array_values():
+    mapper = DocMapper(field_mappings=[FieldMapping("tags", FieldType.TEXT, tokenizer="raw")])
+    tdoc = mapper.doc_from_json({"tags": ["a", "b"]})
+    assert tdoc.fields["tags"] == ["a", "b"]
+
+
+def test_doc_type_errors():
+    mapper = DocMapper(field_mappings=[FieldMapping("n", FieldType.U64)])
+    with pytest.raises(DocParsingError):
+        mapper.doc_from_json({"n": -5})
+    with pytest.raises(DocParsingError):
+        mapper.doc_from_json({"n": "not-a-number"})
+
+
+def test_strict_mode_rejects_unknown():
+    mapper = DocMapper(field_mappings=[FieldMapping("a", FieldType.TEXT)], mode="strict")
+    with pytest.raises(DocParsingError):
+        mapper.doc_from_json({"a": "x", "zz": 1})
+
+
+def test_timestamp_field_must_be_fast_datetime():
+    with pytest.raises(ValueError):
+        DocMapper(
+            field_mappings=[FieldMapping("ts", FieldType.I64, fast=True)],
+            timestamp_field="ts",
+        )
+
+
+def test_mapper_serde_roundtrip():
+    mapper = hdfs_mapper()
+    assert DocMapper.from_dict(mapper.to_dict()).to_dict() == mapper.to_dict()
+
+
+def test_canonical_term():
+    fm_bool = FieldMapping("b", FieldType.BOOL)
+    assert canonical_term(fm_bool, True) == "true"
+    fm_i = FieldMapping("i", FieldType.I64)
+    assert canonical_term(fm_i, 42) == "42"
+
+
+def test_datetime_parsing_formats():
+    micros = parse_datetime_to_micros("2021-04-13T03:42:01Z")
+    assert micros == 1618285321 * 1_000_000
+    assert parse_datetime_to_micros(1618285321) == micros
+    assert parse_datetime_to_micros(1618285321000) == micros  # millis heuristic
+    assert parse_datetime_to_micros("2021-04-13T03:42:01.500Z") == micros + 500_000
+    assert parse_datetime_to_micros("2021-04-13T05:42:01+02:00") == micros
+    with pytest.raises(ValueError):
+        parse_datetime_to_micros("not a date")
+
+
+def test_numeric_fields_reject_bool():
+    mapper = DocMapper(field_mappings=[
+        FieldMapping("u", FieldType.U64), FieldMapping("f", FieldType.F64)])
+    with pytest.raises(DocParsingError):
+        mapper.doc_from_json({"u": True})
+    with pytest.raises(DocParsingError):
+        mapper.doc_from_json({"f": False})

@@ -1,0 +1,139 @@
+import pytest
+
+from quickwit_tpu.query import (
+    Bool, FieldPresence, FullText, MatchAll, Range, Term, TermSet, Wildcard,
+    ast_from_dict, parse_query_string,
+)
+from quickwit_tpu.query.parser import QueryParseError
+from quickwit_tpu.query.tokenizers import get_tokenizer
+
+
+def roundtrip(ast):
+    assert ast_from_dict(ast.to_dict()) == ast
+
+
+def test_ast_roundtrip():
+    ast = Bool(
+        must=(Term("severity_text", "ERROR"), Range("tenant_id",)),
+        must_not=(Term("app", "noisy"),),
+        should=(FullText("body", "connection refused", "phrase"),),
+    )
+    roundtrip(ast)
+    roundtrip(MatchAll())
+    roundtrip(TermSet({"f": ("a", "b")}))
+
+
+def test_parse_field_term():
+    assert parse_query_string("severity_text:ERROR") == Term("severity_text", "ERROR")
+
+
+def test_parse_and_or():
+    ast = parse_query_string("severity_text:ERROR AND tenant_id:22")
+    assert isinstance(ast, Bool)
+    assert Term("severity_text", "ERROR") in ast.must
+    assert Term("tenant_id", "22") in ast.must
+
+    ast = parse_query_string("a:1 OR b:2")
+    assert isinstance(ast, Bool)
+    assert len(ast.should) == 2
+
+
+def test_parse_occur_prefixes():
+    ast = parse_query_string("+a:1 -b:2")
+    assert isinstance(ast, Bool)
+    assert Term("a", "1") in ast.must
+    assert Term("b", "2") in ast.must_not
+
+
+def test_parse_range_brackets():
+    ast = parse_query_string("tenant_id:[10 TO 20}")
+    assert isinstance(ast, Range)
+    assert ast.lower.value == "10" and ast.lower.inclusive
+    assert ast.upper.value == "20" and not ast.upper.inclusive
+
+
+def test_parse_range_comparison():
+    ast = parse_query_string("timestamp:>=2021-01-01T00:00:00Z")
+    assert isinstance(ast, Range)
+    assert ast.lower.value == "2021-01-01T00:00:00Z"
+    assert ast.upper is None
+
+
+def test_parse_phrase_and_default_fields():
+    ast = parse_query_string('"connection refused"', default_search_fields=["body"])
+    assert ast == FullText("body", "connection refused", "phrase")
+    ast2 = parse_query_string("refused", default_search_fields=["body", "title"])
+    assert isinstance(ast2, Bool) and len(ast2.should) == 2
+
+
+def test_parse_presence_wildcard_matchall():
+    assert parse_query_string("*") == MatchAll()
+    assert parse_query_string("f:*") == FieldPresence("f")
+    assert parse_query_string("f:ab*") == Wildcard("f", "ab*")
+
+
+def test_parse_term_set():
+    ast = parse_query_string("f: IN [a b c]")
+    assert ast == TermSet({"f": ("a", "b", "c")})
+
+
+def test_parse_parens_nesting():
+    ast = parse_query_string("(a:1 OR b:2) AND c:3")
+    assert isinstance(ast, Bool)
+    assert Term("c", "3") in ast.must
+
+
+def test_parse_error_on_garbage():
+    with pytest.raises(QueryParseError):
+        parse_query_string("field:")
+
+
+def test_default_tokenizer():
+    toks = get_tokenizer("default")("Hello, World-42 FOO_bar")
+    assert [t.text for t in toks] == ["hello", "world", "42", "foo", "bar"]
+
+
+def test_raw_tokenizer():
+    toks = get_tokenizer("raw")("Hello World")
+    assert [t.text for t in toks] == ["Hello World"]
+
+
+def test_stem_tokenizer_consistency():
+    stem = get_tokenizer("en_stem")
+    assert [t.text for t in stem("running runs")] == [t.text for t in stem("running runs")]
+    assert [t.text for t in stem("connections")][0] == [t.text for t in stem("connection")][0]
+
+
+def test_code_tokenizer():
+    toks = get_tokenizer("source_code_default")("getHTTPResponse_fooBar42")
+    assert "get" in [t.text for t in toks]
+    assert "http" in [t.text for t in toks]
+
+
+def test_parse_and_promotes_only_adjacent():
+    # Lucene classic: `a:1 b:2 AND c:3` keeps a:1 optional
+    ast = parse_query_string("a:1 b:2 AND c:3")
+    assert isinstance(ast, Bool)
+    assert Term("a", "1") in ast.should
+    assert Term("b", "2") in ast.must
+    assert Term("c", "3") in ast.must
+
+
+def test_parse_negative_range_bounds():
+    ast = parse_query_string("tenant_id:[-5 TO 20]")
+    assert isinstance(ast, Range)
+    assert ast.lower.value == "-5"
+
+
+def test_parse_term_set_no_space():
+    assert parse_query_string("f:IN [a b c]") == TermSet({"f": ("a", "b", "c")})
+
+
+def test_parse_wildcard_anywhere():
+    assert parse_query_string("f:*ab") == Wildcard("f", "*ab")
+    assert parse_query_string("f:a?b") == Wildcard("f", "a?b")
+
+
+def test_lone_must_not():
+    ast = parse_query_string("-a:1")
+    assert isinstance(ast, Bool) and ast.must_not == (Term("a", "1"),)

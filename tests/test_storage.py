@@ -1,0 +1,120 @@
+import pytest
+
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.storage import (
+    ByteRangeCache, CachingStorage, LocalFileStorage, MemorySizedCache,
+    RamStorage, StorageError, StorageResolver,
+)
+
+
+@pytest.fixture(params=["ram", "local"])
+def storage(request, tmp_path):
+    if request.param == "ram":
+        return RamStorage(Uri.parse("ram:///test"))
+    return LocalFileStorage(Uri.parse(str(tmp_path)))
+
+
+def test_storage_put_get_roundtrip(storage):
+    storage.put("splits/a.split", b"hello world")
+    assert storage.get_all("splits/a.split") == b"hello world"
+    assert storage.get_slice("splits/a.split", 6, 11) == b"world"
+    assert storage.file_num_bytes("splits/a.split") == 11
+    assert storage.exists("splits/a.split")
+    assert not storage.exists("missing")
+    assert storage.list_files() == ["splits/a.split"]
+
+
+def test_storage_delete(storage):
+    storage.put("x", b"1")
+    storage.delete("x")
+    assert not storage.exists("x")
+    with pytest.raises(StorageError):
+        storage.delete("x")
+
+
+def test_storage_bulk_delete_ignores_missing(storage):
+    storage.put("a", b"1")
+    storage.put("b", b"2")
+    storage.bulk_delete(["a", "b", "missing"])
+    assert storage.list_files() == []
+
+
+def test_storage_not_found_kind(storage):
+    with pytest.raises(StorageError) as exc:
+        storage.get_all("nope")
+    assert exc.value.kind == "not_found"
+
+
+def test_resolver_caches_instances(tmp_path):
+    resolver = StorageResolver.for_test()
+    s1 = resolver.resolve(f"file://{tmp_path}")
+    s2 = resolver.resolve(f"file://{tmp_path}")
+    assert s1 is s2
+
+
+def test_ram_resolver_shares_tree():
+    resolver = StorageResolver.for_test()
+    parent = resolver.resolve("ram:///indexes")
+    child = resolver.resolve("ram:///indexes/idx1")
+    child.put("f.split", b"data")
+    assert parent.get_all("idx1/f.split") == b"data"
+
+
+def test_memory_sized_cache_lru_eviction():
+    cache = MemorySizedCache(capacity_bytes=10)
+    cache.put("a", b"12345")
+    cache.put("b", b"12345")
+    assert cache.get("a") == b"12345"  # a is now most-recent
+    cache.put("c", b"12345")           # evicts b
+    assert cache.get("b") is None
+    assert cache.get("a") is not None
+    assert cache.size_bytes <= 10
+
+
+def test_memory_sized_cache_oversized_item_not_cached():
+    cache = MemorySizedCache(capacity_bytes=4)
+    cache.put("big", b"123456")
+    assert cache.get("big") is None
+
+
+def test_byte_range_cache_covering_lookup():
+    cache = ByteRangeCache()
+    cache.put("f", 100, bytes(range(50)))
+    assert cache.get("f", 110, 120) == bytes(range(10, 20))
+    assert cache.get("f", 90, 110) is None
+    assert cache.get("f", 140, 160) is None
+
+
+def test_byte_range_cache_merges_adjacent():
+    cache = ByteRangeCache()
+    cache.put("f", 0, b"aaaa")
+    cache.put("f", 4, b"bbbb")
+    assert cache.get("f", 2, 6) == b"aabb"
+
+
+def test_caching_storage_serves_from_cache():
+    backend = RamStorage(Uri.parse("ram:///cs"))
+    backend.put("f", b"0123456789")
+    caching = CachingStorage(backend)
+    assert caching.get_slice("f", 0, 4) == b"0123"
+    backend.put("f", b"XXXXXXXXXX")  # mutate behind the cache
+    assert caching.get_slice("f", 1, 3) == b"12"  # still served from cache
+
+
+def test_caching_storage_invalidates_on_put_delete():
+    backend = RamStorage(Uri.parse("ram:///cs2"))
+    caching = CachingStorage(backend)
+    caching.put("f", b"version1")
+    assert caching.get_slice("f", 0, 8) == b"version1"
+    caching.put("f", b"version2")
+    assert caching.get_slice("f", 0, 8) == b"version2"
+    caching.delete("f")
+    with pytest.raises(StorageError):
+        caching.get_all("f")
+
+
+def test_local_storage_sibling_prefix_escape_blocked(tmp_path):
+    root = tmp_path / "store"
+    storage = LocalFileStorage(Uri.parse(str(root)))
+    with pytest.raises(StorageError):
+        storage.put("../store-evil/pwn", b"x")
