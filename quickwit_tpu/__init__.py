@@ -35,3 +35,10 @@ Package layout (mirrors the reference's layer map, SURVEY.md §1):
 """
 
 __version__ = "0.1.0"
+
+# i64 timestamp columns (micros since epoch, ~1e15) and f64 aggregation
+# accumulators need 64-bit math; f64 is exact for integers < 2^53 which covers
+# all datetime micros. Must be set before any tracing.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)

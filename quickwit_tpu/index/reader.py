@@ -1,0 +1,215 @@
+"""Split reader: ranged reads of the array layout, term lookups, doc fetch.
+
+Role of the reference's directory stack (`open_index_with_caches`,
+`quickwit-search/src/leaf.rs:219`: StorageDirectory → CachingDirectory →
+HotDirectory over the hotcache): opens a split with one footer GET, then
+serves exact byte-range reads for postings/columns through a ByteRangeCache.
+Device transfer (warmup) lives in `search/leaf.py`; this class is pure host.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import zlib
+from dataclasses import dataclass
+from typing import Any, Iterator, Optional
+
+import numpy as np
+
+from ..storage.base import Storage
+from ..storage.cache import ByteRangeCache
+from .format import DEFAULT_FOOTER_HINT, ArrayMeta, SplitFooter, read_footer
+
+
+@dataclass(frozen=True)
+class TermInfo:
+    ordinal: int
+    df: int
+    post_off: int   # element offset into the postings arenas
+    post_len: int   # padded element count
+
+
+class _TermDict:
+    """Sorted term dictionary of one field: binary-searchable blob+offsets."""
+
+    def __init__(self, blob: bytes, offsets: np.ndarray, dfs: np.ndarray,
+                 post_offs: np.ndarray, post_lens: np.ndarray):
+        self.blob = blob
+        self.offsets = offsets
+        self.dfs = dfs
+        self.post_offs = post_offs
+        self.post_lens = post_lens
+
+    def __len__(self) -> int:
+        return len(self.dfs)
+
+    def term_at(self, ordinal: int) -> str:
+        return self.blob[self.offsets[ordinal]: self.offsets[ordinal + 1]].decode()
+
+    def lookup(self, term: str) -> Optional[TermInfo]:
+        target = term.encode()
+        lo, hi = 0, len(self.dfs)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            cand = self.blob[self.offsets[mid]: self.offsets[mid + 1]]
+            if cand < target:
+                lo = mid + 1
+            elif cand > target:
+                hi = mid
+            else:
+                return TermInfo(mid, int(self.dfs[mid]), int(self.post_offs[mid]),
+                                int(self.post_lens[mid]))
+        return None
+
+    def iter_terms(self, start: Optional[str] = None) -> Iterator[tuple[str, int]]:
+        """(term, df) pairs in sorted order, optionally from `start`."""
+        begin = 0
+        if start is not None:
+            target = start.encode()
+            lo, hi = 0, len(self.dfs)
+            while lo < hi:
+                mid = (lo + hi) // 2
+                if self.blob[self.offsets[mid]: self.offsets[mid + 1]] < target:
+                    lo = mid + 1
+                else:
+                    hi = mid
+            begin = lo
+        for i in range(begin, len(self.dfs)):
+            yield self.term_at(i), int(self.dfs[i])
+
+
+class SplitReader:
+    def __init__(self, storage: Storage, path: str,
+                 footer_hint: int = DEFAULT_FOOTER_HINT,
+                 cache: Optional[ByteRangeCache] = None,
+                 file_len: Optional[int] = None):
+        self.storage = storage
+        self.path = path
+        self.cache = cache or ByteRangeCache()
+        self.file_len = file_len if file_len is not None else storage.file_num_bytes(path)
+        self.footer: SplitFooter = read_footer(self._get_slice, self.file_len, footer_hint)
+        self._term_dicts: dict[str, _TermDict] = {}
+        self._arrays: dict[str, np.ndarray] = {}
+
+    # --- IO ----------------------------------------------------------------
+    def _get_slice(self, start: int, end: int) -> bytes:
+        cached = self.cache.get(self.path, start, end)
+        if cached is not None:
+            return cached
+        data = self.storage.get_slice(self.path, start, end)
+        self.cache.put(self.path, start, data)
+        return data
+
+    def _array_meta(self, name: str) -> ArrayMeta:
+        meta = self.footer.arrays.get(name)
+        if meta is None:
+            raise KeyError(f"split has no array {name!r}")
+        return meta
+
+    def has_array(self, name: str) -> bool:
+        return name in self.footer.arrays
+
+    def array(self, name: str) -> np.ndarray:
+        """Fetch a whole named array (cached)."""
+        arr = self._arrays.get(name)
+        if arr is None:
+            meta = self._array_meta(name)
+            raw = self._get_slice(meta.offset, meta.offset + meta.nbytes)
+            arr = np.frombuffer(raw, dtype=np.dtype(meta.dtype)).reshape(meta.shape)
+            self._arrays[name] = arr
+        return arr
+
+    def array_slice(self, name: str, start_elem: int, num_elems: int) -> np.ndarray:
+        """Fetch `num_elems` elements of a named array without reading it all —
+        the exact-byte-range read postings warmup relies on."""
+        meta = self._array_meta(name)
+        dtype = np.dtype(meta.dtype)
+        byte_start = meta.offset + start_elem * dtype.itemsize
+        raw = self._get_slice(byte_start, byte_start + num_elems * dtype.itemsize)
+        return np.frombuffer(raw, dtype=dtype)
+
+    # --- inverted index ----------------------------------------------------
+    def term_dict(self, field: str) -> Optional[_TermDict]:
+        td = self._term_dicts.get(field)
+        if td is None:
+            if f"inv.{field}.terms.offsets" not in self.footer.arrays:
+                return None
+            td = _TermDict(
+                blob=self.array(f"inv.{field}.terms.blob").tobytes(),
+                offsets=self.array(f"inv.{field}.terms.offsets"),
+                dfs=self.array(f"inv.{field}.terms.df"),
+                post_offs=self.array(f"inv.{field}.terms.post_off"),
+                post_lens=self.array(f"inv.{field}.terms.post_len"),
+            )
+            self._term_dicts[field] = td
+        return td
+
+    def lookup_term(self, field: str, term: str) -> Optional[TermInfo]:
+        td = self.term_dict(field)
+        return td.lookup(term) if td else None
+
+    def postings(self, field: str, info: TermInfo) -> tuple[np.ndarray, np.ndarray]:
+        """Padded (doc_ids, tfs) for one term; reads only that term's range."""
+        ids = self.array_slice(f"inv.{field}.postings.ids", info.post_off, info.post_len)
+        tfs = self.array_slice(f"inv.{field}.postings.tfs", info.post_off, info.post_len)
+        return ids, tfs
+
+    def positions(self, field: str, info: TermInfo) -> tuple[np.ndarray, np.ndarray]:
+        """(offsets[post_len+1], data) position lists for a term's postings."""
+        offsets = self.array_slice(f"inv.{field}.positions.offsets",
+                                   info.post_off, info.post_len + 1)
+        data_start, data_end = int(offsets[0]), int(offsets[-1])
+        data = self.array_slice(f"inv.{field}.positions.data",
+                                data_start, data_end - data_start)
+        return offsets - data_start, data
+
+    def fieldnorm(self, field: str) -> np.ndarray:
+        return self.array(f"inv.{field}.fieldnorm")
+
+    # --- fast-field columns ------------------------------------------------
+    def column_values(self, field: str) -> tuple[np.ndarray, np.ndarray]:
+        """(values, present) for a numeric column, padded to num_docs_padded."""
+        return self.array(f"col.{field}.values"), self.array(f"col.{field}.present")
+
+    def column_ordinals(self, field: str) -> np.ndarray:
+        return self.array(f"col.{field}.ordinals")
+
+    def column_dict(self, field: str) -> list[str]:
+        blob = self.array(f"col.{field}.dict_blob").tobytes()
+        offsets = self.array(f"col.{field}.dict_offsets")
+        return [blob[offsets[i]: offsets[i + 1]].decode() for i in range(len(offsets) - 1)]
+
+    # --- doc store ---------------------------------------------------------
+    def fetch_docs(self, doc_ids: list[int]) -> list[dict[str, Any]]:
+        """Random-access doc fetch (reference: `fetch_docs.rs` over the doc
+        store); decompresses each needed block once."""
+        block_first = self.array("store.block_first_doc")
+        block_offsets = self.array("store.block_offsets")
+        by_block: dict[int, list[int]] = {}
+        for doc_id in doc_ids:
+            if not (0 <= doc_id < self.footer.num_docs):
+                raise IndexError(f"doc id {doc_id} out of range")
+            block = bisect.bisect_right(block_first, doc_id) - 1
+            by_block.setdefault(block, []).append(doc_id)
+        docs_by_id: dict[int, dict[str, Any]] = {}
+        for block, ids in by_block.items():
+            raw = self.array_slice("store.data", int(block_offsets[block]),
+                                   int(block_offsets[block + 1] - block_offsets[block]))
+            lines = zlib.decompress(raw.tobytes()).split(b"\n")
+            first = int(block_first[block])
+            for doc_id in ids:
+                docs_by_id[doc_id] = json.loads(lines[doc_id - first])
+        return [docs_by_id[d] for d in doc_ids]
+
+    # --- stats -------------------------------------------------------------
+    @property
+    def num_docs(self) -> int:
+        return self.footer.num_docs
+
+    @property
+    def num_docs_padded(self) -> int:
+        return self.footer.num_docs_padded
+
+    def field_meta(self, field: str) -> dict[str, Any]:
+        return self.footer.fields.get(field, {})

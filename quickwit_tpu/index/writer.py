@@ -1,0 +1,286 @@
+"""Split writer: typed docs → one immutable split file.
+
+Role of the reference's indexer hot loop (`quickwit-indexing/src/actors/
+indexer.rs` driving tantivy's `IndexWriter` + `Packager`'s hotcache build),
+re-targeted at the TPU array layout of `format.py`:
+
+- postings per term are **dense padded int32 arrays** (ids + term freqs),
+  padded to POSTING_PAD lanes with `id = num_docs_padded` (an out-of-bounds
+  sentinel whose scatter contributions are dropped on device) and `tf = 0`
+  (zero BM25 contribution),
+- fast fields are dense padded columns with presence masks (numeric) or
+  dictionary ordinals (raw text),
+- the doc store is zlib block-compressed JSON rows with a block index,
+- per-field stats (df, avg field length, min/max) land in the footer so BM25
+  and range pruning need no extra reads.
+"""
+
+from __future__ import annotations
+
+import json
+import zlib
+from collections import defaultdict
+from typing import Any, Optional
+
+import numpy as np
+
+from ..models.doc_mapper import DocMapper, FieldMapping, FieldType, TypedDoc, canonical_term
+from .format import DOC_PAD, POSTING_PAD, SplitFileBuilder, SplitFooter, pad_to
+
+_STORE_BLOCK_BYTES = 64 * 1024
+_NUMERIC_TYPES = (FieldType.I64, FieldType.U64, FieldType.F64, FieldType.BOOL,
+                  FieldType.DATETIME, FieldType.IP)
+
+
+class _InvertedFieldBuilder:
+    def __init__(self, fm: FieldMapping):
+        self.fm = fm
+        self.with_positions = fm.record == "position" and fm.type is FieldType.TEXT
+        # term -> ([doc_ids], [tfs], [positions])
+        self.terms: dict[str, list] = {}
+        self.fieldnorms: dict[int, int] = {}   # token count (BM25 doc length)
+        self._pos_base: dict[int, int] = {}    # next position base, with gaps
+        self.total_tokens = 0
+
+    def add(self, doc_id: int, tokens: list) -> None:
+        pos_base = self._pos_base.get(doc_id, 0)
+        by_term: dict[str, list[int]] = defaultdict(list)
+        for tok in tokens:
+            by_term[tok.text].append(pos_base + tok.position)
+        for term, positions in by_term.items():
+            entry = self.terms.get(term)
+            if entry is None:
+                entry = self.terms[term] = ([], [], [])
+            ids, tfs, poss = entry
+            if ids and ids[-1] == doc_id:
+                tfs[-1] += len(positions)
+                poss[-1].extend(positions)
+            else:
+                ids.append(doc_id)
+                tfs.append(len(positions))
+                poss.append(positions)
+        ntokens = len(tokens)
+        self.fieldnorms[doc_id] = self.fieldnorms.get(doc_id, 0) + ntokens
+        # positions of the next value for this doc start after a +1 gap so
+        # phrases cannot match across value boundaries (tantivy semantics)
+        self._pos_base[doc_id] = pos_base + ntokens + 1
+        self.total_tokens += ntokens
+
+
+class _ColumnBuilder:
+    def __init__(self, fm: FieldMapping):
+        self.fm = fm
+        self.is_numeric = fm.type in _NUMERIC_TYPES
+        self.values: dict[int, Any] = {}
+
+    def add(self, doc_id: int, value: Any) -> None:
+        # multi-valued docs keep the first value (round-1 limitation; the
+        # reference supports full multivalued fast fields)
+        self.values.setdefault(doc_id, value)
+
+
+class SplitWriter:
+    """Accumulates docs, emits the split file bytes + summary stats."""
+
+    def __init__(self, doc_mapper: DocMapper):
+        self.doc_mapper = doc_mapper
+        self.num_docs = 0
+        self._inv: dict[str, _InvertedFieldBuilder] = {
+            fm.name: _InvertedFieldBuilder(fm) for fm in doc_mapper.indexed_fields
+        }
+        self._cols: dict[str, _ColumnBuilder] = {
+            fm.name: _ColumnBuilder(fm) for fm in doc_mapper.fast_fields
+        }
+        self._sources: list[bytes] = []
+        self._uncompressed_docs_size = 0
+        self._time_min: Optional[int] = None
+        self._time_max: Optional[int] = None
+        self.tags: set[str] = set()
+
+    def add_json_doc(self, doc: dict[str, Any]) -> int:
+        return self.add_typed_doc(self.doc_mapper.doc_from_json(doc))
+
+    def add_typed_doc(self, tdoc: TypedDoc) -> int:
+        doc_id = self.num_docs
+        self.num_docs += 1
+        for field_name, values in tdoc.fields.items():
+            fm = self.doc_mapper.field(field_name)
+            if fm is None:
+                continue
+            if fm.indexed:
+                builder = self._inv[field_name]
+                for value in values:
+                    builder.add(doc_id, self.doc_mapper.tokens_for_field(fm, value))
+            if fm.fast:
+                col = self._cols[field_name]
+                for value in values:
+                    col.add(doc_id, _fast_value(fm, value))
+        ts = tdoc.timestamp_micros(self.doc_mapper.timestamp_field)
+        if ts is not None:
+            self._time_min = ts if self._time_min is None else min(self._time_min, ts)
+            self._time_max = ts if self._time_max is None else max(self._time_max, ts)
+        self.tags |= self.doc_mapper.tags(tdoc)
+        source = json.dumps(tdoc.source, separators=(",", ":")).encode()
+        self._sources.append(source)
+        self._uncompressed_docs_size += len(source)
+        return doc_id
+
+    # ------------------------------------------------------------------
+    def finish(self) -> bytes:
+        if self.num_docs == 0:
+            raise ValueError("cannot finish an empty split")
+        num_docs_padded = pad_to(self.num_docs, DOC_PAD)
+        builder = SplitFileBuilder()
+        fields_meta: dict[str, dict[str, Any]] = {}
+
+        for name, inv in self._inv.items():
+            fields_meta[name] = self._write_inverted(builder, name, inv, num_docs_padded)
+        for name, col in self._cols.items():
+            meta = fields_meta.setdefault(name, {"type": col.fm.type.value})
+            meta.update(self._write_column(builder, name, col, num_docs_padded))
+        self._write_docstore(builder)
+
+        footer = SplitFooter(
+            num_docs=self.num_docs,
+            num_docs_padded=num_docs_padded,
+            arrays={},
+            fields=fields_meta,
+            time_range=(self._time_min, self._time_max) if self._time_min is not None else None,
+            doc_mapping_uid=self.doc_mapper.doc_mapping_uid,
+            extra={"uncompressed_docs_size_bytes": self._uncompressed_docs_size},
+        )
+        return builder.finish(footer)
+
+    def _write_inverted(self, builder: SplitFileBuilder, name: str,
+                        inv: _InvertedFieldBuilder, num_docs_padded: int) -> dict[str, Any]:
+        terms_sorted = sorted(inv.terms)
+        num_terms = len(terms_sorted)
+        blob_parts: list[bytes] = []
+        offsets = np.zeros(num_terms + 1, dtype=np.int64)
+        dfs = np.zeros(num_terms, dtype=np.int32)
+        post_offs = np.zeros(num_terms, dtype=np.int64)
+        post_lens = np.zeros(num_terms, dtype=np.int32)
+
+        total_padded = sum(pad_to(len(inv.terms[t][0]), POSTING_PAD) for t in terms_sorted)
+        ids_arena = np.full(total_padded, num_docs_padded, dtype=np.int32)
+        tfs_arena = np.zeros(total_padded, dtype=np.int32)
+        pos_offsets = np.zeros(total_padded + 1, dtype=np.int64) if inv.with_positions else None
+        pos_chunks: list[list[int]] = []
+
+        cursor = 0
+        blob_len = 0
+        pos_cursor = 0
+        for t_idx, term in enumerate(terms_sorted):
+            encoded = term.encode()
+            blob_parts.append(encoded)
+            blob_len += len(encoded)
+            offsets[t_idx + 1] = blob_len
+            ids, tfs, poss = inv.terms[term]
+            df = len(ids)
+            padded = pad_to(df, POSTING_PAD)
+            dfs[t_idx] = df
+            post_offs[t_idx] = cursor
+            post_lens[t_idx] = padded
+            ids_arena[cursor:cursor + df] = ids
+            tfs_arena[cursor:cursor + df] = tfs
+            if pos_offsets is not None:
+                for i, doc_positions in enumerate(poss):
+                    pos_offsets[cursor + i] = pos_cursor
+                    pos_chunks.append(doc_positions)
+                    pos_cursor += len(doc_positions)
+                pos_offsets[cursor + df: cursor + padded + 1] = pos_cursor
+            cursor += padded
+
+        builder.add_array(f"inv.{name}.terms.blob",
+                          np.frombuffer(b"".join(blob_parts), dtype=np.uint8))
+        builder.add_array(f"inv.{name}.terms.offsets", offsets)
+        builder.add_array(f"inv.{name}.terms.df", dfs)
+        builder.add_array(f"inv.{name}.terms.post_off", post_offs)
+        builder.add_array(f"inv.{name}.terms.post_len", post_lens)
+        builder.add_array(f"inv.{name}.postings.ids", ids_arena)
+        builder.add_array(f"inv.{name}.postings.tfs", tfs_arena)
+        if pos_offsets is not None:
+            builder.add_array(f"inv.{name}.positions.offsets", pos_offsets)
+            pos_data = np.array([p for chunk in pos_chunks for p in chunk], dtype=np.int32)
+            builder.add_array(f"inv.{name}.positions.data", pos_data)
+
+        norms = np.zeros(num_docs_padded, dtype=np.int32)
+        for doc_id, length in inv.fieldnorms.items():
+            norms[doc_id] = length
+        builder.add_array(f"inv.{name}.fieldnorm", norms)
+
+        return {
+            "type": inv.fm.type.value,
+            "tokenizer": inv.fm.tokenizer,
+            "record": inv.fm.record,
+            "indexed": True,
+            "num_terms": num_terms,
+            "total_tokens": inv.total_tokens,
+            "avg_len": (inv.total_tokens / self.num_docs) if self.num_docs else 0.0,
+        }
+
+    def _write_column(self, builder: SplitFileBuilder, name: str,
+                      col: _ColumnBuilder, num_docs_padded: int) -> dict[str, Any]:
+        present = np.zeros(num_docs_padded, dtype=np.uint8)
+        doc_ids = np.fromiter(col.values.keys(), dtype=np.int64, count=len(col.values))
+        present[doc_ids] = 1
+        if col.is_numeric:
+            dtype = np.float64 if col.fm.type is FieldType.F64 else np.int64
+            values = np.zeros(num_docs_padded, dtype=dtype)
+            vals = np.fromiter(col.values.values(), dtype=dtype, count=len(col.values))
+            values[doc_ids] = vals
+            builder.add_array(f"col.{name}.values", values)
+            builder.add_array(f"col.{name}.present", present)
+            return {
+                "fast": True, "column_kind": "numeric",
+                "min_value": (vals.min().item() if len(vals) else None),
+                "max_value": (vals.max().item() if len(vals) else None),
+            }
+        # dictionary-encoded raw text column (terms-agg substrate)
+        uniques = sorted({str(v) for v in col.values.values()})
+        ordinal_of = {term: i for i, term in enumerate(uniques)}
+        ordinals = np.full(num_docs_padded, -1, dtype=np.int32)
+        for doc_id, value in col.values.items():
+            ordinals[doc_id] = ordinal_of[str(value)]
+        blob = "".join(uniques).encode()
+        dict_offsets = np.zeros(len(uniques) + 1, dtype=np.int64)
+        acc = 0
+        for i, term in enumerate(uniques):
+            acc += len(term.encode())
+            dict_offsets[i + 1] = acc
+        builder.add_array(f"col.{name}.ordinals", ordinals)
+        builder.add_array(f"col.{name}.dict_blob", np.frombuffer(blob, dtype=np.uint8))
+        builder.add_array(f"col.{name}.dict_offsets", dict_offsets)
+        return {"fast": True, "column_kind": "ordinal", "cardinality": len(uniques)}
+
+    def _write_docstore(self, builder: SplitFileBuilder) -> None:
+        blocks: list[bytes] = []
+        block_first_doc = [0]
+        block_offsets = [0]
+        current: list[bytes] = []
+        current_size = 0
+        for doc_id, source in enumerate(self._sources):
+            current.append(source)
+            current_size += len(source) + 1
+            if current_size >= _STORE_BLOCK_BYTES:
+                blocks.append(zlib.compress(b"\n".join(current), 1))
+                block_offsets.append(block_offsets[-1] + len(blocks[-1]))
+                block_first_doc.append(doc_id + 1)
+                current, current_size = [], 0
+        if current:
+            blocks.append(zlib.compress(b"\n".join(current), 1))
+            block_offsets.append(block_offsets[-1] + len(blocks[-1]))
+            block_first_doc.append(self.num_docs)
+        builder.add_array("store.data", np.frombuffer(b"".join(blocks), dtype=np.uint8))
+        builder.add_array("store.block_offsets", np.array(block_offsets, dtype=np.int64))
+        builder.add_array("store.block_first_doc", np.array(block_first_doc, dtype=np.int32))
+
+
+def _fast_value(fm: FieldMapping, value: Any):
+    if fm.type is FieldType.BOOL:
+        return 1 if value else 0
+    if fm.type in (FieldType.I64, FieldType.U64, FieldType.DATETIME, FieldType.IP):
+        return int(value)
+    if fm.type is FieldType.F64:
+        return float(value)
+    return canonical_term(fm, value) if fm.type is not FieldType.TEXT else str(value)

@@ -1,0 +1,3 @@
+from . import aggs, bm25, masks, topk
+
+__all__ = ["masks", "bm25", "topk", "aggs"]

@@ -1,0 +1,76 @@
+"""Dense doc-set masks — the TPU replacement for posting-list iteration.
+
+The reference's leaf loop (tantivy posting decode → boolean
+intersection/union, SURVEY.md §3.2 hot box) walks compressed posting lists
+with scalar cursors. On TPU the doc set of a split is a **dense bool vector**
+of length `num_docs_padded`: term postings scatter into it, boolean operators
+are elementwise VPU ops, ranges are vectorized compares on resident columns.
+Everything here is shape-static and jit-safe.
+
+Padding convention (see index/writer.py): posting pad slots carry
+`doc_id == num_docs_padded` (out of bounds → dropped by scatter `mode="drop"`)
+and `tf == 0`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def mask_from_postings(doc_ids: jnp.ndarray, num_docs_padded: int) -> jnp.ndarray:
+    """Presence mask from a (padded) posting id array."""
+    mask = jnp.zeros(num_docs_padded, dtype=jnp.bool_)
+    return mask.at[doc_ids].set(True, mode="drop")
+
+
+def dense_from_postings(doc_ids: jnp.ndarray, values: jnp.ndarray,
+                        num_docs_padded: int, dtype=jnp.float32) -> jnp.ndarray:
+    """Scatter per-posting values (tf, scores) into a dense per-doc array."""
+    dense = jnp.zeros(num_docs_padded, dtype=dtype)
+    return dense.at[doc_ids].add(values.astype(dtype), mode="drop")
+
+
+def valid_docs_mask(num_docs: jnp.ndarray, num_docs_padded: int) -> jnp.ndarray:
+    """True for real docs, False for the pad tail."""
+    return jnp.arange(num_docs_padded, dtype=jnp.int32) < num_docs
+
+
+def and_masks(*ms: jnp.ndarray) -> jnp.ndarray:
+    out = ms[0]
+    for m in ms[1:]:
+        out = out & m
+    return out
+
+
+def or_masks(*ms: jnp.ndarray) -> jnp.ndarray:
+    out = ms[0]
+    for m in ms[1:]:
+        out = out | m
+    return out
+
+
+def not_mask(m: jnp.ndarray) -> jnp.ndarray:
+    return ~m
+
+
+def range_mask(values: jnp.ndarray, present: jnp.ndarray,
+               lower, upper, lower_incl: bool, upper_incl: bool,
+               has_lower: bool, has_upper: bool) -> jnp.ndarray:
+    """Range predicate over a numeric fast column.
+
+    `has_*`/`*_incl` are static (they shape the compiled graph); the bounds
+    themselves are traced scalars so the same compiled plan serves different
+    bound values.
+    """
+    mask = present.astype(jnp.bool_)
+    if has_lower:
+        mask = mask & (values >= lower if lower_incl else values > lower)
+    if has_upper:
+        mask = mask & (values <= upper if upper_incl else values < upper)
+    return mask
+
+
+def minimum_should_match_mask(should_masks: list[jnp.ndarray], min_count: int) -> jnp.ndarray:
+    """At least `min_count` of the masks true (bool `should` semantics)."""
+    counts = sum(m.astype(jnp.int32) for m in should_masks)
+    return counts >= min_count

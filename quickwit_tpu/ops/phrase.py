@@ -1,0 +1,65 @@
+"""Host-side phrase matching over positional postings.
+
+Role of tantivy's `PhraseScorer` in the reference's leaf loop. Phrase
+evaluation is a *pre-pass* in this engine: it runs on the (host-resident)
+postings + positions of the phrase terms and produces a precomputed posting
+list (doc ids + phrase frequencies) that enters the device plan like any
+term's postings. This keeps the device graph static while supporting exact
+phrases; a Pallas positional kernel is the planned upgrade path.
+
+Only slop=0 (exact adjacency) is implemented; non-zero slop raises.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def phrase_match(
+    postings: list[tuple[np.ndarray, np.ndarray]],
+    positions: list[tuple[np.ndarray, np.ndarray]],
+    dfs: list[int],
+    slop: int = 0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Docs containing the terms as an exact phrase.
+
+    `postings[i] = (padded_ids, padded_tfs)` and
+    `positions[i] = (offsets[post_len+1], data)` for phrase term i, with
+    `dfs[i]` real (unpadded) postings. Returns (doc_ids, phrase_freqs),
+    unpadded, sorted by doc id.
+    """
+    if slop != 0:
+        raise NotImplementedError("phrase slop > 0 not supported yet")
+    if not postings:
+        return np.array([], dtype=np.int32), np.array([], dtype=np.int32)
+
+    # intersect doc ids across all terms, tracking each term's posting index
+    ids0 = postings[0][0][: dfs[0]]
+    common = ids0
+    for (ids, _), df in zip(postings[1:], dfs[1:]):
+        common = np.intersect1d(common, ids[:df], assume_unique=True)
+        if common.size == 0:
+            return np.array([], dtype=np.int32), np.array([], dtype=np.int32)
+
+    out_ids: list[int] = []
+    out_freqs: list[int] = []
+    # per-term posting index of each common doc
+    term_indices = []
+    for (ids, _), df in zip(postings, dfs):
+        term_indices.append(np.searchsorted(ids[:df], common))
+
+    for row, doc_id in enumerate(common):
+        offsets0, data0 = positions[0]
+        j0 = term_indices[0][row]
+        base = data0[offsets0[j0]: offsets0[j0 + 1]].astype(np.int64)
+        for i in range(1, len(postings)):
+            offs, data = positions[i]
+            ji = term_indices[i][row]
+            pos_i = data[offs[ji]: offs[ji + 1]].astype(np.int64)
+            base = np.intersect1d(base, pos_i - i, assume_unique=True)
+            if base.size == 0:
+                break
+        if base.size:
+            out_ids.append(int(doc_id))
+            out_freqs.append(int(base.size))
+    return np.array(out_ids, dtype=np.int32), np.array(out_freqs, dtype=np.int32)

@@ -1,0 +1,143 @@
+"""Aggregation request model (ES-compatible subset).
+
+Role of the reference's aggregation proxy types (`quickwit-query/src/
+aggregations.rs` + tantivy's aggregation request JSON): parses the ES
+`aggs` request dict into typed specs the leaf executor lowers onto columnar
+kernels (`ops/aggs.py`).
+
+Supported (round 1): date_histogram (fixed_interval), histogram, terms,
+avg/min/max/sum/stats/value_count, percentiles. Sub-aggregations are parsed
+but only metric-under-bucket is executed (one level), matching the
+benchmark configs; deeper nesting raises.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+_INTERVAL_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s|m|h|d)$")
+_INTERVAL_MICROS = {"ms": 1_000, "s": 1_000_000, "m": 60_000_000,
+                    "h": 3_600_000_000, "d": 86_400_000_000}
+
+
+class AggParseError(ValueError):
+    pass
+
+
+def parse_interval_micros(text: str) -> int:
+    m = _INTERVAL_RE.match(text.strip())
+    if not m:
+        raise AggParseError(f"unsupported interval {text!r} (use e.g. 30s, 5m, 1d)")
+    return int(float(m.group(1)) * _INTERVAL_MICROS[m.group(2)])
+
+
+@dataclass(frozen=True)
+class MetricAgg:
+    name: str
+    kind: str          # avg | min | max | sum | stats | value_count | percentiles
+    field: str
+    percents: tuple[float, ...] = (1, 5, 25, 50, 75, 95, 99)
+
+
+@dataclass(frozen=True)
+class DateHistogramAgg:
+    name: str
+    field: str
+    interval_micros: int
+    min_doc_count: int = 0
+    extended_bounds: Optional[tuple[int, int]] = None  # micros
+    sub_metrics: tuple[MetricAgg, ...] = ()
+
+
+@dataclass(frozen=True)
+class HistogramAgg:
+    name: str
+    field: str
+    interval: float
+    min_doc_count: int = 0
+    sub_metrics: tuple[MetricAgg, ...] = ()
+
+
+@dataclass(frozen=True)
+class TermsAgg:
+    name: str
+    field: str
+    size: int = 10
+    min_doc_count: int = 1
+    order_by_count_desc: bool = True
+    sub_metrics: tuple[MetricAgg, ...] = ()
+
+
+AggSpec = Any  # union of the four dataclasses above
+
+
+_METRIC_KINDS = ("avg", "min", "max", "sum", "stats", "value_count", "percentiles")
+
+
+def _parse_metric(name: str, kind: str, body: dict[str, Any]) -> MetricAgg:
+    if "field" not in body:
+        raise AggParseError(f"aggregation {name!r}: metric {kind} requires a field")
+    percents = tuple(body.get("percents", (1, 5, 25, 50, 75, 95, 99)))
+    return MetricAgg(name=name, kind=kind, field=body["field"], percents=percents)
+
+
+def _parse_sub_aggs(name: str, sub: dict[str, Any]) -> tuple[MetricAgg, ...]:
+    metrics = []
+    for sub_name, sub_body in sub.items():
+        sub_kind = _agg_kind(sub_body)
+        if sub_kind not in _METRIC_KINDS:
+            raise AggParseError(
+                f"aggregation {name!r}: only metric sub-aggregations supported, got {sub_kind}")
+        metrics.append(_parse_metric(sub_name, sub_kind, sub_body[sub_kind]))
+    return tuple(metrics)
+
+
+def _agg_kind(body: dict[str, Any]) -> str:
+    kinds = [k for k in body if k not in ("aggs", "aggregations", "meta")]
+    if len(kinds) != 1:
+        raise AggParseError(f"aggregation body must have exactly one kind, got {kinds}")
+    return kinds[0]
+
+
+def parse_aggs(aggs: dict[str, Any]) -> list[AggSpec]:
+    """ES `aggs` dict → typed specs."""
+    specs: list[AggSpec] = []
+    for name, body in aggs.items():
+        kind = _agg_kind(body)
+        params = body[kind]
+        sub = body.get("aggs") or body.get("aggregations") or {}
+        sub_metrics = _parse_sub_aggs(name, sub)
+        if kind == "date_histogram":
+            interval = params.get("fixed_interval") or params.get("interval")
+            if interval is None:
+                raise AggParseError(f"date_histogram {name!r} requires fixed_interval")
+            bounds = None
+            if "extended_bounds" in params:
+                b = params["extended_bounds"]
+                bounds = (int(b["min"]) * 1000, int(b["max"]) * 1000) \
+                    if params.get("bounds_unit") == "ms" else (int(b["min"]), int(b["max"]))
+            specs.append(DateHistogramAgg(
+                name=name, field=params["field"],
+                interval_micros=parse_interval_micros(interval),
+                min_doc_count=params.get("min_doc_count", 0),
+                extended_bounds=bounds, sub_metrics=sub_metrics))
+        elif kind == "histogram":
+            specs.append(HistogramAgg(
+                name=name, field=params["field"], interval=float(params["interval"]),
+                min_doc_count=params.get("min_doc_count", 0), sub_metrics=sub_metrics))
+        elif kind == "terms":
+            order = params.get("order", {"_count": "desc"})
+            specs.append(TermsAgg(
+                name=name, field=params["field"], size=params.get("size", 10),
+                min_doc_count=params.get("min_doc_count", 1),
+                order_by_count_desc=order.get("_count", "desc") == "desc",
+                sub_metrics=sub_metrics))
+        elif kind in _METRIC_KINDS:
+            if sub_metrics:
+                raise AggParseError(f"metric aggregation {name!r} cannot have sub-aggs")
+            specs.append(_parse_metric(name, kind, params))
+        else:
+            raise AggParseError(f"unsupported aggregation kind {kind!r}")
+    return specs

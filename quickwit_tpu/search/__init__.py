@@ -1,0 +1,12 @@
+from .models import (
+    LeafSearchResponse, PartialHit, SearchRequest, SearchResponse, SortField,
+    SplitIdAndFooter, SplitSearchError,
+)
+from .leaf import leaf_search_single_split
+from .collector import IncrementalCollector, finalize_aggregations
+
+__all__ = [
+    "SearchRequest", "SearchResponse", "LeafSearchResponse", "PartialHit",
+    "SortField", "SplitIdAndFooter", "SplitSearchError",
+    "leaf_search_single_split", "IncrementalCollector", "finalize_aggregations",
+]

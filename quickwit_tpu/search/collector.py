@@ -1,0 +1,297 @@
+"""Incremental merge of leaf responses + aggregation finalization.
+
+Role of the reference's `IncrementalCollector` (`collector.rs:1195`) and
+root-side `merge_fruits` / `finalize_aggregation` (`root.rs:841,1120`): leaf
+responses merge associatively — hit lists by sort key, aggregation states by
+bucket key — so the same code runs the segment→split→node→root merge tree at
+any level.
+
+Internal hit ordering convention: `PartialHit.sort_value` is float64
+"higher is better"; ties break by (split_id, doc_id) ascending, matching the
+reference's doc-address tie-break.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from ..ops.aggs import sketch_quantiles
+from .models import LeafSearchResponse, PartialHit
+
+
+def _hit_order_key(h: PartialHit):
+    return (-h.sort_value, h.split_id, h.doc_id)
+
+
+class IncrementalCollector:
+    def __init__(self, max_hits: int, start_offset: int = 0,
+                 search_after: Optional[tuple] = None):
+        self.max_hits = max_hits
+        self.start_offset = start_offset
+        self.search_after = search_after  # (sort_value, split_id, doc_id) internal
+        self.num_hits = 0
+        self.failed_splits: list = []
+        self.num_attempted_splits = 0
+        self.num_successful_splits = 0
+        self._hits: list[PartialHit] = []
+        self._agg_states: dict[str, Any] = {}
+        self.resource_stats: dict[str, float] = {}
+
+    # ------------------------------------------------------------------
+    def add_leaf_response(self, leaf: LeafSearchResponse) -> None:
+        self.num_hits += leaf.num_hits
+        self.failed_splits.extend(leaf.failed_splits)
+        self.num_attempted_splits += leaf.num_attempted_splits
+        self.num_successful_splits += leaf.num_successful_splits
+        for key, value in leaf.resource_stats.items():
+            self.resource_stats[key] = self.resource_stats.get(key, 0) + value
+        hits = leaf.partial_hits
+        if self.search_after is not None:
+            hits = [h for h in hits
+                    if (-h.sort_value, h.split_id, h.doc_id) >
+                    (-self.search_after[0], self.search_after[1], self.search_after[2])]
+        self._hits.extend(hits)
+        keep = self.start_offset + self.max_hits
+        if len(self._hits) > 4 * max(keep, 1):
+            self._hits.sort(key=_hit_order_key)
+            del self._hits[keep:]
+        for name, state in leaf.intermediate_aggs.items():
+            self._merge_agg(name, state)
+
+    # ------------------------------------------------------------------
+    def _merge_agg(self, name: str, state: dict[str, Any]) -> None:
+        current = self._agg_states.get(name)
+        if current is None:
+            self._agg_states[name] = _copy_state(state)
+            return
+        kind = state["kind"]
+        if kind in ("date_histogram", "histogram"):
+            _merge_histogram(current, state)
+        elif kind == "terms":
+            _merge_terms(current, state)
+        elif kind == "percentiles":
+            current["sketch"] = current["sketch"] + state["sketch"]
+        else:  # metric state [count,sum,sum_sq,min,max]
+            a, b = current["state"], state["state"]
+            current["state"] = np.array([
+                a[0] + b[0], a[1] + b[1], a[2] + b[2],
+                min(a[3], b[3]), max(a[4], b[4])])
+
+    # ------------------------------------------------------------------
+    def partial_hits(self) -> list[PartialHit]:
+        self._hits.sort(key=_hit_order_key)
+        return self._hits[self.start_offset: self.start_offset + self.max_hits]
+
+    def to_leaf_response(self) -> LeafSearchResponse:
+        """Re-emit as a leaf response (for tree-merging at the node level)."""
+        self._hits.sort(key=_hit_order_key)
+        return LeafSearchResponse(
+            num_hits=self.num_hits,
+            partial_hits=self._hits[: self.start_offset + self.max_hits],
+            failed_splits=self.failed_splits,
+            num_attempted_splits=self.num_attempted_splits,
+            num_successful_splits=self.num_successful_splits,
+            intermediate_aggs=self._agg_states,
+            resource_stats=self.resource_stats,
+        )
+
+    def aggregation_states(self) -> dict[str, Any]:
+        return self._agg_states
+
+
+# --------------------------------------------------------------------------
+# merge helpers: bucket states keyed absolutely so per-split origins align
+
+def _copy_state(state: dict[str, Any]) -> dict[str, Any]:
+    kind = state["kind"]
+    if kind in ("date_histogram", "histogram"):
+        copy = dict(state)
+        copy["bucket_map"] = _histogram_to_map(state)
+        copy.pop("counts", None)
+        copy.pop("metrics", None)
+        return copy
+    if kind == "terms":
+        copy = dict(state)
+        copy["bucket_map"] = _terms_to_map(state)
+        copy.pop("counts", None)
+        copy.pop("metrics", None)
+        copy.pop("keys", None)
+        return copy
+    return dict(state)
+
+
+def _new_metric_acc(kind: str) -> dict[str, Any]:
+    return {"sum": 0.0, "count": 0, "min": np.inf, "max": -np.inf, "sum_sq": 0.0,
+            "kind": kind}
+
+
+def _acc_metric(acc: dict[str, Any], arrays: dict[str, np.ndarray], i: int) -> None:
+    if "sum" in arrays:
+        acc["sum"] += float(arrays["sum"][i])
+    if "count" in arrays:
+        acc["count"] += int(arrays["count"][i])
+    if "min" in arrays:
+        acc["min"] = min(acc["min"], float(arrays["min"][i]))
+    if "max" in arrays:
+        acc["max"] = max(acc["max"], float(arrays["max"][i]))
+    if "sum_sq" in arrays:
+        acc["sum_sq"] += float(arrays["sum_sq"][i])
+
+
+def _histogram_to_map(state: dict[str, Any]) -> dict[float, dict[str, Any]]:
+    counts = state["counts"]
+    origin, interval = state["origin"], state["interval"]
+    out: dict[float, dict[str, Any]] = {}
+    nonzero = np.nonzero(counts)[0] if not state.get("extended_bounds") \
+        else np.arange(len(counts))
+    metric_kinds = state.get("metric_kinds", {})
+    for i in nonzero:
+        key = origin + int(i) * interval
+        bucket = {"doc_count": int(counts[i]), "metrics": {}}
+        for mname, arrays in state.get("metrics", {}).items():
+            acc = _new_metric_acc(metric_kinds.get(mname, "avg"))
+            _acc_metric(acc, arrays, int(i))
+            bucket["metrics"][mname] = acc
+        out[key] = bucket
+    return out
+
+
+def _merge_bucket_maps(bucket_map: dict, incoming: dict) -> None:
+    for key, bucket in incoming.items():
+        cur = bucket_map.get(key)
+        if cur is None:
+            bucket_map[key] = bucket
+            continue
+        cur["doc_count"] += bucket["doc_count"]
+        for mname, acc in bucket["metrics"].items():
+            cacc = cur["metrics"].get(mname)
+            if cacc is None:
+                cur["metrics"][mname] = acc
+            else:
+                cacc["sum"] += acc["sum"]
+                cacc["count"] += acc["count"]
+                cacc["min"] = min(cacc["min"], acc["min"])
+                cacc["max"] = max(cacc["max"], acc["max"])
+                cacc["sum_sq"] += acc["sum_sq"]
+
+
+def _merge_histogram(current: dict[str, Any], state: dict[str, Any]) -> None:
+    _merge_bucket_maps(current["bucket_map"], _histogram_to_map(state))
+    if state.get("extended_bounds") and not current.get("extended_bounds"):
+        current["extended_bounds"] = state["extended_bounds"]
+
+
+def _terms_to_map(state: dict[str, Any]) -> dict[Any, dict[str, Any]]:
+    counts = state["counts"]
+    keys = state["keys"]
+    metric_kinds = state.get("metric_kinds", {})
+    out: dict[Any, dict[str, Any]] = {}
+    for i in np.nonzero(counts)[0]:
+        if i >= len(keys):
+            continue
+        bucket = {"doc_count": int(counts[i]), "metrics": {}}
+        for mname, arrays in state.get("metrics", {}).items():
+            acc = _new_metric_acc(metric_kinds.get(mname, "avg"))
+            _acc_metric(acc, arrays, int(i))
+            bucket["metrics"][mname] = acc
+        out[keys[i]] = bucket
+    return out
+
+
+def _merge_terms(current: dict[str, Any], state: dict[str, Any]) -> None:
+    _merge_bucket_maps(current["bucket_map"], _terms_to_map(state))
+
+
+# --------------------------------------------------------------------------
+# finalization → ES-shaped aggregation results
+
+def _finalize_metric(acc: dict[str, Any]) -> dict[str, Any]:
+    kind = acc["kind"]
+    count = acc["count"]
+    if kind == "value_count":
+        return {"value": count}
+    if kind == "sum":
+        return {"value": acc["sum"]}
+    if kind == "avg":
+        return {"value": (acc["sum"] / count) if count else None}
+    if kind == "min":
+        return {"value": acc["min"] if np.isfinite(acc["min"]) else None}
+    if kind == "max":
+        return {"value": acc["max"] if np.isfinite(acc["max"]) else None}
+    if kind == "stats":
+        return {
+            "count": count, "sum": acc["sum"],
+            "min": acc["min"] if np.isfinite(acc["min"]) else None,
+            "max": acc["max"] if np.isfinite(acc["max"]) else None,
+            "avg": (acc["sum"] / count) if count else None,
+        }
+    raise ValueError(f"unknown metric kind {kind}")
+
+
+def finalize_aggregations(agg_states: dict[str, Any]) -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    for name, state in agg_states.items():
+        state = _copy_state(state) if "bucket_map" not in state and state["kind"] in (
+            "date_histogram", "histogram", "terms") else state
+        kind = state["kind"]
+        if kind in ("date_histogram", "histogram"):
+            min_dc = state.get("min_doc_count", 0)
+            bucket_map = state["bucket_map"]
+            keys = sorted(bucket_map)
+            bounds = state.get("extended_bounds")
+            interval = state["interval"]
+            if keys and min_dc == 0:
+                # ES semantics: empty buckets are materialized across the
+                # observed range (and any extended_bounds) when min_doc_count=0
+                lo, hi = keys[0], keys[-1]
+                if bounds and kind == "date_histogram":
+                    lo = min(lo, (bounds[0] // interval) * interval)
+                    hi = max(hi, (bounds[1] // interval) * interval)
+                num = int(round((hi - lo) / interval)) + 1
+                keys = [lo + i * interval for i in range(num)]
+            buckets = []
+            for key in keys:
+                bucket = bucket_map.get(key, {"doc_count": 0, "metrics": {}})
+                if bucket["doc_count"] < min_dc:
+                    continue
+                entry: dict[str, Any] = {"doc_count": bucket["doc_count"]}
+                if kind == "date_histogram":
+                    entry["key"] = key / 1000.0   # ES convention: epoch millis
+                else:
+                    entry["key"] = key
+                for mname, acc in bucket["metrics"].items():
+                    entry[mname] = _finalize_metric(acc)
+                buckets.append(entry)
+            out[name] = {"buckets": buckets}
+        elif kind == "terms":
+            bucket_map = state["bucket_map"]
+            min_dc = state.get("min_doc_count", 1)
+            items = [(k, b) for k, b in bucket_map.items() if b["doc_count"] >= min_dc]
+            if state.get("order_desc", True):
+                items.sort(key=lambda kb: (-kb[1]["doc_count"], str(kb[0])))
+            else:  # ES order {"_count": "asc"}: rarest terms first
+                items.sort(key=lambda kb: (kb[1]["doc_count"], str(kb[0])))
+            size = state.get("size", 10)
+            total_other = sum(b["doc_count"] for _, b in items[size:])
+            buckets = []
+            for key, bucket in items[:size]:
+                entry = {"key": key, "doc_count": bucket["doc_count"]}
+                for mname, acc in bucket["metrics"].items():
+                    entry[mname] = _finalize_metric(acc)
+                buckets.append(entry)
+            out[name] = {"buckets": buckets,
+                         "sum_other_doc_count": int(total_other),
+                         "doc_count_error_upper_bound": 0}
+        elif kind == "percentiles":
+            quantiles = sketch_quantiles(state["sketch"],
+                                         [p / 100.0 for p in state["percents"]])
+            out[name] = {"values": {f"{p:g}": v for p, v in
+                                    zip(state["percents"], quantiles)}}
+        else:
+            c, s, s2, mn, mx = state["state"]
+            acc = {"kind": kind, "count": int(c), "sum": float(s),
+                   "sum_sq": float(s2), "min": float(mn), "max": float(mx)}
+            out[name] = _finalize_metric(acc)
+    return out

@@ -1,0 +1,153 @@
+"""Search request/response contracts.
+
+Role of the reference's proto messages (`search.proto:205` SearchRequest,
+`:360` LeafSearchRequest/Response, `:616` failed_splits) — the wire-stable
+seam between root and leaf searchers. JSON-serializable dataclasses here;
+gRPC/REST encodings wrap these in `serve/`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..query.ast import QueryAst, ast_from_dict
+
+
+@dataclass(frozen=True)
+class SortField:
+    """Sort spec: `field` is a fast field name, or "_score" (BM25 desc by
+    default), or "_doc"."""
+    field: str = "_score"
+    order: str = "desc"  # "asc" | "desc"
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"field": self.field, "order": self.order}
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "SortField":
+        return SortField(d.get("field", "_score"), d.get("order", "desc"))
+
+
+@dataclass
+class SearchRequest:
+    index_ids: list[str]
+    query_ast: QueryAst
+    max_hits: int = 20
+    start_offset: int = 0
+    sort_fields: tuple[SortField, ...] = (SortField(),)
+    aggs: Optional[dict[str, Any]] = None          # ES aggs request dict
+    start_timestamp: Optional[int] = None          # micros, inclusive
+    end_timestamp: Optional[int] = None            # micros, exclusive (reference semantics)
+    count_hits_exact: bool = True
+    search_after: Optional[list[Any]] = None       # sort values of last hit
+    snippet_fields: tuple[str, ...] = ()
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "index_ids": self.index_ids,
+            "query_ast": self.query_ast.to_dict(),
+            "max_hits": self.max_hits,
+            "start_offset": self.start_offset,
+            "sort_fields": [s.to_dict() for s in self.sort_fields],
+            "aggs": self.aggs,
+            "start_timestamp": self.start_timestamp,
+            "end_timestamp": self.end_timestamp,
+            "count_hits_exact": self.count_hits_exact,
+            "search_after": self.search_after,
+            "snippet_fields": list(self.snippet_fields),
+        }
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "SearchRequest":
+        return SearchRequest(
+            index_ids=d["index_ids"],
+            query_ast=ast_from_dict(d["query_ast"]),
+            max_hits=d.get("max_hits", 20),
+            start_offset=d.get("start_offset", 0),
+            sort_fields=tuple(SortField.from_dict(s) for s in d.get("sort_fields", [{}])),
+            aggs=d.get("aggs"),
+            start_timestamp=d.get("start_timestamp"),
+            end_timestamp=d.get("end_timestamp"),
+            count_hits_exact=d.get("count_hits_exact", True),
+            search_after=d.get("search_after"),
+            snippet_fields=tuple(d.get("snippet_fields", ())),
+        )
+
+
+@dataclass(frozen=True)
+class PartialHit:
+    """Phase-1 hit: address + sort values, no document body
+    (reference: `search.proto` PartialHit)."""
+    sort_value: float          # primary sort key, already "higher is better"
+    split_id: str
+    doc_id: int
+    raw_sort_value: Any = None  # original-typed value for search_after/display
+
+    def address(self) -> tuple[str, int]:
+        return (self.split_id, self.doc_id)
+
+
+@dataclass
+class SplitSearchError:
+    split_id: str
+    error: str
+    retryable: bool = True
+
+
+@dataclass
+class LeafSearchResponse:
+    """Per-leaf mergeable result (reference: `search.proto` LeafSearchResponse)."""
+    num_hits: int = 0
+    partial_hits: list[PartialHit] = field(default_factory=list)
+    failed_splits: list[SplitSearchError] = field(default_factory=list)
+    num_attempted_splits: int = 0
+    num_successful_splits: int = 0
+    # agg name -> intermediate state dict (kind-specific, numpy-backed)
+    intermediate_aggs: dict[str, Any] = field(default_factory=dict)
+    resource_stats: dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class Hit:
+    """Final hit with document body (phase 2)."""
+    doc: dict[str, Any]
+    score: Optional[float]
+    sort_values: list[Any]
+    split_id: str
+    doc_id: int
+    snippets: Optional[dict[str, list[str]]] = None
+
+
+@dataclass
+class SearchResponse:
+    num_hits: int = 0
+    hits: list[Hit] = field(default_factory=list)
+    elapsed_time_micros: int = 0
+    errors: list[str] = field(default_factory=list)
+    aggregations: Optional[dict[str, Any]] = None
+    scroll_id: Optional[str] = None
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "num_hits": self.num_hits,
+            "hits": [
+                {"doc": h.doc, "score": h.score, "sort_values": h.sort_values,
+                 "split_id": h.split_id, "doc_id": h.doc_id,
+                 **({"snippets": h.snippets} if h.snippets else {})}
+                for h in self.hits
+            ],
+            "elapsed_time_micros": self.elapsed_time_micros,
+            "errors": self.errors,
+            "aggregations": self.aggregations,
+            **({"scroll_id": self.scroll_id} if self.scroll_id else {}),
+        }
+
+
+@dataclass(frozen=True)
+class SplitIdAndFooter:
+    """What a leaf needs to open a split (reference: SplitIdAndFooterOffsets)."""
+    split_id: str
+    storage_uri: str   # storage root holding `{split_id}.split`
+    file_len: Optional[int] = None
+    footer_hint: Optional[int] = None

@@ -1,0 +1,677 @@
+"""QueryAst → tensor plan lowering.
+
+Role of the reference's `DocMapper::query` + `query_builder.rs` (QueryAst →
+tantivy Query + WarmupInfo): against a concrete split, resolve every AST node
+into a **static-structure plan** over named device arrays:
+
+- terms resolve to padded posting arrays (ids/tfs) + per-term idf scalars,
+- ranges resolve to column slots + traced bound scalars,
+- phrases are pre-matched host-side (`ops/phrase.py`) into precomputed
+  posting arrays,
+- wildcard/regex expand against the term dictionary into term sets,
+- aggregations resolve to column slots + static bucket counts.
+
+The plan's `signature` captures only structure + shapes + static params, so
+the jitted executor (executor.py) is cached across queries that differ only
+in term values/bounds — term data and idf/bounds travel as traced inputs.
+
+Everything here is host code doing exact-byte-range IO through SplitReader
+(the warmup role, `leaf.rs:304`): after lowering, the arrays list is the
+complete set of buffers the kernel needs in HBM.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Optional
+
+import numpy as np
+
+from ..models.doc_mapper import DocMapper, FieldMapping, FieldType, canonical_term
+from ..ops.bm25 import idf as bm25_idf
+from ..ops.phrase import phrase_match
+from ..query import ast as Q
+from ..query.aggregations import (
+    AggSpec, DateHistogramAgg, HistogramAgg, MetricAgg, TermsAgg,
+)
+from ..query.tokenizers import get_tokenizer
+from ..index.reader import SplitReader
+from ..utils.datetime_utils import parse_datetime_to_micros
+
+MAX_EXPANSIONS = 1024
+MAX_BUCKETS = 65536  # reference: AggregationLimitsGuard default bucket limit
+
+
+class PlanError(ValueError):
+    pass
+
+
+# --------------------------------------------------------------------------
+# plan node types (static structure; data lives in slots)
+
+@dataclass(frozen=True)
+class PMatchAll:
+    def sig(self) -> str:
+        return "all"
+
+
+@dataclass(frozen=True)
+class PMatchNone:
+    def sig(self) -> str:
+        return "none"
+
+
+@dataclass(frozen=True)
+class PPostings:
+    """A (possibly precomputed) posting list; scoring via BM25 if requested."""
+    ids_slot: int
+    tfs_slot: int
+    scoring: bool
+    norm_slot: int = -1     # dense fieldnorm column (scoring only)
+    idf_slot: int = -1      # traced scalar: idf * boost
+    avg_len_slot: int = -1  # traced scalar
+
+    def sig(self) -> str:
+        return f"post({self.ids_slot},{self.tfs_slot},{self.scoring},{self.norm_slot})"
+
+
+@dataclass(frozen=True)
+class PRange:
+    values_slot: int
+    present_slot: int
+    lo_slot: int = -1
+    hi_slot: int = -1
+    lo_incl: bool = True
+    hi_incl: bool = True
+
+    def sig(self) -> str:
+        return (f"range({self.values_slot},{self.present_slot},{self.lo_slot},"
+                f"{self.hi_slot},{self.lo_incl},{self.hi_incl})")
+
+
+@dataclass(frozen=True)
+class PPresence:
+    present_slot: int  # uint8 present column OR int32 ordinals (>= 0 test)
+    is_ordinal: bool = False
+
+    def sig(self) -> str:
+        return f"pres({self.present_slot},{self.is_ordinal})"
+
+
+@dataclass(frozen=True)
+class PNormPresence:
+    norm_slot: int  # fieldnorm > 0 == field had tokens
+
+    def sig(self) -> str:
+        return f"npres({self.norm_slot})"
+
+
+@dataclass(frozen=True)
+class PBool:
+    must: tuple = ()
+    must_not: tuple = ()
+    should: tuple = ()
+    filter: tuple = ()
+    minimum_should_match: Optional[int] = None
+
+    def sig(self) -> str:
+        return ("bool(m[" + ",".join(c.sig() for c in self.must) +
+                "]n[" + ",".join(c.sig() for c in self.must_not) +
+                "]s[" + ",".join(c.sig() for c in self.should) +
+                "]f[" + ",".join(c.sig() for c in self.filter) +
+                f"]{self.minimum_should_match})")
+
+
+# --------------------------------------------------------------------------
+# aggregation executables
+
+@dataclass(frozen=True)
+class MetricSlots:
+    name: str
+    kind: str           # avg|min|max|sum|stats|value_count|percentiles
+    values_slot: int
+    present_slot: int
+    percents: tuple[float, ...] = ()
+
+    def sig(self) -> str:
+        return f"met({self.kind},{self.values_slot},{self.present_slot})"
+
+
+@dataclass(frozen=True)
+class BucketAggExec:
+    """date_histogram / histogram / terms lowered onto one bucket-index map."""
+    name: str
+    kind: str                    # "date_histogram" | "histogram" | "terms"
+    values_slot: int             # i64/f64 column or int32 ordinals
+    present_slot: int            # -1 for ordinal columns (ordinal >= 0 is presence)
+    num_buckets: int             # static
+    origin_slot: int = -1        # traced (histograms)
+    interval_slot: int = -1      # traced (histograms)
+    metrics: tuple[MetricSlots, ...] = ()
+    # host-side info for finalization (not part of jit signature)
+    host_info: Any = None
+
+    def sig(self) -> str:
+        return (f"bagg({self.kind},{self.values_slot},{self.present_slot},"
+                f"{self.num_buckets},{self.origin_slot},{self.interval_slot},"
+                + ",".join(m.sig() for m in self.metrics) + ")")
+
+
+@dataclass(frozen=True)
+class MetricAggExec:
+    name: str
+    metric: MetricSlots
+
+    def sig(self) -> str:
+        return f"magg({self.metric.sig()})"
+
+
+# --------------------------------------------------------------------------
+# sort
+
+@dataclass(frozen=True)
+class SortExec:
+    """Static sort plan: by score, by column, or by doc id."""
+    by: str                  # "score" | "column" | "doc"
+    descending: bool = True
+    values_slot: int = -1
+    present_slot: int = -1
+
+    def sig(self) -> str:
+        return f"sort({self.by},{self.descending},{self.values_slot},{self.present_slot})"
+
+
+# --------------------------------------------------------------------------
+
+@dataclass
+class LoweredPlan:
+    root: Any
+    sort: SortExec
+    aggs: list[Any]
+    arrays: list[np.ndarray]          # device inputs, slot-indexed
+    array_keys: list[str]             # cache keys for device-transfer reuse
+    scalars: list[np.ndarray]         # traced scalar inputs, slot-indexed
+    num_docs: int
+    num_docs_padded: int
+
+    def signature(self, k: int) -> tuple:
+        shapes = tuple((a.shape, str(a.dtype)) for a in self.arrays)
+        scalar_dtypes = tuple(str(s.dtype) for s in self.scalars)
+        agg_sig = ",".join(a.sig() for a in self.aggs)
+        return (self.root.sig(), self.sort.sig(), agg_sig, shapes, scalar_dtypes,
+                k, self.num_docs_padded)
+
+
+class _Builder:
+    def __init__(self, reader: SplitReader):
+        self.reader = reader
+        self.arrays: list[np.ndarray] = []
+        self.array_keys: list[str] = []
+        self.scalars: list[np.ndarray] = []
+        self._array_slots: dict[str, int] = {}
+
+    def add_array(self, key: str, fetch) -> int:
+        """Deduplicated array slot; `fetch()` runs only on first use."""
+        slot = self._array_slots.get(key)
+        if slot is None:
+            slot = len(self.arrays)
+            self.arrays.append(np.asarray(fetch()))
+            self.array_keys.append(key)
+            self._array_slots[key] = slot
+        return slot
+
+    def add_scalar(self, value, dtype) -> int:
+        self.scalars.append(np.asarray(value, dtype=dtype))
+        return len(self.scalars) - 1
+
+
+# --------------------------------------------------------------------------
+
+class Lowering:
+    def __init__(self, doc_mapper: DocMapper, reader: SplitReader):
+        self.doc_mapper = doc_mapper
+        self.reader = reader
+        self.b = _Builder(reader)
+
+    # --- helpers ----------------------------------------------------------
+    def _field(self, name: str) -> FieldMapping:
+        fm = self.doc_mapper.field(name)
+        if fm is None:
+            raise PlanError(f"unknown field {name!r}")
+        return fm
+
+    def _postings_node(self, field: str, term: str, scoring: bool,
+                       boost: float) -> Any:
+        info = self.reader.lookup_term(field, term)
+        if info is None:
+            return PMatchNone()
+        ids_slot = self.b.add_array(
+            f"post.{field}.{info.ordinal}.ids",
+            lambda: self.reader.postings(field, info)[0])
+        tfs_slot = self.b.add_array(
+            f"post.{field}.{info.ordinal}.tfs",
+            lambda: self.reader.postings(field, info)[1])
+        if not scoring:
+            return PPostings(ids_slot, tfs_slot, scoring=False)
+        meta = self.reader.field_meta(field)
+        norm_slot = self.b.add_array(
+            f"norm.{field}", lambda: self.reader.fieldnorm(field))
+        idf_value = bm25_idf(self.reader.num_docs, info.df) * boost
+        idf_slot = self.b.add_scalar(idf_value, np.float32)
+        avg_slot = self.b.add_scalar(meta.get("avg_len", 1.0), np.float32)
+        return PPostings(ids_slot, tfs_slot, True, norm_slot, idf_slot, avg_slot)
+
+    def _precomputed_node(self, key: str, ids: np.ndarray, freqs: np.ndarray,
+                          field: str, scoring: bool, boost: float,
+                          df_for_idf: int) -> Any:
+        from ..index.format import POSTING_PAD, pad_to
+        if ids.size == 0:
+            return PMatchNone()
+        padded = pad_to(ids.size, POSTING_PAD)
+        pids = np.full(padded, self.reader.num_docs_padded, dtype=np.int32)
+        ptfs = np.zeros(padded, dtype=np.int32)
+        pids[: ids.size] = ids
+        ptfs[: freqs.size] = freqs
+        ids_slot = self.b.add_array(f"pre.{key}.ids", lambda: pids)
+        tfs_slot = self.b.add_array(f"pre.{key}.tfs", lambda: ptfs)
+        if not scoring:
+            return PPostings(ids_slot, tfs_slot, scoring=False)
+        meta = self.reader.field_meta(field)
+        norm_slot = self.b.add_array(
+            f"norm.{field}", lambda: self.reader.fieldnorm(field))
+        idf_slot = self.b.add_scalar(
+            bm25_idf(self.reader.num_docs, max(int(df_for_idf), 1)) * boost, np.float32)
+        avg_slot = self.b.add_scalar(meta.get("avg_len", 1.0), np.float32)
+        return PPostings(ids_slot, tfs_slot, True, norm_slot, idf_slot, avg_slot)
+
+    def _column_slots(self, field: str) -> tuple[int, int]:
+        fm = self._field(field)
+        if not fm.fast:
+            raise PlanError(f"field {field!r} is not a fast field")
+        values_slot = self.b.add_array(
+            f"col.{field}.values", lambda: self.reader.column_values(field)[0])
+        present_slot = self.b.add_array(
+            f"col.{field}.present", lambda: self.reader.column_values(field)[1])
+        return values_slot, present_slot
+
+    def _parse_bound(self, fm: FieldMapping, value: Any) -> Any:
+        if fm.type is FieldType.DATETIME:
+            return parse_datetime_to_micros(value, fm.input_formats) \
+                if not isinstance(value, (int, float)) or isinstance(value, bool) \
+                else parse_datetime_to_micros(value, ("unix_timestamp",))
+        if fm.type in (FieldType.I64, FieldType.U64, FieldType.IP):
+            return int(value)
+        if fm.type is FieldType.F64:
+            return float(value)
+        if fm.type is FieldType.BOOL:
+            return 1 if str(value).lower() == "true" else 0
+        raise PlanError(f"range query unsupported on field type {fm.type}")
+
+    # --- node lowering ----------------------------------------------------
+    def lower(self, ast: Q.QueryAst, scoring: bool, boost: float = 1.0) -> Any:
+        if isinstance(ast, Q.MatchAll):
+            return PMatchAll()
+        if isinstance(ast, Q.MatchNone):
+            return PMatchNone()
+        if isinstance(ast, Q.Boost):
+            return self.lower(ast.underlying, scoring, boost * ast.boost)
+        if isinstance(ast, Q.Term):
+            return self._lower_term(ast, scoring, boost)
+        if isinstance(ast, Q.TermSet):
+            nodes = []
+            for field, terms in ast.terms_per_field.items():
+                fm = self._field(field)
+                for term in terms:
+                    nodes.append(self._postings_node(
+                        field, self._canonical(fm, term), False, boost))
+            return self._or(nodes)
+        if isinstance(ast, Q.FullText):
+            return self._lower_full_text(ast, scoring, boost)
+        if isinstance(ast, Q.PhrasePrefix):
+            return self._lower_phrase_prefix(ast, scoring, boost)
+        if isinstance(ast, Q.Wildcard):
+            return self._lower_pattern(ast.field, fnmatch.translate(ast.pattern),
+                                       scoring, boost, literal_prefix=_wildcard_prefix(ast.pattern))
+        if isinstance(ast, Q.Regex):
+            return self._lower_pattern(ast.field, ast.pattern, scoring, boost,
+                                       literal_prefix=_regex_prefix(ast.pattern))
+        if isinstance(ast, Q.FieldPresence):
+            return self._lower_presence(ast.field)
+        if isinstance(ast, Q.Range):
+            return self._lower_range(ast)
+        if isinstance(ast, Q.Bool):
+            return PBool(
+                must=tuple(self.lower(c, scoring, boost) for c in ast.must),
+                must_not=tuple(self.lower(c, False, boost) for c in ast.must_not),
+                should=tuple(self.lower(c, scoring, boost) for c in ast.should),
+                filter=tuple(self.lower(c, False, boost) for c in ast.filter),
+                minimum_should_match=ast.minimum_should_match,
+            )
+        raise PlanError(f"cannot lower query node {type(ast).__name__}")
+
+    def _canonical(self, fm: FieldMapping, value: str) -> str:
+        if fm.type is FieldType.TEXT:
+            return value
+        if fm.type is FieldType.DATETIME:
+            return str(parse_datetime_to_micros(value, fm.input_formats)
+                       if not str(value).lstrip("-").isdigit()
+                       else parse_datetime_to_micros(int(value), ("unix_timestamp",)))
+        if fm.type is FieldType.F64:
+            return repr(float(value))
+        if fm.type is FieldType.BOOL:
+            return value.lower()
+        return str(int(value))
+
+    def _lower_term(self, ast: Q.Term, scoring: bool, boost: float) -> Any:
+        fm = self._field(ast.field)
+        if fm.type is FieldType.TEXT and fm.tokenizer not in ("raw", "lowercase"):
+            # terms on tokenized text behave as a conjunctive full-text match
+            # (quickwit's query language semantics)
+            return self._lower_full_text(
+                Q.FullText(ast.field, ast.value, "and"), scoring, boost)
+        if not fm.indexed:
+            raise PlanError(f"field {ast.field!r} is not indexed")
+        value = ast.value
+        if fm.type is FieldType.TEXT and fm.tokenizer == "lowercase":
+            value = value.lower()
+        return self._postings_node(ast.field, self._canonical(fm, value), scoring, boost)
+
+    def _lower_full_text(self, ast: Q.FullText, scoring: bool, boost: float) -> Any:
+        fm = self._field(ast.field)
+        if fm.type is not FieldType.TEXT:
+            return self._postings_node(ast.field, self._canonical(fm, ast.text),
+                                       scoring, boost)
+        tokens = get_tokenizer(fm.tokenizer)(ast.text)
+        if not tokens:
+            return PMatchNone()
+        if ast.mode == "phrase" and len(tokens) > 1:
+            return self._lower_phrase(ast.field, [t.text for t in tokens],
+                                      ast.slop, scoring, boost)
+        nodes = [self._postings_node(ast.field, t.text, scoring, boost)
+                 for t in tokens]
+        if len(nodes) == 1:
+            return nodes[0]
+        if ast.mode in ("and", "phrase"):
+            return PBool(must=tuple(nodes))
+        return self._or(nodes, scoring=scoring)
+
+    def _lower_phrase(self, field: str, terms: list[str], slop: int,
+                      scoring: bool, boost: float) -> Any:
+        fm = self._field(field)
+        if fm.record != "position":
+            raise PlanError(
+                f"phrase query on field {field!r} requires record='position'")
+        infos = []
+        for term in terms:
+            info = self.reader.lookup_term(field, term)
+            if info is None:
+                return PMatchNone()
+            infos.append(info)
+        postings = [self.reader.postings(field, i) for i in infos]
+        positions = [self.reader.positions(field, i) for i in infos]
+        ids, freqs = phrase_match(postings, positions, [i.df for i in infos], slop)
+        key = f"{field}.phrase." + ".".join(str(i.ordinal) for i in infos)
+        return self._precomputed_node(key, ids, freqs, field, scoring, boost,
+                                      df_for_idf=ids.size)
+
+    def _lower_phrase_prefix(self, ast: Q.PhrasePrefix, scoring: bool, boost: float) -> Any:
+        fm = self._field(ast.field)
+        tokens = [t.text for t in get_tokenizer(fm.tokenizer)(ast.phrase)]
+        if not tokens:
+            return PMatchNone()
+        td = self.reader.term_dict(ast.field)
+        if td is None:
+            return PMatchNone()
+        prefix = tokens[-1]
+        expansions = []
+        for term, _df in td.iter_terms(start=prefix):
+            if not term.startswith(prefix):
+                break
+            expansions.append(term)
+            if len(expansions) >= ast.max_expansions:
+                break
+        if not expansions:
+            return PMatchNone()
+        if len(tokens) == 1:
+            return self._or([self._postings_node(ast.field, t, scoring, boost)
+                             for t in expansions], scoring=scoring)
+        nodes = [self._lower_phrase(ast.field, tokens[:-1] + [exp], 0, scoring, boost)
+                 for exp in expansions]
+        return self._or(nodes, scoring=scoring)
+
+    def _lower_pattern(self, field: str, pattern: str, scoring: bool,
+                       boost: float, literal_prefix: str = "") -> Any:
+        fm = self._field(field)
+        td = self.reader.term_dict(field)
+        if td is None:
+            return PMatchNone()
+        compiled = re.compile(pattern)
+        matches = []
+        for term, _df in td.iter_terms(start=literal_prefix or None):
+            if literal_prefix and not term.startswith(literal_prefix):
+                break
+            if compiled.fullmatch(term):
+                matches.append(term)
+                if len(matches) > MAX_EXPANSIONS:
+                    raise PlanError(
+                        f"pattern on {field!r} expands to more than {MAX_EXPANSIONS} terms")
+        return self._or([self._postings_node(field, t, False, boost) for t in matches])
+
+    def _lower_presence(self, field: str) -> Any:
+        fm = self._field(field)
+        if fm.fast:
+            meta = self.reader.field_meta(field)
+            if meta.get("column_kind") == "ordinal":
+                slot = self.b.add_array(
+                    f"col.{field}.ordinals", lambda: self.reader.column_ordinals(field))
+                return PPresence(slot, is_ordinal=True)
+            _vals, present_slot = self._column_slots(field)
+            return PPresence(present_slot)
+        if fm.indexed and fm.type is FieldType.TEXT:
+            norm_slot = self.b.add_array(
+                f"norm.{field}", lambda: self.reader.fieldnorm(field))
+            return PNormPresence(norm_slot)
+        raise PlanError(f"presence query needs a fast or indexed text field: {field!r}")
+
+    def _lower_range(self, ast: Q.Range, bounds_are_micros: bool = False) -> Any:
+        """`bounds_are_micros`: bounds on a datetime field are already in
+        micros (request-level time filters) — skip input-format parsing."""
+        fm = self._field(ast.field)
+        if fm.type is FieldType.TEXT:
+            raise PlanError("range queries on text fields are not supported")
+        values_slot, present_slot = self._column_slots(ast.field)
+        dtype = np.float64 if fm.type is FieldType.F64 else np.int64
+        parse = (lambda v: int(v)) if bounds_are_micros else \
+            (lambda v: self._parse_bound(fm, v))
+        lo_slot = hi_slot = -1
+        lo_incl = hi_incl = True
+        if ast.lower is not None:
+            lo_slot = self.b.add_scalar(parse(ast.lower.value), dtype)
+            lo_incl = ast.lower.inclusive
+        if ast.upper is not None:
+            hi_slot = self.b.add_scalar(parse(ast.upper.value), dtype)
+            hi_incl = ast.upper.inclusive
+        return PRange(values_slot, present_slot, lo_slot, hi_slot, lo_incl, hi_incl)
+
+    def _or(self, nodes: list, scoring: bool = False) -> Any:
+        nodes = [n for n in nodes if not isinstance(n, PMatchNone)]
+        if not nodes:
+            return PMatchNone()
+        if len(nodes) == 1:
+            return nodes[0]
+        return PBool(should=tuple(nodes))
+
+    # --- aggregations -----------------------------------------------------
+    def lower_metric(self, spec: MetricAgg) -> MetricSlots:
+        fm = self._field(spec.field)
+        if fm.type is FieldType.TEXT:
+            raise PlanError(f"metric aggregation on text field {spec.field!r}")
+        values_slot, present_slot = self._column_slots(spec.field)
+        return MetricSlots(spec.name, spec.kind, values_slot, present_slot,
+                           tuple(spec.percents))
+
+    def lower_agg(self, spec: AggSpec) -> Any:
+        if isinstance(spec, MetricAgg):
+            return MetricAggExec(spec.name, self.lower_metric(spec))
+        if isinstance(spec, DateHistogramAgg):
+            fm = self._field(spec.field)
+            if fm.type is not FieldType.DATETIME or not fm.fast:
+                raise PlanError("date_histogram requires a fast datetime field")
+            values_slot, present_slot = self._column_slots(spec.field)
+            meta = self.reader.field_meta(spec.field)
+            vmin, vmax = meta.get("min_value"), meta.get("max_value")
+            if vmin is None:
+                return BucketAggExec(spec.name, "date_histogram", values_slot,
+                                     present_slot, 1,
+                                     self.b.add_scalar(0, np.int64),
+                                     self.b.add_scalar(spec.interval_micros, np.int64),
+                                     metrics=self._metric_tuple(spec.sub_metrics),
+                                     host_info={"interval": spec.interval_micros,
+                                                "origin": 0,
+                                                "min_doc_count": spec.min_doc_count})
+            if spec.extended_bounds:
+                vmin = min(vmin, spec.extended_bounds[0])
+                vmax = max(vmax, spec.extended_bounds[1])
+            interval = spec.interval_micros
+            origin = (vmin // interval) * interval
+            num_buckets = int((vmax - origin) // interval) + 1
+            if num_buckets > MAX_BUCKETS:
+                raise PlanError(
+                    f"date_histogram would create {num_buckets} buckets (max {MAX_BUCKETS})")
+            return BucketAggExec(
+                spec.name, "date_histogram", values_slot, present_slot, num_buckets,
+                self.b.add_scalar(origin, np.int64),
+                self.b.add_scalar(interval, np.int64),
+                metrics=self._metric_tuple(spec.sub_metrics),
+                host_info={"interval": interval, "origin": origin,
+                           "min_doc_count": spec.min_doc_count,
+                           "extended_bounds": spec.extended_bounds})
+        if isinstance(spec, HistogramAgg):
+            fm = self._field(spec.field)
+            values_slot, present_slot = self._column_slots(spec.field)
+            meta = self.reader.field_meta(spec.field)
+            vmin, vmax = meta.get("min_value"), meta.get("max_value")
+            if vmin is None:
+                vmin = vmax = 0
+            origin = float(np.floor(vmin / spec.interval) * spec.interval)
+            num_buckets = int((vmax - origin) // spec.interval) + 1
+            if num_buckets > MAX_BUCKETS:
+                raise PlanError(f"histogram would create {num_buckets} buckets")
+            return BucketAggExec(
+                spec.name, "histogram", values_slot, present_slot, num_buckets,
+                self.b.add_scalar(origin, np.float64),
+                self.b.add_scalar(spec.interval, np.float64),
+                metrics=self._metric_tuple(spec.sub_metrics),
+                host_info={"interval": spec.interval, "origin": origin,
+                           "min_doc_count": spec.min_doc_count})
+        if isinstance(spec, TermsAgg):
+            return self._lower_terms_agg(spec)
+        raise PlanError(f"unsupported aggregation {spec!r}")
+
+    def _metric_tuple(self, specs: tuple[MetricAgg, ...]) -> tuple[MetricSlots, ...]:
+        return tuple(self.lower_metric(m) for m in specs)
+
+    def _lower_terms_agg(self, spec: TermsAgg) -> Any:
+        fm = self._field(spec.field)
+        if not fm.fast:
+            raise PlanError(f"terms aggregation requires fast field: {spec.field!r}")
+        meta = self.reader.field_meta(spec.field)
+        if meta.get("column_kind") == "ordinal":
+            ordinals_slot = self.b.add_array(
+                f"col.{spec.field}.ordinals", lambda: self.reader.column_ordinals(spec.field))
+            keys = self.reader.column_dict(spec.field)
+            return BucketAggExec(
+                spec.name, "terms", ordinals_slot, -1, max(len(keys), 1),
+                metrics=self._metric_tuple(spec.sub_metrics),
+                host_info={"keys": keys, "size": spec.size,
+                           "min_doc_count": spec.min_doc_count,
+                           "order_desc": spec.order_by_count_desc})
+        # numeric column: ordinalize host-side once per split (cached)
+        ordinals, uniques = self._ordinalize_numeric(spec.field)
+        return BucketAggExec(
+            spec.name, "terms",
+            self.b.add_array(f"col.{spec.field}.ordinals_dyn", lambda: ordinals),
+            -1, max(len(uniques), 1),
+            metrics=self._metric_tuple(spec.sub_metrics),
+            host_info={"keys": uniques, "size": spec.size,
+                       "min_doc_count": spec.min_doc_count,
+                       "order_desc": spec.order_by_count_desc})
+
+    def _ordinalize_numeric(self, field: str):
+        cache_key = f"_ordinalized.{field}"
+        cached = getattr(self.reader, "_dyn_cache", {}).get(cache_key)
+        if cached is not None:
+            return cached
+        values, present = self.reader.column_values(field)
+        real = values[: self.reader.num_docs][present[: self.reader.num_docs].astype(bool)]
+        uniques = np.unique(real)
+        ordinals = np.full(self.reader.num_docs_padded, -1, dtype=np.int32)
+        mask = present.astype(bool)
+        ordinals[mask] = np.searchsorted(uniques, values[mask]).astype(np.int32)
+        result = (ordinals, [v.item() for v in uniques])
+        if not hasattr(self.reader, "_dyn_cache"):
+            self.reader._dyn_cache = {}
+        self.reader._dyn_cache[cache_key] = result
+        return result
+
+    # --- sort -------------------------------------------------------------
+    def lower_sort(self, sort_field: str, order: str) -> SortExec:
+        descending = order == "desc"
+        if sort_field == "_score":
+            return SortExec("score", descending)
+        if sort_field == "_doc":
+            return SortExec("doc", descending)
+        values_slot, present_slot = self._column_slots(sort_field)
+        return SortExec("column", descending, values_slot, present_slot)
+
+
+def _wildcard_prefix(pattern: str) -> str:
+    for i, ch in enumerate(pattern):
+        if ch in "*?[":
+            return pattern[:i]
+    return pattern
+
+
+def _regex_prefix(pattern: str) -> str:
+    out = []
+    for ch in pattern:
+        if ch in ".*+?()[]{}|^$\\":
+            break
+        out.append(ch)
+    return "".join(out)
+
+
+def lower_request(
+    query_ast: Q.QueryAst,
+    doc_mapper: DocMapper,
+    reader: SplitReader,
+    agg_specs: list[AggSpec],
+    sort_field: str = "_score",
+    sort_order: str = "desc",
+    start_timestamp: Optional[int] = None,
+    end_timestamp: Optional[int] = None,
+) -> LoweredPlan:
+    """Full request lowering: query + request-level time filter + sort + aggs."""
+    low = Lowering(doc_mapper, reader)
+    scoring = sort_field == "_score"
+    root = low.lower(query_ast, scoring=scoring)
+    if start_timestamp is not None or end_timestamp is not None:
+        ts_field = doc_mapper.timestamp_field
+        if ts_field is None:
+            raise PlanError("time-range request on an index without timestamp field")
+        # end_timestamp is exclusive (reference: SearchRequest semantics)
+        ts_node = low._lower_range(Q.Range(
+            ts_field,
+            lower=Q.RangeBound(start_timestamp, True) if start_timestamp is not None else None,
+            upper=Q.RangeBound(end_timestamp, False) if end_timestamp is not None else None,
+        ), bounds_are_micros=True)
+        root = PBool(must=(root,), filter=(ts_node,))
+    sort = low.lower_sort(sort_field, sort_order)
+    aggs = [low.lower_agg(spec) for spec in agg_specs]
+    return LoweredPlan(
+        root=root, sort=sort, aggs=aggs,
+        arrays=low.b.arrays, array_keys=low.b.array_keys, scalars=low.b.scalars,
+        num_docs=reader.num_docs, num_docs_padded=reader.num_docs_padded,
+    )

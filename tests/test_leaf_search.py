@@ -1,0 +1,334 @@
+"""End-to-end leaf search on one split, parity-checked against brute force.
+
+Mirrors the reference's approach of unit-testing leaf search against known
+corpora (leaf.rs tests): we index a synthetic hdfs-logs-like corpus and
+compare hits/counts/aggregations with a pure-Python reference computation.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.index import SplitReader, SplitWriter
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.query import parse_query_string
+from quickwit_tpu.query.ast import Bool, FullText, MatchAll, Range, RangeBound, Term
+from quickwit_tpu.search import (
+    IncrementalCollector, SearchRequest, SortField, finalize_aggregations,
+    leaf_search_single_split,
+)
+from quickwit_tpu.storage import RamStorage
+
+SEVERITIES = ["DEBUG", "INFO", "WARN", "ERROR"]
+NUM_DOCS = 500
+
+
+def corpus():
+    rng = np.random.RandomState(42)
+    docs = []
+    for i in range(NUM_DOCS):
+        sev = SEVERITIES[int(rng.randint(0, 4))]
+        words = ["alpha"] * int(rng.randint(1, 4)) + ["beta"] * int(rng.randint(0, 3))
+        if i % 7 == 0:
+            words += ["gamma", "delta"]  # phrase "gamma delta"
+        if i % 11 == 0:
+            words += ["delta", "gamma"]
+        rng.shuffle(words)
+        docs.append({
+            "timestamp": 1_600_000_000 + i * 60,      # one doc per minute
+            "tenant_id": int(rng.randint(0, 5)),
+            "severity_text": sev,
+            "body": " ".join(words),
+            "latency": float(rng.gamma(2.0, 50.0)),
+        })
+    return docs
+
+
+def mapper():
+    return DocMapper(
+        field_mappings=[
+            FieldMapping("timestamp", FieldType.DATETIME, fast=True,
+                         input_formats=("unix_timestamp",)),
+            FieldMapping("tenant_id", FieldType.U64, fast=True),
+            FieldMapping("severity_text", FieldType.TEXT, tokenizer="raw", fast=True),
+            FieldMapping("body", FieldType.TEXT, record="position"),
+            FieldMapping("latency", FieldType.F64, fast=True),
+        ],
+        timestamp_field="timestamp",
+        default_search_fields=("body",),
+    )
+
+
+DOCS = corpus()
+MAPPER = mapper()
+
+
+@pytest.fixture(scope="module")
+def reader():
+    writer = SplitWriter(MAPPER)
+    for doc in DOCS:
+        writer.add_json_doc(doc)
+    storage = RamStorage(Uri.parse("ram:///leafsearch"))
+    storage.put("s.split", writer.finish())
+    return SplitReader(storage, "s.split")
+
+
+def search(reader, **kwargs):
+    defaults = dict(index_ids=["test"], query_ast=MatchAll(), max_hits=10)
+    defaults.update(kwargs)
+    return leaf_search_single_split(SearchRequest(**defaults), MAPPER, reader, "split-0")
+
+
+# --- brute force reference -------------------------------------------------
+
+def brute_bm25(term: str, field="body"):
+    """doc_id -> bm25 score for a single term."""
+    k1, b = 1.2, 0.75
+    tfs = {}
+    lens = {}
+    for doc_id, doc in enumerate(DOCS):
+        toks = doc[field].split()
+        lens[doc_id] = len(toks)
+        count = sum(1 for t in toks if t == term)
+        if count:
+            tfs[doc_id] = count
+    df = len(tfs)
+    avg_len = sum(lens.values()) / len(DOCS)
+    idf = math.log(1 + (len(DOCS) - df + 0.5) / (df + 0.5))
+    return {
+        d: idf * tf * (k1 + 1) / (tf + k1 * (1 - b + b * lens[d] / avg_len))
+        for d, tf in tfs.items()
+    }
+
+
+# --- tests -----------------------------------------------------------------
+
+def test_match_all_count(reader):
+    resp = search(reader, max_hits=5)
+    assert resp.num_hits == NUM_DOCS
+    assert len(resp.partial_hits) == 5
+
+
+def test_term_query_raw_field(reader):
+    resp = search(reader, query_ast=Term("severity_text", "ERROR"), max_hits=1000)
+    expected = {i for i, d in enumerate(DOCS) if d["severity_text"] == "ERROR"}
+    assert resp.num_hits == len(expected)
+    assert {h.doc_id for h in resp.partial_hits} == expected
+
+
+def test_bm25_scored_term_query(reader):
+    resp = search(reader, query_ast=FullText("body", "beta", "or"), max_hits=10)
+    scores = brute_bm25("beta")
+    assert resp.num_hits == len(scores)
+    expected_top = sorted(scores.items(), key=lambda kv: (-kv[1], kv[0]))[:10]
+    got = [(h.doc_id, h.raw_sort_value) for h in resp.partial_hits]
+    assert [d for d, _ in got] == [d for d, _ in expected_top]
+    for (_, got_s), (_, exp_s) in zip(got, expected_top):
+        assert got_s == pytest.approx(exp_s, rel=1e-5)
+
+
+def test_bool_and_range(reader):
+    ast = Bool(
+        must=(FullText("body", "alpha", "or"),),
+        filter=(Range("tenant_id", lower=RangeBound(2, True), upper=RangeBound(3, True)),),
+    )
+    resp = search(reader, query_ast=ast, max_hits=1000)
+    expected = {i for i, d in enumerate(DOCS)
+                if "alpha" in d["body"].split() and 2 <= d["tenant_id"] <= 3}
+    assert {h.doc_id for h in resp.partial_hits} == expected
+
+
+def test_time_range_filter(reader):
+    start = (1_600_000_000 + 100 * 60) * 1_000_000
+    end = (1_600_000_000 + 200 * 60) * 1_000_000
+    resp = search(reader, start_timestamp=start, end_timestamp=end, max_hits=0)
+    # end exclusive: docs 100..199
+    assert resp.num_hits == 100
+
+
+def test_sort_by_timestamp_desc(reader):
+    resp = search(reader, max_hits=5,
+                  sort_fields=(SortField("timestamp", "desc"),))
+    expected = [NUM_DOCS - 1 - i for i in range(5)]
+    assert [h.doc_id for h in resp.partial_hits] == expected
+    assert resp.partial_hits[0].raw_sort_value == (1_600_000_000 + 499 * 60) * 1_000_000
+
+
+def test_sort_by_value_asc_tiebreak(reader):
+    resp = search(reader, max_hits=20, sort_fields=(SortField("tenant_id", "asc"),))
+    expected = sorted(range(NUM_DOCS), key=lambda i: (DOCS[i]["tenant_id"], i))[:20]
+    assert [h.doc_id for h in resp.partial_hits] == expected
+
+
+def test_phrase_query(reader):
+    resp = search(reader, query_ast=FullText("body", "gamma delta", "phrase"),
+                  max_hits=1000)
+    expected = set()
+    for i, d in enumerate(DOCS):
+        toks = d["body"].split()
+        if any(toks[j] == "gamma" and j + 1 < len(toks) and toks[j + 1] == "delta"
+               for j in range(len(toks))):
+            expected.add(i)
+    assert {h.doc_id for h in resp.partial_hits} == expected
+
+
+def test_query_string_integration(reader):
+    ast = parse_query_string("severity_text:ERROR AND tenant_id:[0 TO 2]",
+                             default_search_fields=["body"])
+    resp = search(reader, query_ast=ast, max_hits=1000)
+    expected = {i for i, d in enumerate(DOCS)
+                if d["severity_text"] == "ERROR" and d["tenant_id"] <= 2}
+    assert {h.doc_id for h in resp.partial_hits} == expected
+
+
+def test_date_histogram_and_terms_aggs(reader):
+    resp = search(reader, max_hits=0, aggs={
+        "over_time": {"date_histogram": {"field": "timestamp", "fixed_interval": "1h"}},
+        "severities": {"terms": {"field": "severity_text", "size": 10}},
+    })
+    collector = IncrementalCollector(max_hits=0)
+    collector.add_leaf_response(resp)
+    result = finalize_aggregations(collector.aggregation_states())
+
+    hour_micros = 3_600_000_000
+    expected_hist = {}
+    for d in DOCS:
+        key = (d["timestamp"] * 1_000_000 // hour_micros) * hour_micros
+        expected_hist[key] = expected_hist.get(key, 0) + 1
+    got_hist = {int(b["key"] * 1000): b["doc_count"] for b in result["over_time"]["buckets"]}
+    assert got_hist == expected_hist
+
+    expected_sev = {}
+    for d in DOCS:
+        expected_sev[d["severity_text"]] = expected_sev.get(d["severity_text"], 0) + 1
+    got_sev = {b["key"]: b["doc_count"] for b in result["severities"]["buckets"]}
+    assert got_sev == expected_sev
+
+
+def test_stats_and_percentiles_aggs(reader):
+    resp = search(reader, max_hits=0, aggs={
+        "lat_stats": {"stats": {"field": "latency"}},
+        "lat_pct": {"percentiles": {"field": "latency", "percents": [50, 95]}},
+    })
+    collector = IncrementalCollector(max_hits=0)
+    collector.add_leaf_response(resp)
+    result = finalize_aggregations(collector.aggregation_states())
+
+    lats = [d["latency"] for d in DOCS]
+    st = result["lat_stats"]
+    assert st["count"] == NUM_DOCS
+    assert st["sum"] == pytest.approx(sum(lats), rel=1e-9)
+    assert st["min"] == pytest.approx(min(lats))
+    assert st["max"] == pytest.approx(max(lats))
+    assert st["avg"] == pytest.approx(sum(lats) / NUM_DOCS, rel=1e-9)
+
+    p50, p95 = np.percentile(lats, 50), np.percentile(lats, 95)
+    got = result["lat_pct"]["values"]
+    assert got["50"] == pytest.approx(p50, rel=0.06)
+    assert got["95"] == pytest.approx(p95, rel=0.06)
+
+
+def test_terms_agg_numeric_field(reader):
+    resp = search(reader, max_hits=0,
+                  aggs={"tenants": {"terms": {"field": "tenant_id", "size": 10}}})
+    collector = IncrementalCollector(max_hits=0)
+    collector.add_leaf_response(resp)
+    result = finalize_aggregations(collector.aggregation_states())
+    expected = {}
+    for d in DOCS:
+        expected[d["tenant_id"]] = expected.get(d["tenant_id"], 0) + 1
+    got = {b["key"]: b["doc_count"] for b in result["tenants"]["buckets"]}
+    assert got == expected
+
+
+def test_sub_metric_under_date_histogram(reader):
+    resp = search(reader, max_hits=0, aggs={
+        "over_time": {
+            "date_histogram": {"field": "timestamp", "fixed_interval": "1h"},
+            "aggs": {"avg_lat": {"avg": {"field": "latency"}}},
+        },
+    })
+    collector = IncrementalCollector(max_hits=0)
+    collector.add_leaf_response(resp)
+    result = finalize_aggregations(collector.aggregation_states())
+    hour_micros = 3_600_000_000
+    expected: dict = {}
+    for d in DOCS:
+        key = (d["timestamp"] * 1_000_000 // hour_micros) * hour_micros
+        expected.setdefault(key, []).append(d["latency"])
+    for b in result["over_time"]["buckets"]:
+        key = int(b["key"] * 1000)
+        assert b["avg_lat"]["value"] == pytest.approx(
+            sum(expected[key]) / len(expected[key]), rel=1e-9)
+
+
+def test_must_not(reader):
+    ast = Bool(must=(MatchAll(),), must_not=(Term("severity_text", "ERROR"),))
+    resp = search(reader, query_ast=ast, max_hits=0)
+    expected = sum(1 for d in DOCS if d["severity_text"] != "ERROR")
+    assert resp.num_hits == expected
+
+
+def test_should_scoring_or(reader):
+    ast = Bool(should=(FullText("body", "beta", "or"), FullText("body", "gamma", "or")))
+    resp = search(reader, query_ast=ast, max_hits=1000)
+    beta = brute_bm25("beta")
+    gamma = brute_bm25("gamma")
+    expected_docs = set(beta) | set(gamma)
+    assert {h.doc_id for h in resp.partial_hits} == expected_docs
+    # top hit score = sum of matching term scores
+    top = resp.partial_hits[0]
+    expected_score = beta.get(top.doc_id, 0) + gamma.get(top.doc_id, 0)
+    assert top.raw_sort_value == pytest.approx(expected_score, rel=1e-5)
+
+
+def test_missing_term_matches_nothing(reader):
+    resp = search(reader, query_ast=Term("severity_text", "NOPE"), max_hits=10)
+    assert resp.num_hits == 0 and resp.partial_hits == []
+
+
+def test_asc_sort_survives_collector_merge(reader):
+    """Regression: ascending sort values must stay in higher-is-better key
+    space through the collector (cross-split merge contract)."""
+    resp = search(reader, max_hits=5, sort_fields=(SortField("timestamp", "asc"),))
+    coll = IncrementalCollector(max_hits=5)
+    coll.add_leaf_response(resp)
+    hits = coll.partial_hits()
+    assert [h.doc_id for h in hits] == [0, 1, 2, 3, 4]
+    assert hits[0].raw_sort_value == 1_600_000_000 * 1_000_000
+
+
+def test_phrase_does_not_match_across_values():
+    """Regression: position gap between multiple values of one field."""
+    m = DocMapper(field_mappings=[
+        FieldMapping("body", FieldType.TEXT, record="position")],
+        default_search_fields=("body",))
+    w = SplitWriter(m)
+    w.add_json_doc({"body": ["hello world", "foo bar"]})
+    w.add_json_doc({"body": "hello world foo bar"})
+    storage = RamStorage(Uri.parse("ram:///gap"))
+    storage.put("s.split", w.finish())
+    r = SplitReader(storage, "s.split")
+    req = SearchRequest(index_ids=["x"],
+                        query_ast=FullText("body", "world foo", "phrase"), max_hits=10)
+    resp = leaf_search_single_split(req, m, r, "s")
+    assert {h.doc_id for h in resp.partial_hits} == {1}
+    # BM25 doc length must count tokens, not gapped positions
+    assert r.fieldnorm("body")[0] == 4
+
+
+def test_terms_agg_count_asc_order(reader):
+    resp = search(reader, max_hits=0, aggs={
+        "sev": {"terms": {"field": "severity_text", "size": 2,
+                          "order": {"_count": "asc"}}}})
+    coll = IncrementalCollector(max_hits=0)
+    coll.add_leaf_response(resp)
+    result = finalize_aggregations(coll.aggregation_states())
+    counts = {}
+    for d in DOCS:
+        counts[d["severity_text"]] = counts.get(d["severity_text"], 0) + 1
+    expected = sorted(counts.items(), key=lambda kv: (kv[1], kv[0]))[:2]
+    got = [(b["key"], b["doc_count"]) for b in result["sev"]["buckets"]]
+    assert got == expected

@@ -1,0 +1,157 @@
+import numpy as np
+import pytest
+
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.index import DOC_PAD, POSTING_PAD, SplitReader, SplitWriter
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.storage import RamStorage
+
+
+def make_mapper():
+    return DocMapper(
+        field_mappings=[
+            FieldMapping("timestamp", FieldType.DATETIME, fast=True,
+                         input_formats=("unix_timestamp",)),
+            FieldMapping("tenant_id", FieldType.U64, fast=True),
+            FieldMapping("severity_text", FieldType.TEXT, tokenizer="raw", fast=True),
+            FieldMapping("body", FieldType.TEXT, record="position"),
+        ],
+        timestamp_field="timestamp",
+        tag_fields=("severity_text",),
+        default_search_fields=("body",),
+    )
+
+
+DOCS = [
+    {"timestamp": 1000 + i, "tenant_id": i % 3, "severity_text": ["INFO", "ERROR"][i % 2],
+     "body": f"log event number {i} shared"}
+    for i in range(10)
+]
+
+
+@pytest.fixture
+def split_reader():
+    mapper = make_mapper()
+    writer = SplitWriter(mapper)
+    for doc in DOCS:
+        writer.add_json_doc(doc)
+    data = writer.finish()
+    storage = RamStorage(Uri.parse("ram:///splits"))
+    storage.put("test.split", data)
+    return SplitReader(storage, "test.split")
+
+
+def test_footer_and_shapes(split_reader):
+    r = split_reader
+    assert r.num_docs == 10
+    assert r.num_docs_padded == DOC_PAD
+    assert r.footer.time_range == (1000 * 1_000_000, 1009 * 1_000_000)
+
+
+def test_term_lookup_and_postings(split_reader):
+    r = split_reader
+    info = r.lookup_term("severity_text", "ERROR")
+    assert info is not None and info.df == 5
+    ids, tfs = r.postings("severity_text", info)
+    assert len(ids) == POSTING_PAD  # padded
+    assert list(ids[:5]) == [1, 3, 5, 7, 9]
+    assert list(tfs[:5]) == [1, 1, 1, 1, 1]
+    # pad sentinel: out-of-bounds doc id, zero tf
+    assert ids[5] == r.num_docs_padded and tfs[5] == 0
+    assert r.lookup_term("severity_text", "MISSING") is None
+    assert r.lookup_term("body", "shared").df == 10
+
+
+def test_term_dict_iteration(split_reader):
+    td = split_reader.term_dict("body")
+    terms = [t for t, _ in td.iter_terms()]
+    assert terms == sorted(terms)
+    assert "shared" in terms and "log" in terms
+    from_n = [t for t, _ in td.iter_terms(start="n")]
+    assert all(t >= "n" for t in from_n)
+
+
+def test_positions(split_reader):
+    r = split_reader
+    info = r.lookup_term("body", "number")
+    offsets, data = r.positions("body", info)
+    # "log event number {i} shared" -> "number" at position 2 in every doc
+    first_positions = data[offsets[0]:offsets[1]]
+    assert list(first_positions) == [2]
+
+
+def test_fieldnorms(split_reader):
+    norms = split_reader.fieldnorm("body")
+    assert norms[0] == 5  # "log event number 0 shared" = 5 tokens
+    assert norms[10] == 0  # padding
+
+
+def test_numeric_column(split_reader):
+    values, present = split_reader.column_values("tenant_id")
+    assert values.dtype == np.int64
+    assert len(values) == DOC_PAD
+    assert list(values[:6]) == [0, 1, 2, 0, 1, 2]
+    assert present[:10].all() and not present[10:].any()
+    meta = split_reader.field_meta("tenant_id")
+    assert meta["min_value"] == 0 and meta["max_value"] == 2
+
+
+def test_ordinal_column(split_reader):
+    ordinals = split_reader.column_ordinals("severity_text")
+    dictionary = split_reader.column_dict("severity_text")
+    assert dictionary == ["ERROR", "INFO"]
+    assert [dictionary[o] for o in ordinals[:4]] == ["INFO", "ERROR", "INFO", "ERROR"]
+    assert ordinals[10] == -1  # padding has no value
+
+
+def test_fetch_docs(split_reader):
+    docs = split_reader.fetch_docs([7, 0, 3])
+    assert docs[0]["body"] == "log event number 7 shared"
+    assert docs[1]["tenant_id"] == 0
+    assert docs[2]["timestamp"] == 1003
+    with pytest.raises(IndexError):
+        split_reader.fetch_docs([100])
+
+
+def test_avg_len_stat(split_reader):
+    meta = split_reader.field_meta("body")
+    assert meta["avg_len"] == 5.0
+    assert meta["num_terms"] > 0
+
+
+def test_footer_single_get_open():
+    """Opening with a generous footer hint must need exactly one storage read."""
+    mapper = make_mapper()
+    writer = SplitWriter(mapper)
+    for doc in DOCS:
+        writer.add_json_doc(doc)
+    data = writer.finish()
+
+    class CountingStorage(RamStorage):
+        reads = 0
+
+        def get_slice(self, path, start, end):
+            CountingStorage.reads += 1
+            return super().get_slice(path, start, end)
+
+    storage = CountingStorage(Uri.parse("ram:///c"))
+    storage.put("s.split", data)
+    SplitReader(storage, "s.split")
+    assert CountingStorage.reads == 1
+
+
+def test_empty_split_rejected():
+    with pytest.raises(ValueError):
+        SplitWriter(make_mapper()).finish()
+
+
+def test_multivalue_text_indexing():
+    mapper = DocMapper(field_mappings=[FieldMapping("tags", FieldType.TEXT, tokenizer="raw")])
+    writer = SplitWriter(mapper)
+    writer.add_json_doc({"tags": ["red", "blue"]})
+    writer.add_json_doc({"tags": "red"})
+    storage = RamStorage(Uri.parse("ram:///mv"))
+    storage.put("s.split", writer.finish())
+    reader = SplitReader(storage, "s.split")
+    assert reader.lookup_term("tags", "red").df == 2
+    assert reader.lookup_term("tags", "blue").df == 1
