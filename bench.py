@@ -1,0 +1,90 @@
+"""Benchmark: hdfs-logs leaf-search on the flagship workload.
+
+Measures p50 end-to-end leaf_search latency on one real chip for the
+BASELINE.json headline config: single-term query (severity_text:ERROR) +
+top-10 hits + date_histogram(1d) + terms(severity) aggregation over an
+hdfs-logs-shaped split (default 10M docs — the distributed-tutorial split
+size; override with BENCH_NUM_DOCS).
+
+Latency includes the full leaf path after warmup: plan lowering (host),
+cached device arrays, jitted kernel execution, and the single batched
+device→host readback of hits + agg states.
+
+`vs_baseline`: the reference's own headline number for this setup is
+"sub-second search from object storage" (docs/overview/index.md:9; no
+hard latency tables are published in-repo — BASELINE.md). vs_baseline is
+therefore reported as 1000ms / p50_ms: how many times faster than the
+reference's 1-second headline bound. The measured CPU-tantivy comparison
+(north star: ≥8x) requires the reference binary, which this image cannot
+build (no Rust toolchain) — see BASELINE.md.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+NUM_DOCS = int(os.environ.get("BENCH_NUM_DOCS", 10_000_000))
+ITERATIONS = int(os.environ.get("BENCH_ITERS", 30))
+
+
+def main() -> None:
+    from quickwit_tpu.common.uri import Uri
+    from quickwit_tpu.index.reader import SplitReader
+    from quickwit_tpu.index.synthetic import HDFS_MAPPER, synthetic_hdfs_split
+    from quickwit_tpu.query.ast import Term
+    from quickwit_tpu.search.leaf import leaf_search_single_split
+    from quickwit_tpu.search.models import SearchRequest
+    from quickwit_tpu.storage.ram import RamStorage
+
+    t0 = time.monotonic()
+    storage = RamStorage(Uri.parse("ram:///bench"))
+    storage.put("bench.split", synthetic_hdfs_split(NUM_DOCS, seed=7))
+    reader = SplitReader(storage, "bench.split")
+    gen_s = time.monotonic() - t0
+
+    request = SearchRequest(
+        index_ids=["hdfs-logs"],
+        query_ast=Term("severity_text", "ERROR"),
+        max_hits=10,
+        aggs={
+            "over_time": {"date_histogram": {"field": "timestamp",
+                                             "fixed_interval": "1d"}},
+            "severities": {"terms": {"field": "severity_text", "size": 10}},
+        },
+    )
+
+    # warmup: compile + device transfer
+    t0 = time.monotonic()
+    resp = leaf_search_single_split(request, HDFS_MAPPER, reader, "bench")
+    warm_s = time.monotonic() - t0
+    assert resp.num_hits > 0
+
+    latencies = []
+    for _ in range(ITERATIONS):
+        t0 = time.monotonic()
+        resp = leaf_search_single_split(request, HDFS_MAPPER, reader, "bench")
+        latencies.append(time.monotonic() - t0)
+    latencies.sort()
+    p50_ms = latencies[len(latencies) // 2] * 1000.0
+    p90_ms = latencies[int(len(latencies) * 0.9)] * 1000.0
+
+    print(f"# corpus={NUM_DOCS} docs, gen={gen_s:.1f}s, "
+          f"warmup(compile+transfer)={warm_s:.1f}s, "
+          f"p50={p50_ms:.2f}ms p90={p90_ms:.2f}ms, "
+          f"num_hits={resp.num_hits}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "hdfs-logs leaf_search p50 (term+date_histogram+terms, "
+                  f"{NUM_DOCS/1e6:.0f}M docs, 1 chip)",
+        "value": round(p50_ms, 2),
+        "unit": "ms",
+        "vs_baseline": round(1000.0 / p50_ms, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

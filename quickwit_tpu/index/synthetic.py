@@ -1,0 +1,219 @@
+"""Fast synthetic split generation for benchmarks and dry-runs.
+
+Builds hdfs-logs-shaped splits (timestamp + tenant_id + severity_text +
+tokenized body) directly as numpy arrays through `SplitFileBuilder`,
+bypassing the per-document Python writer loop so multi-million-doc splits
+materialize in seconds. The output is byte-identical in format to
+`SplitWriter` output and is read through the normal `SplitReader` path, so
+benchmarks exercise the real search stack.
+"""
+
+from __future__ import annotations
+
+import json
+import zlib
+from typing import Optional
+
+import numpy as np
+
+from ..models.doc_mapper import DocMapper, FieldMapping, FieldType
+from .format import DOC_PAD, POSTING_PAD, SplitFileBuilder, SplitFooter, pad_to
+
+# sorted — these double as dictionary/term ordinals
+SEVERITIES = ["DEBUG", "ERROR", "INFO", "WARN"]
+_SEVERITY_P = [0.30, 0.10, 0.45, 0.15]
+
+HDFS_MAPPER = DocMapper(
+    field_mappings=[
+        FieldMapping("timestamp", FieldType.DATETIME, fast=True,
+                     input_formats=("unix_timestamp",)),
+        FieldMapping("tenant_id", FieldType.U64, fast=True),
+        FieldMapping("severity_text", FieldType.TEXT, tokenizer="raw", fast=True),
+        FieldMapping("body", FieldType.TEXT),
+    ],
+    timestamp_field="timestamp",
+    default_search_fields=("body",),
+)
+
+# zipf-ish body vocabulary; term 0 is the frequent term, tail terms are rare
+_BODY_VOCAB_SIZE = 1000
+_BODY_TOKENS_PER_DOC = 8
+
+
+def synthetic_hdfs_split(num_docs: int, seed: int = 0,
+                         start_ts: int = 1_600_000_000,
+                         span_seconds: int = 7 * 86400,
+                         store_docs: bool = False) -> bytes:
+    """One split of `num_docs` synthetic hdfs-logs docs (sorted by time)."""
+    rng = np.random.RandomState(seed)
+    num_docs_padded = pad_to(num_docs, DOC_PAD)
+    builder = SplitFileBuilder()
+    fields: dict = {}
+
+    # --- timestamp column (sorted, micros) --------------------------------
+    ts_seconds = np.sort(rng.randint(0, span_seconds, size=num_docs)) + start_ts
+    ts_micros = np.zeros(num_docs_padded, dtype=np.int64)
+    ts_micros[:num_docs] = ts_seconds.astype(np.int64) * 1_000_000
+    present = np.zeros(num_docs_padded, dtype=np.uint8)
+    present[:num_docs] = 1
+    builder.add_array("col.timestamp.values", ts_micros)
+    builder.add_array("col.timestamp.present", present)
+    fields["timestamp"] = {
+        "type": "datetime", "fast": True, "column_kind": "numeric",
+        "min_value": int(ts_micros[0]), "max_value": int(ts_micros[num_docs - 1]),
+    }
+
+    # --- tenant_id column --------------------------------------------------
+    tenants = rng.randint(0, 10, size=num_docs).astype(np.int64)
+    tenant_col = np.zeros(num_docs_padded, dtype=np.int64)
+    tenant_col[:num_docs] = tenants
+    builder.add_array("col.tenant_id.values", tenant_col)
+    builder.add_array("col.tenant_id.present", present)
+    fields["tenant_id"] = {
+        "type": "u64", "fast": True, "column_kind": "numeric",
+        "min_value": 0, "max_value": 9,
+    }
+
+    # --- severity: ordinal column + inverted field ------------------------
+    sev = rng.choice(len(SEVERITIES), size=num_docs, p=_SEVERITY_P).astype(np.int32)
+    _write_categorical(builder, fields, "severity_text", SEVERITIES, sev,
+                       num_docs, num_docs_padded)
+
+    # --- body: zipf terms, inverted only ----------------------------------
+    _write_body(builder, fields, rng, num_docs, num_docs_padded)
+
+    # --- doc store (optional; benchmarks usually skip fetch phase) --------
+    if store_docs:
+        _write_store(builder, ts_seconds, tenants, sev, num_docs)
+    else:
+        builder.add_array("store.data", np.zeros(0, dtype=np.uint8))
+        builder.add_array("store.block_offsets", np.array([0], dtype=np.int64))
+        builder.add_array("store.block_first_doc", np.array([0], dtype=np.int32))
+
+    footer = SplitFooter(
+        num_docs=num_docs, num_docs_padded=num_docs_padded, arrays={},
+        fields=fields,
+        time_range=(int(ts_micros[0]), int(ts_micros[num_docs - 1])),
+        extra={"synthetic": True},
+    )
+    return builder.finish(footer)
+
+
+def _write_categorical(builder, fields, name, vocab, ordinals_raw,
+                       num_docs, num_docs_padded):
+    """Dict-encoded fast column + inverted postings for a categorical field.
+
+    vocab must be sorted (ordinals are dictionary ordinals)."""
+    assert list(vocab) == sorted(vocab)
+    ordinals = np.full(num_docs_padded, -1, dtype=np.int32)
+    ordinals[:num_docs] = ordinals_raw
+    builder.add_array(f"col.{name}.ordinals", ordinals)
+    blob = "".join(vocab).encode()
+    offsets = np.zeros(len(vocab) + 1, dtype=np.int64)
+    acc = 0
+    for i, term in enumerate(vocab):
+        acc += len(term)
+        offsets[i + 1] = acc
+    builder.add_array(f"col.{name}.dict_blob", np.frombuffer(blob, dtype=np.uint8))
+    builder.add_array(f"col.{name}.dict_offsets", offsets)
+
+    # postings per term
+    order = np.argsort(ordinals_raw, kind="stable")
+    sorted_ords = ordinals_raw[order]
+    starts = np.searchsorted(sorted_ords, np.arange(len(vocab)))
+    ends = np.searchsorted(sorted_ords, np.arange(len(vocab)), side="right")
+    dfs = (ends - starts).astype(np.int32)
+    post_lens = np.array([pad_to(max(int(d), 1), POSTING_PAD) for d in dfs],
+                         dtype=np.int32)
+    post_offs = np.zeros(len(vocab), dtype=np.int64)
+    np.cumsum(post_lens[:-1], out=post_offs[1:])
+    total = int(post_lens.sum())
+    ids_arena = np.full(total, num_docs_padded, dtype=np.int32)
+    tfs_arena = np.zeros(total, dtype=np.int32)
+    for t in range(len(vocab)):
+        ids = order[starts[t]:ends[t]].astype(np.int32)
+        ids_arena[post_offs[t]: post_offs[t] + dfs[t]] = ids
+        tfs_arena[post_offs[t]: post_offs[t] + dfs[t]] = 1
+    term_blob_parts = [t.encode() for t in vocab]
+    term_offsets = np.zeros(len(vocab) + 1, dtype=np.int64)
+    acc = 0
+    for i, t in enumerate(term_blob_parts):
+        acc += len(t)
+        term_offsets[i + 1] = acc
+    builder.add_array(f"inv.{name}.terms.blob",
+                      np.frombuffer(b"".join(term_blob_parts), dtype=np.uint8))
+    builder.add_array(f"inv.{name}.terms.offsets", term_offsets)
+    builder.add_array(f"inv.{name}.terms.df", dfs)
+    builder.add_array(f"inv.{name}.terms.post_off", post_offs)
+    builder.add_array(f"inv.{name}.terms.post_len", post_lens)
+    builder.add_array(f"inv.{name}.postings.ids", ids_arena)
+    builder.add_array(f"inv.{name}.postings.tfs", tfs_arena)
+    norms = np.zeros(num_docs_padded, dtype=np.int32)
+    norms[:num_docs] = 1
+    builder.add_array(f"inv.{name}.fieldnorm", norms)
+    fields[name] = {
+        "type": "text", "tokenizer": "raw", "record": "basic", "indexed": True,
+        "fast": True, "column_kind": "ordinal", "cardinality": len(vocab),
+        "num_terms": len(vocab), "total_tokens": num_docs,
+        "avg_len": 1.0,
+    }
+
+
+def _write_body(builder, fields, rng, num_docs, num_docs_padded):
+    """Zipf-distributed body terms, fully vectorized (one draw + one sort),
+    so 10M-doc benchmark splits generate in seconds."""
+    vocab = [f"term{k:04d}" for k in range(_BODY_VOCAB_SIZE)]
+    draws = rng.zipf(1.5, size=num_docs * _BODY_TOKENS_PER_DOC) - 1
+    flat_terms = np.minimum(draws, _BODY_VOCAB_SIZE - 1).astype(np.int64)
+    flat_docs = np.repeat(np.arange(num_docs, dtype=np.int64), _BODY_TOKENS_PER_DOC)
+    # dedupe (term, doc) pairs -> tf=1 postings sorted by (term, doc)
+    keys = np.unique(flat_terms * num_docs_padded + flat_docs)
+    terms_sorted = (keys // num_docs_padded).astype(np.int32)
+    docs_sorted = (keys % num_docs_padded).astype(np.int32)
+    starts = np.searchsorted(terms_sorted, np.arange(_BODY_VOCAB_SIZE))
+    ends = np.searchsorted(terms_sorted, np.arange(_BODY_VOCAB_SIZE), side="right")
+    dfs = (ends - starts).astype(np.int32)
+    post_lens = np.array([pad_to(max(int(d), 1), POSTING_PAD) for d in dfs],
+                         dtype=np.int32)
+    post_offs = np.zeros(_BODY_VOCAB_SIZE, dtype=np.int64)
+    np.cumsum(post_lens[:-1], out=post_offs[1:])
+    total = int(post_lens.sum())
+    ids_arena = np.full(total, num_docs_padded, dtype=np.int32)
+    tfs_arena = np.zeros(total, dtype=np.int32)
+    # scatter each term's slice into its padded arena range, vectorized:
+    # target positions = post_off[term] + rank within term
+    ranks = np.arange(len(keys), dtype=np.int64) - starts[terms_sorted]
+    positions = post_offs[terms_sorted] + ranks
+    ids_arena[positions] = docs_sorted
+    tfs_arena[positions] = 1
+    norms = np.zeros(num_docs_padded, dtype=np.int32)
+    np.add.at(norms, docs_sorted, 1)
+    term_offsets = np.arange(_BODY_VOCAB_SIZE + 1, dtype=np.int64) * 8
+    builder.add_array("inv.body.terms.blob",
+                      np.frombuffer("".join(vocab).encode(), dtype=np.uint8))
+    builder.add_array("inv.body.terms.offsets", term_offsets)
+    builder.add_array("inv.body.terms.df", dfs)
+    builder.add_array("inv.body.terms.post_off", post_offs)
+    builder.add_array("inv.body.terms.post_len", post_lens)
+    builder.add_array("inv.body.postings.ids", ids_arena)
+    builder.add_array("inv.body.postings.tfs", tfs_arena)
+    builder.add_array("inv.body.fieldnorm", norms)
+    fields["body"] = {
+        "type": "text", "tokenizer": "default", "record": "basic",
+        "indexed": True, "num_terms": _BODY_VOCAB_SIZE,
+        "total_tokens": int(norms.sum()),
+        "avg_len": float(norms[:num_docs].mean()) if num_docs else 0.0,
+    }
+
+
+def _write_store(builder, ts_seconds, tenants, sev, num_docs):
+    lines = []
+    for i in range(num_docs):
+        lines.append(json.dumps({
+            "timestamp": int(ts_seconds[i]), "tenant_id": int(tenants[i]),
+            "severity_text": SEVERITIES[int(sev[i])]},
+            separators=(",", ":")).encode())
+    block = zlib.compress(b"\n".join(lines), 1)
+    builder.add_array("store.data", np.frombuffer(block, dtype=np.uint8))
+    builder.add_array("store.block_offsets", np.array([0, len(block)], dtype=np.int64))
+    builder.add_array("store.block_first_doc", np.array([0, num_docs], dtype=np.int32))

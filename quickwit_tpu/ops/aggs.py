@@ -21,6 +21,51 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
+# --- bucket reductions ------------------------------------------------------
+# Scatter-adds into tiny bucket spaces serialize on TPU (65ms for a 4-bucket
+# terms count over 10M docs, measured); a compare-and-reduce over a
+# broadcast [docs, buckets] predicate fuses onto the VPU instead (0.2ms).
+# Above the threshold, collisions spread out and scatter wins on memory.
+
+_COMPARE_MAX_BUCKETS = 256
+_COMPARE_MAX_BUCKETS_METRIC = 64
+
+
+def bucket_counts(idx: jnp.ndarray, num_buckets: int) -> jnp.ndarray:
+    """Counts per bucket; `idx` int32 with out-of-range sentinel for dropped
+    docs (e.g. num_buckets)."""
+    if num_buckets <= _COMPARE_MAX_BUCKETS:
+        eq = idx[:, None] == jnp.arange(num_buckets, dtype=jnp.int32)[None, :]
+        return jnp.sum(eq, axis=0, dtype=jnp.int32)
+    return jnp.zeros(num_buckets, dtype=jnp.int32).at[idx].add(1, mode="drop")
+
+
+def bucket_sum(idx: jnp.ndarray, values: jnp.ndarray, num_buckets: int,
+               dtype=jnp.float64) -> jnp.ndarray:
+    """Per-bucket sums of `values` (docs with sentinel idx contribute 0)."""
+    if num_buckets <= _COMPARE_MAX_BUCKETS_METRIC:
+        eq = idx[:, None] == jnp.arange(num_buckets, dtype=jnp.int32)[None, :]
+        return jnp.sum(jnp.where(eq, values[:, None].astype(dtype), 0), axis=0)
+    return jnp.zeros(num_buckets, dtype=dtype).at[idx].add(
+        values.astype(dtype), mode="drop")
+
+
+def bucket_min(idx: jnp.ndarray, values: jnp.ndarray, num_buckets: int) -> jnp.ndarray:
+    if num_buckets <= _COMPARE_MAX_BUCKETS_METRIC:
+        eq = idx[:, None] == jnp.arange(num_buckets, dtype=jnp.int32)[None, :]
+        return jnp.min(jnp.where(eq, values[:, None].astype(jnp.float64), jnp.inf), axis=0)
+    return jnp.full(num_buckets, jnp.inf, dtype=jnp.float64).at[idx].min(
+        values.astype(jnp.float64), mode="drop")
+
+
+def bucket_max(idx: jnp.ndarray, values: jnp.ndarray, num_buckets: int) -> jnp.ndarray:
+    if num_buckets <= _COMPARE_MAX_BUCKETS_METRIC:
+        eq = idx[:, None] == jnp.arange(num_buckets, dtype=jnp.int32)[None, :]
+        return jnp.max(jnp.where(eq, values[:, None].astype(jnp.float64), -jnp.inf), axis=0)
+    return jnp.full(num_buckets, -jnp.inf, dtype=jnp.float64).at[idx].max(
+        values.astype(jnp.float64), mode="drop")
+
+
 # --- stats -----------------------------------------------------------------
 
 def stats_state(values: jnp.ndarray, present: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:

@@ -22,6 +22,28 @@ from jax import lax
 # catastrophically slow under the axon PJRT plugin (~70ms/call observed).
 NEG_INF = float("-inf")
 
+_BLOCK = 1024  # == index.format.DOC_PAD, so dense doc arrays always divide
+
+
+def exact_topk(x: jnp.ndarray, k: int):
+    """Exact top-k, blockwise two-stage.
+
+    XLA's top_k on TPU full-sorts the operand (~66ms for 10M f32); reshaping
+    to [G, 1024] blocks, taking per-block top-k, then re-top-k'ing the G*k
+    winners is bit-exact (every global winner is a block winner) and ~300x
+    faster (0.2ms measured). Tie-breaking is preserved: the flattened
+    (block, rank) order equals index order for equal keys.
+    """
+    n = x.shape[0]
+    if n % _BLOCK == 0 and k <= _BLOCK and n // _BLOCK >= 2:
+        grid = n // _BLOCK
+        vals, idx = lax.top_k(x.reshape(grid, _BLOCK), min(k, _BLOCK))
+        flat_idx = (jnp.arange(grid, dtype=jnp.int32)[:, None] * _BLOCK
+                    + idx.astype(jnp.int32)).reshape(-1)
+        top_vals, pos = lax.top_k(vals.reshape(-1), k)
+        return top_vals, flat_idx[pos]
+    return lax.top_k(x, k)
+
 
 def topk_by_score(scores: jnp.ndarray, mask: jnp.ndarray, k: int):
     """(sort_values, doc_ids, match_count) for score-descending top-k.
@@ -30,7 +52,7 @@ def topk_by_score(scores: jnp.ndarray, mask: jnp.ndarray, k: int):
     Non-matching docs get -inf keys; caller drops slots beyond match_count.
     """
     keyed = jnp.where(mask, scores, NEG_INF)
-    values, doc_ids = lax.top_k(keyed, k)
+    values, doc_ids = exact_topk(keyed, k)
     return values, doc_ids.astype(jnp.int32), jnp.sum(mask.astype(jnp.int32))
 
 
@@ -54,7 +76,7 @@ def topk_by_value(values: jnp.ndarray, present: jnp.ndarray, mask: jnp.ndarray,
     # non-matching docs), so they still fill top-k slots, last.
     missing_sentinel = jnp.float64(-1.7976931348623157e308)
     keyed = jnp.where(has_value, key, jnp.where(mask, missing_sentinel, -jnp.inf))
-    top_vals, doc_ids = lax.top_k(keyed, k)
+    top_vals, doc_ids = exact_topk(keyed, k)
     # top_vals stay in "higher is better" key space (ascending sorts keep the
     # negation) — that is the cross-split merge contract of the collector;
     # the leaf converts back to raw values for display.

@@ -1,0 +1,3 @@
+from .fanout import SplitBatch, build_batch, execute_batch, make_mesh
+
+__all__ = ["SplitBatch", "build_batch", "execute_batch", "make_mesh"]

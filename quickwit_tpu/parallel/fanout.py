@@ -1,0 +1,343 @@
+"""Multi-split, multi-chip query execution over a device mesh.
+
+Role of the reference's query fan-out + scatter-gather merge tree
+(SURVEY.md §2.3: rendezvous job placement → per-node leaf batches → per-split
+tasks → `IncrementalCollector` merges → root `merge_fruits`), re-designed for
+TPU: the split dimension becomes a **mesh axis**, the merge tree becomes XLA
+collectives over ICI (the pmap'd merge of BASELINE.json):
+
+    mesh = Mesh(devices, ("splits", "docs"))
+    arrays: postings stacked [n_splits, plen]       → P("splits")
+            columns stacked  [n_splits, padded]     → P("splits", "docs")
+    shard_map: each device searches its split shard over its doc shard
+      - per-split kernel vmapped over the local split batch
+      - doc-axis partials merged by psum (counts/aggs) and
+        all_gather + re-top-k (hits) over ICI
+      - split-axis partials likewise
+
+The doc axis is the long-dimension ("sequence parallel") analogue: one huge
+split's dense doc arrays are sharded across chips, with the same
+collective-merge pattern (SURVEY.md §5.7).
+
+Batch restrictions (checked at build): all splits share one doc-mapping and
+the query must lower to a split-independent structure — wildcard/regex/
+phrase-prefix expand differently per split and fall back to per-split
+sequential leaf search in the search service.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..index.reader import SplitReader
+from ..models.doc_mapper import DocMapper
+from ..query.aggregations import DateHistogramAgg, HistogramAgg, TermsAgg, parse_aggs
+from ..search.models import LeafSearchResponse, PartialHit, SearchRequest
+from ..search.plan import BucketAggExec, LoweredPlan, MetricAggExec, lower_request
+from ..search import executor as executor_mod
+from ..search.leaf import _intermediate_aggs, _sort_values_are_int
+
+
+def make_mesh(axis_splits: int, axis_docs: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    need = axis_splits * axis_docs
+    if devs.size < need:
+        raise ValueError(f"need {need} devices, have {devs.size}")
+    return Mesh(devs[:need].reshape(axis_splits, axis_docs), ("splits", "docs"))
+
+
+# --------------------------------------------------------------------------
+
+@dataclass
+class SplitBatch:
+    """Same-structure plans for one query over many splits, stacked."""
+    template: LoweredPlan                 # structure donor (slots/signature)
+    arrays: list[np.ndarray]              # slot-indexed, stacked [n, ...]
+    scalars: list[np.ndarray]             # slot-indexed, stacked [n]
+    num_docs: np.ndarray                  # [n] int32
+    split_ids: list[str]                  # n entries ("" = padding split)
+    num_docs_padded: int                  # uniform padded doc count
+    doc_mapper: DocMapper
+    sort_field: str
+    sort_order: str
+
+    @property
+    def n_splits(self) -> int:
+        return len(self.split_ids)
+
+
+def _global_agg_overrides(agg_specs, readers: list[SplitReader],
+                          doc_mapper: DocMapper) -> dict:
+    """Compute batch-global bucket spaces so per-split states merge on device."""
+    histograms: dict[str, tuple[int, int]] = {}
+    terms_dicts: dict[str, dict] = {}
+    terms_cards: dict[str, int] = {}
+    terms_keys: dict[str, list] = {}
+    from ..search.plan import MAX_BUCKETS, PlanError
+    for spec in agg_specs:
+        if isinstance(spec, (DateHistogramAgg, HistogramAgg)):
+            vmins, vmaxs = [], []
+            for r in readers:
+                meta = r.field_meta(spec.field)
+                if meta.get("min_value") is not None:
+                    vmins.append(meta["min_value"])
+                    vmaxs.append(meta["max_value"])
+            if isinstance(spec, DateHistogramAgg) and spec.extended_bounds:
+                vmins.append(spec.extended_bounds[0])
+                vmaxs.append(spec.extended_bounds[1])
+            if not vmins:
+                histograms[spec.name] = (0, 1)
+                continue
+            interval = spec.interval_micros if isinstance(spec, DateHistogramAgg) \
+                else spec.interval
+            if isinstance(spec, DateHistogramAgg):
+                origin = (min(vmins) // interval) * interval
+            else:
+                origin = float(np.floor(min(vmins) / interval) * interval)
+            num_buckets = int((max(vmaxs) - origin) // interval) + 1
+            if num_buckets > MAX_BUCKETS:
+                raise PlanError(
+                    f"aggregation {spec.name!r} would create {num_buckets} "
+                    f"buckets over the batch (max {MAX_BUCKETS})")
+            histograms[spec.name] = (origin if isinstance(spec, HistogramAgg)
+                                     else int(origin), num_buckets)
+        elif isinstance(spec, TermsAgg):
+            union: set = set()
+            for r in readers:
+                meta = r.field_meta(spec.field)
+                if meta.get("column_kind") == "ordinal":
+                    union.update(r.column_dict(spec.field))
+                else:
+                    from ..search.plan import Lowering
+                    low = Lowering(doc_mapper, r)
+                    _, keys = low._ordinalize_numeric(spec.field)
+                    union.update(keys)
+            keys_sorted = sorted(union, key=lambda v: (str(type(v)), v))
+            terms_dicts[spec.field] = {k: i for i, k in enumerate(keys_sorted)}
+            terms_cards[spec.field] = len(keys_sorted)
+            terms_keys[spec.field] = keys_sorted
+    return {"histograms": histograms, "terms_dicts": terms_dicts,
+            "terms_cards": terms_cards, "terms_keys": terms_keys}
+
+
+def _pad_fill(key: str, num_docs_padded: int):
+    if key.startswith("post.") and key.endswith(".ids"):
+        return num_docs_padded        # OOB scatter sentinel
+    if key.startswith("pre.") and key.endswith(".ids"):
+        return num_docs_padded
+    if "ordinals" in key:
+        return -1
+    return 0
+
+
+def build_batch(request: SearchRequest, doc_mapper: DocMapper,
+                readers: list[SplitReader], split_ids: list[str],
+                pad_to_splits: Optional[int] = None) -> SplitBatch:
+    agg_specs = parse_aggs(request.aggs) if request.aggs else []
+    overrides = _global_agg_overrides(agg_specs, readers, doc_mapper)
+    sort = request.sort_fields[0] if request.sort_fields else None
+    sort_field = sort.field if sort else "_score"
+    sort_order = sort.order if sort else "desc"
+
+    num_docs_padded = max(r.num_docs_padded for r in readers)
+    plans: list[LoweredPlan] = []
+    for reader in readers:
+        plan = lower_request(
+            request.query_ast, doc_mapper, reader, agg_specs,
+            sort_field=sort_field, sort_order=sort_order,
+            start_timestamp=request.start_timestamp,
+            end_timestamp=request.end_timestamp,
+            batch_overrides=overrides,
+        )
+        plans.append(plan)
+    sigs = {p.root.sig() + p.sort.sig() + ",".join(a.sig() for a in p.aggs)
+            for p in plans}
+    if len(sigs) != 1:
+        raise ValueError(
+            "query does not lower to a uniform structure across splits "
+            "(wildcard/regex/phrase-prefix queries need per-split execution)")
+
+    template = plans[0]
+    n = len(plans)
+    total = pad_to_splits or n
+    num_slots = len(template.arrays)
+
+    stacked_arrays: list[np.ndarray] = []
+    for slot in range(num_slots):
+        key = template.array_keys[slot]
+        fill = _pad_fill(key, num_docs_padded)
+        per_split = [p.arrays[slot] for p in plans]
+        # uniform last-dim length: postings pad to max, doc-dim pad to padded
+        max_len = max(a.shape[0] for a in per_split)
+        if key.startswith(("col.", "norm.")):
+            max_len = num_docs_padded
+        dtype = per_split[0].dtype
+        out = np.full((total, max_len), fill, dtype=dtype)
+        for i, a in enumerate(per_split):
+            out[i, : a.shape[0]] = a
+        stacked_arrays.append(out)
+
+    stacked_scalars: list[np.ndarray] = []
+    for slot in range(len(template.scalars)):
+        vals = [p.scalars[slot] for p in plans]
+        out = np.zeros(total, dtype=vals[0].dtype)
+        for i, v in enumerate(vals):
+            out[i] = v
+        stacked_scalars.append(out)
+
+    num_docs = np.zeros(total, dtype=np.int32)
+    num_docs[:n] = [p.num_docs for p in plans]
+    ids = list(split_ids) + [""] * (total - n)
+
+    # retarget the template's padded size to the batch-uniform one
+    template.num_docs_padded = num_docs_padded
+    return SplitBatch(
+        template=template, arrays=stacked_arrays, scalars=stacked_scalars,
+        num_docs=num_docs, split_ids=ids, num_docs_padded=num_docs_padded,
+        doc_mapper=doc_mapper, sort_field=sort_field, sort_order=sort_order,
+    )
+
+
+# --------------------------------------------------------------------------
+# merged execution
+
+_BATCH_JIT_CACHE: dict[tuple, Any] = {}
+
+
+def _merge_agg_stack(agg_out):
+    """agg_out leaves carry a leading split axis [n, ...] → reduce axis 0
+    (counts/sums add, min/max combine by leaf name)."""
+    def red(path, leaf):
+        name = None
+        for p in reversed(path):
+            if hasattr(p, "key"):
+                name = p.key
+                break
+        if name == "min":
+            return jnp.min(leaf, axis=0)
+        if name == "max":
+            return jnp.max(leaf, axis=0)
+        if name == "stats":
+            # state vector [count, sum, sum_sq, min, max]: first three add
+            return jnp.concatenate([
+                jnp.sum(leaf[:, :3], axis=0),
+                jnp.min(leaf[:, 3:4], axis=0),
+                jnp.max(leaf[:, 4:5], axis=0),
+            ])
+        return jnp.sum(leaf, axis=0)
+    return jax.tree_util.tree_map_with_path(red, agg_out)
+
+
+def batch_shardings(batch: SplitBatch, mesh: Mesh):
+    """NamedShardings for the stacked inputs: every slot is sharded over the
+    'splits' axis; dense per-doc slots (columns, fieldnorms) additionally
+    shard their doc dimension over the 'docs' axis (the long-dimension /
+    sequence-parallel analogue). XLA GSPMD inserts the ICI collectives for
+    the cross-shard reductions and top-k merges."""
+    from jax.sharding import NamedSharding
+    array_shardings = []
+    for key in batch.template.array_keys:
+        if key.startswith(("col.", "norm.")):
+            array_shardings.append(NamedSharding(mesh, P("splits", "docs")))
+        else:
+            array_shardings.append(NamedSharding(mesh, P("splits", None)))
+    scalar_shardings = [NamedSharding(mesh, P("splits"))] * len(batch.template.scalars)
+    nd_sharding = NamedSharding(mesh, P("splits"))
+    return tuple(array_shardings), tuple(scalar_shardings), nd_sharding
+
+
+def _batch_executor(batch: SplitBatch, k: int, mesh: Optional[Mesh]):
+    template = batch.template
+    single_fn = executor_mod._build(template, k)
+
+    def fn(arrays, scalars, num_docs):
+        results = jax.vmap(single_fn)(arrays, scalars, num_docs)
+        sort_vals, doc_ids, hit_scores, counts, agg_out = results
+        # flatten [n, k] → [n*k]; split-major order keeps the
+        # (key desc, split asc, doc asc) tie-break of the collector
+        top_vals, pos = jax.lax.top_k(sort_vals.reshape(-1), k)
+        split_idx = (pos // k).astype(jnp.int32)
+        flat_ids = doc_ids.reshape(-1)[pos]
+        flat_scores = hit_scores.reshape(-1)[pos]
+        total = jnp.sum(counts)
+        return top_vals, split_idx, flat_ids, flat_scores, total, \
+            _merge_agg_stack(agg_out)
+
+    if mesh is None:
+        return jax.jit(fn)
+    arrays_sh, scalars_sh, nd_sh = batch_shardings(batch, mesh)
+    return jax.jit(fn, in_shardings=(arrays_sh, scalars_sh, nd_sh))
+
+
+def execute_batch(batch: SplitBatch, request: SearchRequest,
+                  mesh: Optional[Mesh] = None) -> LeafSearchResponse:
+    """Run the batch (optionally mesh-sharded) and emit one merged
+    LeafSearchResponse covering all splits."""
+    k = max(request.start_offset + request.max_hits, 1)
+    k = min(k, batch.num_docs_padded)
+    # Mesh is hashable; id() would go stale if a dead mesh's address is reused
+    key = (batch.template.signature(k), batch.n_splits,
+           batch.num_docs_padded, mesh)
+    ex = _BATCH_JIT_CACHE.get(key)
+    if ex is None:
+        ex = _batch_executor(batch, k, mesh)
+        _BATCH_JIT_CACHE[key] = ex
+
+    # one batched transfer, cached on the batch for repeat queries
+    dev = getattr(batch, "_device_inputs", None)
+    if dev is None:
+        if mesh is not None:
+            arrays_sh, scalars_sh, nd_sh = batch_shardings(batch, mesh)
+            arrays = tuple(jax.device_put(batch.arrays, list(arrays_sh)))
+            scalars = tuple(jax.device_put(batch.scalars, list(scalars_sh))) \
+                if batch.scalars else ()
+            nd = jax.device_put(batch.num_docs, nd_sh)
+        else:
+            moved = jax.device_put(batch.arrays + batch.scalars + [batch.num_docs])
+            arrays = tuple(moved[: len(batch.arrays)])
+            scalars = tuple(moved[len(batch.arrays):-1])
+            nd = moved[-1]
+        dev = batch._device_inputs = (arrays, scalars, nd)
+    arrays, scalars, nd = dev
+    out = ex(arrays, scalars, nd)
+    top_vals, split_idx, doc_ids, scores, total, merged_aggs = jax.device_get(out)
+
+    num_hits = int(total)
+    hits: list[PartialHit] = []
+    sort_is_int = _sort_values_are_int(batch.doc_mapper, batch.sort_field)
+    for i in range(min(k, num_hits)):
+        internal = float(top_vals[i])
+        if internal == float("-inf"):
+            break
+        split_id = batch.split_ids[int(split_idx[i])]
+        if split_id == "":
+            continue
+        if batch.sort_field == "_score":
+            raw: Any = float(scores[i])
+        elif batch.sort_field == "_doc":
+            raw = int(doc_ids[i])
+        elif internal <= -1.7e308:
+            raw = None
+        else:
+            raw = internal if batch.sort_order == "desc" else -internal
+            if sort_is_int:
+                raw = int(raw)
+        hits.append(PartialHit(sort_value=internal, split_id=split_id,
+                               doc_id=int(doc_ids[i]), raw_sort_value=raw))
+
+    intermediate = _intermediate_aggs(batch.template, list(merged_aggs))
+    real_splits = sum(1 for s in batch.split_ids if s)
+    return LeafSearchResponse(
+        num_hits=num_hits,
+        partial_hits=hits,
+        num_attempted_splits=real_splits,
+        num_successful_splits=real_splits,
+        intermediate_aggs=intermediate,
+    )

@@ -126,30 +126,28 @@ def _build(plan: LoweredPlan, k: int) -> Callable:
             idx = raw.astype(jnp.int32)
             m = m & (idx >= 0) & (idx < nb)
             idx = jnp.where(m, idx, jnp.int32(nb))
-        counts = jnp.zeros(nb, dtype=jnp.int32).at[idx].add(1, mode="drop")
+        counts = agg_ops.bucket_counts(idx, nb)
         out: dict[str, Any] = {"counts": counts}
         metrics: dict[str, Any] = {}
         for met in a.metrics:
             mv = arrays[met.values_slot].astype(jnp.float64)
             mp = arrays[met.present_slot].astype(jnp.bool_)
+            # docs with mm==False get the sentinel index; both bucket-kernel
+            # paths neutralize them, so mv needs no extra masking passes
             mm = m & mp
             midx = jnp.where(mm, idx, jnp.int32(nb))
             state: dict[str, Any] = {}
             need = met.kind
             if need in ("sum", "avg", "stats"):
-                state["sum"] = jnp.zeros(nb, dtype=jnp.float64).at[midx].add(
-                    jnp.where(mm, mv, 0.0), mode="drop")
+                state["sum"] = agg_ops.bucket_sum(midx, mv, nb)
             if need in ("avg", "stats", "value_count"):
-                state["count"] = jnp.zeros(nb, dtype=jnp.int64).at[midx].add(1, mode="drop")
+                state["count"] = agg_ops.bucket_counts(midx, nb).astype(jnp.int64)
             if need in ("min", "stats"):
-                state["min"] = jnp.full(nb, jnp.inf, dtype=jnp.float64).at[midx].min(
-                    jnp.where(mm, mv, jnp.inf), mode="drop")
+                state["min"] = agg_ops.bucket_min(midx, mv, nb)
             if need in ("max", "stats"):
-                state["max"] = jnp.full(nb, -jnp.inf, dtype=jnp.float64).at[midx].max(
-                    jnp.where(mm, mv, -jnp.inf), mode="drop")
+                state["max"] = agg_ops.bucket_max(midx, mv, nb)
             if need == "stats":
-                state["sum_sq"] = jnp.zeros(nb, dtype=jnp.float64).at[midx].add(
-                    jnp.where(mm, mv * mv, 0.0), mode="drop")
+                state["sum_sq"] = agg_ops.bucket_sum(midx, mv * mv, nb)
             metrics[met.name] = state
         out["metrics"] = metrics
         return out
@@ -169,7 +167,7 @@ def _build(plan: LoweredPlan, k: int) -> Callable:
         else:  # "_doc" — sort_vals stay in higher-is-better key space
             key = jnp.arange(padded, dtype=jnp.float64)
             key = jnp.where(mask, key if sort.descending else -key, -jnp.inf)
-            sort_vals, doc_ids = jax.lax.top_k(key, k)
+            sort_vals, doc_ids = topk_ops.exact_topk(key, k)
             count = jnp.sum(mask.astype(jnp.int32))
         hit_scores = scores[jnp.clip(doc_ids, 0, padded - 1)]
         agg_out = []

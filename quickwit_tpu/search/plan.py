@@ -230,10 +230,20 @@ class _Builder:
 # --------------------------------------------------------------------------
 
 class Lowering:
-    def __init__(self, doc_mapper: DocMapper, reader: SplitReader):
+    """`batch_overrides` (multi-split batches, parallel/fanout.py) forces a
+    split-independent plan structure: missing terms lower to empty posting
+    slots instead of PMatchNone, date_histogram bucket spaces come from the
+    batch-global time range, and terms-agg ordinals are remapped to a
+    batch-global dictionary."""
+
+    def __init__(self, doc_mapper: DocMapper, reader: SplitReader,
+                 batch_overrides: Optional[dict] = None):
         self.doc_mapper = doc_mapper
         self.reader = reader
         self.b = _Builder(reader)
+        self.batch = batch_overrides  # {"histograms": {name: (origin, nb)},
+                                      #  "terms_dicts": {field: {key: gord}},
+                                      #  "terms_cards": {field: int}}
 
     # --- helpers ----------------------------------------------------------
     def _field(self, name: str) -> FieldMapping:
@@ -246,7 +256,9 @@ class Lowering:
                        boost: float) -> Any:
         info = self.reader.lookup_term(field, term)
         if info is None:
-            return PMatchNone()
+            if self.batch is None:
+                return PMatchNone()
+            return self._empty_postings_node(field, term, scoring)
         ids_slot = self.b.add_array(
             f"post.{field}.{info.ordinal}.ids",
             lambda: self.reader.postings(field, info)[0])
@@ -263,13 +275,32 @@ class Lowering:
         avg_slot = self.b.add_scalar(meta.get("avg_len", 1.0), np.float32)
         return PPostings(ids_slot, tfs_slot, True, norm_slot, idf_slot, avg_slot)
 
+    def _empty_postings_node(self, field: str, term: str, scoring: bool) -> Any:
+        """Uniform-structure stand-in for a term absent from this split."""
+        from ..index.format import POSTING_PAD
+        sentinel = self.reader.num_docs_padded
+        ids_slot = self.b.add_array(
+            f"post.{field}.absent:{term}.ids",
+            lambda: np.full(POSTING_PAD, sentinel, dtype=np.int32))
+        tfs_slot = self.b.add_array(
+            f"post.{field}.absent:{term}.tfs",
+            lambda: np.zeros(POSTING_PAD, dtype=np.int32))
+        if not scoring:
+            return PPostings(ids_slot, tfs_slot, scoring=False)
+        meta = self.reader.field_meta(field)
+        norm_slot = self.b.add_array(
+            f"norm.{field}", lambda: self.reader.fieldnorm(field))
+        idf_slot = self.b.add_scalar(0.0, np.float32)
+        avg_slot = self.b.add_scalar(meta.get("avg_len", 1.0), np.float32)
+        return PPostings(ids_slot, tfs_slot, True, norm_slot, idf_slot, avg_slot)
+
     def _precomputed_node(self, key: str, ids: np.ndarray, freqs: np.ndarray,
                           field: str, scoring: bool, boost: float,
                           df_for_idf: int) -> Any:
         from ..index.format import POSTING_PAD, pad_to
-        if ids.size == 0:
+        if ids.size == 0 and self.batch is None:
             return PMatchNone()
-        padded = pad_to(ids.size, POSTING_PAD)
+        padded = pad_to(max(ids.size, 1), POSTING_PAD)
         pids = np.full(padded, self.reader.num_docs_padded, dtype=np.int32)
         ptfs = np.zeros(padded, dtype=np.int32)
         pids[: ids.size] = ids
@@ -404,10 +435,16 @@ class Lowering:
             raise PlanError(
                 f"phrase query on field {field!r} requires record='position'")
         infos = []
+        empty = np.array([], dtype=np.int32)
         for term in terms:
             info = self.reader.lookup_term(field, term)
             if info is None:
-                return PMatchNone()
+                if self.batch is None:
+                    return PMatchNone()
+                # batch mode: keep the structure uniform across splits
+                return self._precomputed_node(
+                    f"{field}.phrase.absent:" + "/".join(terms), empty, empty,
+                    field, scoring, boost, df_for_idf=0)
             infos.append(info)
         postings = [self.reader.postings(field, i) for i in infos]
         positions = [self.reader.positions(field, i) for i in infos]
@@ -522,6 +559,17 @@ class Lowering:
             values_slot, present_slot = self._column_slots(spec.field)
             meta = self.reader.field_meta(spec.field)
             vmin, vmax = meta.get("min_value"), meta.get("max_value")
+            if self.batch is not None and spec.name in self.batch.get("histograms", {}):
+                origin, num_buckets = self.batch["histograms"][spec.name]
+                return BucketAggExec(
+                    spec.name, "date_histogram", values_slot, present_slot,
+                    num_buckets,
+                    self.b.add_scalar(origin, np.int64),
+                    self.b.add_scalar(spec.interval_micros, np.int64),
+                    metrics=self._metric_tuple(spec.sub_metrics),
+                    host_info={"interval": spec.interval_micros, "origin": origin,
+                               "min_doc_count": spec.min_doc_count,
+                               "extended_bounds": spec.extended_bounds})
             if vmin is None:
                 return BucketAggExec(spec.name, "date_histogram", values_slot,
                                      present_slot, 1,
@@ -551,6 +599,15 @@ class Lowering:
         if isinstance(spec, HistogramAgg):
             fm = self._field(spec.field)
             values_slot, present_slot = self._column_slots(spec.field)
+            if self.batch is not None and spec.name in self.batch.get("histograms", {}):
+                origin, num_buckets = self.batch["histograms"][spec.name]
+                return BucketAggExec(
+                    spec.name, "histogram", values_slot, present_slot, num_buckets,
+                    self.b.add_scalar(origin, np.float64),
+                    self.b.add_scalar(spec.interval, np.float64),
+                    metrics=self._metric_tuple(spec.sub_metrics),
+                    host_info={"interval": spec.interval, "origin": origin,
+                               "min_doc_count": spec.min_doc_count})
             meta = self.reader.field_meta(spec.field)
             vmin, vmax = meta.get("min_value"), meta.get("max_value")
             if vmin is None:
@@ -578,6 +635,32 @@ class Lowering:
         if not fm.fast:
             raise PlanError(f"terms aggregation requires fast field: {spec.field!r}")
         meta = self.reader.field_meta(spec.field)
+        if self.batch is not None and spec.field in self.batch.get("terms_dicts", {}):
+            # remap this split's local ordinals into the batch-global dictionary
+            global_of = self.batch["terms_dicts"][spec.field]
+            cardinality = self.batch["terms_cards"][spec.field]
+            global_keys = self.batch["terms_keys"][spec.field]
+
+            def fetch_remapped():
+                if meta.get("column_kind") == "ordinal":
+                    local = self.reader.column_ordinals(spec.field)
+                    local_keys = self.reader.column_dict(spec.field)
+                else:
+                    local, local_keys = self._ordinalize_numeric(spec.field)
+                lut = np.array([global_of[k] for k in local_keys], dtype=np.int32)
+                out = np.full_like(local, -1)
+                valid = local >= 0
+                out[valid] = lut[local[valid]]
+                return out
+
+            return BucketAggExec(
+                spec.name, "terms",
+                self.b.add_array(f"col.{spec.field}.ordinals_global", fetch_remapped),
+                -1, max(cardinality, 1),
+                metrics=self._metric_tuple(spec.sub_metrics),
+                host_info={"keys": global_keys, "size": spec.size,
+                           "min_doc_count": spec.min_doc_count,
+                           "order_desc": spec.order_by_count_desc})
         if meta.get("column_kind") == "ordinal":
             ordinals_slot = self.b.add_array(
                 f"col.{spec.field}.ordinals", lambda: self.reader.column_ordinals(spec.field))
@@ -652,9 +735,10 @@ def lower_request(
     sort_order: str = "desc",
     start_timestamp: Optional[int] = None,
     end_timestamp: Optional[int] = None,
+    batch_overrides: Optional[dict] = None,
 ) -> LoweredPlan:
     """Full request lowering: query + request-level time filter + sort + aggs."""
-    low = Lowering(doc_mapper, reader)
+    low = Lowering(doc_mapper, reader, batch_overrides)
     scoring = sort_field == "_score"
     root = low.lower(query_ast, scoring=scoring)
     if start_timestamp is not None or end_timestamp is not None:

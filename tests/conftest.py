@@ -2,7 +2,11 @@
 
 Tests run on CPU with 8 virtual devices so multi-chip sharding
 (`quickwit_tpu.parallel`) is exercised without TPU hardware, per the
-driver's dry-run model. Must run before any jax import.
+driver's dry-run model.
+
+NB: the environment's sitecustomize force-registers the axon TPU plugin and
+rewrites `jax_platforms` to "axon,cpu", so env vars alone are ignored — the
+config must be overridden in-process before any backend initialization.
 """
 
 import os
@@ -11,3 +15,8 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
