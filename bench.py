@@ -33,30 +33,16 @@ ITERATIONS = int(os.environ.get("BENCH_ITERS", 30))
 
 
 def main() -> None:
-    from quickwit_tpu.common.uri import Uri
-    from quickwit_tpu.index.reader import SplitReader
-    from quickwit_tpu.index.synthetic import HDFS_MAPPER, synthetic_hdfs_split
-    from quickwit_tpu.query.ast import Term
+    from __graft_entry__ import _flagship_request, _reader_for
+    from quickwit_tpu.index.synthetic import HDFS_MAPPER
     from quickwit_tpu.search.leaf import leaf_search_single_split
-    from quickwit_tpu.search.models import SearchRequest
-    from quickwit_tpu.storage.ram import RamStorage
 
     t0 = time.monotonic()
-    storage = RamStorage(Uri.parse("ram:///bench"))
-    storage.put("bench.split", synthetic_hdfs_split(NUM_DOCS, seed=7))
-    reader = SplitReader(storage, "bench.split")
+    reader = _reader_for(num_docs=NUM_DOCS, seed=7)
     gen_s = time.monotonic() - t0
 
-    request = SearchRequest(
-        index_ids=["hdfs-logs"],
-        query_ast=Term("severity_text", "ERROR"),
-        max_hits=10,
-        aggs={
-            "over_time": {"date_histogram": {"field": "timestamp",
-                                             "fixed_interval": "1d"}},
-            "severities": {"terms": {"field": "severity_text", "size": 10}},
-        },
-    )
+    # the flagship workload definition is shared with __graft_entry__.entry()
+    request = _flagship_request()
 
     # warmup: compile + device transfer
     t0 = time.monotonic()

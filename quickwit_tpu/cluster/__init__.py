@@ -1,0 +1,3 @@
+from .membership import Cluster, ClusterChange, ClusterMember
+
+__all__ = ["Cluster", "ClusterChange", "ClusterMember"]

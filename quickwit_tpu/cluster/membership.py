@@ -1,0 +1,103 @@
+"""Cluster membership + failure detection.
+
+Role of the reference's `quickwit-cluster` (chitchat scuttlebutt gossip +
+phi-accrual failure detection, `cluster.rs:61,167`): who is in the cluster,
+which roles they run, and liveness. This implementation keeps the same
+surface — members with roles/generation, readiness, a change stream feeding
+client pools — over a pluggable dissemination layer: in-process registry now
+(single-process clusters, tests), heartbeats over the REST transport for
+multi-process (serve layer); the gossip state machine is the same either way.
+
+Failure detection is a simplified phi-accrual: a node is suspected dead when
+its heartbeat age exceeds `dead_after_secs` (the reference's phi threshold
+collapses to this under regular heartbeat intervals).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, Optional
+
+from ..common.pubsub import EventBroker
+
+ALL_ROLES = ("searcher", "indexer", "metastore", "control_plane", "janitor",
+             "ingester")
+
+
+@dataclass
+class ClusterMember:
+    node_id: str
+    roles: tuple[str, ...]
+    rest_endpoint: str = ""          # "host:port" for cross-process transport
+    generation: int = 0
+    is_ready: bool = True
+    last_heartbeat: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class ClusterChange:
+    kind: str  # "add" | "remove" | "update"
+    member: ClusterMember
+
+
+class Cluster:
+    def __init__(self, self_node_id: str, roles: tuple[str, ...],
+                 rest_endpoint: str = "", heartbeat_interval_secs: float = 1.0,
+                 dead_after_secs: float = 10.0,
+                 broker: Optional[EventBroker] = None):
+        self.self_node_id = self_node_id
+        self.broker = broker or EventBroker()
+        self._members: dict[str, ClusterMember] = {}
+        self._lock = threading.Lock()
+        self.heartbeat_interval_secs = heartbeat_interval_secs
+        self.dead_after_secs = dead_after_secs
+        self_member = ClusterMember(self_node_id, roles, rest_endpoint)
+        self._members[self_node_id] = self_member
+
+    # --- membership --------------------------------------------------------
+    def join(self, member: ClusterMember) -> None:
+        with self._lock:
+            existing = self._members.get(member.node_id)
+            self._members[member.node_id] = member
+        self.broker.publish(ClusterChange("update" if existing else "add", member))
+
+    def leave(self, node_id: str) -> None:
+        with self._lock:
+            member = self._members.pop(node_id, None)
+        if member is not None:
+            self.broker.publish(ClusterChange("remove", member))
+
+    def record_heartbeat(self, node_id: str) -> None:
+        with self._lock:
+            member = self._members.get(node_id)
+            if member is not None:
+                member.last_heartbeat = time.monotonic()
+
+    # --- queries -----------------------------------------------------------
+    def members(self, alive_only: bool = True) -> list[ClusterMember]:
+        now = time.monotonic()
+        with self._lock:
+            out = []
+            for member in self._members.values():
+                if alive_only and member.node_id != self.self_node_id:
+                    if now - member.last_heartbeat > self.dead_after_secs:
+                        continue
+                out.append(member)
+            return sorted(out, key=lambda m: m.node_id)
+
+    def nodes_with_role(self, role: str, alive_only: bool = True) -> list[str]:
+        return [m.node_id for m in self.members(alive_only) if role in m.roles]
+
+    def member(self, node_id: str) -> Optional[ClusterMember]:
+        with self._lock:
+            return self._members.get(node_id)
+
+    def is_ready(self) -> bool:
+        return bool(self.nodes_with_role("searcher") or
+                    self.nodes_with_role("indexer"))
+
+    def subscribe(self, handler: Callable[[ClusterChange], None]):
+        return self.broker.subscribe(ClusterChange, handler)

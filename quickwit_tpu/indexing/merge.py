@@ -1,0 +1,213 @@
+"""Merge policies + merge execution.
+
+Role of the reference's merge side (`merge_planner.rs`, `merge_policy/
+stable_log_merge_policy.rs`, `merge_executor.rs`): decide which published
+splits to merge and replace N splits by one, through the same atomic
+stage/upload/publish(replace) protocol so no document is ever lost or
+duplicated (`no_split_loss`/`rows_conserved` invariants of quickwit-dst).
+
+The executor re-indexes documents from the source splits' doc stores through
+a SplitWriter; pending delete tasks (GDPR deletes) are applied during the
+rewrite, like the reference's delete-task pipeline applies deletes at merge
+time.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import zlib
+from dataclasses import dataclass
+from typing import Optional
+
+from ..index.reader import SplitReader
+from ..index.writer import SplitWriter
+from ..metastore.base import ListSplitsQuery, Metastore
+from ..models.doc_mapper import DocMapper
+from ..models.split_metadata import Split, SplitMetadata, SplitState, new_split_id
+from ..storage.base import Storage
+from .pipeline import split_file_path
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class MergeOperation:
+    splits: tuple[Split, ...]
+
+    @property
+    def split_ids(self) -> list[str]:
+        return [s.metadata.split_id for s in self.splits]
+
+
+class MergePolicy:
+    def operations(self, splits: list[Split]) -> list[MergeOperation]:
+        raise NotImplementedError
+
+
+class NopMergePolicy(MergePolicy):
+    def operations(self, splits: list[Split]) -> list[MergeOperation]:
+        return []
+
+
+class StableLogMergePolicy(MergePolicy):
+    """Size-tiered merging (reference `stable_log_merge_policy.rs`): splits
+    bucket into levels by doc-count magnitude; a level reaching
+    `merge_factor` members merges its oldest members into one split.
+    Splits at or above `split_num_docs_target` are mature and never merge.
+    """
+
+    def __init__(self, merge_factor: int = 10, max_merge_factor: int = 12,
+                 split_num_docs_target: int = 10_000_000,
+                 min_level_num_docs: int = 100_000):
+        self.merge_factor = merge_factor
+        self.max_merge_factor = max_merge_factor
+        self.split_num_docs_target = split_num_docs_target
+        self.min_level_num_docs = min_level_num_docs
+
+    def _level(self, num_docs: int) -> int:
+        level = 0
+        threshold = self.min_level_num_docs
+        while num_docs >= threshold:
+            level += 1
+            threshold *= self.merge_factor
+        return level
+
+    def operations(self, splits: list[Split]) -> list[MergeOperation]:
+        candidates = [
+            s for s in splits
+            if s.state is SplitState.PUBLISHED
+            and s.metadata.num_docs < self.split_num_docs_target
+        ]
+        by_level: dict[int, list[Split]] = {}
+        for split in candidates:
+            by_level.setdefault(self._level(split.metadata.num_docs), []).append(split)
+        operations = []
+        for level_splits in by_level.values():
+            level_splits.sort(key=lambda s: s.metadata.split_id)  # ULIDs: time order
+            while len(level_splits) >= self.merge_factor:
+                group = level_splits[: self.max_merge_factor]
+                level_splits = level_splits[len(group):]
+                operations.append(MergeOperation(tuple(group)))
+        return operations
+
+
+def merge_policy_from_config(config: dict) -> MergePolicy:
+    kind = config.get("type", "stable_log")
+    if kind == "stable_log":
+        return StableLogMergePolicy(
+            merge_factor=config.get("merge_factor", 10),
+            max_merge_factor=config.get("max_merge_factor", 12),
+            split_num_docs_target=config.get("split_num_docs_target", 10_000_000),
+            min_level_num_docs=config.get("min_level_num_docs", 100_000),
+        )
+    if kind in ("no_merge", "nop", "none"):
+        return NopMergePolicy()
+    raise ValueError(f"unknown merge policy {kind!r}")
+
+
+def _iter_all_docs(reader: SplitReader):
+    """Stream every stored document of a split in doc-id order."""
+    import json
+    block_first = reader.array("store.block_first_doc")
+    block_offsets = reader.array("store.block_offsets")
+    for block in range(len(block_first) - 1):
+        raw = reader.array_slice(
+            "store.data", int(block_offsets[block]),
+            int(block_offsets[block + 1] - block_offsets[block]))
+        for line in zlib.decompress(raw.tobytes()).split(b"\n"):
+            if line:
+                yield json.loads(line)
+
+
+class MergeExecutor:
+    """Reference `merge_executor.rs`: N published splits → 1, atomically."""
+
+    def __init__(self, index_uid: str, doc_mapper: DocMapper,
+                 metastore: Metastore, split_storage: Storage,
+                 node_id: str = "node-0"):
+        self.index_uid = index_uid
+        self.doc_mapper = doc_mapper
+        self.metastore = metastore
+        self.split_storage = split_storage
+        self.node_id = node_id
+
+    def execute(self, operation: MergeOperation,
+                delete_query_asts: Optional[list] = None) -> Optional[str]:
+        writer = SplitWriter(self.doc_mapper)
+        delete_matchers = self._delete_matchers(delete_query_asts or [])
+        max_delete_opstamp = self.metastore.last_delete_opstamp(self.index_uid)
+        for split in operation.splits:
+            reader = SplitReader(self.split_storage,
+                                 split_file_path(split.metadata.split_id))
+            for doc in _iter_all_docs(reader):
+                if any(matcher(doc) for matcher in delete_matchers):
+                    continue
+                writer.add_json_doc(doc)
+        if writer.num_docs == 0:
+            # all docs deleted: publish the replacement as a pure removal
+            self.metastore.publish_splits(
+                self.index_uid, [], replaced_split_ids=operation.split_ids)
+            return None
+        data = writer.finish()
+        merged_id = new_split_id()
+        metadata = SplitMetadata(
+            split_id=merged_id,
+            index_uid=self.index_uid,
+            source_id=operation.splits[0].metadata.source_id,
+            node_id=self.node_id,
+            num_docs=writer.num_docs,
+            uncompressed_docs_size_bytes=writer._uncompressed_docs_size,
+            footprint_bytes=len(data),
+            time_range_start=writer._time_min,
+            time_range_end=writer._time_max,
+            tags=frozenset(writer.tags),
+            create_timestamp=int(time.time()),
+            num_merge_ops=1 + max(s.metadata.num_merge_ops for s in operation.splits),
+            delete_opstamp=max_delete_opstamp,
+            doc_mapping_uid=operation.splits[0].metadata.doc_mapping_uid,
+        )
+        self.metastore.stage_splits(self.index_uid, [metadata])
+        self.split_storage.put(split_file_path(merged_id), data)
+        self.metastore.publish_splits(
+            self.index_uid, [merged_id],
+            replaced_split_ids=operation.split_ids)
+        logger.info("merged %d splits -> %s (%d docs)",
+                    len(operation.splits), merged_id, writer.num_docs)
+        return merged_id
+
+    def _delete_matchers(self, delete_query_asts: list):
+        """Host-side doc matchers for delete tasks. Round-1 subset: term and
+        bool-of-terms queries on mapped fields evaluated against the raw doc;
+        complex deletes are applied by search-based planners later."""
+        from ..query import ast as Q
+
+        def matcher_for(ast):
+            if isinstance(ast, Q.Term):
+                field_path = ast.field.split(".")
+
+                def match(doc, path=field_path, value=ast.value):
+                    node = doc
+                    for key in path:
+                        if not isinstance(node, dict) or key not in node:
+                            return False
+                        node = node[key]
+                    return str(node) == value
+                return match
+            if isinstance(ast, Q.Bool):
+                subs = [matcher_for(c) for c in ast.must + ast.filter]
+                nots = [matcher_for(c) for c in ast.must_not]
+                shoulds = [matcher_for(c) for c in ast.should]
+
+                def match(doc):
+                    if subs and not all(m(doc) for m in subs):
+                        return False
+                    if nots and any(m(doc) for m in nots):
+                        return False
+                    if shoulds and not (subs or any(m(doc) for m in shoulds)):
+                        return False
+                    return bool(subs or shoulds)
+                return match
+            raise ValueError(
+                f"delete query node {type(ast).__name__} not supported")
+        return [matcher_for(ast) for ast in delete_query_asts]

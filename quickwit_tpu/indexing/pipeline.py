@@ -1,0 +1,149 @@
+"""Indexing pipeline: source → typed docs → split files → atomic publish.
+
+Role of the reference's actor chain (`quickwit-indexing/src/actors/`:
+DocProcessor → Indexer → IndexSerializer → Packager → Uploader → Sequencer →
+Publisher, SURVEY.md §3.3), collapsed into a synchronous pipeline object —
+the stage boundaries and failure semantics are preserved (stage splits
+before upload; upload before publish; publish carries the checkpoint delta
+so crash-replays dedupe), while threading/supervision live one level up in
+the IndexingService.
+
+A split is cut when `split_num_docs_target` is reached or the source batch
+is force-committed (commit_timeout's role for bounded sources).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..index.writer import SplitWriter
+from ..metastore.base import Metastore
+from ..metastore.checkpoint import CheckpointDelta, SourceCheckpoint
+from ..models.doc_mapper import DocMapper, DocParsingError
+from ..models.split_metadata import SplitMetadata, new_split_id
+from ..storage.base import Storage
+from .sources import Source, SourceBatch
+
+logger = logging.getLogger(__name__)
+
+
+def split_file_path(split_id: str) -> str:
+    return f"{split_id}.split"
+
+
+@dataclass
+class PipelineParams:
+    index_uid: str
+    source_id: str
+    node_id: str = "node-0"
+    split_num_docs_target: int = 10_000_000
+    batch_num_docs: int = 10_000
+    doc_mapping_uid: str = "default"
+
+
+@dataclass
+class PipelineCounters:
+    """Observable pipeline state (role of the actors' observable states)."""
+    num_docs_processed: int = 0
+    num_docs_invalid: int = 0
+    num_splits_published: int = 0
+    num_published_docs: int = 0
+
+
+class IndexingPipeline:
+    """One (index, source) pipeline (reference `indexing_pipeline.rs:80`)."""
+
+    def __init__(self, params: PipelineParams, doc_mapper: DocMapper,
+                 source: Source, metastore: Metastore, split_storage: Storage):
+        self.params = params
+        self.doc_mapper = doc_mapper
+        self.source = source
+        self.metastore = metastore
+        self.split_storage = split_storage
+        self.counters = PipelineCounters()
+        self._writer: Optional[SplitWriter] = None
+        self._pending_delta = CheckpointDelta()
+
+    # ------------------------------------------------------------------
+    def run_to_completion(self) -> PipelineCounters:
+        """Drain a bounded source fully, publishing splits along the way."""
+        checkpoint = self._current_checkpoint()
+        # splits cut at batch boundaries, so batches must not exceed the
+        # split target (checkpoint deltas stay aligned with published splits)
+        batch_num_docs = min(self.params.batch_num_docs,
+                             self.params.split_num_docs_target)
+        for batch in self.source.batches(checkpoint, batch_num_docs):
+            self.process_batch(batch)
+        self.commit(force=True)
+        return self.counters
+
+    def _current_checkpoint(self) -> SourceCheckpoint:
+        return self.metastore.source_checkpoint(  # type: ignore[attr-defined]
+            self.params.index_uid, self.params.source_id)
+
+    # ------------------------------------------------------------------
+    def process_batch(self, batch: SourceBatch) -> None:
+        """DocProcessor + Indexer stages."""
+        if self._writer is None:
+            self._writer = SplitWriter(self.doc_mapper)
+        for doc in batch.docs:
+            try:
+                self._writer.add_typed_doc(self.doc_mapper.doc_from_json(doc))
+                self.counters.num_docs_processed += 1
+            except DocParsingError as exc:
+                self.counters.num_docs_invalid += 1
+                logger.debug("dropping invalid doc: %s", exc)
+        self._pending_delta.extend(batch.checkpoint_delta)
+        if (self._writer.num_docs >= self.params.split_num_docs_target
+                or batch.force_commit):
+            self.commit(force=True)
+
+    def commit(self, force: bool = False) -> Optional[str]:
+        """Packager + Uploader + Publisher stages: serialize the split,
+        stage it, upload it, publish it with the pending checkpoint delta."""
+        writer = self._writer
+        if writer is None or writer.num_docs == 0:
+            if not self._pending_delta.is_empty:
+                # batches that produced no valid docs still advance the
+                # checkpoint (otherwise they would replay forever)
+                self.metastore.publish_splits(
+                    self.params.index_uid, [],
+                    source_id=self.params.source_id,
+                    checkpoint_delta=self._pending_delta)
+                self._pending_delta = CheckpointDelta()
+            return None
+        split_id = new_split_id()
+        data = writer.finish()
+        metadata = SplitMetadata(
+            split_id=split_id,
+            index_uid=self.params.index_uid,
+            source_id=self.params.source_id,
+            node_id=self.params.node_id,
+            num_docs=writer.num_docs,
+            uncompressed_docs_size_bytes=writer._uncompressed_docs_size,
+            footprint_bytes=len(data),
+            time_range_start=writer._time_min,
+            time_range_end=writer._time_max,
+            tags=frozenset(writer.tags),
+            create_timestamp=int(time.time()),
+            doc_mapping_uid=self.params.doc_mapping_uid,
+        )
+        # stage → upload → publish: a crash between stages leaves either a
+        # staged-but-absent split (GC'd) or an uploaded-but-unpublished file
+        # (GC'd); never a published split without its file.
+        self.metastore.stage_splits(self.params.index_uid, [metadata])
+        self.split_storage.put(split_file_path(split_id), data)
+        delta = self._pending_delta if not self._pending_delta.is_empty else None
+        self.metastore.publish_splits(
+            self.params.index_uid, [split_id],
+            source_id=self.params.source_id,
+            checkpoint_delta=delta)
+        self.counters.num_splits_published += 1
+        self.counters.num_published_docs += writer.num_docs
+        self._writer = None
+        self._pending_delta = CheckpointDelta()
+        logger.info("published split %s (%d docs)", split_id, metadata.num_docs)
+        return split_id

@@ -1,0 +1,4 @@
+from .gc import run_garbage_collection
+from .retention import apply_retention
+
+__all__ = ["run_garbage_collection", "apply_retention"]

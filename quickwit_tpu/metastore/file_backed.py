@@ -1,0 +1,342 @@
+"""File-backed metastore: per-index JSON state on object storage.
+
+Role of the reference's `FileBackedMetastore`
+(`quickwit-metastore/src/metastore/file_backed/mod.rs:154`): each index's
+full state (metadata, sources, splits, checkpoints, delete tasks) serializes
+to one JSON object at `{index_id}/metastore.json`; writes go through an
+in-process per-index lock and land with a version counter for
+lost-update detection; an `indexes.json` manifest lists live indexes
+(reference `manifest.rs`).
+
+Suited to a single metastore node per cluster (like the reference's
+file-backed mode); the write-proxying via the control plane keeps other
+nodes' views coherent (`control_plane_metastore.rs`).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Iterable, Optional
+
+from ..models.index_metadata import IndexMetadata, SourceConfig
+from ..models.split_metadata import Split, SplitMetadata, SplitState
+from ..storage.base import Storage, StorageError
+from .base import ListSplitsQuery, Metastore, MetastoreError
+from .checkpoint import CheckpointDelta, IncompatibleCheckpointDelta, SourceCheckpoint
+
+MANIFEST_PATH = "indexes.json"
+
+
+def _state_path(index_id: str) -> str:
+    return f"{index_id}/metastore.json"
+
+
+class _IndexState:
+    """In-memory image of one index's metastore file."""
+
+    def __init__(self, metadata: IndexMetadata):
+        self.metadata = metadata
+        self.splits: dict[str, Split] = {}
+        self.checkpoints: dict[str, SourceCheckpoint] = {}
+        self.delete_tasks: list[dict] = []
+        self.last_delete_opstamp = 0
+        self.version = 0
+        self.discarded = False
+
+    def to_dict(self) -> dict:
+        return {
+            "version": self.version,
+            "metadata": self.metadata.to_dict(),
+            "splits": [s.to_dict() for s in self.splits.values()],
+            "checkpoints": {sid: cp.to_dict() for sid, cp in self.checkpoints.items()},
+            "delete_tasks": self.delete_tasks,
+            "last_delete_opstamp": self.last_delete_opstamp,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "_IndexState":
+        state = _IndexState(IndexMetadata.from_dict(d["metadata"]))
+        state.version = d.get("version", 0)
+        for split_dict in d.get("splits", []):
+            split = Split.from_dict(split_dict)
+            state.splits[split.metadata.split_id] = split
+        state.checkpoints = {
+            sid: SourceCheckpoint.from_dict(cp)
+            for sid, cp in d.get("checkpoints", {}).items()
+        }
+        state.delete_tasks = d.get("delete_tasks", [])
+        state.last_delete_opstamp = d.get("last_delete_opstamp", 0)
+        return state
+
+
+class FileBackedMetastore(Metastore):
+    def __init__(self, storage: Storage, polling_interval_secs: Optional[float] = None):
+        self.storage = storage
+        self._lock = threading.RLock()
+        self._states: dict[str, _IndexState] = {}  # index_id -> state
+        self._manifest: Optional[dict[str, str]] = None  # index_id -> index_uid
+        self.polling_interval_secs = polling_interval_secs
+
+    # --- manifest ----------------------------------------------------------
+    def _load_manifest(self) -> dict[str, str]:
+        if self._manifest is None:
+            try:
+                self._manifest = json.loads(self.storage.get_all(MANIFEST_PATH))
+            except StorageError:
+                self._manifest = {}
+        return self._manifest
+
+    def _save_manifest(self) -> None:
+        self.storage.put(MANIFEST_PATH,
+                         json.dumps(self._manifest, indent=1).encode())
+
+    # --- state io ----------------------------------------------------------
+    def _load_state(self, index_id: str) -> _IndexState:
+        state = self._states.get(index_id)
+        if state is not None and not state.discarded:
+            return state
+        try:
+            raw = self.storage.get_all(_state_path(index_id))
+        except StorageError:
+            raise MetastoreError(f"index {index_id!r} not found", kind="not_found")
+        state = _IndexState.from_dict(json.loads(raw))
+        self._states[index_id] = state
+        return state
+
+    def _save_state(self, state: _IndexState) -> None:
+        state.version += 1
+        self.storage.put(_state_path(state.metadata.index_id),
+                         json.dumps(state.to_dict()).encode())
+
+    def _state_by_uid(self, index_uid: str) -> _IndexState:
+        index_id = index_uid.split(":", 1)[0]
+        state = self._load_state(index_id)
+        if state.metadata.index_uid != index_uid:
+            raise MetastoreError(
+                f"index uid mismatch: {index_uid!r} (current incarnation: "
+                f"{state.metadata.index_uid!r})", kind="not_found")
+        return state
+
+    # --- index lifecycle ---------------------------------------------------
+    def create_index(self, index_metadata: IndexMetadata) -> None:
+        with self._lock:
+            manifest = self._load_manifest()
+            index_id = index_metadata.index_id
+            if index_id in manifest:
+                raise MetastoreError(f"index {index_id!r} already exists",
+                                     kind="already_exists")
+            state = _IndexState(index_metadata)
+            for source_id in index_metadata.sources:
+                state.checkpoints[source_id] = SourceCheckpoint()
+            self._states[index_id] = state
+            self._save_state(state)
+            manifest[index_id] = index_metadata.index_uid
+            self._save_manifest()
+
+    def delete_index(self, index_uid: str) -> None:
+        with self._lock:
+            state = self._state_by_uid(index_uid)
+            index_id = state.metadata.index_id
+            manifest = self._load_manifest()
+            manifest.pop(index_id, None)
+            self._save_manifest()
+            state.discarded = True
+            self._states.pop(index_id, None)
+            try:
+                self.storage.delete(_state_path(index_id))
+            except StorageError:
+                pass
+
+    def index_metadata(self, index_id: str) -> IndexMetadata:
+        with self._lock:
+            return self._load_state(index_id).metadata
+
+    def index_metadata_by_uid(self, index_uid: str) -> IndexMetadata:
+        with self._lock:
+            return self._state_by_uid(index_uid).metadata
+
+    def list_indexes(self) -> list[IndexMetadata]:
+        with self._lock:
+            manifest = self._load_manifest()
+            out = []
+            for index_id in sorted(manifest):
+                try:
+                    out.append(self._load_state(index_id).metadata)
+                except MetastoreError:
+                    continue
+            return out
+
+    # --- sources -----------------------------------------------------------
+    def add_source(self, index_uid: str, source: SourceConfig) -> None:
+        with self._lock:
+            state = self._state_by_uid(index_uid)
+            if source.source_id in state.metadata.sources:
+                raise MetastoreError(f"source {source.source_id!r} already exists",
+                                     kind="already_exists")
+            state.metadata.sources[source.source_id] = source
+            state.checkpoints.setdefault(source.source_id, SourceCheckpoint())
+            self._save_state(state)
+
+    def delete_source(self, index_uid: str, source_id: str) -> None:
+        with self._lock:
+            state = self._state_by_uid(index_uid)
+            if state.metadata.sources.pop(source_id, None) is None:
+                raise MetastoreError(f"source {source_id!r} not found", kind="not_found")
+            state.checkpoints.pop(source_id, None)
+            self._save_state(state)
+
+    def toggle_source(self, index_uid: str, source_id: str, enable: bool) -> None:
+        with self._lock:
+            state = self._state_by_uid(index_uid)
+            source = state.metadata.sources.get(source_id)
+            if source is None:
+                raise MetastoreError(f"source {source_id!r} not found", kind="not_found")
+            source.enabled = enable
+            self._save_state(state)
+
+    def reset_source_checkpoint(self, index_uid: str, source_id: str) -> None:
+        with self._lock:
+            state = self._state_by_uid(index_uid)
+            state.checkpoints[source_id] = SourceCheckpoint()
+            self._save_state(state)
+
+    def source_checkpoint(self, index_uid: str, source_id: str) -> SourceCheckpoint:
+        with self._lock:
+            state = self._state_by_uid(index_uid)
+            return SourceCheckpoint.from_dict(
+                state.checkpoints.get(source_id, SourceCheckpoint()).to_dict())
+
+    # --- splits --------------------------------------------------------------
+    def stage_splits(self, index_uid: str, split_metadatas: list[SplitMetadata]) -> None:
+        now = int(time.time())
+        with self._lock:
+            state = self._state_by_uid(index_uid)
+            for md in split_metadatas:
+                existing = state.splits.get(md.split_id)
+                if existing is not None and existing.state is not SplitState.STAGED:
+                    raise MetastoreError(
+                        f"split {md.split_id!r} exists in state {existing.state}",
+                        kind="failed_precondition")
+                state.splits[md.split_id] = Split(
+                    metadata=md, state=SplitState.STAGED, update_timestamp=now)
+            self._save_state(state)
+
+    def publish_splits(
+        self,
+        index_uid: str,
+        staged_split_ids: list[str],
+        replaced_split_ids: Iterable[str] = (),
+        source_id: Optional[str] = None,
+        checkpoint_delta: Optional[CheckpointDelta] = None,
+    ) -> None:
+        now = int(time.time())
+        with self._lock:
+            state = self._state_by_uid(index_uid)
+            # validate everything before mutating anything (atomicity)
+            for split_id in staged_split_ids:
+                split = state.splits.get(split_id)
+                if split is None:
+                    raise MetastoreError(f"split {split_id!r} not found",
+                                         kind="not_found")
+                if split.state is not SplitState.STAGED:
+                    raise MetastoreError(
+                        f"split {split_id!r} is {split.state}, not staged",
+                        kind="failed_precondition")
+            replaced = list(replaced_split_ids)
+            for split_id in replaced:
+                split = state.splits.get(split_id)
+                if split is None or split.state is not SplitState.PUBLISHED:
+                    raise MetastoreError(
+                        f"replaced split {split_id!r} is not published",
+                        kind="failed_precondition")
+            if checkpoint_delta is not None and not checkpoint_delta.is_empty:
+                if source_id is None:
+                    raise MetastoreError("checkpoint delta requires source_id")
+                checkpoint = state.checkpoints.setdefault(source_id, SourceCheckpoint())
+                try:
+                    checkpoint.try_apply_delta(checkpoint_delta)
+                except IncompatibleCheckpointDelta as exc:
+                    raise MetastoreError(str(exc), kind="failed_precondition") from exc
+            for split_id in staged_split_ids:
+                split = state.splits[split_id]
+                split.state = SplitState.PUBLISHED
+                split.update_timestamp = now
+                split.publish_timestamp = now
+            for split_id in replaced:
+                split = state.splits[split_id]
+                split.state = SplitState.MARKED_FOR_DELETION
+                split.update_timestamp = now
+            self._save_state(state)
+
+    def list_splits(self, query: ListSplitsQuery) -> list[Split]:
+        with self._lock:
+            if query.index_uids is not None:
+                states = [self._state_by_uid(uid) for uid in query.index_uids]
+            else:
+                states = [self._load_state(i) for i in self._load_manifest()]
+            out = []
+            for state in states:
+                out.extend(s for s in state.splits.values() if query.matches(s))
+            return sorted(out, key=lambda s: s.metadata.split_id)
+
+    def mark_splits_for_deletion(self, index_uid: str, split_ids: Iterable[str]) -> None:
+        now = int(time.time())
+        with self._lock:
+            state = self._state_by_uid(index_uid)
+            for split_id in split_ids:
+                split = state.splits.get(split_id)
+                if split is None:
+                    continue
+                if split.state is not SplitState.MARKED_FOR_DELETION:
+                    split.state = SplitState.MARKED_FOR_DELETION
+                    split.update_timestamp = now
+            self._save_state(state)
+
+    def delete_splits(self, index_uid: str, split_ids: Iterable[str]) -> None:
+        with self._lock:
+            state = self._state_by_uid(index_uid)
+            for split_id in split_ids:
+                split = state.splits.get(split_id)
+                if split is None:
+                    continue
+                if split.state is SplitState.PUBLISHED:
+                    raise MetastoreError(
+                        f"cannot delete published split {split_id!r}",
+                        kind="failed_precondition")
+                del state.splits[split_id]
+            self._save_state(state)
+
+    # --- delete tasks --------------------------------------------------------
+    def create_delete_task(self, index_uid: str, query_ast_json: dict) -> int:
+        with self._lock:
+            state = self._state_by_uid(index_uid)
+            state.last_delete_opstamp += 1
+            opstamp = state.last_delete_opstamp
+            state.delete_tasks.append({
+                "opstamp": opstamp,
+                "create_timestamp": int(time.time()),
+                "query_ast": query_ast_json,
+            })
+            self._save_state(state)
+            return opstamp
+
+    def list_delete_tasks(self, index_uid: str, opstamp_start: int = 0) -> list[dict]:
+        with self._lock:
+            state = self._state_by_uid(index_uid)
+            return [t for t in state.delete_tasks if t["opstamp"] > opstamp_start]
+
+    def last_delete_opstamp(self, index_uid: str) -> int:
+        with self._lock:
+            return self._state_by_uid(index_uid).last_delete_opstamp
+
+    def update_splits_delete_opstamp(self, index_uid: str,
+                                     split_ids: Iterable[str], opstamp: int) -> None:
+        with self._lock:
+            state = self._state_by_uid(index_uid)
+            for split_id in split_ids:
+                split = state.splits.get(split_id)
+                if split is not None:
+                    split.metadata.delete_opstamp = opstamp
+            self._save_state(state)

@@ -1,0 +1,3 @@
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry, METRICS
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "METRICS"]

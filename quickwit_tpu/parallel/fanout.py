@@ -41,7 +41,9 @@ from ..query.aggregations import DateHistogramAgg, HistogramAgg, TermsAgg, parse
 from ..search.models import LeafSearchResponse, PartialHit, SearchRequest
 from ..search.plan import BucketAggExec, LoweredPlan, MetricAggExec, lower_request
 from ..search import executor as executor_mod
-from ..search.leaf import _intermediate_aggs, _sort_values_are_int
+from ..search.leaf import (
+    _intermediate_aggs, _sort_values_are_int, decode_raw_sort_value,
+)
 
 
 def make_mesh(axis_splits: int, axis_docs: int = 1,
@@ -115,9 +117,8 @@ def _global_agg_overrides(agg_specs, readers: list[SplitReader],
                 if meta.get("column_kind") == "ordinal":
                     union.update(r.column_dict(spec.field))
                 else:
-                    from ..search.plan import Lowering
-                    low = Lowering(doc_mapper, r)
-                    _, keys = low._ordinalize_numeric(spec.field)
+                    from ..search.plan import ordinalize_numeric_column
+                    _, keys = ordinalize_numeric_column(r, spec.field)
                     union.update(keys)
             keys_sorted = sorted(union, key=lambda v: (str(type(v)), v))
             terms_dicts[spec.field] = {k: i for i, k in enumerate(keys_sorted)}
@@ -167,6 +168,10 @@ def build_batch(request: SearchRequest, doc_mapper: DocMapper,
     template = plans[0]
     n = len(plans)
     total = pad_to_splits or n
+    if total < n:
+        raise ValueError(
+            f"pad_to_splits={pad_to_splits} is smaller than the number of "
+            f"splits ({n})")
     num_slots = len(template.arrays)
 
     stacked_arrays: list[np.ndarray] = []
@@ -290,8 +295,13 @@ def execute_batch(batch: SplitBatch, request: SearchRequest,
         ex = _batch_executor(batch, k, mesh)
         _BATCH_JIT_CACHE[key] = ex
 
-    # one batched transfer, cached on the batch for repeat queries
-    dev = getattr(batch, "_device_inputs", None)
+    # one batched transfer, cached on the batch for repeat queries —
+    # keyed by mesh: arrays committed for one sharding must not feed an
+    # executor compiled for another
+    cache = getattr(batch, "_device_inputs", None)
+    if cache is None:
+        cache = batch._device_inputs = {}
+    dev = cache.get(mesh)
     if dev is None:
         if mesh is not None:
             arrays_sh, scalars_sh, nd_sh = batch_shardings(batch, mesh)
@@ -304,7 +314,7 @@ def execute_batch(batch: SplitBatch, request: SearchRequest,
             arrays = tuple(moved[: len(batch.arrays)])
             scalars = tuple(moved[len(batch.arrays):-1])
             nd = moved[-1]
-        dev = batch._device_inputs = (arrays, scalars, nd)
+        dev = cache[mesh] = (arrays, scalars, nd)
     arrays, scalars, nd = dev
     out = ex(arrays, scalars, nd)
     top_vals, split_idx, doc_ids, scores, total, merged_aggs = jax.device_get(out)
@@ -319,16 +329,8 @@ def execute_batch(batch: SplitBatch, request: SearchRequest,
         split_id = batch.split_ids[int(split_idx[i])]
         if split_id == "":
             continue
-        if batch.sort_field == "_score":
-            raw: Any = float(scores[i])
-        elif batch.sort_field == "_doc":
-            raw = int(doc_ids[i])
-        elif internal <= -1.7e308:
-            raw = None
-        else:
-            raw = internal if batch.sort_order == "desc" else -internal
-            if sort_is_int:
-                raw = int(raw)
+        raw = decode_raw_sort_value(internal, batch.sort_field, batch.sort_order,
+                                    sort_is_int, scores[i], int(doc_ids[i]))
         hits.append(PartialHit(sort_value=internal, split_id=split_id,
                                doc_id=int(doc_ids[i]), raw_sort_value=raw))
 

@@ -1,0 +1,141 @@
+"""Elasticsearch query DSL → QueryAst.
+
+Role of the reference's `quickwit-query/src/elastic_query_dsl/`
+(`mod.rs:169` et al.): translate the ES `query` body subset into the
+engine's QueryAst. Supported: term, terms, match, match_phrase,
+match_phrase_prefix, multi_match, match_all/match_none, bool, range,
+exists, wildcard, regexp, prefix, query_string, simple_query_string.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+from .ast import (
+    Bool, Boost, FieldPresence, FullText, MatchAll, MatchNone, PhrasePrefix,
+    QueryAst, Range, RangeBound, Regex, Term, TermSet, Wildcard,
+)
+from .parser import parse_query_string
+
+
+class EsDslParseError(ValueError):
+    pass
+
+
+def _single_kv(body: dict[str, Any], kind: str) -> tuple[str, Any]:
+    if len(body) != 1:
+        raise EsDslParseError(f"{kind} expects exactly one field, got {list(body)}")
+    return next(iter(body.items()))
+
+
+def _as_clause_list(value) -> list:
+    if value is None:
+        return []
+    return value if isinstance(value, list) else [value]
+
+
+def es_query_to_ast(query: dict[str, Any],
+                    default_search_fields: Sequence[str] = ()) -> QueryAst:
+    if not isinstance(query, dict) or len(query) != 1:
+        raise EsDslParseError(f"query must have exactly one root clause, got {query!r}")
+    kind, body = next(iter(query.items()))
+
+    if kind == "match_all":
+        return MatchAll()
+    if kind == "match_none":
+        return MatchNone()
+    if kind == "term":
+        field, spec = _single_kv(body, "term")
+        if isinstance(spec, dict):
+            ast: QueryAst = Term(field, str(spec["value"]))
+            if "boost" in spec:
+                ast = Boost(ast, float(spec["boost"]))
+            return ast
+        return Term(field, _scalar_str(spec))
+    if kind == "terms":
+        entries = {f: v for f, v in body.items() if f != "boost"}
+        field, values = _single_kv(entries, "terms")
+        return TermSet({field: tuple(_scalar_str(v) for v in values)})
+    if kind == "match":
+        field, spec = _single_kv(body, "match")
+        if isinstance(spec, dict):
+            text = str(spec["query"])
+            operator = spec.get("operator", "or").lower()
+            ast = FullText(field, text, operator)
+            if "boost" in spec:
+                ast = Boost(ast, float(spec["boost"]))
+            return ast
+        return FullText(field, _scalar_str(spec), "or")
+    if kind == "match_phrase":
+        field, spec = _single_kv(body, "match_phrase")
+        if isinstance(spec, dict):
+            return FullText(field, str(spec["query"]), "phrase",
+                            slop=spec.get("slop", 0))
+        return FullText(field, _scalar_str(spec), "phrase")
+    if kind == "match_phrase_prefix":
+        field, spec = _single_kv(body, "match_phrase_prefix")
+        if isinstance(spec, dict):
+            return PhrasePrefix(field, str(spec["query"]),
+                                max_expansions=spec.get("max_expansions", 50))
+        return PhrasePrefix(field, _scalar_str(spec))
+    if kind == "multi_match":
+        fields = body.get("fields") or list(default_search_fields)
+        if not fields:
+            raise EsDslParseError("multi_match requires fields")
+        text = str(body["query"])
+        mode = "phrase" if body.get("type") == "phrase" else \
+            body.get("operator", "or").lower()
+        clauses = tuple(FullText(f, text, mode) for f in fields)
+        return clauses[0] if len(clauses) == 1 else Bool(should=clauses)
+    if kind == "bool":
+        msm = body.get("minimum_should_match")
+        return Bool(
+            must=tuple(es_query_to_ast(c, default_search_fields)
+                       for c in _as_clause_list(body.get("must"))),
+            must_not=tuple(es_query_to_ast(c, default_search_fields)
+                           for c in _as_clause_list(body.get("must_not"))),
+            should=tuple(es_query_to_ast(c, default_search_fields)
+                         for c in _as_clause_list(body.get("should"))),
+            filter=tuple(es_query_to_ast(c, default_search_fields)
+                         for c in _as_clause_list(body.get("filter"))),
+            minimum_should_match=int(msm) if msm is not None else None,
+        )
+    if kind == "range":
+        field, spec = _single_kv(body, "range")
+        lower = upper = None
+        if "gte" in spec:
+            lower = RangeBound(spec["gte"], True)
+        elif "gt" in spec:
+            lower = RangeBound(spec["gt"], False)
+        if "lte" in spec:
+            upper = RangeBound(spec["lte"], True)
+        elif "lt" in spec:
+            upper = RangeBound(spec["lt"], False)
+        return Range(field, lower=lower, upper=upper)
+    if kind == "exists":
+        return FieldPresence(body["field"])
+    if kind == "wildcard":
+        field, spec = _single_kv(body, "wildcard")
+        pattern = spec["value"] if isinstance(spec, dict) else spec
+        return Wildcard(field, str(pattern))
+    if kind == "regexp":
+        field, spec = _single_kv(body, "regexp")
+        pattern = spec["value"] if isinstance(spec, dict) else spec
+        return Regex(field, str(pattern))
+    if kind == "prefix":
+        field, spec = _single_kv(body, "prefix")
+        value = spec["value"] if isinstance(spec, dict) else spec
+        return Wildcard(field, f"{value}*")
+    if kind in ("query_string", "simple_query_string"):
+        fields = body.get("fields") or body.get("default_field") or \
+            list(default_search_fields)
+        if isinstance(fields, str):
+            fields = [fields]
+        return parse_query_string(body["query"], fields)
+    raise EsDslParseError(f"unsupported query kind {kind!r}")
+
+
+def _scalar_str(value: Any) -> str:
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    return str(value)

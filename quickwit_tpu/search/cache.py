@@ -1,0 +1,63 @@
+"""Leaf-search result cache.
+
+Role of the reference's `LeafSearchCache` (`leaf_cache.rs:26`): memoizes one
+split's LeafSearchResponse keyed by (split id, canonicalized request). The
+request's time range is clamped to the split's own time range before keying
+(the reference's `remove_redundant_timestamp_range`, `leaf.rs:1048`), so
+rolling time windows that fully cover an immutable split hit the same entry.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import pickle
+from typing import Any, Optional
+
+from ..storage.cache import MemorySizedCache
+from .models import LeafSearchResponse, SearchRequest
+
+
+def canonical_request_key(
+    split_id: str,
+    request: SearchRequest,
+    split_time_range: Optional[tuple[int, int]] = None,
+) -> str:
+    start, end = request.start_timestamp, request.end_timestamp
+    if split_time_range is not None:
+        lo, hi = split_time_range
+        # end is exclusive; a bound outside the split's range is redundant
+        if start is not None and start <= lo:
+            start = None
+        if end is not None and end > hi:
+            end = None
+    payload = {
+        "query": request.query_ast.to_dict(),
+        "max_hits": request.max_hits + request.start_offset,
+        "sort": [s.to_dict() for s in request.sort_fields],
+        "aggs": request.aggs,
+        "start": start,
+        "end": end,
+    }
+    digest = hashlib.blake2b(
+        json.dumps(payload, sort_keys=True).encode(), digest_size=16).hexdigest()
+    return f"{split_id}:{digest}"
+
+
+class LeafSearchCache:
+    def __init__(self, capacity_bytes: int = 64 << 20):
+        self._cache = MemorySizedCache(capacity_bytes)
+
+    def get(self, key: str) -> Optional[LeafSearchResponse]:
+        raw = self._cache.get(key)
+        if raw is None:
+            return None
+        return pickle.loads(raw)
+
+    def put(self, key: str, response: LeafSearchResponse) -> None:
+        self._cache.put(key, pickle.dumps(response))
+
+    @property
+    def stats(self) -> dict[str, int]:
+        return {"hits": self._cache.hits, "misses": self._cache.misses,
+                "size_bytes": self._cache.size_bytes}

@@ -140,7 +140,15 @@ def _acc_metric(acc: dict[str, Any], arrays: dict[str, np.ndarray], i: int) -> N
         acc["sum_sq"] += float(arrays["sum_sq"][i])
 
 
+def _copy_bucket_map(bucket_map: dict) -> dict:
+    return {key: {"doc_count": b["doc_count"],
+                  "metrics": {m: dict(acc) for m, acc in b["metrics"].items()}}
+            for key, b in bucket_map.items()}
+
+
 def _histogram_to_map(state: dict[str, Any]) -> dict[float, dict[str, Any]]:
+    if "bucket_map" in state:  # already-merged state (tree merging at root)
+        return _copy_bucket_map(state["bucket_map"])
     counts = state["counts"]
     origin, interval = state["origin"], state["interval"]
     out: dict[float, dict[str, Any]] = {}
@@ -184,6 +192,8 @@ def _merge_histogram(current: dict[str, Any], state: dict[str, Any]) -> None:
 
 
 def _terms_to_map(state: dict[str, Any]) -> dict[Any, dict[str, Any]]:
+    if "bucket_map" in state:  # already-merged state (tree merging at root)
+        return _copy_bucket_map(state["bucket_map"])
     counts = state["counts"]
     keys = state["keys"]
     metric_kinds = state.get("metric_kinds", {})

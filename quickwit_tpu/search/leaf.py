@@ -28,6 +28,26 @@ from .models import LeafSearchResponse, PartialHit, SearchRequest, SplitSearchEr
 from .plan import BucketAggExec, MetricAggExec, lower_request
 
 
+# bottom sentinel for matching-but-missing sort values (see ops/topk.py)
+MISSING_VALUE_SENTINEL = -1.7e308
+
+
+def decode_raw_sort_value(internal: float, sort_field: str, sort_order: str,
+                          sort_is_int: bool, score: float, doc_id: int):
+    """Internal higher-is-better key → displayed raw sort value.
+
+    Shared by the single-split and batched decode paths so the sort-key
+    encoding lives in exactly one place."""
+    if sort_field == "_score":
+        return float(score)
+    if sort_field == "_doc":
+        return doc_id
+    if internal <= MISSING_VALUE_SENTINEL:
+        return None
+    raw = internal if sort_order == "desc" else -internal
+    return int(raw) if sort_is_int else raw
+
+
 def _device_cache(reader: SplitReader) -> dict[str, Any]:
     cache = getattr(reader, "_device_array_cache", None)
     if cache is None:
@@ -79,19 +99,8 @@ def leaf_search_single_split(
     for i in range(num_hits_returned):
         internal = float(result["sort_values"][i])
         doc_id = int(result["doc_ids"][i])
-        if sort_field == "_score":
-            raw: Any = float(result["scores"][i])
-        elif sort_field == "_doc":
-            raw = doc_id
-        else:
-            # internal sort_value is in "higher is better" key space
-            # (ascending sorts carry negated values); convert back for display
-            if internal <= -1.7e308:   # missing-value sentinel
-                raw = None
-            else:
-                raw = internal if sort_order == "desc" else -internal
-                if sort_is_int:
-                    raw = int(raw)
+        raw = decode_raw_sort_value(internal, sort_field, sort_order,
+                                    sort_is_int, result["scores"][i], doc_id)
         partial_hits.append(PartialHit(
             sort_value=internal, split_id=split_id, doc_id=doc_id,
             raw_sort_value=raw))

@@ -151,3 +151,69 @@ class SplitIdAndFooter:
     storage_uri: str   # storage root holding `{split_id}.split`
     file_len: Optional[int] = None
     footer_hint: Optional[int] = None
+    num_docs: int = 0
+    time_range: Optional[tuple[int, int]] = None  # micros, inclusive
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"split_id": self.split_id, "storage_uri": self.storage_uri,
+                "file_len": self.file_len, "footer_hint": self.footer_hint,
+                "num_docs": self.num_docs,
+                "time_range": list(self.time_range) if self.time_range else None}
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "SplitIdAndFooter":
+        tr = d.get("time_range")
+        return SplitIdAndFooter(
+            d["split_id"], d["storage_uri"], d.get("file_len"),
+            d.get("footer_hint"), d.get("num_docs", 0),
+            (tr[0], tr[1]) if tr else None)
+
+
+@dataclass
+class LeafSearchRequest:
+    """Root → leaf request: search one node's split batch of one index
+    (reference: `search.proto` LeafSearchRequest)."""
+    search_request: SearchRequest
+    index_uid: str
+    doc_mapping: dict[str, Any]          # serialized DocMapper
+    splits: list[SplitIdAndFooter]
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"search_request": self.search_request.to_dict(),
+                "index_uid": self.index_uid,
+                "doc_mapping": self.doc_mapping,
+                "splits": [s.to_dict() for s in self.splits]}
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "LeafSearchRequest":
+        return LeafSearchRequest(
+            search_request=SearchRequest.from_dict(d["search_request"]),
+            index_uid=d["index_uid"],
+            doc_mapping=d["doc_mapping"],
+            splits=[SplitIdAndFooter.from_dict(s) for s in d["splits"]])
+
+
+@dataclass
+class FetchDocsRequest:
+    """Phase-2 request: fetch document bodies for global top hits
+    (reference: `search.proto` FetchDocsRequest)."""
+    index_uid: str
+    split: SplitIdAndFooter
+    doc_ids: list[int]
+    snippet_fields: tuple[str, ...] = ()
+    query_ast: Optional[QueryAst] = None  # for snippet highlighting
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"index_uid": self.index_uid, "split": self.split.to_dict(),
+                "doc_ids": self.doc_ids,
+                "snippet_fields": list(self.snippet_fields),
+                "query_ast": self.query_ast.to_dict() if self.query_ast else None}
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "FetchDocsRequest":
+        return FetchDocsRequest(
+            index_uid=d["index_uid"],
+            split=SplitIdAndFooter.from_dict(d["split"]),
+            doc_ids=d["doc_ids"],
+            snippet_fields=tuple(d.get("snippet_fields", ())),
+            query_ast=ast_from_dict(d["query_ast"]) if d.get("query_ast") else None)

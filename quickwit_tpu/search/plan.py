@@ -683,21 +683,7 @@ class Lowering:
                        "order_desc": spec.order_by_count_desc})
 
     def _ordinalize_numeric(self, field: str):
-        cache_key = f"_ordinalized.{field}"
-        cached = getattr(self.reader, "_dyn_cache", {}).get(cache_key)
-        if cached is not None:
-            return cached
-        values, present = self.reader.column_values(field)
-        real = values[: self.reader.num_docs][present[: self.reader.num_docs].astype(bool)]
-        uniques = np.unique(real)
-        ordinals = np.full(self.reader.num_docs_padded, -1, dtype=np.int32)
-        mask = present.astype(bool)
-        ordinals[mask] = np.searchsorted(uniques, values[mask]).astype(np.int32)
-        result = (ordinals, [v.item() for v in uniques])
-        if not hasattr(self.reader, "_dyn_cache"):
-            self.reader._dyn_cache = {}
-        self.reader._dyn_cache[cache_key] = result
-        return result
+        return ordinalize_numeric_column(self.reader, field)
 
     # --- sort -------------------------------------------------------------
     def lower_sort(self, sort_field: str, order: str) -> SortExec:
@@ -708,6 +694,26 @@ class Lowering:
             return SortExec("doc", descending)
         values_slot, present_slot = self._column_slots(sort_field)
         return SortExec("column", descending, values_slot, present_slot)
+
+
+def ordinalize_numeric_column(reader: SplitReader, field: str):
+    """(ordinals, unique_values) of a numeric fast column, cached per reader
+    (terms aggregations over numeric fields need a dictionary)."""
+    cache_key = f"_ordinalized.{field}"
+    cached = getattr(reader, "_dyn_cache", {}).get(cache_key)
+    if cached is not None:
+        return cached
+    values, present = reader.column_values(field)
+    real = values[: reader.num_docs][present[: reader.num_docs].astype(bool)]
+    uniques = np.unique(real)
+    ordinals = np.full(reader.num_docs_padded, -1, dtype=np.int32)
+    mask = present.astype(bool)
+    ordinals[mask] = np.searchsorted(uniques, values[mask]).astype(np.int32)
+    result = (ordinals, [v.item() for v in uniques])
+    if not hasattr(reader, "_dyn_cache"):
+        reader._dyn_cache = {}
+    reader._dyn_cache[cache_key] = result
+    return result
 
 
 def _wildcard_prefix(pattern: str) -> str:

@@ -1,0 +1,277 @@
+"""Root search: plan, fan out, merge, fetch — the two-phase distributed query.
+
+Role of the reference's `root_search` (`quickwit-search/src/root.rs:1295`)
+and `ClusterClient` (`cluster_client.rs:46,85`):
+
+1. resolve index patterns + doc mappings via the metastore,
+2. list splits with time-range and tag pruning pushed into the metastore
+   query (`refine_*`, `root.rs:1599`, `tag_pruning.rs`),
+3. place per-split jobs on searcher nodes (rendezvous + cost balancing),
+4. per node: one LeafSearchRequest per index, with retry of failed leaf
+   requests on the next-best node,
+5. merge leaf responses (IncrementalCollector),
+6. phase 2: fetch docs for the global top hits from the nodes that
+   searched them (cache affinity),
+7. finalize aggregations into ES-shaped results.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import logging
+import time
+from typing import Any, Callable, Optional, Protocol
+
+from ..metastore.base import ListSplitsQuery, Metastore
+from ..models.doc_mapper import DocMapper
+from ..models.split_metadata import Split, SplitState
+from ..query import ast as Q
+from .collector import IncrementalCollector, finalize_aggregations
+from .models import (
+    FetchDocsRequest, Hit, LeafSearchRequest, LeafSearchResponse, SearchRequest,
+    SearchResponse, SplitIdAndFooter,
+)
+from .placer import SearchJob, nodes_for_split, place_jobs
+
+logger = logging.getLogger(__name__)
+
+
+class SearchClient(Protocol):
+    def leaf_search(self, request: LeafSearchRequest) -> LeafSearchResponse: ...
+    def fetch_docs(self, request: FetchDocsRequest) -> list[dict[str, Any]]: ...
+
+
+def extract_required_tags(ast: Q.QueryAst, tag_fields: tuple[str, ...]) -> set[str]:
+    """Conservative tag extraction: only terms in purely conjunctive
+    positions may prune (reference `tag_pruning.rs`)."""
+    tags: set[str] = set()
+    if isinstance(ast, Q.Term) and ast.field in tag_fields:
+        tags.add(f"{ast.field}:{ast.value}")
+    elif isinstance(ast, Q.Bool) and not ast.should:
+        for child in ast.must + ast.filter:
+            tags |= extract_required_tags(child, tag_fields)
+    elif isinstance(ast, Q.Boost):
+        tags |= extract_required_tags(ast.underlying, tag_fields)
+    return tags
+
+
+class RootSearcher:
+    def __init__(
+        self,
+        metastore: Metastore,
+        clients: dict[str, SearchClient],     # node_id -> client (live pool)
+        nodes_provider: Optional[Callable[[], list[str]]] = None,
+    ):
+        self.metastore = metastore
+        self.clients = clients
+        self.nodes_provider = nodes_provider or (lambda: sorted(self.clients))
+
+    # ------------------------------------------------------------------
+    def search(self, request: SearchRequest) -> SearchResponse:
+        t0 = time.monotonic()
+        indexes = self._resolve_indexes(request.index_ids)
+        if not indexes:
+            raise ValueError(f"no index matches {request.index_ids!r}")
+
+        collector = IncrementalCollector(
+            max_hits=request.max_hits, start_offset=request.start_offset,
+            search_after=self._search_after_key(request))
+        split_meta_by_id: dict[str, tuple[str, SplitIdAndFooter, dict]] = {}
+        nodes = self.nodes_provider()
+
+        for index_metadata in indexes:
+            doc_mapper = index_metadata.index_config.doc_mapper
+            splits = self._prune_splits(index_metadata, doc_mapper, request)
+            if not splits:
+                continue
+            offsets = {}
+            for split in splits:
+                offset = SplitIdAndFooter(
+                    split_id=split.metadata.split_id,
+                    storage_uri=index_metadata.index_config.index_uri,
+                    num_docs=split.metadata.num_docs,
+                    time_range=(split.metadata.time_range_start,
+                                split.metadata.time_range_end)
+                    if split.metadata.time_range_start is not None else None,
+                )
+                offsets[split.metadata.split_id] = offset
+                split_meta_by_id[split.metadata.split_id] = (
+                    index_metadata.index_uid, offset, doc_mapper.to_dict())
+            jobs = [SearchJob(s.metadata.split_id, cost=max(s.metadata.num_docs, 1))
+                    for s in splits]
+            assignment = place_jobs(jobs, nodes)
+            for node_id, node_jobs in assignment.items():
+                leaf_request = LeafSearchRequest(
+                    search_request=request,
+                    index_uid=index_metadata.index_uid,
+                    doc_mapping=doc_mapper.to_dict(),
+                    splits=[offsets[j.split_id] for j in node_jobs],
+                )
+                response = self._leaf_search_with_retry(leaf_request, node_id, nodes)
+                collector.add_leaf_response(response)
+
+        merged = collector
+        if (merged.num_attempted_splits > 0
+                and merged.num_successful_splits == 0 and merged.failed_splits):
+            # every split failed: a query-level problem (e.g. unknown field),
+            # not a partial outage — surface it as an error (reference 400s)
+            raise ValueError(merged.failed_splits[0].error)
+        hits = self._fetch_docs_phase(request, merged, split_meta_by_id, nodes)
+        aggregations = None
+        if request.aggs:
+            aggregations = finalize_aggregations(merged.aggregation_states())
+        return SearchResponse(
+            num_hits=merged.num_hits,
+            hits=hits,
+            elapsed_time_micros=int((time.monotonic() - t0) * 1e6),
+            errors=[f"{e.split_id}: {e.error}" for e in merged.failed_splits],
+            aggregations=aggregations,
+        )
+
+    # ------------------------------------------------------------------
+    def _resolve_indexes(self, patterns: list[str]):
+        out = []
+        seen = set()
+        all_indexes = None
+        for pattern in patterns:
+            if any(ch in pattern for ch in "*?"):
+                if all_indexes is None:
+                    all_indexes = self.metastore.list_indexes()
+                for im in all_indexes:
+                    if fnmatch.fnmatch(im.index_id, pattern) and im.index_uid not in seen:
+                        seen.add(im.index_uid)
+                        out.append(im)
+            else:
+                try:
+                    im = self.metastore.index_metadata(pattern)
+                except Exception:
+                    continue
+                if im.index_uid not in seen:
+                    seen.add(im.index_uid)
+                    out.append(im)
+        return out
+
+    def _prune_splits(self, index_metadata, doc_mapper: DocMapper,
+                      request: SearchRequest) -> list[Split]:
+        required_tags = extract_required_tags(
+            request.query_ast, doc_mapper.tag_fields) or None
+        query = ListSplitsQuery(
+            index_uids=[index_metadata.index_uid],
+            states=[SplitState.PUBLISHED],
+            time_range_start=request.start_timestamp,
+            time_range_end=request.end_timestamp,
+            required_tags=required_tags,
+        )
+        return self.metastore.list_splits(query)
+
+    def _leaf_search_with_retry(self, leaf_request: LeafSearchRequest,
+                                node_id: str, nodes: list[str]) -> LeafSearchResponse:
+        try:
+            client = self.clients[node_id]
+            response = client.leaf_search(leaf_request)
+        except Exception as exc:  # noqa: BLE001 - node-level failure
+            logger.warning("leaf search on %s failed: %s", node_id, exc)
+            response = None
+        if response is not None and not response.failed_splits:
+            return response
+        # retry failed splits (or the whole request) on the next-best node
+        failed_ids = ({e.split_id for e in response.failed_splits}
+                      if response is not None
+                      else {s.split_id for s in leaf_request.splits})
+        retry_splits = [s for s in leaf_request.splits if s.split_id in failed_ids]
+        retry_node = None
+        for candidate in nodes_for_split(retry_splits[0].split_id, nodes):
+            if candidate != node_id:
+                retry_node = candidate
+                break
+        if retry_node is None:
+            return response if response is not None else LeafSearchResponse(
+                failed_splits=[], num_attempted_splits=len(leaf_request.splits))
+        retry_request = LeafSearchRequest(
+            search_request=leaf_request.search_request,
+            index_uid=leaf_request.index_uid,
+            doc_mapping=leaf_request.doc_mapping,
+            splits=retry_splits,
+        )
+        try:
+            retry_response = self.clients[retry_node].leaf_search(retry_request)
+        except Exception as exc:  # noqa: BLE001
+            logger.warning("leaf retry on %s failed: %s", retry_node, exc)
+            return response if response is not None else LeafSearchResponse()
+        if response is None:
+            return retry_response
+        # keep the successful part of the original + the retry results
+        response.failed_splits = retry_response.failed_splits
+        merged = IncrementalCollector(
+            max_hits=leaf_request.search_request.max_hits
+            + leaf_request.search_request.start_offset)
+        ok_part = LeafSearchResponse(
+            num_hits=response.num_hits, partial_hits=response.partial_hits,
+            intermediate_aggs=response.intermediate_aggs,
+            num_attempted_splits=response.num_attempted_splits,
+            num_successful_splits=response.num_successful_splits)
+        merged.add_leaf_response(ok_part)
+        merged.add_leaf_response(retry_response)
+        return merged.to_leaf_response()
+
+    def _fetch_docs_phase(self, request: SearchRequest,
+                          collector: IncrementalCollector,
+                          split_meta_by_id: dict,
+                          nodes: list[str]) -> list[Hit]:
+        top_hits = collector.partial_hits()
+        if not top_hits or request.max_hits == 0:
+            return []
+        by_split: dict[str, list] = {}
+        for hit in top_hits:
+            by_split.setdefault(hit.split_id, []).append(hit)
+        docs_by_address: dict[tuple[str, int], dict] = {}
+        for split_id, hits in by_split.items():
+            index_uid, offset, doc_mapping = split_meta_by_id[split_id]
+            fetch_request = FetchDocsRequest(
+                index_uid=index_uid, split=offset,
+                doc_ids=[h.doc_id for h in hits],
+                snippet_fields=request.snippet_fields,
+                query_ast=request.query_ast if request.snippet_fields else None,
+            )
+            docs = None
+            for node_id in nodes_for_split(split_id, nodes):
+                try:
+                    docs = self.clients[node_id].fetch_docs(fetch_request)
+                    break
+                except Exception as exc:  # noqa: BLE001
+                    logger.warning("fetch_docs on %s failed: %s", node_id, exc)
+            if docs is None:
+                continue
+            for hit, doc in zip(hits, docs):
+                docs_by_address[(split_id, hit.doc_id)] = doc
+        out: list[Hit] = []
+        scoring = not request.sort_fields or request.sort_fields[0].field == "_score"
+        for hit in top_hits:
+            doc = docs_by_address.get((hit.split_id, hit.doc_id))
+            if doc is None:
+                continue
+            snippets = doc.pop("_snippets", None)
+            out.append(Hit(
+                doc=doc,
+                score=hit.raw_sort_value if scoring else None,
+                sort_values=[hit.raw_sort_value],
+                split_id=hit.split_id,
+                doc_id=hit.doc_id,
+                snippets=snippets,
+            ))
+        return out
+
+    @staticmethod
+    def _search_after_key(request: SearchRequest):
+        if not request.search_after:
+            return None
+        sa = request.search_after
+        # [internal_sort_value, split_id, doc_id]
+        if len(sa) != 3:
+            raise ValueError(
+                "search_after expects [sort_value, split_id, doc_id]")
+        sort = request.sort_fields[0] if request.sort_fields else None
+        value = float(sa[0])
+        if sort and sort.field not in ("_score", "_doc") and sort.order == "asc":
+            value = -value
+        return (value, sa[1], int(sa[2]))

@@ -1,0 +1,179 @@
+"""Search service: the node-local search endpoints.
+
+Role of the reference's `SearchService` trait + `SearchServiceImpl`
+(`quickwit-search/src/service.rs:65`) and the leaf entry point
+`multi_index_leaf_search`/`single_doc_mapping_leaf_search`
+(`leaf.rs:1497,1887`):
+
+- `leaf_search`: search a batch of splits of one index on this node — split
+  reordering for pruning (`CanSplitDoBetter`), leaf cache, batched mesh
+  execution when the plan is split-uniform, per-split fallback otherwise,
+  partial failure collection.
+- `fetch_docs`: phase-2 doc fetch + snippet generation.
+
+The SearcherContext owns the caches (reader/hotcache byte ranges + device
+arrays per split, leaf results) and the admission budget — the roles of the
+reference's SearcherContext (`service.rs:405`) and SearchPermitProvider.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+from collections import OrderedDict
+from typing import Any, Optional
+
+from ..index.reader import SplitReader
+from ..models.doc_mapper import DocMapper
+from ..parallel.fanout import build_batch, execute_batch
+from ..storage.base import StorageResolver
+from .cache import LeafSearchCache, canonical_request_key
+from .collector import IncrementalCollector
+from .leaf import leaf_search_single_split
+from .models import (
+    FetchDocsRequest, LeafSearchRequest, LeafSearchResponse, SearchRequest,
+    SplitIdAndFooter, SplitSearchError,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class SearcherContext:
+    def __init__(self, storage_resolver: Optional[StorageResolver] = None,
+                 max_open_splits: int = 128,
+                 leaf_cache_bytes: int = 64 << 20,
+                 batch_size: int = 8):
+        self.storage_resolver = storage_resolver or StorageResolver.default()
+        self.leaf_cache = LeafSearchCache(leaf_cache_bytes)
+        self.batch_size = batch_size
+        self._readers: OrderedDict[str, SplitReader] = OrderedDict()
+        self._max_open_splits = max_open_splits
+        self._lock = threading.Lock()
+
+    def reader(self, split: SplitIdAndFooter) -> SplitReader:
+        """LRU-cached split readers: keeps footer, term dict, byte-range and
+        device-array caches warm across queries (the warmup-amortization the
+        reference's cache stack exists for)."""
+        key = f"{split.storage_uri}/{split.split_id}"
+        with self._lock:
+            reader = self._readers.get(key)
+            if reader is not None:
+                self._readers.move_to_end(key)
+                return reader
+        storage = self.storage_resolver.resolve(split.storage_uri)
+        reader = SplitReader(storage, f"{split.split_id}.split",
+                             file_len=split.file_len)
+        with self._lock:
+            self._readers[key] = reader
+            while len(self._readers) > self._max_open_splits:
+                self._readers.popitem(last=False)
+        return reader
+
+
+class SearchService:
+    """One node's search endpoints. Any node can act as root; leaf work runs
+    where this service lives."""
+
+    def __init__(self, context: Optional[SearcherContext] = None,
+                 node_id: str = "node-0"):
+        self.context = context or SearcherContext()
+        self.node_id = node_id
+
+    # ------------------------------------------------------------------
+    def leaf_search(self, request: LeafSearchRequest) -> LeafSearchResponse:
+        doc_mapper = DocMapper.from_dict(request.doc_mapping)
+        search_request = request.search_request
+        splits = self._optimize_split_order(search_request, request.splits)
+
+        collector = IncrementalCollector(
+            max_hits=search_request.max_hits,
+            start_offset=search_request.start_offset)
+        pending: list[SplitIdAndFooter] = []
+        for split in splits:
+            key = canonical_request_key(split.split_id, search_request,
+                                        split.time_range)
+            cached = self.context.leaf_cache.get(key)
+            if cached is not None:
+                collector.add_leaf_response(cached)
+                continue
+            pending.append(split)
+
+        for begin in range(0, len(pending), self.context.batch_size):
+            group = pending[begin: begin + self.context.batch_size]
+            self._search_group(group, doc_mapper, search_request, collector)
+
+        response = collector.to_leaf_response()
+        response.num_attempted_splits = len(splits)
+        return response
+
+    def _search_group(self, group, doc_mapper, search_request, collector) -> None:
+        if len(group) > 1:
+            try:
+                readers = [self.context.reader(s) for s in group]
+                batch = build_batch(search_request, doc_mapper, readers,
+                                    [s.split_id for s in group])
+                merged = execute_batch(batch, search_request)
+                # batch responses cover several splits; cache only the merged
+                # unit is wrong per-split, so cache skipped on the batch path
+                collector.add_leaf_response(merged)
+                return
+            except Exception as exc:  # noqa: BLE001 - fall back per split
+                logger.debug("batch path failed (%s); searching per split", exc)
+        for split in group:
+            try:
+                reader = self.context.reader(split)
+                response = leaf_search_single_split(
+                    search_request, doc_mapper, reader, split.split_id)
+                key = canonical_request_key(split.split_id, search_request,
+                                            split.time_range)
+                self.context.leaf_cache.put(key, response)
+                collector.add_leaf_response(response)
+            except Exception as exc:  # noqa: BLE001 - partial failure semantics
+                logger.warning("split %s search failed: %s", split.split_id, exc)
+                collector.failed_splits.append(SplitSearchError(
+                    split_id=split.split_id, error=str(exc), retryable=True))
+
+    @staticmethod
+    def _optimize_split_order(request: SearchRequest,
+                              splits: list[SplitIdAndFooter]) -> list[SplitIdAndFooter]:
+        """Reference `CanSplitDoBetter::optimize_split_order` (leaf.rs:1279):
+        timestamp sorts visit the splits most likely to own the top hits
+        first (enables pruning + better partial results under timeouts)."""
+        sort = request.sort_fields[0] if request.sort_fields else None
+        if sort is None or not splits:
+            return list(splits)
+        if sort.field == "_score":
+            return sorted(splits, key=lambda s: -s.num_docs)
+        def end_key(s: SplitIdAndFooter):
+            return s.time_range[1] if s.time_range else 0
+        def start_key(s: SplitIdAndFooter):
+            return s.time_range[0] if s.time_range else 0
+        if sort.order == "desc":
+            return sorted(splits, key=end_key, reverse=True)
+        return sorted(splits, key=start_key)
+
+    # ------------------------------------------------------------------
+    def fetch_docs(self, request: FetchDocsRequest) -> list[dict[str, Any]]:
+        reader = self.context.reader(request.split)
+        docs = reader.fetch_docs(request.doc_ids)
+        if request.snippet_fields and request.query_ast is not None:
+            from .snippets import generate_snippets
+            for doc in docs:
+                doc["_snippets"] = generate_snippets(
+                    doc, request.snippet_fields, request.query_ast)
+        return docs
+
+
+class LocalSearchClient:
+    """In-process transport to a SearchService (the tests' and single-node
+    deployments' client; the HTTP client in serve/ has the same surface)."""
+
+    def __init__(self, service: SearchService):
+        self.service = service
+
+    def leaf_search(self, request: LeafSearchRequest) -> LeafSearchResponse:
+        return self.service.leaf_search(request)
+
+    def fetch_docs(self, request: FetchDocsRequest) -> list[dict[str, Any]]:
+        return self.service.fetch_docs(request)

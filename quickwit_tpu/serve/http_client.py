@@ -1,0 +1,56 @@
+"""HTTP search client — the cross-node transport.
+
+Role of the reference's codegen'd gRPC SearchService client with tower
+retry/timeout layers: same `SearchClient` surface as LocalSearchClient, over
+the peer's `/internal/*` endpoints using stdlib http.client (zero-dep).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+from typing import Any
+
+from ..search.models import FetchDocsRequest, LeafSearchRequest, LeafSearchResponse
+from .serializers import leaf_response_from_dict
+
+
+class HttpTransportError(ConnectionError):
+    pass
+
+
+class HttpSearchClient:
+    def __init__(self, endpoint: str, timeout_secs: float = 30.0):
+        self.endpoint = endpoint  # "host:port"
+        host, port = endpoint.rsplit(":", 1)
+        self.host = host
+        self.port = int(port)
+        self.timeout_secs = timeout_secs
+
+    def _post(self, path: str, payload: Any) -> Any:
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout_secs)
+        try:
+            data = json.dumps(payload).encode()
+            conn.request("POST", path, body=data,
+                         headers={"Content-Type": "application/json"})
+            response = conn.getresponse()
+            body = response.read()
+            if response.status != 200:
+                raise HttpTransportError(
+                    f"{self.endpoint}{path} -> {response.status}: {body[:200]!r}")
+            return json.loads(body)
+        except (OSError, http.client.HTTPException) as exc:
+            raise HttpTransportError(f"{self.endpoint}{path}: {exc}") from exc
+        finally:
+            conn.close()
+
+    def leaf_search(self, request: LeafSearchRequest) -> LeafSearchResponse:
+        return leaf_response_from_dict(
+            self._post("/internal/leaf_search", request.to_dict()))
+
+    def fetch_docs(self, request: FetchDocsRequest) -> list[dict[str, Any]]:
+        return self._post("/internal/fetch_docs", request.to_dict())
+
+    def heartbeat(self, payload: dict[str, Any]) -> dict[str, Any]:
+        return self._post("/internal/heartbeat", payload)

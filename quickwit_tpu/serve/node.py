@@ -1,0 +1,202 @@
+"""Node bootstrap + service wiring.
+
+Role of the reference's `serve_quickwit` (`quickwit-serve/src/lib.rs:557`):
+instantiate the services a node's roles require — searcher, indexer,
+metastore, janitor — over a shared storage resolver and cluster membership,
+and wire remote clients (HTTP) for peers. A node runs any subset of roles
+(`lib.rs:566-700`); single-process all-roles is the default.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..cluster.membership import Cluster, ClusterChange, ClusterMember
+from ..indexing.merge import MergeExecutor, merge_policy_from_config
+from ..indexing.pipeline import IndexingPipeline, PipelineParams
+from ..indexing.sources import VecSource, make_source
+from ..metastore.base import ListSplitsQuery, Metastore
+from ..metastore.file_backed import FileBackedMetastore
+from ..models.doc_mapper import DocMapper
+from ..models.index_metadata import IndexConfig, IndexMetadata, SourceConfig
+from ..models.split_metadata import SplitState
+from ..query import ast as Q
+from ..search.root import RootSearcher
+from ..search.service import LocalSearchClient, SearcherContext, SearchService
+from ..storage.base import StorageResolver
+
+logger = logging.getLogger(__name__)
+
+ALL_SERVICES = ("searcher", "indexer", "metastore", "janitor", "control_plane")
+
+
+@dataclass
+class NodeConfig:
+    node_id: str = "node-0"
+    roles: tuple[str, ...] = ALL_SERVICES
+    metastore_uri: str = "ram:///qw/metastore"
+    default_index_root_uri: str = "ram:///qw/indexes"
+    rest_host: str = "127.0.0.1"
+    rest_port: int = 7280
+    peers: tuple[str, ...] = ()  # "host:port" seeds
+
+
+class IndexService:
+    """Index management operations (role of `quickwit-index-management`)."""
+
+    def __init__(self, metastore: Metastore, storage_resolver: StorageResolver,
+                 default_index_root_uri: str):
+        self.metastore = metastore
+        self.storage_resolver = storage_resolver
+        self.default_index_root_uri = default_index_root_uri
+
+    def create_index(self, index_config_json: dict[str, Any]) -> IndexMetadata:
+        index_id = index_config_json["index_id"]
+        if not index_id or not index_id.replace("-", "").replace("_", "").isalnum():
+            raise ValueError(f"invalid index id {index_id!r}")
+        doc_mapping = index_config_json.get("doc_mapping", {})
+        doc_mapper = DocMapper.from_dict(doc_mapping) if "field_mappings" in doc_mapping \
+            else DocMapper(field_mappings=[])
+        index_uri = index_config_json.get(
+            "index_uri", f"{self.default_index_root_uri}/{index_id}")
+        config = IndexConfig(
+            index_id=index_id, index_uri=index_uri, doc_mapper=doc_mapper,
+            commit_timeout_secs=index_config_json.get(
+                "indexing_settings", {}).get("commit_timeout_secs", 60),
+            split_num_docs_target=index_config_json.get(
+                "indexing_settings", {}).get("split_num_docs_target", 10_000_000),
+            merge_policy=index_config_json.get(
+                "indexing_settings", {}).get("merge_policy", {"type": "stable_log"}),
+        )
+        retention = index_config_json.get("retention")
+        if retention:
+            from ..models.index_metadata import RetentionPolicy
+            config.retention = RetentionPolicy(
+                period_seconds=_parse_period(retention["period"]),
+                schedule=retention.get("schedule", "hourly"))
+        metadata = IndexMetadata(
+            index_uid=f"{index_id}:{int(time.time()) % 100000:05d}",
+            index_config=config,
+            sources={"_ingest-api-source": SourceConfig("_ingest-api-source", "vec")},
+        )
+        self.metastore.create_index(metadata)
+        return metadata
+
+    def delete_index(self, index_id: str) -> list[str]:
+        metadata = self.metastore.index_metadata(index_id)
+        splits = self.metastore.list_splits(
+            ListSplitsQuery(index_uids=[metadata.index_uid]))
+        storage = self.storage_resolver.resolve(metadata.index_config.index_uri)
+        removed = []
+        for split in splits:
+            try:
+                storage.delete(f"{split.metadata.split_id}.split")
+                removed.append(split.metadata.split_id)
+            except Exception:  # noqa: BLE001 - missing files are fine
+                pass
+        self.metastore.delete_index(metadata.index_uid)
+        return removed
+
+
+def _parse_period(period: str) -> int:
+    period = period.strip()
+    units = {"seconds": 1, "minutes": 60, "hours": 3600, "days": 86400,
+             "weeks": 7 * 86400}
+    parts = period.split()
+    if len(parts) == 2 and parts[1] in units:
+        return int(parts[0]) * units[parts[1]]
+    raise ValueError(f"cannot parse retention period {period!r}")
+
+
+class Node:
+    """A running node: metastore + searcher + indexer + janitor services
+    according to roles, plus the client pool for distributed search."""
+
+    def __init__(self, config: NodeConfig,
+                 storage_resolver: Optional[StorageResolver] = None):
+        self.config = config
+        self.storage_resolver = storage_resolver or StorageResolver.default()
+        self.metastore: Metastore = FileBackedMetastore(
+            self.storage_resolver.resolve(config.metastore_uri))
+        self.cluster = Cluster(
+            config.node_id, config.roles,
+            rest_endpoint=f"{config.rest_host}:{config.rest_port}")
+        self.searcher_context = SearcherContext(self.storage_resolver)
+        self.search_service = SearchService(self.searcher_context, config.node_id)
+        self.index_service = IndexService(self.metastore, self.storage_resolver,
+                                          config.default_index_root_uri)
+        self.clients: dict[str, Any] = {
+            config.node_id: LocalSearchClient(self.search_service)}
+        self.root_searcher = RootSearcher(
+            self.metastore, self.clients,
+            nodes_provider=lambda: self.cluster.nodes_with_role("searcher"))
+        self.cluster.subscribe(self._on_cluster_change)
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def _on_cluster_change(self, change: ClusterChange) -> None:
+        member = change.member
+        if change.kind == "remove":
+            if member.node_id != self.config.node_id:
+                self.clients.pop(member.node_id, None)
+            return
+        if member.node_id == self.config.node_id:
+            return
+        if "searcher" in member.roles and member.rest_endpoint:
+            from .http_client import HttpSearchClient
+            self.clients[member.node_id] = HttpSearchClient(member.rest_endpoint)
+
+    # ------------------------------------------------------------------
+    # ingest (v1-style: REST batch → immediate split, commit semantics
+    # per-request; the WAL-based v2 path lives in quickwit_tpu.ingest)
+    def ingest(self, index_id: str, docs: list[dict],
+               commit: str = "auto") -> dict[str, Any]:
+        metadata = self.metastore.index_metadata(index_id)
+        doc_mapper = metadata.index_config.doc_mapper
+        storage = self.storage_resolver.resolve(metadata.index_config.index_uri)
+        params = PipelineParams(
+            index_uid=metadata.index_uid,
+            source_id="_ingest-api-source",
+            node_id=self.config.node_id,
+            split_num_docs_target=metadata.index_config.split_num_docs_target,
+        )
+        source = VecSource(docs, partition_id=f"ingest-{time.time_ns()}")
+        pipeline = IndexingPipeline(params, doc_mapper, source,
+                                    self.metastore, storage)
+        counters = pipeline.run_to_completion()
+        return {"num_docs_for_processing": len(docs),
+                "num_ingested_docs": counters.num_docs_processed,
+                "num_invalid_docs": counters.num_docs_invalid}
+
+    # ------------------------------------------------------------------
+    def run_merges(self, index_id: str) -> int:
+        """One merge-planner pass (role of MergePlanner + MergePipeline)."""
+        metadata = self.metastore.index_metadata(index_id)
+        policy = merge_policy_from_config(metadata.index_config.merge_policy)
+        splits = self.metastore.list_splits(ListSplitsQuery(
+            index_uids=[metadata.index_uid], states=[SplitState.PUBLISHED]))
+        operations = policy.operations(splits)
+        if not operations:
+            return 0
+        storage = self.storage_resolver.resolve(metadata.index_config.index_uri)
+        executor = MergeExecutor(metadata.index_uid,
+                                 metadata.index_config.doc_mapper,
+                                 self.metastore, storage, self.config.node_id)
+        delete_asts = [Q.ast_from_dict(t["query_ast"])
+                       for t in self.metastore.list_delete_tasks(metadata.index_uid)]
+        for operation in operations:
+            executor.execute(operation, delete_query_asts=delete_asts or None)
+        return len(operations)
+
+    # ------------------------------------------------------------------
+    def run_janitor(self) -> dict[str, int]:
+        """GC + retention pass (role of quickwit-janitor's actors)."""
+        from ..janitor.gc import run_garbage_collection
+        from ..janitor.retention import apply_retention
+        gc_stats = run_garbage_collection(self.metastore, self.storage_resolver)
+        retention_stats = apply_retention(self.metastore)
+        return {**gc_stats, **retention_stats}

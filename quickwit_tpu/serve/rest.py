@@ -1,0 +1,428 @@
+"""REST server: quickwit API + ES-compatible API + internal search RPC.
+
+Role of the reference's warp router + handlers (`quickwit-serve/src/rest.rs`,
+`search_api/rest_handler.rs`, `elasticsearch_api/rest_handler.rs:245,674`,
+`index_api/rest_handler.rs`) over Python's stdlib threading HTTP server:
+
+  GET  /health/livez | /health/readyz
+  GET  /metrics                                  (prometheus text)
+  GET  /api/v1/cluster                           (members)
+  POST /api/v1/indexes                           (create index from config)
+  GET  /api/v1/indexes                           | /api/v1/indexes/{id}
+  DELETE /api/v1/indexes/{id}
+  GET  /api/v1/indexes/{id}/splits
+  POST /api/v1/{index}/ingest?commit=...         (ndjson body)
+  GET|POST /api/v1/{index}/search                (query params or JSON)
+  POST /api/v1/{index}/search/stream             (alias of search, round 1)
+  -- ES-compatible --
+  POST|GET /api/v1/_elastic/{index}/_search
+  POST /api/v1/_elastic/_msearch
+  POST /api/v1/_elastic/_bulk | /{index}/_bulk
+  GET  /api/v1/_elastic/_cat/indices
+  GET  /api/v1/_elastic/{index}/_field_caps
+  -- internal RPC (root↔leaf transport; gRPC's role) --
+  POST /internal/leaf_search
+  POST /internal/fetch_docs
+  POST /internal/heartbeat
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..metastore.base import ListSplitsQuery, MetastoreError
+from ..observability.metrics import METRICS
+from ..query.aggregations import AggParseError
+from ..query.es_dsl import EsDslParseError, es_query_to_ast
+from ..query.parser import QueryParseError, parse_query_string
+from ..search.models import (
+    FetchDocsRequest, LeafSearchRequest, SearchRequest, SortField,
+)
+from ..search.plan import PlanError
+from .node import Node
+from .serializers import leaf_response_from_dict, leaf_response_to_dict
+
+logger = logging.getLogger(__name__)
+
+_REQUEST_COUNTER = METRICS.counter("qw_http_requests_total", "HTTP requests")
+_REQUEST_LATENCY = METRICS.histogram("qw_http_request_duration_seconds",
+                                     "HTTP request latency")
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+
+
+def _search_request_from_params(index_id: str, params: dict[str, Any],
+                                default_fields) -> SearchRequest:
+    query = params.get("query", "*")
+    ast = parse_query_string(query, default_fields)
+    sort_fields: tuple[SortField, ...] = (SortField(),)
+    sort_by = params.get("sort_by") or params.get("sort_by_field")
+    if sort_by:
+        if sort_by.startswith("-"):
+            sort_fields = (SortField(sort_by[1:].replace("+", ""), "desc"),)
+        else:
+            sort_fields = (SortField(sort_by.lstrip("+"), "asc"),)
+    aggs = params.get("aggs")
+    if isinstance(aggs, str):
+        aggs = json.loads(aggs)
+    def _ts(name):
+        value = params.get(name)
+        return int(value) * 1_000_000 if value is not None else None
+    return SearchRequest(
+        index_ids=[index_id],
+        query_ast=ast,
+        max_hits=int(params.get("max_hits", 20)),
+        start_offset=int(params.get("start_offset", 0)),
+        sort_fields=sort_fields,
+        aggs=aggs,
+        start_timestamp=_ts("start_timestamp"),
+        end_timestamp=_ts("end_timestamp"),
+        snippet_fields=tuple(params["snippet_fields"].split(","))
+        if params.get("snippet_fields") else (),
+    )
+
+
+def _search_response_to_json(response) -> dict[str, Any]:
+    return response.to_dict()
+
+
+class RestServer:
+    def __init__(self, node: Node, host: Optional[str] = None,
+                 port: Optional[int] = None):
+        self.node = node
+        self.host = host if host is not None else node.config.rest_host
+        self.port = port if port is not None else node.config.rest_port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
+        self.port = self._httpd.server_address[1]
+        self.node.config.rest_port = self.port
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name=f"rest-{self.port}", daemon=True)
+        self._thread.start()
+        logger.info("REST server listening on %s:%d", self.host, self.port)
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # ------------------------------------------------------------------
+    # route implementations
+    def route(self, method: str, path: str, params: dict[str, Any],
+              body: bytes) -> tuple[int, Any]:
+        node = self.node
+        if path == "/health/livez":
+            return 200, True
+        if path == "/health/readyz":
+            return (200, True) if node.cluster.is_ready() else (503, False)
+        if path == "/metrics":
+            return 200, METRICS.expose_text()
+        if path == "/api/v1/cluster":
+            return 200, {
+                "node_id": node.config.node_id,
+                "members": [
+                    {"node_id": m.node_id, "roles": list(m.roles),
+                     "rest_endpoint": m.rest_endpoint, "ready": m.is_ready}
+                    for m in node.cluster.members()
+                ],
+            }
+
+        # --- internal RPC ---------------------------------------------
+        if path == "/internal/leaf_search" and method == "POST":
+            request = LeafSearchRequest.from_dict(json.loads(body))
+            response = node.search_service.leaf_search(request)
+            return 200, leaf_response_to_dict(response)
+        if path == "/internal/fetch_docs" and method == "POST":
+            request = FetchDocsRequest.from_dict(json.loads(body))
+            return 200, node.search_service.fetch_docs(request)
+        if path == "/internal/heartbeat" and method == "POST":
+            payload = json.loads(body)
+            from ..cluster.membership import ClusterMember
+            node.cluster.join(ClusterMember(
+                node_id=payload["node_id"], roles=tuple(payload["roles"]),
+                rest_endpoint=payload.get("rest_endpoint", "")))
+            node.cluster.record_heartbeat(payload["node_id"])
+            return 200, {"node_id": node.config.node_id,
+                         "roles": list(node.config.roles),
+                         "rest_endpoint": f"{self.host}:{self.port}"}
+
+        # --- index management -----------------------------------------
+        if path == "/api/v1/indexes" and method == "POST":
+            metadata = node.index_service.create_index(json.loads(body))
+            return 200, metadata.to_dict()
+        if path == "/api/v1/indexes" and method == "GET":
+            return 200, [m.to_dict() for m in node.metastore.list_indexes()]
+        m = re.fullmatch(r"/api/v1/indexes/([^/]+)", path)
+        if m:
+            index_id = m.group(1)
+            if method == "GET":
+                return 200, node.metastore.index_metadata(index_id).to_dict()
+            if method == "DELETE":
+                removed = node.index_service.delete_index(index_id)
+                return 200, {"removed_splits": removed}
+        m = re.fullmatch(r"/api/v1/indexes/([^/]+)/splits", path)
+        if m and method == "GET":
+            metadata = node.metastore.index_metadata(m.group(1))
+            splits = node.metastore.list_splits(
+                ListSplitsQuery(index_uids=[metadata.index_uid]))
+            return 200, {"splits": [s.to_dict() for s in splits]}
+
+        # --- ingest ----------------------------------------------------
+        m = re.fullmatch(r"/api/v1/([^/_][^/]*)/ingest", path)
+        if m and method == "POST":
+            docs = _parse_ndjson(body)
+            result = node.ingest(m.group(1), docs,
+                                 commit=params.get("commit", "auto"))
+            return 200, result
+        # --- search ----------------------------------------------------
+        m = re.fullmatch(r"/api/v1/([^/_][^/]*)/search(?:/stream)?", path)
+        if m:
+            if method not in ("GET", "POST"):
+                raise ApiError(405, f"method {method} not allowed on search")
+            index_id = m.group(1)
+            if method == "POST" and body:
+                payload = json.loads(body)
+                params = {**params, **payload}
+            default_fields = self._default_fields(index_id)
+            request = _search_request_from_params(index_id, params, default_fields)
+            response = node.root_searcher.search(request)
+            return 200, _search_response_to_json(response)
+
+        # --- ES-compatible --------------------------------------------
+        if path.startswith("/api/v1/_elastic"):
+            return self._route_elastic(method, path[len("/api/v1/_elastic"):],
+                                       params, body)
+        raise ApiError(404, f"no route for {method} {path}")
+
+    # ------------------------------------------------------------------
+    def _default_fields(self, index_pattern: str):
+        try:
+            metadata = self.node.metastore.index_metadata(
+                index_pattern.split(",")[0].rstrip("*"))
+            return metadata.index_config.doc_mapper.default_search_fields
+        except MetastoreError:
+            return ()
+
+    def _route_elastic(self, method: str, path: str, params: dict[str, Any],
+                       body: bytes) -> tuple[int, Any]:
+        node = self.node
+        m = re.fullmatch(r"/([^/]+)/_search", path)
+        if m:
+            payload = json.loads(body) if body else {}
+            request = self._es_search_request(m.group(1), payload, params)
+            response = node.root_searcher.search(request)
+            return 200, self._es_search_response(response, request)
+        if path == "/_msearch" and method == "POST":
+            lines = [json.loads(line) for line in body.split(b"\n") if line.strip()]
+            responses = []
+            for i in range(0, len(lines) - 1, 2):
+                header, query_body = lines[i], lines[i + 1]
+                index = header.get("index", "*")
+                index = ",".join(index) if isinstance(index, list) else index
+                request = self._es_search_request(index, query_body, {})
+                response = node.root_searcher.search(request)
+                responses.append(self._es_search_response(response, request))
+            return 200, {"responses": responses}
+        m = re.fullmatch(r"(?:/([^/]+))?/_bulk", path)
+        if m and method == "POST":
+            return 200, self._es_bulk(m.group(1), body, params)
+        if path == "/_cat/indices" or path.startswith("/_cat/indices"):
+            out = []
+            for im in node.metastore.list_indexes():
+                splits = node.metastore.list_splits(
+                    ListSplitsQuery(index_uids=[im.index_uid]))
+                out.append({
+                    "health": "green", "status": "open", "index": im.index_id,
+                    "docs.count": str(sum(s.metadata.num_docs for s in splits)),
+                    "store.size": str(sum(s.metadata.footprint_bytes for s in splits)),
+                })
+            return 200, out
+        m = re.fullmatch(r"/([^/]+)/_field_caps", path)
+        if m:
+            metadata = node.metastore.index_metadata(m.group(1).rstrip("*").rstrip(","))
+            fields = {}
+            for fm in metadata.index_config.doc_mapper.field_mappings:
+                es_type = {"text": "text", "i64": "long", "u64": "long",
+                           "f64": "double", "bool": "boolean",
+                           "datetime": "date", "ip": "ip", "bytes": "binary",
+                           "json": "object"}[fm.type.value]
+                fields[fm.name] = {es_type: {
+                    "type": es_type, "searchable": fm.indexed,
+                    "aggregatable": fm.fast}}
+            return 200, {"indices": [metadata.index_id], "fields": fields}
+        raise ApiError(404, f"no elastic route for {method} {path}")
+
+    def _es_search_request(self, index: str, payload: dict[str, Any],
+                           params: dict[str, Any]) -> SearchRequest:
+        index_ids = index.split(",")
+        default_fields = self._default_fields(index_ids[0])
+        if "query" in payload:
+            ast = es_query_to_ast(payload["query"], default_fields)
+        elif params.get("q"):
+            ast = parse_query_string(params["q"], default_fields)
+        else:
+            ast = parse_query_string("*")
+        sort_fields: tuple[SortField, ...] = (SortField(),)
+        if payload.get("sort"):
+            entries = payload["sort"]
+            parsed = []
+            for entry in entries[:1]:  # one sort key round 1
+                if isinstance(entry, str):
+                    parsed.append(SortField(entry, "asc"))
+                else:
+                    field_name, spec = next(iter(entry.items()))
+                    order = spec.get("order", "asc") if isinstance(spec, dict) else spec
+                    parsed.append(SortField(field_name, order))
+            sort_fields = tuple(parsed)
+        return SearchRequest(
+            index_ids=index_ids,
+            query_ast=ast,
+            max_hits=int(payload.get("size", params.get("size", 10))),
+            start_offset=int(payload.get("from", params.get("from", 0))),
+            sort_fields=sort_fields,
+            aggs=payload.get("aggs") or payload.get("aggregations"),
+        )
+
+    @staticmethod
+    def _es_search_response(response, request: SearchRequest) -> dict[str, Any]:
+        hits = []
+        for hit in response.hits:
+            entry = {
+                "_index": request.index_ids[0],
+                "_id": f"{hit.split_id}:{hit.doc_id}",
+                "_score": hit.score,
+                "_source": hit.doc,
+            }
+            if hit.sort_values and hit.sort_values[0] is not None:
+                entry["sort"] = hit.sort_values
+            if hit.snippets:
+                entry["highlight"] = hit.snippets
+            hits.append(entry)
+        return {
+            "took": response.elapsed_time_micros // 1000,
+            "timed_out": False,
+            "hits": {
+                "total": {"value": response.num_hits, "relation": "eq"},
+                "max_score": max((h.score for h in response.hits
+                                  if h.score is not None), default=None),
+                "hits": hits,
+            },
+            **({"aggregations": response.aggregations}
+               if response.aggregations is not None else {}),
+        }
+
+    def _es_bulk(self, default_index: Optional[str], body: bytes,
+                 params: dict[str, Any]) -> dict[str, Any]:
+        lines = [line for line in body.split(b"\n") if line.strip()]
+        docs_by_index: dict[str, list[dict]] = {}
+        items = []
+        i = 0
+        while i < len(lines):
+            action = json.loads(lines[i])
+            kind = next(iter(action))
+            if kind not in ("index", "create"):
+                raise ApiError(400, f"unsupported bulk action {kind!r}")
+            index = action[kind].get("_index", default_index)
+            if index is None:
+                raise ApiError(400, "bulk action missing _index")
+            doc = json.loads(lines[i + 1])
+            docs_by_index.setdefault(index, []).append(doc)
+            items.append({kind: {"_index": index, "status": 201}})
+            i += 2
+        errors = False
+        for index, docs in docs_by_index.items():
+            try:
+                self.node.ingest(index, docs, commit=params.get("refresh", "auto"))
+            except MetastoreError as exc:
+                errors = True
+                for item in items:
+                    entry = next(iter(item.values()))
+                    if entry["_index"] == index:
+                        entry["status"] = 404
+                        entry["error"] = str(exc)
+        return {"errors": errors, "items": items}
+
+
+def _parse_ndjson(body: bytes) -> list[dict]:
+    docs = []
+    for line in body.split(b"\n"):
+        line = line.strip()
+        if line:
+            docs.append(json.loads(line))
+    return docs
+
+
+def _make_handler(server: RestServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet
+            logger.debug("http: " + fmt, *args)
+
+        def _handle(self, method: str) -> None:
+            t0 = time.monotonic()
+            parsed = urlparse(self.path)
+            params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            try:
+                status, payload = server.route(method, parsed.path, params, body)
+            except ApiError as exc:
+                status, payload = exc.status, {"message": str(exc)}
+            except (QueryParseError, EsDslParseError, AggParseError,
+                    PlanError, json.JSONDecodeError, ValueError) as exc:
+                status, payload = 400, {"message": str(exc)}
+            except MetastoreError as exc:
+                code = {"not_found": 404, "already_exists": 400,
+                        "failed_precondition": 409}.get(exc.kind, 500)
+                status, payload = code, {"message": str(exc)}
+            except Exception as exc:  # noqa: BLE001
+                logger.exception("internal error on %s %s", method, parsed.path)
+                status, payload = 500, {"message": f"internal error: {exc}"}
+            if isinstance(payload, str):
+                data = payload.encode()
+                content_type = "text/plain; version=0.0.4"
+            else:
+                data = json.dumps(payload).encode()
+                content_type = "application/json"
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            _REQUEST_COUNTER.inc(method=method, status=str(status))
+            _REQUEST_LATENCY.observe(time.monotonic() - t0)
+
+        def do_GET(self):
+            self._handle("GET")
+
+        def do_POST(self):
+            self._handle("POST")
+
+        def do_DELETE(self):
+            self._handle("DELETE")
+
+        def do_PUT(self):
+            self._handle("PUT")
+
+    return Handler

@@ -1,0 +1,197 @@
+"""Distributed root search over an in-process multi-node cluster.
+
+Mirrors the reference's ClusterSandbox tests (multi-node in one process,
+scripted failures) at the service level: three searcher nodes, a real
+file-backed metastore populated by the indexing pipeline, rendezvous
+placement, retry-on-other-node, and the two-phase fetch."""
+
+import pytest
+
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.indexing import IndexingPipeline, PipelineParams, VecSource
+from quickwit_tpu.metastore import FileBackedMetastore
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.models.index_metadata import IndexConfig, IndexMetadata, SourceConfig
+from quickwit_tpu.query import parse_query_string
+from quickwit_tpu.search.models import SearchRequest, SortField
+from quickwit_tpu.search.root import RootSearcher, extract_required_tags
+from quickwit_tpu.search.service import LocalSearchClient, SearcherContext, SearchService
+from quickwit_tpu.storage import RamStorage, StorageResolver
+
+MAPPER = DocMapper(
+    field_mappings=[
+        FieldMapping("ts", FieldType.DATETIME, fast=True,
+                     input_formats=("unix_timestamp",)),
+        FieldMapping("body", FieldType.TEXT),
+        FieldMapping("tenant", FieldType.U64, fast=True),
+        FieldMapping("severity", FieldType.TEXT, tokenizer="raw", fast=True),
+    ],
+    timestamp_field="ts",
+    tag_fields=("tenant",),
+    default_search_fields=("body",),
+)
+
+NUM_DOCS = 600
+
+
+def make_docs():
+    return [{"ts": 1_600_000_000 + i, "body": f"event {i} common word{i % 7}",
+             "tenant": i % 3, "severity": ["INFO", "ERROR"][i % 2]}
+            for i in range(NUM_DOCS)]
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    resolver = StorageResolver.for_test()
+    meta_storage = resolver.resolve("ram:///dist/metastore")
+    split_uri = "ram:///dist/splits"
+    metastore = FileBackedMetastore(meta_storage)
+    config = IndexConfig(index_id="logs", index_uri=split_uri, doc_mapper=MAPPER,
+                         split_num_docs_target=100)
+    metastore.create_index(IndexMetadata(
+        index_uid="logs:01", index_config=config,
+        sources={"src": SourceConfig("src", "vec")}))
+    pipeline = IndexingPipeline(
+        PipelineParams(index_uid="logs:01", source_id="src",
+                       split_num_docs_target=100, batch_num_docs=50),
+        MAPPER, VecSource(make_docs()), metastore,
+        resolver.resolve(split_uri))
+    pipeline.run_to_completion()
+
+    services = {
+        f"node-{i}": SearchService(
+            SearcherContext(storage_resolver=resolver), node_id=f"node-{i}")
+        for i in range(3)
+    }
+    clients = {nid: LocalSearchClient(svc) for nid, svc in services.items()}
+    root = RootSearcher(metastore, clients)
+    return metastore, services, clients, root
+
+
+def test_distributed_term_search(cluster):
+    _, _, _, root = cluster
+    response = root.search(SearchRequest(
+        index_ids=["logs"], query_ast=parse_query_string("severity:ERROR"),
+        max_hits=10, sort_fields=(SortField("ts", "desc"),)))
+    assert response.num_hits == NUM_DOCS // 2
+    assert len(response.hits) == 10
+    # newest ERROR doc first (odd ids are ERROR)
+    assert response.hits[0].doc["ts"] == 1_600_000_000 + NUM_DOCS - 1
+    assert [h.doc["ts"] for h in response.hits] == sorted(
+        (h.doc["ts"] for h in response.hits), reverse=True)
+
+
+def test_distributed_scored_search_with_offset(cluster):
+    _, _, _, root = cluster
+    full = root.search(SearchRequest(
+        index_ids=["logs"], query_ast=parse_query_string("common", ["body"]),
+        max_hits=20))
+    paged = root.search(SearchRequest(
+        index_ids=["logs"], query_ast=parse_query_string("common", ["body"]),
+        max_hits=10, start_offset=10))
+    assert [(h.split_id, h.doc_id) for h in paged.hits] == \
+        [(h.split_id, h.doc_id) for h in full.hits[10:]]
+
+
+def test_distributed_aggregations(cluster):
+    _, _, _, root = cluster
+    response = root.search(SearchRequest(
+        index_ids=["logs"], query_ast=parse_query_string("severity:ERROR"),
+        max_hits=0,
+        aggs={"tenants": {"terms": {"field": "tenant"}}}))
+    buckets = {b["key"]: b["doc_count"]
+               for b in response.aggregations["tenants"]["buckets"]}
+    expected = {}
+    for i in range(1, NUM_DOCS, 2):
+        expected[i % 3] = expected.get(i % 3, 0) + 1
+    assert buckets == expected
+
+
+def test_time_range_prunes_splits(cluster):
+    metastore, services, clients, root = cluster
+    # docs are time-ordered, 100/split: querying the first 150 seconds
+    # must touch only the first 2 splits
+    response = root.search(SearchRequest(
+        index_ids=["logs"], query_ast=parse_query_string("*"),
+        max_hits=0,
+        start_timestamp=1_600_000_000 * 1_000_000,
+        end_timestamp=(1_600_000_000 + 150) * 1_000_000))
+    assert response.num_hits == 150
+
+
+def test_tag_pruning_extraction():
+    ast = parse_query_string("tenant:2 AND severity:ERROR")
+    assert extract_required_tags(ast, ("tenant",)) == {"tenant:2"}
+    # disjunctive positions must NOT produce required tags
+    ast_or = parse_query_string("tenant:2 OR severity:ERROR")
+    assert extract_required_tags(ast_or, ("tenant",)) == set()
+
+
+def test_index_pattern_resolution(cluster):
+    _, _, _, root = cluster
+    response = root.search(SearchRequest(
+        index_ids=["log*"], query_ast=parse_query_string("*"), max_hits=0))
+    assert response.num_hits == NUM_DOCS
+    with pytest.raises(ValueError):
+        root.search(SearchRequest(index_ids=["nope-*"],
+                                  query_ast=parse_query_string("*"), max_hits=0))
+
+
+def test_search_after_pagination(cluster):
+    _, _, _, root = cluster
+    page1 = root.search(SearchRequest(
+        index_ids=["logs"], query_ast=parse_query_string("*"),
+        max_hits=7, sort_fields=(SortField("ts", "desc"),)))
+    last = page1.hits[-1]
+    # internal sort value for desc sort == raw value
+    page2 = root.search(SearchRequest(
+        index_ids=["logs"], query_ast=parse_query_string("*"),
+        max_hits=7, sort_fields=(SortField("ts", "desc"),),
+        search_after=[last.sort_values[0], last.split_id, last.doc_id]))
+    ids1 = {(h.split_id, h.doc_id) for h in page1.hits}
+    ids2 = {(h.split_id, h.doc_id) for h in page2.hits}
+    assert not ids1 & ids2
+    assert page2.hits[0].doc["ts"] < page1.hits[-1].doc["ts"] or \
+        page2.hits[0].doc["ts"] == page1.hits[-1].doc["ts"]
+
+
+class FlakyClient:
+    """Fails the first leaf_search on each node, then recovers."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.calls = 0
+
+    def leaf_search(self, request):
+        self.calls += 1
+        if self.calls == 1:
+            raise ConnectionError("injected failure")
+        return self.inner.leaf_search(request)
+
+    def fetch_docs(self, request):
+        return self.inner.fetch_docs(request)
+
+
+def test_retry_on_node_failure(cluster):
+    metastore, services, clients, _ = cluster
+    flaky = {nid: FlakyClient(c) for nid, c in clients.items()}
+    # make only ONE node flaky so retries land on healthy nodes
+    mixed = dict(clients)
+    first = sorted(mixed)[0]
+    mixed[first] = flaky[first]
+    root = RootSearcher(metastore, mixed)
+    response = root.search(SearchRequest(
+        index_ids=["logs"], query_ast=parse_query_string("severity:ERROR"),
+        max_hits=5))
+    assert response.num_hits == NUM_DOCS // 2  # nothing lost despite failure
+    assert len(response.hits) == 5
+
+
+def test_all_snippets(cluster):
+    _, _, _, root = cluster
+    response = root.search(SearchRequest(
+        index_ids=["logs"], query_ast=parse_query_string("common", ["body"]),
+        max_hits=3, snippet_fields=("body",)))
+    assert response.hits
+    for hit in response.hits:
+        assert "<em>common</em>" in hit.snippets["body"][0]

@@ -1,0 +1,203 @@
+"""Indexing pipeline + merge tests, including crash-replay exactly-once
+semantics (the reference's checkpoint dedupe) and rows-conserved merging
+(quickwit-dst's `rows_conserved` invariant)."""
+
+import json
+
+import pytest
+
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.index import SplitReader
+from quickwit_tpu.indexing import (
+    FileSource, IndexingPipeline, MergeExecutor, PipelineParams,
+    StableLogMergePolicy, VecSource, make_source,
+)
+from quickwit_tpu.indexing.pipeline import split_file_path
+from quickwit_tpu.metastore import FileBackedMetastore, ListSplitsQuery
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.models.index_metadata import IndexConfig, IndexMetadata, SourceConfig
+from quickwit_tpu.models.split_metadata import SplitState
+from quickwit_tpu.query.ast import Term
+from quickwit_tpu.search import SearchRequest, leaf_search_single_split
+from quickwit_tpu.storage import RamStorage
+
+
+MAPPER = DocMapper(
+    field_mappings=[
+        FieldMapping("ts", FieldType.DATETIME, fast=True,
+                     input_formats=("unix_timestamp",)),
+        FieldMapping("body", FieldType.TEXT),
+        FieldMapping("tenant", FieldType.U64, fast=True),
+    ],
+    timestamp_field="ts",
+    tag_fields=("tenant",),
+    default_search_fields=("body",),
+)
+
+
+def make_docs(n, start=0):
+    return [{"ts": 1000 + start + i, "body": f"event {start + i} common",
+             "tenant": (start + i) % 3} for i in range(n)]
+
+
+@pytest.fixture
+def env():
+    storage = RamStorage(Uri.parse("ram:///idx-test"))
+    split_storage = RamStorage(Uri.parse("ram:///idx-test-splits"))
+    metastore = FileBackedMetastore(storage)
+    config = IndexConfig(index_id="logs", index_uri="ram:///idx-test-splits",
+                         doc_mapper=MAPPER)
+    metastore.create_index(IndexMetadata(
+        index_uid="logs:01", index_config=config,
+        sources={"src": SourceConfig("src", "vec")}))
+    return metastore, split_storage
+
+
+def make_pipeline(metastore, split_storage, source, target=1000_000):
+    params = PipelineParams(index_uid="logs:01", source_id="src",
+                            split_num_docs_target=target, batch_num_docs=100)
+    return IndexingPipeline(params, MAPPER, source, metastore, split_storage)
+
+
+def test_pipeline_end_to_end(env):
+    metastore, split_storage = env
+    pipeline = make_pipeline(metastore, split_storage, VecSource(make_docs(250)))
+    counters = pipeline.run_to_completion()
+    assert counters.num_docs_processed == 250
+    assert counters.num_splits_published == 1
+    splits = metastore.list_splits(
+        ListSplitsQuery(index_uids=["logs:01"], states=[SplitState.PUBLISHED]))
+    assert len(splits) == 1
+    md = splits[0].metadata
+    assert md.num_docs == 250
+    assert md.time_range_start == 1000 * 1_000_000
+    assert md.tags == {"tenant:0", "tenant:1", "tenant:2"}
+    # the split is searchable
+    reader = SplitReader(split_storage, split_file_path(md.split_id))
+    resp = leaf_search_single_split(
+        SearchRequest(index_ids=["logs"], query_ast=Term("tenant", "1"),
+                      max_hits=1000),
+        MAPPER, reader, md.split_id)
+    assert resp.num_hits == sum(1 for i in range(250) if i % 3 == 1)
+
+
+def test_pipeline_splits_on_target(env):
+    metastore, split_storage = env
+    pipeline = make_pipeline(metastore, split_storage, VecSource(make_docs(250)),
+                             target=100)
+    pipeline.run_to_completion()
+    splits = metastore.list_splits(
+        ListSplitsQuery(index_uids=["logs:01"], states=[SplitState.PUBLISHED]))
+    assert sum(s.metadata.num_docs for s in splits) == 250
+    assert len(splits) == 3  # 100 + 100 + 50
+
+
+def test_pipeline_crash_replay_is_exactly_once(env):
+    """Re-running the pipeline from the last committed checkpoint (what the
+    supervisor does after a crash) must not duplicate documents."""
+    metastore, split_storage = env
+    docs = make_docs(300)
+    pipeline = make_pipeline(metastore, split_storage, VecSource(docs), target=100)
+    pipeline.run_to_completion()
+    # simulate restart: new pipeline, same source, same checkpoint store
+    pipeline2 = make_pipeline(metastore, split_storage, VecSource(docs), target=100)
+    counters = pipeline2.run_to_completion()
+    assert counters.num_docs_processed == 0  # nothing re-read
+    splits = metastore.list_splits(
+        ListSplitsQuery(index_uids=["logs:01"], states=[SplitState.PUBLISHED]))
+    assert sum(s.metadata.num_docs for s in splits) == 300
+
+
+def test_pipeline_invalid_docs_dropped_but_checkpoint_advances(env):
+    metastore, split_storage = env
+    docs = make_docs(10) + [{"ts": "not-a-ts", "body": 1}] * 5
+    pipeline = make_pipeline(metastore, split_storage, VecSource(docs))
+    counters = pipeline.run_to_completion()
+    assert counters.num_docs_processed == 10
+    assert counters.num_docs_invalid == 5
+    # replay: checkpoint covers the bad docs too
+    pipeline2 = make_pipeline(metastore, split_storage, VecSource(docs))
+    assert pipeline2.run_to_completion().num_docs_processed == 0
+
+
+def test_file_source_checkpoint(tmp_path, env):
+    metastore, split_storage = env
+    path = tmp_path / "docs.ndjson"
+    with open(path, "w") as f:
+        for doc in make_docs(100):
+            f.write(json.dumps(doc) + "\n")
+    source = make_source("file", {"filepath": str(path)})
+    pipeline = make_pipeline(metastore, split_storage, source)
+    assert pipeline.run_to_completion().num_docs_processed == 100
+    # appending docs and re-running indexes only the new tail
+    with open(path, "a") as f:
+        for doc in make_docs(20, start=100):
+            f.write(json.dumps(doc) + "\n")
+    pipeline2 = make_pipeline(metastore, split_storage,
+                              make_source("file", {"filepath": str(path)}))
+    assert pipeline2.run_to_completion().num_docs_processed == 20
+
+
+def test_merge_policy_levels():
+    from quickwit_tpu.models.split_metadata import Split, SplitMetadata
+    policy = StableLogMergePolicy(merge_factor=3, max_merge_factor=3,
+                                  min_level_num_docs=100)
+    splits = [
+        Split(SplitMetadata(f"s{i}", "x:01", num_docs=50), SplitState.PUBLISHED)
+        for i in range(7)
+    ]
+    ops = policy.operations(splits)
+    assert len(ops) == 2  # 7 small splits, factor 3: two merge ops, 1 leftover
+    assert len(ops[0].splits) == 3
+    # a wider max_merge_factor absorbs everything in one op
+    wide = StableLogMergePolicy(merge_factor=3, max_merge_factor=12,
+                                min_level_num_docs=100)
+    assert len(wide.operations(splits)) == 1
+    assert len(wide.operations(splits)[0].splits) == 7
+    # mature splits never merge
+    big = [Split(SplitMetadata(f"b{i}", "x:01", num_docs=20_000_000),
+                 SplitState.PUBLISHED) for i in range(5)]
+    assert policy.operations(big) == []
+
+
+def test_merge_executor_conserves_rows(env):
+    metastore, split_storage = env
+    pipeline = make_pipeline(metastore, split_storage, VecSource(make_docs(300)),
+                             target=100)
+    pipeline.run_to_completion()
+    splits = metastore.list_splits(
+        ListSplitsQuery(index_uids=["logs:01"], states=[SplitState.PUBLISHED]))
+    assert len(splits) == 3
+    executor = MergeExecutor("logs:01", MAPPER, metastore, split_storage)
+    from quickwit_tpu.indexing.merge import MergeOperation
+    merged_id = executor.execute(MergeOperation(tuple(splits)))
+    published = metastore.list_splits(
+        ListSplitsQuery(index_uids=["logs:01"], states=[SplitState.PUBLISHED]))
+    assert [s.metadata.split_id for s in published] == [merged_id]
+    assert published[0].metadata.num_docs == 300
+    assert published[0].metadata.num_merge_ops == 1
+    # merged split is searchable with all docs
+    reader = SplitReader(split_storage, split_file_path(merged_id))
+    resp = leaf_search_single_split(
+        SearchRequest(index_ids=["logs"], query_ast=Term("tenant", "0"),
+                      max_hits=1000), MAPPER, reader, merged_id)
+    assert resp.num_hits == sum(1 for i in range(300) if i % 3 == 0)
+
+
+def test_merge_applies_delete_tasks(env):
+    metastore, split_storage = env
+    pipeline = make_pipeline(metastore, split_storage, VecSource(make_docs(90)),
+                             target=30)
+    pipeline.run_to_completion()
+    metastore.create_delete_task("logs:01",
+                                 {"type": "term", "field": "tenant", "value": "1"})
+    splits = metastore.list_splits(
+        ListSplitsQuery(index_uids=["logs:01"], states=[SplitState.PUBLISHED]))
+    executor = MergeExecutor("logs:01", MAPPER, metastore, split_storage)
+    from quickwit_tpu.indexing.merge import MergeOperation
+    merged_id = executor.execute(
+        MergeOperation(tuple(splits)), delete_query_asts=[Term("tenant", "1")])
+    published = metastore.list_splits(
+        ListSplitsQuery(index_uids=["logs:01"], states=[SplitState.PUBLISHED]))
+    assert published[0].metadata.num_docs == 60  # tenant==1 docs removed
+    assert published[0].metadata.delete_opstamp == 1

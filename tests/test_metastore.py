@@ -1,0 +1,186 @@
+import pytest
+
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.metastore import (
+    CheckpointDelta, FileBackedMetastore, IncompatibleCheckpointDelta,
+    ListSplitsQuery, MetastoreError, SourceCheckpoint,
+)
+from quickwit_tpu.metastore.checkpoint import BEGINNING, offset_position
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType, SplitMetadata
+from quickwit_tpu.models.index_metadata import IndexConfig, IndexMetadata, SourceConfig
+from quickwit_tpu.models.split_metadata import SplitState
+from quickwit_tpu.storage import RamStorage
+
+
+def make_index_metadata(index_id="test-index"):
+    mapper = DocMapper(field_mappings=[FieldMapping("body", FieldType.TEXT)])
+    config = IndexConfig(index_id=index_id, index_uri=f"ram:///indexes/{index_id}",
+                         doc_mapper=mapper)
+    return IndexMetadata(index_uid=f"{index_id}:01", index_config=config,
+                         sources={"src1": SourceConfig("src1", "vec")})
+
+
+@pytest.fixture
+def metastore():
+    storage = RamStorage(Uri.parse("ram:///metastore-test"))
+    ms = FileBackedMetastore(storage)
+    ms.create_index(make_index_metadata())
+    return ms
+
+
+def split_md(split_id, index_uid="test-index:01", num_docs=100):
+    return SplitMetadata(split_id=split_id, index_uid=index_uid, num_docs=num_docs,
+                         source_id="src1")
+
+
+def test_create_index_twice_fails(metastore):
+    with pytest.raises(MetastoreError) as exc:
+        metastore.create_index(make_index_metadata())
+    assert exc.value.kind == "already_exists"
+
+
+def test_index_lifecycle(metastore):
+    assert metastore.index_metadata("test-index").index_uid == "test-index:01"
+    assert len(metastore.list_indexes()) == 1
+    metastore.delete_index("test-index:01")
+    assert metastore.list_indexes() == []
+    with pytest.raises(MetastoreError):
+        metastore.index_metadata("test-index")
+
+
+def test_state_survives_reload():
+    storage = RamStorage(Uri.parse("ram:///reload-test"))
+    ms1 = FileBackedMetastore(storage)
+    ms1.create_index(make_index_metadata())
+    ms1.stage_splits("test-index:01", [split_md("s1")])
+    ms1.publish_splits("test-index:01", ["s1"])
+    # a fresh instance over the same storage sees everything
+    ms2 = FileBackedMetastore(storage)
+    splits = ms2.list_splits(ListSplitsQuery(index_uids=["test-index:01"]))
+    assert [s.metadata.split_id for s in splits] == ["s1"]
+    assert splits[0].state is SplitState.PUBLISHED
+
+
+def test_publish_protocol(metastore):
+    uid = "test-index:01"
+    metastore.stage_splits(uid, [split_md("s1"), split_md("s2")])
+    metastore.publish_splits(uid, ["s1", "s2"])
+    published = metastore.list_splits(
+        ListSplitsQuery(index_uids=[uid], states=[SplitState.PUBLISHED]))
+    assert len(published) == 2
+    # publishing a non-staged split fails
+    with pytest.raises(MetastoreError) as exc:
+        metastore.publish_splits(uid, ["s1"])
+    assert exc.value.kind == "failed_precondition"
+    # publishing an unknown split fails
+    with pytest.raises(MetastoreError):
+        metastore.publish_splits(uid, ["nope"])
+
+
+def test_publish_with_replacement(metastore):
+    uid = "test-index:01"
+    metastore.stage_splits(uid, [split_md("s1"), split_md("s2")])
+    metastore.publish_splits(uid, ["s1", "s2"])
+    metastore.stage_splits(uid, [split_md("merged")])
+    metastore.publish_splits(uid, ["merged"], replaced_split_ids=["s1", "s2"])
+    published = metastore.list_splits(
+        ListSplitsQuery(index_uids=[uid], states=[SplitState.PUBLISHED]))
+    assert [s.metadata.split_id for s in published] == ["merged"]
+    marked = metastore.list_splits(
+        ListSplitsQuery(index_uids=[uid], states=[SplitState.MARKED_FOR_DELETION]))
+    assert {s.metadata.split_id for s in marked} == {"s1", "s2"}
+
+
+def test_exactly_once_checkpoint(metastore):
+    uid = "test-index:01"
+    delta1 = CheckpointDelta.from_range("p0", BEGINNING, offset_position(100))
+    metastore.stage_splits(uid, [split_md("s1")])
+    metastore.publish_splits(uid, ["s1"], source_id="src1", checkpoint_delta=delta1)
+    # replaying the same delta is rejected (exactly-once)
+    metastore.stage_splits(uid, [split_md("s2")])
+    with pytest.raises(MetastoreError) as exc:
+        metastore.publish_splits(uid, ["s2"], source_id="src1",
+                                 checkpoint_delta=delta1)
+    assert exc.value.kind == "failed_precondition"
+    # and the failed publish did NOT publish the split (atomicity)
+    staged = metastore.list_splits(
+        ListSplitsQuery(index_uids=[uid], states=[SplitState.STAGED]))
+    assert [s.metadata.split_id for s in staged] == ["s2"]
+    # the contiguous next delta works
+    delta2 = CheckpointDelta.from_range("p0", offset_position(100), offset_position(200))
+    metastore.publish_splits(uid, ["s2"], source_id="src1", checkpoint_delta=delta2)
+    checkpoint = metastore.source_checkpoint(uid, "src1")
+    assert checkpoint.position_for("p0") == offset_position(200)
+
+
+def test_list_splits_time_and_tag_pruning(metastore):
+    uid = "test-index:01"
+    s1 = SplitMetadata("s1", uid, num_docs=10, time_range_start=0,
+                       time_range_end=999, tags=frozenset({"tenant_id:1"}))
+    s2 = SplitMetadata("s2", uid, num_docs=10, time_range_start=1000,
+                       time_range_end=1999, tags=frozenset({"tenant_id:2"}))
+    metastore.stage_splits(uid, [s1, s2])
+    metastore.publish_splits(uid, ["s1", "s2"])
+    hits = metastore.list_splits(ListSplitsQuery(
+        index_uids=[uid], time_range_start=1500, time_range_end=3000))
+    assert [s.metadata.split_id for s in hits] == ["s2"]
+    # end is exclusive
+    hits = metastore.list_splits(ListSplitsQuery(index_uids=[uid], time_range_end=1000))
+    assert [s.metadata.split_id for s in hits] == ["s1"]
+    hits = metastore.list_splits(ListSplitsQuery(
+        index_uids=[uid], required_tags={"tenant_id:2"}))
+    assert [s.metadata.split_id for s in hits] == ["s2"]
+
+
+def test_delete_splits_lifecycle(metastore):
+    uid = "test-index:01"
+    metastore.stage_splits(uid, [split_md("s1")])
+    metastore.publish_splits(uid, ["s1"])
+    with pytest.raises(MetastoreError):
+        metastore.delete_splits(uid, ["s1"])  # published: refuse
+    metastore.mark_splits_for_deletion(uid, ["s1"])
+    metastore.delete_splits(uid, ["s1"])
+    assert metastore.list_splits(ListSplitsQuery(index_uids=[uid])) == []
+
+
+def test_sources(metastore):
+    uid = "test-index:01"
+    metastore.add_source(uid, SourceConfig("src2", "file", {"filepath": "/x"}))
+    assert "src2" in metastore.index_metadata("test-index").sources
+    with pytest.raises(MetastoreError):
+        metastore.add_source(uid, SourceConfig("src2", "file"))
+    metastore.toggle_source(uid, "src2", False)
+    assert not metastore.index_metadata("test-index").sources["src2"].enabled
+    metastore.delete_source(uid, "src2")
+    assert "src2" not in metastore.index_metadata("test-index").sources
+
+
+def test_delete_tasks(metastore):
+    uid = "test-index:01"
+    op1 = metastore.create_delete_task(uid, {"type": "term", "field": "f", "value": "x"})
+    op2 = metastore.create_delete_task(uid, {"type": "term", "field": "f", "value": "y"})
+    assert op2 > op1
+    assert metastore.last_delete_opstamp(uid) == op2
+    tasks = metastore.list_delete_tasks(uid, opstamp_start=op1)
+    assert len(tasks) == 1 and tasks[0]["opstamp"] == op2
+
+
+def test_index_uid_mismatch_rejected(metastore):
+    with pytest.raises(MetastoreError) as exc:
+        metastore.stage_splits("test-index:99", [split_md("s1", "test-index:99")])
+    assert exc.value.kind == "not_found"
+
+
+def test_checkpoint_delta_extension():
+    delta = CheckpointDelta.from_range("p", BEGINNING, offset_position(10))
+    delta.record("p", offset_position(10), offset_position(20))
+    assert delta.per_partition["p"] == (BEGINNING, offset_position(20))
+    with pytest.raises(IncompatibleCheckpointDelta):
+        delta.record("p", offset_position(99), offset_position(120))
+
+
+def test_checkpoint_backwards_delta_rejected():
+    cp = SourceCheckpoint()
+    with pytest.raises(IncompatibleCheckpointDelta):
+        cp.try_apply_delta(CheckpointDelta.from_range(
+            "p", offset_position(10), offset_position(5)))

@@ -1,0 +1,141 @@
+"""Two-node cluster over real HTTP transport + janitor lifecycle."""
+
+import http.client
+import json
+import time
+
+import pytest
+
+from quickwit_tpu.cluster.membership import ClusterMember
+from quickwit_tpu.janitor import apply_retention, run_garbage_collection
+from quickwit_tpu.metastore.base import ListSplitsQuery
+from quickwit_tpu.models.split_metadata import SplitState
+from quickwit_tpu.serve import Node, NodeConfig, RestServer
+from quickwit_tpu.serve.http_client import HttpSearchClient, HttpTransportError
+from quickwit_tpu.storage import StorageResolver
+
+INDEX_CONFIG = {
+    "index_id": "mn-logs",
+    "doc_mapping": {
+        "field_mappings": [
+            {"name": "ts", "type": "datetime", "fast": True,
+             "input_formats": ["unix_timestamp"]},
+            {"name": "body", "type": "text"},
+        ],
+        "timestamp_field": "ts",
+        "default_search_fields": ["body"],
+    },
+    "indexing_settings": {"split_num_docs_target": 50},
+}
+
+
+def rest(port, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    data = None
+    if body is not None:
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    conn.request(method, path, body=data)
+    response = conn.getresponse()
+    payload = response.read()
+    conn.close()
+    return response.status, (json.loads(payload) if payload else None)
+
+
+@pytest.fixture(scope="module")
+def two_nodes():
+    # shared storage resolver = shared object storage + shared metastore files
+    resolver = StorageResolver.for_test()
+    nodes, servers = [], []
+    for i in range(2):
+        node = Node(NodeConfig(node_id=f"mn-{i}", rest_port=0,
+                               metastore_uri="ram:///mn/metastore",
+                               default_index_root_uri="ram:///mn/indexes"),
+                    storage_resolver=resolver)
+        server = RestServer(node)
+        server.start()
+        nodes.append(node)
+        servers.append(server)
+    # mutual membership via heartbeat (the gossip join)
+    for i, node in enumerate(nodes):
+        peer = servers[1 - i]
+        client = HttpSearchClient(peer.endpoint)
+        client.heartbeat({"node_id": node.config.node_id,
+                          "roles": list(node.config.roles),
+                          "rest_endpoint": servers[i].endpoint})
+    yield nodes, servers
+    for server in servers:
+        server.stop()
+
+
+def test_cross_node_search(two_nodes):
+    nodes, servers = two_nodes
+    port0, port1 = servers[0].port, servers[1].port
+    status, _ = rest(port0, "POST", "/api/v1/indexes", INDEX_CONFIG)
+    assert status == 200
+    docs = "\n".join(json.dumps({"ts": 1_600_000_000 + i, "body": f"doc {i} shared"})
+                     for i in range(200)).encode()
+    status, result = rest(port0, "POST", "/api/v1/mn-logs/ingest", docs)
+    assert status == 200 and result["num_ingested_docs"] == 200
+
+    # both nodes know each other
+    status, cluster = rest(port0, "GET", "/api/v1/cluster")
+    assert {m["node_id"] for m in cluster["members"]} == {"mn-0", "mn-1"}
+
+    # searching via node 1 works even though node 0 ingested; with 2 searcher
+    # nodes, the placer fans splits across BOTH (4 splits of 50 docs)
+    status, result = rest(port1, "GET", "/api/v1/mn-logs/search?query=shared&max_hits=5")
+    assert status == 200
+    assert result["num_hits"] == 200
+
+    # node-level caches: both nodes hold readers now; a repeat query hits them
+    status, result = rest(port1, "GET", "/api/v1/mn-logs/search?query=shared&max_hits=5")
+    assert status == 200 and result["num_hits"] == 200
+
+
+def test_dead_node_failover(two_nodes):
+    nodes, servers = two_nodes
+    port0 = servers[0].port
+    # kill node 1's server; node 0 should still answer by retrying on itself
+    servers[1].stop()
+    # mark node 1 dead via heartbeat age
+    member = nodes[0].cluster.member("mn-1")
+    member.last_heartbeat = time.monotonic() - 1000
+    status, result = rest(port0, "GET", "/api/v1/mn-logs/search?query=shared&max_hits=3")
+    assert status == 200
+    assert result["num_hits"] == 200
+
+
+def test_http_client_error_surface():
+    client = HttpSearchClient("127.0.0.1:1")  # nothing listens
+    with pytest.raises(HttpTransportError):
+        client.heartbeat({"node_id": "x", "roles": []})
+
+
+def test_janitor_gc_and_retention(two_nodes):
+    nodes, _ = two_nodes
+    node = nodes[0]
+    metadata = node.metastore.index_metadata("mn-logs")
+    uid = metadata.index_uid
+    storage = node.storage_resolver.resolve(metadata.index_config.index_uri)
+
+    published = node.metastore.list_splits(
+        ListSplitsQuery(index_uids=[uid], states=[SplitState.PUBLISHED]))
+    victim = published[0].metadata.split_id
+    node.metastore.mark_splits_for_deletion(uid, [victim])
+    # too young: grace period protects it
+    stats = run_garbage_collection(node.metastore, node.storage_resolver)
+    assert stats["gc_deleted_splits"] == 0
+    # pretend time passed
+    stats = run_garbage_collection(node.metastore, node.storage_resolver,
+                                   now=time.time() + 10_000)
+    assert stats["gc_deleted_splits"] == 1
+    assert not storage.exists(f"{victim}.split")
+
+    # retention: a policy of 1 hour expires everything (docs are from 2020)
+    from quickwit_tpu.models.index_metadata import RetentionPolicy
+    metadata.index_config.retention = RetentionPolicy(period_seconds=3600)
+    stats = apply_retention(node.metastore)
+    remaining = node.metastore.list_splits(
+        ListSplitsQuery(index_uids=[uid], states=[SplitState.PUBLISHED]))
+    assert stats["retention_marked_splits"] > 0
+    assert remaining == []

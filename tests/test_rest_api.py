@@ -1,0 +1,211 @@
+"""REST + ES-compatible API tests driving a real HTTP server
+(role of the reference's rest-api-tests golden scenarios)."""
+
+import http.client
+import json
+
+import pytest
+
+from quickwit_tpu.serve import Node, NodeConfig, RestServer
+from quickwit_tpu.storage import StorageResolver
+
+INDEX_CONFIG = {
+    "index_id": "hdfs-logs",
+    "doc_mapping": {
+        "field_mappings": [
+            {"name": "timestamp", "type": "datetime", "fast": True,
+             "input_formats": ["unix_timestamp"]},
+            {"name": "severity_text", "type": "text", "tokenizer": "raw", "fast": True},
+            {"name": "tenant_id", "type": "u64", "fast": True},
+            {"name": "body", "type": "text", "record": "position"},
+        ],
+        "timestamp_field": "timestamp",
+        "tag_fields": ["tenant_id"],
+        "default_search_fields": ["body"],
+    },
+    "indexing_settings": {"split_num_docs_target": 1000},
+}
+
+DOCS = [
+    {"timestamp": 1_600_000_000 + i, "severity_text": ["INFO", "ERROR"][i % 2],
+     "tenant_id": i % 3, "body": f"log line {i} with shared tokens"}
+    for i in range(100)
+]
+
+
+class Client:
+    def __init__(self, port):
+        self.port = port
+
+    def request(self, method, path, body=None, raw=False):
+        conn = http.client.HTTPConnection("127.0.0.1", self.port, timeout=30)
+        data = None
+        if body is not None:
+            data = body if isinstance(body, bytes) else json.dumps(body).encode()
+        conn.request(method, path, body=data)
+        response = conn.getresponse()
+        payload = response.read()
+        conn.close()
+        if raw:
+            return response.status, payload
+        return response.status, (json.loads(payload) if payload else None)
+
+
+@pytest.fixture(scope="module")
+def api():
+    resolver = StorageResolver.for_test()
+    node = Node(NodeConfig(node_id="rest-node", rest_port=0,
+                           metastore_uri="ram:///rest/metastore",
+                           default_index_root_uri="ram:///rest/indexes"),
+                storage_resolver=resolver)
+    server = RestServer(node)
+    server.start()
+    client = Client(server.port)
+    status, _ = client.request("POST", "/api/v1/indexes", INDEX_CONFIG)
+    assert status == 200
+    ndjson = "\n".join(json.dumps(d) for d in DOCS).encode()
+    status, result = client.request(
+        "POST", "/api/v1/hdfs-logs/ingest?commit=force", ndjson)
+    assert status == 200 and result["num_ingested_docs"] == 100
+    yield client
+    server.stop()
+
+
+def test_health_and_cluster(api):
+    assert api.request("GET", "/health/livez") == (200, True)
+    status, cluster = api.request("GET", "/api/v1/cluster")
+    assert status == 200 and cluster["node_id"] == "rest-node"
+
+
+def test_search_get(api):
+    status, result = api.request(
+        "GET", "/api/v1/hdfs-logs/search?query=severity_text:ERROR&max_hits=5")
+    assert status == 200
+    assert result["num_hits"] == 50
+    assert len(result["hits"]) == 5
+    assert result["hits"][0]["doc"]["severity_text"] == "ERROR"
+
+
+def test_search_post_with_aggs_and_sort(api):
+    status, result = api.request("POST", "/api/v1/hdfs-logs/search", {
+        "query": "severity_text:ERROR",
+        "max_hits": 3,
+        "sort_by": "-timestamp",
+        "aggs": {"tenants": {"terms": {"field": "tenant_id"}}},
+    })
+    assert status == 200
+    timestamps = [h["doc"]["timestamp"] for h in result["hits"]]
+    assert timestamps == sorted(timestamps, reverse=True)
+    buckets = {b["key"]: b["doc_count"]
+               for b in result["aggregations"]["tenants"]["buckets"]}
+    expected = {}
+    for i in range(1, 100, 2):
+        expected[i % 3] = expected.get(i % 3, 0) + 1
+    assert buckets == expected
+
+
+def test_search_time_range(api):
+    status, result = api.request(
+        "GET", "/api/v1/hdfs-logs/search?query=*"
+               f"&start_timestamp={1_600_000_000 + 10}&end_timestamp={1_600_000_000 + 20}")
+    assert status == 200
+    assert result["num_hits"] == 10  # end exclusive
+
+
+def test_search_bad_query_is_400(api):
+    status, result = api.request("GET", "/api/v1/hdfs-logs/search?query=body:")
+    assert status == 400
+    assert "message" in result
+
+
+def test_search_unknown_index_404ish(api):
+    status, result = api.request("GET", "/api/v1/nope/search?query=*")
+    assert status == 400  # "no index matches"
+
+
+def test_splits_listing(api):
+    status, result = api.request("GET", "/api/v1/indexes/hdfs-logs/splits")
+    assert status == 200
+    assert sum(s["metadata"]["num_docs"] for s in result["splits"]) == 100
+
+
+def test_es_search(api):
+    status, result = api.request("POST", "/api/v1/_elastic/hdfs-logs/_search", {
+        "query": {"bool": {
+            "must": [{"match": {"body": "shared"}}],
+            "filter": [{"term": {"severity_text": "ERROR"}}],
+        }},
+        "size": 4,
+    })
+    assert status == 200
+    assert result["hits"]["total"]["value"] == 50
+    assert len(result["hits"]["hits"]) == 4
+    hit = result["hits"]["hits"][0]
+    assert hit["_source"]["severity_text"] == "ERROR"
+    assert hit["_score"] is not None
+
+
+def test_es_search_query_string_fallback(api):
+    status, result = api.request(
+        "GET", "/api/v1/_elastic/hdfs-logs/_search?q=severity_text:INFO&size=2")
+    assert status == 200
+    assert result["hits"]["total"]["value"] == 50
+
+
+def test_es_msearch(api):
+    body = (json.dumps({"index": "hdfs-logs"}) + "\n"
+            + json.dumps({"query": {"term": {"severity_text": "ERROR"}}, "size": 1})
+            + "\n" + json.dumps({"index": "hdfs-logs"}) + "\n"
+            + json.dumps({"query": {"match_all": {}}, "size": 1}) + "\n").encode()
+    status, result = api.request("POST", "/api/v1/_elastic/_msearch", body)
+    assert status == 200
+    assert len(result["responses"]) == 2
+    assert result["responses"][0]["hits"]["total"]["value"] == 50
+    assert result["responses"][1]["hits"]["total"]["value"] == 100
+
+
+def test_es_bulk_and_cat(api):
+    bulk = (json.dumps({"index": {"_index": "hdfs-logs"}}) + "\n"
+            + json.dumps({"timestamp": 1_600_001_000, "severity_text": "WARN",
+                          "tenant_id": 9, "body": "bulk doc"}) + "\n").encode()
+    status, result = api.request("POST", "/api/v1/_elastic/_bulk", bulk)
+    assert status == 200 and result["errors"] is False
+    status, result = api.request("GET", "/api/v1/_elastic/_cat/indices")
+    assert status == 200
+    entry = next(e for e in result if e["index"] == "hdfs-logs")
+    assert int(entry["docs.count"]) == 101
+
+
+def test_es_field_caps(api):
+    status, result = api.request("GET", "/api/v1/_elastic/hdfs-logs/_field_caps")
+    assert status == 200
+    assert result["fields"]["timestamp"]["date"]["aggregatable"] is True
+    assert result["fields"]["body"]["text"]["searchable"] is True
+
+
+def test_sorted_search_es_with_sort(api):
+    status, result = api.request("POST", "/api/v1/_elastic/hdfs-logs/_search", {
+        "query": {"match_all": {}},
+        "sort": [{"timestamp": {"order": "desc"}}],
+        "size": 3,
+    })
+    assert status == 200
+    values = [h["sort"][0] for h in result["hits"]["hits"]]
+    assert values == sorted(values, reverse=True)
+
+
+def test_metrics_exposition(api):
+    status, text = api.request("GET", "/metrics", raw=True)
+    assert status == 200
+    assert b"qw_http_requests_total" in text
+
+
+def test_delete_index(api):
+    api.request("POST", "/api/v1/indexes",
+                {**INDEX_CONFIG, "index_id": "tmp-index"})
+    api.request("POST", "/api/v1/tmp-index/ingest",
+                json.dumps({"timestamp": 1, "body": "x"}).encode())
+    status, result = api.request("DELETE", "/api/v1/indexes/tmp-index")
+    assert status == 200
+    status, _ = api.request("GET", "/api/v1/indexes/tmp-index")
+    assert status == 404
