@@ -39,6 +39,19 @@ __version__ = "0.1.0"
 # i64 timestamp columns (micros since epoch, ~1e15) and f64 aggregation
 # accumulators need 64-bit math; f64 is exact for integers < 2^53 which covers
 # all datetime micros. Must be set before any tracing.
+import os as _os
+
 import jax as _jax
 
 _jax.config.update("jax_enable_x64", True)
+
+# Platform override: the environment's sitecustomize may force-register an
+# accelerator plugin and rewrite jax_platforms, ignoring JAX_PLATFORMS;
+# QW_JAX_PLATFORM lets operators (and the CLI) pin the backend explicitly —
+# e.g. QW_JAX_PLATFORM=cpu for host-only roles or when no TPU is reachable.
+_platform = _os.environ.get("QW_JAX_PLATFORM")
+if _platform:
+    _jax.config.update("jax_platforms", _platform)
+    if _platform == "cpu" and _os.environ.get("QW_NUM_CPU_DEVICES"):
+        _jax.config.update("jax_num_cpu_devices",
+                           int(_os.environ["QW_NUM_CPU_DEVICES"]))

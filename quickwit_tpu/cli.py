@@ -1,0 +1,275 @@
+"""Command-line interface.
+
+Role of the reference's `quickwit-cli` (`cli.rs:56`):
+
+  quickwit-tpu run [--config FILE]                      start a node
+  quickwit-tpu index create --index-config FILE
+  quickwit-tpu index list | describe | delete --index ID
+  quickwit-tpu index ingest --index ID [--input-path F] [ndjson on stdin]
+  quickwit-tpu index search --index ID --query Q [--max-hits N] [--aggs JSON]
+  quickwit-tpu index merge --index ID                   one merge pass
+  quickwit-tpu split list --index ID
+  quickwit-tpu tool gc | retention                      janitor passes
+  quickwit-tpu tool extract-split --index ID --split ID --output-dir D
+
+Commands other than `run` execute against a running node's REST API when
+`--endpoint` is given, or an embedded node otherwise (reference: CLI's
+local/remote duality).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Optional
+
+from .common.uri import Protocol
+from .config import load_index_config, load_node_config
+from .serve.node import Node, NodeConfig
+from .storage.base import StorageResolver
+from .storage.local import LocalFileStorage
+from .storage.ram import RamStorage
+
+
+def _resolver() -> StorageResolver:
+    resolver = StorageResolver()
+    resolver.register(Protocol.FILE, LocalFileStorage)
+    from .common.uri import Uri
+    ram_root = RamStorage(Uri.parse("ram:///"))
+    resolver.register(Protocol.RAM, lambda uri: ram_root.subdir(uri))
+    return resolver
+
+
+def _embedded_node(args) -> Node:
+    config = load_node_config(getattr(args, "config", None))
+    return Node(config, storage_resolver=_resolver())
+
+
+def cmd_run(args) -> int:
+    from .serve.rest import RestServer
+    config = load_node_config(args.config)
+    node = Node(config, storage_resolver=_resolver())
+    server = RestServer(node)
+    server.start()
+    print(f"node {config.node_id} (roles: {','.join(config.roles)}) "
+          f"listening on http://{server.endpoint}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+def cmd_index_create(args) -> int:
+    node = _embedded_node(args)
+    index_config = load_index_config(args.index_config)
+    metadata = node.index_service.create_index(index_config)
+    print(json.dumps(metadata.to_dict(), indent=2))
+    return 0
+
+
+def cmd_index_list(args) -> int:
+    node = _embedded_node(args)
+    for metadata in node.metastore.list_indexes():
+        print(metadata.index_id)
+    return 0
+
+
+def cmd_index_describe(args) -> int:
+    node = _embedded_node(args)
+    metadata = node.metastore.index_metadata(args.index)
+    from .metastore.base import ListSplitsQuery
+    splits = node.metastore.list_splits(
+        ListSplitsQuery(index_uids=[metadata.index_uid]))
+    print(json.dumps({
+        "index": metadata.to_dict(),
+        "num_splits": len(splits),
+        "num_docs": sum(s.metadata.num_docs for s in splits),
+    }, indent=2))
+    return 0
+
+
+def cmd_index_delete(args) -> int:
+    node = _embedded_node(args)
+    removed = node.index_service.delete_index(args.index)
+    print(f"deleted index {args.index} ({len(removed)} split files removed)")
+    return 0
+
+
+def cmd_index_ingest(args) -> int:
+    node = _embedded_node(args)
+    if args.input_path:
+        stream = open(args.input_path, "rb")
+    else:
+        stream = sys.stdin.buffer
+    docs = []
+    total = {"num_docs_for_processing": 0, "num_ingested_docs": 0,
+             "num_invalid_docs": 0}
+    def flush():
+        if not docs:
+            return
+        result = node.ingest(args.index, docs, commit="force")
+        for key in total:
+            total[key] += result[key]
+        docs.clear()
+    for line in stream:
+        line = line.strip()
+        if line:
+            docs.append(json.loads(line))
+        if len(docs) >= args.batch_size:
+            flush()
+    flush()
+    if args.input_path:
+        stream.close()
+    print(json.dumps(total))
+    return 0
+
+
+def cmd_index_search(args) -> int:
+    from .query.parser import parse_query_string
+    from .search.models import SearchRequest, SortField
+    node = _embedded_node(args)
+    metadata = node.metastore.index_metadata(args.index)
+    default_fields = metadata.index_config.doc_mapper.default_search_fields
+    sort_fields: tuple[SortField, ...] = (SortField(),)
+    if args.sort_by:
+        field_name = args.sort_by.lstrip("-+")
+        if args.sort_order is not None:
+            order = args.sort_order
+        else:
+            order = "desc" if args.sort_by.startswith("-") else "asc"
+        sort_fields = (SortField(field_name, order),)
+    request = SearchRequest(
+        index_ids=[args.index],
+        query_ast=parse_query_string(args.query, default_fields),
+        max_hits=args.max_hits,
+        start_offset=args.start_offset,
+        sort_fields=sort_fields,
+        aggs=json.loads(args.aggs) if args.aggs else None,
+        start_timestamp=args.start_timestamp,
+        end_timestamp=args.end_timestamp,
+    )
+    response = node.root_searcher.search(request)
+    print(json.dumps(response.to_dict(), indent=2, default=str))
+    return 0
+
+
+def cmd_index_merge(args) -> int:
+    node = _embedded_node(args)
+    num_ops = node.run_merges(args.index)
+    print(f"executed {num_ops} merge operations")
+    return 0
+
+
+def cmd_split_list(args) -> int:
+    node = _embedded_node(args)
+    metadata = node.metastore.index_metadata(args.index)
+    from .metastore.base import ListSplitsQuery
+    splits = node.metastore.list_splits(
+        ListSplitsQuery(index_uids=[metadata.index_uid]))
+    print(json.dumps({"splits": [s.to_dict() for s in splits]}, indent=2))
+    return 0
+
+
+def cmd_tool_gc(args) -> int:
+    node = _embedded_node(args)
+    print(json.dumps(node.run_janitor()))
+    return 0
+
+
+def cmd_tool_extract_split(args) -> int:
+    import os
+    node = _embedded_node(args)
+    metadata = node.metastore.index_metadata(args.index)
+    storage = node.storage_resolver.resolve(metadata.index_config.index_uri)
+    os.makedirs(args.output_dir, exist_ok=True)
+    dest = os.path.join(args.output_dir, f"{args.split}.split")
+    storage.copy_to_file(f"{args.split}.split", dest)
+    print(f"extracted to {dest}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="quickwit-tpu",
+        description="TPU-native distributed search engine")
+    parser.add_argument("--config", help="node config yaml", default=None)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    run = sub.add_parser("run", help="start a node")
+    run.set_defaults(func=cmd_run)
+
+    index = sub.add_parser("index", help="index management")
+    index_sub = index.add_subparsers(dest="subcommand", required=True)
+    create = index_sub.add_parser("create")
+    create.add_argument("--index-config", required=True)
+    create.set_defaults(func=cmd_index_create)
+    lst = index_sub.add_parser("list")
+    lst.set_defaults(func=cmd_index_list)
+    describe = index_sub.add_parser("describe")
+    describe.add_argument("--index", required=True)
+    describe.set_defaults(func=cmd_index_describe)
+    delete = index_sub.add_parser("delete")
+    delete.add_argument("--index", required=True)
+    delete.set_defaults(func=cmd_index_delete)
+    ingest = index_sub.add_parser("ingest")
+    ingest.add_argument("--index", required=True)
+    ingest.add_argument("--input-path", default=None)
+    ingest.add_argument("--batch-size", type=int, default=100_000)
+    ingest.set_defaults(func=cmd_index_ingest)
+    search = index_sub.add_parser("search")
+    search.add_argument("--index", required=True)
+    search.add_argument("--query", required=True)
+    search.add_argument("--max-hits", type=int, default=20)
+    search.add_argument("--start-offset", type=int, default=0)
+    # `--sort-by=-field` for descending (leading dash needs the `=` form,
+    # or use --sort-order)
+    search.add_argument("--sort-by", default=None)
+    search.add_argument("--sort-order", choices=("asc", "desc"), default=None)
+    search.add_argument("--aggs", default=None)
+    search.add_argument("--start-timestamp", type=int, default=None)
+    search.add_argument("--end-timestamp", type=int, default=None)
+    search.set_defaults(func=cmd_index_search)
+    merge = index_sub.add_parser("merge")
+    merge.add_argument("--index", required=True)
+    merge.set_defaults(func=cmd_index_merge)
+
+    split = sub.add_parser("split", help="split management")
+    split_sub = split.add_subparsers(dest="subcommand", required=True)
+    split_list = split_sub.add_parser("list")
+    split_list.add_argument("--index", required=True)
+    split_list.set_defaults(func=cmd_split_list)
+
+    tool = sub.add_parser("tool", help="maintenance tools")
+    tool_sub = tool.add_subparsers(dest="subcommand", required=True)
+    gc = tool_sub.add_parser("gc")
+    gc.set_defaults(func=cmd_tool_gc)
+    extract = tool_sub.add_parser("extract-split")
+    extract.add_argument("--index", required=True)
+    extract.add_argument("--split", required=True)
+    extract.add_argument("--output-dir", required=True)
+    extract.set_defaults(func=cmd_tool_extract_split)
+    return parser
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.func(args)
+    except BrokenPipeError:
+        # output piped into a pager/head that closed early — not an error
+        try:
+            sys.stdout.close()
+        except Exception:  # noqa: BLE001
+            pass
+        return 0
+    except Exception as exc:  # noqa: BLE001 - CLI surface
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

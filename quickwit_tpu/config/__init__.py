@@ -1,0 +1,3 @@
+from .node_config import load_node_config, load_index_config
+
+__all__ = ["load_node_config", "load_index_config"]

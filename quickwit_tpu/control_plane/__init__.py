@@ -1,0 +1,3 @@
+from .scheduler import IndexingScheduler, IndexingTask, PhysicalIndexingPlan
+
+__all__ = ["IndexingScheduler", "IndexingTask", "PhysicalIndexingPlan"]

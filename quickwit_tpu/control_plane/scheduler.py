@@ -1,0 +1,99 @@
+"""Control-plane indexing scheduler.
+
+Role of the reference's `IndexingScheduler` + its 3-phase bin-packing solver
+(`quickwit-control-plane/src/indexing_scheduler/mod.rs:111,360`,
+`scheduling/scheduling_logic.rs`): turn the set of (index, source[, shard])
+logical indexing tasks into a `PhysicalIndexingPlan` assigning tasks to
+indexer nodes, preferring to keep a task where it already runs (affinity —
+the solver's phase-1 "conserve previous assignments"), balancing load by
+task weight, and re-converging when nodes or sources change. The reference's
+LP-style refinement phases collapse here into affinity-preserving greedy
+packing with a capacity bound — same invariants (every task placed, no node
+above capacity unless unavoidable), simpler mechanics.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class IndexingTask:
+    index_uid: str
+    source_id: str
+    shard_id: Optional[str] = None
+    weight: int = 1  # relative CPU weight (reference: load per pipeline)
+
+    @property
+    def key(self) -> tuple:
+        return (self.index_uid, self.source_id, self.shard_id)
+
+
+@dataclass
+class PhysicalIndexingPlan:
+    assignments: dict[str, list[IndexingTask]] = field(default_factory=dict)
+
+    def node_of(self, task: IndexingTask) -> Optional[str]:
+        for node_id, tasks in self.assignments.items():
+            if task in tasks:
+                return node_id
+        return None
+
+    def tasks_for(self, node_id: str) -> list[IndexingTask]:
+        return self.assignments.get(node_id, [])
+
+    @property
+    def num_tasks(self) -> int:
+        return sum(len(t) for t in self.assignments.values())
+
+
+class IndexingScheduler:
+    def __init__(self, max_load_factor: float = 1.2):
+        self.max_load_factor = max_load_factor
+        self.last_plan = PhysicalIndexingPlan()
+
+    def schedule(self, tasks: list[IndexingTask],
+                 indexer_nodes: list[str]) -> PhysicalIndexingPlan:
+        """Build the next physical plan; deterministic given inputs + the
+        previous plan (affinity)."""
+        if not indexer_nodes:
+            self.last_plan = PhysicalIndexingPlan()
+            return self.last_plan
+        nodes = sorted(indexer_nodes)
+        total_weight = sum(t.weight for t in tasks) or 1
+        capacity = (total_weight / len(nodes)) * self.max_load_factor
+        previous: dict[tuple, str] = {}
+        for node_id, node_tasks in self.last_plan.assignments.items():
+            for task in node_tasks:
+                previous[task.key] = node_id
+
+        load: dict[str, float] = {n: 0.0 for n in nodes}
+        plan = PhysicalIndexingPlan(assignments={n: [] for n in nodes})
+
+        # phase 1: keep tasks where they already run, capacity permitting
+        remaining: list[IndexingTask] = []
+        for task in sorted(tasks, key=lambda t: (-t.weight, t.key)):
+            prev_node = previous.get(task.key)
+            if prev_node in load and load[prev_node] + task.weight <= capacity:
+                plan.assignments[prev_node].append(task)
+                load[prev_node] += task.weight
+            else:
+                remaining.append(task)
+        # phase 2: place the rest on the least-loaded node
+        for task in remaining:
+            node_id = min(nodes, key=lambda n: (load[n], n))
+            plan.assignments[node_id].append(task)
+            load[node_id] += task.weight
+
+        plan.assignments = {n: t for n, t in plan.assignments.items() if t}
+        self.last_plan = plan
+        return plan
+
+    def plan_drift(self, running: dict[str, list[IndexingTask]]) -> bool:
+        """True if what nodes report running differs from the last plan
+        (the reference's periodic drift re-check, §3.4)."""
+        want = {n: sorted(t.key for t in ts)
+                for n, ts in self.last_plan.assignments.items()}
+        have = {n: sorted(t.key for t in ts) for n, ts in running.items() if ts}
+        return want != have

@@ -130,3 +130,42 @@ def make_source(source_type: str, params: dict[str, Any]) -> Source:
             f"source type {source_type!r} requires an external client SDK not "
             "available in this build; use 'file', 'vec', or the ingest API")
     raise ValueError(f"unknown source type {source_type!r}")
+
+
+class IngestSource(Source):
+    """WAL-shard source: streams records from the local Ingester's shards of
+    one (index, source) with per-shard checkpoint positions (reference:
+    `quickwit-indexing/src/source/ingest/mod.rs` reading ingester fetch
+    streams; partitions == shard queue ids, positions == WAL record
+    positions)."""
+
+    def __init__(self, ingester, index_uid: str, source_id: str):
+        self.ingester = ingester
+        self.index_uid = index_uid
+        self.source_id = source_id
+
+    def partition_ids(self) -> list[str]:
+        return [s.shard_id for s in self.ingester.list_shards(self.index_uid)
+                if s.source_id == self.source_id]
+
+    def batches(self, checkpoint: SourceCheckpoint,
+                batch_num_docs: int = 10_000) -> Iterator[SourceBatch]:
+        for shard in list(self.ingester.list_shards(self.index_uid)):
+            if shard.source_id != self.source_id:
+                continue
+            current = checkpoint.position_for(shard.shard_id)
+            start = 0 if current == BEGINNING else int(current)
+            from_pos = current
+            while True:
+                records = self.ingester.fetch(
+                    self.index_uid, self.source_id, shard.shard_id,
+                    from_position=start, max_records=batch_num_docs)
+                if not records:
+                    break
+                docs = [doc for _, doc in records]
+                last = records[-1][0]
+                delta = CheckpointDelta.from_range(
+                    shard.shard_id, from_pos, offset_position(last + 1))
+                yield SourceBatch(docs, delta)
+                start = last + 1
+                from_pos = offset_position(start)

@@ -1,0 +1,146 @@
+"""Ingester: hosts WAL shards.
+
+Role of the reference's `Ingester` (`quickwit-ingest/src/ingest_v2/
+ingester.rs:99`): persist doc batches durably into per-shard WAL queues,
+serve fetch streams to the indexing source, truncate behind published
+checkpoints, and recover shard state from disk on restart. Chained
+replication (RF>1, `replication.rs`) is stubbed at the `replicate_to`
+seam — the persist path invokes it for every batch so a follower client
+slots in without protocol changes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable, Optional
+
+from .wal import RecordLog
+
+
+class ShardState(str, Enum):
+    OPEN = "open"
+    CLOSED = "closed"  # no new writes; drains then gets deleted
+
+
+@dataclass
+class Shard:
+    index_uid: str
+    source_id: str
+    shard_id: str
+    log: RecordLog
+    state: ShardState = ShardState.OPEN
+    publish_position: int = 0  # truncation watermark
+
+
+def shard_queue_id(index_uid: str, source_id: str, shard_id: str) -> str:
+    return f"{index_uid.replace(':', '_')}/{source_id}/{shard_id}"
+
+
+class Ingester:
+    def __init__(self, wal_dir: str, fsync: bool = True,
+                 replicate_to: Optional[Callable[[str, list[bytes]], None]] = None):
+        self.wal_dir = wal_dir
+        self.fsync = fsync
+        self.replicate_to = replicate_to
+        self._shards: dict[str, Shard] = {}
+        self._lock = threading.Lock()
+        self._recover()
+
+    # --- recovery ----------------------------------------------------------
+    def _recover(self) -> None:
+        if not os.path.isdir(self.wal_dir):
+            return
+        for index_dir in os.listdir(self.wal_dir):
+            index_path = os.path.join(self.wal_dir, index_dir)
+            if not os.path.isdir(index_path):
+                continue
+            for source_id in os.listdir(index_path):
+                source_path = os.path.join(index_path, source_id)
+                for shard_id in os.listdir(source_path):
+                    queue_id = f"{index_dir}/{source_id}/{shard_id}"
+                    index_uid = index_dir.replace("_", ":", 1) \
+                        if "_" in index_dir else index_dir
+                    self._shards[queue_id] = Shard(
+                        index_uid=index_uid, source_id=source_id,
+                        shard_id=shard_id,
+                        log=RecordLog(os.path.join(source_path, shard_id),
+                                      fsync=self.fsync))
+
+    # --- shard lifecycle ---------------------------------------------------
+    def open_shard(self, index_uid: str, source_id: str, shard_id: str) -> Shard:
+        queue_id = shard_queue_id(index_uid, source_id, shard_id)
+        with self._lock:
+            shard = self._shards.get(queue_id)
+            if shard is None:
+                shard = Shard(
+                    index_uid=index_uid, source_id=source_id, shard_id=shard_id,
+                    log=RecordLog(os.path.join(self.wal_dir, queue_id),
+                                  fsync=self.fsync))
+                self._shards[queue_id] = shard
+            return shard
+
+    def close_shard(self, index_uid: str, source_id: str, shard_id: str) -> None:
+        shard = self._shards.get(shard_queue_id(index_uid, source_id, shard_id))
+        if shard is not None:
+            shard.state = ShardState.CLOSED
+
+    def list_shards(self, index_uid: Optional[str] = None) -> list[Shard]:
+        with self._lock:  # snapshot: persist/open_shard mutate concurrently
+            shards = list(self._shards.values())
+        return [s for s in shards
+                if index_uid is None or s.index_uid == index_uid]
+
+    def shard(self, index_uid: str, source_id: str, shard_id: str) -> Optional[Shard]:
+        return self._shards.get(shard_queue_id(index_uid, source_id, shard_id))
+
+    # --- persist / fetch / truncate ---------------------------------------
+    def persist(self, index_uid: str, source_id: str, shard_id: str,
+                docs: list[dict[str, Any]]) -> tuple[int, int]:
+        """Durable append of a doc batch; returns (first, last) positions
+        (reference: `ingester.rs:430,1117` persist)."""
+        shard = self.open_shard(index_uid, source_id, shard_id)
+        if shard.state is not ShardState.OPEN:
+            raise ValueError(f"shard {shard_id!r} is closed")
+        payloads = [json.dumps(d, separators=(",", ":")).encode() for d in docs]
+        first, last = shard.log.append_batch(payloads)
+        if self.replicate_to is not None:
+            self.replicate_to(shard_queue_id(index_uid, source_id, shard_id),
+                              payloads)
+        return first, last
+
+    def fetch(self, index_uid: str, source_id: str, shard_id: str,
+              from_position: int, max_records: int = 10_000
+              ) -> list[tuple[int, dict[str, Any]]]:
+        """Records from the WAL for the indexing source's fetch stream
+        (reference: `fetch.rs` FetchStreamTask)."""
+        shard = self.shard(index_uid, source_id, shard_id)
+        if shard is None:
+            return []
+        return [(pos, json.loads(payload))
+                for pos, payload in shard.log.read_from(from_position, max_records)]
+
+    def truncate(self, index_uid: str, source_id: str, shard_id: str,
+                 up_to_position: int) -> None:
+        """Reclaim WAL space behind the published checkpoint
+        (reference: TruncateShards / `shard_positions.rs`)."""
+        shard = self.shard(index_uid, source_id, shard_id)
+        if shard is not None:
+            shard.publish_position = max(shard.publish_position, up_to_position)
+            shard.log.truncate(up_to_position)
+
+    # --- observability ------------------------------------------------------
+    def shard_throughput_state(self) -> dict[str, dict[str, int]]:
+        """Per-shard positions for the control plane's capacity decisions
+        (reference: shard-capacity gossip broadcast)."""
+        with self._lock:
+            items = list(self._shards.items())
+        return {
+            queue_id: {"head": shard.log.next_position,
+                       "published": shard.publish_position,
+                       "open": int(shard.state is ShardState.OPEN)}
+            for queue_id, shard in items
+        }

@@ -1,0 +1,151 @@
+"""Segmented write-ahead record log.
+
+Role of the reference's `mrecordlog` crate (the WAL under ingest-v2 shards):
+an append-only, fsync'd, position-addressed record log with truncation.
+Records live in segment files (`wal-{first_position:020d}.seg`); truncation
+drops whole segments whose records are all below the truncate position —
+exactly how the indexer's published checkpoint reclaims WAL space.
+
+Record format per entry: u32 length | payload. Positions are record
+ordinals (not byte offsets), monotonically increasing across segments.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Iterator, Optional
+
+_SEGMENT_MAX_BYTES = 8 << 20
+_LEN = struct.Struct("<I")
+
+
+class RecordLog:
+    def __init__(self, directory: str, fsync: bool = True):
+        self.directory = directory
+        self.fsync = fsync
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        # segments: sorted list of (first_position, path)
+        self._segments: list[tuple[int, str]] = []
+        self._active_file = None
+        self._active_size = 0
+        self.next_position = 0
+        self._recover()
+
+    # --- recovery ----------------------------------------------------------
+    def _recover(self) -> None:
+        names = sorted(n for n in os.listdir(self.directory)
+                       if n.startswith("wal-") and n.endswith(".seg"))
+        for name in names:
+            first = int(name[4:-4])
+            self._segments.append((first, os.path.join(self.directory, name)))
+        if not self._segments:
+            return
+        # count records of the last segment to find next_position; earlier
+        # segments' record counts derive from their successors' first position
+        last_first, last_path = self._segments[-1]
+        count = sum(1 for _ in self._iter_segment(last_path))
+        self.next_position = last_first + count
+
+    # --- append ------------------------------------------------------------
+    def append(self, payload: bytes) -> int:
+        """Returns the position of the appended record."""
+        with self._lock:
+            if self._active_file is None or self._active_size > _SEGMENT_MAX_BYTES:
+                self._roll()
+            position = self.next_position
+            data = _LEN.pack(len(payload)) + payload
+            self._active_file.write(data)
+            self._active_file.flush()
+            if self.fsync:
+                os.fsync(self._active_file.fileno())
+            self._active_size += len(data)
+            self.next_position += 1
+            return position
+
+    def append_batch(self, payloads: list[bytes]) -> tuple[int, int]:
+        """(first_position, last_position) with a single fsync."""
+        if not payloads:
+            raise ValueError("empty batch")
+        with self._lock:
+            if self._active_file is None or self._active_size > _SEGMENT_MAX_BYTES:
+                self._roll()
+            first = self.next_position
+            chunks = []
+            for payload in payloads:
+                chunks.append(_LEN.pack(len(payload)))
+                chunks.append(payload)
+            data = b"".join(chunks)
+            self._active_file.write(data)
+            self._active_file.flush()
+            if self.fsync:
+                os.fsync(self._active_file.fileno())
+            self._active_size += len(data)
+            self.next_position += len(payloads)
+            return first, self.next_position - 1
+
+    def _roll(self) -> None:
+        if self._active_file is not None:
+            self._active_file.close()
+        path = os.path.join(self.directory, f"wal-{self.next_position:020d}.seg")
+        self._segments.append((self.next_position, path))
+        self._active_file = open(path, "ab")
+        self._active_size = os.path.getsize(path)
+
+    # --- read --------------------------------------------------------------
+    @staticmethod
+    def _iter_segment(path: str) -> Iterator[bytes]:
+        with open(path, "rb") as f:
+            while True:
+                header = f.read(_LEN.size)
+                if len(header) < _LEN.size:
+                    return
+                (length,) = _LEN.unpack(header)
+                payload = f.read(length)
+                if len(payload) < length:
+                    return  # torn tail write: ignore (crash recovery)
+                yield payload
+
+    def read_from(self, position: int, max_records: int = 10_000
+                  ) -> list[tuple[int, bytes]]:
+        """Records with position >= `position`, up to max_records."""
+        with self._lock:
+            segments = list(self._segments)
+        out: list[tuple[int, bytes]] = []
+        for i, (first, path) in enumerate(segments):
+            next_first = segments[i + 1][0] if i + 1 < len(segments) else None
+            if next_first is not None and next_first <= position:
+                continue
+            pos = first
+            for payload in self._iter_segment(path):
+                if pos >= position:
+                    out.append((pos, payload))
+                    if len(out) >= max_records:
+                        return out
+                pos += 1
+        return out
+
+    # --- truncate ----------------------------------------------------------
+    def truncate(self, up_to_position: int) -> int:
+        """Drop segments entirely below `up_to_position` (exclusive).
+        Returns number of segments removed."""
+        removed = 0
+        with self._lock:
+            while len(self._segments) > 1:
+                first, path = self._segments[0]
+                next_first = self._segments[1][0]
+                if next_first <= up_to_position:
+                    os.unlink(path)
+                    self._segments.pop(0)
+                    removed += 1
+                else:
+                    break
+        return removed
+
+    def close(self) -> None:
+        with self._lock:
+            if self._active_file is not None:
+                self._active_file.close()
+                self._active_file = None

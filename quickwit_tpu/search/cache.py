@@ -38,6 +38,7 @@ def canonical_request_key(
         "aggs": request.aggs,
         "start": start,
         "end": end,
+        "search_after": request.search_after,
     }
     digest = hashlib.blake2b(
         json.dumps(payload, sort_keys=True).encode(), digest_size=16).hexdigest()

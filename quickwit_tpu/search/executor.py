@@ -157,18 +157,38 @@ def _build(plan: LoweredPlan, k: int) -> Callable:
         mask = mask & mask_ops.valid_docs_mask(num_docs, padded)
         if scores is None:
             scores = jnp.zeros(padded, dtype=jnp.float32)
+        # unified higher-is-better f64 key (missing column values get the
+        # finite bottom sentinel, non-matching docs -inf)
         if sort.by == "score":
-            sort_vals, doc_ids, count = topk_ops.topk_by_score(scores, mask, k)
-            sort_vals = sort_vals.astype(jnp.float64)
+            keyed = jnp.where(mask, scores.astype(jnp.float64), -jnp.inf)
         elif sort.by == "column":
-            sort_vals, doc_ids, count = topk_ops.topk_by_value(
-                arrays[sort.values_slot], arrays[sort.present_slot], mask, k,
-                sort.descending)
-        else:  # "_doc" — sort_vals stay in higher-is-better key space
+            key = arrays[sort.values_slot].astype(jnp.float64)
+            if not sort.descending:
+                key = -key
+            has_value = mask & arrays[sort.present_slot].astype(jnp.bool_)
+            sentinel = jnp.float64(-1.7976931348623157e308)
+            keyed = jnp.where(has_value, key,
+                              jnp.where(mask, sentinel, -jnp.inf))
+        else:  # "_doc"
             key = jnp.arange(padded, dtype=jnp.float64)
-            key = jnp.where(mask, key if sort.descending else -key, -jnp.inf)
-            sort_vals, doc_ids = topk_ops.exact_topk(key, k)
-            count = jnp.sum(mask.astype(jnp.int32))
+            keyed = jnp.where(mask, key if sort.descending else -key, -jnp.inf)
+        # search_after pushdown: restrict top-k eligibility, NOT counts/aggs
+        # (ES semantics: totals and aggregations cover the full query)
+        if plan.search_after_relation != "none":
+            marker = scalars[plan.sa_value_slot]
+            if plan.search_after_relation == "lt":
+                eligible = keyed < marker
+            elif plan.search_after_relation == "le":
+                eligible = keyed <= marker
+            else:  # "lt_tie": same split as the marker
+                marker_doc = scalars[plan.sa_doc_slot]
+                docs = jnp.arange(padded, dtype=jnp.int32)
+                eligible = (keyed < marker) | ((keyed == marker) &
+                                               (docs > marker_doc))
+            keyed = jnp.where(eligible, keyed, -jnp.inf)
+        sort_vals, doc_ids = topk_ops.exact_topk(keyed, k)
+        doc_ids = doc_ids.astype(jnp.int32)
+        count = jnp.sum(mask.astype(jnp.int32))
         hit_scores = scores[jnp.clip(doc_ids, 0, padded - 1)]
         agg_out = []
         for a in aggs:

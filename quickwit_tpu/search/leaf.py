@@ -88,6 +88,8 @@ def leaf_search_single_split(
         sort_field=sort_field, sort_order=sort_order,
         start_timestamp=request.start_timestamp,
         end_timestamp=request.end_timestamp,
+        search_after=search_after_marker(request, split_id, sort_field,
+                                         sort_order),
     )
     device_arrays = warmup_device_arrays(reader, plan)
     result = execute_plan(plan, k, device_arrays)
@@ -98,6 +100,8 @@ def leaf_search_single_split(
     sort_is_int = _sort_values_are_int(doc_mapper, sort_field)
     for i in range(num_hits_returned):
         internal = float(result["sort_values"][i])
+        if internal == float("-inf"):
+            break  # fewer eligible hits than k (search_after pushdown)
         doc_id = int(result["doc_ids"][i])
         raw = decode_raw_sort_value(internal, sort_field, sort_order,
                                     sort_is_int, result["scores"][i], doc_id)
@@ -115,6 +119,35 @@ def leaf_search_single_split(
         intermediate_aggs=intermediate_aggs,
         resource_stats={"cpu_micros": elapsed},
     )
+
+
+def search_after_marker(request: SearchRequest, split_id: str,
+                        sort_field: str, sort_order: str):
+    """(internal_marker_value, relation, marker_doc) for this split, or None.
+
+    A hit qualifies iff key < m, or key == m and (split, doc) > (m_split,
+    m_doc); the split relation is static per split:
+      split < m_split  → strictly-less ("lt")
+      split == m_split → less-or-doc-tie ("lt_tie")
+      split > m_split  → less-or-equal ("le")
+    """
+    if not request.search_after:
+        return None
+    raw, m_split, m_doc = (request.search_after[0], str(request.search_after[1]),
+                           int(request.search_after[2]))
+    if raw is None:
+        internal = MISSING_VALUE_SENTINEL
+    elif sort_field == "_score":
+        internal = float(raw)
+    else:
+        internal = float(raw) if sort_order == "desc" else -float(raw)
+    if split_id < m_split:
+        relation = "lt"
+    elif split_id == m_split:
+        relation = "lt_tie"
+    else:
+        relation = "le"
+    return (internal, relation, m_doc)
 
 
 def _sort_values_are_int(doc_mapper: DocMapper, sort_field: str) -> bool:

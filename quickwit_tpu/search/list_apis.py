@@ -1,0 +1,71 @@
+"""list_terms / list_fields.
+
+Roles of the reference's `list_terms.rs` and `list_fields/mod.rs`: enumerate
+index terms of a field across splits (range-bounded, limited) and describe
+the queryable fields of one or more indexes.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Any, Optional
+
+from ..metastore.base import ListSplitsQuery, Metastore
+from ..models.split_metadata import SplitState
+from .service import SearcherContext
+from .models import SplitIdAndFooter
+
+
+def leaf_list_terms(context: SearcherContext, splits: list[SplitIdAndFooter],
+                    field: str, start_key: Optional[str] = None,
+                    end_key: Optional[str] = None, max_terms: int = 100
+                    ) -> list[str]:
+    """Merged sorted unique terms of `field` across the given splits."""
+    iterators = []
+    for split in splits:
+        reader = context.reader(split)
+        term_dict = reader.term_dict(field)
+        if term_dict is None:
+            continue
+        iterators.append(
+            (term for term, _df in term_dict.iter_terms(start=start_key)))
+    out: list[str] = []
+    for term in heapq.merge(*iterators):
+        if end_key is not None and term >= end_key:
+            break
+        if out and out[-1] == term:
+            continue
+        out.append(term)
+        if len(out) >= max_terms:
+            break
+    return out
+
+
+def root_list_terms(metastore: Metastore, context: SearcherContext,
+                    index_id: str, field: str,
+                    start_key: Optional[str] = None,
+                    end_key: Optional[str] = None,
+                    max_terms: int = 100) -> list[str]:
+    metadata = metastore.index_metadata(index_id)
+    splits = metastore.list_splits(ListSplitsQuery(
+        index_uids=[metadata.index_uid], states=[SplitState.PUBLISHED]))
+    offsets = [SplitIdAndFooter(split_id=s.metadata.split_id,
+                                storage_uri=metadata.index_config.index_uri)
+               for s in splits]
+    return leaf_list_terms(context, offsets, field, start_key, end_key, max_terms)
+
+
+def list_fields(metastore: Metastore, index_patterns: list[str]) -> list[dict[str, Any]]:
+    """Queryable fields across matching indexes (reference list_fields)."""
+    import fnmatch
+    out: dict[str, dict[str, Any]] = {}
+    for metadata in metastore.list_indexes():
+        if not any(fnmatch.fnmatch(metadata.index_id, p) for p in index_patterns):
+            continue
+        for fm in metadata.index_config.doc_mapper.field_mappings:
+            entry = out.setdefault(fm.name, {
+                "field_name": fm.name, "field_type": fm.type.value,
+                "searchable": fm.indexed, "aggregatable": fm.fast,
+                "index_ids": []})
+            entry["index_ids"].append(metadata.index_id)
+    return sorted(out.values(), key=lambda e: e["field_name"])

@@ -195,13 +195,18 @@ class LoweredPlan:
     scalars: list[np.ndarray]         # traced scalar inputs, slot-indexed
     num_docs: int
     num_docs_padded: int
+    # search_after pushdown: "none" | "lt" | "lt_tie" | "le" (static; the
+    # marker value/doc travel as trailing traced scalars)
+    search_after_relation: str = "none"
+    sa_value_slot: int = -1
+    sa_doc_slot: int = -1
 
     def signature(self, k: int) -> tuple:
         shapes = tuple((a.shape, str(a.dtype)) for a in self.arrays)
         scalar_dtypes = tuple(str(s.dtype) for s in self.scalars)
         agg_sig = ",".join(a.sig() for a in self.aggs)
         return (self.root.sig(), self.sort.sig(), agg_sig, shapes, scalar_dtypes,
-                k, self.num_docs_padded)
+                k, self.num_docs_padded, self.search_after_relation)
 
 
 class _Builder:
@@ -742,6 +747,7 @@ def lower_request(
     start_timestamp: Optional[int] = None,
     end_timestamp: Optional[int] = None,
     batch_overrides: Optional[dict] = None,
+    search_after: Optional[tuple] = None,  # (internal_value, relation, doc_id)
 ) -> LoweredPlan:
     """Full request lowering: query + request-level time filter + sort + aggs."""
     low = Lowering(doc_mapper, reader, batch_overrides)
@@ -760,8 +766,15 @@ def lower_request(
         root = PBool(must=(root,), filter=(ts_node,))
     sort = low.lower_sort(sort_field, sort_order)
     aggs = [low.lower_agg(spec) for spec in agg_specs]
+    sa_relation, sa_value_slot, sa_doc_slot = "none", -1, -1
+    if search_after is not None:
+        sa_value, sa_relation, sa_doc = search_after
+        sa_value_slot = low.b.add_scalar(float(sa_value), np.float64)
+        sa_doc_slot = low.b.add_scalar(int(sa_doc), np.int32)
     return LoweredPlan(
         root=root, sort=sort, aggs=aggs,
         arrays=low.b.arrays, array_keys=low.b.array_keys, scalars=low.b.scalars,
         num_docs=reader.num_docs, num_docs_padded=reader.num_docs_padded,
+        search_after_relation=sa_relation,
+        sa_value_slot=sa_value_slot, sa_doc_slot=sa_doc_slot,
     )

@@ -108,7 +108,8 @@ class SearchService:
         return response
 
     def _search_group(self, group, doc_mapper, search_request, collector) -> None:
-        if len(group) > 1:
+        # the batch path has no search_after pushdown; per-split handles it
+        if len(group) > 1 and not search_request.search_after:
             try:
                 readers = [self.context.reader(s) for s in group]
                 batch = build_batch(search_request, doc_mapper, readers,

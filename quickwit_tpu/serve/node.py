@@ -43,6 +43,8 @@ class NodeConfig:
     rest_host: str = "127.0.0.1"
     rest_port: int = 7280
     peers: tuple[str, ...] = ()  # "host:port" seeds
+    data_dir: Optional[str] = None  # WAL + scratch; tmp dir when unset
+    wal_fsync: bool = True
 
 
 class IndexService:
@@ -136,6 +138,22 @@ class Node:
             nodes_provider=lambda: self.cluster.nodes_with_role("searcher"))
         self.cluster.subscribe(self._on_cluster_change)
         self._lock = threading.Lock()
+        # ingest v2: WAL-backed write path (router -> ingester shards)
+        import os
+        import tempfile
+        from ..ingest.ingester import Ingester
+        from ..ingest.router import IngestRouter
+        data_dir = config.data_dir or tempfile.mkdtemp(prefix="qwt-data-")
+        self.data_dir = data_dir
+        self.ingester = Ingester(os.path.join(data_dir, "wal"),
+                                 fsync=config.wal_fsync)
+        self.ingest_router = IngestRouter(self.ingester)
+        from ..control_plane.scheduler import IndexingScheduler
+        self.indexing_scheduler = IndexingScheduler()
+        from ..search.scroll import ScrollStore
+        self.scroll_store = ScrollStore()
+        from .otel import OtelService
+        self.otel = OtelService(self)
 
     # ------------------------------------------------------------------
     def _on_cluster_change(self, change: ClusterChange) -> None:
@@ -173,6 +191,63 @@ class Node:
                 "num_invalid_docs": counters.num_docs_invalid}
 
     # ------------------------------------------------------------------
+    def ingest_v2(self, index_id: str, docs: list[dict]) -> dict[str, Any]:
+        """Durable WAL ingest (v2 path): docs are fsync'd into shard queues
+        and become searchable after the next ingest pipeline pass."""
+        metadata = self.metastore.index_metadata(index_id)
+        return self.ingest_router.ingest(metadata.index_uid, docs)
+
+    def run_ingest_pass(self, index_id: str) -> dict[str, Any]:
+        """Drain WAL shards into splits, publish, truncate behind the
+        published checkpoint (the decoupled indexer side of ingest v2)."""
+        from ..indexing.sources import IngestSource
+        from ..ingest.router import INGEST_V2_SOURCE_ID
+        metadata = self.metastore.index_metadata(index_id)
+        uid = metadata.index_uid
+        if INGEST_V2_SOURCE_ID not in metadata.sources:
+            self.metastore.add_source(
+                uid, SourceConfig(INGEST_V2_SOURCE_ID, "ingest"))
+        source = IngestSource(self.ingester, uid, INGEST_V2_SOURCE_ID)
+        params = PipelineParams(
+            index_uid=uid, source_id=INGEST_V2_SOURCE_ID,
+            node_id=self.config.node_id,
+            split_num_docs_target=metadata.index_config.split_num_docs_target)
+        pipeline = IndexingPipeline(
+            params, metadata.index_config.doc_mapper, source, self.metastore,
+            self.storage_resolver.resolve(metadata.index_config.index_uri))
+        counters = pipeline.run_to_completion()
+        # truncate WAL behind the (now durable) published checkpoint
+        checkpoint = self.metastore.source_checkpoint(uid, INGEST_V2_SOURCE_ID)
+        from ..metastore.checkpoint import BEGINNING
+        for shard in self.ingester.list_shards(uid):
+            position = checkpoint.position_for(shard.shard_id)
+            if position != BEGINNING:
+                self.ingester.truncate(uid, INGEST_V2_SOURCE_ID,
+                                       shard.shard_id, int(position))
+        return {"num_docs_indexed": counters.num_docs_processed,
+                "num_splits_published": counters.num_splits_published}
+
+    def schedule_indexing(self) -> "Any":
+        """Control-plane convergence pass: logical tasks from metastore
+        sources/shards → physical plan over live indexer nodes (§3.4)."""
+        from ..control_plane.scheduler import IndexingTask
+        tasks = []
+        for metadata in self.metastore.list_indexes():
+            for source_id, source in metadata.sources.items():
+                if not source.enabled or source.source_type == "void":
+                    continue
+                shards = [s for s in self.ingester.list_shards(metadata.index_uid)
+                          if s.source_id == source_id]
+                if shards:
+                    tasks.extend(IndexingTask(metadata.index_uid, source_id,
+                                              shard_id=s.shard_id)
+                                 for s in shards)
+                else:
+                    tasks.append(IndexingTask(metadata.index_uid, source_id))
+        indexers = self.cluster.nodes_with_role("indexer")
+        return self.indexing_scheduler.schedule(tasks, indexers)
+
+    # ------------------------------------------------------------------
     def run_merges(self, index_id: str) -> int:
         """One merge-planner pass (role of MergePlanner + MergePipeline)."""
         metadata = self.metastore.index_metadata(index_id)
@@ -191,6 +266,58 @@ class Node:
         for operation in operations:
             executor.execute(operation, delete_query_asts=delete_asts or None)
         return len(operations)
+
+    # ------------------------------------------------------------------
+    def start_scroll(self, request, ttl_secs: float) -> dict[str, Any]:
+        """First page + scroll id (reference scroll flow, scroll.md)."""
+        from dataclasses import replace
+        from ..search.scroll import CACHE_WINDOW, ScrollContext
+        page_size = request.max_hits
+        window_request = replace(request,
+                                 max_hits=max(CACHE_WINDOW, page_size),
+                                 start_offset=0)
+        response = self.root_searcher.search(window_request)
+        context = ScrollContext(
+            request=request, cached_hits=response.hits,
+            cursor=min(page_size, len(response.hits)),
+            total_hits=response.num_hits, ttl_secs=ttl_secs)
+        scroll_id = self.scroll_store.put(context)
+        page = response.to_dict()
+        page["hits"] = page["hits"][:page_size]
+        page["scroll_id"] = scroll_id
+        return page
+
+    def continue_scroll(self, scroll_id: str) -> dict[str, Any]:
+        from dataclasses import replace
+        context = self.scroll_store.get(scroll_id)
+        if context is None:
+            raise ValueError("scroll id not found or expired")
+        page_size = context.request.max_hits
+        hits = context.cached_hits
+        if context.cursor >= len(hits) and len(hits) < context.total_hits and hits:
+            # refill the window via search_after from the last cached hit
+            from ..search.scroll import CACHE_WINDOW
+            last = hits[-1]
+            sort_value = last.sort_values[0] if last.sort_values else last.score
+            refill_request = replace(
+                context.request, start_offset=0, max_hits=CACHE_WINDOW,
+                search_after=[sort_value, last.split_id, last.doc_id])
+            response = self.root_searcher.search(refill_request)
+            hits.extend(response.hits)
+        page_hits = hits[context.cursor: context.cursor + page_size]
+        context.cursor += len(page_hits)
+        return {
+            "num_hits": context.total_hits,
+            "hits": [
+                {"doc": h.doc, "score": h.score, "sort_values": h.sort_values,
+                 "split_id": h.split_id, "doc_id": h.doc_id}
+                for h in page_hits
+            ],
+            "scroll_id": scroll_id,
+            "elapsed_time_micros": 0,
+            "errors": [],
+            "aggregations": None,
+        }
 
     # ------------------------------------------------------------------
     def run_janitor(self) -> dict[str, int]:

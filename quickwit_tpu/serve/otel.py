@@ -1,0 +1,211 @@
+"""OTLP ingestion + Jaeger-style trace query API.
+
+Roles of the reference's `quickwit-opentelemetry` (`otlp/logs.rs:202`,
+`otlp/traces.rs:653`) and `quickwit-jaeger` (`lib.rs:78`): accept OTLP
+JSON payloads for logs and traces into well-known indexes
+(`otel-logs-v0`, `otel-traces-v0`, auto-created with the reference's doc
+mappings), and answer Jaeger HTTP queries (services, operations, trace
+lookup, trace search) by translating them into searches — trace search uses
+the trace-id collection pattern of `find_trace_ids_collector.rs` (terms over
+trace ids ordered by max span timestamp).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+OTEL_LOGS_INDEX = "otel-logs-v0"
+OTEL_TRACES_INDEX = "otel-traces-v0"
+
+OTEL_LOGS_CONFIG = {
+    "index_id": OTEL_LOGS_INDEX,
+    "doc_mapping": {
+        "field_mappings": [
+            {"name": "timestamp", "type": "datetime", "fast": True,
+             "input_formats": ["unix_timestamp", "rfc3339"]},
+            {"name": "severity_text", "type": "text", "tokenizer": "raw", "fast": True},
+            {"name": "severity_number", "type": "i64", "fast": True},
+            {"name": "service_name", "type": "text", "tokenizer": "raw", "fast": True},
+            {"name": "body", "type": "text", "record": "position"},
+            {"name": "trace_id", "type": "text", "tokenizer": "raw"},
+            {"name": "span_id", "type": "text", "tokenizer": "raw"},
+        ],
+        "timestamp_field": "timestamp",
+        "default_search_fields": ["body"],
+        "mode": "lenient",
+    },
+}
+
+OTEL_TRACES_CONFIG = {
+    "index_id": OTEL_TRACES_INDEX,
+    "doc_mapping": {
+        "field_mappings": [
+            {"name": "span_start_timestamp", "type": "datetime", "fast": True,
+             "input_formats": ["unix_timestamp"]},
+            {"name": "trace_id", "type": "text", "tokenizer": "raw", "fast": True},
+            {"name": "span_id", "type": "text", "tokenizer": "raw"},
+            {"name": "parent_span_id", "type": "text", "tokenizer": "raw"},
+            {"name": "service_name", "type": "text", "tokenizer": "raw", "fast": True},
+            {"name": "span_name", "type": "text", "tokenizer": "raw", "fast": True},
+            {"name": "span_duration_micros", "type": "i64", "fast": True},
+            {"name": "span_status", "type": "text", "tokenizer": "raw", "fast": True},
+        ],
+        "timestamp_field": "span_start_timestamp",
+        "default_search_fields": ["span_name"],
+        "mode": "lenient",
+    },
+}
+
+
+def _nanos_to_seconds(value) -> float:
+    return int(value) / 1e9
+
+
+def _attr_map(attributes: list[dict[str, Any]]) -> dict[str, Any]:
+    out = {}
+    for attr in attributes or []:
+        value = attr.get("value", {})
+        out[attr.get("key", "")] = (
+            value.get("stringValue") or value.get("intValue")
+            or value.get("doubleValue") or value.get("boolValue"))
+    return out
+
+
+def otlp_logs_to_docs(payload: dict[str, Any]) -> list[dict[str, Any]]:
+    """OTLP JSON `resourceLogs` → log docs (reference `otlp/logs.rs`)."""
+    docs = []
+    for resource_logs in payload.get("resourceLogs", []):
+        resource_attrs = _attr_map(
+            resource_logs.get("resource", {}).get("attributes", []))
+        service = resource_attrs.get("service.name", "unknown_service")
+        for scope_logs in resource_logs.get("scopeLogs", []):
+            for record in scope_logs.get("logRecords", []):
+                body = record.get("body", {})
+                docs.append({
+                    "timestamp": _nanos_to_seconds(
+                        record.get("timeUnixNano")
+                        or record.get("observedTimeUnixNano") or 0),
+                    "severity_text": record.get("severityText", ""),
+                    "severity_number": record.get("severityNumber", 0),
+                    "service_name": service,
+                    "body": body.get("stringValue", "") if isinstance(body, dict)
+                    else str(body),
+                    "trace_id": record.get("traceId", ""),
+                    "span_id": record.get("spanId", ""),
+                    "attributes": _attr_map(record.get("attributes", [])),
+                })
+    return docs
+
+
+def otlp_traces_to_docs(payload: dict[str, Any]) -> list[dict[str, Any]]:
+    """OTLP JSON `resourceSpans` → span docs (reference `otlp/traces.rs`)."""
+    docs = []
+    for resource_spans in payload.get("resourceSpans", []):
+        resource_attrs = _attr_map(
+            resource_spans.get("resource", {}).get("attributes", []))
+        service = resource_attrs.get("service.name", "unknown_service")
+        for scope_spans in resource_spans.get("scopeSpans", []):
+            for span in scope_spans.get("spans", []):
+                start_nanos = int(span.get("startTimeUnixNano", 0))
+                end_nanos = int(span.get("endTimeUnixNano", start_nanos))
+                docs.append({
+                    "span_start_timestamp": start_nanos / 1e9,
+                    "trace_id": span.get("traceId", ""),
+                    "span_id": span.get("spanId", ""),
+                    "parent_span_id": span.get("parentSpanId", ""),
+                    "service_name": service,
+                    "span_name": span.get("name", ""),
+                    "span_duration_micros": max((end_nanos - start_nanos) // 1000, 0),
+                    "span_status": (span.get("status", {}) or {}).get("code", "unset"),
+                    "attributes": _attr_map(span.get("attributes", [])),
+                })
+    return docs
+
+
+class OtelService:
+    """Glue: auto-create otel indexes, ingest OTLP payloads, answer
+    Jaeger-style queries via the root searcher."""
+
+    def __init__(self, node):
+        self.node = node
+
+    def _ensure_index(self, config: dict[str, Any]) -> None:
+        from ..metastore.base import MetastoreError
+        try:
+            self.node.metastore.index_metadata(config["index_id"])
+        except MetastoreError:
+            self.node.index_service.create_index(config)
+
+    def ingest_logs(self, payload: dict[str, Any]) -> dict[str, Any]:
+        self._ensure_index(OTEL_LOGS_CONFIG)
+        docs = otlp_logs_to_docs(payload)
+        return self.node.ingest(OTEL_LOGS_INDEX, docs)
+
+    def ingest_traces(self, payload: dict[str, Any]) -> dict[str, Any]:
+        self._ensure_index(OTEL_TRACES_CONFIG)
+        docs = otlp_traces_to_docs(payload)
+        return self.node.ingest(OTEL_TRACES_INDEX, docs)
+
+    # --- Jaeger-style reads ------------------------------------------------
+    def services(self) -> list[str]:
+        from ..query.ast import MatchAll
+        from ..search.models import SearchRequest
+        response = self.node.root_searcher.search(SearchRequest(
+            index_ids=[OTEL_TRACES_INDEX], query_ast=MatchAll(), max_hits=0,
+            aggs={"services": {"terms": {"field": "service_name", "size": 1000}}}))
+        return sorted(b["key"] for b in
+                      response.aggregations["services"]["buckets"])
+
+    def operations(self, service: str) -> list[str]:
+        from ..query.ast import Term
+        from ..search.models import SearchRequest
+        response = self.node.root_searcher.search(SearchRequest(
+            index_ids=[OTEL_TRACES_INDEX],
+            query_ast=Term("service_name", service), max_hits=0,
+            aggs={"ops": {"terms": {"field": "span_name", "size": 1000}}}))
+        return sorted(b["key"] for b in response.aggregations["ops"]["buckets"])
+
+    def get_trace(self, trace_id: str) -> list[dict[str, Any]]:
+        from ..query.ast import Term
+        from ..search.models import SearchRequest, SortField
+        response = self.node.root_searcher.search(SearchRequest(
+            index_ids=[OTEL_TRACES_INDEX],
+            query_ast=Term("trace_id", trace_id), max_hits=1000,
+            sort_fields=(SortField("span_start_timestamp", "asc"),)))
+        return [h.doc for h in response.hits]
+
+    def find_traces(self, service: Optional[str] = None,
+                    operation: Optional[str] = None,
+                    min_duration_micros: Optional[int] = None,
+                    start_timestamp: Optional[int] = None,
+                    end_timestamp: Optional[int] = None,
+                    limit: int = 20) -> list[str]:
+        """Trace ids of matching spans, most-recent first (the
+        FindTraceIdsAggregation role: newest max-span-timestamp per trace)."""
+        from ..query.ast import Bool, MatchAll, Range, RangeBound, Term
+        from ..search.models import SearchRequest, SortField
+        must = []
+        if service:
+            must.append(Term("service_name", service))
+        if operation:
+            must.append(Term("span_name", operation))
+        filters = []
+        if min_duration_micros is not None:
+            filters.append(Range("span_duration_micros",
+                                 lower=RangeBound(min_duration_micros, True)))
+        ast = Bool(must=tuple(must), filter=tuple(filters)) \
+            if (must or filters) else MatchAll()
+        response = self.node.root_searcher.search(SearchRequest(
+            index_ids=[OTEL_TRACES_INDEX], query_ast=ast,
+            max_hits=limit * 10,
+            sort_fields=(SortField("span_start_timestamp", "desc"),),
+            start_timestamp=start_timestamp, end_timestamp=end_timestamp))
+        seen: list[str] = []
+        for hit in response.hits:
+            trace_id = hit.doc.get("trace_id")
+            if trace_id and trace_id not in seen:
+                seen.append(trace_id)
+                if len(seen) >= limit:
+                    break
+        return seen

@@ -191,9 +191,64 @@ class RestServer:
         m = re.fullmatch(r"/api/v1/([^/_][^/]*)/ingest", path)
         if m and method == "POST":
             docs = _parse_ndjson(body)
+            if params.get("commit") == "wal":
+                # v2 path: durable WAL append, indexed by the next ingest pass
+                return 200, node.ingest_v2(m.group(1), docs)
             result = node.ingest(m.group(1), docs,
                                  commit=params.get("commit", "auto"))
             return 200, result
+
+        # --- otlp / jaeger --------------------------------------------
+        if path == "/api/v1/otlp/v1/logs" and method == "POST":
+            return 200, node.otel.ingest_logs(json.loads(body))
+        if path == "/api/v1/otlp/v1/traces" and method == "POST":
+            return 200, node.otel.ingest_traces(json.loads(body))
+        if path == "/api/v1/jaeger/api/services":
+            return 200, {"data": node.otel.services(), "total": 0}
+        m = re.fullmatch(r"/api/v1/jaeger/api/services/([^/]+)/operations", path)
+        if m:
+            return 200, {"data": node.otel.operations(m.group(1)), "total": 0}
+        m = re.fullmatch(r"/api/v1/jaeger/api/traces/([^/]+)", path)
+        if m:
+            spans = node.otel.get_trace(m.group(1))
+            if not spans:
+                raise ApiError(404, f"trace {m.group(1)!r} not found")
+            return 200, {"data": [{"traceID": m.group(1), "spans": spans}]}
+        if path == "/api/v1/jaeger/api/traces":
+            trace_ids = node.otel.find_traces(
+                service=params.get("service"),
+                operation=params.get("operation"),
+                min_duration_micros=int(params["minDuration"])
+                if params.get("minDuration") else None,
+                limit=int(params.get("limit", 20)))
+            return 200, {"data": [{"traceID": t,
+                                   "spans": node.otel.get_trace(t)}
+                                  for t in trace_ids]}
+
+        # --- scroll / list apis ---------------------------------------
+        if path == "/api/v1/scroll":
+            scroll_id = params.get("scroll_id")
+            if scroll_id is None and body:
+                scroll_id = json.loads(body).get("scroll_id")
+            if not scroll_id:
+                raise ApiError(400, "missing scroll_id")
+            return 200, node.continue_scroll(scroll_id)
+        m = re.fullmatch(r"/api/v1/([^/_][^/]*)/list-terms", path)
+        if m:
+            from ..search.list_apis import root_list_terms
+            if "field" not in params:
+                raise ApiError(400, "missing field parameter")
+            terms = root_list_terms(
+                node.metastore, node.search_service.context, m.group(1),
+                params["field"], start_key=params.get("start_key"),
+                end_key=params.get("end_key"),
+                max_terms=int(params.get("max_terms", 100)))
+            return 200, {"terms": terms}
+        m = re.fullmatch(r"/api/v1/([^/]+)/fields", path)
+        if m:
+            from ..search.list_apis import list_fields
+            return 200, {"fields": list_fields(node.metastore,
+                                               m.group(1).split(","))}
         # --- search ----------------------------------------------------
         m = re.fullmatch(r"/api/v1/([^/_][^/]*)/search(?:/stream)?", path)
         if m:
@@ -205,6 +260,9 @@ class RestServer:
                 params = {**params, **payload}
             default_fields = self._default_fields(index_id)
             request = _search_request_from_params(index_id, params, default_fields)
+            if params.get("scroll"):
+                ttl = _parse_scroll_ttl(params["scroll"])
+                return 200, node.start_scroll(request, ttl)
             response = node.root_searcher.search(request)
             return 200, _search_response_to_json(response)
 
@@ -361,6 +419,14 @@ class RestServer:
                         entry["status"] = 404
                         entry["error"] = str(exc)
         return {"errors": errors, "items": items}
+
+
+def _parse_scroll_ttl(text: str) -> float:
+    text = text.strip()
+    units = {"s": 1, "m": 60, "h": 3600}
+    if text and text[-1] in units:
+        return float(text[:-1]) * units[text[-1]]
+    return float(text)
 
 
 def _parse_ndjson(body: bytes) -> list[dict]:

@@ -1,0 +1,137 @@
+"""CLI + config loading tests."""
+
+import json
+import os
+
+import pytest
+
+from quickwit_tpu.cli import main
+from quickwit_tpu.config import load_index_config, load_node_config
+from quickwit_tpu.config.node_config import interpolate_env
+
+INDEX_YAML = """
+version: 0.8
+index_id: cli-logs
+doc_mapping:
+  field_mappings:
+    - name: ts
+      type: datetime
+      fast: true
+      input_formats: [unix_timestamp]
+    - name: body
+      type: text
+    - name: resource
+      type: object
+      field_mappings:
+        - name: service
+          type: text
+          tokenizer: raw
+  timestamp_field: ts
+  default_search_fields: [body]
+indexing_settings:
+  split_num_docs_target: 100
+"""
+
+
+def test_interpolate_env():
+    env = {"FOO": "bar"}
+    assert interpolate_env("x-${FOO}-y", env) == "x-bar-y"
+    assert interpolate_env("${MISSING:-default}", env) == "default"
+    with pytest.raises(ValueError):
+        interpolate_env("${MISSING}", env)
+
+
+def test_load_node_config(tmp_path):
+    config_path = tmp_path / "node.yaml"
+    config_path.write_text(
+        "node_id: cfg-node\n"
+        "metastore_uri: ${QW_TEST_MS:-ram:///cfg/ms}\n"
+        "enabled_services: searcher,indexer\n"
+        "rest:\n  listen_port: 9999\n")
+    config = load_node_config(str(config_path), env={})
+    assert config.node_id == "cfg-node"
+    assert config.metastore_uri == "ram:///cfg/ms"
+    assert config.roles == ("searcher", "indexer")
+    assert config.rest_port == 9999
+    # env wins over file
+    config2 = load_node_config(str(config_path), env={"QW_NODE_ID": "env-node"})
+    assert config2.node_id == "env-node"
+
+
+def test_load_index_config_flattens_objects(tmp_path):
+    path = tmp_path / "index.yaml"
+    path.write_text(INDEX_YAML)
+    config = load_index_config(str(path))
+    names = [f["name"] for f in config["doc_mapping"]["field_mappings"]]
+    assert "resource.service" in names
+    assert config["index_id"] == "cli-logs"
+
+
+@pytest.fixture
+def cli_env(tmp_path, monkeypatch):
+    """Embedded-node CLI working over a local-FS metastore."""
+    node_yaml = tmp_path / "node.yaml"
+    node_yaml.write_text(
+        f"node_id: cli-node\n"
+        f"metastore_uri: file://{tmp_path}/metastore\n"
+        f"default_index_root_uri: file://{tmp_path}/indexes\n")
+    index_yaml = tmp_path / "index.yaml"
+    index_yaml.write_text(INDEX_YAML)
+    docs_path = tmp_path / "docs.ndjson"
+    with open(docs_path, "w") as f:
+        for i in range(250):
+            f.write(json.dumps({
+                "ts": 1_600_000_000 + i,
+                "body": f"cli event {i}",
+                "resource": {"service": ["web", "db"][i % 2]},
+            }) + "\n")
+    return str(node_yaml), str(index_yaml), str(docs_path), tmp_path
+
+
+def run_cli(node_yaml, *argv):
+    return main(["--config", node_yaml, *argv])
+
+
+def test_cli_end_to_end(cli_env, capsys):
+    node_yaml, index_yaml, docs_path, tmp_path = cli_env
+    assert run_cli(node_yaml, "index", "create", "--index-config", index_yaml) == 0
+    capsys.readouterr()
+    assert run_cli(node_yaml, "index", "list") == 0
+    assert "cli-logs" in capsys.readouterr().out
+
+    assert run_cli(node_yaml, "index", "ingest", "--index", "cli-logs",
+                   "--input-path", docs_path) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["num_ingested_docs"] == 250
+
+    assert run_cli(node_yaml, "index", "search", "--index", "cli-logs",
+                   "--query", "resource.service:web", "--max-hits", "3") == 0
+    result = json.loads(capsys.readouterr().out)
+    assert result["num_hits"] == 125
+
+    assert run_cli(node_yaml, "split", "list", "--index", "cli-logs") == 0
+    splits = json.loads(capsys.readouterr().out)["splits"]
+    assert sum(s["metadata"]["num_docs"] for s in splits) == 250
+    assert len(splits) == 3  # split target 100
+
+    assert run_cli(node_yaml, "index", "merge", "--index", "cli-logs") == 0
+    capsys.readouterr()
+
+    assert run_cli(node_yaml, "index", "describe", "--index", "cli-logs") == 0
+    described = json.loads(capsys.readouterr().out)
+    assert described["num_docs"] == 250
+
+    out_dir = str(tmp_path / "extracted")
+    split_id = splits[0]["metadata"]["split_id"]
+    assert run_cli(node_yaml, "tool", "extract-split", "--index", "cli-logs",
+                   "--split", split_id, "--output-dir", out_dir) == 0
+    capsys.readouterr()
+    assert os.path.exists(os.path.join(out_dir, f"{split_id}.split"))
+
+    assert run_cli(node_yaml, "index", "delete", "--index", "cli-logs") == 0
+
+
+def test_cli_error_surface(cli_env, capsys):
+    node_yaml, *_ = cli_env
+    assert run_cli(node_yaml, "index", "describe", "--index", "missing") == 1
+    assert "error:" in capsys.readouterr().err

@@ -1,0 +1,156 @@
+"""WAL ingest (v2 path): record log durability, shards, router,
+drain-to-splits, truncation, crash recovery."""
+
+import os
+
+import pytest
+
+from quickwit_tpu.ingest import Ingester, IngestRouter, RecordLog
+from quickwit_tpu.ingest.router import INGEST_V2_SOURCE_ID
+from quickwit_tpu.serve import Node, NodeConfig
+from quickwit_tpu.storage import StorageResolver
+
+
+def test_record_log_append_read(tmp_path):
+    log = RecordLog(str(tmp_path / "q"), fsync=False)
+    positions = [log.append(f"rec-{i}".encode()) for i in range(10)]
+    assert positions == list(range(10))
+    records = log.read_from(4)
+    assert [p for p, _ in records] == list(range(4, 10))
+    assert records[0][1] == b"rec-4"
+    log.close()
+
+
+def test_record_log_batch_and_recovery(tmp_path):
+    path = str(tmp_path / "q")
+    log = RecordLog(path, fsync=False)
+    first, last = log.append_batch([b"a", b"b", b"c"])
+    assert (first, last) == (0, 2)
+    log.close()
+    # a new instance over the same dir resumes at the right position
+    log2 = RecordLog(path, fsync=False)
+    assert log2.next_position == 3
+    assert log2.append(b"d") == 3
+    assert [p for p, _ in log2.read_from(0)] == [0, 1, 2, 3]
+    log2.close()
+
+
+def test_record_log_truncation_drops_segments(tmp_path, monkeypatch):
+    import quickwit_tpu.ingest.wal as wal_mod
+    monkeypatch.setattr(wal_mod, "_SEGMENT_MAX_BYTES", 64)  # tiny segments
+    path = str(tmp_path / "q")
+    log = RecordLog(path, fsync=False)
+    for i in range(50):
+        log.append(f"record-{i:04d}".encode())
+    num_segments = len(os.listdir(path))
+    assert num_segments > 2
+    log.truncate(40)
+    assert len(os.listdir(path)) < num_segments
+    # records at/after the truncate point survive
+    assert [p for p, _ in log.read_from(40)][:3] == [40, 41, 42]
+    log.close()
+
+
+def test_ingester_persist_fetch_truncate(tmp_path):
+    ingester = Ingester(str(tmp_path / "wal"), fsync=False)
+    first, last = ingester.persist("idx:01", "src", "shard-00",
+                                  [{"n": i} for i in range(5)])
+    assert (first, last) == (0, 4)
+    records = ingester.fetch("idx:01", "src", "shard-00", from_position=2)
+    assert [doc["n"] for _, doc in records] == [2, 3, 4]
+    ingester.truncate("idx:01", "src", "shard-00", 3)
+    state = ingester.shard_throughput_state()
+    assert state["idx_01/src/shard-00"]["published"] == 3
+
+
+def test_ingester_recovery(tmp_path):
+    wal_dir = str(tmp_path / "wal")
+    ingester = Ingester(wal_dir, fsync=False)
+    ingester.persist("idx:01", "src", "shard-00", [{"n": 1}, {"n": 2}])
+    # crash + restart
+    ingester2 = Ingester(wal_dir, fsync=False)
+    shards = ingester2.list_shards("idx:01")
+    assert len(shards) == 1
+    records = ingester2.fetch("idx:01", "src", "shard-00", 0)
+    assert len(records) == 2
+    # appends continue from the recovered position
+    first, _ = ingester2.persist("idx:01", "src", "shard-00", [{"n": 3}])
+    assert first == 2
+
+
+def test_router_round_robin_and_closed_shard(tmp_path):
+    ingester = Ingester(str(tmp_path / "wal"), fsync=False)
+    router = IngestRouter(ingester, shards_per_source=2)
+    r1 = router.ingest("idx:01", [{"a": 1}])
+    r2 = router.ingest("idx:01", [{"a": 2}])
+    used = set(list(r1["positions"]) + list(r2["positions"]))
+    assert used == {"shard-00", "shard-01"}
+    # closing one shard reroutes to the other
+    ingester.close_shard("idx:01", INGEST_V2_SOURCE_ID, "shard-00")
+    for _ in range(3):
+        result = router.ingest("idx:01", [{"a": 3}])
+        assert list(result["positions"]) == ["shard-01"]
+
+
+def test_node_wal_ingest_to_search(tmp_path):
+    resolver = StorageResolver.for_test()
+    node = Node(NodeConfig(node_id="wal-node",
+                           metastore_uri="ram:///wal/metastore",
+                           default_index_root_uri="ram:///wal/indexes",
+                           data_dir=str(tmp_path), wal_fsync=False),
+                storage_resolver=resolver)
+    node.index_service.create_index({
+        "index_id": "wlogs",
+        "doc_mapping": {
+            "field_mappings": [
+                {"name": "ts", "type": "datetime", "fast": True,
+                 "input_formats": ["unix_timestamp"]},
+                {"name": "body", "type": "text"},
+            ],
+            "timestamp_field": "ts",
+            "default_search_fields": ["body"],
+        },
+    })
+    docs = [{"ts": 1_600_000_000 + i, "body": f"wal doc {i}"} for i in range(40)]
+    result = node.ingest_v2("wlogs", docs)
+    assert result["num_docs"] == 40
+    # not yet searchable: WAL only
+    from quickwit_tpu.query import parse_query_string
+    from quickwit_tpu.search.models import SearchRequest
+    request = SearchRequest(index_ids=["wlogs"],
+                            query_ast=parse_query_string("wal", ["body"]),
+                            max_hits=5)
+    assert node.root_searcher.search(request).num_hits == 0
+    # drain: pipeline pass indexes + truncates
+    stats = node.run_ingest_pass("wlogs")
+    assert stats["num_docs_indexed"] == 40
+    assert node.root_searcher.search(request).num_hits == 40
+    # second pass: nothing new (checkpoint protects against re-index)
+    assert node.run_ingest_pass("wlogs")["num_docs_indexed"] == 0
+    # more docs, another pass
+    node.ingest_v2("wlogs", [{"ts": 1_600_001_000, "body": "wal late"}])
+    assert node.run_ingest_pass("wlogs")["num_docs_indexed"] == 1
+    assert node.root_searcher.search(request).num_hits == 41
+
+
+def test_scheduler_affinity_and_balance():
+    from quickwit_tpu.control_plane import IndexingScheduler, IndexingTask
+    scheduler = IndexingScheduler()
+    tasks = [IndexingTask(f"idx-{i}:01", "src") for i in range(6)]
+    plan1 = scheduler.schedule(tasks, ["n1", "n2", "n3"])
+    assert plan1.num_tasks == 6
+    loads = [len(plan1.tasks_for(n)) for n in ("n1", "n2", "n3")]
+    assert max(loads) - min(loads) <= 1
+    # removing one node: surviving assignments stay put (affinity)
+    plan2 = scheduler.schedule(tasks, ["n1", "n2"])
+    for task in tasks:
+        node1 = plan1.node_of(task)
+        if node1 in ("n1", "n2"):
+            assert plan2.node_of(task) == node1
+    # adding a node back only moves the minimum
+    plan3 = scheduler.schedule(tasks, ["n1", "n2", "n3"])
+    moved = sum(1 for t in tasks if plan3.node_of(t) != plan2.node_of(t))
+    assert moved <= 3
+    # drift detection
+    assert not scheduler.plan_drift(plan3.assignments)
+    assert scheduler.plan_drift({"n1": []})

@@ -209,3 +209,126 @@ def test_delete_index(api):
     assert status == 200
     status, _ = api.request("GET", "/api/v1/indexes/tmp-index")
     assert status == 404
+
+
+def test_scroll_pagination(api):
+    status, page1 = api.request(
+        "GET", "/api/v1/hdfs-logs/search?query=*&max_hits=7&scroll=5m&sort_by=-timestamp")
+    assert status == 200
+    scroll_id = page1["scroll_id"]
+    assert len(page1["hits"]) == 7
+    seen = {(h["split_id"], h["doc_id"]) for h in page1["hits"]}
+    total = page1["num_hits"]
+    fetched = len(page1["hits"])
+    while True:
+        status, page = api.request("GET", f"/api/v1/scroll?scroll_id={scroll_id}")
+        assert status == 200
+        if not page["hits"]:
+            break
+        for h in page["hits"]:
+            key = (h["split_id"], h["doc_id"])
+            assert key not in seen
+            seen.add(key)
+        fetched += len(page["hits"])
+    assert fetched == total
+
+
+def test_scroll_unknown_id(api):
+    status, result = api.request("GET", "/api/v1/scroll?scroll_id=bogus")
+    assert status == 400
+
+
+def test_list_terms(api):
+    status, result = api.request(
+        "GET", "/api/v1/hdfs-logs/list-terms?field=severity_text")
+    assert status == 200
+    assert set(result["terms"]) >= {"ERROR", "INFO"}
+    status, result = api.request(
+        "GET", "/api/v1/hdfs-logs/list-terms?field=severity_text&start_key=I")
+    assert "ERROR" not in result["terms"]
+    status, _ = api.request("GET", "/api/v1/hdfs-logs/list-terms")
+    assert status == 400
+
+
+def test_list_fields(api):
+    status, result = api.request("GET", "/api/v1/hdfs-log*/fields")
+    assert status == 200
+    by_name = {f["field_name"]: f for f in result["fields"]}
+    assert by_name["timestamp"]["aggregatable"] is True
+    assert by_name["body"]["searchable"] is True
+
+
+def test_otlp_and_jaeger(api):
+    span = lambda tid, sid, name, svc, start, dur: {
+        "traceId": tid, "spanId": sid, "name": name,
+        "startTimeUnixNano": str(start * 10**9),
+        "endTimeUnixNano": str(start * 10**9 + dur * 1000),
+    }
+    payload = {"resourceSpans": [{
+        "resource": {"attributes": [
+            {"key": "service.name", "value": {"stringValue": "frontend"}}]},
+        "scopeSpans": [{"spans": [
+            span("trace-aa", "s1", "GET /", "frontend", 1_600_000_100, 5000),
+            span("trace-aa", "s2", "db query", "frontend", 1_600_000_100, 2000),
+            span("trace-bb", "s3", "GET /", "frontend", 1_600_000_200, 9000),
+        ]}]}]}
+    status, result = api.request("POST", "/api/v1/otlp/v1/traces", payload)
+    assert status == 200 and result["num_ingested_docs"] == 3
+
+    status, services = api.request("GET", "/api/v1/jaeger/api/services")
+    assert "frontend" in services["data"]
+    status, ops = api.request(
+        "GET", "/api/v1/jaeger/api/services/frontend/operations")
+    assert set(ops["data"]) == {"GET /", "db query"}
+    status, trace = api.request("GET", "/api/v1/jaeger/api/traces/trace-aa")
+    assert status == 200 and len(trace["data"][0]["spans"]) == 2
+    status, found = api.request(
+        "GET", "/api/v1/jaeger/api/traces?service=frontend&limit=5")
+    assert {t["traceID"] for t in found["data"]} == {"trace-aa", "trace-bb"}
+    status, _ = api.request("GET", "/api/v1/jaeger/api/traces/nope")
+    assert status == 404
+
+    logs_payload = {"resourceLogs": [{
+        "resource": {"attributes": [
+            {"key": "service.name", "value": {"stringValue": "frontend"}}]},
+        "scopeLogs": [{"logRecords": [
+            {"timeUnixNano": str(1_600_000_100 * 10**9),
+             "severityText": "ERROR",
+             "body": {"stringValue": "connection refused"}}]}]}]}
+    status, result = api.request("POST", "/api/v1/otlp/v1/logs", logs_payload)
+    assert status == 200 and result["num_ingested_docs"] == 1
+    status, search = api.request(
+        "GET", "/api/v1/otel-logs-v0/search?query=severity_text:ERROR")
+    assert search["num_hits"] == 1
+
+
+def test_wal_ingest_endpoint(api):
+    docs = "\n".join(json.dumps(
+        {"timestamp": 1_600_009_000 + i, "severity_text": "DEBUG",
+         "tenant_id": 1, "body": "walflow doc"}) for i in range(5)).encode()
+    status, result = api.request(
+        "POST", "/api/v1/hdfs-logs/ingest?commit=wal", docs)
+    assert status == 200
+    assert result["num_docs"] == 5
+
+
+def test_scroll_deep_pagination_past_window(api, monkeypatch):
+    """Regression: scrolling past the cache window must refill via
+    search_after pushdown and return every hit exactly once."""
+    import quickwit_tpu.search.scroll as scroll_mod
+    monkeypatch.setattr(scroll_mod, "CACHE_WINDOW", 30)
+    status, page = api.request(
+        "GET", "/api/v1/hdfs-logs/search?query=*&max_hits=12&scroll=1m&sort_by=-timestamp")
+    assert status == 200
+    total = page["num_hits"]
+    assert total > 60  # corpus is > 2x the shrunken window
+    scroll_id = page["scroll_id"]
+    seen = [(h["split_id"], h["doc_id"]) for h in page["hits"]]
+    while True:
+        status, page = api.request("GET", f"/api/v1/scroll?scroll_id={scroll_id}")
+        assert status == 200
+        if not page["hits"]:
+            break
+        seen.extend((h["split_id"], h["doc_id"]) for h in page["hits"])
+    assert len(seen) == total
+    assert len(set(seen)) == total  # no duplicates, no gaps
