@@ -67,8 +67,8 @@ def load_node_config(path: Optional[str] = None,
         default_index_root_uri=str(pick(
             "QW_DEFAULT_INDEX_ROOT_URI", "default_index_root_uri",
             "file:///tmp/quickwit_tpu/indexes")),
-        rest_host=str(rest.get("listen_host",
-                               environ.get("QW_REST_HOST", "127.0.0.1"))),
+        rest_host=str(environ.get("QW_REST_HOST",
+                                  rest.get("listen_host", "127.0.0.1"))),
         rest_port=int(environ.get("QW_REST_PORT",
                                   rest.get("listen_port", 7280))),
         peers=tuple(data.get("peer_seeds", ())),

@@ -33,6 +33,10 @@ _NUMERIC_TYPES = (FieldType.I64, FieldType.U64, FieldType.F64, FieldType.BOOL,
 
 
 class _InvertedFieldBuilder:
+    """Python-path postings accumulator. TEXT fields with the `default`
+    tokenizer go through the native builder (`native/fastindex.cpp`) when it
+    is available — see `_NativeInvertedFieldBuilder`."""
+
     def __init__(self, fm: FieldMapping):
         self.fm = fm
         self.with_positions = fm.record == "position" and fm.type is FieldType.TEXT
@@ -67,6 +71,65 @@ class _InvertedFieldBuilder:
         self.total_tokens += ntokens
 
 
+class _NativeInvertedFieldBuilder:
+    """C++ tokenize+postings path (role of tantivy's native segment writer).
+    Buffers raw values and feeds them to fastindex in batches."""
+
+    FLUSH_VALUES = 8192
+
+    def __init__(self, fm: FieldMapping, fastindex):
+        self.fm = fm
+        self.with_positions = fm.record == "position"
+        self.fastindex = fastindex
+        self.handle = fastindex.new_builder(self.with_positions)
+        self._doc_ids: list[int] = []
+        self._texts: list[bytes] = []
+
+    def add_value(self, doc_id: int, value: str) -> None:
+        self._doc_ids.append(doc_id)
+        self._texts.append(value.encode())
+        if len(self._doc_ids) >= self.FLUSH_VALUES:
+            self._flush()
+
+    def _flush(self) -> None:
+        if not self._doc_ids:
+            return
+        doc_ids = np.array(self._doc_ids, dtype=np.int32)
+        blob = b"".join(self._texts)
+        offsets = np.zeros(len(self._texts) + 1, dtype=np.int64)
+        np.cumsum([len(t) for t in self._texts], out=offsets[1:])
+        self.fastindex.add_values(self.handle, doc_ids.tobytes(), blob,
+                                  offsets.tobytes())
+        self._doc_ids.clear()
+        self._texts.clear()
+
+    def finish(self, num_docs_padded: int) -> dict[str, np.ndarray]:
+        self._flush()
+        out = self.fastindex.finish(self.handle, num_docs_padded)
+        arrays = {
+            "terms.blob": np.frombuffer(out[0], dtype=np.uint8),
+            "terms.offsets": np.frombuffer(out[1], dtype=np.int64),
+            "terms.df": np.frombuffer(out[2], dtype=np.int32),
+            "terms.post_off": np.frombuffer(out[3], dtype=np.int64),
+            "terms.post_len": np.frombuffer(out[4], dtype=np.int32),
+            "postings.ids": np.frombuffer(out[5], dtype=np.int32),
+            "postings.tfs": np.frombuffer(out[6], dtype=np.int32),
+            "fieldnorm": np.frombuffer(out[7], dtype=np.int32),
+        }
+        self.total_tokens = int(out[8])
+        if self.with_positions:
+            arrays["positions.offsets"] = np.frombuffer(out[9], dtype=np.int64)
+            arrays["positions.data"] = np.frombuffer(out[10], dtype=np.int32)
+        return arrays
+
+
+def _native_capable(fm: FieldMapping):
+    if fm.type is not FieldType.TEXT or fm.tokenizer != "default":
+        return None
+    from ..native import load_fastindex
+    return load_fastindex()
+
+
 class _ColumnBuilder:
     def __init__(self, fm: FieldMapping):
         self.fm = fm
@@ -85,9 +148,12 @@ class SplitWriter:
     def __init__(self, doc_mapper: DocMapper):
         self.doc_mapper = doc_mapper
         self.num_docs = 0
-        self._inv: dict[str, _InvertedFieldBuilder] = {
-            fm.name: _InvertedFieldBuilder(fm) for fm in doc_mapper.indexed_fields
-        }
+        self._inv: dict[str, Any] = {}
+        for fm in doc_mapper.indexed_fields:
+            fastindex = _native_capable(fm)
+            self._inv[fm.name] = (
+                _NativeInvertedFieldBuilder(fm, fastindex) if fastindex
+                else _InvertedFieldBuilder(fm))
         self._cols: dict[str, _ColumnBuilder] = {
             fm.name: _ColumnBuilder(fm) for fm in doc_mapper.fast_fields
         }
@@ -109,8 +175,13 @@ class SplitWriter:
                 continue
             if fm.indexed:
                 builder = self._inv[field_name]
-                for value in values:
-                    builder.add(doc_id, self.doc_mapper.tokens_for_field(fm, value))
+                if isinstance(builder, _NativeInvertedFieldBuilder):
+                    for value in values:
+                        builder.add_value(doc_id, value)
+                else:
+                    for value in values:
+                        builder.add(doc_id,
+                                    self.doc_mapper.tokens_for_field(fm, value))
             if fm.fast:
                 col = self._cols[field_name]
                 for value in values:
@@ -152,7 +223,22 @@ class SplitWriter:
         return builder.finish(footer)
 
     def _write_inverted(self, builder: SplitFileBuilder, name: str,
-                        inv: _InvertedFieldBuilder, num_docs_padded: int) -> dict[str, Any]:
+                        inv: Any, num_docs_padded: int) -> dict[str, Any]:
+        if isinstance(inv, _NativeInvertedFieldBuilder):
+            arrays = inv.finish(num_docs_padded)
+            for suffix, arr in arrays.items():
+                builder.add_array(f"inv.{name}.{suffix}", arr)
+            num_terms = len(arrays["terms.df"])
+            return {
+                "type": inv.fm.type.value,
+                "tokenizer": inv.fm.tokenizer,
+                "record": inv.fm.record,
+                "indexed": True,
+                "num_terms": num_terms,
+                "total_tokens": inv.total_tokens,
+                "avg_len": (inv.total_tokens / self.num_docs) if self.num_docs else 0.0,
+                "native": True,
+            }
         terms_sorted = sorted(inv.terms)
         num_terms = len(terms_sorted)
         blob_parts: list[bytes] = []

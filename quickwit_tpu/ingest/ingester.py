@@ -37,7 +37,9 @@ class Shard:
 
 
 def shard_queue_id(index_uid: str, source_id: str, shard_id: str) -> str:
-    return f"{index_uid.replace(':', '_')}/{source_id}/{shard_id}"
+    # ':' is not filesystem-friendly; '@' cannot occur in index ids, so the
+    # encoding is reversible even for ids containing underscores
+    return f"{index_uid.replace(':', '@')}/{source_id}/{shard_id}"
 
 
 class Ingester:
@@ -62,8 +64,7 @@ class Ingester:
                 source_path = os.path.join(index_path, source_id)
                 for shard_id in os.listdir(source_path):
                     queue_id = f"{index_dir}/{source_id}/{shard_id}"
-                    index_uid = index_dir.replace("_", ":", 1) \
-                        if "_" in index_dir else index_dir
+                    index_uid = index_dir.replace("@", ":")
                     self._shards[queue_id] = Shard(
                         index_uid=index_uid, source_id=source_id,
                         shard_id=shard_id,

@@ -72,7 +72,9 @@ class IngestRouter:
                 with self._lock:
                     if shard_id in entry.shard_ids:
                         entry.shard_ids.remove(shard_id)
-                if not entry.shard_ids:
-                    entry.shard_ids = list(
-                        self.get_or_create_shards(index_uid, source_id))
+                    if not entry.shard_ids:
+                        # refill inside the lock so concurrent ingests never
+                        # observe an empty shard list
+                        entry.shard_ids = list(
+                            self.get_or_create_shards(index_uid, source_id))
         raise RuntimeError(f"no open shard accepted the batch: {last_error}")

@@ -1,0 +1,76 @@
+"""Native extensions: compiled on first use, with pure-Python fallbacks.
+
+The reference implements its entire indexing hot path natively (Rust/
+tantivy); here the tokenize+postings-accumulation loop is a C++ CPython
+extension (`fastindex.cpp`) compiled on demand with the baked-in g++.
+`load_fastindex()` returns the module or None — callers must degrade to the
+Python path, so a missing toolchain never breaks indexing, only slows it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sysconfig
+import threading
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_cached: Any = "unset"
+
+
+def _build_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
+
+
+def _compile() -> Optional[str]:
+    src = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fastindex.cpp")
+    build_dir = _build_dir()
+    os.makedirs(build_dir, exist_ok=True)
+    so_path = os.path.join(build_dir, "fastindex.so")
+    if (os.path.exists(so_path)
+            and os.path.getmtime(so_path) >= os.path.getmtime(src)):
+        return so_path
+    include = sysconfig.get_paths()["include"]
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+           f"-I{include}", src, "-o", so_path + ".tmp"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(so_path + ".tmp", so_path)
+        return so_path
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            FileNotFoundError) as exc:
+        stderr = getattr(exc, "stderr", b"") or b""
+        logger.warning("fastindex compilation failed, using Python fallback: %s %s",
+                       exc, stderr.decode()[:500])
+        return None
+
+
+def load_fastindex():
+    """The compiled fastindex module, or None (Python fallback)."""
+    global _cached
+    if _cached != "unset":
+        return _cached
+    with _lock:
+        if _cached != "unset":
+            return _cached
+        if os.environ.get("QW_DISABLE_NATIVE") == "1":
+            _cached = None
+            return None
+        so_path = _compile()
+        if so_path is None:
+            _cached = None
+            return None
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("fastindex", so_path)
+        try:
+            module = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(module)  # type: ignore[union-attr]
+            _cached = module
+        except Exception as exc:  # noqa: BLE001 - load failure → fallback
+            logger.warning("fastindex load failed: %s", exc)
+            _cached = None
+    return _cached

@@ -1,0 +1,361 @@
+// Native inverted-index builder — the indexing hot loop.
+//
+// Role of tantivy's segment writer driven by the reference's Indexer actor
+// (`indexer.rs:362`: tokenize -> term hash -> postings accumulation), which
+// is native Rust in the reference. Python feeds a concatenated UTF-8 buffer
+// of field values with (value -> doc) mapping; this builds:
+//   - the sorted term dictionary (blob + offsets + df)
+//   - postings arenas (doc ids + term freqs, padded to POSTING_PAD with the
+//     out-of-bounds sentinel, matching index/format.py's layout)
+//   - optional per-(posting) position lists (record="position" fields)
+//   - per-doc fieldnorms (token counts)
+//
+// Tokenizer parity: byte-for-byte identical to query/tokenizers.py
+// `default` — word chars are [0-9A-Za-z], U+00C0..U+024F, U+0400..U+04FF;
+// tokens lowercase (ASCII +0x20; Latin-1 supplement/Extended-A/B and
+// Cyrillic per Unicode simple case folding); tokens longer than 255 chars
+// are dropped. CPython C API only (no pybind11 in this image).
+
+#include <Python.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kPostingPad = 128;
+constexpr int kMaxTokenLen = 255;  // in codepoints
+
+inline bool is_word_cp(uint32_t cp) {
+  if ((cp >= '0' && cp <= '9') || (cp >= 'A' && cp <= 'Z') ||
+      (cp >= 'a' && cp <= 'z'))
+    return true;
+  if (cp >= 0x00C0 && cp <= 0x024F) return true;  // latin supplement/ext A+B
+  if (cp >= 0x0400 && cp <= 0x04FF) return true;  // cyrillic
+  return false;
+}
+
+// Unicode simple lowercase for the ranges is_word_cp admits.
+inline uint32_t lower_cp(uint32_t cp) {
+  if (cp >= 'A' && cp <= 'Z') return cp + 0x20;
+  if (cp >= 0x00C0 && cp <= 0x00DE && cp != 0x00D7) return cp + 0x20;
+  if (cp >= 0x0100 && cp <= 0x0137) return cp | 1;            // pairs
+  if (cp >= 0x0139 && cp <= 0x0148) return ((cp - 1) | 1) + 1;  // odd pairs
+  if (cp >= 0x014A && cp <= 0x0177) return cp | 1;
+  if (cp == 0x0178) return 0x00FF;
+  if (cp >= 0x0179 && cp <= 0x017E) return ((cp - 1) | 1) + 1;
+  if (cp >= 0x0182 && cp <= 0x0185) return cp | 1;
+  if (cp >= 0x01A0 && cp <= 0x01A5) return cp | 1;
+  if (cp >= 0x01B3 && cp <= 0x01B6) return ((cp - 1) | 1) + 1;
+  if (cp >= 0x01CD && cp <= 0x01DC) return ((cp - 1) | 1) + 1;
+  if (cp >= 0x01DE && cp <= 0x01EF) return cp | 1;
+  if (cp >= 0x01F4 && cp <= 0x01F5) return 0x01F5;
+  if (cp >= 0x01F8 && cp <= 0x021F) return cp | 1;
+  if (cp >= 0x0222 && cp <= 0x0233) return cp | 1;
+  if (cp >= 0x0410 && cp <= 0x042F) return cp + 0x20;  // А-Я
+  if (cp >= 0x0400 && cp <= 0x040F) return cp + 0x50;  // Ѐ-Џ
+  if (cp >= 0x0460 && cp <= 0x0481) return cp | 1;
+  if (cp >= 0x048A && cp <= 0x04BF) return cp | 1;
+  if (cp >= 0x04C1 && cp <= 0x04CE) return ((cp - 1) | 1) + 1;
+  if (cp >= 0x04D0 && cp <= 0x04FF) return cp | 1;
+  return cp;
+}
+
+inline void append_utf8(std::string& out, uint32_t cp) {
+  if (cp < 0x80) {
+    out.push_back(static_cast<char>(cp));
+  } else if (cp < 0x800) {
+    out.push_back(static_cast<char>(0xC0 | (cp >> 6)));
+    out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  } else if (cp < 0x10000) {
+    out.push_back(static_cast<char>(0xE0 | (cp >> 12)));
+    out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+    out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  } else {
+    out.push_back(static_cast<char>(0xF0 | (cp >> 18)));
+    out.push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+    out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+    out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  }
+}
+
+// Decode the next UTF-8 codepoint; on malformed input consume 1 byte and
+// return 0xFFFD (matches Python's handling of already-valid str: malformed
+// input cannot occur from CPython-encoded buffers).
+inline uint32_t next_cp(const uint8_t* buf, size_t len, size_t& i) {
+  uint8_t b0 = buf[i];
+  if (b0 < 0x80) { i += 1; return b0; }
+  if ((b0 >> 5) == 0x6 && i + 1 < len) {
+    uint32_t cp = ((b0 & 0x1F) << 6) | (buf[i + 1] & 0x3F);
+    i += 2; return cp;
+  }
+  if ((b0 >> 4) == 0xE && i + 2 < len) {
+    uint32_t cp = ((b0 & 0x0F) << 12) | ((buf[i + 1] & 0x3F) << 6) |
+                  (buf[i + 2] & 0x3F);
+    i += 3; return cp;
+  }
+  if ((b0 >> 3) == 0x1E && i + 3 < len) {
+    uint32_t cp = ((b0 & 0x07) << 18) | ((buf[i + 1] & 0x3F) << 12) |
+                  ((buf[i + 2] & 0x3F) << 6) | (buf[i + 3] & 0x3F);
+    i += 4; return cp;
+  }
+  i += 1;
+  return 0xFFFD;
+}
+
+struct Posting {
+  int32_t doc;
+  int32_t tf;
+  std::vector<int32_t> positions;
+};
+
+struct TermEntry {
+  std::vector<Posting> postings;
+};
+
+struct Builder {
+  std::unordered_map<std::string, TermEntry> terms;
+  std::vector<int32_t> fieldnorms;   // token count per doc
+  std::vector<int32_t> pos_base;     // next position base per doc (with gaps)
+  int64_t total_tokens = 0;
+  bool with_positions = false;
+};
+
+void add_value(Builder& b, int32_t doc, const uint8_t* buf, size_t len) {
+  if (static_cast<size_t>(doc) >= b.fieldnorms.size()) {
+    b.fieldnorms.resize(doc + 1, 0);
+    b.pos_base.resize(doc + 1, 0);
+  }
+  int32_t base = b.pos_base[doc];
+  // position indexes every token (even dropped overlong ones occupy a
+  // position slot — tokenizer parity with query/tokenizers.py enumerate());
+  // kept counts only indexed tokens (fieldnorm / BM25 doc length).
+  int32_t position = 0;
+  int32_t kept = 0;
+  std::string token;
+  size_t token_cps = 0;
+  size_t i = 0;
+  auto flush = [&](void) {
+    if (!token.empty()) {
+      if (token_cps <= kMaxTokenLen) {
+        TermEntry& entry = b.terms[token];
+        if (!entry.postings.empty() && entry.postings.back().doc == doc) {
+          entry.postings.back().tf += 1;
+          if (b.with_positions)
+            entry.postings.back().positions.push_back(base + position);
+        } else {
+          Posting p;
+          p.doc = doc;
+          p.tf = 1;
+          if (b.with_positions) p.positions.push_back(base + position);
+          entry.postings.push_back(std::move(p));
+        }
+        kept += 1;
+      }
+      position += 1;
+      token.clear();
+      token_cps = 0;
+    }
+  };
+  while (i < len) {
+    uint32_t cp = next_cp(buf, len, i);
+    if (is_word_cp(cp)) {
+      append_utf8(token, lower_cp(cp));
+      token_cps += 1;
+    } else {
+      flush();
+    }
+  }
+  flush();
+  b.fieldnorms[doc] += kept;
+  // +1 gap between values so phrases never match across value boundaries
+  b.pos_base[doc] = base + kept + 1;
+  b.total_tokens += kept;
+}
+
+inline int64_t pad_to(int64_t n, int64_t m) { return ((n + m - 1) / m) * m; }
+
+// ---------------------------------------------------------------------------
+// Python bindings
+
+struct BuilderCapsule {
+  Builder builder;
+};
+
+void capsule_destructor(PyObject* capsule) {
+  delete static_cast<BuilderCapsule*>(
+      PyCapsule_GetPointer(capsule, "fastindex.Builder"));
+}
+
+PyObject* py_new_builder(PyObject*, PyObject* args) {
+  int with_positions = 0;
+  if (!PyArg_ParseTuple(args, "p", &with_positions)) return nullptr;
+  auto* cap = new BuilderCapsule();
+  cap->builder.with_positions = with_positions != 0;
+  return PyCapsule_New(cap, "fastindex.Builder", capsule_destructor);
+}
+
+Builder* get_builder(PyObject* capsule) {
+  auto* cap = static_cast<BuilderCapsule*>(
+      PyCapsule_GetPointer(capsule, "fastindex.Builder"));
+  return cap ? &cap->builder : nullptr;
+}
+
+// add_values(builder, doc_ids_bytes(int32 LE), text_blob, offsets_bytes(int64 LE))
+PyObject* py_add_values(PyObject*, PyObject* args) {
+  PyObject* capsule;
+  Py_buffer doc_ids_buf, text_buf, offsets_buf;
+  if (!PyArg_ParseTuple(args, "Oy*y*y*", &capsule, &doc_ids_buf, &text_buf,
+                        &offsets_buf))
+    return nullptr;
+  Builder* b = get_builder(capsule);
+  if (b == nullptr) {
+    PyBuffer_Release(&doc_ids_buf);
+    PyBuffer_Release(&text_buf);
+    PyBuffer_Release(&offsets_buf);
+    PyErr_SetString(PyExc_ValueError, "invalid builder capsule");
+    return nullptr;
+  }
+  const auto* doc_ids = static_cast<const int32_t*>(doc_ids_buf.buf);
+  const auto* text = static_cast<const uint8_t*>(text_buf.buf);
+  const auto* offsets = static_cast<const int64_t*>(offsets_buf.buf);
+  Py_ssize_t n_values = doc_ids_buf.len / static_cast<Py_ssize_t>(sizeof(int32_t));
+  Py_BEGIN_ALLOW_THREADS
+  for (Py_ssize_t v = 0; v < n_values; ++v) {
+    add_value(*b, doc_ids[v], text + offsets[v],
+              static_cast<size_t>(offsets[v + 1] - offsets[v]));
+  }
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&doc_ids_buf);
+  PyBuffer_Release(&text_buf);
+  PyBuffer_Release(&offsets_buf);
+  Py_RETURN_NONE;
+}
+
+// finish(builder, num_docs_padded) ->
+//   (terms_blob, term_offsets, dfs, post_offs, post_lens,
+//    ids_arena, tfs_arena, fieldnorms, total_tokens,
+//    pos_offsets|None, pos_data|None)      -- all bytes objects (LE arrays)
+PyObject* py_finish(PyObject*, PyObject* args) {
+  PyObject* capsule;
+  long long num_docs_padded;
+  if (!PyArg_ParseTuple(args, "OL", &capsule, &num_docs_padded)) return nullptr;
+  Builder* b = get_builder(capsule);
+  if (b == nullptr) {
+    PyErr_SetString(PyExc_ValueError, "invalid builder capsule");
+    return nullptr;
+  }
+
+  std::vector<const std::string*> sorted_terms;
+  sorted_terms.reserve(b->terms.size());
+  for (const auto& kv : b->terms) sorted_terms.push_back(&kv.first);
+  std::string blob;
+  std::vector<int64_t> term_offsets;
+  std::vector<int32_t> dfs;
+  std::vector<int64_t> post_offs;
+  std::vector<int32_t> post_lens;
+  std::vector<int32_t> ids_arena;
+  std::vector<int32_t> tfs_arena;
+  std::vector<int64_t> pos_offsets;
+  std::vector<int32_t> pos_data;
+
+  Py_BEGIN_ALLOW_THREADS
+  std::sort(sorted_terms.begin(), sorted_terms.end(),
+            [](const std::string* a, const std::string* s) { return *a < *s; });
+  size_t n_terms = sorted_terms.size();
+  term_offsets.reserve(n_terms + 1);
+  term_offsets.push_back(0);
+  dfs.reserve(n_terms);
+  post_offs.reserve(n_terms);
+  post_lens.reserve(n_terms);
+  int64_t total_padded = 0;
+  for (const std::string* term : sorted_terms) {
+    int64_t df = static_cast<int64_t>(b->terms[*term].postings.size());
+    total_padded += pad_to(df, kPostingPad);
+  }
+  ids_arena.assign(total_padded, static_cast<int32_t>(num_docs_padded));
+  tfs_arena.assign(total_padded, 0);
+  if (b->with_positions) pos_offsets.assign(total_padded + 1, 0);
+  int64_t cursor = 0;
+  int64_t pos_cursor = 0;
+  for (const std::string* term : sorted_terms) {
+    blob += *term;
+    term_offsets.push_back(static_cast<int64_t>(blob.size()));
+    auto& postings = b->terms[*term].postings;
+    int64_t df = static_cast<int64_t>(postings.size());
+    int64_t padded = pad_to(df, kPostingPad);
+    dfs.push_back(static_cast<int32_t>(df));
+    post_offs.push_back(cursor);
+    post_lens.push_back(static_cast<int32_t>(padded));
+    for (int64_t i = 0; i < df; ++i) {
+      ids_arena[cursor + i] = postings[i].doc;
+      tfs_arena[cursor + i] = postings[i].tf;
+      if (b->with_positions) {
+        pos_offsets[cursor + i] = pos_cursor;
+        for (int32_t p : postings[i].positions) pos_data.push_back(p);
+        pos_cursor += static_cast<int64_t>(postings[i].positions.size());
+      }
+    }
+    if (b->with_positions) {
+      for (int64_t i = df; i <= padded && cursor + i <= total_padded; ++i)
+        pos_offsets[cursor + i] = pos_cursor;
+    }
+    cursor += padded;
+  }
+  Py_END_ALLOW_THREADS
+
+  std::vector<int32_t> norms(num_docs_padded, 0);
+  size_t copy_n = std::min(b->fieldnorms.size(),
+                           static_cast<size_t>(num_docs_padded));
+  std::memcpy(norms.data(), b->fieldnorms.data(), copy_n * sizeof(int32_t));
+
+  auto bytes_of = [](const void* data, size_t nbytes) {
+    return PyBytes_FromStringAndSize(static_cast<const char*>(data),
+                                     static_cast<Py_ssize_t>(nbytes));
+  };
+  PyObject* result = PyTuple_New(11);
+  PyTuple_SET_ITEM(result, 0, bytes_of(blob.data(), blob.size()));
+  PyTuple_SET_ITEM(result, 1, bytes_of(term_offsets.data(),
+                                       term_offsets.size() * 8));
+  PyTuple_SET_ITEM(result, 2, bytes_of(dfs.data(), dfs.size() * 4));
+  PyTuple_SET_ITEM(result, 3, bytes_of(post_offs.data(), post_offs.size() * 8));
+  PyTuple_SET_ITEM(result, 4, bytes_of(post_lens.data(), post_lens.size() * 4));
+  PyTuple_SET_ITEM(result, 5, bytes_of(ids_arena.data(), ids_arena.size() * 4));
+  PyTuple_SET_ITEM(result, 6, bytes_of(tfs_arena.data(), tfs_arena.size() * 4));
+  PyTuple_SET_ITEM(result, 7, bytes_of(norms.data(), norms.size() * 4));
+  PyTuple_SET_ITEM(result, 8, PyLong_FromLongLong(b->total_tokens));
+  if (b->with_positions) {
+    PyTuple_SET_ITEM(result, 9, bytes_of(pos_offsets.data(),
+                                         pos_offsets.size() * 8));
+    PyTuple_SET_ITEM(result, 10, bytes_of(pos_data.data(),
+                                          pos_data.size() * 4));
+  } else {
+    Py_INCREF(Py_None);
+    PyTuple_SET_ITEM(result, 9, Py_None);
+    Py_INCREF(Py_None);
+    PyTuple_SET_ITEM(result, 10, Py_None);
+  }
+  return result;
+}
+
+PyMethodDef kMethods[] = {
+    {"new_builder", py_new_builder, METH_VARARGS,
+     "new_builder(with_positions) -> capsule"},
+    {"add_values", py_add_values, METH_VARARGS,
+     "add_values(builder, doc_ids_i32, text_blob, offsets_i64)"},
+    {"finish", py_finish, METH_VARARGS,
+     "finish(builder, num_docs_padded) -> arrays tuple"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef kModule = {PyModuleDef_HEAD_INIT, "fastindex",
+                       "native inverted-index builder", -1, kMethods,
+                       nullptr, nullptr, nullptr, nullptr};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_fastindex(void) { return PyModule_Create(&kModule); }

@@ -7,9 +7,9 @@ ascending or descending, ties broken by **ascending doc id** — which is
 exactly `lax.top_k`'s lowest-index-wins tie rule when the key is laid out
 per-doc.
 
-Everything returns fixed-size (k,) arrays plus a match count; invalid slots
-(fewer than k matches) are marked with doc_id == -1 after masking host-side
-in the collector.
+The executor (search/executor.py) builds a unified higher-is-better f64
+key per sort spec and calls `exact_topk`; non-matching docs carry -inf,
+matching docs missing a sort value carry MISSING_VALUE_SENTINEL.
 """
 
 from __future__ import annotations
@@ -21,6 +21,11 @@ from jax import lax
 # captured into a jitted closure forces a per-call constant sync that is
 # catastrophically slow under the axon PJRT plugin (~70ms/call observed).
 NEG_INF = float("-inf")
+
+# bottom sentinel for matching-but-missing sort values; MUST be the same
+# constant everywhere (executor keying, leaf decode, search_after markers)
+# or search_after over missing values loops forever
+MISSING_VALUE_SENTINEL = -1.7976931348623157e308
 
 _BLOCK = 1024  # == index.format.DOC_PAD, so dense doc arrays always divide
 
@@ -43,55 +48,3 @@ def exact_topk(x: jnp.ndarray, k: int):
         top_vals, pos = lax.top_k(vals.reshape(-1), k)
         return top_vals, flat_idx[pos]
     return lax.top_k(x, k)
-
-
-def topk_by_score(scores: jnp.ndarray, mask: jnp.ndarray, k: int):
-    """(sort_values, doc_ids, match_count) for score-descending top-k.
-
-    `scores` dense [num_docs_padded] f32, `mask` the final query mask.
-    Non-matching docs get -inf keys; caller drops slots beyond match_count.
-    """
-    keyed = jnp.where(mask, scores, NEG_INF)
-    values, doc_ids = exact_topk(keyed, k)
-    return values, doc_ids.astype(jnp.int32), jnp.sum(mask.astype(jnp.int32))
-
-
-def topk_by_value(values: jnp.ndarray, present: jnp.ndarray, mask: jnp.ndarray,
-                  k: int, descending: bool):
-    """Top-k by a numeric sort column. Matching docs without a value sort
-    after docs with one; non-matching docs never surface.
-
-    Keys are float64: i64 timestamp columns (micros ~1e15) are exact in f64
-    but would collapse to ~minute precision in f32.
-
-    Ascending order negates the key so `lax.top_k`'s max-selection plus
-    lowest-index tie-break yields (value asc, doc_id asc) — matching the
-    reference's sort semantics (`collector.rs:1083`).
-    """
-    key = values.astype(jnp.float64)
-    if not descending:
-        key = -key
-    has_value = mask & present.astype(jnp.bool_)
-    # matching-but-missing docs get a finite bottom sentinel (above -inf of
-    # non-matching docs), so they still fill top-k slots, last.
-    missing_sentinel = jnp.float64(-1.7976931348623157e308)
-    keyed = jnp.where(has_value, key, jnp.where(mask, missing_sentinel, -jnp.inf))
-    top_vals, doc_ids = exact_topk(keyed, k)
-    # top_vals stay in "higher is better" key space (ascending sorts keep the
-    # negation) — that is the cross-split merge contract of the collector;
-    # the leaf converts back to raw values for display.
-    return top_vals, doc_ids.astype(jnp.int32), jnp.sum(mask.astype(jnp.int32))
-
-
-def merge_topk(values_a: jnp.ndarray, ids_a: jnp.ndarray,
-               values_b: jnp.ndarray, ids_b: jnp.ndarray, k: int):
-    """Merge two sorted top-k lists into one (the ICI tree-reduce step).
-
-    Keys must already be in "descending-is-better" form (ascending sorts are
-    pre-negated by the caller). Ties prefer list a then lower doc id, which
-    preserves the global tie-break when a holds lower split ordinals.
-    """
-    values = jnp.concatenate([values_a, values_b])
-    ids = jnp.concatenate([ids_a, ids_b])
-    top_vals, pos = lax.top_k(values, k)
-    return top_vals, ids[pos]

@@ -166,9 +166,10 @@ def _build(plan: LoweredPlan, k: int) -> Callable:
             if not sort.descending:
                 key = -key
             has_value = mask & arrays[sort.present_slot].astype(jnp.bool_)
-            sentinel = jnp.float64(-1.7976931348623157e308)
-            keyed = jnp.where(has_value, key,
-                              jnp.where(mask, sentinel, -jnp.inf))
+            keyed = jnp.where(
+                has_value, key,
+                jnp.where(mask, jnp.float64(topk_ops.MISSING_VALUE_SENTINEL),
+                          -jnp.inf))
         else:  # "_doc"
             key = jnp.arange(padded, dtype=jnp.float64)
             keyed = jnp.where(mask, key if sort.descending else -key, -jnp.inf)

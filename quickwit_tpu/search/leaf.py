@@ -28,8 +28,7 @@ from .models import LeafSearchResponse, PartialHit, SearchRequest, SplitSearchEr
 from .plan import BucketAggExec, MetricAggExec, lower_request
 
 
-# bottom sentinel for matching-but-missing sort values (see ops/topk.py)
-MISSING_VALUE_SENTINEL = -1.7e308
+from ..ops.topk import MISSING_VALUE_SENTINEL
 
 
 def decode_raw_sort_value(internal: float, sort_field: str, sort_order: str,

@@ -66,9 +66,12 @@ def _attr_map(attributes: list[dict[str, Any]]) -> dict[str, Any]:
     out = {}
     for attr in attributes or []:
         value = attr.get("value", {})
-        out[attr.get("key", "")] = (
-            value.get("stringValue") or value.get("intValue")
-            or value.get("doubleValue") or value.get("boolValue"))
+        for key in ("stringValue", "intValue", "doubleValue", "boolValue"):
+            if key in value:  # falsy values (false, 0, "") must survive
+                out[attr.get("key", "")] = value[key]
+                break
+        else:
+            out[attr.get("key", "")] = None
     return out
 
 

@@ -60,7 +60,18 @@ def test_ingester_persist_fetch_truncate(tmp_path):
     assert [doc["n"] for _, doc in records] == [2, 3, 4]
     ingester.truncate("idx:01", "src", "shard-00", 3)
     state = ingester.shard_throughput_state()
-    assert state["idx_01/src/shard-00"]["published"] == 3
+    assert state["idx@01/src/shard-00"]["published"] == 3
+
+
+def test_ingester_recovery_underscore_index_id(tmp_path):
+    """Regression: index ids containing underscores must round-trip through
+    the WAL directory encoding."""
+    wal_dir = str(tmp_path / "wal")
+    ingester = Ingester(wal_dir, fsync=False)
+    ingester.persist("my_index:01", "src", "shard-00", [{"n": 1}])
+    recovered = Ingester(wal_dir, fsync=False)
+    shards = recovered.list_shards("my_index:01")
+    assert len(shards) == 1 and shards[0].index_uid == "my_index:01"
 
 
 def test_ingester_recovery(tmp_path):

@@ -155,3 +155,54 @@ def test_multivalue_text_indexing():
     reader = SplitReader(storage, "s.split")
     assert reader.lookup_term("tags", "red").df == 2
     assert reader.lookup_term("tags", "blue").df == 1
+
+
+def test_native_and_python_writers_produce_identical_splits(monkeypatch):
+    """The C++ fastindex path must be byte-identical to the Python path."""
+    from quickwit_tpu.native import load_fastindex
+    if load_fastindex() is None:
+        pytest.skip("native toolchain unavailable")
+    mapper = DocMapper(
+        field_mappings=[FieldMapping("body", FieldType.TEXT, record="position")],
+        default_search_fields=("body",))
+    docs = [{"body": ["Hello WORLD again", "über ÊTRE привет"]},
+            {"body": "the quick brown fox the the"},
+            {"body": "x" * 300 + " tail"},  # overlong token dropped
+            {"body": "punct!!!only???"}]
+
+    def build(disable_native):
+        import quickwit_tpu.index.writer as writer_mod
+        if disable_native:
+            monkeypatch.setattr(writer_mod, "_native_capable", lambda fm: None)
+        else:
+            monkeypatch.undo()
+        w = SplitWriter(mapper)
+        for d in docs:
+            w.add_json_doc(d)
+        return w.finish()
+
+    native_bytes = build(disable_native=False)
+    python_bytes = build(disable_native=True)
+    # footers differ only by the "native" marker; compare the array contents
+    storage = RamStorage(Uri.parse("ram:///nativecmp"))
+    storage.put("n.split", native_bytes)
+    storage.put("p.split", python_bytes)
+    rn = SplitReader(storage, "n.split")
+    rp = SplitReader(storage, "p.split")
+    tn, tp = rn.term_dict("body"), rp.term_dict("body")
+    terms_n = list(tn.iter_terms())
+    terms_p = list(tp.iter_terms())
+    assert terms_n == terms_p
+    for term, _df in terms_n:
+        info_n = rn.lookup_term("body", term)
+        info_p = rp.lookup_term("body", term)
+        ids_n, tfs_n = rn.postings("body", info_n)
+        ids_p, tfs_p = rp.postings("body", info_p)
+        assert np.array_equal(ids_n, ids_p), term
+        assert np.array_equal(tfs_n, tfs_p), term
+        offs_n, data_n = rn.positions("body", info_n)
+        offs_p, data_p = rp.positions("body", info_p)
+        assert np.array_equal(data_n, data_p), term
+        assert np.array_equal(offs_n, offs_p), term
+    assert np.array_equal(rn.fieldnorm("body"), rp.fieldnorm("body"))
+    assert rn.field_meta("body")["avg_len"] == rp.field_meta("body")["avg_len"]
