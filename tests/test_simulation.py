@@ -1,0 +1,221 @@
+"""Deterministic fault-injection simulations.
+
+Role of the reference's DST tier (`quickwit-dst`: stateright models + shared
+invariant registry + crash tests like
+`parquet_merge_pipeline_crash_test.rs`): drive the ingest→index→merge→GC
+state machine through randomized operation schedules with crashes injected
+at every storage/metastore call boundary, asserting the same invariants the
+reference registers (`invariants/merge_pipeline.rs:225,248`):
+
+- `no_split_loss`: every doc the source checkpoint covers is searchable
+- `rows_conserved`: merges never create or destroy documents
+- exactly-once: crash replays never duplicate documents
+- GC safety: GC never deletes a file a published split needs
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.index import SplitReader
+from quickwit_tpu.indexing import IndexingPipeline, PipelineParams, VecSource
+from quickwit_tpu.indexing.merge import MergeExecutor, MergeOperation, StableLogMergePolicy
+from quickwit_tpu.indexing.pipeline import split_file_path
+from quickwit_tpu.janitor import run_garbage_collection
+from quickwit_tpu.metastore import FileBackedMetastore, ListSplitsQuery
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.models.index_metadata import IndexConfig, IndexMetadata, SourceConfig
+from quickwit_tpu.models.split_metadata import SplitState
+from quickwit_tpu.query.ast import MatchAll
+from quickwit_tpu.search import SearchRequest, leaf_search_single_split
+from quickwit_tpu.storage import RamStorage, StorageResolver
+from quickwit_tpu.storage.base import Storage
+
+MAPPER = DocMapper(
+    field_mappings=[
+        FieldMapping("ts", FieldType.DATETIME, fast=True,
+                     input_formats=("unix_timestamp",)),
+        FieldMapping("n", FieldType.U64, fast=True),
+        FieldMapping("body", FieldType.TEXT),
+    ],
+    timestamp_field="ts",
+    default_search_fields=("body",),
+)
+
+
+class CrashPoint(Exception):
+    pass
+
+
+class CrashingStorage(Storage):
+    """Raises CrashPoint at the Nth write call (fail-point injection)."""
+
+    def __init__(self, inner, fail_at_write: int):
+        super().__init__(inner.uri)
+        self.inner = inner
+        self.writes = 0
+        self.fail_at_write = fail_at_write
+
+    def put(self, path, payload):
+        self.writes += 1
+        if self.writes == self.fail_at_write:
+            raise CrashPoint(f"storage crash at write #{self.writes}")
+        self.inner.put(path, payload)
+
+    def delete(self, path):
+        self.inner.delete(path)
+
+    def get_slice(self, path, start, end):
+        return self.inner.get_slice(path, start, end)
+
+    def get_all(self, path):
+        return self.inner.get_all(path)
+
+    def file_num_bytes(self, path):
+        return self.inner.file_num_bytes(path)
+
+    def list_files(self):
+        return self.inner.list_files()
+
+
+_ENV_COUNTER = itertools.count()
+
+
+def make_env():
+    # a per-env resolver so GC resolves the SAME storage tree the splits
+    # live in (fresh namespace per test invocation)
+    ns = next(_ENV_COUNTER)
+    resolver = StorageResolver.for_test()
+    meta_storage = resolver.resolve(f"ram:///sim{ns}/meta")
+    split_storage = resolver.resolve(f"ram:///sim{ns}/splits")
+    metastore = FileBackedMetastore(meta_storage)
+    metastore.create_index(IndexMetadata(
+        index_uid="sim:01",
+        index_config=IndexConfig(index_id="sim",
+                                 index_uri=f"ram:///sim{ns}/splits",
+                                 doc_mapper=MAPPER),
+        sources={"src": SourceConfig("src", "vec")}))
+    return metastore, split_storage, resolver
+
+
+def make_docs(n):
+    return [{"ts": 1000 + i, "n": i, "body": f"doc {i}"} for i in range(n)]
+
+
+def searchable_ns(metastore, split_storage) -> list[int]:
+    """All `n` values searchable across published splits."""
+    out = []
+    splits = metastore.list_splits(
+        ListSplitsQuery(index_uids=["sim:01"], states=[SplitState.PUBLISHED]))
+    for split in splits:
+        reader = SplitReader(split_storage, split_file_path(split.metadata.split_id))
+        resp = leaf_search_single_split(
+            SearchRequest(index_ids=["sim"], query_ast=MatchAll(), max_hits=100000),
+            MAPPER, reader, split.metadata.split_id)
+        docs = reader.fetch_docs([h.doc_id for h in resp.partial_hits])
+        out.extend(d["n"] for d in docs)
+    return sorted(out)
+
+
+def run_pipeline(metastore, storage, docs, target=40):
+    pipeline = IndexingPipeline(
+        PipelineParams(index_uid="sim:01", source_id="src",
+                       split_num_docs_target=target, batch_num_docs=25),
+        MAPPER, VecSource(docs), metastore, storage)
+    return pipeline.run_to_completion()
+
+
+@pytest.mark.parametrize("fail_at_write", range(1, 8))
+def test_crash_replay_exactly_once(fail_at_write):
+    """Crash at every storage-write point during indexing, then restart:
+    no loss, no duplicates, whatever the crash point."""
+    metastore, split_storage, resolver = make_env()
+    docs = make_docs(120)
+    crashing = CrashingStorage(split_storage, fail_at_write)
+    try:
+        run_pipeline(metastore, crashing, docs)
+        crashed = False
+    except CrashPoint:
+        crashed = True
+    # restart with healthy storage from the committed checkpoint
+    run_pipeline(metastore, split_storage, docs)
+    ns = searchable_ns(metastore, split_storage)
+    assert ns == list(range(120)), (
+        f"crash at write {fail_at_write} (crashed={crashed}): "
+        f"{len(ns)} docs searchable, loss/dup detected")
+
+
+def test_merge_crash_preserves_originals():
+    """A merge that crashes before publish leaves the original splits
+    published and all rows searchable (no_split_loss)."""
+    metastore, split_storage, resolver = make_env()
+    run_pipeline(metastore, split_storage, make_docs(120), target=40)
+    splits = metastore.list_splits(
+        ListSplitsQuery(index_uids=["sim:01"], states=[SplitState.PUBLISHED]))
+    assert len(splits) == 3
+    # crash during the merged-split upload (first write of the merge)
+    crashing = CrashingStorage(split_storage, fail_at_write=1)
+    executor = MergeExecutor("sim:01", MAPPER, metastore, crashing)
+    with pytest.raises(CrashPoint):
+        executor.execute(MergeOperation(tuple(splits)))
+    assert searchable_ns(metastore, split_storage) == list(range(120))
+    # staged-but-never-uploaded merge split gets GC'd later
+    stats = run_garbage_collection(metastore, resolver,
+                                   staged_grace_secs=0, deletion_grace_secs=0,
+                                   now=10**12)
+    staged = metastore.list_splits(
+        ListSplitsQuery(index_uids=["sim:01"], states=[SplitState.STAGED]))
+    assert staged == []
+    # and the docs are still all there
+    assert searchable_ns(metastore, split_storage) == list(range(120))
+
+
+def test_randomized_schedules_conserve_rows():
+    """Randomized interleavings of ingest/merge/GC keep every row exactly
+    once (rows_conserved across the whole state machine)."""
+    rng = np.random.RandomState(1234)
+    for trial in range(5):
+        metastore, split_storage, resolver = make_env()
+        expected: list[int] = []
+        next_n = 0
+        policy = StableLogMergePolicy(merge_factor=2, max_merge_factor=3,
+                                      min_level_num_docs=10)
+        for step in range(rng.randint(4, 9)):
+            op = rng.choice(["ingest", "merge", "gc"])
+            if op == "ingest":
+                count = int(rng.randint(5, 60))
+                docs = [{"ts": 1000 + n, "n": n, "body": f"doc {n}"}
+                        for n in range(next_n, next_n + count)]
+                expected.extend(range(next_n, next_n + count))
+                next_n += count
+                # fresh source each time: simulates a new partition
+                pipeline = IndexingPipeline(
+                    PipelineParams(index_uid="sim:01", source_id="src",
+                                   split_num_docs_target=30, batch_num_docs=20),
+                    MAPPER, VecSource(docs, partition_id=f"p{step}-{trial}"),
+                    metastore, split_storage)
+                pipeline.run_to_completion()
+            elif op == "merge":
+                splits = metastore.list_splits(ListSplitsQuery(
+                    index_uids=["sim:01"], states=[SplitState.PUBLISHED]))
+                for operation in policy.operations(splits):
+                    MergeExecutor("sim:01", MAPPER, metastore,
+                                  split_storage).execute(operation)
+            else:
+                run_garbage_collection(metastore, resolver,
+                                       staged_grace_secs=0,
+                                       deletion_grace_secs=0, now=10**12)
+            ns = searchable_ns(metastore, split_storage)
+            assert ns == expected, f"trial {trial} step {step} op {op}"
+
+
+def test_gc_never_deletes_published_files():
+    metastore, split_storage, resolver = make_env()
+    run_pipeline(metastore, split_storage, make_docs(80), target=40)
+    run_garbage_collection(metastore, resolver, staged_grace_secs=0,
+                           deletion_grace_secs=0, now=10**12)
+    for split in metastore.list_splits(ListSplitsQuery(
+            index_uids=["sim:01"], states=[SplitState.PUBLISHED])):
+        assert split_storage.exists(split_file_path(split.metadata.split_id))
