@@ -1,0 +1,3 @@
+from .score_topk import fused_score_topk, pallas_available
+
+__all__ = ["fused_score_topk", "pallas_available"]

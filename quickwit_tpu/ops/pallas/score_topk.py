@@ -1,0 +1,113 @@
+"""Pallas TPU kernel: fused BM25 scoring + phase-1 top-k over posting blocks.
+
+The posting-space hot loop (executor.py `_build_posting_space`) is
+score → keyed → exact_topk: three HBM round-trips over the [P] posting
+arrays. This kernel fuses them: each grid block streams one postings tile
+HBM→VMEM, computes BM25 on the VPU, and reduces to its local top-k via an
+unrolled iterative max — so scores never materialize in HBM. The host wraps
+the [grid, k] block winners with one tiny `lax.top_k`.
+
+Block layout: tiles of (8, 128) f32 respect the VPU tiling constraints
+(pallas_guide.md); K iterations of (max, argmax, mask-out) stay in VMEM.
+
+Enable on TPU with QW_PALLAS=1 (default off until hardware-validated;
+interpret mode backs the CPU tests either way).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..bm25 import B, K1
+
+BLOCK = 1024            # postings per grid step (8 x 128 f32 tiles)
+_SUBLANES = 8
+_LANES = 128
+
+
+def pallas_available() -> bool:
+    if os.environ.get("QW_PALLAS") == "1":
+        return True
+    return False
+
+
+def _kernel(ids_ref, tfs_ref, norms_ref, scalar_ref, nd_ref, vals_ref, idx_ref,
+            *, k: int):
+    from jax.experimental import pallas as pl  # noqa: F401 (doc import)
+
+    idf = scalar_ref[0]
+    avg_len = scalar_ref[1]
+    num_docs = nd_ref[0]  # exact i32 (f32 would round above 2^24)
+
+    ids = ids_ref[...].reshape(_SUBLANES, _LANES * (BLOCK // (_SUBLANES * _LANES)))
+    tfs = tfs_ref[...].reshape(ids.shape).astype(jnp.float32)
+    norms = norms_ref[...].reshape(ids.shape).astype(jnp.float32)
+
+    denom = tfs + K1 * (1.0 - B + B * norms / jnp.maximum(avg_len, 1e-9))
+    scores = (idf * (K1 + 1.0)) * tfs / jnp.maximum(denom, 1e-9)
+    valid = (tfs > 0) & (ids < num_docs)
+    keyed = jnp.where(valid, scores, -jnp.inf)
+
+    flat = keyed.reshape(-1)
+    local = jnp.arange(flat.shape[0], dtype=jnp.int32)
+    for j in range(k):
+        best = jnp.argmax(flat)
+        vals_ref[0, j] = flat[best]
+        idx_ref[0, j] = local[best]
+        flat = flat.at[best].set(-jnp.inf)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+def fused_score_topk(ids: jnp.ndarray, tfs: jnp.ndarray,
+                     norms_gathered: jnp.ndarray, idf: jnp.ndarray,
+                     avg_len: jnp.ndarray, num_docs: jnp.ndarray,
+                     k: int = 10, interpret: bool = False):
+    """(top_values f32[k], posting_indices i32[k]) of BM25 scores over a
+    padded posting array. `norms_gathered` = fieldnorms[ids] (XLA gather).
+    """
+    from jax.experimental import pallas as pl
+
+    num_postings = ids.shape[0]
+    padded = ((num_postings + BLOCK - 1) // BLOCK) * BLOCK
+    if padded != num_postings:
+        pad = padded - num_postings
+        ids = jnp.pad(ids, (0, pad), constant_values=2**31 - 1)
+        tfs = jnp.pad(tfs, (0, pad))
+        norms_gathered = jnp.pad(norms_gathered, (0, pad))
+    grid = padded // BLOCK
+    scalars = jnp.stack([jnp.asarray(idf, jnp.float32),
+                         jnp.asarray(avg_len, jnp.float32)])
+    nd = jnp.asarray(num_docs, jnp.int32).reshape(1)
+
+    vals, idx = pl.pallas_call(
+        functools.partial(_kernel, k=k),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((BLOCK,), lambda i: (i,)),
+            pl.BlockSpec((BLOCK,), lambda i: (i,)),
+            pl.BlockSpec((BLOCK,), lambda i: (i,)),
+            pl.BlockSpec((2,), lambda i: (0,)),
+            pl.BlockSpec((1,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, k), lambda i: (i, 0)),
+            pl.BlockSpec((1, k), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((grid, k), jnp.float32),
+            jax.ShapeDtypeStruct((grid, k), jnp.int32),
+        ],
+        interpret=interpret,
+    )(ids.astype(jnp.int32), tfs, norms_gathered, scalars, nd)
+
+    # phase 2: merge the per-block winners (grid*k elements, tiny)
+    block_base = (jnp.arange(grid, dtype=jnp.int32) * BLOCK)[:, None]
+    global_idx = (idx + block_base).reshape(-1)
+    flat_vals = vals.reshape(-1)
+    top_vals, pos = jax.lax.top_k(flat_vals, k)
+    return top_vals, global_idx[pos]

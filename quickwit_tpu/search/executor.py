@@ -36,7 +36,155 @@ from .plan import (
 _JIT_CACHE: dict[tuple, Callable] = {}
 
 
+def _eval_bucket_agg(a: BucketAggExec, arrays, scalars, mask):
+    values = arrays[a.values_slot]
+    nb = a.num_buckets
+    if a.kind == "terms":
+        ordinals = values
+        m = mask & (ordinals >= 0)
+        idx = jnp.where(m, ordinals, jnp.int32(nb))
+    else:
+        present = arrays[a.present_slot].astype(jnp.bool_)
+        m = mask & present
+        origin = scalars[a.origin_slot]
+        interval = scalars[a.interval_slot]
+        if a.kind == "date_histogram":
+            raw = (values - origin) // interval          # exact i64 math
+        else:
+            raw = jnp.floor((values.astype(jnp.float64) - origin) / interval)
+        idx = raw.astype(jnp.int32)
+        m = m & (idx >= 0) & (idx < nb)
+        idx = jnp.where(m, idx, jnp.int32(nb))
+    counts = agg_ops.bucket_counts(idx, nb)
+    out: dict[str, Any] = {"counts": counts}
+    metrics: dict[str, Any] = {}
+    for met in a.metrics:
+        mv = arrays[met.values_slot].astype(jnp.float64)
+        mp = arrays[met.present_slot].astype(jnp.bool_)
+        # docs with mm==False get the sentinel index; both bucket-kernel
+        # paths neutralize them, so mv needs no extra masking passes
+        mm = m & mp
+        midx = jnp.where(mm, idx, jnp.int32(nb))
+        state: dict[str, Any] = {}
+        need = met.kind
+        if need in ("sum", "avg", "stats"):
+            state["sum"] = agg_ops.bucket_sum(midx, mv, nb)
+        if need in ("avg", "stats", "value_count"):
+            state["count"] = agg_ops.bucket_counts(midx, nb).astype(jnp.int64)
+        if need in ("min", "stats"):
+            state["min"] = agg_ops.bucket_min(midx, mv, nb)
+        if need in ("max", "stats"):
+            state["max"] = agg_ops.bucket_max(midx, mv, nb)
+        if need == "stats":
+            state["sum_sq"] = agg_ops.bucket_sum(midx, mv * mv, nb)
+        metrics[met.name] = state
+    out["metrics"] = metrics
+    return out
+
+
+def _posting_space_eligible(plan: LoweredPlan) -> bool:
+    """Single-term queries (no boolean structure, no NOT semantics) can
+    execute entirely over the [P] posting arrays instead of [N] dense docs —
+    the role of the reference's specialized single-term scorer, with P often
+    orders of magnitude below the doc count."""
+    return (isinstance(plan.root, PPostings)
+            and plan.search_after_relation == "none")
+
+
+class _GatherView:
+    """arrays[slot] gathered at per-posting doc ids — lets the bucket-agg
+    evaluator run unchanged in posting space."""
+
+    def __init__(self, arrays, safe_ids):
+        self.arrays = arrays
+        self.safe_ids = safe_ids
+
+    def __getitem__(self, slot: int):
+        return self.arrays[slot][self.safe_ids]
+
+
+def _build_posting_space(plan: LoweredPlan, k: int) -> Callable:
+    root, sort, aggs = plan.root, plan.sort, plan.aggs
+    padded = plan.num_docs_padded
+
+    def fn(arrays, scalars, num_docs):
+        ids = arrays[root.ids_slot]
+        tfs = arrays[root.tfs_slot]
+        num_postings = ids.shape[0]
+        valid = ids < num_docs
+        count = jnp.sum(valid.astype(jnp.int32))
+        safe_ids = jnp.clip(ids, 0, padded - 1)
+        from ..ops.pallas import fused_score_topk, pallas_available
+        if (sort.by == "score" and root.scoring and pallas_available()
+                and k <= 64):
+            # QW_PALLAS=1: fused scoring + phase-1 top-k — the dense [P]
+            # scores array never materializes; hit scores come straight from
+            # the kernel's winners
+            vals_f32, pos = fused_score_topk(
+                ids, tfs, arrays[root.norm_slot][safe_ids],
+                scalars[root.idf_slot], scalars[root.avg_len_slot],
+                num_docs, k=min(k, num_postings),
+                interpret=jax.default_backend() == "cpu")
+            sort_vals = vals_f32.astype(jnp.float64)
+            doc_ids = ids[pos]
+            hit_scores = jnp.where(jnp.isneginf(vals_f32), 0.0, vals_f32)
+            gathered = _GatherView(arrays, safe_ids)
+            agg_out = _eval_aggs(aggs, gathered, scalars, valid)
+            return sort_vals, doc_ids.astype(jnp.int32), hit_scores, count, \
+                tuple(agg_out)
+        if root.scoring:
+            scores = score_postings(
+                tfs, ids, arrays[root.norm_slot],
+                scalars[root.avg_len_slot], scalars[root.idf_slot])
+        else:
+            scores = jnp.zeros(num_postings, dtype=jnp.float32)
+        if sort.by == "score":
+            keyed = jnp.where(valid, scores.astype(jnp.float64), -jnp.inf)
+        elif sort.by == "column":
+            key = arrays[sort.values_slot][safe_ids].astype(jnp.float64)
+            if not sort.descending:
+                key = -key
+            has_value = valid & arrays[sort.present_slot][safe_ids].astype(jnp.bool_)
+            keyed = jnp.where(
+                has_value, key,
+                jnp.where(valid, jnp.float64(topk_ops.MISSING_VALUE_SENTINEL),
+                          -jnp.inf))
+        else:  # "_doc": posting ids are doc-id ascending already
+            key = ids.astype(jnp.float64)
+            keyed = jnp.where(valid, key if sort.descending else -key, -jnp.inf)
+        sort_vals, pos = topk_ops.exact_topk(keyed, min(k, num_postings))
+        doc_ids = ids[pos]
+        hit_scores = scores[pos]
+        # aggregations run over per-posting gathered values
+        gathered = _GatherView(arrays, safe_ids)
+        agg_out = _eval_aggs(aggs, gathered, scalars, valid)
+        return sort_vals, doc_ids.astype(jnp.int32), hit_scores, count, \
+            tuple(agg_out)
+
+    return fn
+
+
+def _eval_aggs(aggs, gathered, scalars, valid):
+    agg_out = []
+    for a in aggs:
+        if isinstance(a, BucketAggExec):
+            agg_out.append(_eval_bucket_agg(a, gathered, scalars, valid))
+        elif isinstance(a, MetricAggExec):
+            met = a.metric
+            mv = gathered[met.values_slot]
+            mp = gathered[met.present_slot]
+            if met.kind == "percentiles":
+                agg_out.append({"sketch": agg_ops.percentile_sketch(mv, mp, valid)})
+            else:
+                agg_out.append({"stats": agg_ops.stats_state(mv, mp, valid)})
+        else:
+            raise TypeError(f"unknown agg exec {type(a).__name__}")
+    return agg_out
+
+
 def _build(plan: LoweredPlan, k: int) -> Callable:
+    if _posting_space_eligible(plan):
+        return _build_posting_space(plan, k)
     padded = plan.num_docs_padded
     root, sort, aggs = plan.root, plan.sort, plan.aggs
 
@@ -107,51 +255,6 @@ def _build(plan: LoweredPlan, k: int) -> Callable:
                 scores = scores + s
         return mask, scores
 
-    def eval_bucket_agg(a: BucketAggExec, arrays, scalars, mask):
-        values = arrays[a.values_slot]
-        nb = a.num_buckets
-        if a.kind == "terms":
-            ordinals = values
-            m = mask & (ordinals >= 0)
-            idx = jnp.where(m, ordinals, jnp.int32(nb))
-        else:
-            present = arrays[a.present_slot].astype(jnp.bool_)
-            m = mask & present
-            origin = scalars[a.origin_slot]
-            interval = scalars[a.interval_slot]
-            if a.kind == "date_histogram":
-                raw = (values - origin) // interval          # exact i64 math
-            else:
-                raw = jnp.floor((values.astype(jnp.float64) - origin) / interval)
-            idx = raw.astype(jnp.int32)
-            m = m & (idx >= 0) & (idx < nb)
-            idx = jnp.where(m, idx, jnp.int32(nb))
-        counts = agg_ops.bucket_counts(idx, nb)
-        out: dict[str, Any] = {"counts": counts}
-        metrics: dict[str, Any] = {}
-        for met in a.metrics:
-            mv = arrays[met.values_slot].astype(jnp.float64)
-            mp = arrays[met.present_slot].astype(jnp.bool_)
-            # docs with mm==False get the sentinel index; both bucket-kernel
-            # paths neutralize them, so mv needs no extra masking passes
-            mm = m & mp
-            midx = jnp.where(mm, idx, jnp.int32(nb))
-            state: dict[str, Any] = {}
-            need = met.kind
-            if need in ("sum", "avg", "stats"):
-                state["sum"] = agg_ops.bucket_sum(midx, mv, nb)
-            if need in ("avg", "stats", "value_count"):
-                state["count"] = agg_ops.bucket_counts(midx, nb).astype(jnp.int64)
-            if need in ("min", "stats"):
-                state["min"] = agg_ops.bucket_min(midx, mv, nb)
-            if need in ("max", "stats"):
-                state["max"] = agg_ops.bucket_max(midx, mv, nb)
-            if need == "stats":
-                state["sum_sq"] = agg_ops.bucket_sum(midx, mv * mv, nb)
-            metrics[met.name] = state
-        out["metrics"] = metrics
-        return out
-
     def fn(arrays, scalars, num_docs):
         mask, scores = eval_node(root, arrays, scalars)
         mask = mask & mask_ops.valid_docs_mask(num_docs, padded)
@@ -191,20 +294,7 @@ def _build(plan: LoweredPlan, k: int) -> Callable:
         doc_ids = doc_ids.astype(jnp.int32)
         count = jnp.sum(mask.astype(jnp.int32))
         hit_scores = scores[jnp.clip(doc_ids, 0, padded - 1)]
-        agg_out = []
-        for a in aggs:
-            if isinstance(a, BucketAggExec):
-                agg_out.append(eval_bucket_agg(a, arrays, scalars, mask))
-            elif isinstance(a, MetricAggExec):
-                met = a.metric
-                mv = arrays[met.values_slot]
-                mp = arrays[met.present_slot]
-                if met.kind == "percentiles":
-                    agg_out.append({"sketch": agg_ops.percentile_sketch(mv, mp, mask)})
-                else:
-                    agg_out.append({"stats": agg_ops.stats_state(mv, mp, mask)})
-            else:
-                raise TypeError(f"unknown agg exec {type(a).__name__}")
+        agg_out = _eval_aggs(aggs, arrays, scalars, mask)
         return sort_vals, doc_ids, hit_scores, count, tuple(agg_out)
 
     return fn

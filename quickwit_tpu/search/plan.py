@@ -561,42 +561,56 @@ class Lowering:
             fm = self._field(spec.field)
             if fm.type is not FieldType.DATETIME or not fm.fast:
                 raise PlanError("date_histogram requires a fast datetime field")
-            values_slot, present_slot = self._column_slots(spec.field)
             meta = self.reader.field_meta(spec.field)
             vmin, vmax = meta.get("min_value"), meta.get("max_value")
+            interval = spec.interval_micros
+            # resolve the bucket space (batch-global origin wins)
             if self.batch is not None and spec.name in self.batch.get("histograms", {}):
                 origin, num_buckets = self.batch["histograms"][spec.name]
-                return BucketAggExec(
-                    spec.name, "date_histogram", values_slot, present_slot,
-                    num_buckets,
-                    self.b.add_scalar(origin, np.int64),
-                    self.b.add_scalar(spec.interval_micros, np.int64),
-                    metrics=self._metric_tuple(spec.sub_metrics),
-                    host_info={"interval": spec.interval_micros, "origin": origin,
-                               "min_doc_count": spec.min_doc_count,
-                               "extended_bounds": spec.extended_bounds})
-            if vmin is None:
-                return BucketAggExec(spec.name, "date_histogram", values_slot,
-                                     present_slot, 1,
-                                     self.b.add_scalar(0, np.int64),
-                                     self.b.add_scalar(spec.interval_micros, np.int64),
-                                     metrics=self._metric_tuple(spec.sub_metrics),
-                                     host_info={"interval": spec.interval_micros,
-                                                "origin": 0,
-                                                "min_doc_count": spec.min_doc_count})
-            if spec.extended_bounds:
-                vmin = min(vmin, spec.extended_bounds[0])
-                vmax = max(vmax, spec.extended_bounds[1])
-            interval = spec.interval_micros
-            origin = (vmin // interval) * interval
-            num_buckets = int((vmax - origin) // interval) + 1
-            if num_buckets > MAX_BUCKETS:
-                raise PlanError(
-                    f"date_histogram would create {num_buckets} buckets (max {MAX_BUCKETS})")
+            elif vmin is None:
+                origin, num_buckets = 0, 1
+            else:
+                lo, hi = vmin, vmax
+                if spec.extended_bounds:
+                    lo = min(lo, spec.extended_bounds[0])
+                    hi = max(hi, spec.extended_bounds[1])
+                origin = (lo // interval) * interval
+                num_buckets = int((hi - origin) // interval) + 1
+                if num_buckets > MAX_BUCKETS:
+                    raise PlanError(
+                        f"date_histogram would create {num_buckets} buckets "
+                        f"(max {MAX_BUCKETS})")
+            # i32 seconds fast path: i64 division is emulated on TPU; for
+            # whole-second intervals the bucket index computes on a derived
+            # (ts_micros//1e6 - base_s) i32 column (base cancels per split)
+            base_s = (vmin // 1_000_000) if vmin is not None else 0
+            # guard the full i32 range: value offsets span (vmax-vmin)/1e6 and
+            # the in-kernel (value - origin) subtraction adds |origin offset|;
+            # batches must stay on the i64 path (per-split vmin would lower
+            # splits to different structures and break batch uniformity)
+            use_s32 = (interval % 1_000_000 == 0
+                       and self.batch is None
+                       and vmin is not None
+                       and (vmax // 1_000_000 - base_s)
+                       + abs(origin // 1_000_000 - base_s) < 2**31)
+            if use_s32:
+                values_slot = self.b.add_array(
+                    f"col.{spec.field}.values_s32",
+                    lambda: self._seconds_column(spec.field, base_s))
+                # present column only — the i64 values column is not read
+                present_slot = self.b.add_array(
+                    f"col.{spec.field}.present",
+                    lambda: self.reader.column_values(spec.field)[1])
+                origin_slot = self.b.add_scalar(
+                    origin // 1_000_000 - base_s, np.int32)
+                interval_slot = self.b.add_scalar(interval // 1_000_000, np.int32)
+            else:
+                values_slot, present_slot = self._column_slots(spec.field)
+                origin_slot = self.b.add_scalar(origin, np.int64)
+                interval_slot = self.b.add_scalar(interval, np.int64)
             return BucketAggExec(
-                spec.name, "date_histogram", values_slot, present_slot, num_buckets,
-                self.b.add_scalar(origin, np.int64),
-                self.b.add_scalar(interval, np.int64),
+                spec.name, "date_histogram", values_slot, present_slot,
+                num_buckets, origin_slot, interval_slot,
                 metrics=self._metric_tuple(spec.sub_metrics),
                 host_info={"interval": interval, "origin": origin,
                            "min_doc_count": spec.min_doc_count,
@@ -689,6 +703,19 @@ class Lowering:
 
     def _ordinalize_numeric(self, field: str):
         return ordinalize_numeric_column(self.reader, field)
+
+    def _seconds_column(self, field: str, base_s: int) -> np.ndarray:
+        """Derived i32 seconds column, cached per reader."""
+        cache_key = f"_s32.{field}.{base_s}"
+        cache = getattr(self.reader, "_dyn_cache", None)
+        if cache is None:
+            cache = self.reader._dyn_cache = {}
+        cached = cache.get(cache_key)
+        if cached is None:
+            values, _present = self.reader.column_values(field)
+            cached = (values // 1_000_000 - base_s).astype(np.int32)
+            cache[cache_key] = cached
+        return cached
 
     # --- sort -------------------------------------------------------------
     def lower_sort(self, sort_field: str, order: str) -> SortExec:

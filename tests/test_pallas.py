@@ -1,0 +1,64 @@
+"""Pallas fused score+topk kernel vs the XLA reference path (interpret
+mode on CPU; the same kernel runs compiled on TPU behind QW_PALLAS=1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quickwit_tpu.ops.bm25 import score_postings
+from quickwit_tpu.ops.pallas.score_topk import fused_score_topk
+from quickwit_tpu.ops.topk import exact_topk
+
+
+def reference(ids, tfs, norms_gathered, idf, avg_len, num_docs, k):
+    scores = score_postings(tfs, ids, jnp.asarray(norms_gathered), avg_len, idf)
+    # reference gathers from dense norms; here norms are pre-gathered, so
+    # emulate by feeding an identity gather
+    valid = (tfs > 0) & (ids < num_docs)
+    keyed = jnp.where(valid, scores.astype(jnp.float64), -jnp.inf)
+    vals, pos = exact_topk(keyed, k)
+    return np.asarray(vals, dtype=np.float32), np.asarray(pos)
+
+
+@pytest.mark.parametrize("num_postings,k", [(1024, 10), (4096, 5), (5000, 10)])
+def test_fused_score_topk_matches_reference(num_postings, k):
+    rng = np.random.RandomState(num_postings)
+    num_docs = 100_000
+    ids = np.sort(rng.choice(num_docs, num_postings, replace=False)).astype(np.int32)
+    tfs = rng.randint(1, 5, num_postings).astype(np.int32)
+    # pad tail: sentinel ids + zero tf (as the split format produces)
+    tfs[-64:] = 0
+    ids[-64:] = 2**30
+    norms = rng.randint(1, 50, num_postings).astype(np.int32)
+    idf = jnp.float32(2.17)
+    avg_len = jnp.float32(9.3)
+
+    got_vals, got_idx = fused_score_topk(
+        jnp.asarray(ids), jnp.asarray(tfs), jnp.asarray(norms),
+        idf, avg_len, jnp.int32(num_docs), k=k, interpret=True)
+
+    # reference path: score_postings gathers norms from a dense array; build
+    # an equivalent dense array so both see identical per-posting norms
+    dense_norms = np.ones(num_docs + 1, dtype=np.int32)
+    safe = np.clip(ids, 0, num_docs)
+    dense_norms[safe] = norms
+    scores = score_postings(jnp.asarray(tfs), jnp.asarray(np.clip(ids, 0, num_docs)),
+                            jnp.asarray(dense_norms), avg_len, idf)
+    valid = (np.asarray(tfs) > 0) & (ids < num_docs)
+    keyed = jnp.where(jnp.asarray(valid), scores.astype(jnp.float64), -jnp.inf)
+    exp_vals, exp_pos = exact_topk(keyed, k)
+
+    np.testing.assert_allclose(np.asarray(got_vals), np.asarray(exp_vals, dtype=np.float32),
+                               rtol=1e-6)
+    assert np.array_equal(np.asarray(got_idx), np.asarray(exp_pos))
+
+
+def test_fused_score_topk_all_invalid():
+    ids = np.full(1024, 2**30, dtype=np.int32)
+    tfs = np.zeros(1024, dtype=np.int32)
+    norms = np.ones(1024, dtype=np.int32)
+    vals, idx = fused_score_topk(
+        jnp.asarray(ids), jnp.asarray(tfs), jnp.asarray(norms),
+        jnp.float32(1.0), jnp.float32(1.0), jnp.int32(100), k=3, interpret=True)
+    assert np.all(np.isneginf(np.asarray(vals)))
