@@ -30,9 +30,40 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 NUM_DOCS = int(os.environ.get("BENCH_NUM_DOCS", 10_000_000))
 ITERATIONS = int(os.environ.get("BENCH_ITERS", 30))
+DEVICE_TIMEOUT_SECS = int(os.environ.get("BENCH_DEVICE_TIMEOUT", 180))
+
+
+def _ensure_device_or_fall_back() -> str:
+    """TPU device init can hang indefinitely if the accelerator tunnel is
+    wedged (and blocks in native code, so in-process watchdogs don't fire);
+    probe it in a killable subprocess and fall back to CPU so the benchmark
+    always emits its JSON line."""
+    import subprocess
+    if os.environ.get("QW_JAX_PLATFORM"):
+        return os.environ["QW_JAX_PLATFORM"]
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, timeout=DEVICE_TIMEOUT_SECS)
+        if probe.returncode == 0:
+            platform = probe.stdout.decode().strip().splitlines()[-1]
+            print(f"# device probe: {platform}", file=sys.stderr)
+            return platform
+        print(f"# device probe failed: {probe.stderr.decode()[-200:]}",
+              file=sys.stderr)
+    except subprocess.TimeoutExpired:
+        print(f"# device init exceeded {DEVICE_TIMEOUT_SECS}s; "
+              "falling back to CPU", file=sys.stderr)
+    os.execve(sys.executable,
+              [sys.executable, os.path.abspath(__file__)],
+              {**os.environ, "QW_JAX_PLATFORM": "cpu",
+               "BENCH_PLATFORM_NOTE": "cpu-fallback"})
+    return "unreachable"
 
 
 def main() -> None:
+    platform = _ensure_device_or_fall_back()
     from __graft_entry__ import _flagship_request, _reader_for
     from quickwit_tpu.index.synthetic import HDFS_MAPPER
     from quickwit_tpu.search.leaf import leaf_search_single_split
@@ -63,9 +94,10 @@ def main() -> None:
           f"warmup(compile+transfer)={warm_s:.1f}s, "
           f"p50={p50_ms:.2f}ms p90={p90_ms:.2f}ms, "
           f"num_hits={resp.num_hits}", file=sys.stderr)
+    note = os.environ.get("BENCH_PLATFORM_NOTE", platform)
     print(json.dumps({
         "metric": "hdfs-logs leaf_search p50 (term+date_histogram+terms, "
-                  f"{NUM_DOCS/1e6:.0f}M docs, 1 chip)",
+                  f"{NUM_DOCS/1e6:.0f}M docs, 1 chip, {note})",
         "value": round(p50_ms, 2),
         "unit": "ms",
         "vs_baseline": round(1000.0 / p50_ms, 2),

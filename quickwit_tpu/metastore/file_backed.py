@@ -27,6 +27,7 @@ from .base import ListSplitsQuery, Metastore, MetastoreError
 from .checkpoint import CheckpointDelta, IncompatibleCheckpointDelta, SourceCheckpoint
 
 MANIFEST_PATH = "indexes.json"
+TEMPLATES_PATH = "templates.json"
 
 
 def _state_path(index_id: str) -> str:
@@ -118,6 +119,52 @@ class FileBackedMetastore(Metastore):
                 f"index uid mismatch: {index_uid!r} (current incarnation: "
                 f"{state.metadata.index_uid!r})", kind="not_found")
         return state
+
+    # --- index templates ---------------------------------------------------
+    # (reference: quickwit-config/src/index_template/mod.rs — templates match
+    # index-id patterns and seed auto-created indexes)
+    def _load_templates(self) -> list[dict]:
+        try:
+            return json.loads(self.storage.get_all(TEMPLATES_PATH))
+        except StorageError:
+            return []
+
+    def create_index_template(self, template: dict) -> None:
+        patterns = template.get("index_id_patterns")
+        if (not isinstance(template.get("template_id"), str)
+                or not isinstance(patterns, list) or not patterns
+                or not all(isinstance(p, str) for p in patterns)):
+            raise MetastoreError(
+                "template requires a string template_id and a non-empty "
+                "list of string index_id_patterns", kind="invalid_argument")
+        with self._lock:
+            templates = [t for t in self._load_templates()
+                         if t["template_id"] != template["template_id"]]
+            templates.append(template)
+            self.storage.put(TEMPLATES_PATH, json.dumps(templates).encode())
+
+    def list_index_templates(self) -> list[dict]:
+        with self._lock:
+            return self._load_templates()
+
+    def delete_index_template(self, template_id: str) -> None:
+        with self._lock:
+            templates = self._load_templates()
+            kept = [t for t in templates if t["template_id"] != template_id]
+            if len(kept) == len(templates):
+                raise MetastoreError(f"template {template_id!r} not found",
+                                     kind="not_found")
+            self.storage.put(TEMPLATES_PATH, json.dumps(kept).encode())
+
+    def find_index_template(self, index_id: str):
+        import fnmatch
+        candidates = [
+            t for t in self.list_index_templates()
+            if any(fnmatch.fnmatch(index_id, p) for p in t["index_id_patterns"])
+        ]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda t: t.get("priority", 0))
 
     # --- index lifecycle ---------------------------------------------------
     def create_index(self, index_metadata: IndexMetadata) -> None:

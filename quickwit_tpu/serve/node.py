@@ -173,7 +173,7 @@ class Node:
     # per-request; the WAL-based v2 path lives in quickwit_tpu.ingest)
     def ingest(self, index_id: str, docs: list[dict],
                commit: str = "auto") -> dict[str, Any]:
-        metadata = self.metastore.index_metadata(index_id)
+        metadata = self._metadata_or_template(index_id)
         doc_mapper = metadata.index_config.doc_mapper
         storage = self.storage_resolver.resolve(metadata.index_config.index_uri)
         params = PipelineParams(
@@ -190,11 +190,36 @@ class Node:
                 "num_ingested_docs": counters.num_docs_processed,
                 "num_invalid_docs": counters.num_docs_invalid}
 
+    def _metadata_or_template(self, index_id: str) -> IndexMetadata:
+        """Existing index, or auto-created from a matching index template
+        (reference: template matching by index-id patterns)."""
+        from ..metastore.base import MetastoreError
+        try:
+            return self.metastore.index_metadata(index_id)
+        except MetastoreError as exc:
+            if exc.kind != "not_found":
+                raise
+            template = getattr(self.metastore, "find_index_template",
+                               lambda _i: None)(index_id)
+            if template is None:
+                raise
+            config = dict(template.get("index_config", {}))
+            config["index_id"] = index_id
+            logger.info("auto-creating index %s from template %s",
+                        index_id, template["template_id"])
+            try:
+                return self.index_service.create_index(config)
+            except MetastoreError as create_exc:
+                if create_exc.kind != "already_exists":
+                    raise
+                # lost a concurrent auto-create race: the index exists now
+                return self.metastore.index_metadata(index_id)
+
     # ------------------------------------------------------------------
     def ingest_v2(self, index_id: str, docs: list[dict]) -> dict[str, Any]:
         """Durable WAL ingest (v2 path): docs are fsync'd into shard queues
         and become searchable after the next ingest pipeline pass."""
-        metadata = self.metastore.index_metadata(index_id)
+        metadata = self._metadata_or_template(index_id)
         return self.ingest_router.ingest(metadata.index_uid, docs)
 
     def run_ingest_pass(self, index_id: str) -> dict[str, Any]:

@@ -166,6 +166,17 @@ class RestServer:
                          "roles": list(node.config.roles),
                          "rest_endpoint": f"{self.host}:{self.port}"}
 
+        # --- index templates ------------------------------------------
+        if path == "/api/v1/templates" and method == "POST":
+            node.metastore.create_index_template(json.loads(body))
+            return 200, {"created": True}
+        if path == "/api/v1/templates" and method == "GET":
+            return 200, node.metastore.list_index_templates()
+        m = re.fullmatch(r"/api/v1/templates/([^/]+)", path)
+        if m and method == "DELETE":
+            node.metastore.delete_index_template(m.group(1))
+            return 200, {"deleted": True}
+
         # --- index management -----------------------------------------
         if path == "/api/v1/indexes" and method == "POST":
             metadata = node.index_service.create_index(json.loads(body))
@@ -460,6 +471,7 @@ def _make_handler(server: RestServer):
                 status, payload = 400, {"message": str(exc)}
             except MetastoreError as exc:
                 code = {"not_found": 404, "already_exists": 400,
+                        "invalid_argument": 400,
                         "failed_precondition": 409}.get(exc.kind, 500)
                 status, payload = code, {"message": str(exc)}
             except Exception as exc:  # noqa: BLE001

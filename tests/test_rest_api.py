@@ -332,3 +332,41 @@ def test_scroll_deep_pagination_past_window(api, monkeypatch):
         seen.extend((h["split_id"], h["doc_id"]) for h in page["hits"])
     assert len(seen) == total
     assert len(set(seen)) == total  # no duplicates, no gaps
+
+
+def test_index_templates_auto_create(api):
+    template = {
+        "template_id": "logs-template",
+        "index_id_patterns": ["applogs-*"],
+        "priority": 10,
+        "index_config": {
+            "doc_mapping": {
+                "field_mappings": [
+                    {"name": "ts", "type": "datetime", "fast": True,
+                     "input_formats": ["unix_timestamp"]},
+                    {"name": "body", "type": "text"},
+                ],
+                "timestamp_field": "ts",
+                "default_search_fields": ["body"],
+            },
+        },
+    }
+    status, _ = api.request("POST", "/api/v1/templates", template)
+    assert status == 200
+    status, templates = api.request("GET", "/api/v1/templates")
+    assert any(t["template_id"] == "logs-template" for t in templates)
+    # ingesting into a missing index matching the pattern auto-creates it
+    doc = json.dumps({"ts": 1_600_000_000, "body": "templated doc"}).encode()
+    status, result = api.request("POST", "/api/v1/applogs-web/ingest", doc)
+    assert status == 200 and result["num_ingested_docs"] == 1
+    status, result = api.request(
+        "GET", "/api/v1/applogs-web/search?query=templated")
+    assert result["num_hits"] == 1
+    # non-matching index still 404s
+    status, _ = api.request("POST", "/api/v1/otherlogs/ingest", doc)
+    assert status == 404
+    # template delete
+    status, _ = api.request("DELETE", "/api/v1/templates/logs-template")
+    assert status == 200
+    status, _ = api.request("POST", "/api/v1/applogs-db/ingest", doc)
+    assert status == 404
