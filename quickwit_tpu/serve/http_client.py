@@ -11,12 +11,19 @@ import http.client
 import json
 from typing import Any
 
+from ..common.tower import CircuitBreaker, CircuitOpen
 from ..search.models import FetchDocsRequest, LeafSearchRequest, LeafSearchResponse
 from .serializers import leaf_response_from_dict
 
 
 class HttpTransportError(ConnectionError):
-    pass
+    """Connection-level failure (peer unreachable/timeout) — counts toward
+    the circuit breaker."""
+
+
+class HttpStatusError(HttpTransportError):
+    """Peer answered with a non-200 — an application error, NOT evidence the
+    peer is dead; does not open the circuit."""
 
 
 class HttpSearchClient:
@@ -26,8 +33,16 @@ class HttpSearchClient:
         self.host = host
         self.port = int(port)
         self.timeout_secs = timeout_secs
+        # stop hammering a dead peer; root search fails fast to its retry
+        # path instead of stacking timeouts (reference tower circuit breaker)
+        self.circuit = CircuitBreaker(
+            failure_threshold=3, cooldown_secs=5.0,
+            counts_as_failure=lambda exc: not isinstance(exc, HttpStatusError))
 
     def _post(self, path: str, payload: Any) -> Any:
+        return self.circuit.call(lambda: self._post_once(path, payload))
+
+    def _post_once(self, path: str, payload: Any) -> Any:
         conn = http.client.HTTPConnection(self.host, self.port,
                                           timeout=self.timeout_secs)
         try:
@@ -37,7 +52,7 @@ class HttpSearchClient:
             response = conn.getresponse()
             body = response.read()
             if response.status != 200:
-                raise HttpTransportError(
+                raise HttpStatusError(
                     f"{self.endpoint}{path} -> {response.status}: {body[:200]!r}")
             return json.loads(body)
         except (OSError, http.client.HTTPException) as exc:

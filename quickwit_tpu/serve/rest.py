@@ -99,8 +99,14 @@ def _search_response_to_json(response) -> dict[str, Any]:
 
 class RestServer:
     def __init__(self, node: Node, host: Optional[str] = None,
-                 port: Optional[int] = None):
+                 port: Optional[int] = None,
+                 ingest_rate_limit_mb_per_sec: float = 80.0):
         self.node = node
+        from ..common.tower import TokenBucket
+        # byte-cost token bucket on ingest (reference: ingest rate limiting)
+        self.ingest_bucket = TokenBucket(
+            rate_per_sec=ingest_rate_limit_mb_per_sec * 1e6,
+            burst=ingest_rate_limit_mb_per_sec * 2e6)
         self.host = host if host is not None else node.config.rest_host
         self.port = port if port is not None else node.config.rest_port
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -166,6 +172,23 @@ class RestServer:
                          "roles": list(node.config.roles),
                          "rest_endpoint": f"{self.host}:{self.port}"}
 
+        # --- developer / debug ----------------------------------------
+        if path == "/api/v1/developer/debug":
+            import sys as _sys
+            import traceback
+            from ..search.executor import executor_cache_size
+            frames = {}
+            for thread_id, frame in _sys._current_frames().items():
+                frames[str(thread_id)] = traceback.format_stack(frame)[-4:]
+            return 200, {
+                "node_id": node.config.node_id,
+                "jit_cache_entries": executor_cache_size(),
+                "leaf_cache": node.searcher_context.leaf_cache.stats,
+                "open_split_readers": len(node.searcher_context._readers),
+                "wal_shards": node.ingester.shard_throughput_state(),
+                "threads": frames,
+            }
+
         # --- index templates ------------------------------------------
         if path == "/api/v1/templates" and method == "POST":
             node.metastore.create_index_template(json.loads(body))
@@ -201,6 +224,7 @@ class RestServer:
         # --- ingest ----------------------------------------------------
         m = re.fullmatch(r"/api/v1/([^/_][^/]*)/ingest", path)
         if m and method == "POST":
+            self._check_ingest_rate(body)
             docs = _parse_ndjson(body)
             if params.get("commit") == "wal":
                 # v2 path: durable WAL append, indexed by the next ingest pass
@@ -284,6 +308,17 @@ class RestServer:
         raise ApiError(404, f"no route for {method} {path}")
 
     # ------------------------------------------------------------------
+    def _check_ingest_rate(self, body: bytes) -> None:
+        from ..common.tower import RateLimitExceeded
+        cost = max(len(body), 1)
+        if cost > self.ingest_bucket.burst:
+            raise ApiError(413, f"ingest body of {cost} bytes exceeds the "
+                                f"maximum batch size ({int(self.ingest_bucket.burst)})")
+        try:
+            self.ingest_bucket.acquire_or_raise(cost=cost)
+        except RateLimitExceeded as exc:
+            raise ApiError(429, str(exc))
+
     def _default_fields(self, index_pattern: str):
         try:
             metadata = self.node.metastore.index_metadata(
@@ -314,6 +349,7 @@ class RestServer:
             return 200, {"responses": responses}
         m = re.fullmatch(r"(?:/([^/]+))?/_bulk", path)
         if m and method == "POST":
+            self._check_ingest_rate(body)
             return 200, self._es_bulk(m.group(1), body, params)
         if path == "/_cat/indices" or path.startswith("/_cat/indices"):
             out = []

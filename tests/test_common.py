@@ -62,3 +62,51 @@ def test_event_broker_handler_exception_isolated():
     broker.subscribe(Ev, seen.append)
     broker.publish(Ev())
     assert len(seen) == 1
+
+
+def test_token_bucket():
+    import time as _time
+    from quickwit_tpu.common.tower import RateLimitExceeded, TokenBucket
+    bucket = TokenBucket(rate_per_sec=10, burst=100)
+    assert bucket.try_acquire(100)
+    assert not bucket.try_acquire(50)  # drained; refill is 10/s so no flake
+    bucket._tokens = 60                # simulate refill without sleeping
+    assert bucket.try_acquire(50)
+    try:
+        bucket.acquire_or_raise(1000)
+        assert False
+    except RateLimitExceeded:
+        pass
+
+
+def test_circuit_breaker_opens_and_recovers():
+    import time as _time
+    from quickwit_tpu.common.tower import CircuitBreaker, CircuitOpen
+    breaker = CircuitBreaker(failure_threshold=2, cooldown_secs=0.4)
+
+    def boom():
+        raise ConnectionError("down")
+
+    for _ in range(2):
+        try:
+            breaker.call(boom)
+        except ConnectionError:
+            pass
+    assert breaker.state == "open"
+    try:
+        breaker.call(lambda: "never runs")
+        assert False
+    except CircuitOpen:
+        pass
+    _time.sleep(0.45)
+    assert breaker.state == "half-open"
+    assert breaker.call(lambda: "probe ok") == "probe ok"
+    assert breaker.state == "closed"
+    # app errors don't open the circuit when excluded by the predicate
+    picky = CircuitBreaker(failure_threshold=1,
+                           counts_as_failure=lambda e: not isinstance(e, ValueError))
+    try:
+        picky.call(lambda: (_ for _ in ()).throw(ValueError("4xx")))
+    except ValueError:
+        pass
+    assert picky.state == "closed"

@@ -370,3 +370,11 @@ def test_index_templates_auto_create(api):
     assert status == 200
     status, _ = api.request("POST", "/api/v1/applogs-db/ingest", doc)
     assert status == 404
+
+
+def test_developer_debug_endpoint(api):
+    status, debug = api.request("GET", "/api/v1/developer/debug")
+    assert status == 200
+    assert debug["node_id"] == "rest-node"
+    assert "jit_cache_entries" in debug  # count depends on test order
+    assert "threads" in debug and debug["threads"]
