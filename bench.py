@@ -97,7 +97,7 @@ def main() -> None:
     note = os.environ.get("BENCH_PLATFORM_NOTE", platform)
     print(json.dumps({
         "metric": "hdfs-logs leaf_search p50 (term+date_histogram+terms, "
-                  f"{NUM_DOCS/1e6:.0f}M docs, 1 chip, {note})",
+                  f"{NUM_DOCS/1e6:g}M docs, 1 chip, {note})",
         "value": round(p50_ms, 2),
         "unit": "ms",
         "vs_baseline": round(1000.0 / p50_ms, 2),

@@ -312,6 +312,10 @@ class Node:
         page["scroll_id"] = scroll_id
         return page
 
+    def end_scroll(self, scroll_id: str) -> bool:
+        """Release a scroll context early (clear-scroll)."""
+        return self.scroll_store.delete(scroll_id)
+
     def continue_scroll(self, scroll_id: str) -> dict[str, Any]:
         from dataclasses import replace
         context = self.scroll_store.get(scroll_id)

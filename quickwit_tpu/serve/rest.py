@@ -267,6 +267,8 @@ class RestServer:
                 scroll_id = json.loads(body).get("scroll_id")
             if not scroll_id:
                 raise ApiError(400, "missing scroll_id")
+            if method == "DELETE":  # clear-scroll (frees the context early)
+                return 200, {"released": node.end_scroll(scroll_id)}
             return 200, node.continue_scroll(scroll_id)
         m = re.fullmatch(r"/api/v1/([^/_][^/]*)/list-terms", path)
         if m:

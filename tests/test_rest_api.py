@@ -378,3 +378,13 @@ def test_developer_debug_endpoint(api):
     assert debug["node_id"] == "rest-node"
     assert "jit_cache_entries" in debug  # count depends on test order
     assert "threads" in debug and debug["threads"]
+
+
+def test_clear_scroll(api):
+    status, page = api.request(
+        "GET", "/api/v1/hdfs-logs/search?query=*&max_hits=5&scroll=1m")
+    scroll_id = page["scroll_id"]
+    status, result = api.request("DELETE", f"/api/v1/scroll?scroll_id={scroll_id}")
+    assert status == 200 and result["released"] is True
+    status, _ = api.request("GET", f"/api/v1/scroll?scroll_id={scroll_id}")
+    assert status == 400  # context gone
