@@ -1,0 +1,165 @@
+"""Property-style randomized query parity.
+
+Role of the reference's proptest suites (`quickwit-search/src/tests.rs`):
+generate random boolean query trees over a random corpus and check the
+device executor's hits/counts against a pure-Python oracle that evaluates
+the same AST doc by doc.
+"""
+
+import numpy as np
+import pytest
+
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.index import SplitReader, SplitWriter
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.query import ast as Q
+from quickwit_tpu.search import SearchRequest, SortField, leaf_search_single_split
+from quickwit_tpu.storage import RamStorage
+
+NUM_DOCS = 400
+WORDS = ["alpha", "beta", "gamma", "delta", "epsilon"]
+LEVELS = ["DEBUG", "INFO", "WARN", "ERROR"]
+
+MAPPER = DocMapper(
+    field_mappings=[
+        FieldMapping("ts", FieldType.DATETIME, fast=True,
+                     input_formats=("unix_timestamp",)),
+        FieldMapping("level", FieldType.TEXT, tokenizer="raw", fast=True),
+        FieldMapping("num", FieldType.I64, fast=True),
+        FieldMapping("body", FieldType.TEXT),
+    ],
+    timestamp_field="ts",
+    default_search_fields=("body",),
+)
+
+
+def make_corpus(rng):
+    docs = []
+    for i in range(NUM_DOCS):
+        n_words = rng.randint(1, 6)
+        docs.append({
+            "ts": 1000 + i,
+            "level": LEVELS[rng.randint(len(LEVELS))],
+            "num": int(rng.randint(-50, 50)),
+            "body": " ".join(WORDS[rng.randint(len(WORDS))]
+                             for _ in range(n_words)),
+        })
+    return docs
+
+
+def random_ast(rng, depth=0) -> Q.QueryAst:
+    roll = rng.rand()
+    if depth >= 2 or roll < 0.35:
+        kind = rng.randint(4)
+        if kind == 0:
+            return Q.Term("level", LEVELS[rng.randint(len(LEVELS))])
+        if kind == 1:
+            return Q.FullText("body", WORDS[rng.randint(len(WORDS))], "or")
+        if kind == 2:
+            lo = int(rng.randint(-60, 40))
+            hi = lo + int(rng.randint(1, 60))
+            return Q.Range("num", Q.RangeBound(lo, bool(rng.rand() < 0.5)),
+                           Q.RangeBound(hi, bool(rng.rand() < 0.5)))
+        return Q.TermSet({"level": tuple(
+            sorted({LEVELS[rng.randint(len(LEVELS))] for _ in range(2)}))})
+    n_must = rng.randint(0, 3)
+    n_should = rng.randint(0, 3)
+    n_not = rng.randint(0, 2)
+    if n_must + n_should == 0:
+        n_must = 1
+    msm = None
+    if n_should >= 2 and rng.rand() < 0.3:
+        msm = int(rng.randint(1, n_should + 1))
+    return Q.Bool(
+        must=tuple(random_ast(rng, depth + 1) for _ in range(n_must)),
+        must_not=tuple(random_ast(rng, depth + 1) for _ in range(n_not)),
+        should=tuple(random_ast(rng, depth + 1) for _ in range(n_should)),
+        minimum_should_match=msm,
+    )
+
+
+def oracle_matches(ast: Q.QueryAst, doc: dict) -> bool:
+    if isinstance(ast, Q.MatchAll):
+        return True
+    if isinstance(ast, Q.Term):
+        return str(doc.get(ast.field)) == ast.value
+    if isinstance(ast, Q.FullText):
+        return ast.text in doc["body"].split()
+    if isinstance(ast, Q.Range):
+        value = doc[ast.field]
+        if ast.lower is not None:
+            bound = int(ast.lower.value)
+            if value < bound or (value == bound and not ast.lower.inclusive):
+                return False
+        if ast.upper is not None:
+            bound = int(ast.upper.value)
+            if value > bound or (value == bound and not ast.upper.inclusive):
+                return False
+        return True
+    if isinstance(ast, Q.TermSet):
+        return any(str(doc.get(f)) in terms
+                   for f, terms in ast.terms_per_field.items())
+    if isinstance(ast, Q.Bool):
+        if any(not oracle_matches(c, doc) for c in ast.must + ast.filter):
+            return False
+        if any(oracle_matches(c, doc) for c in ast.must_not):
+            return False
+        if ast.should:
+            n_matching = sum(oracle_matches(c, doc) for c in ast.should)
+            if ast.minimum_should_match is not None:
+                if n_matching < ast.minimum_should_match:
+                    return False
+            elif not (ast.must or ast.filter) and n_matching == 0:
+                return False
+        return bool(ast.must or ast.filter or ast.should)
+    raise TypeError(type(ast))
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_random_queries_match_oracle(seed):
+    rng = np.random.RandomState(1000 + seed)
+    docs = make_corpus(rng)
+    writer = SplitWriter(MAPPER)
+    for doc in docs:
+        writer.add_json_doc(doc)
+    storage = RamStorage(Uri.parse(f"ram:///prop{seed}"))
+    storage.put("s.split", writer.finish())
+    reader = SplitReader(storage, "s.split")
+
+    for trial in range(6):
+        ast = random_ast(rng)
+        expected = {i for i, doc in enumerate(docs) if oracle_matches(ast, doc)}
+        response = leaf_search_single_split(
+            SearchRequest(index_ids=["p"], query_ast=ast, max_hits=NUM_DOCS,
+                          sort_fields=(SortField("_doc", "asc"),)),
+            MAPPER, reader, "s")
+        got = {h.doc_id for h in response.partial_hits}
+        assert response.num_hits == len(expected), \
+            f"seed={seed} trial={trial} ast={ast.to_dict()}"
+        assert got == expected, f"seed={seed} trial={trial} ast={ast.to_dict()}"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_random_sorts_match_oracle(seed):
+    rng = np.random.RandomState(2000 + seed)
+    docs = make_corpus(rng)
+    writer = SplitWriter(MAPPER)
+    for doc in docs:
+        writer.add_json_doc(doc)
+    storage = RamStorage(Uri.parse(f"ram:///props{seed}"))
+    storage.put("s.split", writer.finish())
+    reader = SplitReader(storage, "s.split")
+
+    ast = random_ast(rng)
+    expected_docs = [i for i, doc in enumerate(docs) if oracle_matches(ast, doc)]
+    for field, order in (("num", "desc"), ("num", "asc"), ("ts", "desc")):
+        response = leaf_search_single_split(
+            SearchRequest(index_ids=["p"], query_ast=ast, max_hits=17,
+                          sort_fields=(SortField(field, order),)),
+            MAPPER, reader, "s")
+        reverse = order == "desc"
+        expected_sorted = sorted(
+            expected_docs,
+            key=lambda i: (-docs[i][field] if reverse else docs[i][field], i))[:17]
+        got = [h.doc_id for h in response.partial_hits]
+        assert got == expected_sorted, f"seed={seed} {field} {order}"
