@@ -99,13 +99,58 @@ class SearchService:
                 continue
             pending.append(split)
 
+        num_skipped = 0
+        prunable = self._pruning_applicable(search_request,
+                                            doc_mapper.timestamp_field)
         for begin in range(0, len(pending), self.context.batch_size):
+            if prunable and begin > 0 and self._can_skip_remaining(
+                    search_request, collector, pending, begin):
+                # reference `CanSplitDoBetter` short-circuit (leaf.rs:1608):
+                # with exact counting off, splits whose best possible sort key
+                # cannot beat the current kth hit are skipped entirely
+                num_skipped = len(pending) - begin
+                break
             group = pending[begin: begin + self.context.batch_size]
             self._search_group(group, doc_mapper, search_request, collector)
 
         response = collector.to_leaf_response()
         response.num_attempted_splits = len(splits)
+        response.resource_stats["num_splits_skipped"] = num_skipped
         return response
+
+    @staticmethod
+    def _pruning_applicable(request: SearchRequest, timestamp_field) -> bool:
+        if request.count_hits_exact or request.aggs or request.max_hits == 0:
+            return False
+        sort = request.sort_fields[0] if request.sort_fields else None
+        # split metadata only bounds the timestamp field's values
+        return sort is not None and sort.field == timestamp_field
+
+    @staticmethod
+    def _can_skip_remaining(request: SearchRequest,
+                            collector: IncrementalCollector,
+                            pending: list[SplitIdAndFooter],
+                            begin: int) -> bool:
+        needed = request.start_offset + request.max_hits
+        hits = collector.partial_hits()
+        if len(hits) < request.max_hits or collector.num_hits < needed:
+            return False
+        if not hits:
+            return False
+        sort = request.sort_fields[0]
+        worst_kept = hits[-1].sort_value  # internal higher-is-better key
+        for i in range(begin, len(pending)):
+            split = pending[i]
+            if split.time_range is None:
+                return False
+            # best achievable internal key in this split for the sort field;
+            # a TIE can still win the (split_id, doc_id) tie-break, so only
+            # strictly-worse splits are skippable
+            best = (split.time_range[1] if sort.order == "desc"
+                    else -split.time_range[0])
+            if best >= worst_kept:
+                return False
+        return True
 
     def _search_group(self, group, doc_mapper, search_request, collector) -> None:
         # the batch path has no search_after pushdown; per-split handles it

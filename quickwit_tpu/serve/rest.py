@@ -88,6 +88,8 @@ def _search_request_from_params(index_id: str, params: dict[str, Any],
         aggs=aggs,
         start_timestamp=_ts("start_timestamp"),
         end_timestamp=_ts("end_timestamp"),
+        count_hits_exact=str(params.get("count_all", "true")).lower()
+        not in ("false", "0", "no"),
         snippet_fields=tuple(params["snippet_fields"].split(","))
         if params.get("snippet_fields") else (),
     )

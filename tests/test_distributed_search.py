@@ -195,3 +195,78 @@ def test_all_snippets(cluster):
     assert response.hits
     for hit in response.hits:
         assert "<em>common</em>" in hit.snippets["body"][0]
+
+
+def test_split_pruning_short_circuit(cluster):
+    """count_hits_exact=False + timestamp sort: splits that cannot beat the
+    current top-k are skipped (CanSplitDoBetter short-circuit)."""
+    metastore, services, clients, root = cluster
+    from quickwit_tpu.search.models import LeafSearchRequest
+    from quickwit_tpu.metastore.base import ListSplitsQuery
+    from quickwit_tpu.models.split_metadata import SplitState
+    from quickwit_tpu.search.models import SplitIdAndFooter
+
+    metadata = metastore.index_metadata("logs")
+    splits = metastore.list_splits(ListSplitsQuery(
+        index_uids=[metadata.index_uid], states=[SplitState.PUBLISHED]))
+    assert len(splits) >= 3
+    offsets = [SplitIdAndFooter(
+        split_id=s.metadata.split_id,
+        storage_uri=metadata.index_config.index_uri,
+        num_docs=s.metadata.num_docs,
+        time_range=(s.metadata.time_range_start, s.metadata.time_range_end))
+        for s in splits]
+    service = next(iter(services.values()))
+    # fresh context so leaf cache doesn't satisfy everything
+    from quickwit_tpu.search.service import SearcherContext, SearchService
+    svc = SearchService(SearcherContext(
+        storage_resolver=service.context.storage_resolver, batch_size=1))
+    request = SearchRequest(
+        index_ids=["logs"], query_ast=parse_query_string("*"),
+        max_hits=5, sort_fields=(SortField("ts", "desc"),),
+        count_hits_exact=False)
+    response = svc.leaf_search(LeafSearchRequest(
+        search_request=request, index_uid=metadata.index_uid,
+        doc_mapping=MAPPER.to_dict(), splits=offsets))
+    assert response.resource_stats.get("num_splits_skipped", 0) >= 1
+    # correctness: the returned top hits equal the exact-path result
+    exact = svc.leaf_search(LeafSearchRequest(
+        search_request=SearchRequest(
+            index_ids=["logs"], query_ast=parse_query_string("*"),
+            max_hits=5, sort_fields=(SortField("ts", "desc"),)),
+        index_uid=metadata.index_uid, doc_mapping=MAPPER.to_dict(),
+        splits=offsets))
+    assert [(h.split_id, h.doc_id) for h in response.partial_hits[:5]] == \
+        [(h.split_id, h.doc_id) for h in exact.partial_hits[:5]]
+
+
+def test_split_pruning_never_skips_on_ties_or_zero_hits(cluster):
+    """Regression: ties on the split boundary must not be pruned, and
+    max_hits=0 with count_all=false must not crash."""
+    metastore, services, clients, root = cluster
+    from quickwit_tpu.search.models import LeafSearchRequest, SplitIdAndFooter
+    from quickwit_tpu.metastore.base import ListSplitsQuery
+    from quickwit_tpu.models.split_metadata import SplitState
+    from quickwit_tpu.search.service import SearcherContext, SearchService
+
+    metadata = metastore.index_metadata("logs")
+    splits = metastore.list_splits(ListSplitsQuery(
+        index_uids=[metadata.index_uid], states=[SplitState.PUBLISHED]))
+    offsets = [SplitIdAndFooter(
+        split_id=s.metadata.split_id,
+        storage_uri=metadata.index_config.index_uri,
+        num_docs=s.metadata.num_docs,
+        time_range=(s.metadata.time_range_start, s.metadata.time_range_end))
+        for s in splits]
+    svc = SearchService(SearcherContext(
+        storage_resolver=next(iter(services.values())).context.storage_resolver,
+        batch_size=1))
+    # max_hits=0 + inexact counting: must not crash (IndexError regression)
+    response = svc.leaf_search(LeafSearchRequest(
+        search_request=SearchRequest(
+            index_ids=["logs"], query_ast=parse_query_string("*"),
+            max_hits=0, sort_fields=(SortField("ts", "desc"),),
+            count_hits_exact=False),
+        index_uid=metadata.index_uid, doc_mapping=MAPPER.to_dict(),
+        splits=offsets))
+    assert response.partial_hits == []
