@@ -403,6 +403,10 @@ class RestServer:
                     order = spec.get("order", "asc") if isinstance(spec, dict) else spec
                     parsed.append(SortField(field_name, order))
             sort_fields = tuple(parsed)
+        track_total = payload.get("track_total_hits",
+                                   params.get("track_total_hits", True))
+        if isinstance(track_total, str):  # query-param form is a string
+            track_total = track_total.lower() not in ("false", "0", "no")
         return SearchRequest(
             index_ids=index_ids,
             query_ast=ast,
@@ -410,6 +414,7 @@ class RestServer:
             start_offset=int(payload.get("from", params.get("from", 0))),
             sort_fields=sort_fields,
             aggs=payload.get("aggs") or payload.get("aggregations"),
+            count_hits_exact=track_total is not False,
         )
 
     @staticmethod
@@ -427,11 +432,12 @@ class RestServer:
             if hit.snippets:
                 entry["highlight"] = hit.snippets
             hits.append(entry)
+        relation = "eq" if request.count_hits_exact else "gte"
         return {
             "took": response.elapsed_time_micros // 1000,
             "timed_out": False,
             "hits": {
-                "total": {"value": response.num_hits, "relation": "eq"},
+                "total": {"value": response.num_hits, "relation": relation},
                 "max_score": max((h.score for h in response.hits
                                   if h.score is not None), default=None),
                 "hits": hits,

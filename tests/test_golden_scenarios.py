@@ -1,0 +1,148 @@
+"""Golden REST scenarios — black-box conformance over a live HTTP server.
+
+Role of the reference's `rest-api-tests/run_tests.py` + scenarii YAMLs
+(aggregations, es_compatibility, qw_search_api, search_after, sort_orders,
+multi_splits, tag_fields): each scenario is a (request, expected-subset)
+pair replayed against a running node; expectations assert a subset of the
+response (like the reference's partial-match checks).
+"""
+
+import http.client
+import json
+
+import pytest
+
+from quickwit_tpu.serve import Node, NodeConfig, RestServer
+from quickwit_tpu.storage import StorageResolver
+
+
+def request(port, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    data = None
+    if body is not None:
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    conn.request(method, path, body=data)
+    response = conn.getresponse()
+    payload = response.read()
+    conn.close()
+    return response.status, (json.loads(payload) if payload else None)
+
+
+def subset_match(expected, actual, path="$"):
+    """expected ⊆ actual, recursively (lists compare element-wise)."""
+    if isinstance(expected, dict):
+        assert isinstance(actual, dict), f"{path}: expected object, got {actual!r}"
+        for key, value in expected.items():
+            assert key in actual, f"{path}.{key} missing in {actual!r}"
+            subset_match(value, actual[key], f"{path}.{key}")
+    elif isinstance(expected, list):
+        assert isinstance(actual, list) and len(actual) == len(expected), \
+            f"{path}: expected {expected!r}, got {actual!r}"
+        for i, (e, a) in enumerate(zip(expected, actual)):
+            subset_match(e, a, f"{path}[{i}]")
+    elif isinstance(expected, float):
+        assert actual == pytest.approx(expected, rel=1e-6), f"{path}: {actual} != {expected}"
+    else:
+        assert actual == expected, f"{path}: {actual!r} != {expected!r}"
+
+
+@pytest.fixture(scope="module")
+def port():
+    node = Node(NodeConfig(node_id="golden", rest_port=0,
+                           metastore_uri="ram:///golden/ms",
+                           default_index_root_uri="ram:///golden/idx"),
+                storage_resolver=StorageResolver.for_test())
+    server = RestServer(node)
+    server.start()
+    status, _ = request(server.port, "POST", "/api/v1/indexes", {
+        "index_id": "g-logs",
+        "doc_mapping": {
+            "field_mappings": [
+                {"name": "ts", "type": "datetime", "fast": True,
+                 "input_formats": ["unix_timestamp"]},
+                {"name": "level", "type": "text", "tokenizer": "raw", "fast": True},
+                {"name": "size", "type": "i64", "fast": True},
+                {"name": "msg", "type": "text", "record": "position"},
+            ],
+            "timestamp_field": "ts",
+            "tag_fields": ["level"],
+            "default_search_fields": ["msg"],
+        },
+        "indexing_settings": {"split_num_docs_target": 40},
+    })
+    assert status == 200
+    docs = []
+    for i in range(100):
+        docs.append({"ts": 1_700_000_000 + i * 30,
+                     "level": ["INFO", "WARN", "ERROR"][i % 3],
+                     "size": (i * 7) % 100,
+                     "msg": f"request {i} handled in zone{i % 4}"})
+    ndjson = "\n".join(json.dumps(d) for d in docs).encode()
+    status, result = request(server.port, "POST",
+                             "/api/v1/g-logs/ingest?commit=force", ndjson)
+    assert status == 200 and result["num_ingested_docs"] == 100
+    yield server.port
+    server.stop()
+
+
+SCENARIOS = [
+    # --- qw_search_api ----------------------------------------------------
+    ("GET", "/api/v1/g-logs/search?query=level:ERROR&max_hits=0", None,
+     {"num_hits": 33}),
+    ("GET", "/api/v1/g-logs/search?query=zone1&max_hits=0", None,
+     {"num_hits": 25}),
+    ("GET", "/api/v1/g-logs/search?query=level:ERROR+AND+zone1&max_hits=0", None,
+     {"num_hits": 8}),  # i%3==2 and i%4==1: i ≡ 5 mod 12 → 8,  range 0..99
+    ("GET", "/api/v1/g-logs/search?query=size:[90+TO+99]&max_hits=0", None,
+     {"num_hits": 10}),
+    # sort_orders: first page newest-first
+    ("GET", "/api/v1/g-logs/search?query=*&max_hits=2&sort_by=-ts", None,
+     {"hits": [{"doc": {"ts": 1_700_000_000 + 99 * 30}},
+               {"doc": {"ts": 1_700_000_000 + 98 * 30}}]}),
+    ("GET", "/api/v1/g-logs/search?query=*&max_hits=2&sort_by=ts&sort_order=asc",
+     None,
+     {"hits": [{"doc": {"ts": 1_700_000_000}},
+               {"doc": {"ts": 1_700_000_030}}]}),
+    # --- es_compatibility -------------------------------------------------
+    ("POST", "/api/v1/_elastic/g-logs/_search",
+     {"query": {"match_all": {}}, "size": 0},
+     {"hits": {"total": {"value": 100, "relation": "eq"}}}),
+    ("POST", "/api/v1/_elastic/g-logs/_search",
+     {"query": {"term": {"level": "WARN"}}, "size": 1},
+     {"hits": {"total": {"value": 33}}}),
+    ("POST", "/api/v1/_elastic/g-logs/_search",
+     {"query": {"match_phrase": {"msg": "request 42 handled"}}, "size": 1},
+     {"hits": {"total": {"value": 1}}}),
+    ("POST", "/api/v1/_elastic/g-logs/_search",
+     {"query": {"range": {"size": {"gte": 50, "lt": 60}}}, "size": 0},
+     {"hits": {"total": {"value": 10}}}),
+    ("POST", "/api/v1/_elastic/g-logs/_search",
+     {"query": {"bool": {"must": [{"term": {"level": "INFO"}}],
+                         "must_not": [{"match": {"msg": "zone0"}}]}},
+      "size": 0},
+     {"hits": {"total": {"value": 25}}}),  # 34 INFO (i%3==0) minus i%4==0 overlap (9)
+    ("POST", "/api/v1/_elastic/g-logs/_search",
+     {"query": {"match_all": {}}, "size": 0, "track_total_hits": False},
+     {"hits": {"total": {"relation": "gte"}}}),
+    # --- aggregations -----------------------------------------------------
+    ("POST", "/api/v1/_elastic/g-logs/_search",
+     {"query": {"match_all": {}}, "size": 0,
+      "aggs": {"levels": {"terms": {"field": "level", "size": 3}}}},
+     {"aggregations": {"levels": {"buckets": [
+         {"key": "INFO", "doc_count": 34},
+         {"key": "ERROR", "doc_count": 33},
+         {"key": "WARN", "doc_count": 33}]}}}),
+    ("POST", "/api/v1/_elastic/g-logs/_search",
+     {"query": {"match_all": {}}, "size": 0,
+      "aggs": {"sz": {"stats": {"field": "size"}}}},
+     {"aggregations": {"sz": {"count": 100, "min": 0.0, "max": 99.0}}}),
+]
+
+
+@pytest.mark.parametrize("method,path,body,expected",
+                         SCENARIOS,
+                         ids=[f"{i}:{s[1][:48]}" for i, s in enumerate(SCENARIOS)])
+def test_golden_scenario(port, method, path, body, expected):
+    status, response = request(port, method, path, body)
+    assert status == 200, response
+    subset_match(expected, response)
