@@ -84,10 +84,17 @@ def cmd_index_describe(args) -> int:
     from .metastore.base import ListSplitsQuery
     splits = node.metastore.list_splits(
         ListSplitsQuery(index_uids=[metadata.index_uid]))
+    from .models.split_metadata import SplitState
+    published = [s for s in splits if s.state is SplitState.PUBLISHED]
     print(json.dumps({
         "index": metadata.to_dict(),
-        "num_splits": len(splits),
-        "num_docs": sum(s.metadata.num_docs for s in splits),
+        "num_splits": len(published),
+        "num_docs": sum(s.metadata.num_docs for s in published),
+        "splits_by_state": {
+            state.value: sum(1 for s in splits if s.state is state)
+            for state in SplitState
+            if any(s.state is state for s in splits)
+        },
     }, indent=2))
     return 0
 

@@ -6,10 +6,11 @@ splits to merge and replace N splits by one, through the same atomic
 stage/upload/publish(replace) protocol so no document is ever lost or
 duplicated (`no_split_loss`/`rows_conserved` invariants of quickwit-dst).
 
-The executor re-indexes documents from the source splits' doc stores through
-a SplitWriter; pending delete tasks (GDPR deletes) are applied during the
-rewrite, like the reference's delete-task pipeline applies deletes at merge
-time.
+The executor merges at the ARRAY level (index/merge_arrays.py: term-dict
+k-way merge, postings offset-concat, compressed docstore blocks reused) in
+the common case; when delete tasks newer than the inputs' delete_opstamp
+are pending, it falls back to a doc-level rewrite that applies them — like
+the reference's delete-task pipeline applies deletes at merge time.
 """
 
 from __future__ import annotations
@@ -133,13 +134,44 @@ class MergeExecutor:
         self.node_id = node_id
 
     def execute(self, operation: MergeOperation,
-                delete_query_asts: Optional[list] = None) -> Optional[str]:
-        writer = SplitWriter(self.doc_mapper)
-        delete_matchers = self._delete_matchers(delete_query_asts or [])
+                delete_tasks: Optional[list[dict]] = None) -> Optional[str]:
+        """`delete_tasks`: metastore task dicts ({"opstamp", "query_ast"}).
+        Only tasks NEWER than every input split's delete_opstamp still need
+        applying — already-applied tasks must not push merges onto the slow
+        doc-level path forever."""
         max_delete_opstamp = self.metastore.last_delete_opstamp(self.index_uid)
-        for split in operation.splits:
-            reader = SplitReader(self.split_storage,
-                                 split_file_path(split.metadata.split_id))
+        min_applied = min(s.metadata.delete_opstamp for s in operation.splits)
+        applicable = [t for t in (delete_tasks or [])
+                      if t["opstamp"] > min_applied]
+        from ..query.ast import ast_from_dict
+        delete_matchers = self._delete_matchers(
+            [ast_from_dict(t["query_ast"]) for t in applicable])
+        readers = [SplitReader(self.split_storage,
+                               split_file_path(s.metadata.split_id))
+                   for s in operation.splits]
+        if not delete_matchers:
+            # fast path: array-level segment merge, no re-tokenization;
+            # stats come from the authoritative split metadata
+            from ..index.merge_arrays import merge_splits
+            data = merge_splits(readers)
+            num_docs = sum(s.metadata.num_docs for s in operation.splits)
+            uncompressed = sum(s.metadata.uncompressed_docs_size_bytes
+                               for s in operation.splits)
+            time_min = min((s.metadata.time_range_start
+                            for s in operation.splits
+                            if s.metadata.time_range_start is not None),
+                           default=None)
+            time_max = max((s.metadata.time_range_end
+                            for s in operation.splits
+                            if s.metadata.time_range_end is not None),
+                           default=None)
+            tags = frozenset().union(*(s.metadata.tags for s in operation.splits))
+            return self._publish_merged(
+                operation, data, num_docs, uncompressed, time_min, time_max,
+                tags, max_delete_opstamp)
+        # delete tasks pending: doc-level rewrite applies them
+        writer = SplitWriter(self.doc_mapper)
+        for reader in readers:
             for doc in _iter_all_docs(reader):
                 if any(matcher(doc) for matcher in delete_matchers):
                     continue
@@ -150,18 +182,25 @@ class MergeExecutor:
                 self.index_uid, [], replaced_split_ids=operation.split_ids)
             return None
         data = writer.finish()
+        return self._publish_merged(
+            operation, data, writer.num_docs, writer._uncompressed_docs_size,
+            writer._time_min, writer._time_max, frozenset(writer.tags),
+            max_delete_opstamp)
+
+    def _publish_merged(self, operation, data, num_docs, uncompressed,
+                        time_min, time_max, tags, max_delete_opstamp):
         merged_id = new_split_id()
         metadata = SplitMetadata(
             split_id=merged_id,
             index_uid=self.index_uid,
             source_id=operation.splits[0].metadata.source_id,
             node_id=self.node_id,
-            num_docs=writer.num_docs,
-            uncompressed_docs_size_bytes=writer._uncompressed_docs_size,
+            num_docs=num_docs,
+            uncompressed_docs_size_bytes=uncompressed,
             footprint_bytes=len(data),
-            time_range_start=writer._time_min,
-            time_range_end=writer._time_max,
-            tags=frozenset(writer.tags),
+            time_range_start=time_min,
+            time_range_end=time_max,
+            tags=tags,
             create_timestamp=int(time.time()),
             num_merge_ops=1 + max(s.metadata.num_merge_ops for s in operation.splits),
             delete_opstamp=max_delete_opstamp,
@@ -173,7 +212,7 @@ class MergeExecutor:
             self.index_uid, [merged_id],
             replaced_split_ids=operation.split_ids)
         logger.info("merged %d splits -> %s (%d docs)",
-                    len(operation.splits), merged_id, writer.num_docs)
+                    len(operation.splits), merged_id, num_docs)
         return merged_id
 
     def _delete_matchers(self, delete_query_asts: list):

@@ -286,10 +286,9 @@ class Node:
         executor = MergeExecutor(metadata.index_uid,
                                  metadata.index_config.doc_mapper,
                                  self.metastore, storage, self.config.node_id)
-        delete_asts = [Q.ast_from_dict(t["query_ast"])
-                       for t in self.metastore.list_delete_tasks(metadata.index_uid)]
+        delete_tasks = self.metastore.list_delete_tasks(metadata.index_uid)
         for operation in operations:
-            executor.execute(operation, delete_query_asts=delete_asts or None)
+            executor.execute(operation, delete_tasks=delete_tasks or None)
         return len(operations)
 
     # ------------------------------------------------------------------

@@ -196,8 +196,45 @@ def test_merge_applies_delete_tasks(env):
     executor = MergeExecutor("logs:01", MAPPER, metastore, split_storage)
     from quickwit_tpu.indexing.merge import MergeOperation
     merged_id = executor.execute(
-        MergeOperation(tuple(splits)), delete_query_asts=[Term("tenant", "1")])
+        MergeOperation(tuple(splits)),
+        delete_tasks=metastore.list_delete_tasks("logs:01"))
     published = metastore.list_splits(
         ListSplitsQuery(index_uids=["logs:01"], states=[SplitState.PUBLISHED]))
     assert published[0].metadata.num_docs == 60  # tenant==1 docs removed
     assert published[0].metadata.delete_opstamp == 1
+
+
+def test_merge_fast_path_resumes_after_deletes_applied(env):
+    """Regression: once every input split's delete_opstamp covers all tasks,
+    merges must use the array fast path again (not doc-level forever)."""
+    metastore, split_storage = env
+    pipeline = make_pipeline(metastore, split_storage, VecSource(make_docs(60)),
+                             target=20)
+    pipeline.run_to_completion()
+    metastore.create_delete_task("logs:01",
+                                 {"type": "term", "field": "tenant", "value": "2"})
+    tasks = metastore.list_delete_tasks("logs:01")
+    splits = metastore.list_splits(
+        ListSplitsQuery(index_uids=["logs:01"], states=[SplitState.PUBLISHED]))
+    executor = MergeExecutor("logs:01", MAPPER, metastore, split_storage)
+    from quickwit_tpu.indexing.merge import MergeOperation
+    # first merge applies the task (doc-level) and stamps delete_opstamp=1
+    merged = executor.execute(MergeOperation(tuple(splits)), delete_tasks=tasks)
+    published = metastore.list_splits(
+        ListSplitsQuery(index_uids=["logs:01"], states=[SplitState.PUBLISHED]))
+    assert published[0].metadata.delete_opstamp == 1
+    # second merge (same tasks still listed): nothing applicable -> fast path.
+    # Observe via monkeypatching: the fast path calls merge_splits.
+    import quickwit_tpu.indexing.merge as merge_mod
+    import quickwit_tpu.index.merge_arrays as ma
+    called = {}
+    orig = ma.merge_splits
+    try:
+        def spy(readers):
+            called["fast"] = True
+            return orig(readers)
+        ma.merge_splits = spy
+        executor.execute(MergeOperation(tuple(published)), delete_tasks=tasks)
+    finally:
+        ma.merge_splits = orig
+    assert called.get("fast"), "array fast path not taken after tasks applied"

@@ -1,0 +1,157 @@
+"""Array-level merge parity: the segment-style merge must be semantically
+identical to doc-level re-indexing (terms, postings, positions, columns,
+doc store, search results)."""
+
+import numpy as np
+import pytest
+
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.index import SplitReader, SplitWriter
+from quickwit_tpu.index.merge_arrays import merge_splits
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.query.ast import FullText, MatchAll, Term
+from quickwit_tpu.search import SearchRequest, SortField, leaf_search_single_split
+from quickwit_tpu.storage import RamStorage
+
+MAPPER = DocMapper(
+    field_mappings=[
+        FieldMapping("ts", FieldType.DATETIME, fast=True,
+                     input_formats=("unix_timestamp",)),
+        FieldMapping("level", FieldType.TEXT, tokenizer="raw", fast=True),
+        FieldMapping("score", FieldType.F64, fast=True),
+        FieldMapping("body", FieldType.TEXT, record="position"),
+    ],
+    timestamp_field="ts",
+    default_search_fields=("body",),
+)
+
+
+def build_inputs():
+    """Three heterogeneous splits: disjoint + overlapping terms, missing
+    columns values, ordinal dictionaries that differ per split."""
+    rng = np.random.RandomState(7)
+    storage = RamStorage(Uri.parse("ram:///amerge"))
+    corpora = []
+    base = 0
+    levels_per_split = [["INFO", "WARN"], ["ERROR"], ["DEBUG", "INFO", "TRACE"]]
+    for s in range(3):
+        docs = []
+        for i in range(60 + s * 30):
+            doc = {
+                "ts": 5000 + base + i,
+                "level": levels_per_split[s][int(rng.randint(len(levels_per_split[s])))],
+                "body": f"alpha beta{'' if i % 3 else ' gamma delta'} word{s}x{i % 5}",
+            }
+            if i % 4 != 0:  # some docs lack the f64 column
+                doc["score"] = float(rng.rand() * 100)
+            docs.append(doc)
+        corpora.append(docs)
+        base += len(docs)
+        writer = SplitWriter(MAPPER)
+        for d in docs:
+            writer.add_json_doc(d)
+        storage.put(f"{s}.split", writer.finish())
+    readers = [SplitReader(storage, f"{s}.split") for s in range(3)]
+    all_docs = [d for docs in corpora for d in docs]
+    return storage, readers, all_docs
+
+
+def doc_level_merge(storage, readers):
+    writer = SplitWriter(MAPPER)
+    for reader in readers:
+        for doc in reader.fetch_docs(list(range(reader.num_docs))):
+            writer.add_json_doc(doc)
+    storage.put("doclevel.split", writer.finish())
+    return SplitReader(storage, "doclevel.split")
+
+
+@pytest.fixture(scope="module")
+def merged_pair():
+    storage, readers, all_docs = build_inputs()
+    storage.put("arraylevel.split", merge_splits(readers))
+    array_reader = SplitReader(storage, "arraylevel.split")
+    doc_reader = doc_level_merge(storage, readers)
+    return array_reader, doc_reader, all_docs
+
+
+def test_term_dicts_identical(merged_pair):
+    array_reader, doc_reader, _ = merged_pair
+    for field in ("body", "level"):
+        ta = list(array_reader.term_dict(field).iter_terms())
+        td = list(doc_reader.term_dict(field).iter_terms())
+        assert ta == td
+
+
+def test_postings_identical(merged_pair):
+    array_reader, doc_reader, _ = merged_pair
+    for field in ("body", "level"):
+        for term, _df in array_reader.term_dict(field).iter_terms():
+            ia = array_reader.lookup_term(field, term)
+            id_ = doc_reader.lookup_term(field, term)
+            ids_a, tfs_a = array_reader.postings(field, ia)
+            ids_d, tfs_d = doc_reader.postings(field, id_)
+            assert np.array_equal(ids_a[: ia.df], ids_d[: id_.df]), (field, term)
+            assert np.array_equal(tfs_a[: ia.df], tfs_d[: id_.df]), (field, term)
+
+
+def test_positions_identical(merged_pair):
+    array_reader, doc_reader, _ = merged_pair
+    for term in ("alpha", "gamma", "delta"):
+        ia = array_reader.lookup_term("body", term)
+        id_ = doc_reader.lookup_term("body", term)
+        offs_a, data_a = array_reader.positions("body", ia)
+        offs_d, data_d = doc_reader.positions("body", id_)
+        for j in range(ia.df):
+            pa = data_a[offs_a[j]: offs_a[j + 1]]
+            pd = data_d[offs_d[j]: offs_d[j + 1]]
+            assert np.array_equal(pa, pd), (term, j)
+
+
+def test_columns_identical(merged_pair):
+    array_reader, doc_reader, _ = merged_pair
+    n = array_reader.num_docs
+    va, pa = array_reader.column_values("score")
+    vd, pd = doc_reader.column_values("score")
+    assert np.array_equal(pa[:n], pd[:n])
+    assert np.array_equal(va[:n][pa[:n] > 0], vd[:n][pd[:n] > 0])
+    # ordinal column: same dict, same decoded values
+    assert array_reader.column_dict("level") == doc_reader.column_dict("level")
+    assert np.array_equal(array_reader.column_ordinals("level")[:n],
+                          doc_reader.column_ordinals("level")[:n])
+    assert np.array_equal(array_reader.fieldnorm("body")[:n],
+                          doc_reader.fieldnorm("body")[:n])
+
+
+def test_docstore_identical(merged_pair):
+    array_reader, doc_reader, all_docs = merged_pair
+    assert array_reader.num_docs == len(all_docs)
+    fetched = array_reader.fetch_docs(list(range(array_reader.num_docs)))
+    assert fetched == all_docs
+
+
+def test_search_parity(merged_pair):
+    array_reader, doc_reader, all_docs = merged_pair
+    requests = [
+        SearchRequest(index_ids=["m"], query_ast=Term("level", "INFO"),
+                      max_hits=1000),
+        SearchRequest(index_ids=["m"], query_ast=FullText("body", "gamma delta", "phrase"),
+                      max_hits=1000),
+        SearchRequest(index_ids=["m"], query_ast=MatchAll(), max_hits=7,
+                      sort_fields=(SortField("ts", "desc"),)),
+        SearchRequest(index_ids=["m"], query_ast=MatchAll(), max_hits=0,
+                      aggs={"lv": {"terms": {"field": "level"}},
+                            "st": {"stats": {"field": "score"}}}),
+    ]
+    for request in requests:
+        ra = leaf_search_single_split(request, MAPPER, array_reader, "x")
+        rd = leaf_search_single_split(request, MAPPER, doc_reader, "x")
+        assert ra.num_hits == rd.num_hits
+        assert [(h.doc_id, h.raw_sort_value) for h in ra.partial_hits] == \
+            [(h.doc_id, h.raw_sort_value) for h in rd.partial_hits]
+
+
+def test_merge_footer_metadata(merged_pair):
+    array_reader, doc_reader, all_docs = merged_pair
+    assert array_reader.footer.time_range == doc_reader.footer.time_range
+    assert array_reader.field_meta("body")["avg_len"] == \
+        pytest.approx(doc_reader.field_meta("body")["avg_len"])
