@@ -48,3 +48,28 @@ def exact_topk(x: jnp.ndarray, k: int):
         top_vals, pos = lax.top_k(vals.reshape(-1), k)
         return top_vals, flat_idx[pos]
     return lax.top_k(x, k)
+
+
+def exact_topk_2key(key1: jnp.ndarray, key2: jnp.ndarray, k: int):
+    """Exact lexicographic top-k by (key1, key2) descending, index-ascending
+    tie-break — the two-sort-field variant of `exact_topk`, built on
+    `lax.sort` with three operands (num_keys=3 sorts ascending by operand 0,
+    then 1, then 2). Blockwise two-stage like `exact_topk`: every global
+    winner under a lexicographic order is also a block winner.
+
+    Returns (key1_top[k], key2_top[k], indices[k]).
+    """
+    n = key1.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    neg1, neg2 = -key1, -key2
+    if n % _BLOCK == 0 and k <= _BLOCK and n // _BLOCK >= 2:
+        grid = n // _BLOCK
+        a, b, i = (neg1.reshape(grid, _BLOCK), neg2.reshape(grid, _BLOCK),
+                   idx.reshape(grid, _BLOCK))
+        sa, sb, si = lax.sort((a, b, i), num_keys=3)
+        flat = (sa[:, :k].reshape(-1), sb[:, :k].reshape(-1),
+                si[:, :k].reshape(-1))
+        fa, fb, fi = lax.sort(flat, num_keys=3)
+        return -fa[:k], -fb[:k], fi[:k]
+    sa, sb, si = lax.sort((neg1, neg2, idx), num_keys=3)
+    return -sa[:k], -sb[:k], si[:k]

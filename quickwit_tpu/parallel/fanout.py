@@ -264,7 +264,9 @@ def _batch_executor(batch: SplitBatch, k: int, mesh: Optional[Mesh]):
 
     def fn(arrays, scalars, num_docs):
         results = jax.vmap(single_fn)(arrays, scalars, num_docs)
-        sort_vals, doc_ids, hit_scores, counts, agg_out = results
+        # batches are single-sort-key only (service routes 2-key requests to
+        # the per-split path), so sort_vals2 is always None here
+        sort_vals, _sort_vals2, doc_ids, hit_scores, counts, agg_out = results
         # flatten [n, k] → [n*k]; split-major order keeps the
         # (key desc, split asc, doc asc) tie-break of the collector
         top_vals, pos = jax.lax.top_k(sort_vals.reshape(-1), k)

@@ -22,7 +22,7 @@ from .models import LeafSearchResponse, PartialHit
 
 
 def _hit_order_key(h: PartialHit):
-    return (-h.sort_value, h.split_id, h.doc_id)
+    return (-h.sort_value, -h.sort_value2, h.split_id, h.doc_id)
 
 
 class IncrementalCollector:
@@ -49,9 +49,10 @@ class IncrementalCollector:
             self.resource_stats[key] = self.resource_stats.get(key, 0) + value
         hits = leaf.partial_hits
         if self.search_after is not None:
+            sa_v, sa_v2, sa_split, sa_doc = self.search_after
             hits = [h for h in hits
-                    if (-h.sort_value, h.split_id, h.doc_id) >
-                    (-self.search_after[0], self.search_after[1], self.search_after[2])]
+                    if (-h.sort_value, -h.sort_value2, h.split_id, h.doc_id) >
+                    (-sa_v, -sa_v2, sa_split, sa_doc)]
         self._hits.extend(hits)
         keep = self.start_offset + self.max_hits
         if len(self._hits) > 4 * max(keep, 1):

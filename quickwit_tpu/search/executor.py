@@ -82,6 +82,64 @@ def _eval_bucket_agg(a: BucketAggExec, arrays, scalars, mask):
     return out
 
 
+
+def _keyed_for(by, descending, values_slot, present_slot, view, mask,
+               scores, doc_key):
+    """Higher-is-better f64 key for one sort part (missing column values get
+    the finite bottom sentinel, non-matching docs -inf). `view` is either the
+    arrays tuple (dense path) or a _GatherView (posting space); `doc_key` is
+    the per-element doc id source for "doc" sorts."""
+    if by == "score":
+        key = scores.astype(jnp.float64)
+        if not descending:
+            key = -key
+        return jnp.where(mask, key, -jnp.inf)
+    if by == "column":
+        key = view[values_slot].astype(jnp.float64)
+        if not descending:
+            key = -key
+        has_value = mask & view[present_slot].astype(jnp.bool_)
+        return jnp.where(
+            has_value, key,
+            jnp.where(mask, jnp.float64(topk_ops.MISSING_VALUE_SENTINEL),
+                      -jnp.inf))
+    # "doc"
+    key = doc_key.astype(jnp.float64)
+    return jnp.where(mask, key if descending else -key, -jnp.inf)
+
+
+def _apply_search_after(plan, keyed, keyed2, scalars, padded):
+    """Restrict top-k eligibility per the search_after marker (counts/aggs
+    keep full-query semantics). With a secondary key the comparison is
+    lexicographic."""
+    relation = plan.search_after_relation
+    marker = scalars[plan.sa_value_slot]
+    if keyed2 is None:
+        if relation == "lt":
+            eligible = keyed < marker
+        elif relation == "le":
+            eligible = keyed <= marker
+        else:  # "lt_tie"
+            marker_doc = scalars[plan.sa_doc_slot]
+            docs = jnp.arange(padded, dtype=jnp.int32)
+            eligible = (keyed < marker) | ((keyed == marker) &
+                                           (docs > marker_doc))
+        return jnp.where(eligible, keyed, -jnp.inf), None
+    marker2 = scalars[plan.sa_value2_slot]
+    lt = (keyed < marker) | ((keyed == marker) & (keyed2 < marker2))
+    tie = (keyed == marker) & (keyed2 == marker2)
+    if relation == "lt":
+        eligible = lt
+    elif relation == "le":
+        eligible = lt | tie
+    else:  # "lt_tie"
+        marker_doc = scalars[plan.sa_doc_slot]
+        docs = jnp.arange(padded, dtype=jnp.int32)
+        eligible = lt | (tie & (docs > marker_doc))
+    return (jnp.where(eligible, keyed, -jnp.inf),
+            jnp.where(eligible, keyed2, -jnp.inf))
+
+
 def _posting_space_eligible(plan: LoweredPlan) -> bool:
     """Single-term queries (no boolean structure, no NOT semantics) can
     execute entirely over the [P] posting arrays instead of [N] dense docs —
@@ -115,8 +173,8 @@ def _build_posting_space(plan: LoweredPlan, k: int) -> Callable:
         count = jnp.sum(valid.astype(jnp.int32))
         safe_ids = jnp.clip(ids, 0, padded - 1)
         from ..ops.pallas import fused_score_topk, pallas_available
-        if (sort.by == "score" and root.scoring and pallas_available()
-                and k <= 64):
+        if (sort.by == "score" and sort.by2 == "none" and root.scoring
+                and pallas_available() and k <= 64):
             # QW_PALLAS=1: fused scoring + phase-1 top-k — the dense [P]
             # scores array never materializes; hit scores come straight from
             # the kernel's winners
@@ -130,36 +188,33 @@ def _build_posting_space(plan: LoweredPlan, k: int) -> Callable:
             hit_scores = jnp.where(jnp.isneginf(vals_f32), 0.0, vals_f32)
             gathered = _GatherView(arrays, safe_ids)
             agg_out = _eval_aggs(aggs, gathered, scalars, valid)
-            return sort_vals, doc_ids.astype(jnp.int32), hit_scores, count, \
-                tuple(agg_out)
+            return sort_vals, None, doc_ids.astype(jnp.int32), hit_scores, \
+                count, tuple(agg_out)
         if root.scoring:
             scores = score_postings(
                 tfs, ids, arrays[root.norm_slot],
                 scalars[root.avg_len_slot], scalars[root.idf_slot])
         else:
             scores = jnp.zeros(num_postings, dtype=jnp.float32)
-        if sort.by == "score":
-            keyed = jnp.where(valid, scores.astype(jnp.float64), -jnp.inf)
-        elif sort.by == "column":
-            key = arrays[sort.values_slot][safe_ids].astype(jnp.float64)
-            if not sort.descending:
-                key = -key
-            has_value = valid & arrays[sort.present_slot][safe_ids].astype(jnp.bool_)
-            keyed = jnp.where(
-                has_value, key,
-                jnp.where(valid, jnp.float64(topk_ops.MISSING_VALUE_SENTINEL),
-                          -jnp.inf))
-        else:  # "_doc": posting ids are doc-id ascending already
-            key = ids.astype(jnp.float64)
-            keyed = jnp.where(valid, key if sort.descending else -key, -jnp.inf)
-        sort_vals, pos = topk_ops.exact_topk(keyed, min(k, num_postings))
+        gathered = _GatherView(arrays, safe_ids)
+        # "doc" sorts key on the posting's doc id (ascending already)
+        keyed = _keyed_for(sort.by, sort.descending, sort.values_slot,
+                           sort.present_slot, gathered, valid, scores, ids)
+        kk = min(k, num_postings)
+        if sort.by2 == "none":
+            sort_vals, pos = topk_ops.exact_topk(keyed, kk)
+            sort_vals2 = None
+        else:
+            keyed2 = _keyed_for(sort.by2, sort.descending2, sort.values2_slot,
+                                sort.present2_slot, gathered, valid, scores,
+                                ids)
+            sort_vals, sort_vals2, pos = topk_ops.exact_topk_2key(
+                keyed, keyed2, kk)
         doc_ids = ids[pos]
         hit_scores = scores[pos]
-        # aggregations run over per-posting gathered values
-        gathered = _GatherView(arrays, safe_ids)
         agg_out = _eval_aggs(aggs, gathered, scalars, valid)
-        return sort_vals, doc_ids.astype(jnp.int32), hit_scores, count, \
-            tuple(agg_out)
+        return sort_vals, sort_vals2, doc_ids.astype(jnp.int32), hit_scores, \
+            count, tuple(agg_out)
 
     return fn
 
@@ -260,42 +315,30 @@ def _build(plan: LoweredPlan, k: int) -> Callable:
         mask = mask & mask_ops.valid_docs_mask(num_docs, padded)
         if scores is None:
             scores = jnp.zeros(padded, dtype=jnp.float32)
-        # unified higher-is-better f64 key (missing column values get the
-        # finite bottom sentinel, non-matching docs -inf)
-        if sort.by == "score":
-            keyed = jnp.where(mask, scores.astype(jnp.float64), -jnp.inf)
-        elif sort.by == "column":
-            key = arrays[sort.values_slot].astype(jnp.float64)
-            if not sort.descending:
-                key = -key
-            has_value = mask & arrays[sort.present_slot].astype(jnp.bool_)
-            keyed = jnp.where(
-                has_value, key,
-                jnp.where(mask, jnp.float64(topk_ops.MISSING_VALUE_SENTINEL),
-                          -jnp.inf))
-        else:  # "_doc"
-            key = jnp.arange(padded, dtype=jnp.float64)
-            keyed = jnp.where(mask, key if sort.descending else -key, -jnp.inf)
+        doc_key = jnp.arange(padded, dtype=jnp.int32)
+        keyed = _keyed_for(sort.by, sort.descending, sort.values_slot,
+                           sort.present_slot, arrays, mask, scores, doc_key)
+        keyed2 = None
+        if sort.by2 != "none":
+            keyed2 = _keyed_for(sort.by2, sort.descending2, sort.values2_slot,
+                                sort.present2_slot, arrays, mask, scores,
+                                doc_key)
         # search_after pushdown: restrict top-k eligibility, NOT counts/aggs
         # (ES semantics: totals and aggregations cover the full query)
         if plan.search_after_relation != "none":
-            marker = scalars[plan.sa_value_slot]
-            if plan.search_after_relation == "lt":
-                eligible = keyed < marker
-            elif plan.search_after_relation == "le":
-                eligible = keyed <= marker
-            else:  # "lt_tie": same split as the marker
-                marker_doc = scalars[plan.sa_doc_slot]
-                docs = jnp.arange(padded, dtype=jnp.int32)
-                eligible = (keyed < marker) | ((keyed == marker) &
-                                               (docs > marker_doc))
-            keyed = jnp.where(eligible, keyed, -jnp.inf)
-        sort_vals, doc_ids = topk_ops.exact_topk(keyed, k)
+            keyed, keyed2 = _apply_search_after(plan, keyed, keyed2, scalars,
+                                                padded)
+        if keyed2 is None:
+            sort_vals, doc_ids = topk_ops.exact_topk(keyed, k)
+            sort_vals2 = None
+        else:
+            sort_vals, sort_vals2, doc_ids = topk_ops.exact_topk_2key(
+                keyed, keyed2, k)
         doc_ids = doc_ids.astype(jnp.int32)
         count = jnp.sum(mask.astype(jnp.int32))
         hit_scores = scores[jnp.clip(doc_ids, 0, padded - 1)]
         agg_out = _eval_aggs(aggs, arrays, scalars, mask)
-        return sort_vals, doc_ids, hit_scores, count, tuple(agg_out)
+        return sort_vals, sort_vals2, doc_ids, hit_scores, count, tuple(agg_out)
 
     return fn
 
@@ -320,9 +363,11 @@ def execute_plan(plan: LoweredPlan, k: int,
     # axon tunnel every separate readback pays a full host↔device RTT
     # (~70ms observed), so per-leaf np.asarray would multiply query latency
     # by the leaf count.
-    sort_vals, doc_ids, hit_scores, count, agg_out = jax.device_get(out)
+    sort_vals, sort_vals2, doc_ids, hit_scores, count, agg_out = \
+        jax.device_get(out)
     return {
         "sort_values": sort_vals,
+        "sort_values2": sort_vals2,
         "doc_ids": doc_ids,
         "scores": hit_scores,
         "count": int(count),

@@ -80,15 +80,18 @@ def leaf_search_single_split(
     sort = request.sort_fields[0] if request.sort_fields else None
     sort_field = sort.field if sort else "_score"
     sort_order = sort.order if sort else "desc"
+    sort2 = request.sort_fields[1] if len(request.sort_fields) > 1 else None
     k = max(request.start_offset + request.max_hits, 1)
 
     plan = lower_request(
         request.query_ast, doc_mapper, reader, agg_specs,
         sort_field=sort_field, sort_order=sort_order,
+        sort2_field=sort2.field if sort2 else None,
+        sort2_order=sort2.order if sort2 else "desc",
         start_timestamp=request.start_timestamp,
         end_timestamp=request.end_timestamp,
         search_after=search_after_marker(request, split_id, sort_field,
-                                         sort_order),
+                                         sort_order, sort2),
     )
     device_arrays = warmup_device_arrays(reader, plan)
     result = execute_plan(plan, k, device_arrays)
@@ -97,6 +100,9 @@ def leaf_search_single_split(
     num_hits_returned = min(k, count)
     partial_hits = []
     sort_is_int = _sort_values_are_int(doc_mapper, sort_field)
+    sort2_is_int = (_sort_values_are_int(doc_mapper, sort2.field)
+                    if sort2 else False)
+    values2 = result.get("sort_values2")
     for i in range(num_hits_returned):
         internal = float(result["sort_values"][i])
         if internal == float("-inf"):
@@ -104,9 +110,15 @@ def leaf_search_single_split(
         doc_id = int(result["doc_ids"][i])
         raw = decode_raw_sort_value(internal, sort_field, sort_order,
                                     sort_is_int, result["scores"][i], doc_id)
+        internal2, raw2 = 0.0, None
+        if sort2 is not None and values2 is not None:
+            internal2 = float(values2[i])
+            raw2 = decode_raw_sort_value(internal2, sort2.field, sort2.order,
+                                         sort2_is_int, result["scores"][i],
+                                         doc_id)
         partial_hits.append(PartialHit(
             sort_value=internal, split_id=split_id, doc_id=doc_id,
-            raw_sort_value=raw))
+            raw_sort_value=raw, sort_value2=internal2, raw_sort_value2=raw2))
 
     intermediate_aggs = _intermediate_aggs(plan, result["aggs"])
     elapsed = int((time.monotonic() - t0) * 1e6)
@@ -121,8 +133,9 @@ def leaf_search_single_split(
 
 
 def search_after_marker(request: SearchRequest, split_id: str,
-                        sort_field: str, sort_order: str):
-    """(internal_marker_value, relation, marker_doc) for this split, or None.
+                        sort_field: str, sort_order: str, sort2=None):
+    """(internal_value, internal_value2|None, relation, marker_doc) for this
+    split, or None.
 
     A hit qualifies iff key < m, or key == m and (split, doc) > (m_split,
     m_doc); the split relation is static per split:
@@ -132,21 +145,27 @@ def search_after_marker(request: SearchRequest, split_id: str,
     """
     if not request.search_after:
         return None
-    raw, m_split, m_doc = (request.search_after[0], str(request.search_after[1]),
-                           int(request.search_after[2]))
-    if raw is None:
-        internal = MISSING_VALUE_SENTINEL
-    elif sort_field == "_score":
-        internal = float(raw)
+    sa = list(request.search_after)
+    if sort2 is not None and len(sa) == 4:
+        raw, raw2, m_split, m_doc = sa[0], sa[1], str(sa[2]), int(sa[3])
     else:
-        internal = float(raw) if sort_order == "desc" else -float(raw)
+        raw, raw2, m_split, m_doc = sa[0], None, str(sa[1]), int(sa[2])
+
+    def encode(value, field, order):
+        if value is None:
+            return MISSING_VALUE_SENTINEL
+        return float(value) if order == "desc" else -float(value)
+
+    internal = encode(raw, sort_field, sort_order)
+    internal2 = (encode(raw2, sort2.field, sort2.order)
+                 if sort2 is not None else None)
     if split_id < m_split:
         relation = "lt"
     elif split_id == m_split:
         relation = "lt_tie"
     else:
         relation = "le"
-    return (internal, relation, m_doc)
+    return (internal, internal2, relation, m_doc)
 
 
 def _sort_values_are_int(doc_mapper: DocMapper, sort_field: str) -> bool:

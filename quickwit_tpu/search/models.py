@@ -29,6 +29,19 @@ class SortField:
         return SortField(d.get("field", "_score"), d.get("order", "desc"))
 
 
+def normalize_sort_fields(sort_fields: tuple) -> tuple:
+    """Drop a `_doc` secondary (doc order is the implicit final tie-break)
+    and anything after a `_doc` primary, so the wire request's key count
+    matches what the executor actually sorts by (search_after markers align)."""
+    if not sort_fields:
+        return sort_fields
+    if sort_fields[0].field == "_doc":
+        return sort_fields[:1]
+    if len(sort_fields) > 1 and sort_fields[1].field == "_doc":
+        return sort_fields[:1]
+    return tuple(sort_fields[:2])
+
+
 @dataclass
 class SearchRequest:
     index_ids: list[str]
@@ -42,6 +55,9 @@ class SearchRequest:
     count_hits_exact: bool = True
     search_after: Optional[list[Any]] = None       # sort values of last hit
     snippet_fields: tuple[str, ...] = ()
+
+    def __post_init__(self) -> None:
+        self.sort_fields = normalize_sort_fields(tuple(self.sort_fields))
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -83,6 +99,8 @@ class PartialHit:
     split_id: str
     doc_id: int
     raw_sort_value: Any = None  # original-typed value for search_after/display
+    sort_value2: float = 0.0   # secondary key (higher-is-better; 0 if unused)
+    raw_sort_value2: Any = None
 
     def address(self) -> tuple[str, int]:
         return (self.split_id, self.doc_id)

@@ -173,14 +173,21 @@ class MetricAggExec:
 
 @dataclass(frozen=True)
 class SortExec:
-    """Static sort plan: by score, by column, or by doc id."""
+    """Static sort plan: by score, by column, or by doc id; optional
+    secondary key (the reference supports up to two sort fields)."""
     by: str                  # "score" | "column" | "doc"
     descending: bool = True
     values_slot: int = -1
     present_slot: int = -1
+    by2: str = "none"        # "none" | "score" | "column"
+    descending2: bool = True
+    values2_slot: int = -1
+    present2_slot: int = -1
 
     def sig(self) -> str:
-        return f"sort({self.by},{self.descending},{self.values_slot},{self.present_slot})"
+        return (f"sort({self.by},{self.descending},{self.values_slot},"
+                f"{self.present_slot},{self.by2},{self.descending2},"
+                f"{self.values2_slot},{self.present2_slot})")
 
 
 # --------------------------------------------------------------------------
@@ -199,6 +206,7 @@ class LoweredPlan:
     # marker value/doc travel as trailing traced scalars)
     search_after_relation: str = "none"
     sa_value_slot: int = -1
+    sa_value2_slot: int = -1
     sa_doc_slot: int = -1
 
     def signature(self, k: int) -> tuple:
@@ -206,7 +214,8 @@ class LoweredPlan:
         scalar_dtypes = tuple(str(s.dtype) for s in self.scalars)
         agg_sig = ",".join(a.sig() for a in self.aggs)
         return (self.root.sig(), self.sort.sig(), agg_sig, shapes, scalar_dtypes,
-                k, self.num_docs_padded, self.search_after_relation)
+                k, self.num_docs_padded, self.search_after_relation,
+                self.sa_value2_slot >= 0)
 
 
 class _Builder:
@@ -717,15 +726,40 @@ class Lowering:
             cache[cache_key] = cached
         return cached
 
+    def _check_sortable(self, field: str) -> None:
+        fm = self._field(field)
+        if fm.type is FieldType.TEXT:
+            # per-split ordinals are not comparable across splits; string
+            # sort keys need a global ordinal map (round-2 item)
+            raise PlanError(
+                f"sorting by text field {field!r} is not supported; sort by "
+                "a numeric/datetime fast field, _score, or _doc")
+
     # --- sort -------------------------------------------------------------
-    def lower_sort(self, sort_field: str, order: str) -> SortExec:
+    def lower_sort(self, sort_field: str, order: str,
+                   sort2_field: Optional[str] = None,
+                   sort2_order: str = "desc") -> SortExec:
         descending = order == "desc"
         if sort_field == "_score":
-            return SortExec("score", descending)
-        if sort_field == "_doc":
-            return SortExec("doc", descending)
-        values_slot, present_slot = self._column_slots(sort_field)
-        return SortExec("column", descending, values_slot, present_slot)
+            primary = SortExec("score", descending)
+        elif sort_field == "_doc":
+            primary = SortExec("doc", descending)
+        else:
+            self._check_sortable(sort_field)
+            values_slot, present_slot = self._column_slots(sort_field)
+            primary = SortExec("column", descending, values_slot, present_slot)
+        if sort2_field is None or sort2_field == "_doc" or primary.by == "doc":
+            # doc order is the implicit final tie-break already
+            return primary
+        from dataclasses import replace as dc_replace
+        if sort2_field == "_score":
+            return dc_replace(primary, by2="score",
+                              descending2=sort2_order == "desc")
+        self._check_sortable(sort2_field)
+        v2, p2 = self._column_slots(sort2_field)
+        return dc_replace(primary, by2="column",
+                          descending2=sort2_order == "desc",
+                          values2_slot=v2, present2_slot=p2)
 
 
 def ordinalize_numeric_column(reader: SplitReader, field: str):
@@ -771,6 +805,8 @@ def lower_request(
     agg_specs: list[AggSpec],
     sort_field: str = "_score",
     sort_order: str = "desc",
+    sort2_field: Optional[str] = None,
+    sort2_order: str = "desc",
     start_timestamp: Optional[int] = None,
     end_timestamp: Optional[int] = None,
     batch_overrides: Optional[dict] = None,
@@ -778,7 +814,7 @@ def lower_request(
 ) -> LoweredPlan:
     """Full request lowering: query + request-level time filter + sort + aggs."""
     low = Lowering(doc_mapper, reader, batch_overrides)
-    scoring = sort_field == "_score"
+    scoring = "_score" in (sort_field, sort2_field)
     root = low.lower(query_ast, scoring=scoring)
     if start_timestamp is not None or end_timestamp is not None:
         ts_field = doc_mapper.timestamp_field
@@ -791,17 +827,20 @@ def lower_request(
             upper=Q.RangeBound(end_timestamp, False) if end_timestamp is not None else None,
         ), bounds_are_micros=True)
         root = PBool(must=(root,), filter=(ts_node,))
-    sort = low.lower_sort(sort_field, sort_order)
+    sort = low.lower_sort(sort_field, sort_order, sort2_field, sort2_order)
     aggs = [low.lower_agg(spec) for spec in agg_specs]
-    sa_relation, sa_value_slot, sa_doc_slot = "none", -1, -1
+    sa_relation, sa_value_slot, sa_value2_slot, sa_doc_slot = "none", -1, -1, -1
     if search_after is not None:
-        sa_value, sa_relation, sa_doc = search_after
+        sa_value, sa_value2, sa_relation, sa_doc = search_after
         sa_value_slot = low.b.add_scalar(float(sa_value), np.float64)
+        if sa_value2 is not None:
+            sa_value2_slot = low.b.add_scalar(float(sa_value2), np.float64)
         sa_doc_slot = low.b.add_scalar(int(sa_doc), np.int32)
     return LoweredPlan(
         root=root, sort=sort, aggs=aggs,
         arrays=low.b.arrays, array_keys=low.b.array_keys, scalars=low.b.scalars,
         num_docs=reader.num_docs, num_docs_padded=reader.num_docs_padded,
         search_after_relation=sa_relation,
-        sa_value_slot=sa_value_slot, sa_doc_slot=sa_doc_slot,
+        sa_value_slot=sa_value_slot, sa_value2_slot=sa_value2_slot,
+        sa_doc_slot=sa_doc_slot,
     )

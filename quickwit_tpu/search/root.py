@@ -251,10 +251,13 @@ class RootSearcher:
             if doc is None:
                 continue
             snippets = doc.pop("_snippets", None)
+            sort_values = [hit.raw_sort_value]
+            if len(request.sort_fields) > 1:
+                sort_values.append(hit.raw_sort_value2)
             out.append(Hit(
                 doc=doc,
                 score=hit.raw_sort_value if scoring else None,
-                sort_values=[hit.raw_sort_value],
+                sort_values=sort_values,
                 split_id=hit.split_id,
                 doc_id=hit.doc_id,
                 snippets=snippets,
@@ -266,12 +269,23 @@ class RootSearcher:
         if not request.search_after:
             return None
         sa = request.search_after
-        # [internal_sort_value, split_id, doc_id]
-        if len(sa) != 3:
+        two_keys = len(request.sort_fields) > 1
+        if len(sa) != (4 if two_keys else 3):
             raise ValueError(
-                "search_after expects [sort_value, split_id, doc_id]")
-        sort = request.sort_fields[0] if request.sort_fields else None
-        value = float(sa[0])
-        if sort and sort.field not in ("_score", "_doc") and sort.order == "asc":
-            value = -value
-        return (value, sa[1], int(sa[2]))
+                "search_after expects [sort_value(s)..., split_id, doc_id] "
+                "matching the number of sort fields")
+
+        def encode(value, sort):
+            if value is None:
+                from .leaf import MISSING_VALUE_SENTINEL
+                return MISSING_VALUE_SENTINEL
+            value = float(value)
+            if sort and sort.order == "asc":
+                value = -value
+            return value
+
+        v1 = encode(sa[0], request.sort_fields[0] if request.sort_fields else None)
+        if two_keys:
+            v2 = encode(sa[1], request.sort_fields[1])
+            return (v1, v2, str(sa[2]), int(sa[3]))
+        return (v1, 0.0, str(sa[1]), int(sa[2]))

@@ -153,8 +153,10 @@ class SearchService:
         return True
 
     def _search_group(self, group, doc_mapper, search_request, collector) -> None:
-        # the batch path has no search_after pushdown; per-split handles it
-        if len(group) > 1 and not search_request.search_after:
+        # the batch path has no search_after pushdown or secondary sort;
+        # the per-split path handles both
+        if (len(group) > 1 and not search_request.search_after
+                and len(search_request.sort_fields) < 2):
             try:
                 readers = [self.context.reader(s) for s in group]
                 batch = build_batch(search_request, doc_mapper, readers,

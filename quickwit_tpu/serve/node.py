@@ -327,9 +327,14 @@ class Node:
             from ..search.scroll import CACHE_WINDOW
             last = hits[-1]
             sort_value = last.sort_values[0] if last.sort_values else last.score
+            if len(context.request.sort_fields) > 1 and len(last.sort_values) > 1:
+                marker = [sort_value, last.sort_values[1],
+                          last.split_id, last.doc_id]
+            else:
+                marker = [sort_value, last.split_id, last.doc_id]
             refill_request = replace(
                 context.request, start_offset=0, max_hits=CACHE_WINDOW,
-                search_after=[sort_value, last.split_id, last.doc_id])
+                search_after=marker)
             response = self.root_searcher.search(refill_request)
             hits.extend(response.hits)
         page_hits = hits[context.cursor: context.cursor + page_size]

@@ -395,7 +395,7 @@ class RestServer:
         if payload.get("sort"):
             entries = payload["sort"]
             parsed = []
-            for entry in entries[:1]:  # one sort key round 1
+            for entry in entries[:2]:  # up to two sort keys (reference max)
                 if isinstance(entry, str):
                     parsed.append(SortField(entry, "asc"))
                 else:

@@ -56,7 +56,8 @@ def leaf_response_to_dict(response: LeafSearchResponse) -> dict[str, Any]:
     return {
         "num_hits": response.num_hits,
         "partial_hits": [
-            [h.sort_value, h.split_id, h.doc_id, h.raw_sort_value]
+            [h.sort_value, h.split_id, h.doc_id, h.raw_sort_value,
+             h.sort_value2, h.raw_sort_value2]
             for h in response.partial_hits
         ],
         "failed_splits": [
@@ -75,7 +76,9 @@ def leaf_response_from_dict(d: dict[str, Any]) -> LeafSearchResponse:
         num_hits=d["num_hits"],
         partial_hits=[
             PartialHit(sort_value=h[0], split_id=h[1], doc_id=h[2],
-                       raw_sort_value=h[3])
+                       raw_sort_value=h[3],
+                       sort_value2=h[4] if len(h) > 4 else 0.0,
+                       raw_sort_value2=h[5] if len(h) > 5 else None)
             for h in d.get("partial_hits", [])
         ],
         failed_splits=[

@@ -332,3 +332,73 @@ def test_terms_agg_count_asc_order(reader):
     expected = sorted(counts.items(), key=lambda kv: (kv[1], kv[0]))[:2]
     got = [(b["key"], b["doc_count"]) for b in result["sev"]["buckets"]]
     assert got == expected
+
+
+def test_two_key_sort_lexicographic(reader):
+    """Secondary sort key: (tenant_id asc, timestamp desc), doc-id tie-break."""
+    resp = search(reader, max_hits=25, sort_fields=(
+        SortField("tenant_id", "asc"), SortField("timestamp", "desc")))
+    expected = sorted(
+        range(NUM_DOCS),
+        key=lambda i: (DOCS[i]["tenant_id"], -DOCS[i]["timestamp"], i))[:25]
+    got = [h.doc_id for h in resp.partial_hits]
+    assert got == expected
+    # raw values decode per-key
+    top = resp.partial_hits[0]
+    assert top.raw_sort_value == DOCS[top.doc_id]["tenant_id"]
+    assert top.raw_sort_value2 == DOCS[top.doc_id]["timestamp"] * 1_000_000
+
+
+def test_two_key_sort_with_scores_secondary(reader):
+    resp = search(reader, query_ast=FullText("body", "beta", "or"), max_hits=10,
+                  sort_fields=(SortField("tenant_id", "desc"),
+                               SortField("_score", "desc")))
+    scores = brute_bm25("beta")
+    expected = sorted(scores, key=lambda i: (-DOCS[i]["tenant_id"],
+                                             -scores[i], i))[:10]
+    assert [h.doc_id for h in resp.partial_hits] == expected
+
+
+def test_two_key_search_after(reader):
+    sorts = (SortField("tenant_id", "asc"), SortField("timestamp", "desc"))
+    page1 = search(reader, max_hits=9, sort_fields=sorts)
+    last = page1.partial_hits[-1]
+    page2 = search(reader, max_hits=9, sort_fields=sorts,
+                   search_after=[last.raw_sort_value, last.raw_sort_value2,
+                                 last.split_id, last.doc_id])
+    expected = sorted(
+        range(NUM_DOCS),
+        key=lambda i: (DOCS[i]["tenant_id"], -DOCS[i]["timestamp"], i))[9:18]
+    assert [h.doc_id for h in page2.partial_hits] == expected
+
+
+def test_doc_secondary_sort_normalized(reader):
+    """Regression: a `_doc` secondary is the implicit tie-break and must
+    normalize away so search_after markers stay single-key."""
+    req = SearchRequest(index_ids=["t"], query_ast=MatchAll(),
+                        sort_fields=(SortField("tenant_id", "asc"),
+                                     SortField("_doc", "asc")))
+    assert len(req.sort_fields) == 1
+    resp = search(reader, max_hits=9,
+                  sort_fields=(SortField("tenant_id", "asc"),
+                               SortField("_doc", "asc")))
+    last = resp.partial_hits[-1]
+    page2 = search(reader, max_hits=9,
+                   sort_fields=(SortField("tenant_id", "asc"),
+                                SortField("_doc", "asc")),
+                   search_after=[last.raw_sort_value, last.split_id, last.doc_id])
+    expected = sorted(range(NUM_DOCS),
+                      key=lambda i: (DOCS[i]["tenant_id"], i))[9:18]
+    assert [h.doc_id for h in page2.partial_hits] == expected
+
+
+def test_score_ascending_secondary(reader):
+    """Regression: `_score` asc as a secondary key must order worst-first
+    within primary ties."""
+    resp = search(reader, query_ast=FullText("body", "beta", "or"), max_hits=12,
+                  sort_fields=(SortField("tenant_id", "desc"),
+                               SortField("_score", "asc")))
+    scores = brute_bm25("beta")
+    expected = sorted(scores, key=lambda i: (-DOCS[i]["tenant_id"],
+                                             scores[i], i))[:12]
+    assert [h.doc_id for h in resp.partial_hits] == expected

@@ -388,3 +388,18 @@ def test_clear_scroll(api):
     assert status == 200 and result["released"] is True
     status, _ = api.request("GET", f"/api/v1/scroll?scroll_id={scroll_id}")
     assert status == 400  # context gone
+
+
+def test_es_two_field_sort(api):
+    status, result = api.request("POST", "/api/v1/_elastic/hdfs-logs/_search", {
+        "query": {"match_all": {}},
+        "sort": [{"tenant_id": {"order": "asc"}},
+                 {"timestamp": {"order": "desc"}}],
+        "size": 6,
+    })
+    assert status == 200
+    rows = [(h["_source"]["tenant_id"], h["_source"]["timestamp"])
+            for h in result["hits"]["hits"]]
+    assert rows == sorted(rows, key=lambda r: (r[0], -r[1]))
+    # both sort values surface in the ES `sort` array
+    assert len(result["hits"]["hits"][0]["sort"]) == 2
