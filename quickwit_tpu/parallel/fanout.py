@@ -83,7 +83,16 @@ def _global_agg_overrides(agg_specs, readers: list[SplitReader],
     terms_cards: dict[str, int] = {}
     terms_keys: dict[str, list] = {}
     from ..search.plan import MAX_BUCKETS, PlanError
+    # nested child buckets need batch-global spaces too (their per-split
+    # ordinal/origin spaces would otherwise be summed incoherently on
+    # device); children key under "parent>child" since ES names are only
+    # unique per level
+    expanded = [(spec, spec.name) for spec in agg_specs]
     for spec in agg_specs:
+        sub = getattr(spec, "sub_bucket", None)
+        if sub is not None:
+            expanded.append((sub, f"{spec.name}>{sub.name}"))
+    for spec, override_key in expanded:
         if isinstance(spec, (DateHistogramAgg, HistogramAgg)):
             vmins, vmaxs = [], []
             for r in readers:
@@ -95,7 +104,7 @@ def _global_agg_overrides(agg_specs, readers: list[SplitReader],
                 vmins.append(spec.extended_bounds[0])
                 vmaxs.append(spec.extended_bounds[1])
             if not vmins:
-                histograms[spec.name] = (0, 1)
+                histograms[override_key] = (0, 1)
                 continue
             interval = spec.interval_micros if isinstance(spec, DateHistogramAgg) \
                 else spec.interval
@@ -108,8 +117,8 @@ def _global_agg_overrides(agg_specs, readers: list[SplitReader],
                 raise PlanError(
                     f"aggregation {spec.name!r} would create {num_buckets} "
                     f"buckets over the batch (max {MAX_BUCKETS})")
-            histograms[spec.name] = (origin if isinstance(spec, HistogramAgg)
-                                     else int(origin), num_buckets)
+            histograms[override_key] = (origin if isinstance(spec, HistogramAgg)
+                                        else int(origin), num_buckets)
         elif isinstance(spec, TermsAgg):
             union: set = set()
             for r in readers:

@@ -5,10 +5,10 @@ aggregations.rs` + tantivy's aggregation request JSON): parses the ES
 `aggs` request dict into typed specs the leaf executor lowers onto columnar
 kernels (`ops/aggs.py`).
 
-Supported (round 1): date_histogram (fixed_interval), histogram, terms,
-avg/min/max/sum/stats/value_count, percentiles. Sub-aggregations are parsed
-but only metric-under-bucket is executed (one level), matching the
-benchmark configs; deeper nesting raises.
+Supported: date_histogram (fixed_interval), histogram, terms,
+avg/min/max/sum/stats/value_count, percentiles. Sub-aggregations: metrics
+under buckets, plus ONE nested bucket level (e.g. date_histogram > terms)
+with its own metrics; deeper nesting raises.
 """
 
 from __future__ import annotations
@@ -49,6 +49,7 @@ class DateHistogramAgg:
     min_doc_count: int = 0
     extended_bounds: Optional[tuple[int, int]] = None  # micros
     sub_metrics: tuple[MetricAgg, ...] = ()
+    sub_bucket: Optional["AggSpec"] = None
 
 
 @dataclass(frozen=True)
@@ -58,6 +59,7 @@ class HistogramAgg:
     interval: float
     min_doc_count: int = 0
     sub_metrics: tuple[MetricAgg, ...] = ()
+    sub_bucket: Optional["AggSpec"] = None
 
 
 @dataclass(frozen=True)
@@ -68,6 +70,7 @@ class TermsAgg:
     min_doc_count: int = 1
     order_by_count_desc: bool = True
     sub_metrics: tuple[MetricAgg, ...] = ()
+    sub_bucket: Optional["AggSpec"] = None
 
 
 AggSpec = Any  # union of the four dataclasses above
@@ -83,15 +86,31 @@ def _parse_metric(name: str, kind: str, body: dict[str, Any]) -> MetricAgg:
     return MetricAgg(name=name, kind=kind, field=body["field"], percents=percents)
 
 
-def _parse_sub_aggs(name: str, sub: dict[str, Any]) -> tuple[MetricAgg, ...]:
+_BUCKET_KINDS = ("date_histogram", "histogram", "terms")
+
+
+def _parse_sub_aggs(name: str, sub: dict[str, Any], depth: int = 0):
+    """(metrics, sub_bucket|None). One nested bucket level allowed."""
     metrics = []
+    sub_bucket = None
     for sub_name, sub_body in sub.items():
         sub_kind = _agg_kind(sub_body)
-        if sub_kind not in _METRIC_KINDS:
+        if sub_kind in _METRIC_KINDS:
+            metrics.append(_parse_metric(sub_name, sub_kind, sub_body[sub_kind]))
+        elif sub_kind in _BUCKET_KINDS:
+            if depth >= 1:
+                raise AggParseError(
+                    f"aggregation {name!r}: bucket nesting deeper than one "
+                    "level is not supported")
+            if sub_bucket is not None:
+                raise AggParseError(
+                    f"aggregation {name!r}: at most one nested bucket "
+                    "aggregation is supported")
+            sub_bucket = _parse_one(sub_name, sub_body, depth=depth + 1)
+        else:
             raise AggParseError(
-                f"aggregation {name!r}: only metric sub-aggregations supported, got {sub_kind}")
-        metrics.append(_parse_metric(sub_name, sub_kind, sub_body[sub_kind]))
-    return tuple(metrics)
+                f"aggregation {name!r}: unsupported sub-aggregation {sub_kind}")
+    return tuple(metrics), sub_bucket
 
 
 def _agg_kind(body: dict[str, Any]) -> str:
@@ -101,43 +120,45 @@ def _agg_kind(body: dict[str, Any]) -> str:
     return kinds[0]
 
 
+def _parse_one(name: str, body: dict[str, Any], depth: int = 0) -> AggSpec:
+    kind = _agg_kind(body)
+    params = body[kind]
+    sub = body.get("aggs") or body.get("aggregations") or {}
+    sub_metrics, sub_bucket = _parse_sub_aggs(name, sub, depth)
+    if kind == "date_histogram":
+        interval = params.get("fixed_interval") or params.get("interval")
+        if interval is None:
+            raise AggParseError(f"date_histogram {name!r} requires fixed_interval")
+        bounds = None
+        if "extended_bounds" in params:
+            b = params["extended_bounds"]
+            bounds = (int(b["min"]) * 1000, int(b["max"]) * 1000) \
+                if params.get("bounds_unit") == "ms" else (int(b["min"]), int(b["max"]))
+        return DateHistogramAgg(
+            name=name, field=params["field"],
+            interval_micros=parse_interval_micros(interval),
+            min_doc_count=params.get("min_doc_count", 0),
+            extended_bounds=bounds, sub_metrics=sub_metrics,
+            sub_bucket=sub_bucket)
+    if kind == "histogram":
+        return HistogramAgg(
+            name=name, field=params["field"], interval=float(params["interval"]),
+            min_doc_count=params.get("min_doc_count", 0),
+            sub_metrics=sub_metrics, sub_bucket=sub_bucket)
+    if kind == "terms":
+        order = params.get("order", {"_count": "desc"})
+        return TermsAgg(
+            name=name, field=params["field"], size=params.get("size", 10),
+            min_doc_count=params.get("min_doc_count", 1),
+            order_by_count_desc=order.get("_count", "desc") == "desc",
+            sub_metrics=sub_metrics, sub_bucket=sub_bucket)
+    if kind in _METRIC_KINDS:
+        if sub_metrics or sub_bucket:
+            raise AggParseError(f"metric aggregation {name!r} cannot have sub-aggs")
+        return _parse_metric(name, kind, params)
+    raise AggParseError(f"unsupported aggregation kind {kind!r}")
+
+
 def parse_aggs(aggs: dict[str, Any]) -> list[AggSpec]:
     """ES `aggs` dict → typed specs."""
-    specs: list[AggSpec] = []
-    for name, body in aggs.items():
-        kind = _agg_kind(body)
-        params = body[kind]
-        sub = body.get("aggs") or body.get("aggregations") or {}
-        sub_metrics = _parse_sub_aggs(name, sub)
-        if kind == "date_histogram":
-            interval = params.get("fixed_interval") or params.get("interval")
-            if interval is None:
-                raise AggParseError(f"date_histogram {name!r} requires fixed_interval")
-            bounds = None
-            if "extended_bounds" in params:
-                b = params["extended_bounds"]
-                bounds = (int(b["min"]) * 1000, int(b["max"]) * 1000) \
-                    if params.get("bounds_unit") == "ms" else (int(b["min"]), int(b["max"]))
-            specs.append(DateHistogramAgg(
-                name=name, field=params["field"],
-                interval_micros=parse_interval_micros(interval),
-                min_doc_count=params.get("min_doc_count", 0),
-                extended_bounds=bounds, sub_metrics=sub_metrics))
-        elif kind == "histogram":
-            specs.append(HistogramAgg(
-                name=name, field=params["field"], interval=float(params["interval"]),
-                min_doc_count=params.get("min_doc_count", 0), sub_metrics=sub_metrics))
-        elif kind == "terms":
-            order = params.get("order", {"_count": "desc"})
-            specs.append(TermsAgg(
-                name=name, field=params["field"], size=params.get("size", 10),
-                min_doc_count=params.get("min_doc_count", 1),
-                order_by_count_desc=order.get("_count", "desc") == "desc",
-                sub_metrics=sub_metrics))
-        elif kind in _METRIC_KINDS:
-            if sub_metrics:
-                raise AggParseError(f"metric aggregation {name!r} cannot have sub-aggs")
-            specs.append(_parse_metric(name, kind, params))
-        else:
-            raise AggParseError(f"unsupported aggregation kind {kind!r}")
-    return specs
+    return [_parse_one(name, body) for name, body in aggs.items()]

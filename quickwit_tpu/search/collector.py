@@ -112,6 +112,7 @@ def _copy_state(state: dict[str, Any]) -> dict[str, Any]:
         copy["bucket_map"] = _histogram_to_map(state)
         copy.pop("counts", None)
         copy.pop("metrics", None)
+        _carry_sub_info(copy, state)
         return copy
     if kind == "terms":
         copy = dict(state)
@@ -119,8 +120,21 @@ def _copy_state(state: dict[str, Any]) -> dict[str, Any]:
         copy.pop("counts", None)
         copy.pop("metrics", None)
         copy.pop("keys", None)
+        _carry_sub_info(copy, state)
         return copy
     return dict(state)
+
+
+def _carry_sub_info(copy: dict, state: dict) -> None:
+    """Finalization parameters of the nested child aggregation (one level)."""
+    sub = state.get("sub")
+    if sub is None:
+        copy.pop("sub", None)
+        return
+    copy["sub_info"] = {k: sub.get(k) for k in
+                        ("name", "kind", "interval", "origin", "min_doc_count",
+                         "size", "order_desc", "extended_bounds")}
+    copy.pop("sub", None)
 
 
 def _new_metric_acc(kind: str) -> dict[str, Any]:
@@ -143,8 +157,44 @@ def _acc_metric(acc: dict[str, Any], arrays: dict[str, np.ndarray], i: int) -> N
 
 def _copy_bucket_map(bucket_map: dict) -> dict:
     return {key: {"doc_count": b["doc_count"],
-                  "metrics": {m: dict(acc) for m, acc in b["metrics"].items()}}
+                  "metrics": {m: dict(acc) for m, acc in b["metrics"].items()},
+                  **({"sub_map": _copy_bucket_map(b["sub_map"])}
+                     if "sub_map" in b else {})}
             for key, b in bucket_map.items()}
+
+
+def _sub_key(sub: dict, j: int):
+    if sub["kind"] == "terms":
+        keys = sub["keys"]
+        return keys[j] if j < len(keys) else None
+    return sub["origin"] + j * sub["interval"]
+
+
+def _attach_sub_map(bucket: dict, state: dict, parent_index: int) -> None:
+    """Nested child buckets of one parent bucket, decoded from the flattened
+    [nb1*nb2] device states."""
+    sub = state.get("sub")
+    if sub is None:
+        return
+    nb2 = sub["nb2"]
+    base = parent_index * nb2
+    counts = sub["counts"]
+    metric_kinds = sub.get("metric_kinds", {})
+    sub_map: dict = {}
+    for j in range(nb2):
+        flat = base + j
+        if flat >= len(counts) or counts[flat] == 0:
+            continue
+        key = _sub_key(sub, j)
+        if key is None:
+            continue
+        child = {"doc_count": int(counts[flat]), "metrics": {}}
+        for mname, arrays in sub.get("metrics", {}).items():
+            acc = _new_metric_acc(metric_kinds.get(mname, "avg"))
+            _acc_metric(acc, arrays, flat)
+            child["metrics"][mname] = acc
+        sub_map[key] = child
+    bucket["sub_map"] = sub_map
 
 
 def _histogram_to_map(state: dict[str, Any]) -> dict[float, dict[str, Any]]:
@@ -163,7 +213,28 @@ def _histogram_to_map(state: dict[str, Any]) -> dict[float, dict[str, Any]]:
             acc = _new_metric_acc(metric_kinds.get(mname, "avg"))
             _acc_metric(acc, arrays, int(i))
             bucket["metrics"][mname] = acc
+        _attach_sub_map(bucket, state, int(i))
         out[key] = bucket
+    return out
+
+
+def _terms_to_map(state: dict[str, Any]) -> dict[Any, dict[str, Any]]:
+    if "bucket_map" in state:  # already-merged state (tree merging at root)
+        return _copy_bucket_map(state["bucket_map"])
+    counts = state["counts"]
+    keys = state["keys"]
+    metric_kinds = state.get("metric_kinds", {})
+    out: dict[Any, dict[str, Any]] = {}
+    for i in np.nonzero(counts)[0]:
+        if i >= len(keys):
+            continue
+        bucket = {"doc_count": int(counts[i]), "metrics": {}}
+        for mname, arrays in state.get("metrics", {}).items():
+            acc = _new_metric_acc(metric_kinds.get(mname, "avg"))
+            _acc_metric(acc, arrays, int(i))
+            bucket["metrics"][mname] = acc
+        _attach_sub_map(bucket, state, int(i))
+        out[keys[i]] = bucket
     return out
 
 
@@ -184,31 +255,17 @@ def _merge_bucket_maps(bucket_map: dict, incoming: dict) -> None:
                 cacc["min"] = min(cacc["min"], acc["min"])
                 cacc["max"] = max(cacc["max"], acc["max"])
                 cacc["sum_sq"] += acc["sum_sq"]
+        if "sub_map" in bucket:
+            if "sub_map" not in cur:
+                cur["sub_map"] = bucket["sub_map"]
+            else:
+                _merge_bucket_maps(cur["sub_map"], bucket["sub_map"])
 
 
 def _merge_histogram(current: dict[str, Any], state: dict[str, Any]) -> None:
     _merge_bucket_maps(current["bucket_map"], _histogram_to_map(state))
     if state.get("extended_bounds") and not current.get("extended_bounds"):
         current["extended_bounds"] = state["extended_bounds"]
-
-
-def _terms_to_map(state: dict[str, Any]) -> dict[Any, dict[str, Any]]:
-    if "bucket_map" in state:  # already-merged state (tree merging at root)
-        return _copy_bucket_map(state["bucket_map"])
-    counts = state["counts"]
-    keys = state["keys"]
-    metric_kinds = state.get("metric_kinds", {})
-    out: dict[Any, dict[str, Any]] = {}
-    for i in np.nonzero(counts)[0]:
-        if i >= len(keys):
-            continue
-        bucket = {"doc_count": int(counts[i]), "metrics": {}}
-        for mname, arrays in state.get("metrics", {}).items():
-            acc = _new_metric_acc(metric_kinds.get(mname, "avg"))
-            _acc_metric(acc, arrays, int(i))
-            bucket["metrics"][mname] = acc
-        out[keys[i]] = bucket
-    return out
 
 
 def _merge_terms(current: dict[str, Any], state: dict[str, Any]) -> None:
@@ -241,60 +298,71 @@ def _finalize_metric(acc: dict[str, Any]) -> dict[str, Any]:
     raise ValueError(f"unknown metric kind {kind}")
 
 
+def _finalize_bucket_map(bucket_map: dict, info: dict[str, Any],
+                         sub_info: Optional[dict] = None) -> dict[str, Any]:
+    """One bucket map → ES-shaped buckets. Shared by top-level aggregations
+    and nested children (children never have grandchildren: one level)."""
+    kind = info["kind"]
+
+    def entry_for(key, bucket, key_scaled):
+        entry: dict[str, Any] = {"key": key_scaled,
+                                 "doc_count": bucket["doc_count"]}
+        for mname, acc in bucket["metrics"].items():
+            entry[mname] = _finalize_metric(acc)
+        if sub_info is not None:
+            entry[sub_info["name"]] = _finalize_bucket_map(
+                bucket.get("sub_map", {}), sub_info)
+        return entry
+
+    if kind == "terms":
+        min_dc = info.get("min_doc_count")
+        min_dc = 1 if min_dc is None else min_dc
+        items = [(k, b) for k, b in bucket_map.items()
+                 if b["doc_count"] >= min_dc]
+        if info.get("order_desc", True):
+            items.sort(key=lambda kb: (-kb[1]["doc_count"], str(kb[0])))
+        else:  # ES order {"_count": "asc"}: rarest terms first
+            items.sort(key=lambda kb: (kb[1]["doc_count"], str(kb[0])))
+        size = info.get("size") or 10
+        total_other = sum(b["doc_count"] for _, b in items[size:])
+        return {"buckets": [entry_for(k, b, k) for k, b in items[:size]],
+                "sum_other_doc_count": int(total_other),
+                "doc_count_error_upper_bound": 0}
+
+    # histograms
+    min_dc = info.get("min_doc_count") or 0
+    interval = info["interval"]
+    bounds = info.get("extended_bounds")
+    keys = sorted(bucket_map)
+    if keys and min_dc == 0:
+        # ES semantics: empty buckets are materialized across the observed
+        # range (and any extended_bounds) when min_doc_count=0
+        lo, hi = keys[0], keys[-1]
+        if bounds and kind == "date_histogram":
+            lo = min(lo, (bounds[0] // interval) * interval)
+            hi = max(hi, (bounds[1] // interval) * interval)
+        num = int(round((hi - lo) / interval)) + 1
+        keys = [lo + i * interval for i in range(num)]
+    buckets = []
+    for key in keys:
+        bucket = bucket_map.get(key, {"doc_count": 0, "metrics": {}})
+        if bucket["doc_count"] < min_dc:
+            continue
+        scaled = key / 1000.0 if kind == "date_histogram" else key
+        buckets.append(entry_for(key, bucket, scaled))
+    return {"buckets": buckets}
+
+
 def finalize_aggregations(agg_states: dict[str, Any]) -> dict[str, Any]:
     out: dict[str, Any] = {}
     for name, state in agg_states.items():
-        state = _copy_state(state) if "bucket_map" not in state and state["kind"] in (
-            "date_histogram", "histogram", "terms") else state
+        if "bucket_map" not in state and state["kind"] in (
+                "date_histogram", "histogram", "terms"):
+            state = _copy_state(state)
         kind = state["kind"]
-        if kind in ("date_histogram", "histogram"):
-            min_dc = state.get("min_doc_count", 0)
-            bucket_map = state["bucket_map"]
-            keys = sorted(bucket_map)
-            bounds = state.get("extended_bounds")
-            interval = state["interval"]
-            if keys and min_dc == 0:
-                # ES semantics: empty buckets are materialized across the
-                # observed range (and any extended_bounds) when min_doc_count=0
-                lo, hi = keys[0], keys[-1]
-                if bounds and kind == "date_histogram":
-                    lo = min(lo, (bounds[0] // interval) * interval)
-                    hi = max(hi, (bounds[1] // interval) * interval)
-                num = int(round((hi - lo) / interval)) + 1
-                keys = [lo + i * interval for i in range(num)]
-            buckets = []
-            for key in keys:
-                bucket = bucket_map.get(key, {"doc_count": 0, "metrics": {}})
-                if bucket["doc_count"] < min_dc:
-                    continue
-                entry: dict[str, Any] = {"doc_count": bucket["doc_count"]}
-                if kind == "date_histogram":
-                    entry["key"] = key / 1000.0   # ES convention: epoch millis
-                else:
-                    entry["key"] = key
-                for mname, acc in bucket["metrics"].items():
-                    entry[mname] = _finalize_metric(acc)
-                buckets.append(entry)
-            out[name] = {"buckets": buckets}
-        elif kind == "terms":
-            bucket_map = state["bucket_map"]
-            min_dc = state.get("min_doc_count", 1)
-            items = [(k, b) for k, b in bucket_map.items() if b["doc_count"] >= min_dc]
-            if state.get("order_desc", True):
-                items.sort(key=lambda kb: (-kb[1]["doc_count"], str(kb[0])))
-            else:  # ES order {"_count": "asc"}: rarest terms first
-                items.sort(key=lambda kb: (kb[1]["doc_count"], str(kb[0])))
-            size = state.get("size", 10)
-            total_other = sum(b["doc_count"] for _, b in items[size:])
-            buckets = []
-            for key, bucket in items[:size]:
-                entry = {"key": key, "doc_count": bucket["doc_count"]}
-                for mname, acc in bucket["metrics"].items():
-                    entry[mname] = _finalize_metric(acc)
-                buckets.append(entry)
-            out[name] = {"buckets": buckets,
-                         "sum_other_doc_count": int(total_other),
-                         "doc_count_error_upper_bound": 0}
+        if kind in ("date_histogram", "histogram", "terms"):
+            out[name] = _finalize_bucket_map(
+                state["bucket_map"], state, sub_info=state.get("sub_info"))
         elif kind == "percentiles":
             quantiles = sketch_quantiles(state["sketch"],
                                          [p / 100.0 for p in state["percents"]])

@@ -36,29 +36,32 @@ from .plan import (
 _JIT_CACHE: dict[tuple, Callable] = {}
 
 
-def _eval_bucket_agg(a: BucketAggExec, arrays, scalars, mask):
+def _bucket_idx(a: BucketAggExec, arrays, scalars, mask):
+    """(idx, in_bucket_mask): per-doc bucket index with the out-of-range
+    sentinel `num_buckets` for dropped docs."""
     values = arrays[a.values_slot]
     nb = a.num_buckets
     if a.kind == "terms":
         ordinals = values
         m = mask & (ordinals >= 0)
         idx = jnp.where(m, ordinals, jnp.int32(nb))
+        return idx, m
+    present = arrays[a.present_slot].astype(jnp.bool_)
+    m = mask & present
+    origin = scalars[a.origin_slot]
+    interval = scalars[a.interval_slot]
+    if a.kind == "date_histogram":
+        raw = (values - origin) // interval          # exact integer math
     else:
-        present = arrays[a.present_slot].astype(jnp.bool_)
-        m = mask & present
-        origin = scalars[a.origin_slot]
-        interval = scalars[a.interval_slot]
-        if a.kind == "date_histogram":
-            raw = (values - origin) // interval          # exact i64 math
-        else:
-            raw = jnp.floor((values.astype(jnp.float64) - origin) / interval)
-        idx = raw.astype(jnp.int32)
-        m = m & (idx >= 0) & (idx < nb)
-        idx = jnp.where(m, idx, jnp.int32(nb))
-    counts = agg_ops.bucket_counts(idx, nb)
-    out: dict[str, Any] = {"counts": counts}
+        raw = jnp.floor((values.astype(jnp.float64) - origin) / interval)
+    idx = raw.astype(jnp.int32)
+    m = m & (idx >= 0) & (idx < nb)
+    return jnp.where(m, idx, jnp.int32(nb)), m
+
+
+def _bucket_metrics(metric_slots, arrays, idx, m, nb):
     metrics: dict[str, Any] = {}
-    for met in a.metrics:
+    for met in metric_slots:
         mv = arrays[met.values_slot].astype(jnp.float64)
         mp = arrays[met.present_slot].astype(jnp.bool_)
         # docs with mm==False get the sentinel index; both bucket-kernel
@@ -78,7 +81,26 @@ def _eval_bucket_agg(a: BucketAggExec, arrays, scalars, mask):
         if need == "stats":
             state["sum_sq"] = agg_ops.bucket_sum(midx, mv * mv, nb)
         metrics[met.name] = state
-    out["metrics"] = metrics
+    return metrics
+
+
+def _eval_bucket_agg(a: BucketAggExec, arrays, scalars, mask):
+    nb = a.num_buckets
+    idx, m = _bucket_idx(a, arrays, scalars, mask)
+    counts = agg_ops.bucket_counts(idx, nb)
+    out: dict[str, Any] = {"counts": counts,
+                           "metrics": _bucket_metrics(a.metrics, arrays, idx,
+                                                      m, nb)}
+    if a.sub is not None:
+        nb2 = a.sub.num_buckets
+        idx2, m2 = _bucket_idx(a.sub, arrays, scalars, mask)
+        both = m & m2
+        combined = jnp.where(both, idx * nb2 + idx2, jnp.int32(nb * nb2))
+        out["sub"] = {
+            "counts": agg_ops.bucket_counts(combined, nb * nb2),
+            "metrics": _bucket_metrics(a.sub.metrics, arrays, combined, both,
+                                       nb * nb2),
+        }
     return out
 
 

@@ -188,6 +188,16 @@ def _intermediate_aggs(plan, agg_results: list) -> dict[str, Any]:
                 "metric_kinds": {m.name: m.kind for m in a.metrics},
                 **a.host_info,
             }
+            if a.sub is not None and "sub" in res:
+                state["sub"] = {
+                    "name": a.sub.name, "kind": a.sub.kind,
+                    "nb2": a.sub.num_buckets,
+                    "counts": np.asarray(res["sub"]["counts"]),
+                    "metrics": {name: {k: np.asarray(v) for k, v in m.items()}
+                                for name, m in res["sub"]["metrics"].items()},
+                    "metric_kinds": {m.name: m.kind for m in a.sub.metrics},
+                    **a.sub.host_info,
+                }
             out[a.name] = state
         elif isinstance(a, MetricAggExec):
             met = a.metric

@@ -152,11 +152,15 @@ class BucketAggExec:
     metrics: tuple[MetricSlots, ...] = ()
     # host-side info for finalization (not part of jit signature)
     host_info: Any = None
+    # one nested bucket level (e.g. date_histogram > terms)
+    sub: Optional["BucketAggExec"] = None
 
     def sig(self) -> str:
+        sub_sig = self.sub.sig() if self.sub is not None else ""
         return (f"bagg({self.kind},{self.values_slot},{self.present_slot},"
                 f"{self.num_buckets},{self.origin_slot},{self.interval_slot},"
-                + ",".join(m.sig() for m in self.metrics) + ")")
+                + ",".join(m.sig() for m in self.metrics)
+                + f",sub[{sub_sig}])")
 
 
 @dataclass(frozen=True)
@@ -566,6 +570,26 @@ class Lowering:
     def lower_agg(self, spec: AggSpec) -> Any:
         if isinstance(spec, MetricAgg):
             return MetricAggExec(spec.name, self.lower_metric(spec))
+        exec_ = self._lower_bucket_agg(spec)
+        sub_spec = getattr(spec, "sub_bucket", None)
+        if sub_spec is not None:
+            # nested children resolve batch overrides under a path-qualified
+            # key: ES names are only unique per level, so a child may legally
+            # share a name with another aggregation
+            child = self._lower_bucket_agg(
+                sub_spec, override_key=f"{spec.name}>{sub_spec.name}")
+            if exec_.num_buckets * child.num_buckets > MAX_BUCKETS:
+                raise PlanError(
+                    f"nested aggregation {spec.name!r}>{sub_spec.name!r} would "
+                    f"create {exec_.num_buckets * child.num_buckets} buckets "
+                    f"(max {MAX_BUCKETS})")
+            from dataclasses import replace as dc_replace
+            exec_ = dc_replace(exec_, sub=child)
+        return exec_
+
+    def _lower_bucket_agg(self, spec: AggSpec,
+                          override_key: Optional[str] = None) -> "BucketAggExec":
+        override_key = override_key or spec.name
         if isinstance(spec, DateHistogramAgg):
             fm = self._field(spec.field)
             if fm.type is not FieldType.DATETIME or not fm.fast:
@@ -574,8 +598,8 @@ class Lowering:
             vmin, vmax = meta.get("min_value"), meta.get("max_value")
             interval = spec.interval_micros
             # resolve the bucket space (batch-global origin wins)
-            if self.batch is not None and spec.name in self.batch.get("histograms", {}):
-                origin, num_buckets = self.batch["histograms"][spec.name]
+            if self.batch is not None and override_key in self.batch.get("histograms", {}):
+                origin, num_buckets = self.batch["histograms"][override_key]
             elif vmin is None:
                 origin, num_buckets = 0, 1
             else:
@@ -627,8 +651,8 @@ class Lowering:
         if isinstance(spec, HistogramAgg):
             fm = self._field(spec.field)
             values_slot, present_slot = self._column_slots(spec.field)
-            if self.batch is not None and spec.name in self.batch.get("histograms", {}):
-                origin, num_buckets = self.batch["histograms"][spec.name]
+            if self.batch is not None and override_key in self.batch.get("histograms", {}):
+                origin, num_buckets = self.batch["histograms"][override_key]
                 return BucketAggExec(
                     spec.name, "histogram", values_slot, present_slot, num_buckets,
                     self.b.add_scalar(origin, np.float64),

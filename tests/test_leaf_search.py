@@ -402,3 +402,71 @@ def test_score_ascending_secondary(reader):
     expected = sorted(scores, key=lambda i: (-DOCS[i]["tenant_id"],
                                              scores[i], i))[:12]
     assert [h.doc_id for h in resp.partial_hits] == expected
+
+
+def test_nested_date_histogram_terms(reader):
+    """date_histogram > terms(severity) with a nested metric — parity vs
+    brute force across the collector merge."""
+    resp = search(reader, max_hits=0, aggs={
+        "over_time": {
+            "date_histogram": {"field": "timestamp", "fixed_interval": "1h"},
+            "aggs": {"by_sev": {"terms": {"field": "severity_text", "size": 10},
+                                "aggs": {"avg_lat": {"avg": {"field": "latency"}}}}},
+        },
+    })
+    coll = IncrementalCollector(max_hits=0)
+    coll.add_leaf_response(resp)
+    result = finalize_aggregations(coll.aggregation_states())
+
+    hour = 3_600_000_000
+    expected: dict = {}
+    for d in DOCS:
+        hkey = (d["timestamp"] * 1_000_000 // hour) * hour
+        sub = expected.setdefault(hkey, {})
+        entry = sub.setdefault(d["severity_text"], {"n": 0, "lat": 0.0})
+        entry["n"] += 1
+        entry["lat"] += d["latency"]
+    for b in result["over_time"]["buckets"]:
+        hkey = int(b["key"] * 1000)
+        exp = expected[hkey]
+        got = {c["key"]: c for c in b["by_sev"]["buckets"]}
+        assert set(got) == set(exp), hkey
+        for sev, e in exp.items():
+            assert got[sev]["doc_count"] == e["n"]
+            assert got[sev]["avg_lat"]["value"] == pytest.approx(
+                e["lat"] / e["n"], rel=1e-9)
+
+
+def test_nested_terms_date_histogram_multi_split():
+    """terms > date_histogram merged across multiple splits."""
+    storage = RamStorage(Uri.parse("ram:///nested2"))
+    readers = []
+    for s in range(2):
+        w = SplitWriter(MAPPER)
+        for d in DOCS[s::2]:
+            w.add_json_doc(d)
+        storage.put(f"{s}.split", w.finish())
+        readers.append(SplitReader(storage, f"{s}.split"))
+    coll = IncrementalCollector(max_hits=0)
+    for s, r in enumerate(readers):
+        resp = leaf_search_single_split(
+            SearchRequest(index_ids=["t"], query_ast=MatchAll(), max_hits=0,
+                          aggs={"sev": {"terms": {"field": "severity_text"},
+                                        "aggs": {"ot": {"date_histogram": {
+                                            "field": "timestamp",
+                                            "fixed_interval": "1h"}}}}}),
+            MAPPER, r, f"s{s}")
+        coll.add_leaf_response(resp)
+    result = finalize_aggregations(coll.aggregation_states())
+    hour = 3_600_000_000
+    expected: dict = {}
+    for d in DOCS:
+        sub = expected.setdefault(d["severity_text"], {})
+        hkey = (d["timestamp"] * 1_000_000 // hour) * hour
+        sub[hkey] = sub.get(hkey, 0) + 1
+    got = {b["key"]: b for b in result["sev"]["buckets"]}
+    assert set(got) == set(expected)
+    for sev, hist in expected.items():
+        child = {int(c["key"] * 1000): c["doc_count"]
+                 for c in got[sev]["ot"]["buckets"]}
+        assert child == hist, sev

@@ -256,3 +256,40 @@ def test_batch_phrase_with_term_missing_in_one_split():
     resp = execute_batch(batch, req)
     assert resp.num_hits == 1
     assert resp.partial_hits[0].split_id == "a"
+
+
+def test_batch_nested_aggregation_parity(readers):
+    """Nested terms>date_histogram through the batched device path must
+    equal the sequential per-split merge."""
+    request = SearchRequest(
+        index_ids=["x"], query_ast=MatchAll(), max_hits=0,
+        aggs={"sev": {"terms": {"field": "severity_text"},
+                      "aggs": {"ot": {"date_histogram": {
+                          "field": "timestamp", "fixed_interval": "1h"}}}}})
+    expected = reference_merge(request, readers)
+    got = batch_result(request, readers)
+    got_coll = IncrementalCollector(max_hits=0)
+    got_coll.add_leaf_response(got)
+    assert _normalize(finalize_aggregations(got_coll.aggregation_states())) == \
+        _normalize(finalize_aggregations(expected.aggregation_states()))
+
+
+def test_batch_nested_histogram_name_collision(readers):
+    """Regression: a nested date_histogram child sharing a name with a
+    top-level date_histogram must keep its own batch-global bucket space
+    (overrides key by parent>child path)."""
+    request = SearchRequest(
+        index_ids=["x"], query_ast=MatchAll(), max_hits=0,
+        aggs={
+            "h": {"date_histogram": {"field": "timestamp",
+                                     "fixed_interval": "1h"}},
+            "t": {"terms": {"field": "severity_text"},
+                  "aggs": {"h": {"date_histogram": {"field": "timestamp",
+                                                    "fixed_interval": "1d"}}}},
+        })
+    expected = reference_merge(request, readers)
+    got = batch_result(request, readers)
+    got_coll = IncrementalCollector(max_hits=0)
+    got_coll.add_leaf_response(got)
+    assert _normalize(finalize_aggregations(got_coll.aggregation_states())) == \
+        _normalize(finalize_aggregations(expected.aggregation_states()))
