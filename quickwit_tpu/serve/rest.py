@@ -145,6 +145,9 @@ class RestServer:
             return (200, True) if node.cluster.is_ready() else (503, False)
         if path == "/metrics":
             return 200, METRICS.expose_text()
+        if path in ("/ui", "/ui/", "/") and method == "GET":
+            from .ui import UI_HTML
+            return 200, ("__html__", UI_HTML)
         if path == "/api/v1/cluster":
             return 200, {
                 "node_id": node.config.node_id,
@@ -523,7 +526,11 @@ def _make_handler(server: RestServer):
             except Exception as exc:  # noqa: BLE001
                 logger.exception("internal error on %s %s", method, parsed.path)
                 status, payload = 500, {"message": f"internal error: {exc}"}
-            if isinstance(payload, str):
+            if (isinstance(payload, tuple) and len(payload) == 2
+                    and payload[0] == "__html__"):
+                data = payload[1].encode()
+                content_type = "text/html; charset=utf-8"
+            elif isinstance(payload, str):
                 data = payload.encode()
                 content_type = "text/plain; version=0.0.4"
             else:
