@@ -53,12 +53,14 @@ def cmd_run(args) -> int:
     node = Node(config, storage_resolver=_resolver())
     server = RestServer(node)
     server.start()
+    node.start_background_services()
     print(f"node {config.node_id} (roles: {','.join(config.roles)}) "
           f"listening on http://{server.endpoint}")
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
+        node.stop_background_services()
         server.stop()
     return 0
 

@@ -26,6 +26,21 @@ from ..common.pubsub import EventBroker
 ALL_ROLES = ("searcher", "indexer", "metastore", "control_plane", "janitor",
              "ingester")
 
+_WILDCARD_HOSTS = ("0.0.0.0", "::", "")
+
+
+def substitute_wildcard_host(endpoint: str, reachable_host: str) -> str:
+    """A node bound to a wildcard address advertises an unroutable
+    `0.0.0.0:port` endpoint; replace the host with the address the peer
+    was actually reached at (the reference solves this with a dedicated
+    advertise-address config; here the transport knows the real address)."""
+    if not endpoint:
+        return endpoint
+    host, _, port = endpoint.rpartition(":")
+    if host in _WILDCARD_HOSTS and reachable_host:
+        return f"{reachable_host}:{port}"
+    return endpoint
+
 
 @dataclass
 class ClusterMember:
@@ -75,6 +90,17 @@ class Cluster:
             member = self._members.get(node_id)
             if member is not None:
                 member.last_heartbeat = time.monotonic()
+
+    def upsert_heartbeat(self, member: ClusterMember) -> None:
+        """Gossip upsert shared by both heartbeat transports (outbound
+        client + inbound REST route): join only when the peer is new or
+        its roles/endpoint changed (avoids a ClusterChange broadcast per
+        tick), then stamp liveness either way."""
+        current = self.member(member.node_id)
+        if (current is None or current.roles != member.roles
+                or current.rest_endpoint != member.rest_endpoint):
+            self.join(member)
+        self.record_heartbeat(member.node_id)
 
     # --- queries -----------------------------------------------------------
     def members(self, alive_only: bool = True) -> list[ClusterMember]:

@@ -29,9 +29,16 @@ class RoutingEntry:
 class IngestRouter:
     def __init__(self, ingester: Ingester,
                  get_or_create_shards: Optional[Callable[[str, str], list[str]]] = None,
-                 shards_per_source: int = 1):
+                 shards_per_source: int = 1,
+                 shard_prefix: str = ""):
         self.ingester = ingester
         self.shards_per_source = shards_per_source
+        # `shard_prefix` (normally the node id) keeps WAL shard ids unique
+        # across nodes: each node drains its own local WAL into a shared
+        # metastore, and per-shard checkpoint partitions must not collide
+        # (the reference's ingest-v2 shards are cluster-global for the
+        # same reason, control_plane.proto:65).
+        self.shard_prefix = f"{shard_prefix}-" if shard_prefix else ""
         # control-plane hook: GetOrCreateOpenShards (control_plane.proto:65);
         # default: local static placement
         self.get_or_create_shards = get_or_create_shards or self._default_shards
@@ -39,7 +46,8 @@ class IngestRouter:
         self._lock = threading.Lock()
 
     def _default_shards(self, index_uid: str, source_id: str) -> list[str]:
-        return [f"shard-{i:02d}" for i in range(self.shards_per_source)]
+        return [f"{self.shard_prefix}shard-{i:02d}"
+                for i in range(self.shards_per_source)]
 
     def _entry(self, index_uid: str, source_id: str) -> RoutingEntry:
         key = (index_uid, source_id)

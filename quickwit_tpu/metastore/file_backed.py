@@ -38,6 +38,7 @@ class _IndexState:
     """In-memory image of one index's metastore file."""
 
     def __init__(self, metadata: IndexMetadata):
+        self.loaded_at = time.monotonic()
         self.metadata = metadata
         self.splits: dict[str, Split] = {}
         self.checkpoints: dict[str, SourceCheckpoint] = {}
@@ -73,20 +74,33 @@ class _IndexState:
 
 
 class FileBackedMetastore(Metastore):
-    def __init__(self, storage: Storage, polling_interval_secs: Optional[float] = None):
+    def __init__(self, storage: Storage, polling_interval_secs: Optional[float] = 30.0):
+        """`polling_interval_secs`: cached per-index state older than this is
+        re-read from storage before serving reads, so other nodes' writes
+        become visible (the reference's file-backed polling). Writes always
+        persist immediately, so a reload never loses local mutations; like
+        the reference, concurrent WRITERS on one index are not supported
+        (single metastore-writer deployment)."""
         self.storage = storage
         self._lock = threading.RLock()
         self._states: dict[str, _IndexState] = {}  # index_id -> state
         self._manifest: Optional[dict[str, str]] = None  # index_id -> index_uid
+        self._manifest_loaded_at = 0.0
         self.polling_interval_secs = polling_interval_secs
 
     # --- manifest ----------------------------------------------------------
     def _load_manifest(self) -> dict[str, str]:
-        if self._manifest is None:
+        stale = (self._manifest is not None
+                 and self.polling_interval_secs is not None
+                 and time.monotonic() - self._manifest_loaded_at
+                 > self.polling_interval_secs)
+        if self._manifest is None or stale:
             try:
                 self._manifest = json.loads(self.storage.get_all(MANIFEST_PATH))
             except StorageError:
-                self._manifest = {}
+                if self._manifest is None:
+                    self._manifest = {}
+            self._manifest_loaded_at = time.monotonic()
         return self._manifest
 
     def _save_manifest(self) -> None:
@@ -96,17 +110,63 @@ class FileBackedMetastore(Metastore):
     # --- state io ----------------------------------------------------------
     def _load_state(self, index_id: str) -> _IndexState:
         state = self._states.get(index_id)
-        if state is not None and not state.discarded:
+        fresh = (state is not None and not state.discarded
+                 and (self.polling_interval_secs is None
+                      or time.monotonic() - state.loaded_at
+                      < self.polling_interval_secs))
+        if fresh:
             return state
         try:
             raw = self.storage.get_all(_state_path(index_id))
         except StorageError:
+            if state is not None and not state.discarded:
+                # Distinguish "another node deleted the index" from a
+                # transient storage blip: a fresh manifest read that no
+                # longer lists the index means deleted — drop the cache.
+                try:
+                    manifest = json.loads(self.storage.get_all(MANIFEST_PATH))
+                except StorageError:
+                    return state  # storage blip: keep serving the cache
+                self._manifest = manifest
+                self._manifest_loaded_at = time.monotonic()
+                if index_id in manifest:
+                    return state  # index exists, state read blipped
+                self._states.pop(index_id, None)
             raise MetastoreError(f"index {index_id!r} not found", kind="not_found")
         state = _IndexState.from_dict(json.loads(raw))
         self._states[index_id] = state
         return state
 
     def _save_state(self, state: _IndexState) -> None:
+        # Optimistic lost-update detection (reference keeps a version in the
+        # per-index file for the same purpose): if the stored version moved
+        # past the one we loaded, or the stored file belongs to a different
+        # incarnation (deleted + recreated under the same id), another
+        # writer raced us — fail the write instead of silently overwriting
+        # their splits/checkpoints. Not a true CAS (storage has no
+        # conditional put) but catches the common race; background writers
+        # are additionally partitioned per index by rendezvous ownership
+        # (serve/node.py). Skipped in explicit single-writer mode
+        # (polling_interval_secs=None) to keep mutations one storage op.
+        if self.polling_interval_secs is not None:
+            index_id = state.metadata.index_id
+            try:
+                stored = json.loads(self.storage.get_all(_state_path(index_id)))
+                stored_version = stored.get("version", 0)
+                stored_uid = stored.get("metadata", {}).get("index_uid")
+            except StorageError:
+                stored_version, stored_uid = 0, None  # first write
+            conflict = (stored_version > state.version
+                        or (stored_uid is not None
+                            and stored_uid != state.metadata.index_uid))
+            if conflict:
+                self._states.pop(index_id, None)  # force reload
+                raise MetastoreError(
+                    f"concurrent modification of index {index_id!r} detected "
+                    f"(stored version {stored_version}, uid {stored_uid!r} vs "
+                    f"loaded {state.version}, {state.metadata.index_uid!r}); "
+                    f"retry", kind="failed_precondition")
+        state.loaded_at = time.monotonic()  # our write IS the latest state
         state.version += 1
         self.storage.put(_state_path(state.metadata.index_id),
                          json.dumps(state.to_dict()).encode())

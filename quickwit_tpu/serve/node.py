@@ -147,7 +147,8 @@ class Node:
         self.data_dir = data_dir
         self.ingester = Ingester(os.path.join(data_dir, "wal"),
                                  fsync=config.wal_fsync)
-        self.ingest_router = IngestRouter(self.ingester)
+        self.ingest_router = IngestRouter(self.ingester,
+                                          shard_prefix=config.node_id)
         from ..control_plane.scheduler import IndexingScheduler
         self.indexing_scheduler = IndexingScheduler()
         from ..search.scroll import ScrollStore
@@ -351,6 +352,129 @@ class Node:
             "errors": [],
             "aggregations": None,
         }
+
+    # ------------------------------------------------------------------
+    # background service loops (role of the reference's long-running actors:
+    # ingest pipelines, MergePlanner, janitor actors, chitchat heartbeats).
+    # Supervision-lite: each loop catches and logs failures and keeps going.
+    def start_background_services(self,
+                                  ingest_interval_secs: float = 2.0,
+                                  merge_interval_secs: float = 30.0,
+                                  janitor_interval_secs: float = 300.0,
+                                  heartbeat_interval_secs: float = 2.0) -> None:
+        if getattr(self, "_bg_stop", None) is not None:
+            return
+        stop = self._bg_stop = threading.Event()
+
+        def loop(name: str, interval: float, tick) -> None:
+            # `stop` is captured (not re-read from self): stop_background_
+            # services may null the attribute while a tick is in flight.
+            while not stop.wait(interval):
+                try:
+                    tick()
+                except Exception:  # noqa: BLE001 - supervised loop
+                    logger.exception("background %s pass failed", name)
+
+        def owns_index(index_uid: str) -> bool:
+            # Deterministic single-worker election per index: every node
+            # computes the same owner from the same alive set (rendezvous
+            # hash, stateless — unlike the scheduler's affinity memory),
+            # so concurrent cli-run indexer nodes sharing one file-backed
+            # metastore don't race merge writes on the same index.
+            from ..common.rendezvous import sort_by_rendezvous_hash
+            indexers = self.cluster.nodes_with_role("indexer")
+            if not indexers:
+                return False
+            return sort_by_rendezvous_hash(index_uid, indexers)[0] \
+                == self.config.node_id
+
+        def ingest_tick() -> None:
+            # Drains the LOCAL WAL — no ownership gate: only this node can
+            # drain its own shards (node-prefixed ids keep checkpoint
+            # partitions collision-free across nodes; a raced metastore
+            # publish fails the version check and retries next tick).
+            if "indexer" not in self.config.roles:
+                return
+            for metadata in self.metastore.list_indexes():
+                shards = self.ingester.list_shards(metadata.index_uid)
+                if any(s.log.next_position > s.publish_position for s in shards):
+                    self.run_ingest_pass(metadata.index_id)
+
+        def merge_tick() -> None:
+            if "indexer" not in self.config.roles:
+                return
+            for metadata in self.metastore.list_indexes():
+                if owns_index(metadata.index_uid):
+                    self.run_merges(metadata.index_id)
+
+        def janitor_tick() -> None:
+            if "janitor" in self.config.roles:
+                self.run_janitor()
+
+        heartbeat_clients: dict[str, object] = {}
+
+        def heartbeat_one(endpoint: str, payload: dict) -> None:
+            # Runs in a bare worker thread (outside loop()'s supervision):
+            # must never let an exception escape, or a malformed peer
+            # response kills the worker with a traceback every tick.
+            try:
+                from ..common.tower import CircuitOpen
+                from ..cluster.membership import substitute_wildcard_host
+                from .http_client import HttpSearchClient, HttpTransportError
+                client = heartbeat_clients.get(endpoint)
+                if client is None:
+                    client = heartbeat_clients[endpoint] = HttpSearchClient(
+                        endpoint, timeout_secs=2.0)
+                try:
+                    info = client.heartbeat(payload)
+                except (HttpTransportError, CircuitOpen) as exc:
+                    # CircuitOpen: the cached client's breaker backs off from
+                    # a dead peer; half-open probes re-admit it on recovery.
+                    logger.debug("heartbeat to %s failed: %s", endpoint, exc)
+                    return
+                self.cluster.upsert_heartbeat(ClusterMember(
+                    node_id=info["node_id"], roles=tuple(info["roles"]),
+                    rest_endpoint=substitute_wildcard_host(
+                        info.get("rest_endpoint", endpoint),
+                        endpoint.rpartition(":")[0])))
+            except Exception:  # noqa: BLE001 - supervised worker
+                logger.exception("heartbeat to %s: bad peer response", endpoint)
+
+        def heartbeat_tick() -> None:
+            payload = {"node_id": self.config.node_id,
+                       "roles": list(self.config.roles),
+                       "rest_endpoint":
+                           f"{self.config.rest_host}:{self.config.rest_port}"}
+            peers = set(self.config.peers)
+            peers.update(m.rest_endpoint for m in self.cluster.members()
+                         if m.node_id != self.config.node_id and m.rest_endpoint)
+            # Fan out concurrently: N slow/unreachable peers must not stretch
+            # the heartbeat period past the liveness window for healthy ones.
+            workers = [threading.Thread(target=heartbeat_one,
+                                        args=(endpoint, payload), daemon=True)
+                       for endpoint in peers]
+            for worker in workers:
+                worker.start()
+            for worker in workers:
+                worker.join(timeout=4.0)
+
+        self._bg_threads = []
+        for name, interval, tick in (
+                ("ingest", ingest_interval_secs, ingest_tick),
+                ("merge", merge_interval_secs, merge_tick),
+                ("janitor", janitor_interval_secs, janitor_tick),
+                ("heartbeat", heartbeat_interval_secs, heartbeat_tick)):
+            thread = threading.Thread(target=loop, args=(name, interval, tick),
+                                       name=f"bg-{name}", daemon=True)
+            thread.start()
+            self._bg_threads.append(thread)
+        logger.info("background services started (%s)", self.config.node_id)
+
+    def stop_background_services(self) -> None:
+        stop = getattr(self, "_bg_stop", None)
+        if stop is not None:
+            stop.set()
+            self._bg_stop = None
 
     # ------------------------------------------------------------------
     def run_janitor(self) -> dict[str, int]:

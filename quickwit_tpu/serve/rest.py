@@ -137,7 +137,7 @@ class RestServer:
     # ------------------------------------------------------------------
     # route implementations
     def route(self, method: str, path: str, params: dict[str, Any],
-              body: bytes) -> tuple[int, Any]:
+              body: bytes, client_host: str = "") -> tuple[int, Any]:
         node = self.node
         if path == "/health/livez":
             return 200, True
@@ -168,11 +168,12 @@ class RestServer:
             return 200, node.search_service.fetch_docs(request)
         if path == "/internal/heartbeat" and method == "POST":
             payload = json.loads(body)
-            from ..cluster.membership import ClusterMember
-            node.cluster.join(ClusterMember(
+            from ..cluster.membership import (ClusterMember,
+                                              substitute_wildcard_host)
+            node.cluster.upsert_heartbeat(ClusterMember(
                 node_id=payload["node_id"], roles=tuple(payload["roles"]),
-                rest_endpoint=payload.get("rest_endpoint", "")))
-            node.cluster.record_heartbeat(payload["node_id"])
+                rest_endpoint=substitute_wildcard_host(
+                    payload.get("rest_endpoint", ""), client_host)))
             return 200, {"node_id": node.config.node_id,
                          "roles": list(node.config.roles),
                          "rest_endpoint": f"{self.host}:{self.port}"}
@@ -512,7 +513,8 @@ def _make_handler(server: RestServer):
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
             try:
-                status, payload = server.route(method, parsed.path, params, body)
+                status, payload = server.route(method, parsed.path, params, body,
+                                               client_host=self.client_address[0])
             except ApiError as exc:
                 status, payload = exc.status, {"message": str(exc)}
             except (QueryParseError, EsDslParseError, AggParseError,

@@ -184,3 +184,86 @@ def test_checkpoint_backwards_delta_rejected():
     with pytest.raises(IncompatibleCheckpointDelta):
         cp.try_apply_delta(CheckpointDelta.from_range(
             "p", offset_position(10), offset_position(5)))
+
+
+def test_polling_refresh_sees_other_writers():
+    """A second metastore instance over the same storage sees another
+    writer's changes after the polling interval (cross-node visibility)."""
+    storage = RamStorage(Uri.parse("ram:///poll-test"))
+    writer = FileBackedMetastore(storage, polling_interval_secs=None)
+    reader = FileBackedMetastore(storage, polling_interval_secs=0.05)
+    writer.create_index(make_index_metadata())
+    import time as _t
+    _t.sleep(0.06)
+    assert reader.index_metadata("test-index").index_uid == "test-index:01"
+    # reader caches; writer publishes a split; reader sees it after TTL
+    writer.stage_splits("test-index:01", [split_md("p1")])
+    writer.publish_splits("test-index:01", ["p1"])
+    _t.sleep(0.06)
+    splits = reader.list_splits(ListSplitsQuery(index_uids=["test-index:01"]))
+    assert [s.metadata.split_id for s in splits] == ["p1"]
+
+
+def test_polling_refresh_sees_deletion():
+    """Another node deleting an index must become visible after the TTL —
+    a missing state file with the index absent from the manifest is a
+    deletion, not a storage blip to paper over with the cache."""
+    storage = RamStorage(Uri.parse("ram:///poll-del-test"))
+    writer = FileBackedMetastore(storage, polling_interval_secs=None)
+    reader = FileBackedMetastore(storage, polling_interval_secs=0.05)
+    writer.create_index(make_index_metadata())
+    assert reader.index_metadata("test-index").index_uid == "test-index:01"
+    writer.delete_index("test-index:01")
+    import time as _t
+    _t.sleep(0.06)
+    with pytest.raises(MetastoreError) as exc:
+        reader.index_metadata("test-index")
+    assert exc.value.kind == "not_found"
+    assert reader.list_indexes() == []
+
+
+def test_concurrent_writer_detected():
+    """Two metastore instances racing writes on one index: the slower
+    writer's save must fail instead of silently erasing the winner's
+    splits (optimistic version check)."""
+    storage = RamStorage(Uri.parse("ram:///race-test"))
+    # long TTL: caches stay warm (forming the race) but multi-writer
+    # detection is enabled (None would declare single-writer and skip it)
+    a = FileBackedMetastore(storage, polling_interval_secs=1000)
+    b = FileBackedMetastore(storage, polling_interval_secs=1000)
+    a.create_index(make_index_metadata())
+    b.index_metadata("test-index")  # b loads the same version
+    a.stage_splits("test-index:01", [split_md("sa")])  # a writes first
+    with pytest.raises(MetastoreError) as exc:
+        b.stage_splits("test-index:01", [split_md("sb")])
+    assert exc.value.kind == "failed_precondition"
+    # b's cache was invalidated: a retry sees a's write and succeeds
+    b.stage_splits("test-index:01", [split_md("sb")])
+    splits = b.list_splits(ListSplitsQuery(index_uids=["test-index:01"]))
+    assert {s.metadata.split_id for s in splits} == {"sa", "sb"}
+
+
+def test_stale_incarnation_write_rejected():
+    """A cached image of a deleted-and-recreated index must not clobber the
+    new incarnation's state file (version alone can't catch this: the new
+    file restarts at version 1, below the stale cache's count)."""
+    storage = RamStorage(Uri.parse("ram:///incarnation-test"))
+    a = FileBackedMetastore(storage, polling_interval_secs=1000)
+    b = FileBackedMetastore(storage, polling_interval_secs=1000)
+    a.create_index(make_index_metadata())
+    # b warms its cache on incarnation :01 and bumps its version past 1
+    b.index_metadata("test-index")
+    b.stage_splits("test-index:01", [split_md("s1")])
+    b.publish_splits("test-index:01", ["s1"])
+    # a (fresh view) deletes and recreates under a new incarnation
+    a._states.pop("test-index", None)
+    a._manifest = None
+    a.delete_index("test-index:01")
+    metadata = make_index_metadata()
+    metadata.index_uid = "test-index:02"
+    a.create_index(metadata)
+    # b's stale-incarnation write must fail, not erase incarnation :02
+    with pytest.raises(MetastoreError) as exc:
+        b.stage_splits("test-index:01", [split_md("s2")])
+    assert exc.value.kind in ("failed_precondition", "not_found")
+    assert a.index_metadata("test-index").index_uid == "test-index:02"
