@@ -97,12 +97,33 @@ def percentile_sketch(values: jnp.ndarray, present: jnp.ndarray,
     t-digest accuracy for tail quantiles.
     """
     m = mask & present.astype(jnp.bool_)
-    v = jnp.maximum(values.astype(jnp.float64), 1.0)
-    bucket = jnp.floor(jnp.log2(v) * PCTL_BUCKETS_PER_OCTAVE).astype(jnp.int32)
-    bucket = jnp.clip(bucket, 0, PCTL_NUM_BUCKETS - 1)
-    bucket = jnp.where(m, bucket, jnp.int32(PCTL_NUM_BUCKETS))
+    bucket = jnp.where(m, _pctl_bucket(values), jnp.int32(PCTL_NUM_BUCKETS))
     counts = jnp.zeros(PCTL_NUM_BUCKETS, dtype=jnp.int32)
     return counts.at[bucket].add(1, mode="drop")
+
+
+def _pctl_bucket(values: jnp.ndarray) -> jnp.ndarray:
+    """Value → log-linear sketch bucket index (shared by the global and
+    per-bucket sketch builders so their resolution can never drift)."""
+    v = jnp.maximum(values.astype(jnp.float64), 1.0)
+    return jnp.clip(
+        jnp.floor(jnp.log2(v) * PCTL_BUCKETS_PER_OCTAVE).astype(jnp.int32),
+        0, PCTL_NUM_BUCKETS - 1)
+
+
+def bucket_percentile_sketch(idx: jnp.ndarray, values: jnp.ndarray,
+                             num_buckets: int) -> jnp.ndarray:
+    """Per-bucket HDR sketches [num_buckets, PCTL_NUM_BUCKETS] int32.
+
+    `idx` int32 with out-of-range sentinel (num_buckets) for dropped docs.
+    One scatter-add into the flattened [nb * PCTL] space (large enough that
+    XLA's scatter path beats compare-reduce here)."""
+    sb = _pctl_bucket(values)
+    flat = jnp.where(idx < num_buckets, idx * PCTL_NUM_BUCKETS + sb,
+                     jnp.int32(num_buckets * PCTL_NUM_BUCKETS))
+    counts = jnp.zeros(num_buckets * PCTL_NUM_BUCKETS, dtype=jnp.int32)
+    return counts.at[flat].add(1, mode="drop").reshape(
+        num_buckets, PCTL_NUM_BUCKETS)
 
 
 def sketch_quantiles(counts: np.ndarray, quantiles: list[float]) -> list[float]:

@@ -276,13 +276,18 @@ def _batch_executor(batch: SplitBatch, k: int, mesh: Optional[Mesh]):
         # batches are single-sort-key only (service routes 2-key requests to
         # the per-split path), so sort_vals2 is always None here
         sort_vals, _sort_vals2, doc_ids, hit_scores, counts, agg_out = results
+        total = jnp.sum(counts)
+        if k == 0:  # count/agg-only: no cross-split hit merge
+            empty_i = jnp.zeros((0,), jnp.int32)
+            return (jnp.zeros((0,), sort_vals.dtype), empty_i, empty_i,
+                    jnp.zeros((0,), hit_scores.dtype), total,
+                    _merge_agg_stack(agg_out))
         # flatten [n, k] → [n*k]; split-major order keeps the
         # (key desc, split asc, doc asc) tie-break of the collector
         top_vals, pos = jax.lax.top_k(sort_vals.reshape(-1), k)
         split_idx = (pos // k).astype(jnp.int32)
         flat_ids = doc_ids.reshape(-1)[pos]
         flat_scores = hit_scores.reshape(-1)[pos]
-        total = jnp.sum(counts)
         return top_vals, split_idx, flat_ids, flat_scores, total, \
             _merge_agg_stack(agg_out)
 
@@ -296,8 +301,9 @@ def execute_batch(batch: SplitBatch, request: SearchRequest,
                   mesh: Optional[Mesh] = None) -> LeafSearchResponse:
     """Run the batch (optionally mesh-sharded) and emit one merged
     LeafSearchResponse covering all splits."""
-    k = max(request.start_offset + request.max_hits, 1)
-    k = min(k, batch.num_docs_padded)
+    # k=0 (count/agg-only): per-split executors skip keying/top-k and the
+    # batch merge skips the cross-split top_k
+    k = min(request.start_offset + request.max_hits, batch.num_docs_padded)
     # Mesh is hashable; id() would go stale if a dead mesh's address is reused
     key = (batch.template.signature(k), batch.n_splits,
            batch.num_docs_padded, mesh)

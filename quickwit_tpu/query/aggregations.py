@@ -33,12 +33,15 @@ def parse_interval_micros(text: str) -> int:
     return int(float(m.group(1)) * _INTERVAL_MICROS[m.group(2)])
 
 
+DEFAULT_PERCENTS = (1, 5, 25, 50, 75, 95, 99)
+
+
 @dataclass(frozen=True)
 class MetricAgg:
     name: str
     kind: str          # avg | min | max | sum | stats | value_count | percentiles
     field: str
-    percents: tuple[float, ...] = (1, 5, 25, 50, 75, 95, 99)
+    percents: tuple[float, ...] = DEFAULT_PERCENTS
 
 
 @dataclass(frozen=True)
@@ -82,7 +85,7 @@ _METRIC_KINDS = ("avg", "min", "max", "sum", "stats", "value_count", "percentile
 def _parse_metric(name: str, kind: str, body: dict[str, Any]) -> MetricAgg:
     if "field" not in body:
         raise AggParseError(f"aggregation {name!r}: metric {kind} requires a field")
-    percents = tuple(body.get("percents", (1, 5, 25, 50, 75, 95, 99)))
+    percents = tuple(body.get("percents", DEFAULT_PERCENTS))
     return MetricAgg(name=name, kind=kind, field=body["field"], percents=percents)
 
 

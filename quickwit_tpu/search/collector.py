@@ -17,7 +17,8 @@ from typing import Any, Optional
 
 import numpy as np
 
-from ..ops.aggs import sketch_quantiles
+from ..ops.aggs import PCTL_NUM_BUCKETS, sketch_quantiles
+from ..query.aggregations import DEFAULT_PERCENTS
 from .models import LeafSearchResponse, PartialHit
 
 
@@ -137,9 +138,9 @@ def _carry_sub_info(copy: dict, state: dict) -> None:
     copy.pop("sub", None)
 
 
-def _new_metric_acc(kind: str) -> dict[str, Any]:
+def _new_metric_acc(kind: str, percents=None) -> dict[str, Any]:
     return {"sum": 0.0, "count": 0, "min": np.inf, "max": -np.inf, "sum_sq": 0.0,
-            "kind": kind}
+            "kind": kind, "sketch": None, "percents": percents}
 
 
 def _acc_metric(acc: dict[str, Any], arrays: dict[str, np.ndarray], i: int) -> None:
@@ -153,6 +154,10 @@ def _acc_metric(acc: dict[str, Any], arrays: dict[str, np.ndarray], i: int) -> N
         acc["max"] = max(acc["max"], float(arrays["max"][i]))
     if "sum_sq" in arrays:
         acc["sum_sq"] += float(arrays["sum_sq"][i])
+    if "sketch" in arrays:
+        row = np.asarray(arrays["sketch"][i])
+        # non-inplace add: accs are shallow-copied by _copy_bucket_map
+        acc["sketch"] = row if acc["sketch"] is None else acc["sketch"] + row
 
 
 def _copy_bucket_map(bucket_map: dict) -> dict:
@@ -180,6 +185,7 @@ def _attach_sub_map(bucket: dict, state: dict, parent_index: int) -> None:
     base = parent_index * nb2
     counts = sub["counts"]
     metric_kinds = sub.get("metric_kinds", {})
+    metric_percents = sub.get("metric_percents", {})
     sub_map: dict = {}
     for j in range(nb2):
         flat = base + j
@@ -190,7 +196,8 @@ def _attach_sub_map(bucket: dict, state: dict, parent_index: int) -> None:
             continue
         child = {"doc_count": int(counts[flat]), "metrics": {}}
         for mname, arrays in sub.get("metrics", {}).items():
-            acc = _new_metric_acc(metric_kinds.get(mname, "avg"))
+            acc = _new_metric_acc(metric_kinds.get(mname, "avg"),
+                                  metric_percents.get(mname))
             _acc_metric(acc, arrays, flat)
             child["metrics"][mname] = acc
         sub_map[key] = child
@@ -206,11 +213,13 @@ def _histogram_to_map(state: dict[str, Any]) -> dict[float, dict[str, Any]]:
     nonzero = np.nonzero(counts)[0] if not state.get("extended_bounds") \
         else np.arange(len(counts))
     metric_kinds = state.get("metric_kinds", {})
+    metric_percents = state.get("metric_percents", {})
     for i in nonzero:
         key = origin + int(i) * interval
         bucket = {"doc_count": int(counts[i]), "metrics": {}}
         for mname, arrays in state.get("metrics", {}).items():
-            acc = _new_metric_acc(metric_kinds.get(mname, "avg"))
+            acc = _new_metric_acc(metric_kinds.get(mname, "avg"),
+                                  metric_percents.get(mname))
             _acc_metric(acc, arrays, int(i))
             bucket["metrics"][mname] = acc
         _attach_sub_map(bucket, state, int(i))
@@ -224,13 +233,15 @@ def _terms_to_map(state: dict[str, Any]) -> dict[Any, dict[str, Any]]:
     counts = state["counts"]
     keys = state["keys"]
     metric_kinds = state.get("metric_kinds", {})
+    metric_percents = state.get("metric_percents", {})
     out: dict[Any, dict[str, Any]] = {}
     for i in np.nonzero(counts)[0]:
         if i >= len(keys):
             continue
         bucket = {"doc_count": int(counts[i]), "metrics": {}}
         for mname, arrays in state.get("metrics", {}).items():
-            acc = _new_metric_acc(metric_kinds.get(mname, "avg"))
+            acc = _new_metric_acc(metric_kinds.get(mname, "avg"),
+                                  metric_percents.get(mname))
             _acc_metric(acc, arrays, int(i))
             bucket["metrics"][mname] = acc
         _attach_sub_map(bucket, state, int(i))
@@ -255,6 +266,10 @@ def _merge_bucket_maps(bucket_map: dict, incoming: dict) -> None:
                 cacc["min"] = min(cacc["min"], acc["min"])
                 cacc["max"] = max(cacc["max"], acc["max"])
                 cacc["sum_sq"] += acc["sum_sq"]
+                if acc.get("sketch") is not None:
+                    cacc["sketch"] = acc["sketch"] \
+                        if cacc.get("sketch") is None \
+                        else cacc["sketch"] + acc["sketch"]
         if "sub_map" in bucket:
             if "sub_map" not in cur:
                 cur["sub_map"] = bucket["sub_map"]
@@ -295,7 +310,21 @@ def _finalize_metric(acc: dict[str, Any]) -> dict[str, Any]:
             "max": acc["max"] if np.isfinite(acc["max"]) else None,
             "avg": (acc["sum"] / count) if count else None,
         }
+    if kind == "percentiles":
+        percents = acc.get("percents") or DEFAULT_PERCENTS
+        sketch = acc.get("sketch")
+        if sketch is None:
+            sketch = np.zeros(PCTL_NUM_BUCKETS, dtype=np.int32)
+        return {"values": _quantile_values(sketch, percents)}
     raise ValueError(f"unknown metric kind {kind}")
+
+
+def _quantile_values(sketch, percents) -> dict[str, Optional[float]]:
+    """ES-shaped percentile values; empty sketches yield null (NaN is not
+    valid JSON and ES emits null for empty percentiles)."""
+    quantiles = sketch_quantiles(sketch, [p / 100.0 for p in percents])
+    return {f"{p:g}": (None if np.isnan(v) else v)
+            for p, v in zip(percents, quantiles)}
 
 
 def _finalize_bucket_map(bucket_map: dict, info: dict[str, Any],
@@ -364,10 +393,8 @@ def finalize_aggregations(agg_states: dict[str, Any]) -> dict[str, Any]:
             out[name] = _finalize_bucket_map(
                 state["bucket_map"], state, sub_info=state.get("sub_info"))
         elif kind == "percentiles":
-            quantiles = sketch_quantiles(state["sketch"],
-                                         [p / 100.0 for p in state["percents"]])
-            out[name] = {"values": {f"{p:g}": v for p, v in
-                                    zip(state["percents"], quantiles)}}
+            out[name] = {"values": _quantile_values(state["sketch"],
+                                                    state["percents"])}
         else:
             c, s, s2, mn, mx = state["state"]
             acc = {"kind": kind, "count": int(c), "sum": float(s),

@@ -70,6 +70,10 @@ def _bucket_metrics(metric_slots, arrays, idx, m, nb):
         midx = jnp.where(mm, idx, jnp.int32(nb))
         state: dict[str, Any] = {}
         need = met.kind
+        if need == "percentiles":
+            state["sketch"] = agg_ops.bucket_percentile_sketch(midx, mv, nb)
+            metrics[met.name] = state
+            continue
         if need in ("sum", "avg", "stats"):
             state["sum"] = agg_ops.bucket_sum(midx, mv, nb)
         if need in ("avg", "stats", "value_count"):
@@ -194,6 +198,12 @@ def _build_posting_space(plan: LoweredPlan, k: int) -> Callable:
         valid = ids < num_docs
         count = jnp.sum(valid.astype(jnp.int32))
         safe_ids = jnp.clip(ids, 0, padded - 1)
+        if k == 0:  # count/agg-only: no scoring, no top-k
+            gathered = _GatherView(arrays, safe_ids)
+            agg_out = _eval_aggs(aggs, gathered, scalars, valid)
+            return (jnp.zeros((0,), jnp.float64), None,
+                    jnp.zeros((0,), jnp.int32), jnp.zeros((0,), jnp.float32),
+                    count, tuple(agg_out))
         from ..ops.pallas import fused_score_topk, pallas_available
         if (sort.by == "score" and sort.by2 == "none" and root.scoring
                 and pallas_available() and k <= 64):
@@ -337,6 +347,12 @@ def _build(plan: LoweredPlan, k: int) -> Callable:
         mask = mask & mask_ops.valid_docs_mask(num_docs, padded)
         if scores is None:
             scores = jnp.zeros(padded, dtype=jnp.float32)
+        if k == 0:  # count/agg-only: no keying, no top-k
+            count = jnp.sum(mask.astype(jnp.int32))
+            agg_out = _eval_aggs(aggs, arrays, scalars, mask)
+            return (jnp.zeros((0,), jnp.float64), None,
+                    jnp.zeros((0,), jnp.int32), jnp.zeros((0,), jnp.float32),
+                    count, tuple(agg_out))
         doc_key = jnp.arange(padded, dtype=jnp.int32)
         keyed = _keyed_for(sort.by, sort.descending, sort.values_slot,
                            sort.present_slot, arrays, mask, scores, doc_key)
@@ -377,7 +393,7 @@ def get_executor(plan: LoweredPlan, k: int) -> Callable:
 def execute_plan(plan: LoweredPlan, k: int,
                  device_arrays: list[jax.Array]) -> dict[str, Any]:
     """Run the plan; returns host-side numpy results."""
-    k = max(1, min(k, plan.num_docs_padded))
+    k = max(0, min(k, plan.num_docs_padded))
     executor = get_executor(plan, k)
     scalars = tuple(jnp.asarray(s) for s in plan.scalars)
     out = executor(tuple(device_arrays), scalars, jnp.int32(plan.num_docs))

@@ -81,7 +81,8 @@ def leaf_search_single_split(
     sort_field = sort.field if sort else "_score"
     sort_order = sort.order if sort else "desc"
     sort2 = request.sort_fields[1] if len(request.sort_fields) > 1 else None
-    k = max(request.start_offset + request.max_hits, 1)
+    # k=0 (count/agg-only): the executor skips keying and top-k entirely
+    k = request.start_offset + request.max_hits
 
     plan = lower_request(
         request.query_ast, doc_mapper, reader, agg_specs,
@@ -186,6 +187,8 @@ def _intermediate_aggs(plan, agg_results: list) -> dict[str, Any]:
                 "metrics": {name: {k: np.asarray(v) for k, v in m.items()}
                             for name, m in res["metrics"].items()},
                 "metric_kinds": {m.name: m.kind for m in a.metrics},
+                "metric_percents": {m.name: list(m.percents) for m in a.metrics
+                                    if m.kind == "percentiles"},
                 **a.host_info,
             }
             if a.sub is not None and "sub" in res:
@@ -196,6 +199,9 @@ def _intermediate_aggs(plan, agg_results: list) -> dict[str, Any]:
                     "metrics": {name: {k: np.asarray(v) for k, v in m.items()}
                                 for name, m in res["sub"]["metrics"].items()},
                     "metric_kinds": {m.name: m.kind for m in a.sub.metrics},
+                    "metric_percents": {m.name: list(m.percents)
+                                        for m in a.sub.metrics
+                                        if m.kind == "percentiles"},
                     **a.sub.host_info,
                 }
             out[a.name] = state

@@ -58,6 +58,16 @@ class SearchRequest:
 
     def __post_init__(self) -> None:
         self.sort_fields = normalize_sort_fields(tuple(self.sort_fields))
+        # Count-only degradation (role of the reference's count-optimized
+        # leaf path, leaf.rs QuickwitCollector w/ max_hits=0): no hits are
+        # returned, so the sort is irrelevant — normalize to doc order.
+        # Skips BM25 scoring and sort-column warmup in the executor, and
+        # lets count-only requests with different sorts share cache entries.
+        # search_after markers are keyed to the original sort, so requests
+        # carrying one keep their sort spec (counts are unaffected either way).
+        if (self.max_hits == 0 and self.start_offset == 0
+                and not self.search_after):
+            self.sort_fields = (SortField("_doc", "asc"),)
 
     def to_dict(self) -> dict[str, Any]:
         return {

@@ -470,3 +470,85 @@ def test_nested_terms_date_histogram_multi_split():
         child = {int(c["key"] * 1000): c["doc_count"]
                  for c in got[sev]["ot"]["buckets"]}
         assert child == hist, sev
+
+
+def test_count_only_degradation(reader):
+    """max_hits=0 (count/agg-only): executor must skip scoring and top-k
+    (k=0 program) while counts and aggregations stay exact; the sort spec
+    is normalized away so differently-sorted count queries share plans."""
+    from quickwit_tpu.query import parse_query_string
+    from quickwit_tpu.search.cache import canonical_request_key
+    from quickwit_tpu.search.models import SortField
+
+    query = parse_query_string("alpha", ["body"])
+    aggs = {"sev": {"terms": {"field": "severity_text"}}}
+    r1 = SearchRequest(index_ids=["test"], query_ast=query, max_hits=0,
+                       aggs=aggs, sort_fields=[SortField("timestamp", "desc")])
+    r2 = SearchRequest(index_ids=["test"], query_ast=query, max_hits=0,
+                       aggs=aggs, sort_fields=[SortField("_score", "desc")])
+    # normalization: sort is irrelevant without hits -> same canonical key
+    assert canonical_request_key("s", r1) == canonical_request_key("s", r2)
+    assert r1.sort_fields[0].field == "_doc"
+
+    response = leaf_search_single_split(r1, MAPPER, reader, "split-x")
+    assert response.partial_hits == []
+    ref = search(reader, query_ast=query, max_hits=10, aggs=aggs)
+    assert response.num_hits == ref.num_hits > 0
+    assert response.intermediate_aggs is not None
+
+
+def test_percentiles_under_bucket_aggs(reader):
+    """percentiles as a sub-aggregation of terms: per-bucket HDR sketches,
+    mergeable across leaves, ES-shaped {"values": {...}} output within the
+    sketch's ~4.4% relative error."""
+    from quickwit_tpu.search.collector import (IncrementalCollector,
+                                               finalize_aggregations)
+
+    req = SearchRequest(
+        index_ids=["test"], query_ast=MatchAll(), max_hits=0,
+        aggs={"sev": {"terms": {"field": "severity_text"},
+                      "aggs": {"lat_p": {"percentiles": {
+                          "field": "latency", "percents": [50, 95]}}}}})
+    response = leaf_search_single_split(req, MAPPER, reader, "s")
+    collector = IncrementalCollector(0)
+    collector.add_leaf_response(response)
+    collector.add_leaf_response(response)  # merge path: quantiles unchanged
+    out = finalize_aggregations(collector.aggregation_states())
+    buckets = out["sev"]["buckets"]
+    assert len(buckets) == 4
+    for b in buckets:
+        vals = sorted(d["latency"] for d in DOCS
+                      if d["severity_text"] == b["key"])
+        true_p50 = vals[int(0.5 * len(vals))]
+        est = b["lat_p"]["values"]["50"]
+        assert abs(est - true_p50) / true_p50 < 0.06
+        assert "95" in b["lat_p"]["values"]
+
+
+def test_count_only_keeps_sort_with_search_after(reader):
+    """Regression: count-only normalization must not rewrite the sort when a
+    search_after marker is present — the marker's arity is keyed to the
+    original sort spec (2-key marker vs _doc sort crashed the parse)."""
+    from quickwit_tpu.search.models import SortField
+
+    req = SearchRequest(
+        index_ids=["test"], query_ast=MatchAll(), max_hits=0,
+        sort_fields=[SortField("timestamp", "desc"),
+                     SortField("tenant_id", "desc")],
+        search_after=[1_600_000_000 * 1_000_000, 3, "split-0", 17])
+    assert [s.field for s in req.sort_fields] == ["timestamp", "tenant_id"]
+    response = leaf_search_single_split(req, MAPPER, reader, "split-0")
+    assert response.partial_hits == []
+    assert response.num_hits == len(DOCS)
+
+
+def test_percentiles_empty_bucket_yields_null(reader):
+    """Regression: a bucket with no values for the percentiles field emits
+    JSON null, not NaN (NaN is invalid strict JSON; ES emits null)."""
+    import json as _json
+    from quickwit_tpu.search.collector import _finalize_metric, _new_metric_acc
+
+    acc = _new_metric_acc("percentiles", percents=(50, 95))
+    out = _finalize_metric(acc)
+    assert out["values"]["50"] is None and out["values"]["95"] is None
+    _json.dumps(out)  # must serialize under strict JSON

@@ -108,6 +108,10 @@ REQUESTS = [
                                                "fixed_interval": "1h"}},
               "lat": {"stats": {"field": "latency"}}},
     ),
+    # count/agg-only: k=0 batch path skips the cross-split hit merge
+    SearchRequest(index_ids=["x"], query_ast=FullText("body", "beta", "or"),
+                  max_hits=0,
+                  aggs={"sev": {"terms": {"field": "severity_text"}}}),
 ]
 
 
