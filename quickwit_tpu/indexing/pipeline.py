@@ -26,6 +26,7 @@ from ..models.doc_mapper import DocMapper, DocParsingError
 from ..models.split_metadata import SplitMetadata, new_split_id
 from ..storage.base import Storage
 from .sources import Source, SourceBatch
+from .transform import TransformRuntimeError
 
 logger = logging.getLogger(__name__)
 
@@ -57,12 +58,14 @@ class IndexingPipeline:
     """One (index, source) pipeline (reference `indexing_pipeline.rs:80`)."""
 
     def __init__(self, params: PipelineParams, doc_mapper: DocMapper,
-                 source: Source, metastore: Metastore, split_storage: Storage):
+                 source: Source, metastore: Metastore, split_storage: Storage,
+                 transform=None):
         self.params = params
         self.doc_mapper = doc_mapper
         self.source = source
         self.metastore = metastore
         self.split_storage = split_storage
+        self.transform = transform  # compiled Transform (VRL analogue) or None
         self.counters = PipelineCounters()
         self._writer: Optional[SplitWriter] = None
         self._pending_delta = CheckpointDelta()
@@ -91,9 +94,13 @@ class IndexingPipeline:
             self._writer = SplitWriter(self.doc_mapper)
         for doc in batch.docs:
             try:
+                if self.transform is not None:
+                    doc = self.transform.apply(doc, copy=False)
+                    if doc is None:  # drop()ped by the script (filtering)
+                        continue
                 self._writer.add_typed_doc(self.doc_mapper.doc_from_json(doc))
                 self.counters.num_docs_processed += 1
-            except DocParsingError as exc:
+            except (DocParsingError, TransformRuntimeError) as exc:
                 self.counters.num_docs_invalid += 1
                 logger.debug("dropping invalid doc: %s", exc)
         self._pending_delta.extend(batch.checkpoint_delta)

@@ -175,6 +175,11 @@ class Node:
     def ingest(self, index_id: str, docs: list[dict],
                commit: str = "auto") -> dict[str, Any]:
         metadata = self._metadata_or_template(index_id)
+        if not self._source_enabled(metadata, "_ingest-api-source"):
+            from ..metastore.base import MetastoreError
+            raise MetastoreError(
+                f"ingest source for index {index_id!r} is disabled",
+                kind="failed_precondition")
         doc_mapper = metadata.index_config.doc_mapper
         storage = self.storage_resolver.resolve(metadata.index_config.index_uri)
         params = PipelineParams(
@@ -184,12 +189,39 @@ class Node:
             split_num_docs_target=metadata.index_config.split_num_docs_target,
         )
         source = VecSource(docs, partition_id=f"ingest-{time.time_ns()}")
-        pipeline = IndexingPipeline(params, doc_mapper, source,
-                                    self.metastore, storage)
+        pipeline = IndexingPipeline(
+            params, doc_mapper, source, self.metastore, storage,
+            transform=self._transform_for(metadata, "_ingest-api-source"))
         counters = pipeline.run_to_completion()
         return {"num_docs_for_processing": len(docs),
                 "num_ingested_docs": counters.num_docs_processed,
                 "num_invalid_docs": counters.num_docs_invalid}
+
+    def _transform_for(self, metadata: IndexMetadata, source_id: str):
+        """Compiled doc transform from the source config's
+        `transform: {script: ...}` params, if any (the reference's VRL
+        source transforms, doc_processor.rs:94). Compiled once per
+        (index, source, script) — the reference compiles VRL at pipeline
+        spawn, not per batch."""
+        from ..indexing.transform import transform_from_source_params
+        source = metadata.sources.get(source_id)
+        if source is None:
+            return None
+        spec = (source.params or {}).get("transform")
+        if not spec:
+            return None
+        script = spec.get("script") if isinstance(spec, dict) else spec
+        cache = getattr(self, "_transform_cache", None)
+        if cache is None:
+            cache = self._transform_cache = {}
+        key = (metadata.index_uid, source_id, script)
+        if key not in cache:
+            cache[key] = transform_from_source_params(source.params)
+        return cache[key]
+
+    def _source_enabled(self, metadata: IndexMetadata, source_id: str) -> bool:
+        source = metadata.sources.get(source_id)
+        return source is None or source.enabled
 
     def _metadata_or_template(self, index_id: str) -> IndexMetadata:
         """Existing index, or auto-created from a matching index template
@@ -230,6 +262,9 @@ class Node:
         from ..ingest.router import INGEST_V2_SOURCE_ID
         metadata = self.metastore.index_metadata(index_id)
         uid = metadata.index_uid
+        if not self._source_enabled(metadata, INGEST_V2_SOURCE_ID):
+            return {"num_docs_indexed": 0, "num_splits_published": 0,
+                    "source_disabled": True}
         if INGEST_V2_SOURCE_ID not in metadata.sources:
             self.metastore.add_source(
                 uid, SourceConfig(INGEST_V2_SOURCE_ID, "ingest"))
@@ -240,7 +275,8 @@ class Node:
             split_num_docs_target=metadata.index_config.split_num_docs_target)
         pipeline = IndexingPipeline(
             params, metadata.index_config.doc_mapper, source, self.metastore,
-            self.storage_resolver.resolve(metadata.index_config.index_uri))
+            self.storage_resolver.resolve(metadata.index_config.index_uri),
+            transform=self._transform_for(metadata, INGEST_V2_SOURCE_ID))
         counters = pipeline.run_to_completion()
         # truncate WAL behind the (now durable) published checkpoint
         checkpoint = self.metastore.source_checkpoint(uid, INGEST_V2_SOURCE_ID)

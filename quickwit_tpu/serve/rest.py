@@ -39,6 +39,8 @@ from urllib.parse import parse_qs, urlparse
 
 from ..metastore.base import ListSplitsQuery, MetastoreError
 from ..observability.metrics import METRICS
+from ..indexing.transform import TransformParseError
+from ..ingest.router import INGEST_V2_SOURCE_ID
 from ..query.aggregations import AggParseError
 from ..query.es_dsl import EsDslParseError, es_query_to_ast
 from ..query.parser import QueryParseError, parse_query_string
@@ -50,6 +52,9 @@ from .node import Node
 from .serializers import leaf_response_from_dict, leaf_response_to_dict
 
 logger = logging.getLogger(__name__)
+
+# sources whose checkpoints guard the built-in ingest paths against replay
+INTERNAL_SOURCE_IDS = (INGEST_V2_SOURCE_ID, "_ingest-api-source")
 
 _REQUEST_COUNTER = METRICS.counter("qw_http_requests_total", "HTTP requests")
 _REQUEST_LATENCY = METRICS.histogram("qw_http_request_duration_seconds",
@@ -226,6 +231,48 @@ class RestServer:
             splits = node.metastore.list_splits(
                 ListSplitsQuery(index_uids=[metadata.index_uid]))
             return 200, {"splits": [s.to_dict() for s in splits]}
+
+        # --- source management (reference: index_api.rs source routes) --
+        m = re.fullmatch(r"/api/v1/indexes/([^/]+)/sources", path)
+        if m and method == "POST":
+            from ..models.index_metadata import SourceConfig
+            metadata = node.metastore.index_metadata(m.group(1))
+            spec = json.loads(body)
+            if not isinstance(spec, dict):
+                raise ApiError(400, "source config must be a JSON object")
+            if not isinstance(spec.get("source_id"), str):
+                raise ApiError(400, "source requires a string source_id")
+            source = SourceConfig(
+                source_id=spec["source_id"],
+                source_type=spec.get("source_type", "vec"),
+                params=spec.get("params", {}),
+                enabled=spec.get("enabled", True))
+            # reject bad transform scripts at config time, not ingest time
+            from ..indexing.transform import transform_from_source_params
+            transform_from_source_params(source.params)
+            node.metastore.add_source(metadata.index_uid, source)
+            return 200, source.to_dict()
+        m = re.fullmatch(r"/api/v1/indexes/([^/]+)/sources/([^/]+)", path)
+        if m and method == "DELETE":
+            if m.group(2) in INTERNAL_SOURCE_IDS:
+                # reference: index_api.rs forbids deleting internal sources
+                # (their checkpoints guard against WAL replay)
+                raise ApiError(
+                    400, f"source {m.group(2)!r} is internal and cannot be "
+                         f"deleted")
+            metadata = node.metastore.index_metadata(m.group(1))
+            node.metastore.delete_source(metadata.index_uid, m.group(2))
+            return 200, {"deleted": m.group(2)}
+        m = re.fullmatch(r"/api/v1/indexes/([^/]+)/sources/([^/]+)/toggle",
+                         path)
+        if m and method == "PUT":
+            metadata = node.metastore.index_metadata(m.group(1))
+            parsed = json.loads(body) if body else {}
+            if not isinstance(parsed, dict):
+                raise ApiError(400, "toggle body must be a JSON object")
+            enable = bool(parsed.get("enable", True))
+            node.metastore.toggle_source(metadata.index_uid, m.group(2), enable)
+            return 200, {"source_id": m.group(2), "enabled": enable}
 
         # --- ingest ----------------------------------------------------
         m = re.fullmatch(r"/api/v1/([^/_][^/]*)/ingest", path)
@@ -518,7 +565,8 @@ def _make_handler(server: RestServer):
             except ApiError as exc:
                 status, payload = exc.status, {"message": str(exc)}
             except (QueryParseError, EsDslParseError, AggParseError,
-                    PlanError, json.JSONDecodeError, ValueError) as exc:
+                    PlanError, TransformParseError, json.JSONDecodeError,
+                    ValueError) as exc:
                 status, payload = 400, {"message": str(exc)}
             except MetastoreError as exc:
                 code = {"not_found": 404, "already_exists": 400,

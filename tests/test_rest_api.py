@@ -61,6 +61,7 @@ def api():
     server = RestServer(node)
     server.start()
     client = Client(server.port)
+    client.node = node  # for tests that drive node-side passes directly
     status, _ = client.request("POST", "/api/v1/indexes", INDEX_CONFIG)
     assert status == 200
     ndjson = "\n".join(json.dumps(d) for d in DOCS).encode()
@@ -403,3 +404,86 @@ def test_es_two_field_sort(api):
     assert rows == sorted(rows, key=lambda r: (r[0], -r[1]))
     # both sort values surface in the ES `sort` array
     assert len(result["hits"]["hits"][0]["sort"]) == 2
+
+
+def test_source_crud_and_transform(api):
+    """Source routes + VRL-analogue transform applied on the WAL drain."""
+    client, node = api, api.node
+    client.request("POST", "/api/v1/indexes", {
+        "index_id": "tx-logs",
+        "doc_mapping": {
+            "field_mappings": [
+                {"name": "level", "type": "text", "tokenizer": "raw",
+                 "fast": True},
+                {"name": "body", "type": "text"}],
+            "default_search_fields": ["body"]}})
+    status, source = client.request(
+        "POST", "/api/v1/indexes/tx-logs/sources", {
+            "source_id": "_ingest-source", "source_type": "ingest",
+            "params": {"transform": {"script":
+                'if .severity == "debug" { drop() }\n'
+                '.level = uppercase(string(.severity))\ndel(.severity)'}}})
+    assert status == 200 and source["source_id"] == "_ingest-source"
+    ndjson = "\n".join(json.dumps(d) for d in [
+        {"severity": "warn", "body": "tx keep"},
+        {"severity": "debug", "body": "tx drop"}]).encode()
+    status, _ = client.request("POST", "/api/v1/tx-logs/ingest?commit=wal",
+                               ndjson)
+    assert status == 200
+    assert node.run_ingest_pass("tx-logs")["num_docs_indexed"] == 1
+    status, result = client.request("GET",
+                                    "/api/v1/tx-logs/search?query=level:WARN")
+    assert status == 200 and result["num_hits"] == 1
+    # bad script rejected at source-create time
+    status, err = client.request("POST", "/api/v1/indexes/tx-logs/sources", {
+        "source_id": "bad", "params": {"transform": {"script": ".x = ("}}})
+    assert status == 400
+    # toggle disables the drain (source_disabled short-circuit)
+    status, out = client.request(
+        "PUT", "/api/v1/indexes/tx-logs/sources/_ingest-source/toggle",
+        {"enable": False})
+    assert status == 200 and out["enabled"] is False
+    assert node.run_ingest_pass("tx-logs").get("source_disabled") is True
+    client.request(
+        "PUT", "/api/v1/indexes/tx-logs/sources/_ingest-source/toggle",
+        {"enable": True})
+    # internal sources cannot be deleted (their checkpoints guard replay)
+    status, err = client.request(
+        "DELETE", "/api/v1/indexes/tx-logs/sources/_ingest-source")
+    assert status == 400 and "internal" in err["message"]
+    # a user source CAN be deleted
+    client.request("POST", "/api/v1/indexes/tx-logs/sources",
+                   {"source_id": "user-src", "source_type": "vec"})
+    status, out = client.request(
+        "DELETE", "/api/v1/indexes/tx-logs/sources/user-src")
+    assert status == 200
+    # malformed bodies are 400, not 500
+    status, _ = client.request(
+        "PUT", "/api/v1/indexes/tx-logs/sources/_ingest-source/toggle",
+        b"true")
+    assert status == 400
+    status, _ = client.request("POST", "/api/v1/indexes/tx-logs/sources",
+                               b"[1]")
+    assert status == 400
+
+
+def test_disabled_ingest_api_source_rejects_v1_ingest(api):
+    client = api
+    client.request("POST", "/api/v1/indexes", {
+        "index_id": "togglev1",
+        "doc_mapping": {"field_mappings": [{"name": "body", "type": "text"}],
+                        "default_search_fields": ["body"]}})
+    status, out = client.request(
+        "PUT", "/api/v1/indexes/togglev1/sources/_ingest-api-source/toggle",
+        {"enable": False})
+    assert status == 200
+    status, err = client.request("POST", "/api/v1/togglev1/ingest",
+                                 b'{"body": "x"}')
+    assert status == 409 and "disabled" in err["message"]
+    # re-enable restores ingestion
+    client.request(
+        "PUT", "/api/v1/indexes/togglev1/sources/_ingest-api-source/toggle",
+        {"enable": True})
+    status, result = client.request("POST", "/api/v1/togglev1/ingest",
+                                    b'{"body": "x"}')
+    assert status == 200 and result["num_ingested_docs"] == 1

@@ -1,0 +1,167 @@
+"""Doc transforms (VRL analogue): language semantics + pipeline wiring."""
+
+import pytest
+
+from quickwit_tpu.indexing.transform import (
+    Transform, TransformParseError, TransformRuntimeError,
+    transform_from_source_params,
+)
+
+
+def apply(script, doc):
+    return Transform(script).apply(doc)
+
+
+def test_assignment_and_paths():
+    out = apply('.level = uppercase(string(.severity))\n'
+                '.meta.source = "syslog"',
+                {"severity": "warn", "body": "x"})
+    assert out["level"] == "WARN"
+    assert out["meta"] == {"source": "syslog"}
+    assert out["body"] == "x"  # untouched fields survive
+
+
+def test_input_not_mutated():
+    doc = {"a": 1}
+    out = apply(".b = 2", doc)
+    assert doc == {"a": 1} and out == {"a": 1, "b": 2}
+
+
+def test_arithmetic_and_rename():
+    out = apply(".duration_ms = .duration_us / 1000\ndel(.duration_us)",
+                {"duration_us": 42_000})
+    assert out == {"duration_ms": 42.0}
+
+
+def test_string_concat_and_functions():
+    out = apply('.msg = .service + ": " + trim(.message)\n'
+                '.tags = split("a,b,c", ",")\n'
+                '.joined = join(.tags, "-")\n'
+                '.n = length(.tags)',
+                {"service": "api", "message": "  boom  "})
+    assert out["msg"] == "api: boom"
+    assert out["tags"] == ["a", "b", "c"]
+    assert out["joined"] == "a-b-c"
+    assert out["n"] == 3
+
+
+def test_conditionals_and_drop():
+    script = ('if .status >= 500 { .severity = "ERROR" } '
+              'else { .severity = "INFO" }\n'
+              'if .internal == true { drop() }')
+    assert apply(script, {"status": 503})["severity"] == "ERROR"
+    assert apply(script, {"status": 200})["severity"] == "INFO"
+    assert apply(script, {"status": 200, "internal": True}) is None
+
+
+def test_exists_and_null_semantics():
+    script = ('if exists(.user) { .has_user = true } '
+              'else { .has_user = false }')
+    assert apply(script, {"user": "a"})["has_user"] is True
+    assert apply(script, {})["has_user"] is False
+    # missing field reads as null; string() of null is ""
+    assert apply('.s = string(.nope)', {})["s"] == ""
+
+
+def test_parse_json_and_comments():
+    out = apply('# extract nested payload\n'
+                '.payload = parse_json(.raw)\n'
+                '.code = .payload.code',
+                {"raw": '{"code": 7}'})
+    assert out["code"] == 7
+
+
+def test_runtime_error_is_typed():
+    with pytest.raises(TransformRuntimeError):
+        apply(".x = .a / 0", {"a": 1})
+    with pytest.raises(TransformRuntimeError):
+        apply(".x = lowercase(.n)", {"n": 5})
+
+
+def test_parse_errors():
+    with pytest.raises(TransformParseError):
+        Transform(".x = ")
+    with pytest.raises(TransformParseError):
+        Transform("unknownfn(.a)")
+    with pytest.raises(TransformParseError):
+        Transform("import os")  # no python constructs
+    with pytest.raises(TransformParseError):
+        Transform('.x = __import__("os")')
+
+
+def test_operator_precedence():
+    out = apply(".x = 1 + 2 * 3\n.y = (1 + 2) * 3\n"
+                ".z = 10 - 2 - 3\n.b = 1 + 1 == 2 && !false",
+                {})
+    assert out["x"] == 7 and out["y"] == 9 and out["z"] == 5
+    assert out["b"] is True
+
+
+def test_from_source_params():
+    assert transform_from_source_params({}) is None
+    assert transform_from_source_params({"transform": None}) is None
+    t = transform_from_source_params({"transform": {"script": ".a = 1"}})
+    assert t.apply({})["a"] == 1
+    with pytest.raises(TransformParseError):
+        transform_from_source_params({"transform": {"script": ""}})
+
+
+def test_pipeline_applies_transform(tmp_path):
+    """End-to-end: the pipeline drops transform-failing docs as invalid and
+    indexes the transformed shape."""
+    from quickwit_tpu.common.uri import Uri
+    from quickwit_tpu.indexing.pipeline import IndexingPipeline, PipelineParams
+    from quickwit_tpu.indexing.sources import VecSource
+    from quickwit_tpu.metastore import FileBackedMetastore
+    from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+    from quickwit_tpu.models.index_metadata import (IndexConfig, IndexMetadata,
+                                                    SourceConfig)
+    from quickwit_tpu.storage import RamStorage
+
+    mapper = DocMapper(field_mappings=[
+        FieldMapping("level", FieldType.TEXT, tokenizer="raw", fast=True),
+        FieldMapping("body", FieldType.TEXT)],
+        default_search_fields=("body",))
+    storage = RamStorage(Uri.parse("ram:///transform-test"))
+    metastore = FileBackedMetastore(storage, polling_interval_secs=None)
+    config = IndexConfig(index_id="tx", index_uri="ram:///transform-test/ix",
+                         doc_mapper=mapper)
+    metastore.create_index(IndexMetadata(
+        index_uid="tx:01", index_config=config,
+        sources={"src": SourceConfig("src", "vec")}))
+
+    docs = [{"severity": "warn", "body": "keep me"},
+            {"severity": "debug", "body": "drop me"},
+            {"severity": 13, "body": "invalid: uppercase(int)"}]
+    transform = Transform('if .severity == "debug" { drop() }\n'
+                          '.level = uppercase(.severity)\n'
+                          'del(.severity)')
+    pipeline = IndexingPipeline(
+        PipelineParams(index_uid="tx:01", source_id="src"),
+        mapper, VecSource(docs), metastore,
+        RamStorage(Uri.parse("ram:///transform-test/ix")),
+        transform=transform)
+    counters = pipeline.run_to_completion()
+    assert counters.num_docs_processed == 1   # "warn" survives
+    assert counters.num_docs_invalid == 1     # uppercase(13) fails
+
+
+def test_subtraction_without_space():
+    """Regression: the lexer must not glue a minus onto a number literal —
+    `.a -1` is subtraction, not the literal -1."""
+    out = apply(".x = .a - 1\n.y = .a -1\n.z = -1\n.w = 2--1", {"a": 10})
+    assert out["x"] == 9 and out["y"] == 9
+    assert out["z"] == -1 and out["w"] == 3
+
+
+def test_apply_inplace():
+    doc = {"a": 1}
+    out = Transform(".b = 2").apply(doc, copy=False)
+    assert out is doc and doc == {"a": 1, "b": 2}
+
+
+def test_non_object_doc_is_typed_error():
+    """A malformed (non-object) WAL record must become an invalid-doc count,
+    not crash the drain: apply raises the typed runtime error."""
+    with pytest.raises(TransformRuntimeError):
+        Transform(".a = 1").apply("just a string")  # type: ignore[arg-type]
