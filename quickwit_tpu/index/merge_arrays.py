@@ -93,10 +93,77 @@ def _first_meta(readers, name) -> dict[str, Any]:
 
 def _merge_inverted(builder, name, readers, doc_offsets, num_docs,
                     num_docs_padded) -> dict[str, Any]:
-    term_dicts = [(i, r.term_dict(name)) for i, r in enumerate(readers)]
-    term_dicts = [(i, td) for i, td in term_dicts if td is not None]
+    """Dispatch: native k-way merge (fastindex.merge_inverted) when the
+    extension is available, byte-identical Python fallback otherwise; the
+    fieldnorm/meta tail is shared."""
     with_positions = any(
         r.has_array(f"inv.{name}.positions.offsets") for r in readers)
+    from ..native import load_fastindex
+    fastindex = load_fastindex()
+    if fastindex is not None and hasattr(fastindex, "merge_inverted"):
+        num_terms = _merge_inverted_native(
+            fastindex, builder, name, readers, doc_offsets, num_docs_padded,
+            with_positions)
+    else:
+        num_terms = _merge_inverted_python(
+            builder, name, readers, doc_offsets, num_docs_padded,
+            with_positions)
+    return _inverted_meta_tail(builder, name, readers, doc_offsets,
+                               num_docs, num_docs_padded, num_terms)
+
+
+def _merge_inverted_native(fastindex, builder, name, readers, doc_offsets,
+                           num_docs_padded, with_positions) -> int:
+    inputs = []
+    for i, r in enumerate(readers):
+        if r.term_dict(name) is None:
+            continue
+        has_pos = r.has_array(f"inv.{name}.positions.offsets")
+        inputs.append((
+            np.ascontiguousarray(r.array(f"inv.{name}.terms.blob"),
+                                 dtype=np.uint8),
+            np.ascontiguousarray(r.array(f"inv.{name}.terms.offsets"),
+                                 dtype=np.int64),
+            np.ascontiguousarray(r.array(f"inv.{name}.terms.df"),
+                                 dtype=np.int32),
+            np.ascontiguousarray(r.array(f"inv.{name}.terms.post_off"),
+                                 dtype=np.int64),
+            np.ascontiguousarray(r.array(f"inv.{name}.postings.ids"),
+                                 dtype=np.int32),
+            np.ascontiguousarray(r.array(f"inv.{name}.postings.tfs"),
+                                 dtype=np.int32),
+            np.ascontiguousarray(r.array(f"inv.{name}.positions.offsets"),
+                                 dtype=np.int64) if has_pos else None,
+            np.ascontiguousarray(r.array(f"inv.{name}.positions.data"),
+                                 dtype=np.int32) if has_pos else None,
+            int(doc_offsets[i]),
+        ))
+    (blob, term_offsets, dfs, post_offs, post_lens, ids, tfs,
+     pos_offsets, pos_data) = fastindex.merge_inverted(
+        inputs, num_docs_padded, with_positions)
+    builder.add_array(f"inv.{name}.terms.blob",
+                      np.frombuffer(blob, dtype=np.uint8))
+    builder.add_array(f"inv.{name}.terms.offsets",
+                      np.frombuffer(term_offsets, dtype=np.int64))
+    builder.add_array(f"inv.{name}.terms.df", np.frombuffer(dfs, np.int32))
+    builder.add_array(f"inv.{name}.terms.post_off",
+                      np.frombuffer(post_offs, np.int64))
+    builder.add_array(f"inv.{name}.terms.post_len",
+                      np.frombuffer(post_lens, np.int32))
+    builder.add_array(f"inv.{name}.postings.ids", np.frombuffer(ids, np.int32))
+    builder.add_array(f"inv.{name}.postings.tfs", np.frombuffer(tfs, np.int32))
+    if with_positions:
+        builder.add_array(f"inv.{name}.positions.offsets",
+                          np.frombuffer(pos_offsets, np.int64))
+        builder.add_array(f"inv.{name}.positions.data",
+                          np.frombuffer(pos_data, np.int32))
+    return len(dfs) // 4
+
+
+def _merge_inverted_python(builder, name, readers, doc_offsets,
+                           num_docs_padded, with_positions) -> int:
+    term_dicts = [(i, r.term_dict(name)) for i, r in enumerate(readers)]
+    term_dicts = [(i, td) for i, td in term_dicts if td is not None]
     # prefetch whole arenas once per reader: per-term ranged reads would hit
     # the byte-range cache's range-merge thousands of times (quadratic)
     arenas = {}
@@ -218,7 +285,11 @@ def _merge_inverted(builder, name, readers, doc_offsets, num_docs,
         builder.add_array(f"inv.{name}.positions.data",
                           np.concatenate(pos_data_chunks) if pos_data_chunks
                           else np.array([], np.int32))
+    return len(dfs_list)
 
+
+def _inverted_meta_tail(builder, name, readers, doc_offsets, num_docs,
+                        num_docs_padded, num_terms) -> dict[str, Any]:
     norms = np.zeros(num_docs_padded, dtype=np.int32)
     total_tokens = 0
     for reader, offset in zip(readers, doc_offsets):
@@ -231,7 +302,7 @@ def _merge_inverted(builder, name, readers, doc_offsets, num_docs,
 
     meta = dict(_first_meta(readers, name))
     meta.update({
-        "num_terms": len(dfs_list),
+        "num_terms": num_terms,
         "total_tokens": total_tokens,
         "avg_len": (total_tokens / num_docs) if num_docs else 0.0,
     })

@@ -382,7 +382,10 @@ class _Parser:
             num = float(value) if "." in value else int(value)
             return lambda doc: num
         if kind == "string":
-            text = json.loads(value)  # handles escapes
+            try:
+                text = json.loads(value)  # handles escapes
+            except ValueError as exc:
+                raise TransformParseError(f"bad string literal {value}: {exc}")
             return lambda doc: text
         if kind == "path":
             parts = tuple(value[1:].split("."))
@@ -456,16 +459,33 @@ class Transform:
             self._program(out)
         except _Drop:
             return None
+        except TransformRuntimeError:
+            raise
+        except Exception as exc:  # noqa: BLE001 - stdlib leaks (OverflowError,
+            # ValueError from split("") etc.) must stay per-doc failures,
+            # never abort the whole drain pass
+            raise TransformRuntimeError(f"{type(exc).__name__}: {exc}")
         return out
 
 
-def transform_from_source_params(params: dict) -> Optional[Transform]:
-    """`transform: {script: ...}` in a SourceConfig's params (reference:
-    `TransformConfig` on the source, doc_processor.rs:94)."""
-    spec = (params or {}).get("transform")
+def transform_script_of(params) -> Optional[str]:
+    """Extract the raw script from a SourceConfig's params, or None.
+    The single source of truth for the `transform` param shape."""
+    if not isinstance(params, dict):
+        if params:
+            raise TransformParseError("source params must be a JSON object")
+        return None
+    spec = params.get("transform")
     if not spec:
         return None
     script = spec.get("script") if isinstance(spec, dict) else spec
     if not isinstance(script, str) or not script.strip():
         raise TransformParseError("transform requires a script string")
-    return Transform(script)
+    return script
+
+
+def transform_from_source_params(params) -> Optional[Transform]:
+    """`transform: {script: ...}` in a SourceConfig's params (reference:
+    `TransformConfig` on the source, doc_processor.rs:94)."""
+    script = transform_script_of(params)
+    return Transform(script) if script is not None else None

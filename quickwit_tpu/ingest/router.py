@@ -18,6 +18,7 @@ from typing import Any, Callable, Optional
 from .ingester import Ingester, ShardState
 
 INGEST_V2_SOURCE_ID = "_ingest-source"
+INGEST_API_SOURCE_ID = "_ingest-api-source"  # the v1 synchronous REST path
 
 
 @dataclass

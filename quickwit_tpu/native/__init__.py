@@ -35,7 +35,7 @@ def _compile() -> Optional[str]:
             and os.path.getmtime(so_path) >= os.path.getmtime(src)):
         return so_path
     include = sysconfig.get_paths()["include"]
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
            f"-I{include}", src, "-o", so_path + ".tmp"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)

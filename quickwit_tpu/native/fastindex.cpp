@@ -342,6 +342,232 @@ PyObject* py_finish(PyObject*, PyObject* args) {
   return result;
 }
 
+// ---------------------------------------------------------------------------
+// k-way term-dictionary merge — the merge hot loop
+// (role of tantivy's segment merge driven by the reference MergeExecutor,
+// merge_split_directories; array-level: postings are re-based and
+// re-padded, never re-tokenized). Semantics mirror
+// index/merge_arrays.py::_merge_inverted exactly.
+
+struct MergeReader {
+  const uint8_t* blob;
+  const int64_t* term_offsets;  // n_terms + 1
+  const int32_t* dfs;
+  const int64_t* post_offs;
+  const int32_t* ids;
+  const int32_t* tfs;
+  const int64_t* pos_offs;  // arena_len + 1, or nullptr
+  const int32_t* pos_data;  // or nullptr
+  int64_t n_terms;
+  int64_t doc_offset;
+  int64_t cursor;  // current term ordinal
+
+  bool done() const { return cursor >= n_terms; }
+  std::pair<const uint8_t*, size_t> term() const {
+    int64_t lo = term_offsets[cursor], hi = term_offsets[cursor + 1];
+    return {blob + lo, static_cast<size_t>(hi - lo)};
+  }
+};
+
+inline int term_cmp(std::pair<const uint8_t*, size_t> a,
+                    std::pair<const uint8_t*, size_t> b) {
+  size_t n = std::min(a.second, b.second);
+  int c = std::memcmp(a.first, b.first, n);
+  if (c != 0) return c;
+  return a.second < b.second ? -1 : (a.second > b.second ? 1 : 0);
+}
+
+// merge_inverted([(blob, term_offsets, dfs, post_offs, ids, tfs,
+//                  pos_offs|None, pos_data|None, doc_offset), ...],
+//                num_docs_padded, with_positions)
+//   -> (blob, term_offsets, dfs, post_offs, post_lens, ids, tfs,
+//       pos_offsets|None, pos_data|None)        -- bytes (LE arrays)
+PyObject* py_merge_inverted(PyObject*, PyObject* args) {
+  PyObject* readers_list;
+  long long num_docs_padded;
+  int with_positions;
+  if (!PyArg_ParseTuple(args, "OLp", &readers_list, &num_docs_padded,
+                        &with_positions))
+    return nullptr;
+  if (!PyList_Check(readers_list)) {
+    PyErr_SetString(PyExc_TypeError, "merge_inverted expects a list");
+    return nullptr;
+  }
+  Py_ssize_t n_readers = PyList_Size(readers_list);
+  std::vector<MergeReader> readers(n_readers);
+  std::vector<std::vector<Py_buffer>> held(n_readers);
+  auto release_all = [&]() {
+    for (auto& bufs : held)
+      for (auto& buf : bufs) PyBuffer_Release(&buf);
+  };
+  for (Py_ssize_t i = 0; i < n_readers; ++i) {
+    PyObject* tup = PyList_GetItem(readers_list, i);
+    Py_buffer blob_b, toffs_b, dfs_b, poffs_b, ids_b, tfs_b;
+    PyObject *pos_offs_o, *pos_data_o;
+    long long doc_offset;
+    if (!PyArg_ParseTuple(tup, "y*y*y*y*y*y*OOL", &blob_b, &toffs_b, &dfs_b,
+                          &poffs_b, &ids_b, &tfs_b, &pos_offs_o, &pos_data_o,
+                          &doc_offset)) {
+      release_all();
+      return nullptr;
+    }
+    held[i] = {blob_b, toffs_b, dfs_b, poffs_b, ids_b, tfs_b};
+    MergeReader& r = readers[i];
+    r.blob = static_cast<const uint8_t*>(blob_b.buf);
+    r.term_offsets = static_cast<const int64_t*>(toffs_b.buf);
+    r.dfs = static_cast<const int32_t*>(dfs_b.buf);
+    r.post_offs = static_cast<const int64_t*>(poffs_b.buf);
+    r.ids = static_cast<const int32_t*>(ids_b.buf);
+    r.tfs = static_cast<const int32_t*>(tfs_b.buf);
+    r.n_terms = dfs_b.len / 4;
+    r.doc_offset = doc_offset;
+    r.cursor = 0;
+    r.pos_offs = nullptr;
+    r.pos_data = nullptr;
+    if (pos_offs_o != Py_None && pos_data_o != Py_None) {
+      Py_buffer po_b, pd_b;
+      if (PyObject_GetBuffer(pos_offs_o, &po_b, PyBUF_SIMPLE) != 0 ||
+          (PyObject_GetBuffer(pos_data_o, &pd_b, PyBUF_SIMPLE) != 0 &&
+           (PyBuffer_Release(&po_b), true))) {
+        release_all();
+        return nullptr;
+      }
+      held[i].push_back(po_b);
+      held[i].push_back(pd_b);
+      r.pos_offs = static_cast<const int64_t*>(po_b.buf);
+      r.pos_data = static_cast<const int32_t*>(pd_b.buf);
+    }
+  }
+
+  std::string blob;
+  std::vector<int64_t> term_offsets{0};
+  std::vector<int32_t> dfs;
+  std::vector<int64_t> post_offs;
+  std::vector<int32_t> post_lens;
+  std::vector<int32_t> ids_arena;
+  std::vector<int32_t> tfs_arena;
+  std::vector<int64_t> pos_offsets;
+  std::vector<int32_t> pos_data;
+
+  Py_BEGIN_ALLOW_THREADS
+  {
+    // upper-bound reservations: repeated geometric growth of the arenas
+    // would memcpy hundreds of MB; the bound is cheap and tight enough
+    // (sum of input dfs + worst-case padding per distinct term)
+    int64_t max_terms = 0, sum_df = 0, blob_bytes = 0, pos_bytes = 0;
+    for (auto& r : readers) {
+      max_terms += r.n_terms;
+      blob_bytes += r.term_offsets[r.n_terms];
+      for (int64_t t = 0; t < r.n_terms; ++t) sum_df += r.dfs[t];
+      if (r.pos_offs != nullptr && r.n_terms > 0) {
+        int64_t last = r.post_offs[r.n_terms - 1] + r.dfs[r.n_terms - 1];
+        pos_bytes += r.pos_offs[last];
+      }
+    }
+    int64_t max_padded = sum_df + max_terms * (kPostingPad - 1) + kPostingPad;
+    blob.reserve(blob_bytes);
+    term_offsets.reserve(max_terms + 1);
+    dfs.reserve(max_terms);
+    post_offs.reserve(max_terms);
+    post_lens.reserve(max_terms);
+    ids_arena.reserve(max_padded);
+    tfs_arena.reserve(max_padded);
+    if (with_positions) {
+      pos_offsets.reserve(max_padded + 1);
+      pos_data.reserve(pos_bytes);
+    }
+  }
+  int64_t cursor = 0;
+  int64_t pos_cursor = 0;
+  std::vector<Py_ssize_t> group;
+  for (;;) {
+    // min term among the heads (k is small: linear scan beats a heap)
+    Py_ssize_t first = -1;
+    for (Py_ssize_t i = 0; i < n_readers; ++i) {
+      if (readers[i].done()) continue;
+      if (first < 0 || term_cmp(readers[i].term(), readers[first].term()) < 0)
+        first = i;
+    }
+    if (first < 0) break;
+    auto term = readers[first].term();
+    group.clear();
+    for (Py_ssize_t i = first; i < n_readers; ++i) {
+      if (!readers[i].done() && term_cmp(readers[i].term(), term) == 0)
+        group.push_back(i);  // ascending reader order == ascending doc ids
+    }
+
+    int64_t df = 0;
+    for (Py_ssize_t i : group) df += readers[i].dfs[readers[i].cursor];
+    int64_t padded = pad_to(std::max<int64_t>(df, 1), kPostingPad);
+    size_t base = ids_arena.size();
+    ids_arena.resize(base + padded, static_cast<int32_t>(num_docs_padded));
+    tfs_arena.resize(base + padded, 0);
+    if (with_positions) pos_offsets.resize(base + padded, 0);
+    int64_t w = 0;
+    for (Py_ssize_t i : group) {
+      MergeReader& r = readers[i];
+      int64_t lo = r.post_offs[r.cursor];
+      int64_t rdf = r.dfs[r.cursor];
+      // bulk copies: tfs memcpy; ids re-based in a vectorizable loop
+      std::memcpy(tfs_arena.data() + base + w, r.tfs + lo, rdf * 4);
+      const int32_t off = static_cast<int32_t>(r.doc_offset);
+      int32_t* dst = ids_arena.data() + base + w;
+      const int32_t* src = r.ids + lo;
+      for (int64_t j = 0; j < rdf; ++j) dst[j] = src[j] + off;
+      if (with_positions && r.pos_offs != nullptr) {
+        int64_t plo = r.pos_offs[lo], phi = r.pos_offs[lo + rdf];
+        int64_t* podst = pos_offsets.data() + base + w;
+        const int64_t* posrc = r.pos_offs + lo;
+        const int64_t shift = pos_cursor - plo;
+        for (int64_t j = 0; j < rdf; ++j) podst[j] = posrc[j] + shift;
+        pos_data.insert(pos_data.end(), r.pos_data + plo, r.pos_data + phi);
+        pos_cursor += phi - plo;
+      } else if (with_positions) {
+        for (int64_t j = 0; j < rdf; ++j) pos_offsets[base + w + j] = pos_cursor;
+      }
+      w += rdf;
+      ++r.cursor;
+    }
+    if (with_positions) {
+      for (int64_t j = df; j < padded; ++j) pos_offsets[base + j] = pos_cursor;
+    }
+    blob.append(reinterpret_cast<const char*>(term.first), term.second);
+    term_offsets.push_back(static_cast<int64_t>(blob.size()));
+    dfs.push_back(static_cast<int32_t>(df));
+    post_offs.push_back(cursor);
+    post_lens.push_back(static_cast<int32_t>(padded));
+    cursor += padded;
+  }
+  if (with_positions) pos_offsets.push_back(pos_cursor);  // trailing guard
+  Py_END_ALLOW_THREADS
+
+  release_all();
+  auto bytes_of = [](const void* data, size_t nbytes) {
+    return PyBytes_FromStringAndSize(static_cast<const char*>(data),
+                                     static_cast<Py_ssize_t>(nbytes));
+  };
+  PyObject* result = PyTuple_New(9);
+  PyTuple_SET_ITEM(result, 0, bytes_of(blob.data(), blob.size()));
+  PyTuple_SET_ITEM(result, 1, bytes_of(term_offsets.data(),
+                                       term_offsets.size() * 8));
+  PyTuple_SET_ITEM(result, 2, bytes_of(dfs.data(), dfs.size() * 4));
+  PyTuple_SET_ITEM(result, 3, bytes_of(post_offs.data(), post_offs.size() * 8));
+  PyTuple_SET_ITEM(result, 4, bytes_of(post_lens.data(), post_lens.size() * 4));
+  PyTuple_SET_ITEM(result, 5, bytes_of(ids_arena.data(), ids_arena.size() * 4));
+  PyTuple_SET_ITEM(result, 6, bytes_of(tfs_arena.data(), tfs_arena.size() * 4));
+  if (with_positions) {
+    PyTuple_SET_ITEM(result, 7, bytes_of(pos_offsets.data(),
+                                         pos_offsets.size() * 8));
+    PyTuple_SET_ITEM(result, 8, bytes_of(pos_data.data(), pos_data.size() * 4));
+  } else {
+    Py_INCREF(Py_None);
+    PyTuple_SET_ITEM(result, 7, Py_None);
+    Py_INCREF(Py_None);
+    PyTuple_SET_ITEM(result, 8, Py_None);
+  }
+  return result;
+}
+
 PyMethodDef kMethods[] = {
     {"new_builder", py_new_builder, METH_VARARGS,
      "new_builder(with_positions) -> capsule"},
@@ -349,6 +575,8 @@ PyMethodDef kMethods[] = {
      "add_values(builder, doc_ids_i32, text_blob, offsets_i64)"},
     {"finish", py_finish, METH_VARARGS,
      "finish(builder, num_docs_padded) -> arrays tuple"},
+    {"merge_inverted", py_merge_inverted, METH_VARARGS,
+     "merge_inverted(readers, num_docs_padded, with_positions) -> arrays"},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -19,6 +19,7 @@ from ..cluster.membership import Cluster, ClusterChange, ClusterMember
 from ..indexing.merge import MergeExecutor, merge_policy_from_config
 from ..indexing.pipeline import IndexingPipeline, PipelineParams
 from ..indexing.sources import VecSource, make_source
+from ..ingest.router import INGEST_API_SOURCE_ID
 from ..metastore.base import ListSplitsQuery, Metastore
 from ..metastore.file_backed import FileBackedMetastore
 from ..models.doc_mapper import DocMapper
@@ -83,7 +84,7 @@ class IndexService:
         metadata = IndexMetadata(
             index_uid=f"{index_id}:{int(time.time()) % 100000:05d}",
             index_config=config,
-            sources={"_ingest-api-source": SourceConfig("_ingest-api-source", "vec")},
+            sources={INGEST_API_SOURCE_ID: SourceConfig(INGEST_API_SOURCE_ID, "vec")},
         )
         self.metastore.create_index(metadata)
         return metadata
@@ -133,6 +134,7 @@ class Node:
                                           config.default_index_root_uri)
         self.clients: dict[str, Any] = {
             config.node_id: LocalSearchClient(self.search_service)}
+        self._transform_cache: dict[tuple, Any] = {}
         self.root_searcher = RootSearcher(
             self.metastore, self.clients,
             nodes_provider=lambda: self.cluster.nodes_with_role("searcher"))
@@ -175,7 +177,7 @@ class Node:
     def ingest(self, index_id: str, docs: list[dict],
                commit: str = "auto") -> dict[str, Any]:
         metadata = self._metadata_or_template(index_id)
-        if not self._source_enabled(metadata, "_ingest-api-source"):
+        if not self._source_enabled(metadata, INGEST_API_SOURCE_ID):
             from ..metastore.base import MetastoreError
             raise MetastoreError(
                 f"ingest source for index {index_id!r} is disabled",
@@ -184,14 +186,14 @@ class Node:
         storage = self.storage_resolver.resolve(metadata.index_config.index_uri)
         params = PipelineParams(
             index_uid=metadata.index_uid,
-            source_id="_ingest-api-source",
+            source_id=INGEST_API_SOURCE_ID,
             node_id=self.config.node_id,
             split_num_docs_target=metadata.index_config.split_num_docs_target,
         )
         source = VecSource(docs, partition_id=f"ingest-{time.time_ns()}")
         pipeline = IndexingPipeline(
             params, doc_mapper, source, self.metastore, storage,
-            transform=self._transform_for(metadata, "_ingest-api-source"))
+            transform=self._transform_for(metadata, INGEST_API_SOURCE_ID))
         counters = pipeline.run_to_completion()
         return {"num_docs_for_processing": len(docs),
                 "num_ingested_docs": counters.num_docs_processed,
@@ -203,21 +205,17 @@ class Node:
         source transforms, doc_processor.rs:94). Compiled once per
         (index, source, script) — the reference compiles VRL at pipeline
         spawn, not per batch."""
-        from ..indexing.transform import transform_from_source_params
+        from ..indexing.transform import Transform, transform_script_of
         source = metadata.sources.get(source_id)
         if source is None:
             return None
-        spec = (source.params or {}).get("transform")
-        if not spec:
+        script = transform_script_of(source.params)
+        if script is None:
             return None
-        script = spec.get("script") if isinstance(spec, dict) else spec
-        cache = getattr(self, "_transform_cache", None)
-        if cache is None:
-            cache = self._transform_cache = {}
         key = (metadata.index_uid, source_id, script)
-        if key not in cache:
-            cache[key] = transform_from_source_params(source.params)
-        return cache[key]
+        if key not in self._transform_cache:
+            self._transform_cache[key] = Transform(script)
+        return self._transform_cache[key]
 
     def _source_enabled(self, metadata: IndexMetadata, source_id: str) -> bool:
         source = metadata.sources.get(source_id)

@@ -40,7 +40,7 @@ from urllib.parse import parse_qs, urlparse
 from ..metastore.base import ListSplitsQuery, MetastoreError
 from ..observability.metrics import METRICS
 from ..indexing.transform import TransformParseError
-from ..ingest.router import INGEST_V2_SOURCE_ID
+from ..ingest.router import INGEST_API_SOURCE_ID, INGEST_V2_SOURCE_ID
 from ..query.aggregations import AggParseError
 from ..query.es_dsl import EsDslParseError, es_query_to_ast
 from ..query.parser import QueryParseError, parse_query_string
@@ -54,7 +54,7 @@ from .serializers import leaf_response_from_dict, leaf_response_to_dict
 logger = logging.getLogger(__name__)
 
 # sources whose checkpoints guard the built-in ingest paths against replay
-INTERNAL_SOURCE_IDS = (INGEST_V2_SOURCE_ID, "_ingest-api-source")
+INTERNAL_SOURCE_IDS = (INGEST_V2_SOURCE_ID, INGEST_API_SOURCE_ID)
 
 _REQUEST_COUNTER = METRICS.counter("qw_http_requests_total", "HTTP requests")
 _REQUEST_LATENCY = METRICS.histogram("qw_http_request_duration_seconds",

@@ -155,3 +155,22 @@ def test_merge_footer_metadata(merged_pair):
     assert array_reader.footer.time_range == doc_reader.footer.time_range
     assert array_reader.field_meta("body")["avg_len"] == \
         pytest.approx(doc_reader.field_meta("body")["avg_len"])
+
+
+def test_native_merge_bytes_identical_to_python(merged_pair):
+    """The C++ merge_inverted must produce byte-identical split files to the
+    Python k-way merge (same blob, arenas, padding, and positions layout)."""
+    import quickwit_tpu.native as native_mod
+    from quickwit_tpu.native import load_fastindex
+
+    if load_fastindex() is None:
+        pytest.skip("native toolchain unavailable")
+    _storage, readers, _docs = build_inputs()
+    data_native = merge_splits(readers)
+    saved = native_mod._cached
+    native_mod._cached = None  # force the Python path
+    try:
+        data_python = merge_splits(readers)
+    finally:
+        native_mod._cached = saved
+    assert data_native == data_python

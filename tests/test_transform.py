@@ -165,3 +165,25 @@ def test_non_object_doc_is_typed_error():
     not crash the drain: apply raises the typed runtime error."""
     with pytest.raises(TransformRuntimeError):
         Transform(".a = 1").apply("just a string")  # type: ignore[arg-type]
+
+
+def test_stdlib_exceptions_become_typed_runtime_errors():
+    """Regression: OverflowError from int(), ValueError from split('') etc.
+    must surface as TransformRuntimeError (per-doc invalid), never abort
+    the whole drain pass."""
+    with pytest.raises(TransformRuntimeError):
+        Transform(".x = int(.a)").apply({"a": "1e999"})
+    with pytest.raises(TransformRuntimeError):
+        Transform('.x = split(.a, "")').apply({"a": "abc"})
+
+
+def test_bad_string_literal_is_parse_error():
+    """Regression: escapes json rejects must raise the typed parse error at
+    compile time, not JSONDecodeError at first use."""
+    with pytest.raises(TransformParseError):
+        Transform('.x = "\\q"')
+
+
+def test_non_dict_params_rejected():
+    with pytest.raises(TransformParseError):
+        transform_from_source_params([1])  # type: ignore[arg-type]
