@@ -55,7 +55,8 @@ def cmd_run(args) -> int:
     server.start()
     node.start_background_services()
     print(f"node {config.node_id} (roles: {','.join(config.roles)}) "
-          f"listening on http://{server.endpoint}")
+          f"listening on "
+          f"{'https' if config.tls_enabled else 'http'}://{server.endpoint}")
     try:
         while True:
             time.sleep(3600)

@@ -59,6 +59,7 @@ def load_node_config(path: Optional[str] = None,
     else:
         roles = tuple(roles_raw)
     rest = data.get("rest", {})
+    tls = rest.get("tls") or {}  # bare "tls:" key parses as None
     return NodeConfig(
         node_id=str(pick("QW_NODE_ID", "node_id", "node-0")),
         roles=roles,
@@ -72,6 +73,10 @@ def load_node_config(path: Optional[str] = None,
         rest_port=int(environ.get("QW_REST_PORT",
                                   rest.get("listen_port", 7280))),
         peers=tuple(data.get("peer_seeds", ())),
+        tls_cert_path=tls.get("cert_path"),
+        tls_key_path=tls.get("key_path"),
+        tls_ca_path=tls.get("ca_path"),
+        tls_skip_verify=bool(tls.get("skip_verify", False)),
     )
 
 

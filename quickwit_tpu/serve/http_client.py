@@ -9,7 +9,8 @@ from __future__ import annotations
 
 import http.client
 import json
-from typing import Any
+import ssl
+from typing import Any, Optional
 
 from ..common.tower import CircuitBreaker, CircuitOpen
 from ..search.models import FetchDocsRequest, LeafSearchRequest, LeafSearchResponse
@@ -27,12 +28,26 @@ class HttpStatusError(HttpTransportError):
 
 
 class HttpSearchClient:
-    def __init__(self, endpoint: str, timeout_secs: float = 30.0):
+    def __init__(self, endpoint: str, timeout_secs: float = 30.0,
+                 tls: bool = False, ca_path: Optional[str] = None,
+                 skip_verify: bool = False):
         self.endpoint = endpoint  # "host:port"
         host, port = endpoint.rsplit(":", 1)
         self.host = host
         self.port = int(port)
         self.timeout_secs = timeout_secs
+        # TLS toward peers (role of quickwit-transport's rustls client side):
+        # `ca_path` pins the cluster CA for self-signed deployments;
+        # `skip_verify` is for tests only.
+        self._ssl_context: Optional[ssl.SSLContext] = None
+        if tls:
+            if skip_verify:
+                context = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+                context.check_hostname = False
+                context.verify_mode = ssl.CERT_NONE
+            else:
+                context = ssl.create_default_context(cafile=ca_path)
+            self._ssl_context = context
         # stop hammering a dead peer; root search fails fast to its retry
         # path instead of stacking timeouts (reference tower circuit breaker)
         self.circuit = CircuitBreaker(
@@ -43,8 +58,13 @@ class HttpSearchClient:
         return self.circuit.call(lambda: self._post_once(path, payload))
 
     def _post_once(self, path: str, payload: Any) -> Any:
-        conn = http.client.HTTPConnection(self.host, self.port,
-                                          timeout=self.timeout_secs)
+        if self._ssl_context is not None:
+            conn: http.client.HTTPConnection = http.client.HTTPSConnection(
+                self.host, self.port, timeout=self.timeout_secs,
+                context=self._ssl_context)
+        else:
+            conn = http.client.HTTPConnection(self.host, self.port,
+                                              timeout=self.timeout_secs)
         try:
             data = json.dumps(payload).encode()
             conn.request("POST", path, body=data,

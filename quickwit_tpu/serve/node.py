@@ -46,6 +46,26 @@ class NodeConfig:
     peers: tuple[str, ...] = ()  # "host:port" seeds
     data_dir: Optional[str] = None  # WAL + scratch; tmp dir when unset
     wal_fsync: bool = True
+    # TLS (role of quickwit-transport's rustls config): server cert/key
+    # enable HTTPS on the REST listener; clusters are homogeneous, so a
+    # TLS-enabled node speaks HTTPS to its peers too. `tls_ca_path`
+    # verifies peer certs (self-signed deployments); `tls_skip_verify`
+    # disables verification (tests only).
+    tls_cert_path: Optional[str] = None
+    tls_key_path: Optional[str] = None
+    tls_ca_path: Optional[str] = None
+    tls_skip_verify: bool = False
+
+    @property
+    def tls_enabled(self) -> bool:
+        return self.tls_cert_path is not None and self.tls_key_path is not None
+
+    def client_tls_kwargs(self) -> dict:
+        """kwargs for HttpSearchClient toward peers of this cluster."""
+        if not self.tls_enabled:
+            return {}
+        return {"tls": True, "ca_path": self.tls_ca_path,
+                "skip_verify": self.tls_skip_verify}
 
 
 class IndexService:
@@ -169,7 +189,8 @@ class Node:
             return
         if "searcher" in member.roles and member.rest_endpoint:
             from .http_client import HttpSearchClient
-            self.clients[member.node_id] = HttpSearchClient(member.rest_endpoint)
+            self.clients[member.node_id] = HttpSearchClient(
+                member.rest_endpoint, **self.config.client_tls_kwargs())
 
     # ------------------------------------------------------------------
     # ingest (v1-style: REST batch → immediate split, commit semantics
@@ -458,7 +479,8 @@ class Node:
                 client = heartbeat_clients.get(endpoint)
                 if client is None:
                     client = heartbeat_clients[endpoint] = HttpSearchClient(
-                        endpoint, timeout_secs=2.0)
+                        endpoint, timeout_secs=2.0,
+                        **self.config.client_tls_kwargs())
                 try:
                     info = client.heartbeat(payload)
                 except (HttpTransportError, CircuitOpen) as exc:

@@ -123,12 +123,27 @@ class RestServer:
     def start(self) -> None:
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
+        config = self.node.config
+        if config.tls_enabled:
+            # role of quickwit-transport's rustls server side: terminate
+            # TLS on the REST listener (REST + internal RPC share it).
+            # Handshake is deferred to the per-connection handler thread
+            # (do_handshake_on_connect=False): a client that connects and
+            # never speaks must not wedge the shared accept loop.
+            import ssl
+            context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            context.load_cert_chain(config.tls_cert_path, config.tls_key_path)
+            self._httpd.socket = context.wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
         self.port = self._httpd.server_address[1]
         self.node.config.rest_port = self.port
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name=f"rest-{self.port}", daemon=True)
         self._thread.start()
-        logger.info("REST server listening on %s:%d", self.host, self.port)
+        logger.info("REST server listening on %s://%s:%d",
+                    "https" if config.tls_enabled else "http",
+                    self.host, self.port)
 
     def stop(self) -> None:
         if self._httpd is not None:
@@ -549,6 +564,28 @@ def _parse_ndjson(body: bytes) -> list[dict]:
 def _make_handler(server: RestServer):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+
+        _handshake_failed = False
+
+        def setup(self):
+            import ssl as _ssl
+            if isinstance(self.request, _ssl.SSLSocket):
+                # deferred TLS handshake, bounded so a silent client ties
+                # up only this handler thread, never the accept loop
+                try:
+                    self.request.settimeout(10.0)
+                    self.request.do_handshake()
+                    self.request.settimeout(None)
+                except (OSError, _ssl.SSLError) as exc:
+                    # garbage/plain-HTTP/silent clients: drop quietly
+                    logger.debug("tls handshake failed from %s: %s",
+                                 self.client_address, exc)
+                    self._handshake_failed = True
+            super().setup()
+
+        def handle(self):
+            if not self._handshake_failed:
+                super().handle()
 
         def log_message(self, fmt, *args):  # quiet
             logger.debug("http: " + fmt, *args)

@@ -203,3 +203,53 @@ def test_wildcard_bind_address_not_advertised():
         == "192.168.1.2:7280"
     assert substitute_wildcard_host("", "10.0.0.5") == ""
     assert substitute_wildcard_host("0.0.0.0:7280", "") == "0.0.0.0:7280"
+
+
+def test_tls_rest_and_peer_transport(tmp_path):
+    """TLS on the REST listener (server cert/key) with the peer client
+    verifying against a pinned CA — heartbeat + search over HTTPS."""
+    import shutil
+    import ssl
+    import subprocess
+    import urllib.request
+
+    if shutil.which("openssl") is None:
+        pytest.skip("openssl unavailable")
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True)
+
+    from quickwit_tpu.serve import NodeConfig
+    resolver = StorageResolver.for_test()
+    node = Node(NodeConfig(node_id="tls-node", rest_port=0,
+                           metastore_uri="ram:///tls/metastore",
+                           default_index_root_uri="ram:///tls/indexes",
+                           tls_cert_path=str(cert), tls_key_path=str(key),
+                           tls_ca_path=str(cert)),
+                storage_resolver=resolver)
+    server = RestServer(node)
+    server.start()
+    try:
+        context = ssl.create_default_context(cafile=str(cert))
+        with urllib.request.urlopen(
+                f"https://127.0.0.1:{server.port}/api/v1/cluster",
+                context=context, timeout=10) as response:
+            cluster = json.loads(response.read())
+        assert cluster["node_id"] == "tls-node"
+        # the peer transport speaks HTTPS with the pinned CA
+        client = HttpSearchClient(f"127.0.0.1:{server.port}",
+                                  **node.config.client_tls_kwargs())
+        info = client.heartbeat({"node_id": "probe", "roles": ["searcher"],
+                                 "rest_endpoint": "127.0.0.1:9"})
+        assert info["node_id"] == "tls-node"
+        # a plain-HTTP client is rejected at the TLS layer
+        plain = HttpSearchClient(f"127.0.0.1:{server.port}")
+        with pytest.raises(HttpTransportError):
+            plain.heartbeat({"node_id": "x", "roles": []})
+    finally:
+        server.stop()
