@@ -26,12 +26,39 @@ def _hit_order_key(h: PartialHit):
     return (-h.sort_value, -h.sort_value2, h.split_id, h.doc_id)
 
 
+class _StrKey:
+    """Order wrapper for text-sort merging: compares the DECODED term
+    strings (per-split ordinals are not cross-split comparable); missing
+    values (None) sort last in both directions (ES `missing: _last`)."""
+
+    __slots__ = ("value", "desc")
+
+    def __init__(self, value, desc: bool):
+        self.value = value
+        self.desc = desc
+
+    def __lt__(self, other: "_StrKey") -> bool:
+        a, b = self.value, other.value
+        if a is None:
+            return False  # None never precedes anything
+        if b is None:
+            return True
+        return a > b if self.desc else a < b
+
+    def __eq__(self, other) -> bool:
+        return self.value == other.value
+
+
 class IncrementalCollector:
     def __init__(self, max_hits: int, start_offset: int = 0,
-                 search_after: Optional[tuple] = None):
+                 search_after: Optional[tuple] = None,
+                 string_sort: Optional[str] = None):
         self.max_hits = max_hits
         self.start_offset = start_offset
         self.search_after = search_after  # (sort_value, split_id, doc_id) internal
+        # "asc" | "desc" when the primary sort is a text field: merge by
+        # raw_sort_value (term string) instead of the split-local float key
+        self.string_sort = string_sort
         self.num_hits = 0
         self.failed_splits: list = []
         self.num_attempted_splits = 0
@@ -57,7 +84,7 @@ class IncrementalCollector:
         self._hits.extend(hits)
         keep = self.start_offset + self.max_hits
         if len(self._hits) > 4 * max(keep, 1):
-            self._hits.sort(key=_hit_order_key)
+            self._hits.sort(key=self._order_key)
             del self._hits[keep:]
         for name, state in leaf.intermediate_aggs.items():
             self._merge_agg(name, state)
@@ -82,13 +109,19 @@ class IncrementalCollector:
                 min(a[3], b[3]), max(a[4], b[4])])
 
     # ------------------------------------------------------------------
+    def _order_key(self, h: PartialHit):
+        if self.string_sort is not None:
+            return (_StrKey(h.raw_sort_value, self.string_sort == "desc"),
+                    h.split_id, h.doc_id)
+        return _hit_order_key(h)
+
     def partial_hits(self) -> list[PartialHit]:
-        self._hits.sort(key=_hit_order_key)
+        self._hits.sort(key=self._order_key)
         return self._hits[self.start_offset: self.start_offset + self.max_hits]
 
     def to_leaf_response(self) -> LeafSearchResponse:
         """Re-emit as a leaf response (for tree-merging at the node level)."""
-        self._hits.sort(key=_hit_order_key)
+        self._hits.sort(key=self._order_key)
         return LeafSearchResponse(
             num_hits=self.num_hits,
             partial_hits=self._hits[: self.start_offset + self.max_hits],

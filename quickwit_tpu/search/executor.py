@@ -29,8 +29,9 @@ from ..ops import masks as mask_ops
 from ..ops import topk as topk_ops
 from ..ops.bm25 import score_postings
 from .plan import (
-    BucketAggExec, LoweredPlan, MetricAggExec, PBool, PMatchAll, PMatchNone,
-    PNormPresence, PPostings, PPresence, PRange, SortExec,
+    PRESENT_FROM_VALUES, BucketAggExec, LoweredPlan, MetricAggExec, PBool,
+    PMatchAll, PMatchNone, PNormPresence, PPostings, PPresence, PRange,
+    SortExec,
 )
 
 _JIT_CACHE: dict[tuple, Callable] = {}
@@ -124,7 +125,11 @@ def _keyed_for(by, descending, values_slot, present_slot, view, mask,
         key = view[values_slot].astype(jnp.float64)
         if not descending:
             key = -key
-        has_value = mask & view[present_slot].astype(jnp.bool_)
+        if present_slot == PRESENT_FROM_VALUES:
+            present = view[values_slot] >= 0  # ordinal columns: -1 = missing
+        else:
+            present = view[present_slot].astype(jnp.bool_)
+        has_value = mask & present
         return jnp.where(
             has_value, key,
             jnp.where(mask, jnp.float64(topk_ops.MISSING_VALUE_SENTINEL),

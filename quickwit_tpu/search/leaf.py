@@ -100,6 +100,11 @@ def leaf_search_single_split(
     count = result["count"]
     num_hits_returned = min(k, count)
     partial_hits = []
+    # text-field sort: internal keys are split-local dictionary ordinals —
+    # decode to term strings here (the reference's leaf likewise returns
+    # term bytes); collector merges on the strings
+    text_dict = (reader.column_dict(plan.sort_text_field)
+                 if plan.sort_text_field else None)
     sort_is_int = _sort_values_are_int(doc_mapper, sort_field)
     sort2_is_int = (_sort_values_are_int(doc_mapper, sort2.field)
                     if sort2 else False)
@@ -109,8 +114,16 @@ def leaf_search_single_split(
         if internal == float("-inf"):
             break  # fewer eligible hits than k (search_after pushdown)
         doc_id = int(result["doc_ids"][i])
-        raw = decode_raw_sort_value(internal, sort_field, sort_order,
-                                    sort_is_int, result["scores"][i], doc_id)
+        if text_dict is not None:
+            if internal == MISSING_VALUE_SENTINEL:
+                raw = None
+            else:
+                ordinal = int(internal if sort_order == "desc" else -internal)
+                raw = text_dict[ordinal]
+        else:
+            raw = decode_raw_sort_value(internal, sort_field, sort_order,
+                                        sort_is_int, result["scores"][i],
+                                        doc_id)
         internal2, raw2 = 0.0, None
         if sort2 is not None and values2 is not None:
             internal2 = float(values2[i])

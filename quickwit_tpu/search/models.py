@@ -29,6 +29,23 @@ class SortField:
         return SortField(d.get("field", "_score"), d.get("order", "desc"))
 
 
+def string_sort_of(request, doc_mapper) -> "Optional[str]":
+    """'asc'/'desc' when the request's primary sort is a text FAST field
+    (dict-ordinal column) — collectors must then merge by the decoded term
+    strings — else None. Must stay in lockstep with the plan's
+    `Lowering._is_text_sort` (plan.py): the leaf decides what it RETURNS
+    there, this decides how collectors MERGE it."""
+    if not request.sort_fields:
+        return None
+    primary = request.sort_fields[0]
+    if primary.field in ("_score", "_doc"):
+        return None
+    fm = doc_mapper.field(primary.field)
+    if fm is None or fm.type.value != "text" or not fm.fast:
+        return None
+    return primary.order
+
+
 def normalize_sort_fields(sort_fields: tuple) -> tuple:
     """Drop a `_doc` secondary (doc order is the implicit final tie-break)
     and anything after a `_doc` primary, so the wire request's key count

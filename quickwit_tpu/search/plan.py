@@ -175,6 +175,11 @@ class MetricAggExec:
 # --------------------------------------------------------------------------
 # sort
 
+# sentinel present_slot: presence is derived on-device as values >= 0
+# (dict-ordinal columns encode missing as -1; no bool column shipped)
+PRESENT_FROM_VALUES = -2
+
+
 @dataclass(frozen=True)
 class SortExec:
     """Static sort plan: by score, by column, or by doc id; optional
@@ -212,6 +217,9 @@ class LoweredPlan:
     sa_value_slot: int = -1
     sa_value2_slot: int = -1
     sa_doc_slot: int = -1
+    # text-field (dict-ordinal) primary sort: the leaf decodes the returned
+    # ordinals back to term strings; merging happens on the strings
+    sort_text_field: Optional[str] = None
 
     def signature(self, k: int) -> tuple:
         shapes = tuple((a.shape, str(a.dtype)) for a in self.arrays)
@@ -750,14 +758,27 @@ class Lowering:
             cache[cache_key] = cached
         return cached
 
-    def _check_sortable(self, field: str) -> None:
+    def _is_text_sort(self, field: str) -> bool:
+        """True for dict-ordinal (raw text fast) columns: sortable on device
+        by local ordinal — the dictionary is lex-sorted, so per-split
+        ordinal order == string order. Cross-split comparison happens on
+        the DECODED term strings in the collector (the reference likewise
+        returns term bytes as leaf sort values for string sorts)."""
         fm = self._field(field)
-        if fm.type is FieldType.TEXT:
-            # per-split ordinals are not comparable across splits; string
-            # sort keys need a global ordinal map (round-2 item)
-            raise PlanError(
-                f"sorting by text field {field!r} is not supported; sort by "
-                "a numeric/datetime fast field, _score, or _doc")
+        if fm.type is not FieldType.TEXT:
+            return False
+        if not fm.fast:
+            raise PlanError(f"sorting by text field {field!r} requires "
+                            f"fast: true")
+        return True
+
+    def _ordinal_sort_slots(self, field: str) -> tuple[int, int]:
+        def fetch_ordinals():
+            return self.reader.column_ordinals(field)
+        values_slot = self.b.add_array(f"col.{field}.ordinals", fetch_ordinals)
+        # presence is derivable on-device (ordinal >= 0): the sentinel slot
+        # avoids shipping + keeping a whole bool column in HBM
+        return values_slot, PRESENT_FROM_VALUES
 
     # --- sort -------------------------------------------------------------
     def lower_sort(self, sort_field: str, order: str,
@@ -768,8 +789,14 @@ class Lowering:
             primary = SortExec("score", descending)
         elif sort_field == "_doc":
             primary = SortExec("doc", descending)
+        elif self._is_text_sort(sort_field):
+            if sort2_field is not None and sort2_field != "_doc":
+                raise PlanError(
+                    f"text-field sort {sort_field!r} cannot be combined "
+                    f"with a secondary sort key")
+            values_slot, present_slot = self._ordinal_sort_slots(sort_field)
+            return SortExec("column", descending, values_slot, present_slot)
         else:
-            self._check_sortable(sort_field)
             values_slot, present_slot = self._column_slots(sort_field)
             primary = SortExec("column", descending, values_slot, present_slot)
         if sort2_field is None or sort2_field == "_doc" or primary.by == "doc":
@@ -779,7 +806,10 @@ class Lowering:
         if sort2_field == "_score":
             return dc_replace(primary, by2="score",
                               descending2=sort2_order == "desc")
-        self._check_sortable(sort2_field)
+        if self._is_text_sort(sort2_field):
+            raise PlanError(
+                f"text field {sort2_field!r} is not supported as a "
+                f"secondary sort key")
         v2, p2 = self._column_slots(sort2_field)
         return dc_replace(primary, by2="column",
                           descending2=sort2_order == "desc",
@@ -852,8 +882,15 @@ def lower_request(
         ), bounds_are_micros=True)
         root = PBool(must=(root,), filter=(ts_node,))
     sort = low.lower_sort(sort_field, sort_order, sort2_field, sort2_order)
+    sort_text_field = sort_field if (
+        sort_field not in ("_score", "_doc")
+        and low._is_text_sort(sort_field)) else None
     aggs = [low.lower_agg(spec) for spec in agg_specs]
     sa_relation, sa_value_slot, sa_value2_slot, sa_doc_slot = "none", -1, -1, -1
+    if search_after is not None and sort_text_field is not None:
+        raise PlanError(
+            f"search_after/scroll is not supported with text-field sort "
+            f"{sort_text_field!r} (string markers are a follow-up)")
     if search_after is not None:
         sa_value, sa_value2, sa_relation, sa_doc = search_after
         sa_value_slot = low.b.add_scalar(float(sa_value), np.float64)
@@ -867,4 +904,5 @@ def lower_request(
         search_after_relation=sa_relation,
         sa_value_slot=sa_value_slot, sa_value2_slot=sa_value2_slot,
         sa_doc_slot=sa_doc_slot,
+        sort_text_field=sort_text_field,
     )

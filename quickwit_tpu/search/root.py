@@ -29,7 +29,7 @@ from ..query import ast as Q
 from .collector import IncrementalCollector, finalize_aggregations
 from .models import (
     FetchDocsRequest, Hit, LeafSearchRequest, LeafSearchResponse, SearchRequest,
-    SearchResponse, SplitIdAndFooter,
+    SearchResponse, SplitIdAndFooter, string_sort_of,
 )
 from .placer import SearchJob, nodes_for_split, place_jobs
 
@@ -73,9 +73,20 @@ class RootSearcher:
         if not indexes:
             raise ValueError(f"no index matches {request.index_ids!r}")
 
+        # the merge key type must be consistent across every matched index:
+        # a sort field that is text in one index and numeric in another has
+        # no global order (the reference rejects this the same way)
+        sort_modes = {string_sort_of(request, im.index_config.doc_mapper)
+                      for im in indexes}
+        if len(sort_modes) > 1:
+            field = request.sort_fields[0].field
+            raise ValueError(
+                f"sort field {field!r} is a text fast field in some matched "
+                f"indexes but not others; cross-index sort needs one type")
         collector = IncrementalCollector(
             max_hits=request.max_hits, start_offset=request.start_offset,
-            search_after=self._search_after_key(request))
+            search_after=self._search_after_key(request),
+            string_sort=next(iter(sort_modes)))
         split_meta_by_id: dict[str, tuple[str, SplitIdAndFooter, dict]] = {}
         nodes = self.nodes_provider()
 
@@ -202,9 +213,13 @@ class RootSearcher:
             return retry_response
         # keep the successful part of the original + the retry results
         response.failed_splits = retry_response.failed_splits
+        from ..models.doc_mapper import DocMapper as _DM
         merged = IncrementalCollector(
             max_hits=leaf_request.search_request.max_hits
-            + leaf_request.search_request.start_offset)
+            + leaf_request.search_request.start_offset,
+            string_sort=string_sort_of(
+                leaf_request.search_request,
+                _DM.from_dict(leaf_request.doc_mapping)))
         ok_part = LeafSearchResponse(
             num_hits=response.num_hits, partial_hits=response.partial_hits,
             intermediate_aggs=response.intermediate_aggs,
@@ -279,6 +294,11 @@ class RootSearcher:
             if value is None:
                 from .leaf import MISSING_VALUE_SENTINEL
                 return MISSING_VALUE_SENTINEL
+            if isinstance(value, str):
+                raise ValueError(
+                    "search_after with string sort values is not supported "
+                    "(text-field sort markers are a follow-up); paginate "
+                    "within the scroll window instead")
             value = float(value)
             if sort and sort.order == "asc":
                 value = -value

@@ -32,6 +32,9 @@ class ScrollContext:
     total_hits: int = 0
     created_at: float = field(default_factory=time.monotonic)
     ttl_secs: float = DEFAULT_TTL_SECS
+    # text-field primary sort: refilling past the cached window needs a
+    # string search_after marker (unsupported — named error on refill)
+    string_sort: bool = False
 
     @property
     def expired(self) -> bool:

@@ -33,7 +33,7 @@ from .collector import IncrementalCollector
 from .leaf import leaf_search_single_split
 from .models import (
     FetchDocsRequest, LeafSearchRequest, LeafSearchResponse, SearchRequest,
-    SplitIdAndFooter, SplitSearchError,
+    SplitIdAndFooter, SplitSearchError, string_sort_of,
 )
 
 logger = logging.getLogger(__name__)
@@ -88,7 +88,8 @@ class SearchService:
 
         collector = IncrementalCollector(
             max_hits=search_request.max_hits,
-            start_offset=search_request.start_offset)
+            start_offset=search_request.start_offset,
+            string_sort=string_sort_of(search_request, doc_mapper))
         pending: list[SplitIdAndFooter] = []
         for split in splits:
             key = canonical_request_key(split.split_id, search_request,
@@ -156,7 +157,8 @@ class SearchService:
         # the batch path has no search_after pushdown or secondary sort;
         # the per-split path handles both
         if (len(group) > 1 and not search_request.search_after
-                and len(search_request.sort_fields) < 2):
+                and len(search_request.sort_fields) < 2
+                and string_sort_of(search_request, doc_mapper) is None):
             try:
                 readers = [self.context.reader(s) for s in group]
                 batch = build_batch(search_request, doc_mapper, readers,

@@ -357,10 +357,15 @@ class Node:
                                  max_hits=max(CACHE_WINDOW, page_size),
                                  start_offset=0)
         response = self.root_searcher.search(window_request)
+        from ..search.models import string_sort_of
+        resolved = self.root_searcher._resolve_indexes(request.index_ids)
+        mapper = resolved[0].index_config.doc_mapper if resolved else None
         context = ScrollContext(
             request=request, cached_hits=response.hits,
             cursor=min(page_size, len(response.hits)),
-            total_hits=response.num_hits, ttl_secs=ttl_secs)
+            total_hits=response.num_hits, ttl_secs=ttl_secs,
+            string_sort=(mapper is not None
+                         and string_sort_of(request, mapper) is not None))
         scroll_id = self.scroll_store.put(context)
         page = response.to_dict()
         page["hits"] = page["hits"][:page_size]
@@ -381,6 +386,13 @@ class Node:
         if context.cursor >= len(hits) and len(hits) < context.total_hits and hits:
             # refill the window via search_after from the last cached hit
             from ..search.scroll import CACHE_WINDOW
+            if context.string_sort:
+                # keyed on the REQUEST's sort type, not the cached value
+                # (a missing-value None marker would bypass a value check)
+                raise ValueError(
+                    "scrolling past the cached window is not supported with "
+                    "text-field sorts (string search_after markers are a "
+                    "follow-up); narrow the query or raise the window")
             last = hits[-1]
             sort_value = last.sort_values[0] if last.sort_values else last.score
             if len(context.request.sort_fields) > 1 and len(last.sort_values) > 1:

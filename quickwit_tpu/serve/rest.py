@@ -469,6 +469,12 @@ class RestServer:
                     order = spec.get("order", "asc") if isinstance(spec, dict) else spec
                     parsed.append(SortField(field_name, order))
             sort_fields = tuple(parsed)
+        if payload.get("search_after"):
+            # silently ignoring it would hand clients page 1 forever; the
+            # ES marker shape (sort values + _shard_doc tiebreak) is a
+            # follow-up — use the scroll API for deep pagination meanwhile
+            raise ApiError(400, "search_after is not supported in the ES "
+                                "API yet; use the scroll API")
         track_total = payload.get("track_total_hits",
                                    params.get("track_total_hits", True))
         if isinstance(track_total, str):  # query-param form is a string

@@ -270,3 +270,50 @@ def test_split_pruning_never_skips_on_ties_or_zero_hits(cluster):
         index_uid=metadata.index_uid, doc_mapping=MAPPER.to_dict(),
         splits=offsets))
     assert response.partial_hits == []
+
+
+def test_text_field_sort_across_splits():
+    """Sorting by a raw text fast field: device top-k by split-local
+    ordinal (dictionary is lex-sorted), collector merges the DECODED term
+    strings across splits; missing values last in both directions."""
+    from quickwit_tpu.serve import Node, NodeConfig
+    node = Node(NodeConfig(node_id="txt-node",
+                           metastore_uri="ram:///txtsort/metastore",
+                           default_index_root_uri="ram:///txtsort/indexes"),
+                storage_resolver=StorageResolver.for_test())
+    node.index_service.create_index({
+        "index_id": "txtsort",
+        "doc_mapping": {
+            "field_mappings": [
+                {"name": "host", "type": "text", "tokenizer": "raw",
+                 "fast": True},
+                {"name": "body", "type": "text"}],
+            "default_search_fields": ["body"]},
+        "indexing_settings": {"split_num_docs_target": 3}})
+    hosts = ["web-02", "db-01", "web-01", "cache-01", "db-02", None,
+             "app-01", "web-03"]
+    node.ingest("txtsort", [
+        {"host": h, "body": f"tsx doc {i}"} if h else {"body": f"tsx doc {i}"}
+        for i, h in enumerate(hosts)])
+
+    def run(order):
+        request = SearchRequest(
+            index_ids=["txtsort"],
+            query_ast=parse_query_string("tsx", ["body"]),
+            max_hits=10, sort_fields=[SortField("host", order)])
+        response = node.root_searcher.search(request)
+        return [h.sort_values[0] if h.sort_values else None
+                for h in response.hits]
+
+    present = sorted(h for h in hosts if h)
+    assert run("asc") == present + [None]
+    assert run("desc") == list(reversed(present)) + [None]
+
+    # rejections are named 400-kind errors, not crashes
+    from quickwit_tpu.search.plan import PlanError
+    with pytest.raises(Exception) as exc:
+        node.root_searcher.search(SearchRequest(
+            index_ids=["txtsort"],
+            query_ast=parse_query_string("tsx", ["body"]),
+            max_hits=2, sort_fields=[SortField("body", "asc")]))
+    assert "fast" in str(exc.value)
