@@ -158,6 +158,12 @@ class DocMapper:
             for key in doc:
                 if key not in known_roots:
                     raise DocParsingError(f"unknown field {key!r} in strict mapping")
+        if self.timestamp_field is not None and self.timestamp_field not in fields:
+            # reference parity (doc_processor.rs): every doc must carry the
+            # timestamp field — split time ranges then bound ALL docs, which
+            # the time-pruning and metadata-count paths rely on
+            raise DocParsingError(
+                f"document is missing timestamp field {self.timestamp_field!r}")
         return TypedDoc(fields=fields, source=doc if self.store_source else {})
 
     def _convert(self, fm: FieldMapping, value: Any) -> Any:

@@ -26,6 +26,7 @@ from typing import Any, Optional
 
 from ..index.reader import SplitReader
 from ..models.doc_mapper import DocMapper
+from ..query.ast import MatchAll
 from ..parallel.fanout import build_batch, execute_batch
 from ..storage.base import StorageResolver
 from .cache import LeafSearchCache, canonical_request_key
@@ -92,6 +93,14 @@ class SearchService:
             string_sort=string_sort_of(search_request, doc_mapper))
         pending: list[SplitIdAndFooter] = []
         for split in splits:
+            if self._count_from_metadata(search_request, split):
+                # pure count over the whole split: the metastore's doc count
+                # IS the answer — never open or transfer the split
+                # (reference: CanSplitDoBetter count path, leaf.rs:1361)
+                collector.add_leaf_response(LeafSearchResponse(
+                    num_hits=split.num_docs, num_attempted_splits=1,
+                    num_successful_splits=1))
+                continue
             key = canonical_request_key(split.split_id, search_request,
                                         split.time_range)
             cached = self.context.leaf_cache.get(key)
@@ -118,6 +127,29 @@ class SearchService:
         response.num_attempted_splits = len(splits)
         response.resource_stats["num_splits_skipped"] = num_skipped
         return response
+
+    @staticmethod
+    def _count_from_metadata(request: SearchRequest,
+                             split: SplitIdAndFooter) -> bool:
+        """True when this split's contribution is exactly its doc count:
+        match-all query, no hits wanted, no aggregations, and any request
+        time filter fully covers the split's own time range (sound because
+        the doc mapper requires the timestamp field on every doc, so the
+        split range bounds all of them)."""
+        if (request.max_hits != 0 or request.start_offset != 0
+                or request.aggs or not isinstance(request.query_ast, MatchAll)):
+            return False
+        if request.start_timestamp is None and request.end_timestamp is None:
+            return True
+        if split.time_range is None:
+            return False  # no bounds recorded: must evaluate
+        lo, hi = split.time_range
+        if request.start_timestamp is not None and request.start_timestamp > lo:
+            return False
+        # end_timestamp is exclusive; split ranges are inclusive
+        if request.end_timestamp is not None and request.end_timestamp <= hi:
+            return False
+        return True
 
     @staticmethod
     def _pruning_applicable(request: SearchRequest, timestamp_field) -> bool:

@@ -13,6 +13,7 @@ from quickwit_tpu.metastore import FileBackedMetastore
 from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
 from quickwit_tpu.models.index_metadata import IndexConfig, IndexMetadata, SourceConfig
 from quickwit_tpu.query import parse_query_string
+from quickwit_tpu.query.ast import MatchAll
 from quickwit_tpu.search.models import SearchRequest, SortField
 from quickwit_tpu.search.root import RootSearcher, extract_required_tags
 from quickwit_tpu.search.service import LocalSearchClient, SearcherContext, SearchService
@@ -317,3 +318,28 @@ def test_text_field_sort_across_splits():
             query_ast=parse_query_string("tsx", ["body"]),
             max_hits=2, sort_fields=[SortField("body", "asc")]))
     assert "fast" in str(exc.value)
+
+
+def test_count_from_metadata_never_opens_split(cluster, monkeypatch):
+    """Pure count (match-all, max_hits=0, no aggs): each split's answer is
+    its metastore doc count — the leaf must not open the split at all."""
+    _, services, _, root = cluster
+    # sabotage split opening: any reader access means the fast path failed
+    for service in services.values():
+        monkeypatch.setattr(
+            service.context, "reader",
+            lambda split: (_ for _ in ()).throw(
+                AssertionError("split opened on a metadata-count query")))
+    response = root.search(SearchRequest(
+        index_ids=["logs"], query_ast=MatchAll(), max_hits=0))
+    assert response.num_hits == NUM_DOCS
+    # a time filter fully covering every split also counts from metadata
+    response = root.search(SearchRequest(
+        index_ids=["logs"], query_ast=MatchAll(), max_hits=0,
+        start_timestamp=0, end_timestamp=10**18))
+    assert response.num_hits == NUM_DOCS
+    # a partial time filter must fall back to real evaluation -> sabotaged
+    failed = root.search(SearchRequest(
+        index_ids=["logs"], query_ast=MatchAll(), max_hits=0,
+        start_timestamp=(1_600_000_000 + 1) * 1_000_000, end_timestamp=10**18))
+    assert failed.num_hits < NUM_DOCS or failed.errors

@@ -97,3 +97,22 @@ def test_numeric_fields_reject_bool():
         mapper.doc_from_json({"u": True})
     with pytest.raises(DocParsingError):
         mapper.doc_from_json({"f": False})
+
+
+def test_timestamp_field_required_per_doc():
+    """Reference parity (doc_processor.rs): a doc missing the timestamp
+    field is invalid — split time ranges must bound every doc, which time
+    pruning and the metadata-count fast path rely on."""
+    import pytest
+
+    from quickwit_tpu.models.doc_mapper import DocParsingError
+    mapper = DocMapper(
+        field_mappings=[
+            FieldMapping("ts", FieldType.DATETIME, fast=True,
+                         input_formats=("unix_timestamp",)),
+            FieldMapping("body", FieldType.TEXT)],
+        timestamp_field="ts")
+    mapper.doc_from_json({"ts": 1_600_000_000, "body": "ok"})
+    with pytest.raises(DocParsingError) as exc:
+        mapper.doc_from_json({"body": "no timestamp"})
+    assert "timestamp" in str(exc.value)
