@@ -46,6 +46,7 @@ from ..query.es_dsl import EsDslParseError, es_query_to_ast
 from ..query.parser import QueryParseError, parse_query_string
 from ..search.models import (
     FetchDocsRequest, LeafSearchRequest, SearchRequest, SortField,
+    normalize_sort_fields,
 )
 from ..search.plan import PlanError
 from .node import Node
@@ -469,12 +470,34 @@ class RestServer:
                     order = spec.get("order", "asc") if isinstance(spec, dict) else spec
                     parsed.append(SortField(field_name, order))
             sort_fields = tuple(parsed)
+        search_after = None
         if payload.get("search_after"):
-            # silently ignoring it would hand clients page 1 forever; the
-            # ES marker shape (sort values + _shard_doc tiebreak) is a
-            # follow-up — use the scroll API for deep pagination meanwhile
-            raise ApiError(400, "search_after is not supported in the ES "
-                                "API yet; use the scroll API")
+            marker = payload["search_after"]
+            if not isinstance(marker, list):
+                raise ApiError(400, "search_after must be an array (a hit's "
+                                    "sort array)")
+            if payload.get("from") or params.get("from"):
+                # ES rejects the combination too; silently applying the
+                # offset after the marker would skip docs on every page
+                raise ApiError(
+                    400, "search_after cannot be combined with from")
+            # count the keys as the engine normalizes them (e.g. a _doc
+            # secondary folds into the implicit tie-break) so the marker
+            # arity matches the sort arrays our own hits emit
+            n_keys = len(normalize_sort_fields(tuple(sort_fields)))
+            tiebreak = marker[-1] if marker else None
+            if (len(marker) != n_keys + 1 or not isinstance(tiebreak, str)
+                    or "|" not in tiebreak):
+                raise ApiError(
+                    400, "search_after must be a hit's full sort array "
+                         "(sort values + the trailing shard-doc tiebreak "
+                         "emitted in hits.hits[].sort)")
+            split_id, _, doc_id = tiebreak.rpartition("|")
+            try:
+                search_after = list(marker[:n_keys]) + [split_id, int(doc_id)]
+            except ValueError:
+                raise ApiError(400, f"malformed shard-doc tiebreak "
+                                    f"{tiebreak!r}")
         track_total = payload.get("track_total_hits",
                                    params.get("track_total_hits", True))
         if isinstance(track_total, str):  # query-param form is a string
@@ -487,6 +510,7 @@ class RestServer:
             sort_fields=sort_fields,
             aggs=payload.get("aggs") or payload.get("aggregations"),
             count_hits_exact=track_total is not False,
+            search_after=search_after,
         )
 
     @staticmethod
@@ -499,8 +523,14 @@ class RestServer:
                 "_score": hit.score,
                 "_source": hit.doc,
             }
-            if hit.sort_values and hit.sort_values[0] is not None:
-                entry["sort"] = hit.sort_values
+            if hit.sort_values:
+                # trailing shard-doc tiebreak (role of ES's implicit
+                # `_shard_doc` under PIT): feeding the whole array back as
+                # `search_after` resumes exactly after this hit, ties incl.
+                # Missing sort values stay as null (ES does the same) so a
+                # page ending on a missing-value hit still yields a marker.
+                entry["sort"] = hit.sort_values + [
+                    f"{hit.split_id}|{hit.doc_id}"]
             if hit.snippets:
                 entry["highlight"] = hit.snippets
             hits.append(entry)

@@ -402,8 +402,10 @@ def test_es_two_field_sort(api):
     rows = [(h["_source"]["tenant_id"], h["_source"]["timestamp"])
             for h in result["hits"]["hits"]]
     assert rows == sorted(rows, key=lambda r: (r[0], -r[1]))
-    # both sort values surface in the ES `sort` array
-    assert len(result["hits"]["hits"][0]["sort"]) == 2
+    # both sort values surface in the ES `sort` array, plus the trailing
+    # shard-doc tiebreak used for search_after resumption
+    first_sort = result["hits"]["hits"][0]["sort"]
+    assert len(first_sort) == 3 and "|" in first_sort[2]
 
 
 def test_source_crud_and_transform(api):
@@ -487,3 +489,46 @@ def test_disabled_ingest_api_source_rejects_v1_ingest(api):
     status, result = client.request("POST", "/api/v1/togglev1/ingest",
                                     b'{"body": "x"}')
     assert status == 200 and result["num_ingested_docs"] == 1
+
+
+def test_es_search_after_pagination(api):
+    """ES search_after: feed each page's last sort array (values + trailing
+    shard-doc tiebreak) back; pages are disjoint, exhaustive, and ordered."""
+    seen = []
+    marker = None
+    for _ in range(50):
+        body = {"query": {"query_string": {"query": "shared"}}, "size": 17,
+                "sort": [{"timestamp": {"order": "desc"}}]}
+        if marker is not None:
+            body["search_after"] = marker
+        status, result = api.request(
+            "POST", "/api/v1/_elastic/hdfs-logs/_search", body)
+        assert status == 200
+        page = result["hits"]["hits"]
+        if not page:
+            break
+        seen.extend(h["_source"]["timestamp"] for h in page)
+        marker = page[-1]["sort"]
+    assert len(seen) == len(set(seen)) == 100  # disjoint + exhaustive
+    assert seen == sorted(seen, reverse=True)
+    # malformed markers are clean 400s
+    status, err = api.request("POST", "/api/v1/_elastic/hdfs-logs/_search", {
+        "size": 2, "sort": [{"timestamp": {"order": "desc"}}],
+        "search_after": [12345]})
+    assert status == 400 and "tiebreak" in err["message"]
+
+
+def test_es_search_after_guards(api):
+    """Regression: client-controlled marker abuse yields 400s, never 500s;
+    from + search_after is rejected like ES."""
+    base = {"size": 2, "sort": [{"timestamp": {"order": "desc"}}]}
+    status, err = api.request("POST", "/api/v1/_elastic/hdfs-logs/_search",
+                              {**base, "search_after": 5})
+    assert status == 400 and "array" in err["message"]
+    status, err = api.request("POST", "/api/v1/_elastic/hdfs-logs/_search",
+                              {**base, "search_after": {"a": 1}})
+    assert status == 400
+    status, err = api.request(
+        "POST", "/api/v1/_elastic/hdfs-logs/_search",
+        {**base, "from": 10, "search_after": [1, "s|1"]})
+    assert status == 400 and "from" in err["message"]
