@@ -86,7 +86,9 @@ def _search_request_from_params(index_id: str, params: dict[str, Any],
         value = params.get(name)
         return int(value) * 1_000_000 if value is not None else None
     return SearchRequest(
-        index_ids=[index_id],
+        # comma-separated lists and glob patterns both resolve at the root
+        # (reference: index id patterns on every search route)
+        index_ids=index_id.split(","),
         query_ast=ast,
         max_hits=int(params.get("max_hits", 20)),
         start_offset=int(params.get("start_offset", 0)),
@@ -391,12 +393,19 @@ class RestServer:
             raise ApiError(429, str(exc))
 
     def _default_fields(self, index_pattern: str):
-        try:
-            metadata = self.node.metastore.index_metadata(
-                index_pattern.split(",")[0].rstrip("*"))
-            return metadata.index_config.doc_mapper.default_search_fields
-        except MetastoreError:
-            return ()
+        # resolve lists/globs the same way the root searcher does, so
+        # `logs-*` picks up a real index's default_search_fields. Metastore
+        # backend failures propagate to the handler's kind mapping (a
+        # metastore outage must not read as 404 not-found). The second
+        # resolution inside root.search hits the TTL-cached metastore
+        # state, so the cost is an in-memory scan, not another fetch.
+        resolved = self.node.root_searcher._resolve_indexes(
+            index_pattern.split(","))
+        if not resolved:
+            # fail on the real problem before query parsing can mask it
+            # with a default_search_fields complaint
+            raise ApiError(404, f"no index matches {index_pattern!r}")
+        return resolved[0].index_config.doc_mapper.default_search_fields
 
     def _route_elastic(self, method: str, path: str, params: dict[str, Any],
                        body: bytes) -> tuple[int, Any]:
@@ -451,7 +460,7 @@ class RestServer:
     def _es_search_request(self, index: str, payload: dict[str, Any],
                            params: dict[str, Any]) -> SearchRequest:
         index_ids = index.split(",")
-        default_fields = self._default_fields(index_ids[0])
+        default_fields = self._default_fields(index)  # full list/pattern
         if "query" in payload:
             ast = es_query_to_ast(payload["query"], default_fields)
         elif params.get("q"):

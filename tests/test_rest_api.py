@@ -121,7 +121,7 @@ def test_search_bad_query_is_400(api):
 
 def test_search_unknown_index_404ish(api):
     status, result = api.request("GET", "/api/v1/nope/search?query=*")
-    assert status == 400  # "no index matches"
+    assert status == 404 and "no index matches" in result["message"]
 
 
 def test_splits_listing(api):
@@ -532,3 +532,21 @@ def test_es_search_after_guards(api):
         "POST", "/api/v1/_elastic/hdfs-logs/_search",
         {**base, "from": 10, "search_after": [1, "s|1"]})
     assert status == 400 and "from" in err["message"]
+
+
+def test_search_index_patterns_and_lists(api):
+    """Comma lists and glob patterns on the search route resolve like the
+    root searcher's index patterns."""
+    for iid in ("pat-a", "pat-b"):
+        api.request("POST", "/api/v1/indexes", {
+            "index_id": iid, "doc_mapping": {
+                "field_mappings": [{"name": "body", "type": "text"}],
+                "default_search_fields": ["body"]}})
+        api.request("POST", f"/api/v1/{iid}/ingest",
+                    json.dumps({"body": f"patdoc {iid}"}).encode())
+    status, result = api.request("GET", "/api/v1/pat-a,pat-b/search?query=patdoc")
+    assert status == 200 and result["num_hits"] == 2
+    status, result = api.request("GET", "/api/v1/pat-*/search?query=patdoc")
+    assert status == 200 and result["num_hits"] == 2
+    status, result = api.request("GET", "/api/v1/zzz-*/search?query=patdoc")
+    assert status == 404 and "no index matches" in result["message"]
