@@ -77,6 +77,7 @@ def load_node_config(path: Optional[str] = None,
         tls_key_path=tls.get("key_path"),
         tls_ca_path=tls.get("ca_path"),
         tls_skip_verify=bool(tls.get("skip_verify", False)),
+        gossip_enabled=bool(data.get("gossip", False)),
     )
 
 

@@ -55,6 +55,10 @@ class NodeConfig:
     tls_key_path: Optional[str] = None
     tls_ca_path: Optional[str] = None
     tls_skip_verify: bool = False
+    # UDP scuttlebutt gossip (role of chitchat): when enabled, membership
+    # disseminates over UDP on the REST port number and the REST heartbeat
+    # loop is not started. peer_seeds serve as gossip seeds unchanged.
+    gossip_enabled: bool = False
 
     @property
     def tls_enabled(self) -> bool:
@@ -527,11 +531,25 @@ class Node:
                 worker.join(timeout=4.0)
 
         self._bg_threads = []
-        for name, interval, tick in (
-                ("ingest", ingest_interval_secs, ingest_tick),
-                ("merge", merge_interval_secs, merge_tick),
-                ("janitor", janitor_interval_secs, janitor_tick),
-                ("heartbeat", heartbeat_interval_secs, heartbeat_tick)):
+        loops = [("ingest", ingest_interval_secs, ingest_tick),
+                 ("merge", merge_interval_secs, merge_tick),
+                 ("janitor", janitor_interval_secs, janitor_tick)]
+        if self.config.gossip_enabled:
+            # UDP scuttlebutt replaces the REST heartbeat loop entirely
+            from ..cluster.gossip import GossipService
+            self._gossip = GossipService(
+                self.cluster, self.config.node_id, self.config.roles,
+                rest_endpoint=f"{self.config.rest_host}:"
+                              f"{self.config.rest_port}",
+                bind_host=self.config.rest_host,
+                bind_port=self.config.rest_port,
+                seeds=self.config.peers,
+                interval_secs=min(heartbeat_interval_secs, 1.0))
+            self._gossip.start()
+        else:
+            loops.append(("heartbeat", heartbeat_interval_secs,
+                          heartbeat_tick))
+        for name, interval, tick in loops:
             thread = threading.Thread(target=loop, args=(name, interval, tick),
                                        name=f"bg-{name}", daemon=True)
             thread.start()
@@ -543,6 +561,10 @@ class Node:
         if stop is not None:
             stop.set()
             self._bg_stop = None
+        gossip = getattr(self, "_gossip", None)
+        if gossip is not None:
+            gossip.stop()
+            self._gossip = None
 
     # ------------------------------------------------------------------
     def run_janitor(self) -> dict[str, int]:
