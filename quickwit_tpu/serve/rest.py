@@ -54,6 +54,8 @@ from .serializers import leaf_response_from_dict, leaf_response_to_dict
 
 logger = logging.getLogger(__name__)
 
+_MAX_INFLATED_BYTES = 256 << 20  # gzip bodies inflate to at most 256 MiB
+
 # sources whose checkpoints guard the built-in ingest paths against replay
 INTERNAL_SOURCE_IDS = (INGEST_V2_SOURCE_ID, INGEST_API_SOURCE_ID)
 
@@ -160,7 +162,8 @@ class RestServer:
     # ------------------------------------------------------------------
     # route implementations
     def route(self, method: str, path: str, params: dict[str, Any],
-              body: bytes, client_host: str = "") -> tuple[int, Any]:
+              body: bytes, client_host: str = "",
+              content_type: str = "") -> tuple[int, Any]:
         node = self.node
         if path == "/health/livez":
             return 200, True
@@ -306,8 +309,18 @@ class RestServer:
 
         # --- otlp / jaeger --------------------------------------------
         if path == "/api/v1/otlp/v1/logs" and method == "POST":
+            if "protobuf" in content_type:  # binary OTLP/HTTP (the default
+                # encoding of real OTel collectors/SDKs)
+                from .otlp_proto import decode_logs_request
+                node.otel.ingest_logs(decode_logs_request(body))
+                # empty ExportLogsServiceResponse (all fields default)
+                return 200, ("__raw__", b"", "application/x-protobuf")
             return 200, node.otel.ingest_logs(json.loads(body))
         if path == "/api/v1/otlp/v1/traces" and method == "POST":
+            if "protobuf" in content_type:
+                from .otlp_proto import decode_traces_request
+                node.otel.ingest_traces(decode_traces_request(body))
+                return 200, ("__raw__", b"", "application/x-protobuf")
             return 200, node.otel.ingest_traces(json.loads(body))
         if path == "/api/v1/jaeger/api/services":
             return 200, {"data": node.otel.services(), "total": 0}
@@ -642,8 +655,24 @@ def _make_handler(server: RestServer):
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
             try:
-                status, payload = server.route(method, parsed.path, params, body,
-                                               client_host=self.client_address[0])
+                if body and "gzip" in (self.headers.get("Content-Encoding")
+                                       or ""):
+                    # OTel collectors' otlphttp exporter gzips by default;
+                    # ES bulk clients too. Bounded against decompression
+                    # bombs.
+                    import zlib
+                    try:
+                        inflater = zlib.decompressobj(
+                            wbits=zlib.MAX_WBITS | 16)
+                        body = inflater.decompress(body, _MAX_INFLATED_BYTES)
+                        if inflater.unconsumed_tail:
+                            raise ApiError(413, "decompressed body too large")
+                    except zlib.error as exc:
+                        raise ApiError(400, f"bad gzip body: {exc}")
+                status, payload = server.route(
+                    method, parsed.path, params, body,
+                    client_host=self.client_address[0],
+                    content_type=self.headers.get("Content-Type", ""))
             except ApiError as exc:
                 status, payload = exc.status, {"message": str(exc)}
             except (QueryParseError, EsDslParseError, AggParseError,
@@ -658,7 +687,11 @@ def _make_handler(server: RestServer):
             except Exception as exc:  # noqa: BLE001
                 logger.exception("internal error on %s %s", method, parsed.path)
                 status, payload = 500, {"message": f"internal error: {exc}"}
-            if (isinstance(payload, tuple) and len(payload) == 2
+            if (isinstance(payload, tuple) and len(payload) == 3
+                    and payload[0] == "__raw__"):
+                data = payload[1]
+                content_type = payload[2]
+            elif (isinstance(payload, tuple) and len(payload) == 2
                     and payload[0] == "__html__"):
                 data = payload[1].encode()
                 content_type = "text/html; charset=utf-8"
