@@ -178,6 +178,46 @@ class OtelService:
             sort_fields=(SortField("span_start_timestamp", "asc"),)))
         return [h.doc for h in response.hits]
 
+    def jaeger_trace(self, trace_id: str,
+                     spans: list[dict[str, Any]]) -> dict[str, Any]:
+        """Span docs → the Jaeger UI's trace JSON (jaeger-ui expects
+        operationName/startTime-micros/duration/processes — the reference's
+        jaeger service emits the same projection, jaeger_api/mod.rs)."""
+        processes: dict[str, dict[str, Any]] = {}
+        process_of: dict[str, str] = {}
+        out_spans = []
+        for doc in spans:
+            service = doc.get("service_name", "unknown_service")
+            pid = process_of.get(service)
+            if pid is None:
+                pid = process_of[service] = f"p{len(process_of) + 1}"
+                processes[pid] = {"serviceName": service, "tags": []}
+            tags = [{"key": k, "type": "string", "value": str(v)}
+                    for k, v in (doc.get("attributes") or {}).items()]
+            status = doc.get("span_status", "unset")
+            if status == "error":
+                tags.append({"key": "error", "type": "bool", "value": "true"})
+            span = {
+                "traceID": doc.get("trace_id", trace_id),
+                "spanID": doc.get("span_id", ""),
+                "operationName": doc.get("span_name", ""),
+                "startTime": int(float(doc.get("span_start_timestamp", 0))
+                                 * 1_000_000),
+                "duration": int(doc.get("span_duration_micros", 0)),
+                "processID": pid,
+                "tags": tags,
+                "references": [],
+                "logs": [],
+            }
+            parent = doc.get("parent_span_id")
+            if parent:
+                span["references"] = [{"refType": "CHILD_OF",
+                                       "traceID": span["traceID"],
+                                       "spanID": parent}]
+            out_spans.append(span)
+        return {"traceID": trace_id, "spans": out_spans,
+                "processes": processes, "warnings": None}
+
     def find_traces(self, service: Optional[str] = None,
                     operation: Optional[str] = None,
                     min_duration_micros: Optional[int] = None,

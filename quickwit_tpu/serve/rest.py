@@ -332,7 +332,7 @@ class RestServer:
             spans = node.otel.get_trace(m.group(1))
             if not spans:
                 raise ApiError(404, f"trace {m.group(1)!r} not found")
-            return 200, {"data": [{"traceID": m.group(1), "spans": spans}]}
+            return 200, {"data": [node.otel.jaeger_trace(m.group(1), spans)]}
         if path == "/api/v1/jaeger/api/traces":
             trace_ids = node.otel.find_traces(
                 service=params.get("service"),
@@ -340,9 +340,9 @@ class RestServer:
                 min_duration_micros=int(params["minDuration"])
                 if params.get("minDuration") else None,
                 limit=int(params.get("limit", 20)))
-            return 200, {"data": [{"traceID": t,
-                                   "spans": node.otel.get_trace(t)}
-                                  for t in trace_ids]}
+            return 200, {"data": [
+                node.otel.jaeger_trace(t, node.otel.get_trace(t))
+                for t in trace_ids]}
 
         # --- scroll / list apis ---------------------------------------
         if path == "/api/v1/scroll":
