@@ -50,9 +50,14 @@ class GossipService:
     def __init__(self, cluster: Cluster, node_id: str, roles: tuple[str, ...],
                  rest_endpoint: str, bind_host: str, bind_port: int,
                  seeds: tuple[str, ...] = (), interval_secs: float = 1.0,
-                 fanout: int = 3):
+                 fanout: int = 3, cluster_id: str = "quickwit-tpu"):
         self.cluster = cluster
         self.node_id = node_id
+        # chitchat embeds the cluster_id in every message and rejects
+        # mismatches (`quickwit-cluster/src/cluster.rs:61`): without it a
+        # spoofed datagram or a second cluster sharing seeds could inject
+        # members the root searcher would fan leaf requests out to.
+        self.cluster_id = cluster_id
         self.interval_secs = interval_secs
         self.fanout = fanout
         self.seeds = tuple(seeds)
@@ -172,6 +177,7 @@ class GossipService:
     # --- protocol ----------------------------------------------------------
     def _send(self, message: dict, addr: tuple[str, int]) -> None:
         try:
+            message = {"cluster_id": self.cluster_id, **message}
             payload = json.dumps(message).encode()
             if len(payload) <= _MAX_DATAGRAM:
                 self._sock.sendto(payload, addr)
@@ -203,6 +209,10 @@ class GossipService:
                 continue
             try:
                 message = json.loads(payload)
+                if message.get("cluster_id") != self.cluster_id:
+                    logger.debug("dropping gossip datagram from %s: "
+                                 "cluster_id mismatch", addr)
+                    continue
                 kind = message.get("kind")
                 digest = dict(message.get("digest") or {})
                 if kind == "syn":

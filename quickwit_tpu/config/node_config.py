@@ -62,6 +62,7 @@ def load_node_config(path: Optional[str] = None,
     tls = rest.get("tls") or {}  # bare "tls:" key parses as None
     return NodeConfig(
         node_id=str(pick("QW_NODE_ID", "node_id", "node-0")),
+        cluster_id=str(pick("QW_CLUSTER_ID", "cluster_id", "quickwit-tpu")),
         roles=roles,
         metastore_uri=str(pick("QW_METASTORE_URI", "metastore_uri",
                                "file:///tmp/quickwit_tpu/metastore")),

@@ -46,8 +46,30 @@ class RecordLog:
         # count records of the last segment to find next_position; earlier
         # segments' record counts derive from their successors' first position
         last_first, last_path = self._segments[-1]
-        count = sum(1 for _ in self._iter_segment(last_path))
+        count, consumed = self._scan_segment(last_path)
+        # drop any torn tail write now: appends reopen this file in 'ab'
+        # mode, and new records written after torn bytes would be misframed
+        # by the stale partial header on replay
+        if consumed < os.path.getsize(last_path):
+            with open(last_path, "r+b") as f:
+                f.truncate(consumed)
         self.next_position = last_first + count
+
+    @staticmethod
+    def _scan_segment(path: str) -> tuple[int, int]:
+        """(record_count, byte_offset_after_last_complete_record)."""
+        count, consumed = 0, 0
+        with open(path, "rb") as f:
+            while True:
+                header = f.read(_LEN.size)
+                if len(header) < _LEN.size:
+                    return count, consumed
+                (length,) = _LEN.unpack(header)
+                payload = f.read(length)
+                if len(payload) < length:
+                    return count, consumed
+                count += 1
+                consumed += _LEN.size + length
 
     # --- append ------------------------------------------------------------
     def append(self, payload: bytes) -> int:
@@ -90,7 +112,11 @@ class RecordLog:
         if self._active_file is not None:
             self._active_file.close()
         path = os.path.join(self.directory, f"wal-{self.next_position:020d}.seg")
-        self._segments.append((self.next_position, path))
+        # a crash between a previous _roll() and the first append leaves an
+        # empty last segment already registered under this path; re-registering
+        # it would make read_from iterate the segment twice
+        if not (self._segments and self._segments[-1][1] == path):
+            self._segments.append((self.next_position, path))
         self._active_file = open(path, "ab")
         self._active_size = os.path.getsize(path)
 
@@ -119,12 +145,17 @@ class RecordLog:
             if next_first is not None and next_first <= position:
                 continue
             pos = first
-            for payload in self._iter_segment(path):
-                if pos >= position:
-                    out.append((pos, payload))
-                    if len(out) >= max_records:
-                        return out
-                pos += 1
+            try:
+                for payload in self._iter_segment(path):
+                    if pos >= position:
+                        out.append((pos, payload))
+                        if len(out) >= max_records:
+                            return out
+                    pos += 1
+            except FileNotFoundError:
+                # concurrent truncate() unlinked this segment; its records
+                # were all below the published checkpoint anyway
+                continue
         return out
 
     # --- truncate ----------------------------------------------------------

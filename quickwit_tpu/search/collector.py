@@ -404,6 +404,16 @@ def _finalize_bucket_map(bucket_map: dict, info: dict[str, Any],
             lo = min(lo, (bounds[0] // interval) * interval)
             hi = max(hi, (bounds[1] // interval) * interval)
         num = int(round((hi - lo) / interval)) + 1
+        # leaf planning caps per-split ranges, but the merged range across
+        # splits/nodes with disjoint time ranges can be far wider — apply
+        # the AggregationLimitsGuard cap here too, like the reference does
+        # at every merge level
+        from .plan import MAX_BUCKETS
+        if num > MAX_BUCKETS:
+            raise ValueError(
+                f"aggregation would materialize {num} buckets at merge "
+                f"(max {MAX_BUCKETS}); raise the interval or set "
+                f"min_doc_count>=1")
         keys = [lo + i * interval for i in range(num)]
     buckets = []
     for key in keys:

@@ -12,6 +12,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -38,6 +39,7 @@ ALL_SERVICES = ("searcher", "indexer", "metastore", "janitor", "control_plane")
 @dataclass
 class NodeConfig:
     node_id: str = "node-0"
+    cluster_id: str = "quickwit-tpu"
     roles: tuple[str, ...] = ALL_SERVICES
     metastore_uri: str = "ram:///qw/metastore"
     default_index_root_uri: str = "ram:///qw/indexes"
@@ -106,7 +108,10 @@ class IndexService:
                 period_seconds=_parse_period(retention["period"]),
                 schedule=retention.get("schedule", "hourly"))
         metadata = IndexMetadata(
-            index_uid=f"{index_id}:{int(time.time()) % 100000:05d}",
+            # ULID-style unique incarnation (reference uses a ULID suffix):
+            # wall-clock-derived values collide on delete+recreate within
+            # the same second, defeating uid-based conflict detection.
+            index_uid=f"{index_id}:{uuid.uuid4().hex[:13]}",
             index_config=config,
             sources={INGEST_API_SOURCE_ID: SourceConfig(INGEST_API_SOURCE_ID, "vec")},
         )
@@ -544,7 +549,8 @@ class Node:
                 bind_host=self.config.rest_host,
                 bind_port=self.config.rest_port,
                 seeds=self.config.peers,
-                interval_secs=min(heartbeat_interval_secs, 1.0))
+                interval_secs=min(heartbeat_interval_secs, 1.0),
+                cluster_id=self.config.cluster_id)
             self._gossip.start()
         else:
             loops.append(("heartbeat", heartbeat_interval_secs,

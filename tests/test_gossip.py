@@ -132,3 +132,26 @@ def test_gossip_restarted_node_rejoins_immediately():
             b2.stop()
     finally:
         a.stop()
+
+
+def test_gossip_rejects_cluster_id_mismatch():
+    """A datagram from a different cluster_id must not inject members
+    (reference: chitchat embeds cluster_id and rejects mismatches)."""
+    ca, a = make_node("ma")
+    cluster_b = Cluster("mb", ("searcher",), rest_endpoint="127.0.0.1:0",
+                        dead_after_secs=1.0)
+    b = GossipService(cluster_b, "mb", ("searcher",),
+                      rest_endpoint="127.0.0.1:0",
+                      bind_host="127.0.0.1", bind_port=0,
+                      seeds=(f"127.0.0.1:{a.port}",), interval_secs=0.05,
+                      cluster_id="other-cluster")
+    a.start()
+    b.start()
+    try:
+        assert not wait_until(
+            lambda: any(m.node_id == "mb" for m in ca.members()),
+            timeout=1.0)
+        assert not any(m.node_id == "ma" for m in cluster_b.members())
+    finally:
+        a.stop()
+        b.stop()

@@ -217,3 +217,63 @@ def test_background_services_drain_wal(tmp_path):
         assert node.ingester.list_shards(uid)[0].publish_position == 25
     finally:
         node.stop_background_services()
+
+
+def test_record_log_empty_segment_crash_no_duplicate(tmp_path, monkeypatch):
+    """Crash between _roll() and first append leaves an empty last segment;
+    restart + roll must not register the same path twice (ADVICE fix)."""
+    from quickwit_tpu.ingest.wal import RecordLog
+    log = RecordLog(str(tmp_path / "wal"))
+    log.append(b"r0")
+    # simulate crash right after a roll created the next (empty) segment
+    log._roll()
+    log.close()
+
+    log2 = RecordLog(str(tmp_path / "wal"))
+    log2.append(b"r1")
+    paths = [p for _, p in log2._segments]
+    assert len(paths) == len(set(paths)), f"duplicate segment: {paths}"
+    records = log2.read_from(0)
+    assert [payload for _, payload in records] == [b"r0", b"r1"]
+    log2.close()
+
+
+def test_record_log_read_survives_concurrent_truncate(tmp_path, monkeypatch):
+    """read_from must skip segments unlinked by a concurrent truncate()
+    instead of raising FileNotFoundError into the fetch path (ADVICE fix)."""
+    import os
+    from quickwit_tpu.ingest.wal import RecordLog
+    monkeypatch.setattr("quickwit_tpu.ingest.wal._SEGMENT_MAX_BYTES", 8)
+    log = RecordLog(str(tmp_path / "wal"), fsync=False)
+    for i in range(6):
+        log.append(f"rec-{i}".encode())
+    assert len(log._segments) > 2
+    # emulate the race: reader snapshotted segments, then truncate unlinks
+    segments = list(log._segments)
+    os.unlink(segments[0][1])
+    log._segments.pop(0)
+    records = log.read_from(0)
+    assert [p for _, p in records] == [f"rec-{i}".encode() for i in range(1, 6)]
+    log.close()
+
+
+def test_record_log_torn_tail_truncated_on_recovery(tmp_path):
+    """A torn (partial) tail write must be truncated at recovery so new
+    appends to the reopened segment are not misframed by stale bytes."""
+    from quickwit_tpu.ingest.wal import RecordLog, _LEN
+    log = RecordLog(str(tmp_path / "wal"), fsync=False)
+    log.append(b"good")
+    path = log._segments[-1][1]
+    log.close()
+    # simulate crash mid-write of the second record: header says 100 bytes,
+    # only 3 arrive
+    with open(path, "ab") as f:
+        f.write(_LEN.pack(100) + b"par")
+
+    log2 = RecordLog(str(tmp_path / "wal"), fsync=False)
+    assert log2.next_position == 1
+    pos = log2.append(b"after-crash")
+    assert pos == 1
+    records = log2.read_from(0)
+    assert [p for _, p in records] == [b"good", b"after-crash"]
+    log2.close()

@@ -552,3 +552,19 @@ def test_percentiles_empty_bucket_yields_null(reader):
     out = _finalize_metric(acc)
     assert out["values"]["50"] is None and out["values"]["95"] is None
     _json.dumps(out)  # must serialize under strict JSON
+
+
+def test_root_finalize_caps_materialized_empty_buckets():
+    """Merged histograms across disjoint-range splits must not materialize an
+    unbounded empty-bucket list at min_doc_count=0 (ADVICE fix): the
+    AggregationLimitsGuard cap applies at root finalization too."""
+    import pytest
+    from quickwit_tpu.search.collector import _finalize_bucket_map
+
+    # two observed keys 10^10 apart at interval=1 → ~10^10 empty buckets
+    bucket_map = {0: {"doc_count": 3, "metrics": {}},
+                  10_000_000_000: {"doc_count": 5, "metrics": {}}}
+    info = {"kind": "histogram", "interval": 1, "min_doc_count": 0,
+            "name": "h"}
+    with pytest.raises(ValueError, match="buckets"):
+        _finalize_bucket_map(bucket_map, info)
