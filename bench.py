@@ -10,19 +10,25 @@ Latency includes the full leaf path after warmup: plan lowering (host),
 cached device arrays, jitted kernel execution, and the single batched
 device→host readback of hits + agg states.
 
-`vs_baseline`: the reference's own headline number for this setup is
-"sub-second search from object storage" (docs/overview/index.md:9; no
-hard latency tables are published in-repo — BASELINE.md). vs_baseline is
-therefore reported as 1000ms / p50_ms: how many times faster than the
-reference's 1-second headline bound. The measured CPU-tantivy comparison
-(north star: ≥8x) requires the reference binary, which this image cannot
-build (no Rust toolchain) — see BASELINE.md.
+`vs_baseline`: when the TPU is reachable, this is the MEASURED ratio
+cpu_p50 / tpu_p50 on identical inputs — this package's own CPU execution
+of the same jitted leaf program (the honest north-star denominator per
+BASELINE.json; the reference tantivy binary cannot be built here — no
+Rust toolchain — see BASELINE.md). On cpu-fallback the ratio degrades to
+1000ms / p50 against the reference's "sub-second" headline bound
+(docs/overview/index.md:9) and the metric label says so.
+
+Device-init robustness: the axon tunnel can wedge indefinitely inside
+native code (in-process watchdogs never fire). The probe runs in killable
+subprocesses: several short-deadline attempts with backoff rather than
+one long gamble, surfacing each failure mode on stderr.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -30,31 +36,52 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 NUM_DOCS = int(os.environ.get("BENCH_NUM_DOCS", 10_000_000))
 ITERATIONS = int(os.environ.get("BENCH_ITERS", 30))
+# total budget for device discovery, split into short killable probes
 DEVICE_TIMEOUT_SECS = int(os.environ.get("BENCH_DEVICE_TIMEOUT", 180))
+PROBE_DEADLINE_SECS = int(os.environ.get("BENCH_PROBE_DEADLINE", 60))
+PROBE_BACKOFF_SECS = float(os.environ.get("BENCH_PROBE_BACKOFF", 5))
 
 
-def _ensure_device_or_fall_back() -> str:
-    """TPU device init can hang indefinitely if the accelerator tunnel is
-    wedged (and blocks in native code, so in-process watchdogs don't fire);
-    probe it in a killable subprocess and fall back to CPU so the benchmark
-    always emits its JSON line."""
-    import subprocess
-    if os.environ.get("QW_JAX_PLATFORM"):
-        return os.environ["QW_JAX_PLATFORM"]
+def _probe_device_once(deadline: float) -> "str | None":
+    """One killable-subprocess device probe; returns platform or None."""
     try:
         probe = subprocess.run(
             [sys.executable, "-c",
              "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, timeout=DEVICE_TIMEOUT_SECS)
-        if probe.returncode == 0:
-            platform = probe.stdout.decode().strip().splitlines()[-1]
-            print(f"# device probe: {platform}", file=sys.stderr)
-            return platform
-        print(f"# device probe failed: {probe.stderr.decode()[-200:]}",
-              file=sys.stderr)
+            capture_output=True, timeout=deadline)
     except subprocess.TimeoutExpired:
-        print(f"# device init exceeded {DEVICE_TIMEOUT_SECS}s; "
-              "falling back to CPU", file=sys.stderr)
+        print(f"# device probe: no response within {deadline:.0f}s "
+              "(tunnel wedged or still initializing)", file=sys.stderr)
+        return None
+    if probe.returncode == 0:
+        return probe.stdout.decode().strip().splitlines()[-1]
+    print(f"# device probe failed rc={probe.returncode}: "
+          f"{probe.stderr.decode()[-200:]}", file=sys.stderr)
+    return None
+
+
+def _ensure_device_or_fall_back() -> str:
+    """Repeated short-deadline probes with backoff across the total budget;
+    CPU fallback (via re-exec so the platform is set before backend init)
+    only after every attempt failed."""
+    if os.environ.get("QW_JAX_PLATFORM"):
+        return os.environ["QW_JAX_PLATFORM"]
+    budget_end = time.monotonic() + DEVICE_TIMEOUT_SECS
+    attempt = 0
+    while time.monotonic() < budget_end:
+        attempt += 1
+        remaining = budget_end - time.monotonic()
+        deadline = min(PROBE_DEADLINE_SECS, max(remaining, 5.0))
+        platform = _probe_device_once(deadline)
+        if platform is not None:
+            print(f"# device probe: {platform} (attempt {attempt})",
+                  file=sys.stderr)
+            return platform
+        if time.monotonic() + PROBE_BACKOFF_SECS >= budget_end:
+            break
+        time.sleep(PROBE_BACKOFF_SECS)
+    print(f"# device init failed after {attempt} probe(s) within "
+          f"{DEVICE_TIMEOUT_SECS}s; falling back to CPU", file=sys.stderr)
     os.execve(sys.executable,
               [sys.executable, os.path.abspath(__file__)],
               {**os.environ, "QW_JAX_PLATFORM": "cpu",
@@ -62,45 +89,94 @@ def _ensure_device_or_fall_back() -> str:
     return "unreachable"
 
 
-def main() -> None:
-    platform = _ensure_device_or_fall_back()
+def _measure(num_docs: int, iterations: int) -> dict:
     from __graft_entry__ import _flagship_request, _reader_for
     from quickwit_tpu.index.synthetic import HDFS_MAPPER
     from quickwit_tpu.search.leaf import leaf_search_single_split
 
     t0 = time.monotonic()
-    reader = _reader_for(num_docs=NUM_DOCS, seed=7)
+    reader = _reader_for(num_docs=num_docs, seed=7)
     gen_s = time.monotonic() - t0
 
-    # the flagship workload definition is shared with __graft_entry__.entry()
     request = _flagship_request()
 
-    # warmup: compile + device transfer
     t0 = time.monotonic()
     resp = leaf_search_single_split(request, HDFS_MAPPER, reader, "bench")
     warm_s = time.monotonic() - t0
     assert resp.num_hits > 0
 
     latencies = []
-    for _ in range(ITERATIONS):
+    for _ in range(iterations):
         t0 = time.monotonic()
         resp = leaf_search_single_split(request, HDFS_MAPPER, reader, "bench")
         latencies.append(time.monotonic() - t0)
     latencies.sort()
-    p50_ms = latencies[len(latencies) // 2] * 1000.0
-    p90_ms = latencies[int(len(latencies) * 0.9)] * 1000.0
+    return {
+        "p50_ms": latencies[len(latencies) // 2] * 1000.0,
+        "p90_ms": latencies[int(len(latencies) * 0.9)] * 1000.0,
+        "gen_s": gen_s,
+        "warm_s": warm_s,
+        "num_hits": int(resp.num_hits),
+    }
 
-    print(f"# corpus={NUM_DOCS} docs, gen={gen_s:.1f}s, "
-          f"warmup(compile+transfer)={warm_s:.1f}s, "
-          f"p50={p50_ms:.2f}ms p90={p90_ms:.2f}ms, "
-          f"num_hits={resp.num_hits}", file=sys.stderr)
+
+def _cpu_reference_p50() -> "float | None":
+    """Measure the same workload on this package's CPU path in a subprocess
+    (the platform is fixed at backend init, so it cannot run in-process)."""
+    iters = max(5, ITERATIONS // 3)
+    try:
+        run = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env={**os.environ, "QW_JAX_PLATFORM": "cpu",
+                 "BENCH_CHILD_JSON": "1", "BENCH_ITERS": str(iters)},
+            capture_output=True, timeout=1200)
+    except subprocess.TimeoutExpired:
+        print("# cpu comparison run timed out; omitting measured ratio",
+              file=sys.stderr)
+        return None
+    for line in run.stdout.decode().splitlines():
+        if line.startswith("{"):
+            return json.loads(line)["p50_ms"]
+    print(f"# cpu comparison run failed rc={run.returncode}: "
+          f"{run.stderr.decode()[-300:]}", file=sys.stderr)
+    return None
+
+
+def main() -> None:
+    child_mode = bool(os.environ.get("BENCH_CHILD_JSON"))
+    platform = _ensure_device_or_fall_back()
+    stats = _measure(NUM_DOCS, ITERATIONS)
+    p50_ms = stats["p50_ms"]
+
+    print(f"# platform={platform} corpus={NUM_DOCS} docs, "
+          f"gen={stats['gen_s']:.1f}s, "
+          f"warmup(compile+transfer)={stats['warm_s']:.1f}s, "
+          f"p50={p50_ms:.2f}ms p90={stats['p90_ms']:.2f}ms, "
+          f"num_hits={stats['num_hits']}", file=sys.stderr)
+    if child_mode:
+        # parent bench parses this; not the driver-facing line
+        print(json.dumps({"p50_ms": round(p50_ms, 2)}))
+        return
+
     note = os.environ.get("BENCH_PLATFORM_NOTE", platform)
+    cpu_p50 = None
+    if platform not in ("cpu", "cpu-fallback") and \
+            not os.environ.get("BENCH_SKIP_CPU_COMPARE"):
+        cpu_p50 = _cpu_reference_p50()
+    if cpu_p50 is not None:
+        vs_baseline = round(cpu_p50 / p50_ms, 2)
+        note = f"{note}, measured own-cpu p50 {cpu_p50:.0f}ms"
+    else:
+        # honest degradation: ratio vs the reference's 1s headline bound,
+        # labeled as such (not a measured baseline)
+        vs_baseline = round(1000.0 / p50_ms, 2)
+        note = f"{note}, vs 1s headline bound"
     print(json.dumps({
         "metric": "hdfs-logs leaf_search p50 (term+date_histogram+terms, "
                   f"{NUM_DOCS/1e6:g}M docs, 1 chip, {note})",
         "value": round(p50_ms, 2),
         "unit": "ms",
-        "vs_baseline": round(1000.0 / p50_ms, 2),
+        "vs_baseline": vs_baseline,
     }))
 
 

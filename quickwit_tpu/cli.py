@@ -34,12 +34,8 @@ from .storage.ram import RamStorage
 
 
 def _resolver() -> StorageResolver:
-    resolver = StorageResolver()
-    resolver.register(Protocol.FILE, LocalFileStorage)
-    from .common.uri import Uri
-    ram_root = RamStorage(Uri.parse("ram:///"))
-    resolver.register(Protocol.RAM, lambda uri: ram_root.subdir(uri))
-    return resolver
+    # file + ram + env-configured S3 (hedged), one shared registry
+    return StorageResolver.default()
 
 
 def _embedded_node(args) -> Node:

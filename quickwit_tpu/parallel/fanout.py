@@ -297,24 +297,12 @@ def _batch_executor(batch: SplitBatch, k: int, mesh: Optional[Mesh]):
     return jax.jit(fn, in_shardings=(arrays_sh, scalars_sh, nd_sh))
 
 
-def execute_batch(batch: SplitBatch, request: SearchRequest,
-                  mesh: Optional[Mesh] = None) -> LeafSearchResponse:
-    """Run the batch (optionally mesh-sharded) and emit one merged
-    LeafSearchResponse covering all splits."""
-    # k=0 (count/agg-only): per-split executors skip keying/top-k and the
-    # batch merge skips the cross-split top_k
-    k = min(request.start_offset + request.max_hits, batch.num_docs_padded)
-    # Mesh is hashable; id() would go stale if a dead mesh's address is reused
-    key = (batch.template.signature(k), batch.n_splits,
-           batch.num_docs_padded, mesh)
-    ex = _BATCH_JIT_CACHE.get(key)
-    if ex is None:
-        ex = _batch_executor(batch, k, mesh)
-        _BATCH_JIT_CACHE[key] = ex
-
-    # one batched transfer, cached on the batch for repeat queries —
-    # keyed by mesh: arrays committed for one sharding must not feed an
-    # executor compiled for another
+def stage_device_inputs(batch: SplitBatch, mesh: Optional[Mesh] = None):
+    """Start the batch's host→device transfer (async under JAX dispatch)
+    and cache the device arrays on the batch for repeat queries — keyed by
+    mesh: arrays committed for one sharding must not feed an executor
+    compiled for another. Callable from a prefetch thread so the transfer
+    overlaps the previous batch's kernel execution."""
     cache = getattr(batch, "_device_inputs", None)
     if cache is None:
         cache = batch._device_inputs = {}
@@ -332,7 +320,25 @@ def execute_batch(batch: SplitBatch, request: SearchRequest,
             scalars = tuple(moved[len(batch.arrays):-1])
             nd = moved[-1]
         dev = cache[mesh] = (arrays, scalars, nd)
-    arrays, scalars, nd = dev
+    return dev
+
+
+def execute_batch(batch: SplitBatch, request: SearchRequest,
+                  mesh: Optional[Mesh] = None) -> LeafSearchResponse:
+    """Run the batch (optionally mesh-sharded) and emit one merged
+    LeafSearchResponse covering all splits."""
+    # k=0 (count/agg-only): per-split executors skip keying/top-k and the
+    # batch merge skips the cross-split top_k
+    k = min(request.start_offset + request.max_hits, batch.num_docs_padded)
+    # Mesh is hashable; id() would go stale if a dead mesh's address is reused
+    key = (batch.template.signature(k), batch.n_splits,
+           batch.num_docs_padded, mesh)
+    ex = _BATCH_JIT_CACHE.get(key)
+    if ex is None:
+        ex = _batch_executor(batch, k, mesh)
+        _BATCH_JIT_CACHE[key] = ex
+
+    arrays, scalars, nd = stage_device_inputs(batch, mesh)
     out = ex(arrays, scalars, nd)
     top_vals, split_idx, doc_ids, scores, total, merged_aggs = jax.device_get(out)
 

@@ -69,20 +69,22 @@ def warmup_device_arrays(reader: SplitReader, plan) -> list:
     return [cache[key] for key in plan.array_keys]
 
 
-def leaf_search_single_split(
+def prepare_single_split(
     request: SearchRequest,
     doc_mapper: DocMapper,
     reader: SplitReader,
     split_id: str,
-) -> LeafSearchResponse:
-    t0 = time.monotonic()
+) -> tuple[Any, list]:
+    """Stage 1 of leaf search — everything up to (and including) starting
+    the host→device transfer: storage byte-range IO via the reader, plan
+    lowering, and the async `device_put`. Runs on a prefetch thread so the
+    next split batch's IO overlaps the current batch's kernel execution
+    (SURVEY hard-part #4: warmup/compute pipelining)."""
     agg_specs = parse_aggs(request.aggs) if request.aggs else []
     sort = request.sort_fields[0] if request.sort_fields else None
     sort_field = sort.field if sort else "_score"
     sort_order = sort.order if sort else "desc"
     sort2 = request.sort_fields[1] if len(request.sort_fields) > 1 else None
-    # k=0 (count/agg-only): the executor skips keying and top-k entirely
-    k = request.start_offset + request.max_hits
 
     plan = lower_request(
         request.query_ast, doc_mapper, reader, agg_specs,
@@ -94,7 +96,40 @@ def leaf_search_single_split(
         search_after=search_after_marker(request, split_id, sort_field,
                                          sort_order, sort2),
     )
+    # device_put is async: the transfer proceeds while the caller executes
+    # the previous batch's kernel
     device_arrays = warmup_device_arrays(reader, plan)
+    return plan, device_arrays
+
+
+def leaf_search_single_split(
+    request: SearchRequest,
+    doc_mapper: DocMapper,
+    reader: SplitReader,
+    split_id: str,
+) -> LeafSearchResponse:
+    plan, device_arrays = prepare_single_split(request, doc_mapper, reader,
+                                               split_id)
+    return execute_prepared_split(request, doc_mapper, reader, split_id,
+                                  plan, device_arrays)
+
+
+def execute_prepared_split(
+    request: SearchRequest,
+    doc_mapper: DocMapper,
+    reader: SplitReader,
+    split_id: str,
+    plan: Any,
+    device_arrays: list,
+) -> LeafSearchResponse:
+    """Stage 2: jitted kernel execution + the single batched readback."""
+    t0 = time.monotonic()
+    sort = request.sort_fields[0] if request.sort_fields else None
+    sort_field = sort.field if sort else "_score"
+    sort_order = sort.order if sort else "desc"
+    sort2 = request.sort_fields[1] if len(request.sort_fields) > 1 else None
+    # k=0 (count/agg-only): the executor skips keying and top-k entirely
+    k = request.start_offset + request.max_hits
     result = execute_plan(plan, k, device_arrays)
 
     count = result["count"]

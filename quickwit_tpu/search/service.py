@@ -27,11 +27,12 @@ from typing import Any, Optional
 from ..index.reader import SplitReader
 from ..models.doc_mapper import DocMapper
 from ..query.ast import MatchAll
-from ..parallel.fanout import build_batch, execute_batch
+from ..parallel.fanout import build_batch, execute_batch, stage_device_inputs
 from ..storage.base import StorageResolver
 from .cache import LeafSearchCache, canonical_request_key
 from .collector import IncrementalCollector
-from .leaf import leaf_search_single_split
+from .leaf import (execute_prepared_split, leaf_search_single_split,
+                   prepare_single_split)
 from .models import (
     FetchDocsRequest, LeafSearchRequest, LeafSearchResponse, SearchRequest,
     SplitIdAndFooter, SplitSearchError, string_sort_of,
@@ -44,13 +45,28 @@ class SearcherContext:
     def __init__(self, storage_resolver: Optional[StorageResolver] = None,
                  max_open_splits: int = 128,
                  leaf_cache_bytes: int = 64 << 20,
-                 batch_size: int = 8):
+                 batch_size: int = 8,
+                 prefetch: bool = True):
         self.storage_resolver = storage_resolver or StorageResolver.default()
         self.leaf_cache = LeafSearchCache(leaf_cache_bytes)
         self.batch_size = batch_size
+        # warmup/compute pipelining (SURVEY hard-part #4): one prefetch
+        # worker stages batch N+1's storage IO + H2D transfer while batch
+        # N executes on device. Single worker = classic double buffering;
+        # bounds both memory (at most one staged batch) and storage load.
+        self.prefetch = prefetch
+        self._prefetch_pool = None
         self._readers: OrderedDict[str, SplitReader] = OrderedDict()
         self._max_open_splits = max_open_splits
         self._lock = threading.Lock()
+
+    def prefetch_pool(self):
+        from concurrent.futures import ThreadPoolExecutor
+        with self._lock:
+            if self._prefetch_pool is None:
+                self._prefetch_pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="leaf-prefetch")
+            return self._prefetch_pool
 
     def reader(self, split: SplitIdAndFooter) -> SplitReader:
         """LRU-cached split readers: keeps footer, term dict, byte-range and
@@ -112,16 +128,38 @@ class SearchService:
         num_skipped = 0
         prunable = self._pruning_applicable(search_request,
                                             doc_mapper.timestamp_field)
-        for begin in range(0, len(pending), self.context.batch_size):
+        batch_size = self.context.batch_size
+        groups = [pending[b: b + batch_size]
+                  for b in range(0, len(pending), batch_size)]
+        # pipelined loop: group i executes while group i+1's storage IO and
+        # H2D transfer run on the prefetch worker (double buffering —
+        # reference rationale: the warmup/cache stack of leaf.rs:304)
+        pipelined = self.context.prefetch and len(groups) > 1
+        future = None
+        if pipelined:
+            future = self.context.prefetch_pool().submit(
+                self._prepare_group, groups[0], doc_mapper, search_request)
+        for i, group in enumerate(groups):
+            begin = i * batch_size
             if prunable and begin > 0 and self._can_skip_remaining(
                     search_request, collector, pending, begin):
                 # reference `CanSplitDoBetter` short-circuit (leaf.rs:1608):
                 # with exact counting off, splits whose best possible sort key
                 # cannot beat the current kth hit are skipped entirely
+                # (a prefetched group may be discarded here — wasted IO is
+                # the price of overlap, never wrong results)
                 num_skipped = len(pending) - begin
                 break
-            group = pending[begin: begin + self.context.batch_size]
-            self._search_group(group, doc_mapper, search_request, collector)
+            prepared = (future.result() if future is not None
+                        else self._prepare_group(group, doc_mapper,
+                                                 search_request))
+            future = None
+            if pipelined and i + 1 < len(groups):
+                future = self.context.prefetch_pool().submit(
+                    self._prepare_group, groups[i + 1], doc_mapper,
+                    search_request)
+            self._execute_group(prepared, doc_mapper, search_request,
+                                collector)
 
         response = collector.to_leaf_response()
         response.num_attempted_splits = len(splits)
@@ -185,7 +223,10 @@ class SearchService:
                 return False
         return True
 
-    def _search_group(self, group, doc_mapper, search_request, collector) -> None:
+    def _prepare_group(self, group, doc_mapper, search_request):
+        """Stage 1 (prefetch-thread-safe): storage IO, plan lowering, and
+        the async H2D transfer for one split group. Returns an opaque
+        prepared unit for `_execute_group`."""
         # the batch path has no search_after pushdown or secondary sort;
         # the per-split path handles both
         if (len(group) > 1 and not search_request.search_after
@@ -195,18 +236,52 @@ class SearchService:
                 readers = [self.context.reader(s) for s in group]
                 batch = build_batch(search_request, doc_mapper, readers,
                                     [s.split_id for s in group])
-                merged = execute_batch(batch, search_request)
+                stage_device_inputs(batch)  # async transfer starts now
+                return ("batch", group, batch)
+            except Exception as exc:  # noqa: BLE001 - fall back per split
+                logger.debug("batch path failed (%s); searching per split", exc)
+        return ("per_split", group,
+                self._prepare_per_split(group, doc_mapper, search_request))
+
+    def _prepare_per_split(self, group, doc_mapper, search_request):
+        prepared = []
+        for split in group:
+            try:
+                reader = self.context.reader(split)
+                plan, device_arrays = prepare_single_split(
+                    search_request, doc_mapper, reader, split.split_id)
+                prepared.append((split, reader, plan, device_arrays, None))
+            except Exception as exc:  # noqa: BLE001 - partial failure
+                prepared.append((split, None, None, None, exc))
+        return prepared
+
+    def _execute_group(self, prepared, doc_mapper, search_request,
+                       collector) -> None:
+        """Stage 2 (main thread): kernel execution + readback + merge."""
+        kind, group, data = prepared
+        if kind == "batch":
+            try:
+                merged = execute_batch(data, search_request)
                 # batch responses cover several splits; cache only the merged
                 # unit is wrong per-split, so cache skipped on the batch path
                 collector.add_leaf_response(merged)
                 return
             except Exception as exc:  # noqa: BLE001 - fall back per split
-                logger.debug("batch path failed (%s); searching per split", exc)
-        for split in group:
+                logger.debug("batch execute failed (%s); per split", exc)
+                data = self._prepare_per_split(group, doc_mapper,
+                                               search_request)
+        for split, reader, plan, device_arrays, prep_error in data:
+            if prep_error is not None:
+                logger.warning("split %s prepare failed: %s",
+                               split.split_id, prep_error)
+                collector.failed_splits.append(SplitSearchError(
+                    split_id=split.split_id, error=str(prep_error),
+                    retryable=True))
+                continue
             try:
-                reader = self.context.reader(split)
-                response = leaf_search_single_split(
-                    search_request, doc_mapper, reader, split.split_id)
+                response = execute_prepared_split(
+                    search_request, doc_mapper, reader, split.split_id,
+                    plan, device_arrays)
                 key = canonical_request_key(split.split_id, search_request,
                                             split.time_range)
                 self.context.leaf_cache.put(key, response)

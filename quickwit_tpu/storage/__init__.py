@@ -2,8 +2,13 @@ from .base import Storage, StorageError, StorageResolver
 from .local import LocalFileStorage
 from .ram import RamStorage
 from .cache import ByteRangeCache, MemorySizedCache, CachingStorage
+from .s3 import S3CompatibleStorage, S3Config
+from .wrappers import (CountingStorage, DebouncedStorage,
+                       StorageTimeoutPolicy, TimeoutAndRetryStorage)
 
 __all__ = [
     "Storage", "StorageError", "StorageResolver", "LocalFileStorage",
     "RamStorage", "ByteRangeCache", "MemorySizedCache", "CachingStorage",
+    "S3CompatibleStorage", "S3Config", "CountingStorage",
+    "DebouncedStorage", "StorageTimeoutPolicy", "TimeoutAndRetryStorage",
 ]

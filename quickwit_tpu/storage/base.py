@@ -104,12 +104,22 @@ class StorageResolver:
     def for_test() -> "StorageResolver":
         from .local import LocalFileStorage
         from .ram import RamStorage
+        from .s3 import S3CompatibleStorage
         resolver = StorageResolver()
         resolver.register(Protocol.FILE, LocalFileStorage)
         _ram_root = RamStorage(Uri.parse("ram:///"))
         resolver.register(Protocol.RAM, lambda uri: _ram_root.subdir(uri))
+        # env-configured (QW_S3_ENDPOINT / AWS_*); hedged ranged reads by
+        # default — S3's tail latency is the reason the wrapper exists
+        resolver.register(Protocol.S3, _make_s3_storage)
         return resolver
 
     @staticmethod
     def default() -> "StorageResolver":
         return StorageResolver.for_test()
+
+
+def _make_s3_storage(uri: Uri) -> Storage:
+    from .s3 import S3CompatibleStorage, S3Config
+    from .wrappers import TimeoutAndRetryStorage
+    return TimeoutAndRetryStorage(S3CompatibleStorage(uri, S3Config.from_env()))

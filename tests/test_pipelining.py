@@ -1,0 +1,121 @@
+"""Warmup/compute pipelining: with real (injected) storage latency, split
+group N+1's IO + H2D staging overlaps group N's kernel execution, so the
+pipelined wall time is well below the sequential sum (SURVEY hard-part #4;
+reference rationale: the warmup/cache stack around leaf.rs:304)."""
+
+import time
+
+import pytest
+
+from quickwit_tpu.common.uri import Protocol, Uri
+from quickwit_tpu.index.writer import SplitWriter
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.query.parser import parse_query_string
+from quickwit_tpu.search.models import (LeafSearchRequest, SearchRequest,
+                                        SplitIdAndFooter)
+from quickwit_tpu.search.service import SearcherContext, SearchService
+from quickwit_tpu.storage.base import StorageResolver
+from quickwit_tpu.storage.fake_s3 import FakeS3Server
+from quickwit_tpu.storage.s3 import S3CompatibleStorage, S3Config
+
+MAPPER = DocMapper(
+    field_mappings=[
+        FieldMapping("body", FieldType.TEXT),
+        FieldMapping("ts", FieldType.DATETIME, fast=True,
+                     input_formats=("unix_timestamp",)),
+    ],
+    timestamp_field="ts", default_search_fields=("body",))
+
+NUM_SPLITS = 4
+
+
+@pytest.fixture(scope="module")
+def s3_splits():
+    server = FakeS3Server(access_key="k", secret_key="s").start()
+    config = S3Config(endpoint=server.endpoint, access_key="k",
+                      secret_key="s")
+    storage = S3CompatibleStorage(Uri.parse("s3://bench/splits"), config)
+    offsets = []
+    for n in range(NUM_SPLITS):
+        writer = SplitWriter(MAPPER)
+        for i in range(500):
+            writer.add_json_doc({
+                "body": f"log entry {i} {'error' if i % 5 == 0 else 'ok'}",
+                "ts": n * 1000 + i})
+        data = writer.finish()
+        storage.put(f"s{n}.split", data)
+        offsets.append(SplitIdAndFooter(
+            split_id=f"s{n}", storage_uri="s3://bench/splits",
+            file_len=len(data), num_docs=500,
+            time_range=(n * 1000 * 1_000_000, (n * 1000 + 499) * 1_000_000)))
+    yield server, config, offsets
+    server.stop()
+
+
+def _make_service(server, config, prefetch):
+    resolver = StorageResolver()
+    resolver.register(
+        Protocol.S3,
+        lambda uri: S3CompatibleStorage(uri, config))
+    context = SearcherContext(storage_resolver=resolver, batch_size=1,
+                              prefetch=prefetch)
+    return SearchService(context)
+
+
+def _run(service, offsets):
+    request = SearchRequest(
+        index_ids=["bench"], query_ast=parse_query_string("body:error"),
+        max_hits=10, aggs={"per_day": {
+            "date_histogram": {"field": "ts", "fixed_interval": "1d"}}})
+    return service.leaf_search(LeafSearchRequest(
+        search_request=request, index_uid="bench:0",
+        doc_mapping=MAPPER.to_dict(), splits=list(offsets)))
+
+
+def test_pipelined_overlap_beats_sequential(s3_splits, monkeypatch):
+    server, config, offsets = s3_splits
+
+    # warm the jit cache so compile time doesn't pollute either measurement
+    _run(_make_service(server, config, prefetch=False), offsets)
+
+    # make both stages expensive: each GET costs 60ms, each kernel 150ms
+    from quickwit_tpu.search import leaf as leaf_mod
+    real_execute = leaf_mod.execute_plan
+
+    def slow_execute(plan, k, device_arrays):
+        time.sleep(0.15)
+        return real_execute(plan, k, device_arrays)
+
+    monkeypatch.setattr(leaf_mod, "execute_plan", slow_execute)
+    server.latency_fn = lambda method, key: 0.06 if method == "GET" else 0.0
+
+    t0 = time.monotonic()
+    seq = _run(_make_service(server, config, prefetch=False), offsets)
+    sequential_s = time.monotonic() - t0
+
+    t0 = time.monotonic()
+    pipe = _run(_make_service(server, config, prefetch=True), offsets)
+    pipelined_s = time.monotonic() - t0
+
+    server.latency_fn = None
+    # identical results
+    assert pipe.num_hits == seq.num_hits > 0
+    assert [(h.split_id, h.doc_id) for h in pipe.partial_hits] == \
+        [(h.split_id, h.doc_id) for h in seq.partial_hits]
+    assert not pipe.failed_splits and not seq.failed_splits
+    # the overlap must reclaim a significant share of the storage latency:
+    # sequential ≈ N*(prep+exec); pipelined ≈ prep + N*exec (+ tails)
+    assert pipelined_s < sequential_s * 0.85, (
+        f"no overlap: sequential={sequential_s:.2f}s "
+        f"pipelined={pipelined_s:.2f}s")
+
+
+def test_pipelined_results_match_with_caches_cold(s3_splits):
+    """Correctness under pipelining without any injected latency."""
+    server, config, offsets = s3_splits
+    seq = _run(_make_service(server, config, prefetch=False), offsets)
+    pipe = _run(_make_service(server, config, prefetch=True), offsets)
+    assert pipe.num_hits == seq.num_hits
+    assert pipe.intermediate_aggs.keys() == seq.intermediate_aggs.keys()
+    assert [(h.split_id, h.doc_id) for h in pipe.partial_hits] == \
+        [(h.split_id, h.doc_id) for h in seq.partial_hits]
