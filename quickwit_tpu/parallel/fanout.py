@@ -149,7 +149,10 @@ def _pad_fill(key: str, num_docs_padded: int):
 
 def build_batch(request: SearchRequest, doc_mapper: DocMapper,
                 readers: list[SplitReader], split_ids: list[str],
-                pad_to_splits: Optional[int] = None) -> SplitBatch:
+                pad_to_splits: Optional[int] = None,
+                absence_sink=None) -> SplitBatch:
+    """`absence_sink(split_id, field, term)`: term-dictionary misses found
+    during lowering, fed to the predicate/negative cache."""
     agg_specs = parse_aggs(request.aggs) if request.aggs else []
     overrides = _global_agg_overrides(agg_specs, readers, doc_mapper)
     sort = request.sort_fields[0] if request.sort_fields else None
@@ -158,13 +161,15 @@ def build_batch(request: SearchRequest, doc_mapper: DocMapper,
 
     num_docs_padded = max(r.num_docs_padded for r in readers)
     plans: list[LoweredPlan] = []
-    for reader in readers:
+    for reader, split_id in zip(readers, split_ids, strict=True):
         plan = lower_request(
             request.query_ast, doc_mapper, reader, agg_specs,
             sort_field=sort_field, sort_order=sort_order,
             start_timestamp=request.start_timestamp,
             end_timestamp=request.end_timestamp,
             batch_overrides=overrides,
+            absence_sink=(None if absence_sink is None else
+                          lambda f, t, s=split_id: absence_sink(s, f, t)),
         )
         plans.append(plan)
     sigs = {p.root.sig() + p.sort.sig() + ",".join(a.sig() for a in p.aggs)

@@ -74,6 +74,7 @@ def prepare_single_split(
     doc_mapper: DocMapper,
     reader: SplitReader,
     split_id: str,
+    absence_sink=None,
 ) -> tuple[Any, list]:
     """Stage 1 of leaf search — everything up to (and including) starting
     the host→device transfer: storage byte-range IO via the reader, plan
@@ -95,6 +96,7 @@ def prepare_single_split(
         end_timestamp=request.end_timestamp,
         search_after=search_after_marker(request, split_id, sort_field,
                                          sort_order, sort2),
+        absence_sink=absence_sink,
     )
     # device_put is async: the transfer proceeds while the caller executes
     # the previous batch's kernel

@@ -263,10 +263,15 @@ class Lowering:
     batch-global dictionary."""
 
     def __init__(self, doc_mapper: DocMapper, reader: SplitReader,
-                 batch_overrides: Optional[dict] = None):
+                 batch_overrides: Optional[dict] = None,
+                 absence_sink=None):
         self.doc_mapper = doc_mapper
         self.reader = reader
         self.b = _Builder(reader)
+        # absence_sink(field, term): every term-dictionary miss is an
+        # immutable proof of absence in this split — feeds the predicate/
+        # negative cache (predicate_cache.py)
+        self.absence_sink = absence_sink
         self.batch = batch_overrides  # {"histograms": {name: (origin, nb)},
                                       #  "terms_dicts": {field: {key: gord}},
                                       #  "terms_cards": {field: int}}
@@ -282,6 +287,8 @@ class Lowering:
                        boost: float) -> Any:
         info = self.reader.lookup_term(field, term)
         if info is None:
+            if self.absence_sink is not None:
+                self.absence_sink(field, term)
             if self.batch is None:
                 return PMatchNone()
             return self._empty_postings_node(field, term, scoring)
@@ -409,21 +416,16 @@ class Lowering:
         raise PlanError(f"cannot lower query node {type(ast).__name__}")
 
     def _canonical(self, fm: FieldMapping, value: str) -> str:
-        if fm.type is FieldType.TEXT:
-            return value
-        if fm.type is FieldType.DATETIME:
-            return str(parse_datetime_to_micros(value, fm.input_formats)
-                       if not str(value).lstrip("-").isdigit()
-                       else parse_datetime_to_micros(int(value), ("unix_timestamp",)))
-        if fm.type is FieldType.F64:
-            return repr(float(value))
-        if fm.type is FieldType.BOOL:
-            return value.lower()
-        return str(int(value))
+        # single source of truth shared with the predicate cache's
+        # required-term extraction: a drift between the two would make
+        # negative-cache pruning unsound, not just ineffective
+        from .predicate_cache import canonical_query_term
+        return canonical_query_term(fm, value)
 
     def _lower_term(self, ast: Q.Term, scoring: bool, boost: float) -> Any:
+        from .predicate_cache import term_is_tokenized_text
         fm = self._field(ast.field)
-        if fm.type is FieldType.TEXT and fm.tokenizer not in ("raw", "lowercase"):
+        if term_is_tokenized_text(fm):
             # terms on tokenized text behave as a conjunctive full-text match
             # (quickwit's query language semantics)
             return self._lower_full_text(
@@ -465,6 +467,8 @@ class Lowering:
         for term in terms:
             info = self.reader.lookup_term(field, term)
             if info is None:
+                if self.absence_sink is not None:
+                    self.absence_sink(field, term)
                 if self.batch is None:
                     return PMatchNone()
                 # batch mode: keep the structure uniform across splits
@@ -865,9 +869,10 @@ def lower_request(
     end_timestamp: Optional[int] = None,
     batch_overrides: Optional[dict] = None,
     search_after: Optional[tuple] = None,  # (internal_value, relation, doc_id)
+    absence_sink=None,
 ) -> LoweredPlan:
     """Full request lowering: query + request-level time filter + sort + aggs."""
-    low = Lowering(doc_mapper, reader, batch_overrides)
+    low = Lowering(doc_mapper, reader, batch_overrides, absence_sink)
     scoring = "_score" in (sort_field, sort2_field)
     root = low.lower(query_ast, scoring=scoring)
     if start_timestamp is not None or end_timestamp is not None:

@@ -30,6 +30,7 @@ from ..query.ast import MatchAll
 from ..parallel.fanout import build_batch, execute_batch, stage_device_inputs
 from ..storage.base import StorageResolver
 from .cache import LeafSearchCache, canonical_request_key
+from .predicate_cache import PredicateCache, required_terms
 from .collector import IncrementalCollector
 from .leaf import (execute_prepared_split, leaf_search_single_split,
                    prepare_single_split)
@@ -56,6 +57,10 @@ class SearcherContext:
         # bounds both memory (at most one staged batch) and storage load.
         self.prefetch = prefetch
         self._prefetch_pool = None
+        # predicate/negative cache: (split, term)-absence proofs prune
+        # provably-empty splits before the reader is even constructed
+        # (reference: leaf_cache.rs:197 + leaf.rs:758-841)
+        self.predicate_cache = PredicateCache()
         self._readers: OrderedDict[str, SplitReader] = OrderedDict()
         self._max_open_splits = max_open_splits
         self._lock = threading.Lock()
@@ -107,6 +112,8 @@ class SearchService:
             max_hits=search_request.max_hits,
             start_offset=search_request.start_offset,
             string_sort=string_sort_of(search_request, doc_mapper))
+        required = required_terms(search_request.query_ast, doc_mapper)
+        num_pruned_by_predicate = 0
         pending: list[SplitIdAndFooter] = []
         for split in splits:
             if self._count_from_metadata(search_request, split):
@@ -115,6 +122,16 @@ class SearchService:
                 # (reference: CanSplitDoBetter count path, leaf.rs:1361)
                 collector.add_leaf_response(LeafSearchResponse(
                     num_hits=split.num_docs, num_attempted_splits=1,
+                    num_successful_splits=1))
+                continue
+            if required and self.context.predicate_cache.known_empty(
+                    split.split_id, required):
+                # negative cache: a required term is proven absent from this
+                # split — provably zero hits and identity agg states, so skip
+                # the reader open, warmup, H2D, and kernel launch entirely
+                num_pruned_by_predicate += 1
+                collector.add_leaf_response(LeafSearchResponse(
+                    num_hits=0, num_attempted_splits=1,
                     num_successful_splits=1))
                 continue
             key = canonical_request_key(split.split_id, search_request,
@@ -164,6 +181,8 @@ class SearchService:
         response = collector.to_leaf_response()
         response.num_attempted_splits = len(splits)
         response.resource_stats["num_splits_skipped"] = num_skipped
+        response.resource_stats["num_splits_pruned_by_predicate_cache"] = \
+            num_pruned_by_predicate
         return response
 
     @staticmethod
@@ -234,8 +253,11 @@ class SearchService:
                 and string_sort_of(search_request, doc_mapper) is None):
             try:
                 readers = [self.context.reader(s) for s in group]
-                batch = build_batch(search_request, doc_mapper, readers,
-                                    [s.split_id for s in group])
+                batch = build_batch(
+                    search_request, doc_mapper, readers,
+                    [s.split_id for s in group],
+                    absence_sink=self.context.predicate_cache
+                    .record_term_absent)
                 stage_device_inputs(batch)  # async transfer starts now
                 return ("batch", group, batch)
             except Exception as exc:  # noqa: BLE001 - fall back per split
@@ -248,8 +270,11 @@ class SearchService:
         for split in group:
             try:
                 reader = self.context.reader(split)
+                cache = self.context.predicate_cache
                 plan, device_arrays = prepare_single_split(
-                    search_request, doc_mapper, reader, split.split_id)
+                    search_request, doc_mapper, reader, split.split_id,
+                    absence_sink=lambda f, t, s=split.split_id:
+                        cache.record_term_absent(s, f, t))
                 prepared.append((split, reader, plan, device_arrays, None))
             except Exception as exc:  # noqa: BLE001 - partial failure
                 prepared.append((split, None, None, None, exc))

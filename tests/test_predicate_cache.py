@@ -1,0 +1,194 @@
+"""Predicate/negative cache: required-term extraction, absence recording
+during lowering, and provably-empty split pruning that skips device work
+(reference: cache_node.rs:33, leaf_cache.rs:197, leaf.rs:758-841)."""
+
+import pytest
+
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.index.writer import SplitWriter
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.query import ast as Q
+from quickwit_tpu.query.parser import parse_query_string
+from quickwit_tpu.search.models import (LeafSearchRequest, SearchRequest,
+                                        SplitIdAndFooter)
+from quickwit_tpu.search.predicate_cache import (PredicateCache,
+                                                 required_terms)
+from quickwit_tpu.search.service import SearcherContext, SearchService
+from quickwit_tpu.storage import CountingStorage, StorageResolver
+from quickwit_tpu.storage.ram import RamStorage
+
+MAPPER = DocMapper(
+    field_mappings=[
+        FieldMapping("body", FieldType.TEXT),
+        FieldMapping("severity", FieldType.TEXT, tokenizer="raw"),
+        FieldMapping("tenant", FieldType.U64, fast=True),
+        FieldMapping("ts", FieldType.DATETIME, fast=True,
+                     input_formats=("unix_timestamp",)),
+    ],
+    timestamp_field="ts", default_search_fields=("body",))
+
+
+# --- required-term extraction -------------------------------------------
+def test_required_terms_conjunctive_only():
+    ast = Q.Bool(
+        must=(Q.Term("severity", "ERROR"),),
+        filter=(Q.Term("tenant", "42"),),
+        should=(Q.Term("severity", "WARN"),),
+        must_not=(Q.Term("severity", "DEBUG"),))
+    assert set(required_terms(ast, MAPPER)) == {
+        ("severity", "ERROR"), ("tenant", "42")}
+
+
+def test_required_terms_full_text_and_vs_or():
+    and_ast = Q.FullText("body", "disk failure", "and")
+    or_ast = Q.FullText("body", "disk failure", "or")
+    single = Q.FullText("body", "disk", "or")
+    assert set(required_terms(and_ast, MAPPER)) == {
+        ("body", "disk"), ("body", "failure")}
+    assert required_terms(or_ast, MAPPER) == []
+    assert required_terms(single, MAPPER) == [("body", "disk")]
+
+
+def test_required_terms_tokenized_term_node():
+    # Term on a default-tokenized text field lowers as conjunctive
+    # full-text; extraction must mirror that
+    ast = Q.Term("body", "Disk Failure")
+    assert set(required_terms(ast, MAPPER)) == {
+        ("body", "disk"), ("body", "failure")}
+
+
+def test_required_terms_skips_unknown_and_ranges():
+    ast = Q.Bool(must=(
+        Q.Range("tenant", lower=Q.RangeBound(1, True), upper=None),
+        Q.Term("severity", "ERROR")))
+    assert required_terms(ast, MAPPER) == [("severity", "ERROR")]
+
+
+def test_predicate_cache_lru_and_lookup():
+    cache = PredicateCache(max_entries=2)
+    cache.record_term_absent("s1", "body", "foo")
+    cache.record_term_absent("s1", "body", "bar")
+    assert cache.is_term_absent("s1", "body", "foo")
+    cache.record_term_absent("s2", "body", "baz")  # evicts oldest (bar)
+    assert not cache.is_term_absent("s1", "body", "bar")
+    assert cache.known_empty("s1", [("body", "foo"), ("body", "nope")])
+    assert not cache.known_empty("s3", [("body", "foo")])
+
+
+# --- end-to-end pruning --------------------------------------------------
+@pytest.fixture()
+def two_splits():
+    storage = CountingStorage(RamStorage(Uri.parse("ram:///predcache")))
+    offsets = []
+    for n, word in enumerate(["alpha", "beta"]):
+        writer = SplitWriter(MAPPER)
+        for i in range(50):
+            writer.add_json_doc({
+                "body": f"{word} event {i}", "severity": "INFO",
+                "tenant": n, "ts": 1000 + i})
+        data = writer.finish()
+        storage.put(f"s{n}.split", data)
+        offsets.append(SplitIdAndFooter(
+            split_id=f"s{n}", storage_uri="ram:///predcache",
+            file_len=len(data), num_docs=50))
+    resolver = StorageResolver()
+    from quickwit_tpu.common.uri import Protocol
+    resolver.register(Protocol.RAM, lambda uri: storage)
+    return resolver, storage, offsets
+
+
+def _leaf_request(query, aggs=None):
+    return LeafSearchRequest(
+        search_request=SearchRequest(
+            index_ids=["t"], query_ast=parse_query_string(query),
+            max_hits=5, aggs=aggs),
+        index_uid="t:0", doc_mapping=MAPPER.to_dict(), splits=None)
+
+
+def test_absent_term_prunes_split_on_repeat_query(two_splits):
+    resolver, storage, offsets = two_splits
+    svc = SearchService(SearcherContext(storage_resolver=resolver,
+                                        batch_size=1))
+
+    # query 1: "alpha" only exists in s0; lowering proves absence in s1
+    req = _leaf_request("body:alpha")
+    req.splits = list(offsets)
+    first = svc.leaf_search(req)
+    assert first.num_hits == 50
+    assert svc.context.predicate_cache.is_term_absent("s1", "body", "alpha")
+    assert first.resource_stats[
+        "num_splits_pruned_by_predicate_cache"] == 0
+
+    # query 2: DIFFERENT request (aggs added) sharing the required term —
+    # s1 must be pruned without opening/executing anything
+    req2 = _leaf_request("body:alpha", aggs={
+        "by_tenant": {"terms": {"field": "tenant"}}})
+    req2.splits = list(offsets)
+    read_paths: list[str] = []
+    original_get_slice = storage.get_slice
+
+    def tracking_get_slice(path, start, end):
+        read_paths.append(path)
+        return original_get_slice(path, start, end)
+
+    storage.get_slice = tracking_get_slice
+    try:
+        second = svc.leaf_search(req2)
+    finally:
+        storage.get_slice = original_get_slice
+    assert second.num_hits == 50
+    assert second.resource_stats[
+        "num_splits_pruned_by_predicate_cache"] == 1
+    assert second.num_attempted_splits == 2
+    # the pruned split must incur ZERO storage reads; only s0's agg
+    # columns may be fetched
+    assert all(p == "s0.split" for p in read_paths), read_paths
+
+
+def test_pruned_split_skips_reader_open_entirely(two_splits):
+    """A cold context that inherits absence knowledge never even opens the
+    pruned split (no footer GETs)."""
+    resolver, storage, offsets = two_splits
+    context = SearcherContext(storage_resolver=resolver, batch_size=1)
+    context.predicate_cache.record_term_absent("s1", "body", "alpha")
+    svc = SearchService(context)
+    req = _leaf_request("body:alpha")
+    req.splits = list(offsets)
+    response = svc.leaf_search(req)
+    assert response.num_hits == 50
+    assert response.resource_stats[
+        "num_splits_pruned_by_predicate_cache"] == 1
+    assert "ram:///predcache/s1" not in context._readers
+    assert "ram:///predcache/s0" in context._readers
+
+
+def test_conjunction_with_absent_term_prunes_even_with_other_filters(
+        two_splits):
+    """Extra filters can only shrink the result: the absence proof carries
+    across queries with different time ranges / extra clauses."""
+    resolver, storage, offsets = two_splits
+    context = SearcherContext(storage_resolver=resolver, batch_size=1)
+    context.predicate_cache.record_term_absent("s1", "body", "alpha")
+    svc = SearchService(context)
+    req = LeafSearchRequest(
+        search_request=SearchRequest(
+            index_ids=["t"],
+            query_ast=parse_query_string("body:alpha AND severity:INFO"),
+            max_hits=5, start_timestamp=0, end_timestamp=10**15),
+        index_uid="t:0", doc_mapping=MAPPER.to_dict(),
+        splits=list(offsets))
+    response = svc.leaf_search(req)
+    assert response.num_hits == 50
+    assert response.resource_stats[
+        "num_splits_pruned_by_predicate_cache"] == 1
+
+
+def test_batch_path_records_absences(two_splits):
+    resolver, storage, offsets = two_splits
+    svc = SearchService(SearcherContext(storage_resolver=resolver,
+                                        batch_size=2))
+    req = _leaf_request("body:beta")
+    req.splits = list(offsets)
+    response = svc.leaf_search(req)
+    assert response.num_hits == 50
+    assert svc.context.predicate_cache.is_term_absent("s0", "body", "beta")
