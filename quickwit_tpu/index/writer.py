@@ -25,6 +25,7 @@ from typing import Any, Optional
 import numpy as np
 
 from ..models.doc_mapper import DocMapper, FieldMapping, FieldType, TypedDoc, canonical_term
+from ..utils.datetime_utils import truncate_to_precision
 from .format import DOC_PAD, POSTING_PAD, SplitFileBuilder, SplitFooter, pad_to
 
 _STORE_BLOCK_BYTES = 64 * 1024
@@ -311,7 +312,11 @@ class SplitWriter:
         doc_ids = np.fromiter(col.values.keys(), dtype=np.int64, count=len(col.values))
         present[doc_ids] = 1
         if col.is_numeric:
-            dtype = np.float64 if col.fm.type is FieldType.F64 else np.int64
+            # u64 columns hold values above i64::MAX (the reference
+            # dynamically types >2^63 values as u64); everything else is i64
+            dtype = (np.float64 if col.fm.type is FieldType.F64
+                     else np.uint64 if col.fm.type is FieldType.U64
+                     else np.int64)
             values = np.zeros(num_docs_padded, dtype=dtype)
             vals = np.fromiter(col.values.values(), dtype=dtype, count=len(col.values))
             values[doc_ids] = vals
@@ -365,8 +370,15 @@ class SplitWriter:
 def _fast_value(fm: FieldMapping, value: Any):
     if fm.type is FieldType.BOOL:
         return 1 if value else 0
-    if fm.type in (FieldType.I64, FieldType.U64, FieldType.DATETIME, FieldType.IP):
+    if fm.type is FieldType.DATETIME:
+        return truncate_to_precision(int(value), fm.fast_precision)
+    if fm.type in (FieldType.I64, FieldType.U64, FieldType.IP):
         return int(value)
     if fm.type is FieldType.F64:
         return float(value)
-    return canonical_term(fm, value) if fm.type is not FieldType.TEXT else str(value)
+    if fm.type is FieldType.TEXT:
+        text = str(value)
+        # reference: `fast: {normalizer: lowercase}` — the fast column
+        # (terms aggs, fast-field reads) observes the normalized form
+        return text.lower() if fm.normalizer == "lowercase" else text
+    return canonical_term(fm, value)

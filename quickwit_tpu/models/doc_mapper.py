@@ -54,24 +54,41 @@ class FieldMapping:
     stored: bool = True
     input_formats: tuple[str, ...] = ("rfc3339", "unix_timestamp")  # DATETIME
     output_format: str = "rfc3339"
+    # normalizer applied to TEXT fast-column values (reference:
+    # `fast: {normalizer: lowercase}` — terms aggs and fast-field reads
+    # observe the normalized form)
+    normalizer: Optional[str] = None
+    # DATETIME fast-column precision (reference `fast_precision`):
+    # "seconds" | "milliseconds" | None (microseconds). Stored values AND
+    # range bounds truncate to it, so sub-precision bounds behave like ES.
+    fast_precision: Optional[str] = None
 
     def to_dict(self) -> dict[str, Any]:
         return {
             "name": self.name, "type": self.type.value, "tokenizer": self.tokenizer,
             "record": self.record, "indexed": self.indexed, "fast": self.fast,
             "stored": self.stored, "input_formats": list(self.input_formats),
-            "output_format": self.output_format,
+            "output_format": self.output_format, "normalizer": self.normalizer,
+            "fast_precision": self.fast_precision,
         }
 
     @staticmethod
     def from_dict(d: dict[str, Any]) -> "FieldMapping":
+        fast = d.get("fast", False)
+        normalizer = d.get("normalizer")
+        if isinstance(fast, dict):
+            # reference shape: `fast: {normalizer: lowercase}`
+            normalizer = fast.get("normalizer", normalizer)
+            fast = True
         return FieldMapping(
             name=d["name"], type=FieldType(d["type"]),
             tokenizer=d.get("tokenizer", "default"), record=d.get("record", "basic"),
-            indexed=d.get("indexed", True), fast=d.get("fast", False),
+            indexed=d.get("indexed", True), fast=fast,
             stored=d.get("stored", True),
             input_formats=tuple(d.get("input_formats", ("rfc3339", "unix_timestamp"))),
             output_format=d.get("output_format", "rfc3339"),
+            normalizer=normalizer,
+            fast_precision=d.get("fast_precision"),
         )
 
 

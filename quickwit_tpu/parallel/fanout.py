@@ -69,6 +69,7 @@ class SplitBatch:
     doc_mapper: DocMapper
     sort_field: str
     sort_order: str
+    readers: list[SplitReader] = None  # for exact int sort-value re-reads
 
     @property
     def n_splits(self) -> int:
@@ -221,6 +222,7 @@ def build_batch(request: SearchRequest, doc_mapper: DocMapper,
         template=template, arrays=stacked_arrays, scalars=stacked_scalars,
         num_docs=num_docs, split_ids=ids, num_docs_padded=num_docs_padded,
         doc_mapper=doc_mapper, sort_field=sort_field, sort_order=sort_order,
+        readers=list(readers),
     )
 
 
@@ -350,15 +352,23 @@ def execute_batch(batch: SplitBatch, request: SearchRequest,
     num_hits = int(total)
     hits: list[PartialHit] = []
     sort_is_int = _sort_values_are_int(batch.doc_mapper, batch.sort_field)
+    exact_cols: dict[int, Any] = {}
     for i in range(min(k, num_hits)):
         internal = float(top_vals[i])
         if internal == float("-inf"):
             break
-        split_id = batch.split_ids[int(split_idx[i])]
+        si = int(split_idx[i])
+        split_id = batch.split_ids[si]
         if split_id == "":
             continue
         raw = decode_raw_sort_value(internal, batch.sort_field, batch.sort_order,
                                     sort_is_int, scores[i], int(doc_ids[i]))
+        if raw is not None and sort_is_int and batch.readers is not None:
+            # exact 64-bit value from the column (f64 keys round at 2^53)
+            if si not in exact_cols:
+                exact_cols[si] = batch.readers[si].column_values(
+                    batch.sort_field)[0]
+            raw = int(exact_cols[si][int(doc_ids[i])])
         hits.append(PartialHit(sort_value=internal, split_id=split_id,
                                doc_id=int(doc_ids[i]), raw_sort_value=raw))
 

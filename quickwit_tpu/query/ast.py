@@ -44,12 +44,19 @@ class MatchNone(QueryAst):
 
 @dataclass(frozen=True)
 class Term(QueryAst):
-    """Exact term on a field; `value` is the raw (pre-normalization) token."""
+    """Exact term on a field; `value` is the raw (pre-normalization) token.
+
+    `verbatim` distinguishes ES `term` queries (no analysis: the value
+    must equal the post-tokenization indexed form — reference:
+    `elastic_query_dsl/term_query.rs`) from query-string terms, which
+    tokenize on text fields like a conjunctive full-text match."""
     field: str
     value: str
+    verbatim: bool = False
 
     def to_dict(self) -> dict[str, Any]:
-        return {"type": "term", "field": self.field, "value": self.value}
+        return {"type": "term", "field": self.field, "value": self.value,
+                "verbatim": self.verbatim}
 
 
 @dataclass(frozen=True)
@@ -75,10 +82,14 @@ class FullText(QueryAst):
     text: str
     mode: str = "or"
     slop: int = 0
+    # ES `zero_terms_query`: what a match whose text tokenizes to nothing
+    # matches — "none" (default) or "all"
+    zero_terms: str = "none"
 
     def to_dict(self) -> dict[str, Any]:
         return {"type": "full_text", "field": self.field, "text": self.text,
-                "mode": self.mode, "slop": self.slop}
+                "mode": self.mode, "slop": self.slop,
+                "zero_terms": self.zero_terms}
 
 
 @dataclass(frozen=True)
@@ -138,6 +149,9 @@ class Range(QueryAst):
     field: str
     lower: Optional[RangeBound] = None
     upper: Optional[RangeBound] = None
+    # ES range `format` param: a java-time pattern the bounds are parsed
+    # with instead of the field's input_formats
+    format: Optional[str] = None
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -145,6 +159,7 @@ class Range(QueryAst):
             "field": self.field,
             "lower": self.lower.to_dict() if self.lower else None,
             "upper": self.upper.to_dict() if self.upper else None,
+            "format": self.format,
         }
 
 
@@ -194,11 +209,12 @@ def ast_from_dict(d: dict[str, Any]) -> QueryAst:
     if tag == "match_none":
         return MatchNone()
     if tag == "term":
-        return Term(d["field"], d["value"])
+        return Term(d["field"], d["value"], d.get("verbatim", False))
     if tag == "term_set":
         return TermSet({f: tuple(ts) for f, ts in d["terms_per_field"].items()})
     if tag == "full_text":
-        return FullText(d["field"], d["text"], d.get("mode", "or"), d.get("slop", 0))
+        return FullText(d["field"], d["text"], d.get("mode", "or"),
+                        d.get("slop", 0), d.get("zero_terms", "none"))
     if tag == "phrase_prefix":
         return PhrasePrefix(d["field"], d["phrase"], d.get("max_expansions", 50))
     if tag == "wildcard":
@@ -209,7 +225,7 @@ def ast_from_dict(d: dict[str, Any]) -> QueryAst:
         return FieldPresence(d["field"])
     if tag == "range":
         return Range(d["field"], RangeBound.from_dict(d.get("lower")),
-                     RangeBound.from_dict(d.get("upper")))
+                     RangeBound.from_dict(d.get("upper")), d.get("format"))
     if tag == "bool":
         return Bool(
             must=_seq(d.get("must", [])),

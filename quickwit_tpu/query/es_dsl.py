@@ -35,7 +35,8 @@ def _as_clause_list(value) -> list:
 
 
 def es_query_to_ast(query: dict[str, Any],
-                    default_search_fields: Sequence[str] = ()) -> QueryAst:
+                    default_search_fields: Sequence[str] = (),
+                    lenient_validator=None) -> QueryAst:
     if not isinstance(query, dict) or len(query) != 1:
         raise EsDslParseError(f"query must have exactly one root clause, got {query!r}")
     kind, body = next(iter(query.items()))
@@ -45,13 +46,18 @@ def es_query_to_ast(query: dict[str, Any],
     if kind == "match_none":
         return MatchNone()
     if kind == "term":
+        # ES term queries are NOT analyzed: the value must equal the
+        # post-tokenization indexed form (verbatim=True)
         field, spec = _single_kv(body, "term")
         if isinstance(spec, dict):
-            ast: QueryAst = Term(field, str(spec["value"]))
+            value = str(spec["value"])
+            if spec.get("case_insensitive"):
+                value = value.lower()
+            ast: QueryAst = Term(field, value, verbatim=True)
             if "boost" in spec:
                 ast = Boost(ast, float(spec["boost"]))
             return ast
-        return Term(field, _scalar_str(spec))
+        return Term(field, _scalar_str(spec), verbatim=True)
     if kind == "terms":
         entries = {f: v for f, v in body.items() if f != "boost"}
         field, values = _single_kv(entries, "terms")
@@ -61,7 +67,8 @@ def es_query_to_ast(query: dict[str, Any],
         if isinstance(spec, dict):
             text = str(spec["query"])
             operator = spec.get("operator", "or").lower()
-            ast = FullText(field, text, operator)
+            zero_terms = str(spec.get("zero_terms_query", "none")).lower()
+            ast = FullText(field, text, operator, zero_terms=zero_terms)
             if "boost" in spec:
                 ast = Boost(ast, float(spec["boost"]))
             return ast
@@ -89,16 +96,18 @@ def es_query_to_ast(query: dict[str, Any],
         return clauses[0] if len(clauses) == 1 else Bool(should=clauses)
     if kind == "bool":
         msm = body.get("minimum_should_match")
+        num_should = len(_as_clause_list(body.get("should")))
         return Bool(
-            must=tuple(es_query_to_ast(c, default_search_fields)
+            must=tuple(es_query_to_ast(c, default_search_fields, lenient_validator)
                        for c in _as_clause_list(body.get("must"))),
-            must_not=tuple(es_query_to_ast(c, default_search_fields)
+            must_not=tuple(es_query_to_ast(c, default_search_fields, lenient_validator)
                            for c in _as_clause_list(body.get("must_not"))),
-            should=tuple(es_query_to_ast(c, default_search_fields)
+            should=tuple(es_query_to_ast(c, default_search_fields, lenient_validator)
                          for c in _as_clause_list(body.get("should"))),
-            filter=tuple(es_query_to_ast(c, default_search_fields)
+            filter=tuple(es_query_to_ast(c, default_search_fields, lenient_validator)
                          for c in _as_clause_list(body.get("filter"))),
-            minimum_should_match=int(msm) if msm is not None else None,
+            minimum_should_match=(None if msm is None
+                                  else _parse_msm(msm, num_should)),
         )
     if kind == "range":
         field, spec = _single_kv(body, "range")
@@ -111,8 +120,12 @@ def es_query_to_ast(query: dict[str, Any],
             upper = RangeBound(spec["lte"], True)
         elif "lt" in spec:
             upper = RangeBound(spec["lt"], False)
-        return Range(field, lower=lower, upper=upper)
+        return Range(field, lower=lower, upper=upper,
+                     format=spec.get("format"))
     if kind == "exists":
+        if not isinstance(body, dict) or not isinstance(body.get("field"),
+                                                        str):
+            raise EsDslParseError("exists expects {\"field\": \"<name>\"}")
         return FieldPresence(body["field"])
     if kind == "wildcard":
         field, spec = _single_kv(body, "wildcard")
@@ -127,12 +140,68 @@ def es_query_to_ast(query: dict[str, Any],
         value = spec["value"] if isinstance(spec, dict) else spec
         return Wildcard(field, f"{value}*")
     if kind in ("query_string", "simple_query_string"):
+        if "fields" in body and not isinstance(body["fields"], list):
+            # ES rejects a bare-string `fields` (400); only `default_field`
+            # takes a single string
+            raise EsDslParseError("query_string `fields` must be an array")
+        if body.get("fields") and body.get("default_field"):
+            raise EsDslParseError(
+                "query_string cannot set both `fields` and `default_field`")
         fields = body.get("fields") or body.get("default_field") or \
             list(default_search_fields)
         if isinstance(fields, str):
             fields = [fields]
-        return parse_query_string(body["query"], fields)
+        ast = parse_query_string(body["query"], fields)
+        if body.get("lenient") and lenient_validator is not None:
+            ast = rewrite_lenient(ast, lenient_validator)
+        return ast
     raise EsDslParseError(f"unsupported query kind {kind!r}")
+
+
+def rewrite_lenient(ast: QueryAst, valid) -> QueryAst:
+    """ES `lenient: true`: clauses referencing unknown fields or carrying
+    values the field type cannot parse become match-none instead of
+    erroring. `valid(field, value_or_None) -> bool` is supplied by the
+    serve layer, which owns the doc mapper."""
+    if isinstance(ast, Bool):
+        return Bool(
+            must=tuple(rewrite_lenient(c, valid) for c in ast.must),
+            must_not=tuple(rewrite_lenient(c, valid) for c in ast.must_not),
+            should=tuple(rewrite_lenient(c, valid) for c in ast.should),
+            filter=tuple(rewrite_lenient(c, valid) for c in ast.filter),
+            minimum_should_match=ast.minimum_should_match)
+    if isinstance(ast, Boost):
+        return Boost(rewrite_lenient(ast.underlying, valid), ast.boost)
+    if isinstance(ast, Term):
+        return ast if valid(ast.field, ast.value) else MatchNone()
+    if isinstance(ast, FullText):
+        return ast if valid(ast.field, ast.text) else MatchNone()
+    if isinstance(ast, Range):
+        ok = all(valid(ast.field, b.value)
+                 for b in (ast.lower, ast.upper) if b is not None)
+        return ast if ok and valid(ast.field, None) else MatchNone()
+    if isinstance(ast, (Wildcard, Regex, PhrasePrefix, FieldPresence)):
+        field = ast.field
+        return ast if valid(field, None) else MatchNone()
+    if isinstance(ast, TermSet):
+        ok = all(valid(f, t) for f, ts in ast.terms_per_field.items()
+                 for t in ts)
+        return ast if ok else MatchNone()
+    return ast
+
+
+def _parse_msm(msm: Any, num_should: int) -> int:
+    """ES minimum_should_match: integer, negative integer (n - |value|),
+    or percentage ("50%" / "-25%") of the number of should clauses."""
+    if isinstance(msm, str) and msm.strip().endswith("%"):
+        pct = float(msm.strip()[:-1])
+        if pct < 0:
+            return num_should - int(num_should * (-pct) / 100.0)
+        return int(num_should * pct / 100.0)
+    value = int(msm)
+    if value < 0:
+        return max(num_should + value, 0)
+    return value
 
 
 def _scalar_str(value: Any) -> str:

@@ -180,6 +180,21 @@ class _Parser:
         if not self.default_fields:
             raise QueryParseError(
                 f"bare term {text!r} requires default_search_fields")
+        # bare comparison shorthand applies as a range on the default
+        # field(s): `default_field: x, query: ">=10"` (ES query_string)
+        for op, incl in ((">=", True), ("<=", True), (">", False),
+                         ("<", False)):
+            if text.startswith(op):
+                bound = RangeBound(text[len(op):], incl)
+                ranges = [Range(f, lower=bound) if op.startswith(">")
+                          else Range(f, upper=bound)
+                          for f in self.default_fields]
+                return ranges[0] if len(ranges) == 1 else \
+                    Bool(should=tuple(ranges))
+        if ("*" in text or "?" in text) and text != "*":
+            # bare wildcard over the default fields (ES query_string)
+            wilds = [Wildcard(f, text) for f in self.default_fields]
+            return wilds[0] if len(wilds) == 1 else Bool(should=tuple(wilds))
         clauses = [FullText(f, text, "or") for f in self.default_fields]
         if len(clauses) == 1:
             return clauses[0]

@@ -78,9 +78,15 @@ class IncrementalCollector:
         hits = leaf.partial_hits
         if self.search_after is not None:
             sa_v, sa_v2, sa_split, sa_doc = self.search_after
-            hits = [h for h in hits
-                    if (-h.sort_value, -h.sort_value2, h.split_id, h.doc_id) >
-                    (-sa_v, -sa_v2, sa_split, sa_doc)]
+            if sa_split is None:
+                # value-only ES marker: strictly after the value; docs
+                # tying the marker on every key are skipped
+                hits = [h for h in hits
+                        if (-h.sort_value, -h.sort_value2) > (-sa_v, -sa_v2)]
+            else:
+                hits = [h for h in hits
+                        if (-h.sort_value, -h.sort_value2, h.split_id,
+                            h.doc_id) > (-sa_v, -sa_v2, sa_split, sa_doc)]
         self._hits.extend(hits)
         keep = self.start_offset + self.max_hits
         if len(self._hits) > 4 * max(keep, 1):

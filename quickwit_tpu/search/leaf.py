@@ -145,6 +145,14 @@ def execute_prepared_split(
     sort_is_int = _sort_values_are_int(doc_mapper, sort_field)
     sort2_is_int = (_sort_values_are_int(doc_mapper, sort2.field)
                     if sort2 else False)
+    # exact 64-bit display values: internal keys are f64 (2^53 mantissa),
+    # so i64/u64 values near ±2^63 round — re-read the exact column value
+    # host-side for the k returned hits (the reference returns exact
+    # tantivy column values in hits[].sort)
+    exact_col = (reader.column_values(sort_field)[0]
+                 if sort_is_int and text_dict is None else None)
+    exact_col2 = (reader.column_values(sort2.field)[0]
+                  if sort2 is not None and sort2_is_int else None)
     values2 = result.get("sort_values2")
     for i in range(num_hits_returned):
         internal = float(result["sort_values"][i])
@@ -161,12 +169,16 @@ def execute_prepared_split(
             raw = decode_raw_sort_value(internal, sort_field, sort_order,
                                         sort_is_int, result["scores"][i],
                                         doc_id)
+            if raw is not None and exact_col is not None:
+                raw = int(exact_col[doc_id])
         internal2, raw2 = 0.0, None
         if sort2 is not None and values2 is not None:
             internal2 = float(values2[i])
             raw2 = decode_raw_sort_value(internal2, sort2.field, sort2.order,
                                          sort2_is_int, result["scores"][i],
                                          doc_id)
+            if raw2 is not None and exact_col2 is not None:
+                raw2 = int(exact_col2[doc_id])
         partial_hits.append(PartialHit(
             sort_value=internal, split_id=split_id, doc_id=doc_id,
             raw_sort_value=raw, sort_value2=internal2, raw_sort_value2=raw2))
@@ -198,9 +210,11 @@ def search_after_marker(request: SearchRequest, split_id: str,
         return None
     sa = list(request.search_after)
     if sort2 is not None and len(sa) == 4:
-        raw, raw2, m_split, m_doc = sa[0], sa[1], str(sa[2]), int(sa[3])
+        raw, raw2, m_split, m_doc = sa[0], sa[1], sa[2], int(sa[3])
     else:
-        raw, raw2, m_split, m_doc = sa[0], None, str(sa[1]), int(sa[2])
+        raw, raw2, m_split, m_doc = sa[0], None, sa[1], int(sa[2])
+    if m_split is not None:
+        m_split = str(m_split)
 
     def encode(value, field, order):
         if value is None:
@@ -210,7 +224,10 @@ def search_after_marker(request: SearchRequest, split_id: str,
     internal = encode(raw, sort_field, sort_order)
     internal2 = (encode(raw2, sort2.field, sort2.order)
                  if sort2 is not None else None)
-    if split_id < m_split:
+    if m_split is None:
+        # value-only ES marker: strictly after the value in every split
+        relation = "lt"
+    elif split_id < m_split:
         relation = "lt"
     elif split_id == m_split:
         relation = "lt_tie"

@@ -174,17 +174,18 @@ class SearchResponse:
     scroll_id: Optional[str] = None
 
     def to_dict(self) -> dict[str, Any]:
+        """Reference REST shape (`search_response_rest.rs:43`): hits are the
+        raw JSON documents, snippets ride in a parallel array."""
+        snippets = ([h.snippets for h in self.hits]
+                    if any(h.snippets for h in self.hits) else None)
         return {
             "num_hits": self.num_hits,
-            "hits": [
-                {"doc": h.doc, "score": h.score, "sort_values": h.sort_values,
-                 "split_id": h.split_id, "doc_id": h.doc_id,
-                 **({"snippets": h.snippets} if h.snippets else {})}
-                for h in self.hits
-            ],
+            "hits": [h.doc for h in self.hits],
+            **({"snippets": snippets} if snippets is not None else {}),
             "elapsed_time_micros": self.elapsed_time_micros,
             "errors": self.errors,
-            "aggregations": self.aggregations,
+            **({"aggregations": self.aggregations}
+               if self.aggregations is not None else {}),
             **({"scroll_id": self.scroll_id} if self.scroll_id else {}),
         }
 

@@ -396,8 +396,16 @@ class Lowering:
         if isinstance(ast, Q.PhrasePrefix):
             return self._lower_phrase_prefix(ast, scoring, boost)
         if isinstance(ast, Q.Wildcard):
-            return self._lower_pattern(ast.field, fnmatch.translate(ast.pattern),
-                                       scoring, boost, literal_prefix=_wildcard_prefix(ast.pattern))
+            pattern = ast.pattern
+            fm_w = self.doc_mapper.field(ast.field)
+            if (fm_w is not None and fm_w.type is FieldType.TEXT
+                    and fm_w.tokenizer not in ("raw", "whitespace")):
+                # ES analyzes wildcard terms with the field's analyzer:
+                # `Jou*al` matches tokens of lowercasing tokenizers
+                # (raw and whitespace preserve case)
+                pattern = pattern.lower()
+            return self._lower_pattern(ast.field, fnmatch.translate(pattern),
+                                       scoring, boost, literal_prefix=_wildcard_prefix(pattern))
         if isinstance(ast, Q.Regex):
             return self._lower_pattern(ast.field, ast.pattern, scoring, boost,
                                        literal_prefix=_regex_prefix(ast.pattern))
@@ -425,7 +433,7 @@ class Lowering:
     def _lower_term(self, ast: Q.Term, scoring: bool, boost: float) -> Any:
         from .predicate_cache import term_is_tokenized_text
         fm = self._field(ast.field)
-        if term_is_tokenized_text(fm):
+        if not ast.verbatim and term_is_tokenized_text(fm):
             # terms on tokenized text behave as a conjunctive full-text match
             # (quickwit's query language semantics)
             return self._lower_full_text(
@@ -433,7 +441,8 @@ class Lowering:
         if not fm.indexed:
             raise PlanError(f"field {ast.field!r} is not indexed")
         value = ast.value
-        if fm.type is FieldType.TEXT and fm.tokenizer == "lowercase":
+        if (not ast.verbatim and fm.type is FieldType.TEXT
+                and fm.tokenizer == "lowercase"):
             value = value.lower()
         return self._postings_node(ast.field, self._canonical(fm, value), scoring, boost)
 
@@ -444,6 +453,10 @@ class Lowering:
                                        scoring, boost)
         tokens = get_tokenizer(fm.tokenizer)(ast.text)
         if not tokens:
+            # ES zero_terms_query: "all" matches everything when the text
+            # tokenizes to nothing (e.g. punctuation-only)
+            if getattr(ast, "zero_terms", "none") == "all":
+                return PMatchAll()
             return PMatchNone()
         if ast.mode == "phrase" and len(tokens) > 1:
             return self._lower_phrase(ast.field, [t.text for t in tokens],
@@ -527,7 +540,20 @@ class Lowering:
         return self._or([self._postings_node(field, t, False, boost) for t in matches])
 
     def _lower_presence(self, field: str) -> Any:
-        fm = self._field(field)
+        fm = self.doc_mapper.field(field)
+        if fm is None:
+            # ES exists semantics: an unknown field name may be the parent
+            # path of mapped dotted fields ("payload" covers "payload.*");
+            # a name matching nothing simply matches no documents
+            prefix = field + "."
+            children = [f for f in self.doc_mapper.field_mappings
+                        if f.name.startswith(prefix)
+                        and (f.fast or (f.indexed
+                                        and f.type is FieldType.TEXT))]
+            if not children:
+                return PMatchNone()
+            nodes = [self._lower_presence(f.name) for f in children]
+            return self._or(nodes)
         if fm.fast:
             meta = self.reader.field_meta(field)
             if meta.get("column_kind") == "ordinal":
@@ -542,16 +568,80 @@ class Lowering:
             return PNormPresence(norm_slot)
         raise PlanError(f"presence query needs a fast or indexed text field: {field!r}")
 
+    def _lower_text_range(self, ast: Q.Range, fm: FieldMapping) -> Any:
+        """Lexicographic range on a text field via the sorted ordinal
+        column (ordinals are assigned in sorted term order, so the range
+        becomes an integer ordinal interval computed host-side — ES range
+        on keyword semantics)."""
+        import bisect
+        if not fm.fast:
+            raise PlanError(
+                f"range on text field {ast.field!r} requires fast=true")
+        meta = self.reader.field_meta(ast.field)
+        if meta.get("column_kind") != "ordinal":
+            raise PlanError(
+                f"range on text field {ast.field!r} needs an ordinal column")
+        terms = self.reader.column_dict(ast.field)
+
+        def norm(v: Any) -> str:
+            text = str(v)
+            return text.lower() if fm.normalizer == "lowercase" else text
+
+        lo_ord = 0
+        hi_ord = len(terms) - 1
+        if ast.lower is not None:
+            v = norm(ast.lower.value)
+            lo_ord = (bisect.bisect_left(terms, v) if ast.lower.inclusive
+                      else bisect.bisect_right(terms, v))
+        if ast.upper is not None:
+            v = norm(ast.upper.value)
+            hi_ord = (bisect.bisect_right(terms, v) - 1
+                      if ast.upper.inclusive
+                      else bisect.bisect_left(terms, v) - 1)
+        if lo_ord > hi_ord:
+            if self.batch is None:
+                return PMatchNone()
+            lo_ord, hi_ord = 0, -1  # uniform structure, empty interval
+        ord_slot = self.b.add_array(
+            f"col.{ast.field}.ordinals",
+            lambda: self.reader.column_ordinals(ast.field))
+        present_slot = self.b.add_array(
+            f"col.{ast.field}.ord_present",
+            lambda: (self.reader.column_ordinals(ast.field) >= 0)
+            .astype(np.uint8))
+        lo_slot = self.b.add_scalar(lo_ord, np.int32)
+        hi_slot = self.b.add_scalar(hi_ord, np.int32)
+        return PRange(ord_slot, present_slot, lo_slot, hi_slot, True, True)
+
     def _lower_range(self, ast: Q.Range, bounds_are_micros: bool = False) -> Any:
         """`bounds_are_micros`: bounds on a datetime field are already in
         micros (request-level time filters) — skip input-format parsing."""
         fm = self._field(ast.field)
         if fm.type is FieldType.TEXT:
-            raise PlanError("range queries on text fields are not supported")
+            return self._lower_text_range(ast, fm)
         values_slot, present_slot = self._column_slots(ast.field)
-        dtype = np.float64 if fm.type is FieldType.F64 else np.int64
-        parse = (lambda v: int(v)) if bounds_are_micros else \
-            (lambda v: self._parse_bound(fm, v))
+        dtype = (np.float64 if fm.type is FieldType.F64
+                 else np.uint64 if fm.type is FieldType.U64
+                 else np.int64)
+        if bounds_are_micros:
+            parse = lambda v: int(v)  # noqa: E731
+        elif ast.format and fm.type is FieldType.DATETIME:
+            from ..utils.datetime_utils import parse_java_time_format
+            parse = lambda v: parse_java_time_format(ast.format, str(v))  # noqa: E731
+        else:
+            parse = lambda v: self._parse_bound(fm, v)  # noqa: E731
+        if fm.type is FieldType.DATETIME and fm.fast_precision:
+            # bounds truncate to the column precision, matching stored
+            # values (reference fast_precision semantics)
+            from ..utils.datetime_utils import truncate_to_precision
+            base_parse = parse
+            parse = lambda v: truncate_to_precision(  # noqa: E731
+                base_parse(v), fm.fast_precision)
+        if fm.type is FieldType.U64:
+            # ES clamps out-of-domain u64 bounds instead of erroring
+            u64_parse = parse
+            parse = lambda v: max(0, min(int(u64_parse(v)),  # noqa: E731
+                                         (1 << 64) - 1))
         lo_slot = hi_slot = -1
         lo_incl = hi_incl = True
         if ast.lower is not None:

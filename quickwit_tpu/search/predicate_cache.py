@@ -120,13 +120,14 @@ def _collect_required(ast: Q.QueryAst, doc_mapper: DocMapper,
         fm = doc_mapper.field(ast.field)
         if fm is None or not fm.indexed:
             return
-        if term_is_tokenized_text(fm):
+        if not ast.verbatim and term_is_tokenized_text(fm):
             # lowered as a conjunctive full-text match
             _collect_required(Q.FullText(ast.field, ast.value, "and"),
                               doc_mapper, out)
             return
         value = ast.value
-        if fm.type is FieldType.TEXT and fm.tokenizer == "lowercase":
+        if (not ast.verbatim and fm.type is FieldType.TEXT
+                and fm.tokenizer == "lowercase"):
             value = value.lower()
         try:
             out.append((ast.field, canonical_query_term(fm, value)))

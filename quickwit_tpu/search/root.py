@@ -307,5 +307,7 @@ class RootSearcher:
         v1 = encode(sa[0], request.sort_fields[0] if request.sort_fields else None)
         if two_keys:
             v2 = encode(sa[1], request.sort_fields[1])
-            return (v1, v2, str(sa[2]), int(sa[3]))
-        return (v1, 0.0, str(sa[1]), int(sa[2]))
+            # m_split None = value-only ES marker (strictly after the value)
+            return (v1, v2, None if sa[2] is None else str(sa[2]),
+                    int(sa[3]))
+        return (v1, 0.0, None if sa[1] is None else str(sa[1]), int(sa[2]))

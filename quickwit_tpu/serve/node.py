@@ -378,7 +378,10 @@ class Node:
         scroll_id = self.scroll_store.put(context)
         page = response.to_dict()
         page["hits"] = page["hits"][:page_size]
+        if "snippets" in page:  # parallel array: keep aligned with hits
+            page["snippets"] = page["snippets"][:page_size]
         page["scroll_id"] = scroll_id
+        page["index"] = request.index_ids[0] if request.index_ids else ""
         return page
 
     def end_scroll(self, scroll_id: str) -> bool:
@@ -418,15 +421,12 @@ class Node:
         context.cursor += len(page_hits)
         return {
             "num_hits": context.total_hits,
-            "hits": [
-                {"doc": h.doc, "score": h.score, "sort_values": h.sort_values,
-                 "split_id": h.split_id, "doc_id": h.doc_id}
-                for h in page_hits
-            ],
+            "hits": [h.doc for h in page_hits],
             "scroll_id": scroll_id,
+            "index": (context.request.index_ids[0]
+                      if context.request.index_ids else ""),
             "elapsed_time_micros": 0,
             "errors": [],
-            "aggregations": None,
         }
 
     # ------------------------------------------------------------------

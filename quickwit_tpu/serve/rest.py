@@ -420,6 +420,34 @@ class RestServer:
             raise ApiError(404, f"no index matches {index_pattern!r}")
         return resolved[0].index_config.doc_mapper.default_search_fields
 
+    def _lenient_validator(self, index_pattern: str):
+        """`valid(field, value|None)` for ES `query_string.lenient`:
+        unknown fields and type-unparsable values become match-none. A
+        clause survives if ANY resolved index maps the field validly
+        (multi-index patterns: ES evaluates leniency per index)."""
+        resolved = self.node.root_searcher._resolve_indexes(
+            index_pattern.split(","))
+        mappers = [meta.index_config.doc_mapper for meta in resolved]
+
+        def valid(field: str, value) -> bool:
+            if not mappers:
+                return True
+            from ..search.predicate_cache import canonical_query_term
+            for mapper in mappers:
+                fm = mapper.field(field)
+                if fm is None:
+                    continue
+                if value is None:
+                    return True
+                try:
+                    canonical_query_term(fm, str(value))
+                    return True
+                except (ValueError, TypeError):
+                    continue
+            return False
+
+        return valid
+
     def _route_elastic(self, method: str, path: str, params: dict[str, Any],
                        body: bytes) -> tuple[int, Any]:
         node = self.node
@@ -427,8 +455,37 @@ class RestServer:
         if m:
             payload = json.loads(body) if body else {}
             request = self._es_search_request(m.group(1), payload, params)
+            if params.get("scroll"):
+                if str(params.get("allow_partial_search_results", "true")
+                       ).lower() == "false":
+                    return 400, {"status": 400, "error": {
+                        "reason": "Invalid argument: Quickwit only supports "
+                                  "scroll API with "
+                                  "allow_partial_search_results set to true"}}
+                ttl = _parse_scroll_ttl(params["scroll"])
+                if ttl > 1800:
+                    return 400, {"status": 400, "error": {
+                        "reason": "Invalid argument: Quickwit only supports "
+                                  "scroll TTL period up to 1800 secs"}}
+                page = node.start_scroll(request, ttl)
+                return 200, self._es_scroll_page(
+                    page, page.get("index", m.group(1)))
             response = node.root_searcher.search(request)
             return 200, self._es_search_response(response, request)
+        if path == "/_search/scroll":
+            payload = json.loads(body) if body else {}
+            scroll_id = payload.get("scroll_id") or params.get("scroll_id")
+            if not scroll_id:
+                raise ApiError(400, "missing scroll_id")
+            if method == "DELETE":
+                # ES clear-scroll accepts a single id or an array of ids
+                ids = scroll_id if isinstance(scroll_id, list) else [scroll_id]
+                return 200, {"succeeded": all(
+                    [node.end_scroll(str(sid)) for sid in ids])}
+            if isinstance(scroll_id, list):
+                raise ApiError(400, "scroll continuation takes one scroll_id")
+            page = node.continue_scroll(scroll_id)
+            return 200, self._es_scroll_page(page, page.get("index", ""))
         if path == "/_msearch" and method == "POST":
             lines = [json.loads(line) for line in body.split(b"\n") if line.strip()]
             responses = []
@@ -474,22 +531,36 @@ class RestServer:
                            params: dict[str, Any]) -> SearchRequest:
         index_ids = index.split(",")
         default_fields = self._default_fields(index)  # full list/pattern
-        if "query" in payload:
-            ast = es_query_to_ast(payload["query"], default_fields)
-        elif params.get("q"):
+        if params.get("q"):
+            # the `q` query-string param overrides any body query
+            # (reference: es_compat_index_search semantics)
             ast = parse_query_string(params["q"], default_fields)
+        elif "query" in payload:
+            ast = es_query_to_ast(payload["query"], default_fields,
+                                  self._lenient_validator(index))
         else:
             ast = parse_query_string("*")
         sort_fields: tuple[SortField, ...] = (SortField(),)
-        if payload.get("sort"):
-            entries = payload["sort"]
+        sort_spec = payload.get("sort")
+        if not sort_spec and params.get("sort"):
+            # GET-param form: "field:order,field2:order2"
+            sort_spec = [
+                {part.partition(":")[0]: part.partition(":")[2] or "asc"}
+                for part in str(params["sort"]).split(",") if part]
+        if sort_spec:
+            if isinstance(sort_spec, (str, dict)):
+                # single string or single {field: spec} mapping
+                sort_spec = [sort_spec] if isinstance(sort_spec, str) else [
+                    {k: v} for k, v in sort_spec.items()]
             parsed = []
-            for entry in entries[:2]:  # up to two sort keys (reference max)
+            for entry in sort_spec[:2]:  # up to two sort keys (reference max)
                 if isinstance(entry, str):
-                    parsed.append(SortField(entry, "asc"))
+                    field_name, _, order = entry.partition(":")
+                    parsed.append(SortField(field_name, order or "asc"))
                 else:
                     field_name, spec = next(iter(entry.items()))
-                    order = spec.get("order", "asc") if isinstance(spec, dict) else spec
+                    order = (spec.get("order", "asc")
+                             if isinstance(spec, dict) else spec)
                     parsed.append(SortField(field_name, order))
             sort_fields = tuple(parsed)
         search_after = None
@@ -508,18 +579,25 @@ class RestServer:
             # arity matches the sort arrays our own hits emit
             n_keys = len(normalize_sort_fields(tuple(sort_fields)))
             tiebreak = marker[-1] if marker else None
-            if (len(marker) != n_keys + 1 or not isinstance(tiebreak, str)
-                    or "|" not in tiebreak):
+            if (len(marker) == n_keys + 1 and isinstance(tiebreak, str)
+                    and "|" in tiebreak):
+                split_id, _, doc_id = tiebreak.rpartition("|")
+                try:
+                    search_after = (list(marker[:n_keys])
+                                    + [split_id, int(doc_id)])
+                except ValueError:
+                    raise ApiError(400, f"malformed shard-doc tiebreak "
+                                        f"{tiebreak!r}")
+            elif len(marker) == n_keys:
+                # value-only marker (no shard-doc tiebreak): ES resumes
+                # strictly after the VALUE — docs tying the marker on every
+                # key are skipped entirely
+                search_after = list(marker) + [None, -1]
+            else:
                 raise ApiError(
-                    400, "search_after must be a hit's full sort array "
-                         "(sort values + the trailing shard-doc tiebreak "
-                         "emitted in hits.hits[].sort)")
-            split_id, _, doc_id = tiebreak.rpartition("|")
-            try:
-                search_after = list(marker[:n_keys]) + [split_id, int(doc_id)]
-            except ValueError:
-                raise ApiError(400, f"malformed shard-doc tiebreak "
-                                    f"{tiebreak!r}")
+                    400, "search_after must be the hit's sort array "
+                         "(sort values, optionally with the trailing "
+                         "shard-doc tiebreak emitted in hits.hits[].sort)")
         track_total = payload.get("track_total_hits",
                                    params.get("track_total_hits", True))
         if isinstance(track_total, str):  # query-param form is a string
@@ -534,6 +612,24 @@ class RestServer:
             count_hits_exact=track_total is not False,
             search_after=search_after,
         )
+
+    @staticmethod
+    def _es_scroll_page(page: dict[str, Any], index: str) -> dict[str, Any]:
+        """qw scroll page (raw-doc hits) → ES scroll response shape."""
+        out = {
+            "_scroll_id": page.get("scroll_id", ""),
+            "took": page.get("elapsed_time_micros", 0) // 1000,
+            "timed_out": False,
+            "hits": {
+                "total": {"value": page.get("num_hits", 0),
+                          "relation": "eq"},
+                "hits": [{"_index": index, "_source": doc}
+                         for doc in page.get("hits", [])],
+            },
+        }
+        if page.get("aggregations") is not None:
+            out["aggregations"] = page["aggregations"]
+        return out
 
     @staticmethod
     def _es_search_response(response, request: SearchRequest) -> dict[str, Any]:

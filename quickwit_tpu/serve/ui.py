@@ -89,13 +89,11 @@ async function search() {
         'partial results — failures:\n' + body.errors.join('\n');
     }
     if (body.hits.length) {
-      const rows = body.hits.map((h) =>
-        `<tr><td>${esc(h.split_id.slice(-8))}:${h.doc_id}</td>` +
-        `<td>${h.score == null ? esc((h.sort_values || []).join(', '))
-                               : h.score.toFixed(4)}</td>` +
-        `<td><pre>${esc(JSON.stringify(h.doc, null, 1))}</pre></td></tr>`).join('');
+      const rows = body.hits.map((h, i) =>
+        `<tr><td>${i + 1}</td>` +
+        `<td><pre>${esc(JSON.stringify(h, null, 1))}</pre></td></tr>`).join('');
       $('hits').innerHTML =
-        `<table><tr><th>doc</th><th>score / sort</th><th>source</th></tr>${rows}</table>`;
+        `<table><tr><th>#</th><th>document</th></tr>${rows}</table>`;
     }
     if (body.aggregations) {
       $('aggs').innerHTML =

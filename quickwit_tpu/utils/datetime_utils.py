@@ -94,3 +94,49 @@ def _parse_rfc3339(text: str) -> int | None:
 def format_micros_rfc3339(micros: int) -> str:
     dt = _dt.datetime.fromtimestamp(micros / MICROS, tz=_dt.timezone.utc)
     return dt.strftime("%Y-%m-%dT%H:%M:%S.%f") + "Z"
+
+
+def truncate_to_precision(micros: int, precision: "str | None") -> int:
+    """Truncate microseconds to a fast-column precision (reference
+    `fast_precision`): both stored values and range bounds truncate, so
+    sub-precision range bounds behave exactly like the reference."""
+    if precision == "seconds":
+        return (micros // 1_000_000) * 1_000_000
+    if precision == "milliseconds":
+        return (micros // 1_000) * 1_000
+    return micros
+
+
+_JAVA_TIME_TOKENS = [
+    ("yyyy", "%Y"), ("MM", "%m"), ("dd", "%d"), ("HH", "%H"),
+    ("mm", "%M"), ("ss", "%S"), ("SSSSSS", "%f"), ("SSS", "%f"),
+]
+
+
+def parse_java_time_format(pattern: str, text: str) -> int:
+    """Parse `text` with an ES/java-time `format` pattern (range queries'
+    `format` param; reference: quickwit-datetime's java-time support).
+    Supports the yyyy/MM/dd/HH/mm/ss/SSS[SSS] tokens and quoted literals."""
+    import datetime as _dt
+    fmt = ""
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == "'":
+            end = pattern.find("'", i + 1)
+            if end == -1:
+                raise ValueError(f"unterminated quote in format {pattern!r}")
+            fmt += pattern[i + 1: end].replace("%", "%%")
+            i = end + 1
+            continue
+        for token, directive in _JAVA_TIME_TOKENS:
+            if pattern.startswith(token, i):
+                fmt += directive
+                i += len(token)
+                break
+        else:
+            fmt += ch.replace("%", "%%")
+            i += 1
+    parsed = _dt.datetime.strptime(text, fmt).replace(
+        tzinfo=_dt.timezone.utc)
+    return int(parsed.timestamp()) * 1_000_000 + parsed.microsecond

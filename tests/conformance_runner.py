@@ -1,0 +1,267 @@
+"""ES-conformance scenario runner.
+
+Role of the reference's `rest-api-tests/run_tests.py`: replay YAML
+scenario steps (request + expected-response assertions) against a live
+node over real HTTP. The scenario *files* are read from the reference
+checkout at runtime and used as a black-box parity oracle — their
+expectations were validated against real Elasticsearch, which makes them
+the highest-signal conformance corpus available. Setups are OUR OWN
+translations (tests/conformance_setups.py): where the reference leans on
+dynamic mapping, we declare explicit field mappings with the same
+observable behavior.
+
+Step semantics mirrored from the reference runner:
+- a file is a `---`-separated stream of steps; each step may carry
+  method(s), endpoint, params, json, ndjson, headers, status_code,
+  expected, sleep_after, num_retries
+- `expected` is compared recursively; `$expect: "<python>"` evaluates
+  with `val` bound to the actual node; lists compare prefix-wise
+  (reference behavior: expected lists check the first N items)
+"""
+
+from __future__ import annotations
+
+import gzip
+import http.client
+import json
+import time
+import urllib.parse
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import yaml
+
+SCENARII_ROOT = "/root/reference/quickwit/rest-api-tests/scenarii"
+
+
+@dataclass
+class StepResult:
+    suite: str
+    scenario: str
+    step_index: int
+    passed: bool
+    error: Optional[str] = None
+
+
+@dataclass
+class ConformanceReport:
+    results: list[StepResult] = field(default_factory=list)
+
+    def record(self, suite: str, scenario: str, index: int,
+               error: Optional[str]) -> None:
+        self.results.append(StepResult(suite, scenario, index,
+                                       error is None, error))
+
+    @property
+    def passed(self) -> int:
+        return sum(1 for r in self.results if r.passed)
+
+    @property
+    def total(self) -> int:
+        return len(self.results)
+
+    def failures(self) -> list[StepResult]:
+        return [r for r in self.results if not r.passed]
+
+
+class CheckFailure(AssertionError):
+    pass
+
+
+def check_result(result: Any, expected: Any, path: str = "") -> None:
+    """Recursive comparison with the reference's semantics."""
+    if isinstance(expected, dict) and "$expect" in expected:
+        expectations = expected["$expect"]
+        if isinstance(expectations, str):
+            expectations = [expectations]
+        for expectation in expectations:
+            if not eval(expectation, None, {"val": result}):  # noqa: S307
+                raise CheckFailure(
+                    f"$expect failed at {path or '.'}: {expectation!r} "
+                    f"(val={result!r})")
+        return
+    if isinstance(expected, dict):
+        if not isinstance(result, dict):
+            raise CheckFailure(f"expected dict at {path or '.'}, "
+                               f"got {type(result).__name__}: {result!r}")
+        for key, value in expected.items():
+            if key not in result:
+                raise CheckFailure(f"missing key {path}.{key}")
+            check_result(result[key], value, f"{path}.{key}")
+        return
+    if isinstance(expected, list):
+        if not isinstance(result, list):
+            raise CheckFailure(f"expected list at {path or '.'}, "
+                               f"got {type(result).__name__}")
+        # reference: expected lists assert a prefix of the actual list
+        if len(result) < len(expected):
+            raise CheckFailure(
+                f"list at {path or '.'} has {len(result)} items, "
+                f"expected at least {len(expected)}")
+        for i, item in enumerate(expected):
+            check_result(result[i], item, f"{path}[{i}]")
+        return
+    if isinstance(expected, float) and isinstance(result, (int, float)):
+        if abs(result - expected) > 1e-6 * max(1.0, abs(expected)):
+            raise CheckFailure(f"{path or '.'}: {result!r} != {expected!r}")
+        return
+    if result != expected:
+        raise CheckFailure(f"{path or '.'}: {result!r} != {expected!r}")
+
+
+def _resolve_previous(node: Any, previous: Any) -> Any:
+    """Substitute `{"$previous": "<expr>"}` with eval(expr, val=previous)
+    (reference runner semantics)."""
+    if isinstance(node, dict):
+        if len(node) == 1 and "$previous" in node:
+            return eval(node["$previous"], None, {"val": previous})  # noqa: S307
+        return {k: _resolve_previous(v, previous) for k, v in node.items()}
+    if isinstance(node, list):
+        return [_resolve_previous(v, previous) for v in node]
+    return node
+
+
+def load_scenario(path: str) -> list[dict]:
+    with open(path) as f:
+        data = f.read()
+    steps = []
+    for chunk in data.split("\n---"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        step = yaml.safe_load(chunk)
+        if isinstance(step, dict):
+            steps.append(step)
+    return steps
+
+
+class ScenarioClient:
+    """HTTP client bound to a node, replaying steps."""
+
+    def __init__(self, port: int, api_root: str = "/api/v1/_elastic/"):
+        self.port = port
+        self.api_root = api_root
+        self.previous_result: Any = None
+
+    def run_step(self, step: dict, ctx: dict) -> Optional[str]:
+        """Returns None on success, error string on failure. Tracks the
+        previous step's JSON response for `$previous` references
+        (reference runner's resolve_previous_result)."""
+        merged = {**ctx, **step}
+        if "engines" in merged and "quickwit" not in merged["engines"]:
+            return None  # elasticsearch-only step
+        if "json" in merged:
+            merged["json"] = _resolve_previous(merged["json"],
+                                               self.previous_result)
+        methods = merged.get("method", "GET")
+        if not isinstance(methods, list):
+            methods = [methods]
+        error = None
+        for method in methods:
+            error = self._run_one(method, merged)
+            if error is not None:
+                break
+        if "sleep_after" in merged:
+            time.sleep(merged["sleep_after"])
+        return error
+
+    def _run_one(self, method: str, step: dict) -> Optional[str]:
+        endpoint = step.get("endpoint", "")
+        api_root = step.get("api_root", self.api_root)
+        if api_root.startswith("http"):
+            api_root = "/" + api_root.split("/", 3)[3]
+        path = api_root.rstrip("/") + "/" + endpoint.lstrip("/")
+        if len(path) > 1:
+            path = path.rstrip("/")
+        params = step.get("params")
+        if params:
+            path += "?" + urllib.parse.urlencode(params)
+        body = None
+        headers = dict(step.get("headers") or {})
+        if "ndjson" in step and step["ndjson"] is not None:
+            body = ("\n".join(json.dumps(d) for d in step["ndjson"]) +
+                    "\n").encode()
+            headers.setdefault("Content-Type", "application/json")
+        elif "body_from_file" in step and step["body_from_file"]:
+            file_path = step["_cwd"] + "/" + step["body_from_file"]
+            with open(file_path, "rb") as f:
+                body = f.read()
+            if file_path.endswith(".gz"):
+                body = gzip.decompress(body)
+        elif "json" in step and step["json"] is not None:
+            body = json.dumps(step["json"]).encode()
+            headers.setdefault("Content-Type", "application/json")
+
+        expected_status = step.get("status_code", 200)
+        num_retries = step.get("num_retries", 0)
+        for attempt in range(num_retries + 1):
+            status, payload = self._request(method, path, body, headers)
+            if expected_status is None or status == expected_status:
+                break
+            if attempt < num_retries:
+                time.sleep(0.3)
+        else:
+            return (f"{method} {path}: status {status}, "
+                    f"expected {expected_status}: {payload[:300]!r}")
+        if expected_status is not None and status != expected_status:
+            return (f"{method} {path}: status {status}, "
+                    f"expected {expected_status}: {payload[:300]!r}")
+        try:
+            actual = json.loads(payload) if payload else None
+        except json.JSONDecodeError:
+            actual = None
+        if actual is not None:
+            self.previous_result = actual
+        expected = step.get("expected")
+        if expected is not None:
+            if actual is None and payload:
+                return f"{method} {path}: non-JSON response {payload[:200]!r}"
+            try:
+                check_result(actual, expected)
+            except CheckFailure as exc:
+                return f"{method} {path}: {exc}"
+        return None
+
+    def _request(self, method: str, path: str, body: Optional[bytes],
+                 headers: dict) -> tuple[int, bytes]:
+        conn = http.client.HTTPConnection("127.0.0.1", self.port,
+                                          timeout=60)
+        try:
+            conn.request(method, path, body=body, headers=headers)
+            response = conn.getresponse()
+            return response.status, response.read()
+        finally:
+            conn.close()
+
+
+def write_report(report: ConformanceReport, exclusions: dict,
+                 out_path: str) -> None:
+    lines = ["# ES conformance report", "",
+             f"Scenario oracle: reference `rest-api-tests/scenarii` "
+             f"(validated against real Elasticsearch).", "",
+             f"**{report.passed}/{report.total} steps passing** "
+             f"({100.0 * report.passed / max(report.total, 1):.1f}%).", ""]
+    by_suite: dict[str, list[StepResult]] = {}
+    for r in report.results:
+        by_suite.setdefault(r.suite, []).append(r)
+    lines.append("| suite | passed | total |")
+    lines.append("|---|---|---|")
+    for suite, results in sorted(by_suite.items()):
+        ok = sum(1 for r in results if r.passed)
+        lines.append(f"| {suite} | {ok} | {len(results)} |")
+    lines.append("")
+    if exclusions:
+        lines.append("## Named exclusions (features not yet implemented)")
+        lines.append("")
+        for key, reason in sorted(exclusions.items()):
+            lines.append(f"- `{key}` — {reason}")
+        lines.append("")
+    failures = report.failures()
+    if failures:
+        lines.append("## Failing steps")
+        lines.append("")
+        for r in failures:
+            lines.append(f"- `{r.suite}/{r.scenario}` step {r.step_index}: "
+                         f"{(r.error or '')[:300]}")
+    with open(out_path, "w") as f:
+        f.write("\n".join(lines) + "\n")

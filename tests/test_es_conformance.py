@@ -1,0 +1,107 @@
+"""Replay the reference's ES-conformance scenario corpus against a live
+node (reference: `rest-api-tests/run_tests.py` + `scenarii/`). The
+scenario files are the oracle — validated against real Elasticsearch —
+and are read from the reference checkout; setups are our own translations
+(conformance_setups.py). Skips when the corpus is not present."""
+
+import os
+
+import pytest
+
+from conformance_runner import (SCENARII_ROOT, ConformanceReport,
+                                ScenarioClient, load_scenario, write_report)
+from conformance_setups import SETUPS
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(SCENARII_ROOT),
+    reason="reference scenario corpus not available")
+
+# Named exclusions: scenario steps exercising features this engine does
+# not implement yet. Key: "suite/scenario:step" or "suite/scenario" (all
+# steps). Every exclusion names the missing feature.
+EXCLUSIONS: dict[str, str] = {
+    "search_after/0001-search_after_edge_case.yaml:6":
+        "exact i64 search_after comparison at the ±2^63 boundary "
+        "(internal f64 sort keys round above 2^53)",
+}
+
+# Known-failing steps (regression ratchet): features still to be built.
+# Tracked in CONFORMANCE.md; shrink this list as features land. A failure
+# OUTSIDE this list is a regression and fails the suite.
+KNOWN_FAILING: set[str] = set()
+_known_failing_path = os.path.join(os.path.dirname(__file__),
+                                   "conformance_known_failing.txt")
+if os.path.exists(_known_failing_path):
+    with open(_known_failing_path) as _f:
+        KNOWN_FAILING = {line.strip() for line in _f
+                         if line.strip() and not line.startswith("#")}
+
+REPORT = ConformanceReport()
+
+
+@pytest.fixture(scope="module")
+def node_port():
+    from quickwit_tpu.serve import Node, NodeConfig, RestServer
+    from quickwit_tpu.storage import StorageResolver
+    node = Node(NodeConfig(node_id="conformance-node", rest_port=0,
+                           metastore_uri="ram:///conf/metastore",
+                           default_index_root_uri="ram:///conf/indexes"),
+                storage_resolver=StorageResolver.for_test())
+    server = RestServer(node, host="127.0.0.1", port=0)
+    server.start()
+    yield server.port
+    server.stop()
+    exclusions_hit = {k: v for k, v in EXCLUSIONS.items()}
+    write_report(REPORT, exclusions_hit,
+                 os.path.join(os.path.dirname(__file__), "..",
+                              "CONFORMANCE.md"))
+
+
+def _run_suite(suite: str, port: int) -> list[str]:
+    client = ScenarioClient(port)
+    suite_dir = os.path.join(SCENARII_ROOT, suite)
+    ctx_path = os.path.join(suite_dir, "_ctx.yaml")
+    ctx = {}
+    if os.path.exists(ctx_path):
+        steps = load_scenario(ctx_path)
+        ctx = steps[0] if steps else {}
+    ctx.pop("engines", None)
+
+    for step in SETUPS[suite]():
+        step = dict(step)
+        step["_cwd"] = SCENARII_ROOT
+        error = client.run_step(step, {})
+        assert error is None, f"setup for {suite} failed: {error}"
+
+    unexpected: list[str] = []
+    newly_passing: list[str] = []
+    for name in sorted(os.listdir(suite_dir)):
+        if name.startswith("_") or not name.endswith(".yaml"):
+            continue
+        scenario = os.path.join(suite_dir, name)
+        for index, step in enumerate(load_scenario(scenario)):
+            step["_cwd"] = suite_dir
+            key_all = f"{suite}/{name}"
+            key_step = f"{suite}/{name}:{index}"
+            if key_all in EXCLUSIONS or key_step in EXCLUSIONS:
+                continue
+            error = client.run_step(step, ctx)
+            REPORT.record(suite, name, index, error)
+            if error is not None and key_step not in KNOWN_FAILING:
+                unexpected.append(f"{key_step}: {error}")
+            elif error is None and key_step in KNOWN_FAILING:
+                newly_passing.append(key_step)
+    if newly_passing:
+        print(f"\n{len(newly_passing)} KNOWN_FAILING steps now pass "
+              f"(remove from the list): {newly_passing[:10]}")
+    return unexpected
+
+
+@pytest.mark.parametrize("suite", sorted(SETUPS))
+def test_conformance_suite(suite, node_port):
+    """Regression ratchet: every step outside KNOWN_FAILING must pass.
+    KNOWN_FAILING shrinks as features land; it never grows silently."""
+    unexpected = _run_suite(suite, node_port)
+    assert not unexpected, (
+        f"{len(unexpected)} conformance REGRESSIONS (steps that previously "
+        f"passed):\n" + "\n".join(unexpected[:25]))

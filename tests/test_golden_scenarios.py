@@ -97,12 +97,12 @@ SCENARIOS = [
      {"num_hits": 10}),
     # sort_orders: first page newest-first
     ("GET", "/api/v1/g-logs/search?query=*&max_hits=2&sort_by=-ts", None,
-     {"hits": [{"doc": {"ts": 1_700_000_000 + 99 * 30}},
-               {"doc": {"ts": 1_700_000_000 + 98 * 30}}]}),
+     {"hits": [{"ts": 1_700_000_000 + 99 * 30},
+               {"ts": 1_700_000_000 + 98 * 30}]}),
     ("GET", "/api/v1/g-logs/search?query=*&max_hits=2&sort_by=ts&sort_order=asc",
      None,
-     {"hits": [{"doc": {"ts": 1_700_000_000}},
-               {"doc": {"ts": 1_700_000_030}}]}),
+     {"hits": [{"ts": 1_700_000_000},
+               {"ts": 1_700_000_030}]}),
     # --- es_compatibility -------------------------------------------------
     ("POST", "/api/v1/_elastic/g-logs/_search",
      {"query": {"match_all": {}}, "size": 0},

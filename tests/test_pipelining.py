@@ -78,16 +78,17 @@ def test_pipelined_overlap_beats_sequential(s3_splits, monkeypatch):
     # warm the jit cache so compile time doesn't pollute either measurement
     _run(_make_service(server, config, prefetch=False), offsets)
 
-    # make both stages expensive: each GET costs 60ms, each kernel 150ms
+    # make both stages expensive enough to dominate scheduler noise under
+    # parallel test load: each GET costs 100ms, each kernel 250ms
     from quickwit_tpu.search import leaf as leaf_mod
     real_execute = leaf_mod.execute_plan
 
     def slow_execute(plan, k, device_arrays):
-        time.sleep(0.15)
+        time.sleep(0.25)
         return real_execute(plan, k, device_arrays)
 
     monkeypatch.setattr(leaf_mod, "execute_plan", slow_execute)
-    server.latency_fn = lambda method, key: 0.06 if method == "GET" else 0.0
+    server.latency_fn = lambda method, key: 0.1 if method == "GET" else 0.0
 
     t0 = time.monotonic()
     seq = _run(_make_service(server, config, prefetch=False), offsets)

@@ -84,7 +84,7 @@ def test_search_get(api):
     assert status == 200
     assert result["num_hits"] == 50
     assert len(result["hits"]) == 5
-    assert result["hits"][0]["doc"]["severity_text"] == "ERROR"
+    assert result["hits"][0]["severity_text"] == "ERROR"
 
 
 def test_search_post_with_aggs_and_sort(api):
@@ -95,7 +95,7 @@ def test_search_post_with_aggs_and_sort(api):
         "aggs": {"tenants": {"terms": {"field": "tenant_id"}}},
     })
     assert status == 200
-    timestamps = [h["doc"]["timestamp"] for h in result["hits"]]
+    timestamps = [h["timestamp"] for h in result["hits"]]
     assert timestamps == sorted(timestamps, reverse=True)
     buckets = {b["key"]: b["doc_count"]
                for b in result["aggregations"]["tenants"]["buckets"]}
@@ -218,7 +218,7 @@ def test_scroll_pagination(api):
     assert status == 200
     scroll_id = page1["scroll_id"]
     assert len(page1["hits"]) == 7
-    seen = {(h["split_id"], h["doc_id"]) for h in page1["hits"]}
+    seen = {json.dumps(h, sort_keys=True) for h in page1["hits"]}
     total = page1["num_hits"]
     fetched = len(page1["hits"])
     while True:
@@ -227,7 +227,7 @@ def test_scroll_pagination(api):
         if not page["hits"]:
             break
         for h in page["hits"]:
-            key = (h["split_id"], h["doc_id"])
+            key = json.dumps(h, sort_keys=True)
             assert key not in seen
             seen.add(key)
         fetched += len(page["hits"])
@@ -324,13 +324,13 @@ def test_scroll_deep_pagination_past_window(api, monkeypatch):
     total = page["num_hits"]
     assert total > 60  # corpus is > 2x the shrunken window
     scroll_id = page["scroll_id"]
-    seen = [(h["split_id"], h["doc_id"]) for h in page["hits"]]
+    seen = [h["timestamp"] for h in page["hits"]]
     while True:
         status, page = api.request("GET", f"/api/v1/scroll?scroll_id={scroll_id}")
         assert status == 200
         if not page["hits"]:
             break
-        seen.extend((h["split_id"], h["doc_id"]) for h in page["hits"])
+        seen.extend(h["timestamp"] for h in page["hits"])
     assert len(seen) == total
     assert len(set(seen)) == total  # no duplicates, no gaps
 
@@ -511,11 +511,23 @@ def test_es_search_after_pagination(api):
         marker = page[-1]["sort"]
     assert len(seen) == len(set(seen)) == 100  # disjoint + exhaustive
     assert seen == sorted(seen, reverse=True)
-    # malformed markers are clean 400s
+    # value-only markers (no shard-doc tiebreak) are valid ES semantics:
+    # resume strictly after the value (marker = a hit's sort VALUE)
+    status, first = api.request("POST", "/api/v1/_elastic/hdfs-logs/_search", {
+        "size": 3, "sort": [{"timestamp": {"order": "desc"}}],
+        "query": {"query_string": {"query": "shared"}}})
+    third_sort_value = first["hits"]["hits"][2]["sort"][0]
+    status, result = api.request("POST", "/api/v1/_elastic/hdfs-logs/_search", {
+        "size": 2, "sort": [{"timestamp": {"order": "desc"}}],
+        "search_after": [third_sort_value]})
+    assert status == 200
+    assert [h["_source"]["timestamp"]
+            for h in result["hits"]["hits"]] == [seen[3], seen[4]]
+    # malformed (wrong-arity) markers are clean 400s
     status, err = api.request("POST", "/api/v1/_elastic/hdfs-logs/_search", {
         "size": 2, "sort": [{"timestamp": {"order": "desc"}}],
-        "search_after": [12345]})
-    assert status == 400 and "tiebreak" in err["message"]
+        "search_after": [1, 2, 3, "x", 5]})
+    assert status == 400 and "sort array" in err["message"]
 
 
 def test_es_search_after_guards(api):

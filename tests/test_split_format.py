@@ -88,7 +88,7 @@ def test_fieldnorms(split_reader):
 
 def test_numeric_column(split_reader):
     values, present = split_reader.column_values("tenant_id")
-    assert values.dtype == np.int64
+    assert values.dtype == np.uint64  # u64 columns hold values above 2^63
     assert len(values) == DOC_PAD
     assert list(values[:6]) == [0, 1, 2, 0, 1, 2]
     assert present[:10].all() and not present[10:].any()
