@@ -74,6 +74,32 @@ class NodeConfig:
                 "skip_verify": self.tls_skip_verify}
 
 
+def _validate_doc_mapping(doc_mapper: DocMapper) -> None:
+    """Create-time schema validation (reference: doc-mapper build errors,
+    `tag_pruning.rs` allowed tag types + default-search-field checks).
+    Raises ValueError → HTTP 400."""
+    from ..models.doc_mapper import FieldType
+    for tag in doc_mapper.tag_fields:
+        fm = doc_mapper.field(tag)
+        if fm is None:
+            raise ValueError(f"tag field {tag!r} is not a mapped field")
+        allowed = (fm.type in (FieldType.U64, FieldType.I64)
+                   or (fm.type is FieldType.TEXT and fm.tokenizer == "raw"))
+        if not allowed:
+            raise ValueError(
+                f"tag field {tag!r} must be a raw-tokenized text, u64, or "
+                f"i64 field (got {fm.type.value}"
+                f"{'/' + fm.tokenizer if fm.type is FieldType.TEXT else ''})")
+    for field in doc_mapper.default_search_fields:
+        fm = doc_mapper.field(field)
+        if fm is None:
+            raise ValueError(
+                f"default search field {field!r} is not a mapped field")
+        if not fm.indexed:
+            raise ValueError(
+                f"default search field {field!r} is not indexed")
+
+
 class IndexService:
     """Index management operations (role of `quickwit-index-management`)."""
 
@@ -90,6 +116,13 @@ class IndexService:
         doc_mapping = index_config_json.get("doc_mapping", {})
         doc_mapper = DocMapper.from_dict(doc_mapping) if "field_mappings" in doc_mapping \
             else DocMapper(field_mappings=[])
+        # search_settings.default_search_fields (reference config shape)
+        # overrides/augments the doc_mapping-level list
+        search_settings = index_config_json.get("search_settings") or {}
+        if search_settings.get("default_search_fields"):
+            doc_mapper.default_search_fields = tuple(
+                search_settings["default_search_fields"])
+        _validate_doc_mapping(doc_mapper)
         index_uri = index_config_json.get(
             "index_uri", f"{self.default_index_root_uri}/{index_id}")
         config = IndexConfig(

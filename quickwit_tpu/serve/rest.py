@@ -471,7 +471,7 @@ class RestServer:
                 return 200, self._es_scroll_page(
                     page, page.get("index", m.group(1)))
             response = node.root_searcher.search(request)
-            return 200, self._es_search_response(response, request)
+            return 200, self._es_search_response(response, request, params)
         if path == "/_search/scroll":
             payload = json.loads(body) if body else {}
             scroll_id = payload.get("scroll_id") or params.get("scroll_id")
@@ -493,25 +493,100 @@ class RestServer:
                 header, query_body = lines[i], lines[i + 1]
                 index = header.get("index", "*")
                 index = ",".join(index) if isinstance(index, list) else index
-                request = self._es_search_request(index, query_body, {})
-                response = node.root_searcher.search(request)
-                responses.append(self._es_search_response(response, request))
+                try:
+                    request = self._es_search_request(index, query_body,
+                                                      params)
+                    response = node.root_searcher.search(request)
+                    entry = self._es_search_response(response, request,
+                                                     params)
+                    entry["status"] = 200
+                except ApiError as exc:
+                    # per-request failures (e.g. a missing index) ride in
+                    # the response array, matching ES msearch semantics
+                    if exc.status == 404 and header.get("ignore_unavailable"):
+                        entry = {"status": 200, "took": 0,
+                                 "timed_out": False,
+                                 "hits": {"total": {"value": 0,
+                                                    "relation": "eq"},
+                                          "hits": []}}
+                    else:
+                        entry = {"status": exc.status,
+                                 "error": {"reason": str(exc)}}
+                responses.append(entry)
             return 200, {"responses": responses}
         m = re.fullmatch(r"(?:/([^/]+))?/_bulk", path)
         if m and method == "POST":
             self._check_ingest_rate(body)
             return 200, self._es_bulk(m.group(1), body, params)
-        if path == "/_cat/indices" or path.startswith("/_cat/indices"):
+        m = re.fullmatch(r"/_cat/indices(?:/([^/]+))?", path)
+        if m:
+            # reference only supports format=json and the h/health params;
+            # anything else is a 400
+            if params.get("format") != "json":
+                raise ApiError(400, "_cat/indices requires format=json")
+            unknown = set(params) - {"format", "h", "health"}
+            if unknown:
+                raise ApiError(400, f"unsupported _cat parameters: "
+                                    f"{sorted(unknown)}")
+            pattern = m.group(1)
+            columns = ([c.strip() for c in params["h"].split(",")]
+                       if params.get("h") else None)
             out = []
-            for im in node.metastore.list_indexes():
+            for im in sorted(node.metastore.list_indexes(),
+                             key=lambda im: im.index_id):
+                if pattern and not _matches_index_pattern(im.index_id,
+                                                          pattern):
+                    continue
+                health = "green"
+                if params.get("health") and params["health"] != health:
+                    continue
+                from ..models.split_metadata import SplitState
                 splits = node.metastore.list_splits(
-                    ListSplitsQuery(index_uids=[im.index_uid]))
-                out.append({
-                    "health": "green", "status": "open", "index": im.index_id,
-                    "docs.count": str(sum(s.metadata.num_docs for s in splits)),
-                    "store.size": str(sum(s.metadata.footprint_bytes for s in splits)),
-                })
+                    ListSplitsQuery(index_uids=[im.index_uid],
+                                    states=[SplitState.PUBLISHED]))
+                num_docs = sum(s.metadata.num_docs for s in splits)
+                size = sum(s.metadata.footprint_bytes for s in splits)
+                row = {
+                    "health": health, "status": "open",
+                    "index": im.index_id,
+                    "uuid": im.index_uid,
+                    "pri": "1", "rep": "0",
+                    "docs.count": str(num_docs), "docs.deleted": "0",
+                    "dataset.size": _human_size(size),
+                    "store.size": _human_size(size),
+                    "pri.store.size": _human_size(size),
+                }
+                if columns:
+                    row = {c: row.get(c, "") for c in columns}
+                out.append(row)
             return 200, out
+        m = re.fullmatch(r"/_resolve/index/([^/]+)", path)
+        if m:
+            indices = [{"name": im.index_id, "attributes": ["open"]}
+                       for im in sorted(node.metastore.list_indexes(),
+                                        key=lambda im: im.index_id)
+                       if _matches_index_pattern(im.index_id, m.group(1))]
+            return 200, {"indices": indices, "aliases": [],
+                         "data_streams": []}
+        if path == "/_cluster/health":
+            return 200, {"cluster_name": node.config.cluster_id,
+                         "status": "green", "timed_out": False,
+                         "number_of_nodes": len(node.cluster.members())}
+        m = re.fullmatch(r"/([^/_][^/]*)", path)
+        if m and method == "DELETE":
+            # ES delete-index: comma lists; 404 on any missing name unless
+            # ignore_unavailable=true
+            names = [n for n in m.group(1).split(",") if n]
+            known = {im.index_id for im in node.metastore.list_indexes()}
+            missing = [n for n in names if n not in known]
+            ignore = str(params.get("ignore_unavailable", "false")
+                         ).lower() == "true"
+            if missing and not ignore:
+                raise ApiError(404, f"no such index {missing[0]!r}")
+            for name in names:
+                if name in known:
+                    node.index_service.delete_index(name)
+            return 200, {"acknowledged": True}
         m = re.fullmatch(r"/([^/]+)/_field_caps", path)
         if m:
             metadata = node.metastore.index_metadata(m.group(1).rstrip("*").rstrip(","))
@@ -540,6 +615,16 @@ class RestServer:
                                   self._lenient_validator(index))
         else:
             ast = parse_query_string("*")
+        if params.get("extra_filters"):
+            # quickwit extension: comma-separated query-string clauses
+            # ANDed onto the query (reference: rest_handler extra_filters)
+            from ..query.ast import Bool as QBool
+            filters = tuple(
+                parse_query_string(clause, default_fields)
+                for clause in str(params["extra_filters"]).split(",")
+                if clause)
+            if filters:
+                ast = QBool(must=(ast,), filter=filters)
         sort_fields: tuple[SortField, ...] = (SortField(),)
         sort_spec = payload.get("sort")
         if not sort_spec and params.get("sort"):
@@ -632,14 +717,23 @@ class RestServer:
         return out
 
     @staticmethod
-    def _es_search_response(response, request: SearchRequest) -> dict[str, Any]:
+    def _es_search_response(response, request: SearchRequest,
+                            params: Optional[dict[str, Any]] = None
+                            ) -> dict[str, Any]:
+        includes = excludes = None
+        if params:
+            includes = _parse_source_param(params.get("_source_includes"))
+            excludes = _parse_source_param(params.get("_source_excludes"))
         hits = []
         for hit in response.hits:
+            source = hit.doc
+            if includes or excludes:
+                source = _filter_source(source, includes, excludes)
             entry = {
                 "_index": request.index_ids[0],
                 "_id": f"{hit.split_id}:{hit.doc_id}",
                 "_score": hit.score,
-                "_source": hit.doc,
+                "_source": source,
             }
             if hit.sort_values:
                 # trailing shard-doc tiebreak (role of ES's implicit
@@ -696,6 +790,81 @@ class RestServer:
                         entry["status"] = 404
                         entry["error"] = str(exc)
         return {"errors": errors, "items": items}
+
+
+def _matches_index_pattern(index_id: str, pattern: str) -> bool:
+    import fnmatch
+    return any(fnmatch.fnmatch(index_id, p)
+               for p in pattern.split(",") if p)
+
+
+def _human_size(num_bytes: int) -> str:
+    """ES _cat human sizes: 100b / 23.5kb / 1.2mb / 3.4gb."""
+    value = float(num_bytes)
+    for unit in ("b", "kb", "mb", "gb", "tb"):
+        if value < 1024 or unit == "tb":
+            if unit == "b":
+                return f"{int(value)}b"
+            return f"{value:.1f}{unit}"
+        value /= 1024.0
+    return f"{value:.1f}tb"
+
+
+def _filter_source(doc: Any, includes: "list[str] | None",
+                   excludes: "list[str] | None") -> Any:
+    """ES `_source_includes`/`_source_excludes` filtering with dotted
+    paths: an include keeps the named subtree (parents materialize along
+    the path); excludes remove subtrees and win over includes."""
+    def subtree(node: Any, path: list[str]) -> Any:
+        if not path or not isinstance(node, dict):
+            return node
+        if path[0] not in node:
+            return _MISSING
+        inner = subtree(node[path[0]], path[1:])
+        return _MISSING if inner is _MISSING else {path[0]: inner}
+
+    def merge(a: Any, b: Any) -> Any:
+        if isinstance(a, dict) and isinstance(b, dict):
+            out = dict(a)
+            for k, v in b.items():
+                out[k] = merge(out[k], v) if k in out else v
+            return out
+        return b
+
+    out = doc
+    if includes:
+        out = {}
+        for inc in includes:
+            part = subtree(doc, inc.split("."))
+            if part is not _MISSING:
+                out = merge(out, part)
+    if excludes:
+        def drop(node: Any, path: list[str]) -> Any:
+            if not isinstance(node, dict) or not path:
+                return node
+            if len(path) == 1:
+                return {k: v for k, v in node.items() if k != path[0]}
+            return {k: (drop(v, path[1:]) if k == path[0] else v)
+                    for k, v in node.items()}
+        for exc in excludes:
+            out = drop(out, exc.split("."))
+    return out
+
+
+_MISSING = object()
+
+
+def _parse_source_param(value: "str | None") -> "list[str] | None":
+    """Accepts `a,b.c` and the bracketed `['a','b']` form clients send."""
+    if not value:
+        return None
+    text = value.strip()
+    if text.startswith("["):
+        text = text.strip("[]")
+        parts = [p.strip().strip("'\"") for p in text.split(",")]
+    else:
+        parts = [p.strip() for p in text.split(",")]
+    return [p for p in parts if p] or None
 
 
 def _parse_scroll_ttl(text: str) -> float:
