@@ -136,10 +136,16 @@ class _ColumnBuilder:
         self.fm = fm
         self.is_numeric = fm.type in _NUMERIC_TYPES
         self.values: dict[int, Any] = {}
+        # ordinal (text) columns keep EVERY value: the dense column stores
+        # the first (sort substrate), extra values ride in (doc, ordinal)
+        # pair arrays for terms aggregations (reference: multivalued fast
+        # fields)
+        self.multi: dict[int, list] = {}
 
     def add(self, doc_id: int, value: Any) -> None:
-        # multi-valued docs keep the first value (round-1 limitation; the
-        # reference supports full multivalued fast fields)
+        if not self.is_numeric:
+            self.multi.setdefault(doc_id, []).append(value)
+        # numeric columns keep the first value (dense single-valued)
         self.values.setdefault(doc_id, value)
 
 
@@ -158,6 +164,11 @@ class SplitWriter:
         self._cols: dict[str, _ColumnBuilder] = {
             fm.name: _ColumnBuilder(fm) for fm in doc_mapper.fast_fields
         }
+        if doc_mapper.store_document_size:
+            # synthetic `_doc_length` fast column (reference
+            # store_document_size): serialized byte size per doc
+            self._cols["_doc_length"] = _ColumnBuilder(FieldMapping(
+                "_doc_length", FieldType.I64, fast=True, indexed=False))
         self._sources: list[bytes] = []
         self._uncompressed_docs_size = 0
         self._time_min: Optional[int] = None
@@ -195,6 +206,11 @@ class SplitWriter:
         source = json.dumps(tdoc.source, separators=(",", ":")).encode()
         self._sources.append(source)
         self._uncompressed_docs_size += len(source)
+        if "_doc_length" in self._cols:
+            # measured over the standard (space-separated) JSON text — the
+            # canonical "document as received" size for NDJSON ingestion
+            self._cols["_doc_length"].add(
+                doc_id, len(json.dumps(tdoc.source)))
         return doc_id
 
     # ------------------------------------------------------------------
@@ -328,7 +344,9 @@ class SplitWriter:
                 "max_value": (vals.max().item() if len(vals) else None),
             }
         # dictionary-encoded raw text column (terms-agg substrate)
-        uniques = sorted({str(v) for v in col.values.values()})
+        all_values = col.multi if col.multi else {
+            d: [v] for d, v in col.values.items()}
+        uniques = sorted({str(v) for vs in all_values.values() for v in vs})
         ordinal_of = {term: i for i, term in enumerate(uniques)}
         ordinals = np.full(num_docs_padded, -1, dtype=np.int32)
         for doc_id, value in col.values.items():
@@ -342,7 +360,33 @@ class SplitWriter:
         builder.add_array(f"col.{name}.ordinals", ordinals)
         builder.add_array(f"col.{name}.dict_blob", np.frombuffer(blob, dtype=np.uint8))
         builder.add_array(f"col.{name}.dict_offsets", dict_offsets)
-        return {"fast": True, "column_kind": "ordinal", "cardinality": len(uniques)}
+        meta = {"fast": True, "column_kind": "ordinal",
+                "cardinality": len(uniques)}
+        if any(len(vs) > 1 for vs in all_values.values()):
+            # multivalued: (doc, ordinal) pair arrays, one pair per DISTINCT
+            # value per doc (ES terms aggs count a doc once per term).
+            # Padding: doc 0 with ordinal -1 — excluded on device by the
+            # ordinal>=0 test without out-of-bounds gathers.
+            pair_docs: list[int] = []
+            pair_ords: list[int] = []
+            for doc_id in sorted(all_values):
+                seen: set[str] = set()
+                for value in all_values[doc_id]:
+                    text = str(value)
+                    if text in seen:
+                        continue
+                    seen.add(text)
+                    pair_docs.append(doc_id)
+                    pair_ords.append(ordinal_of[text])
+            padded = pad_to(max(len(pair_docs), 1), POSTING_PAD)
+            docs_arr = np.zeros(padded, dtype=np.int32)
+            ords_arr = np.full(padded, -1, dtype=np.int32)
+            docs_arr[:len(pair_docs)] = pair_docs
+            ords_arr[:len(pair_ords)] = pair_ords
+            builder.add_array(f"col.{name}.mv_docs", docs_arr)
+            builder.add_array(f"col.{name}.mv_ords", ords_arr)
+            meta["multivalued"] = True
+        return meta
 
     def _write_docstore(self, builder: SplitFileBuilder) -> None:
         blocks: list[bytes] = []

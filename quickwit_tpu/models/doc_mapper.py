@@ -137,6 +137,10 @@ class DocMapper:
     default_search_fields: tuple[str, ...] = ()
     store_source: bool = True
     mode: str = "lenient"  # "lenient" | "strict": unknown fields ignored/rejected
+    # reference `store_document_size`: a synthetic `_doc_length` fast
+    # column holding each doc's serialized byte size (aggregatable,
+    # never part of _source)
+    store_document_size: bool = False
 
     def __post_init__(self) -> None:
         self._by_name = {fm.name: fm for fm in self.field_mappings}
@@ -252,6 +256,7 @@ class DocMapper:
             "default_search_fields": list(self.default_search_fields),
             "store_source": self.store_source,
             "mode": self.mode,
+            "store_document_size": self.store_document_size,
         }
 
     @staticmethod
@@ -264,6 +269,7 @@ class DocMapper:
             default_search_fields=tuple(d.get("default_search_fields", ())),
             store_source=d.get("store_source", True),
             mode=d.get("mode", "lenient"),
+            store_document_size=d.get("store_document_size", False),
         )
 
 

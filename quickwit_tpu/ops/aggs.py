@@ -143,3 +143,82 @@ def sketch_quantiles(counts: np.ndarray, quantiles: list[float]) -> list[float]:
         hi = 2.0 ** ((bucket + 1) / PCTL_BUCKETS_PER_OCTAVE)
         out.append((lo + hi) / 2.0)
     return out
+
+
+# --- cardinality (HyperLogLog) ---------------------------------------------
+# 256 registers (p=8, ~6.5% relative error — matching the tolerance band of
+# ES's default-precision cardinality). The register vector is the mergeable
+# state: cross-split/cross-chip merge is an elementwise max, so it rides the
+# same psum-style reduction tree as the other agg states (with max instead
+# of add). Register updates use the compare-and-reduce pattern (scatter-max
+# into 256 buckets serializes on TPU, same pathology as bucket_counts).
+
+HLL_NUM_REGISTERS = 256
+_HLL_P = 8
+
+
+def hll_hash_bytes(data: bytes) -> int:
+    """64-bit FNV-1a — host-side hashing of term strings so that identical
+    terms hash identically across splits regardless of their ordinals."""
+    h = 0xcbf29ce484222325
+    for b in data:
+        h = ((h ^ b) * 0x100000001b3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def _hll_mix64(x: jnp.ndarray) -> jnp.ndarray:
+    """splitmix64 finalizer on uint64 (i64 ops are emulated on TPU but this
+    runs once per doc over fused elementwise ops)."""
+    x = (x ^ (x >> 30)) * jnp.uint64(0xbf58476d1ce4e5b9)
+    x = (x ^ (x >> 27)) * jnp.uint64(0x94d049bb133111eb)
+    return x ^ (x >> 31)
+
+
+def hll_registers(hashes: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """[HLL_NUM_REGISTERS] int32 register vector (max of rho per register).
+
+    `hashes` uint64 per doc, `valid` bool per doc. rho = 1 + leading zeros
+    of the suffix (capped at 57-p)."""
+    reg = (hashes >> jnp.uint64(64 - _HLL_P)).astype(jnp.int32)
+    suffix = hashes << jnp.uint64(_HLL_P)
+    # leading-zero count of the 64-bit suffix via float exponent is
+    # imprecise; use a branchless binary clz on uint64
+    clz = jnp.zeros(suffix.shape, dtype=jnp.int32)
+    x = suffix
+    for shift in (32, 16, 8, 4, 2, 1):
+        mask_hi = x >> jnp.uint64(64 - shift)
+        zero_hi = mask_hi == 0
+        clz = clz + jnp.where(zero_hi, shift, 0)
+        x = jnp.where(zero_hi, x << jnp.uint64(shift), x)
+    rho = jnp.minimum(clz + 1, 64 - _HLL_P).astype(jnp.int32)
+    rho = jnp.where(valid, rho, 0)
+    reg = jnp.where(valid, reg, jnp.int32(HLL_NUM_REGISTERS))
+    eq = reg[:, None] == jnp.arange(HLL_NUM_REGISTERS,
+                                    dtype=jnp.int32)[None, :]
+    return jnp.max(jnp.where(eq, rho[:, None], 0), axis=0)
+
+
+def hll_from_numeric(values: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Registers for a numeric column: hash the 64-bit value pattern."""
+    bits = values.astype(jnp.int64).astype(jnp.uint64) \
+        if values.dtype != jnp.float64 \
+        else jax_bitcast_f64(values)
+    return hll_registers(_hll_mix64(bits), valid)
+
+
+def jax_bitcast_f64(values: jnp.ndarray) -> jnp.ndarray:
+    import jax
+    return jax.lax.bitcast_convert_type(values, jnp.uint64)
+
+
+def hll_estimate(registers: np.ndarray) -> float:
+    """Classic HLL estimate with small-range (linear counting) correction."""
+    registers = np.asarray(registers, dtype=np.float64)
+    m = float(HLL_NUM_REGISTERS)
+    alpha = 0.7213 / (1 + 1.079 / m)
+    harmonic = np.sum(np.exp2(-registers))
+    estimate = alpha * m * m / harmonic
+    zeros = float(np.sum(registers == 0))
+    if estimate <= 2.5 * m and zeros > 0:
+        estimate = m * np.log(m / zeros)
+    return float(estimate)

@@ -110,7 +110,9 @@ def _global_agg_overrides(agg_specs, readers: list[SplitReader],
             interval = spec.interval_micros if isinstance(spec, DateHistogramAgg) \
                 else spec.interval
             if isinstance(spec, DateHistogramAgg):
-                origin = (min(vmins) // interval) * interval
+                offset = getattr(spec, "offset_micros", 0)
+                origin = ((min(vmins) - offset) // interval) * interval \
+                    + offset
             else:
                 origin = float(np.floor(min(vmins) / interval) * interval)
             num_buckets = int((max(vmaxs) - origin) // interval) + 1

@@ -42,6 +42,7 @@ class MetricAgg:
     kind: str          # avg | min | max | sum | stats | value_count | percentiles
     field: str
     percents: tuple[float, ...] = DEFAULT_PERCENTS
+    keyed: bool = True  # percentiles output shape (ES `keyed` param)
 
 
 @dataclass(frozen=True)
@@ -51,6 +52,17 @@ class DateHistogramAgg:
     interval_micros: int
     min_doc_count: int = 0
     extended_bounds: Optional[tuple[int, int]] = None  # micros
+    offset_micros: int = 0  # ES `offset`: shifts bucket boundaries
+    sub_metrics: tuple[MetricAgg, ...] = ()
+    sub_bucket: Optional["AggSpec"] = None
+
+
+@dataclass(frozen=True)
+class RangeAgg:
+    """ES range aggregation: explicit [from, to) buckets, all emitted."""
+    name: str
+    field: str
+    ranges: tuple[tuple[str, Optional[float], Optional[float]], ...]
     sub_metrics: tuple[MetricAgg, ...] = ()
     sub_bucket: Optional["AggSpec"] = None
 
@@ -72,6 +84,10 @@ class TermsAgg:
     size: int = 10
     min_doc_count: int = 1
     order_by_count_desc: bool = True
+    # per-split truncation (reference/tantivy `split_size`/`shard_size`):
+    # each split forwards only its top-N buckets; the merge reports
+    # doc_count_error_upper_bound accordingly. None = exact.
+    split_size: Optional[int] = None
     sub_metrics: tuple[MetricAgg, ...] = ()
     sub_bucket: Optional["AggSpec"] = None
 
@@ -79,17 +95,19 @@ class TermsAgg:
 AggSpec = Any  # union of the four dataclasses above
 
 
-_METRIC_KINDS = ("avg", "min", "max", "sum", "stats", "value_count", "percentiles")
+_METRIC_KINDS = ("avg", "min", "max", "sum", "stats", "extended_stats",
+                 "value_count", "percentiles", "cardinality")
 
 
 def _parse_metric(name: str, kind: str, body: dict[str, Any]) -> MetricAgg:
     if "field" not in body:
         raise AggParseError(f"aggregation {name!r}: metric {kind} requires a field")
     percents = tuple(body.get("percents", DEFAULT_PERCENTS))
-    return MetricAgg(name=name, kind=kind, field=body["field"], percents=percents)
+    return MetricAgg(name=name, kind=kind, field=body["field"],
+                     percents=percents, keyed=body.get("keyed", True))
 
 
-_BUCKET_KINDS = ("date_histogram", "histogram", "terms")
+_BUCKET_KINDS = ("date_histogram", "histogram", "terms", "range")
 
 
 def _parse_sub_aggs(name: str, sub: dict[str, Any], depth: int = 0):
@@ -98,6 +116,10 @@ def _parse_sub_aggs(name: str, sub: dict[str, Any], depth: int = 0):
     sub_bucket = None
     for sub_name, sub_body in sub.items():
         sub_kind = _agg_kind(sub_body)
+        if sub_kind == "cardinality":
+            raise AggParseError(
+                f"aggregation {name!r}: cardinality under bucket "
+                "aggregations is not supported yet")
         if sub_kind in _METRIC_KINDS:
             metrics.append(_parse_metric(sub_name, sub_kind, sub_body[sub_kind]))
         elif sub_kind in _BUCKET_KINDS:
@@ -134,15 +156,22 @@ def _parse_one(name: str, body: dict[str, Any], depth: int = 0) -> AggSpec:
             raise AggParseError(f"date_histogram {name!r} requires fixed_interval")
         bounds = None
         if "extended_bounds" in params:
+            # ES extended_bounds for date_histogram are epoch MILLISECONDS;
+            # bounds_unit="micros" is the internal escape hatch
             b = params["extended_bounds"]
-            bounds = (int(b["min"]) * 1000, int(b["max"]) * 1000) \
-                if params.get("bounds_unit") == "ms" else (int(b["min"]), int(b["max"]))
+            scale = 1 if params.get("bounds_unit") == "micros" else 1000
+            bounds = (int(b["min"]) * scale, int(b["max"]) * scale)
+        offset = 0
+        if params.get("offset"):
+            text = str(params["offset"]).strip()
+            sign = -1 if text.startswith("-") else 1
+            offset = sign * parse_interval_micros(text.lstrip("+-"))
         return DateHistogramAgg(
             name=name, field=params["field"],
             interval_micros=parse_interval_micros(interval),
             min_doc_count=params.get("min_doc_count", 0),
-            extended_bounds=bounds, sub_metrics=sub_metrics,
-            sub_bucket=sub_bucket)
+            extended_bounds=bounds, offset_micros=offset,
+            sub_metrics=sub_metrics, sub_bucket=sub_bucket)
     if kind == "histogram":
         return HistogramAgg(
             name=name, field=params["field"], interval=float(params["interval"]),
@@ -150,11 +179,32 @@ def _parse_one(name: str, body: dict[str, Any], depth: int = 0) -> AggSpec:
             sub_metrics=sub_metrics, sub_bucket=sub_bucket)
     if kind == "terms":
         order = params.get("order", {"_count": "desc"})
+        split_size = params.get("split_size", params.get(
+            "shard_size", params.get("segment_size")))
         return TermsAgg(
             name=name, field=params["field"], size=params.get("size", 10),
             min_doc_count=params.get("min_doc_count", 1),
             order_by_count_desc=order.get("_count", "desc") == "desc",
+            split_size=int(split_size) if split_size is not None else None,
             sub_metrics=sub_metrics, sub_bucket=sub_bucket)
+    if kind == "range":
+        ranges = []
+        for r in params.get("ranges", ()):
+            lo = float(r["from"]) if "from" in r else None
+            hi = float(r["to"]) if "to" in r else None
+            key = r.get("key")
+            if key is None:  # ES auto key: "from-to" with * for open ends
+                key = f"{lo if lo is not None else '*'}-" \
+                      f"{hi if hi is not None else '*'}"
+            ranges.append((str(key), lo, hi))
+        if not ranges:
+            raise AggParseError(f"range aggregation {name!r} needs ranges")
+        if sub_bucket is not None:
+            raise AggParseError(
+                f"range aggregation {name!r}: nested bucket aggs under "
+                "range are not supported yet")
+        return RangeAgg(name=name, field=params["field"],
+                        ranges=tuple(ranges), sub_metrics=sub_metrics)
     if kind in _METRIC_KINDS:
         if sub_metrics or sub_bucket:
             raise AggParseError(f"metric aggregation {name!r} cannot have sub-aggs")

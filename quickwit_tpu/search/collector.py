@@ -106,8 +106,13 @@ class IncrementalCollector:
             _merge_histogram(current, state)
         elif kind == "terms":
             _merge_terms(current, state)
+        elif kind == "range":
+            _merge_bucket_maps(current["bucket_map"], _range_to_map(state))
         elif kind == "percentiles":
             current["sketch"] = current["sketch"] + state["sketch"]
+        elif kind == "cardinality":
+            # HLL registers merge by elementwise max
+            current["hll"] = np.maximum(current["hll"], state["hll"])
         else:  # metric state [count,sum,sum_sq,min,max]
             a, b = current["state"], state["state"]
             current["state"] = np.array([
@@ -162,7 +167,33 @@ def _copy_state(state: dict[str, Any]) -> dict[str, Any]:
         copy.pop("keys", None)
         _carry_sub_info(copy, state)
         return copy
+    if kind == "range":
+        copy = dict(state)
+        copy["bucket_map"] = _range_to_map(state)
+        copy.pop("counts", None)
+        copy.pop("metrics", None)
+        return copy
     return dict(state)
+
+
+def _range_to_map(state: dict[str, Any]) -> dict:
+    """Range buckets keyed by their static range index (all emitted)."""
+    if "bucket_map" in state:  # already-merged state (tree merging at root)
+        return _copy_bucket_map(state["bucket_map"])
+    counts = np.asarray(state["counts"])
+    out = {}
+    for i in range(len(state["ranges"])):
+        acc_metrics = {}
+        for name, arrays in state.get("metrics", {}).items():
+            met_kind = state["metric_kinds"][name]
+            acc = _new_metric_acc(
+                met_kind, state.get("metric_percents", {}).get(name),
+                state.get("metric_keyed", {}).get(name, True))
+            _acc_metric(acc, arrays, i)
+            acc_metrics[name] = acc
+        out[i] = {"doc_count": int(counts[i]) if i < len(counts) else 0,
+                  "metrics": acc_metrics}
+    return out
 
 
 def _carry_sub_info(copy: dict, state: dict) -> None:
@@ -177,9 +208,9 @@ def _carry_sub_info(copy: dict, state: dict) -> None:
     copy.pop("sub", None)
 
 
-def _new_metric_acc(kind: str, percents=None) -> dict[str, Any]:
+def _new_metric_acc(kind: str, percents=None, keyed: bool = True) -> dict[str, Any]:
     return {"sum": 0.0, "count": 0, "min": np.inf, "max": -np.inf, "sum_sq": 0.0,
-            "kind": kind, "sketch": None, "percents": percents}
+            "kind": kind, "sketch": None, "percents": percents, "keyed": keyed}
 
 
 def _acc_metric(acc: dict[str, Any], arrays: dict[str, np.ndarray], i: int) -> None:
@@ -225,6 +256,7 @@ def _attach_sub_map(bucket: dict, state: dict, parent_index: int) -> None:
     counts = sub["counts"]
     metric_kinds = sub.get("metric_kinds", {})
     metric_percents = sub.get("metric_percents", {})
+    metric_keyed = sub.get("metric_keyed", {})
     sub_map: dict = {}
     for j in range(nb2):
         flat = base + j
@@ -236,7 +268,8 @@ def _attach_sub_map(bucket: dict, state: dict, parent_index: int) -> None:
         child = {"doc_count": int(counts[flat]), "metrics": {}}
         for mname, arrays in sub.get("metrics", {}).items():
             acc = _new_metric_acc(metric_kinds.get(mname, "avg"),
-                                  metric_percents.get(mname))
+                                  metric_percents.get(mname),
+                                  metric_keyed.get(mname, True))
             _acc_metric(acc, arrays, flat)
             child["metrics"][mname] = acc
         sub_map[key] = child
@@ -253,12 +286,14 @@ def _histogram_to_map(state: dict[str, Any]) -> dict[float, dict[str, Any]]:
         else np.arange(len(counts))
     metric_kinds = state.get("metric_kinds", {})
     metric_percents = state.get("metric_percents", {})
+    metric_keyed = state.get("metric_keyed", {})
     for i in nonzero:
         key = origin + int(i) * interval
         bucket = {"doc_count": int(counts[i]), "metrics": {}}
         for mname, arrays in state.get("metrics", {}).items():
             acc = _new_metric_acc(metric_kinds.get(mname, "avg"),
-                                  metric_percents.get(mname))
+                                  metric_percents.get(mname),
+                                  metric_keyed.get(mname, True))
             _acc_metric(acc, arrays, int(i))
             bucket["metrics"][mname] = acc
         _attach_sub_map(bucket, state, int(i))
@@ -273,6 +308,7 @@ def _terms_to_map(state: dict[str, Any]) -> dict[Any, dict[str, Any]]:
     keys = state["keys"]
     metric_kinds = state.get("metric_kinds", {})
     metric_percents = state.get("metric_percents", {})
+    metric_keyed = state.get("metric_keyed", {})
     out: dict[Any, dict[str, Any]] = {}
     for i in np.nonzero(counts)[0]:
         if i >= len(keys):
@@ -280,7 +316,8 @@ def _terms_to_map(state: dict[str, Any]) -> dict[Any, dict[str, Any]]:
         bucket = {"doc_count": int(counts[i]), "metrics": {}}
         for mname, arrays in state.get("metrics", {}).items():
             acc = _new_metric_acc(metric_kinds.get(mname, "avg"),
-                                  metric_percents.get(mname))
+                                  metric_percents.get(mname),
+                                  metric_keyed.get(mname, True))
             _acc_metric(acc, arrays, int(i))
             bucket["metrics"][mname] = acc
         _attach_sub_map(bucket, state, int(i))
@@ -324,6 +361,12 @@ def _merge_histogram(current: dict[str, Any], state: dict[str, Any]) -> None:
 
 def _merge_terms(current: dict[str, Any], state: dict[str, Any]) -> None:
     _merge_bucket_maps(current["bucket_map"], _terms_to_map(state))
+    if state.get("error_bound"):
+        current["error_bound"] = (current.get("error_bound", 0)
+                                  + state["error_bound"])
+    if state.get("other_docs"):
+        current["other_docs"] = (current.get("other_docs", 0)
+                                 + state["other_docs"])
 
 
 # --------------------------------------------------------------------------
@@ -349,21 +392,63 @@ def _finalize_metric(acc: dict[str, Any]) -> dict[str, Any]:
             "max": acc["max"] if np.isfinite(acc["max"]) else None,
             "avg": (acc["sum"] / count) if count else None,
         }
+    if kind == "extended_stats":
+        avg = (acc["sum"] / count) if count else None
+        # population variance: E[x^2] - E[x]^2 (ES's default)
+        variance = ((acc["sum_sq"] / count - avg * avg)
+                    if count else None)
+        if variance is not None:
+            variance = max(variance, 0.0)
+        sampling = (count * variance / (count - 1)
+                    if count and count > 1 and variance is not None else None)
+        std = variance ** 0.5 if variance is not None else None
+        out = {
+            "count": count, "sum": acc["sum"],
+            "min": acc["min"] if np.isfinite(acc["min"]) else None,
+            "max": acc["max"] if np.isfinite(acc["max"]) else None,
+            "avg": avg,
+            "sum_of_squares": acc["sum_sq"],
+            "variance": variance,
+            "variance_population": variance,
+            "variance_sampling": sampling,
+            "std_deviation": std,
+            "std_deviation_population": std,
+            "std_deviation_sampling":
+                sampling ** 0.5 if sampling is not None else None,
+        }
+        if avg is not None and std is not None:
+            out["std_deviation_bounds"] = {
+                "upper": avg + 2 * std, "lower": avg - 2 * std,
+                "upper_population": avg + 2 * std,
+                "lower_population": avg - 2 * std,
+                "upper_sampling": (avg + 2 * out["std_deviation_sampling"]
+                                   if out["std_deviation_sampling"]
+                                   is not None else None),
+                "lower_sampling": (avg - 2 * out["std_deviation_sampling"]
+                                   if out["std_deviation_sampling"]
+                                   is not None else None),
+            }
+        return out
     if kind == "percentiles":
         percents = acc.get("percents") or DEFAULT_PERCENTS
         sketch = acc.get("sketch")
         if sketch is None:
             sketch = np.zeros(PCTL_NUM_BUCKETS, dtype=np.int32)
-        return {"values": _quantile_values(sketch, percents)}
+        return {"values": _quantile_values(sketch, percents,
+                                           acc.get("keyed", True))}
     raise ValueError(f"unknown metric kind {kind}")
 
 
-def _quantile_values(sketch, percents) -> dict[str, Optional[float]]:
+def _quantile_values(sketch, percents, keyed: bool = True):
     """ES-shaped percentile values; empty sketches yield null (NaN is not
-    valid JSON and ES emits null for empty percentiles)."""
+    valid JSON and ES emits null for empty percentiles). `keyed: false`
+    emits the list-of-{key,value} shape."""
     quantiles = sketch_quantiles(sketch, [p / 100.0 for p in percents])
-    return {f"{p:g}": (None if np.isnan(v) else v)
-            for p, v in zip(percents, quantiles)}
+    if keyed:
+        return {f"{p:g}": (None if np.isnan(v) else v)
+                for p, v in zip(percents, quantiles)}
+    return [{"key": float(p), "value": (None if np.isnan(v) else v)}
+            for p, v in zip(percents, quantiles)]
 
 
 def _finalize_bucket_map(bucket_map: dict, info: dict[str, Any],
@@ -375,6 +460,9 @@ def _finalize_bucket_map(bucket_map: dict, info: dict[str, Any],
     def entry_for(key, bucket, key_scaled):
         entry: dict[str, Any] = {"key": key_scaled,
                                  "doc_count": bucket["doc_count"]}
+        if kind == "date_histogram":
+            from ..utils.datetime_utils import format_micros_rfc3339
+            entry["key_as_string"] = format_micros_rfc3339(int(key))
         for mname, acc in bucket["metrics"].items():
             entry[mname] = _finalize_metric(acc)
         if sub_info is not None:
@@ -392,10 +480,14 @@ def _finalize_bucket_map(bucket_map: dict, info: dict[str, Any],
         else:  # ES order {"_count": "asc"}: rarest terms first
             items.sort(key=lambda kb: (kb[1]["doc_count"], str(kb[0])))
         size = info.get("size") or 10
-        total_other = sum(b["doc_count"] for _, b in items[size:])
+        total_other = (sum(b["doc_count"] for _, b in items[size:])
+                       + info.get("other_docs", 0))
         return {"buckets": [entry_for(k, b, k) for k, b in items[:size]],
                 "sum_other_doc_count": int(total_other),
-                "doc_count_error_upper_bound": 0}
+                # nonzero only under split_size truncation: per-split
+                # largest-dropped counts summed at merge
+                "doc_count_error_upper_bound": int(
+                    info.get("error_bound", 0))}
 
     # histograms
     min_dc = info.get("min_doc_count") or 0
@@ -407,8 +499,11 @@ def _finalize_bucket_map(bucket_map: dict, info: dict[str, Any],
         # range (and any extended_bounds) when min_doc_count=0
         lo, hi = keys[0], keys[-1]
         if bounds and kind == "date_histogram":
-            lo = min(lo, (bounds[0] // interval) * interval)
-            hi = max(hi, (bounds[1] // interval) * interval)
+            offset = info.get("offset", 0) or 0
+            lo = min(lo, ((bounds[0] - offset) // interval) * interval
+                     + offset)
+            hi = max(hi, ((bounds[1] - offset) // interval) * interval
+                     + offset)
         num = int(round((hi - lo) / interval)) + 1
         # leaf planning caps per-split ranges, but the merged range across
         # splits/nodes with disjoint time ranges can be far wider — apply
@@ -435,15 +530,34 @@ def finalize_aggregations(agg_states: dict[str, Any]) -> dict[str, Any]:
     out: dict[str, Any] = {}
     for name, state in agg_states.items():
         if "bucket_map" not in state and state["kind"] in (
-                "date_histogram", "histogram", "terms"):
+                "date_histogram", "histogram", "terms", "range"):
             state = _copy_state(state)
         kind = state["kind"]
         if kind in ("date_histogram", "histogram", "terms"):
             out[name] = _finalize_bucket_map(
                 state["bucket_map"], state, sub_info=state.get("sub_info"))
+        elif kind == "range":
+            buckets = []
+            for i, (key, lo, hi) in enumerate(state["ranges"]):
+                bucket = state["bucket_map"].get(
+                    i, {"doc_count": 0, "metrics": {}})
+                entry: dict[str, Any] = {"key": key,
+                                         "doc_count": bucket["doc_count"]}
+                if lo is not None:
+                    entry["from"] = lo
+                if hi is not None:
+                    entry["to"] = hi
+                for mname, acc in bucket["metrics"].items():
+                    entry[mname] = _finalize_metric(acc)
+                buckets.append(entry)
+            out[name] = {"buckets": buckets}
         elif kind == "percentiles":
-            out[name] = {"values": _quantile_values(state["sketch"],
-                                                    state["percents"])}
+            out[name] = {"values": _quantile_values(
+                state["sketch"], state["percents"],
+                state.get("keyed", True))}
+        elif kind == "cardinality":
+            from ..ops.aggs import hll_estimate
+            out[name] = {"value": round(hll_estimate(state["hll"]))}
         else:
             c, s, s2, mn, mx = state["state"]
             acc = {"kind": kind, "count": int(c), "sum": float(s),

@@ -47,6 +47,14 @@ def _bucket_idx(a: BucketAggExec, arrays, scalars, mask):
         m = mask & (ordinals >= 0)
         idx = jnp.where(m, ordinals, jnp.int32(nb))
         return idx, m
+    if a.kind == "terms_mv":
+        # multivalued: values are (doc, ordinal) PAIR arrays — gather the
+        # doc-level mask at each pair's doc id; padding pairs carry
+        # ordinal -1 (dropped here) with doc 0 (in-bounds gather)
+        pair_docs = arrays[a.present_slot]
+        m = mask[pair_docs] & (values >= 0)
+        idx = jnp.where(m, values, jnp.int32(nb))
+        return idx, m
     present = arrays[a.present_slot].astype(jnp.bool_)
     m = mask & present
     origin = scalars[a.origin_slot]
@@ -75,21 +83,63 @@ def _bucket_metrics(metric_slots, arrays, idx, m, nb):
             state["sketch"] = agg_ops.bucket_percentile_sketch(midx, mv, nb)
             metrics[met.name] = state
             continue
-        if need in ("sum", "avg", "stats"):
+        if need in ("sum", "avg", "stats", "extended_stats"):
             state["sum"] = agg_ops.bucket_sum(midx, mv, nb)
-        if need in ("avg", "stats", "value_count"):
+        if need in ("avg", "stats", "extended_stats", "value_count"):
             state["count"] = agg_ops.bucket_counts(midx, nb).astype(jnp.int64)
-        if need in ("min", "stats"):
+        if need in ("min", "stats", "extended_stats"):
             state["min"] = agg_ops.bucket_min(midx, mv, nb)
-        if need in ("max", "stats"):
+        if need in ("max", "stats", "extended_stats"):
             state["max"] = agg_ops.bucket_max(midx, mv, nb)
-        if need == "stats":
+        if need in ("stats", "extended_stats"):
             state["sum_sq"] = agg_ops.bucket_sum(midx, mv * mv, nb)
         metrics[met.name] = state
     return metrics
 
 
+def _eval_range_agg(a: BucketAggExec, arrays, mask):
+    """Range buckets may OVERLAP (ES counts a doc in every range it falls
+    in), so each range gets its own mask instead of one bucket index."""
+    nb = a.num_buckets
+    values = arrays[a.values_slot].astype(jnp.float64)
+    present = arrays[a.present_slot].astype(jnp.bool_)
+    froms = arrays[a.froms_slot]
+    tos = arrays[a.tos_slot]
+    in_range = ((mask & present)[:, None]
+                & (values[:, None] >= froms[None, :])
+                & (values[:, None] < tos[None, :]))          # [D, nb]
+    counts = jnp.sum(in_range, axis=0, dtype=jnp.int32)
+    metrics: dict[str, Any] = {}
+    for met in a.metrics:
+        mv = arrays[met.values_slot].astype(jnp.float64)
+        mp = arrays[met.present_slot].astype(jnp.bool_)
+        mm = in_range & mp[:, None]                          # [D, nb]
+        state: dict[str, Any] = {}
+        need = met.kind
+        mvb = mv[:, None]
+        if need == "percentiles":
+            state["sketch"] = jnp.stack([
+                agg_ops.percentile_sketch(mv, mp, in_range[:, i] & mask)
+                for i in range(nb)])
+            metrics[met.name] = state
+            continue
+        if need in ("sum", "avg", "stats", "extended_stats"):
+            state["sum"] = jnp.sum(jnp.where(mm, mvb, 0.0), axis=0)
+        if need in ("avg", "stats", "extended_stats", "value_count"):
+            state["count"] = jnp.sum(mm, axis=0, dtype=jnp.int64)
+        if need in ("min", "stats", "extended_stats"):
+            state["min"] = jnp.min(jnp.where(mm, mvb, jnp.inf), axis=0)
+        if need in ("max", "stats", "extended_stats"):
+            state["max"] = jnp.max(jnp.where(mm, mvb, -jnp.inf), axis=0)
+        if need in ("stats", "extended_stats"):
+            state["sum_sq"] = jnp.sum(jnp.where(mm, mvb * mvb, 0.0), axis=0)
+        metrics[met.name] = state
+    return {"counts": counts, "metrics": metrics}
+
+
 def _eval_bucket_agg(a: BucketAggExec, arrays, scalars, mask):
+    if a.kind == "range":
+        return _eval_range_agg(a, arrays, mask)
     nb = a.num_buckets
     idx, m = _bucket_idx(a, arrays, scalars, mask)
     counts = agg_ops.bucket_counts(idx, nb)
@@ -175,9 +225,29 @@ def _posting_space_eligible(plan: LoweredPlan) -> bool:
     """Single-term queries (no boolean structure, no NOT semantics) can
     execute entirely over the [P] posting arrays instead of [N] dense docs —
     the role of the reference's specialized single-term scorer, with P often
-    orders of magnitude below the doc count."""
-    return (isinstance(plan.root, PPostings)
-            and plan.search_after_relation == "none")
+    orders of magnitude below the doc count.
+
+    Aggregations whose auxiliary arrays are NOT doc-space (range bounds,
+    multivalued pair arrays, per-ordinal hash tables) cannot ride the
+    _GatherView (it gathers every slot at per-posting doc ids) — those
+    plans take the dense path."""
+    if not (isinstance(plan.root, PPostings)
+            and plan.search_after_relation == "none"):
+        return False
+    for a in plan.aggs:
+        if isinstance(a, BucketAggExec):
+            if a.kind in ("range", "terms_mv"):
+                return False
+            if any(m.kind == "cardinality" for m in a.metrics):
+                return False
+            if a.sub is not None and (
+                    a.sub.kind in ("range", "terms_mv")
+                    or any(m.kind == "cardinality" for m in a.sub.metrics)):
+                return False
+        elif isinstance(a, MetricAggExec):
+            if a.metric.kind == "cardinality":
+                return False
+    return True
 
 
 class _GatherView:
@@ -263,6 +333,21 @@ def _eval_aggs(aggs, gathered, scalars, valid):
             agg_out.append(_eval_bucket_agg(a, gathered, scalars, valid))
         elif isinstance(a, MetricAggExec):
             met = a.metric
+            if met.kind == "cardinality":
+                if met.hash_slot >= 0:
+                    # text column: gather per-ordinal TERM hashes
+                    ordinals = gathered[met.values_slot]
+                    ok = valid & (ordinals >= 0)
+                    hashes = gathered[met.hash_slot][
+                        jnp.clip(ordinals, 0, None)]
+                    agg_out.append(
+                        {"hll": agg_ops.hll_registers(hashes, ok)})
+                else:
+                    mv = gathered[met.values_slot]
+                    mp = gathered[met.present_slot].astype(jnp.bool_)
+                    agg_out.append(
+                        {"hll": agg_ops.hll_from_numeric(mv, valid & mp)})
+                continue
             mv = gathered[met.values_slot]
             mp = gathered[met.present_slot]
             if met.kind == "percentiles":

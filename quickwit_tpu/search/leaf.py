@@ -242,6 +242,29 @@ def _sort_values_are_int(doc_mapper: DocMapper, sort_field: str) -> bool:
         FieldType.I64, FieldType.U64, FieldType.DATETIME, FieldType.BOOL, FieldType.IP)
 
 
+def _truncate_terms_state(state: dict[str, Any]) -> None:
+    """Per-split `split_size` truncation (reference/tantivy shard_size
+    semantics): forward only the top-N buckets by count; the largest
+    dropped count becomes this split's doc_count_error_upper_bound
+    contribution (error bounds sum at merge)."""
+    counts = np.asarray(state["counts"])
+    split_size = int(state["split_size"])
+    nonzero = int((counts > 0).sum())
+    if nonzero <= split_size:
+        state["error_bound"] = 0
+        return
+    order = np.argsort(-counts, kind="stable")
+    dropped_max = int(counts[order[split_size]])
+    kept = np.zeros_like(counts)
+    kept_idx = order[:split_size]
+    kept[kept_idx] = counts[kept_idx]
+    state["error_bound"] = dropped_max
+    # ES/tantivy compute sum_other_doc_count from the FULL per-split doc
+    # total, not just forwarded buckets — carry the dropped mass
+    state["other_docs"] = int(counts.sum() - kept.sum())
+    state["counts"] = kept
+
+
 def _intermediate_aggs(plan, agg_results: list) -> dict[str, Any]:
     """Device outputs + host_info → the mergeable intermediate agg states
     (role of the reference's serialized intermediate aggregation results)."""
@@ -249,15 +272,20 @@ def _intermediate_aggs(plan, agg_results: list) -> dict[str, Any]:
     for a, res in zip(plan.aggs, agg_results):
         if isinstance(a, BucketAggExec):
             state: dict[str, Any] = {
-                "kind": a.kind,
+                # terms_mv is an execution detail; the mergeable state is a
+                # plain terms state (counts over the ordinal space)
+                "kind": "terms" if a.kind == "terms_mv" else a.kind,
                 "counts": np.asarray(res["counts"]),
                 "metrics": {name: {k: np.asarray(v) for k, v in m.items()}
                             for name, m in res["metrics"].items()},
                 "metric_kinds": {m.name: m.kind for m in a.metrics},
                 "metric_percents": {m.name: list(m.percents) for m in a.metrics
                                     if m.kind == "percentiles"},
+                "metric_keyed": {m.name: m.keyed for m in a.metrics},
                 **a.host_info,
             }
+            if a.kind == "terms" and state.get("split_size"):
+                _truncate_terms_state(state)
             if a.sub is not None and "sub" in res:
                 state["sub"] = {
                     "name": a.sub.name, "kind": a.sub.kind,
@@ -269,6 +297,8 @@ def _intermediate_aggs(plan, agg_results: list) -> dict[str, Any]:
                     "metric_percents": {m.name: list(m.percents)
                                         for m in a.sub.metrics
                                         if m.kind == "percentiles"},
+                    "metric_keyed": {m.name: m.keyed
+                                     for m in a.sub.metrics},
                     **a.sub.host_info,
                 }
             out[a.name] = state
@@ -277,7 +307,11 @@ def _intermediate_aggs(plan, agg_results: list) -> dict[str, Any]:
             if met.kind == "percentiles":
                 out[a.name] = {"kind": "percentiles",
                                "sketch": np.asarray(res["sketch"]),
-                               "percents": list(met.percents)}
+                               "percents": list(met.percents),
+                               "keyed": met.keyed}
+            elif met.kind == "cardinality":
+                out[a.name] = {"kind": "cardinality",
+                               "hll": np.asarray(res["hll"])}
             else:
                 out[a.name] = {"kind": met.kind, "state": np.asarray(res["stats"])}
     return out

@@ -34,7 +34,7 @@ from ..ops.bm25 import idf as bm25_idf
 from ..ops.phrase import phrase_match
 from ..query import ast as Q
 from ..query.aggregations import (
-    AggSpec, DateHistogramAgg, HistogramAgg, MetricAgg, TermsAgg,
+    AggSpec, DateHistogramAgg, HistogramAgg, MetricAgg, RangeAgg, TermsAgg,
 )
 from ..query.tokenizers import get_tokenizer
 from ..index.reader import SplitReader
@@ -130,13 +130,19 @@ class PBool:
 @dataclass(frozen=True)
 class MetricSlots:
     name: str
-    kind: str           # avg|min|max|sum|stats|value_count|percentiles
+    kind: str  # avg|min|max|sum|stats|extended_stats|value_count|percentiles|cardinality
     values_slot: int
     present_slot: int
     percents: tuple[float, ...] = ()
+    keyed: bool = True  # percentiles output shape
+    # cardinality on text columns: per-ordinal 64-bit term hashes
+    # (host-precomputed so cross-split merges hash the TERM, not the
+    # split-local ordinal); -1 = hash the numeric value in-kernel
+    hash_slot: int = -1
 
     def sig(self) -> str:
-        return f"met({self.kind},{self.values_slot},{self.present_slot})"
+        return (f"met({self.kind},{self.values_slot},{self.present_slot},"
+                f"{self.hash_slot})")
 
 
 @dataclass(frozen=True)
@@ -149,6 +155,8 @@ class BucketAggExec:
     num_buckets: int             # static
     origin_slot: int = -1        # traced (histograms)
     interval_slot: int = -1      # traced (histograms)
+    froms_slot: int = -1         # range agg: [nb] f64 lower bounds
+    tos_slot: int = -1           # range agg: [nb] f64 upper bounds
     metrics: tuple[MetricSlots, ...] = ()
     # host-side info for finalization (not part of jit signature)
     host_info: Any = None
@@ -159,6 +167,7 @@ class BucketAggExec:
         sub_sig = self.sub.sig() if self.sub is not None else ""
         return (f"bagg({self.kind},{self.values_slot},{self.present_slot},"
                 f"{self.num_buckets},{self.origin_slot},{self.interval_slot},"
+                f"{self.froms_slot},{self.tos_slot},"
                 + ",".join(m.sig() for m in self.metrics)
                 + f",sub[{sub_sig}])")
 
@@ -280,6 +289,10 @@ class Lowering:
     def _field(self, name: str) -> FieldMapping:
         fm = self.doc_mapper.field(name)
         if fm is None:
+            if (name == "_doc_length"
+                    and self.doc_mapper.store_document_size):
+                return FieldMapping("_doc_length", FieldType.I64,
+                                    fast=True, indexed=False)
             raise PlanError(f"unknown field {name!r}")
         return fm
 
@@ -663,11 +676,42 @@ class Lowering:
     # --- aggregations -----------------------------------------------------
     def lower_metric(self, spec: MetricAgg) -> MetricSlots:
         fm = self._field(spec.field)
+        if spec.kind == "cardinality":
+            return self._lower_cardinality(spec, fm)
         if fm.type is FieldType.TEXT:
             raise PlanError(f"metric aggregation on text field {spec.field!r}")
         values_slot, present_slot = self._column_slots(spec.field)
         return MetricSlots(spec.name, spec.kind, values_slot, present_slot,
-                           tuple(spec.percents))
+                           tuple(spec.percents),
+                           keyed=getattr(spec, "keyed", True))
+
+    def _lower_cardinality(self, spec: MetricAgg,
+                           fm: FieldMapping) -> MetricSlots:
+        """Cardinality via HLL registers computed on device. Text columns
+        gather host-precomputed per-ordinal TERM hashes so register merges
+        are consistent across splits (ordinals are split-local)."""
+        if not fm.fast:
+            raise PlanError(
+                f"cardinality aggregation requires fast field {spec.field!r}")
+        meta = self.reader.field_meta(spec.field)
+        if meta.get("column_kind") == "ordinal":
+            ord_slot = self.b.add_array(
+                f"col.{spec.field}.ordinals",
+                lambda: self.reader.column_ordinals(spec.field))
+
+            def term_hashes() -> np.ndarray:
+                from ..ops.aggs import hll_hash_bytes
+                terms = self.reader.column_dict(spec.field)
+                return np.array([hll_hash_bytes(t.encode()) for t in terms]
+                                or [0], dtype=np.uint64)
+
+            hash_slot = self.b.add_array(
+                f"col.{spec.field}.ord_hash", term_hashes)
+            return MetricSlots(spec.name, "cardinality", ord_slot, -1,
+                               hash_slot=hash_slot)
+        values_slot, present_slot = self._column_slots(spec.field)
+        return MetricSlots(spec.name, "cardinality", values_slot,
+                           present_slot)
 
     def lower_agg(self, spec: AggSpec) -> Any:
         if isinstance(spec, MetricAgg):
@@ -680,6 +724,10 @@ class Lowering:
             # share a name with another aggregation
             child = self._lower_bucket_agg(
                 sub_spec, override_key=f"{spec.name}>{sub_spec.name}")
+            if exec_.kind == "terms_mv" or child.kind == "terms_mv":
+                raise PlanError(
+                    "multivalued terms aggs cannot nest (pair arrays and "
+                    "doc-space buckets have different shapes)")
             if exec_.num_buckets * child.num_buckets > MAX_BUCKETS:
                 raise PlanError(
                     f"nested aggregation {spec.name!r}>{sub_spec.name!r} would "
@@ -709,7 +757,10 @@ class Lowering:
                 if spec.extended_bounds:
                     lo = min(lo, spec.extended_bounds[0])
                     hi = max(hi, spec.extended_bounds[1])
-                origin = (lo // interval) * interval
+                # ES `offset` shifts every bucket boundary: buckets start at
+                # k*interval + offset
+                offset = getattr(spec, "offset_micros", 0)
+                origin = ((lo - offset) // interval) * interval + offset
                 num_buckets = int((hi - origin) // interval) + 1
                 if num_buckets > MAX_BUCKETS:
                     raise PlanError(
@@ -724,6 +775,7 @@ class Lowering:
             # batches must stay on the i64 path (per-split vmin would lower
             # splits to different structures and break batch uniformity)
             use_s32 = (interval % 1_000_000 == 0
+                       and origin % 1_000_000 == 0
                        and self.batch is None
                        and vmin is not None
                        and (vmax // 1_000_000 - base_s)
@@ -749,7 +801,8 @@ class Lowering:
                 metrics=self._metric_tuple(spec.sub_metrics),
                 host_info={"interval": interval, "origin": origin,
                            "min_doc_count": spec.min_doc_count,
-                           "extended_bounds": spec.extended_bounds})
+                           "extended_bounds": spec.extended_bounds,
+                           "offset": getattr(spec, "offset_micros", 0)})
         if isinstance(spec, HistogramAgg):
             fm = self._field(spec.field)
             values_slot, present_slot = self._column_slots(spec.field)
@@ -779,6 +832,28 @@ class Lowering:
                            "min_doc_count": spec.min_doc_count})
         if isinstance(spec, TermsAgg):
             return self._lower_terms_agg(spec)
+        if isinstance(spec, RangeAgg):
+            fm = self._field(spec.field)
+            if fm.type is FieldType.TEXT or not fm.fast:
+                raise PlanError(
+                    f"range aggregation requires a fast numeric field: "
+                    f"{spec.field!r}")
+            values_slot, present_slot = self._column_slots(spec.field)
+            froms = np.array([lo if lo is not None else -np.inf
+                              for _, lo, _ in spec.ranges], dtype=np.float64)
+            tos = np.array([hi if hi is not None else np.inf
+                            for _, _, hi in spec.ranges], dtype=np.float64)
+            froms_slot = self.b.add_array(
+                f"agg.{spec.name}.range_froms", lambda: froms)
+            tos_slot = self.b.add_array(
+                f"agg.{spec.name}.range_tos", lambda: tos)
+            return BucketAggExec(
+                spec.name, "range", values_slot, present_slot,
+                len(spec.ranges),
+                froms_slot=froms_slot, tos_slot=tos_slot,
+                metrics=self._metric_tuple(spec.sub_metrics),
+                host_info={"ranges": list(spec.ranges),
+                           "min_doc_count": 0})
         raise PlanError(f"unsupported aggregation {spec!r}")
 
     def _metric_tuple(self, specs: tuple[MetricAgg, ...]) -> tuple[MetricSlots, ...]:
@@ -814,7 +889,31 @@ class Lowering:
                 metrics=self._metric_tuple(spec.sub_metrics),
                 host_info={"keys": global_keys, "size": spec.size,
                            "min_doc_count": spec.min_doc_count,
-                           "order_desc": spec.order_by_count_desc})
+                           "order_desc": spec.order_by_count_desc,
+                           "split_size": spec.split_size})
+        if meta.get("column_kind") == "ordinal" and meta.get("multivalued"):
+            if self.batch is not None:
+                raise PlanError(
+                    f"multivalued terms agg {spec.field!r} is per-split "
+                    "(batch path falls back)")
+            if spec.sub_metrics or spec.sub_bucket:
+                raise PlanError(
+                    f"sub-aggregations under multivalued terms "
+                    f"{spec.field!r} are not supported yet")
+            keys = self.reader.column_dict(spec.field)
+            ords_slot = self.b.add_array(
+                f"col.{spec.field}.mv_ords",
+                lambda: self.reader.array(f"col.{spec.field}.mv_ords"))
+            docs_slot = self.b.add_array(
+                f"col.{spec.field}.mv_docs",
+                lambda: self.reader.array(f"col.{spec.field}.mv_docs"))
+            return BucketAggExec(
+                spec.name, "terms_mv", ords_slot, docs_slot,
+                max(len(keys), 1),
+                host_info={"keys": keys, "size": spec.size,
+                           "min_doc_count": spec.min_doc_count,
+                           "order_desc": spec.order_by_count_desc,
+                           "split_size": spec.split_size})
         if meta.get("column_kind") == "ordinal":
             ordinals_slot = self.b.add_array(
                 f"col.{spec.field}.ordinals", lambda: self.reader.column_ordinals(spec.field))
@@ -824,7 +923,8 @@ class Lowering:
                 metrics=self._metric_tuple(spec.sub_metrics),
                 host_info={"keys": keys, "size": spec.size,
                            "min_doc_count": spec.min_doc_count,
-                           "order_desc": spec.order_by_count_desc})
+                           "order_desc": spec.order_by_count_desc,
+                           "split_size": spec.split_size})
         # numeric column: ordinalize host-side once per split (cached)
         ordinals, uniques = self._ordinalize_numeric(spec.field)
         return BucketAggExec(
@@ -834,7 +934,8 @@ class Lowering:
             metrics=self._metric_tuple(spec.sub_metrics),
             host_info={"keys": uniques, "size": spec.size,
                        "min_doc_count": spec.min_doc_count,
-                       "order_desc": spec.order_by_count_desc})
+                       "order_desc": spec.order_by_count_desc,
+                       "split_size": spec.split_size})
 
     def _ordinalize_numeric(self, field: str):
         return ordinalize_numeric_column(self.reader, field)

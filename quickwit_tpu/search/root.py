@@ -131,6 +131,9 @@ class RootSearcher:
         aggregations = None
         if request.aggs:
             aggregations = finalize_aggregations(merged.aggregation_states())
+            # ES returns the aggregation skeleton even when no split
+            # contributed states (empty index / zero matching splits)
+            _fill_empty_aggs(aggregations, request.aggs)
         return SearchResponse(
             num_hits=merged.num_hits,
             hits=hits,
@@ -311,3 +314,61 @@ class RootSearcher:
             return (v1, v2, None if sa[2] is None else str(sa[2]),
                     int(sa[3]))
         return (v1, 0.0, None if sa[1] is None else str(sa[1]), int(sa[2]))
+
+
+def _fill_empty_aggs(aggregations: dict, aggs_request: dict) -> None:
+    """Synthesize ES empty-result shapes for aggregations no split reported
+    states for (empty index / zero matching splits). Shapes come from the
+    SAME finalize path as real results (identity states in, finalize out),
+    so empty and non-empty responses cannot diverge structurally."""
+    import numpy as np
+
+    from ..ops.aggs import HLL_NUM_REGISTERS, PCTL_NUM_BUCKETS
+    from ..query.aggregations import (DateHistogramAgg, HistogramAgg,
+                                      MetricAgg, RangeAgg, TermsAgg,
+                                      parse_aggs)
+    from .collector import finalize_aggregations
+    try:
+        specs = parse_aggs(aggs_request)
+    except Exception:  # noqa: BLE001 - request already validated upstream
+        return
+    empty_states: dict[str, dict] = {}
+    for spec in specs:
+        if spec.name in aggregations:
+            continue
+        if isinstance(spec, MetricAgg):
+            if spec.kind == "percentiles":
+                empty_states[spec.name] = {
+                    "kind": "percentiles",
+                    "sketch": np.zeros(PCTL_NUM_BUCKETS, dtype=np.int64),
+                    "percents": list(spec.percents), "keyed": spec.keyed}
+            elif spec.kind == "cardinality":
+                empty_states[spec.name] = {
+                    "kind": "cardinality",
+                    "hll": np.zeros(HLL_NUM_REGISTERS, dtype=np.int32)}
+            else:
+                empty_states[spec.name] = {
+                    "kind": spec.kind,
+                    "state": np.array([0.0, 0.0, 0.0, np.inf, -np.inf])}
+        elif isinstance(spec, RangeAgg):
+            empty_states[spec.name] = {
+                "kind": "range", "ranges": list(spec.ranges),
+                "bucket_map": {}}
+        elif isinstance(spec, TermsAgg):
+            empty_states[spec.name] = {
+                "kind": "terms", "bucket_map": {}, "size": spec.size,
+                "min_doc_count": spec.min_doc_count,
+                "order_desc": spec.order_by_count_desc}
+        elif isinstance(spec, (DateHistogramAgg, HistogramAgg)):
+            interval = (spec.interval_micros
+                        if isinstance(spec, DateHistogramAgg)
+                        else spec.interval)
+            empty_states[spec.name] = {
+                "kind": ("date_histogram"
+                         if isinstance(spec, DateHistogramAgg)
+                         else "histogram"),
+                "bucket_map": {}, "interval": interval, "origin": 0,
+                "min_doc_count": spec.min_doc_count,
+                "offset": getattr(spec, "offset_micros", 0)}
+    if empty_states:
+        aggregations.update(finalize_aggregations(empty_states))

@@ -246,11 +246,15 @@ class SearchService:
         """Stage 1 (prefetch-thread-safe): storage IO, plan lowering, and
         the async H2D transfer for one split group. Returns an opaque
         prepared unit for `_execute_group`."""
-        # the batch path has no search_after pushdown or secondary sort;
-        # the per-split path handles both
+        # the batch path has no search_after pushdown, secondary sort, or
+        # per-split terms truncation; the per-split path handles those
+        import json as _json
         if (len(group) > 1 and not search_request.search_after
                 and len(search_request.sort_fields) < 2
-                and string_sort_of(search_request, doc_mapper) is None):
+                and string_sort_of(search_request, doc_mapper) is None
+                and not any(key in _json.dumps(search_request.aggs or {})
+                            for key in ("split_size", "shard_size",
+                                        "segment_size"))):
             try:
                 readers = [self.context.reader(s) for s in group]
                 batch = build_batch(

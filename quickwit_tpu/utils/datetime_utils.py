@@ -93,6 +93,9 @@ def _parse_rfc3339(text: str) -> int | None:
 
 def format_micros_rfc3339(micros: int) -> str:
     dt = _dt.datetime.fromtimestamp(micros / MICROS, tz=_dt.timezone.utc)
+    if micros % MICROS == 0:
+        # reference Rfc3339 output drops zero subseconds
+        return dt.strftime("%Y-%m-%dT%H:%M:%S") + "Z"
     return dt.strftime("%Y-%m-%dT%H:%M:%S.%f") + "Z"
 
 

@@ -114,7 +114,7 @@ def aggregations_setup() -> list[dict]:
     ]
     return [
         _delete("aggregations"), _delete("empty_aggregations"),
-        _create("aggregations", fields),
+        _create("aggregations", fields, store_document_size=True),
         _create("empty_aggregations", [
             {"name": "date", "type": "datetime", "fast": True,
              "input_formats": ["rfc3339"],

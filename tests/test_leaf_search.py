@@ -568,3 +568,95 @@ def test_root_finalize_caps_materialized_empty_buckets():
             "name": "h"}
     with pytest.raises(ValueError, match="buckets"):
         _finalize_bucket_map(bucket_map, info)
+
+
+# --- round-2 aggregation breadth -----------------------------------------
+
+def _search_aggs(reader, aggs, query="*"):
+    request = SearchRequest(index_ids=["t"],
+                            query_ast=parse_query_string(query, ["body"]),
+                            max_hits=0, aggs=aggs)
+    response = leaf_search_single_split(request, MAPPER, reader, "s")
+    return finalize_aggregations(response.intermediate_aggs)
+
+
+def test_range_agg_with_overlap(reader):
+    """ES counts a doc in EVERY range it falls in (ranges may overlap)."""
+    result = _search_aggs(reader, {"lat": {"range": {
+        "field": "latency",
+        "ranges": [{"to": 100, "key": "low"},
+                   {"from": 50, "to": 150, "key": "mid"},
+                   {"from": 100, "key": "high"}]}}})
+    lats = [d["latency"] for d in DOCS]
+    buckets = {b["key"]: b["doc_count"] for b in result["lat"]["buckets"]}
+    assert buckets["low"] == sum(1 for v in lats if v < 100)
+    assert buckets["mid"] == sum(1 for v in lats if 50 <= v < 150)
+    assert buckets["high"] == sum(1 for v in lats if v >= 100)
+    # from/to echoed, all ranges emitted even at 0 docs
+    entries = {b["key"]: b for b in result["lat"]["buckets"]}
+    assert entries["mid"]["from"] == 50.0 and entries["mid"]["to"] == 150.0
+
+
+def test_range_agg_sub_metrics(reader):
+    result = _search_aggs(reader, {"lat": {
+        "range": {"field": "latency", "ranges": [{"to": 100}, {"from": 100}]},
+        "aggs": {"avg_lat": {"avg": {"field": "latency"}}}}})
+    lats = [d["latency"] for d in DOCS]
+    low = [v for v in lats if v < 100]
+    bucket = result["lat"]["buckets"][0]
+    assert bucket["doc_count"] == len(low)
+    assert bucket["avg_lat"]["value"] == pytest.approx(
+        sum(low) / len(low), rel=1e-6)
+
+
+def test_cardinality_agg(reader):
+    result = _search_aggs(reader, {
+        "sev": {"cardinality": {"field": "severity_text"}},
+        "tenants": {"cardinality": {"field": "tenant_id"}}})
+    # HLL with 256 registers: small cardinalities are near-exact
+    assert result["sev"]["value"] == 4
+    assert result["tenants"]["value"] == 5
+
+
+def test_extended_stats_agg(reader):
+    result = _search_aggs(reader, {"lat": {
+        "extended_stats": {"field": "latency"}}})
+    lats = np.array([d["latency"] for d in DOCS])
+    out = result["lat"]
+    assert out["count"] == len(lats)
+    assert out["sum_of_squares"] == pytest.approx(float((lats ** 2).sum()),
+                                                  rel=1e-9)
+    assert out["variance"] == pytest.approx(float(lats.var()), rel=1e-9)
+    assert out["std_deviation"] == pytest.approx(float(lats.std()), rel=1e-9)
+
+
+def test_multivalued_terms_agg():
+    """Array-valued raw text fields count each doc once per distinct term."""
+    mv_mapper = DocMapper(field_mappings=[
+        FieldMapping("tags", FieldType.TEXT, tokenizer="raw", fast=True),
+        FieldMapping("n", FieldType.U64, fast=True)])
+    writer = SplitWriter(mv_mapper)
+    writer.add_json_doc({"tags": ["nice"], "n": 1})
+    writer.add_json_doc({"tags": ["nice", "cool"], "n": 2})
+    writer.add_json_doc({"tags": ["cool", "cool", "rare"], "n": 3})
+    writer.add_json_doc({"n": 4})
+    storage = RamStorage(Uri.parse("ram:///mvterms"))
+    storage.put("mv.split", writer.finish())
+    mv_reader = SplitReader(storage, "mv.split")
+    request = SearchRequest(index_ids=["t"], query_ast=MatchAll(), max_hits=0,
+                            aggs={"tags": {"terms": {"field": "tags"}}})
+    response = leaf_search_single_split(request, mv_mapper, mv_reader, "mv")
+    result = finalize_aggregations(response.intermediate_aggs)
+    buckets = {b["key"]: b["doc_count"] for b in result["tags"]["buckets"]}
+    assert buckets == {"nice": 2, "cool": 2, "rare": 1}
+
+
+def test_date_histogram_offset_and_key_as_string(reader):
+    result = _search_aggs(reader, {"per_hour": {"date_histogram": {
+        "field": "timestamp", "fixed_interval": "1h",
+        "offset": "-30m"}}})
+    buckets = result["per_hour"]["buckets"]
+    # boundaries shifted by -30m: keys ≡ 1800s mod 3600s
+    assert all(int(b["key"]) % 3_600_000 == 1_800_000 for b in buckets)
+    assert all(b["key_as_string"].endswith(":30:00Z") for b in buckets)
+    assert sum(b["doc_count"] for b in buckets) == NUM_DOCS

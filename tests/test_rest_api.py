@@ -171,7 +171,11 @@ def test_es_bulk_and_cat(api):
                           "tenant_id": 9, "body": "bulk doc"}) + "\n").encode()
     status, result = api.request("POST", "/api/v1/_elastic/_bulk", bulk)
     assert status == 200 and result["errors"] is False
-    status, result = api.request("GET", "/api/v1/_elastic/_cat/indices")
+    # format=json is required (reference 400s on any other format)
+    status, _ = api.request("GET", "/api/v1/_elastic/_cat/indices")
+    assert status == 400
+    status, result = api.request(
+        "GET", "/api/v1/_elastic/_cat/indices?format=json")
     assert status == 200
     entry = next(e for e in result if e["index"] == "hdfs-logs")
     assert int(entry["docs.count"]) == 101
