@@ -79,6 +79,8 @@ def load_node_config(path: Optional[str] = None,
         tls_ca_path=tls.get("ca_path"),
         tls_skip_verify=bool(tls.get("skip_verify", False)),
         gossip_enabled=bool(data.get("gossip", False)),
+        replication_factor=int(pick("QW_REPLICATION_FACTOR",
+                                    "replication_factor", 1)),
     )
 
 

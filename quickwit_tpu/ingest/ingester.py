@@ -21,6 +21,15 @@ from typing import Any, Callable, Optional
 from .wal import RecordLog
 
 
+class ReplicationGap(ValueError):
+    """Follower is missing records before the offered batch; carries the
+    follower's next position so the leader can backfill."""
+
+    def __init__(self, message: str, have: int):
+        super().__init__(message)
+        self.have = have
+
+
 class ShardState(str, Enum):
     OPEN = "open"
     CLOSED = "closed"  # no new writes; drains then gets deleted
@@ -34,6 +43,13 @@ class Shard:
     log: RecordLog
     state: ShardState = ShardState.OPEN
     publish_position: int = 0  # truncation watermark
+    # serializes persist+replicate as one critical section: replication
+    # stays batch-ordered and a failed chain rolls the local tail back
+    persist_lock: threading.Lock = field(default_factory=threading.Lock)
+    # "leader" shards accept router writes and are drained by the indexer;
+    # "replica" shards only accept replica_persist and sit out of drains
+    # until promoted (reference: chained replication, replication.rs)
+    role: str = "leader"
 
 
 def shard_queue_id(index_uid: str, source_id: str, shard_id: str) -> str:
@@ -44,10 +60,15 @@ def shard_queue_id(index_uid: str, source_id: str, shard_id: str) -> str:
 
 class Ingester:
     def __init__(self, wal_dir: str, fsync: bool = True,
-                 replicate_to: Optional[Callable[[str, list[bytes]], None]] = None):
+                 replicate_to: Optional[Callable[
+                     [str, str, str, int, list[bytes]], None]] = None):
         self.wal_dir = wal_dir
         self.fsync = fsync
         self.replicate_to = replicate_to
+        # on_truncate(index_uid, source_id, shard_id, position): leader-side
+        # hook propagating truncation to the replica (space reclaim)
+        self.on_truncate: Optional[Callable[[str, str, str, int],
+                                            None]] = None
         self._shards: dict[str, Shard] = {}
         self._lock = threading.Lock()
         self._recover()
@@ -65,35 +86,70 @@ class Ingester:
                 for shard_id in os.listdir(source_path):
                     queue_id = f"{index_dir}/{source_id}/{shard_id}"
                     index_uid = index_dir.replace("@", ":")
+                    shard_dir = os.path.join(source_path, shard_id)
+                    role = "leader"
+                    role_path = os.path.join(shard_dir, "_role")
+                    if os.path.exists(role_path):
+                        with open(role_path) as f:
+                            role = f.read().strip() or "leader"
                     self._shards[queue_id] = Shard(
                         index_uid=index_uid, source_id=source_id,
-                        shard_id=shard_id,
-                        log=RecordLog(os.path.join(source_path, shard_id),
-                                      fsync=self.fsync))
+                        shard_id=shard_id, role=role,
+                        log=RecordLog(shard_dir, fsync=self.fsync))
 
     # --- shard lifecycle ---------------------------------------------------
-    def open_shard(self, index_uid: str, source_id: str, shard_id: str) -> Shard:
+    def open_shard(self, index_uid: str, source_id: str, shard_id: str,
+                   role: str = "leader") -> Shard:
         queue_id = shard_queue_id(index_uid, source_id, shard_id)
         with self._lock:
             shard = self._shards.get(queue_id)
             if shard is None:
+                shard_dir = os.path.join(self.wal_dir, queue_id)
                 shard = Shard(
                     index_uid=index_uid, source_id=source_id, shard_id=shard_id,
-                    log=RecordLog(os.path.join(self.wal_dir, queue_id),
-                                  fsync=self.fsync))
+                    role=role,
+                    log=RecordLog(shard_dir, fsync=self.fsync))
+                if role != "leader":
+                    self._write_role(shard_dir, role)
                 self._shards[queue_id] = shard
             return shard
+
+    @staticmethod
+    def _write_role(shard_dir: str, role: str) -> None:
+        os.makedirs(shard_dir, exist_ok=True)
+        with open(os.path.join(shard_dir, "_role"), "w") as f:
+            f.write(role)
+
+    def promote_replica(self, queue_id: str) -> bool:
+        """Replica → leader (the leader ingester died; this copy takes over
+        draining — reference: AdviseResetShards / shard re-open,
+        ingest_controller.rs:204). Checkpoint continuity holds because the
+        replica hosts the SAME shard id at the same WAL positions."""
+        with self._lock:
+            shard = self._shards.get(queue_id)
+            if shard is None or shard.role == "leader":
+                return False
+            shard.role = "leader"
+            self._write_role(os.path.join(self.wal_dir, queue_id), "leader")
+            return True
+
+    def replica_shards(self) -> list[tuple[str, Shard]]:
+        with self._lock:
+            return [(qid, s) for qid, s in self._shards.items()
+                    if s.role == "replica"]
 
     def close_shard(self, index_uid: str, source_id: str, shard_id: str) -> None:
         shard = self._shards.get(shard_queue_id(index_uid, source_id, shard_id))
         if shard is not None:
             shard.state = ShardState.CLOSED
 
-    def list_shards(self, index_uid: Optional[str] = None) -> list[Shard]:
+    def list_shards(self, index_uid: Optional[str] = None,
+                    include_replicas: bool = False) -> list[Shard]:
         with self._lock:  # snapshot: persist/open_shard mutate concurrently
             shards = list(self._shards.values())
         return [s for s in shards
-                if index_uid is None or s.index_uid == index_uid]
+                if (index_uid is None or s.index_uid == index_uid)
+                and (include_replicas or s.role == "leader")]
 
     def shard(self, index_uid: str, source_id: str, shard_id: str) -> Optional[Shard]:
         return self._shards.get(shard_queue_id(index_uid, source_id, shard_id))
@@ -106,12 +162,69 @@ class Ingester:
         shard = self.open_shard(index_uid, source_id, shard_id)
         if shard.state is not ShardState.OPEN:
             raise ValueError(f"shard {shard_id!r} is closed")
+        if shard.role != "leader":
+            raise ValueError(f"shard {shard_id!r} is a replica")
         payloads = [json.dumps(d, separators=(",", ":")).encode() for d in docs]
-        first, last = shard.log.append_batch(payloads)
-        if self.replicate_to is not None:
-            self.replicate_to(shard_queue_id(index_uid, source_id, shard_id),
-                              payloads)
+        with shard.persist_lock:
+            # one critical section per shard: replication sees batches in
+            # WAL order, and a failed chain rolls the local tail back so
+            # the ack means "durable on leader AND follower or neither"
+            # (reference: replication.rs persist semantics; a client retry
+            # after an error therefore cannot duplicate documents)
+            state = shard.log.tail_state()
+            first, last = shard.log.append_batch(payloads)
+            if self.replicate_to is not None:
+                try:
+                    self.replicate_to(index_uid, source_id, shard.shard_id,
+                                      first, payloads)
+                except Exception:
+                    shard.log.rollback_to(state)
+                    raise
         return first, last
+
+    def replica_persist(self, index_uid: str, source_id: str, shard_id: str,
+                        first_position: int, payloads: list[bytes]) -> int:
+        """Follower side of chained replication: position-aligned append.
+        Idempotent — records already present (leader retry) are skipped;
+        a gap (missed batch) is an error the leader must handle."""
+        shard = self.open_shard(index_uid, source_id, shard_id,
+                                role="replica")
+        if shard.role == "leader":
+            raise ValueError(
+                f"shard {shard_id!r} is led from this node; refusing to "
+                "replicate onto it")
+        next_position = shard.log.next_position
+        if first_position > next_position:
+            raise ReplicationGap(
+                f"replication gap on {shard_id!r}: have {next_position}, "
+                f"got batch at {first_position}", have=next_position)
+        skip = next_position - first_position
+        if skip >= len(payloads):
+            return next_position - 1  # full batch already replicated
+        shard.log.append_batch(payloads[skip:])
+        return shard.log.next_position - 1
+
+    def replica_reset(self, index_uid: str, source_id: str, shard_id: str,
+                      position: int) -> None:
+        """Restart a replica log at `position` — used when the leader's
+        retained WAL no longer covers the follower's gap (the missing
+        records are already published; the shared metastore checkpoint is
+        the durability floor there)."""
+        shard = self.open_shard(index_uid, source_id, shard_id,
+                                role="replica")
+        if shard.role == "leader":
+            raise ValueError(f"shard {shard_id!r} is led from this node")
+        shard.log.reset_to(position)
+
+    def replica_truncate(self, index_uid: str, source_id: str,
+                         shard_id: str, up_to_position: int) -> None:
+        """Follower-side truncation behind the leader's published
+        checkpoint (replica WALs must not grow without bound)."""
+        shard = self.shard(index_uid, source_id, shard_id)
+        if shard is not None and shard.role == "replica":
+            shard.publish_position = max(shard.publish_position,
+                                         up_to_position)
+            shard.log.truncate(up_to_position)
 
     def fetch(self, index_uid: str, source_id: str, shard_id: str,
               from_position: int, max_records: int = 10_000
@@ -132,6 +245,14 @@ class Ingester:
         if shard is not None:
             shard.publish_position = max(shard.publish_position, up_to_position)
             shard.log.truncate(up_to_position)
+            if self.on_truncate is not None and shard.role == "leader":
+                # propagate to the replica (best-effort: replicas re-derive
+                # the watermark from the shared metastore at promotion)
+                try:
+                    self.on_truncate(index_uid, source_id, shard_id,
+                                     up_to_position)
+                except Exception:  # noqa: BLE001 - space reclaim only
+                    pass
 
     # --- observability ------------------------------------------------------
     def shard_throughput_state(self) -> dict[str, dict[str, int]]:

@@ -158,6 +158,61 @@ class RecordLog:
                 continue
         return out
 
+    # --- tail rollback (replication atomicity) -----------------------------
+    def tail_state(self) -> tuple:
+        """Opaque pre-append snapshot for `rollback_to` — taken by a caller
+        holding the batch atomic (persist+replicate) critical section."""
+        with self._lock:
+            active_path = self._segments[-1][1] if self._segments else None
+            # on-disk size, not _active_size: after recovery the active file
+            # holds bytes appended before restart that _roll hasn't measured
+            size = (os.path.getsize(active_path)
+                    if active_path and os.path.exists(active_path) else 0)
+            return (self.next_position, active_path, size,
+                    len(self._segments))
+
+    def rollback_to(self, state: tuple) -> None:
+        """Undo appends made since `tail_state()` (same critical section —
+        no interleaved appends): chained replication needs 'durable on both
+        or neither', so a failed replication rolls the local tail back."""
+        next_position, active_path, active_size, num_segments = state
+        with self._lock:
+            # drop any segment the rolled-back append created
+            while len(self._segments) > num_segments:
+                _, path = self._segments.pop()
+                if self._active_file is not None:
+                    self._active_file.close()
+                    self._active_file = None
+                if os.path.exists(path):
+                    os.unlink(path)
+            if num_segments == 0:
+                if self._active_file is not None:
+                    self._active_file.close()
+                self._active_file = None
+                self._active_size = 0
+            elif active_path is not None and os.path.exists(active_path):
+                if self._active_file is not None:
+                    self._active_file.close()
+                with open(active_path, "r+b") as f:
+                    f.truncate(active_size)
+                self._active_file = open(active_path, "ab")
+                self._active_size = active_size
+            self.next_position = next_position
+
+    def reset_to(self, position: int) -> None:
+        """Drop everything and restart the log at `position` (replica
+        catch-up past the leader's truncation watermark)."""
+        with self._lock:
+            if self._active_file is not None:
+                self._active_file.close()
+                self._active_file = None
+            for _, path in self._segments:
+                if os.path.exists(path):
+                    os.unlink(path)
+            self._segments = []
+            self._active_size = 0
+            self.next_position = position
+
     # --- truncate ----------------------------------------------------------
     def truncate(self, up_to_position: int) -> int:
         """Drop segments entirely below `up_to_position` (exclusive).

@@ -26,6 +26,11 @@ class HttpStatusError(HttpTransportError):
     """Peer answered with a non-200 — an application error, NOT evidence the
     peer is dead; does not open the circuit."""
 
+    def __init__(self, message: str, status: int = 0, body: bytes = b""):
+        super().__init__(message)
+        self.status = status
+        self.body = body
+
 
 class HttpSearchClient:
     def __init__(self, endpoint: str, timeout_secs: float = 30.0,
@@ -73,8 +78,11 @@ class HttpSearchClient:
             body = response.read()
             if response.status != 200:
                 raise HttpStatusError(
-                    f"{self.endpoint}{path} -> {response.status}: {body[:200]!r}")
+                    f"{self.endpoint}{path} -> {response.status}: {body[:200]!r}",
+                    status=response.status, body=body)
             return json.loads(body)
+        except HttpStatusError:
+            raise  # ConnectionError subclass: must not be re-wrapped below
         except (OSError, http.client.HTTPException) as exc:
             raise HttpTransportError(f"{self.endpoint}{path}: {exc}") from exc
         finally:
@@ -89,3 +97,7 @@ class HttpSearchClient:
 
     def heartbeat(self, payload: dict[str, Any]) -> dict[str, Any]:
         return self._post("/internal/heartbeat", payload)
+
+    def replicate(self, payload: dict[str, Any]) -> dict[str, Any]:
+        """Chained-replication append on the follower (ingest v2)."""
+        return self._post("/internal/replicate", payload)

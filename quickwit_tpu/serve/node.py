@@ -9,6 +9,7 @@ and wire remote clients (HTTP) for peers. A node runs any subset of roles
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
 import time
@@ -61,6 +62,10 @@ class NodeConfig:
     # disseminates over UDP on the REST port number and the REST heartbeat
     # loop is not started. peer_seeds serve as gossip seeds unchanged.
     gossip_enabled: bool = False
+    # ingest v2 chained replication (reference replication_factor): 2 =
+    # every persisted batch is synchronously replicated to one follower
+    # before the ack; follower replicas promote when the leader dies
+    replication_factor: int = 1
 
     @property
     def tls_enabled(self) -> bool:
@@ -209,8 +214,12 @@ class Node:
         from ..ingest.router import IngestRouter
         data_dir = config.data_dir or tempfile.mkdtemp(prefix="qwt-data-")
         self.data_dir = data_dir
-        self.ingester = Ingester(os.path.join(data_dir, "wal"),
-                                 fsync=config.wal_fsync)
+        self.ingester = Ingester(
+            os.path.join(data_dir, "wal"), fsync=config.wal_fsync,
+            replicate_to=(self._replicate_batch
+                          if config.replication_factor > 1 else None))
+        if config.replication_factor > 1:
+            self.ingester.on_truncate = self._replica_truncate
         self.ingest_router = IngestRouter(self.ingester,
                                           shard_prefix=config.node_id)
         from ..control_plane.scheduler import IndexingScheduler
@@ -310,6 +319,121 @@ class Node:
                 return self.metastore.index_metadata(index_id)
 
     # ------------------------------------------------------------------
+    def _replicate_batch(self, index_uid: str, source_id: str,
+                         shard_id: str, first_position: int,
+                         payloads: list[bytes]) -> None:
+        """Leader side of chained replication: pick the follower by
+        rendezvous on the shard's queue id among OTHER live indexer nodes
+        and replicate synchronously; the persist ack implies follower
+        durability (reference: replication.rs + persist semantics)."""
+        import base64
+
+        from ..common.rendezvous import sort_by_rendezvous_hash
+        from ..ingest.ingester import shard_queue_id
+        peers = [m for m in self.cluster.members()
+                 if m.node_id != self.config.node_id
+                 and "indexer" in m.roles and m.rest_endpoint]
+        if not peers:
+            raise IOError(
+                "replication_factor > 1 but no live follower is available")
+        queue_id = shard_queue_id(index_uid, source_id, shard_id)
+        ordered = sort_by_rendezvous_hash(queue_id,
+                                          [m.node_id for m in peers])
+        follower = next(m for m in peers if m.node_id == ordered[0])
+        client = self.clients.get(follower.node_id)
+        if client is None:
+            from .http_client import HttpSearchClient
+            client = HttpSearchClient(follower.rest_endpoint,
+                                      **self.config.client_tls_kwargs())
+            # cache: per-batch client construction would defeat the
+            # circuit breaker and pay a TCP/TLS handshake per persist
+            self.clients[follower.node_id] = client
+
+        def send(first: int, batch: list[bytes], reset: bool = False):
+            return client.replicate({
+                "index_uid": index_uid, "source_id": source_id,
+                "shard_id": shard_id, "first_position": first,
+                "payloads": [base64.b64encode(p).decode() for p in batch],
+                **({"reset": True} if reset else {}),
+            })
+
+        from .http_client import HttpStatusError
+        try:
+            send(first_position, payloads)
+            return
+        except HttpStatusError as exc:
+            if exc.status != 409:
+                raise
+            gap_body = exc.body
+        # gap: a fresh follower (rendezvous re-pick after membership change)
+        # is missing earlier records — backfill from the local WAL. When our
+        # retained WAL starts past the follower's position (truncated behind
+        # the published checkpoint), the follower resets to what we hold:
+        # the metastore checkpoint already covers the records below.
+        shard = self.ingester.shard(index_uid, source_id, shard_id)
+        replica_pos = json.loads(gap_body or b"{}").get(
+            "replica_position", 0)
+        records = shard.log.read_from(int(replica_pos), max_records=1 << 20)
+        if not records:
+            raise IOError(f"cannot backfill follower for {shard_id!r}: "
+                          "no retained records")
+        start = records[0][0]
+        send(start, [p for _, p in records], reset=(start > replica_pos))
+
+    def _replica_truncate(self, index_uid: str, source_id: str,
+                          shard_id: str, position: int) -> None:
+        """Best-effort truncation propagation to the follower (replica
+        WALs must not grow without bound while the leader reclaims)."""
+        from ..common.rendezvous import sort_by_rendezvous_hash
+        from ..ingest.ingester import shard_queue_id
+        peers = [m for m in self.cluster.members()
+                 if m.node_id != self.config.node_id
+                 and "indexer" in m.roles and m.rest_endpoint]
+        if not peers:
+            return
+        queue_id = shard_queue_id(index_uid, source_id, shard_id)
+        ordered = sort_by_rendezvous_hash(queue_id,
+                                          [m.node_id for m in peers])
+        follower = next(m for m in peers if m.node_id == ordered[0])
+        client = self.clients.get(follower.node_id)
+        if client is None:
+            return
+        client._post("/internal/replica_truncate", {
+            "index_uid": index_uid, "source_id": source_id,
+            "shard_id": shard_id, "position": position})
+
+    def promote_orphaned_replicas(self, grace_secs: float = 30.0) -> list[str]:
+        """Replica shards whose leader node is no longer a live cluster
+        member get promoted and drained from here (the reference's
+        AdviseResetShards / shard re-open on ingester death). Shard ids are
+        node-prefixed ("{node_id}-shard-NN"), which names the leader.
+
+        Promotion is irreversible (the old leader's persists are refused
+        after it), so it only fires after the leader has been CONTINUOUSLY
+        absent for `grace_secs` — a heartbeat blip, GC pause, or this
+        node's own fresh restart (empty membership view) must not
+        split-brain the shard."""
+        alive = {m.node_id for m in self.cluster.members()}
+        dead_since = getattr(self, "_leader_dead_since", None)
+        if dead_since is None:
+            dead_since = self._leader_dead_since = {}
+        now = time.monotonic()
+        promoted = []
+        for queue_id, shard in self.ingester.replica_shards():
+            leader_node = shard.shard_id.rsplit("-shard-", 1)[0]
+            if leader_node in alive:
+                dead_since.pop(leader_node, None)
+                continue
+            first_seen_dead = dead_since.setdefault(leader_node, now)
+            if now - first_seen_dead < grace_secs:
+                continue
+            if self.ingester.promote_replica(queue_id):
+                promoted.append(shard.shard_id)
+                logger.warning(
+                    "promoted replica shard %s (leader %s dead for %.0fs)",
+                    shard.shard_id, leader_node, now - first_seen_dead)
+        return promoted
+
     def ingest_v2(self, index_id: str, docs: list[dict]) -> dict[str, Any]:
         """Durable WAL ingest (v2 path): docs are fsync'd into shard queues
         and become searchable after the next ingest pipeline pass."""
@@ -504,6 +628,9 @@ class Node:
             # publish fails the version check and retries next tick).
             if "indexer" not in self.config.roles:
                 return
+            # failover: adopt replica shards whose leader died before
+            # draining (checkpoints continue at the same positions)
+            self.promote_orphaned_replicas()
             for metadata in self.metastore.list_indexes():
                 shards = self.ingester.list_shards(metadata.index_uid)
                 if any(s.log.next_position > s.publish_position for s in shards):

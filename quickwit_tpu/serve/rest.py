@@ -192,6 +192,33 @@ class RestServer:
         if path == "/internal/fetch_docs" and method == "POST":
             request = FetchDocsRequest.from_dict(json.loads(body))
             return 200, node.search_service.fetch_docs(request)
+        if path == "/internal/replicate" and method == "POST":
+            # follower side of ingest chained replication
+            import base64
+
+            from ..ingest.ingester import ReplicationGap
+            payload = json.loads(body)
+            if payload.get("reset"):
+                # leader's retained WAL starts past our gap: restart the
+                # replica log at the offered position (records below it
+                # are already published; the metastore checkpoint covers)
+                node.ingester.replica_reset(
+                    payload["index_uid"], payload["source_id"],
+                    payload["shard_id"], int(payload["first_position"]))
+            try:
+                last = node.ingester.replica_persist(
+                    payload["index_uid"], payload["source_id"],
+                    payload["shard_id"], int(payload["first_position"]),
+                    [base64.b64decode(p) for p in payload["payloads"]])
+            except ReplicationGap as gap:
+                return 409, {"gap": True, "replica_position": gap.have}
+            return 200, {"replica_position": last}
+        if path == "/internal/replica_truncate" and method == "POST":
+            payload = json.loads(body)
+            node.ingester.replica_truncate(
+                payload["index_uid"], payload["source_id"],
+                payload["shard_id"], int(payload["position"]))
+            return 200, {"ok": True}
         if path == "/internal/heartbeat" and method == "POST":
             payload = json.loads(body)
             from ..cluster.membership import (ClusterMember,

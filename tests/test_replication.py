@@ -1,0 +1,243 @@
+"""Ingest v2 chained replication + ingester-death failover
+(reference: `quickwit-ingest/src/ingest_v2/replication.rs`,
+`ingest_controller.rs:204` AdviseResetShards)."""
+
+import http.client
+import json
+import time
+
+import pytest
+
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.ingest.ingester import Ingester, shard_queue_id
+from quickwit_tpu.serve import Node, NodeConfig, RestServer
+from quickwit_tpu.storage import StorageResolver
+
+INDEX_CONFIG = {
+    "index_id": "rep-logs",
+    "doc_mapping": {
+        "field_mappings": [
+            {"name": "ts", "type": "datetime", "fast": True,
+             "input_formats": ["unix_timestamp"]},
+            {"name": "body", "type": "text"},
+        ],
+        "timestamp_field": "ts",
+        "default_search_fields": ["body"],
+    },
+}
+
+
+# --- unit level ----------------------------------------------------------
+def test_replica_persist_alignment_and_idempotence(tmp_path):
+    follower = Ingester(str(tmp_path / "wal"), fsync=False)
+    batch = [b'{"n":0}', b'{"n":1}']
+    last = follower.replica_persist("idx:1", "src", "a-shard-00", 0, batch)
+    assert last == 1
+    # leader retry of the same batch: skipped, not duplicated
+    last = follower.replica_persist("idx:1", "src", "a-shard-00", 0, batch)
+    assert last == 1
+    # partial overlap: only the new record appends
+    last = follower.replica_persist("idx:1", "src", "a-shard-00", 1,
+                                    [b'{"n":1}', b'{"n":2}'])
+    assert last == 2
+    # a gap is an error (batch 5.. while we hold ..2)
+    with pytest.raises(ValueError, match="gap"):
+        follower.replica_persist("idx:1", "src", "a-shard-00", 5, [b"x"])
+    shard = follower.shard("idx:1", "src", "a-shard-00")
+    assert shard.role == "replica"
+    records = shard.log.read_from(0)
+    assert [p for _, p in records] == [b'{"n":0}', b'{"n":1}', b'{"n":2}']
+    # replica shards accept no router writes and sit out of drains
+    with pytest.raises(ValueError, match="replica"):
+        follower.persist("idx:1", "src", "a-shard-00", [{"n": 9}])
+    assert follower.list_shards("idx:1") == []
+    assert len(follower.list_shards("idx:1", include_replicas=True)) == 1
+
+
+def test_replica_role_survives_restart_and_promotion(tmp_path):
+    wal = str(tmp_path / "wal")
+    follower = Ingester(wal, fsync=False)
+    follower.replica_persist("idx:1", "src", "a-shard-00", 0, [b"r0"])
+    del follower
+
+    reopened = Ingester(wal, fsync=False)
+    [(queue_id, shard)] = reopened.replica_shards()
+    assert shard.role == "replica"
+    assert reopened.promote_replica(queue_id)
+    assert reopened.list_shards("idx:1")[0].shard_id == "a-shard-00"
+    del reopened
+    # promotion is durable too
+    again = Ingester(wal, fsync=False)
+    assert again.replica_shards() == []
+    assert again.list_shards("idx:1")[0].role == "leader"
+
+
+# --- two-node failover ---------------------------------------------------
+def rest(port, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    data = None
+    if body is not None:
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    conn.request(method, path, body=data)
+    response = conn.getresponse()
+    payload = response.read()
+    conn.close()
+    return response.status, (json.loads(payload) if payload else None)
+
+
+@pytest.fixture()
+def replicated_pair(tmp_path):
+    resolver = StorageResolver.for_test()
+    nodes, servers = [], []
+    for i in range(2):
+        node = Node(NodeConfig(
+            node_id=f"rep-{i}", rest_port=0,
+            metastore_uri="ram:///rep/metastore",
+            default_index_root_uri="ram:///rep/indexes",
+            data_dir=str(tmp_path / f"node{i}"),
+            wal_fsync=False, replication_factor=2),
+            storage_resolver=resolver)
+        server = RestServer(node)
+        server.start()
+        nodes.append(node)
+        servers.append(server)
+    from quickwit_tpu.cluster.membership import ClusterMember
+    for i, node in enumerate(nodes):
+        peer = servers[1 - i]
+        node.cluster.upsert_heartbeat(ClusterMember(
+            node_id=f"rep-{1 - i}",
+            roles=("searcher", "indexer", "metastore"),
+            rest_endpoint=f"127.0.0.1:{peer.port}"))
+    yield nodes, servers
+    for server in servers:
+        server.stop()
+
+
+def test_persist_replicates_and_failover_loses_nothing(replicated_pair,
+                                                       tmp_path):
+    nodes, servers = replicated_pair
+    leader, follower = nodes
+
+    status, _ = rest(servers[0].port, "POST", "/api/v1/indexes", INDEX_CONFIG)
+    assert status == 200
+    metadata = leader.metastore.index_metadata("rep-logs")
+    uid = metadata.index_uid
+
+    # ingest 30 docs through the v2 WAL path on the leader
+    for batch in range(3):
+        docs = [{"ts": 1_700_000_000 + batch * 10 + i,
+                 "body": f"replicated doc {batch}-{i}"} for i in range(10)]
+        result = leader.ingest_v2("rep-logs", docs)
+        assert result["num_docs"] == 10
+
+    # every batch is on the follower as a replica at identical positions
+    leader_shards = leader.ingester.list_shards(uid)
+    assert leader_shards, "leader hosts the shard"
+    shard_id = leader_shards[0].shard_id
+    replica = follower.ingester.shard(uid, "_ingest-source", shard_id)
+    assert replica is not None and replica.role == "replica"
+    assert replica.log.next_position == \
+        leader.ingester.shard(uid, "_ingest-source", shard_id) \
+        .log.next_position == 30
+
+    # leader drains the first 10 docs into a split, then DIES mid-stream
+    leader.run_ingest_pass("rep-logs")  # publishes all 30 actually
+    # ... so simulate the harder case: more docs arrive, leader dies
+    leader.ingest_v2("rep-logs", [
+        {"ts": 1_700_000_100 + i, "body": f"post-crash doc {i}"}
+        for i in range(5)])
+    servers[0].stop()
+    follower.cluster.leave("rep-0")
+
+    # promotion waits out the grace period (a heartbeat blip must not
+    # split-brain), then fires
+    assert follower.promote_orphaned_replicas(grace_secs=3600) == []
+    promoted = follower.promote_orphaned_replicas(grace_secs=0)
+    assert promoted == [shard_id]
+    follower.run_ingest_pass("rep-logs")
+
+    # zero doc loss: all 35 docs searchable through the follower
+    status, result = rest(servers[1].port, "GET",
+                          "/api/v1/rep-logs/search?query=body:doc&max_hits=0")
+    assert status == 200
+    assert result["num_hits"] == 35
+
+    # checkpoints are exact: a second drain pass publishes nothing new
+    out = follower.run_ingest_pass("rep-logs")
+    assert out.get("num_docs_indexed", 0) == 0
+
+    # the promoted shard keeps accepting writes (without replication:
+    # no follower remains, so RF degrades with an error we tolerate here)
+    follower.config.replication_factor = 1
+    follower.ingester.replicate_to = None
+    follower.ingest_v2("rep-logs", [{"ts": 1_700_000_200,
+                                     "body": "after failover doc"}])
+    follower.run_ingest_pass("rep-logs")
+    status, result = rest(servers[1].port, "GET",
+                          "/api/v1/rep-logs/search?query=body:doc&max_hits=0")
+    assert result["num_hits"] == 36
+
+
+def test_failed_replication_rolls_back_leader_wal(tmp_path):
+    """'Durable on both or neither': a failed chain leaves NO local copy,
+    so a client retry cannot duplicate documents."""
+    calls = {"n": 0}
+
+    def flaky_replicate(index_uid, source_id, shard_id, first, payloads):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise IOError("follower unreachable")
+
+    leader = Ingester(str(tmp_path / "wal"), fsync=False,
+                      replicate_to=flaky_replicate)
+    with pytest.raises(IOError):
+        leader.persist("idx:1", "src", "n0-shard-00", [{"n": 0}, {"n": 1}])
+    shard = leader.shard("idx:1", "src", "n0-shard-00")
+    assert shard.log.next_position == 0
+    assert shard.log.read_from(0) == []
+    # the retry lands at the SAME positions — no duplicates
+    first, last = leader.persist("idx:1", "src", "n0-shard-00",
+                                 [{"n": 0}, {"n": 1}])
+    assert (first, last) == (0, 1)
+    assert len(shard.log.read_from(0)) == 2
+
+
+def test_gap_backfill_catches_up_fresh_follower(replicated_pair):
+    """A follower picked mid-stream (rendezvous re-pick) starts empty; the
+    leader backfills it from its local WAL instead of failing forever."""
+    nodes, servers = replicated_pair
+    leader, follower = nodes
+    status, _ = rest(servers[0].port, "POST", "/api/v1/indexes", INDEX_CONFIG)
+    assert status == 200
+    uid = leader.metastore.index_metadata("rep-logs").index_uid
+
+    # first batch replicates normally; then simulate the follower losing
+    # its replica (fresh node) before the second batch
+    leader.ingest_v2("rep-logs", [{"ts": 1, "body": "a"}, {"ts": 2, "body": "b"}])
+    shard_id = leader.ingester.list_shards(uid)[0].shard_id
+    replica = follower.ingester.shard(uid, "_ingest-source", shard_id)
+    replica.log.reset_to(0)
+    assert replica.log.next_position == 0
+
+    leader.ingest_v2("rep-logs", [{"ts": 3, "body": "c"}])
+    # backfill brought the follower fully up to date
+    assert replica.log.next_position == 3
+    assert len(replica.log.read_from(0)) == 3
+
+
+def test_truncation_propagates_to_replica(replicated_pair):
+    nodes, servers = replicated_pair
+    leader, follower = nodes
+    status, _ = rest(servers[0].port, "POST", "/api/v1/indexes", INDEX_CONFIG)
+    assert status == 200
+    uid = leader.metastore.index_metadata("rep-logs").index_uid
+    leader.ingest_v2("rep-logs", [
+        {"ts": i, "body": f"doc {i}"} for i in range(5)])
+    shard_id = leader.ingester.list_shards(uid)[0].shard_id
+    # draining publishes and truncates the leader WAL; the follower's
+    # replica truncates along with it
+    leader.run_ingest_pass("rep-logs")
+    leader.ingest_v2("rep-logs", [{"ts": 99, "body": "tail doc"}])
+    leader.run_ingest_pass("rep-logs")
+    replica = follower.ingester.shard(uid, "_ingest-source", shard_id)
+    assert replica.publish_position >= 5
