@@ -120,6 +120,33 @@ class Metastore:
     def last_delete_opstamp(self, index_uid: str) -> int:
         raise NotImplementedError
 
+    # --- index templates (shared logic; backends store/list/delete) -----
+    @staticmethod
+    def validate_template(template: dict) -> None:
+        patterns = template.get("index_id_patterns")
+        if (not isinstance(template.get("template_id"), str)
+                or not isinstance(patterns, list) or not patterns
+                or not all(isinstance(p, str) for p in patterns)):
+            raise MetastoreError(
+                "template requires a string template_id and a non-empty "
+                "list of string index_id_patterns", kind="invalid_argument")
+
+    def find_index_template(self, index_id: str):
+        """Highest-priority template whose pattern matches (reference:
+        index_template/mod.rs:35)."""
+        import fnmatch
+        candidates = [
+            t for t in self.list_index_templates()
+            if any(fnmatch.fnmatch(index_id, p)
+                   for p in t["index_id_patterns"])
+        ]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda t: t.get("priority", 0))
+
+    def list_index_templates(self) -> list[dict]:
+        raise NotImplementedError
+
     def update_splits_delete_opstamp(self, index_uid: str,
                                      split_ids: Iterable[str], opstamp: int) -> None:
         raise NotImplementedError

@@ -190,13 +190,7 @@ class FileBackedMetastore(Metastore):
             return []
 
     def create_index_template(self, template: dict) -> None:
-        patterns = template.get("index_id_patterns")
-        if (not isinstance(template.get("template_id"), str)
-                or not isinstance(patterns, list) or not patterns
-                or not all(isinstance(p, str) for p in patterns)):
-            raise MetastoreError(
-                "template requires a string template_id and a non-empty "
-                "list of string index_id_patterns", kind="invalid_argument")
+        self.validate_template(template)
         with self._lock:
             templates = [t for t in self._load_templates()
                          if t["template_id"] != template["template_id"]]
@@ -216,15 +210,6 @@ class FileBackedMetastore(Metastore):
                                      kind="not_found")
             self.storage.put(TEMPLATES_PATH, json.dumps(kept).encode())
 
-    def find_index_template(self, index_id: str):
-        import fnmatch
-        candidates = [
-            t for t in self.list_index_templates()
-            if any(fnmatch.fnmatch(index_id, p) for p in t["index_id_patterns"])
-        ]
-        if not candidates:
-            return None
-        return max(candidates, key=lambda t: t.get("priority", 0))
 
     # --- index lifecycle ---------------------------------------------------
     def create_index(self, index_metadata: IndexMetadata) -> None:

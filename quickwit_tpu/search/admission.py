@@ -1,0 +1,146 @@
+"""Byte-accurate HBM admission for leaf search.
+
+Role of the reference's `SearchPermitProvider`
+(`quickwit-search/src/search_permit_provider.rs:43,436`): split searches
+must not materialize more memory than the device has. The reference
+estimates pessimistically and corrects after warmup; here the lowered
+plan KNOWS every array's exact byte size before any transfer, so
+admission is exact:
+
+- a query's NEW transfer bytes are **pinned** for the duration of its
+  execution; admission is FIFO (a ticket queue — large requests cannot
+  be starved by a stream of small ones) and blocks while earlier pins
+  would overflow the budget — over-budget work queues instead of
+  materializing;
+- after execution the pins downgrade to **resident** bytes (the device
+  array cache that makes repeat queries skip H2D); residency is evicted
+  LRU per split reader whenever new pins need room. Readers with
+  in-flight queries are never evicted (their device arrays are in use).
+
+A single query larger than the whole budget is admitted alone (pinned
+bytes of others == 0) — refusing it would deadlock, and the reference
+likewise lets one oversized split through to fail loudly on-device.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import threading
+import time
+import weakref
+from collections import OrderedDict, deque
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_BUDGET_BYTES = int(os.environ.get("QW_HBM_BUDGET_BYTES", 8 << 30))
+
+
+class HbmBudget:
+    def __init__(self, budget_bytes: int = DEFAULT_BUDGET_BYTES):
+        self.budget = budget_bytes
+        self._cond = threading.Condition()
+        self._pinned = 0
+        self._pin_counts: dict[int, int] = {}  # id(owner) -> in-flight count
+        self._tickets: deque[int] = deque()    # FIFO admission order
+        self._ticket_seq = itertools.count()
+        # id(reader) -> [resident_bytes, weakref(reader)]
+        self._resident: "OrderedDict[int, list]" = OrderedDict()
+        self._resident_bytes = 0
+
+    # ------------------------------------------------------------------
+    def admit(self, owner, new_bytes: int,
+              timeout_secs: float = 120.0) -> int:
+        """Block (FIFO) until `new_bytes` fit; returns the admitted
+        (pinned) byte count. Evicts idle readers' resident device arrays
+        LRU to make room."""
+        if new_bytes <= 0:
+            return 0
+        ticket = next(self._ticket_seq)
+        deadline = time.monotonic() + timeout_secs
+        with self._cond:
+            self._tickets.append(ticket)
+            try:
+                while not (self._tickets[0] == ticket
+                           and (self._pinned == 0
+                                or self._pinned + new_bytes <= self.budget)):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"HBM admission timed out: need {new_bytes} "
+                            f"bytes, {self._pinned} pinned of {self.budget}")
+                    self._cond.wait(remaining)
+            finally:
+                self._tickets.remove(ticket)
+                self._cond.notify_all()  # next ticket may now be at head
+            self._pinned += new_bytes
+            self._pin_counts[id(owner)] = \
+                self._pin_counts.get(id(owner), 0) + 1
+            self._evict_locked()
+            if new_bytes > self.budget:
+                logger.warning(
+                    "query needs %d bytes against a %d-byte HBM budget; "
+                    "admitted alone", new_bytes, self.budget)
+        return new_bytes
+
+    def release(self, owner, admitted_bytes: int,
+                to_resident: bool = True) -> None:
+        """Pins → residency when the owner keeps a device-array cache
+        (split readers); transient owners (batches) just unpin — their
+        arrays die with them and must not count as resident.
+        `to_resident=False` unpins without residency (failed transfer:
+        nothing actually landed in HBM)."""
+        if admitted_bytes <= 0:
+            return
+        with self._cond:
+            self._pinned -= admitted_bytes
+            count = self._pin_counts.get(id(owner), 1) - 1
+            if count <= 0:
+                self._pin_counts.pop(id(owner), None)
+            else:
+                self._pin_counts[id(owner)] = count
+            if to_resident and getattr(owner, "_device_array_cache",
+                                       None) is not None:
+                oid = id(owner)
+                entry = self._resident.pop(oid, None)
+                if entry is None:
+                    entry = [0, weakref.ref(
+                        owner, lambda _ref, oid=oid: self._drop(oid))]
+                entry[0] += admitted_bytes
+                self._resident[oid] = entry
+                self._resident_bytes += admitted_bytes
+            self._cond.notify_all()
+
+    def _drop(self, oid: int) -> None:
+        """weakref callback: a reader was garbage-collected; its device
+        arrays are gone, so its residency must not cause evictions."""
+        with self._cond:
+            entry = self._resident.pop(oid, None)
+            if entry is not None:
+                self._resident_bytes -= entry[0]
+                self._cond.notify_all()
+
+    def _evict_locked(self) -> None:
+        while (self._resident_bytes + self._pinned > self.budget
+               and self._resident):
+            victim_id = next(
+                (rid for rid in self._resident
+                 if self._pin_counts.get(rid, 0) == 0), None)
+            if victim_id is None:
+                return  # every resident reader has in-flight queries
+            nbytes, ref = self._resident.pop(victim_id)
+            self._resident_bytes -= nbytes
+            reader = ref()
+            if reader is not None:
+                # dropping the refs releases HBM once no kernel holds them
+                cache = getattr(reader, "_device_array_cache", None)
+                if cache:
+                    cache.clear()
+                logger.info("evicted %d resident device bytes (LRU)", nbytes)
+
+    # --- observability ------------------------------------------------
+    def stats(self) -> dict:
+        with self._cond:
+            return {"budget": self.budget, "pinned": self._pinned,
+                    "resident": self._resident_bytes}

@@ -54,19 +54,34 @@ def _device_cache(reader: SplitReader) -> dict[str, Any]:
     return cache
 
 
-def warmup_device_arrays(reader: SplitReader, plan) -> list:
+def warmup_device_arrays(reader: SplitReader, plan, budget=None
+                         ) -> tuple[list, int]:
     """Host→device transfer of the plan's arrays, with per-split reuse
-    (role of `warmup`, `leaf.rs:304`)."""
+    (role of `warmup`, `leaf.rs:304`). With an `HbmBudget`, the exact NEW
+    transfer bytes are admitted (blocking while over budget) BEFORE any
+    device_put — the byte-accurate SearchPermitProvider role. Returns
+    (device_arrays, admitted_bytes); the caller releases after execution."""
     cache = _device_cache(reader)
     missing = [(key, arr) for key, arr in zip(plan.array_keys, plan.arrays)
                if key not in cache]
-    if missing:
-        # one batched host→device transfer (each separate device_put pays a
-        # full RTT under the axon tunnel)
-        transferred = jax.device_put([arr for _, arr in missing])
-        for (key, _), dev in zip(missing, transferred):
-            cache[key] = dev
-    return [cache[key] for key in plan.array_keys]
+    admitted = 0
+    if missing and budget is not None:
+        # pins this reader too: the budget will not evict its cache while
+        # the query is in flight
+        admitted = budget.admit(reader,
+                                sum(arr.nbytes for _, arr in missing))
+    try:
+        if missing:
+            # one batched host→device transfer (each separate device_put
+            # pays a full RTT under the axon tunnel)
+            transferred = jax.device_put([arr for _, arr in missing])
+            for (key, _), dev in zip(missing, transferred):
+                cache[key] = dev
+        return [cache[key] for key in plan.array_keys], admitted
+    except BaseException:
+        if admitted and budget is not None:
+            budget.release(reader, admitted, to_resident=False)
+        raise
 
 
 def prepare_single_split(
@@ -75,7 +90,8 @@ def prepare_single_split(
     reader: SplitReader,
     split_id: str,
     absence_sink=None,
-) -> tuple[Any, list]:
+    budget=None,
+) -> tuple[Any, list, int]:
     """Stage 1 of leaf search — everything up to (and including) starting
     the host→device transfer: storage byte-range IO via the reader, plan
     lowering, and the async `device_put`. Runs on a prefetch thread so the
@@ -100,8 +116,8 @@ def prepare_single_split(
     )
     # device_put is async: the transfer proceeds while the caller executes
     # the previous batch's kernel
-    device_arrays = warmup_device_arrays(reader, plan)
-    return plan, device_arrays
+    device_arrays, admitted = warmup_device_arrays(reader, plan, budget)
+    return plan, device_arrays, admitted
 
 
 def leaf_search_single_split(
@@ -110,8 +126,8 @@ def leaf_search_single_split(
     reader: SplitReader,
     split_id: str,
 ) -> LeafSearchResponse:
-    plan, device_arrays = prepare_single_split(request, doc_mapper, reader,
-                                               split_id)
+    plan, device_arrays, _ = prepare_single_split(request, doc_mapper,
+                                                  reader, split_id)
     return execute_prepared_split(request, doc_mapper, reader, split_id,
                                   plan, device_arrays)
 

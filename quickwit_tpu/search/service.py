@@ -61,6 +61,11 @@ class SearcherContext:
         # provably-empty splits before the reader is even constructed
         # (reference: leaf_cache.rs:197 + leaf.rs:758-841)
         self.predicate_cache = PredicateCache()
+        # byte-accurate HBM admission (reference SearchPermitProvider):
+        # the lowered plan knows every array's size, so over-budget work
+        # queues instead of materializing
+        from .admission import HbmBudget
+        self.hbm_budget = HbmBudget()
         self._readers: OrderedDict[str, SplitReader] = OrderedDict()
         self._max_open_splits = max_open_splits
         self._lock = threading.Lock()
@@ -255,6 +260,8 @@ class SearchService:
                 and not any(key in _json.dumps(search_request.aggs or {})
                             for key in ("split_size", "shard_size",
                                         "segment_size"))):
+            admitted = 0
+            batch = None
             try:
                 readers = [self.context.reader(s) for s in group]
                 batch = build_batch(
@@ -262,9 +269,13 @@ class SearchService:
                     [s.split_id for s in group],
                     absence_sink=self.context.predicate_cache
                     .record_term_absent)
+                admitted = self.context.hbm_budget.admit(
+                    batch, sum(a.nbytes for a in batch.arrays))
                 stage_device_inputs(batch)  # async transfer starts now
-                return ("batch", group, batch)
+                return ("batch", group, (batch, admitted))
             except Exception as exc:  # noqa: BLE001 - fall back per split
+                if admitted and batch is not None:
+                    self.context.hbm_budget.release(batch, admitted)
                 logger.debug("batch path failed (%s); searching per split", exc)
         return ("per_split", group,
                 self._prepare_per_split(group, doc_mapper, search_request))
@@ -275,13 +286,15 @@ class SearchService:
             try:
                 reader = self.context.reader(split)
                 cache = self.context.predicate_cache
-                plan, device_arrays = prepare_single_split(
+                plan, device_arrays, admitted = prepare_single_split(
                     search_request, doc_mapper, reader, split.split_id,
                     absence_sink=lambda f, t, s=split.split_id:
-                        cache.record_term_absent(s, f, t))
-                prepared.append((split, reader, plan, device_arrays, None))
+                        cache.record_term_absent(s, f, t),
+                    budget=self.context.hbm_budget)
+                prepared.append((split, reader, plan, device_arrays,
+                                 admitted, None))
             except Exception as exc:  # noqa: BLE001 - partial failure
-                prepared.append((split, None, None, None, exc))
+                prepared.append((split, None, None, None, 0, exc))
         return prepared
 
     def _execute_group(self, prepared, doc_mapper, search_request,
@@ -289,17 +302,26 @@ class SearchService:
         """Stage 2 (main thread): kernel execution + readback + merge."""
         kind, group, data = prepared
         if kind == "batch":
+            batch, admitted = data
             try:
-                merged = execute_batch(data, search_request)
+                merged = execute_batch(batch, search_request)
                 # batch responses cover several splits; cache only the merged
                 # unit is wrong per-split, so cache skipped on the batch path
                 collector.add_leaf_response(merged)
                 return
             except Exception as exc:  # noqa: BLE001 - fall back per split
                 logger.debug("batch execute failed (%s); per split", exc)
+                # release BEFORE the per-split prepares re-admit: under a
+                # tight budget the fallback would otherwise wait on its own
+                # still-pinned batch bytes
+                self.context.hbm_budget.release(batch, admitted)
+                admitted = 0
                 data = self._prepare_per_split(group, doc_mapper,
                                                search_request)
-        for split, reader, plan, device_arrays, prep_error in data:
+            finally:
+                if admitted:
+                    self.context.hbm_budget.release(batch, admitted)
+        for split, reader, plan, device_arrays, admitted, prep_error in data:
             if prep_error is not None:
                 logger.warning("split %s prepare failed: %s",
                                split.split_id, prep_error)
@@ -319,6 +341,8 @@ class SearchService:
                 logger.warning("split %s search failed: %s", split.split_id, exc)
                 collector.failed_splits.append(SplitSearchError(
                     split_id=split.split_id, error=str(exc), retryable=True))
+            finally:
+                self.context.hbm_budget.release(reader, admitted)
 
     @staticmethod
     def _optimize_split_order(request: SearchRequest,

@@ -190,8 +190,15 @@ class Node:
                  storage_resolver: Optional[StorageResolver] = None):
         self.config = config
         self.storage_resolver = storage_resolver or StorageResolver.default()
-        self.metastore: Metastore = FileBackedMetastore(
-            self.storage_resolver.resolve(config.metastore_uri))
+        if config.metastore_uri.startswith("sqlite://"):
+            # SQL backend (reference: PostgresqlMetastore): transactional
+            # publish on a database instead of object-store CAS
+            from ..metastore.sql import SqlMetastore
+            self.metastore: Metastore = SqlMetastore(
+                config.metastore_uri[len("sqlite://"):])
+        else:
+            self.metastore = FileBackedMetastore(
+                self.storage_resolver.resolve(config.metastore_uri))
         self.cluster = Cluster(
             config.node_id, config.roles,
             rest_endpoint=f"{config.rest_host}:{config.rest_port}")

@@ -1,0 +1,138 @@
+"""Byte-accurate HBM admission (reference: SearchPermitProvider,
+search_permit_provider.rs:43): over-budget work queues instead of
+materializing; residency evicts LRU."""
+
+import threading
+import time
+
+import pytest
+
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.index import SplitReader, SplitWriter
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.query.parser import parse_query_string
+from quickwit_tpu.search.admission import HbmBudget
+from quickwit_tpu.search.models import (LeafSearchRequest, SearchRequest,
+                                        SplitIdAndFooter)
+from quickwit_tpu.search.service import SearcherContext, SearchService
+from quickwit_tpu.storage import RamStorage, StorageResolver
+
+MAPPER = DocMapper(
+    field_mappings=[
+        FieldMapping("body", FieldType.TEXT),
+        FieldMapping("ts", FieldType.DATETIME, fast=True,
+                     input_formats=("unix_timestamp",)),
+    ],
+    timestamp_field="ts", default_search_fields=("body",))
+
+
+class _FakeReader:
+    def __init__(self):
+        self._device_array_cache = {"k": object()}
+
+
+def test_budget_blocks_until_release():
+    budget = HbmBudget(budget_bytes=1000)
+    r1, r2 = _FakeReader(), _FakeReader()
+    assert budget.admit(r1, 700) == 700
+    order = []
+
+    def second():
+        budget.admit(r2, 700, timeout_secs=10)
+        order.append("admitted")
+
+    t = threading.Thread(target=second)
+    t.start()
+    time.sleep(0.2)
+    assert order == []  # queued: 700 + 700 > 1000
+    order.append("released")
+    budget.release(r1, 700)
+    t.join(timeout=5)
+    assert order == ["released", "admitted"]
+    budget.release(r2, 700)
+
+
+def test_admission_evicts_lru_residency():
+    budget = HbmBudget(budget_bytes=1000)
+    r1, r2 = _FakeReader(), _FakeReader()
+    budget.admit(r1, 800)
+    budget.release(r1, 800)  # 800 resident on r1
+    assert budget.stats()["resident"] == 800
+    budget.admit(r2, 600)  # must evict r1's residency
+    assert r1._device_array_cache == {}
+    assert budget.stats()["resident"] == 0
+    budget.release(r2, 600)
+
+
+def test_oversized_query_admitted_alone():
+    budget = HbmBudget(budget_bytes=100)
+    reader = _FakeReader()
+    assert budget.admit(reader, 5000) == 5000  # pinned==0: goes through
+    budget.release(reader, 5000)
+
+
+def test_admission_timeout_is_loud():
+    budget = HbmBudget(budget_bytes=100)
+    r1, r2 = _FakeReader(), _FakeReader()
+    budget.admit(r1, 90)
+    with pytest.raises(TimeoutError, match="admission timed out"):
+        budget.admit(r2, 90, timeout_secs=0.2)
+    budget.release(r1, 90)
+
+
+def test_leaf_search_over_budget_queues_not_materializes():
+    """End-to-end: two splits, a budget smaller than both plans together.
+    Both searches succeed; the second provably WAITED for the first's
+    release (the budget's high-water mark never exceeds one plan)."""
+    storage = RamStorage(Uri.parse("ram:///admission"))
+    offsets = []
+    for n in range(2):
+        writer = SplitWriter(MAPPER)
+        for i in range(200):
+            writer.add_json_doc({"body": f"payload word{i % 7} split{n}",
+                                 "ts": 1000 + i})
+        data = writer.finish()
+        storage.put(f"s{n}.split", data)
+        offsets.append(SplitIdAndFooter(
+            split_id=f"s{n}", storage_uri="ram:///admission",
+            file_len=len(data), num_docs=200))
+    resolver = StorageResolver()
+    from quickwit_tpu.common.uri import Protocol
+    resolver.register(Protocol.RAM, lambda uri: storage)
+    context = SearcherContext(storage_resolver=resolver, batch_size=1,
+                              prefetch=False)
+    svc = SearchService(context)
+
+    # measure one split's plan bytes with an effectively-infinite budget
+    request = SearchRequest(index_ids=["t"],
+                            query_ast=parse_query_string("body:payload"),
+                            max_hits=5)
+    first = svc.leaf_search(LeafSearchRequest(
+        search_request=request, index_uid="t:0",
+        doc_mapping=MAPPER.to_dict(), splits=[offsets[0]]))
+    assert first.num_hits == 200
+
+    # fresh context with a budget that fits ONE split's arrays, not two
+    per_split = context.hbm_budget.stats()["resident"]
+    assert per_split > 0
+    context2 = SearcherContext(storage_resolver=resolver, batch_size=1,
+                               prefetch=False)
+    context2.hbm_budget = HbmBudget(budget_bytes=int(per_split * 1.5))
+    high_water = {"max": 0}
+    original_admit = context2.hbm_budget.admit
+
+    def tracking_admit(reader, nbytes, **kw):
+        out = original_admit(reader, nbytes, **kw)
+        stats = context2.hbm_budget.stats()
+        high_water["max"] = max(high_water["max"], stats["pinned"])
+        return out
+
+    context2.hbm_budget.admit = tracking_admit
+    svc2 = SearchService(context2)
+    response = svc2.leaf_search(LeafSearchRequest(
+        search_request=request, index_uid="t:0",
+        doc_mapping=MAPPER.to_dict(), splits=list(offsets)))
+    assert response.num_hits == 400
+    assert not response.failed_splits
+    # pinned bytes never held both splits at once
+    assert high_water["max"] <= per_split * 1.5
