@@ -148,9 +148,12 @@ class SqlMetastore(Metastore):
                     kind="already_exists")
 
     def delete_index(self, index_uid: str) -> None:
-        with self._tx():
+        with self._tx(), self._txn():
+            # the existence/incarnation check runs INSIDE the transaction:
+            # BEGIN IMMEDIATE holds the write lock across the whole
+            # check-then-act even between processes
             self._index_row_by_uid(index_uid)
-            with self._txn():
+            if True:
                 for table in ("splits", "checkpoints", "delete_tasks"):
                     self._conn.execute(
                         f"DELETE FROM {table} WHERE index_uid = ?",  # noqa: S608
@@ -180,14 +183,14 @@ class SqlMetastore(Metastore):
 
     # --- sources ------------------------------------------------------
     def add_source(self, index_uid: str, source: SourceConfig) -> None:
-        with self._tx():
+        with self._tx(), self._txn():
             metadata = self._index_row_by_uid(index_uid)
             if source.source_id in metadata.sources:
                 raise MetastoreError(
                     f"source {source.source_id!r} already exists",
                     kind="already_exists")
             metadata.sources[source.source_id] = source
-            with self._txn():
+            if True:
                 self._save_metadata(metadata)
                 self._conn.execute(
                     "INSERT OR IGNORE INTO checkpoints VALUES (?, ?, ?)",
@@ -195,12 +198,12 @@ class SqlMetastore(Metastore):
                      json.dumps(SourceCheckpoint().to_dict())))
 
     def delete_source(self, index_uid: str, source_id: str) -> None:
-        with self._tx():
+        with self._tx(), self._txn():
             metadata = self._index_row_by_uid(index_uid)
             if metadata.sources.pop(source_id, None) is None:
                 raise MetastoreError(f"source {source_id!r} not found",
                                      kind="not_found")
-            with self._txn():
+            if True:
                 self._save_metadata(metadata)
                 self._conn.execute(
                     "DELETE FROM checkpoints WHERE index_uid = ? AND "
@@ -208,14 +211,14 @@ class SqlMetastore(Metastore):
 
     def toggle_source(self, index_uid: str, source_id: str,
                       enable: bool) -> None:
-        with self._tx():
+        with self._tx(), self._txn():
             metadata = self._index_row_by_uid(index_uid)
             source = metadata.sources.get(source_id)
             if source is None:
                 raise MetastoreError(f"source {source_id!r} not found",
                                      kind="not_found")
             source.enabled = enable
-            with self._txn():
+            if True:
                 self._save_metadata(metadata)
 
     def reset_source_checkpoint(self, index_uid: str, source_id: str) -> None:
@@ -239,9 +242,12 @@ class SqlMetastore(Metastore):
     # --- splits -------------------------------------------------------
     def stage_splits(self, index_uid: str, split_metadatas) -> None:
         now = int(time.time())
-        with self._tx():
+        with self._tx(), self._txn():
+            # the existence/incarnation check runs INSIDE the transaction:
+            # BEGIN IMMEDIATE holds the write lock across the whole
+            # check-then-act even between processes
             self._index_row_by_uid(index_uid)
-            with self._txn():
+            if True:
                 for md in split_metadatas:
                     row = self._conn.execute(
                         "SELECT state FROM splits WHERE index_uid = ? AND "
@@ -263,9 +269,12 @@ class SqlMetastore(Metastore):
                        checkpoint_delta: Optional[CheckpointDelta] = None
                        ) -> None:
         now = int(time.time())
-        with self._tx():
+        with self._tx(), self._txn():
+            # the existence/incarnation check runs INSIDE the transaction:
+            # BEGIN IMMEDIATE holds the write lock across the whole
+            # check-then-act even between processes
             self._index_row_by_uid(index_uid)
-            with self._txn():  # one transaction: all-or-nothing cut-over
+            if True:  # one transaction: all-or-nothing cut-over
                 splits = {}
                 for split_id in staged_split_ids:
                     row = self._conn.execute(
@@ -333,6 +342,8 @@ class SqlMetastore(Metastore):
     def list_splits(self, query: ListSplitsQuery) -> list[Split]:
         with self._tx():
             if query.index_uids is not None:
+                if not query.index_uids:
+                    return []
                 for uid in query.index_uids:
                     self._index_row_by_uid(uid)
                 placeholders = ",".join("?" * len(query.index_uids))
@@ -349,9 +360,12 @@ class SqlMetastore(Metastore):
     def mark_splits_for_deletion(self, index_uid: str,
                                  split_ids: Iterable[str]) -> None:
         now = int(time.time())
-        with self._tx():
+        with self._tx(), self._txn():
+            # the existence/incarnation check runs INSIDE the transaction:
+            # BEGIN IMMEDIATE holds the write lock across the whole
+            # check-then-act even between processes
             self._index_row_by_uid(index_uid)
-            with self._txn():
+            if True:
                 for split_id in split_ids:
                     row = self._conn.execute(
                         "SELECT split FROM splits WHERE index_uid = ? AND "
@@ -370,9 +384,12 @@ class SqlMetastore(Metastore):
 
     def delete_splits(self, index_uid: str,
                       split_ids: Iterable[str]) -> None:
-        with self._tx():
+        with self._tx(), self._txn():
+            # the existence/incarnation check runs INSIDE the transaction:
+            # BEGIN IMMEDIATE holds the write lock across the whole
+            # check-then-act even between processes
             self._index_row_by_uid(index_uid)
-            with self._txn():
+            if True:
                 for split_id in split_ids:
                     row = self._conn.execute(
                         "SELECT state FROM splits WHERE index_uid = ? AND "
@@ -389,9 +406,12 @@ class SqlMetastore(Metastore):
 
     # --- delete tasks -------------------------------------------------
     def create_delete_task(self, index_uid: str, query_ast_json: dict) -> int:
-        with self._tx():
+        with self._tx(), self._txn():
+            # the existence/incarnation check runs INSIDE the transaction:
+            # BEGIN IMMEDIATE holds the write lock across the whole
+            # check-then-act even between processes
             self._index_row_by_uid(index_uid)
-            with self._txn():
+            if True:
                 row = self._conn.execute(
                     "SELECT COALESCE(MAX(opstamp), 0) FROM delete_tasks "
                     "WHERE index_uid = ?", (index_uid,)).fetchone()
@@ -425,9 +445,12 @@ class SqlMetastore(Metastore):
     def update_splits_delete_opstamp(self, index_uid: str,
                                      split_ids: Iterable[str],
                                      opstamp: int) -> None:
-        with self._tx():
+        with self._tx(), self._txn():
+            # the existence/incarnation check runs INSIDE the transaction:
+            # BEGIN IMMEDIATE holds the write lock across the whole
+            # check-then-act even between processes
             self._index_row_by_uid(index_uid)
-            with self._txn():
+            if True:
                 for split_id in split_ids:
                     row = self._conn.execute(
                         "SELECT split FROM splits WHERE index_uid = ? AND "

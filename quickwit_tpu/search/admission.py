@@ -56,6 +56,11 @@ class HbmBudget:
         (pinned) byte count. Evicts idle readers' resident device arrays
         LRU to make room."""
         if new_bytes <= 0:
+            # zero-byte admission still PINS the owner: its cached device
+            # arrays are in use and must not be evicted mid-query
+            with self._cond:
+                self._pin_counts[id(owner)] = \
+                    self._pin_counts.get(id(owner), 0) + 1
             return 0
         ticket = next(self._ticket_seq)
         deadline = time.monotonic() + timeout_secs
@@ -90,10 +95,17 @@ class HbmBudget:
         (split readers); transient owners (batches) just unpin — their
         arrays die with them and must not count as resident.
         `to_resident=False` unpins without residency (failed transfer:
-        nothing actually landed in HBM)."""
-        if admitted_bytes <= 0:
-            return
+        nothing actually landed in HBM). Zero-byte releases still unpin
+        the owner (matching zero-byte admissions)."""
         with self._cond:
+            if admitted_bytes <= 0:
+                count = self._pin_counts.get(id(owner), 1) - 1
+                if count <= 0:
+                    self._pin_counts.pop(id(owner), None)
+                else:
+                    self._pin_counts[id(owner)] = count
+                self._cond.notify_all()
+                return
             self._pinned -= admitted_bytes
             count = self._pin_counts.get(id(owner), 1) - 1
             if count <= 0:

@@ -65,9 +65,10 @@ def warmup_device_arrays(reader: SplitReader, plan, budget=None
     missing = [(key, arr) for key, arr in zip(plan.array_keys, plan.arrays)
                if key not in cache]
     admitted = 0
-    if missing and budget is not None:
-        # pins this reader too: the budget will not evict its cache while
-        # the query is in flight
+    if budget is not None:
+        # pins this reader even when nothing is missing (zero-byte
+        # admission): its cached device arrays are in use and must not be
+        # evicted mid-query
         admitted = budget.admit(reader,
                                 sum(arr.nbytes for _, arr in missing))
     try:
@@ -79,9 +80,39 @@ def warmup_device_arrays(reader: SplitReader, plan, budget=None
                 cache[key] = dev
         return [cache[key] for key in plan.array_keys], admitted
     except BaseException:
-        if admitted and budget is not None:
+        if budget is not None:
             budget.release(reader, admitted, to_resident=False)
         raise
+
+
+def prepare_plan_only(
+    request: SearchRequest,
+    doc_mapper: DocMapper,
+    reader: SplitReader,
+    split_id: str,
+    absence_sink=None,
+):
+    """Stage 1a: storage byte-range IO + plan lowering WITHOUT the device
+    transfer. The service's per-split path defers H2D to the execute
+    stage so each split's admit→transfer→execute→release cycle runs
+    alone — a whole group admitted up front could exceed the budget and
+    starve itself."""
+    agg_specs = parse_aggs(request.aggs) if request.aggs else []
+    sort = request.sort_fields[0] if request.sort_fields else None
+    sort_field = sort.field if sort else "_score"
+    sort_order = sort.order if sort else "desc"
+    sort2 = request.sort_fields[1] if len(request.sort_fields) > 1 else None
+    return lower_request(
+        request.query_ast, doc_mapper, reader, agg_specs,
+        sort_field=sort_field, sort_order=sort_order,
+        sort2_field=sort2.field if sort2 else None,
+        sort2_order=sort2.order if sort2 else "desc",
+        start_timestamp=request.start_timestamp,
+        end_timestamp=request.end_timestamp,
+        search_after=search_after_marker(request, split_id, sort_field,
+                                         sort_order, sort2),
+        absence_sink=absence_sink,
+    )
 
 
 def prepare_single_split(
@@ -97,23 +128,8 @@ def prepare_single_split(
     lowering, and the async `device_put`. Runs on a prefetch thread so the
     next split batch's IO overlaps the current batch's kernel execution
     (SURVEY hard-part #4: warmup/compute pipelining)."""
-    agg_specs = parse_aggs(request.aggs) if request.aggs else []
-    sort = request.sort_fields[0] if request.sort_fields else None
-    sort_field = sort.field if sort else "_score"
-    sort_order = sort.order if sort else "desc"
-    sort2 = request.sort_fields[1] if len(request.sort_fields) > 1 else None
-
-    plan = lower_request(
-        request.query_ast, doc_mapper, reader, agg_specs,
-        sort_field=sort_field, sort_order=sort_order,
-        sort2_field=sort2.field if sort2 else None,
-        sort2_order=sort2.order if sort2 else "desc",
-        start_timestamp=request.start_timestamp,
-        end_timestamp=request.end_timestamp,
-        search_after=search_after_marker(request, split_id, sort_field,
-                                         sort_order, sort2),
-        absence_sink=absence_sink,
-    )
+    plan = prepare_plan_only(request, doc_mapper, reader, split_id,
+                             absence_sink)
     # device_put is async: the transfer proceeds while the caller executes
     # the previous batch's kernel
     device_arrays, admitted = warmup_device_arrays(reader, plan, budget)

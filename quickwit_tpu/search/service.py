@@ -33,7 +33,7 @@ from .cache import LeafSearchCache, canonical_request_key
 from .predicate_cache import PredicateCache, required_terms
 from .collector import IncrementalCollector
 from .leaf import (execute_prepared_split, leaf_search_single_split,
-                   prepare_single_split)
+                   prepare_plan_only)
 from .models import (
     FetchDocsRequest, LeafSearchRequest, LeafSearchResponse, SearchRequest,
     SplitIdAndFooter, SplitSearchError, string_sort_of,
@@ -169,8 +169,12 @@ class SearchService:
                 # with exact counting off, splits whose best possible sort key
                 # cannot beat the current kth hit are skipped entirely
                 # (a prefetched group may be discarded here — wasted IO is
-                # the price of overlap, never wrong results)
+                # the price of overlap, never wrong results; its admitted
+                # HBM pins must still be returned)
                 num_skipped = len(pending) - begin
+                if future is not None:
+                    self._discard_prepared(future.result())
+                    future = None
                 break
             prepared = (future.result() if future is not None
                         else self._prepare_group(group, doc_mapper,
@@ -280,21 +284,33 @@ class SearchService:
         return ("per_split", group,
                 self._prepare_per_split(group, doc_mapper, search_request))
 
+    def _discard_prepared(self, prepared) -> None:
+        """A prefetched group dropped by the pruning short-circuit must
+        return its admitted HBM pins (the per-split path takes none at
+        prepare time — only the batch path pre-admits)."""
+        kind, _group, data = prepared
+        if kind == "batch":
+            batch, admitted = data
+            self.context.hbm_budget.release(batch, admitted)
+
     def _prepare_per_split(self, group, doc_mapper, search_request):
         prepared = []
         for split in group:
             try:
                 reader = self.context.reader(split)
                 cache = self.context.predicate_cache
-                plan, device_arrays, admitted = prepare_single_split(
+                # plan-only (storage IO + lowering): the H2D transfer is
+                # deferred to the execute stage so each split's
+                # admit→transfer→execute→release cycle runs alone — a whole
+                # group admitted up front could exceed the budget and
+                # starve itself
+                plan = prepare_plan_only(
                     search_request, doc_mapper, reader, split.split_id,
                     absence_sink=lambda f, t, s=split.split_id:
-                        cache.record_term_absent(s, f, t),
-                    budget=self.context.hbm_budget)
-                prepared.append((split, reader, plan, device_arrays,
-                                 admitted, None))
+                        cache.record_term_absent(s, f, t))
+                prepared.append((split, reader, plan, None))
             except Exception as exc:  # noqa: BLE001 - partial failure
-                prepared.append((split, None, None, None, 0, exc))
+                prepared.append((split, None, None, exc))
         return prepared
 
     def _execute_group(self, prepared, doc_mapper, search_request,
@@ -321,7 +337,8 @@ class SearchService:
             finally:
                 if admitted:
                     self.context.hbm_budget.release(batch, admitted)
-        for split, reader, plan, device_arrays, admitted, prep_error in data:
+        from .leaf import warmup_device_arrays
+        for split, reader, plan, prep_error in data:
             if prep_error is not None:
                 logger.warning("split %s prepare failed: %s",
                                split.split_id, prep_error)
@@ -329,7 +346,12 @@ class SearchService:
                     split_id=split.split_id, error=str(prep_error),
                     retryable=True))
                 continue
+            admitted = 0
+            warmed = False
             try:
+                device_arrays, admitted = warmup_device_arrays(
+                    reader, plan, self.context.hbm_budget)
+                warmed = True
                 response = execute_prepared_split(
                     search_request, doc_mapper, reader, split.split_id,
                     plan, device_arrays)
@@ -342,7 +364,8 @@ class SearchService:
                 collector.failed_splits.append(SplitSearchError(
                     split_id=split.split_id, error=str(exc), retryable=True))
             finally:
-                self.context.hbm_budget.release(reader, admitted)
+                if warmed:  # failed warmups release their own pins
+                    self.context.hbm_budget.release(reader, admitted)
 
     @staticmethod
     def _optimize_split_order(request: SearchRequest,

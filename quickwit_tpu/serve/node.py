@@ -606,15 +606,6 @@ class Node:
             return
         stop = self._bg_stop = threading.Event()
 
-        def loop(name: str, interval: float, tick) -> None:
-            # `stop` is captured (not re-read from self): stop_background_
-            # services may null the attribute while a tick is in flight.
-            while not stop.wait(interval):
-                try:
-                    tick()
-                except Exception:  # noqa: BLE001 - supervised loop
-                    logger.exception("background %s pass failed", name)
-
         def owns_index(index_uid: str) -> bool:
             # Deterministic single-worker election per index: every node
             # computes the same owner from the same alive set (rendezvous
@@ -702,7 +693,6 @@ class Node:
             for worker in workers:
                 worker.join(timeout=4.0)
 
-        self._bg_threads = []
         loops = [("ingest", ingest_interval_secs, ingest_tick),
                  ("merge", merge_interval_secs, merge_tick),
                  ("janitor", janitor_interval_secs, janitor_tick)]
@@ -722,11 +712,31 @@ class Node:
         else:
             loops.append(("heartbeat", heartbeat_interval_secs,
                           heartbeat_tick))
+        # each background service is an actor on the shared Universe
+        # (reference: the quickwit-actors supervision trees hosting
+        # IndexingService / janitor / pipelines): one mailbox each,
+        # periodic Tick messages from the scheduler, supervised restarts,
+        # and tick coalescing (try_send) so a slow pass skips beats
+        # instead of queueing them up
+        from ..common.actors import Actor, Universe
+        universe = self._bg_universe = Universe()
+
+        class _Service(Actor):
+            def __init__(self, name: str, tick):
+                self.name = f"bg-{name}"
+                self._tick = tick
+
+            def on_message(self, message) -> None:
+                if stop.is_set():
+                    return
+                self._tick()
+
         for name, interval, tick in loops:
-            thread = threading.Thread(target=loop, args=(name, interval, tick),
-                                       name=f"bg-{name}", daemon=True)
-            thread.start()
-            self._bg_threads.append(thread)
+            mailbox, _handle = universe.spawn(
+                _Service(name, tick), capacity=1, supervised=True,
+                max_restarts=1 << 30)  # services restart forever
+            universe.schedule_periodic(
+                interval, lambda m=mailbox: m.try_send("tick"))
         logger.info("background services started (%s)", self.config.node_id)
 
     def stop_background_services(self) -> None:
@@ -734,6 +744,10 @@ class Node:
         if stop is not None:
             stop.set()
             self._bg_stop = None
+        universe = getattr(self, "_bg_universe", None)
+        if universe is not None:
+            universe.quit(timeout=2.0)
+            self._bg_universe = None
         gossip = getattr(self, "_gossip", None)
         if gossip is not None:
             gossip.stop()
