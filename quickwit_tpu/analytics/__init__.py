@@ -1,0 +1,3 @@
+from .sql import SqlError, execute_sql, parse_sql
+
+__all__ = ["SqlError", "execute_sql", "parse_sql"]

@@ -8,9 +8,14 @@ client pools — over a pluggable dissemination layer: in-process registry now
 (single-process clusters, tests), heartbeats over the REST transport for
 multi-process (serve layer); the gossip state machine is the same either way.
 
-Failure detection is a simplified phi-accrual: a node is suspected dead when
-its heartbeat age exceeds `dead_after_secs` (the reference's phi threshold
-collapses to this under regular heartbeat intervals).
+Failure detection is phi-accrual (reference: chitchat's
+FailureDetectorConfig, cluster.rs:25-27): each member keeps a sliding
+window of inter-arrival intervals; phi = age / mean_interval · log10(e)
+(the exponential-distribution suspicion level). A node is suspected dead
+when phi exceeds `phi_threshold` — adaptive to the OBSERVED cadence, so
+jittery-but-alive peers are not declared dead the way a fixed age
+threshold would. `dead_after_secs` remains a hard upper bound (and the
+fallback before enough samples accumulate).
 """
 
 from __future__ import annotations
@@ -50,6 +55,8 @@ class ClusterMember:
     generation: int = 0
     is_ready: bool = True
     last_heartbeat: float = field(default_factory=time.monotonic)
+    # sliding window of heartbeat inter-arrival intervals (phi-accrual)
+    intervals: list = field(default_factory=list)
 
 
 @dataclass
@@ -69,6 +76,9 @@ class Cluster:
         self._lock = threading.Lock()
         self.heartbeat_interval_secs = heartbeat_interval_secs
         self.dead_after_secs = dead_after_secs
+        # chitchat's default phi threshold is 8.0 (~1 false positive per
+        # 10^8 under the model); jitter-tolerant
+        self.phi_threshold = 8.0
         self_member = ClusterMember(self_node_id, roles, rest_endpoint)
         self._members[self_node_id] = self_member
 
@@ -85,11 +95,49 @@ class Cluster:
         if member is not None:
             self.broker.publish(ClusterChange("remove", member))
 
+    PHI_WINDOW = 32
+    MIN_SAMPLES = 4
+
     def record_heartbeat(self, node_id: str) -> None:
         with self._lock:
             member = self._members.get(node_id)
             if member is not None:
-                member.last_heartbeat = time.monotonic()
+                now = time.monotonic()
+                interval = now - member.last_heartbeat
+                if 0 < interval < self.dead_after_secs * 4:
+                    member.intervals.append(interval)
+                    if len(member.intervals) > self.PHI_WINDOW:
+                        member.intervals.pop(0)
+                member.last_heartbeat = now
+
+    def phi(self, member: ClusterMember, now: Optional[float] = None) -> float:
+        """Suspicion level (phi-accrual): -log10 P(no heartbeat for this
+        long | observed cadence), exponential model. Below MIN_SAMPLES the
+        detector abstains (returns 0) and the hard age bound governs."""
+        import math
+        if len(member.intervals) < self.MIN_SAMPLES:
+            return 0.0
+        now = time.monotonic() if now is None else now
+        mean = sum(member.intervals) / len(member.intervals)
+        age = now - member.last_heartbeat
+        return age / max(mean, 1e-6) * math.log10(math.e)
+
+    def is_alive(self, member: ClusterMember,
+                 now: Optional[float] = None) -> bool:
+        """Hybrid accrual: phi ACCELERATES detection of fast-cadence peers
+        (a 100ms heartbeater silent for seconds is suspect long before the
+        wall-clock bound), floored so a single GC pause cannot flap
+        membership; `dead_after_secs` stays the authoritative upper
+        bound regardless of cadence."""
+        if member.node_id == self.self_node_id:
+            return True
+        now = time.monotonic() if now is None else now
+        age = now - member.last_heartbeat
+        if age > self.dead_after_secs:
+            return False  # hard bound
+        if age < min(self.dead_after_secs / 4, 2.0):
+            return True  # flap floor: brief pauses never kill a peer
+        return self.phi(member, now) < self.phi_threshold
 
     def upsert_heartbeat(self, member: ClusterMember) -> None:
         """Gossip upsert shared by both heartbeat transports (outbound
@@ -108,9 +156,8 @@ class Cluster:
         with self._lock:
             out = []
             for member in self._members.values():
-                if alive_only and member.node_id != self.self_node_id:
-                    if now - member.last_heartbeat > self.dead_after_secs:
-                        continue
+                if alive_only and not self.is_alive(member, now):
+                    continue
                 out.append(member)
             return sorted(out, key=lambda m: m.node_id)
 

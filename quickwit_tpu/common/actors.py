@@ -236,8 +236,11 @@ class Universe:
                     self._virtual_now = deadline
                     heapq.heappop(self._timers)
                 else:
+                    # schedule()/quit() notify this condition, so real-time
+                    # mode can sleep the full remaining interval; the 1ms
+                    # poll exists only for accelerated idle detection
                     self._idle.wait(0.001 if self.accelerated else
-                                    min(deadline - now, 0.05))
+                                    min(deadline - now, 5.0))
                     continue
             try:
                 callback()
@@ -289,17 +292,25 @@ class Universe:
                         handle.state = "failed"
                         logger.error("actor %s failed permanently: %s",
                                      actor.name, exc)
+                        # drain + close: queued messages must not count as
+                        # in-flight forever (they would freeze the
+                        # accelerated clock), and draining frees capacity
+                        # so a blocked sender unblocks instead of hanging
+                        mailbox.close()
+                        while True:
+                            try:
+                                mailbox.recv(timeout=0)
+                            except (queue.Empty, MailboxClosed):
+                                break
+                            self._on_activity(-1)
                         break
                     handle.restarts += 1
                     logger.warning("actor %s crashed (%s); restart #%d",
                                    actor.name, exc, handle.restarts)
-                    # backoff on the virtual clock in accelerated mode
-                    if self.accelerated:
-                        restart = threading.Event()
-                        self.schedule(backoff, restart.set)
-                        restart.wait(5.0)
-                    else:
-                        time.sleep(backoff)
+                    # accelerated mode: messages queued behind the crash
+                    # keep the system non-idle, so a virtual-clock backoff
+                    # would deadlock — restart (near-)immediately instead
+                    time.sleep(0.001 if self.accelerated else backoff)
                     backoff = min(backoff * 2, 5.0)
             handle._exited.set()
 

@@ -153,13 +153,12 @@ class SqlMetastore(Metastore):
             # BEGIN IMMEDIATE holds the write lock across the whole
             # check-then-act even between processes
             self._index_row_by_uid(index_uid)
-            if True:
-                for table in ("splits", "checkpoints", "delete_tasks"):
-                    self._conn.execute(
-                        f"DELETE FROM {table} WHERE index_uid = ?",  # noqa: S608
-                        (index_uid,))
+            for table in ("splits", "checkpoints", "delete_tasks"):
                 self._conn.execute(
-                    "DELETE FROM indexes WHERE index_uid = ?", (index_uid,))
+                    f"DELETE FROM {table} WHERE index_uid = ?",  # noqa: S608
+                    (index_uid,))
+            self._conn.execute(
+                "DELETE FROM indexes WHERE index_uid = ?", (index_uid,))
 
     def index_metadata(self, index_id: str) -> IndexMetadata:
         with self._tx():
@@ -190,12 +189,11 @@ class SqlMetastore(Metastore):
                     f"source {source.source_id!r} already exists",
                     kind="already_exists")
             metadata.sources[source.source_id] = source
-            if True:
-                self._save_metadata(metadata)
-                self._conn.execute(
-                    "INSERT OR IGNORE INTO checkpoints VALUES (?, ?, ?)",
-                    (index_uid, source.source_id,
-                     json.dumps(SourceCheckpoint().to_dict())))
+            self._save_metadata(metadata)
+            self._conn.execute(
+                "INSERT OR IGNORE INTO checkpoints VALUES (?, ?, ?)",
+                (index_uid, source.source_id,
+                 json.dumps(SourceCheckpoint().to_dict())))
 
     def delete_source(self, index_uid: str, source_id: str) -> None:
         with self._tx(), self._txn():
@@ -203,11 +201,10 @@ class SqlMetastore(Metastore):
             if metadata.sources.pop(source_id, None) is None:
                 raise MetastoreError(f"source {source_id!r} not found",
                                      kind="not_found")
-            if True:
-                self._save_metadata(metadata)
-                self._conn.execute(
-                    "DELETE FROM checkpoints WHERE index_uid = ? AND "
-                    "source_id = ?", (index_uid, source_id))
+            self._save_metadata(metadata)
+            self._conn.execute(
+                "DELETE FROM checkpoints WHERE index_uid = ? AND "
+                "source_id = ?", (index_uid, source_id))
 
     def toggle_source(self, index_uid: str, source_id: str,
                       enable: bool) -> None:
@@ -218,8 +215,7 @@ class SqlMetastore(Metastore):
                 raise MetastoreError(f"source {source_id!r} not found",
                                      kind="not_found")
             source.enabled = enable
-            if True:
-                self._save_metadata(metadata)
+            self._save_metadata(metadata)
 
     def reset_source_checkpoint(self, index_uid: str, source_id: str) -> None:
         with self._tx(), self._txn():
@@ -247,21 +243,20 @@ class SqlMetastore(Metastore):
             # BEGIN IMMEDIATE holds the write lock across the whole
             # check-then-act even between processes
             self._index_row_by_uid(index_uid)
-            if True:
-                for md in split_metadatas:
-                    row = self._conn.execute(
-                        "SELECT state FROM splits WHERE index_uid = ? AND "
-                        "split_id = ?", (index_uid, md.split_id)).fetchone()
-                    if row is not None and row[0] != SplitState.STAGED.value:
-                        raise MetastoreError(
-                            f"split {md.split_id!r} exists in state {row[0]}",
-                            kind="failed_precondition")
-                    split = Split(metadata=md, state=SplitState.STAGED,
-                                  update_timestamp=now)
-                    self._conn.execute(
-                        "INSERT OR REPLACE INTO splits VALUES (?, ?, ?, ?)",
-                        (index_uid, md.split_id, SplitState.STAGED.value,
-                         json.dumps(split.to_dict())))
+            for md in split_metadatas:
+                row = self._conn.execute(
+                    "SELECT state FROM splits WHERE index_uid = ? AND "
+                    "split_id = ?", (index_uid, md.split_id)).fetchone()
+                if row is not None and row[0] != SplitState.STAGED.value:
+                    raise MetastoreError(
+                        f"split {md.split_id!r} exists in state {row[0]}",
+                        kind="failed_precondition")
+                split = Split(metadata=md, state=SplitState.STAGED,
+                              update_timestamp=now)
+                self._conn.execute(
+                    "INSERT OR REPLACE INTO splits VALUES (?, ?, ?, ?)",
+                    (index_uid, md.split_id, SplitState.STAGED.value,
+                     json.dumps(split.to_dict())))
 
     def publish_splits(self, index_uid: str, staged_split_ids: list[str],
                        replaced_split_ids: Iterable[str] = (),
@@ -274,70 +269,70 @@ class SqlMetastore(Metastore):
             # BEGIN IMMEDIATE holds the write lock across the whole
             # check-then-act even between processes
             self._index_row_by_uid(index_uid)
-            if True:  # one transaction: all-or-nothing cut-over
-                splits = {}
-                for split_id in staged_split_ids:
-                    row = self._conn.execute(
-                        "SELECT state, split FROM splits WHERE index_uid = ?"
-                        " AND split_id = ?",
-                        (index_uid, split_id)).fetchone()
-                    if row is None:
-                        raise MetastoreError(
-                            f"split {split_id!r} not found", kind="not_found")
-                    if row[0] != SplitState.STAGED.value:
-                        raise MetastoreError(
-                            f"split {split_id!r} is {row[0]}, not staged",
-                            kind="failed_precondition")
-                    splits[split_id] = Split.from_dict(json.loads(row[1]))
-                replaced = list(replaced_split_ids)
-                for split_id in replaced:
-                    row = self._conn.execute(
-                        "SELECT state, split FROM splits WHERE index_uid = ?"
-                        " AND split_id = ?",
-                        (index_uid, split_id)).fetchone()
-                    if row is None or row[0] != SplitState.PUBLISHED.value:
-                        raise MetastoreError(
-                            f"replaced split {split_id!r} is not published",
-                            kind="failed_precondition")
-                    splits[split_id] = Split.from_dict(json.loads(row[1]))
-                if checkpoint_delta is not None and not checkpoint_delta.is_empty:
-                    if source_id is None:
-                        raise MetastoreError(
-                            "checkpoint delta requires source_id")
-                    row = self._conn.execute(
-                        "SELECT checkpoint FROM checkpoints WHERE "
-                        "index_uid = ? AND source_id = ?",
-                        (index_uid, source_id)).fetchone()
-                    checkpoint = (SourceCheckpoint.from_dict(
-                        json.loads(row[0])) if row else SourceCheckpoint())
-                    try:
-                        checkpoint.try_apply_delta(checkpoint_delta)
-                    except IncompatibleCheckpointDelta as exc:
-                        raise MetastoreError(
-                            str(exc), kind="failed_precondition") from exc
-                    self._conn.execute(
-                        "INSERT OR REPLACE INTO checkpoints VALUES (?, ?, ?)",
-                        (index_uid, source_id,
-                         json.dumps(checkpoint.to_dict())))
-                for split_id in staged_split_ids:
-                    split = splits[split_id]
-                    split.state = SplitState.PUBLISHED
-                    split.update_timestamp = now
-                    split.publish_timestamp = now
-                    self._conn.execute(
-                        "UPDATE splits SET state = ?, split = ? WHERE "
-                        "index_uid = ? AND split_id = ?",
-                        (split.state.value, json.dumps(split.to_dict()),
-                         index_uid, split_id))
-                for split_id in replaced:
-                    split = splits[split_id]
-                    split.state = SplitState.MARKED_FOR_DELETION
-                    split.update_timestamp = now
-                    self._conn.execute(
-                        "UPDATE splits SET state = ?, split = ? WHERE "
-                        "index_uid = ? AND split_id = ?",
-                        (split.state.value, json.dumps(split.to_dict()),
-                         index_uid, split_id))
+            # one transaction: all-or-nothing cut-over
+            splits = {}
+            for split_id in staged_split_ids:
+                row = self._conn.execute(
+                    "SELECT state, split FROM splits WHERE index_uid = ?"
+                    " AND split_id = ?",
+                    (index_uid, split_id)).fetchone()
+                if row is None:
+                    raise MetastoreError(
+                        f"split {split_id!r} not found", kind="not_found")
+                if row[0] != SplitState.STAGED.value:
+                    raise MetastoreError(
+                        f"split {split_id!r} is {row[0]}, not staged",
+                        kind="failed_precondition")
+                splits[split_id] = Split.from_dict(json.loads(row[1]))
+            replaced = list(replaced_split_ids)
+            for split_id in replaced:
+                row = self._conn.execute(
+                    "SELECT state, split FROM splits WHERE index_uid = ?"
+                    " AND split_id = ?",
+                    (index_uid, split_id)).fetchone()
+                if row is None or row[0] != SplitState.PUBLISHED.value:
+                    raise MetastoreError(
+                        f"replaced split {split_id!r} is not published",
+                        kind="failed_precondition")
+                splits[split_id] = Split.from_dict(json.loads(row[1]))
+            if checkpoint_delta is not None and not checkpoint_delta.is_empty:
+                if source_id is None:
+                    raise MetastoreError(
+                        "checkpoint delta requires source_id")
+                row = self._conn.execute(
+                    "SELECT checkpoint FROM checkpoints WHERE "
+                    "index_uid = ? AND source_id = ?",
+                    (index_uid, source_id)).fetchone()
+                checkpoint = (SourceCheckpoint.from_dict(
+                    json.loads(row[0])) if row else SourceCheckpoint())
+                try:
+                    checkpoint.try_apply_delta(checkpoint_delta)
+                except IncompatibleCheckpointDelta as exc:
+                    raise MetastoreError(
+                        str(exc), kind="failed_precondition") from exc
+                self._conn.execute(
+                    "INSERT OR REPLACE INTO checkpoints VALUES (?, ?, ?)",
+                    (index_uid, source_id,
+                     json.dumps(checkpoint.to_dict())))
+            for split_id in staged_split_ids:
+                split = splits[split_id]
+                split.state = SplitState.PUBLISHED
+                split.update_timestamp = now
+                split.publish_timestamp = now
+                self._conn.execute(
+                    "UPDATE splits SET state = ?, split = ? WHERE "
+                    "index_uid = ? AND split_id = ?",
+                    (split.state.value, json.dumps(split.to_dict()),
+                     index_uid, split_id))
+            for split_id in replaced:
+                split = splits[split_id]
+                split.state = SplitState.MARKED_FOR_DELETION
+                split.update_timestamp = now
+                self._conn.execute(
+                    "UPDATE splits SET state = ?, split = ? WHERE "
+                    "index_uid = ? AND split_id = ?",
+                    (split.state.value, json.dumps(split.to_dict()),
+                     index_uid, split_id))
 
     def list_splits(self, query: ListSplitsQuery) -> list[Split]:
         with self._tx():
@@ -365,22 +360,21 @@ class SqlMetastore(Metastore):
             # BEGIN IMMEDIATE holds the write lock across the whole
             # check-then-act even between processes
             self._index_row_by_uid(index_uid)
-            if True:
-                for split_id in split_ids:
-                    row = self._conn.execute(
-                        "SELECT split FROM splits WHERE index_uid = ? AND "
-                        "split_id = ?", (index_uid, split_id)).fetchone()
-                    if row is None:
-                        continue
-                    split = Split.from_dict(json.loads(row[0]))
-                    if split.state is not SplitState.MARKED_FOR_DELETION:
-                        split.state = SplitState.MARKED_FOR_DELETION
-                        split.update_timestamp = now
-                        self._conn.execute(
-                            "UPDATE splits SET state = ?, split = ? WHERE "
-                            "index_uid = ? AND split_id = ?",
-                            (split.state.value, json.dumps(split.to_dict()),
-                             index_uid, split_id))
+            for split_id in split_ids:
+                row = self._conn.execute(
+                    "SELECT split FROM splits WHERE index_uid = ? AND "
+                    "split_id = ?", (index_uid, split_id)).fetchone()
+                if row is None:
+                    continue
+                split = Split.from_dict(json.loads(row[0]))
+                if split.state is not SplitState.MARKED_FOR_DELETION:
+                    split.state = SplitState.MARKED_FOR_DELETION
+                    split.update_timestamp = now
+                    self._conn.execute(
+                        "UPDATE splits SET state = ?, split = ? WHERE "
+                        "index_uid = ? AND split_id = ?",
+                        (split.state.value, json.dumps(split.to_dict()),
+                         index_uid, split_id))
 
     def delete_splits(self, index_uid: str,
                       split_ids: Iterable[str]) -> None:
@@ -389,20 +383,19 @@ class SqlMetastore(Metastore):
             # BEGIN IMMEDIATE holds the write lock across the whole
             # check-then-act even between processes
             self._index_row_by_uid(index_uid)
-            if True:
-                for split_id in split_ids:
-                    row = self._conn.execute(
-                        "SELECT state FROM splits WHERE index_uid = ? AND "
-                        "split_id = ?", (index_uid, split_id)).fetchone()
-                    if row is None:
-                        continue
-                    if row[0] == SplitState.PUBLISHED.value:
-                        raise MetastoreError(
-                            f"cannot delete published split {split_id!r}",
-                            kind="failed_precondition")
-                    self._conn.execute(
-                        "DELETE FROM splits WHERE index_uid = ? AND "
-                        "split_id = ?", (index_uid, split_id))
+            for split_id in split_ids:
+                row = self._conn.execute(
+                    "SELECT state FROM splits WHERE index_uid = ? AND "
+                    "split_id = ?", (index_uid, split_id)).fetchone()
+                if row is None:
+                    continue
+                if row[0] == SplitState.PUBLISHED.value:
+                    raise MetastoreError(
+                        f"cannot delete published split {split_id!r}",
+                        kind="failed_precondition")
+                self._conn.execute(
+                    "DELETE FROM splits WHERE index_uid = ? AND "
+                    "split_id = ?", (index_uid, split_id))
 
     # --- delete tasks -------------------------------------------------
     def create_delete_task(self, index_uid: str, query_ast_json: dict) -> int:
@@ -411,18 +404,17 @@ class SqlMetastore(Metastore):
             # BEGIN IMMEDIATE holds the write lock across the whole
             # check-then-act even between processes
             self._index_row_by_uid(index_uid)
-            if True:
-                row = self._conn.execute(
-                    "SELECT COALESCE(MAX(opstamp), 0) FROM delete_tasks "
-                    "WHERE index_uid = ?", (index_uid,)).fetchone()
-                opstamp = int(row[0]) + 1
-                task = {"opstamp": opstamp,
-                        "create_timestamp": int(time.time()),
-                        "query_ast": query_ast_json}
-                self._conn.execute(
-                    "INSERT INTO delete_tasks VALUES (?, ?, ?)",
-                    (index_uid, opstamp, json.dumps(task)))
-                return opstamp
+            row = self._conn.execute(
+                "SELECT COALESCE(MAX(opstamp), 0) FROM delete_tasks "
+                "WHERE index_uid = ?", (index_uid,)).fetchone()
+            opstamp = int(row[0]) + 1
+            task = {"opstamp": opstamp,
+                    "create_timestamp": int(time.time()),
+                    "query_ast": query_ast_json}
+            self._conn.execute(
+                "INSERT INTO delete_tasks VALUES (?, ?, ?)",
+                (index_uid, opstamp, json.dumps(task)))
+            return opstamp
 
     def list_delete_tasks(self, index_uid: str,
                           opstamp_start: int = 0) -> list[dict]:
@@ -450,19 +442,18 @@ class SqlMetastore(Metastore):
             # BEGIN IMMEDIATE holds the write lock across the whole
             # check-then-act even between processes
             self._index_row_by_uid(index_uid)
-            if True:
-                for split_id in split_ids:
-                    row = self._conn.execute(
-                        "SELECT split FROM splits WHERE index_uid = ? AND "
-                        "split_id = ?", (index_uid, split_id)).fetchone()
-                    if row is None:
-                        continue
-                    split = Split.from_dict(json.loads(row[0]))
-                    split.metadata.delete_opstamp = opstamp
-                    self._conn.execute(
-                        "UPDATE splits SET split = ? WHERE index_uid = ? "
-                        "AND split_id = ?",
-                        (json.dumps(split.to_dict()), index_uid, split_id))
+            for split_id in split_ids:
+                row = self._conn.execute(
+                    "SELECT split FROM splits WHERE index_uid = ? AND "
+                    "split_id = ?", (index_uid, split_id)).fetchone()
+                if row is None:
+                    continue
+                split = Split.from_dict(json.loads(row[0]))
+                split.metadata.delete_opstamp = opstamp
+                self._conn.execute(
+                    "UPDATE splits SET split = ? WHERE index_uid = ? "
+                    "AND split_id = ?",
+                    (json.dumps(split.to_dict()), index_uid, split_id))
 
     # --- index templates ----------------------------------------------
     def create_index_template(self, template: dict) -> None:

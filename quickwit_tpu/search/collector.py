@@ -52,10 +52,14 @@ class _StrKey:
 class IncrementalCollector:
     def __init__(self, max_hits: int, start_offset: int = 0,
                  search_after: Optional[tuple] = None,
-                 string_sort: Optional[str] = None):
+                 string_sort: Optional[str] = None,
+                 string_search_after: Optional[tuple] = None):
         self.max_hits = max_hits
         self.start_offset = start_offset
         self.search_after = search_after  # (sort_value, split_id, doc_id) internal
+        # text-sort marker: (raw_term|None, split|None, doc) — filtered on
+        # the DECODED strings (per-split ordinals are not comparable)
+        self.string_search_after = string_search_after
         # "asc" | "desc" when the primary sort is a text field: merge by
         # raw_sort_value (term string) instead of the split-local float key
         self.string_sort = string_sort
@@ -76,6 +80,18 @@ class IncrementalCollector:
         for key, value in leaf.resource_stats.items():
             self.resource_stats[key] = self.resource_stats.get(key, 0) + value
         hits = leaf.partial_hits
+        if self.string_search_after is not None and self.string_sort:
+            raw, m_split, m_doc = self.string_search_after
+            desc = self.string_sort == "desc"
+            marker = (_StrKey(raw, desc), m_split or "", m_doc)
+            if m_split is None:
+                hits = [h for h in hits
+                        if _StrKey(raw, desc) < _StrKey(h.raw_sort_value,
+                                                        desc)]
+            else:
+                hits = [h for h in hits
+                        if marker < (_StrKey(h.raw_sort_value, desc),
+                                     h.split_id, h.doc_id)]
         if self.search_after is not None:
             sa_v, sa_v2, sa_split, sa_doc = self.search_after
             if sa_split is None:

@@ -110,7 +110,9 @@ def prepare_plan_only(
         start_timestamp=request.start_timestamp,
         end_timestamp=request.end_timestamp,
         search_after=search_after_marker(request, split_id, sort_field,
-                                         sort_order, sort2),
+                                         sort_order, sort2,
+                                         doc_mapper=doc_mapper,
+                                         reader=reader),
         absence_sink=absence_sink,
     )
 
@@ -228,7 +230,8 @@ def execute_prepared_split(
 
 
 def search_after_marker(request: SearchRequest, split_id: str,
-                        sort_field: str, sort_order: str, sort2=None):
+                        sort_field: str, sort_order: str, sort2=None,
+                        doc_mapper=None, reader=None):
     """(internal_value, internal_value2|None, relation, marker_doc) for this
     split, or None.
 
@@ -237,6 +240,12 @@ def search_after_marker(request: SearchRequest, split_id: str,
       split < m_split  → strictly-less ("lt")
       split == m_split → less-or-doc-tie ("lt_tie")
       split > m_split  → less-or-equal ("le")
+
+    String markers (text-field sorts): internal keys are SPLIT-LOCAL
+    dictionary ordinals, so the raw term string translates per split via
+    binary search in the column dict; a term absent from this split maps
+    to the half-ordinal between its neighbors (f64 keys compare exactly),
+    with tie relations impossible by construction.
     """
     if not request.search_after:
         return None
@@ -248,9 +257,27 @@ def search_after_marker(request: SearchRequest, split_id: str,
     if m_split is not None:
         m_split = str(m_split)
 
+    string_sort = None
+    if doc_mapper is not None:
+        from .models import string_sort_of
+        string_sort = string_sort_of(request, doc_mapper)
+
+    def encode_string(value: str, order: str) -> float:
+        import bisect
+        terms = reader.column_dict(sort_field)
+        index = bisect.bisect_left(terms, value)
+        if index < len(terms) and terms[index] == value:
+            ordinal = float(index)          # exact: tie relations apply
+        else:
+            ordinal = index - 0.5           # between neighbors: no ties
+        return ordinal if order == "desc" else -ordinal
+
     def encode(value, field, order):
         if value is None:
             return MISSING_VALUE_SENTINEL
+        if string_sort is not None and field == sort_field \
+                and isinstance(value, str):
+            return encode_string(value, order)
         return float(value) if order == "desc" else -float(value)
 
     internal = encode(raw, sort_field, sort_order)

@@ -1083,10 +1083,6 @@ def lower_request(
         and low._is_text_sort(sort_field)) else None
     aggs = [low.lower_agg(spec) for spec in agg_specs]
     sa_relation, sa_value_slot, sa_value2_slot, sa_doc_slot = "none", -1, -1, -1
-    if search_after is not None and sort_text_field is not None:
-        raise PlanError(
-            f"search_after/scroll is not supported with text-field sort "
-            f"{sort_text_field!r} (string markers are a follow-up)")
     if search_after is not None:
         sa_value, sa_value2, sa_relation, sa_doc = search_after
         sa_value_slot = low.b.add_scalar(float(sa_value), np.float64)

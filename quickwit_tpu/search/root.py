@@ -83,10 +83,14 @@ class RootSearcher:
             raise ValueError(
                 f"sort field {field!r} is a text fast field in some matched "
                 f"indexes but not others; cross-index sort needs one type")
+        string_sort = next(iter(sort_modes))
         collector = IncrementalCollector(
             max_hits=request.max_hits, start_offset=request.start_offset,
-            search_after=self._search_after_key(request),
-            string_sort=next(iter(sort_modes)))
+            search_after=(None if string_sort is not None
+                          else self._search_after_key(request)),
+            string_sort=string_sort,
+            string_search_after=(self._string_search_after(request)
+                                 if string_sort is not None else None))
         split_meta_by_id: dict[str, tuple[str, SplitIdAndFooter, dict]] = {}
         nodes = self.nodes_provider()
 
@@ -143,6 +147,25 @@ class RootSearcher:
         )
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _string_search_after(request: SearchRequest):
+        """Marker for text-field sorts: (raw_term|None, split|None, doc).
+        Leafs push it down as per-split ordinal bounds; the root collector
+        re-filters on the decoded term strings (split-local ordinals are
+        not cross-split comparable)."""
+        if not request.search_after:
+            return None
+        sa = request.search_after
+        if len(sa) == 3:
+            raw, m_split, m_doc = sa[0], sa[1], sa[2]
+        elif len(sa) == 4:  # secondary sort rides along; primary governs
+            raw, m_split, m_doc = sa[0], sa[2], sa[3]
+        else:
+            raise ValueError(
+                "search_after expects [sort_value(s)..., split_id, doc_id]")
+        return (raw, None if m_split is None else str(m_split),
+                int(m_doc) if m_doc is not None else -1)
+
     def _resolve_indexes(self, patterns: list[str]):
         out = []
         seen = set()
@@ -299,9 +322,7 @@ class RootSearcher:
                 return MISSING_VALUE_SENTINEL
             if isinstance(value, str):
                 raise ValueError(
-                    "search_after with string sort values is not supported "
-                    "(text-field sort markers are a follow-up); paginate "
-                    "within the scroll window instead")
+                    "search_after got a string for a numeric sort field")
             value = float(value)
             if sort and sort.order == "asc":
                 value = -value

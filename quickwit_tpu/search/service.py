@@ -264,7 +264,7 @@ class SearchService:
                 and not any(key in _json.dumps(search_request.aggs or {})
                             for key in ("split_size", "shard_size",
                                         "segment_size"))):
-            admitted = 0
+            admitted = None
             batch = None
             try:
                 readers = [self.context.reader(s) for s in group]
@@ -278,7 +278,7 @@ class SearchService:
                 stage_device_inputs(batch)  # async transfer starts now
                 return ("batch", group, (batch, admitted))
             except Exception as exc:  # noqa: BLE001 - fall back per split
-                if admitted and batch is not None:
+                if admitted is not None and batch is not None:
                     self.context.hbm_budget.release(batch, admitted)
                 logger.debug("batch path failed (%s); searching per split", exc)
         return ("per_split", group,
@@ -331,11 +331,11 @@ class SearchService:
                 # tight budget the fallback would otherwise wait on its own
                 # still-pinned batch bytes
                 self.context.hbm_budget.release(batch, admitted)
-                admitted = 0
+                admitted = None
                 data = self._prepare_per_split(group, doc_mapper,
                                                search_request)
             finally:
-                if admitted:
+                if admitted is not None:
                     self.context.hbm_budget.release(batch, admitted)
         from .leaf import warmup_device_arrays
         for split, reader, plan, prep_error in data:

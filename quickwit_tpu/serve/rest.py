@@ -371,6 +371,24 @@ class RestServer:
                 node.otel.jaeger_trace(t, node.otel.get_trace(t))
                 for t in trace_ids]}
 
+        # --- SQL analytics (role of the fork's datafusion_api) --------
+        if path == "/api/v1/_sql" and method == "POST":
+            from ..analytics import SqlError, execute_sql
+            from ..search.models import SearchRequest as _SR
+            payload = json.loads(body) if body else {}
+            statement = payload.get("query")
+            if not isinstance(statement, str) or not statement.strip():
+                raise ApiError(400, "_sql expects {\"query\": \"SELECT ...\"}")
+
+            def run_search(index_id, query_ast, max_hits, aggs):
+                return node.root_searcher.search(_SR(
+                    index_ids=[index_id], query_ast=query_ast,
+                    max_hits=max_hits, aggs=aggs))
+
+            try:
+                return 200, execute_sql(statement, run_search)
+            except SqlError as exc:
+                raise ApiError(400, str(exc))
         # --- scroll / list apis ---------------------------------------
         if path == "/api/v1/scroll":
             scroll_id = params.get("scroll_id")
@@ -675,6 +693,9 @@ class RestServer:
                              if isinstance(spec, dict) else spec)
                     parsed.append(SortField(field_name, order))
             sort_fields = tuple(parsed)
+        # ES date sorts exchange epoch MILLIS by default (nanos with
+        # format=epoch_nanos_int); internal sort keys are micros
+        scales = self._es_sort_scales(index, sort_fields, sort_spec)
         search_after = None
         if payload.get("search_after"):
             marker = payload["search_after"]
@@ -705,6 +726,12 @@ class RestServer:
                 # strictly after the VALUE — docs tying the marker on every
                 # key are skipped entirely
                 search_after = list(marker) + [None, -1]
+            if search_after is not None:
+                search_after = ([self._scale_in(v, scales[i] if
+                                                i < len(scales) else None)
+                                 for i, v in
+                                 enumerate(search_after[:n_keys])]
+                                + search_after[n_keys:])
             else:
                 raise ApiError(
                     400, "search_after must be the hit's sort array "
@@ -714,7 +741,7 @@ class RestServer:
                                    params.get("track_total_hits", True))
         if isinstance(track_total, str):  # query-param form is a string
             track_total = track_total.lower() not in ("false", "0", "no")
-        return SearchRequest(
+        request = SearchRequest(
             index_ids=index_ids,
             query_ast=ast,
             max_hits=int(payload.get("size", params.get("size", 10))),
@@ -724,6 +751,67 @@ class RestServer:
             count_hits_exact=track_total is not False,
             search_after=search_after,
         )
+        request._es_sort_scales = scales  # response-side display scaling
+        return request
+
+    def _es_sort_scales(self, index_pattern: str, sort_fields,
+                        sort_spec) -> list:
+        """Per-sort-key display scale: 'ms' (default ES date exchange
+        format), 'ns' (format=epoch_nanos_int), or None (non-date)."""
+        try:
+            resolved = self.node.root_searcher._resolve_indexes(
+                index_pattern.split(","))
+            mapper = resolved[0].index_config.doc_mapper if resolved else None
+        except Exception:  # noqa: BLE001 - resolution errors surface later
+            mapper = None
+        scales = []
+        specs = sort_spec if isinstance(sort_spec, list) else []
+        for i, sf in enumerate(sort_fields):
+            fm = mapper.field(sf.field) if mapper is not None else None
+            if fm is None:
+                scales.append(None)  # unknown: pass markers through
+                continue
+            if fm.type.value == "text":
+                scales.append("txt")  # never coerce string markers
+                continue
+            if fm.type.value != "datetime":
+                scales.append("num")  # numeric: coerce "5688" like ES
+                continue
+            fmt = None
+            if i < len(specs) and isinstance(specs[i], dict):
+                inner = next(iter(specs[i].values()))
+                if isinstance(inner, dict):
+                    fmt = inner.get("format")
+            scales.append("ns" if fmt == "epoch_nanos_int" else "ms")
+        return scales
+
+    @staticmethod
+    def _scale_in(value, scale):
+        """Marker value (exchange format) → internal micros; numeric
+        strings coerce like ES."""
+        if value is None or isinstance(value, bool):
+            return value
+        if scale in (None, "txt"):
+            return value  # text/unknown sort: markers pass through verbatim
+        if isinstance(value, str):
+            try:
+                value = float(value) if "." in value else int(value)
+            except ValueError:
+                return value
+        if scale == "ms":
+            return int(value) * 1000
+        if scale == "ns":
+            return int(value) // 1000
+        return value
+
+    @staticmethod
+    def _scale_out(value, scale):
+        if value is None or isinstance(value, str) or \
+                scale in (None, "txt", "num"):
+            return value
+        if scale == "ms":
+            return int(value) // 1000
+        return int(value) * 1000
 
     @staticmethod
     def _es_scroll_page(page: dict[str, Any], index: str) -> dict[str, Any]:
@@ -768,8 +856,11 @@ class RestServer:
                 # `search_after` resumes exactly after this hit, ties incl.
                 # Missing sort values stay as null (ES does the same) so a
                 # page ending on a missing-value hit still yields a marker.
-                entry["sort"] = hit.sort_values + [
-                    f"{hit.split_id}|{hit.doc_id}"]
+                scales = getattr(request, "_es_sort_scales", [])
+                values = [RestServer._scale_out(
+                    v, scales[i] if i < len(scales) else None)
+                    for i, v in enumerate(hit.sort_values)]
+                entry["sort"] = values + [f"{hit.split_id}|{hit.doc_id}"]
             if hit.snippets:
                 entry["highlight"] = hit.snippets
             hits.append(entry)

@@ -155,3 +155,35 @@ def test_gossip_rejects_cluster_id_mismatch():
     finally:
         a.stop()
         b.stop()
+
+
+def test_phi_accrual_adapts_to_cadence():
+    """Phi-accrual: the same absolute silence is suspicious for a fast
+    heartbeater and normal for a slow one — a fixed age threshold cannot
+    express this (reference: chitchat FailureDetectorConfig)."""
+    import time as _time
+
+    from quickwit_tpu.cluster.membership import Cluster, ClusterMember
+    cluster = Cluster("self", ("searcher",), dead_after_secs=1000.0)
+    fast = ClusterMember("fast", ("searcher",), rest_endpoint="h:1")
+    slow = ClusterMember("slow", ("searcher",), rest_endpoint="h:2")
+    cluster.join(fast)
+    cluster.join(slow)
+    now = _time.monotonic()
+    # synthesize observed cadences: fast @100ms, slow @5s
+    fast.intervals = [0.1] * 8
+    slow.intervals = [5.0] * 8
+    fast.last_heartbeat = now - 3.0   # 30 missed fast beats
+    slow.last_heartbeat = now - 3.0   # less than one slow beat
+    assert cluster.phi(fast, now) > cluster.phi_threshold
+    assert cluster.phi(slow, now) < cluster.phi_threshold
+    assert not cluster.is_alive(fast, now)
+    assert cluster.is_alive(slow, now)
+    # the hard bound still catches long-silent peers regardless of cadence
+    slow.last_heartbeat = now - 2000.0
+    assert not cluster.is_alive(slow, now)
+    # below MIN_SAMPLES the detector abstains and the hard bound governs
+    fresh = ClusterMember("fresh", ("searcher",), rest_endpoint="h:3")
+    cluster.join(fresh)
+    fresh.last_heartbeat = now - 3.0
+    assert cluster.is_alive(fresh, now)

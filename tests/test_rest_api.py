@@ -566,3 +566,26 @@ def test_search_index_patterns_and_lists(api):
     assert status == 200 and result["num_hits"] == 2
     status, result = api.request("GET", "/api/v1/zzz-*/search?query=patdoc")
     assert status == 404 and "no index matches" in result["message"]
+
+
+def test_es_search_after_string_sort(api):
+    """search_after pagination over a TEXT fast-field sort: markers carry
+    the raw term string; leafs push per-split ordinal bounds, the root
+    re-filters on decoded strings."""
+    seen = []
+    marker = None
+    for _ in range(50):
+        body = {"query": {"match_all": {}}, "size": 7,
+                "sort": [{"severity_text": {"order": "asc"}}]}
+        if marker is not None:
+            body["search_after"] = marker
+        status, result = api.request(
+            "POST", "/api/v1/_elastic/hdfs-logs/_search", body)
+        assert status == 200, result
+        page = result["hits"]["hits"]
+        if not page:
+            break
+        seen.extend(h["_source"]["severity_text"] for h in page)
+        marker = page[-1]["sort"]
+    assert len(seen) >= 100  # the whole corpus paged through
+    assert seen == sorted(seen)  # ascending by term across pages
