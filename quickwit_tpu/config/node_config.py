@@ -78,6 +78,7 @@ def load_node_config(path: Optional[str] = None,
         tls_key_path=tls.get("key_path"),
         tls_ca_path=tls.get("ca_path"),
         tls_skip_verify=bool(tls.get("skip_verify", False)),
+        tls_verify_client=bool(tls.get("verify_client", False)),
         gossip_enabled=bool(data.get("gossip", False)),
         replication_factor=int(pick("QW_REPLICATION_FACTOR",
                                     "replication_factor", 1)),

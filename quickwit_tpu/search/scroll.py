@@ -32,9 +32,6 @@ class ScrollContext:
     total_hits: int = 0
     created_at: float = field(default_factory=time.monotonic)
     ttl_secs: float = DEFAULT_TTL_SECS
-    # text-field primary sort: refilling past the cached window needs a
-    # string search_after marker (unsupported — named error on refill)
-    string_sort: bool = False
 
     @property
     def expired(self) -> bool:
@@ -53,6 +50,13 @@ class ScrollStore:
             self._contexts[scroll_id] = context
         return scroll_id
 
+    def put_with_id(self, scroll_id: str, context: ScrollContext) -> None:
+        """Install a replicated context under its existing id (the
+        affinity-replica side of put_kv)."""
+        with self._lock:
+            self._gc()
+            self._contexts[scroll_id] = context
+
     def get(self, scroll_id: str) -> Optional[ScrollContext]:
         with self._lock:
             context = self._contexts.get(scroll_id)
@@ -69,3 +73,39 @@ class ScrollStore:
         dead = [k for k, c in self._contexts.items() if c.expired]
         for k in dead:
             del self._contexts[k]
+
+
+# --------------------------------------------------------------------------
+# serialization (cluster-KV replication of scroll contexts — reference:
+# put_kv to best-affinity nodes, scroll_context.rs:146)
+
+def context_to_dict(context: ScrollContext) -> dict:
+    return {
+        "request": context.request.to_dict(),
+        "cached_hits": [
+            {"doc": h.doc, "score": h.score, "sort_values": h.sort_values,
+             "split_id": h.split_id, "doc_id": h.doc_id,
+             "snippets": h.snippets}
+            for h in context.cached_hits],
+        "cursor": context.cursor,
+        "total_hits": context.total_hits,
+        "ttl_secs": context.ttl_secs,
+        "age_secs": time.monotonic() - context.created_at,
+    }
+
+
+def context_from_dict(d: dict) -> ScrollContext:
+    from .models import Hit, SearchRequest
+    return ScrollContext(
+        request=SearchRequest.from_dict(d["request"]),
+        cached_hits=[Hit(doc=h["doc"], score=h.get("score"),
+                         sort_values=h.get("sort_values") or [],
+                         split_id=h.get("split_id", ""),
+                         doc_id=h.get("doc_id", 0),
+                         snippets=h.get("snippets"))
+                     for h in d["cached_hits"]],
+        cursor=d.get("cursor", 0),
+        total_hits=d.get("total_hits", 0),
+        created_at=time.monotonic() - d.get("age_secs", 0.0),
+        ttl_secs=d.get("ttl_secs", DEFAULT_TTL_SECS),
+    )

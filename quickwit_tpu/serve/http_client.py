@@ -35,7 +35,9 @@ class HttpStatusError(HttpTransportError):
 class HttpSearchClient:
     def __init__(self, endpoint: str, timeout_secs: float = 30.0,
                  tls: bool = False, ca_path: Optional[str] = None,
-                 skip_verify: bool = False):
+                 skip_verify: bool = False,
+                 client_cert_path: Optional[str] = None,
+                 client_key_path: Optional[str] = None):
         self.endpoint = endpoint  # "host:port"
         host, port = endpoint.rsplit(":", 1)
         self.host = host
@@ -52,6 +54,9 @@ class HttpSearchClient:
                 context.verify_mode = ssl.CERT_NONE
             else:
                 context = ssl.create_default_context(cafile=ca_path)
+            if client_cert_path:
+                # mTLS identity toward verify-client peers
+                context.load_cert_chain(client_cert_path, client_key_path)
             self._ssl_context = context
         # stop hammering a dead peer; root search fails fast to its retry
         # path instead of stacking timeouts (reference tower circuit breaker)

@@ -58,6 +58,10 @@ class NodeConfig:
     tls_key_path: Optional[str] = None
     tls_ca_path: Optional[str] = None
     tls_skip_verify: bool = False
+    # mTLS (reference quickwit-transport `validate_client`): the REST
+    # listener REQUIRES peer client certificates signed by tls_ca_path,
+    # and peer clients present the node cert/key as their identity
+    tls_verify_client: bool = False
     # UDP scuttlebutt gossip (role of chitchat): when enabled, membership
     # disseminates over UDP on the REST port number and the REST heartbeat
     # loop is not started. peer_seeds serve as gossip seeds unchanged.
@@ -75,8 +79,12 @@ class NodeConfig:
         """kwargs for HttpSearchClient toward peers of this cluster."""
         if not self.tls_enabled:
             return {}
-        return {"tls": True, "ca_path": self.tls_ca_path,
-                "skip_verify": self.tls_skip_verify}
+        kwargs = {"tls": True, "ca_path": self.tls_ca_path,
+                  "skip_verify": self.tls_skip_verify}
+        if self.tls_verify_client:
+            kwargs["client_cert_path"] = self.tls_cert_path
+            kwargs["client_key_path"] = self.tls_key_path
+        return kwargs
 
 
 def _validate_doc_mapping(doc_mapper: DocMapper) -> None:
@@ -530,16 +538,12 @@ class Node:
                                  max_hits=max(CACHE_WINDOW, page_size),
                                  start_offset=0)
         response = self.root_searcher.search(window_request)
-        from ..search.models import string_sort_of
-        resolved = self.root_searcher._resolve_indexes(request.index_ids)
-        mapper = resolved[0].index_config.doc_mapper if resolved else None
         context = ScrollContext(
             request=request, cached_hits=response.hits,
             cursor=min(page_size, len(response.hits)),
-            total_hits=response.num_hits, ttl_secs=ttl_secs,
-            string_sort=(mapper is not None
-                         and string_sort_of(request, mapper) is not None))
+            total_hits=response.num_hits, ttl_secs=ttl_secs)
         scroll_id = self.scroll_store.put(context)
+        self._replicate_scroll(scroll_id, context)
         page = response.to_dict()
         page["hits"] = page["hits"][:page_size]
         if "snippets" in page:  # parallel array: keep aligned with hits
@@ -552,9 +556,77 @@ class Node:
         """Release a scroll context early (clear-scroll)."""
         return self.scroll_store.delete(scroll_id)
 
+    def _scroll_affinity_peers(self, scroll_id: str) -> list:
+        """ALL other searcher members in rendezvous order (rendezvous
+        weights are per-node, so every node computes the same relative
+        order regardless of which node is excluded): replication targets
+        the first, recovery walks the whole list — a 3-node cluster where
+        the serving node restarts still finds the replica wherever the
+        next page lands."""
+        from ..common.rendezvous import sort_by_rendezvous_hash
+        peers = {m.node_id: m for m in self.cluster.members()
+                 if m.node_id != self.config.node_id
+                 and "searcher" in m.roles and m.rest_endpoint}
+        if not peers:
+            return []
+        ordered = sort_by_rendezvous_hash(scroll_id, list(peers))
+        return [peers[n] for n in ordered]
+
+    def _replicate_scroll(self, scroll_id: str, context) -> None:
+        """Best-effort put_kv to the best-affinity peer (reference:
+        scroll_context.rs:146): a node restart then no longer kills live
+        scrolls — the next page is served from the replica."""
+        from ..search.scroll import context_to_dict
+        for member in self._scroll_affinity_peers(scroll_id)[:1]:
+            client = self.clients.get(member.node_id)
+            if client is None:
+                continue
+            try:
+                client._post("/internal/kv", {
+                    "key": scroll_id, "kind": "scroll",
+                    "value": context_to_dict(context)})
+            except Exception:  # noqa: BLE001 - best-effort replication
+                logger.debug("scroll replication to %s failed",
+                             member.node_id)
+
+    def _replicate_scroll_cursor(self, scroll_id: str, cursor: int) -> None:
+        """Per-page cursor sync: a few bytes instead of re-shipping the
+        whole cached window on every page."""
+        for member in self._scroll_affinity_peers(scroll_id)[:1]:
+            client = self.clients.get(member.node_id)
+            if client is None:
+                continue
+            try:
+                client._post("/internal/kv", {
+                    "key": scroll_id, "kind": "scroll_cursor",
+                    "value": cursor})
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _fetch_scroll(self, scroll_id: str):
+        """Local miss (e.g. this node restarted, or the client hit a
+        different node): recover the context from the affinity replica."""
+        from ..search.scroll import context_from_dict
+        for member in self._scroll_affinity_peers(scroll_id):
+            client = self.clients.get(member.node_id)
+            if client is None:
+                continue
+            try:
+                payload = client._post("/internal/kv_get",
+                                       {"key": scroll_id})
+            except Exception:  # noqa: BLE001
+                continue
+            if payload and payload.get("value"):
+                context = context_from_dict(payload["value"])
+                self.scroll_store.put_with_id(scroll_id, context)
+                return context
+        return None
+
     def continue_scroll(self, scroll_id: str) -> dict[str, Any]:
         from dataclasses import replace
         context = self.scroll_store.get(scroll_id)
+        if context is None:
+            context = self._fetch_scroll(scroll_id)
         if context is None:
             raise ValueError("scroll id not found or expired")
         page_size = context.request.max_hits
@@ -562,13 +634,8 @@ class Node:
         if context.cursor >= len(hits) and len(hits) < context.total_hits and hits:
             # refill the window via search_after from the last cached hit
             from ..search.scroll import CACHE_WINDOW
-            if context.string_sort:
-                # keyed on the REQUEST's sort type, not the cached value
-                # (a missing-value None marker would bypass a value check)
-                raise ValueError(
-                    "scrolling past the cached window is not supported with "
-                    "text-field sorts (string search_after markers are a "
-                    "follow-up); narrow the query or raise the window")
+            # string search_after markers make text-sort refills work the
+            # same as numeric ones (the raw term string IS the marker)
             last = hits[-1]
             sort_value = last.sort_values[0] if last.sort_values else last.score
             if len(context.request.sort_fields) > 1 and len(last.sort_values) > 1:
@@ -581,8 +648,10 @@ class Node:
                 search_after=marker)
             response = self.root_searcher.search(refill_request)
             hits.extend(response.hits)
+            self._replicate_scroll(scroll_id, context)  # window changed
         page_hits = hits[context.cursor: context.cursor + page_size]
         context.cursor += len(page_hits)
+        self._replicate_scroll_cursor(scroll_id, context.cursor)
         return {
             "num_hits": context.total_hits,
             "hits": [h.doc for h in page_hits],

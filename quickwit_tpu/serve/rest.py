@@ -138,6 +138,14 @@ class RestServer:
             import ssl
             context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             context.load_cert_chain(config.tls_cert_path, config.tls_key_path)
+            if config.tls_verify_client:
+                if not config.tls_ca_path:
+                    raise ValueError(
+                        "rest.tls.verify_client requires rest.tls.ca_path "
+                        "(the CA that signs peer client certificates)")
+                # mTLS: only peers holding a CA-signed client cert connect
+                context.verify_mode = ssl.CERT_REQUIRED
+                context.load_verify_locations(cafile=config.tls_ca_path)
             self._httpd.socket = context.wrap_socket(
                 self._httpd.socket, server_side=True,
                 do_handshake_on_connect=False)
@@ -213,6 +221,29 @@ class RestServer:
             except ReplicationGap as gap:
                 return 409, {"gap": True, "replica_position": gap.have}
             return 200, {"replica_position": last}
+        if path == "/internal/kv" and method == "POST":
+            # cluster KV (reference put_kv), dispatched on kind
+            from ..search.scroll import context_from_dict
+            payload = json.loads(body)
+            kind = payload.get("kind")
+            if kind == "scroll":
+                node.scroll_store.put_with_id(
+                    payload["key"], context_from_dict(payload["value"]))
+            elif kind == "scroll_cursor":
+                context = node.scroll_store.get(payload["key"])
+                if context is not None:
+                    context.cursor = max(context.cursor,
+                                         int(payload["value"]))
+            else:
+                raise ApiError(400, f"unknown kv kind {kind!r}")
+            return 200, {"ok": True}
+        if path == "/internal/kv_get" and method == "POST":
+            from ..search.scroll import context_to_dict
+            payload = json.loads(body)
+            context = node.scroll_store.get(payload["key"])
+            if context is None:
+                return 200, {"value": None}
+            return 200, {"value": context_to_dict(context)}
         if path == "/internal/replica_truncate" and method == "POST":
             payload = json.loads(body)
             node.ingester.replica_truncate(

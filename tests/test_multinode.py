@@ -6,7 +6,7 @@ import time
 
 import pytest
 
-from quickwit_tpu.cluster.membership import ClusterMember
+from quickwit_tpu.cluster.membership import ClusterChange, ClusterMember
 from quickwit_tpu.janitor import apply_retention, run_garbage_collection
 from quickwit_tpu.metastore.base import ListSplitsQuery
 from quickwit_tpu.models.split_metadata import SplitState
@@ -251,5 +251,95 @@ def test_tls_rest_and_peer_transport(tmp_path):
         plain = HttpSearchClient(f"127.0.0.1:{server.port}")
         with pytest.raises(HttpTransportError):
             plain.heartbeat({"node_id": "x", "roles": []})
+    finally:
+        server.stop()
+
+
+def test_scroll_survives_node_restart_via_replica(two_nodes):
+    """Scroll contexts replicate to the best-affinity peer (reference
+    put_kv, scroll_context.rs:146): losing the serving node's in-memory
+    store no longer kills live scrolls."""
+    nodes, servers = two_nodes
+    # test_dead_node_failover stopped node 1's server for good: bring a
+    # fresh listener up for it, then refresh liveness (the module fixture
+    # heartbeats only once at setup)
+    replacement = RestServer(nodes[1], host="127.0.0.1", port=0)
+    replacement.start()
+    servers = [servers[0], replacement]
+    for i, node in enumerate(nodes):
+        node.cluster.upsert_heartbeat(ClusterMember(
+            node_id=f"mn-{1 - i}",
+            roles=("searcher", "indexer", "metastore"),
+            rest_endpoint=f"127.0.0.1:{servers[1 - i].port}"))
+    nodes[0].clients.pop("mn-1", None)  # re-resolve at the new port
+    nodes[0]._on_cluster_change(ClusterChange("update", ClusterMember(
+        "mn-1", ("searcher", "indexer", "metastore"),
+        rest_endpoint=f"127.0.0.1:{replacement.port}")))
+    status, _ = rest(servers[0].port, "POST", "/api/v1/indexes", {
+        **INDEX_CONFIG, "index_id": "scr-logs"})
+    assert status == 200
+    docs = "\n".join(json.dumps({"ts": 1_700_000_000 + i,
+                                 "body": f"scroll doc {i}"})
+                     for i in range(40)).encode()
+    status, _ = rest(servers[0].port, "POST",
+                     "/api/v1/scr-logs/ingest?commit=force", docs)
+    assert status == 200
+
+    status, page1 = rest(servers[0].port, "GET",
+                         "/api/v1/scr-logs/search?query=*&max_hits=10"
+                         "&scroll=1m")
+    assert status == 200 and len(page1["hits"]) == 10
+    scroll_id = page1["scroll_id"]
+
+    # simulate the serving node losing its in-memory contexts (restart)
+    nodes[0].scroll_store._contexts.clear()
+
+    # the next page recovers from the affinity replica on the peer
+    status, page2 = rest(servers[0].port, "GET",
+                         f"/api/v1/scroll?scroll_id={scroll_id}")
+    assert status == 200, page2
+    assert len(page2["hits"]) == 10
+    ids1 = {json.dumps(h, sort_keys=True) for h in page1["hits"]}
+    ids2 = {json.dumps(h, sort_keys=True) for h in page2["hits"]}
+    assert not ids1 & ids2  # disjoint pages: the cursor replicated too
+
+
+def test_mtls_requires_client_certificate(tmp_path):
+    """mTLS (reference quickwit-transport validate_client): the listener
+    rejects TLS clients without a CA-signed client certificate; peers
+    presenting the node cert connect."""
+    import shutil
+    import subprocess
+
+    if shutil.which("openssl") is None:
+        pytest.skip("openssl unavailable")
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True)
+    node = Node(NodeConfig(node_id="mtls-node", rest_port=0,
+                           metastore_uri="ram:///mtls/metastore",
+                           default_index_root_uri="ram:///mtls/indexes",
+                           tls_cert_path=str(cert), tls_key_path=str(key),
+                           tls_ca_path=str(cert), tls_verify_client=True),
+                storage_resolver=StorageResolver.for_test())
+    server = RestServer(node)
+    server.start()
+    try:
+        # no client cert: the handshake is refused
+        bare = HttpSearchClient(f"127.0.0.1:{server.port}", tls=True,
+                                ca_path=str(cert))
+        with pytest.raises(HttpTransportError):
+            bare.heartbeat({"node_id": "x", "roles": []})
+        # with the cluster cert as client identity: accepted
+        client = HttpSearchClient(f"127.0.0.1:{server.port}",
+                                  **node.config.client_tls_kwargs())
+        info = client.heartbeat({"node_id": "probe", "roles": ["searcher"],
+                                 "rest_endpoint": "127.0.0.1:9"})
+        assert info["node_id"] == "mtls-node"
     finally:
         server.stop()
