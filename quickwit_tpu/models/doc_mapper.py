@@ -263,7 +263,7 @@ class DocMapper:
     def from_dict(d: dict[str, Any]) -> "DocMapper":
         return DocMapper(
             doc_mapping_uid=d.get("doc_mapping_uid", "default"),
-            field_mappings=[FieldMapping.from_dict(f) for f in d["field_mappings"]],
+            field_mappings=[FieldMapping.from_dict(f) for f in d.get("field_mappings", [])],
             timestamp_field=d.get("timestamp_field"),
             tag_fields=tuple(d.get("tag_fields", ())),
             default_search_fields=tuple(d.get("default_search_fields", ())),

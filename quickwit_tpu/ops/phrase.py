@@ -7,7 +7,9 @@ list (doc ids + phrase frequencies) that enters the device plan like any
 term's postings. This keeps the device graph static while supporting exact
 phrases; a Pallas positional kernel is the planned upgrade path.
 
-Only slop=0 (exact adjacency) is implemented; non-zero slop raises.
+slop>0 uses the k-way minimal-window algorithm over RELATIVE positions
+(p_i - i): an alignment of the phrase terms matches when the spread of
+their relative positions is <= slop — tantivy's PhraseScorer semantics.
 """
 
 from __future__ import annotations
@@ -20,16 +22,17 @@ def phrase_match(
     positions: list[tuple[np.ndarray, np.ndarray]],
     dfs: list[int],
     slop: int = 0,
+    term_keys: list | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Docs containing the terms as an exact phrase.
 
     `postings[i] = (padded_ids, padded_tfs)` and
     `positions[i] = (offsets[post_len+1], data)` for phrase term i, with
-    `dfs[i]` real (unpadded) postings. Returns (doc_ids, phrase_freqs),
-    unpadded, sorted by doc id.
+    `dfs[i]` real (unpadded) postings. `term_keys[i]` identifies the term
+    in slot i so REPEATED phrase terms ("a a") are required to occupy
+    distinct document positions, as in Lucene/tantivy. Returns
+    (doc_ids, phrase_freqs), unpadded, sorted by doc id.
     """
-    if slop != 0:
-        raise NotImplementedError("phrase slop > 0 not supported yet")
     if not postings:
         return np.array([], dtype=np.int32), np.array([], dtype=np.int32)
 
@@ -48,7 +51,27 @@ def phrase_match(
     for (ids, _), df in zip(postings, dfs):
         term_indices.append(np.searchsorted(ids[:df], common))
 
+    # slots holding the same term must align to distinct positions
+    dup_groups: list[list[int]] = []
+    if term_keys is not None:
+        by_key: dict = {}
+        for i, k in enumerate(term_keys):
+            by_key.setdefault(k, []).append(i)
+        dup_groups = [slots for slots in by_key.values() if len(slots) > 1]
+
     for row, doc_id in enumerate(common):
+        if slop > 0:
+            relatives = []
+            for i in range(len(postings)):
+                offs, data = positions[i]
+                ji = term_indices[i][row]
+                relatives.append(
+                    data[offs[ji]: offs[ji + 1]].astype(np.int64) - i)
+            freq = _sloppy_matches(relatives, slop, dup_groups)
+            if freq > 0:
+                out_ids.append(int(doc_id))
+                out_freqs.append(freq)
+            continue
         offsets0, data0 = positions[0]
         j0 = term_indices[0][row]
         base = data0[offsets0[j0]: offsets0[j0 + 1]].astype(np.int64)
@@ -63,3 +86,24 @@ def phrase_match(
             out_ids.append(int(doc_id))
             out_freqs.append(int(base.size))
     return np.array(out_ids, dtype=np.int32), np.array(out_freqs, dtype=np.int32)
+
+
+def _sloppy_matches(relatives: list[np.ndarray], slop: int,
+                    dup_groups: list[list[int]] = ()) -> int:
+    """Number of k-way alignments whose relative-position spread <= slop
+    (minimal-window sweep with one pointer per term). A window only counts
+    when slots of a repeated term (`dup_groups`) sit at distinct absolute
+    positions (relative + slot index) — Lucene/tantivy semantics."""
+    pointers = [0] * len(relatives)
+    matches = 0
+    while all(p < len(r) for p, r in zip(pointers, relatives)):
+        values = [r[p] for p, r in zip(pointers, relatives)]
+        lo, hi = min(values), max(values)
+        if hi - lo <= slop and all(
+                len({values[i] + i for i in group}) == len(group)
+                for group in dup_groups):
+            matches += 1
+        # advance the minimum pointer to look for further windows
+        advance = values.index(lo)
+        pointers[advance] += 1
+    return matches

@@ -97,28 +97,36 @@ class PhrasePrefix(QueryAst):
     field: str
     phrase: str
     max_expansions: int = 50
+    analyzer: Optional[str] = None  # ES per-query analyzer override
 
     def to_dict(self) -> dict[str, Any]:
         return {"type": "phrase_prefix", "field": self.field, "phrase": self.phrase,
-                "max_expansions": self.max_expansions}
+                "max_expansions": self.max_expansions,
+                "analyzer": self.analyzer}
 
 
 @dataclass(frozen=True)
 class Wildcard(QueryAst):
     field: str
     pattern: str  # `*` and `?` wildcards
+    case_insensitive: bool = False
 
     def to_dict(self) -> dict[str, Any]:
-        return {"type": "wildcard", "field": self.field, "pattern": self.pattern}
+        return {"type": "wildcard", "field": self.field,
+                "pattern": self.pattern,
+                "case_insensitive": self.case_insensitive}
 
 
 @dataclass(frozen=True)
 class Regex(QueryAst):
     field: str
     pattern: str
+    case_insensitive: bool = False
 
     def to_dict(self) -> dict[str, Any]:
-        return {"type": "regex", "field": self.field, "pattern": self.pattern}
+        return {"type": "regex", "field": self.field,
+                "pattern": self.pattern,
+                "case_insensitive": self.case_insensitive}
 
 
 @dataclass(frozen=True)
@@ -216,11 +224,12 @@ def ast_from_dict(d: dict[str, Any]) -> QueryAst:
         return FullText(d["field"], d["text"], d.get("mode", "or"),
                         d.get("slop", 0), d.get("zero_terms", "none"))
     if tag == "phrase_prefix":
-        return PhrasePrefix(d["field"], d["phrase"], d.get("max_expansions", 50))
+        return PhrasePrefix(d["field"], d["phrase"], d.get("max_expansions", 50),
+                            d.get("analyzer"))
     if tag == "wildcard":
-        return Wildcard(d["field"], d["pattern"])
+        return Wildcard(d["field"], d["pattern"], d.get("case_insensitive", False))
     if tag == "regex":
-        return Regex(d["field"], d["pattern"])
+        return Regex(d["field"], d["pattern"], d.get("case_insensitive", False))
     if tag == "field_presence":
         return FieldPresence(d["field"])
     if tag == "range":

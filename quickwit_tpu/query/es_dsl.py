@@ -82,18 +82,73 @@ def es_query_to_ast(query: dict[str, Any],
     if kind == "match_phrase_prefix":
         field, spec = _single_kv(body, "match_phrase_prefix")
         if isinstance(spec, dict):
+            analyzer = spec.get("analyzer")
+            if analyzer is not None:
+                from .tokenizers import known_tokenizer
+                if not known_tokenizer(analyzer):
+                    raise EsDslParseError(
+                        f"unknown analyzer {analyzer!r}")
             return PhrasePrefix(field, str(spec["query"]),
-                                max_expansions=spec.get("max_expansions", 50))
+                                max_expansions=spec.get("max_expansions", 50),
+                                analyzer=analyzer)
         return PhrasePrefix(field, _scalar_str(spec))
     if kind == "multi_match":
+        if body.get("fields") == []:
+            raise EsDslParseError("multi_match `fields` must not be empty")
         fields = body.get("fields") or list(default_search_fields)
+        if isinstance(fields, str):
+            fields = [fields]  # multi_match accepts a single string
         if not fields:
             raise EsDslParseError("multi_match requires fields")
+        # ES `field^boost` syntax
+        boosts = {}
+        parsed_fields = []
+        for f in fields:
+            name, _, boost = str(f).partition("^")
+            parsed_fields.append(name)
+            if boost:
+                boosts[name] = float(boost)
+        fields = parsed_fields
+        if lenient_validator is not None:
+            # ES drops unknown fields from multi_match regardless of the
+            # `lenient` flag (field leniency vs value leniency)
+            known = [f for f in fields if lenient_validator(f, None)]
+            if not known:
+                return MatchNone()
+            fields = known
         text = str(body["query"])
-        mode = "phrase" if body.get("type") == "phrase" else \
-            body.get("operator", "or").lower()
-        clauses = tuple(FullText(f, text, mode) for f in fields)
-        return clauses[0] if len(clauses) == 1 else Bool(should=clauses)
+        mm_type = body.get("type")
+        def boosted(node, f):
+            return Boost(node, boosts[f]) if f in boosts else node
+
+        if mm_type == "phrase_prefix":
+            max_exp = int(body.get("max_expansions", 50))
+            clauses: tuple = tuple(
+                boosted(PhrasePrefix(f, text, max_expansions=max_exp), f)
+                for f in fields)
+        else:
+            mode = "phrase" if mm_type == "phrase" else \
+                body.get("operator", "or").lower()
+            clauses = tuple(
+                boosted(FullText(f, text, mode,
+                                 slop=int(body.get("slop", 0))), f)
+                for f in fields)
+        ast = clauses[0] if len(clauses) == 1 else Bool(should=clauses)
+        if body.get("lenient") and lenient_validator is not None:
+            ast = rewrite_lenient(ast, lenient_validator)
+        return ast
+    if kind == "match_bool_prefix":
+        # every token matches as a term except the last, which matches as
+        # a prefix (ES match_bool_prefix)
+        field, spec = _single_kv(body, "match_bool_prefix")
+        text = str(spec["query"]) if isinstance(spec, dict) else \
+            _scalar_str(spec)
+        operator = (str(spec.get("operator", "or")).lower()
+                    if isinstance(spec, dict) else "or")
+        # analysis happens at lowering with the FIELD's tokenizer (the
+        # last TOKEN becomes a prefix, not the last space-separated word)
+        mode = "bool_prefix_and" if operator == "and" else "bool_prefix_or"
+        return FullText(field, text, mode)
     if kind == "bool":
         msm = body.get("minimum_should_match")
         num_should = len(_as_clause_list(body.get("should")))
@@ -130,15 +185,18 @@ def es_query_to_ast(query: dict[str, Any],
     if kind == "wildcard":
         field, spec = _single_kv(body, "wildcard")
         pattern = spec["value"] if isinstance(spec, dict) else spec
-        return Wildcard(field, str(pattern))
+        ci = isinstance(spec, dict) and bool(spec.get("case_insensitive"))
+        return Wildcard(field, str(pattern), case_insensitive=ci)
     if kind == "regexp":
         field, spec = _single_kv(body, "regexp")
         pattern = spec["value"] if isinstance(spec, dict) else spec
-        return Regex(field, str(pattern))
+        ci = isinstance(spec, dict) and bool(spec.get("case_insensitive"))
+        return Regex(field, str(pattern), case_insensitive=ci)
     if kind == "prefix":
         field, spec = _single_kv(body, "prefix")
         value = spec["value"] if isinstance(spec, dict) else spec
-        return Wildcard(field, f"{value}*")
+        ci = isinstance(spec, dict) and bool(spec.get("case_insensitive"))
+        return Wildcard(field, f"{value}*", case_insensitive=ci)
     if kind in ("query_string", "simple_query_string"):
         if "fields" in body and not isinstance(body["fields"], list):
             # ES rejects a bare-string `fields` (400); only `default_field`

@@ -170,9 +170,13 @@ class _Parser:
                     return Range(field, upper=bound)
             if value.startswith('"'):
                 return self._phrase(field, value)
-            if "*" in value or "?" in value:
-                return Wildcard(field, value)
-            return Term(field, value)
+            unescaped = value.replace("\\*", "\x00").replace("\\?", "\x01")
+            if "*" in unescaped or "?" in unescaped:
+                # escaped wildcards match literally (fnmatch classes)
+                return Wildcard(field, unescaped.replace("\x00", "[*]")
+                                .replace("\x01", "[?]"))
+            return Term(field, unescaped.replace("\x00", "*")
+                        .replace("\x01", "?"))
         # bare term → full-text over default fields
         return self._default_field_query(tok)
 
@@ -191,10 +195,17 @@ class _Parser:
                           for f in self.default_fields]
                 return ranges[0] if len(ranges) == 1 else \
                     Bool(should=tuple(ranges))
-        if ("*" in text or "?" in text) and text != "*":
-            # bare wildcard over the default fields (ES query_string)
-            wilds = [Wildcard(f, text) for f in self.default_fields]
+        unescaped = text.replace("\\*", "\x00").replace("\\?", "\x01")
+        if ("*" in unescaped or "?" in unescaped) and text != "*":
+            # bare wildcard over the default fields (ES query_string);
+            # ESCAPED wildcards become fnmatch character classes so they
+            # match literally
+            pattern = (unescaped.replace("\x00", "[*]")
+                       .replace("\x01", "[?]"))
+            wilds = [Wildcard(f, pattern) for f in self.default_fields]
             return wilds[0] if len(wilds) == 1 else Bool(should=tuple(wilds))
+        # escaped wildcards are literal characters, not operators
+        text = unescaped.replace("\x00", "*").replace("\x01", "?")
         clauses = [FullText(f, text, "or") for f in self.default_fields]
         if len(clauses) == 1:
             return clauses[0]

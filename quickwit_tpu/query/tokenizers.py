@@ -126,3 +126,7 @@ def get_tokenizer(name: str) -> Tokenizer:
 
 def tokenizer_names() -> list[str]:
     return sorted(_REGISTRY)
+
+
+def known_tokenizer(name: str) -> bool:
+    return name in _REGISTRY

@@ -401,8 +401,12 @@ class Lowering:
             for field, terms in ast.terms_per_field.items():
                 fm = self._field(field)
                 for term in terms:
-                    nodes.append(self._postings_node(
-                        field, self._canonical(fm, term), False, boost))
+                    if not fm.indexed and fm.fast \
+                            and fm.type is FieldType.TEXT:
+                        nodes.append(self._fast_only_term(field, term))
+                    else:
+                        nodes.append(self._postings_node(
+                            field, self._canonical(fm, term), False, boost))
             return self._or(nodes)
         if isinstance(ast, Q.FullText):
             return self._lower_full_text(ast, scoring, boost)
@@ -417,11 +421,17 @@ class Lowering:
                 # `Jou*al` matches tokens of lowercasing tokenizers
                 # (raw and whitespace preserve case)
                 pattern = pattern.lower()
-            return self._lower_pattern(ast.field, fnmatch.translate(pattern),
-                                       scoring, boost, literal_prefix=_wildcard_prefix(pattern))
+            return self._lower_pattern(
+                ast.field, fnmatch.translate(pattern), scoring, boost,
+                literal_prefix=("" if ast.case_insensitive
+                                else _wildcard_prefix(pattern)),
+                case_insensitive=ast.case_insensitive)
         if isinstance(ast, Q.Regex):
-            return self._lower_pattern(ast.field, ast.pattern, scoring, boost,
-                                       literal_prefix=_regex_prefix(ast.pattern))
+            return self._lower_pattern(
+                ast.field, ast.pattern, scoring, boost,
+                literal_prefix=("" if ast.case_insensitive
+                                else _regex_prefix(ast.pattern)),
+                case_insensitive=ast.case_insensitive)
         if isinstance(ast, Q.FieldPresence):
             return self._lower_presence(ast.field)
         if isinstance(ast, Q.Range):
@@ -452,6 +462,11 @@ class Lowering:
             return self._lower_full_text(
                 Q.FullText(ast.field, ast.value, "and"), scoring, boost)
         if not fm.indexed:
+            if fm.fast and fm.type is FieldType.TEXT:
+                # fast-only text field: exact-term match as an ordinal
+                # EQUALITY on the dictionary column (reference: fast-field
+                # queries on index:false fields)
+                return self._fast_only_term(ast.field, ast.value)
             raise PlanError(f"field {ast.field!r} is not indexed")
         value = ast.value
         if (not ast.verbatim and fm.type is FieldType.TEXT
@@ -471,6 +486,20 @@ class Lowering:
             if getattr(ast, "zero_terms", "none") == "all":
                 return PMatchAll()
             return PMatchNone()
+        if ast.mode in ("bool_prefix_and", "bool_prefix_or"):
+            # match_bool_prefix: every analyzed token is a term match
+            # except the LAST, which matches as a prefix
+            prefix_node = self._lower_phrase_prefix(
+                Q.PhrasePrefix(ast.field, tokens[-1].text), scoring, boost)
+            term_nodes = [self._postings_node(ast.field, t.text, scoring,
+                                              boost)
+                          for t in tokens[:-1]]
+            clauses = tuple(term_nodes) + (prefix_node,)
+            if len(clauses) == 1:
+                return clauses[0]
+            if ast.mode == "bool_prefix_and":
+                return PBool(must=clauses)
+            return PBool(should=clauses, minimum_should_match=1)
         if ast.mode == "phrase" and len(tokens) > 1:
             return self._lower_phrase(ast.field, [t.text for t in tokens],
                                       ast.slop, scoring, boost)
@@ -504,14 +533,16 @@ class Lowering:
             infos.append(info)
         postings = [self.reader.postings(field, i) for i in infos]
         positions = [self.reader.positions(field, i) for i in infos]
-        ids, freqs = phrase_match(postings, positions, [i.df for i in infos], slop)
+        ids, freqs = phrase_match(postings, positions, [i.df for i in infos],
+                                  slop, term_keys=terms)
         key = f"{field}.phrase." + ".".join(str(i.ordinal) for i in infos)
         return self._precomputed_node(key, ids, freqs, field, scoring, boost,
                                       df_for_idf=ids.size)
 
     def _lower_phrase_prefix(self, ast: Q.PhrasePrefix, scoring: bool, boost: float) -> Any:
         fm = self._field(ast.field)
-        tokens = [t.text for t in get_tokenizer(fm.tokenizer)(ast.phrase)]
+        tokenizer_name = getattr(ast, "analyzer", None) or fm.tokenizer
+        tokens = [t.text for t in get_tokenizer(tokenizer_name)(ast.phrase)]
         if not tokens:
             return PMatchNone()
         td = self.reader.term_dict(ast.field)
@@ -519,11 +550,16 @@ class Lowering:
             return PMatchNone()
         prefix = tokens[-1]
         expansions = []
+        budget = ast.max_expansions
         for term, _df in td.iter_terms(start=prefix):
             if not term.startswith(prefix):
                 break
             expansions.append(term)
-            if len(expansions) >= ast.max_expansions:
+            # the exact term is a match, not an "expansion": it does not
+            # consume the budget (tantivy prefix semantics)
+            if term != prefix:
+                budget -= 1
+            if budget <= 0:
                 break
         if not expansions:
             return PMatchNone()
@@ -535,12 +571,14 @@ class Lowering:
         return self._or(nodes, scoring=scoring)
 
     def _lower_pattern(self, field: str, pattern: str, scoring: bool,
-                       boost: float, literal_prefix: str = "") -> Any:
+                       boost: float, literal_prefix: str = "",
+                       case_insensitive: bool = False) -> Any:
         fm = self._field(field)
         td = self.reader.term_dict(field)
         if td is None:
             return PMatchNone()
-        compiled = re.compile(pattern)
+        compiled = re.compile(pattern,
+                              re.IGNORECASE if case_insensitive else 0)
         matches = []
         for term, _df in td.iter_terms(start=literal_prefix or None):
             if literal_prefix and not term.startswith(literal_prefix):
@@ -580,6 +618,14 @@ class Lowering:
                 f"norm.{field}", lambda: self.reader.fieldnorm(field))
             return PNormPresence(norm_slot)
         raise PlanError(f"presence query needs a fast or indexed text field: {field!r}")
+
+    def _fast_only_term(self, field: str, value: str) -> Any:
+        """Exact term on a fast-only (index:false) text field: an ordinal
+        equality interval on the dictionary column."""
+        fm = self._field(field)
+        return self._lower_text_range(Q.Range(
+            field, lower=Q.RangeBound(value, True),
+            upper=Q.RangeBound(value, True)), fm)
 
     def _lower_text_range(self, ast: Q.Range, fm: FieldMapping) -> Any:
         """Lexicographic range on a text field via the sorted ordinal
@@ -864,6 +910,11 @@ class Lowering:
         if not fm.fast:
             raise PlanError(f"terms aggregation requires fast field: {spec.field!r}")
         meta = self.reader.field_meta(spec.field)
+        if meta.get("multivalued") and self.batch is not None:
+            # multivalued pair arrays have split-dependent shapes: the
+            # batch path cannot host them — fall back per split
+            raise PlanError(
+                f"multivalued terms agg {spec.field!r} is per-split")
         if self.batch is not None and spec.field in self.batch.get("terms_dicts", {}):
             # remap this split's local ordinals into the batch-global dictionary
             global_of = self.batch["terms_dicts"][spec.field]

@@ -107,10 +107,10 @@ def _validate_doc_mapping(doc_mapper: DocMapper) -> None:
         fm = doc_mapper.field(field)
         if fm is None:
             raise ValueError(
-                f"default search field {field!r} is not a mapped field")
+                f"unknown default search field `{field}`")
         if not fm.indexed:
             raise ValueError(
-                f"default search field {field!r} is not indexed")
+                f"default search field `{field}` is not indexed")
 
 
 class IndexService:
@@ -127,8 +127,7 @@ class IndexService:
         if not index_id or not index_id.replace("-", "").replace("_", "").isalnum():
             raise ValueError(f"invalid index id {index_id!r}")
         doc_mapping = index_config_json.get("doc_mapping", {})
-        doc_mapper = DocMapper.from_dict(doc_mapping) if "field_mappings" in doc_mapping \
-            else DocMapper(field_mappings=[])
+        doc_mapper = DocMapper.from_dict(doc_mapping)
         # search_settings.default_search_fields (reference config shape)
         # overrides/augments the doc_mapping-level list
         search_settings = index_config_json.get("search_settings") or {}

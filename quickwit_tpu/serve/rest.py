@@ -594,6 +594,50 @@ class RestServer:
         if m and method == "POST":
             self._check_ingest_rate(body)
             return 200, self._es_bulk(m.group(1), body, params)
+        m = re.fullmatch(r"/([^/]+)/_count", path)
+        if m and method in ("GET", "POST"):
+            payload = json.loads(body) if body else {}
+            request = self._es_search_request(m.group(1), payload, params)
+            from dataclasses import replace as _dc_replace
+            response = node.root_searcher.search(
+                _dc_replace(request, max_hits=0, aggs=None))
+            return 200, {"count": response.num_hits,
+                         "_shards": {"total": 1, "successful": 1,
+                                     "skipped": 0, "failed": 0}}
+        m = re.fullmatch(r"(?:/([^/_][^/]*))?/_stats", path)
+        if m and method == "GET":
+            from ..models.split_metadata import SplitState
+            pattern = m.group(1)
+            indices = {}
+            total_docs = total_bytes = total_segments = 0
+            for im in sorted(node.metastore.list_indexes(),
+                             key=lambda im: im.index_id):
+                if pattern and not _matches_index_pattern(im.index_id,
+                                                          pattern):
+                    continue
+                splits = node.metastore.list_splits(
+                    ListSplitsQuery(index_uids=[im.index_uid],
+                                    states=[SplitState.PUBLISHED]))
+                docs = sum(s.metadata.num_docs for s in splits)
+                size = sum(s.metadata.footprint_bytes for s in splits)
+                total_docs += docs
+                total_bytes += size
+                total_segments += len(splits)
+                stats = {"docs": {"count": docs, "deleted": 0},
+                         "store": {"size_in_bytes": size},
+                         "segments": {"count": len(splits)}}
+                indices[im.index_id] = {"primaries": stats, "total": stats}
+            if pattern and not indices and not any(
+                    ch in pattern for ch in "*?"):
+                # concrete name misses -> 404; an unmatched WILDCARD is an
+                # empty 200 (ES allow_no_indices=true default)
+                raise ApiError(404, f"no index matches {pattern!r}")
+            all_stats = {"docs": {"count": total_docs, "deleted": 0},
+                         "store": {"size_in_bytes": total_bytes},
+                         "segments": {"count": total_segments}}
+            return 200, {"_all": {"primaries": all_stats,
+                                  "total": all_stats},
+                         "indices": indices}
         m = re.fullmatch(r"/_cat/indices(?:/([^/]+))?", path)
         if m:
             # reference only supports format=json and the h/health params;

@@ -58,9 +58,11 @@ GHARCHIVE_FIELDS = [
     {"name": "payload.push_id", "type": "i64"},
     {"name": "payload.ref", "type": "text"},
     {"name": "payload.ref_type", "type": "text"},
-    {"name": "payload.description", "type": "text"},
+    {"name": "payload.description", "type": "text", "record": "position"},
     {"name": "payload.commits.message", "type": "text",
      "record": "position"},
+    {"name": "payload.forkee.id", "type": "u64", "fast": True},
+    {"name": "payload.pages.page_name", "type": "text"},
     {"name": "payload.pull_request.body", "type": "text",
      "record": "position"},
     {"name": "payload.pull_request.title", "type": "text"},
@@ -224,8 +226,41 @@ def default_search_fields_setup() -> list[dict]:
     ]
 
 
+
+
+def multi_splits_setup() -> list[dict]:
+    docs = [
+        {"timestamp": "2015-01-10T10:00:00Z"},
+        {"timestamp": "2015-01-11T12:00:00Z"},
+        {"timestamp": "2015-01-10T10:00:00Z"},
+        {"timestamp": "2015-01-10T13:00:00Z"},
+        {"timestamp": "2015-01-11T12:00:00Z"},
+        {"timestamp": "2015-01-10T10:00:00Z"},
+        {"timestamp": "2015-01-10T14:00:00.000000001Z"},
+        {"timestamp": "2015-01-11T12:00:00Z"},
+        {"timestamp": "2015-01-10T10:00:00Z"},
+        {"timestamp": "2015-01-10T12:00:00Z"},
+        {"timestamp": "2015-01-11T12:00:00Z"},
+        {"timestamp": "2016-01-10T10:00:00Z"},
+        {"timestamp": "2016-01-11T12:00:00Z"},
+    ]
+    # the reference shuffles docs across 1-10 random splits; three fixed
+    # batches exercise the same multi-split merge deterministically
+    return [
+        _delete("multi_splits"),
+        _create("multi_splits", [
+            {"name": "timestamp", "type": "datetime", "fast": True,
+             "input_formats": ["rfc3339"]}],
+            timestamp_field="timestamp"),
+        _ingest("multi_splits", docs[:5]),
+        _ingest("multi_splits", docs[5:9]),
+        _ingest("multi_splits", docs[9:]),
+    ]
+
+
 SETUPS = {
     "es_compatibility": es_compatibility_setup,
+    "multi_splits": multi_splits_setup,
     "aggregations": aggregations_setup,
     "sort_orders": sort_orders_setup,
     "search_after": search_after_setup,

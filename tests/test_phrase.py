@@ -1,0 +1,59 @@
+"""Unit tests for host-side phrase matching (ops/phrase.py) — tantivy
+PhraseScorer semantics, including the repeated-term rule: duplicate
+phrase terms must occupy DISTINCT document positions."""
+
+import numpy as np
+
+from quickwit_tpu.ops.phrase import phrase_match
+
+
+def _term(doc_positions: dict[int, list[int]]):
+    """Build (postings, positions, df) for one term from doc->positions."""
+    ids = np.array(sorted(doc_positions), dtype=np.int32)
+    tfs = np.array([len(doc_positions[d]) for d in sorted(doc_positions)],
+                   dtype=np.int32)
+    offsets = np.zeros(len(ids) + 1, dtype=np.int32)
+    data = []
+    for i, d in enumerate(sorted(doc_positions)):
+        data.extend(doc_positions[d])
+        offsets[i + 1] = len(data)
+    return (ids, tfs), (offsets, np.array(data, dtype=np.int32)), len(ids)
+
+
+def _match(terms, slop=0, keys=None):
+    posts, poss, dfs = zip(*terms)
+    return phrase_match(list(posts), list(poss), list(dfs), slop,
+                        term_keys=keys)
+
+
+def test_exact_phrase():
+    # doc 0: "quick brown fox"; doc 1: "brown quick"
+    quick = _term({0: [0], 1: [1]})
+    brown = _term({0: [1], 1: [0]})
+    ids, freqs = _match([quick, brown], slop=0, keys=["quick", "brown"])
+    assert ids.tolist() == [0] and freqs.tolist() == [1]
+
+
+def test_sloppy_transposition():
+    quick = _term({0: [0], 1: [1]})
+    brown = _term({0: [1], 1: [0]})
+    ids, _ = _match([quick, brown], slop=2, keys=["quick", "brown"])
+    assert ids.tolist() == [0, 1]
+
+
+def test_repeated_term_needs_two_occurrences():
+    # phrase "a a" with slop=1 must NOT match a doc holding a single "a"
+    a = _term({0: [0], 1: [0, 1], 2: [0, 5]})
+    ids, freqs = _match([a, a], slop=1, keys=["a", "a"])
+    assert ids.tolist() == [1]
+    assert freqs.tolist() == [1]
+    # wider slop reaches the spread-out occurrences in doc 2
+    ids, _ = _match([a, a], slop=5, keys=["a", "a"])
+    assert ids.tolist() == [1, 2]
+
+
+def test_repeated_term_exact_unaffected():
+    # slop=0 path already required distinct positions; stays correct
+    a = _term({0: [0], 1: [0, 1]})
+    ids, freqs = _match([a, a], slop=0, keys=["a", "a"])
+    assert ids.tolist() == [1] and freqs.tolist() == [1]
