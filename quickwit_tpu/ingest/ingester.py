@@ -50,6 +50,9 @@ class Shard:
     # "replica" shards only accept replica_persist and sit out of drains
     # until promoted (reference: chained replication, replication.rs)
     role: str = "leader"
+    # cumulative ingested payload bytes; the scaling arbiter turns deltas
+    # of this into MiB/s (reference: per-shard ingestion-rate gossip)
+    bytes_written: int = 0
 
 
 def shard_queue_id(index_uid: str, source_id: str, shard_id: str) -> str:
@@ -180,6 +183,7 @@ class Ingester:
                 except Exception:
                     shard.log.rollback_to(state)
                     raise
+            shard.bytes_written += sum(len(p) for p in payloads)
         return first, last
 
     def replica_persist(self, index_uid: str, source_id: str, shard_id: str,
@@ -263,6 +267,7 @@ class Ingester:
         return {
             queue_id: {"head": shard.log.next_position,
                        "published": shard.publish_position,
-                       "open": int(shard.state is ShardState.OPEN)}
+                       "open": int(shard.state is ShardState.OPEN),
+                       "bytes": shard.bytes_written}
             for queue_id, shard in items
         }

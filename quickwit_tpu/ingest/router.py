@@ -60,6 +60,14 @@ class IngestRouter:
                 self._table[key] = entry
             return entry
 
+    def refresh(self, index_uid: str,
+                source_id: str = INGEST_V2_SOURCE_ID) -> None:
+        """Drop the cached shard list so the next batch re-resolves it —
+        called after the control plane opens or closes shards (reference:
+        routing-table invalidation on shard-set change)."""
+        with self._lock:
+            self._table.pop((index_uid, source_id), None)
+
     def ingest(self, index_uid: str, docs: list[dict[str, Any]],
                source_id: str = INGEST_V2_SOURCE_ID) -> dict[str, Any]:
         """Route one batch; returns {shard_id: (first, last)} positions."""

@@ -70,6 +70,9 @@ class NodeConfig:
     # every persisted batch is synchronously replicated to one follower
     # before the ack; follower replicas promote when the leader dies
     replication_factor: int = 1
+    # per-shard ingestion throughput target (MiB/s) driving the shard
+    # autoscaling arbiter (reference: DEFAULT_SHARD_THROUGHPUT_LIMIT)
+    max_shard_throughput_mib: float = 5.0
 
     @property
     def tls_enabled(self) -> bool:
@@ -234,14 +237,34 @@ class Node:
                           if config.replication_factor > 1 else None))
         if config.replication_factor > 1:
             self.ingester.on_truncate = self._replica_truncate
-        self.ingest_router = IngestRouter(self.ingester,
-                                          shard_prefix=config.node_id)
+        self.ingest_router = IngestRouter(
+            self.ingester, shard_prefix=config.node_id,
+            get_or_create_shards=self._live_open_shards)
         from ..control_plane.scheduler import IndexingScheduler
         self.indexing_scheduler = IndexingScheduler()
+        from ..control_plane.arbiter import (ScalingArbiter, ScalingPermits,
+                                             ShardRateTracker)
+        self.scaling_arbiter = ScalingArbiter(
+            max_shard_throughput_mib=config.max_shard_throughput_mib)
+        self.scaling_permits = ScalingPermits()
+        self.shard_rate_tracker = ShardRateTracker()
         from ..search.scroll import ScrollStore
         self.scroll_store = ScrollStore()
         from .otel import OtelService
         self.otel = OtelService(self)
+
+    def _live_open_shards(self, index_uid: str,
+                          source_id: str) -> list[str]:
+        """Routing-table resolver: the LIVE open leader shards for the
+        source (autoscaling changes the set); falls back to the router's
+        static default for the very first batch."""
+        from ..ingest.ingester import ShardState
+        live = sorted(
+            s.shard_id for s in self.ingester.list_shards(index_uid)
+            if s.source_id == source_id and s.role == "leader"
+            and s.state is ShardState.OPEN)
+        return live or self.ingest_router._default_shards(index_uid,
+                                                          source_id)
 
     # ------------------------------------------------------------------
     def _on_cluster_change(self, change: ClusterChange) -> None:
@@ -508,6 +531,62 @@ class Node:
         indexers = self.cluster.nodes_with_role("indexer")
         return self.indexing_scheduler.schedule(tasks, indexers)
 
+    def autoscale_shards(self) -> list[tuple[str, str, str]]:
+        """One shard-scaling convergence pass (role of the reference's
+        IngestController scale decisions, `ingest_controller.rs:424`):
+        sample per-shard ingestion rates, consult the arbiter per source,
+        and open/close local leader shards under permit rate limits.
+        Returns the actions taken as (kind, index_uid, shard_id)."""
+        from ..control_plane.arbiter import (ScaleUp,
+                                             find_scale_down_candidate)
+        from ..ingest.ingester import ShardState, shard_queue_id
+        groups: dict[tuple[str, str], list[str]] = {}
+        live_queue_ids: list[str] = []
+        for s in self.ingester.list_shards():
+            if s.state is ShardState.OPEN and s.role == "leader":
+                groups.setdefault((s.index_uid, s.source_id),
+                                  []).append(s.shard_id)
+                queue_id = shard_queue_id(s.index_uid, s.source_id,
+                                          s.shard_id)
+                live_queue_ids.append(queue_id)
+                self.shard_rate_tracker.observe(queue_id, s.bytes_written)
+        # shards closed/deleted by ANY path leave the tracker (bounded)
+        self.shard_rate_tracker.retain(live_queue_ids)
+        actions: list[tuple[str, str, str]] = []
+        for (index_uid, source_id), shard_ids in sorted(groups.items()):
+            stats = self.shard_rate_tracker.source_stats(
+                [shard_queue_id(index_uid, source_id, sid)
+                 for sid in shard_ids])
+            decision = self.scaling_arbiter.should_scale(stats)
+            if decision is None:
+                continue
+            key = f"{index_uid}/{source_id}"
+            granted = self.scaling_permits.acquire(key, decision)
+            if granted == 0:
+                continue
+            if isinstance(decision, ScaleUp):
+                # a large scale-up may be granted partially (burst cap);
+                # the rest re-requests on later ticks as permits refill
+                ords = [int(sid.rsplit("-", 1)[-1]) for sid in shard_ids
+                        if sid.rsplit("-", 1)[-1].isdigit()]
+                base = max(ords, default=-1)
+                for k in range(granted):
+                    sid = f"{self.config.node_id}-shard-{base + 1 + k:02d}"
+                    self.ingester.open_shard(index_uid, source_id, sid)
+                    actions.append(("open", index_uid, sid))
+            else:
+                candidate = find_scale_down_candidate(
+                    {sid: self.config.node_id for sid in shard_ids})
+                if candidate is None:
+                    continue
+                _, sid = candidate
+                self.ingester.close_shard(index_uid, source_id, sid)
+                self.shard_rate_tracker.forget(
+                    shard_queue_id(index_uid, source_id, sid))
+                actions.append(("close", index_uid, sid))
+            self.ingest_router.refresh(index_uid, source_id)
+        return actions
+
     # ------------------------------------------------------------------
     def run_merges(self, index_id: str) -> int:
         """One merge-planner pass (role of MergePlanner + MergePipeline)."""
@@ -761,9 +840,15 @@ class Node:
             for worker in workers:
                 worker.join(timeout=4.0)
 
+        def autoscale_tick() -> None:
+            if "indexer" in self.config.roles:
+                self.autoscale_shards()
+
         loops = [("ingest", ingest_interval_secs, ingest_tick),
                  ("merge", merge_interval_secs, merge_tick),
-                 ("janitor", janitor_interval_secs, janitor_tick)]
+                 ("janitor", janitor_interval_secs, janitor_tick),
+                 ("autoscale", max(ingest_interval_secs, 2.0),
+                  autoscale_tick)]
         if self.config.gossip_enabled:
             # UDP scuttlebutt replaces the REST heartbeat loop entirely
             from ..cluster.gossip import GossipService

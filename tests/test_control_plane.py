@@ -1,0 +1,285 @@
+"""Control-plane solver, scheduler, and shard-autoscaling tests
+(reference behaviors: scheduling_logic.rs solve phases, scaling_arbiter.rs
+thresholds, shard_table.rs permits, ingest_controller.rs candidates)."""
+
+import numpy as np
+import pytest
+
+from quickwit_tpu.control_plane import (
+    IndexingScheduler, IndexingTask, NotEnoughCapacity, ScaleDown, ScaleUp,
+    SchedulingProblem, ScalingArbiter, ScalingPermits, ShardRateTracker,
+    ShardStats, find_scale_down_candidate, solve,
+)
+
+
+def _problem(num_shards, load_per_shard, capacities, affinities=None):
+    return SchedulingProblem(
+        num_shards=np.array(num_shards, dtype=np.int64),
+        load_per_shard=np.array(load_per_shard, dtype=np.int64),
+        capacities=np.array(capacities, dtype=np.int64),
+        affinities=affinities or {})
+
+
+# ---------------------------------------------------------------- solver
+def test_solver_places_everything():
+    problem = _problem([4, 2], [1000, 500], [4000, 4000, 4000])
+    counts = solve(problem)
+    assert counts.sum(axis=0).tolist() == [4, 2]
+
+
+def test_solver_balances_load():
+    # 8 equal shards on 2 equal nodes -> 4/4, not 8/0 (virtual capacity)
+    problem = _problem([8], [1000], [8000, 8000])
+    counts = solve(problem)
+    loads = counts @ problem.load_per_shard
+    assert abs(int(loads[0]) - int(loads[1])) <= 1000
+
+
+def test_solver_stability_idempotent():
+    problem = _problem([5, 3], [700, 300], [4000, 4000])
+    first = solve(problem)
+    again = solve(problem, first)
+    assert np.array_equal(first, again)
+
+
+def test_solver_remove_extraneous_keeps_rest():
+    problem = _problem([2], [500], [4000, 4000])
+    previous = np.array([[3], [1]], dtype=np.int64)  # source scaled down
+    counts = solve(problem, previous)
+    assert counts.sum() == 2
+    # the node holding more shards keeps its allocation; the shave comes
+    # from the fewest-holder first
+    assert counts[0, 0] >= counts[1, 0]
+
+
+def test_solver_affinity_pull():
+    problem = _problem([2], [500], [4000, 4000, 4000],
+                       affinities={0: {2: 10}})
+    counts = solve(problem)
+    assert counts[2, 0] == 2
+
+
+def test_solver_capacity_inflation_when_overloaded():
+    # total load 6000 > cluster 4000: still places everything (inflated)
+    problem = _problem([6], [1000], [2000, 2000])
+    counts = solve(problem)
+    assert counts.sum() == 6
+
+
+def test_solver_no_indexers():
+    problem = _problem([1], [100], [])
+    with pytest.raises(NotEnoughCapacity):
+        solve(problem)
+
+
+def test_solver_prefers_few_nodes_per_source():
+    # light load: a source should not be sprayed over every node
+    problem = _problem([2, 2], [100, 100], [4000, 4000, 4000, 4000])
+    counts = solve(problem)
+    for s in range(2):
+        assert np.count_nonzero(counts[:, s]) == 1
+
+
+# ------------------------------------------------------------- scheduler
+def test_scheduler_shard_stickiness():
+    scheduler = IndexingScheduler()
+    tasks = [IndexingTask("idx:01", "src", shard_id=f"s{i}")
+             for i in range(4)]
+    plan1 = scheduler.schedule(tasks, ["n1", "n2"])
+    assert plan1.num_tasks == 4
+    plan2 = scheduler.schedule(tasks, ["n1", "n2"])
+    for t in tasks:
+        assert plan2.node_of(t) == plan1.node_of(t)
+
+
+def test_scheduler_explicit_affinity():
+    scheduler = IndexingScheduler()
+    tasks = [IndexingTask("idx:01", "ingest", shard_id=f"s{i}")
+             for i in range(2)]
+    plan = scheduler.schedule(tasks, ["n1", "n2", "n3"],
+                              affinities={("idx:01", "ingest", 1):
+                                          {"n3": 5}})
+    assert all(plan.node_of(t) == "n3" for t in tasks)
+
+
+def test_scheduler_weight_capacity():
+    # one heavy group saturating a node pushes light groups elsewhere
+    scheduler = IndexingScheduler(indexer_millicpu=1000)
+    heavy = [IndexingTask("big:01", "src", shard_id=f"h{i}", weight=4)
+             for i in range(2)]  # 2 * 1000 millicpu
+    light = [IndexingTask("small:01", "src", shard_id=f"l{i}")
+             for i in range(2)]
+    plan = scheduler.schedule(heavy + light, ["n1", "n2"])
+    assert plan.num_tasks == 4
+    for n in ("n1", "n2"):
+        load = sum(t.weight for t in plan.tasks_for(n))
+        assert load <= 6  # nothing absurdly piled on one node
+
+
+# --------------------------------------------------------------- arbiter
+def test_arbiter_scale_up_on_short_term():
+    arbiter = ScalingArbiter(max_shard_throughput_mib=10.0,
+                             scale_up_factor=1.01)
+    decision = arbiter.should_scale(
+        ShardStats(num_open_shards=2, avg_short_term_rate_mib=9.0,
+                   avg_long_term_rate_mib=8.0))
+    assert decision == ScaleUp(1)
+
+
+def test_arbiter_long_term_floor_blocks_spike():
+    # short-term spike but long-term volume too small to feed more shards
+    arbiter = ScalingArbiter(max_shard_throughput_mib=10.0,
+                             scale_up_factor=2.0)
+    decision = arbiter.should_scale(
+        ShardStats(num_open_shards=2, avg_short_term_rate_mib=9.0,
+                   avg_long_term_rate_mib=3.0))
+    # max_by_volume = 3.0 * 2 / 3.0 = 2 -> no growth
+    assert decision is None
+
+
+def test_arbiter_scale_down_long_term_only():
+    arbiter = ScalingArbiter(max_shard_throughput_mib=10.0)
+    down = arbiter.should_scale(
+        ShardStats(num_open_shards=3, avg_short_term_rate_mib=0.5,
+                   avg_long_term_rate_mib=1.0))
+    assert isinstance(down, ScaleDown)
+    # short drop alone does not scale down
+    hold = arbiter.should_scale(
+        ShardStats(num_open_shards=3, avg_short_term_rate_mib=0.5,
+                   avg_long_term_rate_mib=5.0))
+    assert hold is None
+
+
+def test_arbiter_respects_min_shards():
+    arbiter = ScalingArbiter(max_shard_throughput_mib=10.0)
+    up = arbiter.should_scale(
+        ShardStats(num_open_shards=1, avg_short_term_rate_mib=1.0,
+                   avg_long_term_rate_mib=1.0), min_shards=3)
+    assert up == ScaleUp(2)
+    hold = arbiter.should_scale(
+        ShardStats(num_open_shards=3, avg_short_term_rate_mib=0.1,
+                   avg_long_term_rate_mib=0.1), min_shards=3)
+    assert hold is None
+
+
+def test_arbiter_idle_source_no_action():
+    arbiter = ScalingArbiter()
+    assert arbiter.should_scale(ShardStats(0, 0.0, 0.0)) is None
+    assert arbiter.should_scale(ShardStats(2, 0.0, 0.0)) is None
+
+
+# --------------------------------------------------------------- permits
+def test_scaling_permits_rate_limit():
+    now = [0.0]
+    permits = ScalingPermits(clock=lambda: now[0])
+    # up: burst of 5 per minute
+    for _ in range(5):
+        assert permits.acquire("src", ScaleUp(1))
+    assert not permits.acquire("src", ScaleUp(1))
+    now[0] += 12.0  # one refill period's worth
+    assert permits.acquire("src", ScaleUp(1))
+    # down: 1 per minute
+    assert permits.acquire("src", ScaleDown())
+    assert not permits.acquire("src", ScaleDown())
+    now[0] += 60.0
+    assert permits.acquire("src", ScaleDown())
+
+
+def test_scaling_permits_partial_grant():
+    # a ScaleUp above the burst cap grants what remains instead of
+    # stalling forever (the arbiter re-requests the rest next tick)
+    now = [0.0]
+    permits = ScalingPermits(clock=lambda: now[0])
+    assert permits.acquire("src", ScaleUp(8)) == 5
+    assert permits.acquire("src", ScaleUp(8)) == 0
+    now[0] += 24.0  # two refill periods -> 2 tokens
+    assert permits.acquire("src", ScaleUp(8)) == 2
+
+
+def test_rate_tracker_retain():
+    tracker = ShardRateTracker()
+    tracker.observe("a", 100)
+    tracker.observe("b", 100)
+    tracker.retain(["a"])
+    assert tracker.rates("b") == (0.0, 0.0)
+    assert "b" not in tracker._state and "a" in tracker._state
+
+
+def test_scaling_permits_release_on_failure():
+    now = [0.0]
+    permits = ScalingPermits(clock=lambda: now[0])
+    assert permits.acquire("src", ScaleDown())
+    permits.release("src", ScaleDown())
+    assert permits.acquire("src", ScaleDown())
+
+
+def test_find_scale_down_candidate():
+    assert find_scale_down_candidate({}) is None
+    leader, shard = find_scale_down_candidate(
+        {"s1": "nodeA", "s2": "nodeB", "s3": "nodeB"})
+    assert leader == "nodeB" and shard == "s2"
+
+
+# ---------------------------------------------------------- rate tracker
+def test_rate_tracker_ema():
+    now = [0.0]
+    tracker = ShardRateTracker(short_tau_secs=1.0, long_tau_secs=100.0,
+                               clock=lambda: now[0])
+    tracker.observe("q", 0)
+    for _ in range(20):
+        now[0] += 1.0
+        tracker.observe("q", int(now[0]) * (1 << 20))  # 1 MiB/s steady
+    short, long_ = tracker.rates("q")
+    assert 0.9 < short < 1.1
+    assert 0.0 < long_ < short + 0.01
+    stats = tracker.source_stats(["q", "missing"])
+    assert stats.num_open_shards == 2
+    assert stats.avg_short_term_rate_mib == pytest.approx(short / 2)
+
+
+# ----------------------------------------------------- node integration
+def test_node_autoscale_opens_and_closes_shards(tmp_path):
+    from quickwit_tpu.serve import Node, NodeConfig
+    from quickwit_tpu.storage import StorageResolver
+    from quickwit_tpu.ingest.router import INGEST_V2_SOURCE_ID
+    from quickwit_tpu.ingest.ingester import ShardState
+
+    node = Node(NodeConfig(node_id="scale-node", rest_port=0,
+                           metastore_uri="ram:///scale/metastore",
+                           default_index_root_uri="ram:///scale/idx",
+                           data_dir=str(tmp_path), wal_fsync=False,
+                           max_shard_throughput_mib=0.001),
+                storage_resolver=StorageResolver.for_test())
+    # drive the tracker + permit clocks by hand (virtual time)
+    now = [0.0]
+    node.shard_rate_tracker.clock = lambda: now[0]
+    node.scaling_permits = ScalingPermits(clock=lambda: now[0])
+
+    from quickwit_tpu.ingest.ingester import shard_queue_id
+    node.ingester.open_shard("idx:01", INGEST_V2_SOURCE_ID, "s-00")
+    qid = shard_queue_id("idx:01", INGEST_V2_SOURCE_ID, "s-00")
+    # warm the EMAs: steady ~10 KiB/s for 30 virtual seconds, well above
+    # the 0.001 MiB/s per-shard limit
+    for _ in range(30):
+        node.ingester.persist("idx:01", INGEST_V2_SOURCE_ID, "s-00",
+                              [{"n": i, "pad": "x" * 200}
+                               for i in range(50)])
+        bytes_now = node.ingester.shard_throughput_state()[qid]["bytes"]
+        node.shard_rate_tracker.observe(qid, bytes_now)
+        now[0] += 1.0
+    actions = node.autoscale_shards()
+    opened = [a for a in actions if a[0] == "open"]
+    assert opened, f"expected a scale-up, got {actions}"
+
+    def open_shards():
+        return [s for s in node.ingester.list_shards("idx:01")
+                if s.state is ShardState.OPEN]
+
+    n_after_up = len(open_shards())
+    assert n_after_up >= 2
+    # long idle -> long-term EMA decays under the down threshold; permits
+    # allow one close per pass per minute
+    for _ in range(10):
+        now[0] += 120.0
+        node.autoscale_shards()
+    assert len(open_shards()) == 1  # scales back to min_shards
