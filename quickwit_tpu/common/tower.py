@@ -22,22 +22,29 @@ class TokenBucket:
     """Token-bucket rate limiter (reference `tower/rate.rs` /
     `rate_limit.rs`): capacity `burst`, refilled at `rate_per_sec`."""
 
-    def __init__(self, rate_per_sec: float, burst: float):
+    def __init__(self, rate_per_sec: float, burst: float,
+                 clock=time.monotonic):
         self.rate = float(rate_per_sec)
         self.burst = float(burst)
+        self.clock = clock
         self._tokens = float(burst)
-        self._last = time.monotonic()
+        self._last = clock()
         self._lock = threading.Lock()
 
     def try_acquire(self, cost: float = 1.0) -> bool:
         with self._lock:
-            now = time.monotonic()
+            now = self.clock()
             self._tokens = min(self.burst, self._tokens + (now - self._last) * self.rate)
             self._last = now
             if self._tokens >= cost:
                 self._tokens -= cost
                 return True
             return False
+
+    def release(self, cost: float = 1.0) -> None:
+        """Refund tokens a failed operation did not really consume."""
+        with self._lock:
+            self._tokens = min(self.burst, self._tokens + cost)
 
     def acquire_or_raise(self, cost: float = 1.0) -> None:
         if not self.try_acquire(cost):

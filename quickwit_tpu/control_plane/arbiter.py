@@ -28,6 +28,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..common.tower import TokenBucket
+
 
 @dataclass(frozen=True)
 class ShardStats:
@@ -79,35 +81,10 @@ class ScalingArbiter:
         return None
 
 
-class _PermitBucket:
-    """Token bucket counted in scaling decisions (not bytes)."""
-
-    def __init__(self, burst: int, refill: int, period_secs: float,
-                 clock=time.monotonic):
-        self.capacity = float(burst)
-        self.tokens = float(burst)
-        self.rate = refill / period_secs
-        self.clock = clock
-        self.last = clock()
-
-    def acquire(self, n: int = 1) -> bool:
-        now = self.clock()
-        self.tokens = min(self.capacity,
-                          self.tokens + (now - self.last) * self.rate)
-        self.last = now
-        if self.tokens >= n:
-            self.tokens -= n
-            return True
-        return False
-
-    def release(self, n: int = 1) -> None:
-        self.tokens = min(self.capacity, self.tokens + n)
-
-
 @dataclass
 class _SourcePermits:
-    up: _PermitBucket
-    down: _PermitBucket
+    up: "TokenBucket"
+    down: "TokenBucket"
 
 
 class ScalingPermits:
@@ -122,10 +99,10 @@ class ScalingPermits:
         entry = self._per_source.get(source_key)
         if entry is None:
             entry = _SourcePermits(
-                up=_PermitBucket(burst=5, refill=5, period_secs=60.0,
-                                 clock=self._clock),
-                down=_PermitBucket(burst=1, refill=1, period_secs=60.0,
-                                   clock=self._clock))
+                up=TokenBucket(rate_per_sec=5 / 60.0, burst=5,
+                               clock=self._clock),
+                down=TokenBucket(rate_per_sec=1 / 60.0, burst=1,
+                                 clock=self._clock))
             self._per_source[source_key] = entry
         return entry
 
@@ -138,18 +115,21 @@ class ScalingPermits:
         entry = self._entry(source_key)
         if isinstance(decision, ScaleUp):
             for n in range(decision.num_shards, 0, -1):
-                if entry.up.acquire(n):
+                if entry.up.try_acquire(n):
                     return n
             return 0
-        return 1 if entry.down.acquire(1) else 0
+        return 1 if entry.down.try_acquire(1) else 0
 
-    def release(self, source_key: str,
-                decision: ScaleUp | ScaleDown) -> None:
+    def release(self, source_key: str, decision: ScaleUp | ScaleDown,
+                granted: Optional[int] = None) -> None:
         """Give permits back when the metastore/ingester op failed — a
-        failed attempt must not eat the budget for the retry."""
+        failed attempt must not eat the budget for the retry. Pass the
+        count `acquire` actually returned: refunding the full decision
+        after a partial grant would mint permits never consumed."""
         entry = self._entry(source_key)
         if isinstance(decision, ScaleUp):
-            entry.up.release(decision.num_shards)
+            entry.up.release(granted if granted is not None
+                             else decision.num_shards)
         else:
             entry.down.release(1)
 

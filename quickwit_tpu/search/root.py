@@ -68,6 +68,12 @@ class RootSearcher:
 
     # ------------------------------------------------------------------
     def search(self, request: SearchRequest) -> SearchResponse:
+        from ..observability.tracing import TRACER
+        with TRACER.span("root_search",
+                         {"indexes": ",".join(request.index_ids)}):
+            return self._search_traced(request)
+
+    def _search_traced(self, request: SearchRequest) -> SearchResponse:
         t0 = time.monotonic()
         indexes = self._resolve_indexes(request.index_ids)
         if not indexes:

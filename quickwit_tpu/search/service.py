@@ -109,6 +109,13 @@ class SearchService:
 
     # ------------------------------------------------------------------
     def leaf_search(self, request: LeafSearchRequest) -> LeafSearchResponse:
+        from ..observability.tracing import TRACER
+        with TRACER.span("leaf_search",
+                         {"num_splits": len(request.splits)}):
+            return self._leaf_search_traced(request)
+
+    def _leaf_search_traced(self,
+                            request: LeafSearchRequest) -> LeafSearchResponse:
         doc_mapper = DocMapper.from_dict(request.doc_mapping)
         search_request = request.search_request
         splits = self._optimize_split_order(search_request, request.splits)

@@ -77,8 +77,14 @@ class HttpSearchClient:
                                               timeout=self.timeout_secs)
         try:
             data = json.dumps(payload).encode()
-            conn.request("POST", path, body=data,
-                         headers={"Content-Type": "application/json"})
+            headers = {"Content-Type": "application/json"}
+            # propagate the active trace across the root->leaf hop
+            # (reference: tracing_utils.rs inject_current_context)
+            from ..observability.tracing import TRACER
+            traceparent = TRACER.current_traceparent()
+            if traceparent:
+                headers["traceparent"] = traceparent
+            conn.request("POST", path, body=data, headers=headers)
             response = conn.getresponse()
             body = response.read()
             if response.status != 200:

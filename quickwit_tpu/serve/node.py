@@ -73,6 +73,10 @@ class NodeConfig:
     # per-shard ingestion throughput target (MiB/s) driving the shard
     # autoscaling arbiter (reference: DEFAULT_SHARD_THROUGHPUT_LIMIT)
     max_shard_throughput_mib: float = 5.0
+    # self-tracing (reference: quickwit-telemetry-exporters, opt-in via
+    # QW_ENABLE_OPENTELEMETRY_OTLP_EXPORTER there): export the node's own
+    # request spans into its own otel-traces index
+    self_tracing: bool = False
 
     @property
     def tls_enabled(self) -> bool:
@@ -252,6 +256,13 @@ class Node:
         self.scroll_store = ScrollStore()
         from .otel import OtelService
         self.otel = OtelService(self)
+        self.span_exporter = None
+        if config.self_tracing:
+            from ..observability.tracing import TRACER, BatchSpanExporter
+            self.span_exporter = BatchSpanExporter(
+                self.otel.ingest_traces, service_name="quickwit-tpu",
+                node_id=config.node_id, scope=config.node_id)
+            TRACER.add_processor(self.span_exporter)
 
     def _live_open_shards(self, index_uid: str,
                           source_id: str) -> list[str]:
@@ -893,6 +904,11 @@ class Node:
         logger.info("background services started (%s)", self.config.node_id)
 
     def stop_background_services(self) -> None:
+        if self.span_exporter is not None:
+            from ..observability.tracing import TRACER
+            TRACER.remove_processor(self.span_exporter)
+            self.span_exporter.stop()
+            self.span_exporter = None
         stop = getattr(self, "_bg_stop", None)
         if stop is not None:
             stop.set()

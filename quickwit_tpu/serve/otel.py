@@ -101,6 +101,15 @@ def otlp_logs_to_docs(payload: dict[str, Any]) -> list[dict[str, Any]]:
     return docs
 
 
+def _status_str(code: Any) -> str:
+    """OTLP Status.code arrives as a proto3 JSON int (0/1/2), the enum
+    name (STATUS_CODE_OK), or a bare string from lenient producers."""
+    mapping = {0: "unset", 1: "ok", 2: "error",
+               "STATUS_CODE_UNSET": "unset", "STATUS_CODE_OK": "ok",
+               "STATUS_CODE_ERROR": "error"}
+    return mapping.get(code, str(code).lower())
+
+
 def otlp_traces_to_docs(payload: dict[str, Any]) -> list[dict[str, Any]]:
     """OTLP JSON `resourceSpans` → span docs (reference `otlp/traces.rs`)."""
     docs = []
@@ -120,7 +129,8 @@ def otlp_traces_to_docs(payload: dict[str, Any]) -> list[dict[str, Any]]:
                     "service_name": service,
                     "span_name": span.get("name", ""),
                     "span_duration_micros": max((end_nanos - start_nanos) // 1000, 0),
-                    "span_status": (span.get("status", {}) or {}).get("code", "unset"),
+                    "span_status": _status_str(
+                        (span.get("status", {}) or {}).get("code", "unset")),
                     "attributes": _attr_map(span.get("attributes", [])),
                 })
     return docs

@@ -171,7 +171,47 @@ class RestServer:
     # route implementations
     def route(self, method: str, path: str, params: dict[str, Any],
               body: bytes, client_host: str = "",
-              content_type: str = "") -> tuple[int, Any]:
+              content_type: str = "",
+              traceparent: str = "") -> tuple[int, Any]:
+        """Traced entry point: every request is a server span, joined to
+        the caller's trace when a W3C `traceparent` header came in
+        (reference: tracing_utils.rs context extraction)."""
+        from ..observability.tracing import TRACER
+        with TRACER.span("http.request",
+                         {"http.method": method, "http.target": path},
+                         remote_parent=traceparent,
+                         scope=self.node.config.node_id) as span:
+            try:
+                status, payload = self._route_inner(
+                    method, path, params, body, client_host=client_host,
+                    content_type=content_type)
+            except ApiError as exc:
+                # handled client/server error: classify before the span
+                # closes so routine 4xx don't pollute error-rate queries
+                span.set_attribute("http.status_code", exc.status)
+                span.status = "error" if exc.status >= 500 else "ok"
+                raise
+            except (QueryParseError, EsDslParseError, AggParseError,
+                    PlanError, TransformParseError, json.JSONDecodeError,
+                    ValueError):
+                span.set_attribute("http.status_code", 400)
+                span.status = "ok"
+                raise
+            except MetastoreError as exc:
+                code = {"not_found": 404, "already_exists": 400,
+                        "invalid_argument": 400,
+                        "failed_precondition": 409}.get(exc.kind, 500)
+                span.set_attribute("http.status_code", code)
+                span.status = "error" if code >= 500 else "ok"
+                raise
+            span.set_attribute("http.status_code", status)
+            if status >= 500:
+                span.status = "error"
+            return status, payload
+
+    def _route_inner(self, method: str, path: str, params: dict[str, Any],
+                     body: bytes, client_host: str = "",
+                     content_type: str = "") -> tuple[int, Any]:
         node = self.node
         if path == "/health/livez":
             return 200, True
@@ -1130,7 +1170,8 @@ def _make_handler(server: RestServer):
                 status, payload = server.route(
                     method, parsed.path, params, body,
                     client_host=self.client_address[0],
-                    content_type=self.headers.get("Content-Type", ""))
+                    content_type=self.headers.get("Content-Type", ""),
+                    traceparent=self.headers.get("traceparent", ""))
             except ApiError as exc:
                 status, payload = exc.status, {"message": str(exc)}
             except (QueryParseError, EsDslParseError, AggParseError,

@@ -1,0 +1,244 @@
+"""Self-tracing tests: tracer context, W3C propagation, batch export,
+rate-limited logging, and the node's own-index export loop."""
+
+import threading
+import time
+
+from quickwit_tpu.observability.tracing import (
+    TRACER, BatchSpanExporter, RateLimitedLog, Tracer, format_traceparent,
+    parse_traceparent, spans_to_otlp,
+)
+
+
+def test_span_nesting_and_ids():
+    tracer = Tracer()
+    done = []
+    tracer.add_processor(done.append)
+    with tracer.span("outer") as outer:
+        with tracer.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_span_id == outer.span_id
+    assert [s.name for s in done] == ["inner", "outer"]
+    assert all(s.status == "ok" for s in done)
+    assert done[0].end_ns >= done[0].start_ns
+
+
+def test_span_error_status():
+    tracer = Tracer()
+    done = []
+    tracer.add_processor(done.append)
+    try:
+        with tracer.span("boom"):
+            raise RuntimeError("x")
+    except RuntimeError:
+        pass
+    assert done[0].status == "error"
+
+
+def test_traceparent_roundtrip_and_validation():
+    tracer = Tracer()
+    with tracer.span("root") as root:
+        header = tracer.current_traceparent()
+    assert parse_traceparent(header) == (root.trace_id, root.span_id)
+    assert parse_traceparent("") is None
+    assert parse_traceparent("00-zz-yy-01") is None
+    assert parse_traceparent("00-" + "0" * 32 + "-" + "1" * 16 + "-01") is None
+    assert parse_traceparent(format_traceparent("ab" * 16, "cd" * 8)) == \
+        ("ab" * 16, "cd" * 8)
+
+
+def test_remote_parent_joins_trace():
+    tracer = Tracer()
+    header = format_traceparent("ab" * 16, "cd" * 8)
+    with tracer.span("server", remote_parent=header) as span:
+        assert span.trace_id == "ab" * 16
+        assert span.parent_span_id == "cd" * 8
+    # local parent wins over a remote header
+    with tracer.span("outer") as outer:
+        with tracer.span("inner", remote_parent=header) as inner:
+            assert inner.trace_id == outer.trace_id
+
+
+def test_suppress_blocks_recording():
+    tracer = Tracer()
+    done = []
+    tracer.add_processor(done.append)
+    with tracer.suppress():
+        with tracer.span("hidden"):
+            pass
+    assert done == []
+
+
+def test_threads_have_separate_contexts():
+    tracer = Tracer()
+    seen = {}
+
+    def worker():
+        seen["worker_parent"] = tracer.current_span()
+
+    with tracer.span("main"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert seen["worker_parent"] is None
+
+
+def test_spans_to_otlp_shape_roundtrips_through_ingest():
+    from quickwit_tpu.serve.otel import otlp_traces_to_docs
+    tracer = Tracer()
+    finished = []
+    tracer.add_processor(finished.append)
+    with tracer.span("root_search", {"indexes": "idx", "n": 3}):
+        pass
+    payload = spans_to_otlp(finished, "quickwit-tpu", node_id="n1")
+    docs = otlp_traces_to_docs(payload)
+    assert len(docs) == 1
+    assert docs[0]["span_name"] == "root_search"
+    assert docs[0]["service_name"] == "quickwit-tpu"
+    assert docs[0]["trace_id"] == finished[0].trace_id
+    assert docs[0]["span_status"] == "ok"
+
+
+def test_batch_exporter_flush_and_shed():
+    batches = []
+    exporter = BatchSpanExporter(batches.append, max_batch=10,
+                                 interval_secs=30.0, max_buffer=5)
+    tracer = Tracer()
+    tracer.add_processor(exporter)
+    for _ in range(8):  # 3 past max_buffer are shed, never block
+        with tracer.span("s"):
+            pass
+    exporter.flush()
+    exporter.stop()
+    spans = [s for b in batches
+             for rs in b["resourceSpans"]
+             for ss in rs["scopeSpans"] for s in ss["spans"]]
+    assert len(spans) == 5
+
+
+def test_batch_exporter_size_trigger():
+    batches = []
+    exporter = BatchSpanExporter(batches.append, max_batch=3,
+                                 interval_secs=60.0)
+    tracer = Tracer()
+    tracer.add_processor(exporter)
+    for _ in range(3):
+        with tracer.span("s"):
+            pass
+    deadline = time.time() + 5.0
+    while not batches and time.time() < deadline:
+        time.sleep(0.01)
+    exporter.stop()
+    assert batches, "size-triggered export did not fire"
+
+
+def test_rate_limited_log():
+    now = [0.0]
+    limiter = RateLimitedLog(limit=2, period_secs=10.0,
+                             clock=lambda: now[0])
+    assert limiter.should_log("k") == (True, 0)
+    assert limiter.should_log("k") == (True, 0)
+    assert limiter.should_log("k") == (False, 0)
+    assert limiter.should_log("k") == (False, 0)
+    now[0] += 10.0
+    emit, suppressed = limiter.should_log("k")
+    assert emit and suppressed == 2
+    assert limiter.should_log("other") == (True, 0)
+
+
+def test_node_self_tracing_exports_to_own_index(tmp_path):
+    from quickwit_tpu.serve import Node, NodeConfig, RestServer
+    from quickwit_tpu.storage import StorageResolver
+    node = Node(NodeConfig(node_id="trace-node", rest_port=0,
+                           metastore_uri="ram:///trace/metastore",
+                           default_index_root_uri="ram:///trace/idx",
+                           self_tracing=True),
+                storage_resolver=StorageResolver.for_test())
+    server = RestServer(node, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        # any traced request produces spans; flush ships them into the
+        # node's own otel index synchronously
+        server.route("GET", "/health/livez", {}, b"")
+        node.span_exporter.flush()
+        from quickwit_tpu.serve.otel import OTEL_TRACES_INDEX
+        from quickwit_tpu.query.ast import Term
+        from quickwit_tpu.search.models import SearchRequest
+        response = node.root_searcher.search(SearchRequest(
+            index_ids=[OTEL_TRACES_INDEX],
+            query_ast=Term("service_name", "quickwit-tpu"), max_hits=10))
+        assert response.num_hits >= 1
+        names = {h.doc["span_name"] for h in response.hits}
+        assert "http.request" in names
+    finally:
+        node.stop_background_services()
+        server.stop()
+        from quickwit_tpu.observability.tracing import TRACER as global_t
+        assert node.span_exporter is None or \
+            node.span_exporter not in global_t._processors
+
+
+def test_exporter_scope_filters_other_nodes():
+    batches_a, batches_b = [], []
+    ea = BatchSpanExporter(batches_a.append, node_id="A", scope="A",
+                           interval_secs=60.0)
+    eb = BatchSpanExporter(batches_b.append, node_id="B", scope="B",
+                           interval_secs=60.0)
+    tracer = Tracer()
+    tracer.add_processor(ea)
+    tracer.add_processor(eb)
+    with tracer.span("req", scope="A"):
+        with tracer.span("child"):  # inherits scope A
+            pass
+    ea.flush(); eb.flush(); ea.stop(); eb.stop()
+    a_spans = [s for b in batches_a for rs in b["resourceSpans"]
+               for ss in rs["scopeSpans"] for s in ss["spans"]]
+    assert len(a_spans) == 2
+    assert batches_b == []
+
+
+def test_otlp_status_enum_names():
+    tracer = Tracer()
+    finished = []
+    tracer.add_processor(finished.append)
+    with tracer.span("fine"):
+        pass
+    try:
+        with tracer.span("broken"):
+            raise RuntimeError("x")
+    except RuntimeError:
+        pass
+    payload = spans_to_otlp(finished, "svc")
+    codes = {s["name"]: s["status"]["code"]
+             for rs in payload["resourceSpans"]
+             for ss in rs["scopeSpans"] for s in ss["spans"]}
+    assert codes == {"fine": "STATUS_CODE_OK",
+                     "broken": "STATUS_CODE_ERROR"}
+    # and the lenient ingest side maps every encoding back
+    from quickwit_tpu.serve.otel import _status_str
+    assert _status_str(2) == "error" and _status_str(1) == "ok"
+    assert _status_str("STATUS_CODE_OK") == "ok"
+    assert _status_str("unset") == "unset"
+
+
+def test_rest_4xx_spans_not_errors():
+    from quickwit_tpu.serve import Node, NodeConfig, RestServer
+    from quickwit_tpu.storage import StorageResolver
+    node = Node(NodeConfig(node_id="status-node", rest_port=0,
+                           metastore_uri="ram:///st/metastore",
+                           default_index_root_uri="ram:///st/idx"),
+                storage_resolver=StorageResolver.for_test())
+    server = RestServer(node, host="127.0.0.1", port=0)
+    finished = []
+    TRACER.add_processor(finished.append)
+    try:
+        status, _ = server.route("GET", "/api/v1/indexes/missing", {}, b"")
+    except Exception:
+        pass
+    finally:
+        TRACER.remove_processor(finished.append)
+    spans = [s for s in finished if s.name == "http.request"]
+    # the 404 is classified ok (client error), with the code recorded
+    assert spans and spans[-1].status == "ok"
+    assert spans[-1].attributes.get("http.status_code") == 404
+    assert spans[-1].scope == "status-node"
