@@ -130,7 +130,9 @@ class ScalingPermits:
         if isinstance(decision, ScaleUp):
             entry.up.release(granted if granted is not None
                              else decision.num_shards)
-        else:
+        elif granted is None or granted > 0:
+            # same partial-grant rule as ScaleUp: a denied acquire
+            # (granted == 0) must not mint a down permit on release
             entry.down.release(1)
 
 

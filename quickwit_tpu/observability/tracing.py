@@ -48,7 +48,9 @@ class SpanData:
 
 
 def _new_id(nbytes: int) -> str:
-    return "".join(f"{random.getrandbits(8):02x}" for _ in range(nbytes))
+    # os.urandom, not the global PRNG: seeded harnesses and forked workers
+    # share `random` state and would mint colliding trace/span ids
+    return os.urandom(nbytes).hex()
 
 
 def format_traceparent(trace_id: str, span_id: str) -> str:

@@ -41,6 +41,20 @@ from .models import (
 
 logger = logging.getLogger(__name__)
 
+# rate_limited_tracing.rs analogue: a bad query fanned over thousands of
+# splits must not emit thousands of identical warnings
+from ..observability.tracing import RateLimitedLog  # noqa: E402
+
+_SPLIT_WARN_LIMITER = RateLimitedLog(limit=5, period_secs=60.0)
+
+
+def _warn_split_failure(kind: str, split_id: str, exc: object) -> None:
+    emit, suppressed = _SPLIT_WARN_LIMITER.should_log(kind)
+    if emit:
+        extra = f" ({suppressed} similar suppressed)" if suppressed else ""
+        logger.warning("split %s %s failed: %s%s", split_id, kind, exc,
+                       extra)
+
 
 class SearcherContext:
     def __init__(self, storage_resolver: Optional[StorageResolver] = None,
@@ -347,8 +361,7 @@ class SearchService:
         from .leaf import warmup_device_arrays
         for split, reader, plan, prep_error in data:
             if prep_error is not None:
-                logger.warning("split %s prepare failed: %s",
-                               split.split_id, prep_error)
+                _warn_split_failure("prepare", split.split_id, prep_error)
                 collector.failed_splits.append(SplitSearchError(
                     split_id=split.split_id, error=str(prep_error),
                     retryable=True))
@@ -367,7 +380,7 @@ class SearchService:
                 self.context.leaf_cache.put(key, response)
                 collector.add_leaf_response(response)
             except Exception as exc:  # noqa: BLE001 - partial failure semantics
-                logger.warning("split %s search failed: %s", split.split_id, exc)
+                _warn_split_failure("search", split.split_id, exc)
                 collector.failed_splits.append(SplitSearchError(
                     split_id=split.split_id, error=str(exc), retryable=True))
             finally:

@@ -257,12 +257,22 @@ class Node:
         from .otel import OtelService
         self.otel = OtelService(self)
         self.span_exporter = None
-        if config.self_tracing:
-            from ..observability.tracing import TRACER, BatchSpanExporter
-            self.span_exporter = BatchSpanExporter(
-                self.otel.ingest_traces, service_name="quickwit-tpu",
-                node_id=config.node_id, scope=config.node_id)
-            TRACER.add_processor(self.span_exporter)
+        self._ensure_span_exporter()
+
+    def _ensure_span_exporter(self) -> None:
+        """Create + register the self-tracing exporter if configured.
+
+        Called from __init__ AND start_background_services: stop tears the
+        exporter down, so a stop/start cycle must recreate it or the node
+        would keep serving with `self_tracing: true` while silently
+        exporting nothing."""
+        if not self.config.self_tracing or self.span_exporter is not None:
+            return
+        from ..observability.tracing import TRACER, BatchSpanExporter
+        self.span_exporter = BatchSpanExporter(
+            self.otel.ingest_traces, service_name="quickwit-tpu",
+            node_id=self.config.node_id, scope=self.config.node_id)
+        TRACER.add_processor(self.span_exporter)
 
     def _live_open_shards(self, index_uid: str,
                           source_id: str) -> list[str]:
@@ -575,26 +585,36 @@ class Node:
             granted = self.scaling_permits.acquire(key, decision)
             if granted == 0:
                 continue
-            if isinstance(decision, ScaleUp):
-                # a large scale-up may be granted partially (burst cap);
-                # the rest re-requests on later ticks as permits refill
-                ords = [int(sid.rsplit("-", 1)[-1]) for sid in shard_ids
-                        if sid.rsplit("-", 1)[-1].isdigit()]
-                base = max(ords, default=-1)
-                for k in range(granted):
-                    sid = f"{self.config.node_id}-shard-{base + 1 + k:02d}"
-                    self.ingester.open_shard(index_uid, source_id, sid)
-                    actions.append(("open", index_uid, sid))
-            else:
-                candidate = find_scale_down_candidate(
-                    {sid: self.config.node_id for sid in shard_ids})
-                if candidate is None:
-                    continue
-                _, sid = candidate
-                self.ingester.close_shard(index_uid, source_id, sid)
-                self.shard_rate_tracker.forget(
-                    shard_queue_id(index_uid, source_id, sid))
-                actions.append(("close", index_uid, sid))
+            try:
+                if isinstance(decision, ScaleUp):
+                    # a large scale-up may be granted partially (burst
+                    # cap); the rest re-requests on later ticks as
+                    # permits refill
+                    ords = [int(sid.rsplit("-", 1)[-1]) for sid in shard_ids
+                            if sid.rsplit("-", 1)[-1].isdigit()]
+                    base = max(ords, default=-1)
+                    for k in range(granted):
+                        sid = (f"{self.config.node_id}-shard-"
+                               f"{base + 1 + k:02d}")
+                        self.ingester.open_shard(index_uid, source_id, sid)
+                        actions.append(("open", index_uid, sid))
+                else:
+                    candidate = find_scale_down_candidate(
+                        {sid: self.config.node_id for sid in shard_ids})
+                    if candidate is None:
+                        self.scaling_permits.release(key, decision,
+                                                     granted=granted)
+                        continue
+                    _, sid = candidate
+                    self.ingester.close_shard(index_uid, source_id, sid)
+                    self.shard_rate_tracker.forget(
+                        shard_queue_id(index_uid, source_id, sid))
+                    actions.append(("close", index_uid, sid))
+            except Exception:  # noqa: BLE001
+                # a failed open/close must not eat the rate budget for
+                # the retry on the next convergence tick
+                self.scaling_permits.release(key, decision, granted=granted)
+                raise
             self.ingest_router.refresh(index_uid, source_id)
         return actions
 
@@ -762,6 +782,7 @@ class Node:
                                   heartbeat_interval_secs: float = 2.0) -> None:
         if getattr(self, "_bg_stop", None) is not None:
             return
+        self._ensure_span_exporter()
         stop = self._bg_stop = threading.Event()
 
         def owns_index(index_uid: str) -> bool:

@@ -70,6 +70,26 @@ class ApiError(Exception):
         self.status = status
 
 
+_PARSE_ERRORS = (QueryParseError, EsDslParseError, AggParseError,
+                 PlanError, TransformParseError, json.JSONDecodeError,
+                 ValueError)
+_METASTORE_STATUS = {"not_found": 404, "already_exists": 400,
+                     "invalid_argument": 400, "failed_precondition": 409}
+
+
+def classify_exception(exc: BaseException) -> Optional[int]:
+    """Exception → HTTP status, shared by the span classifier and the
+    response writer so recorded span status can never diverge from the
+    actual response code. None = unhandled (500 + traceback log)."""
+    if isinstance(exc, ApiError):
+        return exc.status
+    if isinstance(exc, _PARSE_ERRORS):
+        return 400
+    if isinstance(exc, MetastoreError):
+        return _METASTORE_STATUS.get(exc.kind, 500)
+    return None
+
+
 def _search_request_from_params(index_id: str, params: dict[str, Any],
                                 default_fields) -> SearchRequest:
     query = params.get("query", "*")
@@ -185,22 +205,12 @@ class RestServer:
                 status, payload = self._route_inner(
                     method, path, params, body, client_host=client_host,
                     content_type=content_type)
-            except ApiError as exc:
+            except Exception as exc:
                 # handled client/server error: classify before the span
                 # closes so routine 4xx don't pollute error-rate queries
-                span.set_attribute("http.status_code", exc.status)
-                span.status = "error" if exc.status >= 500 else "ok"
-                raise
-            except (QueryParseError, EsDslParseError, AggParseError,
-                    PlanError, TransformParseError, json.JSONDecodeError,
-                    ValueError):
-                span.set_attribute("http.status_code", 400)
-                span.status = "ok"
-                raise
-            except MetastoreError as exc:
-                code = {"not_found": 404, "already_exists": 400,
-                        "invalid_argument": 400,
-                        "failed_precondition": 409}.get(exc.kind, 500)
+                code = classify_exception(exc)
+                if code is None:
+                    raise  # unhandled → span closes with status=error
                 span.set_attribute("http.status_code", code)
                 span.status = "error" if code >= 500 else "ok"
                 raise
@@ -1172,20 +1182,15 @@ def _make_handler(server: RestServer):
                     client_host=self.client_address[0],
                     content_type=self.headers.get("Content-Type", ""),
                     traceparent=self.headers.get("traceparent", ""))
-            except ApiError as exc:
-                status, payload = exc.status, {"message": str(exc)}
-            except (QueryParseError, EsDslParseError, AggParseError,
-                    PlanError, TransformParseError, json.JSONDecodeError,
-                    ValueError) as exc:
-                status, payload = 400, {"message": str(exc)}
-            except MetastoreError as exc:
-                code = {"not_found": 404, "already_exists": 400,
-                        "invalid_argument": 400,
-                        "failed_precondition": 409}.get(exc.kind, 500)
-                status, payload = code, {"message": str(exc)}
             except Exception as exc:  # noqa: BLE001
-                logger.exception("internal error on %s %s", method, parsed.path)
-                status, payload = 500, {"message": f"internal error: {exc}"}
+                code = classify_exception(exc)
+                if code is None:
+                    logger.exception("internal error on %s %s", method,
+                                     parsed.path)
+                    status = 500
+                    payload = {"message": f"internal error: {exc}"}
+                else:
+                    status, payload = code, {"message": str(exc)}
             if (isinstance(payload, tuple) and len(payload) == 3
                     and payload[0] == "__raw__"):
                 data = payload[1]
