@@ -5,10 +5,12 @@ aggregations.rs` + tantivy's aggregation request JSON): parses the ES
 `aggs` request dict into typed specs the leaf executor lowers onto columnar
 kernels (`ops/aggs.py`).
 
-Supported: date_histogram (fixed_interval), histogram, terms,
-avg/min/max/sum/stats/value_count, percentiles. Sub-aggregations: metrics
-under buckets, plus ONE nested bucket level (e.g. date_histogram > terms)
-with its own metrics; deeper nesting raises.
+Supported: date_histogram (fixed_interval), histogram, terms, range,
+composite (terms/histogram/date_histogram sources, after-pagination,
+missing_bucket), avg/min/max/sum/stats/extended_stats/value_count,
+percentiles, cardinality. Sub-aggregations: metrics under buckets, plus
+ONE nested bucket level (e.g. date_histogram > terms) with its own
+metrics; deeper nesting raises; composite takes no sub-aggs yet.
 """
 
 from __future__ import annotations
@@ -92,7 +94,29 @@ class TermsAgg:
     sub_bucket: Optional["AggSpec"] = None
 
 
-AggSpec = Any  # union of the four dataclasses above
+@dataclass(frozen=True)
+class CompositeSource:
+    """One source of a composite aggregation key tuple."""
+    name: str
+    kind: str                     # "terms" | "histogram" | "date_histogram"
+    field: str
+    interval: float = 0.0         # histogram
+    interval_micros: int = 0      # date_histogram
+    missing_bucket: bool = False  # honored on every source kind (as in ES)
+
+
+@dataclass(frozen=True)
+class CompositeAgg:
+    """ES composite aggregation: paginated buckets over multi-source key
+    tuples in ascending lexicographic key order (`after` resumes strictly
+    past a key tuple)."""
+    name: str
+    sources: tuple[CompositeSource, ...]
+    size: int = 10
+    after: Optional[tuple[Any, ...]] = None  # decoded per-source values
+
+
+AggSpec = Any  # union of the dataclasses above
 
 
 _METRIC_KINDS = ("avg", "min", "max", "sum", "stats", "extended_stats",
@@ -205,11 +229,116 @@ def _parse_one(name: str, body: dict[str, Any], depth: int = 0) -> AggSpec:
                 "range are not supported yet")
         return RangeAgg(name=name, field=params["field"],
                         ranges=tuple(ranges), sub_metrics=sub_metrics)
+    if kind == "composite":
+        if depth > 0:
+            raise AggParseError(
+                f"composite aggregation {name!r} must be top-level")
+        if sub_metrics or sub_bucket:
+            raise AggParseError(
+                f"composite aggregation {name!r}: sub-aggregations under "
+                "composite are not supported yet")
+        return _parse_composite(name, params)
     if kind in _METRIC_KINDS:
         if sub_metrics or sub_bucket:
             raise AggParseError(f"metric aggregation {name!r} cannot have sub-aggs")
         return _parse_metric(name, kind, params)
     raise AggParseError(f"unsupported aggregation kind {kind!r}")
+
+
+def _decode_after_value(value: Any, source_kind: str) -> Any:
+    """Accept both plain ES after values and tantivy's type-prefixed form
+    (`str:x`, `f64:1`, `i64:1`, `u64:1`) emitted by the reference.
+
+    Decoding is source-kind-aware so a plain value is never misread:
+    histogram sources take numbers (a bare string must be the typed form);
+    terms sources keep strings as-is except the unambiguous prefixes —
+    a term field legitimately holding "i64:42" still pages correctly
+    because the numeric coercion is re-checked against the dictionary
+    type at lowering (plan.py)."""
+    if not isinstance(value, str):
+        return value
+    if source_kind in ("histogram", "date_histogram"):
+        for prefix in ("f64:", "i64:", "u64:"):
+            if value.startswith(prefix):
+                return float(value[len(prefix):])
+        try:
+            return float(value)
+        except ValueError:
+            raise AggParseError(
+                f"composite after value {value!r} is not numeric for a "
+                f"{source_kind} source")
+    if value.startswith("str:"):
+        return value[4:]
+    for prefix in ("f64:",):
+        if value.startswith(prefix):
+            return float(value[len(prefix):])
+    for prefix in ("i64:", "u64:"):
+        if value.startswith(prefix):
+            return int(value[len(prefix):])
+    return value
+
+
+def _parse_composite(name: str, params: dict[str, Any]) -> "CompositeAgg":
+    raw_sources = params.get("sources")
+    if not raw_sources or not isinstance(raw_sources, list):
+        raise AggParseError(
+            f"composite aggregation {name!r} requires a sources list")
+    sources = []
+    for entry in raw_sources:
+        if not isinstance(entry, dict) or len(entry) != 1:
+            raise AggParseError(
+                f"composite {name!r}: each source must be "
+                "{name: {kind: {...}}}")
+        src_name, src_body = next(iter(entry.items()))
+        src_kind = _agg_kind(src_body)
+        src_params = src_body[src_kind]
+        if src_kind not in ("terms", "histogram", "date_histogram"):
+            raise AggParseError(
+                f"composite {name!r}: unsupported source kind {src_kind!r}")
+        order = src_params.get("order", "asc")
+        if order != "asc":
+            raise AggParseError(
+                f"composite {name!r}: descending source order is not "
+                "supported yet")
+        if "field" not in src_params:
+            raise AggParseError(
+                f"composite {name!r}: source {src_name!r} requires a field")
+        interval = 0.0
+        interval_micros = 0
+        if src_kind == "histogram":
+            interval = float(src_params["interval"])
+            if interval <= 0:
+                raise AggParseError(
+                    f"composite {name!r}: histogram interval must be > 0")
+        elif src_kind == "date_histogram":
+            text = (src_params.get("fixed_interval")
+                    or src_params.get("interval"))
+            if text is None:
+                raise AggParseError(
+                    f"composite {name!r}: date_histogram source requires "
+                    "fixed_interval")
+            interval_micros = parse_interval_micros(text)
+        sources.append(CompositeSource(
+            name=src_name, kind=src_kind, field=src_params["field"],
+            interval=interval, interval_micros=interval_micros,
+            missing_bucket=bool(src_params.get("missing_bucket", False))))
+    after = None
+    if "after" in params:
+        raw_after = params["after"]
+        if not isinstance(raw_after, dict):
+            raise AggParseError(f"composite {name!r}: after must be a map")
+        missing = [s.name for s in sources if s.name not in raw_after]
+        if missing:
+            raise AggParseError(
+                f"composite {name!r}: after is missing sources {missing}")
+        after = tuple(_decode_after_value(raw_after[s.name], s.kind)
+                      for s in sources)
+    size = int(params.get("size", 10))
+    if size < 1 or size > 4096:
+        raise AggParseError(
+            f"composite {name!r}: size must be in [1, 4096]")
+    return CompositeAgg(name=name, sources=tuple(sources), size=size,
+                       after=after)
 
 
 def parse_aggs(aggs: dict[str, Any]) -> list[AggSpec]:

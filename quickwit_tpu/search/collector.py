@@ -124,6 +124,10 @@ class IncrementalCollector:
             _merge_terms(current, state)
         elif kind == "range":
             _merge_bucket_maps(current["bucket_map"], _range_to_map(state))
+        elif kind == "composite":
+            bucket_map = current["bucket_map"]
+            for key, count in _composite_pairs(state):
+                bucket_map[key] = bucket_map.get(key, 0) + count
         elif kind == "percentiles":
             current["sketch"] = current["sketch"] + state["sketch"]
         elif kind == "cardinality":
@@ -189,7 +193,48 @@ def _copy_state(state: dict[str, Any]) -> dict[str, Any]:
         copy.pop("counts", None)
         copy.pop("metrics", None)
         return copy
+    if kind == "composite":
+        copy = dict(state)
+        copy["bucket_map"] = dict(_composite_pairs(state))
+        copy.pop("buckets", None)
+        return copy
     return dict(state)
+
+
+def _composite_pairs(state: dict[str, Any]):
+    """(key_tuple, count) pairs from a leaf state ("buckets" list) or an
+    already-merged state ("bucket_map") — wire decode turns tuples into
+    lists, so keys re-freeze here."""
+    if "bucket_map" in state:
+        return [(tuple(k) if isinstance(k, list) else k, c)
+                for k, c in state["bucket_map"].items()]
+    return [(tuple(k), c) for k, c in state["buckets"]]
+
+
+def _composite_order_key(key_tuple):
+    """ES composite ordering: ascending per source, null first."""
+    return tuple((0, "") if v is None else (1, v) for v in key_tuple)
+
+
+def _finalize_composite(state: dict[str, Any]) -> dict[str, Any]:
+    bucket_map = (state["bucket_map"] if "bucket_map" in state
+                  else dict(_composite_pairs(state)))
+    ordered = sorted(bucket_map.items(),
+                     key=lambda kv: _composite_order_key(kv[0]))
+    ordered = ordered[: state["size"]]
+    sources = state["sources"]
+    buckets = []
+    for key_tuple, count in ordered:
+        key: dict[str, Any] = {}
+        for value, info in zip(key_tuple, sources):
+            if info["kind"] == "date_histogram" and value is not None:
+                value = int(value) // 1000  # micros → ES integer ms
+            key[info["name"]] = value
+        buckets.append({"key": key, "doc_count": int(count)})
+    out: dict[str, Any] = {"buckets": buckets}
+    if buckets:
+        out["after_key"] = buckets[-1]["key"]
+    return out
 
 
 def _range_to_map(state: dict[str, Any]) -> dict:
@@ -567,6 +612,8 @@ def finalize_aggregations(agg_states: dict[str, Any]) -> dict[str, Any]:
                     entry[mname] = _finalize_metric(acc)
                 buckets.append(entry)
             out[name] = {"buckets": buckets}
+        elif kind == "composite":
+            out[name] = _finalize_composite(state)
         elif kind == "percentiles":
             out[name] = {"values": _quantile_values(
                 state["sketch"], state["percents"],

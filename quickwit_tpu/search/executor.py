@@ -29,9 +29,9 @@ from ..ops import masks as mask_ops
 from ..ops import topk as topk_ops
 from ..ops.bm25 import score_postings
 from .plan import (
-    PRESENT_FROM_VALUES, BucketAggExec, LoweredPlan, MetricAggExec, PBool,
-    PMatchAll, PMatchNone, PNormPresence, PPostings, PPresence, PRange,
-    SortExec,
+    PRESENT_FROM_VALUES, BucketAggExec, CompositeAggExec, LoweredPlan,
+    MetricAggExec, PBool, PMatchAll, PMatchNone, PNormPresence, PPostings,
+    PPresence, PRange, SortExec,
 )
 
 _JIT_CACHE: dict[tuple, Callable] = {}
@@ -326,10 +326,80 @@ def _build_posting_space(plan: LoweredPlan, k: int) -> Callable:
     return fn
 
 
+def _eval_composite_agg(a: CompositeAggExec, arrays, scalars, mask):
+    """Composite buckets TPU-first: one multi-key lexicographic sort over
+    the doc space, run-boundary detection, and the first `size` distinct
+    key tuples read back with exact counts — no dynamic hash tables.
+
+    Per-source i32 keys use the order-preserving encoding documented on
+    CompositeSourceExec (missing=0, value=(idx+1)*2, after markers odd)."""
+    num = mask.shape[0]
+    m = mask
+    keys = []
+    for s in a.sources:
+        if s.kind == "terms_ord":
+            ordinals = arrays[s.values_slot]
+            present = ordinals >= 0
+            key = (ordinals.astype(jnp.int32) + 1) * 2
+        else:
+            values = arrays[s.values_slot]
+            present = arrays[s.present_slot].astype(jnp.bool_)
+            origin = scalars[s.origin_slot]
+            interval = scalars[s.interval_slot]
+            if s.kind == "date_histogram":
+                idx = ((values - origin) // interval).astype(jnp.int32)
+            else:
+                idx = jnp.floor((values.astype(jnp.float64) - origin)
+                                / interval).astype(jnp.int32)
+            key = (idx + 1) * 2
+        if s.missing_bucket:
+            key = jnp.where(present, key, jnp.int32(0))
+        else:
+            m = m & present
+        keys.append(key)
+    if a.has_after:
+        # strict lexicographic tuple > after, cascaded per source
+        gt = jnp.zeros(num, dtype=jnp.bool_)
+        eq = jnp.ones(num, dtype=jnp.bool_)
+        for key, s in zip(keys, a.sources):
+            marker = scalars[s.after_slot]
+            gt = gt | (eq & (key > marker))
+            eq = eq & (key == marker)
+        m = m & gt
+    sentinel = jnp.int32(2**31 - 1)
+    keys = [jnp.where(m, key, sentinel) for key in keys]
+    sorted_keys = jax.lax.sort(tuple(keys), num_keys=len(keys))
+    if not isinstance(sorted_keys, (tuple, list)):
+        sorted_keys = (sorted_keys,)
+    valid_total = jnp.sum(m.astype(jnp.int32))
+    idxs = jnp.arange(num, dtype=jnp.int32)
+    diff = jnp.zeros(max(num - 1, 0), dtype=jnp.bool_)
+    for sk in sorted_keys:
+        diff = diff | (sk[1:] != sk[:-1])
+    is_start = jnp.concatenate(
+        [jnp.ones(min(num, 1), dtype=jnp.bool_), diff])
+    is_start = is_start & (idxs < valid_total)
+    start_pos = jnp.where(is_start, idxs, jnp.int32(num))
+    k_runs = min(a.size, num)
+    neg_top, _ = jax.lax.top_k(-start_pos, min(k_runs + 1, num))
+    starts = -neg_top                       # ascending run starts
+    if starts.shape[0] < k_runs + 1:
+        starts = jnp.concatenate(
+            [starts, jnp.full(k_runs + 1 - starts.shape[0], num, jnp.int32)])
+    safe = jnp.clip(starts[:k_runs], 0, num - 1)
+    run_keys = jnp.stack([sk[safe] for sk in sorted_keys])   # [S, k_runs]
+    ends = jnp.minimum(starts[1:], valid_total)
+    counts = jnp.where(starts[:k_runs] < valid_total,
+                       ends - starts[:k_runs], jnp.int32(0))
+    return {"run_keys": run_keys, "counts": counts}
+
+
 def _eval_aggs(aggs, gathered, scalars, valid):
     agg_out = []
     for a in aggs:
-        if isinstance(a, BucketAggExec):
+        if isinstance(a, CompositeAggExec):
+            agg_out.append(_eval_composite_agg(a, gathered, scalars, valid))
+        elif isinstance(a, BucketAggExec):
             agg_out.append(_eval_bucket_agg(a, gathered, scalars, valid))
         elif isinstance(a, MetricAggExec):
             met = a.metric

@@ -25,7 +25,8 @@ from ..ops.aggs import PCTL_NUM_BUCKETS
 from ..query.aggregations import parse_aggs
 from .executor import execute_plan
 from .models import LeafSearchResponse, PartialHit, SearchRequest, SplitSearchError
-from .plan import BucketAggExec, MetricAggExec, lower_request
+from .plan import (BucketAggExec, CompositeAggExec, MetricAggExec,
+                   lower_request)
 
 
 from ..ops.topk import MISSING_VALUE_SENTINEL
@@ -361,6 +362,32 @@ def _intermediate_aggs(plan, agg_results: list) -> dict[str, Any]:
                     **a.sub.host_info,
                 }
             out[a.name] = state
+        elif isinstance(a, CompositeAggExec):
+            run_keys = np.asarray(res["run_keys"])       # [S, k_runs]
+            counts = np.asarray(res["counts"])
+            src_infos = a.host_info["sources"]
+            buckets = []
+            for j in range(run_keys.shape[1]):
+                if counts[j] <= 0:
+                    continue
+                values = []
+                for si, info in enumerate(src_infos):
+                    enc = int(run_keys[si, j])
+                    if enc == 0:
+                        values.append(None)
+                        continue
+                    idx = enc // 2 - 1
+                    if info["kind"] == "terms":
+                        values.append(info["keys"][idx])
+                    else:  # histogram kinds decode to absolute keys
+                        values.append(info["origin"] + idx * info["interval"])
+                buckets.append([values, int(counts[j])])
+            out[a.name] = {
+                "kind": "composite", "buckets": buckets,
+                "size": a.host_info["size"],
+                "sources": [{"name": i["name"], "kind": i["kind"]}
+                            for i in src_infos],
+            }
         elif isinstance(a, MetricAggExec):
             met = a.metric
             if met.kind == "percentiles":

@@ -34,7 +34,8 @@ from ..ops.bm25 import idf as bm25_idf
 from ..ops.phrase import phrase_match
 from ..query import ast as Q
 from ..query.aggregations import (
-    AggSpec, DateHistogramAgg, HistogramAgg, MetricAgg, RangeAgg, TermsAgg,
+    AggSpec, CompositeAgg, CompositeSource, DateHistogramAgg, HistogramAgg,
+    MetricAgg, RangeAgg, TermsAgg,
 )
 from ..query.tokenizers import get_tokenizer
 from ..index.reader import SplitReader
@@ -179,6 +180,55 @@ class MetricAggExec:
 
     def sig(self) -> str:
         return f"magg({self.metric.sig()})"
+
+
+@dataclass(frozen=True)
+class CompositeSourceExec:
+    """One composite-agg key source lowered onto a per-doc i32 key.
+
+    Key encoding (order-preserving): missing → 0, value with
+    ordinal/bucket-index `idx` → (idx+1)*2. The odd gap values encode
+    `after` positions that fall BETWEEN this split's keys (a term absent
+    from the split's dictionary lowers to insertion_point*2+1), so the
+    device-side strict `key > after` comparison is exact in every split."""
+    kind: str                 # "terms_ord" | "histogram" | "date_histogram"
+    values_slot: int
+    present_slot: int = -1    # terms_ord derives presence from ordinal >= 0
+    origin_slot: int = -1     # histogram kinds (traced scalar)
+    interval_slot: int = -1
+    missing_bucket: bool = False
+    after_slot: int = -1      # traced i32 scalar (plan.has_after only)
+
+    def sig(self) -> str:
+        return (f"csrc({self.kind},{self.values_slot},{self.present_slot},"
+                f"{self.origin_slot},{self.interval_slot},"
+                f"{int(self.missing_bucket)},{self.after_slot})")
+
+
+@dataclass(frozen=True)
+class CompositeAggExec:
+    """`composite` lowered TPU-first: per-source i32 key planes, one
+    multi-key `lax.sort` over the doc space, run-boundary detection, and a
+    static-size readback of the first `size` distinct key tuples + counts
+    (role of tantivy's composite collector driven via `collector.rs:523`)."""
+    name: str
+    sources: tuple[CompositeSourceExec, ...]
+    size: int
+    has_after: bool
+    host_info: Any = None     # per-source decode info (not jit-relevant)
+
+    def sig(self) -> str:
+        return (f"cagg({self.size},{int(self.has_after)},"
+                + ",".join(s.sig() for s in self.sources) + ")")
+
+
+def aligned_origin(vmin, interval, offset=0):
+    """ES bucket alignment shared by every histogram lowering (plain and
+    composite): the bucket boundary k*interval + offset at or below vmin.
+    Exact integer math for date micros, float for numeric histograms."""
+    if isinstance(interval, int):
+        return ((vmin - offset) // interval) * interval + offset
+    return float(np.floor((vmin - offset) / interval) * interval + offset)
 
 
 # --------------------------------------------------------------------------
@@ -762,6 +812,8 @@ class Lowering:
     def lower_agg(self, spec: AggSpec) -> Any:
         if isinstance(spec, MetricAgg):
             return MetricAggExec(spec.name, self.lower_metric(spec))
+        if isinstance(spec, CompositeAgg):
+            return self._lower_composite_agg(spec)
         exec_ = self._lower_bucket_agg(spec)
         sub_spec = getattr(spec, "sub_bucket", None)
         if sub_spec is not None:
@@ -806,7 +858,7 @@ class Lowering:
                 # ES `offset` shifts every bucket boundary: buckets start at
                 # k*interval + offset
                 offset = getattr(spec, "offset_micros", 0)
-                origin = ((lo - offset) // interval) * interval + offset
+                origin = aligned_origin(lo, interval, offset)
                 num_buckets = int((hi - origin) // interval) + 1
                 if num_buckets > MAX_BUCKETS:
                     raise PlanError(
@@ -865,7 +917,7 @@ class Lowering:
             vmin, vmax = meta.get("min_value"), meta.get("max_value")
             if vmin is None:
                 vmin = vmax = 0
-            origin = float(np.floor(vmin / spec.interval) * spec.interval)
+            origin = aligned_origin(vmin, spec.interval)
             num_buckets = int((vmax - origin) // spec.interval) + 1
             if num_buckets > MAX_BUCKETS:
                 raise PlanError(f"histogram would create {num_buckets} buckets")
@@ -987,6 +1039,160 @@ class Lowering:
                        "min_doc_count": spec.min_doc_count,
                        "order_desc": spec.order_by_count_desc,
                        "split_size": spec.split_size})
+
+    def _lower_composite_agg(self, spec: CompositeAgg) -> CompositeAggExec:
+        if self.batch is not None:
+            # split-local ordinals/origins in the key encoding: the batch
+            # (vmapped multi-split) path falls back per split like
+            # multivalued terms
+            raise PlanError(f"composite agg {spec.name!r} is per-split")
+        execs = []
+        infos = []
+        for si, src in enumerate(spec.sources):
+            after_val = spec.after[si] if spec.after is not None else None
+            execs.append(self._lower_composite_source(
+                spec.name, src, spec.after is not None, after_val, infos))
+        return CompositeAggExec(
+            name=spec.name, sources=tuple(execs), size=spec.size,
+            has_after=spec.after is not None,
+            host_info={"sources": infos, "size": spec.size})
+
+    def _lower_composite_source(self, agg_name: str, src: CompositeSource,
+                                has_after: bool, after_val,
+                                infos: list) -> CompositeSourceExec:
+        fm = self._field(src.field)
+        if not fm.fast:
+            raise PlanError(
+                f"composite {agg_name!r}: source field {src.field!r} must "
+                "be a fast field")
+        meta = self.reader.field_meta(src.field)
+        if meta.get("multivalued"):
+            raise PlanError(
+                f"composite {agg_name!r}: multivalued source field "
+                f"{src.field!r} is not supported")
+
+        def after_slot_for(encoded) -> int:
+            if not has_after:
+                return -1
+            clamped = int(np.clip(encoded, -(2**31) + 1, 2**31 - 2))
+            return self.b.add_scalar(clamped, np.int32)
+
+        if src.kind == "terms":
+            if meta.get("column_kind") == "ordinal":
+                values_slot = self.b.add_array(
+                    f"col.{src.field}.ordinals",
+                    lambda: self.reader.column_ordinals(src.field))
+                keys = self.reader.column_dict(src.field)
+            else:
+                ordinals, uniques = self._ordinalize_numeric(src.field)
+                values_slot = self.b.add_array(
+                    f"col.{src.field}.ordinals_dyn", lambda: ordinals)
+                keys = uniques
+            enc = 0
+            if after_val is not None:
+                import bisect
+                keys_list = list(keys)
+                if keys_list and not isinstance(after_val,
+                                                type(keys_list[0])):
+                    # the dictionary's type is authoritative: coerce the
+                    # marker (a term field holding literal "i64:42" was
+                    # prefix-decoded to int) rather than letting bisect
+                    # raise a TypeError mid-split
+                    try:
+                        after_val = type(keys_list[0])(after_val)
+                    except (TypeError, ValueError):
+                        raise PlanError(
+                            f"composite after value for source "
+                            f"{src.name!r} does not match the field type")
+                pos = bisect.bisect_left(keys_list, after_val)
+                if pos < len(keys_list) and keys_list[pos] == after_val:
+                    enc = (pos + 1) * 2       # exact: strictly past it
+                else:
+                    enc = pos * 2 + 1         # between split-local keys
+                enc = max(enc, 1)             # non-null after excludes null
+            infos.append({"name": src.name, "kind": "terms",
+                          "keys": [k.item() if isinstance(k, np.generic)
+                                   else k for k in keys]})
+            return CompositeSourceExec(
+                "terms_ord", values_slot,
+                missing_bucket=src.missing_bucket,
+                after_slot=after_slot_for(enc))
+        if src.kind == "date_histogram":
+            if fm.type is not FieldType.DATETIME:
+                raise PlanError(
+                    f"composite {agg_name!r}: date_histogram source "
+                    f"requires a datetime field, got {src.field!r}")
+            interval = src.interval_micros
+            vmin = meta.get("min_value")
+            vmax = meta.get("max_value")
+            origin = 0 if vmin is None else aligned_origin(vmin, interval)
+            # the key encoding (idx+1)*2 must fit i32, a looser bound than
+            # MAX_BUCKETS (composite never materializes a bucket array)
+            if vmax is not None and (vmax - origin) // interval > 2**29:
+                raise PlanError(
+                    f"composite {agg_name!r}: date_histogram interval too "
+                    "fine for the split's time range")
+            enc = 0
+            if after_val is not None:
+                micros = int(float(after_val) * 1000)  # ES after is ms
+                enc = max(int((micros - origin) // interval + 1) * 2, 1)
+            infos.append({"name": src.name, "kind": "date_histogram",
+                          "origin": int(origin), "interval": int(interval)})
+            # whole-second intervals ride the same derived-i32 seconds
+            # column as the plain date_histogram lowering (i64 division is
+            # emulated on TPU); origin is interval-aligned so origin%1s==0
+            base_s = (vmin // 1_000_000) if vmin is not None else 0
+            use_s32 = (interval % 1_000_000 == 0
+                       and vmin is not None
+                       and (vmax // 1_000_000 - base_s)
+                       + abs(origin // 1_000_000 - base_s) < 2**31)
+            if use_s32:
+                values_slot = self.b.add_array(
+                    f"col.{src.field}.values_s32",
+                    lambda: self._seconds_column(src.field, base_s))
+                present_slot = self.b.add_array(
+                    f"col.{src.field}.present",
+                    lambda: self.reader.column_values(src.field)[1])
+                origin_slot = self.b.add_scalar(
+                    origin // 1_000_000 - base_s, np.int32)
+                interval_slot = self.b.add_scalar(
+                    interval // 1_000_000, np.int32)
+            else:
+                values_slot, present_slot = self._column_slots(src.field)
+                origin_slot = self.b.add_scalar(origin, np.int64)
+                interval_slot = self.b.add_scalar(interval, np.int64)
+            return CompositeSourceExec(
+                "date_histogram", values_slot, present_slot,
+                origin_slot=origin_slot, interval_slot=interval_slot,
+                missing_bucket=src.missing_bucket,
+                after_slot=after_slot_for(enc))
+        # histogram
+        if fm.type is FieldType.TEXT:
+            raise PlanError(
+                f"composite {agg_name!r}: histogram source requires a "
+                f"numeric field, got {src.field!r}")
+        interval_f = src.interval
+        vmin = meta.get("min_value")
+        vmax = meta.get("max_value")
+        origin_f = 0.0 if vmin is None else aligned_origin(vmin, interval_f)
+        # i32 key-encoding bound, looser than MAX_BUCKETS (see above)
+        if vmax is not None and (vmax - origin_f) / interval_f > 2**29:
+            raise PlanError(
+                f"composite {agg_name!r}: histogram interval too fine for "
+                "the split's value range")
+        values_slot, present_slot = self._column_slots(src.field)
+        enc = 0
+        if after_val is not None:
+            idx = int(np.floor((float(after_val) - origin_f) / interval_f))
+            enc = max((idx + 1) * 2, 1)
+        infos.append({"name": src.name, "kind": "histogram",
+                      "origin": origin_f, "interval": interval_f})
+        return CompositeSourceExec(
+            "histogram", values_slot, present_slot,
+            origin_slot=self.b.add_scalar(origin_f, np.float64),
+            interval_slot=self.b.add_scalar(interval_f, np.float64),
+            missing_bucket=src.missing_bucket,
+            after_slot=after_slot_for(enc))
 
     def _ordinalize_numeric(self, field: str):
         return ordinalize_numeric_column(self.reader, field)

@@ -351,9 +351,9 @@ def _fill_empty_aggs(aggregations: dict, aggs_request: dict) -> None:
     import numpy as np
 
     from ..ops.aggs import HLL_NUM_REGISTERS, PCTL_NUM_BUCKETS
-    from ..query.aggregations import (DateHistogramAgg, HistogramAgg,
-                                      MetricAgg, RangeAgg, TermsAgg,
-                                      parse_aggs)
+    from ..query.aggregations import (CompositeAgg, DateHistogramAgg,
+                                      HistogramAgg, MetricAgg, RangeAgg,
+                                      TermsAgg, parse_aggs)
     from .collector import finalize_aggregations
     try:
         specs = parse_aggs(aggs_request)
@@ -381,6 +381,11 @@ def _fill_empty_aggs(aggregations: dict, aggs_request: dict) -> None:
             empty_states[spec.name] = {
                 "kind": "range", "ranges": list(spec.ranges),
                 "bucket_map": {}}
+        elif isinstance(spec, CompositeAgg):
+            empty_states[spec.name] = {
+                "kind": "composite", "bucket_map": {}, "size": spec.size,
+                "sources": [{"name": s.name, "kind": s.kind}
+                            for s in spec.sources]}
         elif isinstance(spec, TermsAgg):
             empty_states[spec.name] = {
                 "kind": "terms", "bucket_map": {}, "size": spec.size,

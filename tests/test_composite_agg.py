@@ -1,0 +1,196 @@
+"""Composite aggregation: multi-source key tuples, after-pagination,
+missing_bucket, cross-split merges (reference oracle:
+`rest-api-tests/scenarii/aggregations/0001-aggregations.yaml` composite
+steps; engine design: one multi-key lax.sort + run-boundary readback,
+`search/executor.py::_eval_composite_agg`)."""
+
+import numpy as np
+import pytest
+
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.index import SplitReader, SplitWriter
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.query.aggregations import AggParseError, parse_aggs
+from quickwit_tpu.query.ast import MatchAll, Term
+from quickwit_tpu.search import (
+    IncrementalCollector, SearchRequest, finalize_aggregations,
+    leaf_search_single_split,
+)
+from quickwit_tpu.storage import RamStorage
+
+MAPPER = DocMapper(field_mappings=[
+    FieldMapping("host", FieldType.TEXT, tokenizer="raw", fast=True),
+    FieldMapping("name", FieldType.TEXT, tokenizer="raw", fast=True, indexed=True),
+    FieldMapping("response", FieldType.F64, fast=True),
+    FieldMapping("ts", FieldType.DATETIME, fast=True,
+                 input_formats=("unix_timestamp",)),
+])
+
+DOCS = [
+    {"name": "Fritz", "response": 30.0, "ts": 1_600_000_000},
+    {"name": "Fritz", "response": 30.0, "ts": 1_600_000_000},
+    {"name": "Bernhard", "response": 130.0, "ts": 1_600_086_400},
+    {"host": "192.168.0.1", "name": "Fred", "response": 100.0,
+     "ts": 1_600_000_000},
+    {"host": "192.168.0.1", "name": "Fritz", "response": 30.0,
+     "ts": 1_600_000_000},
+    {"host": "192.168.0.10", "name": "Albert", "response": 100.0,
+     "ts": 1_600_086_400},
+    {"host": "192.168.0.10", "name": "Holger", "response": 30.0,
+     "ts": 1_600_000_000},
+    {"host": "192.168.0.10", "name": "Horst", "ts": 1_600_000_000},
+    {"host": "192.168.0.10", "name": "Werner", "response": 20.0,
+     "ts": 1_600_000_000},
+    {"host": "192.168.0.11", "name": "Manfred", "response": 100.0,
+     "ts": 1_600_086_400},
+]
+
+COMPOSITE = {
+    "comp": {"composite": {
+        "size": 5,
+        "sources": [
+            {"host": {"terms": {"field": "host", "missing_bucket": True}}},
+            {"name": {"terms": {"field": "name"}}},
+            {"response": {"histogram": {"field": "response",
+                                        "interval": 50}}},
+        ]}}}
+
+
+def _reader_for(docs, tag):
+    writer = SplitWriter(MAPPER)
+    for doc in docs:
+        writer.add_json_doc(doc)
+    storage = RamStorage(Uri.parse(f"ram:///composite-{tag}"))
+    storage.put("s.split", writer.finish())
+    return SplitReader(storage, "s.split")
+
+
+def _search(aggs, readers, query=None):
+    request = SearchRequest(index_ids=["t"], query_ast=query or MatchAll(),
+                            max_hits=0, aggs=aggs)
+    collector = IncrementalCollector(max_hits=0)
+    for i, reader in enumerate(readers):
+        collector.add_leaf_response(
+            leaf_search_single_split(request, MAPPER, reader, f"s{i}"))
+    return finalize_aggregations(collector.aggregation_states())
+
+
+@pytest.fixture(scope="module")
+def single_reader():
+    return _reader_for(DOCS, "one")
+
+
+@pytest.fixture(scope="module")
+def split_readers():
+    # same corpus split across two splits: merged result must be identical
+    return [_reader_for(DOCS[:4], "a"), _reader_for(DOCS[4:], "b")]
+
+
+EXPECTED_PAGE1 = [
+    ({"host": None, "name": "Bernhard", "response": 100.0}, 1),
+    ({"host": None, "name": "Fritz", "response": 0.0}, 2),
+    ({"host": "192.168.0.1", "name": "Fred", "response": 100.0}, 1),
+    ({"host": "192.168.0.1", "name": "Fritz", "response": 0.0}, 1),
+    ({"host": "192.168.0.10", "name": "Albert", "response": 100.0}, 1),
+]
+
+EXPECTED_PAGE2 = [
+    ({"host": "192.168.0.10", "name": "Holger", "response": 0.0}, 1),
+    # Horst has no response and response has no missing_bucket → excluded
+    ({"host": "192.168.0.10", "name": "Werner", "response": 0.0}, 1),
+    ({"host": "192.168.0.11", "name": "Manfred", "response": 100.0}, 1),
+]
+
+
+def _assert_buckets(result, expected):
+    got = [(b["key"], b["doc_count"]) for b in result["buckets"]]
+    assert got == [(k, c) for k, c in expected]
+
+
+def test_composite_first_page(single_reader):
+    result = _search(COMPOSITE, [single_reader])["comp"]
+    _assert_buckets(result, EXPECTED_PAGE1)
+    assert result["after_key"] == EXPECTED_PAGE1[-1][0]
+
+
+def test_composite_after_pagination(single_reader):
+    import copy
+    aggs = copy.deepcopy(COMPOSITE)
+    aggs["comp"]["composite"]["after"] = EXPECTED_PAGE1[-1][0]
+    result = _search(aggs, [single_reader])["comp"]
+    _assert_buckets(result, EXPECTED_PAGE2)
+
+
+def test_composite_typed_after_form(single_reader):
+    """The reference/tantivy emits type-prefixed after keys."""
+    import copy
+    aggs = copy.deepcopy(COMPOSITE)
+    aggs["comp"]["composite"]["after"] = {
+        "host": "str:192.168.0.10", "name": "str:Albert",
+        "response": "f64:100"}
+    result = _search(aggs, [single_reader])["comp"]
+    _assert_buckets(result, EXPECTED_PAGE2)
+
+
+def test_composite_cross_split_merge(split_readers):
+    """Split-local ordinals decode to terms before the merge, so a corpus
+    split across two splits yields identical pages."""
+    result = _search(COMPOSITE, split_readers)["comp"]
+    _assert_buckets(result, EXPECTED_PAGE1)
+    import copy
+    aggs = copy.deepcopy(COMPOSITE)
+    aggs["comp"]["composite"]["after"] = result["after_key"]
+    _assert_buckets(_search(aggs, split_readers)["comp"], EXPECTED_PAGE2)
+
+
+def test_composite_respects_query(single_reader):
+    result = _search(COMPOSITE, [single_reader],
+                     query=Term(field="name", value="Fritz"))["comp"]
+    got = {(b["key"]["host"], b["doc_count"]) for b in result["buckets"]}
+    assert got == {(None, 2), ("192.168.0.1", 1)}
+
+
+def test_composite_date_histogram_source(single_reader):
+    aggs = {"by_day": {"composite": {"sources": [
+        {"day": {"date_histogram": {"field": "ts",
+                                    "fixed_interval": "1d"}}},
+        {"name": {"terms": {"field": "name"}}},
+    ]}}}
+    result = _search(aggs, [single_reader])["by_day"]
+    keys = [(b["key"]["day"], b["key"]["name"], b["doc_count"])
+            for b in result["buckets"]]
+    day0 = 1_600_000_000 // 86_400 * 86_400 * 1000.0   # ES ms keys
+    day1 = day0 + 86_400_000.0
+    assert (day0, "Fred", 1) in keys
+    assert (day1, "Albert", 1) in keys
+    # Horst HAS ts → included (no response source here)
+    assert (day0, "Horst", 1) in keys
+
+
+def test_composite_size_exact_counts(single_reader):
+    """doc_counts on a size-limited page are exact, not truncated."""
+    aggs = {"c": {"composite": {"size": 1, "sources": [
+        {"name": {"terms": {"field": "name"}}}]}}}
+    result = _search(aggs, [single_reader])["c"]
+    assert [(b["key"]["name"], b["doc_count"]) for b in result["buckets"]] \
+        == [("Albert", 1)]
+    aggs["c"]["composite"]["after"] = result["after_key"]
+    result = _search(aggs, [single_reader])["c"]
+    assert [(b["key"]["name"], b["doc_count"]) for b in result["buckets"]] \
+        == [("Bernhard", 1)]
+
+
+def test_composite_parse_errors():
+    with pytest.raises(AggParseError):
+        parse_aggs({"c": {"composite": {"sources": []}}})
+    with pytest.raises(AggParseError):
+        parse_aggs({"c": {"composite": {"sources": [
+            {"x": {"terms": {"field": "f", "order": "desc"}}}]}}})
+    with pytest.raises(AggParseError):
+        parse_aggs({"c": {"composite": {
+            "sources": [{"x": {"terms": {"field": "f"}}}],
+            "after": {"wrong_name": 1}}}})
+    with pytest.raises(AggParseError):  # sub-aggs not supported yet
+        parse_aggs({"c": {"composite": {"sources": [
+            {"x": {"terms": {"field": "f"}}}]},
+            "aggs": {"m": {"avg": {"field": "g"}}}}})

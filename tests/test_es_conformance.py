@@ -23,10 +23,6 @@ EXCLUSIONS: dict[str, str] = {
     "search_after/0001-search_after_edge_case.yaml:6":
         "exact i64 search_after comparison at the ±2^63 boundary "
         "(internal f64 sort keys round above 2^53)",
-    "aggregations/0001-aggregations.yaml:16":
-        "composite aggregation (paginated multi-source buckets)",
-    "aggregations/0001-aggregations.yaml:17":
-        "composite aggregation (paginated multi-source buckets)",
     "aggregations/0001-aggregations.yaml:10":
         "t-digest-exact percentile interpolation (±0.1): the fixed "
         "log-bucket device sketch differs in the upper tail",
