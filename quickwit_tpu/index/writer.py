@@ -184,7 +184,17 @@ class SplitWriter:
         for field_name, values in tdoc.fields.items():
             fm = self.doc_mapper.field(field_name)
             if fm is None:
-                continue
+                if self.doc_mapper.mode != "dynamic":
+                    continue
+                # dynamic mode: unmapped paths materialize per split with
+                # the dynamic_mapping options (raw terms over canonical
+                # value strings — doc_mapper._collect_dynamic_leaves)
+                fm = self.doc_mapper.dynamic_field(field_name)
+                if fm.indexed and field_name not in self._inv:
+                    fastindex = _native_capable(fm)
+                    self._inv[field_name] = (
+                        _NativeInvertedFieldBuilder(fm, fastindex)
+                        if fastindex else _InvertedFieldBuilder(fm))
             if fm.indexed:
                 builder = self._inv[field_name]
                 if isinstance(builder, _NativeInvertedFieldBuilder):

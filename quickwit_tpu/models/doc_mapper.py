@@ -11,9 +11,17 @@ Role of the reference's `quickwit-doc-mapper` (`doc_mapper_impl.rs`,
 TPU-first divergence: fields are a *flat* list of dot-separated paths (the
 reference flattens its mapping tree the same way at tantivy-schema build
 time), and fast fields are laid out as dense HBM-friendly columns
-(see `index/columns.py`). Dynamic (schemaless) JSON fields are handled by a
-catch-all `_dynamic` text field (tokenized `path.segments:value` pairs),
-a simplification of the reference's dynamic mapping.
+(see `index/columns.py`).
+
+Dynamic mode (`mode: dynamic` + `dynamic_mapping`, reference:
+`field_mapping_entry.rs:613` QuickwitJsonOptions::default_dynamic): every
+unmapped leaf path materializes per split as a raw-tokenized text field
+whose terms carry the canonical string form of the JSON value — the
+analogue of tantivy's path-prefixed JSON terms, on this engine's padded
+posting arrays. Term/full-text/phrase queries on unmapped paths resolve
+against these per-split fields at plan time. Fast columns for dynamic
+paths are not materialized yet (range/sort/agg on a dynamic path needs a
+concrete mapping; the config's `fast` flag is accepted for compatibility).
 """
 
 from __future__ import annotations
@@ -92,6 +100,35 @@ class FieldMapping:
         )
 
 
+@dataclass(frozen=True)
+class DynamicMapping:
+    """Indexing options applied to unmapped fields under `mode: dynamic`
+    (reference: QuickwitJsonOptions, `field_mapping_entry.rs:621`)."""
+    indexed: bool = True
+    tokenizer: str = "raw"     # reference default_json: raw, no fieldnorms
+    record: str = "basic"
+    stored: bool = True
+    fast: bool = True          # accepted; dynamic fast columns not built yet
+    expand_dots: bool = True
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"indexed": self.indexed, "tokenizer": self.tokenizer,
+                "record": self.record, "stored": self.stored,
+                "fast": self.fast, "expand_dots": self.expand_dots}
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "DynamicMapping":
+        fast = d.get("fast", True)
+        if isinstance(fast, dict):
+            fast = True
+        return DynamicMapping(
+            indexed=d.get("indexed", True),
+            tokenizer=d.get("tokenizer", "raw"),
+            record=d.get("record", "basic"),
+            stored=d.get("stored", True), fast=fast,
+            expand_dots=d.get("expand_dots", True))
+
+
 def _iter_path(doc: Any, path: Sequence[str]) -> Iterator[Any]:
     """Yield all values at `path` in a (possibly nested/array) JSON doc."""
     if not path:
@@ -136,7 +173,10 @@ class DocMapper:
     tag_fields: tuple[str, ...] = ()
     default_search_fields: tuple[str, ...] = ()
     store_source: bool = True
-    mode: str = "lenient"  # "lenient" | "strict": unknown fields ignored/rejected
+    # "lenient" (unknown fields ignored) | "strict" (rejected) |
+    # "dynamic" (materialized per dynamic_mapping)
+    mode: str = "lenient"
+    dynamic_mapping: Optional[DynamicMapping] = None
     # reference `store_document_size`: a synthetic `_doc_length` fast
     # column holding each doc's serialized byte size (aggregatable,
     # never part of _source)
@@ -144,6 +184,15 @@ class DocMapper:
 
     def __post_init__(self) -> None:
         self._by_name = {fm.name: fm for fm in self.field_mappings}
+        # interior dotted prefixes of mapped names ("a.b.c" → {"a","a.b"}):
+        # O(1) membership test on the per-doc dynamic walk
+        self._interior_prefixes = set()
+        for fm in self.field_mappings:
+            parts = fm.name.split(".")
+            for i in range(1, len(parts)):
+                self._interior_prefixes.add(".".join(parts[:i]))
+        if self.mode == "dynamic" and self.dynamic_mapping is None:
+            self.dynamic_mapping = DynamicMapping()
         if self.timestamp_field is not None:
             ts = self._by_name.get(self.timestamp_field)
             if ts is None or ts.type is not FieldType.DATETIME or not ts.fast:
@@ -152,6 +201,27 @@ class DocMapper:
 
     def field(self, name: str) -> Optional[FieldMapping]:
         return self._by_name.get(name)
+
+    def dynamic_field(self, name: str) -> FieldMapping:
+        """The synthesized mapping an unmapped path gets under
+        `mode: dynamic` — raw-tokenized text over canonical value strings
+        (both the writer and the query lowering use this, so index- and
+        query-side terms always agree)."""
+        dm = self.dynamic_mapping or DynamicMapping()
+        return FieldMapping(name, FieldType.TEXT, tokenizer=dm.tokenizer,
+                            record=dm.record, indexed=dm.indexed,
+                            stored=dm.stored, fast=False)
+
+    def shadows_concrete_field(self, name: str) -> bool:
+        """True when a dotted path descends through a mapped NON-JSON
+        field (`text.inner` under a concrete text field): such paths are
+        never dynamic — they are simply invalid."""
+        parts = name.split(".")
+        for i in range(1, len(parts)):
+            parent = self._by_name.get(".".join(parts[:i]))
+            if parent is not None:
+                return parent.type is not FieldType.JSON
+        return False
 
     @property
     def fast_fields(self) -> list[FieldMapping]:
@@ -179,6 +249,8 @@ class DocMapper:
             for key in doc:
                 if key not in known_roots:
                     raise DocParsingError(f"unknown field {key!r} in strict mapping")
+        elif self.mode == "dynamic":
+            self._collect_dynamic(doc, (), fields)
         if self.timestamp_field is not None and self.timestamp_field not in fields:
             # reference parity (doc_processor.rs): every doc must carry the
             # timestamp field — split time ranges then bound ALL docs, which
@@ -186,6 +258,64 @@ class DocMapper:
             raise DocParsingError(
                 f"document is missing timestamp field {self.timestamp_field!r}")
         return TypedDoc(fields=fields, source=doc if self.store_source else {})
+
+    def _collect_dynamic(self, node: Any, path: tuple[str, ...],
+                         fields: dict[str, list[Any]]) -> None:
+        """Walk the doc's UNMAPPED parts, materializing each leaf value
+        under its dotted path as a canonical string (numbers/bools index
+        the same string the query lowering produces)."""
+        if isinstance(node, dict):
+            for key, value in node.items():
+                sub = path + (key,)
+                dotted = ".".join(sub)
+                fm = self._by_name.get(dotted)
+                if fm is not None:
+                    if fm.type is FieldType.JSON:
+                        # subpaths of a mapped JSON field stay searchable
+                        # in dynamic mode via dynamic leaves (the whole
+                        # value is separately stored under the mapping)
+                        self._collect_dynamic_leaves(value, sub, fields)
+                    elif "." in key and not fields.get(dotted):
+                        # literal dotted key colliding with a mapped name
+                        # (expand_dots): route it to the concrete mapping
+                        # instead of silently dropping it
+                        raw = value if isinstance(value, list) else [value]
+                        try:
+                            fields[dotted] = [self._convert(fm, v)
+                                              for v in raw if v is not None]
+                        except (ValueError, TypeError) as exc:
+                            raise DocParsingError(
+                                f"field {dotted!r}: {exc}") from exc
+                    continue
+                if dotted in self._interior_prefixes:
+                    # interior node of the concrete schema: only its
+                    # unmapped children are dynamic
+                    self._collect_dynamic(value, sub, fields)
+                else:
+                    self._collect_dynamic_leaves(value, sub, fields)
+        elif isinstance(node, list):
+            for item in node:
+                self._collect_dynamic(item, path, fields)
+
+    def _collect_dynamic_leaves(self, node: Any, path: tuple[str, ...],
+                                fields: dict[str, list[Any]]) -> None:
+        if node is None:
+            return
+        if isinstance(node, dict):
+            for key, value in node.items():
+                self._collect_dynamic_leaves(value, path + (key,), fields)
+            return
+        if isinstance(node, list):
+            for item in node:
+                self._collect_dynamic_leaves(item, path, fields)
+            return
+        if isinstance(node, bool):
+            text = "true" if node else "false"
+        elif isinstance(node, float):
+            text = repr(node)
+        else:
+            text = str(node)
+        fields.setdefault(".".join(path), []).append(text)
 
     def _convert(self, fm: FieldMapping, value: Any) -> Any:
         t = fm.type
@@ -256,6 +386,8 @@ class DocMapper:
             "default_search_fields": list(self.default_search_fields),
             "store_source": self.store_source,
             "mode": self.mode,
+            "dynamic_mapping": (self.dynamic_mapping.to_dict()
+                                if self.dynamic_mapping else None),
             "store_document_size": self.store_document_size,
         }
 
@@ -269,6 +401,8 @@ class DocMapper:
             default_search_fields=tuple(d.get("default_search_fields", ())),
             store_source=d.get("store_source", True),
             mode=d.get("mode", "lenient"),
+            dynamic_mapping=(DynamicMapping.from_dict(d["dynamic_mapping"])
+                             if d.get("dynamic_mapping") else None),
             store_document_size=d.get("store_document_size", False),
         )
 

@@ -50,7 +50,9 @@ def es_query_to_ast(query: dict[str, Any],
         # post-tokenization indexed form (verbatim=True)
         field, spec = _single_kv(body, "term")
         if isinstance(spec, dict):
-            value = str(spec["value"])
+            # _scalar_str, not str(): JSON true must canonicalize to
+            # "true" exactly like the scalar shorthand form
+            value = _scalar_str(spec["value"])
             if spec.get("case_insensitive"):
                 value = value.lower()
             ast: QueryAst = Term(field, value, verbatim=True)

@@ -343,6 +343,12 @@ class Lowering:
                     and self.doc_mapper.store_document_size):
                 return FieldMapping("_doc_length", FieldType.I64,
                                     fast=True, indexed=False)
+            if (self.doc_mapper.mode == "dynamic"
+                    and not self.doc_mapper.shadows_concrete_field(name)):
+                # unmapped path under dynamic mode: the split may hold it
+                # as a materialized dynamic field; term lookups on splits
+                # that never saw the path lower to empty postings
+                return self.doc_mapper.dynamic_field(name)
             raise PlanError(f"unknown field {name!r}")
         return fm
 
@@ -364,12 +370,23 @@ class Lowering:
         if not scoring:
             return PPostings(ids_slot, tfs_slot, scoring=False)
         meta = self.reader.field_meta(field)
-        norm_slot = self.b.add_array(
-            f"norm.{field}", lambda: self.reader.fieldnorm(field))
+        norm_slot = self._fieldnorm_slot(field)
         idf_value = bm25_idf(self.reader.num_docs, info.df) * boost
         idf_slot = self.b.add_scalar(idf_value, np.float32)
         avg_slot = self.b.add_scalar(meta.get("avg_len", 1.0), np.float32)
         return PPostings(ids_slot, tfs_slot, True, norm_slot, idf_slot, avg_slot)
+
+    def _fieldnorm_slot(self, field: str) -> int:
+        """Fieldnorm array slot, tolerating splits that never materialized
+        the field (dynamic-mode paths absent from a split): zeros keep the
+        plan structure uniform and contribute nothing to BM25."""
+        reader = self.reader
+        if reader.has_array(f"inv.{field}.fieldnorm"):
+            return self.b.add_array(
+                f"norm.{field}", lambda: reader.fieldnorm(field))
+        return self.b.add_array(
+            f"norm.{field}.absent",
+            lambda: np.zeros(reader.num_docs_padded, dtype=np.int32))
 
     def _empty_postings_node(self, field: str, term: str, scoring: bool) -> Any:
         """Uniform-structure stand-in for a term absent from this split."""
@@ -384,8 +401,7 @@ class Lowering:
         if not scoring:
             return PPostings(ids_slot, tfs_slot, scoring=False)
         meta = self.reader.field_meta(field)
-        norm_slot = self.b.add_array(
-            f"norm.{field}", lambda: self.reader.fieldnorm(field))
+        norm_slot = self._fieldnorm_slot(field)
         idf_slot = self.b.add_scalar(0.0, np.float32)
         avg_slot = self.b.add_scalar(meta.get("avg_len", 1.0), np.float32)
         return PPostings(ids_slot, tfs_slot, True, norm_slot, idf_slot, avg_slot)
@@ -406,8 +422,7 @@ class Lowering:
         if not scoring:
             return PPostings(ids_slot, tfs_slot, scoring=False)
         meta = self.reader.field_meta(field)
-        norm_slot = self.b.add_array(
-            f"norm.{field}", lambda: self.reader.fieldnorm(field))
+        norm_slot = self._fieldnorm_slot(field)
         idf_slot = self.b.add_scalar(
             bm25_idf(self.reader.num_docs, max(int(df_for_idf), 1)) * boost, np.float32)
         avg_slot = self.b.add_scalar(meta.get("avg_len", 1.0), np.float32)
@@ -664,9 +679,7 @@ class Lowering:
             _vals, present_slot = self._column_slots(field)
             return PPresence(present_slot)
         if fm.indexed and fm.type is FieldType.TEXT:
-            norm_slot = self.b.add_array(
-                f"norm.{field}", lambda: self.reader.fieldnorm(field))
-            return PNormPresence(norm_slot)
+            return PNormPresence(self._fieldnorm_slot(field))
         raise PlanError(f"presence query needs a fast or indexed text field: {field!r}")
 
     def _fast_only_term(self, field: str, value: str) -> Any:

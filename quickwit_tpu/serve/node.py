@@ -113,8 +113,14 @@ def _validate_doc_mapping(doc_mapper: DocMapper) -> None:
     for field in doc_mapper.default_search_fields:
         fm = doc_mapper.field(field)
         if fm is None:
-            raise ValueError(
-                f"unknown default search field `{field}`")
+            if (doc_mapper.mode == "dynamic"
+                    and not doc_mapper.shadows_concrete_field(field)):
+                # resolvable dynamically — but only if dynamic fields are
+                # indexed (reference: dynamic default-field validation)
+                fm = doc_mapper.dynamic_field(field)
+            else:
+                raise ValueError(
+                    f"unknown default search field `{field}`")
         if not fm.indexed:
             raise ValueError(
                 f"default search field `{field}` is not indexed")

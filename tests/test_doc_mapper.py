@@ -116,3 +116,90 @@ def test_timestamp_field_required_per_doc():
     with pytest.raises(DocParsingError) as exc:
         mapper.doc_from_json({"body": "no timestamp"})
     assert "timestamp" in str(exc.value)
+
+
+# ---------------------------------------------------------------------------
+# dynamic mapping mode (reference: QuickwitJsonOptions::default_dynamic,
+# field_mapping_entry.rs:613; validation scenarios:
+# rest-api-tests/scenarii/default_search_fields/0002)
+
+def _dynamic_mapper(**kwargs):
+    from quickwit_tpu.models.doc_mapper import DocMapper, FieldMapping, FieldType
+    return DocMapper(field_mappings=[FieldMapping("title", FieldType.TEXT)],
+                     mode="dynamic", **kwargs)
+
+
+def test_dynamic_mode_materializes_unmapped_leaves():
+    mapper = _dynamic_mapper()
+    tdoc = mapper.doc_from_json({
+        "title": "hello", "service": "gw",
+        "nested": {"code": 42, "ok": True, "pi": 3.5},
+        "tags": ["a", "b"]})
+    assert tdoc.fields["service"] == ["gw"]
+    assert tdoc.fields["nested.code"] == ["42"]       # canonical strings
+    assert tdoc.fields["nested.ok"] == ["true"]
+    assert tdoc.fields["nested.pi"] == ["3.5"]
+    assert tdoc.fields["tags"] == ["a", "b"]
+    assert tdoc.fields["title"] == ["hello"]          # concrete untouched
+
+
+def test_dynamic_mode_respects_concrete_subpaths():
+    from quickwit_tpu.models.doc_mapper import DocMapper, FieldMapping, FieldType
+    mapper = DocMapper(field_mappings=[
+        FieldMapping("resource.service", FieldType.TEXT)], mode="dynamic")
+    tdoc = mapper.doc_from_json(
+        {"resource": {"service": "gw", "extra": 1}})
+    assert tdoc.fields["resource.service"] == ["gw"]
+    assert tdoc.fields["resource.extra"] == ["1"]
+    assert mapper.shadows_concrete_field("resource.service.x")
+    assert not mapper.shadows_concrete_field("resource.other")
+
+
+def test_dynamic_field_options_follow_dynamic_mapping():
+    from quickwit_tpu.models.doc_mapper import DynamicMapping
+    mapper = _dynamic_mapper(
+        dynamic_mapping=DynamicMapping(indexed=False))
+    fm = mapper.dynamic_field("anything.at.all")
+    assert not fm.indexed
+    assert fm.tokenizer == "raw"
+    # round-trips through the wire dict
+    from quickwit_tpu.models.doc_mapper import DocMapper
+    again = DocMapper.from_dict(mapper.to_dict())
+    assert again.dynamic_mapping.indexed is False
+    assert again.mode == "dynamic"
+
+
+def test_dynamic_default_search_field_validation():
+    import pytest as _pytest
+    from quickwit_tpu.serve.node import _validate_doc_mapping
+    from quickwit_tpu.models.doc_mapper import DynamicMapping
+    ok = _dynamic_mapper()
+    ok.default_search_fields = ("some_field",)
+    _validate_doc_mapping(ok)  # dynamic + indexed → fine
+    not_indexed = _dynamic_mapper(
+        dynamic_mapping=DynamicMapping(indexed=False))
+    not_indexed.default_search_fields = ("some_field",)
+    with _pytest.raises(ValueError, match="is not indexed"):
+        _validate_doc_mapping(not_indexed)
+    shadowed = _dynamic_mapper()
+    shadowed.default_search_fields = ("title.inner",)
+    with _pytest.raises(ValueError, match="unknown default search field"):
+        _validate_doc_mapping(shadowed)
+
+
+def test_dynamic_literal_dotted_key_routes_to_concrete_mapping():
+    from quickwit_tpu.models.doc_mapper import DocMapper, FieldMapping, FieldType
+    mapper = DocMapper(field_mappings=[
+        FieldMapping("resource.service", FieldType.TEXT)], mode="dynamic")
+    tdoc = mapper.doc_from_json({"resource.service": "gw"})
+    assert tdoc.fields["resource.service"] == ["gw"]
+
+
+def test_dynamic_json_field_subpaths_materialize():
+    from quickwit_tpu.models.doc_mapper import DocMapper, FieldMapping, FieldType
+    mapper = DocMapper(field_mappings=[
+        FieldMapping("attrs", FieldType.JSON)], mode="dynamic")
+    tdoc = mapper.doc_from_json({"attrs": {"x": "1", "deep": {"y": 2}}})
+    assert tdoc.fields["attrs.x"] == ["1"]
+    assert tdoc.fields["attrs.deep.y"] == ["2"]
+    assert tdoc.fields["attrs"] == [{"x": "1", "deep": {"y": 2}}]

@@ -33,9 +33,6 @@ EXCLUSIONS: dict[str, str] = {
     "es_compatibility/0021-cat-indices.yaml:1":
         "asserts the reference's exact on-disk split sizes (storage "
         "formats differ by design)",
-    "default_search_fields/0002_invalid_default_fields.yaml:2":
-        "dynamic mapping mode (fields materialized at ingest with "
-        "dynamic_mapping settings) is not implemented",
 }
 
 # Known-failing steps (regression ratchet): features still to be built.

@@ -297,3 +297,30 @@ def test_batch_nested_histogram_name_collision(readers):
     got_coll.add_leaf_response(got)
     assert _normalize(finalize_aggregations(got_coll.aggregation_states())) == \
         _normalize(finalize_aggregations(expected.aggregation_states()))
+
+
+def test_batch_dynamic_field_absent_from_one_split():
+    """A dynamic-mode path that one split never ingested must contribute
+    zero hits from that split — not crash on the missing fieldnorm array
+    (regression: _fieldnorm_slot zeros fallback)."""
+    from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+    from quickwit_tpu.index import SplitWriter, SplitReader
+
+    m = DocMapper(field_mappings=[FieldMapping("title", FieldType.TEXT)],
+                  mode="dynamic")
+    storage = RamStorage(Uri.parse("ram:///dynbatch"))
+    rs = []
+    for s, docs in enumerate([[{"title": "a", "service": "gw"}],
+                              [{"title": "b"}]]):  # no `service` in split 1
+        w = SplitWriter(m)
+        for d in docs:
+            w.add_json_doc(d)
+        storage.put(f"{s}.split", w.finish())
+        rs.append(SplitReader(storage, f"{s}.split"))
+    req = SearchRequest(index_ids=["x"],
+                        query_ast=Term(field="service", value="gw"),
+                        max_hits=10)
+    batch = build_batch(req, m, rs, ["a", "b"])
+    resp = execute_batch(batch, req)
+    assert resp.num_hits == 1
+    assert resp.partial_hits[0].split_id == "a"
