@@ -80,9 +80,14 @@ class StableLogMergePolicy(MergePolicy):
             if s.state is SplitState.PUBLISHED
             and s.metadata.num_docs < self.split_num_docs_target
         ]
-        by_level: dict[int, list[Split]] = {}
+        # partitioned splits only merge within their partition (reference
+        # split_metadata.rs:75-78: merging across partition_id defeats
+        # routing-based pruning), so the level buckets key on both
+        by_level: dict[tuple[int, int], list[Split]] = {}
         for split in candidates:
-            by_level.setdefault(self._level(split.metadata.num_docs), []).append(split)
+            key = (split.metadata.partition_id,
+                   self._level(split.metadata.num_docs))
+            by_level.setdefault(key, []).append(split)
         operations = []
         for level_splits in by_level.values():
             level_splits.sort(key=lambda s: s.metadata.split_id)  # ULIDs: time order
@@ -205,6 +210,7 @@ class MergeExecutor:
             num_merge_ops=1 + max(s.metadata.num_merge_ops for s in operation.splits),
             delete_opstamp=max_delete_opstamp,
             doc_mapping_uid=operation.splits[0].metadata.doc_mapping_uid,
+            partition_id=operation.splits[0].metadata.partition_id,
         )
         self.metastore.stage_splits(self.index_uid, [metadata])
         self.split_storage.put(split_file_path(merged_id), data)

@@ -67,7 +67,9 @@ class IndexingPipeline:
         self.split_storage = split_storage
         self.transform = transform  # compiled Transform (VRL analogue) or None
         self.counters = PipelineCounters()
-        self._writer: Optional[SplitWriter] = None
+        # one writer per partition id (reference `indexer.rs:146-160`);
+        # partition 0 is the unpartitioned default
+        self._writers: dict[int, SplitWriter] = {}
         self._pending_delta = CheckpointDelta()
 
     # ------------------------------------------------------------------
@@ -88,31 +90,52 @@ class IndexingPipeline:
             self.params.index_uid, self.params.source_id)
 
     # ------------------------------------------------------------------
+    # overflow partition once max_num_partitions writers exist
+    # (reference `indexer.rs:61,157-160` maps excess docs to OTHER)
+    OTHER_PARTITION = 2**64 - 1
+
+    def _writer_for(self, partition: int) -> SplitWriter:
+        writer = self._writers.get(partition)
+        if writer is None:
+            if (partition != self.OTHER_PARTITION
+                    and len(self._writers)
+                    >= self.doc_mapper.max_num_partitions):
+                return self._writer_for(self.OTHER_PARTITION)
+            writer = self._writers[partition] = SplitWriter(self.doc_mapper)
+        return writer
+
     def process_batch(self, batch: SourceBatch) -> None:
         """DocProcessor + Indexer stages."""
-        if self._writer is None:
-            self._writer = SplitWriter(self.doc_mapper)
         for doc in batch.docs:
             try:
                 if self.transform is not None:
                     doc = self.transform.apply(doc, copy=False)
                     if doc is None:  # drop()ped by the script (filtering)
                         continue
-                self._writer.add_typed_doc(self.doc_mapper.doc_from_json(doc))
+                # parse BEFORE fetching the writer: an invalid doc must
+                # not register a phantom partition writer (the partition
+                # budget would fill with empties, mis-routing later docs)
+                tdoc = self.doc_mapper.doc_from_json(doc)
+                partition = self.doc_mapper.partition_id(doc)
+                self._writer_for(partition).add_typed_doc(tdoc)
                 self.counters.num_docs_processed += 1
             except (DocParsingError, TransformRuntimeError) as exc:
                 self.counters.num_docs_invalid += 1
                 logger.debug("dropping invalid doc: %s", exc)
         self._pending_delta.extend(batch.checkpoint_delta)
-        if (self._writer.num_docs >= self.params.split_num_docs_target
+        total = sum(w.num_docs for w in self._writers.values())
+        if (total >= self.params.split_num_docs_target
                 or batch.force_commit):
             self.commit(force=True)
 
     def commit(self, force: bool = False) -> Optional[str]:
-        """Packager + Uploader + Publisher stages: serialize the split,
-        stage it, upload it, publish it with the pending checkpoint delta."""
-        writer = self._writer
-        if writer is None or writer.num_docs == 0:
+        """Packager + Uploader + Publisher stages: serialize one split per
+        partition, stage them, upload them, publish them TOGETHER with the
+        pending checkpoint delta (partitioned docs from one batch window
+        must land atomically, like the reference's per-partition
+        IndexedSplitBatch)."""
+        writers = {p: w for p, w in self._writers.items() if w.num_docs > 0}
+        if not writers:
             if not self._pending_delta.is_empty:
                 # batches that produced no valid docs still advance the
                 # checkpoint (otherwise they would replay forever)
@@ -122,35 +145,44 @@ class IndexingPipeline:
                     checkpoint_delta=self._pending_delta)
                 self._pending_delta = CheckpointDelta()
             return None
-        split_id = new_split_id()
-        data = writer.finish()
-        metadata = SplitMetadata(
-            split_id=split_id,
-            index_uid=self.params.index_uid,
-            source_id=self.params.source_id,
-            node_id=self.params.node_id,
-            num_docs=writer.num_docs,
-            uncompressed_docs_size_bytes=writer._uncompressed_docs_size,
-            footprint_bytes=len(data),
-            time_range_start=writer._time_min,
-            time_range_end=writer._time_max,
-            tags=frozenset(writer.tags),
-            create_timestamp=int(time.time()),
-            doc_mapping_uid=self.params.doc_mapping_uid,
-        )
+        staged: list[tuple[SplitMetadata, bytes]] = []
+        for partition in sorted(writers):
+            writer = writers[partition]
+            data = writer.finish()
+            staged.append((SplitMetadata(
+                split_id=new_split_id(),
+                index_uid=self.params.index_uid,
+                source_id=self.params.source_id,
+                node_id=self.params.node_id,
+                num_docs=writer.num_docs,
+                uncompressed_docs_size_bytes=writer._uncompressed_docs_size,
+                footprint_bytes=len(data),
+                time_range_start=writer._time_min,
+                time_range_end=writer._time_max,
+                tags=frozenset(writer.tags),
+                create_timestamp=int(time.time()),
+                doc_mapping_uid=self.params.doc_mapping_uid,
+                partition_id=partition,
+            ), data))
         # stage → upload → publish: a crash between stages leaves either a
         # staged-but-absent split (GC'd) or an uploaded-but-unpublished file
         # (GC'd); never a published split without its file.
-        self.metastore.stage_splits(self.params.index_uid, [metadata])
-        self.split_storage.put(split_file_path(split_id), data)
+        self.metastore.stage_splits(self.params.index_uid,
+                                    [m for m, _ in staged])
+        for metadata, data in staged:
+            self.split_storage.put(split_file_path(metadata.split_id), data)
         delta = self._pending_delta if not self._pending_delta.is_empty else None
+        split_ids = [m.split_id for m, _ in staged]
         self.metastore.publish_splits(
-            self.params.index_uid, [split_id],
+            self.params.index_uid, split_ids,
             source_id=self.params.source_id,
             checkpoint_delta=delta)
-        self.counters.num_splits_published += 1
-        self.counters.num_published_docs += writer.num_docs
-        self._writer = None
+        for metadata, _ in staged:
+            self.counters.num_splits_published += 1
+            self.counters.num_published_docs += metadata.num_docs
+            logger.info("published split %s (%d docs, partition %d)",
+                        metadata.split_id, metadata.num_docs,
+                        metadata.partition_id)
+        self._writers = {}
         self._pending_delta = CheckpointDelta()
-        logger.info("published split %s (%d docs)", split_id, metadata.num_docs)
-        return split_id
+        return split_ids[0]

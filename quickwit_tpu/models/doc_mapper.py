@@ -177,6 +177,12 @@ class DocMapper:
     # "dynamic" (materialized per dynamic_mapping)
     mode: str = "lenient"
     dynamic_mapping: Optional[DynamicMapping] = None
+    # doc-level partition routing (reference: `routing_expression/mod.rs`,
+    # doc_mapping.partition_key + max_num_partitions): docs hash to
+    # partitions, each split holds one partition, only same-partition
+    # splits merge
+    partition_key: str = ""
+    max_num_partitions: int = 200
     # reference `store_document_size`: a synthetic `_doc_length` fast
     # column holding each doc's serialized byte size (aggregatable,
     # never part of _source)
@@ -193,6 +199,8 @@ class DocMapper:
                 self._interior_prefixes.add(".".join(parts[:i]))
         if self.mode == "dynamic" and self.dynamic_mapping is None:
             self.dynamic_mapping = DynamicMapping()
+        from .routing_expression import RoutingExpr
+        self._routing_expr = RoutingExpr(self.partition_key)
         if self.timestamp_field is not None:
             ts = self._by_name.get(self.timestamp_field)
             if ts is None or ts.type is not FieldType.DATETIME or not ts.fast:
@@ -367,6 +375,10 @@ class DocMapper:
         from ..query.tokenizers import Token
         return [Token(canonical_term(fm, value), 0)]
 
+    def partition_id(self, doc: dict[str, Any]) -> int:
+        """Stable u64 partition for a raw JSON doc (0 = unpartitioned)."""
+        return self._routing_expr.eval_hash(doc)
+
     def tags(self, tdoc: TypedDoc) -> set[str]:
         """`tag_field:value` strings recorded in split metadata for pruning
         (reference: `tag_pruning.rs`)."""
@@ -388,6 +400,8 @@ class DocMapper:
             "mode": self.mode,
             "dynamic_mapping": (self.dynamic_mapping.to_dict()
                                 if self.dynamic_mapping else None),
+            "partition_key": self.partition_key,
+            "max_num_partitions": self.max_num_partitions,
             "store_document_size": self.store_document_size,
         }
 
@@ -403,6 +417,8 @@ class DocMapper:
             mode=d.get("mode", "lenient"),
             dynamic_mapping=(DynamicMapping.from_dict(d["dynamic_mapping"])
                              if d.get("dynamic_mapping") else None),
+            partition_key=d.get("partition_key", ""),
+            max_num_partitions=d.get("max_num_partitions", 200),
             store_document_size=d.get("store_document_size", False),
         )
 

@@ -110,6 +110,20 @@ def _validate_doc_mapping(doc_mapper: DocMapper) -> None:
                 f"tag field {tag!r} must be a raw-tokenized text, u64, or "
                 f"i64 field (got {fm.type.value}"
                 f"{'/' + fm.tokenizer if fm.type is FieldType.TEXT else ''})")
+    if doc_mapper.partition_key:
+        from ..models.routing_expression import (RoutingExpr,
+                                                 RoutingExprError)
+        try:
+            expr = RoutingExpr(doc_mapper.partition_key)
+        except RoutingExprError as exc:
+            raise ValueError(f"invalid partition_key: {exc}")
+        for field in expr.field_names():
+            if doc_mapper.field(field) is None \
+                    and doc_mapper.mode != "dynamic":
+                # a typo'd key would silently collapse every doc into the
+                # single "absent" partition
+                raise ValueError(
+                    f"partition_key references unknown field `{field}`")
     for field in doc_mapper.default_search_fields:
         fm = doc_mapper.field(field)
         if fm is None:

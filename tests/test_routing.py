@@ -1,0 +1,219 @@
+"""Partition routing expressions (reference:
+`quickwit-doc-mapper/src/routing_expression/mod.rs`) and the
+per-partition split cut in the indexing pipeline (`indexer.rs:146-160`)."""
+
+import pytest
+
+from quickwit_tpu.models.routing_expression import (RoutingExpr,
+                                                    RoutingExprError)
+
+
+def test_parse_and_fields():
+    assert RoutingExpr("").is_empty
+    assert RoutingExpr("tenant_id").field_names() == ["tenant_id"]
+    assert RoutingExpr("tenant_id,app").field_names() == ["tenant_id", "app"]
+    expr = RoutingExpr("hash_mod((tenant_id,app), 50)")
+    assert expr.field_names() == ["tenant_id", "app"]
+    assert RoutingExpr("resource.service").field_names() == \
+        ["resource.service"]
+
+
+def test_parse_errors():
+    with pytest.raises(RoutingExprError):
+        RoutingExpr("unknown_fn(a, 2)")
+    with pytest.raises(RoutingExprError):
+        RoutingExpr("hash_mod(a)")
+    with pytest.raises(RoutingExprError):
+        RoutingExpr("hash_mod(a, 0)")
+    with pytest.raises(RoutingExprError):
+        RoutingExpr("a,,b")
+
+
+def test_eval_deterministic_and_value_sensitive():
+    expr = RoutingExpr("tenant_id")
+    h1 = expr.eval_hash({"tenant_id": "acme"})
+    assert h1 == expr.eval_hash({"tenant_id": "acme", "other": 1})
+    assert h1 != expr.eval_hash({"tenant_id": "globex"})
+    assert h1 != expr.eval_hash({})              # absent ≠ any value
+    assert expr.eval_hash({}) != expr.eval_hash({"tenant_id": None})
+    # type-sensitive: "1" vs 1 are different partitions (injective encode)
+    assert expr.eval_hash({"tenant_id": 1}) != \
+        expr.eval_hash({"tenant_id": "1"})
+
+
+def test_eval_nested_path_and_structure_salt():
+    expr = RoutingExpr("resource.service")
+    doc = {"resource": {"service": "gw"}}
+    assert expr.eval_hash(doc) == expr.eval_hash(doc)
+    # a different expression over the same value gives different ids
+    # (the expression tree salts the hash like the reference)
+    assert expr.eval_hash(doc) != \
+        RoutingExpr("resource.other").eval_hash(
+            {"resource": {"other": "gw"}})
+
+
+def test_hash_mod_bounds_partition_count():
+    expr = RoutingExpr("hash_mod(tenant_id, 3)")
+    seen = {expr.eval_hash({"tenant_id": f"t{i}"}) for i in range(200)}
+    # the OUTER hash isn't bounded, but only 3 distinct inner residues
+    # exist, so at most 3 distinct partition ids appear
+    assert len(seen) <= 3
+
+
+def test_escaped_dot_is_one_segment():
+    expr = RoutingExpr(r"a\.b")
+    assert expr.field_names() == ["a.b"]
+    assert expr.eval_hash({"a.b": "x"}) != expr.eval_hash({"a": {"b": "x"}})
+
+
+def test_pipeline_partitions_docs_into_splits():
+    from quickwit_tpu.common.uri import Uri
+    from quickwit_tpu.indexing.pipeline import IndexingPipeline, PipelineParams
+    from quickwit_tpu.indexing.sources import VecSource
+    from quickwit_tpu.metastore.file_backed import FileBackedMetastore
+    from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+    from quickwit_tpu.models.index_metadata import IndexConfig, IndexMetadata
+    from quickwit_tpu.models.split_metadata import SplitState
+    from quickwit_tpu.storage import RamStorage
+
+    mapper = DocMapper(field_mappings=[
+        FieldMapping("tenant_id", FieldType.TEXT, tokenizer="raw", fast=True),
+        FieldMapping("body", FieldType.TEXT)],
+        partition_key="tenant_id", max_num_partitions=10)
+    storage = RamStorage(Uri.parse("ram:///routing"))
+    metastore = FileBackedMetastore(RamStorage(Uri.parse("ram:///routing-ms")))
+    metadata = IndexMetadata(index_uid="t:1", index_config=IndexConfig(
+        index_id="t", index_uri="ram:///routing", doc_mapper=mapper))
+    metastore.create_index(metadata)
+    docs = [{"tenant_id": f"t{i % 3}", "body": f"doc {i}"} for i in range(30)]
+    pipeline = IndexingPipeline(
+        PipelineParams(index_uid="t:1", source_id="vec"),
+        mapper, VecSource(docs), metastore, storage)
+    pipeline.run_to_completion()
+    from quickwit_tpu.metastore.base import ListSplitsQuery
+    splits = metastore.list_splits(ListSplitsQuery(
+        index_uids=["t:1"], states=[SplitState.PUBLISHED]))
+    # 3 tenants → 3 partitioned splits, each value-homogeneous
+    assert len(splits) == 3
+    assert len({s.metadata.partition_id for s in splits}) == 3
+    assert sum(s.metadata.num_docs for s in splits) == 30
+
+
+def test_pipeline_overflow_partition_caps_split_count():
+    from quickwit_tpu.common.uri import Uri
+    from quickwit_tpu.indexing.pipeline import IndexingPipeline, PipelineParams
+    from quickwit_tpu.indexing.sources import VecSource
+    from quickwit_tpu.metastore.file_backed import FileBackedMetastore
+    from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+    from quickwit_tpu.models.index_metadata import IndexConfig, IndexMetadata
+    from quickwit_tpu.models.split_metadata import SplitState
+    from quickwit_tpu.storage import RamStorage
+
+    mapper = DocMapper(field_mappings=[
+        FieldMapping("tenant_id", FieldType.TEXT, tokenizer="raw")],
+        partition_key="tenant_id", max_num_partitions=4)
+    storage = RamStorage(Uri.parse("ram:///routing2"))
+    metastore = FileBackedMetastore(
+        RamStorage(Uri.parse("ram:///routing2-ms")))
+    metadata = IndexMetadata(index_uid="t:1", index_config=IndexConfig(
+        index_id="t", index_uri="ram:///routing2", doc_mapper=mapper))
+    metastore.create_index(metadata)
+    docs = [{"tenant_id": f"t{i}"} for i in range(20)]  # 20 distinct keys
+    pipeline = IndexingPipeline(
+        PipelineParams(index_uid="t:1", source_id="vec"),
+        mapper, VecSource(docs), metastore, storage)
+    pipeline.run_to_completion()
+    from quickwit_tpu.metastore.base import ListSplitsQuery
+    splits = metastore.list_splits(ListSplitsQuery(
+        index_uids=["t:1"], states=[SplitState.PUBLISHED]))
+    # 4 partition writers + the OTHER overflow partition
+    assert len(splits) == 5
+    other = [s for s in splits
+             if s.metadata.partition_id == IndexingPipeline.OTHER_PARTITION]
+    assert len(other) == 1
+    assert other[0].metadata.num_docs == 16
+    assert sum(s.metadata.num_docs for s in splits) == 20
+
+
+def test_merge_policy_respects_partitions():
+    from quickwit_tpu.indexing.merge import StableLogMergePolicy
+    from quickwit_tpu.models.split_metadata import (Split, SplitMetadata,
+                                                    SplitState)
+
+    def split(i, partition):
+        return Split(metadata=SplitMetadata(
+            split_id=f"{i:026d}", index_uid="t:1", num_docs=10,
+            partition_id=partition), state=SplitState.PUBLISHED)
+
+    policy = StableLogMergePolicy(merge_factor=3, max_merge_factor=3,
+                                  min_level_num_docs=100)
+    splits = [split(i, partition=i % 2) for i in range(6)]
+    ops = policy.operations(splits)
+    assert len(ops) == 2
+    for op in ops:
+        partitions = {s.metadata.partition_id for s in op.splits}
+        assert len(partitions) == 1
+
+
+def test_object_values_hash_key_order_independent():
+    expr = RoutingExpr("meta")
+    assert expr.eval_hash({"meta": {"a": 1, "b": 2}}) == \
+        expr.eval_hash({"meta": {"b": 2, "a": 1}})
+
+
+def test_invalid_docs_do_not_consume_partition_slots():
+    from quickwit_tpu.common.uri import Uri
+    from quickwit_tpu.indexing.pipeline import IndexingPipeline, PipelineParams
+    from quickwit_tpu.indexing.sources import VecSource
+    from quickwit_tpu.metastore.base import ListSplitsQuery
+    from quickwit_tpu.metastore.file_backed import FileBackedMetastore
+    from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+    from quickwit_tpu.models.index_metadata import IndexConfig, IndexMetadata
+    from quickwit_tpu.models.split_metadata import SplitState
+    from quickwit_tpu.storage import RamStorage
+
+    mapper = DocMapper(field_mappings=[
+        FieldMapping("tenant_id", FieldType.TEXT, tokenizer="raw"),
+        FieldMapping("ts", FieldType.DATETIME, fast=True,
+                     input_formats=("unix_timestamp",))],
+        timestamp_field="ts", partition_key="tenant_id",
+        max_num_partitions=2)
+    storage = RamStorage(Uri.parse("ram:///routing3"))
+    metastore = FileBackedMetastore(
+        RamStorage(Uri.parse("ram:///routing3-ms")))
+    metastore.create_index(IndexMetadata(
+        index_uid="t:1", index_config=IndexConfig(
+            index_id="t", index_uri="ram:///routing3", doc_mapper=mapper)))
+    # two invalid docs (missing ts) with distinct keys, then two valid
+    # docs with two new keys: the invalid ones must not eat the budget
+    docs = ([{"tenant_id": f"bad{i}"} for i in range(2)]
+            + [{"tenant_id": f"ok{i}", "ts": 1_600_000_000} for i in range(2)])
+    pipeline = IndexingPipeline(
+        PipelineParams(index_uid="t:1", source_id="vec"),
+        mapper, VecSource(docs), metastore, storage)
+    counters = pipeline.run_to_completion()
+    assert counters.num_docs_invalid == 2
+    splits = metastore.list_splits(ListSplitsQuery(
+        index_uids=["t:1"], states=[SplitState.PUBLISHED]))
+    assert len(splits) == 2  # each valid key got its own partition
+    assert IndexingPipeline.OTHER_PARTITION not in {
+        s.metadata.partition_id for s in splits}
+
+
+def test_partition_key_validated_at_index_creation():
+    from quickwit_tpu.serve.node import _validate_doc_mapping
+    from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+
+    bad = DocMapper(field_mappings=[
+        FieldMapping("tenant_id", FieldType.TEXT)],
+        partition_key="tennant_id")
+    with pytest.raises(ValueError, match="unknown field"):
+        _validate_doc_mapping(bad)
+    ok = DocMapper(field_mappings=[
+        FieldMapping("tenant_id", FieldType.TEXT)],
+        partition_key="hash_mod(tenant_id, 7)")
+    _validate_doc_mapping(ok)
+    malformed = DocMapper(field_mappings=[], partition_key="tenant_id")
+    malformed.partition_key = "hash_mod(,"
+    with pytest.raises(ValueError, match="invalid partition_key|unknown"):
+        _validate_doc_mapping(malformed)
