@@ -52,6 +52,7 @@ class PipelineCounters:
     num_docs_invalid: int = 0
     num_splits_published: int = 0
     num_published_docs: int = 0
+    num_published_bytes: int = 0  # uncompressed (cooperative metrics)
 
 
 class IndexingPipeline:
@@ -180,6 +181,8 @@ class IndexingPipeline:
         for metadata, _ in staged:
             self.counters.num_splits_published += 1
             self.counters.num_published_docs += metadata.num_docs
+            self.counters.num_published_bytes += \
+                metadata.uncompressed_docs_size_bytes
             logger.info("published split %s (%d docs, partition %d)",
                         metadata.split_id, metadata.num_docs,
                         metadata.partition_id)

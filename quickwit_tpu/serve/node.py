@@ -77,6 +77,12 @@ class NodeConfig:
     # QW_ENABLE_OPENTELEMETRY_OTLP_EXPORTER there): export the node's own
     # request spans into its own otel-traces index
     self_tracing: bool = False
+    # cooperative indexing (reference cooperative_indexing.rs): WAL-drain
+    # pipelines take phase-spread turns over each index's commit window,
+    # at most max_concurrent_pipelines building splits at once. Off by
+    # default: every tick drains every index immediately.
+    cooperative_indexing: bool = False
+    max_concurrent_pipelines: int = 3
 
     @property
     def tls_enabled(self) -> bool:
@@ -111,17 +117,16 @@ def _validate_doc_mapping(doc_mapper: DocMapper) -> None:
                 f"i64 field (got {fm.type.value}"
                 f"{'/' + fm.tokenizer if fm.type is FieldType.TEXT else ''})")
     if doc_mapper.partition_key:
-        from ..models.routing_expression import (RoutingExpr,
-                                                 RoutingExprError)
-        try:
-            expr = RoutingExpr(doc_mapper.partition_key)
-        except RoutingExprError as exc:
-            raise ValueError(f"invalid partition_key: {exc}")
-        for field in expr.field_names():
-            if doc_mapper.field(field) is None \
-                    and doc_mapper.mode != "dynamic":
-                # a typo'd key would silently collapse every doc into the
-                # single "absent" partition
+        # malformed expressions already raised RoutingExprError (a
+        # ValueError → 400) in DocMapper.__post_init__; here we only
+        # catch typos that can never resolve. Routing evaluates on the
+        # RAW doc, so lenient/dynamic modes and subpaths of mapped JSON
+        # fields resolve at runtime — only strict mode pins the schema.
+        for field in doc_mapper._routing_expr.field_names():
+            root = field.split(".")[0]
+            known_root = any(fm.name == root or fm.name.startswith(root + ".")
+                             for fm in doc_mapper.field_mappings)
+            if doc_mapper.mode == "strict" and not known_root:
                 raise ValueError(
                     f"partition_key references unknown field `{field}`")
     for field in doc_mapper.default_search_fields:
@@ -164,10 +169,17 @@ class IndexService:
         _validate_doc_mapping(doc_mapper)
         index_uri = index_config_json.get(
             "index_uri", f"{self.default_index_root_uri}/{index_id}")
+        commit_timeout = index_config_json.get(
+            "indexing_settings", {}).get("commit_timeout_secs", 60)
+        if not isinstance(commit_timeout, (int, float)) \
+                or commit_timeout <= 0:
+            # cooperative indexing divides by this; a zero would halt the
+            # node's whole WAL-drain loop
+            raise ValueError(
+                f"commit_timeout_secs must be positive, got {commit_timeout!r}")
         config = IndexConfig(
             index_id=index_id, index_uri=index_uri, doc_mapper=doc_mapper,
-            commit_timeout_secs=index_config_json.get(
-                "indexing_settings", {}).get("commit_timeout_secs", 60),
+            commit_timeout_secs=commit_timeout,
             split_num_docs_target=index_config_json.get(
                 "indexing_settings", {}).get("split_num_docs_target", 10_000_000),
             merge_policy=index_config_json.get(
@@ -276,6 +288,13 @@ class Node:
         self.scroll_store = ScrollStore()
         from .otel import OtelService
         self.otel = OtelService(self)
+        # cooperative indexing state (shared across every index pipeline)
+        self._coop_permits = threading.Semaphore(
+            max(1, config.max_concurrent_pipelines))
+        self._coop_cycles: dict[str, Any] = {}
+        self._coop_next_wake: dict[str, float] = {}
+        self._coop_clock = time.monotonic  # tests swap in a virtual clock
+        self.pipeline_metrics: dict[str, Any] = {}
         self.span_exporter = None
         self._ensure_span_exporter()
 
@@ -550,7 +569,39 @@ class Node:
                 self.ingester.truncate(uid, INGEST_V2_SOURCE_ID,
                                        shard.shard_id, int(position))
         return {"num_docs_indexed": counters.num_docs_processed,
-                "num_splits_published": counters.num_splits_published}
+                "num_splits_published": counters.num_splits_published,
+                "uncompressed_bytes": counters.num_published_bytes}
+
+    def _cooperative_drain(self, metadata: IndexMetadata) -> None:
+        """One cooperative-indexing turn for an index's WAL pipeline
+        (reference cooperative_indexing.rs): drain only at this
+        pipeline's phase of the commit window, under the node-wide
+        concurrency permit; the post-work sleep re-phases the cycle."""
+        from ..indexing.cooperative import CooperativeIndexingCycle
+        uid = metadata.index_uid
+        now = self._coop_clock()
+        cycle = self._coop_cycles.get(uid)
+        if cycle is None:
+            cycle = CooperativeIndexingCycle(
+                uid, metadata.index_config.commit_timeout_secs,
+                self._coop_permits, clock=self._coop_clock)
+            self._coop_cycles[uid] = cycle
+            self._coop_next_wake[uid] = now + cycle.initial_sleep_duration()
+        if now < self._coop_next_wake[uid]:
+            return
+        # never block the shared tick loop on the semaphore: a full house
+        # means another pipeline is indexing — retry next tick
+        period = cycle.begin_period(timeout=0.001)
+        if period is None:
+            return
+        published_bytes = 0
+        try:
+            result = self.run_ingest_pass(metadata.index_id)
+            published_bytes = int(result.get("uncompressed_bytes", 0))
+        finally:
+            sleep_secs, metrics = period.end_of_work(published_bytes)
+            self._coop_next_wake[uid] = self._coop_clock() + sleep_secs
+            self.pipeline_metrics[uid] = metrics
 
     def schedule_indexing(self) -> "Any":
         """Control-plane convergence pass: logical tasks from metastore
@@ -828,10 +879,22 @@ class Node:
             # failover: adopt replica shards whose leader died before
             # draining (checkpoints continue at the same positions)
             self.promote_orphaned_replicas()
+            live_uids = set()
             for metadata in self.metastore.list_indexes():
+                live_uids.add(metadata.index_uid)
                 shards = self.ingester.list_shards(metadata.index_uid)
                 if any(s.log.next_position > s.publish_position for s in shards):
-                    self.run_ingest_pass(metadata.index_id)
+                    if self.config.cooperative_indexing:
+                        self._cooperative_drain(metadata)
+                    else:
+                        self.run_ingest_pass(metadata.index_id)
+            # deleted indexes release their cooperative state (index
+            # churn must not grow these dicts forever)
+            for state in (self._coop_cycles, self._coop_next_wake,
+                          self.pipeline_metrics):
+                for uid in list(state):
+                    if uid not in live_uids:
+                        del state[uid]
 
         def merge_tick() -> None:
             if "indexer" not in self.config.roles:

@@ -1,0 +1,140 @@
+"""Cooperative indexing (reference `cooperative_indexing.rs`): phase
+spreading, the concurrency semaphore, sleep-time steering, and the node's
+WAL-drain wiring — all on a virtual clock."""
+
+import threading
+
+import pytest
+
+from quickwit_tpu.indexing.cooperative import (
+    NUDGE_TOLERANCE_SECS, CooperativeIndexingCycle)
+
+
+class VirtualClock:
+    def __init__(self, start: float = 0.0):
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+
+def _cycle(pipeline_id="p1", commit_timeout=60.0, permits=None, clock=None):
+    return CooperativeIndexingCycle(
+        pipeline_id, commit_timeout,
+        permits if permits is not None else threading.Semaphore(3),
+        clock=clock or VirtualClock())
+
+
+def test_target_phase_spreads_uniformly():
+    phases = [_cycle(f"pipeline-{i}").target_phase for i in range(100)]
+    assert all(0 <= p < 60.0 for p in phases)
+    # a uniform spread: all four quarters of the window are populated
+    quarters = {int(p // 15) for p in phases}
+    assert quarters == {0, 1, 2, 3}
+    # deterministic per id
+    assert _cycle("a").target_phase == _cycle("a").target_phase
+    assert _cycle("a").target_phase != _cycle("b").target_phase
+
+
+def test_ideal_cycle_period_is_commit_timeout():
+    clock = VirtualClock()
+    cycle = _cycle(clock=clock)
+    # the phase steers where work ENDS (the commit instant): start 10s
+    # early so the 10s work period ends exactly on phase
+    clock.now = (cycle.target_phase - 10.0) % 60.0
+    period = cycle.begin_period()
+    clock.now += 10.0               # work for 10s, ending on phase
+    sleep, metrics = period.end_of_work(50_000_000)
+    # on-phase commit: no nudge, sleep = commit_timeout - work
+    assert sleep == pytest.approx(50.0, abs=0.01)
+    assert 0 < metrics.cpu_load_mcpu <= 4000
+    assert metrics.throughput_mb_per_sec > 0
+
+
+def test_sleep_nudges_toward_target_phase():
+    clock = VirtualClock()
+    cycle = _cycle(clock=clock)
+    # wake 20s AFTER the phase: the sleep shortens by the full nudge
+    clock.now = cycle.target_phase + 20.0
+    period = cycle.begin_period()
+    clock.now += 1.0
+    sleep, _ = period.end_of_work(0)
+    assert sleep == pytest.approx(60.0 - 1.0 - NUDGE_TOLERANCE_SECS,
+                                  abs=0.01)
+    # wake 20s BEFORE the phase: the sleep lengthens by the full nudge
+    clock.now = cycle.target_phase + 60.0 - 20.0
+    period = cycle.begin_period()
+    clock.now += 1.0
+    sleep, _ = period.end_of_work(0)
+    assert sleep == pytest.approx(60.0 - 1.0 + NUDGE_TOLERANCE_SECS,
+                                  abs=0.01)
+
+
+def test_overlong_work_never_sleeps_negative():
+    clock = VirtualClock()
+    cycle = _cycle(clock=clock)
+    period = cycle.begin_period()
+    clock.now += 75.0  # longer than the whole window
+    sleep, metrics = period.end_of_work(0)
+    assert sleep == 0.0
+    assert metrics.cpu_load_mcpu == 4000  # saturated
+
+
+def test_semaphore_bounds_concurrent_periods():
+    permits = threading.Semaphore(2)
+    clock = VirtualClock()
+    cycles = [_cycle(f"p{i}", permits=permits, clock=clock)
+              for i in range(3)]
+    p1 = cycles[0].begin_period(timeout=0.001)
+    p2 = cycles[1].begin_period(timeout=0.001)
+    assert p1 is not None and p2 is not None
+    assert cycles[2].begin_period(timeout=0.001) is None  # house full
+    p1.end_of_work(0)
+    assert cycles[2].begin_period(timeout=0.001) is not None
+
+
+def test_initial_sleep_lands_on_phase():
+    clock = VirtualClock(start=7.0)
+    cycle = _cycle(clock=clock)
+    sleep = cycle.initial_sleep_duration()
+    landed = (clock.now + sleep) % 60.0
+    # either lands on the phase or was already within nudge range of it
+    assert (abs(landed - cycle.target_phase) < 0.01) or sleep == 0.0
+
+
+def test_node_cooperative_drain_phases_and_metrics():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from quickwit_tpu.serve import Node, NodeConfig
+    from quickwit_tpu.storage import StorageResolver
+
+    node = Node(NodeConfig(node_id="coop", rest_port=0,
+                           metastore_uri="ram:///coop/ms",
+                           default_index_root_uri="ram:///coop/idx",
+                           cooperative_indexing=True),
+                storage_resolver=StorageResolver.for_test())
+    clock = VirtualClock(start=100.0)
+    node._coop_clock = clock
+    node.index_service.create_index({
+        "version": "0.8", "index_id": "logs",
+        "doc_mapping": {"field_mappings": [
+            {"name": "body", "type": "text"}]},
+        "indexing_settings": {"commit_timeout_secs": 60}})
+    node.ingest_v2("logs", [{"body": f"doc {i}"} for i in range(5)])
+    metadata = node.metastore.index_metadata("logs")
+
+    # first call establishes the cycle and (usually) defers to the phase
+    node._cooperative_drain(metadata)
+    uid = metadata.index_uid
+    assert uid in node._coop_cycles
+    # advance past the scheduled wake: the drain must happen
+    clock.now = node._coop_next_wake[uid] + 0.01
+    node._cooperative_drain(metadata)
+    assert node.pipeline_metrics[uid].cpu_load_mcpu >= 0
+    from quickwit_tpu.query.ast import MatchAll
+    from quickwit_tpu.search.models import SearchRequest
+    result = node.root_searcher.search(SearchRequest(
+        index_ids=["logs"], query_ast=MatchAll(), max_hits=10))
+    assert result.num_hits == 5
+    # immediately after: re-phased a full window out, so no double drain
+    assert node._coop_next_wake[uid] > clock.now + 50.0

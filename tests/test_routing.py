@@ -204,16 +204,22 @@ def test_partition_key_validated_at_index_creation():
     from quickwit_tpu.serve.node import _validate_doc_mapping
     from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
 
+    # strict mode pins the schema: a typo'd key fails fast
     bad = DocMapper(field_mappings=[
         FieldMapping("tenant_id", FieldType.TEXT)],
-        partition_key="tennant_id")
+        partition_key="tennant_id", mode="strict")
     with pytest.raises(ValueError, match="unknown field"):
         _validate_doc_mapping(bad)
+    # lenient mode routes on the RAW doc, so unmapped keys are legal
+    lenient = DocMapper(field_mappings=[
+        FieldMapping("tenant_id", FieldType.TEXT)],
+        partition_key="attributes.tenant")
+    _validate_doc_mapping(lenient)
     ok = DocMapper(field_mappings=[
         FieldMapping("tenant_id", FieldType.TEXT)],
         partition_key="hash_mod(tenant_id, 7)")
     _validate_doc_mapping(ok)
-    malformed = DocMapper(field_mappings=[], partition_key="tenant_id")
-    malformed.partition_key = "hash_mod(,"
-    with pytest.raises(ValueError, match="invalid partition_key|unknown"):
-        _validate_doc_mapping(malformed)
+    # malformed expressions raise from DocMapper construction itself
+    # (RoutingExprError is a ValueError -> HTTP 400)
+    with pytest.raises(ValueError):
+        DocMapper(field_mappings=[], partition_key="hash_mod(,")
