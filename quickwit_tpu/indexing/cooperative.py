@@ -60,8 +60,10 @@ class CooperativeIndexingCycle:
         self.origin = 0.0 if origin is None else origin
         digest = hashlib.blake2b(pipeline_id.encode(),
                                  digest_size=8).digest()
+        # max(…, 1): sub-millisecond windows must not modulo by zero
+        window_millis = max(int(self.commit_timeout * 1000), 1)
         self.target_phase = (int.from_bytes(digest, "little")
-                             % int(self.commit_timeout * 1000)) / 1000.0
+                             % window_millis) / 1000.0
 
     def initial_sleep_duration(self) -> float:
         """Sleep that puts the FIRST period near the target phase."""

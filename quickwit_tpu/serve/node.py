@@ -83,6 +83,9 @@ class NodeConfig:
     # default: every tick drains every index immediately.
     cooperative_indexing: bool = False
     max_concurrent_pipelines: int = 3
+    # standalone compactor role: bounded concurrent merge executions
+    # (reference compactor_supervisor.rs slots)
+    max_concurrent_merges: int = 2
 
     @property
     def tls_enabled(self) -> bool:
@@ -122,13 +125,21 @@ def _validate_doc_mapping(doc_mapper: DocMapper) -> None:
         # catch typos that can never resolve. Routing evaluates on the
         # RAW doc, so lenient/dynamic modes and subpaths of mapped JSON
         # fields resolve at runtime — only strict mode pins the schema.
-        for field in doc_mapper._routing_expr.field_names():
-            root = field.split(".")[0]
-            known_root = any(fm.name == root or fm.name.startswith(root + ".")
-                             for fm in doc_mapper.field_mappings)
-            if doc_mapper.mode == "strict" and not known_root:
-                raise ValueError(
-                    f"partition_key references unknown field `{field}`")
+        if doc_mapper.mode == "strict":
+            from ..models.doc_mapper import FieldType
+            for field in doc_mapper._routing_expr.field_names():
+                if doc_mapper.field(field) is not None:
+                    continue
+                # subpaths of a mapped JSON field hold arbitrary keys
+                # even under strict mode; everything else is a typo
+                parts = field.split(".")
+                json_ancestor = any(
+                    (fm := doc_mapper.field(".".join(parts[:i])))
+                    is not None and fm.type is FieldType.JSON
+                    for i in range(1, len(parts)))
+                if not json_ancestor:
+                    raise ValueError(
+                        f"partition_key references unknown field `{field}`")
     for field in doc_mapper.default_search_fields:
         fm = doc_mapper.field(field)
         if fm is None:
@@ -288,6 +299,18 @@ class Node:
         self.scroll_store = ScrollStore()
         from .otel import OtelService
         self.otel = OtelService(self)
+        # standalone compactor role (reference quickwit-compaction):
+        # planner + bounded supervisor; when any alive compactor exists,
+        # indexers stop running merges themselves
+        self.compactor = None
+        self.compaction_planner = None
+        if "compactor" in config.roles:
+            from ..compaction import CompactionPlanner, CompactorSupervisor
+            self.compactor = CompactorSupervisor(
+                self.metastore, self.storage_resolver,
+                node_id=config.node_id,
+                max_concurrent_merges=config.max_concurrent_merges)
+            self.compaction_planner = CompactionPlanner(self.metastore)
         # cooperative indexing state (shared across every index pipeline)
         self._coop_permits = threading.Semaphore(
             max(1, config.max_concurrent_pipelines))
@@ -690,6 +713,39 @@ class Node:
         return actions
 
     # ------------------------------------------------------------------
+    def run_compaction_pass(self, synchronous: bool = False) -> int:
+        """One compactor tick (reference compaction_planner tick +
+        supervisor dispatch): plan merges for the indexes this compactor
+        owns (rendezvous over alive compactor nodes) and submit them up
+        to the supervisor's free slots. Returns tasks submitted."""
+        from ..common.rendezvous import sort_by_rendezvous_hash
+        if self.compactor is None or self.compaction_planner is None:
+            return 0
+        compactors = self.cluster.nodes_with_role("compactor") \
+            or [self.config.node_id]
+        owned = [m.index_uid for m in self.metastore.list_indexes()
+                 if sort_by_rendezvous_hash(m.index_uid, compactors)[0]
+                 == self.config.node_id]
+        if not owned:
+            return 0
+        slots = self.compactor.available_slots()
+        if slots == 0:
+            return 0
+        planner = self.compaction_planner
+
+        def on_done(task, ok):
+            (planner.complete_task if ok else planner.fail_task)(
+                task.task_id)
+
+        submitted = 0
+        for task in planner.plan(index_uids=owned, max_tasks=slots):
+            if self.compactor.submit(task, on_done=on_done,
+                                     synchronous=synchronous):
+                submitted += 1
+            else:
+                planner.fail_task(task.task_id)  # slot raced away
+        return submitted
+
     def run_merges(self, index_id: str) -> int:
         """One merge-planner pass (role of MergePlanner + MergePipeline)."""
         metadata = self.metastore.index_metadata(index_id)
@@ -897,7 +953,15 @@ class Node:
                         del state[uid]
 
         def merge_tick() -> None:
+            # compactor nodes own merging when present; indexers merge
+            # only in clusters WITHOUT compactors (reference: the
+            # standalone compactor role takes merge work off indexers)
+            if self.compactor is not None:
+                self.run_compaction_pass()
+                return
             if "indexer" not in self.config.roles:
+                return
+            if self.cluster.nodes_with_role("compactor"):
                 return
             for metadata in self.metastore.list_indexes():
                 if owns_index(metadata.index_uid):

@@ -1,0 +1,138 @@
+"""Standalone compactor role (reference quickwit-compaction): planner
+in-flight claims, supervisor slots + drain lifecycle, and the node-level
+role split (indexers stop merging when a compactor exists)."""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+from quickwit_tpu.compaction import (CompactionPlanner, CompactorState,
+                                     CompactorSupervisor)
+from quickwit_tpu.serve import Node, NodeConfig
+from quickwit_tpu.storage import StorageResolver
+
+
+def _node(node_id="n0", roles=("searcher", "indexer", "metastore",
+                               "control_plane"), ns="comp", **kwargs):
+    return Node(NodeConfig(node_id=node_id, roles=tuple(roles), rest_port=0,
+                           metastore_uri=f"ram:///{ns}/ms",
+                           default_index_root_uri=f"ram:///{ns}/idx",
+                           **kwargs),
+                storage_resolver=StorageResolver.for_test())
+
+
+def _make_index(node, index_id="logs", merge_factor=2):
+    node.index_service.create_index({
+        "version": "0.8", "index_id": index_id,
+        "doc_mapping": {"field_mappings": [
+            {"name": "body", "type": "text"}]},
+        "indexing_settings": {
+            "merge_policy": {"type": "stable_log",
+                             "merge_factor": merge_factor,
+                             "max_merge_factor": merge_factor,
+                             "min_level_num_docs": 100}}})
+    return node.metastore.index_metadata(index_id)
+
+
+def _publish_small_splits(node, index_id, count):
+    for i in range(count):
+        node.ingest(index_id, [{"body": f"doc {i} alpha"}])
+
+
+def test_planner_claims_and_excludes_in_flight():
+    node = _node(ns="plan1")
+    _make_index(node)
+    _publish_small_splits(node, "logs", 2)
+    planner = CompactionPlanner(node.metastore)
+    tasks = planner.plan()
+    assert len(tasks) == 1
+    assert len(tasks[0].split_ids) == 2
+    # a second tick with the task in flight plans nothing
+    assert planner.plan() == []
+    planner.complete_task(tasks[0].task_id)
+    # splits unchanged (nothing merged them): re-plans the same merge
+    assert len(planner.plan()) == 1
+
+
+def test_planner_timeout_releases_claims():
+    clock_now = [0.0]
+    node = _node(ns="plan2")
+    _make_index(node)
+    _publish_small_splits(node, "logs", 2)
+    planner = CompactionPlanner(node.metastore, task_timeout_secs=100,
+                                clock=lambda: clock_now[0])
+    assert len(planner.plan()) == 1
+    assert planner.plan() == []
+    clock_now[0] = 101.0  # the stuck worker's claim expires
+    assert len(planner.plan()) == 1
+
+
+def test_supervisor_executes_merge_and_reports():
+    node = _node(ns="sup1")
+    _make_index(node)
+    _publish_small_splits(node, "logs", 2)
+    planner = CompactionPlanner(node.metastore)
+    supervisor = CompactorSupervisor(node.metastore, node.storage_resolver,
+                                     max_concurrent_merges=1)
+    [task] = planner.plan()
+    done = []
+    assert supervisor.submit(task, on_done=lambda t, ok: done.append(ok),
+                             synchronous=True)
+    assert done == [True]
+    assert supervisor.num_completed == 1
+    from quickwit_tpu.metastore.base import ListSplitsQuery
+    from quickwit_tpu.models.split_metadata import SplitState
+    published = node.metastore.list_splits(ListSplitsQuery(
+        index_uids=[task.index_uid], states=[SplitState.PUBLISHED]))
+    assert len(published) == 1  # 2 merged into 1
+    assert published[0].metadata.num_docs == 2
+
+
+def test_supervisor_slots_and_drain():
+    node = _node(ns="sup2")
+    supervisor = CompactorSupervisor(node.metastore, node.storage_resolver,
+                                     max_concurrent_merges=2)
+    assert supervisor.available_slots() == 2
+    assert supervisor.state is CompactorState.RUNNING
+    assert supervisor.decommission(timeout=1.0)
+    assert supervisor.state is CompactorState.DRAINED
+    assert supervisor.available_slots() == 0
+    # drained supervisors reject work
+    from quickwit_tpu.compaction import MergeTask
+    assert not supervisor.submit(MergeTask("t", "uid", ("a", "b")))
+
+
+def test_stale_task_inputs_are_skipped():
+    node = _node(ns="sup3")
+    _make_index(node)
+    _publish_small_splits(node, "logs", 2)
+    planner = CompactionPlanner(node.metastore)
+    supervisor = CompactorSupervisor(node.metastore, node.storage_resolver)
+    [task] = planner.plan()
+    # someone else merges first (an indexer before role handoff)
+    node.run_merges("logs")
+    assert supervisor.submit(task, synchronous=True)
+    assert supervisor.num_failed == 1  # skipped, not crashed
+    assert supervisor.num_completed == 0
+
+
+def test_node_compactor_role_takes_over_merging():
+    node = _node(ns="role1",
+                 roles=("searcher", "indexer", "metastore",
+                        "control_plane", "compactor"))
+    assert node.compactor is not None
+    _make_index(node)
+    _publish_small_splits(node, "logs", 4)
+    submitted = node.run_compaction_pass(synchronous=True)
+    assert submitted >= 1
+    from quickwit_tpu.metastore.base import ListSplitsQuery
+    from quickwit_tpu.models.split_metadata import SplitState
+    published = node.metastore.list_splits(ListSplitsQuery(
+        index_uids=[node.metastore.index_metadata("logs").index_uid],
+        states=[SplitState.PUBLISHED]))
+    # 4 splits pairwise merged (merge_factor=2) into 2
+    assert len(published) == 2
+    assert all(s.metadata.num_docs == 2 for s in published)
+    assert node.compactor.num_completed >= 1
