@@ -93,11 +93,16 @@ class CompactionPlanner:
 
     # -- planning ------------------------------------------------------
     def plan(self, index_uids: Optional[list[str]] = None,
-             max_tasks: Optional[int] = None) -> list[MergeTask]:
-        """One planning tick → new merge tasks (claims recorded)."""
+             max_tasks: Optional[int] = None,
+             indexes: Optional[list] = None) -> list[MergeTask]:
+        """One planning tick → new merge tasks (claims recorded).
+        `indexes` short-circuits the metastore scan when the caller
+        already fetched the metadata (the node's tick does)."""
         claimed = self._claimed_split_ids()
         tasks: list[MergeTask] = []
-        for metadata in self.metastore.list_indexes():
+        if indexes is None:
+            indexes = self.metastore.list_indexes()
+        for metadata in indexes:
             if index_uids is not None and \
                     metadata.index_uid not in index_uids:
                 continue

@@ -126,18 +126,22 @@ def _validate_doc_mapping(doc_mapper: DocMapper) -> None:
         # RAW doc, so lenient/dynamic modes and subpaths of mapped JSON
         # fields resolve at runtime — only strict mode pins the schema.
         if doc_mapper.mode == "strict":
-            from ..models.doc_mapper import FieldType
             for field in doc_mapper._routing_expr.field_names():
                 if doc_mapper.field(field) is not None:
                     continue
-                # subpaths of a mapped JSON field hold arbitrary keys
-                # even under strict mode; everything else is a typo
                 parts = field.split(".")
+                # subpaths of a mapped JSON field hold arbitrary keys
+                # even under strict mode; a PARENT path of concretely
+                # mapped fields ("resource" over "resource.service")
+                # also resolves at runtime (routing hashes the object)
                 json_ancestor = any(
                     (fm := doc_mapper.field(".".join(parts[:i])))
                     is not None and fm.type is FieldType.JSON
                     for i in range(1, len(parts)))
-                if not json_ancestor:
+                mapped_descendant = any(
+                    fm.name.startswith(field + ".")
+                    for fm in doc_mapper.field_mappings)
+                if not json_ancestor and not mapped_descendant:
                     raise ValueError(
                         f"partition_key references unknown field `{field}`")
     for field in doc_mapper.default_search_fields:
@@ -713,6 +717,17 @@ class Node:
         return actions
 
     # ------------------------------------------------------------------
+    def advertised_roles(self) -> tuple[str, ...]:
+        """Roles this node advertises to peers: a draining/drained
+        compactor withdraws the role so indexers resume merging and
+        other compactors take over its rendezvous ownership."""
+        from ..compaction import CompactorState
+        roles = self.config.roles
+        if (self.compactor is not None
+                and self.compactor.state is not CompactorState.RUNNING):
+            roles = tuple(r for r in roles if r != "compactor")
+        return roles
+
     def run_compaction_pass(self, synchronous: bool = False) -> int:
         """One compactor tick (reference compaction_planner tick +
         supervisor dispatch): plan merges for the indexes this compactor
@@ -723,7 +738,8 @@ class Node:
             return 0
         compactors = self.cluster.nodes_with_role("compactor") \
             or [self.config.node_id]
-        owned = [m.index_uid for m in self.metastore.list_indexes()
+        indexes = self.metastore.list_indexes()
+        owned = [m for m in indexes
                  if sort_by_rendezvous_hash(m.index_uid, compactors)[0]
                  == self.config.node_id]
         if not owned:
@@ -738,7 +754,7 @@ class Node:
                 task.task_id)
 
         submitted = 0
-        for task in planner.plan(index_uids=owned, max_tasks=slots):
+        for task in planner.plan(max_tasks=slots, indexes=owned):
             if self.compactor.submit(task, on_done=on_done,
                                      synchronous=synchronous):
                 submitted += 1
@@ -955,13 +971,23 @@ class Node:
         def merge_tick() -> None:
             # compactor nodes own merging when present; indexers merge
             # only in clusters WITHOUT compactors (reference: the
-            # standalone compactor role takes merge work off indexers)
-            if self.compactor is not None:
+            # standalone compactor role takes merge work off indexers).
+            # A draining/drained compactor neither merges nor counts —
+            # it stops advertising the role (advertised_roles), so
+            # indexers resume merging rather than stall forever.
+            from ..compaction import CompactorState
+            if (self.compactor is not None
+                    and self.compactor.state is CompactorState.RUNNING):
                 self.run_compaction_pass()
                 return
             if "indexer" not in self.config.roles:
                 return
-            if self.cluster.nodes_with_role("compactor"):
+            # REMOTE compactors own merging (a drained one stops
+            # advertising the role on its next heartbeat); our own
+            # non-running compactor never counts
+            others = [n for n in self.cluster.nodes_with_role("compactor")
+                      if n != self.config.node_id]
+            if others:
                 return
             for metadata in self.metastore.list_indexes():
                 if owns_index(metadata.index_uid):
@@ -1003,7 +1029,7 @@ class Node:
 
         def heartbeat_tick() -> None:
             payload = {"node_id": self.config.node_id,
-                       "roles": list(self.config.roles),
+                       "roles": list(self.advertised_roles()),
                        "rest_endpoint":
                            f"{self.config.rest_host}:{self.config.rest_port}"}
             peers = set(self.config.peers)

@@ -313,6 +313,22 @@ class RestServer:
                          "rest_endpoint": f"{self.host}:{self.port}"}
 
         # --- developer / debug ----------------------------------------
+        if path == "/api/v1/developer/pprof/flamegraph" and method == "GET":
+            # on-demand CPU profile (reference developer_api/pprof.rs:167):
+            # sample every thread for `duration` seconds at `hz`, render a
+            # self-contained SVG (or ?format=collapsed for raw stacks)
+            from ..observability.profiler import (collapse, render_svg,
+                                                  sample_stacks)
+            duration = min(float(params.get("duration", 2.0)), 30.0)
+            hz = min(float(params.get("hz", 100.0)), 1000.0)
+            counts = sample_stacks(duration_secs=duration, hz=hz)
+            if params.get("format") == "collapsed":
+                return 200, ("__raw__", collapse(counts).encode(),
+                             "text/plain; charset=utf-8")
+            svg = render_svg(counts,
+                             title=f"{node.config.node_id} CPU profile "
+                                   f"({duration:g}s @ {hz:g}Hz)")
+            return 200, ("__raw__", svg.encode(), "image/svg+xml")
         if path == "/api/v1/developer/debug":
             import sys as _sys
             import traceback

@@ -136,3 +136,17 @@ def test_node_compactor_role_takes_over_merging():
     assert len(published) == 2
     assert all(s.metadata.num_docs == 2 for s in published)
     assert node.compactor.num_completed >= 1
+
+
+def test_drained_compactor_withdraws_role_and_indexers_resume():
+    node = _node(ns="role2",
+                 roles=("searcher", "indexer", "metastore",
+                        "control_plane", "compactor"))
+    assert "compactor" in node.advertised_roles()
+    node.compactor.decommission(timeout=1.0)
+    assert "compactor" not in node.advertised_roles()
+    # with its own compactor drained and no remote ones, the node's
+    # indexer-side merging still works
+    _make_index(node, index_id="logs2")
+    _publish_small_splits(node, "logs2", 2)
+    assert node.run_merges("logs2") == 1

@@ -1,0 +1,95 @@
+"""Statistical profiler + flamegraph (reference developer_api/pprof.rs)."""
+
+import threading
+import time
+
+from quickwit_tpu.observability.profiler import (collapse, render_svg,
+                                                 sample_stacks)
+
+
+def _busy_loop(stop):
+    while not stop.is_set():
+        sum(i * i for i in range(500))
+
+
+def test_sampler_catches_busy_function():
+    stop = threading.Event()
+    worker = threading.Thread(target=_busy_loop, args=(stop,), daemon=True)
+    worker.start()
+    try:
+        counts = sample_stacks(duration_secs=0.4, hz=200)
+    finally:
+        stop.set()
+        worker.join(timeout=2)
+    assert sum(counts.values()) > 10
+    assert any(any("_busy_loop" in frame for frame in stack)
+               for stack in counts)
+
+
+def test_collapsed_format():
+    stop = threading.Event()
+    worker = threading.Thread(target=_busy_loop, args=(stop,), daemon=True)
+    worker.start()
+    try:
+        counts = sample_stacks(duration_secs=0.2, hz=200)
+    finally:
+        stop.set()
+        worker.join(timeout=2)
+    text = collapse(counts)
+    lines = [line for line in text.splitlines() if line]
+    assert lines
+    for line in lines:
+        frames, _, count = line.rpartition(" ")
+        assert int(count) > 0
+        assert ";" in frames or frames  # root-only stacks allowed
+
+
+def test_svg_renders_self_contained():
+    stop = threading.Event()
+    worker = threading.Thread(target=_busy_loop, args=(stop,), daemon=True)
+    worker.start()
+    try:
+        counts = sample_stacks(duration_secs=0.2, hz=200)
+    finally:
+        stop.set()
+        worker.join(timeout=2)
+    svg = render_svg(counts, title="test profile")
+    assert svg.startswith("<svg")
+    assert svg.endswith("</svg>")
+    assert "test profile" in svg
+    assert "<script" not in svg
+    assert "_busy_loop" in svg
+
+
+def test_rest_flamegraph_endpoint():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import urllib.request
+
+    from quickwit_tpu.serve import Node, NodeConfig, RestServer
+    from quickwit_tpu.storage import StorageResolver
+
+    node = Node(NodeConfig(node_id="prof", rest_port=0,
+                           metastore_uri="ram:///prof/ms",
+                           default_index_root_uri="ram:///prof/idx"),
+                storage_resolver=StorageResolver.for_test())
+    server = RestServer(node, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        stop = threading.Event()
+        worker = threading.Thread(target=_busy_loop, args=(stop,),
+                                  daemon=True)
+        worker.start()
+        url = (f"http://127.0.0.1:{server.port}/api/v1/developer/pprof/"
+               f"flamegraph?duration=0.3&hz=200")
+        with urllib.request.urlopen(url) as resp:
+            assert resp.headers["Content-Type"].startswith("image/svg")
+            body = resp.read().decode()
+        assert body.startswith("<svg")
+        with urllib.request.urlopen(url + "&format=collapsed") as resp:
+            text = resp.read().decode()
+        assert text.strip()
+        stop.set()
+        worker.join(timeout=2)
+    finally:
+        server.stop()
