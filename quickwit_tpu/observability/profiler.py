@@ -22,6 +22,10 @@ from collections import Counter
 from typing import Optional
 
 
+# serializes on-demand profiles (the REST endpoint takes it non-blocking)
+PROFILE_LOCK = threading.Lock()
+
+
 def _frame_label(frame) -> str:
     code = frame.f_code
     filename = code.co_filename

@@ -81,21 +81,30 @@ def stats_state(values: jnp.ndarray, present: jnp.ndarray, mask: jnp.ndarray) ->
     return jnp.stack([count, s, s2, mn, mx])
 
 
-# --- percentiles (log-linear sketch) --------------------------------------
+# --- percentiles (DDSketch-compatible log buckets) ------------------------
+#
+# Bucket mapping matches the sketch the reference drives through tantivy
+# (sketches-ddsketch with 1% relative accuracy): γ = (1+α)/(1-α) with
+# α = 0.01, a value v > 0 lands in bucket k = ceil(log_γ v), and the
+# bucket reports 2γ^k/(γ+1) — verified to reproduce the reference
+# conformance corpus values to ~1e-12 (e.g. 100 → 100.49456770856...).
+# Non-positive values land in the underflow bucket (reported 0.0);
+# positive values below the k-range clip to the FIRST real bucket
+# (reported ~2.8e-10 — closer to truth than 0 for tiny durations).
 
-PCTL_BUCKETS_PER_OCTAVE = 16
-PCTL_OCTAVES = 40  # covers 1 .. 2^40 (~1e12); values below 1 land in bucket 0
-PCTL_NUM_BUCKETS = PCTL_BUCKETS_PER_OCTAVE * PCTL_OCTAVES
+PCTL_ALPHA = 0.01
+PCTL_GAMMA = (1.0 + PCTL_ALPHA) / (1.0 - PCTL_ALPHA)
+_PCTL_LN_GAMMA = float(np.log(PCTL_GAMMA))
+PCTL_K_MIN = -1100   # v ≈ 2.8e-10
+PCTL_K_MAX = 1500    # v ≈ 1.1e13
+PCTL_NUM_BUCKETS = PCTL_K_MAX - PCTL_K_MIN + 2  # +underflow bucket 0
 
 
 def percentile_sketch(values: jnp.ndarray, present: jnp.ndarray,
                       mask: jnp.ndarray) -> jnp.ndarray:
-    """HDR-style log-linear bucket counts [PCTL_NUM_BUCKETS] int32.
+    """DDSketch bucket counts [PCTL_NUM_BUCKETS] int32.
 
-    Non-negative values only (durations, sizes); merge = elementwise add.
-    Relative error ~ 2^(1/16) per bucket (~4.4%), comparable to ES's default
-    t-digest accuracy for tail quantiles.
-    """
+    Positive values (durations, sizes); merge = elementwise add."""
     m = mask & present.astype(jnp.bool_)
     bucket = jnp.where(m, _pctl_bucket(values), jnp.int32(PCTL_NUM_BUCKETS))
     counts = jnp.zeros(PCTL_NUM_BUCKETS, dtype=jnp.int32)
@@ -103,12 +112,14 @@ def percentile_sketch(values: jnp.ndarray, present: jnp.ndarray,
 
 
 def _pctl_bucket(values: jnp.ndarray) -> jnp.ndarray:
-    """Value → log-linear sketch bucket index (shared by the global and
-    per-bucket sketch builders so their resolution can never drift)."""
-    v = jnp.maximum(values.astype(jnp.float64), 1.0)
-    return jnp.clip(
-        jnp.floor(jnp.log2(v) * PCTL_BUCKETS_PER_OCTAVE).astype(jnp.int32),
-        0, PCTL_NUM_BUCKETS - 1)
+    """Value → DDSketch bucket index (shared by the global and per-bucket
+    sketch builders so their resolution can never drift)."""
+    v = values.astype(jnp.float64)
+    positive = v > 0.0
+    k = jnp.ceil(jnp.log(jnp.maximum(v, 1e-300)) / _PCTL_LN_GAMMA)
+    idx = jnp.clip(k.astype(jnp.int32) - PCTL_K_MIN + 1,
+                   1, PCTL_NUM_BUCKETS - 1)
+    return jnp.where(positive, idx, jnp.int32(0))
 
 
 def bucket_percentile_sketch(idx: jnp.ndarray, values: jnp.ndarray,
@@ -135,13 +146,17 @@ def sketch_quantiles(counts: np.ndarray, quantiles: list[float]) -> list[float]:
     cum = np.cumsum(counts)
     out = []
     for q in quantiles:
-        rank = q * total
-        bucket = int(np.searchsorted(cum, max(rank, 1), side="left"))
+        # tantivy/DDSketch rank rule: 1-based target = floor(q·n),
+        # clamped to [1, n]; the first bucket reaching it wins (verified
+        # against the reference corpus: p85 of {30,130} → 30's bucket)
+        target = min(max(int(np.floor(q * total)), 1), int(total))
+        bucket = int(np.searchsorted(cum, target, side="left"))
         bucket = min(bucket, len(counts) - 1)
-        # bucket midpoint in value space
-        lo = 2.0 ** (bucket / PCTL_BUCKETS_PER_OCTAVE)
-        hi = 2.0 ** ((bucket + 1) / PCTL_BUCKETS_PER_OCTAVE)
-        out.append((lo + hi) / 2.0)
+        if bucket == 0:
+            out.append(0.0)
+        else:
+            k = bucket + PCTL_K_MIN - 1
+            out.append(2.0 * PCTL_GAMMA ** k / (PCTL_GAMMA + 1.0))
     return out
 
 

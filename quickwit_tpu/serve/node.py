@@ -718,13 +718,16 @@ class Node:
 
     # ------------------------------------------------------------------
     def advertised_roles(self) -> tuple[str, ...]:
-        """Roles this node advertises to peers: a draining/drained
-        compactor withdraws the role so indexers resume merging and
-        other compactors take over its rendezvous ownership."""
+        """Roles this node advertises to peers. A DRAINED compactor
+        withdraws the role so indexers resume merging and other
+        compactors take over its rendezvous ownership; a DRAINING one
+        keeps advertising (its in-flight merges still claim splits only
+        it knows about — letting indexers race in would duplicate
+        merges), it just plans no new work."""
         from ..compaction import CompactorState
         roles = self.config.roles
         if (self.compactor is not None
-                and self.compactor.state is not CompactorState.RUNNING):
+                and self.compactor.state is CompactorState.DRAINED):
             roles = tuple(r for r in roles if r != "compactor")
         return roles
 
@@ -972,19 +975,17 @@ class Node:
             # compactor nodes own merging when present; indexers merge
             # only in clusters WITHOUT compactors (reference: the
             # standalone compactor role takes merge work off indexers).
-            # A draining/drained compactor neither merges nor counts —
-            # it stops advertising the role (advertised_roles), so
-            # indexers resume merging rather than stall forever.
+            # DRAINING still holds the merge baton (its in-flight tasks
+            # claim splits); only DRAINED hands merging back to indexers
+            # — locally at once, remotely via withdrawn heartbeat roles.
             from ..compaction import CompactorState
-            if (self.compactor is not None
-                    and self.compactor.state is CompactorState.RUNNING):
-                self.run_compaction_pass()
-                return
+            if self.compactor is not None:
+                if self.compactor.state is CompactorState.RUNNING:
+                    self.run_compaction_pass()
+                if self.compactor.state is not CompactorState.DRAINED:
+                    return
             if "indexer" not in self.config.roles:
                 return
-            # REMOTE compactors own merging (a drained one stops
-            # advertising the role on its next heartbeat); our own
-            # non-running compactor never counts
             others = [n for n in self.cluster.nodes_with_role("compactor")
                       if n != self.config.node_id]
             if others:

@@ -316,12 +316,20 @@ class RestServer:
         if path == "/api/v1/developer/pprof/flamegraph" and method == "GET":
             # on-demand CPU profile (reference developer_api/pprof.rs:167):
             # sample every thread for `duration` seconds at `hz`, render a
-            # self-contained SVG (or ?format=collapsed for raw stacks)
-            from ..observability.profiler import (collapse, render_svg,
-                                                  sample_stacks)
+            # self-contained SVG (or ?format=collapsed for raw stacks).
+            # One profile at a time (the reference serializes too):
+            # concurrent profilers would sample each other and N×30s
+            # GIL-heavy loops are a free DoS.
+            from ..observability.profiler import (PROFILE_LOCK, collapse,
+                                                  render_svg, sample_stacks)
             duration = min(float(params.get("duration", 2.0)), 30.0)
             hz = min(float(params.get("hz", 100.0)), 1000.0)
-            counts = sample_stacks(duration_secs=duration, hz=hz)
+            if not PROFILE_LOCK.acquire(blocking=False):
+                raise ApiError(429, "a profile is already running")
+            try:
+                counts = sample_stacks(duration_secs=duration, hz=hz)
+            finally:
+                PROFILE_LOCK.release()
             if params.get("format") == "collapsed":
                 return 200, ("__raw__", collapse(counts).encode(),
                              "text/plain; charset=utf-8")

@@ -23,9 +23,6 @@ EXCLUSIONS: dict[str, str] = {
     "search_after/0001-search_after_edge_case.yaml:6":
         "exact i64 search_after comparison at the ±2^63 boundary "
         "(internal f64 sort keys round above 2^53)",
-    "aggregations/0001-aggregations.yaml:10":
-        "t-digest-exact percentile interpolation (±0.1): the fixed "
-        "log-bucket device sketch differs in the upper tail",
     "es_compatibility/0021-cat-indices.yaml:0":
         "asserts the reference's exact on-disk sizes and its startup "
         "otel index set; this engine's dense padded split format has a "
