@@ -86,6 +86,9 @@ class TermsAgg:
     size: int = 10
     min_doc_count: int = 1
     order_by_count_desc: bool = True
+    # ES terms ordering target: "_count" (default), "_key", or the name
+    # of a single-value sub-metric ("m" or "m.max" for stats fields)
+    order_target: str = "_count"
     # per-split truncation (reference/tantivy `split_size`/`shard_size`):
     # each split forwards only its top-N buckets; the merge reports
     # doc_count_error_upper_bound accordingly. None = exact.
@@ -203,12 +206,48 @@ def _parse_one(name: str, body: dict[str, Any], depth: int = 0) -> AggSpec:
             sub_metrics=sub_metrics, sub_bucket=sub_bucket)
     if kind == "terms":
         order = params.get("order", {"_count": "desc"})
+        if not isinstance(order, dict) or len(order) != 1:
+            raise AggParseError(
+                f"terms aggregation {name!r}: order must be a single-entry "
+                "map like {\"_count\": \"desc\"}")
+        order_target, order_dir = next(iter(order.items()))
+        if order_dir not in ("asc", "desc"):
+            raise AggParseError(
+                f"terms aggregation {name!r}: order direction must be "
+                "asc or desc")
+        if order_target not in ("_count", "_key"):
+            # the target must resolve to ONE value (ES rejects anything
+            # else with a 400; degrading silently would reorder wrong)
+            metric_root, _, sub_field = order_target.partition(".")
+            metric = next((m for m in sub_metrics
+                           if m.name == metric_root), None)
+            if metric is None:
+                raise AggParseError(
+                    f"terms aggregation {name!r}: order target "
+                    f"{order_target!r} is not a sub-aggregation")
+            single_value = ("avg", "min", "max", "sum", "value_count",
+                            "cardinality")
+            stats_fields = ("min", "max", "avg", "sum", "count",
+                            "sum_of_squares", "variance", "std_deviation")
+            if sub_field:
+                if metric.kind not in ("stats", "extended_stats") \
+                        or sub_field not in stats_fields:
+                    raise AggParseError(
+                        f"terms aggregation {name!r}: order target "
+                        f"{order_target!r} does not resolve to a single "
+                        "value")
+            elif metric.kind not in single_value:
+                raise AggParseError(
+                    f"terms aggregation {name!r}: ordering by "
+                    f"{metric.kind} requires a field path like "
+                    f"\"{metric_root}.max\"")
         split_size = params.get("split_size", params.get(
             "shard_size", params.get("segment_size")))
         return TermsAgg(
             name=name, field=params["field"], size=params.get("size", 10),
             min_doc_count=params.get("min_doc_count", 1),
-            order_by_count_desc=order.get("_count", "desc") == "desc",
+            order_by_count_desc=order_dir == "desc",
+            order_target=order_target,
             split_size=int(split_size) if split_size is not None else None,
             sub_metrics=sub_metrics, sub_bucket=sub_bucket)
     if kind == "range":

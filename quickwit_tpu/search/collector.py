@@ -265,7 +265,8 @@ def _carry_sub_info(copy: dict, state: dict) -> None:
         return
     copy["sub_info"] = {k: sub.get(k) for k in
                         ("name", "kind", "interval", "origin", "min_doc_count",
-                         "size", "order_desc", "extended_bounds")}
+                         "size", "order_desc", "order_target",
+                         "extended_bounds")}
     copy.pop("sub", None)
 
 
@@ -512,6 +513,23 @@ def _quantile_values(sketch, percents, keyed: bool = True):
             for p, v in zip(percents, quantiles)]
 
 
+class _KeyOrd:
+    """Typed key ordering for terms `_key` sorts (numbers before their
+    string forms never mix: a terms agg's keys share one type)."""
+
+    def __init__(self, key):
+        self.key = key
+
+    def __lt__(self, other: "_KeyOrd") -> bool:
+        a, b = self.key, other.key
+        if isinstance(a, str) or isinstance(b, str):
+            return str(a) < str(b)
+        return a < b
+
+    def __eq__(self, other) -> bool:
+        return self.key == other.key
+
+
 def _finalize_bucket_map(bucket_map: dict, info: dict[str, Any],
                          sub_info: Optional[dict] = None) -> dict[str, Any]:
     """One bucket map → ES-shaped buckets. Shared by top-level aggregations
@@ -536,7 +554,29 @@ def _finalize_bucket_map(bucket_map: dict, info: dict[str, Any],
         min_dc = 1 if min_dc is None else min_dc
         items = [(k, b) for k, b in bucket_map.items()
                  if b["doc_count"] >= min_dc]
-        if info.get("order_desc", True):
+        desc = info.get("order_desc", True)
+        target = info.get("order_target", "_count")
+        if target == "_key":
+            items.sort(key=lambda kb: _KeyOrd(kb[0]), reverse=desc)
+        elif target != "_count":
+            # order by a single-value sub-metric ("m" or "m.max"):
+            # missing/NaN metric values sort last in either direction
+            metric_name, _, sub_field = target.partition(".")
+
+            def sort_key(kb):
+                acc = kb[1]["metrics"].get(metric_name)
+                value = None
+                if acc is not None:
+                    final = _finalize_metric(acc)
+                    value = final.get(sub_field or "value")
+                    if isinstance(value, float) and np.isnan(value):
+                        value = None
+                if value is None:
+                    return (1, 0, str(kb[0]))
+                return (0, -value if desc else value, str(kb[0]))
+
+            items.sort(key=sort_key)
+        elif desc:
             items.sort(key=lambda kb: (-kb[1]["doc_count"], str(kb[0])))
         else:  # ES order {"_count": "asc"}: rarest terms first
             items.sort(key=lambda kb: (kb[1]["doc_count"], str(kb[0])))

@@ -344,7 +344,12 @@ def _intermediate_aggs(plan, agg_results: list) -> dict[str, Any]:
                 "metric_keyed": {m.name: m.keyed for m in a.metrics},
                 **a.host_info,
             }
-            if a.kind == "terms" and state.get("split_size"):
+            if (a.kind == "terms" and state.get("split_size")
+                    and state.get("order_target", "_count") == "_count"):
+                # split_size truncation keeps top-N by count — unsound
+                # under _key/metric ordering (the globally-first bucket
+                # could rank low by count in every split), so those
+                # orders forward exact per-split states instead
                 _truncate_terms_state(state)
             if a.sub is not None and "sub" in res:
                 state["sub"] = {

@@ -970,6 +970,14 @@ class Lowering:
     def _metric_tuple(self, specs: tuple[MetricAgg, ...]) -> tuple[MetricSlots, ...]:
         return tuple(self.lower_metric(m) for m in specs)
 
+    def _terms_host_info(self, spec: TermsAgg, keys) -> dict:
+        """The one terms finalization-parameter dict (four call sites)."""
+        return {"keys": keys, "size": spec.size,
+                "min_doc_count": spec.min_doc_count,
+                "order_desc": spec.order_by_count_desc,
+                "order_target": spec.order_target,
+                "split_size": spec.split_size}
+
     def _lower_terms_agg(self, spec: TermsAgg) -> Any:
         fm = self._field(spec.field)
         if not fm.fast:
@@ -1003,10 +1011,7 @@ class Lowering:
                 self.b.add_array(f"col.{spec.field}.ordinals_global", fetch_remapped),
                 -1, max(cardinality, 1),
                 metrics=self._metric_tuple(spec.sub_metrics),
-                host_info={"keys": global_keys, "size": spec.size,
-                           "min_doc_count": spec.min_doc_count,
-                           "order_desc": spec.order_by_count_desc,
-                           "split_size": spec.split_size})
+                host_info=self._terms_host_info(spec, global_keys))
         if meta.get("column_kind") == "ordinal" and meta.get("multivalued"):
             if self.batch is not None:
                 raise PlanError(
@@ -1026,10 +1031,7 @@ class Lowering:
             return BucketAggExec(
                 spec.name, "terms_mv", ords_slot, docs_slot,
                 max(len(keys), 1),
-                host_info={"keys": keys, "size": spec.size,
-                           "min_doc_count": spec.min_doc_count,
-                           "order_desc": spec.order_by_count_desc,
-                           "split_size": spec.split_size})
+                host_info=self._terms_host_info(spec, keys))
         if meta.get("column_kind") == "ordinal":
             ordinals_slot = self.b.add_array(
                 f"col.{spec.field}.ordinals", lambda: self.reader.column_ordinals(spec.field))
@@ -1037,10 +1039,7 @@ class Lowering:
             return BucketAggExec(
                 spec.name, "terms", ordinals_slot, -1, max(len(keys), 1),
                 metrics=self._metric_tuple(spec.sub_metrics),
-                host_info={"keys": keys, "size": spec.size,
-                           "min_doc_count": spec.min_doc_count,
-                           "order_desc": spec.order_by_count_desc,
-                           "split_size": spec.split_size})
+                host_info=self._terms_host_info(spec, keys))
         # numeric column: ordinalize host-side once per split (cached)
         ordinals, uniques = self._ordinalize_numeric(spec.field)
         return BucketAggExec(
@@ -1048,10 +1047,7 @@ class Lowering:
             self.b.add_array(f"col.{spec.field}.ordinals_dyn", lambda: ordinals),
             -1, max(len(uniques), 1),
             metrics=self._metric_tuple(spec.sub_metrics),
-            host_info={"keys": uniques, "size": spec.size,
-                       "min_doc_count": spec.min_doc_count,
-                       "order_desc": spec.order_by_count_desc,
-                       "split_size": spec.split_size})
+            host_info=self._terms_host_info(spec, uniques))
 
     def _lower_composite_agg(self, spec: CompositeAgg) -> CompositeAggExec:
         if self.batch is not None:

@@ -249,16 +249,19 @@ class OtelService:
                                  lower=RangeBound(min_duration_micros, True)))
         ast = Bool(must=tuple(must), filter=tuple(filters)) \
             if (must or filters) else MatchAll()
+        # device-side FindTraceIdsAggregation (reference
+        # find_trace_ids_collector.rs): a terms aggregation over the
+        # trace_id fast column ordered by max span timestamp — the
+        # dedup/top-N runs in the bucket kernels, not over fetched docs
         response = self.node.root_searcher.search(SearchRequest(
-            index_ids=[OTEL_TRACES_INDEX], query_ast=ast,
-            max_hits=limit * 10,
-            sort_fields=(SortField("span_start_timestamp", "desc"),),
+            index_ids=[OTEL_TRACES_INDEX], query_ast=ast, max_hits=0,
+            aggs={"trace_ids": {
+                "terms": {"field": "trace_id", "size": limit,
+                          "order": {"max_ts": "desc"}},
+                "aggs": {"max_ts": {
+                    "max": {"field": "span_start_timestamp"}}}}},
             start_timestamp=start_timestamp, end_timestamp=end_timestamp))
-        seen: list[str] = []
-        for hit in response.hits:
-            trace_id = hit.doc.get("trace_id")
-            if trace_id and trace_id not in seen:
-                seen.append(trace_id)
-                if len(seen) >= limit:
-                    break
-        return seen
+        buckets = (response.aggregations or {}).get(
+            "trace_ids", {}).get("buckets", [])
+        # spans ingested without a traceId bucket under "" — never a trace
+        return [b["key"] for b in buckets if b["key"]]

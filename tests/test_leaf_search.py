@@ -660,3 +660,37 @@ def test_date_histogram_offset_and_key_as_string(reader):
     assert all(int(b["key"]) % 3_600_000 == 1_800_000 for b in buckets)
     assert all(b["key_as_string"].endswith(":30:00Z") for b in buckets)
     assert sum(b["doc_count"] for b in buckets) == NUM_DOCS
+
+
+def test_terms_order_by_sub_metric(reader):
+    """ES terms `order` by a single-value sub-aggregation (the device-side
+    substrate of the Jaeger FindTraceIdsAggregation, otel.py)."""
+    resp = search(reader, max_hits=0, aggs={
+        "by_sev": {"terms": {"field": "severity_text", "size": 2,
+                             "order": {"top_latency": "desc"}},
+                   "aggs": {"top_latency": {"max": {"field": "latency"}}}}})
+    coll = IncrementalCollector(max_hits=0)
+    coll.add_leaf_response(resp)
+    out = finalize_aggregations(coll.aggregation_states())["by_sev"]
+    got = [(b["key"], b["top_latency"]["value"]) for b in out["buckets"]]
+    assert len(got) == 2
+    # brute-force expectation
+    best = {}
+    for d in DOCS:
+        sev = d["severity_text"]
+        best[sev] = max(best.get(sev, float("-inf")), d["latency"])
+    expected = sorted(best.items(), key=lambda kv: -kv[1])[:2]
+    assert [k for k, _ in got] == [k for k, _ in expected]
+    for (_, got_v), (_, exp_v) in zip(got, expected):
+        assert abs(got_v - exp_v) < 1e-6
+
+
+def test_terms_order_by_key(reader):
+    resp = search(reader, max_hits=0, aggs={
+        "by_sev": {"terms": {"field": "severity_text", "size": 10,
+                             "order": {"_key": "asc"}}}})
+    coll = IncrementalCollector(max_hits=0)
+    coll.add_leaf_response(resp)
+    out = finalize_aggregations(coll.aggregation_states())["by_sev"]
+    keys = [b["key"] for b in out["buckets"]]
+    assert keys == sorted(keys)
