@@ -53,6 +53,31 @@ def merge_splits(readers: list[SplitReader]) -> bytes:
             builder, name, readers, doc_offsets, num_docs, num_docs_padded))
     _merge_docstore(builder, readers, doc_offsets)
 
+    for name, meta in fields_meta.items():
+        # dynamic fields: union the observed value classes across inputs
+        # and retype the merged column (str coercion wins; mixed numerics
+        # promoted to f64 by _merge_numeric_column)
+        classes: set[str] = set()
+        dynamic = False
+        for r in readers:
+            rmeta = r.footer.fields.get(name, {})
+            if rmeta.get("dynamic"):
+                dynamic = True
+                classes.update(rmeta.get("value_classes", ()))
+        if not dynamic:
+            continue
+        meta["dynamic"] = True
+        meta["value_classes"] = sorted(classes)
+        kind = meta.get("column_kind")
+        if kind == "ordinal":
+            meta["col_type"] = "text"
+        elif kind == "numeric":
+            col_types = {r.footer.fields.get(name, {}).get("col_type")
+                         for r in readers
+                         if r.footer.fields.get(name, {}).get("col_type")}
+            meta["col_type"] = (col_types.pop() if len(col_types) == 1
+                                else "f64")
+
     time_ranges = [r.footer.time_range for r in readers if r.footer.time_range]
     time_range = None
     if time_ranges:
@@ -80,6 +105,9 @@ def _union_fields(readers: list[SplitReader]) -> dict[str, list[str]]:
                 numeric_cols.add(name)
             elif kind == "ordinal":
                 ordinal_cols.add(name)
+    # a dynamic field coerced numeric in one split and string in another
+    # merges as strings (the writer's own coercion order: str wins)
+    numeric_cols -= ordinal_cols
     return {"inverted": sorted(inverted), "numeric_cols": sorted(numeric_cols),
             "ordinal_cols": sorted(ordinal_cols)}
 
@@ -317,9 +345,11 @@ def _info_at(td, ordinal: int):
 
 def _merge_numeric_column(builder, name, readers, doc_offsets, num_docs,
                           num_docs_padded) -> dict[str, Any]:
-    sample = next(r for r in readers
-                  if r.footer.fields.get(name, {}).get("column_kind") == "numeric")
-    dtype = sample.column_values(name)[0].dtype
+    dtypes = {r.column_values(name)[0].dtype for r in readers
+              if r.footer.fields.get(name, {}).get("column_kind") == "numeric"}
+    # dynamic columns typed differently per split (i64 here, f64 there)
+    # coerce to f64 on merge — the writer's own mixed-numeric rule
+    dtype = dtypes.pop() if len(dtypes) == 1 else np.dtype(np.float64)
     values = np.zeros(num_docs_padded, dtype=dtype)
     present = np.zeros(num_docs_padded, dtype=np.uint8)
     vmin, vmax = None, None
@@ -339,26 +369,75 @@ def _merge_numeric_column(builder, name, readers, doc_offsets, num_docs,
             "min_value": vmin, "max_value": vmax}
 
 
+def _canonical_numeric_strings(reader, name) -> "list[tuple[int, str]]":
+    """Per-doc canonical strings of a NUMERIC column — used when a
+    dynamic field is string-typed in the merged split but numeric in
+    this input. Rendering follows the source split's value classes so it
+    matches what the writer's own str-coercion (dynamic_canonical) would
+    have produced: bool columns → true/false, integer-only → "5", floats
+    → repr. (A long stored in an f64 column — the input split saw both —
+    is unrecoverable and renders as repr(float).)"""
+    meta = reader.footer.fields.get(name, {})
+    classes = set(meta.get("value_classes", ()))
+    v, p = reader.column_values(name)
+    out = []
+    is_bool = meta.get("col_type") == "bool" or classes == {"boolean"}
+    ints_only = classes and "double" not in classes and not is_bool
+    for doc_id in np.nonzero(p[: reader.num_docs])[0]:
+        val = v[doc_id]
+        if is_bool:
+            text = "true" if val else "false"
+        elif ints_only or not np.issubdtype(v.dtype, np.floating):
+            text = str(int(val))
+        else:
+            text = repr(float(val))
+        out.append((int(doc_id), text))
+    return out
+
 def _merge_ordinal_column(builder, name, readers, doc_offsets, num_docs,
                           num_docs_padded) -> dict[str, Any]:
+    # (doc, value-string) pairs per reader; ordinal inputs keep EVERY
+    # value via the mv arrays when present, numeric inputs contribute
+    # canonical strings (mixed-type dynamic columns coerce to strings)
+    per_reader_pairs: list[list[tuple[int, str]]] = []
     union: set[str] = set()
     for reader in readers:
-        if reader.footer.fields.get(name, {}).get("column_kind") == "ordinal":
-            union.update(reader.column_dict(name))
+        kind = reader.footer.fields.get(name, {}).get("column_kind")
+        if kind == "ordinal":
+            local_keys = reader.column_dict(name)
+            pairs: list[tuple[int, str]] = []
+            if reader.has_array(f"col.{name}.mv_docs"):
+                docs = reader.array(f"col.{name}.mv_docs")
+                ords = reader.array(f"col.{name}.mv_ords")
+                for d, o in zip(docs.tolist(), ords.tolist()):
+                    if o >= 0:
+                        pairs.append((d, local_keys[o]))
+            else:
+                local = reader.column_ordinals(name)[: reader.num_docs]
+                for doc_id in np.nonzero(local >= 0)[0]:
+                    pairs.append((int(doc_id), local_keys[local[doc_id]]))
+            per_reader_pairs.append(pairs)
+        elif kind == "numeric":
+            per_reader_pairs.append(_canonical_numeric_strings(reader, name))
+        else:
+            per_reader_pairs.append([])
+        union.update(v for _d, v in per_reader_pairs[-1])
     uniques = sorted(union)
     ordinal_of = {t: i for i, t in enumerate(uniques)}
     ordinals = np.full(num_docs_padded, -1, dtype=np.int32)
-    for reader, offset in zip(readers, doc_offsets):
-        if reader.footer.fields.get(name, {}).get("column_kind") != "ordinal":
-            continue
-        local = reader.column_ordinals(name)[: reader.num_docs]
-        local_keys = reader.column_dict(name)
-        lut = np.array([ordinal_of[k] for k in local_keys], dtype=np.int32) \
-            if local_keys else np.array([], dtype=np.int32)
-        out = np.full(reader.num_docs, -1, dtype=np.int32)
-        mask = local >= 0
-        out[mask] = lut[local[mask]]
-        ordinals[offset: offset + reader.num_docs] = out
+    all_pairs: list[tuple[int, int]] = []  # (global doc, global ordinal)
+    multivalued = False
+    for pairs, offset in zip(per_reader_pairs, doc_offsets):
+        seen_docs: set[int] = set()
+        for doc_id, value in pairs:
+            g = int(offset) + doc_id
+            o = ordinal_of[value]
+            if g not in seen_docs:
+                ordinals[g] = o  # dense column keeps the first value
+                seen_docs.add(g)
+            all_pairs.append((g, o))
+        if len(seen_docs) != len(pairs):
+            multivalued = True
     blob = "".join(uniques).encode()
     dict_offsets = np.zeros(len(uniques) + 1, dtype=np.int64)
     acc = 0
@@ -368,7 +447,22 @@ def _merge_ordinal_column(builder, name, readers, doc_offsets, num_docs,
     builder.add_array(f"col.{name}.ordinals", ordinals)
     builder.add_array(f"col.{name}.dict_blob", np.frombuffer(blob, dtype=np.uint8))
     builder.add_array(f"col.{name}.dict_offsets", dict_offsets)
-    return {"fast": True, "column_kind": "ordinal", "cardinality": len(uniques)}
+    meta = {"fast": True, "column_kind": "ordinal",
+            "cardinality": len(uniques)}
+    if multivalued:
+        from .format import POSTING_PAD, pad_to as _pad_to
+        seen_pairs: set[tuple[int, int]] = set()
+        mv = [p for p in all_pairs
+              if p not in seen_pairs and not seen_pairs.add(p)]
+        padded = _pad_to(max(len(mv), 1), POSTING_PAD)
+        docs_arr = np.zeros(padded, dtype=np.int32)
+        ords_arr = np.full(padded, -1, dtype=np.int32)
+        docs_arr[: len(mv)] = [d for d, _o in mv]
+        ords_arr[: len(mv)] = [o for _d, o in mv]
+        builder.add_array(f"col.{name}.mv_docs", docs_arr)
+        builder.add_array(f"col.{name}.mv_ords", ords_arr)
+        meta["multivalued"] = True
+    return meta
 
 
 def _merge_docstore(builder, readers, doc_offsets) -> None:

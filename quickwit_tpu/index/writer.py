@@ -24,7 +24,9 @@ from typing import Any, Optional
 
 import numpy as np
 
-from ..models.doc_mapper import DocMapper, FieldMapping, FieldType, TypedDoc, canonical_term
+from ..models.doc_mapper import (
+    DocMapper, FieldMapping, FieldType, TypedDoc, canonical_term,
+    dynamic_canonical)
 from ..utils.datetime_utils import truncate_to_precision
 from .format import DOC_PAD, POSTING_PAD, SplitFileBuilder, SplitFooter, pad_to
 
@@ -131,6 +133,65 @@ def _native_capable(fm: FieldMapping):
     return load_fastindex()
 
 
+class _DynamicColumnBuilder:
+    """Accumulates RAW dynamic leaf values; the split decides the column
+    type at finish time (reference: tantivy's dynamic column coercion —
+    the columnar side coerces mixed numerics to f64, mixed anything-else
+    to strings, which is what makes a `long` observed alongside a
+    `double` searchable but not aggregatable)."""
+
+    def __init__(self):
+        self.values: dict[int, list[Any]] = {}
+        self.classes: set[str] = set()
+        self._max_int = 0
+        self._min_int = 0
+
+    def add(self, doc_id: int, value: Any) -> None:
+        if isinstance(value, bool):
+            self.classes.add("boolean")
+        elif isinstance(value, int):
+            self.classes.add("long")
+            self._max_int = max(self._max_int, value)
+            self._min_int = min(self._min_int, value)
+        elif isinstance(value, float):
+            self.classes.add("double")
+        else:
+            self.classes.add("str")
+        self.values.setdefault(doc_id, []).append(value)
+
+    def coerced_type(self) -> FieldType:
+        if "str" in self.classes:
+            return FieldType.TEXT
+        if "double" in self.classes:
+            return FieldType.F64
+        if "long" in self.classes:
+            if self._max_int > (1 << 63) - 1:
+                # >i64::MAX alongside a negative value: no integer dtype
+                # holds both — coerce to f64 (lossy at the extremes, like
+                # the reference's columnar coercion)
+                return (FieldType.F64 if self._min_int < 0
+                        else FieldType.U64)
+            return FieldType.I64
+        return FieldType.BOOL
+
+    def to_column(self, tokenizer: str) -> "_ColumnBuilder":
+        coerced = self.coerced_type()
+        fm = FieldMapping("dynamic", coerced, tokenizer=tokenizer,
+                          fast=True, indexed=False)
+        col = _ColumnBuilder(fm)
+        for doc_id, values in self.values.items():
+            for value in values:
+                if coerced is FieldType.TEXT:
+                    col.add(doc_id, dynamic_canonical(value))
+                elif coerced is FieldType.BOOL:
+                    col.add(doc_id, 1 if value else 0)
+                elif coerced is FieldType.F64:
+                    col.add(doc_id, float(value))
+                else:
+                    col.add(doc_id, int(value))
+        return col
+
+
 class _ColumnBuilder:
     def __init__(self, fm: FieldMapping):
         self.fm = fm
@@ -164,6 +225,7 @@ class SplitWriter:
         self._cols: dict[str, _ColumnBuilder] = {
             fm.name: _ColumnBuilder(fm) for fm in doc_mapper.fast_fields
         }
+        self._dyn_cols: dict[str, _DynamicColumnBuilder] = {}
         if doc_mapper.store_document_size:
             # synthetic `_doc_length` fast column (reference
             # store_document_size): serialized byte size per doc
@@ -183,31 +245,53 @@ class SplitWriter:
         self.num_docs += 1
         for field_name, values in tdoc.fields.items():
             fm = self.doc_mapper.field(field_name)
+            dynamic = False
             if fm is None:
                 if self.doc_mapper.mode != "dynamic":
                     continue
                 # dynamic mode: unmapped paths materialize per split with
-                # the dynamic_mapping options (raw terms over canonical
-                # value strings — doc_mapper._collect_dynamic_leaves)
+                # the dynamic_mapping options — raw terms over canonical
+                # value strings on the inverted side, a typed column
+                # (coerced from the observed value classes) on the fast
+                # side (doc_mapper._collect_dynamic_leaves keeps values raw)
+                dynamic = True
                 fm = self.doc_mapper.dynamic_field(field_name)
                 if fm.indexed and field_name not in self._inv:
                     fastindex = _native_capable(fm)
                     self._inv[field_name] = (
                         _NativeInvertedFieldBuilder(fm, fastindex)
                         if fastindex else _InvertedFieldBuilder(fm))
+            index_values = ([dynamic_canonical(v) for v in values]
+                            if dynamic else values)
             if fm.indexed:
                 builder = self._inv[field_name]
                 if isinstance(builder, _NativeInvertedFieldBuilder):
-                    for value in values:
+                    for value in index_values:
                         builder.add_value(doc_id, value)
                 else:
-                    for value in values:
+                    for value in index_values:
                         builder.add(doc_id,
                                     self.doc_mapper.tokens_for_field(fm, value))
             if fm.fast:
-                col = self._cols[field_name]
-                for value in values:
-                    col.add(doc_id, _fast_value(fm, value))
+                if dynamic:
+                    dcol = self._dyn_cols.setdefault(
+                        field_name, _DynamicColumnBuilder())
+                    for value in values:
+                        dcol.add(doc_id, value)
+                else:
+                    col = self._cols[field_name]
+                    for value in values:
+                        col.add(doc_id, _fast_value(fm, value))
+            elif dynamic:
+                # no column: still record the observed value classes for
+                # the per-split field registry (list_fields / field caps)
+                dcol = self._dyn_cols.setdefault(
+                    field_name, _DynamicColumnBuilder())
+                dcol.classes.update(
+                    "boolean" if isinstance(v, bool) else
+                    "long" if isinstance(v, int) else
+                    "double" if isinstance(v, float) else "str"
+                    for v in values)
         ts = tdoc.timestamp_micros(self.doc_mapper.timestamp_field)
         if ts is not None:
             self._time_min = ts if self._time_min is None else min(self._time_min, ts)
@@ -236,6 +320,17 @@ class SplitWriter:
         for name, col in self._cols.items():
             meta = fields_meta.setdefault(name, {"type": col.fm.type.value})
             meta.update(self._write_column(builder, name, col, num_docs_padded))
+        dm_tokenizer = (self.doc_mapper.dynamic_mapping.tokenizer
+                        if self.doc_mapper.dynamic_mapping else "raw")
+        for name, dcol in self._dyn_cols.items():
+            meta = fields_meta.setdefault(name, {})
+            meta["dynamic"] = True
+            meta["value_classes"] = sorted(dcol.classes)
+            if dcol.values:
+                col = dcol.to_column(dm_tokenizer)
+                meta.setdefault("type", col.fm.type.value)
+                meta["col_type"] = col.fm.type.value
+                meta.update(self._write_column(builder, name, col, num_docs_padded))
         self._write_docstore(builder)
 
         footer = SplitFooter(

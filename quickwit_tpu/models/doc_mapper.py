@@ -70,14 +70,26 @@ class FieldMapping:
     # "seconds" | "milliseconds" | None (microseconds). Stored values AND
     # range bounds truncate to it, so sub-precision bounds behave like ES.
     fast_precision: Optional[str] = None
+    # `type: concatenate` (reference: field_mapping_entry.rs concatenate
+    # fields): a synthetic TEXT field indexing the canonical leaf values
+    # of the listed source fields (and, optionally, of every dynamic
+    # leaf) under ITS OWN tokenizer. Internally typed TEXT; non-empty
+    # concatenate_fields marks it.
+    concatenate_fields: tuple[str, ...] = ()
+    include_dynamic_fields: bool = False
 
     def to_dict(self) -> dict[str, Any]:
         return {
-            "name": self.name, "type": self.type.value, "tokenizer": self.tokenizer,
+            "name": self.name,
+            "type": ("concatenate" if self.concatenate_fields
+                     else self.type.value),
+            "tokenizer": self.tokenizer,
             "record": self.record, "indexed": self.indexed, "fast": self.fast,
             "stored": self.stored, "input_formats": list(self.input_formats),
             "output_format": self.output_format, "normalizer": self.normalizer,
             "fast_precision": self.fast_precision,
+            "concatenate_fields": list(self.concatenate_fields),
+            "include_dynamic_fields": self.include_dynamic_fields,
         }
 
     @staticmethod
@@ -88,8 +100,15 @@ class FieldMapping:
             # reference shape: `fast: {normalizer: lowercase}`
             normalizer = fast.get("normalizer", normalizer)
             fast = True
+        type_name = d["type"]
+        concatenate_fields = tuple(d.get("concatenate_fields", ()))
+        if type_name == "concatenate":
+            type_name = "text"
+            if not concatenate_fields:
+                raise ValueError(
+                    f"concatenate field {d['name']!r} needs concatenate_fields")
         return FieldMapping(
-            name=d["name"], type=FieldType(d["type"]),
+            name=d["name"], type=FieldType(type_name),
             tokenizer=d.get("tokenizer", "default"), record=d.get("record", "basic"),
             indexed=d.get("indexed", True), fast=fast,
             stored=d.get("stored", True),
@@ -97,6 +116,8 @@ class FieldMapping:
             output_format=d.get("output_format", "rfc3339"),
             normalizer=normalizer,
             fast_precision=d.get("fast_precision"),
+            concatenate_fields=concatenate_fields,
+            include_dynamic_fields=d.get("include_dynamic_fields", False),
         )
 
 
@@ -108,7 +129,7 @@ class DynamicMapping:
     tokenizer: str = "raw"     # reference default_json: raw, no fieldnorms
     record: str = "basic"
     stored: bool = True
-    fast: bool = True          # accepted; dynamic fast columns not built yet
+    fast: bool = True          # per-split typed dynamic columns
     expand_dots: bool = True
 
     def to_dict(self) -> dict[str, Any]:
@@ -190,6 +211,8 @@ class DocMapper:
 
     def __post_init__(self) -> None:
         self._by_name = {fm.name: fm for fm in self.field_mappings}
+        self._concat_fields = [fm for fm in self.field_mappings
+                               if fm.concatenate_fields]
         # interior dotted prefixes of mapped names ("a.b.c" → {"a","a.b"}):
         # O(1) membership test on the per-doc dynamic walk
         self._interior_prefixes = set()
@@ -214,11 +237,13 @@ class DocMapper:
         """The synthesized mapping an unmapped path gets under
         `mode: dynamic` — raw-tokenized text over canonical value strings
         (both the writer and the query lowering use this, so index- and
-        query-side terms always agree)."""
+        query-side terms always agree). `fast` carries the dynamic
+        mapping's flag: the writer materializes a per-split typed column
+        (string→ordinal, int→i64, float→f64, bool→bool) behind it."""
         dm = self.dynamic_mapping or DynamicMapping()
         return FieldMapping(name, FieldType.TEXT, tokenizer=dm.tokenizer,
                             record=dm.record, indexed=dm.indexed,
-                            stored=dm.stored, fast=False)
+                            stored=dm.stored, fast=dm.fast)
 
     def shadows_concrete_field(self, name: str) -> bool:
         """True when a dotted path descends through a mapped NON-JSON
@@ -245,6 +270,8 @@ class DocMapper:
             raise DocParsingError(f"document must be a JSON object, got {type(doc).__name__}")
         fields: dict[str, list[Any]] = {}
         for fm in self.field_mappings:
+            if fm.concatenate_fields:
+                continue  # synthesized below from the source fields
             raw_values = list(_iter_path(doc, fm.name.split(".")))
             if not raw_values:
                 continue
@@ -265,7 +292,38 @@ class DocMapper:
             # the time-pruning and metadata-count paths rely on
             raise DocParsingError(
                 f"document is missing timestamp field {self.timestamp_field!r}")
+        for cf in self._concat_fields:
+            values = self._concat_values(cf, fields)
+            if values:
+                fields[cf.name] = values
         return TypedDoc(fields=fields, source=doc if self.store_source else {})
+
+    def _concat_values(self, cf: FieldMapping,
+                       fields: dict[str, list[Any]]) -> list[str]:
+        """Canonical leaf-value strings a concatenate field indexes: the
+        listed source fields' values (JSON fields contribute every leaf)
+        plus, with include_dynamic_fields, every dynamic leaf value."""
+        out: list[str] = []
+
+        def leaves(value: Any) -> None:
+            if isinstance(value, dict):
+                for v in value.values():
+                    leaves(v)
+            elif isinstance(value, list):
+                for v in value:
+                    leaves(v)
+            elif value is not None:
+                out.append(dynamic_canonical(value))
+
+        for src in cf.concatenate_fields:
+            for value in fields.get(src, ()):
+                leaves(value)
+        if cf.include_dynamic_fields:
+            for name, values in fields.items():
+                if name not in self._by_name:  # dynamic leaf
+                    for value in values:
+                        leaves(value)
+        return out
 
     def _collect_dynamic(self, node: Any, path: tuple[str, ...],
                          fields: dict[str, list[Any]]) -> None:
@@ -307,6 +365,11 @@ class DocMapper:
 
     def _collect_dynamic_leaves(self, node: Any, path: tuple[str, ...],
                                 fields: dict[str, list[Any]]) -> None:
+        """Collect RAW leaf values (bool/int/float/str) under dotted
+        paths. The writer types each dynamic leaf per split from these
+        (long/double/boolean/string value classes — reference: tantivy's
+        typed JSON terms + dynamic columns); term lowering uses the
+        canonical string form (`dynamic_canonical`)."""
         if node is None:
             return
         if isinstance(node, dict):
@@ -317,13 +380,7 @@ class DocMapper:
             for item in node:
                 self._collect_dynamic_leaves(item, path, fields)
             return
-        if isinstance(node, bool):
-            text = "true" if node else "false"
-        elif isinstance(node, float):
-            text = repr(node)
-        else:
-            text = str(node)
-        fields.setdefault(".".join(path), []).append(text)
+        fields.setdefault(".".join(path), []).append(node)
 
     def _convert(self, fm: FieldMapping, value: Any) -> Any:
         t = fm.type
@@ -409,7 +466,7 @@ class DocMapper:
     def from_dict(d: dict[str, Any]) -> "DocMapper":
         return DocMapper(
             doc_mapping_uid=d.get("doc_mapping_uid", "default"),
-            field_mappings=[FieldMapping.from_dict(f) for f in d.get("field_mappings", [])],
+            field_mappings=_expand_field_mappings(d.get("field_mappings", [])),
             timestamp_field=d.get("timestamp_field"),
             tag_fields=tuple(d.get("tag_fields", ())),
             default_search_fields=tuple(d.get("default_search_fields", ())),
@@ -421,6 +478,38 @@ class DocMapper:
             max_num_partitions=d.get("max_num_partitions", 200),
             store_document_size=d.get("store_document_size", False),
         )
+
+
+def _expand_field_mappings(entries: Sequence[dict],
+                           prefix: str = "") -> list[FieldMapping]:
+    """Parse field-mapping entries, flattening `type: object` groups into
+    dotted paths (reference: `mapping_tree.rs` builds the same flat
+    tantivy schema from its nested tree) and accepting the `array<T>`
+    aliases (every field is multivalued in this engine, so array<T> ≡ T)."""
+    out: list[FieldMapping] = []
+    for d in entries:
+        typ = str(d.get("type", "text"))
+        if typ.startswith("array<") and typ.endswith(">"):
+            d = {**d, "type": typ[len("array<"):-1]}
+            typ = d["type"]
+        name = prefix + d["name"]
+        if typ == "object":
+            out.extend(_expand_field_mappings(
+                d.get("field_mappings", []), name + "."))
+        else:
+            out.append(FieldMapping.from_dict({**d, "name": name}))
+    return out
+
+
+def dynamic_canonical(value: Any) -> str:
+    """Canonical string form of a dynamic leaf value — shared by the
+    writer (index terms, ordinal column entries) and the query lowering,
+    so both sides always agree."""
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    if isinstance(value, float):
+        return repr(value)
+    return str(value)
 
 
 def canonical_term(fm: FieldMapping, value: Any) -> str:

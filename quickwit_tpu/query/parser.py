@@ -170,6 +170,17 @@ class _Parser:
                     return Range(field, upper=bound)
             if value.startswith('"'):
                 return self._phrase(field, value)
+            if value.startswith("'"):
+                # single-quoted phrase: `field:'AB CD'` — quotes ride
+                # inside bare tokens, so join tokens to the closing quote
+                parts = [value]
+                while not (parts[-1].endswith("'")
+                           and (len(parts) > 1 or len(parts[0]) > 1)):
+                    nxt = self.peek()
+                    if nxt is None:
+                        raise QueryParseError("unclosed ' phrase")
+                    parts.append(self.next())
+                return self._phrase(field, '"' + " ".join(parts)[1:-1] + '"')
             unescaped = value.replace("\\*", "\x00").replace("\\?", "\x01")
             if "*" in unescaped or "?" in unescaped:
                 # escaped wildcards match literally (fnmatch classes)
@@ -243,6 +254,10 @@ class _Parser:
         tok = self.next()
         if tok in ("+", "-"):
             tok = tok + self.next()
+        if tok.startswith('"'):
+            # reference parity: the query language has no quoted (or
+            # whitespace-escaped) range bounds — use the ES API instead
+            raise QueryParseError("range bounds do not support quoted values")
         return tok
 
     def _range(self, field: str) -> QueryAst:

@@ -544,6 +544,13 @@ class Lowering:
         if fm.type is not FieldType.TEXT:
             return self._postings_node(ast.field, self._canonical(fm, ast.text),
                                        scoring, boost)
+        if not fm.indexed:
+            if fm.fast:
+                # fast-only text field: the query text matches the exact
+                # stored value on the dictionary column (reference:
+                # fast-field search on index:false fields)
+                return self._fast_only_term(ast.field, ast.text)
+            raise PlanError(f"field {ast.field!r} is not indexed")
         tokens = get_tokenizer(fm.tokenizer)(ast.text)
         if not tokens:
             # ES zero_terms_query: "all" matches everything when the text
@@ -666,9 +673,17 @@ class Lowering:
                         if f.name.startswith(prefix)
                         and (f.fast or (f.indexed
                                         and f.type is FieldType.TEXT))]
-            if not children:
-                return PMatchNone()
             nodes = [self._lower_presence(f.name) for f in children]
+            if self.doc_mapper.mode == "dynamic":
+                # per-split dynamic fields from the footer registry: the
+                # exact path, or any materialized leaf under it
+                for name, meta in self.reader.footer.fields.items():
+                    if not meta.get("dynamic"):
+                        continue
+                    if name == field or name.startswith(prefix):
+                        nodes.append(self._dynamic_presence(name, meta))
+            if not nodes:
+                return PMatchNone()
             return self._or(nodes)
         if fm.fast:
             meta = self.reader.field_meta(field)
@@ -681,6 +696,21 @@ class Lowering:
         if fm.indexed and fm.type is FieldType.TEXT:
             return PNormPresence(self._fieldnorm_slot(field))
         raise PlanError(f"presence query needs a fast or indexed text field: {field!r}")
+
+    def _dynamic_presence(self, name: str, meta: dict) -> Any:
+        """Presence of one materialized dynamic field in this split."""
+        kind = meta.get("column_kind")
+        if kind == "ordinal":
+            slot = self.b.add_array(
+                f"col.{name}.ordinals",
+                lambda: self.reader.column_ordinals(name))
+            return PPresence(slot, is_ordinal=True)
+        if kind == "numeric":
+            _vals, present_slot = self._column_slots(name)
+            return PPresence(present_slot)
+        if meta.get("indexed"):
+            return PNormPresence(self._fieldnorm_slot(name))
+        return PMatchNone()
 
     def _fast_only_term(self, field: str, value: str) -> Any:
         """Exact term on a fast-only (index:false) text field: an ordinal
@@ -739,6 +769,21 @@ class Lowering:
         """`bounds_are_micros`: bounds on a datetime field are already in
         micros (request-level time filters) — skip input-format parsing."""
         fm = self._field(ast.field)
+        if (self.doc_mapper.field(ast.field) is None
+                and self.doc_mapper.mode == "dynamic"
+                and fm.type is FieldType.TEXT):
+            # dynamic path: route by the column this split actually
+            # materialized (string→ordinal, numeric→typed values); a
+            # split that never saw the field (or coerced it to another
+            # class) matches nothing
+            meta = self.reader.field_meta(ast.field)
+            kind = meta.get("column_kind")
+            if kind == "numeric":
+                fm = FieldMapping(ast.field,
+                                  FieldType(meta.get("col_type", "f64")),
+                                  fast=True, indexed=False)
+            elif kind != "ordinal":
+                return PMatchNone()
         if fm.type is FieldType.TEXT:
             return self._lower_text_range(ast, fm)
         values_slot, present_slot = self._column_slots(ast.field)

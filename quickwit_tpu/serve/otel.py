@@ -253,15 +253,17 @@ class OtelService:
         # find_trace_ids_collector.rs): a terms aggregation over the
         # trace_id fast column ordered by max span timestamp — the
         # dedup/top-N runs in the bucket kernels, not over fetched docs
+        # size+1: spans ingested without a traceId bucket under "" and
+        # are dropped below — the extra slot keeps `limit` real traces
+        # even when the empty bucket ranks in the top N
         response = self.node.root_searcher.search(SearchRequest(
             index_ids=[OTEL_TRACES_INDEX], query_ast=ast, max_hits=0,
             aggs={"trace_ids": {
-                "terms": {"field": "trace_id", "size": limit,
+                "terms": {"field": "trace_id", "size": limit + 1,
                           "order": {"max_ts": "desc"}},
                 "aggs": {"max_ts": {
                     "max": {"field": "span_start_timestamp"}}}}},
             start_timestamp=start_timestamp, end_timestamp=end_timestamp))
         buckets = (response.aggregations or {}).get(
             "trace_ids", {}).get("buckets", [])
-        # spans ingested without a traceId bucket under "" — never a trace
-        return [b["key"] for b in buckets if b["key"]]
+        return [b["key"] for b in buckets if b["key"]][:limit]

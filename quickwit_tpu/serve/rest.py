@@ -601,6 +601,26 @@ class RestServer:
     def _route_elastic(self, method: str, path: str, params: dict[str, Any],
                        body: bytes) -> tuple[int, Any]:
         node = self.node
+        if path in ("", "/") and method == "GET":
+            # ES cluster-info handshake (reference:
+            # elasticsearch_api/rest_handler.rs:73 es_compat_cluster_info)
+            from .. import __version__
+            return 200, {
+                "name": node.config.node_id,
+                "cluster_name": node.config.cluster_id,
+                "cluster_uuid": node.config.cluster_id,
+                "tagline": "You Know, for Search",
+                "version": {
+                    "distribution": "quickwit-tpu",
+                    "number": "7.17.0",
+                    "build_hash": __version__,
+                    "build_date": "2026-01-01T00:00:00Z",
+                    "build_snapshot": False,
+                    "lucene_version": "8.11.1",
+                    "minimum_wire_compatibility_version": "6.8.0",
+                    "minimum_index_compatibility_version": "6.0.0-beta1",
+                },
+            }
         m = re.fullmatch(r"/([^/]+)/_search", path)
         if m:
             payload = json.loads(body) if body else {}
@@ -781,20 +801,72 @@ class RestServer:
                 if name in known:
                     node.index_service.delete_index(name)
             return 200, {"acknowledged": True}
+        if path == "/_field_caps":
+            return self._es_field_caps("*", params, body)
         m = re.fullmatch(r"/([^/]+)/_field_caps", path)
         if m:
-            metadata = node.metastore.index_metadata(m.group(1).rstrip("*").rstrip(","))
-            fields = {}
-            for fm in metadata.index_config.doc_mapper.field_mappings:
-                es_type = {"text": "text", "i64": "long", "u64": "long",
-                           "f64": "double", "bool": "boolean",
-                           "datetime": "date", "ip": "ip", "bytes": "binary",
-                           "json": "object"}[fm.type.value]
-                fields[fm.name] = {es_type: {
-                    "type": es_type, "searchable": fm.indexed,
-                    "aggregatable": fm.fast}}
-            return 200, {"indices": [metadata.index_id], "fields": fields}
+            return self._es_field_caps(m.group(1), params, body)
         raise ApiError(404, f"no elastic route for {method} {path}")
+
+    # list-fields type class → ES field-caps entry types (reference:
+    # elasticsearch_api/model/field_capability.rs:150 — Str expands to
+    # keyword AND text entries with the same flags)
+    _FIELD_CAPS_TYPES = {"str": ("keyword", "text"), "long": ("long",),
+                         "double": ("double",), "boolean": ("boolean",),
+                         "date": ("date_nanos",), "ip": ("ip",),
+                         "binary": ("binary",)}
+
+    def _es_field_caps(self, index_pattern: str, params: dict[str, Any],
+                       body: bytes = b"") -> tuple[int, Any]:
+        """ES `_field_caps`, driven by the per-split field registries
+        (reference: build_list_field_request_for_es_api +
+        convert_to_es_field_capabilities_response). A POST `index_filter`
+        prunes splits via its conjunctive tag terms and time bounds;
+        empty/invalid filters are 400 like ES."""
+        from ..search.list_apis import list_field_entries
+        node = self.node
+        patterns = index_pattern.split(",")
+        known = {im.index_id for im in node.metastore.list_indexes()}
+        for p in patterns:
+            # concrete (non-wildcard) names must exist; wildcards may
+            # match nothing (ES expand_wildcards semantics)
+            if p and "*" not in p and "?" not in p and p not in known:
+                raise ApiError(404, f"no such index {p!r}")
+        filter_ast = None
+        if body:
+            payload = json.loads(body)
+            index_filter = payload.get("index_filter")
+            if index_filter is not None:
+                if not isinstance(index_filter, dict) or not index_filter:
+                    raise ApiError(400, "index_filter must be a non-empty "
+                                        "query object")
+                try:
+                    filter_ast = es_query_to_ast(index_filter)
+                except EsDslParseError as exc:
+                    raise ApiError(400, f"invalid index_filter: {exc}")
+        field_patterns = None
+        if params.get("fields"):
+            field_patterns = [p.strip()
+                              for p in str(params["fields"]).split(",")]
+        entries = list_field_entries(
+            node.metastore, node.search_service.context,
+            patterns, field_patterns=field_patterns,
+            filter_ast=filter_ast,
+            start_timestamp=(int(params["start_timestamp"])
+                             if params.get("start_timestamp") else None),
+            end_timestamp=(int(params["end_timestamp"])
+                           if params.get("end_timestamp") else None))
+        indices = sorted({i for e in entries for i in e["index_ids"]})
+        fields: dict[str, dict[str, Any]] = {}
+        for e in entries:
+            for es_type in self._FIELD_CAPS_TYPES.get(e["type_class"], ()):
+                cap = {"metadata_field": False, "type": es_type,
+                       "searchable": e["searchable"],
+                       "aggregatable": e["aggregatable"]}
+                if len(e["index_ids"]) != len(indices):
+                    cap["indices"] = sorted(e["index_ids"])
+                fields.setdefault(e["field_name"], {})[es_type] = cap
+        return 200, {"indices": indices, "fields": fields}
 
     def _es_search_request(self, index: str, payload: dict[str, Any],
                            params: dict[str, Any]) -> SearchRequest:

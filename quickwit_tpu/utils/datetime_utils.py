@@ -16,7 +16,7 @@ _RFC3339_RE = re.compile(
     r"^(\d{4})-(\d{2})-(\d{2})[Tt ](\d{2}):(\d{2}):(\d{2})(\.\d+)?"
     r"(?:([Zz])|([+-]\d{2}):?(\d{2}))?$"
 )
-_DATE_RE = re.compile(r"^(\d{4})-(\d{2})-(\d{2})$")
+_DATE_RE = re.compile(r"^(\d{4})[-/](\d{2})[-/](\d{2})$")
 
 MICROS = 1_000_000
 
@@ -47,6 +47,10 @@ def parse_datetime_to_micros(
             if fmt == "unix_timestamp":
                 if isinstance(value, (int, float)) and not isinstance(value, bool):
                     return _unix_number_to_micros(value)
+                if isinstance(value, str) and re.fullmatch(r"-?\d+", value):
+                    # query-string bounds arrive as strings
+                    # (reference: `ts:>=1684993002`)
+                    return _unix_number_to_micros(int(value))
                 continue
             if fmt in ("rfc3339", "iso8601"):
                 if not isinstance(value, str):

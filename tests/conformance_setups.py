@@ -258,6 +258,134 @@ def multi_splits_setup() -> list[dict]:
     ]
 
 
+def qw_search_api_setup() -> list[dict]:
+    # three indexes from the reference _setup.quickwit.yaml: `simple`
+    # (dynamic with datetime fast fields), `nested` (json/object paths
+    # left to dynamic materialization; concrete object + fast-only text
+    # fields), `millisec` (ms-precision timestamps)
+    return [
+        _delete("simple"), _delete("nested"), _delete("millisec"),
+        _create("simple", [
+            {"name": "ts", "type": "datetime", "fast": True},
+            {"name": "not_fast", "type": "datetime", "fast": True}],
+            timestamp_field="ts", mode="dynamic",
+            dynamic_mapping={"tokenizer": "default", "expand_dots": True,
+                             "fast": True}),
+        _ingest("simple", [
+            {"ts": 1684993001, "not_fast": 1684993001,
+             "auto_date": "2023-05-25T10:00:00Z"},
+            {"ts": 1684993002, "not_fast": 1684993002,
+             "auto_date": "2023-05-25T11:00:00Z"}]),
+        _ingest("simple", [
+            {"ts": 1684993003, "not_fast": 1684993003},
+            {"ts": 1684993004, "not_fast": 1684993004}]),
+        _create("nested", [
+            {"name": "object_multi", "type": "object", "field_mappings": [
+                {"name": "object_text_field", "type": "text"},
+                {"name": "object_fast_field", "type": "u64",
+                 "fast": True}]},
+            {"name": "text_fast", "type": "text", "fast": True,
+             "indexed": False},
+            {"name": "text_raw", "type": "text", "fast": False,
+             "indexed": True, "tokenizer": "raw"}],
+            mode="dynamic", index_field_presence=True),
+        _ingest("nested", [
+            {"json_text": {"field_a": "hello", "field_b": "world"}},
+            {"json_text": {"field_a": "hi"}},
+            {"json_fast": {"field_c": 1}},
+            {"object_multi": {"object_text_field": "multi hello"}},
+            {"object_multi": {"object_fast_field": 1}},
+            {"object_multi": {"object_fast_field": 2}},
+            {"text_raw": "indexed-with-raw-tokenizer-dashes"},
+            {"text_raw": "indexed with raw tokenizer dashes"},
+            {"text_fast": "fast-text-value-dashes"},
+            {"text_fast": "fast text value whitespaces"}]),
+        _create("millisec", [
+            {"name": "ts", "type": "datetime", "fast": True,
+             "input_formats": ["rfc3339"],
+             "fast_precision": "milliseconds"}],
+            timestamp_field="ts", mode="strict"),
+        _ingest("millisec", [
+            {"ts": "2022-12-16T10:00:56.297Z"},
+            {"ts": "2022-12-16T10:00:57.000Z"},
+            {"ts": "2022-12-16T10:00:57.297Z"}]),
+    ]
+
+
+def concat_fields_setup() -> list[dict]:
+    concat = {"concatenate_fields": ["text1", "text2", "boolean", "int",
+                                     "json", "float"]}
+    return [
+        _delete("concat"),
+        _create("concat", [
+            {"name": "text1", "type": "text", "tokenizer": "default"},
+            {"name": "text2", "type": "text", "tokenizer": "raw"},
+            {"name": "boolean", "type": "bool"},
+            {"name": "int", "type": "u64"},
+            {"name": "float", "type": "f64"},
+            {"name": "json", "type": "json"},
+            {"name": "concat_raw", "type": "concatenate",
+             "tokenizer": "raw", "include_dynamic_fields": True, **concat},
+            {"name": "concat_default", "type": "concatenate",
+             "tokenizer": "default", **concat}],
+            mode="dynamic",
+            dynamic_mapping={"tokenizer": "default", "expand_dots": True}),
+        _ingest("concat", [
+            {"text1": "AB-CD", "text2": "EF-GH"},
+            {"text1": "true"},
+            {"boolean": True},
+            {"text2": "i like 42"},
+            {"int": 42},
+            {"other-field": "otherfieldvalue", "other-field-number": 9,
+             "other-field-bool": False},
+            {"json": {"some_bool": False, "some_int": 10,
+                      "nested": {"some_string": "nestedstring"}}},
+            {"float": 1.5},
+            {"json": {"val:": 2.5, "date": "2024-01-01T00:13:00Z"}},
+            {"other": 3.5},
+            {"big": 9223372036854775808},
+            {"neg": -5}]),
+    ]
+
+
+def es_field_capabilities_setup() -> list[dict]:
+    dyn = {"mode": "dynamic",
+           "dynamic_mapping": {"tokenizer": "default", "fast": True}}
+    fields = [
+        {"name": "date", "type": "datetime", "input_formats": ["rfc3339"],
+         "fast_precision": "seconds", "fast": True},
+        {"name": "host", "type": "ip", "fast": True},
+    ]
+    return [
+        _delete("fieldcaps"), _delete("fieldcaps-2"),
+        _create("fieldcaps",
+                fields + [{"name": "tags", "type": "array<text>",
+                           "tokenizer": "raw", "fast": True}],
+                timestamp_field="date", tag_fields=["tags"], **dyn),
+        _create("fieldcaps-2", fields, **dyn),
+        _ingest("fieldcaps", [
+            {"name": "Fritz", "response": 30, "id": 5,
+             "date": "2015-01-10T12:00:00Z", "host": "192.168.0.1",
+             "tags": ["nice", "cool"]},
+            {"nested": {"name": "Fritz", "response": 30},
+             "date": "2015-01-11T12:00:00Z", "host": "192.168.0.11",
+             "tags": ["nice"]}]),
+        _ingest("fieldcaps", [
+            {"id": -5.5, "date": "2018-01-10T12:00:00Z"}]),
+        _ingest("fieldcaps", [
+            {"mixed": 5, "date": "2023-01-10T12:00:00Z"},
+            {"mixed": -5.5, "date": "2024-01-10T12:00:00Z"}]),
+        _ingest("fieldcaps-2", [
+            {"name": "Fritz", "response": 30, "id": 6,
+             "host": "192.168.0.1", "tags": ["nice", "cool"],
+             "tags-2": ["awesome"]}]),
+    ]
+
+
+def es_compatibility_info_setup() -> list[dict]:
+    return []
+
+
 SETUPS = {
     "es_compatibility": es_compatibility_setup,
     "multi_splits": multi_splits_setup,
@@ -266,4 +394,8 @@ SETUPS = {
     "search_after": search_after_setup,
     "tag_fields": tag_fields_setup,
     "default_search_fields": default_search_fields_setup,
+    "qw_search_api": qw_search_api_setup,
+    "concat_fields": concat_fields_setup,
+    "es_field_capabilities": es_field_capabilities_setup,
+    "es_compatibility_info": es_compatibility_info_setup,
 }

@@ -136,11 +136,17 @@ def test_dynamic_mode_materializes_unmapped_leaves():
         "nested": {"code": 42, "ok": True, "pi": 3.5},
         "tags": ["a", "b"]})
     assert tdoc.fields["service"] == ["gw"]
-    assert tdoc.fields["nested.code"] == ["42"]       # canonical strings
-    assert tdoc.fields["nested.ok"] == ["true"]
-    assert tdoc.fields["nested.pi"] == ["3.5"]
+    # raw values: the writer types each leaf per split
+    # (dynamic_canonical gives the index-term form)
+    assert tdoc.fields["nested.code"] == [42]
+    assert tdoc.fields["nested.ok"] == [True]
+    assert tdoc.fields["nested.pi"] == [3.5]
     assert tdoc.fields["tags"] == ["a", "b"]
     assert tdoc.fields["title"] == ["hello"]          # concrete untouched
+    from quickwit_tpu.models.doc_mapper import dynamic_canonical
+    assert [dynamic_canonical(v) for v in tdoc.fields["nested.code"]] == ["42"]
+    assert [dynamic_canonical(v) for v in tdoc.fields["nested.ok"]] == ["true"]
+    assert [dynamic_canonical(v) for v in tdoc.fields["nested.pi"]] == ["3.5"]
 
 
 def test_dynamic_mode_respects_concrete_subpaths():
@@ -150,7 +156,7 @@ def test_dynamic_mode_respects_concrete_subpaths():
     tdoc = mapper.doc_from_json(
         {"resource": {"service": "gw", "extra": 1}})
     assert tdoc.fields["resource.service"] == ["gw"]
-    assert tdoc.fields["resource.extra"] == ["1"]
+    assert tdoc.fields["resource.extra"] == [1]
     assert mapper.shadows_concrete_field("resource.service.x")
     assert not mapper.shadows_concrete_field("resource.other")
 
@@ -201,5 +207,5 @@ def test_dynamic_json_field_subpaths_materialize():
         FieldMapping("attrs", FieldType.JSON)], mode="dynamic")
     tdoc = mapper.doc_from_json({"attrs": {"x": "1", "deep": {"y": 2}}})
     assert tdoc.fields["attrs.x"] == ["1"]
-    assert tdoc.fields["attrs.deep.y"] == ["2"]
+    assert tdoc.fields["attrs.deep.y"] == [2]
     assert tdoc.fields["attrs"] == [{"x": "1", "deep": {"y": 2}}]

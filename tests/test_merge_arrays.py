@@ -174,3 +174,43 @@ def test_native_merge_bytes_identical_to_python(merged_pair):
     finally:
         native_mod._cached = saved
     assert data_native == data_python
+
+
+def test_merge_dynamic_mixed_type_columns():
+    """A dynamic field typed i64 in one split and string in another must
+    merge as one string (ordinal) column holding the canonical forms;
+    all-numeric-but-mixed (i64+f64) dynamic columns promote to f64."""
+    mapper = DocMapper(field_mappings=[], mode="dynamic")
+    storage = RamStorage(Uri.parse("ram:///dmerge"))
+    batches = [
+        [{"mixed": 5, "nums": 1}, {"mixed": 7, "nums": 2}],
+        [{"mixed": "abc", "nums": 2.5}],
+    ]
+    readers = []
+    for i, docs in enumerate(batches):
+        w = SplitWriter(mapper)
+        for d in docs:
+            w.add_json_doc(d)
+        storage.put(f"d{i}.split", w.finish())
+        readers.append(SplitReader(storage, f"d{i}.split"))
+    merged = merge_splits(readers)
+    storage.put("m.split", merged)
+    r = SplitReader(storage, "m.split")
+    meta = r.field_meta("mixed")
+    assert meta["dynamic"] is True
+    assert meta["column_kind"] == "ordinal"
+    assert sorted(meta["value_classes"]) == ["long", "str"]
+    assert r.column_dict("mixed") == ["5", "7", "abc"]
+    nums_meta = r.field_meta("nums")
+    assert nums_meta["column_kind"] == "numeric"
+    values, present = r.column_values("nums")
+    assert values.dtype == np.float64
+    assert values[:3].tolist() == [1.0, 2.0, 2.5]
+    assert present[:3].tolist() == [1, 1, 1]
+    # term search over the merged dynamic field still matches (inverted
+    # side: canonical raw terms)
+    res = leaf_search_single_split(
+        SearchRequest(index_ids=["x"], query_ast=Term("mixed", "abc"),
+                      max_hits=5),
+        mapper, r, "m")
+    assert res.num_hits == 1

@@ -184,8 +184,10 @@ def test_es_bulk_and_cat(api):
 def test_es_field_caps(api):
     status, result = api.request("GET", "/api/v1/_elastic/hdfs-logs/_field_caps")
     assert status == 200
-    assert result["fields"]["timestamp"]["date"]["aggregatable"] is True
+    # reference field-caps model: datetime → date_nanos, text → keyword+text
+    assert result["fields"]["timestamp"]["date_nanos"]["aggregatable"] is True
     assert result["fields"]["body"]["text"]["searchable"] is True
+    assert result["fields"]["body"]["keyword"]["searchable"] is True
 
 
 def test_sorted_search_es_with_sort(api):
