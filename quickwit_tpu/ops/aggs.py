@@ -146,10 +146,12 @@ def sketch_quantiles(counts: np.ndarray, quantiles: list[float]) -> list[float]:
     cum = np.cumsum(counts)
     out = []
     for q in quantiles:
-        # tantivy/DDSketch rank rule: 1-based target = floor(q·n),
-        # clamped to [1, n]; the first bucket reaching it wins (verified
-        # against the reference corpus: p85 of {30,130} → 30's bucket)
-        target = min(max(int(np.floor(q * total)), 1), int(total))
+        # DDSketch (sketches-ddsketch crate, used by tantivy) rank rule:
+        # rank = floor(q·(n-1)), return the first bucket whose cumulative
+        # count strictly exceeds it — i.e. the 0-based rank-th item.
+        # (p85 of {30,130} → 30's bucket, median of 5 → the 3rd item.)
+        rank = int(np.floor(q * (total - 1)))
+        target = min(rank + 1, int(total))
         bucket = int(np.searchsorted(cum, target, side="left"))
         bucket = min(bucket, len(counts) - 1)
         if bucket == 0:

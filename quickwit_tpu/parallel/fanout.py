@@ -88,11 +88,15 @@ def _global_agg_overrides(agg_specs, readers: list[SplitReader],
     # ordinal/origin spaces would otherwise be summed incoherently on
     # device); children key under "parent>child" since ES names are only
     # unique per level
-    expanded = [(spec, spec.name) for spec in agg_specs]
+    expanded: list = []
+
+    def _expand(spec, path):
+        expanded.append((spec, path))
+        for sub in getattr(spec, "sub_buckets", ()):
+            _expand(sub, f"{path}>{sub.name}")
+
     for spec in agg_specs:
-        sub = getattr(spec, "sub_bucket", None)
-        if sub is not None:
-            expanded.append((sub, f"{spec.name}>{sub.name}"))
+        _expand(spec, spec.name)
     for spec, override_key in expanded:
         if isinstance(spec, (DateHistogramAgg, HistogramAgg)):
             vmins, vmaxs = [], []

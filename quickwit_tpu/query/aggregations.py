@@ -8,9 +8,12 @@ kernels (`ops/aggs.py`).
 Supported: date_histogram (fixed_interval), histogram, terms, range,
 composite (terms/histogram/date_histogram sources, after-pagination,
 missing_bucket), avg/min/max/sum/stats/extended_stats/value_count,
-percentiles, cardinality. Sub-aggregations: metrics under buckets, plus
-ONE nested bucket level (e.g. date_histogram > terms) with its own
-metrics; deeper nesting raises; composite takes no sub-aggs yet.
+percentiles, cardinality. Sub-aggregations: metrics (percentiles
+included) under buckets at ANY depth, with ARBITRARY bucket nesting —
+multiple sibling bucket children per level, each chain flattened into a
+mixed-radix device bucket space (reference: tantivy's recursive
+aggregation tree, collector.rs:523). Composite takes no sub-aggs yet;
+range accepts metrics but no bucket children.
 """
 
 from __future__ import annotations
@@ -56,7 +59,7 @@ class DateHistogramAgg:
     extended_bounds: Optional[tuple[int, int]] = None  # micros
     offset_micros: int = 0  # ES `offset`: shifts bucket boundaries
     sub_metrics: tuple[MetricAgg, ...] = ()
-    sub_bucket: Optional["AggSpec"] = None
+    sub_buckets: tuple["AggSpec", ...] = ()
 
 
 @dataclass(frozen=True)
@@ -66,7 +69,6 @@ class RangeAgg:
     field: str
     ranges: tuple[tuple[str, Optional[float], Optional[float]], ...]
     sub_metrics: tuple[MetricAgg, ...] = ()
-    sub_bucket: Optional["AggSpec"] = None
 
 
 @dataclass(frozen=True)
@@ -76,7 +78,7 @@ class HistogramAgg:
     interval: float
     min_doc_count: int = 0
     sub_metrics: tuple[MetricAgg, ...] = ()
-    sub_bucket: Optional["AggSpec"] = None
+    sub_buckets: tuple["AggSpec", ...] = ()
 
 
 @dataclass(frozen=True)
@@ -94,7 +96,7 @@ class TermsAgg:
     # doc_count_error_upper_bound accordingly. None = exact.
     split_size: Optional[int] = None
     sub_metrics: tuple[MetricAgg, ...] = ()
-    sub_bucket: Optional["AggSpec"] = None
+    sub_buckets: tuple["AggSpec", ...] = ()
 
 
 @dataclass(frozen=True)
@@ -138,9 +140,11 @@ _BUCKET_KINDS = ("date_histogram", "histogram", "terms", "range")
 
 
 def _parse_sub_aggs(name: str, sub: dict[str, Any], depth: int = 0):
-    """(metrics, sub_bucket|None). One nested bucket level allowed."""
+    """(metrics, sub_buckets). Bucket children may nest arbitrarily deep
+    and have siblings; the product of bucket counts along each chain is
+    capped at lowering time (MAX_BUCKETS)."""
     metrics = []
-    sub_bucket = None
+    sub_buckets = []
     for sub_name, sub_body in sub.items():
         sub_kind = _agg_kind(sub_body)
         if sub_kind == "cardinality":
@@ -149,20 +153,18 @@ def _parse_sub_aggs(name: str, sub: dict[str, Any], depth: int = 0):
                 "aggregations is not supported yet")
         if sub_kind in _METRIC_KINDS:
             metrics.append(_parse_metric(sub_name, sub_kind, sub_body[sub_kind]))
+        elif sub_kind == "range":
+            # range buckets may overlap, so they have no single per-doc
+            # bucket index to extend the mixed-radix space with
+            raise AggParseError(
+                f"aggregation {name!r}: range cannot nest under bucket "
+                "aggregations")
         elif sub_kind in _BUCKET_KINDS:
-            if depth >= 1:
-                raise AggParseError(
-                    f"aggregation {name!r}: bucket nesting deeper than one "
-                    "level is not supported")
-            if sub_bucket is not None:
-                raise AggParseError(
-                    f"aggregation {name!r}: at most one nested bucket "
-                    "aggregation is supported")
-            sub_bucket = _parse_one(sub_name, sub_body, depth=depth + 1)
+            sub_buckets.append(_parse_one(sub_name, sub_body, depth=depth + 1))
         else:
             raise AggParseError(
                 f"aggregation {name!r}: unsupported sub-aggregation {sub_kind}")
-    return tuple(metrics), sub_bucket
+    return tuple(metrics), tuple(sub_buckets)
 
 
 def _agg_kind(body: dict[str, Any]) -> str:
@@ -176,7 +178,7 @@ def _parse_one(name: str, body: dict[str, Any], depth: int = 0) -> AggSpec:
     kind = _agg_kind(body)
     params = body[kind]
     sub = body.get("aggs") or body.get("aggregations") or {}
-    sub_metrics, sub_bucket = _parse_sub_aggs(name, sub, depth)
+    sub_metrics, sub_buckets = _parse_sub_aggs(name, sub, depth)
     if kind == "date_histogram":
         interval = params.get("fixed_interval") or params.get("interval")
         if interval is None:
@@ -198,12 +200,12 @@ def _parse_one(name: str, body: dict[str, Any], depth: int = 0) -> AggSpec:
             interval_micros=parse_interval_micros(interval),
             min_doc_count=params.get("min_doc_count", 0),
             extended_bounds=bounds, offset_micros=offset,
-            sub_metrics=sub_metrics, sub_bucket=sub_bucket)
+            sub_metrics=sub_metrics, sub_buckets=sub_buckets)
     if kind == "histogram":
         return HistogramAgg(
             name=name, field=params["field"], interval=float(params["interval"]),
             min_doc_count=params.get("min_doc_count", 0),
-            sub_metrics=sub_metrics, sub_bucket=sub_bucket)
+            sub_metrics=sub_metrics, sub_buckets=sub_buckets)
     if kind == "terms":
         order = params.get("order", {"_count": "desc"})
         if not isinstance(order, dict) or len(order) != 1:
@@ -249,7 +251,7 @@ def _parse_one(name: str, body: dict[str, Any], depth: int = 0) -> AggSpec:
             order_by_count_desc=order_dir == "desc",
             order_target=order_target,
             split_size=int(split_size) if split_size is not None else None,
-            sub_metrics=sub_metrics, sub_bucket=sub_bucket)
+            sub_metrics=sub_metrics, sub_buckets=sub_buckets)
     if kind == "range":
         ranges = []
         for r in params.get("ranges", ()):
@@ -262,7 +264,7 @@ def _parse_one(name: str, body: dict[str, Any], depth: int = 0) -> AggSpec:
             ranges.append((str(key), lo, hi))
         if not ranges:
             raise AggParseError(f"range aggregation {name!r} needs ranges")
-        if sub_bucket is not None:
+        if sub_buckets:
             raise AggParseError(
                 f"range aggregation {name!r}: nested bucket aggs under "
                 "range are not supported yet")
@@ -272,13 +274,13 @@ def _parse_one(name: str, body: dict[str, Any], depth: int = 0) -> AggSpec:
         if depth > 0:
             raise AggParseError(
                 f"composite aggregation {name!r} must be top-level")
-        if sub_metrics or sub_bucket:
+        if sub_metrics or sub_buckets:
             raise AggParseError(
                 f"composite aggregation {name!r}: sub-aggregations under "
                 "composite are not supported yet")
         return _parse_composite(name, params)
     if kind in _METRIC_KINDS:
-        if sub_metrics or sub_bucket:
+        if sub_metrics or sub_buckets:
             raise AggParseError(f"metric aggregation {name!r} cannot have sub-aggs")
         return _parse_metric(name, kind, params)
     raise AggParseError(f"unsupported aggregation kind {kind!r}")

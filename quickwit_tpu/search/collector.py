@@ -258,16 +258,21 @@ def _range_to_map(state: dict[str, Any]) -> dict:
 
 
 def _carry_sub_info(copy: dict, state: dict) -> None:
-    """Finalization parameters of the nested child aggregation (one level)."""
-    sub = state.get("sub")
-    if sub is None:
-        copy.pop("sub", None)
-        return
-    copy["sub_info"] = {k: sub.get(k) for k in
-                        ("name", "kind", "interval", "origin", "min_doc_count",
-                         "size", "order_desc", "order_target",
-                         "extended_bounds")}
-    copy.pop("sub", None)
+    """Finalization parameters of the nested children, all levels."""
+    subs = state.get("subs")
+    copy.pop("subs", None)
+    if subs:
+        copy["sub_infos"] = [_sub_info_of(sub) for sub in subs]
+
+
+def _sub_info_of(sub: dict) -> dict:
+    info = {k: sub.get(k) for k in
+            ("name", "kind", "interval", "origin", "min_doc_count",
+             "size", "order_desc", "order_target", "extended_bounds",
+             "offset")}
+    if sub.get("subs"):
+        info["sub_infos"] = [_sub_info_of(s) for s in sub["subs"]]
+    return info
 
 
 def _new_metric_acc(kind: str, percents=None, keyed: bool = True) -> dict[str, Any]:
@@ -295,8 +300,9 @@ def _acc_metric(acc: dict[str, Any], arrays: dict[str, np.ndarray], i: int) -> N
 def _copy_bucket_map(bucket_map: dict) -> dict:
     return {key: {"doc_count": b["doc_count"],
                   "metrics": {m: dict(acc) for m, acc in b["metrics"].items()},
-                  **({"sub_map": _copy_bucket_map(b["sub_map"])}
-                     if "sub_map" in b else {})}
+                  **({"sub_maps": {n: _copy_bucket_map(m)
+                                   for n, m in b["sub_maps"].items()}}
+                     if "sub_maps" in b else {})}
             for key, b in bucket_map.items()}
 
 
@@ -307,35 +313,40 @@ def _sub_key(sub: dict, j: int):
     return sub["origin"] + j * sub["interval"]
 
 
-def _attach_sub_map(bucket: dict, state: dict, parent_index: int) -> None:
-    """Nested child buckets of one parent bucket, decoded from the flattened
-    [nb1*nb2] device states."""
-    sub = state.get("sub")
-    if sub is None:
+def _attach_sub_maps(bucket: dict, state: dict, parent_flat: int) -> None:
+    """Nested children of one parent bucket, decoded recursively from the
+    flattened mixed-radix device states (child flat index =
+    parent_flat * child_nb + child_local)."""
+    subs = state.get("subs")
+    if not subs:
         return
-    nb2 = sub["nb2"]
-    base = parent_index * nb2
-    counts = sub["counts"]
-    metric_kinds = sub.get("metric_kinds", {})
-    metric_percents = sub.get("metric_percents", {})
-    metric_keyed = sub.get("metric_keyed", {})
-    sub_map: dict = {}
-    for j in range(nb2):
-        flat = base + j
-        if flat >= len(counts) or counts[flat] == 0:
-            continue
-        key = _sub_key(sub, j)
-        if key is None:
-            continue
-        child = {"doc_count": int(counts[flat]), "metrics": {}}
-        for mname, arrays in sub.get("metrics", {}).items():
-            acc = _new_metric_acc(metric_kinds.get(mname, "avg"),
-                                  metric_percents.get(mname),
-                                  metric_keyed.get(mname, True))
-            _acc_metric(acc, arrays, flat)
-            child["metrics"][mname] = acc
-        sub_map[key] = child
-    bucket["sub_map"] = sub_map
+    sub_maps: dict = {}
+    for sub in subs:
+        nb = sub["nb"]
+        base = parent_flat * nb
+        counts = sub["counts"]
+        metric_kinds = sub.get("metric_kinds", {})
+        metric_percents = sub.get("metric_percents", {})
+        metric_keyed = sub.get("metric_keyed", {})
+        sub_map: dict = {}
+        for j in range(nb):
+            flat = base + j
+            if flat >= len(counts) or counts[flat] == 0:
+                continue
+            key = _sub_key(sub, j)
+            if key is None:
+                continue
+            child = {"doc_count": int(counts[flat]), "metrics": {}}
+            for mname, arrays in sub.get("metrics", {}).items():
+                acc = _new_metric_acc(metric_kinds.get(mname, "avg"),
+                                      metric_percents.get(mname),
+                                      metric_keyed.get(mname, True))
+                _acc_metric(acc, arrays, flat)
+                child["metrics"][mname] = acc
+            _attach_sub_maps(child, sub, flat)
+            sub_map[key] = child
+        sub_maps[sub["name"]] = sub_map
+    bucket["sub_maps"] = sub_maps
 
 
 def _histogram_to_map(state: dict[str, Any]) -> dict[float, dict[str, Any]]:
@@ -358,7 +369,7 @@ def _histogram_to_map(state: dict[str, Any]) -> dict[float, dict[str, Any]]:
                                   metric_keyed.get(mname, True))
             _acc_metric(acc, arrays, int(i))
             bucket["metrics"][mname] = acc
-        _attach_sub_map(bucket, state, int(i))
+        _attach_sub_maps(bucket, state, int(i))
         out[key] = bucket
     return out
 
@@ -382,7 +393,7 @@ def _terms_to_map(state: dict[str, Any]) -> dict[Any, dict[str, Any]]:
                                   metric_keyed.get(mname, True))
             _acc_metric(acc, arrays, int(i))
             bucket["metrics"][mname] = acc
-        _attach_sub_map(bucket, state, int(i))
+        _attach_sub_maps(bucket, state, int(i))
         out[keys[i]] = bucket
     return out
 
@@ -408,11 +419,15 @@ def _merge_bucket_maps(bucket_map: dict, incoming: dict) -> None:
                     cacc["sketch"] = acc["sketch"] \
                         if cacc.get("sketch") is None \
                         else cacc["sketch"] + acc["sketch"]
-        if "sub_map" in bucket:
-            if "sub_map" not in cur:
-                cur["sub_map"] = bucket["sub_map"]
+        if "sub_maps" in bucket:
+            if "sub_maps" not in cur:
+                cur["sub_maps"] = bucket["sub_maps"]
             else:
-                _merge_bucket_maps(cur["sub_map"], bucket["sub_map"])
+                for name, sub_map in bucket["sub_maps"].items():
+                    if name not in cur["sub_maps"]:
+                        cur["sub_maps"][name] = sub_map
+                    else:
+                        _merge_bucket_maps(cur["sub_maps"][name], sub_map)
 
 
 def _merge_histogram(current: dict[str, Any], state: dict[str, Any]) -> None:
@@ -531,9 +546,9 @@ class _KeyOrd:
 
 
 def _finalize_bucket_map(bucket_map: dict, info: dict[str, Any],
-                         sub_info: Optional[dict] = None) -> dict[str, Any]:
-    """One bucket map → ES-shaped buckets. Shared by top-level aggregations
-    and nested children (children never have grandchildren: one level)."""
+                         sub_infos: Optional[list] = None) -> dict[str, Any]:
+    """One bucket map → ES-shaped buckets, recursing into nested children
+    at any depth."""
     kind = info["kind"]
 
     def entry_for(key, bucket, key_scaled):
@@ -544,9 +559,10 @@ def _finalize_bucket_map(bucket_map: dict, info: dict[str, Any],
             entry["key_as_string"] = format_micros_rfc3339(int(key))
         for mname, acc in bucket["metrics"].items():
             entry[mname] = _finalize_metric(acc)
-        if sub_info is not None:
-            entry[sub_info["name"]] = _finalize_bucket_map(
-                bucket.get("sub_map", {}), sub_info)
+        for child_info in (sub_infos or ()):
+            entry[child_info["name"]] = _finalize_bucket_map(
+                bucket.get("sub_maps", {}).get(child_info["name"], {}),
+                child_info, child_info.get("sub_infos"))
         return entry
 
     if kind == "terms":
@@ -636,7 +652,8 @@ def finalize_aggregations(agg_states: dict[str, Any]) -> dict[str, Any]:
         kind = state["kind"]
         if kind in ("date_histogram", "histogram", "terms"):
             out[name] = _finalize_bucket_map(
-                state["bucket_map"], state, sub_info=state.get("sub_info"))
+                state["bucket_map"], state,
+                sub_infos=state.get("sub_infos"))
         elif kind == "range":
             buckets = []
             for i, (key, lo, hi) in enumerate(state["ranges"]):

@@ -140,22 +140,32 @@ def _eval_range_agg(a: BucketAggExec, arrays, mask):
 def _eval_bucket_agg(a: BucketAggExec, arrays, scalars, mask):
     if a.kind == "range":
         return _eval_range_agg(a, arrays, mask)
-    nb = a.num_buckets
     idx, m = _bucket_idx(a, arrays, scalars, mask)
-    counts = agg_ops.bucket_counts(idx, nb)
-    out: dict[str, Any] = {"counts": counts,
-                           "metrics": _bucket_metrics(a.metrics, arrays, idx,
-                                                      m, nb)}
-    if a.sub is not None:
-        nb2 = a.sub.num_buckets
-        idx2, m2 = _bucket_idx(a.sub, arrays, scalars, mask)
+    return _eval_bucket_level(a, arrays, scalars, mask, idx, m,
+                              a.num_buckets)
+
+
+def _eval_bucket_level(a: BucketAggExec, arrays, scalars, mask, idx, m,
+                       space: int):
+    """One level of a nested bucket tree. `idx`/`m` are the FLATTENED
+    bucket index (mixed-radix over all ancestors) and its validity mask;
+    `space` is the flattened bucket count. Children extend the radix:
+    child_flat = parent_flat * child_nb + child_local."""
+    out: dict[str, Any] = {
+        "counts": agg_ops.bucket_counts(jnp.where(m, idx, jnp.int32(space)),
+                                        space),
+        "metrics": _bucket_metrics(a.metrics, arrays, idx, m, space),
+    }
+    subs = []
+    for child in a.subs:
+        nb2 = child.num_buckets
+        idx2, m2 = _bucket_idx(child, arrays, scalars, mask)
         both = m & m2
-        combined = jnp.where(both, idx * nb2 + idx2, jnp.int32(nb * nb2))
-        out["sub"] = {
-            "counts": agg_ops.bucket_counts(combined, nb * nb2),
-            "metrics": _bucket_metrics(a.sub.metrics, arrays, combined, both,
-                                       nb * nb2),
-        }
+        combined = jnp.where(both, idx * nb2 + idx2, jnp.int32(space * nb2))
+        subs.append(_eval_bucket_level(child, arrays, scalars, mask,
+                                       combined, both, space * nb2))
+    if subs:
+        out["subs"] = subs
     return out
 
 
@@ -240,10 +250,14 @@ def _posting_space_eligible(plan: LoweredPlan) -> bool:
                 return False
             if any(m.kind == "cardinality" for m in a.metrics):
                 return False
-            if a.sub is not None and (
-                    a.sub.kind in ("range", "terms_mv")
-                    or any(m.kind == "cardinality" for m in a.sub.metrics)):
-                return False
+            stack = list(a.subs)
+            while stack:
+                child = stack.pop()
+                if (child.kind in ("range", "terms_mv")
+                        or any(m.kind == "cardinality"
+                               for m in child.metrics)):
+                    return False
+                stack.extend(child.subs)
         elif isinstance(a, MetricAggExec):
             if a.metric.kind == "cardinality":
                 return False

@@ -325,6 +325,29 @@ def _truncate_terms_state(state: dict[str, Any]) -> None:
     state["counts"] = kept
 
 
+def _sub_state(child, res) -> dict[str, Any]:
+    """Mergeable state of one nested bucket child: counts/metrics over
+    the FLATTENED (ancestor-radix) space, plus its own children."""
+    state = {
+        "name": child.name,
+        "kind": "terms" if child.kind == "terms_mv" else child.kind,
+        "nb": child.num_buckets,
+        "counts": np.asarray(res["counts"]),
+        "metrics": {name: {k: np.asarray(v) for k, v in m.items()}
+                    for name, m in res["metrics"].items()},
+        "metric_kinds": {m.name: m.kind for m in child.metrics},
+        "metric_percents": {m.name: list(m.percents) for m in child.metrics
+                            if m.kind == "percentiles"},
+        "metric_keyed": {m.name: m.keyed for m in child.metrics},
+        **child.host_info,
+    }
+    if child.subs and "subs" in res:
+        state["subs"] = [_sub_state(grandchild, grand_res)
+                        for grandchild, grand_res
+                        in zip(child.subs, res["subs"])]
+    return state
+
+
 def _intermediate_aggs(plan, agg_results: list) -> dict[str, Any]:
     """Device outputs + host_info → the mergeable intermediate agg states
     (role of the reference's serialized intermediate aggregation results)."""
@@ -351,21 +374,10 @@ def _intermediate_aggs(plan, agg_results: list) -> dict[str, Any]:
                 # could rank low by count in every split), so those
                 # orders forward exact per-split states instead
                 _truncate_terms_state(state)
-            if a.sub is not None and "sub" in res:
-                state["sub"] = {
-                    "name": a.sub.name, "kind": a.sub.kind,
-                    "nb2": a.sub.num_buckets,
-                    "counts": np.asarray(res["sub"]["counts"]),
-                    "metrics": {name: {k: np.asarray(v) for k, v in m.items()}
-                                for name, m in res["sub"]["metrics"].items()},
-                    "metric_kinds": {m.name: m.kind for m in a.sub.metrics},
-                    "metric_percents": {m.name: list(m.percents)
-                                        for m in a.sub.metrics
-                                        if m.kind == "percentiles"},
-                    "metric_keyed": {m.name: m.keyed
-                                     for m in a.sub.metrics},
-                    **a.sub.host_info,
-                }
+            if a.subs and "subs" in res:
+                state["subs"] = [_sub_state(child, child_res)
+                                 for child, child_res
+                                 in zip(a.subs, res["subs"])]
             out[a.name] = state
         elif isinstance(a, CompositeAggExec):
             run_keys = np.asarray(res["run_keys"])       # [S, k_runs]

@@ -161,16 +161,17 @@ class BucketAggExec:
     metrics: tuple[MetricSlots, ...] = ()
     # host-side info for finalization (not part of jit signature)
     host_info: Any = None
-    # one nested bucket level (e.g. date_histogram > terms)
-    sub: Optional["BucketAggExec"] = None
+    # nested bucket children, arbitrary depth and siblings; each chain
+    # computes over a mixed-radix flattened bucket space on device
+    subs: tuple["BucketAggExec", ...] = ()
 
     def sig(self) -> str:
-        sub_sig = self.sub.sig() if self.sub is not None else ""
+        subs_sig = ";".join(s.sig() for s in self.subs)
         return (f"bagg({self.kind},{self.values_slot},{self.present_slot},"
                 f"{self.num_buckets},{self.origin_slot},{self.interval_slot},"
                 f"{self.froms_slot},{self.tos_slot},"
                 + ",".join(m.sig() for m in self.metrics)
-                + f",sub[{sub_sig}])")
+                + f",subs[{subs_sig}])")
 
 
 @dataclass(frozen=True)
@@ -872,25 +873,36 @@ class Lowering:
             return MetricAggExec(spec.name, self.lower_metric(spec))
         if isinstance(spec, CompositeAgg):
             return self._lower_composite_agg(spec)
-        exec_ = self._lower_bucket_agg(spec)
-        sub_spec = getattr(spec, "sub_bucket", None)
-        if sub_spec is not None:
-            # nested children resolve batch overrides under a path-qualified
-            # key: ES names are only unique per level, so a child may legally
-            # share a name with another aggregation
-            child = self._lower_bucket_agg(
-                sub_spec, override_key=f"{spec.name}>{sub_spec.name}")
+        return self._lower_bucket_tree(spec, spec.name, spec.name,
+                                       parent_space=1)
+
+    def _lower_bucket_tree(self, spec: AggSpec, path: str, top_name: str,
+                           parent_space: int) -> "BucketAggExec":
+        """Lower one bucket agg and its children recursively. Children
+        resolve batch overrides under path-qualified keys ("a>b>c"): ES
+        names are only unique per level. `parent_space` is the flattened
+        bucket count above this node — the chain product is capped."""
+        exec_ = self._lower_bucket_agg(spec, override_key=path)
+        space = parent_space * max(exec_.num_buckets, 1)
+        if space > MAX_BUCKETS and parent_space > 1:
+            # the cap guards the flattened PRODUCT space; a single level's
+            # own bucket count is governed by its own kind's limits
+            # (histogram caps at lowering; terms ordinal spaces uncapped)
+            raise PlanError(
+                f"nested aggregation {path!r} would create {space} "
+                f"buckets (max {MAX_BUCKETS})")
+        children = []
+        for sub_spec in getattr(spec, "sub_buckets", ()):
+            child = self._lower_bucket_tree(
+                sub_spec, f"{path}>{sub_spec.name}", top_name, space)
             if exec_.kind == "terms_mv" or child.kind == "terms_mv":
                 raise PlanError(
                     "multivalued terms aggs cannot nest (pair arrays and "
                     "doc-space buckets have different shapes)")
-            if exec_.num_buckets * child.num_buckets > MAX_BUCKETS:
-                raise PlanError(
-                    f"nested aggregation {spec.name!r}>{sub_spec.name!r} would "
-                    f"create {exec_.num_buckets * child.num_buckets} buckets "
-                    f"(max {MAX_BUCKETS})")
+            children.append(child)
+        if children:
             from dataclasses import replace as dc_replace
-            exec_ = dc_replace(exec_, sub=child)
+            exec_ = dc_replace(exec_, subs=tuple(children))
         return exec_
 
     def _lower_bucket_agg(self, spec: AggSpec,
@@ -1062,7 +1074,7 @@ class Lowering:
                 raise PlanError(
                     f"multivalued terms agg {spec.field!r} is per-split "
                     "(batch path falls back)")
-            if spec.sub_metrics or spec.sub_bucket:
+            if spec.sub_metrics or spec.sub_buckets:
                 raise PlanError(
                     f"sub-aggregations under multivalued terms "
                     f"{spec.field!r} are not supported yet")

@@ -1,0 +1,163 @@
+"""Arbitrary-depth nested bucket aggregations (reference: tantivy's
+recursive aggregation tree driven via quickwit, collector.rs:523).
+
+The device computes every chain over a mixed-radix flattened bucket
+space; these tests check 3-level chains, sibling children, percentiles
+under nested buckets, and exactness across multi-split merges against a
+brute-force oracle."""
+
+import numpy as np
+import pytest
+
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.index import SplitReader, SplitWriter
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.query.ast import MatchAll
+from quickwit_tpu.query.aggregations import AggParseError, parse_aggs
+from quickwit_tpu.search import (
+    IncrementalCollector, SearchRequest, leaf_search_single_split,
+)
+from quickwit_tpu.search.collector import finalize_aggregations
+from quickwit_tpu.storage import RamStorage
+
+MAPPER = DocMapper(field_mappings=[
+    FieldMapping("ts", FieldType.DATETIME, fast=True,
+                 input_formats=("unix_timestamp",)),
+    FieldMapping("service", FieldType.TEXT, tokenizer="raw", fast=True),
+    FieldMapping("level", FieldType.TEXT, tokenizer="raw", fast=True),
+    FieldMapping("latency", FieldType.F64, fast=True),
+], timestamp_field="ts")
+
+DAY = 86_400
+
+
+def _docs(rng, n, day_range):
+    services = ["api", "web", "worker"]
+    levels = ["INFO", "WARN", "ERROR"]
+    return [{"ts": int(rng.randint(0, day_range)) * DAY + 3600,
+             "service": services[rng.randint(len(services))],
+             "level": levels[rng.randint(len(levels))],
+             "latency": float(rng.randint(1, 100))}
+            for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.RandomState(42)
+    storage = RamStorage(Uri.parse("ram:///nested"))
+    all_docs = []
+    readers = []
+    for s in range(3):
+        docs = _docs(rng, 80, day_range=4)
+        w = SplitWriter(MAPPER)
+        for d in docs:
+            w.add_json_doc(d)
+        storage.put(f"s{s}.split", w.finish())
+        readers.append(SplitReader(storage, f"s{s}.split"))
+        all_docs.extend(docs)
+    return readers, all_docs
+
+
+def _search(readers, aggs):
+    request = SearchRequest(index_ids=["x"], query_ast=MatchAll(),
+                            max_hits=0, aggs=aggs)
+    collector = IncrementalCollector(max_hits=0)
+    for i, reader in enumerate(readers):
+        collector.add_leaf_response(leaf_search_single_split(
+            request, MAPPER, reader, f"s{i}"))
+    return finalize_aggregations(collector.aggregation_states())
+
+
+def test_three_level_nesting_exact(corpus):
+    readers, docs = corpus
+    result = _search(readers, {
+        "days": {"date_histogram": {"field": "ts", "fixed_interval": "1d"},
+                 "aggs": {"svc": {"terms": {"field": "service", "size": 10},
+                                  "aggs": {"lvl": {"terms": {
+                                      "field": "level", "size": 10}}}}}}})
+    for day_bucket in result["days"]["buckets"]:
+        day_lo = day_bucket["key"] * 1000  # ES ms key -> micros
+        day_docs = [d for d in docs
+                    if day_lo <= d["ts"] * 1_000_000 < day_lo + DAY * 1e6]
+        assert day_bucket["doc_count"] == len(day_docs)
+        for svc_bucket in day_bucket["svc"]["buckets"]:
+            svc_docs = [d for d in day_docs
+                        if d["service"] == svc_bucket["key"]]
+            assert svc_bucket["doc_count"] == len(svc_docs)
+            for lvl_bucket in svc_bucket["lvl"]["buckets"]:
+                n = sum(1 for d in svc_docs
+                        if d["level"] == lvl_bucket["key"])
+                assert lvl_bucket["doc_count"] == n
+
+
+def test_sibling_children_and_metrics(corpus):
+    readers, docs = corpus
+    result = _search(readers, {
+        "svc": {"terms": {"field": "service", "size": 10},
+                "aggs": {
+                    "lvl": {"terms": {"field": "level", "size": 10}},
+                    "by_day": {"date_histogram": {
+                        "field": "ts", "fixed_interval": "1d"}},
+                    "lat": {"avg": {"field": "latency"}}}}})
+    for b in result["svc"]["buckets"]:
+        sdocs = [d for d in docs if d["service"] == b["key"]]
+        assert b["doc_count"] == len(sdocs)
+        assert b["lat"]["value"] == pytest.approx(
+            np.mean([d["latency"] for d in sdocs]))
+        assert sum(x["doc_count"] for x in b["lvl"]["buckets"]) == len(sdocs)
+        assert sum(x["doc_count"]
+                   for x in b["by_day"]["buckets"]) == len(sdocs)
+
+
+def test_percentiles_under_nested_buckets(corpus):
+    readers, docs = corpus
+    result = _search(readers, {
+        "days": {"date_histogram": {"field": "ts", "fixed_interval": "1d"},
+                 "aggs": {"svc": {"terms": {"field": "service", "size": 10},
+                                  "aggs": {"pct": {"percentiles": {
+                                      "field": "latency",
+                                      "percents": [50, 95]}}}}}}})
+    checked = 0
+    for day_bucket in result["days"]["buckets"]:
+        day_lo = day_bucket["key"] * 1000
+        for svc_bucket in day_bucket["svc"]["buckets"]:
+            vals = [d["latency"] for d in docs
+                    if day_lo <= d["ts"] * 1_000_000 < day_lo + DAY * 1e6
+                    and d["service"] == svc_bucket["key"]]
+            got = svc_bucket["pct"]["values"]["50"]
+            assert got is not None
+            # exact DDSketch rank convention: the 0-based
+            # floor(q·(n-1))-th item, within the sketch's relative
+            # accuracy (alpha=1%)
+            expected = sorted(vals)[int(np.floor(0.5 * (len(vals) - 1)))]
+            assert abs(got - expected) <= 0.03 * expected + 1e-9, \
+                (got, expected, sorted(vals))
+            checked += 1
+    assert checked >= 6
+
+
+def test_nested_bucket_space_capped():
+    from quickwit_tpu.search.plan import PlanError
+    storage = RamStorage(Uri.parse("ram:///nested-cap"))
+    rng = np.random.RandomState(0)
+    w = SplitWriter(MAPPER)
+    for d in _docs(rng, 50, day_range=3650):  # ten years of days
+        w.add_json_doc(d)
+    storage.put("wide.split", w.finish())
+    reader = SplitReader(storage, "wide.split")
+    # each level alone fits (3650 buckets) but the chain product does not
+    request = SearchRequest(
+        index_ids=["x"], query_ast=MatchAll(), max_hits=0,
+        aggs={"d1": {"date_histogram": {"field": "ts",
+                                        "fixed_interval": "1d"},
+                     "aggs": {"d2": {"date_histogram": {
+                         "field": "ts", "fixed_interval": "1d"}}}}})
+    with pytest.raises(PlanError, match="nested aggregation"):
+        leaf_search_single_split(request, MAPPER, reader, "wide")
+
+
+def test_composite_still_rejects_sub_aggs():
+    with pytest.raises(AggParseError):
+        parse_aggs({"c": {"composite": {"sources": [
+            {"s": {"terms": {"field": "service"}}}]},
+            "aggs": {"m": {"avg": {"field": "latency"}}}}})
