@@ -115,7 +115,7 @@ class VoidSource(Source):
         return iter(())
 
 
-_UNSUPPORTED = {"kafka", "kinesis", "pulsar", "sqs", "gcp_pubsub"}
+_UNSUPPORTED = {"kinesis", "pulsar", "sqs", "gcp_pubsub"}
 
 
 def make_source(source_type: str, params: dict[str, Any]) -> Source:
@@ -125,10 +125,26 @@ def make_source(source_type: str, params: dict[str, Any]) -> Source:
         return FileSource(params["filepath"])
     if source_type == "void":
         return VoidSource()
+    if source_type == "kafka":
+        # reference SourceParams::Kafka shape: topic + librdkafka-style
+        # client_params carrying bootstrap.servers
+        from .kafka import KafkaSource
+        servers = (params.get("client_params", {})
+                   .get("bootstrap.servers")
+                   or params.get("bootstrap_servers"))
+        if not servers:
+            raise ValueError(
+                "kafka source requires client_params[\"bootstrap.servers\"]")
+        if isinstance(servers, str):
+            servers = [s.strip() for s in servers.split(",") if s.strip()]
+        if "topic" not in params:
+            raise ValueError("kafka source requires a topic")
+        return KafkaSource(servers, params["topic"])
     if source_type in _UNSUPPORTED:
         raise NotImplementedError(
             f"source type {source_type!r} requires an external client SDK not "
-            "available in this build; use 'file', 'vec', or the ingest API")
+            "available in this build; use 'file', 'vec', 'kafka', or the "
+            "ingest API")
     raise ValueError(f"unknown source type {source_type!r}")
 
 

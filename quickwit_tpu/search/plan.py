@@ -873,10 +873,9 @@ class Lowering:
             return MetricAggExec(spec.name, self.lower_metric(spec))
         if isinstance(spec, CompositeAgg):
             return self._lower_composite_agg(spec)
-        return self._lower_bucket_tree(spec, spec.name, spec.name,
-                                       parent_space=1)
+        return self._lower_bucket_tree(spec, spec.name, parent_space=1)
 
-    def _lower_bucket_tree(self, spec: AggSpec, path: str, top_name: str,
+    def _lower_bucket_tree(self, spec: AggSpec, path: str,
                            parent_space: int) -> "BucketAggExec":
         """Lower one bucket agg and its children recursively. Children
         resolve batch overrides under path-qualified keys ("a>b>c"): ES
@@ -894,7 +893,7 @@ class Lowering:
         children = []
         for sub_spec in getattr(spec, "sub_buckets", ()):
             child = self._lower_bucket_tree(
-                sub_spec, f"{path}>{sub_spec.name}", top_name, space)
+                sub_spec, f"{path}>{sub_spec.name}", space)
             if exec_.kind == "terms_mv" or child.kind == "terms_mv":
                 raise PlanError(
                     "multivalued terms aggs cannot nest (pair arrays and "

@@ -270,6 +270,8 @@ class Node:
         self.clients: dict[str, Any] = {
             config.node_id: LocalSearchClient(self.search_service)}
         self._transform_cache: dict[tuple, Any] = {}
+        # cached external-source clients (kafka connections survive passes)
+        self._external_sources: dict[tuple, Any] = {}
         self.root_searcher = RootSearcher(
             self.metastore, self.clients,
             nodes_provider=lambda: self.cluster.nodes_with_role("searcher"))
@@ -394,6 +396,66 @@ class Node:
         return {"num_docs_for_processing": len(docs),
                 "num_ingested_docs": counters.num_docs_processed,
                 "num_invalid_docs": counters.num_docs_invalid}
+
+    # source types with their own drive paths (REST ingest / WAL drain)
+    _INTERNAL_SOURCE_TYPES = ("vec", "void", "ingest_api", "ingest_v2")
+
+    def run_source_pass(self, index_id: str, source_id: str):
+        """Drain one configured EXTERNAL source (file/kafka) through an
+        indexing pipeline pass — the role of the reference's per-(index,
+        source) pipeline actors under IndexingService
+        (`indexing_service.rs:1152`). Checkpoints make each pass resume
+        exactly where the last one stopped; source clients are cached so
+        broker connections persist across passes."""
+        metadata = self.metastore.index_metadata(index_id)
+        source_config = metadata.sources.get(source_id)
+        if (source_config is None or not source_config.enabled
+                or source_config.source_type in self._INTERNAL_SOURCE_TYPES):
+            return None
+        # config fingerprint in the key: delete + re-add with the same
+        # source_id but a new topic/brokers must not keep consuming the
+        # old config through a stale cached client
+        fingerprint = json.dumps(
+            [source_config.source_type, source_config.params],
+            sort_keys=True)
+        key = (metadata.index_uid, source_id)
+        cached = self._external_sources.get(key)
+        if cached is not None and cached[0] != fingerprint:
+            self._close_source(cached[1])
+            cached = None
+        if cached is None:
+            cached = (fingerprint,
+                      make_source(source_config.source_type,
+                                  source_config.params))
+            self._external_sources[key] = cached
+        source = cached[1]
+        storage = self.storage_resolver.resolve(
+            metadata.index_config.index_uri)
+        pipeline = IndexingPipeline(
+            PipelineParams(
+                index_uid=metadata.index_uid, source_id=source_id,
+                node_id=self.config.node_id,
+                split_num_docs_target=metadata.index_config
+                .split_num_docs_target),
+            metadata.index_config.doc_mapper, source, self.metastore,
+            storage, transform=self._transform_for(metadata, source_id))
+        try:
+            return pipeline.run_to_completion()
+        except Exception:
+            # a broken source connection must not wedge future passes on
+            # a stale cached client
+            self._external_sources.pop(key, None)
+            self._close_source(source)
+            raise
+
+    @staticmethod
+    def _close_source(source) -> None:
+        close = getattr(source, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:  # noqa: BLE001 - best-effort socket cleanup
+                logger.debug("source close failed", exc_info=True)
 
     def _transform_for(self, metadata: IndexMetadata, source_id: str):
         """Compiled doc transform from the source config's
@@ -963,6 +1025,22 @@ class Node:
                         self._cooperative_drain(metadata)
                     else:
                         self.run_ingest_pass(metadata.index_id)
+                # configured external sources (file/kafka): owner-gated so
+                # one node consumes each index's partitions (the reference
+                # control plane assigns (source,partition)→indexer; our
+                # rendezvous election is the same single-consumer rule)
+                for source_id, source_config in metadata.sources.items():
+                    if (source_config.enabled
+                            and source_config.source_type
+                            not in self._INTERNAL_SOURCE_TYPES
+                            and owns_index(metadata.index_uid)):
+                        try:
+                            self.run_source_pass(metadata.index_id,
+                                                 source_id)
+                        except Exception as exc:  # noqa: BLE001
+                            logger.warning(
+                                "source %s/%s pass failed: %s",
+                                metadata.index_id, source_id, exc)
             # deleted indexes release their cooperative state (index
             # churn must not grow these dicts forever)
             for state in (self._coop_cycles, self._coop_next_wake,
@@ -970,6 +1048,9 @@ class Node:
                 for uid in list(state):
                     if uid not in live_uids:
                         del state[uid]
+            for key in list(self._external_sources):
+                if key[0] not in live_uids:
+                    self._close_source(self._external_sources.pop(key)[1])
 
         def merge_tick() -> None:
             # compactor nodes own merging when present; indexers merge
