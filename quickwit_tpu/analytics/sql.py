@@ -18,11 +18,16 @@ Dialect (vertical slice):
     SELECT <agg|col|DATE_TRUNC('unit', col)> [AS alias], ...
     FROM <index>
     [WHERE <col op literal> [AND|OR ...] ]
-    [GROUP BY <col | DATE_TRUNC('unit', col)> [, <col>]]
+    [GROUP BY <col | DATE_TRUNC('unit', col)> [, ...]]     -- any depth
+    [HAVING <agg|alias> <op> <number> [AND ...]]
     [ORDER BY <alias|expr> [ASC|DESC]]
-    [LIMIT n]
+    [LIMIT n] [OFFSET n]
 
-Aggregates: COUNT(*), COUNT(col), SUM, AVG, MIN, MAX.
+Aggregates: COUNT(*), COUNT(col), SUM, AVG, MIN, MAX, STDDEV, VARIANCE,
+APPROX_PERCENTILE(col, p) — the last rides the DDSketch percentile
+kernels (the fork's sketch UDFs, `quickwit-datafusion/src/sources/
+metrics/sketch_udf.rs`). GROUP BY chains compile onto the arbitrary-
+depth nested bucket spaces, so N keys = one device pass.
 Operators: = != <> < <= > >= ; string/number literals; AND/OR + parens.
 """
 
@@ -56,8 +61,9 @@ _TOKEN_RE = re.compile(r"""
     )""", re.VERBOSE)
 
 _KEYWORDS = {"select", "from", "where", "group", "by", "order", "limit",
-             "and", "or", "as", "asc", "desc", "count", "sum", "avg",
-             "min", "max", "date_trunc"}
+             "offset", "having", "and", "or", "as", "asc", "desc",
+             "count", "sum", "avg", "min", "max", "stddev", "variance",
+             "approx_percentile", "date_trunc"}
 
 
 def _tokenize(text: str) -> list[tuple[str, str]]:
@@ -93,6 +99,7 @@ class SelectItem:
     column: Optional[str] = None
     unit: Optional[str] = None
     alias: Optional[str] = None
+    percent: Optional[float] = None   # approx_percentile
 
     @property
     def name(self) -> str:
@@ -101,6 +108,8 @@ class SelectItem:
         if self.kind == "count_star":
             return "count(*)"
         if self.kind == "agg":
+            if self.func == "approx_percentile":
+                return f"approx_percentile({self.column}, {self.percent:g})"
             return f"{self.func}({self.column})"
         if self.kind == "trunc":
             return f"date_trunc('{self.unit}', {self.column})"
@@ -114,7 +123,9 @@ class SqlQuery:
     where: Optional[Q.QueryAst] = None
     group_by: list[SelectItem] = field(default_factory=list)
     order_by: Optional[tuple[str, bool]] = None  # (name, desc)
+    having: list[tuple[str, str, float]] = field(default_factory=list)
     limit: Optional[int] = None
+    offset: int = 0
 
 
 class _Parser:
@@ -163,6 +174,11 @@ class _Parser:
             group_by.append(self.group_key())
             while self.accept("op", ","):
                 group_by.append(self.group_key())
+        having: list[tuple[str, str, float]] = []
+        if self.accept("kw", "having"):
+            having.append(self.having_clause())
+            while self.accept("kw", "and"):
+                having.append(self.having_clause())
         order_by = None
         if self.accept("kw", "order"):
             self.expect("kw", "by")
@@ -176,10 +192,22 @@ class _Parser:
         limit = None
         if self.accept("kw", "limit"):
             limit = int(self.expect("number")[1])
+        offset = 0
+        if self.accept("kw", "offset"):
+            offset = int(self.expect("number")[1])
         if self.peek() is not None:
             raise SqlError(f"unexpected trailing token {self.peek()[1]!r}")
         return SqlQuery(index=index, select=select, where=where,
-                        group_by=group_by, order_by=order_by, limit=limit)
+                        group_by=group_by, order_by=order_by,
+                        having=having, limit=limit, offset=offset)
+
+    def having_clause(self) -> tuple[str, str, float]:
+        item = self.select_item()
+        op = self.expect("op")[1]
+        if op not in ("=", "!=", "<>", "<", "<=", ">", ">="):
+            raise SqlError(f"unsupported HAVING operator {op!r}")
+        value = float(self.expect("number")[1])
+        return (item.name, op, value)
 
     def select_item(self) -> SelectItem:
         token = self.next()
@@ -192,12 +220,23 @@ class _Parser:
             self.expect("op", ")")
             return SelectItem("agg", func="count", column=column,
                               alias=self._alias())
-        if token[0] == "kw" and token[1] in ("sum", "avg", "min", "max"):
+        if token[0] == "kw" and token[1] in ("sum", "avg", "min", "max",
+                                             "stddev", "variance"):
             self.expect("op", "(")
             column = self.expect("ident")[1]
             self.expect("op", ")")
             return SelectItem("agg", func=token[1], column=column,
                               alias=self._alias())
+        if token[0] == "kw" and token[1] == "approx_percentile":
+            self.expect("op", "(")
+            column = self.expect("ident")[1]
+            self.expect("op", ",")
+            percent = float(self.expect("number")[1])
+            if not 0 < percent < 100:
+                raise SqlError("approx_percentile takes a percent in (0,100)")
+            self.expect("op", ")")
+            return SelectItem("agg", func="approx_percentile", column=column,
+                              percent=percent, alias=self._alias())
         if token[0] == "kw" and token[1] == "date_trunc":
             self.expect("op", "(")
             unit = self.expect("string")[1].lower()
@@ -281,7 +320,22 @@ def _metric_body(item: SelectItem) -> dict:
         return {}
     if item.func == "count":
         return {"value_count": {"field": item.column}}
+    if item.func == "approx_percentile":
+        return {"percentiles": {"field": item.column,
+                                "percents": [item.percent]}}
+    if item.func in ("stddev", "variance"):
+        return {"extended_stats": {"field": item.column}}
     return {item.func: {"field": item.column}}
+
+
+def _metric_value(item: SelectItem, agg_result: dict):
+    if item.func == "approx_percentile":
+        return (agg_result.get("values") or {}).get(f"{item.percent:g}")
+    if item.func == "stddev":
+        return agg_result.get("std_deviation")
+    if item.func == "variance":
+        return agg_result.get("variance")
+    return agg_result.get("value")
 
 
 def execute_sql(text: str, search) -> dict[str, Any]:
@@ -311,12 +365,29 @@ def execute_sql(text: str, search) -> dict[str, Any]:
 
 
 def _agg_requests(aggregates: list[SelectItem]) -> dict:
+    """One agg entry per DISTINCT metric body: SELECT STDDEV(x),
+    VARIANCE(x) shares one extended_stats kernel; `_agg_key` maps each
+    select item to its entry."""
     aggs = {}
+    seen: dict[str, str] = {}
     for i, item in enumerate(aggregates):
         if item.kind == "count_star":
             continue  # doc_count / num_hits covers it
-        aggs[f"a{i}"] = _metric_body(item)
+        body = _metric_body(item)
+        canon = repr(sorted(body.items()))
+        if canon not in seen:
+            seen[canon] = f"a{i}"
+            aggs[f"a{i}"] = body
     return aggs
+
+
+def _agg_key(aggregates: list[SelectItem], item: SelectItem) -> str:
+    canon = repr(sorted(_metric_body(item).items()))
+    for i, other in enumerate(aggregates):
+        if other.kind != "count_star" and \
+                repr(sorted(_metric_body(other).items())) == canon:
+            return f"a{i}"
+    raise SqlError(f"internal: no agg entry for {item.name!r}")
 
 
 def _run_global_aggs(q: SqlQuery, ast, aggregates, search):
@@ -326,9 +397,11 @@ def _run_global_aggs(q: SqlQuery, ast, aggregates, search):
         if item.kind == "count_star":
             row.append(response.num_hits)
         else:
-            row.append((response.aggregations or {}).get(
-                f"a{i}", {}).get("value"))
-    return {"columns": [s.name for s in q.select], "rows": [row]}
+            row.append(_metric_value(
+                item, (response.aggregations or {}).get(
+                    _agg_key(aggregates, item), {})))
+    rows = _apply_having(q, [row])
+    return {"columns": [s.name for s in q.select], "rows": rows}
 
 
 def _group_agg_body(key: SelectItem) -> dict:
@@ -346,8 +419,6 @@ def _group_agg_body(key: SelectItem) -> dict:
 
 
 def _run_grouped(q: SqlQuery, ast, aggregates, search):
-    if len(q.group_by) > 2:
-        raise SqlError("GROUP BY supports at most two keys")
     # every selected plain column must be a group key
     group_names = {g.name for g in q.group_by} | \
                   {g.column for g in q.group_by}
@@ -356,27 +427,64 @@ def _run_grouped(q: SqlQuery, ast, aggregates, search):
                 and s.column not in group_names:
             raise SqlError(f"column {s.name!r} must appear in GROUP BY")
 
-    outer_body = _group_agg_body(q.group_by[0])
-    sub: dict = dict(_agg_requests(aggregates))
-    if len(q.group_by) == 2:
-        inner = _group_agg_body(q.group_by[1])
-        inner["aggs"] = dict(_agg_requests(aggregates))
-        sub = {"g1": inner}
-    outer_body["aggs"] = sub
-    response = search(q.index, ast, 0, {"g0": outer_body})
-    buckets = (response.aggregations or {}).get("g0", {}).get("buckets", [])
+    # GROUP BY chain of any length compiles onto one nested bucket tree
+    # (arbitrary-depth flattened device bucket spaces); metrics ride the
+    # innermost level
+    bodies = [_group_agg_body(g) for g in q.group_by]
+    bodies[-1]["aggs"] = dict(_agg_requests(aggregates))
+    for i in range(len(bodies) - 2, -1, -1):
+        bodies[i]["aggs"] = {f"g{i + 1}": bodies[i + 1]}
+    response = search(q.index, ast, 0, {"g0": bodies[0]})
 
-    rows = []
-    for bucket in buckets:
-        if len(q.group_by) == 2:
-            for inner_bucket in bucket.get("g1", {}).get("buckets", []):
-                rows.append(_bucket_row(q, [bucket, inner_bucket],
-                                        aggregates))
-        else:
-            rows.append(_bucket_row(q, [bucket], aggregates))
+    rows: list[list] = []
 
+    def walk(level: int, path: list[dict], container: dict) -> None:
+        for bucket in container.get(f"g{level}", {}).get("buckets", []):
+            if level + 1 < len(q.group_by):
+                walk(level + 1, path + [bucket], bucket)
+            else:
+                rows.append(_bucket_row(q, path + [bucket], aggregates))
+
+    walk(0, [], response.aggregations or {})
+    rows = _apply_having(q, rows)
     rows = _order_and_limit(q, rows)
     return {"columns": [s.name for s in q.select], "rows": rows}
+
+
+_HAVING_OPS = {
+    "=": lambda a, b: a == b, "!=": lambda a, b: a != b,
+    "<>": lambda a, b: a != b, "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b, ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+}
+
+
+def _apply_having(q: SqlQuery, rows: list[list]) -> list[list]:
+    if not q.having:
+        return rows
+    names = [s.name for s in q.select]
+    for target, _op, _value in q.having:
+        if target not in names:
+            raise SqlError(
+                f"HAVING target {target!r} must be selected (add it to "
+                "the SELECT list, aliased if needed)")
+    out = []
+    for row in rows:
+        keep = True
+        for target, op, value in q.having:
+            cell = row[names.index(target)]
+            try:
+                numeric = float(cell) if cell is not None else None
+            except (TypeError, ValueError):
+                raise SqlError(
+                    f"HAVING target {target!r} is not numeric "
+                    f"(got {cell!r})")
+            if numeric is None or not _HAVING_OPS[op](numeric, value):
+                keep = False
+                break
+        if keep:
+            out.append(row)
+    return out
 
 
 def _bucket_key(item: SelectItem, bucket: dict):
@@ -396,8 +504,8 @@ def _bucket_row(q: SqlQuery, buckets: list[dict], aggregates):
         elif s.kind == "count_star":
             row.append(inner.get("doc_count"))
         else:
-            pos = next(i for i, a in enumerate(aggregates) if a is s)
-            row.append(inner.get(f"a{pos}", {}).get("value"))
+            row.append(_metric_value(
+                s, inner.get(_agg_key(aggregates, s), {})))
     return row
 
 
@@ -411,14 +519,19 @@ def _order_and_limit(q: SqlQuery, rows: list[list]):
         rows.sort(key=lambda r: (r[idx] is None,
                                  r[idx] if r[idx] is not None else 0),
                   reverse=desc)
+    if q.offset:
+        rows = rows[q.offset:]
     if q.limit is not None:
         rows = rows[: q.limit]
     return rows
 
 
 def _run_projection(q: SqlQuery, ast, search):
+    if q.having:
+        raise SqlError("HAVING requires GROUP BY or aggregates")
     limit = q.limit if q.limit is not None else 100
-    response = search(q.index, ast, limit, None)
+    # fetch offset+limit hits so pagination slices real rows
+    response = search(q.index, ast, limit + q.offset, None)
     columns = [s.name for s in q.select]
     rows = []
     for hit in response.hits:
@@ -430,5 +543,8 @@ def _run_projection(q: SqlQuery, ast, search):
                 value = value.get(part) if isinstance(value, dict) else None
             row.append(value)
         rows.append(row)
-    rows = _order_and_limit(q, rows) if q.order_by else rows[:limit]
+    if q.order_by:
+        rows = _order_and_limit(q, rows)
+    else:
+        rows = rows[q.offset: q.offset + limit]
     return {"columns": columns, "rows": rows}

@@ -34,6 +34,10 @@ _STORE_BLOCK_BYTES = 64 * 1024
 _NUMERIC_TYPES = (FieldType.I64, FieldType.U64, FieldType.F64, FieldType.BOOL,
                   FieldType.DATETIME, FieldType.IP)
 
+# current analyzer generation (v2 = Porter2 en_stem); stamped into split
+# footers so stale-analysis splits are detectable at plan time
+ANALYZER_VERSION = 2
+
 
 class _InvertedFieldBuilder:
     """Python-path postings accumulator. TEXT fields with the `default`
@@ -340,7 +344,12 @@ class SplitWriter:
             fields=fields_meta,
             time_range=(self._time_min, self._time_max) if self._time_min is not None else None,
             doc_mapping_uid=self.doc_mapper.doc_mapping_uid,
-            extra={"uncompressed_docs_size_bytes": self._uncompressed_docs_size},
+            extra={"uncompressed_docs_size_bytes": self._uncompressed_docs_size,
+                   # bumped whenever a tokenizer's output changes (e.g.
+                   # en_stem light-stemmer → Porter2): query-side analysis
+                   # must match index-side terms, so a version mismatch at
+                   # plan time warns that the split needs reindexing
+                   "analyzer_version": ANALYZER_VERSION},
         )
         return builder.finish(footer)
 

@@ -32,6 +32,7 @@ class FakeKafkaBroker:
         # refuses their Fetch/ListOffsets with NOT_LEADER
         self.peer_brokers: list["FakeKafkaBroker"] = []
         self.partition_leaders: dict[tuple[str, int], int] = {}
+        self._truncated: dict[tuple[str, int], int] = {}
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind((host, port))
@@ -54,6 +55,17 @@ class FakeKafkaBroker:
             log.extend(values)
             self._batches.setdefault((topic, partition), []).append(
                 (base, encode_record_batch(base, values)))
+
+    def truncate_before(self, topic: str, partition: int,
+                        offset: int) -> None:
+        """Simulate retention: offsets below `offset` are gone; fetches
+        below it return OFFSET_OUT_OF_RANGE."""
+        with self._lock:
+            self._truncated[(topic, partition)] = offset
+            self._batches[(topic, partition)] = [
+                (base, data) for base, data
+                in self._batches.get((topic, partition), [])
+                if base >= offset]
 
     def stop(self) -> None:
         self._running = False
@@ -175,7 +187,9 @@ class FakeKafkaBroker:
                             (topic, partition), self.node_id) != self.node_id:
                         partitions.append((partition, 6, -1))  # NOT_LEADER
                         continue
-                    offset = 0 if timestamp == EARLIEST else len(log[partition])
+                    floor = self._truncated.get((topic, partition), 0)
+                    offset = (floor if timestamp == EARLIEST
+                              else len(log[partition]))
                 partitions.append((partition, 0, offset))
             out_topics.append((topic, partitions))
         out = struct.pack(">i", len(out_topics))
@@ -211,6 +225,10 @@ class FakeKafkaBroker:
                     if self.partition_leaders.get(
                             (topic, partition), self.node_id) != self.node_id:
                         partitions.append((partition, 6, 0, b""))
+                        continue
+                    if fetch_offset < self._truncated.get(
+                            (topic, partition), 0):
+                        partitions.append((partition, 1, 0, b""))  # OOR
                         continue
                     high = len(log[partition])
                     record_set = b"".join(

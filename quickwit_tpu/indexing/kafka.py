@@ -218,7 +218,12 @@ LATEST = -1
 
 
 class KafkaProtocolError(RuntimeError):
-    pass
+    def __init__(self, message: str, code: Optional[int] = None):
+        super().__init__(message)
+        self.code = code
+
+
+OFFSET_OUT_OF_RANGE = 1
 
 
 class _KafkaApiError(Exception):
@@ -424,15 +429,15 @@ class KafkaWireClient:
             return call(address)
         except _KafkaApiError as exc:
             if exc.code != self._NOT_LEADER:
-                raise KafkaProtocolError(str(exc)) from exc
+                raise KafkaProtocolError(str(exc), code=exc.code) from exc
             self.metadata([topic])
             new_address = self._leader_address(topic, partition)
             if new_address == address:
-                raise KafkaProtocolError(str(exc)) from exc
+                raise KafkaProtocolError(str(exc), code=exc.code) from exc
             try:
                 return call(new_address)
             except _KafkaApiError as exc2:
-                raise KafkaProtocolError(str(exc2)) from exc2
+                raise KafkaProtocolError(str(exc2), code=exc2.code) from exc2
 
     def fetch(self, topic: str, partition: int, offset: int,
               max_bytes: int = 4 << 20, max_wait_ms: int = 100
@@ -536,9 +541,21 @@ class KafkaSource:
                       else int(position))
             target = latest[partition]
             while offset < target:
-                records, _high = self.client.fetch(
-                    self.topic, partition, offset,
-                    max_bytes=self.max_fetch_bytes)
+                try:
+                    records, _high = self.client.fetch(
+                        self.topic, partition, offset,
+                        max_bytes=self.max_fetch_bytes)
+                except KafkaProtocolError as exc:
+                    if (exc.code == OFFSET_OUT_OF_RANGE
+                            and earliest[partition] > offset):
+                        # retention truncated past the checkpoint: resume
+                        # at the earliest retained offset (the records in
+                        # between are gone — auto.offset.reset=earliest
+                        # semantics; the checkpoint jump is the honest
+                        # record of the loss)
+                        offset = earliest[partition]
+                        continue
+                    raise
                 records = [(off, v) for off, v in records if off < target]
                 if not records:
                     break
@@ -551,11 +568,11 @@ class KafkaSource:
                                      value.decode("utf-8", "replace")})
                 taken = records[:batch_num_docs]
                 next_offset = taken[-1][0] + 1
+                # the delta always starts at the STORED position — after a
+                # retention reset it spans the truncated hole, keeping the
+                # exactly-once chain contiguous
                 delta = CheckpointDelta.from_range(
-                    partition_id,
-                    BEGINNING if position == BEGINNING
-                    else offset_position(offset),
-                    offset_position(next_offset))
+                    partition_id, position, offset_position(next_offset))
                 yield SourceBatch(docs, delta)
                 position = offset_position(next_offset)
                 offset = next_offset

@@ -62,29 +62,12 @@ def _whitespace(text: str) -> list[Token]:
     return [Token(tok, pos) for pos, tok in enumerate(text.split()) if len(tok) <= MAX_TOKEN_LEN]
 
 
-_STEM_SUFFIXES = (
-    ("ational", "ate"), ("iveness", "ive"), ("fulness", "ful"), ("ousness", "ous"),
-    ("ization", "ize"), ("ingly", ""), ("edly", ""), ("ement", ""), ("ments", "ment"),
-    ("ing", ""), ("ied", "y"), ("ies", "y"), ("ed", ""), ("es", "e"), ("s", ""),
-)
-
-
-def _stem_word(word: str) -> str:
-    """A light Porter-style stemmer — deterministic, not full Porter.
-
-    Index-time and query-time use the same function so parity holds within
-    this engine; not byte-compatible with tantivy's snowball output.
-    """
-    if len(word) <= 3:
-        return word
-    for suffix, repl in _STEM_SUFFIXES:
-        if word.endswith(suffix) and len(word) - len(suffix) + len(repl) >= 3:
-            return word[: len(word) - len(suffix)] + repl
-    return word
-
-
 def _en_stem(text: str) -> list[Token]:
-    return [Token(_stem_word(t.text), t.position) for t in _default(text)]
+    """Default tokenization + Porter2 (English Snowball) stemming —
+    byte-compatible with tantivy's rust-stemmers "english" output
+    (`porter2.py`), so `en_stem` index terms match the reference's."""
+    from .porter2 import stem
+    return [Token(stem(t.text), t.position) for t in _default(text)]
 
 
 def _chinese_compatible(text: str) -> list[Token]:

@@ -41,6 +41,14 @@ from ..query.tokenizers import get_tokenizer
 from ..index.reader import SplitReader
 from ..utils.datetime_utils import parse_datetime_to_micros
 
+import logging
+
+logger = logging.getLogger(__name__)
+
+from ..observability.tracing import RateLimitedLog  # noqa: E402
+
+_ANALYZER_WARN = RateLimitedLog(limit=3, period_secs=300.0)
+
 MAX_EXPANSIONS = 1024
 MAX_BUCKETS = 65536  # reference: AggregationLimitsGuard default bucket limit
 
@@ -355,6 +363,20 @@ class Lowering:
 
     def _postings_node(self, field: str, term: str, scoring: bool,
                        boost: float) -> Any:
+        fm = self.doc_mapper.field(field)
+        if fm is not None and fm.tokenizer == "en_stem":
+            extra = self.reader.footer.extra or {}
+            from ..index.writer import ANALYZER_VERSION
+            if extra.get("analyzer_version", 1) != ANALYZER_VERSION:
+                # stemmer output changed since this split was written:
+                # query-side terms may not match — results need a reindex
+                emit, _ = _ANALYZER_WARN.should_log("analyzer")
+                if emit:
+                    logger.warning(
+                        "split %s was written with analyzer_version %s "
+                        "(current %s): en_stem terms may mismatch — "
+                        "reindex to refresh", self.reader.path,
+                        extra.get("analyzer_version", 1), ANALYZER_VERSION)
         info = self.reader.lookup_term(field, term)
         if info is None:
             if self.absence_sink is not None:

@@ -61,7 +61,10 @@ class SearcherContext:
                  max_open_splits: int = 128,
                  leaf_cache_bytes: int = 64 << 20,
                  batch_size: int = 8,
-                 prefetch: bool = True):
+                 prefetch: bool = True,
+                 offload_endpoint: Optional[str] = None,
+                 offload_max_local_splits: int = 16,
+                 offload_client_factory=None):
         self.storage_resolver = storage_resolver or StorageResolver.default()
         self.leaf_cache = LeafSearchCache(leaf_cache_bytes)
         self.batch_size = batch_size
@@ -83,6 +86,36 @@ class SearcherContext:
         self._readers: OrderedDict[str, SplitReader] = OrderedDict()
         self._max_open_splits = max_open_splits
         self._lock = threading.Lock()
+        # serverless offload (reference: lambda leaf-search offload,
+        # quickwit-lambda-client/src/invoker.rs:129 + the scheduling
+        # split at leaf.rs:1658,1828): cold splits beyond
+        # offload_max_local_splits per leaf request are dispatched to the
+        # configured endpoint — any process serving the internal
+        # leaf-search protocol (a peer node, a FaaS worker pool, ...)
+        self.offload_endpoint = offload_endpoint
+        self.offload_max_local_splits = offload_max_local_splits
+        self._offload_client_factory = offload_client_factory
+        self._offload_client = None
+
+    def offload_client(self):
+        with self._lock:
+            if self._offload_client is None:
+                if self._offload_client_factory is not None:
+                    self._offload_client = self._offload_client_factory(
+                        self.offload_endpoint)
+                else:
+                    from ..serve.http_client import HttpSearchClient
+                    self._offload_client = HttpSearchClient(
+                        self.offload_endpoint)
+            return self._offload_client
+
+    def has_warm_reader(self, split: SplitIdAndFooter) -> bool:
+        """True when this split's reader (and its byte-range/device
+        caches) is already resident — the 'warm split' signal the offload
+        scheduling uses (the reference offloads splits absent from the
+        local split cache)."""
+        with self._lock:
+            return f"{split.storage_uri}/{split.split_id}" in self._readers
 
     def prefetch_pool(self):
         from concurrent.futures import ThreadPoolExecutor
@@ -168,6 +201,41 @@ class SearchService:
                 continue
             pending.append(split)
 
+        offload_future = None
+        offload_result: dict[str, Any] = {}
+        offloaded: list[SplitIdAndFooter] = []
+        if (self.context.offload_endpoint
+                and len(pending) > self.context.offload_max_local_splits):
+            # scheduling split (reference schedule_search_tasks,
+            # leaf.rs:1828): warm splits stay local; the coldest tail
+            # beyond the local budget runs on the offload endpoint
+            # CONCURRENTLY with the local loop
+            warm = [s for s in pending if self.context.has_warm_reader(s)]
+            cold = [s for s in pending
+                    if not self.context.has_warm_reader(s)]
+            budget = max(self.context.offload_max_local_splits, len(warm))
+            local = (warm + cold)[:budget]
+            offloaded = (warm + cold)[budget:]
+            if offloaded:
+                pending = local
+                remote_request = LeafSearchRequest(
+                    search_request=search_request,
+                    index_uid=request.index_uid,
+                    doc_mapping=request.doc_mapping, splits=offloaded)
+                result_box: dict[str, Any] = {}
+
+                def _invoke(box=result_box, rr=remote_request):
+                    try:
+                        box["response"] = \
+                            self.context.offload_client().leaf_search(rr)
+                    except Exception as exc:  # noqa: BLE001 - fallback below
+                        box["error"] = exc
+
+                offload_future = threading.Thread(target=_invoke,
+                                                  daemon=True)
+                offload_future.start()
+                offload_result = result_box
+
         num_skipped = 0
         prunable = self._pruning_applicable(search_request,
                                             doc_mapper.timestamp_field)
@@ -208,12 +276,37 @@ class SearchService:
             self._execute_group(prepared, doc_mapper, search_request,
                                 collector)
 
+        num_offloaded = 0
+        if offload_future is not None:
+            offload_future.join(timeout=self._OFFLOAD_TIMEOUT_SECS)
+            remote = offload_result.get("response")
+            if remote is not None:
+                collector.add_leaf_response(remote)
+                num_offloaded = len(offloaded)
+            else:
+                # offload failed (endpoint down / timeout): the splits
+                # still belong to this request — run them locally
+                # (reference invoker falls back the same way)
+                _warn_split_failure(
+                    "offload", offloaded[0].split_id if offloaded else "-",
+                    offload_result.get("error", "timeout"))
+                for group in [offloaded[b: b + batch_size]
+                              for b in range(0, len(offloaded), batch_size)]:
+                    prepared = self._prepare_group(group, doc_mapper,
+                                                   search_request)
+                    self._execute_group(prepared, doc_mapper, search_request,
+                                        collector)
+
         response = collector.to_leaf_response()
         response.num_attempted_splits = len(splits)
         response.resource_stats["num_splits_skipped"] = num_skipped
         response.resource_stats["num_splits_pruned_by_predicate_cache"] = \
             num_pruned_by_predicate
+        if num_offloaded:
+            response.resource_stats["num_splits_offloaded"] = num_offloaded
         return response
+
+    _OFFLOAD_TIMEOUT_SECS = 30.0
 
     @staticmethod
     def _count_from_metadata(request: SearchRequest,

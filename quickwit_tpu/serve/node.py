@@ -83,6 +83,12 @@ class NodeConfig:
     # default: every tick drains every index immediately.
     cooperative_indexing: bool = False
     max_concurrent_pipelines: int = 3
+    # serverless offload (reference: quickwit-lambda leaf offload): cold
+    # splits beyond offload_max_local_splits per leaf request dispatch to
+    # this endpoint — any server speaking the internal leaf-search
+    # protocol (peer node, FaaS worker pool). None = all-local.
+    offload_endpoint: Optional[str] = None
+    offload_max_local_splits: int = 16
     # standalone compactor role: bounded concurrent merge executions
     # (reference compactor_supervisor.rs slots)
     max_concurrent_merges: int = 2
@@ -263,7 +269,10 @@ class Node:
         self.cluster = Cluster(
             config.node_id, config.roles,
             rest_endpoint=f"{config.rest_host}:{config.rest_port}")
-        self.searcher_context = SearcherContext(self.storage_resolver)
+        self.searcher_context = SearcherContext(
+            self.storage_resolver,
+            offload_endpoint=config.offload_endpoint,
+            offload_max_local_splits=config.offload_max_local_splits)
         self.search_service = SearchService(self.searcher_context, config.node_id)
         self.index_service = IndexService(self.metastore, self.storage_resolver,
                                           config.default_index_root_uri)
@@ -411,6 +420,12 @@ class Node:
         source_config = metadata.sources.get(source_id)
         if (source_config is None or not source_config.enabled
                 or source_config.source_type in self._INTERNAL_SOURCE_TYPES):
+            # a deleted/disabled source releases its cached client (and
+            # its broker sockets) immediately, not at index deletion
+            stale = self._external_sources.pop(
+                (metadata.index_uid, source_id), None)
+            if stale is not None:
+                self._close_source(stale[1])
             return None
         # config fingerprint in the key: delete + re-add with the same
         # source_id but a new topic/brokers must not keep consuming the

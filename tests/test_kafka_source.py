@@ -255,3 +255,23 @@ def test_multi_broker_leader_routing():
     finally:
         a.stop()
         b.stop()
+
+
+def test_retention_truncation_resumes_at_earliest(broker):
+    """A checkpoint below the broker's retention floor resumes at the
+    earliest retained offset instead of failing forever
+    (auto.offset.reset=earliest semantics)."""
+    broker.create_topic("logs")
+    broker.seed("logs", 0, _docs(5))
+    broker.seed("logs", 0, _docs(5, start=5))
+    servers = {"bootstrap.servers": f"{broker.host}:{broker.port}"}
+    source = make_source("kafka", {"topic": "logs", "client_params": servers})
+    checkpoint = SourceCheckpoint()
+    first = next(iter(source.batches(checkpoint, batch_num_docs=3)))
+    checkpoint.try_apply_delta(first.checkpoint_delta)  # position -> 3
+    broker.truncate_before("logs", 0, 5)  # offsets 3..4 are gone
+    seqs = []
+    for batch in source.batches(checkpoint):
+        seqs.extend(d["seq"] for d in batch.docs)
+        checkpoint.try_apply_delta(batch.checkpoint_delta)
+    assert seqs == [5, 6, 7, 8, 9]

@@ -1,0 +1,121 @@
+"""Serverless leaf-search offload (reference: quickwit-lambda-client
+invoker + the local/offload scheduling split at leaf.rs:1658,1828).
+
+The 'lambda pool' here is a second in-process node sharing the same
+object storage — any server speaking the internal leaf-search protocol
+can serve offloaded splits."""
+
+import json
+
+import pytest
+
+from quickwit_tpu.serve import Node, NodeConfig, RestServer
+from quickwit_tpu.storage import StorageResolver
+from test_rest_api import Client
+
+NUM_SPLITS = 6
+DOCS_PER_SPLIT = 30
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    resolver = StorageResolver.for_test()
+    worker = Node(NodeConfig(node_id="offload-worker", rest_port=0,
+                             roles=("searcher",),
+                             metastore_uri="ram:///ol/metastore",
+                             default_index_root_uri="ram:///ol/idx"),
+                  storage_resolver=resolver)
+    worker_server = RestServer(worker, host="127.0.0.1", port=0)
+    worker_server.start()
+    main = Node(NodeConfig(node_id="offload-main", rest_port=0,
+                           metastore_uri="ram:///ol/metastore",
+                           default_index_root_uri="ram:///ol/idx",
+                           offload_endpoint=f"127.0.0.1:{worker_server.port}",
+                           offload_max_local_splits=2),
+                storage_resolver=resolver)
+    main_server = RestServer(main, host="127.0.0.1", port=0)
+    main_server.start()
+    api = Client(main_server.port)
+    status, _ = api.request("POST", "/api/v1/indexes", {
+        "index_id": "ol-logs",
+        "doc_mapping": {"field_mappings": [
+            {"name": "body", "type": "text"},
+            {"name": "n", "type": "i64", "fast": True}]}})
+    assert status == 200
+    for s in range(NUM_SPLITS):
+        docs = [{"body": f"payload token{s}", "n": s * 100 + i}
+                for i in range(DOCS_PER_SPLIT)]
+        ndjson = "\n".join(json.dumps(d) for d in docs).encode()
+        status, _ = api.request(
+            "POST", "/api/v1/ol-logs/ingest?commit=force", ndjson)
+        assert status == 200
+    yield main, api
+    main_server.stop()
+    worker_server.stop()
+
+
+def test_offload_splits_to_worker(cluster):
+    main, api = cluster
+    status, result = api.request(
+        "GET", "/api/v1/ol-logs/search?query=body:payload&max_hits=5")
+    assert status == 200
+    assert result["num_hits"] == NUM_SPLITS * DOCS_PER_SPLIT
+    # the main node kept at most its local budget; the rest ran remotely
+    # (resource stats ride the leaf response into the root merge)
+    from quickwit_tpu.metastore.base import ListSplitsQuery
+    from quickwit_tpu.models.split_metadata import SplitState
+    from quickwit_tpu.search.models import (
+        LeafSearchRequest, SearchRequest, SplitIdAndFooter)
+    from quickwit_tpu.query.ast import FullText
+    metadata0 = main.metastore.index_metadata("ol-logs")
+    splits = [SplitIdAndFooter(
+        split_id=s.metadata.split_id,
+        storage_uri=metadata0.index_config.index_uri,
+        num_docs=s.metadata.num_docs)
+        for s in main.metastore.list_splits(ListSplitsQuery(
+            index_uids=[metadata0.index_uid],
+            states=[SplitState.PUBLISHED]))]
+    assert len(splits) == NUM_SPLITS
+    metadata = main.metastore.index_metadata("ol-logs")
+    leaf = main.search_service.leaf_search(LeafSearchRequest(
+        search_request=SearchRequest(
+            index_ids=["ol-logs"],
+            query_ast=FullText("body", "payload", "and"), max_hits=5),
+        index_uid=metadata.index_uid,
+        doc_mapping=metadata.index_config.doc_mapper.to_dict(),
+        splits=splits))
+    assert leaf.num_hits == NUM_SPLITS * DOCS_PER_SPLIT
+    assert leaf.resource_stats.get("num_splits_offloaded", 0) >= \
+        NUM_SPLITS - 2
+
+
+def test_offload_failure_falls_back_local():
+    resolver = StorageResolver.for_test()
+    node = Node(NodeConfig(node_id="fb", rest_port=0,
+                           metastore_uri="ram:///fb/metastore",
+                           default_index_root_uri="ram:///fb/idx",
+                           # unreachable endpoint: every offload fails
+                           offload_endpoint="127.0.0.1:1",
+                           offload_max_local_splits=1),
+                storage_resolver=resolver)
+    server = RestServer(node, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        api = Client(server.port)
+        status, _ = api.request("POST", "/api/v1/indexes", {
+            "index_id": "fb-logs",
+            "doc_mapping": {"field_mappings": [
+                {"name": "body", "type": "text"}]}})
+        assert status == 200
+        for s in range(3):
+            ndjson = "\n".join(json.dumps({"body": "common word"})
+                               for _ in range(10)).encode()
+            status, _ = api.request(
+                "POST", "/api/v1/fb-logs/ingest?commit=force", ndjson)
+            assert status == 200
+        status, result = api.request(
+            "GET", "/api/v1/fb-logs/search?query=body:common")
+        assert status == 200
+        assert result["num_hits"] == 30  # all splits answered locally
+    finally:
+        server.stop()

@@ -137,3 +137,31 @@ def test_parse_wildcard_anywhere():
 def test_lone_must_not():
     ast = parse_query_string("-a:1")
     assert isinstance(ast, Bool) and ast.must_not == (Term("a", "1"),)
+
+
+def test_porter2_snowball_vectors():
+    """en_stem must be byte-compatible with the snowball english stemmer
+    (tantivy's rust-stemmers output) on the standard sample pairs."""
+    from quickwit_tpu.query.porter2 import stem
+    vectors = {
+        "consigned": "consign", "consistency": "consist",
+        "knightly": "knight", "generously": "generous",
+        "skis": "ski", "skies": "sky", "dying": "die",
+        "news": "news", "inning": "inning", "exceeding": "exceed",
+        "crying": "cri", "cries": "cri", "hopping": "hop",
+        "hoping": "hope", "hopefulness": "hope",
+        "conditional": "condit", "digitizer": "digit",
+        "vietnamization": "vietnam", "sensitiviti": "sensit",
+        "electriciti": "electr", "replacement": "replac",
+        "running": "run", "quickly": "quick", "argument": "argument",
+        "flies": "fli", "agreed": "agre",
+    }
+    for word, expected in vectors.items():
+        assert stem(word) == expected, (word, stem(word), expected)
+
+
+def test_en_stem_tokenizer_index_query_parity():
+    from quickwit_tpu.query.tokenizers import get_tokenizer
+    tok = get_tokenizer("en_stem")
+    assert [t.text for t in tok("Running quickly, the flies agreed")] == \
+        ["run", "quick", "the", "fli", "agre"]

@@ -164,3 +164,62 @@ def test_parse_shapes():
     assert q.select[0].name == "n"
     with pytest.raises(SqlError):
         parse_sql("SELECT FROM logs")
+
+
+def test_group_by_three_keys(api):
+    """N-key GROUP BY rides the arbitrary-depth nested bucket spaces."""
+    status, out = api(
+        "SELECT service, status, DATE_TRUNC('day', ts) AS day, COUNT(*) "
+        "FROM metrics GROUP BY service, status, DATE_TRUNC('day', ts)")
+    assert status == 200
+    import collections
+    expected = collections.Counter(
+        (d["service"], d["status"], d["ts"] // 86_400 * 86_400)
+        for d in DOCS)
+    assert sum(r[3] for r in out["rows"]) == len(DOCS)
+    assert len(out["rows"]) == len(expected)
+    for service, status_code, day, count in out["rows"]:
+        from quickwit_tpu.utils.datetime_utils import parse_datetime_to_micros
+        day_s = parse_datetime_to_micros(day, ("rfc3339",)) // 1_000_000
+        assert expected[(service, int(status_code), day_s)] == count
+
+
+def test_having_filters_groups(api):
+    status, out = api(
+        "SELECT service, COUNT(*) AS n FROM metrics "
+        "GROUP BY service HAVING n >= 20")
+    assert status == 200
+    import collections
+    counts = collections.Counter(d["service"] for d in DOCS)
+    assert {r[0] for r in out["rows"]} == \
+        {s for s, c in counts.items() if c >= 20}
+
+
+def test_approx_percentile_and_stddev(api):
+    status, out = api(
+        "SELECT APPROX_PERCENTILE(latency, 50) AS p50, STDDEV(latency), "
+        "VARIANCE(latency) FROM metrics")
+    assert status == 200
+    lats = sorted(d["latency"] for d in DOCS)
+    p50, stddev, variance = out["rows"][0]
+    expected_p50 = lats[int(0.5 * (len(lats) - 1))]
+    assert abs(p50 - expected_p50) <= 0.03 * expected_p50
+    assert stddev == pytest.approx(float(np.std(lats)), rel=1e-6)
+    assert variance == pytest.approx(float(np.var(lats)), rel=1e-6)
+
+
+def test_limit_offset_pagination(api):
+    status, page1 = api("SELECT service, COUNT(*) FROM metrics "
+                        "GROUP BY service ORDER BY service ASC LIMIT 2")
+    status2, page2 = api("SELECT service, COUNT(*) FROM metrics "
+                        "GROUP BY service ORDER BY service ASC "
+                        "LIMIT 2 OFFSET 2")
+    assert status == 200 and status2 == 200
+    assert [r[0] for r in page1["rows"]] == ["api", "db"]
+    assert [r[0] for r in page2["rows"]] == ["web"]
+
+
+def test_having_requires_selected_target(api):
+    status, out = api("SELECT service FROM metrics GROUP BY service "
+                      "HAVING count(*) > 5")
+    assert status == 400
