@@ -86,6 +86,11 @@ def load_node_config(path: Optional[str] = None,
             "offload_endpoint"),
         offload_max_local_splits=int((data.get("searcher", {}) or {}).get(
             "offload_max_local_splits", 16)),
+        grpc_port=(int(environ["QW_GRPC_PORT"])
+                   if "QW_GRPC_PORT" in environ
+                   else (int((data.get("grpc", {}) or {})["listen_port"])
+                         if (data.get("grpc") or {}).get("listen_port")
+                         is not None else None)),
     )
 
 

@@ -89,6 +89,10 @@ class NodeConfig:
     # protocol (peer node, FaaS worker pool). None = all-local.
     offload_endpoint: Optional[str] = None
     offload_max_local_splits: int = 16
+    # gRPC listener (reference: the tonic server in grpc.rs — OTLP
+    # collector services + Jaeger SpanReaderPlugin over stdlib HTTP/2).
+    # None = disabled; 0 = ephemeral port.
+    grpc_port: Optional[int] = None
     # standalone compactor role: bounded concurrent merge executions
     # (reference compactor_supervisor.rs slots)
     max_concurrent_merges: int = 2
@@ -314,6 +318,11 @@ class Node:
         self.scroll_store = ScrollStore()
         from .otel import OtelService
         self.otel = OtelService(self)
+        self.grpc_server = None
+        if config.grpc_port is not None:
+            from .grpc_server import GrpcServer
+            self.grpc_server = GrpcServer(self, host=config.rest_host,
+                                          port=config.grpc_port)
         # standalone compactor role (reference quickwit-compaction):
         # planner + bounded supervisor; when any alive compactor exists,
         # indexers stop running merges themselves
@@ -1006,6 +1015,11 @@ class Node:
         if getattr(self, "_bg_stop", None) is not None:
             return
         self._ensure_span_exporter()
+        if self.grpc_server is None and self.config.grpc_port is not None:
+            # stop/start cycles recreate the listener (stop tears it down)
+            from .grpc_server import GrpcServer
+            self.grpc_server = GrpcServer(self, host=self.config.rest_host,
+                                          port=self.config.grpc_port)
         stop = self._bg_stop = threading.Event()
 
         def owns_index(index_uid: str) -> bool:
@@ -1195,6 +1209,9 @@ class Node:
         logger.info("background services started (%s)", self.config.node_id)
 
     def stop_background_services(self) -> None:
+        if self.grpc_server is not None:
+            self.grpc_server.stop()
+            self.grpc_server = None
         if self.span_exporter is not None:
             from ..observability.tracing import TRACER
             TRACER.remove_processor(self.span_exporter)

@@ -161,9 +161,21 @@ class OtelService:
         return self.node.ingest(OTEL_TRACES_INDEX, docs)
 
     # --- Jaeger-style reads ------------------------------------------------
+    def _traces_index_exists(self) -> bool:
+        """Jaeger reads on a node that never ingested a span answer
+        empty, not error (the index appears on first OTLP ingest)."""
+        from ..metastore.base import MetastoreError
+        try:
+            self.node.metastore.index_metadata(OTEL_TRACES_INDEX)
+            return True
+        except MetastoreError:
+            return False
+
     def services(self) -> list[str]:
         from ..query.ast import MatchAll
         from ..search.models import SearchRequest
+        if not self._traces_index_exists():
+            return []
         response = self.node.root_searcher.search(SearchRequest(
             index_ids=[OTEL_TRACES_INDEX], query_ast=MatchAll(), max_hits=0,
             aggs={"services": {"terms": {"field": "service_name", "size": 1000}}}))
@@ -173,6 +185,8 @@ class OtelService:
     def operations(self, service: str) -> list[str]:
         from ..query.ast import Term
         from ..search.models import SearchRequest
+        if not self._traces_index_exists():
+            return []
         response = self.node.root_searcher.search(SearchRequest(
             index_ids=[OTEL_TRACES_INDEX],
             query_ast=Term("service_name", service), max_hits=0,

@@ -1,0 +1,211 @@
+"""gRPC surface over the stdlib HTTP/2 transport: OTLP collector
+services + Jaeger SpanReaderPlugin (reference: the tonic gRPC server,
+quickwit-jaeger/src/lib.rs:78, quickwit-opentelemetry otlp).
+
+The client side is the in-repo GrpcChannel — real HTTP/2 frames and
+HPACK over a real socket."""
+
+import struct
+
+import pytest
+
+from quickwit_tpu.serve import Node, NodeConfig, RestServer
+from quickwit_tpu.serve.grpc_server import (
+    GrpcChannel, pb_bytes, pb_msg, pb_str, pb_varint, pb_varint_raw,
+)
+from quickwit_tpu.storage import StorageResolver
+
+
+def _fixed64(field: int, value: int) -> bytes:
+    return pb_varint_raw(field << 3 | 1) + struct.pack("<Q", value)
+
+
+def _otlp_span(trace_id: str, span_id: str, name: str, start_s: int,
+               dur_us: int) -> bytes:
+    return (pb_bytes(1, bytes.fromhex(trace_id))
+            + pb_bytes(2, bytes.fromhex(span_id))
+            + pb_str(5, name)
+            + _fixed64(7, start_s * 10**9)
+            + _fixed64(8, start_s * 10**9 + dur_us * 1000))
+
+
+def _export_request(service: str, spans: list[bytes]) -> bytes:
+    any_value = pb_str(1, service)
+    key_value = pb_str(1, "service.name") + pb_msg(2, any_value)
+    resource = pb_msg(1, key_value)
+    scope_spans = b"".join(pb_msg(2, s) for s in spans)
+    resource_spans = pb_msg(1, resource) + pb_msg(2, scope_spans)
+    return pb_msg(1, resource_spans)
+
+
+@pytest.fixture(scope="module")
+def grpc():
+    node = Node(NodeConfig(node_id="grpc-node", rest_port=0, grpc_port=0,
+                           metastore_uri="ram:///grpc/ms",
+                           default_index_root_uri="ram:///grpc/idx"),
+                storage_resolver=StorageResolver.for_test())
+    server = RestServer(node, host="127.0.0.1", port=0)
+    server.start()
+    channel = GrpcChannel("127.0.0.1", node.grpc_server.port)
+    yield node, channel
+    channel.close()
+    node.grpc_server.stop()
+    server.stop()
+
+
+TRACE_A = "0102030405060708090a0b0c0d0e0f10"
+TRACE_B = "1112131415161718191a1b1c1d1e1f20"
+
+
+def test_otlp_grpc_trace_export(grpc):
+    node, channel = grpc
+    request = _export_request("frontend", [
+        _otlp_span(TRACE_A, "0102030405060708", "GET /", 1_700_000_000,
+                   5000),
+        _otlp_span(TRACE_A, "1102030405060708", "auth", 1_700_000_001,
+                   900),
+    ]) + _export_request("backend", [
+        _otlp_span(TRACE_B, "2102030405060708", "query", 1_700_000_002,
+                   15000),
+    ])
+    messages, status, message = channel.call(
+        "/opentelemetry.proto.collector.trace.v1.TraceService/Export",
+        request)
+    assert status == 0, message
+    assert messages == [b""]  # empty ExportTraceServiceResponse
+
+
+def test_jaeger_grpc_get_services(grpc):
+    node, channel = grpc
+    messages, status, message = channel.call(
+        "/jaeger.storage.v1.SpanReaderPlugin/GetServices", b"")
+    assert status == 0, message
+    services = _decode_strings(messages[0], field=1)
+    assert sorted(services) == ["backend", "frontend"]
+
+
+def test_jaeger_grpc_get_operations(grpc):
+    node, channel = grpc
+    messages, status, _ = channel.call(
+        "/jaeger.storage.v1.SpanReaderPlugin/GetOperations",
+        pb_str(1, "frontend"))
+    assert status == 0
+    names = _decode_strings(messages[0], field=1)
+    assert sorted(names) == ["GET /", "auth"]
+
+
+def test_jaeger_grpc_find_trace_ids(grpc):
+    node, channel = grpc
+    query = pb_msg(1, pb_str(1, "backend"))
+    messages, status, _ = channel.call(
+        "/jaeger.storage.v1.SpanReaderPlugin/FindTraceIDs", query)
+    assert status == 0
+    ids = _decode_byte_fields(messages[0], field=1)
+    assert [i.hex() for i in ids] == [TRACE_B]
+
+
+def test_jaeger_grpc_find_traces_streams_spans(grpc):
+    node, channel = grpc
+    query = pb_msg(1, pb_str(1, "frontend"))
+    messages, status, _ = channel.call(
+        "/jaeger.storage.v1.SpanReaderPlugin/FindTraces", query)
+    assert status == 0
+    assert len(messages) == 1  # one chunk per trace
+    spans = _decode_byte_fields(messages[0], field=1)
+    assert len(spans) == 2
+    names = set()
+    for span in spans:
+        fields = dict(_iter_simple(span))
+        assert fields[1] == bytes.fromhex(TRACE_A)
+        names.add(fields[3].decode())
+    assert names == {"GET /", "auth"}
+
+
+def test_jaeger_grpc_get_trace_not_found(grpc):
+    node, channel = grpc
+    messages, status, message = channel.call(
+        "/jaeger.storage.v1.SpanReaderPlugin/GetTrace",
+        pb_bytes(1, b"\xde\xad\xbe\xef"))
+    assert status == 5  # NOT_FOUND
+    assert "not found" in message
+
+
+def test_unknown_method_unimplemented(grpc):
+    node, channel = grpc
+    _messages, status, message = channel.call("/no.such.Service/Nope", b"")
+    assert status == 12
+    assert "unknown method" in message
+
+
+# --- tiny protobuf readers for assertions ---------------------------------
+
+def _iter_simple(payload: bytes):
+    from quickwit_tpu.serve.otlp_proto import iter_fields
+    for field, wire, value in iter_fields(memoryview(payload)):
+        yield field, bytes(value) if wire == 2 else value
+
+
+def _decode_strings(payload: bytes, field: int) -> list[str]:
+    return [v.decode() for f, v in _iter_simple(payload)
+            if f == field and isinstance(v, bytes)]
+
+
+def _decode_byte_fields(payload: bytes, field: int) -> list[bytes]:
+    return [v for f, v in _iter_simple(payload)
+            if f == field and isinstance(v, bytes)]
+
+
+def test_large_streamed_response_respects_flow_control():
+    """Responses above SETTINGS_MAX_FRAME_SIZE and the 65535 initial
+    flow-control window split into frames and wait for WINDOW_UPDATEs."""
+    from quickwit_tpu.serve.http2 import Http2Server
+    from quickwit_tpu.serve.grpc_server import _grpc_frame
+
+    big = bytes(range(256)) * 1024  # 256 KiB
+
+    def handler(headers, body):
+        return ([(":status", "200"),
+                 ("content-type", "application/grpc")],
+                [_grpc_frame(big)], [("grpc-status", "0")])
+
+    server = Http2Server(handler)
+    channel = GrpcChannel(server.host, server.port)
+    try:
+        messages, status, message = channel.call("/x/Y", b"req")
+        assert status == 0, message
+        assert messages == [big]
+    finally:
+        channel.close()
+        server.stop()
+
+
+def test_grpc_port_loads_from_config(tmp_path):
+    from quickwit_tpu.config.node_config import load_node_config
+    path = tmp_path / "node.yaml"
+    path.write_text("node_id: n1\ngrpc:\n  listen_port: 7281\n")
+    config = load_node_config(str(path), env={})
+    assert config.grpc_port == 7281
+    config2 = load_node_config(str(path), env={"QW_GRPC_PORT": "9999"})
+    assert config2.grpc_port == 9999
+    assert load_node_config(None, env={}).grpc_port is None
+
+
+def test_grpc_server_restarts_with_background_services():
+    node = Node(NodeConfig(node_id="grpc-restart", rest_port=0, grpc_port=0,
+                           metastore_uri="ram:///grpcr/ms",
+                           default_index_root_uri="ram:///grpcr/idx"),
+                storage_resolver=StorageResolver.for_test())
+    assert node.grpc_server is not None
+    node.start_background_services()
+    node.stop_background_services()
+    assert node.grpc_server is None
+    node.start_background_services()
+    try:
+        assert node.grpc_server is not None
+        channel = GrpcChannel("127.0.0.1", node.grpc_server.port)
+        _m, status, _msg = channel.call(
+            "/jaeger.storage.v1.SpanReaderPlugin/GetServices", b"")
+        assert status == 0
+        channel.close()
+    finally:
+        node.stop_background_services()
