@@ -12,8 +12,9 @@ percentiles, cardinality. Sub-aggregations: metrics (percentiles
 included) under buckets at ANY depth, with ARBITRARY bucket nesting —
 multiple sibling bucket children per level, each chain flattened into a
 mixed-radix device bucket space (reference: tantivy's recursive
-aggregation tree, collector.rs:523). Composite takes no sub-aggs yet;
-range accepts metrics but no bucket children.
+aggregation tree, collector.rs:523). Composite takes metric sub-aggs
+(segment-reduced per run on device); range accepts metrics but no
+bucket children.
 """
 
 from __future__ import annotations
@@ -119,6 +120,7 @@ class CompositeAgg:
     sources: tuple[CompositeSource, ...]
     size: int = 10
     after: Optional[tuple[Any, ...]] = None  # decoded per-source values
+    sub_metrics: tuple[MetricAgg, ...] = ()
 
 
 AggSpec = Any  # union of the dataclasses above
@@ -274,11 +276,16 @@ def _parse_one(name: str, body: dict[str, Any], depth: int = 0) -> AggSpec:
         if depth > 0:
             raise AggParseError(
                 f"composite aggregation {name!r} must be top-level")
-        if sub_metrics or sub_buckets:
+        if sub_buckets:
             raise AggParseError(
-                f"composite aggregation {name!r}: sub-aggregations under "
-                "composite are not supported yet")
-        return _parse_composite(name, params)
+                f"composite aggregation {name!r}: bucket aggregations "
+                "under composite are not supported yet")
+        for metric in sub_metrics:
+            if metric.kind in ("percentiles", "cardinality"):
+                raise AggParseError(
+                    f"composite aggregation {name!r}: {metric.kind} under "
+                    "composite is not supported yet")
+        return _parse_composite(name, params, sub_metrics)
     if kind in _METRIC_KINDS:
         if sub_metrics or sub_buckets:
             raise AggParseError(f"metric aggregation {name!r} cannot have sub-aggs")
@@ -319,7 +326,8 @@ def _decode_after_value(value: Any, source_kind: str) -> Any:
     return value
 
 
-def _parse_composite(name: str, params: dict[str, Any]) -> "CompositeAgg":
+def _parse_composite(name: str, params: dict[str, Any],
+                     sub_metrics: tuple = ()) -> "CompositeAgg":
     raw_sources = params.get("sources")
     if not raw_sources or not isinstance(raw_sources, list):
         raise AggParseError(
@@ -379,7 +387,7 @@ def _parse_composite(name: str, params: dict[str, Any]) -> "CompositeAgg":
         raise AggParseError(
             f"composite {name!r}: size must be in [1, 4096]")
     return CompositeAgg(name=name, sources=tuple(sources), size=size,
-                       after=after)
+                        after=after, sub_metrics=sub_metrics)
 
 
 def parse_aggs(aggs: dict[str, Any]) -> list[AggSpec]:

@@ -126,8 +126,25 @@ class IncrementalCollector:
             _merge_bucket_maps(current["bucket_map"], _range_to_map(state))
         elif kind == "composite":
             bucket_map = current["bucket_map"]
-            for key, count in _composite_pairs(state):
-                bucket_map[key] = bucket_map.get(key, 0) + count
+            for key, bucket in _composite_pairs(state):
+                cur = bucket_map.get(key)
+                if isinstance(cur, int):  # pre-metrics wire shape
+                    cur = {"doc_count": cur, "metrics": {}}
+                    bucket_map[key] = cur
+                if cur is None:
+                    bucket_map[key] = bucket
+                    continue
+                cur["doc_count"] += bucket["doc_count"]
+                for mname, acc in bucket["metrics"].items():
+                    cacc = cur["metrics"].get(mname)
+                    if cacc is None:
+                        cur["metrics"][mname] = acc
+                    else:
+                        cacc["sum"] += acc["sum"]
+                        cacc["count"] += acc["count"]
+                        cacc["min"] = min(cacc["min"], acc["min"])
+                        cacc["max"] = max(cacc["max"], acc["max"])
+                        cacc["sum_sq"] += acc["sum_sq"]
         elif kind == "percentiles":
             current["sketch"] = current["sketch"] + state["sketch"]
         elif kind == "cardinality":
@@ -202,13 +219,30 @@ def _copy_state(state: dict[str, Any]) -> dict[str, Any]:
 
 
 def _composite_pairs(state: dict[str, Any]):
-    """(key_tuple, count) pairs from a leaf state ("buckets" list) or an
+    """(key_tuple, bucket) pairs from a leaf state ("buckets" list) or an
     already-merged state ("bucket_map") — wire decode turns tuples into
-    lists, so keys re-freeze here."""
+    lists, so keys re-freeze here. Buckets carry {"doc_count", "metrics"}
+    (metric accumulators keyed by name)."""
+    metric_kinds = state.get("metric_kinds", {})
     if "bucket_map" in state:
-        return [(tuple(k) if isinstance(k, list) else k, c)
-                for k, c in state["bucket_map"].items()]
-    return [(tuple(k), c) for k, c in state["buckets"]]
+        return [(tuple(k) if isinstance(k, list) else k,
+                 {"doc_count": b, "metrics": {}} if isinstance(b, int)
+                 else b)
+                for k, b in state["bucket_map"].items()]
+    out = []
+    for entry in state["buckets"]:
+        values, count = entry[0], entry[1]
+        metrics: dict = {}
+        if len(entry) > 2:
+            for name, accum in entry[2].items():
+                acc = _new_metric_acc(metric_kinds.get(name, "avg"))
+                acc.update({k: v for k, v in accum.items()
+                            if k in ("sum", "count", "min", "max",
+                                     "sum_sq")})
+                metrics[name] = acc
+        out.append((tuple(values), {"doc_count": count,
+                                    "metrics": metrics}))
+    return out
 
 
 def _composite_order_key(key_tuple):
@@ -224,13 +258,18 @@ def _finalize_composite(state: dict[str, Any]) -> dict[str, Any]:
     ordered = ordered[: state["size"]]
     sources = state["sources"]
     buckets = []
-    for key_tuple, count in ordered:
+    for key_tuple, bucket in ordered:
+        if isinstance(bucket, int):  # pre-metrics wire shape
+            bucket = {"doc_count": bucket, "metrics": {}}
         key: dict[str, Any] = {}
         for value, info in zip(key_tuple, sources):
             if info["kind"] == "date_histogram" and value is not None:
                 value = int(value) // 1000  # micros → ES integer ms
             key[info["name"]] = value
-        buckets.append({"key": key, "doc_count": int(count)})
+        entry = {"key": key, "doc_count": int(bucket["doc_count"])}
+        for mname, acc in bucket["metrics"].items():
+            entry[mname] = _finalize_metric(acc)
+        buckets.append(entry)
     out: dict[str, Any] = {"buckets": buckets}
     if buckets:
         out["after_key"] = buckets[-1]["key"]

@@ -382,9 +382,19 @@ def _eval_composite_agg(a: CompositeAggExec, arrays, scalars, mask):
         m = m & gt
     sentinel = jnp.int32(2**31 - 1)
     keys = [jnp.where(m, key, sentinel) for key in keys]
-    sorted_keys = jax.lax.sort(tuple(keys), num_keys=len(keys))
-    if not isinstance(sorted_keys, (tuple, list)):
-        sorted_keys = (sorted_keys,)
+    # metric operands ride the same sort so per-run (bucket) metric
+    # states segment-reduce over contiguous ranges
+    metric_ops: list = []
+    for met in a.metrics:
+        mv = arrays[met.values_slot].astype(jnp.float64)
+        mp = arrays[met.present_slot].astype(jnp.bool_)
+        metric_ops.extend([mv, mp & m])
+    sorted_all = jax.lax.sort(tuple(keys) + tuple(metric_ops),
+                              num_keys=len(keys))
+    if not isinstance(sorted_all, (tuple, list)):
+        sorted_all = (sorted_all,)
+    sorted_keys = sorted_all[: len(keys)]
+    sorted_metrics = sorted_all[len(keys):]
     valid_total = jnp.sum(m.astype(jnp.int32))
     idxs = jnp.arange(num, dtype=jnp.int32)
     diff = jnp.zeros(max(num - 1, 0), dtype=jnp.bool_)
@@ -405,7 +415,42 @@ def _eval_composite_agg(a: CompositeAggExec, arrays, scalars, mask):
     ends = jnp.minimum(starts[1:], valid_total)
     counts = jnp.where(starts[:k_runs] < valid_total,
                        ends - starts[:k_runs], jnp.int32(0))
-    return {"run_keys": run_keys, "counts": counts}
+    out = {"run_keys": run_keys, "counts": counts}
+    if a.metrics:
+        # per-position run id = rank of this position's run among the
+        # first k_runs (positions past them segment-drop)
+        run_id = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+        in_range = (idxs < valid_total) & (run_id >= 0) & (run_id < k_runs)
+        metrics: dict[str, Any] = {}
+        for mi, met in enumerate(a.metrics):
+            mv = sorted_metrics[2 * mi]
+            mp = sorted_metrics[2 * mi + 1].astype(jnp.bool_)
+            seg = jnp.where(in_range & mp, run_id, jnp.int32(k_runs))
+            state: dict[str, Any] = {}
+            need = met.kind
+            if need in ("sum", "avg", "stats", "extended_stats"):
+                state["sum"] = jax.ops.segment_sum(
+                    jnp.where(in_range & mp, mv, 0.0), seg,
+                    num_segments=k_runs + 1)[:k_runs]
+            if need in ("avg", "stats", "extended_stats", "value_count"):
+                state["count"] = jax.ops.segment_sum(
+                    (in_range & mp).astype(jnp.int64), seg,
+                    num_segments=k_runs + 1)[:k_runs]
+            if need in ("min", "stats", "extended_stats"):
+                state["min"] = jax.ops.segment_min(
+                    jnp.where(in_range & mp, mv, jnp.inf), seg,
+                    num_segments=k_runs + 1)[:k_runs]
+            if need in ("max", "stats", "extended_stats"):
+                state["max"] = jax.ops.segment_max(
+                    jnp.where(in_range & mp, mv, -jnp.inf), seg,
+                    num_segments=k_runs + 1)[:k_runs]
+            if need in ("stats", "extended_stats"):
+                state["sum_sq"] = jax.ops.segment_sum(
+                    jnp.where(in_range & mp, mv * mv, 0.0), seg,
+                    num_segments=k_runs + 1)[:k_runs]
+            metrics[met.name] = state
+        out["metrics"] = metrics
+    return out
 
 
 def _eval_aggs(aggs, gathered, scalars, valid):

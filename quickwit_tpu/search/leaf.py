@@ -383,6 +383,9 @@ def _intermediate_aggs(plan, agg_results: list) -> dict[str, Any]:
             run_keys = np.asarray(res["run_keys"])       # [S, k_runs]
             counts = np.asarray(res["counts"])
             src_infos = a.host_info["sources"]
+            metric_kinds = a.host_info.get("metric_kinds", {})
+            res_metrics = {name: {k: np.asarray(v) for k, v in m.items()}
+                           for name, m in res.get("metrics", {}).items()}
             buckets = []
             for j in range(run_keys.shape[1]):
                 if counts[j] <= 0:
@@ -398,10 +401,18 @@ def _intermediate_aggs(plan, agg_results: list) -> dict[str, Any]:
                         values.append(info["keys"][idx])
                     else:  # histogram kinds decode to absolute keys
                         values.append(info["origin"] + idx * info["interval"])
-                buckets.append([values, int(counts[j])])
+                entry = [values, int(counts[j])]
+                if res_metrics:
+                    entry.append({
+                        name: {k: (float(v[j]) if k != "count"
+                                   else int(v[j]))
+                               for k, v in state.items()}
+                        for name, state in res_metrics.items()})
+                buckets.append(entry)
             out[a.name] = {
                 "kind": "composite", "buckets": buckets,
                 "size": a.host_info["size"],
+                "metric_kinds": dict(metric_kinds),
                 "sources": [{"name": i["name"], "kind": i["kind"]}
                             for i in src_infos],
             }

@@ -224,11 +224,13 @@ class CompositeAggExec:
     sources: tuple[CompositeSourceExec, ...]
     size: int
     has_after: bool
+    metrics: tuple["MetricSlots", ...] = ()
     host_info: Any = None     # per-source decode info (not jit-relevant)
 
     def sig(self) -> str:
         return (f"cagg({self.size},{int(self.has_after)},"
-                + ",".join(s.sig() for s in self.sources) + ")")
+                + ",".join(s.sig() for s in self.sources) + ";"
+                + ",".join(m.sig() for m in self.metrics) + ")")
 
 
 def aligned_origin(vmin, interval, offset=0):
@@ -1142,7 +1144,10 @@ class Lowering:
         return CompositeAggExec(
             name=spec.name, sources=tuple(execs), size=spec.size,
             has_after=spec.after is not None,
-            host_info={"sources": infos, "size": spec.size})
+            metrics=self._metric_tuple(spec.sub_metrics),
+            host_info={"sources": infos, "size": spec.size,
+                       "metric_kinds": {m.name: m.kind
+                                        for m in spec.sub_metrics}})
 
     def _lower_composite_source(self, agg_name: str, src: CompositeSource,
                                 has_after: bool, after_val,

@@ -190,7 +190,42 @@ def test_composite_parse_errors():
         parse_aggs({"c": {"composite": {
             "sources": [{"x": {"terms": {"field": "f"}}}],
             "after": {"wrong_name": 1}}}})
-    with pytest.raises(AggParseError):  # sub-aggs not supported yet
+    # metric sub-aggs are supported; BUCKET children are not
+    spec = parse_aggs({"c": {"composite": {"sources": [
+        {"x": {"terms": {"field": "f"}}}]},
+        "aggs": {"m": {"avg": {"field": "g"}}}}})[0]
+    assert spec.sub_metrics[0].kind == "avg"
+    with pytest.raises(AggParseError):
         parse_aggs({"c": {"composite": {"sources": [
             {"x": {"terms": {"field": "f"}}}]},
-            "aggs": {"m": {"avg": {"field": "g"}}}}})
+            "aggs": {"t": {"terms": {"field": "g"}}}}})
+    with pytest.raises(AggParseError):  # percentiles under composite
+        parse_aggs({"c": {"composite": {"sources": [
+            {"x": {"terms": {"field": "f"}}}]},
+            "aggs": {"p": {"percentiles": {"field": "g"}}}}})
+
+
+def test_composite_metric_sub_aggs_exact(split_readers):
+    """Metric sub-aggs under composite segment-reduce per run on device;
+    values match brute force, including across a cross-split merge."""
+    aggs = {"c": {
+        "composite": {"size": 100, "sources": [
+            {"name": {"terms": {"field": "name"}}}]},
+        "aggs": {"r_avg": {"avg": {"field": "response"}},
+                 "r_max": {"max": {"field": "response"}},
+                 "n": {"value_count": {"field": "response"}}}}}
+    result = _search(aggs, split_readers)["c"]
+    assert result["buckets"]
+    for b in result["buckets"]:
+        name = b["key"]["name"]
+        docs = [d for d in DOCS if d["name"] == name]
+        vals = [d["response"] for d in docs if "response" in d]
+        assert b["doc_count"] == len(docs)
+        assert b["n"]["value"] == len(vals)
+        if vals:
+            assert b["r_avg"]["value"] == pytest.approx(
+                sum(vals) / len(vals))
+            assert b["r_max"]["value"] == max(vals)
+        else:  # Horst: no response values at all
+            assert b["r_avg"]["value"] is None
+            assert b["r_max"]["value"] is None

@@ -156,8 +156,8 @@ def test_nested_bucket_space_capped():
         leaf_search_single_split(request, MAPPER, reader, "wide")
 
 
-def test_composite_still_rejects_sub_aggs():
+def test_composite_still_rejects_bucket_sub_aggs():
     with pytest.raises(AggParseError):
         parse_aggs({"c": {"composite": {"sources": [
             {"s": {"terms": {"field": "service"}}}]},
-            "aggs": {"m": {"avg": {"field": "latency"}}}}})
+            "aggs": {"t": {"terms": {"field": "level"}}}}})
