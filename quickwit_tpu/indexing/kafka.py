@@ -546,15 +546,20 @@ class KafkaSource:
                         self.topic, partition, offset,
                         max_bytes=self.max_fetch_bytes)
                 except KafkaProtocolError as exc:
-                    if (exc.code == OFFSET_OUT_OF_RANGE
-                            and earliest[partition] > offset):
-                        # retention truncated past the checkpoint: resume
-                        # at the earliest retained offset (the records in
-                        # between are gone — auto.offset.reset=earliest
-                        # semantics; the checkpoint jump is the honest
-                        # record of the loss)
-                        offset = earliest[partition]
-                        continue
+                    if exc.code == OFFSET_OUT_OF_RANGE:
+                        # refresh the floor first: retention may have
+                        # truncated DURING this pass, making the snapshot
+                        # taken at pass start stale
+                        earliest[partition] = self.client.list_offsets(
+                            self.topic, [partition], EARLIEST)[partition]
+                        if earliest[partition] > offset:
+                            # retention truncated past the checkpoint:
+                            # resume at the earliest retained offset (the
+                            # records in between are gone —
+                            # auto.offset.reset=earliest semantics; the
+                            # checkpoint jump is the honest record of loss)
+                            offset = earliest[partition]
+                            continue
                     raise
                 records = [(off, v) for off, v in records if off < target]
                 if not records:

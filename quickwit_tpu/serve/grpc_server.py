@@ -86,8 +86,9 @@ def _pb_duration(micros: int) -> bytes:
 
 def _pb_keyvalue(key: str, value: Any) -> bytes:
     # jaeger.api_v2 KeyValue: key=1, v_type=2, v_str=3, v_bool=4
+    # ValueType: STRING=0, BOOL=1, INT64=2
     if isinstance(value, bool):
-        return pb_str(1, key) + pb_varint(2, 2) + pb_varint(4, 1 if value else 0)
+        return pb_str(1, key) + pb_varint(2, 1) + pb_varint(4, 1 if value else 0)
     return pb_str(1, key) + pb_str(3, str(value))
 
 
@@ -143,12 +144,25 @@ def _decode_trace_query(payload: bytes) -> dict[str, Any]:
                     query["service"] = bytes(v2).decode("utf-8", "replace")
                 elif f2 == 2 and w2 == 2:
                     query["operation"] = bytes(v2).decode("utf-8", "replace")
+                elif f2 == 3 and w2 == 2:
+                    # map<string,string> tags: repeated entries {key=1, value=2}
+                    key = text = ""
+                    for f3, w3, v3 in _fields(bytes(v2)):
+                        if f3 == 1 and w3 == 2:
+                            key = bytes(v3).decode("utf-8", "replace")
+                        elif f3 == 2 and w3 == 2:
+                            text = bytes(v3).decode("utf-8", "replace")
+                    if key:
+                        query.setdefault("tags", {})[key] = text
                 elif f2 == 4 and w2 == 2:
                     query["start_min"] = _decode_timestamp_s(bytes(v2))
                 elif f2 == 5 and w2 == 2:
                     query["start_max"] = _decode_timestamp_s(bytes(v2))
                 elif f2 == 6 and w2 == 2:
                     query["duration_min_micros"] = \
+                        _decode_duration_micros(bytes(v2))
+                elif f2 == 7 and w2 == 2:
+                    query["duration_max_micros"] = \
                         _decode_duration_micros(bytes(v2))
                 elif f2 == 8 and w2 == 0:
                     query["num_traces"] = int(v2)
@@ -256,14 +270,21 @@ class GrpcServer:
             out += pb_msg(2, pb_str(1, name))           # Operation{name}
         yield bytes(out)
 
-    def _find_trace_ids(self, payload: bytes):
-        query = _decode_trace_query(payload)
-        trace_ids = self.node.otel.find_traces(
+    @staticmethod
+    def _trace_query_kwargs(query: dict[str, Any]) -> dict[str, Any]:
+        return dict(
             service=query.get("service"), operation=query.get("operation"),
             min_duration_micros=query.get("duration_min_micros"),
+            max_duration_micros=query.get("duration_max_micros"),
+            tags=query.get("tags"),
             start_timestamp=query.get("start_min"),
             end_timestamp=query.get("start_max"),
             limit=query.get("num_traces", 20))
+
+    def _find_trace_ids(self, payload: bytes):
+        query = _decode_trace_query(payload)
+        trace_ids = self.node.otel.find_traces(
+            **self._trace_query_kwargs(query))
         out = bytearray()
         for trace_id in trace_ids:
             out += pb_bytes(1, _hex_bytes(trace_id))
@@ -271,16 +292,12 @@ class GrpcServer:
 
     def _find_traces(self, payload: bytes):
         query = _decode_trace_query(payload)
-        trace_ids = self.node.otel.find_traces(
-            service=query.get("service"), operation=query.get("operation"),
-            min_duration_micros=query.get("duration_min_micros"),
-            start_timestamp=query.get("start_min"),
-            end_timestamp=query.get("start_max"),
-            limit=query.get("num_traces", 20))
+        traces = self.node.otel.find_traces_with_spans(
+            **self._trace_query_kwargs(query))
         # server-streaming: one SpansResponseChunk per trace
-        for trace_id in trace_ids:
+        for _trace_id, docs in traces:
             chunk = bytearray()
-            for doc in self.node.otel.get_trace(trace_id):
+            for doc in docs:
                 chunk += pb_msg(1, encode_jaeger_span(doc))
             yield bytes(chunk)
 

@@ -380,7 +380,8 @@ class _ConnState:
                 ident = int.from_bytes(payload[i: i + 2], "big")
                 value = int.from_bytes(payload[i + 2: i + 6], "big")
                 if ident == 0x5:
-                    self.max_frame_size = max(16384, min(value, 1 << 24 - 1))
+                    self.max_frame_size = max(16384,
+                                              min(value, (1 << 24) - 1))
                 elif ident == 0x4:
                     delta = value - self._initial_stream_window
                     self._initial_stream_window = value

@@ -285,6 +285,10 @@ class Node:
         self._transform_cache: dict[tuple, Any] = {}
         # cached external-source clients (kafka connections survive passes)
         self._external_sources: dict[tuple, Any] = {}
+        # one pass at a time per (index_uid, source_id): a REST-triggered
+        # pass and the background tick must not drain the same cached
+        # source concurrently (the per-source pipeline-actor guarantee)
+        self._source_pass_locks: dict[tuple, threading.Lock] = {}
         self.root_searcher = RootSearcher(
             self.metastore, self.clients,
             nodes_provider=lambda: self.cluster.nodes_with_role("searcher"))
@@ -425,7 +429,17 @@ class Node:
         (`indexing_service.rs:1152`). Checkpoints make each pass resume
         exactly where the last one stopped; source clients are cached so
         broker connections persist across passes."""
-        metadata = self.metastore.index_metadata(index_id)
+        with self._lock:
+            pass_lock = self._source_pass_locks.setdefault(
+                (index_id, source_id), threading.Lock())
+        with pass_lock:
+            # metadata is read INSIDE the lock: a pass queued behind a
+            # running one must see config changes (source deleted /
+            # re-pointed) made while it waited
+            metadata = self.metastore.index_metadata(index_id)
+            return self._run_source_pass_locked(metadata, source_id)
+
+    def _run_source_pass_locked(self, metadata, source_id: str):
         source_config = metadata.sources.get(source_id)
         if (source_config is None or not source_config.enabled
                 or source_config.source_type in self._INTERNAL_SOURCE_TYPES):

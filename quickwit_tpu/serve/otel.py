@@ -245,11 +245,19 @@ class OtelService:
     def find_traces(self, service: Optional[str] = None,
                     operation: Optional[str] = None,
                     min_duration_micros: Optional[int] = None,
+                    max_duration_micros: Optional[int] = None,
+                    tags: "Optional[dict[str, str]]" = None,
                     start_timestamp: Optional[int] = None,
                     end_timestamp: Optional[int] = None,
-                    limit: int = 20) -> list[str]:
+                    limit: int = 20,
+                    span_cache: "Optional[dict]" = None) -> list[str]:
         """Trace ids of matching spans, most-recent first (the
-        FindTraceIdsAggregation role: newest max-span-timestamp per trace)."""
+        FindTraceIdsAggregation role: newest max-span-timestamp per trace).
+
+        Tag filters post-filter fetched spans: span attributes live in the
+        lenient-mode RAW doc, not in indexed columns, so a trace qualifies
+        when at least one of its spans carries ALL requested tags (Jaeger
+        semantics; `error=true` matches span_status == "error")."""
         from ..query.ast import Bool, MatchAll, Range, RangeBound, Term
         from ..search.models import SearchRequest, SortField
         must = []
@@ -261,23 +269,78 @@ class OtelService:
         if min_duration_micros is not None:
             filters.append(Range("span_duration_micros",
                                  lower=RangeBound(min_duration_micros, True)))
+        if max_duration_micros is not None:
+            filters.append(Range("span_duration_micros",
+                                 upper=RangeBound(max_duration_micros, True)))
         ast = Bool(must=tuple(must), filter=tuple(filters)) \
             if (must or filters) else MatchAll()
-        # device-side FindTraceIdsAggregation (reference
-        # find_trace_ids_collector.rs): a terms aggregation over the
-        # trace_id fast column ordered by max span timestamp — the
-        # dedup/top-N runs in the bucket kernels, not over fetched docs
+
+        def top_trace_ids(size: int) -> "tuple[list[str], bool]":
+            # device-side FindTraceIdsAggregation (reference
+            # find_trace_ids_collector.rs): a terms aggregation over the
+            # trace_id fast column ordered by max span timestamp — the
+            # dedup/top-N runs in the bucket kernels, not over fetched docs
+            response = self.node.root_searcher.search(SearchRequest(
+                index_ids=[OTEL_TRACES_INDEX], query_ast=ast, max_hits=0,
+                aggs={"trace_ids": {
+                    "terms": {"field": "trace_id", "size": size,
+                              "order": {"max_ts": "desc"}},
+                    "aggs": {"max_ts": {
+                        "max": {"field": "span_start_timestamp"}}}}},
+                start_timestamp=start_timestamp, end_timestamp=end_timestamp))
+            buckets = (response.aggregations or {}).get(
+                "trace_ids", {}).get("buckets", [])
+            exhausted = len(buckets) < size
+            return [b["key"] for b in buckets if b["key"]], exhausted
+
         # size+1: spans ingested without a traceId bucket under "" and
-        # are dropped below — the extra slot keeps `limit` real traces
+        # are dropped above — the extra slot keeps `limit` real traces
         # even when the empty bucket ranks in the top N
-        response = self.node.root_searcher.search(SearchRequest(
-            index_ids=[OTEL_TRACES_INDEX], query_ast=ast, max_hits=0,
-            aggs={"trace_ids": {
-                "terms": {"field": "trace_id", "size": limit + 1,
-                          "order": {"max_ts": "desc"}},
-                "aggs": {"max_ts": {
-                    "max": {"field": "span_start_timestamp"}}}}},
-            start_timestamp=start_timestamp, end_timestamp=end_timestamp))
-        buckets = (response.aggregations or {}).get(
-            "trace_ids", {}).get("buckets", [])
-        return [b["key"] for b in buckets if b["key"]][:limit]
+        if not tags:
+            trace_ids, _ = top_trace_ids(limit + 1)
+            return trace_ids[:limit]
+        # tag post-filtering prunes AFTER the agg, so widen the candidate
+        # pool geometrically until `limit` matches or the index runs dry
+        # (the cache is request-scoped — passed down, never instance state)
+        cache = {} if span_cache is None else span_cache
+        size = limit * 5 + 1
+        while True:
+            trace_ids, exhausted = top_trace_ids(size)
+            matches = [t for t in trace_ids
+                       if self._trace_matches_tags(t, tags, cache)]
+            if len(matches) >= limit or exhausted:
+                return matches[:limit]
+            size *= 4
+
+    def find_traces_with_spans(self, **kwargs) -> "list[tuple[str, list]]":
+        """find_traces + the span docs of each match, fetching each trace's
+        spans at most once across filter + response encoding (the gRPC
+        FindTraces streaming path)."""
+        cache: dict = {}
+        trace_ids = self.find_traces(span_cache=cache, **kwargs)
+        return [(t, cache[t] if t in cache else self.get_trace(t))
+                for t in trace_ids]
+
+    @staticmethod
+    def _tag_value(value: Any) -> str:
+        # jaeger clients send "true"/"false" for bool tags; OTLP decoding
+        # stores Python bools — normalize both to the wire spelling
+        if isinstance(value, bool):
+            return "true" if value else "false"
+        return str(value)
+
+    def _trace_matches_tags(self, trace_id: str, tags: "dict[str, str]",
+                            cache: dict) -> bool:
+        if trace_id not in cache:
+            cache[trace_id] = self.get_trace(trace_id)
+        for doc in cache[trace_id]:
+            attrs = dict(doc.get("attributes") or {})
+            if doc.get("span_status") == "error":
+                attrs.setdefault("error", "true")
+            # exact string match (Jaeger tag semantics), with bools
+            # normalized to their lowercase wire spelling
+            if all(k in attrs
+                   and self._tag_value(attrs[k]) == self._tag_value(v)
+                   for k, v in tags.items()):
+                return True
+        return False

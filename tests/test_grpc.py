@@ -137,6 +137,63 @@ def test_unknown_method_unimplemented(grpc):
     assert "unknown method" in message
 
 
+TRACE_C = "2122232425262728292a2b2c2d2e2f30"
+
+
+def _tagged_span(trace_id: str, span_id: str, name: str, start_s: int,
+                 dur_us: int, attrs: dict, error: bool = False) -> bytes:
+    span = _otlp_span(trace_id, span_id, name, start_s, dur_us)
+    for key, value in attrs.items():
+        key_value = pb_str(1, key) + pb_msg(2, pb_str(1, str(value)))
+        span += pb_msg(9, key_value)
+    if error:
+        span += pb_msg(15, pb_varint(3, 2))  # Status{code: ERROR}
+    return span
+
+
+def test_jaeger_grpc_find_traces_tag_and_duration_max_filters(grpc):
+    node, channel = grpc
+    request = _export_request("tagged", [
+        _tagged_span(TRACE_C, "3102030405060708", "slow-err", 1_700_000_010,
+                     50_000, {"env": "prod"}, error=True),
+    ])
+    _, status, message = channel.call(
+        "/opentelemetry.proto.collector.trace.v1.TraceService/Export",
+        request)
+    assert status == 0, message
+
+    def find(params: bytes) -> list[bytes]:
+        messages, status, msg = channel.call(
+            "/jaeger.storage.v1.SpanReaderPlugin/FindTraceIDs",
+            pb_msg(1, params))
+        assert status == 0, msg
+        return _decode_byte_fields(messages[0], field=1) if messages else []
+
+    tag = pb_msg(3, pb_str(1, "env") + pb_str(2, "prod"))
+    assert [i.hex() for i in find(pb_str(1, "tagged") + tag)] == [TRACE_C]
+    # non-matching tag value filters the trace out
+    bad_tag = pb_msg(3, pb_str(1, "env") + pb_str(2, "staging"))
+    assert find(pb_str(1, "tagged") + bad_tag) == []
+    # error=true matches the span_status-derived virtual tag
+    err_tag = pb_msg(3, pb_str(1, "error") + pb_str(2, "true"))
+    assert [i.hex() for i in find(pb_str(1, "tagged") + err_tag)] == [TRACE_C]
+    # duration_max below the span's 50ms filters it out (field 7 Duration)
+    dur_max = pb_msg(7, pb_varint(2, 1_000_000))  # 1ms in nanos
+    assert find(pb_str(1, "tagged") + dur_max) == []
+
+    # bool tags stream back with v_type=BOOL(1) + v_bool (the mandated
+    # error=true tag on error spans; reference emits ValueType::Bool=1)
+    messages, status, _ = channel.call(
+        "/jaeger.storage.v1.SpanReaderPlugin/FindTraces",
+        pb_msg(1, pb_str(1, "tagged")))
+    assert status == 0 and len(messages) == 1
+    spans = _decode_byte_fields(messages[0], field=1)
+    kvs = [dict(_iter_simple(kv))
+           for kv in _decode_byte_fields(spans[0], field=8)]
+    error_kv = next(kv for kv in kvs if kv[1] == b"error")
+    assert error_kv[2] == 1 and error_kv[4] == 1  # v_type=BOOL, v_bool=true
+
+
 # --- tiny protobuf readers for assertions ---------------------------------
 
 def _iter_simple(payload: bytes):
