@@ -50,7 +50,8 @@ class GossipService:
     def __init__(self, cluster: Cluster, node_id: str, roles: tuple[str, ...],
                  rest_endpoint: str, bind_host: str, bind_port: int,
                  seeds: tuple[str, ...] = (), interval_secs: float = 1.0,
-                 fanout: int = 3, cluster_id: str = "quickwit-tpu"):
+                 fanout: int = 3, cluster_id: str = "quickwit-tpu",
+                 grpc_endpoint: str = ""):
         self.cluster = cluster
         self.node_id = node_id
         # chitchat embeds the cluster_id in every message and rejects
@@ -69,6 +70,7 @@ class GossipService:
             node_id: {"generation": time.time_ns(), "version": 1,
                       "data": {"roles": list(roles),
                                "rest_endpoint": rest_endpoint,
+                               "grpc_endpoint": grpc_endpoint,
                                "gossip_port": 0}},  # patched after bind
         }
         self._lock = threading.Lock()
@@ -141,6 +143,8 @@ class GossipService:
             if source_host:
                 data["rest_endpoint"] = substitute_wildcard_host(
                     endpoint, source_host)
+                data["grpc_endpoint"] = substitute_wildcard_host(
+                    str(data.get("grpc_endpoint", "")), source_host)
             with self._lock:
                 current = self._state.get(nid)
                 if current is not None and (
@@ -151,7 +155,8 @@ class GossipService:
                                     "version": version, "data": data}
             member = ClusterMember(
                 node_id=nid, roles=tuple(data.get("roles", ())),
-                rest_endpoint=str(data.get("rest_endpoint", "")))
+                rest_endpoint=str(data.get("rest_endpoint", "")),
+                grpc_endpoint=str(data.get("grpc_endpoint", "")))
             self.cluster.upsert_heartbeat(member)
 
     def _gossip_addresses(self) -> list[tuple[str, int]]:

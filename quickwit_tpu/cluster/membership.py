@@ -52,6 +52,7 @@ class ClusterMember:
     node_id: str
     roles: tuple[str, ...]
     rest_endpoint: str = ""          # "host:port" for cross-process transport
+    grpc_endpoint: str = ""          # "host:port" gRPC plane ("" = REST only)
     generation: int = 0
     is_ready: bool = True
     last_heartbeat: float = field(default_factory=time.monotonic)
@@ -146,7 +147,8 @@ class Cluster:
         tick), then stamp liveness either way."""
         current = self.member(member.node_id)
         if (current is None or current.roles != member.roles
-                or current.rest_endpoint != member.rest_endpoint):
+                or current.rest_endpoint != member.rest_endpoint
+                or current.grpc_endpoint != member.grpc_endpoint):
             self.join(member)
         self.record_heartbeat(member.node_id)
 

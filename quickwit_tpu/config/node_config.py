@@ -86,12 +86,26 @@ def load_node_config(path: Optional[str] = None,
             "offload_endpoint"),
         offload_max_local_splits=int((data.get("searcher", {}) or {}).get(
             "offload_max_local_splits", 16)),
+        **_split_cache_fields(data),
         grpc_port=(int(environ["QW_GRPC_PORT"])
                    if "QW_GRPC_PORT" in environ
                    else (int((data.get("grpc", {}) or {})["listen_port"])
                          if (data.get("grpc") or {}).get("listen_port")
                          is not None else None)),
     )
+
+
+def _split_cache_fields(data: dict) -> dict[str, Any]:
+    """`searcher.split_cache: {root_path, max_bytes, max_splits}` → the
+    NodeConfig disk-split-cache fields (absent/None = disabled)."""
+    cache = (data.get("searcher", {}) or {}).get("split_cache")
+    if not isinstance(cache, dict) or not cache.get("root_path"):
+        return {}
+    return {
+        "split_cache_dir": str(cache["root_path"]),
+        "split_cache_max_bytes": int(cache.get("max_bytes", 10 << 30)),
+        "split_cache_max_splits": int(cache.get("max_splits", 10_000)),
+    }
 
 
 def load_index_config(path: str, env: Optional[dict[str, str]] = None) -> dict[str, Any]:

@@ -59,33 +59,58 @@ def phrase_match(
             by_key.setdefault(k, []).append(i)
         dup_groups = [slots for slots in by_key.values() if len(slots) > 1]
 
+    if slop == 0:
+        return _exact_phrase_vectorized(positions, term_indices, common)
+
     for row, doc_id in enumerate(common):
-        if slop > 0:
-            relatives = []
-            for i in range(len(postings)):
-                offs, data = positions[i]
-                ji = term_indices[i][row]
-                relatives.append(
-                    data[offs[ji]: offs[ji + 1]].astype(np.int64) - i)
-            freq = _sloppy_matches(relatives, slop, dup_groups)
-            if freq > 0:
-                out_ids.append(int(doc_id))
-                out_freqs.append(freq)
-            continue
-        offsets0, data0 = positions[0]
-        j0 = term_indices[0][row]
-        base = data0[offsets0[j0]: offsets0[j0 + 1]].astype(np.int64)
-        for i in range(1, len(postings)):
+        relatives = []
+        for i in range(len(postings)):
             offs, data = positions[i]
             ji = term_indices[i][row]
-            pos_i = data[offs[ji]: offs[ji + 1]].astype(np.int64)
-            base = np.intersect1d(base, pos_i - i, assume_unique=True)
-            if base.size == 0:
-                break
-        if base.size:
+            relatives.append(
+                data[offs[ji]: offs[ji + 1]].astype(np.int64) - i)
+        freq = _sloppy_matches(relatives, slop, dup_groups)
+        if freq > 0:
             out_ids.append(int(doc_id))
-            out_freqs.append(int(base.size))
+            out_freqs.append(freq)
     return np.array(out_ids, dtype=np.int32), np.array(out_freqs, dtype=np.int32)
+
+
+def _exact_phrase_vectorized(positions, term_indices, common):
+    """slop=0 across ALL common docs at once — no per-doc Python loop.
+
+    Positions of term i are shifted by -i (relative alignment) and encoded
+    as doc_row * 2^32 + relative_position; the phrase's alignments are the
+    k-way intersection of these encoded sets, and per-doc phrase freqs fall
+    out of one bincount. Frequent phrases (10^4+ candidate docs) match in
+    milliseconds instead of seconds."""
+    base = None
+    for i, (offs, data) in enumerate(positions):
+        idx = term_indices[i]
+        starts = offs[idx].astype(np.int64)
+        lens = (offs[idx + 1] - offs[idx]).astype(np.int64)
+        total = int(lens.sum())
+        if total == 0:
+            return (np.array([], dtype=np.int32),
+                    np.array([], dtype=np.int32))
+        # ragged gather: element j of run r sits at starts[r] + j
+        run_of = np.repeat(np.arange(len(idx), dtype=np.int64), lens)
+        within = np.arange(total, dtype=np.int64) - \
+            np.repeat(np.cumsum(lens) - lens, lens)
+        vals = data[starts[run_of] + within].astype(np.int64)
+        # +len(positions) keeps the shifted relatives (vals - i) positive
+        # for every slot, so the doc-row bits stay clean
+        encoded = run_of << np.int64(32) | (vals - i + len(positions))
+        base = encoded if base is None else \
+            np.intersect1d(base, encoded, assume_unique=True)
+        if base.size == 0:
+            return (np.array([], dtype=np.int32),
+                    np.array([], dtype=np.int32))
+    rows = (base >> np.int64(32)).astype(np.int64)
+    freqs_per_row = np.bincount(rows, minlength=len(common))
+    hit_rows = np.nonzero(freqs_per_row)[0]
+    return (common[hit_rows].astype(np.int32),
+            freqs_per_row[hit_rows].astype(np.int32))
 
 
 def _sloppy_matches(relatives: list[np.ndarray], slop: int,

@@ -280,7 +280,10 @@ def batch_shardings(batch: SplitBatch, mesh: Mesh):
     return tuple(array_shardings), tuple(scalar_shardings), nd_sharding
 
 
-def _batch_executor(batch: SplitBatch, k: int, mesh: Optional[Mesh]):
+def batch_fn(batch: SplitBatch, k: int):
+    """The unjitted merged-batch closure (arrays, scalars, num_docs) →
+    result tree — exposed so measurement harnesses can wrap it (e.g. in a
+    device-side repeat loop) before jitting."""
     template = batch.template
     single_fn = executor_mod._build(template, k)
 
@@ -304,10 +307,31 @@ def _batch_executor(batch: SplitBatch, k: int, mesh: Optional[Mesh]):
         return top_vals, split_idx, flat_ids, flat_scores, total, \
             _merge_agg_stack(agg_out)
 
+    return fn
+
+
+def _batch_executor(batch: SplitBatch, k: int, mesh: Optional[Mesh],
+                    example_args):
+    """(jitted_packed_fn, treedef, spec): the merged result tree rides ONE
+    f64 device array so the readback is a single transfer (see
+    executor.py packed-readback rationale; exactness argument identical)."""
+    fn = batch_fn(batch, k)
+    shaped = jax.eval_shape(fn, *example_args)
+    treedef = jax.tree_util.tree_structure(shaped)
+    spec = [(leaf.shape, leaf.dtype)
+            for leaf in jax.tree_util.tree_leaves(shaped)]
+
+    def packed(arrays, scalars, num_docs):
+        out = fn(arrays, scalars, num_docs)
+        flat = [leaf.reshape(-1).astype(jnp.float64)
+                for leaf in jax.tree_util.tree_leaves(out)]
+        return jnp.concatenate(flat) if flat else jnp.zeros((0,))
+
     if mesh is None:
-        return jax.jit(fn)
+        return jax.jit(packed), treedef, spec
     arrays_sh, scalars_sh, nd_sh = batch_shardings(batch, mesh)
-    return jax.jit(fn, in_shardings=(arrays_sh, scalars_sh, nd_sh))
+    return (jax.jit(packed, in_shardings=(arrays_sh, scalars_sh, nd_sh)),
+            treedef, spec)
 
 
 def stage_device_inputs(batch: SplitBatch, mesh: Optional[Mesh] = None):
@@ -343,17 +367,26 @@ def execute_batch(batch: SplitBatch, request: SearchRequest,
     # k=0 (count/agg-only): per-split executors skip keying/top-k and the
     # batch merge skips the cross-split top_k
     k = min(request.start_offset + request.max_hits, batch.num_docs_padded)
+    arrays, scalars, nd = stage_device_inputs(batch, mesh)
     # Mesh is hashable; id() would go stale if a dead mesh's address is reused
     key = (batch.template.signature(k), batch.n_splits,
            batch.num_docs_padded, mesh)
-    ex = _BATCH_JIT_CACHE.get(key)
-    if ex is None:
-        ex = _batch_executor(batch, k, mesh)
-        _BATCH_JIT_CACHE[key] = ex
+    cached = _BATCH_JIT_CACHE.get(key)
+    if cached is None:
+        cached = _batch_executor(batch, k, mesh, (arrays, scalars, nd))
+        _BATCH_JIT_CACHE[key] = cached
+    ex, treedef, spec = cached
 
-    arrays, scalars, nd = stage_device_inputs(batch, mesh)
-    out = ex(arrays, scalars, nd)
-    top_vals, split_idx, doc_ids, scores, total, merged_aggs = jax.device_get(out)
+    packed = jax.device_get(ex(arrays, scalars, nd))
+    leaves = []
+    offset = 0
+    for shape, dtype in spec:
+        size = int(np.prod(shape)) if shape else 1
+        leaves.append(packed[offset: offset + size]
+                      .astype(dtype).reshape(shape))
+        offset += size
+    top_vals, split_idx, doc_ids, scores, total, merged_aggs = \
+        jax.tree_util.tree_unflatten(treedef, leaves)
 
     num_hits = int(total)
     hits: list[PartialHit] = []

@@ -64,8 +64,13 @@ class SearcherContext:
                  prefetch: bool = True,
                  offload_endpoint: Optional[str] = None,
                  offload_max_local_splits: int = 16,
-                 offload_client_factory=None):
+                 offload_client_factory=None,
+                 split_cache=None):
         self.storage_resolver = storage_resolver or StorageResolver.default()
+        # disk-resident split cache (reference SearchSplitCache,
+        # split_cache/mod.rs:43): reader opens check it first; misses
+        # report the split as a download candidate
+        self.split_cache = split_cache
         self.leaf_cache = LeafSearchCache(leaf_cache_bytes)
         self.batch_size = batch_size
         # warmup/compute pipelining (SURVEY hard-part #4): one prefetch
@@ -136,6 +141,17 @@ class SearcherContext:
                 self._readers.move_to_end(key)
                 return reader
         storage = self.storage_resolver.resolve(split.storage_uri)
+        if self.split_cache is not None:
+            local = self.split_cache.local_path(split.split_id)
+            if local is not None:
+                from ..common.uri import Uri
+                from ..storage.local import LocalFileStorage
+                storage = LocalFileStorage(
+                    Uri.parse(f"file://{self.split_cache.root_path}"))
+            else:
+                self.split_cache.report_split(
+                    split.split_id, split.storage_uri,
+                    num_bytes_hint=split.file_len or 0)
         reader = SplitReader(storage, f"{split.split_id}.split",
                              file_len=split.file_len)
         with self._lock:

@@ -7,10 +7,10 @@ subset a gRPC server needs is implemented here:
 
 - connection preface + SETTINGS exchange, PING replies, GOAWAY
 - HEADERS/CONTINUATION with full HPACK decoding (static + dynamic
-  tables, integer prefix coding) — EXCEPT Huffman-coded string literals,
-  which raise a clear error (the RFC 7541 Appendix B code table is a
-  fixed constant this from-scratch build does not embed; gRPC clients
-  can disable Huffman, and the in-repo client sends raw literals)
+  tables, integer prefix coding, Huffman-coded string literals via the
+  RFC 7541 Appendix B table in `hpack_huffman.py`) — stock gRPC clients
+  (grpc-core Huffman-encodes headers by default) interoperate; see the
+  grpcio-client tests
 - DATA with flow control (generous WINDOW_UPDATEs keep senders moving)
 - response HEADERS + DATA + trailers (gRPC's status trailers), encoded
   as literal-without-indexing raw strings (always-valid HPACK)
@@ -71,7 +71,7 @@ class Http2Error(RuntimeError):
 
 
 class HpackDecoder:
-    """RFC 7541 decoder (dynamic table, no Huffman — see module doc)."""
+    """RFC 7541 decoder: dynamic table + Huffman string literals."""
 
     def __init__(self, max_table_size: int = 4096):
         self.dynamic: list[tuple[str, str]] = []
@@ -117,9 +117,11 @@ class HpackDecoder:
         raw = data[pos: pos + length]
         pos += length
         if huffman:
-            raise Http2Error(
-                "huffman-coded header strings are not supported by this "
-                "HPACK decoder (disable huffman on the client)")
+            from .hpack_huffman import HuffmanError, huffman_decode
+            try:
+                raw = huffman_decode(bytes(raw))
+            except HuffmanError as exc:
+                raise Http2Error(f"bad huffman header literal: {exc}")
         return raw.decode("utf-8", "replace"), pos
 
     def decode(self, data: bytes) -> list[tuple[str, str]]:

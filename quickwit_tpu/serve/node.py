@@ -89,6 +89,11 @@ class NodeConfig:
     # protocol (peer node, FaaS worker pool). None = all-local.
     offload_endpoint: Optional[str] = None
     offload_max_local_splits: int = 16
+    # disk-resident split cache (reference split_cache/mod.rs): None
+    # disables; the dir is created on startup and scanned for leftovers
+    split_cache_dir: Optional[str] = None
+    split_cache_max_bytes: int = 10 << 30
+    split_cache_max_splits: int = 10_000
     # gRPC listener (reference: the tonic server in grpc.rs — OTLP
     # collector services + Jaeger SpanReaderPlugin over stdlib HTTP/2).
     # None = disabled; 0 = ephemeral port.
@@ -259,6 +264,8 @@ class Node:
 
     def __init__(self, config: NodeConfig,
                  storage_resolver: Optional[StorageResolver] = None):
+        from ..utils.compile_cache import enable_persistent_compile_cache
+        enable_persistent_compile_cache()
         self.config = config
         self.storage_resolver = storage_resolver or StorageResolver.default()
         if config.metastore_uri.startswith("sqlite://"):
@@ -273,10 +280,19 @@ class Node:
         self.cluster = Cluster(
             config.node_id, config.roles,
             rest_endpoint=f"{config.rest_host}:{config.rest_port}")
+        self.split_cache = None
+        if config.split_cache_dir:
+            from ..storage.split_cache import DiskSplitCache
+            self.split_cache = DiskSplitCache(
+                config.split_cache_dir, self.storage_resolver,
+                max_bytes=config.split_cache_max_bytes,
+                max_splits=config.split_cache_max_splits)
+            self.split_cache.start()
         self.searcher_context = SearcherContext(
             self.storage_resolver,
             offload_endpoint=config.offload_endpoint,
-            offload_max_local_splits=config.offload_max_local_splits)
+            offload_max_local_splits=config.offload_max_local_splits,
+            split_cache=self.split_cache)
         self.search_service = SearchService(self.searcher_context, config.node_id)
         self.index_service = IndexService(self.metastore, self.storage_resolver,
                                           config.default_index_root_uri)
@@ -378,6 +394,25 @@ class Node:
                                                           source_id)
 
     # ------------------------------------------------------------------
+    def _grpc_advertise(self) -> str:
+        """This node's gRPC endpoint for peers ("" when disabled or when
+        the cluster runs TLS — the gRPC plane is h2c)."""
+        if self.grpc_server is None or self.config.tls_enabled:
+            return ""
+        return f"{self.config.rest_host}:{self.grpc_server.port}"
+
+    def _make_peer_client(self, member: ClusterMember):
+        """Search client for one peer: the gRPC plane (binary payloads on a
+        persistent HTTP/2 connection — the reference's codegen'd tonic
+        client role) when the peer advertises it, JSON/HTTP otherwise."""
+        if member.grpc_endpoint and not self.config.tls_enabled:
+            from .grpc_server import GrpcSearchClient
+            return GrpcSearchClient(member.grpc_endpoint,
+                                    member.rest_endpoint)
+        from .http_client import HttpSearchClient
+        return HttpSearchClient(member.rest_endpoint,
+                                **self.config.client_tls_kwargs())
+
     def _on_cluster_change(self, change: ClusterChange) -> None:
         member = change.member
         if change.kind == "remove":
@@ -387,9 +422,7 @@ class Node:
         if member.node_id == self.config.node_id:
             return
         if "searcher" in member.roles and member.rest_endpoint:
-            from .http_client import HttpSearchClient
-            self.clients[member.node_id] = HttpSearchClient(
-                member.rest_endpoint, **self.config.client_tls_kwargs())
+            self.clients[member.node_id] = self._make_peer_client(member)
 
     # ------------------------------------------------------------------
     # ingest (v1-style: REST batch → immediate split, commit semantics
@@ -1148,6 +1181,9 @@ class Node:
                     node_id=info["node_id"], roles=tuple(info["roles"]),
                     rest_endpoint=substitute_wildcard_host(
                         info.get("rest_endpoint", endpoint),
+                        endpoint.rpartition(":")[0]),
+                    grpc_endpoint=substitute_wildcard_host(
+                        info.get("grpc_endpoint", ""),
                         endpoint.rpartition(":")[0])))
             except Exception:  # noqa: BLE001 - supervised worker
                 logger.exception("heartbeat to %s: bad peer response", endpoint)
@@ -1156,7 +1192,8 @@ class Node:
             payload = {"node_id": self.config.node_id,
                        "roles": list(self.advertised_roles()),
                        "rest_endpoint":
-                           f"{self.config.rest_host}:{self.config.rest_port}"}
+                           f"{self.config.rest_host}:{self.config.rest_port}",
+                       "grpc_endpoint": self._grpc_advertise()}
             peers = set(self.config.peers)
             peers.update(m.rest_endpoint for m in self.cluster.members()
                          if m.node_id != self.config.node_id and m.rest_endpoint)
@@ -1190,7 +1227,8 @@ class Node:
                 bind_port=self.config.rest_port,
                 seeds=self.config.peers,
                 interval_secs=min(heartbeat_interval_secs, 1.0),
-                cluster_id=self.config.cluster_id)
+                cluster_id=self.config.cluster_id,
+                grpc_endpoint=self._grpc_advertise())
             self._gossip.start()
         else:
             loops.append(("heartbeat", heartbeat_interval_secs,
@@ -1243,6 +1281,8 @@ class Node:
         if gossip is not None:
             gossip.stop()
             self._gossip = None
+        if self.split_cache is not None:
+            self.split_cache.stop()
 
     # ------------------------------------------------------------------
     def run_janitor(self) -> dict[str, int]:

@@ -307,10 +307,13 @@ class RestServer:
             node.cluster.upsert_heartbeat(ClusterMember(
                 node_id=payload["node_id"], roles=tuple(payload["roles"]),
                 rest_endpoint=substitute_wildcard_host(
-                    payload.get("rest_endpoint", ""), client_host)))
+                    payload.get("rest_endpoint", ""), client_host),
+                grpc_endpoint=substitute_wildcard_host(
+                    payload.get("grpc_endpoint", ""), client_host)))
             return 200, {"node_id": node.config.node_id,
                          "roles": list(node.config.roles),
-                         "rest_endpoint": f"{self.host}:{self.port}"}
+                         "rest_endpoint": f"{self.host}:{self.port}",
+                         "grpc_endpoint": node._grpc_advertise()}
 
         # --- developer / debug ----------------------------------------
         if path == "/api/v1/developer/pprof/flamegraph" and method == "GET":

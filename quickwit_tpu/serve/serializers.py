@@ -52,6 +52,21 @@ def _freeze(value: Any) -> Any:
     return tuple(value) if isinstance(value, list) else value
 
 
+def leaf_response_to_wire(response: LeafSearchResponse) -> dict[str, Any]:
+    """Like `leaf_response_to_dict` but with intermediate agg states left
+    as raw numpy — for the binary transport (`binwire.py`), which encodes
+    arrays as dtype+shape+bytes instead of JSON lists."""
+    d = leaf_response_to_dict(response)
+    d["intermediate_aggs"] = response.intermediate_aggs
+    return d
+
+
+def leaf_response_from_wire(d: dict[str, Any]) -> LeafSearchResponse:
+    response = leaf_response_from_dict({**d, "intermediate_aggs": {}})
+    response.intermediate_aggs = d.get("intermediate_aggs", {})
+    return response
+
+
 def leaf_response_to_dict(response: LeafSearchResponse) -> dict[str, Any]:
     return {
         "num_hits": response.num_hits,

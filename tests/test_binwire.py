@@ -1,0 +1,86 @@
+"""binwire codec round-trips: the binary payloads under the internal
+gRPC search RPCs (role of protobuf + postcard intermediate-agg bytes)."""
+
+import numpy as np
+import pytest
+
+from quickwit_tpu.serve.binwire import BinwireError, decode, encode
+
+
+def test_scalars_roundtrip():
+    for value in [None, True, False, 0, -1, 2**62, -(2**62), 1.5, -0.25,
+                  "", "héllo", b"", b"\x00\xff", float("inf"),
+                  float("-inf")]:
+        assert decode(encode(value)) == value
+
+
+def test_nan_roundtrip():
+    out = decode(encode(float("nan")))
+    assert out != out
+
+
+def test_nested_structures():
+    value = {"a": [1, "x", None, {"b": [True, 2.5]}],
+             "empty": {}, "list": [], "bytes": b"raw"}
+    assert decode(encode(value)) == value
+
+
+def test_numpy_arrays_roundtrip():
+    for arr in [np.arange(10, dtype=np.int64),
+                np.zeros((3, 4), dtype=np.float64),
+                np.array([], dtype=np.int32),
+                np.array([[1, 2], [3, 4]], dtype=np.uint8),
+                (np.arange(6).reshape(2, 3) * 1.5).astype(np.float32)]:
+        out = decode(encode(arr))
+        assert out.dtype == arr.dtype and out.shape == arr.shape
+        assert np.array_equal(out, arr)
+
+
+def test_non_string_dict_keys():
+    value = {(1, 2): "pair", 3.5: "float", 7: "int"}
+    out = decode(encode(value))
+    assert out == {(1, 2): "pair", 3.5: "float", 7: "int"}
+
+
+def test_agg_state_shaped_tree():
+    state = {"over_time": {"kind": "date_histogram",
+                           "counts": np.arange(100, dtype=np.int32),
+                           "metrics": {"lat": {
+                               "sum": np.ones(100),
+                               "count": np.arange(100, dtype=np.int64)}},
+                           "origin": 1_600_000_000_000_000,
+                           "interval": 86_400_000_000}}
+    out = decode(encode(state))
+    assert np.array_equal(out["over_time"]["counts"],
+                          state["over_time"]["counts"])
+    assert out["over_time"]["interval"] == 86_400_000_000
+
+
+def test_truncated_and_trailing_bytes_error():
+    good = encode({"a": 1})
+    with pytest.raises(BinwireError):
+        decode(good[:-1])
+    with pytest.raises(BinwireError):
+        decode(good + b"x")
+
+
+def test_leaf_response_wire_roundtrip():
+    from quickwit_tpu.search.models import (
+        LeafSearchResponse, PartialHit, SplitSearchError)
+    from quickwit_tpu.serve.serializers import (
+        leaf_response_from_wire, leaf_response_to_wire)
+    response = LeafSearchResponse(
+        num_hits=42,
+        partial_hits=[PartialHit(sort_value=3.5, split_id="s1", doc_id=7,
+                                 raw_sort_value=1_600_000_000)],
+        failed_splits=[SplitSearchError("s2", "boom", True)],
+        num_attempted_splits=2, num_successful_splits=1,
+        intermediate_aggs={"t": {"kind": "terms",
+                                 "counts": np.array([5, 6], np.int64)}},
+        resource_stats={"cpu_micros": 12.0})
+    out = leaf_response_from_wire(decode(encode(
+        leaf_response_to_wire(response))))
+    assert out.num_hits == 42
+    assert out.partial_hits[0].raw_sort_value == 1_600_000_000
+    assert out.failed_splits[0].split_id == "s2"
+    assert np.array_equal(out.intermediate_aggs["t"]["counts"], [5, 6])

@@ -57,3 +57,30 @@ def test_repeated_term_exact_unaffected():
     a = _term({0: [0], 1: [0, 1]})
     ids, freqs = _match([a, a], slop=0, keys=["a", "a"])
     assert ids.tolist() == [1] and freqs.tolist() == [1]
+
+
+def test_exact_vectorized_parity_random():
+    """The vectorized slop=0 path agrees with a brute-force per-doc
+    oracle on random 3-term corpora."""
+    rng = np.random.RandomState(5)
+    for trial in range(20):
+        num_docs, length, vocab = 40, 10, 6
+        toks = rng.randint(0, vocab, size=(num_docs, length))
+        phrase = [rng.randint(0, 3), rng.randint(0, 3), rng.randint(0, 3)]
+        expected = {}
+        for d in range(num_docs):
+            freq = sum(
+                1 for p in range(length - 2)
+                if toks[d, p] == phrase[0] and toks[d, p + 1] == phrase[1]
+                and toks[d, p + 2] == phrase[2])
+            if freq:
+                expected[d] = freq
+        terms = []
+        for t in phrase:
+            doc_positions = {
+                d: list(np.nonzero(toks[d] == t)[0])
+                for d in range(num_docs) if (toks[d] == t).any()}
+            terms.append(_term(doc_positions))
+        ids, freqs = _match(terms, slop=0, keys=[str(t) for t in phrase])
+        assert dict(zip(ids.tolist(), freqs.tolist())) == expected, \
+            (trial, phrase)

@@ -1,0 +1,166 @@
+"""Disk-resident split cache (storage/split_cache.py) — eviction table
+semantics, crash-leftover handling, and the reader-open wiring.
+Reference: quickwit-storage/src/split_cache/{mod,split_table}.rs."""
+
+import os
+
+import pytest
+
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.storage import RamStorage, StorageResolver
+from quickwit_tpu.storage.split_cache import (
+    DiskSplitCache, SplitTable, _HITS, _MISSES)
+
+
+# --- SplitTable --------------------------------------------------------------
+
+def test_table_lru_eviction_order():
+    table = SplitTable(max_bytes=100)
+    table.register_on_disk("a", 40)
+    table.register_on_disk("b", 40)
+    table.touch("a")  # freshen a: b becomes the LRU victim
+    evicted = table.make_room(40)
+    assert evicted == ["b"]
+    assert table.on_disk_bytes == 40
+
+
+def test_table_no_room_for_oversized_split():
+    table = SplitTable(max_bytes=100)
+    table.register_on_disk("a", 90)
+    assert table.make_room(150) is None  # can never fit
+    assert table.info("a") is not None   # nothing evicted on failure
+
+
+def test_table_count_budget():
+    table = SplitTable(max_bytes=1 << 40, max_splits=2)
+    table.register_on_disk("a", 1)
+    table.register_on_disk("b", 1)
+    evicted = table.make_room(1)
+    assert evicted == ["a"]  # oldest goes
+
+
+def test_table_best_candidate_is_most_recent():
+    table = SplitTable(max_bytes=100)
+    table.touch("x", "ram:///s")
+    table.touch("y", "ram:///s")
+    assert table.best_candidate()[0] == "y"
+    table.touch("x")
+    assert table.best_candidate()[0] == "x"
+    table.start_download("x")
+    assert table.best_candidate()[0] == "y"  # downloading excluded
+
+
+# --- DiskSplitCache ----------------------------------------------------------
+
+@pytest.fixture
+def resolver():
+    return StorageResolver.for_test()
+
+
+def _put_split(resolver, split_id: str, payload: bytes,
+               uri: str = "ram:///sc/splits"):
+    resolver.resolve(uri).put(f"{split_id}.split", payload)
+
+
+def test_report_download_hit_cycle(tmp_path, resolver):
+    _put_split(resolver, "s1", b"x" * 1000)
+    cache = DiskSplitCache(str(tmp_path), resolver, max_bytes=10_000)
+    assert cache.local_path("s1") is None           # miss
+    cache.report_split("s1", "ram:///sc/splits", 1000)
+    assert cache.download_one() == "s1"
+    path = cache.local_path("s1")                   # hit
+    assert path is not None and os.path.getsize(path) == 1000
+    assert cache.download_one() is None             # nothing left
+
+
+def test_byte_budget_evicts_lru(tmp_path, resolver):
+    for sid in ("a", "b", "c"):
+        _put_split(resolver, sid, b"y" * 600)
+    cache = DiskSplitCache(str(tmp_path), resolver, max_bytes=1500)
+    for sid in ("a", "b"):
+        cache.report_split(sid, "ram:///sc/splits")
+        assert cache.download_one() == sid
+    # freshen a, then c's download must evict b (the LRU), not a
+    assert cache.local_path("a") is not None
+    cache.report_split("c", "ram:///sc/splits")
+    assert cache.download_one() == "c"
+    assert cache.local_path("a") is not None
+    assert cache.local_path("b") is None
+    assert not os.path.exists(tmp_path / "b.split")
+    assert cache.table.on_disk_bytes == 1200
+
+
+def test_startup_adopts_splits_and_drops_temps(tmp_path, resolver):
+    (tmp_path / "old.split").write_bytes(b"z" * 100)
+    (tmp_path / "partial.split.temp").write_bytes(b"zz")
+    cache = DiskSplitCache(str(tmp_path), resolver, max_bytes=10_000)
+    assert not os.path.exists(tmp_path / "partial.split.temp")
+    assert cache.local_path("old") is not None
+    assert cache.table.on_disk_bytes == 100
+
+
+def test_startup_budget_shrink_evicts(tmp_path, resolver):
+    (tmp_path / "big.split").write_bytes(b"z" * 900)
+    (tmp_path / "small.split").write_bytes(b"z" * 100)
+    cache = DiskSplitCache(str(tmp_path), resolver, max_bytes=150)
+    # the 900-byte split cannot stay under the shrunk budget
+    assert cache.local_path("big") is None
+    assert not os.path.exists(tmp_path / "big.split")
+    assert cache.local_path("small") is not None
+
+
+def test_failed_download_drops_candidate(tmp_path, resolver):
+    cache = DiskSplitCache(str(tmp_path), resolver, max_bytes=10_000)
+    cache.report_split("ghost", "ram:///sc/splits")  # object doesn't exist
+    assert cache.download_one() is None
+    assert cache.table.info("ghost") is None         # not retried forever
+
+
+# --- reader-open wiring ------------------------------------------------------
+
+def test_searcher_context_serves_cached_split_locally(tmp_path, resolver):
+    from quickwit_tpu.index.synthetic import HDFS_MAPPER, synthetic_hdfs_split
+    from quickwit_tpu.query.ast import Term
+    from quickwit_tpu.search.leaf import leaf_search_single_split
+    from quickwit_tpu.search.models import SearchRequest, SplitIdAndFooter
+    from quickwit_tpu.search.service import SearcherContext
+
+    split_bytes = synthetic_hdfs_split(5_000, seed=2)
+    _put_split(resolver, "warm", split_bytes)
+    cache = DiskSplitCache(str(tmp_path), resolver, max_bytes=1 << 30)
+    context = SearcherContext(resolver, split_cache=cache)
+    split = SplitIdAndFooter(split_id="warm",
+                             storage_uri="ram:///sc/splits",
+                             file_len=len(split_bytes))
+
+    misses0, hits0 = _MISSES.get(), _HITS.get()
+    reader = context.reader(split)   # miss -> reported as candidate
+    assert _MISSES.get() == misses0 + 1
+    assert cache.download_one() == "warm"
+
+    context._readers.clear()         # force a re-open
+    reader = context.reader(split)   # now served from local disk
+    assert _HITS.get() == hits0 + 1
+    from quickwit_tpu.storage.local import LocalFileStorage
+    assert isinstance(reader.storage, LocalFileStorage)
+
+    request = SearchRequest(index_ids=["x"],
+                            query_ast=Term("severity_text", "ERROR"),
+                            max_hits=5)
+    response = leaf_search_single_split(request, HDFS_MAPPER, reader, "warm")
+    assert response.num_hits > 0     # the cached copy is a working split
+
+
+def test_node_config_split_cache_section(tmp_path):
+    from quickwit_tpu.config.node_config import load_node_config
+    path = tmp_path / "node.yaml"
+    path.write_text(
+        "node_id: n1\n"
+        "searcher:\n"
+        "  split_cache:\n"
+        f"    root_path: {tmp_path}/sc\n"
+        "    max_bytes: 1234\n")
+    config = load_node_config(str(path), env={})
+    assert config.split_cache_dir == f"{tmp_path}/sc"
+    assert config.split_cache_max_bytes == 1234
+    assert config.split_cache_max_splits == 10_000

@@ -1,0 +1,84 @@
+"""The bench corpora (index/synthetic.py) exercise the REAL search stack:
+format-identical splits read through SplitReader, phrase/percentile
+results checked against brute-force oracles regenerated from the same
+seed."""
+
+import numpy as np
+
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.index.reader import SplitReader
+from quickwit_tpu.index.synthetic import (
+    _SO_TOKENS_PER_DOC, _SO_VOCAB_SIZE, OTEL_BENCH_MAPPER, SO_MAPPER,
+    synthetic_otel_split, synthetic_stackoverflow_split)
+from quickwit_tpu.query.ast import FullText, MatchAll
+from quickwit_tpu.search.leaf import leaf_search_single_split
+from quickwit_tpu.search.models import SearchRequest
+from quickwit_tpu.storage.ram import RamStorage
+
+
+def _reader(blob: bytes) -> SplitReader:
+    storage = RamStorage(Uri.parse("ram:///synth-test"))
+    storage.put("x.split", blob)
+    return SplitReader(storage, "x.split")
+
+
+def _so_tokens(num_docs: int, seed: int) -> np.ndarray:
+    """Regenerate the token matrix the split was built from (same RNG
+    consumption order as synthetic_stackoverflow_split)."""
+    rng = np.random.RandomState(seed)
+    np.sort(rng.randint(0, 90 * 86400, size=num_docs))  # the ts draw
+    draws = rng.zipf(1.4, size=num_docs * _SO_TOKENS_PER_DOC) - 1
+    return np.minimum(draws, _SO_VOCAB_SIZE - 1).reshape(
+        num_docs, _SO_TOKENS_PER_DOC)
+
+
+def test_stackoverflow_phrase_matches_bruteforce():
+    num_docs, seed = 30_000, 3
+    reader = _reader(synthetic_stackoverflow_split(num_docs, seed=seed))
+    toks = _so_tokens(num_docs, seed)
+    t1, t2 = 10, 11
+    expected = int(((toks[:, :-1] == t1) & (toks[:, 1:] == t2))
+                   .any(axis=1).sum())
+    request = SearchRequest(
+        index_ids=["so"], max_hits=20,
+        query_ast=FullText("body", f"t{t1:04d} t{t2:04d}", mode="phrase"))
+    resp = leaf_search_single_split(request, SO_MAPPER, reader, "s0")
+    assert resp.num_hits == expected > 0
+    assert len(resp.partial_hits) == min(20, expected)
+    assert resp.partial_hits[0].sort_value > 0  # BM25-scored
+
+
+def test_stackoverflow_single_term_df():
+    num_docs, seed = 20_000, 9
+    reader = _reader(synthetic_stackoverflow_split(num_docs, seed=seed))
+    toks = _so_tokens(num_docs, seed)
+    term = 4
+    expected = int((toks == term).any(axis=1).sum())
+    request = SearchRequest(
+        index_ids=["so"], max_hits=5,
+        query_ast=FullText("body", f"t{term:04d}", mode="or"))
+    resp = leaf_search_single_split(request, SO_MAPPER, reader, "s0")
+    assert resp.num_hits == expected
+
+
+def test_otel_split_percentiles_median():
+    num_docs = 4096
+    reader = _reader(synthetic_otel_split(num_docs, seed=1))
+    request = SearchRequest(
+        index_ids=["otel"], query_ast=MatchAll(), max_hits=0,
+        aggs={"lat": {"percentiles": {"field": "span_duration_micros",
+                                      "percents": [50.0]}}})
+    resp = leaf_search_single_split(request, OTEL_BENCH_MAPPER, reader, "s0")
+    assert resp.num_hits == num_docs
+    assert "lat" in resp.intermediate_aggs
+    # sketch median vs the exact column median: log-space sketch buckets
+    # guarantee small relative error
+    durations = reader.column_values("span_duration_micros")[0][:num_docs]
+    exact = float(np.median(durations))
+    from quickwit_tpu.search.collector import (
+        IncrementalCollector, finalize_aggregations)
+    collector = IncrementalCollector(max_hits=0)
+    collector.add_leaf_response(resp)
+    merged = finalize_aggregations(collector.aggregation_states())
+    got = merged["lat"]["values"]["50"]
+    assert abs(got - exact) / exact < 0.05
