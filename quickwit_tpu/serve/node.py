@@ -298,6 +298,9 @@ class Node:
                                           config.default_index_root_uri)
         self.clients: dict[str, Any] = {
             config.node_id: LocalSearchClient(self.search_service)}
+        # node_id -> (grpc_endpoint, rest_endpoint) the client was built
+        # for, so role-only membership updates don't churn live sockets
+        self._client_endpoints: dict[str, tuple] = {}
         self._transform_cache: dict[tuple, Any] = {}
         # cached external-source clients (kafka connections survive passes)
         self._external_sources: dict[tuple, Any] = {}
@@ -417,12 +420,32 @@ class Node:
         member = change.member
         if change.kind == "remove":
             if member.node_id != self.config.node_id:
-                self.clients.pop(member.node_id, None)
+                self._close_client(self.clients.pop(member.node_id, None))
+                self._client_endpoints.pop(member.node_id, None)
             return
         if member.node_id == self.config.node_id:
             return
         if "searcher" in member.roles and member.rest_endpoint:
+            # replace the client only when the peer's endpoints changed (a
+            # rejoin under new ports): closing a live client mid-flight
+            # fails in-flight RPCs and trips the circuit breaker, so
+            # role-only updates must keep the existing connection
+            endpoints = (member.grpc_endpoint, member.rest_endpoint)
+            if self._client_endpoints.get(member.node_id) == endpoints \
+                    and member.node_id in self.clients:
+                return
+            self._close_client(self.clients.get(member.node_id))
+            self._client_endpoints[member.node_id] = endpoints
             self.clients[member.node_id] = self._make_peer_client(member)
+
+    @staticmethod
+    def _close_client(client) -> None:
+        close = getattr(client, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:  # noqa: BLE001 - best-effort cleanup
+                pass
 
     # ------------------------------------------------------------------
     # ingest (v1-style: REST batch → immediate split, commit semantics

@@ -303,14 +303,18 @@ class OtelService:
         # pool geometrically until `limit` matches or the index runs dry
         # (the cache is request-scoped — passed down, never instance state)
         cache = {} if span_cache is None else span_cache
-        size = limit * 5 + 1
+        # hard cap: neither a huge client `limit` nor a never-matching tag
+        # may widen the terms agg without bound (device allocation) —
+        # return whatever matched within the cap instead
+        max_size = 10_000
+        size = min(limit * 5 + 1, max_size)
         while True:
             trace_ids, exhausted = top_trace_ids(size)
             matches = [t for t in trace_ids
                        if self._trace_matches_tags(t, tags, cache)]
-            if len(matches) >= limit or exhausted:
+            if len(matches) >= limit or exhausted or size >= max_size:
                 return matches[:limit]
-            size *= 4
+            size = min(size * 4, max_size)
 
     def find_traces_with_spans(self, **kwargs) -> "list[tuple[str, list]]":
         """find_traces + the span docs of each match, fetching each trace's

@@ -185,8 +185,8 @@ class DiskSplitCache:
     # -- read path ----------------------------------------------------------
     def local_path(self, split_id: str) -> Optional[str]:
         """Local file path when the split is cached (counts a hit and
-        freshens its eviction rank); None otherwise (counts a miss and
-        registers the split as a download candidate)."""
+        freshens its eviction rank); None otherwise (counts a miss —
+        candidate registration happens in the caller via report_split)."""
         with self._lock:
             info = self.table.info(split_id)
             if info is not None and info["status"] == ON_DISK:
@@ -231,16 +231,31 @@ class DiskSplitCache:
                 # cannot fit without evicting fresher data: drop candidacy
                 self.table.forget(split_id)
                 return None
-            self.table.register_on_disk(split_id, len(payload), storage_uri)
         self._delete_files(evicted)
-        temp = os.path.join(self.root_path, f"{split_id}.split.temp")
-        final = os.path.join(self.root_path, f"{split_id}.split")
-        with open(temp, "wb") as fh:
-            fh.write(payload)
-        os.replace(temp, final)
-        _DOWNLOADS.inc()
         if evicted:
             _EVICTIONS.inc(len(evicted))
+        # Temp-write + rename must COMPLETE before the table claims the
+        # split is on disk: a concurrent local_path() must never hand out
+        # a path to a file that does not exist yet, and a failed write
+        # (disk full) must not leave a permanent phantom entry.
+        temp = os.path.join(self.root_path, f"{split_id}.split.temp")
+        final = os.path.join(self.root_path, f"{split_id}.split")
+        try:
+            with open(temp, "wb") as fh:
+                fh.write(payload)
+            os.replace(temp, final)
+        except OSError as exc:
+            logger.warning("split cache write %s failed: %s", split_id, exc)
+            with self._lock:
+                self.table.forget(split_id)
+            try:
+                os.remove(temp)
+            except OSError:
+                pass
+            return None
+        with self._lock:
+            self.table.register_on_disk(split_id, len(payload), storage_uri)
+        _DOWNLOADS.inc()
         return split_id
 
     def _delete_files(self, split_ids: list[str]) -> None:

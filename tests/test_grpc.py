@@ -38,6 +38,10 @@ def _export_request(service: str, spans: list[bytes]) -> bytes:
     return pb_msg(1, resource_spans)
 
 
+TRACE_A = "0102030405060708090a0b0c0d0e0f10"
+TRACE_B = "1112131415161718191a1b1c1d1e1f20"
+
+
 @pytest.fixture(scope="module")
 def grpc():
     node = Node(NodeConfig(node_id="grpc-node", rest_port=0, grpc_port=0,
@@ -47,18 +51,8 @@ def grpc():
     server = RestServer(node, host="127.0.0.1", port=0)
     server.start()
     channel = GrpcChannel("127.0.0.1", node.grpc_server.port)
-    yield node, channel
-    channel.close()
-    node.grpc_server.stop()
-    server.stop()
-
-
-TRACE_A = "0102030405060708090a0b0c0d0e0f10"
-TRACE_B = "1112131415161718191a1b1c1d1e1f20"
-
-
-def test_otlp_grpc_trace_export(grpc):
-    node, channel = grpc
+    # seed the spans every reader test depends on HERE, so each test
+    # passes standalone instead of relying on file execution order
     request = _export_request("frontend", [
         _otlp_span(TRACE_A, "0102030405060708", "GET /", 1_700_000_000,
                    5000),
@@ -68,15 +62,23 @@ def test_otlp_grpc_trace_export(grpc):
         _otlp_span(TRACE_B, "2102030405060708", "query", 1_700_000_002,
                    15000),
     ])
-    messages, status, message = channel.call(
+    export_result = channel.call(
         "/opentelemetry.proto.collector.trace.v1.TraceService/Export",
         request)
+    yield node, channel, export_result
+    channel.close()
+    node.grpc_server.stop()
+    server.stop()
+
+
+def test_otlp_grpc_trace_export(grpc):
+    _node, _channel, (messages, status, message) = grpc
     assert status == 0, message
     assert messages == [b""]  # empty ExportTraceServiceResponse
 
 
 def test_jaeger_grpc_get_services(grpc):
-    node, channel = grpc
+    node, channel, _ = grpc
     messages, status, message = channel.call(
         "/jaeger.storage.v1.SpanReaderPlugin/GetServices", b"")
     assert status == 0, message
@@ -85,7 +87,7 @@ def test_jaeger_grpc_get_services(grpc):
 
 
 def test_jaeger_grpc_get_operations(grpc):
-    node, channel = grpc
+    node, channel, _ = grpc
     messages, status, _ = channel.call(
         "/jaeger.storage.v1.SpanReaderPlugin/GetOperations",
         pb_str(1, "frontend"))
@@ -95,7 +97,7 @@ def test_jaeger_grpc_get_operations(grpc):
 
 
 def test_jaeger_grpc_find_trace_ids(grpc):
-    node, channel = grpc
+    node, channel, _ = grpc
     query = pb_msg(1, pb_str(1, "backend"))
     messages, status, _ = channel.call(
         "/jaeger.storage.v1.SpanReaderPlugin/FindTraceIDs", query)
@@ -105,7 +107,7 @@ def test_jaeger_grpc_find_trace_ids(grpc):
 
 
 def test_jaeger_grpc_find_traces_streams_spans(grpc):
-    node, channel = grpc
+    node, channel, _ = grpc
     query = pb_msg(1, pb_str(1, "frontend"))
     messages, status, _ = channel.call(
         "/jaeger.storage.v1.SpanReaderPlugin/FindTraces", query)
@@ -122,7 +124,7 @@ def test_jaeger_grpc_find_traces_streams_spans(grpc):
 
 
 def test_jaeger_grpc_get_trace_not_found(grpc):
-    node, channel = grpc
+    node, channel, _ = grpc
     messages, status, message = channel.call(
         "/jaeger.storage.v1.SpanReaderPlugin/GetTrace",
         pb_bytes(1, b"\xde\xad\xbe\xef"))
@@ -131,7 +133,7 @@ def test_jaeger_grpc_get_trace_not_found(grpc):
 
 
 def test_unknown_method_unimplemented(grpc):
-    node, channel = grpc
+    node, channel, _ = grpc
     _messages, status, message = channel.call("/no.such.Service/Nope", b"")
     assert status == 12
     assert "unknown method" in message
@@ -152,7 +154,7 @@ def _tagged_span(trace_id: str, span_id: str, name: str, start_s: int,
 
 
 def test_jaeger_grpc_find_traces_tag_and_duration_max_filters(grpc):
-    node, channel = grpc
+    node, channel, _ = grpc
     request = _export_request("tagged", [
         _tagged_span(TRACE_C, "3102030405060708", "slow-err", 1_700_000_010,
                      50_000, {"env": "prod"}, error=True),
