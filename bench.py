@@ -39,11 +39,21 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 NUM_DOCS = int(os.environ.get("BENCH_NUM_DOCS", 10_000_000))
 SO_DOCS = int(os.environ.get("BENCH_SO_DOCS", 5_000_000))
-OTEL_SPLITS = int(os.environ.get("BENCH_OTEL_SPLITS", 1000))
-OTEL_DOCS = int(os.environ.get("BENCH_OTEL_DOCS", 4096))
+# config #5: many-split fused dispatch. 64 splits x 512k docs (33.5M docs
+# total) per the round-4 directive — real split sizes, not 4096-doc
+# micro-splits; all splits still execute as ONE vmapped XLA program.
+OTEL_SPLITS = int(os.environ.get("BENCH_OTEL_SPLITS", 64))
+OTEL_DOCS = int(os.environ.get("BENCH_OTEL_DOCS", 524_288))
 ITERATIONS = int(os.environ.get("BENCH_ITERS", 20))
 PIPELINE_DEPTH = int(os.environ.get("BENCH_PIPELINE_DEPTH", 8))
 PIPELINE_QUERIES = int(os.environ.get("BENCH_PIPELINE_QUERIES", 48))
+# concurrent queries per dispatch on the pipelined path (the serving
+# QueryBatcher's shape, search/batcher.py): measured on the real chip,
+# every dispatch round through the axon tunnel costs a fixed ~60-65 ms
+# that pipelining depth cannot amortize (tools/profile_tunnel.py), while
+# batched queries inside one dispatch run at device speed — the same
+# reason the reference batches leaf requests per node (leaf.rs:81)
+PIPELINE_BATCH = int(os.environ.get("BENCH_PIPELINE_BATCH", 16))
 DEV_DEPTHS = (8, 40)
 DEVICE_TIMEOUT_SECS = int(os.environ.get("BENCH_DEVICE_TIMEOUT", 180))
 PROBE_DEADLINE_SECS = int(os.environ.get("BENCH_PROBE_DEADLINE", 60))
@@ -129,7 +139,8 @@ def _workloads():
     """name → (request, mapper, reader_thunk). Configs cite
     BASELINE.json.configs 1:1; `flagship` is the round-2-comparable
     north-star workload (term + top-10 + date_histogram + terms)."""
-    from quickwit_tpu.index.synthetic import HDFS_MAPPER, SO_MAPPER
+    from quickwit_tpu.index.synthetic import (
+        HDFS_MAPPER, SO_MAPPER, body_term, so_term)
     from quickwit_tpu.query.ast import Bool, FullText, Range, RangeBound, Term
     from quickwit_tpu.search.models import SearchRequest
 
@@ -144,7 +155,7 @@ def _workloads():
             index_ids=["hdfs-logs"],
             query_ast=Bool(
                 must=(Term("severity_text", "ERROR"),),
-                should=(Term("body", "term0003"), Term("body", "term0007")),
+                should=(Term("body", body_term(3)), Term("body", body_term(7))),
                 filter=(Range("timestamp",
                               lower=RangeBound(t0_us + day_us, True),
                               upper=RangeBound(t0_us + 4 * day_us, False)),),
@@ -160,7 +171,8 @@ def _workloads():
         ), HDFS_MAPPER, lambda: _hdfs_reader(NUM_DOCS)),
         "c4_phrase_bm25_top20": (SearchRequest(
             index_ids=["stackoverflow"],
-            query_ast=FullText("body", "t0010 t0011", mode="phrase"),
+            query_ast=FullText("body", f"{so_term(10)} {so_term(11)}",
+                               mode="phrase"),
             max_hits=20,
         ), SO_MAPPER, lambda: _so_reader(SO_DOCS)),
         "flagship": (SearchRequest(
@@ -201,6 +213,46 @@ def _percentile(samples, q) -> float:
     return samples[min(len(samples) - 1, int(len(samples) * q))]
 
 
+def _measure_batched_throughput(plan, k, device_arrays, num_queries: int,
+                                batch: int) -> dict:
+    """Per-query latency with `num_queries` concurrent queries executed as
+    multi-query dispatches of width `batch` (the serving QueryBatcher's
+    shape), dispatches pipelined. Returns the breakdown the round-3/4
+    verdicts asked for: where each millisecond goes."""
+    from quickwit_tpu.search import executor as ex
+    nbatches = max(1, num_queries // batch)
+    scalar_sets = [plan.scalars] * batch
+    # warm: the vmapped program compiles once per (signature, batch)
+    t0 = time.monotonic()
+    ex.readback_plan_multi(
+        ex.dispatch_plan_multi(plan, k, device_arrays, scalar_sets))
+    warm_batch_s = time.monotonic() - t0
+
+    # cache_scalars=False: every measured batch pays its scalar H2D upload,
+    # as a mixed workload of DISTINCT concurrent queries would — the
+    # content cache must not flatter the headline number
+    t_all0 = time.monotonic()
+    t0 = time.monotonic()
+    dispatched = [ex.dispatch_plan_multi(plan, k, device_arrays, scalar_sets,
+                                         cache_scalars=False)
+                  for _ in range(nbatches)]
+    dispatch_ms = (time.monotonic() - t0) * 1000
+    t0 = time.monotonic()
+    for d in dispatched:
+        ex.readback_plan_multi(d)
+    readback_ms = (time.monotonic() - t0) * 1000
+    total = nbatches * batch
+    return {
+        "pipe_ms": round((time.monotonic() - t_all0) * 1000 / total, 2),
+        "pipe_batch": batch,
+        "pipe_breakdown": {
+            "dispatch_host_ms": round(dispatch_ms / total, 3),
+            "readback_wait_ms": round(readback_ms / total, 3),
+            "warm_batch_s": round(warm_batch_s, 1),
+        },
+    }
+
+
 def _measure_single_split(request, mapper, reader, iters: int,
                           full: bool = True) -> dict:
     """e2e / pipelined / device-time measurements for one-split configs."""
@@ -214,6 +266,13 @@ def _measure_single_split(request, mapper, reader, iters: int,
     resp = leaf_search_single_split(request, mapper, reader, "bench")
     warm_s = time.monotonic() - t0
     stats = {"num_hits": int(resp.num_hits), "warm_s": round(warm_s, 1)}
+    raw_est = (reader.footer.extra or {}).get("raw_json_bytes_est")
+    if raw_est:
+        # storage blowup of the TPU-padded split layout vs the ndjson a
+        # user would have ingested (round-4 directive #5)
+        stats["split_bytes"] = int(reader.file_len)
+        stats["raw_json_bytes_est"] = int(raw_est)
+        stats["split_vs_raw"] = round(reader.file_len / raw_est, 2)
 
     lat = []
     for _ in range(iters):
@@ -222,15 +281,26 @@ def _measure_single_split(request, mapper, reader, iters: int,
         lat.append(time.monotonic() - t0)
     stats["e2e_ms"] = round(_percentile(lat, 0.5) * 1000, 2)
     stats["e2e_p90_ms"] = round(_percentile(lat, 0.9) * 1000, 2)
-    if not full:  # CPU comparison child: e2e p50 is the whole story
-        return stats
 
-    # pipelined: D queries in flight, async host copies overlap the RTTs
     plan, device_arrays, _ = prepare_single_split(
         request, mapper, reader, "bench")
     k = request.start_offset + request.max_hits
+    if not full:
+        # CPU comparison child: e2e p50 + the SAME batched-throughput path
+        # the TPU pipe number uses, so the pipelined ratio denominator is
+        # the CPU's own best concurrent-query number, not its 1-shot one
+        stats.update(_measure_batched_throughput(
+            plan, k, device_arrays, PIPELINE_QUERIES, PIPELINE_BATCH))
+        return stats
+
     stats["hbm_bytes"] = _estimate_bytes(plan)
 
+    # pipelined throughput: concurrent queries ride multi-query dispatches
+    stats.update(_measure_batched_throughput(
+        plan, k, device_arrays, PIPELINE_QUERIES, PIPELINE_BATCH))
+
+    # legacy one-query-per-dispatch pipelining, for the record: bounded by
+    # the per-dispatch tunnel round (tools/profile_tunnel.py)
     def _async_copy(tree):
         for leaf in jax.tree_util.tree_leaves(tree):
             if hasattr(leaf, "copy_to_host_async"):
@@ -245,7 +315,7 @@ def _measure_single_split(request, mapper, reader, iters: int,
             ex.readback_plan_result(inflight.pop(0))
     while inflight:
         ex.readback_plan_result(inflight.pop(0))
-    stats["pipe_ms"] = round(
+    stats["pipe_solo_ms"] = round(
         (time.monotonic() - t0) * 1000 / PIPELINE_QUERIES, 2)
 
     # device time: fori_loop N-deep inside one dispatch, two depths
@@ -312,7 +382,7 @@ def _measure_batch_otel(iters: int, full: bool = True) -> dict:
     resp = fanout.execute_batch(batch, request)
     warm_s = time.monotonic() - t0
     stats = {"num_hits": int(resp.num_hits), "warm_s": round(warm_s, 1),
-             "n_splits": OTEL_SPLITS}
+             "n_splits": OTEL_SPLITS, "docs_per_split": OTEL_DOCS}
 
     lat = []
     for _ in range(iters):
@@ -368,10 +438,10 @@ def _run_all(iters: int, with_device_loops: bool = True) -> dict:
         stats["gen_s"] = round(gen_s, 1)
         results[name] = stats
         print(f"# {name}: {json.dumps(stats)}", file=sys.stderr)
-    results["c5_otel_percentiles_1k"] = _measure_batch_otel(
+    results["c5_otel_percentiles"] = _measure_batch_otel(
         max(3, iters // 3), full=with_device_loops)
-    print(f"# c5_otel_percentiles_1k: "
-          f"{json.dumps(results['c5_otel_percentiles_1k'])}", file=sys.stderr)
+    print(f"# c5_otel_percentiles: "
+          f"{json.dumps(results['c5_otel_percentiles'])}", file=sys.stderr)
     return results
 
 
@@ -408,15 +478,29 @@ def main() -> None:
     print(f"# compile cache: {cache_dir}", file=sys.stderr)
 
     if child_mode:
-        # CPU comparison child: e2e p50 per config only
+        # CPU comparison child: e2e p50 + batched throughput per config
         results = _run_all(ITERATIONS, with_device_loops=False)
-        print(json.dumps({name: s["e2e_ms"] for name, s in results.items()}))
+        print(json.dumps({
+            name: {"e2e_ms": s["e2e_ms"], "pipe_ms": s.get("pipe_ms")}
+            for name, s in results.items()}))
         return
 
     results = _run_all(ITERATIONS)
 
     import jax
+    import numpy as np
     device_kind = jax.devices()[0].device_kind
+
+    # transport round-trip: fresh 4-byte H2D + blocking D2H. Under the
+    # axon tunnel this is ~60 ms and it floors every 1-shot e2e number
+    # (two serialized rounds: dispatch + readback); on a PCIe/ICI-attached
+    # TPU host it is microseconds. Recorded so the e2e rows can be read
+    # against the transport they were measured over.
+    t0 = time.monotonic()
+    probes = 3
+    for i in range(probes):
+        jax.device_get(jax.device_put(np.int32(i)))
+    rtt_ms = (time.monotonic() - t0) * 1000 / probes / 2
     peak = _PEAK_HBM.get(device_kind)
     for stats in results.values():
         if peak and "hbm_gbps" in stats:
@@ -428,19 +512,33 @@ def main() -> None:
         cpu = _cpu_reference()
     if cpu:
         for name, stats in results.items():
-            if name in cpu:
-                stats["cpu_ms"] = cpu[name]
-                stats["vs_cpu_e2e"] = round(cpu[name] / stats["e2e_ms"], 2)
-                stats["vs_cpu_pipelined"] = round(
-                    cpu[name] / stats["pipe_ms"], 2) \
-                    if "pipe_ms" in stats else None
-                stats["vs_cpu_device"] = round(
-                    cpu[name] / stats["dev_ms"], 1) \
-                    if "dev_ms" in stats else None
+            if name not in cpu:
+                continue
+            entry = cpu[name]
+            if not isinstance(entry, dict):  # legacy child format
+                entry = {"e2e_ms": entry, "pipe_ms": None}
+            cpu_e2e = entry["e2e_ms"]
+            # the pipelined denominator is the CPU's own BEST concurrent-
+            # query number (it gets the same multi-query batched path),
+            # never the inflated 1-shot latency
+            cpu_best = min(x for x in (cpu_e2e, entry.get("pipe_ms"))
+                           if x is not None)
+            stats["cpu_ms"] = cpu_e2e
+            if entry.get("pipe_ms") is not None:
+                stats["cpu_pipe_ms"] = entry["pipe_ms"]
+            stats["vs_cpu_e2e"] = round(cpu_e2e / stats["e2e_ms"], 2)
+            stats["vs_cpu_pipelined"] = round(
+                cpu_best / stats["pipe_ms"], 2) \
+                if "pipe_ms" in stats else None
+            stats["vs_cpu_device"] = round(
+                cpu_best / stats["dev_ms"], 1) \
+                if "dev_ms" in stats else None
 
     details = {
         "platform": platform, "device_kind": device_kind,
         "peak_hbm_gbps": (peak / 1e9 if peak else None),
+        "transport_rtt_ms": round(rtt_ms, 1),
+        "pipeline_batch": PIPELINE_BATCH,
         "num_docs": NUM_DOCS, "configs": results,
     }
     details_path = os.path.join(
@@ -453,11 +551,14 @@ def main() -> None:
     note = os.environ.get("BENCH_PLATFORM_NOTE", platform)
     if head.get("cpu_ms"):
         vs = head["vs_cpu_pipelined"]
-        note = (f"{note}, dev p50 {head['dev_ms']}ms "
+        note = (f"{note}, {PIPELINE_BATCH} concurrent queries/dispatch, "
+                f"dev p50 {head['dev_ms']}ms "
                 f"({head.get('bw_util', 0) * 100:.0f}% HBM bw, "
                 f"{head['vs_cpu_device']}x vs cpu-device), "
-                f"e2e 1-shot {head['e2e_ms']}ms over tunnel, "
-                f"measured own-cpu p50 {head['cpu_ms']:.0f}ms")
+                f"e2e 1-shot {head['e2e_ms']}ms incl 2x{rtt_ms:.0f}ms "
+                f"tunnel rtt, cpu denominator min(own-cpu 1-shot "
+                f"{head['cpu_ms']:.0f}ms, own-cpu batched "
+                f"{head.get('cpu_pipe_ms', head['cpu_ms']):.0f}ms)")
         value = head["pipe_ms"]
     else:
         vs = round(1000.0 / head["e2e_ms"], 2)

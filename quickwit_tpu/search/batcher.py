@@ -1,0 +1,133 @@
+"""Cross-query dispatch coalescing.
+
+Concurrent queries that lower to the SAME plan structure (signature) and
+the SAME device arrays on one split differ only in their traced scalars
+(term idf, range bounds, agg origins, markers). The batcher executes such
+queries as ONE vmapped XLA program via `executor.dispatch_plan_multi` —
+one dispatch round + one packed readback for the whole batch.
+
+Why this exists (measured; tools/profile_tunnel.py): each dispatch round
+through a remote-TPU transport costs a fixed wall-clock overhead that
+pipelining depth cannot amortize, while work inside one dispatch runs at
+device speed. Batching concurrent requests per dispatch is also the
+reference's own shape — leaf requests are batched per node
+(`quickwit-search/src/leaf.rs:81` greedy_batch_split).
+
+Batching is convoy-style: dispatches for one key are serialized by a
+per-key lock, so queries arriving while a dispatch is in flight pile up
+and ride the next dispatch together. A lone query pays ZERO added
+latency — the lock is free and it dispatches immediately."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from . import executor
+
+
+class _Pending:
+    __slots__ = ("scalars", "event", "result", "error")
+
+    def __init__(self, scalars):
+        self.scalars = scalars
+        self.event = threading.Event()
+        self.result: Any = None
+        self.error: Exception | None = None
+
+
+class QueryBatcher:
+    """Groups concurrent same-(signature, arrays, split) queries into one
+    multi-query dispatch. Thread-safe; every caller blocks only for its
+    own result."""
+
+    def __init__(self, max_batch: int = 16):
+        self.max_batch = max_batch
+        self._lock = threading.Lock()
+        self._queues: dict[tuple, list[_Pending]] = {}
+        # per-key dispatch serialization, refcounted so the dict cannot
+        # grow without bound across query shapes / reader reopens
+        self._dispatch_locks: dict[tuple, list] = {}  # key -> [lock, refs]
+        # observability: dispatches vs queries served (batching efficiency)
+        self.num_dispatches = 0
+        self.num_queries = 0
+
+    def execute(self, plan, k: int, device_arrays, split_key) -> dict[str, Any]:
+        """Run one query, possibly riding a shared dispatch. `split_key`
+        must uniquely identify the split (reader identity); the key also
+        carries the plan's array cache keys, so queries sharing a dispatch
+        are guaranteed to read the very same device arrays (two terms of
+        equal posting shape lower to the same signature but DIFFERENT
+        arrays — they must not share)."""
+        key = (plan.signature(k), tuple(plan.array_keys), split_key)
+        me = _Pending(plan.scalars)
+        my_queue = None
+        with self._lock:
+            self.num_queries += 1
+            queue = self._queues.get(key)
+            if queue is not None and len(queue) < self.max_batch:
+                queue.append(me)          # follower: the leader serves us
+            else:
+                # new (or full) queue: lead a FRESH list. A full previous
+                # list stays owned by its own leader (it is popped by
+                # identity below), so its followers are never orphaned.
+                my_queue = [me]
+                self._queues[key] = my_queue
+                entry = self._dispatch_locks.setdefault(
+                    key, [threading.Lock(), 0])
+                entry[1] += 1
+                dispatch_lock = entry[0]
+        if my_queue is None:
+            me.event.wait()
+            if me.error is not None:
+                raise _waiter_error(me.error)
+            return me.result
+        # serialize dispatches per key: while a previous dispatch is in
+        # flight this blocks, and our queue keeps accumulating followers —
+        # the batching window emerges from real dispatch latency instead of
+        # a configured sleep
+        try:
+            with dispatch_lock:
+                with self._lock:
+                    if self._queues.get(key) is my_queue:
+                        del self._queues[key]
+                    batch = my_queue
+                    self.num_dispatches += 1
+                try:
+                    if len(batch) == 1:
+                        results = [executor.execute_plan(plan, k,
+                                                         device_arrays)]
+                    else:
+                        results = executor.readback_plan_multi(
+                            executor.dispatch_plan_multi(
+                                plan, k, device_arrays,
+                                [p.scalars for p in batch]))
+                    for pending, result in zip(batch, results):
+                        pending.result = result
+                        pending.event.set()
+                except Exception as exc:  # noqa: BLE001 - fan to waiters
+                    for pending in batch:
+                        pending.error = exc
+                        pending.event.set()
+        finally:
+            with self._lock:
+                entry = self._dispatch_locks.get(key)
+                if entry is not None:
+                    entry[1] -= 1
+                    if entry[1] <= 0:
+                        del self._dispatch_locks[key]
+        if me.error is not None:
+            raise me.error
+        return me.result
+
+
+def _waiter_error(err: Exception) -> Exception:
+    """A fresh per-waiter exception chained to the shared dispatch error:
+    many waiter threads re-raising the SAME instance would race on its
+    __traceback__ and leak handler-side mutations across queries."""
+    try:
+        copy = type(err)(*err.args)
+    except Exception:  # noqa: BLE001 - exotic constructor signatures
+        copy = RuntimeError(f"batched dispatch failed: {err!r}")
+    copy.__cause__ = err
+    return copy

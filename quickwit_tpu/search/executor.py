@@ -677,6 +677,135 @@ def _unpack_result(packed: np.ndarray, treedef, spec):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+# --- multi-query dispatch ----------------------------------------------------
+#
+# B queries that share one plan STRUCTURE (same signature: shapes, agg tree,
+# sort spec) and one split's device arrays execute as ONE XLA program — vmap
+# over the stacked per-query scalars with the arrays broadcast — and return
+# as ONE packed [B, total] readback. Measured on the real chip (see
+# tools/profile_tunnel.py): every dispatch round through the axon tunnel
+# costs a fixed ~60-65 ms wall regardless of program content and pipelining
+# depth cannot amortize it, while work INSIDE one dispatch runs at full
+# device speed (~2 ms/query). Batching concurrent queries per dispatch is
+# also the reference's own shape: leaf requests are batched per node
+# (`quickwit-search/src/leaf.rs:81` greedy_batch_split).
+
+_MULTI_CACHE: dict[tuple, tuple] = {}
+_MULTI_SCALAR_CACHE: dict[tuple, Any] = {}
+_MULTI_SCALAR_CACHE_CAP = 128
+
+
+def _batch_bucket(n: int) -> int:
+    """Round a convoy size up to the next power of two: arbitrary convoy
+    sizes (2..max_batch) would each compile their own vmapped program —
+    seconds of stall per new size over a remote transport. Bucketing
+    bounds the distinct programs per signature to ~log2(max_batch);
+    surplus lanes repeat the last query and are dropped at readback."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+def _get_packed_multi_executor(plan: LoweredPlan, k: int, batch: int,
+                               device_arrays):
+    key = (plan.signature(k), batch)
+    cached = _MULTI_CACHE.get(key)
+    if cached is None:
+        fn = _build(plan, k)
+        # eval_shape only consumes shapes/dtypes — numpy example scalars
+        # avoid touching the device (a device upload here would cost the
+        # very transfer round this path exists to avoid)
+        example_args = (tuple(device_arrays),
+                        tuple(np.asarray(s) for s in plan.scalars),
+                        np.int32(plan.num_docs))
+        shaped = jax.eval_shape(fn, *example_args)
+        treedef = jax.tree_util.tree_structure(shaped)
+        spec = [(leaf.shape, leaf.dtype)
+                for leaf in jax.tree_util.tree_leaves(shaped)]
+
+        def multi(arrays, scal_b, nd_b):
+            out = jax.vmap(lambda s, n: fn(arrays, s, n),
+                           in_axes=(0, 0))(scal_b, nd_b)
+            flat = [leaf.reshape(leaf.shape[0], -1).astype(jnp.float64)
+                    for leaf in jax.tree_util.tree_leaves(out)]
+            return (jnp.concatenate(flat, axis=1) if flat
+                    else jnp.zeros((batch, 0)))
+
+        cached = (jax.jit(multi), treedef, spec)
+        _MULTI_CACHE[key] = cached
+    return cached
+
+
+def _device_multi_scalars(plan: LoweredPlan, scalar_sets, use_cache=True):
+    """Stacked per-slot [B] scalar arrays + per-lane num_docs, one batched
+    H2D transfer, content-cached (repeated batches skip the upload RTT).
+    `use_cache=False` forces the upload — the bench uses it so measured
+    numbers include the per-batch transfer a mixed workload pays."""
+    batch = len(scalar_sets)
+    key = None
+    if use_cache:
+        key = (plan.num_docs, batch,
+               tuple(tuple((s.dtype.str, s.item())
+                           for s in map(np.asarray, qs))
+                     for qs in scalar_sets))
+        cached = _MULTI_SCALAR_CACHE.get(key)
+        if cached is not None:
+            return cached
+    stacked = [np.stack([np.asarray(qs[slot]) for qs in scalar_sets])
+               for slot in range(len(plan.scalars))]
+    nd_b = np.full((batch,), plan.num_docs, np.int32)
+    moved = jax.device_put(stacked + [nd_b])
+    cached = (tuple(moved[:-1]), moved[-1])
+    if key is not None:
+        if len(_MULTI_SCALAR_CACHE) >= _MULTI_SCALAR_CACHE_CAP:
+            _MULTI_SCALAR_CACHE.pop(next(iter(_MULTI_SCALAR_CACHE)))
+        _MULTI_SCALAR_CACHE[key] = cached
+    return cached
+
+
+def dispatch_plan_multi(plan: LoweredPlan, k: int,
+                        device_arrays: list[jax.Array],
+                        scalar_sets: list, cache_scalars: bool = True
+                        ) -> tuple:
+    """Async dispatch of len(scalar_sets) same-structure queries as ONE
+    XLA program + ONE packed readback buffer. Each element of
+    `scalar_sets` is a full per-query scalar tuple (plan.scalars layout).
+    The lane count is padded to a power-of-two bucket (surplus lanes
+    repeat the last query and are discarded at readback)."""
+    k = max(0, min(k, plan.num_docs_padded))
+    batch = len(scalar_sets)
+    bucket = _batch_bucket(batch)
+    padded_sets = list(scalar_sets) + [scalar_sets[-1]] * (bucket - batch)
+    scal_b, nd_b = _device_multi_scalars(plan, padded_sets,
+                                         use_cache=cache_scalars)
+    executor, treedef, spec = _get_packed_multi_executor(
+        plan, k, bucket, device_arrays)
+    out = executor(tuple(device_arrays), scal_b, nd_b)
+    if hasattr(out, "copy_to_host_async"):
+        out.copy_to_host_async()
+    return out, treedef, spec, batch
+
+
+def readback_plan_multi(dispatched) -> list[dict[str, Any]]:
+    """ONE device→host transfer for the whole batch; per-lane unpack."""
+    packed, treedef, spec, batch = dispatched
+    host = np.asarray(jax.device_get(packed))
+    results = []
+    for lane in range(batch):
+        sort_vals, sort_vals2, doc_ids, hit_scores, count, agg_out = \
+            _unpack_result(host[lane], treedef, spec)
+        results.append({
+            "sort_values": sort_vals,
+            "sort_values2": sort_vals2,
+            "doc_ids": doc_ids,
+            "scores": hit_scores,
+            "count": int(count),
+            "aggs": list(agg_out),
+        })
+    return results
+
+
 def dispatch_plan(plan: LoweredPlan, k: int,
                   device_arrays: list[jax.Array]):
     """Async dispatch: returns (packed_device_array, treedef, spec) WITHOUT

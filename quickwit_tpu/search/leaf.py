@@ -158,8 +158,11 @@ def execute_prepared_split(
     split_id: str,
     plan: Any,
     device_arrays: list,
+    batcher=None,
 ) -> LeafSearchResponse:
-    """Stage 2: jitted kernel execution + the single batched readback."""
+    """Stage 2: jitted kernel execution + the single batched readback.
+    With a `QueryBatcher`, concurrent same-structure queries on this split
+    share one vmapped dispatch (see search/batcher.py)."""
     t0 = time.monotonic()
     sort = request.sort_fields[0] if request.sort_fields else None
     sort_field = sort.field if sort else "_score"
@@ -167,7 +170,11 @@ def execute_prepared_split(
     sort2 = request.sort_fields[1] if len(request.sort_fields) > 1 else None
     # k=0 (count/agg-only): the executor skips keying and top-k entirely
     k = request.start_offset + request.max_hits
-    result = execute_plan(plan, k, device_arrays)
+    if batcher is not None:
+        result = batcher.execute(plan, k, device_arrays,
+                                 split_key=id(reader))
+    else:
+        result = execute_plan(plan, k, device_arrays)
 
     count = result["count"]
     num_hits_returned = min(k, count)

@@ -88,6 +88,12 @@ class SearcherContext:
         # queues instead of materializing
         from .admission import HbmBudget
         self.hbm_budget = HbmBudget()
+        # cross-query dispatch coalescing: concurrent same-structure
+        # queries on one split ride a single vmapped dispatch
+        # (search/batcher.py; reference analogue: per-node leaf request
+        # batching, leaf.rs:81)
+        from .batcher import QueryBatcher
+        self.query_batcher = QueryBatcher()
         self._readers: OrderedDict[str, SplitReader] = OrderedDict()
         self._max_open_splits = max_open_splits
         self._lock = threading.Lock()
@@ -483,7 +489,8 @@ class SearchService:
                 warmed = True
                 response = execute_prepared_split(
                     search_request, doc_mapper, reader, split.split_id,
-                    plan, device_arrays)
+                    plan, device_arrays,
+                    batcher=self.context.query_batcher)
                 key = canonical_request_key(split.split_id, search_request,
                                             split.time_range)
                 self.context.leaf_cache.put(key, response)

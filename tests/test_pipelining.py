@@ -80,13 +80,17 @@ def test_pipelined_overlap_beats_sequential(s3_splits, monkeypatch):
 
     # make both stages expensive enough to dominate scheduler noise under
     # parallel test load: each GET costs 100ms, each kernel 250ms
+    # patch at the executor level: both the direct path and the
+    # QueryBatcher route through executor.execute_plan for lone queries
+    from quickwit_tpu.search import executor as executor_mod
     from quickwit_tpu.search import leaf as leaf_mod
-    real_execute = leaf_mod.execute_plan
+    real_execute = executor_mod.execute_plan
 
     def slow_execute(plan, k, device_arrays):
         time.sleep(0.25)
         return real_execute(plan, k, device_arrays)
 
+    monkeypatch.setattr(executor_mod, "execute_plan", slow_execute)
     monkeypatch.setattr(leaf_mod, "execute_plan", slow_execute)
     server.latency_fn = lambda method, key: 0.1 if method == "GET" else 0.0
 

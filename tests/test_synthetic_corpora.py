@@ -8,7 +8,7 @@ import numpy as np
 from quickwit_tpu.common.uri import Uri
 from quickwit_tpu.index.reader import SplitReader
 from quickwit_tpu.index.synthetic import (
-    _SO_TOKENS_PER_DOC, _SO_VOCAB_SIZE, OTEL_BENCH_MAPPER, SO_MAPPER,
+    _SO_TOKENS_PER_DOC, _SO_VOCAB_SIZE, OTEL_BENCH_MAPPER, SO_MAPPER, so_term,
     synthetic_otel_split, synthetic_stackoverflow_split)
 from quickwit_tpu.query.ast import FullText, MatchAll
 from quickwit_tpu.search.leaf import leaf_search_single_split
@@ -41,7 +41,8 @@ def test_stackoverflow_phrase_matches_bruteforce():
                    .any(axis=1).sum())
     request = SearchRequest(
         index_ids=["so"], max_hits=20,
-        query_ast=FullText("body", f"t{t1:04d} t{t2:04d}", mode="phrase"))
+        query_ast=FullText("body", f"{so_term(t1)} {so_term(t2)}",
+                           mode="phrase"))
     resp = leaf_search_single_split(request, SO_MAPPER, reader, "s0")
     assert resp.num_hits == expected > 0
     assert len(resp.partial_hits) == min(20, expected)
@@ -56,7 +57,7 @@ def test_stackoverflow_single_term_df():
     expected = int((toks == term).any(axis=1).sum())
     request = SearchRequest(
         index_ids=["so"], max_hits=5,
-        query_ast=FullText("body", f"t{term:04d}", mode="or"))
+        query_ast=FullText("body", so_term(term), mode="or"))
     resp = leaf_search_single_split(request, SO_MAPPER, reader, "s0")
     assert resp.num_hits == expected
 

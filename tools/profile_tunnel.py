@@ -1,0 +1,97 @@
+"""Host-overhead profiling harness for the axon-tunnel TPU backend.
+
+Round-5 findings (all measured on the real chip, TPU v5 lite via the
+axon tunnel; the raw probe variants lived in prof_*.py during the
+investigation and are consolidated here):
+
+1. The per-query "host overhead" that kept flagship pipe_ms at ~56 ms
+   (vs dev_ms 2.09) is a fixed ~60-65 ms per DISPATCH round-trip through
+   the tunnel, serialized across dispatches — not Python, not readback
+   size (packed output is 344 bytes), not program content (a 37-line
+   HLO count-only program pays the same 65 ms as the 549-line flagship
+   program when measured honestly with dispatch-then-device_get).
+2. `jax.block_until_ready` on an output whose D2H copy has not been
+   requested returns early under the axon platform — drain-style
+   measurements that block only the last output report fantasy numbers
+   (0.02 ms/exec). Only device_get-based timing is trustworthy.
+3. Pipelining depth does NOT amortize the cost: dispatch-all/copy-all/
+   get-all, interleaved depth-8/32, burst drains — all converge to
+   ~61 ms/query because the tunnel serializes the rounds.
+4. Queries executed INSIDE one dispatch are full speed: a
+   `lax.fori_loop` running the kernel N deep costs ~2 ms/iteration
+   (differenced across two depths), and the c5 batch runs 1000 splits
+   in one dispatch for one ~65 ms round.
+
+Conclusion: the only lever that works is putting more work per
+dispatch. Hence `executor.dispatch_plan_multi` (vmap over stacked
+per-query scalars, one packed readback) — which is also the
+reference-faithful design: quickwit batches leaf requests per node
+(`quickwit-search/src/leaf.rs:81` greedy_batch_split).
+
+Run this script on the real chip to re-verify the numbers.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+
+def main():
+    import jax
+    import numpy as np
+    from quickwit_tpu.utils.compile_cache import enable_persistent_compile_cache
+    enable_persistent_compile_cache(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir, ".jax_cache"))
+    from bench import _workloads
+    from quickwit_tpu.search import executor as ex
+    from quickwit_tpu.search.leaf import prepare_single_split
+
+    # tunnel RTT: fresh 4-byte H2D put + blocking get
+    t0 = time.monotonic()
+    for i in range(4):
+        jax.device_get(jax.device_put(np.int32(i)))
+    rtt = (time.monotonic() - t0) / 4 / 2
+    print(f"# tunnel one-way round estimate: {rtt*1e3:.1f} ms", file=sys.stderr)
+
+    request, mapper, reader_thunk = _workloads()["flagship"]
+    print("# generating corpus...", file=sys.stderr)
+    reader = reader_thunk()
+    plan, device_arrays, _ = prepare_single_split(request, mapper, reader, "b")
+    k = request.start_offset + request.max_hits
+    scalars, nd = ex._device_scalars(plan)
+    args = (tuple(device_arrays), scalars, nd)
+    packed_fn, _, _ = ex._get_packed_executor(plan, k, args)
+    jax.device_get(packed_fn(*args))  # warm
+
+    N = 24
+    t0 = time.monotonic()
+    outs = [packed_fn(*args) for _ in range(N)]
+    for o in outs:
+        o.copy_to_host_async()
+    for o in outs:
+        jax.device_get(o)
+    print(f"# single-query dispatches, any pipelining pattern: "
+          f"{(time.monotonic()-t0)/N*1e3:.1f} ms/q", file=sys.stderr)
+
+    for B in (8, 16):
+        t0 = time.monotonic()
+        d = ex.dispatch_plan_multi(plan, k, device_arrays,
+                                   [plan.scalars] * B)
+        ex.readback_plan_multi(d)
+        print(f"# multi-dispatch B={B} compile+first: "
+              f"{time.monotonic()-t0:.1f}s", file=sys.stderr)
+        NB = 4
+        t0 = time.monotonic()
+        ds = [ex.dispatch_plan_multi(plan, k, device_arrays,
+                                     [plan.scalars] * B) for _ in range(NB)]
+        for d in ds:
+            ex.readback_plan_multi(d)
+        dt = time.monotonic() - t0
+        print(f"# multi-dispatch B={B}: {dt/NB*1e3:.1f} ms/batch = "
+              f"{dt/NB/B*1e3:.2f} ms/query", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
