@@ -1,0 +1,142 @@
+"""Delete-task planner: schedules delete-applying merges.
+
+Role of the reference's `DeleteTaskPlanner`
+(`quickwit-janitor/src/actors/delete_task_planner.rs:75`): find published
+splits whose `delete_opstamp` lags the index's latest delete task, probe
+each with a COUNT search for the pending delete queries, and
+
+- splits with zero matching docs get their `delete_opstamp` fast-forwarded
+  in place (no rewrite — the reference does exactly this to keep GDPR
+  sweeps cheap on untouched splits),
+- splits with matching docs are rewritten through the normal merge
+  protocol (`MergeExecutor.execute` with the pending tasks), which
+  publishes the replacement atomically and stamps it with the latest
+  opstamp.
+
+One planner pass is idempotent: rerunning converges to every published
+split carrying `last_delete_opstamp`.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..indexing.merge import MergeExecutor, MergeOperation
+from ..metastore.base import ListSplitsQuery, Metastore
+from ..models.doc_mapper import DocMapper
+from ..models.split_metadata import SplitState
+from ..storage.base import Storage
+
+logger = logging.getLogger(__name__)
+
+# bound per pass (reference plans a small batch per activation so one huge
+# backlog cannot starve regular merges)
+MAX_REWRITES_PER_PASS = 16
+
+
+class DeleteTaskPlanner:
+    def __init__(self, index_uid: str, doc_mapper: DocMapper,
+                 metastore: Metastore, split_storage: Storage,
+                 node_id: str = "node-0"):
+        self.index_uid = index_uid
+        self.doc_mapper = doc_mapper
+        self.metastore = metastore
+        self.split_storage = split_storage
+        self.executor = MergeExecutor(index_uid, doc_mapper, metastore,
+                                      split_storage, node_id=node_id)
+
+    def run_pass(self, max_rewrites: int = MAX_REWRITES_PER_PASS
+                 ) -> dict[str, int]:
+        """One planning pass; returns counters for observability/tests."""
+        last_opstamp = self.metastore.last_delete_opstamp(self.index_uid)
+        stale = [
+            s for s in self.metastore.list_splits(ListSplitsQuery(
+                index_uids=[self.index_uid],
+                states=[SplitState.PUBLISHED]))
+            if s.metadata.delete_opstamp < last_opstamp
+        ]
+        # oldest opstamp first: the most-behind splits carry the most
+        # pending deletes and bound the sweep's convergence
+        stale.sort(key=lambda s: s.metadata.delete_opstamp)
+        fast_forwarded: list[str] = []
+        rewritten = 0
+        for split in stale:
+            if rewritten >= max_rewrites:
+                break
+            tasks = [
+                t for t in self.metastore.list_delete_tasks(
+                    self.index_uid,
+                    opstamp_start=split.metadata.delete_opstamp)
+                if t["opstamp"] > split.metadata.delete_opstamp
+            ]
+            if not tasks:
+                fast_forwarded.append(split.metadata.split_id)
+                continue
+            if not self._split_matches_any(split, tasks):
+                fast_forwarded.append(split.metadata.split_id)
+                continue
+            try:
+                self.executor.execute(MergeOperation(splits=(split,)),
+                                      delete_tasks=tasks)
+                rewritten += 1
+            except Exception as exc:  # noqa: BLE001 - next pass retries
+                logger.warning("delete merge of %s failed: %s",
+                               split.metadata.split_id, exc)
+        if fast_forwarded:
+            self.metastore.update_splits_delete_opstamp(
+                self.index_uid, fast_forwarded, last_opstamp)
+        return {"delete_splits_rewritten": rewritten,
+                "delete_splits_fast_forwarded": len(fast_forwarded),
+                "delete_splits_pending": max(
+                    0, len(stale) - rewritten - len(fast_forwarded))}
+
+    def _split_matches_any(self, split, tasks: list[dict]) -> bool:
+        """COUNT probe: does any pending delete query hit this split?
+        (reference probes with a search before scheduling the rewrite)"""
+        from ..index.reader import SplitReader
+        from ..indexing.pipeline import split_file_path
+        from ..query.ast import ast_from_dict
+        from ..search.leaf import leaf_search_single_split
+        from ..search.models import SearchRequest
+        try:
+            reader = SplitReader(self.split_storage,
+                                 split_file_path(split.metadata.split_id))
+        except Exception as exc:  # noqa: BLE001 - treat as matching
+            logger.debug("delete probe open failed for %s: %s",
+                         split.metadata.split_id, exc)
+            return True  # rewrite path will surface the real error
+        for task in tasks:
+            try:
+                response = leaf_search_single_split(
+                    SearchRequest(index_ids=[self.index_uid],
+                                  query_ast=ast_from_dict(task["query_ast"]),
+                                  max_hits=0),
+                    self.doc_mapper, reader, split.metadata.split_id)
+            except Exception as exc:  # noqa: BLE001 - treat as matching
+                logger.debug("delete probe failed for %s: %s",
+                             split.metadata.split_id, exc)
+                return True
+            if response.num_hits > 0:
+                return True
+        return False
+
+
+def run_delete_planner(metastore: Metastore, storage_resolver,
+                       node_id: str = "node-0") -> dict[str, int]:
+    """Planner pass over every index (the janitor entry point)."""
+    totals = {"delete_splits_rewritten": 0,
+              "delete_splits_fast_forwarded": 0,
+              "delete_splits_pending": 0}
+    for index_metadata in metastore.list_indexes():
+        if metastore.last_delete_opstamp(index_metadata.index_uid) == 0:
+            continue  # no delete tasks ever created for this index
+        doc_mapper = index_metadata.index_config.doc_mapper
+        storage = storage_resolver.resolve(
+            index_metadata.index_config.index_uri)
+        planner = DeleteTaskPlanner(
+            index_metadata.index_uid, doc_mapper, metastore, storage,
+            node_id=node_id)
+        stats = planner.run_pass()
+        for key, value in stats.items():
+            totals[key] += value
+    return totals

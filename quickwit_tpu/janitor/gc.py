@@ -32,9 +32,11 @@ def run_garbage_collection(metastore: Metastore, storage_resolver: StorageResolv
     now_ts = now if now is not None else time.time()
     removed_files = 0
     removed_entries = 0
+    removed_orphans = 0
     for index_metadata in metastore.list_indexes():
         index_uid = index_metadata.index_uid
         storage = storage_resolver.resolve(index_metadata.index_config.index_uri)
+        removed_orphans += _delete_orphan_files(metastore, storage, index_uid)
         stale_staged = [
             s for s in metastore.list_splits(ListSplitsQuery(
                 index_uids=[index_uid], states=[SplitState.STAGED]))
@@ -60,4 +62,50 @@ def run_garbage_collection(metastore: Metastore, storage_resolver: StorageResolv
         metastore.delete_splits(index_uid, split_ids)
         removed_entries += len(split_ids)
         logger.info("gc removed %d splits of %s", len(split_ids), index_uid)
-    return {"gc_deleted_files": removed_files, "gc_deleted_splits": removed_entries}
+    return {"gc_deleted_files": removed_files,
+            "gc_deleted_splits": removed_entries,
+            "gc_deleted_orphans": removed_orphans}
+
+
+def _delete_orphan_files(metastore: Metastore, storage,
+                         index_uid: str) -> int:
+    """Remove `.split` files with NO metastore entry in ANY state
+    (reference `garbage_collection.rs:1` orphan cleanup). Safe without a
+    grace period because of two orderings:
+    - every upload path stages its metastore entry BEFORE the storage put
+      (uploader/merge protocol), and
+    - the file listing is taken BEFORE a forced metastore refresh, so any
+      file in the listing had its stage committed before the state we
+      compare against was read (a cached, minutes-old metastore view
+      could otherwise miss another node's fresh stage and delete a live
+      upload).
+    A file with no entry can then only be the debris of a crashed upload
+    whose staged entry was already GC'd, or of a delete_splits whose file
+    removal failed."""
+    try:
+        files = storage.list_files()
+    except Exception as exc:  # noqa: BLE001 - listing is best-effort
+        logger.debug("orphan scan listing failed for %s: %s",
+                     index_uid, exc)
+        return 0
+    metastore.refresh()
+    known = {
+        s.metadata.split_id
+        for s in metastore.list_splits(ListSplitsQuery(
+            index_uids=[index_uid]))
+    }
+    removed = 0
+    for name in files:
+        if not name.endswith(".split"):
+            continue
+        split_id = name[: -len(".split")]
+        if split_id in known:
+            continue
+        try:
+            storage.delete(name)
+            removed += 1
+        except Exception:  # noqa: BLE001 - already gone is success
+            pass
+    if removed:
+        logger.info("gc removed %d orphan files of %s", removed, index_uid)
+    return removed

@@ -71,6 +71,18 @@ class Metastore:
     def list_indexes(self) -> list[IndexMetadata]:
         raise NotImplementedError
 
+    def refresh(self) -> None:
+        """Drop any cached state so the next read reflects what other
+        nodes have durably written. Backends with live reads (SQL) need
+        nothing; the file-backed store invalidates its polling cache.
+        Safety-critical readers (GC orphan scan) call this before acting
+        on absence."""
+
+    def update_retention_policy(self, index_uid: str, retention) -> None:
+        """Persist a retention-policy change (reference `update_index`
+        subset: retention only; other settings are immutable here)."""
+        raise NotImplementedError
+
     # --- sources -----------------------------------------------------------
     def add_source(self, index_uid: str, source: SourceConfig) -> None:
         raise NotImplementedError

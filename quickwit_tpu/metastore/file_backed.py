@@ -88,6 +88,16 @@ class FileBackedMetastore(Metastore):
         self._manifest_loaded_at = 0.0
         self.polling_interval_secs = polling_interval_secs
 
+    def refresh(self) -> None:
+        """Invalidate the polling cache: the next read of the manifest or
+        any index state re-fetches from storage, making other nodes'
+        committed writes visible NOW (the GC orphan scan depends on this
+        to never treat a just-staged split as an orphan)."""
+        with self._lock:
+            self._manifest_loaded_at = 0.0
+            for state in self._states.values():
+                state.loaded_at = float("-inf")
+
     # --- manifest ----------------------------------------------------------
     def _load_manifest(self) -> dict[str, str]:
         stale = (self._manifest is not None
@@ -277,6 +287,12 @@ class FileBackedMetastore(Metastore):
             if state.metadata.sources.pop(source_id, None) is None:
                 raise MetastoreError(f"source {source_id!r} not found", kind="not_found")
             state.checkpoints.pop(source_id, None)
+            self._save_state(state)
+
+    def update_retention_policy(self, index_uid: str, retention) -> None:
+        with self._lock:
+            state = self._state_by_uid(index_uid)
+            state.metadata.index_config.retention = retention
             self._save_state(state)
 
     def toggle_source(self, index_uid: str, source_id: str, enable: bool) -> None:

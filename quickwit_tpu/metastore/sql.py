@@ -206,6 +206,12 @@ class SqlMetastore(Metastore):
                 "DELETE FROM checkpoints WHERE index_uid = ? AND "
                 "source_id = ?", (index_uid, source_id))
 
+    def update_retention_policy(self, index_uid: str, retention) -> None:
+        with self._tx(), self._txn():
+            metadata = self._index_row_by_uid(index_uid)
+            metadata.index_config.retention = retention
+            self._save_metadata(metadata)
+
     def toggle_source(self, index_uid: str, source_id: str,
                       enable: bool) -> None:
         with self._tx(), self._txn():

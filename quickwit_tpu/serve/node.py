@@ -434,9 +434,14 @@ class Node:
             if self._client_endpoints.get(member.node_id) == endpoints \
                     and member.node_id in self.clients:
                 return
-            self._close_client(self.clients.get(member.node_id))
+            # publish the replacement BEFORE closing the old reference: a
+            # concurrent search thread that already fetched the old client
+            # may still fail, but no thread can fetch an already-closed
+            # client from the map
+            old = self.clients.get(member.node_id)
             self._client_endpoints[member.node_id] = endpoints
             self.clients[member.node_id] = self._make_peer_client(member)
+            self._close_client(old)
 
     @staticmethod
     def _close_client(client) -> None:
@@ -622,12 +627,19 @@ class Node:
         follower = next(m for m in peers if m.node_id == ordered[0])
         client = self.clients.get(follower.node_id)
         if client is None:
-            from .http_client import HttpSearchClient
-            client = HttpSearchClient(follower.rest_endpoint,
-                                      **self.config.client_tls_kwargs())
-            # cache: per-batch client construction would defeat the
-            # circuit breaker and pay a TCP/TLS handshake per persist
+            # same construction _on_cluster_change would use (gRPC plane
+            # when advertised) — a plain HTTP client cached here would
+            # otherwise pin this peer to JSON/HTTP forever once the
+            # endpoints are recorded. Cache: per-batch client construction
+            # would defeat the circuit breaker and pay a TCP/TLS handshake
+            # per persist. Recording the endpoints keeps the next no-op
+            # gossip update from closing a client mid-replication
+            # (_on_cluster_change keeps clients whose endpoints are
+            # unchanged).
+            client = self._make_peer_client(follower)
             self.clients[follower.node_id] = client
+            self._client_endpoints[follower.node_id] = (
+                follower.grpc_endpoint, follower.rest_endpoint)
 
         def send(first: int, batch: list[bytes], reset: bool = False):
             return client.replicate({
@@ -1309,9 +1321,14 @@ class Node:
 
     # ------------------------------------------------------------------
     def run_janitor(self) -> dict[str, int]:
-        """GC + retention pass (role of quickwit-janitor's actors)."""
+        """GC + retention + delete-task planning pass (role of
+        quickwit-janitor's actors)."""
+        from ..janitor.delete_planner import run_delete_planner
         from ..janitor.gc import run_garbage_collection
         from ..janitor.retention import apply_retention
         gc_stats = run_garbage_collection(self.metastore, self.storage_resolver)
         retention_stats = apply_retention(self.metastore)
-        return {**gc_stats, **retention_stats}
+        delete_stats = run_delete_planner(self.metastore,
+                                          self.storage_resolver,
+                                          node_id=self.config.node_id)
+        return {**gc_stats, **retention_stats, **delete_stats}

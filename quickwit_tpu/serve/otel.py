@@ -293,20 +293,20 @@ class OtelService:
             exhausted = len(buckets) < size
             return [b["key"] for b in buckets if b["key"]], exhausted
 
+        # hard cap: a huge client `limit` (or, below, a never-matching
+        # tag) must not widen the terms agg without bound (device
+        # allocation) — BOTH the tagged and untagged paths clamp to it
+        max_size = 10_000
         # size+1: spans ingested without a traceId bucket under "" and
         # are dropped above — the extra slot keeps `limit` real traces
         # even when the empty bucket ranks in the top N
         if not tags:
-            trace_ids, _ = top_trace_ids(limit + 1)
+            trace_ids, _ = top_trace_ids(min(limit + 1, max_size))
             return trace_ids[:limit]
         # tag post-filtering prunes AFTER the agg, so widen the candidate
         # pool geometrically until `limit` matches or the index runs dry
         # (the cache is request-scoped — passed down, never instance state)
         cache = {} if span_cache is None else span_cache
-        # hard cap: neither a huge client `limit` nor a never-matching tag
-        # may widen the terms agg without bound (device allocation) —
-        # return whatever matched within the cap instead
-        max_size = 10_000
         size = min(limit * 5 + 1, max_size)
         while True:
             trace_ids, exhausted = top_trace_ids(size)

@@ -388,6 +388,26 @@ class RestServer:
                 ListSplitsQuery(index_uids=[metadata.index_uid]))
             return 200, {"splits": [s.to_dict() for s in splits]}
 
+        # --- delete tasks (reference: delete_task_api/handler.rs) -------
+        m = re.fullmatch(r"/api/v1/([^/_][^/]*)/delete-tasks", path)
+        if m and method == "POST":
+            from ..query.es_dsl import es_query_to_ast
+            metadata = node.metastore.index_metadata(m.group(1))
+            payload = json.loads(body)
+            delete_query = payload.get("query")
+            if delete_query is None:
+                return 400, {"error": "missing delete query"}
+            ast = es_query_to_ast(
+                delete_query,
+                metadata.index_config.doc_mapper.default_search_fields)
+            opstamp = node.metastore.create_delete_task(
+                metadata.index_uid, ast.to_dict())
+            return 200, {"opstamp": opstamp}
+        if m and method == "GET":
+            metadata = node.metastore.index_metadata(m.group(1))
+            return 200, {"delete_tasks": node.metastore.list_delete_tasks(
+                metadata.index_uid)}
+
         # --- source management (reference: index_api.rs source routes) --
         m = re.fullmatch(r"/api/v1/indexes/([^/]+)/sources", path)
         if m and method == "POST":

@@ -78,6 +78,10 @@ class SplitTable:
         info = self._splits.get(split_id)
         if info is not None and info["status"] == ON_DISK:
             return
+        if info is not None and info.get("reserved"):
+            # converting a make_room reservation into the real entry:
+            # release the reserved bytes before adding the actual size
+            self.on_disk_bytes -= info["num_bytes"]
         self._splits[split_id] = {
             "status": ON_DISK, "storage_uri": storage_uri,
             "num_bytes": num_bytes, "touch": self._touch_stamp()}
@@ -85,7 +89,8 @@ class SplitTable:
 
     def forget(self, split_id: str) -> None:
         info = self._splits.pop(split_id, None)
-        if info is not None and info["status"] == ON_DISK:
+        if info is not None and (info["status"] == ON_DISK
+                                 or info.get("reserved")):
             self.on_disk_bytes -= info["num_bytes"]
 
     def num_on_disk(self) -> int:
@@ -112,15 +117,24 @@ class SplitTable:
     def abort_download(self, split_id: str) -> None:
         info = self._splits.get(split_id)
         if info is not None and info["status"] == DOWNLOADING:
+            if info.pop("reserved", None):
+                self.on_disk_bytes -= info["num_bytes"]
             info["status"] = CANDIDATE
 
     def make_room(self, incoming_bytes: int,
-                  incoming_count: int = 1) -> "Optional[list[str]]":
+                  incoming_count: int = 1,
+                  reserve_for: Optional[str] = None) -> "Optional[list[str]]":
         """Evict least-recently-touched ON-DISK splits until
         `incoming_bytes` fits under the byte + count budgets. Returns the
         evicted ids, or None when the incoming split can NEVER fit (or
         only by evicting something fresher than it — the reference's
-        NoRoomAvailable)."""
+        NoRoomAvailable).
+
+        With `reserve_for`, the incoming bytes are accounted against
+        `on_disk_bytes` IMMEDIATELY (tagged reserved on that split's
+        entry), so a concurrent download admitted between this call and
+        `register_on_disk` cannot overshoot the budget; the reservation
+        is released by register_on_disk / forget / abort_download."""
         if incoming_bytes > self.max_bytes:
             return None
         evicted: list[str] = []
@@ -128,7 +142,11 @@ class SplitTable:
             ((i["touch"], sid) for sid, i in self._splits.items()
              if i["status"] == ON_DISK))
         bytes_after = self.on_disk_bytes
-        count_after = len(on_disk)
+        # reserved in-flight downloads hold a count slot too — otherwise
+        # concurrent admissions protect the byte budget but overshoot
+        # max_splits
+        count_after = len(on_disk) + sum(
+            1 for i in self._splits.values() if i.get("reserved"))
         idx = 0
         while (bytes_after + incoming_bytes > self.max_bytes
                or count_after + incoming_count > self.max_splits):
@@ -141,6 +159,12 @@ class SplitTable:
             evicted.append(victim)
         for victim in evicted:
             self.forget(victim)
+        if reserve_for is not None:
+            info = self._splits.get(reserve_for)
+            if info is not None and info["status"] == DOWNLOADING:
+                info["num_bytes"] = incoming_bytes
+                info["reserved"] = True
+                self.on_disk_bytes += incoming_bytes
         return evicted
 
 
@@ -226,7 +250,8 @@ class DiskSplitCache:
                 self.table.forget(split_id)
             return None
         with self._lock:
-            evicted = self.table.make_room(len(payload))
+            evicted = self.table.make_room(len(payload),
+                                           reserve_for=split_id)
             if evicted is None:
                 # cannot fit without evicting fresher data: drop candidacy
                 self.table.forget(split_id)

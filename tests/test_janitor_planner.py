@@ -1,0 +1,202 @@
+"""Janitor debt (round-2/3/4 directive): GC orphan-file scan + the
+delete-task planner that schedules delete-applying merges.
+
+Reference parity targets:
+- orphan scan: `quickwit-index-management/src/garbage_collection.rs:1`
+- planner: `quickwit-janitor/src/actors/delete_task_planner.rs:75`
+"""
+
+import pytest
+
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.index import SplitReader
+from quickwit_tpu.indexing import IndexingPipeline, PipelineParams, VecSource
+from quickwit_tpu.indexing.pipeline import split_file_path
+from quickwit_tpu.janitor import run_delete_planner, run_garbage_collection
+from quickwit_tpu.janitor.delete_planner import DeleteTaskPlanner
+from quickwit_tpu.metastore import FileBackedMetastore, ListSplitsQuery
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.models.index_metadata import (
+    IndexConfig, IndexMetadata, SourceConfig)
+from quickwit_tpu.models.split_metadata import SplitState
+from quickwit_tpu.query.ast import Term
+from quickwit_tpu.search import SearchRequest, leaf_search_single_split
+from quickwit_tpu.storage import RamStorage, StorageResolver
+
+MAPPER = DocMapper(
+    field_mappings=[
+        FieldMapping("ts", FieldType.DATETIME, fast=True,
+                     input_formats=("unix_timestamp",)),
+        FieldMapping("body", FieldType.TEXT),
+        FieldMapping("tenant", FieldType.U64, fast=True),
+    ],
+    timestamp_field="ts",
+    default_search_fields=("body",),
+)
+
+
+@pytest.fixture
+def env():
+    resolver = StorageResolver.for_test()
+    meta_storage = resolver.resolve("ram:///jp/metastore")
+    split_storage = resolver.resolve("ram:///jp/splits")
+    metastore = FileBackedMetastore(meta_storage)
+    config = IndexConfig(index_id="logs", index_uri="ram:///jp/splits",
+                         doc_mapper=MAPPER)
+    metastore.create_index(IndexMetadata(
+        index_uid="logs:01", index_config=config,
+        sources={"src": SourceConfig("src", "vec"),
+                 "src2": SourceConfig("src2", "vec")}))
+    return metastore, split_storage, resolver
+
+
+def _index(metastore, split_storage, docs, target=10**6, source_id="src"):
+    params = PipelineParams(index_uid="logs:01", source_id=source_id,
+                            split_num_docs_target=target, batch_num_docs=50)
+    IndexingPipeline(params, MAPPER, VecSource(docs), metastore,
+                     split_storage).run_to_completion()
+
+
+def _docs(n):
+    return [{"ts": 1000 + i, "body": f"event {i}", "tenant": i % 3}
+            for i in range(n)]
+
+
+# --- orphan scan -------------------------------------------------------------
+
+def test_gc_removes_orphan_files_and_keeps_live_ones(env):
+    metastore, split_storage, resolver = env
+    _index(metastore, split_storage, _docs(40))
+    live = [f"{s.metadata.split_id}.split"
+            for s in metastore.list_splits(
+                ListSplitsQuery(index_uids=["logs:01"]))]
+    assert live
+    # an orphan: a split file with NO metastore entry in any state (the
+    # debris of a crashed upload whose staged entry was already GC'd)
+    split_storage.put("deadbeef-orphan.split", b"\x00" * 64)
+    # a non-split file must never be touched
+    split_storage.put("notes.txt", b"keep me")
+    stats = run_garbage_collection(metastore, resolver)
+    assert stats["gc_deleted_orphans"] == 1
+    files = set(split_storage.list_files())
+    assert "deadbeef-orphan.split" not in files
+    assert "notes.txt" in files
+    for name in live:
+        assert name in files
+
+
+def test_gc_orphan_scan_is_stable_when_clean(env):
+    metastore, split_storage, resolver = env
+    _index(metastore, split_storage, _docs(10))
+    before = set(split_storage.list_files())
+    stats = run_garbage_collection(metastore, resolver)
+    assert stats["gc_deleted_orphans"] == 0
+    assert set(split_storage.list_files()) == before
+
+
+# --- delete-task planner -----------------------------------------------------
+
+def test_planner_rewrites_matching_and_fast_forwards_clean(env):
+    metastore, split_storage, _ = env
+    # two splits: tenants 0/1/2 in the first, tenant 2 only in the second
+    _index(metastore, split_storage, _docs(30))
+    _index(metastore, split_storage,
+           [{"ts": 5000 + i, "body": f"late {i}", "tenant": 2}
+            for i in range(10)], source_id="src2")
+    splits = metastore.list_splits(ListSplitsQuery(
+        index_uids=["logs:01"], states=[SplitState.PUBLISHED]))
+    assert len(splits) == 2
+
+    metastore.create_delete_task(
+        "logs:01", {"type": "term", "field": "tenant", "value": "1"})
+    planner = DeleteTaskPlanner("logs:01", MAPPER, metastore, split_storage)
+    stats = planner.run_pass()
+    # the mixed split matches tenant=1 -> rewritten; the tenant-2-only
+    # split is clean -> fast-forwarded without a rewrite
+    assert stats["delete_splits_rewritten"] == 1
+    assert stats["delete_splits_fast_forwarded"] == 1
+
+    published = metastore.list_splits(ListSplitsQuery(
+        index_uids=["logs:01"], states=[SplitState.PUBLISHED]))
+    assert all(s.metadata.delete_opstamp == 1 for s in published)
+    # no tenant-1 doc survives anywhere
+    for split in published:
+        reader = SplitReader(split_storage,
+                             split_file_path(split.metadata.split_id))
+        resp = leaf_search_single_split(
+            SearchRequest(index_ids=["logs"],
+                          query_ast=Term("tenant", "1"), max_hits=0),
+            MAPPER, reader, split.metadata.split_id)
+        assert resp.num_hits == 0
+    # doc conservation: only tenant-1 docs were dropped
+    total = sum(s.metadata.num_docs for s in published)
+    assert total == 30 - 10 + 10
+
+    # second pass converges to a no-op
+    stats2 = planner.run_pass()
+    assert stats2 == {"delete_splits_rewritten": 0,
+                      "delete_splits_fast_forwarded": 0,
+                      "delete_splits_pending": 0}
+
+
+def test_delete_task_rest_roundtrip():
+    """POST /api/v1/{index}/delete-tasks (reference delete_task_api) →
+    janitor pass applies it; GET lists the recorded task."""
+    import json
+    import urllib.request
+
+    from quickwit_tpu.serve.node import Node, NodeConfig
+    from quickwit_tpu.serve.rest import RestServer
+
+    node = Node(NodeConfig(node_id="jp-rest", rest_port=0,
+                           metastore_uri="ram:///jp-rest/metastore",
+                           default_index_root_uri="ram:///jp-rest/indexes"),
+                storage_resolver=StorageResolver.for_test())
+    server = RestServer(node)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+
+    def http(method, path, body=None, raw=None):
+        data = raw if raw is not None else (
+            json.dumps(body).encode() if body is not None else None)
+        req = urllib.request.Request(base + path, data=data, method=method)
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    try:
+        http("POST", "/api/v1/indexes", {
+            "version": "0.8", "index_id": "jp",
+            "doc_mapping": {"field_mappings": [
+                {"name": "ts", "type": "datetime", "fast": True,
+                 "input_formats": ["unix_timestamp"]},
+                {"name": "tenant", "type": "text", "tokenizer": "raw"},
+            ], "timestamp_field": "ts"},
+        })
+        ndjson = "\n".join(json.dumps({"ts": 1000 + i,
+                                       "tenant": str(i % 2)})
+                           for i in range(20)).encode()
+        http("POST", "/api/v1/jp/ingest?commit=force", raw=ndjson)
+        created = http("POST", "/api/v1/jp/delete-tasks",
+                       {"query": {"term": {"tenant": "1"}}})
+        assert created["opstamp"] == 1
+        listed = http("GET", "/api/v1/jp/delete-tasks")
+        assert len(listed["delete_tasks"]) == 1
+        stats = node.run_janitor()
+        assert stats["delete_splits_rewritten"] == 1
+        result = http("POST", "/api/v1/_elastic/jp/_search",
+                      {"query": {"match_all": {}}, "size": 0})
+        assert result["hits"]["total"]["value"] == 10
+    finally:
+        server.stop()
+
+
+def test_run_delete_planner_entry_point(env):
+    metastore, split_storage, resolver = env
+    _index(metastore, split_storage, _docs(12))
+    metastore.create_delete_task(
+        "logs:01", {"type": "term", "field": "tenant", "value": "0"})
+    stats = run_delete_planner(metastore, resolver)
+    assert stats["delete_splits_rewritten"] == 1
+    published = metastore.list_splits(ListSplitsQuery(
+        index_uids=["logs:01"], states=[SplitState.PUBLISHED]))
+    assert sum(s.metadata.num_docs for s in published) == 8

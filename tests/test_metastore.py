@@ -165,6 +165,18 @@ def test_sources(metastore):
     assert "src2" not in metastore.index_metadata("test-index").sources
 
 
+def test_update_retention_policy_persists_and_refresh(metastore):
+    from quickwit_tpu.models.index_metadata import RetentionPolicy
+    uid = "test-index:01"
+    metastore.update_retention_policy(uid, RetentionPolicy(period_seconds=60))
+    metastore.refresh()  # survives a forced cache drop
+    got = metastore.index_metadata("test-index").index_config.retention
+    assert got is not None and got.period_seconds == 60
+    metastore.update_retention_policy(uid, None)
+    metastore.refresh()
+    assert metastore.index_metadata("test-index").index_config.retention is None
+
+
 def test_delete_tasks(metastore):
     uid = "test-index:01"
     op1 = metastore.create_delete_task(uid, {"type": "term", "field": "f", "value": "x"})

@@ -131,9 +131,12 @@ def test_janitor_gc_and_retention(two_nodes):
     assert stats["gc_deleted_splits"] == 1
     assert not storage.exists(f"{victim}.split")
 
-    # retention: a policy of 1 hour expires everything (docs are from 2020)
+    # retention: a policy of 1 hour expires everything (docs are from
+    # 2020). The policy must be PERSISTED — apply_retention re-reads
+    # metastore state (the janitor's forced refresh drops cached objects)
     from quickwit_tpu.models.index_metadata import RetentionPolicy
-    metadata.index_config.retention = RetentionPolicy(period_seconds=3600)
+    node.metastore.update_retention_policy(
+        uid, RetentionPolicy(period_seconds=3600))
     stats = apply_retention(node.metastore)
     remaining = node.metastore.list_splits(
         ListSplitsQuery(index_uids=[uid], states=[SplitState.PUBLISHED]))

@@ -24,6 +24,42 @@ def test_table_lru_eviction_order():
     assert table.on_disk_bytes == 40
 
 
+def test_table_reservation_blocks_concurrent_overshoot():
+    """make_room(reserve_for=...) accounts the incoming bytes at
+    reservation time: a second download admitted in the window between
+    make_room and register_on_disk cannot overshoot max_bytes."""
+    table = SplitTable(max_bytes=100)
+    table.register_on_disk("a", 60)
+    table.touch("b")
+    table.start_download("b")
+    assert table.make_room(50, reserve_for="b") == ["a"]
+    assert table.on_disk_bytes == 50  # reserved, not yet on disk
+    # concurrent download "c": only 50 bytes of budget remain — it must
+    # see the reservation and fit (or fail), never overshoot
+    table.touch("c")
+    table.start_download("c")
+    assert table.make_room(50, reserve_for="c") == []
+    assert table.on_disk_bytes == 100
+    # nothing evictable (both entries are reserved downloads): a third
+    # download cannot be admitted at all
+    table.touch("d")
+    table.start_download("d")
+    assert table.make_room(10, reserve_for="d") is None
+    # completing b converts the reservation without double counting
+    table.register_on_disk("b", 50)
+    assert table.on_disk_bytes == 100
+    # failing c rolls its reservation back
+    table.forget("c")
+    assert table.on_disk_bytes == 50
+    # aborting a reserved download also rolls back
+    table.touch("e")
+    table.start_download("e")
+    table.make_room(20, reserve_for="e")
+    assert table.on_disk_bytes == 70
+    table.abort_download("e")
+    assert table.on_disk_bytes == 50
+
+
 def test_table_no_room_for_oversized_split():
     table = SplitTable(max_bytes=100)
     table.register_on_disk("a", 90)
