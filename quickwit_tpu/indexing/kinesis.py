@@ -1,0 +1,235 @@
+"""Kinesis source speaking the real Kinesis JSON API on stdlib HTTP.
+
+Role of the reference's Kinesis source
+(`quickwit-indexing/src/source/kinesis/kinesis_source.rs`): consume doc
+batches from Kinesis stream shards with per-shard checkpoint positions
+flowing through the exactly-once `CheckpointDelta` publish protocol. This
+build has no AWS SDK, so the API itself is implemented here — the
+x-amz-json-1.1 target protocol with SigV4 (service "kinesis", reusing the
+canonical signer from storage/s3.py) over persistent stdlib HTTP
+connections:
+
+  ListShards · GetShardIterator · GetRecords
+
+Positions come from OUR metastore checkpoint (never Kinesis consumer
+state), exactly like the reference: the `SourceCheckpoint` stores each
+shard's last-processed sequence number and replays from
+AFTER_SEQUENCE_NUMBER on any crash, making Kinesis→split ingestion
+exactly-once (`checkpoint.rs:30`). Sequence numbers are decimal strings;
+the checkpoint's (length, lexicographic) position ordering sorts them
+numerically — the same encoding the reference uses.
+
+Scope note: parent/child shard lineage after a reshard is consumed as a
+flat shard list (each shard keeps its own checkpoint partition); strict
+parent-before-child ordering is not enforced.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import time
+from typing import Any, Iterator, Optional
+from urllib.parse import urlparse
+
+from ..storage.s3 import S3Config, sigv4_headers
+
+API_VERSION = "Kinesis_20131202"
+
+
+class KinesisError(RuntimeError):
+    def __init__(self, message: str, error_type: Optional[str] = None):
+        super().__init__(message)
+        self.error_type = error_type
+
+
+class KinesisWireClient:
+    """Minimal Kinesis API client: JSON target protocol + SigV4 on one
+    persistent HTTP connection (re-dialed on failure)."""
+
+    def __init__(self, endpoint: str, config: S3Config,
+                 timeout: float = 30.0):
+        parsed = urlparse(endpoint if "//" in endpoint
+                          else f"http://{endpoint}")
+        self.scheme = parsed.scheme or "http"
+        self.host = parsed.hostname or endpoint
+        self.port = parsed.port or (443 if self.scheme == "https" else 80)
+        self.config = config
+        self.timeout = timeout
+        self._conn: Optional[http.client.HTTPConnection] = None
+
+    def close(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            finally:
+                self._conn = None
+
+    def _connection(self) -> http.client.HTTPConnection:
+        if self._conn is None:
+            cls = (http.client.HTTPSConnection if self.scheme == "https"
+                   else http.client.HTTPConnection)
+            self._conn = cls(self.host, self.port, timeout=self.timeout)
+        return self._conn
+
+    _RETRYABLE_STATUS = (500, 502, 503, 504)
+    _RETRYABLE_TYPES = ("ProvisionedThroughputExceededException",
+                        "LimitExceededException")
+    _MAX_ATTEMPTS = 3
+
+    def call(self, action: str, payload: dict[str, Any]) -> dict[str, Any]:
+        """One signed API call with the same retry envelope the S3 client
+        uses: transient 5xx and Kinesis throttles (GetRecords is
+        rate-capped per shard) back off and retry; a dead kept-alive
+        connection re-dials once per attempt."""
+        body = json.dumps(payload).encode()
+        host_header = (self.host if self.port in (80, 443)
+                       else f"{self.host}:{self.port}")
+        headers = sigv4_headers(
+            "POST", host_header, "/", [],
+            hashlib.sha256(body).hexdigest(), self.config,
+            extra_headers={
+                "content-type": "application/x-amz-json-1.1",
+                "x-amz-target": f"{API_VERSION}.{action}",
+            },
+            service="kinesis")
+        last_error: Optional[KinesisError] = None
+        for attempt in range(1, self._MAX_ATTEMPTS + 1):
+            try:
+                conn = self._connection()
+                conn.request("POST", "/", body=body, headers=headers)
+                response = conn.getresponse()
+                raw = response.read()
+            except (http.client.HTTPException, OSError) as exc:
+                self.close()
+                last_error = KinesisError(f"kinesis transport error: {exc}")
+                if attempt == self._MAX_ATTEMPTS:
+                    raise last_error
+                time.sleep(0.05 * attempt)
+                continue
+            try:
+                decoded = json.loads(raw) if raw else {}
+            except ValueError:
+                decoded = {}  # proxy HTML error page etc: status rules
+            if response.status == 200:
+                return decoded
+            error_type = (decoded.get("__type") or "").split("#")[-1]
+            last_error = KinesisError(
+                decoded.get("message") or decoded.get("Message")
+                or f"kinesis call {action} failed: {response.status}",
+                error_type=error_type or None)
+            if (response.status in self._RETRYABLE_STATUS
+                    or error_type in self._RETRYABLE_TYPES) \
+                    and attempt < self._MAX_ATTEMPTS:
+                time.sleep(0.05 * attempt)
+                continue
+            raise last_error
+        raise last_error  # unreachable; keeps the type checker honest
+
+    # -- the three consumer APIs -------------------------------------------
+    def list_shards(self, stream: str) -> list[str]:
+        shards: list[str] = []
+        token: Optional[str] = None
+        while True:
+            payload: dict[str, Any] = (
+                {"NextToken": token} if token else {"StreamName": stream})
+            out = self.call("ListShards", payload)
+            shards.extend(s["ShardId"] for s in out.get("Shards", []))
+            token = out.get("NextToken")
+            if not token:
+                return sorted(shards)
+
+    def get_shard_iterator(self, stream: str, shard_id: str,
+                           iterator_type: str,
+                           sequence_number: Optional[str] = None) -> str:
+        payload: dict[str, Any] = {
+            "StreamName": stream, "ShardId": shard_id,
+            "ShardIteratorType": iterator_type}
+        if sequence_number is not None:
+            payload["StartingSequenceNumber"] = sequence_number
+        return self.call("GetShardIterator", payload)["ShardIterator"]
+
+    def get_records(self, shard_iterator: str, limit: int
+                    ) -> dict[str, Any]:
+        return self.call("GetRecords", {"ShardIterator": shard_iterator,
+                                        "Limit": limit})
+
+
+class KinesisSource:
+    """Checkpointed Kinesis stream source (reference
+    `kinesis_source.rs`). Partitions map to checkpoint partition ids
+    "{stream}:{shard_id}"; positions are the LAST PROCESSED sequence
+    number (Kinesis convention — resume is AFTER_SEQUENCE_NUMBER). Each
+    pipeline turn drains every shard until GetRecords reports zero
+    MillisBehindLatest (or returns empty), so the indexing pipeline's
+    commit/turn machinery paces consumption."""
+
+    def __init__(self, endpoint: str, stream: str, config: S3Config,
+                 records_per_call: int = 1000,
+                 max_pages_per_shard_pass: int = 100):
+        self.stream = stream
+        self.client = KinesisWireClient(endpoint, config)
+        self.records_per_call = records_per_call
+        # bounded work per pass: under continuous production a shard's
+        # MillisBehindLatest may never reach zero, and chasing the live
+        # tip would starve the other shards and make a "pass" unbounded
+        # (same rationale as KafkaSource's per-pass watermark snapshot)
+        self.max_pages_per_shard_pass = max_pages_per_shard_pass
+
+    def close(self) -> None:
+        self.client.close()
+
+    def _stream_shards(self) -> list[str]:
+        # re-listed every call: resharding creates child shards that must
+        # start being consumed without a process restart
+        return self.client.list_shards(self.stream)
+
+    def partition_ids(self) -> list[str]:
+        return [f"{self.stream}:{s}" for s in self._stream_shards()]
+
+    def batches(self, checkpoint, batch_num_docs: int = 10_000
+                ) -> Iterator[Any]:
+        import base64
+
+        from ..metastore.checkpoint import BEGINNING, CheckpointDelta
+        from .sources import SourceBatch
+
+        for shard_id in self._stream_shards():
+            partition_id = f"{self.stream}:{shard_id}"
+            position = checkpoint.position_for(partition_id)
+            iterator = self.client.get_shard_iterator(
+                self.stream, shard_id,
+                "TRIM_HORIZON" if position == BEGINNING
+                else "AFTER_SEQUENCE_NUMBER",
+                None if position == BEGINNING else position)
+            pages = 0
+            while iterator and pages < self.max_pages_per_shard_pass:
+                pages += 1
+                out = self.client.get_records(
+                    iterator, min(self.records_per_call, batch_num_docs))
+                records = out.get("Records", [])
+                iterator = out.get("NextShardIterator")
+                if records:
+                    docs = []
+                    for record in records:
+                        data = base64.b64decode(record["Data"])
+                        try:
+                            docs.append(json.loads(data))
+                        except (ValueError, UnicodeDecodeError):
+                            docs.append({"_malformed":
+                                         data.decode("utf-8", "replace")})
+                    to_pos = records[-1]["SequenceNumber"]
+                    delta = CheckpointDelta.from_range(
+                        partition_id, position, to_pos)
+                    yield SourceBatch(docs, delta)
+                    position = to_pos
+                if out.get("MillisBehindLatest", 0) == 0:
+                    # caught up with the shard tip: bound this pass (the
+                    # next pipeline turn resumes from the checkpoint)
+                    break
+                if not records:
+                    # behind but empty page (Kinesis allows empty reads
+                    # mid-stream): avoid a hot spin
+                    time.sleep(0.01)
+        return

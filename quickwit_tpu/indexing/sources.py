@@ -115,7 +115,7 @@ class VoidSource(Source):
         return iter(())
 
 
-_UNSUPPORTED = {"kinesis", "pulsar", "sqs", "gcp_pubsub"}
+_UNSUPPORTED = {"pulsar", "sqs", "gcp_pubsub"}
 
 
 def make_source(source_type: str, params: dict[str, Any]) -> Source:
@@ -140,6 +140,27 @@ def make_source(source_type: str, params: dict[str, Any]) -> Source:
         if "topic" not in params:
             raise ValueError("kafka source requires a topic")
         return KafkaSource(servers, params["topic"])
+    if source_type == "kinesis":
+        # reference SourceParams::Kinesis shape: stream_name + region;
+        # endpoint override for non-AWS deployments (and the wire fake)
+        from ..storage.s3 import S3Config
+        from .kinesis import KinesisSource
+        if "stream_name" not in params:
+            raise ValueError("kinesis source requires a stream_name")
+        # credentials: environment first (AWS_ACCESS_KEY_ID / ... — the
+        # normal deployment shape), explicit params override (tests,
+        # non-AWS endpoints)
+        import dataclasses
+        base = S3Config.from_env()
+        region = params.get("region") or base.region or "us-east-1"
+        endpoint = (params.get("endpoint")
+                    or f"https://kinesis.{region}.amazonaws.com")
+        config = dataclasses.replace(
+            base, region=region,
+            access_key=params.get("access_key", base.access_key),
+            secret_key=params.get("secret_key", base.secret_key),
+            session_token=params.get("session_token", base.session_token))
+        return KinesisSource(endpoint, params["stream_name"], config)
     if source_type in _UNSUPPORTED:
         raise NotImplementedError(
             f"source type {source_type!r} requires an external client SDK not "

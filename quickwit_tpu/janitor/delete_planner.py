@@ -58,17 +58,19 @@ class DeleteTaskPlanner:
         # oldest opstamp first: the most-behind splits carry the most
         # pending deletes and bound the sweep's convergence
         stale.sort(key=lambda s: s.metadata.delete_opstamp)
+        # ONE task fetch per pass (backends return tasks with opstamp
+        # strictly greater than opstamp_start); filtered per split in
+        # memory instead of a metastore query per split
+        all_tasks = self.metastore.list_delete_tasks(
+            self.index_uid,
+            opstamp_start=stale[0].metadata.delete_opstamp) if stale else []
         fast_forwarded: list[str] = []
         rewritten = 0
         for split in stale:
             if rewritten >= max_rewrites:
                 break
-            tasks = [
-                t for t in self.metastore.list_delete_tasks(
-                    self.index_uid,
-                    opstamp_start=split.metadata.delete_opstamp)
-                if t["opstamp"] > split.metadata.delete_opstamp
-            ]
+            tasks = [t for t in all_tasks
+                     if t["opstamp"] > split.metadata.delete_opstamp]
             if not tasks:
                 fast_forwarded.append(split.metadata.split_id)
                 continue

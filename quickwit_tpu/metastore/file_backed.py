@@ -92,11 +92,14 @@ class FileBackedMetastore(Metastore):
         """Invalidate the polling cache: the next read of the manifest or
         any index state re-fetches from storage, making other nodes'
         committed writes visible NOW (the GC orphan scan depends on this
-        to never treat a just-staged split as an orphan)."""
+        to never treat a just-staged split as an orphan). The cache is
+        DROPPED, not just aged, so the contract also holds with
+        polling_interval_secs=None (whose freshness check would otherwise
+        serve any cached state forever)."""
         with self._lock:
+            self._manifest = None
             self._manifest_loaded_at = 0.0
-            for state in self._states.values():
-                state.loaded_at = float("-inf")
+            self._states.clear()
 
     # --- manifest ----------------------------------------------------------
     def _load_manifest(self) -> dict[str, str]:

@@ -73,11 +73,12 @@ def sigv4_headers(method: str, host: str, canonical_uri: str,
                   query: list[tuple[str, str]], payload_sha256: str,
                   config: S3Config,
                   now: Optional[datetime.datetime] = None,
-                  extra_headers: Optional[dict[str, str]] = None
-                  ) -> dict[str, str]:
+                  extra_headers: Optional[dict[str, str]] = None,
+                  service: str = "s3") -> dict[str, str]:
     """AWS Signature Version 4 for one request. Returns the headers to
     send (including Authorization). Exposed for direct testing against
-    the published AWS test vectors."""
+    the published AWS test vectors, and reused by other AWS-API clients
+    (Kinesis source) via `service`."""
     now = now or datetime.datetime.now(datetime.timezone.utc)
     amz_date = now.strftime("%Y%m%dT%H%M%SZ")
     datestamp = now.strftime("%Y%m%d")
@@ -101,14 +102,14 @@ def sigv4_headers(method: str, host: str, canonical_uri: str,
         method, canonical_uri, canonical_query, canonical_headers,
         signed_headers, payload_sha256])
 
-    scope = f"{datestamp}/{config.region}/s3/aws4_request"
+    scope = f"{datestamp}/{config.region}/{service}/aws4_request"
     string_to_sign = "\n".join([
         "AWS4-HMAC-SHA256", amz_date, scope,
         hashlib.sha256(canonical_request.encode()).hexdigest()])
 
     key = _sign(f"AWS4{config.secret_key}".encode(), datestamp)
     key = _sign(key, config.region)
-    key = _sign(key, "s3")
+    key = _sign(key, service)
     key = _sign(key, "aws4_request")
     signature = hmac.new(key, string_to_sign.encode(),
                          hashlib.sha256).hexdigest()
