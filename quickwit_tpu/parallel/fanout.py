@@ -42,7 +42,7 @@ from ..search.models import LeafSearchResponse, PartialHit, SearchRequest
 from ..search.plan import BucketAggExec, LoweredPlan, MetricAggExec, lower_request
 from ..search import executor as executor_mod
 from ..search.leaf import (
-    _intermediate_aggs, _sort_values_are_int, decode_raw_sort_value,
+    _intermediate_aggs, _sort_values_are_int, decode_sort_value_exact,
 )
 
 
@@ -69,6 +69,8 @@ class SplitBatch:
     doc_mapper: DocMapper
     sort_field: str
     sort_order: str
+    sort2_field: Optional[str] = None     # secondary sort key (2-key sorts)
+    sort2_order: str = "desc"
     readers: list[SplitReader] = None  # for exact int sort-value re-reads
 
     @property
@@ -90,7 +92,14 @@ def _global_agg_overrides(agg_specs, readers: list[SplitReader],
     # unique per level
     expanded: list = []
 
+    from ..query.aggregations import CompositeAgg
+
     def _expand(spec, path):
+        if isinstance(spec, CompositeAgg):
+            # composite is per-split by design (split-local key
+            # encodings) — lowering raises before any override is read,
+            # so computing cross-reader dictionaries here is pure waste
+            return
         expanded.append((spec, path))
         for sub in getattr(spec, "sub_buckets", ()):
             _expand(sub, f"{path}>{sub.name}")
@@ -165,6 +174,7 @@ def build_batch(request: SearchRequest, doc_mapper: DocMapper,
     sort = request.sort_fields[0] if request.sort_fields else None
     sort_field = sort.field if sort else "_score"
     sort_order = sort.order if sort else "desc"
+    sort2 = request.sort_fields[1] if len(request.sort_fields) > 1 else None
 
     num_docs_padded = max(r.num_docs_padded for r in readers)
     plans: list[LoweredPlan] = []
@@ -172,6 +182,8 @@ def build_batch(request: SearchRequest, doc_mapper: DocMapper,
         plan = lower_request(
             request.query_ast, doc_mapper, reader, agg_specs,
             sort_field=sort_field, sort_order=sort_order,
+            sort2_field=sort2.field if sort2 else None,
+            sort2_order=sort2.order if sort2 else "desc",
             start_timestamp=request.start_timestamp,
             end_timestamp=request.end_timestamp,
             batch_overrides=overrides,
@@ -228,6 +240,8 @@ def build_batch(request: SearchRequest, doc_mapper: DocMapper,
         template=template, arrays=stacked_arrays, scalars=stacked_scalars,
         num_docs=num_docs, split_ids=ids, num_docs_padded=num_docs_padded,
         doc_mapper=doc_mapper, sort_field=sort_field, sort_order=sort_order,
+        sort2_field=sort2.field if sort2 else None,
+        sort2_order=sort2.order if sort2 else "desc",
         readers=list(readers),
     )
 
@@ -289,23 +303,29 @@ def batch_fn(batch: SplitBatch, k: int):
 
     def fn(arrays, scalars, num_docs):
         results = jax.vmap(single_fn)(arrays, scalars, num_docs)
-        # batches are single-sort-key only (service routes 2-key requests to
-        # the per-split path), so sort_vals2 is always None here
-        sort_vals, _sort_vals2, doc_ids, hit_scores, counts, agg_out = results
+        sort_vals, sort_vals2, doc_ids, hit_scores, counts, agg_out = results
         total = jnp.sum(counts)
         if k == 0:  # count/agg-only: no cross-split hit merge
             empty_i = jnp.zeros((0,), jnp.int32)
-            return (jnp.zeros((0,), sort_vals.dtype), empty_i, empty_i,
+            return (jnp.zeros((0,), sort_vals.dtype), None, empty_i, empty_i,
                     jnp.zeros((0,), hit_scores.dtype), total,
                     _merge_agg_stack(agg_out))
         # flatten [n, k] → [n*k]; split-major order keeps the
         # (key desc, split asc, doc asc) tie-break of the collector
-        top_vals, pos = jax.lax.top_k(sort_vals.reshape(-1), k)
+        if sort_vals2 is None:
+            top_vals, pos = jax.lax.top_k(sort_vals.reshape(-1), k)
+            top_vals2 = None
+        else:
+            # 2-key sorts: lexicographic cross-split re-top-k (the same
+            # kernel the per-split path uses, over the flattened winners)
+            from ..ops import topk as topk_ops
+            top_vals, top_vals2, pos = topk_ops.exact_topk_2key(
+                sort_vals.reshape(-1), sort_vals2.reshape(-1), k)
         split_idx = (pos // k).astype(jnp.int32)
         flat_ids = doc_ids.reshape(-1)[pos]
         flat_scores = hit_scores.reshape(-1)[pos]
-        return top_vals, split_idx, flat_ids, flat_scores, total, \
-            _merge_agg_stack(agg_out)
+        return top_vals, top_vals2, split_idx, flat_ids, flat_scores, \
+            total, _merge_agg_stack(agg_out)
 
     return fn
 
@@ -385,13 +405,24 @@ def execute_batch(batch: SplitBatch, request: SearchRequest,
         leaves.append(packed[offset: offset + size]
                       .astype(dtype).reshape(shape))
         offset += size
-    top_vals, split_idx, doc_ids, scores, total, merged_aggs = \
+    top_vals, top_vals2, split_idx, doc_ids, scores, total, merged_aggs = \
         jax.tree_util.tree_unflatten(treedef, leaves)
 
     num_hits = int(total)
     hits: list[PartialHit] = []
     sort_is_int = _sort_values_are_int(batch.doc_mapper, batch.sort_field)
-    exact_cols: dict[int, Any] = {}
+    sort2_is_int = (_sort_values_are_int(batch.doc_mapper, batch.sort2_field)
+                    if batch.sort2_field else False)
+    exact_cols: dict[tuple, Any] = {}
+
+    def exact_col(si: int, field: str, is_int: bool):
+        if not is_int or batch.readers is None:
+            return None
+        if (si, field) not in exact_cols:
+            exact_cols[(si, field)] = \
+                batch.readers[si].column_values(field)[0]
+        return exact_cols[(si, field)]
+
     for i in range(min(k, num_hits)):
         internal = float(top_vals[i])
         if internal == float("-inf"):
@@ -400,16 +431,21 @@ def execute_batch(batch: SplitBatch, request: SearchRequest,
         split_id = batch.split_ids[si]
         if split_id == "":
             continue
-        raw = decode_raw_sort_value(internal, batch.sort_field, batch.sort_order,
-                                    sort_is_int, scores[i], int(doc_ids[i]))
-        if raw is not None and sort_is_int and batch.readers is not None:
-            # exact 64-bit value from the column (f64 keys round at 2^53)
-            if si not in exact_cols:
-                exact_cols[si] = batch.readers[si].column_values(
-                    batch.sort_field)[0]
-            raw = int(exact_cols[si][int(doc_ids[i])])
+        doc_id = int(doc_ids[i])
+        raw = decode_sort_value_exact(
+            internal, batch.sort_field, batch.sort_order, sort_is_int,
+            scores[i], doc_id, exact_col(si, batch.sort_field, sort_is_int))
+        internal2, raw2 = 0.0, None
+        if batch.sort2_field is not None and top_vals2 is not None:
+            internal2 = float(top_vals2[i])
+            raw2 = decode_sort_value_exact(
+                internal2, batch.sort2_field, batch.sort2_order,
+                sort2_is_int, scores[i], doc_id,
+                exact_col(si, batch.sort2_field, sort2_is_int))
         hits.append(PartialHit(sort_value=internal, split_id=split_id,
-                               doc_id=int(doc_ids[i]), raw_sort_value=raw))
+                               doc_id=doc_id, raw_sort_value=raw,
+                               sort_value2=internal2,
+                               raw_sort_value2=raw2))
 
     intermediate = _intermediate_aggs(batch.template, list(merged_aggs))
     real_splits = sum(1 for s in batch.split_ids if s)

@@ -121,6 +121,7 @@ class CompositeAgg:
     size: int = 10
     after: Optional[tuple[Any, ...]] = None  # decoded per-source values
     sub_metrics: tuple[MetricAgg, ...] = ()
+    sub_buckets: tuple["AggSpec", ...] = ()
 
 
 AggSpec = Any  # union of the dataclasses above
@@ -276,16 +277,12 @@ def _parse_one(name: str, body: dict[str, Any], depth: int = 0) -> AggSpec:
         if depth > 0:
             raise AggParseError(
                 f"composite aggregation {name!r} must be top-level")
-        if sub_buckets:
-            raise AggParseError(
-                f"composite aggregation {name!r}: bucket aggregations "
-                "under composite are not supported yet")
         for metric in sub_metrics:
             if metric.kind in ("percentiles", "cardinality"):
                 raise AggParseError(
                     f"composite aggregation {name!r}: {metric.kind} under "
                     "composite is not supported yet")
-        return _parse_composite(name, params, sub_metrics)
+        return _parse_composite(name, params, sub_metrics, sub_buckets)
     if kind in _METRIC_KINDS:
         if sub_metrics or sub_buckets:
             raise AggParseError(f"metric aggregation {name!r} cannot have sub-aggs")
@@ -327,7 +324,8 @@ def _decode_after_value(value: Any, source_kind: str) -> Any:
 
 
 def _parse_composite(name: str, params: dict[str, Any],
-                     sub_metrics: tuple = ()) -> "CompositeAgg":
+                     sub_metrics: tuple = (),
+                     sub_buckets: tuple = ()) -> "CompositeAgg":
     raw_sources = params.get("sources")
     if not raw_sources or not isinstance(raw_sources, list):
         raise AggParseError(
@@ -387,7 +385,8 @@ def _parse_composite(name: str, params: dict[str, Any],
         raise AggParseError(
             f"composite {name!r}: size must be in [1, 4096]")
     return CompositeAgg(name=name, sources=tuple(sources), size=size,
-                        after=after, sub_metrics=sub_metrics)
+                        after=after, sub_metrics=sub_metrics,
+                        sub_buckets=sub_buckets)
 
 
 def parse_aggs(aggs: dict[str, Any]) -> list[AggSpec]:

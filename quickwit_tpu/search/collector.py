@@ -126,25 +126,12 @@ class IncrementalCollector:
             _merge_bucket_maps(current["bucket_map"], _range_to_map(state))
         elif kind == "composite":
             bucket_map = current["bucket_map"]
-            for key, bucket in _composite_pairs(state):
-                cur = bucket_map.get(key)
-                if isinstance(cur, int):  # pre-metrics wire shape
-                    cur = {"doc_count": cur, "metrics": {}}
-                    bucket_map[key] = cur
-                if cur is None:
-                    bucket_map[key] = bucket
-                    continue
-                cur["doc_count"] += bucket["doc_count"]
-                for mname, acc in bucket["metrics"].items():
-                    cacc = cur["metrics"].get(mname)
-                    if cacc is None:
-                        cur["metrics"][mname] = acc
-                    else:
-                        cacc["sum"] += acc["sum"]
-                        cacc["count"] += acc["count"]
-                        cacc["min"] = min(cacc["min"], acc["min"])
-                        cacc["max"] = max(cacc["max"], acc["max"])
-                        cacc["sum_sq"] += acc["sum_sq"]
+            for key, bucket in bucket_map.items():
+                if isinstance(bucket, int):  # pre-metrics wire shape
+                    bucket_map[key] = {"doc_count": bucket, "metrics": {}}
+            # buckets (and their nested sub_maps) merge by key tuple with
+            # the same machinery every other bucket kind uses
+            _merge_bucket_maps(bucket_map, dict(_composite_pairs(state)))
         elif kind == "percentiles":
             current["sketch"] = current["sketch"] + state["sketch"]
         elif kind == "cardinality":
@@ -214,6 +201,7 @@ def _copy_state(state: dict[str, Any]) -> dict[str, Any]:
         copy = dict(state)
         copy["bucket_map"] = dict(_composite_pairs(state))
         copy.pop("buckets", None)
+        _carry_sub_info(copy, state)
         return copy
     return dict(state)
 
@@ -240,8 +228,13 @@ def _composite_pairs(state: dict[str, Any]):
                             if k in ("sum", "count", "min", "max",
                                      "sum_sq")})
                 metrics[name] = acc
-        out.append((tuple(values), {"doc_count": count,
-                                    "metrics": metrics}))
+        bucket = {"doc_count": count, "metrics": metrics}
+        if len(entry) > 3 and state.get("subs"):
+            # entry[3] is this bucket's run index into the flattened
+            # child states: decode its nested children like any other
+            # parent bucket kind
+            _attach_sub_maps(bucket, state, int(entry[3]))
+        out.append((tuple(values), bucket))
     return out
 
 
@@ -253,6 +246,10 @@ def _composite_order_key(key_tuple):
 def _finalize_composite(state: dict[str, Any]) -> dict[str, Any]:
     bucket_map = (state["bucket_map"] if "bucket_map" in state
                   else dict(_composite_pairs(state)))
+    if "sub_infos" not in state and state.get("subs"):
+        # finalizing a raw (never-merged) leaf state directly
+        state = {**state,
+                 "sub_infos": [_sub_info_of(s) for s in state["subs"]]}
     ordered = sorted(bucket_map.items(),
                      key=lambda kv: _composite_order_key(kv[0]))
     ordered = ordered[: state["size"]]
@@ -269,6 +266,10 @@ def _finalize_composite(state: dict[str, Any]) -> dict[str, Any]:
         entry = {"key": key, "doc_count": int(bucket["doc_count"])}
         for mname, acc in bucket["metrics"].items():
             entry[mname] = _finalize_metric(acc)
+        for child_info in (state.get("sub_infos") or ()):
+            entry[child_info["name"]] = _finalize_bucket_map(
+                bucket.get("sub_maps", {}).get(child_info["name"], {}),
+                child_info, child_info.get("sub_infos"))
         buckets.append(entry)
     out: dict[str, Any] = {"buckets": buckets}
     if buckets:

@@ -405,18 +405,22 @@ def _eval_composite_agg(a: CompositeAggExec, arrays, scalars, mask):
     sentinel = jnp.int32(2**31 - 1)
     keys = [jnp.where(m, key, sentinel) for key in keys]
     # metric operands ride the same sort so per-run (bucket) metric
-    # states segment-reduce over contiguous ranges
+    # states segment-reduce over contiguous ranges; the position index
+    # rides along too, recovering the permutation that lets bucket
+    # CHILDREN evaluate back in doc space
     metric_ops: list = []
     for met in a.metrics:
         mv = arrays[met.values_slot].astype(jnp.float64)
         mp = arrays[met.present_slot].astype(jnp.bool_)
         metric_ops.extend([mv, mp & m])
-    sorted_all = jax.lax.sort(tuple(keys) + tuple(metric_ops),
+    positions = jnp.arange(num, dtype=jnp.int32)
+    sorted_all = jax.lax.sort(tuple(keys) + (positions,) + tuple(metric_ops),
                               num_keys=len(keys))
     if not isinstance(sorted_all, (tuple, list)):
         sorted_all = (sorted_all,)
     sorted_keys = sorted_all[: len(keys)]
-    sorted_metrics = sorted_all[len(keys):]
+    perm = sorted_all[len(keys)]
+    sorted_metrics = sorted_all[len(keys) + 1:]
     valid_total = jnp.sum(m.astype(jnp.int32))
     idxs = jnp.arange(num, dtype=jnp.int32)
     diff = jnp.zeros(max(num - 1, 0), dtype=jnp.bool_)
@@ -438,11 +442,28 @@ def _eval_composite_agg(a: CompositeAggExec, arrays, scalars, mask):
     counts = jnp.where(starts[:k_runs] < valid_total,
                        ends - starts[:k_runs], jnp.int32(0))
     out = {"run_keys": run_keys, "counts": counts}
+    # per-position run id = rank of this position's run among the first
+    # k_runs (positions past them segment-drop)
+    run_id = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+    in_range = (idxs < valid_total) & (run_id >= 0) & (run_id < k_runs)
+    if a.subs:
+        # scatter each doc's run id back to its original position: bucket
+        # children then evaluate with the normal nested machinery, the
+        # composite acting as the outermost radix level
+        run_id_doc = jnp.full(num, k_runs, jnp.int32).at[perm].set(
+            jnp.where(in_range, run_id, jnp.int32(k_runs)))
+        in_run = run_id_doc < k_runs
+        subs = []
+        for child in a.subs:
+            nb2 = child.num_buckets
+            idx2, m2 = _bucket_idx(child, arrays, scalars, mask)
+            both = in_run & m2
+            combined = jnp.where(both, run_id_doc * nb2 + idx2,
+                                 jnp.int32(k_runs * nb2))
+            subs.append(_eval_bucket_level(child, arrays, scalars, mask,
+                                           combined, both, k_runs * nb2))
+        out["subs"] = subs
     if a.metrics:
-        # per-position run id = rank of this position's run among the
-        # first k_runs (positions past them segment-drop)
-        run_id = jnp.cumsum(is_start.astype(jnp.int32)) - 1
-        in_range = (idxs < valid_total) & (run_id >= 0) & (run_id < k_runs)
         metrics: dict[str, Any] = {}
         for mi, met in enumerate(a.metrics):
             mv = sorted_metrics[2 * mi]

@@ -48,6 +48,19 @@ def decode_raw_sort_value(internal: float, sort_field: str, sort_order: str,
     return int(raw) if sort_is_int else raw
 
 
+def decode_sort_value_exact(internal: float, sort_field: str,
+                            sort_order: str, sort_is_int: bool,
+                            score: float, doc_id: int, exact_col):
+    """`decode_raw_sort_value` + the exact 64-bit column re-read for int
+    sorts (internal f64 keys round at 2^53) — the one decode used for
+    primary AND secondary keys on both the per-split and batched paths."""
+    raw = decode_raw_sort_value(internal, sort_field, sort_order,
+                                sort_is_int, score, doc_id)
+    if raw is not None and sort_is_int and exact_col is not None:
+        return int(exact_col[doc_id])
+    return raw
+
+
 def _device_cache(reader: SplitReader) -> dict[str, Any]:
     cache = getattr(reader, "_device_array_cache", None)
     if cache is None:
@@ -208,19 +221,15 @@ def execute_prepared_split(
                 ordinal = int(internal if sort_order == "desc" else -internal)
                 raw = text_dict[ordinal]
         else:
-            raw = decode_raw_sort_value(internal, sort_field, sort_order,
-                                        sort_is_int, result["scores"][i],
-                                        doc_id)
-            if raw is not None and exact_col is not None:
-                raw = int(exact_col[doc_id])
+            raw = decode_sort_value_exact(
+                internal, sort_field, sort_order, sort_is_int,
+                result["scores"][i], doc_id, exact_col)
         internal2, raw2 = 0.0, None
         if sort2 is not None and values2 is not None:
             internal2 = float(values2[i])
-            raw2 = decode_raw_sort_value(internal2, sort2.field, sort2.order,
-                                         sort2_is_int, result["scores"][i],
-                                         doc_id)
-            if raw2 is not None and exact_col2 is not None:
-                raw2 = int(exact_col2[doc_id])
+            raw2 = decode_sort_value_exact(
+                internal2, sort2.field, sort2.order, sort2_is_int,
+                result["scores"][i], doc_id, exact_col2)
         partial_hits.append(PartialHit(
             sort_value=internal, split_id=split_id, doc_id=doc_id,
             raw_sort_value=raw, sort_value2=internal2, raw_sort_value2=raw2))
@@ -409,20 +418,29 @@ def _intermediate_aggs(plan, agg_results: list) -> dict[str, Any]:
                     else:  # histogram kinds decode to absolute keys
                         values.append(info["origin"] + idx * info["interval"])
                 entry = [values, int(counts[j])]
-                if res_metrics:
+                if res_metrics or a.subs:
                     entry.append({
                         name: {k: (float(v[j]) if k != "count"
                                    else int(v[j]))
                                for k, v in state.items()}
                         for name, state in res_metrics.items()})
+                if a.subs:
+                    # run index: the collector decodes this bucket's
+                    # children out of the flattened child states below
+                    entry.append(j)
                 buckets.append(entry)
-            out[a.name] = {
+            state_out = {
                 "kind": "composite", "buckets": buckets,
                 "size": a.host_info["size"],
                 "metric_kinds": dict(metric_kinds),
                 "sources": [{"name": i["name"], "kind": i["kind"]}
                             for i in src_infos],
             }
+            if a.subs and "subs" in res:
+                state_out["subs"] = [
+                    _sub_state(child, child_res)
+                    for child, child_res in zip(a.subs, res["subs"])]
+            out[a.name] = state_out
         elif isinstance(a, MetricAggExec):
             met = a.metric
             if met.kind == "percentiles":

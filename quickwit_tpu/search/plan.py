@@ -219,18 +219,26 @@ class CompositeAggExec:
     """`composite` lowered TPU-first: per-source i32 key planes, one
     multi-key `lax.sort` over the doc space, run-boundary detection, and a
     static-size readback of the first `size` distinct key tuples + counts
-    (role of tantivy's composite collector driven via `collector.rs:523`)."""
+    (role of tantivy's composite collector driven via `collector.rs:523`).
+
+    Bucket children (`subs`) evaluate in DOC space: the sort permutation
+    scatters each doc's run id (composite bucket index) back to its
+    original position, and the normal nested-bucket evaluator runs with
+    the composite as the outermost radix level (child flat index =
+    run_id * child_nb + child_local)."""
     name: str
     sources: tuple[CompositeSourceExec, ...]
     size: int
     has_after: bool
     metrics: tuple["MetricSlots", ...] = ()
+    subs: tuple["BucketAggExec", ...] = ()
     host_info: Any = None     # per-source decode info (not jit-relevant)
 
     def sig(self) -> str:
         return (f"cagg({self.size},{int(self.has_after)},"
                 + ",".join(s.sig() for s in self.sources) + ";"
-                + ",".join(m.sig() for m in self.metrics) + ")")
+                + ",".join(m.sig() for m in self.metrics) + ";"
+                + ",".join(s.sig() for s in self.subs) + ")")
 
 
 def aligned_origin(vmin, interval, offset=0):
@@ -1141,10 +1149,22 @@ class Lowering:
             after_val = spec.after[si] if spec.after is not None else None
             execs.append(self._lower_composite_source(
                 spec.name, src, spec.after is not None, after_val, infos))
+        children = []
+        for sub_spec in getattr(spec, "sub_buckets", ()):
+            child = self._lower_bucket_tree(
+                sub_spec, f"{spec.name}>{sub_spec.name}",
+                parent_space=spec.size)
+            if child.kind == "terms_mv":
+                raise PlanError(
+                    "multivalued terms aggs cannot nest under composite "
+                    "(pair arrays and doc-space buckets have different "
+                    "shapes)")
+            children.append(child)
         return CompositeAggExec(
             name=spec.name, sources=tuple(execs), size=spec.size,
             has_after=spec.after is not None,
             metrics=self._metric_tuple(spec.sub_metrics),
+            subs=tuple(children),
             host_info={"sources": infos, "size": spec.size,
                        "metric_kinds": {m.name: m.kind
                                         for m in spec.sub_metrics}})

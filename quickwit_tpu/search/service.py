@@ -391,11 +391,11 @@ class SearchService:
         """Stage 1 (prefetch-thread-safe): storage IO, plan lowering, and
         the async H2D transfer for one split group. Returns an opaque
         prepared unit for `_execute_group`."""
-        # the batch path has no search_after pushdown, secondary sort, or
-        # per-split terms truncation; the per-split path handles those
+        # the batch path has no search_after pushdown or per-split terms
+        # truncation; the per-split path handles those (2-key sorts ride
+        # the batch via the lexicographic cross-split re-top-k)
         import json as _json
         if (len(group) > 1 and not search_request.search_after
-                and len(search_request.sort_fields) < 2
                 and string_sort_of(search_request, doc_mapper) is None
                 and not any(key in _json.dumps(search_request.aggs or {})
                             for key in ("split_size", "shard_size",

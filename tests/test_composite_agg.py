@@ -190,19 +190,65 @@ def test_composite_parse_errors():
         parse_aggs({"c": {"composite": {
             "sources": [{"x": {"terms": {"field": "f"}}}],
             "after": {"wrong_name": 1}}}})
-    # metric sub-aggs are supported; BUCKET children are not
+    # metric AND bucket sub-aggs are both supported
     spec = parse_aggs({"c": {"composite": {"sources": [
         {"x": {"terms": {"field": "f"}}}]},
-        "aggs": {"m": {"avg": {"field": "g"}}}}})[0]
+        "aggs": {"m": {"avg": {"field": "g"}},
+                 "t": {"terms": {"field": "h"}}}}})[0]
     assert spec.sub_metrics[0].kind == "avg"
-    with pytest.raises(AggParseError):
-        parse_aggs({"c": {"composite": {"sources": [
-            {"x": {"terms": {"field": "f"}}}]},
-            "aggs": {"t": {"terms": {"field": "g"}}}}})
+    assert spec.sub_buckets[0].name == "t"
     with pytest.raises(AggParseError):  # percentiles under composite
         parse_aggs({"c": {"composite": {"sources": [
             {"x": {"terms": {"field": "f"}}}]},
             "aggs": {"p": {"percentiles": {"field": "g"}}}}})
+
+
+def test_composite_bucket_children_exact(split_readers):
+    """Bucket children under composite (terms child with its own metric),
+    exact vs brute force, including the cross-split merge where run
+    indices differ per split and buckets align by key tuple."""
+    aggs = {"c": {
+        "composite": {"size": 100, "sources": [
+            {"host": {"terms": {"field": "host",
+                                "missing_bucket": True}}}]},
+        "aggs": {"by_name": {
+            "terms": {"field": "name", "size": 20},
+            "aggs": {"r_sum": {"sum": {"field": "response"}}}}}}}
+    result = _search(aggs, split_readers)["c"]
+    assert result["buckets"]
+    seen_hosts = set()
+    for b in result["buckets"]:
+        host = b["key"]["host"]
+        seen_hosts.add(host)
+        docs = [d for d in DOCS if d.get("host") == host]
+        assert b["doc_count"] == len(docs)
+        child = b["by_name"]["buckets"]
+        by_name = {cb["key"]: cb for cb in child}
+        names = {d["name"] for d in docs}
+        assert set(by_name) == names
+        for name in names:
+            sel = [d for d in docs if d["name"] == name]
+            assert by_name[name]["doc_count"] == len(sel)
+            assert by_name[name]["r_sum"]["value"] == pytest.approx(
+                sum(d.get("response", 0.0) for d in sel))
+    assert seen_hosts == {None, "192.168.0.1", "192.168.0.10",
+                          "192.168.0.11"}
+
+
+def test_composite_date_histogram_child(single_reader):
+    """A date_histogram child under a composite terms source."""
+    aggs = {"c": {
+        "composite": {"size": 100, "sources": [
+            {"name": {"terms": {"field": "name"}}}]},
+        "aggs": {"days": {"date_histogram": {
+            "field": "ts", "fixed_interval": "1d"}}}}}
+    result = _search(aggs, [single_reader])["c"]
+    fritz = next(b for b in result["buckets"]
+                 if b["key"]["name"] == "Fritz")
+    days = fritz["days"]["buckets"]
+    total = sum(b["doc_count"] for b in days)
+    assert total == 3  # all Fritz docs on day one
+    assert len([b for b in days if b["doc_count"]]) == 1
 
 
 def test_composite_metric_sub_aggs_exact(split_readers):

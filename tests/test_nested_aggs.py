@@ -156,8 +156,10 @@ def test_nested_bucket_space_capped():
         leaf_search_single_split(request, MAPPER, reader, "wide")
 
 
-def test_composite_still_rejects_bucket_sub_aggs():
-    with pytest.raises(AggParseError):
-        parse_aggs({"c": {"composite": {"sources": [
-            {"s": {"terms": {"field": "service"}}}]},
-            "aggs": {"t": {"terms": {"field": "level"}}}}})
+def test_composite_accepts_bucket_sub_aggs():
+    # bucket children under composite are supported (round-4 directive
+    # #8); exactness is covered in test_composite_agg.py
+    spec = parse_aggs({"c": {"composite": {"sources": [
+        {"s": {"terms": {"field": "service"}}}]},
+        "aggs": {"t": {"terms": {"field": "level"}}}}})[0]
+    assert spec.sub_buckets[0].name == "t"

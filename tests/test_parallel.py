@@ -112,6 +112,15 @@ REQUESTS = [
     SearchRequest(index_ids=["x"], query_ast=FullText("body", "beta", "or"),
                   max_hits=0,
                   aggs={"sev": {"terms": {"field": "severity_text"}}}),
+    # 2-key sorts ride the batch path (lexicographic cross-split re-top-k);
+    # tenant_id has heavy ties so the secondary key genuinely decides
+    SearchRequest(index_ids=["x"], query_ast=MatchAll(), max_hits=8,
+                  sort_fields=(SortField("tenant_id", "asc"),
+                               SortField("timestamp", "desc"))),
+    SearchRequest(index_ids=["x"],
+                  query_ast=Term("severity_text", "ERROR"), max_hits=6,
+                  sort_fields=(SortField("timestamp", "desc"),
+                               SortField("latency", "asc"))),
 ]
 
 
@@ -122,11 +131,17 @@ def test_batch_matches_sequential_merge(readers, req_idx):
     got = batch_result(request, readers)
 
     assert got.num_hits == expected.num_hits
-    exp_hits = [(h.split_id, h.doc_id, h.sort_value) for h in expected.partial_hits()]
-    got_hits = [(h.split_id, h.doc_id, h.sort_value) for h in got.partial_hits]
-    assert [(s, d) for s, d, _ in got_hits] == [(s, d) for s, d, _ in exp_hits]
-    for (_, _, gv), (_, _, ev) in zip(got_hits, exp_hits):
+    exp_hits = [(h.split_id, h.doc_id, h.sort_value, h.sort_value2,
+                 h.raw_sort_value2) for h in expected.partial_hits()]
+    got_hits = [(h.split_id, h.doc_id, h.sort_value, h.sort_value2,
+                 h.raw_sort_value2) for h in got.partial_hits]
+    assert [(s, d) for s, d, *_ in got_hits] == \
+        [(s, d) for s, d, *_ in exp_hits]
+    for (_, _, gv, gv2, gr2), (_, _, ev, ev2, er2) in zip(got_hits, exp_hits):
         assert gv == pytest.approx(ev, rel=1e-5)
+        assert gv2 == pytest.approx(ev2, rel=1e-5)
+        if er2 is not None and isinstance(er2, int):
+            assert gr2 == er2
 
     if request.aggs:
         exp_aggs = finalize_aggregations(expected.aggregation_states())
