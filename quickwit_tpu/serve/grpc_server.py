@@ -194,7 +194,8 @@ class GrpcServer:
     """gRPC endpoint for one node: OTLP collector services + the Jaeger
     span reader, mounted on the stdlib HTTP/2 server."""
 
-    def __init__(self, node, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 0,
+                 ssl_context=None):
         self.node = node
         self._handlers: dict[str, Callable[[bytes], Iterable[bytes]]] = {
             "/opentelemetry.proto.collector.trace.v1.TraceService/Export":
@@ -222,7 +223,8 @@ class GrpcServer:
             "/quickwit.search.SearchService/Replicate":
                 self._replicate,
         }
-        self._http2 = Http2Server(self._handle, host=host, port=port)
+        self._http2 = Http2Server(self._handle, host=host, port=port,
+                                  ssl_context=ssl_context)
         self.host, self.port = self._http2.host, self._http2.port
 
     def stop(self) -> None:
@@ -386,8 +388,18 @@ class GrpcChannel:
     """Blocking h2c gRPC client: one request per call over a persistent
     connection (raw-literal HPACK — no Huffman, by design)."""
 
-    def __init__(self, host: str, port: int, timeout: float = 15.0):
+    def __init__(self, host: str, port: int, timeout: float = 15.0,
+                 ssl_context=None):
         self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._scheme = "http"
+        if ssl_context is not None:
+            # gRPC-over-TLS — the secure cluster's binary plane; ALPN is
+            # configured by whoever built the context (GrpcSearchClient);
+            # server identity checked per the context's settings
+            self._sock = ssl_context.wrap_socket(
+                self._sock,
+                server_hostname=host if ssl_context.check_hostname else None)
+            self._scheme = "https"
         self._sock.sendall(
             PREFACE + frame(FRAME_SETTINGS, 0, 0, b""))
         self._decoder = HpackDecoder()
@@ -410,7 +422,7 @@ class GrpcChannel:
         with self._lock:
             stream_id = self._stream_id
             self._stream_id += 2
-            headers = [(":method", "POST"), (":scheme", "http"),
+            headers = [(":method", "POST"), (":scheme", self._scheme),
                        (":path", path), (":authority", "localhost"),
                        ("content-type", "application/grpc"), ("te", "trailers")]
             headers.extend(extra_headers)
@@ -471,7 +483,7 @@ class GrpcSearchClient:
 
     def __init__(self, grpc_endpoint: str, rest_endpoint: str,
                  timeout_secs: float = 30.0, **http_kwargs):
-        from .http_client import HttpSearchClient
+        from .http_client import HttpSearchClient, client_ssl_context
         self.endpoint = rest_endpoint
         self.grpc_endpoint = grpc_endpoint
         host, port = grpc_endpoint.rsplit(":", 1)
@@ -480,6 +492,15 @@ class GrpcSearchClient:
         self.http = HttpSearchClient(rest_endpoint,
                                      timeout_secs=timeout_secs, **http_kwargs)
         self.circuit = self.http.circuit
+        # a TLS cluster runs its gRPC plane over TLS too (same CA / mTLS
+        # settings as the REST client); ALPN h2 set once here — the
+        # channel must not re-mutate the context on every reconnect
+        self._channel_ssl = client_ssl_context(**http_kwargs)
+        if self._channel_ssl is not None:
+            try:
+                self._channel_ssl.set_alpn_protocols(["h2"])
+            except NotImplementedError:
+                pass
         self._channel: "GrpcChannel | None" = None
         self._channel_lock = threading.Lock()
 
@@ -497,7 +518,8 @@ class GrpcSearchClient:
                 if self._channel is None:
                     self._channel = GrpcChannel(
                         self._grpc_host, self._grpc_port,
-                        timeout=self.timeout_secs)
+                        timeout=self.timeout_secs,
+                        ssl_context=self._channel_ssl)
                 channel = self._channel
             from ..observability.tracing import TRACER
             from .http2 import Http2Error

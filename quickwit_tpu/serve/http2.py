@@ -224,8 +224,16 @@ class Http2Server:
     trailers)` as they END_STREAM."""
 
     def __init__(self, handler: Callable, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, ssl_context=None):
         self.handler = handler
+        # with an ssl_context the listener speaks HTTP/2 over TLS (h2 via
+        # ALPN) instead of h2c — the TLS-cluster binary plane
+        self._ssl_context = ssl_context
+        if ssl_context is not None:
+            try:
+                ssl_context.set_alpn_protocols(["h2"])
+            except NotImplementedError:
+                pass
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind((host, port))
@@ -252,6 +260,20 @@ class Http2Server:
                              daemon=True).start()
 
     def _connection(self, conn: socket.socket) -> None:
+        if self._ssl_context is not None:
+            # handshake on the connection thread, BOUNDED: a silent or
+            # stalled client must neither wedge the accept loop nor pin
+            # this thread/fd forever (same 10s bound as the REST plane)
+            try:
+                conn.settimeout(10.0)
+                conn = self._ssl_context.wrap_socket(conn, server_side=True)
+                conn.settimeout(None)  # long-lived h2 connection
+            except (OSError, ValueError):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
         state = _ConnState(conn)
 
         def read_exact(n: int) -> bytes:

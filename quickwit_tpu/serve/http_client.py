@@ -32,6 +32,29 @@ class HttpStatusError(HttpTransportError):
         self.body = body
 
 
+def client_ssl_context(tls: bool = False, ca_path: Optional[str] = None,
+                       skip_verify: bool = False,
+                       client_cert_path: Optional[str] = None,
+                       client_key_path: Optional[str] = None
+                       ) -> Optional[ssl.SSLContext]:
+    """Peer-facing TLS context (role of quickwit-transport's rustls client
+    side), shared by the JSON/HTTP client and the gRPC channel: `ca_path`
+    pins the cluster CA for self-signed deployments; `skip_verify` is for
+    tests only; a client cert is the mTLS identity toward verify-client
+    peers."""
+    if not tls:
+        return None
+    if skip_verify:
+        context = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        context.check_hostname = False
+        context.verify_mode = ssl.CERT_NONE
+    else:
+        context = ssl.create_default_context(cafile=ca_path)
+    if client_cert_path:
+        context.load_cert_chain(client_cert_path, client_key_path)
+    return context
+
+
 class HttpSearchClient:
     def __init__(self, endpoint: str, timeout_secs: float = 30.0,
                  tls: bool = False, ca_path: Optional[str] = None,
@@ -43,21 +66,8 @@ class HttpSearchClient:
         self.host = host
         self.port = int(port)
         self.timeout_secs = timeout_secs
-        # TLS toward peers (role of quickwit-transport's rustls client side):
-        # `ca_path` pins the cluster CA for self-signed deployments;
-        # `skip_verify` is for tests only.
-        self._ssl_context: Optional[ssl.SSLContext] = None
-        if tls:
-            if skip_verify:
-                context = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
-                context.check_hostname = False
-                context.verify_mode = ssl.CERT_NONE
-            else:
-                context = ssl.create_default_context(cafile=ca_path)
-            if client_cert_path:
-                # mTLS identity toward verify-client peers
-                context.load_cert_chain(client_cert_path, client_key_path)
-            self._ssl_context = context
+        self._ssl_context = client_ssl_context(
+            tls, ca_path, skip_verify, client_cert_path, client_key_path)
         # stop hammering a dead peer; root search fails fast to its retry
         # path instead of stacking timeouts (reference tower circuit breaker)
         self.circuit = CircuitBreaker(

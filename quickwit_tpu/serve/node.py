@@ -106,6 +106,24 @@ class NodeConfig:
     def tls_enabled(self) -> bool:
         return self.tls_cert_path is not None and self.tls_key_path is not None
 
+    def server_ssl_context(self):
+        """Server-side TLS context (role of quickwit-transport's rustls
+        server config), shared by the REST listener and the gRPC plane."""
+        if not self.tls_enabled:
+            return None
+        import ssl
+        context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        context.load_cert_chain(self.tls_cert_path, self.tls_key_path)
+        if self.tls_verify_client:
+            if not self.tls_ca_path:
+                raise ValueError(
+                    "rest.tls.verify_client requires rest.tls.ca_path "
+                    "(the CA that signs peer client certificates)")
+            # mTLS: only peers holding a CA-signed client cert connect
+            context.verify_mode = ssl.CERT_REQUIRED
+            context.load_verify_locations(cafile=self.tls_ca_path)
+        return context
+
     def client_tls_kwargs(self) -> dict:
         """kwargs for HttpSearchClient toward peers of this cluster."""
         if not self.tls_enabled:
@@ -344,8 +362,9 @@ class Node:
         self.grpc_server = None
         if config.grpc_port is not None:
             from .grpc_server import GrpcServer
-            self.grpc_server = GrpcServer(self, host=config.rest_host,
-                                          port=config.grpc_port)
+            self.grpc_server = GrpcServer(
+                self, host=config.rest_host, port=config.grpc_port,
+                ssl_context=config.server_ssl_context())
         # standalone compactor role (reference quickwit-compaction):
         # planner + bounded supervisor; when any alive compactor exists,
         # indexers stop running merges themselves
@@ -398,20 +417,23 @@ class Node:
 
     # ------------------------------------------------------------------
     def _grpc_advertise(self) -> str:
-        """This node's gRPC endpoint for peers ("" when disabled or when
-        the cluster runs TLS — the gRPC plane is h2c)."""
-        if self.grpc_server is None or self.config.tls_enabled:
+        """This node's gRPC endpoint for peers ("" when disabled). A TLS
+        cluster advertises too — the gRPC plane runs h2-over-TLS with the
+        same cert/CA/mTLS settings as the REST listener."""
+        if self.grpc_server is None:
             return ""
         return f"{self.config.rest_host}:{self.grpc_server.port}"
 
     def _make_peer_client(self, member: ClusterMember):
         """Search client for one peer: the gRPC plane (binary payloads on a
         persistent HTTP/2 connection — the reference's codegen'd tonic
-        client role) when the peer advertises it, JSON/HTTP otherwise."""
-        if member.grpc_endpoint and not self.config.tls_enabled:
+        client role) when the peer advertises it, JSON/HTTP otherwise.
+        Under TLS both planes carry the cluster's TLS settings."""
+        if member.grpc_endpoint:
             from .grpc_server import GrpcSearchClient
             return GrpcSearchClient(member.grpc_endpoint,
-                                    member.rest_endpoint)
+                                    member.rest_endpoint,
+                                    **self.config.client_tls_kwargs())
         from .http_client import HttpSearchClient
         return HttpSearchClient(member.rest_endpoint,
                                 **self.config.client_tls_kwargs())
@@ -1100,8 +1122,10 @@ class Node:
         if self.grpc_server is None and self.config.grpc_port is not None:
             # stop/start cycles recreate the listener (stop tears it down)
             from .grpc_server import GrpcServer
-            self.grpc_server = GrpcServer(self, host=self.config.rest_host,
-                                          port=self.config.grpc_port)
+            self.grpc_server = GrpcServer(
+                self, host=self.config.rest_host,
+                port=self.config.grpc_port,
+                ssl_context=self.config.server_ssl_context())
         stop = self._bg_stop = threading.Event()
 
         def owns_index(index_uid: str) -> bool:

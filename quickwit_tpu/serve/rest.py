@@ -150,22 +150,11 @@ class RestServer:
         self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
         config = self.node.config
         if config.tls_enabled:
-            # role of quickwit-transport's rustls server side: terminate
-            # TLS on the REST listener (REST + internal RPC share it).
-            # Handshake is deferred to the per-connection handler thread
+            # terminate TLS on the REST listener. Handshake is deferred
+            # to the per-connection handler thread
             # (do_handshake_on_connect=False): a client that connects and
             # never speaks must not wedge the shared accept loop.
-            import ssl
-            context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-            context.load_cert_chain(config.tls_cert_path, config.tls_key_path)
-            if config.tls_verify_client:
-                if not config.tls_ca_path:
-                    raise ValueError(
-                        "rest.tls.verify_client requires rest.tls.ca_path "
-                        "(the CA that signs peer client certificates)")
-                # mTLS: only peers holding a CA-signed client cert connect
-                context.verify_mode = ssl.CERT_REQUIRED
-                context.load_verify_locations(cafile=config.tls_ca_path)
+            context = config.server_ssl_context()
             self._httpd.socket = context.wrap_socket(
                 self._httpd.socket, server_side=True,
                 do_handshake_on_connect=False)

@@ -441,3 +441,117 @@ def test_fanout_over_grpc_framing():
                 node.grpc_server.stop()
         for server in servers:
             server.stop()
+
+
+def test_fanout_over_grpc_framing_under_tls(tmp_path):
+    """Round-4 directive #9: a TLS cluster keeps its BINARY plane — the
+    gRPC framing runs h2-over-TLS with the cluster cert/CA, peers pick
+    the GrpcSearchClient, and distributed search works end to end."""
+    import http.client as hc
+    import json as _json
+    import shutil
+    import subprocess
+
+    if shutil.which("openssl") is None:
+        pytest.skip("openssl unavailable")
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True)
+
+    import ssl as _ssl
+
+    from quickwit_tpu.config.node_config import NodeConfig
+    from quickwit_tpu.serve.grpc_server import GrpcSearchClient
+    from quickwit_tpu.serve.http_client import HttpSearchClient
+    from quickwit_tpu.serve.node import Node
+    from quickwit_tpu.serve.rest import RestServer
+
+    resolver = StorageResolver.for_test()
+    nodes, servers = [], []
+    for i in range(2):
+        node = Node(NodeConfig(node_id=f"gt-{i}", rest_port=0, grpc_port=0,
+                               metastore_uri="ram:///gtls/ms",
+                               default_index_root_uri="ram:///gtls/idx",
+                               tls_cert_path=str(cert),
+                               tls_key_path=str(key),
+                               tls_ca_path=str(cert)),
+                    storage_resolver=resolver)
+        server = RestServer(node)
+        server.start()
+        nodes.append(node)
+        servers.append(server)
+    try:
+        for i, node in enumerate(nodes):
+            # TLS advertise: the gRPC endpoint is published even with TLS on
+            assert node._grpc_advertise(), "TLS node must advertise gRPC"
+            HttpSearchClient(servers[1 - i].endpoint,
+                             **node.config.client_tls_kwargs()).heartbeat({
+                "node_id": node.config.node_id,
+                "roles": list(node.config.roles),
+                "rest_endpoint": servers[i].endpoint,
+                "grpc_endpoint": node._grpc_advertise()})
+        assert isinstance(nodes[0].clients["gt-1"], GrpcSearchClient)
+        assert isinstance(nodes[1].clients["gt-0"], GrpcSearchClient)
+
+        context = _ssl.create_default_context(cafile=str(cert))
+
+        def rest(port, method, path, body=None):
+            conn = hc.HTTPSConnection("127.0.0.1", port, timeout=30,
+                                      context=context)
+            data = (None if body is None else
+                    body if isinstance(body, bytes)
+                    else _json.dumps(body).encode())
+            conn.request(method, path, body=data)
+            response = conn.getresponse()
+            payload = response.read()
+            conn.close()
+            return response.status, (_json.loads(payload) if payload else None)
+
+        status, _ = rest(servers[0].port, "POST", "/api/v1/indexes", {
+            "index_id": "gtls-logs",
+            "doc_mapping": {"field_mappings": [
+                {"name": "ts", "type": "datetime", "fast": True,
+                 "input_formats": ["unix_timestamp"]},
+                {"name": "body", "type": "text"}],
+                "timestamp_field": "ts",
+                "default_search_fields": ["body"]},
+            "indexing_settings": {"split_num_docs_target": 50}})
+        assert status == 200
+        docs = "\n".join(
+            _json.dumps({"ts": 1_600_000_000 + i,
+                         "body": f"doc {i} tlsword"})
+            for i in range(120)).encode()
+        status, result = rest(servers[0].port, "POST",
+                              "/api/v1/gtls-logs/ingest", docs)
+        assert status == 200 and result["num_ingested_docs"] == 120
+
+        status, result = rest(
+            servers[1].port, "GET",
+            "/api/v1/gtls-logs/search?query=tlsword&max_hits=5&sort_by=-ts")
+        assert status == 200 and result["num_hits"] == 120
+        assert len(result["hits"]) == 5
+
+        # a plaintext h2c client must be rejected by the TLS gRPC plane
+        from quickwit_tpu.serve.grpc_server import GrpcChannel
+        host, port = nodes[0]._grpc_advertise().rsplit(":", 1)
+        with pytest.raises(Exception):
+            plain = GrpcChannel(host, int(port), timeout=5)
+            plain.call("/quickwit.search.SearchService/LeafSearch", b"")
+
+        # the persistent TLS channel actually carried the fan-out
+        used = [c for node in nodes for c in node.clients.values()
+                if isinstance(c, GrpcSearchClient)
+                and c._channel is not None]
+        assert used, "no gRPC channel was used for the TLS fan-out"
+        assert all(c._channel_ssl is not None for c in used)
+    finally:
+        for node in nodes:
+            if node.grpc_server is not None:
+                node.grpc_server.stop()
+        for server in servers:
+            server.stop()
