@@ -493,14 +493,9 @@ class GrpcSearchClient:
                                      timeout_secs=timeout_secs, **http_kwargs)
         self.circuit = self.http.circuit
         # a TLS cluster runs its gRPC plane over TLS too (same CA / mTLS
-        # settings as the REST client); ALPN h2 set once here — the
-        # channel must not re-mutate the context on every reconnect
-        self._channel_ssl = client_ssl_context(**http_kwargs)
-        if self._channel_ssl is not None:
-            try:
-                self._channel_ssl.set_alpn_protocols(["h2"])
-            except NotImplementedError:
-                pass
+        # settings as the REST client), with h2 ALPN baked in at
+        # construction — no per-reconnect context mutation
+        self._channel_ssl = client_ssl_context(alpn=["h2"], **http_kwargs)
         self._channel: "GrpcChannel | None" = None
         self._channel_lock = threading.Lock()
 

@@ -19,6 +19,7 @@ subset a gRPC server needs is implemented here:
 from __future__ import annotations
 
 import socket
+import ssl
 import struct
 import threading
 from typing import Callable, Optional
@@ -218,6 +219,101 @@ class _Stream:
         self.ended = False
 
 
+def _tls_duplex_bridge(tls_sock) -> socket.socket:
+    """Bridge a server-side SSLSocket to a plaintext socketpair pumped by
+    a single owner thread, and return the plaintext end.
+
+    Why: the h2 connection logic is full-duplex — one thread blocks in
+    the frame-read loop while dispatch threads send response frames — and
+    OpenSSL does not allow SSL_read and SSL_write to run concurrently on
+    one SSL object (a TLS1.3 KeyUpdate processed inside SSL_read while
+    another thread is mid SSL_write corrupts the cipher state). Every SSL
+    call below happens on the pump thread alone; the h2 code sees an
+    ordinary full-duplex socket."""
+    import select as select_mod
+
+    plain, inner = socket.socketpair()
+    tls_sock.settimeout(0)   # non-blocking: the pump multiplexes
+    inner.settimeout(0)
+    chunk = 1 << 16
+    high_water = 1 << 20     # stop draining a side whose peer is slow
+
+    def pump() -> None:
+        to_tls = b""    # bytes from the h2 side awaiting SSL_write
+        to_inner = b""  # decrypted bytes awaiting delivery to h2
+        tls_eof = inner_eof = False
+        # non-blocking SSL: a recv can demand socket WRITABILITY and a
+        # send can demand READABILITY (key updates / renegotiation)
+        recv_wants_write = send_wants_read = False
+        try:
+            while not (tls_eof and not to_inner) \
+                    and not (inner_eof and not to_tls):
+                rlist, wlist = [], []
+                read_tls = (not tls_eof and len(to_inner) < high_water
+                            and not recv_wants_write)
+                if read_tls or send_wants_read:
+                    rlist.append(tls_sock)
+                if to_tls or recv_wants_write:
+                    wlist.append(tls_sock)
+                if not inner_eof and len(to_tls) < high_water:
+                    rlist.append(inner)
+                if to_inner:
+                    wlist.append(inner)
+                readable, writable, _ = select_mod.select(
+                    rlist, wlist, [], 30.0)
+                if not readable and not writable:
+                    continue  # idle heartbeat tick
+                tls_ready_r = tls_sock in readable
+                tls_ready_w = tls_sock in writable
+                if (not tls_eof and (tls_ready_r or
+                                     (recv_wants_write and tls_ready_w))):
+                    recv_wants_write = False
+                    try:
+                        while True:  # drain the SSL-internal buffer too
+                            data = tls_sock.recv(chunk)
+                            if not data:
+                                tls_eof = True
+                                break
+                            to_inner += data
+                            if not tls_sock.pending():
+                                break
+                    except ssl.SSLWantReadError:
+                        pass
+                    except ssl.SSLWantWriteError:
+                        recv_wants_write = True
+                if to_tls and (tls_ready_w or
+                               (send_wants_read and tls_ready_r)):
+                    send_wants_read = False
+                    try:
+                        sent = tls_sock.send(to_tls)
+                        to_tls = to_tls[sent:]
+                    except ssl.SSLWantWriteError:
+                        pass
+                    except ssl.SSLWantReadError:
+                        send_wants_read = True
+                if inner in readable:
+                    data = inner.recv(chunk)
+                    if not data:
+                        inner_eof = True
+                    else:
+                        to_tls += data
+                if to_inner and inner in writable:
+                    sent = inner.send(to_inner)
+                    to_inner = to_inner[sent:]
+        except (OSError, ssl.SSLError):
+            pass
+        finally:
+            for sock in (tls_sock, inner):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    threading.Thread(target=pump, daemon=True,
+                     name="h2-tls-pump").start()
+    return plain
+
+
 class Http2Server:
     """Threaded h2c server: one thread per connection, streams dispatched
     to `handler(headers, body) -> (response_headers, body_chunks,
@@ -226,14 +322,10 @@ class Http2Server:
     def __init__(self, handler: Callable, host: str = "127.0.0.1",
                  port: int = 0, ssl_context=None):
         self.handler = handler
-        # with an ssl_context the listener speaks HTTP/2 over TLS (h2 via
-        # ALPN) instead of h2c — the TLS-cluster binary plane
+        # with an ssl_context the listener speaks HTTP/2 over TLS instead
+        # of h2c — the TLS-cluster binary plane (ALPN h2 is baked into
+        # the context by its builder, server_ssl_context(alpn=["h2"]))
         self._ssl_context = ssl_context
-        if ssl_context is not None:
-            try:
-                ssl_context.set_alpn_protocols(["h2"])
-            except NotImplementedError:
-                pass
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind((host, port))
@@ -274,6 +366,11 @@ class Http2Server:
                 except OSError:
                     pass
                 return
+            # h2 is full-duplex (this reader thread + dispatch threads
+            # writing responses), but OpenSSL forbids concurrent
+            # SSL_read/SSL_write on one SSL object — bridge the TLS
+            # socket to a plaintext socketpair owned by ONE pump thread
+            conn = _tls_duplex_bridge(conn)
         state = _ConnState(conn)
 
         def read_exact(n: int) -> bytes:

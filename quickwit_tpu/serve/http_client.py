@@ -35,13 +35,15 @@ class HttpStatusError(HttpTransportError):
 def client_ssl_context(tls: bool = False, ca_path: Optional[str] = None,
                        skip_verify: bool = False,
                        client_cert_path: Optional[str] = None,
-                       client_key_path: Optional[str] = None
+                       client_key_path: Optional[str] = None,
+                       alpn: Optional[list[str]] = None
                        ) -> Optional[ssl.SSLContext]:
     """Peer-facing TLS context (role of quickwit-transport's rustls client
     side), shared by the JSON/HTTP client and the gRPC channel: `ca_path`
     pins the cluster CA for self-signed deployments; `skip_verify` is for
     tests only; a client cert is the mTLS identity toward verify-client
-    peers."""
+    peers; `alpn` is set here (ONE construction path) so callers never
+    mutate a context they share."""
     if not tls:
         return None
     if skip_verify:
@@ -52,6 +54,11 @@ def client_ssl_context(tls: bool = False, ca_path: Optional[str] = None,
         context = ssl.create_default_context(cafile=ca_path)
     if client_cert_path:
         context.load_cert_chain(client_cert_path, client_key_path)
+    if alpn:
+        try:
+            context.set_alpn_protocols(alpn)
+        except NotImplementedError:
+            pass
     return context
 
 

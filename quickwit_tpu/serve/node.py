@@ -106,9 +106,10 @@ class NodeConfig:
     def tls_enabled(self) -> bool:
         return self.tls_cert_path is not None and self.tls_key_path is not None
 
-    def server_ssl_context(self):
+    def server_ssl_context(self, alpn=None):
         """Server-side TLS context (role of quickwit-transport's rustls
-        server config), shared by the REST listener and the gRPC plane."""
+        server config), shared by the REST listener and the gRPC plane
+        (the latter passes alpn=["h2"])."""
         if not self.tls_enabled:
             return None
         import ssl
@@ -122,6 +123,11 @@ class NodeConfig:
             # mTLS: only peers holding a CA-signed client cert connect
             context.verify_mode = ssl.CERT_REQUIRED
             context.load_verify_locations(cafile=self.tls_ca_path)
+        if alpn:
+            try:
+                context.set_alpn_protocols(alpn)
+            except NotImplementedError:
+                pass
         return context
 
     def client_tls_kwargs(self) -> dict:
@@ -364,7 +370,7 @@ class Node:
             from .grpc_server import GrpcServer
             self.grpc_server = GrpcServer(
                 self, host=config.rest_host, port=config.grpc_port,
-                ssl_context=config.server_ssl_context())
+                ssl_context=config.server_ssl_context(alpn=["h2"]))
         # standalone compactor role (reference quickwit-compaction):
         # planner + bounded supervisor; when any alive compactor exists,
         # indexers stop running merges themselves
@@ -1125,7 +1131,7 @@ class Node:
             self.grpc_server = GrpcServer(
                 self, host=self.config.rest_host,
                 port=self.config.grpc_port,
-                ssl_context=self.config.server_ssl_context())
+                ssl_context=self.config.server_ssl_context(alpn=["h2"]))
         stop = self._bg_stop = threading.Event()
 
         def owns_index(index_uid: str) -> bool:

@@ -1,0 +1,424 @@
+"""Bounded model checking of ingest replication failover.
+
+Round-4 directive #10; role of the reference's DST models over the ingest
+path (`quickwit-dst/src/models/`, chained replication `replication.rs`,
+shard re-open on ingester death `ingest_controller.rs:204`).
+
+Like tests/test_model_check.py (publish/merge protocol), the explorer
+drives the REAL implementation — every transition executes the production
+`Ingester`/`RecordLog` code (persist with chained replication + rollback,
+replica_persist idempotence + gap detection, gap backfill with
+replica_reset past the truncation floor, promote_replica, WAL truncate,
+and crash recovery: every state materialization re-opens the WAL
+directories through `Ingester._recover`). The gap-backfill driver mirrors
+the node-level `_replicate_to_follower` sequence (serve/node.py) on top
+of the same primitives.
+
+World: one shard, a leader node and one follower slot, MAX_BATCHES
+client batches. Actions from every reachable state:
+
+- ingest           leader persists + chain-replicates; client ACKed
+- ingest_crash     leader crashes after the chain commits, BEFORE the
+                   client ack (both WALs hold an unacked batch)
+- publish          the drained prefix advances the checkpoint; both WALs
+                   truncate behind it
+- crash/recover    either node (recovery replays the real WAL)
+- promote          leader dead → follower's replica becomes leader
+- swap_follower    dead follower replaced by an EMPTY new node (the
+                   rendezvous re-pick); the next ingest hits a
+                   ReplicationGap and backfills — past the leader's
+                   truncation floor via replica_reset when needed
+
+Invariants (every reachable state):
+- no_loss:      every acked-unpublished batch is durable in at least one
+                node's on-disk WAL
+- leader_serves: an alive leader's WAL covers every acked-unpublished
+                batch (what the indexer will drain)
+- promotable:   with the leader dead and the follower alive, promotion
+                cannot lose acked data — the follower's WAL covers every
+                acked-unpublished batch
+- no_divergence: positions present in BOTH WALs hold identical payloads
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from collections import deque
+from dataclasses import dataclass, replace
+
+
+from quickwit_tpu.ingest.ingester import (
+    Ingester, ReplicationGap, shard_queue_id)
+
+UID, SRC, SHARD = "mc:01", "src", "s0"
+QUEUE_ID = shard_queue_id(UID, SRC, SHARD)
+MAX_BATCHES = 5
+
+
+def payload_of(batch: int) -> bytes:
+    return json.dumps({"b": batch}, separators=(",", ":")).encode()
+
+
+@dataclass(frozen=True)
+class NodeState:
+    alive: bool
+    role: str                        # "leader" | "replica"
+    floor: int                       # log start position
+    records: tuple[int, ...]         # batch ids at floor, floor+1, ...
+    # NOTE: the shard's publish_position is deliberately NOT model state:
+    # it is an in-memory soft watermark re-derived from the metastore
+    # checkpoint after recovery (World.published is the durable truth)
+    # whether this node HOSTS the shard at all: a freshly swapped-in
+    # follower has no replica shard until the first replica_persist
+    # reaches it, and the real promote_replica refuses unhosted shards
+    has_shard: bool = True
+
+
+@dataclass(frozen=True)
+class World:
+    nodes: tuple[NodeState, NodeState]   # (a, b)
+    acked: frozenset
+    published: int                       # batches 1..published are published
+    next_batch: int
+
+    def key(self) -> str:
+        return json.dumps({
+            "nodes": [[n.alive, n.role, n.floor, list(n.records),
+                       n.has_shard] for n in self.nodes],
+            "acked": sorted(self.acked),
+            "published": self.published,
+            "next": self.next_batch,
+        }, sort_keys=True)
+
+
+INITIAL = World(
+    nodes=(NodeState(True, "leader", 0, ()),
+           NodeState(True, "replica", 0, ())),
+    acked=frozenset(), published=0, next_batch=1)
+
+
+class _Live:
+    """A world MATERIALIZED through the real implementation: fresh WAL
+    directories written via the real API, then re-opened through
+    `Ingester.__init__`/`_recover` so recovery code runs on every
+    expansion. Crashed nodes keep their directories (kill-9 keeps disk)
+    but get no Ingester."""
+
+    def __init__(self, world: World, root: str):
+        self.world = world
+        self.root = root
+        self.ingesters: list = [None, None]
+        for i, node in enumerate(world.nodes):
+            wal_dir = os.path.join(root, "ab"[i])
+            seed = Ingester(wal_dir, fsync=False)
+            if node.has_shard:
+                shard = seed.open_shard(UID, SRC, SHARD, role=node.role)
+                if node.floor:
+                    shard.log.reset_to(node.floor)
+                for batch in node.records:
+                    shard.log.append(payload_of(batch))
+                shard.log.close()
+            if node.alive:
+                # REAL recovery: a fresh Ingester re-opens the WAL
+                ing = Ingester(wal_dir, fsync=False)
+                recovered = ing.shard(UID, SRC, SHARD)
+                if node.has_shard:
+                    assert recovered is not None
+                    assert recovered.role == node.role
+                self.ingesters[i] = ing
+
+    def node_state(self, i: int) -> NodeState:
+        old = self.world.nodes[i]
+        ing = self.ingesters[i]
+        if ing is None:
+            return old
+        shard = ing.shard(UID, SRC, SHARD)
+        if shard is None:
+            return replace(old, alive=True, has_shard=False)
+        records = shard.log.read_from(0)
+        floor = records[0][0] if records else shard.log.next_position
+        return NodeState(
+            alive=True, role=shard.role, floor=floor,
+            records=tuple(json.loads(p)["b"] for _pos, p in records),
+            has_shard=True)
+
+    def snapshot(self, **updates) -> World:
+        return replace(self.world,
+                       nodes=(self.node_state(0), self.node_state(1)),
+                       **updates)
+
+
+def _chain_replicate(live: _Live, leader_idx: int):
+    """The leader's replication callback, mirroring the node-level
+    `_replicate_to_follower` (serve/node.py): plain replica_persist; on a
+    ReplicationGap, backfill from the leader's own retained WAL, dropping
+    to replica_reset when truncation ate the follower's gap."""
+    follower = live.ingesters[1 - leader_idx]
+
+    def send(index_uid, source_id, shard_id, first, payloads):
+        if follower is None:
+            raise IOError("no live follower")
+        try:
+            follower.replica_persist(UID, source_id, shard_id,
+                                     first, payloads)
+            return
+        except ReplicationGap as gap:
+            leader = live.ingesters[leader_idx]
+            shard = leader.shard(UID, source_id, shard_id)
+            retained = shard.log.read_from(gap.have)
+            if not retained or retained[0][0] > gap.have:
+                # leader truncated past the follower's position: the gap
+                # records are published (checkpoint floor); restart the
+                # replica there
+                restart = retained[0][0] if retained \
+                    else shard.log.next_position
+                follower.replica_reset(UID, source_id, shard_id,
+                                       restart)
+                retained = shard.log.read_from(restart)
+            if retained:
+                follower.replica_persist(UID, source_id, shard_id,
+                                         retained[0][0],
+                                         [p for _pos, p in retained])
+    return send
+
+
+def _expand(world: World, scratch: str):
+    """All successor worlds, each produced by real-implementation calls."""
+    out = []
+    leader_idxs = [i for i, n in enumerate(world.nodes)
+                   if n.role == "leader" and n.alive and n.has_shard]
+
+    def fresh(tag):
+        path = os.path.join(scratch, tag)
+        if os.path.exists(path):
+            shutil.rmtree(path)
+        os.makedirs(path)
+        return path
+
+    # -- ingest (acked) and ingest with leader crash before ack ----------
+    # attempted from EVERY alive leader: after a promotion with the old
+    # leader recovered, BOTH claim the shard — the old leader's chain is
+    # refused by the promoted peer (replica_persist on a leader shard),
+    # which is exactly the fencing that keeps dual-leadership write-dead
+    for leader_idx in leader_idxs:
+        follower_idx = 1 - leader_idx
+        follower = world.nodes[follower_idx]
+        if not (follower.alive and world.next_batch <= MAX_BATCHES):
+            continue
+        for crash_before_ack in (False, True):
+            live = _Live(world, fresh("ingest"))
+            leader = live.ingesters[leader_idx]
+            leader.replicate_to = _chain_replicate(live, leader_idx)
+            try:
+                leader.persist(UID, SRC, SHARD,
+                               [{"b": world.next_batch}])
+            except (ValueError, IOError):
+                # chain refused (dual-leader fencing): the rollback must
+                # leave the world EXACTLY as it was — a real invariant of
+                # the persist critical section, checked here
+                assert live.snapshot() == world, \
+                    "failed chain did not roll back cleanly"
+                break
+            if crash_before_ack:
+                nodes = [live.node_state(0), live.node_state(1)]
+                nodes[leader_idx] = replace(nodes[leader_idx], alive=False)
+                out.append(("ingest_crash", replace(
+                    world, nodes=tuple(nodes),
+                    next_batch=world.next_batch + 1)))
+            else:
+                out.append(("ingest", live.snapshot(
+                    acked=world.acked | {world.next_batch},
+                    next_batch=world.next_batch + 1)))
+
+    # -- publish + truncate ----------------------------------------------
+    for leader_idx in leader_idxs:
+        new_published = world.published + 1
+        if new_published not in world.acked:
+            continue
+        if new_published not in world.nodes[leader_idx].records:
+            continue  # this (stale) leader cannot drain what it lacks
+        live = _Live(world, fresh("publish"))
+        leader = live.ingesters[leader_idx]
+        # the indexer drained batches 1..published+1; positions are
+        # 1-per-batch so the new watermark equals the batch number
+        leader.truncate(UID, SRC, SHARD, new_published)
+        fol = live.ingesters[1 - leader_idx]
+        if fol is not None and world.nodes[1 - leader_idx].has_shard:
+            fol.replica_truncate(UID, SRC, SHARD, new_published)
+        out.append(("publish", live.snapshot(published=new_published)))
+
+    # -- crashes / recoveries --------------------------------------------
+    for i, node in enumerate(world.nodes):
+        if node.alive:
+            nodes = list(world.nodes)
+            nodes[i] = replace(node, alive=False)
+            out.append((f"crash_{'ab'[i]}",
+                        replace(world, nodes=tuple(nodes))))
+        else:
+            # recovery IS materialization through Ingester._recover
+            nodes = list(world.nodes)
+            nodes[i] = replace(node, alive=True)
+            candidate = replace(world, nodes=tuple(nodes))
+            live = _Live(candidate, fresh("recover"))
+            out.append((f"recover_{'ab'[i]}", live.snapshot()))
+
+    # -- promotion --------------------------------------------------------
+    # a dead leader + an alive, shard-hosting replica: the replica takes
+    # over (node-level grace handling is in promote_orphaned_replicas;
+    # the model explores the post-grace decision)
+    for i, node in enumerate(world.nodes):
+        peer = world.nodes[1 - i]
+        if (node.role == "leader" and not node.alive and peer.alive
+                and peer.role == "replica" and peer.has_shard):
+            live = _Live(world, fresh("promote"))
+            promoted = live.ingesters[1 - i].promote_replica(QUEUE_ID)
+            assert promoted
+            out.append(("promote", live.snapshot()))
+
+    # -- dead follower replaced by an empty new node ----------------------
+    for leader_idx in leader_idxs:
+        follower_idx = 1 - leader_idx
+        if world.nodes[follower_idx].alive:
+            continue
+        nodes = list(world.nodes)
+        # no replica shard until the first replica_persist reaches it —
+        # so it is NOT promotable yet (the real promote_replica refuses
+        # unhosted shards; the checker caught an early model that
+        # pre-created the shard and could "promote" an empty follower)
+        nodes[follower_idx] = NodeState(True, "replica", 0, (),
+                                        has_shard=False)
+        out.append(("swap_follower", replace(world, nodes=tuple(nodes))))
+
+    return out
+
+
+def _check_invariants(world: World, trace):
+    unpublished = {batch for batch in world.acked
+                   if batch > world.published}
+    on_disk = set()
+    for node in world.nodes:
+        on_disk.update(node.records)
+    assert unpublished <= on_disk, \
+        f"no_loss violated: {unpublished - on_disk} acked but on no disk " \
+        f"(trace: {trace})"
+
+    leader = next((n for n in world.nodes if n.role == "leader"), None)
+    if leader is not None and leader.alive:
+        assert unpublished <= set(leader.records), \
+            f"leader_serves violated (trace: {trace})"
+    if leader is not None and not leader.alive:
+        follower = next((n for n in world.nodes if n is not leader), None)
+        if follower is not None and follower.alive \
+                and follower.role == "replica" and follower.has_shard:
+            assert unpublished <= set(follower.records), \
+                f"promotable violated: promotion would lose " \
+                f"{unpublished - set(follower.records)} (trace: {trace})"
+
+    pos_a = {a_pos: batch for a_pos, batch in
+             zip(range(world.nodes[0].floor,
+                       world.nodes[0].floor + len(world.nodes[0].records)),
+                 world.nodes[0].records)}
+    pos_b = {b_pos: batch for b_pos, batch in
+             zip(range(world.nodes[1].floor,
+                       world.nodes[1].floor + len(world.nodes[1].records)),
+                 world.nodes[1].records)}
+    for pos in pos_a.keys() & pos_b.keys():
+        assert pos_a[pos] == pos_b[pos], \
+            f"no_divergence violated at position {pos}: " \
+            f"{pos_a[pos]} != {pos_b[pos]} (trace: {trace})"
+
+
+def test_replication_failover_model_check(tmp_path):
+    scratch = str(tmp_path)
+    visited: dict[str, tuple] = {INITIAL.key(): ()}
+    queue = deque([(INITIAL, ())])
+    transitions = 0
+    max_depth = 0
+    _check_invariants(INITIAL, ())
+    while queue:
+        world, trace = queue.popleft()
+        for action, successor in _expand(world, scratch):
+            transitions += 1
+            key = successor.key()
+            if key in visited:
+                continue
+            next_trace = trace + (action,)
+            visited[key] = next_trace
+            max_depth = max(max_depth, len(next_trace))
+            _check_invariants(successor, next_trace)
+            queue.append((successor, next_trace))
+
+    # exact counts: silent pruning must not be able to fake coverage
+    assert len(visited) == 2396, len(visited)
+    assert transitions == 6888, transitions
+    assert max_depth == 15, max_depth
+    # the interesting scenarios were genuinely reached
+    reached = set()
+    for trace in visited.values():
+        reached.update(trace)
+    assert {"ingest", "ingest_crash", "publish", "promote",
+            "swap_follower", "crash_a", "crash_b", "recover_a",
+            "recover_b"} <= reached
+
+
+def test_gap_backfill_past_truncation_floor(tmp_path):
+    """Directed scenario (one path through the model, asserted in
+    detail): leader truncates behind the checkpoint, a FRESH follower
+    appears, and the next ingest backfills it — with replica_reset
+    jumping the published hole — so promotion immediately after would
+    lose nothing."""
+    a = Ingester(str(tmp_path / "a"), fsync=False)
+    b = Ingester(str(tmp_path / "b"), fsync=False)
+
+    world = {"follower": b}
+
+    def send(index_uid, source_id, shard_id, first, payloads):
+        fol = world["follower"]
+        try:
+            fol.replica_persist(UID, source_id, shard_id, first,
+                                payloads)
+            return
+        except ReplicationGap as gap:
+            shard = a.shard(UID, source_id, shard_id)
+            retained = shard.log.read_from(gap.have)
+            if not retained or retained[0][0] > gap.have:
+                restart = retained[0][0] if retained \
+                    else shard.log.next_position
+                fol.replica_reset(UID, source_id, shard_id, restart)
+                retained = shard.log.read_from(restart)
+            if retained:
+                fol.replica_persist(UID, source_id, shard_id,
+                                    retained[0][0],
+                                    [p for _pos, p in retained])
+
+    a.replicate_to = send
+    a.open_shard(UID, SRC, SHARD)
+    b.open_shard(UID, SRC, SHARD, role="replica")
+    # 1-byte segments: every append rolls, so truncation is per-record —
+    # the only way the leader's retained floor can actually advance
+    # (truncate drops whole segments)
+    import quickwit_tpu.ingest.wal as wal_mod
+    monkey_max = wal_mod._SEGMENT_MAX_BYTES
+    wal_mod._SEGMENT_MAX_BYTES = 1
+    try:
+        for i in range(1, 4):
+            a.persist(UID, SRC, SHARD, [{"b": i}])
+    finally:
+        wal_mod._SEGMENT_MAX_BYTES = monkey_max
+    # publish batches 1..2 and truncate the leader WAL behind them
+    a.truncate(UID, SRC, SHARD, 2)
+    assert a.shard(UID, SRC, SHARD).log.read_from(0)[0][0] == 2
+    # the follower dies; a fresh empty node takes its slot
+    fresh = Ingester(str(tmp_path / "c"), fsync=False)
+    fresh.open_shard(UID, SRC, SHARD, role="replica")
+    world["follower"] = fresh
+    # next ingest gap-backfills the new follower past the published hole
+    a.persist(UID, SRC, SHARD, [{"b": 4}])
+    records = fresh.shard(UID, SRC, SHARD).log.read_from(0)
+    got = [(pos, json.loads(p)["b"]) for pos, p in records]
+    assert got == [(2, 3), (3, 4)], got
+    # promotion now loses nothing that is acked and unpublished
+    assert fresh.promote_replica(QUEUE_ID)
+    assert fresh.shard(UID, SRC, SHARD).role == "leader"
