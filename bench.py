@@ -213,6 +213,115 @@ def _percentile(samples, q) -> float:
     return samples[min(len(samples) - 1, int(len(samples) * q))]
 
 
+def _native_cpu_leaf(plan, request, reference_count: int,
+                     iters: int) -> "dict | None":
+    """Single-threaded C++ comparator (native/leafbench.cpp): the same
+    leaf computation over the same arrays, standing in for the reference
+    tantivy leaf (no Rust toolchain in-image — BASELINE.md). Returns p50
+    ms, or None when the plan shape is outside the comparator's scope
+    (posting-space term query + optional date_histogram/terms aggs).
+    The comparator is a FAVORABLE CPU baseline: pre-decoded postings,
+    pre-ordinalized columns, no doc-store work."""
+    import ctypes
+
+    import numpy as np
+    from quickwit_tpu.native import load_leafbench
+    from quickwit_tpu.search import executor as ex
+    from quickwit_tpu.search.plan import BucketAggExec, PPostings
+
+    lib = load_leafbench()
+    if lib is None or not isinstance(plan.root, PPostings) \
+            or not ex._posting_space_eligible(plan):
+        return None
+    if not plan.array_keys[plan.root.ids_slot].startswith("post."):
+        # phrase/precomputed postings ("pre."): the CPU would owe extra
+        # position-intersection work the comparator doesn't model — skip
+        return None
+    hist = terms = None
+    for agg in plan.aggs:
+        if not isinstance(agg, BucketAggExec) or agg.subs or agg.metrics:
+            return None
+        if agg.kind == "date_histogram" and hist is None:
+            hist = agg
+        elif agg.kind == "terms" and terms is None:
+            terms = agg
+        else:
+            return None
+
+    k = request.start_offset + request.max_hits
+    if k > 0 and not plan.root.scoring:
+        return None  # field-sorted hits: the comparator only models BM25
+
+    def arr(slot):
+        return np.ascontiguousarray(plan.arrays[slot])
+
+    ids = arr(plan.root.ids_slot)
+    tfs = arr(plan.root.tfs_slot)
+    if plan.root.scoring:
+        norms = arr(plan.root.norm_slot).astype(np.int32, copy=False)
+        idf = float(np.asarray(plan.scalars[plan.root.idf_slot]))
+        avg_len = float(np.asarray(plan.scalars[plan.root.avg_len_slot]))
+    else:  # k == 0: the C++ loop never touches the scoring operands
+        norms = np.zeros(1, np.int32)
+        idf, avg_len = 0.0, 1.0
+
+    if hist is not None:
+        ts_values = arr(hist.values_slot).astype(np.int64, copy=False)
+        ts_present = arr(hist.present_slot).astype(np.uint8, copy=False)
+        origin = int(np.asarray(plan.scalars[hist.origin_slot]))
+        interval = int(np.asarray(plan.scalars[hist.interval_slot]))
+        n_hist = hist.num_buckets
+    else:
+        ts_values = np.zeros(1, np.int64)
+        ts_present = np.zeros(1, np.uint8)
+        origin, interval, n_hist = 0, 1, 0
+    if terms is not None:
+        ord_col = arr(terms.values_slot).astype(np.int32, copy=False)
+        n_terms = terms.num_buckets
+    else:
+        ord_col = np.zeros(1, np.int32)
+        n_terms = 0
+
+    hist_out = np.zeros(max(n_hist, 1), np.int64)
+    terms_out = np.zeros(max(n_terms, 1), np.int64)
+    topk_scores = np.zeros(max(k, 1), np.float32)
+    topk_docs = np.zeros(max(k, 1), np.int32)
+    count_out = np.zeros(1, np.int64)
+
+    def ptr(a, t):
+        return a.ctypes.data_as(ctypes.POINTER(t))
+
+    def run_once():
+        hist_out[:] = 0
+        terms_out[:] = 0
+        lib.leaf_term_aggs(
+            ptr(ids, ctypes.c_int32), ptr(tfs, ctypes.c_int32),
+            ctypes.c_int64(len(ids)), ptr(norms, ctypes.c_int32),
+            ctypes.c_int64(plan.num_docs),
+            ptr(ts_values, ctypes.c_int64), ptr(ts_present, ctypes.c_uint8),
+            ctypes.c_int64(origin), ctypes.c_int64(interval),
+            ctypes.c_int32(n_hist),
+            ptr(ord_col, ctypes.c_int32), ctypes.c_int32(n_terms),
+            ctypes.c_double(idf), ctypes.c_double(avg_len),
+            ctypes.c_int32(k),
+            ptr(hist_out, ctypes.c_int64), ptr(terms_out, ctypes.c_int64),
+            ptr(topk_scores, ctypes.c_float), ptr(topk_docs, ctypes.c_int32),
+            ptr(count_out, ctypes.c_int64))
+
+    run_once()
+    if int(count_out[0]) != reference_count:
+        print(f"# native comparator count mismatch: {int(count_out[0])} "
+              f"vs {reference_count} — dropping denominator",
+              file=sys.stderr)
+        return None
+    lat = []
+    for _ in range(iters):
+        t0 = time.monotonic()
+        run_once()
+        lat.append(time.monotonic() - t0)
+    return {"native_cpu_ms": round(_percentile(lat, 0.5) * 1000, 3)}
+
+
 def _measure_batched_throughput(plan, k, device_arrays, num_queries: int,
                                 batch: int) -> dict:
     """Per-query latency with `num_queries` concurrent queries executed as
@@ -294,6 +403,13 @@ def _measure_single_split(request, mapper, reader, iters: int,
         return stats
 
     stats["hbm_bytes"] = _estimate_bytes(plan)
+
+    # native single-core C++ comparator on the same arrays (the honest
+    # stand-in for the reference tantivy leaf; see _native_cpu_leaf)
+    native = _native_cpu_leaf(plan, request, int(resp.num_hits),
+                              max(5, iters // 2))
+    if native:
+        stats.update(native)
 
     # pipelined throughput: concurrent queries ride multi-query dispatches
     stats.update(_measure_batched_throughput(
@@ -533,6 +649,17 @@ def main() -> None:
             stats["vs_cpu_device"] = round(
                 cpu_best / stats["dev_ms"], 1) \
                 if "dev_ms" in stats else None
+    for stats in results.values():
+        # the C++ comparator as denominator — the strictest one: a single
+        # modern core over pre-decoded arrays. Independent of the own-CPU
+        # child run, so it survives BENCH_SKIP_CPU_COMPARE / child failure
+        if stats.get("native_cpu_ms"):
+            stats["vs_native_pipelined"] = round(
+                stats["native_cpu_ms"] / stats["pipe_ms"], 2) \
+                if "pipe_ms" in stats else None
+            stats["vs_native_device"] = round(
+                stats["native_cpu_ms"] / stats["dev_ms"], 2) \
+                if "dev_ms" in stats else None
 
     details = {
         "platform": platform, "device_kind": device_kind,
@@ -551,6 +678,12 @@ def main() -> None:
     note = os.environ.get("BENCH_PLATFORM_NOTE", platform)
     if head.get("cpu_ms"):
         vs = head["vs_cpu_pipelined"]
+        native_note = ""
+        if head.get("native_cpu_ms"):
+            native_note = (f", native C++ single-core comparator "
+                           f"{head['native_cpu_ms']}ms -> "
+                           f"{head.get('vs_native_pipelined')}x pipelined/"
+                           f"{head.get('vs_native_device')}x device")
         note = (f"{note}, {PIPELINE_BATCH} concurrent queries/dispatch, "
                 f"dev p50 {head['dev_ms']}ms "
                 f"({head.get('bw_util', 0) * 100:.0f}% HBM bw, "
@@ -558,7 +691,13 @@ def main() -> None:
                 f"e2e 1-shot {head['e2e_ms']}ms incl 2x{rtt_ms:.0f}ms "
                 f"tunnel rtt, cpu denominator min(own-cpu 1-shot "
                 f"{head['cpu_ms']:.0f}ms, own-cpu batched "
-                f"{head.get('cpu_pipe_ms', head['cpu_ms']):.0f}ms)")
+                f"{head.get('cpu_pipe_ms', head['cpu_ms']):.0f}ms)"
+                f"{native_note}")
+        value = head["pipe_ms"]
+    elif head.get("vs_native_pipelined"):
+        vs = head["vs_native_pipelined"]
+        note = (f"{note}, denominator: native C++ single-core comparator "
+                f"{head['native_cpu_ms']}ms (own-cpu child unavailable)")
         value = head["pipe_ms"]
     else:
         vs = round(1000.0 / head["e2e_ms"], 2)

@@ -26,17 +26,24 @@ def _build_dir() -> str:
     return os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
 
 
-def _compile() -> Optional[str]:
-    src = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fastindex.cpp")
+def _compile(src_basename: str = "fastindex.cpp",
+             extra_flags: "tuple[str, ...]" = (),
+             needs_python_include: bool = True) -> Optional[str]:
+    """Mtime-cached on-demand g++ build shared by every native piece."""
+    src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       src_basename)
     build_dir = _build_dir()
     os.makedirs(build_dir, exist_ok=True)
-    so_path = os.path.join(build_dir, "fastindex.so")
+    so_path = os.path.join(
+        build_dir, os.path.splitext(src_basename)[0] + ".so")
     if (os.path.exists(so_path)
             and os.path.getmtime(so_path) >= os.path.getmtime(src)):
         return so_path
-    include = sysconfig.get_paths()["include"]
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-           f"-I{include}", src, "-o", so_path + ".tmp"]
+           *extra_flags]
+    if needs_python_include:
+        cmd.append(f"-I{sysconfig.get_paths()['include']}")
+    cmd += [src, "-o", so_path + ".tmp"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(so_path + ".tmp", so_path)
@@ -44,8 +51,8 @@ def _compile() -> Optional[str]:
     except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
             FileNotFoundError) as exc:
         stderr = getattr(exc, "stderr", b"") or b""
-        logger.warning("fastindex compilation failed, using Python fallback: %s %s",
-                       exc, stderr.decode()[:500])
+        logger.warning("%s compilation failed, using Python fallback: %s %s",
+                       src_basename, exc, stderr.decode()[:500])
         return None
 
 
@@ -74,3 +81,36 @@ def load_fastindex():
             logger.warning("fastindex load failed: %s", exc)
             _cached = None
     return _cached
+
+
+_leafbench_cached: Any = "unset"
+
+
+def load_leafbench():
+    """The compiled leafbench ctypes library (the benchmark's native CPU
+    comparator, see leafbench.cpp), or None when g++ is unavailable or
+    native code is disabled."""
+    global _leafbench_cached
+    if _leafbench_cached != "unset":
+        return _leafbench_cached
+    with _lock:
+        if _leafbench_cached != "unset":
+            return _leafbench_cached
+        if os.environ.get("QW_DISABLE_NATIVE") == "1":
+            _leafbench_cached = None
+            return None
+        so_path = _compile("leafbench.cpp", extra_flags=("-march=native",),
+                           needs_python_include=False)
+        if so_path is None:
+            _leafbench_cached = None
+            return None
+        import ctypes
+        try:
+            lib = ctypes.CDLL(so_path)
+        except OSError as exc:
+            logger.warning("leafbench load failed: %s", exc)
+            _leafbench_cached = None
+            return None
+        lib.leaf_term_aggs.restype = None
+        _leafbench_cached = lib
+        return lib

@@ -239,8 +239,10 @@ def _tls_duplex_bridge(tls_sock) -> socket.socket:
     high_water = 1 << 20     # stop draining a side whose peer is slow
 
     def pump() -> None:
-        to_tls = b""    # bytes from the h2 side awaiting SSL_write
-        to_inner = b""  # decrypted bytes awaiting delivery to h2
+        # bytearrays: `del buf[:sent]` keeps partial drains O(n) (bytes
+        # slicing would re-copy the tail on every partial send)
+        to_tls = bytearray()    # from the h2 side, awaiting SSL_write
+        to_inner = bytearray()  # decrypted, awaiting delivery to h2
         tls_eof = inner_eof = False
         # non-blocking SSL: a recv can demand socket WRITABILITY and a
         # send can demand READABILITY (key updates / renegotiation)
@@ -285,8 +287,8 @@ def _tls_duplex_bridge(tls_sock) -> socket.socket:
                                (send_wants_read and tls_ready_r)):
                     send_wants_read = False
                     try:
-                        sent = tls_sock.send(to_tls)
-                        to_tls = to_tls[sent:]
+                        sent = tls_sock.send(bytes(to_tls))
+                        del to_tls[:sent]
                     except ssl.SSLWantWriteError:
                         pass
                     except ssl.SSLWantReadError:
@@ -299,7 +301,7 @@ def _tls_duplex_bridge(tls_sock) -> socket.socket:
                         to_tls += data
                 if to_inner and inner in writable:
                     sent = inner.send(to_inner)
-                    to_inner = to_inner[sent:]
+                    del to_inner[:sent]
         except (OSError, ssl.SSLError):
             pass
         finally:
