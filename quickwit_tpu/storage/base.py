@@ -112,6 +112,8 @@ class StorageResolver:
         # env-configured (QW_S3_ENDPOINT / AWS_*); hedged ranged reads by
         # default — S3's tail latency is the reason the wrapper exists
         resolver.register(Protocol.S3, _make_s3_storage)
+        resolver.register(Protocol.AZURE, _make_azure_storage)
+        resolver.register(Protocol.GCS, _make_gcs_storage)
         return resolver
 
     @staticmethod
@@ -123,3 +125,15 @@ def _make_s3_storage(uri: Uri) -> Storage:
     from .s3 import S3CompatibleStorage, S3Config
     from .wrappers import TimeoutAndRetryStorage
     return TimeoutAndRetryStorage(S3CompatibleStorage(uri, S3Config.from_env()))
+
+
+def _make_azure_storage(uri: Uri) -> Storage:
+    from .azure import AzureBlobStorage
+    from .wrappers import TimeoutAndRetryStorage
+    return TimeoutAndRetryStorage(AzureBlobStorage(uri))
+
+
+def _make_gcs_storage(uri: Uri) -> Storage:
+    from .gcs import GcsStorage
+    from .wrappers import TimeoutAndRetryStorage
+    return TimeoutAndRetryStorage(GcsStorage(uri))

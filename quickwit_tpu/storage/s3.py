@@ -33,7 +33,8 @@ from dataclasses import dataclass
 from typing import Iterable, Optional
 
 from ..common.uri import Uri
-from .base import Storage, StorageError
+from .base import StorageError
+from .http_object import HttpObjectStorage
 
 _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
 _RETRYABLE_STATUS = (500, 502, 503, 504)
@@ -120,118 +121,41 @@ def sigv4_headers(method: str, host: str, canonical_uri: str,
     return headers
 
 
-class S3CompatibleStorage(Storage):
+class S3CompatibleStorage(HttpObjectStorage):
     """`Storage` over the S3 REST API with SigV4 and path-style
-    addressing. URI shape: `s3://bucket/prefix`."""
+    addressing. URI shape: `s3://bucket/prefix`. Connection pool, retry
+    loop, and read paths live in HttpObjectStorage; this class adds the
+    SigV4 signing hook and S3-specific operations."""
+
+    service_name = "s3"
 
     def __init__(self, uri: Uri, config: Optional[S3Config] = None):
-        super().__init__(uri)
         self.config = config or S3Config.from_env()
+        super().__init__(uri, self.config.request_timeout_secs)
         parts = uri.path.lstrip("/").split("/", 1)
         self.bucket = parts[0]
         self.prefix = parts[1].strip("/") if len(parts) > 1 else ""
         if not self.bucket:
             raise StorageError(f"s3 uri has no bucket: {uri}")
-        endpoint = self.config.endpoint or \
-            f"https://s3.{self.config.region}.amazonaws.com"
-        parsed = urllib.parse.urlparse(endpoint)
-        self._secure = parsed.scheme == "https"
-        self._host = parsed.hostname or ""
-        self._port = parsed.port or (443 if self._secure else 80)
-        self._host_header = parsed.netloc
-        self._local = threading.local()
+        self._init_endpoint(self.config.endpoint or
+                            f"https://s3.{self.config.region}.amazonaws.com")
 
-    # --- connection pool (one per thread) ------------------------------
-    def _connection(self) -> http.client.HTTPConnection:
-        conn = getattr(self._local, "conn", None)
-        if conn is None:
-            cls = (http.client.HTTPSConnection if self._secure
-                   else http.client.HTTPConnection)
-            conn = cls(self._host, self._port,
-                       timeout=self.config.request_timeout_secs)
-            self._local.conn = conn
-        return conn
+    @property
+    def _root_segment(self) -> str:
+        return self.bucket
 
-    def _drop_connection(self) -> None:
-        conn = getattr(self._local, "conn", None)
-        if conn is not None:
-            try:
-                conn.close()
-            except OSError:
-                pass
-            self._local.conn = None
-
-    # --- request core ---------------------------------------------------
-    def _key(self, path: str) -> str:
-        if path.startswith("/") or ".." in path.split("/"):
-            raise StorageError(f"invalid object path: {path!r}")
-        return f"{self.prefix}/{path}" if self.prefix else path
-
-    def _request(self, method: str, key: str,
-                 query: Optional[list[tuple[str, str]]] = None,
-                 body: bytes = b"",
-                 extra_headers: Optional[dict[str, str]] = None
-                 ) -> tuple[int, dict[str, str], bytes]:
-        query = query or []
-        canonical_uri = "/" + urllib.parse.quote(
-            f"{self.bucket}/{key}" if key else self.bucket, safe="/-_.~")
+    def _sign_headers(self, method, resource_path, query, body,
+                      extra_headers):
         payload_sha = hashlib.sha256(body).hexdigest() if body \
             else _EMPTY_SHA256
-        last_error: Optional[Exception] = None
-        for attempt in range(_MAX_ATTEMPTS):
-            headers = sigv4_headers(
-                method, self._host_header, canonical_uri, query,
-                payload_sha, self.config, extra_headers=extra_headers)
-            target = canonical_uri
-            if query:
-                target += "?" + urllib.parse.urlencode(sorted(query))
-            try:
-                conn = self._connection()
-                conn.request(method, target, body=body or None,
-                             headers=headers)
-                resp = conn.getresponse()
-                data = resp.read()
-                status = resp.status
-                resp_headers = {k.lower(): v for k, v in resp.getheaders()}
-            except (OSError, http.client.HTTPException, socket.timeout) as exc:
-                self._drop_connection()
-                last_error = exc
-                time.sleep(0.05 * (2 ** attempt))
-                continue
-            if status in _RETRYABLE_STATUS:
-                last_error = StorageError(
-                    f"s3 {method} {key}: HTTP {status}", kind="internal")
-                time.sleep(0.05 * (2 ** attempt))
-                continue
-            return status, resp_headers, data
-        raise StorageError(f"s3 {method} {key} failed after "
-                           f"{_MAX_ATTEMPTS} attempts: {last_error}",
-                           kind="timeout" if isinstance(
-                               last_error, socket.timeout) else "internal")
-
-    @staticmethod
-    def _check(status: int, data: bytes, op: str, path: str) -> None:
-        if status == 404:
-            raise StorageError(f"not found: {path}", kind="not_found")
-        if status in (401, 403):
-            raise StorageError(f"s3 {op} {path}: HTTP {status}",
-                               kind="unauthorized")
-        if status >= 300:
-            raise StorageError(
-                f"s3 {op} {path}: HTTP {status}: {data[:200]!r}")
+        return sigv4_headers(method, self._host_header, resource_path,
+                             query, payload_sha, self.config,
+                             extra_headers=extra_headers)
 
     # --- Storage impl ----------------------------------------------------
     def put(self, path: str, payload: bytes) -> None:
         status, _, data = self._request("PUT", self._key(path), body=payload)
         self._check(status, data, "PUT", path)
-
-    def delete(self, path: str) -> None:
-        status, _, data = self._request("DELETE", self._key(path))
-        # S3 DELETE is idempotent: 404 here means a racing GC already won,
-        # but the reference surfaces not_found for single deletes
-        if status == 404:
-            raise StorageError(f"not found: {path}", kind="not_found")
-        self._check(status, data, "DELETE", path)
 
     def bulk_delete(self, paths: Iterable[str]) -> None:
         """Multi-object delete (`POST /?delete`), 1000 keys per request —
@@ -267,32 +191,6 @@ class S3CompatibleStorage(Storage):
     def _content_md5(body: bytes) -> str:
         import base64
         return base64.b64encode(hashlib.md5(body).digest()).decode()
-
-    def get_slice(self, path: str, start: int, end: int) -> bytes:
-        if start >= end:
-            return b""
-        status, _, data = self._request(
-            "GET", self._key(path),
-            extra_headers={"range": f"bytes={start}-{end - 1}"})
-        if status == 416:
-            raise StorageError(
-                f"range {start}:{end} out of bounds for {path}")
-        self._check(status, data, "GET", path)
-        if status == 200 and (start > 0 or len(data) > end - start):
-            # 200 (not 206) means the server ignored the Range header and
-            # returned the full object; slice host-side
-            return data[start:end]
-        return data
-
-    def get_all(self, path: str) -> bytes:
-        status, _, data = self._request("GET", self._key(path))
-        self._check(status, data, "GET", path)
-        return data
-
-    def file_num_bytes(self, path: str) -> int:
-        status, headers, data = self._request("HEAD", self._key(path))
-        self._check(status, data, "HEAD", path)
-        return int(headers.get("content-length", 0))
 
     def list_files(self) -> list[str]:
         """ListObjectsV2 with pagination; returns keys relative to the

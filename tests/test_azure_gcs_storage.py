@@ -1,0 +1,165 @@
+"""Azure Blob + GCS storage backends against wire-accurate fakes.
+
+Reference parity targets:
+- Azure: `quickwit-storage/src/object_storage/azure_blob_storage.rs:1`
+  (real SharedKey signing, verified by the fake with the identical
+  canonicalization — the Azurite role)
+- GCS: `quickwit-storage/src/opendal_storage/` (the XML S3-interop
+  protocol with HMAC keys + SigV4, tested against the existing
+  signature-verifying S3 fake at the GCS endpoint)
+"""
+
+import base64
+
+import pytest
+
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.storage import (
+    AzureBlobStorage, AzureConfig, GcsStorage, S3Config, StorageError,
+    StorageResolver)
+from quickwit_tpu.storage.fake_azure import FakeAzureServer
+from quickwit_tpu.storage.fake_s3 import FakeS3Server
+
+AZ_KEY = base64.b64encode(b"super-secret-azure-key").decode()
+
+
+@pytest.fixture(scope="module")
+def azure_server():
+    fake = FakeAzureServer(account="devacct", access_key=AZ_KEY).start()
+    yield fake
+    fake.stop()
+
+
+@pytest.fixture
+def azure(azure_server):
+    azure_server.blobs.clear()
+    azure_server.auth_failures = 0
+    return AzureBlobStorage(
+        Uri.parse("azure://idx/splits"),
+        AzureConfig(account="devacct", access_key=AZ_KEY,
+                    endpoint=azure_server.endpoint))
+
+
+def test_azure_roundtrip_signed(azure, azure_server):
+    azure.put("a.split", b"hello azure world")
+    assert azure.get_all("a.split") == b"hello azure world"
+    assert azure.get_slice("a.split", 6, 11) == b"azure"
+    assert azure.file_num_bytes("a.split") == 17
+    assert azure.exists("a.split")
+    assert not azure.exists("missing")
+    assert azure.list_files() == ["a.split"]
+    azure.delete("a.split")
+    assert not azure.exists("a.split")
+    with pytest.raises(StorageError) as exc:
+        azure.delete("a.split")
+    assert exc.value.kind == "not_found"
+    assert azure_server.auth_failures == 0
+
+
+def test_azure_bad_key_rejected(azure_server):
+    bad = AzureBlobStorage(
+        Uri.parse("azure://idx/splits"),
+        AzureConfig(account="devacct",
+                    access_key=base64.b64encode(b"WRONG").decode(),
+                    endpoint=azure_server.endpoint))
+    with pytest.raises(StorageError) as exc:
+        bad.put("x", b"data")
+    assert exc.value.kind == "unauthorized"
+    assert azure_server.auth_failures >= 1
+
+
+def test_azure_list_pagination(azure, azure_server):
+    for i in range(7):
+        azure.put(f"s{i}.split", b"x")
+    azure_server.list_page_size = 3
+    try:
+        assert azure.list_files() == [f"s{i}.split" for i in range(7)]
+    finally:
+        azure_server.list_page_size = None
+
+
+def test_azure_transient_500_retries(azure, azure_server):
+    azure.put("r.split", b"retry me")
+    azure_server.fail_requests = 1
+    assert azure.get_all("r.split") == b"retry me"
+
+
+def test_azure_split_search_end_to_end(azure_server):
+    """Index into Azure storage, search through the normal reader path —
+    the split format rides any Storage."""
+    from quickwit_tpu.index import SplitReader, SplitWriter
+    from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+    from quickwit_tpu.query.ast import Term
+    from quickwit_tpu.search import SearchRequest, leaf_search_single_split
+
+    mapper = DocMapper(
+        field_mappings=[
+            FieldMapping("ts", FieldType.DATETIME, fast=True,
+                         input_formats=("unix_timestamp",)),
+            FieldMapping("body", FieldType.TEXT),
+        ],
+        timestamp_field="ts", default_search_fields=("body",))
+    storage = AzureBlobStorage(
+        Uri.parse("azure://idx/az-e2e"),
+        AzureConfig(account="devacct", access_key=AZ_KEY,
+                    endpoint=azure_server.endpoint))
+    writer = SplitWriter(mapper)
+    for i in range(50):
+        writer.add_json_doc({"ts": 1000 + i, "body": f"doc {i} azureword"})
+    storage.put("s.split", writer.finish())
+    reader = SplitReader(storage, "s.split")
+    resp = leaf_search_single_split(
+        SearchRequest(index_ids=["t"], query_ast=Term("body", "azureword"),
+                      max_hits=5),
+        mapper, reader, "s")
+    assert resp.num_hits == 50
+
+
+def test_azure_resolver_wiring(azure_server, monkeypatch):
+    monkeypatch.setenv("AZURE_STORAGE_ACCOUNT", "devacct")
+    monkeypatch.setenv("AZURE_STORAGE_ACCESS_KEY", AZ_KEY)
+    monkeypatch.setenv("QW_AZURE_ENDPOINT", azure_server.endpoint)
+    resolver = StorageResolver.default()
+    storage = resolver.resolve("azure://idx/resolved")
+    storage.put("x.split", b"via resolver")
+    assert storage.get_all("x.split") == b"via resolver"
+
+
+# --- GCS (XML S3-interop protocol) -----------------------------------------
+
+@pytest.fixture(scope="module")
+def gcs_server():
+    fake = FakeS3Server(access_key="GOOGHMACID", secret_key="gcssecret"
+                        ).start()
+    yield fake
+    fake.stop()
+
+
+def test_gcs_roundtrip_signed(gcs_server):
+    storage = GcsStorage(
+        Uri.parse("gs://bucket/prefix"),
+        S3Config(endpoint=gcs_server.endpoint, region="auto",
+                 access_key="GOOGHMACID", secret_key="gcssecret"))
+    storage.put("g.split", b"hello gcs")
+    assert storage.get_all("g.split") == b"hello gcs"
+    assert storage.get_slice("g.split", 6, 9) == b"gcs"
+    assert storage.list_files() == ["g.split"]
+    storage.delete("g.split")
+    assert not storage.exists("g.split")
+    assert gcs_server.auth_failures == 0
+
+
+def test_gcs_env_config_and_resolver(gcs_server, monkeypatch):
+    monkeypatch.setenv("QW_GCS_ENDPOINT", gcs_server.endpoint)
+    monkeypatch.setenv("GCS_HMAC_KEY_ID", "GOOGHMACID")
+    monkeypatch.setenv("GCS_HMAC_SECRET", "gcssecret")
+    resolver = StorageResolver.default()
+    storage = resolver.resolve("gs://bucket/envprefix")
+    storage.put("e.split", b"env wired")
+    assert storage.get_all("e.split") == b"env wired"
+    # wrong secret is rejected by the signature-verifying fake
+    monkeypatch.setenv("GCS_HMAC_SECRET", "WRONG")
+    bad = GcsStorage(Uri.parse("gs://bucket/other"))
+    with pytest.raises(StorageError):
+        bad.put("x", b"nope")
+    assert gcs_server.auth_failures >= 1
