@@ -819,7 +819,6 @@ class Lowering:
                 return PMatchNone()
         if fm.type is FieldType.TEXT:
             return self._lower_text_range(ast, fm)
-        values_slot, present_slot = self._column_slots(ast.field)
         dtype = (np.float64 if fm.type is FieldType.F64
                  else np.uint64 if fm.type is FieldType.U64
                  else np.int64)
@@ -842,15 +841,62 @@ class Lowering:
             u64_parse = parse
             parse = lambda v: max(0, min(int(u64_parse(v)),  # noqa: E731
                                          (1 << 64) - 1))
-        lo_slot = hi_slot = -1
-        lo_incl = hi_incl = True
-        if ast.lower is not None:
-            lo_slot = self.b.add_scalar(parse(ast.lower.value), dtype)
-            lo_incl = ast.lower.inclusive
-        if ast.upper is not None:
-            hi_slot = self.b.add_scalar(parse(ast.upper.value), dtype)
-            hi_incl = ast.upper.inclusive
+        lo_val = parse(ast.lower.value) if ast.lower is not None else None
+        hi_val = parse(ast.upper.value) if ast.upper is not None else None
+        lo_incl = ast.lower.inclusive if ast.lower is not None else True
+        hi_incl = ast.upper.inclusive if ast.upper is not None else True
+
+        s32 = self._s32_range_slots(ast.field, fm, lo_val, lo_incl,
+                                    hi_val, hi_incl)
+        if s32 is not None:
+            return PRange(*s32, lo_incl, hi_incl)
+
+        values_slot, present_slot = self._column_slots(ast.field)
+        lo_slot = (self.b.add_scalar(lo_val, dtype)
+                   if lo_val is not None else -1)
+        hi_slot = (self.b.add_scalar(hi_val, dtype)
+                   if hi_val is not None else -1)
         return PRange(values_slot, present_slot, lo_slot, hi_slot, lo_incl, hi_incl)
+
+    def _s32_range_slots(self, field: str, fm: FieldMapping, lo_val,
+                         lo_incl: bool, hi_val, hi_incl: bool):
+        """i32-seconds fast path for datetime range filters (the range
+        twin of the date_histogram s32 path): i64 compares are emulated
+        on TPU and the µs values column is 2x the HBM bytes of the
+        derived seconds column. EXACT for whole-second inclusive-lower /
+        exclusive-upper bounds regardless of sub-second values, because
+        floor is monotone: ts >= L*1e6 <=> floor(ts/1e6) >= L, and
+        ts < U*1e6 <=> floor(ts/1e6) < U. Any other bound shape (or a
+        batch plan, whose per-split base would break uniformity) returns
+        None and takes the i64 path. Returns (values_slot, present_slot,
+        lo_slot, hi_slot) or None."""
+        if (fm.type is not FieldType.DATETIME or self.batch is not None
+                or (lo_val is not None
+                    and not (lo_incl and lo_val % 1_000_000 == 0))
+                or (hi_val is not None
+                    and not (not hi_incl and hi_val % 1_000_000 == 0))):
+            return None
+        meta = self.reader.field_meta(field)
+        vmin, vmax = meta.get("min_value"), meta.get("max_value")
+        if vmin is None:
+            return None
+        base_s = vmin // 1_000_000
+        # every compared quantity must fit i32 after the base shift;
+        # out-of-split bounds clamp (equivalent: they pass/fail all docs)
+        span_ok = (vmax // 1_000_000 - base_s) < 2**31 - 2
+        if not span_ok:
+            return None
+
+        def offset(bound_micros: int) -> int:
+            shifted = bound_micros // 1_000_000 - base_s
+            return int(max(-(2**31) + 2, min(shifted, 2**31 - 2)))
+
+        values_slot, present_slot = self._s32_column_slots(field, base_s)
+        lo_slot = (self.b.add_scalar(offset(lo_val), np.int32)
+                   if lo_val is not None else -1)
+        hi_slot = (self.b.add_scalar(offset(hi_val), np.int32)
+                   if hi_val is not None else -1)
+        return values_slot, present_slot, lo_slot, hi_slot
 
     def _or(self, nodes: list, scoring: bool = False) -> Any:
         nodes = [n for n in nodes if not isinstance(n, PMatchNone)]
@@ -980,13 +1026,8 @@ class Lowering:
                        and (vmax // 1_000_000 - base_s)
                        + abs(origin // 1_000_000 - base_s) < 2**31)
             if use_s32:
-                values_slot = self.b.add_array(
-                    f"col.{spec.field}.values_s32",
-                    lambda: self._seconds_column(spec.field, base_s))
-                # present column only — the i64 values column is not read
-                present_slot = self.b.add_array(
-                    f"col.{spec.field}.present",
-                    lambda: self.reader.column_values(spec.field)[1])
+                values_slot, present_slot = self._s32_column_slots(
+                    spec.field, base_s)
                 origin_slot = self.b.add_scalar(
                     origin // 1_000_000 - base_s, np.int32)
                 interval_slot = self.b.add_scalar(interval // 1_000_000, np.int32)
@@ -1259,12 +1300,8 @@ class Lowering:
                        and (vmax // 1_000_000 - base_s)
                        + abs(origin // 1_000_000 - base_s) < 2**31)
             if use_s32:
-                values_slot = self.b.add_array(
-                    f"col.{src.field}.values_s32",
-                    lambda: self._seconds_column(src.field, base_s))
-                present_slot = self.b.add_array(
-                    f"col.{src.field}.present",
-                    lambda: self.reader.column_values(src.field)[1])
+                values_slot, present_slot = self._s32_column_slots(
+                    src.field, base_s)
                 origin_slot = self.b.add_scalar(
                     origin // 1_000_000 - base_s, np.int32)
                 interval_slot = self.b.add_scalar(
@@ -1308,6 +1345,19 @@ class Lowering:
 
     def _ordinalize_numeric(self, field: str):
         return ordinalize_numeric_column(self.reader, field)
+
+    def _s32_column_slots(self, field: str, base_s: int) -> tuple[int, int]:
+        """(values_slot, present_slot) of the derived i32-seconds column —
+        the ONE place its cache keys and derivation are defined (shared by
+        the range fast path and both date_histogram lowerings)."""
+        values_slot = self.b.add_array(
+            f"col.{field}.values_s32",
+            lambda: self._seconds_column(field, base_s))
+        # present column only — the i64 values column is not read
+        present_slot = self.b.add_array(
+            f"col.{field}.present",
+            lambda: self.reader.column_values(field)[1])
+        return values_slot, present_slot
 
     def _seconds_column(self, field: str, base_s: int) -> np.ndarray:
         """Derived i32 seconds column, cached per reader."""

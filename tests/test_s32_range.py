@@ -1,0 +1,143 @@
+"""i32-seconds fast path for datetime range filters: exactness against
+the i64 path on sub-second timestamps, eligibility gating, and array
+sharing with the date_histogram s32 column."""
+
+import numpy as np
+import pytest
+
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.index import SplitReader, SplitWriter
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.query.ast import MatchAll, Range, RangeBound
+from quickwit_tpu.search import SearchRequest, leaf_search_single_split
+from quickwit_tpu.search.leaf import prepare_plan_only
+from quickwit_tpu.storage import RamStorage
+
+MAPPER = DocMapper(
+    field_mappings=[
+        FieldMapping("ts", FieldType.DATETIME, fast=True,
+                     input_formats=("unix_timestamp",)),
+        FieldMapping("body", FieldType.TEXT),
+    ],
+    timestamp_field="ts", default_search_fields=("body",))
+
+BASE = 1_600_000_000 * 1_000_000
+
+
+@pytest.fixture(scope="module")
+def env():
+    rng = np.random.RandomState(17)
+    docs = []
+    writer = SplitWriter(MAPPER)
+    for i in range(400):
+        # sub-second offsets: the dangerous case for seconds-granularity
+        # comparisons
+        ts = BASE + int(rng.randint(0, 3600)) * 1_000_000 \
+            + int(rng.randint(0, 1_000_000))
+        docs.append(ts)
+        writer.add_json_doc({"ts": ts, "body": f"m{i % 3}"})
+    storage = RamStorage(Uri.parse("ram:///s32"))
+    storage.put("s.split", writer.finish())
+    return docs, SplitReader(storage, "s.split")
+
+
+def _search(reader, lower=None, upper=None):
+    request = SearchRequest(
+        index_ids=["t"], max_hits=0,
+        query_ast=Range("ts", lower=lower, upper=upper))
+    return leaf_search_single_split(request, MAPPER, reader, "s").num_hits
+
+
+def _plan(reader, lower=None, upper=None, aggs=None):
+    request = SearchRequest(
+        index_ids=["t"], max_hits=0,
+        query_ast=Range("ts", lower=lower, upper=upper), aggs=aggs)
+    return prepare_plan_only(request, MAPPER, reader, "s")
+
+
+def test_whole_second_gte_lt_uses_s32_and_is_exact(env):
+    docs, reader = env
+    lo = BASE + 600 * 1_000_000
+    hi = BASE + 2400 * 1_000_000
+    plan = _plan(reader, RangeBound(lo, True), RangeBound(hi, False))
+    keys = set(plan.array_keys)
+    assert "col.ts.values_s32" in keys      # fast path engaged
+    assert "col.ts.values" not in keys      # i64 column never transferred
+    got = _search(reader, RangeBound(lo, True), RangeBound(hi, False))
+    assert got == sum(1 for t in docs if lo <= t < hi)
+
+
+@pytest.mark.parametrize("lower,upper", [
+    # sub-second bound
+    (RangeBound(BASE + 600 * 1_000_000 + 123, True), None),
+    # exclusive lower
+    (RangeBound(BASE + 600 * 1_000_000, False),
+     RangeBound(BASE + 2400 * 1_000_000, False)),
+    # inclusive upper
+    (RangeBound(BASE + 600 * 1_000_000, True),
+     RangeBound(BASE + 2400 * 1_000_000, True)),
+])
+def test_other_bound_shapes_fall_back_and_stay_exact(env, lower, upper):
+    docs, reader = env
+    plan = _plan(reader, lower, upper)
+    assert "col.ts.values" in set(plan.array_keys)  # i64 path
+
+    def keep(t):
+        if lower is not None:
+            if lower.inclusive and t < lower.value:
+                return False
+            if not lower.inclusive and t <= lower.value:
+                return False
+        if upper is not None:
+            if upper.inclusive and t > upper.value:
+                return False
+            if not upper.inclusive and t >= upper.value:
+                return False
+        return True
+
+    assert _search(reader, lower, upper) == sum(1 for t in docs if keep(t))
+
+
+def test_boundary_docs_decide_identically(env):
+    """Docs exactly AT a whole-second bound: the floor argument in the
+    docstring, exercised for both bounds."""
+    _docs, reader = env
+    writer = SplitWriter(MAPPER)
+    edge = BASE + 100 * 1_000_000
+    for ts in (edge - 1, edge, edge + 1,
+               edge + 999_999, edge + 1_000_000):
+        writer.add_json_doc({"ts": ts, "body": "edge"})
+    storage = RamStorage(Uri.parse("ram:///s32edge"))
+    storage.put("e.split", writer.finish())
+    edge_reader = SplitReader(storage, "e.split")
+    # [edge, edge+1s): includes edge, edge+1, edge+999999
+    got = _search(edge_reader, RangeBound(edge, True),
+                  RangeBound(edge + 1_000_000, False))
+    assert got == 3
+
+
+def test_s32_column_shared_with_date_histogram(env):
+    """Range + date_histogram on the same field: ONE derived s32 column
+    serves both (same base, same cache key)."""
+    _docs, reader = env
+    plan = _plan(reader,
+                 RangeBound(BASE + 600 * 1_000_000, True),
+                 RangeBound(BASE + 2400 * 1_000_000, False),
+                 aggs={"per_min": {"date_histogram": {
+                     "field": "ts", "fixed_interval": "1m"}}})
+    assert plan.array_keys.count("col.ts.values_s32") == 1
+
+
+def test_request_time_filter_rides_s32(env):
+    """The request-level start/end timestamp filter (whole-µs bounds,
+    gte/lt semantics) lowers onto the s32 path too."""
+    docs, reader = env
+    lo = BASE + 600 * 1_000_000
+    hi = BASE + 2400 * 1_000_000
+    request = SearchRequest(index_ids=["t"], max_hits=0,
+                            query_ast=MatchAll(),
+                            start_timestamp=lo, end_timestamp=hi)
+    plan = prepare_plan_only(request, MAPPER, reader, "s")
+    assert "col.ts.values_s32" in set(plan.array_keys)
+    resp = leaf_search_single_split(request, MAPPER, reader, "s")
+    assert resp.num_hits == sum(1 for t in docs if lo <= t < hi)
