@@ -206,10 +206,21 @@ class _ColumnBuilder:
         # pair arrays for terms aggregations (reference: multivalued fast
         # fields)
         self.multi: dict[int, list] = {}
+        # zonemap bounds track EVERY value, not just the first one the
+        # dense column keeps — Term/Range matching goes through the
+        # inverted index, which indexes all of a doc's values, so
+        # first-value-only bounds could prune a split that matches
+        self.vmin: Any = None
+        self.vmax: Any = None
 
     def add(self, doc_id: int, value: Any) -> None:
         if not self.is_numeric:
             self.multi.setdefault(doc_id, []).append(value)
+        else:
+            if self.vmin is None or value < self.vmin:
+                self.vmin = value
+            if self.vmax is None or value > self.vmax:
+                self.vmax = value
         # numeric columns keep the first value (dense single-valued)
         self.values.setdefault(doc_id, value)
 
@@ -240,6 +251,9 @@ class SplitWriter:
         self._time_min: Optional[int] = None
         self._time_max: Optional[int] = None
         self.tags: set[str] = set()
+        # filled by finish(): per-field zonemap bounds of the mapped
+        # numeric fast columns
+        self.column_bounds: dict[str, tuple[Any, Any]] = {}
 
     def add_json_doc(self, doc: dict[str, Any]) -> int:
         return self.add_typed_doc(self.doc_mapper.doc_from_json(doc))
@@ -336,6 +350,17 @@ class SplitWriter:
                 meta["col_type"] = col.fm.type.value
                 meta.update(self._write_column(builder, name, col, num_docs_padded))
         self._write_docstore(builder)
+
+        # split-granular zonemap: bounds over EVERY value of each
+        # explicitly-mapped numeric field (i64/u64/f64 — the only fields
+        # the root's constraint extraction consults; dynamic columns and
+        # synthetic fields would be metastore dead weight)
+        from ..models.doc_mapper import FieldType as _FT
+        self.column_bounds = {
+            name: (col.vmin, col.vmax)
+            for name, col in self._cols.items()
+            if col.vmin is not None
+            and col.fm.type in (_FT.I64, _FT.U64, _FT.F64)}
 
         footer = SplitFooter(
             num_docs=self.num_docs,

@@ -112,6 +112,23 @@ def merge_policy_from_config(config: dict) -> MergePolicy:
     raise ValueError(f"unknown merge policy {kind!r}")
 
 
+def _merge_column_bounds(splits) -> dict:
+    """Zonemap union over merge inputs: min of mins / max of maxes. A
+    field is kept only when EVERY input carries bounds for it — a split
+    without the entry might be a pre-zonemap split that still holds
+    values, so dropping the field is the only sound choice."""
+    if not splits:
+        return {}
+    common = set(splits[0].metadata.column_bounds)
+    for split in splits[1:]:
+        common &= set(split.metadata.column_bounds)
+    out = {}
+    for name in common:
+        bounds = [s.metadata.column_bounds[name] for s in splits]
+        out[name] = (min(b[0] for b in bounds), max(b[1] for b in bounds))
+    return out
+
+
 def _iter_all_docs(reader: SplitReader):
     """Stream every stored document of a split in doc-id order."""
     import json
@@ -173,7 +190,8 @@ class MergeExecutor:
             tags = frozenset().union(*(s.metadata.tags for s in operation.splits))
             return self._publish_merged(
                 operation, data, num_docs, uncompressed, time_min, time_max,
-                tags, max_delete_opstamp)
+                tags, max_delete_opstamp,
+                _merge_column_bounds(operation.splits))
         # delete tasks pending: doc-level rewrite applies them
         writer = SplitWriter(self.doc_mapper)
         for reader in readers:
@@ -190,10 +208,12 @@ class MergeExecutor:
         return self._publish_merged(
             operation, data, writer.num_docs, writer._uncompressed_docs_size,
             writer._time_min, writer._time_max, frozenset(writer.tags),
-            max_delete_opstamp)
+            max_delete_opstamp,
+            dict(writer.column_bounds))
 
     def _publish_merged(self, operation, data, num_docs, uncompressed,
-                        time_min, time_max, tags, max_delete_opstamp):
+                        time_min, time_max, tags, max_delete_opstamp,
+                        column_bounds=None):
         merged_id = new_split_id()
         metadata = SplitMetadata(
             split_id=merged_id,
@@ -211,6 +231,7 @@ class MergeExecutor:
             delete_opstamp=max_delete_opstamp,
             doc_mapping_uid=operation.splits[0].metadata.doc_mapping_uid,
             partition_id=operation.splits[0].metadata.partition_id,
+            column_bounds=column_bounds or {},
         )
         self.metastore.stage_splits(self.index_uid, [metadata])
         self.split_storage.put(split_file_path(merged_id), data)

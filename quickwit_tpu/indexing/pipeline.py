@@ -164,6 +164,7 @@ class IndexingPipeline:
                 create_timestamp=int(time.time()),
                 doc_mapping_uid=self.params.doc_mapping_uid,
                 partition_id=partition,
+                column_bounds=dict(writer.column_bounds),
             ), data))
         # stage → upload → publish: a crash between stages leaves either a
         # staged-but-absent split (GC'd) or an uploaded-but-unpublished file

@@ -44,6 +44,11 @@ class SplitMetadata:
     num_merge_ops: int = 0
     doc_mapping_uid: str = "default"
     partition_id: int = 0
+    # per-column min/max of the split's numeric fast columns — the
+    # split-granular zonemap (reference: quickwit-parquet-engine
+    # src/zonemap/): the root prunes splits whose bounds preclude a
+    # required numeric predicate before any byte of them is fetched
+    column_bounds: dict[str, tuple[Any, Any]] = field(default_factory=dict)
 
     def is_mature(self, now_ts: Optional[float] = None) -> bool:
         if self.maturity_timestamp == 0:
@@ -83,6 +88,8 @@ class SplitMetadata:
             "num_merge_ops": self.num_merge_ops,
             "doc_mapping_uid": self.doc_mapping_uid,
             "partition_id": self.partition_id,
+            "column_bounds": {name: list(bounds) for name, bounds
+                              in self.column_bounds.items()},
         }
 
     @staticmethod
@@ -102,6 +109,8 @@ class SplitMetadata:
             num_merge_ops=d.get("num_merge_ops", 0),
             doc_mapping_uid=d.get("doc_mapping_uid", "default"),
             partition_id=d.get("partition_id", 0),
+            column_bounds={name: tuple(bounds) for name, bounds
+                           in d.get("column_bounds", {}).items()},
         )
 
 

@@ -55,6 +55,102 @@ def extract_required_tags(ast: Q.QueryAst, tag_fields: tuple[str, ...]) -> set[s
     return tags
 
 
+def extract_numeric_constraints(ast: Q.QueryAst,
+                                doc_mapper) -> dict[str, tuple]:
+    """Required numeric constraints per field, for zonemap pruning
+    (reference: `quickwit-parquet-engine/src/zonemap/` min/max pruning —
+    here at split granularity; doc granularity is the device masks).
+    Like tag pruning, only purely conjunctive positions count; only
+    fields EXPLICITLY mapped numeric (i64/u64/f64) participate —
+    datetime bounds are unit-ambiguous before input-format parsing
+    (seconds vs micros) and dynamic columns have uncertain coercion, so
+    either could prune wrongly. Returns field -> (lo, lo_incl, hi,
+    hi_incl) with None = unbounded; multiple constraints on one field
+    intersect."""
+    from ..models.doc_mapper import FieldType
+    out: dict[str, tuple] = {}
+
+    def numeric_field(field: str) -> bool:
+        fm = doc_mapper.field(field)
+        return fm is not None and fm.type in (
+            FieldType.I64, FieldType.U64, FieldType.F64)
+
+    def tighten(field: str, lo, lo_incl, hi, hi_incl) -> None:
+        cur = out.get(field, (None, True, None, True))
+        clo, clo_incl, chi, chi_incl = cur
+        if lo is not None and (clo is None or lo > clo
+                               or (lo == clo and not lo_incl)):
+            clo, clo_incl = lo, lo_incl
+        if hi is not None and (chi is None or hi < chi
+                               or (hi == chi and not hi_incl)):
+            chi, chi_incl = hi, hi_incl
+        out[field] = (clo, clo_incl, chi, chi_incl)
+
+    def numeric(value, field: str):
+        """Parse a bound EXACTLY as the leaf's `_parse_bound` does —
+        int() truncation for i64/u64 and the ES u64 domain clamp — so
+        the root can never prune a split the leaf would match."""
+        if isinstance(value, bool) or value is None:
+            return None
+        fm = doc_mapper.field(field)
+        try:
+            if fm.type is FieldType.F64:
+                return float(value)
+            parsed = int(value)  # leaf plan.py _parse_bound semantics
+        except (ValueError, TypeError):
+            return None
+        if fm.type is FieldType.U64:
+            parsed = max(0, min(parsed, (1 << 64) - 1))
+        return parsed
+
+    def walk(node) -> None:
+        if isinstance(node, Q.Range) and numeric_field(node.field):
+            lo = (numeric(node.lower.value, node.field)
+                  if node.lower is not None else None)
+            hi = (numeric(node.upper.value, node.field)
+                  if node.upper is not None else None)
+            if (node.lower is not None and lo is None) \
+                    or (node.upper is not None and hi is None):
+                return  # unparseable bound: skip
+            tighten(node.field, lo,
+                    node.lower.inclusive if node.lower else True,
+                    hi, node.upper.inclusive if node.upper else True)
+        elif isinstance(node, Q.Term) and numeric_field(node.field):
+            value = numeric(node.value, node.field)
+            if value is not None:
+                tighten(node.field, value, True, value, True)
+        elif isinstance(node, Q.Bool) and not node.should:
+            for child in node.must + node.filter:
+                walk(child)
+        elif isinstance(node, Q.Boost):
+            walk(node.underlying)
+
+    walk(ast)
+    return out
+
+
+def split_excluded_by_bounds(column_bounds: dict,
+                             constraints: dict[str, tuple]) -> bool:
+    """True when some required constraint cannot match any value within
+    the split's recorded [min, max] for that column. Fields without
+    recorded bounds (text columns, pre-zonemap splits) never prune."""
+    for field, (lo, lo_incl, hi, hi_incl) in constraints.items():
+        bounds = column_bounds.get(field)
+        if bounds is None:
+            continue
+        bmin, bmax = bounds
+        try:
+            if lo is not None and (bmax < lo
+                                   or (bmax == lo and not lo_incl)):
+                return True
+            if hi is not None and (bmin > hi
+                                   or (bmin == hi and not hi_incl)):
+                return True
+        except TypeError:
+            continue  # incomparable types: never prune
+    return False
+
+
 class RootSearcher:
     def __init__(
         self,
@@ -205,7 +301,15 @@ class RootSearcher:
             time_range_end=request.end_timestamp,
             required_tags=required_tags,
         )
-        return self.metastore.list_splits(query)
+        splits = self.metastore.list_splits(query)
+        # zonemap pruning: drop splits whose numeric column bounds
+        # preclude a required predicate, before any byte is fetched
+        constraints = extract_numeric_constraints(request.query_ast,
+                                                  doc_mapper)
+        if constraints:
+            splits = [s for s in splits if not split_excluded_by_bounds(
+                s.metadata.column_bounds, constraints)]
+        return splits
 
     def _leaf_search_with_retry(self, leaf_request: LeafSearchRequest,
                                 node_id: str, nodes: list[str]) -> LeafSearchResponse:

@@ -1,0 +1,197 @@
+"""Split-granular zonemap pruning (reference:
+quickwit-parquet-engine/src/zonemap/ min/max pruning): numeric
+fast-column bounds recorded at publish, merged through compaction, and
+used by the root to skip splits whose bounds preclude a required
+predicate — without opening them."""
+
+import pytest
+
+from quickwit_tpu.index import SplitWriter
+from quickwit_tpu.indexing import IndexingPipeline, PipelineParams, VecSource
+from quickwit_tpu.indexing.merge import MergeExecutor, MergeOperation
+from quickwit_tpu.metastore import FileBackedMetastore, ListSplitsQuery
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.models.index_metadata import (
+    IndexConfig, IndexMetadata, SourceConfig)
+from quickwit_tpu.models.split_metadata import SplitState
+from quickwit_tpu.query.ast import Bool, Range, RangeBound, Term
+from quickwit_tpu.search.root import (
+    RootSearcher, extract_numeric_constraints, split_excluded_by_bounds)
+from quickwit_tpu.search import SearchRequest
+from quickwit_tpu.search.service import (
+    LocalSearchClient, SearcherContext, SearchService)
+from quickwit_tpu.storage import StorageResolver
+
+MAPPER = DocMapper(
+    field_mappings=[
+        FieldMapping("ts", FieldType.DATETIME, fast=True,
+                     input_formats=("unix_timestamp",)),
+        FieldMapping("status", FieldType.U64, fast=True),
+        FieldMapping("latency", FieldType.F64, fast=True),
+        FieldMapping("body", FieldType.TEXT),
+    ],
+    timestamp_field="ts", default_search_fields=("body",))
+
+
+def test_writer_records_column_bounds():
+    writer = SplitWriter(MAPPER)
+    for i in range(20):
+        writer.add_json_doc({"ts": 1000 + i, "status": 200 + i % 3,
+                             "latency": float(i), "body": "x"})
+    writer.finish()
+    bounds = writer.column_bounds
+    assert bounds["status"] == (200, 202)
+    assert bounds["latency"] == (0.0, 19.0)
+    # only fields the root's pruning consults are published: datetime
+    # bounds are unit-ambiguous (time pruning covers them) and text
+    # columns have no zonemap
+    assert "ts" not in bounds
+    assert "body" not in bounds
+
+
+def test_bounds_cover_multivalued_numeric_fields():
+    """The dense column keeps each doc's FIRST value, but Term/Range
+    matching goes through the inverted index over ALL values — bounds
+    must cover every value or pruning would hide multivalued docs."""
+    mapper = DocMapper(
+        field_mappings=[
+            FieldMapping("ts", FieldType.DATETIME, fast=True,
+                         input_formats=("unix_timestamp",)),
+            FieldMapping("code", FieldType.I64, fast=True),
+        ],
+        timestamp_field="ts")
+    writer = SplitWriter(mapper)
+    writer.add_json_doc({"ts": 1, "code": [2, 500]})
+    writer.finish()
+    assert writer.column_bounds["code"] == (2, 500)
+    # a Term(code, 500) constraint must NOT exclude these bounds
+    assert not split_excluded_by_bounds(
+        writer.column_bounds, {"code": (500, True, 500, True)})
+
+
+def test_root_bound_coercion_matches_leaf():
+    """Float bounds on integer fields truncate at the leaf (int());
+    the root must coerce identically or it would prune splits the leaf
+    matches. u64 bounds clamp to the domain the same way."""
+    constraints = extract_numeric_constraints(
+        Range("status", lower=RangeBound(10.5, True)), MAPPER)
+    assert constraints["status"] == (10, True, None, True)
+    constraints = extract_numeric_constraints(
+        Range("status", upper=RangeBound(-1, False)), MAPPER)
+    # clamped to 0: bounds containing 0 must NOT be excluded outright
+    assert constraints["status"][2] == 0
+
+
+def test_constraint_extraction_conjunctive_only():
+    constraints = extract_numeric_constraints(
+        Bool(must=(Term("status", "500"),),
+             filter=(Range("latency", lower=RangeBound(10.0, True),
+                           upper=RangeBound(50.0, False)),)), MAPPER)
+    assert constraints["status"] == (500, True, 500, True)
+    assert constraints["latency"] == (10.0, True, 50.0, False)
+    # disjunctions must NOT produce constraints
+    assert extract_numeric_constraints(
+        Bool(should=(Term("status", "500"), Term("status", "200"))),
+        MAPPER) == {}
+    # datetime fields are excluded (unit-ambiguous bounds)
+    assert extract_numeric_constraints(
+        Range("ts", lower=RangeBound(1600000600, True)), MAPPER) == {}
+    # text fields with numeric-looking terms are excluded
+    assert extract_numeric_constraints(Term("body", "500"), MAPPER) == {}
+
+
+def test_exclusion_logic_boundaries():
+    bounds = {"status": (200, 404)}
+    # overlapping: keep
+    assert not split_excluded_by_bounds(
+        bounds, {"status": (404, True, None, True)})
+    # strictly above the max: prune
+    assert split_excluded_by_bounds(
+        bounds, {"status": (405, True, None, True)})
+    # exclusive bound exactly at the max: prune
+    assert split_excluded_by_bounds(
+        bounds, {"status": (404, False, None, True)})
+    # below the min, exclusive upper at min: prune
+    assert split_excluded_by_bounds(
+        bounds, {"status": (None, True, 200, False)})
+    # unknown field: never prune
+    assert not split_excluded_by_bounds(
+        {}, {"status": (9999, True, None, True)})
+
+
+@pytest.fixture
+def cluster():
+    resolver = StorageResolver.for_test()
+    meta_storage = resolver.resolve("ram:///zm/ms")
+    split_storage = resolver.resolve("ram:///zm/splits")
+    metastore = FileBackedMetastore(meta_storage)
+    metastore.create_index(IndexMetadata(
+        index_uid="zm:01",
+        index_config=IndexConfig(index_id="zm", index_uri="ram:///zm/splits",
+                                 doc_mapper=MAPPER),
+        sources={"src": SourceConfig("src", "vec"),
+                 "src2": SourceConfig("src2", "vec")}))
+
+    def index(docs, source_id):
+        params = PipelineParams(index_uid="zm:01", source_id=source_id,
+                                split_num_docs_target=10**6,
+                                batch_num_docs=100)
+        IndexingPipeline(params, MAPPER, VecSource(docs), metastore,
+                         split_storage).run_to_completion()
+
+    # split A: statuses 200-204; split B: statuses 500-504
+    index([{"ts": 1000 + i, "status": 200 + i % 5, "latency": float(i),
+            "body": "a"} for i in range(50)], "src")
+    index([{"ts": 5000 + i, "status": 500 + i % 5, "latency": 100.0 + i,
+            "body": "b"} for i in range(50)], "src2")
+
+    context = SearcherContext(storage_resolver=resolver)
+    service = SearchService(context)
+    root = RootSearcher(metastore, {"local": LocalSearchClient(service)})
+    return metastore, split_storage, root
+
+
+def test_root_prunes_splits_by_bounds(cluster):
+    metastore, _storage, root = cluster
+    splits = metastore.list_splits(ListSplitsQuery(
+        index_uids=["zm:01"], states=[SplitState.PUBLISHED]))
+    assert len(splits) == 2
+    assert all(s.metadata.column_bounds for s in splits)
+
+    md = metastore.index_metadata("zm")
+
+    def planned(request):
+        return len(root._prune_splits(md, MAPPER, request))
+
+    # status >= 500: only split B is planned; results stay exact
+    request = SearchRequest(
+        index_ids=["zm"], max_hits=5,
+        query_ast=Range("status", lower=RangeBound(500, True)))
+    assert planned(request) == 1
+    assert root.search(request).num_hits == 50
+
+    # status == 700: nothing qualifies, no split planned at all
+    request = SearchRequest(index_ids=["zm"], max_hits=5,
+                            query_ast=Term("status", "700"))
+    assert planned(request) == 0
+    assert root.search(request).num_hits == 0
+
+    # no numeric constraint: both splits planned (no over-pruning)
+    request = SearchRequest(index_ids=["zm"], max_hits=5,
+                            query_ast=Term("body", "a"))
+    assert planned(request) == 2
+    assert root.search(request).num_hits == 50
+
+
+def test_bounds_survive_merge(cluster):
+    metastore, split_storage, _root = cluster
+    splits = metastore.list_splits(ListSplitsQuery(
+        index_uids=["zm:01"], states=[SplitState.PUBLISHED]))
+    executor = MergeExecutor("zm:01", MAPPER, metastore, split_storage)
+    executor.execute(MergeOperation(tuple(splits)))
+    merged = metastore.list_splits(ListSplitsQuery(
+        index_uids=["zm:01"], states=[SplitState.PUBLISHED]))
+    assert len(merged) == 1
+    bounds = merged[0].metadata.column_bounds
+    assert bounds["status"] == (200, 504)
+    assert bounds["latency"] == (0.0, 149.0)
