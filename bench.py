@@ -115,13 +115,48 @@ def _ensure_device_or_fall_back() -> str:
 # workloads
 
 
+def _cached_split_bytes(tag: str, build) -> bytes:
+    """Disk cache for generated benchmark splits: the CPU comparison
+    child regenerates IDENTICAL corpora (same seeds) — at the realistic
+    corpus scale (100k vocab, 20 tokens/doc, 10M docs) generation costs
+    minutes, so parent and child share the bytes through .bench_cache.
+    The cache key carries the generator parameters, so changing them
+    invalidates naturally."""
+    from quickwit_tpu.index.synthetic import (
+        _BODY_TOKENS_PER_DOC, _BODY_VOCAB_SIZE, _SO_TOKENS_PER_DOC,
+        _SO_VOCAB_SIZE)
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".bench_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    # the key also hashes the generator SOURCE, so any change to the
+    # synthetic corpus code (distribution knobs, split format emitted by
+    # the builders) invalidates stale cached bytes
+    import hashlib
+    import quickwit_tpu.index.synthetic as _synth_mod
+    with open(_synth_mod.__file__, "rb") as fh:
+        gen_hash = hashlib.md5(fh.read()).hexdigest()[:10]
+    params = (f"{tag}-v{_BODY_VOCAB_SIZE}x{_BODY_TOKENS_PER_DOC}"
+              f"-s{_SO_VOCAB_SIZE}x{_SO_TOKENS_PER_DOC}-g{gen_hash}")
+    path = os.path.join(cache_dir, f"{params}.split")
+    if os.path.exists(path):
+        with open(path, "rb") as fh:
+            return fh.read()
+    data = build()
+    with open(path + ".tmp", "wb") as fh:
+        fh.write(data)
+    os.replace(path + ".tmp", path)
+    return data
+
+
 def _hdfs_reader(num_docs: int, seed: int = 7):
     from quickwit_tpu.common.uri import Uri
     from quickwit_tpu.index.reader import SplitReader
     from quickwit_tpu.index.synthetic import synthetic_hdfs_split
     from quickwit_tpu.storage.ram import RamStorage
     storage = RamStorage(Uri.parse("ram:///bench"))
-    storage.put("hdfs.split", synthetic_hdfs_split(num_docs, seed=seed))
+    storage.put("hdfs.split", _cached_split_bytes(
+        f"hdfs-{num_docs}-{seed}",
+        lambda: synthetic_hdfs_split(num_docs, seed=seed)))
     return SplitReader(storage, "hdfs.split")
 
 
@@ -131,7 +166,9 @@ def _so_reader(num_docs: int, seed: int = 11):
     from quickwit_tpu.index.synthetic import synthetic_stackoverflow_split
     from quickwit_tpu.storage.ram import RamStorage
     storage = RamStorage(Uri.parse("ram:///bench"))
-    storage.put("so.split", synthetic_stackoverflow_split(num_docs, seed=seed))
+    storage.put("so.split", _cached_split_bytes(
+        f"so-{num_docs}-{seed}",
+        lambda: synthetic_stackoverflow_split(num_docs, seed=seed)))
     return SplitReader(storage, "so.split")
 
 
@@ -322,6 +359,21 @@ def _native_cpu_leaf(plan, request, reference_count: int,
     return {"native_cpu_ms": round(_percentile(lat, 0.5) * 1000, 3)}
 
 
+def _batch_width_for(plan) -> int:
+    """Queries per dispatch, bounded by per-lane device footprint: dense
+    plans materialize [num_docs_padded] masks/scores/keys per lane, so a
+    16-wide vmap over a 10M-doc dense plan would stack multi-GB
+    intermediates; posting-space plans are far lighter."""
+    from quickwit_tpu.search import executor as ex
+    if ex._posting_space_eligible(plan):
+        return PIPELINE_BATCH
+    # dense per-lane intermediates ~ padded * ~48B (per-clause masks +
+    # scores + f64 keys + sort scratch); keep the stack under ~4 GB
+    per_lane = plan.num_docs_padded * 48
+    width = max(1, min(PIPELINE_BATCH, (4 << 30) // max(per_lane, 1)))
+    return 1 << (width.bit_length() - 1)  # power-of-two bucket
+
+
 def _measure_batched_throughput(plan, k, device_arrays, num_queries: int,
                                 batch: int) -> dict:
     """Per-query latency with `num_queries` concurrent queries executed as
@@ -394,12 +446,17 @@ def _measure_single_split(request, mapper, reader, iters: int,
     plan, device_arrays, _ = prepare_single_split(
         request, mapper, reader, "bench")
     k = request.start_offset + request.max_hits
+    width = _batch_width_for(plan)
     if not full:
         # CPU comparison child: e2e p50 + the SAME batched-throughput path
         # the TPU pipe number uses, so the pipelined ratio denominator is
         # the CPU's own best concurrent-query number, not its 1-shot one
-        stats.update(_measure_batched_throughput(
-            plan, k, device_arrays, PIPELINE_QUERIES, PIPELINE_BATCH))
+        try:
+            stats.update(_measure_batched_throughput(
+                plan, k, device_arrays, PIPELINE_QUERIES, width))
+        except Exception as exc:  # noqa: BLE001 - denominator must survive
+            print(f"# cpu batched path failed ({exc}); e2e only",
+                  file=sys.stderr)
         return stats
 
     stats["hbm_bytes"] = _estimate_bytes(plan)
@@ -411,9 +468,15 @@ def _measure_single_split(request, mapper, reader, iters: int,
     if native:
         stats.update(native)
 
-    # pipelined throughput: concurrent queries ride multi-query dispatches
-    stats.update(_measure_batched_throughput(
-        plan, k, device_arrays, PIPELINE_QUERIES, PIPELINE_BATCH))
+    # pipelined throughput: concurrent queries ride multi-query dispatches.
+    # An untested-on-hardware failure (vmapped compile OOM etc.) must not
+    # kill the bench: fall back to the solo-dispatch pipelined metric.
+    try:
+        stats.update(_measure_batched_throughput(
+            plan, k, device_arrays, PIPELINE_QUERIES, width))
+    except Exception as exc:  # noqa: BLE001 - record, fall back below
+        print(f"# batched dispatch failed ({exc}); falling back to "
+              "solo-dispatch pipelining", file=sys.stderr)
 
     # legacy one-query-per-dispatch pipelining, for the record: bounded by
     # the per-dispatch tunnel round (tools/profile_tunnel.py)
@@ -433,6 +496,9 @@ def _measure_single_split(request, mapper, reader, iters: int,
         ex.readback_plan_result(inflight.pop(0))
     stats["pipe_solo_ms"] = round(
         (time.monotonic() - t0) * 1000 / PIPELINE_QUERIES, 2)
+    if "pipe_ms" not in stats:  # batched path failed: solo is the metric
+        stats["pipe_ms"] = stats["pipe_solo_ms"]
+        stats["pipe_batch"] = 1
 
     # device time: fori_loop N-deep inside one dispatch, two depths
     single_fn = ex._build(plan, max(0, min(k, plan.num_docs_padded)))

@@ -360,7 +360,11 @@ class SplitWriter:
             name: (col.vmin, col.vmax)
             for name, col in self._cols.items()
             if col.vmin is not None
-            and col.fm.type in (_FT.I64, _FT.U64, _FT.F64)}
+            and col.fm.type in (_FT.I64, _FT.U64, _FT.F64)
+            # synthetic columns (_doc_length) are not mapped fields: the
+            # root never consults them, so publishing their bounds would
+            # be per-split metastore dead weight
+            and self.doc_mapper.field(name) is not None}
 
         footer = SplitFooter(
             num_docs=self.num_docs,

@@ -241,6 +241,22 @@ class CompositeAggExec:
                 + ",".join(s.sig() for s in self.subs) + ")")
 
 
+def coerce_numeric_bound(field_type: FieldType, value: Any):
+    """Numeric range-bound coercion shared by the leaf lowering
+    (`_parse_bound`) and the root's zonemap pruning
+    (`root.extract_numeric_constraints`) — the two MUST stay identical or
+    the root could prune a split the leaf matches: int() truncation for
+    integer fields, the ES u64 domain clamp, float for f64. Raises
+    ValueError/TypeError on unparseable input."""
+    if field_type is FieldType.F64:
+        return float(value)
+    parsed = int(value)
+    if field_type is FieldType.U64:
+        # ES clamps out-of-domain u64 bounds instead of erroring
+        parsed = max(0, min(parsed, (1 << 64) - 1))
+    return parsed
+
+
 def aligned_origin(vmin, interval, offset=0):
     """ES bucket alignment shared by every histogram lowering (plain and
     composite): the bucket boundary k*interval + offset at or below vmin.
@@ -476,10 +492,10 @@ class Lowering:
             return parse_datetime_to_micros(value, fm.input_formats) \
                 if not isinstance(value, (int, float)) or isinstance(value, bool) \
                 else parse_datetime_to_micros(value, ("unix_timestamp",))
-        if fm.type in (FieldType.I64, FieldType.U64, FieldType.IP):
+        if fm.type in (FieldType.I64, FieldType.U64, FieldType.F64):
+            return coerce_numeric_bound(fm.type, value)
+        if fm.type is FieldType.IP:
             return int(value)
-        if fm.type is FieldType.F64:
-            return float(value)
         if fm.type is FieldType.BOOL:
             return 1 if str(value).lower() == "true" else 0
         raise PlanError(f"range query unsupported on field type {fm.type}")
@@ -836,11 +852,6 @@ class Lowering:
             base_parse = parse
             parse = lambda v: truncate_to_precision(  # noqa: E731
                 base_parse(v), fm.fast_precision)
-        if fm.type is FieldType.U64:
-            # ES clamps out-of-domain u64 bounds instead of erroring
-            u64_parse = parse
-            parse = lambda v: max(0, min(int(u64_parse(v)),  # noqa: E731
-                                         (1 << 64) - 1))
         lo_val = parse(ast.lower.value) if ast.lower is not None else None
         hi_val = parse(ast.upper.value) if ast.upper is not None else None
         lo_incl = ast.lower.inclusive if ast.lower is not None else True

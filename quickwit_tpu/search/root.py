@@ -87,21 +87,15 @@ def extract_numeric_constraints(ast: Q.QueryAst,
         out[field] = (clo, clo_incl, chi, chi_incl)
 
     def numeric(value, field: str):
-        """Parse a bound EXACTLY as the leaf's `_parse_bound` does —
-        int() truncation for i64/u64 and the ES u64 domain clamp — so
-        the root can never prune a split the leaf would match."""
+        """THE leaf's bound coercion (shared helper — a drift between
+        leaf matching and root pruning would silently lose hits)."""
         if isinstance(value, bool) or value is None:
             return None
-        fm = doc_mapper.field(field)
+        from .plan import coerce_numeric_bound
         try:
-            if fm.type is FieldType.F64:
-                return float(value)
-            parsed = int(value)  # leaf plan.py _parse_bound semantics
+            return coerce_numeric_bound(doc_mapper.field(field).type, value)
         except (ValueError, TypeError):
             return None
-        if fm.type is FieldType.U64:
-            parsed = max(0, min(parsed, (1 << 64) - 1))
-        return parsed
 
     def walk(node) -> None:
         if isinstance(node, Q.Range) and numeric_field(node.field):
