@@ -760,7 +760,12 @@ def main() -> None:
                 f"{head.get('cpu_pipe_ms', head['cpu_ms']):.0f}ms)"
                 f"{native_note}")
         value = head["pipe_ms"]
-    elif head.get("vs_native_pipelined"):
+    elif head.get("vs_native_pipelined") \
+            and platform not in ("cpu", "cpu-fallback"):
+        # real device but the own-cpu child was unavailable: the native
+        # comparator is the denominator. (A cpu-fallback run keeps the
+        # 1s-headline-bound framing below — JAX-on-CPU is not the
+        # production leaf path and a native ratio would misstate it.)
         vs = head["vs_native_pipelined"]
         note = (f"{note}, denominator: native C++ single-core comparator "
                 f"{head['native_cpu_ms']}ms (own-cpu child unavailable)")
