@@ -26,106 +26,32 @@ parent-before-child ordering is not enforced.
 
 from __future__ import annotations
 
-import hashlib
-import http.client
 import json
 import time
 from typing import Any, Iterator, Optional
-from urllib.parse import urlparse
 
-from ..storage.s3 import S3Config, sigv4_headers
+from ..storage.s3 import S3Config
+from .aws_json import AwsApiError, AwsJsonClient  # noqa: F401 - AwsApiError re-exported for callers
 
 API_VERSION = "Kinesis_20131202"
 
 
-class KinesisError(RuntimeError):
-    def __init__(self, message: str, error_type: Optional[str] = None):
-        super().__init__(message)
-        self.error_type = error_type
+class KinesisError(AwsApiError):
+    pass
 
 
-class KinesisWireClient:
+class KinesisWireClient(AwsJsonClient):
     """Minimal Kinesis API client: JSON target protocol + SigV4 on one
-    persistent HTTP connection (re-dialed on failure)."""
+    persistent HTTP connection (shared AwsJsonClient machinery: retry
+    envelope for throttles/transient 5xx, re-dial on dead keep-alives)."""
 
-    def __init__(self, endpoint: str, config: S3Config,
-                 timeout: float = 30.0):
-        parsed = urlparse(endpoint if "//" in endpoint
-                          else f"http://{endpoint}")
-        self.scheme = parsed.scheme or "http"
-        self.host = parsed.hostname or endpoint
-        self.port = parsed.port or (443 if self.scheme == "https" else 80)
-        self.config = config
-        self.timeout = timeout
-        self._conn: Optional[http.client.HTTPConnection] = None
-
-    def close(self) -> None:
-        if self._conn is not None:
-            try:
-                self._conn.close()
-            finally:
-                self._conn = None
-
-    def _connection(self) -> http.client.HTTPConnection:
-        if self._conn is None:
-            cls = (http.client.HTTPSConnection if self.scheme == "https"
-                   else http.client.HTTPConnection)
-            self._conn = cls(self.host, self.port, timeout=self.timeout)
-        return self._conn
-
-    _RETRYABLE_STATUS = (500, 502, 503, 504)
-    _RETRYABLE_TYPES = ("ProvisionedThroughputExceededException",
-                        "LimitExceededException")
-    _MAX_ATTEMPTS = 3
-
-    def call(self, action: str, payload: dict[str, Any]) -> dict[str, Any]:
-        """One signed API call with the same retry envelope the S3 client
-        uses: transient 5xx and Kinesis throttles (GetRecords is
-        rate-capped per shard) back off and retry; a dead kept-alive
-        connection re-dials once per attempt."""
-        body = json.dumps(payload).encode()
-        host_header = (self.host if self.port in (80, 443)
-                       else f"{self.host}:{self.port}")
-        headers = sigv4_headers(
-            "POST", host_header, "/", [],
-            hashlib.sha256(body).hexdigest(), self.config,
-            extra_headers={
-                "content-type": "application/x-amz-json-1.1",
-                "x-amz-target": f"{API_VERSION}.{action}",
-            },
-            service="kinesis")
-        last_error: Optional[KinesisError] = None
-        for attempt in range(1, self._MAX_ATTEMPTS + 1):
-            try:
-                conn = self._connection()
-                conn.request("POST", "/", body=body, headers=headers)
-                response = conn.getresponse()
-                raw = response.read()
-            except (http.client.HTTPException, OSError) as exc:
-                self.close()
-                last_error = KinesisError(f"kinesis transport error: {exc}")
-                if attempt == self._MAX_ATTEMPTS:
-                    raise last_error
-                time.sleep(0.05 * attempt)
-                continue
-            try:
-                decoded = json.loads(raw) if raw else {}
-            except ValueError:
-                decoded = {}  # proxy HTML error page etc: status rules
-            if response.status == 200:
-                return decoded
-            error_type = (decoded.get("__type") or "").split("#")[-1]
-            last_error = KinesisError(
-                decoded.get("message") or decoded.get("Message")
-                or f"kinesis call {action} failed: {response.status}",
-                error_type=error_type or None)
-            if (response.status in self._RETRYABLE_STATUS
-                    or error_type in self._RETRYABLE_TYPES) \
-                    and attempt < self._MAX_ATTEMPTS:
-                time.sleep(0.05 * attempt)
-                continue
-            raise last_error
-        raise last_error  # unreachable; keeps the type checker honest
+    service = "kinesis"
+    target_prefix = API_VERSION
+    content_type = "application/x-amz-json-1.1"
+    # GetRecords is rate-capped per shard: throttles retry inside the call
+    retryable_types = ("ProvisionedThroughputExceededException",
+                       "LimitExceededException")
+    error_class = KinesisError
 
     # -- the three consumer APIs -------------------------------------------
     def list_shards(self, stream: str) -> list[str]:

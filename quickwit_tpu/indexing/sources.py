@@ -115,10 +115,13 @@ class VoidSource(Source):
         return iter(())
 
 
-_UNSUPPORTED = {"pulsar", "sqs", "gcp_pubsub"}
+_UNSUPPORTED = {"pulsar", "gcp_pubsub"}
 
 
-def make_source(source_type: str, params: dict[str, Any]) -> Source:
+def make_source(source_type: str, params: dict[str, Any],
+                resolver=None) -> Source:
+    """`resolver`: storage resolver for sources that FETCH notified
+    objects (sqs); ignored by stream sources."""
     if source_type == "vec":
         return VecSource(params.get("docs", []), params.get("partition_id", "vec"))
     if source_type == "file":
@@ -161,6 +164,26 @@ def make_source(source_type: str, params: dict[str, Any]) -> Source:
             secret_key=params.get("secret_key", base.secret_key),
             session_token=params.get("session_token", base.session_token))
         return KinesisSource(endpoint, params["stream_name"], config)
+    if source_type == "sqs":
+        # reference SourceParams::Sqs shape: queue_url (+ region);
+        # notifications carry the files to ingest
+        import dataclasses
+
+        from ..storage.s3 import S3Config
+        from .sqs import SqsFileSource
+        if "queue_url" not in params:
+            raise ValueError("sqs source requires a queue_url")
+        base = S3Config.from_env()
+        region = params.get("region") or base.region or "us-east-1"
+        endpoint = (params.get("endpoint")
+                    or f"https://sqs.{region}.amazonaws.com")
+        config = dataclasses.replace(
+            base, region=region,
+            access_key=params.get("access_key", base.access_key),
+            secret_key=params.get("secret_key", base.secret_key),
+            session_token=params.get("session_token", base.session_token))
+        return SqsFileSource(endpoint, params["queue_url"], config,
+                             resolver=resolver)
     if source_type in _UNSUPPORTED:
         raise NotImplementedError(
             f"source type {source_type!r} requires an external client SDK not "

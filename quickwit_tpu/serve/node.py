@@ -553,7 +553,8 @@ class Node:
         if cached is None:
             cached = (fingerprint,
                       make_source(source_config.source_type,
-                                  source_config.params))
+                                  source_config.params,
+                                  resolver=self.storage_resolver))
             self._external_sources[key] = cached
         source = cached[1]
         storage = self.storage_resolver.resolve(
