@@ -23,8 +23,9 @@ Dialect (vertical slice):
     [ORDER BY <alias|expr> [ASC|DESC]]
     [LIMIT n] [OFFSET n]
 
-Aggregates: COUNT(*), COUNT(col), SUM, AVG, MIN, MAX, STDDEV, VARIANCE,
-APPROX_PERCENTILE(col, p) — the last rides the DDSketch percentile
+Aggregates: COUNT(*), COUNT(col), COUNT(DISTINCT col) /
+APPROX_COUNT_DISTINCT (device HLL cardinality), SUM, AVG, MIN, MAX,
+STDDEV, VARIANCE, APPROX_PERCENTILE(col, p) — the last rides the DDSketch percentile
 kernels (the fork's sketch UDFs, `quickwit-datafusion/src/sources/
 metrics/sketch_udf.rs`). GROUP BY chains compile onto the arbitrary-
 depth nested bucket spaces, so N keys = one device pass.
@@ -63,7 +64,8 @@ _TOKEN_RE = re.compile(r"""
 _KEYWORDS = {"select", "from", "where", "group", "by", "order", "limit",
              "offset", "having", "and", "or", "as", "asc", "desc",
              "count", "sum", "avg", "min", "max", "stddev", "variance",
-             "approx_percentile", "date_trunc"}
+             "approx_percentile", "approx_count_distinct", "date_trunc",
+             "distinct"}
 
 
 def _tokenize(text: str) -> list[tuple[str, str]]:
@@ -216,9 +218,22 @@ class _Parser:
             if self.accept("op", "*"):
                 self.expect("op", ")")
                 return SelectItem("count_star", alias=self._alias())
+            if self.accept("kw", "distinct"):
+                # COUNT(DISTINCT col) rides the device HLL cardinality
+                # kernel (approximate, like every engine at scale)
+                column = self.expect("ident")[1]
+                self.expect("op", ")")
+                return SelectItem("agg", func="count_distinct",
+                                  column=column, alias=self._alias())
             column = self.expect("ident")[1]
             self.expect("op", ")")
             return SelectItem("agg", func="count", column=column,
+                              alias=self._alias())
+        if token[0] == "kw" and token[1] == "approx_count_distinct":
+            self.expect("op", "(")
+            column = self.expect("ident")[1]
+            self.expect("op", ")")
+            return SelectItem("agg", func="count_distinct", column=column,
                               alias=self._alias())
         if token[0] == "kw" and token[1] in ("sum", "avg", "min", "max",
                                              "stddev", "variance"):
@@ -320,6 +335,8 @@ def _metric_body(item: SelectItem) -> dict:
         return {}
     if item.func == "count":
         return {"value_count": {"field": item.column}}
+    if item.func == "count_distinct":
+        return {"cardinality": {"field": item.column}}
     if item.func == "approx_percentile":
         return {"percentiles": {"field": item.column,
                                 "percents": [item.percent]}}

@@ -175,12 +175,21 @@ _HLL_P = 8
 
 
 def hll_hash_bytes(data: bytes) -> int:
-    """64-bit FNV-1a — host-side hashing of term strings so that identical
-    terms hash identically across splits regardless of their ordinals."""
+    """Host-side hashing of term strings so that identical terms hash
+    identically across splits regardless of their ordinals: 64-bit
+    FNV-1a + the splitmix64 finalizer. The finalizer is ESSENTIAL —
+    HLL's register index is the hash's TOP bits, and raw FNV-1a of
+    short, similar terms ("svc0".."svc6") barely diffuses trailing-byte
+    differences upward, collapsing every term into one register (a
+    cardinality of ~1). The numeric path applies the same finalizer on
+    device (_hll_mix64)."""
     h = 0xcbf29ce484222325
     for b in data:
         h = ((h ^ b) * 0x100000001b3) & 0xFFFFFFFFFFFFFFFF
-    return h
+    # splitmix64 finalizer (keep in lockstep with _hll_mix64)
+    h = ((h ^ (h >> 30)) * 0xbf58476d1ce4e5b9) & 0xFFFFFFFFFFFFFFFF
+    h = ((h ^ (h >> 27)) * 0x94d049bb133111eb) & 0xFFFFFFFFFFFFFFFF
+    return h ^ (h >> 31)
 
 
 def _hll_mix64(x: jnp.ndarray) -> jnp.ndarray:
@@ -191,11 +200,10 @@ def _hll_mix64(x: jnp.ndarray) -> jnp.ndarray:
     return x ^ (x >> 31)
 
 
-def hll_registers(hashes: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
-    """[HLL_NUM_REGISTERS] int32 register vector (max of rho per register).
-
-    `hashes` uint64 per doc, `valid` bool per doc. rho = 1 + leading zeros
-    of the suffix (capped at 57-p)."""
+def _hll_reg_rho(hashes: jnp.ndarray, valid: jnp.ndarray):
+    """(register index, rho) per doc: register = top p hash bits, rho =
+    1 + leading zeros of the suffix (capped). Invalid docs get rho 0 and
+    the out-of-range register sentinel."""
     reg = (hashes >> jnp.uint64(64 - _HLL_P)).astype(jnp.int32)
     suffix = hashes << jnp.uint64(_HLL_P)
     # leading-zero count of the 64-bit suffix via float exponent is
@@ -210,9 +218,33 @@ def hll_registers(hashes: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     rho = jnp.minimum(clz + 1, 64 - _HLL_P).astype(jnp.int32)
     rho = jnp.where(valid, rho, 0)
     reg = jnp.where(valid, reg, jnp.int32(HLL_NUM_REGISTERS))
+    return reg, rho
+
+
+def hll_registers(hashes: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """[HLL_NUM_REGISTERS] int32 register vector (max of rho per register).
+
+    `hashes` uint64 per doc, `valid` bool per doc."""
+    reg, rho = _hll_reg_rho(hashes, valid)
     eq = reg[:, None] == jnp.arange(HLL_NUM_REGISTERS,
                                     dtype=jnp.int32)[None, :]
     return jnp.max(jnp.where(eq, rho[:, None], 0), axis=0)
+
+
+def bucket_hll_registers(idx: jnp.ndarray, hashes: jnp.ndarray,
+                         valid: jnp.ndarray,
+                         num_buckets: int) -> jnp.ndarray:
+    """Per-bucket HLL registers [num_buckets, HLL_NUM_REGISTERS] int32 —
+    cardinality as a bucket sub-metric: one scatter-MAX into the
+    flattened [nb * registers] space (the per-bucket twin of
+    bucket_percentile_sketch's scatter-add)."""
+    reg, rho = _hll_reg_rho(hashes, valid)
+    ok = valid & (idx < num_buckets)
+    flat = jnp.where(ok, idx * HLL_NUM_REGISTERS + reg,
+                     jnp.int32(num_buckets * HLL_NUM_REGISTERS))
+    out = jnp.zeros(num_buckets * HLL_NUM_REGISTERS, dtype=jnp.int32)
+    return out.at[flat].max(rho, mode="drop").reshape(
+        num_buckets, HLL_NUM_REGISTERS)
 
 
 def hll_from_numeric(values: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:

@@ -263,7 +263,7 @@ def _merge_agg_stack(agg_out):
                 break
         if name == "min":
             return jnp.min(leaf, axis=0)
-        if name == "max":
+        if name in ("max", "hll"):  # HLL registers merge by max too
             return jnp.max(leaf, axis=0)
         if name == "stats":
             # state vector [count, sum, sum_sq, min, max]: first three add

@@ -150,10 +150,6 @@ def _parse_sub_aggs(name: str, sub: dict[str, Any], depth: int = 0):
     sub_buckets = []
     for sub_name, sub_body in sub.items():
         sub_kind = _agg_kind(sub_body)
-        if sub_kind == "cardinality":
-            raise AggParseError(
-                f"aggregation {name!r}: cardinality under bucket "
-                "aggregations is not supported yet")
         if sub_kind in _METRIC_KINDS:
             metrics.append(_parse_metric(sub_name, sub_kind, sub_body[sub_kind]))
         elif sub_kind == "range":

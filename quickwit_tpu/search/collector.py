@@ -17,7 +17,7 @@ from typing import Any, Optional
 
 import numpy as np
 
-from ..ops.aggs import PCTL_NUM_BUCKETS, sketch_quantiles
+from ..ops.aggs import PCTL_NUM_BUCKETS, hll_estimate, sketch_quantiles
 from ..query.aggregations import DEFAULT_PERCENTS
 from .models import LeafSearchResponse, PartialHit
 
@@ -317,7 +317,8 @@ def _sub_info_of(sub: dict) -> dict:
 
 def _new_metric_acc(kind: str, percents=None, keyed: bool = True) -> dict[str, Any]:
     return {"sum": 0.0, "count": 0, "min": np.inf, "max": -np.inf, "sum_sq": 0.0,
-            "kind": kind, "sketch": None, "percents": percents, "keyed": keyed}
+            "kind": kind, "sketch": None, "hll": None, "percents": percents,
+            "keyed": keyed}
 
 
 def _acc_metric(acc: dict[str, Any], arrays: dict[str, np.ndarray], i: int) -> None:
@@ -335,6 +336,11 @@ def _acc_metric(acc: dict[str, Any], arrays: dict[str, np.ndarray], i: int) -> N
         row = np.asarray(arrays["sketch"][i])
         # non-inplace add: accs are shallow-copied by _copy_bucket_map
         acc["sketch"] = row if acc["sketch"] is None else acc["sketch"] + row
+    if "hll" in arrays:
+        row = np.asarray(arrays["hll"][i])
+        # HLL registers merge by elementwise max (non-inplace, as above)
+        acc["hll"] = row if acc.get("hll") is None \
+            else np.maximum(acc["hll"], row)
 
 
 def _copy_bucket_map(bucket_map: dict) -> dict:
@@ -459,6 +465,10 @@ def _merge_bucket_maps(bucket_map: dict, incoming: dict) -> None:
                     cacc["sketch"] = acc["sketch"] \
                         if cacc.get("sketch") is None \
                         else cacc["sketch"] + acc["sketch"]
+                if acc.get("hll") is not None:
+                    cacc["hll"] = acc["hll"] \
+                        if cacc.get("hll") is None \
+                        else np.maximum(cacc["hll"], acc["hll"])
         if "sub_maps" in bucket:
             if "sub_maps" not in cur:
                 cur["sub_maps"] = bucket["sub_maps"]
@@ -492,6 +502,10 @@ def _merge_terms(current: dict[str, Any], state: dict[str, Any]) -> None:
 def _finalize_metric(acc: dict[str, Any]) -> dict[str, Any]:
     kind = acc["kind"]
     count = acc["count"]
+    if kind == "cardinality":
+        hll = acc.get("hll")
+        return {"value": round(hll_estimate(hll)) if hll is not None
+                else 0}
     if kind == "value_count":
         return {"value": count}
     if kind == "sum":

@@ -59,6 +59,40 @@ def _device_scalars(plan: LoweredPlan) -> tuple[Any, Any]:
     return cached
 
 
+def _cardinality_hashes(met, arrays):
+    """(hashes[uint64], present[bool]) per doc for a cardinality metric:
+    text columns gather per-ordinal TERM hashes (cross-split identity),
+    numeric columns mix the 64-bit value pattern. THE one derivation —
+    the bucket, range, and top-level metric paths all call it."""
+    if met.hash_slot >= 0:
+        ordinals = arrays[met.values_slot]
+        present = ordinals >= 0
+        hashes = arrays[met.hash_slot][jnp.clip(ordinals, 0, None)]
+    else:
+        values = arrays[met.values_slot]
+        present = arrays[met.present_slot].astype(jnp.bool_)
+        bits = (agg_ops.jax_bitcast_f64(values)
+                if values.dtype == jnp.float64
+                else values.astype(jnp.int64).astype(jnp.uint64))
+        hashes = agg_ops._hll_mix64(bits)
+    return hashes, present
+
+
+def _bucket_tree_blocks_posting_space(children) -> bool:
+    """True when a nested-bucket subtree needs arrays the _GatherView
+    cannot serve (range bounds, multivalued pair arrays, per-ordinal
+    hash tables) — shared by the plain and composite eligibility
+    checks."""
+    stack = list(children)
+    while stack:
+        child = stack.pop()
+        if (child.kind in ("range", "terms_mv")
+                or any(m.kind == "cardinality" for m in child.metrics)):
+            return True
+        stack.extend(child.subs)
+    return False
+
+
 def _bucket_idx(a: BucketAggExec, arrays, scalars, mask):
     """(idx, in_bucket_mask): per-doc bucket index with the out-of-range
     sentinel `num_buckets` for dropped docs."""
@@ -93,6 +127,13 @@ def _bucket_idx(a: BucketAggExec, arrays, scalars, mask):
 def _bucket_metrics(metric_slots, arrays, idx, m, nb):
     metrics: dict[str, Any] = {}
     for met in metric_slots:
+        if met.kind == "cardinality":
+            # per-bucket HLL registers (scatter-max)
+            hashes, present = _cardinality_hashes(met, arrays)
+            ok = m & present
+            metrics[met.name] = {"hll": agg_ops.bucket_hll_registers(
+                jnp.where(ok, idx, jnp.int32(nb)), hashes, ok, nb)}
+            continue
         mv = arrays[met.values_slot].astype(jnp.float64)
         mp = arrays[met.present_slot].astype(jnp.bool_)
         # docs with mm==False get the sentinel index; both bucket-kernel
@@ -133,6 +174,16 @@ def _eval_range_agg(a: BucketAggExec, arrays, mask):
     counts = jnp.sum(in_range, axis=0, dtype=jnp.int32)
     metrics: dict[str, Any] = {}
     for met in a.metrics:
+        if met.kind == "cardinality":
+            # overlapping ranges: per-range HLL registers (small nb
+            # loop, like the percentile sketches below). c_present, not
+            # `present`: the enclosing scope's present masks the RANGE
+            # field and must not be shadowed
+            hashes, c_present = _cardinality_hashes(met, arrays)
+            metrics[met.name] = {"hll": jnp.stack([
+                agg_ops.hll_registers(hashes, in_range[:, i] & c_present)
+                for i in range(nb)])}
+            continue
         mv = arrays[met.values_slot].astype(jnp.float64)
         mp = arrays[met.present_slot].astype(jnp.bool_)
         mm = in_range & mp[:, None]                          # [D, nb]
@@ -268,18 +319,13 @@ def _posting_space_eligible(plan: LoweredPlan) -> bool:
         return False
     for a in plan.aggs:
         if isinstance(a, BucketAggExec):
-            if a.kind in ("range", "terms_mv"):
+            if _bucket_tree_blocks_posting_space([a]):
                 return False
-            if any(m.kind == "cardinality" for m in a.metrics):
+        elif isinstance(a, CompositeAggExec):
+            # composite CHILDREN are normal nested buckets and carry the
+            # same gather-view restrictions
+            if _bucket_tree_blocks_posting_space(a.subs):
                 return False
-            stack = list(a.subs)
-            while stack:
-                child = stack.pop()
-                if (child.kind in ("range", "terms_mv")
-                        or any(m.kind == "cardinality"
-                               for m in child.metrics)):
-                    return False
-                stack.extend(child.subs)
         elif isinstance(a, MetricAggExec):
             if a.metric.kind == "cardinality":
                 return False
@@ -506,19 +552,9 @@ def _eval_aggs(aggs, gathered, scalars, valid):
         elif isinstance(a, MetricAggExec):
             met = a.metric
             if met.kind == "cardinality":
-                if met.hash_slot >= 0:
-                    # text column: gather per-ordinal TERM hashes
-                    ordinals = gathered[met.values_slot]
-                    ok = valid & (ordinals >= 0)
-                    hashes = gathered[met.hash_slot][
-                        jnp.clip(ordinals, 0, None)]
-                    agg_out.append(
-                        {"hll": agg_ops.hll_registers(hashes, ok)})
-                else:
-                    mv = gathered[met.values_slot]
-                    mp = gathered[met.present_slot].astype(jnp.bool_)
-                    agg_out.append(
-                        {"hll": agg_ops.hll_from_numeric(mv, valid & mp)})
+                hashes, present = _cardinality_hashes(met, gathered)
+                agg_out.append(
+                    {"hll": agg_ops.hll_registers(hashes, valid & present)})
                 continue
             mv = gathered[met.values_slot]
             mp = gathered[met.present_slot]

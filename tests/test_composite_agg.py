@@ -275,3 +275,43 @@ def test_composite_metric_sub_aggs_exact(split_readers):
         else:  # Horst: no response values at all
             assert b["r_avg"]["value"] is None
             assert b["r_max"]["value"] is None
+
+
+def test_cardinality_under_composite_child_posting_space(single_reader):
+    """Regression (review repro): a single-TERM query is posting-space
+    eligible, but a cardinality metric under a composite's bucket child
+    gathers a per-ordinal hash table that the posting-space gather view
+    would index by doc ids — eligibility must route this to the dense
+    path and the values must be exact."""
+    from quickwit_tpu.query.ast import Term
+    from quickwit_tpu.search import executor as ex
+    from quickwit_tpu.search.leaf import prepare_plan_only
+
+    aggs = {"c": {
+        "composite": {"size": 50, "sources": [
+            {"host": {"terms": {"field": "host",
+                                "missing_bucket": True}}}]},
+        "aggs": {"by_name": {
+            "terms": {"field": "name", "size": 20},
+            "aggs": {"rcard": {"cardinality": {"field": "response"}}}}}}}
+    request = SearchRequest(index_ids=["t"], max_hits=0,
+                            query_ast=Term("name", "Fritz"), aggs=aggs)
+    plan = prepare_plan_only(request, MAPPER, single_reader, "s")
+    assert not ex._posting_space_eligible(plan)
+
+    collector = IncrementalCollector(max_hits=0)
+    collector.add_leaf_response(leaf_search_single_split(
+        request, MAPPER, single_reader, "s"))
+    result = finalize_aggregations(collector.aggregation_states())["c"]
+    sel = [d for d in DOCS if d["name"] == "Fritz"]
+    assert result["buckets"]
+    for b in result["buckets"]:
+        host = b["key"]["host"]
+        docs = [d for d in sel if d.get("host") == host]
+        want = len({d["response"] for d in docs if "response" in d})
+        assert b["by_name"]["buckets"]
+        for cb in b["by_name"]["buckets"]:
+            got = cb["rcard"]["value"]
+            exact = len({d["response"] for d in docs
+                         if d["name"] == cb["key"] and "response" in d})
+            assert got == exact, (host, cb["key"], got, exact)

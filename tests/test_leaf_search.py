@@ -694,3 +694,33 @@ def test_terms_order_by_key(reader):
     out = finalize_aggregations(coll.aggregation_states())["by_sev"]
     keys = [b["key"] for b in out["buckets"]]
     assert keys == sorted(keys)
+
+
+def test_cardinality_similar_short_terms():
+    """Regression: raw FNV-1a of short terms differing only in the last
+    character barely diffuses into the TOP hash bits HLL registers key
+    on, collapsing every term into one register (cardinality ~1). The
+    splitmix64 finalizer in hll_hash_bytes must keep them apart."""
+    m = DocMapper(
+        field_mappings=[
+            FieldMapping("timestamp", FieldType.DATETIME, fast=True,
+                         input_formats=("unix_timestamp",)),
+            FieldMapping("svc", FieldType.TEXT, tokenizer="raw",
+                         fast=True),
+        ],
+        timestamp_field="timestamp")
+    writer = SplitWriter(m)
+    for i in range(140):
+        writer.add_json_doc({"timestamp": 1000 + i,
+                             "svc": f"svc{i % 7}"})
+    storage = RamStorage(Uri.parse("ram:///card-similar"))
+    storage.put("s.split", writer.finish())
+    r = SplitReader(storage, "s.split")
+    resp = leaf_search_single_split(
+        SearchRequest(index_ids=["t"], query_ast=MatchAll(), max_hits=0,
+                      aggs={"c": {"cardinality": {"field": "svc"}}}),
+        m, r, "s")
+    collector = IncrementalCollector(max_hits=0)
+    collector.add_leaf_response(resp)
+    merged = finalize_aggregations(collector.aggregation_states())
+    assert merged["c"]["value"] == 7

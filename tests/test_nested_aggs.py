@@ -163,3 +163,21 @@ def test_composite_accepts_bucket_sub_aggs():
         {"s": {"terms": {"field": "service"}}}]},
         "aggs": {"t": {"terms": {"field": "level"}}}}})[0]
     assert spec.sub_buckets[0].name == "t"
+
+
+def test_cardinality_under_buckets(corpus):
+    """Cardinality as a bucket sub-metric (per-bucket scatter-max HLL
+    registers) — exact at small cardinalities, merged across splits by
+    register max."""
+    readers, docs = corpus
+    result = _search(readers, {"by_level": {
+        "terms": {"field": "level", "size": 10},
+        "aggs": {"services": {"cardinality": {"field": "service"}},
+                 "lats": {"cardinality": {"field": "latency"}}}}})
+    for bucket in result["by_level"]["buckets"]:
+        level = bucket["key"]
+        sel = [d for d in docs if d["level"] == level]
+        assert bucket["services"]["value"] == \
+            len({d["service"] for d in sel}), level
+        exact = len({d["latency"] for d in sel})
+        assert abs(bucket["lats"]["value"] - exact) <= max(2, exact * 0.1)

@@ -223,3 +223,22 @@ def test_having_requires_selected_target(api):
     status, out = api("SELECT service FROM metrics GROUP BY service "
                       "HAVING count(*) > 5")
     assert status == 400
+
+
+def test_count_distinct(api):
+    """COUNT(DISTINCT col) / APPROX_COUNT_DISTINCT ride the device HLL
+    cardinality kernel — approximate by contract (like every engine's
+    large-scale distinct count); tiny cardinalities are exact."""
+    status, out = api("SELECT COUNT(DISTINCT service) AS services, "
+                      "COUNT(DISTINCT latency) AS lats FROM metrics")
+    assert status == 200
+    assert out["columns"] == ["services", "lats"]
+    [row] = out["rows"]
+    assert row[0] == len({d["service"] for d in DOCS})
+    exact = len({d["latency"] for d in DOCS})
+    assert abs(row[1] - exact) <= exact * 0.1  # HLL error envelope
+    status, out2 = api("SELECT APPROX_COUNT_DISTINCT(service) AS s "
+                       "FROM metrics WHERE status = 500")
+    assert status == 200
+    want = len({d["service"] for d in DOCS if d["status"] == 500})
+    assert out2["rows"][0][0] == want
