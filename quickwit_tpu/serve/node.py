@@ -1351,6 +1351,44 @@ class Node:
             self.split_cache.stop()
 
     # ------------------------------------------------------------------
+    def warmup_index(self, index_id: str,
+                     requests: Optional[list] = None) -> dict[str, Any]:
+        """Pre-warm the searcher for an index: run the given
+        SearchRequests once, discarding results, so reader opens, storage
+        byte-range fetches, host→device transfers, AND the
+        per-plan-structure jit compilations happen before user traffic
+        (the round-4 weak-point: first-query warmup costs seconds per
+        plan structure). The REST route builds the requests through the
+        SAME parser production queries use, so warmed plan structures
+        match real traffic; `requests=None` warms a default match-all
+        top-k + a date-histogram shape."""
+        from ..query.ast import MatchAll
+        from ..search.models import SearchRequest
+        if not requests:
+            metadata = self._metadata_or_template(index_id)
+            doc_mapper = metadata.index_config.doc_mapper
+            requests = [SearchRequest(index_ids=[index_id],
+                                      query_ast=MatchAll(), max_hits=10)]
+            if doc_mapper.timestamp_field:
+                requests.append(SearchRequest(
+                    index_ids=[index_id], query_ast=MatchAll(), max_hits=0,
+                    aggs={"_warm_hist": {"date_histogram": {
+                        "field": doc_mapper.timestamp_field,
+                        "fixed_interval": "1d"}}}))
+        timings = []
+        for request in requests:
+            t0 = time.monotonic()
+            try:
+                self.root_searcher.search(request)
+                status = "ok"
+            except Exception as exc:  # noqa: BLE001 - report, keep warming
+                status = f"error: {exc}"
+            timings.append({"status": status,
+                            "elapsed_ms": round(
+                                (time.monotonic() - t0) * 1000, 1)})
+        return {"warmed": timings}
+
+    # ------------------------------------------------------------------
     def run_janitor(self) -> dict[str, int]:
         """GC + retention + delete-task planning pass (role of
         quickwit-janitor's actors)."""

@@ -377,6 +377,24 @@ class RestServer:
                 ListSplitsQuery(index_uids=[metadata.index_uid]))
             return 200, {"splits": [s.to_dict() for s in splits]}
 
+        # --- searcher pre-warm (operability: run representative queries
+        # once so jit compiles + transfers happen before user traffic) ---
+        m = re.fullmatch(r"/api/v1/([^/_][^/]*)/warmup", path)
+        if m and method == "POST":
+            payload = json.loads(body) if body else {}
+            index_id = m.group(1)
+            requests = None
+            if payload.get("queries"):
+                # the SAME request construction production searches use:
+                # warmed plan structures (sort, time filters, aggs, k)
+                # match real traffic exactly
+                fields = node.metastore.index_metadata(
+                    index_id).index_config.doc_mapper.default_search_fields
+                requests = [
+                    _search_request_from_params(index_id, spec, fields)
+                    for spec in payload["queries"]]
+            return 200, node.warmup_index(index_id, requests)
+
         # --- delete tasks (reference: delete_task_api/handler.rs) -------
         m = re.fullmatch(r"/api/v1/([^/_][^/]*)/delete-tasks", path)
         if m and method == "POST":

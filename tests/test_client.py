@@ -1,0 +1,119 @@
+"""Public QuickwitClient (role of quickwit-rest-client): the typed
+surface applications use, exercised end-to-end against a live node."""
+
+import pytest
+
+from quickwit_tpu.client import QuickwitClient, QuickwitError
+from quickwit_tpu.serve import Node, NodeConfig, RestServer
+from quickwit_tpu.storage import StorageResolver
+
+DOCS = [{"ts": 1_600_000_000 + i, "sev": ["INFO", "ERROR"][i % 4 == 0],
+         "body": f"event {i} clientword"} for i in range(40)]
+
+
+@pytest.fixture(scope="module")
+def client():
+    node = Node(NodeConfig(node_id="cl", rest_port=0,
+                           metastore_uri="ram:///cl/ms",
+                           default_index_root_uri="ram:///cl/idx"),
+                storage_resolver=StorageResolver.for_test())
+    server = RestServer(node)
+    server.start()
+    qw = QuickwitClient(f"127.0.0.1:{server.port}")
+    yield qw
+    qw.close()
+    server.stop()
+
+
+def test_full_lifecycle(client):
+    assert client.health()
+    client.create_index({
+        "index_id": "app",
+        "doc_mapping": {"field_mappings": [
+            {"name": "ts", "type": "datetime", "fast": True,
+             "input_formats": ["unix_timestamp"]},
+            {"name": "sev", "type": "text", "tokenizer": "raw",
+             "fast": True},
+            {"name": "body", "type": "text"}],
+            "timestamp_field": "ts"},
+        "search_settings": {"default_search_fields": ["body"]}})
+    assert any(ix["index_config"]["index_id"] == "app"
+               for ix in client.list_indexes())
+
+    out = client.ingest("app", DOCS, commit="force")
+    assert out["num_ingested_docs"] == len(DOCS)
+    assert len(client.list_splits("app")) == 1
+
+    result = client.search("app", query="clientword", max_hits=5,
+                           sort_by="-ts")
+    assert result["num_hits"] == len(DOCS)
+    assert len(result["hits"]) == 5
+    assert result["hits"][0]["ts"] >= result["hits"][1]["ts"]
+
+    es = client.es_search("app", {
+        "query": {"match": {"body": "clientword"}}, "size": 0,
+        "aggs": {"per_hour": {"date_histogram": {
+            "field": "ts", "fixed_interval": "1h"}}}})
+    assert es["hits"]["total"]["value"] == len(DOCS)
+    assert sum(b["doc_count"]
+               for b in es["aggregations"]["per_hour"]["buckets"]) \
+        == len(DOCS)
+
+    rows = client.sql("SELECT COUNT(*) AS n FROM app")["rows"]
+    assert rows[0][0] == len(DOCS)
+
+    # scroll drains every page exactly once
+    seen = []
+    for page in client.scroll("app", query="clientword", max_hits=15):
+        seen.extend(h["ts"] for h in page["hits"])
+    assert sorted(seen) == sorted(d["ts"] for d in DOCS)
+
+    assert client.cluster()["node_id"] == "cl"
+
+
+def test_errors_are_typed(client):
+    with pytest.raises(QuickwitError) as exc:
+        client.search("no-such-index", query="x")
+    assert exc.value.status in (400, 404)
+    with pytest.raises(QuickwitError):
+        client.create_index({"index_id": "bad", "doc_mapping": {
+            "field_mappings": [{"name": "x", "type": "nope"}]}})
+
+
+def test_delete_task_via_client(client):
+    client.create_index({
+        "index_id": "gdpr",
+        "doc_mapping": {"field_mappings": [
+            {"name": "ts", "type": "datetime", "fast": True,
+             "input_formats": ["unix_timestamp"]},
+            {"name": "user", "type": "text", "tokenizer": "raw"}],
+            "timestamp_field": "ts"}})
+    client.ingest("gdpr", [{"ts": 1 + i, "user": f"u{i % 2}"}
+                           for i in range(10)], commit="force")
+    out = client.create_delete_task("gdpr", {"term": {"user": "u1"}})
+    assert out["opstamp"] == 1
+
+
+def test_warmup_endpoint(client):
+    """POST /api/v1/{index}/warmup: default shapes compile + run; custom
+    specs ride the production request parser (sort/time filters count
+    toward the warmed plan structure). Self-contained: creates its own
+    index."""
+    client.create_index({
+        "index_id": "warm",
+        "doc_mapping": {"field_mappings": [
+            {"name": "ts", "type": "datetime", "fast": True,
+             "input_formats": ["unix_timestamp"]},
+            {"name": "body", "type": "text"}],
+            "timestamp_field": "ts"},
+        "search_settings": {"default_search_fields": ["body"]}})
+    client.ingest("warm", [{"ts": 1 + i, "body": f"w {i} warmword"}
+                           for i in range(8)], commit="force")
+    out = client.request("POST", "/api/v1/warm/warmup")
+    assert len(out["warmed"]) == 2
+    assert all(w["status"] == "ok" for w in out["warmed"])
+    out = client.request("POST", "/api/v1/warm/warmup", {
+        "queries": [{"query": "warmword", "max_hits": 5,
+                     "sort_by": "-ts"}]})
+    assert out["warmed"][0]["status"] == "ok"
+    assert out["warmed"][0]["elapsed_ms"] >= 0
