@@ -355,6 +355,10 @@ class Node:
             get_or_create_shards=self._live_open_shards)
         from ..control_plane.scheduler import IndexingScheduler
         self.indexing_scheduler = IndexingScheduler()
+        # None until a control-plane plan is first applied (legacy
+        # rendezvous election gates external sources until then).
+        self._applied_indexing_tasks: Optional[list[dict]] = None
+        self._assigned_sources: set[tuple[str, str]] = set()
         from ..control_plane.arbiter import (ScalingArbiter, ScalingPermits,
                                              ShardRateTracker)
         self.scaling_arbiter = ScalingArbiter(
@@ -827,7 +831,134 @@ class Node:
             self._coop_next_wake[uid] = self._coop_clock() + sleep_secs
             self.pipeline_metrics[uid] = metrics
 
-    def schedule_indexing(self) -> "Any":
+    # -- control-plane convergence (§3.4) -------------------------------
+    def apply_indexing_plan(self, tasks: list[dict]) -> dict[str, Any]:
+        """This node's slice of the physical indexing plan (the role of
+        the reference's per-indexer ApplyIndexingPlanRequest,
+        `indexing_service.rs:1152`): external-source passes run only for
+        assigned (index, source) pairs once a plan is applied. With no
+        plan ever applied, the legacy per-index rendezvous election
+        gates instead, so single-node/CLI deployments need no control
+        plane."""
+        applied = [
+            {"index_uid": t["index_uid"], "source_id": t["source_id"],
+             "shard_id": t.get("shard_id")}
+            for t in tasks]
+        # The ingest actor thread reads both fields; the gate checks
+        # _applied_indexing_tasks last, so publish the source set FIRST
+        # to avoid one tick seeing new tasks with the stale set.
+        self._assigned_sources = {
+            (t["index_uid"], t["source_id"]) for t in applied}
+        self._applied_indexing_tasks = applied
+        return {"applied": len(applied)}
+
+    def indexing_tasks(self) -> list[dict]:
+        """What this node believes it is running (drift-check input)."""
+        return list(self._applied_indexing_tasks or [])
+
+    def indexing_tasks_report(self) -> dict[str, Any]:
+        """Drift-check wire report. `applied` distinguishes an EMPTY plan
+        slice from NO plan ever applied: a never-applied node still gates
+        sources by the legacy election, so the leader must push even an
+        empty slice to converge it onto the plan."""
+        return {"applied": self._applied_indexing_tasks is not None,
+                "tasks": self.indexing_tasks()}
+
+    def source_assignment_allows(self, index_uid: str,
+                                 source_id: str) -> "Optional[bool]":
+        """True/False per the applied plan; None when no plan was ever
+        applied OR no control-plane node is alive (caller falls back to
+        the rendezvous election, so decommissioning every control-plane
+        node cannot strand newly added sources behind a stale plan)."""
+        if self._applied_indexing_tasks is None:
+            return None
+        if not self.cluster.nodes_with_role("control_plane"):
+            return None
+        return (index_uid, source_id) in self._assigned_sources
+
+    def run_control_plane_pass(self) -> dict[str, Any]:
+        """One scheduler convergence pass: plan, drift-check against what
+        indexers report running, re-apply on drift (the reference's
+        periodic re-check, §3.4). Runs on the elected control-plane node
+        (lowest alive node id with the role); others no-op."""
+        controllers = self.cluster.nodes_with_role("control_plane")
+        if controllers and min(controllers) != self.config.node_id:
+            return {"role": "follower"}
+        # One membership read for both the plan and the poll/apply loops:
+        # a node joining between two reads would otherwise receive an
+        # empty slice (gating all its sources off for a full tick) or
+        # have its planned tasks run nowhere this pass.
+        indexers = self.cluster.nodes_with_role("indexer")
+        plan = self.schedule_indexing(indexers)
+        # Poll indexers concurrently: a few blackholed-but-member nodes
+        # must not stretch one pass by N x the client timeout.
+        running: dict[str, dict] = {
+            n: {"applied": False, "tasks": []} for n in indexers}
+
+        def poll_one(node_id: str) -> None:
+            client = self.clients.get(node_id)
+            if client is None:
+                return
+            try:
+                report = client._post("/internal/indexing_tasks", {})
+                if report:
+                    running[node_id] = report
+            except Exception:  # noqa: BLE001 - dead node: drift
+                pass
+
+        workers = []
+        for node_id in indexers:
+            if node_id == self.config.node_id:
+                running[node_id] = self.indexing_tasks_report()
+            else:
+                worker = threading.Thread(target=poll_one, args=(node_id,),
+                                          daemon=True)
+                worker.start()
+                workers.append(worker)
+        deadline = time.monotonic() + 10.0
+        for worker in workers:
+            worker.join(timeout=max(0.0, deadline - time.monotonic()))
+
+        def task_key(t: dict) -> tuple:
+            return (t["index_uid"], t["source_id"], t.get("shard_id"))
+
+        want = {node_id: [{"index_uid": t.index_uid,
+                           "source_id": t.source_id,
+                           "shard_id": t.shard_id}
+                          for t in plan.assignments.get(node_id, [])]
+                for node_id in indexers}
+        # Re-apply ONLY to nodes whose reported state differs from the
+        # plan: one unreachable indexer (permanent drift) must not spam
+        # already-converged nodes with apply POSTs every tick. A node
+        # that never applied ANY plan is always drifted — even with an
+        # empty slice — because until a plan lands it consumes sources
+        # via the legacy election, racing the planned consumer.
+        changed = [node_id for node_id in indexers
+                   if not running[node_id].get("applied")
+                   or {task_key(t) for t in want[node_id]}
+                   != {task_key(t) for t in running[node_id].get("tasks", [])}]
+        applied = 0
+        for node_id in changed:
+            if node_id == self.config.node_id:
+                self.apply_indexing_plan(want[node_id])
+                applied += 1
+                continue
+            client = self.clients.get(node_id)
+            if client is None:
+                continue
+            try:
+                client._post("/internal/apply_indexing_plan",
+                             {"tasks": want[node_id]})
+                applied += 1
+            except Exception as exc:  # noqa: BLE001 - next tick
+                logger.warning("apply plan to %s failed: %s",
+                               node_id, exc)
+        return {"role": "leader", "drift": bool(changed),
+                "nodes_applied": applied,
+                "planned_tasks": sum(len(t) for t in want.values())}
+
+    def schedule_indexing(
+            self, indexers: Optional[list[str]] = None) -> "Any":
         """Control-plane convergence pass: logical tasks from metastore
         sources/shards → physical plan over live indexer nodes (§3.4)."""
         from ..control_plane.scheduler import IndexingTask
@@ -844,7 +975,8 @@ class Node:
                                  for s in shards)
                 else:
                     tasks.append(IndexingTask(metadata.index_uid, source_id))
-        indexers = self.cluster.nodes_with_role("indexer")
+        if indexers is None:
+            indexers = self.cluster.nodes_with_role("indexer")
         return self.indexing_scheduler.schedule(tasks, indexers)
 
     def autoscale_shards(self) -> list[tuple[str, str, str]]:
@@ -1172,10 +1304,17 @@ class Node:
                 # control plane assigns (source,partition)→indexer; our
                 # rendezvous election is the same single-consumer rule)
                 for source_id, source_config in metadata.sources.items():
-                    if (source_config.enabled
-                            and source_config.source_type
-                            not in self._INTERNAL_SOURCE_TYPES
-                            and owns_index(metadata.index_uid)):
+                    # cheap filters FIRST: internal/disabled sources must
+                    # not pay cluster-lock + rendezvous-hash per tick
+                    if (not source_config.enabled
+                            or source_config.source_type
+                            in self._INTERNAL_SOURCE_TYPES):
+                        continue
+                    allowed = self.source_assignment_allows(
+                        metadata.index_uid, source_id)
+                    if allowed is None:  # no plan applied: legacy election
+                        allowed = owns_index(metadata.index_uid)
+                    if allowed:
                         try:
                             self.run_source_pass(metadata.index_id,
                                                  source_id)
@@ -1277,11 +1416,24 @@ class Node:
             if "indexer" in self.config.roles:
                 self.autoscale_shards()
 
+        def control_plane_tick() -> None:
+            # scheduler convergence (§3.4): plan, drift-check, re-apply.
+            # Leader election happens inside the pass (lowest alive
+            # control-plane node); followers no-op.
+            if "control_plane" not in self.config.roles:
+                return
+            try:
+                self.run_control_plane_pass()
+            except Exception as exc:  # noqa: BLE001 - next tick retries
+                logger.warning("control-plane pass failed: %s", exc)
+
         loops = [("ingest", ingest_interval_secs, ingest_tick),
                  ("merge", merge_interval_secs, merge_tick),
                  ("janitor", janitor_interval_secs, janitor_tick),
                  ("autoscale", max(ingest_interval_secs, 2.0),
-                  autoscale_tick)]
+                  autoscale_tick),
+                 ("control-plane", max(merge_interval_secs, 10.0),
+                  control_plane_tick)]
         if self.config.gossip_enabled:
             # UDP scuttlebutt replaces the REST heartbeat loop entirely
             from ..cluster.gossip import GossipService

@@ -283,6 +283,11 @@ class RestServer:
             if context is None:
                 return 200, {"value": None}
             return 200, {"value": context_to_dict(context)}
+        if path == "/internal/apply_indexing_plan" and method == "POST":
+            payload = json.loads(body) if body else {}
+            return 200, node.apply_indexing_plan(payload.get("tasks", []))
+        if path == "/internal/indexing_tasks" and method == "POST":
+            return 200, node.indexing_tasks_report()
         if path == "/internal/replica_truncate" and method == "POST":
             payload = json.loads(body)
             node.ingester.replica_truncate(

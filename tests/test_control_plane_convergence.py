@@ -1,0 +1,140 @@
+"""Cluster-wide control-plane convergence (reference §3.4: the singleton
+scheduler computes a PhysicalIndexingPlan, applies it per indexer via
+ApplyIndexingPlanRequest, and periodically re-checks drift): plan apply
+over real HTTP, per-node source gating, drift-driven reassignment when
+an indexer dies."""
+
+import json
+
+import pytest
+
+from quickwit_tpu.cluster.membership import ClusterMember
+from quickwit_tpu.serve import Node, NodeConfig, RestServer
+from quickwit_tpu.serve.http_client import HttpSearchClient
+from quickwit_tpu.storage import StorageResolver
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    resolver = StorageResolver.for_test()
+    nodes, servers = [], []
+    for i in range(2):
+        node = Node(NodeConfig(node_id=f"cp-{i}", rest_port=0,
+                               metastore_uri="ram:///cp/ms",
+                               default_index_root_uri="ram:///cp/idx"),
+                    storage_resolver=resolver)
+        server = RestServer(node)
+        server.start()
+        nodes.append(node)
+        servers.append(server)
+    for i, node in enumerate(nodes):
+        HttpSearchClient(servers[1 - i].endpoint).heartbeat({
+            "node_id": node.config.node_id,
+            "roles": list(node.config.roles),
+            "rest_endpoint": servers[i].endpoint})
+    # two file sources on one index: the solver spreads them
+    files = []
+    for n in range(2):
+        path = tmp_path / f"src{n}.ndjson"
+        path.write_text("\n".join(
+            json.dumps({"ts": 1000 + n * 100 + i,
+                        "body": f"doc s{n} {i}"}) for i in range(5)))
+        files.append(str(path))
+    nodes[0].index_service.create_index({
+        "index_id": "cp-logs",
+        "doc_mapping": {"field_mappings": [
+            {"name": "ts", "type": "datetime", "fast": True,
+             "input_formats": ["unix_timestamp"]},
+            {"name": "body", "type": "text"}],
+            "timestamp_field": "ts"},
+        "search_settings": {"default_search_fields": ["body"]}})
+    from quickwit_tpu.models.index_metadata import SourceConfig
+    uid = nodes[0].metastore.index_metadata("cp-logs").index_uid
+    for n, path in enumerate(files):
+        nodes[0].metastore.add_source(uid, SourceConfig(
+            f"file-{n}", "file", params={"filepath": path}))
+    yield nodes, servers
+    for server in servers:
+        server.stop()
+
+
+def test_plan_applies_and_gates_sources(cluster):
+    nodes, _servers = cluster
+    # leader = lowest alive control-plane node id = cp-0
+    out = nodes[0].run_control_plane_pass()
+    assert out["role"] == "leader"
+    assert out["drift"] is True          # first pass: nothing running yet
+    assert out["nodes_applied"] == 2
+    # 2 file sources + the built-in ingest source
+    assert out["planned_tasks"] == 3
+
+    # each node holds exactly its slice, applied over real HTTP
+    all_tasks = nodes[0].indexing_tasks() + nodes[1].indexing_tasks()
+    file_tasks = sorted(t["source_id"] for t in all_tasks
+                        if t["source_id"].startswith("file-"))
+    assert file_tasks == ["file-0", "file-1"]
+    for node in nodes:
+        for t in node.indexing_tasks():
+            assert node.source_assignment_allows(
+                t["index_uid"], t["source_id"]) is True
+    # a source NOT in a node's slice is gated off for that node
+    uid = nodes[0].metastore.index_metadata("cp-logs").index_uid
+    for node in nodes:
+        mine = {t["source_id"] for t in node.indexing_tasks()}
+        other = {"file-0", "file-1"} - mine
+        assert mine  # the solver spread work to both nodes
+        for source_id in other:
+            assert node.source_assignment_allows(uid, source_id) is False
+
+    # convergent: an immediate second pass sees no drift
+    out2 = nodes[0].run_control_plane_pass()
+    assert out2["drift"] is False
+
+    # the follower node's pass is a no-op (single scheduler)
+    assert nodes[1].run_control_plane_pass() == {"role": "follower"}
+
+
+def test_drift_reassigns_when_indexer_dies(cluster):
+    nodes, _servers = cluster
+    nodes[0].run_control_plane_pass()
+    before = {t["source_id"] for t in nodes[0].indexing_tasks()
+              if t["source_id"].startswith("file-")}
+    assert len(before) == 1
+    # cp-1 dies: liveness lapses out of the alive set
+    import time as time_mod
+    member = nodes[0].cluster.member("cp-1")
+    member.last_heartbeat = time_mod.monotonic() - 10_000
+    out = nodes[0].run_control_plane_pass()
+    assert out["drift"] is True
+    # every file task lands on the survivor
+    assert sorted(t["source_id"] for t in nodes[0].indexing_tasks()
+                  if t["source_id"].startswith("file-")) \
+        == ["file-0", "file-1"]
+    uid = nodes[0].metastore.index_metadata("cp-logs").index_uid
+    assert all(nodes[0].source_assignment_allows(uid, s)
+               for s in ("file-0", "file-1"))
+
+
+def test_restarted_indexer_reconverges(cluster):
+    """A node that lost its in-memory plan (restart) reports
+    applied=False and is re-applied on the next pass — even an EMPTY
+    slice counts, since a never-applied node would otherwise keep
+    consuming via the legacy election, racing the planned consumer."""
+    nodes, _servers = cluster
+    nodes[0].run_control_plane_pass()
+    assert nodes[1].indexing_tasks_report()["applied"] is True
+    nodes[1]._applied_indexing_tasks = None
+    nodes[1]._assigned_sources = set()
+    out = nodes[0].run_control_plane_pass()
+    assert out["drift"] is True
+    assert nodes[1].indexing_tasks_report()["applied"] is True
+    assert nodes[1].indexing_tasks()
+    # and the already-converged leader was NOT re-applied
+    assert out["nodes_applied"] == 1
+
+
+def test_no_plan_means_legacy_election(cluster):
+    nodes, _servers = cluster
+    # before any control-plane pass, gating falls back to rendezvous
+    uid = nodes[0].metastore.index_metadata("cp-logs").index_uid
+    assert nodes[0].source_assignment_allows(uid, "file-0") is None
