@@ -242,3 +242,173 @@ def test_count_distinct(api):
     assert status == 200
     want = len({d["service"] for d in DOCS if d["status"] == 500})
     assert out2["rows"][0][0] == want
+
+
+# --------------------------------------------------------------------------
+# relational tail: subqueries, window functions, JOINs
+
+@pytest.fixture(scope="module")
+def rel_api():
+    """Two joinable indexes (fact `orders`, dimension `users`) behind
+    the REST SQL route."""
+    node = Node(NodeConfig(node_id="sql-rel", rest_port=0,
+                           metastore_uri="ram:///sqlrel/ms",
+                           default_index_root_uri="ram:///sqlrel/idx"),
+                storage_resolver=StorageResolver.for_test())
+    server = RestServer(node, host="127.0.0.1", port=0)
+    server.start()
+
+    def create(index_id, fields):
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=30)
+        conn.request("POST", "/api/v1/indexes", json.dumps({
+            "index_id": index_id,
+            "doc_mapping": {"field_mappings": fields,
+                            "timestamp_field": "ts"}}).encode())
+        assert conn.getresponse().status == 200
+        conn.close()
+
+    ts = {"name": "ts", "type": "datetime", "fast": True,
+          "input_formats": ["unix_timestamp"]}
+    raw = {"type": "text", "tokenizer": "raw", "fast": True}
+    create("orders", [ts, {"name": "user", **raw},
+                      {"name": "amount", "type": "f64", "fast": True}])
+    create("users", [ts, {"name": "name", **raw},
+                     {"name": "tier", **raw}])
+    node.ingest("orders", [{"ts": 100 + i, "user": f"u{i % 3}",
+                            "amount": float(10 * (i + 1))}
+                           for i in range(9)], commit="force")
+    node.ingest("users", [{"ts": 1, "name": "u0", "tier": "gold"},
+                          {"ts": 2, "name": "u1", "tier": "silver"},
+                          {"ts": 3, "name": "u2", "tier": "gold"}],
+                commit="force")
+
+    def sql(query):
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=30)
+        conn.request("POST", "/api/v1/_sql",
+                     json.dumps({"query": query}).encode())
+        response = conn.getresponse()
+        payload = json.loads(response.read())
+        conn.close()
+        return response.status, payload
+
+    yield sql
+    server.stop()
+
+
+def test_scalar_subquery(rel_api):
+    # AVG(amount) = 50; strictly greater -> {60, 70, 80, 90}
+    status, out = rel_api("SELECT COUNT(*) FROM orders WHERE amount > "
+                          "(SELECT AVG(amount) FROM orders)")
+    assert status == 200
+    assert out["rows"] == [[4]]
+
+
+def test_in_subquery_and_literal_list(rel_api):
+    status, out = rel_api(
+        "SELECT COUNT(*) AS n FROM orders WHERE user IN "
+        "(SELECT name FROM users WHERE tier = 'gold')")
+    assert (status, out["rows"]) == (200, [[6]])
+    status, out = rel_api(
+        "SELECT COUNT(*) AS n FROM orders WHERE user NOT IN "
+        "(SELECT name FROM users WHERE tier = 'gold')")
+    assert (status, out["rows"]) == (200, [[3]])
+    status, out = rel_api(
+        "SELECT COUNT(*) AS n FROM orders WHERE user IN ('u0', 'u1')")
+    assert (status, out["rows"]) == (200, [[6]])
+
+
+def test_window_row_number_and_running_sum(rel_api):
+    status, out = rel_api(
+        "SELECT user, amount, ROW_NUMBER() OVER "
+        "(PARTITION BY user ORDER BY amount) AS rn "
+        "FROM orders ORDER BY rn LIMIT 3")
+    assert status == 200
+    assert [r[2] for r in out["rows"]] == [1, 1, 1]
+    # running SUM with ORDER BY = SQL default frame (running aggregate)
+    status, out = rel_api(
+        "SELECT user, amount, SUM(amount) OVER "
+        "(PARTITION BY user ORDER BY amount) AS run FROM orders LIMIT 9")
+    assert status == 200
+    runs = {}
+    for user, amount, run in out["rows"]:
+        runs.setdefault(user, 0.0)
+        runs[user] += amount
+        assert run == runs[user]
+
+
+def test_window_rank_desc(rel_api):
+    status, out = rel_api("SELECT amount, RANK() OVER "
+                          "(ORDER BY amount DESC) AS r "
+                          "FROM orders ORDER BY r LIMIT 2")
+    assert status == 200
+    assert out["rows"][0] == [90.0, 1]
+    assert out["rows"][1] == [80.0, 2]
+
+
+def test_inner_join_group_by(rel_api):
+    status, out = rel_api(
+        "SELECT u.tier, COUNT(*) AS n, SUM(o.amount) AS total "
+        "FROM orders o JOIN users u ON o.user = u.name "
+        "GROUP BY u.tier ORDER BY total DESC")
+    assert status == 200
+    assert out["rows"] == [["gold", 6, 300.0], ["silver", 3, 150.0]]
+
+
+def test_left_join_with_pushdown(rel_api):
+    # WHERE o.amount >= 80 pushes down through the orders-side scan
+    status, out = rel_api(
+        "SELECT o.user, u.tier FROM orders o "
+        "LEFT JOIN users u ON o.user = u.name WHERE o.amount >= 80")
+    assert status == 200
+    assert sorted(out["rows"]) == [["u1", "silver"], ["u2", "gold"]]
+
+
+def test_relational_errors(rel_api):
+    # unqualified column in a JOIN query
+    status, _ = rel_api("SELECT user FROM orders o "
+                        "JOIN users u ON o.user = u.name")
+    assert status == 400
+    # window + GROUP BY is rejected
+    status, _ = rel_api("SELECT SUM(amount) OVER (PARTITION BY user) "
+                        "FROM orders GROUP BY user")
+    assert status == 400
+    # scalar subquery returning many rows is rejected
+    status, _ = rel_api("SELECT COUNT(*) FROM orders WHERE amount > "
+                        "(SELECT amount FROM orders)")
+    assert status == 400
+
+
+def test_null_join_keys_never_match(rel_api):
+    # a doc with no `user` field must not join to a doc with no `name`
+    # (SQL: NULL = NULL is not a match)
+    status, out = rel_api(
+        "SELECT COUNT(*) AS n FROM orders o "
+        "JOIN users u ON o.user = u.name")
+    assert status == 200
+    base = out["rows"][0][0]
+    assert base == 9  # every order has a user; no null cross-match
+
+
+def test_scalar_subquery_nonnumeric_range_is_400(rel_api):
+    status, _ = rel_api("SELECT COUNT(*) FROM orders WHERE amount > "
+                        "(SELECT name FROM users LIMIT 1)")
+    assert status == 400
+
+
+def test_trunc_with_window_is_400(rel_api):
+    status, _ = rel_api(
+        "SELECT DATE_TRUNC('day', ts), ROW_NUMBER() OVER (ORDER BY ts) "
+        "FROM orders")
+    assert status == 400
+
+
+def test_contextual_keywords_stay_valid_columns():
+    # fields named like the NEW keywords must keep parsing as columns
+    q = parse_sql("SELECT rank, partition FROM idx WHERE rank > 3")
+    assert [s.column for s in q.select] == ["rank", "partition"]
+    q = parse_sql('SELECT "count" FROM idx')  # quoted = escape hatch
+    assert q.select[0].column == "count"
+    q = parse_sql("SELECT COUNT(*) FROM idx GROUP BY on")
+    assert q.group_by[0].column == "on"
