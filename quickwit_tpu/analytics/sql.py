@@ -381,8 +381,9 @@ class _Parser:
                 # kernel (approximate, like every engine at scale)
                 column = self._ident()
                 self.expect("op", ")")
-                return SelectItem("agg", func="count_distinct",
-                                  column=column, alias=self._alias())
+                return self._maybe_over(
+                    SelectItem("agg", func="count_distinct",
+                               column=column, alias=self._alias()))
             column = self._ident()
             self.expect("op", ")")
             return self._maybe_over(
@@ -392,8 +393,9 @@ class _Parser:
             self.expect("op", "(")
             column = self._ident()
             self.expect("op", ")")
-            return SelectItem("agg", func="count_distinct", column=column,
-                              alias=self._alias())
+            return self._maybe_over(
+                SelectItem("agg", func="count_distinct", column=column,
+                           alias=self._alias()))
         if token[0] == "kw" and token[1] in ("sum", "avg", "min", "max",
                                              "stddev", "variance"):
             self.expect("op", "(")
@@ -410,8 +412,9 @@ class _Parser:
             if not 0 < percent < 100:
                 raise SqlError("approx_percentile takes a percent in (0,100)")
             self.expect("op", ")")
-            return SelectItem("agg", func="approx_percentile", column=column,
-                              percent=percent, alias=self._alias())
+            return self._maybe_over(
+                SelectItem("agg", func="approx_percentile", column=column,
+                           percent=percent, alias=self._alias()))
         if token[0] == "kw" and token[1] == "date_trunc":
             self.expect("op", "(")
             unit = self.expect("string")[1].lower()
@@ -634,6 +637,10 @@ def _resolve_one_subquery(pred: SubqueryPred, search) -> Q.QueryAst:
         return Q.Bool(must=(Q.MatchAll(),),
                       must_not=(Q.TermSet({pred.column: values}),))
     rows = _execute(sub, search)["rows"]
+    if not rows:
+        # SQL: a 0-row scalar subquery is NULL; any comparison with
+        # NULL is unknown -> matches nothing
+        return Q.MatchNone()
     if len(rows) != 1 or len(rows[0]) != 1:
         raise SqlError("scalar subquery must return exactly one value "
                        f"(got {len(rows)} rows)")
@@ -1134,7 +1141,7 @@ def _run_join(q: SqlQuery, search) -> dict[str, Any]:
         if j.alias in aliases:
             raise SqlError(f"duplicate table alias {j.alias!r}")
         aliases[j.alias] = j.index
-    for s in q.select:
+    for s in q.select + q.group_by:
         if s.kind == "window":
             raise SqlError(
                 "window functions are not supported in JOIN queries")
@@ -1153,6 +1160,14 @@ def _run_join(q: SqlQuery, search) -> dict[str, Any]:
                     f"exactly one table (got {sorted(owners) or 'none'})")
             owner = owners.pop()
             pushdown[owner].append(_strip_alias(conj, owner))
+    # a WHERE predicate on the nullable side of a LEFT JOIN is
+    # null-rejecting (our predicates never match a missing field), so
+    # SQL's post-join WHERE degenerates the join to INNER; pushing the
+    # predicate into the side's scan while staying left-outer would
+    # instead RESURRECT filtered-out rows as NULL-extended ones
+    joins = [JoinClause(j.index, j.alias, j.on, left_outer=False)
+             if j.left_outer and pushdown[j.alias] else j
+             for j in q.joins]
 
     sides: dict[str, list[dict]] = {}
     for alias, index in aliases.items():
@@ -1164,7 +1179,7 @@ def _run_join(q: SqlQuery, search) -> dict[str, Any]:
     rows: list[dict[str, Optional[dict]]] = [
         {q.alias: doc} for doc in sides[q.alias]]
     joined = {q.alias}
-    for j in q.joins:
+    for j in joins:
         left_keys: list[str] = []
         right_keys: list[str] = []
         for lhs, rhs in j.on:

@@ -412,3 +412,39 @@ def test_contextual_keywords_stay_valid_columns():
     assert q.select[0].column == "count"
     q = parse_sql("SELECT COUNT(*) FROM idx GROUP BY on")
     assert q.group_by[0].column == "on"
+
+
+def test_left_join_where_on_nullable_side_degenerates_to_inner(rel_api):
+    # SQL evaluates WHERE post-join: a null-rejecting predicate on the
+    # LEFT-joined side must drop unmatched rows, not resurrect them as
+    # NULL-extended ones
+    status, out = rel_api(
+        "SELECT o.user, u.tier FROM orders o "
+        "LEFT JOIN users u ON o.user = u.name WHERE u.tier = 'gold'")
+    assert status == 200
+    assert all(tier == "gold" for _user, tier in out["rows"])
+    assert len(out["rows"]) == 6
+
+
+def test_zero_row_scalar_subquery_is_null(rel_api):
+    # 0-row scalar subquery = NULL; comparison with NULL matches nothing
+    status, out = rel_api(
+        "SELECT COUNT(*) AS n FROM orders WHERE amount > "
+        "(SELECT amount FROM orders WHERE amount > 99999)")
+    assert (status, out["rows"]) == (200, [[0]])
+
+
+def test_group_by_trunc_in_join_is_400(rel_api):
+    status, _ = rel_api(
+        "SELECT COUNT(*) FROM orders o JOIN users u ON o.user = u.name "
+        "GROUP BY DATE_TRUNC('day', o.ts)")
+    assert status == 400
+
+
+def test_distinct_window_gets_typed_error():
+    with pytest.raises(SqlError, match="window function"):
+        parse_sql("SELECT COUNT(DISTINCT x) OVER (PARTITION BY y) "
+                  "FROM idx")
+    with pytest.raises(SqlError, match="window function"):
+        parse_sql("SELECT APPROX_PERCENTILE(x, 50) OVER "
+                  "(PARTITION BY y) FROM idx")
