@@ -21,10 +21,16 @@ Expressions: literals (numbers, "strings", true/false/null), field refs
 `== != < <= > >=`, boolean `&& ||`, and function calls. `+` concatenates
 when either side is a string.
 
-Functions: string, int, float, bool, lowercase, uppercase, trim,
-replace(s, from, to), contains(s, sub), starts_with(s, p),
-ends_with(s, p), split(s, sep), join(arr, sep), length(x), exists(.f),
-now() (epoch seconds), parse_json(s).
+Functions: string, int, float, bool, lowercase/downcase,
+uppercase/upcase, trim, replace(s, from, to), contains(s, sub),
+starts_with(s, p), ends_with(s, p), split(s, sep), join(arr, sep),
+length(x), exists(.f), now() (epoch seconds), parse_json(s),
+encode_json(x), round/floor/ceil/abs, slice(x, lo, hi),
+truncate(s, n), push(arr, v), merge(obj, obj), md5/sha1/sha256,
+to_unix_timestamp(x), parse_timestamp(s, fmt),
+format_timestamp(secs, fmt), parse_regex(s, pattern) (named groups),
+parse_key_value(s) (logfmt), parse_common_log(s) (Apache CLF/combined),
+parse_syslog(s) (RFC3164), parse_url(s).
 
 Failure semantics match VRL's abort-on-error default: any runtime error
 (type mismatch, bad function arg) makes the doc invalid — counted and
@@ -33,10 +39,15 @@ dropped by the pipeline, never published half-transformed.
 
 from __future__ import annotations
 
+import datetime as _dt
+import functools
+import hashlib
 import json
+import math
 import re
 import time
 from typing import Any, Callable, Optional
+from urllib.parse import urlsplit, parse_qsl
 
 
 class TransformParseError(Exception):
@@ -139,6 +150,174 @@ def _fn_join(arr, sep):
     return _str_arg("join", sep).join(_fn_string(v) for v in arr)
 
 
+def _num_arg(name: str, x) -> float:
+    if isinstance(x, bool) or not isinstance(x, (int, float)):
+        raise TransformRuntimeError(f"{name}() requires a number, got "
+                                    f"{type(x).__name__}")
+    return x
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled_regex(pattern: str) -> "re.Pattern":
+    try:
+        return re.compile(pattern)
+    except re.error as exc:
+        raise TransformRuntimeError(f"parse_regex(): bad pattern: {exc}")
+
+
+def _fn_parse_regex(s, pattern):
+    """Named capture groups -> object (VRL parse_regex!); no match is a
+    per-doc error, like VRL's abort-on-error default."""
+    m = _compiled_regex(_str_arg("parse_regex", pattern)).search(
+        _str_arg("parse_regex", s))
+    if m is None:
+        raise TransformRuntimeError("parse_regex(): no match")
+    out = {k: v for k, v in m.groupdict().items() if v is not None}
+    if not out:  # positional groups fall back to _0.._n
+        out = {f"_{i}": g for i, g in enumerate(m.groups(), 1)
+               if g is not None}
+    return out
+
+
+_KV_RE = re.compile(r'([A-Za-z0-9_.\-]+)=("(?:[^"\\]|\\.)*"|\S*)')
+
+
+def _fn_parse_key_value(s):
+    """logfmt-style `k=v k2="quoted v"` -> object (VRL
+    parse_key_value!)."""
+    out = {}
+    for key, raw in _KV_RE.findall(_str_arg("parse_key_value", s)):
+        if raw.startswith('"') and raw.endswith('"') and len(raw) >= 2:
+            try:
+                out[key] = json.loads(raw)
+            except ValueError:
+                out[key] = raw[1:-1]
+        else:
+            out[key] = raw
+    return out
+
+
+_CLF_RE = re.compile(
+    r'^(?P<host>\S+) (?P<identity>\S+) (?P<user>\S+) '
+    r'\[(?P<timestamp>[^\]]+)\] "(?P<method>\S+) (?P<path>\S+)'
+    r'(?: (?P<protocol>[^"]+))?" (?P<status>\d{3}) (?P<size>\d+|-)'
+    r'(?: "(?P<referrer>[^"]*)" "(?P<user_agent>[^"]*)")?')
+
+
+def _fn_parse_common_log(s):
+    """Apache common/combined log format -> object (VRL
+    parse_common_log! / parse_apache_log!)."""
+    m = _CLF_RE.match(_str_arg("parse_common_log", s))
+    if m is None:
+        raise TransformRuntimeError("parse_common_log(): no match")
+    out = {k: v for k, v in m.groupdict().items() if v is not None}
+    out["status"] = int(out["status"])
+    out["size"] = 0 if out["size"] == "-" else int(out["size"])
+    return out
+
+
+_SYSLOG_RE = re.compile(
+    r'^<(?P<pri>\d{1,3})>(?P<timestamp>[A-Z][a-z]{2} [ \d]\d '
+    r'\d{2}:\d{2}:\d{2}) (?P<hostname>\S+) '
+    r'(?P<appname>[^\[:\s]+)(?:\[(?P<procid>\d+)\])?: ?(?P<message>.*)$')
+
+
+def _fn_parse_syslog(s):
+    """RFC3164 syslog line -> object with facility/severity split out
+    (VRL parse_syslog!)."""
+    m = _SYSLOG_RE.match(_str_arg("parse_syslog", s))
+    if m is None:
+        raise TransformRuntimeError("parse_syslog(): no match")
+    out = {k: v for k, v in m.groupdict().items() if v is not None}
+    pri = int(out.pop("pri"))
+    out["facility"] = pri // 8
+    out["severity"] = pri % 8
+    if "procid" in out:
+        out["procid"] = int(out["procid"])
+    return out
+
+
+def _fn_parse_url(s):
+    parts = urlsplit(_str_arg("parse_url", s))
+    out: dict[str, Any] = {"scheme": parts.scheme, "host": parts.hostname,
+                           "path": parts.path}
+    if parts.port is not None:
+        out["port"] = parts.port
+    if parts.query:
+        out["query"] = dict(parse_qsl(parts.query))
+    if parts.fragment:
+        out["fragment"] = parts.fragment
+    return out
+
+
+_TS_FORMATS = ("%Y-%m-%dT%H:%M:%S.%f%z", "%Y-%m-%dT%H:%M:%S%z",
+               "%Y-%m-%dT%H:%M:%S.%f", "%Y-%m-%dT%H:%M:%S",
+               "%Y-%m-%d %H:%M:%S.%f", "%Y-%m-%d %H:%M:%S", "%Y-%m-%d")
+
+
+def _fn_to_unix_timestamp(x):
+    """Epoch seconds from a number (pass-through) or an RFC3339-ish
+    string (VRL to_unix_timestamp)."""
+    if isinstance(x, (int, float)) and not isinstance(x, bool):
+        return int(x)
+    text = _str_arg("to_unix_timestamp", x).replace("Z", "+00:00")
+    for fmt in _TS_FORMATS:
+        try:
+            parsed = _dt.datetime.strptime(text, fmt)
+        except ValueError:
+            continue
+        if parsed.tzinfo is None:
+            parsed = parsed.replace(tzinfo=_dt.timezone.utc)
+        return int(parsed.timestamp())
+    raise TransformRuntimeError(
+        f"to_unix_timestamp(): unrecognized timestamp {x!r}")
+
+
+def _fn_parse_timestamp(s, fmt):
+    """strptime with an explicit format -> epoch seconds (VRL
+    parse_timestamp!)."""
+    try:
+        parsed = _dt.datetime.strptime(_str_arg("parse_timestamp", s),
+                                       _str_arg("parse_timestamp", fmt))
+    except ValueError as exc:
+        raise TransformRuntimeError(f"parse_timestamp(): {exc}")
+    if parsed.tzinfo is None:
+        parsed = parsed.replace(tzinfo=_dt.timezone.utc)
+    return int(parsed.timestamp())
+
+
+def _fn_format_timestamp(ts, fmt):
+    """Epoch seconds -> string via strftime, UTC (VRL
+    format_timestamp!)."""
+    try:
+        moment = _dt.datetime.fromtimestamp(
+            _num_arg("format_timestamp", ts), tz=_dt.timezone.utc)
+    except (OverflowError, OSError, ValueError) as exc:
+        raise TransformRuntimeError(f"format_timestamp(): {exc}")
+    return moment.strftime(_str_arg("format_timestamp", fmt))
+
+
+def _fn_slice(x, start, end):
+    lo = int(_num_arg("slice", start))
+    hi = int(_num_arg("slice", end))
+    if isinstance(x, (str, list)):
+        return x[lo:hi]
+    raise TransformRuntimeError(
+        f"slice() requires string/array, got {type(x).__name__}")
+
+
+def _fn_push(arr, value):
+    if not isinstance(arr, list):
+        raise TransformRuntimeError("push() requires an array")
+    return arr + [value]
+
+
+def _fn_merge(a, b):
+    if not isinstance(a, dict) or not isinstance(b, dict):
+        raise TransformRuntimeError("merge() requires two objects")
+    return {**a, **b}
+
+
 _FUNCTIONS: dict[str, tuple[int, Callable]] = {
     "string": (1, _fn_string),
     "int": (1, _fn_int),
@@ -146,6 +325,9 @@ _FUNCTIONS: dict[str, tuple[int, Callable]] = {
     "bool": (1, lambda x: bool(x)),
     "lowercase": (1, lambda x: _str_arg("lowercase", x).lower()),
     "uppercase": (1, lambda x: _str_arg("uppercase", x).upper()),
+    # VRL spells these downcase/upcase — both spellings resolve
+    "downcase": (1, lambda x: _str_arg("downcase", x).lower()),
+    "upcase": (1, lambda x: _str_arg("upcase", x).upper()),
     "trim": (1, lambda x: _str_arg("trim", x).strip()),
     "replace": (3, lambda s, a, b: _str_arg("replace", s).replace(
         _str_arg("replace", a), _str_arg("replace", b))),
@@ -161,6 +343,38 @@ _FUNCTIONS: dict[str, tuple[int, Callable]] = {
     "length": (1, _fn_length),
     "now": (0, lambda: int(time.time())),
     "parse_json": (1, _fn_parse_json),
+    "encode_json": (1, lambda x: json.dumps(x)),
+    # numeric (round is half-away-from-zero like VRL, not Python's
+    # banker's rounding: round(2.5) == 3, round(-2.5) == -3)
+    "round": (1, lambda x: math.floor(_num_arg("round", x) + 0.5)
+              if _num_arg("round", x) >= 0
+              else math.ceil(_num_arg("round", x) - 0.5)),
+    "floor": (1, lambda x: math.floor(_num_arg("floor", x))),
+    "ceil": (1, lambda x: math.ceil(_num_arg("ceil", x))),
+    "abs": (1, lambda x: abs(_num_arg("abs", x))),
+    # strings / arrays / objects
+    "slice": (3, _fn_slice),
+    "truncate": (2, lambda s, n: _str_arg("truncate", s)
+                 [: int(_num_arg("truncate", n))]),
+    "push": (2, _fn_push),
+    "merge": (2, _fn_merge),
+    # hashes (hex digests, VRL md5/sha1/sha2)
+    "md5": (1, lambda x: hashlib.md5(
+        _str_arg("md5", x).encode()).hexdigest()),
+    "sha1": (1, lambda x: hashlib.sha1(
+        _str_arg("sha1", x).encode()).hexdigest()),
+    "sha256": (1, lambda x: hashlib.sha256(
+        _str_arg("sha256", x).encode()).hexdigest()),
+    # timestamps
+    "to_unix_timestamp": (1, _fn_to_unix_timestamp),
+    "parse_timestamp": (2, _fn_parse_timestamp),
+    "format_timestamp": (2, _fn_format_timestamp),
+    # structured parsers
+    "parse_regex": (2, _fn_parse_regex),
+    "parse_key_value": (1, _fn_parse_key_value),
+    "parse_common_log": (1, _fn_parse_common_log),
+    "parse_syslog": (1, _fn_parse_syslog),
+    "parse_url": (1, _fn_parse_url),
 }
 
 

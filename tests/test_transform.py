@@ -187,3 +187,105 @@ def test_bad_string_literal_is_parse_error():
 def test_non_dict_params_rejected():
     with pytest.raises(TransformParseError):
         transform_from_source_params([1])  # type: ignore[arg-type]
+
+
+# --------------------------------------------------------------------------
+# extended function library (VRL stdlib analogues)
+
+def test_structured_parsers():
+    t = Transform('.kv = parse_key_value(.line)')
+    out = t.apply({"line": 'level=info msg="hello world" code=7'})
+    assert out["kv"] == {"level": "info", "msg": "hello world",
+                        "code": "7"}
+
+    t = Transform('.req = parse_common_log(.line)')
+    out = t.apply({"line": '127.0.0.1 - frank [10/Oct/2000:13:55:36 '
+                           '-0700] "GET /apache_pb.gif HTTP/1.0" '
+                           '200 2326'})
+    assert out["req"]["host"] == "127.0.0.1"
+    assert out["req"]["method"] == "GET"
+    assert out["req"]["status"] == 200
+    assert out["req"]["size"] == 2326
+
+    t = Transform('.log = parse_syslog(.line)')
+    out = t.apply({"line": "<34>Oct 11 22:14:15 mymachine su[230]: "
+                           "'su root' failed"})
+    assert out["log"]["facility"] == 4
+    assert out["log"]["severity"] == 2
+    assert out["log"]["hostname"] == "mymachine"
+    assert out["log"]["appname"] == "su"
+    assert out["log"]["procid"] == 230
+
+    t = Transform('.u = parse_url(.link)')
+    out = t.apply({"link": "https://example.com:8443/a/b?x=1&y=2#frag"})
+    assert out["u"] == {"scheme": "https", "host": "example.com",
+                       "port": 8443, "path": "/a/b",
+                       "query": {"x": "1", "y": "2"}, "fragment": "frag"}
+
+    t = Transform('.m = parse_regex(.s, "(?P<user>\\\\w+)@(?P<dom>\\\\w+)")')
+    assert t.apply({"s": "bob@example"})["m"] == {"user": "bob",
+                                                 "dom": "example"}
+
+
+def test_timestamp_functions():
+    t = Transform('.ts = to_unix_timestamp(.when)')
+    assert t.apply({"when": "2001-09-09T01:46:40Z"})["ts"] == 1_000_000_000
+    assert t.apply({"when": 123.9})["ts"] == 123
+
+    t = Transform('.ts = parse_timestamp(.when, "%d/%b/%Y %H:%M:%S")')
+    assert t.apply({"when": "09/Sep/2001 01:46:40"})["ts"] \
+        == 1_000_000_000
+
+    t = Transform('.day = format_timestamp(.ts, "%Y-%m-%d")')
+    assert t.apply({"ts": 1_000_000_000})["day"] == "2001-09-09"
+
+
+def test_numeric_array_hash_functions():
+    t = Transform("""
+.r = round(.x)
+.f = floor(.x)
+.c = ceil(.x)
+.a = abs(0 - .x)
+.first = slice(.tags, 0, 2)
+.short = truncate(.name, 3)
+.more = push(.tags, "z")
+.all = merge(.obj, .obj2)
+.h = sha256(.name)
+.enc = encode_json(.obj)
+.lower = downcase(.name)
+""")
+    out = t.apply({"x": 2.5, "tags": ["a", "b", "c"], "name": "HELLO",
+                   "obj": {"k": 1}, "obj2": {"j": 2}})
+    # round is half-away-from-zero (VRL), not banker's rounding
+    assert (out["r"], out["f"], out["c"], out["a"]) == (3, 2, 3, 2.5)
+    assert out["first"] == ["a", "b"]
+    assert out["short"] == "HEL"
+    assert out["more"] == ["a", "b", "c", "z"]
+    assert out["all"] == {"k": 1, "j": 2}
+    assert out["h"] == ("3733cd977ff8eb18b987357e22ced99f46097f31ecb2"
+                        "39e878ae63760e83e4d5")
+    assert out["enc"] == '{"k": 1}'
+    assert out["lower"] == "hello"
+
+
+def test_extended_functions_fail_per_doc():
+    import pytest as _pytest
+    t = Transform('.m = parse_regex(.s, "(?P<d>\\\\d+)")')
+    with _pytest.raises(TransformRuntimeError):
+        t.apply({"s": "no digits here"})
+    t = Transform('.x = parse_common_log(.line)')
+    with _pytest.raises(TransformRuntimeError):
+        t.apply({"line": "not a log line"})
+    t = Transform('.x = round(.s)')
+    with _pytest.raises(TransformRuntimeError):
+        t.apply({"s": "str"})
+    # stdlib leaks (ValueError from urlsplit ports, OverflowError from
+    # inf) stay typed per-doc failures — never abort the whole batch
+    t = Transform('.u = parse_url(.link)')
+    with _pytest.raises(TransformRuntimeError):
+        t.apply({"link": "http://host:bad/"})
+    t = Transform('.r = round(.x)')
+    with _pytest.raises(TransformRuntimeError):
+        t.apply({"x": float("inf")})
+    t = Transform('.r = round(0 - 2.5)')
+    assert t.apply({})["r"] == -3
