@@ -448,3 +448,49 @@ def test_distinct_window_gets_typed_error():
     with pytest.raises(SqlError, match="window function"):
         parse_sql("SELECT APPROX_PERCENTILE(x, 50) OVER "
                   "(PARTITION BY y) FROM idx")
+
+
+def test_ui_console_has_sql_tab():
+    # the zero-dep console at /ui carries the SQL tab wired to /_sql
+    from quickwit_tpu.serve.ui import UI_HTML
+    for needle in ("tab-sql", "run-sql", "/api/v1/_sql", "sqlbar"):
+        assert needle in UI_HTML
+
+
+def test_ui_console_js_strings_have_no_raw_newlines():
+    """A raw newline inside a quoted JS string (e.g. a Python '\\n'
+    escape that should have been '\\\\n' in the embedded template) is a
+    JS SyntaxError that kills the WHOLE console script — regression
+    guard for exactly that breakage."""
+    import re
+    from quickwit_tpu.serve.ui import UI_HTML
+    js = re.search(r"<script>(.*)</script>", UI_HTML, re.S).group(1)
+    in_str = None
+    escaped = False
+    line = 1
+    bad = []
+    i = 0
+    while i < len(js):
+        c = js[i]
+        if c == "\n":
+            line += 1
+        if in_str:
+            if escaped:
+                escaped = False
+            elif c == "\\":
+                escaped = True
+            elif c == "\n" and in_str != "`":  # templates may span lines
+                bad.append(line)
+                in_str = None
+            elif c == in_str:
+                in_str = None
+            i += 1
+            continue
+        if c == "/" and js[i + 1: i + 2] == "[":  # esc()'s regex literal
+            i = js.index("/g", i) + 2
+            continue
+        if c in "'\"`":
+            in_str = c
+        i += 1
+    assert not bad, f"raw newline inside JS string at script line(s) {bad}"
+    assert in_str is None, "unterminated JS string literal"
