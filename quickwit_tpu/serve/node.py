@@ -852,6 +852,20 @@ class Node:
         self._applied_indexing_tasks = applied
         return {"applied": len(applied)}
 
+    def owns_index(self, index_uid: str) -> bool:
+        """Deterministic single-worker election per index: every node
+        computes the same owner from the same alive set (rendezvous
+        hash, stateless — unlike the scheduler's affinity memory), so
+        concurrent cli-run indexer nodes sharing one file-backed
+        metastore don't race merge writes on the same index. The legacy
+        source gate when no indexing plan was ever applied."""
+        from ..common.rendezvous import sort_by_rendezvous_hash
+        indexers = self.cluster.nodes_with_role("indexer")
+        if not indexers:
+            return False
+        return sort_by_rendezvous_hash(index_uid, indexers)[0] \
+            == self.config.node_id
+
     def indexing_tasks(self) -> list[dict]:
         """What this node believes it is running (drift-check input)."""
         return list(self._applied_indexing_tasks or [])
@@ -1266,19 +1280,7 @@ class Node:
                 port=self.config.grpc_port,
                 ssl_context=self.config.server_ssl_context(alpn=["h2"]))
         stop = self._bg_stop = threading.Event()
-
-        def owns_index(index_uid: str) -> bool:
-            # Deterministic single-worker election per index: every node
-            # computes the same owner from the same alive set (rendezvous
-            # hash, stateless — unlike the scheduler's affinity memory),
-            # so concurrent cli-run indexer nodes sharing one file-backed
-            # metastore don't race merge writes on the same index.
-            from ..common.rendezvous import sort_by_rendezvous_hash
-            indexers = self.cluster.nodes_with_role("indexer")
-            if not indexers:
-                return False
-            return sort_by_rendezvous_hash(index_uid, indexers)[0] \
-                == self.config.node_id
+        owns_index = self.owns_index
 
         def ingest_tick() -> None:
             # Drains the LOCAL WAL — no ownership gate: only this node can

@@ -1,0 +1,269 @@
+"""Bounded model check of the control-plane convergence protocol.
+
+Role of the reference's stateright models (`quickwit-dst/src/models/`):
+exhaustive BFS over failure interleavings, driving the REAL
+implementation — `Node.run_control_plane_pass` (leader election, plan,
+concurrent poll, per-node diff apply), `apply_indexing_plan`,
+`indexing_tasks_report`, `source_assignment_allows`, and the real
+`IndexingScheduler` — with only the transport faked (direct method
+calls that raise when the model cuts a link). Reference behavior
+modeled: the singleton scheduler's apply/drift loop
+(`control_plane/src/indexing_scheduler/mod.rs:111,360` +
+`indexing_service.rs:1152`).
+
+Actions: leader pass, leader death + revival, indexer process restart
+(in-memory plan loss), network partition + heal. From EVERY reachable
+state the protocol must re-converge once the network is quiet:
+repeated passes reach drift=False, and then each external source has
+EXACTLY ONE consumer among alive nodes (the single-consumer rule the
+plan gating exists to enforce), with gating live on every alive node.
+"""
+
+import time
+
+import pytest
+
+from quickwit_tpu.cluster.membership import ClusterMember
+from quickwit_tpu.models.index_metadata import SourceConfig
+from quickwit_tpu.serve import Node, NodeConfig
+from quickwit_tpu.storage import StorageResolver
+
+NODE_IDS = ("m0", "m1", "m2")
+SOURCES = ("file-0", "file-1")
+MAX_DEPTH = 6
+CONVERGE_PASSES = 4
+
+
+class FakeClient:
+    """The wire, minus the wire: routes the two control-plane RPCs
+    straight to the peer object; raises when the model partitioned or
+    killed the peer (exactly what a socket would do)."""
+
+    def __init__(self, world, peer_id):
+        self.world = world
+        self.peer_id = peer_id
+
+    def _post(self, path, body):
+        if self.peer_id in self.world.dead or \
+                self.peer_id in self.world.cut:
+            raise ConnectionError(f"{self.peer_id} unreachable")
+        peer = self.world.nodes[self.peer_id]
+        if path == "/internal/indexing_tasks":
+            return peer.indexing_tasks_report()
+        if path == "/internal/apply_indexing_plan":
+            return peer.apply_indexing_plan(body.get("tasks", []))
+        raise AssertionError(f"unexpected RPC {path}")
+
+
+class World:
+    """One materialization: three all-role nodes sharing a metastore,
+    one index with two external sources."""
+
+    def __init__(self):
+        self.resolver = StorageResolver.for_test()
+        self.nodes = {}
+        self.dead: set[str] = set()
+        self.cut: set[str] = set()
+        for node_id in NODE_IDS:
+            self.nodes[node_id] = Node(
+                NodeConfig(node_id=node_id, rest_port=0,
+                           metastore_uri="ram:///mc/ms",
+                           default_index_root_uri="ram:///mc/idx"),
+                storage_resolver=self.resolver)
+        for node in self.nodes.values():
+            for peer_id, peer in self.nodes.items():
+                if peer_id != node.config.node_id:
+                    node.cluster.upsert_heartbeat(ClusterMember(
+                        peer_id, tuple(peer.config.roles)))
+                    node.clients[peer_id] = FakeClient(self, peer_id)
+        first = self.nodes["m0"]
+        first.index_service.create_index({
+            "index_id": "mc", "doc_mapping": {"field_mappings": [
+                {"name": "body", "type": "text"}]}})
+        self.uid = first.metastore.index_metadata("mc").index_uid
+        for source_id in SOURCES:
+            first.metastore.add_source(self.uid, SourceConfig(
+                source_id, "file", params={"filepath": "/dev/null"}))
+
+    # --- model actions ----------------------------------------------------
+    def alive(self):
+        return [n for n in NODE_IDS if n not in self.dead]
+
+    def leader_id(self):
+        return min(self.alive())
+
+    def set_liveness(self, node_id, alive):
+        stamp = time.monotonic() - (0 if alive else 10_000)
+        for node in self.nodes.values():
+            member = node.cluster.member(node_id)
+            if member is not None:
+                member.last_heartbeat = stamp
+                member.intervals.clear()
+
+    def apply(self, action):
+        if action == "pass":
+            self.nodes[self.leader_id()].run_control_plane_pass()
+        elif action == "kill-0":
+            self.dead.add("m0")
+            self.set_liveness("m0", False)
+        elif action == "revive-0":
+            self.dead.discard("m0")
+            self.set_liveness("m0", True)
+        elif action == "restart-1":
+            # process restart: the in-memory plan is gone
+            node = self.nodes["m1"]
+            node._applied_indexing_tasks = None
+            node._assigned_sources = set()
+        elif action == "cut-1":
+            self.cut.add("m1")
+        elif action == "heal-1":
+            self.cut.discard("m1")
+        else:
+            raise AssertionError(action)
+
+    def enabled(self, action):
+        if action == "pass":
+            return True
+        if action == "kill-0":
+            return "m0" not in self.dead
+        if action == "revive-0":
+            return "m0" in self.dead
+        if action == "restart-1":
+            return "m1" not in self.dead
+        if action == "cut-1":
+            return "m1" not in self.cut and "m1" not in self.dead
+        if action == "heal-1":
+            return "m1" in self.cut
+        raise AssertionError(action)
+
+    # --- observations -----------------------------------------------------
+    def fingerprint(self):
+        per_node = []
+        for node_id in NODE_IDS:
+            node = self.nodes[node_id]
+            applied = node._applied_indexing_tasks
+            per_node.append((applied is None, tuple(sorted(
+                (t["index_uid"], t["source_id"])
+                for t in (applied or [])))))
+        return (frozenset(self.dead), frozenset(self.cut),
+                tuple(per_node))
+
+    def consumers(self, source_id):
+        """Alive nodes whose REAL ingest gate would run this source —
+        source_assignment_allows with the production owns_index
+        rendezvous fallback for never-applied nodes (the same pair of
+        calls ingest_tick makes)."""
+        out = []
+        for node_id in self.alive():
+            node = self.nodes[node_id]
+            allowed = node.source_assignment_allows(self.uid, source_id)
+            if allowed is None:
+                allowed = node.owns_index(self.uid)
+            if allowed:
+                out.append(node_id)
+        return out
+
+
+def materialize(seq):
+    world = World()
+    for action in seq:
+        world.apply(action)
+    return world
+
+
+def check_convergence(world, trace):
+    """Quiet the network (heal cuts, keep deaths) and require the REAL
+    pass loop to converge, then enforce single-consumer + liveness."""
+    world.cut.clear()
+    leader = world.nodes[world.leader_id()]
+    out = None
+    for _ in range(CONVERGE_PASSES):
+        out = leader.run_control_plane_pass()
+        if out["drift"] is False:
+            break
+    assert out is not None and out["drift"] is False, \
+        f"no convergence after {CONVERGE_PASSES} passes; trace={trace}"
+    for source_id in SOURCES:
+        owners = world.consumers(source_id)
+        assert len(owners) == 1, \
+            (f"source {source_id} has consumers {owners} "
+             f"(want exactly 1); trace={trace}")
+    # every alive node is ON the plan (no node left behind on the
+    # legacy election after convergence)
+    for node_id in world.alive():
+        report = world.nodes[node_id].indexing_tasks_report()
+        assert report["applied"] is True, \
+            f"{node_id} never got a plan; trace={trace}"
+
+
+ACTIONS = ("pass", "kill-0", "revive-0", "restart-1", "cut-1", "heal-1")
+
+
+def test_model_check_convergence():
+    """BFS over failure interleavings; every reachable state must
+    re-converge to exactly-one-consumer-per-source."""
+    seen = set()
+    frontier = [()]
+    world = materialize(())
+    seen.add(world.fingerprint())
+    states = transitions = 0
+    while frontier:
+        next_frontier = []
+        for seq in frontier:
+            if len(seq) >= MAX_DEPTH:
+                continue
+            for action in ACTIONS:
+                world = materialize(seq)
+                if not world.enabled(action):
+                    continue
+                world.apply(action)
+                transitions += 1
+                fp = world.fingerprint()
+                if fp in seen:
+                    continue
+                seen.add(fp)
+                next_frontier.append(seq + (action,))
+                check_convergence(world, seq + (action,))
+                states += 1
+        frontier = next_frontier
+    # pin the explored-space size: silent shrinkage = lost coverage
+    # (1,876 states / 1,885 transitions at depth 6 when written)
+    assert states >= 1_500, states
+    assert transitions >= 1_500, transitions
+
+
+def test_leader_failover_reassigns():
+    """Directed scenario: the LEADER dies; the next controller takes
+    over and re-plans the dead node's sources onto survivors."""
+    world = materialize(("pass",))
+    before = {s: world.consumers(s) for s in SOURCES}
+    assert all(len(v) == 1 for v in before.values())
+    world.apply("kill-0")
+    assert world.leader_id() == "m1"
+    out = world.nodes["m1"].run_control_plane_pass()
+    assert out["drift"] is True
+    for source_id in SOURCES:
+        [owner] = world.consumers(source_id)
+        assert owner != "m0"
+
+
+def test_restarted_node_rejoins_plan():
+    """Directed scenario: an indexer restart (plan loss) re-converges
+    onto the plan instead of double-consuming via the election."""
+    world = materialize(("pass", "restart-1"))
+    report = world.nodes["m1"].indexing_tasks_report()
+    assert report["applied"] is False
+    check_convergence(world, ("pass", "restart-1"))
+
+
+def test_partitioned_node_keeps_old_slice_until_heal():
+    """A partitioned indexer keeps running its last applied slice (it
+    can't learn otherwise); after heal the next pass restores exact
+    single-ownership."""
+    world = materialize(("pass", "cut-1"))
+    old = {t["source_id"]
+           for t in world.nodes["m1"].indexing_tasks()}
+    world.nodes[world.leader_id()].run_control_plane_pass()
+    assert {t["source_id"]
+            for t in world.nodes["m1"].indexing_tasks()} == old
+    check_convergence(world, ("pass", "cut-1"))

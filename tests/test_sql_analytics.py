@@ -486,8 +486,21 @@ def test_ui_console_js_strings_have_no_raw_newlines():
                 in_str = None
             i += 1
             continue
-        if c == "/" and js[i + 1: i + 2] == "[":  # esc()'s regex literal
-            i = js.index("/g", i) + 2
+        if c == "/" and js[i + 1: i + 2] == "/":  # // comment: to EOL
+            i = js.find("\n", i)
+            if i < 0:
+                break
+            continue
+        if c == "/" and js[i + 1: i + 2] == "[":
+            # a character-class regex literal (e.g. esc()'s); skip to
+            # its closing ']' then the trailing '/flags' — bounded to
+            # the same line so a miss can't swallow later script
+            close = js.index("]", i)
+            end = js.index("/", close)
+            eol = js.find("\n", i)
+            assert eol < 0 or end < eol, \
+                f"unrecognized '/[' construct at script line {line}"
+            i = end + 1
             continue
         if c in "'\"`":
             in_str = c
