@@ -8,7 +8,9 @@ Role of the reference's `quickwit-cli` (`cli.rs:56`):
   quickwit-tpu index ingest --index ID [--input-path F] [ndjson on stdin]
   quickwit-tpu index search --index ID --query Q [--max-hits N] [--aggs JSON]
   quickwit-tpu index merge --index ID                   one merge pass
-  quickwit-tpu split list --index ID
+  quickwit-tpu source create --index ID --source-config FILE
+  quickwit-tpu source list | delete | enable | disable --index ID [--source S]
+  quickwit-tpu split list | describe | mark-for-deletion --index ID
   quickwit-tpu tool gc | retention                      janitor passes
   quickwit-tpu tool extract-split --index ID --split ID --output-dir D
 
@@ -170,6 +172,84 @@ def cmd_index_merge(args) -> int:
     return 0
 
 
+def cmd_source_create(args) -> int:
+    from .config import load_source_config
+    from .indexing.sources import parse_source_config
+    node = _embedded_node(args)
+    metadata = node.metastore.index_metadata(args.index)
+    # same parse/validation path as the REST POST /sources route
+    source = parse_source_config(load_source_config(args.source_config))
+    node.metastore.add_source(metadata.index_uid, source)
+    print(json.dumps(source.to_dict(), indent=2))
+    return 0
+
+
+def cmd_source_list(args) -> int:
+    node = _embedded_node(args)
+    metadata = node.metastore.index_metadata(args.index)
+    print(json.dumps({"sources": [s.to_dict()
+                                  for s in metadata.sources.values()]},
+                     indent=2))
+    return 0
+
+
+def cmd_source_delete(args) -> int:
+    from .ingest.router import INTERNAL_SOURCE_IDS
+    if args.source in INTERNAL_SOURCE_IDS:
+        print(f"error: {args.source} is a built-in source",
+              file=sys.stderr)
+        return 1
+    node = _embedded_node(args)
+    metadata = node.metastore.index_metadata(args.index)
+    node.metastore.delete_source(metadata.index_uid, args.source)
+    print(f"deleted source {args.source}")
+    return 0
+
+
+def cmd_source_toggle(args) -> int:
+    node = _embedded_node(args)
+    metadata = node.metastore.index_metadata(args.index)
+    enable = args.subcommand == "enable"
+    node.metastore.toggle_source(metadata.index_uid, args.source, enable)
+    print(f"{'enabled' if enable else 'disabled'} source {args.source}")
+    return 0
+
+
+def cmd_split_describe(args) -> int:
+    node = _embedded_node(args)
+    metadata = node.metastore.index_metadata(args.index)
+    from .metastore.base import ListSplitsQuery
+    splits = node.metastore.list_splits(
+        ListSplitsQuery(index_uids=[metadata.index_uid]))
+    for split in splits:
+        if split.metadata.split_id == args.split:
+            print(json.dumps(split.to_dict(), indent=2))
+            return 0
+    print(f"error: split {args.split} not found in {args.index}",
+          file=sys.stderr)
+    return 1
+
+
+def cmd_split_mark_for_deletion(args) -> int:
+    node = _embedded_node(args)
+    metadata = node.metastore.index_metadata(args.index)
+    split_ids = [s.strip() for s in args.splits.split(",") if s.strip()]
+    from .metastore.base import ListSplitsQuery
+    known = {s.metadata.split_id for s in node.metastore.list_splits(
+        ListSplitsQuery(index_uids=[metadata.index_uid]))}
+    unknown = [s for s in split_ids if s not in known]
+    if unknown:
+        # the metastore skips unknown ids silently; the CLI must not
+        # report success for splits that were never marked
+        print(f"error: unknown split id(s): {', '.join(unknown)}",
+              file=sys.stderr)
+        return 1
+    node.metastore.mark_splits_for_deletion(metadata.index_uid, split_ids)
+    print(f"marked {len(split_ids)} split(s) for deletion "
+          "(the janitor GC pass removes the files)")
+    return 0
+
+
 def cmd_split_list(args) -> int:
     node = _embedded_node(args)
     metadata = node.metastore.index_metadata(args.index)
@@ -243,11 +323,39 @@ def build_parser() -> argparse.ArgumentParser:
     merge.add_argument("--index", required=True)
     merge.set_defaults(func=cmd_index_merge)
 
+    source = sub.add_parser("source", help="source management")
+    source_sub = source.add_subparsers(dest="subcommand", required=True)
+    source_create = source_sub.add_parser("create")
+    source_create.add_argument("--index", required=True)
+    source_create.add_argument("--source-config", required=True)
+    source_create.set_defaults(func=cmd_source_create)
+    source_list = source_sub.add_parser("list")
+    source_list.add_argument("--index", required=True)
+    source_list.set_defaults(func=cmd_source_list)
+    source_delete = source_sub.add_parser("delete")
+    source_delete.add_argument("--index", required=True)
+    source_delete.add_argument("--source", required=True)
+    source_delete.set_defaults(func=cmd_source_delete)
+    for toggle_name in ("enable", "disable"):
+        toggle = source_sub.add_parser(toggle_name)
+        toggle.add_argument("--index", required=True)
+        toggle.add_argument("--source", required=True)
+        toggle.set_defaults(func=cmd_source_toggle)
+
     split = sub.add_parser("split", help="split management")
     split_sub = split.add_subparsers(dest="subcommand", required=True)
     split_list = split_sub.add_parser("list")
     split_list.add_argument("--index", required=True)
     split_list.set_defaults(func=cmd_split_list)
+    split_desc = split_sub.add_parser("describe")
+    split_desc.add_argument("--index", required=True)
+    split_desc.add_argument("--split", required=True)
+    split_desc.set_defaults(func=cmd_split_describe)
+    split_mark = split_sub.add_parser("mark-for-deletion")
+    split_mark.add_argument("--index", required=True)
+    split_mark.add_argument("--splits", required=True,
+                            help="comma-separated split ids")
+    split_mark.set_defaults(func=cmd_split_mark_for_deletion)
 
     tool = sub.add_parser("tool", help="maintenance tools")
     tool_sub = tool.add_subparsers(dest="subcommand", required=True)

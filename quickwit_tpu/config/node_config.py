@@ -38,6 +38,21 @@ def _load_yaml(path: str, env: Optional[dict[str, str]] = None) -> dict[str, Any
     return yaml.safe_load(interpolate_env(raw, env)) or {}
 
 
+def load_source_config(path: str,
+                       env: Optional[dict[str, str]] = None) -> dict[str, Any]:
+    """Source config file (yaml/json) -> the dict the source-create
+    route consumes (reference: `source_config/mod.rs` yaml shape)."""
+    data = _load_yaml(path, env)
+    if not isinstance(data, dict):
+        raise ValueError(
+            f"source config {path} must be a YAML/JSON object, "
+            f"got {type(data).__name__}")
+    data.pop("version", None)
+    if not isinstance(data.get("source_id"), str):
+        raise ValueError("source config requires a string source_id")
+    return data
+
+
 def load_node_config(path: Optional[str] = None,
                      env: Optional[dict[str, str]] = None) -> NodeConfig:
     """Precedence: defaults < config file < QW_* env vars

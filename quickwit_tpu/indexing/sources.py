@@ -118,6 +118,27 @@ class VoidSource(Source):
 _UNSUPPORTED = {"pulsar", "gcp_pubsub"}
 
 
+def parse_source_config(spec: Any) -> "Any":
+    """Validated spec dict -> SourceConfig — the ONE place the REST
+    route and the CLI share for defaults + config-time transform-script
+    validation (reference: `source_config/mod.rs` deserialization).
+    Raises ValueError (HTTP 400 at the REST boundary)."""
+    from ..models.index_metadata import SourceConfig
+    from .transform import transform_from_source_params
+    if not isinstance(spec, dict):
+        raise ValueError("source config must be a JSON/YAML object")
+    if not isinstance(spec.get("source_id"), str):
+        raise ValueError("source requires a string source_id")
+    source = SourceConfig(
+        source_id=spec["source_id"],
+        source_type=spec.get("source_type", "vec"),
+        params=spec.get("params", {}),
+        enabled=spec.get("enabled", True))
+    # reject bad transform scripts at config time, not ingest time
+    transform_from_source_params(source.params)
+    return source
+
+
 def make_source(source_type: str, params: dict[str, Any],
                 resolver=None) -> Source:
     """`resolver`: storage resolver for sources that FETCH notified

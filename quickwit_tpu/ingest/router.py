@@ -20,6 +20,9 @@ from .ingester import Ingester, ShardState
 INGEST_V2_SOURCE_ID = "_ingest-source"
 INGEST_API_SOURCE_ID = "_ingest-api-source"  # the v1 synchronous REST path
 
+# sources whose checkpoints guard the built-in ingest paths against replay
+INTERNAL_SOURCE_IDS = (INGEST_V2_SOURCE_ID, INGEST_API_SOURCE_ID)
+
 
 @dataclass
 class RoutingEntry:

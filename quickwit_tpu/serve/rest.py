@@ -40,7 +40,8 @@ from urllib.parse import parse_qs, urlparse
 from ..metastore.base import ListSplitsQuery, MetastoreError
 from ..observability.metrics import METRICS
 from ..indexing.transform import TransformParseError
-from ..ingest.router import INGEST_API_SOURCE_ID, INGEST_V2_SOURCE_ID
+from ..ingest.router import (INGEST_API_SOURCE_ID, INGEST_V2_SOURCE_ID,
+                             INTERNAL_SOURCE_IDS)
 from ..query.aggregations import AggParseError
 from ..query.es_dsl import EsDslParseError, es_query_to_ast
 from ..query.parser import QueryParseError, parse_query_string
@@ -56,8 +57,7 @@ logger = logging.getLogger(__name__)
 
 _MAX_INFLATED_BYTES = 256 << 20  # gzip bodies inflate to at most 256 MiB
 
-# sources whose checkpoints guard the built-in ingest paths against replay
-INTERNAL_SOURCE_IDS = (INGEST_V2_SOURCE_ID, INGEST_API_SOURCE_ID)
+
 
 _REQUEST_COUNTER = METRICS.counter("qw_http_requests_total", "HTTP requests")
 _REQUEST_LATENCY = METRICS.histogram("qw_http_request_duration_seconds",
@@ -423,21 +423,9 @@ class RestServer:
         # --- source management (reference: index_api.rs source routes) --
         m = re.fullmatch(r"/api/v1/indexes/([^/]+)/sources", path)
         if m and method == "POST":
-            from ..models.index_metadata import SourceConfig
+            from ..indexing.sources import parse_source_config
             metadata = node.metastore.index_metadata(m.group(1))
-            spec = json.loads(body)
-            if not isinstance(spec, dict):
-                raise ApiError(400, "source config must be a JSON object")
-            if not isinstance(spec.get("source_id"), str):
-                raise ApiError(400, "source requires a string source_id")
-            source = SourceConfig(
-                source_id=spec["source_id"],
-                source_type=spec.get("source_type", "vec"),
-                params=spec.get("params", {}),
-                enabled=spec.get("enabled", True))
-            # reject bad transform scripts at config time, not ingest time
-            from ..indexing.transform import transform_from_source_params
-            transform_from_source_params(source.params)
+            source = parse_source_config(json.loads(body))
             node.metastore.add_source(metadata.index_uid, source)
             return 200, source.to_dict()
         m = re.fullmatch(r"/api/v1/indexes/([^/]+)/sources/([^/]+)", path)

@@ -135,3 +135,79 @@ def test_cli_error_surface(cli_env, capsys):
     node_yaml, *_ = cli_env
     assert run_cli(node_yaml, "index", "describe", "--index", "missing") == 1
     assert "error:" in capsys.readouterr().err
+
+
+def test_cli_source_and_split_admin(cli_env, capsys):
+    """source create/list/enable/disable/delete + split
+    describe/mark-for-deletion (reference: quickwit-cli source.rs,
+    split.rs subcommands)."""
+    node_yaml, index_yaml, docs_path, tmp_path = cli_env
+    assert run_cli(node_yaml, "index", "create",
+                   "--index-config", index_yaml) == 0
+    capsys.readouterr()
+
+    src_yaml = tmp_path / "source.yaml"
+    src_yaml.write_text(
+        "version: 0.8\n"
+        "source_id: files\n"
+        "source_type: file\n"
+        "params:\n"
+        f"  filepath: {docs_path}\n")
+    assert run_cli(node_yaml, "source", "create", "--index", "cli-logs",
+                   "--source-config", str(src_yaml)) == 0
+    created = json.loads(capsys.readouterr().out)
+    assert created["source_id"] == "files"
+
+    assert run_cli(node_yaml, "source", "list", "--index", "cli-logs") == 0
+    sources = json.loads(capsys.readouterr().out)["sources"]
+    assert any(s["source_id"] == "files" and s["enabled"]
+               for s in sources)
+
+    assert run_cli(node_yaml, "source", "disable", "--index", "cli-logs",
+                   "--source", "files") == 0
+    capsys.readouterr()
+    assert run_cli(node_yaml, "source", "list", "--index", "cli-logs") == 0
+    sources = json.loads(capsys.readouterr().out)["sources"]
+    [files] = [s for s in sources if s["source_id"] == "files"]
+    assert files["enabled"] is False
+    assert run_cli(node_yaml, "source", "enable", "--index", "cli-logs",
+                   "--source", "files") == 0
+    capsys.readouterr()
+
+    # built-in sources cannot be deleted
+    assert run_cli(node_yaml, "source", "delete", "--index", "cli-logs",
+                   "--source", "_ingest-api-source") == 1
+    capsys.readouterr()
+    assert run_cli(node_yaml, "source", "delete", "--index", "cli-logs",
+                   "--source", "files") == 0
+    capsys.readouterr()
+    assert run_cli(node_yaml, "source", "list", "--index", "cli-logs") == 0
+    sources = json.loads(capsys.readouterr().out)["sources"]
+    assert not any(s["source_id"] == "files" for s in sources)
+
+    # split describe + mark-for-deletion
+    assert run_cli(node_yaml, "index", "ingest", "--index", "cli-logs",
+                   "--input-path", docs_path) == 0
+    capsys.readouterr()
+    assert run_cli(node_yaml, "split", "list", "--index", "cli-logs") == 0
+    splits = json.loads(capsys.readouterr().out)["splits"]
+    split_id = splits[0]["metadata"]["split_id"]
+    assert run_cli(node_yaml, "split", "describe", "--index", "cli-logs",
+                   "--split", split_id) == 0
+    described = json.loads(capsys.readouterr().out)
+    assert described["metadata"]["split_id"] == split_id
+    assert run_cli(node_yaml, "split", "describe", "--index", "cli-logs",
+                   "--split", "nope") == 1
+    capsys.readouterr()
+    # unknown ids are an error, not a silent success
+    assert run_cli(node_yaml, "split", "mark-for-deletion",
+                   "--index", "cli-logs", "--splits", "nope") == 1
+    assert "unknown split" in capsys.readouterr().err
+    assert run_cli(node_yaml, "split", "mark-for-deletion",
+                   "--index", "cli-logs", "--splits", f" {split_id} ") == 0
+    capsys.readouterr()
+    assert run_cli(node_yaml, "split", "list", "--index", "cli-logs") == 0
+    splits = json.loads(capsys.readouterr().out)["splits"]
+    [marked] = [s for s in splits
+                if s["metadata"]["split_id"] == split_id]
+    assert marked["state"] == "MarkedForDeletion"
