@@ -234,6 +234,9 @@ def cmd_split_mark_for_deletion(args) -> int:
     node = _embedded_node(args)
     metadata = node.metastore.index_metadata(args.index)
     split_ids = [s.strip() for s in args.splits.split(",") if s.strip()]
+    if not split_ids:
+        print("error: --splits parsed to no split ids", file=sys.stderr)
+        return 1
     from .metastore.base import ListSplitsQuery
     known = {s.metadata.split_id for s in node.metastore.list_splits(
         ListSplitsQuery(index_uids=[metadata.index_uid]))}

@@ -107,6 +107,12 @@ class QuickwitClient:
     def create_index(self, index_config: dict) -> dict:
         return self.request("POST", "/api/v1/indexes", index_config)
 
+    def update_index(self, index_id: str, update: dict) -> dict:
+        """Live config update: search_settings, retention,
+        indexing_settings, append-only doc_mapping additions."""
+        return self.request(
+            "PUT", f"/api/v1/indexes/{quote(index_id)}", update)
+
     def delete_index(self, index_id: str) -> dict:
         return self.request("DELETE", f"/api/v1/indexes/{quote(index_id)}")
 

@@ -47,9 +47,9 @@ def load_source_config(path: str,
         raise ValueError(
             f"source config {path} must be a YAML/JSON object, "
             f"got {type(data).__name__}")
+    # field-level validation lives in parse_source_config (the one
+    # shared REST/CLI site); this loader only owns file -> dict
     data.pop("version", None)
-    if not isinstance(data.get("source_id"), str):
-        raise ValueError("source config requires a string source_id")
     return data
 
 

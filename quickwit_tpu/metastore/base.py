@@ -83,6 +83,13 @@ class Metastore:
         subset: retention only; other settings are immutable here)."""
         raise NotImplementedError
 
+    def update_index_config(self, index_uid: str, index_config) -> None:
+        """Persist a validated replacement IndexConfig (reference
+        `update_index`, `metastore.proto` UpdateIndexRequest). The
+        CALLER (IndexService.update_index) owns compatibility checks —
+        append-only mapping changes, immutable index_id/uri."""
+        raise NotImplementedError
+
     # --- sources -----------------------------------------------------------
     def add_source(self, index_uid: str, source: SourceConfig) -> None:
         raise NotImplementedError

@@ -298,6 +298,12 @@ class FileBackedMetastore(Metastore):
             state.metadata.index_config.retention = retention
             self._save_state(state)
 
+    def update_index_config(self, index_uid: str, index_config) -> None:
+        with self._lock:
+            state = self._state_by_uid(index_uid)
+            state.metadata.index_config = index_config
+            self._save_state(state)
+
     def toggle_source(self, index_uid: str, source_id: str, enable: bool) -> None:
         with self._lock:
             state = self._state_by_uid(index_uid)

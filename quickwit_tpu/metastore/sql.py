@@ -212,6 +212,12 @@ class SqlMetastore(Metastore):
             metadata.index_config.retention = retention
             self._save_metadata(metadata)
 
+    def update_index_config(self, index_uid: str, index_config) -> None:
+        with self._tx(), self._txn():
+            metadata = self._index_row_by_uid(index_uid)
+            metadata.index_config = index_config
+            self._save_metadata(metadata)
+
     def toggle_source(self, index_uid: str, source_id: str,
                       enable: bool) -> None:
         with self._tx(), self._txn():

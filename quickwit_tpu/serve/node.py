@@ -256,6 +256,96 @@ class IndexService:
         self.metastore.create_index(metadata)
         return metadata
 
+    def update_index(self, index_id: str,
+                     update_json: dict[str, Any]) -> IndexMetadata:
+        """Live index-config update (reference `update_index`,
+        `index_api/rest_handler.rs` PUT route): search settings,
+        retention, indexing settings, and APPEND-ONLY doc-mapping
+        changes — existing fields must stay byte-identical (old splits
+        were built with them); new fields only apply to future splits,
+        which is exactly the reference's compatibility rule."""
+        metadata = self.metastore.index_metadata(index_id)
+        current = metadata.index_config
+        for key in ("search_settings", "indexing_settings"):
+            if update_json.get(key) is not None \
+                    and not isinstance(update_json[key], dict):
+                raise ValueError(f"{key} must be a JSON object")
+        # round-trip copy: index_metadata() returns the metastore's LIVE
+        # cached object — mutating it before validation would corrupt
+        # the running config on a rejected request
+        doc_mapper = DocMapper.from_dict(current.doc_mapper.to_dict())
+        if "doc_mapping" in update_json:
+            new_mapper = DocMapper.from_dict(update_json["doc_mapping"])
+            old_fields = {f.name: f.to_dict()
+                          for f in current.doc_mapper.field_mappings}
+            new_fields = {f.name: f.to_dict()
+                          for f in new_mapper.field_mappings}
+            for name, old in old_fields.items():
+                if name not in new_fields:
+                    raise ValueError(
+                        f"doc_mapping update cannot REMOVE field "
+                        f"{name!r} (existing splits were built with it)")
+                if new_fields[name] != old:
+                    raise ValueError(
+                        f"doc_mapping update cannot CHANGE field "
+                        f"{name!r} (existing splits were built with it); "
+                        "only new fields may be appended")
+            if new_mapper.timestamp_field != \
+                    current.doc_mapper.timestamp_field:
+                raise ValueError("timestamp_field is immutable")
+            if not new_mapper.default_search_fields:
+                new_mapper.default_search_fields = \
+                    current.doc_mapper.default_search_fields
+            doc_mapper = new_mapper
+        search_settings = update_json.get("search_settings") or {}
+        if "default_search_fields" in search_settings:
+            fields = search_settings["default_search_fields"]
+            if not isinstance(fields, list) \
+                    or not all(isinstance(f, str) for f in fields):
+                raise ValueError(
+                    "default_search_fields must be a list of strings")
+            doc_mapper.default_search_fields = tuple(fields)
+        _validate_doc_mapping(doc_mapper)
+        indexing = update_json.get("indexing_settings") or {}
+        commit_timeout = indexing.get(
+            "commit_timeout_secs", current.commit_timeout_secs)
+        if not isinstance(commit_timeout, (int, float)) \
+                or commit_timeout <= 0:
+            raise ValueError(
+                f"commit_timeout_secs must be positive, got "
+                f"{commit_timeout!r}")
+        merge_policy = indexing.get("merge_policy", current.merge_policy)
+        if not isinstance(merge_policy, dict):
+            raise ValueError("merge_policy must be a JSON object")
+        # reject now, not on every future merge pass
+        merge_policy_from_config(merge_policy)
+        config = IndexConfig(
+            index_id=current.index_id,          # immutable
+            index_uri=current.index_uri,        # immutable
+            doc_mapper=doc_mapper,
+            commit_timeout_secs=commit_timeout,
+            split_num_docs_target=indexing.get(
+                "split_num_docs_target", current.split_num_docs_target),
+            merge_policy=merge_policy,
+            retention=current.retention,
+        )
+        if "retention" in update_json:
+            retention = update_json["retention"]
+            if retention is None:
+                config.retention = None
+            elif not isinstance(retention, dict) \
+                    or not isinstance(retention.get("period"), str):
+                raise ValueError(
+                    'retention must be null or {"period": "<n> days", '
+                    '"schedule"?: ...}')
+            else:
+                from ..models.index_metadata import RetentionPolicy
+                config.retention = RetentionPolicy(
+                    period_seconds=_parse_period(retention["period"]),
+                    schedule=retention.get("schedule", "hourly"))
+        self.metastore.update_index_config(metadata.index_uid, config)
+        return self.metastore.index_metadata(index_id)
+
     def delete_index(self, index_id: str) -> list[str]:
         metadata = self.metastore.index_metadata(index_id)
         splits = self.metastore.list_splits(

@@ -9,6 +9,7 @@ Role of the reference's warp router + handlers (`quickwit-serve/src/rest.rs`,
   GET  /api/v1/cluster                           (members)
   POST /api/v1/indexes                           (create index from config)
   GET  /api/v1/indexes                           | /api/v1/indexes/{id}
+  PUT  /api/v1/indexes/{id}                      (live config update)
   DELETE /api/v1/indexes/{id}
   GET  /api/v1/indexes/{id}/splits
   POST /api/v1/{index}/ingest?commit=...         (ndjson body)
@@ -372,6 +373,16 @@ class RestServer:
             index_id = m.group(1)
             if method == "GET":
                 return 200, node.metastore.index_metadata(index_id).to_dict()
+            if method == "PUT":
+                # live config update (reference update_index): search
+                # settings, retention, indexing settings, append-only
+                # doc-mapping additions
+                update = json.loads(body)
+                if not isinstance(update, dict):
+                    raise ApiError(400, "update must be a JSON object")
+                metadata = node.index_service.update_index(index_id,
+                                                           update)
+                return 200, metadata.to_dict()
             if method == "DELETE":
                 removed = node.index_service.delete_index(index_id)
                 return 200, {"removed_splits": removed}

@@ -1,0 +1,132 @@
+"""Live index-config updates (reference `update_index`,
+`index_api/rest_handler.rs` PUT route + `metastore.proto`
+UpdateIndexRequest): search settings apply to the NEXT query, doc
+mappings are append-only (existing splits were built with the old
+fields), retention and indexing settings swap in place."""
+
+import pytest
+
+from quickwit_tpu.client import QuickwitClient, QuickwitError
+from quickwit_tpu.serve import Node, NodeConfig, RestServer
+from quickwit_tpu.storage import StorageResolver
+
+
+@pytest.fixture()
+def cluster():
+    node = Node(NodeConfig(node_id="up", rest_port=0,
+                           metastore_uri="ram:///up/ms",
+                           default_index_root_uri="ram:///up/idx"),
+                storage_resolver=StorageResolver.for_test())
+    server = RestServer(node)
+    server.start()
+    client = QuickwitClient(f"127.0.0.1:{server.port}")
+    client.create_index({
+        "index_id": "upd",
+        "doc_mapping": {"field_mappings": [
+            {"name": "ts", "type": "datetime", "fast": True,
+             "input_formats": ["unix_timestamp"]},
+            {"name": "title", "type": "text"},
+            {"name": "body", "type": "text"}],
+            "timestamp_field": "ts"},
+        "search_settings": {"default_search_fields": ["body"]}})
+    client.ingest("upd", [{"ts": 1 + i, "title": f"tword {i}",
+                           "body": f"bword {i}"} for i in range(6)],
+                  commit="force")
+    yield node, client
+    client.close()
+    server.stop()
+
+
+def test_update_default_search_fields_applies_live(cluster):
+    _node, client = cluster
+    # "tword" lives in title, which is NOT a default search field yet
+    assert client.search("upd", query="tword")["num_hits"] == 0
+    out = client.update_index("upd", {
+        "search_settings": {"default_search_fields": ["title", "body"]}})
+    assert out["index_config"]["doc_mapping"][
+        "default_search_fields"] == ["title", "body"]
+    assert client.search("upd", query="tword")["num_hits"] == 6
+    assert client.search("upd", query="bword")["num_hits"] == 6
+
+
+def test_append_only_doc_mapping(cluster):
+    _node, client = cluster
+    base = client.request("GET", "/api/v1/indexes/upd")
+    mapping = base["index_config"]["doc_mapping"]
+    # append a new field: allowed; future docs are searchable on it
+    mapping["field_mappings"].append(
+        {"name": "sev", "type": "text", "tokenizer": "raw",
+         "fast": True})
+    client.update_index("upd", {"doc_mapping": mapping})
+    client.ingest("upd", [{"ts": 100, "title": "x", "body": "x",
+                           "sev": "ERROR"}], commit="force")
+    assert client.search("upd", query="sev:ERROR")["num_hits"] == 1
+
+    # removing an existing field: rejected
+    removed = dict(mapping)
+    removed["field_mappings"] = [f for f in mapping["field_mappings"]
+                                 if f["name"] != "title"]
+    with pytest.raises(QuickwitError) as exc:
+        client.update_index("upd", {"doc_mapping": removed})
+    assert exc.value.status == 400 and "REMOVE" in str(exc.value)
+
+    # changing an existing field's type: rejected
+    changed = dict(mapping)
+    changed["field_mappings"] = [
+        {**f, "type": "u64"} if f["name"] == "title" else f
+        for f in mapping["field_mappings"]]
+    with pytest.raises(QuickwitError) as exc:
+        client.update_index("upd", {"doc_mapping": changed})
+    assert exc.value.status == 400 and "CHANGE" in str(exc.value)
+
+
+def test_update_retention_and_indexing_settings(cluster):
+    node, client = cluster
+    out = client.update_index("upd", {
+        "retention": {"period": "7 days"},
+        "indexing_settings": {"split_num_docs_target": 123,
+                              "commit_timeout_secs": 5}})
+    config = out["index_config"]
+    assert config["retention"]["period_seconds"] == 7 * 86_400
+    assert config["split_num_docs_target"] == 123
+    assert config["commit_timeout_secs"] == 5
+    # clearing retention
+    out = client.update_index("upd", {"retention": None})
+    assert out["index_config"]["retention"] is None
+    # invariants: id/uri immutable, bad commit timeout rejected
+    with pytest.raises(QuickwitError) as exc:
+        client.update_index("upd", {
+            "indexing_settings": {"commit_timeout_secs": 0}})
+    assert exc.value.status == 400
+    metadata = node.metastore.index_metadata("upd")
+    assert metadata.index_config.index_id == "upd"
+
+
+def test_rejected_update_leaves_config_untouched(cluster):
+    """A rejected PUT must not corrupt the metastore's live cached
+    config (the update path works on a copy, never the cached
+    object)."""
+    node, client = cluster
+    with pytest.raises(QuickwitError) as exc:
+        client.update_index("upd", {
+            "search_settings": {"default_search_fields": ["nope"]}})
+    assert exc.value.status == 400
+    # cached config untouched: body is still the default search field
+    assert node.metastore.index_metadata("upd").index_config \
+        .doc_mapper.default_search_fields == ("body",)
+    assert client.search("upd", query="bword")["num_hits"] == 6
+
+
+def test_malformed_update_shapes_are_400(cluster):
+    _node, client = cluster
+    for bad in ({"retention": {}},                    # missing period
+                {"retention": "30 days"},             # not an object
+                {"search_settings": ["x"]},           # not an object
+                {"indexing_settings": {
+                    "merge_policy": {"type": "bogus"}}},
+                {"indexing_settings": {"merge_policy": "bogus"}},
+                {"search_settings": {
+                    "default_search_fields": "body"}}):
+        with pytest.raises(QuickwitError) as exc:
+            client.update_index("upd", bad)
+        assert exc.value.status == 400, bad
