@@ -206,6 +206,22 @@ def cmd_source_delete(args) -> int:
     return 0
 
 
+def cmd_source_reset_checkpoint(args) -> int:
+    from .ingest.router import INTERNAL_SOURCE_IDS
+    if args.source in INTERNAL_SOURCE_IDS:
+        print(f"error: {args.source} is a built-in source; its "
+              "checkpoint guards the ingest WAL against replay",
+              file=sys.stderr)
+        return 1
+    node = _embedded_node(args)
+    metadata = node.metastore.index_metadata(args.index)
+    node.metastore.reset_source_checkpoint(metadata.index_uid,
+                                           args.source)
+    print(f"reset checkpoint of source {args.source} "
+          "(the source replays from the beginning)")
+    return 0
+
+
 def cmd_source_toggle(args) -> int:
     node = _embedded_node(args)
     metadata = node.metastore.index_metadata(args.index)
@@ -344,6 +360,10 @@ def build_parser() -> argparse.ArgumentParser:
         toggle.add_argument("--index", required=True)
         toggle.add_argument("--source", required=True)
         toggle.set_defaults(func=cmd_source_toggle)
+    reset = source_sub.add_parser("reset-checkpoint")
+    reset.add_argument("--index", required=True)
+    reset.add_argument("--source", required=True)
+    reset.set_defaults(func=cmd_source_reset_checkpoint)
 
     split = sub.add_parser("split", help="split management")
     split_sub = split.add_subparsers(dest="subcommand", required=True)

@@ -316,6 +316,9 @@ class FileBackedMetastore(Metastore):
     def reset_source_checkpoint(self, index_uid: str, source_id: str) -> None:
         with self._lock:
             state = self._state_by_uid(index_uid)
+            if source_id not in state.metadata.sources:
+                raise MetastoreError(f"source {source_id!r} not found",
+                                     kind="not_found")
             state.checkpoints[source_id] = SourceCheckpoint()
             self._save_state(state)
 

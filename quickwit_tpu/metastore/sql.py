@@ -231,6 +231,10 @@ class SqlMetastore(Metastore):
 
     def reset_source_checkpoint(self, index_uid: str, source_id: str) -> None:
         with self._tx(), self._txn():
+            metadata = self._index_row_by_uid(index_uid)
+            if source_id not in metadata.sources:
+                raise MetastoreError(f"source {source_id!r} not found",
+                                     kind="not_found")
             self._conn.execute(
                 "INSERT OR REPLACE INTO checkpoints VALUES (?, ?, ?)",
                 (index_uid, source_id,

@@ -450,6 +450,22 @@ class RestServer:
             metadata = node.metastore.index_metadata(m.group(1))
             node.metastore.delete_source(metadata.index_uid, m.group(2))
             return 200, {"deleted": m.group(2)}
+        m = re.fullmatch(
+            r"/api/v1/indexes/([^/]+)/sources/([^/]+)/reset-checkpoint",
+            path)
+        if m and method == "PUT":
+            # reference index_api reset_source_checkpoint: replay the
+            # source from the beginning (exactly-once bookkeeping wiped).
+            # The built-in ingest checkpoints guard the WAL against
+            # replaying already-published records — never resettable.
+            if m.group(2) in INTERNAL_SOURCE_IDS:
+                raise ApiError(400, f"{m.group(2)} is a built-in source; "
+                                    "its checkpoint guards the ingest "
+                                    "WAL against replay")
+            metadata = node.metastore.index_metadata(m.group(1))
+            node.metastore.reset_source_checkpoint(metadata.index_uid,
+                                                   m.group(2))
+            return 200, {"source_id": m.group(2), "checkpoint": "reset"}
         m = re.fullmatch(r"/api/v1/indexes/([^/]+)/sources/([^/]+)/toggle",
                          path)
         if m and method == "PUT":
