@@ -35,9 +35,11 @@ Operators: = != <> < <= > >= ; string/number literals; AND/OR + parens.
 
 Relational tail (the role of the fork's DataFusion operators the device
 path has no analogue for):
-- Subqueries in WHERE: scalar comparisons and [NOT] IN membership;
-  resolved against live results first, so the OUTER query still compiles
-  onto the device scan (membership becomes a term-set mask).
+- Subqueries in WHERE: scalar comparisons, [NOT] IN membership, and
+  [NOT] EXISTS with a single equality correlation (decorrelated onto
+  the IN machinery); resolved against live results first, so the OUTER
+  query still compiles onto the device scan (membership becomes a
+  term-set mask).
 - Window functions: ROW_NUMBER / RANK / COUNT / SUM / AVG / MIN / MAX
   OVER (PARTITION BY ... [ORDER BY ...]); with ORDER BY the frame is the
   SQL default running frame (peers included).
@@ -84,14 +86,14 @@ _KEYWORDS = {"select", "from", "where", "group", "by", "order", "limit",
              "count", "sum", "avg", "min", "max", "stddev", "variance",
              "approx_percentile", "approx_count_distinct", "date_trunc",
              "distinct", "join", "left", "inner", "on", "over",
-             "partition", "row_number", "rank", "in", "not"}
+             "partition", "row_number", "rank", "in", "not", "exists"}
 
 # Keywords new to the relational tail are CONTEXTUAL: where the grammar
 # expects an identifier they still parse as column names, so existing
 # indexes with fields named e.g. `rank` or `partition` keep working
 # (`"quoted"` identifiers are the universal escape hatch).
 _CONTEXTUAL = {"join", "left", "inner", "on", "over", "partition",
-               "row_number", "rank", "in", "not"}
+               "row_number", "rank", "in", "not", "exists"}
 
 # Materialization cap for the host-side relational layer (JOIN sides and
 # window-function inputs). Joins/windows run over rows fetched through
@@ -169,11 +171,22 @@ class JoinClause:
 @dataclass(frozen=True)
 class SubqueryPred:
     """A WHERE leaf whose right-hand side is a subquery; resolved
-    against live results (scalar comparison or IN/NOT IN membership)
-    before the predicate is compiled onto the device path."""
+    against live results (scalar comparison or IN/NOT IN membership,
+    or [NOT] EXISTS decorrelation) before the predicate is compiled
+    onto the device path. `column` is empty for EXISTS."""
     column: str
-    op: str                           # = != <> < <= > >= in not_in
+    op: str              # = != <> < <= > >= in not_in exists not_exists
     query: "SqlQuery"
+
+
+@dataclass(frozen=True)
+class ColumnEq:
+    """`a.k = b.k` — a column-to-column equality leaf. Only meaningful
+    as the correlation predicate inside an EXISTS subquery (the device
+    scan has no cross-doc comparisons); anywhere else it resolves to a
+    clear SqlError."""
+    left: str
+    right: str
 
 
 @dataclass
@@ -315,6 +328,8 @@ class _Parser:
 
     def having_clause(self) -> tuple[str, str, float]:
         item = self.select_item()
+        if item.kind == "star":
+            raise SqlError("HAVING takes an aggregate or alias")
         op = self.expect("op")[1]
         if op not in ("=", "!=", "<>", "<", "<=", ">", ">="):
             raise SqlError(f"unsupported HAVING operator {op!r}")
@@ -359,6 +374,10 @@ class _Parser:
 
     def select_item(self) -> SelectItem:
         token = self.next()
+        if token == ("op", "*") or token[0] == "number":
+            # `SELECT 1` / `SELECT *`: only meaningful inside EXISTS
+            # subqueries (the row content is irrelevant there)
+            return SelectItem("star", alias=self._alias())
         if token[0] == "kw" and token[1] in ("row_number", "rank") \
                 and self.peek() == ("op", "("):
             self.next()  # (
@@ -443,6 +462,9 @@ class _Parser:
     def order_target(self) -> str:
         # an alias, a bare column, count(*) or fn(col)
         item = self.select_item()
+        if item.kind == "star":
+            raise SqlError("ORDER BY position numbers are not "
+                           "supported; use the column name or alias")
         return item.name
 
     # --- WHERE ---------------------------------------------------------
@@ -463,11 +485,30 @@ class _Parser:
             left = Q.Bool(must=(left, right))
         return left
 
+    def _exists_subquery(self, negate: bool) -> Q.QueryAst:
+        self.expect("op", "(")
+        sub = self.parse_select()
+        self.expect("op", ")")
+        return SubqueryPred("", "not_exists" if negate else "exists", sub)
+
     def pred_factor(self) -> Q.QueryAst:
         if self.accept("op", "("):
             inner = self.predicate()
             self.expect("op", ")")
             return inner
+        # [NOT] EXISTS (SELECT ...) — contextual: `exists`/`not` still
+        # parse as column names unless the subquery shape follows
+        if self.peek() == ("kw", "exists") \
+                and self.tokens[self.pos + 1: self.pos + 2] \
+                == [("op", "(")]:
+            self.next()
+            return self._exists_subquery(negate=False)
+        if self.peek() == ("kw", "not") \
+                and self.tokens[self.pos + 1: self.pos + 2] \
+                == [("kw", "exists")]:
+            self.next()
+            self.next()
+            return self._exists_subquery(negate=True)
         column = self._ident()
         if self.accept("kw", "not"):
             self.expect("kw", "in")
@@ -483,6 +524,12 @@ class _Parser:
             sub = self.parse_select()
             self.expect("op", ")")
             return SubqueryPred(column, op, sub)
+        if op == "=" and self.peek() is not None \
+                and (self.peek()[0] == "ident"
+                     or (self.peek()[0] == "kw"
+                         and self.peek()[1] in _CONTEXTUAL)):
+            # column = column: EXISTS correlation predicate
+            return ColumnEq(column, self._ident())
         kind, literal = self.next()
         if kind not in ("number", "string"):
             raise SqlError(f"expected literal after {op}, got {literal!r}")
@@ -555,10 +602,14 @@ def execute_sql(text: str, search) -> dict[str, Any]:
 
 
 def _execute(q: SqlQuery, search) -> dict[str, Any]:
+    if any(s.kind == "star" for s in q.select):
+        raise SqlError(
+            "SELECT * / SELECT 1 is only supported inside EXISTS "
+            "subqueries; name the columns")
     if q.joins:
         return _run_join(q, search)
-    ast = _resolve_subqueries(q.where, search) if q.where is not None \
-        else Q.MatchAll()
+    ast = _resolve_subqueries(q.where, search, q.alias) \
+        if q.where is not None else Q.MatchAll()
     aggregates = [s for s in q.select
                   if s.kind in ("agg", "count_star")]
     windows = [s for s in q.select if s.kind == "window"]
@@ -592,26 +643,35 @@ def _execute(q: SqlQuery, search) -> dict[str, Any]:
 # subqueries: resolved against live results, then compiled to plain
 # predicates so the outer query still rides the device path untouched
 
-def _resolve_subqueries(node, search):
+def _resolve_subqueries(node, search, outer_alias=None):
     if isinstance(node, SubqueryPred):
-        return _resolve_one_subquery(node, search)
+        return _resolve_one_subquery(node, search, outer_alias)
+    if isinstance(node, ColumnEq):
+        raise SqlError(
+            f"column-to-column comparison {node.left} = {node.right} is "
+            "only supported in JOIN ON clauses and as the correlation "
+            "predicate of an EXISTS subquery")
     if isinstance(node, Q.Bool):
         return Q.Bool(
-            must=tuple(_resolve_subqueries(c, search) for c in node.must),
-            must_not=tuple(_resolve_subqueries(c, search)
+            must=tuple(_resolve_subqueries(c, search, outer_alias)
+                       for c in node.must),
+            must_not=tuple(_resolve_subqueries(c, search, outer_alias)
                            for c in node.must_not),
-            should=tuple(_resolve_subqueries(c, search)
+            should=tuple(_resolve_subqueries(c, search, outer_alias)
                          for c in node.should),
-            filter=tuple(_resolve_subqueries(c, search)
+            filter=tuple(_resolve_subqueries(c, search, outer_alias)
                          for c in node.filter),
             minimum_should_match=node.minimum_should_match)
     return node
 
 
-def _resolve_one_subquery(pred: SubqueryPred, search) -> Q.QueryAst:
+def _resolve_one_subquery(pred: SubqueryPred, search,
+                          outer_alias=None) -> Q.QueryAst:
     sub = pred.query
     if sub.joins:
         raise SqlError("subqueries cannot contain JOINs")
+    if pred.op in ("exists", "not_exists"):
+        return _decorrelate_exists(pred, search, outer_alias)
     if pred.op in ("in", "not_in"):
         if len(sub.select) != 1:
             raise SqlError("IN subquery must select exactly one column")
@@ -663,6 +723,85 @@ def _resolve_one_subquery(pred: SubqueryPred, search) -> Q.QueryAst:
     if pred.op in (">", ">="):
         return Q.Range(pred.column, lower=bound)
     return Q.Range(pred.column, upper=bound)
+
+
+def _decorrelate_exists(pred: SubqueryPred, search,
+                        outer_alias) -> Q.QueryAst:
+    """[NOT] EXISTS with an equality correlation decorrelates onto the
+    IN machinery: `EXISTS (SELECT 1 FROM b x WHERE x.k = k AND <preds>)`
+    becomes `k [NOT] IN (SELECT x.k FROM b WHERE <preds>)`, so the
+    outer query STILL compiles onto the device scan (the fork's
+    DataFusion plans the same rewrite). NULL semantics follow EXISTS:
+    a missing outer key never matches (and NOT EXISTS keeps it)."""
+    sub = pred.query
+    negate = pred.op == "not_exists"
+    if sub.group_by or sub.having or sub.order_by \
+            or sub.limit is not None or sub.offset:
+        raise SqlError(
+            "EXISTS subqueries support only FROM and WHERE "
+            "(GROUP BY/HAVING/ORDER BY/LIMIT would be silently "
+            "meaningless after decorrelation)")
+    inner_prefix = (sub.alias + ".") if sub.alias else None
+    outer_prefix = (outer_alias + ".") if outer_alias else None
+
+    def strip_outer(name: str) -> str:
+        if outer_prefix and name.startswith(outer_prefix):
+            return name[len(outer_prefix):]
+        return name
+
+    correlations: list[tuple[str, str]] = []   # (outer col, inner col)
+    inner_preds: list[Q.QueryAst] = []
+    for conj in _conjuncts(sub.where) if sub.where is not None else []:
+        if isinstance(conj, ColumnEq):
+            if inner_prefix is None:
+                raise SqlError(
+                    "correlated EXISTS requires an alias on the inner "
+                    "table (EXISTS (SELECT 1 FROM other x "
+                    "WHERE x.k = k))")
+            sides = (conj.left, conj.right)
+            inner_side = [s for s in sides
+                          if s.startswith(inner_prefix)]
+            outer_side = [s for s in sides
+                          if not s.startswith(inner_prefix)]
+            if len(inner_side) != 1:
+                raise SqlError(
+                    f"EXISTS correlation {conj.left} = {conj.right} "
+                    f"must compare one {sub.alias!r}-column with one "
+                    "outer column")
+            correlations.append((strip_outer(outer_side[0]),
+                                 inner_side[0][len(inner_prefix):]))
+            continue
+        fields = _pred_fields(conj)
+        if inner_prefix is not None and any(
+                not f.startswith(inner_prefix) for f in fields
+                if "." in f
+                and f.split(".", 1)[0] == (outer_alias or "")):
+            raise SqlError(
+                "outer-column predicates inside EXISTS must be the "
+                "equality correlation (col = col)")
+        inner_preds.append(_strip_alias(conj, sub.alias)
+                           if sub.alias else conj)
+    if len(correlations) > 1:
+        raise SqlError(
+            "EXISTS supports exactly one equality correlation")
+    inner_where = Q.Bool(must=tuple(inner_preds)) if inner_preds \
+        else None
+    if not correlations:
+        # uncorrelated EXISTS: constant-folds on whether ANY row matches
+        probe = SqlQuery(index=sub.index,
+                         select=[SelectItem("count_star")],
+                         where=inner_where, alias=sub.alias)
+        [[count]] = _execute(probe, search)["rows"]
+        non_empty = bool(count)
+        return Q.MatchAll() if non_empty != negate else Q.MatchNone()
+    outer_col, inner_col = correlations[0]
+    membership = SqlQuery(
+        index=sub.index,
+        select=[SelectItem("col", column=inner_col)],
+        where=inner_where, alias=sub.alias)
+    return _resolve_one_subquery(
+        SubqueryPred(outer_col, "not_in" if negate else "in",
+                     membership), search)
 
 
 def _sql_str(value) -> str:
@@ -1070,7 +1209,13 @@ def _conjuncts(node) -> list:
 
 
 def _pred_fields(node) -> set[str]:
+    if isinstance(node, ColumnEq):
+        return {node.left, node.right}
     if isinstance(node, SubqueryPred):
+        if node.op in ("exists", "not_exists"):
+            raise SqlError(
+                "[NOT] EXISTS is not supported in this position "
+                "(JOIN WHERE clauses or nested inside another EXISTS)")
         return {node.column}
     if isinstance(node, Q.Term):
         return {node.field}
@@ -1101,6 +1246,8 @@ def _strip_alias(node, alias: str):
 
     if isinstance(node, SubqueryPred):
         return SubqueryPred(strip(node.column), node.op, node.query)
+    if isinstance(node, ColumnEq):
+        return ColumnEq(strip(node.left), strip(node.right))
     if isinstance(node, (Q.Term, Q.Range)):
         return replace(node, field=strip(node.field))
     if isinstance(node, Q.TermSet):

@@ -507,3 +507,82 @@ def test_ui_console_js_strings_have_no_raw_newlines():
         i += 1
     assert not bad, f"raw newline inside JS string at script line(s) {bad}"
     assert in_str is None, "unterminated JS string literal"
+
+
+def test_correlated_exists(rel_api):
+    """[NOT] EXISTS with equality correlation decorrelates onto the IN
+    machinery — outer query stays on the device scan."""
+    status, out = rel_api(
+        "SELECT COUNT(*) AS n FROM orders WHERE EXISTS "
+        "(SELECT 1 FROM users u WHERE u.name = user "
+        "AND u.tier = 'gold')")
+    assert (status, out["rows"]) == (200, [[6]])
+    status, out = rel_api(
+        "SELECT COUNT(*) AS n FROM orders WHERE NOT EXISTS "
+        "(SELECT 1 FROM users u WHERE u.name = user "
+        "AND u.tier = 'gold')")
+    assert (status, out["rows"]) == (200, [[3]])
+    # outer alias + SELECT * form
+    status, out = rel_api(
+        "SELECT COUNT(*) AS n FROM orders o WHERE EXISTS "
+        "(SELECT * FROM users u WHERE u.name = o.user "
+        "AND u.tier = 'silver')")
+    assert (status, out["rows"]) == (200, [[3]])
+
+
+def test_uncorrelated_exists_constant_folds(rel_api):
+    status, out = rel_api(
+        "SELECT COUNT(*) AS n FROM orders WHERE EXISTS "
+        "(SELECT 1 FROM users u WHERE u.tier = 'bronze')")
+    assert (status, out["rows"]) == (200, [[0]])
+    status, out = rel_api(
+        "SELECT COUNT(*) AS n FROM orders WHERE NOT EXISTS "
+        "(SELECT 1 FROM users u WHERE u.tier = 'bronze')")
+    assert (status, out["rows"]) == (200, [[9]])
+
+
+def test_exists_error_surfaces(rel_api):
+    # inner alias required for correlation
+    status, _ = rel_api(
+        "SELECT COUNT(*) FROM orders WHERE EXISTS "
+        "(SELECT 1 FROM users WHERE name = user)")
+    assert status == 400
+    # col = col outside EXISTS/ON is rejected with a clear error
+    status, _ = rel_api("SELECT COUNT(*) FROM orders WHERE user = amount")
+    assert status == 400
+    # bare SELECT * outside EXISTS is rejected
+    status, _ = rel_api("SELECT * FROM orders")
+    assert status == 400
+
+
+def test_exists_as_column_name_still_parses():
+    q = parse_sql("SELECT COUNT(*) FROM idx WHERE exists > 3")
+    assert q.where is not None  # parsed as a range on column `exists`
+
+
+def test_exists_review_regressions(rel_api):
+    # uncorrelated EXISTS needs no inner alias
+    status, out = rel_api(
+        "SELECT COUNT(*) AS n FROM orders WHERE EXISTS "
+        "(SELECT 1 FROM users WHERE tier = 'gold')")
+    assert (status, out["rows"]) == (200, [[9]])
+    # unsupported sub-clauses are rejected, not silently dropped
+    for bad in ("SELECT COUNT(*) FROM orders WHERE EXISTS "
+                "(SELECT 1 FROM users u WHERE u.name = user "
+                "GROUP BY u.tier)",
+                "SELECT COUNT(*) FROM orders WHERE EXISTS "
+                "(SELECT 1 FROM users u LIMIT 0)"):
+        status, _ = rel_api(bad)
+        assert status == 400, bad
+    # EXISTS inside JOIN WHERE gets a clear unsupported error
+    status, out = rel_api(
+        "SELECT COUNT(*) FROM orders o JOIN users u ON o.user = u.name "
+        "WHERE EXISTS (SELECT 1 FROM users x WHERE x.tier = 'gold')")
+    assert status == 400 and "EXISTS" in out["message"]
+    # SELECT * in a JOIN errors clearly BEFORE materializing sides
+    status, out = rel_api(
+        "SELECT * FROM orders o JOIN users u ON o.user = u.name")
+    assert status == 400 and "EXISTS" in out["message"]
+    # ORDER BY position numbers rejected at parse time
+    status, out = rel_api("SELECT COUNT(*) AS n FROM orders ORDER BY 2")
+    assert status == 400 and "position" in out["message"]
