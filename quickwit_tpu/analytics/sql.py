@@ -725,6 +725,17 @@ def _resolve_one_subquery(pred: SubqueryPred, search,
     return Q.Range(pred.column, upper=bound)
 
 
+def _contains_column_eq(node) -> bool:
+    if isinstance(node, ColumnEq):
+        return True
+    if isinstance(node, Q.Bool):
+        return any(_contains_column_eq(c)
+                   for group in (node.must, node.must_not,
+                                 node.should, node.filter)
+                   for c in group)
+    return False
+
+
 def _decorrelate_exists(pred: SubqueryPred, search,
                         outer_alias) -> Q.QueryAst:
     """[NOT] EXISTS with an equality correlation decorrelates onto the
@@ -741,6 +752,11 @@ def _decorrelate_exists(pred: SubqueryPred, search,
             "EXISTS subqueries support only FROM and WHERE "
             "(GROUP BY/HAVING/ORDER BY/LIMIT would be silently "
             "meaningless after decorrelation)")
+    if any(s.kind in ("agg", "count_star") for s in sub.select):
+        # SQL: an ungrouped aggregate subquery yields EXACTLY one row
+        # (COUNT over zero rows is still the row [0]), so EXISTS over
+        # it is constant-true — fold, matching Postgres/DataFusion
+        return Q.MatchNone() if negate else Q.MatchAll()
     inner_prefix = (sub.alias + ".") if sub.alias else None
     outer_prefix = (outer_alias + ".") if outer_alias else None
 
@@ -771,6 +787,11 @@ def _decorrelate_exists(pred: SubqueryPred, search,
             correlations.append((strip_outer(outer_side[0]),
                                  inner_side[0][len(inner_prefix):]))
             continue
+        if _contains_column_eq(conj):
+            raise SqlError(
+                "the EXISTS correlation (col = col) must be a "
+                "top-level AND conjunct of the subquery's WHERE — "
+                "not nested under OR/NOT")
         fields = _pred_fields(conj)
         if inner_prefix is not None and any(
                 not f.startswith(inner_prefix) for f in fields

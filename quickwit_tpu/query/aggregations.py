@@ -134,9 +134,18 @@ _METRIC_KINDS = ("avg", "min", "max", "sum", "stats", "extended_stats",
 def _parse_metric(name: str, kind: str, body: dict[str, Any]) -> MetricAgg:
     if "field" not in body:
         raise AggParseError(f"aggregation {name!r}: metric {kind} requires a field")
-    percents = tuple(body.get("percents", DEFAULT_PERCENTS))
+    if not isinstance(body.get("field"), str):
+        raise AggParseError(
+            f"aggregation {name!r}: field must be a string")
+    raw_percents = body.get("percents", DEFAULT_PERCENTS)
+    if not isinstance(raw_percents, (list, tuple)) or not all(
+            isinstance(p, (int, float)) and not isinstance(p, bool)
+            for p in raw_percents):
+        raise AggParseError(
+            f"aggregation {name!r}: percents must be a list of numbers")
     return MetricAgg(name=name, kind=kind, field=body["field"],
-                     percents=percents, keyed=body.get("keyed", True))
+                     percents=tuple(float(p) for p in raw_percents),
+                     keyed=body.get("keyed", True))
 
 
 _BUCKET_KINDS = ("date_histogram", "histogram", "terms", "range")

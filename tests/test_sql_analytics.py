@@ -586,3 +586,24 @@ def test_exists_review_regressions(rel_api):
     # ORDER BY position numbers rejected at parse time
     status, out = rel_api("SELECT COUNT(*) AS n FROM orders ORDER BY 2")
     assert status == 400 and "position" in out["message"]
+
+
+def test_exists_aggregate_subquery_is_constant_true(rel_api):
+    # SQL: an ungrouped aggregate subquery yields exactly one row, so
+    # EXISTS over it is always true (matches Postgres/DataFusion)
+    status, out = rel_api(
+        "SELECT COUNT(*) AS n FROM orders WHERE EXISTS "
+        "(SELECT COUNT(*) FROM users u WHERE u.tier = 'bronze')")
+    assert (status, out["rows"]) == (200, [[9]])
+    status, out = rel_api(
+        "SELECT COUNT(*) AS n FROM orders WHERE NOT EXISTS "
+        "(SELECT COUNT(*) FROM users u WHERE u.tier = 'bronze')")
+    assert (status, out["rows"]) == (200, [[0]])
+
+
+def test_exists_correlation_under_or_is_clear_error(rel_api):
+    status, out = rel_api(
+        "SELECT COUNT(*) FROM orders WHERE EXISTS "
+        "(SELECT 1 FROM users u WHERE u.name = user "
+        "OR u.tier = 'gold')")
+    assert status == 400 and "top-level AND conjunct" in out["message"]
