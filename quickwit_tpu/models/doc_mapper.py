@@ -464,6 +464,20 @@ class DocMapper:
 
     @staticmethod
     def from_dict(d: dict[str, Any]) -> "DocMapper":
+        if not isinstance(d, dict):
+            raise ValueError(
+                f"doc_mapping must be a JSON object, "
+                f"got {type(d).__name__}")
+        if not isinstance(d.get("field_mappings", []), list):
+            raise ValueError("field_mappings must be a list")
+        for key in ("tag_fields", "default_search_fields"):
+            value = d.get(key, [])
+            if not isinstance(value, (list, tuple)) or not all(
+                    isinstance(f, str) for f in value):
+                raise ValueError(f"{key} must be a list of strings")
+        if d.get("dynamic_mapping") is not None \
+                and not isinstance(d["dynamic_mapping"], dict):
+            raise ValueError("dynamic_mapping must be a JSON object")
         return DocMapper(
             doc_mapping_uid=d.get("doc_mapping_uid", "default"),
             field_mappings=_expand_field_mappings(d.get("field_mappings", [])),
@@ -488,6 +502,13 @@ def _expand_field_mappings(entries: Sequence[dict],
     aliases (every field is multivalued in this engine, so array<T> ≡ T)."""
     out: list[FieldMapping] = []
     for d in entries:
+        if not isinstance(d, dict):
+            raise ValueError(
+                f"field mapping entry must be an object, got {d!r}")
+        if not isinstance(d.get("name"), str) or not d["name"]:
+            raise ValueError(
+                f"field mapping entry requires a string name "
+                f"(got {d.get('name')!r})")
         typ = str(d.get("type", "text"))
         if typ.startswith("array<") and typ.endswith(">"):
             d = {**d, "type": typ[len("array<"):-1]}

@@ -132,6 +132,9 @@ _METRIC_KINDS = ("avg", "min", "max", "sum", "stats", "extended_stats",
 
 
 def _parse_metric(name: str, kind: str, body: dict[str, Any]) -> MetricAgg:
+    if not isinstance(body, dict):
+        raise AggParseError(
+            f"aggregation {name!r}: {kind} body must be an object")
     if "field" not in body:
         raise AggParseError(f"aggregation {name!r}: metric {kind} requires a field")
     if not isinstance(body.get("field"), str):
@@ -185,6 +188,11 @@ def _agg_kind(body: dict[str, Any]) -> str:
 def _parse_one(name: str, body: dict[str, Any], depth: int = 0) -> AggSpec:
     kind = _agg_kind(body)
     params = body[kind]
+    if kind not in _METRIC_KINDS and not isinstance(params, dict):
+        # metric bodies are validated in _parse_metric; bucket bodies
+        # must be objects too (ES rejects {"terms": 7} the same way)
+        raise AggParseError(
+            f"aggregation {name!r}: {kind} body must be an object")
     sub = body.get("aggs") or body.get("aggregations") or {}
     sub_metrics, sub_buckets = _parse_sub_aggs(name, sub, depth)
     if kind == "date_histogram":

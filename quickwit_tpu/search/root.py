@@ -168,6 +168,12 @@ class RootSearcher:
         indexes = self._resolve_indexes(request.index_ids)
         if not indexes:
             raise ValueError(f"no index matches {request.index_ids!r}")
+        if request.aggs:
+            # validate the agg request up front: an EMPTY index must
+            # reject a malformed aggregation exactly like a populated
+            # one (zero splits would otherwise skip the leaf parse)
+            from ..query.aggregations import parse_aggs
+            parse_aggs(request.aggs)
 
         # the merge key type must be consistent across every matched index:
         # a sort field that is text in one index and numeric in another has

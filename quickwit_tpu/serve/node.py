@@ -209,17 +209,28 @@ class IndexService:
         self.default_index_root_uri = default_index_root_uri
 
     def create_index(self, index_config_json: dict[str, Any]) -> IndexMetadata:
-        index_id = index_config_json["index_id"]
-        if not index_id or not index_id.replace("-", "").replace("_", "").isalnum():
+        if not isinstance(index_config_json, dict):
+            raise ValueError("index config must be a JSON object")
+        index_id = index_config_json.get("index_id")
+        if not isinstance(index_id, str) or not index_id \
+                or not index_id.replace("-", "").replace("_", "").isalnum():
             raise ValueError(f"invalid index id {index_id!r}")
+        for key in ("search_settings", "indexing_settings", "retention"):
+            value = index_config_json.get(key)
+            if value is not None and not isinstance(value, dict):
+                raise ValueError(f"{key} must be a JSON object")
         doc_mapping = index_config_json.get("doc_mapping", {})
         doc_mapper = DocMapper.from_dict(doc_mapping)
         # search_settings.default_search_fields (reference config shape)
         # overrides/augments the doc_mapping-level list
         search_settings = index_config_json.get("search_settings") or {}
-        if search_settings.get("default_search_fields"):
-            doc_mapper.default_search_fields = tuple(
-                search_settings["default_search_fields"])
+        fields = search_settings.get("default_search_fields")
+        if fields:
+            if not isinstance(fields, list) \
+                    or not all(isinstance(f, str) for f in fields):
+                raise ValueError(
+                    "default_search_fields must be a list of strings")
+            doc_mapper.default_search_fields = tuple(fields)
         _validate_doc_mapping(doc_mapper)
         index_uri = index_config_json.get(
             "index_uri", f"{self.default_index_root_uri}/{index_id}")
@@ -241,6 +252,9 @@ class IndexService:
         )
         retention = index_config_json.get("retention")
         if retention:
+            if not isinstance(retention.get("period"), str):
+                raise ValueError(
+                    'retention requires {"period": "<n> days", ...}')
             from ..models.index_metadata import RetentionPolicy
             config.retention = RetentionPolicy(
                 period_seconds=_parse_period(retention["period"]),

@@ -58,6 +58,7 @@ def api():
         conn.close()
         return response.status, data
 
+    call.port = server.port
     yield call
     server.stop()
 
@@ -176,3 +177,111 @@ def test_fuzz_es_dsl(api):
         assert status in (200, 400), \
             f"case {i}: body={json.dumps(body)[:200]} -> " \
             f"{status}: {data[:300]!r}"
+
+
+def test_fuzz_ingest_bodies(api):
+    """Malformed ndjson ingest bodies: every line is either indexed or
+    counted invalid; the request itself never 500s."""
+    rng = random.Random(SEED + 3)
+    for i in range(60):
+        lines = []
+        for _ in range(rng.randrange(1, 5)):
+            roll = rng.random()
+            if roll < 0.3:
+                lines.append(json.dumps(
+                    {"ts": rng.randrange(0, 2_000), "sev": "a",
+                     "num": rng.random() * 10, "body": "ok"}))
+            elif roll < 0.5:   # valid JSON, wrong shapes
+                lines.append(json.dumps(rng.choice(
+                    [[1, 2], "str", 42, {"ts": "not-a-time"},
+                     {"num": {"nested": True}}, {}])))
+            else:              # not JSON at all
+                lines.append("".join(
+                    rng.choice(string.printable[:94])
+                    for _ in range(rng.randrange(1, 30))))
+        body = "\n".join(lines).encode()
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", api.port, timeout=30)
+        conn.request("POST", "/api/v1/fuzz/ingest?commit=auto", body)
+        response = conn.getresponse()
+        data = response.read()
+        conn.close()
+        assert response.status in (200, 400), \
+            f"case {i}: body={body[:200]!r} -> " \
+            f"{response.status}: {data[:300]!r}"
+
+
+def test_fuzz_index_configs(api):
+    """Junk index-config payloads: typed 400s, never 500s, and no
+    half-created indexes left behind."""
+    rng = random.Random(SEED + 4)
+    for i in range(60):
+        roll = rng.random()
+        if roll < 0.3:
+            payload = rng.choice(
+                [[], "str", 42, {}, {"index_id": 7},
+                 {"index_id": "x!/bad"},
+                 {"index_id": "ok-but", "doc_mapping": "nope"},
+                 {"index_id": "ok2", "indexing_settings": "fast"},
+                 {"index_id": "ok3", "search_settings": "x"},
+                 {"index_id": "ok4", "retention": {"schedule": "hourly"}},
+                 {"index_id": "ok5", "doc_mapping": {"tag_fields": 5}},
+                 {"index_id": "ok6",
+                  "doc_mapping": {"dynamic_mapping": "x"}},
+                 {"index_id": "ok7", "search_settings":
+                  {"default_search_fields": "body"}}])
+        else:
+            payload = {
+                "index_id": f"fz-{i}" if rng.random() < 0.5 else "fuzz",
+                "doc_mapping": {"field_mappings": [
+                    rng.choice([
+                        {"name": "a", "type": "text"},
+                        {"name": "a", "type": "bogus"},
+                        {"name": 5, "type": "text"},
+                        {"type": "text"},
+                        "junk",
+                    ])],
+                    "timestamp_field": rng.choice([None, "a", "missing"]),
+                }}
+        status, data = api("POST", "/api/v1/indexes", payload)
+        assert status in (200, 400), \
+            f"case {i}: payload={json.dumps(payload)[:200]} -> " \
+            f"{status}: {data[:300]!r}"
+        if status == 200:  # clean up successes so reruns stay stable
+            index_id = payload["index_id"]
+            if index_id != "fuzz":
+                api("DELETE", f"/api/v1/indexes/{index_id}")
+
+
+def test_fuzz_agg_body_shapes(api):
+    """Non-dict metric bodies and junk agg shapes: typed 400s."""
+    for aggs in ({"g": {"avg": 42}}, {"g": {"avg": "subfield"}},
+                 {"g": {"percentiles": {"field": "num",
+                                        "percents": "x"}}},
+                 {"g": {"terms": 7}}, {"g": []}):
+        status, data = api("POST", "/api/v1/_elastic/fuzz/_search",
+                           {"query": {"match_all": {}}, "size": 0,
+                            "aggs": aggs})
+        assert status == 400, (aggs, status, data[:200])
+
+
+def test_malformed_aggs_rejected_on_empty_index(api):
+    """An EMPTY index must reject malformed aggs exactly like a
+    populated one — aggs validate up front at the root, not lazily in
+    the leaf the empty index never reaches."""
+    status, _ = api("POST", "/api/v1/indexes",
+                    {"index_id": "empty-agg", "doc_mapping":
+                     {"field_mappings": [{"name": "b", "type": "text"}]}})
+    assert status == 200
+    for aggs in ({"g": {"avg": 42}}, {"g": {"terms": 7}}):
+        status, data = api(
+            "POST", "/api/v1/_elastic/empty-agg/_search",
+            {"query": {"match_all": {}}, "aggs": aggs})
+        assert status == 400, (aggs, status, data[:200])
+    # a valid agg on the empty index yields empty shapes
+    status, data = api(
+        "POST", "/api/v1/_elastic/empty-agg/_search",
+        {"query": {"match_all": {}}, "size": 0,
+         "aggs": {"g": {"terms": {"field": "b"}}}})
+    assert status == 200
+    api("DELETE", "/api/v1/indexes/empty-agg")
