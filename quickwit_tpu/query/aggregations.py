@@ -186,6 +186,9 @@ def _agg_kind(body: dict[str, Any]) -> str:
 
 
 def _parse_one(name: str, body: dict[str, Any], depth: int = 0) -> AggSpec:
+    if not isinstance(body, dict):
+        raise AggParseError(
+            f"aggregation {name!r} must be an object")
     kind = _agg_kind(body)
     params = body[kind]
     if kind not in _METRIC_KINDS and not isinstance(params, dict):
@@ -194,6 +197,9 @@ def _parse_one(name: str, body: dict[str, Any], depth: int = 0) -> AggSpec:
         raise AggParseError(
             f"aggregation {name!r}: {kind} body must be an object")
     sub = body.get("aggs") or body.get("aggregations") or {}
+    if not isinstance(sub, dict):
+        raise AggParseError(
+            f"aggregation {name!r}: nested aggs must be an object")
     sub_metrics, sub_buckets = _parse_sub_aggs(name, sub, depth)
     if kind == "date_histogram":
         interval = params.get("fixed_interval") or params.get("interval")
@@ -404,4 +410,6 @@ def _parse_composite(name: str, params: dict[str, Any],
 
 def parse_aggs(aggs: dict[str, Any]) -> list[AggSpec]:
     """ES `aggs` dict → typed specs."""
+    if not isinstance(aggs, dict):
+        raise AggParseError("aggs must be an object")
     return [_parse_one(name, body) for name, body in aggs.items()]

@@ -199,6 +199,13 @@ def _validate_doc_mapping(doc_mapper: DocMapper) -> None:
                 f"default search field `{field}` is not indexed")
 
 
+def _require_string_list(name: str, value) -> tuple:
+    if not isinstance(value, list) \
+            or not all(isinstance(v, str) for v in value):
+        raise ValueError(f"{name} must be a list of strings")
+    return tuple(value)
+
+
 class IndexService:
     """Index management operations (role of `quickwit-index-management`)."""
 
@@ -226,11 +233,8 @@ class IndexService:
         search_settings = index_config_json.get("search_settings") or {}
         fields = search_settings.get("default_search_fields")
         if fields:
-            if not isinstance(fields, list) \
-                    or not all(isinstance(f, str) for f in fields):
-                raise ValueError(
-                    "default_search_fields must be a list of strings")
-            doc_mapper.default_search_fields = tuple(fields)
+            doc_mapper.default_search_fields = _require_string_list(
+                "default_search_fields", fields)
         _validate_doc_mapping(doc_mapper)
         index_uri = index_config_json.get(
             "index_uri", f"{self.default_index_root_uri}/{index_id}")
@@ -313,12 +317,9 @@ class IndexService:
             doc_mapper = new_mapper
         search_settings = update_json.get("search_settings") or {}
         if "default_search_fields" in search_settings:
-            fields = search_settings["default_search_fields"]
-            if not isinstance(fields, list) \
-                    or not all(isinstance(f, str) for f in fields):
-                raise ValueError(
-                    "default_search_fields must be a list of strings")
-            doc_mapper.default_search_fields = tuple(fields)
+            doc_mapper.default_search_fields = _require_string_list(
+                "default_search_fields",
+                search_settings["default_search_fields"])
         _validate_doc_mapping(doc_mapper)
         indexing = update_json.get("indexing_settings") or {}
         commit_timeout = indexing.get(

@@ -285,3 +285,17 @@ def test_malformed_aggs_rejected_on_empty_index(api):
          "aggs": {"g": {"terms": {"field": "b"}}}})
     assert status == 200
     api("DELETE", "/api/v1/indexes/empty-agg")
+
+
+def test_agg_container_shapes_rejected(api):
+    """Non-object agg containers at every level: top-level aggs, the
+    per-name body, and nested aggs — typed 400s on empty AND populated
+    indexes."""
+    for body in ({"query": {"match_all": {}}, "aggs": 5},
+                 {"query": {"match_all": {}}, "aggs": {"g": 42}},
+                 {"query": {"match_all": {}}, "aggs": {"g": ["terms"]}},
+                 {"query": {"match_all": {}},
+                  "aggs": {"g": {"terms": {"field": "sev"},
+                                 "aggs": 7}}}):
+        status, data = api("POST", "/api/v1/_elastic/fuzz/_search", body)
+        assert status == 400, (body, status, data[:200])
