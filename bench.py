@@ -774,13 +774,23 @@ def main() -> None:
         vs = round(1000.0 / head["e2e_ms"], 2)
         note = f"{note}, vs 1s headline bound"
         value = head["e2e_ms"]
-    print(json.dumps({
+    headline = {
         "metric": "hdfs-logs leaf_search pipelined p50 (term+date_histogram"
                   f"+terms, {NUM_DOCS/1e6:g}M docs, 1 chip, {note})",
         "value": value,
         "unit": "ms",
         "vs_baseline": vs,
-    }))
+    }
+    if platform in ("cpu", "cpu-fallback"):
+        # honesty: JAX-on-CPU is not the production leaf path, so a CPU run
+        # must not headline a ratio that reads like an accelerator result —
+        # the number survives under an explicit name, the headline leads
+        # with the caveat, and vs_baseline is withheld
+        headline["metric"] = ("no TPU available — CPU fallback: "
+                              + headline["metric"])
+        headline["vs_baseline"] = None
+        headline["vs_1s_bound_on_cpu_fallback"] = vs
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":

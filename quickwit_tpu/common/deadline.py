@@ -1,0 +1,207 @@
+"""End-to-end query deadlines and retry budgets.
+
+Role of the reference's request-scoped timeouts (`search_job_placer` retry
+budget + per-request tower timeouts): a query enters the cluster with one
+wall-clock budget, and every downstream actor — root fan-out, leaf split
+groups, the convoy batcher, HBM admission, storage hedging — checks the
+*remaining* time instead of holding its own unrelated timeout. On expiry the
+query fails partially and on time (`timed_out: true` + per-split errors),
+never hangs.
+
+`Deadline` is an absolute point on the monotonic clock; `QueryBudget` couples
+a deadline with a bounded retry allowance and exponential backoff capped by
+the remaining time. The ambient deadline travels through the stack via a
+`contextvars.ContextVar` so deep layers (admission, storage wrappers) need no
+signature changes; thread-pool hops must rebind explicitly with
+`bind_deadline` because contextvars do not propagate into worker threads.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import math
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, Optional
+
+# Canonical marker for "ran out of time" errors. Split/storage error strings
+# embed it so the root can tell deadline failures (-> timed_out partial
+# response) apart from query-level failures (-> hard error).
+DEADLINE_ERROR_MARK = "deadline exceeded"
+
+
+class DeadlineExceeded(Exception):
+    """A step was attempted (or abandoned) after the query budget ran out."""
+
+    def __init__(self, operation: str = ""):
+        self.operation = operation
+        suffix = f" during {operation}" if operation else ""
+        super().__init__(f"{DEADLINE_ERROR_MARK}{suffix}")
+
+
+def is_deadline_error(message: str) -> bool:
+    return DEADLINE_ERROR_MARK in (message or "")
+
+
+class Deadline:
+    """Absolute expiry instant on the monotonic clock (or unbounded)."""
+
+    __slots__ = ("_expires_at",)
+
+    def __init__(self, expires_at: float):
+        self._expires_at = expires_at
+
+    @classmethod
+    def after(cls, timeout_secs: float) -> "Deadline":
+        return cls(time.monotonic() + max(timeout_secs, 0.0))
+
+    @classmethod
+    def never(cls) -> "Deadline":
+        return cls(math.inf)
+
+    @classmethod
+    def from_millis(cls, timeout_millis: Optional[int]) -> "Deadline":
+        """Wire helper: a missing/zero-or-negative budget means unbounded /
+        already expired respectively (a leaf receiving `deadline_millis=0`
+        must shed immediately, not inherit forever)."""
+        if timeout_millis is None:
+            return cls.never()
+        return cls.after(timeout_millis / 1000.0)
+
+    @property
+    def bounded(self) -> bool:
+        return self._expires_at != math.inf
+
+    def remaining(self) -> float:
+        """Seconds left; `inf` when unbounded, clamped at 0 after expiry."""
+        if not self.bounded:
+            return math.inf
+        return max(self._expires_at - time.monotonic(), 0.0)
+
+    @property
+    def expired(self) -> bool:
+        return self.bounded and time.monotonic() >= self._expires_at
+
+    def check(self, operation: str = "") -> None:
+        if self.expired:
+            raise DeadlineExceeded(operation)
+
+    def clamp(self, timeout_secs: Optional[float]) -> Optional[float]:
+        """Smallest of `timeout_secs` and the remaining budget; `None` stays
+        `None` for unbounded deadlines (blocking-call semantics)."""
+        if not self.bounded:
+            return timeout_secs
+        remaining = self.remaining()
+        if timeout_secs is None:
+            return remaining
+        return min(timeout_secs, remaining)
+
+    def timeout_millis(self) -> Optional[int]:
+        """Remaining budget as integer millis for the wire (None = unbounded).
+
+        Serializing the *remaining* time (not the original budget) means root
+        queue time is not silently re-granted to the leaf."""
+        if not self.bounded:
+            return None
+        return max(int(self.remaining() * 1000.0), 0)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        if not self.bounded:
+            return "Deadline(never)"
+        return f"Deadline(remaining={self.remaining():.3f}s)"
+
+
+class QueryBudget:
+    """A deadline plus a bounded, thread-safe retry allowance.
+
+    Retries across a root fan-out share one pool so a query with many failing
+    splits cannot amplify into unbounded duplicate work. Backoff is
+    exponential from the second retry on (the first retry stays immediate,
+    preserving fast single-failure recovery) and always capped by the
+    remaining deadline.
+    """
+
+    BACKOFF_BASE_SECS = 0.05
+    BACKOFF_CAP_SECS = 2.0
+
+    def __init__(self, deadline: Deadline, max_retries: int = 8):
+        self.deadline = deadline
+        self.max_retries = max_retries
+        self._retries_used = 0
+        self._lock = threading.Lock()
+
+    @classmethod
+    def for_timeout_millis(cls, timeout_millis: Optional[int],
+                           max_retries: int = 8) -> "QueryBudget":
+        return cls(Deadline.from_millis(timeout_millis), max_retries=max_retries)
+
+    @property
+    def retries_used(self) -> int:
+        with self._lock:
+            return self._retries_used
+
+    def try_acquire_retry(self) -> Optional[int]:
+        """Claim one retry slot; returns the 0-based retry index, or None when
+        the pool is drained or the deadline has already passed."""
+        if self.deadline.expired:
+            return None
+        with self._lock:
+            if self._retries_used >= self.max_retries:
+                return None
+            index = self._retries_used
+            self._retries_used += 1
+            return index
+
+    def backoff_secs(self, retry_index: int) -> float:
+        """Pre-retry sleep: 0 for the first retry, then exponential, always
+        capped by both the ceiling and the remaining budget."""
+        if retry_index <= 0:
+            return 0.0
+        delay = min(self.BACKOFF_BASE_SECS * (2.0 ** (retry_index - 1)),
+                    self.BACKOFF_CAP_SECS)
+        remaining = self.deadline.remaining()
+        if remaining == math.inf:
+            return delay
+        return min(delay, remaining)
+
+    def sleep_before_retry(self, retry_index: int) -> bool:
+        """Sleep the backoff; returns False when the deadline expired (the
+        retry should be abandoned)."""
+        delay = self.backoff_secs(retry_index)
+        if delay > 0.0:
+            time.sleep(delay)
+        return not self.deadline.expired
+
+
+# --- ambient propagation --------------------------------------------------
+
+_CURRENT_DEADLINE: contextvars.ContextVar[Optional[Deadline]] = (
+    contextvars.ContextVar("quickwit_tpu_deadline", default=None))
+
+
+def current_deadline() -> Optional[Deadline]:
+    """The deadline bound to this thread of execution, if any."""
+    return _CURRENT_DEADLINE.get()
+
+
+@contextmanager
+def deadline_scope(deadline: Optional[Deadline]):
+    token = _CURRENT_DEADLINE.set(deadline)
+    try:
+        yield deadline
+    finally:
+        _CURRENT_DEADLINE.reset(token)
+
+
+def bind_deadline(fn: Callable, deadline: Optional[Deadline] = None) -> Callable:
+    """Wrap `fn` so it runs under `deadline` (default: the caller's current
+    deadline). Needed for ThreadPoolExecutor hops — contextvars do not
+    propagate into pool worker threads automatically."""
+    captured = deadline if deadline is not None else current_deadline()
+
+    def wrapper(*args, **kwargs):
+        with deadline_scope(captured):
+            return fn(*args, **kwargs)
+
+    return wrapper

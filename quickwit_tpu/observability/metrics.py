@@ -171,3 +171,21 @@ class MetricsRegistry:
 
 
 METRICS = MetricsRegistry()
+
+# --- search-robustness metrics (deadline propagation / shedding) ----------
+# Remaining query budget observed when a leaf search starts executing: a
+# left-shifted distribution means queries burn their budget queueing.
+SEARCH_DEADLINE_REMAINING = METRICS.histogram(
+    "qw_search_deadline_remaining_seconds",
+    "Remaining deadline budget when a leaf search begins execution")
+# Work abandoned because the deadline had already passed, labeled by stage
+# (admission queue, leaf group loop, batcher, ...).
+SEARCH_SHED_TOTAL = METRICS.counter(
+    "qw_search_shed_total",
+    "Operations shed because the query deadline expired before they ran")
+SEARCH_TIMED_OUT_TOTAL = METRICS.counter(
+    "qw_search_timed_out_total",
+    "Root searches that returned a timed_out partial response")
+SEARCH_LEAF_RETRIES_TOTAL = METRICS.counter(
+    "qw_search_leaf_retries_total",
+    "Leaf requests retried on another node after a failure")

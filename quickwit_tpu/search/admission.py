@@ -32,6 +32,9 @@ import time
 import weakref
 from collections import OrderedDict, deque
 
+from ..common.deadline import DeadlineExceeded, current_deadline
+from ..observability.metrics import SEARCH_SHED_TOTAL
+
 logger = logging.getLogger(__name__)
 
 DEFAULT_BUDGET_BYTES = int(os.environ.get("QW_HBM_BUDGET_BYTES", 8 << 30))
@@ -54,7 +57,16 @@ class HbmBudget:
               timeout_secs: float = 120.0) -> int:
         """Block (FIFO) until `new_bytes` fit; returns the admitted
         (pinned) byte count. Evicts idle readers' resident device arrays
-        LRU to make room."""
+        LRU to make room.
+
+        Load shedding: a query whose ambient deadline has already passed —
+        or passes while it queues — is rejected with `DeadlineExceeded`
+        instead of occupying a ticket; its caller has no time left to use
+        the admission anyway."""
+        query_deadline = current_deadline()
+        if query_deadline is not None and query_deadline.expired:
+            SEARCH_SHED_TOTAL.inc(stage="admission")
+            raise DeadlineExceeded("HBM admission")
         if new_bytes <= 0:
             # zero-byte admission still PINS the owner: its cached device
             # arrays are in use and must not be evicted mid-query
@@ -63,6 +75,9 @@ class HbmBudget:
                     self._pin_counts.get(id(owner), 0) + 1
             return 0
         ticket = next(self._ticket_seq)
+        if query_deadline is not None:
+            timeout_secs = min(timeout_secs,
+                               query_deadline.clamp(timeout_secs))
         deadline = time.monotonic() + timeout_secs
         with self._cond:
             self._tickets.append(ticket)
@@ -72,6 +87,10 @@ class HbmBudget:
                                 or self._pinned + new_bytes <= self.budget)):
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
+                        if (query_deadline is not None
+                                and query_deadline.expired):
+                            SEARCH_SHED_TOTAL.inc(stage="admission")
+                            raise DeadlineExceeded("HBM admission queue wait")
                         raise TimeoutError(
                             f"HBM admission timed out: need {new_bytes} "
                             f"bytes, {self._pinned} pinned of {self.budget}")

@@ -16,24 +16,38 @@ reference's own shape — leaf requests are batched per node
 Batching is convoy-style: dispatches for one key are serialized by a
 per-key lock, so queries arriving while a dispatch is in flight pile up
 and ride the next dispatch together. A lone query pays ZERO added
-latency — the lock is free and it dispatches immediately."""
+latency — the lock is free and it dispatches immediately.
+
+Deadline behavior: every rider carries its ambient deadline. Followers
+wait bounded (never past their own expiry plus a small leader-signal
+slack); at dispatch time the leader sheds already-expired riders with
+`DeadlineExceeded` but still dispatches for the live ones — a leader must
+never orphan its followers."""
 
 from __future__ import annotations
 
 import threading
-from typing import Any
+from typing import Any, Optional
 
+from ..common.deadline import Deadline, DeadlineExceeded, current_deadline
+from ..observability.metrics import SEARCH_SHED_TOTAL
 from . import executor
+
+# Extra follower wait beyond its own deadline: the leader may be setting the
+# event at this very moment — shedding exactly at expiry would discard a
+# result that is already computed.
+_FOLLOWER_SLACK_SECS = 0.05
 
 
 class _Pending:
-    __slots__ = ("scalars", "event", "result", "error")
+    __slots__ = ("scalars", "event", "result", "error", "deadline")
 
-    def __init__(self, scalars):
+    def __init__(self, scalars, deadline: Optional[Deadline] = None):
         self.scalars = scalars
         self.event = threading.Event()
         self.result: Any = None
         self.error: Exception | None = None
+        self.deadline = deadline
 
 
 class QueryBatcher:
@@ -41,7 +55,7 @@ class QueryBatcher:
     multi-query dispatch. Thread-safe; every caller blocks only for its
     own result."""
 
-    def __init__(self, max_batch: int = 16):
+    def __init__(self, max_batch: int = 16, fault_injector=None):
         self.max_batch = max_batch
         self._lock = threading.Lock()
         self._queues: dict[tuple, list[_Pending]] = {}
@@ -51,6 +65,8 @@ class QueryBatcher:
         # observability: dispatches vs queries served (batching efficiency)
         self.num_dispatches = 0
         self.num_queries = 0
+        # chaos hook: perturbs "batcher.dispatch" before each real dispatch
+        self.fault_injector = fault_injector
 
     def execute(self, plan, k: int, device_arrays, split_key) -> dict[str, Any]:
         """Run one query, possibly riding a shared dispatch. `split_key`
@@ -60,7 +76,7 @@ class QueryBatcher:
         equal posting shape lower to the same signature but DIFFERENT
         arrays — they must not share)."""
         key = (plan.signature(k), tuple(plan.array_keys), split_key)
-        me = _Pending(plan.scalars)
+        me = _Pending(plan.scalars, current_deadline())
         my_queue = None
         with self._lock:
             self.num_queries += 1
@@ -78,7 +94,15 @@ class QueryBatcher:
                 entry[1] += 1
                 dispatch_lock = entry[0]
         if my_queue is None:
-            me.event.wait()
+            if me.deadline is None or not me.deadline.bounded:
+                me.event.wait()
+            elif not me.event.wait(
+                    me.deadline.remaining() + _FOLLOWER_SLACK_SECS):
+                # the leader (stuck in a slow dispatch) outlived our budget;
+                # abandon the ride — our scalars may still be computed, the
+                # result is simply unclaimed
+                SEARCH_SHED_TOTAL.inc(stage="batcher_wait")
+                raise DeadlineExceeded("batched dispatch wait")
             if me.error is not None:
                 raise _waiter_error(me.error)
             return me.result
@@ -92,21 +116,34 @@ class QueryBatcher:
                     if self._queues.get(key) is my_queue:
                         del self._queues[key]
                     batch = my_queue
-                    self.num_dispatches += 1
+                # riders whose budget ran out while queued are shed NOW:
+                # dispatching for them wastes device time nobody can use
+                expired = [p for p in batch
+                           if p.deadline is not None and p.deadline.expired]
+                alive = [p for p in batch if p not in expired]
+                for pending in expired:
+                    SEARCH_SHED_TOTAL.inc(stage="batcher_dispatch")
+                    pending.error = DeadlineExceeded("batched dispatch")
+                    pending.event.set()
                 try:
-                    if len(batch) == 1:
-                        results = [executor.execute_plan(plan, k,
-                                                         device_arrays)]
-                    else:
-                        results = executor.readback_plan_multi(
-                            executor.dispatch_plan_multi(
-                                plan, k, device_arrays,
-                                [p.scalars for p in batch]))
-                    for pending, result in zip(batch, results):
-                        pending.result = result
-                        pending.event.set()
+                    if alive:
+                        with self._lock:
+                            self.num_dispatches += 1
+                        if self.fault_injector is not None:
+                            self.fault_injector.perturb("batcher.dispatch")
+                        if len(alive) == 1 and alive[0] is me:
+                            results = [executor.execute_plan(plan, k,
+                                                             device_arrays)]
+                        else:
+                            results = executor.readback_plan_multi(
+                                executor.dispatch_plan_multi(
+                                    plan, k, device_arrays,
+                                    [p.scalars for p in alive]))
+                        for pending, result in zip(alive, results):
+                            pending.result = result
+                            pending.event.set()
                 except Exception as exc:  # noqa: BLE001 - fan to waiters
-                    for pending in batch:
+                    for pending in alive:
                         pending.error = exc
                         pending.event.set()
         finally:

@@ -176,6 +176,12 @@ def execute_prepared_split(
     """Stage 2: jitted kernel execution + the single batched readback.
     With a `QueryBatcher`, concurrent same-structure queries on this split
     share one vmapped dispatch (see search/batcher.py)."""
+    from ..common.deadline import current_deadline
+    ambient = current_deadline()
+    if ambient is not None:
+        # shed before launching a kernel whose result nobody can use; the
+        # service turns this into a typed, retryable SplitSearchError
+        ambient.check(f"leaf split {split_id} execute")
     t0 = time.monotonic()
     sort = request.sort_fields[0] if request.sort_fields else None
     sort_field = sort.field if sort else "_score"

@@ -72,6 +72,10 @@ class SearchRequest:
     count_hits_exact: bool = True
     search_after: Optional[list[Any]] = None       # sort values of last hit
     snippet_fields: tuple[str, ...] = ()
+    # Wall-clock budget for the whole query (None = server default). NOT part
+    # of the leaf-cache key (cache.canonical_request_key): two queries that
+    # differ only in budget must share results.
+    timeout_millis: Optional[int] = None
 
     def __post_init__(self) -> None:
         self.sort_fields = normalize_sort_fields(tuple(self.sort_fields))
@@ -99,6 +103,8 @@ class SearchRequest:
             "count_hits_exact": self.count_hits_exact,
             "search_after": self.search_after,
             "snippet_fields": list(self.snippet_fields),
+            **({"timeout_millis": self.timeout_millis}
+               if self.timeout_millis is not None else {}),
         }
 
     @staticmethod
@@ -115,6 +121,7 @@ class SearchRequest:
             count_hits_exact=d.get("count_hits_exact", True),
             search_after=d.get("search_after"),
             snippet_fields=tuple(d.get("snippet_fields", ())),
+            timeout_millis=d.get("timeout_millis"),
         )
 
 
@@ -172,6 +179,13 @@ class SearchResponse:
     errors: list[str] = field(default_factory=list)
     aggregations: Optional[dict[str, Any]] = None
     scroll_id: Optional[str] = None
+    # Deadline outcome: True when the query budget expired and this is a
+    # partial result. `failed_splits` carries the structured per-split errors
+    # (the flat `errors` strings above stay for backward compat).
+    timed_out: bool = False
+    failed_splits: list[SplitSearchError] = field(default_factory=list)
+    num_attempted_splits: int = 0
+    num_successful_splits: int = 0
 
     def to_dict(self) -> dict[str, Any]:
         """Reference REST shape (`search_response_rest.rs:43`): hits are the
@@ -187,6 +201,13 @@ class SearchResponse:
             **({"aggregations": self.aggregations}
                if self.aggregations is not None else {}),
             **({"scroll_id": self.scroll_id} if self.scroll_id else {}),
+            # additive keys: only emitted when set, so pre-deadline response
+            # shapes stay byte-identical
+            **({"timed_out": True} if self.timed_out else {}),
+            **({"failed_splits": [
+                {"split_id": e.split_id, "error": e.error,
+                 "retryable": e.retryable} for e in self.failed_splits]}
+               if self.failed_splits else {}),
         }
 
 
@@ -223,12 +244,18 @@ class LeafSearchRequest:
     index_uid: str
     doc_mapping: dict[str, Any]          # serialized DocMapper
     splits: list[SplitIdAndFooter]
+    # Remaining budget at dispatch time, in millis (None = unbounded). The
+    # root serializes what is LEFT, not the original timeout, so time spent
+    # queued at the root is not silently re-granted to the leaf.
+    deadline_millis: Optional[int] = None
 
     def to_dict(self) -> dict[str, Any]:
         return {"search_request": self.search_request.to_dict(),
                 "index_uid": self.index_uid,
                 "doc_mapping": self.doc_mapping,
-                "splits": [s.to_dict() for s in self.splits]}
+                "splits": [s.to_dict() for s in self.splits],
+                **({"deadline_millis": self.deadline_millis}
+                   if self.deadline_millis is not None else {})}
 
     @staticmethod
     def from_dict(d: dict[str, Any]) -> "LeafSearchRequest":
@@ -236,7 +263,8 @@ class LeafSearchRequest:
             search_request=SearchRequest.from_dict(d["search_request"]),
             index_uid=d["index_uid"],
             doc_mapping=d["doc_mapping"],
-            splits=[SplitIdAndFooter.from_dict(s) for s in d["splits"]])
+            splits=[SplitIdAndFooter.from_dict(s) for s in d["splits"]],
+            deadline_millis=d.get("deadline_millis"))
 
 
 @dataclass

@@ -19,21 +19,39 @@ from __future__ import annotations
 
 import fnmatch
 import logging
+import threading
 import time
 from typing import Any, Callable, Optional, Protocol
 
+from ..common.deadline import (
+    Deadline, DeadlineExceeded, QueryBudget, deadline_scope, is_deadline_error,
+)
 from ..metastore.base import ListSplitsQuery, Metastore
 from ..models.doc_mapper import DocMapper
 from ..models.split_metadata import Split, SplitState
+from ..observability.metrics import (
+    SEARCH_LEAF_RETRIES_TOTAL, SEARCH_TIMED_OUT_TOTAL,
+)
 from ..query import ast as Q
 from .collector import IncrementalCollector, finalize_aggregations
 from .models import (
     FetchDocsRequest, Hit, LeafSearchRequest, LeafSearchResponse, SearchRequest,
-    SearchResponse, SplitIdAndFooter, string_sort_of,
+    SearchResponse, SplitIdAndFooter, SplitSearchError, string_sort_of,
 )
 from .placer import SearchJob, nodes_for_split, place_jobs
 
 logger = logging.getLogger(__name__)
+
+
+def _all_splits_failed(leaf_request: LeafSearchRequest, error: str,
+                       retryable: bool = True) -> LeafSearchResponse:
+    """A leaf response reporting every split of the request as failed —
+    never an empty `failed_splits` for work that was not done."""
+    return LeafSearchResponse(
+        failed_splits=[SplitSearchError(split_id=s.split_id, error=error,
+                                        retryable=retryable)
+                       for s in leaf_request.splits],
+        num_attempted_splits=len(leaf_request.splits))
 
 
 class SearchClient(Protocol):
@@ -146,24 +164,46 @@ def split_excluded_by_bounds(column_bounds: dict,
 
 
 class RootSearcher:
+    # Queries that arrive without an explicit budget still get one: the root
+    # must never hang on a stuck leaf regardless of what the caller sent.
+    DEFAULT_TIMEOUT_SECS = 30.0
+    # Per-query retry pool shared across the whole fan-out (reference: the
+    # retry policy retries each failed leaf request once; the pool caps the
+    # aggregate so a wide outage cannot amplify into a retry storm).
+    MAX_RETRIES_PER_QUERY = 8
+
     def __init__(
         self,
         metastore: Metastore,
         clients: dict[str, SearchClient],     # node_id -> client (live pool)
         nodes_provider: Optional[Callable[[], list[str]]] = None,
+        default_timeout_secs: Optional[float] = None,
     ):
         self.metastore = metastore
         self.clients = clients
         self.nodes_provider = nodes_provider or (lambda: sorted(self.clients))
+        self.default_timeout_secs = (
+            self.DEFAULT_TIMEOUT_SECS if default_timeout_secs is None
+            else default_timeout_secs)
 
     # ------------------------------------------------------------------
     def search(self, request: SearchRequest) -> SearchResponse:
         from ..observability.tracing import TRACER
+        if request.timeout_millis is not None:
+            deadline = Deadline.from_millis(request.timeout_millis)
+        else:
+            deadline = Deadline.after(self.default_timeout_secs)
+        budget = QueryBudget(deadline, max_retries=self.MAX_RETRIES_PER_QUERY)
         with TRACER.span("root_search",
                          {"indexes": ",".join(request.index_ids)}):
-            return self._search_traced(request)
+            with deadline_scope(deadline):
+                response = self._search_traced(request, budget)
+        if response.timed_out:
+            SEARCH_TIMED_OUT_TOTAL.inc()
+        return response
 
-    def _search_traced(self, request: SearchRequest) -> SearchResponse:
+    def _search_traced(self, request: SearchRequest,
+                       budget: QueryBudget) -> SearchResponse:
         t0 = time.monotonic()
         indexes = self._resolve_indexes(request.index_ids)
         if not indexes:
@@ -195,6 +235,7 @@ class RootSearcher:
                                  if string_sort is not None else None))
         split_meta_by_id: dict[str, tuple[str, SplitIdAndFooter, dict]] = {}
         nodes = self.nodes_provider()
+        dispatches: list[tuple[str, LeafSearchRequest]] = []
 
         for index_metadata in indexes:
             doc_mapper = index_metadata.index_config.doc_mapper
@@ -224,16 +265,25 @@ class RootSearcher:
                     doc_mapping=doc_mapper.to_dict(),
                     splits=[offsets[j.split_id] for j in node_jobs],
                 )
-                response = self._leaf_search_with_retry(leaf_request, node_id, nodes)
-                collector.add_leaf_response(response)
+                dispatches.append((node_id, leaf_request))
+
+        for response in self._fan_out(dispatches, nodes, budget):
+            collector.add_leaf_response(response)
 
         merged = collector
+        deadline_hit = (budget.deadline.expired
+                        or any(is_deadline_error(e.error)
+                               for e in merged.failed_splits))
         if (merged.num_attempted_splits > 0
-                and merged.num_successful_splits == 0 and merged.failed_splits):
+                and merged.num_successful_splits == 0 and merged.failed_splits
+                and not deadline_hit):
             # every split failed: a query-level problem (e.g. unknown field),
-            # not a partial outage — surface it as an error (reference 400s)
+            # not a partial outage — surface it as an error (reference 400s).
+            # Deadline expiries are NOT query-level problems: they return a
+            # timed_out partial response below.
             raise ValueError(merged.failed_splits[0].error)
-        hits = self._fetch_docs_phase(request, merged, split_meta_by_id, nodes)
+        hits = self._fetch_docs_phase(request, merged, split_meta_by_id, nodes,
+                                      budget.deadline)
         aggregations = None
         if request.aggs:
             aggregations = finalize_aggregations(merged.aggregation_states())
@@ -246,7 +296,57 @@ class RootSearcher:
             elapsed_time_micros=int((time.monotonic() - t0) * 1e6),
             errors=[f"{e.split_id}: {e.error}" for e in merged.failed_splits],
             aggregations=aggregations,
+            timed_out=deadline_hit or budget.deadline.expired,
+            failed_splits=list(merged.failed_splits),
+            num_attempted_splits=merged.num_attempted_splits,
+            num_successful_splits=merged.num_successful_splits,
         )
+
+    # ------------------------------------------------------------------
+    def _fan_out(self, dispatches: list[tuple[str, LeafSearchRequest]],
+                 nodes: list[str],
+                 budget: QueryBudget) -> list[LeafSearchResponse]:
+        """Dispatch every leaf request concurrently and collect responses in
+        dispatch order (merge determinism). Each join is bounded by the
+        remaining deadline; a dispatch still running at expiry is abandoned —
+        its daemon thread finishes in the background — and reported as
+        deadline-failed splits instead of blocking the root."""
+        if not dispatches:
+            return []
+        deadline = budget.deadline
+        if len(dispatches) == 1 and not deadline.bounded:
+            node_id, leaf_request = dispatches[0]
+            return [self._leaf_search_with_retry(leaf_request, node_id, nodes,
+                                                 budget)]
+        results: list[Optional[LeafSearchResponse]] = [None] * len(dispatches)
+
+        def run(i: int, node_id: str, leaf_request: LeafSearchRequest) -> None:
+            with deadline_scope(deadline):
+                try:
+                    results[i] = self._leaf_search_with_retry(
+                        leaf_request, node_id, nodes, budget)
+                except Exception as exc:  # noqa: BLE001 - surfaced per split
+                    results[i] = _all_splits_failed(leaf_request, str(exc))
+
+        threads = []
+        for i, (node_id, leaf_request) in enumerate(dispatches):
+            thread = threading.Thread(
+                target=run, args=(i, node_id, leaf_request),
+                name=f"root-fanout-{i}", daemon=True)
+            threads.append(thread)
+            thread.start()
+        for thread in threads:
+            thread.join(timeout=deadline.clamp(None))
+        out: list[LeafSearchResponse] = []
+        for i, (node_id, leaf_request) in enumerate(dispatches):
+            response = results[i]
+            if response is None:
+                response = _all_splits_failed(
+                    leaf_request,
+                    f"deadline exceeded waiting for leaf search on {node_id}",
+                    retryable=False)
+            out.append(response)
+        return out
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -312,43 +412,83 @@ class RootSearcher:
         return splits
 
     def _leaf_search_with_retry(self, leaf_request: LeafSearchRequest,
-                                node_id: str, nodes: list[str]) -> LeafSearchResponse:
+                                node_id: str, nodes: list[str],
+                                budget: Optional[QueryBudget] = None,
+                                ) -> LeafSearchResponse:
+        budget = budget or QueryBudget(Deadline.never(),
+                                       max_retries=self.MAX_RETRIES_PER_QUERY)
+        first_error: Optional[str] = None
         try:
+            budget.deadline.check(f"leaf dispatch to {node_id}")
+            leaf_request.deadline_millis = budget.deadline.timeout_millis()
             client = self.clients[node_id]
             response = client.leaf_search(leaf_request)
+        except DeadlineExceeded as exc:
+            return _all_splits_failed(leaf_request, str(exc), retryable=False)
         except Exception as exc:  # noqa: BLE001 - node-level failure
             logger.warning("leaf search on %s failed: %s", node_id, exc)
+            first_error = f"leaf search on {node_id} failed: {exc}"
             response = None
         if response is not None and not response.failed_splits:
             return response
+        # Per-split failures of the whole request when the node itself died;
+        # these are what a no-retry path must RETURN, never drop — a response
+        # with empty failed_splits claims splits were searched cleanly.
+        original_failures = (
+            list(response.failed_splits) if response is not None
+            else [SplitSearchError(split_id=s.split_id, error=first_error)
+                  for s in leaf_request.splits])
+        retryable_ids = {e.split_id for e in original_failures if e.retryable}
+
+        def with_failures(failures: list[SplitSearchError]) -> LeafSearchResponse:
+            if response is None:
+                return LeafSearchResponse(
+                    failed_splits=failures,
+                    num_attempted_splits=len(leaf_request.splits))
+            response.failed_splits = failures
+            return response
+
+        if not retryable_ids:
+            return with_failures(original_failures)
+        retry_index = budget.try_acquire_retry()
+        if retry_index is None:  # pool drained or deadline passed
+            return with_failures(original_failures)
         # retry failed splits (or the whole request) on the next-best node
-        failed_ids = ({e.split_id for e in response.failed_splits}
-                      if response is not None
-                      else {s.split_id for s in leaf_request.splits})
-        retry_splits = [s for s in leaf_request.splits if s.split_id in failed_ids]
+        retry_splits = [s for s in leaf_request.splits
+                        if s.split_id in retryable_ids]
         retry_node = None
         for candidate in nodes_for_split(retry_splits[0].split_id, nodes):
             if candidate != node_id:
                 retry_node = candidate
                 break
         if retry_node is None:
-            return response if response is not None else LeafSearchResponse(
-                failed_splits=[], num_attempted_splits=len(leaf_request.splits))
+            return with_failures(original_failures)
+        if not budget.sleep_before_retry(retry_index):
+            return with_failures(original_failures)
+        SEARCH_LEAF_RETRIES_TOTAL.inc()
+        non_retryable = [e for e in original_failures
+                         if e.split_id not in retryable_ids]
         retry_request = LeafSearchRequest(
             search_request=leaf_request.search_request,
             index_uid=leaf_request.index_uid,
             doc_mapping=leaf_request.doc_mapping,
             splits=retry_splits,
+            deadline_millis=budget.deadline.timeout_millis(),
         )
         try:
             retry_response = self.clients[retry_node].leaf_search(retry_request)
         except Exception as exc:  # noqa: BLE001
             logger.warning("leaf retry on %s failed: %s", retry_node, exc)
-            return response if response is not None else LeafSearchResponse()
+            return with_failures(
+                [SplitSearchError(split_id=s.split_id,
+                                  error=f"retry on {retry_node} failed: {exc}")
+                 for s in retry_splits] + non_retryable)
         if response is None:
+            retry_response.failed_splits = (
+                list(retry_response.failed_splits) + non_retryable)
             return retry_response
         # keep the successful part of the original + the retry results
-        response.failed_splits = retry_response.failed_splits
+        # (non-retryable failures from the first attempt ride along)
         from ..models.doc_mapper import DocMapper as _DM
         merged = IncrementalCollector(
             max_hits=leaf_request.search_request.max_hits
@@ -358,6 +498,7 @@ class RootSearcher:
                 _DM.from_dict(leaf_request.doc_mapping)))
         ok_part = LeafSearchResponse(
             num_hits=response.num_hits, partial_hits=response.partial_hits,
+            failed_splits=non_retryable,
             intermediate_aggs=response.intermediate_aggs,
             num_attempted_splits=response.num_attempted_splits,
             num_successful_splits=response.num_successful_splits)
@@ -368,7 +509,9 @@ class RootSearcher:
     def _fetch_docs_phase(self, request: SearchRequest,
                           collector: IncrementalCollector,
                           split_meta_by_id: dict,
-                          nodes: list[str]) -> list[Hit]:
+                          nodes: list[str],
+                          deadline: Optional[Deadline] = None) -> list[Hit]:
+        deadline = deadline or Deadline.never()
         top_hits = collector.partial_hits()
         if not top_hits or request.max_hits == 0:
             return []
@@ -377,6 +520,10 @@ class RootSearcher:
             by_split.setdefault(hit.split_id, []).append(hit)
         docs_by_address: dict[tuple[str, int], dict] = {}
         for split_id, hits in by_split.items():
+            if deadline.expired:
+                # out of budget: return what phase 1 earned; hits whose docs
+                # were not fetched are dropped from the (already partial) page
+                break
             index_uid, offset, doc_mapping = split_meta_by_id[split_id]
             fetch_request = FetchDocsRequest(
                 index_uid=index_uid, split=offset,

@@ -24,8 +24,14 @@ import threading
 from collections import OrderedDict
 from typing import Any, Optional
 
+from ..common.deadline import (
+    Deadline, bind_deadline, current_deadline, deadline_scope,
+)
 from ..index.reader import SplitReader
 from ..models.doc_mapper import DocMapper
+from ..observability.metrics import (
+    SEARCH_DEADLINE_REMAINING, SEARCH_SHED_TOTAL,
+)
 from ..query.ast import MatchAll
 from ..parallel.fanout import build_batch, execute_batch, stage_device_inputs
 from ..storage.base import StorageResolver
@@ -185,6 +191,19 @@ class SearchService:
 
     def _leaf_search_traced(self,
                             request: LeafSearchRequest) -> LeafSearchResponse:
+        # The wire deadline (remaining budget serialized by the root) wins;
+        # in-process callers inherit the ambient scope; otherwise unbounded.
+        if request.deadline_millis is not None:
+            deadline = Deadline.from_millis(request.deadline_millis)
+        else:
+            deadline = current_deadline() or Deadline.never()
+        if deadline.bounded:
+            SEARCH_DEADLINE_REMAINING.observe(deadline.remaining())
+        with deadline_scope(deadline):
+            return self._leaf_search_deadlined(request, deadline)
+
+    def _leaf_search_deadlined(self, request: LeafSearchRequest,
+                               deadline: Deadline) -> LeafSearchResponse:
         doc_mapper = DocMapper.from_dict(request.doc_mapping)
         search_request = request.search_request
         splits = self._optimize_split_order(search_request, request.splits)
@@ -243,7 +262,8 @@ class SearchService:
                 remote_request = LeafSearchRequest(
                     search_request=search_request,
                     index_uid=request.index_uid,
-                    doc_mapping=request.doc_mapping, splits=offloaded)
+                    doc_mapping=request.doc_mapping, splits=offloaded,
+                    deadline_millis=deadline.timeout_millis())
                 result_box: dict[str, Any] = {}
 
                 def _invoke(box=result_box, rr=remote_request):
@@ -270,10 +290,25 @@ class SearchService:
         pipelined = self.context.prefetch and len(groups) > 1
         future = None
         if pipelined:
+            # bind_deadline: contextvars do not reach pool worker threads
             future = self.context.prefetch_pool().submit(
-                self._prepare_group, groups[0], doc_mapper, search_request)
+                bind_deadline(self._prepare_group), groups[0], doc_mapper,
+                search_request)
         for i, group in enumerate(groups):
             begin = i * batch_size
+            if deadline.expired:
+                # out of budget mid-request: every remaining split surfaces
+                # as a typed, retryable failure — partial and on time
+                SEARCH_SHED_TOTAL.inc(stage="leaf_groups")
+                for split in pending[begin:]:
+                    collector.failed_splits.append(SplitSearchError(
+                        split_id=split.split_id,
+                        error="deadline exceeded before split executed at leaf",
+                        retryable=True))
+                if future is not None:
+                    self._discard_prepared(future.result())
+                    future = None
+                break
             if prunable and begin > 0 and self._can_skip_remaining(
                     search_request, collector, pending, begin):
                 # reference `CanSplitDoBetter` short-circuit (leaf.rs:1608):
@@ -293,14 +328,15 @@ class SearchService:
             future = None
             if pipelined and i + 1 < len(groups):
                 future = self.context.prefetch_pool().submit(
-                    self._prepare_group, groups[i + 1], doc_mapper,
-                    search_request)
+                    bind_deadline(self._prepare_group), groups[i + 1],
+                    doc_mapper, search_request)
             self._execute_group(prepared, doc_mapper, search_request,
                                 collector)
 
         num_offloaded = 0
         if offload_future is not None:
-            offload_future.join(timeout=self._OFFLOAD_TIMEOUT_SECS)
+            offload_future.join(
+                timeout=deadline.clamp(self._OFFLOAD_TIMEOUT_SECS))
             remote = offload_result.get("response")
             if remote is not None:
                 collector.add_leaf_response(remote)
@@ -314,6 +350,15 @@ class SearchService:
                     offload_result.get("error", "timeout"))
                 for group in [offloaded[b: b + batch_size]
                               for b in range(0, len(offloaded), batch_size)]:
+                    if deadline.expired:
+                        SEARCH_SHED_TOTAL.inc(stage="offload_fallback")
+                        for split in group:
+                            collector.failed_splits.append(SplitSearchError(
+                                split_id=split.split_id,
+                                error="deadline exceeded before offloaded "
+                                      "split ran locally",
+                                retryable=True))
+                        continue
                     prepared = self._prepare_group(group, doc_mapper,
                                                    search_request)
                     self._execute_group(prepared, doc_mapper, search_request,
@@ -474,7 +519,14 @@ class SearchService:
                 if admitted is not None:
                     self.context.hbm_budget.release(batch, admitted)
         from .leaf import warmup_device_arrays
+        deadline = current_deadline()
         for split, reader, plan, prep_error in data:
+            if deadline is not None and deadline.expired:
+                collector.failed_splits.append(SplitSearchError(
+                    split_id=split.split_id,
+                    error="deadline exceeded before split executed at leaf",
+                    retryable=True))
+                continue
             if prep_error is not None:
                 _warn_split_failure("prepare", split.split_id, prep_error)
                 collector.failed_splits.append(SplitSearchError(

@@ -81,17 +81,22 @@ class HttpSearchClient:
             failure_threshold=3, cooldown_secs=5.0,
             counts_as_failure=lambda exc: not isinstance(exc, HttpStatusError))
 
-    def _post(self, path: str, payload: Any) -> Any:
-        return self.circuit.call(lambda: self._post_once(path, payload))
+    def _post(self, path: str, payload: Any,
+              timeout_secs: Optional[float] = None) -> Any:
+        return self.circuit.call(
+            lambda: self._post_once(path, payload, timeout_secs))
 
-    def _post_once(self, path: str, payload: Any) -> Any:
+    def _post_once(self, path: str, payload: Any,
+                   timeout_secs: Optional[float] = None) -> Any:
+        timeout = (self.timeout_secs if timeout_secs is None
+                   else min(self.timeout_secs, timeout_secs))
         if self._ssl_context is not None:
             conn: http.client.HTTPConnection = http.client.HTTPSConnection(
-                self.host, self.port, timeout=self.timeout_secs,
+                self.host, self.port, timeout=timeout,
                 context=self._ssl_context)
         else:
             conn = http.client.HTTPConnection(self.host, self.port,
-                                              timeout=self.timeout_secs)
+                                              timeout=timeout)
         try:
             data = json.dumps(payload).encode()
             headers = {"Content-Type": "application/json"}
@@ -117,8 +122,16 @@ class HttpSearchClient:
             conn.close()
 
     def leaf_search(self, request: LeafSearchRequest) -> LeafSearchResponse:
+        # socket timeout tracks the request's remaining budget (plus slack
+        # for the leaf to serialize its partial response) instead of the
+        # fixed per-connection default — a deadline-bound request must not
+        # wait out a 30s socket timeout
+        timeout_secs = None
+        if request.deadline_millis is not None:
+            timeout_secs = request.deadline_millis / 1000.0 + 0.5
         return leaf_response_from_dict(
-            self._post("/internal/leaf_search", request.to_dict()))
+            self._post("/internal/leaf_search", request.to_dict(),
+                       timeout_secs=timeout_secs))
 
     def fetch_docs(self, request: FetchDocsRequest) -> list[dict[str, Any]]:
         return self._post("/internal/fetch_docs", request.to_dict())

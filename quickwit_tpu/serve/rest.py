@@ -123,11 +123,39 @@ def _search_request_from_params(index_id: str, params: dict[str, Any],
         not in ("false", "0", "no"),
         snippet_fields=tuple(params["snippet_fields"].split(","))
         if params.get("snippet_fields") else (),
+        timeout_millis=int(params["timeout_ms"])
+        if params.get("timeout_ms") is not None else None,
     )
 
 
 def _search_response_to_json(response) -> dict[str, Any]:
     return response.to_dict()
+
+
+_ES_DURATION_UNITS = {"nanos": 1e-6, "micros": 1e-3, "ms": 1.0,
+                      "s": 1000.0, "m": 60_000.0, "h": 3_600_000.0,
+                      "d": 86_400_000.0}
+
+
+def _parse_es_duration_millis(value) -> Optional[int]:
+    """ES time-unit strings ("500ms", "1s", "2m") → millis. Bare numbers
+    are millis (ES's own default for `timeout`)."""
+    if value is None:
+        return None
+    if isinstance(value, (int, float)):
+        return int(value)
+    text = str(value).strip().lower()
+    for unit in sorted(_ES_DURATION_UNITS, key=len, reverse=True):
+        if text.endswith(unit):
+            number = text[: -len(unit)]
+            try:
+                return int(float(number) * _ES_DURATION_UNITS[unit])
+            except ValueError:
+                break
+    try:
+        return int(float(text))
+    except ValueError:
+        raise ApiError(400, f"invalid time value: {value!r}")
 
 
 class RestServer:
@@ -1021,6 +1049,8 @@ class RestServer:
             aggs=payload.get("aggs") or payload.get("aggregations"),
             count_hits_exact=track_total is not False,
             search_after=search_after,
+            timeout_millis=_parse_es_duration_millis(
+                payload.get("timeout", params.get("timeout"))),
         )
         request._es_sort_scales = scales  # response-side display scaling
         return request
@@ -1136,9 +1166,9 @@ class RestServer:
                 entry["highlight"] = hit.snippets
             hits.append(entry)
         relation = "eq" if request.count_hits_exact else "gte"
-        return {
+        out = {
             "took": response.elapsed_time_micros // 1000,
-            "timed_out": False,
+            "timed_out": bool(getattr(response, "timed_out", False)),
             "hits": {
                 "total": {"value": response.num_hits, "relation": relation},
                 "max_score": max((h.score for h in response.hits
@@ -1148,6 +1178,24 @@ class RestServer:
             **({"aggregations": response.aggregations}
                if response.aggregations is not None else {}),
         }
+        failed = getattr(response, "failed_splits", None) or []
+        if failed:
+            # `_shards` is additive: emitted only when failures exist, so
+            # fully-successful responses keep their exact historical shape
+            attempted = (getattr(response, "num_attempted_splits", 0)
+                         or len(failed))
+            out["_shards"] = {
+                "total": attempted,
+                "successful": getattr(response, "num_successful_splits", 0),
+                "skipped": 0,
+                "failed": len(failed),
+                "failures": [
+                    {"shard": e.split_id,
+                     "reason": {"type": "split_search_error",
+                                "reason": e.error}}
+                    for e in failed],
+            }
+        return out
 
     def _es_bulk(self, default_index: Optional[str], body: bytes,
                  params: dict[str, Any]) -> dict[str, Any]:

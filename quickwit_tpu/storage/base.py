@@ -18,7 +18,7 @@ from ..common.uri import Protocol, Uri
 class StorageError(IOError):
     def __init__(self, message: str, kind: str = "internal"):
         super().__init__(message)
-        self.kind = kind  # "not_found" | "unauthorized" | "internal" | "timeout"
+        self.kind = kind  # "not_found" | "unauthorized" | "internal" | "timeout" | "deadline"
 
 
 class Storage:

@@ -23,6 +23,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Iterable, Iterator
 
+from ..common.deadline import DEADLINE_ERROR_MARK, current_deadline
 from .base import Storage, StorageError
 
 
@@ -73,13 +74,29 @@ class TimeoutAndRetryStorage(Storage):
         timeouts = list(self.policy.attempt_timeouts(end - start))
         max_attempts = len(timeouts)
         per_attempt_timeout = timeouts[0]
+        # hedge waits never extend past the query's own budget: a read the
+        # caller can no longer use must fail now, not at the policy timeout
+        query_deadline = current_deadline()
         launched, failed = 1, 0
         last_error: Exception | None = None
         launch()
         while True:
+            wait_timeout = per_attempt_timeout
+            if query_deadline is not None and query_deadline.bounded:
+                if query_deadline.expired:
+                    raise StorageError(
+                        f"get_slice {path}[{start}:{end}] "
+                        f"{DEADLINE_ERROR_MARK}", kind="deadline")
+                wait_timeout = min(wait_timeout,
+                                   max(query_deadline.remaining(), 0.001))
             try:
-                ok, value = results.get(timeout=per_attempt_timeout)
+                ok, value = results.get(timeout=wait_timeout)
             except queue.Empty:
+                if query_deadline is not None and query_deadline.expired:
+                    raise StorageError(
+                        f"get_slice {path}[{start}:{end}] "
+                        f"{DEADLINE_ERROR_MARK} after {launched} attempts",
+                        kind="deadline")
                 if launched < max_attempts:
                     launch()  # hedge: race a fresh attempt, keep waiting
                     launched += 1

@@ -1,0 +1,369 @@
+"""Seeded chaos suite for the search path.
+
+Drives the root→leaf→storage stack through injected latency spikes, typed
+errors, hangs, and node loss (quickwit_tpu.common.faults) and asserts the
+robustness invariants the deadline machinery promises:
+
+- no query ever exceeds its deadline + a fixed slack (no hangs);
+- failures always surface as typed partial results (`failed_splits` /
+  `timed_out`), never as silently-dropped splits;
+- identical seeds reproduce identical failure schedules.
+
+Everything here is deterministic and fast (marked `chaos`, runs in tier-1);
+long randomized soak variants belong in `slow`-marked tests."""
+
+import time
+
+import pytest
+
+from quickwit_tpu.common.deadline import (
+    Deadline, DeadlineExceeded, QueryBudget, deadline_scope,
+)
+from quickwit_tpu.common.faults import (
+    FaultInjector, FaultRule, FaultyClient, FaultyStorageResolver,
+    InjectedFault,
+)
+from quickwit_tpu.indexing import IndexingPipeline, PipelineParams, VecSource
+from quickwit_tpu.metastore import FileBackedMetastore
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.models.index_metadata import (
+    IndexConfig, IndexMetadata, SourceConfig,
+)
+from quickwit_tpu.query import parse_query_string
+from quickwit_tpu.search.models import (
+    LeafSearchRequest, SearchRequest, SortField, SplitIdAndFooter,
+)
+from quickwit_tpu.search.root import RootSearcher
+from quickwit_tpu.search.service import (
+    LocalSearchClient, SearcherContext, SearchService,
+)
+from quickwit_tpu.storage import StorageResolver
+
+pytestmark = pytest.mark.chaos
+
+MAPPER = DocMapper(
+    field_mappings=[
+        FieldMapping("ts", FieldType.DATETIME, fast=True,
+                     input_formats=("unix_timestamp",)),
+        FieldMapping("body", FieldType.TEXT),
+        FieldMapping("severity", FieldType.TEXT, tokenizer="raw", fast=True),
+    ],
+    timestamp_field="ts",
+    default_search_fields=("body",),
+)
+
+NUM_DOCS = 600          # 6 splits of 100
+ERROR_DOCS = NUM_DOCS // 2
+# Fixed slack on top of a request deadline: thread joins, partial-response
+# assembly, and CPU-jax dispatch jitter — generous for CI, far below the
+# injected hang durations it must cut off.
+DEADLINE_SLACK_SECS = 1.6
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    """Splits + metastore built ONCE on a clean resolver; each test wraps
+    the read path in its own injector so fault occurrences start from a
+    fresh, reproducible sequence."""
+    resolver = StorageResolver.for_test()
+    metastore = FileBackedMetastore(resolver.resolve("ram:///chaos/ms"))
+    split_uri = "ram:///chaos/splits"
+    config = IndexConfig(index_id="chaos", index_uri=split_uri,
+                         doc_mapper=MAPPER, split_num_docs_target=100)
+    metastore.create_index(IndexMetadata(
+        index_uid="chaos:01", index_config=config,
+        sources={"src": SourceConfig("src", "vec")}))
+    docs = [{"ts": 1_700_000_000 + i,
+             "body": f"event {i} common",
+             "severity": ["INFO", "ERROR"][i % 2]} for i in range(NUM_DOCS)]
+    pipeline = IndexingPipeline(
+        PipelineParams(index_uid="chaos:01", source_id="src",
+                       split_num_docs_target=100, batch_num_docs=50),
+        MAPPER, VecSource(docs), metastore, resolver.resolve(split_uri))
+    pipeline.run_to_completion()
+    return resolver, metastore
+
+
+def build_root(corpus, num_nodes=3, storage_injector=None,
+               client_injector=None, batcher_injector=None,
+               prefetch=False, batch_size=1):
+    """Fresh services/clients per call: no cache state crosses tests or
+    determinism runs."""
+    resolver, metastore = corpus
+    storage_resolver = (FaultyStorageResolver(resolver, storage_injector)
+                        if storage_injector is not None else resolver)
+    clients = {}
+    for i in range(num_nodes):
+        node_id = f"node-{i}"
+        context = SearcherContext(storage_resolver=storage_resolver,
+                                  prefetch=prefetch, batch_size=batch_size)
+        if batcher_injector is not None:
+            context.query_batcher.fault_injector = batcher_injector
+        client = LocalSearchClient(SearchService(context, node_id=node_id))
+        if client_injector is not None:
+            client = FaultyClient(client, client_injector, node_id)
+        clients[node_id] = client
+    return RootSearcher(metastore, clients)
+
+
+def term_request(**kwargs):
+    return SearchRequest(
+        index_ids=["chaos"], query_ast=parse_query_string("severity:ERROR"),
+        sort_fields=(SortField("ts", "desc"),), **kwargs)
+
+
+# --- invariant: failures surface typed, queries still answer ---------------
+
+
+def test_storage_errors_surface_as_typed_partial_results(corpus):
+    # two storage reads error (each killing the split that issued them);
+    # with a single node there is no retry target, so those splits MUST
+    # fail — and every one of them must appear in failed_splits (nothing
+    # silently dropped)
+    injector = FaultInjector(seed=11, rules=[
+        FaultRule("storage.get_slice", "error", every=3, max_fires=2),
+        FaultRule("storage.get_slice", "latency", every=7,
+                  latency_secs=0.01),
+    ])
+    root = build_root(corpus, num_nodes=1, storage_injector=injector)
+    t0 = time.monotonic()
+    response = root.search(term_request(max_hits=5, timeout_millis=20_000))
+    elapsed = time.monotonic() - t0
+    assert elapsed < 20.0 + DEADLINE_SLACK_SECS
+    assert response.failed_splits, "injected storage errors vanished"
+    for failure in response.failed_splits:
+        assert "injected fault" in failure.error
+    # accounting: every split is either successful or reported failed
+    failed_ids = {e.split_id for e in response.failed_splits}
+    assert len(failed_ids) == 2  # one split per fired fault, no more
+    assert response.num_successful_splits + len(failed_ids) == 6
+    # hits from surviving splits only (50 ERROR docs per split)
+    assert response.num_hits == 50 * response.num_successful_splits
+
+
+def test_node_failure_recovered_by_budgeted_retry(corpus):
+    # node-0 drops every leaf request; rendezvous retry lands its splits on
+    # a healthy peer, so the final response is complete and clean
+    injector = FaultInjector(seed=5, rules=[
+        FaultRule("client.leaf_search@node-0", "error"),
+    ])
+    root = build_root(corpus, num_nodes=3, client_injector=injector)
+    response = root.search(term_request(max_hits=10))
+    assert response.num_hits == ERROR_DOCS
+    assert not response.failed_splits
+    assert not response.timed_out
+    assert len(response.hits) == 10
+
+
+def test_all_nodes_down_is_a_typed_error_not_a_hang(corpus):
+    injector = FaultInjector(seed=5, rules=[
+        FaultRule("client.leaf_search@*", "error"),
+    ])
+    root = build_root(corpus, num_nodes=2, client_injector=injector)
+    t0 = time.monotonic()
+    with pytest.raises(ValueError, match="injected fault|failed"):
+        root.search(term_request(max_hits=0, timeout_millis=20_000))
+    assert time.monotonic() - t0 < 20.0 + DEADLINE_SLACK_SECS
+
+
+def test_batcher_fault_fans_typed_errors_no_hang(corpus):
+    # the convoy batcher's dispatch blows up on every 2nd dispatch: affected
+    # riders get typed errors (surfacing as failed splits), others succeed
+    injector = FaultInjector(seed=3, rules=[
+        FaultRule("batcher.dispatch", "error", every=2),
+    ])
+    root = build_root(corpus, num_nodes=1, batcher_injector=injector)
+    t0 = time.monotonic()
+    response = root.search(term_request(max_hits=3, timeout_millis=20_000))
+    assert time.monotonic() - t0 < 20.0 + DEADLINE_SLACK_SECS
+    assert len(response.failed_splits) == 3  # dispatches 2, 4, 6 of 6
+    for failure in response.failed_splits:
+        assert "injected fault" in failure.error
+    assert response.num_hits == 50 * 3
+
+
+# --- invariant: deadline + slack, never a hang -----------------------------
+
+
+def test_leaf_hang_cut_off_at_deadline(corpus):
+    # every leaf RPC stalls 3s; the query budget is 0.4s — the root must
+    # answer within deadline + slack with a timed_out partial response
+    injector = FaultInjector(seed=21, rules=[
+        FaultRule("client.leaf_search@*", "hang", hang_secs=3.0),
+    ])
+    root = build_root(corpus, num_nodes=3, client_injector=injector)
+    t0 = time.monotonic()
+    response = root.search(term_request(max_hits=5, timeout_millis=400))
+    elapsed = time.monotonic() - t0
+    assert elapsed < 0.4 + DEADLINE_SLACK_SECS
+    assert response.timed_out
+    assert response.failed_splits
+    for failure in response.failed_splits:
+        assert "deadline exceeded" in failure.error
+    # the ES/native wire shape carries the verdict
+    assert response.to_dict()["timed_out"] is True
+
+
+def test_expired_budget_sheds_instead_of_searching(corpus):
+    # a budget that expires before the fan-out even starts: every split is
+    # shed with a typed deadline error, fast
+    root = build_root(corpus, num_nodes=2)
+    t0 = time.monotonic()
+    response = root.search(term_request(max_hits=5, timeout_millis=1))
+    elapsed = time.monotonic() - t0
+    assert elapsed < DEADLINE_SLACK_SECS
+    assert response.timed_out
+    assert response.num_hits == 0
+    assert len({e.split_id for e in response.failed_splits}) == 6
+    for failure in response.failed_splits:
+        assert "deadline exceeded" in failure.error
+
+
+def test_storage_hang_cut_off_at_deadline(corpus):
+    # slow storage (0.5s per read) against a 0.3s budget: reads are cut off
+    # by the ambient deadline inside the leaf, the root answers on time
+    injector = FaultInjector(seed=8, rules=[
+        FaultRule("storage.get_slice", "hang", hang_secs=0.5),
+    ])
+    root = build_root(corpus, num_nodes=1, storage_injector=injector)
+    t0 = time.monotonic()
+    response = root.search(term_request(max_hits=5, timeout_millis=300))
+    elapsed = time.monotonic() - t0
+    assert elapsed < 0.3 + DEADLINE_SLACK_SECS
+    assert response.timed_out
+    assert response.failed_splits
+
+
+# --- invariant: same seed, same schedule -----------------------------------
+
+
+def test_same_seed_reproduces_schedule_and_failures(corpus):
+    rules = [
+        FaultRule("storage.get_slice", "error", probability=0.2),
+        FaultRule("storage.get_slice", "latency", probability=0.3,
+                  latency_secs=0.002),
+    ]
+
+    def outcome(root, request):
+        try:
+            r = root.search(request)
+            return (r.num_hits, sorted(e.split_id for e in r.failed_splits))
+        except ValueError as exc:  # all splits failed — also reproducible
+            return ("all-failed", str(exc))
+
+    def run():
+        injector = FaultInjector(seed=1234, rules=rules)
+        root = build_root(corpus, num_nodes=1, storage_injector=injector)
+        outcomes = [
+            outcome(root, term_request(max_hits=5, timeout_millis=30_000)),
+            outcome(root, SearchRequest(
+                index_ids=["chaos"],
+                query_ast=parse_query_string("common", ["body"]),
+                max_hits=0, timeout_millis=30_000,
+                aggs={"sev": {"terms": {"field": "severity"}}})),
+        ]
+        return injector.schedule(), outcomes
+
+    schedule_a, outcomes_a = run()
+    schedule_b, outcomes_b = run()
+    assert schedule_a == schedule_b
+    assert outcomes_a == outcomes_b
+    assert schedule_a, "seeded rules never fired — the run tested nothing"
+
+
+def test_decisions_immune_to_cross_operation_interleaving():
+    # the same per-operation call sequences must see the same decisions no
+    # matter how calls to DIFFERENT operations interleave (thread timing)
+    rules = [FaultRule("op.*", "error", probability=0.5)]
+
+    def decisions(order):
+        injector = FaultInjector(seed=99, rules=rules)
+        for op in order:
+            try:
+                injector.perturb(op)
+            except InjectedFault:
+                pass
+        return injector.schedule()
+
+    interleaved = decisions(["op.a", "op.b", "op.a", "op.b", "op.a", "op.b"])
+    grouped = decisions(["op.a", "op.a", "op.a", "op.b", "op.b", "op.b"])
+    assert interleaved == grouped
+
+
+# --- satellite regression: no silently-dropped split failures --------------
+
+
+def _leaf_request_for(splits):
+    return LeafSearchRequest(
+        search_request=term_request(max_hits=3),
+        index_uid="chaos:01", doc_mapping=MAPPER.to_dict(),
+        splits=[SplitIdAndFooter(split_id=s, storage_uri="ram:///chaos/splits")
+                for s in splits])
+
+
+class _DeadClient:
+    def leaf_search(self, request):
+        raise RuntimeError("node unreachable")
+
+
+def test_no_retry_node_still_reports_failed_splits(corpus):
+    # single node, node dead, nowhere to retry: the response MUST carry a
+    # SplitSearchError per split (this used to return failed_splits=[])
+    _, metastore = corpus
+    root = RootSearcher(metastore, {"node-0": _DeadClient()})
+    leaf_request = _leaf_request_for(["s1", "s2", "s3"])
+    response = root._leaf_search_with_retry(leaf_request, "node-0",
+                                            ["node-0"])
+    assert sorted(e.split_id for e in response.failed_splits) == \
+        ["s1", "s2", "s3"]
+    assert response.num_attempted_splits == 3
+    for failure in response.failed_splits:
+        assert "node unreachable" in failure.error
+
+
+def test_failed_retry_still_reports_failed_splits(corpus):
+    # both the primary and the retry node throw: failures must surface with
+    # the retry error (this used to return an EMPTY LeafSearchResponse)
+    _, metastore = corpus
+    root = RootSearcher(metastore, {"node-0": _DeadClient(),
+                                    "node-1": _DeadClient()})
+    leaf_request = _leaf_request_for(["s1", "s2"])
+    response = root._leaf_search_with_retry(leaf_request, "node-0",
+                                            ["node-0", "node-1"])
+    assert sorted(e.split_id for e in response.failed_splits) == ["s1", "s2"]
+    assert response.num_attempted_splits == 2
+    for failure in response.failed_splits:
+        assert "retry on node-1 failed" in failure.error
+
+
+# --- budget mechanics ------------------------------------------------------
+
+
+def test_query_budget_retry_pool_and_backoff():
+    budget = QueryBudget(Deadline.after(60.0), max_retries=2)
+    assert budget.try_acquire_retry() == 0
+    assert budget.try_acquire_retry() == 1
+    assert budget.try_acquire_retry() is None  # pool drained
+    assert budget.backoff_secs(0) == 0.0       # first retry is immediate
+    assert budget.backoff_secs(1) == pytest.approx(0.05)
+    assert budget.backoff_secs(2) == pytest.approx(0.10)
+    assert budget.backoff_secs(100) == QueryBudget.BACKOFF_CAP_SECS
+    # backoff never exceeds the remaining deadline
+    tight = QueryBudget(Deadline.after(0.01))
+    assert tight.backoff_secs(100) <= 0.01
+    # an expired deadline grants no retries at all
+    expired = QueryBudget(Deadline.after(0.0))
+    assert expired.try_acquire_retry() is None
+
+
+def test_deadline_scope_propagates_and_clamps():
+    with deadline_scope(Deadline.after(5.0)) as deadline:
+        assert deadline.clamp(60.0) <= 5.0
+        assert deadline.clamp(1.0) == 1.0
+        assert deadline.timeout_millis() <= 5_000
+    unbounded = Deadline.never()
+    assert unbounded.clamp(None) is None
+    assert unbounded.timeout_millis() is None
+    assert not unbounded.expired
+    with pytest.raises(DeadlineExceeded, match="deadline exceeded"):
+        Deadline.after(0.0).check("unit")

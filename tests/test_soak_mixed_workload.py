@@ -10,6 +10,7 @@ deadlocks (bounded wall-clock), no dropped ingest."""
 import http.client
 import json
 import threading
+import time
 
 import pytest
 
@@ -18,6 +19,12 @@ from quickwit_tpu.storage import StorageResolver
 
 THREADS = 8
 ROUNDS = 12
+
+
+def _percentile(sorted_values, q):
+    assert sorted_values
+    idx = min(len(sorted_values) - 1, int(q * (len(sorted_values) - 1)))
+    return sorted_values[idx]
 
 
 @pytest.fixture()
@@ -47,7 +54,7 @@ def api():
     node.ingest("soak", [
         {"ts": 1000 + i, "sev": ["a", "b"][i % 2], "num": float(i),
          "body": f"seed{i} common"} for i in range(50)], commit="force")
-    yield server.port
+    yield server.port, node
     server.stop()
 
 
@@ -61,10 +68,20 @@ def _call(port, method, path, body=None):
 
 
 def test_concurrent_mixed_workload(api):
-    port = api
+    port, node = api
+    batcher = node.searcher_context.query_batcher
+    queries_before = batcher.num_queries
+    dispatches_before = batcher.num_dispatches
     errors: list[str] = []
     ingested = [0] * THREADS
+    latencies: list[float] = []  # list.append is GIL-atomic
     barrier = threading.Barrier(THREADS)
+
+    def timed_call(method, path, body=None):
+        t0 = time.monotonic()
+        result = _call(port, method, path, body)
+        latencies.append(time.monotonic() - t0)
+        return result
 
     def worker(worker_id: int) -> None:
         try:
@@ -72,14 +89,14 @@ def test_concurrent_mixed_workload(api):
             for round_no in range(ROUNDS):
                 kind = (worker_id + round_no) % 4
                 if kind == 0:      # plain search
-                    status, data = _call(
-                        port, "GET",
+                    status, data = timed_call(
+                        "GET",
                         "/api/v1/soak/search?query=common&max_hits=5")
                     assert status == 200, data[:200]
                     assert json.loads(data)["num_hits"] >= 50
                 elif kind == 1:    # aggregation (same-shape: convoy)
-                    status, data = _call(
-                        port, "POST", "/api/v1/_elastic/soak/_search",
+                    status, data = timed_call(
+                        "POST", "/api/v1/_elastic/soak/_search",
                         json.dumps({
                             "query": {"match": {"body": "common"}},
                             "size": 0,
@@ -91,8 +108,8 @@ def test_concurrent_mixed_workload(api):
                         "per_sev"]["buckets"]
                     assert sum(b["doc_count"] for b in buckets) >= 50
                 elif kind == 2:    # SQL
-                    status, data = _call(
-                        port, "POST", "/api/v1/_sql", json.dumps({
+                    status, data = timed_call(
+                        "POST", "/api/v1/_sql", json.dumps({
                             "query": "SELECT sev, COUNT(*) AS n "
                                      "FROM soak GROUP BY sev"}).encode())
                     assert status == 200, data[:200]
@@ -102,8 +119,8 @@ def test_concurrent_mixed_workload(api):
                          "sev": "c", "num": 1.0,
                          "body": f"w{worker_id}r{round_no} common"})
                         for _ in range(2))
-                    status, data = _call(
-                        port, "POST",
+                    status, data = timed_call(
+                        "POST",
                         "/api/v1/soak/ingest?commit=force",
                         docs.encode())
                     assert status == 200, data[:200]
@@ -120,8 +137,73 @@ def test_concurrent_mixed_workload(api):
     assert not any(w.is_alive() for w in workers), "soak deadlocked"
     assert not errors, errors
 
+    # latency tail: every request bounded, no hidden per-request hang
+    ordered = sorted(latencies)
+    p50, p99 = _percentile(ordered, 0.50), _percentile(ordered, 0.99)
+    print(f"\nsoak latency over {len(ordered)} requests: "
+          f"p50={p50 * 1000:.1f}ms p99={p99 * 1000:.1f}ms")
+    assert p99 < 30.0, f"p99 latency {p99:.1f}s — a request nearly hung"
+
+    # convoy accounting stays sane under the storm (strict coalescing is
+    # asserted by the dedicated burst test below)
+    query_delta = batcher.num_queries - queries_before
+    dispatch_delta = batcher.num_dispatches - dispatches_before
+    print(f"convoy batcher: {query_delta} queries -> "
+          f"{dispatch_delta} dispatches")
+    assert dispatch_delta <= query_delta
+
     # every ingested doc is searchable afterwards (nothing dropped)
     status, data = _call(
         port, "GET", "/api/v1/soak/search?query=common&max_hits=0")
     assert status == 200
     assert json.loads(data)["num_hits"] == 50 + sum(ingested)
+
+
+def test_convoy_batcher_coalesces_concurrent_burst(api):
+    """Same-shape queries arriving together must share device dispatches.
+
+    32 range queries differ ONLY in their (traced-scalar) lower bound, so
+    they share one compiled plan but miss the leaf cache individually; with
+    the corpus still a single split, each rides the convoy batcher — the
+    burst must finish in strictly fewer dispatches than queries."""
+    port, node = api
+    batcher = node.searcher_context.query_batcher
+    queries_before = batcher.num_queries
+    dispatches_before = batcher.num_dispatches
+    errors: list[str] = []
+    barrier = threading.Barrier(THREADS)
+    per_thread = 4
+
+    def worker(worker_id: int) -> None:
+        try:
+            barrier.wait(timeout=30)
+            for i in range(per_thread):
+                lo = worker_id * per_thread + i  # 0..31, all distinct
+                status, data = _call(
+                    port, "POST", "/api/v1/_elastic/soak/_search",
+                    json.dumps({
+                        "query": {"range": {"num": {"gte": lo,
+                                                    "lte": 49.0}}},
+                        "size": 1}).encode())
+                assert status == 200, data[:200]
+                assert json.loads(data)["hits"]["total"]["value"] == 50 - lo
+        except Exception as exc:  # noqa: BLE001 - collected for report
+            errors.append(f"worker {worker_id}: {exc!r}")
+
+    workers = [threading.Thread(target=worker, args=(i,))
+               for i in range(THREADS)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join(timeout=120)
+    assert not any(w.is_alive() for w in workers), "burst deadlocked"
+    assert not errors, errors
+
+    query_delta = batcher.num_queries - queries_before
+    dispatch_delta = batcher.num_dispatches - dispatches_before
+    print(f"\nburst: {query_delta} batcher queries -> "
+          f"{dispatch_delta} dispatches")
+    assert query_delta == THREADS * per_thread, \
+        "burst queries bypassed the batcher (cache hit or fast path?)"
+    assert dispatch_delta < query_delta, \
+        "concurrent same-shape queries never coalesced into a batch"
