@@ -608,6 +608,73 @@ def _measure_batch_otel(iters: int, full: bool = True) -> dict:
     return stats
 
 
+def _measure_pruning(iters: int) -> dict:
+    """Config #6: dynamic top-K split pruning (search/pruning.py) over a
+    time-partitioned index — N disjoint-window splits, term query sorted by
+    timestamp desc. Measures the leaf latency with pruning on vs off (leaf
+    cache disabled so every iteration really executes) and reports the new
+    pruning counters: splits skipped by the threshold, splits downgraded to
+    count-only when exact counts are required."""
+    from quickwit_tpu.index.synthetic import HDFS_MAPPER, synthetic_hdfs_split
+    from quickwit_tpu.query.ast import Term
+    from quickwit_tpu.search.models import (
+        LeafSearchRequest, SearchRequest, SortField, SplitIdAndFooter)
+    from quickwit_tpu.search.service import SearcherContext, SearchService
+    from quickwit_tpu.storage import StorageResolver
+
+    n_splits = int(os.environ.get("BENCH_PRUNE_SPLITS", 16))
+    docs_per = int(os.environ.get("BENCH_PRUNE_DOCS", 65_536))
+    resolver = StorageResolver.for_test()
+    storage = resolver.resolve("ram:///bench-prune")
+    day = 86_400
+    offsets = []
+    for s in range(n_splits):
+        start = 1_600_000_000 + s * day
+        storage.put(f"p{s}.split", synthetic_hdfs_split(
+            docs_per, seed=100 + s, start_ts=start, span_seconds=day))
+        offsets.append(SplitIdAndFooter(
+            split_id=f"p{s}", storage_uri="ram:///bench-prune",
+            num_docs=docs_per,
+            time_range=(start * 1_000_000, (start + day) * 1_000_000)))
+
+    def run(pruning, exact):
+        service = SearchService(SearcherContext(
+            storage_resolver=resolver, batch_size=1, prefetch=False,
+            leaf_cache_bytes=0, enable_threshold_pruning=pruning))
+        request = LeafSearchRequest(
+            search_request=SearchRequest(
+                index_ids=["hdfs-logs"],
+                query_ast=Term("severity_text", "ERROR"), max_hits=10,
+                sort_fields=(SortField("timestamp", "desc"),),
+                count_hits_exact=exact),
+            index_uid="bench:prune", doc_mapping=HDFS_MAPPER.to_dict(),
+            splits=offsets)
+        service.leaf_search(request)  # warm readers + compile
+        lat = []
+        response = None
+        for _ in range(iters):
+            t0 = time.monotonic()
+            response = service.leaf_search(request)
+            lat.append(time.monotonic() - t0)
+        return response, _percentile(lat, 0.5) * 1000
+
+    resp_on, on_ms = run(pruning=True, exact=False)
+    resp_off, off_ms = run(pruning=False, exact=False)
+    resp_count, count_ms = run(pruning=True, exact=True)
+    return {
+        "n_splits": n_splits, "docs_per_split": docs_per,
+        "e2e_ms": round(on_ms, 2),           # pruned leaf, the real path
+        "unpruned_ms": round(off_ms, 2),
+        "pruning_speedup": round(off_ms / max(on_ms, 1e-9), 2),
+        "splits_pruned_by_threshold": int(
+            resp_on.resource_stats.get("num_splits_pruned_by_threshold", 0)),
+        "exact_count_ms": round(count_ms, 2),
+        "splits_downgraded_to_count": int(
+            resp_count.resource_stats.get(
+                "num_splits_downgraded_to_count", 0)),
+    }
+
+
 def _run_all(iters: int, with_device_loops: bool = True) -> dict:
     results: dict = {}
     workloads = _workloads()
@@ -624,6 +691,10 @@ def _run_all(iters: int, with_device_loops: bool = True) -> dict:
         max(3, iters // 3), full=with_device_loops)
     print(f"# c5_otel_percentiles: "
           f"{json.dumps(results['c5_otel_percentiles'])}", file=sys.stderr)
+    if with_device_loops:  # parent run only: the child has no use for it
+        results["c6_split_pruning"] = _measure_pruning(max(3, iters // 3))
+        print(f"# c6_split_pruning: "
+              f"{json.dumps(results['c6_split_pruning'])}", file=sys.stderr)
     return results
 
 

@@ -220,6 +220,45 @@ class FaultyStorageResolver:
         return getattr(self._inner, name)
 
 
+class FaultyMetastore:
+    """Metastore wrapper perturbing the root's plan-time reads
+    (``metastore.list_splits``, ``metastore.index_metadata``).
+
+    Error-kind faults surface as `MetastoreError` (kind="internal") — the
+    typed failure the root's planning path owns; latency/hang faults model a
+    slow metastore backend, which the root must absorb into its deadline and
+    still answer with a typed partial response. Mutations pass through
+    unperturbed (a faulty publish would corrupt fixture setup)."""
+
+    def __init__(self, inner, injector: FaultInjector,
+                 op_prefix: str = "metastore"):
+        self._inner = inner
+        self._injector = injector
+        self._op_prefix = op_prefix
+
+    def _perturb(self, method: str) -> None:
+        from ..metastore.base import MetastoreError
+        try:
+            self._injector.perturb(f"{self._op_prefix}.{method}")
+        except InjectedFault as exc:
+            raise MetastoreError(str(exc), kind="internal") from exc
+
+    def list_splits(self, query):
+        self._perturb("list_splits")
+        return self._inner.list_splits(query)
+
+    def index_metadata(self, index_id: str):
+        self._perturb("index_metadata")
+        return self._inner.index_metadata(index_id)
+
+    def list_indexes(self):
+        self._perturb("list_indexes")
+        return self._inner.list_indexes()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
 class FaultyClient:
     """Leaf-search client wrapper perturbing RPCs to one node.
 

@@ -91,6 +91,7 @@ class SplitReader:
         self.footer: SplitFooter = read_footer(self._get_slice, self.file_len, footer_hint)
         self._term_dicts: dict[str, _TermDict] = {}
         self._arrays: dict[str, np.ndarray] = {}
+        self._term_stats: dict[tuple[str, str], tuple[int, int]] = {}
 
     # --- IO ----------------------------------------------------------------
     def _get_slice(self, start: int, end: int) -> bytes:
@@ -213,3 +214,25 @@ class SplitReader:
 
     def field_meta(self, field: str) -> dict[str, Any]:
         return self.footer.fields.get(field, {})
+
+    def term_stats(self, field: str, term: str) -> tuple[int, int]:
+        """(df, max_tf) of one term — the inputs of the BM25 per-split score
+        upper bound (search/pruning.py). Absent term → (0, 0). Served from
+        the persisted `terms.max_tf` footer array when present (one 4-byte
+        ranged read); older splits without it fall back to scanning the
+        term's padded tf slice (pads are 0, so the max is unaffected)."""
+        cached = self._term_stats.get((field, term))
+        if cached is not None:
+            return cached
+        info = self.lookup_term(field, term)
+        if info is None:
+            stats = (0, 0)
+        elif self.has_array(f"inv.{field}.terms.max_tf"):
+            max_tf = self.array_slice(f"inv.{field}.terms.max_tf",
+                                      info.ordinal, 1)
+            stats = (info.df, int(max_tf[0]))
+        else:
+            _ids, tfs = self.postings(field, info)
+            stats = (info.df, int(tfs.max()) if tfs.size else 0)
+        self._term_stats[(field, term)] = stats
+        return stats

@@ -386,6 +386,15 @@ class SplitWriter:
                         inv: Any, num_docs_padded: int) -> dict[str, Any]:
         if isinstance(inv, _NativeInvertedFieldBuilder):
             arrays = inv.finish(num_docs_padded)
+            # per-term max tf: the BM25 score upper bound's input
+            # (search/pruning.py). reduceat over the padded tf arena —
+            # pads are 0 and every segment holds >= 1 real posting
+            if len(arrays["terms.df"]):
+                arrays["terms.max_tf"] = np.maximum.reduceat(
+                    arrays["postings.tfs"],
+                    arrays["terms.post_off"]).astype(np.int32)
+            else:
+                arrays["terms.max_tf"] = np.zeros(0, dtype=np.int32)
             for suffix, arr in arrays.items():
                 builder.add_array(f"inv.{name}.{suffix}", arr)
             num_terms = len(arrays["terms.df"])
@@ -406,6 +415,7 @@ class SplitWriter:
         dfs = np.zeros(num_terms, dtype=np.int32)
         post_offs = np.zeros(num_terms, dtype=np.int64)
         post_lens = np.zeros(num_terms, dtype=np.int32)
+        max_tfs = np.zeros(num_terms, dtype=np.int32)
 
         total_padded = sum(pad_to(len(inv.terms[t][0]), POSTING_PAD) for t in terms_sorted)
         ids_arena = np.full(total_padded, num_docs_padded, dtype=np.int32)
@@ -429,6 +439,7 @@ class SplitWriter:
             post_lens[t_idx] = padded
             ids_arena[cursor:cursor + df] = ids
             tfs_arena[cursor:cursor + df] = tfs
+            max_tfs[t_idx] = max(tfs) if df else 0
             if pos_offsets is not None:
                 for i, doc_positions in enumerate(poss):
                     pos_offsets[cursor + i] = pos_cursor
@@ -443,6 +454,7 @@ class SplitWriter:
         builder.add_array(f"inv.{name}.terms.df", dfs)
         builder.add_array(f"inv.{name}.terms.post_off", post_offs)
         builder.add_array(f"inv.{name}.terms.post_len", post_lens)
+        builder.add_array(f"inv.{name}.terms.max_tf", max_tfs)
         builder.add_array(f"inv.{name}.postings.ids", ids_arena)
         builder.add_array(f"inv.{name}.postings.tfs", tfs_arena)
         if pos_offsets is not None:

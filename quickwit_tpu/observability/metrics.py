@@ -189,3 +189,20 @@ SEARCH_TIMED_OUT_TOTAL = METRICS.counter(
 SEARCH_LEAF_RETRIES_TOTAL = METRICS.counter(
     "qw_search_leaf_retries_total",
     "Leaf requests retried on another node after a failure")
+
+# --- dynamic top-K split pruning (search/pruning.py) ----------------------
+# Splits never executed because their sort-value/score upper bound could
+# not beat the collector's Kth value (count_hits_exact=False).
+SEARCH_SPLITS_PRUNED_TOTAL = METRICS.counter(
+    "qw_search_splits_pruned_by_threshold_total",
+    "Splits skipped because their sort bound cannot beat the top-K threshold")
+# Splits that could not contribute hits but still owed an exact count:
+# re-executed as count-only requests (max_hits=0 fast path).
+SEARCH_SPLITS_DOWNGRADED_TOTAL = METRICS.counter(
+    "qw_search_splits_downgraded_to_count_total",
+    "Splits downgraded to count-only requests by the top-K threshold")
+# Kernel dispatches that carried a threshold scalar (sub-threshold docs
+# masked before top_k); batch dispatches count each real lane.
+SEARCH_KERNEL_THRESHOLD_TOTAL = METRICS.counter(
+    "qw_search_kernel_threshold_pushdown_total",
+    "Plan executions dispatched with a pushed-down top-K threshold scalar")

@@ -50,6 +50,21 @@ def exact_topk(x: jnp.ndarray, k: int):
     return lax.top_k(x, k)
 
 
+def apply_threshold_mask(keyed: jnp.ndarray, threshold) -> jnp.ndarray:
+    """Dynamic top-K pruning mask: docs whose internal higher-is-better key
+    is STRICTLY below `threshold` (a traced f64 scalar — the collector's
+    current Kth sort value) become -inf so `lax.top_k` never surfaces them
+    and the packed readback carries fewer live hits.
+
+    `>=` keeps threshold-tying docs: a tie on the primary key can still win
+    the (sort_value2, split_id, doc_id) tie-break at the collector, so
+    masking them would change results. Non-matching docs are already -inf
+    and stay -inf; when threshold == MISSING_VALUE_SENTINEL every matching
+    doc (including missing-value docs AT the sentinel) survives.
+    """
+    return jnp.where(keyed >= threshold, keyed, NEG_INF)
+
+
 def exact_topk_2key(key1: jnp.ndarray, key2: jnp.ndarray, k: int):
     """Exact lexicographic top-k by (key1, key2) descending, index-ascending
     tie-break — the two-sort-field variant of `exact_topk`, built on

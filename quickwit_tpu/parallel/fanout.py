@@ -166,9 +166,15 @@ def _pad_fill(key: str, num_docs_padded: int):
 def build_batch(request: SearchRequest, doc_mapper: DocMapper,
                 readers: list[SplitReader], split_ids: list[str],
                 pad_to_splits: Optional[int] = None,
-                absence_sink=None) -> SplitBatch:
+                absence_sink=None,
+                sort_value_threshold: Optional[float] = None) -> SplitBatch:
     """`absence_sink(split_id, field, term)`: term-dictionary misses found
-    during lowering, fed to the predicate/negative cache."""
+    during lowering, fed to the predicate/negative cache.
+
+    `sort_value_threshold` is the batch-wide dynamic top-K threshold
+    (internal encoding): the same value is lowered into every lane's plan,
+    so slot layouts stay uniform and the pushdown rides the existing
+    stacked-scalar machinery."""
     agg_specs = parse_aggs(request.aggs) if request.aggs else []
     overrides = _global_agg_overrides(agg_specs, readers, doc_mapper)
     sort = request.sort_fields[0] if request.sort_fields else None
@@ -189,6 +195,7 @@ def build_batch(request: SearchRequest, doc_mapper: DocMapper,
             batch_overrides=overrides,
             absence_sink=(None if absence_sink is None else
                           lambda f, t, s=split_id: absence_sink(s, f, t)),
+            sort_value_threshold=sort_value_threshold,
         )
         plans.append(plan)
     sigs = {p.root.sig() + p.sort.sig() + ",".join(a.sig() for a in p.aggs)
@@ -387,6 +394,11 @@ def execute_batch(batch: SplitBatch, request: SearchRequest,
     # k=0 (count/agg-only): per-split executors skip keying/top-k and the
     # batch merge skips the cross-split top_k
     k = min(request.start_offset + request.max_hits, batch.num_docs_padded)
+    if batch.template.threshold_slot >= 0:
+        from ..observability.metrics import SEARCH_KERNEL_THRESHOLD_TOTAL
+        # one dispatch, but each real lane's docs are threshold-masked
+        SEARCH_KERNEL_THRESHOLD_TOTAL.inc(
+            sum(1 for s in batch.split_ids if s))
     arrays, scalars, nd = stage_device_inputs(batch, mesh)
     # Mesh is hashable; id() would go stale if a dead mesh's address is reused
     key = (batch.template.signature(k), batch.n_splits,

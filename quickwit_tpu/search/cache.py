@@ -23,6 +23,17 @@ def canonical_request_key(
     request: SearchRequest,
     split_time_range: Optional[tuple[int, int]] = None,
 ) -> str:
+    """Split-local cache key: the request's result-affecting fields plus the
+    time filter REBASED against the split's own time range (a bound the
+    split can't exceed hashes as absent, so differently-bounded requests
+    share entries when the split can't tell them apart).
+
+    Threshold-pruning downgrade audit (search/pruning.downgrade_to_count):
+    a count-only downgrade of a top-K request MUST NOT alias the full
+    request's entry — and cannot, because the downgrade changes at least
+    `max_hits + start_offset` (→ 0) and the normalized `sort` (→ _doc asc),
+    both hashed below. Threshold-pushdown responses themselves are never
+    cached (their hit lists are truncated); see _execute_per_split."""
     start, end = request.start_timestamp, request.end_timestamp
     if split_time_range is not None:
         lo, hi = split_time_range

@@ -154,6 +154,29 @@ class IncrementalCollector:
         self._hits.sort(key=self._order_key)
         return self._hits[self.start_offset: self.start_offset + self.max_hits]
 
+    def sort_value_threshold(self) -> Optional[float]:
+        """Current Kth internal sort value (higher-is-better), or None when
+        the top-K window is not yet full — the dynamic-pruning threshold
+        (reference: `CanSplitDoBetter`, leaf.rs:1279).
+
+        A pending split whose best achievable internal key is STRICTLY below
+        this value cannot displace any collected hit: an equal primary key
+        could still win on the (sort_value2, split_id, doc_id) tie-break, so
+        callers must prune on `best < threshold`, never `<=`. Not meaningful
+        for text sorts (split-local ordinals aren't comparable to time
+        ranges or score bounds) — returns None there.
+        """
+        if self.string_sort is not None or self.max_hits <= 0:
+            return None
+        keep = self.start_offset + self.max_hits
+        if len(self._hits) < keep:
+            return None
+        self._hits.sort(key=self._order_key)
+        window = self._hits[self.start_offset: keep]
+        if len(window) < self.max_hits:
+            return None
+        return window[-1].sort_value
+
     def to_leaf_response(self) -> LeafSearchResponse:
         """Re-emit as a leaf response (for tree-merging at the node level)."""
         self._hits.sort(key=self._order_key)

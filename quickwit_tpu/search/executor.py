@@ -18,6 +18,7 @@ queries with the same shape reuse one compilation.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Any, Callable
 
 import jax
@@ -38,8 +39,10 @@ _JIT_CACHE: dict[tuple, Callable] = {}
 
 # device-resident scalar tuples keyed by (plan signature, values): repeated
 # queries skip the host->device scalar upload entirely — under a remote
-# tunnel every upload RTT would otherwise double the steady-state latency
-_SCALAR_CACHE: dict[tuple, Any] = {}
+# tunnel every upload RTT would otherwise double the steady-state latency.
+# LRU (move-to-end on hit), matching the other caches: a hot scalar tuple
+# re-used every query must not be evicted just because it was inserted first.
+_SCALAR_CACHE: "OrderedDict[tuple, Any]" = OrderedDict()
 _SCALAR_CACHE_CAP = 512
 
 
@@ -54,8 +57,10 @@ def _device_scalars(plan: LoweredPlan) -> tuple[Any, Any]:
         moved = jax.device_put(list(plan.scalars) + [np.int32(plan.num_docs)])
         cached = (tuple(moved[:-1]), moved[-1])
         if len(_SCALAR_CACHE) >= _SCALAR_CACHE_CAP:
-            _SCALAR_CACHE.pop(next(iter(_SCALAR_CACHE)))
+            _SCALAR_CACHE.popitem(last=False)
         _SCALAR_CACHE[key] = cached
+    else:
+        _SCALAR_CACHE.move_to_end(key)
     return cached
 
 
@@ -363,7 +368,8 @@ def _build_posting_space(plan: LoweredPlan, k: int) -> Callable:
                     count, tuple(agg_out))
         from ..ops.pallas import fused_score_topk, pallas_available
         if (sort.by == "score" and sort.by2 == "none" and root.scoring
-                and pallas_available() and k <= 64):
+                and pallas_available() and k <= 64
+                and plan.threshold_slot < 0):
             # QW_PALLAS=1: fused scoring + phase-1 top-k — the dense [P]
             # scores array never materializes; hit scores come straight from
             # the kernel's winners
@@ -389,6 +395,11 @@ def _build_posting_space(plan: LoweredPlan, k: int) -> Callable:
         # "doc" sorts key on the posting's doc id (ascending already)
         keyed = _keyed_for(sort.by, sort.descending, sort.values_slot,
                            sort.present_slot, gathered, valid, scores, ids)
+        if plan.threshold_slot >= 0:
+            # dynamic pruning pushdown: counts/aggs above keep full-query
+            # semantics; only top-k eligibility is restricted
+            keyed = topk_ops.apply_threshold_mask(
+                keyed, scalars[plan.threshold_slot])
         kk = min(k, num_postings)
         if sort.by2 == "none":
             sort_vals, pos = topk_ops.exact_topk(keyed, kk)
@@ -397,6 +408,8 @@ def _build_posting_space(plan: LoweredPlan, k: int) -> Callable:
             keyed2 = _keyed_for(sort.by2, sort.descending2, sort.values2_slot,
                                 sort.present2_slot, gathered, valid, scores,
                                 ids)
+            if plan.threshold_slot >= 0:
+                keyed2 = jnp.where(jnp.isneginf(keyed), -jnp.inf, keyed2)
             sort_vals, sort_vals2, pos = topk_ops.exact_topk_2key(
                 keyed, keyed2, kk)
         doc_ids = ids[pos]
@@ -664,6 +677,12 @@ def _build(plan: LoweredPlan, k: int) -> Callable:
         if plan.search_after_relation != "none":
             keyed, keyed2 = _apply_search_after(plan, keyed, keyed2, scalars,
                                                 padded)
+        if plan.threshold_slot >= 0:
+            # dynamic-pruning threshold: same eligibility-only contract
+            keyed = topk_ops.apply_threshold_mask(
+                keyed, scalars[plan.threshold_slot])
+            if keyed2 is not None:
+                keyed2 = jnp.where(jnp.isneginf(keyed), -jnp.inf, keyed2)
         if keyed2 is None:
             sort_vals, doc_ids = topk_ops.exact_topk(keyed, k)
             sort_vals2 = None

@@ -105,12 +105,18 @@ def prepare_plan_only(
     reader: SplitReader,
     split_id: str,
     absence_sink=None,
+    sort_value_threshold: Optional[float] = None,
 ):
     """Stage 1a: storage byte-range IO + plan lowering WITHOUT the device
     transfer. The service's per-split path defers H2D to the execute
     stage so each split's admit→transfer→execute→release cycle runs
     alone — a whole group admitted up front could exceed the budget and
-    starve itself."""
+    starve itself.
+
+    `sort_value_threshold` (internal higher-is-better key) is pushed into
+    the plan as a traced scalar masking sub-threshold docs before top_k
+    (search/pruning.py); the plan signature only encodes its PRESENCE, so
+    compiled executables are reused across threshold values."""
     agg_specs = parse_aggs(request.aggs) if request.aggs else []
     sort = request.sort_fields[0] if request.sort_fields else None
     sort_field = sort.field if sort else "_score"
@@ -128,6 +134,7 @@ def prepare_plan_only(
                                          doc_mapper=doc_mapper,
                                          reader=reader),
         absence_sink=absence_sink,
+        sort_value_threshold=sort_value_threshold,
     )
 
 
@@ -189,6 +196,9 @@ def execute_prepared_split(
     sort2 = request.sort_fields[1] if len(request.sort_fields) > 1 else None
     # k=0 (count/agg-only): the executor skips keying and top-k entirely
     k = request.start_offset + request.max_hits
+    if plan.threshold_slot >= 0:
+        from ..observability.metrics import SEARCH_KERNEL_THRESHOLD_TOTAL
+        SEARCH_KERNEL_THRESHOLD_TOTAL.inc()
     if batcher is not None:
         result = batcher.execute(plan, k, device_arrays,
                                  split_key=id(reader))

@@ -248,6 +248,12 @@ class LeafSearchRequest:
     # root serializes what is LEFT, not the original timeout, so time spent
     # queued at the root is not silently re-granted to the leaf.
     deadline_millis: Optional[int] = None
+    # Kth sort value already collected elsewhere (INTERNAL higher-is-better
+    # encoding, see collector.sort_value_threshold). Seeds the leaf's
+    # dynamic-pruning threshold so a root retry's second round can skip
+    # splits the first round already beat. Advisory only — a leaf that
+    # ignores it returns a superset, never a wrong result.
+    sort_value_threshold: Optional[float] = None
 
     def to_dict(self) -> dict[str, Any]:
         return {"search_request": self.search_request.to_dict(),
@@ -255,7 +261,9 @@ class LeafSearchRequest:
                 "doc_mapping": self.doc_mapping,
                 "splits": [s.to_dict() for s in self.splits],
                 **({"deadline_millis": self.deadline_millis}
-                   if self.deadline_millis is not None else {})}
+                   if self.deadline_millis is not None else {}),
+                **({"sort_value_threshold": self.sort_value_threshold}
+                   if self.sort_value_threshold is not None else {})}
 
     @staticmethod
     def from_dict(d: dict[str, Any]) -> "LeafSearchRequest":
@@ -264,7 +272,8 @@ class LeafSearchRequest:
             index_uid=d["index_uid"],
             doc_mapping=d["doc_mapping"],
             splits=[SplitIdAndFooter.from_dict(s) for s in d["splits"]],
-            deadline_millis=d.get("deadline_millis"))
+            deadline_millis=d.get("deadline_millis"),
+            sort_value_threshold=d.get("sort_value_threshold"))
 
 
 @dataclass

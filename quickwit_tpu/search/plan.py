@@ -314,6 +314,11 @@ class LoweredPlan:
     # text-field (dict-ordinal) primary sort: the leaf decodes the returned
     # ordinals back to term strings; merging happens on the strings
     sort_text_field: Optional[str] = None
+    # dynamic top-K threshold pushdown: traced f64 scalar (internal
+    # higher-is-better key) masking sub-threshold docs before top_k. Like
+    # search_after, only PRESENCE is static — the value rides a scalar slot
+    # so the compiled executable is reused across threshold values.
+    threshold_slot: int = -1
 
     def signature(self, k: int) -> tuple:
         shapes = tuple((a.shape, str(a.dtype)) for a in self.arrays)
@@ -321,7 +326,7 @@ class LoweredPlan:
         agg_sig = ",".join(a.sig() for a in self.aggs)
         return (self.root.sig(), self.sort.sig(), agg_sig, shapes, scalar_dtypes,
                 k, self.num_docs_padded, self.search_after_relation,
-                self.sa_value2_slot >= 0)
+                self.sa_value2_slot >= 0, self.threshold_slot >= 0)
 
 
 class _Builder:
@@ -1491,6 +1496,7 @@ def lower_request(
     batch_overrides: Optional[dict] = None,
     search_after: Optional[tuple] = None,  # (internal_value, relation, doc_id)
     absence_sink=None,
+    sort_value_threshold: Optional[float] = None,  # internal higher-is-better
 ) -> LoweredPlan:
     """Full request lowering: query + request-level time filter + sort + aggs."""
     low = Lowering(doc_mapper, reader, batch_overrides, absence_sink)
@@ -1519,6 +1525,13 @@ def lower_request(
         if sa_value2 is not None:
             sa_value2_slot = low.b.add_scalar(float(sa_value2), np.float64)
         sa_doc_slot = low.b.add_scalar(int(sa_doc), np.int32)
+    threshold_slot = -1
+    if (sort_value_threshold is not None and sort_field != "_doc"
+            and sort_text_field is None):
+        # text sorts compare split-local ordinals — a cross-split threshold
+        # is meaningless there, so the pushdown silently disarms
+        threshold_slot = low.b.add_scalar(
+            float(sort_value_threshold), np.float64)
     return LoweredPlan(
         root=root, sort=sort, aggs=aggs,
         arrays=low.b.arrays, array_keys=low.b.array_keys, scalars=low.b.scalars,
@@ -1527,4 +1540,5 @@ def lower_request(
         sa_value_slot=sa_value_slot, sa_value2_slot=sa_value2_slot,
         sa_doc_slot=sa_doc_slot,
         sort_text_field=sort_text_field,
+        threshold_slot=threshold_slot,
     )

@@ -468,12 +468,23 @@ class RootSearcher:
         SEARCH_LEAF_RETRIES_TOTAL.inc()
         non_retryable = [e for e in original_failures
                          if e.split_id not in retryable_ids]
+        # seed the retry with the Kth sort value the first attempt already
+        # collected: round 2 starts pruning where round 1 left off instead
+        # of re-proving the threshold from scratch (search/pruning.py)
+        retry_threshold = None
+        if response is not None:
+            from ..models.doc_mapper import DocMapper as _DM
+            from .pruning import threshold_from_response
+            retry_threshold = threshold_from_response(
+                leaf_request.search_request,
+                _DM.from_dict(leaf_request.doc_mapping), response)
         retry_request = LeafSearchRequest(
             search_request=leaf_request.search_request,
             index_uid=leaf_request.index_uid,
             doc_mapping=leaf_request.doc_mapping,
             splits=retry_splits,
             deadline_millis=budget.deadline.timeout_millis(),
+            sort_value_threshold=retry_threshold,
         )
         try:
             retry_response = self.clients[retry_node].leaf_search(retry_request)

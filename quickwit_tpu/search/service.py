@@ -31,6 +31,7 @@ from ..index.reader import SplitReader
 from ..models.doc_mapper import DocMapper
 from ..observability.metrics import (
     SEARCH_DEADLINE_REMAINING, SEARCH_SHED_TOTAL,
+    SEARCH_SPLITS_DOWNGRADED_TOTAL, SEARCH_SPLITS_PRUNED_TOTAL,
 )
 from ..query.ast import MatchAll
 from ..parallel.fanout import build_batch, execute_batch, stage_device_inputs
@@ -43,6 +44,10 @@ from .leaf import (execute_prepared_split, leaf_search_single_split,
 from .models import (
     FetchDocsRequest, LeafSearchRequest, LeafSearchResponse, SearchRequest,
     SplitIdAndFooter, SplitSearchError, string_sort_of,
+)
+from .pruning import (
+    PruningContext, ScoreBoundCache, ThresholdBox, downgrade_to_count,
+    pruning_context, record_split_term_stats, split_best_internal_key,
 )
 
 logger = logging.getLogger(__name__)
@@ -71,7 +76,8 @@ class SearcherContext:
                  offload_endpoint: Optional[str] = None,
                  offload_max_local_splits: int = 16,
                  offload_client_factory=None,
-                 split_cache=None):
+                 split_cache=None,
+                 enable_threshold_pruning: bool = True):
         self.storage_resolver = storage_resolver or StorageResolver.default()
         # disk-resident split cache (reference SearchSplitCache,
         # split_cache/mod.rs:43): reader opens check it first; misses
@@ -89,6 +95,14 @@ class SearcherContext:
         # provably-empty splits before the reader is even constructed
         # (reference: leaf_cache.rs:197 + leaf.rs:758-841)
         self.predicate_cache = PredicateCache()
+        # dynamic top-K pruning (reference CanSplitDoBetter, leaf.rs:1279):
+        # once the collector holds K hits, splits whose sort bound cannot
+        # beat the Kth value are skipped or downgraded to count-only.
+        # The flag exists so equivalence tests can run an unpruned baseline.
+        self.enable_threshold_pruning = enable_threshold_pruning
+        # per-(split, field, term) df/max-tf for BM25 score upper bounds,
+        # recorded at split open (search/pruning.py)
+        self.score_bound_cache = ScoreBoundCache()
         # byte-accurate HBM admission (reference SearchPermitProvider):
         # the lowered plan knows every array's size, so over-budget work
         # queues instead of materializing
@@ -133,6 +147,14 @@ class SearcherContext:
         local split cache)."""
         with self._lock:
             return f"{split.storage_uri}/{split.split_id}" in self._readers
+
+    def peek_reader(self, split: SplitIdAndFooter) -> Optional[SplitReader]:
+        """Warm reader or None — NEVER opens a cold split. Threshold
+        pruning consults footer metadata (field min/max, term max-tf)
+        through this: paying a footer GET to maybe skip one kernel would
+        often cost more than the kernel."""
+        with self._lock:
+            return self._readers.get(f"{split.storage_uri}/{split.split_id}")
 
     def prefetch_pool(self):
         from concurrent.futures import ThreadPoolExecutor
@@ -212,6 +234,16 @@ class SearchService:
             max_hits=search_request.max_hits,
             start_offset=search_request.start_offset,
             string_sort=string_sort_of(search_request, doc_mapper))
+        # dynamic top-K pruning (reference CanSplitDoBetter): resolve the
+        # sort kind once; the ThresholdBox carries the collector's Kth
+        # value to the prefetch thread (monotone, so stale reads are sound)
+        prune_ctx = (pruning_context(search_request, doc_mapper)
+                     if self.context.enable_threshold_pruning
+                     else PruningContext(None, None))
+        threshold = ThresholdBox(
+            seed=(request.sort_value_threshold
+                  if prune_ctx.mode is not None else None))
+        prune_stats = {"pruned": 0, "downgraded": 0}
         required = required_terms(search_request.query_ast, doc_mapper)
         num_pruned_by_predicate = 0
         pending: list[SplitIdAndFooter] = []
@@ -242,6 +274,24 @@ class SearchService:
                 continue
             pending.append(split)
 
+        if (prune_ctx.mode is not None and threshold.get() is not None
+                and not search_request.count_hits_exact):
+            # wire-seeded threshold (root retry round 2): drop provably
+            # beaten splits BEFORE the offload cut, so pruned splits never
+            # count against the local budget or ship to the endpoint
+            still_pending: list[SplitIdAndFooter] = []
+            for split in pending:
+                best = self._split_bound(prune_ctx, split)
+                if best is not None and best < threshold.get():
+                    prune_stats["pruned"] += 1
+                    SEARCH_SPLITS_PRUNED_TOTAL.inc()
+                    collector.add_leaf_response(LeafSearchResponse(
+                        num_hits=0, num_attempted_splits=1,
+                        num_successful_splits=1))
+                else:
+                    still_pending.append(split)
+            pending = still_pending
+
         offload_future = None
         offload_result: dict[str, Any] = {}
         offloaded: list[SplitIdAndFooter] = []
@@ -263,7 +313,11 @@ class SearchService:
                     search_request=search_request,
                     index_uid=request.index_uid,
                     doc_mapping=request.doc_mapping, splits=offloaded,
-                    deadline_millis=deadline.timeout_millis())
+                    deadline_millis=deadline.timeout_millis(),
+                    # let the endpoint start pruning where we already are
+                    sort_value_threshold=(threshold.get()
+                                          if prune_ctx.mode is not None
+                                          else None))
                 result_box: dict[str, Any] = {}
 
                 def _invoke(box=result_box, rr=remote_request):
@@ -278,22 +332,23 @@ class SearchService:
                 offload_future.start()
                 offload_result = result_box
 
-        num_skipped = 0
-        prunable = self._pruning_applicable(search_request,
-                                            doc_mapper.timestamp_field)
         batch_size = self.context.batch_size
         groups = [pending[b: b + batch_size]
                   for b in range(0, len(pending), batch_size)]
         # pipelined loop: group i executes while group i+1's storage IO and
         # H2D transfer run on the prefetch worker (double buffering —
-        # reference rationale: the warmup/cache stack of leaf.rs:304)
+        # reference rationale: the warmup/cache stack of leaf.rs:304).
+        # The prefetch worker re-reads the ThresholdBox before staging, so
+        # a split that just became prunable never burns storage IO or H2D;
+        # the execute stage re-checks once more (the threshold is monotone,
+        # so both reads are sound however stale).
         pipelined = self.context.prefetch and len(groups) > 1
         future = None
         if pipelined:
             # bind_deadline: contextvars do not reach pool worker threads
             future = self.context.prefetch_pool().submit(
                 bind_deadline(self._prepare_group), groups[0], doc_mapper,
-                search_request)
+                search_request, prune_ctx, threshold)
         for i, group in enumerate(groups):
             begin = i * batch_size
             if deadline.expired:
@@ -309,29 +364,19 @@ class SearchService:
                     self._discard_prepared(future.result())
                     future = None
                 break
-            if prunable and begin > 0 and self._can_skip_remaining(
-                    search_request, collector, pending, begin):
-                # reference `CanSplitDoBetter` short-circuit (leaf.rs:1608):
-                # with exact counting off, splits whose best possible sort key
-                # cannot beat the current kth hit are skipped entirely
-                # (a prefetched group may be discarded here — wasted IO is
-                # the price of overlap, never wrong results; its admitted
-                # HBM pins must still be returned)
-                num_skipped = len(pending) - begin
-                if future is not None:
-                    self._discard_prepared(future.result())
-                    future = None
-                break
             prepared = (future.result() if future is not None
                         else self._prepare_group(group, doc_mapper,
-                                                 search_request))
+                                                 search_request, prune_ctx,
+                                                 threshold))
             future = None
             if pipelined and i + 1 < len(groups):
                 future = self.context.prefetch_pool().submit(
                     bind_deadline(self._prepare_group), groups[i + 1],
-                    doc_mapper, search_request)
+                    doc_mapper, search_request, prune_ctx, threshold)
             self._execute_group(prepared, doc_mapper, search_request,
-                                collector)
+                                collector, prune_ctx, threshold, prune_stats)
+            # publish the (possibly higher) Kth value for the next groups
+            threshold.update(collector.sort_value_threshold())
 
         num_offloaded = 0
         if offload_future is not None:
@@ -360,13 +405,22 @@ class SearchService:
                                 retryable=True))
                         continue
                     prepared = self._prepare_group(group, doc_mapper,
-                                                   search_request)
+                                                   search_request, prune_ctx,
+                                                   threshold)
                     self._execute_group(prepared, doc_mapper, search_request,
-                                        collector)
+                                        collector, prune_ctx, threshold,
+                                        prune_stats)
+                    threshold.update(collector.sort_value_threshold())
 
         response = collector.to_leaf_response()
         response.num_attempted_splits = len(splits)
-        response.resource_stats["num_splits_skipped"] = num_skipped
+        # num_splits_skipped predates the threshold subsystem and stays as
+        # an alias of the threshold-pruned count (dashboards key on it)
+        response.resource_stats["num_splits_skipped"] = prune_stats["pruned"]
+        response.resource_stats["num_splits_pruned_by_threshold"] = \
+            prune_stats["pruned"]
+        response.resource_stats["num_splits_downgraded_to_count"] = \
+            prune_stats["downgraded"]
         response.resource_stats["num_splits_pruned_by_predicate_cache"] = \
             num_pruned_by_predicate
         if num_offloaded:
@@ -398,49 +452,90 @@ class SearchService:
             return False
         return True
 
-    @staticmethod
-    def _pruning_applicable(request: SearchRequest, timestamp_field) -> bool:
-        if request.count_hits_exact or request.aggs or request.max_hits == 0:
-            return False
-        sort = request.sort_fields[0] if request.sort_fields else None
-        # split metadata only bounds the timestamp field's values
-        return sort is not None and sort.field == timestamp_field
+    def _split_bound(self, prune_ctx: PruningContext,
+                     split: SplitIdAndFooter) -> Optional[float]:
+        """Best internal sort key any doc of `split` can reach, or None
+        (must run). Consults only metadata already in hand: split
+        time_range, a WARM reader's footer field min/max, or the score
+        bound cache (falling back to a warm reader's term stats)."""
+        def field_meta():
+            reader = self.context.peek_reader(split)
+            return (reader.field_meta(prune_ctx.sort.field)
+                    if reader is not None else None)
 
-    @staticmethod
-    def _can_skip_remaining(request: SearchRequest,
-                            collector: IncrementalCollector,
-                            pending: list[SplitIdAndFooter],
-                            begin: int) -> bool:
-        needed = request.start_offset + request.max_hits
-        hits = collector.partial_hits()
-        if len(hits) < request.max_hits or collector.num_hits < needed:
-            return False
-        if not hits:
-            return False
-        sort = request.sort_fields[0]
-        worst_kept = hits[-1].sort_value  # internal higher-is-better key
-        for i in range(begin, len(pending)):
-            split = pending[i]
-            if split.time_range is None:
-                return False
-            # best achievable internal key in this split for the sort field;
-            # a TIE can still win the (split_id, doc_id) tie-break, so only
-            # strictly-worse splits are skippable
-            best = (split.time_range[1] if sort.order == "desc"
-                    else -split.time_range[0])
-            if best >= worst_kept:
-                return False
-        return True
+        def score_stats(field, term):
+            stats = self.context.score_bound_cache.get(
+                split.split_id, field, term)
+            if stats is None:
+                reader = self.context.peek_reader(split)
+                if reader is None:
+                    return None
+                stats = reader.term_stats(field, term)
+                self.context.score_bound_cache.record(
+                    split.split_id, field, term, *stats)
+            return stats
 
-    def _prepare_group(self, group, doc_mapper, search_request):
-        """Stage 1 (prefetch-thread-safe): storage IO, plan lowering, and
-        the async H2D transfer for one split group. Returns an opaque
-        prepared unit for `_execute_group`."""
+        return split_best_internal_key(prune_ctx, split,
+                                       field_meta_fn=field_meta,
+                                       score_stats_fn=score_stats)
+
+    def _classify_group(self, group, search_request, prune_ctx, threshold):
+        """(run, skipped, to_count): splits whose bound cannot beat the
+        current threshold are skipped (inexact counting) or downgraded to
+        count-only requests (exact counting); ties always run."""
+        thr = threshold.get() if prune_ctx.mode is not None else None
+        if thr is None:
+            return list(group), [], []
+        run, skipped, to_count = [], [], []
+        for split in group:
+            best = self._split_bound(prune_ctx, split)
+            if best is not None and best < thr:
+                (to_count if search_request.count_hits_exact
+                 else skipped).append(split)
+            else:
+                run.append(split)
+        return run, skipped, to_count
+
+    def _prepare_group(self, group, doc_mapper, search_request, prune_ctx,
+                       threshold):
+        """Stage 1 (prefetch-thread-safe): threshold re-check + storage IO,
+        plan lowering, and the async H2D transfer for one split group.
+        Returns an opaque prepared unit for `_execute_group`:
+        (kind, run_group, data, extras) where extras carries the
+        threshold-pruned splits (skipped / count-ready / count-prepared)."""
+        run_group, skipped, to_count = self._classify_group(
+            group, search_request, prune_ctx, threshold)
+        count_ready: list[tuple] = []
+        count_prepared: list[tuple] = []
+        count_request = None
+        if to_count:
+            # exact counting: the split still owes its hit count — re-issue
+            # as a count-only request (max_hits=0) riding the metadata
+            # count, the leaf cache, or the k==0 no-sort/no-top-k kernel
+            count_request = downgrade_to_count(search_request)
+            for split in to_count:
+                if self._count_from_metadata(count_request, split):
+                    count_ready.append((split, LeafSearchResponse(
+                        num_hits=split.num_docs, num_attempted_splits=1,
+                        num_successful_splits=1)))
+                    continue
+                key = canonical_request_key(split.split_id, count_request,
+                                            split.time_range)
+                cached = self.context.leaf_cache.get(key)
+                if cached is not None:
+                    count_ready.append((split, cached))
+                    continue
+                count_prepared.extend(self._prepare_per_split(
+                    [split], doc_mapper, count_request, prune_ctx=None))
+        extras = {"skipped": skipped, "count_ready": count_ready,
+                  "count_prepared": count_prepared,
+                  "count_request": count_request}
+        push_thr = (threshold.get() if prune_ctx.mode is not None else None)
         # the batch path has no search_after pushdown or per-split terms
         # truncation; the per-split path handles those (2-key sorts ride
         # the batch via the lexicographic cross-split re-top-k)
         import json as _json
-        if (len(group) > 1 and not search_request.search_after
+        if (len(run_group) > 1 and not search_request.search_after
                 and string_sort_of(search_request, doc_mapper) is None
                 and not any(key in _json.dumps(search_request.aggs or {})
                             for key in ("split_size", "shard_size",
@@ -448,38 +543,54 @@ class SearchService:
             admitted = None
             batch = None
             try:
-                readers = [self.context.reader(s) for s in group]
+                readers = [self.context.reader(s) for s in run_group]
+                if prune_ctx.mode == "score":
+                    for reader, split in zip(readers, run_group):
+                        record_split_term_stats(
+                            self.context.score_bound_cache, split.split_id,
+                            reader, prune_ctx.terms)
                 batch = build_batch(
                     search_request, doc_mapper, readers,
-                    [s.split_id for s in group],
+                    [s.split_id for s in run_group],
                     absence_sink=self.context.predicate_cache
-                    .record_term_absent)
+                    .record_term_absent,
+                    sort_value_threshold=push_thr)
                 admitted = self.context.hbm_budget.admit(
                     batch, sum(a.nbytes for a in batch.arrays))
                 stage_device_inputs(batch)  # async transfer starts now
-                return ("batch", group, (batch, admitted))
+                return ("batch", run_group, (batch, admitted), extras)
             except Exception as exc:  # noqa: BLE001 - fall back per split
                 if admitted is not None and batch is not None:
                     self.context.hbm_budget.release(batch, admitted)
                 logger.debug("batch path failed (%s); searching per split", exc)
-        return ("per_split", group,
-                self._prepare_per_split(group, doc_mapper, search_request))
+        return ("per_split", run_group,
+                self._prepare_per_split(run_group, doc_mapper, search_request,
+                                        prune_ctx=prune_ctx,
+                                        sort_value_threshold=push_thr),
+                extras)
 
     def _discard_prepared(self, prepared) -> None:
-        """A prefetched group dropped by the pruning short-circuit must
-        return its admitted HBM pins (the per-split path takes none at
-        prepare time — only the batch path pre-admits)."""
-        kind, _group, data = prepared
+        """A prefetched group dropped by the deadline must return its
+        admitted HBM pins (the per-split path takes none at prepare time —
+        only the batch path pre-admits)."""
+        kind, _group, data, _extras = prepared
         if kind == "batch":
             batch, admitted = data
             self.context.hbm_budget.release(batch, admitted)
 
-    def _prepare_per_split(self, group, doc_mapper, search_request):
+    def _prepare_per_split(self, group, doc_mapper, search_request,
+                           prune_ctx=None, sort_value_threshold=None):
         prepared = []
         for split in group:
             try:
                 reader = self.context.reader(split)
                 cache = self.context.predicate_cache
+                if prune_ctx is not None and prune_ctx.mode == "score":
+                    # remember df/max-tf at split open so future queries
+                    # can bound this split before (re)opening it
+                    record_split_term_stats(
+                        self.context.score_bound_cache, split.split_id,
+                        reader, prune_ctx.terms)
                 # plan-only (storage IO + lowering): the H2D transfer is
                 # deferred to the execute stage so each split's
                 # admit→transfer→execute→release cycle runs alone — a whole
@@ -488,16 +599,37 @@ class SearchService:
                 plan = prepare_plan_only(
                     search_request, doc_mapper, reader, split.split_id,
                     absence_sink=lambda f, t, s=split.split_id:
-                        cache.record_term_absent(s, f, t))
+                        cache.record_term_absent(s, f, t),
+                    sort_value_threshold=sort_value_threshold)
                 prepared.append((split, reader, plan, None))
             except Exception as exc:  # noqa: BLE001 - partial failure
                 prepared.append((split, None, None, exc))
         return prepared
 
     def _execute_group(self, prepared, doc_mapper, search_request,
-                       collector) -> None:
+                       collector, prune_ctx, threshold, prune_stats) -> None:
         """Stage 2 (main thread): kernel execution + readback + merge."""
-        kind, group, data = prepared
+        kind, group, data, extras = prepared
+        for split in extras["skipped"]:
+            # conclusively handled without execution: zero hits here can
+            # reach the top-K (num_hits is a lower bound when
+            # count_hits_exact=False, same contract as before)
+            prune_stats["pruned"] += 1
+            SEARCH_SPLITS_PRUNED_TOTAL.inc()
+            collector.add_leaf_response(LeafSearchResponse(
+                num_hits=0, num_attempted_splits=1, num_successful_splits=1))
+        for _split, response in extras["count_ready"]:
+            prune_stats["downgraded"] += 1
+            SEARCH_SPLITS_DOWNGRADED_TOTAL.inc()
+            collector.add_leaf_response(response)
+        if extras["count_prepared"]:
+            prune_stats["downgraded"] += len(extras["count_prepared"])
+            SEARCH_SPLITS_DOWNGRADED_TOTAL.inc(
+                len(extras["count_prepared"]))
+            self._execute_per_split(
+                extras["count_prepared"], doc_mapper,
+                extras["count_request"], collector,
+                prune_ctx=None, threshold=None, prune_stats=None)
         if kind == "batch":
             batch, admitted = data
             try:
@@ -513,11 +645,21 @@ class SearchService:
                 # still-pinned batch bytes
                 self.context.hbm_budget.release(batch, admitted)
                 admitted = None
-                data = self._prepare_per_split(group, doc_mapper,
-                                               search_request)
+                data = self._prepare_per_split(
+                    group, doc_mapper, search_request, prune_ctx=prune_ctx,
+                    sort_value_threshold=(threshold.get()
+                                          if prune_ctx.mode is not None
+                                          else None))
             finally:
                 if admitted is not None:
                     self.context.hbm_budget.release(batch, admitted)
+        self._execute_per_split(data, doc_mapper, search_request, collector,
+                                prune_ctx=prune_ctx, threshold=threshold,
+                                prune_stats=prune_stats)
+
+    def _execute_per_split(self, data, doc_mapper, search_request, collector,
+                           prune_ctx=None, threshold=None,
+                           prune_stats=None) -> None:
         from .leaf import warmup_device_arrays
         deadline = current_deadline()
         for split, reader, plan, prep_error in data:
@@ -533,6 +675,24 @@ class SearchService:
                     split_id=split.split_id, error=str(prep_error),
                     retryable=True))
                 continue
+            if (prune_ctx is not None and prune_ctx.mode is not None
+                    and threshold is not None
+                    and not search_request.count_hits_exact):
+                # execute-time re-check: the threshold may have risen past
+                # this split's bound since the prefetch thread prepared it
+                # (wasted prepare IO is the price of overlap, never wrong
+                # results)
+                thr = threshold.get()
+                if thr is not None:
+                    best = self._split_bound(prune_ctx, split)
+                    if best is not None and best < thr:
+                        if prune_stats is not None:
+                            prune_stats["pruned"] += 1
+                        SEARCH_SPLITS_PRUNED_TOTAL.inc()
+                        collector.add_leaf_response(LeafSearchResponse(
+                            num_hits=0, num_attempted_splits=1,
+                            num_successful_splits=1))
+                        continue
             admitted = 0
             warmed = False
             try:
@@ -543,10 +703,16 @@ class SearchService:
                     search_request, doc_mapper, reader, split.split_id,
                     plan, device_arrays,
                     batcher=self.context.query_batcher)
-                key = canonical_request_key(split.split_id, search_request,
-                                            split.time_range)
-                self.context.leaf_cache.put(key, response)
+                if plan.threshold_slot < 0:
+                    # a threshold-pushdown response may have its hit list
+                    # truncated below k — correct for THIS query's merge,
+                    # poison for a future query with a lower threshold
+                    key = canonical_request_key(
+                        split.split_id, search_request, split.time_range)
+                    self.context.leaf_cache.put(key, response)
                 collector.add_leaf_response(response)
+                if threshold is not None:
+                    threshold.update(collector.sort_value_threshold())
             except Exception as exc:  # noqa: BLE001 - partial failure semantics
                 _warn_split_failure("search", split.split_id, exc)
                 collector.failed_splits.append(SplitSearchError(

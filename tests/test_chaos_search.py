@@ -20,8 +20,8 @@ from quickwit_tpu.common.deadline import (
     Deadline, DeadlineExceeded, QueryBudget, deadline_scope,
 )
 from quickwit_tpu.common.faults import (
-    FaultInjector, FaultRule, FaultyClient, FaultyStorageResolver,
-    InjectedFault,
+    FaultInjector, FaultRule, FaultyClient, FaultyMetastore,
+    FaultyStorageResolver, InjectedFault,
 )
 from quickwit_tpu.indexing import IndexingPipeline, PipelineParams, VecSource
 from quickwit_tpu.metastore import FileBackedMetastore
@@ -232,6 +232,41 @@ def test_storage_hang_cut_off_at_deadline(corpus):
     assert elapsed < 0.3 + DEADLINE_SLACK_SECS
     assert response.timed_out
     assert response.failed_splits
+
+
+def test_slow_metastore_yields_typed_partial_not_extra_work(corpus):
+    # list_splits stalls 1s against a 0.4s budget: the stall itself is a
+    # synchronous lower bound on latency, but once the deadline is gone the
+    # root must SHED the whole fan-out (typed deadline failures, timed_out)
+    # instead of piling leaf work on top of the blown budget
+    injector = FaultInjector(seed=13, rules=[
+        FaultRule("metastore.list_splits", "hang", hang_secs=1.0),
+    ])
+    root = build_root(corpus, num_nodes=2)
+    root.metastore = FaultyMetastore(root.metastore, injector)
+    t0 = time.monotonic()
+    response = root.search(term_request(max_hits=5, timeout_millis=400))
+    elapsed = time.monotonic() - t0
+    assert elapsed < 1.0 + DEADLINE_SLACK_SECS  # stall + slack, nothing more
+    assert response.timed_out
+    assert response.num_hits == 0
+    assert len({e.split_id for e in response.failed_splits}) == 6
+    for failure in response.failed_splits:
+        assert "deadline exceeded" in failure.error
+    assert injector.occurrences("metastore.list_splits") == 1
+
+
+def test_metastore_error_surfaces_typed_not_a_hang(corpus):
+    from quickwit_tpu.metastore import MetastoreError
+    injector = FaultInjector(seed=13, rules=[
+        FaultRule("metastore.list_splits", "error"),
+    ])
+    root = build_root(corpus, num_nodes=1)
+    root.metastore = FaultyMetastore(root.metastore, injector)
+    t0 = time.monotonic()
+    with pytest.raises(MetastoreError, match="injected fault"):
+        root.search(term_request(max_hits=5, timeout_millis=20_000))
+    assert time.monotonic() - t0 < DEADLINE_SLACK_SECS
 
 
 # --- invariant: same seed, same schedule -----------------------------------

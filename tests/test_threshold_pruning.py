@@ -1,0 +1,403 @@
+"""Dynamic top-K split pruning: equivalence + accounting.
+
+The pruning subsystem (search/pruning.py) may skip or downgrade splits, push
+the collector's Kth value into the kernel, and seed retries over the wire —
+but it must NEVER change what the user sees. The property suite here runs
+every request shape once against a pruning-enabled leaf and once against an
+`enable_threshold_pruning=False` baseline on the same corpus and asserts
+identical top-K hits and sort values (and identical num_hits whenever exact
+counting is on). The accounting tests pin the perf claim itself: fewer
+kernel dispatches than splits attempted, visible through the batcher and
+the new pruning counters."""
+
+import pytest
+
+from quickwit_tpu.indexing import IndexingPipeline, PipelineParams, VecSource
+from quickwit_tpu.metastore import FileBackedMetastore
+from quickwit_tpu.metastore.base import ListSplitsQuery
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.models.index_metadata import (
+    IndexConfig, IndexMetadata, SourceConfig,
+)
+from quickwit_tpu.models.split_metadata import SplitState
+from quickwit_tpu.observability.metrics import (
+    SEARCH_KERNEL_THRESHOLD_TOTAL, SEARCH_SPLITS_DOWNGRADED_TOTAL,
+    SEARCH_SPLITS_PRUNED_TOTAL,
+)
+from quickwit_tpu.query import parse_query_string
+from quickwit_tpu.search.cache import canonical_request_key
+from quickwit_tpu.search.models import (
+    LeafSearchRequest, SearchRequest, SortField, SplitIdAndFooter,
+)
+from quickwit_tpu.search.pruning import (
+    PruningContext, ThresholdBox, downgrade_to_count, pruning_context,
+    scoring_terms, term_score_bound, threshold_from_response,
+)
+from quickwit_tpu.search.service import (
+    SearcherContext, SearchService,
+)
+from quickwit_tpu.storage import StorageResolver
+
+MAPPER = DocMapper(
+    field_mappings=[
+        FieldMapping("ts", FieldType.DATETIME, fast=True,
+                     input_formats=("unix_timestamp",)),
+        FieldMapping("val", FieldType.I64, fast=True),
+        FieldMapping("body", FieldType.TEXT),
+        FieldMapping("sev", FieldType.TEXT, tokenizer="raw", fast=True),
+    ],
+    timestamp_field="ts",
+    default_search_fields=("body",),
+)
+
+BASE_TS = 1_650_000_000
+NUM_DOCS = 600  # 6 splits of 100, time-ordered => disjoint split ranges
+
+
+def make_docs():
+    docs = []
+    for i in range(NUM_DOCS):
+        split = i // 100
+        # "common" term frequency decays across splits so the BM25 upper
+        # bound actually separates them (split 0: tf 20, split 5: tf 1)
+        tf = {0: 20, 1: 5, 2: 4, 3: 3, 4: 2, 5: 1}[split]
+        docs.append({
+            "ts": BASE_TS + i,
+            "val": i,
+            "body": f"event{i} " + "common " * tf,
+            "sev": ["INFO", "WARN", "ERROR", "DEBUG"][i % 4],
+        })
+    return docs
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    resolver = StorageResolver.for_test()
+    metastore = FileBackedMetastore(resolver.resolve("ram:///prune/ms"))
+    split_uri = "ram:///prune/splits"
+    config = IndexConfig(index_id="prune", index_uri=split_uri,
+                         doc_mapper=MAPPER, split_num_docs_target=100)
+    metastore.create_index(IndexMetadata(
+        index_uid="prune:01", index_config=config,
+        sources={"src": SourceConfig("src", "vec")}))
+    pipeline = IndexingPipeline(
+        PipelineParams(index_uid="prune:01", source_id="src",
+                       split_num_docs_target=100, batch_num_docs=50),
+        MAPPER, VecSource(make_docs()), metastore,
+        resolver.resolve(split_uri))
+    pipeline.run_to_completion()
+    splits = metastore.list_splits(ListSplitsQuery(
+        index_uids=["prune:01"], states=[SplitState.PUBLISHED]))
+    offsets = [SplitIdAndFooter(
+        split_id=s.metadata.split_id, storage_uri=split_uri,
+        num_docs=s.metadata.num_docs,
+        time_range=(s.metadata.time_range_start, s.metadata.time_range_end))
+        for s in splits]
+    assert len(offsets) == 6
+    return resolver, offsets
+
+
+def make_service(resolver, pruning=True, batch_size=1):
+    return SearchService(SearcherContext(
+        storage_resolver=resolver, batch_size=batch_size,
+        enable_threshold_pruning=pruning))
+
+
+def leaf(service, request, offsets, threshold=None):
+    return service.leaf_search(LeafSearchRequest(
+        search_request=request, index_uid="prune:01",
+        doc_mapping=MAPPER.to_dict(), splits=offsets,
+        sort_value_threshold=threshold))
+
+
+def hit_keys(response):
+    return [(h.split_id, h.doc_id, h.sort_value, h.sort_value2,
+             h.raw_sort_value) for h in response.partial_hits]
+
+
+def request(query="*", sort=(("ts", "desc"),), **kwargs):
+    return SearchRequest(
+        index_ids=["prune"], query_ast=parse_query_string(query, ["body"]),
+        sort_fields=tuple(SortField(f, o) for f, o in sort), **kwargs)
+
+
+# --- equivalence property suite -------------------------------------------
+
+EQUIVALENCE_CASES = [
+    # timestamp sort, both orders, exact and inexact counting
+    request(max_hits=5),
+    request(max_hits=5, count_hits_exact=False),
+    request(sort=(("ts", "asc"),), max_hits=5),
+    request(sort=(("ts", "asc"),), max_hits=5, count_hits_exact=False),
+    # filtered query + paging offset
+    request(query="sev:ERROR", max_hits=7, count_hits_exact=False),
+    request(max_hits=5, start_offset=10),
+    # non-timestamp numeric fast field
+    request(sort=(("val", "desc"),), max_hits=8),
+    request(sort=(("val", "asc"),), max_hits=8, count_hits_exact=False),
+    # two-key sort rides the sort_value2 lane
+    request(sort=(("ts", "desc"), ("val", "asc")), max_hits=6),
+    request(sort=(("val", "asc"), ("ts", "desc")), max_hits=6,
+            count_hits_exact=False),
+    # BM25 relevance sort (score-bound mode)
+    request(query="common", sort=(("_score", "desc"),), max_hits=10),
+    request(query="common", sort=(("_score", "desc"),), max_hits=10,
+            count_hits_exact=False),
+    request(query="common", sort=(("_score", "asc"),), max_hits=10),
+    # string sort: pruning must stay inert, results identical
+    request(sort=(("sev", "asc"),), max_hits=5),
+    request(sort=(("sev", "desc"),), max_hits=5, count_hits_exact=False),
+    # time filter on top of the sort
+    request(max_hits=5, start_timestamp=(BASE_TS + 150) * 1_000_000,
+            end_timestamp=(BASE_TS + 450) * 1_000_000),
+    # more wanted hits than one split holds: threshold fills late
+    request(max_hits=150, count_hits_exact=False),
+]
+
+
+@pytest.mark.parametrize("case", range(len(EQUIVALENCE_CASES)))
+def test_pruned_equals_unpruned(corpus, case):
+    resolver, offsets = corpus
+    req = EQUIVALENCE_CASES[case]
+    baseline = leaf(make_service(resolver, pruning=False), req, offsets)
+    # run the pruned side twice on ONE service: the first pass warms
+    # readers and records score-bound stats, the second prunes off them
+    # (leaf-cache hits are part of the contract being checked)
+    pruned_svc = make_service(resolver, pruning=True)
+    leaf(pruned_svc, req, offsets)
+    pruned = leaf(pruned_svc, req, offsets)
+    assert hit_keys(pruned) == hit_keys(baseline)
+    assert pruned.num_successful_splits == baseline.num_successful_splits
+    if req.count_hits_exact:
+        # exact counting survives the downgrade-to-count path
+        assert pruned.num_hits == baseline.num_hits
+    else:
+        # inexact num_hits is a lower bound; the top window itself is exact
+        assert pruned.num_hits <= baseline.num_hits
+
+
+def test_search_after_equivalence(corpus):
+    resolver, offsets = corpus
+    page1 = leaf(make_service(resolver, pruning=False),
+                 request(max_hits=7), offsets)
+    last = page1.partial_hits[6]
+    after = request(max_hits=7,
+                    search_after=[last.sort_value, last.split_id,
+                                  last.doc_id])
+    baseline = leaf(make_service(resolver, pruning=False), after, offsets)
+    pruned = leaf(make_service(resolver, pruning=True), after, offsets)
+    assert hit_keys(pruned) == hit_keys(baseline)
+    assert not ({(h.split_id, h.doc_id) for h in pruned.partial_hits}
+                & {(h.split_id, h.doc_id) for h in page1.partial_hits[:7]})
+
+
+def test_wire_seeded_threshold_truncates_soundly(corpus):
+    resolver, offsets = corpus
+    # a threshold at the 3rd-newest doc's key: only keys >= it may return
+    thr = float((BASE_TS + NUM_DOCS - 3) * 1_000_000)
+    pruned = leaf(make_service(resolver, pruning=True),
+                  request(max_hits=5, count_hits_exact=False), offsets,
+                  threshold=thr)
+    assert [h.sort_value for h in pruned.partial_hits] == [
+        (BASE_TS + NUM_DOCS - 1 - i) * 1_000_000.0 for i in range(3)]
+    # 5 of 6 splits are beaten by the seed before anything executes
+    assert pruned.resource_stats["num_splits_pruned_by_threshold"] == 5
+
+
+# --- accounting: the perf claim, observable --------------------------------
+
+
+def test_fewer_dispatches_than_splits_attempted(corpus):
+    resolver, offsets = corpus
+    service = make_service(resolver, pruning=True, batch_size=1)
+    pruned_before = SEARCH_SPLITS_PRUNED_TOTAL.get()
+    response = leaf(service, request(max_hits=5, count_hits_exact=False),
+                    offsets)
+    # splits are visited newest-first and ranges are disjoint: the first
+    # split fills the top-5, every other split is provably beaten
+    assert response.num_attempted_splits == 6
+    assert service.context.query_batcher.num_dispatches < 6
+    assert response.resource_stats["num_splits_pruned_by_threshold"] >= 1
+    # legacy alias the dashboards key on
+    assert response.resource_stats["num_splits_skipped"] == \
+        response.resource_stats["num_splits_pruned_by_threshold"]
+    assert SEARCH_SPLITS_PRUNED_TOTAL.get() - pruned_before == \
+        response.resource_stats["num_splits_pruned_by_threshold"]
+
+
+def test_exact_counts_ride_downgraded_requests(corpus):
+    resolver, offsets = corpus
+    service = make_service(resolver, pruning=True, batch_size=1)
+    downgraded_before = SEARCH_SPLITS_DOWNGRADED_TOTAL.get()
+    req = request(query="sev:ERROR", max_hits=5)  # count_hits_exact=True
+    response = leaf(service, req, offsets)
+    baseline = leaf(make_service(resolver, pruning=False), req, offsets)
+    assert hit_keys(response) == hit_keys(baseline)
+    assert response.num_hits == baseline.num_hits == NUM_DOCS // 4
+    assert response.resource_stats["num_splits_downgraded_to_count"] >= 1
+    assert response.resource_stats["num_splits_pruned_by_threshold"] == 0
+    assert SEARCH_SPLITS_DOWNGRADED_TOTAL.get() - downgraded_before == \
+        response.resource_stats["num_splits_downgraded_to_count"]
+
+
+def test_kernel_threshold_pushdown_counted(corpus):
+    resolver, offsets = corpus
+    # a seed below every doc prunes nothing but rides into every kernel
+    thr = float(BASE_TS * 1_000_000)
+    req = request(max_hits=5, count_hits_exact=False)
+    before = SEARCH_KERNEL_THRESHOLD_TOTAL.get()
+    response = leaf(make_service(resolver, pruning=True, batch_size=1),
+                    req, offsets, threshold=thr)
+    executed = SEARCH_KERNEL_THRESHOLD_TOTAL.get() - before
+    assert executed >= 1
+    baseline = leaf(make_service(resolver, pruning=False), req, offsets)
+    assert hit_keys(response)[:5] == hit_keys(baseline)[:5]
+
+
+def test_batched_path_accepts_threshold(corpus):
+    resolver, offsets = corpus
+    thr = float(BASE_TS * 1_000_000)
+    req = request(max_hits=5, count_hits_exact=False)
+    before = SEARCH_KERNEL_THRESHOLD_TOTAL.get()
+    response = leaf(make_service(resolver, pruning=True, batch_size=8),
+                    req, offsets, threshold=thr)
+    baseline = leaf(make_service(resolver, pruning=False, batch_size=8),
+                    req, offsets)
+    assert hit_keys(response)[:5] == hit_keys(baseline)[:5]
+    # the batch dispatch counts each real lane it masked
+    assert SEARCH_KERNEL_THRESHOLD_TOTAL.get() - before >= 1
+
+
+def test_score_mode_prunes_on_warm_stats(corpus):
+    resolver, offsets = corpus
+    service = make_service(resolver, pruning=True, batch_size=1)
+    warm = request(query="common", sort=(("_score", "desc"),), max_hits=5,
+                   count_hits_exact=False)
+    leaf(service, warm, offsets)  # records per-split df/max-tf at open
+    probe = request(query="common", sort=(("_score", "desc"),), max_hits=4,
+                    count_hits_exact=False)
+    response = leaf(service, probe, offsets)
+    # split 5's bound (max_tf=1) cannot beat the 4th-best tf-20 score
+    assert response.resource_stats["num_splits_pruned_by_threshold"] >= 1
+    baseline = leaf(make_service(resolver, pruning=False), probe, offsets)
+    assert hit_keys(response) == hit_keys(baseline)
+
+
+# --- cache-key audit (satellite): downgrades never alias -------------------
+
+
+def test_downgraded_count_request_has_distinct_cache_key(corpus):
+    resolver, offsets = corpus
+    full = request(query="sev:ERROR", max_hits=5)
+    count = downgrade_to_count(full)
+    split = offsets[0]
+    assert count.max_hits == 0 and count.sort_fields == \
+        (SortField("_doc", "asc"),)
+    assert canonical_request_key(split.split_id, full, split.time_range) != \
+        canonical_request_key(split.split_id, count, split.time_range)
+    # functional form of the same claim: a downgraded run must not poison
+    # the cache entry the full request reads
+    service = make_service(resolver, pruning=True, batch_size=1)
+    first = leaf(service, full, offsets)   # populates both kinds of entries
+    again = leaf(service, full, offsets)   # leaf-cache round trip
+    assert hit_keys(again) == hit_keys(first)
+    assert again.num_hits == first.num_hits
+
+
+# --- unit coverage of the pruning primitives -------------------------------
+
+
+def test_threshold_box_is_monotone():
+    box = ThresholdBox()
+    assert box.get() is None
+    box.update(None)
+    assert box.get() is None
+    box.update(5.0)
+    box.update(3.0)   # stale, lower publication must not regress
+    assert box.get() == 5.0
+    box.update(7.0)
+    assert box.get() == 7.0
+    seeded = ThresholdBox(seed=2.0)
+    assert seeded.get() == 2.0
+
+
+def test_pruning_context_classification():
+    ts_desc = request(max_hits=5)
+    assert pruning_context(ts_desc, MAPPER).mode == "timestamp"
+    assert pruning_context(request(sort=(("val", "asc"),), max_hits=5),
+                           MAPPER).mode == "fast_field"
+    score = request(query="common", sort=(("_score", "desc"),), max_hits=5)
+    assert pruning_context(score, MAPPER).mode == "score"
+    # inert shapes: every one of these must refuse to prune
+    inert = [
+        request(max_hits=0),                                  # count-only
+        request(max_hits=5, aggs={"a": {"terms": {"field": "sev"}}}),
+        request(sort=(("_doc", "asc"),), max_hits=5),
+        request(sort=(("sev", "asc"),), max_hits=5),          # string sort
+        request(query="common", sort=(("_score", "asc"),), max_hits=5),
+        request(query='"exact phrase"', sort=(("_score", "desc"),),
+                max_hits=5),                                  # unboundable
+        request(sort=(("body", "desc"),), max_hits=5),        # not fast
+    ]
+    for req in inert:
+        assert pruning_context(req, MAPPER).mode is None, req
+
+
+def test_scoring_terms_mirror_lowering():
+    terms = scoring_terms(parse_query_string("common", ["body"]), MAPPER)
+    assert terms == [("body", "common", 1.0)]
+    # tokenized multi-term full text contributes every token
+    terms = scoring_terms(
+        parse_query_string("common event1", ["body"]), MAPPER)
+    assert {t[1] for t in terms} == {"common", "event1"}
+    # filter context never scores: a phrase under must_not is boundable
+    terms = scoring_terms(parse_query_string(
+        'common AND -sev:"INFO"', ["body"]), MAPPER)
+    assert terms is not None and ("body", "common", 1.0) in terms
+    # a scoring phrase is not
+    assert scoring_terms(parse_query_string(
+        '"common event"', ["body"]), MAPPER) is None
+
+
+def test_term_score_bound_shape():
+    assert term_score_bound(100, 0, 0) == 0.0
+    low = term_score_bound(100, 50, 1)
+    high = term_score_bound(100, 50, 20)
+    assert 0.0 < low < high            # increasing in max_tf
+    assert term_score_bound(100, 50, 20, boost=2.0) == pytest.approx(
+        2.0 * high)
+
+
+def test_term_stats_reads_persisted_max_tf(corpus):
+    resolver, offsets = corpus
+    service = make_service(resolver, pruning=True)
+    reader = service.context.reader(offsets[0])
+    assert reader.has_array("inv.body.terms.max_tf")
+    df, max_tf = reader.term_stats("body", "common")
+    info = reader.lookup_term("body", "common")
+    _ids, tfs = reader.postings("body", info)
+    assert df == info.df == 100
+    assert max_tf == int(tfs.max())
+    assert reader.term_stats("body", "no-such-term") == (0, 0)
+
+
+def test_threshold_from_response_requires_full_window(corpus):
+    resolver, offsets = corpus
+    req = request(max_hits=5)
+    response = leaf(make_service(resolver, pruning=False), req, offsets)
+    thr = threshold_from_response(req, MAPPER, response)
+    assert thr == response.partial_hits[4].sort_value
+    assert threshold_from_response(request(max_hits=0), MAPPER,
+                                   response) is None
+    short = leaf(make_service(resolver, pruning=False),
+                 request(query="event5", max_hits=5, count_hits_exact=False),
+                 offsets)
+    assert len(short.partial_hits) < 5
+    assert threshold_from_response(req, MAPPER, short) is None
+
+
+def test_inert_context_never_consults_bounds(corpus):
+    resolver, offsets = corpus
+    service = make_service(resolver, pruning=True)
+    ctx = PruningContext(None, None)
+    assert service._split_bound(ctx, offsets[0]) is None
