@@ -359,6 +359,123 @@ def _native_cpu_leaf(plan, request, reference_count: int,
     return {"native_cpu_ms": round(_percentile(lat, 0.5) * 1000, 3)}
 
 
+def _native_cpu_bool_range(plan, request, reference_count: int,
+                           iters: int) -> "dict | None":
+    """Native comparator for the c2 shape (leafbench.cpp leaf_bool_range):
+    one scored MUST term AND'ed with an integer range filter, up to two
+    scored SHOULD terms on a shared field. Range bounds are fed in the
+    column's own on-disk domain (raw values, or scaled deltas for
+    FOR-packed columns), so the comparison is domain-invariant. Returns
+    p50 ms or None when the plan is outside this shape."""
+    import ctypes
+
+    import numpy as np
+    from quickwit_tpu.native import load_leafbench
+    from quickwit_tpu.search.plan import PBool, PPostings, PRange
+
+    lib = load_leafbench()
+    k = request.start_offset + request.max_hits
+    if lib is None or not isinstance(plan.root, PBool) or plan.aggs or k <= 0:
+        return None
+    node = plan.root
+    if (len(node.must) != 1 or node.must_not or len(node.filter) != 1
+            or len(node.should) > 2 or node.minimum_should_match):
+        return None
+    must, rng = node.must[0], node.filter[0]
+    shoulds = list(node.should)
+    if (not isinstance(must, PPostings) or not must.scoring
+            or not isinstance(rng, PRange)):
+        return None
+    for s in shoulds:
+        if not isinstance(s, PPostings) or not s.scoring:
+            return None
+    if len(shoulds) == 2 and shoulds[0].norm_slot != shoulds[1].norm_slot:
+        return None  # the C++ models ONE shared should field
+    for p in [must] + shoulds:
+        if not plan.array_keys[p.ids_slot].startswith("post."):
+            return None  # phrase/precomputed postings: out of scope
+
+    def arr(slot, dt=None):
+        a = np.ascontiguousarray(plan.arrays[slot])
+        return a.astype(dt, copy=False) if dt is not None else a
+
+    ts_values = arr(rng.values_slot)
+    if ts_values.dtype.kind not in "iu" or ts_values.dtype == np.uint64:
+        return None  # float ranges / full-width u64: not modeled
+    ts_values = ts_values.astype(np.int64, copy=False)
+    ts_present = arr(rng.present_slot, np.uint8)
+
+    def bound(slot, default):
+        return (int(np.asarray(plan.scalars[slot])) if slot >= 0
+                else default)
+
+    lo = bound(rng.lo_slot, -(2 ** 63))
+    hi = bound(rng.hi_slot, 2 ** 63 - 1)
+    if not rng.lo_incl:
+        lo += 1
+    if not rng.hi_incl:
+        hi -= 1
+
+    must_ids = arr(must.ids_slot)
+    must_tfs = arr(must.tfs_slot)
+    must_norms = arr(must.norm_slot, np.int32)
+    must_idf = float(np.asarray(plan.scalars[must.idf_slot]))
+    must_avg = float(np.asarray(plan.scalars[must.avg_len_slot]))
+    empty = np.zeros(0, np.int32)
+    s_arrs = [(arr(s.ids_slot), arr(s.tfs_slot)) for s in shoulds]
+    while len(s_arrs) < 2:
+        s_arrs.append((empty, empty))
+    if shoulds:
+        should_norms = arr(shoulds[0].norm_slot, np.int32)
+        should_avg = float(np.asarray(plan.scalars[shoulds[0].avg_len_slot]))
+    else:
+        should_norms = np.zeros(1, np.int32)
+        should_avg = 1.0
+    s_idfs = [float(np.asarray(plan.scalars[s.idf_slot])) for s in shoulds]
+    while len(s_idfs) < 2:
+        s_idfs.append(0.0)
+
+    topk_scores = np.zeros(max(k, 1), np.float32)
+    topk_docs = np.zeros(max(k, 1), np.int32)
+    count_out = np.zeros(1, np.int64)
+
+    def ptr(a, t):
+        return a.ctypes.data_as(ctypes.POINTER(t))
+
+    def run_once():
+        lib.leaf_bool_range(
+            ptr(must_ids, ctypes.c_int32), ptr(must_tfs, ctypes.c_int32),
+            ctypes.c_int64(len(must_ids)), ptr(must_norms, ctypes.c_int32),
+            ctypes.c_double(must_idf), ctypes.c_double(must_avg),
+            ptr(s_arrs[0][0], ctypes.c_int32),
+            ptr(s_arrs[0][1], ctypes.c_int32),
+            ctypes.c_int64(len(s_arrs[0][0])),
+            ptr(s_arrs[1][0], ctypes.c_int32),
+            ptr(s_arrs[1][1], ctypes.c_int32),
+            ctypes.c_int64(len(s_arrs[1][0])),
+            ptr(should_norms, ctypes.c_int32),
+            ctypes.c_double(s_idfs[0]), ctypes.c_double(s_idfs[1]),
+            ctypes.c_double(should_avg),
+            ptr(ts_values, ctypes.c_int64), ptr(ts_present, ctypes.c_uint8),
+            ctypes.c_int64(lo), ctypes.c_int64(hi),
+            ctypes.c_int64(plan.num_docs), ctypes.c_int32(k),
+            ptr(topk_scores, ctypes.c_float), ptr(topk_docs, ctypes.c_int32),
+            ptr(count_out, ctypes.c_int64))
+
+    run_once()
+    if int(count_out[0]) != reference_count:
+        print(f"# native bool+range comparator count mismatch: "
+              f"{int(count_out[0])} vs {reference_count} — dropping "
+              "denominator", file=sys.stderr)
+        return None
+    lat = []
+    for _ in range(iters):
+        t0 = time.monotonic()
+        run_once()
+        lat.append(time.monotonic() - t0)
+    return {"native_cpu_ms": round(_percentile(lat, 0.5) * 1000, 3)}
+
+
 def _batch_width_for(plan) -> int:
     """Queries per dispatch, bounded by per-lane device footprint: dense
     plans materialize [num_docs_padded] masks/scores/keys per lane, so a
@@ -465,6 +582,10 @@ def _measure_single_split(request, mapper, reader, iters: int,
     # stand-in for the reference tantivy leaf; see _native_cpu_leaf)
     native = _native_cpu_leaf(plan, request, int(resp.num_hits),
                               max(5, iters // 2))
+    if not native:
+        # boolean AND/OR + range shape (c2): its own native kernel
+        native = _native_cpu_bool_range(plan, request, int(resp.num_hits),
+                                        max(5, iters // 2))
     if native:
         stats.update(native)
 
@@ -805,6 +926,12 @@ def main() -> None:
         "pipeline_batch": PIPELINE_BATCH,
         "num_docs": NUM_DOCS, "configs": results,
     }
+    if platform in ("cpu", "cpu-fallback"):
+        # raw CPU-fallback ratio lives HERE, where its context (platform,
+        # per-config numbers) is visible; the printed headline withholds
+        # every ratio on fallback runs
+        details["cpu_fallback_vs_1s_bound"] = round(
+            1000.0 / results["flagship"]["e2e_ms"], 2)
     details_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAILS.json")
     with open(details_path, "w") as fh:
@@ -854,13 +981,13 @@ def main() -> None:
     }
     if platform in ("cpu", "cpu-fallback"):
         # honesty: JAX-on-CPU is not the production leaf path, so a CPU run
-        # must not headline a ratio that reads like an accelerator result —
-        # the number survives under an explicit name, the headline leads
-        # with the caveat, and vs_baseline is withheld
+        # must not headline ANY ratio that reads like an accelerator result
+        # — the headline leads with the caveat and carries latency only;
+        # raw numbers stay in BENCH_DETAILS.json
+        # (cpu_fallback_vs_1s_bound + per-config tables)
         headline["metric"] = ("no TPU available — CPU fallback: "
                               + headline["metric"])
         headline["vs_baseline"] = None
-        headline["vs_1s_bound_on_cpu_fallback"] = vs
     print(json.dumps(headline))
 
 

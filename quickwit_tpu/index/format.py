@@ -26,7 +26,14 @@ Array naming convention (see writer.py):
     inv.{field}.positions.offsets / .data      (record="position" fields)
     inv.{field}.fieldnorm
     col.{field}.values / .present / .ordinals / .dict_blob / .dict_offsets
+    col.{field}.packed / .zmin / .zmax      (format v2, see docs/device-layout.md)
     store.data / store.block_offsets / store.block_first_doc
+
+Format v2 stores eligible numeric fast-field columns frame-of-reference
+bit-packed (`col.{field}.packed`, u8/u16/u32 deltas from the column min,
+optionally GCD-scaled) instead of the full-width `col.{field}.values`,
+plus per-512-doc-block min/max zonemaps (`.zmin`/`.zmax`). v1 splits (raw
+full-width columns, no zonemaps) remain readable and searchable.
 """
 
 from __future__ import annotations
@@ -38,8 +45,16 @@ from typing import Any, Optional
 import numpy as np
 
 MAGIC = b"QWTPU001"
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
+# Versions this reader still opens: v1 splits carry raw full-width columns
+# only; every v2 structure is optional per column, so the v1 fallback is
+# simply "the packed/zonemap arrays are absent".
+SUPPORTED_FORMAT_VERSIONS = (1, 2)
 ALIGN = 128
+
+# Zonemap granularity: per-block min/max over present docs, one block =
+# ZONEMAP_BLOCK doc lanes. Divides DOC_PAD so padded tails are whole blocks.
+ZONEMAP_BLOCK = 512
 
 # Docs are padded to a multiple of DOC_PAD (8 sublanes x 128 lanes) so dense
 # per-doc arrays tile cleanly onto the VPU; postings to POSTING_PAD lanes.
@@ -103,7 +118,7 @@ class SplitFooter:
     @staticmethod
     def from_json_bytes(data: bytes) -> "SplitFooter":
         doc = json.loads(data)
-        if doc.get("format_version") != FORMAT_VERSION:
+        if doc.get("format_version") not in SUPPORTED_FORMAT_VERSIONS:
             raise ValueError(f"unsupported split format version {doc.get('format_version')}")
         arrays = {a["name"]: ArrayMeta.from_dict(a) for a in doc["arrays"]}
         tr = doc.get("time_range")

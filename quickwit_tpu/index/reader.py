@@ -169,9 +169,58 @@ class SplitReader:
         return self.array(f"inv.{field}.fieldnorm")
 
     # --- fast-field columns ------------------------------------------------
+    def column_packing(self, field: str) -> Optional[dict[str, Any]]:
+        """FOR packing info (`for_min`/`for_scale`/`bit_width`) when the
+        column is stored as packed deltas (format v2), else None."""
+        info = self.field_meta(field).get("packed")
+        if info and self.has_array(f"col.{field}.packed"):
+            return info
+        return None
+
+    def column_packed(self, field: str) -> tuple[np.ndarray, np.ndarray]:
+        """(deltas, present) — the compact on-device representation of a
+        packed column; `value = for_min + delta * for_scale`."""
+        return (self.array(f"col.{field}.packed"),
+                self.array(f"col.{field}.present"))
+
+    def column_zonemaps(self, field: str) -> Optional[tuple[np.ndarray, np.ndarray]]:
+        """Per-block (zmin, zmax) bounds in the column's on-disk domain
+        (scaled deltas when packed, raw values otherwise); None for v1
+        splits, which predate zonemaps."""
+        if not self.has_array(f"col.{field}.zmin"):
+            return None
+        return self.array(f"col.{field}.zmin"), self.array(f"col.{field}.zmax")
+
     def column_values(self, field: str) -> tuple[np.ndarray, np.ndarray]:
-        """(values, present) for a numeric column, padded to num_docs_padded."""
-        return self.array(f"col.{field}.values"), self.array(f"col.{field}.present")
+        """(values, present) for a numeric column, padded to num_docs_padded.
+
+        Packed columns (format v2) are reconstructed full-width host-side
+        and cached, so every host consumer (exact sort-value re-reads,
+        ordinalization, derived seconds columns, the doc-store-free bench
+        comparator) sees the exact array a raw split would store. Device
+        staging should prefer `column_packed` — that is where the byte
+        savings live."""
+        key = f"col.{field}.values"
+        if key not in self._arrays and not self.has_array(key):
+            info = self.column_packing(field)
+            if info is not None:
+                packed = self.array(f"col.{field}.packed")
+                fm = self.field_meta(field)
+                kind = fm.get("col_type") or fm.get("type")
+                if kind == "u64":
+                    values = (packed.astype(np.uint64)
+                              * np.uint64(info["for_scale"])
+                              + np.uint64(info["for_min"]))
+                else:
+                    values = (packed.astype(np.int64)
+                              * np.int64(info["for_scale"])
+                              + np.int64(info["for_min"]))
+                # raw splits scatter into zeros: absent lanes hold 0, not
+                # for_min — reconstruct bit-identically
+                present = self.array(f"col.{field}.present")
+                values = np.where(present != 0, values, values.dtype.type(0))
+                self._arrays[key] = values
+        return self.array(key), self.array(f"col.{field}.present")
 
     def column_ordinals(self, field: str) -> np.ndarray:
         return self.array(f"col.{field}.ordinals")

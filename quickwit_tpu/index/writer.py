@@ -18,6 +18,7 @@ re-targeted at the TPU array layout of `format.py`:
 from __future__ import annotations
 
 import json
+import os
 import zlib
 from collections import defaultdict
 from typing import Any, Optional
@@ -28,7 +29,9 @@ from ..models.doc_mapper import (
     DocMapper, FieldMapping, FieldType, TypedDoc, canonical_term,
     dynamic_canonical)
 from ..utils.datetime_utils import truncate_to_precision
-from .format import DOC_PAD, POSTING_PAD, SplitFileBuilder, SplitFooter, pad_to
+from .format import (
+    DOC_PAD, POSTING_PAD, ZONEMAP_BLOCK, SplitFileBuilder, SplitFooter,
+    pad_to)
 
 _STORE_BLOCK_BYTES = 64 * 1024
 _NUMERIC_TYPES = (FieldType.I64, FieldType.U64, FieldType.F64, FieldType.BOOL,
@@ -491,13 +494,32 @@ class SplitWriter:
             values = np.zeros(num_docs_padded, dtype=dtype)
             vals = np.fromiter(col.values.values(), dtype=dtype, count=len(col.values))
             values[doc_ids] = vals
-            builder.add_array(f"col.{name}.values", values)
-            builder.add_array(f"col.{name}.present", present)
-            return {
+            meta = {
                 "fast": True, "column_kind": "numeric",
                 "min_value": (vals.min().item() if len(vals) else None),
                 "max_value": (vals.max().item() if len(vals) else None),
             }
+            packed = _pack_numeric(col.fm.type, vals)
+            if packed is not None:
+                # frame-of-reference layout: the narrow delta lanes REPLACE
+                # the full-width values array on disk and in HBM; the reader
+                # reconstructs full-width views host-side on demand
+                deltas, for_min, for_scale, bit_width = packed
+                lanes = np.zeros(num_docs_padded, dtype=deltas.dtype)
+                lanes[doc_ids] = deltas
+                builder.add_array(f"col.{name}.packed", lanes)
+                meta["packed"] = {"for_min": for_min, "for_scale": for_scale,
+                                  "bit_width": bit_width}
+                zdomain = lanes.astype(np.int32)
+            else:
+                builder.add_array(f"col.{name}.values", values)
+                zdomain = values
+            builder.add_array(f"col.{name}.present", present)
+            zmin, zmax = _column_zonemaps(zdomain, present)
+            builder.add_array(f"col.{name}.zmin", zmin)
+            builder.add_array(f"col.{name}.zmax", zmax)
+            meta["zonemap_block"] = ZONEMAP_BLOCK
+            return meta
         # dictionary-encoded raw text column (terms-agg substrate)
         all_values = col.multi if col.multi else {
             d: [v] for d, v in col.values.items()}
@@ -564,6 +586,68 @@ class SplitWriter:
         builder.add_array("store.data", np.frombuffer(b"".join(blocks), dtype=np.uint8))
         builder.add_array("store.block_offsets", np.array(block_offsets, dtype=np.int64))
         builder.add_array("store.block_first_doc", np.array(block_first_doc, dtype=np.int32))
+
+
+def _packing_enabled() -> bool:
+    """Kill switch for A/B comparisons and bug triage: QW_DISABLE_PACKED=1
+    writes raw full-width numeric columns (the v1 layout, still under a v2
+    footer). Read per call so tests can flip it between splits."""
+    return os.environ.get("QW_DISABLE_PACKED", "0") != "1"
+
+
+def _pack_numeric(field_type: FieldType, vals: np.ndarray):
+    """Frame-of-reference packing decision for one numeric column.
+
+    value = for_min + delta * for_scale, deltas stored in the narrowest
+    unsigned lane (u8/u16/u32). for_scale is the GCD of the deltas — it
+    collapses quantized domains (whole-second datetime micros scale by
+    1e6, all-equal columns collapse to u8 zeros). The scaled span is
+    capped just below 2^31 so kernels compare deltas in i32 and the host
+    can express a never-matching rebased bound (span+1) in the same
+    domain. f64 columns and wider-span integer columns keep the raw
+    full-width layout (the high-dynamic-range fallback).
+
+    Returns (deltas, for_min, for_scale, bit_width) or None for raw.
+    """
+    if not _packing_enabled() or field_type is FieldType.F64 or not len(vals):
+        return None
+    for_min = int(vals.min())
+    span = int(vals.max()) - for_min
+    if span >= (1 << 62):  # delta subtraction below must not overflow i64
+        return None
+    deltas = (vals - vals.dtype.type(for_min)).astype(np.uint64)
+    for_scale = int(np.gcd.reduce(deltas)) or 1
+    if for_scale > 1:
+        deltas //= np.uint64(for_scale)
+    span_scaled = span // for_scale
+    if span_scaled <= 0xFF:
+        bit_width = 8
+    elif span_scaled <= 0xFFFF:
+        bit_width = 16
+    elif span_scaled <= (1 << 31) - 2:
+        bit_width = 32
+    else:
+        return None
+    lane = {8: np.uint8, 16: np.uint16, 32: np.uint32}[bit_width]
+    return deltas.astype(lane), for_min, for_scale, bit_width
+
+
+def _column_zonemaps(values: np.ndarray, present: np.ndarray):
+    """Per-ZONEMAP_BLOCK-doc min/max over PRESENT values, in the on-disk
+    domain of the column (scaled i32 deltas for packed columns, raw values
+    otherwise). Blocks with no present docs get inverted sentinels
+    (zmin > zmax where the dtype allows) so range predicates skip them."""
+    nb = values.shape[0] // ZONEMAP_BLOCK
+    v = values.reshape(nb, ZONEMAP_BLOCK)
+    p = present.reshape(nb, ZONEMAP_BLOCK).astype(bool)
+    if values.dtype.kind == "f":
+        lo_sent, hi_sent = -np.inf, np.inf
+    else:
+        info = np.iinfo(values.dtype)
+        lo_sent, hi_sent = info.min, info.max
+    zmin = np.where(p, v, hi_sent).min(axis=1).astype(values.dtype)
+    zmax = np.where(p, v, lo_sent).max(axis=1).astype(values.dtype)
+    return zmin, zmax
 
 
 def _fast_value(fm: FieldMapping, value: Any):

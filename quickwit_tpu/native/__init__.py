@@ -112,5 +112,6 @@ def load_leafbench():
             _leafbench_cached = None
             return None
         lib.leaf_term_aggs.restype = None
+        lib.leaf_bool_range.restype = None
         _leafbench_cached = lib
         return lib

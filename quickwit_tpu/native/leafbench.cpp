@@ -21,7 +21,34 @@
 namespace {
 constexpr float kK1 = 1.2f;
 constexpr float kB = 0.75f;
+
+// fixed-size min-heap on (score, -doc) — tantivy's TopCollector shape
+struct Hit {
+  float score;
+  int32_t doc;
+  bool operator<(const Hit& o) const {
+    // heap of the WORST kept hit on top: higher score = better,
+    // lower doc breaks ties (matches the engine's doc-asc tie-break)
+    if (score != o.score) return score > o.score;
+    return doc < o.doc;
+  }
+};
+
+inline float Bm25(float tf, float norm, float inv_avg, float idf_gain) {
+  const float denom = tf + kK1 * (1.0f - kB + kB * norm * inv_avg);
+  return idf_gain * tf / std::max(denom, 1e-9f);
 }
+
+// Merge-advance a sorted posting list to `doc`; returns its tf or 0.
+// Pad entries (ids < 0 or >= num_docs) never equal a real doc id.
+inline float TfAt(const int32_t* ids, const int32_t* tfs, int64_t n,
+                  int64_t* cursor, int32_t doc) {
+  int64_t i = *cursor;
+  while (i < n && ids[i] >= 0 && ids[i] < doc) ++i;
+  *cursor = i;
+  return (i < n && ids[i] == doc) ? static_cast<float>(tfs[i]) : 0.0f;
+}
+}  // namespace
 
 extern "C" {
 
@@ -46,17 +73,6 @@ void leaf_term_aggs(const int32_t* ids, const int32_t* tfs, int64_t n_post,
   const float inv_avg = 1.0f / std::max(static_cast<float>(avg_len), 1e-9f);
   int64_t count = 0;
 
-  // fixed-size min-heap on (score, -doc) — tantivy's TopCollector shape
-  struct Hit {
-    float score;
-    int32_t doc;
-    bool operator<(const Hit& o) const {
-      // heap of the WORST kept hit on top: higher score = better,
-      // lower doc breaks ties (matches the engine's doc-asc tie-break)
-      if (score != o.score) return score > o.score;
-      return doc < o.doc;
-    }
-  };
   std::vector<Hit> heap;
   heap.reserve(k > 0 ? k : 1);
 
@@ -65,10 +81,9 @@ void leaf_term_aggs(const int32_t* ids, const int32_t* tfs, int64_t n_post,
     if (doc < 0 || doc >= num_docs) continue;  // pad slot
     ++count;
     if (k > 0) {
-      const float tf = static_cast<float>(tfs[i]);
-      const float norm = static_cast<float>(norms[doc]);
-      const float denom = tf + kK1 * (1.0f - kB + kB * norm * inv_avg);
-      const float score = idf_gain * tf / std::max(denom, 1e-9f);
+      const float score = Bm25(static_cast<float>(tfs[i]),
+                               static_cast<float>(norms[doc]),
+                               inv_avg, idf_gain);
       if (static_cast<int32_t>(heap.size()) < k) {
         heap.push_back({score, doc});
         std::push_heap(heap.begin(), heap.end());
@@ -85,6 +100,84 @@ void leaf_term_aggs(const int32_t* ids, const int32_t* tfs, int64_t n_post,
     if (n_terms > 0 && ord_col != nullptr) {
       const int32_t ord = ord_col[doc];
       if (ord >= 0 && ord < n_terms) ++terms_out[ord];
+    }
+  }
+  if (k > 0) {
+    std::sort_heap(heap.begin(), heap.end());  // best-first
+    for (size_t i = 0; i < heap.size(); ++i) {
+      topk_scores[i] = heap[i].score;
+      topk_docs[i] = heap[i].doc;
+    }
+    for (int32_t i = static_cast<int32_t>(heap.size()); i < k; ++i) {
+      topk_scores[i] = -1.0f;
+      topk_docs[i] = -1;
+    }
+  }
+  *count_out = count;
+}
+
+// One leaf search of a boolean query: scored MUST term AND'ed with a
+// timestamp range filter, plus up to two optional scored SHOULD terms
+// (pure OR — they widen scores, never the match set). The c2 benchmark
+// shape. All posting lists are doc-id-sorted with pads outside
+// [0, num_docs); range bounds are INCLUSIVE and pre-resolved by the
+// caller into the column's on-disk domain (raw values, or scaled deltas
+// for FOR-packed columns — comparisons are domain-invariant).
+//   must_*:   scored conjunctive term postings + its field's norms
+//   s1_*/s2_*: should-term postings (n == 0 disables a slot); both share
+//              one field (norms + avg_len), per-term idf
+//   ts_*:     range operand column (int64) + present bytes
+// Outputs (caller-allocated): topk_scores/topk_docs[k], count_out[1].
+void leaf_bool_range(const int32_t* must_ids, const int32_t* must_tfs,
+                     int64_t n_must, const int32_t* must_norms,
+                     double must_idf, double must_avg_len,
+                     const int32_t* s1_ids, const int32_t* s1_tfs,
+                     int64_t n_s1,
+                     const int32_t* s2_ids, const int32_t* s2_tfs,
+                     int64_t n_s2,
+                     const int32_t* should_norms, double s1_idf,
+                     double s2_idf, double should_avg_len,
+                     const int64_t* ts_values, const uint8_t* ts_present,
+                     int64_t lo, int64_t hi,
+                     int64_t num_docs, int32_t k,
+                     float* topk_scores, int32_t* topk_docs,
+                     int64_t* count_out) {
+  const float must_gain = static_cast<float>(must_idf) * (kK1 + 1.0f);
+  const float s1_gain = static_cast<float>(s1_idf) * (kK1 + 1.0f);
+  const float s2_gain = static_cast<float>(s2_idf) * (kK1 + 1.0f);
+  const float must_inv_avg =
+      1.0f / std::max(static_cast<float>(must_avg_len), 1e-9f);
+  const float should_inv_avg =
+      1.0f / std::max(static_cast<float>(should_avg_len), 1e-9f);
+  int64_t count = 0;
+  int64_t c1 = 0, c2 = 0;  // merge cursors into the should lists
+
+  std::vector<Hit> heap;
+  heap.reserve(k > 0 ? k : 1);
+
+  for (int64_t i = 0; i < n_must; ++i) {
+    const int32_t doc = must_ids[i];
+    if (doc < 0 || doc >= num_docs) continue;  // pad slot
+    if (!ts_present[doc]) continue;
+    const int64_t v = ts_values[doc];
+    if (v < lo || v > hi) continue;
+    ++count;
+    if (k <= 0) continue;
+    float score = Bm25(static_cast<float>(must_tfs[i]),
+                       static_cast<float>(must_norms[doc]),
+                       must_inv_avg, must_gain);
+    const float snorm = static_cast<float>(should_norms[doc]);
+    const float tf1 = TfAt(s1_ids, s1_tfs, n_s1, &c1, doc);
+    if (tf1 > 0.0f) score += Bm25(tf1, snorm, should_inv_avg, s1_gain);
+    const float tf2 = TfAt(s2_ids, s2_tfs, n_s2, &c2, doc);
+    if (tf2 > 0.0f) score += Bm25(tf2, snorm, should_inv_avg, s2_gain);
+    if (static_cast<int32_t>(heap.size()) < k) {
+      heap.push_back({score, doc});
+      std::push_heap(heap.begin(), heap.end());
+    } else if (Hit{score, doc} < heap.front()) {
+      std::pop_heap(heap.begin(), heap.end());
+      heap.back() = {score, doc};
+      std::push_heap(heap.begin(), heap.end());
     }
   }
   if (k > 0) {

@@ -189,6 +189,31 @@ SEARCH_TIMED_OUT_TOTAL = METRICS.counter(
 SEARCH_LEAF_RETRIES_TOTAL = METRICS.counter(
     "qw_search_leaf_retries_total",
     "Leaf requests retried on another node after a failure")
+# Phase-2 doc fetches retried once on the next replica (root.py
+# _fetch_docs_phase); the leaf retry budget above covers phase 1 only.
+SEARCH_FETCH_DOCS_RETRIES_TOTAL = METRICS.counter(
+    "qw_search_fetch_docs_retries_total",
+    "Per-split doc fetches retried on another replica after a failure")
+
+# --- query batcher (search/batcher.py) ------------------------------------
+# Batching efficiency is queries/dispatches: 1.0 means no coalescing,
+# higher means concurrent same-shape queries rode shared vmapped
+# dispatches. Exported as two counters (PromQL rate-ratio friendly) plus
+# a convenience gauge of the cumulative ratio.
+SEARCH_BATCHER_QUERIES_TOTAL = METRICS.counter(
+    "qw_search_batcher_queries_total",
+    "Queries entering the cross-query dispatch batcher")
+SEARCH_BATCHER_DISPATCHES_TOTAL = METRICS.counter(
+    "qw_search_batcher_dispatches_total",
+    "Device dispatch rounds issued by the batcher")
+SEARCH_BATCHER_RATIO = METRICS.gauge(
+    "qw_search_batcher_ratio",
+    "Cumulative queries-per-dispatch coalescing ratio of the batcher")
+# Time a rider spends queued between enqueue and its dispatch starting —
+# the convoy window. Followers pay this to ride a shared dispatch.
+SEARCH_BATCHER_QUEUE_WAIT = METRICS.histogram(
+    "qw_search_batcher_queue_wait_seconds",
+    "Wait between a query entering the batcher and its dispatch starting")
 
 # --- dynamic top-K split pruning (search/pruning.py) ----------------------
 # Splits never executed because their sort-value/score upper bound could

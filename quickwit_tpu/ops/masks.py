@@ -55,13 +55,43 @@ def not_mask(m: jnp.ndarray) -> jnp.ndarray:
 
 def range_mask(values: jnp.ndarray, present: jnp.ndarray,
                lower, upper, lower_incl: bool, upper_incl: bool,
-               has_lower: bool, has_upper: bool) -> jnp.ndarray:
+               has_lower: bool, has_upper: bool,
+               zmin: jnp.ndarray = None, zmax: jnp.ndarray = None,
+               zonemap_block: int = 512) -> jnp.ndarray:
     """Range predicate over a numeric fast column.
 
     `has_*`/`*_incl` are static (they shape the compiled graph); the bounds
     themselves are traced scalars so the same compiled plan serves different
     bound values.
+
+    Block-sparse evaluation: when per-block zonemaps (`zmin`/`zmax`, one
+    entry per `zonemap_block` doc lanes, same domain as `values` — scaled
+    deltas for FOR-packed columns) ride along as traced operands, the
+    per-doc compare is gated by a block-level prequalification mask: a
+    block whose [zmin, zmax] envelope cannot intersect the bounds
+    contributes no lanes, mirroring split-level pruning
+    (search/pruning.py) one level down. Blocks with no present docs carry
+    inverted sentinels and never qualify.
     """
+    if zmin is not None:
+        blk_ok = jnp.ones(zmin.shape, dtype=jnp.bool_)
+        if has_lower:
+            blk_ok = blk_ok & (zmax >= lower if lower_incl else zmax > lower)
+        if has_upper:
+            blk_ok = blk_ok & (zmin <= upper if upper_incl else zmin < upper)
+        nb = zmin.shape[0]
+        blocked = values.reshape(nb, zonemap_block)
+        pblocked = present.reshape(nb, zonemap_block).astype(jnp.bool_)
+        mask = jnp.where(blk_ok[:, None], pblocked, False)
+        if has_lower:
+            mask = mask & jnp.where(
+                blk_ok[:, None],
+                blocked >= lower if lower_incl else blocked > lower, False)
+        if has_upper:
+            mask = mask & jnp.where(
+                blk_ok[:, None],
+                blocked <= upper if upper_incl else blocked < upper, False)
+        return mask.reshape(-1)
     mask = present.astype(jnp.bool_)
     if has_lower:
         mask = mask & (values >= lower if lower_incl else values > lower)

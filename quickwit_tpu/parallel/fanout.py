@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..index.format import ZONEMAP_BLOCK
 from ..index.reader import SplitReader
 from ..models.doc_mapper import DocMapper
 from ..query.aggregations import DateHistogramAgg, HistogramAgg, TermsAgg, parse_aggs
@@ -153,13 +154,19 @@ def _global_agg_overrides(agg_specs, readers: list[SplitReader],
             "terms_cards": terms_cards, "terms_keys": terms_keys}
 
 
-def _pad_fill(key: str, num_docs_padded: int):
+def _pad_fill(key: str, num_docs_padded: int, dtype=None):
     if key.startswith("post.") and key.endswith(".ids"):
         return num_docs_padded        # OOB scatter sentinel
     if key.startswith("pre.") and key.endswith(".ids"):
         return num_docs_padded
     if "ordinals" in key:
         return -1
+    if key.endswith(".zmin"):
+        # inverted envelope: pad blocks never qualify (harmless either way —
+        # their doc lanes carry present=0 — but keep the zonemaps honest)
+        return np.inf if dtype.kind == "f" else np.iinfo(dtype).max
+    if key.endswith(".zmax"):
+        return -np.inf if dtype.kind == "f" else np.iinfo(dtype).min
     return 0
 
 
@@ -217,13 +224,23 @@ def build_batch(request: SearchRequest, doc_mapper: DocMapper,
     stacked_arrays: list[np.ndarray] = []
     for slot in range(num_slots):
         key = template.array_keys[slot]
-        fill = _pad_fill(key, num_docs_padded)
         per_split = [p.arrays[slot] for p in plans]
+        dtype = per_split[0].dtype
+        if any(a.dtype != dtype for a in per_split[1:]):
+            # e.g. FOR-packed lanes of different widths (u8 vs u16), or a
+            # packed/raw mix whose slot layout happened to coincide —
+            # numpy slice assignment would truncate silently, so refuse
+            # and let the service fall back to per-split execution
+            raise ValueError(
+                f"array slot {key!r} has non-uniform dtypes across splits "
+                "(mixed column packings need per-split execution)")
+        fill = _pad_fill(key, num_docs_padded, dtype)
         # uniform last-dim length: postings pad to max, doc-dim pad to padded
         max_len = max(a.shape[0] for a in per_split)
-        if key.startswith(("col.", "norm.")):
+        if key.endswith((".zmin", ".zmax")):
+            max_len = num_docs_padded // ZONEMAP_BLOCK
+        elif key.startswith(("col.", "norm.")):
             max_len = num_docs_padded
-        dtype = per_split[0].dtype
         out = np.full((total, max_len), fill, dtype=dtype)
         for i, a in enumerate(per_split):
             out[i, : a.shape[0]] = a
@@ -231,7 +248,11 @@ def build_batch(request: SearchRequest, doc_mapper: DocMapper,
 
     stacked_scalars: list[np.ndarray] = []
     for slot in range(len(template.scalars)):
-        vals = [p.scalars[slot] for p in plans]
+        vals = [np.asarray(p.scalars[slot]) for p in plans]
+        if any(v.dtype != vals[0].dtype for v in vals[1:]):
+            raise ValueError(
+                f"scalar slot {slot} has non-uniform dtypes across splits "
+                "(mixed column packings need per-split execution)")
         out = np.zeros(total, dtype=vals[0].dtype)
         for i, v in enumerate(vals):
             out[i] = v
@@ -292,9 +313,12 @@ def batch_shardings(batch: SplitBatch, mesh: Mesh):
     from jax.sharding import NamedSharding
     array_shardings = []
     for key in batch.template.array_keys:
-        if key.startswith(("col.", "norm.")):
+        if key.startswith(("col.", "norm.")) \
+                and not key.endswith((".zmin", ".zmax")):
             array_shardings.append(NamedSharding(mesh, P("splits", "docs")))
         else:
+            # zonemaps are per-BLOCK (padded/512), not per-doc: replicate
+            # along the doc axis so block gating never crosses shards
             array_shardings.append(NamedSharding(mesh, P("splits", None)))
     scalar_shardings = [NamedSharding(mesh, P("splits"))] * len(batch.template.scalars)
     nd_sharding = NamedSharding(mesh, P("splits"))

@@ -20,6 +20,10 @@ admission is exact:
 A single query larger than the whole budget is admitted alone (pinned
 bytes of others == 0) — refusing it would deadlock, and the reference
 likewise lets one oversized split through to fail loudly on-device.
+
+Format-v2 splits stage FOR-packed numeric columns as narrow delta lanes
+(docs/device-layout.md), so the bytes admitted here are the compact
+footprint — a fixed budget admits proportionally more concurrent splits.
 """
 
 from __future__ import annotations

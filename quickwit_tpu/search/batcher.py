@@ -27,10 +27,14 @@ never orphan its followers."""
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Optional
 
 from ..common.deadline import Deadline, DeadlineExceeded, current_deadline
-from ..observability.metrics import SEARCH_SHED_TOTAL
+from ..observability.metrics import (
+    SEARCH_BATCHER_DISPATCHES_TOTAL, SEARCH_BATCHER_QUERIES_TOTAL,
+    SEARCH_BATCHER_QUEUE_WAIT, SEARCH_BATCHER_RATIO, SEARCH_SHED_TOTAL,
+)
 from . import executor
 
 # Extra follower wait beyond its own deadline: the leader may be setting the
@@ -40,7 +44,8 @@ _FOLLOWER_SLACK_SECS = 0.05
 
 
 class _Pending:
-    __slots__ = ("scalars", "event", "result", "error", "deadline")
+    __slots__ = ("scalars", "event", "result", "error", "deadline",
+                 "enqueued_at")
 
     def __init__(self, scalars, deadline: Optional[Deadline] = None):
         self.scalars = scalars
@@ -48,6 +53,7 @@ class _Pending:
         self.result: Any = None
         self.error: Exception | None = None
         self.deadline = deadline
+        self.enqueued_at = time.monotonic()
 
 
 class QueryBatcher:
@@ -80,6 +86,7 @@ class QueryBatcher:
         my_queue = None
         with self._lock:
             self.num_queries += 1
+            SEARCH_BATCHER_QUERIES_TOTAL.inc()
             queue = self._queues.get(key)
             if queue is not None and len(queue) < self.max_batch:
                 queue.append(me)          # follower: the leader serves us
@@ -127,8 +134,15 @@ class QueryBatcher:
                     pending.event.set()
                 try:
                     if alive:
+                        now = time.monotonic()
+                        for pending in alive:
+                            SEARCH_BATCHER_QUEUE_WAIT.observe(
+                                now - pending.enqueued_at)
                         with self._lock:
                             self.num_dispatches += 1
+                            SEARCH_BATCHER_DISPATCHES_TOTAL.inc()
+                            SEARCH_BATCHER_RATIO.set(
+                                self.num_queries / self.num_dispatches)
                         if self.fault_injector is not None:
                             self.fault_injector.perturb("batcher.dispatch")
                         if len(alive) == 1 and alive[0] is me:

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..index.format import ZONEMAP_BLOCK
 from ..ops import aggs as agg_ops
 from ..ops import masks as mask_ops
 from ..ops import topk as topk_ops
@@ -337,16 +338,48 @@ def _posting_space_eligible(plan: LoweredPlan) -> bool:
     return True
 
 
-class _GatherView:
-    """arrays[slot] gathered at per-posting doc ids — lets the bucket-agg
-    evaluator run unchanged in posting space."""
+class _RebaseView:
+    """arrays[slot] with FOR-packed slots reconstructed in-register:
+    `delta * for_scale + for_min` in the column's integer domain (see
+    LoweredPlan.rebase), so sort keys, metric inputs and cardinality
+    hashes observe full-width values while HBM holds the narrow lanes.
+    Absent lanes reconstruct to for_min rather than the raw layout's 0 —
+    invisible downstream because every consumer masks by the present
+    column."""
 
-    def __init__(self, arrays, safe_ids):
+    def __init__(self, arrays, scalars, rebase):
         self.arrays = arrays
-        self.safe_ids = safe_ids
+        self.scalars = scalars
+        self.rebase = rebase
 
     def __getitem__(self, slot: int):
-        return self.arrays[slot][self.safe_ids]
+        arr = self.arrays[slot]
+        rb = self.rebase.get(slot)
+        if rb is None:
+            return arr
+        scale, fmin = self.scalars[rb[0]], self.scalars[rb[1]]
+        return arr.astype(scale.dtype) * scale + fmin
+
+
+class _GatherView:
+    """arrays[slot] gathered at per-posting doc ids — lets the bucket-agg
+    evaluator run unchanged in posting space. FOR-packed slots rebase
+    AFTER the gather: the [P]-sized reconstruction is cheaper than
+    materializing the full-width doc-space column first."""
+
+    def __init__(self, arrays, safe_ids, scalars=None, rebase=None):
+        self.arrays = arrays
+        self.safe_ids = safe_ids
+        self.scalars = scalars
+        self.rebase = rebase or {}
+
+    def __getitem__(self, slot: int):
+        g = self.arrays[slot][self.safe_ids]
+        rb = self.rebase.get(slot)
+        if rb is None:
+            return g
+        scale, fmin = self.scalars[rb[0]], self.scalars[rb[1]]
+        return g.astype(scale.dtype) * scale + fmin
 
 
 def _build_posting_space(plan: LoweredPlan, k: int) -> Callable:
@@ -361,7 +394,7 @@ def _build_posting_space(plan: LoweredPlan, k: int) -> Callable:
         count = jnp.sum(valid.astype(jnp.int32))
         safe_ids = jnp.clip(ids, 0, padded - 1)
         if k == 0:  # count/agg-only: no scoring, no top-k
-            gathered = _GatherView(arrays, safe_ids)
+            gathered = _GatherView(arrays, safe_ids, scalars, plan.rebase)
             agg_out = _eval_aggs(aggs, gathered, scalars, valid)
             return (jnp.zeros((0,), jnp.float64), None,
                     jnp.zeros((0,), jnp.int32), jnp.zeros((0,), jnp.float32),
@@ -381,7 +414,7 @@ def _build_posting_space(plan: LoweredPlan, k: int) -> Callable:
             sort_vals = vals_f32.astype(jnp.float64)
             doc_ids = ids[pos]
             hit_scores = jnp.where(jnp.isneginf(vals_f32), 0.0, vals_f32)
-            gathered = _GatherView(arrays, safe_ids)
+            gathered = _GatherView(arrays, safe_ids, scalars, plan.rebase)
             agg_out = _eval_aggs(aggs, gathered, scalars, valid)
             return sort_vals, None, doc_ids.astype(jnp.int32), hit_scores, \
                 count, tuple(agg_out)
@@ -391,7 +424,7 @@ def _build_posting_space(plan: LoweredPlan, k: int) -> Callable:
                 scalars[root.avg_len_slot], scalars[root.idf_slot])
         else:
             scores = jnp.zeros(num_postings, dtype=jnp.float32)
-        gathered = _GatherView(arrays, safe_ids)
+        gathered = _GatherView(arrays, safe_ids, scalars, plan.rebase)
         # "doc" sorts key on the posting's doc id (ascending already)
         keyed = _keyed_for(sort.by, sort.descending, sort.values_slot,
                            sort.present_slot, gathered, valid, scores, ids)
@@ -603,12 +636,23 @@ def _build(plan: LoweredPlan, k: int) -> Callable:
             scores = mask_ops.dense_from_postings(ids, partial, padded)
             return mask, scores
         if isinstance(node, PRange):
+            values = arrays[node.values_slot]
+            if values.dtype.kind == "u" and values.dtype.itemsize <= 4:
+                # FOR-packed lanes compare as scaled deltas in i32 — the
+                # lowering caps the span so span + 1 (the never-matching
+                # bound) stays representable
+                values = values.astype(jnp.int32)
             return mask_ops.range_mask(
-                arrays[node.values_slot], arrays[node.present_slot],
+                values, arrays[node.present_slot],
                 scalars[node.lo_slot] if node.lo_slot >= 0 else 0,
                 scalars[node.hi_slot] if node.hi_slot >= 0 else 0,
                 node.lo_incl, node.hi_incl,
-                node.lo_slot >= 0, node.hi_slot >= 0), None
+                node.lo_slot >= 0, node.hi_slot >= 0,
+                zmin=(arrays[node.zmin_slot]
+                      if node.zmin_slot >= 0 else None),
+                zmax=(arrays[node.zmax_slot]
+                      if node.zmax_slot >= 0 else None),
+                zonemap_block=ZONEMAP_BLOCK), None
         if isinstance(node, PPresence):
             col = arrays[node.present_slot]
             return (col >= 0) if node.is_ordinal else col.astype(jnp.bool_), None
@@ -654,23 +698,26 @@ def _build(plan: LoweredPlan, k: int) -> Callable:
         return mask, scores
 
     def fn(arrays, scalars, num_docs):
+        # predicate evaluation reads the raw (possibly packed-delta) arrays;
+        # value consumers go through the rebasing view
+        view = _RebaseView(arrays, scalars, plan.rebase)
         mask, scores = eval_node(root, arrays, scalars)
         mask = mask & mask_ops.valid_docs_mask(num_docs, padded)
         if scores is None:
             scores = jnp.zeros(padded, dtype=jnp.float32)
         if k == 0:  # count/agg-only: no keying, no top-k
             count = jnp.sum(mask.astype(jnp.int32))
-            agg_out = _eval_aggs(aggs, arrays, scalars, mask)
+            agg_out = _eval_aggs(aggs, view, scalars, mask)
             return (jnp.zeros((0,), jnp.float64), None,
                     jnp.zeros((0,), jnp.int32), jnp.zeros((0,), jnp.float32),
                     count, tuple(agg_out))
         doc_key = jnp.arange(padded, dtype=jnp.int32)
         keyed = _keyed_for(sort.by, sort.descending, sort.values_slot,
-                           sort.present_slot, arrays, mask, scores, doc_key)
+                           sort.present_slot, view, mask, scores, doc_key)
         keyed2 = None
         if sort.by2 != "none":
             keyed2 = _keyed_for(sort.by2, sort.descending2, sort.values2_slot,
-                                sort.present2_slot, arrays, mask, scores,
+                                sort.present2_slot, view, mask, scores,
                                 doc_key)
         # search_after pushdown: restrict top-k eligibility, NOT counts/aggs
         # (ES semantics: totals and aggregations cover the full query)
@@ -692,7 +739,7 @@ def _build(plan: LoweredPlan, k: int) -> Callable:
         doc_ids = doc_ids.astype(jnp.int32)
         count = jnp.sum(mask.astype(jnp.int32))
         hit_scores = scores[jnp.clip(doc_ids, 0, padded - 1)]
-        agg_out = _eval_aggs(aggs, arrays, scalars, mask)
+        agg_out = _eval_aggs(aggs, view, scalars, mask)
         return sort_vals, sort_vals2, doc_ids, hit_scores, count, tuple(agg_out)
 
     return fn

@@ -73,8 +73,12 @@ def warmup_device_arrays(reader: SplitReader, plan, budget=None
     """Host→device transfer of the plan's arrays, with per-split reuse
     (role of `warmup`, `leaf.rs:304`). With an `HbmBudget`, the exact NEW
     transfer bytes are admitted (blocking while over budget) BEFORE any
-    device_put — the byte-accurate SearchPermitProvider role. Returns
-    (device_arrays, admitted_bytes); the caller releases after execution."""
+    device_put — the byte-accurate SearchPermitProvider role. FOR-packed
+    columns (format v2) reach this point as their narrow u8/u16/u32 delta
+    lanes, so `arr.nbytes` admits the COMPACT device footprint — the
+    packing's HBM win flows through admission with no special casing.
+    Returns (device_arrays, admitted_bytes); the caller releases after
+    execution."""
     cache = _device_cache(reader)
     missing = [(key, arr) for key, arr in zip(plan.array_keys, plan.arrays)
                if key not in cache]

@@ -94,10 +94,16 @@ class PRange:
     hi_slot: int = -1
     lo_incl: bool = True
     hi_incl: bool = True
+    # block-sparse evaluation: per-512-doc-block min/max zonemap arrays in
+    # the same domain as values_slot (scaled deltas for packed columns,
+    # raw values otherwise); -1 = no zonemaps (v1 splits, derived columns)
+    zmin_slot: int = -1
+    zmax_slot: int = -1
 
     def sig(self) -> str:
         return (f"range({self.values_slot},{self.present_slot},{self.lo_slot},"
-                f"{self.hi_slot},{self.lo_incl},{self.hi_incl})")
+                f"{self.hi_slot},{self.lo_incl},{self.hi_incl},"
+                f"{self.zmin_slot},{self.zmax_slot})")
 
 
 @dataclass(frozen=True)
@@ -319,14 +325,22 @@ class LoweredPlan:
     # search_after, only PRESENCE is static — the value rides a scalar slot
     # so the compiled executable is reused across threshold values.
     threshold_slot: int = -1
+    # FOR-packed value loads: array slot -> (scale_slot, min_slot) traced
+    # scalars. Consumers that need actual values (sort keys, metric/bucket
+    # aggs) reconstruct `packed * scale + min` in-kernel; the SLOT map is
+    # static (part of the signature), the scale/min values are traced so
+    # per-split frames share one compiled executable.
+    rebase: dict[int, tuple[int, int]] = dc_field(default_factory=dict)
 
     def signature(self, k: int) -> tuple:
         shapes = tuple((a.shape, str(a.dtype)) for a in self.arrays)
         scalar_dtypes = tuple(str(s.dtype) for s in self.scalars)
         agg_sig = ",".join(a.sig() for a in self.aggs)
+        rebase_sig = tuple(sorted(
+            (slot, slots) for slot, slots in self.rebase.items()))
         return (self.root.sig(), self.sort.sig(), agg_sig, shapes, scalar_dtypes,
                 k, self.num_docs_padded, self.search_after_relation,
-                self.sa_value2_slot >= 0, self.threshold_slot >= 0)
+                self.sa_value2_slot >= 0, self.threshold_slot >= 0, rebase_sig)
 
 
 class _Builder:
@@ -374,6 +388,8 @@ class Lowering:
         self.batch = batch_overrides  # {"histograms": {name: (origin, nb)},
                                       #  "terms_dicts": {field: {key: gord}},
                                       #  "terms_cards": {field: int}}
+        # FOR-packed slots needing in-kernel reconstruction (LoweredPlan.rebase)
+        self.rebase: dict[int, tuple[int, int]] = {}
 
     # --- helpers ----------------------------------------------------------
     def _field(self, name: str) -> FieldMapping:
@@ -486,11 +502,50 @@ class Lowering:
         fm = self._field(field)
         if not fm.fast:
             raise PlanError(f"field {field!r} is not a fast field")
+        packed = self._packed_column_slots(field)
+        if packed is not None:
+            return packed
         values_slot = self.b.add_array(
             f"col.{field}.values", lambda: self.reader.column_values(field)[0])
         present_slot = self.b.add_array(
             f"col.{field}.present", lambda: self.reader.column_values(field)[1])
         return values_slot, present_slot
+
+    def _packed_column_slots(self, field: str) -> Optional[tuple[int, int]]:
+        """Column slots over the PACKED delta lanes (format v2): the narrow
+        array is what ships to HBM, and a per-slot rebase entry (traced
+        scale/min scalars) tells value consumers to reconstruct
+        `delta * scale + min` in-register — full-width semantics, compact
+        bytes. Works under batch plans: the slot map is structural, the
+        frame values ride per-split traced scalars."""
+        info = self.reader.column_packing(field)
+        if info is None:
+            return None
+        values_slot = self.b.add_array(
+            f"col.{field}.packed",
+            lambda: self.reader.column_packed(field)[0])
+        present_slot = self.b.add_array(
+            f"col.{field}.present",
+            lambda: self.reader.column_packed(field)[1])
+        if values_slot not in self.rebase:
+            meta = self.reader.field_meta(field)
+            sdtype = (np.uint64
+                      if (meta.get("col_type") or meta.get("type")) == "u64"
+                      else np.int64)
+            scale_slot = self.b.add_scalar(info["for_scale"], sdtype)
+            min_slot = self.b.add_scalar(info["for_min"], sdtype)
+            self.rebase[values_slot] = (scale_slot, min_slot)
+        return values_slot, present_slot
+
+    def _zonemap_slots(self, field: str) -> tuple[int, int]:
+        """(zmin_slot, zmax_slot) of a column's block zonemaps, or (-1, -1)
+        for splits that predate them (format v1)."""
+        zm = self.reader.column_zonemaps(field)
+        if zm is None:
+            return -1, -1
+        zmin_slot = self.b.add_array(f"col.{field}.zmin", lambda: zm[0])
+        zmax_slot = self.b.add_array(f"col.{field}.zmax", lambda: zm[1])
+        return zmin_slot, zmax_slot
 
     def _parse_bound(self, fm: FieldMapping, value: Any) -> Any:
         if fm.type is FieldType.DATETIME:
@@ -862,6 +917,11 @@ class Lowering:
         lo_incl = ast.lower.inclusive if ast.lower is not None else True
         hi_incl = ast.upper.inclusive if ast.upper is not None else True
 
+        packed = self._packed_range_slots(ast.field, fm, lo_val, lo_incl,
+                                          hi_val, hi_incl)
+        if packed is not None:
+            return packed
+
         s32 = self._s32_range_slots(ast.field, fm, lo_val, lo_incl,
                                     hi_val, hi_incl)
         if s32 is not None:
@@ -872,7 +932,53 @@ class Lowering:
                    if lo_val is not None else -1)
         hi_slot = (self.b.add_scalar(hi_val, dtype)
                    if hi_val is not None else -1)
-        return PRange(values_slot, present_slot, lo_slot, hi_slot, lo_incl, hi_incl)
+        zmin_slot, zmax_slot = self._zonemap_slots(ast.field)
+        return PRange(values_slot, present_slot, lo_slot, hi_slot,
+                      lo_incl, hi_incl, zmin_slot, zmax_slot)
+
+    def _packed_range_slots(self, field: str, fm: FieldMapping, lo_val,
+                            lo_incl: bool, hi_val, hi_incl: bool):
+        """Narrow-integer fast path for range predicates over FOR-packed
+        columns: bounds rebase host-side into the scaled delta domain
+        (`ceil((lo - for_min) / for_scale)` / floor for the upper), so the
+        kernel compares the u8/u16/u32 delta lanes against i32 scalars —
+        no full-width operands in HBM and no i64 emulation on device.
+        EXACT for every bound: stored values are for_min + k*for_scale, so
+        the monotone ceil/floor rebase preserves the predicate. Bounds
+        normalize to inclusive integers first; out-of-frame bounds clamp
+        to span+1 / -1, which match nothing (deltas live in [0, span]).
+        Returns a complete PRange (with zonemap gating) or None."""
+        if fm.type is FieldType.F64:
+            return None  # f64 columns are never packed
+        info = self.reader.column_packing(field)
+        if info is None:
+            return None
+        m, s = int(info["for_min"]), int(info["for_scale"])
+        meta = self.reader.field_meta(field)
+        span = (int(meta["max_value"]) - m) // s  # fits i32 by construction
+        if lo_val is None:
+            lo_r = 0
+        else:
+            lo_exact = int(lo_val) + (0 if lo_incl else 1)
+            lo_r = -((m - lo_exact) // s)  # ceil((lo - m) / s)
+        if hi_val is None:
+            hi_r = span
+        else:
+            hi_exact = int(hi_val) - (0 if hi_incl else 1)
+            hi_r = (hi_exact - m) // s     # floor((hi - m) / s)
+        lo_r = max(0, min(lo_r, span + 1))
+        hi_r = max(-1, min(hi_r, span))
+        values_slot = self.b.add_array(
+            f"col.{field}.packed",
+            lambda: self.reader.column_packed(field)[0])
+        present_slot = self.b.add_array(
+            f"col.{field}.present",
+            lambda: self.reader.column_packed(field)[1])
+        lo_slot = self.b.add_scalar(lo_r, np.int32)
+        hi_slot = self.b.add_scalar(hi_r, np.int32)
+        zmin_slot, zmax_slot = self._zonemap_slots(field)
+        return PRange(values_slot, present_slot, lo_slot, hi_slot,
+                      True, True, zmin_slot, zmax_slot)
 
     def _s32_range_slots(self, field: str, fm: FieldMapping, lo_val,
                          lo_incl: bool, hi_val, hi_incl: bool):
@@ -1541,4 +1647,5 @@ def lower_request(
         sa_doc_slot=sa_doc_slot,
         sort_text_field=sort_text_field,
         threshold_slot=threshold_slot,
+        rebase=low.rebase,
     )

@@ -30,7 +30,8 @@ from ..metastore.base import ListSplitsQuery, Metastore
 from ..models.doc_mapper import DocMapper
 from ..models.split_metadata import Split, SplitState
 from ..observability.metrics import (
-    SEARCH_LEAF_RETRIES_TOTAL, SEARCH_TIMED_OUT_TOTAL,
+    SEARCH_FETCH_DOCS_RETRIES_TOTAL, SEARCH_LEAF_RETRIES_TOTAL,
+    SEARCH_TIMED_OUT_TOTAL,
 )
 from ..query import ast as Q
 from .collector import IncrementalCollector, finalize_aggregations
@@ -542,8 +543,20 @@ class RootSearcher:
                 snippet_fields=request.snippet_fields,
                 query_ast=request.query_ast if request.snippet_fields else None,
             )
+            # first attempt on the split's preferred replica, then exactly
+            # ONE retry on the next replica — and only if budget remains.
+            # Unbounded replica walks here could blow far past the deadline
+            # phase 1 already honored.
             docs = None
-            for node_id in nodes_for_split(split_id, nodes):
+            candidates = nodes_for_split(split_id, nodes)
+            for attempt, node_id in enumerate(candidates[:2]):
+                if attempt > 0:
+                    if deadline.expired:
+                        logger.warning(
+                            "fetch_docs for split %s: no budget left for a "
+                            "replica retry", split_id)
+                        break
+                    SEARCH_FETCH_DOCS_RETRIES_TOTAL.inc()
                 try:
                     docs = self.clients[node_id].fetch_docs(fetch_request)
                     break

@@ -371,6 +371,90 @@ def test_failed_retry_still_reports_failed_splits(corpus):
         assert "retry on node-1 failed" in failure.error
 
 
+# --- fetch-docs phase: one replica retry, never a replica walk -------------
+
+
+class _FlakyFetchClient:
+    """Counts fetch_docs per node and fails on the nodes in `fail` (a
+    shared mutable set so tests can pick victims AFTER split ids exist)."""
+
+    def __init__(self, inner, node_id, fail, calls):
+        self._inner = inner
+        self.node_id = node_id
+        self._fail = fail
+        self._calls = calls
+
+    def fetch_docs(self, request):
+        self._calls[self.node_id] = self._calls.get(self.node_id, 0) + 1
+        if self.node_id in self._fail:
+            raise RuntimeError("injected fetch_docs failure")
+        return self._inner.fetch_docs(request)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def _fetch_retry_root(corpus, fail, calls, num_nodes=3):
+    resolver, metastore = corpus
+    clients = {}
+    for i in range(num_nodes):
+        node_id = f"node-{i}"
+        context = SearcherContext(storage_resolver=resolver)
+        clients[node_id] = _FlakyFetchClient(
+            LocalSearchClient(SearchService(context, node_id=node_id)),
+            node_id, fail, calls)
+    return RootSearcher(metastore, clients)
+
+
+def test_fetch_docs_failure_recovered_on_next_replica(corpus):
+    # the preferred replica of every split drops phase-2 doc fetches; the
+    # single budgeted retry on the next replica must still fill the page
+    from quickwit_tpu.search.placer import nodes_for_split
+    from quickwit_tpu.observability.metrics import (
+        SEARCH_FETCH_DOCS_RETRIES_TOTAL,
+    )
+    fail: set[str] = set()
+    calls: dict[str, int] = {}
+    root = _fetch_retry_root(corpus, fail, calls)
+    nodes = sorted(root.clients)
+    _, metastore = corpus
+    from quickwit_tpu.metastore.base import ListSplitsQuery
+    splits = metastore.list_splits(ListSplitsQuery())
+    # newest split holds the ts-desc top page; fail ONLY its preferred
+    # replica so the retry target stays healthy
+    top_split = max(splits, key=lambda s: s.metadata.time_range_end or 0)
+    preference = nodes_for_split(top_split.metadata.split_id, nodes)
+    fail.add(preference[0])
+    before = SEARCH_FETCH_DOCS_RETRIES_TOTAL.get()
+    response = root.search(term_request(max_hits=5))
+    assert len(response.hits) == 5, \
+        "page incomplete: fetch_docs retry never recovered the docs"
+    assert not response.failed_splits
+    assert SEARCH_FETCH_DOCS_RETRIES_TOTAL.get() - before == 1
+    assert calls[preference[0]] == 1   # first attempt failed
+    assert calls[preference[1]] == 1   # exactly one retry, on replica #2
+
+
+def test_fetch_docs_retries_once_not_a_replica_walk(corpus):
+    # every replica is down for phase 2: the phase must attempt the
+    # preferred node plus ONE retry — not walk all replicas — and still
+    # return the phase-1 counts with the unfetchable docs dropped
+    from quickwit_tpu.observability.metrics import (
+        SEARCH_FETCH_DOCS_RETRIES_TOTAL,
+    )
+    fail: set[str] = set()
+    calls: dict[str, int] = {}
+    root = _fetch_retry_root(corpus, fail, calls)
+    fail.update(root.clients)
+    before = SEARCH_FETCH_DOCS_RETRIES_TOTAL.get()
+    response = root.search(term_request(max_hits=5))
+    assert response.hits == []          # docs unfetchable everywhere
+    assert response.num_hits == ERROR_DOCS  # phase-1 result preserved
+    assert SEARCH_FETCH_DOCS_RETRIES_TOTAL.get() - before == 1
+    assert sum(calls.values()) == 2, \
+        f"expected first attempt + one retry, saw {calls}"
+
+
 # --- budget mechanics ------------------------------------------------------
 
 

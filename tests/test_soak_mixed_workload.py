@@ -14,6 +14,10 @@ import time
 
 import pytest
 
+from quickwit_tpu.observability.metrics import (
+    SEARCH_BATCHER_DISPATCHES_TOTAL, SEARCH_BATCHER_QUERIES_TOTAL,
+    SEARCH_BATCHER_QUEUE_WAIT, SEARCH_BATCHER_RATIO,
+)
 from quickwit_tpu.serve import Node, NodeConfig, RestServer
 from quickwit_tpu.storage import StorageResolver
 
@@ -207,3 +211,22 @@ def test_convoy_batcher_coalesces_concurrent_burst(api):
         "burst queries bypassed the batcher (cache hit or fast path?)"
     assert dispatch_delta < query_delta, \
         "concurrent same-shape queries never coalesced into a batch"
+
+    # the exported metrics must tell the same story as the instance
+    # counters: operators read qw_search_batcher_* — not internals
+    assert SEARCH_BATCHER_QUERIES_TOTAL.get() >= batcher.num_queries
+    assert SEARCH_BATCHER_DISPATCHES_TOTAL.get() >= batcher.num_dispatches
+    assert SEARCH_BATCHER_RATIO.get() > 1.0, \
+        "batching ratio gauge never saw a coalesced dispatch"
+
+    # queue-wait histogram: one observation per dispatched rider, finite
+    # tail (the convoy window is bounded by real dispatch latency)
+    wait_p50 = SEARCH_BATCHER_QUEUE_WAIT.percentile(0.50)
+    wait_p99 = SEARCH_BATCHER_QUEUE_WAIT.percentile(0.99)
+    assert wait_p50 is not None and wait_p99 is not None, \
+        "no queue-wait observations recorded by the batcher"
+    print(f"batcher queue wait: p50<={wait_p50 * 1000:.1f}ms "
+          f"p99<={wait_p99 * 1000:.1f}ms "
+          f"ratio={SEARCH_BATCHER_RATIO.get():.2f}")
+    assert wait_p99 <= 10.0, \
+        f"queue-wait p99 bucket {wait_p99}s — riders starved in the convoy"
