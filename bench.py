@@ -491,6 +491,24 @@ def _batch_width_for(plan) -> int:
     return 1 << (width.bit_length() - 1)  # power-of-two bucket
 
 
+def _phase_breakdown(run_once) -> dict:
+    """One profiled run of `run_once` → {phase: total_ms}: the same
+    waterfall a `"profile": true` query returns, attached per config in
+    BENCH_DETAILS.json so a regression can be blamed on a phase (staging
+    vs compile vs execute) without re-running under a profiler."""
+    from quickwit_tpu.observability.profile import (
+        QueryProfile, profile_scope)
+    profile = QueryProfile(query_id="bench")
+    with profile_scope(profile):
+        run_once()
+    profile.finish()
+    out: dict = {}
+    for p in profile.phases():
+        out[p["name"]] = round(out.get(p["name"], 0.0)
+                               + p["duration_ms"], 3)
+    return out
+
+
 def _measure_batched_throughput(plan, k, device_arrays, num_queries: int,
                                 batch: int) -> dict:
     """Per-query latency with `num_queries` concurrent queries executed as
@@ -559,6 +577,10 @@ def _measure_single_split(request, mapper, reader, iters: int,
         lat.append(time.monotonic() - t0)
     stats["e2e_ms"] = round(_percentile(lat, 0.5) * 1000, 2)
     stats["e2e_p90_ms"] = round(_percentile(lat, 0.9) * 1000, 2)
+    if full:
+        stats["phases_ms"] = _phase_breakdown(
+            lambda: leaf_search_single_split(request, mapper, reader,
+                                             "bench"))
 
     plan, device_arrays, _ = prepare_single_split(
         request, mapper, reader, "bench")
@@ -695,6 +717,8 @@ def _measure_batch_otel(iters: int, full: bool = True) -> dict:
     stats["e2e_ms"] = round(_percentile(lat, 0.5) * 1000, 2)
     if not full:
         return stats
+    stats["phases_ms"] = _phase_breakdown(
+        lambda: fanout.execute_batch(batch, request))
 
     # device time via the same two-depth fori_loop on the batch closure
     arrays, scalars, nd = fanout.stage_device_inputs(batch, None)
@@ -777,13 +801,15 @@ def _measure_pruning(iters: int) -> dict:
             t0 = time.monotonic()
             response = service.leaf_search(request)
             lat.append(time.monotonic() - t0)
-        return response, _percentile(lat, 0.5) * 1000
+        return response, _percentile(lat, 0.5) * 1000, \
+            lambda: service.leaf_search(request)
 
-    resp_on, on_ms = run(pruning=True, exact=False)
-    resp_off, off_ms = run(pruning=False, exact=False)
-    resp_count, count_ms = run(pruning=True, exact=True)
+    resp_on, on_ms, rerun_on = run(pruning=True, exact=False)
+    resp_off, off_ms, _ = run(pruning=False, exact=False)
+    resp_count, count_ms, _ = run(pruning=True, exact=True)
     return {
         "n_splits": n_splits, "docs_per_split": docs_per,
+        "phases_ms": _phase_breakdown(rerun_on),
         "e2e_ms": round(on_ms, 2),           # pruned leaf, the real path
         "unpruned_ms": round(off_ms, 2),
         "pruning_speedup": round(off_ms / max(on_ms, 1e-9), 2),

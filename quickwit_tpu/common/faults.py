@@ -118,6 +118,12 @@ class FaultInjector:
                     operation=operation, occurrence=occurrence,
                     rule_index=rule_index, kind=rule.kind))
                 firing.append((rule_index, rule))
+        for _, rule in firing:
+            # fleet-visible audit of what the chaos plan actually did: a
+            # soak run's failure counts can be cross-checked against the
+            # faults that were really injected
+            from ..observability.metrics import FAULTS_INJECTED_TOTAL
+            FAULTS_INJECTED_TOTAL.inc(op=operation, kind=rule.kind)
         error: Optional[InjectedFault] = None
         for rule_index, rule in firing:
             if rule.kind == "latency":

@@ -99,6 +99,14 @@ class SplitReader:
         if cached is not None:
             return cached
         data = self.storage.get_slice(self.path, start, end)
+        # per-query storage attribution: every split read (footer,
+        # postings, columns) funnels through here on a byte-range-cache
+        # miss; no-op (one ContextVar get) when no profile is bound
+        from ..observability.profile import current_profile
+        profile = current_profile()
+        if profile is not None:
+            profile.add("storage_read_bytes", len(data))
+            profile.add("storage_reads", 1)
         self.cache.put(self.path, start, data)
         return data
 

@@ -64,10 +64,16 @@ def shard_queue_id(index_uid: str, source_id: str, shard_id: str) -> str:
 class Ingester:
     def __init__(self, wal_dir: str, fsync: bool = True,
                  replicate_to: Optional[Callable[
-                     [str, str, str, int, list[bytes]], None]] = None):
+                     [str, str, str, int, list[bytes]], None]] = None,
+                 fault_injector=None):
         self.wal_dir = wal_dir
         self.fsync = fsync
         self.replicate_to = replicate_to
+        # chaos hook (common/faults.FaultInjector): threads into every
+        # shard's RecordLog ("wal.fsync") and perturbs "ingest.replicate"
+        # around the chained-replication hop — an error-kind rule there
+        # exercises the rollback path exactly like a dropped follower
+        self.fault_injector = fault_injector
         # on_truncate(index_uid, source_id, shard_id, position): leader-side
         # hook propagating truncation to the replica (space reclaim)
         self.on_truncate: Optional[Callable[[str, str, str, int],
@@ -98,7 +104,8 @@ class Ingester:
                     self._shards[queue_id] = Shard(
                         index_uid=index_uid, source_id=source_id,
                         shard_id=shard_id, role=role,
-                        log=RecordLog(shard_dir, fsync=self.fsync))
+                        log=RecordLog(shard_dir, fsync=self.fsync,
+                                      fault_injector=self.fault_injector))
 
     # --- shard lifecycle ---------------------------------------------------
     def open_shard(self, index_uid: str, source_id: str, shard_id: str,
@@ -111,7 +118,8 @@ class Ingester:
                 shard = Shard(
                     index_uid=index_uid, source_id=source_id, shard_id=shard_id,
                     role=role,
-                    log=RecordLog(shard_dir, fsync=self.fsync))
+                    log=RecordLog(shard_dir, fsync=self.fsync,
+                                  fault_injector=self.fault_injector))
                 if role != "leader":
                     self._write_role(shard_dir, role)
                 self._shards[queue_id] = shard
@@ -178,6 +186,8 @@ class Ingester:
             first, last = shard.log.append_batch(payloads)
             if self.replicate_to is not None:
                 try:
+                    if self.fault_injector is not None:
+                        self.fault_injector.perturb("ingest.replicate")
                     self.replicate_to(index_uid, source_id, shard.shard_id,
                                       first, payloads)
                 except Exception:

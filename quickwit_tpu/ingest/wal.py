@@ -22,9 +22,14 @@ _LEN = struct.Struct("<I")
 
 
 class RecordLog:
-    def __init__(self, directory: str, fsync: bool = True):
+    def __init__(self, directory: str, fsync: bool = True,
+                 fault_injector=None):
         self.directory = directory
         self.fsync = fsync
+        # chaos hook (common/faults.FaultInjector): perturbs "wal.fsync"
+        # before each durability barrier — a latency rule models a slow
+        # disk, an error rule a failed fsync the caller must surface
+        self.fault_injector = fault_injector
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.Lock()
         # segments: sorted list of (first_position, path)
@@ -79,6 +84,10 @@ class RecordLog:
                 self._roll()
             position = self.next_position
             data = _LEN.pack(len(payload)) + payload
+            # perturb BEFORE the write: an error-kind "failed fsync" must
+            # reject the record cleanly, not leave unaccounted bytes on disk
+            if self.fault_injector is not None:
+                self.fault_injector.perturb("wal.fsync")
             self._active_file.write(data)
             self._active_file.flush()
             if self.fsync:
@@ -100,6 +109,8 @@ class RecordLog:
                 chunks.append(_LEN.pack(len(payload)))
                 chunks.append(payload)
             data = b"".join(chunks)
+            if self.fault_injector is not None:
+                self.fault_injector.perturb("wal.fsync")
             self._active_file.write(data)
             self._active_file.flush()
             if self.fsync:

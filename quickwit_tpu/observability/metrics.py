@@ -19,10 +19,17 @@ def _label_key(labels: dict[str, str]) -> tuple:
     return tuple(sorted(labels.items()))
 
 
+def _escape_label_value(value: str) -> str:
+    """Prometheus text-format label escaping: backslash, double-quote and
+    newline must be escaped or the exposition line is unparseable."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _format_labels(key: tuple) -> str:
     if not key:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key)
     return "{" + inner + "}"
 
 
@@ -231,3 +238,26 @@ SEARCH_SPLITS_DOWNGRADED_TOTAL = METRICS.counter(
 SEARCH_KERNEL_THRESHOLD_TOTAL = METRICS.counter(
     "qw_search_kernel_threshold_pushdown_total",
     "Plan executions dispatched with a pushed-down top-K threshold scalar")
+
+# --- per-query execution profiles (observability/profile.py) ---------------
+# Wall time per waterfall phase, labeled phase=<name> (plan_build,
+# admission_wait, batcher_queue_wait, storage_read, staging, compile,
+# execute, topk_merge, root_merge, fetch_docs, ...). Fed by every profiled
+# query, so fleet-wide attribution is queryable without slowlog capture.
+SEARCH_PHASE_SECONDS = METRICS.histogram(
+    "qw_search_phase_seconds",
+    "Wall time spent per query-execution phase (profile waterfall)")
+SEARCH_PROFILED_QUERIES_TOTAL = METRICS.counter(
+    "qw_search_profiled_queries_total",
+    "Root searches that ran with an execution profile attached")
+SEARCH_SLOWLOG_RECORDED_TOTAL = METRICS.counter(
+    "qw_search_slowlog_recorded_total",
+    "Queries captured into the slow-query ring buffer")
+
+# --- chaos / fault injection (common/faults.py) ----------------------------
+# Every fault the injector actually fired, labeled op=<operation>
+# kind=<latency|error|hang>: chaos runs are visible in /metrics instead of
+# only in test assertions.
+FAULTS_INJECTED_TOTAL = METRICS.counter(
+    "qw_faults_injected_total",
+    "Faults fired by the deterministic chaos FaultInjector")

@@ -38,6 +38,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..index.format import ZONEMAP_BLOCK
 from ..index.reader import SplitReader
 from ..models.doc_mapper import DocMapper
+from ..observability.profile import (
+    PHASE_COMPILE, PHASE_EXECUTE, PHASE_PLAN_BUILD, PHASE_STAGING,
+    PHASE_TOPK_MERGE, current_profile, profile_add, profiled_phase,
+)
 from ..query.aggregations import DateHistogramAgg, HistogramAgg, TermsAgg, parse_aggs
 from ..search.models import LeafSearchResponse, PartialHit, SearchRequest
 from ..search.plan import BucketAggExec, LoweredPlan, MetricAggExec, lower_request
@@ -182,6 +186,21 @@ def build_batch(request: SearchRequest, doc_mapper: DocMapper,
     (internal encoding): the same value is lowered into every lane's plan,
     so slot layouts stay uniform and the pushdown rides the existing
     stacked-scalar machinery."""
+    # plan_build covers per-split lowering (storage byte-range IO surfaces
+    # as storage_read_* counters) plus the host-side lane stacking
+    with profiled_phase(PHASE_PLAN_BUILD) as rec:
+        if rec is not None:
+            rec["splits"] = len(split_ids)
+            rec["stage"] = "batch"
+        return _build_batch(request, doc_mapper, readers, split_ids,
+                            pad_to_splits, absence_sink, sort_value_threshold)
+
+
+def _build_batch(request: SearchRequest, doc_mapper: DocMapper,
+                 readers: list[SplitReader], split_ids: list[str],
+                 pad_to_splits: Optional[int],
+                 absence_sink,
+                 sort_value_threshold: Optional[float]) -> SplitBatch:
     agg_specs = parse_aggs(request.aggs) if request.aggs else []
     overrides = _global_agg_overrides(agg_specs, readers, doc_mapper)
     sort = request.sort_fields[0] if request.sort_fields else None
@@ -396,17 +415,30 @@ def stage_device_inputs(batch: SplitBatch, mesh: Optional[Mesh] = None):
         cache = batch._device_inputs = {}
     dev = cache.get(mesh)
     if dev is None:
-        if mesh is not None:
-            arrays_sh, scalars_sh, nd_sh = batch_shardings(batch, mesh)
-            arrays = tuple(jax.device_put(batch.arrays, list(arrays_sh)))
-            scalars = tuple(jax.device_put(batch.scalars, list(scalars_sh))) \
-                if batch.scalars else ()
-            nd = jax.device_put(batch.num_docs, nd_sh)
-        else:
-            moved = jax.device_put(batch.arrays + batch.scalars + [batch.num_docs])
-            arrays = tuple(moved[: len(batch.arrays)])
-            scalars = tuple(moved[len(batch.arrays):-1])
-            nd = moved[-1]
+        staging_bytes = (sum(a.nbytes for a in batch.arrays)
+                         + sum(s.nbytes for s in batch.scalars)
+                         + batch.num_docs.nbytes)
+        # staging times the transfer DISPATCH (device_put is async;
+        # completion overlaps into the execute phase by design — same
+        # contract as the per-split warmup in search/leaf.py)
+        with profiled_phase(PHASE_STAGING) as rec:
+            if rec is not None:
+                rec["bytes"] = staging_bytes
+                rec["stage"] = "batch"
+            if mesh is not None:
+                arrays_sh, scalars_sh, nd_sh = batch_shardings(batch, mesh)
+                arrays = tuple(jax.device_put(batch.arrays, list(arrays_sh)))
+                scalars = tuple(jax.device_put(batch.scalars,
+                                               list(scalars_sh))) \
+                    if batch.scalars else ()
+                nd = jax.device_put(batch.num_docs, nd_sh)
+            else:
+                moved = jax.device_put(
+                    batch.arrays + batch.scalars + [batch.num_docs])
+                arrays = tuple(moved[: len(batch.arrays)])
+                scalars = tuple(moved[len(batch.arrays):-1])
+                nd = moved[-1]
+        profile_add("staging_bytes", staging_bytes)
         dev = cache[mesh] = (arrays, scalars, nd)
     return dev
 
@@ -427,13 +459,31 @@ def execute_batch(batch: SplitBatch, request: SearchRequest,
     # Mesh is hashable; id() would go stale if a dead mesh's address is reused
     key = (batch.template.signature(k), batch.n_splits,
            batch.num_docs_padded, mesh)
+    profile = current_profile()
     cached = _BATCH_JIT_CACHE.get(key)
-    if cached is None:
-        cached = _batch_executor(batch, k, mesh, (arrays, scalars, nd))
-        _BATCH_JIT_CACHE[key] = cached
-    ex, treedef, spec = cached
-
-    packed = jax.device_get(ex(arrays, scalars, nd))
+    if profile is None:
+        if cached is None:
+            cached = _batch_executor(batch, k, mesh, (arrays, scalars, nd))
+            _BATCH_JIT_CACHE[key] = cached
+        ex, treedef, spec = cached
+        packed = jax.device_get(ex(arrays, scalars, nd))
+    else:
+        # Compile-vs-execute attribution (same lazy-jit approximation as
+        # executor.dispatch_plan): on a batch-jit-cache MISS the first call
+        # pays trace+XLA-compile; on a HIT the dispatch is a cheap enqueue
+        # and the blocking device_get absorbs the device execution time.
+        hit = cached is not None
+        profile.add("compile_cache_hits" if hit else "compile_cache_misses")
+        with profile.phase(PHASE_EXECUTE if hit else PHASE_COMPILE,
+                           stage="dispatch_batch"):
+            if cached is None:
+                cached = _batch_executor(batch, k, mesh,
+                                         (arrays, scalars, nd))
+                _BATCH_JIT_CACHE[key] = cached
+            ex, treedef, spec = cached
+            out = ex(arrays, scalars, nd)
+        with profile.phase(PHASE_EXECUTE, stage="readback"):
+            packed = jax.device_get(out)
     leaves = []
     offset = 0
     for shape, dtype in spec:
@@ -459,31 +509,35 @@ def execute_batch(batch: SplitBatch, request: SearchRequest,
                 batch.readers[si].column_values(field)[0]
         return exact_cols[(si, field)]
 
-    for i in range(min(k, num_hits)):
-        internal = float(top_vals[i])
-        if internal == float("-inf"):
-            break
-        si = int(split_idx[i])
-        split_id = batch.split_ids[si]
-        if split_id == "":
-            continue
-        doc_id = int(doc_ids[i])
-        raw = decode_sort_value_exact(
-            internal, batch.sort_field, batch.sort_order, sort_is_int,
-            scores[i], doc_id, exact_col(si, batch.sort_field, sort_is_int))
-        internal2, raw2 = 0.0, None
-        if batch.sort2_field is not None and top_vals2 is not None:
-            internal2 = float(top_vals2[i])
-            raw2 = decode_sort_value_exact(
-                internal2, batch.sort2_field, batch.sort2_order,
-                sort2_is_int, scores[i], doc_id,
-                exact_col(si, batch.sort2_field, sort2_is_int))
-        hits.append(PartialHit(sort_value=internal, split_id=split_id,
-                               doc_id=doc_id, raw_sort_value=raw,
-                               sort_value2=internal2,
-                               raw_sort_value2=raw2))
-
-    intermediate = _intermediate_aggs(batch.template, list(merged_aggs))
+    with profiled_phase(PHASE_TOPK_MERGE) as rec:
+        for i in range(min(k, num_hits)):
+            internal = float(top_vals[i])
+            if internal == float("-inf"):
+                break
+            si = int(split_idx[i])
+            split_id = batch.split_ids[si]
+            if split_id == "":
+                continue
+            doc_id = int(doc_ids[i])
+            raw = decode_sort_value_exact(
+                internal, batch.sort_field, batch.sort_order, sort_is_int,
+                scores[i], doc_id,
+                exact_col(si, batch.sort_field, sort_is_int))
+            internal2, raw2 = 0.0, None
+            if batch.sort2_field is not None and top_vals2 is not None:
+                internal2 = float(top_vals2[i])
+                raw2 = decode_sort_value_exact(
+                    internal2, batch.sort2_field, batch.sort2_order,
+                    sort2_is_int, scores[i], doc_id,
+                    exact_col(si, batch.sort2_field, sort2_is_int))
+            hits.append(PartialHit(sort_value=internal, split_id=split_id,
+                                   doc_id=doc_id, raw_sort_value=raw,
+                                   sort_value2=internal2,
+                                   raw_sort_value2=raw2))
+        intermediate = _intermediate_aggs(batch.template, list(merged_aggs))
+        if rec is not None:
+            rec["hits"] = len(hits)
+            rec["stage"] = "batch"
     real_splits = sum(1 for s in batch.split_ids if s)
     return LeafSearchResponse(
         num_hits=num_hits,

@@ -38,6 +38,7 @@ from collections import OrderedDict, deque
 
 from ..common.deadline import DeadlineExceeded, current_deadline
 from ..observability.metrics import SEARCH_SHED_TOTAL
+from ..observability.profile import PHASE_ADMISSION_WAIT, current_profile
 
 logger = logging.getLogger(__name__)
 
@@ -68,8 +69,11 @@ class HbmBudget:
         instead of occupying a ticket; its caller has no time left to use
         the admission anyway."""
         query_deadline = current_deadline()
+        profile = current_profile()
         if query_deadline is not None and query_deadline.expired:
             SEARCH_SHED_TOTAL.inc(stage="admission")
+            if profile is not None:
+                profile.mark_partial("shed: HBM admission")
             raise DeadlineExceeded("HBM admission")
         if new_bytes <= 0:
             # zero-byte admission still PINS the owner: its cached device
@@ -83,33 +87,50 @@ class HbmBudget:
             timeout_secs = min(timeout_secs,
                                query_deadline.clamp(timeout_secs))
         deadline = time.monotonic() + timeout_secs
-        with self._cond:
-            self._tickets.append(ticket)
-            try:
-                while not (self._tickets[0] == ticket
-                           and (self._pinned == 0
-                                or self._pinned + new_bytes <= self.budget)):
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        if (query_deadline is not None
-                                and query_deadline.expired):
-                            SEARCH_SHED_TOTAL.inc(stage="admission")
-                            raise DeadlineExceeded("HBM admission queue wait")
-                        raise TimeoutError(
-                            f"HBM admission timed out: need {new_bytes} "
-                            f"bytes, {self._pinned} pinned of {self.budget}")
-                    self._cond.wait(remaining)
-            finally:
-                self._tickets.remove(ticket)
-                self._cond.notify_all()  # next ticket may now be at head
-            self._pinned += new_bytes
-            self._pin_counts[id(owner)] = \
-                self._pin_counts.get(id(owner), 0) + 1
-            self._evict_locked()
-            if new_bytes > self.budget:
-                logger.warning(
-                    "query needs %d bytes against a %d-byte HBM budget; "
-                    "admitted alone", new_bytes, self.budget)
+        t_admit = time.monotonic()
+        try:
+            with self._cond:
+                self._tickets.append(ticket)
+                try:
+                    while not (self._tickets[0] == ticket
+                               and (self._pinned == 0
+                                    or self._pinned + new_bytes
+                                    <= self.budget)):
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            if (query_deadline is not None
+                                    and query_deadline.expired):
+                                SEARCH_SHED_TOTAL.inc(stage="admission")
+                                raise DeadlineExceeded(
+                                    "HBM admission queue wait")
+                            raise TimeoutError(
+                                f"HBM admission timed out: need {new_bytes} "
+                                f"bytes, {self._pinned} pinned of "
+                                f"{self.budget}")
+                        self._cond.wait(remaining)
+                finally:
+                    self._tickets.remove(ticket)
+                    self._cond.notify_all()  # next ticket may now be at head
+                self._pinned += new_bytes
+                self._pin_counts[id(owner)] = \
+                    self._pin_counts.get(id(owner), 0) + 1
+                self._evict_locked()
+                if new_bytes > self.budget:
+                    logger.warning(
+                        "query needs %d bytes against a %d-byte HBM budget; "
+                        "admitted alone", new_bytes, self.budget)
+        except BaseException:
+            if profile is not None:
+                # shed while queued: the partial wait is still reported
+                profile.record_phase(
+                    PHASE_ADMISSION_WAIT, time.monotonic() - t_admit,
+                    start=t_admit, bytes=new_bytes, aborted=True)
+                profile.mark_partial("shed: HBM admission queue wait")
+            raise
+        if profile is not None:
+            profile.record_phase(PHASE_ADMISSION_WAIT,
+                                 time.monotonic() - t_admit, start=t_admit,
+                                 bytes=new_bytes)
         return new_bytes
 
     def release(self, owner, admitted_bytes: int,

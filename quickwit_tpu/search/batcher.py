@@ -35,6 +35,7 @@ from ..observability.metrics import (
     SEARCH_BATCHER_DISPATCHES_TOTAL, SEARCH_BATCHER_QUERIES_TOTAL,
     SEARCH_BATCHER_QUEUE_WAIT, SEARCH_BATCHER_RATIO, SEARCH_SHED_TOTAL,
 )
+from ..observability.profile import PHASE_BATCHER_QUEUE, current_profile
 from . import executor
 
 # Extra follower wait beyond its own deadline: the leader may be setting the
@@ -45,15 +46,19 @@ _FOLLOWER_SLACK_SECS = 0.05
 
 class _Pending:
     __slots__ = ("scalars", "event", "result", "error", "deadline",
-                 "enqueued_at")
+                 "enqueued_at", "profile")
 
-    def __init__(self, scalars, deadline: Optional[Deadline] = None):
+    def __init__(self, scalars, deadline: Optional[Deadline] = None,
+                 profile=None):
         self.scalars = scalars
         self.event = threading.Event()
         self.result: Any = None
         self.error: Exception | None = None
         self.deadline = deadline
         self.enqueued_at = time.monotonic()
+        # each rider's ambient QueryProfile (or None): the leader reports
+        # every rider's queue wait into ITS profile at dispatch time
+        self.profile = profile
 
 
 class QueryBatcher:
@@ -82,7 +87,7 @@ class QueryBatcher:
         equal posting shape lower to the same signature but DIFFERENT
         arrays — they must not share)."""
         key = (plan.signature(k), tuple(plan.array_keys), split_key)
-        me = _Pending(plan.scalars, current_deadline())
+        me = _Pending(plan.scalars, current_deadline(), current_profile())
         my_queue = None
         with self._lock:
             self.num_queries += 1
@@ -109,6 +114,12 @@ class QueryBatcher:
                 # abandon the ride — our scalars may still be computed, the
                 # result is simply unclaimed
                 SEARCH_SHED_TOTAL.inc(stage="batcher_wait")
+                if me.profile is not None:
+                    me.profile.record_phase(
+                        PHASE_BATCHER_QUEUE,
+                        time.monotonic() - me.enqueued_at,
+                        start=me.enqueued_at, aborted=True)
+                    me.profile.mark_partial("shed: batcher wait")
                 raise DeadlineExceeded("batched dispatch wait")
             if me.error is not None:
                 raise _waiter_error(me.error)
@@ -128,16 +139,27 @@ class QueryBatcher:
                 expired = [p for p in batch
                            if p.deadline is not None and p.deadline.expired]
                 alive = [p for p in batch if p not in expired]
+                now = time.monotonic()
                 for pending in expired:
                     SEARCH_SHED_TOTAL.inc(stage="batcher_dispatch")
+                    if pending.profile is not None:
+                        pending.profile.record_phase(
+                            PHASE_BATCHER_QUEUE, now - pending.enqueued_at,
+                            start=pending.enqueued_at, aborted=True)
+                        pending.profile.mark_partial("shed: batcher dispatch")
                     pending.error = DeadlineExceeded("batched dispatch")
                     pending.event.set()
                 try:
                     if alive:
                         now = time.monotonic()
                         for pending in alive:
-                            SEARCH_BATCHER_QUEUE_WAIT.observe(
-                                now - pending.enqueued_at)
+                            wait = now - pending.enqueued_at
+                            SEARCH_BATCHER_QUEUE_WAIT.observe(wait)
+                            if pending.profile is not None:
+                                pending.profile.record_phase(
+                                    PHASE_BATCHER_QUEUE, wait,
+                                    start=pending.enqueued_at,
+                                    riders=len(alive))
                         with self._lock:
                             self.num_dispatches += 1
                             SEARCH_BATCHER_DISPATCHES_TOTAL.inc()

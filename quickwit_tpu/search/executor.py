@@ -26,6 +26,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..index.format import ZONEMAP_BLOCK
+from ..observability.profile import (
+    PHASE_COMPILE, PHASE_EXECUTE, current_profile,
+)
 from ..ops import aggs as agg_ops
 from ..ops import masks as mask_ops
 from ..ops import topk as topk_ops
@@ -902,18 +905,38 @@ def dispatch_plan_multi(plan: LoweredPlan, k: int,
     padded_sets = list(scalar_sets) + [scalar_sets[-1]] * (bucket - batch)
     scal_b, nd_b = _device_multi_scalars(plan, padded_sets,
                                          use_cache=cache_scalars)
-    executor, treedef, spec = _get_packed_multi_executor(
-        plan, k, bucket, device_arrays)
-    out = executor(tuple(device_arrays), scal_b, nd_b)
+    profile = current_profile()
+    if profile is None:
+        executor, treedef, spec = _get_packed_multi_executor(
+            plan, k, bucket, device_arrays)
+        out = executor(tuple(device_arrays), scal_b, nd_b)
+    else:
+        # same lazy-jit attribution as dispatch_plan, keyed per batch
+        # bucket (each bucket size compiles its own vmapped program)
+        hit = (plan.signature(k), bucket) in _MULTI_CACHE
+        profile.add("compile_cache_hits" if hit else "compile_cache_misses")
+        with profile.phase(PHASE_EXECUTE if hit else PHASE_COMPILE,
+                           stage="dispatch_multi"):
+            executor, treedef, spec = _get_packed_multi_executor(
+                plan, k, bucket, device_arrays)
+            out = executor(tuple(device_arrays), scal_b, nd_b)
     if hasattr(out, "copy_to_host_async"):
         out.copy_to_host_async()
     return out, treedef, spec, batch
 
 
+def _profiled_device_get(packed):
+    profile = current_profile()
+    if profile is None:
+        return jax.device_get(packed)
+    with profile.phase(PHASE_EXECUTE, stage="readback"):
+        return jax.device_get(packed)
+
+
 def readback_plan_multi(dispatched) -> list[dict[str, Any]]:
     """ONE device→host transfer for the whole batch; per-lane unpack."""
     packed, treedef, spec, batch = dispatched
-    host = np.asarray(jax.device_get(packed))
+    host = np.asarray(_profiled_device_get(packed))
     results = []
     for lane in range(batch):
         sort_vals, sort_vals2, doc_ids, hit_scores, count, agg_out = \
@@ -939,16 +962,36 @@ def dispatch_plan(plan: LoweredPlan, k: int,
     k = max(0, min(k, plan.num_docs_padded))
     scalars, num_docs = _device_scalars(plan)
     args = (tuple(device_arrays), scalars, num_docs)
-    executor, treedef, spec = _get_packed_executor(plan, k, args)
-    return executor(*args), treedef, spec
+    profile = current_profile()
+    if profile is None:
+        executor, treedef, spec = _get_packed_executor(plan, k, args)
+        return executor(*args), treedef, spec
+    # Compile-vs-execute attribution: jax.jit compiles lazily on first
+    # call, so on a packed-cache MISS this dispatch's wall time is
+    # trace+XLA-compile (the dispatch itself is an async enqueue); on a
+    # HIT it is a cheap enqueue counted toward execute. The approximation
+    # is documented in docs/observability.md.
+    hit = plan.signature(k) in _PACKED_CACHE
+    profile.add("compile_cache_hits" if hit else "compile_cache_misses")
+    with profile.phase(PHASE_EXECUTE if hit else PHASE_COMPILE,
+                       stage="dispatch"):
+        executor, treedef, spec = _get_packed_executor(plan, k, args)
+        return executor(*args), treedef, spec
 
 
 def readback_plan_result(dispatched) -> dict[str, Any]:
     """ONE device→host transfer for the entire result tree, unpacked by
     the trace-time spec."""
     packed, treedef, spec = dispatched
+    profile = current_profile()
+    if profile is None:
+        host = jax.device_get(packed)
+    else:
+        # the blocking readback absorbs the device execution time
+        with profile.phase(PHASE_EXECUTE, stage="readback"):
+            host = jax.device_get(packed)
     sort_vals, sort_vals2, doc_ids, hit_scores, count, agg_out = \
-        _unpack_result(jax.device_get(packed), treedef, spec)
+        _unpack_result(host, treedef, spec)
     return {
         "sort_values": sort_vals,
         "sort_values2": sort_vals2,

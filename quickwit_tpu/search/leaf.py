@@ -21,6 +21,10 @@ import numpy as np
 
 from ..models.doc_mapper import DocMapper, FieldType
 from ..index.reader import SplitReader
+from ..observability.profile import (
+    PHASE_PLAN_BUILD, PHASE_STAGING, PHASE_TOPK_MERGE, current_profile,
+    profile_add, profiled_phase,
+)
 from ..ops.aggs import PCTL_NUM_BUCKETS
 from ..query.aggregations import parse_aggs
 from .executor import execute_plan
@@ -82,18 +86,25 @@ def warmup_device_arrays(reader: SplitReader, plan, budget=None
     cache = _device_cache(reader)
     missing = [(key, arr) for key, arr in zip(plan.array_keys, plan.arrays)
                if key not in cache]
+    staging_bytes = sum(arr.nbytes for _, arr in missing)
     admitted = 0
     if budget is not None:
         # pins this reader even when nothing is missing (zero-byte
         # admission): its cached device arrays are in use and must not be
         # evicted mid-query
-        admitted = budget.admit(reader,
-                                sum(arr.nbytes for _, arr in missing))
+        admitted = budget.admit(reader, staging_bytes)
     try:
         if missing:
             # one batched host→device transfer (each separate device_put
-            # pays a full RTT under the axon tunnel)
-            transferred = jax.device_put([arr for _, arr in missing])
+            # pays a full RTT under the axon tunnel). The staging phase
+            # times the transfer DISPATCH (device_put is async; completion
+            # overlaps into the execute phase by design).
+            with profiled_phase(PHASE_STAGING) as rec:
+                if rec is not None:
+                    rec["bytes"] = staging_bytes
+                    rec["arrays"] = len(missing)
+                transferred = jax.device_put([arr for _, arr in missing])
+            profile_add("staging_bytes", staging_bytes)
             for (key, _), dev in zip(missing, transferred):
                 cache[key] = dev
         return [cache[key] for key in plan.array_keys], admitted
@@ -126,20 +137,25 @@ def prepare_plan_only(
     sort_field = sort.field if sort else "_score"
     sort_order = sort.order if sort else "desc"
     sort2 = request.sort_fields[1] if len(request.sort_fields) > 1 else None
-    return lower_request(
-        request.query_ast, doc_mapper, reader, agg_specs,
-        sort_field=sort_field, sort_order=sort_order,
-        sort2_field=sort2.field if sort2 else None,
-        sort2_order=sort2.order if sort2 else "desc",
-        start_timestamp=request.start_timestamp,
-        end_timestamp=request.end_timestamp,
-        search_after=search_after_marker(request, split_id, sort_field,
-                                         sort_order, sort2,
-                                         doc_mapper=doc_mapper,
-                                         reader=reader),
-        absence_sink=absence_sink,
-        sort_value_threshold=sort_value_threshold,
-    )
+    # plan_build covers storage byte-range IO (footer/postings/column
+    # reads surface as storage_read_* counters) plus the lowering itself
+    with profiled_phase(PHASE_PLAN_BUILD) as rec:
+        if rec is not None:
+            rec["split_id"] = split_id
+        return lower_request(
+            request.query_ast, doc_mapper, reader, agg_specs,
+            sort_field=sort_field, sort_order=sort_order,
+            sort2_field=sort2.field if sort2 else None,
+            sort2_order=sort2.order if sort2 else "desc",
+            start_timestamp=request.start_timestamp,
+            end_timestamp=request.end_timestamp,
+            search_after=search_after_marker(request, split_id, sort_field,
+                                             sort_order, sort2,
+                                             doc_mapper=doc_mapper,
+                                             reader=reader),
+            absence_sink=absence_sink,
+            sort_value_threshold=sort_value_threshold,
+        )
 
 
 def prepare_single_split(
@@ -203,6 +219,7 @@ def execute_prepared_split(
     if plan.threshold_slot >= 0:
         from ..observability.metrics import SEARCH_KERNEL_THRESHOLD_TOTAL
         SEARCH_KERNEL_THRESHOLD_TOTAL.inc()
+        profile_add("kernel_threshold_pushdowns")
     if batcher is not None:
         result = batcher.execute(plan, k, device_arrays,
                                  split_key=id(reader))
@@ -210,6 +227,8 @@ def execute_prepared_split(
         result = execute_plan(plan, k, device_arrays)
 
     count = result["count"]
+    profile = current_profile()
+    t_merge = time.monotonic()
     num_hits_returned = min(k, count)
     partial_hits = []
     # text-field sort: internal keys are split-local dictionary ordinals —
@@ -255,6 +274,11 @@ def execute_prepared_split(
             raw_sort_value=raw, sort_value2=internal2, raw_sort_value2=raw2))
 
     intermediate_aggs = _intermediate_aggs(plan, result["aggs"])
+    if profile is not None:
+        # host-side top-K decode + agg-state extraction for this split
+        profile.record_phase(PHASE_TOPK_MERGE,
+                             time.monotonic() - t_merge, start=t_merge,
+                             split_id=split_id, hits=len(partial_hits))
     elapsed = int((time.monotonic() - t0) * 1e6)
     return LeafSearchResponse(
         num_hits=count,

@@ -76,6 +76,11 @@ class SearchRequest:
     # of the leaf-cache key (cache.canonical_request_key): two queries that
     # differ only in budget must share results.
     timeout_millis: Optional[int] = None
+    # ES-compatible `"profile": true` flag: return the per-query execution
+    # profile (phase waterfall + device counters) in the response. Like
+    # timeout_millis, NOT part of the leaf-cache key — profiling must not
+    # fragment the cache.
+    profile: bool = False
 
     def __post_init__(self) -> None:
         self.sort_fields = normalize_sort_fields(tuple(self.sort_fields))
@@ -105,6 +110,7 @@ class SearchRequest:
             "snippet_fields": list(self.snippet_fields),
             **({"timeout_millis": self.timeout_millis}
                if self.timeout_millis is not None else {}),
+            **({"profile": True} if self.profile else {}),
         }
 
     @staticmethod
@@ -122,6 +128,7 @@ class SearchRequest:
             search_after=d.get("search_after"),
             snippet_fields=tuple(d.get("snippet_fields", ())),
             timeout_millis=d.get("timeout_millis"),
+            profile=d.get("profile", False),
         )
 
 
@@ -158,6 +165,10 @@ class LeafSearchResponse:
     # agg name -> intermediate state dict (kind-specific, numpy-backed)
     intermediate_aggs: dict[str, Any] = field(default_factory=dict)
     resource_stats: dict[str, float] = field(default_factory=dict)
+    # Leaf-local execution profile (QueryProfile.to_dict()) when the request
+    # asked for one over a remote hop; None for embedded leaves, which write
+    # into the root's ambient profile directly.
+    profile: Optional[dict[str, Any]] = None
 
 
 @dataclass
@@ -186,6 +197,10 @@ class SearchResponse:
     failed_splits: list[SplitSearchError] = field(default_factory=list)
     num_attempted_splits: int = 0
     num_successful_splits: int = 0
+    # Execution profile (QueryProfile.to_dict()) when the request carried
+    # `"profile": true`; additive in to_dict so unprofiled responses keep
+    # their shape.
+    profile: Optional[dict[str, Any]] = None
 
     def to_dict(self) -> dict[str, Any]:
         """Reference REST shape (`search_response_rest.rs:43`): hits are the
@@ -208,6 +223,7 @@ class SearchResponse:
                 {"split_id": e.split_id, "error": e.error,
                  "retryable": e.retryable} for e in self.failed_splits]}
                if self.failed_splits else {}),
+            **({"profile": self.profile} if self.profile is not None else {}),
         }
 
 

@@ -31,8 +31,13 @@ from ..models.doc_mapper import DocMapper
 from ..models.split_metadata import Split, SplitState
 from ..observability.metrics import (
     SEARCH_FETCH_DOCS_RETRIES_TOTAL, SEARCH_LEAF_RETRIES_TOTAL,
-    SEARCH_TIMED_OUT_TOTAL,
+    SEARCH_PROFILED_QUERIES_TOTAL, SEARCH_TIMED_OUT_TOTAL,
 )
+from ..observability.profile import (
+    PHASE_FETCH_DOCS, PHASE_ROOT_MERGE, QueryProfile, current_profile,
+    profile_scope, profiled_phase,
+)
+from ..observability.slowlog import SLOW_QUERY_LOG
 from ..query import ast as Q
 from .collector import IncrementalCollector, finalize_aggregations
 from .models import (
@@ -195,13 +200,52 @@ class RootSearcher:
         else:
             deadline = Deadline.after(self.default_timeout_secs)
         budget = QueryBudget(deadline, max_retries=self.MAX_RETRIES_PER_QUERY)
-        with TRACER.span("root_search",
-                         {"indexes": ",".join(request.index_ids)}):
-            with deadline_scope(deadline):
-                response = self._search_traced(request, budget)
+        # profile on explicit request, or for EVERY query when the slow-query
+        # log is armed — a slow query can only be captured if it was profiled
+        # from admission, not discovered after the fact
+        profile = None
+        if request.profile or SLOW_QUERY_LOG.armed:
+            import uuid
+            profile = QueryProfile(query_id=uuid.uuid4().hex[:16])
+            SEARCH_PROFILED_QUERIES_TOTAL.inc()
+        t0 = time.monotonic()
+        try:
+            with TRACER.span("root_search",
+                             {"indexes": ",".join(request.index_ids)}):
+                with deadline_scope(deadline), profile_scope(profile):
+                    response = self._search_traced(request, budget)
+        except BaseException as exc:
+            if profile is not None:
+                profile.mark_partial(f"error: {exc}")
+                profile.finish(time.monotonic() - t0)
+                self._capture_slow_query(request, profile,
+                                         timed_out=is_deadline_error(str(exc)))
+            raise
         if response.timed_out:
             SEARCH_TIMED_OUT_TOTAL.inc()
+        if profile is not None:
+            if response.timed_out:
+                profile.mark_partial("timed_out")
+            profile.finish(response.elapsed_time_micros / 1e6)
+            if request.profile:
+                response.profile = profile.to_dict()
+            self._capture_slow_query(request, profile,
+                                     timed_out=response.timed_out)
         return response
+
+    @staticmethod
+    def _capture_slow_query(request: SearchRequest, profile,
+                            timed_out: bool) -> None:
+        elapsed_ms = profile.wall_ms or 0.0
+        if not SLOW_QUERY_LOG.should_capture(elapsed_ms, timed_out):
+            return
+        SLOW_QUERY_LOG.record({
+            "query_id": profile.query_id,
+            "indexes": list(request.index_ids),
+            "elapsed_ms": elapsed_ms,
+            "timed_out": timed_out,
+            "profile": profile.to_dict(),
+        })
 
     def _search_traced(self, request: SearchRequest,
                        budget: QueryBudget) -> SearchResponse:
@@ -268,8 +312,18 @@ class RootSearcher:
                 )
                 dispatches.append((node_id, leaf_request))
 
-        for response in self._fan_out(dispatches, nodes, budget):
-            collector.add_leaf_response(response)
+        responses = self._fan_out(dispatches, nodes, budget)
+        # root merge covers only the post-join collector work: the fan-out
+        # wall is already accounted inside each leaf's own phases, and an
+        # umbrella phase here would double-count it against sum≈wall
+        profile = current_profile()
+        with profiled_phase(PHASE_ROOT_MERGE) as rec:
+            if rec is not None:
+                rec["leaf_responses"] = len(responses)
+            for response in responses:
+                collector.add_leaf_response(response)
+                if profile is not None and response.profile is not None:
+                    profile.add_child(response.profile)
 
         merged = collector
         deadline_hit = (budget.deadline.expired
@@ -283,8 +337,11 @@ class RootSearcher:
             # Deadline expiries are NOT query-level problems: they return a
             # timed_out partial response below.
             raise ValueError(merged.failed_splits[0].error)
-        hits = self._fetch_docs_phase(request, merged, split_meta_by_id, nodes,
-                                      budget.deadline)
+        with profiled_phase(PHASE_FETCH_DOCS) as rec:
+            hits = self._fetch_docs_phase(request, merged, split_meta_by_id,
+                                          nodes, budget.deadline)
+            if rec is not None:
+                rec["docs"] = len(hits)
         aggregations = None
         if request.aggs:
             aggregations = finalize_aggregations(merged.aggregation_states())
@@ -320,9 +377,20 @@ class RootSearcher:
             return [self._leaf_search_with_retry(leaf_request, node_id, nodes,
                                                  budget)]
         results: list[Optional[LeafSearchResponse]] = [None] * len(dispatches)
+        # fan-out threads start with empty span stacks and fresh contextvars:
+        # capture the root's traceparent and profile HERE so every leaf
+        # dispatch joins the root trace (trace stitching) and reports its
+        # phases into the root's profile instead of minting orphans
+        from ..observability.tracing import TRACER
+        parent_tp = TRACER.current_traceparent()
+        profile = current_profile()
 
         def run(i: int, node_id: str, leaf_request: LeafSearchRequest) -> None:
-            with deadline_scope(deadline):
+            with TRACER.span("leaf_dispatch",
+                             {"node": node_id,
+                              "num_splits": len(leaf_request.splits)},
+                             remote_parent=parent_tp), \
+                    profile_scope(profile), deadline_scope(deadline):
                 try:
                     results[i] = self._leaf_search_with_retry(
                         leaf_request, node_id, nodes, budget)
@@ -408,8 +476,13 @@ class RootSearcher:
         constraints = extract_numeric_constraints(request.query_ast,
                                                   doc_mapper)
         if constraints:
+            before = len(splits)
             splits = [s for s in splits if not split_excluded_by_bounds(
                 s.metadata.column_bounds, constraints)]
+            if before != len(splits):
+                profile = current_profile()
+                if profile is not None:
+                    profile.add("splits_pruned_zonemap", before - len(splits))
         return splits
 
     def _leaf_search_with_retry(self, leaf_request: LeafSearchRequest,

@@ -33,6 +33,9 @@ from ..observability.metrics import (
     SEARCH_DEADLINE_REMAINING, SEARCH_SHED_TOTAL,
     SEARCH_SPLITS_DOWNGRADED_TOTAL, SEARCH_SPLITS_PRUNED_TOTAL,
 )
+from ..observability.profile import (
+    QueryProfile, bind_profile, current_profile, profile_scope,
+)
 from ..query.ast import MatchAll
 from ..parallel.fanout import build_batch, execute_batch, stage_device_inputs
 from ..storage.base import StorageResolver
@@ -54,7 +57,7 @@ logger = logging.getLogger(__name__)
 
 # rate_limited_tracing.rs analogue: a bad query fanned over thousands of
 # splits must not emit thousands of identical warnings
-from ..observability.tracing import RateLimitedLog  # noqa: E402
+from ..observability.tracing import TRACER, RateLimitedLog  # noqa: E402
 
 _SPLIT_WARN_LIMITER = RateLimitedLog(limit=5, period_secs=60.0)
 
@@ -206,7 +209,21 @@ class SearchService:
 
     # ------------------------------------------------------------------
     def leaf_search(self, request: LeafSearchRequest) -> LeafSearchResponse:
-        from ..observability.tracing import TRACER
+        # A remote hop (REST/gRPC wire) drops the root's ambient profile
+        # object — build a leaf-local one when profiling was requested and
+        # ship it back on the response; embedded leaves (same process,
+        # fan-out thread) write into the root's profile directly through
+        # the ambient binding and must NOT double-profile.
+        if (current_profile() is None
+                and request.search_request.profile):
+            local_profile = QueryProfile()
+            with TRACER.span("leaf_search",
+                             {"num_splits": len(request.splits)}):
+                with profile_scope(local_profile):
+                    response = self._leaf_search_traced(request)
+            local_profile.finish()
+            response.profile = local_profile.to_dict()
+            return response
         with TRACER.span("leaf_search",
                          {"num_splits": len(request.splits)}):
             return self._leaf_search_traced(request)
@@ -319,11 +336,21 @@ class SearchService:
                                           if prune_ctx.mode is not None
                                           else None))
                 result_box: dict[str, Any] = {}
+                # the dispatch thread has an empty thread-local span stack:
+                # capture the traceparent HERE so the offload client's
+                # injected header joins this query's trace (satellite of
+                # the trace-stitching work; same capture as root _fan_out)
+                offload_tp = TRACER.current_traceparent()
 
-                def _invoke(box=result_box, rr=remote_request):
+                def _invoke(box=result_box, rr=remote_request,
+                            tp=offload_tp):
                     try:
-                        box["response"] = \
-                            self.context.offload_client().leaf_search(rr)
+                        with TRACER.span(
+                                "leaf_offload",
+                                {"num_splits": len(rr.splits)},
+                                remote_parent=tp):
+                            box["response"] = \
+                                self.context.offload_client().leaf_search(rr)
                     except Exception as exc:  # noqa: BLE001 - fallback below
                         box["error"] = exc
 
@@ -345,16 +372,20 @@ class SearchService:
         pipelined = self.context.prefetch and len(groups) > 1
         future = None
         if pipelined:
-            # bind_deadline: contextvars do not reach pool worker threads
+            # bind_deadline/bind_profile: contextvars do not reach pool
+            # worker threads
             future = self.context.prefetch_pool().submit(
-                bind_deadline(self._prepare_group), groups[0], doc_mapper,
-                search_request, prune_ctx, threshold)
+                bind_profile(bind_deadline(self._prepare_group)), groups[0],
+                doc_mapper, search_request, prune_ctx, threshold)
         for i, group in enumerate(groups):
             begin = i * batch_size
             if deadline.expired:
                 # out of budget mid-request: every remaining split surfaces
                 # as a typed, retryable failure — partial and on time
                 SEARCH_SHED_TOTAL.inc(stage="leaf_groups")
+                shed_profile = current_profile()
+                if shed_profile is not None:
+                    shed_profile.mark_partial("shed: leaf group loop")
                 for split in pending[begin:]:
                     collector.failed_splits.append(SplitSearchError(
                         split_id=split.split_id,
@@ -371,8 +402,9 @@ class SearchService:
             future = None
             if pipelined and i + 1 < len(groups):
                 future = self.context.prefetch_pool().submit(
-                    bind_deadline(self._prepare_group), groups[i + 1],
-                    doc_mapper, search_request, prune_ctx, threshold)
+                    bind_profile(bind_deadline(self._prepare_group)),
+                    groups[i + 1], doc_mapper, search_request, prune_ctx,
+                    threshold)
             self._execute_group(prepared, doc_mapper, search_request,
                                 collector, prune_ctx, threshold, prune_stats)
             # publish the (possibly higher) Kth value for the next groups
@@ -397,6 +429,10 @@ class SearchService:
                               for b in range(0, len(offloaded), batch_size)]:
                     if deadline.expired:
                         SEARCH_SHED_TOTAL.inc(stage="offload_fallback")
+                        shed_profile = current_profile()
+                        if shed_profile is not None:
+                            shed_profile.mark_partial(
+                                "shed: offload fallback")
                         for split in group:
                             collector.failed_splits.append(SplitSearchError(
                                 split_id=split.split_id,
@@ -425,6 +461,18 @@ class SearchService:
             num_pruned_by_predicate
         if num_offloaded:
             response.resource_stats["num_splits_offloaded"] = num_offloaded
+        profile = current_profile()
+        if profile is not None:
+            # pruning decisions land in the waterfall's counters; the
+            # threshold that killed the pruned splits rides along so the
+            # profile can answer "skipped — against WHAT bound?"
+            for stat_key, value in response.resource_stats.items():
+                profile.add(stat_key, value)
+            final_threshold = threshold.get()
+            if final_threshold is not None and (
+                    prune_stats["pruned"] or prune_stats["downgraded"]):
+                profile.set_counter("topk_prune_threshold",
+                                    float(final_threshold))
         return response
 
     _OFFLOAD_TIMEOUT_SECS = 30.0
@@ -662,8 +710,11 @@ class SearchService:
                            prune_stats=None) -> None:
         from .leaf import warmup_device_arrays
         deadline = current_deadline()
+        profile = current_profile()
         for split, reader, plan, prep_error in data:
             if deadline is not None and deadline.expired:
+                if profile is not None:
+                    profile.mark_partial("shed: split execute")
                 collector.failed_splits.append(SplitSearchError(
                     split_id=split.split_id,
                     error="deadline exceeded before split executed at leaf",

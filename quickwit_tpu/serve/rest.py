@@ -125,6 +125,8 @@ def _search_request_from_params(index_id: str, params: dict[str, Any],
         if params.get("snippet_fields") else (),
         timeout_millis=int(params["timeout_ms"])
         if params.get("timeout_ms") is not None else None,
+        profile=str(params.get("profile", "false")).lower()
+        in ("true", "1", "yes"),
     )
 
 
@@ -363,6 +365,21 @@ class RestServer:
                              title=f"{node.config.node_id} CPU profile "
                                    f"({duration:g}s @ {hz:g}Hz)")
             return 200, ("__raw__", svg.encode(), "image/svg+xml")
+        if path == "/api/v1/developer/slowlog":
+            # ring buffer of slow/shed/timed-out query profiles (role of the
+            # reference's slow-query log). GET returns the buffer; POST with
+            # {"threshold_ms": N} arms/re-arms capture, N=null disarms.
+            from ..observability.slowlog import SLOW_QUERY_LOG
+            if method == "POST":
+                payload = json.loads(body) if body else {}
+                threshold = payload.get("threshold_ms")
+                SLOW_QUERY_LOG.configure(
+                    float(threshold) if threshold is not None else None)
+                return 200, {"armed": SLOW_QUERY_LOG.armed,
+                             "threshold_ms": SLOW_QUERY_LOG.threshold_ms}
+            return 200, {"armed": SLOW_QUERY_LOG.armed,
+                         "threshold_ms": SLOW_QUERY_LOG.threshold_ms,
+                         "entries": SLOW_QUERY_LOG.entries()}
         if path == "/api/v1/developer/debug":
             import sys as _sys
             import traceback
@@ -1051,6 +1068,11 @@ class RestServer:
             search_after=search_after,
             timeout_millis=_parse_es_duration_millis(
                 payload.get("timeout", params.get("timeout"))),
+            # ES `"profile": true` body flag (query-param form rides along
+            # for GET searches)
+            profile=bool(payload.get("profile")) or
+            str(params.get("profile", "false")).lower()
+            in ("true", "1", "yes"),
         )
         request._es_sort_scales = scales  # response-side display scaling
         return request
@@ -1177,6 +1199,11 @@ class RestServer:
             },
             **({"aggregations": response.aggregations}
                if response.aggregations is not None else {}),
+            # phase waterfall (additive, only when the request asked): the
+            # shape is ours, not ES's shard-profile schema — the flag is
+            # what is ES-compatible
+            **({"profile": response.profile}
+               if getattr(response, "profile", None) is not None else {}),
         }
         failed = getattr(response, "failed_splits", None) or []
         if failed:

@@ -83,6 +83,9 @@ def leaf_response_to_dict(response: LeafSearchResponse) -> dict[str, Any]:
         "num_successful_splits": response.num_successful_splits,
         "intermediate_aggs": _encode_value(response.intermediate_aggs),
         "resource_stats": response.resource_stats,
+        # additive: absent unless the leaf profiled this request
+        **({"profile": response.profile}
+           if response.profile is not None else {}),
     }
 
 
@@ -104,4 +107,5 @@ def leaf_response_from_dict(d: dict[str, Any]) -> LeafSearchResponse:
         num_successful_splits=d.get("num_successful_splits", 0),
         intermediate_aggs=_decode_value(d.get("intermediate_aggs", {})),
         resource_stats=d.get("resource_stats", {}),
+        profile=d.get("profile"),
     )

@@ -100,6 +100,10 @@ class TimeoutAndRetryStorage(Storage):
                 if launched < max_attempts:
                     launch()  # hedge: race a fresh attempt, keep waiting
                     launched += 1
+                    # hedged retries are the tail-latency signal the
+                    # profile's storage counters must carry
+                    from ..observability.profile import profile_add
+                    profile_add("storage_hedged_requests")
                     continue
                 raise StorageError(
                     f"get_slice {path}[{start}:{end}] timed out after "
@@ -111,6 +115,8 @@ class TimeoutAndRetryStorage(Storage):
             if launched < max_attempts:
                 launch()  # a failure consumes the retry budget too
                 launched += 1
+                from ..observability.profile import profile_add
+                profile_add("storage_hedged_requests")
                 continue
             if failed >= launched:
                 raise last_error  # every attempt has failed

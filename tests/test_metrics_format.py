@@ -1,0 +1,136 @@
+"""Prometheus text-format conformance for the metrics registry.
+
+A scraper only sees the exposition text, so these tests parse
+`METRICS.expose_text()` back with a strict grammar instead of asserting on
+Python-side state: label escaping must round-trip, histogram buckets must be
+cumulative and end at `+Inf == _count`, and every sample line must belong to
+a family announced by `# HELP` / `# TYPE` headers.
+"""
+
+import math
+import re
+
+import pytest
+
+from quickwit_tpu.observability.metrics import (
+    METRICS, Counter, Histogram, _escape_label_value,
+)
+
+# One exposition sample: name, optional {labels}, value.
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(.*)\})?'
+    r' (-?(?:[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|\+Inf|NaN))$')
+# One label pair inside the braces; the value is a double-quoted string
+# whose only escapes are \\  \"  \n (the Prometheus text-format set).
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(value: str) -> str:
+    return (value.replace("\\n", "\n").replace('\\"', '"')
+            .replace("\\\\", "\\"))
+
+
+def parse_exposition(text: str) -> dict[str, dict[tuple, float]]:
+    """Strict parse of the text format. Returns
+    ``{sample_name: {sorted_label_tuple: value}}`` and asserts structural
+    invariants (HELP/TYPE before samples, no unparseable lines)."""
+    samples: dict[str, dict[tuple, float]] = {}
+    declared_types: dict[str, str] = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            assert len(line.split(" ", 3)) >= 3, f"malformed HELP: {line!r}"
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert kind in ("counter", "gauge", "histogram"), line
+            assert name not in declared_types, f"duplicate TYPE for {name}"
+            declared_types[name] = kind
+            continue
+        assert not line.startswith("#"), f"unknown comment line: {line!r}"
+        m = _SAMPLE_RE.match(line)
+        assert m is not None, f"unparseable sample line: {line!r}"
+        name, raw_labels, raw_value = m.groups()
+        family = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert (name in declared_types or family in declared_types), \
+            f"sample {name!r} has no preceding # TYPE"
+        labels: dict[str, str] = {}
+        if raw_labels:
+            consumed = ",".join(f'{k}="{v}"'
+                                for k, v in _LABEL_RE.findall(raw_labels))
+            assert consumed == raw_labels, \
+                f"label section not fully parsed: {raw_labels!r}"
+            labels = {k: _unescape(v) for k, v in _LABEL_RE.findall(raw_labels)}
+        value = float("inf") if raw_value == "+Inf" else float(raw_value)
+        samples.setdefault(name, {})[tuple(sorted(labels.items()))] = value
+    return samples
+
+
+def test_label_escaping_round_trips():
+    nasty = 'path\\with "quotes"\nand newline'
+    counter = Counter("qw_test_escape_total", "escaping probe")
+    counter.inc(3.0, op=nasty)
+    text = "\n".join(counter.expose()) + "\n"
+    # the raw value must not appear unescaped (a bare newline would split
+    # the sample across two unparseable lines)
+    assert '\n' not in text.split(" ", 1)[0]
+    parsed = parse_exposition(text)
+    labels = tuple(sorted({"op": nasty}.items()))
+    assert parsed["qw_test_escape_total"][labels] == 3.0
+
+
+def test_escape_helper_is_order_safe():
+    # escaping backslash first is what keeps \" from double-escaping
+    assert _escape_label_value('\\"') == '\\\\\\"'
+    assert _escape_label_value("a\nb") == "a\\nb"
+    assert _unescape(_escape_label_value('w\\ei"rd\nvalue')) == 'w\\ei"rd\nvalue'
+
+
+def test_histogram_buckets_cumulative_and_consistent():
+    hist = Histogram("qw_test_latency_seconds", "probe",
+                     buckets=(0.01, 0.1, 1.0))
+    observed = [0.005, 0.05, 0.05, 0.5, 5.0]  # last lands in +Inf only
+    for v in observed:
+        hist.observe(v, op="read")
+    parsed = parse_exposition("\n".join(hist.expose()) + "\n")
+    buckets = parsed["qw_test_latency_seconds_bucket"]
+    by_le = {dict(k)["le"]: v for k, v in buckets.items()}
+    assert by_le == {"0.01": 1, "0.1": 3, "1": 4, "+Inf": 5}
+    # cumulative: counts non-decreasing in bucket order
+    ordered = [by_le["0.01"], by_le["0.1"], by_le["1"], by_le["+Inf"]]
+    assert ordered == sorted(ordered)
+    labels = tuple(sorted({"op": "read"}.items()))
+    count = parsed["qw_test_latency_seconds_count"][labels]
+    total = parsed["qw_test_latency_seconds_sum"][labels]
+    assert count == len(observed) == by_le["+Inf"]
+    assert total == pytest.approx(sum(observed))
+
+
+def test_full_registry_exposition_parses():
+    """The real global registry — after driving a few metrics through the
+    awkward cases (labels, floats, multiple label sets) — must emit text
+    the strict parser accepts line-for-line."""
+    probe = METRICS.counter("qw_test_registry_probe_total", "probe")
+    probe.inc(1.5, stage="leaf", node='n"1')
+    probe.inc(2.0, stage="root", node="n\\2")
+    METRICS.histogram("qw_test_registry_probe_seconds", "probe").observe(0.2)
+    text = METRICS.expose_text()
+    parsed = parse_exposition(text)
+    assert parsed  # non-empty registry
+    assert parsed["qw_test_registry_probe_total"][
+        tuple(sorted({"stage": "leaf", "node": 'n"1'}.items()))] == 1.5
+    assert parsed["qw_test_registry_probe_total"][
+        tuple(sorted({"stage": "root", "node": "n\\2"}.items()))] == 2.0
+    # every histogram family in the registry keeps +Inf == _count
+    for name, series in parsed.items():
+        if not name.endswith("_bucket"):
+            continue
+        family = name[: -len("_bucket")]
+        for key, value in series.items():
+            if dict(key).get("le") == "+Inf":
+                bare = tuple(kv for kv in key if kv[0] != "le")
+                assert value == parsed[family + "_count"][bare]
+    assert not any(math.isnan(v)
+                   for series in parsed.values() for v in series.values())

@@ -81,6 +81,14 @@ def test_concurrent_mixed_workload(api):
     latencies: list[float] = []  # list.append is GIL-atomic
     barrier = threading.Barrier(THREADS)
 
+    # arm the slow-query log over REST for the whole storm: threshold 0
+    # captures every search, so the dump below is a per-query waterfall
+    # census of the soak — exactly what the endpoint is for in production
+    status, data = _call(port, "POST", "/api/v1/developer/slowlog",
+                         json.dumps({"threshold_ms": 0.0}).encode())
+    assert status == 200, data[:200]
+    assert json.loads(data)["armed"]
+
     def timed_call(method, path, body=None):
         t0 = time.monotonic()
         result = _call(port, method, path, body)
@@ -161,6 +169,34 @@ def test_concurrent_mixed_workload(api):
         port, "GET", "/api/v1/soak/search?query=common&max_hits=0")
     assert status == 200
     assert json.loads(data)["num_hits"] == 50 + sum(ingested)
+
+    # slow-query dump: the armed ring buffer captured real waterfalls for
+    # the storm's searches — phase names, not zeros — and disarming stops
+    # further capture
+    try:
+        status, data = _call(port, "GET", "/api/v1/developer/slowlog")
+        assert status == 200, data[:200]
+        dump = json.loads(data)
+        assert dump["armed"]
+        entries = dump["entries"]
+        assert entries, "armed slowlog captured nothing during the soak"
+        for entry in entries:
+            assert entry["elapsed_ms"] >= 0
+            assert entry["profile"]["phases"], \
+                f"slowlog entry {entry['query_id']} has an empty waterfall"
+        slowest = sorted(entries, key=lambda e: e["elapsed_ms"])[-3:]
+        print("slowlog dump (slowest of "
+              f"{len(entries)} captured):")
+        for entry in reversed(slowest):
+            phases = {p["name"]: round(p["duration_ms"], 2)
+                      for p in entry["profile"]["phases"]}
+            print(f"  {entry['query_id']} {entry['elapsed_ms']:.1f}ms "
+                  f"{phases}")
+    finally:
+        status, data = _call(port, "POST", "/api/v1/developer/slowlog",
+                             json.dumps({"threshold_ms": None}).encode())
+        assert status == 200
+        assert not json.loads(data)["armed"]
 
 
 def test_convoy_batcher_coalesces_concurrent_burst(api):
