@@ -822,6 +822,81 @@ def _measure_pruning(iters: int) -> dict:
     }
 
 
+def _measure_tenant_isolation(duration_secs: float = 1.0) -> dict:
+    """Config #7: noisy-neighbor isolation on the HBM admission queue
+    (tenancy/drr.py via search/admission.py). A background-class tenant
+    floods the single admission slot from several threads while an
+    interactive-class victim runs a steady trickle; reports the victim's
+    p99 admission wait alone vs under the storm and their ratio — the
+    number the weighted deficit-round-robin scheduler exists to bound."""
+    import threading
+
+    from quickwit_tpu.search.admission import HbmBudget
+    from quickwit_tpu.tenancy.context import TenantContext, tenant_scope
+
+    cost = 1_000
+    hold_secs = 0.002
+    n_victim = int(os.environ.get("BENCH_TENANT_QUERIES", 50))
+
+    def run_victim(budget, n):
+        tenant = TenantContext.for_class("victim", "interactive")
+        owner = object()
+        waits = []
+        for _ in range(n):
+            with tenant_scope(tenant):
+                t0 = time.monotonic()
+                budget.admit(owner, cost, timeout_secs=30.0)
+            waits.append(time.monotonic() - t0)
+            time.sleep(hold_secs)
+            budget.release(owner, cost, to_resident=False)
+        return waits
+
+    alone = run_victim(HbmBudget(budget_bytes=cost), n_victim)
+
+    budget = HbmBudget(budget_bytes=cost)
+    stop = threading.Event()
+    flood_admissions = [0]
+
+    def flood():
+        tenant = TenantContext.for_class("flood", "background")
+        owner = object()
+        while not stop.is_set():
+            with tenant_scope(tenant):
+                try:
+                    budget.admit(owner, cost, timeout_secs=5.0)
+                except TimeoutError:
+                    continue
+            flood_admissions[0] += 1
+            time.sleep(hold_secs)
+            budget.release(owner, cost, to_resident=False)
+
+    flooders = [threading.Thread(target=flood, daemon=True)
+                for _ in range(6)]
+    for thread in flooders:
+        thread.start()
+    try:
+        stormed = run_victim(budget, n_victim)
+    finally:
+        stop.set()
+        for thread in flooders:
+            thread.join(timeout=10)
+
+    p99_alone = _percentile(alone, 0.99)
+    p99_storm = _percentile(stormed, 0.99)
+    return {
+        "victim_queries": n_victim,
+        "flood_threads": 6,
+        "flood_admissions": flood_admissions[0],
+        "p99_alone_ms": round(p99_alone * 1000, 3),
+        "p99_storm_ms": round(p99_storm * 1000, 3),
+        # the headline: bounded noisy-neighbor degradation (lower = better)
+        "noisy_neighbor_p99_ratio": round(
+            p99_storm / max(p99_alone, 1e-4), 2),
+        "mean_storm_ms": round(
+            sum(stormed) / len(stormed) * 1000, 3),
+    }
+
+
 def _run_all(iters: int, with_device_loops: bool = True) -> dict:
     results: dict = {}
     workloads = _workloads()
@@ -842,6 +917,9 @@ def _run_all(iters: int, with_device_loops: bool = True) -> dict:
         results["c6_split_pruning"] = _measure_pruning(max(3, iters // 3))
         print(f"# c6_split_pruning: "
               f"{json.dumps(results['c6_split_pruning'])}", file=sys.stderr)
+        results["c7_tenant_isolation"] = _measure_tenant_isolation()
+        print(f"# c7_tenant_isolation: "
+              f"{json.dumps(results['c7_tenant_isolation'])}", file=sys.stderr)
     return results
 
 

@@ -41,6 +41,22 @@ class TokenBucket:
                 return True
             return False
 
+    def time_to_available(self, cost: float = 1.0) -> float:
+        """Seconds until `cost` tokens will have refilled — the honest
+        value for a 429 `Retry-After` header. Costs above the burst are
+        clamped (they can never be fully banked; the caller charges them
+        as a full-bucket drain instead)."""
+        with self._lock:
+            now = self.clock()
+            tokens = min(self.burst,
+                         self._tokens + (now - self._last) * self.rate)
+            needed = min(cost, self.burst) - tokens
+            if needed <= 0.0:
+                return 0.0
+            if self.rate <= 0.0:
+                return float("inf")
+            return needed / self.rate
+
     def release(self, cost: float = 1.0) -> None:
         """Refund tokens a failed operation did not really consume."""
         with self._lock:

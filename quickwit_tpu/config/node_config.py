@@ -102,6 +102,8 @@ def load_node_config(path: Optional[str] = None,
         offload_max_local_splits=int((data.get("searcher", {}) or {}).get(
             "offload_max_local_splits", 16)),
         **_split_cache_fields(data),
+        tenancy=(data.get("tenancy")
+                 if isinstance(data.get("tenancy"), dict) else None),
         grpc_port=(int(environ["QW_GRPC_PORT"])
                    if "QW_GRPC_PORT" in environ
                    else (int((data.get("grpc", {}) or {})["listen_port"])

@@ -148,12 +148,17 @@ class MergeExecutor:
 
     def __init__(self, index_uid: str, doc_mapper: DocMapper,
                  metastore: Metastore, split_storage: Storage,
-                 node_id: str = "node-0"):
+                 node_id: str = "node-0", fault_injector=None):
         self.index_uid = index_uid
         self.doc_mapper = doc_mapper
         self.metastore = metastore
         self.split_storage = split_storage
         self.node_id = node_id
+        # chaos hook (common/faults.FaultInjector): "merge.execute" perturbs
+        # the read/merge phase, "merge.publish" the atomic replace — a fault
+        # at either point must leave every input split PUBLISHED and
+        # searchable (no_split_loss), and a retry must conserve rows
+        self.fault_injector = fault_injector
 
     def execute(self, operation: MergeOperation,
                 delete_tasks: Optional[list[dict]] = None) -> Optional[str]:
@@ -161,6 +166,8 @@ class MergeExecutor:
         Only tasks NEWER than every input split's delete_opstamp still need
         applying — already-applied tasks must not push merges onto the slow
         doc-level path forever."""
+        if self.fault_injector is not None:
+            self.fault_injector.perturb("merge.execute")
         max_delete_opstamp = self.metastore.last_delete_opstamp(self.index_uid)
         min_applied = min(s.metadata.delete_opstamp for s in operation.splits)
         applicable = [t for t in (delete_tasks or [])
@@ -235,6 +242,10 @@ class MergeExecutor:
         )
         self.metastore.stage_splits(self.index_uid, [metadata])
         self.split_storage.put(split_file_path(merged_id), data)
+        if self.fault_injector is not None:
+            # pre-publish crash: the merged split stays STAGED (GC fodder)
+            # and every input stays PUBLISHED — the replace is all-or-nothing
+            self.fault_injector.perturb("merge.publish")
         self.metastore.publish_splits(
             self.index_uid, [merged_id],
             replaced_split_ids=operation.split_ids)

@@ -60,13 +60,18 @@ class IndexingPipeline:
 
     def __init__(self, params: PipelineParams, doc_mapper: DocMapper,
                  source: Source, metastore: Metastore, split_storage: Storage,
-                 transform=None):
+                 transform=None, fault_injector=None):
         self.params = params
         self.doc_mapper = doc_mapper
         self.source = source
         self.metastore = metastore
         self.split_storage = split_storage
         self.transform = transform  # compiled Transform (VRL analogue) or None
+        # chaos hook (common/faults.FaultInjector): perturbs the commit's
+        # stage/upload/publish boundaries ("indexing.stage",
+        # "indexing.upload", "indexing.publish") so the crash-between-stages
+        # claims above are test-driven, not asserted
+        self.fault_injector = fault_injector
         self.counters = PipelineCounters()
         # one writer per partition id (reference `indexer.rs:146-160`);
         # partition 0 is the unpartitioned default
@@ -168,13 +173,21 @@ class IndexingPipeline:
             ), data))
         # stage → upload → publish: a crash between stages leaves either a
         # staged-but-absent split (GC'd) or an uploaded-but-unpublished file
-        # (GC'd); never a published split without its file.
+        # (GC'd); never a published split without its file. Each boundary
+        # perturbs BEFORE its mutation so an error-kind fault models a crash
+        # that left the previous stage durable and this one not started.
+        if self.fault_injector is not None:
+            self.fault_injector.perturb("indexing.stage")
         self.metastore.stage_splits(self.params.index_uid,
                                     [m for m, _ in staged])
+        if self.fault_injector is not None:
+            self.fault_injector.perturb("indexing.upload")
         for metadata, data in staged:
             self.split_storage.put(split_file_path(metadata.split_id), data)
         delta = self._pending_delta if not self._pending_delta.is_empty else None
         split_ids = [m.split_id for m, _ in staged]
+        if self.fault_injector is not None:
+            self.fault_injector.perturb("indexing.publish")
         self.metastore.publish_splits(
             self.params.index_uid, split_ids,
             source_id=self.params.source_id,

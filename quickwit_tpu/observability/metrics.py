@@ -254,6 +254,29 @@ SEARCH_SLOWLOG_RECORDED_TOTAL = METRICS.counter(
     "qw_search_slowlog_recorded_total",
     "Queries captured into the slow-query ring buffer")
 
+# --- multi-tenant workload isolation (tenancy/) ----------------------------
+# All tenant labels pass through TenancyRegistry.metric_label, which hashes
+# long ids and caps distinct label values, so cardinality stays bounded no
+# matter what clients put in the tenant header.
+TENANT_QUERIES_TOTAL = METRICS.counter(
+    "qw_tenant_queries_total",
+    "Root searches per tenant, labeled by completion status")
+TENANT_SHED_TOTAL = METRICS.counter(
+    "qw_tenant_shed_total",
+    "Queries shed by the overload controller, per tenant and checkpoint")
+TENANT_REJECTED_TOTAL = METRICS.counter(
+    "qw_tenant_rejected_total",
+    "Queries rejected by per-tenant token-bucket rate limits")
+TENANT_STAGED_BYTES_TOTAL = METRICS.counter(
+    "qw_tenant_staged_bytes_total",
+    "HBM bytes admitted (staged) per tenant")
+TENANT_EXECUTE_SECONDS_TOTAL = METRICS.counter(
+    "qw_tenant_execute_seconds_total",
+    "Execution wall time attributed to each tenant from query profiles")
+TENANT_ADMISSION_WAIT = METRICS.histogram(
+    "qw_tenant_admission_wait_seconds",
+    "HBM admission queue wait per tenant")
+
 # --- chaos / fault injection (common/faults.py) ----------------------------
 # Every fault the injector actually fired, labeled op=<operation>
 # kind=<latency|error|hang>: chaos runs are visible in /metrics instead of

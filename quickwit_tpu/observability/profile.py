@@ -158,6 +158,23 @@ class QueryProfile:
             return sum(p.get("duration_ms", 0.0) for p in self._phases
                        if p["name"] == name)
 
+    def phase_ms_recursive(self, name: str) -> float:
+        """Total milliseconds under `name` including remote leaves' child
+        profiles — the cross-node attribution tenancy accounting charges
+        (an embedded leaf writes into this profile directly, a remote one
+        arrives as a child)."""
+        def from_child(child: dict) -> float:
+            total = sum(p.get("duration_ms", 0.0)
+                        for p in child.get("phases", ())
+                        if p.get("name") == name)
+            return total + sum(from_child(c)
+                               for c in child.get("leaves", ()))
+        with self._lock:
+            own = sum(p.get("duration_ms", 0.0) for p in self._phases
+                      if p["name"] == name)
+            children = [dict(c) for c in self._children]
+        return own + sum(from_child(c) for c in children)
+
     def to_dict(self) -> dict[str, Any]:
         with self._lock:
             phases = sorted((dict(p) for p in self._phases),

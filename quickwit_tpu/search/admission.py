@@ -8,10 +8,14 @@ plan KNOWS every array's exact byte size before any transfer, so
 admission is exact:
 
 - a query's NEW transfer bytes are **pinned** for the duration of its
-  execution; admission is FIFO (a ticket queue — large requests cannot
-  be starved by a stream of small ones) and blocks while earlier pins
-  would overflow the budget — over-budget work queues instead of
-  materializing;
+  execution; admission order is weighted deficit-round-robin across
+  tenants (`tenancy/drr.py`): per-tenant FIFO sub-queues, grant order by
+  deficit counter, so one flooding tenant cannot convoy everyone else's
+  queue wait, while large requests still cannot be starved by a stream
+  of small ones. Unlabeled traffic all lands in one implicit tenant,
+  where DRR degenerates to the exact FIFO this queue used to be.
+  Admission blocks while earlier pins would overflow the budget —
+  over-budget work queues instead of materializing;
 - after execution the pins downgrade to **resident** bytes (the device
   array cache that makes repeat queries skip H2D); residency is evicted
   LRU per split reader whenever new pins need room. Readers with
@@ -28,17 +32,20 @@ footprint — a fixed budget admits proportionally more concurrent splits.
 
 from __future__ import annotations
 
-import itertools
 import logging
 import os
 import threading
 import time
 import weakref
-from collections import OrderedDict, deque
+from collections import OrderedDict
 
 from ..common.deadline import DeadlineExceeded, current_deadline
 from ..observability.metrics import SEARCH_SHED_TOTAL
 from ..observability.profile import PHASE_ADMISSION_WAIT, current_profile
+from ..tenancy.context import effective_tenant
+from ..tenancy.drr import DrrScheduler
+from ..tenancy.overload import OVERLOAD, OverloadShed
+from ..tenancy.registry import GLOBAL_TENANCY
 
 logger = logging.getLogger(__name__)
 
@@ -51,8 +58,9 @@ class HbmBudget:
         self._cond = threading.Condition()
         self._pinned = 0
         self._pin_counts: dict[int, int] = {}  # id(owner) -> in-flight count
-        self._tickets: deque[int] = deque()    # FIFO admission order
-        self._ticket_seq = itertools.count()
+        # weighted deficit-round-robin admission order across tenants;
+        # guarded by self._cond's lock (the scheduler itself is lock-free)
+        self._drr = DrrScheduler()
         # id(reader) -> [resident_bytes, weakref(reader)]
         self._resident: "OrderedDict[int, list]" = OrderedDict()
         self._resident_bytes = 0
@@ -60,16 +68,22 @@ class HbmBudget:
     # ------------------------------------------------------------------
     def admit(self, owner, new_bytes: int,
               timeout_secs: float = 120.0) -> int:
-        """Block (FIFO) until `new_bytes` fit; returns the admitted
-        (pinned) byte count. Evicts idle readers' resident device arrays
-        LRU to make room.
+        """Block until `new_bytes` fit; returns the admitted (pinned) byte
+        count. Grant order is weighted deficit-round-robin across the
+        ambient tenant's sub-queue (FIFO within a tenant; see module
+        docstring). Evicts idle readers' resident device arrays LRU to
+        make room.
 
         Load shedding: a query whose ambient deadline has already passed —
         or passes while it queues — is rejected with `DeadlineExceeded`
         instead of occupying a ticket; its caller has no time left to use
-        the admission anyway."""
+        the admission anyway. Under sustained overload the controller
+        additionally sheds low-priority tenants up front (`OverloadShed`),
+        and a tenant over its staged-bytes/s bucket is rejected with
+        `TenantRateLimited` before it queues."""
         query_deadline = current_deadline()
         profile = current_profile()
+        tenant = effective_tenant()
         if query_deadline is not None and query_deadline.expired:
             SEARCH_SHED_TOTAL.inc(stage="admission")
             if profile is not None:
@@ -82,7 +96,15 @@ class HbmBudget:
                 self._pin_counts[id(owner)] = \
                     self._pin_counts.get(id(owner), 0) + 1
             return 0
-        ticket = next(self._ticket_seq)
+        if OVERLOAD.should_shed(tenant.priority):
+            SEARCH_SHED_TOTAL.inc(stage="overload_admission")
+            GLOBAL_TENANCY.note_shed(tenant.tenant_id, stage="admission")
+            if profile is not None:
+                profile.mark_partial("shed: overload (admission)")
+            raise OverloadShed("admission", OVERLOAD.retry_after_secs())
+        # staged-bytes/s pacing: charged before queueing so a flooding
+        # tenant is bounced while its bytes are still hypothetical
+        GLOBAL_TENANCY.charge_staged_bytes(tenant, new_bytes)
         if query_deadline is not None:
             timeout_secs = min(timeout_secs,
                                query_deadline.clamp(timeout_secs))
@@ -90,9 +112,10 @@ class HbmBudget:
         t_admit = time.monotonic()
         try:
             with self._cond:
-                self._tickets.append(ticket)
+                ticket = self._drr.enqueue(tenant.tenant_id, tenant.weight,
+                                           new_bytes)
                 try:
-                    while not (self._tickets[0] == ticket
+                    while not (self._drr.head() is ticket
                                and (self._pinned == 0
                                     or self._pinned + new_bytes
                                     <= self.budget)):
@@ -108,9 +131,12 @@ class HbmBudget:
                                 f"bytes, {self._pinned} pinned of "
                                 f"{self.budget}")
                         self._cond.wait(remaining)
-                finally:
-                    self._tickets.remove(ticket)
-                    self._cond.notify_all()  # next ticket may now be at head
+                except BaseException:
+                    self._drr.remove(ticket, served=False)
+                    self._cond.notify_all()  # a new head may now be grantable
+                    raise
+                self._drr.remove(ticket, served=True)
+                self._cond.notify_all()
                 self._pinned += new_bytes
                 self._pin_counts[id(owner)] = \
                     self._pin_counts.get(id(owner), 0) + 1
@@ -120,16 +146,22 @@ class HbmBudget:
                         "query needs %d bytes against a %d-byte HBM budget; "
                         "admitted alone", new_bytes, self.budget)
         except BaseException:
+            wait = time.monotonic() - t_admit
+            OVERLOAD.note_wait(wait)
             if profile is not None:
                 # shed while queued: the partial wait is still reported
                 profile.record_phase(
-                    PHASE_ADMISSION_WAIT, time.monotonic() - t_admit,
+                    PHASE_ADMISSION_WAIT, wait,
                     start=t_admit, bytes=new_bytes, aborted=True)
                 profile.mark_partial("shed: HBM admission queue wait")
             raise
+        wait = time.monotonic() - t_admit
+        OVERLOAD.note_wait(wait)
+        GLOBAL_TENANCY.note_admission_wait(tenant.tenant_id, wait)
+        GLOBAL_TENANCY.note_staged_bytes(tenant.tenant_id, new_bytes)
         if profile is not None:
             profile.record_phase(PHASE_ADMISSION_WAIT,
-                                 time.monotonic() - t_admit, start=t_admit,
+                                 wait, start=t_admit,
                                  bytes=new_bytes)
         return new_bytes
 
@@ -199,4 +231,5 @@ class HbmBudget:
     def stats(self) -> dict:
         with self._cond:
             return {"budget": self.budget, "pinned": self._pinned,
-                    "resident": self._resident_bytes}
+                    "resident": self._resident_bytes,
+                    "waiting_by_tenant": self._drr.waiting_by_tenant()}

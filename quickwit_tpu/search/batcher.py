@@ -26,6 +26,8 @@ never orphan its followers."""
 
 from __future__ import annotations
 
+import heapq
+import itertools
 import threading
 import time
 from typing import Any, Optional
@@ -36,12 +38,46 @@ from ..observability.metrics import (
     SEARCH_BATCHER_QUEUE_WAIT, SEARCH_BATCHER_RATIO, SEARCH_SHED_TOTAL,
 )
 from ..observability.profile import PHASE_BATCHER_QUEUE, current_profile
+from ..tenancy.context import effective_tenant
+from ..tenancy.overload import OVERLOAD, OverloadShed
+from ..tenancy.registry import GLOBAL_TENANCY
 from . import executor
 
 # Extra follower wait beyond its own deadline: the leader may be setting the
 # event at this very moment — shedding exactly at expiry would discard a
 # result that is already computed.
 _FOLLOWER_SLACK_SECS = 0.05
+
+
+class _PriorityLock:
+    """Per-key dispatch lock with priority-ordered handoff.
+
+    `threading.Lock` hands contended acquisitions to an arbitrary waiter;
+    here, when several convoy leaders for the same key are queued behind an
+    in-flight dispatch, the leader from the highest-priority tenant
+    dispatches next (FIFO within a priority band). With a single waiter —
+    or all waiters at equal priority — behavior is indistinguishable from
+    the plain lock this replaces."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._held = False
+        self._waiters: list[tuple[int, int]] = []  # heap: (-priority, seq)
+        self._seq = itertools.count()
+
+    def acquire(self, priority: int = 0) -> None:
+        with self._cond:
+            entry = (-priority, next(self._seq))
+            heapq.heappush(self._waiters, entry)
+            while self._held or self._waiters[0] != entry:
+                self._cond.wait()
+            heapq.heappop(self._waiters)
+            self._held = True
+
+    def release(self) -> None:
+        with self._cond:
+            self._held = False
+            self._cond.notify_all()
 
 
 class _Pending:
@@ -87,6 +123,13 @@ class QueryBatcher:
         equal posting shape lower to the same signature but DIFFERENT
         arrays — they must not share)."""
         key = (plan.signature(k), tuple(plan.array_keys), split_key)
+        tenant = effective_tenant()
+        # overload checkpoint: under sustained queue-wait pressure the
+        # lowest-priority tenants are bounced before taking a batch slot
+        if OVERLOAD.should_shed(tenant.priority):
+            SEARCH_SHED_TOTAL.inc(stage="overload_batcher")
+            GLOBAL_TENANCY.note_shed(tenant.tenant_id, stage="batcher")
+            raise OverloadShed("batcher", OVERLOAD.retry_after_secs())
         me = _Pending(plan.scalars, current_deadline(), current_profile())
         my_queue = None
         with self._lock:
@@ -102,7 +145,7 @@ class QueryBatcher:
                 my_queue = [me]
                 self._queues[key] = my_queue
                 entry = self._dispatch_locks.setdefault(
-                    key, [threading.Lock(), 0])
+                    key, [_PriorityLock(), 0])
                 entry[1] += 1
                 dispatch_lock = entry[0]
         if my_queue is None:
@@ -127,9 +170,11 @@ class QueryBatcher:
         # serialize dispatches per key: while a previous dispatch is in
         # flight this blocks, and our queue keeps accumulating followers —
         # the batching window emerges from real dispatch latency instead of
-        # a configured sleep
+        # a configured sleep. Contended handoff is priority-ordered: a
+        # higher-class tenant's convoy dispatches before a lower one's.
         try:
-            with dispatch_lock:
+            dispatch_lock.acquire(tenant.priority)
+            try:
                 with self._lock:
                     if self._queues.get(key) is my_queue:
                         del self._queues[key]
@@ -155,6 +200,7 @@ class QueryBatcher:
                         for pending in alive:
                             wait = now - pending.enqueued_at
                             SEARCH_BATCHER_QUEUE_WAIT.observe(wait)
+                            OVERLOAD.note_wait(wait)
                             if pending.profile is not None:
                                 pending.profile.record_phase(
                                     PHASE_BATCHER_QUEUE, wait,
@@ -182,6 +228,8 @@ class QueryBatcher:
                     for pending in alive:
                         pending.error = exc
                         pending.event.set()
+            finally:
+                dispatch_lock.release()
         finally:
             with self._lock:
                 entry = self._dispatch_locks.get(key)

@@ -264,6 +264,11 @@ class LeafSearchRequest:
     # root serializes what is LEFT, not the original timeout, so time spent
     # queued at the root is not silently re-granted to the leaf.
     deadline_millis: Optional[int] = None
+    # Resolved tenant (TenantContext.to_wire(): {"id", "class"}) so a remote
+    # leaf schedules HBM admission / batching in the same class the root
+    # resolved. Additive: absent for tenant-blind traffic. Like
+    # deadline_millis, NOT part of the leaf-cache key.
+    tenant: Optional[dict[str, Any]] = None
     # Kth sort value already collected elsewhere (INTERNAL higher-is-better
     # encoding, see collector.sort_value_threshold). Seeds the leaf's
     # dynamic-pruning threshold so a root retry's second round can skip
@@ -278,6 +283,8 @@ class LeafSearchRequest:
                 "splits": [s.to_dict() for s in self.splits],
                 **({"deadline_millis": self.deadline_millis}
                    if self.deadline_millis is not None else {}),
+                **({"tenant": self.tenant}
+                   if self.tenant is not None else {}),
                 **({"sort_value_threshold": self.sort_value_threshold}
                    if self.sort_value_threshold is not None else {})}
 
@@ -289,6 +296,7 @@ class LeafSearchRequest:
             doc_mapping=d["doc_mapping"],
             splits=[SplitIdAndFooter.from_dict(s) for s in d["splits"]],
             deadline_millis=d.get("deadline_millis"),
+            tenant=d.get("tenant"),
             sort_value_threshold=d.get("sort_value_threshold"))
 
 

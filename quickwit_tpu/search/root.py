@@ -39,6 +39,9 @@ from ..observability.profile import (
 )
 from ..observability.slowlog import SLOW_QUERY_LOG
 from ..query import ast as Q
+from ..tenancy.context import current_tenant, tenant_scope
+from ..tenancy.overload import OverloadShed
+from ..tenancy.registry import GLOBAL_TENANCY, TenantRateLimited
 from .collector import IncrementalCollector, finalize_aggregations
 from .models import (
     FetchDocsRequest, Hit, LeafSearchRequest, LeafSearchResponse, SearchRequest,
@@ -195,6 +198,12 @@ class RootSearcher:
     # ------------------------------------------------------------------
     def search(self, request: SearchRequest) -> SearchResponse:
         from ..observability.tracing import TRACER
+        # per-tenant QPS bucket at ROOT admission: a tenant over its limit
+        # is bounced before any metastore work, with a Retry-After the REST
+        # layer turns into a 429. No bound tenant -> no check (neutral).
+        tenant = current_tenant()
+        if tenant is not None:
+            GLOBAL_TENANCY.check_query_rate(tenant)
         if request.timeout_millis is not None:
             deadline = Deadline.from_millis(request.timeout_millis)
         else:
@@ -215,6 +224,16 @@ class RootSearcher:
                 with deadline_scope(deadline), profile_scope(profile):
                     response = self._search_traced(request, budget)
         except BaseException as exc:
+            if tenant is not None:
+                if isinstance(exc, OverloadShed):
+                    status = "shed"
+                elif isinstance(exc, TenantRateLimited):
+                    status = "rejected"
+                elif is_deadline_error(str(exc)):
+                    status = "timed_out"
+                else:
+                    status = "error"
+                GLOBAL_TENANCY.note_query(tenant.tenant_id, status=status)
             if profile is not None:
                 profile.mark_partial(f"error: {exc}")
                 profile.finish(time.monotonic() - t0)
@@ -223,10 +242,22 @@ class RootSearcher:
             raise
         if response.timed_out:
             SEARCH_TIMED_OUT_TOTAL.inc()
+        if tenant is not None:
+            GLOBAL_TENANCY.note_query(
+                tenant.tenant_id,
+                status="timed_out" if response.timed_out else "ok")
         if profile is not None:
             if response.timed_out:
                 profile.mark_partial("timed_out")
             profile.finish(response.elapsed_time_micros / 1e6)
+            if tenant is not None:
+                # execute-time attribution: device execute milliseconds from
+                # the profile waterfall (embedded + remote leaves) charged
+                # to the tenant's meter
+                from ..observability.profile import PHASE_EXECUTE
+                GLOBAL_TENANCY.note_execute_seconds(
+                    tenant.tenant_id,
+                    profile.phase_ms_recursive(PHASE_EXECUTE) / 1000.0)
             if request.profile:
                 response.profile = profile.to_dict()
             self._capture_slow_query(request, profile,
@@ -239,11 +270,15 @@ class RootSearcher:
         elapsed_ms = profile.wall_ms or 0.0
         if not SLOW_QUERY_LOG.should_capture(elapsed_ms, timed_out):
             return
+        tenant = current_tenant()
         SLOW_QUERY_LOG.record({
             "query_id": profile.query_id,
             "indexes": list(request.index_ids),
             "elapsed_ms": elapsed_ms,
             "timed_out": timed_out,
+            # which tenant's query this was: a noisy-neighbor hunt starts
+            # by grouping the slowlog on this field
+            **({"tenant": tenant.tenant_id} if tenant is not None else {}),
             "profile": profile.to_dict(),
         })
 
@@ -384,16 +419,25 @@ class RootSearcher:
         from ..observability.tracing import TRACER
         parent_tp = TRACER.current_traceparent()
         profile = current_profile()
+        tenant = current_tenant()
+
+        control_errors: list = []
 
         def run(i: int, node_id: str, leaf_request: LeafSearchRequest) -> None:
             with TRACER.span("leaf_dispatch",
                              {"node": node_id,
                               "num_splits": len(leaf_request.splits)},
                              remote_parent=parent_tp), \
-                    profile_scope(profile), deadline_scope(deadline):
+                    profile_scope(profile), deadline_scope(deadline), \
+                    tenant_scope(tenant):
                 try:
                     results[i] = self._leaf_search_with_retry(
                         leaf_request, node_id, nodes, budget)
+                except (OverloadShed, TenantRateLimited) as exc:
+                    # re-raised on the main thread after join: local
+                    # backpressure fails the whole query, not one leaf
+                    control_errors.append(exc)
+                    results[i] = _all_splits_failed(leaf_request, str(exc))
                 except Exception as exc:  # noqa: BLE001 - surfaced per split
                     results[i] = _all_splits_failed(leaf_request, str(exc))
 
@@ -406,6 +450,8 @@ class RootSearcher:
             thread.start()
         for thread in threads:
             thread.join(timeout=deadline.clamp(None))
+        if control_errors:
+            raise control_errors[0]
         out: list[LeafSearchResponse] = []
         for i, (node_id, leaf_request) in enumerate(dispatches):
             response = results[i]
@@ -492,13 +538,24 @@ class RootSearcher:
         budget = budget or QueryBudget(Deadline.never(),
                                        max_retries=self.MAX_RETRIES_PER_QUERY)
         first_error: Optional[str] = None
+        tenant = current_tenant()
         try:
             budget.deadline.check(f"leaf dispatch to {node_id}")
             leaf_request.deadline_millis = budget.deadline.timeout_millis()
+            if tenant is not None:
+                # the resolved class rides the wire so a remote leaf
+                # schedules in the same band without sharing tenant config
+                leaf_request.tenant = tenant.to_wire()
             client = self.clients[node_id]
             response = client.leaf_search(leaf_request)
         except DeadlineExceeded as exc:
             return _all_splits_failed(leaf_request, str(exc), retryable=False)
+        except (OverloadShed, TenantRateLimited):
+            # local backpressure rejects the WHOLE query (429 upstream);
+            # retrying on another node would defeat the controller. A
+            # REMOTE leaf's 429 arrives as a client error instead and
+            # keeps the failed-node retry path below.
+            raise
         except Exception as exc:  # noqa: BLE001 - node-level failure
             logger.warning("leaf search on %s failed: %s", node_id, exc)
             first_error = f"leaf search on {node_id} failed: {exc}"
@@ -558,6 +615,7 @@ class RootSearcher:
             doc_mapping=leaf_request.doc_mapping,
             splits=retry_splits,
             deadline_millis=budget.deadline.timeout_millis(),
+            tenant=tenant.to_wire() if tenant is not None else None,
             sort_value_threshold=retry_threshold,
         )
         try:

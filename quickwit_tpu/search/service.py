@@ -39,6 +39,11 @@ from ..observability.profile import (
 from ..query.ast import MatchAll
 from ..parallel.fanout import build_batch, execute_batch, stage_device_inputs
 from ..storage.base import StorageResolver
+from ..tenancy.context import (
+    TenantContext, bind_tenant, current_tenant, tenant_scope,
+)
+from ..tenancy.overload import OverloadShed
+from ..tenancy.registry import TenantRateLimited
 from .cache import LeafSearchCache, canonical_request_key
 from .predicate_cache import PredicateCache, required_terms
 from .collector import IncrementalCollector
@@ -209,6 +214,17 @@ class SearchService:
 
     # ------------------------------------------------------------------
     def leaf_search(self, request: LeafSearchRequest) -> LeafSearchResponse:
+        # A remote hop also drops the root's ambient tenant — rebuild it
+        # from the wire field so leaf-side admission/batching enforce the
+        # same class; embedded leaves (same process, fan-out thread)
+        # already run under the root's binding.
+        if request.tenant is not None and current_tenant() is None:
+            with tenant_scope(TenantContext.from_wire(request.tenant)):
+                return self._leaf_search_profiled(request)
+        return self._leaf_search_profiled(request)
+
+    def _leaf_search_profiled(self,
+                              request: LeafSearchRequest) -> LeafSearchResponse:
         # A remote hop (REST/gRPC wire) drops the root's ambient profile
         # object — build a leaf-local one when profiling was requested and
         # ship it back on the response; embedded leaves (same process,
@@ -326,11 +342,15 @@ class SearchService:
             offloaded = (warm + cold)[budget:]
             if offloaded:
                 pending = local
+                offload_tenant = current_tenant()
                 remote_request = LeafSearchRequest(
                     search_request=search_request,
                     index_uid=request.index_uid,
                     doc_mapping=request.doc_mapping, splits=offloaded,
                     deadline_millis=deadline.timeout_millis(),
+                    # the offload endpoint enforces the same tenant class
+                    tenant=(offload_tenant.to_wire()
+                            if offload_tenant is not None else None),
                     # let the endpoint start pruning where we already are
                     sort_value_threshold=(threshold.get()
                                           if prune_ctx.mode is not None
@@ -372,11 +392,11 @@ class SearchService:
         pipelined = self.context.prefetch and len(groups) > 1
         future = None
         if pipelined:
-            # bind_deadline/bind_profile: contextvars do not reach pool
-            # worker threads
+            # bind_deadline/bind_profile/bind_tenant: contextvars do not
+            # reach pool worker threads
             future = self.context.prefetch_pool().submit(
-                bind_profile(bind_deadline(self._prepare_group)), groups[0],
-                doc_mapper, search_request, prune_ctx, threshold)
+                bind_tenant(bind_profile(bind_deadline(self._prepare_group))),
+                groups[0], doc_mapper, search_request, prune_ctx, threshold)
         for i, group in enumerate(groups):
             begin = i * batch_size
             if deadline.expired:
@@ -402,7 +422,8 @@ class SearchService:
             future = None
             if pipelined and i + 1 < len(groups):
                 future = self.context.prefetch_pool().submit(
-                    bind_profile(bind_deadline(self._prepare_group)),
+                    bind_tenant(bind_profile(bind_deadline(
+                        self._prepare_group))),
                     groups[i + 1], doc_mapper, search_request, prune_ctx,
                     threshold)
             self._execute_group(prepared, doc_mapper, search_request,
@@ -607,6 +628,12 @@ class SearchService:
                     batch, sum(a.nbytes for a in batch.arrays))
                 stage_device_inputs(batch)  # async transfer starts now
                 return ("batch", run_group, (batch, admitted), extras)
+            except (OverloadShed, TenantRateLimited):
+                # whole-query backpressure, not a split failure: falling
+                # back per split would just re-admit and shed again
+                if admitted is not None and batch is not None:
+                    self.context.hbm_budget.release(batch, admitted)
+                raise
             except Exception as exc:  # noqa: BLE001 - fall back per split
                 if admitted is not None and batch is not None:
                     self.context.hbm_budget.release(batch, admitted)
@@ -686,6 +713,9 @@ class SearchService:
                 # unit is wrong per-split, so cache skipped on the batch path
                 collector.add_leaf_response(merged)
                 return
+            except (OverloadShed, TenantRateLimited):
+                self.context.hbm_budget.release(batch, admitted)
+                raise
             except Exception as exc:  # noqa: BLE001 - fall back per split
                 logger.debug("batch execute failed (%s); per split", exc)
                 # release BEFORE the per-split prepares re-admit: under a
@@ -764,6 +794,12 @@ class SearchService:
                 collector.add_leaf_response(response)
                 if threshold is not None:
                     threshold.update(collector.sort_value_threshold())
+            except (OverloadShed, TenantRateLimited):
+                # a shed/rate-limited tenant is rejected as a WHOLE query
+                # (429 + Retry-After at the API layer) — recording it as a
+                # retryable split failure would make the root burn retries
+                # on work the controller just refused
+                raise
             except Exception as exc:  # noqa: BLE001 - partial failure semantics
                 _warn_split_failure("search", split.split_id, exc)
                 collector.failed_splits.append(SplitSearchError(

@@ -20,6 +20,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from typing import Any, Callable, Iterable, Optional
 
 from .http2 import (
@@ -390,6 +391,7 @@ class GrpcChannel:
 
     def __init__(self, host: str, port: int, timeout: float = 15.0,
                  ssl_context=None):
+        self._timeout = timeout
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._scheme = "http"
         if ssl_context is not None:
@@ -416,10 +418,21 @@ class GrpcChannel:
         return read_exact_from(self._sock, n)
 
     def call(self, path: str, message: bytes,
-             extra_headers: "tuple[tuple[str, str], ...]" = ()
+             extra_headers: "tuple[tuple[str, str], ...]" = (),
+             timeout_secs: Optional[float] = None
              ) -> tuple[list[bytes], int, str]:
-        """(response messages, grpc-status, grpc-message)."""
+        """(response messages, grpc-status, grpc-message).
+
+        `timeout_secs` clamps THIS call to the caller's remaining deadline
+        budget (never above the channel default): the budget covers the
+        whole stream, so the socket timeout is re-armed with the remaining
+        time before every frame read — N slow frames cannot each burn a
+        full per-frame timeout. The shared socket's default timeout is
+        restored afterwards (calls are serialized by the channel lock)."""
+        budget = self._timeout if timeout_secs is None \
+            else min(self._timeout, max(timeout_secs, 0.001))
         with self._lock:
+            deadline = time.monotonic() + budget
             stream_id = self._stream_id
             self._stream_id += 2
             headers = [(":method", "POST"), (":scheme", self._scheme),
@@ -430,39 +443,16 @@ class GrpcChannel:
                         hpack_encode_raw(headers))
             out += frame(FRAME_DATA, FLAG_END_STREAM, stream_id,
                          _grpc_frame(message))
-            self._sock.sendall(out)
-            data = bytearray()
-            status, status_message = -1, ""
-            while True:
-                frame_type, flags, fid, payload = read_frame(self._read_exact)
-                if frame_type == FRAME_SETTINGS:
-                    if not flags & FLAG_ACK:
-                        self._sock.sendall(
-                            frame(FRAME_SETTINGS, FLAG_ACK, 0, b""))
-                    continue
-                if frame_type == FRAME_PING and not flags & FLAG_ACK:
-                    self._sock.sendall(
-                        frame(FRAME_PING, FLAG_ACK, 0, payload))
-                    continue
-                if frame_type == FRAME_WINDOW_UPDATE or fid != stream_id:
-                    continue
-                if frame_type == FRAME_HEADERS:
-                    for name, value in self._decoder.decode(payload):
-                        if name == "grpc-status":
-                            status = int(value)
-                        elif name == "grpc-message":
-                            status_message = value
-                elif frame_type == FRAME_DATA:
-                    data += payload
-                    if payload:
-                        import struct as _struct
-                        increment = _struct.pack(">I", len(payload))
-                        self._sock.sendall(
-                            frame(FRAME_WINDOW_UPDATE, 0, 0, increment)
-                            + frame(FRAME_WINDOW_UPDATE, 0, stream_id,
-                                    increment))
-                if flags & FLAG_END_STREAM:
-                    break
+            try:
+                self._sock.settimeout(min(budget, self._timeout))
+                self._sock.sendall(out)
+                data, status, status_message = self._read_stream(
+                    stream_id, deadline, path)
+            finally:
+                try:
+                    self._sock.settimeout(self._timeout)
+                except OSError:
+                    pass  # socket already dead; the caller sees the error
             messages = []
             pos = 0
             while pos + 5 <= len(data):
@@ -470,6 +460,46 @@ class GrpcChannel:
                 messages.append(bytes(data[pos + 5: pos + 5 + length]))
                 pos += 5 + length
             return messages, status, status_message
+
+    def _read_stream(self, stream_id: int, deadline: float, path: str
+                     ) -> tuple[bytearray, int, str]:
+        data = bytearray()
+        status, status_message = -1, ""
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise socket.timeout(
+                    f"grpc call {path} exceeded its deadline budget")
+            self._sock.settimeout(remaining)
+            frame_type, flags, fid, payload = read_frame(self._read_exact)
+            if frame_type == FRAME_SETTINGS:
+                if not flags & FLAG_ACK:
+                    self._sock.sendall(
+                        frame(FRAME_SETTINGS, FLAG_ACK, 0, b""))
+                continue
+            if frame_type == FRAME_PING and not flags & FLAG_ACK:
+                self._sock.sendall(
+                    frame(FRAME_PING, FLAG_ACK, 0, payload))
+                continue
+            if frame_type == FRAME_WINDOW_UPDATE or fid != stream_id:
+                continue
+            if frame_type == FRAME_HEADERS:
+                for name, value in self._decoder.decode(payload):
+                    if name == "grpc-status":
+                        status = int(value)
+                    elif name == "grpc-message":
+                        status_message = value
+            elif frame_type == FRAME_DATA:
+                data += payload
+                if payload:
+                    import struct as _struct
+                    increment = _struct.pack(">I", len(payload))
+                    self._sock.sendall(
+                        frame(FRAME_WINDOW_UPDATE, 0, 0, increment)
+                        + frame(FRAME_WINDOW_UPDATE, 0, stream_id,
+                                increment))
+            if flags & FLAG_END_STREAM:
+                return data, status, status_message
 
 
 class GrpcSearchClient:
@@ -505,7 +535,8 @@ class GrpcSearchClient:
                 self._channel.close()
                 self._channel = None
 
-    def _call(self, path: str, payload: bytes) -> bytes:
+    def _call(self, path: str, payload: bytes,
+              timeout_secs: Optional[float] = None) -> bytes:
         from .http_client import HttpStatusError, HttpTransportError
 
         def once() -> bytes:
@@ -522,7 +553,8 @@ class GrpcSearchClient:
             extra = (("traceparent", traceparent),) if traceparent else ()
             try:
                 messages, status, message = channel.call(
-                    path, payload, extra_headers=extra)
+                    path, payload, extra_headers=extra,
+                    timeout_secs=timeout_secs)
             except (OSError, Http2Error) as exc:
                 # connection-level failure: drop the channel so the next
                 # call reconnects; counts toward the breaker
@@ -544,8 +576,16 @@ class GrpcSearchClient:
     def leaf_search(self, request):
         from .binwire import decode, encode
         from .serializers import leaf_response_from_wire
+        # clamp the call to the query's remaining deadline budget (plus
+        # grace for trailers), mirroring HttpSearchClient.leaf_search —
+        # a 2s-deadline query must not hold the shared channel for the
+        # full 30s default
+        timeout_secs = None
+        if getattr(request, "deadline_millis", None) is not None:
+            timeout_secs = request.deadline_millis / 1000.0 + 0.5
         raw = self._call("/quickwit.search.SearchService/LeafSearch",
-                         encode(request.to_dict()))
+                         encode(request.to_dict()),
+                         timeout_secs=timeout_secs)
         return leaf_response_from_wire(decode(raw))
 
     def fetch_docs(self, request):

@@ -101,6 +101,10 @@ class NodeConfig:
     # standalone compactor role: bounded concurrent merge executions
     # (reference compactor_supervisor.rs slots)
     max_concurrent_merges: int = 2
+    # multi-tenant workload isolation (tenancy/): per-tenant classes,
+    # weights, rate limits and the overload controller. None/absent =
+    # tenancy disabled, the tenant-blind neutral path.
+    tenancy: Optional[dict] = None
 
     @property
     def tls_enabled(self) -> bool:
@@ -396,6 +400,12 @@ class Node:
         from ..utils.compile_cache import enable_persistent_compile_cache
         enable_persistent_compile_cache()
         self.config = config
+        if config.tenancy is not None:
+            # arm the process-global registry from the node config's
+            # `tenancy` section (absent config leaves whatever state the
+            # registry already has — embedded/test nodes stay neutral)
+            from ..tenancy import configure_tenancy
+            configure_tenancy(config.tenancy)
         self.storage_resolver = storage_resolver or StorageResolver.default()
         if config.metastore_uri.startswith("sqlite://"):
             # SQL backend (reference: PostgresqlMetastore): transactional

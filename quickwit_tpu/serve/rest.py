@@ -51,6 +51,10 @@ from ..search.models import (
     normalize_sort_fields,
 )
 from ..search.plan import PlanError
+from ..tenancy import (
+    ES_FALLBACK_HEADER, GLOBAL_TENANCY, OverloadShed, TENANT_HEADER,
+    TenantRateLimited, tenant_scope,
+)
 from .node import Node
 from .serializers import leaf_response_from_dict, leaf_response_to_dict
 
@@ -66,9 +70,15 @@ _REQUEST_LATENCY = METRICS.histogram("qw_http_request_duration_seconds",
 
 
 class ApiError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 headers: Optional[dict[str, str]] = None,
+                 payload: Any = None):
         super().__init__(message)
         self.status = status
+        # extra response headers (e.g. Retry-After on 429) and an optional
+        # structured body overriding the default {"message": ...}
+        self.headers = headers or {}
+        self.payload = payload
 
 
 _PARSE_ERRORS = (QueryParseError, EsDslParseError, AggParseError,
@@ -84,11 +94,27 @@ def classify_exception(exc: BaseException) -> Optional[int]:
     actual response code. None = unhandled (500 + traceback log)."""
     if isinstance(exc, ApiError):
         return exc.status
+    if isinstance(exc, (TenantRateLimited, OverloadShed)):
+        return 429
     if isinstance(exc, _PARSE_ERRORS):
         return 400
     if isinstance(exc, MetastoreError):
         return _METASTORE_STATUS.get(exc.kind, 500)
     return None
+
+
+def _throttle_error(exc: Exception) -> ApiError:
+    """TenantRateLimited / OverloadShed → 429 with a Retry-After header
+    and an ES-compatible error body (clients with ES retry middleware
+    back off without custom handling)."""
+    import math
+    retry_after = max(1, math.ceil(getattr(exc, "retry_after_secs", 1.0)))
+    kind = ("rate_limit_exceeded" if isinstance(exc, TenantRateLimited)
+            else "overloaded")
+    return ApiError(
+        429, str(exc), headers={"Retry-After": str(retry_after)},
+        payload={"status": 429,
+                 "error": {"type": kind, "reason": str(exc)}})
 
 
 def _search_request_from_params(index_id: str, params: dict[str, Any],
@@ -212,19 +238,32 @@ class RestServer:
     def route(self, method: str, path: str, params: dict[str, Any],
               body: bytes, client_host: str = "",
               content_type: str = "",
-              traceparent: str = "") -> tuple[int, Any]:
+              traceparent: str = "",
+              tenant_id: str = "") -> tuple[int, Any]:
         """Traced entry point: every request is a server span, joined to
         the caller's trace when a W3C `traceparent` header came in
-        (reference: tracing_utils.rs context extraction)."""
+        (reference: tracing_utils.rs context extraction). The resolved
+        tenant (from the `x-qw-tenant` header, `x-opaque-id` fallback, or
+        the configured default) is bound ambiently for the whole request;
+        with tenancy disabled and no header it resolves to None and the
+        stack stays tenant-blind."""
         from ..observability.tracing import TRACER
         with TRACER.span("http.request",
                          {"http.method": method, "http.target": path},
                          remote_parent=traceparent,
                          scope=self.node.config.node_id) as span:
             try:
-                status, payload = self._route_inner(
-                    method, path, params, body, client_host=client_host,
-                    content_type=content_type)
+                tenant = GLOBAL_TENANCY.resolve(tenant_id or None)
+                if tenant is not None:
+                    span.set_attribute("tenant.id", tenant.tenant_id)
+                try:
+                    with tenant_scope(tenant):
+                        status, payload = self._route_inner(
+                            method, path, params, body,
+                            client_host=client_host,
+                            content_type=content_type)
+                except (TenantRateLimited, OverloadShed) as exc:
+                    raise _throttle_error(exc)
             except Exception as exc:
                 # handled client/server error: classify before the span
                 # closes so routine 4xx don't pollute error-rate queries
@@ -365,6 +404,9 @@ class RestServer:
                              title=f"{node.config.node_id} CPU profile "
                                    f"({duration:g}s @ {hz:g}Hz)")
             return 200, ("__raw__", svg.encode(), "image/svg+xml")
+        if path == "/api/v1/developer/tenants" and method == "GET":
+            # per-tenant config + live usage counters + overload state
+            return 200, GLOBAL_TENANCY.report()
         if path == "/api/v1/developer/slowlog":
             # ring buffer of slow/shed/timed-out query profiles (role of the
             # reference's slow-query log). GET returns the buffer; POST with
@@ -1383,6 +1425,7 @@ def _make_handler(server: RestServer):
             params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
+            extra_headers: dict[str, str] = {}
             try:
                 if body and "gzip" in (self.headers.get("Content-Encoding")
                                        or ""):
@@ -1402,7 +1445,10 @@ def _make_handler(server: RestServer):
                     method, parsed.path, params, body,
                     client_host=self.client_address[0],
                     content_type=self.headers.get("Content-Type", ""),
-                    traceparent=self.headers.get("traceparent", ""))
+                    traceparent=self.headers.get("traceparent", ""),
+                    tenant_id=(self.headers.get(TENANT_HEADER)
+                               or self.headers.get(ES_FALLBACK_HEADER)
+                               or ""))
             except Exception as exc:  # noqa: BLE001
                 code = classify_exception(exc)
                 if code is None:
@@ -1411,7 +1457,13 @@ def _make_handler(server: RestServer):
                     status = 500
                     payload = {"message": f"internal error: {exc}"}
                 else:
-                    status, payload = code, {"message": str(exc)}
+                    status = code
+                    if isinstance(exc, ApiError) and exc.payload is not None:
+                        payload = exc.payload
+                    else:
+                        payload = {"message": str(exc)}
+                    if isinstance(exc, ApiError):
+                        extra_headers = exc.headers
             if (isinstance(payload, tuple) and len(payload) == 3
                     and payload[0] == "__raw__"):
                 data = payload[1]
@@ -1429,6 +1481,8 @@ def _make_handler(server: RestServer):
             self.send_response(status)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(data)))
+            for name, value in extra_headers.items():
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(data)
             _REQUEST_COUNTER.inc(method=method, status=str(status))

@@ -1,0 +1,152 @@
+"""Chaos tests for the indexing/merge write path: injected faults at the
+stage/upload/publish boundaries must leave the metastore in a state a
+plain retry repairs — exactly-once publication (checkpoint dedupe) and
+rows-conserved merging survive the crash schedule, and every injected
+fault is audited in `qw_faults_injected_total`."""
+
+import pytest
+
+from quickwit_tpu.common.faults import FaultInjector, FaultRule, InjectedFault
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.indexing import (
+    IndexingPipeline, MergeExecutor, PipelineParams, VecSource,
+)
+from quickwit_tpu.indexing.merge import MergeOperation
+from quickwit_tpu.metastore import FileBackedMetastore, ListSplitsQuery
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.models.index_metadata import (
+    IndexConfig, IndexMetadata, SourceConfig,
+)
+from quickwit_tpu.models.split_metadata import SplitState
+from quickwit_tpu.observability.metrics import FAULTS_INJECTED_TOTAL
+from quickwit_tpu.storage import RamStorage
+
+MAPPER = DocMapper(
+    field_mappings=[
+        FieldMapping("ts", FieldType.DATETIME, fast=True,
+                     input_formats=("unix_timestamp",)),
+        FieldMapping("body", FieldType.TEXT),
+    ],
+    timestamp_field="ts",
+    default_search_fields=("body",),
+)
+
+
+def make_docs(n):
+    return [{"ts": 1000 + i, "body": f"event {i}"} for i in range(n)]
+
+
+@pytest.fixture
+def env():
+    storage = RamStorage(Uri.parse("ram:///chaos-idx"))
+    split_storage = RamStorage(Uri.parse("ram:///chaos-idx-splits"))
+    metastore = FileBackedMetastore(storage)
+    config = IndexConfig(index_id="logs", index_uri="ram:///chaos-idx-splits",
+                         doc_mapper=MAPPER)
+    metastore.create_index(IndexMetadata(
+        index_uid="logs:01", index_config=config,
+        sources={"src": SourceConfig("src", "vec")}))
+    return metastore, split_storage
+
+
+def make_pipeline(metastore, split_storage, docs, injector=None, target=100):
+    params = PipelineParams(index_uid="logs:01", source_id="src",
+                            split_num_docs_target=target, batch_num_docs=100)
+    return IndexingPipeline(params, MAPPER, VecSource(docs), metastore,
+                            split_storage, fault_injector=injector)
+
+
+def published(metastore):
+    return metastore.list_splits(ListSplitsQuery(
+        index_uids=["logs:01"], states=[SplitState.PUBLISHED]))
+
+
+def test_publish_fault_rolls_back_and_replay_is_exactly_once(env):
+    """An error fault at the publish boundary leaves NOTHING published and
+    the checkpoint unadvanced (splits are staged-only, GC fodder); the
+    supervisor's crash-replay then publishes every doc exactly once."""
+    metastore, split_storage = env
+    injector = FaultInjector(seed=7, rules=[
+        FaultRule(operation="indexing.publish", kind="error", max_fires=1)])
+    before = FAULTS_INJECTED_TOTAL.get(op="indexing.publish", kind="error")
+    docs = make_docs(300)
+    pipeline = make_pipeline(metastore, split_storage, docs, injector)
+    with pytest.raises(InjectedFault):
+        pipeline.run_to_completion()
+    # rollback contract: no published split, checkpoint unadvanced
+    assert published(metastore) == []
+    assert FAULTS_INJECTED_TOTAL.get(
+        op="indexing.publish", kind="error") == before + 1
+    # crash-replay from the durable checkpoint: everything lands exactly once
+    retry = make_pipeline(metastore, split_storage, docs)
+    counters = retry.run_to_completion()
+    assert counters.num_docs_processed == 300  # nothing was checkpointed
+    assert sum(s.metadata.num_docs for s in published(metastore)) == 300
+
+
+def test_stage_and_upload_faults_leave_no_published_splits(env):
+    """Faults earlier in the commit (stage, upload) roll back the same way:
+    a crash before publish never surfaces a split to search."""
+    metastore, split_storage = env
+    for op in ("indexing.stage", "indexing.upload"):
+        injector = FaultInjector(seed=3, rules=[
+            FaultRule(operation=op, kind="error", max_fires=1)])
+        pipeline = make_pipeline(metastore, split_storage, make_docs(50),
+                                 injector)
+        with pytest.raises(InjectedFault):
+            pipeline.run_to_completion()
+        assert published(metastore) == []
+    # both schedules were audited
+    assert FAULTS_INJECTED_TOTAL.get(op="indexing.stage", kind="error") >= 1
+    assert FAULTS_INJECTED_TOTAL.get(op="indexing.upload", kind="error") >= 1
+
+
+def test_merge_publish_fault_keeps_inputs_and_retry_conserves_rows(env):
+    """A fault right before the merge's atomic replace must leave every
+    input split PUBLISHED (no_split_loss); the retry merges the same
+    inputs and conserves rows exactly (rows_conserved)."""
+    metastore, split_storage = env
+    pipeline = make_pipeline(metastore, split_storage, make_docs(300))
+    pipeline.run_to_completion()
+    inputs = published(metastore)
+    assert len(inputs) == 3
+    injector = FaultInjector(seed=11, rules=[
+        FaultRule(operation="merge.publish", kind="error", max_fires=1)])
+    before = FAULTS_INJECTED_TOTAL.get(op="merge.publish", kind="error")
+    executor = MergeExecutor("logs:01", MAPPER, metastore, split_storage,
+                             fault_injector=injector)
+    with pytest.raises(InjectedFault):
+        executor.execute(MergeOperation(tuple(inputs)))
+    # the replace is all-or-nothing: inputs untouched, merged split unseen
+    after_fault = published(metastore)
+    assert {s.metadata.split_id for s in after_fault} \
+        == {s.metadata.split_id for s in inputs}
+    assert FAULTS_INJECTED_TOTAL.get(
+        op="merge.publish", kind="error") == before + 1
+    # retry (rule exhausted): one merged split, rows conserved
+    merged_id = executor.execute(MergeOperation(tuple(inputs)))
+    final = published(metastore)
+    assert [s.metadata.split_id for s in final] == [merged_id]
+    assert final[0].metadata.num_docs == 300
+
+
+def test_merge_execute_fault_fires_before_any_mutation(env):
+    """An error at merge.execute (read/merge phase) is a pure no-op on the
+    metastore: inputs stay published, nothing is staged."""
+    metastore, split_storage = env
+    pipeline = make_pipeline(metastore, split_storage, make_docs(200))
+    pipeline.run_to_completion()
+    inputs = published(metastore)
+    injector = FaultInjector(seed=5, rules=[
+        FaultRule(operation="merge.execute", kind="error", max_fires=1)])
+    executor = MergeExecutor("logs:01", MAPPER, metastore, split_storage,
+                             fault_injector=injector)
+    with pytest.raises(InjectedFault):
+        executor.execute(MergeOperation(tuple(inputs)))
+    staged = metastore.list_splits(ListSplitsQuery(
+        index_uids=["logs:01"], states=[SplitState.STAGED]))
+    assert staged == []
+    assert {s.metadata.split_id for s in published(metastore)} \
+        == {s.metadata.split_id for s in inputs}
+    # deterministic schedule: same seed + call sequence -> same decisions
+    assert injector.schedule() == {"merge.execute": [(1, 0, "error")]}

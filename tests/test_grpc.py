@@ -238,6 +238,65 @@ def test_large_streamed_response_respects_flow_control():
         server.stop()
 
 
+def test_grpc_call_timeout_clamped_to_deadline_budget():
+    """A per-call `timeout_secs` below the channel default bounds the
+    WHOLE stream: a leaf stalling past the query's remaining budget
+    frees the shared channel in ~budget seconds, not the 30s default,
+    and the socket's default timeout is restored afterwards."""
+    import time as _time
+
+    from quickwit_tpu.serve.http2 import Http2Server
+    from quickwit_tpu.serve.grpc_server import _grpc_frame
+
+    def handler(headers, body):
+        _time.sleep(1.5)  # stall well past the call budget
+        return ([(":status", "200"),
+                 ("content-type", "application/grpc")],
+                [_grpc_frame(b"ok")], [("grpc-status", "0")])
+
+    server = Http2Server(handler)
+    channel = GrpcChannel(server.host, server.port, timeout=30.0)
+    try:
+        start = _time.monotonic()
+        with pytest.raises(OSError):
+            channel.call("/x/Y", b"req", timeout_secs=0.3)
+        assert _time.monotonic() - start < 1.2
+        assert channel._sock.gettimeout() == 30.0  # default restored
+    finally:
+        channel.close()
+        server.stop()
+
+
+def test_grpc_leaf_search_clamps_timeout_to_remaining_deadline():
+    """GrpcSearchClient.leaf_search mirrors HttpSearchClient: the wire
+    deadline_millis (remaining budget at dispatch) plus trailer grace
+    becomes the per-call timeout; no deadline means channel default."""
+    from quickwit_tpu.query import parse_query_string
+    from quickwit_tpu.search.models import LeafSearchRequest, SearchRequest
+    from quickwit_tpu.serve.grpc_server import GrpcSearchClient
+
+    client = GrpcSearchClient("127.0.0.1:1", "http://127.0.0.1:1")
+    seen = []
+
+    def fake_call(path, payload, timeout_secs=None):
+        seen.append(timeout_secs)
+        raise RuntimeError("stop before decode")
+
+    client._call = fake_call
+    request = LeafSearchRequest(
+        search_request=SearchRequest(
+            index_ids=["idx"],
+            query_ast=parse_query_string("x", ["body"])),
+        index_uid="idx:0000", doc_mapping={}, splits=[],
+        deadline_millis=2000)
+    with pytest.raises(RuntimeError):
+        client.leaf_search(request)
+    request.deadline_millis = None
+    with pytest.raises(RuntimeError):
+        client.leaf_search(request)
+    assert seen == [2.5, None]
+
+
 def test_grpc_port_loads_from_config(tmp_path):
     from quickwit_tpu.config.node_config import load_node_config
     path = tmp_path / "node.yaml"

@@ -108,6 +108,40 @@ def test_histogram_buckets_cumulative_and_consistent():
     assert total == pytest.approx(sum(observed))
 
 
+def test_tenant_metrics_expose_with_bounded_labels():
+    """Per-tenant metrics carry client-controlled ids as label values: the
+    laundered labels (raw short ids, hashed long ids, the `_other`
+    overflow bucket) must all survive the strict exposition grammar, and
+    the admission-wait histogram must stay internally consistent."""
+    from quickwit_tpu.tenancy.registry import (
+        MAX_TENANT_LABELS, OVERFLOW_LABEL, TenancyRegistry,
+    )
+    registry = TenancyRegistry({"enabled": True})
+    registry.note_admission_wait("acme", 0.05)
+    registry.note_staged_bytes("acme", 1 << 20)
+    registry.note_shed("acme", stage="admission")
+    registry.note_rejected("acme", limit="qps")
+    registry.note_execute_seconds("acme", 0.3)
+    registry.note_query('we"ird\\ten\nant', status="ok")  # escaping probe
+    registry.note_query("x" * 200, status="ok")           # hashed long id
+    for i in range(MAX_TENANT_LABELS + 5):                # overflow bucket
+        registry.note_query(f"cardinality-{i}", status="ok")
+    parsed = parse_exposition(METRICS.expose_text())
+    queries = parsed["qw_tenant_queries_total"]
+    labels_seen = {dict(key)["tenant"] for key in queries}
+    assert OVERFLOW_LABEL in labels_seen  # cardinality stays bounded
+    assert any(label.startswith("t-") for label in labels_seen)
+    assert all(len(label) <= 32 for label in labels_seen)
+    wait_count = parsed["qw_tenant_admission_wait_seconds_count"]
+    acme = tuple(sorted({"tenant": "acme"}.items()))
+    assert wait_count[acme] >= 1
+    for name in ("qw_tenant_staged_bytes_total", "qw_tenant_shed_total",
+                 "qw_tenant_rejected_total",
+                 "qw_tenant_execute_seconds_total"):
+        assert any(dict(key).get("tenant") == "acme"
+                   for key in parsed[name]), name
+
+
 def test_full_registry_exposition_parses():
     """The real global registry — after driving a few metrics through the
     awkward cases (labels, floats, multiple label sets) — must emit text

@@ -1,0 +1,469 @@
+"""Multi-tenant workload isolation: tenant context propagation, registry
+resolution/quotas, bounded-cardinality metric labels, the overload shed
+ladder, DRR fairness properties, and the noisy-neighbor storm on the real
+HBM admission queue."""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from quickwit_tpu.search.admission import HbmBudget
+from quickwit_tpu.tenancy.context import (
+    DEFAULT_CLASS, DEFAULT_TENANT, MAX_PRIORITY, TenantContext, bind_tenant,
+    current_tenant, effective_tenant, tenant_scope,
+)
+from quickwit_tpu.tenancy.drr import DrrScheduler
+from quickwit_tpu.tenancy.overload import OverloadController
+from quickwit_tpu.tenancy.registry import (
+    MAX_TENANT_LABELS, OVERFLOW_LABEL, TenancyRegistry, TenantRateLimited,
+)
+
+
+# --- context & propagation -------------------------------------------------
+
+def test_tenant_scope_binds_and_restores():
+    assert current_tenant() is None
+    assert effective_tenant() is DEFAULT_TENANT
+    acme = TenantContext.for_class("acme", "interactive")
+    with tenant_scope(acme):
+        assert current_tenant() is acme
+        assert effective_tenant() is acme
+        with tenant_scope(None):
+            assert current_tenant() is None
+        assert current_tenant() is acme
+    assert current_tenant() is None
+
+
+def test_bind_tenant_crosses_thread_pool_hops():
+    """contextvars do not flow into pool workers; bind_tenant re-binds the
+    captured tenant exactly like bind_deadline/bind_profile."""
+    acme = TenantContext.for_class("acme")
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        with tenant_scope(acme):
+            bound = bind_tenant(effective_tenant)
+        assert pool.submit(effective_tenant).result() is DEFAULT_TENANT
+        assert pool.submit(bound).result() is acme
+
+
+def test_for_class_unknown_degrades_to_default():
+    tenant = TenantContext.for_class("x", "platinum-turbo")
+    assert tenant.priority_class == DEFAULT_CLASS
+    # explicit weight override beats the class weight
+    heavy = TenantContext.for_class("y", "background", weight=9.0)
+    assert heavy.weight == 9.0 and heavy.priority == 0
+
+
+def test_wire_round_trip():
+    tenant = TenantContext.for_class("acme", "interactive")
+    assert TenantContext.from_wire(tenant.to_wire()) == tenant
+    assert TenantContext.from_wire(None) is None
+    assert TenantContext.from_wire({"class": "interactive"}) is None
+    assert TenantContext.from_wire("acme") is None
+    # unknown class on the wire degrades, not fails
+    degraded = TenantContext.from_wire({"id": "z", "class": "nope"})
+    assert degraded.priority_class == DEFAULT_CLASS
+
+
+# --- registry: resolution neutrality ---------------------------------------
+
+def test_resolve_is_neutral_when_disabled():
+    registry = TenancyRegistry()
+    assert registry.resolve(None) is None
+    assert registry.resolve("") is None
+    # an explicit id is always honored, even with tenancy disabled
+    tenant = registry.resolve("acme")
+    assert tenant.tenant_id == "acme"
+    assert tenant.priority_class == DEFAULT_CLASS
+
+
+def test_resolve_enabled_uses_config():
+    registry = TenancyRegistry({
+        "enabled": True,
+        "default_tenant": "shared",
+        "default_class": "background",
+        "tenants": {"acme": {"class": "interactive", "weight": 8.0}},
+    })
+    implicit = registry.resolve(None)
+    assert implicit.tenant_id == "shared"
+    assert implicit.priority_class == "background"
+    acme = registry.resolve("acme")
+    assert acme.priority_class == "interactive" and acme.weight == 8.0
+    # client-controlled ids are bounded
+    assert len(registry.resolve("x" * 500).tenant_id) == 128
+
+
+# --- registry: token buckets -----------------------------------------------
+
+def test_qps_limit_rejects_with_retry_after():
+    registry = TenancyRegistry({
+        "enabled": True,
+        "tenants": {"acme": {"qps_limit": 2}},
+    })
+    acme = registry.resolve("acme")
+    registry.check_query_rate(acme)
+    registry.check_query_rate(acme)
+    with pytest.raises(TenantRateLimited) as excinfo:
+        registry.check_query_rate(acme)
+    assert excinfo.value.limit == "qps"
+    assert 0.0 < excinfo.value.retry_after_secs <= 1.0
+    # unlimited tenants never hit the bucket
+    other = registry.resolve("other")
+    for _ in range(50):
+        registry.check_query_rate(other)
+
+
+def test_staged_bytes_oversized_query_drains_not_starves():
+    """A query bigger than one second's allowance costs the whole burst
+    instead of being permanently unadmittable — the byte ceiling belongs
+    to the HBM budget, this bucket only paces the rate."""
+    registry = TenancyRegistry({
+        "enabled": True,
+        "default_limits": {"staged_bytes_per_sec_limit": 1000},
+    })
+    tenant = registry.resolve("big")
+    registry.charge_staged_bytes(tenant, 50_000)  # >> burst, still admitted
+    with pytest.raises(TenantRateLimited) as excinfo:
+        registry.charge_staged_bytes(tenant, 1)
+    assert excinfo.value.limit == "staged_bytes"
+    assert excinfo.value.retry_after_secs > 0.0
+    # rejections are accounted per tenant
+    assert registry.report()["tenants"]["big"]["counters"]["rejected"] == 1
+
+
+# --- registry: bounded label cardinality -----------------------------------
+
+def test_metric_labels_hash_long_ids_and_cap_cardinality():
+    registry = TenancyRegistry({"enabled": True,
+                                "tenants": {"configured": {}}})
+    assert registry.metric_label("short") == "short"
+    long_id = "x" * 100
+    hashed = registry.metric_label(long_id)
+    assert hashed.startswith("t-") and len(hashed) <= 32
+    assert registry.metric_label(long_id) == hashed  # stable
+    for i in range(MAX_TENANT_LABELS + 20):
+        registry.metric_label(f"tenant-{i}")
+    assert registry.metric_label("one-too-many") == OVERFLOW_LABEL
+    # configured tenants always keep their own label, even past the cap
+    assert registry.metric_label("configured") == "configured"
+
+
+# --- overload controller ---------------------------------------------------
+
+def test_overload_disabled_is_constant_false():
+    controller = OverloadController(target_wait_secs=0.01, enabled=False)
+    for _ in range(100):
+        controller.note_wait(10.0)
+    assert controller.severity() == 0.0
+    assert not controller.should_shed(0)
+
+
+def test_overload_shed_ladder_sheds_lowest_first():
+    controller = OverloadController(target_wait_secs=0.1, enabled=True)
+    # calm: nothing shed
+    controller.note_wait(0.01)
+    assert controller.shed_floor() == 0
+    # waits breach the target: bottom class shed first
+    for _ in range(50):
+        controller.note_wait(0.15)
+    assert controller.severity() > 1.0
+    assert controller.shed_floor() == 1
+    assert controller.should_shed(0)
+    assert not controller.should_shed(1)
+    # waits keep climbing: standard shed too, top class NEVER shed
+    for _ in range(50):
+        controller.note_wait(1.0)
+    assert controller.shed_floor() == MAX_PRIORITY
+    assert controller.should_shed(1)
+    assert not controller.should_shed(MAX_PRIORITY)
+    assert controller.retry_after_secs() >= controller.target_wait_secs
+    # recovery: zero waits pull the EWMA back down
+    for _ in range(100):
+        controller.note_wait(0.0)
+    assert controller.shed_floor() == 0
+
+
+# --- DRR scheduler properties ----------------------------------------------
+
+def _drain(scheduler, n):
+    order = []
+    for _ in range(n):
+        ticket = scheduler.head()
+        if ticket is None:
+            break
+        order.append(ticket)
+        scheduler.remove(ticket, served=True)
+    return order
+
+
+def test_drr_single_tenant_is_exact_fifo():
+    """The tenancy-disabled neutrality argument: one tenant, one ring
+    entry, grants in strict enqueue order regardless of costs."""
+    scheduler = DrrScheduler(quantum_bytes=8)
+    tickets = [scheduler.enqueue("default", 1.0, cost)
+               for cost in (5, 100, 1, 7, 300, 2)]
+    assert _drain(scheduler, 10) == tickets
+
+
+def test_drr_weighted_fair_shares():
+    """Property: over a contended window, grants converge to the weight
+    ratio (1:2:4 here), while each tenant's own order stays FIFO."""
+    scheduler = DrrScheduler(quantum_bytes=2)
+    mine = {"a": [], "b": [], "c": []}
+    for i in range(100):
+        mine["a"].append(scheduler.enqueue("a", 1.0, 1))
+        mine["b"].append(scheduler.enqueue("b", 2.0, 1))
+        mine["c"].append(scheduler.enqueue("c", 4.0, 1))
+    order = _drain(scheduler, 70)  # all queues still non-empty throughout
+    counts = {t: sum(1 for ticket in order if ticket.tenant_id == t)
+              for t in ("a", "b", "c")}
+    assert counts["a"] > 0
+    assert 1.5 <= counts["b"] / counts["a"] <= 2.5
+    assert 3.0 <= counts["c"] / counts["a"] <= 5.0
+    for tenant, tickets in mine.items():
+        served = [t for t in order if t.tenant_id == tenant]
+        assert served == tickets[:len(served)]  # FIFO within tenant
+
+
+def test_drr_large_ticket_not_starved_by_small_stream():
+    """Anti-starvation: the waiting tenant's deficit grows every ring
+    revolution, so a ticket 10 quanta large is granted while the other
+    tenant still has a deep queue."""
+    scheduler = DrrScheduler(quantum_bytes=2)
+    big = scheduler.enqueue("whale", 1.0, 20)
+    for _ in range(500):
+        scheduler.enqueue("stream", 1.0, 1)
+    order = _drain(scheduler, 60)
+    assert big in order  # granted long before the stream drains
+
+
+def test_drr_timeout_removal_frees_the_ring():
+    scheduler = DrrScheduler(quantum_bytes=2)
+    a = scheduler.enqueue("a", 1.0, 1000)  # will never be granted cheaply
+    b = scheduler.enqueue("b", 1.0, 1)
+    scheduler.remove(a, served=False)  # timed out / shed: no deficit charge
+    assert _drain(scheduler, 5) == [b]
+    assert len(scheduler) == 0
+    assert scheduler.waiting_by_tenant() == {}
+
+
+# --- noisy-neighbor storm on the real admission queue ----------------------
+
+def _percentile(values, q):
+    ordered = sorted(values)
+    return ordered[min(len(ordered) - 1, int(q * (len(ordered) - 1)))]
+
+
+def _run_victim(budget, cost, n, waits):
+    tenant = TenantContext.for_class("victim", "interactive")
+    owner = object()
+    for _ in range(n):
+        with tenant_scope(tenant):
+            start = time.monotonic()
+            budget.admit(owner, cost, timeout_secs=30.0)
+        waits.append(time.monotonic() - start)
+        time.sleep(0.002)  # hold the slot: simulated execute
+        budget.release(owner, cost, to_resident=False)
+
+
+def test_noisy_neighbor_isolation_under_admission_storm():
+    """Tenant 'flood' (background, weight 1) saturates HBM admission from
+    several threads while tenant 'victim' (interactive, weight 4) runs a
+    steady trickle. Isolation holds when (a) the victim completes every
+    query, (b) its p99 admission wait stays bounded by a small multiple
+    of the slot hold time, and (c) its mean wait undercuts the flood's —
+    the DRR weight actually buys schedule share under contention."""
+    cost = 1_000
+    budget = HbmBudget(budget_bytes=cost)  # one admission slot: max contention
+
+    # baseline: the victim alone
+    alone_waits = []
+    _run_victim(budget, cost, 10, alone_waits)
+
+    storm_waits = []
+    flood_waits = []
+    stop = threading.Event()
+
+    def flood():
+        tenant = TenantContext.for_class("flood", "background")
+        owner = object()
+        while not stop.is_set():
+            with tenant_scope(tenant):
+                start = time.monotonic()
+                try:
+                    budget.admit(owner, cost, timeout_secs=5.0)
+                except TimeoutError:
+                    continue
+            flood_waits.append(time.monotonic() - start)
+            time.sleep(0.002)
+            budget.release(owner, cost, to_resident=False)
+
+    flooders = [threading.Thread(target=flood, daemon=True)
+                for _ in range(6)]
+    for thread in flooders:
+        thread.start()
+    try:
+        _run_victim(budget, cost, 30, storm_waits)
+    finally:
+        stop.set()
+        for thread in flooders:
+            thread.join(timeout=10)
+
+    assert len(storm_waits) == 30  # 100% completion under the storm
+    p99_alone = _percentile(alone_waits, 0.99)
+    p99_storm = _percentile(storm_waits, 0.99)
+    # bounded degradation: a handful of hold periods, not the whole flood
+    # queue convoy (6 flooders re-queueing would convoy FIFO waits without
+    # the weighted scheduler)
+    assert p99_storm < 0.5, (p99_alone, p99_storm)
+    assert flood_waits, "flood never got admitted (starvation)"
+    mean_victim = sum(storm_waits) / len(storm_waits)
+    mean_flood = sum(flood_waits) / len(flood_waits)
+    assert mean_victim <= mean_flood * 1.5, (mean_victim, mean_flood)
+    assert budget.stats()["waiting_by_tenant"] == {}  # queue fully drained
+
+
+# --- REST surface: 429 + Retry-After + developer endpoint ------------------
+
+def test_rest_429_retry_after_and_tenant_report():
+    """End-to-end over a real HTTP server: an over-quota tenant gets a 429
+    with a Retry-After header and an ES-shaped error body; the x-opaque-id
+    fallback resolves to the same tenant; the developer endpoint reports
+    the rejection. The node config's `tenancy` section arms everything."""
+    import http.client
+    import json
+
+    from quickwit_tpu.serve import Node, NodeConfig, RestServer
+    from quickwit_tpu.storage import StorageResolver
+    from quickwit_tpu.tenancy import configure_tenancy
+
+    node = Node(NodeConfig(
+        node_id="tenancy-node", rest_port=0,
+        metastore_uri="ram:///tenancy/ms",
+        default_index_root_uri="ram:///tenancy/idx",
+        tenancy={"enabled": True,
+                 "tenants": {"acme": {"class": "interactive",
+                                      "qps_limit": 1}}}),
+        storage_resolver=StorageResolver.for_test())
+    server = RestServer(node, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        def call(method, path, headers=None, body=None):
+            conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=30)
+            conn.request(method, path, headers=headers or {},
+                         body=json.dumps(body).encode() if body else None)
+            response = conn.getresponse()
+            raw = response.read()
+            conn.close()
+            return (response.status,
+                    {k.lower(): v for k, v in response.getheaders()},
+                    json.loads(raw) if raw else None)
+
+        def get(path, headers=None):
+            return call("GET", path, headers)
+
+        status0, _, _ = call("POST", "/api/v1/indexes", body={
+            "index_id": "tn-logs",
+            "doc_mapping": {"field_mappings": [
+                {"name": "body", "type": "text"}],
+                "default_search_fields": ["body"]}})
+        assert status0 == 200
+
+        # the first query spends the 1-qps budget; the second bounces at
+        # the rate limit before any metastore work
+        status1, _, _ = get("/api/v1/tn-logs/search?query=x",
+                            {"x-qw-tenant": "acme"})
+        assert status1 == 200
+        status2, headers2, payload2 = get("/api/v1/tn-logs/search?query=x",
+                                          {"x-qw-tenant": "acme"})
+        assert status2 == 429
+        assert int(headers2["retry-after"]) >= 1
+        assert payload2["status"] == 429
+        assert payload2["error"]["type"] == "rate_limit_exceeded"
+        assert "acme" in payload2["error"]["reason"]
+        # unmodified ES clients land in the same bucket via x-opaque-id
+        status3, headers3, _ = get("/api/v1/tn-logs/search?query=x",
+                                   {"x-opaque-id": "acme"})
+        assert status3 == 429 and "retry-after" in headers3
+        # attribution surfaces on the developer endpoint
+        status4, _, report = get("/api/v1/developer/tenants")
+        assert status4 == 200 and report["enabled"]
+        acme = report["tenants"]["acme"]
+        assert acme["class"] == "interactive"
+        assert acme["limits"]["qps"] == 1
+        assert acme["counters"]["rejected"] >= 2
+        assert "overload" in report
+    finally:
+        server.stop()
+        configure_tenancy({})  # restore the disabled-by-default registry
+
+
+def test_overload_shed_propagates_as_429_not_split_failure():
+    """An `OverloadShed` raised deep in the leaf path (admission/batcher)
+    must surface as a whole-query 429 "overloaded" with Retry-After — NOT
+    get swallowed by the per-split partial-failure machinery into a
+    generic error (regression: the fan-out's `except Exception` used to
+    convert it into retryable failed splits)."""
+    import http.client
+    import json
+
+    from quickwit_tpu.serve import Node, NodeConfig, RestServer
+    from quickwit_tpu.storage import StorageResolver
+    from quickwit_tpu.tenancy import configure_tenancy
+    from quickwit_tpu.tenancy.overload import OVERLOAD
+
+    node = Node(NodeConfig(
+        node_id="shed-node", rest_port=0,
+        metastore_uri="ram:///shed/ms",
+        default_index_root_uri="ram:///shed/idx",
+        tenancy={"enabled": True,
+                 "tenants": {"fg": {"class": "interactive"},
+                             "bg": {"class": "background"}},
+                 "overload": {"enabled": True, "target_wait_secs": 0.05}}),
+        storage_resolver=StorageResolver.for_test())
+    server = RestServer(node, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        def call(method, path, headers=None, body=None):
+            conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=30)
+            conn.request(method, path, headers=headers or {}, body=body)
+            response = conn.getresponse()
+            raw = response.read()
+            conn.close()
+            return (response.status,
+                    {k.lower(): v for k, v in response.getheaders()},
+                    json.loads(raw) if raw else None)
+
+        status, _, _ = call("POST", "/api/v1/indexes", body=json.dumps({
+            "index_id": "shed-logs",
+            "doc_mapping": {"field_mappings": [
+                {"name": "body", "type": "text"}],
+                "default_search_fields": ["body"]}}).encode())
+        assert status == 200
+        ndjson = "\n".join(json.dumps({"body": f"msg number {i}"})
+                           for i in range(8))
+        status, _, _ = call("POST", "/api/v1/shed-logs/ingest?commit=force",
+                            body=ndjson.encode())
+        assert status == 200
+        for _ in range(30):  # push the EWMA well past the 0.05s target
+            OVERLOAD.note_wait(0.5)
+        # fresh query strings each time: a repeat is a leaf-cache hit with
+        # a zero-byte admission that never reaches the shed checkpoints
+        status, headers, payload = call(
+            "GET", "/api/v1/shed-logs/search?query=msg",
+            headers={"x-qw-tenant": "bg"})
+        assert status == 429, payload
+        assert payload["error"]["type"] == "overloaded"
+        assert "retry-after" in headers
+        status, _, payload = call(
+            "GET", "/api/v1/shed-logs/search?query=number",
+            headers={"x-qw-tenant": "fg"})
+        assert status == 200 and payload["num_hits"] == 8
+    finally:
+        server.stop()
+        configure_tenancy({})
+        OVERLOAD.reset()
+        OVERLOAD.configure(enabled=False, target_wait_secs=0.5)
