@@ -85,6 +85,8 @@ class GossipService:
     def start(self) -> None:
         for name, target in (("gossip-rx", self._listen_loop),
                              ("gossip-tx", self._gossip_loop)):
+            # qwlint: disable-next-line=QW003 - cluster gossip loops are
+            # node-lifetime background threads, never query-scoped
             thread = threading.Thread(target=target, name=name, daemon=True)
             thread.start()
             self._threads.append(thread)

@@ -181,6 +181,8 @@ class Universe:
         self._timers: list[tuple[float, int, Callable[[], None]]] = []
         self._timer_seq = itertools.count()
         self._stop = threading.Event()
+        # qwlint: disable-next-line=QW003 - the universe clock is
+        # process-lifetime infrastructure with no query context to carry
         self._clock_thread = threading.Thread(
             target=self._clock_loop, name="universe-clock", daemon=True)
         self._clock_thread.start()
@@ -314,6 +316,8 @@ class Universe:
                     backoff = min(backoff * 2, 5.0)
             handle._exited.set()
 
+        # qwlint: disable-next-line=QW003 - actor mailbox loops outlive
+        # any query; messages carry their own metadata instead
         thread = threading.Thread(target=run, name=f"actor-{actor.name}",
                                   daemon=True)
         handle.thread = thread

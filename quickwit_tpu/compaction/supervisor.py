@@ -80,6 +80,9 @@ class CompactorSupervisor:
         if synchronous:
             self._execute(task, on_done)
         else:
+            # qwlint: disable-next-line=QW003 - merge tasks are background
+            # maintenance; they must NOT inherit a submitting query's
+            # deadline or the merge would be shed mid-write
             threading.Thread(
                 target=self._execute, args=(task, on_done),
                 name=f"merge-{task.task_id}", daemon=True).start()

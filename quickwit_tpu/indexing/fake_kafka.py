@@ -39,6 +39,8 @@ class FakeKafkaBroker:
         self._server.listen(8)
         self.host, self.port = self._server.getsockname()
         self._running = True
+        # qwlint: disable-next-line=QW003 - test-double broker accept
+        # loop; serves no quickwit_tpu queries
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
 
@@ -81,6 +83,8 @@ class FakeKafkaBroker:
                 conn, _addr = self._server.accept()
             except OSError:
                 return
+            # qwlint: disable-next-line=QW003 - test-double connection
+            # handler; no query context exists on this path
             threading.Thread(target=self._handle, args=(conn,),
                              daemon=True).start()
 

@@ -157,6 +157,8 @@ class FakeKinesisServer:
         return f"http://127.0.0.1:{self._httpd.server_port}"
 
     def start(self) -> "FakeKinesisServer":
+        # qwlint: disable-next-line=QW003 - test-double HTTP server; no
+        # query context exists on this path
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()

@@ -130,6 +130,8 @@ class FakeSqsServer:
         return f"{self.endpoint}/000000000000/test-queue"
 
     def start(self) -> "FakeSqsServer":
+        # qwlint: disable-next-line=QW003 - test-double HTTP server; no
+        # query context exists on this path
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()

@@ -239,6 +239,8 @@ class BatchSpanExporter:
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
+        # qwlint: disable-next-line=QW003 - exporter drains finished spans
+        # for ALL queries; binding one query's context would be wrong
         self._thread = threading.Thread(target=self._run,
                                         name="span-exporter", daemon=True)
         self._thread.start()

@@ -137,6 +137,8 @@ def bucket_percentile_sketch(idx: jnp.ndarray, values: jnp.ndarray,
         num_buckets, PCTL_NUM_BUCKETS)
 
 
+# qwlint: disable-next-line=QW001 - root-side finalize over a host numpy
+# sketch already shipped from the leaves; no device data in sight
 def sketch_quantiles(counts: np.ndarray, quantiles: list[float]) -> list[float]:
     """Host-side quantile estimation from a (merged) sketch."""
     counts = np.asarray(counts)
@@ -260,6 +262,8 @@ def jax_bitcast_f64(values: jnp.ndarray) -> jnp.ndarray:
     return jax.lax.bitcast_convert_type(values, jnp.uint64)
 
 
+# qwlint: disable-next-line=QW001 - host-side HLL bias correction on the
+# merged register array (root finalize, off the dispatch path)
 def hll_estimate(registers: np.ndarray) -> float:
     """Classic HLL estimate with small-range (linear counting) correction."""
     registers = np.asarray(registers, dtype=np.float64)

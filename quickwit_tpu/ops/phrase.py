@@ -17,6 +17,8 @@ from __future__ import annotations
 import numpy as np
 
 
+# qwlint: disable-next-line=QW001 - positions arrive as host numpy from
+# the split's position index; matching never touches device arrays
 def phrase_match(
     postings: list[tuple[np.ndarray, np.ndarray]],
     positions: list[tuple[np.ndarray, np.ndarray]],
@@ -76,6 +78,8 @@ def phrase_match(
     return np.array(out_ids, dtype=np.int32), np.array(out_freqs, dtype=np.int32)
 
 
+# qwlint: disable-next-line=QW001 - vectorized host numpy inner loop of
+# phrase_match (see note there)
 def _exact_phrase_vectorized(positions, term_indices, common):
     """slop=0 across ALL common docs at once — no per-doc Python loop.
 

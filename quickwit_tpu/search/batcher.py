@@ -224,6 +224,9 @@ class QueryBatcher:
                         for pending, result in zip(alive, results):
                             pending.result = result
                             pending.event.set()
+                # qwlint: disable-next-line=QW004 - the dispatch error is
+                # fanned to every batched waiter and re-raised per-waiter
+                # via _waiter_error; nothing is swallowed
                 except Exception as exc:  # noqa: BLE001 - fan to waiters
                     for pending in alive:
                         pending.error = exc
@@ -248,6 +251,8 @@ def _waiter_error(err: Exception) -> Exception:
     __traceback__ and leak handler-side mutations across queries."""
     try:
         copy = type(err)(*err.args)
+    # qwlint: disable-next-line=QW004 - reconstruction fallback: the
+    # original error stays chained as __cause__ either way
     except Exception:  # noqa: BLE001 - exotic constructor signatures
         copy = RuntimeError(f"batched dispatch failed: {err!r}")
     copy.__cause__ = err

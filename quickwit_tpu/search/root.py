@@ -26,7 +26,8 @@ from typing import Any, Callable, Optional, Protocol
 from ..common.deadline import (
     Deadline, DeadlineExceeded, QueryBudget, deadline_scope, is_deadline_error,
 )
-from ..metastore.base import ListSplitsQuery, Metastore
+from ..common.ctx import run_with_context
+from ..metastore.base import ListSplitsQuery, Metastore, MetastoreError
 from ..models.doc_mapper import DocMapper
 from ..models.split_metadata import Split, SplitState
 from ..observability.metrics import (
@@ -413,9 +414,10 @@ class RootSearcher:
                                                  budget)]
         results: list[Optional[LeafSearchResponse]] = [None] * len(dispatches)
         # fan-out threads start with empty span stacks and fresh contextvars:
-        # capture the root's traceparent and profile HERE so every leaf
-        # dispatch joins the root trace (trace stitching) and reports its
-        # phases into the root's profile instead of minting orphans
+        # capture the root's traceparent HERE (the tracer's span stack is
+        # thread-local, not a contextvar) so every leaf dispatch joins the
+        # root trace; the contextvar bindings — deadline, tenant, profile —
+        # ride the run_with_context snapshot below
         from ..observability.tracing import TRACER
         parent_tp = TRACER.current_traceparent()
         profile = current_profile()
@@ -427,9 +429,7 @@ class RootSearcher:
             with TRACER.span("leaf_dispatch",
                              {"node": node_id,
                               "num_splits": len(leaf_request.splits)},
-                             remote_parent=parent_tp), \
-                    profile_scope(profile), deadline_scope(deadline), \
-                    tenant_scope(tenant):
+                             remote_parent=parent_tp):
                 try:
                     results[i] = self._leaf_search_with_retry(
                         leaf_request, node_id, nodes, budget)
@@ -441,10 +441,16 @@ class RootSearcher:
                 except Exception as exc:  # noqa: BLE001 - surfaced per split
                     results[i] = _all_splits_failed(leaf_request, str(exc))
 
+        # snapshot under the authoritative bindings: budget.deadline is THE
+        # query deadline even if a caller ever invokes _fan_out outside its
+        # scope, so re-enter the scopes explicitly before capturing
+        with profile_scope(profile), deadline_scope(deadline), \
+                tenant_scope(tenant):
+            spawned_run = run_with_context(run)
         threads = []
         for i, (node_id, leaf_request) in enumerate(dispatches):
             thread = threading.Thread(
-                target=run, args=(i, node_id, leaf_request),
+                target=spawned_run, args=(i, node_id, leaf_request),
                 name=f"root-fanout-{i}", daemon=True)
             threads.append(thread)
             thread.start()
@@ -498,7 +504,10 @@ class RootSearcher:
             else:
                 try:
                     im = self.metastore.index_metadata(pattern)
-                except Exception:
+                except MetastoreError:
+                    # unknown index id in a multi-pattern request: skip the
+                    # pattern (ES semantics); anything NOT a typed metastore
+                    # failure — deadline expiry, backpressure — propagates
                     continue
                 if im.index_uid not in seen:
                     seen.add(im.index_uid)
@@ -620,6 +629,17 @@ class RootSearcher:
         )
         try:
             retry_response = self.clients[retry_node].leaf_search(retry_request)
+        except (OverloadShed, TenantRateLimited):
+            # the retry client can be LOCAL (in-process service): its
+            # backpressure must fail the whole query as a typed 429, same
+            # contract as the first attempt above — swallowing it here
+            # demoted a controller rejection to a generic split failure
+            raise
+        except DeadlineExceeded as exc:
+            return with_failures(
+                [SplitSearchError(split_id=s.split_id, error=str(exc),
+                                  retryable=False)
+                 for s in retry_splits] + non_retryable)
         except Exception as exc:  # noqa: BLE001
             logger.warning("leaf retry on %s failed: %s", retry_node, exc)
             return with_failures(
@@ -691,6 +711,10 @@ class RootSearcher:
                 try:
                     docs = self.clients[node_id].fetch_docs(fetch_request)
                     break
+                except (OverloadShed, TenantRateLimited):
+                    # local backpressure fails the whole query as a typed
+                    # 429 — replica-retrying it would defeat the controller
+                    raise
                 except Exception as exc:  # noqa: BLE001
                     logger.warning("fetch_docs on %s failed: %s", node_id, exc)
             if docs is None:
@@ -763,6 +787,8 @@ def _fill_empty_aggs(aggregations: dict, aggs_request: dict) -> None:
     from .collector import finalize_aggregations
     try:
         specs = parse_aggs(aggs_request)
+    # qwlint: disable-next-line=QW004 - pure parse of an already-validated
+    # dict; no control-flow exception can originate here
     except Exception:  # noqa: BLE001 - request already validated upstream
         return
     empty_states: dict[str, dict] = {}

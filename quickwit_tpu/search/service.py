@@ -24,8 +24,9 @@ import threading
 from collections import OrderedDict
 from typing import Any, Optional
 
+from ..common.ctx import run_with_context
 from ..common.deadline import (
-    Deadline, bind_deadline, current_deadline, deadline_scope,
+    Deadline, current_deadline, deadline_scope,
 )
 from ..index.reader import SplitReader
 from ..models.doc_mapper import DocMapper
@@ -34,13 +35,13 @@ from ..observability.metrics import (
     SEARCH_SPLITS_DOWNGRADED_TOTAL, SEARCH_SPLITS_PRUNED_TOTAL,
 )
 from ..observability.profile import (
-    QueryProfile, bind_profile, current_profile, profile_scope,
+    QueryProfile, current_profile, profile_scope,
 )
 from ..query.ast import MatchAll
 from ..parallel.fanout import build_batch, execute_batch, stage_device_inputs
 from ..storage.base import StorageResolver
 from ..tenancy.context import (
-    TenantContext, bind_tenant, current_tenant, tenant_scope,
+    TenantContext, current_tenant, tenant_scope,
 )
 from ..tenancy.overload import OverloadShed
 from ..tenancy.registry import TenantRateLimited
@@ -371,11 +372,16 @@ class SearchService:
                                 remote_parent=tp):
                             box["response"] = \
                                 self.context.offload_client().leaf_search(rr)
+                    # qwlint: disable-next-line=QW004 - offload failure
+                    # (incl. a remote 429/timeout) falls back to LOCAL
+                    # execution below; failing the query would defeat offload
                     except Exception as exc:  # noqa: BLE001 - fallback below
                         box["error"] = exc
 
-                offload_future = threading.Thread(target=_invoke,
-                                                  daemon=True)
+                # run_with_context: the invoke thread must see the query's
+                # deadline (client clamp) and profile (offload phases)
+                offload_future = threading.Thread(
+                    target=run_with_context(_invoke), daemon=True)
                 offload_future.start()
                 offload_result = result_box
 
@@ -392,10 +398,10 @@ class SearchService:
         pipelined = self.context.prefetch and len(groups) > 1
         future = None
         if pipelined:
-            # bind_deadline/bind_profile/bind_tenant: contextvars do not
-            # reach pool worker threads
+            # contextvars do not reach pool worker threads: one snapshot
+            # carries deadline+tenant+profile (and any future binding)
             future = self.context.prefetch_pool().submit(
-                bind_tenant(bind_profile(bind_deadline(self._prepare_group))),
+                run_with_context(self._prepare_group),
                 groups[0], doc_mapper, search_request, prune_ctx, threshold)
         for i, group in enumerate(groups):
             begin = i * batch_size
@@ -422,8 +428,7 @@ class SearchService:
             future = None
             if pipelined and i + 1 < len(groups):
                 future = self.context.prefetch_pool().submit(
-                    bind_tenant(bind_profile(bind_deadline(
-                        self._prepare_group))),
+                    run_with_context(self._prepare_group),
                     groups[i + 1], doc_mapper, search_request, prune_ctx,
                     threshold)
             self._execute_group(prepared, doc_mapper, search_request,
@@ -677,6 +682,11 @@ class SearchService:
                         cache.record_term_absent(s, f, t),
                     sort_value_threshold=sort_value_threshold)
                 prepared.append((split, reader, plan, None))
+            except (OverloadShed, TenantRateLimited):
+                # whole-query backpressure: demoting it to a per-split
+                # failure here would turn a typed 429 into a generic 400
+                # (same contract as _prepare_group/_execute_per_split)
+                raise
             except Exception as exc:  # noqa: BLE001 - partial failure
                 prepared.append((split, None, None, exc))
         return prepared

@@ -23,6 +23,9 @@ import threading
 import time
 from typing import Any, Callable, Iterable, Optional
 
+from ..common.deadline import DeadlineExceeded
+from ..tenancy.overload import OverloadShed
+from ..tenancy.registry import TenantRateLimited
 from .http2 import (
     FLAG_ACK, FLAG_END_HEADERS, FLAG_END_STREAM, FRAME_DATA, FRAME_HEADERS,
     FRAME_PING, FRAME_SETTINGS, FRAME_WINDOW_UPDATE, Http2Server, HpackDecoder,
@@ -31,6 +34,8 @@ from .http2 import (
 
 GRPC_OK = 0
 GRPC_UNKNOWN = 2
+GRPC_DEADLINE_EXCEEDED = 4
+GRPC_RESOURCE_EXHAUSTED = 8
 GRPC_UNIMPLEMENTED = 12
 
 
@@ -254,6 +259,16 @@ class GrpcServer:
             return (response_headers, [],
                     [("grpc-status", str(exc.status)),
                      ("grpc-message", str(exc))])
+        except DeadlineExceeded as exc:
+            # str(exc) embeds the deadline mark, so the remote root's
+            # is_deadline_error() classifier still recognizes the failure
+            return (response_headers, [],
+                    [("grpc-status", str(GRPC_DEADLINE_EXCEEDED)),
+                     ("grpc-message", str(exc))])
+        except (OverloadShed, TenantRateLimited) as exc:
+            return (response_headers, [],
+                    [("grpc-status", str(GRPC_RESOURCE_EXHAUSTED)),
+                     ("grpc-message", f"{type(exc).__name__}: {exc}")])
         except Exception as exc:  # noqa: BLE001 - status trailer, not a 500
             return (response_headers, [],
                     [("grpc-status", str(GRPC_UNKNOWN)),
@@ -565,9 +580,17 @@ class GrpcSearchClient:
                 raise HttpTransportError(
                     f"grpc {self.grpc_endpoint}{path}: {exc}") from exc
             if status != 0:
+                # translate gRPC status into the HTTP status the root's
+                # failure handling keys on: RESOURCE_EXHAUSTED is remote
+                # backpressure (429 -> failed-node retry path, see
+                # search/root.py), DEADLINE_EXCEEDED is a timeout (504);
+                # the message carries the deadline mark for
+                # is_deadline_error(). Anything else stays a generic 500.
+                http_status = {GRPC_RESOURCE_EXHAUSTED: 429,
+                               GRPC_DEADLINE_EXCEEDED: 504}.get(status, 500)
                 raise HttpStatusError(
                     f"grpc {self.grpc_endpoint}{path} -> status {status}: "
-                    f"{message}", status=500)
+                    f"{message}", status=http_status)
             return messages[0] if messages else b""
 
         return self.circuit.call(once)

@@ -311,6 +311,8 @@ def _tls_duplex_bridge(tls_sock) -> socket.socket:
                 except OSError:
                     pass
 
+    # qwlint: disable-next-line=QW003 - byte-pump between the TLS and
+    # plaintext halves of one socket; carries frames, not queries
     threading.Thread(target=pump, daemon=True,
                      name="h2-tls-pump").start()
     return plain
@@ -334,6 +336,9 @@ class Http2Server:
         self._server.listen(16)
         self.host, self.port = self._server.getsockname()
         self._running = True
+        # qwlint: disable-next-line=QW003 - listener accept loop: query
+        # context is established per-request from the payload downstream
+        # (deadline_millis -> deadline_scope), never inherited from here
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
 
@@ -350,6 +355,8 @@ class Http2Server:
                 conn, _addr = self._server.accept()
             except OSError:
                 return
+            # qwlint: disable-next-line=QW003 - connection thread; see
+            # listener note above (context comes from each request)
             threading.Thread(target=self._connection, args=(conn,),
                              daemon=True).start()
 
@@ -441,6 +448,9 @@ class Http2Server:
                     stream = streams[stream_id]
                     if stream.ended and stream.headers_done:
                         del streams[stream_id]
+                        # qwlint: disable-next-line=QW003 - per-stream
+                        # dispatch; the handler binds context from the
+                        # decoded request, not from the reader thread
                         threading.Thread(
                             target=self._dispatch,
                             args=(state, stream), daemon=True).start()
@@ -456,6 +466,10 @@ class Http2Server:
         try:
             response_headers, body_chunks, trailers = self.handler(
                 stream.headers or [], bytes(stream.data))
+        # qwlint: disable-next-line=QW004 - transport's last-resort 500:
+        # typed exceptions are mapped to statuses by the gRPC/REST layers
+        # above; anything reaching here is a handler bug, and raising
+        # would kill the shared connection for unrelated streams
         except Exception:  # noqa: BLE001 - connection must survive
             response_headers = [(":status", "500")]
             body_chunks = []

@@ -1040,6 +1040,8 @@ class Node:
             if node_id == self.config.node_id:
                 running[node_id] = self.indexing_tasks_report()
             else:
+                # qwlint: disable-next-line=QW003 - control-plane poll of
+                # peer nodes; admin path with its own 10s join budget
                 worker = threading.Thread(target=poll_one, args=(node_id,),
                                           daemon=True)
                 worker.start()
@@ -1521,6 +1523,8 @@ class Node:
                          if m.node_id != self.config.node_id and m.rest_endpoint)
             # Fan out concurrently: N slow/unreachable peers must not stretch
             # the heartbeat period past the liveness window for healthy ones.
+            # qwlint: disable-next-line=QW003 - liveness heartbeats to
+            # peers; cluster plumbing, not query work
             workers = [threading.Thread(target=heartbeat_one,
                                         args=(endpoint, payload), daemon=True)
                        for endpoint in peers]

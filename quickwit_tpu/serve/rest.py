@@ -217,6 +217,8 @@ class RestServer:
                 do_handshake_on_connect=False)
         self.port = self._httpd.server_address[1]
         self.node.config.rest_port = self.port
+        # qwlint: disable-next-line=QW003 - REST listener: each request
+        # binds deadline/tenant from its own headers/params downstream
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name=f"rest-{self.port}", daemon=True)
         self._thread.start()
@@ -1127,6 +1129,9 @@ class RestServer:
             resolved = self.node.root_searcher._resolve_indexes(
                 index_pattern.split(","))
             mapper = resolved[0].index_config.doc_mapper if resolved else None
+        # qwlint: disable-next-line=QW004 - best-effort mapper lookup for
+        # ES sort-scale shims; a failure here just skips scaling and the
+        # real resolution error surfaces from the search itself
         except Exception:  # noqa: BLE001 - resolution errors surface later
             mapper = None
         scales = []

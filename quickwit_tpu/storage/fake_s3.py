@@ -262,6 +262,8 @@ class FakeS3Server:
         self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
         self.port = self._httpd.server_address[1]
         self.endpoint = f"http://127.0.0.1:{self.port}"
+        # qwlint: disable-next-line=QW003 - test-double HTTP server; no
+        # query context exists on this path
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="fake-s3", daemon=True)
 

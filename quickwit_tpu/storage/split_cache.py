@@ -27,13 +27,13 @@ from ..observability.metrics import METRICS
 
 logger = logging.getLogger(__name__)
 
-_HITS = METRICS.counter("split_cache_hits_total",
+_HITS = METRICS.counter("qw_split_cache_hits_total",
                         "reader opens served from the disk split cache")
-_MISSES = METRICS.counter("split_cache_misses_total",
+_MISSES = METRICS.counter("qw_split_cache_misses_total",
                           "reader opens that had to go to object storage")
-_EVICTIONS = METRICS.counter("split_cache_evictions_total",
+_EVICTIONS = METRICS.counter("qw_split_cache_evictions_total",
                              "splits evicted from the disk cache")
-_DOWNLOADS = METRICS.counter("split_cache_downloads_total",
+_DOWNLOADS = METRICS.counter("qw_split_cache_downloads_total",
                              "splits downloaded into the disk cache")
 
 CANDIDATE = "candidate"
@@ -242,6 +242,9 @@ class DiskSplitCache:
         try:
             storage = self.storage_resolver.resolve(storage_uri)
             payload = storage.get_all(f"{split_id}.split")
+        # qwlint: disable-next-line=QW004 - background prefetch worker off
+        # the query path: a failed download only drops the candidate, and
+        # the worker loop must survive storage faults (incl. injected ones)
         except Exception as exc:  # noqa: BLE001 - worker must survive
             logger.warning("split cache download %s failed: %s",
                            split_id, exc)
@@ -293,6 +296,9 @@ class DiskSplitCache:
     # -- worker -------------------------------------------------------------
     def start(self) -> None:
         if self._worker is None:
+            # qwlint: disable-next-line=QW003 - long-lived background
+            # downloader; deliberately NOT bound to the starting request's
+            # deadline/tenant context
             self._worker = threading.Thread(
                 target=self._worker_loop, name="split-cache-dl", daemon=True)
             self._worker.start()

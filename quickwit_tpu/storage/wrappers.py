@@ -23,6 +23,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Iterable, Iterator
 
+from ..common.ctx import run_with_context
 from ..common.deadline import DEADLINE_ERROR_MARK, current_deadline
 from .base import Storage, StorageError
 
@@ -64,12 +65,17 @@ class TimeoutAndRetryStorage(Storage):
             try:
                 results.put((True, self.underlying.get_slice(path, start,
                                                              end)))
+            # qwlint: disable-next-line=QW004 - every attempt's error is
+            # shipped across the queue and re-raised by the racing caller
             except Exception as exc:  # noqa: BLE001 - raced; re-raised below
                 results.put((False, exc))
 
         def launch() -> None:
-            threading.Thread(target=attempt, name="storage-hedge",
-                             daemon=True).start()
+            # the hedge thread must see the query's deadline/tenant so the
+            # underlying storage (fault injection, rate accounting) attributes
+            # the read to the right query instead of an ambient default
+            threading.Thread(target=run_with_context(attempt),
+                             name="storage-hedge", daemon=True).start()
 
         timeouts = list(self.policy.attempt_timeouts(end - start))
         max_attempts = len(timeouts)
@@ -164,6 +170,8 @@ class DebouncedStorage(Storage):
         if leader:
             try:
                 cell.value = self.underlying.get_slice(path, start, end)
+            # qwlint: disable-next-line=QW004 - the error is published via
+            # the cell and re-raised by the leader AND every waiter below
             except Exception as exc:  # noqa: BLE001 - published to waiters
                 cell.error = exc
             finally:

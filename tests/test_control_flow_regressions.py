@@ -1,0 +1,301 @@
+"""Regression tests for the real defects the qwlint sweep uncovered.
+
+Each test pins a specific repaired site:
+
+- gRPC server `_handle` used to collapse EVERY non-GrpcError into
+  status UNKNOWN(2), and the client mapped any non-zero status to a
+  generic HTTP 500 — so a remote leaf's typed backpressure (429) and
+  deadline (504) semantics vanished across the wire.
+- the root's retry dispatch swallowed OverloadShed/TenantRateLimited/
+  DeadlineExceeded from the second attempt into generic split errors.
+- `SearchService._prepare_per_split` demoted whole-query backpressure
+  raised at reader-open into a per-split failure (429 became 400).
+- hedged storage attempts and the batch-offload thread ran with EMPTY
+  contextvars, losing the query deadline/tenant across the thread hop.
+- the split-cache metrics exported without the qw_ namespace prefix.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from quickwit_tpu.common.ctx import run_with_context
+from quickwit_tpu.common.deadline import (
+    Deadline, DeadlineExceeded, current_deadline, deadline_scope,
+    is_deadline_error,
+)
+from quickwit_tpu.query import parse_query_string
+from quickwit_tpu.search.models import (
+    LeafSearchRequest, SearchRequest, SplitIdAndFooter,
+)
+from quickwit_tpu.search.root import RootSearcher
+from quickwit_tpu.serve.grpc_server import (
+    GRPC_DEADLINE_EXCEEDED, GRPC_RESOURCE_EXHAUSTED, GRPC_UNKNOWN,
+    GrpcSearchClient, GrpcServer, _grpc_frame,
+)
+from quickwit_tpu.serve.http_client import HttpStatusError
+from quickwit_tpu.storage.base import Storage
+from quickwit_tpu.storage.wrappers import (
+    DebouncedStorage, StorageTimeoutPolicy, TimeoutAndRetryStorage,
+)
+from quickwit_tpu.tenancy.overload import OverloadShed
+from quickwit_tpu.tenancy.registry import TenantRateLimited
+
+
+class _FakeNodeConfig:
+    node_id = "regression-node"
+
+
+class _FakeNode:
+    config = _FakeNodeConfig()
+
+
+def _trailer_map(trailers):
+    return dict(trailers)
+
+
+@pytest.fixture()
+def grpc_server():
+    server = GrpcServer(_FakeNode())
+    yield server
+    server.stop()
+
+
+def _handle_raising(server, exc):
+    server._handlers["/test/Boom"] = lambda payload: (_ for _ in ()).throw(exc)
+    _headers, _chunks, trailers = server._handle(
+        [(":path", "/test/Boom")], _grpc_frame(b""))
+    return _trailer_map(trailers)
+
+
+# --- gRPC server: typed exceptions become real status codes ----------------
+
+def test_grpc_server_maps_deadline_to_status_4(grpc_server):
+    trailers = _handle_raising(grpc_server, DeadlineExceeded("leaf search"))
+    assert trailers["grpc-status"] == str(GRPC_DEADLINE_EXCEEDED)
+    # the deadline mark must survive into the trailer so the remote root's
+    # is_deadline_error() classifier still sees a timeout, not a failure
+    assert is_deadline_error(trailers["grpc-message"])
+
+
+def test_grpc_server_maps_backpressure_to_status_8(grpc_server):
+    for exc in (OverloadShed("cpu", 0.25),
+                TenantRateLimited("t1", "qps", 0.5)):
+        trailers = _handle_raising(grpc_server, exc)
+        assert trailers["grpc-status"] == str(GRPC_RESOURCE_EXHAUSTED), exc
+
+
+def test_grpc_server_unexpected_errors_stay_unknown(grpc_server):
+    trailers = _handle_raising(grpc_server, ValueError("boom"))
+    assert trailers["grpc-status"] == str(GRPC_UNKNOWN)
+
+
+# --- gRPC client: status codes become truthful HTTP statuses ---------------
+
+@pytest.fixture()
+def grpc_client_pair():
+    server = GrpcServer(_FakeNode())
+    client = GrpcSearchClient(f"127.0.0.1:{server.port}",
+                              f"http://127.0.0.1:{server.port}")
+    yield server, client
+    client.close()
+    server.stop()
+
+
+def _client_status_for(server, client, exc) -> HttpStatusError:
+    server._handlers["/test/Boom"] = lambda payload: (_ for _ in ()).throw(exc)
+    with pytest.raises(HttpStatusError) as info:
+        client._call("/test/Boom", b"")
+    return info.value
+
+
+def test_grpc_client_maps_resource_exhausted_to_429(grpc_client_pair):
+    server, client = grpc_client_pair
+    error = _client_status_for(server, client, OverloadShed("cpu", 0.25))
+    # 429 keeps the root's documented remote-backpressure contract: the
+    # failed-node retry path handles it like any other client error, but
+    # the status no longer lies (it used to arrive as a generic 500)
+    assert error.status == 429
+    assert "overload shed" in str(error)
+
+
+def test_grpc_client_maps_deadline_to_504_with_mark(grpc_client_pair):
+    server, client = grpc_client_pair
+    error = _client_status_for(server, client,
+                               DeadlineExceeded("remote leaf"))
+    assert error.status == 504
+    assert is_deadline_error(str(error))
+
+
+def test_grpc_client_keeps_500_for_unknown(grpc_client_pair):
+    server, client = grpc_client_pair
+    error = _client_status_for(server, client, ValueError("boom"))
+    assert error.status == 500
+
+
+# --- root retry dispatch: typed control flow propagates --------------------
+
+def _search_request():
+    return SearchRequest(index_ids=["idx"],
+                         query_ast=parse_query_string("body:x"))
+
+
+def _leaf_request():
+    return LeafSearchRequest(
+        search_request=_search_request(),
+        index_uid="idx:01", doc_mapping={},
+        splits=[SplitIdAndFooter(split_id="s1", storage_uri="ram:///x")])
+
+
+class _DeadClient:
+    def leaf_search(self, request):
+        raise RuntimeError("node unreachable")
+
+
+class _RaisingClient:
+    def __init__(self, exc):
+        self.exc = exc
+
+    def leaf_search(self, request):
+        raise self.exc
+
+
+def test_retry_reraises_backpressure_as_typed(caplog):
+    # primary node dead, retry node sheds: the shed must surface as a
+    # typed 429, NOT be demoted to a generic per-split failure (it used
+    # to be swallowed by the retry site's broad except)
+    root = RootSearcher(None, {
+        "node-0": _DeadClient(),
+        "node-1": _RaisingClient(OverloadShed("queue", 0.5))})
+    with pytest.raises(OverloadShed):
+        root._leaf_search_with_retry(_leaf_request(), "node-0",
+                                     ["node-0", "node-1"])
+
+
+def test_retry_deadline_returns_nonretryable_failures():
+    # deadline on the retry attempt ends the query with non-retryable,
+    # mark-carrying split failures instead of a generic retry error
+    root = RootSearcher(None, {
+        "node-0": _DeadClient(),
+        "node-1": _RaisingClient(DeadlineExceeded("retry dispatch"))})
+    response = root._leaf_search_with_retry(_leaf_request(), "node-0",
+                                            ["node-0", "node-1"])
+    assert [e.split_id for e in response.failed_splits] == ["s1"]
+    failure = response.failed_splits[0]
+    assert failure.retryable is False
+    assert is_deadline_error(failure.error)
+
+
+# --- leaf prepare: backpressure is whole-query, not per-split --------------
+
+def test_prepare_per_split_reraises_backpressure():
+    from quickwit_tpu.search.service import SearcherContext, SearchService
+    from quickwit_tpu.storage import StorageResolver
+    context = SearcherContext(storage_resolver=StorageResolver.for_test())
+    service = SearchService(context, node_id="n0")
+    context.reader = lambda split: (_ for _ in ()).throw(
+        TenantRateLimited("t1", "qps", 0.5))
+    split = SplitIdAndFooter(split_id="s1", storage_uri="ram:///x")
+    with pytest.raises(TenantRateLimited):
+        service._prepare_per_split([split], None, _search_request())
+
+
+# --- context propagation across thread hops --------------------------------
+
+def test_run_with_context_carries_bindings_into_threads():
+    seen = {}
+
+    def probe():
+        deadline = current_deadline()
+        seen["bounded"] = deadline is not None and deadline.bounded
+
+    with deadline_scope(Deadline.after(30.0)):
+        wrapped = run_with_context(probe)
+    thread = threading.Thread(target=wrapped)
+    thread.start()
+    thread.join(timeout=5.0)
+    assert seen["bounded"] is True
+    # the spawning thread's own context is untouched
+    assert current_deadline() is None or not current_deadline().bounded
+
+
+def test_run_with_context_wrapper_is_reentrant_across_threads():
+    # one wrapped callable handed to MANY threads (the hedge pattern):
+    # a shared Context.run would raise RuntimeError on concurrent entry
+    results = []
+    barrier = threading.Barrier(4)
+
+    def probe():
+        barrier.wait(timeout=5.0)
+        deadline = current_deadline()
+        results.append(deadline is not None and deadline.bounded)
+
+    with deadline_scope(Deadline.after(30.0)):
+        wrapped = run_with_context(probe)
+    threads = [threading.Thread(target=wrapped) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=5.0)
+    assert results == [True] * 4
+
+
+class _RecordingStorage(Storage):
+    def __init__(self):
+        super().__init__("ram:///record")
+        self.deadlines = []
+
+    def get_slice(self, path, start, end):
+        deadline = current_deadline()
+        self.deadlines.append(deadline is not None and deadline.bounded)
+        return b"x" * (end - start)
+
+
+def test_hedged_attempt_threads_see_query_deadline():
+    # the hedge runs each attempt on a fresh thread; before the fix that
+    # thread had EMPTY contextvars, so the underlying storage (fault
+    # accounting, nested deadline checks) saw no deadline at all
+    recording = _RecordingStorage()
+    hedged = TimeoutAndRetryStorage(recording, StorageTimeoutPolicy(
+        timeout_millis=5_000, max_num_retries=1))
+    with deadline_scope(Deadline.after(30.0)):
+        payload = hedged.get_slice("f", 0, 4)
+    assert payload == b"xxxx"
+    assert recording.deadlines == [True]
+
+
+def test_debounced_leader_error_reaches_every_waiter():
+    class _FailingStorage(Storage):
+        def __init__(self):
+            super().__init__("ram:///fail")
+
+        def get_slice(self, path, start, end):
+            raise OverloadShed("storage", 0.1)
+
+    debounced = DebouncedStorage(_FailingStorage())
+    with pytest.raises(OverloadShed):
+        debounced.get_slice("f", 0, 4)
+
+
+# --- metrics hygiene: the renamed split-cache series -----------------------
+
+def test_all_registered_metrics_are_qw_prefixed():
+    # importing the module registers its metrics; split_cache's four
+    # counters used to export without the namespace prefix
+    import quickwit_tpu.storage.split_cache  # noqa: F401
+    from quickwit_tpu.observability.metrics import METRICS
+    names = list(METRICS._metrics)
+    assert names, "registry unexpectedly empty"
+    offenders = [n for n in names if not n.startswith("qw_")]
+    assert not offenders, f"non-qw_ metrics registered: {offenders}"
+
+
+def test_split_cache_metrics_registered_under_new_names():
+    import quickwit_tpu.storage.split_cache  # noqa: F401
+    from quickwit_tpu.observability.metrics import METRICS
+    for name in ("qw_split_cache_hits_total", "qw_split_cache_misses_total",
+                 "qw_split_cache_evictions_total",
+                 "qw_split_cache_downloads_total"):
+        assert name in METRICS._metrics

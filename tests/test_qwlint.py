@@ -1,0 +1,56 @@
+"""Tier-1 gate: qwlint over the real package must be clean modulo the
+checked-in baseline. A new finding fails this test with the finding text;
+either fix it or (for a justified grandfathered case) add a baseline
+entry with a real `why`. Stale entries fail too, so the baseline only
+ever ratchets down."""
+
+from __future__ import annotations
+
+import json
+import os
+
+from tools.qwlint import (analyze_paths, apply_baseline,
+                          default_baseline_path, load_baseline)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO_ROOT, "quickwit_tpu")
+
+
+def _run():
+    findings = analyze_paths([PACKAGE], root=REPO_ROOT)
+    entries = load_baseline(default_baseline_path())
+    return findings, entries, *apply_baseline(findings, entries)
+
+
+def test_package_is_clean_modulo_baseline():
+    _, _, new, _ = _run()
+    assert not new, (
+        "qwlint found new issue(s) — fix them or baseline with a "
+        "justification:\n" + "\n".join(f.render() for f in new))
+
+
+def test_baseline_has_no_stale_entries():
+    _, _, _, stale = _run()
+    assert not stale, (
+        "baseline entries no longer match any finding — the sites were "
+        "fixed, remove the entries to lock in the win:\n"
+        + "\n".join(json.dumps(e) for e in stale))
+
+
+def test_baseline_entries_all_have_justifications():
+    entries = load_baseline(default_baseline_path())
+    missing = [e for e in entries
+               if not e["why"].strip() or e["why"].startswith("TODO")]
+    assert not missing, (
+        "baseline entries must say WHY the finding is acceptable:\n"
+        + "\n".join(json.dumps(e) for e in missing))
+
+
+def test_baseline_never_grandfathers_new_modules():
+    # the baseline is a ratchet over known files; keep its scope honest
+    entries = load_baseline(default_baseline_path())
+    allowed = {"quickwit_tpu/search/leaf.py",
+               "quickwit_tpu/search/collector.py",
+               "quickwit_tpu/search/plan.py",
+               "quickwit_tpu/serve/node.py"}
+    assert {e["path"] for e in entries} <= allowed

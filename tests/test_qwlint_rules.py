@@ -1,0 +1,394 @@
+"""Fixture tests for each qwlint rule: every rule must fire on a positive
+snippet, stay quiet on the idiomatic negative, and honor all three
+suppression scopes. Snippets are written to tmp_path (OUTSIDE
+quickwit_tpu/) — the engine treats out-of-tree files as always in scope
+precisely so these fixtures exercise scoped rules."""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+import pytest
+
+from tools.qwlint import (analyze_file, analyze_paths, apply_baseline,
+                          load_baseline, write_baseline)
+from tools.qwlint.core import Finding, LintError
+
+
+def lint(tmp_path, source: str, name: str = "snippet.py"):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(source))
+    return analyze_file(str(path), root=str(tmp_path))
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# --- QW001 hidden-host-readback ----------------------------------------------
+
+def test_qw001_flags_float_item_and_asarray(tmp_path):
+    findings = lint(tmp_path, """
+        import numpy as np
+
+        def hot(x):
+            a = float(x)
+            b = x.item()
+            c = np.asarray(x)
+            return a, b, c
+    """)
+    assert rules_of(findings) == ["QW001", "QW001", "QW001"]
+
+
+def test_qw001_ignores_literals_module_level_and_blocking_with_args(tmp_path):
+    findings = lint(tmp_path, """
+        import numpy as np
+
+        NEG_INF = float("-inf")        # literal: host constant
+        EAGER = np.asarray([1, 2, 3])  # module level: import time
+
+        def hot(x, fh):
+            lo = float("-inf")         # literal inside a function
+            n = int(-1)
+            fh.item(3)                 # args -> not the 0-arg readback
+            return lo, n
+    """)
+    assert findings == []
+
+
+def test_qw001_block_until_ready_and_device_get(tmp_path):
+    findings = lint(tmp_path, """
+        import jax
+
+        def hot(out):
+            out.block_until_ready()
+            return jax.device_get(out)
+    """)
+    assert rules_of(findings) == ["QW001", "QW001"]
+
+
+def test_qw001_scoped_to_hot_path_modules(tmp_path):
+    # the same snippet inside quickwit_tpu/ but NOT in a hot-path module
+    # must not fire
+    pkg = tmp_path / "quickwit_tpu" / "metastore"
+    pkg.mkdir(parents=True)
+    (pkg / "cold.py").write_text("def f(x):\n    return float(x)\n")
+    assert analyze_paths([str(tmp_path)], root=str(tmp_path)) == []
+
+
+# --- QW002 recompilation-hazard ----------------------------------------------
+
+def test_qw002_flags_jit_inside_function(tmp_path):
+    findings = lint(tmp_path, """
+        import jax
+
+        def per_query(fn, x):
+            compiled = jax.jit(fn)
+            return compiled(x)
+    """)
+    assert rules_of(findings) == ["QW002"]
+
+
+def test_qw002_flags_immediately_invoked_jit(tmp_path):
+    findings = lint(tmp_path, """
+        import jax
+
+        def per_query(fn, x):
+            return jax.jit(fn)(x)
+    """)
+    assert rules_of(findings) == ["QW002"]
+
+
+def test_qw002_allows_module_level_builder_and_cache(tmp_path):
+    findings = lint(tmp_path, """
+        import functools
+        import jax
+
+        TOPK = jax.jit(sum)
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def kernel(x, k):
+            return x[:k]
+
+        def build(fn):
+            return jax.jit(fn)     # returned to a caching caller
+
+        _JIT_CACHE = {}
+
+        def get(fn, key):
+            if key not in _JIT_CACHE:
+                _JIT_CACHE[key] = jax.jit(fn)
+            return _JIT_CACHE[key]
+    """)
+    assert findings == []
+
+
+def test_qw002_flags_runtime_static_argnums(tmp_path):
+    findings = lint(tmp_path, """
+        import jax
+
+        def build(fn, request):
+            nums = request.static_positions
+            return jax.jit(fn, static_argnums=nums)
+    """)
+    assert rules_of(findings) == ["QW002"]
+
+
+# --- QW003 ambient-context-propagation ---------------------------------------
+
+def test_qw003_flags_bare_thread_and_pool_submit(tmp_path):
+    findings = lint(tmp_path, """
+        import threading
+
+        def go(pool, work):
+            threading.Thread(target=work).start()
+            pool.submit(work, 1)
+    """)
+    assert rules_of(findings) == ["QW003", "QW003"]
+
+
+def test_qw003_allows_wrapped_callables_and_task_queues(tmp_path):
+    findings = lint(tmp_path, """
+        import threading
+        from quickwit_tpu.common.ctx import run_with_context
+
+        def go(pool, compactor, work, task):
+            threading.Thread(target=run_with_context(work)).start()
+            pool.submit(run_with_context(work), 1)
+            spawned = run_with_context(work)
+            threading.Thread(target=spawned).start()  # name, wrapped above
+            compactor.submit(task)  # work queue, not an executor
+    """)
+    assert findings == []
+
+
+# --- QW004 swallowed-control-flow --------------------------------------------
+
+def test_qw004_flags_broad_except(tmp_path):
+    findings = lint(tmp_path, """
+        def leaf_search(run):
+            try:
+                return run()
+            except Exception as exc:
+                return None
+    """)
+    assert rules_of(findings) == ["QW004"]
+
+
+def test_qw004_allows_typed_guard_reraise_and_classifier(tmp_path):
+    findings = lint(tmp_path, """
+        from quickwit_tpu.common.deadline import DeadlineExceeded
+        from quickwit_tpu.tenancy.overload import OverloadShed
+
+        def guarded(run):
+            try:
+                return run()
+            except (OverloadShed, DeadlineExceeded):
+                raise
+            except Exception:
+                return None
+
+        def reraises(run):
+            try:
+                return run()
+            except Exception:
+                raise
+
+        def classifies(run, is_deadline_error):
+            try:
+                return run()
+            except Exception as exc:
+                if is_deadline_error(exc):
+                    raise
+                return None
+    """)
+    assert findings == []
+
+
+def test_qw004_scoped_to_query_path_modules(tmp_path):
+    pkg = tmp_path / "quickwit_tpu" / "indexing"
+    pkg.mkdir(parents=True)
+    (pkg / "pipeline.py").write_text(
+        "def f(run):\n"
+        "    try:\n"
+        "        return run()\n"
+        "    except Exception:\n"
+        "        return None\n")
+    assert analyze_paths([str(tmp_path)], root=str(tmp_path)) == []
+
+
+# --- QW005 metrics-hygiene ---------------------------------------------------
+
+def test_qw005_flags_prefix_cardinality_and_fstring(tmp_path):
+    findings = lint(tmp_path, """
+        from quickwit_tpu.observability.metrics import METRICS
+
+        _BAD = METRICS.counter("searches_total", "no prefix")
+        _OK = METRICS.counter("qw_searches_total", "prefixed")
+
+        def observe(request):
+            _OK.inc(split_id=request.split_id)
+            _OK.inc(stage=f"leaf-{request.ordinal}")
+    """)
+    assert rules_of(findings) == ["QW005", "QW005", "QW005"]
+
+
+def test_qw005_duplicate_registration_across_files(tmp_path):
+    (tmp_path / "a.py").write_text(
+        'from quickwit_tpu.observability.metrics import METRICS\n'
+        '_A = METRICS.counter("qw_dup_total", "first")\n')
+    (tmp_path / "b.py").write_text(
+        'from quickwit_tpu.observability.metrics import METRICS\n'
+        '_B = METRICS.counter("qw_dup_total", "second")\n')
+    findings = analyze_paths([str(tmp_path)], root=str(tmp_path))
+    assert rules_of(findings) == ["QW005"]
+    assert findings[0].path == "b.py"  # the LATER registration is flagged
+
+
+def test_qw005_bounded_labels_ok(tmp_path):
+    findings = lint(tmp_path, """
+        from quickwit_tpu.observability.metrics import METRICS
+
+        _OK = METRICS.counter("qw_ok_total", "fine")
+
+        def observe():
+            _OK.inc(stage="leaf", outcome="hit")
+    """)
+    assert findings == []
+
+
+# --- suppression scopes ------------------------------------------------------
+
+def test_suppression_same_line(tmp_path):
+    findings = lint(tmp_path, """
+        def hot(x):
+            return float(x)  # qwlint: disable=QW001 - host numpy input
+    """)
+    assert findings == []
+
+
+def test_suppression_next_line_spans_comment_block(tmp_path):
+    findings = lint(tmp_path, """
+        def leaf(run):
+            try:
+                return run()
+            # qwlint: disable-next-line=QW004 - justification prose that
+            # wraps across several comment lines before the handler
+            except Exception:
+                return None
+    """)
+    assert findings == []
+
+
+def test_suppression_def_level_covers_whole_function(tmp_path):
+    findings = lint(tmp_path, """
+        # qwlint: disable-next-line=QW001 - whole function is host-side
+        def finalize(xs):
+            return [float(x) for x in xs] + [x.item() for x in xs]
+    """)
+    assert findings == []
+
+
+def test_suppression_file_level(tmp_path):
+    findings = lint(tmp_path, """
+        # qwlint: disable-file=QW001
+        def hot(x):
+            return float(x)
+    """)
+    assert findings == []
+
+
+def test_suppression_only_silences_named_rule(tmp_path):
+    findings = lint(tmp_path, """
+        def hot(x):
+            return float(x)  # qwlint: disable=QW004 - wrong rule id
+    """)
+    assert rules_of(findings) == ["QW001"]
+
+
+# --- baseline round-trip -----------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text("def hot(x):\n    return float(x)\n")
+    findings = analyze_paths([str(src)], root=str(tmp_path))
+    assert rules_of(findings) == ["QW001"]
+
+    baseline_path = tmp_path / "baseline.json"
+    write_baseline(findings, str(baseline_path))
+    entries = load_baseline(str(baseline_path))
+    new, stale = apply_baseline(findings, entries)
+    assert new == [] and stale == []
+
+    # a SECOND finding in the same function exceeds the baselined count:
+    # the whole group resurfaces (regression signal)
+    src.write_text("def hot(x):\n    a = float(x)\n    b = int(x)\n"
+                   "    return a, b\n")
+    findings = analyze_paths([str(src)], root=str(tmp_path))
+    new, stale = apply_baseline(findings, entries)
+    assert len(new) == 2 and all("baselined" in f.message for f in new)
+
+    # fixing the site makes the entry stale, not silently ignored
+    src.write_text("def hot(x):\n    return x\n")
+    new, stale = apply_baseline(
+        analyze_paths([str(src)], root=str(tmp_path)), entries)
+    assert new == [] and len(stale) == 1
+
+
+def test_baseline_keys_survive_line_churn(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text("def hot(x):\n    return float(x)\n")
+    findings = analyze_paths([str(src)], root=str(tmp_path))
+    baseline_path = tmp_path / "baseline.json"
+    write_baseline(findings, str(baseline_path))
+    # shift the finding down 20 lines: the (rule, path, function) key is
+    # line-free, so the baseline still matches
+    src.write_text("\n" * 20 + "def hot(x):\n    return float(x)\n")
+    new, stale = apply_baseline(
+        analyze_paths([str(src)], root=str(tmp_path)),
+        load_baseline(str(baseline_path)))
+    assert new == [] and stale == []
+
+
+def test_baseline_rejects_malformed_entries(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"entries": [{"rule": "QW001"}]}))
+    with pytest.raises(LintError):
+        load_baseline(str(bad))
+
+
+def test_syntax_error_is_lint_error(tmp_path):
+    src = tmp_path / "broken.py"
+    src.write_text("def f(:\n")
+    with pytest.raises(LintError):
+        analyze_paths([str(src)], root=str(tmp_path))
+
+
+# --- CLI contract ------------------------------------------------------------
+
+def test_cli_exit_codes(tmp_path):
+    from tools.qwlint.__main__ import main
+    clean = tmp_path / "clean.py"
+    clean.write_text("def f(x):\n    return x\n")
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("def f(x):\n    return float(x)\n")
+    assert main([str(clean), "--no-baseline"]) == 0
+    assert main([str(dirty), "--no-baseline"]) == 1
+    baseline = tmp_path / "b.json"
+    assert main([str(dirty), "--write-baseline", str(baseline)]) == 0
+    assert main([str(dirty), "--baseline", str(baseline)]) == 0
+    assert main([str(dirty), "--baseline", str(tmp_path / "nope.json")]) == 2
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    assert main([str(broken), "--no-baseline"]) == 2
+
+
+def test_cli_json_output(tmp_path, capsys):
+    from tools.qwlint.__main__ import main
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("def f(x):\n    return float(x)\n")
+    assert main([str(dirty), "--no-baseline", "--json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload[0]["rule"] == "QW001"
+    assert payload[0]["function"] == "f"

@@ -1,0 +1,40 @@
+"""qwlint — codebase-specific static analysis for quickwit_tpu.
+
+An AST-based analyzer (stdlib only) that encodes this repo's invariants
+as lint rules. The two historical bug classes it targets are exactly the
+ones PR 5 and ROADMAP item 1 paid for at runtime: typed control-flow
+exceptions (deadline expiry, `OverloadShed`, `TenantRateLimited`,
+injected faults) swallowed by broad `except Exception` catches, and
+silent device→host readbacks (`float()` on a traced value is a full
+`block_until_ready`) hiding in hot-path code.
+
+Rules:
+    QW001 hidden-host-readback        (hot-path modules only)
+    QW002 recompilation-hazard        (per-call `jax.jit`, dynamic statics)
+    QW003 ambient-context-propagation (bare callables across thread hops)
+    QW004 swallowed-control-flow      (broad excepts on the query path)
+    QW005 metrics-hygiene             (qw_ prefix, duplicates, cardinality)
+
+Suppression: `# qwlint: disable=QW001` on the flagged line, on the
+enclosing `def` line (covers the whole function), or
+`# qwlint: disable-file=QW001` anywhere in the file (covers the file).
+Grandfathered findings live in `tools/qwlint/baseline.json`, keyed by
+(rule, path, function) with a count and a one-line justification — line
+numbers are deliberately NOT part of the key so unrelated edits don't
+churn the baseline, while any NEW finding in the same function trips it.
+
+CLI: `python -m tools.qwlint quickwit_tpu/ [--baseline FILE] [--json]`.
+Exit codes: 0 clean, 1 findings, 2 usage/internal error.
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    FileContext,
+    analyze_file,
+    analyze_paths,
+    apply_baseline,
+    default_baseline_path,
+    load_baseline,
+    write_baseline,
+)
+from .rules import RULES, RULE_DOCS  # noqa: F401

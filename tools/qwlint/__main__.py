@@ -1,0 +1,93 @@
+"""CLI: `python -m tools.qwlint [paths...]`.
+
+Exit-code contract (consumed by tests/test_qwlint.py and CI):
+    0  no findings beyond the baseline
+    1  at least one new finding
+    2  usage error or unanalyzable input (syntax error)
+
+The checked-in baseline (tools/qwlint/baseline.json) is applied by
+default; `--no-baseline` shows everything, `--baseline FILE` swaps it,
+`--write-baseline FILE` regenerates one (carrying over justifications
+for keys that still match) for the adopt-then-ratchet workflow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import (LintError, analyze_paths, apply_baseline,
+                   default_baseline_path, load_baseline, write_baseline)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="qwlint",
+        description="codebase-specific static analysis for quickwit_tpu")
+    parser.add_argument("paths", nargs="*", default=["quickwit_tpu"],
+                        help="files or directories to lint "
+                             "(default: quickwit_tpu)")
+    parser.add_argument("--root", default=None,
+                        help="directory finding paths are relative to "
+                             "(default: cwd)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline JSON to grandfather findings "
+                             "(default: tools/qwlint/baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore any baseline; report every finding")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit findings as a JSON array on stdout")
+    parser.add_argument("--write-baseline", default=None, metavar="FILE",
+                        help="write a baseline covering current findings "
+                             "and exit 0")
+    args = parser.parse_args(argv)
+
+    paths = args.paths or ["quickwit_tpu"]
+    try:
+        findings = analyze_paths(paths, root=args.root)
+    except LintError as exc:
+        print(f"qwlint: error: {exc}", file=sys.stderr)
+        return 2
+
+    entries = []
+    if not args.no_baseline:
+        baseline_path = args.baseline or default_baseline_path()
+        if os.path.exists(baseline_path):
+            try:
+                entries = load_baseline(baseline_path)
+            except (LintError, json.JSONDecodeError, OSError) as exc:
+                print(f"qwlint: bad baseline {baseline_path}: {exc}",
+                      file=sys.stderr)
+                return 2
+        elif args.baseline is not None:
+            print(f"qwlint: baseline not found: {baseline_path}",
+                  file=sys.stderr)
+            return 2
+
+    if args.write_baseline:
+        write_baseline(findings, args.write_baseline, previous=entries)
+        print(f"qwlint: wrote baseline with {len(findings)} findings to "
+              f"{args.write_baseline}")
+        return 0
+
+    new, stale = apply_baseline(findings, entries)
+
+    if args.as_json:
+        print(json.dumps([f.to_dict() for f in new], indent=2))
+    else:
+        for finding in new:
+            print(finding.render())
+        for entry in stale:
+            print(f"qwlint: note: stale baseline entry (fixed? remove it): "
+                  f"{entry['rule']} {entry['path']} {entry['function']}",
+                  file=sys.stderr)
+        baselined = len(findings) - len(new)
+        print(f"qwlint: {len(new)} finding(s), {baselined} baselined, "
+              f"{len(stale)} stale baseline entr(y/ies)", file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,324 @@
+"""qwlint engine: file loading, suppression comments, baseline, runner.
+
+Rules live in `tools/qwlint/rules.py`; this module owns everything
+rule-independent so adding a rule never touches the engine (see
+docs/static-analysis.md, "how to add a rule").
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+_DISABLE_RE = re.compile(r"qwlint:\s*disable(?P<scope>-file|-next-line)?"
+                         r"\s*=\s*(?P<ids>QW\d{3}(?:\s*,\s*QW\d{3})*)")
+_RULE_ID_RE = re.compile(r"QW\d{3}")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str        # posix path relative to the analysis root
+    line: int
+    col: int
+    function: str    # dotted qualname of the enclosing def, or "<module>"
+    message: str
+
+    def key(self) -> tuple:
+        """Baseline identity: line numbers excluded on purpose so edits
+        above a grandfathered site don't churn the baseline."""
+        return (self.rule, self.path, self.function)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.function}] {self.message}")
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "function": self.function,
+                "message": self.message}
+
+
+class LintError(Exception):
+    """Unanalyzable input (syntax error, undecodable file)."""
+
+
+def _parse_suppressions(source: str) -> tuple[dict[int, set], set]:
+    """(line -> disabled rule ids, file-level disabled ids) from
+    `# qwlint: disable=QW0xx[,QW0yy]` (this line),
+    `# qwlint: disable-next-line=QW0xx` (the line below — for lines whose
+    trailing-comment budget is spent) and `# qwlint: disable-file=QW0xx`
+    comments. Trailing prose after the ids (a justification) is allowed."""
+    per_line: dict[int, set] = {}
+    whole_file: set = set()
+    comment_only: set = set()
+    pending_next: dict[int, set] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            if tok.line[:tok.start[1]].strip() == "":
+                comment_only.add(tok.start[0])
+            match = _DISABLE_RE.search(tok.string)
+            if not match:
+                continue
+            ids = set(_RULE_ID_RE.findall(match.group("ids")))
+            scope = match.group("scope")
+            if scope == "-file":
+                whole_file |= ids
+            elif scope == "-next-line":
+                pending_next.setdefault(tok.start[0], set()).update(ids)
+            else:
+                per_line.setdefault(tok.start[0], set()).update(ids)
+    except tokenize.TokenError:
+        pass  # partial comment map beats refusing to lint
+    # "-next-line" targets the next CODE line: a justification wrapped
+    # over several comment lines still lands on the statement below it
+    for comment_line, ids in pending_next.items():
+        target = comment_line + 1
+        while target in comment_only:
+            target += 1
+        per_line.setdefault(target, set()).update(ids)
+    return per_line, whole_file
+
+
+def _annotate(tree: ast.AST) -> dict[int, ast.AST]:
+    """Stamp every node with its enclosing qualname (`_qw_qual`), the def
+    line numbers of the enclosing function stack (`_qw_funcs`) and its
+    parent node (`_qw_parent`). Returns {def lineno -> FunctionDef}."""
+    defs: dict[int, ast.AST] = {}
+
+    def walk(node: ast.AST, qual: str, funcs: tuple) -> None:
+        node._qw_qual = qual or "<module>"  # type: ignore[attr-defined]
+        node._qw_funcs = funcs              # type: ignore[attr-defined]
+        for child in ast.iter_child_nodes(node):
+            child._qw_parent = node         # type: ignore[attr-defined]
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = f"{qual}.{child.name}" if qual else child.name
+                defs[child.lineno] = child
+                child._qw_qual = name       # type: ignore[attr-defined]
+                child._qw_funcs = funcs + (child.lineno,)  # type: ignore
+                # decorators run in the ENCLOSING scope at def time (a
+                # module-level @partial(jax.jit, ...) compiles once, not
+                # per call) — only the body belongs to the new function
+                decorators = {id(d) for d in child.decorator_list}
+                for sub in ast.iter_child_nodes(child):
+                    sub._qw_parent = child  # type: ignore[attr-defined]
+                    if id(sub) in decorators:
+                        walk(sub, qual, funcs)
+                    else:
+                        walk(sub, name, funcs + (child.lineno,))
+            elif isinstance(child, ast.ClassDef):
+                name = f"{qual}.{child.name}" if qual else child.name
+                walk(child, name, funcs)
+            else:
+                walk(child, qual, funcs)
+
+    tree._qw_parent = None  # type: ignore[attr-defined]
+    walk(tree, "", ())
+    return defs
+
+
+class FileContext:
+    """Everything a rule needs about one file: the annotated tree, the
+    suppression map, and a `shared` dict for cross-file rule state (the
+    runner hands every file the same instance)."""
+
+    def __init__(self, path: str, relpath: str, source: str,
+                 shared: Optional[dict] = None):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        try:
+            self.tree = ast.parse(source)
+        except SyntaxError as exc:
+            raise LintError(f"{relpath}: {exc}") from exc
+        self.line_disables, self.file_disables = _parse_suppressions(source)
+        self.defs_by_line = _annotate(self.tree)
+        self.shared = shared if shared is not None else {}
+        self.findings: list[Finding] = []
+
+    # -- helpers for rules -------------------------------------------------
+    def in_package_scope(self, patterns: Iterable[str]) -> bool:
+        """True when this file is inside the named quickwit_tpu modules —
+        or OUTSIDE quickwit_tpu entirely (fixture snippets and ad-hoc CLI
+        targets are always in scope, so the rules stay testable)."""
+        if "quickwit_tpu/" not in self.relpath:
+            return True
+        return any(p in self.relpath for p in patterns)
+
+    def suppressed(self, rule: str, node: ast.AST) -> bool:
+        if rule in self.file_disables:
+            return True
+        lines = {getattr(node, "lineno", 0)}
+        lines.update(getattr(node, "_qw_funcs", ()))
+        return any(rule in self.line_disables.get(line, ())
+                   for line in lines)
+
+    def add(self, rule: str, node: ast.AST, message: str) -> None:
+        if self.suppressed(rule, node):
+            return
+        self.findings.append(Finding(
+            rule=rule, path=self.relpath,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            function=getattr(node, "_qw_qual", "<module>"),
+            message=message))
+
+    def enclosing_def(self, node: ast.AST) -> Optional[ast.AST]:
+        funcs = getattr(node, "_qw_funcs", ())
+        return self.defs_by_line.get(funcs[-1]) if funcs else None
+
+    def enclosing_defs(self, node: ast.AST) -> list[ast.AST]:
+        return [self.defs_by_line[line]
+                for line in getattr(node, "_qw_funcs", ())
+                if line in self.defs_by_line]
+
+    def statement_of(self, node: ast.AST) -> Optional[ast.stmt]:
+        cur: Optional[ast.AST] = node
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = getattr(cur, "_qw_parent", None)
+        return cur
+
+
+def dotted_name(node: ast.AST) -> str:
+    """`np.asarray` → "np.asarray"; unknown bases collapse to the attr
+    chain that IS resolvable (`x[0].foo.item` → "item")."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+def last_segment(node: ast.AST) -> str:
+    name = dotted_name(node)
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+# --- runner -----------------------------------------------------------------
+
+def _iter_py_files(path: str):
+    if os.path.isfile(path):
+        yield path
+        return
+    for base, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs
+                         if d not in ("__pycache__", ".git"))
+        for name in sorted(files):
+            if name.endswith(".py"):
+                yield os.path.join(base, name)
+
+
+def analyze_file(path: str, root: Optional[str] = None,
+                 shared: Optional[dict] = None) -> list[Finding]:
+    from .rules import RULES
+    root = root or os.getcwd()
+    relpath = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    ctx = FileContext(path, relpath, source, shared=shared)
+    for rule in RULES:
+        rule.check(ctx)
+    return ctx.findings
+
+
+def analyze_paths(paths: Iterable[str],
+                  root: Optional[str] = None) -> list[Finding]:
+    """Lint every .py file under `paths`; relative paths in findings are
+    against `root` (default: cwd, which the CLI sets to the repo root)."""
+    from .rules import RULES
+    shared: dict = {}
+    findings: list[Finding] = []
+    errors: list[str] = []
+    for path in paths:
+        for file_path in _iter_py_files(path):
+            try:
+                findings.extend(analyze_file(file_path, root=root,
+                                             shared=shared))
+            except LintError as exc:
+                errors.append(str(exc))
+    for rule in RULES:
+        finalize = getattr(rule, "finalize", None)
+        if finalize is not None:
+            findings.extend(finalize(shared))
+    if errors:
+        raise LintError("; ".join(errors))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+# --- baseline ---------------------------------------------------------------
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load_baseline(path: str) -> list[dict]:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    entries = data["entries"] if isinstance(data, dict) else data
+    for entry in entries:
+        for key in ("rule", "path", "function"):
+            if key not in entry:
+                raise LintError(f"baseline entry missing {key!r}: {entry}")
+        entry.setdefault("count", 1)
+        entry.setdefault("why", "")
+    return entries
+
+
+def apply_baseline(findings: list[Finding], entries: list[dict]
+                   ) -> tuple[list[Finding], list[dict]]:
+    """(new findings, stale entries). A finding is grandfathered while its
+    (rule, path, function) key has remaining baseline count; if a function
+    accrues MORE findings than its baselined count, every one of them is
+    reported (an honest "this function regressed" signal beats guessing
+    which of n identical-keyed findings is the new one)."""
+    allowed: dict[tuple, int] = {}
+    for entry in entries:
+        key = (entry["rule"], entry["path"], entry["function"])
+        allowed[key] = allowed.get(key, 0) + int(entry["count"])
+    by_key: dict[tuple, list[Finding]] = {}
+    for finding in findings:
+        by_key.setdefault(finding.key(), []).append(finding)
+    new: list[Finding] = []
+    for key, group in by_key.items():
+        if len(group) > allowed.get(key, 0):
+            if allowed.get(key, 0):
+                note = (f" ({len(group)} findings vs {allowed[key]} "
+                        f"baselined in this function)")
+                group = [Finding(f.rule, f.path, f.line, f.col, f.function,
+                                 f.message + note) for f in group]
+            new.extend(group)
+    seen_keys = set(by_key)
+    stale = [entry for entry in entries
+             if (entry["rule"], entry["path"], entry["function"])
+             not in seen_keys]
+    return (sorted(new, key=lambda f: (f.path, f.line, f.col, f.rule)),
+            stale)
+
+
+def write_baseline(findings: list[Finding], path: str,
+                   previous: Optional[list[dict]] = None) -> None:
+    """Emit a baseline covering `findings`, carrying over `why` text from
+    a previous baseline where the key still matches."""
+    whys: dict[tuple, str] = {}
+    for entry in previous or []:
+        whys[(entry["rule"], entry["path"], entry["function"])] = \
+            entry.get("why", "")
+    counts: dict[tuple, int] = {}
+    for finding in findings:
+        counts[finding.key()] = counts.get(finding.key(), 0) + 1
+    entries = [{"rule": rule, "path": rel, "function": func, "count": count,
+                "why": whys.get((rule, rel, func), "TODO: justify or fix")}
+               for (rule, rel, func), count in sorted(counts.items())]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"entries": entries}, fh, indent=2)
+        fh.write("\n")

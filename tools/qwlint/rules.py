@@ -1,0 +1,391 @@
+"""The five qwlint rules. Each rule is an object with `id`, `title`, and
+`check(ctx: FileContext)`; cross-file rules may also define
+`finalize(shared) -> list[Finding]` which the runner calls once after
+every file has been checked."""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import FileContext, Finding, dotted_name, last_segment
+
+# --- QW001 hidden-host-readback ---------------------------------------------
+
+_HOT_PATH_MODULES = (
+    "quickwit_tpu/ops/",
+    "quickwit_tpu/search/executor.py",
+    "quickwit_tpu/search/leaf.py",
+    "quickwit_tpu/search/collector.py",
+    "quickwit_tpu/search/plan.py",
+)
+
+_READBACK_BUILTINS = {"float", "int", "bool"}
+_READBACK_METHODS = {"item", "block_until_ready"}
+_READBACK_DOTTED = {"np.asarray", "numpy.asarray", "jax.device_get"}
+
+
+def _is_constantish(node: ast.AST) -> bool:
+    """Literals and signed literals: `float("-inf")`, `int(-1)` are host
+    constants, not readbacks."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.operand,
+                                                    ast.Constant):
+        return True
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return all(_is_constantish(e) for e in node.elts)
+    return False
+
+
+class HiddenHostReadback:
+    id = "QW001"
+    title = "hidden-host-readback"
+
+    def check(self, ctx: FileContext) -> None:
+        if not ctx.in_package_scope(_HOT_PATH_MODULES):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not getattr(node, "_qw_funcs", ()):
+                continue  # module level runs at import time, not per query
+            func = node.func
+            if (isinstance(func, ast.Name)
+                    and func.id in _READBACK_BUILTINS
+                    and len(node.args) == 1 and not node.keywords
+                    and not _is_constantish(node.args[0])):
+                ctx.add(self.id, node,
+                        f"{func.id}() on a possibly-device value forces a "
+                        "device→host sync (ROADMAP item 1: readback_wait_ms "
+                        "dominates the hot path); compute on device, move "
+                        "it behind the packed readback seam, or suppress "
+                        "with a justification if the value is already host "
+                        "numpy")
+                continue
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in _READBACK_METHODS
+                    and not node.args and not node.keywords):
+                ctx.add(self.id, node,
+                        f".{func.attr}() blocks on device completion and "
+                        "copies to host; hot-path code must batch "
+                        "readbacks through the packed seam "
+                        "(search/executor.py::readback_plan_result)")
+                continue
+            name = dotted_name(func)
+            if name in _READBACK_DOTTED and node.args \
+                    and not _is_constantish(node.args[0]):
+                ctx.add(self.id, node,
+                        f"{name}() materializes its argument on host — a "
+                        "silent transfer when the argument is a device "
+                        "array; keep hot-path data device-resident")
+
+
+# --- QW002 recompilation-hazard ---------------------------------------------
+
+_CACHE_NAME_RE = re.compile(r"_CACHE")
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    name = dotted_name(node.func)
+    if name in ("jax.jit", "jit"):
+        return True
+    # functools.partial(jax.jit, ...) builds a jit factory
+    if last_segment(node.func) == "partial" and node.args:
+        return dotted_name(node.args[0]) in ("jax.jit", "jit")
+    return False
+
+
+class RecompilationHazard:
+    id = "QW002"
+    title = "recompilation-hazard"
+
+    def check(self, ctx: FileContext) -> None:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not _is_jit_call(node):
+                continue
+            self._check_static_args(ctx, node)
+            if not getattr(node, "_qw_funcs", ()):
+                continue  # module-level jit compiles once per process
+            parent = getattr(node, "_qw_parent", None)
+            if isinstance(parent, ast.Call) and parent.func is node:
+                ctx.add(self.id, node,
+                        "jax.jit(...)(...) creates and invokes a fresh "
+                        "compiled callable per call — every query "
+                        "recompiles; hoist the jitted callable to module "
+                        "level or memoize it in a plan-keyed cache "
+                        "(executor.py _JIT_CACHE pattern)")
+                continue
+            if self._reaches_cache(ctx, node):
+                continue
+            ctx.add(self.id, node,
+                    "jax.jit created inside a function without a "
+                    "*_CACHE store or builder return — if this runs per "
+                    "query, each call pays a full XLA compile; memoize "
+                    "keyed by plan structure, never by request values")
+
+    @staticmethod
+    def _check_static_args(ctx: FileContext, node: ast.Call) -> None:
+        for kw in node.keywords:
+            if kw.arg not in ("static_argnums", "static_argnames"):
+                continue
+            if not _static_spec_is_literal(kw.value):
+                ctx.add(RecompilationHazard.id, node,
+                        f"{kw.arg} computed at runtime: request-derived "
+                        "values in static positions key the jit cache — "
+                        "every distinct per-query value triggers a "
+                        "recompile; statics must be plan-structure "
+                        "constants")
+
+    @staticmethod
+    def _reaches_cache(ctx: FileContext, node: ast.Call) -> bool:
+        """The builder idioms that are NOT hazards: the jit object is
+        returned to a caller that caches it, or the enclosing function
+        itself touches a *_CACHE name (memoizing getter)."""
+        stmt = ctx.statement_of(node)
+        if isinstance(stmt, ast.Return):
+            return True
+        for fn in ctx.enclosing_defs(node):
+            for inner in ast.walk(fn):
+                if isinstance(inner, ast.Name) \
+                        and _CACHE_NAME_RE.search(inner.id):
+                    return True
+        return False
+
+
+def _static_spec_is_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(isinstance(e, ast.Constant) for e in node.elts)
+    return False
+
+
+# --- QW003 ambient-context-propagation --------------------------------------
+
+_CTX_WRAPPERS = {"run_with_context", "bind_deadline", "bind_tenant",
+                 "bind_profile"}
+
+
+def _wrapped_names(tree: ast.AST) -> set[str]:
+    """Names assigned from a wrapper call (`run = run_with_context(f)`) are
+    wrapped callables too — the spawn site may be lines away."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        value = getattr(node, "value", None)
+        if not (isinstance(node, (ast.Assign, ast.AnnAssign))
+                and isinstance(value, ast.Call)
+                and last_segment(value.func) in _CTX_WRAPPERS):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        names.update(t.id for t in targets if isinstance(t, ast.Name))
+    return names
+
+
+_POOL_RECEIVER_RE = re.compile(r"pool|executor", re.IGNORECASE)
+
+
+def _is_pool_receiver(node: ast.AST) -> bool:
+    """`.submit` is only a thread hop on pools/executors — a work-queue
+    `.submit(task)` (compaction supervisor) takes data, not a callable."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    return bool(_POOL_RECEIVER_RE.search(last_segment(node) or ""))
+
+
+def _is_wrapped_callable(node: ast.AST, wrapped: set[str]) -> bool:
+    if isinstance(node, ast.Call) \
+            and last_segment(node.func) in _CTX_WRAPPERS:
+        return True
+    return isinstance(node, ast.Name) and node.id in wrapped
+
+
+class AmbientContextPropagation:
+    id = "QW003"
+    title = "ambient-context-propagation"
+
+    def check(self, ctx: FileContext) -> None:
+        wrapped = _wrapped_names(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if last_segment(node.func) == "Thread":
+                target = next((kw.value for kw in node.keywords
+                               if kw.arg == "target"), None)
+                if target is not None \
+                        and not _is_wrapped_callable(target, wrapped):
+                    ctx.add(self.id, node,
+                            "threading.Thread(target=...) with a bare "
+                            "callable: the new thread starts with EMPTY "
+                            "contextvars, silently dropping the caller's "
+                            "deadline/tenant/profile bindings — wrap the "
+                            "target with common.ctx.run_with_context (or "
+                            "suppress if the thread never serves a query)")
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "submit" and node.args
+                    and _is_pool_receiver(node.func.value)):
+                if not _is_wrapped_callable(node.args[0], wrapped):
+                    ctx.add(self.id, node,
+                            "executor.submit(fn, ...) with a bare "
+                            "callable: pool worker threads do not inherit "
+                            "contextvars — deadline/tenant/profile vanish "
+                            "across the hop; wrap fn with "
+                            "common.ctx.run_with_context")
+
+
+# --- QW004 swallowed-control-flow -------------------------------------------
+
+_QUERY_PATH_MODULES = (
+    "quickwit_tpu/search/",
+    "quickwit_tpu/serve/",
+    "quickwit_tpu/storage/",
+    "quickwit_tpu/parallel/",
+)
+
+_TYPED_CONTROL_FLOW = {"OverloadShed", "TenantRateLimited",
+                       "DeadlineExceeded", "InjectedFault"}
+# calling one of these inside the handler counts as classifying the
+# exception rather than swallowing it
+_CLASSIFIER_HELPERS = {"is_deadline_error", "classify_exception"}
+
+_BROAD_NAMES = {"Exception", "BaseException"}
+
+
+def _handler_type_names(handler: ast.ExceptHandler) -> set[str]:
+    if handler.type is None:
+        return set()
+    nodes = handler.type.elts if isinstance(handler.type, ast.Tuple) \
+        else [handler.type]
+    return {last_segment(n) for n in nodes}
+
+
+def _references_control_flow(handler: ast.ExceptHandler) -> bool:
+    wanted = _TYPED_CONTROL_FLOW | _CLASSIFIER_HELPERS
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise) and node.exc is None:
+            return True  # bare re-raise
+        if isinstance(node, ast.Name) and node.id in wanted:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in wanted:
+            return True
+    return False
+
+
+class SwallowedControlFlow:
+    id = "QW004"
+    title = "swallowed-control-flow"
+
+    def check(self, ctx: FileContext) -> None:
+        if not ctx.in_package_scope(_QUERY_PATH_MODULES):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            shielded = False
+            for handler in node.handlers:
+                names = _handler_type_names(handler)
+                if names & _TYPED_CONTROL_FLOW:
+                    shielded = True  # typed clause runs before the broad one
+                    continue
+                is_broad = handler.type is None or names & _BROAD_NAMES
+                if not is_broad or shielded:
+                    continue
+                if _references_control_flow(handler):
+                    continue
+                ctx.add(self.id, handler,
+                        "broad except on the query path swallows typed "
+                        "control-flow exceptions (DeadlineExceeded, "
+                        "OverloadShed, TenantRateLimited, InjectedFault) "
+                        "into generic failures — re-raise them first "
+                        "(`except (OverloadShed, TenantRateLimited): "
+                        "raise`) or classify inside the handler")
+
+
+# --- QW005 metrics-hygiene --------------------------------------------------
+
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+_METRIC_OBSERVERS = {"inc", "observe", "set", "add"}
+_METRIC_RECEIVER_RE = re.compile(r"^_?[A-Z][A-Z0-9_]*$")
+_HIGH_CARDINALITY_LABELS = {"query", "query_str", "doc_id", "split_id",
+                            "trace_id", "span_id", "request_id", "path",
+                            "uri", "url", "user", "opaque_id"}
+
+
+class MetricsHygiene:
+    id = "QW005"
+    title = "metrics-hygiene"
+
+    def check(self, ctx: FileContext) -> None:
+        registrations = ctx.shared.setdefault("qw005_registrations", [])
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in _METRIC_FACTORIES
+                    and last_segment(func.value) == "METRICS"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                name = node.args[0].value
+                if not name.startswith("qw_"):
+                    ctx.add(self.id, node,
+                            f"metric {name!r} is not qw_-prefixed — every "
+                            "exported series must carry the namespace "
+                            "prefix (reference: quickwit-metrics "
+                            "new_counter! conventions)")
+                registrations.append({
+                    "name": name, "path": ctx.relpath,
+                    "function": getattr(node, "_qw_qual", "<module>"),
+                    "line": node.lineno, "col": node.col_offset,
+                    "suppressed": ctx.suppressed(self.id, node)})
+                continue
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in _METRIC_OBSERVERS
+                    and isinstance(func.value, ast.Name)
+                    and _METRIC_RECEIVER_RE.match(func.value.id)):
+                for kw in node.keywords:
+                    if kw.arg in _HIGH_CARDINALITY_LABELS:
+                        ctx.add(self.id, node,
+                                f"label {kw.arg!r} is unbounded per-query "
+                                "cardinality — each distinct value mints "
+                                "a new series; aggregate, hash-bucket, or "
+                                "drop the label (tenancy/registry.py "
+                                "shows the bounded pattern)")
+                    elif isinstance(kw.value, ast.JoinedStr):
+                        ctx.add(self.id, node,
+                                f"f-string value for label {kw.arg!r}: "
+                                "interpolated label values are an "
+                                "unbounded-cardinality trap; use a small "
+                                "closed vocabulary")
+
+    def finalize(self, shared: dict) -> list[Finding]:
+        by_name: dict[str, list[dict]] = {}
+        for reg in shared.get("qw005_registrations", []):
+            by_name.setdefault(reg["name"], []).append(reg)
+        findings = []
+        for name, regs in sorted(by_name.items()):
+            if len(regs) < 2:
+                continue
+            regs.sort(key=lambda r: (r["path"], r["line"]))
+            first = regs[0]
+            for reg in regs[1:]:
+                if reg["suppressed"]:
+                    continue
+                findings.append(Finding(
+                    rule=self.id, path=reg["path"], line=reg["line"],
+                    col=reg["col"], function=reg["function"],
+                    message=(f"metric {name!r} already registered at "
+                             f"{first['path']}:{first['line']} — duplicate "
+                             "registration either aliases state across "
+                             "modules or raises TypeError on a type "
+                             "mismatch at import time")))
+        return findings
+
+
+RULES = [HiddenHostReadback(), RecompilationHazard(),
+         AmbientContextPropagation(), SwallowedControlFlow(),
+         MetricsHygiene()]
+
+RULE_DOCS = {rule.id: rule.title for rule in RULES}
