@@ -897,6 +897,120 @@ def _measure_tenant_isolation(duration_secs: float = 1.0) -> dict:
     }
 
 
+def _measure_offload_scaling() -> dict:
+    """Config #8: elastic offload pool scaling (quickwit_tpu/offload/).
+    A storm of concurrent leaf dispatches fans the same cold-split tail
+    over 1/2/4 in-process workers (real SearchService leaves over shared
+    ram:// storage, rendezvous placement + hedging/stealing live);
+    reports per-pool-size dispatch p50/p99 and the 1→4-worker p99
+    speedup the elastic pool exists to buy under concurrency."""
+    import threading
+
+    from quickwit_tpu.common.deadline import Deadline
+    from quickwit_tpu.indexing import (
+        IndexingPipeline, PipelineParams, VecSource,
+    )
+    from quickwit_tpu.metastore import FileBackedMetastore
+    from quickwit_tpu.metastore.base import ListSplitsQuery
+    from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+    from quickwit_tpu.models.index_metadata import (
+        IndexConfig, IndexMetadata, SourceConfig,
+    )
+    from quickwit_tpu.offload import OffloadDispatcher, WorkerPool
+    from quickwit_tpu.query import parse_query_string
+    from quickwit_tpu.search.models import (
+        LeafSearchRequest, SearchRequest, SplitIdAndFooter,
+    )
+    from quickwit_tpu.search.service import (
+        LocalSearchClient, SearcherContext, SearchService,
+    )
+    from quickwit_tpu.storage import StorageResolver
+
+    num_splits = 8
+    docs_per_split = 100
+    storm_threads = int(os.environ.get("BENCH_OFFLOAD_THREADS", 4))
+    queries_per_thread = int(os.environ.get("BENCH_OFFLOAD_QUERIES", 6))
+
+    mapper = DocMapper(field_mappings=[FieldMapping("body", FieldType.TEXT)],
+                       default_search_fields=("body",))
+    resolver = StorageResolver.for_test()
+    metastore = FileBackedMetastore(resolver.resolve("ram:///bench-ol/ms"))
+    split_uri = "ram:///bench-ol/splits"
+    metastore.create_index(IndexMetadata(
+        index_uid="bench-ol:01",
+        index_config=IndexConfig(index_id="bench-ol", index_uri=split_uri,
+                                 doc_mapper=mapper,
+                                 split_num_docs_target=docs_per_split),
+        sources={"src": SourceConfig("src", "vec")}))
+    docs = [{"body": f"event {i} common"}
+            for i in range(num_splits * docs_per_split)]
+    IndexingPipeline(
+        PipelineParams(index_uid="bench-ol:01", source_id="src",
+                       split_num_docs_target=docs_per_split,
+                       batch_num_docs=docs_per_split),
+        mapper, VecSource(docs), metastore,
+        resolver.resolve(split_uri)).run_to_completion()
+    splits = [SplitIdAndFooter(split_id=s.metadata.split_id,
+                               storage_uri=split_uri,
+                               num_docs=s.metadata.num_docs)
+              for s in metastore.list_splits(ListSplitsQuery())]
+    request = LeafSearchRequest(
+        search_request=SearchRequest(
+            index_ids=["bench-ol"],
+            query_ast=parse_query_string("body:common"), max_hits=10),
+        index_uid="bench-ol:01", doc_mapping=mapper.to_dict(),
+        splits=splits)
+
+    def storm(num_workers: int) -> dict:
+        pool = WorkerPool()
+        for i in range(num_workers):
+            worker_id = f"bw-{i}"
+            pool.add_worker(worker_id, LocalSearchClient(SearchService(
+                SearcherContext(resolver, prefetch=False),
+                node_id=worker_id)))
+        dispatcher = OffloadDispatcher(pool, task_splits=2)
+        # one warmup dispatch opens every worker's readers off the clock
+        dispatcher.dispatch(request, deadline=Deadline.after(60.0))
+        latencies: list = []
+        lock = threading.Lock()
+
+        def client():
+            for _ in range(queries_per_thread):
+                t0 = time.monotonic()
+                outcome = dispatcher.dispatch(request,
+                                              deadline=Deadline.after(60.0))
+                elapsed = time.monotonic() - t0
+                assert not outcome.unserved
+                with lock:
+                    latencies.append(elapsed)
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(storm_threads)]
+        t0 = time.monotonic()
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join()
+        wall = time.monotonic() - t0
+        return {
+            "p50_ms": round(_percentile(latencies, 0.50) * 1000, 2),
+            "p99_ms": round(_percentile(latencies, 0.99) * 1000, 2),
+            "dispatches_per_s": round(len(latencies) / wall, 1),
+        }
+
+    by_pool_size = {f"{n}_workers": storm(n) for n in (1, 2, 4)}
+    return {
+        "storm_threads": storm_threads,
+        "queries_per_thread": queries_per_thread,
+        "num_splits": num_splits,
+        "pool": by_pool_size,
+        # the headline: concurrent-dispatch tail latency bought per worker
+        "p99_speedup_1w_to_4w": round(
+            by_pool_size["1_workers"]["p99_ms"]
+            / max(by_pool_size["4_workers"]["p99_ms"], 1e-3), 2),
+    }
+
+
 def _run_all(iters: int, with_device_loops: bool = True) -> dict:
     results: dict = {}
     workloads = _workloads()
@@ -920,6 +1034,9 @@ def _run_all(iters: int, with_device_loops: bool = True) -> dict:
         results["c7_tenant_isolation"] = _measure_tenant_isolation()
         print(f"# c7_tenant_isolation: "
               f"{json.dumps(results['c7_tenant_isolation'])}", file=sys.stderr)
+        results["c8_offload_scaling"] = _measure_offload_scaling()
+        print(f"# c8_offload_scaling: "
+              f"{json.dumps(results['c8_offload_scaling'])}", file=sys.stderr)
     return results
 
 

@@ -97,6 +97,9 @@ def load_node_config(path: Optional[str] = None,
         gossip_enabled=bool(data.get("gossip", False)),
         replication_factor=int(pick("QW_REPLICATION_FACTOR",
                                     "replication_factor", 1)),
+        offload=((data.get("searcher", {}) or {}).get("offload")
+                 if isinstance((data.get("searcher", {}) or {}).get(
+                     "offload"), dict) else None),
         offload_endpoint=(data.get("searcher", {}) or {}).get(
             "offload_endpoint"),
         offload_max_local_splits=int((data.get("searcher", {}) or {}).get(

@@ -82,6 +82,7 @@ class SearcherContext:
                  leaf_cache_bytes: int = 64 << 20,
                  batch_size: int = 8,
                  prefetch: bool = True,
+                 offload: Optional[dict] = None,
                  offload_endpoint: Optional[str] = None,
                  offload_max_local_splits: int = 16,
                  offload_client_factory=None,
@@ -126,28 +127,84 @@ class SearcherContext:
         self._readers: OrderedDict[str, SplitReader] = OrderedDict()
         self._max_open_splits = max_open_splits
         self._lock = threading.Lock()
-        # serverless offload (reference: lambda leaf-search offload,
-        # quickwit-lambda-client/src/invoker.rs:129 + the scheduling
-        # split at leaf.rs:1658,1828): cold splits beyond
-        # offload_max_local_splits per leaf request are dispatched to the
-        # configured endpoint — any process serving the internal
-        # leaf-search protocol (a peer node, a FaaS worker pool, ...)
+        # elastic leaf-search offload (reference: lambda leaf-search
+        # offload, quickwit-lambda-client/src/invoker.rs:129 + the
+        # scheduling split at leaf.rs:1658,1828): cold splits beyond
+        # `max_local_splits` per leaf request fan out over an elastic
+        # worker pool (quickwit_tpu/offload/) — any processes serving the
+        # internal leaf-search protocol (peer nodes, a FaaS worker
+        # fleet, ...). The legacy single-endpoint knobs migrate into a
+        # pool-of-one; `offload=None` with no endpoint keeps the subsystem
+        # unimported and the leaf path byte-identical to the pre-pool
+        # behavior.
+        if offload is None and offload_endpoint:
+            offload = {"endpoints": [offload_endpoint]}
+        self.offload = offload
         self.offload_endpoint = offload_endpoint
-        self.offload_max_local_splits = offload_max_local_splits
+        self.offload_max_local_splits = (
+            int(offload.get("max_local_splits", offload_max_local_splits))
+            if offload is not None else offload_max_local_splits)
         self._offload_client_factory = offload_client_factory
-        self._offload_client = None
+        self._offload_pool = None
+        self._offload_dispatcher = None
 
-    def offload_client(self):
+    def offload_dispatcher(self):
+        """The pool dispatcher, built lazily on first offloading leaf
+        request; None when no pool is configured."""
+        if self.offload is None:
+            return None
         with self._lock:
-            if self._offload_client is None:
-                if self._offload_client_factory is not None:
-                    self._offload_client = self._offload_client_factory(
-                        self.offload_endpoint)
-                else:
-                    from ..serve.http_client import HttpSearchClient
-                    self._offload_client = HttpSearchClient(
-                        self.offload_endpoint)
-            return self._offload_client
+            if self._offload_dispatcher is None:
+                from ..offload import (
+                    Autoscaler, OffloadDispatcher, WorkerPool,
+                )
+                config = self.offload
+                pool = WorkerPool(
+                    suspect_after=int(config.get("suspect_after", 1)),
+                    eject_after=int(config.get("eject_after", 3)),
+                    readmit_backoff_secs=float(
+                        config.get("readmit_backoff_secs", 0.5)),
+                    readmit_backoff_max_secs=float(
+                        config.get("readmit_backoff_max_secs", 30.0)))
+                for endpoint in config.get("endpoints", ()):
+                    if self._offload_client_factory is not None:
+                        client = self._offload_client_factory(endpoint)
+                    else:
+                        from ..serve.http_client import HttpSearchClient
+                        client = HttpSearchClient(endpoint)
+                    pool.add_worker(endpoint, client)
+                autoscaler = None
+                launcher = config.get("launcher")
+                if launcher is not None:
+                    autoscale = config.get("autoscale") or {}
+                    autoscaler = Autoscaler(
+                        pool, launcher,
+                        min_workers=int(autoscale.get("min_workers", 1)),
+                        max_workers=int(autoscale.get("max_workers", 8)),
+                        queue_per_worker=int(
+                            autoscale.get("queue_per_worker", 16)),
+                        scale_down_cooldown_secs=float(autoscale.get(
+                            "scale_down_cooldown_secs", 10.0)))
+                self._offload_pool = pool
+                self._offload_dispatcher = OffloadDispatcher(
+                    pool,
+                    task_splits=int(config.get("task_splits", 8)),
+                    max_inflight_per_worker=int(
+                        config.get("max_inflight_per_worker", 1)),
+                    hedge_min_delay_secs=float(
+                        config.get("hedge_min_delay_secs", 0.05)),
+                    hedge_max_delay_secs=float(
+                        config.get("hedge_max_delay_secs", 5.0)),
+                    injector=config.get("fault_injector"),
+                    autoscaler=autoscaler)
+            return self._offload_dispatcher
+
+    def offload_pool(self):
+        """The live WorkerPool (builds the dispatcher if needed); None
+        when offload is unconfigured."""
+        if self.offload_dispatcher() is None:
+            return None
+        return self._offload_pool
 
     def has_warm_reader(self, split: SplitIdAndFooter) -> bool:
         """True when this split's reader (and its byte-range/device
@@ -329,11 +386,12 @@ class SearchService:
         offload_future = None
         offload_result: dict[str, Any] = {}
         offloaded: list[SplitIdAndFooter] = []
-        if (self.context.offload_endpoint
+        offload_dispatcher = self.context.offload_dispatcher()
+        if (offload_dispatcher is not None
                 and len(pending) > self.context.offload_max_local_splits):
             # scheduling split (reference schedule_search_tasks,
             # leaf.rs:1828): warm splits stay local; the coldest tail
-            # beyond the local budget runs on the offload endpoint
+            # beyond the local budget fans out over the worker pool
             # CONCURRENTLY with the local loop
             warm = [s for s in pending if self.context.has_warm_reader(s)]
             cold = [s for s in pending
@@ -349,18 +407,18 @@ class SearchService:
                     index_uid=request.index_uid,
                     doc_mapping=request.doc_mapping, splits=offloaded,
                     deadline_millis=deadline.timeout_millis(),
-                    # the offload endpoint enforces the same tenant class
+                    # the offload workers enforce the same tenant class
                     tenant=(offload_tenant.to_wire()
                             if offload_tenant is not None else None),
-                    # let the endpoint start pruning where we already are
+                    # let the workers start pruning where we already are
                     sort_value_threshold=(threshold.get()
                                           if prune_ctx.mode is not None
                                           else None))
                 result_box: dict[str, Any] = {}
                 # the dispatch thread has an empty thread-local span stack:
-                # capture the traceparent HERE so the offload client's
-                # injected header joins this query's trace (satellite of
-                # the trace-stitching work; same capture as root _fan_out)
+                # capture the traceparent HERE so each worker RPC's
+                # injected header joins this query's trace (same capture
+                # as root _fan_out)
                 offload_tp = TRACER.current_traceparent()
 
                 def _invoke(box=result_box, rr=remote_request,
@@ -370,16 +428,24 @@ class SearchService:
                                 "leaf_offload",
                                 {"num_splits": len(rr.splits)},
                                 remote_parent=tp):
-                            box["response"] = \
-                                self.context.offload_client().leaf_search(rr)
-                    # qwlint: disable-next-line=QW004 - offload failure
-                    # (incl. a remote 429/timeout) falls back to LOCAL
-                    # execution below; failing the query would defeat offload
+                            box["outcome"] = offload_dispatcher.dispatch(
+                                rr, deadline=deadline, traceparent=tp)
+                    except (OverloadShed, TenantRateLimited) as exc:
+                        # typed backpressure from a worker: this query is
+                        # rejected as a WHOLE (HTTP 429), NOT retried
+                        # locally — a local retry would defeat the remote
+                        # tenant limits
+                        box["backpressure"] = exc
+                    # qwlint: disable-next-line=QW004 - only generic pool
+                    # failure lands here (typed backpressure is re-raised
+                    # above); the offloaded splits fall back to LOCAL
+                    # execution below, so nothing is swallowed
                     except Exception as exc:  # noqa: BLE001 - fallback below
                         box["error"] = exc
 
-                # run_with_context: the invoke thread must see the query's
-                # deadline (client clamp) and profile (offload phases)
+                # run_with_context: the dispatch thread (and the worker
+                # attempt threads it spawns) must see the query's
+                # deadline, tenant and profile
                 offload_future = threading.Thread(
                     target=run_with_context(_invoke), daemon=True)
                 offload_future.start()
@@ -440,19 +506,42 @@ class SearchService:
         if offload_future is not None:
             offload_future.join(
                 timeout=deadline.clamp(self._OFFLOAD_TIMEOUT_SECS))
-            remote = offload_result.get("response")
-            if remote is not None:
-                collector.add_leaf_response(remote)
-                num_offloaded = len(offloaded)
+            backpressure = offload_result.get("backpressure")
+            if backpressure is not None:
+                # a worker said 429 for this tenant/node: surface the SAME
+                # typed error so serve/rest.py renders a real 429 instead
+                # of silently re-running the splits locally (which would
+                # bypass the remote admission decision)
+                raise backpressure
+            outcome = offload_result.get("outcome")
+            leftovers: list[SplitIdAndFooter] = []
+            if outcome is not None:
+                for remote in outcome.responses:
+                    collector.add_leaf_response(remote)
+                    if remote.profile is not None:
+                        remote_profile = current_profile()
+                        if remote_profile is not None:
+                            remote_profile.add_child(remote.profile)
+                leftovers = list(outcome.unserved)
+                num_offloaded = len(offloaded) - len(leftovers)
+                stats_profile = current_profile()
+                if stats_profile is not None:
+                    for stat_key, value in outcome.stats.items():
+                        if value:
+                            stats_profile.add(f"offload_{stat_key}", value)
             else:
-                # offload failed (endpoint down / timeout): the splits
-                # still belong to this request — run them locally
+                leftovers = list(offloaded)
+            if leftovers:
+                # pool failed / timed out / left splits unserved: the
+                # splits still belong to this request — run them locally
                 # (reference invoker falls back the same way)
                 _warn_split_failure(
-                    "offload", offloaded[0].split_id if offloaded else "-",
-                    offload_result.get("error", "timeout"))
-                for group in [offloaded[b: b + batch_size]
-                              for b in range(0, len(offloaded), batch_size)]:
+                    "offload", leftovers[0].split_id,
+                    offload_result.get(
+                        "error",
+                        "unserved" if outcome is not None else "timeout"))
+                for group in [leftovers[b: b + batch_size]
+                              for b in range(0, len(leftovers), batch_size)]:
                     if deadline.expired:
                         SEARCH_SHED_TOTAL.inc(stage="offload_fallback")
                         shed_profile = current_profile()

@@ -84,9 +84,14 @@ class NodeConfig:
     cooperative_indexing: bool = False
     max_concurrent_pipelines: int = 3
     # serverless offload (reference: quickwit-lambda leaf offload): cold
-    # splits beyond offload_max_local_splits per leaf request dispatch to
-    # this endpoint — any server speaking the internal leaf-search
-    # protocol (peer node, FaaS worker pool). None = all-local.
+    # splits beyond offload_max_local_splits per leaf request fan out over
+    # an elastic worker pool (quickwit_tpu/offload/) — any servers
+    # speaking the internal leaf-search protocol (peer nodes, FaaS
+    # workers). `offload` is the pool config dict (keys: endpoints,
+    # max_local_splits, task_splits, hedging/health/autoscale knobs);
+    # offload_endpoint is the legacy single-endpoint form, normalized to
+    # a pool of one. None/None = all-local.
+    offload: Optional[dict] = None
     offload_endpoint: Optional[str] = None
     offload_max_local_splits: int = 16
     # disk-resident split cache (reference split_cache/mod.rs): None
@@ -429,6 +434,7 @@ class Node:
             self.split_cache.start()
         self.searcher_context = SearcherContext(
             self.storage_resolver,
+            offload=config.offload,
             offload_endpoint=config.offload_endpoint,
             offload_max_local_splits=config.offload_max_local_splits,
             split_cache=self.split_cache)

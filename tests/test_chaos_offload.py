@@ -1,0 +1,215 @@
+"""Seeded chaos suite for the offload pool (quickwit_tpu/offload/).
+
+Drives a leaf SearchService whose cold-split tail fans out over four
+in-process workers, with faults injected at the `offload.dispatch@<worker>`
+point (common/faults.py), and asserts the dispatcher's recovery invariants:
+
+- a worker dying mid-query loses no splits: its tasks re-dispatch to the
+  next rendezvous-ranked worker and the response matches the unfaulted run;
+- an injected straggler is cut off by a hedge well inside the deadline;
+- typed backpressure (429) from a worker surfaces as a whole-query 429 —
+  never silently retried on the local path;
+- with every worker dead the query still completes via local fallback.
+
+Deterministic and fast (marked `chaos`, runs in tier-1)."""
+
+import time
+
+import pytest
+
+from quickwit_tpu.common.faults import FaultInjector, FaultRule
+from quickwit_tpu.indexing import IndexingPipeline, PipelineParams, VecSource
+from quickwit_tpu.metastore import FileBackedMetastore
+from quickwit_tpu.metastore.base import ListSplitsQuery
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.models.index_metadata import (
+    IndexConfig, IndexMetadata, SourceConfig,
+)
+from quickwit_tpu.query import parse_query_string
+from quickwit_tpu.search.models import (
+    LeafSearchRequest, SearchRequest, SplitIdAndFooter,
+)
+from quickwit_tpu.search.service import (
+    LocalSearchClient, SearcherContext, SearchService,
+)
+from quickwit_tpu.serve.rest import classify_exception
+from quickwit_tpu.storage import StorageResolver
+from quickwit_tpu.tenancy.registry import TenantRateLimited
+
+pytestmark = pytest.mark.chaos
+
+MAPPER = DocMapper(
+    field_mappings=[
+        FieldMapping("ts", FieldType.DATETIME, fast=True,
+                     input_formats=("unix_timestamp",)),
+        FieldMapping("body", FieldType.TEXT),
+    ],
+    timestamp_field="ts",
+    default_search_fields=("body",),
+)
+
+NUM_SPLITS = 6
+DOCS_PER_SPLIT = 100
+DEADLINE_SLACK_SECS = 1.6
+WORKER_IDS = ("ow-0", "ow-1", "ow-2", "ow-3")
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    resolver = StorageResolver.for_test()
+    metastore = FileBackedMetastore(resolver.resolve("ram:///olchaos/ms"))
+    split_uri = "ram:///olchaos/splits"
+    config = IndexConfig(index_id="olchaos", index_uri=split_uri,
+                         doc_mapper=MAPPER, split_num_docs_target=100)
+    metastore.create_index(IndexMetadata(
+        index_uid="olchaos:01", index_config=config,
+        sources={"src": SourceConfig("src", "vec")}))
+    docs = [{"ts": 1_700_000_000 + i, "body": f"event {i} common"}
+            for i in range(NUM_SPLITS * DOCS_PER_SPLIT)]
+    pipeline = IndexingPipeline(
+        PipelineParams(index_uid="olchaos:01", source_id="src",
+                       split_num_docs_target=100, batch_num_docs=50),
+        MAPPER, VecSource(docs), metastore, resolver.resolve(split_uri))
+    pipeline.run_to_completion()
+    splits = [SplitIdAndFooter(split_id=s.metadata.split_id,
+                               storage_uri=split_uri,
+                               num_docs=s.metadata.num_docs)
+              for s in metastore.list_splits(ListSplitsQuery())]
+    assert len(splits) == NUM_SPLITS
+    return resolver, splits
+
+
+class _SheddingClient:
+    def leaf_search(self, request):
+        raise TenantRateLimited("acme", "qps", 0.5)
+
+
+def build_service(corpus, injector=None, worker_overrides=None,
+                  **offload_extra):
+    """A leaf service whose ENTIRE split set offloads (max_local_splits=0)
+    to four in-process workers sharing the corpus storage; per-worker
+    faults inject at the dispatcher's `offload.dispatch@<id>` point."""
+    resolver, _ = corpus
+    worker_overrides = worker_overrides or {}
+
+    def factory(worker_id):
+        override = worker_overrides.get(worker_id)
+        if override is not None:
+            return override
+        return LocalSearchClient(SearchService(
+            SearcherContext(resolver, prefetch=False),
+            node_id=worker_id))
+
+    context = SearcherContext(
+        resolver, prefetch=False,
+        offload={"endpoints": list(WORKER_IDS), "max_local_splits": 0,
+                 "task_splits": 1, "hedge_min_delay_secs": 0.05,
+                 "fault_injector": injector, **offload_extra},
+        offload_client_factory=factory)
+    return SearchService(context, node_id="olchaos-main")
+
+
+def leaf_request(splits, timeout_millis=20_000):
+    return LeafSearchRequest(
+        search_request=SearchRequest(
+            index_ids=["olchaos"],
+            query_ast=parse_query_string("body:common"),
+            max_hits=5, timeout_millis=timeout_millis),
+        index_uid="olchaos:01", doc_mapping=MAPPER.to_dict(),
+        splits=splits, deadline_millis=timeout_millis)
+
+
+def test_unfaulted_pool_serves_every_split(corpus):
+    _, splits = corpus
+    response = build_service(corpus).leaf_search(leaf_request(splits))
+    assert response.num_successful_splits == NUM_SPLITS
+    assert response.num_hits == NUM_SPLITS * DOCS_PER_SPLIT
+    assert not response.failed_splits
+
+
+def test_worker_death_mid_query_loses_no_splits(corpus):
+    # one worker's every dispatch errors: its tasks must re-land on the
+    # next-ranked workers, matching the unfaulted run split-for-split,
+    # inside the deadline
+    _, splits = corpus
+    injector = FaultInjector(seed=7, rules=[
+        FaultRule("offload.dispatch@ow-1", "error"),
+    ])
+    service = build_service(corpus, injector=injector)
+    t0 = time.monotonic()
+    response = service.leaf_search(leaf_request(splits))
+    assert time.monotonic() - t0 < 20.0 + DEADLINE_SLACK_SECS
+    assert response.num_successful_splits == NUM_SPLITS
+    assert response.num_hits == NUM_SPLITS * DOCS_PER_SPLIT
+    assert not response.failed_splits
+    pool = service.context.offload_pool()
+    assert pool.snapshot()["ow-1"]["failures"] >= 1
+
+
+def test_injected_straggler_recovered_by_hedge(corpus):
+    # every dispatch on one worker stalls 3s; the hedge (p95-driven, min
+    # 50ms here) must duplicate the straggling task elsewhere and answer
+    # far inside both the stall and the deadline
+    _, splits = corpus
+    injector = FaultInjector(seed=3, rules=[
+        FaultRule("offload.dispatch@ow-2", "hang", hang_secs=3.0),
+    ])
+    service = build_service(corpus, injector=injector)
+    t0 = time.monotonic()
+    response = service.leaf_search(leaf_request(splits,
+                                                timeout_millis=10_000))
+    elapsed = time.monotonic() - t0
+    assert elapsed < 3.0, "hedge never cut off the injected straggler"
+    assert response.num_successful_splits == NUM_SPLITS
+    assert response.num_hits == NUM_SPLITS * DOCS_PER_SPLIT
+
+
+def test_worker_429_surfaces_as_whole_query_429(corpus):
+    # a worker under tenant rate limiting answers typed backpressure: the
+    # query must fail as a 429 — NOT fall back to local execution, which
+    # would launder the remote admission decision
+    _, splits = corpus
+    service = build_service(
+        corpus, worker_overrides={w: _SheddingClient() for w in WORKER_IDS})
+    with pytest.raises(TenantRateLimited) as info:
+        service.leaf_search(leaf_request(splits))
+    assert classify_exception(info.value) == 429
+
+
+def test_every_worker_dead_falls_back_to_local_execution(corpus):
+    # generic (non-429) failure everywhere: the splits still belong to the
+    # query — the service runs them locally and the response is complete
+    _, splits = corpus
+    injector = FaultInjector(seed=11, rules=[
+        FaultRule("offload.dispatch@*", "error"),
+    ])
+    service = build_service(corpus, injector=injector)
+    t0 = time.monotonic()
+    response = service.leaf_search(leaf_request(splits))
+    assert time.monotonic() - t0 < 20.0 + DEADLINE_SLACK_SECS
+    assert response.num_successful_splits == NUM_SPLITS
+    assert response.num_hits == NUM_SPLITS * DOCS_PER_SPLIT
+    assert not response.failed_splits
+
+
+def test_same_seed_same_per_occurrence_fault_decisions(corpus):
+    # hedging/stealing make the NUMBER of dispatches timing-dependent, but
+    # the injector's decision for the k-th dispatch to a given worker must
+    # be identical across runs (the blake2b per-(seed, op, occurrence)
+    # contract) — and every run must still serve all splits
+    _, splits = corpus
+    rules = [FaultRule("offload.dispatch@*", "error", probability=0.5)]
+
+    def run():
+        injector = FaultInjector(seed=1234, rules=rules)
+        service = build_service(corpus, injector=injector)
+        response = service.leaf_search(leaf_request(splits))
+        return injector.schedule(), response.num_successful_splits
+
+    schedule_a, served_a = run()
+    schedule_b, served_b = run()
+    assert served_a == served_b == NUM_SPLITS
+    assert schedule_a, "seeded rules never fired — the run tested nothing"
+    for op in set(schedule_a) & set(schedule_b):
+        shared = min(len(schedule_a[op]), len(schedule_b[op]))
+        assert schedule_a[op][:shared] == schedule_b[op][:shared], op

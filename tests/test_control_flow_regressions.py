@@ -188,6 +188,43 @@ def test_retry_deadline_returns_nonretryable_failures():
     assert is_deadline_error(failure.error)
 
 
+# --- offload pool: worker 429s stay typed, never a silent local retry ------
+
+def test_offload_dispatch_reraises_worker_backpressure_as_typed():
+    # a pool worker shedding (or rate limiting) used to fall into the
+    # offload path's generic fallback-to-local, silently re-running the
+    # splits the worker just refused; the dispatcher must re-raise the
+    # typed exception so the query fails as a whole-query 429
+    from quickwit_tpu.offload import OffloadDispatcher, WorkerPool
+
+    for exc in (OverloadShed("offload_worker", 0.5),
+                TenantRateLimited("t1", "qps", 0.5)):
+        pool = WorkerPool()
+        pool.add_worker("w0", _RaisingClient(exc))
+        dispatcher = OffloadDispatcher(pool)
+        with pytest.raises(type(exc)):
+            dispatcher.dispatch(_leaf_request(),
+                                deadline=Deadline.after(5.0))
+
+
+def test_offload_dispatch_reconstructs_remote_http_429():
+    # an HTTP worker answers 429 with the rest.py throttle body: the
+    # dispatcher must rebuild the typed exception from the wire shape
+    # (it used to be just another retryable HttpStatusError)
+    import json as _json
+
+    from quickwit_tpu.offload import OffloadDispatcher, WorkerPool
+
+    body = _json.dumps({"status": 429, "error": {
+        "type": "rate_limit_exceeded", "reason": "tenant t1"}}).encode()
+    pool = WorkerPool()
+    pool.add_worker("w0", _RaisingClient(
+        HttpStatusError("429 from worker", status=429, body=body)))
+    dispatcher = OffloadDispatcher(pool)
+    with pytest.raises(TenantRateLimited):
+        dispatcher.dispatch(_leaf_request(), deadline=Deadline.after(5.0))
+
+
 # --- leaf prepare: backpressure is whole-query, not per-split --------------
 
 def test_prepare_per_split_reraises_backpressure():

@@ -142,6 +142,64 @@ def test_tenant_metrics_expose_with_bounded_labels():
                    for key in parsed[name]), name
 
 
+def test_offload_metrics_expose_with_strict_grammar():
+    """Drive a real pool dispatch (one healthy worker, one dead one, so
+    the ok/error/retry families all move) and assert every qw_offload_*
+    series survives the strict exposition parse with its documented
+    bounded labels."""
+    from quickwit_tpu.common.deadline import Deadline
+    from quickwit_tpu.offload import OffloadDispatcher, WorkerPool
+    from quickwit_tpu.query.ast import MatchAll
+    from quickwit_tpu.search.models import (
+        LeafSearchRequest, LeafSearchResponse, SearchRequest,
+        SplitIdAndFooter,
+    )
+
+    class _Worker:
+        def __init__(self, exc=None):
+            self.exc = exc
+
+        def leaf_search(self, request):
+            if self.exc is not None:
+                raise self.exc
+            return LeafSearchResponse(
+                num_successful_splits=len(request.splits))
+
+    pool = WorkerPool(suspect_after=1, eject_after=2)
+    pool.add_worker("mf-ok", _Worker())
+    pool.add_worker("mf-dead", _Worker(exc=RuntimeError("down")))
+    dispatcher = OffloadDispatcher(pool, task_splits=1)
+    request = LeafSearchRequest(
+        search_request=SearchRequest(index_ids=["m"], query_ast=MatchAll()),
+        index_uid="m:01", doc_mapping={},
+        splits=[SplitIdAndFooter(split_id=f"mf-{i}", storage_uri="ram:///m")
+                for i in range(8)])
+    outcome = dispatcher.dispatch(request, deadline=Deadline.after(10.0))
+    assert not outcome.unserved
+
+    parsed = parse_exposition(METRICS.expose_text())
+    dispatches = parsed["qw_offload_dispatches_total"]
+    outcomes = {dict(key)["outcome"] for key in dispatches}
+    assert "ok" in outcomes and "error" in outcomes
+    assert outcomes <= {"ok", "error", "backpressure", "discarded"}
+    states = {dict(key)["state"]: value
+              for key, value in parsed["qw_offload_pool_workers"].items()}
+    assert set(states) == {"healthy", "suspect", "ejected"}
+    assert sum(states.values()) == 2.0  # gauge counts THIS pool's workers
+    split_outcomes = {dict(key)["outcome"]
+                      for key in parsed["qw_offload_splits_total"]}
+    assert "remote" in split_outcomes
+    assert split_outcomes <= {"remote", "fallback_local"}
+    assert any(key == () for key in parsed["qw_offload_retries_total"])
+    assert "qw_offload_queue_depth" in parsed
+    # the histogram family parsed (its +Inf == _count consistency is
+    # checked registry-wide below)
+    assert "qw_offload_dispatch_seconds_count" in parsed
+    for name in ("qw_offload_hedges_total", "qw_offload_steals_total",
+                 "qw_offload_autoscale_events_total"):
+        assert name in METRICS._metrics, name
+
+
 def test_full_registry_exposition_parses():
     """The real global registry — after driving a few metrics through the
     awkward cases (labels, floats, multiple label sets) — must emit text

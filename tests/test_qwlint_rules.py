@@ -163,6 +163,33 @@ def test_qw003_allows_wrapped_callables_and_task_queues(tmp_path):
     assert findings == []
 
 
+def test_qw003_offload_attempt_spawn_needs_context_wrap(tmp_path):
+    # the offload dispatcher's per-attempt thread spawn: a bare target
+    # loses the query's deadline/tenant/profile across the hop (this
+    # mirrors quickwit_tpu/offload/dispatcher.py's _launch, which ships
+    # wrapped — the negative below)
+    findings = lint(tmp_path, """
+        import threading
+
+        def launch(attempt, task, worker_id):
+            threading.Thread(target=attempt, args=(task, worker_id),
+                             name=f"offload-{worker_id}",
+                             daemon=True).start()
+    """)
+    assert rules_of(findings) == ["QW003"]
+    findings = lint(tmp_path, """
+        import threading
+        from quickwit_tpu.common.ctx import run_with_context
+
+        def launch(attempt, task, worker_id):
+            threading.Thread(target=run_with_context(attempt),
+                             args=(task, worker_id),
+                             name=f"offload-{worker_id}",
+                             daemon=True).start()
+    """)
+    assert findings == []
+
+
 # --- QW004 swallowed-control-flow --------------------------------------------
 
 def test_qw004_flags_broad_except(tmp_path):

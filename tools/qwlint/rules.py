@@ -241,6 +241,7 @@ _QUERY_PATH_MODULES = (
     "quickwit_tpu/serve/",
     "quickwit_tpu/storage/",
     "quickwit_tpu/parallel/",
+    "quickwit_tpu/offload/",
 )
 
 _TYPED_CONTROL_FLOW = {"OverloadShed", "TenantRateLimited",
