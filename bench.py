@@ -622,17 +622,12 @@ def _measure_single_split(request, mapper, reader, iters: int,
               "solo-dispatch pipelining", file=sys.stderr)
 
     # legacy one-query-per-dispatch pipelining, for the record: bounded by
-    # the per-dispatch tunnel round (tools/profile_tunnel.py)
-    def _async_copy(tree):
-        for leaf in jax.tree_util.tree_leaves(tree):
-            if hasattr(leaf, "copy_to_host_async"):
-                leaf.copy_to_host_async()
-        return tree
-
+    # the per-dispatch tunnel round (tools/profile_tunnel.py);
+    # dispatch_plan itself starts the async D2H copy of the packed result
     inflight = []
     t0 = time.monotonic()
     for _ in range(PIPELINE_QUERIES):
-        inflight.append(_async_copy(ex.dispatch_plan(plan, k, device_arrays)))
+        inflight.append(ex.dispatch_plan(plan, k, device_arrays))
         if len(inflight) > PIPELINE_DEPTH:
             ex.readback_plan_result(inflight.pop(0))
     while inflight:
@@ -1011,6 +1006,90 @@ def _measure_offload_scaling() -> dict:
     }
 
 
+def _measure_resident_warm(iters: int) -> dict:
+    """Config #9: the resident-column serving path (search/residency.py).
+
+    N splits through a one-slot reader LRU, so every query reopens its
+    readers — the worst case for the seed's per-reader device cache, which
+    died with the reader and re-paid full H2D staging per query. With the
+    resident store the columns survive reader churn keyed by split id:
+    warm queries stage ZERO column bytes (counter-verified per query).
+    Leaf response cache off and threshold pruning off so every iteration
+    executes and warms every split."""
+    from quickwit_tpu.index.synthetic import HDFS_MAPPER, synthetic_hdfs_split
+    from quickwit_tpu.query.ast import Term
+    from quickwit_tpu.search.models import (
+        LeafSearchRequest, SearchRequest, SortField, SplitIdAndFooter)
+    from quickwit_tpu.search.residency import (
+        RESIDENT_COLUMN_MISSES, RESIDENT_STAGING_CACHE_HITS)
+    from quickwit_tpu.search.service import SearcherContext, SearchService
+    from quickwit_tpu.storage import StorageResolver
+
+    n_splits = int(os.environ.get("BENCH_RESIDENT_SPLITS", 8))
+    docs_per = int(os.environ.get("BENCH_RESIDENT_DOCS", 65_536))
+    resolver = StorageResolver.for_test()
+    storage = resolver.resolve("ram:///bench-resident")
+    day = 86_400
+    offsets = []
+    for s in range(n_splits):
+        start = 1_600_000_000 + s * day
+        storage.put(f"r{s}.split", synthetic_hdfs_split(
+            docs_per, seed=200 + s, start_ts=start, span_seconds=day))
+        offsets.append(SplitIdAndFooter(
+            split_id=f"r{s}", storage_uri="ram:///bench-resident",
+            num_docs=docs_per,
+            time_range=(start * 1_000_000, (start + day) * 1_000_000)))
+
+    request = LeafSearchRequest(
+        search_request=SearchRequest(
+            index_ids=["hdfs-logs"],
+            query_ast=Term("severity_text", "ERROR"), max_hits=10,
+            sort_fields=(SortField("timestamp", "desc"),)),
+        index_uid="bench:resident", doc_mapping=HDFS_MAPPER.to_dict(),
+        splits=offsets)
+
+    def run(resident):
+        service = SearchService(SearcherContext(
+            storage_resolver=resolver, batch_size=1, prefetch=False,
+            leaf_cache_bytes=0, enable_threshold_pruning=False,
+            max_open_splits=1, resident_columns=resident))
+        t0 = time.monotonic()
+        service.leaf_search(request)  # cold: compile + stage every split
+        cold_s = time.monotonic() - t0
+        # counter deltas over the WARM loop only: hits must be
+        # iters * n_splits, uploads must be zero
+        hits0 = RESIDENT_STAGING_CACHE_HITS.get()
+        misses0 = RESIDENT_COLUMN_MISSES.get()
+        lat = []
+        for _ in range(iters):
+            t0 = time.monotonic()
+            response = service.leaf_search(request)
+            lat.append(time.monotonic() - t0)
+        assert not response.failed_splits
+        return {
+            "cold_s": round(cold_s, 1),
+            "warm_ms": _percentile(lat, 0.5) * 1000,
+            "hits": RESIDENT_STAGING_CACHE_HITS.get() - hits0,
+            "uploads": RESIDENT_COLUMN_MISSES.get() - misses0,
+            "rerun": lambda: service.leaf_search(request),
+        }
+
+    res = run(resident=True)
+    churn = run(resident=False)  # store off: no counters touched
+    return {
+        "n_splits": n_splits, "docs_per_split": docs_per,
+        "cold_s": res["cold_s"],
+        "e2e_ms": round(res["warm_ms"], 2),   # warm resident, the real path
+        "reader_churn_ms": round(churn["warm_ms"], 2),  # seed: residency
+                                       # died with the reader, re-staged all
+        "resident_warm_speedup": round(
+            churn["warm_ms"] / max(res["warm_ms"], 1e-9), 2),
+        "staging_cache_hits": int(res["hits"]),  # iters * n_splits expected
+        "warm_column_uploads": int(res["uploads"]),  # must be 0
+        "phases_ms": _phase_breakdown(res["rerun"]),
+    }
+
+
 def _run_all(iters: int, with_device_loops: bool = True) -> dict:
     results: dict = {}
     workloads = _workloads()
@@ -1037,6 +1116,10 @@ def _run_all(iters: int, with_device_loops: bool = True) -> dict:
         results["c8_offload_scaling"] = _measure_offload_scaling()
         print(f"# c8_offload_scaling: "
               f"{json.dumps(results['c8_offload_scaling'])}", file=sys.stderr)
+        results["c9_resident_warm"] = _measure_resident_warm(
+            max(3, iters // 3))
+        print(f"# c9_resident_warm: "
+              f"{json.dumps(results['c9_resident_warm'])}", file=sys.stderr)
     return results
 
 

@@ -41,6 +41,10 @@ PHASE_ADMISSION_WAIT = "admission_wait"
 PHASE_BATCHER_QUEUE = "batcher_queue_wait"
 PHASE_STORAGE_READ = "storage_read"
 PHASE_STAGING = "staging"
+# staging split by outcome (ROADMAP item 1 attribution): an upload that
+# actually moved column bytes vs a resident-store hit that moved none
+PHASE_STAGING_UPLOAD = "staging_upload"
+PHASE_STAGING_CACHE_HIT = "staging_cache_hit"
 PHASE_COMPILE = "compile"
 PHASE_EXECUTE = "execute"
 PHASE_TOPK_MERGE = "topk_merge"

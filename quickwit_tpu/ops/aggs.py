@@ -81,6 +81,20 @@ def stats_state(values: jnp.ndarray, present: jnp.ndarray, mask: jnp.ndarray) ->
     return jnp.stack([count, s, s2, mn, mx])
 
 
+def merge_stats_states(a, b) -> np.ndarray:
+    """Merge two `stats_state` partials ([count, sum, sum_sq, min, max]).
+
+    The layout contract lives here, next to the kernel that emits it: the
+    first three components add, min/max combine — which is what makes the
+    per-split partials a pure fixed-shape reduction (associative and
+    commutative), mergeable host-side at the collector or on device under
+    `psum`. Operates on host numpy (post-readback partials)."""
+    # qwlint: disable-next-line=QW001 - post-readback host partials by contract
+    a, b = np.asarray(a), np.asarray(b)
+    return np.array([a[0] + b[0], a[1] + b[1], a[2] + b[2],
+                     min(a[3], b[3]), max(a[4], b[4])])
+
+
 # --- percentiles (DDSketch-compatible log buckets) ------------------------
 #
 # Bucket mapping matches the sketch the reference drives through tantivy

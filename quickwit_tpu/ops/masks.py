@@ -104,3 +104,19 @@ def minimum_should_match_mask(should_masks: list[jnp.ndarray], min_count: int) -
     """At least `min_count` of the masks true (bool `should` semantics)."""
     counts = sum(m.astype(jnp.int32) for m in should_masks)
     return counts >= min_count
+
+
+def dead_lane_mask(keyed: jnp.ndarray) -> jnp.ndarray:
+    """Lanes whose higher-is-better sort key is -inf: non-matching docs,
+    threshold-pruned lanes, and search_after-excluded lanes. These never
+    surface through top-k, and the scalar-only readback's hit lists are
+    meaningless past the live prefix."""
+    return jnp.isneginf(keyed)
+
+
+def propagate_dead_lanes(keyed: jnp.ndarray,
+                         keyed2: jnp.ndarray) -> jnp.ndarray:
+    """Kill the secondary sort key wherever the primary lane is dead, so
+    the lexicographic 2-key top-k cannot resurrect a pruned/excluded doc
+    on the strength of its tiebreaker alone."""
+    return jnp.where(dead_lane_mask(keyed), -jnp.inf, keyed2)

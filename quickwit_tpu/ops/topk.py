@@ -29,6 +29,29 @@ MISSING_VALUE_SENTINEL = -1.7976931348623157e308
 
 _BLOCK = 1024  # == index.format.DOC_PAD, so dense doc arrays always divide
 
+# f32's most-negative finite, as a Python float (module-level: computed
+# once at import, never inside a traced function)
+F32_LOWEST = float(jnp.finfo(jnp.float32).min)
+
+
+def _pad_to_block(x: jnp.ndarray, k: int):
+    """Pad `x` with -inf lanes up to a _BLOCK multiple so the blockwise
+    two-stage applies to ANY operand length (posting arrays pad to 128,
+    not 1024 — without this, posting-space top-k falls off the blockwise
+    path onto `lax.top_k`'s f64 full-sort, ~290ms for a c1-shape operand).
+
+    Bit-exact: pad lanes hold -inf at the highest indices, so every real
+    lane ranks at or above every pad lane and lowest-index-wins ties
+    resolve inside the real prefix — with k <= n no pad index can ever
+    surface in the top-k. Returns None when padding wouldn't enable the
+    blockwise path (tiny operand or k > _BLOCK)."""
+    n = x.shape[0]
+    rem = n % _BLOCK
+    if rem == 0 or k > _BLOCK or k > n or (n + _BLOCK - rem) // _BLOCK < 2:
+        return None
+    pad = _BLOCK - rem
+    return jnp.concatenate([x, jnp.full((pad,), NEG_INF, x.dtype)])
+
 
 def exact_topk(x: jnp.ndarray, k: int):
     """Exact top-k, blockwise two-stage.
@@ -37,9 +60,15 @@ def exact_topk(x: jnp.ndarray, k: int):
     to [G, 1024] blocks, taking per-block top-k, then re-top-k'ing the G*k
     winners is bit-exact (every global winner is a block winner) and ~300x
     faster (0.2ms measured). Tie-breaking is preserved: the flattened
-    (block, rank) order equals index order for equal keys.
+    (block, rank) order equals index order for equal keys. Non-multiple
+    lengths are -inf-padded first (see `_pad_to_block`).
     """
     n = x.shape[0]
+    if n % _BLOCK != 0:
+        padded = _pad_to_block(x, k)
+        if padded is not None:
+            x = padded
+            n = x.shape[0]
     if n % _BLOCK == 0 and k <= _BLOCK and n // _BLOCK >= 2:
         grid = n // _BLOCK
         vals, idx = lax.top_k(x.reshape(grid, _BLOCK), min(k, _BLOCK))
@@ -48,6 +77,106 @@ def exact_topk(x: jnp.ndarray, k: int):
         top_vals, pos = lax.top_k(vals.reshape(-1), k)
         return top_vals, flat_idx[pos]
     return lax.top_k(x, k)
+
+
+def guided_topk(x: jnp.ndarray, k: int):
+    """Top-k with an f32-screened candidate set and an exactness certificate.
+
+    `lax.top_k`'s fast CPU path is f32-only: the f64 blockwise `exact_topk`
+    on a c1-shape operand costs ~180ms where the f32 equivalent costs ~4ms.
+    This variant screens per-block candidates in f32 and refines the G*k
+    survivors in f64, returning `(vals, idx, safe)` where `safe` (f64 1/0)
+    certifies the result equals `exact_topk(x, k)` bit-for-bit including
+    tie-breaks. Callers MUST re-run an exact variant when `safe == 0`
+    (executor.py does this host-side after readback — `lax.cond` is not an
+    option because vmap lowers it to `select`, executing both branches).
+
+    Exactness argument:
+    - The f64→f32 downcast is monotone, so any element excluded by the
+      screen with f32 key strictly below a block's k-th screen value is
+      f64-dominated by k in-block elements and cannot be a global winner.
+    - Ambiguity only arises when a block's (k+1)-th screen value ties its
+      k-th (`spill == boundary`): distinct f64 keys may collapse onto the
+      tied f32 value and the screen's index-order pick may drop a winner.
+      Detected per block in O(G) and reported via `safe`.
+    - A boundary tie whose collapse group is f64-PURE (every in-block lane
+      at the boundary's f32 value holds the identical f64 key) stays safe:
+      within an f64-equal group the screen's lowest-index-wins order IS
+      `exact_topk`'s tie order, and any excluded group member is outranked
+      by >= k in-block lanes (strictly-greater f32 implies strictly-greater
+      f64; equal-f32 picks precede it in index). This is the common case
+      for score sorts — a single-term query gives every match the same BM25
+      value, so the boundary is one giant exact tie. Checked in O(n) by
+      comparing each lane at the boundary's f32 value against the
+      boundary's f64 value.
+    - Ties at -inf (non-matching) and at the downcast-pinned sentinel
+      (`F32_LOWEST` ⟺ MISSING_VALUE_SENTINEL exactly, see below) are
+      f64-equal groups subsumed by the purity rule (kept as explicit
+      clauses anyway — they are free).
+    - Tie-break parity: equal f64 keys are equal in f32, so the screen
+      keeps them in ascending-index order within a block, and candidate
+      (block, rank) order preserves global index order across blocks.
+
+    To make magnitude-heavy keys (epoch-micros timestamps) f32-stable, real
+    values are shifted by the finite minimum before the downcast; sentinel
+    and -inf lanes are not shifted. A real lane whose shifted value
+    underflows f32's most-negative finite is pinned to `F32_LOWEST`, which
+    after the shift (all real lanes >= 0) is occupied ONLY by the sentinel
+    — so sentinel ordering survives the downcast exactly.
+
+    The f32 screen's VALUES output is never consumed: deriving the
+    boundary/spill check from it makes XLA CPU fall off the TopK fast path
+    (~20x; the whole point of this function). The f32 keys of the k+1
+    candidates are recomputed from the gathered f64 values instead, and
+    only the screen's indices feed the gather.
+    """
+    n = x.shape[0]
+    if n % _BLOCK != 0 and k + 1 <= _BLOCK and k > 0:
+        padded = _pad_to_block(x, k)
+        if padded is not None:
+            # pad lanes are -inf: never shifted, screen to -inf, and their
+            # blocks certify safe via the isneginf(boundary) clause
+            x = padded
+            n = x.shape[0]
+    if not (n % _BLOCK == 0 and k + 1 <= _BLOCK and n // _BLOCK >= 2
+            and k > 0):
+        vals, idx = exact_topk(x, k)
+        return vals, idx, jnp.float64(1.0)
+    grid = n // _BLOCK
+
+    def downcast(shifted):
+        hi = shifted.astype(jnp.float32)
+        return jnp.where(jnp.isneginf(hi) & ~jnp.isneginf(shifted),
+                         jnp.float32(F32_LOWEST), hi)
+
+    finite_real = x > MISSING_VALUE_SENTINEL
+    m = jnp.min(jnp.where(finite_real, x, jnp.inf))
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    shifted = jnp.where(finite_real, x - m, x)
+    screen = downcast(shifted).reshape(grid, _BLOCK)
+    _, bidx = lax.top_k(screen, k + 1)
+    flat_idx = (jnp.arange(grid, dtype=jnp.int32)[:, None] * _BLOCK
+                + bidx.astype(jnp.int32)).reshape(-1)
+    cand = x[flat_idx]
+    cand_shifted = jnp.where(cand > MISSING_VALUE_SENTINEL, cand - m, cand)
+    hc = downcast(cand_shifted).reshape(grid, k + 1)
+    boundary, spill = hc[:, k - 1], hc[:, k]
+    # f64-purity of the boundary collapse group: every in-block lane whose
+    # screen value equals the boundary's must hold the boundary's exact f64
+    # key (raw domain — equal raw keys shift and downcast identically)
+    boundary64 = cand.reshape(grid, k + 1)[:, k - 1]
+    pure = jnp.all(jnp.where(screen == boundary[:, None],
+                             x.reshape(grid, _BLOCK) == boundary64[:, None],
+                             True), axis=1)
+    blk_safe = ((spill < boundary) | pure | jnp.isneginf(boundary)
+                | (boundary == jnp.float32(F32_LOWEST)))
+    safe = jnp.all(blk_safe).astype(jnp.float64)
+    # drop the spill column so the refine sees exactly the per-block top-k
+    # candidate order `exact_topk` would produce
+    cand_k = cand.reshape(grid, k + 1)[:, :k].reshape(-1)
+    idx_k = flat_idx.reshape(grid, k + 1)[:, :k].reshape(-1)
+    top_vals, pos = lax.top_k(cand_k, k)
+    return top_vals, idx_k[pos], safe
 
 
 def apply_threshold_mask(keyed: jnp.ndarray, threshold) -> jnp.ndarray:
@@ -75,6 +204,16 @@ def exact_topk_2key(key1: jnp.ndarray, key2: jnp.ndarray, k: int):
     Returns (key1_top[k], key2_top[k], indices[k]).
     """
     n = key1.shape[0]
+    if n % _BLOCK != 0:
+        p1 = _pad_to_block(key1, k)
+        if p1 is not None:
+            # pad lanes are (-inf, -inf) at the highest indices: they lose
+            # the lexicographic tie-break to every real lane, so with
+            # k <= n no pad index can surface (same argument as exact_topk)
+            key1 = p1
+            key2 = jnp.concatenate([
+                key2, jnp.full((p1.shape[0] - n,), NEG_INF, key2.dtype)])
+            n = key1.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
     neg1, neg2 = -key1, -key2
     if n % _BLOCK == 0 and k <= _BLOCK and n // _BLOCK >= 2:

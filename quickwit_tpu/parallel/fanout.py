@@ -27,6 +27,7 @@ sequential leaf search in the search service.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Any, Optional, Sequence
 
@@ -39,8 +40,9 @@ from ..index.format import ZONEMAP_BLOCK
 from ..index.reader import SplitReader
 from ..models.doc_mapper import DocMapper
 from ..observability.profile import (
-    PHASE_COMPILE, PHASE_EXECUTE, PHASE_PLAN_BUILD, PHASE_STAGING,
-    PHASE_TOPK_MERGE, current_profile, profile_add, profiled_phase,
+    PHASE_COMPILE, PHASE_EXECUTE, PHASE_PLAN_BUILD, PHASE_STAGING_CACHE_HIT,
+    PHASE_STAGING_UPLOAD, PHASE_TOPK_MERGE, current_profile, profile_add,
+    profiled_phase,
 )
 from ..query.aggregations import DateHistogramAgg, HistogramAgg, TermsAgg, parse_aggs
 from ..search.models import LeafSearchResponse, PartialHit, SearchRequest
@@ -344,21 +346,25 @@ def batch_shardings(batch: SplitBatch, mesh: Mesh):
     return tuple(array_shardings), tuple(scalar_shardings), nd_sharding
 
 
-def batch_fn(batch: SplitBatch, k: int):
+def batch_fn(batch: SplitBatch, k: int, exact: bool = False):
     """The unjitted merged-batch closure (arrays, scalars, num_docs) →
     result tree — exposed so measurement harnesses can wrap it (e.g. in a
     device-side repeat loop) before jitting."""
     template = batch.template
-    single_fn = executor_mod._build(template, k)
+    single_fn = executor_mod._build(template, k, exact)
 
     def fn(arrays, scalars, num_docs):
         results = jax.vmap(single_fn)(arrays, scalars, num_docs)
-        sort_vals, sort_vals2, doc_ids, hit_scores, counts, agg_out = results
+        sort_vals, sort_vals2, doc_ids, hit_scores, counts, topk_safe, \
+            agg_out = results
         total = jnp.sum(counts)
+        # one certificate for the whole batch: any unsafe split taints the
+        # cross-split merge, so the host re-runs the batch exactly
+        safe = jnp.min(topk_safe)
         if k == 0:  # count/agg-only: no cross-split hit merge
             empty_i = jnp.zeros((0,), jnp.int32)
             return (jnp.zeros((0,), sort_vals.dtype), None, empty_i, empty_i,
-                    jnp.zeros((0,), hit_scores.dtype), total,
+                    jnp.zeros((0,), hit_scores.dtype), total, safe,
                     _merge_agg_stack(agg_out))
         # flatten [n, k] → [n*k]; split-major order keeps the
         # (key desc, split asc, doc asc) tie-break of the collector
@@ -375,17 +381,26 @@ def batch_fn(batch: SplitBatch, k: int):
         flat_ids = doc_ids.reshape(-1)[pos]
         flat_scores = hit_scores.reshape(-1)[pos]
         return top_vals, top_vals2, split_idx, flat_ids, flat_scores, \
-            total, _merge_agg_stack(agg_out)
+            total, safe, _merge_agg_stack(agg_out)
 
     return fn
 
 
+def _donate_batch_inputs() -> bool:
+    """Donate the stacked batch arrays to the executor so XLA reuses their
+    HBM as scratch: the stacks are per-request copies of the column data
+    (the resident per-split arrays are NOT what is donated) and are
+    invalidated after the dispatch that consumed them. CPU PJRT does not
+    implement donation and warns per compile, so gate on backend."""
+    return jax.default_backend() != "cpu"
+
+
 def _batch_executor(batch: SplitBatch, k: int, mesh: Optional[Mesh],
-                    example_args):
+                    example_args, exact: bool = False):
     """(jitted_packed_fn, treedef, spec): the merged result tree rides ONE
     f64 device array so the readback is a single transfer (see
     executor.py packed-readback rationale; exactness argument identical)."""
-    fn = batch_fn(batch, k)
+    fn = batch_fn(batch, k, exact)
     shaped = jax.eval_shape(fn, *example_args)
     treedef = jax.tree_util.tree_structure(shaped)
     spec = [(leaf.shape, leaf.dtype)
@@ -397,10 +412,12 @@ def _batch_executor(batch: SplitBatch, k: int, mesh: Optional[Mesh],
                 for leaf in jax.tree_util.tree_leaves(out)]
         return jnp.concatenate(flat) if flat else jnp.zeros((0,))
 
+    donate = (0,) if _donate_batch_inputs() else ()
     if mesh is None:
-        return jax.jit(packed), treedef, spec
+        return jax.jit(packed, donate_argnums=donate), treedef, spec
     arrays_sh, scalars_sh, nd_sh = batch_shardings(batch, mesh)
-    return (jax.jit(packed, in_shardings=(arrays_sh, scalars_sh, nd_sh)),
+    return (jax.jit(packed, in_shardings=(arrays_sh, scalars_sh, nd_sh),
+                    donate_argnums=donate),
             treedef, spec)
 
 
@@ -414,6 +431,15 @@ def stage_device_inputs(batch: SplitBatch, mesh: Optional[Mesh] = None):
     if cache is None:
         cache = batch._device_inputs = {}
     dev = cache.get(mesh)
+    if dev is not None:
+        # re-dispatch of an already-staged batch (hedged retry, readback
+        # replay): record the skip so the waterfall shows where staging
+        # would have been
+        with profiled_phase(PHASE_STAGING_CACHE_HIT) as rec:
+            if rec is not None:
+                rec["bytes"] = 0
+                rec["stage"] = "batch"
+        return dev
     if dev is None:
         staging_bytes = (sum(a.nbytes for a in batch.arrays)
                          + sum(s.nbytes for s in batch.scalars)
@@ -421,7 +447,7 @@ def stage_device_inputs(batch: SplitBatch, mesh: Optional[Mesh] = None):
         # staging times the transfer DISPATCH (device_put is async;
         # completion overlaps into the execute phase by design — same
         # contract as the per-split warmup in search/leaf.py)
-        with profiled_phase(PHASE_STAGING) as rec:
+        with profiled_phase(PHASE_STAGING_UPLOAD) as rec:
             if rec is not None:
                 rec["bytes"] = staging_bytes
                 rec["stage"] = "batch"
@@ -443,14 +469,43 @@ def stage_device_inputs(batch: SplitBatch, mesh: Optional[Mesh] = None):
     return dev
 
 
-def execute_batch(batch: SplitBatch, request: SearchRequest,
-                  mesh: Optional[Mesh] = None) -> LeafSearchResponse:
-    """Run the batch (optionally mesh-sharded) and emit one merged
-    LeafSearchResponse covering all splits."""
+# Mesh programs contain cross-device collectives (the on-mesh merge's
+# psums/all-reduces). Two such programs enqueued concurrently from
+# different query threads can interleave their per-device rendezvous
+# (thread A first on device 0, thread B first on device 1) and deadlock —
+# observed as 5s+ AllReduceParticipantData stalls under the soak suite's
+# 8-thread storm on the 8-fake-device CPU host platform. Enqueue is
+# therefore serialized; on real hardware the per-device streams then
+# execute programs in one consistent order and the enqueue itself is a
+# cheap async launch. The CPU host platform has NO ordered streams (a
+# shared thread pool with data-dependency ordering only), so there the
+# program must also COMPLETE before the lock releases. Single-device
+# dispatches (mesh is None) carry no collectives and take no lock.
+_MESH_DISPATCH_LOCK = threading.Lock()
+
+
+def _enqueue_batch(ex, arrays, scalars, nd, mesh):
+    if mesh is None:
+        return ex(arrays, scalars, nd)
+    with _MESH_DISPATCH_LOCK:
+        out = ex(arrays, scalars, nd)
+        if jax.default_backend() == "cpu":
+            jax.block_until_ready(out)
+        return out
+
+
+def dispatch_batch(batch: SplitBatch, request: SearchRequest,
+                   mesh: Optional[Mesh] = None, exact: bool = False):
+    """Async half of the fused batch dispatch: stage (or reuse) the device
+    inputs, enqueue ONE XLA program over all splits, start the D2H copy of
+    the packed result, and return without blocking. `readback_batch`
+    completes it — the seam lets the service shed deadline-expired queries
+    before ever paying the readback wait, and overlap the next group's
+    dispatch with this one's readback."""
     # k=0 (count/agg-only): per-split executors skip keying/top-k and the
     # batch merge skips the cross-split top_k
     k = min(request.start_offset + request.max_hits, batch.num_docs_padded)
-    if batch.template.threshold_slot >= 0:
+    if batch.template.threshold_slot >= 0 and not exact:
         from ..observability.metrics import SEARCH_KERNEL_THRESHOLD_TOTAL
         # one dispatch, but each real lane's docs are threshold-masked
         SEARCH_KERNEL_THRESHOLD_TOTAL.inc(
@@ -458,15 +513,16 @@ def execute_batch(batch: SplitBatch, request: SearchRequest,
     arrays, scalars, nd = stage_device_inputs(batch, mesh)
     # Mesh is hashable; id() would go stale if a dead mesh's address is reused
     key = (batch.template.signature(k), batch.n_splits,
-           batch.num_docs_padded, mesh)
+           batch.num_docs_padded, mesh, exact)
     profile = current_profile()
     cached = _BATCH_JIT_CACHE.get(key)
     if profile is None:
         if cached is None:
-            cached = _batch_executor(batch, k, mesh, (arrays, scalars, nd))
+            cached = _batch_executor(batch, k, mesh, (arrays, scalars, nd),
+                                     exact)
             _BATCH_JIT_CACHE[key] = cached
         ex, treedef, spec = cached
-        packed = jax.device_get(ex(arrays, scalars, nd))
+        out = _enqueue_batch(ex, arrays, scalars, nd, mesh)
     else:
         # Compile-vs-execute attribution (same lazy-jit approximation as
         # executor.dispatch_plan): on a batch-jit-cache MISS the first call
@@ -478,10 +534,31 @@ def execute_batch(batch: SplitBatch, request: SearchRequest,
                            stage="dispatch_batch"):
             if cached is None:
                 cached = _batch_executor(batch, k, mesh,
-                                         (arrays, scalars, nd))
+                                         (arrays, scalars, nd), exact)
                 _BATCH_JIT_CACHE[key] = cached
             ex, treedef, spec = cached
-            out = ex(arrays, scalars, nd)
+            out = _enqueue_batch(ex, arrays, scalars, nd, mesh)
+    if _donate_batch_inputs():
+        # the stacked inputs were donated into this dispatch — drop the
+        # staging-cache entry so nothing touches the dead buffers
+        cache = getattr(batch, "_device_inputs", None)
+        if cache is not None:
+            cache.pop(mesh, None)
+    if hasattr(out, "copy_to_host_async"):
+        out.copy_to_host_async()
+    return out, treedef, spec, (batch, request, mesh, k)
+
+
+def readback_batch(dispatched) -> LeafSearchResponse:
+    """Blocking half of the fused batch dispatch: await the packed scalar
+    readback, unpack, host-decode the merged hits/aggs. A `safe == 0`
+    guided-top-k certificate triggers one exact re-execution of the whole
+    batch (see ops/topk.py:guided_topk)."""
+    out, treedef, spec, (batch, request, mesh, k) = dispatched
+    profile = current_profile()
+    if profile is None:
+        packed = jax.device_get(out)
+    else:
         with profile.phase(PHASE_EXECUTE, stage="readback"):
             packed = jax.device_get(out)
     leaves = []
@@ -491,8 +568,11 @@ def execute_batch(batch: SplitBatch, request: SearchRequest,
         leaves.append(packed[offset: offset + size]
                       .astype(dtype).reshape(shape))
         offset += size
-    top_vals, top_vals2, split_idx, doc_ids, scores, total, merged_aggs = \
-        jax.tree_util.tree_unflatten(treedef, leaves)
+    top_vals, top_vals2, split_idx, doc_ids, scores, total, topk_safe, \
+        merged_aggs = jax.tree_util.tree_unflatten(treedef, leaves)
+    if float(topk_safe) < 1.0:
+        executor_mod._note_guided_fallback()
+        return execute_batch(batch, request, mesh, exact=True)
 
     num_hits = int(total)
     hits: list[PartialHit] = []
@@ -546,3 +626,11 @@ def execute_batch(batch: SplitBatch, request: SearchRequest,
         num_successful_splits=real_splits,
         intermediate_aggs=intermediate,
     )
+
+
+def execute_batch(batch: SplitBatch, request: SearchRequest,
+                  mesh: Optional[Mesh] = None,
+                  exact: bool = False) -> LeafSearchResponse:
+    """Run the batch (optionally mesh-sharded) and emit one merged
+    LeafSearchResponse covering all splits."""
+    return readback_batch(dispatch_batch(batch, request, mesh, exact))

@@ -194,6 +194,7 @@ class QueryBatcher:
                         pending.profile.mark_partial("shed: batcher dispatch")
                     pending.error = DeadlineExceeded("batched dispatch")
                     pending.event.set()
+                readback_fn = None
                 try:
                     if alive:
                         now = time.monotonic()
@@ -214,16 +215,20 @@ class QueryBatcher:
                         if self.fault_injector is not None:
                             self.fault_injector.perturb("batcher.dispatch")
                         if len(alive) == 1 and alive[0] is me:
+                            # lone query: nobody queues behind a convoy of
+                            # one, so dispatch + readback run inline — the
+                            # seed path, byte-identical latency profile
                             results = [executor.execute_plan(plan, k,
                                                              device_arrays)]
+                            for pending, result in zip(alive, results):
+                                pending.result = result
+                                pending.event.set()
                         else:
-                            results = executor.readback_plan_multi(
-                                executor.dispatch_plan_multi(
-                                    plan, k, device_arrays,
-                                    [p.scalars for p in alive]))
-                        for pending, result in zip(alive, results):
-                            pending.result = result
-                            pending.event.set()
+                            dispatched = executor.dispatch_plan_multi(
+                                plan, k, device_arrays,
+                                [p.scalars for p in alive])
+                            readback_fn = (lambda d=dispatched:
+                                           executor.readback_plan_multi(d))
                 # qwlint: disable-next-line=QW004 - the dispatch error is
                 # fanned to every batched waiter and re-raised per-waiter
                 # via _waiter_error; nothing is swallowed
@@ -232,7 +237,36 @@ class QueryBatcher:
                         pending.error = exc
                         pending.event.set()
             finally:
+                # released after DISPATCH, before the blocking readback:
+                # the next convoy for this key overlaps its dispatch with
+                # our device->host wait (the async-readback pipeline)
                 dispatch_lock.release()
+            if readback_fn is not None:
+                try:
+                    still_wanted = [p for p in alive
+                                    if p.deadline is None
+                                    or not p.deadline.expired]
+                    if not still_wanted:
+                        # every rider's budget ran out while the kernel
+                        # flew: nobody can use the answer, so the
+                        # device->host transfer is never awaited
+                        from .residency import RESIDENT_READBACKS_SHED
+                        RESIDENT_READBACKS_SHED.inc()
+                        for pending in alive:
+                            pending.error = DeadlineExceeded(
+                                "batched readback shed")
+                            pending.event.set()
+                    else:
+                        results = readback_fn()
+                        for pending, result in zip(alive, results):
+                            pending.result = result
+                            pending.event.set()
+                # qwlint: disable-next-line=QW004 - fanned to waiters and
+                # re-raised per-waiter, same contract as the dispatch side
+                except Exception as exc:  # noqa: BLE001 - fan to waiters
+                    for pending in alive:
+                        pending.error = exc
+                        pending.event.set()
         finally:
             with self._lock:
                 entry = self._dispatch_locks.get(key)

@@ -17,8 +17,10 @@ from typing import Any, Optional
 
 import numpy as np
 
-from ..ops.aggs import PCTL_NUM_BUCKETS, hll_estimate, sketch_quantiles
+from ..ops.aggs import (PCTL_NUM_BUCKETS, hll_estimate, merge_stats_states,
+                        sketch_quantiles)
 from ..query.aggregations import DEFAULT_PERCENTS
+from .hostdecode import host_array, host_float, host_int, host_list
 from .models import LeafSearchResponse, PartialHit
 
 
@@ -138,10 +140,8 @@ class IncrementalCollector:
             # HLL registers merge by elementwise max
             current["hll"] = np.maximum(current["hll"], state["hll"])
         else:  # metric state [count,sum,sum_sq,min,max]
-            a, b = current["state"], state["state"]
-            current["state"] = np.array([
-                a[0] + b[0], a[1] + b[1], a[2] + b[2],
-                min(a[3], b[3]), max(a[4], b[4])])
+            current["state"] = merge_stats_states(current["state"],
+                                                  state["state"])
 
     # ------------------------------------------------------------------
     def _order_key(self, h: PartialHit):
@@ -256,7 +256,7 @@ def _composite_pairs(state: dict[str, Any]):
             # entry[3] is this bucket's run index into the flattened
             # child states: decode its nested children like any other
             # parent bucket kind
-            _attach_sub_maps(bucket, state, int(entry[3]))
+            _attach_sub_maps(bucket, state, host_int(entry[3]))
         out.append((tuple(values), bucket))
     return out
 
@@ -284,9 +284,9 @@ def _finalize_composite(state: dict[str, Any]) -> dict[str, Any]:
         key: dict[str, Any] = {}
         for value, info in zip(key_tuple, sources):
             if info["kind"] == "date_histogram" and value is not None:
-                value = int(value) // 1000  # micros → ES integer ms
+                value = host_int(value) // 1000  # micros → ES integer ms
             key[info["name"]] = value
-        entry = {"key": key, "doc_count": int(bucket["doc_count"])}
+        entry = {"key": key, "doc_count": host_int(bucket["doc_count"])}
         for mname, acc in bucket["metrics"].items():
             entry[mname] = _finalize_metric(acc)
         for child_info in (state.get("sub_infos") or ()):
@@ -304,7 +304,7 @@ def _range_to_map(state: dict[str, Any]) -> dict:
     """Range buckets keyed by their static range index (all emitted)."""
     if "bucket_map" in state:  # already-merged state (tree merging at root)
         return _copy_bucket_map(state["bucket_map"])
-    counts = np.asarray(state["counts"])
+    counts = host_array(state["counts"])
     out = {}
     for i in range(len(state["ranges"])):
         acc_metrics = {}
@@ -315,7 +315,7 @@ def _range_to_map(state: dict[str, Any]) -> dict:
                 state.get("metric_keyed", {}).get(name, True))
             _acc_metric(acc, arrays, i)
             acc_metrics[name] = acc
-        out[i] = {"doc_count": int(counts[i]) if i < len(counts) else 0,
+        out[i] = {"doc_count": host_int(counts[i]) if i < len(counts) else 0,
                   "metrics": acc_metrics}
     return out
 
@@ -346,21 +346,21 @@ def _new_metric_acc(kind: str, percents=None, keyed: bool = True) -> dict[str, A
 
 def _acc_metric(acc: dict[str, Any], arrays: dict[str, np.ndarray], i: int) -> None:
     if "sum" in arrays:
-        acc["sum"] += float(arrays["sum"][i])
+        acc["sum"] += host_float(arrays["sum"][i])
     if "count" in arrays:
-        acc["count"] += int(arrays["count"][i])
+        acc["count"] += host_int(arrays["count"][i])
     if "min" in arrays:
-        acc["min"] = min(acc["min"], float(arrays["min"][i]))
+        acc["min"] = min(acc["min"], host_float(arrays["min"][i]))
     if "max" in arrays:
-        acc["max"] = max(acc["max"], float(arrays["max"][i]))
+        acc["max"] = max(acc["max"], host_float(arrays["max"][i]))
     if "sum_sq" in arrays:
-        acc["sum_sq"] += float(arrays["sum_sq"][i])
+        acc["sum_sq"] += host_float(arrays["sum_sq"][i])
     if "sketch" in arrays:
-        row = np.asarray(arrays["sketch"][i])
+        row = host_array(arrays["sketch"][i])
         # non-inplace add: accs are shallow-copied by _copy_bucket_map
         acc["sketch"] = row if acc["sketch"] is None else acc["sketch"] + row
     if "hll" in arrays:
-        row = np.asarray(arrays["hll"][i])
+        row = host_array(arrays["hll"][i])
         # HLL registers merge by elementwise max (non-inplace, as above)
         acc["hll"] = row if acc.get("hll") is None \
             else np.maximum(acc["hll"], row)
@@ -405,7 +405,7 @@ def _attach_sub_maps(bucket: dict, state: dict, parent_flat: int) -> None:
             key = _sub_key(sub, j)
             if key is None:
                 continue
-            child = {"doc_count": int(counts[flat]), "metrics": {}}
+            child = {"doc_count": host_int(counts[flat]), "metrics": {}}
             for mname, arrays in sub.get("metrics", {}).items():
                 acc = _new_metric_acc(metric_kinds.get(mname, "avg"),
                                       metric_percents.get(mname),
@@ -429,16 +429,16 @@ def _histogram_to_map(state: dict[str, Any]) -> dict[float, dict[str, Any]]:
     metric_kinds = state.get("metric_kinds", {})
     metric_percents = state.get("metric_percents", {})
     metric_keyed = state.get("metric_keyed", {})
-    for i in nonzero:
-        key = origin + int(i) * interval
-        bucket = {"doc_count": int(counts[i]), "metrics": {}}
+    for i in host_list(nonzero):
+        key = origin + i * interval
+        bucket = {"doc_count": host_int(counts[i]), "metrics": {}}
         for mname, arrays in state.get("metrics", {}).items():
             acc = _new_metric_acc(metric_kinds.get(mname, "avg"),
                                   metric_percents.get(mname),
                                   metric_keyed.get(mname, True))
-            _acc_metric(acc, arrays, int(i))
+            _acc_metric(acc, arrays, i)
             bucket["metrics"][mname] = acc
-        _attach_sub_maps(bucket, state, int(i))
+        _attach_sub_maps(bucket, state, i)
         out[key] = bucket
     return out
 
@@ -452,17 +452,17 @@ def _terms_to_map(state: dict[str, Any]) -> dict[Any, dict[str, Any]]:
     metric_percents = state.get("metric_percents", {})
     metric_keyed = state.get("metric_keyed", {})
     out: dict[Any, dict[str, Any]] = {}
-    for i in np.nonzero(counts)[0]:
+    for i in host_list(np.nonzero(counts)[0]):
         if i >= len(keys):
             continue
-        bucket = {"doc_count": int(counts[i]), "metrics": {}}
+        bucket = {"doc_count": host_int(counts[i]), "metrics": {}}
         for mname, arrays in state.get("metrics", {}).items():
             acc = _new_metric_acc(metric_kinds.get(mname, "avg"),
                                   metric_percents.get(mname),
                                   metric_keyed.get(mname, True))
-            _acc_metric(acc, arrays, int(i))
+            _acc_metric(acc, arrays, i)
             bucket["metrics"][mname] = acc
-        _attach_sub_maps(bucket, state, int(i))
+        _attach_sub_maps(bucket, state, i)
         out[keys[i]] = bucket
     return out
 
@@ -601,7 +601,7 @@ def _quantile_values(sketch, percents, keyed: bool = True):
     if keyed:
         return {f"{p:g}": (None if np.isnan(v) else v)
                 for p, v in zip(percents, quantiles)}
-    return [{"key": float(p), "value": (None if np.isnan(v) else v)}
+    return [{"key": host_float(p), "value": (None if np.isnan(v) else v)}
             for p, v in zip(percents, quantiles)]
 
 
@@ -633,7 +633,7 @@ def _finalize_bucket_map(bucket_map: dict, info: dict[str, Any],
                                  "doc_count": bucket["doc_count"]}
         if kind == "date_histogram":
             from ..utils.datetime_utils import format_micros_rfc3339
-            entry["key_as_string"] = format_micros_rfc3339(int(key))
+            entry["key_as_string"] = format_micros_rfc3339(host_int(key))
         for mname, acc in bucket["metrics"].items():
             entry[mname] = _finalize_metric(acc)
         for child_info in (sub_infos or ()):
@@ -677,10 +677,10 @@ def _finalize_bucket_map(bucket_map: dict, info: dict[str, Any],
         total_other = (sum(b["doc_count"] for _, b in items[size:])
                        + info.get("other_docs", 0))
         return {"buckets": [entry_for(k, b, k) for k, b in items[:size]],
-                "sum_other_doc_count": int(total_other),
+                "sum_other_doc_count": host_int(total_other),
                 # nonzero only under split_size truncation: per-split
                 # largest-dropped counts summed at merge
-                "doc_count_error_upper_bound": int(
+                "doc_count_error_upper_bound": host_int(
                     info.get("error_bound", 0))}
 
     # histograms
@@ -698,7 +698,7 @@ def _finalize_bucket_map(bucket_map: dict, info: dict[str, Any],
                      + offset)
             hi = max(hi, ((bounds[1] - offset) // interval) * interval
                      + offset)
-        num = int(round((hi - lo) / interval)) + 1
+        num = host_int(round((hi - lo) / interval)) + 1
         # leaf planning caps per-split ranges, but the merged range across
         # splits/nodes with disjoint time ranges can be far wider — apply
         # the AggregationLimitsGuard cap here too, like the reference does
@@ -757,7 +757,8 @@ def finalize_aggregations(agg_states: dict[str, Any]) -> dict[str, Any]:
             out[name] = {"value": round(hll_estimate(state["hll"]))}
         else:
             c, s, s2, mn, mx = state["state"]
-            acc = {"kind": kind, "count": int(c), "sum": float(s),
-                   "sum_sq": float(s2), "min": float(mn), "max": float(mx)}
+            acc = {"kind": kind, "count": host_int(c),
+                   "sum": host_float(s), "sum_sq": host_float(s2),
+                   "min": host_float(mn), "max": host_float(mx)}
             out[name] = _finalize_metric(acc)
     return out

@@ -387,7 +387,8 @@ class _GatherView:
         return g.astype(scale.dtype) * scale + fmin
 
 
-def _build_posting_space(plan: LoweredPlan, k: int) -> Callable:
+def _build_posting_space(plan: LoweredPlan, k: int,
+                         exact: bool = False) -> Callable:
     root, sort, aggs = plan.root, plan.sort, plan.aggs
     padded = plan.num_docs_padded
 
@@ -403,7 +404,7 @@ def _build_posting_space(plan: LoweredPlan, k: int) -> Callable:
             agg_out = _eval_aggs(aggs, gathered, scalars, valid)
             return (jnp.zeros((0,), jnp.float64), None,
                     jnp.zeros((0,), jnp.int32), jnp.zeros((0,), jnp.float32),
-                    count, tuple(agg_out))
+                    count, jnp.float64(1.0), tuple(agg_out))
         from ..ops.pallas import fused_score_topk, pallas_available
         if (sort.by == "score" and sort.by2 == "none" and root.scoring
                 and pallas_available() and k <= 64
@@ -418,11 +419,12 @@ def _build_posting_space(plan: LoweredPlan, k: int) -> Callable:
                 interpret=jax.default_backend() == "cpu")
             sort_vals = vals_f32.astype(jnp.float64)
             doc_ids = ids[pos]
-            hit_scores = jnp.where(jnp.isneginf(vals_f32), 0.0, vals_f32)
+            hit_scores = jnp.where(mask_ops.dead_lane_mask(vals_f32),
+                                   0.0, vals_f32)
             gathered = _GatherView(arrays, safe_ids, scalars, plan.rebase)
             agg_out = _eval_aggs(aggs, gathered, scalars, valid)
             return sort_vals, None, doc_ids.astype(jnp.int32), hit_scores, \
-                count, tuple(agg_out)
+                count, jnp.float64(1.0), tuple(agg_out)
         if root.scoring:
             scores = score_postings(
                 tfs, ids, arrays[root.norm_slot],
@@ -439,22 +441,26 @@ def _build_posting_space(plan: LoweredPlan, k: int) -> Callable:
             keyed = topk_ops.apply_threshold_mask(
                 keyed, scalars[plan.threshold_slot])
         kk = min(k, num_postings)
+        topk_safe = jnp.float64(1.0)
         if sort.by2 == "none":
-            sort_vals, pos = topk_ops.exact_topk(keyed, kk)
+            if exact:
+                sort_vals, pos = topk_ops.exact_topk(keyed, kk)
+            else:
+                sort_vals, pos, topk_safe = topk_ops.guided_topk(keyed, kk)
             sort_vals2 = None
         else:
             keyed2 = _keyed_for(sort.by2, sort.descending2, sort.values2_slot,
                                 sort.present2_slot, gathered, valid, scores,
                                 ids)
             if plan.threshold_slot >= 0:
-                keyed2 = jnp.where(jnp.isneginf(keyed), -jnp.inf, keyed2)
+                keyed2 = mask_ops.propagate_dead_lanes(keyed, keyed2)
             sort_vals, sort_vals2, pos = topk_ops.exact_topk_2key(
                 keyed, keyed2, kk)
         doc_ids = ids[pos]
         hit_scores = scores[pos]
         agg_out = _eval_aggs(aggs, gathered, scalars, valid)
         return sort_vals, sort_vals2, doc_ids.astype(jnp.int32), hit_scores, \
-            count, tuple(agg_out)
+            count, topk_safe, tuple(agg_out)
 
     return fn
 
@@ -618,9 +624,9 @@ def _eval_aggs(aggs, gathered, scalars, valid):
     return agg_out
 
 
-def _build(plan: LoweredPlan, k: int) -> Callable:
+def _build(plan: LoweredPlan, k: int, exact: bool = False) -> Callable:
     if _posting_space_eligible(plan):
-        return _build_posting_space(plan, k)
+        return _build_posting_space(plan, k, exact)
     padded = plan.num_docs_padded
     root, sort, aggs = plan.root, plan.sort, plan.aggs
 
@@ -715,7 +721,7 @@ def _build(plan: LoweredPlan, k: int) -> Callable:
             agg_out = _eval_aggs(aggs, view, scalars, mask)
             return (jnp.zeros((0,), jnp.float64), None,
                     jnp.zeros((0,), jnp.int32), jnp.zeros((0,), jnp.float32),
-                    count, tuple(agg_out))
+                    count, jnp.float64(1.0), tuple(agg_out))
         doc_key = jnp.arange(padded, dtype=jnp.int32)
         keyed = _keyed_for(sort.by, sort.descending, sort.values_slot,
                            sort.present_slot, view, mask, scores, doc_key)
@@ -734,9 +740,13 @@ def _build(plan: LoweredPlan, k: int) -> Callable:
             keyed = topk_ops.apply_threshold_mask(
                 keyed, scalars[plan.threshold_slot])
             if keyed2 is not None:
-                keyed2 = jnp.where(jnp.isneginf(keyed), -jnp.inf, keyed2)
+                keyed2 = mask_ops.propagate_dead_lanes(keyed, keyed2)
+        topk_safe = jnp.float64(1.0)
         if keyed2 is None:
-            sort_vals, doc_ids = topk_ops.exact_topk(keyed, k)
+            if exact:
+                sort_vals, doc_ids = topk_ops.exact_topk(keyed, k)
+            else:
+                sort_vals, doc_ids, topk_safe = topk_ops.guided_topk(keyed, k)
             sort_vals2 = None
         else:
             sort_vals, sort_vals2, doc_ids = topk_ops.exact_topk_2key(
@@ -745,16 +755,17 @@ def _build(plan: LoweredPlan, k: int) -> Callable:
         count = jnp.sum(mask.astype(jnp.int32))
         hit_scores = scores[jnp.clip(doc_ids, 0, padded - 1)]
         agg_out = _eval_aggs(aggs, view, scalars, mask)
-        return sort_vals, sort_vals2, doc_ids, hit_scores, count, tuple(agg_out)
+        return sort_vals, sort_vals2, doc_ids, hit_scores, count, topk_safe, \
+            tuple(agg_out)
 
     return fn
 
 
-def get_executor(plan: LoweredPlan, k: int) -> Callable:
-    key = plan.signature(k)
+def get_executor(plan: LoweredPlan, k: int, exact: bool = False) -> Callable:
+    key = (plan.signature(k), exact)
     cached = _JIT_CACHE.get(key)
     if cached is None:
-        cached = jax.jit(_build(plan, k))
+        cached = jax.jit(_build(plan, k, exact))
         _JIT_CACHE[key] = cached
     return cached
 
@@ -772,11 +783,12 @@ def get_executor(plan: LoweredPlan, k: int) -> Callable:
 _PACKED_CACHE: dict[tuple, tuple] = {}
 
 
-def _get_packed_executor(plan: LoweredPlan, k: int, example_args):
-    key = plan.signature(k)
+def _get_packed_executor(plan: LoweredPlan, k: int, example_args,
+                         exact: bool = False):
+    key = (plan.signature(k), exact)
     cached = _PACKED_CACHE.get(key)
     if cached is None:
-        fn = _build(plan, k)
+        fn = _build(plan, k, exact)
         shaped = jax.eval_shape(fn, *example_args)
         treedef = jax.tree_util.tree_structure(shaped)
         leaves = jax.tree_util.tree_leaves(shaped)
@@ -840,11 +852,11 @@ def _batch_bucket(n: int) -> int:
 # qwlint: disable-next-line=QW001 - np.asarray on host scalar tuples for
 # jax.eval_shape (trace-time, no data movement)
 def _get_packed_multi_executor(plan: LoweredPlan, k: int, batch: int,
-                               device_arrays):
-    key = (plan.signature(k), batch)
+                               device_arrays, exact: bool = False):
+    key = (plan.signature(k), batch, exact)
     cached = _MULTI_CACHE.get(key)
     if cached is None:
-        fn = _build(plan, k)
+        fn = _build(plan, k, exact)
         # eval_shape only consumes shapes/dtypes — numpy example scalars
         # avoid touching the device (a device upload here would cost the
         # very transfer round this path exists to avoid)
@@ -900,8 +912,8 @@ def _device_multi_scalars(plan: LoweredPlan, scalar_sets, use_cache=True):
 
 def dispatch_plan_multi(plan: LoweredPlan, k: int,
                         device_arrays: list[jax.Array],
-                        scalar_sets: list, cache_scalars: bool = True
-                        ) -> tuple:
+                        scalar_sets: list, cache_scalars: bool = True,
+                        exact: bool = False) -> tuple:
     """Async dispatch of len(scalar_sets) same-structure queries as ONE
     XLA program + ONE packed readback buffer. Each element of
     `scalar_sets` is a full per-query scalar tuple (plan.scalars layout).
@@ -916,21 +928,22 @@ def dispatch_plan_multi(plan: LoweredPlan, k: int,
     profile = current_profile()
     if profile is None:
         executor, treedef, spec = _get_packed_multi_executor(
-            plan, k, bucket, device_arrays)
+            plan, k, bucket, device_arrays, exact)
         out = executor(tuple(device_arrays), scal_b, nd_b)
     else:
         # same lazy-jit attribution as dispatch_plan, keyed per batch
         # bucket (each bucket size compiles its own vmapped program)
-        hit = (plan.signature(k), bucket) in _MULTI_CACHE
+        hit = (plan.signature(k), bucket, exact) in _MULTI_CACHE
         profile.add("compile_cache_hits" if hit else "compile_cache_misses")
         with profile.phase(PHASE_EXECUTE if hit else PHASE_COMPILE,
                            stage="dispatch_multi"):
             executor, treedef, spec = _get_packed_multi_executor(
-                plan, k, bucket, device_arrays)
+                plan, k, bucket, device_arrays, exact)
             out = executor(tuple(device_arrays), scal_b, nd_b)
     if hasattr(out, "copy_to_host_async"):
         out.copy_to_host_async()
-    return out, treedef, spec, batch
+    return out, treedef, spec, batch, (plan, k, device_arrays,
+                                       list(scalar_sets), cache_scalars)
 
 
 # qwlint: disable-next-line=QW001 - THE sanctioned packed-readback seam:
@@ -947,13 +960,20 @@ def _profiled_device_get(packed):
 # qwlint: disable-next-line=QW001 - batch variant of the sanctioned seam;
 # one transfer for the whole batch, then host-side unpack
 def readback_plan_multi(dispatched) -> list[dict[str, Any]]:
-    """ONE device→host transfer for the whole batch; per-lane unpack."""
-    packed, treedef, spec, batch = dispatched
+    """ONE device→host transfer for the whole batch; per-lane unpack.
+
+    Lanes whose guided top-k screen reports `safe == 0` (an f32 boundary
+    tie that could reorder f64 winners — see ops/topk.py:guided_topk) are
+    re-dispatched as one exact batch and spliced back in."""
+    packed, treedef, spec, batch, redispatch = dispatched
     host = np.asarray(_profiled_device_get(packed))
     results = []
+    unsafe_lanes = []
     for lane in range(batch):
-        sort_vals, sort_vals2, doc_ids, hit_scores, count, agg_out = \
-            _unpack_result(host[lane], treedef, spec)
+        sort_vals, sort_vals2, doc_ids, hit_scores, count, topk_safe, \
+            agg_out = _unpack_result(host[lane], treedef, spec)
+        if float(topk_safe) < 1.0:
+            unsafe_lanes.append(lane)
         results.append({
             "sort_values": sort_vals,
             "sort_values2": sort_vals2,
@@ -962,42 +982,64 @@ def readback_plan_multi(dispatched) -> list[dict[str, Any]]:
             "count": int(count),
             "aggs": list(agg_out),
         })
+    if unsafe_lanes:
+        plan, k, device_arrays, scalar_sets, cache_scalars = redispatch
+        _note_guided_fallback(len(unsafe_lanes))
+        exact = readback_plan_multi(dispatch_plan_multi(
+            plan, k, device_arrays,
+            [scalar_sets[lane] for lane in unsafe_lanes],
+            cache_scalars=cache_scalars, exact=True))
+        for lane, res in zip(unsafe_lanes, exact):
+            results[lane] = res
     return results
 
 
 def dispatch_plan(plan: LoweredPlan, k: int,
-                  device_arrays: list[jax.Array]):
-    """Async dispatch: returns (packed_device_array, treedef, spec) WITHOUT
-    reading back — the pipelining seam (dispatch query i+1 before the
-    readback of query i so concurrent queries amortize the host↔device
+                  device_arrays: list[jax.Array], exact: bool = False):
+    """Async dispatch: returns (packed_device_array, treedef, spec, ...)
+    WITHOUT reading back — the pipelining seam (dispatch query i+1 before
+    the readback of query i so concurrent queries amortize the host↔device
     RTT). The whole result tree rides ONE device array (see the packed-
-    readback block above)."""
+    readback block above); `copy_to_host_async` starts the D2H transfer so
+    the later blocking readback only waits out the remainder."""
     k = max(0, min(k, plan.num_docs_padded))
     scalars, num_docs = _device_scalars(plan)
     args = (tuple(device_arrays), scalars, num_docs)
     profile = current_profile()
     if profile is None:
-        executor, treedef, spec = _get_packed_executor(plan, k, args)
-        return executor(*args), treedef, spec
-    # Compile-vs-execute attribution: jax.jit compiles lazily on first
-    # call, so on a packed-cache MISS this dispatch's wall time is
-    # trace+XLA-compile (the dispatch itself is an async enqueue); on a
-    # HIT it is a cheap enqueue counted toward execute. The approximation
-    # is documented in docs/observability.md.
-    hit = plan.signature(k) in _PACKED_CACHE
-    profile.add("compile_cache_hits" if hit else "compile_cache_misses")
-    with profile.phase(PHASE_EXECUTE if hit else PHASE_COMPILE,
-                       stage="dispatch"):
-        executor, treedef, spec = _get_packed_executor(plan, k, args)
-        return executor(*args), treedef, spec
+        executor, treedef, spec = _get_packed_executor(plan, k, args, exact)
+        out = executor(*args)
+    else:
+        # Compile-vs-execute attribution: jax.jit compiles lazily on first
+        # call, so on a packed-cache MISS this dispatch's wall time is
+        # trace+XLA-compile (the dispatch itself is an async enqueue); on a
+        # HIT it is a cheap enqueue counted toward execute. The
+        # approximation is documented in docs/observability.md.
+        hit = (plan.signature(k), exact) in _PACKED_CACHE
+        profile.add("compile_cache_hits" if hit else "compile_cache_misses")
+        with profile.phase(PHASE_EXECUTE if hit else PHASE_COMPILE,
+                           stage="dispatch"):
+            executor, treedef, spec = _get_packed_executor(
+                plan, k, args, exact)
+            out = executor(*args)
+    if hasattr(out, "copy_to_host_async"):
+        out.copy_to_host_async()
+    return out, treedef, spec, (plan, k, device_arrays)
+
+
+def _note_guided_fallback(n: int = 1) -> None:
+    """Count guided-top-k exact re-dispatches (f32 screen tie detected)."""
+    from ..observability.metrics import METRICS
+    METRICS.counter("qw_topk_guided_fallback_total").inc(n)
 
 
 # qwlint: disable-next-line=QW001 - the sanctioned seam's single-plan
 # entry point; the blocking device_get IS the measured readback
 def readback_plan_result(dispatched) -> dict[str, Any]:
     """ONE device→host transfer for the entire result tree, unpacked by
-    the trace-time spec."""
-    packed, treedef, spec = dispatched
+    the trace-time spec. A guided top-k lane reporting `safe == 0` is
+    re-executed with the exact blockwise kernel before returning."""
+    packed, treedef, spec, redispatch = dispatched
     profile = current_profile()
     if profile is None:
         host = jax.device_get(packed)
@@ -1005,8 +1047,13 @@ def readback_plan_result(dispatched) -> dict[str, Any]:
         # the blocking readback absorbs the device execution time
         with profile.phase(PHASE_EXECUTE, stage="readback"):
             host = jax.device_get(packed)
-    sort_vals, sort_vals2, doc_ids, hit_scores, count, agg_out = \
+    sort_vals, sort_vals2, doc_ids, hit_scores, count, topk_safe, agg_out = \
         _unpack_result(host, treedef, spec)
+    if float(topk_safe) < 1.0:
+        plan, k, device_arrays = redispatch
+        _note_guided_fallback()
+        return readback_plan_result(
+            dispatch_plan(plan, k, device_arrays, exact=True))
     return {
         "sort_values": sort_vals,
         "sort_values2": sort_vals2,

@@ -38,7 +38,9 @@ from ..observability.profile import (
     QueryProfile, current_profile, profile_scope,
 )
 from ..query.ast import MatchAll
-from ..parallel.fanout import build_batch, execute_batch, stage_device_inputs
+from ..parallel.fanout import (
+    build_batch, dispatch_batch, readback_batch, stage_device_inputs,
+)
 from ..storage.base import StorageResolver
 from ..tenancy.context import (
     TenantContext, current_tenant, tenant_scope,
@@ -87,7 +89,8 @@ class SearcherContext:
                  offload_max_local_splits: int = 16,
                  offload_client_factory=None,
                  split_cache=None,
-                 enable_threshold_pruning: bool = True):
+                 enable_threshold_pruning: bool = True,
+                 resident_columns: bool = True):
         self.storage_resolver = storage_resolver or StorageResolver.default()
         # disk-resident split cache (reference SearchSplitCache,
         # split_cache/mod.rs:43): reader opens check it first; misses
@@ -118,6 +121,14 @@ class SearcherContext:
         # queues instead of materializing
         from .admission import HbmBudget
         self.hbm_budget = HbmBudget()
+        # device-resident column store (search/residency.py): a warm
+        # split's packed columns stay in HBM across queries AND reader
+        # reopens (residency keys on split id, not reader identity); the
+        # budget sees resident bytes through its existing owner seam. The
+        # flag exists so equivalence tests can run a cold-staging baseline.
+        from .residency import ResidentColumnStore
+        self.resident_store = (ResidentColumnStore()
+                               if resident_columns else None)
         # cross-query dispatch coalescing: concurrent same-structure
         # queries on one split ride a single vmapped dispatch
         # (search/batcher.py; reference analogue: per-node leaf request
@@ -127,6 +138,7 @@ class SearcherContext:
         self._readers: OrderedDict[str, SplitReader] = OrderedDict()
         self._max_open_splits = max_open_splits
         self._lock = threading.Lock()
+        self._meshes: dict = {}
         # elastic leaf-search offload (reference: lambda leaf-search
         # offload, quickwit-lambda-client/src/invoker.rs:129 + the
         # scheduling split at leaf.rs:1658,1828): cold splits beyond
@@ -205,6 +217,28 @@ class SearcherContext:
         if self.offload_dispatcher() is None:
             return None
         return self._offload_pool
+
+    def device_mesh(self, n_splits: int):
+        """A ("splits", "docs") mesh sized to shard `n_splits` across this
+        host's accelerators, or None when the batch cannot shard — single
+        device, single split, or no axis size >1 divides the batch. The
+        None degenerate IS the seed single-device dispatch, so CPU tier-1
+        behavior is byte-identical."""
+        import jax
+        ndev = len(jax.devices())
+        if ndev < 2 or n_splits < 2:
+            return None
+        axis = min(ndev, n_splits)
+        while axis > 1 and n_splits % axis:
+            axis -= 1
+        if axis < 2:
+            return None
+        with self._lock:
+            mesh = self._meshes.get(axis)
+            if mesh is None:
+                from ..parallel.fanout import make_mesh
+                mesh = self._meshes[axis] = make_mesh(axis)
+            return mesh
 
     def has_warm_reader(self, split: SplitIdAndFooter) -> bool:
         """True when this split's reader (and its byte-range/device
@@ -720,8 +754,11 @@ class SearchService:
                     sort_value_threshold=push_thr)
                 admitted = self.context.hbm_budget.admit(
                     batch, sum(a.nbytes for a in batch.arrays))
-                stage_device_inputs(batch)  # async transfer starts now
-                return ("batch", run_group, (batch, admitted), extras)
+                # the mesh is fixed at staging time: arrays committed for
+                # one sharding must not feed an executor traced for another
+                mesh = self.context.device_mesh(batch.n_splits)
+                stage_device_inputs(batch, mesh)  # async transfer starts now
+                return ("batch", run_group, (batch, admitted, mesh), extras)
             except (OverloadShed, TenantRateLimited):
                 # whole-query backpressure, not a split failure: falling
                 # back per split would just re-admit and shed again
@@ -744,7 +781,7 @@ class SearchService:
         only the batch path pre-admits)."""
         kind, _group, data, _extras = prepared
         if kind == "batch":
-            batch, admitted = data
+            batch, admitted, _mesh = data
             self.context.hbm_budget.release(batch, admitted)
 
     def _prepare_per_split(self, group, doc_mapper, search_request,
@@ -805,9 +842,29 @@ class SearchService:
                 extras["count_request"], collector,
                 prune_ctx=None, threshold=None, prune_stats=None)
         if kind == "batch":
-            batch, admitted = data
+            batch, admitted, mesh = data
             try:
-                merged = execute_batch(batch, search_request)
+                # dispatch and readback are split so the deadline can shed
+                # BETWEEN them: the fused kernel may run to completion on
+                # device, but a query nobody is waiting for never pays the
+                # device->host transfer (scalars die with their buffers)
+                dispatched = dispatch_batch(batch, search_request, mesh)
+                deadline = current_deadline()
+                if deadline is not None and deadline.expired:
+                    from .residency import RESIDENT_READBACKS_SHED
+                    RESIDENT_READBACKS_SHED.inc()
+                    profile = current_profile()
+                    if profile is not None:
+                        profile.mark_partial("shed: batch readback")
+                    for split_id in batch.split_ids:
+                        if split_id:
+                            collector.failed_splits.append(SplitSearchError(
+                                split_id=split_id,
+                                error="deadline exceeded before readback "
+                                      "was awaited",
+                                retryable=True))
+                    return
+                merged = readback_batch(dispatched)
                 # batch responses cover several splits; cache only the merged
                 # unit is wrong per-split, so cache skipped on the batch path
                 collector.add_leaf_response(merged)
@@ -875,9 +932,12 @@ class SearchService:
                         continue
             admitted = 0
             warmed = False
+            owner = reader
             try:
-                device_arrays, admitted = warmup_device_arrays(
-                    reader, plan, self.context.hbm_budget)
+                device_arrays, admitted, owner = warmup_device_arrays(
+                    reader, plan, self.context.hbm_budget,
+                    store=self.context.resident_store,
+                    split_id=split.split_id)
                 warmed = True
                 response = execute_prepared_split(
                     search_request, doc_mapper, reader, split.split_id,
@@ -905,7 +965,10 @@ class SearchService:
                     split_id=split.split_id, error=str(exc), retryable=True))
             finally:
                 if warmed:  # failed warmups release their own pins
-                    self.context.hbm_budget.release(reader, admitted)
+                    # releasing against the residency OWNER (not the reader)
+                    # is what moves the pins to resident instead of freeing
+                    # them: the owner carries `_device_array_cache`
+                    self.context.hbm_budget.release(owner, admitted)
 
     @staticmethod
     def _optimize_split_order(request: SearchRequest,

@@ -455,6 +455,88 @@ def test_fetch_docs_retries_once_not_a_replica_walk(corpus):
         f"expected first attempt + one retry, saw {calls}"
 
 
+# --- residency eviction faults ---------------------------------------------
+
+
+def _resident_leaf_setup(corpus, budget_factor):
+    """A SearchService whose HBM budget fits `budget_factor` splits'
+    resident columns — admission of later splits must evict earlier ones
+    mid-request. Returns (service, context, offsets)."""
+    from quickwit_tpu.metastore.base import ListSplitsQuery
+    from quickwit_tpu.search.admission import HbmBudget
+    resolver, metastore = corpus
+    splits = metastore.list_splits(ListSplitsQuery())
+    offsets = [SplitIdAndFooter(split_id=s.metadata.split_id,
+                                storage_uri="ram:///chaos/splits")
+               for s in sorted(splits, key=lambda s: s.metadata.split_id)]
+    # probe one split's resident footprint with an unconstrained context
+    probe = SearcherContext(storage_resolver=resolver, batch_size=1,
+                            prefetch=False)
+    SearchService(probe).leaf_search(LeafSearchRequest(
+        search_request=term_request(max_hits=3), index_uid="chaos:01",
+        doc_mapping=MAPPER.to_dict(), splits=offsets[:1]))
+    per_split = probe.hbm_budget.stats()["resident"]
+    assert per_split > 0
+    context = SearcherContext(storage_resolver=resolver, batch_size=1,
+                              prefetch=False)
+    context.hbm_budget = HbmBudget(
+        budget_bytes=int(per_split * budget_factor))
+    return SearchService(context), context, offsets
+
+
+def test_residency_evict_fault_absorbed_query_succeeds(corpus):
+    # every eviction notification raises an injected error INSIDE the
+    # admission lock of whichever query triggered the LRU; the fault must
+    # be absorbed: all queries complete with full, correct results, and
+    # the evictions are still counted
+    from quickwit_tpu.search.residency import RESIDENT_EVICTIONS
+    service, context, offsets = _resident_leaf_setup(corpus,
+                                                     budget_factor=2.5)
+    injector = FaultInjector(seed=7, rules=[
+        FaultRule("residency.evict", "error"),
+    ])
+    context.resident_store.fault_injector = injector
+    before = RESIDENT_EVICTIONS.get()
+    for max_hits in (5, 4):  # distinct pages: second pass re-warms evicted
+        response = service.leaf_search(LeafSearchRequest(
+            search_request=term_request(max_hits=max_hits),
+            index_uid="chaos:01", doc_mapping=MAPPER.to_dict(),
+            splits=list(offsets)))
+        assert response.num_hits == ERROR_DOCS
+        assert not response.failed_splits
+        assert len(response.partial_hits) == max_hits
+    assert injector.occurrences("residency.evict") >= 1
+    assert RESIDENT_EVICTIONS.get() - before >= 1
+    # store accounting survived the faulted evictions
+    assert context.resident_store.stats()["bytes"] >= 0
+    assert context.hbm_budget.stats()["pinned"] == 0
+
+
+def test_residency_evict_results_match_fault_free_run(corpus):
+    # same seed corpus, same pressured budget: a run with eviction faults
+    # injected is bit-identical to a fault-free run (the cache layer may
+    # lose residency, never correctness)
+    faulted, faulted_ctx, offsets = _resident_leaf_setup(corpus,
+                                                         budget_factor=1.5)
+    faulted_ctx.resident_store.fault_injector = FaultInjector(
+        seed=29, rules=[FaultRule("residency.evict", "error", every=2)])
+    clean, _, _ = _resident_leaf_setup(corpus, budget_factor=1.5)
+    request = term_request(max_hits=7)
+
+    def run(service):
+        r = service.leaf_search(LeafSearchRequest(
+            search_request=request, index_uid="chaos:01",
+            doc_mapping=MAPPER.to_dict(), splits=list(offsets)))
+        assert not r.failed_splits
+        return (r.num_hits,
+                [(h.split_id, h.doc_id, h.sort_value)
+                 for h in r.partial_hits])
+
+    assert run(faulted) == run(clean)
+    assert faulted_ctx.resident_store.fault_injector.occurrences(
+        "residency.evict") >= 1
+
+
 # --- budget mechanics ------------------------------------------------------
 
 

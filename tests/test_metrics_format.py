@@ -200,6 +200,56 @@ def test_offload_metrics_expose_with_strict_grammar():
         assert name in METRICS._metrics, name
 
 
+def test_resident_metrics_expose_with_strict_grammar():
+    """Drive a real ResidentColumnStore through a cold upload, a resident
+    hit, a full staging-cache hit, an eviction, and a shed readback, then
+    assert every qw_resident_* series survives the strict exposition
+    parse. Counters are process-global, so we snapshot before/after and
+    assert on deltas."""
+    from quickwit_tpu.search.residency import (
+        RESIDENT_READBACKS_SHED, ResidentColumnStore,
+    )
+
+    def snapshot():
+        parsed = parse_exposition(METRICS.expose_text())
+        return {name: sum(parsed.get(name, {}).values())
+                for name in ("qw_resident_column_hits_total",
+                             "qw_resident_column_misses_total",
+                             "qw_resident_staging_cache_hits_total",
+                             "qw_resident_evictions_total",
+                             "qw_resident_readbacks_shed_total")}
+
+    before = snapshot()
+    store = ResidentColumnStore()
+    cols = store.columns_for("mf-resident-split")
+    cols._device_array_cache["col.a"] = object()
+    store.note_upload("mf-resident-split", 4096, columns=2)
+    store.note_hits(2, full=False)     # partial warmup: resident columns
+    store.note_hits(3, full=True)      # warm repeat: zero device_put
+    cols._device_array_cache.clear()   # HbmBudget LRU eviction seam
+    RESIDENT_READBACKS_SHED.inc()
+
+    parsed = parse_exposition(METRICS.expose_text())
+    after = snapshot()
+    assert after["qw_resident_column_hits_total"] - \
+        before["qw_resident_column_hits_total"] == 5
+    assert after["qw_resident_column_misses_total"] - \
+        before["qw_resident_column_misses_total"] == 2
+    assert after["qw_resident_staging_cache_hits_total"] - \
+        before["qw_resident_staging_cache_hits_total"] == 1
+    assert after["qw_resident_evictions_total"] - \
+        before["qw_resident_evictions_total"] == 1
+    assert after["qw_resident_readbacks_shed_total"] - \
+        before["qw_resident_readbacks_shed_total"] == 1
+    # the gauge reflects THIS store's post-eviction residency (zero bytes)
+    assert parsed["qw_resident_bytes"][()] == 0.0
+    # the guided-fallback counter rides the same exposition
+    from quickwit_tpu.search import executor as executor_mod
+    executor_mod._note_guided_fallback()
+    parsed = parse_exposition(METRICS.expose_text())
+    assert parsed["qw_topk_guided_fallback_total"][()] >= 1.0
+
+
 def test_full_registry_exposition_parses():
     """The real global registry — after driving a few metrics through the
     awkward cases (labels, floats, multiple label sets) — must emit text

@@ -1,0 +1,373 @@
+"""Resident-column engine equivalence suite.
+
+Property: the device-resident column store (search/residency.py) is a pure
+caching layer — a context with `resident_columns=True` returns responses
+bit-identical to the cold-staging baseline (`resident_columns=False`)
+across repeat queries, LRU eviction pressure, reader reopens, format
+v1/v2 splits, threshold-pruning pushdown, and multi-split batch dispatch.
+
+Plus the tentpole's acceptance claim, asserted directly: a warm repeat
+query on a fully-cached split performs ZERO column device_put — the whole
+staging phase collapses into a `qw_resident_staging_cache_hits_total`
+bump with no new `qw_resident_column_misses_total`.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quickwit_tpu.common.uri import Protocol, Uri
+from quickwit_tpu.index import SplitWriter
+from quickwit_tpu.index import format as split_format
+from quickwit_tpu.index.format import SplitFileBuilder
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.query.parser import parse_query_string
+from quickwit_tpu.search.admission import HbmBudget
+from quickwit_tpu.search.models import (LeafSearchRequest, SearchRequest,
+                                        SortField, SplitIdAndFooter)
+from quickwit_tpu.search.residency import (
+    RESIDENT_COLUMN_MISSES, RESIDENT_EVICTIONS, RESIDENT_STAGING_CACHE_HITS,
+)
+from quickwit_tpu.search.service import SearcherContext, SearchService
+from quickwit_tpu.storage import RamStorage, StorageResolver
+
+MAPPER = DocMapper(
+    field_mappings=[
+        FieldMapping("body", FieldType.TEXT),
+        FieldMapping("ts", FieldType.DATETIME, fast=True,
+                     input_formats=("unix_timestamp",)),
+        FieldMapping("severity", FieldType.TEXT, tokenizer="raw", fast=True),
+        FieldMapping("latency", FieldType.F64, fast=True),
+    ],
+    timestamp_field="ts", default_search_fields=("body",))
+
+NUM_SPLITS = 3
+DOCS_PER_SPLIT = 300
+
+AGGS = {
+    "sev": {"terms": {"field": "severity"}},
+    "lat": {"stats": {"field": "latency"}},
+    "per_hour": {"date_histogram": {"field": "ts", "fixed_interval": "1h"}},
+}
+
+
+def _build_corpus(storage, packed: bool = True):
+    """NUM_SPLITS deterministic splits into `storage`; returns offsets."""
+    prev = os.environ.get("QW_DISABLE_PACKED")
+    os.environ["QW_DISABLE_PACKED"] = "0" if packed else "1"
+    try:
+        rng = np.random.RandomState(7)
+        offsets = []
+        for n in range(NUM_SPLITS):
+            writer = SplitWriter(MAPPER)
+            for i in range(DOCS_PER_SPLIT):
+                writer.add_json_doc({
+                    "body": f"log entry {i} "
+                            f"{'error' if i % 5 == 0 else 'ok'}",
+                    "ts": 1_700_000_000 + n * 3600 + i * 7,
+                    "severity": ["INFO", "WARN", "ERROR"][i % 3],
+                    "latency": float(rng.gamma(2.0, 50.0)),
+                })
+            data = writer.finish()
+            storage.put(f"s{n}.split", data)
+            offsets.append(SplitIdAndFooter(
+                split_id=f"s{n}", storage_uri=str(storage.uri),
+                file_len=len(data), num_docs=DOCS_PER_SPLIT))
+        return offsets
+    finally:
+        if prev is None:
+            os.environ.pop("QW_DISABLE_PACKED", None)
+        else:
+            os.environ["QW_DISABLE_PACKED"] = prev
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    storage = RamStorage(Uri.parse("ram:///resident"))
+    offsets = _build_corpus(storage)
+    resolver = StorageResolver()
+    resolver.register(Protocol.RAM, lambda uri: storage)
+    return resolver, storage, offsets
+
+
+def _make_service(resolver, **context_kw):
+    context_kw.setdefault("batch_size", 1)
+    context_kw.setdefault("prefetch", False)
+    context = SearcherContext(storage_resolver=resolver, **context_kw)
+    return SearchService(context), context
+
+
+def _request(query="body:error", max_hits=10, **kw):
+    kw.setdefault("sort_fields", (SortField("ts", "desc"),))
+    return SearchRequest(index_ids=["res"],
+                         query_ast=parse_query_string(query),
+                         max_hits=max_hits, aggs=AGGS, **kw)
+
+
+def _run(service, offsets, request=None):
+    return service.leaf_search(LeafSearchRequest(
+        search_request=request or _request(), index_uid="res:0",
+        doc_mapping=MAPPER.to_dict(), splits=list(offsets)))
+
+
+def assert_same_response(a, b):
+    assert a.num_hits == b.num_hits
+    assert not a.failed_splits and not b.failed_splits
+    assert [(h.split_id, h.doc_id, h.sort_value, h.raw_sort_value)
+            for h in a.partial_hits] == \
+        [(h.split_id, h.doc_id, h.sort_value, h.raw_sort_value)
+         for h in b.partial_hits]
+    assert json.dumps(a.intermediate_aggs, sort_keys=True, default=repr) == \
+        json.dumps(b.intermediate_aggs, sort_keys=True, default=repr)
+
+
+# --- resident vs cold-staging baseline -------------------------------------
+
+
+def test_resident_matches_cold_staging(corpus):
+    resolver, _, offsets = corpus
+    resident, _ = _make_service(resolver, resident_columns=True)
+    cold, _ = _make_service(resolver, resident_columns=False)
+    for query in ("body:error", "body:ok", "severity:WARN"):
+        request = _request(query)
+        assert_same_response(_run(resident, offsets, request),
+                             _run(cold, offsets, request))
+
+
+def test_warm_repeat_matches_and_stages_zero_columns(corpus):
+    """The acceptance criterion: a repeat query on cached splits is a full
+    staging-cache hit — zero column device_put — and still bit-identical."""
+    resolver, _, offsets = corpus
+    service, context = _make_service(resolver, resident_columns=True)
+    cold, _ = _make_service(resolver, resident_columns=False)
+    first = _run(service, offsets)
+    # a bit-identical repeat is answered by the leaf response cache before
+    # warmup even runs — also zero staging, but it proves nothing about
+    # residency. The probe is a DIFFERENT page size over the same columns:
+    # leaf-cache miss, resident-store full hit.
+    second = _run(service, offsets)
+    assert_same_response(first, second)
+    warm_request = _request(max_hits=7)
+    hits_before = RESIDENT_STAGING_CACHE_HITS.get()
+    misses_before = RESIDENT_COLUMN_MISSES.get()
+    warm = _run(service, offsets, warm_request)
+    # every split's warmup was served entirely from the resident store
+    assert RESIDENT_STAGING_CACHE_HITS.get() - hits_before == NUM_SPLITS
+    # and not one column was uploaded
+    assert RESIDENT_COLUMN_MISSES.get() - misses_before == 0
+    assert_same_response(warm, _run(cold, offsets, warm_request))
+    stats = context.resident_store.stats()
+    assert stats["splits"] == NUM_SPLITS
+    assert stats["bytes"] > 0
+
+
+def test_residency_survives_reader_reopen(corpus):
+    """Residency keys on split id, not reader identity: with a one-slot
+    reader LRU every split's reader is reopened between queries, yet the
+    repeat query still stages nothing."""
+    resolver, _, offsets = corpus
+    service, _ = _make_service(resolver, resident_columns=True,
+                               max_open_splits=1)
+    cold, _ = _make_service(resolver, resident_columns=False,
+                            max_open_splits=1)
+    _run(service, offsets)
+    warm_request = _request(max_hits=7)  # leaf-cache miss, columns warm
+    hits_before = RESIDENT_STAGING_CACHE_HITS.get()
+    misses_before = RESIDENT_COLUMN_MISSES.get()
+    warm = _run(service, offsets, warm_request)
+    assert RESIDENT_STAGING_CACHE_HITS.get() - hits_before == NUM_SPLITS
+    assert RESIDENT_COLUMN_MISSES.get() - misses_before == 0
+    assert_same_response(warm, _run(cold, offsets, warm_request))
+
+
+# --- eviction pressure ------------------------------------------------------
+
+
+def test_equivalence_under_eviction_pressure(corpus):
+    """A budget that fits ~1.5 splits forces LRU eviction of resident
+    columns mid-request; results stay identical to the cold baseline and
+    evictions are observable."""
+    resolver, _, offsets = corpus
+    # measure one split's resident bytes with an unconstrained probe
+    probe, probe_ctx = _make_service(resolver, resident_columns=True)
+    _run(probe, offsets[:1])
+    per_split = probe_ctx.hbm_budget.stats()["resident"]
+    assert per_split > 0
+
+    cold, _ = _make_service(resolver, resident_columns=False)
+    pressured, context = _make_service(resolver, resident_columns=True)
+    context.hbm_budget = HbmBudget(budget_bytes=int(per_split * 1.5))
+    evictions_before = RESIDENT_EVICTIONS.get()
+    for _ in range(2):  # two passes: warm hits AND evictions interleave
+        assert_same_response(_run(pressured, offsets), _run(cold, offsets))
+    assert RESIDENT_EVICTIONS.get() - evictions_before > 0
+    # accounting stayed consistent: never more resident than the budget
+    assert context.hbm_budget.stats()["resident"] <= per_split * 1.5
+    assert context.resident_store.stats()["bytes"] >= 0
+
+
+# --- format v1 / v2 ---------------------------------------------------------
+
+
+def test_v1_split_equivalence_resident(corpus):
+    """v1 splits (raw full-width columns, no zonemaps) flow through the
+    resident store identically: warm repeat stages nothing, and the v1
+    response matches the packed-v2 response on the same corpus."""
+    resolver, _, offsets = corpus
+
+    v1_storage = RamStorage(Uri.parse("ram:///resident-v1"))
+    prev_add = SplitFileBuilder.add_array
+
+    def add_skipping_zonemaps(self, name, array):
+        if name.endswith((".zmin", ".zmax")):
+            return
+        prev_add(self, name, array)
+
+    prev_ver = split_format.FORMAT_VERSION
+    SplitFileBuilder.add_array = add_skipping_zonemaps
+    split_format.FORMAT_VERSION = 1
+    try:
+        v1_offsets = _build_corpus(v1_storage, packed=False)
+    finally:
+        SplitFileBuilder.add_array = prev_add
+        split_format.FORMAT_VERSION = prev_ver
+
+    v1_resolver = StorageResolver()
+    v1_resolver.register(Protocol.RAM, lambda uri: v1_storage)
+    v1_service, _ = _make_service(v1_resolver, resident_columns=True)
+    v2_service, _ = _make_service(resolver, resident_columns=True)
+
+    v1_first = _run(v1_service, v1_offsets)
+    v2_first = _run(v2_service, offsets)
+    assert_same_response(v1_first, v2_first)
+
+    warm_request = _request(max_hits=7)  # leaf-cache miss, columns warm
+    hits_before = RESIDENT_STAGING_CACHE_HITS.get()
+    v1_warm = _run(v1_service, v1_offsets, warm_request)
+    assert RESIDENT_STAGING_CACHE_HITS.get() - hits_before == NUM_SPLITS
+    assert_same_response(v1_warm, _run(v2_service, offsets, warm_request))
+
+
+# --- pruning pushdown -------------------------------------------------------
+
+
+def test_pruning_pushdown_equivalence_resident(corpus):
+    """Dynamic top-K threshold pruning composes with residency: pruned
+    resident == unpruned resident == unpruned cold, for a small page over
+    many splits (where pruning actually bites)."""
+    resolver, _, offsets = corpus
+    pruned, _ = _make_service(resolver, resident_columns=True,
+                              enable_threshold_pruning=True)
+    unpruned, _ = _make_service(resolver, resident_columns=True,
+                                enable_threshold_pruning=False)
+    cold, _ = _make_service(resolver, resident_columns=False,
+                            enable_threshold_pruning=False)
+    request = _request("body:error", max_hits=3)
+    a = _run(pruned, offsets, request)
+    b = _run(unpruned, offsets, request)
+    c = _run(cold, offsets, request)
+    assert_same_response(a, b)
+    assert_same_response(b, c)
+    # a warm follow-up page under pruning still stages nothing new
+    warm_request = _request("body:error", max_hits=2)
+    hits_before = RESIDENT_STAGING_CACHE_HITS.get()
+    misses_before = RESIDENT_COLUMN_MISSES.get()
+    warm = _run(pruned, offsets, warm_request)
+    assert RESIDENT_STAGING_CACHE_HITS.get() - hits_before > 0
+    assert RESIDENT_COLUMN_MISSES.get() - misses_before == 0
+    assert_same_response(warm, _run(cold, offsets, warm_request))
+
+
+# --- multi-split batch dispatch ---------------------------------------------
+
+
+def test_multi_split_batch_equivalence(corpus):
+    """batch_size > 1 routes through the fused batch dispatch (mesh on
+    multi-device hosts, seed single-device path on CPU); resident and cold
+    responses stay identical, warm repeats included."""
+    resolver, _, offsets = corpus
+    resident, _ = _make_service(resolver, resident_columns=True,
+                                batch_size=8)
+    cold, _ = _make_service(resolver, resident_columns=False, batch_size=8)
+    request = _request("body:error")
+    first = _run(resident, offsets, request)
+    assert_same_response(first, _run(cold, offsets, request))
+    assert_same_response(first, _run(resident, offsets, request))
+
+
+# --- guided top-k certificate ----------------------------------------------
+
+
+def test_guided_topk_unsafe_boundary_forces_exact_fallback():
+    """Keys engineered so distinct f64 values collapse onto one f32 screen
+    value exactly at the k/k+1 boundary: the certificate must report
+    safe == 0, and the exact path (what the executor re-dispatches) must
+    rank the true f64 order."""
+    from quickwit_tpu.ops.topk import _BLOCK, exact_topk, guided_topk
+    n, k = 4 * _BLOCK, 8
+    # post-shift magnitudes near 1.0 with spacing far below f32's ULP
+    # (~6e-8 at 1.0): shift anchor 0.5, then a dense cluster at 1.0
+    x = np.full(n, 0.5, dtype=np.float64)
+    cluster = 1.0 + np.arange(32, dtype=np.float64) * 1e-12
+    x[100:100 + 32] = cluster[::-1]  # true winners, descending in f64
+    xj = jnp.asarray(x)
+    _, _, safe = guided_topk(xj, k)
+    assert float(safe) == 0.0, (
+        "screen collapse at the boundary went uncertified")
+    vals, idx = exact_topk(xj, k)
+    expect = np.sort(cluster)[::-1][:k]
+    np.testing.assert_array_equal(np.asarray(vals), expect)
+    # order: x[100] holds the cluster max and values descend with index
+    assert list(np.asarray(idx)) == list(range(100, 100 + k))
+
+
+def test_guided_topk_safe_case_is_bit_exact():
+    from quickwit_tpu.ops.topk import _BLOCK, exact_topk, guided_topk
+    rng = np.random.RandomState(3)
+    n, k = 4 * _BLOCK, 10
+    x = jnp.asarray(rng.uniform(-1e6, 1e6, size=n))
+    gv, gi, safe = guided_topk(x, k)
+    assert float(safe) == 1.0
+    ev, ei = exact_topk(x, k)
+    np.testing.assert_array_equal(np.asarray(gv), np.asarray(ev))
+    np.testing.assert_array_equal(np.asarray(gi), np.asarray(ei))
+
+
+def test_topk_posting_pad_lengths_stay_blockwise_and_exact():
+    """Posting arrays pad to 128, not _BLOCK (1024): a c1-shape operand
+    length (~1M, 128-multiple) used to fall off the blockwise path onto
+    `lax.top_k`'s f64 full-sort (~290ms). The -inf padding must keep the
+    blockwise path AND stay bit-identical to `lax.top_k` — including tie
+    ranks and never surfacing a pad index."""
+    from jax import lax
+
+    from quickwit_tpu.ops.topk import (MISSING_VALUE_SENTINEL, _BLOCK,
+                                       exact_topk, exact_topk_2key,
+                                       guided_topk)
+    rng = np.random.RandomState(11)
+    k = 10
+    for n in (3 * _BLOCK + 128, 2 * _BLOCK + 896, 5000):
+        x = rng.uniform(-1e6, 1e6, size=n)
+        x[rng.rand(n) < 0.3] = -np.inf
+        x[rng.rand(n) < 0.1] = MISSING_VALUE_SENTINEL
+        xj = jnp.asarray(x)
+        ref_v, ref_i = lax.top_k(xj, k)
+        ev, ei = exact_topk(xj, k)
+        np.testing.assert_array_equal(np.asarray(ev), np.asarray(ref_v))
+        np.testing.assert_array_equal(np.asarray(ei), np.asarray(ref_i))
+        assert int(np.asarray(ei).max()) < n
+        gv, gi, safe = guided_topk(xj, k)
+        if float(safe) == 1.0:
+            np.testing.assert_array_equal(np.asarray(gv), np.asarray(ref_v))
+            np.testing.assert_array_equal(np.asarray(gi), np.asarray(ref_i))
+            assert int(np.asarray(gi).max()) < n
+        y = rng.randn(n)
+        y[x == -np.inf] = -np.inf
+        v1, v2, i2 = exact_topk_2key(jnp.asarray(x), jnp.asarray(y), k)
+        order = np.lexsort((np.arange(n), -y, -x))[:k]
+        np.testing.assert_array_equal(np.asarray(i2), order)
+        np.testing.assert_array_equal(np.asarray(v1), x[order])
+        np.testing.assert_array_equal(np.asarray(v2), y[order])

@@ -18,6 +18,9 @@ _HOT_PATH_MODULES = (
     "quickwit_tpu/search/leaf.py",
     "quickwit_tpu/search/collector.py",
     "quickwit_tpu/search/plan.py",
+    # the audited host-decode seam: conversions are ALLOWED here (each is
+    # individually suppressed with its contract), nowhere else
+    "quickwit_tpu/search/hostdecode.py",
 )
 
 _READBACK_BUILTINS = {"float", "int", "bool"}
