@@ -22,8 +22,10 @@ Layout of a split file:
 
 Array naming convention (see writer.py):
     inv.{field}.terms.blob / .offsets / .df / .post_off / .post_len
+    inv.{field}.terms.max_tf
     inv.{field}.postings.ids / .tfs
     inv.{field}.positions.offsets / .data      (record="position" fields)
+    inv.{field}.impact.quant / .bmax / .scale  (format v3, see index/impact.py)
     inv.{field}.fieldnorm
     col.{field}.values / .present / .ordinals / .dict_blob / .dict_offsets
     col.{field}.packed / .zmin / .zmax      (format v2, see docs/device-layout.md)
@@ -34,6 +36,15 @@ bit-packed (`col.{field}.packed`, u8/u16/u32 deltas from the column min,
 optionally GCD-scaled) instead of the full-width `col.{field}.values`,
 plus per-512-doc-block min/max zonemaps (`.zmin`/`.zmax`). v1 splits (raw
 full-width columns, no zonemaps) remain readable and searchable.
+
+Format v3 stores each text field's postings **impact-ordered**: within a
+term, postings are sorted by descending quantized BM25 contribution
+(`inv.{field}.impact.quant`, u8 buckets), with per-128-posting block
+maxima (`.bmax`, u8) and a per-term dequantization scale (`.scale`, f64)
+whose product is a sound upper bound on the query-time score. Readers
+treat the absence of the impact arrays as the v2/v1 fallback — every v3
+structure is optional per field, so older splits stay searchable and
+positions-recording fields simply keep doc order.
 """
 
 from __future__ import annotations
@@ -45,11 +56,12 @@ from typing import Any, Optional
 import numpy as np
 
 MAGIC = b"QWTPU001"
-FORMAT_VERSION = 2
+FORMAT_VERSION = 3
 # Versions this reader still opens: v1 splits carry raw full-width columns
-# only; every v2 structure is optional per column, so the v1 fallback is
-# simply "the packed/zonemap arrays are absent".
-SUPPORTED_FORMAT_VERSIONS = (1, 2)
+# only; every v2 structure is optional per column and every v3 structure is
+# optional per field, so the fallback is simply "the packed/zonemap/impact
+# arrays are absent".
+SUPPORTED_FORMAT_VERSIONS = (1, 2, 3)
 ALIGN = 128
 
 # Zonemap granularity: per-block min/max over present docs, one block =

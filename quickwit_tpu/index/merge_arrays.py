@@ -19,19 +19,83 @@ cost IO-bound instead of tokenize-bound.
 from __future__ import annotations
 
 import heapq
-from typing import Any
+import logging
+import zlib
+from typing import Any, Optional
 
 import numpy as np
 
 from .format import DOC_PAD, POSTING_PAD, SplitFileBuilder, SplitFooter, pad_to
 from .reader import SplitReader
 
+logger = logging.getLogger(__name__)
 
-def merge_splits(readers: list[SplitReader]) -> bytes:
+
+def merge_splits(readers: list[SplitReader], reorder_field: Optional[str] = None,
+                 fault_hook=None) -> bytes:
     """Merged split file bytes. All inputs must share a doc mapping (the
-    caller guarantees it via doc_mapping_uid, as the reference does)."""
-    if not readers:
-        raise ValueError("nothing to merge")
+    caller guarantees it via doc_mapping_uid, as the reference does).
+
+    `reorder_field` opts into cluster-aware doc reordering (the doc-id
+    reassignment of arxiv 1411.1220 applied to the timestamp axis): the
+    merged split's doc ids follow ascending `reorder_field` values instead
+    of input append order, so per-512-doc zonemaps tighten and range
+    filters prune more blocks. Purely a layout decision — the doc SET and
+    every per-doc structure are conserved, and any failure (including a
+    `fault_hook` chaos fault) falls back to the append-order merge.
+    `fault_hook` is the merge executor's FaultInjector binding for the
+    "merge.reorder" point."""
+    if reorder_field is not None:
+        try:
+            if fault_hook is not None:
+                fault_hook()
+            order = _cluster_order(readers, reorder_field)
+            if order is not None:
+                return _merge_splits_ordered(readers, order)
+        except Exception as exc:  # noqa: BLE001 - layout opt must never fail a merge
+            logger.warning("cluster reorder on %r failed (%s); "
+                           "merging in append order", reorder_field, exc)
+    return _merge_splits_ordered(readers, None)
+
+
+def _cluster_order(readers: list[SplitReader],
+                   field: str) -> Optional[tuple[np.ndarray, np.ndarray]]:
+    """(new_order, old2new) doc permutation clustering the merged split by
+    ascending `field` value (docs missing the value last, ties stable in
+    append order), or None when inapplicable: no input holds the column,
+    the append order is already clustered, or any inverted field records
+    positions (their per-posting arrays are not rebased under a permute)."""
+    for r in readers:
+        for name, meta in r.footer.fields.items():
+            if (meta.get("indexed")
+                    and r.has_array(f"inv.{name}.positions.offsets")):
+                return None
+    num_docs = sum(r.num_docs for r in readers)
+    doc_offsets = np.cumsum([0] + [r.num_docs for r in readers])[:-1]
+    keys = np.full(num_docs, np.inf, dtype=np.float64)
+    found = False
+    for reader, offset in zip(readers, doc_offsets):
+        if reader.footer.fields.get(field, {}).get("column_kind") != "numeric":
+            continue
+        n = reader.num_docs
+        v, p = reader.column_values(field)
+        pm = p[:n].astype(bool)
+        keys[offset: offset + n][pm] = v[:n][pm].astype(np.float64)
+        found = found or bool(pm.any())
+    if not found:
+        return None
+    new_order = np.argsort(keys, kind="stable").astype(np.int64)
+    if np.array_equal(new_order, np.arange(num_docs, dtype=np.int64)):
+        return None  # already clustered: keep the cheap append-order layout
+    old2new = np.empty(num_docs, dtype=np.int64)
+    old2new[new_order] = np.arange(num_docs, dtype=np.int64)
+    return new_order, old2new
+
+
+def _merge_splits_ordered(readers: list[SplitReader],
+                          order: Optional[tuple[np.ndarray,
+                                                np.ndarray]]) -> bytes:
+    new_order, old2new = order if order is not None else (None, None)
     num_docs = sum(r.num_docs for r in readers)
     num_docs_padded = pad_to(num_docs, DOC_PAD)
     doc_offsets = np.cumsum([0] + [r.num_docs for r in readers])[:-1]
@@ -42,16 +106,19 @@ def merge_splits(readers: list[SplitReader]) -> bytes:
     field_names = _union_fields(readers)
     for name in field_names["inverted"]:
         fields_meta[name] = _merge_inverted(
-            builder, name, readers, doc_offsets, num_docs, num_docs_padded)
+            builder, name, readers, doc_offsets, num_docs, num_docs_padded,
+            new_order, old2new)
     for name in field_names["numeric_cols"]:
         meta = fields_meta.setdefault(name, dict(_first_meta(readers, name)))
         meta.update(_merge_numeric_column(
-            builder, name, readers, doc_offsets, num_docs, num_docs_padded))
+            builder, name, readers, doc_offsets, num_docs, num_docs_padded,
+            new_order))
     for name in field_names["ordinal_cols"]:
         meta = fields_meta.setdefault(name, dict(_first_meta(readers, name)))
         meta.update(_merge_ordinal_column(
-            builder, name, readers, doc_offsets, num_docs, num_docs_padded))
-    _merge_docstore(builder, readers, doc_offsets)
+            builder, name, readers, doc_offsets, num_docs, num_docs_padded,
+            new_order, old2new))
+    _merge_docstore(builder, readers, doc_offsets, new_order)
 
     for name, meta in fields_meta.items():
         # dynamic fields: union the observed value classes across inputs
@@ -119,25 +186,107 @@ def _first_meta(readers, name) -> dict[str, Any]:
     return {}
 
 
+class _ArrayCollector:
+    """Builder-shaped shim capturing arrays for post-processing (posting
+    re-sort, impact ordering, doc reorder) before they hit the real
+    SplitFileBuilder."""
+
+    def __init__(self):
+        self.arrays: dict[str, np.ndarray] = {}
+
+    def add_array(self, name: str, arr: np.ndarray) -> None:
+        self.arrays[name] = arr
+
+
 def _merge_inverted(builder, name, readers, doc_offsets, num_docs,
-                    num_docs_padded) -> dict[str, Any]:
+                    num_docs_padded, new_order=None,
+                    old2new=None) -> dict[str, Any]:
     """Dispatch: native k-way merge (fastindex.merge_inverted) when the
-    extension is available, byte-identical Python fallback otherwise; the
-    fieldnorm/meta tail is shared."""
+    extension is available, byte-identical Python fallback otherwise.
+    Both paths land in a collector so the merged arenas can be
+    post-processed: doc ids remapped under a cluster reorder, each term's
+    postings restored to ascending-doc order (v3 inputs arrive
+    impact-ordered — their concatenation is sorted by NEITHER doc nor
+    impact), per-term max tf persisted, and finally the merged field
+    re-impact-ordered against its own merged df/fieldnorm/avg_len instead
+    of inheriting the inputs' stale quantization scales."""
     with_positions = any(
         r.has_array(f"inv.{name}.positions.offsets") for r in readers)
+    collect = _ArrayCollector()
     from ..native import load_fastindex
     fastindex = load_fastindex()
     if fastindex is not None and hasattr(fastindex, "merge_inverted"):
-        num_terms = _merge_inverted_native(
-            fastindex, builder, name, readers, doc_offsets, num_docs_padded,
+        _merge_inverted_native(
+            fastindex, collect, name, readers, doc_offsets, num_docs_padded,
             with_positions)
     else:
-        num_terms = _merge_inverted_python(
-            builder, name, readers, doc_offsets, num_docs_padded,
+        _merge_inverted_python(
+            collect, name, readers, doc_offsets, num_docs_padded,
             with_positions)
-    return _inverted_meta_tail(builder, name, readers, doc_offsets,
-                               num_docs, num_docs_padded, num_terms)
+    prefix = f"inv.{name}."
+    arrays = {full[len(prefix):]: arr for full, arr in collect.arrays.items()}
+
+    norms = np.zeros(num_docs_padded, dtype=np.int32)
+    total_tokens = 0
+    for reader, offset in zip(readers, doc_offsets):
+        if not reader.has_array(f"inv.{name}.fieldnorm"):
+            continue
+        norms[offset: offset + reader.num_docs] = \
+            reader.fieldnorm(name)[: reader.num_docs]
+        total_tokens += int(reader.field_meta(name).get("total_tokens", 0))
+    if new_order is not None:
+        norms[:num_docs] = norms[:num_docs][new_order]
+    arrays["fieldnorm"] = norms
+
+    dfs = arrays["terms.df"]
+    post_offs = arrays["terms.post_off"].astype(np.int64)
+    post_lens = arrays["terms.post_len"].astype(np.int64)
+    ids = np.array(arrays["postings.ids"], dtype=np.int32, copy=True)
+    tfs = np.array(arrays["postings.tfs"], dtype=np.int32, copy=True)
+    needs_doc_sort = (old2new is not None or any(
+        r.impact_info(name) is not None for r in readers))
+    if old2new is not None:
+        real = tfs > 0  # pads keep the sentinel id, outside old2new's range
+        ids[real] = old2new[ids[real]]
+    if len(dfs) and needs_doc_sort and not with_positions:
+        # positions fields never reach here reordered: impact ordering
+        # skips them at write time and _cluster_order refuses the permute
+        seg = np.repeat(np.arange(len(post_offs), dtype=np.int64), post_lens)
+        order = np.lexsort((ids, seg))
+        ids = ids[order]
+        tfs = tfs[order]
+    arrays["postings.ids"] = ids
+    arrays["postings.tfs"] = tfs
+    # per-term max tf: merged splits persist the term_stats input just like
+    # freshly written ones, so reader reopens never rescan postings
+    if len(dfs):
+        arrays["terms.max_tf"] = np.maximum.reduceat(
+            tfs, post_offs).astype(np.int32)
+    else:
+        arrays["terms.max_tf"] = np.zeros(0, dtype=np.int32)
+
+    impact_meta = None
+    if not with_positions:
+        from .writer import apply_impact_ordering
+        avg_len = (total_tokens / num_docs) if num_docs else 0.0
+        impact_meta = apply_impact_ordering(arrays, avg_len, num_docs)
+
+    for suffix, arr in arrays.items():
+        builder.add_array(prefix + suffix, arr)
+
+    meta = dict(_first_meta(readers, name))
+    meta.update({
+        "num_terms": len(dfs),
+        "total_tokens": total_tokens,
+        "avg_len": (total_tokens / num_docs) if num_docs else 0.0,
+    })
+    if impact_meta is not None:
+        meta["impact"] = impact_meta
+    else:
+        # an inherited first-meta "impact" entry would claim an ordering
+        # the merged arenas no longer have
+        meta.pop("impact", None)
+    return meta
 
 
 def _merge_inverted_native(fastindex, builder, name, readers, doc_offsets,
@@ -316,27 +465,6 @@ def _merge_inverted_python(builder, name, readers, doc_offsets,
     return len(dfs_list)
 
 
-def _inverted_meta_tail(builder, name, readers, doc_offsets, num_docs,
-                        num_docs_padded, num_terms) -> dict[str, Any]:
-    norms = np.zeros(num_docs_padded, dtype=np.int32)
-    total_tokens = 0
-    for reader, offset in zip(readers, doc_offsets):
-        if not reader.has_array(f"inv.{name}.fieldnorm"):
-            continue
-        part = reader.fieldnorm(name)[: reader.num_docs]
-        norms[offset: offset + reader.num_docs] = part
-        total_tokens += int(reader.field_meta(name).get("total_tokens", 0))
-    builder.add_array(f"inv.{name}.fieldnorm", norms)
-
-    meta = dict(_first_meta(readers, name))
-    meta.update({
-        "num_terms": num_terms,
-        "total_tokens": total_tokens,
-        "avg_len": (total_tokens / num_docs) if num_docs else 0.0,
-    })
-    return meta
-
-
 def _info_at(td, ordinal: int):
     from .reader import TermInfo
     return TermInfo(ordinal, int(td.dfs[ordinal]), int(td.post_offs[ordinal]),
@@ -344,7 +472,7 @@ def _info_at(td, ordinal: int):
 
 
 def _merge_numeric_column(builder, name, readers, doc_offsets, num_docs,
-                          num_docs_padded) -> dict[str, Any]:
+                          num_docs_padded, new_order=None) -> dict[str, Any]:
     dtypes = {r.column_values(name)[0].dtype for r in readers
               if r.footer.fields.get(name, {}).get("column_kind") == "numeric"}
     # dynamic columns typed differently per split (i64 here, f64 there)
@@ -363,10 +491,22 @@ def _merge_numeric_column(builder, name, readers, doc_offsets, num_docs,
         if meta.get("min_value") is not None:
             vmin = meta["min_value"] if vmin is None else min(vmin, meta["min_value"])
             vmax = meta["max_value"] if vmax is None else max(vmax, meta["max_value"])
+    if new_order is not None:
+        values[:num_docs] = values[:num_docs][new_order]
+        present[:num_docs] = present[:num_docs][new_order]
     builder.add_array(f"col.{name}.values", values)
     builder.add_array(f"col.{name}.present", present)
+    # merged splits regain per-512-doc zonemaps (the reason the cluster
+    # reorder exists: sorted values make the block bounds tight). Domain
+    # is the raw values array — the merged column is never FOR-packed
+    from .format import ZONEMAP_BLOCK
+    from .writer import _column_zonemaps
+    zmin, zmax = _column_zonemaps(values, present)
+    builder.add_array(f"col.{name}.zmin", zmin)
+    builder.add_array(f"col.{name}.zmax", zmax)
     return {"fast": True, "column_kind": "numeric",
-            "min_value": vmin, "max_value": vmax}
+            "min_value": vmin, "max_value": vmax,
+            "zonemap_block": ZONEMAP_BLOCK, "packed": None}
 
 
 def _canonical_numeric_strings(reader, name) -> "list[tuple[int, str]]":
@@ -395,7 +535,8 @@ def _canonical_numeric_strings(reader, name) -> "list[tuple[int, str]]":
     return out
 
 def _merge_ordinal_column(builder, name, readers, doc_offsets, num_docs,
-                          num_docs_padded) -> dict[str, Any]:
+                          num_docs_padded, new_order=None,
+                          old2new=None) -> dict[str, Any]:
     # (doc, value-string) pairs per reader; ordinal inputs keep EVERY
     # value via the mv arrays when present, numeric inputs contribute
     # canonical strings (mixed-type dynamic columns coerce to strings)
@@ -438,6 +579,12 @@ def _merge_ordinal_column(builder, name, readers, doc_offsets, num_docs,
             all_pairs.append((g, o))
         if len(seen_docs) != len(pairs):
             multivalued = True
+    if new_order is not None:
+        ordinals[:num_docs] = ordinals[:num_docs][new_order]
+        # pair docs follow the permuted ids; stable doc-ascending re-sort
+        # keeps each doc's distinct-value order intact
+        all_pairs = sorted(((int(old2new[g]), o) for g, o in all_pairs),
+                           key=lambda p: p[0])
     blob = "".join(uniques).encode()
     dict_offsets = np.zeros(len(uniques) + 1, dtype=np.int64)
     acc = 0
@@ -465,7 +612,10 @@ def _merge_ordinal_column(builder, name, readers, doc_offsets, num_docs,
     return meta
 
 
-def _merge_docstore(builder, readers, doc_offsets) -> None:
+def _merge_docstore(builder, readers, doc_offsets, new_order=None) -> None:
+    if new_order is not None:
+        _rebuild_docstore(builder, readers, new_order)
+        return
     data_chunks: list[np.ndarray] = []
     block_offsets = [0]
     block_first = []
@@ -487,3 +637,49 @@ def _merge_docstore(builder, readers, doc_offsets) -> None:
                       else np.array([], np.uint8))
     builder.add_array("store.block_offsets", np.array(block_offsets, dtype=np.int64))
     builder.add_array("store.block_first_doc", np.array(block_first, dtype=np.int32))
+
+
+def _rebuild_docstore(builder, readers, new_order) -> None:
+    """Doc-level docstore rebuild for the cluster reorder: the compressed
+    input blocks cannot be reused (their doc runs are no longer
+    contiguous), so every source line re-blocks in the new order with the
+    writer's own blocking parameters."""
+    from .writer import _STORE_BLOCK_BYTES
+    sources: list[bytes] = []
+    for reader in readers:
+        block_first = reader.array("store.block_first_doc")
+        block_offsets = reader.array("store.block_offsets")
+        data = reader.array("store.data")
+        for b in range(len(block_first) - 1):
+            raw = data[int(block_offsets[b]): int(block_offsets[b + 1])]
+            sources.extend(line for line in
+                           zlib.decompress(raw.tobytes()).split(b"\n")
+                           if line)
+    num_docs = len(sources)
+    if num_docs != new_order.shape[0]:
+        raise ValueError(f"docstore holds {num_docs} docs, permutation "
+                         f"covers {new_order.shape[0]}")
+    blocks: list[bytes] = []
+    block_first_doc = [0]
+    block_offsets_out = [0]
+    current: list[bytes] = []
+    current_size = 0
+    for new_id, old_id in enumerate(new_order.tolist()):
+        source = sources[old_id]
+        current.append(source)
+        current_size += len(source) + 1
+        if current_size >= _STORE_BLOCK_BYTES:
+            blocks.append(zlib.compress(b"\n".join(current), 1))
+            block_offsets_out.append(block_offsets_out[-1] + len(blocks[-1]))
+            block_first_doc.append(new_id + 1)
+            current, current_size = [], 0
+    if current:
+        blocks.append(zlib.compress(b"\n".join(current), 1))
+        block_offsets_out.append(block_offsets_out[-1] + len(blocks[-1]))
+        block_first_doc.append(num_docs)
+    builder.add_array("store.data",
+                      np.frombuffer(b"".join(blocks), dtype=np.uint8))
+    builder.add_array("store.block_offsets",
+                      np.array(block_offsets_out, dtype=np.int64))
+    builder.add_array("store.block_first_doc",
+                      np.array(block_first_doc, dtype=np.int32))

@@ -11,7 +11,9 @@ from __future__ import annotations
 
 import bisect
 import json
+import threading
 import zlib
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Iterator, Optional
 
@@ -20,6 +22,39 @@ import numpy as np
 from ..storage.base import Storage
 from ..storage.cache import ByteRangeCache
 from .format import DEFAULT_FOOTER_HINT, ArrayMeta, SplitFooter, read_footer
+from .impact import IMPACT_BLOCK
+
+
+class _TermStatsCache:
+    """Process-wide (path, field, term) → stats LRU shared across reader
+    reopens. Splits are immutable, so stats computed by one reader instance
+    stay valid for every later open of the same path — without this, a v2
+    split lacking the `terms.max_tf` footer re-scans the term's postings on
+    EVERY reader reopen (the leaf reader cache evicts under pressure)."""
+
+    _MAX = 1 << 17
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[tuple, Any] = OrderedDict()
+
+    def get(self, key: tuple) -> Any:
+        with self._lock:
+            value = self._entries.get(key)
+            if value is not None:
+                self._entries.move_to_end(key)
+            return value
+
+    def put(self, key: tuple, value: Any) -> None:
+        with self._lock:
+            self._entries[key] = value
+            self._entries.move_to_end(key)
+            while len(self._entries) > self._MAX:
+                self._entries.popitem(last=False)
+
+
+_GLOBAL_TERM_STATS = _TermStatsCache()   # ((uri, path), field, term) -> (df, max_tf)
+_GLOBAL_TERM_CAPS = _TermStatsCache()    # ((uri, path), field, term) -> float | 0.0
 
 
 @dataclass(frozen=True)
@@ -86,6 +121,9 @@ class SplitReader:
                  file_len: Optional[int] = None):
         self.storage = storage
         self.path = path
+        # key for the process-wide stats/caps caches: the bare path is not
+        # unique across storages (two indexes both have an "s0.split")
+        self._stats_scope = (str(storage.uri), path)
         self.cache = cache or ByteRangeCache()
         self.file_len = file_len if file_len is not None else storage.file_num_bytes(path)
         self.footer: SplitFooter = read_footer(self._get_slice, self.file_len, footer_hint)
@@ -277,7 +315,9 @@ class SplitReader:
         upper bound (search/pruning.py). Absent term → (0, 0). Served from
         the persisted `terms.max_tf` footer array when present (one 4-byte
         ranged read); older splits without it fall back to scanning the
-        term's padded tf slice (pads are 0, so the max is unaffected)."""
+        term's padded tf slice (pads are 0, so the max is unaffected).
+        Scan results backfill a process-wide per-path cache so a reader
+        reopened on the same (immutable) split never rescans."""
         cached = self._term_stats.get((field, term))
         if cached is not None:
             return cached
@@ -289,7 +329,60 @@ class SplitReader:
                                       info.ordinal, 1)
             stats = (info.df, int(max_tf[0]))
         else:
-            _ids, tfs = self.postings(field, info)
-            stats = (info.df, int(tfs.max()) if tfs.size else 0)
+            global_key = (self._stats_scope, field, term)
+            stats = _GLOBAL_TERM_STATS.get(global_key)
+            if stats is None:
+                _ids, tfs = self.postings(field, info)
+                stats = (info.df, int(tfs.max()) if tfs.size else 0)
+                _GLOBAL_TERM_STATS.put(global_key, stats)
         self._term_stats[(field, term)] = stats
         return stats
+
+    # --- impact-ordered postings (format v3) --------------------------------
+    def impact_info(self, field: str) -> Optional[dict[str, Any]]:
+        """The field's impact descriptor ({"buckets","block","ordered"}) when
+        its postings are impact-ordered with the v3 side arrays present,
+        else None (v1/v2 splits, positions-recording fields, kill switch)."""
+        info = self.field_meta(field).get("impact")
+        if info and info.get("ordered") and self.has_array(
+                f"inv.{field}.impact.bmax"):
+            return info
+        return None
+
+    def impact_term_bounds(self, field: str,
+                           info: TermInfo) -> tuple[np.ndarray, np.float64]:
+        """(block_maxima u8, scale f64) for one term — per-IMPACT_BLOCK
+        quantized upper bounds; `bmax * scale` bounds the query-time score
+        of every posting in the block. Non-increasing across a term's
+        blocks by construction (postings sorted by descending impact)."""
+        bmax = self.array_slice(f"inv.{field}.impact.bmax",
+                                info.post_off // IMPACT_BLOCK,
+                                info.post_len // IMPACT_BLOCK)
+        scale = self.array_slice(f"inv.{field}.impact.scale",
+                                 info.ordinal, 1)[0]
+        return bmax, scale
+
+    def term_score_cap(self, field: str, term: str) -> Optional[float]:
+        """Exact dequantized upper bound on the term's best query-time BM25
+        score (boost 1), or None when the split has no impact arrays for
+        the field. Strictly sharper than the `max_tf` formula bound — it
+        reflects the actual best (tf, fieldnorm) pair in the split, not the
+        norms-free worst case. Cached process-wide per path (immutable
+        splits) alongside the term stats."""
+        global_key = (self._stats_scope, field, term)
+        cached = _GLOBAL_TERM_CAPS.get(global_key)
+        if cached is not None:
+            return cached[0]
+        if self.impact_info(field) is None:
+            cap = None
+        else:
+            info = self.lookup_term(field, term)
+            if info is None:
+                cap = 0.0
+            else:
+                # impact order puts the best posting first, so the first
+                # block's max IS the term's max quant
+                bmax, scale = self.impact_term_bounds(field, info)
+                cap = float(bmax[0]) * float(scale) if bmax.size else 0.0
+        _GLOBAL_TERM_CAPS.put(global_key, (cap,))
+        return cap

@@ -18,6 +18,7 @@ import numpy as np
 
 from ..models.doc_mapper import DocMapper, FieldMapping, FieldType
 from .format import DOC_PAD, POSTING_PAD, SplitFileBuilder, SplitFooter, pad_to
+from .writer import apply_impact_ordering
 
 # sorted — these double as dictionary/term ordinals
 SEVERITIES = ["DEBUG", "ERROR", "INFO", "WARN"]
@@ -212,21 +213,41 @@ def _write_body(builder, fields, rng, num_docs, num_docs_padded):
     np.add.at(norms, docs_sorted, 1)
     term_offsets = (np.arange(_BODY_VOCAB_SIZE + 1, dtype=np.int64)
                     * len(body_term(0)))
+    avg_len = float(norms[:num_docs].mean()) if num_docs else 0.0
+    # same impact-ordering pass as the real writer (format v3), so bench
+    # splits exercise the block-max prefix cutoff; QW_DISABLE_IMPACT=1
+    # builds the doc-ordered comparator
+    body_arrays = {
+        "postings.ids": ids_arena, "postings.tfs": tfs_arena,
+        "terms.df": dfs, "terms.post_off": post_offs, "fieldnorm": norms,
+    }
+    impact_meta = apply_impact_ordering(body_arrays, avg_len, num_docs)
     builder.add_array("inv.body.terms.blob",
                       np.frombuffer("".join(vocab).encode(), dtype=np.uint8))
     builder.add_array("inv.body.terms.offsets", term_offsets)
     builder.add_array("inv.body.terms.df", dfs)
     builder.add_array("inv.body.terms.post_off", post_offs)
     builder.add_array("inv.body.terms.post_len", post_lens)
-    builder.add_array("inv.body.postings.ids", ids_arena)
-    builder.add_array("inv.body.postings.tfs", tfs_arena)
+    builder.add_array("inv.body.terms.max_tf",
+                      np.maximum.reduceat(body_arrays["postings.tfs"],
+                                          post_offs).astype(np.int32))
+    builder.add_array("inv.body.postings.ids", body_arrays["postings.ids"])
+    builder.add_array("inv.body.postings.tfs", body_arrays["postings.tfs"])
     builder.add_array("inv.body.fieldnorm", norms)
+    if impact_meta is not None:
+        builder.add_array("inv.body.impact.quant",
+                          body_arrays["impact.quant"])
+        builder.add_array("inv.body.impact.bmax", body_arrays["impact.bmax"])
+        builder.add_array("inv.body.impact.scale",
+                          body_arrays["impact.scale"])
     fields["body"] = {
         "type": "text", "tokenizer": "default", "record": "basic",
         "indexed": True, "num_terms": _BODY_VOCAB_SIZE,
         "total_tokens": int(norms.sum()),
-        "avg_len": float(norms[:num_docs].mean()) if num_docs else 0.0,
+        "avg_len": avg_len,
     }
+    if impact_meta is not None:
+        fields["body"]["impact"] = impact_meta
 
 
 SO_MAPPER = DocMapper(

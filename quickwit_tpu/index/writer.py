@@ -32,6 +32,7 @@ from ..utils.datetime_utils import truncate_to_precision
 from .format import (
     DOC_PAD, POSTING_PAD, ZONEMAP_BLOCK, SplitFileBuilder, SplitFooter,
     pad_to)
+from .impact import IMPACT_BLOCK, IMPACT_BUCKETS, build_impact_arrays
 
 _STORE_BLOCK_BYTES = 64 * 1024
 _NUMERIC_TYPES = (FieldType.I64, FieldType.U64, FieldType.F64, FieldType.BOOL,
@@ -398,19 +399,25 @@ class SplitWriter:
                     arrays["terms.post_off"]).astype(np.int32)
             else:
                 arrays["terms.max_tf"] = np.zeros(0, dtype=np.int32)
+            avg_len = (inv.total_tokens / self.num_docs) if self.num_docs else 0.0
+            impact_meta = apply_impact_ordering(arrays, avg_len,
+                                                self.num_docs)
             for suffix, arr in arrays.items():
                 builder.add_array(f"inv.{name}.{suffix}", arr)
             num_terms = len(arrays["terms.df"])
-            return {
+            meta = {
                 "type": inv.fm.type.value,
                 "tokenizer": inv.fm.tokenizer,
                 "record": inv.fm.record,
                 "indexed": True,
                 "num_terms": num_terms,
                 "total_tokens": inv.total_tokens,
-                "avg_len": (inv.total_tokens / self.num_docs) if self.num_docs else 0.0,
+                "avg_len": avg_len,
                 "native": True,
             }
+            if impact_meta is not None:
+                meta["impact"] = impact_meta
+            return meta
         terms_sorted = sorted(inv.terms)
         num_terms = len(terms_sorted)
         blob_parts: list[bytes] = []
@@ -451,34 +458,42 @@ class SplitWriter:
                 pos_offsets[cursor + df: cursor + padded + 1] = pos_cursor
             cursor += padded
 
-        builder.add_array(f"inv.{name}.terms.blob",
-                          np.frombuffer(b"".join(blob_parts), dtype=np.uint8))
-        builder.add_array(f"inv.{name}.terms.offsets", offsets)
-        builder.add_array(f"inv.{name}.terms.df", dfs)
-        builder.add_array(f"inv.{name}.terms.post_off", post_offs)
-        builder.add_array(f"inv.{name}.terms.post_len", post_lens)
-        builder.add_array(f"inv.{name}.terms.max_tf", max_tfs)
-        builder.add_array(f"inv.{name}.postings.ids", ids_arena)
-        builder.add_array(f"inv.{name}.postings.tfs", tfs_arena)
-        if pos_offsets is not None:
-            builder.add_array(f"inv.{name}.positions.offsets", pos_offsets)
-            pos_data = np.array([p for chunk in pos_chunks for p in chunk], dtype=np.int32)
-            builder.add_array(f"inv.{name}.positions.data", pos_data)
-
         norms = np.zeros(num_docs_padded, dtype=np.int32)
         for doc_id, length in inv.fieldnorms.items():
             norms[doc_id] = length
-        builder.add_array(f"inv.{name}.fieldnorm", norms)
 
-        return {
+        arrays = {
+            "terms.blob": np.frombuffer(b"".join(blob_parts), dtype=np.uint8),
+            "terms.offsets": offsets,
+            "terms.df": dfs,
+            "terms.post_off": post_offs,
+            "terms.post_len": post_lens,
+            "terms.max_tf": max_tfs,
+            "postings.ids": ids_arena,
+            "postings.tfs": tfs_arena,
+        }
+        if pos_offsets is not None:
+            arrays["positions.offsets"] = pos_offsets
+            arrays["positions.data"] = np.array(
+                [p for chunk in pos_chunks for p in chunk], dtype=np.int32)
+        arrays["fieldnorm"] = norms
+        avg_len = (inv.total_tokens / self.num_docs) if self.num_docs else 0.0
+        impact_meta = apply_impact_ordering(arrays, avg_len, self.num_docs)
+        for suffix, arr in arrays.items():
+            builder.add_array(f"inv.{name}.{suffix}", arr)
+
+        meta = {
             "type": inv.fm.type.value,
             "tokenizer": inv.fm.tokenizer,
             "record": inv.fm.record,
             "indexed": True,
             "num_terms": num_terms,
             "total_tokens": inv.total_tokens,
-            "avg_len": (inv.total_tokens / self.num_docs) if self.num_docs else 0.0,
+            "avg_len": avg_len,
         }
+        if impact_meta is not None:
+            meta["impact"] = impact_meta
+        return meta
 
     def _write_column(self, builder: SplitFileBuilder, name: str,
                       col: _ColumnBuilder, num_docs_padded: int) -> dict[str, Any]:
@@ -593,6 +608,41 @@ def _packing_enabled() -> bool:
     writes raw full-width numeric columns (the v1 layout, still under a v2
     footer). Read per call so tests can flip it between splits."""
     return os.environ.get("QW_DISABLE_PACKED", "0") != "1"
+
+
+def _impact_enabled() -> bool:
+    """Kill switch mirroring `_packing_enabled`: QW_DISABLE_IMPACT=1 keeps
+    postings doc-ordered with no impact arrays (the v2 layout under a v3
+    footer) — the comparator for the impact equivalence suite and bench."""
+    return os.environ.get("QW_DISABLE_IMPACT", "0") != "1"
+
+
+def apply_impact_ordering(arrays: dict[str, np.ndarray], avg_len: float,
+                          num_docs: int) -> Optional[dict[str, Any]]:
+    """Impact-order one inverted field's posting arenas in place of the
+    doc-ordered ones and attach the v3 `impact.*` arrays. `arrays` uses the
+    writer's suffix keys (`postings.ids`, `terms.df`, ...); mutated in
+    place. Returns the field-meta impact descriptor, or None when the field
+    keeps doc order (kill switch, positions recorded, or no terms).
+
+    Shared by the initial write (`_write_inverted`) and the merge path
+    (`merge_arrays._merge_inverted`), so merged splits re-quantize against
+    their merged df/fieldnorm/avg_len instead of inheriting stale scales.
+    """
+    if (not _impact_enabled() or "positions.offsets" in arrays
+            or not len(arrays["terms.df"])):
+        return None
+    ids, tfs, quant, bmax, scales = build_impact_arrays(
+        arrays["postings.ids"], arrays["postings.tfs"],
+        arrays["terms.post_off"], arrays["terms.df"],
+        arrays["fieldnorm"], avg_len, num_docs)
+    arrays["postings.ids"] = ids
+    arrays["postings.tfs"] = tfs
+    arrays["impact.quant"] = quant
+    arrays["impact.bmax"] = bmax
+    arrays["impact.scale"] = scales
+    return {"buckets": IMPACT_BUCKETS, "block": IMPACT_BLOCK,
+            "ordered": True}
 
 
 def _pack_numeric(field_type: FieldType, vals: np.ndarray):

@@ -157,7 +157,9 @@ class MergeExecutor:
         # chaos hook (common/faults.FaultInjector): "merge.execute" perturbs
         # the read/merge phase, "merge.publish" the atomic replace — a fault
         # at either point must leave every input split PUBLISHED and
-        # searchable (no_split_loss), and a retry must conserve rows
+        # searchable (no_split_loss), and a retry must conserve rows.
+        # "merge.reorder" perturbs only the cluster-aware doc reordering:
+        # the merge must then degrade to append order, never fail or corrupt
         self.fault_injector = fault_injector
 
     def execute(self, operation: MergeOperation,
@@ -180,9 +182,18 @@ class MergeExecutor:
                    for s in operation.splits]
         if not delete_matchers:
             # fast path: array-level segment merge, no re-tokenization;
-            # stats come from the authoritative split metadata
+            # stats come from the authoritative split metadata. The merged
+            # split clusters doc ids by timestamp so zonemaps tighten;
+            # "merge.reorder" chaos faults (and any other reorder failure)
+            # degrade to the plain append-order merge inside merge_splits
             from ..index.merge_arrays import merge_splits
-            data = merge_splits(readers)
+            reorder_hook = None
+            if self.fault_injector is not None:
+                reorder_hook = (
+                    lambda: self.fault_injector.perturb("merge.reorder"))
+            data = merge_splits(readers,
+                                reorder_field=self.doc_mapper.timestamp_field,
+                                fault_hook=reorder_hook)
             num_docs = sum(s.metadata.num_docs for s in operation.splits)
             uncompressed = sum(s.metadata.uncompressed_docs_size_bytes
                                for s in operation.splits)

@@ -239,6 +239,24 @@ SEARCH_KERNEL_THRESHOLD_TOTAL = METRICS.counter(
     "qw_search_kernel_threshold_pushdown_total",
     "Plan executions dispatched with a pushed-down top-K threshold scalar")
 
+# --- impact-ordered postings (format v3, index/impact.py) ------------------
+# Host-side prefix-cutoff decisions made at plan lowering: how many
+# 128-posting blocks of the sole scoring term stayed live vs were skipped
+# (never staged to HBM) because their quantized block-max bound could not
+# reach the pushed-down threshold.
+IMPACT_BLOCKS_SCORED_TOTAL = METRICS.counter(
+    "qw_impact_blocks_scored_total",
+    "Impact posting blocks staged and scored (live prefix)")
+IMPACT_BLOCKS_SKIPPED_TOTAL = METRICS.counter(
+    "qw_impact_blocks_skipped_total",
+    "Impact posting blocks skipped by the block-max prefix cutoff")
+IMPACT_POSTINGS_BYTES_AVOIDED_TOTAL = METRICS.counter(
+    "qw_impact_postings_bytes_avoided_total",
+    "Posting bytes (ids+tfs) never staged thanks to the prefix cutoff")
+IMPACT_PREFIX_CUTOFFS_TOTAL = METRICS.counter(
+    "qw_impact_prefix_cutoffs_total",
+    "Plan lowerings that truncated a term's postings to the live prefix")
+
 # --- per-query execution profiles (observability/profile.py) ---------------
 # Wall time per waterfall phase, labeled phase=<name> (plan_build,
 # admission_wait, batcher_queue_wait, storage_read, staging, compile,

@@ -36,3 +36,15 @@ def score_postings(tfs: jnp.ndarray, doc_ids: jnp.ndarray,
     norms = fieldnorms[jnp.clip(doc_ids, 0, fieldnorms.shape[0] - 1)].astype(jnp.float32)
     denom = tf + K1 * (1.0 - B + B * norms / jnp.maximum(avg_len, 1e-9))
     return (boost * idf_value * (K1 + 1.0)) * tf / jnp.maximum(denom, 1e-9)
+
+
+def dequantize_block_bounds(bmax: jnp.ndarray, scale) -> jnp.ndarray:
+    """Per-block f64 score upper bounds from the u8 block maxima of an
+    impact-ordered term (format v3, index/impact.py).
+
+    `scale` is a traced f64 scalar — the persisted per-term dequantization
+    scale with the query boost already folded in host-side at lowering,
+    mirroring how boost folds into the idf scalar. Soundness
+    (`bmax * scale >= score` for every posting of the block) is the
+    writer's quantization contract."""
+    return bmax.astype(jnp.float64) * scale

@@ -194,6 +194,30 @@ def apply_threshold_mask(keyed: jnp.ndarray, threshold) -> jnp.ndarray:
     return jnp.where(keyed >= threshold, keyed, NEG_INF)
 
 
+def block_max_threshold_mask(keyed: jnp.ndarray, block_bounds: jnp.ndarray,
+                             threshold) -> jnp.ndarray:
+    """Impact block-max early exit (format v3): mask WHOLE blocks of the
+    posting-space key whose quantized score upper bound cannot reach the
+    pushed-down threshold, without scoring them individually.
+
+    `keyed` is the internal higher-is-better f64 key over one term's
+    postings (score-descending sorts only — the bound is an upper bound on
+    the score itself, so it bounds the internal key only when key ==
+    score); `block_bounds` is the per-block f64 bound from
+    `bm25.dequantize_block_bounds`, one entry per `keyed.shape[0] //
+    nblocks` lanes. `>=` keeps threshold-tying blocks for the same
+    tie-break reason as `apply_threshold_mask`: the bound is sound
+    (bound >= score always), so a block with bound < threshold contains no
+    posting with score >= threshold — masking it to -inf changes nothing
+    `apply_threshold_mask` would keep. Survivor blocks pass through
+    untouched and are rescored exactly, which is what keeps results
+    bit-identical to the unmasked path."""
+    nb = block_bounds.shape[0]
+    blocks = keyed.reshape(nb, keyed.shape[0] // nb)
+    live = (block_bounds >= threshold)[:, None]
+    return jnp.where(live, blocks, NEG_INF).reshape(-1)
+
+
 def exact_topk_2key(key1: jnp.ndarray, key2: jnp.ndarray, k: int):
     """Exact lexicographic top-k by (key1, key2) descending, index-ascending
     tie-break — the two-sort-field variant of `exact_topk`, built on

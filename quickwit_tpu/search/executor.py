@@ -32,7 +32,7 @@ from ..observability.profile import (
 from ..ops import aggs as agg_ops
 from ..ops import masks as mask_ops
 from ..ops import topk as topk_ops
-from ..ops.bm25 import score_postings
+from ..ops.bm25 import dequantize_block_bounds, score_postings
 from .plan import (
     PRESENT_FROM_VALUES, BucketAggExec, CompositeAggExec, LoweredPlan,
     MetricAggExec, PBool, PMatchAll, PMatchNone, PNormPresence, PPostings,
@@ -328,6 +328,14 @@ def _posting_space_eligible(plan: LoweredPlan) -> bool:
     if not (isinstance(plan.root, PPostings)
             and plan.search_after_relation == "none"):
         return False
+    if plan.root.impact_ordered and plan.sort.by not in ("score", "doc"):
+        # impact-ordered postings (format v3) break posting-index ==
+        # doc-order; a field-primary key's lowest-index-wins ties would
+        # diverge from the doc-ordered seed. Score keys are safe (equal-
+        # score groups stay contiguous and doc-ascending by the writer's
+        # sort contract) and "doc" keys are unique. The dense path below
+        # scatters into doc space, which is order-independent.
+        return False
     for a in plan.aggs:
         if isinstance(a, BucketAggExec):
             if _bucket_tree_blocks_posting_space([a]):
@@ -440,6 +448,18 @@ def _build_posting_space(plan: LoweredPlan, k: int,
             # semantics; only top-k eligibility is restricted
             keyed = topk_ops.apply_threshold_mask(
                 keyed, scalars[plan.threshold_slot])
+            if (root.impact_bmax_slot >= 0 and sort.by == "score"
+                    and sort.descending):
+                # impact block-max early exit (format v3): whole 128-posting
+                # blocks whose quantized score bound cannot reach the
+                # threshold mask without scoring — a no-op for results
+                # (the bound is sound, so every masked lane was already
+                # below the threshold mask above)
+                bounds = dequantize_block_bounds(
+                    arrays[root.impact_bmax_slot],
+                    scalars[root.impact_scale_slot])
+                keyed = topk_ops.block_max_threshold_mask(
+                    keyed, bounds, scalars[plan.threshold_slot])
         kk = min(k, num_postings)
         topk_safe = jnp.float64(1.0)
         if sort.by2 == "none":

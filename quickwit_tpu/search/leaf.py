@@ -262,6 +262,11 @@ def execute_prepared_split(
         result = execute_plan(plan, k, device_arrays)
 
     count = result["count"]
+    if getattr(plan, "count_override", None) is not None:
+        # impact prefix cutoff (plan.py): the kernel only saw the live
+        # prefix of a single bare term's postings, so its count is a
+        # truncation artifact — the exact match count is the term's df
+        count = plan.count_override
     profile = current_profile()
     t_merge = time.monotonic()
     num_hits_returned = min(k, count)

@@ -38,7 +38,8 @@ from ..query.aggregations import (
     MetricAgg, RangeAgg, TermsAgg,
 )
 from ..query.tokenizers import get_tokenizer
-from ..index.reader import SplitReader
+from ..index.impact import IMPACT_BLOCK
+from ..index.reader import SplitReader, TermInfo
 from ..utils.datetime_utils import parse_datetime_to_micros
 
 import logging
@@ -81,9 +82,21 @@ class PPostings:
     norm_slot: int = -1     # dense fieldnorm column (scoring only)
     idf_slot: int = -1      # traced scalar: idf * boost
     avg_len_slot: int = -1  # traced scalar
+    # format v3 impact-ordered postings (index/impact.py). The flag is
+    # ground truth about the STORAGE order of this term's postings: the
+    # executor must not take the posting-space path for field-primary
+    # sorts over impact order (posting index no longer equals doc order,
+    # so lowest-index-wins ties would diverge from the doc-ordered seed).
+    # The slots carry the per-block quantized score bounds + dequant scale
+    # for the kernel's block-max early exit; -1 when not armed.
+    impact_bmax_slot: int = -1
+    impact_scale_slot: int = -1
+    impact_ordered: bool = False
 
     def sig(self) -> str:
-        return f"post({self.ids_slot},{self.tfs_slot},{self.scoring},{self.norm_slot})"
+        return (f"post({self.ids_slot},{self.tfs_slot},{self.scoring},"
+                f"{self.norm_slot},{self.impact_bmax_slot},"
+                f"{self.impact_ordered})")
 
 
 @dataclass(frozen=True)
@@ -331,6 +344,12 @@ class LoweredPlan:
     # static (part of the signature), the scale/min values are traced so
     # per-split frames share one compiled executable.
     rebase: dict[int, tuple[int, int]] = dc_field(default_factory=dict)
+    # impact prefix cutoff (format v3): when the lowering truncated the
+    # sole scoring term's postings to the live above-threshold prefix, the
+    # kernel's matched-doc count runs over fewer lanes — the exact count
+    # (the term's df) is known host-side and overrides it at the leaf.
+    # Host-only; deliberately NOT in the signature.
+    count_override: Optional[int] = None
 
     def signature(self, k: int) -> tuple:
         shapes = tuple((a.shape, str(a.dtype)) for a in self.arrays)
@@ -390,6 +409,14 @@ class Lowering:
                                       #  "terms_cards": {field: int}}
         # FOR-packed slots needing in-kernel reconstruction (LoweredPlan.rebase)
         self.rebase: dict[int, tuple[int, int]] = {}
+        # impact prefix-cutoff context, armed by lower_request ONLY when the
+        # whole query is a single scoring term with a pushed-down threshold
+        # (no aggs / filters / search_after / time window / batch): the one
+        # shape where dropping a term's below-threshold posting tail cannot
+        # change any result the threshold mask would keep
+        self._impact_term: Optional[tuple[str, str, float]] = None
+        self._impact_threshold: Optional[float] = None
+        self.count_override: Optional[int] = None
 
     # --- helpers ----------------------------------------------------------
     def _field(self, name: str) -> FieldMapping:
@@ -431,20 +458,99 @@ class Lowering:
             if self.batch is None:
                 return PMatchNone()
             return self._empty_postings_node(field, term, scoring)
-        ids_slot = self.b.add_array(
-            f"post.{field}.{info.ordinal}.ids",
-            lambda: self.reader.postings(field, info)[0])
-        tfs_slot = self.b.add_array(
-            f"post.{field}.{info.ordinal}.tfs",
-            lambda: self.reader.postings(field, info)[1])
+        impact_ordered = self.reader.impact_info(field) is not None
+        prefix = None
+        if (scoring and impact_ordered and self.batch is None
+                and self._impact_term is not None
+                and self._impact_term[0] == field
+                and self._impact_term[1] == term):
+            prefix = self._impact_prefix(field, info, boost)
+        if prefix is not None and prefix["live_len"] < info.post_len:
+            # impact order makes the threshold cutoff a PREFIX cutoff: the
+            # tail never stages to HBM (smaller arrays fall through the
+            # same HbmBudget/residency accounting), and the matched-doc
+            # count is restored host-side from the term's df
+            live_len = prefix["live_len"]
+            ids_slot = self.b.add_array(
+                f"post.{field}.{info.ordinal}.ids@{live_len}",
+                lambda: self.reader.array_slice(
+                    f"inv.{field}.postings.ids", info.post_off, live_len))
+            tfs_slot = self.b.add_array(
+                f"post.{field}.{info.ordinal}.tfs@{live_len}",
+                lambda: self.reader.array_slice(
+                    f"inv.{field}.postings.tfs", info.post_off, live_len))
+            self.count_override = info.df
+        else:
+            ids_slot = self.b.add_array(
+                f"post.{field}.{info.ordinal}.ids",
+                lambda: self.reader.postings(field, info)[0])
+            tfs_slot = self.b.add_array(
+                f"post.{field}.{info.ordinal}.tfs",
+                lambda: self.reader.postings(field, info)[1])
         if not scoring:
-            return PPostings(ids_slot, tfs_slot, scoring=False)
+            return PPostings(ids_slot, tfs_slot, scoring=False,
+                             impact_ordered=impact_ordered)
         meta = self.reader.field_meta(field)
         norm_slot = self._fieldnorm_slot(field)
         idf_value = bm25_idf(self.reader.num_docs, info.df) * boost
         idf_slot = self.b.add_scalar(idf_value, np.float32)
         avg_slot = self.b.add_scalar(meta.get("avg_len", 1.0), np.float32)
-        return PPostings(ids_slot, tfs_slot, True, norm_slot, idf_slot, avg_slot)
+        bmax_slot = scale_slot = -1
+        if prefix is not None:
+            live_blocks = prefix["live_blocks"]
+            bmax_live = prefix["bmax"][:live_blocks]
+            bmax_slot = self.b.add_array(
+                f"impact.{field}.{info.ordinal}.bmax@{live_blocks}",
+                lambda: bmax_live)
+            # boost folds into the traced scale exactly like it folds into
+            # the idf scalar, so the kernel bound covers the boosted score
+            scale_slot = self.b.add_scalar(prefix["scale"] * boost,
+                                           np.float64)
+        return PPostings(ids_slot, tfs_slot, True, norm_slot, idf_slot,
+                         avg_slot, impact_bmax_slot=bmax_slot,
+                         impact_scale_slot=scale_slot,
+                         impact_ordered=impact_ordered)
+
+    def _impact_prefix(self, field: str, info: "TermInfo", boost: float):
+        """Host-side prefix-cutoff decision for one impact-ordered term:
+        how many leading 128-posting blocks can still reach the pushed-down
+        threshold. Block bounds are non-increasing (postings sorted by
+        descending impact), so the live set is a prefix; its length rounds
+        UP to a power of two of blocks (capped at the term's total) to keep
+        the distinct staged shapes — and therefore executor recompiles —
+        logarithmic in term length. Returns None when the side arrays are
+        unusable."""
+        from .hostdecode import host_int
+        bmax, scale = self.reader.impact_term_bounds(field, info)
+        nblocks = info.post_len // IMPACT_BLOCK
+        if nblocks <= 0 or bmax.shape[0] != nblocks:
+            return None
+        bounds = bmax.astype(np.float64) * (np.float64(scale) * boost)
+        live = host_int(np.count_nonzero(bounds >= self._impact_threshold))
+        # at least one block stays: downstream shapes must be non-empty,
+        # and the kernel mask handles an all-dead block exactly
+        live_blocks = 1
+        while live_blocks < live:
+            live_blocks *= 2
+        live_blocks = min(live_blocks, nblocks)
+        skipped = nblocks - live_blocks
+        from ..observability.profile import profile_add
+        profile_add("impact_blocks_scored", live_blocks)
+        from ..observability.metrics import (
+            IMPACT_BLOCKS_SCORED_TOTAL, IMPACT_BLOCKS_SKIPPED_TOTAL,
+            IMPACT_POSTINGS_BYTES_AVOIDED_TOTAL, IMPACT_PREFIX_CUTOFFS_TOTAL)
+        IMPACT_BLOCKS_SCORED_TOTAL.inc(live_blocks)
+        if skipped > 0:
+            # ids + tfs are int32: 8 bytes per posting never staged
+            bytes_avoided = skipped * IMPACT_BLOCK * 8
+            profile_add("impact_blocks_skipped", skipped)
+            profile_add("impact_postings_bytes_avoided", bytes_avoided)
+            profile_add("impact_prefix_cutoffs")
+            IMPACT_BLOCKS_SKIPPED_TOTAL.inc(skipped)
+            IMPACT_POSTINGS_BYTES_AVOIDED_TOTAL.inc(bytes_avoided)
+            IMPACT_PREFIX_CUTOFFS_TOTAL.inc()
+        return {"bmax": bmax, "scale": scale, "live_blocks": live_blocks,
+                "live_len": live_blocks * IMPACT_BLOCK}
 
     def _fieldnorm_slot(self, field: str) -> int:
         """Fieldnorm array slot, tolerating splits that never materialized
@@ -1607,6 +1713,24 @@ def lower_request(
     """Full request lowering: query + request-level time filter + sort + aggs."""
     low = Lowering(doc_mapper, reader, batch_overrides, absence_sink)
     scoring = "_score" in (sort_field, sort2_field)
+    if (sort_value_threshold is not None and batch_overrides is None
+            and not agg_specs and search_after is None
+            and start_timestamp is None and end_timestamp is None
+            and sort_field == "_score" and sort_order == "desc"
+            and sort2_field is None):
+        # impact prefix cutoff: sound only when the request is EXACTLY one
+        # scoring term — a bare Term/FullText (possibly boosted), never a
+        # Bool, so no filter/should sibling can rescue a dropped posting
+        # and the term's df is the exact matched-doc count
+        node = query_ast
+        while isinstance(node, Q.Boost):
+            node = node.underlying
+        if isinstance(node, (Q.Term, Q.FullText)):
+            from .pruning import scoring_terms
+            terms = scoring_terms(query_ast, doc_mapper)
+            if terms is not None and len(terms) == 1:
+                low._impact_term = terms[0]
+                low._impact_threshold = sort_value_threshold
     root = low.lower(query_ast, scoring=scoring)
     if start_timestamp is not None or end_timestamp is not None:
         ts_field = doc_mapper.timestamp_field
@@ -1648,4 +1772,5 @@ def lower_request(
         sort_text_field=sort_text_field,
         threshold_slot=threshold_slot,
         rebase=low.rebase,
+        count_override=low.count_override,
     )

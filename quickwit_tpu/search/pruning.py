@@ -27,7 +27,10 @@ Soundness per sort kind:
                 BM25's tf/(tf + K1*(1-B+B*norm/avg)) is increasing in tf and
                 decreasing in norm, so norm→0, tf→max_tf upper-bounds every
                 doc; the query bound sums the per-term bounds over every
-                scoring (must+should) term. Queries with score contributions
+                scoring (must+should) term. Impact-ordered splits (format
+                v3) replace the formula with the exact dequantized first
+                block maximum, which also reflects the real fieldnorms.
+                Queries with score contributions
                 we cannot bound (phrase, prefix, wildcard, regex) disable
                 pruning entirely (return None) — sound, never wrong.
   _score asc / _doc / text sorts — never pruned.
@@ -77,31 +80,36 @@ class ThresholdBox:
 
 
 class ScoreBoundCache:
-    """LRU of (split_id, field, term) → (df, max_tf) recorded at split open.
+    """LRU of (split_id, field, term) → (df, max_tf[, score_cap]) recorded
+    at split open.
 
     Like the predicate cache's absence proofs, the stats are immutable
     properties of an (immutable) split, so entries never invalidate; the
     backing `terms.max_tf` footer array persists them across reader
-    evictions and process restarts.
+    evictions and process restarts. `score_cap` (format v3 impact-ordered
+    splits) is the EXACT dequantized first-block maximum — sharper than the
+    max_tf/norm→0 formula because it reflects the real fieldnorms — or None
+    on v2 splits.
     """
 
     def __init__(self, max_entries: int = 1 << 17):
         self._entries: OrderedDict[tuple[str, str, str],
-                                   tuple[int, int]] = OrderedDict()
+                                   tuple] = OrderedDict()
         self._max_entries = max_entries
         self._lock = threading.Lock()
 
     def record(self, split_id: str, field: str, term: str,
-               df: int, max_tf: int) -> None:
+               df: int, max_tf: int,
+               score_cap: Optional[float] = None) -> None:
         key = (split_id, field, term)
         with self._lock:
-            self._entries[key] = (df, max_tf)
+            self._entries[key] = (df, max_tf, score_cap)
             self._entries.move_to_end(key)
             while len(self._entries) > self._max_entries:
                 self._entries.popitem(last=False)
 
     def get(self, split_id: str, field: str,
-            term: str) -> Optional[tuple[int, int]]:
+            term: str) -> Optional[tuple]:
         key = (split_id, field, term)
         with self._lock:
             stats = self._entries.get(key)
@@ -212,14 +220,20 @@ def split_score_upper_bound(
         stats: Callable[[str, str], Optional[tuple[int, int]]],
 ) -> Optional[float]:
     """Σ per-term bounds over the query's scoring terms. `stats` maps
-    (field, term) → (df, max_tf) or None when unknown; any unknown term
-    makes the split unboundable (None → run it)."""
+    (field, term) → (df, max_tf[, score_cap]) or None when unknown; any
+    unknown term makes the split unboundable (None → run it). When the
+    3rd element (exact impact block-max cap, format v3) is present it is
+    used directly — boost scales linearly through the whole BM25 formula,
+    so `boost * cap` stays an upper bound."""
     total = 0.0
     for field, term, boost in terms:
         st = stats(field, term)
         if st is None:
             return None
-        total += term_score_bound(num_docs, st[0], st[1], boost)
+        if len(st) > 2 and st[2] is not None:
+            total += boost * st[2]
+        else:
+            total += term_score_bound(num_docs, st[0], st[1], boost)
     return total
 
 
@@ -232,7 +246,8 @@ def record_split_term_stats(cache: ScoreBoundCache, split_id: str, reader,
         if cache.get(split_id, field, term) is not None:
             continue
         df, max_tf = reader.term_stats(field, term)
-        cache.record(split_id, field, term, df, max_tf)
+        cache.record(split_id, field, term, df, max_tf,
+                     reader.term_score_cap(field, term))
 
 
 # --------------------------------------------------------------------------

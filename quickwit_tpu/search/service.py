@@ -667,9 +667,11 @@ class SearchService:
                 reader = self.context.peek_reader(split)
                 if reader is None:
                     return None
-                stats = reader.term_stats(field, term)
+                df, max_tf = reader.term_stats(field, term)
+                cap = reader.term_score_cap(field, term)
+                stats = (df, max_tf, cap)
                 self.context.score_bound_cache.record(
-                    split.split_id, field, term, *stats)
+                    split.split_id, field, term, df, max_tf, cap)
             return stats
 
         return split_best_internal_key(prune_ctx, split,

@@ -1,0 +1,645 @@
+"""Impact-ordered postings (format v3): equivalence + soundness properties.
+
+The impact family (index/impact.py, writer.py, plan.py, ops/topk.py) may
+reorder postings, quantize scores, and skip whole blocks — but it must
+NEVER change what the user sees. Every search-level test here runs the
+same request against an impact-ordered (v3) corpus and a
+`QW_DISABLE_IMPACT`-written doc-ordered (v2-layout) twin and asserts
+bit-identical hits, sort values and counts; the format-level tests pin the
+soundness contract itself (`quant * scale >= exact score`, always), and
+the merge tests pin that cluster reordering degrades — never corrupts —
+under injected faults.
+
+Leaf-cache caveat baked into the helpers: `sort_value_threshold` is not
+part of the canonical request key, so every measured call uses a FRESH
+SearchService — a warm repeat would be served from the leaf cache and no
+kernel (and no impact counter) would ever run.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from quickwit_tpu.common.faults import FaultInjector, FaultRule
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.index import SplitReader, SplitWriter
+from quickwit_tpu.index.impact import (
+    IMPACT_BLOCK, IMPACT_BUCKETS, exact_scores_f32,
+)
+from quickwit_tpu.index.merge_arrays import merge_splits
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.observability.metrics import (
+    IMPACT_BLOCKS_SCORED_TOTAL, IMPACT_BLOCKS_SKIPPED_TOTAL,
+    IMPACT_POSTINGS_BYTES_AVOIDED_TOTAL, IMPACT_PREFIX_CUTOFFS_TOTAL,
+)
+from quickwit_tpu.query import parse_query_string
+from quickwit_tpu.query.ast import Boost, Term
+from quickwit_tpu.search.models import (
+    LeafSearchRequest, SearchRequest, SortField, SplitIdAndFooter,
+)
+from quickwit_tpu.search.pruning import (
+    ScoreBoundCache, split_score_upper_bound, term_score_bound,
+)
+from quickwit_tpu.search.service import SearcherContext, SearchService
+from quickwit_tpu.storage import RamStorage, StorageResolver
+
+MAPPER = DocMapper(
+    field_mappings=[
+        FieldMapping("ts", FieldType.DATETIME, fast=True,
+                     input_formats=("unix_timestamp",)),
+        FieldMapping("val", FieldType.I64, fast=True),
+        FieldMapping("body", FieldType.TEXT),
+        FieldMapping("sev", FieldType.TEXT, tokenizer="raw", fast=True),
+    ],
+    timestamp_field="ts",
+    default_search_fields=("body",),
+)
+
+BASE_TS = 1_700_000_000
+DOCS_PER_SPLIT = 300
+NUM_SPLITS = 3
+
+
+def make_docs(split: int):
+    docs = []
+    for i in range(DOCS_PER_SPLIT):
+        # tf tiers give real score spread so a top-10 threshold separates
+        # impact blocks: 5 hot docs, 25 warm, the rest tf=1 tail
+        tf = 20 if i < 5 else (5 if i < 30 else 1)
+        docs.append({
+            "ts": BASE_TS + split * DOCS_PER_SPLIT + i,
+            "val": split * DOCS_PER_SPLIT + i,
+            "body": f"event{split}x{i} " + "common " * tf
+                    + ("alpha " if i % 2 == 0 else "beta "),
+            "sev": ["INFO", "WARN", "ERROR"][i % 3],
+        })
+    return docs
+
+
+def write_split(storage, name, docs, mapper=MAPPER):
+    writer = SplitWriter(mapper)
+    for doc in docs:
+        writer.add_json_doc(doc)
+    storage.put(f"{name}.split", writer.finish())
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    """The same 3 splits written twice: impact-ordered (v3) and, via the
+    kill switch, doc-ordered v2 layout — the equivalence comparator."""
+    resolver = StorageResolver.for_test()
+    v3_uri, v2_uri = "ram:///impact/v3", "ram:///impact/v2"
+    storage_v3 = resolver.resolve(v3_uri)
+    storage_v2 = resolver.resolve(v2_uri)
+    assert os.environ.get("QW_DISABLE_IMPACT", "0") != "1"
+    for split in range(NUM_SPLITS):
+        write_split(storage_v3, f"s{split}", make_docs(split))
+    os.environ["QW_DISABLE_IMPACT"] = "1"
+    try:
+        for split in range(NUM_SPLITS):
+            write_split(storage_v2, f"s{split}", make_docs(split))
+    finally:
+        del os.environ["QW_DISABLE_IMPACT"]
+
+    def offsets(uri):
+        return [SplitIdAndFooter(split_id=f"s{s}", storage_uri=uri,
+                                 num_docs=DOCS_PER_SPLIT, time_range=None)
+                for s in range(NUM_SPLITS)]
+    return {
+        "resolver": resolver,
+        "v3": offsets(v3_uri), "v2": offsets(v2_uri),
+        "readers_v3": [SplitReader(storage_v3, f"s{s}.split")
+                       for s in range(NUM_SPLITS)],
+        "readers_v2": [SplitReader(storage_v2, f"s{s}.split")
+                       for s in range(NUM_SPLITS)],
+    }
+
+
+def leaf(corpus, offsets, request, threshold=None):
+    # fresh service per call: the leaf cache ignores the threshold, and
+    # impact counters only move when the kernel actually runs.
+    # batch_size=1 keeps splits on the per-split lowering (the batched
+    # plan carries batch_overrides, which disables the prefix cutoff)
+    service = SearchService(SearcherContext(
+        storage_resolver=corpus["resolver"], batch_size=1))
+    return service.leaf_search(LeafSearchRequest(
+        search_request=request, index_uid="impact:01",
+        doc_mapping=MAPPER.to_dict(), splits=offsets,
+        sort_value_threshold=threshold))
+
+
+def request(query="body:common", max_hits=10, **kwargs):
+    ast = (query if not isinstance(query, str)
+           else parse_query_string(query, ["body"]))
+    kwargs.setdefault("sort_fields", (SortField("_score", "desc"),))
+    return SearchRequest(index_ids=["impact"], query_ast=ast,
+                         max_hits=max_hits, **kwargs)
+
+
+def hit_keys(response):
+    return [(h.split_id, h.doc_id, h.sort_value, h.sort_value2)
+            for h in response.partial_hits]
+
+
+def impact_counters():
+    return {
+        "scored": IMPACT_BLOCKS_SCORED_TOTAL.get(),
+        "skipped": IMPACT_BLOCKS_SKIPPED_TOTAL.get(),
+        "bytes": IMPACT_POSTINGS_BYTES_AVOIDED_TOTAL.get(),
+        "cutoffs": IMPACT_PREFIX_CUTOFFS_TOTAL.get(),
+    }
+
+
+def counter_deltas(before, after):
+    return {k: after[k] - before[k] for k in before}
+
+
+def term_layout(reader, field, term):
+    """(ids, tfs, quant, bmax, scale, info) for one term of a v3 split."""
+    info = reader.lookup_term(field, term)
+    assert info is not None
+    ids = reader.array_slice(f"inv.{field}.postings.ids", info.post_off,
+                             info.post_len)
+    tfs = reader.array_slice(f"inv.{field}.postings.tfs", info.post_off,
+                             info.post_len)
+    quant = reader.array_slice(f"inv.{field}.impact.quant",
+                               info.post_off, info.post_len)
+    bmax, scale = reader.impact_term_bounds(field, info)
+    return ids, tfs, quant, bmax, scale, info
+
+
+def exact_term_scores(reader, field, term):
+    """Query-kernel f32 scores for a term's real postings, posting order."""
+    from quickwit_tpu.ops.bm25 import idf as bm25_idf
+    ids, tfs, _, _, _, info = term_layout(reader, field, term)
+    real = tfs[:info.df]
+    norms = reader.fieldnorm(field)
+    avg_len = reader.field_meta(field)["avg_len"]
+    idf32 = np.float32(bm25_idf(reader.num_docs, info.df))
+    return exact_scores_f32(real, ids[:info.df], norms, avg_len, idf32)
+
+
+# --- format level: the v3 arrays and their soundness contract --------------
+
+
+def test_v3_split_announces_impact(corpus):
+    for reader in corpus["readers_v3"]:
+        info = reader.impact_info("body")
+        assert info == {"buckets": IMPACT_BUCKETS, "block": IMPACT_BLOCK,
+                        "ordered": True}
+
+
+def test_kill_switch_writes_doc_ordered_layout(corpus):
+    for reader in corpus["readers_v2"]:
+        assert reader.impact_info("body") is None
+        assert reader.term_score_cap("body", "common") is None
+        assert not reader.has_array("inv.body.impact.quant")
+        assert not reader.has_array("inv.body.impact.bmax")
+        assert not reader.has_array("inv.body.impact.scale")
+
+
+def test_quantization_soundness_every_posting(corpus):
+    # THE invariant: the dequantized bucket bounds the exact score, for
+    # every posting of every probed term — skipping can never lose a hit
+    for reader in corpus["readers_v3"]:
+        for term in ("common", "alpha", "beta", "event0x0"):
+            if reader.lookup_term("body", term) is None:
+                continue
+            _, _, quant, _, scale, info = term_layout(reader, "body", term)
+            scores = exact_term_scores(reader, "body", term)
+            bounds = quant[:info.df].astype(np.float64) * float(scale)
+            assert np.all(bounds >= scores.astype(np.float64)), term
+
+
+def test_block_maxima_bound_and_cover_their_blocks(corpus):
+    reader = corpus["readers_v3"][0]
+    _, _, quant, bmax, _, info = term_layout(reader, "body", "common")
+    blocks = quant.reshape(-1, IMPACT_BLOCK)
+    assert np.array_equal(bmax, blocks.max(axis=1))
+    assert info.post_len % IMPACT_BLOCK == 0  # blocks never straddle terms
+
+
+def test_block_maxima_non_increasing_within_term(corpus):
+    for reader in corpus["readers_v3"]:
+        for term in ("common", "alpha"):
+            _, _, _, bmax, _, _ = term_layout(reader, "body", term)
+            assert np.all(np.diff(bmax.astype(np.int32)) <= 0), term
+
+
+def test_first_posting_lands_on_top_bucket(corpus):
+    # quantize_term scales so the best posting is exactly bucket 255:
+    # the first block's bound is as tight as u8 quantization allows
+    for reader in corpus["readers_v3"]:
+        for term in ("common", "alpha", "beta"):
+            _, _, quant, _, scale, _ = term_layout(reader, "body", term)
+            assert quant[0] == IMPACT_BUCKETS, term
+            assert float(scale) > 0.0
+
+
+def test_impact_order_is_score_desc_then_doc_asc(corpus):
+    reader = corpus["readers_v3"][0]
+    for term in ("common", "alpha"):
+        ids, _, _, _, _, info = term_layout(reader, "body", term)
+        scores = exact_term_scores(reader, "body", term)
+        assert np.all(scores[:-1] >= scores[1:]), term
+        ties = scores[:-1] == scores[1:]
+        assert np.all(ids[:info.df][1:][ties] > ids[:info.df][:-1][ties]), \
+            f"{term}: equal-score runs must stay doc-ascending"
+
+
+def test_term_score_cap_exact_and_sharper_than_formula(corpus):
+    for reader in corpus["readers_v3"]:
+        for term in ("common", "alpha"):
+            cap = reader.term_score_cap("body", term)
+            true_max = float(exact_term_scores(reader, "body", term).max())
+            df, max_tf = reader.term_stats("body", term)
+            formula = term_score_bound(reader.num_docs, df, max_tf)
+            assert cap is not None
+            assert cap >= true_max  # still an upper bound
+            assert cap <= formula * (1.0 + 1e-6)  # never looser
+            # and genuinely sharper here: real fieldnorms are >> 0
+            assert cap < formula
+
+
+def test_absent_term_cap_is_zero(corpus):
+    reader = corpus["readers_v3"][0]
+    assert reader.term_score_cap("body", "zzz-not-a-term") == 0.0
+
+
+def test_positions_field_is_never_impact_ordered():
+    mapper = DocMapper(
+        field_mappings=[FieldMapping("body", FieldType.TEXT,
+                                     record="position")],
+        default_search_fields=("body",))
+    storage = RamStorage(Uri.parse("ram:///impact/pos"))
+    write_split(storage, "p", [{"body": f"alpha word{i}"}
+                               for i in range(40)], mapper)
+    reader = SplitReader(storage, "p.split")
+    assert reader.impact_info("body") is None
+    assert reader.term_score_cap("body", "alpha") is None
+    # phrase data must be intact (positions depend on doc-ordered tfs
+    # staying aligned, which is why the writer refuses to impact-order)
+    info = reader.lookup_term("body", "alpha")
+    assert info is not None and info.df == 40
+
+
+def test_term_stats_contract_unchanged(corpus):
+    # callers of the 2-tuple contract (pruning, stats backfill) must not
+    # see the score cap leak into term_stats
+    for reader in corpus["readers_v3"] + corpus["readers_v2"]:
+        stats = reader.term_stats("body", "common")
+        assert len(stats) == 2
+        df, max_tf = stats
+        assert df == DOCS_PER_SPLIT and max_tf == 20
+
+
+# --- search level: impact-ordered execution is invisible in results --------
+
+
+def test_plain_score_sort_equivalence_v3_vs_v2(corpus):
+    for query in ("body:common", "body:alpha", "body:common body:alpha"):
+        r3 = leaf(corpus, corpus["v3"], request(query))
+        r2 = leaf(corpus, corpus["v2"], request(query))
+        assert hit_keys(r3) == hit_keys(r2), query
+        assert r3.num_hits == r2.num_hits == NUM_SPLITS * DOCS_PER_SPLIT \
+            if query == "body:common" else r3.num_hits == r2.num_hits
+
+
+def test_threshold_pushdown_identical_hits_and_count(corpus):
+    base = leaf(corpus, corpus["v3"], request())
+    threshold = base.partial_hits[-1].sort_value
+    pushed = leaf(corpus, corpus["v3"], request(), threshold=threshold)
+    assert hit_keys(pushed) == hit_keys(base)
+    # count_override: the kernel only saw the live prefix, but the exact
+    # match count must still be the term's df
+    assert pushed.num_hits == base.num_hits == NUM_SPLITS * DOCS_PER_SPLIT
+
+
+def test_prefix_cutoff_skips_blocks_and_accounts_bytes(corpus):
+    base = leaf(corpus, corpus["v3"], request())
+    threshold = base.partial_hits[-1].sort_value
+    before = impact_counters()
+    pushed = leaf(corpus, corpus["v3"], request(), threshold=threshold)
+    delta = counter_deltas(before, impact_counters())
+    assert hit_keys(pushed) == hit_keys(base)
+    assert delta["cutoffs"] >= 1
+    assert delta["scored"] >= 1
+    assert delta["skipped"] >= 1  # the perf claim: tail blocks never stage
+    assert delta["bytes"] == delta["skipped"] * IMPACT_BLOCK * 8
+
+
+def test_threshold_equivalence_against_v2_baseline(corpus):
+    base = leaf(corpus, corpus["v2"], request())
+    threshold = base.partial_hits[-1].sort_value
+    r3 = leaf(corpus, corpus["v3"], request(), threshold=threshold)
+    r2 = leaf(corpus, corpus["v2"], request(), threshold=threshold)
+    assert hit_keys(r3) == hit_keys(r2) == hit_keys(base)
+    assert r3.num_hits == r2.num_hits
+
+
+def test_v2_splits_under_v3_reader_never_cut_off(corpus):
+    base = leaf(corpus, corpus["v2"], request())
+    threshold = base.partial_hits[-1].sort_value
+    before = impact_counters()
+    pushed = leaf(corpus, corpus["v2"], request(), threshold=threshold)
+    delta = counter_deltas(before, impact_counters())
+    assert delta["cutoffs"] == 0 and delta["skipped"] == 0
+    assert hit_keys(pushed) == hit_keys(base)
+
+
+def test_boost_pow2_equivalence(corpus):
+    # powers of two scale f32 scores exactly, so boosted tie-breaks stay
+    # bit-identical between layouts (non-pow2 boosts round differently)
+    ast = Boost(underlying=Term(field="body", value="common"), boost=2.0)
+    base = leaf(corpus, corpus["v2"], request(ast))
+    r3 = leaf(corpus, corpus["v3"], request(ast))
+    threshold = base.partial_hits[-1].sort_value
+    pushed = leaf(corpus, corpus["v3"], request(ast), threshold=threshold)
+    assert hit_keys(r3) == hit_keys(base)
+    assert hit_keys(pushed) == hit_keys(base)
+
+
+def test_multi_term_query_equivalent_but_not_cut_off(corpus):
+    # two scoring terms: per-posting thresholds are per-term unsound, so
+    # the prefix cutoff must not engage — results still identical
+    query = "body:common body:alpha"
+    base = leaf(corpus, corpus["v2"], request(query))
+    threshold = base.partial_hits[-1].sort_value
+    before = impact_counters()
+    pushed = leaf(corpus, corpus["v3"], request(query), threshold=threshold)
+    delta = counter_deltas(before, impact_counters())
+    assert delta["cutoffs"] == 0
+    assert hit_keys(pushed) == hit_keys(base)
+    assert pushed.num_hits == base.num_hits
+
+
+def test_aggs_disable_cutoff_and_stay_equivalent(corpus):
+    # aggs consume every matching doc — truncating the posting prefix
+    # would silently drop buckets, so the gate must refuse
+    aggs = {"sev": {"terms": {"field": "sev"}}}
+    base = leaf(corpus, corpus["v2"], request(aggs=aggs))
+    threshold = base.partial_hits[-1].sort_value
+    before = impact_counters()
+    pushed = leaf(corpus, corpus["v3"], request(aggs=aggs),
+                  threshold=threshold)
+    delta = counter_deltas(before, impact_counters())
+    assert delta["cutoffs"] == 0
+    assert hit_keys(pushed) == hit_keys(base)
+    assert pushed.intermediate_aggs == base.intermediate_aggs
+
+
+def test_field_sort_equivalence_no_posting_space(corpus):
+    # field-primary sorts are not tie-equivalent over impact order — the
+    # executor gates them off the posting-space path; results must match
+    req = lambda: request("body:common",
+                          sort_fields=(SortField("ts", "desc"),))
+    r3 = leaf(corpus, corpus["v3"], req())
+    r2 = leaf(corpus, corpus["v2"], req())
+    assert hit_keys(r3) == hit_keys(r2)
+    assert r3.num_hits == r2.num_hits
+
+
+def test_search_after_equivalence(corpus):
+    base = leaf(corpus, corpus["v2"], request(max_hits=20))
+    page = base.partial_hits[9]
+    def req():
+        return request(max_hits=10,
+                       search_after=[page.sort_value, page.split_id,
+                                     page.doc_id])
+    r3 = leaf(corpus, corpus["v3"], req())
+    r2 = leaf(corpus, corpus["v2"], req())
+    assert hit_keys(r3) == hit_keys(r2) == hit_keys(base)[10:20]
+
+
+def test_warm_repeat_serves_cache_not_kernel(corpus):
+    service = SearchService(SearcherContext(
+        storage_resolver=corpus["resolver"]))
+    req = LeafSearchRequest(
+        search_request=request(), index_uid="impact:01",
+        doc_mapping=MAPPER.to_dict(), splits=corpus["v3"])
+    first = service.leaf_search(req)
+    before = impact_counters()
+    second = service.leaf_search(req)
+    delta = counter_deltas(before, impact_counters())
+    assert hit_keys(second) == hit_keys(first)
+    assert delta == {"scored": 0, "skipped": 0, "bytes": 0, "cutoffs": 0}
+
+
+def test_mixed_v2_v3_splits_in_one_request(corpus):
+    mixed = [corpus["v3"][0], corpus["v2"][1], corpus["v3"][2]]
+    base = leaf(corpus, corpus["v2"], request())
+    threshold = base.partial_hits[-1].sort_value
+    r_mixed = leaf(corpus, mixed, request(), threshold=threshold)
+    # split ids coincide across the twin corpora, so hit keys compare 1:1
+    assert hit_keys(r_mixed) == hit_keys(base)
+    assert r_mixed.num_hits == base.num_hits
+
+
+def test_resident_warm_repeats_stay_identical(corpus):
+    # resident-column serving + leaf cache OFF: every repeat re-executes
+    # the kernel over resident arrays — impact masking must be stable
+    # across warm repeats, not just on the first staging
+    base = leaf(corpus, corpus["v2"], request())
+    threshold = base.partial_hits[-1].sort_value
+    service = SearchService(SearcherContext(
+        storage_resolver=corpus["resolver"], batch_size=1,
+        leaf_cache_bytes=0, resident_columns=True))
+    req = LeafSearchRequest(
+        search_request=request(), index_uid="impact:01",
+        doc_mapping=MAPPER.to_dict(), splits=corpus["v3"],
+        sort_value_threshold=threshold)
+    runs = [service.leaf_search(req) for _ in range(3)]
+    for run in runs:
+        assert hit_keys(run) == hit_keys(base)
+        assert run.num_hits == base.num_hits
+
+
+def test_pruning_downgrade_equivalence():
+    # a split whose exact impact cap cannot beat the collector's Kth
+    # value is downgraded to count-only — results must match the
+    # doc-ordered twin, and the count must still include the weak split
+    from quickwit_tpu.observability.metrics import (
+        SEARCH_SPLITS_DOWNGRADED_TOTAL)
+    resolver = StorageResolver.for_test()
+
+    # _score scheduling visits splits by descending num_docs, so the hot
+    # split must be the LARGER one for its Kth value to become the
+    # threshold before the weak split is classified
+    def build(uri):
+        storage = resolver.resolve(uri)
+        hot = [{"ts": BASE_TS + i, "val": i,
+                "body": "common " * 20} for i in range(400)]
+        weak = [{"ts": BASE_TS + 1000 + i, "val": 1000 + i,
+                 "body": "common filler words here"} for i in range(300)]
+        write_split(storage, "hot", hot)
+        write_split(storage, "weak", weak)
+        return [SplitIdAndFooter(split_id=s, storage_uri=uri,
+                                 num_docs=n, time_range=None)
+                for s, n in (("hot", 400), ("weak", 300))]
+    v3 = build("ram:///impact/dg3")
+    os.environ["QW_DISABLE_IMPACT"] = "1"
+    try:
+        v2 = build("ram:///impact/dg2")
+    finally:
+        del os.environ["QW_DISABLE_IMPACT"]
+
+    def run(offsets):
+        # a fresh service cannot bound a never-opened split (no warm
+        # reader, empty ScoreBoundCache), so query 1 is the warmup that
+        # records each split's stats at open; query 2 uses a different
+        # max_hits (a different leaf-cache key) and is where the weak
+        # split's cached exact cap can lose to the hot split's Kth value.
+        # prefetch=False: the weak group's classify must observe the
+        # threshold published by the hot group's execution, not race it
+        service = SearchService(SearcherContext(
+            storage_resolver=resolver, batch_size=1, prefetch=False))
+        def query(max_hits):
+            return service.leaf_search(LeafSearchRequest(
+                search_request=request(max_hits=max_hits),
+                index_uid="impact:01",
+                doc_mapping=MAPPER.to_dict(), splits=offsets))
+        query(10)
+        return query(9)
+    before = SEARCH_SPLITS_DOWNGRADED_TOTAL.get()
+    r3 = run(v3)
+    assert SEARCH_SPLITS_DOWNGRADED_TOTAL.get() - before >= 1, \
+        "weak split should have been downgraded via its exact cap"
+    assert r3.resource_stats["num_splits_downgraded_to_count"] >= 1
+    r2 = run(v2)
+    assert hit_keys(r3) == hit_keys(r2)
+    assert all(h.split_id == "hot" for h in r3.partial_hits)
+    assert r3.num_hits == r2.num_hits == 700  # count keeps the weak split
+
+
+# --- pruning: the exact cap flows through the score-bound cache ------------
+
+
+def test_score_bound_cache_roundtrips_cap():
+    cache = ScoreBoundCache()
+    cache.record("s0", "body", "common", 100, 20, 1.25)
+    cache.record("s1", "body", "common", 100, 20)  # v2: no cap
+    assert cache.get("s0", "body", "common") == (100, 20, 1.25)
+    assert cache.get("s1", "body", "common") == (100, 20, None)
+
+
+def test_split_upper_bound_prefers_exact_cap():
+    terms = [("body", "common", 1.0)]
+    formula = split_score_upper_bound(
+        terms, 1000, lambda f, t: (100, 20, None))
+    capped = split_score_upper_bound(
+        terms, 1000, lambda f, t: (100, 20, 0.5))
+    boosted = split_score_upper_bound(
+        [("body", "common", 2.0)], 1000, lambda f, t: (100, 20, 0.5))
+    assert formula == pytest.approx(term_score_bound(1000, 100, 20))
+    assert capped == 0.5 < formula
+    assert boosted == 1.0  # boost scales linearly through the cap
+    assert split_score_upper_bound(terms, 1000, lambda f, t: None) is None
+
+
+# --- merge: impact re-derivation + cluster reorder degrade path ------------
+
+
+def interleaved_merge_inputs():
+    """3 splits whose timestamps interleave: append-order concat leaves ts
+    scrambled, so the cluster reorder has real work to do."""
+    storage = RamStorage(Uri.parse("ram:///impact/minputs"))
+    all_docs = []
+    for split in range(3):
+        docs = []
+        for i in range(70 + split * 10):
+            docs.append({
+                "ts": 9000 + i * 3 + split,  # interleaves across splits
+                "val": split * 1000 + i,
+                "body": f"alpha doc{split}x{i} " + "common " * (1 + i % 7),
+                "sev": ["INFO", "WARN", "ERROR"][i % 3],
+            })
+        write_split(storage, f"m{split}", docs)
+        all_docs.extend(docs)
+    readers = [SplitReader(storage, f"m{s}.split") for s in range(3)]
+    return storage, readers, all_docs
+
+
+def test_merge_preserves_impact_ordering():
+    storage, readers, all_docs = interleaved_merge_inputs()
+    storage.put("merged.split", merge_splits(readers, reorder_field="ts"))
+    merged = SplitReader(storage, "merged.split")
+    assert merged.impact_info("body") == {
+        "buckets": IMPACT_BUCKETS, "block": IMPACT_BLOCK, "ordered": True}
+    assert merged.num_docs == len(all_docs)
+    # soundness holds against the MERGED corpus statistics
+    for term in ("common", "alpha"):
+        _, _, quant, bmax, scale, info = term_layout(merged, "body", term)
+        scores = exact_term_scores(merged, "body", term)
+        assert np.all(quant[:info.df].astype(np.float64) * float(scale)
+                      >= scores.astype(np.float64)), term
+        assert np.all(np.diff(bmax.astype(np.int32)) <= 0), term
+    # max_tf regenerated for the merged layout
+    df, max_tf = merged.term_stats("body", "common")
+    assert df == len(all_docs) and max_tf == 7
+
+
+def test_merge_reorder_clusters_timestamps():
+    storage, readers, all_docs = interleaved_merge_inputs()
+    storage.put("merged.split", merge_splits(readers, reorder_field="ts"))
+    merged = SplitReader(storage, "merged.split")
+    values, present = merged.column_values("ts")
+    ts = values[:merged.num_docs]
+    assert np.all(present[:merged.num_docs])
+    assert np.all(np.diff(ts) >= 0), "docs must cluster by timestamp"
+    # zonemaps exist for the merged numeric columns and bound the data
+    zmin, zmax = merged.column_zonemaps("val")
+    assert zmin is not None and zmax is not None
+    # docstore rebuilt under the same permutation: doc i IS the doc with
+    # the i-th smallest timestamp
+    expected = sorted(all_docs, key=lambda d: d["ts"])
+    got = merged.fetch_docs([0, 1, merged.num_docs - 1])
+    assert [g["val"] for g in got] == [expected[0]["val"],
+                                      expected[1]["val"],
+                                      expected[-1]["val"]]
+
+
+def test_merge_reorder_chaos_falls_back_to_append_order(caplog):
+    # satellite chaos point "merge.reorder": an injected fault inside the
+    # clustering pass must yield the byte-identical append-order merge
+    storage, readers, _ = interleaved_merge_inputs()
+    plain = merge_splits(readers)
+    injector = FaultInjector(seed=7, rules=[
+        FaultRule("merge.reorder", "error")])
+    import logging
+    with caplog.at_level(logging.WARNING,
+                         logger="quickwit_tpu.index.merge_arrays"):
+        degraded = merge_splits(
+            readers, reorder_field="ts",
+            fault_hook=lambda: injector.perturb("merge.reorder"))
+    assert degraded == plain
+    assert any("cluster reorder" in r.message for r in caplog.records)
+    # and the degraded split is a fully functional v3 split
+    storage.put("degraded.split", degraded)
+    reader = SplitReader(storage, "degraded.split")
+    assert reader.impact_info("body") is not None
+    assert reader.term_stats("body", "alpha")[0] == reader.num_docs
+
+
+def test_merged_split_search_equivalence():
+    # searching the merged (reordered) split scores exactly like a
+    # doc-level rewrite of the same corpus — doc ids permute, the
+    # (score, identity) multiset doesn't. Per-split searches are NOT the
+    # comparator: merging changes df/avg_len, so scores legitimately move.
+    from quickwit_tpu.search import leaf_search_single_split
+    storage, readers, all_docs = interleaved_merge_inputs()
+    storage.put("merged.split", merge_splits(readers, reorder_field="ts"))
+    merged = SplitReader(storage, "merged.split")
+    write_split(storage, "doclevel", all_docs)
+    doclevel = SplitReader(storage, "doclevel.split")
+    req = request("body:common", max_hits=len(all_docs))
+    merged_resp = leaf_search_single_split(req, MAPPER, merged, "merged")
+    doc_resp = leaf_search_single_split(req, MAPPER, doclevel, "doclevel")
+    assert merged_resp.num_hits == doc_resp.num_hits == len(all_docs)
+
+    def scored_vals(reader, resp):
+        docs = reader.fetch_docs([h.doc_id for h in resp.partial_hits])
+        return sorted((h.sort_value, d["val"])
+                      for h, d in zip(resp.partial_hits, docs))
+    assert scored_vals(merged, merged_resp) == \
+        scored_vals(doclevel, doc_resp)

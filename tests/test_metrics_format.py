@@ -250,6 +250,47 @@ def test_resident_metrics_expose_with_strict_grammar():
     assert parsed["qw_topk_guided_fallback_total"][()] >= 1.0
 
 
+def test_impact_metrics_expose_with_strict_grammar():
+    """The impact prefix-cutoff counters (bumped by the lowering's
+    `_impact_prefix` decision, search/plan.py) must ride the strict
+    exposition: all four qw_impact_* families announce HELP/TYPE and
+    their samples parse. Counters are process-global, so assert deltas."""
+    from quickwit_tpu.observability.metrics import (
+        IMPACT_BLOCKS_SCORED_TOTAL, IMPACT_BLOCKS_SKIPPED_TOTAL,
+        IMPACT_POSTINGS_BYTES_AVOIDED_TOTAL, IMPACT_PREFIX_CUTOFFS_TOTAL,
+    )
+    names = ("qw_impact_blocks_scored_total",
+             "qw_impact_blocks_skipped_total",
+             "qw_impact_postings_bytes_avoided_total",
+             "qw_impact_prefix_cutoffs_total")
+
+    def snapshot():
+        parsed = parse_exposition(METRICS.expose_text())
+        return {name: sum(parsed.get(name, {}).values()) for name in names}
+
+    before = snapshot()
+    # one prefix-cutoff decision: 2 live blocks, 14 skipped, ids+tfs int32
+    IMPACT_BLOCKS_SCORED_TOTAL.inc(2)
+    IMPACT_BLOCKS_SKIPPED_TOTAL.inc(14)
+    IMPACT_POSTINGS_BYTES_AVOIDED_TOTAL.inc(14 * 128 * 8)
+    IMPACT_PREFIX_CUTOFFS_TOTAL.inc()
+    text = METRICS.expose_text()
+    parsed = parse_exposition(text)
+    after = snapshot()
+    for name in names:
+        assert name in parsed, f"{name} missing from exposition"
+        assert f"# HELP {name} " in text
+        assert f"# TYPE {name} counter" in text
+    assert after["qw_impact_blocks_scored_total"] - \
+        before["qw_impact_blocks_scored_total"] == 2
+    assert after["qw_impact_blocks_skipped_total"] - \
+        before["qw_impact_blocks_skipped_total"] == 14
+    assert after["qw_impact_postings_bytes_avoided_total"] - \
+        before["qw_impact_postings_bytes_avoided_total"] == 14 * 128 * 8
+    assert after["qw_impact_prefix_cutoffs_total"] - \
+        before["qw_impact_prefix_cutoffs_total"] == 1
+
+
 def test_full_registry_exposition_parses():
     """The real global registry — after driving a few metrics through the
     awkward cases (labels, floats, multiple label sets) — must emit text

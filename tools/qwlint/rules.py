@@ -18,6 +18,9 @@ _HOT_PATH_MODULES = (
     "quickwit_tpu/search/leaf.py",
     "quickwit_tpu/search/collector.py",
     "quickwit_tpu/search/plan.py",
+    # write-time impact quantization: numpy-only by contract (its scores
+    # must mirror ops/bm25.py bit-for-bit, and merge re-runs it per field)
+    "quickwit_tpu/index/impact.py",
     # the audited host-decode seam: conversions are ALLOWED here (each is
     # individually suppressed with its contract), nowhere else
     "quickwit_tpu/search/hostdecode.py",
