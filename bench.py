@@ -1200,6 +1200,173 @@ def _measure_impact_ordered(iters: int) -> dict:
     }
 
 
+def _measure_dashboard_qps(iters: int) -> dict:
+    """Config #11: the hierarchical-cache dashboard workload
+    (docs/hierarchical-cache.md). N panels share ONE filter but carry
+    distinct agg shapes — the shape a dashboard refresh fans out as. With
+    the mask + partial-agg tiers on, warm count/agg panels short-circuit
+    to cached partials (zero kernel launches) and warm hit panels reuse
+    the cached predicate mask (zero predicate-column bytes staged); the
+    cache-disabled twin re-evaluates the same filter per panel. Reports
+    concurrent QPS, p50/p99, and the staged-bytes / kernel-launches
+    avoided. Leaf cache off so the tiers (not whole-response reuse) are
+    what is measured; both counter claims are asserted, and every panel's
+    response is asserted identical across the twins."""
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from quickwit_tpu.index.synthetic import (
+        HDFS_MAPPER, body_term, synthetic_hdfs_split)
+    from quickwit_tpu.observability.metrics import (
+        PREDICATE_STAGED_BYTES_TOTAL, SEARCH_KERNEL_LAUNCHES_TOTAL,
+        STAGING_BYTES_TOTAL)
+    from quickwit_tpu.query.ast import Term
+    from quickwit_tpu.search.models import (
+        LeafSearchRequest, SearchRequest, SortField, SplitIdAndFooter)
+    from quickwit_tpu.search.service import SearcherContext, SearchService
+    from quickwit_tpu.storage import StorageResolver
+
+    n_splits = int(os.environ.get("BENCH_DASH_SPLITS", 4))
+    docs_per = int(os.environ.get("BENCH_DASH_DOCS", 65_536))
+    concurrency = int(os.environ.get("BENCH_DASH_CONCURRENCY", 4))
+    resolver = StorageResolver.for_test()
+    storage = resolver.resolve("ram:///bench-dash")
+    offsets = []
+    for s in range(n_splits):
+        storage.put(f"d{s}.split",
+                    synthetic_hdfs_split(docs_per, seed=500 + s))
+        offsets.append(SplitIdAndFooter(
+            split_id=f"d{s}", storage_uri="ram:///bench-dash",
+            num_docs=docs_per))
+
+    shapes = {
+        "sev": {"terms": {"field": "severity_text"}},
+        "tenants": {"terms": {"field": "tenant_id"}},
+        "tenant_stats": {"stats": {"field": "tenant_id"}},
+        "per_hour": {"date_histogram": {"field": "timestamp",
+                                        "fixed_interval": "1h"}},
+        "per_30m": {"date_histogram": {"field": "timestamp",
+                                       "fixed_interval": "30m"}},
+        "per_2h": {"date_histogram": {"field": "timestamp",
+                                      "fixed_interval": "2h"}},
+    }
+    shared_filter = Term("body", body_term(3))
+
+    def panel(name, spec, max_hits):
+        return LeafSearchRequest(
+            search_request=SearchRequest(
+                index_ids=["hdfs-logs"], query_ast=shared_filter,
+                max_hits=max_hits, aggs={name: spec},
+                sort_fields=(SortField("timestamp", "desc"),)),
+            index_uid="bench:dash", doc_mapping=HDFS_MAPPER.to_dict(),
+            splits=offsets)
+
+    # half the dashboard is count/agg-only (Tier B short-circuit), half
+    # carries a top-hits page (Tier A mask path)
+    panels = [panel(name, spec, 0 if i % 2 == 0 else 10)
+              for i, (name, spec) in enumerate(shapes.items())]
+
+    def make_service(enabled):
+        return SearchService(SearcherContext(
+            storage_resolver=resolver, batch_size=1, prefetch=False,
+            leaf_cache_bytes=0, enable_mask_cache=enabled,
+            enable_agg_cache=enabled))
+
+    counter_lock = threading.Lock()
+
+    def drive(service):
+        cold = [service.leaf_search(p) for p in panels]  # compile + fill
+        for p in panels:
+            service.leaf_search(p)  # warm plans (mask-hit shape compiles)
+        staged0 = STAGING_BYTES_TOTAL.get()
+        pred0 = PREDICATE_STAGED_BYTES_TOTAL.get()
+        launches0 = SEARCH_KERNEL_LAUNCHES_TOTAL.get()
+        lat = []
+
+        def one(p):
+            t0 = time.monotonic()
+            service.leaf_search(p)
+            dt = time.monotonic() - t0
+            with counter_lock:
+                lat.append(dt)
+
+        t_start = time.monotonic()
+        with ThreadPoolExecutor(max_workers=concurrency) as pool:
+            for _ in range(iters):
+                list(pool.map(one, panels))
+        wall = time.monotonic() - t_start
+        return cold, {
+            "qps": round(len(lat) / max(wall, 1e-9), 1),
+            "p50_ms": round(_percentile(lat, 0.5) * 1000, 2),
+            "p99_ms": round(_percentile(lat, 0.99) * 1000, 2),
+            "staged_bytes": int(STAGING_BYTES_TOTAL.get() - staged0),
+            "predicate_staged_bytes": int(
+                PREDICATE_STAGED_BYTES_TOTAL.get() - pred0),
+            "kernel_launches": int(
+                SEARCH_KERNEL_LAUNCHES_TOTAL.get() - launches0),
+        }
+
+    cached_cold, hot = drive(make_service(True))
+    twin_cold, cold = drive(make_service(False))
+
+    # staging attribution under node churn: in-process, the resident
+    # column store (PR 9) already absorbs repeat staging, so the mask
+    # tier's staged-bytes win shows on a FRESH context (restart / leaf
+    # churn) whose cache tier survived — it stages sort/agg columns plus a
+    # 128-byte mask, never the postings the filter was built from
+    def churn(enabled, rounds, warm_tier=None):
+        staged0 = STAGING_BYTES_TOTAL.get()
+        pred0 = PREDICATE_STAGED_BYTES_TOTAL.get()
+        for _ in range(rounds):
+            service = make_service(enabled)
+            if warm_tier is not None:
+                service.context.mask_cache = warm_tier[0]
+                service.context.agg_cache = warm_tier[1]
+            service.leaf_search(panels[1])  # a top-hits (mask-path) panel
+        return (int(STAGING_BYTES_TOTAL.get() - staged0),
+                int(PREDICATE_STAGED_BYTES_TOTAL.get() - pred0))
+
+    seed_service = make_service(True)
+    seed_service.leaf_search(panels[1])  # fill the tier once
+    warm_tier = (seed_service.context.mask_cache,
+                 seed_service.context.agg_cache)
+    churn_rounds = 3
+    cached_staged, cached_pred = churn(True, churn_rounds, warm_tier)
+    twin_staged, twin_pred = churn(False, churn_rounds)
+    assert cached_pred == 0, \
+        "mask-hit panels on fresh nodes staged predicate columns"
+    assert twin_pred > 0, \
+        "cache-disabled twin staged no predicate columns (probe broken)"
+
+    for a, b in zip(cached_cold, twin_cold):
+        assert a.num_hits == b.num_hits and json.dumps(
+            a.intermediate_aggs, sort_keys=True, default=repr) == json.dumps(
+            b.intermediate_aggs, sort_keys=True, default=repr), \
+            "hierarchical caches changed a dashboard panel's results"
+    # the tentpole's acceptance claim: a warm dashboard stages ZERO
+    # predicate-column bytes (mask hits) while the cache-disabled twin
+    # re-stages the filter columns it just threw away
+    assert hot["predicate_staged_bytes"] == 0, \
+        "warm mask-path panels staged predicate columns"
+    assert hot["kernel_launches"] < cold["kernel_launches"], \
+        "Tier B short-circuit launched as many kernels as the twin"
+
+    return {
+        "n_panels": len(panels), "n_splits": n_splits,
+        "docs_per_split": docs_per, "concurrency": concurrency,
+        "e2e_ms": hot["p50_ms"],  # headline: warm cached panel p50
+        "cached": hot, "uncached": cold,
+        "qps_speedup": round(hot["qps"] / max(cold["qps"], 1e-9), 2),
+        "p99_speedup": round(cold["p99_ms"] / max(hot["p99_ms"], 1e-9), 2),
+        "kernel_launches_avoided":
+            cold["kernel_launches"] - hot["kernel_launches"],
+        # per fresh-node query on a tier-warm filter (churn phase)
+        "staged_bytes_avoided": (twin_staged - cached_staged) // churn_rounds,
+        "predicate_staged_bytes_avoided":
+            (twin_pred - cached_pred) // churn_rounds,
+    }
+
+
 def _run_all(iters: int, with_device_loops: bool = True) -> dict:
     results: dict = {}
     workloads = _workloads()
@@ -1234,6 +1401,10 @@ def _run_all(iters: int, with_device_loops: bool = True) -> dict:
             max(3, iters // 3))
         print(f"# c10_impact_ordered: "
               f"{json.dumps(results['c10_impact_ordered'])}", file=sys.stderr)
+        results["c11_dashboard_qps"] = _measure_dashboard_qps(
+            max(3, iters // 3))
+        print(f"# c11_dashboard_qps: "
+              f"{json.dumps(results['c11_dashboard_qps'])}", file=sys.stderr)
     return results
 
 

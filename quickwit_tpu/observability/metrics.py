@@ -334,6 +334,68 @@ OFFLOAD_AUTOSCALE_TOTAL = METRICS.counter(
     "qw_offload_autoscale_events_total",
     "Offload pool autoscaler resize events, by direction (up | down)")
 
+# --- hierarchical leaf caches (search/cache.py, search/mask_cache.py,
+#     search/agg_cache.py, search/predicate_cache.py) ------------------------
+# Three result-reuse tiers over immutable splits plus the term-absence
+# negative cache, each with hit/miss/evicted-bytes counters so cache health
+# is visible on /metrics instead of only the REST developer endpoint. All
+# four are tenant-partitioned (search/tenant_cache.py); these counters
+# aggregate across partitions (per-tenant byte breakdowns stay on the
+# developer endpoint to bound label cardinality).
+LEAF_CACHE_HITS_TOTAL = METRICS.counter(
+    "qw_leaf_cache_hits_total",
+    "Whole-split LeafSearchResponse cache hits")
+LEAF_CACHE_MISSES_TOTAL = METRICS.counter(
+    "qw_leaf_cache_misses_total",
+    "Whole-split LeafSearchResponse cache misses")
+LEAF_CACHE_EVICTED_BYTES_TOTAL = METRICS.counter(
+    "qw_leaf_cache_evicted_bytes_total",
+    "Bytes evicted from the leaf response cache under capacity pressure")
+PREDICATE_CACHE_HITS_TOTAL = METRICS.counter(
+    "qw_predicate_cache_hits_total",
+    "Splits proven empty by the term-absence negative cache")
+PREDICATE_CACHE_MISSES_TOTAL = METRICS.counter(
+    "qw_predicate_cache_misses_total",
+    "Negative-cache consults that could not prove the split empty")
+PREDICATE_CACHE_EVICTED_BYTES_TOTAL = METRICS.counter(
+    "qw_predicate_cache_evicted_bytes_total",
+    "Absence-proof bytes evicted from the predicate cache under its "
+    "byte/entry bounds")
+MASK_CACHE_HITS_TOTAL = METRICS.counter(
+    "qw_mask_cache_hits_total",
+    "Predicate-mask cache hits (filter bitmask reused across query shapes)")
+MASK_CACHE_MISSES_TOTAL = METRICS.counter(
+    "qw_mask_cache_misses_total",
+    "Predicate-mask cache misses (filter evaluated on device)")
+MASK_CACHE_EVICTED_BYTES_TOTAL = METRICS.counter(
+    "qw_mask_cache_evicted_bytes_total",
+    "Packed mask bytes evicted from the mask cache under capacity pressure")
+AGG_CACHE_HITS_TOTAL = METRICS.counter(
+    "qw_agg_cache_hits_total",
+    "Partial-aggregation cache hits (count or intermediate agg state)")
+AGG_CACHE_MISSES_TOTAL = METRICS.counter(
+    "qw_agg_cache_misses_total",
+    "Partial-aggregation cache misses")
+AGG_CACHE_EVICTED_BYTES_TOTAL = METRICS.counter(
+    "qw_agg_cache_evicted_bytes_total",
+    "Intermediate-agg bytes evicted from the partial-agg cache under "
+    "capacity pressure")
+# Staging attribution for the mask tier's headline claim: total staged
+# bytes, the subset staged ONLY for predicate evaluation (arrays no sort/
+# agg consumer touches — a mask-cache hit stages zero of these), and total
+# device kernel dispatches (a Tier-B short-circuit launches none).
+STAGING_BYTES_TOTAL = METRICS.counter(
+    "qw_staging_bytes_total",
+    "Host-to-device bytes staged by leaf warmup")
+PREDICATE_STAGED_BYTES_TOTAL = METRICS.counter(
+    "qw_predicate_column_staged_bytes_total",
+    "Staged bytes attributable only to predicate evaluation "
+    "(postings/fieldnorm/filter-column arrays without a sort or agg "
+    "consumer)")
+SEARCH_KERNEL_LAUNCHES_TOTAL = METRICS.counter(
+    "qw_search_kernel_launches_total",
+    "Device kernel dispatches (single, multi-query, and mask-fill)")
+
 # --- chaos / fault injection (common/faults.py) ----------------------------
 # Every fault the injector actually fired, labeled op=<operation>
 # kind=<latency|error|hang>: chaos runs are visible in /metrics instead of

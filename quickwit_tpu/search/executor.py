@@ -32,11 +32,12 @@ from ..observability.profile import (
 from ..ops import aggs as agg_ops
 from ..ops import masks as mask_ops
 from ..ops import topk as topk_ops
+from ..observability.metrics import SEARCH_KERNEL_LAUNCHES_TOTAL
 from ..ops.bm25 import dequantize_block_bounds, score_postings
 from .plan import (
     PRESENT_FROM_VALUES, BucketAggExec, CompositeAggExec, LoweredPlan,
-    MetricAggExec, PBool, PMatchAll, PMatchNone, PNormPresence, PPostings,
-    PPresence, PRange, SortExec,
+    MetricAggExec, PBool, PMaskRef, PMatchAll, PMatchNone, PNormPresence,
+    PPostings, PPresence, PRange, SortExec,
 )
 
 _JIT_CACHE: dict[tuple, Callable] = {}
@@ -644,11 +645,31 @@ def _eval_aggs(aggs, gathered, scalars, valid):
     return agg_out
 
 
-def _build(plan: LoweredPlan, k: int, exact: bool = False) -> Callable:
-    if _posting_space_eligible(plan):
-        return _build_posting_space(plan, k, exact)
-    padded = plan.num_docs_padded
-    root, sort, aggs = plan.root, plan.sort, plan.aggs
+def _pack_mask(mask, padded: int):
+    """Big-endian bit pack of a [padded] bool mask into [ceil(padded/8)]
+    uint8 — np.packbits bit order, so a device-computed mask and a host
+    np.packbits of the same booleans are byte-identical (the mask-cache
+    equivalence tests lean on this)."""
+    nbytes = (padded + 7) // 8
+    bits = jnp.zeros((nbytes * 8,), dtype=jnp.uint32)
+    bits = bits.at[:padded].set(mask.astype(jnp.uint32))
+    weights = jnp.array([128, 64, 32, 16, 8, 4, 2, 1], dtype=jnp.uint32)
+    return jnp.sum(bits.reshape(nbytes, 8) * weights, axis=1).astype(jnp.uint8)
+
+
+def _unpack_mask(packed, padded: int):
+    """Inverse of `_pack_mask`: [nbytes] uint8 -> [padded] bool."""
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    bits = (packed[:, None] >> shifts[None, :]) & jnp.uint8(1)
+    return bits.reshape(-1)[:padded].astype(jnp.bool_)
+
+
+def _node_evaluator(padded: int) -> Callable:
+    """The predicate-tree evaluator, shared by the full search kernel
+    (`_build`) and the mask-fill kernel (`compute_packed_mask`) — one
+    implementation, so a cached mask is bit-identical to inline evaluation
+    by construction (zonemaps, FOR-packed compares, msm semantics and
+    all)."""
 
     def eval_node(node, arrays, scalars):
         """Returns (mask[padded] bool, scores[padded] f32 | None)."""
@@ -656,6 +677,9 @@ def _build(plan: LoweredPlan, k: int, exact: bool = False) -> Callable:
             return jnp.ones(padded, dtype=jnp.bool_), None
         if isinstance(node, PMatchNone):
             return jnp.zeros(padded, dtype=jnp.bool_), None
+        if isinstance(node, PMaskRef):
+            # Tier A hit: the whole predicate is the cached packed bitmask
+            return _unpack_mask(arrays[node.packed_slot], padded), None
         if isinstance(node, PPostings):
             ids = arrays[node.ids_slot]
             mask = mask_ops.mask_from_postings(ids, padded)
@@ -727,6 +751,16 @@ def _build(plan: LoweredPlan, k: int, exact: bool = False) -> Callable:
             for s in score_parts[1:]:
                 scores = scores + s
         return mask, scores
+
+    return eval_node
+
+
+def _build(plan: LoweredPlan, k: int, exact: bool = False) -> Callable:
+    if _posting_space_eligible(plan):
+        return _build_posting_space(plan, k, exact)
+    padded = plan.num_docs_padded
+    root, sort, aggs = plan.root, plan.sort, plan.aggs
+    eval_node = _node_evaluator(padded)
 
     def fn(arrays, scalars, num_docs):
         # predicate evaluation reads the raw (possibly packed-delta) arrays;
@@ -940,6 +974,7 @@ def dispatch_plan_multi(plan: LoweredPlan, k: int,
     The lane count is padded to a power-of-two bucket (surplus lanes
     repeat the last query and are discarded at readback)."""
     k = max(0, min(k, plan.num_docs_padded))
+    SEARCH_KERNEL_LAUNCHES_TOTAL.inc()
     batch = len(scalar_sets)
     bucket = _batch_bucket(batch)
     padded_sets = list(scalar_sets) + [scalar_sets[-1]] * (bucket - batch)
@@ -1023,6 +1058,7 @@ def dispatch_plan(plan: LoweredPlan, k: int,
     readback block above); `copy_to_host_async` starts the D2H transfer so
     the later blocking readback only waits out the remainder."""
     k = max(0, min(k, plan.num_docs_padded))
+    SEARCH_KERNEL_LAUNCHES_TOTAL.inc()
     scalars, num_docs = _device_scalars(plan)
     args = (tuple(device_arrays), scalars, num_docs)
     profile = current_profile()
@@ -1092,3 +1128,50 @@ def execute_plan(plan: LoweredPlan, k: int,
 
 def executor_cache_size() -> int:
     return len(_JIT_CACHE)
+
+
+# --- predicate-mask fill (Tier A, search/mask_cache.py) ----------------------
+
+_MASK_FILL_CACHE: dict[tuple, Callable] = {}
+
+
+def compute_packed_mask(
+        plan: LoweredPlan,
+        device_arrays: list[jax.Array]) -> tuple[np.ndarray, jax.Array]:
+    """Evaluate ONLY the plan's predicate root over already-staged device
+    arrays and return `(host_packed, device_packed)` — the uint8 bitmask in
+    np.packbits bit order, both as the host copy destined for the cache tier
+    and as the still-device-resident original so callers can seed it into a
+    warm split's residency cache without a round trip.
+
+    Runs as its own tiny jitted kernel right after the main execute, while
+    the split's arrays are still pinned — so a fill costs one extra launch
+    plus a padded/8-byte readback, not a re-staging. Reuses the SAME
+    `_node_evaluator` as the search kernel: the cached mask is bit-identical
+    to inline evaluation by construction. Callers must gate on
+    `plan.count_override is None` — an impact-prefix-truncated plan
+    (format v3) never saw the posting tail, so its mask would be
+    incomplete."""
+    padded = plan.num_docs_padded
+    root = plan.root
+    key = (root.sig(),
+           tuple((a.shape, str(a.dtype)) for a in plan.arrays),
+           tuple(str(s.dtype) for s in plan.scalars),
+           padded)
+    fill = _MASK_FILL_CACHE.get(key)
+    if fill is None:
+        eval_node = _node_evaluator(padded)
+
+        def mask_fn(arrays, scalars, num_docs):
+            mask, _ = eval_node(root, arrays, scalars)
+            mask = mask & mask_ops.valid_docs_mask(num_docs, padded)
+            return _pack_mask(mask, padded)
+
+        fill = jax.jit(mask_fn)
+        _MASK_FILL_CACHE[key] = fill
+    scalars, num_docs = _device_scalars(plan)
+    SEARCH_KERNEL_LAUNCHES_TOTAL.inc()
+    packed = fill(tuple(device_arrays), scalars, num_docs)
+    # qwlint: disable-next-line=QW001 - deliberate padded/8-byte readback of
+    # the freshly computed mask into the host-side cache tier
+    return np.asarray(jax.device_get(packed), dtype=np.uint8), packed

@@ -25,12 +25,15 @@ from ..observability.profile import (
     PHASE_PLAN_BUILD, PHASE_STAGING_CACHE_HIT, PHASE_STAGING_UPLOAD,
     PHASE_TOPK_MERGE, current_profile, profile_add, profiled_phase,
 )
+from ..observability.metrics import (
+    PREDICATE_STAGED_BYTES_TOTAL, STAGING_BYTES_TOTAL,
+)
 from ..ops.aggs import PCTL_NUM_BUCKETS
 from ..query.aggregations import parse_aggs
 from .executor import execute_plan
 from .models import LeafSearchResponse, PartialHit, SearchRequest, SplitSearchError
 from .plan import (BucketAggExec, CompositeAggExec, MetricAggExec,
-                   lower_request)
+                   lower_request, predicate_only_slots)
 
 
 from ..ops.topk import MISSING_VALUE_SENTINEL
@@ -102,9 +105,22 @@ def warmup_device_arrays(reader: SplitReader, plan, budget=None,
     else:
         owner = reader
         cache = _device_cache(reader)
-    missing = [(key, arr) for key, arr in zip(plan.array_keys, plan.arrays)
+    missing = [(slot, key, arr)
+               for slot, (key, arr) in enumerate(zip(plan.array_keys,
+                                                     plan.arrays))
                if key not in cache]
-    staging_bytes = sum(arr.nbytes for _, arr in missing)
+    staging_bytes = sum(arr.nbytes for _, _, arr in missing)
+    if missing:
+        STAGING_BYTES_TOTAL.inc(staging_bytes)
+        # predicate-only attribution: the bytes a mask-cache hit avoids.
+        # The bench's "zero predicate staging when warm" invariant asserts
+        # on exactly this counter (tools/bench.py::c11_dashboard_qps).
+        pred_slots = predicate_only_slots(plan)
+        predicate_bytes = sum(arr.nbytes for slot, _, arr in missing
+                              if slot in pred_slots)
+        if predicate_bytes:
+            PREDICATE_STAGED_BYTES_TOTAL.inc(predicate_bytes)
+            profile_add("predicate_staging_bytes", predicate_bytes)
     admitted = 0
     if budget is not None:
         # pins the owner even when nothing is missing (zero-byte
@@ -121,9 +137,9 @@ def warmup_device_arrays(reader: SplitReader, plan, budget=None,
                 if rec is not None:
                     rec["bytes"] = staging_bytes
                     rec["arrays"] = len(missing)
-                transferred = jax.device_put([arr for _, arr in missing])
+                transferred = jax.device_put([arr for _, _, arr in missing])
             profile_add("staging_bytes", staging_bytes)
-            for (key, _), dev in zip(missing, transferred):
+            for (_, key, _), dev in zip(missing, transferred):
                 cache[key] = dev
             if store is not None and split_id is not None:
                 store.note_upload(split_id, staging_bytes, len(missing))
@@ -154,6 +170,9 @@ def prepare_plan_only(
     split_id: str,
     absence_sink=None,
     sort_value_threshold: Optional[float] = None,
+    aggs_override: Optional[dict] = None,
+    mask_override=None,
+    mask_key: Optional[str] = None,
 ):
     """Stage 1a: storage byte-range IO + plan lowering WITHOUT the device
     transfer. The service's per-split path defers H2D to the execute
@@ -164,8 +183,16 @@ def prepare_plan_only(
     `sort_value_threshold` (internal higher-is-better key) is pushed into
     the plan as a traced scalar masking sub-threshold docs before top_k
     (search/pruning.py); the plan signature only encodes its PRESENCE, so
-    compiled executables are reused across threshold values."""
-    agg_specs = parse_aggs(request.aggs) if request.aggs else []
+    compiled executables are reused across threshold values.
+
+    Hierarchical-cache hooks (search/service.py::_consult_split_caches):
+    `aggs_override` replaces the request's agg dict — the partial-agg tier
+    passes only the aggs it MISSED, so cached ones are neither lowered nor
+    staged nor computed ({} lowers none at all). `mask_override`/`mask_key`
+    forward a cached packed predicate mask to `lower_request`, which then
+    skips query lowering and every predicate column."""
+    aggs_dict = request.aggs if aggs_override is None else aggs_override
+    agg_specs = parse_aggs(aggs_dict) if aggs_dict else []
     sort = request.sort_fields[0] if request.sort_fields else None
     sort_field = sort.field if sort else "_score"
     sort_order = sort.order if sort else "desc"
@@ -188,6 +215,8 @@ def prepare_plan_only(
                                              reader=reader),
             absence_sink=absence_sink,
             sort_value_threshold=sort_value_threshold,
+            mask_override=mask_override,
+            mask_key=mask_key,
         )
 
 

@@ -152,6 +152,21 @@ class PBool:
                 f"]{self.minimum_should_match})")
 
 
+@dataclass(frozen=True)
+class PMaskRef:
+    """Query root replaced wholesale by a cached predicate mask
+    (search/mask_cache.py): the slot holds the np.packbits-packed uint8
+    bitmask (big-endian, 1 bit per padded doc) and the executor unpacks it
+    instead of evaluating the query tree. Its sig() forks every compiled-
+    executable cache via `LoweredPlan.signature`, like any other root.
+    Scoring requests are ineligible (a mask carries no BM25 scores) — the
+    lowering rejects the combination."""
+    packed_slot: int
+
+    def sig(self) -> str:
+        return f"maskref({self.packed_slot})"
+
+
 # --------------------------------------------------------------------------
 # aggregation executables
 
@@ -1709,10 +1724,27 @@ def lower_request(
     search_after: Optional[tuple] = None,  # (internal_value, relation, doc_id)
     absence_sink=None,
     sort_value_threshold: Optional[float] = None,  # internal higher-is-better
+    mask_override: Optional[np.ndarray] = None,  # packed predicate mask
+    mask_key: Optional[str] = None,              # its array-cache key
 ) -> LoweredPlan:
-    """Full request lowering: query + request-level time filter + sort + aggs."""
+    """Full request lowering: query + request-level time filter + sort + aggs.
+
+    `mask_override` (Tier A, search/mask_cache.py): a cached packed filter
+    bitmask standing in for the whole predicate — query lowering AND the
+    time-filter wrap are skipped (the digest already covers both), so no
+    predicate column is fetched or staged. Sort and agg columns lower as
+    usual. `mask_key` keys the mask's array slot so warm splits reuse its
+    device copy through `ResidentColumnStore` like any column."""
     low = Lowering(doc_mapper, reader, batch_overrides, absence_sink)
     scoring = "_score" in (sort_field, sort2_field)
+    if mask_override is not None:
+        if scoring:
+            raise PlanError("mask_override cannot serve scoring requests")
+        root = PMaskRef(packed_slot=low.b.add_array(
+            mask_key or "mask.override", lambda: mask_override))
+        return _finish_lowering(low, root, reader, agg_specs, sort_field,
+                                sort_order, sort2_field, sort2_order,
+                                search_after, sort_value_threshold)
     if (sort_value_threshold is not None and batch_overrides is None
             and not agg_specs and search_after is None
             and start_timestamp is None and end_timestamp is None
@@ -1743,6 +1775,25 @@ def lower_request(
             upper=Q.RangeBound(end_timestamp, False) if end_timestamp is not None else None,
         ), bounds_are_micros=True)
         root = PBool(must=(root,), filter=(ts_node,))
+    return _finish_lowering(low, root, reader, agg_specs, sort_field,
+                            sort_order, sort2_field, sort2_order,
+                            search_after, sort_value_threshold)
+
+
+def _finish_lowering(
+    low: "Lowering",
+    root: Any,
+    reader: SplitReader,
+    agg_specs: list[AggSpec],
+    sort_field: str,
+    sort_order: str,
+    sort2_field: Optional[str],
+    sort2_order: str,
+    search_after: Optional[tuple],
+    sort_value_threshold: Optional[float],
+) -> LoweredPlan:
+    """Sort/agg/search-after/threshold lowering shared by the query path
+    and the mask-override path of `lower_request`."""
     sort = low.lower_sort(sort_field, sort_order, sort2_field, sort2_order)
     sort_text_field = sort_field if (
         sort_field not in ("_score", "_doc")
@@ -1774,3 +1825,77 @@ def lower_request(
         rebase=low.rebase,
         count_override=low.count_override,
     )
+
+
+# --------------------------------------------------------------------------
+# slot classification (staged-bytes attribution, observability/metrics.py)
+
+def _query_node_slots(node: Any, out: set[int]) -> None:
+    if isinstance(node, PPostings):
+        for slot in (node.ids_slot, node.tfs_slot, node.norm_slot,
+                     node.impact_bmax_slot):
+            if slot >= 0:
+                out.add(slot)
+    elif isinstance(node, PRange):
+        for slot in (node.values_slot, node.present_slot,
+                     node.zmin_slot, node.zmax_slot):
+            if slot >= 0:
+                out.add(slot)
+    elif isinstance(node, PPresence):
+        if node.present_slot >= 0:
+            out.add(node.present_slot)
+    elif isinstance(node, PNormPresence):
+        if node.norm_slot >= 0:
+            out.add(node.norm_slot)
+    elif isinstance(node, PBool):
+        for clause in (*node.must, *node.must_not, *node.should, *node.filter):
+            _query_node_slots(clause, out)
+    # PMatchAll / PMatchNone / PMaskRef: no predicate columns. A PMaskRef's
+    # packed slot is deliberately NOT a predicate column — it's the cached
+    # substitute for them, and counting it would make the "zero predicate
+    # staging on a warm hit" invariant unassertable.
+
+
+def _metric_slots(metric: MetricSlots, out: set[int]) -> None:
+    for slot in (metric.values_slot, metric.present_slot, metric.hash_slot):
+        if slot >= 0:
+            out.add(slot)
+
+
+def _agg_slots(agg: Any, out: set[int]) -> None:
+    if isinstance(agg, BucketAggExec):
+        for slot in (agg.values_slot, agg.present_slot,
+                     agg.froms_slot, agg.tos_slot):
+            if slot >= 0:
+                out.add(slot)
+        for metric in agg.metrics:
+            _metric_slots(metric, out)
+        for sub in agg.subs:
+            _agg_slots(sub, out)
+    elif isinstance(agg, MetricAggExec):
+        _metric_slots(agg.metric, out)
+    elif isinstance(agg, CompositeAggExec):
+        for source in agg.sources:
+            for slot in (source.values_slot, source.present_slot):
+                if slot >= 0:
+                    out.add(slot)
+        for metric in agg.metrics:
+            _metric_slots(metric, out)
+        for sub in agg.subs:
+            _agg_slots(sub, out)
+
+
+def predicate_only_slots(plan: LoweredPlan) -> set[int]:
+    """Array slots referenced ONLY by the query root — the staging a
+    predicate-mask hit avoids. Slots shared with sort or aggs are excluded
+    (a mask hit still stages those), as are sort/agg-only slots."""
+    root_slots: set[int] = set()
+    _query_node_slots(plan.root, root_slots)
+    other_slots: set[int] = set()
+    for slot in (plan.sort.values_slot, plan.sort.present_slot,
+                 plan.sort.values2_slot, plan.sort.present2_slot):
+        if slot >= 0:
+            other_slots.add(slot)
+    for agg in plan.aggs:
+        _agg_slots(agg, other_slots)
+    return root_slots - other_slots

@@ -25,25 +25,55 @@ import threading
 from collections import OrderedDict
 
 from ..models.doc_mapper import DocMapper, FieldMapping, FieldType
+from ..observability.metrics import (
+    PREDICATE_CACHE_EVICTED_BYTES_TOTAL, PREDICATE_CACHE_HITS_TOTAL,
+    PREDICATE_CACHE_MISSES_TOTAL,
+)
 from ..query import ast as Q
 from ..query.tokenizers import get_tokenizer
 
+# Accounted cost of one absence marker beyond its key strings: the
+# OrderedDict slot, the key tuple, and three string headers. An estimate
+# (CPython internals vary), but a stable one — the point is that the cache
+# is bounded in BYTES like its sibling tiers, not in entries, so long
+# field/term keys can't blow past an entry-count bound's implied size.
+_ENTRY_OVERHEAD_BYTES = 160
+
 
 class PredicateCache:
-    """LRU of (split_id, field, term) → proven-absent markers."""
+    """Byte-bounded LRU of (split_id, field, term) → proven-absent markers."""
 
-    def __init__(self, max_entries: int = 1 << 17):
-        self._entries: OrderedDict[tuple[str, str, str], bool] = OrderedDict()
-        self._max_entries = max_entries
+    def __init__(self, max_bytes: int = 8 << 20):
+        self._entries: OrderedDict[tuple[str, str, str], int] = OrderedDict()
+        self.max_bytes = max_bytes
+        self._size = 0
         self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evicted_bytes = 0
+
+    @staticmethod
+    def _entry_bytes(key: tuple[str, str, str]) -> int:
+        return _ENTRY_OVERHEAD_BYTES + sum(len(part) for part in key)
 
     def record_term_absent(self, split_id: str, field: str, term: str) -> None:
         key = (split_id, field, term)
+        nbytes = self._entry_bytes(key)
         with self._lock:
-            self._entries[key] = True
-            self._entries.move_to_end(key)
-            while len(self._entries) > self._max_entries:
-                self._entries.popitem(last=False)
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._size -= old
+            self._entries[key] = nbytes
+            self._size += nbytes
+            dropped = 0
+            while self._size > self.max_bytes and self._entries:
+                _, evicted = self._entries.popitem(last=False)
+                self._size -= evicted
+                dropped += evicted
+            if dropped:
+                self.evicted_bytes += dropped
+        if dropped:
+            PREDICATE_CACHE_EVICTED_BYTES_TOTAL.inc(dropped)
 
     def is_term_absent(self, split_id: str, field: str, term: str) -> bool:
         with self._lock:
@@ -54,12 +84,42 @@ class PredicateCache:
 
     def known_empty(self, split_id: str,
                     required: list[tuple[str, str]]) -> bool:
-        return any(self.is_term_absent(split_id, field, term)
-                   for field, term in required)
+        """True when any required term is proven absent. Hit/miss counters
+        live here (not in `is_term_absent`) so one consultation counts
+        once, however many required terms it scans."""
+        empty = any(self.is_term_absent(split_id, field, term)
+                    for field, term in required)
+        with self._lock:
+            if empty:
+                self.hits += 1
+            else:
+                self.misses += 1
+        if empty:
+            PREDICATE_CACHE_HITS_TOTAL.inc()
+        else:
+            PREDICATE_CACHE_MISSES_TOTAL.inc()
+        return empty
 
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)
+
+    @property
+    def size_bytes(self) -> int:
+        with self._lock:
+            return self._size
+
+    @property
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "size_bytes": self._size,
+                "max_bytes": self.max_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evicted_bytes": self.evicted_bytes,
+            }
 
 
 def term_is_tokenized_text(fm: FieldMapping) -> bool:

@@ -19,6 +19,7 @@ reference's SearcherContext (`service.rs:405`) and SearchPermitProvider.
 from __future__ import annotations
 
 import logging
+import os
 import re
 import threading
 from collections import OrderedDict
@@ -47,7 +48,10 @@ from ..tenancy.context import (
 )
 from ..tenancy.overload import OverloadShed
 from ..tenancy.registry import TenantRateLimited
-from .cache import LeafSearchCache, canonical_request_key
+from .agg_cache import PartialAggCache, agg_shape_digest
+from .cache import (LeafSearchCache, canonical_filter_digest,
+                    canonical_request_key)
+from .mask_cache import PredicateMaskCache, packed_mask_nbytes
 from .predicate_cache import PredicateCache, required_terms
 from .collector import IncrementalCollector
 from .leaf import (execute_prepared_split, leaf_search_single_split,
@@ -90,13 +94,38 @@ class SearcherContext:
                  offload_client_factory=None,
                  split_cache=None,
                  enable_threshold_pruning: bool = True,
-                 resident_columns: bool = True):
+                 resident_columns: bool = True,
+                 mask_cache_bytes: int = 32 << 20,
+                 agg_cache_bytes: int = 32 << 20,
+                 enable_mask_cache: bool = True,
+                 enable_agg_cache: bool = True,
+                 fault_injector=None):
         self.storage_resolver = storage_resolver or StorageResolver.default()
         # disk-resident split cache (reference SearchSplitCache,
         # split_cache/mod.rs:43): reader opens check it first; misses
         # report the split as a download candidate
         self.split_cache = split_cache
         self.leaf_cache = LeafSearchCache(leaf_cache_bytes)
+        # hierarchical leaf caches (docs/hierarchical-cache.md). Tier A
+        # memoizes evaluated filter bitmasks, Tier B memoizes per-split
+        # count + intermediate agg states; both key on the canonical
+        # FILTER digest so dashboard panels sharing one filter collapse.
+        # Constructor flags serve equivalence tests; the QW_DISABLE_* env
+        # kill switches serve operators (same pattern as QW_DISABLE_IMPACT).
+        # `fault_injector` threads the chaos points (cache.mask_corrupt /
+        # cache.evict) into both tiers and the residency store.
+        self.fault_injector = fault_injector
+        self.mask_cache = (
+            PredicateMaskCache(mask_cache_bytes,
+                               fault_injector=fault_injector)
+            if enable_mask_cache
+            and os.environ.get("QW_DISABLE_MASK_CACHE", "0") != "1"
+            else None)
+        self.agg_cache = (
+            PartialAggCache(agg_cache_bytes, fault_injector=fault_injector)
+            if enable_agg_cache
+            and os.environ.get("QW_DISABLE_AGG_CACHE", "0") != "1"
+            else None)
         self.batch_size = batch_size
         # warmup/compute pipelining (SURVEY hard-part #4): one prefetch
         # worker stages batch N+1's storage IO + H2D transfer while batch
@@ -127,8 +156,9 @@ class SearcherContext:
         # budget sees resident bytes through its existing owner seam. The
         # flag exists so equivalence tests can run a cold-staging baseline.
         from .residency import ResidentColumnStore
-        self.resident_store = (ResidentColumnStore()
-                               if resident_columns else None)
+        self.resident_store = (
+            ResidentColumnStore(fault_injector=fault_injector)
+            if resident_columns else None)
         # cross-query dispatch coalescing: concurrent same-structure
         # queries on one split ride a single vmapped dispatch
         # (search/batcher.py; reference analogue: per-node leaf request
@@ -396,6 +426,13 @@ class SearchService:
             cached = self.context.leaf_cache.get(key)
             if cached is not None:
                 collector.add_leaf_response(cached)
+                continue
+            agg_served = self._serve_from_agg_cache(search_request, split)
+            if agg_served is not None:
+                # Tier B full short-circuit: a count/agg-only request whose
+                # count AND every agg state are cached never opens the
+                # reader — the dashboard-fanout case collapses to a merge
+                collector.add_leaf_response(agg_served)
                 continue
             pending.append(split)
 
@@ -724,6 +761,20 @@ class SearchService:
                 if cached is not None:
                     count_ready.append((split, cached))
                     continue
+                if self.context.agg_cache is not None:
+                    # Tier B: the count entry shares the filter digest with
+                    # the full request, so a downgraded split whose count
+                    # was ever computed (any top-K, sort, or agg variant)
+                    # resolves without opening the reader
+                    cached_count = self.context.agg_cache.get_count(
+                        split.split_id,
+                        canonical_filter_digest(count_request,
+                                                split.time_range))
+                    if cached_count is not None:
+                        count_ready.append((split, LeafSearchResponse(
+                            num_hits=cached_count, num_attempted_splits=1,
+                            num_successful_splits=1)))
+                        continue
                 count_prepared.extend(self._prepare_per_split(
                     [split], doc_mapper, count_request, prune_ctx=None))
         extras = {"skipped": skipped, "count_ready": count_ready,
@@ -736,6 +787,7 @@ class SearchService:
         import json as _json
         if (len(run_group) > 1 and not search_request.search_after
                 and string_sort_of(search_request, doc_mapper) is None
+                and not self._split_caches_route_per_split(search_request)
                 and not any(key in _json.dumps(search_request.aggs or {})
                             for key in ("split_size", "shard_size",
                                         "segment_size"))):
@@ -804,20 +856,173 @@ class SearchService:
                 # admit→transfer→execute→release cycle runs alone — a whole
                 # group admitted up front could exceed the budget and
                 # starve itself
+                cache_ctx = self._consult_split_caches(search_request,
+                                                       split, reader)
                 plan = prepare_plan_only(
                     search_request, doc_mapper, reader, split.split_id,
                     absence_sink=lambda f, t, s=split.split_id:
                         cache.record_term_absent(s, f, t),
-                    sort_value_threshold=sort_value_threshold)
-                prepared.append((split, reader, plan, None))
+                    sort_value_threshold=sort_value_threshold,
+                    aggs_override=(cache_ctx or {}).get("aggs_override"),
+                    mask_override=(cache_ctx or {}).get("mask"),
+                    mask_key=(cache_ctx or {}).get("mask_key"))
+                prepared.append((split, reader, plan, None, cache_ctx))
             except (OverloadShed, TenantRateLimited):
                 # whole-query backpressure: demoting it to a per-split
                 # failure here would turn a typed 429 into a generic 400
                 # (same contract as _prepare_group/_execute_per_split)
                 raise
             except Exception as exc:  # noqa: BLE001 - partial failure
-                prepared.append((split, None, None, exc))
+                prepared.append((split, None, None, exc, None))
         return prepared
+
+    # --- hierarchical leaf caches (Tier A/B, docs/hierarchical-cache.md) --
+
+    def _serve_from_agg_cache(self, request, split):
+        """Full Tier B short-circuit: a count/agg-only request (max_hits=0,
+        no offset) whose count AND every agg state are cached builds its
+        LeafSearchResponse from partials alone — no reader open, no
+        staging, no kernel. Any missing piece returns None (the split runs
+        normally and refills)."""
+        agg_cache = self.context.agg_cache
+        if (agg_cache is None or request.max_hits != 0
+                or request.start_offset != 0):
+            return None
+        digest = canonical_filter_digest(request, split.time_range)
+        count = agg_cache.get_count(split.split_id, digest)
+        if count is None:
+            return None
+        states: dict[str, Any] = {}
+        for name, spec in (request.aggs or {}).items():
+            state = agg_cache.get_agg(split.split_id, digest,
+                                      agg_shape_digest(spec))
+            if state is None:
+                return None
+            states[name] = state
+        return LeafSearchResponse(
+            num_hits=count, num_attempted_splits=1, num_successful_splits=1,
+            intermediate_aggs=states)
+
+    def _split_caches_route_per_split(self, request) -> bool:
+        """True when the Tier A/B caches could serve or warm this request.
+        Consults and fills are per-split operations; the fused batch path
+        merges its results on-mesh, so a batched group can neither use a
+        cached mask nor attribute partials back to one split. Such groups
+        route per-split instead — cheap since the resident column store
+        keeps warm splits on device either way. Scoring sorts stay fused
+        (mask-ineligible: the default sort IS _score and the mask carries
+        no BM25 scores) except agg-only requests, where Tier B applies
+        regardless of sort. Both kill switches off restores the fused
+        routing bit-identically."""
+        sort_fields = [s.field for s in request.sort_fields] or ["_score"]
+        if self.context.mask_cache is not None and "_score" not in sort_fields:
+            return True
+        return (self.context.agg_cache is not None and bool(request.aggs)
+                and request.max_hits == 0 and request.start_offset == 0)
+
+    def _consult_split_caches(self, request, split, reader):
+        """Tier A/B lookups for one split, before lowering. Returns None
+        (both tiers off) or a cache_ctx dict driving `prepare_plan_only`
+        and the post-execute fill:
+
+        - mask / mask_key: a cached packed predicate mask replaces the
+          whole query root (zero predicate columns fetched or staged);
+          mask_fill marks a miss to backfill. Scoring requests are
+          ineligible — the mask carries no BM25 scores, and the default
+          sort IS _score.
+        - agg_hits: cached intermediate states attached post-execute;
+          aggs_override: the missed subset actually lowered ({} lowers
+          none); agg_fill: names to backfill from the response."""
+        mask_cache = self.context.mask_cache
+        agg_cache = self.context.agg_cache
+        if mask_cache is None and agg_cache is None:
+            return None
+        digest = canonical_filter_digest(request, split.time_range)
+        ctx: dict[str, Any] = {
+            "digest": digest, "mask": None, "mask_key": None,
+            "mask_fill": False, "agg_hits": {}, "aggs_override": None,
+            "agg_fill": []}
+        sort_fields = [s.field for s in request.sort_fields] or ["_score"]
+        if mask_cache is not None and "_score" not in sort_fields:
+            packed = mask_cache.get(split.split_id, digest,
+                                    packed_mask_nbytes(reader.num_docs_padded))
+            if packed is not None:
+                ctx["mask"] = packed
+                ctx["mask_key"] = f"mask.{digest}"
+            else:
+                ctx["mask_fill"] = True
+        if agg_cache is not None and request.aggs:
+            missing: dict[str, Any] = {}
+            for name, spec in request.aggs.items():
+                state = agg_cache.get_agg(split.split_id, digest,
+                                          agg_shape_digest(spec))
+                if state is not None:
+                    ctx["agg_hits"][name] = state
+                else:
+                    missing[name] = spec
+            if ctx["agg_hits"]:
+                ctx["aggs_override"] = missing
+            ctx["agg_fill"] = list(missing)
+        return ctx
+
+    def _fill_split_caches(self, request, split, plan, device_arrays,
+                           response, cache_ctx, owner=None) -> None:
+        """Post-execute backfill, while the split's device arrays are still
+        pinned. Fills are best-effort: a failure (including injected cache
+        faults) degrades to an uncached split, never fails the query."""
+        if cache_ctx is None:
+            return
+        digest = cache_ctx["digest"]
+        mask_cache = self.context.mask_cache
+        if (mask_cache is not None and cache_ctx.get("mask_fill")
+                and plan.count_override is None):
+            # count_override marks an impact-prefix-truncated plan (format
+            # v3): the kernel never saw the posting tail, so its mask is
+            # incomplete — skip the fill, never cache a partial mask
+            from .executor import compute_packed_mask
+            try:
+                host_packed, dev_packed = compute_packed_mask(
+                    plan, device_arrays)
+                mask_cache.put(split.split_id, digest, host_packed)
+                store = self.context.resident_store
+                if (store is not None and owner is not None
+                        and getattr(owner, "_device_array_cache",
+                                    None) is not None):
+                    # seed the device copy under the SAME key a mask-hit
+                    # plan will stage (`mask.<digest>`): the next warm run
+                    # finds every array resident and uploads nothing.
+                    # Accounted in the store's byte stats (columns=0: the
+                    # mask is not a column miss); the padded/8 bytes ride
+                    # outside HbmBudget admission by design — they are
+                    # noise next to any column and admission could shed a
+                    # best-effort fill
+                    owner._device_array_cache[f"mask.{digest}"] = dev_packed
+                    store.note_upload(split.split_id,
+                                      int(dev_packed.nbytes), 0)
+            except (OverloadShed, TenantRateLimited):
+                raise
+            except Exception as exc:  # noqa: BLE001 - fill is best-effort
+                logger.debug("mask-cache fill failed for %s: %s",
+                             split.split_id, exc)
+        agg_cache = self.context.agg_cache
+        if agg_cache is None:
+            return
+        try:
+            # sound under threshold pushdown and search_after: the kernel
+            # computes count/aggs from the FULL filter mask (executor.py);
+            # only the hit list is eligibility-restricted
+            agg_cache.put_count(split.split_id, digest, response.num_hits)
+            for name in cache_ctx.get("agg_fill", ()):
+                state = response.intermediate_aggs.get(name)
+                spec = (request.aggs or {}).get(name)
+                if state is not None and spec is not None:
+                    agg_cache.put_agg(split.split_id, digest,
+                                      agg_shape_digest(spec), state)
+        except (OverloadShed, TenantRateLimited):
+            raise
+        except Exception as exc:  # noqa: BLE001 - fill is best-effort
+            logger.debug("agg-cache fill failed for %s: %s",
+                         split.split_id, exc)
 
     def _execute_group(self, prepared, doc_mapper, search_request,
                        collector, prune_ctx, threshold, prune_stats) -> None:
@@ -899,7 +1104,7 @@ class SearchService:
         from .leaf import warmup_device_arrays
         deadline = current_deadline()
         profile = current_profile()
-        for split, reader, plan, prep_error in data:
+        for split, reader, plan, prep_error, cache_ctx in data:
             if deadline is not None and deadline.expired:
                 if profile is not None:
                     profile.mark_partial("shed: split execute")
@@ -945,6 +1150,14 @@ class SearchService:
                     search_request, doc_mapper, reader, split.split_id,
                     plan, device_arrays,
                     batcher=self.context.query_batcher)
+                if cache_ctx is not None and cache_ctx["agg_hits"]:
+                    # Tier B hits join the response BEFORE the leaf-cache
+                    # put and the merge — the cached LeafSearchResponse
+                    # must be complete, and the collector merges by name
+                    response.intermediate_aggs.update(cache_ctx["agg_hits"])
+                self._fill_split_caches(search_request, split, plan,
+                                        device_arrays, response, cache_ctx,
+                                        owner=owner)
                 if plan.threshold_slot < 0:
                     # a threshold-pushdown response may have its hit list
                     # truncated below k — correct for THIS query's merge,

@@ -431,11 +431,17 @@ class RestServer:
             frames = {}
             for thread_id, frame in _sys._current_frames().items():
                 frames[str(thread_id)] = traceback.format_stack(frame)[-4:]
+            ctx = node.searcher_context
             return 200, {
                 "node_id": node.config.node_id,
                 "jit_cache_entries": executor_cache_size(),
-                "leaf_cache": node.searcher_context.leaf_cache.stats,
-                "open_split_readers": len(node.searcher_context._readers),
+                "leaf_cache": ctx.leaf_cache.stats,
+                "predicate_cache": ctx.predicate_cache.stats,
+                "mask_cache": (ctx.mask_cache.stats
+                               if ctx.mask_cache is not None else None),
+                "agg_cache": (ctx.agg_cache.stats
+                              if ctx.agg_cache is not None else None),
+                "open_split_readers": len(ctx._readers),
                 "wal_shards": node.ingester.shard_throughput_state(),
                 "threads": frames,
             }

@@ -19,15 +19,23 @@ from .base import Storage
 
 
 class MemorySizedCache:
-    """Byte-size-bounded LRU: key -> bytes."""
+    """Byte-size-bounded LRU: key -> bytes.
 
-    def __init__(self, capacity_bytes: int):
+    `on_evict(nbytes)` fires (outside the lock) whenever capacity pressure
+    drops entries — the hierarchical leaf caches route it into their
+    `qw_*_cache_evicted_bytes_total` counters. `resize` re-bounds a live
+    cache (tenant-quota rebalancing, search/tenant_cache.py), evicting
+    LRU-first down to the new capacity."""
+
+    def __init__(self, capacity_bytes: int, on_evict=None):
         self.capacity_bytes = capacity_bytes
         self._entries: OrderedDict[str, bytes] = OrderedDict()
         self._size = 0
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self.evicted_bytes = 0
+        self._on_evict = on_evict
 
     def get(self, key: str) -> Optional[bytes]:
         with self._lock:
@@ -39,6 +47,20 @@ class MemorySizedCache:
             self.hits += 1
             return data
 
+    def _evict_to_capacity_locked(self) -> int:
+        dropped = 0
+        while self._size > self.capacity_bytes and self._entries:
+            _, evicted = self._entries.popitem(last=False)
+            self._size -= len(evicted)
+            dropped += len(evicted)
+        if dropped:
+            self.evicted_bytes += dropped
+        return dropped
+
+    def _notify_evicted(self, dropped: int) -> None:
+        if dropped and self._on_evict is not None:
+            self._on_evict(dropped)
+
     def put(self, key: str, data: bytes) -> None:
         if len(data) > self.capacity_bytes:
             return  # reference behavior: items larger than the cache are not cached
@@ -48,9 +70,34 @@ class MemorySizedCache:
                 self._size -= len(old)
             self._entries[key] = data
             self._size += len(data)
-            while self._size > self.capacity_bytes and self._entries:
-                _, evicted = self._entries.popitem(last=False)
-                self._size -= len(evicted)
+            dropped = self._evict_to_capacity_locked()
+        self._notify_evicted(dropped)
+
+    def delete(self, key: str) -> None:
+        """Drop one entry (not counted as capacity eviction — used by the
+        corruption chaos path, where the caller already accounts the miss)."""
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._size -= len(old)
+
+    def resize(self, capacity_bytes: int) -> None:
+        with self._lock:
+            self.capacity_bytes = capacity_bytes
+            dropped = self._evict_to_capacity_locked()
+        self._notify_evicted(dropped)
+
+    def clear(self) -> int:
+        """Forced full eviction (cache.evict chaos point); returns and
+        counts the dropped bytes."""
+        with self._lock:
+            dropped = self._size
+            self._entries.clear()
+            self._size = 0
+            if dropped:
+                self.evicted_bytes += dropped
+        self._notify_evicted(dropped)
+        return dropped
 
     @property
     def size_bytes(self) -> int:

@@ -1,0 +1,479 @@
+"""Hierarchical leaf-cache equivalence suite (docs/hierarchical-cache.md).
+
+Property: the predicate-mask cache (Tier A), the partial-aggregation cache
+(Tier B), and their tenant partitioning (Tier C) are pure caching layers —
+every response is bit-identical to a cold-execution baseline with the
+caches disabled, across repeat queries, eviction pressure, injected cache
+faults, format v1/v2 splits, threshold pushdown, count downgrades, and
+impact-ordered (v3) truncation.
+
+Plus the tentpole's perf claims, asserted via counters:
+- a warm mask hit stages ZERO predicate-column bytes
+  (`qw_predicate_column_staged_bytes_total` delta == 0);
+- a fully-cached dashboard panel (max_hits=0) launches ZERO kernels
+  (`qw_search_kernel_launches_total` delta == 0) — no reader open, no
+  staging, the response is assembled from cached partials alone.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from quickwit_tpu.common.faults import FaultInjector, FaultRule
+from quickwit_tpu.common.uri import Protocol, Uri
+from quickwit_tpu.index import SplitWriter
+from quickwit_tpu.index import format as split_format
+from quickwit_tpu.index.format import SplitFileBuilder
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.observability.metrics import (
+    AGG_CACHE_HITS_TOTAL, MASK_CACHE_EVICTED_BYTES_TOTAL,
+    MASK_CACHE_HITS_TOTAL, MASK_CACHE_MISSES_TOTAL,
+    PREDICATE_STAGED_BYTES_TOTAL, SEARCH_KERNEL_LAUNCHES_TOTAL,
+)
+from quickwit_tpu.query.parser import parse_query_string
+from quickwit_tpu.search.mask_cache import PredicateMaskCache
+from quickwit_tpu.search.models import (LeafSearchRequest, SearchRequest,
+                                        SortField, SplitIdAndFooter)
+from quickwit_tpu.search.service import SearcherContext, SearchService
+from quickwit_tpu.search.tenant_cache import TenantPartitionedCache
+from quickwit_tpu.storage import RamStorage, StorageResolver
+from quickwit_tpu.tenancy.context import TenantContext, tenant_scope
+
+MAPPER = DocMapper(
+    field_mappings=[
+        FieldMapping("body", FieldType.TEXT),
+        FieldMapping("ts", FieldType.DATETIME, fast=True,
+                     input_formats=("unix_timestamp",)),
+        FieldMapping("severity", FieldType.TEXT, tokenizer="raw", fast=True),
+        FieldMapping("latency", FieldType.F64, fast=True),
+    ],
+    timestamp_field="ts", default_search_fields=("body",))
+
+NUM_SPLITS = 3
+DOCS_PER_SPLIT = 300
+
+AGGS = {
+    "sev": {"terms": {"field": "severity"}},
+    "lat": {"stats": {"field": "latency"}},
+    "per_hour": {"date_histogram": {"field": "ts", "fixed_interval": "1h"}},
+}
+
+
+def _build_corpus(storage, packed: bool = True):
+    prev = os.environ.get("QW_DISABLE_PACKED")
+    os.environ["QW_DISABLE_PACKED"] = "0" if packed else "1"
+    try:
+        rng = np.random.RandomState(7)
+        offsets = []
+        for n in range(NUM_SPLITS):
+            writer = SplitWriter(MAPPER)
+            for i in range(DOCS_PER_SPLIT):
+                writer.add_json_doc({
+                    "body": f"log entry {i} "
+                            f"{'error' if i % 5 == 0 else 'ok'}",
+                    "ts": 1_700_000_000 + n * 3600 + i * 7,
+                    "severity": ["INFO", "WARN", "ERROR"][i % 3],
+                    "latency": float(rng.gamma(2.0, 50.0)),
+                })
+            data = writer.finish()
+            storage.put(f"s{n}.split", data)
+            offsets.append(SplitIdAndFooter(
+                split_id=f"s{n}", storage_uri=str(storage.uri),
+                file_len=len(data), num_docs=DOCS_PER_SPLIT))
+        return offsets
+    finally:
+        if prev is None:
+            os.environ.pop("QW_DISABLE_PACKED", None)
+        else:
+            os.environ["QW_DISABLE_PACKED"] = prev
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    storage = RamStorage(Uri.parse("ram:///hiercache"))
+    offsets = _build_corpus(storage)
+    resolver = StorageResolver()
+    resolver.register(Protocol.RAM, lambda uri: storage)
+    return resolver, storage, offsets
+
+
+def _make_service(resolver, **context_kw):
+    context_kw.setdefault("batch_size", 1)
+    context_kw.setdefault("prefetch", False)
+    context = SearcherContext(storage_resolver=resolver, **context_kw)
+    return SearchService(context), context
+
+
+def _cold_service(resolver, **kw):
+    """Baseline twin: every hierarchical tier off."""
+    kw.setdefault("enable_mask_cache", False)
+    kw.setdefault("enable_agg_cache", False)
+    kw.setdefault("leaf_cache_bytes", 0)
+    return _make_service(resolver, **kw)
+
+
+def _request(query="body:error", max_hits=10, **kw):
+    kw.setdefault("sort_fields", (SortField("ts", "desc"),))
+    kw.setdefault("aggs", AGGS)
+    return SearchRequest(index_ids=["hc"],
+                         query_ast=parse_query_string(query),
+                         max_hits=max_hits, **kw)
+
+
+def _run(service, offsets, request=None, threshold=None):
+    return service.leaf_search(LeafSearchRequest(
+        search_request=request or _request(), index_uid="hc:0",
+        doc_mapping=MAPPER.to_dict(), splits=list(offsets),
+        sort_value_threshold=threshold))
+
+
+def assert_same_response(a, b):
+    assert a.num_hits == b.num_hits
+    assert not a.failed_splits and not b.failed_splits
+    assert [(h.split_id, h.doc_id, h.sort_value, h.raw_sort_value)
+            for h in a.partial_hits] == \
+        [(h.split_id, h.doc_id, h.sort_value, h.raw_sort_value)
+         for h in b.partial_hits]
+    assert json.dumps(a.intermediate_aggs, sort_keys=True, default=repr) == \
+        json.dumps(b.intermediate_aggs, sort_keys=True, default=repr)
+
+
+# --- Tier A: predicate-mask cache -------------------------------------------
+
+
+def test_mask_tier_equivalence_and_zero_predicate_staging(corpus):
+    """The acceptance criterion: a warm mask hit serves every split with
+    ZERO predicate-column bytes staged — the whole filter collapses into a
+    PMaskRef over the cached bitmask — and stays bit-identical."""
+    resolver, _, offsets = corpus
+    masked, context = _make_service(resolver, enable_agg_cache=False)
+    cold, _ = _cold_service(resolver)
+    first = _run(masked, offsets)
+    assert_same_response(first, _run(cold, offsets))
+    assert context.mask_cache.stats["size_bytes"] > 0
+    # a DIFFERENT page size over the same filter: leaf-cache miss, mask hit
+    warm_request = _request(max_hits=7)
+    hits_before = MASK_CACHE_HITS_TOTAL.get()
+    pred_before = PREDICATE_STAGED_BYTES_TOTAL.get()
+    warm = _run(masked, offsets, warm_request)
+    assert MASK_CACHE_HITS_TOTAL.get() - hits_before == NUM_SPLITS
+    # not one predicate-column byte was staged on the warm run (the mask
+    # slot itself is deliberately not a predicate column)
+    assert PREDICATE_STAGED_BYTES_TOTAL.get() - pred_before == 0
+    assert_same_response(warm, _run(cold, offsets, warm_request))
+
+
+def test_mask_ineligible_for_scoring_sorts(corpus):
+    """_score sorts carry BM25 scores the mask cannot reproduce: the tier
+    must never consult or fill, and results must match the cold twin."""
+    resolver, _, offsets = corpus
+    masked, context = _make_service(resolver, enable_agg_cache=False)
+    cold, _ = _cold_service(resolver)
+    request = _request(sort_fields=(SortField("_score", "desc"),), aggs=None)
+    for _ in range(2):
+        assert_same_response(_run(masked, offsets, request),
+                             _run(cold, offsets, request))
+    stats = context.mask_cache.stats
+    assert stats["hits"] == 0 and stats["misses"] == 0
+    assert stats["size_bytes"] == 0
+
+
+def test_mask_fill_gated_on_impact_truncation(corpus):
+    """An impact-prefix-truncated plan (format v3, count_override set)
+    never saw the posting tail — its mask would be incomplete. The fill
+    gate must skip it."""
+    resolver, _, offsets = corpus
+    service, context = _make_service(resolver, enable_agg_cache=False)
+    cache_ctx = {"digest": "d" * 32, "mask_fill": True, "agg_hits": {},
+                 "agg_fill": []}
+
+    class _Plan:
+        count_override = 42  # impact-truncated marker
+
+    service._fill_split_caches(_request(), offsets[0], _Plan(), [],
+                               None, cache_ctx)
+    assert context.mask_cache.stats["size_bytes"] == 0
+
+
+def test_mask_kill_switch_and_flag(corpus, monkeypatch):
+    resolver, _, offsets = corpus
+    _, off_ctx = _make_service(resolver, enable_mask_cache=False,
+                               enable_agg_cache=False)
+    assert off_ctx.mask_cache is None
+    monkeypatch.setenv("QW_DISABLE_MASK_CACHE", "1")
+    monkeypatch.setenv("QW_DISABLE_AGG_CACHE", "1")
+    killed, killed_ctx = _make_service(resolver)
+    assert killed_ctx.mask_cache is None and killed_ctx.agg_cache is None
+    monkeypatch.delenv("QW_DISABLE_MASK_CACHE")
+    monkeypatch.delenv("QW_DISABLE_AGG_CACHE")
+    live, _ = _make_service(resolver)
+    request = _request(max_hits=4)
+    assert_same_response(_run(live, offsets, request),
+                         _run(killed, offsets, request))
+
+
+def test_mask_cache_shape_mismatch_degrades_to_miss():
+    cache = PredicateMaskCache(1 << 20)
+    cache.put("s0", "abc", np.arange(48, dtype=np.uint8))
+    assert cache.get("s0", "abc", 48) is not None
+    # wrong padded doc space (post-corruption shape drift): miss, not a
+    # wrong-shaped array fed to the kernel
+    assert cache.get("s0", "abc", 64) is None
+    assert cache.get("s0", "zzz", 48) is None
+
+
+# --- Tier B: partial-aggregation cache --------------------------------------
+
+
+def test_agg_tier_full_short_circuit_launches_zero_kernels(corpus):
+    """A dashboard count/agg panel (max_hits=0) whose filter was already
+    executed — under ANY hit page size, and under RENAMED aggs of the same
+    shape — is assembled from cached partials: zero kernel launches."""
+    resolver, _, offsets = corpus
+    service, _ = _make_service(resolver, enable_mask_cache=False)
+    cold, _ = _cold_service(resolver)
+    _run(service, offsets)  # fills count + all three agg states
+    renamed = {f"panel_{k}": dict(v) for k, v in AGGS.items()}
+    panel = _request(max_hits=0, aggs=renamed)
+    launches_before = SEARCH_KERNEL_LAUNCHES_TOTAL.get()
+    agg_hits_before = AGG_CACHE_HITS_TOTAL.get()
+    served = _run(service, offsets, panel)
+    assert SEARCH_KERNEL_LAUNCHES_TOTAL.get() - launches_before == 0
+    assert AGG_CACHE_HITS_TOTAL.get() - agg_hits_before >= NUM_SPLITS
+    assert_same_response(served, _run(cold, offsets, panel))
+
+
+def test_agg_partial_hits_merge_with_executed_misses(corpus):
+    """A panel sharing two cached agg shapes plus one NEW shape lowers only
+    the miss; cached states join the executed response before the merge."""
+    resolver, _, offsets = corpus
+    service, _ = _make_service(resolver, enable_mask_cache=False)
+    cold, _ = _cold_service(resolver)
+    _run(service, offsets)
+    mixed_aggs = {"sev": AGGS["sev"], "lat": AGGS["lat"],
+                  "per_min": {"date_histogram": {
+                      "field": "ts", "fixed_interval": "1m"}}}
+    request = _request(max_hits=5, aggs=mixed_aggs)
+    assert_same_response(_run(service, offsets, request),
+                         _run(cold, offsets, request))
+    # and the new shape is now cached too: repeat is identical
+    assert_same_response(_run(service, offsets, _request(max_hits=3,
+                                                         aggs=mixed_aggs)),
+                         _run(cold, offsets, _request(max_hits=3,
+                                                      aggs=mixed_aggs)))
+
+
+def test_count_downgrade_served_from_agg_cache(corpus):
+    """Splits downgraded to count-only (threshold pruning + exact counts)
+    reuse the cached per-split count: same digest, sort-independent."""
+    resolver, _, bare = corpus
+    # pruning needs split time bounds; the corpus's ts ranges are disjoint
+    # per split (i*7 < 3600 spacing)
+    offsets = [SplitIdAndFooter(
+        split_id=o.split_id, storage_uri=o.storage_uri,
+        file_len=o.file_len, num_docs=o.num_docs,
+        time_range=((1_700_000_000 + n * 3600) * 1_000_000,
+                    (1_700_000_000 + n * 3600
+                     + (DOCS_PER_SPLIT - 1) * 7) * 1_000_000))
+        for n, o in enumerate(bare)]
+    service, _ = _make_service(resolver, enable_mask_cache=False,
+                               enable_threshold_pruning=True)
+    cold, _ = _cold_service(resolver, enable_threshold_pruning=False)
+    request = _request(max_hits=3, aggs=None, count_hits_exact=True)
+    first = _run(service, offsets, request)
+    assert first.resource_stats.get(
+        "num_splits_downgraded_to_count", 0) >= 1
+    assert_same_response(first, _run(cold, offsets, request))
+    warm_request = _request(max_hits=2, aggs=None, count_hits_exact=True)
+    assert_same_response(_run(service, offsets, warm_request),
+                         _run(cold, offsets, warm_request))
+
+
+# --- threshold pushdown stays uncacheable -----------------------------------
+
+
+def test_threshold_pushdown_response_never_enters_leaf_cache(corpus):
+    """A pushed-down threshold truncates the hit list below k — correct
+    for the carrying query, poison for any future reader. The leaf cache
+    must refuse it; an unthresholded twin of the same request lands."""
+    resolver, _, offsets = corpus
+    service, context = _make_service(resolver, enable_mask_cache=False,
+                                     enable_agg_cache=False)
+    request = _request(max_hits=3, aggs=None)
+    before = context.leaf_cache.stats["size_bytes"]
+    _run(service, offsets[:1], request, threshold=1.7e9 + 500)
+    assert context.leaf_cache.stats["size_bytes"] == before
+    _run(service, offsets[:1], request)
+    assert context.leaf_cache.stats["size_bytes"] > before
+
+
+# --- eviction pressure and fault storms -------------------------------------
+
+
+def test_equivalence_under_eviction_pressure(corpus):
+    """Cache capacities that fit ~one entry force continuous eviction in
+    every tier; responses stay identical and evictions are observable."""
+    resolver, _, offsets = corpus
+    # one 128-byte packed mask (1024 padded docs / 8) fits, two don't
+    pressured, context = _make_service(resolver, mask_cache_bytes=160,
+                                       agg_cache_bytes=256,
+                                       leaf_cache_bytes=512)
+    cold, _ = _cold_service(resolver)
+    evicted_before = MASK_CACHE_EVICTED_BYTES_TOTAL.get()
+    for query in ("body:error", "body:ok", "severity:WARN", "body:error"):
+        for max_hits in (10, 7):
+            request = _request(query, max_hits=max_hits)
+            assert_same_response(_run(pressured, offsets, request),
+                                 _run(cold, offsets, request))
+    assert MASK_CACHE_EVICTED_BYTES_TOTAL.get() - evicted_before > 0
+    assert context.mask_cache.stats["size_bytes"] <= 160
+    assert context.agg_cache.stats["size_bytes"] <= 256
+
+
+def test_equivalence_under_cache_fault_storm(corpus):
+    """`cache.mask_corrupt` poisons every other hit, `cache.evict` storms
+    every third put: both degrade to recompute, never to wrong results."""
+    resolver, _, offsets = corpus
+    injector = FaultInjector(seed=5, rules=[
+        FaultRule(operation="cache.mask_corrupt", kind="error", every=2),
+        FaultRule(operation="cache.evict", kind="error", every=3),
+    ])
+    chaotic, context = _make_service(resolver, fault_injector=injector)
+    cold, _ = _cold_service(resolver)
+    for query in ("body:error", "severity:WARN"):
+        for max_hits in (10, 7, 4):
+            request = _request(query, max_hits=max_hits)
+            assert_same_response(_run(chaotic, offsets, request),
+                                 _run(cold, offsets, request))
+    # the storm actually fired against live traffic
+    fired = injector.schedule()
+    assert "cache.mask_corrupt" in fired or "cache.evict" in fired
+    # corruption drops entries; MASK misses grew past the cold-fill count
+    assert context.mask_cache.stats["misses"] > 0
+
+
+# --- Tier C: tenant partitioning --------------------------------------------
+
+
+def test_tenant_quotas_follow_drr_weights():
+    cache = TenantPartitionedCache(6000)
+    with tenant_scope(TenantContext.for_class("acme", "standard")):
+        cache.put("k1", b"x" * 100)
+    # single tenant: full capacity (tenancy-off degenerates to this)
+    assert cache.stats["partitions"]["acme"]["quota_bytes"] == 6000
+    with tenant_scope(TenantContext.for_class("bigco", "interactive")):
+        cache.put("k1", b"y" * 100)
+    # standard:interactive = 2:4 -> 2000 / 4000
+    parts = cache.stats["partitions"]
+    assert parts["acme"]["quota_bytes"] == 2000
+    assert parts["bigco"]["quota_bytes"] == 4000
+
+
+def test_tenant_storm_cannot_evict_other_tenants_working_set():
+    cache = TenantPartitionedCache(4000)
+    acme = TenantContext.for_class("acme", "standard")
+    bigco = TenantContext.for_class("bigco", "standard")
+    with tenant_scope(acme):
+        cache.put("hot", b"a" * 500)
+    with tenant_scope(bigco):
+        for i in range(100):  # far past bigco's 2000-byte quota
+            cache.put(f"storm{i}", b"b" * 500)
+        assert cache.stats["partitions"]["bigco"]["size_bytes"] <= 2000
+    with tenant_scope(acme):
+        assert cache.get("hot") == b"a" * 500  # untouched by the storm
+    # and keys are tenant-scoped: bigco never sees acme's entry
+    with tenant_scope(bigco):
+        assert cache.get("hot") is None
+
+
+def test_tenant_partitioned_mask_reuse_is_per_tenant(corpus):
+    """End-to-end: two tenants issuing the same filter keep separate mask
+    partitions (no cross-tenant cache reads), yet both match the cold
+    baseline."""
+    resolver, _, offsets = corpus
+    service, context = _make_service(resolver, enable_agg_cache=False,
+                                     leaf_cache_bytes=0)
+    cold, _ = _cold_service(resolver)
+    request = _request(max_hits=6)
+    with tenant_scope(TenantContext.for_class("acme", "standard")):
+        a = _run(service, offsets, request)
+    with tenant_scope(TenantContext.for_class("bigco", "interactive")):
+        b = _run(service, offsets, request)
+    assert_same_response(a, b)
+    assert_same_response(a, _run(cold, offsets, request))
+    parts = context.mask_cache.stats["partitions"]
+    assert set(parts) == {"acme", "bigco"}
+    assert parts["acme"]["size_bytes"] > 0
+    assert parts["bigco"]["size_bytes"] > 0
+
+
+# --- format v1 / v2 ---------------------------------------------------------
+
+
+def test_v1_split_equivalence_with_caches(corpus):
+    """v1 splits (raw full-width columns, no zonemaps, no impact blocks)
+    flow through every tier identically, and the v1 warm response matches
+    the packed-v2 warm response on the same corpus."""
+    resolver, _, offsets = corpus
+
+    v1_storage = RamStorage(Uri.parse("ram:///hiercache-v1"))
+    prev_add = SplitFileBuilder.add_array
+
+    def add_skipping_zonemaps(self, name, array):
+        if name.endswith((".zmin", ".zmax")):
+            return
+        prev_add(self, name, array)
+
+    prev_ver = split_format.FORMAT_VERSION
+    SplitFileBuilder.add_array = add_skipping_zonemaps
+    split_format.FORMAT_VERSION = 1
+    try:
+        v1_offsets = _build_corpus(v1_storage, packed=False)
+    finally:
+        SplitFileBuilder.add_array = prev_add
+        split_format.FORMAT_VERSION = prev_ver
+
+    v1_resolver = StorageResolver()
+    v1_resolver.register(Protocol.RAM, lambda uri: v1_storage)
+    v1_service, _ = _make_service(v1_resolver)
+    v2_service, _ = _make_service(resolver)
+    assert_same_response(_run(v1_service, v1_offsets),
+                         _run(v2_service, offsets))
+    warm_request = _request(max_hits=7)  # mask + agg hits on both
+    assert_same_response(_run(v1_service, v1_offsets, warm_request),
+                         _run(v2_service, offsets, warm_request))
+
+
+# --- routing: the default batched config ------------------------------------
+
+
+def test_default_batched_config_serves_and_fills_caches(corpus):
+    """Regression: a stock node (batch_size > 1, prefetch on) must still
+    warm and serve Tier A/B. The fused batch path merges on-mesh and can
+    neither use a cached mask nor attribute partials to one split, so
+    cache-applicable requests route per-split; scoring sorts and
+    kill-switched services keep the fused batch routing."""
+    resolver, _, offsets = corpus
+    batched, context = _make_service(resolver, batch_size=16, prefetch=True)
+    cold, _ = _cold_service(resolver, batch_size=16, prefetch=True)
+    first = _run(batched, offsets)
+    assert context.mask_cache.stats["size_bytes"] > 0, \
+        "batched config never filled the mask tier"
+    warm_request = _request(max_hits=7)
+    hits_before = MASK_CACHE_HITS_TOTAL.get()
+    pred_before = PREDICATE_STAGED_BYTES_TOTAL.get()
+    warm = _run(batched, offsets, warm_request)
+    assert MASK_CACHE_HITS_TOTAL.get() - hits_before == NUM_SPLITS
+    assert PREDICATE_STAGED_BYTES_TOTAL.get() - pred_before == 0
+    assert_same_response(first, _run(cold, offsets))
+    assert_same_response(warm, _run(cold, offsets, warm_request))
+    # scoring sorts are mask-ineligible: they stay on the fused batch path
+    assert not batched._split_caches_route_per_split(
+        _request(sort_fields=(), aggs=None))
+    # ...but agg-only requests reroute regardless of sort (Tier B applies)
+    assert batched._split_caches_route_per_split(
+        _request(sort_fields=(), max_hits=0))
+    killed, _ = _cold_service(resolver, batch_size=16)
+    assert not killed._split_caches_route_per_split(_request())

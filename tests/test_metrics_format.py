@@ -291,6 +291,85 @@ def test_impact_metrics_expose_with_strict_grammar():
         before["qw_impact_prefix_cutoffs_total"] == 1
 
 
+def test_hierarchical_cache_metrics_expose_with_strict_grammar():
+    """Drive every hierarchical-cache tier (leaf response, term-absence
+    predicate cache, predicate-mask, partial-agg) through a real hit, miss,
+    and capacity eviction, then assert all twelve qw_*_cache_* counters
+    plus the staging-attribution trio announce HELP/TYPE and their deltas
+    match what the caches actually did. Counters are process-global, so
+    assert on before/after deltas."""
+    import numpy as np
+
+    from quickwit_tpu.search.agg_cache import PartialAggCache
+    from quickwit_tpu.search.cache import LeafSearchCache
+    from quickwit_tpu.search.mask_cache import PredicateMaskCache
+    from quickwit_tpu.search.models import LeafSearchResponse
+    from quickwit_tpu.search.predicate_cache import PredicateCache
+
+    names = tuple(
+        f"qw_{tier}_cache_{event}_total"
+        for tier in ("leaf", "predicate", "mask", "agg")
+        for event in ("hits", "misses", "evicted_bytes")
+    ) + ("qw_staging_bytes_total",
+         "qw_predicate_column_staged_bytes_total",
+         "qw_search_kernel_launches_total")
+
+    def snapshot():
+        parsed = parse_exposition(METRICS.expose_text())
+        return {name: sum(parsed.get(name, {}).values()) for name in names}
+
+    before = snapshot()
+
+    leaf = LeafSearchCache(capacity_bytes=1024)
+    leaf.put("k1", LeafSearchResponse(num_hits=7))
+    assert leaf.get("k1") is not None        # hit
+    assert leaf.get("k-absent") is None      # miss
+    for i in range(64):                      # force capacity evictions
+        leaf.put(f"spill{i}", LeafSearchResponse(num_hits=i))
+
+    pred = PredicateCache(max_bytes=400)
+    pred.record_term_absent("s0", "body", "ghost")
+    assert pred.known_empty("s0", [("body", "ghost")])         # hit
+    assert not pred.known_empty("s0", [("body", "present")])   # miss
+    for i in range(8):                       # byte-bound evictions
+        pred.record_term_absent("s0", "body", f"spill-term-{i}")
+
+    mask = PredicateMaskCache(capacity_bytes=200)
+    mask.put("s0", "d1", np.arange(128, dtype=np.uint8))
+    assert mask.get("s0", "d1", 128) is not None   # hit
+    assert mask.get("s0", "d2", 128) is None       # miss
+    mask.put("s0", "d3", np.arange(128, dtype=np.uint8))  # evicts d1
+
+    agg = PartialAggCache(capacity_bytes=256)
+    agg.put_count("s0", "d1", 42)
+    assert agg.get_count("s0", "d1") == 42         # hit
+    assert agg.get_count("s0", "d2") is None       # miss
+    agg.put_agg("s0", "d1", "shape", {"sum": 1.0, "pad": "x" * 200})
+    agg.put_agg("s0", "d2", "shape", {"sum": 2.0, "pad": "y" * 200})
+
+    # staging attribution: one warmup staging 4 KiB, 1 KiB of it
+    # predicate-only, then one kernel dispatch (leaf.py / executor.py)
+    from quickwit_tpu.observability.metrics import (
+        PREDICATE_STAGED_BYTES_TOTAL, SEARCH_KERNEL_LAUNCHES_TOTAL,
+        STAGING_BYTES_TOTAL,
+    )
+    STAGING_BYTES_TOTAL.inc(4096)
+    PREDICATE_STAGED_BYTES_TOTAL.inc(1024)
+    SEARCH_KERNEL_LAUNCHES_TOTAL.inc()
+
+    text = METRICS.expose_text()
+    parsed = parse_exposition(text)
+    after = snapshot()
+    for name in names:
+        assert name in parsed, f"{name} missing from exposition"
+        assert f"# HELP {name} " in text
+        assert f"# TYPE {name} counter" in text
+    for tier in ("leaf", "predicate", "mask", "agg"):
+        for event in ("hits", "misses", "evicted_bytes"):
+            name = f"qw_{tier}_cache_{event}_total"
+            assert after[name] - before[name] > 0, name
+
+
 def test_full_registry_exposition_parses():
     """The real global registry — after driving a few metrics through the
     awkward cases (labels, floats, multiple label sets) — must emit text

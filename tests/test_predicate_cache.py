@@ -65,14 +65,18 @@ def test_required_terms_skips_unknown_and_ranges():
 
 
 def test_predicate_cache_lru_and_lookup():
-    cache = PredicateCache(max_entries=2)
+    # room for exactly two of these markers (169 accounted bytes each)
+    cache = PredicateCache(max_bytes=340)
     cache.record_term_absent("s1", "body", "foo")
     cache.record_term_absent("s1", "body", "bar")
     assert cache.is_term_absent("s1", "body", "foo")
     cache.record_term_absent("s2", "body", "baz")  # evicts oldest (bar)
     assert not cache.is_term_absent("s1", "body", "bar")
+    assert cache.evicted_bytes > 0
+    assert cache.size_bytes <= 340
     assert cache.known_empty("s1", [("body", "foo"), ("body", "nope")])
     assert not cache.known_empty("s3", [("body", "foo")])
+    assert cache.stats["hits"] == 1 and cache.stats["misses"] == 1
 
 
 # --- end-to-end pruning --------------------------------------------------

@@ -18,6 +18,11 @@ _HOT_PATH_MODULES = (
     "quickwit_tpu/search/leaf.py",
     "quickwit_tpu/search/collector.py",
     "quickwit_tpu/search/plan.py",
+    # hierarchical cache tiers sit on the per-split hot path: a mask/agg
+    # consult or fill must never smuggle in a device readback of its own
+    "quickwit_tpu/search/mask_cache.py",
+    "quickwit_tpu/search/agg_cache.py",
+    "quickwit_tpu/search/tenant_cache.py",
     # write-time impact quantization: numpy-only by contract (its scores
     # must mirror ops/bm25.py bit-for-bit, and merge re-runs it per field)
     "quickwit_tpu/index/impact.py",
