@@ -29,12 +29,11 @@ from __future__ import annotations
 
 import json
 import logging
-import random
 import socket
 import threading
-import time
 from typing import Optional
 
+from ..common.clock import get_clock, get_rng
 from .membership import Cluster, ClusterMember
 
 logger = logging.getLogger(__name__)
@@ -67,7 +66,7 @@ class GossipService:
         # (generation, version) so a restart (new generation, version 1)
         # supersedes any pre-crash version
         self._state: dict[str, dict] = {
-            node_id: {"generation": time.time_ns(), "version": 1,
+            node_id: {"generation": get_clock().time_ns(), "version": 1,
                       "data": {"roles": list(roles),
                                "rest_endpoint": rest_endpoint,
                                "grpc_endpoint": grpc_endpoint,
@@ -192,15 +191,18 @@ class GossipService:
             logger.debug("gossip send to %s failed: %s", addr, exc)
 
     def _gossip_loop(self) -> None:
-        while not self._stop.wait(self.interval_secs):
+        # interval waits route through the process clock so an accelerated
+        # clock compresses rounds; fanout sampling uses the process rng so
+        # a seeded run picks the same targets
+        while not get_clock().wait(self._stop, self.interval_secs):
             with self._lock:
                 self._state[self.node_id]["version"] += 1
             targets = self._gossip_addresses()
             if not targets:
                 continue
             digest = self._digest()
-            for addr in random.sample(targets,
-                                      min(self.fanout, len(targets))):
+            for addr in get_rng().sample(targets,
+                                         min(self.fanout, len(targets))):
                 self._send({"kind": "syn", "digest": digest}, addr)
 
     def _listen_loop(self) -> None:

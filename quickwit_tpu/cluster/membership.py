@@ -21,11 +21,11 @@ fallback before enough samples accumulate).
 from __future__ import annotations
 
 import threading
-import time
 from dataclasses import dataclass, field
 from enum import Enum
 from typing import Callable, Optional
 
+from ..common.clock import monotonic
 from ..common.pubsub import EventBroker
 
 ALL_ROLES = ("searcher", "indexer", "metastore", "control_plane", "janitor",
@@ -55,7 +55,7 @@ class ClusterMember:
     grpc_endpoint: str = ""          # "host:port" gRPC plane ("" = REST only)
     generation: int = 0
     is_ready: bool = True
-    last_heartbeat: float = field(default_factory=time.monotonic)
+    last_heartbeat: float = field(default_factory=monotonic)
     # sliding window of heartbeat inter-arrival intervals (phi-accrual)
     intervals: list = field(default_factory=list)
 
@@ -103,7 +103,7 @@ class Cluster:
         with self._lock:
             member = self._members.get(node_id)
             if member is not None:
-                now = time.monotonic()
+                now = monotonic()
                 interval = now - member.last_heartbeat
                 if 0 < interval < self.dead_after_secs * 4:
                     member.intervals.append(interval)
@@ -118,7 +118,7 @@ class Cluster:
         import math
         if len(member.intervals) < self.MIN_SAMPLES:
             return 0.0
-        now = time.monotonic() if now is None else now
+        now = monotonic() if now is None else now
         mean = sum(member.intervals) / len(member.intervals)
         age = now - member.last_heartbeat
         return age / max(mean, 1e-6) * math.log10(math.e)
@@ -132,7 +132,7 @@ class Cluster:
         bound regardless of cadence."""
         if member.node_id == self.self_node_id:
             return True
-        now = time.monotonic() if now is None else now
+        now = monotonic() if now is None else now
         age = now - member.last_heartbeat
         if age > self.dead_after_secs:
             return False  # hard bound
@@ -154,7 +154,7 @@ class Cluster:
 
     # --- queries -----------------------------------------------------------
     def members(self, alive_only: bool = True) -> list[ClusterMember]:
-        now = time.monotonic()
+        now = monotonic()
         with self._lock:
             out = []
             for member in self._members.values():

@@ -29,9 +29,10 @@ import itertools
 import logging
 import queue
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
+
+from .clock import get_clock
 
 logger = logging.getLogger(__name__)
 
@@ -99,7 +100,8 @@ class Mailbox:
         condition, so a send's notify cannot slip between a failed check
         and the wait (no lost wakeups, no polling — idle actors sleep the
         full timeout)."""
-        deadline = time.monotonic() + timeout if timeout is not None else None
+        deadline = (get_clock().monotonic() + timeout
+                    if timeout is not None else None)
         with self._not_empty:
             while True:
                 try:
@@ -113,7 +115,7 @@ class Mailbox:
                 if self._closed.is_set():
                     raise MailboxClosed(self.name)
                 remaining = (None if deadline is None
-                             else deadline - time.monotonic())
+                             else deadline - get_clock().monotonic())
                 if remaining is not None and remaining <= 0:
                     raise queue.Empty
                 self._not_empty.wait(remaining)
@@ -192,7 +194,7 @@ class Universe:
         if self.accelerated:
             with self._idle:
                 return self._virtual_now
-        return time.monotonic()
+        return get_clock().monotonic()
 
     def schedule(self, delay: float, callback: Callable[[], None]) -> None:
         """Run `callback` after `delay` (virtual seconds when
@@ -204,7 +206,8 @@ class Universe:
             self._idle.notify_all()
 
     def now_locked(self) -> float:
-        return self._virtual_now if self.accelerated else time.monotonic()
+        return (self._virtual_now if self.accelerated
+                else get_clock().monotonic())
 
     def schedule_periodic(self, interval: float,
                           callback: Callable[[], None]) -> None:
@@ -312,7 +315,7 @@ class Universe:
                     # accelerated mode: messages queued behind the crash
                     # keep the system non-idle, so a virtual-clock backoff
                     # would deadlock — restart (near-)immediately instead
-                    time.sleep(0.001 if self.accelerated else backoff)
+                    get_clock().sleep(0.001 if self.accelerated else backoff)
                     backoff = min(backoff * 2, 5.0)
             handle._exited.set()
 

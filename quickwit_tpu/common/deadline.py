@@ -21,9 +21,10 @@ from __future__ import annotations
 import contextvars
 import math
 import threading
-import time
 from contextlib import contextmanager
 from typing import Callable, Optional
+
+from .clock import get_clock
 
 # Canonical marker for "ran out of time" errors. Split/storage error strings
 # embed it so the root can tell deadline failures (-> timed_out partial
@@ -54,7 +55,7 @@ class Deadline:
 
     @classmethod
     def after(cls, timeout_secs: float) -> "Deadline":
-        return cls(time.monotonic() + max(timeout_secs, 0.0))
+        return cls(get_clock().monotonic() + max(timeout_secs, 0.0))
 
     @classmethod
     def never(cls) -> "Deadline":
@@ -77,11 +78,11 @@ class Deadline:
         """Seconds left; `inf` when unbounded, clamped at 0 after expiry."""
         if not self.bounded:
             return math.inf
-        return max(self._expires_at - time.monotonic(), 0.0)
+        return max(self._expires_at - get_clock().monotonic(), 0.0)
 
     @property
     def expired(self) -> bool:
-        return self.bounded and time.monotonic() >= self._expires_at
+        return self.bounded and get_clock().monotonic() >= self._expires_at
 
     def check(self, operation: str = "") -> None:
         if self.expired:
@@ -170,7 +171,7 @@ class QueryBudget:
         retry should be abandoned)."""
         delay = self.backoff_secs(retry_index)
         if delay > 0.0:
-            time.sleep(delay)
+            get_clock().sleep(delay)
         return not self.deadline.expired
 
 

@@ -20,11 +20,11 @@ from __future__ import annotations
 import hashlib
 import random
 import threading
-import time
-from dataclasses import dataclass, field
+from dataclasses import asdict, dataclass, field
 from typing import Optional
 
 from ..storage.base import Storage, StorageError
+from .clock import get_clock
 
 FAULT_ERROR_MARK = "injected fault"
 
@@ -126,12 +126,15 @@ class FaultInjector:
             FAULTS_INJECTED_TOTAL.inc(op=operation, kind=rule.kind)
         error: Optional[InjectedFault] = None
         for rule_index, rule in firing:
+            # sleeps route through the process clock so the DST harness's
+            # virtual clock absorbs them instantly (simulated latency, no
+            # wall time) while production/chaos runs really stall
             if rule.kind == "latency":
-                time.sleep(rule.latency_secs)
+                get_clock().sleep(rule.latency_secs)
             elif rule.kind == "hang":
                 # A bounded stall: long enough that only deadline-aware
                 # callers survive it, short enough that test runs terminate.
-                time.sleep(rule.hang_secs)
+                get_clock().sleep(rule.hang_secs)
             elif error is None:
                 error = InjectedFault(
                     f"{rule.error_message} (op={operation}, n={occurrence})")
@@ -141,6 +144,41 @@ class FaultInjector:
     def occurrences(self, operation: str) -> int:
         with self._lock:
             return self._occurrences.get(operation, 0)
+
+    def to_plan(self) -> dict:
+        """Serialize the full injector state — seed, rule set, per-operation
+        occurrence cursors, per-rule fire counts — as a JSON-safe dict (the
+        `faults` section of a DST replay artifact). `from_plan` restores an
+        injector that continues the decision stream exactly where this one
+        stands: decisions are pure functions of `(seed, rule, op, occurrence)`,
+        so state is nothing but the cursors."""
+        with self._lock:
+            return {
+                "seed": self.seed,
+                "rules": [asdict(rule) for rule in self.rules],
+                "occurrences": dict(sorted(self._occurrences.items())),
+                "fires_per_rule": list(self._fires_per_rule),
+            }
+
+    @classmethod
+    def from_plan(cls, plan: dict) -> "FaultInjector":
+        """Rebuild an injector from `to_plan()` output. A fresh plan (cursors
+        all zero) reproduces the original run's schedule from the start; a
+        mid-run plan resumes it."""
+        injector = cls(
+            seed=int(plan["seed"]),
+            rules=[FaultRule(**rule) for rule in plan.get("rules", [])])
+        with injector._lock:
+            injector._occurrences = {
+                str(op): int(count)
+                for op, count in plan.get("occurrences", {}).items()}
+            fires = plan.get("fires_per_rule")
+            if fires is not None:
+                if len(fires) != len(injector.rules):
+                    raise ValueError(
+                        "fires_per_rule length does not match rule count")
+                injector._fires_per_rule = [int(n) for n in fires]
+        return injector
 
     def schedule(self) -> dict[str, list[tuple[int, int, str]]]:
         """Fired decisions keyed by operation, ordered by occurrence:

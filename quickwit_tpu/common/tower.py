@@ -8,8 +8,9 @@ client): protect services from overload and stop hammering dead peers.
 from __future__ import annotations
 
 import threading
-import time
 from typing import Callable, TypeVar
+
+from .clock import monotonic
 
 T = TypeVar("T")
 
@@ -23,7 +24,7 @@ class TokenBucket:
     `rate_limit.rs`): capacity `burst`, refilled at `rate_per_sec`."""
 
     def __init__(self, rate_per_sec: float, burst: float,
-                 clock=time.monotonic):
+                 clock=monotonic):
         self.rate = float(rate_per_sec)
         self.burst = float(burst)
         self.clock = clock
@@ -94,20 +95,20 @@ class CircuitBreaker:
         with self._lock:
             if self._opened_at is None:
                 return "closed"
-            if time.monotonic() - self._opened_at >= self.cooldown_secs:
+            if monotonic() - self._opened_at >= self.cooldown_secs:
                 return "half-open"
             return "open"
 
     def call(self, fn: Callable[[], T]) -> T:
         with self._lock:
             if self._opened_at is not None:
-                if time.monotonic() - self._opened_at < self.cooldown_secs:
+                if monotonic() - self._opened_at < self.cooldown_secs:
                     raise CircuitOpen(
                         f"circuit open ({self._consecutive_failures} consecutive failures)")
                 # half-open: admit a SINGLE probe — re-arm the cooldown so
                 # concurrent callers keep failing fast instead of piling
                 # timeouts onto a possibly-dead peer
-                self._opened_at = time.monotonic()
+                self._opened_at = monotonic()
         try:
             result = fn()
         except Exception as exc:
@@ -115,7 +116,7 @@ class CircuitBreaker:
                 with self._lock:
                     self._consecutive_failures += 1
                     if self._consecutive_failures >= self.failure_threshold:
-                        self._opened_at = time.monotonic()
+                        self._opened_at = monotonic()
             raise
         with self._lock:
             self._consecutive_failures = 0

@@ -17,11 +17,11 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 import uuid
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from ..common.clock import monotonic
 from ..indexing.merge import merge_policy_from_config
 from ..metastore.base import ListSplitsQuery, Metastore
 from ..models.split_metadata import Split, SplitState
@@ -53,7 +53,7 @@ class CompactionPlanner:
 
     def __init__(self, metastore: Metastore,
                  task_timeout_secs: float = 600.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = monotonic):
         self.metastore = metastore
         self.task_timeout_secs = task_timeout_secs
         self.clock = clock

@@ -24,10 +24,10 @@ Semantics preserved from the reference:
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..common.clock import monotonic
 from ..common.tower import TokenBucket
 
 
@@ -91,7 +91,7 @@ class ScalingPermits:
     """Per-source decision rate limiting (reference:
     `shard_table.rs:33` SCALING_{UP,DOWN}_RATE_LIMITER_SETTINGS)."""
 
-    def __init__(self, clock=time.monotonic):
+    def __init__(self, clock=monotonic):
         self._clock = clock
         self._per_source: dict[str, _SourcePermits] = {}
 
@@ -158,7 +158,7 @@ class ShardRateTracker:
     and owns the smoothing."""
 
     def __init__(self, short_tau_secs: float = 5.0,
-                 long_tau_secs: float = 60.0, clock=time.monotonic):
+                 long_tau_secs: float = 60.0, clock=monotonic):
         self.short_tau = short_tau_secs
         self.long_tau = long_tau_secs
         self.clock = clock

@@ -1,0 +1,66 @@
+"""Replay artifacts: self-contained JSON repro files.
+
+An artifact carries everything a fresh process needs to re-execute a
+violating run byte-identically — the (shrunk) scenario, the seed, the
+explicit op list, the fault plan with zeroed cursors, the break-flag
+switches that were active, the violation, and the reference trace +
+digest `dst replay` compares against. Nothing in it references local
+filesystem state; `python -m quickwit_tpu.dst replay <file>` on any
+machine reproduces the run from the file alone.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from ..common.faults import FaultInjector
+from .invariants import Violation
+from .scenario import Scenario
+from .trace import Trace, canonical_json
+
+ARTIFACT_VERSION = 1
+ARTIFACT_KIND = "quickwit-dst-replay"
+
+
+def make_artifact(scenario: Scenario, seed: int, ops: list[dict[str, Any]],
+                  violation: Violation, trace: Trace,
+                  break_publish: bool = False,
+                  break_wal: bool = False) -> dict[str, Any]:
+    # a FRESH injector's plan (cursors at zero): replay must start the
+    # fault decision streams from the beginning, not where the run ended
+    fault_plan = FaultInjector(seed, list(scenario.fault_rules)).to_plan()
+    return {
+        "version": ARTIFACT_VERSION,
+        "kind": ARTIFACT_KIND,
+        "scenario": scenario.to_dict(),
+        "seed": int(seed),
+        "ops": list(ops),
+        "fault_plan": fault_plan,
+        "break_flags": {"publish": bool(break_publish),
+                        "wal": bool(break_wal)},
+        "violation": violation.to_dict(),
+        "trace_digest": trace.digest(),
+        "trace": list(trace.events),
+    }
+
+
+def save_artifact(artifact: dict[str, Any], path: str) -> None:
+    if artifact.get("kind") != ARTIFACT_KIND:
+        raise ValueError("not a DST replay artifact")
+    with open(path, "w", encoding="utf-8") as f:
+        # canonical form on disk too: diffing two artifacts is meaningful
+        f.write(canonical_json(artifact))
+        f.write("\n")
+
+
+def load_artifact(path: str) -> dict[str, Any]:
+    with open(path, encoding="utf-8") as f:
+        artifact = json.load(f)
+    if artifact.get("kind") != ARTIFACT_KIND:
+        raise ValueError(f"{path}: not a DST replay artifact")
+    if int(artifact.get("version", -1)) > ARTIFACT_VERSION:
+        raise ValueError(
+            f"{path}: artifact version {artifact['version']} is newer than "
+            f"this harness ({ARTIFACT_VERSION})")
+    return artifact

@@ -1,0 +1,507 @@
+"""SimCluster: a full in-process cluster assembled from the real components.
+
+Every moving part is the production implementation — `Ingester` WAL shards
+with chained replication, `IngestRouter`, `IndexingPipeline` drains,
+`FileBackedMetastore` instances polling one shared object store,
+`MergeExecutor`, `RootSearcher` fan-out over `SearchService` leaves,
+`IndexingScheduler` planning, the offload `WorkerPool` + `Autoscaler` —
+only the seams are simulated: the network (`SimNetwork`), time (the
+process `FakeClock` the harness installs), randomness (the seeded process
+rng), and faults (the run's `FaultInjector`).
+
+Node liveness is modeled, not threaded: a killed node keeps its WAL
+directory (the machine's disk) but is partitioned and excluded from every
+role; orphaned replica shards on survivors are promoted, and a restart
+re-runs the real `Ingester` recovery over the old WAL plus a fresh
+metastore cache — exactly the failover path the zero-loss invariant is
+about.
+
+The deliberate-bug switches (`break_publish`, `break_wal` — the
+`QW_DST_BREAK_{PUBLISH,WAL}` env flags) inject the two classes of bug the
+harness self-test must catch: checkpoint-less drains (duplicate publish)
+and a replication link that silently truncates batches (loss after
+failover).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import shutil
+import tempfile
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..common.faults import FaultInjector, FaultyMetastore, FaultyStorageResolver
+from ..control_plane.scheduler import IndexingScheduler, IndexingTask
+from ..index import SplitReader
+from ..indexing import IndexingPipeline, PipelineParams, VecSource
+from ..indexing.merge import MergeExecutor, StableLogMergePolicy
+from ..indexing.pipeline import split_file_path
+from ..indexing.sources import IngestSource
+from ..ingest import Ingester, IngestRouter
+from ..ingest.ingester import ReplicationGap
+from ..ingest.router import INGEST_V2_SOURCE_ID
+from ..metastore import FileBackedMetastore, ListSplitsQuery
+from ..metastore.base import MetastoreError
+from ..metastore.checkpoint import BEGINNING, IncompatibleCheckpointDelta
+from ..models import DocMapper, FieldMapping, FieldType
+from ..models.index_metadata import IndexConfig, IndexMetadata, SourceConfig
+from ..models.split_metadata import SplitState
+from ..offload.autoscaler import Autoscaler, WorkerLauncher
+from ..offload.pool import WorkerPool
+from ..query.ast import MatchAll
+from ..search import SearchRequest, leaf_search_single_split
+from ..search.root import RootSearcher
+from ..search.service import LocalSearchClient, SearcherContext, SearchService
+from ..storage import StorageResolver
+from ..tenancy.overload import OverloadController
+from .network import SimNetwork, SimSearchClient
+from .scenario import Scenario
+
+SIM_MAPPER = DocMapper(
+    field_mappings=[
+        FieldMapping("ts", FieldType.DATETIME, fast=True,
+                     input_formats=("unix_timestamp",)),
+        FieldMapping("n", FieldType.U64, fast=True),
+        FieldMapping("body", FieldType.TEXT),
+    ],
+    timestamp_field="ts",
+    default_search_fields=("body",),
+)
+
+METASTORE_POLL_SECS = 5.0  # < Scenario.step_secs: publishes surface next step
+SPLIT_NUM_DOCS_TARGET = 50
+
+# per-process namespace counter: the ram:// tree and WAL tempdir are unique
+# per run; neither may ever appear in the trace
+_NS_COUNTER = itertools.count()
+
+
+class _StubWorkerLauncher(WorkerLauncher):
+    """Autoscaler substrate for the sim: launch/terminate bookkeeping only
+    (the pool-size invariant is about the controller, not the workers)."""
+
+    def launch(self, worker_id: str):
+        return object()
+
+    def terminate(self, worker_id: str) -> None:
+        pass
+
+
+@dataclass
+class SimNode:
+    node_id: str
+    wal_dir: str
+    alive: bool = True
+    ingester: Any = None
+    router: Any = None
+    metastore: Any = None
+    service: Any = None
+    client: Any = None
+    extras: dict = field(default_factory=dict)
+
+
+class SimCluster:
+    def __init__(self, scenario: Scenario, injector: FaultInjector,
+                 network: SimNetwork, clock,
+                 break_publish: bool = False, break_wal: bool = False):
+        self.scenario = scenario
+        self.injector = injector
+        self.network = network
+        self.clock = clock
+        self.break_publish = break_publish
+        self.break_wal = break_wal
+        self._ns = next(_NS_COUNTER)
+        self._drain_seq = itertools.count()
+        self.resolver = StorageResolver.for_test()
+        self.faulty_resolver = FaultyStorageResolver(self.resolver, injector)
+        self.meta_storage = self.resolver.resolve(
+            f"ram:///dst{self._ns}/meta")
+        self.base_dir = tempfile.mkdtemp(prefix="qw-dst-")
+        # acked ledger: doc `n`s whose ingest the cluster ACKNOWLEDGED
+        # (persist + replication chain succeeded) — the zero-loss floor
+        self.acked: dict[str, list[int]] = {i: [] for i in scenario.indexes}
+
+        bootstrap = FileBackedMetastore(self.meta_storage,
+                                        polling_interval_secs=None)
+        for index_id in scenario.indexes:
+            bootstrap.create_index(IndexMetadata(
+                index_uid=self._uid(index_id),
+                index_config=IndexConfig(
+                    index_id=index_id,
+                    index_uri=self._index_uri(index_id),
+                    doc_mapper=SIM_MAPPER,
+                    split_num_docs_target=SPLIT_NUM_DOCS_TARGET),
+                sources={INGEST_V2_SOURCE_ID: SourceConfig(
+                    INGEST_V2_SOURCE_ID, "ingest")}))
+
+        self.nodes: dict[str, SimNode] = {}
+        for i in range(scenario.nodes):
+            node_id = f"sim-{i}"
+            self.nodes[node_id] = self._build_node(node_id)
+
+        self.merge_policy = StableLogMergePolicy(
+            merge_factor=2, max_merge_factor=4, min_level_num_docs=20)
+        self.cp_scheduler = IndexingScheduler()
+        self.worker_pool = WorkerPool()
+        self.autoscaler = Autoscaler(
+            self.worker_pool, _StubWorkerLauncher(),
+            min_workers=1, max_workers=4, queue_per_worker=8,
+            overload=OverloadController())
+
+    # --- identifiers -------------------------------------------------------
+    def _uid(self, index_id: str) -> str:
+        return f"{index_id}:01"
+
+    def _index_uri(self, index_id: str) -> str:
+        return f"ram:///dst{self._ns}/{index_id}"
+
+    # --- node lifecycle ----------------------------------------------------
+    def _build_node(self, node_id: str) -> SimNode:
+        wal_dir = os.path.join(self.base_dir, node_id)
+        node = SimNode(node_id=node_id, wal_dir=wal_dir)
+        replicate = (self._make_replicate(node_id)
+                     if self.scenario.replication and self.scenario.nodes > 1
+                     else None)
+        node.ingester = Ingester(wal_dir, fsync=False,
+                                 replicate_to=replicate,
+                                 fault_injector=self.injector)
+        node.ingester.on_truncate = self._make_on_truncate(node_id)
+        node.router = IngestRouter(node.ingester, shards_per_source=1,
+                                   shard_prefix=node_id)
+        node.metastore = FileBackedMetastore(
+            self.meta_storage, polling_interval_secs=METASTORE_POLL_SECS)
+        node.service = SearchService(
+            SearcherContext(self.faulty_resolver, prefetch=False),
+            node_id=node_id)
+        node.client = LocalSearchClient(node.service)
+        return node
+
+    def _make_replicate(self, leader_id: str):
+        def replicate(index_uid: str, source_id: str, shard_id: str,
+                      first: int, payloads: list[bytes]) -> None:
+            follower_id = self._follower_for(leader_id)
+            if follower_id is None:
+                # the replication chain cannot be completed: NACK rather
+                # than ack a leader-only write a later kill would lose —
+                # reference semantics: persist fails when no follower is
+                # available, clients retry against a healthy chain
+                raise ConnectionError("simnet: no replica available")
+            if self.network.is_partitioned(follower_id):
+                raise ConnectionError(
+                    f"simnet: replica {follower_id} unreachable")
+            if self.break_wal:
+                # QW_DST_BREAK_WAL: the link silently truncates each batch
+                # — the acked tail exists only on the leader, so a leader
+                # kill + replica promotion loses it (zero-loss violation)
+                payloads = payloads[:-1]
+            follower = self.nodes[follower_id].ingester
+            try:
+                follower.replica_persist(index_uid, source_id, shard_id,
+                                         first, payloads)
+            except ReplicationGap as gap:
+                if self.break_wal:
+                    return  # the buggy link also swallows gap reports
+                leader_shard = self.nodes[leader_id].ingester.shard(
+                    index_uid, source_id, shard_id)
+                records = leader_shard.log.read_from(gap.have, 1_000_000)
+                follower.replica_persist(index_uid, source_id, shard_id,
+                                         gap.have,
+                                         [payload for _, payload in records])
+        return replicate
+
+    def _make_on_truncate(self, leader_id: str):
+        def on_truncate(index_uid: str, source_id: str, shard_id: str,
+                        position: int) -> None:
+            for node_id in self.alive_nodes():
+                if node_id != leader_id:
+                    self.nodes[node_id].ingester.replica_truncate(
+                        index_uid, source_id, shard_id, position)
+        return on_truncate
+
+    def _follower_for(self, leader_id: str) -> Optional[str]:
+        for node_id in self.alive_nodes():
+            if node_id != leader_id:
+                return node_id
+        return None
+
+    def alive_nodes(self) -> list[str]:
+        return sorted(n for n, node in self.nodes.items() if node.alive)
+
+    def kill(self, node_id: str) -> dict[str, Any]:
+        """Crash the node: partitioned and excluded from every role, but its
+        WAL directory (the machine's disk) survives — a later restart runs
+        real recovery over it. Kills are crashes, not machine loss, so the
+        zero-loss ledger invariant is checkable under any kill sequence."""
+        node = self.nodes[node_id]
+        if not node.alive:
+            return {"skipped": "already-dead"}
+        node.alive = False
+        self.network.partition(node_id)
+        if self.break_wal:
+            self._drop_unfsynced_tail(node)
+        return {"killed": node_id,
+                "promoted": self.promote_orphans()}
+
+    def _drop_unfsynced_tail(self, node: SimNode) -> None:
+        """QW_DST_BREAK_WAL, crash half: the last acked record of each
+        leader shard was never durably fsynced, so the crash loses it —
+        rewrite the on-disk WAL without its tail record (positions
+        preserved). Combined with the truncating replication link, the
+        acked tail then exists on no surviving copy."""
+        for shard in node.ingester.list_shards(include_replicas=False):
+            records = shard.log.read_from(0, 1_000_000)
+            if not records:
+                continue
+            first = records[0][0]
+            shard.log.reset_to(first)
+            if len(records) > 1:
+                shard.log.append_batch(
+                    [payload for _, payload in records[:-1]])
+
+    def restart(self, node_id: str) -> dict[str, Any]:
+        node = self.nodes[node_id]
+        if node.alive:
+            return {"skipped": "already-alive"}
+        # real recovery: a fresh Ingester re-reads the old WAL directory,
+        # a fresh metastore instance starts cold (must re-poll state)
+        self.nodes[node_id] = self._build_node(node_id)
+        self.network.heal(node_id)
+        shards = sorted(
+            s.shard_id
+            for s in self.nodes[node_id].ingester.list_shards(
+                include_replicas=True))
+        return {"restarted": node_id, "recovered_shards": shards}
+
+    def promote_orphans(self) -> list[str]:
+        """Promote replica shards whose leader node is dead (the reference's
+        AdviseResetShards failover) on every surviving node."""
+        alive = set(self.alive_nodes())
+        promoted = []
+        for node_id in self.alive_nodes():
+            ingester = self.nodes[node_id].ingester
+            for queue_id, shard in ingester.replica_shards():
+                leader = shard.shard_id.rsplit("-shard-", 1)[0]
+                if leader not in alive and ingester.promote_replica(queue_id):
+                    promoted.append(queue_id)
+        return sorted(promoted)
+
+    # --- ops ---------------------------------------------------------------
+    def ingest(self, node_id: str, index_id: str,
+               docs: list[dict[str, Any]]) -> dict[str, Any]:
+        node = self.nodes[node_id]
+        if not node.alive:
+            return {"skipped": "dead"}
+        try:
+            result = node.router.ingest(self._uid(index_id), docs)
+        except Exception as exc:  # noqa: BLE001 - any failure means NACK
+            # chained replication rolled the leader WAL back: the batch is
+            # durable on both or neither, so nothing joins the acked ledger
+            return {"error": type(exc).__name__}
+        self.acked[index_id].extend(int(d["n"]) for d in docs)
+        return {"acked": result["num_docs"]}
+
+    def drain(self, node_id: str) -> dict[str, Any]:
+        node = self.nodes[node_id]
+        if not node.alive:
+            return {"skipped": "dead"}
+        summary: dict[str, Any] = {}
+        for index_id in self.scenario.indexes:
+            if self.break_publish:
+                summary[index_id] = self._drain_break_publish(node, index_id)
+            else:
+                summary[index_id] = self._drain_index(node, index_id)
+        return summary
+
+    def _drain_index(self, node: SimNode, index_id: str) -> dict[str, Any]:
+        uid = self._uid(index_id)
+        storage = self.resolver.resolve(self._index_uri(index_id))
+        params = PipelineParams(
+            index_uid=uid, source_id=INGEST_V2_SOURCE_ID,
+            node_id=node.node_id,
+            split_num_docs_target=SPLIT_NUM_DOCS_TARGET, batch_num_docs=25)
+        counters = None
+        for attempt in (0, 1):
+            source = IngestSource(node.ingester, uid, INGEST_V2_SOURCE_ID)
+            pipeline = IndexingPipeline(params, SIM_MAPPER, source,
+                                        node.metastore, storage)
+            try:
+                counters = pipeline.run_to_completion()
+                break
+            except IncompatibleCheckpointDelta:
+                # another node already published these positions (post-
+                # failover double drain): exactly-once enforcement worked
+                return {"skipped": "checkpoint"}
+            except MetastoreError as exc:
+                if attempt or getattr(exc, "kind", "") != "failed_precondition":
+                    return {"error": "metastore"}
+                # stale cache lost the CAS: age it past the polling TTL so
+                # the retry reloads, exactly like a node would on its next
+                # poll tick
+                self.clock.advance(METASTORE_POLL_SECS + 1.0)
+        if counters is None:
+            return {"error": "metastore"}
+        checkpoint = node.metastore.source_checkpoint(uid,
+                                                      INGEST_V2_SOURCE_ID)
+        for shard in node.ingester.list_shards(uid):
+            position = checkpoint.position_for(shard.shard_id)
+            if position != BEGINNING:
+                node.ingester.truncate(uid, INGEST_V2_SOURCE_ID,
+                                       shard.shard_id, int(position))
+        return {"indexed": counters.num_docs_processed,
+                "splits": counters.num_splits_published}
+
+    def _drain_break_publish(self, node: SimNode,
+                             index_id: str) -> dict[str, Any]:
+        """QW_DST_BREAK_PUBLISH: drain the WAL from position zero with a
+        fresh checkpoint partition each pass and never truncate — the
+        'lost the checkpoint linkage' bug class. Every re-drain republishes
+        the same records (exactly-once violation)."""
+        uid = self._uid(index_id)
+        storage = self.resolver.resolve(self._index_uri(index_id))
+        docs: list[dict[str, Any]] = []
+        for shard in node.ingester.list_shards(uid):
+            for _, doc in node.ingester.fetch(uid, INGEST_V2_SOURCE_ID,
+                                              shard.shard_id,
+                                              from_position=0,
+                                              max_records=1_000_000):
+                docs.append(doc)
+        if not docs:
+            return {"indexed": 0, "splits": 0}
+        params = PipelineParams(
+            index_uid=uid, source_id=INGEST_V2_SOURCE_ID,
+            node_id=node.node_id,
+            split_num_docs_target=SPLIT_NUM_DOCS_TARGET, batch_num_docs=25)
+        source = VecSource(
+            docs, partition_id=f"bp-{node.node_id}-{next(self._drain_seq)}")
+        pipeline = IndexingPipeline(params, SIM_MAPPER, source,
+                                    node.metastore, storage)
+        counters = pipeline.run_to_completion()
+        return {"indexed": counters.num_docs_processed,
+                "splits": counters.num_splits_published}
+
+    def search(self, index_id: str, max_hits: int,
+               repeat: int = 2) -> list[dict[str, Any]]:
+        """Run the query `repeat` times through the full root fan-out —
+        the second pass hits the warm cache tiers, which is exactly what
+        the cache≡cold invariant compares."""
+        alive = self.alive_nodes()
+        if not alive:
+            return [{"error": "NoAliveNodes"}]
+        searcher = self.nodes[alive[0]]
+        clients = {
+            node_id: SimSearchClient(self.network, node_id,
+                                     self.nodes[node_id].client)
+            for node_id in alive
+        }
+        root = RootSearcher(
+            FaultyMetastore(searcher.metastore, self.injector), clients,
+            nodes_provider=lambda: self.alive_nodes(),
+            default_timeout_secs=self.scenario.search_timeout_secs)
+        request = SearchRequest(index_ids=[index_id], query_ast=MatchAll(),
+                                max_hits=max_hits)
+        outs: list[dict[str, Any]] = []
+        for _ in range(repeat):
+            try:
+                resp = root.search(request)
+            except Exception as exc:  # noqa: BLE001 - typed outcome per run
+                outs.append({"error": type(exc).__name__})
+                continue
+            complete = (not resp.timed_out and not resp.errors
+                        and not resp.failed_splits)
+            outs.append({
+                "ns": sorted(int(h.doc["n"]) for h in resp.hits),
+                "num_hits": int(resp.num_hits),
+                "complete": bool(complete),
+            })
+        return outs
+
+    def merge(self, node_id: str, index_id: str) -> dict[str, Any]:
+        node = self.nodes[node_id]
+        if not node.alive:
+            return {"skipped": "dead"}
+        uid = self._uid(index_id)
+        storage = self.resolver.resolve(self._index_uri(index_id))
+        query = ListSplitsQuery(index_uids=[uid],
+                                states=[SplitState.PUBLISHED])
+        try:
+            splits = node.metastore.list_splits(query)
+            docs_before = sum(s.metadata.num_docs for s in splits)
+            operations = self.merge_policy.operations(splits)
+            if not operations:
+                return {"merged": 0}
+            executor = MergeExecutor(uid, SIM_MAPPER, node.metastore, storage)
+            executor.execute(operations[0])
+            docs_after = sum(
+                s.metadata.num_docs
+                for s in node.metastore.list_splits(query))
+        except Exception as exc:  # noqa: BLE001 - typed outcome per op
+            return {"error": type(exc).__name__}
+        return {"merged": 1, "docs_before": docs_before,
+                "docs_after": docs_after}
+
+    def autoscale(self, queue_depth: int) -> dict[str, Any]:
+        size = self.autoscaler.tick(queue_depth)
+        return {"pool_size": size,
+                "min": self.autoscaler.min_workers,
+                "max": self.autoscaler.max_workers}
+
+    def plan(self) -> dict[str, Any]:
+        tasks = [IndexingTask(self._uid(index_id), INGEST_V2_SOURCE_ID)
+                 for index_id in self.scenario.indexes]
+        alive = self.alive_nodes()
+        physical = self.cp_scheduler.schedule(tasks, alive)
+        assignment_counts: dict[str, int] = {}
+        for node_id, node_tasks in sorted(physical.assignments.items()):
+            for task in node_tasks:
+                key = f"{task.index_uid}/{task.source_id}"
+                assignment_counts[key] = assignment_counts.get(key, 0) + 1
+        return {"nodes": alive, "assignments": assignment_counts,
+                "num_tasks": len(tasks),
+                "assigned_to_dead": sorted(
+                    n for n in physical.assignments
+                    if physical.assignments[n] and n not in alive)}
+
+    # --- quiescence + oracle ------------------------------------------------
+    def quiesce(self) -> dict[str, Any]:
+        """Drain everything outstanding deterministically: restart every
+        crashed node (disks are durable — WAL recovery is exactly what the
+        zero-loss invariant audits), age past the metastore TTL, then drain
+        every node (twice — a first pass may publish positions a second
+        node's drain needs to observe before truncating)."""
+        summary: dict[str, Any] = {
+            "restarted": [node_id for node_id in sorted(self.nodes)
+                          if not self.nodes[node_id].alive
+                          and self.restart(node_id)],
+            "promoted": self.promote_orphans()}
+        for round_index in range(2):
+            self.clock.advance(METASTORE_POLL_SECS * 2)
+            for node_id in self.alive_nodes():
+                summary[f"drain{round_index}:{node_id}"] = self.drain(node_id)
+        return summary
+
+    def searchable_ns(self, index_id: str) -> list[int]:
+        """Ground truth, network-free: every doc `n` searchable across the
+        index's published splits, duplicates preserved, via direct split
+        reads against the shared object store."""
+        uid = self._uid(index_id)
+        storage = self.resolver.resolve(self._index_uri(index_id))
+        metastore = FileBackedMetastore(self.meta_storage,
+                                        polling_interval_secs=None)
+        out: list[int] = []
+        splits = metastore.list_splits(ListSplitsQuery(
+            index_uids=[uid], states=[SplitState.PUBLISHED]))
+        for split in splits:
+            reader = SplitReader(
+                storage, split_file_path(split.metadata.split_id))
+            resp = leaf_search_single_split(
+                SearchRequest(index_ids=[index_id], query_ast=MatchAll(),
+                              max_hits=1_000_000),
+                SIM_MAPPER, reader, split.metadata.split_id)
+            docs = reader.fetch_docs([h.doc_id for h in resp.partial_hits])
+            out.extend(int(d["n"]) for d in docs)
+        return sorted(out)
+
+    def close(self) -> None:
+        shutil.rmtree(self.base_dir, ignore_errors=True)

@@ -1,0 +1,275 @@
+"""The deterministic scheduler: one run = one seeded, replayable execution.
+
+`run_scenario` owns the whole run: it installs a `FakeClock` and a seeded
+`random.Random` process-wide (every component routed through
+`quickwit_tpu.common.clock` — qwlint QW006 keeps them honest), builds the
+`FaultInjector` + `SimNetwork` + `SimCluster`, then executes the
+materialized op list **synchronously, one op at a time** — the op order IS
+the interleaving, FoundationDB-style, so a run is pinned by
+(scenario, seed, op list, fault plan) and nothing else. Virtual time
+advances only when the scheduler (or a latency fault) says so; scenario
+hours cost milliseconds of wall clock.
+
+`sweep` explores seeds; on a violation it `shrink`s the op list and fault
+plan (greedy single-pass delta-debugging, keeping a candidate only if the
+SAME invariant still fires) and persists a self-contained replay artifact.
+`replay` re-executes an artifact from its own contents alone and reports
+whether the trace digest matches byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from ..common.clock import FakeClock, use_clock, use_rng
+from ..common.faults import FaultInjector
+from .artifact import make_artifact, save_artifact
+from .cluster import SimCluster
+from .invariants import InvariantChecker, Violation
+from .network import SimNetwork
+from .scenario import SCENARIOS, Scenario
+from .trace import Trace
+
+# virtual start of every run: far enough from zero that monotonic deltas
+# and wall timestamps are both well-behaved, and identical across runs
+_VIRTUAL_START = 1000.0
+_VIRTUAL_EPOCH = 1_700_000_000.0
+
+
+def _env_flag(name: str) -> bool:
+    return os.environ.get(name, "").strip().lower() in ("1", "true", "yes")
+
+
+@dataclass
+class RunResult:
+    scenario: Scenario
+    seed: int
+    ops: list[dict[str, Any]]
+    violations: list[Violation]
+    trace: Trace
+
+    @property
+    def digest(self) -> str:
+        return self.trace.digest()
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    @property
+    def first_violation(self) -> Optional[Violation]:
+        return self.violations[0] if self.violations else None
+
+
+def run_scenario(scenario: Scenario, seed: int,
+                 ops: Optional[list[dict[str, Any]]] = None,
+                 fault_plan: Optional[dict[str, Any]] = None,
+                 break_publish: Optional[bool] = None,
+                 break_wal: Optional[bool] = None) -> RunResult:
+    """Execute one deterministic run. `ops` / `fault_plan` default to the
+    scenario's materialization and fault rules for `seed`; replay and
+    shrinking pass explicit (possibly reduced) values. The break flags
+    default to the `QW_DST_BREAK_{PUBLISH,WAL}` env switches; replay pins
+    them from the artifact so a run reproduces from the file alone."""
+    if ops is None:
+        ops = scenario.materialize(seed)
+    if break_publish is None:
+        break_publish = _env_flag("QW_DST_BREAK_PUBLISH")
+    if break_wal is None:
+        break_wal = _env_flag("QW_DST_BREAK_WAL")
+    if fault_plan is not None:
+        injector = FaultInjector.from_plan(fault_plan)
+    else:
+        injector = FaultInjector(seed, list(scenario.fault_rules))
+
+    expected_index_of_n = {
+        int(doc["n"]): op["index"]
+        for op in ops if op["kind"] == "ingest" for doc in op["docs"]
+    }
+    checker = InvariantChecker(scenario.invariants, expected_index_of_n)
+    trace = Trace()
+    clock = FakeClock(start=_VIRTUAL_START, epoch=_VIRTUAL_EPOCH)
+    rng = random.Random(seed)
+
+    with use_clock(clock), use_rng(rng):
+        network = SimNetwork(injector, seed, duplicate_probability=0.05)
+        cluster = SimCluster(scenario, injector, network, clock,
+                             break_publish=break_publish,
+                             break_wal=break_wal)
+        try:
+            trace.record("start", scenario=scenario.name, seed=seed,
+                         num_ops=len(ops), break_publish=break_publish,
+                         break_wal=break_wal)
+            for step, op in enumerate(ops):
+                clock.advance(scenario.step_secs)
+                result = _execute(cluster, op)
+                trace.record("op", step=step, now=round(clock.monotonic(), 6),
+                             op=op if op["kind"] != "ingest" else {
+                                 "kind": "ingest", "node": op["node"],
+                                 "index": op["index"],
+                                 "num_docs": len(op["docs"])},
+                             result=result)
+                checker.after_op(cluster, op, result, step)
+                if checker.violations:
+                    break
+            if not checker.violations:
+                summary = cluster.quiesce()
+                trace.record("quiesce", now=round(clock.monotonic(), 6),
+                             summary=summary)
+                checker.at_quiescence(cluster, step=len(ops))
+            trace.record("fault_schedule", schedule=injector.schedule())
+            trace.record("end",
+                         violations=[v.to_dict() for v in checker.violations])
+        finally:
+            cluster.close()
+    return RunResult(scenario=scenario, seed=seed, ops=ops,
+                     violations=checker.violations, trace=trace)
+
+
+def _execute(cluster: SimCluster, op: dict[str, Any]) -> Any:
+    kind = op["kind"]
+    if kind == "ingest":
+        return cluster.ingest(op["node"], op["index"], op["docs"])
+    if kind == "drain":
+        return cluster.drain(op["node"])
+    if kind == "search":
+        return cluster.search(op["index"], op["max_hits"])
+    if kind == "merge":
+        return cluster.merge(op["node"], op["index"])
+    if kind == "kill":
+        return cluster.kill(op["node"])
+    if kind == "restart":
+        return cluster.restart(op["node"])
+    if kind == "autoscale":
+        return cluster.autoscale(op["queue_depth"])
+    if kind == "plan":
+        return cluster.plan()
+    raise ValueError(f"unknown op kind: {kind!r}")
+
+
+# --- shrinking ---------------------------------------------------------------
+
+def shrink(scenario: Scenario, seed: int, ops: list[dict[str, Any]],
+           violation: Violation,
+           break_publish: bool = False,
+           break_wal: bool = False) -> tuple[Scenario, list[dict[str, Any]]]:
+    """Greedy seed-local shrink: one backward elimination pass over the op
+    list, then one over the fault rules — a candidate survives only if the
+    SAME-NAMED invariant still fires. Single-pass keeps the cost linear in
+    the op count (each probe is a full deterministic run)."""
+    name = violation.invariant
+
+    def still_fails(sc: Scenario, candidate_ops: list[dict[str, Any]]) -> bool:
+        result = run_scenario(sc, seed, ops=candidate_ops,
+                              break_publish=break_publish,
+                              break_wal=break_wal)
+        return any(v.invariant == name for v in result.violations)
+
+    current = list(ops)
+    for i in reversed(range(len(current))):
+        candidate = current[:i] + current[i + 1:]
+        if still_fails(scenario, candidate):
+            current = candidate
+
+    rules = list(scenario.fault_rules)
+    for i in reversed(range(len(rules))):
+        candidate_rules = rules[:i] + rules[i + 1:]
+        candidate_sc = dataclasses.replace(scenario,
+                                           fault_rules=tuple(candidate_rules))
+        if still_fails(candidate_sc, current):
+            rules = candidate_rules
+            scenario = candidate_sc
+    return scenario, current
+
+
+# --- sweep -------------------------------------------------------------------
+
+def sweep(scenario: Scenario, seeds: int, start_seed: int = 0,
+          artifacts_dir: Optional[str] = None,
+          break_publish: Optional[bool] = None,
+          break_wal: Optional[bool] = None,
+          shrink_violations: bool = True,
+          stop_on_first: bool = True) -> dict[str, Any]:
+    """Run `seeds` consecutive seeds; shrink + persist an artifact for each
+    violating seed. Returns a JSON-safe summary (the CLI prints it)."""
+    if break_publish is None:
+        break_publish = _env_flag("QW_DST_BREAK_PUBLISH")
+    if break_wal is None:
+        break_wal = _env_flag("QW_DST_BREAK_WAL")
+    summary: dict[str, Any] = {
+        "scenario": scenario.name, "seeds": seeds, "start_seed": start_seed,
+        "passed": [], "violations": [],
+    }
+    for seed in range(start_seed, start_seed + seeds):
+        result = run_scenario(scenario, seed,
+                              break_publish=break_publish,
+                              break_wal=break_wal)
+        if result.ok:
+            summary["passed"].append(seed)
+            continue
+        violation = result.first_violation
+        entry: dict[str, Any] = {"seed": seed,
+                                 "invariant": violation.invariant,
+                                 "violation": violation.to_dict()}
+        shrunk_scenario, shrunk_ops = scenario, result.ops
+        if shrink_violations:
+            shrunk_scenario, shrunk_ops = shrink(
+                scenario, seed, result.ops, violation,
+                break_publish=break_publish, break_wal=break_wal)
+            entry["ops_before_shrink"] = len(result.ops)
+            entry["ops_after_shrink"] = len(shrunk_ops)
+            entry["fault_rules_after_shrink"] = len(
+                shrunk_scenario.fault_rules)
+        # re-run the shrunk repro to capture its trace for the artifact
+        repro = run_scenario(shrunk_scenario, seed, ops=shrunk_ops,
+                             break_publish=break_publish,
+                             break_wal=break_wal)
+        repro_violation = (repro.first_violation
+                           if repro.first_violation else violation)
+        artifact = make_artifact(
+            shrunk_scenario, seed, shrunk_ops, repro_violation, repro.trace,
+            break_publish=break_publish, break_wal=break_wal)
+        if artifacts_dir:
+            os.makedirs(artifacts_dir, exist_ok=True)
+            path = os.path.join(
+                artifacts_dir,
+                f"dst-{scenario.name}-seed{seed}-"
+                f"{violation.invariant}.json")
+            save_artifact(artifact, path)
+            entry["artifact"] = path
+        else:
+            entry["artifact_inline"] = artifact
+        summary["violations"].append(entry)
+        if stop_on_first:
+            break
+    summary["ok"] = not summary["violations"]
+    return summary
+
+
+# --- replay ------------------------------------------------------------------
+
+def replay(artifact: dict[str, Any]) -> tuple[RunResult, bool]:
+    """Re-execute a replay artifact from its contents alone. Returns the
+    fresh `RunResult` and whether its trace digest matches the recorded
+    one byte-for-byte."""
+    scenario = Scenario.from_dict(artifact["scenario"])
+    flags = artifact.get("break_flags", {})
+    result = run_scenario(
+        scenario, int(artifact["seed"]), ops=list(artifact["ops"]),
+        fault_plan=artifact.get("fault_plan"),
+        break_publish=bool(flags.get("publish", False)),
+        break_wal=bool(flags.get("wal", False)))
+    return result, result.digest == artifact["trace_digest"]
+
+
+def scenario_by_name(name: str) -> Scenario:
+    try:
+        return SCENARIOS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown scenario {name!r}; known: {sorted(SCENARIOS)}"
+        ) from None

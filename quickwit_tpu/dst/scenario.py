@@ -1,0 +1,189 @@
+"""Scenario DSL: declarative workload + invariant selection for one run.
+
+A `Scenario` declares the cluster shape (nodes, indexes), the workload mix
+(ingest / drain / search / merge / membership churn / autoscaler and
+control-plane ticks as weighted op kinds), the fault plan, and which
+invariants to check. `materialize(seed)` expands it into the explicit,
+JSON-safe op list one run executes — the op list IS the interleaving: the
+scheduler executes it in order, so storing it in a replay artifact (and
+deleting entries from it during shrinking) fully pins a run.
+
+Materialization tracks its own alive-set so churn ops are always
+executable (never kill the last node, never restart a live one); the
+executor mirrors the same bookkeeping, keeping op semantics identical
+between generation and replay.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+from dataclasses import asdict, dataclass, field
+from typing import Any, Optional
+
+from ..common.faults import FaultRule
+
+DEFAULT_WEIGHTS: dict[str, int] = {
+    "ingest": 6,
+    "drain": 4,
+    "search": 5,
+    "merge": 1,
+    "kill": 2,
+    "restart": 2,
+    "autoscale": 1,
+    "plan": 1,
+}
+
+ALL_INVARIANTS = (
+    "exactly_once_publish",
+    "zero_loss_wal_failover",
+    "cache_cold_equivalence",
+    "tenant_isolation",
+    "merge_input_conservation",
+    "deadline_monotonicity",
+    "autoscaler_bounds",
+    "plan_completeness",
+)
+
+
+@dataclass(frozen=True)
+class Scenario:
+    name: str
+    nodes: int = 2
+    indexes: tuple[str, ...] = ("tenant-a", "tenant-b")
+    steps: int = 40
+    docs_min: int = 1
+    docs_max: int = 6
+    # virtual seconds advanced before each op: > the metastore polling TTL
+    # the cluster uses, so cross-node publishes become visible step-over-step
+    step_secs: float = 7.5
+    search_timeout_secs: float = 5.0
+    replication: bool = True
+    weights: dict[str, int] = field(
+        default_factory=lambda: dict(DEFAULT_WEIGHTS))
+    invariants: tuple[str, ...] = ALL_INVARIANTS
+    fault_rules: tuple[FaultRule, ...] = ()
+
+    # --- materialization ---------------------------------------------------
+    def _rng(self, seed: int) -> random.Random:
+        digest = hashlib.blake2b(f"{self.name}:{seed}".encode(),
+                                 digest_size=8).digest()
+        return random.Random(int.from_bytes(digest, "big"))
+
+    def materialize(self, seed: int) -> list[dict[str, Any]]:
+        """Expand into the explicit op list for `seed`. Ops are JSON-safe
+        dicts; doc payloads carry globally unique sequence numbers `n`
+        (disjoint across indexes by construction — the tenant-isolation
+        oracle keys on them)."""
+        rng = self._rng(seed)
+        node_ids = [f"sim-{i}" for i in range(self.nodes)]
+        alive = set(node_ids)
+        kinds = [k for k, w in sorted(self.weights.items()) if w > 0]
+        weights = [self.weights[k] for k in kinds]
+        ops: list[dict[str, Any]] = []
+        next_n = 0
+        for _ in range(self.steps):
+            kind = rng.choices(kinds, weights=weights, k=1)[0]
+            if kind == "kill" and len(alive) <= 1:
+                kind = "search"  # never kill the last node
+            if kind == "restart" and len(alive) == len(node_ids):
+                kind = "drain"  # nothing to restart
+            if kind == "ingest":
+                node = rng.choice(sorted(alive))
+                index_id = rng.choice(self.indexes)
+                count = rng.randint(self.docs_min, self.docs_max)
+                docs = [{"n": next_n + i,
+                         "ts": 1_600_000_000 + next_n + i,
+                         "body": f"doc {index_id} {next_n + i}"}
+                        for i in range(count)]
+                next_n += count
+                ops.append({"kind": "ingest", "node": node,
+                            "index": index_id, "docs": docs})
+            elif kind == "drain":
+                ops.append({"kind": "drain",
+                            "node": rng.choice(sorted(alive))})
+            elif kind == "search":
+                ops.append({"kind": "search",
+                            "index": rng.choice(self.indexes),
+                            "max_hits": rng.choice((10, 100, 1000))})
+            elif kind == "merge":
+                ops.append({"kind": "merge", "node": rng.choice(sorted(alive)),
+                            "index": rng.choice(self.indexes)})
+            elif kind == "kill":
+                node = rng.choice(sorted(alive))
+                alive.discard(node)
+                ops.append({"kind": "kill", "node": node})
+            elif kind == "restart":
+                node = rng.choice(sorted(set(node_ids) - alive))
+                alive.add(node)
+                ops.append({"kind": "restart", "node": node})
+            elif kind == "autoscale":
+                ops.append({"kind": "autoscale",
+                            "queue_depth": rng.randint(0, 64)})
+            elif kind == "plan":
+                ops.append({"kind": "plan"})
+        return ops
+
+    # --- (de)serialization -------------------------------------------------
+    def to_dict(self) -> dict[str, Any]:
+        out = asdict(self)
+        out["indexes"] = list(self.indexes)
+        out["invariants"] = list(self.invariants)
+        out["fault_rules"] = [asdict(r) for r in self.fault_rules]
+        return out
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "Scenario":
+        return cls(
+            name=data["name"],
+            nodes=int(data.get("nodes", 2)),
+            indexes=tuple(data.get("indexes", ("tenant-a", "tenant-b"))),
+            steps=int(data.get("steps", 40)),
+            docs_min=int(data.get("docs_min", 1)),
+            docs_max=int(data.get("docs_max", 6)),
+            step_secs=float(data.get("step_secs", 7.5)),
+            search_timeout_secs=float(data.get("search_timeout_secs", 5.0)),
+            replication=bool(data.get("replication", True)),
+            weights={str(k): int(v)
+                     for k, v in data.get("weights", DEFAULT_WEIGHTS).items()},
+            invariants=tuple(data.get("invariants", ALL_INVARIANTS)),
+            fault_rules=tuple(FaultRule(**r)
+                              for r in data.get("fault_rules", ())),
+        )
+
+
+def _default_fault_rules() -> tuple[FaultRule, ...]:
+    """The mixed scenario's chaos plan: occasional storage latency, rare
+    retryable leaf/storage errors, rare replication failures — all survivable
+    by design (retries, rollback, failover), so a 100+-seed sweep passes."""
+    return (
+        FaultRule(operation="storage.get_slice", kind="latency",
+                  probability=0.05, latency_secs=0.2),
+        FaultRule(operation="net.leaf_search@*", kind="error",
+                  probability=0.04),
+        FaultRule(operation="ingest.replicate", kind="error",
+                  probability=0.05),
+        FaultRule(operation="wal.fsync", kind="latency",
+                  probability=0.05, latency_secs=0.05),
+    )
+
+
+SCENARIOS: dict[str, Scenario] = {
+    # tier-1 smoke: small, fast, three core invariants, light faults
+    "smoke": Scenario(
+        name="smoke", nodes=2, steps=18, step_secs=7.5,
+        indexes=("tenant-a", "tenant-b"),
+        invariants=("exactly_once_publish", "zero_loss_wal_failover",
+                    "tenant_isolation"),
+        fault_rules=(FaultRule(operation="ingest.replicate", kind="error",
+                               probability=0.05),),
+    ),
+    # the acceptance scenario: mixed ingest/search/failover, full invariant
+    # set, the default chaos plan
+    "mixed": Scenario(
+        name="mixed", nodes=3, steps=40,
+        indexes=("tenant-a", "tenant-b"),
+        invariants=ALL_INVARIANTS,
+        fault_rules=_default_fault_rules(),
+    ),
+}

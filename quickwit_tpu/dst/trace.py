@@ -1,0 +1,42 @@
+"""Run trace: the deterministic event log a simulation run produces.
+
+The trace is the determinism oracle: two executions of the same
+(seed, scenario, op list, fault plan) must produce byte-identical traces —
+`digest()` is what the harness, the self-tests, and `dst replay` compare.
+Consequently every recorded field must be a pure function of the run's
+inputs: op summaries, virtual timestamps, invariant observations, fault
+decisions — never wall-clock times, filesystem paths, object ids, or
+anything else that varies across processes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any
+
+
+def canonical_json(value: Any) -> str:
+    """Stable serialization: sorted keys, no whitespace — the byte form
+    every digest in the DST layer is computed over."""
+    return json.dumps(value, sort_keys=True, separators=(",", ":"))
+
+
+class Trace:
+    def __init__(self) -> None:
+        self.events: list[dict[str, Any]] = []
+
+    def record(self, kind: str, **fields: Any) -> None:
+        event = {"kind": kind}
+        event.update(fields)
+        # round-trip through canonical JSON now: a non-serializable or
+        # non-deterministic value should fail at the recording site, not
+        # at digest time three hundred events later
+        self.events.append(json.loads(canonical_json(event)))
+
+    def digest(self) -> str:
+        return hashlib.blake2b(canonical_json(self.events).encode(),
+                               digest_size=16).hexdigest()
+
+    def __len__(self) -> int:
+        return len(self.events)

@@ -24,9 +24,10 @@ from __future__ import annotations
 
 import hashlib
 import threading
-import time
 from dataclasses import dataclass
 from typing import Callable, Optional
+
+from ..common.clock import monotonic
 
 NUDGE_TOLERANCE_SECS = 5.0
 
@@ -48,7 +49,7 @@ class CooperativeIndexingCycle:
 
     def __init__(self, pipeline_id: str, commit_timeout_secs: float,
                  permits: threading.Semaphore,
-                 clock: Callable[[], float] = time.monotonic,
+                 clock: Callable[[], float] = monotonic,
                  origin: Optional[float] = None):
         if commit_timeout_secs <= 0:
             raise ValueError("commit_timeout must be positive")

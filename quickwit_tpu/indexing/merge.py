@@ -16,11 +16,11 @@ the reference's delete-task pipeline applies deletes at merge time.
 from __future__ import annotations
 
 import logging
-import time
 import zlib
 from dataclasses import dataclass
 from typing import Optional
 
+from ..common.clock import wall_time
 from ..index.reader import SplitReader
 from ..index.writer import SplitWriter
 from ..metastore.base import ListSplitsQuery, Metastore
@@ -244,7 +244,7 @@ class MergeExecutor:
             time_range_start=time_min,
             time_range_end=time_max,
             tags=tags,
-            create_timestamp=int(time.time()),
+            create_timestamp=int(wall_time()),
             num_merge_ops=1 + max(s.metadata.num_merge_ops for s in operation.splits),
             delete_opstamp=max_delete_opstamp,
             doc_mapping_uid=operation.splits[0].metadata.doc_mapping_uid,

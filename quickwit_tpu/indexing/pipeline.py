@@ -15,10 +15,10 @@ is force-committed (commit_timeout's role for bounded sources).
 from __future__ import annotations
 
 import logging
-import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from ..common.clock import wall_time
 from ..index.writer import SplitWriter
 from ..metastore.base import Metastore
 from ..metastore.checkpoint import CheckpointDelta, SourceCheckpoint
@@ -166,7 +166,7 @@ class IndexingPipeline:
                 time_range_start=writer._time_min,
                 time_range_end=writer._time_max,
                 tags=frozenset(writer.tags),
-                create_timestamp=int(time.time()),
+                create_timestamp=int(wall_time()),
                 doc_mapping_uid=self.params.doc_mapping_uid,
                 partition_id=partition,
                 column_bounds=dict(writer.column_bounds),

@@ -17,9 +17,9 @@ from __future__ import annotations
 
 import json
 import threading
-import time
 from typing import Iterable, Optional
 
+from ..common.clock import monotonic, wall_time
 from ..models.index_metadata import IndexMetadata, SourceConfig
 from ..models.split_metadata import Split, SplitMetadata, SplitState
 from ..storage.base import Storage, StorageError
@@ -38,7 +38,7 @@ class _IndexState:
     """In-memory image of one index's metastore file."""
 
     def __init__(self, metadata: IndexMetadata):
-        self.loaded_at = time.monotonic()
+        self.loaded_at = monotonic()
         self.metadata = metadata
         self.splits: dict[str, Split] = {}
         self.checkpoints: dict[str, SourceCheckpoint] = {}
@@ -105,7 +105,7 @@ class FileBackedMetastore(Metastore):
     def _load_manifest(self) -> dict[str, str]:
         stale = (self._manifest is not None
                  and self.polling_interval_secs is not None
-                 and time.monotonic() - self._manifest_loaded_at
+                 and monotonic() - self._manifest_loaded_at
                  > self.polling_interval_secs)
         if self._manifest is None or stale:
             try:
@@ -113,7 +113,7 @@ class FileBackedMetastore(Metastore):
             except StorageError:
                 if self._manifest is None:
                     self._manifest = {}
-            self._manifest_loaded_at = time.monotonic()
+            self._manifest_loaded_at = monotonic()
         return self._manifest
 
     def _save_manifest(self) -> None:
@@ -125,7 +125,7 @@ class FileBackedMetastore(Metastore):
         state = self._states.get(index_id)
         fresh = (state is not None and not state.discarded
                  and (self.polling_interval_secs is None
-                      or time.monotonic() - state.loaded_at
+                      or monotonic() - state.loaded_at
                       < self.polling_interval_secs))
         if fresh:
             return state
@@ -141,7 +141,7 @@ class FileBackedMetastore(Metastore):
                 except StorageError:
                     return state  # storage blip: keep serving the cache
                 self._manifest = manifest
-                self._manifest_loaded_at = time.monotonic()
+                self._manifest_loaded_at = monotonic()
                 if index_id in manifest:
                     return state  # index exists, state read blipped
                 self._states.pop(index_id, None)
@@ -179,7 +179,7 @@ class FileBackedMetastore(Metastore):
                     f"(stored version {stored_version}, uid {stored_uid!r} vs "
                     f"loaded {state.version}, {state.metadata.index_uid!r}); "
                     f"retry", kind="failed_precondition")
-        state.loaded_at = time.monotonic()  # our write IS the latest state
+        state.loaded_at = monotonic()  # our write IS the latest state
         state.version += 1
         self.storage.put(_state_path(state.metadata.index_id),
                          json.dumps(state.to_dict()).encode())
@@ -330,7 +330,7 @@ class FileBackedMetastore(Metastore):
 
     # --- splits --------------------------------------------------------------
     def stage_splits(self, index_uid: str, split_metadatas: list[SplitMetadata]) -> None:
-        now = int(time.time())
+        now = int(wall_time())
         with self._lock:
             state = self._state_by_uid(index_uid)
             for md in split_metadatas:
@@ -351,7 +351,7 @@ class FileBackedMetastore(Metastore):
         source_id: Optional[str] = None,
         checkpoint_delta: Optional[CheckpointDelta] = None,
     ) -> None:
-        now = int(time.time())
+        now = int(wall_time())
         with self._lock:
             state = self._state_by_uid(index_uid)
             # validate everything before mutating anything (atomicity)
@@ -402,7 +402,7 @@ class FileBackedMetastore(Metastore):
             return sorted(out, key=lambda s: s.metadata.split_id)
 
     def mark_splits_for_deletion(self, index_uid: str, split_ids: Iterable[str]) -> None:
-        now = int(time.time())
+        now = int(wall_time())
         with self._lock:
             state = self._state_by_uid(index_uid)
             for split_id in split_ids:
@@ -436,7 +436,7 @@ class FileBackedMetastore(Metastore):
             opstamp = state.last_delete_opstamp
             state.delete_tasks.append({
                 "opstamp": opstamp,
-                "create_timestamp": int(time.time()),
+                "create_timestamp": int(wall_time()),
                 "query_ast": query_ast_json,
             })
             self._save_state(state)

@@ -7,10 +7,10 @@ settings, retention), registered sources, and per-source checkpoints.
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from ..common.clock import wall_time
 from .doc_mapper import DocMapper
 
 
@@ -95,7 +95,7 @@ class IndexMetadata:
     # source_id -> partition_id -> position (exactly-once checkpoints,
     # reference: quickwit-metastore/src/checkpoint.rs)
     checkpoints: dict[str, dict[str, str]] = field(default_factory=dict)
-    create_timestamp: int = field(default_factory=lambda: int(time.time()))
+    create_timestamp: int = field(default_factory=lambda: int(wall_time()))
 
     @property
     def index_id(self) -> str:

@@ -8,11 +8,11 @@ MarkedForDeletion lifecycle enforced by the metastore.
 
 from __future__ import annotations
 
-import time
-import uuid
 from dataclasses import dataclass, field
 from enum import Enum
 from typing import Any, Optional
+
+from ..common.clock import get_rng, wall_time
 
 
 class SplitState(str, Enum):
@@ -23,7 +23,11 @@ class SplitState(str, Enum):
 
 def new_split_id() -> str:
     # ULID-like: time-ordered prefix + random suffix (reference uses ULIDs).
-    return f"{int(time.time() * 1000):013d}-{uuid.uuid4().hex[:12]}"
+    # Both components come from the process clock/rng seams: under the DST
+    # harness split ids are then a pure function of the scenario seed, which
+    # keeps rendezvous placement (hashed over split ids) replayable.
+    return (f"{int(wall_time() * 1000):013d}-"
+            f"{get_rng().getrandbits(48):012x}")
 
 
 @dataclass
@@ -53,7 +57,7 @@ class SplitMetadata:
     def is_mature(self, now_ts: Optional[float] = None) -> bool:
         if self.maturity_timestamp == 0:
             return True
-        return (now_ts if now_ts is not None else time.time()) >= self.maturity_timestamp
+        return (now_ts if now_ts is not None else wall_time()) >= self.maturity_timestamp
 
     def overlaps_time_range(self, start_micros: Optional[int], end_micros: Optional[int]) -> bool:
         """Time pruning predicate (reference: ListSplitsQuery time filter)."""

@@ -17,9 +17,9 @@ from __future__ import annotations
 
 import math
 import threading
-import time
 from typing import Callable, Optional
 
+from ..common.clock import monotonic
 from ..observability.metrics import OFFLOAD_AUTOSCALE_TOTAL
 from .pool import WorkerPool
 
@@ -82,7 +82,7 @@ class Autoscaler:
                  queue_per_worker: int = 16,
                  scale_down_cooldown_secs: float = 10.0,
                  overload=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = monotonic):
         if min_workers < 0 or max_workers < max(min_workers, 1):
             raise ValueError("need 0 <= min_workers <= max_workers, "
                              "max_workers >= 1")

@@ -34,11 +34,11 @@ from __future__ import annotations
 
 import json
 import threading
-import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from ..common.clock import monotonic
 from ..common.ctx import run_with_context
 from ..common.deadline import Deadline, current_deadline
 from ..observability.metrics import (
@@ -129,7 +129,7 @@ class OffloadDispatcher:
                  hedge_max_delay_secs: float = 5.0,
                  min_attempt_budget_secs: float = 0.02,
                  injector=None, autoscaler=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = monotonic):
         self.pool = pool
         self.task_splits = max(int(task_splits), 1)
         self.max_inflight_per_worker = max(int(max_inflight_per_worker), 1)

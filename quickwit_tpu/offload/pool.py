@@ -25,10 +25,10 @@ whose p95 sets the hedge delay.
 from __future__ import annotations
 
 import threading
-import time
 from collections import deque
 from typing import Callable, Optional
 
+from ..common.clock import monotonic
 from ..observability.metrics import OFFLOAD_POOL_WORKERS
 
 HEALTHY = "healthy"
@@ -69,7 +69,7 @@ class WorkerPool:
                  readmit_backoff_secs: float = 0.5,
                  readmit_backoff_max_secs: float = 30.0,
                  latency_window: int = 128,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = monotonic):
         if suspect_after < 1 or eject_after < suspect_after:
             raise ValueError("need 1 <= suspect_after <= eject_after")
         self.suspect_after = suspect_after

@@ -17,6 +17,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from ..common.clock import get_clock, monotonic as _clock_monotonic
 from ..cluster.membership import Cluster, ClusterChange, ClusterMember
 from ..indexing.merge import MergeExecutor, merge_policy_from_config
 from ..indexing.pipeline import IndexingPipeline, PipelineParams
@@ -513,7 +514,7 @@ class Node:
             max(1, config.max_concurrent_pipelines))
         self._coop_cycles: dict[str, Any] = {}
         self._coop_next_wake: dict[str, float] = {}
-        self._coop_clock = time.monotonic  # tests swap in a virtual clock
+        self._coop_clock = _clock_monotonic  # process clock; tests/DST swap in a virtual one
         self.pipeline_metrics: dict[str, Any] = {}
         self.span_exporter = None
         self._ensure_span_exporter()
@@ -624,7 +625,7 @@ class Node:
             node_id=self.config.node_id,
             split_num_docs_target=metadata.index_config.split_num_docs_target,
         )
-        source = VecSource(docs, partition_id=f"ingest-{time.time_ns()}")
+        source = VecSource(docs, partition_id=f"ingest-{get_clock().time_ns()}")
         pipeline = IndexingPipeline(
             params, doc_mapper, source, self.metastore, storage,
             transform=self._transform_for(metadata, INGEST_API_SOURCE_ID))
@@ -863,7 +864,7 @@ class Node:
         dead_since = getattr(self, "_leader_dead_since", None)
         if dead_since is None:
             dead_since = self._leader_dead_since = {}
-        now = time.monotonic()
+        now = _clock_monotonic()
         promoted = []
         for queue_id, shard in self.ingester.replica_shards():
             leader_node = shard.shard_id.rsplit("-shard-", 1)[0]

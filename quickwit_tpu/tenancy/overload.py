@@ -22,8 +22,8 @@ pre-tenancy behavior.
 from __future__ import annotations
 
 import threading
-import time
 
+from ..common.clock import monotonic
 from .context import MAX_PRIORITY
 
 
@@ -74,7 +74,7 @@ class OverloadController:
         with self._lock:
             self._ewma = (self.alpha * max(wait_secs, 0.0)
                           + (1.0 - self.alpha) * self._ewma)
-            self._last_update = time.monotonic()
+            self._last_update = monotonic()
 
     def severity(self) -> float:
         """Smoothed wait over target; 0 when disabled or idle. Staleness
@@ -83,7 +83,7 @@ class OverloadController:
         with self._lock:
             if not self.enabled or self._last_update == 0.0:
                 return 0.0
-            if time.monotonic() - self._last_update > self.idle_reset_secs:
+            if monotonic() - self._last_update > self.idle_reset_secs:
                 self._ewma = 0.0
                 return 0.0
             if self.target_wait_secs <= 0.0:

@@ -9,9 +9,19 @@ import json
 import pytest
 
 from quickwit_tpu.cluster.membership import ClusterMember
+from quickwit_tpu.common.clock import FakeClock, monotonic, use_clock
 from quickwit_tpu.serve import Node, NodeConfig, RestServer
 from quickwit_tpu.serve.http_client import HttpSearchClient
 from quickwit_tpu.storage import StorageResolver
+
+
+@pytest.fixture(autouse=True)
+def _virtual_clock():
+    # liveness aging is pure arithmetic on the clock seam: a FakeClock
+    # pins it, so the "indexer dies" test rewinds a heartbeat explicitly
+    # instead of racing real time
+    with use_clock(FakeClock(start=1000.0)):
+        yield
 
 
 @pytest.fixture
@@ -100,10 +110,10 @@ def test_drift_reassigns_when_indexer_dies(cluster):
     before = {t["source_id"] for t in nodes[0].indexing_tasks()
               if t["source_id"].startswith("file-")}
     assert len(before) == 1
-    # cp-1 dies: liveness lapses out of the alive set
-    import time as time_mod
+    # cp-1 dies: liveness lapses out of the alive set (virtual clock:
+    # the rewind is exact, not a race against wall time)
     member = nodes[0].cluster.member("cp-1")
-    member.last_heartbeat = time_mod.monotonic() - 10_000
+    member.last_heartbeat = monotonic() - 10_000
     out = nodes[0].run_control_plane_pass()
     assert out["drift"] is True
     # every file task lands on the survivor

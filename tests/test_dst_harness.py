@@ -1,0 +1,162 @@
+"""DST harness self-tests: the harness must be able to find bugs.
+
+The two planted-bug switches are the proof of fitness — each must be
+found by a bounded seed sweep, shrunk to a small repro, and reproduced
+from its replay artifact ALONE, byte-identically, twice. Alongside:
+fault-plan serialization round-trips, scenario materialization
+determinism, artifact save/load, and shrinking actually shrinking."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from quickwit_tpu.common.faults import FaultInjector, FaultRule, InjectedFault
+from quickwit_tpu.dst import (SCENARIOS, Scenario, load_artifact, replay,
+                              run_scenario, save_artifact, sweep)
+from quickwit_tpu.dst.__main__ import main as dst_main
+from quickwit_tpu.dst.trace import canonical_json
+
+
+# --- fault-plan serialization ------------------------------------------------
+
+def _spin(injector: FaultInjector, ops: list[str]) -> list[str]:
+    fired = []
+    for op in ops:
+        try:
+            injector.perturb(op)
+        except InjectedFault:
+            fired.append(op)
+    return fired
+
+
+def test_fault_plan_round_trip_preserves_cursors():
+    rules = [FaultRule(operation="net.leaf_search@*", kind="error",
+                       probability=0.3),
+             FaultRule(operation="wal.fsync", kind="latency",
+                       probability=0.2, latency_secs=0.0)]
+    a = FaultInjector(seed=42, rules=rules)
+    ops = [f"net.leaf_search@sim-{i % 3}" for i in range(30)] + \
+          ["wal.fsync"] * 10
+    first_half = _spin(a, ops)
+
+    plan = a.to_plan()
+    restored = FaultInjector.from_plan(json.loads(json.dumps(plan)))
+    # same mid-stream state: the two injectors must agree on every future
+    # decision — occurrence cursors and fires-so-far all survive the trip
+    assert _spin(a, ops) == _spin(restored, ops)
+    assert a.to_plan() == restored.to_plan()
+
+
+def test_fault_plan_rejects_mismatched_fires():
+    plan = FaultInjector(seed=1, rules=[
+        FaultRule(operation="x", kind="error", probability=1.0)]).to_plan()
+    plan["fires_per_rule"] = [0, 0]
+    with pytest.raises(ValueError):
+        FaultInjector.from_plan(plan)
+
+
+def test_fresh_plan_replays_identically_from_zero():
+    rules = [FaultRule(operation="storage.*", kind="error", probability=0.5)]
+    plan = FaultInjector(seed=9, rules=rules).to_plan()
+    ops = [f"storage.get_slice" for _ in range(40)]
+    assert (_spin(FaultInjector.from_plan(plan), list(ops))
+            == _spin(FaultInjector(seed=9, rules=rules), list(ops)))
+
+
+# --- scenario DSL ------------------------------------------------------------
+
+def test_materialize_is_deterministic_and_seed_sensitive():
+    scenario = SCENARIOS["mixed"]
+    assert scenario.materialize(5) == scenario.materialize(5)
+    assert scenario.materialize(5) != scenario.materialize(6)
+
+
+def test_scenario_dict_round_trip():
+    scenario = SCENARIOS["mixed"]
+    back = Scenario.from_dict(json.loads(json.dumps(scenario.to_dict())))
+    assert back == scenario
+    assert back.materialize(11) == scenario.materialize(11)
+
+
+# --- planted-bug self-tests --------------------------------------------------
+
+def _find_shrink_replay(break_publish: bool, break_wal: bool,
+                        expected_invariant: str, tmp_path):
+    summary = sweep(SCENARIOS["smoke"], seeds=200,
+                    artifacts_dir=str(tmp_path),
+                    break_publish=break_publish, break_wal=break_wal)
+    assert not summary["ok"], \
+        f"sweep failed to find the planted {expected_invariant} bug"
+    entry = summary["violations"][0]
+    assert entry["invariant"] == expected_invariant
+    # shrinking produced a strictly smaller repro
+    assert entry["ops_after_shrink"] < entry["ops_before_shrink"]
+    # reproduce from the artifact ALONE (fresh load from disk), twice,
+    # byte-identically
+    artifact = load_artifact(entry["artifact"])
+    first, first_match = replay(artifact)
+    second, second_match = replay(artifact)
+    assert first_match and second_match
+    assert first.trace.events == second.trace.events
+    assert any(v.invariant == expected_invariant
+               for v in first.violations)
+    return artifact
+
+
+def test_break_publish_found_shrunk_and_replayed(tmp_path):
+    artifact = _find_shrink_replay(True, False, "exactly_once_publish",
+                                   tmp_path)
+    # the artifact pins the planted bug: replay needs no env flag
+    assert artifact["break_flags"] == {"publish": True, "wal": False}
+
+
+def test_break_wal_found_shrunk_and_replayed(tmp_path):
+    artifact = _find_shrink_replay(False, True, "zero_loss_wal_failover",
+                                   tmp_path)
+    assert artifact["break_flags"] == {"publish": False, "wal": True}
+
+
+def test_break_flags_default_from_env(monkeypatch):
+    monkeypatch.setenv("QW_DST_BREAK_PUBLISH", "1")
+    result = run_scenario(SCENARIOS["smoke"], seed=0)
+    assert any(v.invariant == "exactly_once_publish"
+               for v in result.violations)
+
+
+# --- artifacts + CLI ---------------------------------------------------------
+
+def test_artifact_save_load_round_trip(tmp_path):
+    summary = sweep(SCENARIOS["smoke"], seeds=200, break_wal=True,
+                    artifacts_dir=str(tmp_path))
+    path = summary["violations"][0]["artifact"]
+    artifact = load_artifact(path)
+    clone = tmp_path / "clone.json"
+    save_artifact(artifact, str(clone))
+    assert load_artifact(str(clone)) == artifact
+    # canonical on disk: identical bytes for identical content
+    assert clone.read_text() == canonical_json(artifact) + "\n"
+
+
+def test_load_artifact_rejects_foreign_json(tmp_path):
+    path = tmp_path / "not-artifact.json"
+    path.write_text('{"kind": "something-else"}')
+    with pytest.raises(ValueError):
+        load_artifact(str(path))
+
+
+def test_cli_break_sweep_and_replay_exit_codes(tmp_path, capsys,
+                                               monkeypatch):
+    monkeypatch.setenv("QW_DST_BREAK_WAL", "1")
+    rc = dst_main(["sweep", "--scenario", "smoke", "--seeds", "200",
+                   "--artifacts-dir", str(tmp_path), "--json"])
+    assert rc == 1  # violations found => nonzero
+    out = json.loads(capsys.readouterr().out)
+    path = out["violations"][0]["artifact"]
+    monkeypatch.delenv("QW_DST_BREAK_WAL")
+    rc = dst_main(["replay", path, "--json"])
+    replay_out = json.loads(capsys.readouterr().out)
+    assert rc == 0, replay_out  # reproduced byte-identically => zero
+    assert replay_out["digest_match"] is True
+    assert replay_out["violation_reproduced"] is True

@@ -1,12 +1,22 @@
 """UDP scuttlebutt gossip: discovery, transitivity, liveness over real
-sockets."""
-
-import time
+sockets — on a scaled virtual clock: every sleep/interval-wait routes
+through the process clock seam (`common.clock`), so a `ScaledClock`
+compresses the real waiting 4x while liveness aging still sees the full
+virtual durations."""
 
 import pytest
 
+from quickwit_tpu.common.clock import ScaledClock, monotonic, use_clock
 from quickwit_tpu.cluster.gossip import GossipService
 from quickwit_tpu.cluster.membership import Cluster
+
+
+@pytest.fixture(autouse=True)
+def _scaled_clock():
+    # 0.25 => gossip rounds and poll sleeps run at quarter real time; the
+    # membership/aging math (dead_after, phi) sees unscaled virtual time
+    with use_clock(ScaledClock(factor=0.25)):
+        yield
 
 
 def make_node(node_id, seeds=(), interval=0.05, dead_after=1.0):
@@ -20,11 +30,12 @@ def make_node(node_id, seeds=(), interval=0.05, dead_after=1.0):
 
 
 def wait_until(predicate, timeout=10.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
+    from quickwit_tpu.common.clock import get_clock
+    deadline = monotonic() + timeout
+    while monotonic() < deadline:
         if predicate():
             return True
-        time.sleep(0.05)
+        get_clock().sleep(0.05)
     return predicate()
 
 
@@ -88,7 +99,8 @@ def test_gossip_garbage_datagrams_ignored():
         probe.sendto(b'{"kind": "ack", "deltas": [17, null, "x"]}',
                      ("127.0.0.1", a.port))
         probe.close()
-        time.sleep(0.3)
+        from quickwit_tpu.common.clock import get_clock
+        get_clock().sleep(0.3)
         # the listener survives: a fresh well-formed exchange still works
         cb, b = make_node("jb", seeds=(f"127.0.0.1:{a.port}",))
         b.start()
@@ -161,15 +173,13 @@ def test_phi_accrual_adapts_to_cadence():
     """Phi-accrual: the same absolute silence is suspicious for a fast
     heartbeater and normal for a slow one — a fixed age threshold cannot
     express this (reference: chitchat FailureDetectorConfig)."""
-    import time as _time
-
     from quickwit_tpu.cluster.membership import Cluster, ClusterMember
     cluster = Cluster("self", ("searcher",), dead_after_secs=1000.0)
     fast = ClusterMember("fast", ("searcher",), rest_endpoint="h:1")
     slow = ClusterMember("slow", ("searcher",), rest_endpoint="h:2")
     cluster.join(fast)
     cluster.join(slow)
-    now = _time.monotonic()
+    now = monotonic()
     # synthesize observed cadences: fast @100ms, slow @5s
     fast.intervals = [0.1] * 8
     slow.intervals = [5.0] * 8

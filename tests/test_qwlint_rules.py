@@ -285,6 +285,98 @@ def test_qw005_bounded_labels_ok(tmp_path):
     assert findings == []
 
 
+# --- QW006 ambient-time-and-randomness ---------------------------------------
+
+def test_qw006_flags_time_calls_and_bare_references(tmp_path):
+    findings = lint(tmp_path, """
+        import time
+
+        def wait_for(cond, clock=time.monotonic):
+            start = time.time()
+            time.sleep(0.1)
+            return clock() - start
+    """)
+    assert rules_of(findings) == ["QW006", "QW006", "QW006"]
+
+
+def test_qw006_flags_global_random_and_datetime_now(tmp_path):
+    findings = lint(tmp_path, """
+        import random
+        from datetime import datetime
+
+        def jitter(targets):
+            peer = random.choice(targets)
+            stamp = datetime.now()
+            return peer, stamp
+    """)
+    assert rules_of(findings) == ["QW006", "QW006"]
+
+
+def test_qw006_flags_from_imports(tmp_path):
+    findings = lint(tmp_path, """
+        from time import monotonic, sleep
+        from random import randint
+    """)
+    assert rules_of(findings) == ["QW006", "QW006"]
+
+
+def test_qw006_clock_seam_and_seeded_rng_ok(tmp_path):
+    findings = lint(tmp_path, """
+        import random
+
+        from quickwit_tpu.common.clock import get_clock, get_rng, monotonic
+
+        def wait_for(cond, timeout):
+            deadline = monotonic() + timeout
+            get_clock().sleep(0.01)
+            return monotonic() < deadline
+
+        def pick(targets, seed):
+            rng = random.Random(seed)  # seeded instance: deterministic
+            return rng.choice(targets) if targets else get_rng().random()
+    """)
+    assert findings == []
+
+
+def test_qw006_out_of_scope_module_ignored(tmp_path):
+    # adapters outside the simulation scope may still use ambient time
+    pkg = tmp_path / "quickwit_tpu" / "indexing"
+    pkg.mkdir(parents=True)
+    path = pkg / "kinesis.py"
+    path.write_text(textwrap.dedent("""
+        import time
+
+        def poll():
+            time.sleep(0.01)
+    """))
+    findings = analyze_file(str(path), root=str(tmp_path))
+    assert findings == []
+
+
+def test_qw006_scoped_module_flagged(tmp_path):
+    pkg = tmp_path / "quickwit_tpu" / "cluster"
+    pkg.mkdir(parents=True)
+    path = pkg / "gossip.py"
+    path.write_text(textwrap.dedent("""
+        import time
+
+        def tick():
+            return time.monotonic()
+    """))
+    findings = analyze_file(str(path), root=str(tmp_path))
+    assert rules_of(findings) == ["QW006"]
+
+
+def test_qw006_suppression(tmp_path):
+    findings = lint(tmp_path, """
+        import time
+
+        def bench():
+            return time.perf_counter()  # qwlint: disable=QW006 - bench only
+    """)
+    assert findings == []
+
+
 # --- suppression scopes ------------------------------------------------------
 
 def test_suppression_same_line(tmp_path):

@@ -396,8 +396,102 @@ class MetricsHygiene:
         return findings
 
 
+# --- QW006 ambient-time-and-randomness ---------------------------------------
+
+# Modules the DST harness simulates: everything here must read time and
+# randomness through quickwit_tpu/common/clock.py, or a seeded run is no
+# longer deterministic (and scenario hours cost wall-clock hours). The
+# clock seam itself (common/clock.py) is intentionally NOT scoped — it is
+# the one place ambient time is allowed. External-source adapters
+# (kinesis/aws_json/fake_sqs) and the sql metastore stay unscoped until
+# they grow simulation coverage.
+_SIM_SCOPED_MODULES = (
+    "quickwit_tpu/common/actors.py",
+    "quickwit_tpu/common/deadline.py",
+    "quickwit_tpu/common/faults.py",
+    "quickwit_tpu/common/tower.py",
+    "quickwit_tpu/cluster/",
+    "quickwit_tpu/control_plane/",
+    "quickwit_tpu/dst/",
+    "quickwit_tpu/indexing/cooperative.py",
+    "quickwit_tpu/indexing/merge.py",
+    "quickwit_tpu/indexing/pipeline.py",
+    "quickwit_tpu/indexing/sources.py",
+    "quickwit_tpu/ingest/ingester.py",
+    "quickwit_tpu/ingest/router.py",
+    "quickwit_tpu/ingest/wal.py",
+    "quickwit_tpu/metastore/file_backed.py",
+    "quickwit_tpu/models/index_metadata.py",
+    "quickwit_tpu/models/split_metadata.py",
+    "quickwit_tpu/offload/",
+    "quickwit_tpu/tenancy/overload.py",
+)
+
+_TIME_ATTRS = {"time", "monotonic", "sleep", "time_ns", "monotonic_ns",
+               "perf_counter", "perf_counter_ns"}
+# module-level random.* draws share one unseedable global stream;
+# random.Random(seed) / random.SystemRandom() construction is fine
+_RANDOM_ATTRS = {"random", "randint", "randrange", "randbytes", "choice",
+                 "choices", "shuffle", "sample", "uniform", "gauss",
+                 "getrandbits", "normalvariate", "expovariate",
+                 "triangular", "betavariate", "paretovariate",
+                 "vonmisesvariate", "weibullvariate", "lognormvariate"}
+_DATETIME_DOTTED = {"datetime.now", "datetime.utcnow",
+                    "datetime.datetime.now", "datetime.datetime.utcnow",
+                    "date.today", "datetime.date.today"}
+
+
+class AmbientTimeAndRandomness:
+    id = "QW006"
+    title = "ambient-time-and-randomness"
+
+    def _message(self, what: str) -> str:
+        return (f"direct {what} in a simulation-scoped module: the DST "
+                "harness cannot virtualize it, so seeded runs stop being "
+                "deterministic and scenario hours cost wall-clock hours — "
+                "route through quickwit_tpu.common.clock (get_clock(), "
+                "monotonic()/wall_time()/sleep(), get_rng())")
+
+    def check(self, ctx: FileContext) -> None:
+        if not ctx.in_package_scope(_SIM_SCOPED_MODULES):
+            return
+        if ctx.relpath.endswith("common/clock.py"):
+            return  # the seam itself: ambient time is its job
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.level == 0:
+                if node.module == "time":
+                    bad = sorted(a.name for a in node.names
+                                 if a.name in _TIME_ATTRS)
+                    if bad:
+                        ctx.add(self.id, node, self._message(
+                            f"`from time import {', '.join(bad)}`"))
+                elif node.module == "random":
+                    bad = sorted(a.name for a in node.names
+                                 if a.name in _RANDOM_ATTRS)
+                    if bad:
+                        ctx.add(self.id, node, self._message(
+                            f"`from random import {', '.join(bad)}`"))
+                continue
+            if not isinstance(node, ast.Attribute):
+                continue
+            dotted = dotted_name(node)
+            if dotted in _DATETIME_DOTTED:
+                ctx.add(self.id, node, self._message(f"{dotted}()"))
+            elif (isinstance(node.value, ast.Name)
+                    and node.value.id == "time"
+                    and node.attr in _TIME_ATTRS):
+                # a bare reference (e.g. `clock=time.monotonic` default)
+                # is as ambient as a call
+                ctx.add(self.id, node, self._message(f"time.{node.attr}"))
+            elif (isinstance(node.value, ast.Name)
+                    and node.value.id == "random"
+                    and node.attr in _RANDOM_ATTRS):
+                ctx.add(self.id, node,
+                        self._message(f"random.{node.attr}"))
+
+
 RULES = [HiddenHostReadback(), RecompilationHazard(),
          AmbientContextPropagation(), SwallowedControlFlow(),
-         MetricsHygiene()]
+         MetricsHygiene(), AmbientTimeAndRandomness()]
 
 RULE_DOCS = {rule.id: rule.title for rule in RULES}
