@@ -2,7 +2,11 @@
 
 - ``sweep --scenario mixed --seeds 200 [--artifacts-dir DIR] [--json]``
   explores seeds; exit code 1 if any seed violated an invariant (its
-  shrunk replay artifact is persisted / printed).
+  shrunk replay artifact is persisted / printed). ``--conformance``
+  additionally replays every trace against the qwmc checkpoint model
+  (``tools.qwmc.conformance``): a trace that is not a behavior of the
+  exhaustively-checked model fails the sweep even if no runtime
+  invariant fired.
 - ``replay path/to/artifact.json [--json]`` re-executes an artifact and
   exits 1 unless the trace digest matches byte-for-byte AND the recorded
   violation fires again.
@@ -26,13 +30,22 @@ def _cmd_sweep(args: argparse.Namespace) -> int:
     summary = sweep(scenario, seeds=args.seeds, start_seed=args.start_seed,
                     artifacts_dir=args.artifacts_dir,
                     shrink_violations=not args.no_shrink,
-                    stop_on_first=not args.keep_going)
+                    stop_on_first=not args.keep_going,
+                    conformance=args.conformance)
     if args.json:
         print(json.dumps(summary, sort_keys=True, indent=2))
     else:
-        print(f"scenario={summary['scenario']} seeds={summary['seeds']} "
-              f"passed={len(summary['passed'])} "
-              f"violations={len(summary['violations'])}")
+        line = (f"scenario={summary['scenario']} seeds={summary['seeds']} "
+                f"passed={len(summary['passed'])} "
+                f"violations={len(summary['violations'])}")
+        if "nonconforming" in summary:
+            line += f" nonconforming={len(summary['nonconforming'])}"
+        print(line)
+        for entry in summary.get("nonconforming", []):
+            for v in entry["report"]["violations"]:
+                print(f"  seed {entry['seed']}: trace not a model "
+                      f"behavior — {v['invariant']} on {v['index']}: "
+                      f"{v['detail']}")
         for entry in summary["violations"]:
             line = (f"  seed {entry['seed']}: {entry['invariant']}")
             if "ops_after_shrink" in entry:
@@ -109,6 +122,9 @@ def main(argv: list[str] | None = None) -> int:
                          help="persist violations without shrinking")
     p_sweep.add_argument("--keep-going", action="store_true",
                          help="continue past the first violating seed")
+    p_sweep.add_argument("--conformance", action="store_true",
+                         help="also replay every trace against the qwmc "
+                              "checkpoint model (refinement check)")
     p_sweep.add_argument("--json", action="store_true")
     p_sweep.set_defaults(fn=_cmd_sweep)
 

@@ -40,7 +40,7 @@ from ..indexing.merge import MergeExecutor, StableLogMergePolicy
 from ..indexing.pipeline import split_file_path
 from ..indexing.sources import IngestSource
 from ..ingest import Ingester, IngestRouter
-from ..ingest.ingester import ReplicationGap
+from ..ingest.ingester import ReplicationGap, shard_queue_id
 from ..ingest.router import INGEST_V2_SOURCE_ID
 from ..metastore import FileBackedMetastore, ListSplitsQuery
 from ..metastore.base import MetastoreError
@@ -122,6 +122,10 @@ class SimCluster:
         # acked ledger: doc `n`s whose ingest the cluster ACKNOWLEDGED
         # (persist + replication chain succeeded) — the zero-loss floor
         self.acked: dict[str, list[int]] = {i: [] for i in scenario.indexes}
+        # skip-cache over the durable chain registry (metastore
+        # shard_chains): queue_id -> (leader, follower) last recorded, so
+        # the per-batch replicate hook only writes on chain changes
+        self._chain_cache: dict[str, tuple[str, Optional[str]]] = {}
 
         bootstrap = FileBackedMetastore(self.meta_storage,
                                         polling_interval_secs=None)
@@ -191,6 +195,17 @@ class SimCluster:
             if self.network.is_partitioned(follower_id):
                 raise ConnectionError(
                     f"simnet: replica {follower_id} unreachable")
+            queue_id = shard_queue_id(index_uid, source_id, shard_id)
+            if self._chain_cache.get(queue_id) != (leader_id, follower_id):
+                # durable registration BEFORE the first batch reaches a
+                # new follower: failover may only promote the REGISTERED
+                # follower, so the record must exist before that follower
+                # can hold acked data (qwmc's stale-replica-promotion
+                # counterexample is exactly an unregistered-copy takeover)
+                self.nodes[leader_id].metastore.record_shard_chain(
+                    index_uid, source_id, shard_id,
+                    leader=leader_id, follower=follower_id)
+                self._chain_cache[queue_id] = (leader_id, follower_id)
             if self.break_wal:
                 # QW_DST_BREAK_WAL: the link silently truncates each batch
                 # — the acked tail exists only on the leader, so a leader
@@ -206,8 +221,19 @@ class SimCluster:
                 leader_shard = self.nodes[leader_id].ingester.shard(
                     index_uid, source_id, shard_id)
                 records = leader_shard.log.read_from(gap.have, 1_000_000)
+                if not records:
+                    return
+                start = records[0][0]
+                if start > gap.have:
+                    # the leader's retained WAL starts past the follower's
+                    # position (truncated behind the published checkpoint):
+                    # restart the replica log at what the leader still
+                    # holds — the checkpoint covers everything below
+                    # (serve/node.py's reset= backfill path)
+                    follower.replica_reset(index_uid, source_id, shard_id,
+                                           start)
                 follower.replica_persist(index_uid, source_id, shard_id,
-                                         gap.have,
+                                         start,
                                          [payload for _, payload in records])
         return replicate
 
@@ -268,22 +294,110 @@ class SimCluster:
         # a fresh metastore instance starts cold (must re-poll state)
         self.nodes[node_id] = self._build_node(node_id)
         self.network.heal(node_id)
+        demoted = self._reconcile_rejoined(node_id)
         shards = sorted(
             s.shard_id
             for s in self.nodes[node_id].ingester.list_shards(
                 include_replicas=True))
-        return {"restarted": node_id, "recovered_shards": shards}
+        result = {"restarted": node_id, "recovered_shards": shards}
+        if demoted:
+            result["demoted"] = demoted
+        return result
+
+    def _reconcile_rejoined(self, node_id: str) -> list[str]:
+        """A rejoined node recovers its shards with the role they had when
+        it crashed — a stale LEADER role when another copy was promoted
+        meanwhile (qwmc's stale-leader-rejoin counterexample: the
+        split-brain re-uses published positions and loses an acked
+        record). The durable chain registry is the truth: demote the local
+        copy, resetting its WAL at the published checkpoint — the
+        registered chain holds every acked record, so nothing is lost."""
+        node = self.nodes[node_id]
+        node.metastore.refresh()  # cold start must not serve a stale view
+        demoted = []
+        for shard in node.ingester.list_shards(include_replicas=False):
+            chain = node.metastore.shard_chain(
+                shard.index_uid, shard.source_id, shard.shard_id)
+            if chain is None or chain.get("leader") == node_id:
+                continue
+            queue_id = shard_queue_id(shard.index_uid, shard.source_id,
+                                      shard.shard_id)
+            if node.ingester.demote_to_replica(
+                    queue_id, self._published_floor(node, shard)):
+                demoted.append(queue_id)
+        return sorted(demoted)
+
+    def _published_floor(self, node: SimNode, shard) -> int:
+        """Published checkpoint for the shard (exclusive end position):
+        everything below it is in published splits."""
+        checkpoint = node.metastore.source_checkpoint(shard.index_uid,
+                                                      shard.source_id)
+        position = checkpoint.position_for(shard.shard_id)
+        return 0 if position == BEGINNING else int(position)
+
+    def _checkpoint_total(self, node: SimNode, uid: str) -> int:
+        """Sum of the source checkpoint's partition positions (each one an
+        EXCLUSIVE end = records published from that shard) — the concrete
+        image of the qwmc checkpoint model's `ckpt` counter, recorded in
+        drain summaries so `tools.qwmc.conformance` can replay the trace
+        against the abstract transition relation."""
+        checkpoint = node.metastore.source_checkpoint(uid,
+                                                      INGEST_V2_SOURCE_ID)
+        return sum(int(p) for p in checkpoint.positions.values()
+                   if p != BEGINNING)
 
     def promote_orphans(self) -> list[str]:
         """Promote replica shards whose leader node is dead (the reference's
-        AdviseResetShards failover) on every surviving node."""
+        AdviseResetShards failover) on every surviving node.
+
+        The durable chain registry gates the takeover: the current leader
+        is whoever the registry records (falling back to the shard-id
+        prefix for never-replicated shards), and only the REGISTERED
+        follower is eligible — a copy that merely looks healthy may have
+        crashed out of the chain and be missing acked batches (qwmc's
+        stale-replica-promotion counterexample). A promoted log behind the
+        published checkpoint forward-resets to it, or fresh appends would
+        collide with already-consumed positions (behind-checkpoint
+        counterexample)."""
         alive = set(self.alive_nodes())
         promoted = []
         for node_id in self.alive_nodes():
-            ingester = self.nodes[node_id].ingester
-            for queue_id, shard in ingester.replica_shards():
-                leader = shard.shard_id.rsplit("-shard-", 1)[0]
-                if leader not in alive and ingester.promote_replica(queue_id):
+            node = self.nodes[node_id]
+            refreshed = False
+            for queue_id, shard in node.ingester.replica_shards():
+                if not refreshed:
+                    # promotion decisions must read the registry and the
+                    # checkpoint fresh, not from the polling cache
+                    node.metastore.refresh()
+                    refreshed = True
+                chain = node.metastore.shard_chain(
+                    shard.index_uid, shard.source_id, shard.shard_id)
+                if chain is not None and chain.get("leader") == node_id:
+                    # a crash between the registry write and the role flip
+                    # left the record already naming us: finish the
+                    # promotion (idempotent — the registry is the truth)
+                    if node.ingester.promote_replica(
+                            queue_id,
+                            min_position=self._published_floor(node, shard)):
+                        promoted.append(queue_id)
+                    continue
+                leader = (chain["leader"] if chain is not None
+                          else shard.shard_id.rsplit("-shard-", 1)[0])
+                if leader in alive:
+                    continue
+                if chain is not None and chain.get("follower") != node_id:
+                    continue
+                # registry BEFORE the role flip: if we crash in between,
+                # the next failover round finds the record naming us and
+                # finishes the flip (branch above) instead of demoting a
+                # copy that holds acked data back to the checkpoint
+                node.metastore.record_shard_chain(
+                    shard.index_uid, shard.source_id, shard.shard_id,
+                    leader=node_id, follower=None)
+                self._chain_cache[queue_id] = (node_id, None)
+                if node.ingester.promote_replica(
+                        queue_id,
+                        min_position=self._published_floor(node, shard)):
                     promoted.append(queue_id)
         return sorted(promoted)
 
@@ -332,7 +446,8 @@ class SimCluster:
             except IncompatibleCheckpointDelta:
                 # another node already published these positions (post-
                 # failover double drain): exactly-once enforcement worked
-                return {"skipped": "checkpoint"}
+                return {"skipped": "checkpoint",
+                        "checkpoint": self._checkpoint_total(node, uid)}
             except MetastoreError as exc:
                 if attempt or getattr(exc, "kind", "") != "failed_precondition":
                     return {"error": "metastore"}
@@ -350,7 +465,8 @@ class SimCluster:
                 node.ingester.truncate(uid, INGEST_V2_SOURCE_ID,
                                        shard.shard_id, int(position))
         return {"indexed": counters.num_docs_processed,
-                "splits": counters.num_splits_published}
+                "splits": counters.num_splits_published,
+                "checkpoint": self._checkpoint_total(node, uid)}
 
     def _drain_break_publish(self, node: SimNode,
                              index_id: str) -> dict[str, Any]:
@@ -368,7 +484,8 @@ class SimCluster:
                                               max_records=1_000_000):
                 docs.append(doc)
         if not docs:
-            return {"indexed": 0, "splits": 0}
+            return {"indexed": 0, "splits": 0,
+                    "checkpoint": self._checkpoint_total(node, uid)}
         params = PipelineParams(
             index_uid=uid, source_id=INGEST_V2_SOURCE_ID,
             node_id=node.node_id,
@@ -378,8 +495,11 @@ class SimCluster:
         pipeline = IndexingPipeline(params, SIM_MAPPER, source,
                                     node.metastore, storage)
         counters = pipeline.run_to_completion()
+        # the checkpoint never advances here (fresh partition each pass):
+        # exactly the divergence the conformance check is built to catch
         return {"indexed": counters.num_docs_processed,
-                "splits": counters.num_splits_published}
+                "splits": counters.num_splits_published,
+                "checkpoint": self._checkpoint_total(node, uid)}
 
     def search(self, index_id: str, max_hits: int,
                repeat: int = 2) -> list[dict[str, Any]]:

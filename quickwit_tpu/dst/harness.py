@@ -193,21 +193,41 @@ def sweep(scenario: Scenario, seeds: int, start_seed: int = 0,
           break_publish: Optional[bool] = None,
           break_wal: Optional[bool] = None,
           shrink_violations: bool = True,
-          stop_on_first: bool = True) -> dict[str, Any]:
+          stop_on_first: bool = True,
+          conformance: bool = False) -> dict[str, Any]:
     """Run `seeds` consecutive seeds; shrink + persist an artifact for each
-    violating seed. Returns a JSON-safe summary (the CLI prints it)."""
+    violating seed. Returns a JSON-safe summary (the CLI prints it).
+
+    With `conformance=True` every run's trace is additionally replayed
+    against the qwmc checkpoint model's abstract transition relation
+    (`tools.qwmc.conformance.check_trace`) — a second, independent oracle:
+    the runtime invariants compare against the acked ledger, the
+    conformance check against what the exhaustively-verified model permits,
+    so a planted bug must fall to both."""
     if break_publish is None:
         break_publish = _env_flag("QW_DST_BREAK_PUBLISH")
     if break_wal is None:
         break_wal = _env_flag("QW_DST_BREAK_WAL")
+    check_trace = None
+    if conformance:
+        # lazy: tools/ sits beside quickwit_tpu/ at the repo root; the
+        # DST layer must stay importable without it (wheel installs)
+        from tools.qwmc.conformance import check_trace
     summary: dict[str, Any] = {
         "scenario": scenario.name, "seeds": seeds, "start_seed": start_seed,
         "passed": [], "violations": [],
     }
+    if conformance:
+        summary["nonconforming"] = []
     for seed in range(start_seed, start_seed + seeds):
         result = run_scenario(scenario, seed,
                               break_publish=break_publish,
                               break_wal=break_wal)
+        if check_trace is not None:
+            report = check_trace(result.trace.events)
+            if not report["conforms"]:
+                summary["nonconforming"].append(
+                    {"seed": seed, "report": report})
         if result.ok:
             summary["passed"].append(seed)
             continue
@@ -246,7 +266,8 @@ def sweep(scenario: Scenario, seeds: int, start_seed: int = 0,
         summary["violations"].append(entry)
         if stop_on_first:
             break
-    summary["ok"] = not summary["violations"]
+    summary["ok"] = not summary["violations"] \
+        and not summary.get("nonconforming")
     return summary
 
 

@@ -22,6 +22,15 @@ def canonical_json(value: Any) -> str:
     return json.dumps(value, sort_keys=True, separators=(",", ":"))
 
 
+def blake2b_digest(value: Any) -> str:
+    """THE digest of the artifact/trace schema: blake2b-128 over canonical
+    JSON. Every digest field in a DST replay artifact, a qwmc counterexample
+    artifact, or a run trace is computed by this one function, so the two
+    artifact families cannot drift apart byte-format-wise."""
+    return hashlib.blake2b(canonical_json(value).encode(),
+                           digest_size=16).hexdigest()
+
+
 class Trace:
     def __init__(self) -> None:
         self.events: list[dict[str, Any]] = []
@@ -35,8 +44,7 @@ class Trace:
         self.events.append(json.loads(canonical_json(event)))
 
     def digest(self) -> str:
-        return hashlib.blake2b(canonical_json(self.events).encode(),
-                               digest_size=16).hexdigest()
+        return blake2b_digest(self.events)
 
     def __len__(self) -> int:
         return len(self.events)

@@ -53,6 +53,17 @@ class Shard:
     # cumulative ingested payload bytes; the scaling arbiter turns deltas
     # of this into MiB/s (reference: per-shard ingestion-rate gossip)
     bytes_written: int = 0
+    # positions below this are replication-chain committed and safe to
+    # serve to fetch streams; maintained at every leadership event (shard
+    # creation, recovery, promotion — where the full WAL is the
+    # at-least-once committed floor — and each successful persist).
+    # -1 = unset (replica shards; fetch falls back to the log head).
+    # Without the clamp a fetch racing the persist critical section could
+    # drain an appended-but-unreplicated tail that a failed chain then
+    # rolls back, re-using the published positions for different
+    # documents — the qwmc replication model's publish_from watermark
+    # (tools/qwmc/models.py).
+    committed_position: int = -1
 
 
 def shard_queue_id(index_uid: str, source_id: str, shard_id: str) -> str:
@@ -101,11 +112,16 @@ class Ingester:
                     if os.path.exists(role_path):
                         with open(role_path) as f:
                             role = f.read().strip() or "leader"
-                    self._shards[queue_id] = Shard(
+                    shard = Shard(
                         index_uid=index_uid, source_id=source_id,
                         shard_id=shard_id, role=role,
                         log=RecordLog(shard_dir, fsync=self.fsync,
                                       fault_injector=self.fault_injector))
+                    if role == "leader":
+                        # recovery commits the durable tail (at-least-once:
+                        # the chain may or may not have acked it)
+                        shard.committed_position = shard.log.next_position
+                    self._shards[queue_id] = shard
 
     # --- shard lifecycle ---------------------------------------------------
     def open_shard(self, index_uid: str, source_id: str, shard_id: str,
@@ -122,6 +138,8 @@ class Ingester:
                                   fault_injector=self.fault_injector))
                 if role != "leader":
                     self._write_role(shard_dir, role)
+                else:
+                    shard.committed_position = shard.log.next_position
                 self._shards[queue_id] = shard
             return shard
 
@@ -131,17 +149,53 @@ class Ingester:
         with open(os.path.join(shard_dir, "_role"), "w") as f:
             f.write(role)
 
-    def promote_replica(self, queue_id: str) -> bool:
+    def promote_replica(self, queue_id: str,
+                        min_position: Optional[int] = None) -> bool:
         """Replica → leader (the leader ingester died; this copy takes over
         draining — reference: AdviseResetShards / shard re-open,
         ingest_controller.rs:204). Checkpoint continuity holds because the
-        replica hosts the SAME shard id at the same WAL positions."""
+        replica hosts the SAME shard id at the same WAL positions.
+
+        `min_position` is the published checkpoint: a promoted log whose
+        head is BEHIND it forward-resets to the checkpoint, or the new
+        leader would hand already-consumed positions to fresh appends
+        (qwmc's behind-checkpoint promotion counterexample — the old
+        leader's recovery-committed tail published past this copy's head).
+        Everything dropped by the reset sits below the checkpoint, hence
+        is already published."""
         with self._lock:
             shard = self._shards.get(queue_id)
             if shard is None or shard.role == "leader":
                 return False
+            if (min_position is not None
+                    and shard.log.next_position < min_position):
+                shard.log.reset_to(min_position)
+                shard.publish_position = max(shard.publish_position,
+                                             min_position)
             shard.role = "leader"
+            # everything a replica holds came through the chain: committed
+            shard.committed_position = shard.log.next_position
             self._write_role(os.path.join(self.wal_dir, queue_id), "leader")
+            return True
+
+    def demote_to_replica(self, queue_id: str, position: int) -> bool:
+        """Leader → replica, WAL reset at `position` (the published
+        checkpoint): a node that crashed and rejoined after another copy
+        was promoted still recovers its shard with the old leader role —
+        qwmc's stale-leader-rejoin counterexample shows the split-brain
+        re-uses published positions and loses an acked record. The
+        registered chain (metastore.shard_chain) holds every acked
+        record, so the stale content is redundant; keeping it would
+        collide with positions the promoted leader hands out."""
+        with self._lock:
+            shard = self._shards.get(queue_id)
+            if shard is None or shard.role != "leader":
+                return False
+            shard.role = "replica"
+            self._write_role(os.path.join(self.wal_dir, queue_id), "replica")
+            shard.log.reset_to(position)
+            shard.publish_position = max(shard.publish_position, position)
+            shard.committed_position = -1
             return True
 
     def replica_shards(self) -> list[tuple[str, Shard]]:
@@ -194,6 +248,7 @@ class Ingester:
                     shard.log.rollback_to(state)
                     raise
             shard.bytes_written += sum(len(p) for p in payloads)
+            shard.committed_position = shard.log.next_position
         return first, last
 
     def replica_persist(self, index_uid: str, source_id: str, shard_id: str,
@@ -248,8 +303,13 @@ class Ingester:
         shard = self.shard(index_uid, source_id, shard_id)
         if shard is None:
             return []
-        return [(pos, json.loads(payload))
-                for pos, payload in shard.log.read_from(from_position, max_records)]
+        records = shard.log.read_from(from_position, max_records)
+        if shard.role == "leader" and shard.committed_position >= 0:
+            # never serve past the replication-committed watermark (see
+            # Shard.committed_position)
+            records = [(pos, payload) for pos, payload in records
+                       if pos < shard.committed_position]
+        return [(pos, json.loads(payload)) for pos, payload in records]
 
     def truncate(self, index_uid: str, source_id: str, shard_id: str,
                  up_to_position: int) -> None:

@@ -103,6 +103,26 @@ class Metastore:
     def reset_source_checkpoint(self, index_uid: str, source_id: str) -> None:
         raise NotImplementedError
 
+    # --- replication chain registry ----------------------------------------
+    # Durable record of each shard's replication chain: which node leads the
+    # shard and which node is the registered follower. The leader writes the
+    # record BEFORE replicating the first batch to a new follower, and a
+    # promotion rewrites it; failover may then promote ONLY the registered
+    # follower — a replica copy that merely looks healthy (e.g. a crashed
+    # follower that rejoined with a stale WAL) is not eligible. The qwmc
+    # replication model (tools/qwmc/models.py) checks exhaustively that this
+    # registry discipline is what makes promotion lose no acked record.
+    def record_shard_chain(self, index_uid: str, source_id: str,
+                           shard_id: str, leader: str,
+                           follower: Optional[str]) -> None:
+        raise NotImplementedError
+
+    def shard_chain(self, index_uid: str, source_id: str,
+                    shard_id: str) -> Optional[dict]:
+        """Returns ``{"leader": node_id, "follower": node_id | None}`` or
+        None when the shard never formed a replication chain."""
+        raise NotImplementedError
+
     # --- splits ------------------------------------------------------------
     def stage_splits(self, index_uid: str, split_metadatas: list[SplitMetadata]) -> None:
         raise NotImplementedError

@@ -42,6 +42,8 @@ class _IndexState:
         self.metadata = metadata
         self.splits: dict[str, Split] = {}
         self.checkpoints: dict[str, SourceCheckpoint] = {}
+        # source_id -> shard_id -> {"leader": node, "follower": node|None}
+        self.shard_chains: dict[str, dict[str, dict]] = {}
         self.delete_tasks: list[dict] = []
         self.last_delete_opstamp = 0
         self.version = 0
@@ -53,6 +55,7 @@ class _IndexState:
             "metadata": self.metadata.to_dict(),
             "splits": [s.to_dict() for s in self.splits.values()],
             "checkpoints": {sid: cp.to_dict() for sid, cp in self.checkpoints.items()},
+            "shard_chains": self.shard_chains,
             "delete_tasks": self.delete_tasks,
             "last_delete_opstamp": self.last_delete_opstamp,
         }
@@ -68,6 +71,7 @@ class _IndexState:
             sid: SourceCheckpoint.from_dict(cp)
             for sid, cp in d.get("checkpoints", {}).items()
         }
+        state.shard_chains = d.get("shard_chains", {})
         state.delete_tasks = d.get("delete_tasks", [])
         state.last_delete_opstamp = d.get("last_delete_opstamp", 0)
         return state
@@ -327,6 +331,34 @@ class FileBackedMetastore(Metastore):
             state = self._state_by_uid(index_uid)
             return SourceCheckpoint.from_dict(
                 state.checkpoints.get(source_id, SourceCheckpoint()).to_dict())
+
+    # --- replication chain registry ------------------------------------------
+    def record_shard_chain(self, index_uid: str, source_id: str,
+                           shard_id: str, leader: str,
+                           follower: Optional[str]) -> None:
+        # Chain changes are rare (follower re-pick, promotion) but must win
+        # against a concurrently-drained checkpoint CAS: retry once through
+        # a cache drop, like a node's next poll tick would.
+        record = {"leader": leader, "follower": follower}
+        for attempt in (0, 1):
+            with self._lock:
+                try:
+                    state = self._state_by_uid(index_uid)
+                    state.shard_chains.setdefault(source_id, {})[shard_id] = \
+                        dict(record)
+                    self._save_state(state)
+                    return
+                except MetastoreError as exc:
+                    if attempt or exc.kind != "failed_precondition":
+                        raise
+                    self.refresh()
+
+    def shard_chain(self, index_uid: str, source_id: str,
+                    shard_id: str) -> Optional[dict]:
+        with self._lock:
+            state = self._state_by_uid(index_uid)
+            record = state.shard_chains.get(source_id, {}).get(shard_id)
+            return dict(record) if record is not None else None
 
     # --- splits --------------------------------------------------------------
     def stage_splits(self, index_uid: str, split_metadatas: list[SplitMetadata]) -> None:

@@ -52,6 +52,14 @@ CREATE TABLE IF NOT EXISTS checkpoints (
     checkpoint TEXT NOT NULL,
     PRIMARY KEY (index_uid, source_id)
 );
+CREATE TABLE IF NOT EXISTS shard_chains (
+    index_uid TEXT NOT NULL,
+    source_id TEXT NOT NULL,
+    shard_id  TEXT NOT NULL,
+    leader    TEXT NOT NULL,
+    follower  TEXT,
+    PRIMARY KEY (index_uid, source_id, shard_id)
+);
 CREATE TABLE IF NOT EXISTS delete_tasks (
     index_uid TEXT NOT NULL,
     opstamp   INTEGER NOT NULL,
@@ -153,7 +161,8 @@ class SqlMetastore(Metastore):
             # BEGIN IMMEDIATE holds the write lock across the whole
             # check-then-act even between processes
             self._index_row_by_uid(index_uid)
-            for table in ("splits", "checkpoints", "delete_tasks"):
+            for table in ("splits", "checkpoints", "shard_chains",
+                          "delete_tasks"):
                 self._conn.execute(
                     f"DELETE FROM {table} WHERE index_uid = ?",  # noqa: S608
                     (index_uid,))
@@ -250,6 +259,28 @@ class SqlMetastore(Metastore):
             if row is None:
                 return SourceCheckpoint()
             return SourceCheckpoint.from_dict(json.loads(row[0]))
+
+    # --- replication chain registry -----------------------------------
+    def record_shard_chain(self, index_uid: str, source_id: str,
+                           shard_id: str, leader: str,
+                           follower: Optional[str]) -> None:
+        with self._tx(), self._txn():
+            self._index_row_by_uid(index_uid)
+            self._conn.execute(
+                "INSERT OR REPLACE INTO shard_chains VALUES (?, ?, ?, ?, ?)",
+                (index_uid, source_id, shard_id, leader, follower))
+
+    def shard_chain(self, index_uid: str, source_id: str,
+                    shard_id: str) -> Optional[dict]:
+        with self._tx():
+            self._index_row_by_uid(index_uid)
+            row = self._conn.execute(
+                "SELECT leader, follower FROM shard_chains WHERE "
+                "index_uid = ? AND source_id = ? AND shard_id = ?",
+                (index_uid, source_id, shard_id)).fetchone()
+            if row is None:
+                return None
+            return {"leader": row[0], "follower": row[1]}
 
     # --- splits -------------------------------------------------------
     def stage_splits(self, index_uid: str, split_metadatas) -> None:

@@ -490,6 +490,10 @@ def _enqueue_batch(ex, arrays, scalars, nd, mesh):
     with _MESH_DISPATCH_LOCK:
         out = ex(arrays, scalars, nd)
         if jax.default_backend() == "cpu":
+            # qwlint: disable-next-line=QW007 — the block IS the point: with
+            # no ordered streams on the CPU host platform, releasing the lock
+            # before the program completes re-opens the collective-rendezvous
+            # interleave deadlock this lock exists to prevent (see above)
             jax.block_until_ready(out)
         return out
 

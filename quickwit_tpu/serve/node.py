@@ -780,6 +780,22 @@ class Node:
         ordered = sort_by_rendezvous_hash(queue_id,
                                           [m.node_id for m in peers])
         follower = next(m for m in peers if m.node_id == ordered[0])
+        recorded = getattr(self, "_recorded_chains", None)
+        if recorded is None:
+            recorded = self._recorded_chains = {}
+        chain = (self.config.node_id, follower.node_id)
+        if recorded.get(queue_id) != chain:
+            # durable chain registration BEFORE the first batch reaches a
+            # new follower: failover promotes only the REGISTERED follower
+            # (a rejoined copy with a stale WAL is not eligible), so the
+            # record must exist before this follower can hold acked data.
+            # A registry write failure fails the persist — acking a batch
+            # on an unregistered chain would void the promotion-safety
+            # argument (tools/qwmc replication model).
+            self.metastore.record_shard_chain(
+                index_uid, source_id, shard_id,
+                leader=self.config.node_id, follower=follower.node_id)
+            recorded[queue_id] = chain
         client = self.clients.get(follower.node_id)
         if client is None:
             # same construction _on_cluster_change would use (gRPC plane
@@ -849,11 +865,42 @@ class Node:
             "index_uid": index_uid, "source_id": source_id,
             "shard_id": shard_id, "position": position})
 
+    def _shard_chain(self, shard) -> Optional[dict]:
+        """Registered replication chain for the shard, or None when it
+        never formed one (or the index is gone)."""
+        from ..metastore.base import MetastoreError
+        try:
+            return self.metastore.shard_chain(shard.index_uid,
+                                              shard.source_id,
+                                              shard.shard_id)
+        except MetastoreError:
+            return None
+
+    def _published_floor(self, shard) -> int:
+        """Published checkpoint for the shard (exclusive end): everything
+        below it is already in published splits."""
+        from ..metastore.base import MetastoreError
+        from ..metastore.checkpoint import BEGINNING
+        try:
+            checkpoint = self.metastore.source_checkpoint(shard.index_uid,
+                                                          shard.source_id)
+        except MetastoreError:
+            return 0
+        position = checkpoint.position_for(shard.shard_id)
+        return 0 if position == BEGINNING else int(position)
+
     def promote_orphaned_replicas(self, grace_secs: float = 30.0) -> list[str]:
         """Replica shards whose leader node is no longer a live cluster
         member get promoted and drained from here (the reference's
-        AdviseResetShards / shard re-open on ingester death). Shard ids are
-        node-prefixed ("{node_id}-shard-NN"), which names the leader.
+        AdviseResetShards / shard re-open on ingester death). The durable
+        chain registry (metastore.shard_chain) names the current leader —
+        shard-id prefixes ("{node_id}-shard-NN") only seed it for shards
+        that never replicated — and gates the takeover: only the
+        REGISTERED follower is eligible, because a copy that merely looks
+        healthy may have crashed out of the chain and be missing acked
+        batches (qwmc's stale-replica-promotion counterexample). A
+        promoted log behind the published checkpoint forward-resets to it,
+        or fresh appends would land on already-consumed positions.
 
         Promotion is irreversible (the old leader's persists are refused
         after it), so it only fires after the leader has been CONTINUOUSLY
@@ -866,20 +913,73 @@ class Node:
             dead_since = self._leader_dead_since = {}
         now = _clock_monotonic()
         promoted = []
+        refreshed = False
         for queue_id, shard in self.ingester.replica_shards():
-            leader_node = shard.shard_id.rsplit("-shard-", 1)[0]
+            chain = self._shard_chain(shard)
+            if chain is not None and chain.get("leader") == self.config.node_id:
+                # a crash between the registry write and the role flip left
+                # the record already naming this node: finish the promotion
+                if self.ingester.promote_replica(
+                        queue_id, min_position=self._published_floor(shard)):
+                    promoted.append(shard.shard_id)
+                continue
+            leader_node = (chain["leader"] if chain is not None
+                           else shard.shard_id.rsplit("-shard-", 1)[0])
             if leader_node in alive:
                 dead_since.pop(leader_node, None)
                 continue
             first_seen_dead = dead_since.setdefault(leader_node, now)
             if now - first_seen_dead < grace_secs:
                 continue
-            if self.ingester.promote_replica(queue_id):
+            if not refreshed:
+                # the takeover decision must read the registry and the
+                # checkpoint fresh, not from the polling cache
+                self.metastore.refresh()
+                refreshed = True
+                chain = self._shard_chain(shard)
+            if chain is not None and chain.get("follower") != self.config.node_id:
+                continue  # not the registered follower: not eligible
+            # registry BEFORE the role flip: a crash in between leaves the
+            # record naming this node, and the next tick finishes the flip
+            # (branch above) instead of another copy taking over
+            from ..metastore.base import MetastoreError
+            try:
+                self.metastore.record_shard_chain(
+                    shard.index_uid, shard.source_id, shard.shard_id,
+                    leader=self.config.node_id, follower=None)
+            except MetastoreError:
+                continue  # retry next tick; the old record still gates
+            if self.ingester.promote_replica(
+                    queue_id, min_position=self._published_floor(shard)):
                 promoted.append(shard.shard_id)
                 logger.warning(
                     "promoted replica shard %s (leader %s dead for %.0fs)",
                     shard.shard_id, leader_node, now - first_seen_dead)
         return promoted
+
+    def reconcile_stale_leaders(self) -> list[str]:
+        """Demote local leader-role shards whose REGISTERED leader is
+        another node: this node crashed, its replica was promoted
+        elsewhere, and WAL recovery restored the stale leader role — the
+        split-brain that qwmc's stale-leader-rejoin counterexample turns
+        into an acked-record loss (re-used published positions). The WAL
+        resets at the published checkpoint; the registered chain holds
+        every acked record, so the stale copy is redundant."""
+        from ..ingest.ingester import shard_queue_id
+        demoted = []
+        for shard in self.ingester.list_shards(include_replicas=False):
+            chain = self._shard_chain(shard)
+            if chain is None or chain.get("leader") == self.config.node_id:
+                continue
+            queue_id = shard_queue_id(shard.index_uid, shard.source_id,
+                                      shard.shard_id)
+            if self.ingester.demote_to_replica(queue_id,
+                                               self._published_floor(shard)):
+                demoted.append(shard.shard_id)
+                logger.warning(
+                    "demoted stale leader shard %s (registry names %s)",
+                    shard.shard_id, chain["leader"])
+        return demoted
 
     def ingest_v2(self, index_id: str, docs: list[dict]) -> dict[str, Any]:
         """Durable WAL ingest (v2 path): docs are fsync'd into shard queues
@@ -1414,7 +1514,10 @@ class Node:
             if "indexer" not in self.config.roles:
                 return
             # failover: adopt replica shards whose leader died before
-            # draining (checkpoints continue at the same positions)
+            # draining (checkpoints continue at the same positions), and
+            # step down from shards the registry says another node now
+            # leads (stale role recovered from a pre-crash WAL)
+            self.reconcile_stale_leaders()
             self.promote_orphaned_replicas()
             live_uids = set()
             for metadata in self.metastore.list_indexes():

@@ -230,9 +230,9 @@ def test_merge_fast_path_resumes_after_deletes_applied(env):
     called = {}
     orig = ma.merge_splits
     try:
-        def spy(readers):
+        def spy(readers, **kwargs):
             called["fast"] = True
-            return orig(readers)
+            return orig(readers, **kwargs)
         ma.merge_splits = spy
         executor.execute(MergeOperation(tuple(published)), delete_tasks=tasks)
     finally:

@@ -377,6 +377,153 @@ def test_qw006_suppression(tmp_path):
     assert findings == []
 
 
+# --- QW007 lock-order-hazard --------------------------------------------------
+
+def qw007(findings):
+    return [f for f in findings if f.rule == "QW007"]
+
+
+def test_qw007_opposite_order_across_files_is_a_cycle(tmp_path):
+    (tmp_path / "a.py").write_text(textwrap.dedent("""
+        from locks import A_LOCK, B_LOCK
+
+        def forward():
+            with A_LOCK:
+                with B_LOCK:
+                    pass
+    """))
+    (tmp_path / "b.py").write_text(textwrap.dedent("""
+        from locks import A_LOCK, B_LOCK
+
+        def backward():
+            with B_LOCK, A_LOCK:
+                pass
+    """))
+    findings = qw007(analyze_paths([str(tmp_path)], root=str(tmp_path)))
+    assert [(f.path, f.function) for f in findings] == [
+        ("a.py", "forward"), ("b.py", "backward")]
+    assert all("cycle: " in f.message for f in findings)
+
+
+def test_qw007_consistent_order_is_clean(tmp_path):
+    for name, fn in (("a.py", "one"), ("b.py", "two")):
+        (tmp_path / name).write_text(textwrap.dedent(f"""
+            from locks import A_LOCK, B_LOCK
+
+            def {fn}():
+                with A_LOCK:
+                    with B_LOCK:
+                        pass
+        """))
+    assert qw007(analyze_paths([str(tmp_path)], root=str(tmp_path))) == []
+
+
+def test_qw007_acquire_release_spans(tmp_path):
+    # an explicit .acquire() holds until .release(); nesting inside the
+    # span makes an edge, nesting after the release does not
+    (tmp_path / "spans.py").write_text(textwrap.dedent("""
+        import threading
+
+        a_lock = threading.Lock()
+        b_lock = threading.Lock()
+
+        def inside_span():
+            a_lock.acquire()
+            with b_lock:
+                pass
+            a_lock.release()
+
+        def after_release():
+            b_lock.acquire()
+            b_lock.release()
+            with a_lock:
+                pass
+
+        def reversed_order():
+            with b_lock:
+                a_lock.acquire()
+                a_lock.release()
+    """))
+    findings = qw007(analyze_paths([str(tmp_path)], root=str(tmp_path)))
+    # inside_span (a→b) and reversed_order (b→a) form the cycle;
+    # after_release contributes no edge at all
+    assert sorted(f.function for f in findings) == \
+        ["inside_span", "reversed_order"]
+
+
+def test_qw007_same_lock_name_is_not_a_self_cycle(tmp_path):
+    # two *instances* behind one name (per-shard locks, RLocks): nesting
+    # the same identity is not reported as a deadlock
+    (tmp_path / "re.py").write_text(textwrap.dedent("""
+        def move(src, dst):
+            with src.queue_lock:
+                with dst.queue_lock:
+                    pass
+    """))
+    assert qw007(analyze_paths([str(tmp_path)], root=str(tmp_path))) == []
+
+
+def test_qw007_self_attr_merges_across_methods(tmp_path):
+    # `self._lock` in two methods of one class is ONE graph node
+    # (ClassName._lock), so opposite orders against a global still cycle
+    (tmp_path / "cls.py").write_text(textwrap.dedent("""
+        import threading
+
+        FLUSH_LOCK = threading.Lock()
+
+        class Buffer:
+            def put(self):
+                with self._lock:
+                    with FLUSH_LOCK:
+                        pass
+
+            def flush(self):
+                with FLUSH_LOCK:
+                    with self._lock:
+                        pass
+    """))
+    findings = qw007(analyze_paths([str(tmp_path)], root=str(tmp_path)))
+    assert sorted(f.function for f in findings) == \
+        ["Buffer.flush", "Buffer.put"]
+    assert "Buffer._lock" in findings[0].message
+
+
+def test_qw007_readback_while_holding_lock(tmp_path):
+    findings = qw007(lint(tmp_path, """
+        import jax
+
+        def dispatch(self, out):
+            with self._dispatch_lock:
+                jax.block_until_ready(out)
+            jax.block_until_ready(out)  # after release: fine
+    """))
+    assert len(findings) == 1
+    assert "_dispatch_lock" in findings[0].message
+
+
+def test_qw007_suppressed_edge_never_enters_the_graph(tmp_path):
+    (tmp_path / "a.py").write_text(textwrap.dedent("""
+        from locks import A_LOCK, B_LOCK
+
+        def forward():
+            with A_LOCK:
+                # qwlint: disable-next-line=QW007 - startup only, see doc
+                with B_LOCK:
+                    pass
+    """))
+    (tmp_path / "b.py").write_text(textwrap.dedent("""
+        from locks import A_LOCK, B_LOCK
+
+        def backward():
+            with B_LOCK:
+                with A_LOCK:
+                    pass
+    """))
+    # with the forward edge suppressed there is no cycle left, so the
+    # backward site is clean too (its order is now the canonical one)
+    assert qw007(analyze_paths([str(tmp_path)], root=str(tmp_path))) == []
+
+
 # --- suppression scopes ------------------------------------------------------
 
 def test_suppression_same_line(tmp_path):

@@ -1,0 +1,229 @@
+"""Tier-1 gate for qwmc: both protocol models verify clean to their
+pinned bounds with asserted state counts (a drifting count means the
+model changed — repin deliberately, it is the spec), every planted bug
+yields its counterexample at the pinned shortest-path length, artifacts
+replay deterministically, and the DST conformance bridge accepts clean
+sweeps while rejecting planted-bug traces. Deeper-bound sweeps are
+`slow`-marked."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tools.qwmc import check_model
+from tools.qwmc.__main__ import main as qwmc_main
+from tools.qwmc.artifact import replay_artifact, save_counterexample
+from tools.qwmc.conformance import check_trace
+from tools.qwmc.models import build_model
+
+
+# --- exhaustive verification at the pinned bounds -----------------------------
+
+def test_replication_verifies_at_pinned_bound():
+    result = check_model(build_model("replication"))
+    assert result.ok and result.complete
+    assert (result.states, result.transitions, result.depth) \
+        == (18199, 56306, 22)
+
+
+def test_checkpoint_verifies_at_pinned_bound():
+    result = check_model(build_model("checkpoint"))
+    assert result.ok and result.complete
+    assert (result.states, result.transitions, result.depth) \
+        == (3231, 14838, 17)
+
+
+@pytest.mark.slow
+def test_replication_deeper_crash_budget():
+    result = check_model(build_model("replication", crashes=2))
+    assert result.ok and result.complete
+    assert result.states == 182406
+
+
+@pytest.mark.slow
+def test_checkpoint_deeper_bounds():
+    result = check_model(build_model("checkpoint", records=4, crashes=2))
+    assert result.ok and result.complete
+    assert result.states == 20380
+
+
+# --- planted bugs produce counterexamples -------------------------------------
+
+def _violation(model_name, **config):
+    result = check_model(build_model(model_name, **config))
+    assert result.violation is not None, "planted bug went undetected"
+    return result
+
+
+def test_break_publish_counterexample():
+    result = _violation("checkpoint", break_publish=True)
+    v = result.violation
+    assert (v.kind, v.name, len(v.path)) == ("invariant", "exactly_once", 5)
+
+
+def test_break_wal_counterexample():
+    result = _violation("replication", break_wal=True)
+    v = result.violation
+    assert (v.kind, v.name, len(v.path)) == ("invariant", "zero_loss", 6)
+
+
+def test_stale_rejoin_counterexample():
+    # the pre-fix semantics: a crashed leader rejoins with its stale role
+    # intact (no registry demotion) and loses an acked record
+    result = _violation("replication", stale_rejoin=True)
+    v = result.violation
+    assert (v.kind, v.name, len(v.path)) == ("invariant", "zero_loss", 14)
+
+
+def test_no_fsync_counterexample():
+    result = _violation("replication", fsync=False)
+    v = result.violation
+    assert (v.kind, v.name, len(v.path)) == ("invariant", "zero_loss", 7)
+
+
+# --- artifacts ----------------------------------------------------------------
+
+def test_counterexample_artifact_replays_deterministically(tmp_path):
+    result = _violation("checkpoint", break_publish=True)
+    path = save_counterexample(result, str(tmp_path))
+    verdict = replay_artifact(path)
+    assert verdict["reproduced"] is True
+    assert (verdict["name"], verdict["steps"]) == ("exactly_once", 5)
+    # same violation re-persisted lands on the same digest-derived path
+    assert save_counterexample(result, str(tmp_path)) == path
+
+
+# --- CLI ----------------------------------------------------------------------
+
+def test_cli_verifies_all_models_with_pinned_json(capsys):
+    assert qwmc_main(["check", "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ok"] is True
+    by_model = {r["model"]: r for r in out["results"]}
+    assert by_model["replication"]["states"] == 18199
+    assert by_model["checkpoint"]["states"] == 3231
+    assert all(r["complete"] for r in out["results"])
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    assert qwmc_main(["check", "--model", "checkpoint", "--break-publish",
+                      "--artifact-dir", str(tmp_path)]) == 1
+    capsys.readouterr()
+    artifacts = list(tmp_path.glob("qwmc-checkpoint-*.json"))
+    assert len(artifacts) == 1
+    assert qwmc_main(["replay", str(artifacts[0])]) == 0
+    capsys.readouterr()
+    assert qwmc_main(["check", "--model", "nonesuch"]) == 2
+
+
+# --- conformance bridge: unit fixtures ----------------------------------------
+
+def _ingest_event(index, acked, step=0):
+    return {"kind": "op", "step": step,
+            "op": {"kind": "ingest", "node": "sim-0", "index": index,
+                   "num_docs": acked},
+            "result": {"acked": acked}}
+
+
+def _drain_event(index, indexed, checkpoint, step=1):
+    return {"kind": "op", "step": step,
+            "op": {"kind": "drain", "node": "sim-0"},
+            "result": {index: {"indexed": indexed, "splits": 1,
+                               "checkpoint": checkpoint}}}
+
+
+def test_conformance_accepts_a_clean_trace():
+    report = check_trace([
+        _ingest_event("t", 5),
+        _drain_event("t", 5, 5),
+        {"kind": "quiesce", "summary": {
+            "drain0:sim-0": {"t": {"skipped": "checkpoint",
+                                   "checkpoint": 5}}}},
+    ])
+    assert report["conforms"] is True
+    assert report["indexes"]["t"] == {"acked": 5, "published": 5,
+                                      "checkpoint": 5}
+
+
+def test_conformance_rejects_republication():
+    # draining the same 5 records twice is not a behavior of the model:
+    # its publish CAS consumes each WAL position exactly once
+    report = check_trace([
+        _ingest_event("t", 5),
+        _drain_event("t", 5, 5),
+        _drain_event("t", 5, 5, step=2),
+        {"kind": "quiesce", "summary": {}},
+    ])
+    assert report["conforms"] is False
+    assert report["violations"][0]["invariant"] == "exactly_once"
+
+
+def test_conformance_rejects_lost_records():
+    report = check_trace([
+        _ingest_event("t", 5),
+        _drain_event("t", 3, 3),
+        {"kind": "quiesce", "summary": {}},
+    ])
+    assert report["conforms"] is False
+    assert [v["invariant"] for v in report["violations"]] == ["zero_loss"]
+
+
+def test_conformance_final_check_requires_quiescence():
+    # a run cut short by a primary invariant violation never drained its
+    # tail; conformance must not double-report that as loss
+    report = check_trace([_ingest_event("t", 5)])
+    assert report["conforms"] is True
+    assert report["quiesced"] is False
+
+
+def test_conformance_checkpoint_observations_max_merge():
+    # a stale polling cache may report an older checkpoint: staleness is
+    # not a protocol violation, the model tracks the monotone envelope
+    report = check_trace([
+        _ingest_event("t", 5),
+        _drain_event("t", 5, 5),
+        {"kind": "quiesce", "summary": {
+            "drain0:sim-1": {"t": {"skipped": "checkpoint",
+                                   "checkpoint": 2}}}},
+    ])
+    assert report["conforms"] is True
+    assert report["indexes"]["t"]["checkpoint"] == 5
+
+
+# --- conformance bridge: end-to-end through the DST harness -------------------
+
+def _sweep(conformance=True, **flags):
+    from quickwit_tpu.dst.harness import scenario_by_name, sweep
+    return sweep(scenario_by_name("smoke"), seeds=2, conformance=conformance,
+                 shrink_violations=False, stop_on_first=False, **flags)
+
+
+def test_conformance_clean_smoke_sweep():
+    summary = _sweep()
+    assert summary["violations"] == []
+    assert summary["nonconforming"] == []
+    assert summary["ok"] is True
+
+
+def test_conformance_flags_break_publish_sweep():
+    summary = _sweep(break_publish=True)
+    assert summary["nonconforming"], \
+        "planted publish bug must yield a non-conforming trace"
+    names = {v["invariant"]
+             for entry in summary["nonconforming"]
+             for v in entry["report"]["violations"]}
+    assert "exactly_once" in names
+    assert summary["ok"] is False
+
+
+def test_conformance_flags_break_wal_sweep():
+    summary = _sweep(break_wal=True)
+    assert summary["nonconforming"], \
+        "planted WAL-loss bug must yield a non-conforming trace"
+    names = {v["invariant"]
+             for entry in summary["nonconforming"]
+             for v in entry["report"]["violations"]}
+    assert "zero_loss" in names
+    assert summary["ok"] is False

@@ -1,0 +1,237 @@
+"""Kernel fixtures for tools/qwmc: planted-bug toy models must be found
+with MINIMAL counterexamples, replay must be an exact determinism oracle,
+symmetry reduction must shrink the space without changing verdicts, and
+the weak-fairness lasso search must separate livelocks from fair loops."""
+
+from __future__ import annotations
+
+import pytest
+
+from tools.qwmc.kernel import Model, check_model, replay_path
+
+
+# --- toy models ---------------------------------------------------------------
+
+class Counter(Model):
+    """0..limit counter; the planted bug is an invariant capping at 3."""
+
+    name = "counter"
+
+    def __init__(self, limit=6, cap=None):
+        self.limit = limit
+        self.cap = cap
+        self.config = {"limit": limit, "cap": cap}
+
+    def initial_state(self):
+        return {"n": 0}
+
+    def actions(self, s):
+        return [("inc", {"n": s["n"] + 1})] if s["n"] < self.limit else []
+
+    def invariants(self):
+        if self.cap is None:
+            return []
+        return [("capped", lambda s: s["n"] <= self.cap)]
+
+    def is_terminal(self, s):
+        return s["n"] == self.limit
+
+
+class Chain(Model):
+    """a -> b -> c; c has no actions — a deadlock unless declared final."""
+
+    name = "chain"
+
+    def __init__(self, c_is_final=False):
+        self.c_is_final = c_is_final
+        self.config = {"c_is_final": c_is_final}
+
+    def initial_state(self):
+        return {"at": "a"}
+
+    def actions(self, s):
+        step = {"a": "b", "b": "c"}.get(s["at"])
+        return [] if step is None else [(f"to_{step}", {"at": step})]
+
+    def is_terminal(self, s):
+        return self.c_is_final and s["at"] == "c"
+
+
+class Mutex(Model):
+    """Two symmetric processes entering a critical section with no guard:
+    the mutual-exclusion invariant is violated at depth 2, symmetrically."""
+
+    name = "mutex"
+
+    def __init__(self):
+        self.config = {}
+
+    def initial_state(self):
+        return {"crit": {"p0": False, "p1": False}}
+
+    def actions(self, s):
+        out = []
+        for pid in ("p0", "p1"):
+            if not s["crit"][pid]:
+                t = {"crit": dict(s["crit"])}
+                t["crit"][pid] = True
+                out.append((f"enter({pid})", t))
+        return out
+
+    def invariants(self):
+        return [("mutual_exclusion",
+                 lambda s: sum(s["crit"].values()) <= 1)]
+
+    def is_terminal(self, s):
+        return True
+
+    def symmetries(self):
+        return [{"p0": "p1", "p1": "p0"}]
+
+
+class PingPong(Model):
+    """a <-> b with an exit to the goal from either side. Whether the
+    ping-pong livelock is a violation hinges entirely on declaring the
+    exit weakly fair: it is enabled in EVERY state of the {a, b} SCC, so
+    fairness forces it to fire eventually."""
+
+    name = "pingpong"
+
+    def __init__(self, fair_exit=True):
+        self.fair_exit = fair_exit
+        self.config = {"fair_exit": fair_exit}
+
+    def initial_state(self):
+        return {"at": "a"}
+
+    def actions(self, s):
+        if s["at"] == "goal":
+            return []
+        other = "b" if s["at"] == "a" else "a"
+        return [("swap", {"at": other}), ("finish", {"at": "goal"})]
+
+    def is_terminal(self, s):
+        return s["at"] == "goal"
+
+    def liveness_goal(self):
+        return lambda s: s["at"] == "goal"
+
+    def weakly_fair(self, label):
+        return self.fair_exit and label == "finish"
+
+
+# --- safety -------------------------------------------------------------------
+
+def test_clean_model_verifies_and_counts_the_space():
+    result = check_model(Counter(limit=6))
+    assert result.ok and result.complete
+    assert (result.states, result.transitions, result.depth) == (7, 6, 6)
+
+
+def test_invariant_violation_has_shortest_path():
+    result = check_model(Counter(limit=6, cap=3))
+    v = result.violation
+    assert v is not None and v.kind == "invariant" and v.name == "capped"
+    assert v.path == ["inc"] * 4  # minimal: BFS reports the 4-step witness
+    assert v.state == {"n": 4}
+
+
+def test_transition_invariant_violation():
+    class Jumpy(Counter):
+        def actions(self, s):
+            out = super().actions(s)
+            if s["n"] == 2:
+                out.append(("jump_back", {"n": 0}))
+            return out
+
+        def transition_invariants(self):
+            return [("monotonic", lambda s, _l, t: t["n"] >= s["n"])]
+
+    v = check_model(Jumpy(limit=4)).violation
+    assert v is not None
+    assert (v.kind, v.name) == ("transition_invariant", "monotonic")
+    assert v.path == ["inc", "inc", "jump_back"]
+
+
+def test_deadlock_detection_and_terminal_states():
+    v = check_model(Chain(c_is_final=False)).violation
+    assert v is not None and v.kind == "deadlock"
+    assert v.path == ["to_b", "to_c"]
+    assert check_model(Chain(c_is_final=True)).ok
+
+
+def test_duplicate_action_labels_rejected():
+    class Dup(Model):
+        name = "dup"
+        config = {}
+
+        def initial_state(self):
+            return {"n": 0}
+
+        def actions(self, s):
+            return [("go", {"n": 1}), ("go", {"n": 2})] if s["n"] == 0 \
+                else []
+
+        def is_terminal(self, s):
+            return True
+
+    with pytest.raises(ValueError, match="duplicate action label"):
+        check_model(Dup())
+
+
+# --- symmetry reduction -------------------------------------------------------
+
+def test_symmetry_preserves_the_verdict_and_shrinks_the_space():
+    reduced = check_model(Mutex(), symmetry=True)
+    full = check_model(Mutex(), symmetry=False)
+    for result in (reduced, full):
+        assert result.violation is not None
+        assert result.violation.name == "mutual_exclusion"
+        assert len(result.violation.path) == 2
+    # {p0 in crit} and {p1 in crit} collapse into one orbit representative
+    assert reduced.states < full.states
+
+
+def test_symmetric_clean_model_explores_the_quotient():
+    class SafeMutex(Mutex):
+        def actions(self, s):
+            if any(s["crit"].values()):
+                return []  # someone holds it: nobody else may enter
+            return super().actions(s)
+
+    reduced = check_model(SafeMutex(), symmetry=True)
+    full = check_model(SafeMutex(), symmetry=False)
+    assert reduced.ok and full.ok
+    assert (reduced.states, full.states) == (2, 3)
+
+
+# --- liveness / weak fairness -------------------------------------------------
+
+def test_unfair_livelock_is_a_lasso_counterexample():
+    result = check_model(PingPong(fair_exit=False))
+    v = result.violation
+    assert v is not None and v.kind == "liveness"
+    assert v.cycle, "a lasso witness must carry its cycle"
+    # the cycle really is the swap livelock: replaying stem+cycle stays
+    # off-goal, and the cycle's labels never include the exit
+    assert "finish" not in v.cycle
+    final = replay_path(PingPong(fair_exit=False), v.path, v.cycle)
+    assert final["at"] != "goal"
+
+
+def test_weak_fairness_discharges_the_livelock():
+    # same graph, but the always-enabled exit is weakly fair: every fair
+    # run eventually fires it, so the ping-pong loop is not a counterexample
+    assert check_model(PingPong(fair_exit=True)).ok
+
+
+# --- replay -------------------------------------------------------------------
+
+def test_replay_is_deterministic_and_rejects_divergence():
+    model = Counter(limit=6, cap=3)
+    v = check_model(model).violation
+    assert replay_path(Counter(limit=6, cap=3), v.path) == v.state
+    assert replay_path(Counter(limit=6, cap=3), v.path) == \
+        replay_path(Counter(limit=6, cap=3), v.path)
+    with pytest.raises(ValueError, match="not enabled"):
+        replay_path(Counter(limit=2), ["inc", "inc", "inc"])

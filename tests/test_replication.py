@@ -241,3 +241,139 @@ def test_truncation_propagates_to_replica(replicated_pair):
     leader.run_ingest_pass("rep-logs")
     replica = follower.ingester.shard(uid, "_ingest-source", shard_id)
     assert replica.publish_position >= 5
+
+
+# --- qwmc-surfaced protocol defects (tools/qwmc/models.py) ---------------
+# The three regression scenarios below reproduce, at the implementation
+# level, the counterexamples the replication model's exhaustive check
+# found: stale-leader rejoin split-brain, stale-replica promotion, and
+# behind-checkpoint promotion position collision.
+
+def test_chain_registry_recorded_and_gates_promotion(replicated_pair):
+    """The leader durably registers (leader, follower) before the first
+    replicated batch; promotion is only offered to the registered
+    follower."""
+    nodes, servers = replicated_pair
+    leader, follower = nodes
+    status, _ = rest(servers[0].port, "POST", "/api/v1/indexes", INDEX_CONFIG)
+    assert status == 200
+    uid = leader.metastore.index_metadata("rep-logs").index_uid
+
+    leader.ingest_v2("rep-logs", [{"ts": 1, "body": "a"}])
+    shard_id = leader.ingester.list_shards(uid)[0].shard_id
+    chain = follower.metastore.shard_chain(uid, "_ingest-source", shard_id)
+    assert chain == {"leader": "rep-0", "follower": "rep-1"}
+
+    # an unregistered copy (the chain names rep-1, not this impostor) is
+    # not eligible even when the leader is gone
+    servers[0].stop()
+    follower.cluster.leave("rep-0")
+    follower.metastore.record_shard_chain(
+        uid, "_ingest-source", shard_id, leader="rep-0", follower="rep-9")
+    assert follower.promote_orphaned_replicas(grace_secs=0) == []
+    # restoring the honest record makes the registered follower take over,
+    # and promotion rewrites the registry to name the new leader
+    follower.metastore.record_shard_chain(
+        uid, "_ingest-source", shard_id, leader="rep-0", follower="rep-1")
+    assert follower.promote_orphaned_replicas(grace_secs=0) == [shard_id]
+    assert follower.metastore.shard_chain(
+        uid, "_ingest-source", shard_id) == {"leader": "rep-1",
+                                             "follower": None}
+    assert follower.ingester.shard(uid, "_ingest-source",
+                                   shard_id).role == "leader"
+
+
+def test_stale_leader_rejoin_demotes_via_registry(replicated_pair,
+                                                  tmp_path):
+    """qwmc stale-leader-rejoin counterexample: the crashed leader rejoins
+    AFTER its replica was promoted, recovers its shard with the old leader
+    role, and the split-brain re-uses published positions. The registry
+    names the new leader, so the rejoined node steps down (WAL reset at
+    the published checkpoint) instead."""
+    nodes, servers = replicated_pair
+    leader, follower = nodes
+    status, _ = rest(servers[0].port, "POST", "/api/v1/indexes", INDEX_CONFIG)
+    assert status == 200
+    uid = leader.metastore.index_metadata("rep-logs").index_uid
+
+    leader.ingest_v2("rep-logs", [
+        {"ts": 1_700_000_000 + i, "body": f"doc {i}"} for i in range(5)])
+    shard_id = leader.ingester.list_shards(uid)[0].shard_id
+
+    # leader "crashes"; the registered follower takes over and drains
+    servers[0].stop()
+    follower.cluster.leave("rep-0")
+    assert follower.promote_orphaned_replicas(grace_secs=0) == [shard_id]
+    follower.run_ingest_pass("rep-logs")
+
+    # the old leader rejoins: recovery restored its stale leader role,
+    # but the registry names rep-1 — reconciliation demotes the copy
+    stale = leader.ingester.shard(uid, "_ingest-source", shard_id)
+    assert stale.role == "leader"  # the split-brain the model caught
+    leader.metastore.refresh()
+    assert leader.reconcile_stale_leaders() == [shard_id]
+    demoted = leader.ingester.shard(uid, "_ingest-source", shard_id)
+    assert demoted.role == "replica"
+    # the reset log restarts at the published checkpoint: fresh appends
+    # through the PROMOTED leader cannot collide with its positions
+    assert demoted.log.next_position == 5
+    assert demoted.log.read_from(0) == []
+    # and the stale copy refuses router writes outright
+    with pytest.raises(ValueError, match="replica"):
+        leader.ingester.persist(uid, "_ingest-source", shard_id,
+                                [{"n": 99}])
+
+
+def test_promotion_forward_resets_behind_checkpoint(tmp_path):
+    """qwmc behind-checkpoint counterexample: promoting a copy whose log
+    head is behind the published checkpoint would hand already-consumed
+    positions to fresh appends; promotion forward-resets the log to the
+    checkpoint (everything dropped is below it, hence published)."""
+    follower = Ingester(str(tmp_path / "wal"), fsync=False)
+    follower.replica_persist("idx:1", "src", "a-shard-00", 0, [b"r0", b"r1"])
+    [(queue_id, shard)] = follower.replica_shards()
+    # the checkpoint advanced to 5 (the old leader's recovery-committed
+    # tail was published at-least-once) while this copy saw only 0..1
+    assert follower.promote_replica(queue_id, min_position=5)
+    assert shard.log.next_position == 5
+    assert shard.log.read_from(0) == []
+    assert shard.publish_position == 5
+    first, last = follower.persist("idx:1", "src", "a-shard-00", [{"n": 9}])
+    assert (first, last) == (5, 5)  # past the consumed positions
+
+    # a copy AT or AHEAD of the checkpoint is left untouched
+    other = Ingester(str(tmp_path / "wal2"), fsync=False)
+    other.replica_persist("idx:1", "src", "b-shard-00", 0, [b"r0", b"r1"])
+    [(queue_id2, shard2)] = other.replica_shards()
+    assert other.promote_replica(queue_id2, min_position=1)
+    assert shard2.log.next_position == 2
+    assert len(shard2.log.read_from(0)) == 2
+
+
+def test_fetch_clamped_to_replication_committed_watermark(tmp_path):
+    """qwmc publish watermark: a fetch racing the persist critical section
+    must not see the appended-but-unreplicated tail — a failed chain rolls
+    it back and the positions get re-used for DIFFERENT documents, which
+    a premature publish would have marked consumed."""
+    observed = {}
+
+    def replicate(index_uid, source_id, shard_id, first, payloads):
+        # what a concurrent fetch stream sees mid-persist, after the local
+        # append but before the chain commits
+        observed["mid"] = leader.fetch(index_uid, source_id, shard_id, 0)
+        if observed.get("fail"):
+            raise IOError("follower unreachable")
+
+    leader = Ingester(str(tmp_path / "wal"), fsync=False,
+                      replicate_to=replicate)
+    leader.persist("idx:1", "src", "n0-shard-00", [{"n": 0}])
+    assert observed["mid"] == []  # uncommitted tail invisible
+    assert [d["n"] for _, d in leader.fetch("idx:1", "src", "n0-shard-00",
+                                            0)] == [0]
+    # a failed chain rolls back; the watermark still covers the first batch
+    observed["fail"] = True
+    with pytest.raises(IOError):
+        leader.persist("idx:1", "src", "n0-shard-00", [{"n": 1}])
+    assert observed["mid"] == [(0, {"n": 0})]
+    assert [d["n"] for _, d in leader.fetch("idx:1", "src", "n0-shard-00",
+                                            0)] == [0]

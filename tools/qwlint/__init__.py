@@ -14,6 +14,10 @@ Rules:
     QW003 ambient-context-propagation (bare callables across thread hops)
     QW004 swallowed-control-flow      (broad excepts on the query path)
     QW005 metrics-hygiene             (qw_ prefix, duplicates, cardinality)
+    QW006 ambient-time-and-randomness (sim-scoped modules must use the
+                                       virtualizable clock/rng seams)
+    QW007 lock-order-hazard           (cross-file acquisition-graph cycles,
+                                       device readbacks under a held lock)
 
 Suppression: `# qwlint: disable=QW001` on the flagged line, on the
 enclosing `def` line (covers the whole function), or

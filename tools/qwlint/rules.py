@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import ast
 import re
+from typing import Optional
 
 from .core import FileContext, Finding, dotted_name, last_segment
 
@@ -490,8 +491,205 @@ class AmbientTimeAndRandomness:
                         self._message(f"random.{node.attr}"))
 
 
+# --- QW007 lock-order-hazard -------------------------------------------------
+
+# A name is treated as a lock when its last dotted segment is `lock`/`mutex`
+# or ends with `_lock`/`_LOCK` — matches `_MESH_DISPATCH_LOCK`, the batcher/
+# budget/cache `self._lock`s and `shard.persist_lock`, but not `deadlock`
+# or condition variables (which wrap a lock and are named `_cv`/`_cond`).
+_LOCK_NAME_RE = re.compile(r"(?:^|_)(?:lock|mutex)$", re.IGNORECASE)
+
+# Device syncs that must not run while a lock is held: every waiter on the
+# lock stalls for a device round-trip it never asked for. Reuses QW001's
+# readback sets; `jax.block_until_ready(x)` is the call-form spelling.
+_QW007_READBACK_DOTTED = _READBACK_DOTTED | {"jax.block_until_ready"}
+
+_QW007_SHARED = "qw007_edges"
+
+
+class LockOrder:
+    """Cross-file lock-acquisition-order analysis.
+
+    Collects an acquisition graph: an edge A → B means some function
+    acquires B (via `with B:` or `B.acquire()`) while already holding A.
+    After every file is checked, `finalize` reports each edge that sits on
+    a cycle of two or more distinct locks — two threads taking the same
+    pair in opposite orders is a deadlock waiting for scheduler timing.
+    Self-edges are skipped: re-entering the *name* usually means two
+    instances (per-shard `persist_lock`) or an RLock, not a self-deadlock.
+
+    Also flags device readbacks executed while any lock is held: the
+    readback's latency becomes every waiter's latency.
+    """
+
+    id = "QW007"
+    title = "lock-order-hazard"
+
+    # -- lock identity -----------------------------------------------------
+    def _lock_id(self, ctx: FileContext, expr: ast.AST) -> Optional[str]:
+        name = dotted_name(expr)
+        if not name or not _LOCK_NAME_RE.search(name.rsplit(".", 1)[-1]):
+            return None
+        parts = name.split(".")
+        if parts[0] in ("self", "cls"):
+            # rewrite `self._lock` to `ClassName._lock` so every method of
+            # the class contributes to one node in the graph
+            qual = getattr(expr, "_qw_qual", "<module>")
+            funcs = getattr(expr, "_qw_funcs", ())
+            segments = [] if qual == "<module>" else qual.split(".")
+            cls = ".".join(segments[:len(segments) - len(funcs)])
+            parts[0] = cls or parts[0]
+        return ".".join(parts)
+
+    # -- recording ---------------------------------------------------------
+    def _record_edge(self, ctx: FileContext, held: str, acquired: str,
+                     node: ast.AST) -> None:
+        if held == acquired or ctx.suppressed(self.id, node):
+            return
+        sites = ctx.shared.setdefault(_QW007_SHARED, {}) \
+                          .setdefault((held, acquired), [])
+        sites.append({"path": ctx.relpath,
+                      "line": getattr(node, "lineno", 0),
+                      "col": getattr(node, "col_offset", 0),
+                      "function": getattr(node, "_qw_qual", "<module>")})
+
+    def _scan_readbacks(self, ctx: FileContext, exprs, held) -> None:
+        if not held:
+            return
+        stack = list(exprs)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue  # runs later, not under this lock
+            stack.extend(ast.iter_child_nodes(node))
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            hit = None
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in _READBACK_METHODS
+                    and not node.args and not node.keywords):
+                hit = f".{func.attr}()"
+            else:
+                name = dotted_name(func)
+                if name in _QW007_READBACK_DOTTED and node.args:
+                    hit = f"{name}()"
+            if hit:
+                locks = ", ".join(lock for lock, _ in held)
+                ctx.add(self.id, node,
+                        f"{hit} forces a device→host sync while holding "
+                        f"{locks}: every thread waiting on the lock stalls "
+                        "for the device round-trip; move the readback "
+                        "outside the critical section or suppress with the "
+                        "ordering argument that makes holding it necessary")
+
+    # -- ordered traversal -------------------------------------------------
+    def _visit_block(self, ctx: FileContext, stmts, held) -> None:
+        held = list(held)
+        for stmt in stmts:
+            held = self._visit_stmt(ctx, stmt, held)
+
+    def _visit_stmt(self, ctx: FileContext, stmt: ast.stmt, held):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._visit_block(ctx, stmt.body, [])  # runs with no locks held
+            return held
+        if isinstance(stmt, ast.ClassDef):
+            self._visit_block(ctx, stmt.body, [])
+            return held
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self._scan_readbacks(ctx, [i.context_expr for i in stmt.items],
+                                 held)
+            inner = list(held)
+            for item in stmt.items:
+                lock = self._lock_id(ctx, item.context_expr)
+                if lock is None:
+                    continue
+                for outer, _ in inner:
+                    self._record_edge(ctx, outer, lock, item.context_expr)
+                inner.append((lock, item.context_expr))
+            self._visit_block(ctx, stmt.body, inner)
+            return held
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._scan_readbacks(ctx, [stmt.test], held)
+            self._visit_block(ctx, stmt.body, held)
+            self._visit_block(ctx, stmt.orelse, held)
+            return held
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._scan_readbacks(ctx, [stmt.iter], held)
+            self._visit_block(ctx, stmt.body, held)
+            self._visit_block(ctx, stmt.orelse, held)
+            return held
+        if isinstance(stmt, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+            self._visit_block(ctx, stmt.body, held)
+            for handler in stmt.handlers:
+                self._visit_block(ctx, handler.body, held)
+            self._visit_block(ctx, stmt.orelse, held)
+            self._visit_block(ctx, stmt.finalbody, held)
+            return held
+        # simple statement: explicit acquire()/release() bookkeeping, then
+        # readback scan under whatever is held
+        call = stmt.value if isinstance(stmt, ast.Expr) \
+            and isinstance(stmt.value, ast.Call) else None
+        if call is not None and isinstance(call.func, ast.Attribute):
+            lock = self._lock_id(ctx, call.func.value)
+            if lock is not None and call.func.attr == "acquire":
+                for outer, _ in held:
+                    self._record_edge(ctx, outer, lock, call)
+                return held + [(lock, call)]
+            if lock is not None and call.func.attr == "release":
+                return [(name, site) for name, site in held
+                        if name != lock]
+        self._scan_readbacks(ctx, [stmt], held)
+        return held
+
+    def check(self, ctx: FileContext) -> None:
+        self._visit_block(ctx, ctx.tree.body, [])
+
+    # -- cross-file cycle report -------------------------------------------
+    def finalize(self, shared: dict) -> list[Finding]:
+        edges = shared.get(_QW007_SHARED, {})
+        adjacency: dict[str, set] = {}
+        for src, dst in edges:
+            adjacency.setdefault(src, set()).add(dst)
+        findings: list[Finding] = []
+        for (src, dst), sites in sorted(edges.items()):
+            path = self._shortest_path(adjacency, dst, src)
+            if path is None:
+                continue  # edge not on any cycle
+            cycle = " → ".join([src] + path)
+            for site in sites:
+                findings.append(Finding(
+                    rule=self.id, path=site["path"], line=site["line"],
+                    col=site["col"], function=site["function"],
+                    message=f"acquires {dst} while holding {src}, but "
+                            f"elsewhere the order is reversed (cycle: "
+                            f"{cycle}); two threads taking these locks in "
+                            "opposite orders deadlock — pick one global "
+                            "order and restructure the losing site"))
+        return findings
+
+    @staticmethod
+    def _shortest_path(adjacency: dict, start: str,
+                       goal: str) -> Optional[list]:
+        """BFS path start → goal through the acquisition graph, or None."""
+        frontier = [[start]]
+        seen = {start}
+        while frontier:
+            next_frontier = []
+            for path in frontier:
+                if path[-1] == goal:
+                    return path
+                for succ in sorted(adjacency.get(path[-1], ())):
+                    if succ not in seen:
+                        seen.add(succ)
+                        next_frontier.append(path + [succ])
+            frontier = next_frontier
+        return None
+
+
 RULES = [HiddenHostReadback(), RecompilationHazard(),
          AmbientContextPropagation(), SwallowedControlFlow(),
-         MetricsHygiene(), AmbientTimeAndRandomness()]
+         MetricsHygiene(), AmbientTimeAndRandomness(), LockOrder()]
 
 RULE_DOCS = {rule.id: rule.title for rule in RULES}

@@ -1,0 +1,26 @@
+"""qwmc: explicit-state model checker for the quickwit_tpu protocols.
+
+Stdlib-only, mirroring qwlint's shape: a small kernel (`kernel.py`), the
+protocol models extracted from the implementation (`models.py`), canonical
+counterexample artifacts sharing the DST schema (`artifact.py`), the
+DST-trace refinement bridge (`conformance.py`), and a CLI (`__main__.py`)
+with qwlint-style exit codes (0 = verified, 1 = violation found,
+2 = usage/internal error).
+
+The DST harness (`quickwit_tpu/dst/`) explores *seeds*; qwmc explores the
+*full reachable state space* of the two protocols the DST exercises —
+chained replication (ingester WAL + replica chain) and WAL-drain →
+publish → truncate checkpointing — exhaustively to a pinned bound.  The
+conformance bridge closes the loop: every DST trace must be a behavior of
+the abstract model, so the models cannot silently drift from the code.
+"""
+
+from .kernel import CheckResult, Model, ModelViolation, check_model, replay_path
+
+__all__ = [
+    "CheckResult",
+    "Model",
+    "ModelViolation",
+    "check_model",
+    "replay_path",
+]

@@ -1,0 +1,152 @@
+"""CLI: `python -m tools.qwmc [check] [--model NAME] ...` / `replay FILE`.
+
+Exit-code contract (qwlint-style, consumed by tests/test_qwmc.py and CI):
+    0  every checked model verified clean to its bound
+    1  at least one violation found (counterexample artifacts written when
+       --artifact-dir is given), or a replay failed to reproduce
+    2  usage error / unknown model / bad artifact
+
+`check` (the default subcommand) exhaustively explores the selected
+models at their pinned bounds; config flags tighten or loosen the bounds
+and plant the known bugs (`--break-publish`, `--break-wal`,
+`--stale-rejoin`, `--no-fsync`).  `replay` re-executes a counterexample
+artifact from its contents alone.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .artifact import replay_artifact, save_counterexample
+from .kernel import check_model
+from .models import MODELS, build_model
+
+
+def _model_config(args: argparse.Namespace, name: str) -> dict:
+    config: dict = {}
+    if args.crashes is not None:
+        config["crashes"] = args.crashes
+    if name == "replication":
+        if args.ops is not None:
+            config["ops"] = args.ops
+        if args.break_wal:
+            config["break_wal"] = True
+        if args.stale_rejoin:
+            config["stale_rejoin"] = True
+        if args.no_fsync:
+            config["fsync"] = False
+    elif name == "checkpoint":
+        if args.records is not None:
+            config["records"] = args.records
+        if args.break_publish:
+            config["break_publish"] = True
+    return config
+
+
+def _cmd_check(args: argparse.Namespace) -> int:
+    names = [args.model] if args.model else sorted(MODELS)
+    for name in names:
+        if name not in MODELS:
+            print(f"qwmc: error: unknown model {name!r} "
+                  f"(known: {sorted(MODELS)})", file=sys.stderr)
+            return 2
+    results = []
+    artifacts = []
+    for name in names:
+        model = build_model(name, **_model_config(args, name))
+        result = check_model(model, depth=args.depth,
+                             symmetry=not args.no_symmetry)
+        results.append(result)
+        if result.violation is not None and args.artifact_dir:
+            artifacts.append(save_counterexample(result, args.artifact_dir))
+    ok = all(r.ok for r in results)
+    if args.as_json:
+        print(json.dumps({"ok": ok,
+                          "results": [r.to_dict() for r in results],
+                          "artifacts": artifacts},
+                         indent=2, sort_keys=True))
+    else:
+        for result in results:
+            status = "verified" if result.ok else "VIOLATION"
+            bound = "" if result.complete else " (depth-bounded)"
+            print(f"qwmc: {result.model}: {status} — {result.states} "
+                  f"states, {result.transitions} transitions, depth "
+                  f"{result.depth}{bound}")
+            v = result.violation
+            if v is not None:
+                print(f"qwmc:   {v.kind}: {v.name}")
+                print(f"qwmc:   path ({len(v.path)} steps): "
+                      + " -> ".join(v.path))
+                if v.cycle:
+                    print(f"qwmc:   lasso cycle: " + " -> ".join(v.cycle))
+        for path in artifacts:
+            print(f"qwmc: wrote counterexample artifact {path}")
+    return 0 if ok else 1
+
+
+def _cmd_replay(args: argparse.Namespace) -> int:
+    try:
+        verdict = replay_artifact(args.artifact)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError) as exc:
+        print(f"qwmc: error: {exc}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(verdict, indent=2, sort_keys=True))
+    else:
+        status = "reproduced" if verdict["reproduced"] else "DIVERGED"
+        print(f"qwmc: {verdict['model']}: {verdict['kind']}/"
+              f"{verdict['name']} in {verdict['steps']} steps — {status}")
+    return 0 if verdict["reproduced"] else 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="qwmc",
+        description="exhaustive model checking of the quickwit_tpu "
+                    "replication/checkpoint protocols")
+    sub = parser.add_subparsers(dest="command")
+
+    check = sub.add_parser("check", help="explore models (default)")
+    for p in (parser, check):
+        p.add_argument("--model", default=None,
+                       help=f"model to check (default: all of "
+                            f"{sorted(MODELS)})")
+        p.add_argument("--depth", type=int, default=None,
+                       help="BFS depth bound (default: exhaust)")
+        p.add_argument("--json", action="store_true", dest="as_json",
+                       help="emit results as JSON on stdout")
+        p.add_argument("--artifact-dir", default=None,
+                       help="write counterexample artifacts here")
+        p.add_argument("--no-symmetry", action="store_true",
+                       help="disable symmetry reduction")
+        p.add_argument("--crashes", type=int, default=None,
+                       help="crash budget override (both models)")
+        p.add_argument("--ops", type=int, default=None,
+                       help="replication: ops per producer")
+        p.add_argument("--records", type=int, default=None,
+                       help="checkpoint: records to ingest")
+        p.add_argument("--break-publish", action="store_true",
+                       help="plant the QW_DST_BREAK_PUBLISH bug")
+        p.add_argument("--break-wal", action="store_true",
+                       help="plant the QW_DST_BREAK_WAL bug")
+        p.add_argument("--stale-rejoin", action="store_true",
+                       help="plant the pre-fix stale-leader-rejoin "
+                            "semantics")
+        p.add_argument("--no-fsync", action="store_true",
+                       help="replication: model fsync=False durability")
+
+    replay = sub.add_parser("replay",
+                            help="re-execute a counterexample artifact")
+    replay.add_argument("artifact")
+    replay.add_argument("--json", action="store_true", dest="as_json")
+
+    args = parser.parse_args(argv)
+    if args.command == "replay":
+        return _cmd_replay(args)
+    return _cmd_check(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

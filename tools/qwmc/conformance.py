@@ -1,0 +1,150 @@
+"""DST-trace → checkpoint-model refinement bridge.
+
+`check_trace` replays a deterministic-simulation trace (the event list a
+`quickwit_tpu.dst` run records) against the abstract transition relation
+of `models.CheckpointModel`, per index:
+
+    concrete event                    abstract action / guard
+    ------------------------------    --------------------------------
+    ingest acked n docs               n × `ingest`: next += n
+    drain published k docs            `read`+`publish`: requires
+                                      published + k <= next — publishing
+                                      more records than were ever acked
+                                      into the WAL is not a behavior of
+                                      the model (its publish CAS consumes
+                                      each position exactly once)
+    drain-reported checkpoint total   the model's `ckpt` counter
+    quiescence                        `is_terminal`: ckpt == next — weak
+                                      fairness of poll/read/publish makes
+                                      the model converge, so a run whose
+                                      final checkpoint is short of the
+                                      acked count lost records
+
+A violation is reported under the MODEL's invariant name (`exactly_once`
+for the publish-guard failure, `zero_loss` for the convergence failure),
+tying a non-conforming trace directly to the counterexample the planted
+bugs (`QW_DST_BREAK_PUBLISH`, `QW_DST_BREAK_WAL`) produce under
+`python -m tools.qwmc check checkpoint` / `replication`.
+
+Pure function of the trace: no cluster, no clock, no I/O — callable from
+the sweep loop (`dst sweep --conformance`) and from tests alike.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class _AbstractIndex:
+    """The refinement image of one index: the checkpoint model's counters
+    with the per-indexer structure abstracted away (the trace only shows
+    committed effects, which is exactly the quotient the guards need)."""
+
+    def __init__(self) -> None:
+        self.acked = 0        # model `next`: acked WAL appends
+        self.published = 0    # model `len(published)`: records in splits
+        self.ckpt: Optional[int] = None  # model `ckpt`: last observed
+
+    def ingest(self, n: int) -> None:
+        self.acked += n
+
+    def observe_ckpt(self, ckpt: Optional[int]) -> None:
+        # max-merge: a node's polling cache may report an already-superseded
+        # checkpoint (staleness, not a protocol violation) — the model's
+        # `ckpt` is the monotone envelope of the observations
+        if ckpt is not None:
+            self.ckpt = max(self.ckpt or 0, int(ckpt))
+
+    def publish(self, indexed: int, ckpt: Optional[int]) -> Optional[str]:
+        self.published += indexed
+        self.observe_ckpt(ckpt)
+        if self.published > self.acked:
+            return (f"published {self.published} records but only "
+                    f"{self.acked} were ever acked — re-publication of "
+                    "consumed WAL positions (model invariant exactly_once)")
+        return None
+
+    def finalize(self) -> Optional[str]:
+        ckpt = self.ckpt if self.ckpt is not None else 0
+        if ckpt < self.acked:
+            return (f"quiesced with checkpoint {ckpt} short of "
+                    f"{self.acked} acked records — the model's fair "
+                    "drain/publish loop converges to ckpt == next, so the "
+                    "gap is lost data (model invariant zero_loss)")
+        if ckpt > self.acked:
+            return (f"quiesced with checkpoint {ckpt} beyond the "
+                    f"{self.acked} acked records — positions were "
+                    "published that no ack ever covered (model invariant "
+                    "exactly_once)")
+        return None
+
+
+def _drain_results(event: dict[str, Any]):
+    """Yield (index_id, per-index drain dict) pairs from an `op` event
+    with a drain result or from each drain in a `quiesce` summary."""
+    if event["kind"] == "op" and event.get("op", {}).get("kind") == "drain":
+        result = event.get("result")
+        if isinstance(result, dict):
+            yield from ((idx, r) for idx, r in result.items()
+                        if isinstance(r, dict))
+    elif event["kind"] == "quiesce":
+        for key, drain in event.get("summary", {}).items():
+            if key.startswith("drain") and isinstance(drain, dict):
+                yield from ((idx, r) for idx, r in drain.items()
+                            if isinstance(r, dict))
+
+
+def check_trace(events: list[dict[str, Any]]) -> dict[str, Any]:
+    """Replay `events` through the abstract checkpoint machine. Returns a
+    JSON-safe report: `conforms`, the per-index final counters, and one
+    entry per guard violation (model-invariant name, index, step)."""
+    indexes: dict[str, _AbstractIndex] = {}
+    violations: list[dict[str, Any]] = []
+
+    def index_of(index_id: str) -> _AbstractIndex:
+        return indexes.setdefault(index_id, _AbstractIndex())
+
+    quiesced = False
+    for event in events:
+        step = event.get("step")
+        if event["kind"] == "op" and \
+                event.get("op", {}).get("kind") == "ingest":
+            result = event.get("result")
+            if isinstance(result, dict) and "acked" in result:
+                index_of(event["op"]["index"]).ingest(int(result["acked"]))
+            continue
+        for index_id, drain in _drain_results(event):
+            if "indexed" not in drain:
+                # skipped / errored drain: no publish action, but a
+                # checkpoint reading (if any) is still an observation
+                index_of(index_id).observe_ckpt(drain.get("checkpoint"))
+                continue
+            error = index_of(index_id).publish(int(drain["indexed"]),
+                                               drain.get("checkpoint"))
+            if error is not None:
+                violations.append({"invariant": "exactly_once",
+                                   "index": index_id, "step": step,
+                                   "detail": error})
+        if event["kind"] == "quiesce":
+            quiesced = True
+
+    # final-state guard only when the run actually converged: a run cut
+    # short by an invariant violation never drained its tail, and flagging
+    # that as loss would double-report the primary failure
+    if quiesced:
+        for index_id, abstract in sorted(indexes.items()):
+            error = abstract.finalize()
+            if error is not None:
+                name = ("zero_loss" if (abstract.ckpt or 0) < abstract.acked
+                        else "exactly_once")
+                violations.append({"invariant": name, "index": index_id,
+                                   "step": None, "detail": error})
+
+    return {
+        "conforms": not violations,
+        "quiesced": quiesced,
+        "indexes": {idx: {"acked": a.acked, "published": a.published,
+                          "checkpoint": a.ckpt}
+                    for idx, a in sorted(indexes.items())},
+        "violations": violations,
+    }

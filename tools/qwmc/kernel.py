@@ -1,0 +1,560 @@
+"""Explicit-state model-checker kernel.
+
+States are plain JSON-shaped Python structures (dicts/lists/tuples/
+scalars).  A model contributes an initial state, a set of guarded actions
+(`actions(state)` returns the enabled `(label, successor)` pairs), safety
+invariants evaluated on every reachable state, transition invariants
+evaluated on every explored edge, and optionally a liveness goal with
+weakly-fair action labels.  The kernel does the rest:
+
+* **BFS** over the reachable state space with canonical-form hashing —
+  every state is frozen into a hashable canonical form before dedup, so
+  models can return ordinary mutable structures.
+* **Symmetry reduction**: a model may declare id-renaming symmetries
+  (permutations of node/replica/producer ids); the canonical form of a
+  state is the minimum frozen form over the whole permutation group, which
+  collapses symmetric states into one representative.  The parent map
+  stores *concrete* states, so every counterexample path is a genuine
+  execution of the model, never a permuted collage.
+* **Safety counterexamples** are minimal by construction: BFS reaches every
+  state along a shortest label path, so the first violation found is
+  already shrunk to the fewest possible steps.
+* **Deadlock detection**: a state with no enabled action that the model
+  does not declare terminal is reported with its (shortest) path.
+* **Weak-fairness lasso detection** for liveness (`<> goal`): after the
+  full state graph is built, Tarjan SCCs of the subgraph induced on
+  non-goal states are tested.  An SCC admits a weakly-fair lasso iff every
+  weakly-fair action label enabled in *every* state of the SCC has an edge
+  inside the SCC — exactness holds because the witness cycle constructed
+  below visits every SCC state, so its continuously-enabled label set is
+  precisely the SCC-wide one.
+
+Stdlib only; no imports from quickwit_tpu (the artifact layer bridges the
+two worlds, see `artifact.py`).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator, Optional
+
+State = Any
+Label = str
+
+_dumps = functools.partial(json.dumps, sort_keys=True,
+                           separators=(",", ":"))
+
+
+# ----------------------------------------------------------------------
+# canonical frozen forms
+
+
+def freeze(value: State) -> Any:
+    """Recursively convert a JSON-shaped structure into a hashable
+    canonical form (dicts become sorted key/value tuples)."""
+    if isinstance(value, dict):
+        return ("d",) + tuple(
+            (k, freeze(v)) for k, v in sorted(value.items()))
+    if isinstance(value, (list, tuple)):
+        return ("l",) + tuple(freeze(v) for v in value)
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    raise TypeError(f"unfreezable state component: {type(value).__name__}")
+
+
+def rename(value: State, mapping: dict[str, str]) -> State:
+    """Apply an id-renaming symmetry: every string (key or value) that is
+    exactly a mapped id is replaced.  Substrings are never touched."""
+    if isinstance(value, dict):
+        return {mapping.get(k, k) if isinstance(k, str) else k:
+                rename(v, mapping) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [rename(v, mapping) for v in value]
+    if isinstance(value, str):
+        return mapping.get(value, value)
+    return value
+
+
+# ----------------------------------------------------------------------
+# model protocol
+
+
+class Model:
+    """Base class for checkable models.  Subclasses override the hooks;
+    every hook must be deterministic and must not mutate its input state
+    (return fresh structures from `actions`)."""
+
+    name = "model"
+
+    #: config dict recorded into artifacts; must rebuild the model via
+    #: `build_model(name, **config)` for counterexample replay
+    config: dict[str, Any] = {}
+
+    def initial_state(self) -> State:
+        raise NotImplementedError
+
+    def actions(self, state: State) -> list[tuple[Label, State]]:
+        """All enabled actions as (label, successor) pairs.  Labels must be
+        unique within one state (include parameters, e.g. ``crash(n1)``) so
+        counterexample paths replay deterministically."""
+        raise NotImplementedError
+
+    def invariants(self) -> list[tuple[str, Callable[[State], bool]]]:
+        return []
+
+    def transition_invariants(
+            self) -> list[tuple[str, Callable[[State, Label, State], bool]]]:
+        return []
+
+    def is_terminal(self, state: State) -> bool:
+        """True if it is acceptable for this state to have no enabled
+        actions (otherwise a successor-less state is a deadlock)."""
+        return False
+
+    def symmetries(self) -> list[dict[str, str]]:
+        """Id-renaming permutations (excluding identity is fine; the
+        kernel always includes it)."""
+        return []
+
+    def liveness_goal(self) -> Optional[Callable[[State], bool]]:
+        """Predicate for the liveness property ``<> goal``, or None to
+        skip liveness checking."""
+        return None
+
+    def weakly_fair(self, label: Label) -> bool:
+        """Whether an action label is weakly fair (cannot stay enabled
+        forever without firing)."""
+        return False
+
+
+# ----------------------------------------------------------------------
+# results
+
+
+@dataclass
+class ModelViolation:
+    """A property violation with its minimal witness.
+
+    ``kind`` is one of ``invariant`` / ``transition_invariant`` /
+    ``deadlock`` / ``liveness``.  ``path`` is the shortest label sequence
+    from the initial state to the violating state (for liveness: to the
+    lasso entry), and ``cycle`` (liveness only) is the label sequence of a
+    weakly-fair cycle that never reaches the goal.  ``state`` is the
+    concrete violating state — a genuine execution endpoint, valid for
+    deterministic replay via `replay_path`.
+    """
+
+    kind: str
+    name: str
+    path: list[Label]
+    state: State
+    cycle: list[Label] = field(default_factory=list)
+
+    def to_dict(self) -> dict[str, Any]:
+        out = {"kind": self.kind, "name": self.name,
+               "path": list(self.path), "state": self.state}
+        if self.cycle:
+            out["cycle"] = list(self.cycle)
+        return out
+
+
+@dataclass
+class CheckResult:
+    model: str
+    config: dict[str, Any]
+    states: int
+    transitions: int
+    depth: int
+    violation: Optional[ModelViolation]
+    complete: bool  # False when a depth bound cut exploration short
+
+    @property
+    def ok(self) -> bool:
+        return self.violation is None
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "model": self.model,
+            "config": dict(self.config),
+            "states": self.states,
+            "transitions": self.transitions,
+            "depth": self.depth,
+            "complete": self.complete,
+            "ok": self.ok,
+            "violation": None if self.violation is None
+            else self.violation.to_dict(),
+        }
+
+
+# ----------------------------------------------------------------------
+# checking
+
+
+class _Space:
+    """Explored state space: canonical key -> bookkeeping."""
+
+    def __init__(self) -> None:
+        # key -> (parent_key | None, label | None, concrete_state, depth)
+        self.nodes: dict[Any, tuple[Any, Optional[Label], State, int]] = {}
+        # key -> list of (label, succ_key); filled during BFS, used by the
+        # liveness pass
+        self.edges: dict[Any, list[tuple[Label, Any]]] = {}
+
+    def path_to(self, key: Any) -> list[Label]:
+        labels: list[Label] = []
+        while True:
+            parent, label, _state, _depth = self.nodes[key]
+            if parent is None:
+                break
+            labels.append(label)  # type: ignore[arg-type]
+            key = parent
+        labels.reverse()
+        return labels
+
+
+def _canonicalize(state: State, perms: list[dict[str, str]]) -> Any:
+    """Canonical hashable key: sorted-key JSON, minimized over the
+    symmetry group.  JSON strings give a C-speed total order (a renaming
+    permutes sibling subtrees, so structural tuple comparison could face
+    mixed types and raise)."""
+    key = _dumps(state)
+    for perm in perms:
+        candidate = _dumps(rename(state, perm))
+        if candidate < key:
+            key = candidate
+    return key
+
+
+def check_model(model: Model, depth: Optional[int] = None,
+                symmetry: bool = True,
+                max_states: int = 2_000_000) -> CheckResult:
+    """Exhaustively explore `model` (optionally to a BFS depth bound) and
+    return the first violation found, if any.  Safety violations are
+    reported as soon as a violating state/edge is *generated* (so their
+    paths are shortest); deadlock and liveness are judged on the explored
+    graph afterwards."""
+    perms = [p for p in model.symmetries() if p] if symmetry else []
+    invariants = model.invariants()
+    transition_invariants = model.transition_invariants()
+
+    # memoized canonicalization: distinct parents regenerate the same
+    # concrete successor often, and the symmetry minimization (rename +
+    # dumps per permutation) is the hottest part of the whole search
+    canon_cache: dict[str, str] = {}
+
+    def canon(state: State) -> str:
+        base = _dumps(state)
+        key = canon_cache.get(base)
+        if key is None:
+            if perms:
+                key = base
+                for perm in perms:
+                    candidate = _dumps(rename(state, perm))
+                    if candidate < key:
+                        key = candidate
+            else:
+                key = base
+            canon_cache[base] = key
+        return key
+
+    space = _Space()
+    init = model.initial_state()
+    init_key = canon(init)
+    space.nodes[init_key] = (None, None, init, 0)
+
+    for name, pred in invariants:
+        if not pred(init):
+            return CheckResult(
+                model.name, model.config, 1, 0, 0,
+                ModelViolation("invariant", name, [], init), True)
+
+    queue: deque[Any] = deque([init_key])
+    transitions = 0
+    max_depth_seen = 0
+    complete = True
+
+    while queue:
+        key = queue.popleft()
+        _parent, _label, state, d = space.nodes[key]
+        if depth is not None and d >= depth:
+            complete = False
+            continue
+        enabled = model.actions(state)
+        out_edges: list[tuple[Label, Any]] = []
+        if not enabled and not model.is_terminal(state):
+            return CheckResult(
+                model.name, model.config, len(space.nodes), transitions, d,
+                ModelViolation("deadlock", "no enabled actions",
+                               space.path_to(key), state), complete)
+        seen_labels: set[Label] = set()
+        for label, succ in enabled:
+            if label in seen_labels:
+                raise ValueError(
+                    f"{model.name}: duplicate action label {label!r} in one "
+                    f"state — labels must be unique for replay")
+            seen_labels.add(label)
+            transitions += 1
+            for name, tpred in transition_invariants:
+                if not tpred(state, label, succ):
+                    return CheckResult(
+                        model.name, model.config, len(space.nodes),
+                        transitions, d + 1,
+                        ModelViolation("transition_invariant", name,
+                                       space.path_to(key) + [label], succ),
+                        complete)
+            for name, pred in invariants:
+                if not pred(succ):
+                    return CheckResult(
+                        model.name, model.config, len(space.nodes),
+                        transitions, d + 1,
+                        ModelViolation("invariant", name,
+                                       space.path_to(key) + [label], succ),
+                        complete)
+            succ_key = canon(succ)
+            out_edges.append((label, succ_key))
+            if succ_key not in space.nodes:
+                if len(space.nodes) >= max_states:
+                    raise RuntimeError(
+                        f"{model.name}: state-space explosion "
+                        f"(> {max_states} states) — tighten the bound")
+                space.nodes[succ_key] = (key, label, succ, d + 1)
+                max_depth_seen = max(max_depth_seen, d + 1)
+                queue.append(succ_key)
+        space.edges[key] = out_edges
+
+    violation = None
+    goal = model.liveness_goal()
+    if goal is not None and complete:
+        violation = _find_fair_lasso(model, space, goal, perms)
+        if violation is not None and perms:
+            # Candidate only: in the symmetry quotient, parametrized labels
+            # from different orbit representatives mix inside one SCC, which
+            # can only SHRINK the always-enabled fair-label intersection —
+            # the quotient test over-approximates lassos (never misses one).
+            # Confirm on the unreduced graph, where the test is exact.
+            full = _explore_plain(model, max_states)
+            violation = _find_fair_lasso(model, full, goal, [])
+    return CheckResult(model.name, model.config, len(space.nodes),
+                       transitions, max_depth_seen, violation, complete)
+
+
+def _explore_plain(model: Model, max_states: int) -> _Space:
+    """Bare reachability BFS without symmetry reduction or property
+    checks — builds the exact state graph for the liveness confirm pass."""
+    space = _Space()
+    init = model.initial_state()
+    init_key = _dumps(init)
+    space.nodes[init_key] = (None, None, init, 0)
+    queue: deque[Any] = deque([init_key])
+    while queue:
+        key = queue.popleft()
+        _p, _l, state, d = space.nodes[key]
+        out_edges: list[tuple[Label, Any]] = []
+        for label, succ in model.actions(state):
+            succ_key = _dumps(succ)
+            out_edges.append((label, succ_key))
+            if succ_key not in space.nodes:
+                if len(space.nodes) >= max_states:
+                    raise RuntimeError(
+                        f"{model.name}: state-space explosion in liveness "
+                        f"confirm pass (> {max_states} states)")
+                space.nodes[succ_key] = (key, label, succ, d + 1)
+                queue.append(succ_key)
+        space.edges[key] = out_edges
+    return space
+
+
+# ----------------------------------------------------------------------
+# liveness: weak-fairness lasso detection
+
+
+def _tarjan_sccs(nodes: set[Any],
+                 edges: dict[Any, list[tuple[Label, Any]]]
+                 ) -> Iterator[list[Any]]:
+    """Iterative Tarjan over the subgraph induced on `nodes`."""
+    index: dict[Any, int] = {}
+    low: dict[Any, int] = {}
+    on_stack: set[Any] = set()
+    stack: list[Any] = []
+    counter = 0
+    for root in nodes:
+        if root in index:
+            continue
+        work = [(root, iter([k for _l, k in edges.get(root, [])
+                             if k in nodes]))]
+        index[root] = low[root] = counter
+        counter += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for succ in it:
+                if succ not in index:
+                    index[succ] = low[succ] = counter
+                    counter += 1
+                    stack.append(succ)
+                    on_stack.add(succ)
+                    work.append(
+                        (succ, iter([k for _l, k in edges.get(succ, [])
+                                     if k in nodes])))
+                    advanced = True
+                    break
+                if succ in on_stack:
+                    low[node] = min(low[node], index[succ])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    member = stack.pop()
+                    on_stack.discard(member)
+                    scc.append(member)
+                    if member == node:
+                        break
+                yield scc
+
+
+def _internal_path(start: Any, goal_key: Any, members: set[Any],
+                   edges: dict[Any, list[tuple[Label, Any]]]
+                   ) -> list[tuple[Label, Any]]:
+    """Shortest (label, key) path from start to goal within `members`.
+    Returns [] when start == goal_key."""
+    if start == goal_key:
+        return []
+    parents: dict[Any, tuple[Any, Label]] = {}
+    frontier = deque([start])
+    seen = {start}
+    while frontier:
+        node = frontier.popleft()
+        for label, succ in edges.get(node, []):
+            if succ not in members or succ in seen:
+                continue
+            parents[succ] = (node, label)
+            if succ == goal_key:
+                path: list[tuple[Label, Any]] = []
+                cur = succ
+                while cur != start:
+                    prev, lab = parents[cur]
+                    path.append((lab, cur))
+                    cur = prev
+                path.reverse()
+                return path
+            seen.add(succ)
+            frontier.append(succ)
+    raise AssertionError("SCC not strongly connected")  # pragma: no cover
+
+
+def _find_fair_lasso(model: Model, space: _Space,
+                     goal: Callable[[State], bool],
+                     perms: list[dict[str, str]]
+                     ) -> Optional[ModelViolation]:
+    """Search for a reachable weakly-fair cycle among non-goal states.
+
+    The SCC-level test is exact for action-label weak fairness: let E be
+    the set of weakly-fair labels enabled in EVERY state of the SCC.  Any
+    lasso inside the SCC has all of E continuously enabled, so a fair
+    lasso must fire each of them — impossible if some label in E has no
+    edge inside the SCC.  Conversely, when every label in E has an
+    internal edge, the witness constructed below visits every SCC state
+    (so its continuously-enabled set is exactly E) and takes one edge per
+    label in E, hence it is weakly fair.
+    """
+    non_goal = {k for k, (_p, _l, state, _d) in space.nodes.items()
+                if not goal(state)}
+    for scc in _tarjan_sccs(non_goal, space.edges):
+        members = set(scc)
+        internal = [(k, label, succ) for k in scc
+                    for label, succ in space.edges.get(k, [])
+                    if succ in members]
+        if not internal:
+            continue  # trivial SCC without self-loop: no lasso
+        # labels of weakly-fair actions enabled in EVERY member state
+        always_enabled: Optional[set[Label]] = None
+        for k in scc:
+            labels = {label for label, _succ in space.edges.get(k, [])
+                      if model.weakly_fair(label)}
+            always_enabled = labels if always_enabled is None \
+                else always_enabled & labels
+            if not always_enabled:
+                break
+        required = always_enabled or set()
+        internal_labels = {label for _k, label, _s in internal}
+        if not required <= internal_labels:
+            continue  # some fair action can never fire inside: no fair lasso
+        # Build the witness cycle: start anywhere, visit every member state
+        # (pins the continuously-enabled set to E), take one edge for each
+        # required label, and return to the start.
+        start = scc[0]
+        cycle_edges: list[tuple[Label, Any]] = []
+        cur = start
+        pending_states = [k for k in scc if k != start]
+        pending_labels = dict()
+        for lab in required:
+            for k, label, succ in internal:
+                if label == lab:
+                    pending_labels[lab] = (k, label, succ)
+                    break
+        for target in pending_states:
+            seg = _internal_path(cur, target, members, space.edges)
+            cycle_edges.extend(seg)
+            cur = target
+        for k, label, succ in pending_labels.values():
+            cycle_edges.extend(_internal_path(cur, k, members, space.edges))
+            cycle_edges.append((label, succ))
+            cur = succ
+        cycle_edges.extend(_internal_path(cur, start, members, space.edges))
+        if not cycle_edges:  # single state, required empty, has self-loop
+            for k, label, succ in internal:
+                if succ == start and k == start:
+                    cycle_edges = [(label, succ)]
+                    break
+        entry_state = space.nodes[start][2]
+        # Lift the quotient cycle to concrete labels from the entry state:
+        # with symmetry reduction on, stored edge labels are relative to
+        # each node's stored representative and may not replay verbatim
+        # from the entry state.  One concrete revolution suffices as a
+        # witness (the infinite lasso closes after at most |perm group|
+        # revolutions, fair by symmetry).
+        concrete = entry_state
+        lifted: list[Label] = []
+        for _label, next_key in cycle_edges:
+            for lab, succ in model.actions(concrete):
+                if _canonicalize(succ, perms) == next_key:
+                    lifted.append(lab)
+                    concrete = succ
+                    break
+            else:  # pragma: no cover - quotient edges always lift
+                raise AssertionError("failed to lift lasso cycle")
+        return ModelViolation(
+            "liveness", "weakly-fair lasso never reaches goal",
+            space.path_to(start), entry_state, cycle=lifted)
+    return None
+
+
+# ----------------------------------------------------------------------
+# replay
+
+
+def replay_path(model: Model, labels: list[Label],
+                cycle: Optional[list[Label]] = None) -> State:
+    """Deterministically re-execute a counterexample path from the initial
+    state, raising if any label is not enabled — the determinism oracle
+    for qwmc artifacts (mirrors `dst replay`).  When `cycle` is given the
+    lasso is replayed once around after the stem."""
+    state = model.initial_state()
+    for label in list(labels) + list(cycle or []):
+        enabled = dict(model.actions(state))
+        if label not in enabled:
+            raise ValueError(
+                f"replay diverged: action {label!r} not enabled "
+                f"(enabled: {sorted(enabled)})")
+        state = enabled[label]
+    return state

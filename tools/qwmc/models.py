@@ -1,0 +1,566 @@
+"""The two protocol models, extracted from the implementation.
+
+**ReplicationModel** — chained replication, from
+`quickwit_tpu/ingest/ingester.py` + `wal.py` + the DST cluster's chain
+wiring (`dst/cluster.py`):
+
+* ``persist(p)`` / ``replica_persist`` / ``ack`` / ``rollback`` mirror the
+  leader's critical section: append under the persist lock, replicate to
+  the first alive non-leader, ack only when both copies hold the batch,
+  roll the leader tail back when the chain cannot be completed (no
+  follower, or the candidate refuses because it holds a leader-role copy —
+  `ingester.py` ``persist``/``replica_persist``).
+* ``replica_persist`` is modeled as *full convergence* (follower log :=
+  leader log): the real protocol sends one batch and heals gaps by
+  backfilling from ``gap.have`` (`cluster.py _make_replicate`), which
+  converges to the same post-state because every reset position is bounded
+  by the registered leader's head (see `docs/model-checking.md`).
+* ``wal_fsync(n)`` exposes the fsync boundary explicitly: with
+  ``fsync=True`` (production `Ingester` default) every append advances the
+  durable watermark atomically; with ``fsync=False`` durability lags until
+  an explicit fsync, and ``crash(n)`` truncates the log to the durable
+  prefix (power-loss semantics, `wal.py` recovery contract).
+* ``promote_replica(n)`` / ``restart(n)`` / ``restart_demote(n)`` mirror
+  failover against a durable *chain registry* (the metastore records the
+  current ``(leader, follower)`` pair): promotion is only offered to the
+  REGISTERED follower — the one node guaranteed to hold the complete
+  acked prefix; a replica that crashed and rejoined is stale even though
+  its disk looks healthy, and checking at ``crashes=2`` is what exposed
+  that a mere per-replica "synced" flag is not a sound eligibility rule.
+  A crashed leader that rejoins after its replica was promoted demotes
+  its stale leader-role copy on restart (``restart_demote`` = restart +
+  ``replica_reset`` at the published checkpoint).  The
+  ``stale_rejoin=True`` variant disables the demotion — reproducing the
+  defect this model surfaced in the implementation (a rejoined stale
+  leader re-uses positions and the checkpoint race loses an acked
+  record).
+* ``publish_from(n)`` / ``truncate(n)`` / ``replica_truncate(n)`` abstract
+  the drain → publish → truncate path to a single shared checkpoint cursor
+  (the metastore CAS admits exactly one publisher per position).
+* ``break_wal=True`` plants the `QW_DST_BREAK_WAL` bug: the replication
+  link drops each batch's tail and swallows the gap report.
+
+Checked properties: **zero-loss failover** (every acked record is
+published or still on some disk, dead disks included), **no duplicate
+publish**, durable-watermark bounds, **checkpoint monotonicity** and
+published-sequence append-onlyness (transition invariants), deadlock
+freedom, and the liveness goal that every producer op eventually resolves
+under weak fairness of the recovery actions.
+
+**CheckpointModel** — WAL drain → publish → truncate checkpointing, from
+`indexing/pipeline.py` + `metastore/checkpoint.py` + `file_backed.py`:
+
+* ``ingest`` appends to the WAL; record == position (sequential ints).
+* ``read(i)`` stages a drain from the indexer's cached checkpoint view
+  (`pipeline.py run_to_completion` reads the source checkpoint once);
+  ``publish(i)`` is the CAS: a delta whose ``from`` matches the metastore
+  checkpoint publishes and advances it, a stale delta is rejected
+  (`checkpoint.py try_apply_delta` → IncompatibleCheckpointDelta) and the
+  staged splits are dropped for the next pass to redo.
+* ``poll(i)`` refreshes a stale view (`file_backed.py` polling);
+  ``truncate`` reclaims the WAL behind the checkpoint; ``crash(i)`` kills
+  a pipeline mid-drain — staged splits are garbage, the restarted pipeline
+  re-reads the checkpoint.
+* ``break_publish=True`` plants `QW_DST_BREAK_PUBLISH`: drains always
+  restart from position zero into a fresh partition and never truncate, so
+  the second pass duplicates every record.
+
+Checked properties: **exactly-once publish**, **no loss** (truncated
+records must have been published), checkpoint bounds + monotonicity,
+published append-onlyness, deadlock freedom, and liveness: all ingested
+records are eventually published (weak fairness of ``poll`` is what rules
+out the stale-view CAS-retry livelock — remove it and the checker reports
+the lasso).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from .kernel import Label, Model, State
+
+
+def _end(node: dict[str, Any]) -> int:
+    return node["first"] + len(node["recs"])
+
+
+class ReplicationModel(Model):
+    name = "replication"
+
+    def __init__(self, nodes: int = 3, producers: int = 2, ops: int = 2,
+                 crashes: int = 1, fsync: bool = True,
+                 break_wal: bool = False, stale_rejoin: bool = False):
+        self.n_nodes = nodes
+        self.n_producers = producers
+        self.ops = ops
+        self.crashes = crashes
+        self.fsync = fsync
+        self.break_wal = break_wal
+        self.stale_rejoin = stale_rejoin
+        self.config = {
+            "nodes": nodes, "producers": producers, "ops": ops,
+            "crashes": crashes, "fsync": fsync, "break_wal": break_wal,
+            "stale_rejoin": stale_rejoin,
+        }
+        self.node_ids = [f"n{i}" for i in range(nodes)]
+        self.producer_ids = [f"p{i}" for i in range(producers)]
+
+    # ------------------------------------------------------------------
+    def initial_state(self) -> State:
+        return {
+            "nodes": {
+                nid: {"alive": True,
+                      "role": "leader" if i == 0 else None,
+                      "first": 0, "recs": [], "durable": 0}
+                for i, nid in enumerate(self.node_ids)},
+            # the durable chain registry (metastore shard-leadership
+            # records): who leads, and which single replica is the
+            # current chain target — the only node promotion may pick
+            "leader": self.node_ids[0],
+            "follower": None,
+            "pending": None,
+            "acked": [],
+            "remaining": {pid: self.ops for pid in self.producer_ids},
+            "next_rec": 0,
+            "pub_pos": 0,
+            "published": [],
+            "crashes_left": self.crashes,
+        }
+
+    @staticmethod
+    def _copy(s: State) -> State:
+        # hand-rolled deep copy of the known state shape: this is the
+        # hottest path in the whole checker (one copy per transition)
+        return {
+            "nodes": {nid: {"alive": n["alive"], "role": n["role"],
+                            "first": n["first"],
+                            "recs": list(n["recs"]),
+                            "durable": n["durable"]}
+                      for nid, n in s["nodes"].items()},
+            "leader": s["leader"],
+            "follower": s["follower"],
+            "pending": None if s["pending"] is None else dict(s["pending"]),
+            "acked": [list(a) for a in s["acked"]],
+            "remaining": dict(s["remaining"]),
+            "next_rec": s["next_rec"],
+            "pub_pos": s["pub_pos"],
+            "published": list(s["published"]),
+            "crashes_left": s["crashes_left"],
+        }
+
+    # ------------------------------------------------------------------
+    def _follower_candidate(self, s: State) -> Optional[str]:
+        """First alive non-leader, in node order — `cluster.py
+        _follower_for` (which iterates `alive_nodes()` sorted)."""
+        for nid in self.node_ids:
+            if nid != s["leader"] and s["nodes"][nid]["alive"]:
+                return nid
+        return None
+
+    def actions(self, s: State) -> list[tuple[Label, State]]:
+        out: list[tuple[Label, State]] = []
+        leader_id = s["leader"]
+        leader = s["nodes"][leader_id]
+        pending = s["pending"]
+
+        # persist(p): leader appends the batch inside its critical section
+        if pending is None and leader["alive"]:
+            for pid in self.producer_ids:
+                if s["remaining"][pid] <= 0:
+                    continue
+                t = self._copy(s)
+                tl = t["nodes"][leader_id]
+                tl["recs"].append(t["next_rec"])
+                if self.fsync:
+                    tl["durable"] = _end(tl)
+                t["pending"] = {"producer": pid, "rec": t["next_rec"],
+                                "stage": "appended"}
+                t["next_rec"] += 1
+                out.append((f"persist({pid})", t))
+
+        if pending is not None and pending["stage"] == "appended" \
+                and leader["alive"]:
+            cand = self._follower_candidate(s)
+            if cand is not None \
+                    and s["nodes"][cand]["role"] != "leader":
+                # replica_persist: converge the follower to the leader log
+                # (batch + gap backfill), registering it as the chain
+                # target first (durably, so promotion after a total outage
+                # still picks the right node); under break_wal the link
+                # drops the batch tail and swallows the gap report
+                t = self._copy(s)
+                tl, tf = t["nodes"][leader_id], t["nodes"][cand]
+                tf["role"] = "replica"
+                tf["first"] = tl["first"]
+                tf["recs"] = list(tl["recs"][:-1] if self.break_wal
+                                  else tl["recs"])
+                tf["durable"] = _end(tf) if self.fsync else tf["first"]
+                t["follower"] = cand
+                t["pending"]["stage"] = "replicated"
+                out.append(("replica_persist", t))
+            else:
+                # no completable chain (no follower, or the candidate
+                # holds a leader-role copy and refuses): NACK + roll the
+                # leader tail back (`ingester.py persist` except-path).
+                # The tail-match check keeps the action total in the
+                # split-brain bug variants, where a stale peer's publishes
+                # can truncate the in-flight tail out from under us.
+                t = self._copy(s)
+                tl = t["nodes"][leader_id]
+                if tl["recs"] and tl["recs"][-1] == pending["rec"]:
+                    tl["recs"].pop()
+                    tl["durable"] = min(tl["durable"], _end(tl))
+                t["remaining"][pending["producer"]] -= 1
+                t["pending"] = None
+                out.append(("rollback", t))
+
+        if pending is not None and pending["stage"] == "replicated":
+            # ack: both copies hold the record; the client is answered
+            t = self._copy(s)
+            t["acked"].append([_end(leader) - 1, pending["rec"]])
+            t["remaining"][pending["producer"]] -= 1
+            t["pending"] = None
+            out.append(("ack", t))
+
+        if not self.fsync:
+            for nid in self.node_ids:
+                n = s["nodes"][nid]
+                if n["alive"] and n["durable"] < _end(n):
+                    t = self._copy(s)
+                    t["nodes"][nid]["durable"] = _end(t["nodes"][nid])
+                    out.append((f"wal_fsync({nid})", t))
+
+        # crash(n): power loss — the log survives truncated to the
+        # durable prefix; a leader crash mid-persist errors the client
+        if s["crashes_left"] > 0:
+            for nid in self.node_ids:
+                n = s["nodes"][nid]
+                if not n["alive"]:
+                    continue
+                t = self._copy(s)
+                tn = t["nodes"][nid]
+                tn["alive"] = False
+                tn["recs"] = tn["recs"][:tn["durable"] - tn["first"]]
+                if nid == leader_id and t["pending"] is not None:
+                    t["remaining"][t["pending"]["producer"]] -= 1
+                    t["pending"] = None
+                t["crashes_left"] -= 1
+                out.append((f"crash({nid})", t))
+
+        for nid in self.node_ids:
+            n = s["nodes"][nid]
+            if n["alive"]:
+                continue
+            t = self._copy(s)
+            tn = t["nodes"][nid]
+            tn["alive"] = True
+            if tn["role"] == "leader" and t["leader"] != nid \
+                    and not self.stale_rejoin:
+                # leadership moved while this node was down: the registry
+                # says another node leads, so the recovered leader-role
+                # copy demotes itself — replica_reset at the published
+                # checkpoint (the durability floor); replica_persist
+                # backfill heals it from there
+                tn["role"] = "replica"
+                tn["first"] = t["pub_pos"]
+                tn["recs"] = []
+                tn["durable"] = t["pub_pos"]
+                out.append((f"restart_demote({nid})", t))
+            else:
+                out.append((f"restart({nid})", t))
+
+        # promote_replica(n): failover onto the REGISTERED chain follower
+        # only — any other replica (e.g. one that crashed and rejoined
+        # after the chain moved on) may be missing acked records.  The
+        # stale_rejoin (pre-fix) variant promotes any replica, like the
+        # implementation did before the chain registry existed.
+        if not leader["alive"]:
+            if self.stale_rejoin:
+                candidates = [nid for nid in self.node_ids
+                              if s["nodes"][nid]["alive"]
+                              and s["nodes"][nid]["role"] == "replica"]
+            else:
+                candidates = [s["follower"]] if s["follower"] is not None \
+                    else []
+            for nid in candidates:
+                n = s["nodes"][nid]
+                if n["alive"] and n["role"] == "replica":
+                    t = self._copy(s)
+                    tn = t["nodes"][nid]
+                    tn["role"] = "leader"
+                    if not self.stale_rejoin and _end(tn) < t["pub_pos"]:
+                        # the published checkpoint is past this log's head
+                        # (the old leader's recovery-committed tail was
+                        # drained): forward-reset so new appends cannot
+                        # land on already-consumed positions — everything
+                        # dropped is below the checkpoint, hence published
+                        tn["first"] = t["pub_pos"]
+                        tn["recs"] = []
+                        tn["durable"] = t["pub_pos"]
+                    t["leader"] = nid
+                    t["follower"] = None
+                    out.append((f"promote_replica({nid})", t))
+
+        # publish_from(n): drain → publish; the shared checkpoint cursor
+        # admits exactly one publisher per position (metastore CAS).  The
+        # drain is clamped to the COMMITTED watermark: an in-flight
+        # appended-but-unreplicated tail is not publishable (`ingester.py
+        # Shard.committed_position` bounds fetch the same way)
+        for nid in self.node_ids:
+            n = s["nodes"][nid]
+            committed = _end(n)
+            if nid == leader_id and pending is not None \
+                    and pending["stage"] == "appended":
+                committed -= 1
+            if n["alive"] and n["role"] == "leader" \
+                    and n["first"] <= s["pub_pos"] < committed:
+                t = self._copy(s)
+                tn = t["nodes"][nid]
+                t["published"].append(tn["recs"][t["pub_pos"] - tn["first"]])
+                t["pub_pos"] += 1
+                out.append((f"publish_from({nid})", t))
+
+        # truncate behind the published checkpoint (leader truncate or
+        # the propagated replica_truncate)
+        for nid in self.node_ids:
+            n = s["nodes"][nid]
+            if not n["alive"] or n["role"] not in ("leader", "replica"):
+                continue
+            drop = min(s["pub_pos"], _end(n)) - n["first"]
+            if drop <= 0:
+                continue
+            t = self._copy(s)
+            tn = t["nodes"][nid]
+            tn["first"] += drop
+            tn["recs"] = tn["recs"][drop:]
+            tn["durable"] = max(tn["durable"], tn["first"])
+            verb = "truncate" if n["role"] == "leader" else "replica_truncate"
+            out.append((f"{verb}({nid})", t))
+
+        return out
+
+    # ------------------------------------------------------------------
+    def invariants(self) -> list[tuple[str, Callable[[State], bool]]]:
+        def zero_loss(s: State) -> bool:
+            published = set(s["published"])
+            on_disk = {rec for n in s["nodes"].values() for rec in n["recs"]}
+            return all(rec in published or rec in on_disk
+                       for _pos, rec in s["acked"])
+
+        def no_dup_publish(s: State) -> bool:
+            return len(s["published"]) == len(set(s["published"]))
+
+        def durable_bounds(s: State) -> bool:
+            return all(n["first"] <= n["durable"] <= _end(n)
+                       for n in s["nodes"].values())
+
+        return [("zero_loss", zero_loss),
+                ("no_dup_publish", no_dup_publish),
+                ("durable_bounds", durable_bounds)]
+
+    def transition_invariants(
+            self) -> list[tuple[str, Callable[[State, Label, State], bool]]]:
+        return [
+            ("checkpoint_monotonic",
+             lambda s, _l, t: t["pub_pos"] >= s["pub_pos"]),
+            ("published_append_only",
+             lambda s, _l, t: t["published"][:len(s["published"])]
+             == s["published"]),
+        ]
+
+    def is_terminal(self, s: State) -> bool:
+        return s["pending"] is None \
+            and all(v == 0 for v in s["remaining"].values())
+
+    def liveness_goal(self) -> Optional[Callable[[State], bool]]:
+        return self.is_terminal
+
+    def weakly_fair(self, label: Label) -> bool:
+        # the recovery/progress actions a supervisor keeps retrying; the
+        # chaos actions (crash, fsync timing, publish/truncate pacing)
+        # are unconstrained
+        return label.split("(")[0] in {
+            "persist", "replica_persist", "ack", "rollback", "restart",
+            "restart_demote", "promote_replica"}
+
+    def symmetries(self) -> list[dict[str, str]]:
+        perms: list[dict[str, str]] = []
+        # non-initial-leader nodes are interchangeable, producers too
+        node_swaps = [{}]
+        if self.n_nodes == 3:
+            node_swaps.append({"n1": "n2", "n2": "n1"})
+        prod_swaps = [{}]
+        if self.n_producers == 2:
+            prod_swaps.append({"p0": "p1", "p1": "p0"})
+        for ns in node_swaps:
+            for ps in prod_swaps:
+                if ns or ps:
+                    perms.append({**ns, **ps})
+        return perms
+
+
+class CheckpointModel(Model):
+    name = "checkpoint"
+
+    def __init__(self, records: int = 3, indexers: int = 2,
+                 crashes: int = 1, break_publish: bool = False):
+        self.records = records
+        self.n_indexers = indexers
+        self.crashes = crashes
+        self.break_publish = break_publish
+        self.config = {"records": records, "indexers": indexers,
+                       "crashes": crashes, "break_publish": break_publish}
+        self.indexer_ids = [f"i{i}" for i in range(indexers)]
+
+    # ------------------------------------------------------------------
+    def initial_state(self) -> State:
+        return {
+            "first": 0,        # retained-WAL start (truncation watermark)
+            "next": 0,         # WAL head; record k lives at position k
+            "ckpt": 0,         # metastore source checkpoint (CAS-guarded)
+            "published": [],
+            "indexers": {iid: {"view": 0, "staged": None}
+                         for iid in self.indexer_ids},
+            "crashes_left": self.crashes,
+        }
+
+    @staticmethod
+    def _copy(s: State) -> State:
+        return {
+            "first": s["first"], "next": s["next"], "ckpt": s["ckpt"],
+            "published": list(s["published"]),
+            "indexers": {iid: {"view": ix["view"],
+                               "staged": None if ix["staged"] is None
+                               else dict(ix["staged"])}
+                         for iid, ix in s["indexers"].items()},
+            "crashes_left": s["crashes_left"],
+        }
+
+    def actions(self, s: State) -> list[tuple[Label, State]]:
+        out: list[tuple[Label, State]] = []
+
+        if s["next"] < self.records:
+            t = self._copy(s)
+            t["next"] += 1
+            out.append(("ingest", t))
+
+        for iid in self.indexer_ids:
+            ix = s["indexers"][iid]
+
+            if ix["view"] != s["ckpt"]:
+                # refresh a stale cached checkpoint (metastore polling)
+                t = self._copy(s)
+                t["indexers"][iid]["view"] = t["ckpt"]
+                out.append((f"poll({iid})", t))
+
+            if ix["staged"] is None:
+                # read(i): stage a drain from the cached checkpoint view;
+                # the planted publish bug always re-reads from zero
+                lo = s["first"] if self.break_publish \
+                    else max(ix["view"], s["first"])
+                if lo < s["next"]:
+                    t = self._copy(s)
+                    t["indexers"][iid]["staged"] = {"from": lo,
+                                                    "to": t["next"]}
+                    out.append((f"read({iid})", t))
+            else:
+                # publish(i): the checkpoint CAS — or, under the planted
+                # bug, an unconditional publish into a fresh partition
+                t = self._copy(s)
+                staged = t["indexers"][iid]["staged"]
+                if self.break_publish:
+                    t["published"].extend(
+                        range(staged["from"], staged["to"]))
+                elif staged["from"] == t["ckpt"]:
+                    t["published"].extend(
+                        range(staged["from"], staged["to"]))
+                    t["ckpt"] = staged["to"]
+                # else: IncompatibleCheckpointDelta — splits dropped,
+                # the next read()/publish() pass redoes the work
+                t["indexers"][iid]["staged"] = None
+                out.append((f"publish({iid})", t))
+
+                if s["crashes_left"] > 0:
+                    # crash(i): pipeline dies mid-drain; staged splits are
+                    # garbage-collected, the restart re-reads the metastore
+                    t = self._copy(s)
+                    t["indexers"][iid]["staged"] = None
+                    t["indexers"][iid]["view"] = t["ckpt"]
+                    t["crashes_left"] -= 1
+                    out.append((f"crash({iid})", t))
+
+        if not self.break_publish and s["first"] < s["ckpt"]:
+            t = self._copy(s)
+            t["first"] = t["ckpt"]
+            out.append(("truncate", t))
+
+        return out
+
+    # ------------------------------------------------------------------
+    def invariants(self) -> list[tuple[str, Callable[[State], bool]]]:
+        def exactly_once(s: State) -> bool:
+            return len(s["published"]) == len(set(s["published"]))
+
+        def no_loss(s: State) -> bool:
+            # every truncated record must have been published
+            published = set(s["published"])
+            return all(r in published for r in range(s["first"]))
+
+        def ckpt_bounds(s: State) -> bool:
+            if not (s["first"] <= s["ckpt"] <= s["next"]):
+                return False
+            for ix in s["indexers"].values():
+                if ix["view"] > s["ckpt"]:
+                    return False
+                if ix["staged"] is not None and not \
+                        (0 <= ix["staged"]["from"] <= ix["staged"]["to"]
+                         <= s["next"]):
+                    return False
+            return True
+
+        return [("exactly_once", exactly_once), ("no_loss", no_loss),
+                ("ckpt_bounds", ckpt_bounds)]
+
+    def transition_invariants(
+            self) -> list[tuple[str, Callable[[State, Label, State], bool]]]:
+        return [
+            ("checkpoint_monotonic",
+             lambda s, _l, t: t["ckpt"] >= s["ckpt"]),
+            ("published_append_only",
+             lambda s, _l, t: t["published"][:len(s["published"])]
+             == s["published"]),
+        ]
+
+    def is_terminal(self, s: State) -> bool:
+        return s["next"] == self.records and s["ckpt"] == s["next"] \
+            and all(ix["staged"] is None for ix in s["indexers"].values())
+
+    def liveness_goal(self) -> Optional[Callable[[State], bool]]:
+        return self.is_terminal
+
+    def weakly_fair(self, label: Label) -> bool:
+        # poll's fairness is load-bearing: without it the stale-view
+        # read → CAS-reject → read livelock is a legitimate lasso
+        return label.split("(")[0] in {"ingest", "poll", "read", "publish"}
+
+    def symmetries(self) -> list[dict[str, str]]:
+        if self.n_indexers == 2:
+            return [{"i0": "i1", "i1": "i0"}]
+        return []
+
+
+# ----------------------------------------------------------------------
+
+MODELS: dict[str, type[Model]] = {
+    "replication": ReplicationModel,
+    "checkpoint": CheckpointModel,
+}
+
+
+def build_model(name: str, **config: Any) -> Model:
+    """Construct a model by name with config overrides — the constructor
+    used both by the CLI and by counterexample-artifact replay."""
+    if name not in MODELS:
+        raise ValueError(
+            f"unknown model {name!r} (known: {sorted(MODELS)})")
+    return MODELS[name](**config)
