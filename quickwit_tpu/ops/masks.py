@@ -16,6 +16,21 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+# qwir R5 anchor: the per-doc live-lane byte budget of the leaf kernel.
+# Dense doc-space intermediates are minted here (bool masks, scatter
+# targets) and in executor._keyed_for (f64 sort keys): at any point the
+# kernel holds at most ~8 doc-scale lanes live — predicate masks under a
+# bool combine, the valid-docs mask, f32 scores, two f64 sort keys, the
+# i32 doc key, and a zonemap-blocked compare temp — ≈ 40 bytes/doc, with
+# headroom for XLA keeping a few extra temps unfused. tools/qwir's
+# buffer-liveness walk (rule R5) enforces
+#     peak_bytes <= inputs + QWIR_PEAK_PER_DOC_BYTES * doc_lanes + fixed
+# per audited program: a change that starts materializing O(docs) state
+# beyond this budget (e.g. a [docs, docs] pairwise temp, or per-doc
+# bucket replication) fails the audit instead of silently eating HBM that
+# admission (search/admission.py::HbmBudget) never accounted.
+QWIR_PEAK_PER_DOC_BYTES = 96
+
 
 def mask_from_postings(doc_ids: jnp.ndarray, num_docs_padded: int) -> jnp.ndarray:
     """Presence mask from a (padded) posting id array."""

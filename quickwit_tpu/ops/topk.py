@@ -33,6 +33,33 @@ _BLOCK = 1024  # == index.format.DOC_PAD, so dense doc arrays always divide
 # once at import, never inside a traced function)
 F32_LOWEST = float(jnp.finfo(jnp.float32).min)
 
+# qwir R2 certification registry: the functions below are the ONLY
+# sanctioned f64 sort/top_k sites in the leaf kernel. tools/qwir attributes
+# every f64-keyed sort eqn in the audited jaxprs to its defining frame and
+# fails the audit unless that frame is certified here (or in the sibling
+# registries in search/executor.py and parallel/fanout.py). Justifications
+# are part of the certificate — keep them true.
+QWIR_CERTIFIED_F64 = {
+    "exact_topk": (
+        "the exact blockwise two-stage: per-block sorts are fixed at "
+        "_BLOCK=1024 lanes and the stage-2 re-top-k runs over G*k winners "
+        "— never a corpus-scale full sort (the ~290ms lax.top_k f64 "
+        "full-sort this kernel replaced)."),
+    "guided_topk": (
+        "f32 screen + f64 refine over G*(k+1) gathered candidates with an "
+        "exactness certificate; the only f64 top_k runs over the candidate "
+        "set, and unsafe screens re-dispatch through exact_topk."),
+    "exact_topk_2key": (
+        "2-key lexicographic top-k has no f32 screen (distinct f64 "
+        "primary keys may collapse in f32 and flip the key2 tie-break); "
+        "the f64 lax.sort stays blockwise: 1024-lane block sorts plus a "
+        "G*k stage-2, bit-exact by the block-winner argument."),
+    "_pad_to_block": (
+        "concatenates -inf pad lanes in the operand's own dtype so the "
+        "blockwise kernels above apply to non-multiple lengths — padding, "
+        "not promotion."),
+}
+
 
 def _pad_to_block(x: jnp.ndarray, k: int):
     """Pad `x` with -inf lanes up to a _BLOCK multiple so the blockwise

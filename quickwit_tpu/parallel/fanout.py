@@ -386,6 +386,49 @@ def batch_fn(batch: SplitBatch, k: int, exact: bool = False):
     return fn
 
 
+def batch_cache_key(batch: SplitBatch, k: int, mesh: Optional[Mesh],
+                    exact: bool = False) -> tuple:
+    """The `_BATCH_JIT_CACHE` key `dispatch_batch` uses, post k-clamp —
+    mirrored here for tools/qwir's compile-cache closure certificate (must
+    stay in lockstep with the key expression in `dispatch_batch`)."""
+    k = min(k, batch.num_docs_padded)
+    return (batch.template.signature(k), batch.n_splits,
+            batch.num_docs_padded, mesh, exact)
+
+
+def abstract_batch_program(batch: SplitBatch, k: int, exact: bool = False):
+    """ClosedJaxpr of the fused merged-batch program (`batch_fn`'s closure,
+    minus the packed f64 readback concat) — abstract-traced over
+    ShapeDtypeStructs, never compiled or executed, no mesh required.
+
+    The mesh variant jits the SAME closure with NamedShardings; GSPMD
+    inserts its collectives after this jaxpr, so collective-soundness
+    auditing (qwir R4) checks explicit shard_map/collective eqns here and
+    proves the named-axis contract on the declared ("splits", "docs")
+    axes."""
+    k = min(max(0, k), batch.num_docs_padded)
+    fn = batch_fn(batch, k, exact)
+    arrays = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                   for a in batch.arrays)
+    scalars = tuple(jax.ShapeDtypeStruct(s.shape, s.dtype)
+                    for s in batch.scalars)
+    nd = jax.ShapeDtypeStruct(batch.num_docs.shape, batch.num_docs.dtype)
+    return jax.make_jaxpr(fn)(arrays, scalars, nd)
+
+
+# qwir R2 certification registry (see executor.py's for semantics): the
+# cross-split merge re-top-k's the flattened per-split winners — an f64
+# sort over n_splits*k lanes, O(fan-out × page size), NOT corpus-scale.
+# The corpus-scale sorts it consumes already ran under the certified
+# ops/topk.py kernels inside the vmapped per-split programs.
+QWIR_CERTIFIED_F64 = {
+    "fn": (
+        "batch_fn's cross-split merge: lax.top_k / exact_topk_2key over "
+        "the flattened [n_splits*k] per-split winners — bounded by fan-out "
+        "times page size, never by corpus size."),
+}
+
+
 def _donate_batch_inputs() -> bool:
     """Donate the stacked batch arrays to the executor so XLA reuses their
     HBM as scratch: the stacks are per-request copies of the column data

@@ -1130,6 +1130,110 @@ def executor_cache_size() -> int:
     return len(_JIT_CACHE)
 
 
+# --- static-audit hooks (tools/qwir) -----------------------------------------
+#
+# The auditor (`python -m tools.qwir audit`) abstract-evals the SAME
+# closures the dispatch paths jit — `_build`, the vmapped multi-query
+# wrapper, the mask-fill kernel — over ShapeDtypeStructs. The audited
+# jaxpr therefore IS the program the compile caches key (modulo the packed
+# f64 readback concat, which is audited separately as the sanctioned
+# seam), with zero compilation, zero data movement, and zero devices
+# touched. The `*_cache_key` mirrors must stay in lockstep with the
+# dict-key expressions in `get_executor` / `_get_packed_executor` /
+# `_get_packed_multi_executor` / `compute_packed_mask` — the R1 closure
+# certificate is only a proof if the audited key IS the cache key.
+
+def program_cache_key(plan: LoweredPlan, k: int, exact: bool = False) -> tuple:
+    """The `_JIT_CACHE`/`_PACKED_CACHE` key for this plan, post k-clamp."""
+    k = max(0, min(k, plan.num_docs_padded))
+    return (plan.signature(k), exact)
+
+
+def multi_program_cache_key(plan: LoweredPlan, k: int, batch: int,
+                            exact: bool = False) -> tuple:
+    """The `_MULTI_CACHE` key (batch already bucketed by the caller)."""
+    k = max(0, min(k, plan.num_docs_padded))
+    return (plan.signature(k), batch, exact)
+
+
+def mask_fill_cache_key(plan: LoweredPlan) -> tuple:
+    """The `_MASK_FILL_CACHE` key for this plan's predicate-only kernel."""
+    return (plan.root.sig(),
+            tuple((a.shape, str(a.dtype)) for a in plan.arrays),
+            tuple(str(s.dtype) for s in map(np.asarray, plan.scalars)),
+            plan.num_docs_padded)
+
+
+def _abstract_inputs(plan: LoweredPlan):
+    arrays = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                   for a in plan.arrays)
+    scalars = tuple(jax.ShapeDtypeStruct(s.shape, s.dtype)
+                    for s in map(np.asarray, plan.scalars))
+    return arrays, scalars, jax.ShapeDtypeStruct((), np.int32)
+
+
+def abstract_program(plan: LoweredPlan, k: int, exact: bool = False):
+    """ClosedJaxpr of the single-split leaf program — traced, never run."""
+    k = max(0, min(k, plan.num_docs_padded))
+    fn = _build(plan, k, exact)
+    arrays, scalars, num_docs = _abstract_inputs(plan)
+    return jax.make_jaxpr(fn)(arrays, scalars, num_docs)
+
+
+def abstract_multi_program(plan: LoweredPlan, k: int, batch: int,
+                           exact: bool = False):
+    """ClosedJaxpr of the vmapped multi-query program for one batch bucket
+    (the closure `_get_packed_multi_executor` jits, minus the packed
+    concat)."""
+    k = max(0, min(k, plan.num_docs_padded))
+    fn = _build(plan, k, exact)
+    arrays, scalars, _ = _abstract_inputs(plan)
+    scal_b = tuple(jax.ShapeDtypeStruct((batch,) + s.shape, s.dtype)
+                   for s in scalars)
+    nd_b = jax.ShapeDtypeStruct((batch,), np.int32)
+
+    def multi(arrays, scal_b, nd_b):
+        return jax.vmap(lambda s, n: fn(arrays, s, n),
+                        in_axes=(0, 0))(scal_b, nd_b)
+
+    return jax.make_jaxpr(multi)(arrays, scal_b, nd_b)
+
+
+def abstract_mask_fill(plan: LoweredPlan):
+    """ClosedJaxpr of the Tier-A predicate-mask fill kernel
+    (`compute_packed_mask`'s jitted body)."""
+    padded = plan.num_docs_padded
+    root = plan.root
+    eval_node = _node_evaluator(padded)
+
+    def mask_fn(arrays, scalars, num_docs):
+        mask, _ = eval_node(root, arrays, scalars)
+        mask = mask & mask_ops.valid_docs_mask(num_docs, padded)
+        return _pack_mask(mask, padded)
+
+    arrays, scalars, num_docs = _abstract_inputs(plan)
+    return jax.make_jaxpr(mask_fn)(arrays, scalars, num_docs)
+
+
+# qwir R2 certification registry: functions in THIS module allowed to mint
+# doc-scale f64 lanes or feed f64 sorts. Keys are function qualnames as
+# they appear in jaxpr eqn source frames; values are the justification the
+# audit report carries. Keep justifications concrete — they are the
+# "inline justified suppression" the acceptance gate requires.
+QWIR_CERTIFIED_F64 = {
+    "_keyed_for": (
+        "the unified sort key IS f64 by contract: it must represent i64 "
+        "column values and epoch-micros exactly (f32 collapses distinct "
+        "timestamps). The corpus-scale-sort hazard this feeds is screened "
+        "by guided_topk's f32 path; exact f64 sorts are certified at "
+        "their ops/topk.py sites."),
+    "_apply_search_after": (
+        "search_after eligibility rewrites the f64 key lanes in place "
+        "(same dtype in, same dtype out) — no new f64 surface beyond "
+        "_keyed_for's certified key."),
+}
+
+
 # --- predicate-mask fill (Tier A, search/mask_cache.py) ----------------------
 
 _MASK_FILL_CACHE: dict[tuple, Callable] = {}

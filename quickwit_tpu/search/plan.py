@@ -376,6 +376,20 @@ class LoweredPlan:
                 k, self.num_docs_padded, self.search_after_relation,
                 self.sa_value2_slot >= 0, self.threshold_slot >= 0, rebase_sig)
 
+    def structure_digest(self, k: int) -> str:
+        """Stable hex digest of the compile-cache structure key.
+
+        The signature tuple is built from primitive types only (node sig
+        strings, shape tuples, dtype names, ints/bools), so its repr is
+        deterministic across processes — tools/qwir keys its compile-cache
+        closure manifest on this digest. Anything that changes the compiled
+        program's identity MUST flow through `signature` (and therefore
+        through this digest), or the closure certificate stops being a
+        proof."""
+        import hashlib
+        return hashlib.blake2b(repr(self.signature(k)).encode(),
+                               digest_size=16).hexdigest()
+
 
 class _Builder:
     def __init__(self, reader: SplitReader):

@@ -1,0 +1,69 @@
+"""The unified gate: `python -m tools.qwcheck` must run all three
+analyzers, merge their verdicts into one document, and fold their exit
+codes into one. These tests run the real gates (each is tier-1 fast)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tools.qwcheck.__main__ import _GATES, main
+
+
+def test_gate_list_is_pinned():
+    assert _GATES == ("qwlint", "qwmc", "qwir")
+
+
+def test_merged_json_and_exit_code(capsys):
+    rc = main(["--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["ok"] is True
+    for gate in _GATES:
+        assert out[gate]["ok"] is True
+    assert out["qwlint"]["findings"] == []
+    assert all(r["ok"] for r in out["qwmc"]["results"])
+    assert out["qwir"]["program_count"] > 0
+    assert out["qwir"]["self_test_failures"] == []
+
+
+def test_skip_marks_gate_skipped(capsys):
+    rc = main(["--json", "--skip", "qwmc", "--skip", "qwir"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["qwmc"] == {"ok": True, "skipped": True}
+    assert out["qwir"] == {"ok": True, "skipped": True}
+    assert "findings" in out["qwlint"]
+
+
+def test_failing_gate_fails_the_merge(monkeypatch, capsys):
+    import tools.qwcheck.__main__ as qwcheck
+    monkeypatch.setitem(qwcheck._RUNNERS, "qwmc",
+                        lambda: (1, {"ok": False, "results": []}))
+    rc = main(["--json", "--skip", "qwir"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["ok"] is False
+    assert out["qwmc"]["ok"] is False
+
+
+def test_crashing_gate_exits_2(monkeypatch, capsys):
+    import tools.qwcheck.__main__ as qwcheck
+
+    def boom():
+        raise RuntimeError("gate exploded")
+
+    monkeypatch.setitem(qwcheck._RUNNERS, "qwmc", boom)
+    rc = main(["--json", "--skip", "qwir", "--skip", "qwlint"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 2
+    assert out["qwmc"]["ok"] is False
+    assert "gate exploded" in out["qwmc"]["error"]
+
+
+def test_unknown_skip_is_a_usage_error(capsys):
+    with pytest.raises(SystemExit) as exc:
+        main(["--skip", "nonsense"])
+    assert exc.value.code == 2
+    capsys.readouterr()

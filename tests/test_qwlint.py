@@ -54,3 +54,49 @@ def test_baseline_never_grandfathers_new_modules():
                "quickwit_tpu/search/plan.py",
                "quickwit_tpu/serve/node.py"}
     assert {e["path"] for e in entries} <= allowed
+
+
+def test_prune_baseline_removes_only_stale_entries(tmp_path, capsys):
+    from tools.qwlint.__main__ import main
+
+    target = tmp_path / "hot.py"
+    target.write_text(
+        "import numpy as np\n\n"
+        "def hot(x):\n"
+        "    return float(x.sum())\n")
+    baseline = tmp_path / "baseline.json"
+    live = {"rule": "QW001", "path": "hot.py", "function": "hot",
+            "count": 1, "why": "fixture: known readback"}
+    stale = {"rule": "QW001", "path": "gone.py", "function": "old",
+             "count": 1, "why": "fixture: site was deleted"}
+    baseline.write_text(json.dumps({"entries": [live, stale]}))
+
+    # without --prune-baseline the stale entry is only reported
+    rc = main([str(target), "--root", str(tmp_path),
+               "--baseline", str(baseline)])
+    assert rc == 0
+    assert "stale baseline entry" in capsys.readouterr().err
+    assert len(load_baseline(str(baseline))) == 2
+
+    # with it, the baseline file is rewritten minus exactly the stale key
+    rc = main([str(target), "--root", str(tmp_path),
+               "--baseline", str(baseline), "--prune-baseline"])
+    assert rc == 0
+    assert "pruned 1 stale" in capsys.readouterr().err
+    remaining = load_baseline(str(baseline))
+    assert [(e["rule"], e["path"], e["function"]) for e in remaining] == [
+        ("QW001", "hot.py", "hot")]
+    assert remaining[0]["why"] == "fixture: known readback"
+
+    # idempotent: nothing stale left, file untouched
+    before = baseline.read_text()
+    assert main([str(target), "--root", str(tmp_path),
+                 "--baseline", str(baseline), "--prune-baseline"]) == 0
+    capsys.readouterr()
+    assert baseline.read_text() == before
+
+
+def test_prune_baseline_conflicts_with_no_baseline(capsys):
+    from tools.qwlint.__main__ import main
+    assert main(["--prune-baseline", "--no-baseline"]) == 2
+    assert "conflicts" in capsys.readouterr().err

@@ -1,0 +1,99 @@
+"""Strict structural validation of the SARIF 2.1.0 logs both CLIs emit
+(`qwlint --sarif`, `qwir audit --sarif`). No jsonschema dependency: the
+validator below checks exactly the invariants CI annotators rely on —
+version pin, run/tool/driver skeleton, rule metadata, result shape, and
+that every result's ruleId resolves to a declared rule."""
+
+from __future__ import annotations
+
+import json
+
+from tools.sarif import SARIF_VERSION, sarif_log, write_sarif
+
+
+def assert_valid_sarif(log: dict) -> None:
+    assert log["version"] == SARIF_VERSION == "2.1.0"
+    assert log["$schema"].endswith("sarif-schema-2.1.0.json")
+    assert isinstance(log["runs"], list) and len(log["runs"]) == 1
+    run = log["runs"][0]
+    driver = run["tool"]["driver"]
+    assert isinstance(driver["name"], str) and driver["name"]
+    rule_ids = set()
+    for rule in driver["rules"]:
+        assert isinstance(rule["id"], str) and rule["id"]
+        assert rule["shortDescription"]["text"]
+        rule_ids.add(rule["id"])
+    for result in run["results"]:
+        assert result["ruleId"] in rule_ids, (
+            f"result names undeclared rule {result['ruleId']}")
+        assert result["level"] in ("none", "note", "warning", "error")
+        assert isinstance(result["message"]["text"], str)
+        assert result["locations"], "every result needs a location"
+        for loc in result["locations"]:
+            phys = loc.get("physicalLocation")
+            logical = loc.get("logicalLocations")
+            assert phys or logical
+            if phys:
+                assert phys["artifactLocation"]["uri"]
+                if "region" in phys:
+                    assert phys["region"]["startLine"] >= 1
+            if logical:
+                assert all(l["fullyQualifiedName"] for l in logical)
+        for sup in result.get("suppressions", ()):
+            assert sup["kind"] in ("inSource", "external")
+
+
+def test_emitter_builds_valid_logs():
+    log = sarif_log(
+        tool="demo",
+        rules={"R1": "closure", "QW001": "readback"},
+        results=[
+            {"ruleId": "QW001", "message": "m", "file": "a/b.py",
+             "line": 3, "id": "QW001:a/b.py:f"},
+            {"ruleId": "R1", "message": "m2", "site": "prog:site",
+             "suppressed": True, "justification": "because"},
+        ])
+    assert_valid_sarif(log)
+    suppressed = log["runs"][0]["results"][1]
+    assert suppressed["level"] == "none"
+    assert suppressed["suppressions"][0]["justification"] == "because"
+
+
+def test_qwir_audit_sarif_is_valid(tmp_path):
+    from tools.qwir.__main__ import main
+    out = tmp_path / "qwir.sarif"
+    assert main(["audit", "--sarif", str(out)]) == 0
+    log = json.loads(out.read_text())
+    assert_valid_sarif(log)
+    run = log["runs"][0]
+    assert run["tool"]["driver"]["name"] == "qwir"
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} == \
+        {"R1", "R2", "R3", "R4", "R5"}
+    # the certified f64 suppressions ride along as level=none results
+    assert any(r["level"] == "none" for r in run["results"])
+
+
+def test_qwlint_sarif_is_valid(tmp_path):
+    from tools.qwlint.__main__ import main
+    out = tmp_path / "qwlint.sarif"
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import numpy as np\n\n"
+        "def hot(x):\n"
+        "    return float(x.sum())\n")
+    assert main([str(bad), "--root", str(tmp_path), "--no-baseline",
+                 "--sarif", str(out)]) == 1
+    log = json.loads(out.read_text())
+    assert_valid_sarif(log)
+    results = log["runs"][0]["results"]
+    assert any(r["ruleId"] == "QW001" for r in results)
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "bad.py"
+    assert loc["region"]["startLine"] == 4
+
+
+def test_write_sarif_round_trips(tmp_path):
+    path = tmp_path / "x.sarif"
+    log = write_sarif(path, tool="t", rules={"R": "r"},
+                      results=[{"ruleId": "R", "message": "m", "site": "s"}])
+    assert json.loads(path.read_text()) == log

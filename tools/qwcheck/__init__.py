@@ -1,0 +1,16 @@
+"""qwcheck — the one-command static gate.
+
+Runs every repo-grown analysis in-process and merges the verdicts:
+
+    qwlint  source-level lint of the hot path (tools/qwlint)
+    qwmc    exhaustive protocol model checking (tools/qwmc)
+    qwir    jaxpr-level audit of the lowered leaf programs + the
+            compile-cache closure certificate (tools/qwir)
+
+`python -m tools.qwcheck` exits 0 only when all three are clean; `--json`
+emits one merged document `{"qwlint": ..., "qwmc": ..., "qwir": ...,
+"ok": ...}` for CI. Individual tools remain runnable on their own; this
+package contains no analysis logic of its own.
+"""
+
+from __future__ import annotations

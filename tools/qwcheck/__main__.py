@@ -1,0 +1,102 @@
+"""CLI: `python -m tools.qwcheck [--json] [--skip TOOL ...]`.
+
+Exit codes: 0 all gates clean, 1 any gate found something, 2 a gate
+crashed or was misused. Each gate runs in-process (no subprocesses) so
+one `pytest`-free command gives the full static verdict; `--skip` exists
+for bisecting which gate is failing, not for shipping around one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import traceback
+
+_GATES = ("qwlint", "qwmc", "qwir")
+
+
+def _run_qwlint() -> tuple[int, dict]:
+    from tools.qwlint.core import (analyze_paths, apply_baseline,
+                                   default_baseline_path, load_baseline)
+    findings = analyze_paths(["quickwit_tpu"])
+    entries = load_baseline(default_baseline_path())
+    new, stale = apply_baseline(findings, entries)
+    return (1 if new else 0), {
+        "ok": not new,
+        "findings": [f.to_dict() for f in new],
+        "baselined": len(findings) - len(new),
+        "stale_baseline_entries": len(stale),
+    }
+
+
+def _run_qwmc() -> tuple[int, dict]:
+    from tools.qwmc.kernel import check_model
+    from tools.qwmc.models import MODELS, build_model
+    results = [check_model(build_model(name)) for name in sorted(MODELS)]
+    ok = all(r.ok for r in results)
+    return (0 if ok else 1), {
+        "ok": ok,
+        "results": [r.to_dict() for r in results],
+    }
+
+
+def _run_qwir() -> tuple[int, dict]:
+    from tools.qwir.__main__ import _setup_platform
+    _setup_platform()
+    from tools.qwir.audit import run_audit
+    from tools.qwir.selftest import run_self_test
+    report = run_audit()
+    self_test_failures = run_self_test()
+    ok = report.ok and not self_test_failures
+    doc = report.to_json()
+    doc["self_test_failures"] = self_test_failures
+    doc["ok"] = ok
+    doc.pop("programs", None)  # bulky; the manifest carries the detail
+    return (0 if ok else 1), doc
+
+
+_RUNNERS = {"qwlint": _run_qwlint, "qwmc": _run_qwmc, "qwir": _run_qwir}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.qwcheck",
+        description="run qwlint + qwmc + qwir as one merged gate")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit one merged JSON document")
+    parser.add_argument("--skip", action="append", default=[],
+                        choices=_GATES, metavar="TOOL",
+                        help="skip a gate (repeatable; for bisecting)")
+    args = parser.parse_args(argv)
+
+    merged: dict = {}
+    worst = 0
+    for gate in _GATES:
+        if gate in args.skip:
+            merged[gate] = {"ok": True, "skipped": True}
+            continue
+        try:
+            rc, doc = _RUNNERS[gate]()
+        except Exception as exc:  # a crashed gate is a usage-level failure
+            traceback.print_exc()
+            print(f"qwcheck: {gate} crashed: {exc}", file=sys.stderr)
+            merged[gate] = {"ok": False, "error": str(exc)}
+            worst = max(worst, 2)
+            continue
+        merged[gate] = doc
+        worst = max(worst, rc)
+        if not args.as_json:
+            verdict = "ok" if rc == 0 else "FAIL"
+            print(f"qwcheck: {gate}: {verdict}")
+    merged["ok"] = worst == 0
+    if args.as_json:
+        json.dump(merged, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+    elif merged["ok"]:
+        print("qwcheck: all gates clean")
+    return worst
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,204 @@
+"""Audit orchestration: corpus → liveness → rules → R1 manifest closure.
+
+The manifest (tools/qwir/manifest.json) is the compile-cache closure
+certificate: one entry per corpus program carrying the mirrored runtime
+cache-key digest and the structural jaxpr digest. `run_audit` recomputes
+both over the live corpus and fails R1 on ANY drift — a new program, a
+vanished program, a cache key that moved, or a lowered body that changed.
+Intentional changes regenerate it via `python -m tools.qwir audit
+--write-manifest` (and must update the pinned count in tests/test_qwir.py
+— that is the review speed bump ROADMAP items 1/2 are required to hit).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+from . import ir
+from .rules import PER_PROGRAM_RULES, RULE_DOCS, Finding
+
+MANIFEST_FORMAT = 1
+
+
+def default_manifest_path() -> Path:
+    return Path(__file__).resolve().parent / "manifest.json"
+
+
+@dataclass
+class AuditReport:
+    findings: list[Finding] = field(default_factory=list)
+    programs: dict[str, dict] = field(default_factory=dict)
+    program_count: int = 0
+
+    @property
+    def unsuppressed(self) -> list[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> list[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    @property
+    def ok(self) -> bool:
+        return not self.unsuppressed
+
+    def to_json(self) -> dict:
+        return {
+            "tool": "qwir",
+            "ok": self.ok,
+            "program_count": self.program_count,
+            "rules": RULE_DOCS,
+            "findings": [f.to_json() for f in self.unsuppressed],
+            "suppressed": [f.to_json() for f in self.suppressed],
+            "programs": self.programs,
+        }
+
+
+def describe_programs(specs) -> dict[str, dict]:
+    out = {}
+    for spec in specs:
+        out[spec.name] = {
+            "kind": spec.kind,
+            "cache_key": spec.cache_key_digest,
+            "jaxpr": ir.jaxpr_digest(spec.closed),
+            "eqns": sum(1 for _ in ir.iter_eqns(spec.closed)),
+            "doc_lanes": int(spec.doc_lanes),
+            "peak_bytes": int(spec.peak.peak_bytes) if spec.peak else 0,
+            "input_bytes": int(spec.peak.input_bytes) if spec.peak else 0,
+        }
+    return out
+
+
+def manifest_from_programs(programs: dict[str, dict]) -> dict:
+    return {
+        "format": MANIFEST_FORMAT,
+        "program_count": len(programs),
+        "programs": {
+            name: {k: rec[k] for k in
+                   ("kind", "cache_key", "jaxpr", "eqns", "doc_lanes")}
+            for name, rec in sorted(programs.items())
+        },
+    }
+
+
+def check_closure(programs: dict[str, dict],
+                  manifest: Optional[dict]) -> list[Finding]:
+    """R1: the recomputed (cache key, jaxpr digest) set must exactly match
+    the checked-in manifest — finite, pinned, and closed."""
+    findings: list[Finding] = []
+    if manifest is None:
+        findings.append(Finding(
+            rule="R1", program="<corpus>", site="manifest:missing",
+            message=("no compile-cache closure manifest — run "
+                     "`python -m tools.qwir audit --write-manifest` and "
+                     "check tools/qwir/manifest.json in")))
+        return findings
+    pinned = manifest.get("programs", {})
+    if manifest.get("format") != MANIFEST_FORMAT:
+        findings.append(Finding(
+            rule="R1", program="<corpus>", site="manifest:format",
+            message=f"manifest format {manifest.get('format')!r} != "
+                    f"{MANIFEST_FORMAT}"))
+    for name in sorted(set(pinned) - set(programs)):
+        findings.append(Finding(
+            rule="R1", program=name, site="closure:vanished",
+            message=("program pinned in the manifest no longer lowers from "
+                     "the corpus — a dispatch path died or the corpus "
+                     "regressed; regenerate the manifest deliberately")))
+    for name in sorted(set(programs) - set(pinned)):
+        findings.append(Finding(
+            rule="R1", program=name, site="closure:unpinned",
+            message=("program compiles a cache entry not pinned in the "
+                     "manifest — the compile-cache closure grew; audit the "
+                     "new program and regenerate the manifest")))
+    for name in sorted(set(programs) & set(pinned)):
+        rec, pin = programs[name], pinned[name]
+        if rec["cache_key"] != pin.get("cache_key"):
+            findings.append(Finding(
+                rule="R1", program=name, site="closure:cache_key",
+                message=("runtime compile-cache key drifted from the "
+                         "pinned certificate — plan signature or cache "
+                         "keying changed; every deployed cache entry is a "
+                         "cold compile until the manifest is regenerated")))
+        if rec["jaxpr"] != pin.get("jaxpr"):
+            findings.append(Finding(
+                rule="R1", program=name, site="closure:jaxpr",
+                message=("lowered program body drifted from the pinned "
+                         "jaxpr digest (same cache key ⇒ silent behavior "
+                         "change; different jax lowering ⇒ re-certify) — "
+                         "regenerate the manifest after review")))
+    declared = manifest.get("program_count")
+    if declared != len(pinned):
+        findings.append(Finding(
+            rule="R1", program="<corpus>", site="closure:count",
+            message=(f"manifest program_count {declared} does not match "
+                     f"its own program table ({len(pinned)})")))
+    return findings
+
+
+def check_aliasing(programs: dict[str, dict]) -> list[Finding]:
+    """R1 soundness: programs MAY share a compile-cache key (that is a
+    cache hit — the v1 and v3 term plans lower identically), but then
+    they must digest to the same jaxpr; a key collision across different
+    bodies means dispatch hands one plan the other plan's executable."""
+    findings: list[Finding] = []
+    by_key: dict[str, dict[str, list[str]]] = {}
+    for name, rec in sorted(programs.items()):
+        by_key.setdefault(rec["cache_key"], {}) \
+              .setdefault(rec["jaxpr"], []).append(name)
+    for key_digest, bodies in sorted(by_key.items()):
+        if len(bodies) > 1:
+            names = sorted(n for group in bodies.values() for n in group)
+            findings.append(Finding(
+                rule="R1", program=names[0],
+                site=f"closure:alias:{key_digest[:12]}",
+                message=("compile-cache key collision across DIFFERENT "
+                         f"lowered bodies: {names} share one cache entry "
+                         "but trace to distinct jaxprs — the second to "
+                         "compile silently runs the first one's "
+                         "executable")))
+    return findings
+
+
+def load_manifest(path: Path) -> Optional[dict]:
+    if not path.exists():
+        return None
+    return json.loads(path.read_text())
+
+
+def write_manifest(path: Path, programs: dict[str, dict]) -> dict:
+    manifest = manifest_from_programs(programs)
+    path.write_text(json.dumps(manifest, indent=1, sort_keys=True) + "\n")
+    return manifest
+
+
+def audit_specs(specs) -> AuditReport:
+    """Run the per-program rules (R2–R5) over already-built specs."""
+    report = AuditReport(program_count=len(specs))
+    for spec in specs:
+        if spec.peak is None:
+            spec.peak = ir.liveness_peak(spec.closed)
+        for rule in PER_PROGRAM_RULES:
+            report.findings.extend(rule(spec))
+    report.programs = describe_programs(specs)
+    report.findings.extend(check_aliasing(report.programs))
+    return report
+
+
+def run_audit(manifest_path: Optional[Path] = None,
+              update_manifest: bool = False) -> AuditReport:
+    """The full audit: build the corpus, run R2–R5, prove R1 closure."""
+    from .corpus import build_corpus
+    specs = build_corpus()
+    report = audit_specs(specs)
+    path = manifest_path or default_manifest_path()
+    if update_manifest:
+        write_manifest(path, report.programs)
+    report.findings.extend(
+        check_closure(
+            {n: rec for n, rec in report.programs.items()},
+            load_manifest(path)))
+    return report

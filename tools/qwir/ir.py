@@ -1,0 +1,237 @@
+"""jaxpr introspection: recursive eqn walks, stable structural digests,
+source-frame attribution, and a buffer-liveness peak-bytes walk.
+
+Everything here consumes ClosedJaxprs produced by the abstract hooks
+(`executor.abstract_program` & friends) — pure trace-time objects; nothing
+in this module compiles or executes device code.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Iterator, Optional
+
+import numpy as np
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+# --- recursive eqn walk ------------------------------------------------------
+
+def _sub_jaxprs(params: dict):
+    """Every (Closed)Jaxpr reachable from an eqn's params — pjit bodies,
+    scan/while/cond branches, shard_map bodies, custom_* call jaxprs."""
+    for v in params.values():
+        for j in _jaxprs_in(v):
+            yield j
+
+
+def _jaxprs_in(v):
+    # duck-typed: core.Jaxpr has .eqns/.invars, ClosedJaxpr wraps one in
+    # .jaxpr — avoids importing jax internals whose paths move per version
+    if hasattr(v, "eqns") and hasattr(v, "invars"):
+        yield v
+    elif hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):
+        yield v.jaxpr
+    elif isinstance(v, (list, tuple)):
+        for item in v:
+            yield from _jaxprs_in(item)
+
+
+def iter_eqns(closed) -> Iterator[Any]:
+    """Depth-first over every eqn, descending into sub-jaxprs."""
+    stack = [closed.jaxpr]
+    while stack:
+        jaxpr = stack.pop()
+        for eqn in jaxpr.eqns:
+            yield eqn
+            stack.extend(_sub_jaxprs(eqn.params))
+
+
+def prim_base(name: str) -> str:
+    """Primitive family name: jax suffixes rewrite generations with digits
+    (`psum` → `psum2`); strip them so rule tables survive version bumps."""
+    return name.rstrip("0123456789")
+
+
+# --- aval helpers ------------------------------------------------------------
+
+def aval_sig(aval) -> tuple:
+    return (tuple(getattr(aval, "shape", ())), str(getattr(aval, "dtype", "?")))
+
+
+def aval_bytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * np.dtype(dtype).itemsize
+
+
+def var_avals(vs) -> list:
+    return [v.aval for v in vs if hasattr(v, "aval")]
+
+
+# --- source-frame attribution ------------------------------------------------
+
+def repo_frame(eqn) -> Optional[tuple[str, str]]:
+    """(repo-relative path, function name) of the innermost repo frame that
+    bound this eqn, or None for eqns jax materialized with no user frame.
+    Frames run innermost-first, so the first repo hit is the defining
+    function — the anchor the certification registries key on."""
+    tb = getattr(eqn.source_info, "traceback", None)
+    if tb is None:
+        return None
+    root = str(REPO_ROOT) + "/"
+    for fr in tb.frames:
+        fname = fr.file_name
+        if fname.startswith(root):
+            return fname[len(root):], fr.function_name
+    return None
+
+
+# --- stable structural digest ------------------------------------------------
+
+def jaxpr_digest(closed) -> str:
+    """Hex digest of the program's structure: primitives, dataflow, avals,
+    and params — NOT the pretty-printer output (which drifts across jax
+    versions) and NOT object identities. Two traces of the same closure
+    over the same ShapeDtypeStructs digest identically; any change to the
+    lowered program (new eqn, dtype flip, shape change, param change)
+    changes the digest."""
+    h = hashlib.blake2b(digest_size=16)
+    _digest_jaxpr(h, closed.jaxpr)
+    for const in getattr(closed, "consts", ()) or ():
+        arr = np.asarray(const)
+        h.update(f"const:{arr.shape}:{arr.dtype}".encode())
+        if arr.size <= 1024:
+            h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+def _digest_jaxpr(h, jaxpr) -> None:
+    env: dict[int, int] = {}
+
+    def vid(v) -> str:
+        if not hasattr(v, "aval"):  # DropVar etc.
+            return "drop"
+        if hasattr(v, "val"):  # Literal
+            val = np.asarray(v.val)
+            body = (np.ascontiguousarray(val).tobytes() if val.size <= 64
+                    else str(val.shape).encode())
+            return f"lit:{val.dtype}:{body!r}"
+        return f"v{env.setdefault(id(v), len(env))}:{aval_sig(v.aval)}"
+
+    h.update(("in:" + ",".join(vid(v) for v in jaxpr.invars)).encode())
+    h.update(("const:" + ",".join(vid(v) for v in jaxpr.constvars)).encode())
+    for eqn in jaxpr.eqns:
+        h.update(f"|{eqn.primitive.name}".encode())
+        h.update(("(" + ",".join(vid(v) for v in eqn.invars) + ")->("
+                  + ",".join(vid(v) for v in eqn.outvars) + ")").encode())
+        for key in sorted(eqn.params):
+            val = eqn.params[key]
+            subs = list(_jaxprs_in(val))
+            if subs:
+                h.update(f"{key}=jaxpr[".encode())
+                for sub in subs:
+                    _digest_jaxpr(h, sub)
+                h.update(b"]")
+            else:
+                h.update(f"{key}={_stable_param(val)}".encode())
+    h.update(("out:" + ",".join(vid(v) for v in jaxpr.outvars)).encode())
+
+
+def _stable_param(v) -> str:
+    """Params stringified without leaking object identities/addresses."""
+    if isinstance(v, (bool, int, float, str, bytes, type(None))):
+        return repr(v)
+    if isinstance(v, (list, tuple)):
+        inner = ",".join(_stable_param(x) for x in v)
+        return f"({inner})" if isinstance(v, tuple) else f"[{inner}]"
+    if isinstance(v, dict):
+        return "{" + ",".join(f"{k}:{_stable_param(v[k])}"
+                              for k in sorted(map(str, v))) + "}"
+    if isinstance(v, np.dtype) or (isinstance(v, type)
+                                   and issubclass(v, np.generic)):
+        return str(np.dtype(v))
+    if isinstance(v, np.ndarray):
+        return f"ndarray{v.shape}:{v.dtype}"
+    if hasattr(v, "axis_names"):  # Mesh / AbstractMesh
+        return f"mesh{tuple(v.axis_names)}:{tuple(np.shape(v.devices)) if hasattr(v, 'devices') else ()}"
+    # functions, shardings, effects, trees: type identity only — their
+    # semantic content either shows up elsewhere (sub-jaxprs, avals) or is
+    # not part of program structure
+    return type(v).__name__
+
+
+# --- buffer-liveness peak walk ----------------------------------------------
+
+@dataclass
+class PeakReport:
+    peak_bytes: int
+    input_bytes: int
+    # largest single intermediate buffer and the repo frame that minted it
+    largest_bytes: int = 0
+    largest_site: str = ""
+
+
+def liveness_peak(closed) -> PeakReport:
+    """Upper-bound peak live bytes, by a last-use liveness scan over the
+    eqn sequence (sub-jaxpr peaks charged at their call eqn on top of the
+    caller's live set). Ignores XLA fusion/aliasing — i.e. this is what
+    the program could hold if nothing fuses, the honest bound to check
+    against an admission quantum."""
+    jaxpr = closed.jaxpr
+    input_bytes = sum(aval_bytes(a) for a in var_avals(jaxpr.invars))
+    input_bytes += sum(int(np.asarray(c).nbytes)
+                       for c in (getattr(closed, "consts", ()) or ()))
+    report = PeakReport(peak_bytes=0, input_bytes=input_bytes)
+    _walk_peak(jaxpr, input_bytes, report)
+    return report
+
+
+def _walk_peak(jaxpr, base_bytes: int, report: PeakReport) -> int:
+    """Peak bytes while executing `jaxpr`, given `base_bytes` already live
+    outside it (its inputs + enclosing frames). Returns the peak."""
+    last_use: dict[int, int] = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if hasattr(v, "aval") and not hasattr(v, "val"):
+                last_use[id(v)] = i
+    n_eqns = len(jaxpr.eqns)
+    for v in jaxpr.outvars:
+        if hasattr(v, "aval") and not hasattr(v, "val"):
+            last_use[id(v)] = n_eqns
+    live: dict[int, int] = {}
+    cur = base_bytes
+    peak = base_bytes
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.outvars:
+            if not hasattr(v, "aval") or id(v) in live:
+                continue
+            b = aval_bytes(v.aval)
+            live[id(v)] = b
+            cur += b
+            if b > report.largest_bytes:
+                frame = repo_frame(eqn)
+                report.largest_bytes = b
+                report.largest_site = (f"{frame[0]}:{frame[1]}" if frame
+                                       else eqn.primitive.name)
+        inner_extra = 0
+        for sub in _sub_jaxprs(eqn.params):
+            # the sub-jaxpr's own invars alias buffers already counted in
+            # `cur`; charge only its internal growth
+            sub_peak = _walk_peak(sub, 0, report)
+            inner_extra = max(inner_extra, sub_peak)
+        peak = max(peak, cur + inner_extra)
+        for v in eqn.invars:
+            vk = id(v)
+            if last_use.get(vk) == i and vk in live:
+                cur -= live.pop(vk)
+    report.peak_bytes = max(report.peak_bytes, peak)
+    return peak

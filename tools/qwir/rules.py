@@ -1,0 +1,273 @@
+"""qwir rule engine: R2–R5 over one audited program's jaxpr.
+
+R1 (compile-cache closure) is corpus-global and lives in audit.py; the
+rules here are per-program and pure — they take a ProgramSpec-shaped
+object exposing `.name`, `.closed`, `.mesh_axes`, `.doc_lanes`,
+`.num_docs_padded` and return Findings.
+
+Suppression model: R2 consults the in-code certification registries
+(`QWIR_CERTIFIED_F64` dicts in ops/topk.py, search/executor.py,
+parallel/fanout.py — the justification lives NEXT TO the kernel it
+certifies). A certified hit is reported as a suppressed finding carrying
+its justification; an uncertified hit fails the audit. Finding ids are
+stable across runs: (rule, program, site) with no line numbers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from . import ir
+
+# --- findings ----------------------------------------------------------------
+
+
+@dataclass
+class Finding:
+    rule: str           # "R1".."R5"
+    program: str        # corpus program name ("<corpus>" for R1 globals)
+    site: str           # stable site id, e.g. "quickwit_tpu/ops/topk.py:exact_topk:sort"
+    message: str
+    suppressed: bool = False
+    justification: str = ""
+
+    @property
+    def fid(self) -> str:
+        return f"{self.rule}:{self.program}:{self.site}"
+
+    def to_json(self) -> dict:
+        out = {"rule": self.rule, "program": self.program, "site": self.site,
+               "id": self.fid, "message": self.message,
+               "suppressed": self.suppressed}
+        if self.justification:
+            out["justification"] = self.justification
+        return out
+
+
+RULE_DOCS = {
+    "R1": "compile-cache-closure",
+    "R2": "f64-promotion-leak",
+    "R3": "host-round-trip",
+    "R4": "collective-soundness",
+    "R5": "hbm-ceiling",
+}
+
+
+# --- R2: f64 promotion leaks -------------------------------------------------
+
+# doc-scale threshold for flagging f64 promotions: conversions of fewer
+# elements (scalars, per-block bounds, top-k results) are not the hazard
+# class; the 290ms bug class (PR 8) was corpus-scale f64 sorting
+F64_PROMOTION_MIN_ELEMENTS = 4096
+
+_SORT_PRIMS = {"sort", "top_k", "approx_top_k"}
+
+
+def _certified_registries() -> dict[tuple[str, str], str]:
+    """(repo path, function) -> justification, collected from the product
+    modules' QWIR_CERTIFIED_F64 dicts so the suppression text stays inline
+    with the kernel it certifies."""
+    out: dict[tuple[str, str], str] = {}
+    from quickwit_tpu.ops import topk as _topk
+    from quickwit_tpu.search import executor as _executor
+    from quickwit_tpu.parallel import fanout as _fanout
+    for mod, path in ((_topk, "quickwit_tpu/ops/topk.py"),
+                      (_executor, "quickwit_tpu/search/executor.py"),
+                      (_fanout, "quickwit_tpu/parallel/fanout.py")):
+        for func, why in getattr(mod, "QWIR_CERTIFIED_F64", {}).items():
+            out[(path, func)] = why
+    return out
+
+
+def _site_of(eqn, fallback: str) -> str:
+    frame = ir.repo_frame(eqn)
+    return f"{frame[0]}:{frame[1]}" if frame else fallback
+
+
+def check_f64(spec) -> list[Finding]:
+    """f64 sort/top_k eqns and doc-scale promotions TO f64 must come from
+    certified frames. The dtype lattice is scanned per eqn: a
+    convert_element_type minting >= F64_PROMOTION_MIN_ELEMENTS f64
+    elements, or any sort family eqn keyed on an f64 operand, is a hit."""
+    certified = _certified_registries()
+    findings: list[Finding] = []
+    seen: set[str] = set()
+    for eqn in ir.iter_eqns(spec.closed):
+        base = ir.prim_base(eqn.primitive.name)
+        hit = None
+        if base in _SORT_PRIMS:
+            f64_ops = [a for a in ir.var_avals(eqn.invars)
+                       if str(getattr(a, "dtype", "")) == "float64"]
+            if f64_ops:
+                lanes = max(
+                    (int(a.shape[-1]) if a.shape else 1) for a in f64_ops)
+                hit = (f"{base}", f"f64-keyed {base} over {lanes}-lane "
+                       f"operands")
+        elif base == "convert_element_type":
+            new_dtype = str(eqn.params.get("new_dtype", ""))
+            out_avals = ir.var_avals(eqn.outvars)
+            if new_dtype == "float64" and out_avals:
+                n = 1
+                for d in out_avals[0].shape:
+                    n *= int(d)
+                if n >= F64_PROMOTION_MIN_ELEMENTS:
+                    hit = ("promote", f"promotes {n} elements to f64")
+        if hit is None:
+            continue
+        kind, detail = hit
+        frame = ir.repo_frame(eqn)
+        site = (f"{frame[0]}:{frame[1]}:{kind}" if frame
+                else f"<nosource>:{kind}")
+        if site in seen:
+            continue
+        seen.add(site)
+        why = certified.get(frame) if frame else None
+        findings.append(Finding(
+            rule="R2", program=spec.name, site=site,
+            message=(f"{detail} at {site.rsplit(':', 1)[0]} — f64 work at "
+                     "doc scale must run under a certified exact-fallback "
+                     "site (QWIR_CERTIFIED_F64 registries)"),
+            suppressed=why is not None, justification=why or ""))
+    return findings
+
+
+# --- R3: host round-trips ----------------------------------------------------
+
+# primitive families that move data or control across the host boundary
+# mid-program; any of these inside a leaf/fused program is a per-query
+# host sync the packed-readback architecture exists to avoid
+_TRANSFER_PRIMS = {"pure_callback", "io_callback", "debug_callback",
+                   "callback", "outside_call", "host_callback_call",
+                   "infeed", "outfeed", "device_put", "copy_to_host"}
+
+
+def check_transfers(spec) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: set[str] = set()
+    for eqn in ir.iter_eqns(spec.closed):
+        base = ir.prim_base(eqn.primitive.name)
+        if base not in _TRANSFER_PRIMS and "callback" not in base:
+            continue
+        site = f"{_site_of(eqn, '<nosource>')}:{base}"
+        if site in seen:
+            continue
+        seen.add(site)
+        findings.append(Finding(
+            rule="R3", program=spec.name, site=site,
+            message=(f"host-boundary primitive `{eqn.primitive.name}` "
+                     "inside the lowered program — every invocation pays a "
+                     "device↔host round trip; the only sanctioned transfer "
+                     "is the packed readback seam AFTER dispatch")))
+    return findings
+
+
+# --- R4: collective soundness ------------------------------------------------
+
+_COLLECTIVE_PRIMS = {"psum", "pmax", "pmin", "all_gather", "all_to_all",
+                     "ppermute", "pbroadcast", "reduce_scatter",
+                     "psum_scatter", "axis_index", "pgather",
+                     "all_gather_invariant"}
+
+
+def _axis_names(params: dict):
+    for key in ("axes", "axis_name", "axis_index_groups_axis"):
+        v = params.get(key)
+        if v is None:
+            continue
+        if isinstance(v, (list, tuple)):
+            for a in v:
+                if isinstance(a, str):
+                    yield a
+        elif isinstance(v, str):
+            yield v
+
+
+def check_collectives(spec) -> list[Finding]:
+    """Every explicit collective must name an axis of the program's
+    declared mesh, and shard_map bodies must bind exactly declared axes.
+    (GSPMD-inserted collectives appear post-jaxpr and are keyed by the
+    same NamedShardings the mesh dispatch passes — the explicit-eqn check
+    here is what guards the shard_map root-merge programs ROADMAP item 1
+    adds.)"""
+    declared = set(spec.mesh_axes or ())
+    findings: list[Finding] = []
+    seen: set[str] = set()
+    for eqn in ir.iter_eqns(spec.closed):
+        base = ir.prim_base(eqn.primitive.name)
+        names: set[str] = set()
+        if base in _COLLECTIVE_PRIMS:
+            names = set(_axis_names(eqn.params))
+        elif base == "shard_map":
+            mesh = eqn.params.get("mesh")
+            names = set(getattr(mesh, "axis_names", ()) or ())
+        else:
+            continue
+        bogus = names - declared
+        if not names:
+            bogus = {"<unnamed>"}
+        if not bogus:
+            continue
+        site = f"{_site_of(eqn, '<nosource>')}:{base}:{','.join(sorted(bogus))}"
+        if site in seen:
+            continue
+        seen.add(site)
+        findings.append(Finding(
+            rule="R4", program=spec.name, site=site,
+            message=(f"collective `{eqn.primitive.name}` binds axis "
+                     f"{sorted(bogus)} not in the declared mesh axes "
+                     f"{sorted(declared)} — an undeclared axis either "
+                     "fails at compile time on device or silently reduces "
+                     "over the wrong replica group")))
+    return findings
+
+
+# --- R5: static HBM ceiling --------------------------------------------------
+
+# fixed allowance for non-doc-scale state: agg bucket spaces (bounded by
+# MAX_BUCKETS), top-k candidate sets, scalar temps
+R5_FIXED_SLACK_BYTES = 16 << 20
+
+
+def r5_ceiling_bytes(spec) -> int:
+    from quickwit_tpu.ops.masks import QWIR_PEAK_PER_DOC_BYTES
+    report = spec.peak  # computed once by the auditor
+    return (report.input_bytes
+            + QWIR_PEAK_PER_DOC_BYTES * int(spec.doc_lanes)
+            + R5_FIXED_SLACK_BYTES)
+
+
+def check_hbm(spec) -> list[Finding]:
+    """Peak live bytes (liveness walk, fusion-free upper bound) must stay
+    within the per-doc budget anchored in ops/masks.py AND within the DRR
+    admission quantum — the unit HbmBudget schedules by; a program whose
+    unfused liveness exceeds the quantum can stall admission for every
+    queued tenant behind one query."""
+    from quickwit_tpu.tenancy.drr import DEFAULT_QUANTUM_BYTES
+    report = spec.peak
+    findings: list[Finding] = []
+    ceiling = r5_ceiling_bytes(spec)
+    if report.peak_bytes > ceiling:
+        findings.append(Finding(
+            rule="R5", program=spec.name, site="peak:budget",
+            message=(f"liveness peak {report.peak_bytes} B exceeds the "
+                     f"per-doc budget ceiling {ceiling} B "
+                     f"(inputs {report.input_bytes} B + "
+                     f"{spec.doc_lanes} doc-lanes; largest buffer "
+                     f"{report.largest_bytes} B from "
+                     f"{report.largest_site or 'unknown'})")))
+    quantum_ceiling = report.input_bytes + DEFAULT_QUANTUM_BYTES
+    if report.peak_bytes > quantum_ceiling:
+        findings.append(Finding(
+            rule="R5", program=spec.name, site="peak:quantum",
+            message=(f"liveness peak {report.peak_bytes} B exceeds the "
+                     f"staged inputs plus one DRR admission quantum "
+                     f"({quantum_ceiling} B) — admission cannot account "
+                     "this program's scratch; largest buffer "
+                     f"{report.largest_bytes} B from "
+                     f"{report.largest_site or 'unknown'}")))
+    return findings
+
+
+PER_PROGRAM_RULES = (check_f64, check_transfers, check_collectives,
+                     check_hbm)

@@ -17,6 +17,7 @@ import argparse
 import json
 import os
 import sys
+from pathlib import Path
 
 from .core import (LintError, analyze_paths, apply_baseline,
                    default_baseline_path, load_baseline, write_baseline)
@@ -42,7 +43,18 @@ def main(argv=None) -> int:
     parser.add_argument("--write-baseline", default=None, metavar="FILE",
                         help="write a baseline covering current findings "
                              "and exit 0")
+    parser.add_argument("--prune-baseline", action="store_true",
+                        help="rewrite the active baseline with stale "
+                             "entries (fixed findings) removed — the "
+                             "ratchet tightens itself")
+    parser.add_argument("--sarif", default=None, metavar="FILE",
+                        help="also write a SARIF 2.1.0 log to FILE")
     args = parser.parse_args(argv)
+
+    if args.prune_baseline and args.no_baseline:
+        print("qwlint: --prune-baseline conflicts with --no-baseline",
+              file=sys.stderr)
+        return 2
 
     paths = args.paths or ["quickwit_tpu"]
     try:
@@ -73,6 +85,29 @@ def main(argv=None) -> int:
         return 0
 
     new, stale = apply_baseline(findings, entries)
+
+    if args.prune_baseline and stale:
+        baseline_path = args.baseline or default_baseline_path()
+        stale_keys = {(e["rule"], e["path"], e["function"]) for e in stale}
+        kept = [e for e in entries
+                if (e["rule"], e["path"], e["function"]) not in stale_keys]
+        with open(baseline_path, "w", encoding="utf-8") as fh:
+            json.dump({"entries": kept}, fh, indent=2)
+            fh.write("\n")
+        print(f"qwlint: pruned {len(stale)} stale entr(y/ies) from "
+              f"{baseline_path} ({len(kept)} remain)", file=sys.stderr)
+        stale = []
+
+    if args.sarif:
+        from tools.sarif import write_sarif
+        from .rules import RULES
+        write_sarif(
+            Path(args.sarif), tool="qwlint",
+            rules={r.id: r.title for r in RULES},
+            results=[{"ruleId": f.rule, "message": f.message,
+                      "file": f.path, "line": f.line,
+                      "id": f"{f.rule}:{f.path}:{f.function}"}
+                     for f in new])
 
     if args.as_json:
         print(json.dumps([f.to_dict() for f in new], indent=2))

@@ -1,0 +1,72 @@
+"""Minimal SARIF 2.1.0 emitter shared by qwlint and qwir.
+
+Emits only the mandatory skeleton CI annotators consume: one run, a
+driver with rule metadata, and results carrying ruleId + message +
+either a physical location (qwlint: file/line) or a logical location
+(qwir: program/site — jaxpr findings have no source line by design).
+Suppressed findings are carried with a `suppressions` entry so review
+tooling can still render the certified-exception audit trail.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def sarif_log(tool: str, rules: dict[str, str],
+              results: list[dict]) -> dict:
+    """Build a SARIF log dict.
+
+    `rules` maps ruleId -> short description. Each result dict needs
+    `ruleId`, `message`, and either `file` (+ optional `line`) or
+    `site`; optional keys: `id` (stable finding id), `suppressed`,
+    `justification`.
+    """
+    out_results = []
+    for r in results:
+        entry: dict = {
+            "ruleId": r["ruleId"],
+            "level": "none" if r.get("suppressed") else "error",
+            "message": {"text": r["message"]},
+        }
+        if r.get("id"):
+            entry["partialFingerprints"] = {"stableId": r["id"]}
+        if r.get("file"):
+            phys = {"artifactLocation": {"uri": r["file"]}}
+            if r.get("line"):
+                phys["region"] = {"startLine": int(r["line"])}
+            entry["locations"] = [{"physicalLocation": phys}]
+        else:
+            entry["locations"] = [{"logicalLocations": [
+                {"fullyQualifiedName": r.get("site", r.get("id", "?"))}]}]
+        if r.get("suppressed"):
+            entry["suppressions"] = [{
+                "kind": "inSource",
+                "justification": r.get("justification", ""),
+            }]
+        out_results.append(entry)
+    return {
+        "version": SARIF_VERSION,
+        "$schema": SARIF_SCHEMA,
+        "runs": [{
+            "tool": {"driver": {
+                "name": tool,
+                "rules": [{"id": rid,
+                           "shortDescription": {"text": desc}}
+                          for rid, desc in sorted(rules.items())],
+            }},
+            "results": out_results,
+        }],
+    }
+
+
+def write_sarif(path: Path, tool: str, rules: dict[str, str],
+                results: list[dict]) -> dict:
+    log = sarif_log(tool, rules, results)
+    path.write_text(json.dumps(log, indent=1, sort_keys=True) + "\n")
+    return log
