@@ -72,7 +72,13 @@ class GossipService:
                                "grpc_endpoint": grpc_endpoint,
                                "gossip_port": 0}},  # patched after bind
         }
+        # qwlint: disable-next-line=QW008 - gossip/membership background loops
+        # run on real time outside the DST op path; leaf primitives with no
+        # seam locks held inside
         self._lock = threading.Lock()
+        # qwlint: disable-next-line=QW008 - gossip/membership background loops
+        # run on real time outside the DST op path; leaf primitives with no
+        # seam locks held inside
         self._stop = threading.Event()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self._sock.bind((bind_host, bind_port))
@@ -86,6 +92,9 @@ class GossipService:
                              ("gossip-tx", self._gossip_loop)):
             # qwlint: disable-next-line=QW003 - cluster gossip loops are
             # node-lifetime background threads, never query-scoped
+            # qwlint: disable-next-line=QW008 - gossip/membership background
+            # loops run on real time outside the DST op path; leaf primitives
+            # with no seam locks held inside
             thread = threading.Thread(target=target, name=name, daemon=True)
             thread.start()
             self._threads.append(thread)

@@ -74,6 +74,9 @@ class Cluster:
         self.self_node_id = self_node_id
         self.broker = broker or EventBroker()
         self._members: dict[str, ClusterMember] = {}
+        # qwlint: disable-next-line=QW008 - gossip/membership background loops
+        # run on real time outside the DST op path; leaf primitives with no
+        # seam locks held inside
         self._lock = threading.Lock()
         self.heartbeat_interval_secs = heartbeat_interval_secs
         self.dead_after_secs = dead_after_secs

@@ -54,7 +54,13 @@ class Mailbox:
         self.name = name
         self._low: "queue.Queue[Any]" = queue.Queue(maxsize=capacity)
         self._high: "queue.Queue[Any]" = queue.Queue()  # never blocks
+        # qwlint: disable-next-line=QW008 - actor mailboxes rendezvous through
+        # queue.Queue, which the qwrace scheduler cannot see; gating these
+        # primitives would stall the gated token on invisible queue waits
         self._closed = threading.Event()
+        # qwlint: disable-next-line=QW008 - actor mailboxes rendezvous through
+        # queue.Queue, which the qwrace scheduler cannot see; gating these
+        # primitives would stall the gated token on invisible queue waits
         self._not_empty = threading.Condition()
         # universe hook counting in-flight messages (idle detection for
         # accelerated time)
@@ -175,16 +181,28 @@ class Universe:
     def __init__(self, accelerated: bool = False):
         self.accelerated = accelerated
         self._handles: list[ActorHandle] = []
+        # qwlint: disable-next-line=QW008 - actor mailboxes rendezvous through
+        # queue.Queue, which the qwrace scheduler cannot see; gating these
+        # primitives would stall the gated token on invisible queue waits
         self._lock = threading.Lock()
         self._inflight = 0
+        # qwlint: disable-next-line=QW008 - actor mailboxes rendezvous through
+        # queue.Queue, which the qwrace scheduler cannot see; gating these
+        # primitives would stall the gated token on invisible queue waits
         self._idle = threading.Condition()
         # virtual clock (only consulted in accelerated mode)
         self._virtual_now = 0.0
         self._timers: list[tuple[float, int, Callable[[], None]]] = []
         self._timer_seq = itertools.count()
+        # qwlint: disable-next-line=QW008 - actor mailboxes rendezvous through
+        # queue.Queue, which the qwrace scheduler cannot see; gating these
+        # primitives would stall the gated token on invisible queue waits
         self._stop = threading.Event()
         # qwlint: disable-next-line=QW003 - the universe clock is
         # process-lifetime infrastructure with no query context to carry
+        # qwlint: disable-next-line=QW008 - actor mailboxes rendezvous through
+        # queue.Queue, which the qwrace scheduler cannot see; gating these
+        # primitives would stall the gated token on invisible queue waits
         self._clock_thread = threading.Thread(
             target=self._clock_loop, name="universe-clock", daemon=True)
         self._clock_thread.start()
@@ -321,6 +339,9 @@ class Universe:
 
         # qwlint: disable-next-line=QW003 - actor mailbox loops outlive
         # any query; messages carry their own metadata instead
+        # qwlint: disable-next-line=QW008 - actor mailboxes rendezvous through
+        # queue.Queue, which the qwrace scheduler cannot see; gating these
+        # primitives would stall the gated token on invisible queue waits
         thread = threading.Thread(target=run, name=f"actor-{actor.name}",
                                   daemon=True)
         handle.thread = thread

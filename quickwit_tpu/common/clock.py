@@ -89,6 +89,9 @@ class ScaledClock(Clock):
         if not 0.0 < factor <= 1.0:
             raise ValueError("factor must be in (0, 1]")
         self.factor = float(factor)
+        # qwlint: disable-next-line=QW008 - clock infrastructure underpins the
+        # seam itself; raw leaf primitive with no instrumented ops inside its
+        # critical sections
         self._lock = threading.Lock()
         self._offset = 0.0  # virtual seconds ahead of the real clock
 
@@ -127,6 +130,9 @@ class FakeClock(Clock):
     returns immediately)."""
 
     def __init__(self, start: float = 1000.0, epoch: float = 1_600_000_000.0):
+        # qwlint: disable-next-line=QW008 - clock infrastructure underpins the
+        # seam itself; raw leaf primitive with no instrumented ops inside its
+        # critical sections
         self._lock = threading.Lock()
         self._now = float(start)
         self._epoch_skew = float(epoch) - float(start)
@@ -157,6 +163,9 @@ class FakeClock(Clock):
 
 
 _SYSTEM_CLOCK = SystemClock()
+# qwlint: disable-next-line=QW008 - clock infrastructure underpins the seam
+# itself; raw leaf primitive with no instrumented ops inside its critical
+# sections
 _clock_lock = threading.Lock()
 _process_clock: Clock = _SYSTEM_CLOCK
 # default RNG: entropy-seeded, exactly what bare `random.*` calls used

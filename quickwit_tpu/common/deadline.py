@@ -130,6 +130,9 @@ class QueryBudget:
         self.deadline = deadline
         self.max_retries = max_retries
         self._retries_used = 0
+        # qwlint: disable-next-line=QW008 - leaf lock over deadline
+        # bookkeeping; no instrumented ops inside, so it is never contended
+        # under the gated scheduler
         self._lock = threading.Lock()
 
     @classmethod

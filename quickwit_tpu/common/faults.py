@@ -83,6 +83,8 @@ class FaultInjector:
     def __init__(self, seed: int, rules: list[FaultRule]):
         self.seed = seed
         self.rules = list(rules)
+        # qwlint: disable-next-line=QW008 - fault-injector leaf lock; pure
+        # dict/counter ops inside, never a seam primitive
         self._lock = threading.Lock()
         self._occurrences: dict[str, int] = {}
         self._fires_per_rule: list[int] = [0] * len(self.rules)

@@ -32,6 +32,8 @@ class EventBroker:
     """Typed pub/sub: subscribe by event class, publish instances."""
 
     def __init__(self) -> None:
+        # qwlint: disable-next-line=QW008 - leaf lock on the subscriber map; no
+        # instrumented ops inside
         self._lock = threading.Lock()
         self._subscribers: dict[type, dict[int, Callable[[Any], None]]] = defaultdict(dict)
         self._next_key = 0

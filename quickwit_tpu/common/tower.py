@@ -30,6 +30,9 @@ class TokenBucket:
         self.clock = clock
         self._tokens = float(burst)
         self._last = clock()
+        # qwlint: disable-next-line=QW008 - middleware leaf locks
+        # (rate/concurrency counters); no instrumented ops inside their
+        # critical sections
         self._lock = threading.Lock()
 
     def try_acquire(self, cost: float = 1.0) -> bool:
@@ -88,6 +91,9 @@ class CircuitBreaker:
         self.counts_as_failure = counts_as_failure or (lambda exc: True)
         self._consecutive_failures = 0
         self._opened_at: float | None = None
+        # qwlint: disable-next-line=QW008 - middleware leaf locks
+        # (rate/concurrency counters); no instrumented ops inside their
+        # critical sections
         self._lock = threading.Lock()
 
     @property

@@ -59,6 +59,8 @@ class CompactionPlanner:
         self.clock = clock
         # completion hooks fire on merge WORKER threads while plan()
         # runs on the tick thread — every _in_flight access locks
+        # qwlint: disable-next-line=QW008 - compaction supervisor background
+        # loop, outside the DST-raced path; leaf primitives only
         self._lock = threading.Lock()
         self._in_flight: dict[str, _InFlight] = {}
 

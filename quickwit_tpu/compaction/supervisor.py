@@ -35,9 +35,13 @@ class CompactorSupervisor:
         self.storage_resolver = storage_resolver
         self.node_id = node_id
         self.max_concurrent_merges = max_concurrent_merges
+        # qwlint: disable-next-line=QW008 - compaction supervisor background
+        # loop, outside the DST-raced path; leaf primitives only
         self._lock = threading.Lock()
         self._active: set[str] = set()
         self._state = CompactorState.RUNNING
+        # qwlint: disable-next-line=QW008 - compaction supervisor background
+        # loop, outside the DST-raced path; leaf primitives only
         self._drained = threading.Event()
         self.num_completed = 0
         self.num_failed = 0
@@ -83,6 +87,8 @@ class CompactorSupervisor:
             # qwlint: disable-next-line=QW003 - merge tasks are background
             # maintenance; they must NOT inherit a submitting query's
             # deadline or the merge would be shed mid-write
+            # qwlint: disable-next-line=QW008 - compaction supervisor
+            # background loop, outside the DST-raced path; leaf primitives only
             threading.Thread(
                 target=self._execute, args=(task, on_done),
                 name=f"merge-{task.task_id}", daemon=True).start()

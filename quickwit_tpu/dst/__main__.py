@@ -6,7 +6,10 @@
   additionally replays every trace against the qwmc checkpoint model
   (``tools.qwmc.conformance``): a trace that is not a behavior of the
   exhaustively-checked model fails the sweep even if no runtime
-  invariant fired.
+  invariant fired. ``--pct`` layers the qwrace PCT scheduler under every
+  run: thread interleavings become seed-deterministic and a FastTrack
+  happens-before detector reports data races / deadlocks as regular DST
+  violations (shrunk and persisted like any other).
 - ``replay path/to/artifact.json [--json]`` re-executes an artifact and
   exits 1 unless the trace digest matches byte-for-byte AND the recorded
   violation fires again.
@@ -27,11 +30,17 @@ from .scenario import SCENARIOS
 
 def _cmd_sweep(args: argparse.Namespace) -> int:
     scenario = scenario_by_name(args.scenario)
+    race = None
+    if args.pct:
+        # lazy: the DST layer stays importable without the tools/ tree
+        from tools.qwrace.harness import PctRace
+        race = PctRace(depth=args.pct_depth, horizon=args.pct_horizon)
     summary = sweep(scenario, seeds=args.seeds, start_seed=args.start_seed,
                     artifacts_dir=args.artifacts_dir,
                     shrink_violations=not args.no_shrink,
                     stop_on_first=not args.keep_going,
-                    conformance=args.conformance)
+                    conformance=args.conformance,
+                    race=race)
     if args.json:
         print(json.dumps(summary, sort_keys=True, indent=2))
     else:
@@ -125,6 +134,19 @@ def main(argv: list[str] | None = None) -> int:
     p_sweep.add_argument("--conformance", action="store_true",
                          help="also replay every trace against the qwmc "
                               "checkpoint model (refinement check)")
+    p_sweep.add_argument("--pct", action="store_true",
+                         help="run every seed under the qwrace PCT "
+                              "scheduler: randomized-but-deterministic "
+                              "thread interleavings with happens-before "
+                              "race detection (tools/qwrace)")
+    p_sweep.add_argument("--pct-depth", type=int, default=3,
+                         help="PCT bug depth d: d-1 priority change "
+                              "points per schedule (default 3)")
+    p_sweep.add_argument("--pct-horizon", type=int, default=4096,
+                         help="PCT horizon k: change points are drawn "
+                              "from the first k scheduling decisions; "
+                              "match to trace length for deep "
+                              "deadlock-order bugs (default 4096)")
     p_sweep.add_argument("--json", action="store_true")
     p_sweep.set_defaults(fn=_cmd_sweep)
 

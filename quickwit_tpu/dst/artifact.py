@@ -57,11 +57,12 @@ def finish_artifact(kind: str, body: dict[str, Any]) -> dict[str, Any]:
 def make_artifact(scenario: Scenario, seed: int, ops: list[dict[str, Any]],
                   violation: Violation, trace: Trace,
                   break_publish: bool = False,
-                  break_wal: bool = False) -> dict[str, Any]:
+                  break_wal: bool = False,
+                  race: Any = None) -> dict[str, Any]:
     # a FRESH injector's plan (cursors at zero): replay must start the
     # fault decision streams from the beginning, not where the run ended
     fault_plan = FaultInjector(seed, list(scenario.fault_rules)).to_plan()
-    return finish_artifact(ARTIFACT_KIND, {
+    body = {
         "scenario": scenario.to_dict(),
         "seed": int(seed),
         "ops": list(ops),
@@ -71,7 +72,12 @@ def make_artifact(scenario: Scenario, seed: int, ops: list[dict[str, Any]],
         "violation": violation.to_dict(),
         "trace_digest": trace.digest(),
         "trace": list(trace.events),
-    })
+    }
+    if race is not None:
+        # the PCT controller config: with it, `dst replay` reconstructs
+        # the race runtime and the schedule re-derives from the seed alone
+        body["race"] = race.to_dict()
+    return finish_artifact(ARTIFACT_KIND, body)
 
 
 def save_artifact(artifact: dict[str, Any], path: str,

@@ -51,7 +51,7 @@ from ..models.split_metadata import SplitState
 from ..offload.autoscaler import Autoscaler, WorkerLauncher
 from ..offload.pool import WorkerPool
 from ..query.ast import MatchAll
-from ..search import SearchRequest, leaf_search_single_split
+from ..search import SearchRequest, SortField, leaf_search_single_split
 from ..search.root import RootSearcher
 from ..search.service import LocalSearchClient, SearcherContext, SearchService
 from ..storage import StorageResolver
@@ -176,8 +176,28 @@ class SimCluster:
                                    shard_prefix=node_id)
         node.metastore = FileBackedMetastore(
             self.meta_storage, polling_interval_secs=METASTORE_POLL_SECS)
+        context_kwargs: dict[str, Any] = {}
+        if self.scenario.offload:
+            # production-shaped fan-out in-process: a two-worker fleet per
+            # node, `max_local_splits=1` + `task_splits=1` so ANY leaf
+            # request beyond one cold split exercises the dispatcher's
+            # spawn/steal/hedge threads against the shared cache tiers.
+            # Workers are full SearchServices over the same faulty
+            # resolver, reached through LocalSearchClient (deterministic:
+            # no sockets, no real network)
+            context_kwargs["offload"] = {
+                "endpoints": [f"{node_id}-w0", f"{node_id}-w1"],
+                "max_local_splits": 1,
+                "task_splits": 1,
+                "max_inflight_per_worker": 2,
+            }
+            context_kwargs["offload_client_factory"] = (
+                lambda endpoint: LocalSearchClient(SearchService(
+                    SearcherContext(self.faulty_resolver, prefetch=False),
+                    node_id=endpoint)))
         node.service = SearchService(
-            SearcherContext(self.faulty_resolver, prefetch=False),
+            SearcherContext(self.faulty_resolver, prefetch=False,
+                            **context_kwargs),
             node_id=node_id)
         node.client = LocalSearchClient(node.service)
         return node
@@ -502,6 +522,7 @@ class SimCluster:
                 "checkpoint": self._checkpoint_total(node, uid)}
 
     def search(self, index_id: str, max_hits: int,
+               sort: Optional[str] = None,
                repeat: int = 2) -> list[dict[str, Any]]:
         """Run the query `repeat` times through the full root fan-out —
         the second pass hits the warm cache tiers, which is exactly what
@@ -519,8 +540,13 @@ class SimCluster:
             FaultyMetastore(searcher.metastore, self.injector), clients,
             nodes_provider=lambda: self.alive_nodes(),
             default_timeout_secs=self.scenario.search_timeout_secs)
-        request = SearchRequest(index_ids=[index_id], query_ast=MatchAll(),
-                                max_hits=max_hits)
+        # a fast-field sort arms threshold pruning: the leaf's shared
+        # ThresholdBox is then written by the local execute loop and read
+        # by the offload dispatch thread — the interleaving the qwrace
+        # schedule exploration targets
+        request = SearchRequest(
+            index_ids=[index_id], query_ast=MatchAll(), max_hits=max_hits,
+            sort_fields=([SortField(sort, "desc")] if sort else []))
         outs: list[dict[str, Any]] = []
         for _ in range(repeat):
             try:

@@ -22,6 +22,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import random
+from contextlib import nullcontext
 from dataclasses import dataclass
 from typing import Any, Optional
 
@@ -69,12 +70,20 @@ def run_scenario(scenario: Scenario, seed: int,
                  ops: Optional[list[dict[str, Any]]] = None,
                  fault_plan: Optional[dict[str, Any]] = None,
                  break_publish: Optional[bool] = None,
-                 break_wal: Optional[bool] = None) -> RunResult:
+                 break_wal: Optional[bool] = None,
+                 race: Optional[Any] = None) -> RunResult:
     """Execute one deterministic run. `ops` / `fault_plan` default to the
     scenario's materialization and fault rules for `seed`; replay and
     shrinking pass explicit (possibly reduced) values. The break flags
     default to the `QW_DST_BREAK_{PUBLISH,WAL}` env switches; replay pins
-    them from the artifact so a run reproduces from the file alone."""
+    them from the artifact so a run reproduces from the file alone.
+
+    `race` is a `tools.qwrace.PctRace` controller (or None): when set, the
+    run executes under the gated PCT scheduler — every thread and lock the
+    cluster builds goes through the `common.sync` seam, interleavings are
+    explored at sync-op granularity inside each (still serial) DST op, and
+    happens-before race findings become ordinary `Violation`s so shrink /
+    artifact / replay apply unchanged."""
     if ops is None:
         ops = scenario.materialize(seed)
     if break_publish is None:
@@ -95,28 +104,52 @@ def run_scenario(scenario: Scenario, seed: int,
     clock = FakeClock(start=_VIRTUAL_START, epoch=_VIRTUAL_EPOCH)
     rng = random.Random(seed)
 
-    with use_clock(clock), use_rng(rng):
+    racer = race.begin(seed) if race is not None else None
+    # () never matches in an except clause: abort_exc is only "live" when
+    # a race controller is installed
+    abort_exc = racer.abort_exc if racer is not None else ()
+
+    # activate BEFORE the cluster is built: a lock constructed outside the
+    # runtime would be invisible to happens-before and yield false races
+    with use_clock(clock), use_rng(rng), \
+            (racer.activate() if racer is not None else nullcontext()):
         network = SimNetwork(injector, seed, duplicate_probability=0.05)
         cluster = SimCluster(scenario, injector, network, clock,
                              break_publish=break_publish,
                              break_wal=break_wal)
         try:
+            start_extra = {"race": race.to_dict()} if race is not None else {}
             trace.record("start", scenario=scenario.name, seed=seed,
                          num_ops=len(ops), break_publish=break_publish,
-                         break_wal=break_wal)
-            for step, op in enumerate(ops):
-                clock.advance(scenario.step_secs)
-                result = _execute(cluster, op)
-                trace.record("op", step=step, now=round(clock.monotonic(), 6),
-                             op=op if op["kind"] != "ingest" else {
-                                 "kind": "ingest", "node": op["node"],
-                                 "index": op["index"],
-                                 "num_docs": len(op["docs"])},
-                             result=result)
-                checker.after_op(cluster, op, result, step)
-                if checker.violations:
-                    break
-            if not checker.violations:
+                         break_wal=break_wal, **start_extra)
+            aborted = False
+            try:
+                for step, op in enumerate(ops):
+                    if racer is not None:
+                        racer.before_op(step)
+                    clock.advance(scenario.step_secs)
+                    result = _execute(cluster, op)
+                    trace.record("op", step=step,
+                                 now=round(clock.monotonic(), 6),
+                                 op=op if op["kind"] != "ingest" else {
+                                     "kind": "ingest", "node": op["node"],
+                                     "index": op["index"],
+                                     "num_docs": len(op["docs"])},
+                                 result=result)
+                    checker.after_op(cluster, op, result, step)
+                    if checker.violations:
+                        break
+                    if racer is not None and racer.detector.findings():
+                        break   # stop at the first race, like any violation
+            except abort_exc:
+                # scheduler deadlock / budget abort: the finding is already
+                # in the detector; the run ends here
+                aborted = True
+            if racer is not None:
+                racer.finalize()
+                checker.violations.extend(racer.violations())
+                trace.record("race", **racer.trace_event())
+            if not checker.violations and not aborted:
                 summary = cluster.quiesce()
                 trace.record("quiesce", now=round(clock.monotonic(), 6),
                              summary=summary)
@@ -125,6 +158,8 @@ def run_scenario(scenario: Scenario, seed: int,
             trace.record("end",
                          violations=[v.to_dict() for v in checker.violations])
         finally:
+            if racer is not None:
+                racer.finalize()
             cluster.close()
     return RunResult(scenario=scenario, seed=seed, ops=ops,
                      violations=checker.violations, trace=trace)
@@ -137,7 +172,8 @@ def _execute(cluster: SimCluster, op: dict[str, Any]) -> Any:
     if kind == "drain":
         return cluster.drain(op["node"])
     if kind == "search":
-        return cluster.search(op["index"], op["max_hits"])
+        return cluster.search(op["index"], op["max_hits"],
+                              sort=op.get("sort"))
     if kind == "merge":
         return cluster.merge(op["node"], op["index"])
     if kind == "kill":
@@ -156,17 +192,21 @@ def _execute(cluster: SimCluster, op: dict[str, Any]) -> Any:
 def shrink(scenario: Scenario, seed: int, ops: list[dict[str, Any]],
            violation: Violation,
            break_publish: bool = False,
-           break_wal: bool = False) -> tuple[Scenario, list[dict[str, Any]]]:
+           break_wal: bool = False,
+           race: Optional[Any] = None) -> tuple[Scenario, list[dict[str, Any]]]:
     """Greedy seed-local shrink: one backward elimination pass over the op
     list, then one over the fault rules — a candidate survives only if the
     SAME-NAMED invariant still fires. Single-pass keeps the cost linear in
-    the op count (each probe is a full deterministic run)."""
+    the op count (each probe is a full deterministic run). Race findings
+    shrink exactly like any other violation: each probe re-runs under the
+    same PCT controller (same seed → same schedule for the surviving op
+    prefix)."""
     name = violation.invariant
 
     def still_fails(sc: Scenario, candidate_ops: list[dict[str, Any]]) -> bool:
         result = run_scenario(sc, seed, ops=candidate_ops,
                               break_publish=break_publish,
-                              break_wal=break_wal)
+                              break_wal=break_wal, race=race)
         return any(v.invariant == name for v in result.violations)
 
     current = list(ops)
@@ -194,7 +234,8 @@ def sweep(scenario: Scenario, seeds: int, start_seed: int = 0,
           break_wal: Optional[bool] = None,
           shrink_violations: bool = True,
           stop_on_first: bool = True,
-          conformance: bool = False) -> dict[str, Any]:
+          conformance: bool = False,
+          race: Optional[Any] = None) -> dict[str, Any]:
     """Run `seeds` consecutive seeds; shrink + persist an artifact for each
     violating seed. Returns a JSON-safe summary (the CLI prints it).
 
@@ -219,10 +260,12 @@ def sweep(scenario: Scenario, seeds: int, start_seed: int = 0,
     }
     if conformance:
         summary["nonconforming"] = []
+    if race is not None:
+        summary["race"] = race.to_dict()
     for seed in range(start_seed, start_seed + seeds):
         result = run_scenario(scenario, seed,
                               break_publish=break_publish,
-                              break_wal=break_wal)
+                              break_wal=break_wal, race=race)
         if check_trace is not None:
             report = check_trace(result.trace.events)
             if not report["conforms"]:
@@ -239,7 +282,7 @@ def sweep(scenario: Scenario, seeds: int, start_seed: int = 0,
         if shrink_violations:
             shrunk_scenario, shrunk_ops = shrink(
                 scenario, seed, result.ops, violation,
-                break_publish=break_publish, break_wal=break_wal)
+                break_publish=break_publish, break_wal=break_wal, race=race)
             entry["ops_before_shrink"] = len(result.ops)
             entry["ops_after_shrink"] = len(shrunk_ops)
             entry["fault_rules_after_shrink"] = len(
@@ -247,12 +290,12 @@ def sweep(scenario: Scenario, seeds: int, start_seed: int = 0,
         # re-run the shrunk repro to capture its trace for the artifact
         repro = run_scenario(shrunk_scenario, seed, ops=shrunk_ops,
                              break_publish=break_publish,
-                             break_wal=break_wal)
+                             break_wal=break_wal, race=race)
         repro_violation = (repro.first_violation
                            if repro.first_violation else violation)
         artifact = make_artifact(
             shrunk_scenario, seed, shrunk_ops, repro_violation, repro.trace,
-            break_publish=break_publish, break_wal=break_wal)
+            break_publish=break_publish, break_wal=break_wal, race=race)
         if artifacts_dir:
             os.makedirs(artifacts_dir, exist_ok=True)
             path = os.path.join(
@@ -279,11 +322,18 @@ def replay(artifact: dict[str, Any]) -> tuple[RunResult, bool]:
     one byte-for-byte."""
     scenario = Scenario.from_dict(artifact["scenario"])
     flags = artifact.get("break_flags", {})
+    race = None
+    if artifact.get("race"):
+        # lazy for the same reason as qwmc conformance above: the DST
+        # layer stays importable without the tools/ tree
+        from tools.qwrace.harness import race_from_dict
+        race = race_from_dict(artifact["race"])
     result = run_scenario(
         scenario, int(artifact["seed"]), ops=list(artifact["ops"]),
         fault_plan=artifact.get("fault_plan"),
         break_publish=bool(flags.get("publish", False)),
-        break_wal=bool(flags.get("wal", False)))
+        break_wal=bool(flags.get("wal", False)),
+        race=race)
     return result, result.digest == artifact["trace_digest"]
 
 

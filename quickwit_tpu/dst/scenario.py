@@ -59,6 +59,18 @@ class Scenario:
     step_secs: float = 7.5
     search_timeout_secs: float = 5.0
     replication: bool = True
+    # elastic leaf-search offload at production fan-out: each node gets an
+    # in-process worker fleet and `max_local_splits=1`, so every multi-split
+    # leaf request exercises the dispatcher's thread spawn / steal / hedge
+    # machinery concurrently with the cache tiers — the interleaving
+    # surface the qwrace `--pct` schedule exploration randomizes
+    offload: bool = False
+    # mix in fast-field-sorted searches (sort by "ts"/"n" desc): they arm
+    # threshold pruning, whose shared ThresholdBox the local execute loop
+    # and the offload dispatch thread then touch concurrently. Opt-in so
+    # pre-existing scenarios' op streams (and their replay artifacts)
+    # stay byte-identical.
+    sorted_searches: bool = False
     weights: dict[str, int] = field(
         default_factory=lambda: dict(DEFAULT_WEIGHTS))
     invariants: tuple[str, ...] = ALL_INVARIANTS
@@ -103,9 +115,14 @@ class Scenario:
                 ops.append({"kind": "drain",
                             "node": rng.choice(sorted(alive))})
             elif kind == "search":
-                ops.append({"kind": "search",
-                            "index": rng.choice(self.indexes),
-                            "max_hits": rng.choice((10, 100, 1000))})
+                op = {"kind": "search",
+                      "index": rng.choice(self.indexes),
+                      "max_hits": rng.choice((10, 100, 1000))}
+                if self.sorted_searches:
+                    sort = rng.choice((None, "ts", "n"))
+                    if sort is not None:
+                        op["sort"] = sort
+                ops.append(op)
             elif kind == "merge":
                 ops.append({"kind": "merge", "node": rng.choice(sorted(alive)),
                             "index": rng.choice(self.indexes)})
@@ -144,6 +161,8 @@ class Scenario:
             step_secs=float(data.get("step_secs", 7.5)),
             search_timeout_secs=float(data.get("search_timeout_secs", 5.0)),
             replication=bool(data.get("replication", True)),
+            offload=bool(data.get("offload", False)),
+            sorted_searches=bool(data.get("sorted_searches", False)),
             weights={str(k): int(v)
                      for k, v in data.get("weights", DEFAULT_WEIGHTS).items()},
             invariants=tuple(data.get("invariants", ALL_INVARIANTS)),
@@ -185,5 +204,23 @@ SCENARIOS: dict[str, Scenario] = {
         indexes=("tenant-a", "tenant-b"),
         invariants=ALL_INVARIANTS,
         fault_rules=_default_fault_rules(),
+    ),
+    # offload dispatch + cache-tier interleavings at production fan-out
+    # (ROADMAP item 5's named headroom): every node runs an in-process
+    # worker fleet with max_local_splits=1, so multi-split searches drive
+    # the dispatcher's spawn/steal/hedge threads against the shared cache
+    # tiers. Under `dst sweep --pct` the qwrace scheduler randomizes the
+    # thread interleavings; without it the run stays a concurrency smoke.
+    # single node: the whole published split set lands in ONE leaf request,
+    # so the offload cut (max_local_splits=1) reliably fans the cold tail
+    # out over the in-process worker fleet
+    "fanout": Scenario(
+        name="fanout", nodes=1, steps=30,
+        indexes=("tenant-a", "tenant-b"),
+        offload=True, replication=False, sorted_searches=True,
+        weights={"ingest": 8, "drain": 6, "search": 8, "merge": 1,
+                 "kill": 0, "restart": 0, "autoscale": 2, "plan": 0},
+        invariants=("exactly_once_publish", "tenant_isolation",
+                    "cache_cold_equivalence", "autoscaler_bounds"),
     ),
 }

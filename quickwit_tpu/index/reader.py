@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import bisect
 import json
-import threading
 import zlib
 from collections import OrderedDict
 from dataclasses import dataclass
@@ -23,6 +22,7 @@ from ..storage.base import Storage
 from ..storage.cache import ByteRangeCache
 from .format import DEFAULT_FOOTER_HINT, ArrayMeta, SplitFooter, read_footer
 from .impact import IMPACT_BLOCK
+from ..common import sync
 
 
 class _TermStatsCache:
@@ -35,7 +35,7 @@ class _TermStatsCache:
     _MAX = 1 << 17
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = sync.lock("_TermStatsCache._lock")
         self._entries: OrderedDict[tuple, Any] = OrderedDict()
 
     def get(self, key: tuple) -> Any:

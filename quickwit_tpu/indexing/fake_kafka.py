@@ -24,6 +24,9 @@ class FakeKafkaBroker:
                  node_id: int = 0):
         self._topics: dict[str, list[list[bytes]]] = {}
         self._batches: dict[tuple[str, int], list[tuple[int, bytes]]] = {}
+        # qwlint: disable-next-line=QW008 - indexing source loops and queue
+        # test doubles outside the DST-raced path; rendezvous is
+        # uninstrumentable real IO/time
         self._lock = threading.Lock()
         self.fail_next_fetches = 0
         self.node_id = node_id
@@ -41,6 +44,9 @@ class FakeKafkaBroker:
         self._running = True
         # qwlint: disable-next-line=QW003 - test-double broker accept
         # loop; serves no quickwit_tpu queries
+        # qwlint: disable-next-line=QW008 - indexing source loops and queue
+        # test doubles outside the DST-raced path; rendezvous is
+        # uninstrumentable real IO/time
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
 
@@ -85,6 +91,9 @@ class FakeKafkaBroker:
                 return
             # qwlint: disable-next-line=QW003 - test-double connection
             # handler; no query context exists on this path
+            # qwlint: disable-next-line=QW008 - indexing source loops and queue
+            # test doubles outside the DST-raced path; rendezvous is
+            # uninstrumentable real IO/time
             threading.Thread(target=self._handle, args=(conn,),
                              daemon=True).start()
 

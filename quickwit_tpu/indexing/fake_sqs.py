@@ -28,6 +28,9 @@ class FakeSqsServer:
         # message_id -> {"body", "receipt", "invisible_until"}
         self.messages: dict[str, dict] = {}
         self.deleted: list[str] = []
+        # qwlint: disable-next-line=QW008 - indexing source loops and queue
+        # test doubles outside the DST-raced path; rendezvous is
+        # uninstrumentable real IO/time
         self.lock = threading.Lock()
         self.request_log: list[str] = []
         self.fail_requests = 0
@@ -132,6 +135,9 @@ class FakeSqsServer:
     def start(self) -> "FakeSqsServer":
         # qwlint: disable-next-line=QW003 - test-double HTTP server; no
         # query context exists on this path
+        # qwlint: disable-next-line=QW008 - indexing source loops and queue
+        # test doubles outside the DST-raced path; rendezvous is
+        # uninstrumentable real IO/time
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()

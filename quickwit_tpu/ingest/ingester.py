@@ -90,6 +90,8 @@ class Ingester:
         self.on_truncate: Optional[Callable[[str, str, str, int],
                                             None]] = None
         self._shards: dict[str, Shard] = {}
+        # qwlint: disable-next-line=QW008 - ingest WAL/router leaf locks; pure
+        # in-memory ops inside, never a seam primitive
         self._lock = threading.Lock()
         self._recover()
 

@@ -47,6 +47,8 @@ class IngestRouter:
         # default: local static placement
         self.get_or_create_shards = get_or_create_shards or self._default_shards
         self._table: dict[tuple[str, str], RoutingEntry] = {}
+        # qwlint: disable-next-line=QW008 - ingest WAL/router leaf locks; pure
+        # in-memory ops inside, never a seam primitive
         self._lock = threading.Lock()
 
     def _default_shards(self, index_uid: str, source_id: str) -> list[str]:

@@ -31,6 +31,8 @@ class RecordLog:
         # disk, an error rule a failed fsync the caller must surface
         self.fault_injector = fault_injector
         os.makedirs(directory, exist_ok=True)
+        # qwlint: disable-next-line=QW008 - ingest WAL/router leaf locks; pure
+        # in-memory ops inside, never a seam primitive
         self._lock = threading.Lock()
         # segments: sorted list of (first_position, path)
         self._segments: list[tuple[int, str]] = []

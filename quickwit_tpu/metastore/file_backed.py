@@ -86,6 +86,8 @@ class FileBackedMetastore(Metastore):
         the reference, concurrent WRITERS on one index are not supported
         (single metastore-writer deployment)."""
         self.storage = storage
+        # qwlint: disable-next-line=QW008 - metastore leaf lock; pure dict/file
+        # ops inside its critical sections
         self._lock = threading.RLock()
         self._states: dict[str, _IndexState] = {}  # index_id -> state
         self._manifest: Optional[dict[str, str]] = None  # index_id -> index_uid

@@ -87,6 +87,8 @@ class SqlMetastore(Metastore):
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA busy_timeout=10000")
         self._conn.executescript(_SCHEMA)
+        # qwlint: disable-next-line=QW008 - metastore leaf lock; pure dict/file
+        # ops inside its critical sections
         self._lock = threading.RLock()
 
     # --- helpers ------------------------------------------------------

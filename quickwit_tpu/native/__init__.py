@@ -18,6 +18,8 @@ from typing import Any, Optional
 
 logger = logging.getLogger(__name__)
 
+# qwlint: disable-next-line=QW008 - one-time native-backend init lock; leaf by
+# construction
 _lock = threading.Lock()
 _cached: Any = "unset"
 

@@ -38,6 +38,8 @@ class Counter:
         self.name = name
         self.help = help_text
         self._values: dict[tuple, float] = {}
+        # qwlint: disable-next-line=QW008 - metrics/tracing leaf locks; counter
+        # updates only, no instrumented ops inside
         self._lock = threading.Lock()
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
@@ -60,6 +62,8 @@ class Gauge:
         self.name = name
         self.help = help_text
         self._values: dict[tuple, float] = {}
+        # qwlint: disable-next-line=QW008 - metrics/tracing leaf locks; counter
+        # updates only, no instrumented ops inside
         self._lock = threading.Lock()
 
     def set(self, value: float, **labels: str) -> None:
@@ -90,6 +94,8 @@ class Histogram:
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = {}
         self._totals: dict[tuple, int] = {}
+        # qwlint: disable-next-line=QW008 - metrics/tracing leaf locks; counter
+        # updates only, no instrumented ops inside
         self._lock = threading.Lock()
 
     def observe(self, value: float, **labels: str) -> None:
@@ -145,6 +151,8 @@ class Histogram:
 class MetricsRegistry:
     def __init__(self) -> None:
         self._metrics: dict[str, object] = {}
+        # qwlint: disable-next-line=QW008 - metrics/tracing leaf locks; counter
+        # updates only, no instrumented ops inside
         self._lock = threading.Lock()
 
     def counter(self, name: str, help_text: str = "") -> Counter:

@@ -79,6 +79,8 @@ class QueryProfile:
         # profiles returned by REMOTE leaves over the wire (embedded leaves
         # write into this profile directly through the ambient binding)
         self._children: list[dict[str, Any]] = []
+        # qwlint: disable-next-line=QW008 - metrics/tracing leaf locks; counter
+        # updates only, no instrumented ops inside
         self._lock = threading.Lock()
 
     # --- recording ---------------------------------------------------------

@@ -43,6 +43,8 @@ class SlowQueryLog:
         self.capacity = capacity
         self._threshold_ms = threshold_ms
         self._entries: deque[dict[str, Any]] = deque(maxlen=capacity)
+        # qwlint: disable-next-line=QW008 - metrics/tracing leaf locks; counter
+        # updates only, no instrumented ops inside
         self._lock = threading.Lock()
 
     @property

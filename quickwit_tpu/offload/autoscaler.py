@@ -16,12 +16,12 @@ configured endpoints are membership, not capacity.
 from __future__ import annotations
 
 import math
-import threading
 from typing import Callable, Optional
 
 from ..common.clock import monotonic
 from ..observability.metrics import OFFLOAD_AUTOSCALE_TOTAL
 from .pool import WorkerPool
+from ..common import sync
 
 
 class WorkerLauncher:
@@ -97,7 +97,7 @@ class Autoscaler:
             overload = OVERLOAD
         self.overload = overload
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = sync.lock("Autoscaler._lock")
         self._counter = 0
         self._managed: set[str] = set()
         self._last_scale_up = 0.0

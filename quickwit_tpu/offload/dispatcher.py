@@ -33,11 +33,11 @@ as a whole-query 429 — a worker's rate limits must bind the root too.
 from __future__ import annotations
 
 import json
-import threading
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from ..common import sync
 from ..common.clock import monotonic
 from ..common.ctx import run_with_context
 from ..common.deadline import Deadline, current_deadline
@@ -180,7 +180,11 @@ class OffloadDispatcher:
             return OffloadOutcome(unserved=list(request.splits),
                                   stats={"no_workers": 1})
 
-        cv = threading.Condition()
+        # per-call condition: the result board's only synchronization.
+        # Deliberately NOT a `*_lock`-named lock: the bridge reports it as
+        # anonymous (QW007's static graph never claims to see per-call
+        # primitives)
+        cv = sync.condition(name="offload_cv")
         queues: dict[str, deque[_Task]] = {
             worker_id: deque(tasks) for worker_id, tasks
             in self.plan_tasks(request.splits, workers).items()}
@@ -282,7 +286,7 @@ class OffloadDispatcher:
             if task.first_dispatch_at is None:
                 task.first_dispatch_at = self._clock()
             self.pool.begin_dispatch(worker_id)
-            threading.Thread(
+            sync.thread(
                 target=run_with_context(_attempt),
                 args=(task, worker_id, kind),
                 name=f"offload-{worker_id}", daemon=True).start()

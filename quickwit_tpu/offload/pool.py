@@ -24,10 +24,11 @@ whose p95 sets the hedge delay.
 
 from __future__ import annotations
 
-import threading
+import os
 from collections import deque
 from typing import Callable, Optional
 
+from ..common import sync
 from ..common.clock import monotonic
 from ..observability.metrics import OFFLOAD_POOL_WORKERS
 
@@ -77,7 +78,14 @@ class WorkerPool:
         self.readmit_backoff_secs = float(readmit_backoff_secs)
         self.readmit_backoff_max_secs = float(readmit_backoff_max_secs)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = sync.lock("WorkerPool._lock")
+        sync.register_shared(self, "WorkerPool")
+        # qwrace planted race (mandatory self-test): with
+        # QW_RACE_BREAK_POOL set, note_result mutates health state WITHOUT
+        # the pool lock — racing begin_dispatch/candidates on any schedule
+        # where the accesses are unordered
+        self._break_unlocked = os.environ.get(
+            "QW_RACE_BREAK_POOL", "").strip().lower() in ("1", "true", "yes")
         self._workers: dict[str, _Worker] = {}
         # pool-wide rolling window of successful-dispatch latencies; its
         # p95 is the hedge trigger ("this attempt is slower than 95% of
@@ -122,6 +130,7 @@ class WorkerPool:
         the next dispatch outcome decides re-eject vs recovery)."""
         now = self._clock()
         with self._lock:
+            sync.note_write(self, "workers")
             eligible = []
             for worker in self._workers.values():
                 if worker.state == EJECTED:
@@ -137,6 +146,7 @@ class WorkerPool:
     # --- dispatch accounting ---------------------------------------------
     def begin_dispatch(self, worker_id: str) -> None:
         with self._lock:
+            sync.note_write(self, "workers")
             worker = self._workers.get(worker_id)
             if worker is None:
                 return
@@ -147,35 +157,44 @@ class WorkerPool:
                     latency_secs: Optional[float] = None) -> None:
         """End-of-attempt accounting: inflight release + the passive
         health transition this outcome implies."""
+        if self._break_unlocked:
+            self._note_result_locked(worker_id, ok, latency_secs)
+            return
         with self._lock:
-            worker = self._workers.get(worker_id)
-            if worker is None:
-                return  # removed while the attempt was in flight
-            worker.inflight = max(worker.inflight - 1, 0)
+            self._note_result_locked(worker_id, ok, latency_secs)
+
+    def _note_result_locked(self, worker_id: str, ok: bool,
+                            latency_secs: Optional[float]) -> None:
+        sync.note_write(self, "workers")
+        worker = self._workers.get(worker_id)
+        if worker is None:
+            return  # removed while the attempt was in flight
+        worker.inflight = max(worker.inflight - 1, 0)
+        if latency_secs is not None:
+            worker.busy_secs += latency_secs
+        if ok:
+            worker.consecutive_failures = 0
+            worker.eject_count = 0
+            worker.state = HEALTHY
             if latency_secs is not None:
-                worker.busy_secs += latency_secs
-            if ok:
-                worker.consecutive_failures = 0
-                worker.eject_count = 0
-                worker.state = HEALTHY
-                if latency_secs is not None:
-                    self._latencies.append(latency_secs)
-            else:
-                worker.failures += 1
-                worker.consecutive_failures += 1
-                if worker.consecutive_failures >= self.eject_after:
-                    worker.state = EJECTED
-                    backoff = min(
-                        self.readmit_backoff_secs * (2 ** worker.eject_count),
-                        self.readmit_backoff_max_secs)
-                    worker.ejected_until = self._clock() + backoff
-                    worker.eject_count += 1
-                elif worker.consecutive_failures >= self.suspect_after:
-                    worker.state = SUSPECT
-            self._refresh_gauges_locked()
+                self._latencies.append(latency_secs)
+        else:
+            worker.failures += 1
+            worker.consecutive_failures += 1
+            if worker.consecutive_failures >= self.eject_after:
+                worker.state = EJECTED
+                backoff = min(
+                    self.readmit_backoff_secs * (2 ** worker.eject_count),
+                    self.readmit_backoff_max_secs)
+                worker.ejected_until = self._clock() + backoff
+                worker.eject_count += 1
+            elif worker.consecutive_failures >= self.suspect_after:
+                worker.state = SUSPECT
+        self._refresh_gauges_locked()
 
     def inflight(self, worker_id: str) -> int:
         with self._lock:
+            sync.note_read(self, "workers")
             worker = self._workers.get(worker_id)
             return worker.inflight if worker is not None else 0
 
@@ -192,6 +211,7 @@ class WorkerPool:
     # --- introspection ----------------------------------------------------
     def state_of(self, worker_id: str) -> Optional[str]:
         with self._lock:
+            sync.note_read(self, "workers")
             worker = self._workers.get(worker_id)
             return worker.state if worker is not None else None
 

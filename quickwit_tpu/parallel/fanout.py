@@ -524,6 +524,10 @@ def stage_device_inputs(batch: SplitBatch, mesh: Optional[Mesh] = None):
 # shared thread pool with data-dependency ordering only), so there the
 # program must also COMPLETE before the lock releases. Single-device
 # dispatches (mesh is None) carry no collectives and take no lock.
+# qwlint: disable-next-line=QW008 - leaf lock by design: the critical
+# section is a jax enqueue (+ block_until_ready on CPU), never a seam
+# primitive, so the gated qwrace scheduler cannot preempt inside it and
+# instrumenting it would only serialize jax dispatch behind the token
 _MESH_DISPATCH_LOCK = threading.Lock()
 
 

@@ -34,11 +34,11 @@ from __future__ import annotations
 
 import logging
 import os
-import threading
 import time
 import weakref
 from collections import OrderedDict
 
+from ..common import sync
 from ..common.deadline import DeadlineExceeded, current_deadline
 from ..observability.metrics import SEARCH_SHED_TOTAL
 from ..observability.profile import PHASE_ADMISSION_WAIT, current_profile
@@ -55,7 +55,8 @@ DEFAULT_BUDGET_BYTES = int(os.environ.get("QW_HBM_BUDGET_BYTES", 8 << 30))
 class HbmBudget:
     def __init__(self, budget_bytes: int = DEFAULT_BUDGET_BYTES):
         self.budget = budget_bytes
-        self._cond = threading.Condition()
+        self._cond = sync.condition(name="HbmBudget._cond")
+        sync.register_shared(self, "HbmBudget")
         self._pinned = 0
         self._pin_counts: dict[int, int] = {}  # id(owner) -> in-flight count
         # weighted deficit-round-robin admission order across tenants;
@@ -137,6 +138,7 @@ class HbmBudget:
                     raise
                 self._drr.remove(ticket, served=True)
                 self._cond.notify_all()
+                sync.note_write(self, "pinned")
                 self._pinned += new_bytes
                 self._pin_counts[id(owner)] = \
                     self._pin_counts.get(id(owner), 0) + 1
@@ -174,6 +176,7 @@ class HbmBudget:
         nothing actually landed in HBM). Zero-byte releases still unpin
         the owner (matching zero-byte admissions)."""
         with self._cond:
+            sync.note_write(self, "pinned")
             if admitted_bytes <= 0:
                 count = self._pin_counts.get(id(owner), 1) - 1
                 if count <= 0:

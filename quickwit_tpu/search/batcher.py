@@ -28,10 +28,10 @@ from __future__ import annotations
 
 import heapq
 import itertools
-import threading
 import time
 from typing import Any, Optional
 
+from ..common import sync
 from ..common.deadline import Deadline, DeadlineExceeded, current_deadline
 from ..observability.metrics import (
     SEARCH_BATCHER_DISPATCHES_TOTAL, SEARCH_BATCHER_QUERIES_TOTAL,
@@ -60,7 +60,7 @@ class _PriorityLock:
     the plain lock this replaces."""
 
     def __init__(self):
-        self._cond = threading.Condition()
+        self._cond = sync.condition(name="batcher_dispatch_cv")
         self._held = False
         self._waiters: list[tuple[int, int]] = []  # heap: (-priority, seq)
         self._seq = itertools.count()
@@ -87,7 +87,7 @@ class _Pending:
     def __init__(self, scalars, deadline: Optional[Deadline] = None,
                  profile=None):
         self.scalars = scalars
-        self.event = threading.Event()
+        self.event = sync.event()
         self.result: Any = None
         self.error: Exception | None = None
         self.deadline = deadline
@@ -104,7 +104,8 @@ class QueryBatcher:
 
     def __init__(self, max_batch: int = 16, fault_injector=None):
         self.max_batch = max_batch
-        self._lock = threading.Lock()
+        self._lock = sync.lock("QueryBatcher._lock")
+        sync.register_shared(self, "QueryBatcher")
         self._queues: dict[tuple, list[_Pending]] = {}
         # per-key dispatch serialization, refcounted so the dict cannot
         # grow without bound across query shapes / reader reopens
@@ -133,6 +134,7 @@ class QueryBatcher:
         me = _Pending(plan.scalars, current_deadline(), current_profile())
         my_queue = None
         with self._lock:
+            sync.note_write(self, "queues")
             self.num_queries += 1
             SEARCH_BATCHER_QUERIES_TOTAL.inc()
             queue = self._queues.get(key)
@@ -176,6 +178,7 @@ class QueryBatcher:
             dispatch_lock.acquire(tenant.priority)
             try:
                 with self._lock:
+                    sync.note_write(self, "queues")
                     if self._queues.get(key) is my_queue:
                         del self._queues[key]
                     batch = my_queue

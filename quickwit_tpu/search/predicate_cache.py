@@ -21,7 +21,6 @@ is a proof, whether or not the term was required in that query.
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 
 from ..models.doc_mapper import DocMapper, FieldMapping, FieldType
@@ -31,6 +30,7 @@ from ..observability.metrics import (
 )
 from ..query import ast as Q
 from ..query.tokenizers import get_tokenizer
+from ..common import sync
 
 # Accounted cost of one absence marker beyond its key strings: the
 # OrderedDict slot, the key tuple, and three string headers. An estimate
@@ -47,7 +47,7 @@ class PredicateCache:
         self._entries: OrderedDict[tuple[str, str, str], int] = OrderedDict()
         self.max_bytes = max_bytes
         self._size = 0
-        self._lock = threading.Lock()
+        self._lock = sync.lock("PredicateCache._lock")
         self.hits = 0
         self.misses = 0
         self.evicted_bytes = 0

@@ -38,9 +38,11 @@ Soundness per sort kind:
 
 from __future__ import annotations
 
-import threading
+import os
 from collections import OrderedDict
 from typing import Any, Callable, Optional
+
+from ..common import sync
 
 from ..models.doc_mapper import DocMapper, FieldType
 from ..ops.bm25 import B, K1, idf
@@ -65,16 +67,31 @@ class ThresholdBox:
 
     def __init__(self, seed: Optional[float] = None):
         self._value = seed
-        self._lock = threading.Lock()
+        self._lock = sync.lock("ThresholdBox._lock")
+        sync.register_shared(self, "ThresholdBox")
+        # qwrace planted race (mandatory self-test): with
+        # QW_RACE_BREAK_THRESHOLD set, update() does its read-modify-write
+        # WITHOUT the box lock — the exact bug the monotone-publish
+        # contract above exists to prevent
+        self._break_unlocked = os.environ.get(
+            "QW_RACE_BREAK_THRESHOLD", "").strip().lower() in (
+                "1", "true", "yes")
 
     def get(self) -> Optional[float]:
         with self._lock:
+            sync.note_read(self, "value")
             return self._value
 
     def update(self, value: Optional[float]) -> None:
         if value is None:
             return
+        if self._break_unlocked:
+            sync.note_write(self, "value")
+            if self._value is None or value > self._value:
+                self._value = value
+            return
         with self._lock:
+            sync.note_write(self, "value")
             if self._value is None or value > self._value:
                 self._value = value
 
@@ -96,7 +113,7 @@ class ScoreBoundCache:
         self._entries: OrderedDict[tuple[str, str, str],
                                    tuple] = OrderedDict()
         self._max_entries = max_entries
-        self._lock = threading.Lock()
+        self._lock = sync.lock("ScoreBoundCache._lock")
 
     def record(self, split_id: str, field: str, term: str,
                df: int, max_tf: int,

@@ -29,12 +29,12 @@ triggered LRU pressure).
 from __future__ import annotations
 
 import logging
-import threading
 import weakref
 from typing import Any, Optional
 
 from ..common.faults import InjectedFault
 from ..observability.metrics import METRICS
+from ..common import sync
 
 logger = logging.getLogger(__name__)
 
@@ -106,7 +106,8 @@ class ResidentColumnStore:
     """
 
     def __init__(self, fault_injector=None):
-        self._lock = threading.Lock()
+        self._lock = sync.lock("ResidentColumnStore._lock")
+        sync.register_shared(self, "ResidentColumnStore")
         self._by_split: dict[str, SplitColumns] = {}
         self._bytes = 0
         self.fault_injector = fault_injector

@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import fnmatch
 import logging
-import threading
 import time
 from typing import Any, Callable, Optional, Protocol
 
@@ -49,6 +48,7 @@ from .models import (
     SearchResponse, SplitIdAndFooter, SplitSearchError, string_sort_of,
 )
 from .placer import SearchJob, nodes_for_split, place_jobs
+from ..common import sync
 
 logger = logging.getLogger(__name__)
 
@@ -449,7 +449,7 @@ class RootSearcher:
             spawned_run = run_with_context(run)
         threads = []
         for i, (node_id, leaf_request) in enumerate(dispatches):
-            thread = threading.Thread(
+            thread = sync.thread(
                 target=spawned_run, args=(i, node_id, leaf_request),
                 name=f"root-fanout-{i}", daemon=True)
             threads.append(thread)

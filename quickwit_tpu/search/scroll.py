@@ -12,13 +12,13 @@ store itself, swappable for a replicated one).
 from __future__ import annotations
 
 import base64
-import threading
 import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from .models import PartialHit, SearchRequest
+from ..common import sync
 
 DEFAULT_TTL_SECS = 300
 CACHE_WINDOW = 1000
@@ -41,7 +41,7 @@ class ScrollContext:
 class ScrollStore:
     def __init__(self) -> None:
         self._contexts: dict[str, ScrollContext] = {}
-        self._lock = threading.Lock()
+        self._lock = sync.lock("ScrollStore._lock")
 
     def put(self, context: ScrollContext) -> str:
         scroll_id = base64.urlsafe_b64encode(uuid.uuid4().bytes).decode().rstrip("=")

@@ -18,10 +18,10 @@ reference's SearcherContext (`service.rs:405`) and SearchPermitProvider.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import os
 import re
-import threading
 from collections import OrderedDict
 from typing import Any, Optional
 
@@ -70,6 +70,7 @@ logger = logging.getLogger(__name__)
 # rate_limited_tracing.rs analogue: a bad query fanned over thousands of
 # splits must not emit thousands of identical warnings
 from ..observability.tracing import TRACER, RateLimitedLog  # noqa: E402
+from ..common import sync
 
 _SPLIT_WARN_LIMITER = RateLimitedLog(limit=5, period_secs=60.0)
 
@@ -167,7 +168,7 @@ class SearcherContext:
         self.query_batcher = QueryBatcher()
         self._readers: OrderedDict[str, SplitReader] = OrderedDict()
         self._max_open_splits = max_open_splits
-        self._lock = threading.Lock()
+        self._lock = sync.lock("SearchService._lock")
         self._meshes: dict = {}
         # elastic leaf-search offload (reference: lambda leaf-search
         # offload, quickwit-lambda-client/src/invoker.rs:129 + the
@@ -481,10 +482,10 @@ class SearchService:
                     # the offload workers enforce the same tenant class
                     tenant=(offload_tenant.to_wire()
                             if offload_tenant is not None else None),
-                    # let the workers start pruning where we already are
-                    sort_value_threshold=(threshold.get()
-                                          if prune_ctx.mode is not None
-                                          else None))
+                    # seeded at dispatch time inside _invoke (below): the
+                    # threshold is monotone, so the LATEST value prunes
+                    # strictly more on the workers than a capture-time copy
+                    sort_value_threshold=None)
                 result_box: dict[str, Any] = {}
                 # the dispatch thread has an empty thread-local span stack:
                 # capture the traceparent HERE so each worker RPC's
@@ -495,6 +496,12 @@ class SearchService:
                 def _invoke(box=result_box, rr=remote_request,
                             tp=offload_tp):
                     try:
+                        # read the shared ThresholdBox from the dispatch
+                        # thread, NOT at capture time: the local execute
+                        # loop keeps raising it concurrently
+                        if prune_ctx.mode is not None:
+                            rr = dataclasses.replace(
+                                rr, sort_value_threshold=threshold.get())
                         with TRACER.span(
                                 "leaf_offload",
                                 {"num_splits": len(rr.splits)},
@@ -517,8 +524,9 @@ class SearchService:
                 # run_with_context: the dispatch thread (and the worker
                 # attempt threads it spawns) must see the query's
                 # deadline, tenant and profile
-                offload_future = threading.Thread(
-                    target=run_with_context(_invoke), daemon=True)
+                offload_future = sync.thread(
+                    target=run_with_context(_invoke),
+                    name="leaf-offload", daemon=True)
                 offload_future.start()
                 offload_result = result_box
 
@@ -791,6 +799,15 @@ class SearchService:
                 and not any(key in _json.dumps(search_request.aggs or {})
                             for key in ("split_size", "shard_size",
                                         "segment_size"))):
+            # Batch lanes must be in split_id order: the kernel's
+            # cross-split merge breaks sort-value ties by flattened lane
+            # index (fanout.batch_fn / ops.topk.exact_topk_2key), and the
+            # collector's total order is (key desc, split_id asc, doc asc).
+            # _optimize_split_order and the offload cut reorder/recompose
+            # run_group between passes, so an all-ties search would
+            # otherwise keep a DIFFERENT tie subset under truncation cold
+            # vs warm, breaking cache_cold_equivalence.
+            run_group = sorted(run_group, key=lambda s: s.split_id)
             admitted = None
             batch = None
             try:

@@ -23,9 +23,9 @@ is the full capacity — bit-identical behavior to an unpartitioned
 
 from __future__ import annotations
 
-import threading
 from typing import Optional
 
+from ..common import sync
 from ..storage.cache import MemorySizedCache
 from ..tenancy.context import effective_tenant
 
@@ -37,12 +37,14 @@ class TenantPartitionedCache:
         self.capacity_bytes = capacity_bytes
         self._parts: dict[str, MemorySizedCache] = {}
         self._weights: dict[str, float] = {}
-        self._lock = threading.Lock()
+        self._lock = sync.lock("TenantPartitionedCache._lock")
+        sync.register_shared(self, "TenantPartitionedCache")
         self._on_evict = on_evict
 
     def _partition(self) -> MemorySizedCache:
         tenant = effective_tenant()
         with self._lock:
+            sync.note_write(self, "parts")
             part = self._parts.get(tenant.tenant_id)
             if part is None:
                 part = MemorySizedCache(self.capacity_bytes,
@@ -79,14 +81,20 @@ class TenantPartitionedCache:
     @property
     def stats(self) -> dict:
         with self._lock:
+            sync.note_read(self, "parts")
             parts = dict(self._parts)
+        # per-partition counters read under EACH partition's own lock
+        # (stats_snapshot): the bare attribute reads this replaced raced
+        # the hit/miss increments on the partitions (found by qwrace)
+        snaps = {tenant_id: p.stats_snapshot()
+                 for tenant_id, p in parts.items()}
         return {
-            "hits": sum(p.hits for p in parts.values()),
-            "misses": sum(p.misses for p in parts.values()),
-            "size_bytes": sum(p.size_bytes for p in parts.values()),
-            "evicted_bytes": sum(p.evicted_bytes for p in parts.values()),
+            "hits": sum(s["hits"] for s in snaps.values()),
+            "misses": sum(s["misses"] for s in snaps.values()),
+            "size_bytes": sum(s["size_bytes"] for s in snaps.values()),
+            "evicted_bytes": sum(s["evicted_bytes"] for s in snaps.values()),
             "partitions": {
-                tenant_id: {"quota_bytes": p.capacity_bytes,
-                            "size_bytes": p.size_bytes}
-                for tenant_id, p in parts.items()},
+                tenant_id: {"quota_bytes": s["capacity_bytes"],
+                            "size_bytes": s["size_bytes"]}
+                for tenant_id, s in snaps.items()},
         }

@@ -421,6 +421,9 @@ class GrpcChannel:
             PREFACE + frame(FRAME_SETTINGS, 0, 0, b""))
         self._decoder = HpackDecoder()
         self._stream_id = 1
+        # qwlint: disable-next-line=QW008 - serve-layer transport
+        # infrastructure (sockets, real IO) outside the DST-raced path; gating
+        # it would block the token on real IO
         self._lock = threading.Lock()
 
     def close(self) -> None:
@@ -542,6 +545,9 @@ class GrpcSearchClient:
         # construction — no per-reconnect context mutation
         self._channel_ssl = client_ssl_context(alpn=["h2"], **http_kwargs)
         self._channel: "GrpcChannel | None" = None
+        # qwlint: disable-next-line=QW008 - serve-layer transport
+        # infrastructure (sockets, real IO) outside the DST-raced path; gating
+        # it would block the token on real IO
         self._channel_lock = threading.Lock()
 
     def close(self) -> None:

@@ -219,6 +219,9 @@ class RestServer:
         self.node.config.rest_port = self.port
         # qwlint: disable-next-line=QW003 - REST listener: each request
         # binds deadline/tenant from its own headers/params downstream
+        # qwlint: disable-next-line=QW008 - serve-layer transport
+        # infrastructure (sockets, real IO) outside the DST-raced path; gating
+        # it would block the token on real IO
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name=f"rest-{self.port}", daemon=True)
         self._thread.start()

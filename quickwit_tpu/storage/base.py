@@ -81,6 +81,8 @@ class StorageResolver:
     def __init__(self) -> None:
         self._factories: dict[Protocol, Callable[[Uri], Storage]] = {}
         self._cache: dict[str, Storage] = {}
+        # qwlint: disable-next-line=QW008 - storage base/fakes leaf locks; pure
+        # in-memory ops inside, never a seam primitive
         self._lock = threading.Lock()
 
     def register(self, protocol: Protocol, factory: Callable[[Uri], Storage]) -> None:

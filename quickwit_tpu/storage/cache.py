@@ -11,10 +11,10 @@ Role of the reference's cache hierarchy (`quickwit-storage/src/cache/`):
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 from typing import Iterable, Optional
 
+from ..common import sync
 from .base import Storage
 
 
@@ -31,7 +31,7 @@ class MemorySizedCache:
         self.capacity_bytes = capacity_bytes
         self._entries: OrderedDict[str, bytes] = OrderedDict()
         self._size = 0
-        self._lock = threading.Lock()
+        self._lock = sync.lock("MemorySizedCache._lock")
         self.hits = 0
         self.misses = 0
         self.evicted_bytes = 0
@@ -39,6 +39,7 @@ class MemorySizedCache:
 
     def get(self, key: str) -> Optional[bytes]:
         with self._lock:
+            sync.note_write(self, "entries")
             data = self._entries.get(key)
             if data is None:
                 self.misses += 1
@@ -65,6 +66,7 @@ class MemorySizedCache:
         if len(data) > self.capacity_bytes:
             return  # reference behavior: items larger than the cache are not cached
         with self._lock:
+            sync.note_write(self, "entries")
             old = self._entries.pop(key, None)
             if old is not None:
                 self._size -= len(old)
@@ -83,6 +85,7 @@ class MemorySizedCache:
 
     def resize(self, capacity_bytes: int) -> None:
         with self._lock:
+            sync.note_write(self, "entries")
             self.capacity_bytes = capacity_bytes
             dropped = self._evict_to_capacity_locked()
         self._notify_evicted(dropped)
@@ -101,7 +104,21 @@ class MemorySizedCache:
 
     @property
     def size_bytes(self) -> int:
-        return self._size
+        # under the lock: `_size` is written by concurrent put/evict and a
+        # torn read would leak into quota math (found by qwrace)
+        with self._lock:
+            sync.note_read(self, "entries")
+            return self._size
+
+    def stats_snapshot(self) -> dict:
+        """Counters + size read atomically under the cache lock — the
+        aggregation path must not race the hit/miss increments."""
+        with self._lock:
+            sync.note_read(self, "entries")
+            return {"hits": self.hits, "misses": self.misses,
+                    "size_bytes": self._size,
+                    "evicted_bytes": self.evicted_bytes,
+                    "capacity_bytes": self.capacity_bytes}
 
 
 class ByteRangeCache:
@@ -111,12 +128,13 @@ class ByteRangeCache:
 
     def __init__(self) -> None:
         self._ranges: dict[str, list[tuple[int, int, bytes]]] = {}
-        self._lock = threading.Lock()
+        self._lock = sync.lock("ByteRangeCache._lock")
         self.hits = 0
         self.misses = 0
 
     def get(self, path: str, start: int, end: int) -> Optional[bytes]:
         with self._lock:
+            sync.note_write(self, "ranges")
             for r_start, r_end, data in self._ranges.get(path, ()):
                 if r_start <= start and end <= r_end:
                     self.hits += 1
@@ -127,6 +145,7 @@ class ByteRangeCache:
     def put(self, path: str, start: int, data: bytes) -> None:
         end = start + len(data)
         with self._lock:
+            sync.note_write(self, "ranges")
             ranges = self._ranges.setdefault(path, [])
             merged_start, merged_end, merged = start, end, data
             keep: list[tuple[int, int, bytes]] = []

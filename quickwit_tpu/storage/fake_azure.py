@@ -22,6 +22,8 @@ class FakeAzureServer:
         self.access_key = access_key  # base64; "" disables verification
         # container -> blob name -> bytes
         self.blobs: dict[str, dict[str, bytes]] = {}
+        # qwlint: disable-next-line=QW008 - storage base/fakes leaf locks; pure
+        # in-memory ops inside, never a seam primitive
         self.lock = threading.Lock()
         self.request_log: list[tuple[str, str]] = []
         self.auth_failures = 0
@@ -179,6 +181,8 @@ class FakeAzureServer:
     def start(self) -> "FakeAzureServer":
         # qwlint: disable-next-line=QW003 - test-double HTTP server; no
         # query context exists on this path
+        # qwlint: disable-next-line=QW008 - storage base/fakes leaf locks; pure
+        # in-memory ops inside, never a seam primitive
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()

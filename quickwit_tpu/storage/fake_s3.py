@@ -45,6 +45,8 @@ class FakeS3Server:
         self.latency_fn = latency_fn
         self.objects: dict[str, dict[str, bytes]] = {}  # bucket -> key -> data
         self.ignore_range = False  # emulate servers that 200 full objects
+        # qwlint: disable-next-line=QW008 - storage base/fakes leaf locks; pure
+        # in-memory ops inside, never a seam primitive
         self.lock = threading.Lock()
         self.request_log: list[tuple[str, str, dict]] = []
         self.fail_requests = 0        # fail the next N requests with 500
@@ -264,6 +266,8 @@ class FakeS3Server:
         self.endpoint = f"http://127.0.0.1:{self.port}"
         # qwlint: disable-next-line=QW003 - test-double HTTP server; no
         # query context exists on this path
+        # qwlint: disable-next-line=QW008 - storage base/fakes leaf locks; pure
+        # in-memory ops inside, never a seam primitive
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="fake-s3", daemon=True)
 

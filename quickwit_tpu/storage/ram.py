@@ -12,6 +12,8 @@ class RamStorage(Storage):
     def __init__(self, uri: Uri):
         super().__init__(uri)
         self._files: dict[str, bytes] = {}
+        # qwlint: disable-next-line=QW008 - storage base/fakes leaf locks; pure
+        # in-memory ops inside, never a seam primitive
         self._lock = threading.Lock()
 
     def subdir(self, uri: Uri) -> "RamStorage":

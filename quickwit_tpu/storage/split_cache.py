@@ -176,9 +176,18 @@ class DiskSplitCache:
         self.root_path = root_path
         self.storage_resolver = storage_resolver
         os.makedirs(root_path, exist_ok=True)
+        # qwlint: disable-next-line=QW008 - on-disk cache downloader does real
+        # file IO and timed event waits on real time; outside the DST-raced in-
+        # memory path
         self._lock = threading.Lock()
         self.table = SplitTable(max_bytes, max_splits)
+        # qwlint: disable-next-line=QW008 - on-disk cache downloader does real
+        # file IO and timed event waits on real time; outside the DST-raced in-
+        # memory path
         self._wakeup = threading.Event()
+        # qwlint: disable-next-line=QW008 - on-disk cache downloader does real
+        # file IO and timed event waits on real time; outside the DST-raced in-
+        # memory path
         self._stop = threading.Event()
         self._worker: Optional[threading.Thread] = None
         # startup scan: drop interrupted downloads, adopt finished splits
@@ -299,6 +308,9 @@ class DiskSplitCache:
             # qwlint: disable-next-line=QW003 - long-lived background
             # downloader; deliberately NOT bound to the starting request's
             # deadline/tenant context
+            # qwlint: disable-next-line=QW008 - on-disk cache downloader does
+            # real file IO and timed event waits on real time; outside the DST-
+            # raced in-memory path
             self._worker = threading.Thread(
                 target=self._worker_loop, name="split-cache-dl", daemon=True)
             self._worker.start()

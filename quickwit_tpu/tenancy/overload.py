@@ -21,10 +21,10 @@ pre-tenancy behavior.
 
 from __future__ import annotations
 
-import threading
 
 from ..common.clock import monotonic
 from .context import MAX_PRIORITY
+from ..common import sync
 
 
 class OverloadShed(Exception):
@@ -49,7 +49,7 @@ class OverloadController:
         self.alpha = float(alpha)
         self.idle_reset_secs = float(idle_reset_secs)
         self.enabled = bool(enabled)
-        self._lock = threading.Lock()
+        self._lock = sync.lock("OverloadController._lock")
         self._ewma = 0.0
         self._last_update = 0.0
 

@@ -26,7 +26,6 @@ tenants always keep their own label (config size bounds them).
 from __future__ import annotations
 
 import hashlib
-import threading
 from typing import Optional
 
 from ..common.tower import TokenBucket
@@ -37,6 +36,7 @@ from ..observability.metrics import (
 )
 from .context import DEFAULT_CLASS, DEFAULT_TENANT, TenantContext
 from .overload import OVERLOAD
+from ..common import sync
 
 MAX_TENANT_LABELS = 64
 _LABEL_ID_MAX_LEN = 32
@@ -58,7 +58,8 @@ class TenantRateLimited(Exception):
 
 class TenancyRegistry:
     def __init__(self, config: Optional[dict] = None):
-        self._lock = threading.Lock()
+        self._lock = sync.lock("TenancyRegistry._lock")
+        sync.register_shared(self, "TenancyRegistry")
         self.configure(config)
 
     # --- configuration ----------------------------------------------------

@@ -320,6 +320,46 @@ def test_text_field_sort_across_splits():
     assert "fast" in str(exc.value)
 
 
+def test_unsorted_tie_truncation_is_split_order_invariant(monkeypatch):
+    """Regression: the batched cross-split merge breaks sort-value ties by
+    flattened lane index (parallel/fanout.py:batch_fn), so the batch lanes
+    must be pinned to split_id order no matter how the visit order was
+    optimized or recomposed by the offload cut. An unsorted search has
+    EVERY hit tied; truncation at max_hits used to keep whichever docs sat
+    in the earliest lanes — a different subset cold vs warm (surfaced by
+    the DST fanout scenario's cache_cold_equivalence invariant, seed 17)."""
+    from quickwit_tpu.serve import Node, NodeConfig
+    node = Node(NodeConfig(node_id="tie-node",
+                           metastore_uri="ram:///ties/metastore",
+                           default_index_root_uri="ram:///ties/indexes"),
+                storage_resolver=StorageResolver.for_test())
+    node.index_service.create_index({
+        "index_id": "ties",
+        "doc_mapping": {
+            "field_mappings": [{"name": "body", "type": "text"}],
+            "default_search_fields": ["body"]},
+        "indexing_settings": {"split_num_docs_target": 4}})
+    node.ingest("ties", [{"body": f"tied doc {i}"} for i in range(12)])
+
+    request = SearchRequest(
+        index_ids=["ties"],
+        query_ast=parse_query_string("tied", ["body"]),
+        max_hits=6)
+
+    def run(order_fn):
+        monkeypatch.setattr(SearchService, "_optimize_split_order",
+                            staticmethod(order_fn))
+        response = node.root_searcher.search(request)
+        return [(h.split_id, h.doc_id) for h in response.hits]
+
+    natural = run(lambda request, splits: list(splits))
+    shuffled = run(lambda request, splits: list(reversed(splits)))
+    # identical tie subset either way, and it is the prefix of the
+    # collector's total order (split_id asc, doc_id asc)
+    assert natural == shuffled == sorted(natural)
+    assert len(natural) == 6
+
+
 def test_count_from_metadata_never_opens_split(cluster, monkeypatch):
     """Pure count (match-all, max_hits=0, no aggs): each split's answer is
     its metastore doc count — the leaf must not open the split at all."""

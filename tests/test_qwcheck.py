@@ -12,7 +12,7 @@ from tools.qwcheck.__main__ import _GATES, main
 
 
 def test_gate_list_is_pinned():
-    assert _GATES == ("qwlint", "qwmc", "qwir")
+    assert _GATES == ("qwlint", "qwmc", "qwir", "qwrace")
 
 
 def test_merged_json_and_exit_code(capsys):

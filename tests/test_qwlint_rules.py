@@ -145,19 +145,21 @@ def test_qw003_flags_bare_thread_and_pool_submit(tmp_path):
             threading.Thread(target=work).start()
             pool.submit(work, 1)
     """)
-    assert rules_of(findings) == ["QW003", "QW003"]
+    # the raw Thread construction is itself a QW008 since the sync seam
+    # landed; the bare targets stay QW003 either way
+    assert sorted(rules_of(findings)) == ["QW003", "QW003", "QW008"]
 
 
 def test_qw003_allows_wrapped_callables_and_task_queues(tmp_path):
     findings = lint(tmp_path, """
-        import threading
+        from quickwit_tpu.common import sync
         from quickwit_tpu.common.ctx import run_with_context
 
         def go(pool, compactor, work, task):
-            threading.Thread(target=run_with_context(work)).start()
+            sync.thread(target=run_with_context(work)).start()
             pool.submit(run_with_context(work), 1)
             spawned = run_with_context(work)
-            threading.Thread(target=spawned).start()  # name, wrapped above
+            sync.thread(target=spawned).start()  # name, wrapped above
             compactor.submit(task)  # work queue, not an executor
     """)
     assert findings == []
@@ -169,23 +171,23 @@ def test_qw003_offload_attempt_spawn_needs_context_wrap(tmp_path):
     # mirrors quickwit_tpu/offload/dispatcher.py's _launch, which ships
     # wrapped — the negative below)
     findings = lint(tmp_path, """
-        import threading
+        from quickwit_tpu.common import sync
 
         def launch(attempt, task, worker_id):
-            threading.Thread(target=attempt, args=(task, worker_id),
-                             name=f"offload-{worker_id}",
-                             daemon=True).start()
+            sync.thread(target=attempt, args=(task, worker_id),
+                        name=f"offload-{worker_id}",
+                        daemon=True).start()
     """)
     assert rules_of(findings) == ["QW003"]
     findings = lint(tmp_path, """
-        import threading
+        from quickwit_tpu.common import sync
         from quickwit_tpu.common.ctx import run_with_context
 
         def launch(attempt, task, worker_id):
-            threading.Thread(target=run_with_context(attempt),
-                             args=(task, worker_id),
-                             name=f"offload-{worker_id}",
-                             daemon=True).start()
+            sync.thread(target=run_with_context(attempt),
+                        args=(task, worker_id),
+                        name=f"offload-{worker_id}",
+                        daemon=True).start()
     """)
     assert findings == []
 
@@ -522,6 +524,102 @@ def test_qw007_suppressed_edge_never_enters_the_graph(tmp_path):
     # with the forward edge suppressed there is no cycle left, so the
     # backward site is clean too (its order is now the canonical one)
     assert qw007(analyze_paths([str(tmp_path)], root=str(tmp_path))) == []
+
+
+# --- QW008 raw-threading-construction ----------------------------------------
+
+def test_qw008_flags_attribute_and_from_import_constructors(tmp_path):
+    findings = lint(tmp_path, """
+        import threading
+        from threading import Event, Semaphore as Sem
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self._done = Event()
+                self._slots = Sem(4)
+
+        def spawn(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            return t
+    """)
+    assert rules_of(findings).count("QW008") == 5
+
+
+def test_qw008_quiet_on_seam_and_non_constructor_threading(tmp_path):
+    findings = lint(tmp_path, """
+        import threading
+        from quickwit_tpu.common import sync
+
+        class Box:
+            def __init__(self):
+                self._lock = sync.lock("Box._lock")
+                self._cond = sync.condition(self._lock, name="box_cv")
+                self._done = sync.event("box_done")
+
+        def who():
+            # introspection / TLS are not constructors the seam wraps
+            local = threading.local()
+            return threading.current_thread().name, local
+    """)
+    assert "QW008" not in rules_of(findings)
+
+
+def test_qw008_exempts_the_seam_module_itself(tmp_path):
+    pkg = tmp_path / "quickwit_tpu" / "common"
+    pkg.mkdir(parents=True)
+    (pkg / "sync.py").write_text(textwrap.dedent("""
+        import threading
+
+        def lock(name):
+            return threading.Lock()
+    """))
+    findings = analyze_paths([str(tmp_path)], root=str(tmp_path))
+    assert "QW008" not in rules_of(findings)
+
+
+def test_qw008_covers_whole_package(tmp_path):
+    # QW008 scopes to ALL of quickwit_tpu/ (no hot-path module list): the
+    # scheduler seam is a whole-package contract, cold paths included
+    pkg = tmp_path / "quickwit_tpu" / "metastore"
+    pkg.mkdir(parents=True)
+    (pkg / "cold.py").write_text(
+        "import threading\nLOCK = threading.Lock()\n")
+    findings = analyze_paths([str(tmp_path)], root=str(tmp_path))
+    assert [(f.rule, f.path) for f in findings] == [
+        ("QW008", "quickwit_tpu/metastore/cold.py")]
+
+
+def test_qw008_suppression_with_justification(tmp_path):
+    findings = lint(tmp_path, """
+        import threading
+
+        class Counters:
+            def __init__(self):
+                # qwlint: disable-next-line=QW008 - leaf lock: critical
+                # sections are plain int updates with no seam operations,
+                # so the gated scheduler never parks while holding it
+                self._lock = threading.Lock()
+    """)
+    assert findings == []
+
+
+def test_qw003_covers_seam_thread_factory(tmp_path):
+    # the lowercase `thread` seam factory spawns real threads too: a bare
+    # target drops contextvars exactly like threading.Thread would
+    findings = lint(tmp_path, """
+        from quickwit_tpu.common import sync
+        from quickwit_tpu.common.ctx import run_with_context
+
+        def bad(fn):
+            return sync.thread(target=fn, daemon=True)
+
+        def good(fn):
+            return sync.thread(target=run_with_context(fn), daemon=True)
+    """)
+    assert rules_of(findings) == ["QW003"]
 
 
 # --- suppression scopes ------------------------------------------------------

@@ -1,5 +1,6 @@
-"""Strict structural validation of the SARIF 2.1.0 logs both CLIs emit
-(`qwlint --sarif`, `qwir audit --sarif`). No jsonschema dependency: the
+"""Strict structural validation of the SARIF 2.1.0 logs the CLIs emit
+(`qwlint --sarif`, `qwir audit --sarif`, `qwrace sweep/bridge --sarif`).
+No jsonschema dependency: the
 validator below checks exactly the invariants CI annotators rely on —
 version pin, run/tool/driver skeleton, rule metadata, result shape, and
 that every result's ruleId resolves to a declared rule."""
@@ -90,6 +91,37 @@ def test_qwlint_sarif_is_valid(tmp_path):
     loc = results[0]["locations"][0]["physicalLocation"]
     assert loc["artifactLocation"]["uri"] == "bad.py"
     assert loc["region"]["startLine"] == 4
+
+
+def test_qwrace_sarif_is_valid():
+    # synthetic findings in the detector's exact output shape (plus one
+    # bridge scope gap) — the fast path; the CLI end-to-end sweep lives
+    # in tests/test_qwrace.py
+    from tools.qwrace.harness import QWRACE_RULES, findings_to_sarif_results
+    findings = [
+        {"kind": "write-read", "object": "ThresholdBox#1", "field": "value",
+         "op_step": 3,
+         "access": {"site": "quickwit_tpu/search/pruning.py:42",
+                    "lockset": []},
+         "previous": {"site": "quickwit_tpu/search/service.py:210",
+                      "lockset": ["SearchService._lock"]}},
+        {"kind": "deadlock",
+         "blocked": [{"name": "main"}, {"name": "leaf-offload"}]},
+        {"kind": "scheduler_budget_exhausted", "steps": 500_000},
+    ]
+    gaps = [{"held": "A._lock", "acquired": "B._lock",
+             "site": "quickwit_tpu/x.py:7"}]
+    log = sarif_log(tool="qwrace", rules=QWRACE_RULES,
+                    results=findings_to_sarif_results(findings, gaps))
+    assert_valid_sarif(log)
+    results = log["runs"][0]["results"]
+    assert [r["ruleId"] for r in results] == \
+        ["QWRACE001", "QWRACE002", "QWRACE002", "QWRACE003"]
+    race = results[0]
+    phys = race["locations"][0]["physicalLocation"]
+    assert phys["artifactLocation"]["uri"] == "quickwit_tpu/search/pruning.py"
+    assert phys["region"]["startLine"] == 42
+    assert "ThresholdBox#1.value" in race["message"]["text"]
 
 
 def test_write_sarif_round_trips(tmp_path):

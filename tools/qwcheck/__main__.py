@@ -13,7 +13,7 @@ import json
 import sys
 import traceback
 
-_GATES = ("qwlint", "qwmc", "qwir")
+_GATES = ("qwlint", "qwmc", "qwir", "qwrace")
 
 
 def _run_qwlint() -> tuple[int, dict]:
@@ -56,13 +56,20 @@ def _run_qwir() -> tuple[int, dict]:
     return (0 if ok else 1), doc
 
 
-_RUNNERS = {"qwlint": _run_qwlint, "qwmc": _run_qwmc, "qwir": _run_qwir}
+def _run_qwrace() -> tuple[int, dict]:
+    from tools.qwrace.__main__ import run_gate
+    return run_gate()
+
+
+_RUNNERS = {"qwlint": _run_qwlint, "qwmc": _run_qwmc, "qwir": _run_qwir,
+            "qwrace": _run_qwrace}
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.qwcheck",
-        description="run qwlint + qwmc + qwir as one merged gate")
+        description="run qwlint + qwmc + qwir + qwrace as one merged "
+                    "gate")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="emit one merged JSON document")
     parser.add_argument("--skip", action="append", default=[],

@@ -230,7 +230,9 @@ class AmbientContextPropagation:
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call):
                 continue
-            if last_segment(node.func) == "Thread":
+            # "thread" covers the seam factory (common.sync.thread): the
+            # contextvars hop is identical whichever constructor spawns it
+            if last_segment(node.func) in ("Thread", "thread"):
                 target = next((kw.value for kw in node.keywords
                                if kw.arg == "target"), None)
                 if target is not None \
@@ -513,6 +515,10 @@ _LOCK_NAME_RE = re.compile(r"(?:^|_)(?:lock|mutex)$", re.IGNORECASE)
 _QW007_READBACK_DOTTED = _READBACK_DOTTED | {"jax.block_until_ready"}
 
 _QW007_SHARED = "qw007_edges"
+# every edge, suppressed included: a suppression waives the CYCLE report,
+# not the edge's existence — tools/qwrace's lock-graph bridge compares the
+# runtime witness graph against this full static graph
+_QW007_ALL_SHARED = "qw007_all_edges"
 
 
 class LockOrder:
@@ -552,14 +558,18 @@ class LockOrder:
     # -- recording ---------------------------------------------------------
     def _record_edge(self, ctx: FileContext, held: str, acquired: str,
                      node: ast.AST) -> None:
-        if held == acquired or ctx.suppressed(self.id, node):
+        if held == acquired:
             return
-        sites = ctx.shared.setdefault(_QW007_SHARED, {}) \
-                          .setdefault((held, acquired), [])
-        sites.append({"path": ctx.relpath,
-                      "line": getattr(node, "lineno", 0),
-                      "col": getattr(node, "col_offset", 0),
-                      "function": getattr(node, "_qw_qual", "<module>")})
+        site = {"path": ctx.relpath,
+                "line": getattr(node, "lineno", 0),
+                "col": getattr(node, "col_offset", 0),
+                "function": getattr(node, "_qw_qual", "<module>")}
+        ctx.shared.setdefault(_QW007_ALL_SHARED, {}) \
+                  .setdefault((held, acquired), []).append(site)
+        if ctx.suppressed(self.id, node):
+            return
+        ctx.shared.setdefault(_QW007_SHARED, {}) \
+                  .setdefault((held, acquired), []).append(site)
 
     def _scan_readbacks(self, ctx: FileContext, exprs, held) -> None:
         if not held:
@@ -696,8 +706,71 @@ class LockOrder:
         return None
 
 
+# --- QW008 raw-threading-construction ----------------------------------------
+
+# constructors the sync seam wraps; Timer/Barrier are unused in the tree
+# and would be findings too if they appeared
+_QW008_CTORS = {"Lock", "RLock", "Condition", "Event", "Semaphore",
+                "BoundedSemaphore", "Thread"}
+
+
+class RawThreadingConstruction:
+    """Raw `threading.{Lock,RLock,Condition,Event,Semaphore,Thread}`
+    construction outside `common/sync.py`.
+
+    The sync seam is how `tools/qwrace` gates every thread under one
+    seeded scheduler and records happens-before edges: a raw primitive is
+    invisible to race detection (its release→acquire edges are missing,
+    so accesses it actually protects report as races) and — worse — a raw
+    lock held across an instrumented preemption point can park its holder
+    while another thread blocks on the real lock, hanging the gated run.
+    Construct through `quickwit_tpu.common.sync` (`lock()/rlock()/
+    condition()/event()/semaphore()/thread()`), or suppress with the
+    argument that makes the site safe (leaf critical section containing
+    no seam operations, process-lifetime infrastructure thread, ...).
+    """
+
+    id = "QW008"
+    title = "raw-threading-construction"
+
+    def _message(self, what: str) -> str:
+        return (f"raw {what} outside common/sync.py: invisible to the "
+                "qwrace scheduler and happens-before detection — "
+                "construct via quickwit_tpu.common.sync "
+                "(lock()/rlock()/condition()/event()/semaphore()/"
+                "thread()), or suppress with the argument that makes the "
+                "raw primitive safe here")
+
+    def check(self, ctx: FileContext) -> None:
+        if not ctx.in_package_scope(("quickwit_tpu/",)):
+            return
+        if ctx.relpath.endswith("common/sync.py"):
+            return  # the seam itself: raw construction is its job
+        from_imports: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.level == 0 \
+                    and node.module == "threading":
+                from_imports.update(
+                    a.asname or a.name for a in node.names
+                    if a.name in _QW008_CTORS)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            dotted = dotted_name(func)
+            if (isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id == "threading"
+                    and func.attr in _QW008_CTORS):
+                ctx.add(self.id, node,
+                        self._message(f"threading.{func.attr}()"))
+            elif (isinstance(func, ast.Name) and dotted in from_imports):
+                ctx.add(self.id, node, self._message(f"{dotted}()"))
+
+
 RULES = [HiddenHostReadback(), RecompilationHazard(),
          AmbientContextPropagation(), SwallowedControlFlow(),
-         MetricsHygiene(), AmbientTimeAndRandomness(), LockOrder()]
+         MetricsHygiene(), AmbientTimeAndRandomness(), LockOrder(),
+         RawThreadingConstruction()]
 
 RULE_DOCS = {rule.id: rule.title for rule in RULES}

@@ -1,0 +1,18 @@
+"""qwrace — deterministic happens-before race detection over the DST
+scheduler.
+
+The fourth analyzer in the family (qwlint / qwmc / qwir / qwrace, see
+docs/static-analysis.md): FastTrack-style vector-clock detection running
+under a gated, seeded PCT thread scheduler, so every detected race is
+deterministic, shrinkable by the DST shrinker, and replayable
+byte-identically from a canonical-JSON artifact. `bridge` cross-checks
+the runtime lock-order witness graph against qwlint QW007's static
+acquisition graph.
+"""
+
+from .detector import RaceDetector
+from .harness import PctRace, race_from_dict
+from .runtime import RaceRuntime, SchedulerAbort
+
+__all__ = ["PctRace", "RaceDetector", "RaceRuntime", "SchedulerAbort",
+           "race_from_dict"]
