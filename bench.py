@@ -1367,6 +1367,161 @@ def _measure_dashboard_qps(iters: int) -> dict:
     }
 
 
+def _measure_preemption() -> dict:
+    """Config #12: mid-query tenant preemption at chunk boundaries
+    (search/chunkexec.py). A background-class tenant scans a big split
+    in a loop while the overload ladder is tripped; interactive-class
+    arrivals declare themselves through the preempt gate. With the
+    resumable chunked scan the background query parks its carried state
+    at the NEXT chunk boundary; fused, the earliest it can yield is the
+    end of the whole split. Reports the interactive-visible reaction
+    latency p50/p99 under both, the fused→chunked p99 improvement, and
+    the warm single-query overhead of the chunked scan vs the fused
+    kernel on the same split (the ≤5% budget the adaptive sizer holds)."""
+    import threading
+
+    import numpy as np
+
+    from quickwit_tpu.index import SplitReader
+    from quickwit_tpu.index.synthetic import HDFS_MAPPER, synthetic_hdfs_split
+    from quickwit_tpu.query.ast import Term
+    from quickwit_tpu.search import chunkexec, executor
+    from quickwit_tpu.search.chunkexec import CHUNKING, PREEMPT_GATE
+    from quickwit_tpu.search.plan import lower_request
+    from quickwit_tpu.storage import StorageResolver
+    from quickwit_tpu.tenancy.overload import OVERLOAD
+
+    docs = int(os.environ.get("BENCH_PREEMPT_DOCS", 524_288))
+    n_interactive = int(os.environ.get("BENCH_PREEMPT_QUERIES", 25))
+    k = 10
+    resolver = StorageResolver.for_test()
+    storage = resolver.resolve("ram:///bench-preempt")
+    storage.put("big.split", synthetic_hdfs_split(docs, seed=900))
+    storage.put("small.split", synthetic_hdfs_split(4096, seed=901))
+    big = SplitReader(storage, "big.split")
+    small = SplitReader(storage, "small.split")
+    # the background tenant's analytics scan: dense full-split sweep with
+    # a date histogram — the hundreds-of-ms query class preemption exists
+    # to get out of an interactive arrival's way
+    from quickwit_tpu.query.aggregations import DateHistogramAgg, MetricAgg
+    from quickwit_tpu.query.ast import MatchAll
+    aggs = [DateHistogramAgg(
+        name="per_hour", field="timestamp", interval_micros=3_600 * 10**6,
+        sub_metrics=(MetricAgg("tid_avg", "avg", "tenant_id"),))]
+    plan = lower_request(MatchAll(), HDFS_MAPPER, big, aggs,
+                         sort_field="timestamp", sort_order="desc")
+    arrays = list(plan.arrays)
+    small_plan = lower_request(Term("severity_text", "ERROR"), HDFS_MAPPER,
+                               small, [])
+    small_arrays = list(small_plan.arrays)
+    mode, total, align = chunkexec.chunk_mode(plan)
+    # pinned 8-slab span: the sizer must not collapse the scan mid-bench
+    span = max(align, (total // 8 // align) * align)
+    n_chunks = len(chunkexec.chunk_spans(total, span, align))
+    assert n_chunks >= 4, "bench split too small to chunk meaningfully"
+
+    # warm both paths (compiles) and assert the chunked scan is exact
+    fused = executor.execute_plan(plan, k, arrays)
+    chunked = chunkexec.execute_plan_chunked(plan, k, arrays, span=span)
+    assert chunked is not None
+    np.testing.assert_array_equal(np.asarray(fused["doc_ids"]),
+                                  np.asarray(chunked["doc_ids"]))
+    executor.execute_plan(small_plan, k, small_arrays)
+
+    def p50_secs(fn, n=7):
+        lat = []
+        for _ in range(n):
+            t0 = time.monotonic()
+            fn()
+            lat.append(time.monotonic() - t0)
+        return _percentile(lat, 0.5)
+
+    fused_scan_ms = p50_secs(
+        lambda: executor.execute_plan(plan, k, arrays)) * 1000
+    chunked_scan_ms = p50_secs(
+        lambda: chunkexec.execute_plan_chunked(plan, k, arrays,
+                                               span=span)) * 1000
+
+    from quickwit_tpu.tenancy.context import TenantContext, tenant_scope
+    bg_tenant = TenantContext.for_class("bench-bg", "background")
+
+    def reaction_run(enabled):
+        was_enabled = CHUNKING.enabled
+        CHUNKING.set(enabled=enabled)
+        OVERLOAD.configure(enabled=True, target_wait_secs=0.01)
+        for _ in range(20):
+            OVERLOAD.note_wait(1.0)  # trip the shed floor: ladder active
+        assert OVERLOAD.shed_floor() > 0
+        stop = threading.Event()
+        gate_ack = threading.Event()
+
+        def background():
+            with tenant_scope(bg_tenant):
+                while not stop.is_set():
+                    if PREEMPT_GATE.should_yield(0):
+                        # fused path's earliest yield point: between scans
+                        gate_ack.set()
+                        PREEMPT_GATE.wait_until_clear(0, 2.0)
+                        continue
+                    if enabled:
+                        # parks INSIDE at the next boundary when an
+                        # interactive query is running (PREEMPT_TOTAL)
+                        chunkexec.execute_plan_chunked(plan, k, arrays,
+                                                       span=span)
+                    else:
+                        executor.execute_plan(plan, k, arrays)
+
+        thread = threading.Thread(target=background, daemon=True)
+        thread.start()
+        reactions = []
+        try:
+            time.sleep(0.05)  # let the background scan get mid-flight
+            for _ in range(n_interactive):
+                before = chunkexec.PREEMPT_TOTAL.get()
+                gate_ack.clear()
+                t0 = time.monotonic()
+                with PREEMPT_GATE.running(2):
+                    while (not gate_ack.is_set()
+                           and chunkexec.PREEMPT_TOTAL.get() <= before
+                           and time.monotonic() - t0 < 10.0):
+                        time.sleep(0.0002)
+                    reactions.append(time.monotonic() - t0)
+                    # the interactive query itself, while holding the slot
+                    executor.execute_plan(small_plan, k, small_arrays)
+                time.sleep(0.01)  # background resumes and gets mid-scan
+        finally:
+            stop.set()
+            thread.join(timeout=10.0)
+            OVERLOAD.reset()
+            OVERLOAD.configure(enabled=False, target_wait_secs=0.5)
+            CHUNKING.set(enabled=was_enabled)
+        return {
+            "p50_ms": round(_percentile(reactions, 0.5) * 1000, 3),
+            "p99_ms": round(_percentile(reactions, 0.99) * 1000, 3),
+        }
+
+    preempts0 = chunkexec.PREEMPT_TOTAL.get()
+    chunked_reaction = reaction_run(enabled=True)
+    preempts = int(chunkexec.PREEMPT_TOTAL.get() - preempts0)
+    fused_reaction = reaction_run(enabled=False)
+    return {
+        "docs": docs, "n_chunks": n_chunks,
+        "interactive_queries": n_interactive,
+        "preempts": preempts,
+        "chunked_reaction": chunked_reaction,
+        "fused_reaction": fused_reaction,
+        # the headline: interactive arrivals see the accelerator within
+        # one chunk boundary instead of one whole split (higher = better)
+        "preempt_p99_improvement": round(
+            fused_reaction["p99_ms"]
+            / max(chunked_reaction["p99_ms"], 1e-3), 2),
+        "fused_scan_ms": round(fused_scan_ms, 2),
+        "chunked_scan_ms": round(chunked_scan_ms, 2),
+        "warm_overhead_pct": round(
+            (chunked_scan_ms / max(fused_scan_ms, 1e-9) - 1.0) * 100, 1),
+    }
+
+
 def _run_all(iters: int, with_device_loops: bool = True) -> dict:
     results: dict = {}
     workloads = _workloads()
@@ -1405,6 +1560,9 @@ def _run_all(iters: int, with_device_loops: bool = True) -> dict:
             max(3, iters // 3))
         print(f"# c11_dashboard_qps: "
               f"{json.dumps(results['c11_dashboard_qps'])}", file=sys.stderr)
+        results["c12_preemption"] = _measure_preemption()
+        print(f"# c12_preemption: "
+              f"{json.dumps(results['c12_preemption'])}", file=sys.stderr)
     return results
 
 

@@ -31,6 +31,11 @@ from .clock import get_clock
 # response) apart from query-level failures (-> hard error).
 DEADLINE_ERROR_MARK = "deadline exceeded"
 
+# Canonical marker for "explicitly cancelled" errors — the caller asked for
+# the query to stop, so the root answers with a typed cancelled/partial
+# response instead of a timeout or a hard error.
+CANCEL_ERROR_MARK = "query cancelled"
+
 
 class DeadlineExceeded(Exception):
     """A step was attempted (or abandoned) after the query budget ran out."""
@@ -41,8 +46,60 @@ class DeadlineExceeded(Exception):
         super().__init__(f"{DEADLINE_ERROR_MARK}{suffix}")
 
 
+class CancelledQuery(Exception):
+    """The query was explicitly cancelled (REST DELETE, scroll teardown).
+
+    Distinct from `DeadlineExceeded`: a cancel is a *success* of the control
+    plane, not a budget failure — the root maps it to a typed
+    `cancelled: true` partial response, never a retry."""
+
+    def __init__(self, operation: str = "", reason: str = ""):
+        self.operation = operation
+        self.reason = reason
+        suffix = f" during {operation}" if operation else ""
+        why = f": {reason}" if reason else ""
+        super().__init__(f"{CANCEL_ERROR_MARK}{suffix}{why}")
+
+
 def is_deadline_error(message: str) -> bool:
     return DEADLINE_ERROR_MARK in (message or "")
+
+
+def is_cancel_error(message: str) -> bool:
+    return CANCEL_ERROR_MARK in (message or "")
+
+
+class CancellationToken:
+    """One query's cooperative cancel flag.
+
+    Thread-safe and monotonic (once cancelled, forever cancelled). Deep
+    layers — the batcher's readback shed, the chunked leaf loop's boundary
+    checks — poll `cancelled` / call `check()`; the REST DELETE surface
+    flips it from another thread via the query registry. Polling sites are
+    read-only on the hot path: a single bool read, no lock."""
+
+    __slots__ = ("_cancelled", "_reason")
+
+    def __init__(self):
+        self._cancelled = False
+        self._reason = ""
+
+    def cancel(self, reason: str = "") -> None:
+        # bool store is atomic under the GIL; last reason wins (benign)
+        self._reason = reason
+        self._cancelled = True
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    @property
+    def reason(self) -> str:
+        return self._reason
+
+    def check(self, operation: str = "") -> None:
+        if self._cancelled:
+            raise CancelledQuery(operation, self._reason)
 
 
 class Deadline:
@@ -198,14 +255,43 @@ def deadline_scope(deadline: Optional[Deadline]):
         _CURRENT_DEADLINE.reset(token)
 
 
+_CURRENT_CANCEL: contextvars.ContextVar[Optional[CancellationToken]] = (
+    contextvars.ContextVar("quickwit_tpu_cancel", default=None))
+
+
+def current_cancel_token() -> Optional[CancellationToken]:
+    """The cancellation token bound to this thread of execution, if any."""
+    return _CURRENT_CANCEL.get()
+
+
+@contextmanager
+def cancel_scope(token: Optional[CancellationToken]):
+    ctx_token = _CURRENT_CANCEL.set(token)
+    try:
+        yield token
+    finally:
+        _CURRENT_CANCEL.reset(ctx_token)
+
+
+def check_cancelled(operation: str = "") -> None:
+    """Raise `CancelledQuery` when the ambient token has been cancelled;
+    no-op when no token is bound (non-cancellable execution)."""
+    token = _CURRENT_CANCEL.get()
+    if token is not None:
+        token.check(operation)
+
+
 def bind_deadline(fn: Callable, deadline: Optional[Deadline] = None) -> Callable:
     """Wrap `fn` so it runs under `deadline` (default: the caller's current
-    deadline). Needed for ThreadPoolExecutor hops — contextvars do not
-    propagate into pool worker threads automatically."""
+    deadline) AND the caller's cancellation token. Needed for
+    ThreadPoolExecutor hops — contextvars do not propagate into pool worker
+    threads automatically. The cancel token rides along because every hop
+    that must honor the deadline must honor an explicit cancel too."""
     captured = deadline if deadline is not None else current_deadline()
+    captured_cancel = current_cancel_token()
 
     def wrapper(*args, **kwargs):
-        with deadline_scope(captured):
+        with deadline_scope(captured), cancel_scope(captured_cancel):
             return fn(*args, **kwargs)
 
     return wrapper

@@ -32,6 +32,7 @@ import tempfile
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from ..common.deadline import CancellationToken
 from ..common.faults import FaultInjector, FaultyMetastore, FaultyStorageResolver
 from ..control_plane.scheduler import IndexingScheduler, IndexingTask
 from ..index import SplitReader
@@ -52,6 +53,7 @@ from ..offload.autoscaler import Autoscaler, WorkerLauncher
 from ..offload.pool import WorkerPool
 from ..query.ast import MatchAll
 from ..search import SearchRequest, SortField, leaf_search_single_split
+from ..search.cancel import CANCEL_REGISTRY
 from ..search.root import RootSearcher
 from ..search.service import LocalSearchClient, SearcherContext, SearchService
 from ..storage import StorageResolver
@@ -114,6 +116,7 @@ class SimCluster:
         self.break_wal = break_wal
         self._ns = next(_NS_COUNTER)
         self._drain_seq = itertools.count()
+        self._cancel_seq = itertools.count()
         self.resolver = StorageResolver.for_test()
         self.faulty_resolver = FaultyStorageResolver(self.resolver, injector)
         self.meta_storage = self.resolver.resolve(
@@ -521,6 +524,18 @@ class SimCluster:
                 "splits": counters.num_splits_published,
                 "checkpoint": self._checkpoint_total(node, uid)}
 
+    def _make_root(self, alive: list[str]) -> RootSearcher:
+        searcher = self.nodes[alive[0]]
+        clients = {
+            node_id: SimSearchClient(self.network, node_id,
+                                     self.nodes[node_id].client)
+            for node_id in alive
+        }
+        return RootSearcher(
+            FaultyMetastore(searcher.metastore, self.injector), clients,
+            nodes_provider=lambda: self.alive_nodes(),
+            default_timeout_secs=self.scenario.search_timeout_secs)
+
     def search(self, index_id: str, max_hits: int,
                sort: Optional[str] = None,
                repeat: int = 2) -> list[dict[str, Any]]:
@@ -530,16 +545,7 @@ class SimCluster:
         alive = self.alive_nodes()
         if not alive:
             return [{"error": "NoAliveNodes"}]
-        searcher = self.nodes[alive[0]]
-        clients = {
-            node_id: SimSearchClient(self.network, node_id,
-                                     self.nodes[node_id].client)
-            for node_id in alive
-        }
-        root = RootSearcher(
-            FaultyMetastore(searcher.metastore, self.injector), clients,
-            nodes_provider=lambda: self.alive_nodes(),
-            default_timeout_secs=self.scenario.search_timeout_secs)
+        root = self._make_root(alive)
         # a fast-field sort arms threshold pruning: the leaf's shared
         # ThresholdBox is then written by the local execute loop and read
         # by the offload dispatch thread — the interleaving the qwrace
@@ -562,6 +568,41 @@ class SimCluster:
                 "complete": bool(complete),
             })
         return outs
+
+    def cancel_search(self, index_id: str, max_hits: int) -> dict[str, Any]:
+        """Execute a search whose handle was cancelled BEFORE the query
+        started — the REST DELETE racing ahead of the query it targets.
+        The root adopts the pre-cancelled token from the registry, so the
+        cancel deterministically lands before any split executes: the
+        response is typed-cancelled (when splits existed to cut short),
+        carries zero hits, and the registry entry is gone afterwards —
+        exactly what the cancel_responsiveness invariant audits."""
+        alive = self.alive_nodes()
+        if not alive:
+            return {"error": "NoAliveNodes"}
+        # same staleness as the root's own view: whether the query HAD
+        # splits to cancel is judged through the node's polling metastore
+        uid = self._uid(index_id)
+        had_splits = bool(self.nodes[alive[0]].metastore.list_splits(
+            ListSplitsQuery(index_uids=[uid],
+                            states=[SplitState.PUBLISHED])))
+        root = self._make_root(alive)
+        qid = f"dst-cancel-{next(self._cancel_seq)}"
+        token = CancellationToken()
+        CANCEL_REGISTRY.register(qid, token)
+        accepted = CANCEL_REGISTRY.cancel(qid, reason="dst cancel op")
+        request = SearchRequest(index_ids=[index_id], query_ast=MatchAll(),
+                                max_hits=max_hits, query_id=qid)
+        try:
+            resp = root.search(request)
+        except Exception as exc:  # noqa: BLE001 - typed outcome per op
+            return {"error": type(exc).__name__,
+                    "registry_drained": CANCEL_REGISTRY.get(qid) is None}
+        return {"accepted": accepted,
+                "cancelled": bool(resp.cancelled),
+                "num_hits": int(resp.num_hits),
+                "had_splits": had_splits,
+                "registry_drained": CANCEL_REGISTRY.get(qid) is None}
 
     def merge(self, node_id: str, index_id: str) -> dict[str, Any]:
         node = self.nodes[node_id]

@@ -184,6 +184,8 @@ def _execute(cluster: SimCluster, op: dict[str, Any]) -> Any:
         return cluster.autoscale(op["queue_depth"])
     if kind == "plan":
         return cluster.plan()
+    if kind == "cancel":
+        return cluster.cancel_search(op["index"], op["max_hits"])
     raise ValueError(f"unknown op kind: {kind!r}")
 
 

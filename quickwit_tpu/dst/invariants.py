@@ -49,6 +49,9 @@ INVARIANTS: dict[str, str] = {
     "plan_completeness":
         "the physical indexing plan assigns every task exactly once, "
         "only to alive nodes",
+    "cancel_responsiveness":
+        "a query cancelled before it started returns a typed cancelled "
+        "response with zero hits and leaves no registry entry behind",
 }
 
 # slack for deadline comparisons: serialization rounds to whole millis
@@ -100,6 +103,8 @@ class InvariantChecker:
             self._check_autoscale(result, step)
         elif kind == "plan":
             self._check_plan(result, step)
+        elif kind == "cancel":
+            self._check_cancel(result, step)
 
     def _check_search(self, op: dict[str, Any], outs: list[dict[str, Any]],
                       step: int, cluster) -> None:
@@ -151,6 +156,28 @@ class InvariantChecker:
         if not result["min"] <= size <= result["max"]:
             self._fail("autoscaler_bounds", step, pool_size=size,
                        min=result["min"], max=result["max"])
+
+    def _check_cancel(self, result: dict[str, Any], step: int) -> None:
+        """A pre-cancelled query handle must never produce hits (the
+        per-split cancel check runs before any device work), and the
+        registry entry must be gone once the search returns — a leaked
+        token would pin the next query under the same handle. With no
+        published splits yet the response is trivially complete (zero
+        splits to cancel), so `cancelled` is only required once the
+        query had work to cut short."""
+        if not self._on("cancel_responsiveness"):
+            return
+        if "error" in result:
+            return  # typed failure (e.g. no alive nodes): nothing to audit
+        problems = {}
+        if result.get("num_hits"):
+            problems["num_hits"] = result["num_hits"]
+        if result.get("had_splits") and not result.get("cancelled"):
+            problems["uncancelled_with_splits"] = True
+        if not result.get("registry_drained"):
+            problems["registry_leak"] = True
+        if problems:
+            self._fail("cancel_responsiveness", step, **problems)
 
     def _check_plan(self, result: dict[str, Any], step: int) -> None:
         if not self._on("plan_completeness"):

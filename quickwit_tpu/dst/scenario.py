@@ -32,6 +32,11 @@ DEFAULT_WEIGHTS: dict[str, int] = {
     "restart": 2,
     "autoscale": 1,
     "plan": 1,
+    # a search whose handle was cancelled before the query started (the
+    # REST DELETE racing ahead of the query): weight 0 by default so
+    # pre-existing scenarios' op streams (and replay artifacts) stay
+    # byte-identical — materialize() only draws kinds with weight > 0
+    "cancel": 0,
 }
 
 ALL_INVARIANTS = (
@@ -43,6 +48,7 @@ ALL_INVARIANTS = (
     "deadline_monotonicity",
     "autoscaler_bounds",
     "plan_completeness",
+    "cancel_responsiveness",
 )
 
 
@@ -139,6 +145,13 @@ class Scenario:
                             "queue_depth": rng.randint(0, 64)})
             elif kind == "plan":
                 ops.append({"kind": "plan"})
+            elif kind == "cancel":
+                # same shape knobs as a search; the executor cancels the
+                # query handle before the query starts, so the run is
+                # deterministic: the cancel always lands first
+                ops.append({"kind": "cancel",
+                            "index": rng.choice(self.indexes),
+                            "max_hits": rng.choice((10, 100, 1000))})
         return ops
 
     # --- (de)serialization -------------------------------------------------
@@ -214,13 +227,19 @@ SCENARIOS: dict[str, Scenario] = {
     # single node: the whole published split set lands in ONE leaf request,
     # so the offload cut (max_local_splits=1) reliably fans the cold tail
     # out over the in-process worker fleet
+    # the cancel weight mixes pre-cancelled query handles into the same
+    # stream: the typed-cancelled path (registry adopt, per-split cancel
+    # checks, batcher bail-out) runs against the offload dispatcher and
+    # cache tiers, and cancel_responsiveness audits every one of them
     "fanout": Scenario(
         name="fanout", nodes=1, steps=30,
         indexes=("tenant-a", "tenant-b"),
         offload=True, replication=False, sorted_searches=True,
         weights={"ingest": 8, "drain": 6, "search": 8, "merge": 1,
-                 "kill": 0, "restart": 0, "autoscale": 2, "plan": 0},
+                 "kill": 0, "restart": 0, "autoscale": 2, "plan": 0,
+                 "cancel": 2},
         invariants=("exactly_once_publish", "tenant_isolation",
-                    "cache_cold_equivalence", "autoscaler_bounds"),
+                    "cache_cold_equivalence", "autoscaler_bounds",
+                    "cancel_responsiveness"),
     ),
 }

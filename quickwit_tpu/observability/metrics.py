@@ -411,3 +411,42 @@ SEARCH_KERNEL_LAUNCHES_TOTAL = METRICS.counter(
 FAULTS_INJECTED_TOTAL = METRICS.counter(
     "qw_faults_injected_total",
     "Faults fired by the deterministic chaos FaultInjector")
+
+# --- resumable chunked leaf kernels (search/chunkexec.py) -------------------
+# One increment per compiled chunk program dispatched by the chunked scan;
+# comparing against qw_search_kernel_launches_total shows how much of the
+# kernel traffic runs under boundary control.
+CHUNK_DISPATCHES_TOTAL = METRICS.counter(
+    "qw_chunk_dispatches_total",
+    "Chunk programs dispatched by the resumable chunked leaf scan")
+# A chunked query that lost its carried state (parked-state eviction under
+# byte pressure, or a kernel.chunk_yield fault) and re-executed from chunk 0.
+CHUNK_RESTARTS_TOTAL = METRICS.counter(
+    "qw_chunk_restarts_total",
+    "Chunked queries that discarded carried state and re-executed from scratch")
+# Cross-chunk block-max pruning: remaining chunks provably could not beat
+# the current Kth value, so the scan stopped early.
+CHUNK_EARLY_TERMINATIONS_TOTAL = METRICS.counter(
+    "qw_chunk_early_terminations_total",
+    "Chunked scans stopped early by the cross-chunk block-max bound")
+# Host wall time between consecutive chunk boundaries (dispatch + readback
+# + boundary checks): the preemption/cancellation latency bound. The chunk
+# sizer steers this toward its target interval (~10ms class).
+CHUNK_BOUNDARY_SECONDS = METRICS.histogram(
+    "qw_chunk_boundary_seconds",
+    "Wall time between consecutive chunk boundaries of the chunked scan")
+# A lower-class query yielded at a chunk boundary because the overload
+# ladder tripped while a higher-class query was running.
+PREEMPT_TOTAL = METRICS.counter(
+    "qw_preempt_total",
+    "Chunked queries preempted at a boundary in favor of a higher class")
+# Bytes of carried top-K/agg state currently parked by preempted queries
+# (bounded by the per-tenant DRR quantum; evictions force restarts).
+PREEMPT_PARKED_BYTES = METRICS.gauge(
+    "qw_preempt_parked_bytes",
+    "Carried chunk state bytes currently parked by preempted queries")
+# REST DELETE /api/v1/search/<query_id> cancellations that found (and
+# flipped) a live query's CancellationToken.
+SEARCH_CANCEL_TOTAL = METRICS.counter(
+    "qw_search_cancel_total",
+    "Explicit query cancellations accepted via the REST cancel surface")

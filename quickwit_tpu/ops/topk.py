@@ -15,6 +15,7 @@ matching docs missing a sort value carry MISSING_VALUE_SENTINEL.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 # Python float literal, NOT a pre-created jnp array: a concrete jax array
@@ -243,6 +244,54 @@ def block_max_threshold_mask(keyed: jnp.ndarray, block_bounds: jnp.ndarray,
     blocks = keyed.reshape(nb, keyed.shape[0] // nb)
     live = (block_bounds >= threshold)[:, None]
     return jnp.where(live, blocks, NEG_INF).reshape(-1)
+
+
+def merge_topk_chunks(chunks, k: int):
+    """Host-side merge of per-chunk top-k results (search/chunkexec.py).
+
+    `chunks` is a list of `(vals, vals2, doc_ids, scores)` tuples — each a
+    chunk program's readback, vals descending with the kernel's
+    lowest-lane-wins tie-break already applied inside the chunk, `vals2`
+    None for single-key sorts, doc ids already rebased to GLOBAL doc space.
+    Returns the same 4-tuple truncated/padded to `k`.
+
+    Bit-exactness argument vs the fused kernel: any global top-k lane is a
+    top-k lane of its own chunk (same dominance argument as `exact_topk`'s
+    blockwise two-stage), so the concatenated per-chunk winners contain the
+    global winners. Chunks partition the lane space in ascending lane
+    order (posting chunks slice the posting array contiguously; dense
+    chunks slice the doc space contiguously), so a STABLE sort of the
+    concatenation ordered (chunk, in-chunk rank) reproduces the fused
+    kernel's lowest-lane-index tie order exactly. -inf pad lanes sort last
+    and are re-padded, never surfacing a fake hit.
+    """
+    def _cat(column, dtype):
+        # qwlint: disable-next-line=QW001 - chunk readbacks are host numpy
+        # by contract: each chunk program was read back at its own boundary
+        # (the readback IS the boundary), so nothing lives on device here
+        return np.concatenate([np.asarray(x, dtype=dtype) for x in column])
+
+    vals = _cat([c[0] for c in chunks], np.float64)
+    has2 = chunks[0][1] is not None
+    vals2 = _cat([c[1] for c in chunks], np.float64) if has2 else None
+    doc_ids = _cat([c[2] for c in chunks], np.int32)
+    scores = _cat([c[3] for c in chunks], np.float32)
+    # np.lexsort: stable, last key primary; negate for descending. -inf
+    # lanes negate to +inf and sink to the tail by the same comparison the
+    # device sort uses.
+    keys = (-vals,) if vals2 is None else (-vals2, -vals)
+    order = np.lexsort(keys)[:k]
+    out_vals = np.full(k, NEG_INF, dtype=np.float64)
+    out_vals2 = np.full(k, NEG_INF, dtype=np.float64) if has2 else None
+    out_ids = np.zeros(k, dtype=np.int32)
+    out_scores = np.zeros(k, dtype=np.float32)
+    take = len(order)
+    out_vals[:take] = vals[order]
+    if has2:
+        out_vals2[:take] = vals2[order]
+    out_ids[:take] = doc_ids[order]
+    out_scores[:take] = scores[order]
+    return out_vals, out_vals2, out_ids, out_scores
 
 
 def exact_topk_2key(key1: jnp.ndarray, key2: jnp.ndarray, k: int):

@@ -205,6 +205,12 @@ def _build_batch(request: SearchRequest, doc_mapper: DocMapper,
                  sort_value_threshold: Optional[float]) -> SplitBatch:
     agg_specs = parse_aggs(request.aggs) if request.aggs else []
     overrides = _global_agg_overrides(agg_specs, readers, doc_mapper)
+    # a term absent from one split lowers to the uniform empty stand-in,
+    # whose impact_ordered flag is part of the plan sig since format v3:
+    # the stand-in must agree with the splits that DO hold the field, so
+    # the lowering needs cross-reader visibility (an empty posting list is
+    # vacuously sound under either storage-order claim)
+    overrides["batch_readers"] = readers
     sort = request.sort_fields[0] if request.sort_fields else None
     sort_field = sort.field if sort else "_score"
     sort_order = sort.order if sort else "desc"
@@ -553,6 +559,10 @@ def dispatch_batch(batch: SplitBatch, request: SearchRequest,
     completes it — the seam lets the service shed deadline-expired queries
     before ever paying the readback wait, and overlap the next group's
     dispatch with this one's readback."""
+    # cancelled queries stop HERE, before staging device inputs or paying
+    # an enqueue nobody will read (the readback seam checks again)
+    from ..common.deadline import check_cancelled
+    check_cancelled("batch dispatch")
     # k=0 (count/agg-only): per-split executors skip keying/top-k and the
     # batch merge skips the cross-split top_k
     k = min(request.start_offset + request.max_hits, batch.num_docs_padded)
@@ -606,6 +616,10 @@ def readback_batch(dispatched) -> LeafSearchResponse:
     guided-top-k certificate triggers one exact re-execution of the whole
     batch (see ops/topk.py:guided_topk)."""
     out, treedef, spec, (batch, request, mesh, k) = dispatched
+    # the dispatch already flew; a cancel landing in between still saves
+    # the device->host transfer wait
+    from ..common.deadline import check_cancelled
+    check_cancelled("batch readback")
     profile = current_profile()
     if profile is None:
         packed = jax.device_get(out)

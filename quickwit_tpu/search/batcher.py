@@ -32,7 +32,10 @@ import time
 from typing import Any, Optional
 
 from ..common import sync
-from ..common.deadline import Deadline, DeadlineExceeded, current_deadline
+from ..common.deadline import (
+    CancellationToken, CancelledQuery, Deadline, DeadlineExceeded,
+    current_cancel_token, current_deadline,
+)
 from ..observability.metrics import (
     SEARCH_BATCHER_DISPATCHES_TOTAL, SEARCH_BATCHER_QUERIES_TOTAL,
     SEARCH_BATCHER_QUEUE_WAIT, SEARCH_BATCHER_RATIO, SEARCH_SHED_TOTAL,
@@ -47,6 +50,11 @@ from . import executor
 # event at this very moment — shedding exactly at expiry would discard a
 # result that is already computed.
 _FOLLOWER_SLACK_SECS = 0.05
+
+# A rider with a CancellationToken polls its event in slices of this size so
+# a mid-wait cancel is observed promptly instead of after the full batch
+# round-trip (the shed-before-readback gap).
+_CANCEL_POLL_SECS = 0.05
 
 
 class _PriorityLock:
@@ -82,10 +90,10 @@ class _PriorityLock:
 
 class _Pending:
     __slots__ = ("scalars", "event", "result", "error", "deadline",
-                 "enqueued_at", "profile")
+                 "enqueued_at", "profile", "cancel")
 
     def __init__(self, scalars, deadline: Optional[Deadline] = None,
-                 profile=None):
+                 profile=None, cancel: Optional[CancellationToken] = None):
         self.scalars = scalars
         self.event = sync.event()
         self.result: Any = None
@@ -95,6 +103,10 @@ class _Pending:
         # each rider's ambient QueryProfile (or None): the leader reports
         # every rider's queue wait into ITS profile at dispatch time
         self.profile = profile
+        # the rider's ambient CancellationToken (or None): consulted by
+        # both the rider's own wait and the leader's shed points, so a
+        # cancelled rider neither blocks on nor is served by the batch
+        self.cancel = cancel
 
 
 class QueryBatcher:
@@ -116,6 +128,52 @@ class QueryBatcher:
         # chaos hook: perturbs "batcher.dispatch" before each real dispatch
         self.fault_injector = fault_injector
 
+    @staticmethod
+    def _abort_wait(me: _Pending, reason: str) -> None:
+        if me.profile is not None:
+            me.profile.record_phase(
+                PHASE_BATCHER_QUEUE,
+                time.monotonic() - me.enqueued_at,
+                start=me.enqueued_at, aborted=True)
+            me.profile.mark_partial(reason)
+
+    def _follower_wait(self, me: _Pending) -> None:
+        """Block until the leader serves `me`, bounded by the rider's own
+        deadline AND its cancel token. A rider without a token waits in one
+        shot (the seed path); with one, the wait polls in short slices so a
+        mid-flight cancel costs at most one slice — previously a rider
+        cancelled between dispatch and readback still paid the full wait."""
+        bounded = me.deadline is not None and me.deadline.bounded
+        if me.cancel is None:
+            if not bounded:
+                me.event.wait()
+                return
+            if me.event.wait(me.deadline.remaining() + _FOLLOWER_SLACK_SECS):
+                return
+            # the leader (stuck in a slow dispatch) outlived our budget;
+            # abandon the ride — our scalars may still be computed, the
+            # result is simply unclaimed
+            SEARCH_SHED_TOTAL.inc(stage="batcher_wait")
+            self._abort_wait(me, "shed: batcher wait")
+            raise DeadlineExceeded("batched dispatch wait")
+        while True:
+            if me.cancel.cancelled:
+                SEARCH_SHED_TOTAL.inc(stage="batcher_cancel")
+                self._abort_wait(me, "cancelled: batcher wait")
+                raise CancelledQuery("batched dispatch wait",
+                                     me.cancel.reason)
+            if bounded:
+                remaining = me.deadline.remaining() + _FOLLOWER_SLACK_SECS
+                if remaining <= 0:
+                    SEARCH_SHED_TOTAL.inc(stage="batcher_wait")
+                    self._abort_wait(me, "shed: batcher wait")
+                    raise DeadlineExceeded("batched dispatch wait")
+                slice_secs = min(_CANCEL_POLL_SECS, remaining)
+            else:
+                slice_secs = _CANCEL_POLL_SECS
+            if me.event.wait(slice_secs):
+                return
+
     def execute(self, plan, k: int, device_arrays, split_key) -> dict[str, Any]:
         """Run one query, possibly riding a shared dispatch. `split_key`
         must uniquely identify the split (reader identity); the key also
@@ -131,7 +189,12 @@ class QueryBatcher:
             SEARCH_SHED_TOTAL.inc(stage="overload_batcher")
             GLOBAL_TENANCY.note_shed(tenant.tenant_id, stage="batcher")
             raise OverloadShed("batcher", OVERLOAD.retry_after_secs())
-        me = _Pending(plan.scalars, current_deadline(), current_profile())
+        cancel = current_cancel_token()
+        if cancel is not None:
+            # already-cancelled queries never take a batch slot
+            cancel.check("batcher enqueue")
+        me = _Pending(plan.scalars, current_deadline(), current_profile(),
+                      cancel)
         my_queue = None
         with self._lock:
             sync.note_write(self, "queues")
@@ -151,21 +214,7 @@ class QueryBatcher:
                 entry[1] += 1
                 dispatch_lock = entry[0]
         if my_queue is None:
-            if me.deadline is None or not me.deadline.bounded:
-                me.event.wait()
-            elif not me.event.wait(
-                    me.deadline.remaining() + _FOLLOWER_SLACK_SECS):
-                # the leader (stuck in a slow dispatch) outlived our budget;
-                # abandon the ride — our scalars may still be computed, the
-                # result is simply unclaimed
-                SEARCH_SHED_TOTAL.inc(stage="batcher_wait")
-                if me.profile is not None:
-                    me.profile.record_phase(
-                        PHASE_BATCHER_QUEUE,
-                        time.monotonic() - me.enqueued_at,
-                        start=me.enqueued_at, aborted=True)
-                    me.profile.mark_partial("shed: batcher wait")
-                raise DeadlineExceeded("batched dispatch wait")
+            self._follower_wait(me)
             if me.error is not None:
                 raise _waiter_error(me.error)
             return me.result
@@ -182,11 +231,16 @@ class QueryBatcher:
                     if self._queues.get(key) is my_queue:
                         del self._queues[key]
                     batch = my_queue
-                # riders whose budget ran out while queued are shed NOW:
-                # dispatching for them wastes device time nobody can use
+                # riders whose budget ran out — or who were cancelled —
+                # while queued are shed NOW: dispatching for them wastes
+                # device time nobody can use
                 expired = [p for p in batch
                            if p.deadline is not None and p.deadline.expired]
-                alive = [p for p in batch if p not in expired]
+                cancelled = [p for p in batch
+                             if p not in expired and p.cancel is not None
+                             and p.cancel.cancelled]
+                alive = [p for p in batch
+                         if p not in expired and p not in cancelled]
                 now = time.monotonic()
                 for pending in expired:
                     SEARCH_SHED_TOTAL.inc(stage="batcher_dispatch")
@@ -196,6 +250,17 @@ class QueryBatcher:
                             start=pending.enqueued_at, aborted=True)
                         pending.profile.mark_partial("shed: batcher dispatch")
                     pending.error = DeadlineExceeded("batched dispatch")
+                    pending.event.set()
+                for pending in cancelled:
+                    SEARCH_SHED_TOTAL.inc(stage="batcher_cancel")
+                    if pending.profile is not None:
+                        pending.profile.record_phase(
+                            PHASE_BATCHER_QUEUE, now - pending.enqueued_at,
+                            start=pending.enqueued_at, aborted=True)
+                        pending.profile.mark_partial(
+                            "cancelled: batcher dispatch")
+                    pending.error = CancelledQuery("batched dispatch",
+                                                   pending.cancel.reason)
                     pending.event.set()
                 readback_fn = None
                 try:
@@ -247,22 +312,39 @@ class QueryBatcher:
             if readback_fn is not None:
                 try:
                     still_wanted = [p for p in alive
-                                    if p.deadline is None
-                                    or not p.deadline.expired]
+                                    if (p.deadline is None
+                                        or not p.deadline.expired)
+                                    and (p.cancel is None
+                                         or not p.cancel.cancelled)]
                     if not still_wanted:
-                        # every rider's budget ran out while the kernel
-                        # flew: nobody can use the answer, so the
-                        # device->host transfer is never awaited
+                        # every rider's budget ran out (or was cancelled)
+                        # while the kernel flew: nobody can use the answer,
+                        # so the device->host transfer is never awaited
                         from .residency import RESIDENT_READBACKS_SHED
                         RESIDENT_READBACKS_SHED.inc()
                         for pending in alive:
-                            pending.error = DeadlineExceeded(
-                                "batched readback shed")
+                            if (pending.cancel is not None
+                                    and pending.cancel.cancelled):
+                                pending.error = CancelledQuery(
+                                    "batched readback",
+                                    pending.cancel.reason)
+                            else:
+                                pending.error = DeadlineExceeded(
+                                    "batched readback shed")
                             pending.event.set()
                     else:
                         results = readback_fn()
                         for pending, result in zip(alive, results):
-                            pending.result = result
+                            if (pending.cancel is not None
+                                    and pending.cancel.cancelled):
+                                # cancelled after dispatch: the batch still
+                                # flew for the live riders, but this one's
+                                # answer is abandoned by contract
+                                pending.error = CancelledQuery(
+                                    "batched readback",
+                                    pending.cancel.reason)
+                            else:
+                                pending.result = result
                             pending.event.set()
                 # qwlint: disable-next-line=QW004 - fanned to waiters and
                 # re-raised per-waiter, same contract as the dispatch side

@@ -284,6 +284,16 @@ def _keyed_for(by, descending, values_slot, present_slot, view, mask,
     return jnp.where(mask, key if descending else -key, -jnp.inf)
 
 
+def _global_doc_ids(plan, scalars, padded):
+    """Per-lane GLOBAL doc ids: the plain iota for whole-split plans; the
+    chunk's traced doc offset shifts it for chunked dense sub-plans
+    (search/chunkexec.py) so doc-keyed comparisons match the fused path."""
+    docs = jnp.arange(padded, dtype=jnp.int32)
+    if plan.doc_base_slot >= 0:
+        docs = docs + scalars[plan.doc_base_slot].astype(jnp.int32)
+    return docs
+
+
 def _apply_search_after(plan, keyed, keyed2, scalars, padded):
     """Restrict top-k eligibility per the search_after marker (counts/aggs
     keep full-query semantics). With a secondary key the comparison is
@@ -297,7 +307,7 @@ def _apply_search_after(plan, keyed, keyed2, scalars, padded):
             eligible = keyed <= marker
         else:  # "lt_tie"
             marker_doc = scalars[plan.sa_doc_slot]
-            docs = jnp.arange(padded, dtype=jnp.int32)
+            docs = _global_doc_ids(plan, scalars, padded)
             eligible = (keyed < marker) | ((keyed == marker) &
                                            (docs > marker_doc))
         return jnp.where(eligible, keyed, -jnp.inf), None
@@ -310,7 +320,7 @@ def _apply_search_after(plan, keyed, keyed2, scalars, padded):
         eligible = lt | tie
     else:  # "lt_tie"
         marker_doc = scalars[plan.sa_doc_slot]
-        docs = jnp.arange(padded, dtype=jnp.int32)
+        docs = _global_doc_ids(plan, scalars, padded)
         eligible = lt | (tie & (docs > marker_doc))
     return (jnp.where(eligible, keyed, -jnp.inf),
             jnp.where(eligible, keyed2, -jnp.inf))
@@ -776,7 +786,7 @@ def _build(plan: LoweredPlan, k: int, exact: bool = False) -> Callable:
             return (jnp.zeros((0,), jnp.float64), None,
                     jnp.zeros((0,), jnp.int32), jnp.zeros((0,), jnp.float32),
                     count, jnp.float64(1.0), tuple(agg_out))
-        doc_key = jnp.arange(padded, dtype=jnp.int32)
+        doc_key = _global_doc_ids(plan, scalars, padded)
         keyed = _keyed_for(sort.by, sort.descending, sort.values_slot,
                            sort.present_slot, view, mask, scores, doc_key)
         keyed2 = None

@@ -263,11 +263,18 @@ def execute_prepared_split(
     plan: Any,
     device_arrays: list,
     batcher=None,
+    threshold_box=None,
+    fault_injector=None,
 ) -> LeafSearchResponse:
     """Stage 2: jitted kernel execution + the single batched readback.
     With a `QueryBatcher`, concurrent same-structure queries on this split
-    share one vmapped dispatch (see search/batcher.py)."""
+    share one vmapped dispatch (see search/batcher.py). Work that profiles
+    past the chunk-sizer target runs as a resumable chunked scan instead
+    (search/chunkexec.py): cancellable/preemptable at every chunk boundary,
+    with cross-chunk early termination fed by `threshold_box`."""
     from ..common.deadline import current_deadline
+    from ..tenancy.context import effective_tenant
+    from .chunkexec import PREEMPT_GATE, maybe_execute_chunked
     ambient = current_deadline()
     if ambient is not None:
         # shed before launching a kernel whose result nobody can use; the
@@ -284,11 +291,23 @@ def execute_prepared_split(
         from ..observability.metrics import SEARCH_KERNEL_THRESHOLD_TOTAL
         SEARCH_KERNEL_THRESHOLD_TOTAL.inc()
         profile_add("kernel_threshold_pushdowns")
-    if batcher is not None:
-        result = batcher.execute(plan, k, device_arrays,
-                                 split_key=id(reader))
-    else:
-        result = execute_plan(plan, k, device_arrays)
+    # fused splits register with the preempt gate too: their presence is
+    # what tells a running chunked scan that interactive work is waiting
+    with PREEMPT_GATE.running(effective_tenant().priority):
+        result = maybe_execute_chunked(plan, k, device_arrays,
+                                       threshold_box=threshold_box,
+                                       fault_injector=fault_injector)
+        if result is None:
+            if batcher is not None:
+                result = batcher.execute(plan, k, device_arrays,
+                                         split_key=id(reader))
+            else:
+                result = execute_plan(plan, k, device_arrays)
+    # cancelled mid-scan with partial_on_cancel: keep the chunks already
+    # merged, flag the split so the root's response carries cancelled=true
+    # qwlint: disable-next-line=QW001 - "partial" is a host bool stamped by
+    # the chunked scan's boundary loop, never a device value
+    partial_cancel = bool(result.get("partial"))
 
     count = result["count"]
     if getattr(plan, "count_override", None) is not None:
@@ -362,8 +381,17 @@ def execute_prepared_split(
     return LeafSearchResponse(
         num_hits=count,
         partial_hits=partial_hits,
+        # a partial-on-cancel split still counts as successful (its hits are
+        # real and mergeable); the cancel marker below is what flips the
+        # root response to cancelled=true without tripping the
+        # every-split-failed guard
         num_attempted_splits=1,
         num_successful_splits=1,
+        failed_splits=([SplitSearchError(
+            split_id=split_id,
+            error="query cancelled: progressive partial results up to the "
+                  "last completed chunk boundary",
+            retryable=False)] if partial_cancel else []),
         intermediate_aggs=intermediate_aggs,
         resource_stats={"cpu_micros": elapsed},
     )

@@ -81,6 +81,11 @@ class SearchRequest:
     # timeout_millis, NOT part of the leaf-cache key — profiling must not
     # fragment the cache.
     profile: bool = False
+    # Caller-chosen handle for mid-flight cancellation via
+    # `DELETE /api/v1/search/<query_id>` (reference role: ES task cancel).
+    # Like timeout_millis, NOT part of the leaf-cache key: identity of the
+    # in-flight attempt, not of the results.
+    query_id: Optional[str] = None
 
     def __post_init__(self) -> None:
         self.sort_fields = normalize_sort_fields(tuple(self.sort_fields))
@@ -111,6 +116,8 @@ class SearchRequest:
             **({"timeout_millis": self.timeout_millis}
                if self.timeout_millis is not None else {}),
             **({"profile": True} if self.profile else {}),
+            **({"query_id": self.query_id}
+               if self.query_id is not None else {}),
         }
 
     @staticmethod
@@ -129,6 +136,7 @@ class SearchRequest:
             snippet_fields=tuple(d.get("snippet_fields", ())),
             timeout_millis=d.get("timeout_millis"),
             profile=d.get("profile", False),
+            query_id=d.get("query_id"),
         )
 
 
@@ -194,6 +202,10 @@ class SearchResponse:
     # partial result. `failed_splits` carries the structured per-split errors
     # (the flat `errors` strings above stay for backward compat).
     timed_out: bool = False
+    # Cancellation outcome: True when the query was cancelled mid-flight
+    # (REST DELETE or programmatic token) and this is whatever the chunked
+    # leaves had accumulated at their last chunk boundary — possibly empty.
+    cancelled: bool = False
     failed_splits: list[SplitSearchError] = field(default_factory=list)
     num_attempted_splits: int = 0
     num_successful_splits: int = 0
@@ -219,6 +231,7 @@ class SearchResponse:
             # additive keys: only emitted when set, so pre-deadline response
             # shapes stay byte-identical
             **({"timed_out": True} if self.timed_out else {}),
+            **({"cancelled": True} if self.cancelled else {}),
             **({"failed_splits": [
                 {"split_id": e.split_id, "error": e.error,
                  "retryable": e.retryable} for e in self.failed_splits]}

@@ -365,6 +365,14 @@ class LoweredPlan:
     # (the term's df) is known host-side and overrides it at the leaf.
     # Host-only; deliberately NOT in the signature.
     count_override: Optional[int] = None
+    # chunked execution (search/chunkexec.py): dense chunk sub-plans carry
+    # the chunk's global doc offset as a traced int32 scalar so doc-id sort
+    # keys and search_after doc comparisons stay in GLOBAL doc space while
+    # the arrays are chunk-local. -1 (every plan the normal lowering
+    # produces) keeps today's programs byte-identical; presence is static
+    # (part of the signature), the offset value is traced so every chunk of
+    # a split shares one compiled executable.
+    doc_base_slot: int = -1
 
     def signature(self, k: int) -> tuple:
         shapes = tuple((a.shape, str(a.dtype)) for a in self.arrays)
@@ -374,7 +382,8 @@ class LoweredPlan:
             (slot, slots) for slot, slots in self.rebase.items()))
         return (self.root.sig(), self.sort.sig(), agg_sig, shapes, scalar_dtypes,
                 k, self.num_docs_padded, self.search_after_relation,
-                self.sa_value2_slot >= 0, self.threshold_slot >= 0, rebase_sig)
+                self.sa_value2_slot >= 0, self.threshold_slot >= 0, rebase_sig,
+                self.doc_base_slot >= 0)
 
     def structure_digest(self, k: int) -> str:
         """Stable hex digest of the compile-cache structure key.
@@ -596,6 +605,13 @@ class Lowering:
     def _empty_postings_node(self, field: str, term: str, scoring: bool) -> Any:
         """Uniform-structure stand-in for a term absent from this split."""
         from ..index.format import POSTING_PAD
+        # impact_ordered is in the plan sig: the stand-in has no postings
+        # (either storage-order claim is vacuously true), so mirror the
+        # batch peers that do hold the field — otherwise a v3 batch with
+        # the field absent from ONE split fails the uniformity check
+        impact = any(
+            r.impact_info(field) is not None
+            for r in self.batch.get("batch_readers", ()))
         sentinel = self.reader.num_docs_padded
         ids_slot = self.b.add_array(
             f"post.{field}.absent:{term}.ids",
@@ -604,12 +620,14 @@ class Lowering:
             f"post.{field}.absent:{term}.tfs",
             lambda: np.zeros(POSTING_PAD, dtype=np.int32))
         if not scoring:
-            return PPostings(ids_slot, tfs_slot, scoring=False)
+            return PPostings(ids_slot, tfs_slot, scoring=False,
+                             impact_ordered=impact)
         meta = self.reader.field_meta(field)
         norm_slot = self._fieldnorm_slot(field)
         idf_slot = self.b.add_scalar(0.0, np.float32)
         avg_slot = self.b.add_scalar(meta.get("avg_len", 1.0), np.float32)
-        return PPostings(ids_slot, tfs_slot, True, norm_slot, idf_slot, avg_slot)
+        return PPostings(ids_slot, tfs_slot, True, norm_slot, idf_slot,
+                         avg_slot, impact_ordered=impact)
 
     def _precomputed_node(self, key: str, ids: np.ndarray, freqs: np.ndarray,
                           field: str, scoring: bool, boost: float,
@@ -1913,3 +1931,139 @@ def predicate_only_slots(plan: LoweredPlan) -> set[int]:
     for agg in plan.aggs:
         _agg_slots(agg, other_slots)
     return root_slots - other_slots
+
+
+# --------------------------------------------------------------------------
+# chunked-execution slot classification (search/chunkexec.py)
+
+@dataclass(frozen=True)
+class ChunkSlotPlan:
+    """How each array slot of a plan partitions along the doc dimension.
+
+    `chunkexec` slices a dense plan into doc-span sub-plans; every slot
+    must fall into exactly one class or the plan is chunk-ineligible:
+
+    - `posting_pairs`: (ids_slot, tfs_slot) posting lists — doc ids are
+      filtered to the chunk's doc window and rebased host-side (out-of-
+      window lanes get the chunk's OOB scatter sentinel).
+    - `doc_slots`: per-padded-doc columns (values, presence, fieldnorms,
+      ordinals) — sliced `[base : base + span]`.
+    - `zone_slots`: per-ZONEMAP_BLOCK zonemaps — sliced by block index.
+    - `packed_slots`: np.packbits doc bitmasks — sliced by byte index.
+    - `full_slots`: bounded non-doc tables (range-agg bounds, per-ordinal
+      hash tables, impact block maxima) — passed through whole.
+    """
+    posting_pairs: tuple[tuple[int, int], ...]
+    doc_slots: frozenset
+    zone_slots: frozenset
+    packed_slots: frozenset
+    full_slots: frozenset
+
+
+def chunk_slot_plan(plan: LoweredPlan) -> Optional[ChunkSlotPlan]:
+    """Classify every array slot for doc-dimension chunking, or return None
+    when the plan is chunk-ineligible (composite aggs sort the whole doc
+    space at once; multivalued pair arrays gather by global doc id; any
+    slot the walkers cannot attribute is conservatively disqualifying)."""
+    from ..index.format import ZONEMAP_BLOCK
+    pairs: list[tuple[int, int]] = []
+    doc: set[int] = set()
+    zone: set[int] = set()
+    packed: set[int] = set()
+    full: set[int] = set()
+
+    def walk_node(node: Any) -> bool:
+        if isinstance(node, PPostings):
+            pairs.append((node.ids_slot, node.tfs_slot))
+            if node.norm_slot >= 0:
+                doc.add(node.norm_slot)
+            if node.impact_bmax_slot >= 0:
+                full.add(node.impact_bmax_slot)
+            return True
+        if isinstance(node, PRange):
+            doc.add(node.values_slot)
+            if node.present_slot >= 0:
+                doc.add(node.present_slot)
+            for slot in (node.zmin_slot, node.zmax_slot):
+                if slot >= 0:
+                    zone.add(slot)
+            return True
+        if isinstance(node, PPresence):
+            doc.add(node.present_slot)
+            return True
+        if isinstance(node, PNormPresence):
+            doc.add(node.norm_slot)
+            return True
+        if isinstance(node, PBool):
+            return all(walk_node(c) for c in
+                       (*node.must, *node.must_not, *node.should, *node.filter))
+        if isinstance(node, PMaskRef):
+            packed.add(node.packed_slot)
+            return True
+        return isinstance(node, (PMatchAll, PMatchNone))
+
+    def walk_metric(metric: MetricSlots) -> bool:
+        doc.add(metric.values_slot)
+        if metric.present_slot >= 0:
+            doc.add(metric.present_slot)
+        if metric.hash_slot >= 0:
+            full.add(metric.hash_slot)  # per-ordinal table, not per-doc
+        return True
+
+    def walk_agg(agg: Any) -> bool:
+        if isinstance(agg, BucketAggExec):
+            if agg.kind == "terms_mv":
+                return False  # pair arrays gather the mask by global doc id
+            doc.add(agg.values_slot)
+            if agg.present_slot >= 0:
+                doc.add(agg.present_slot)
+            for slot in (agg.froms_slot, agg.tos_slot):
+                if slot >= 0:
+                    full.add(slot)  # [num_buckets] bound tables
+            return (all(walk_metric(m) for m in agg.metrics)
+                    and all(walk_agg(s) for s in agg.subs))
+        if isinstance(agg, MetricAggExec):
+            return walk_metric(agg.metric)
+        return False  # CompositeAggExec: whole-doc-space sort
+
+    if not walk_node(plan.root):
+        return None
+    for slot in (plan.sort.values_slot, plan.sort.present_slot,
+                 plan.sort.values2_slot, plan.sort.present2_slot):
+        if slot >= 0:
+            doc.add(slot)
+    for agg in plan.aggs:
+        if not walk_agg(agg):
+            return None
+
+    padded = plan.num_docs_padded
+    pair_slots = {s for p in pairs for s in p}
+    classified = doc | zone | packed | full | pair_slots
+    if classified != set(range(len(plan.arrays))):
+        return None  # a slot nobody attributed — refuse to slice blind
+    # one class per slot: a slot consumed under two different partitioning
+    # rules cannot be sliced consistently
+    buckets = [doc, zone, packed, full, pair_slots]
+    for i, a in enumerate(buckets):
+        for b in buckets[i + 1:]:
+            if a & b:
+                return None
+    for slot in doc:
+        a = plan.arrays[slot]
+        if a.ndim != 1 or a.shape[0] != padded:
+            return None
+    for slot in zone:
+        a = plan.arrays[slot]
+        if a.ndim != 1 or a.shape[0] * ZONEMAP_BLOCK != padded:
+            return None
+    for slot in packed:
+        a = plan.arrays[slot]
+        if a.ndim != 1 or a.shape[0] != padded // 8:
+            return None
+    for ids_slot, tfs_slot in pairs:
+        if plan.arrays[ids_slot].shape != plan.arrays[tfs_slot].shape:
+            return None
+    return ChunkSlotPlan(
+        posting_pairs=tuple(pairs), doc_slots=frozenset(doc),
+        zone_slots=frozenset(zone), packed_slots=frozenset(packed),
+        full_slots=frozenset(full))

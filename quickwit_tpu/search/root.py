@@ -23,7 +23,8 @@ import time
 from typing import Any, Callable, Optional, Protocol
 
 from ..common.deadline import (
-    Deadline, DeadlineExceeded, QueryBudget, deadline_scope, is_deadline_error,
+    CancellationToken, CancelledQuery, Deadline, DeadlineExceeded, QueryBudget,
+    cancel_scope, deadline_scope, is_cancel_error, is_deadline_error,
 )
 from ..common.ctx import run_with_context
 from ..metastore.base import ListSplitsQuery, Metastore, MetastoreError
@@ -42,6 +43,7 @@ from ..query import ast as Q
 from ..tenancy.context import current_tenant, tenant_scope
 from ..tenancy.overload import OverloadShed
 from ..tenancy.registry import GLOBAL_TENANCY, TenantRateLimited
+from .cancel import CANCEL_REGISTRY
 from .collector import IncrementalCollector, finalize_aggregations
 from .models import (
     FetchDocsRequest, Hit, LeafSearchRequest, LeafSearchResponse, SearchRequest,
@@ -218,12 +220,38 @@ class RootSearcher:
             import uuid
             profile = QueryProfile(query_id=uuid.uuid4().hex[:16])
             SEARCH_PROFILED_QUERIES_TOTAL.inc()
+        # Cancellation seam: ambient token for the whole query. With a
+        # query_id it is also registered for REST DELETE; without one it
+        # still flows to the leaves so embedded callers can cancel
+        # programmatically via the scope.
+        cancel_token = CancellationToken()
+        if request.query_id is not None:
+            # A DELETE can race ahead of the query it targets (a client
+            # cancelling a retry under its stable handle): adopt an
+            # already-cancelled token registered under this id instead of
+            # replacing it, so the early cancel still lands. Live tokens
+            # are NOT adopted — last-writer-wins for genuine retries.
+            raced = CANCEL_REGISTRY.get(request.query_id)
+            if raced is not None and raced.cancelled:
+                cancel_token = raced
+            CANCEL_REGISTRY.register(request.query_id, cancel_token)
         t0 = time.monotonic()
         try:
             with TRACER.span("root_search",
                              {"indexes": ",".join(request.index_ids)}):
-                with deadline_scope(deadline), profile_scope(profile):
-                    response = self._search_traced(request, budget)
+                with deadline_scope(deadline), cancel_scope(cancel_token), \
+                        profile_scope(profile):
+                    try:
+                        response = self._search_traced(request, budget)
+                    except CancelledQuery as exc:
+                        # typed partial: the cancel landed before any merged
+                        # result existed — report it as cancelled, not error
+                        response = SearchResponse(
+                            elapsed_time_micros=int(
+                                (time.monotonic() - t0) * 1e6),
+                            errors=[str(exc)],
+                            cancelled=True,
+                        )
         except BaseException as exc:
             if tenant is not None:
                 if isinstance(exc, OverloadShed):
@@ -232,6 +260,8 @@ class RootSearcher:
                     status = "rejected"
                 elif is_deadline_error(str(exc)):
                     status = "timed_out"
+                elif is_cancel_error(str(exc)):
+                    status = "cancelled"
                 else:
                     status = "error"
                 GLOBAL_TENANCY.note_query(tenant.tenant_id, status=status)
@@ -241,12 +271,16 @@ class RootSearcher:
                 self._capture_slow_query(request, profile,
                                          timed_out=is_deadline_error(str(exc)))
             raise
+        finally:
+            if request.query_id is not None:
+                CANCEL_REGISTRY.unregister(request.query_id, cancel_token)
         if response.timed_out:
             SEARCH_TIMED_OUT_TOTAL.inc()
         if tenant is not None:
             GLOBAL_TENANCY.note_query(
                 tenant.tenant_id,
-                status="timed_out" if response.timed_out else "ok")
+                status=("cancelled" if response.cancelled
+                        else "timed_out" if response.timed_out else "ok"))
         if profile is not None:
             if response.timed_out:
                 profile.mark_partial("timed_out")
@@ -365,9 +399,11 @@ class RootSearcher:
         deadline_hit = (budget.deadline.expired
                         or any(is_deadline_error(e.error)
                                for e in merged.failed_splits))
+        cancel_hit = any(is_cancel_error(e.error)
+                         for e in merged.failed_splits)
         if (merged.num_attempted_splits > 0
                 and merged.num_successful_splits == 0 and merged.failed_splits
-                and not deadline_hit):
+                and not deadline_hit and not cancel_hit):
             # every split failed: a query-level problem (e.g. unknown field),
             # not a partial outage — surface it as an error (reference 400s).
             # Deadline expiries are NOT query-level problems: they return a
@@ -391,6 +427,7 @@ class RootSearcher:
             errors=[f"{e.split_id}: {e.error}" for e in merged.failed_splits],
             aggregations=aggregations,
             timed_out=deadline_hit or budget.deadline.expired,
+            cancelled=cancel_hit,
             failed_splits=list(merged.failed_splits),
             num_attempted_splits=merged.num_attempted_splits,
             num_successful_splits=merged.num_successful_splits,

@@ -27,7 +27,8 @@ from typing import Any, Optional
 
 from ..common.ctx import run_with_context
 from ..common.deadline import (
-    Deadline, current_deadline, deadline_scope,
+    CancelledQuery, Deadline, current_cancel_token, current_deadline,
+    deadline_scope,
 )
 from ..index.reader import SplitReader
 from ..models.doc_mapper import DocMapper
@@ -1120,8 +1121,19 @@ class SearchService:
                            prune_stats=None) -> None:
         from .leaf import warmup_device_arrays
         deadline = current_deadline()
+        cancel = current_cancel_token()
         profile = current_profile()
         for split, reader, plan, prep_error, cache_ctx in data:
+            if cancel is not None and cancel.cancelled:
+                # cancelled between splits: unexecuted splits are reported
+                # as non-retryable cancel failures (the root must not spend
+                # its retry pool re-running work the caller abandoned)
+                collector.failed_splits.append(SplitSearchError(
+                    split_id=split.split_id,
+                    error=f"query cancelled before split executed"
+                          f"{': ' + cancel.reason if cancel.reason else ''}",
+                    retryable=False))
+                continue
             if deadline is not None and deadline.expired:
                 if profile is not None:
                     profile.mark_partial("shed: split execute")
@@ -1166,7 +1178,9 @@ class SearchService:
                 response = execute_prepared_split(
                     search_request, doc_mapper, reader, split.split_id,
                     plan, device_arrays,
-                    batcher=self.context.query_batcher)
+                    batcher=self.context.query_batcher,
+                    threshold_box=threshold,
+                    fault_injector=self.context.fault_injector)
                 if cache_ctx is not None and cache_ctx["agg_hits"]:
                     # Tier B hits join the response BEFORE the leaf-cache
                     # put and the merge — the cached LeafSearchResponse
@@ -1191,6 +1205,11 @@ class SearchService:
                 # retryable split failure would make the root burn retries
                 # on work the controller just refused
                 raise
+            except CancelledQuery as exc:
+                # NEVER retryable: the caller asked for the query to stop.
+                # Remaining splits fall out at the top-of-loop cancel check.
+                collector.failed_splits.append(SplitSearchError(
+                    split_id=split.split_id, error=str(exc), retryable=False))
             except Exception as exc:  # noqa: BLE001 - partial failure semantics
                 _warn_split_failure("search", split.split_id, exc)
                 collector.failed_splits.append(SplitSearchError(

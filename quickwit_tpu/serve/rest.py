@@ -153,6 +153,7 @@ def _search_request_from_params(index_id: str, params: dict[str, Any],
         if params.get("timeout_ms") is not None else None,
         profile=str(params.get("profile", "false")).lower()
         in ("true", "1", "yes"),
+        query_id=params.get("query_id"),
     )
 
 
@@ -668,6 +669,21 @@ class RestServer:
             from ..search.list_apis import list_fields
             return 200, {"fields": list_fields(node.metastore,
                                                m.group(1).split(","))}
+        # --- query cancellation ----------------------------------------
+        m = re.fullmatch(r"/api/v1/search/([^/]+)", path)
+        if m and method == "DELETE":
+            # cancel an in-flight query by its caller-chosen query_id: the
+            # chunked leaf scan observes the token at its next chunk
+            # boundary (reference role: ES `_tasks/<id>/_cancel`). Non-DELETE
+            # methods fall through (an index named "search" keeps its routes).
+            from ..observability.metrics import SEARCH_CANCEL_TOTAL
+            from ..search.cancel import CANCEL_REGISTRY
+            cancelled = CANCEL_REGISTRY.cancel(
+                m.group(1), reason="REST DELETE")
+            SEARCH_CANCEL_TOTAL.inc()
+            # idempotent: cancelling a finished/unknown query is a no-op,
+            # not an error (the race against completion is inherent)
+            return 200, {"query_id": m.group(1), "cancelled": cancelled}
         # --- search ----------------------------------------------------
         m = re.fullmatch(r"/api/v1/([^/_][^/]*)/search(?:/stream)?", path)
         if m:

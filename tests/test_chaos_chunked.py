@@ -1,0 +1,200 @@
+"""Chaos suite for the resumable chunked leaf scan (search/chunkexec.py).
+
+Two injection points guard the carried state across chunk boundaries:
+
+- `kernel.chunk_yield` fires at the boundary control point. A fault there
+  must never wedge the scan: the carried state is discarded and the query
+  re-executes from scratch (counted in qw_chunk_restarts_total), and a
+  fault storm degrades to the fused path — same answer, no chunk benefits.
+- `kernel.preempt_park` fires while the carried state is parked during a
+  preemption yield. A fault (modeling parked-state eviction under byte
+  pressure) likewise forces a clean from-scratch re-execution.
+
+Determinism: all faults use `every`/`max_fires` schedules, never
+probability, so each test sees the exact same failure sequence every run.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from quickwit_tpu.common.faults import FaultInjector, FaultRule
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.index import SplitReader, SplitWriter
+from quickwit_tpu.index.format import POSTING_PAD
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.query.ast import Term
+from quickwit_tpu.search import chunkexec, executor
+from quickwit_tpu.search.chunkexec import PREEMPT_GATE, execute_plan_chunked
+from quickwit_tpu.search.plan import lower_request
+from quickwit_tpu.storage import RamStorage
+from quickwit_tpu.tenancy.context import TenantContext, tenant_scope
+from quickwit_tpu.tenancy.overload import OVERLOAD
+
+pytestmark = pytest.mark.chaos
+
+MAPPER = DocMapper(
+    field_mappings=[
+        FieldMapping("ts", FieldType.DATETIME, fast=True,
+                     input_formats=("unix_timestamp",)),
+        FieldMapping("body", FieldType.TEXT),
+    ],
+    timestamp_field="ts",
+    default_search_fields=("body",),
+)
+NUM_DOCS = 1100  # multi-chunk posting lists at POSTING_PAD spans
+
+
+@pytest.fixture(scope="module")
+def plan():
+    rng = np.random.RandomState(11)
+    writer = SplitWriter(MAPPER)
+    for i in range(NUM_DOCS):
+        writer.add_json_doc({
+            "ts": 1_700_000_000 + i,
+            "body": " ".join(["alpha"] * int(rng.randint(1, 3))),
+        })
+    storage = RamStorage(Uri.parse("ram:///chaoschunk"))
+    storage.put("c.split", writer.finish())
+    reader = SplitReader(storage, "c.split")
+    return lower_request(Term("body", "alpha"), MAPPER, reader, [])
+
+
+def _chunks_of(plan):
+    mode, total, align = chunkexec.chunk_mode(plan)
+    assert mode == "posting"
+    return len(chunkexec.chunk_spans(total, POSTING_PAD, POSTING_PAD))
+
+
+def test_chunk_yield_fault_restarts_cleanly(plan):
+    """One boundary fault: the carried state is dropped, the scan restarts
+    from chunk zero, and the final result is still bit-identical to the
+    fused kernel — the retry is invisible except in the restart counter."""
+    assert _chunks_of(plan) >= 3
+    fused = executor.execute_plan(plan, 10, list(plan.arrays))
+    injector = FaultInjector(seed=7, rules=[
+        FaultRule("kernel.chunk_yield", "error", max_fires=1)])
+    restarts_before = chunkexec.CHUNK_RESTARTS_TOTAL.get()
+    result = execute_plan_chunked(plan, 10, list(plan.arrays),
+                                  span=POSTING_PAD, fault_injector=injector)
+    assert result is not None
+    assert chunkexec.CHUNK_RESTARTS_TOTAL.get() == restarts_before + 1
+    np.testing.assert_array_equal(np.asarray(fused["sort_values"]),
+                                  np.asarray(result["sort_values"]))
+    np.testing.assert_array_equal(np.asarray(fused["doc_ids"]),
+                                  np.asarray(result["doc_ids"]))
+    assert int(fused["count"]) == int(result["count"])
+
+
+def test_chunk_yield_fault_storm_degrades_to_fused(plan):
+    """EVERY boundary faults: after the bounded restart budget the scan
+    gives up on chunking and finishes on the fused path — the query is
+    never wedged and the answer is still exact."""
+    fused = executor.execute_plan(plan, 10, list(plan.arrays))
+    injector = FaultInjector(seed=7, rules=[
+        FaultRule("kernel.chunk_yield", "error")])  # unlimited fires
+    t0 = time.monotonic()
+    result = execute_plan_chunked(plan, 10, list(plan.arrays),
+                                  span=POSTING_PAD, fault_injector=injector)
+    assert time.monotonic() - t0 < 30.0, "fault storm wedged the scan"
+    assert result is not None
+    np.testing.assert_array_equal(np.asarray(fused["sort_values"]),
+                                  np.asarray(result["sort_values"]))
+    assert int(fused["count"]) == int(result["count"])
+
+
+def test_chunk_yield_fault_schedule_is_deterministic(plan):
+    """Same seed -> same fired schedule, independent of prior runs."""
+    def run(seed):
+        injector = FaultInjector(seed=seed, rules=[
+            FaultRule("kernel.chunk_yield", "error", every=3, max_fires=2)])
+        execute_plan_chunked(plan, 10, list(plan.arrays),
+                             span=POSTING_PAD, fault_injector=injector)
+        return injector.schedule()
+
+    assert run(123) == run(123)
+
+
+def _trip_overload():
+    OVERLOAD.configure(enabled=True, target_wait_secs=0.01)
+    for _ in range(20):
+        OVERLOAD.note_wait(1.0)
+    assert OVERLOAD.shed_floor() > 0
+
+
+def _clear_overload():
+    OVERLOAD.reset()
+    OVERLOAD.configure(enabled=False, target_wait_secs=0.5)
+
+
+def test_preempt_park_eviction_restarts_from_scratch(plan):
+    """A fault while the carried state is parked (eviction under parked-
+    byte pressure) throws the state away; the preempted query re-executes
+    from scratch once the gate clears and still returns the exact result."""
+    fused = executor.execute_plan(plan, 10, list(plan.arrays))
+    injector = FaultInjector(seed=3, rules=[
+        FaultRule("kernel.preempt_park", "error", max_fires=1)])
+    _trip_overload()
+    release = threading.Event()
+
+    def interactive():
+        with PREEMPT_GATE.running(2):
+            release.wait(5.0)
+
+    thread = threading.Thread(target=interactive, daemon=True)
+    thread.start()
+    restarts_before = chunkexec.CHUNK_RESTARTS_TOTAL.get()
+    preempts_before = chunkexec.PREEMPT_TOTAL.get()
+    try:
+        while not PREEMPT_GATE.should_yield(0):
+            time.sleep(0.005)
+        threading.Timer(0.15, release.set).start()
+        with tenant_scope(TenantContext.for_class("bg", "background")):
+            result = execute_plan_chunked(plan, 10, list(plan.arrays),
+                                          span=POSTING_PAD,
+                                          fault_injector=injector)
+    finally:
+        release.set()
+        thread.join(timeout=5.0)
+        _clear_overload()
+    assert result is not None
+    assert chunkexec.PREEMPT_TOTAL.get() > preempts_before
+    assert chunkexec.CHUNK_RESTARTS_TOTAL.get() > restarts_before
+    np.testing.assert_array_equal(np.asarray(fused["sort_values"]),
+                                  np.asarray(result["sort_values"]))
+    np.testing.assert_array_equal(np.asarray(fused["doc_ids"]),
+                                  np.asarray(result["doc_ids"]))
+    assert int(fused["count"]) == int(result["count"])
+
+
+def test_parked_bytes_gauge_returns_to_zero(plan):
+    """However a scan ends — clean, restarted, or evicted — no parked
+    bytes leak past it."""
+    from quickwit_tpu.observability.metrics import PREEMPT_PARKED_BYTES
+    assert chunkexec.PARKED_STATES.parked_bytes() == 0
+    injector = FaultInjector(seed=5, rules=[
+        FaultRule("kernel.preempt_park", "error")])
+    _trip_overload()
+    release = threading.Event()
+
+    def interactive():
+        with PREEMPT_GATE.running(2):
+            release.wait(5.0)
+
+    thread = threading.Thread(target=interactive, daemon=True)
+    thread.start()
+    try:
+        while not PREEMPT_GATE.should_yield(0):
+            time.sleep(0.005)
+        threading.Timer(0.1, release.set).start()
+        with tenant_scope(TenantContext.for_class("bg", "background")):
+            execute_plan_chunked(plan, 10, list(plan.arrays),
+                                 span=POSTING_PAD, fault_injector=injector)
+    finally:
+        release.set()
+        thread.join(timeout=5.0)
+        _clear_overload()
+    assert chunkexec.PARKED_STATES.parked_bytes() == 0
+    assert PREEMPT_PARKED_BYTES.get() == 0.0

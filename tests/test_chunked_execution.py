@@ -1,0 +1,564 @@
+"""Resumable chunked leaf kernels (search/chunkexec.py).
+
+The core claim is BIT-IDENTITY: a plan executed as a chunked scan over
+doc-block/posting-lane slabs must return exactly the fused kernel's
+result — same top-K rows in the same order (including ties), same count,
+same agg states — for every chunk size. On top of that sit the robustness
+behaviors the chunk boundaries buy: mid-scan cancellation with honest
+partial results, tenant preemption with parked carried state, cross-chunk
+early termination, and the batcher's cancel-aware rider wait.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from quickwit_tpu.common.deadline import (
+    CancellationToken, CancelledQuery, Deadline, cancel_scope, deadline_scope,
+)
+from quickwit_tpu.common.uri import Uri
+from quickwit_tpu.index import SplitReader, SplitWriter
+from quickwit_tpu.index.format import DOC_PAD, POSTING_PAD
+from quickwit_tpu.models import DocMapper, FieldMapping, FieldType
+from quickwit_tpu.query.aggregations import DateHistogramAgg, MetricAgg
+from quickwit_tpu.query.ast import Bool, MatchAll, Range, RangeBound, Term
+from quickwit_tpu.search import chunkexec, executor
+from quickwit_tpu.search.batcher import QueryBatcher
+from quickwit_tpu.search.chunkexec import (
+    CHUNKING, PARKED_STATES, PREEMPT_GATE, ParkedStateRegistry,
+    execute_plan_chunked,
+)
+from quickwit_tpu.search.plan import lower_request
+from quickwit_tpu.storage import RamStorage
+from quickwit_tpu.tenancy.overload import OVERLOAD
+
+SEVERITIES = ["DEBUG", "INFO", "WARN", "ERROR"]
+BIG_DOCS = 1100   # pads to 2048 docs -> two DOC_PAD dense chunks
+SMALL_DOCS = 300  # pads to 1024 docs -> dense-chunk ineligible (one chunk)
+
+
+def _mapper():
+    return DocMapper(
+        field_mappings=[
+            FieldMapping("timestamp", FieldType.DATETIME, fast=True,
+                         input_formats=("unix_timestamp",)),
+            FieldMapping("severity_text", FieldType.TEXT, tokenizer="raw",
+                         fast=True),
+            FieldMapping("tenant_id", FieldType.U64, fast=True),
+            FieldMapping("body", FieldType.TEXT),
+            FieldMapping("latency", FieldType.F64, fast=True),
+        ],
+        timestamp_field="timestamp",
+        default_search_fields=("body",),
+    )
+
+
+MAPPER = _mapper()
+T0 = 1_700_000_000
+
+
+def _docs(n, seed):
+    rng = np.random.RandomState(seed)
+    docs = []
+    for i in range(n):
+        docs.append({
+            "timestamp": T0 + i * 60,
+            "severity_text": SEVERITIES[int(rng.randint(0, 4))],
+            "tenant_id": int(rng.randint(0, 4)),
+            "body": " ".join(["alpha"] * int(rng.randint(1, 3))
+                             + ["beta"] * int(rng.randint(0, 2))),
+            "latency": float(rng.gamma(2.0, 40.0)),
+        })
+    return docs
+
+
+def _build_reader(docs, name, env=None):
+    import os
+    old = {k: os.environ.get(k) for k in (env or {})}
+    os.environ.update(env or {})
+    try:
+        writer = SplitWriter(MAPPER)
+        for doc in docs:
+            writer.add_json_doc(doc)
+        data = writer.finish()
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    storage = RamStorage(Uri.parse("ram:///chunked"))
+    storage.put(name, data)
+    return SplitReader(storage, name)
+
+
+@pytest.fixture(scope="module")
+def big_reader():
+    return _build_reader(_docs(BIG_DOCS, seed=5), "big.split")
+
+
+@pytest.fixture(scope="module")
+def big_reader_v2():
+    return _build_reader(_docs(BIG_DOCS, seed=5), "bigv2.split",
+                         env={"QW_DISABLE_IMPACT": "1"})
+
+
+@pytest.fixture(scope="module")
+def big_reader_v1():
+    return _build_reader(_docs(BIG_DOCS, seed=5), "bigv1.split",
+                         env={"QW_DISABLE_PACKED": "1"})
+
+
+def _aggs():
+    return [
+        DateHistogramAgg(name="per_hour", field="timestamp",
+                         interval_micros=3_600 * 10**6,
+                         sub_metrics=(MetricAgg("lat_avg", "avg", "latency"),)),
+        MetricAgg("lat_stats", "stats", "latency"),
+    ]
+
+
+def _assert_identical(fused, chunked):
+    assert chunked is not None, "plan unexpectedly refused to chunk"
+    assert int(fused["count"]) == int(chunked["count"])
+    for key in ("sort_values", "sort_values2", "doc_ids", "scores"):
+        a, b = fused[key], chunked[key]
+        if a is None or b is None:
+            assert a is None and b is None, key
+            continue
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=key)
+    import jax
+    fa = jax.tree_util.tree_leaves(fused["aggs"])
+    ca = jax.tree_util.tree_leaves(chunked["aggs"])
+    assert len(fa) == len(ca)
+    for xa, xb in zip(fa, ca):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+
+
+def _compare(plan, k, span, threshold_box=None):
+    fused = executor.execute_plan(plan, k, list(plan.arrays))
+    chunked = execute_plan_chunked(plan, k, list(plan.arrays), span=span,
+                                   threshold_box=threshold_box)
+    _assert_identical(fused, chunked)
+    return chunked
+
+
+# --- bit-identity: chunked == fused ---------------------------------------
+
+@pytest.mark.parametrize("span_blocks", [1, 7])
+def test_posting_term_equivalence(big_reader, span_blocks):
+    plan = lower_request(Term("body", "alpha"), MAPPER, big_reader, [])
+    mode, total, align = chunkexec.chunk_mode(plan)
+    assert mode == "posting"
+    assert total > span_blocks * POSTING_PAD, "need a multi-chunk term"
+    _compare(plan, 10, span_blocks * POSTING_PAD)
+
+
+def test_posting_term_k0_count_only(big_reader):
+    plan = lower_request(Term("body", "alpha"), MAPPER, big_reader, [])
+    _compare(plan, 0, POSTING_PAD)
+
+
+def test_posting_term_k_exceeds_hits(big_reader):
+    # k larger than one chunk's postings: per-chunk kk < k lanes, the
+    # cross-chunk merge must still pad/order exactly like the fused kernel
+    plan = lower_request(Term("body", "beta"), MAPPER, big_reader, [])
+    _compare(plan, 64, POSTING_PAD)
+
+
+@pytest.mark.parametrize("order", ["asc", "desc"])
+def test_dense_column_sort_equivalence(big_reader, order):
+    plan = lower_request(MatchAll(), MAPPER, big_reader, [],
+                         sort_field="latency", sort_order=order)
+    mode, total, align = chunkexec.chunk_mode(plan)
+    assert mode == "dense" and total == 2 * DOC_PAD
+    _compare(plan, 10, DOC_PAD)
+
+
+def test_dense_bool_range_filter_equivalence(big_reader):
+    query = Bool(
+        must=(Term("severity_text", "ERROR"),),
+        filter=(Range("timestamp",
+                      lower=RangeBound((T0 + 600) * 10**6, True),
+                      upper=RangeBound((T0 + 60 * BIG_DOCS) * 10**6, False)),
+                Range("tenant_id", lower=RangeBound(1, True),
+                      upper=RangeBound(3, False))),
+    )
+    plan = lower_request(query, MAPPER, big_reader, [],
+                         sort_field="timestamp", sort_order="desc")
+    _compare(plan, 10, DOC_PAD)
+
+
+def test_dense_two_key_sort_equivalence(big_reader):
+    plan = lower_request(MatchAll(), MAPPER, big_reader, [],
+                         sort_field="tenant_id", sort_order="desc",
+                         sort2_field="timestamp", sort2_order="asc")
+    _compare(plan, 15, DOC_PAD)
+
+
+def test_dense_search_after_equivalence(big_reader):
+    plan = lower_request(MatchAll(), MAPPER, big_reader, [],
+                         sort_field="latency", sort_order="desc",
+                         search_after=(123.5, None, "lt_tie", 7))
+    _compare(plan, 10, DOC_PAD)
+
+
+def test_dense_aggs_equivalence(big_reader):
+    plan = lower_request(MatchAll(), MAPPER, big_reader, _aggs())
+    _compare(plan, 0, DOC_PAD)
+
+
+def test_dense_aggs_with_hits_equivalence(big_reader):
+    plan = lower_request(MatchAll(), MAPPER, big_reader, _aggs(),
+                         sort_field="timestamp", sort_order="desc")
+    _compare(plan, 10, DOC_PAD)
+
+
+def test_v2_format_equivalence(big_reader_v2):
+    # no impact side arrays: posting chunks slice ids/tfs only
+    plan = lower_request(Term("body", "alpha"), MAPPER, big_reader_v2, [])
+    _compare(plan, 10, POSTING_PAD)
+    plan = lower_request(MatchAll(), MAPPER, big_reader_v2, [],
+                         sort_field="latency", sort_order="desc")
+    _compare(plan, 10, DOC_PAD)
+
+
+def test_v1_format_equivalence(big_reader_v1):
+    # no packed masks: dense chunks slice plain doc-space arrays
+    plan = lower_request(Term("body", "alpha"), MAPPER, big_reader_v1, [])
+    _compare(plan, 10, POSTING_PAD)
+    plan = lower_request(MatchAll(), MAPPER, big_reader_v1, [],
+                         sort_field="latency", sort_order="desc")
+    _compare(plan, 10, DOC_PAD)
+
+
+def test_threshold_pushdown_boundary_tightening(big_reader):
+    # a shared ThresholdBox rising mid-scan tightens each later chunk's
+    # pushed threshold; the >= mask keeps every final-top-K row, so the
+    # result must still equal the fused kernel's (run with the ORIGINAL
+    # threshold) exactly
+    from quickwit_tpu.search.pruning import ThresholdBox
+    plan = lower_request(MatchAll(), MAPPER, big_reader, [],
+                         sort_field="latency", sort_order="desc",
+                         sort_value_threshold=10.0)
+    assert plan.threshold_slot >= 0
+    fused = executor.execute_plan(plan, 10, list(plan.arrays))
+    box = ThresholdBox()
+    # tighter than the plan's own threshold but BELOW the true 10th value,
+    # so tightening changes chunk-local masks without dropping final rows
+    box.update(float(np.asarray(fused["sort_values"])[9]) - 1e-6)
+    chunked = execute_plan_chunked(plan, 10, list(plan.arrays),
+                                   span=DOC_PAD, threshold_box=box)
+    assert chunked is not None
+    np.testing.assert_array_equal(np.asarray(fused["sort_values"]),
+                                  np.asarray(chunked["sort_values"]))
+    np.testing.assert_array_equal(np.asarray(fused["doc_ids"]),
+                                  np.asarray(chunked["doc_ids"]))
+
+
+def test_single_chunk_falls_back_to_fused(big_reader):
+    # span covering everything -> the chunked path declines (None) and the
+    # caller keeps the seed fused program
+    plan = lower_request(MatchAll(), MAPPER, big_reader, [],
+                         sort_field="latency", sort_order="desc")
+    assert execute_plan_chunked(plan, 10, list(plan.arrays),
+                                span=4 * DOC_PAD) is None
+
+
+def test_chunking_disabled_is_inert(big_reader):
+    plan = lower_request(Term("body", "alpha"), MAPPER, big_reader, [])
+    CHUNKING.set(enabled=False)
+    try:
+        assert execute_plan_chunked(plan, 10, list(plan.arrays),
+                                    span=POSTING_PAD) is None
+    finally:
+        CHUNKING.set(enabled=True)
+
+
+def test_composite_agg_never_chunks(big_reader):
+    from quickwit_tpu.query.aggregations import parse_aggs
+    aggs = parse_aggs({"by_sev": {
+        "composite": {"size": 8, "sources": [
+            {"sev": {"terms": {"field": "severity_text"}}}]}}})
+    plan = lower_request(MatchAll(), MAPPER, big_reader, aggs)
+    assert chunkexec.chunk_mode(plan) is None
+
+
+# --- early termination -----------------------------------------------------
+
+def test_early_termination_skips_cold_chunks(big_reader):
+    # a threshold pushdown on an impact-ordered term cuts the posting tail
+    # host-side (count_override = df) and stages per-block maxima: the
+    # chunked scan re-reads those bounds at every boundary and stops as
+    # soon as no remaining chunk can beat the current Kth score
+    plan = lower_request(Term("body", "alpha"), MAPPER, big_reader, [],
+                         sort_value_threshold=0.0005)
+    assert plan.count_override is not None, "prefix cutoff did not engage"
+    _, total, _ = chunkexec.chunk_mode(plan)
+    n_chunks = len(chunkexec.chunk_spans(total, POSTING_PAD, POSTING_PAD))
+    assert n_chunks >= 3
+    assert chunkexec._early_term_eligible(plan, 10, "posting")
+    fused = executor.execute_plan(plan, 10, list(plan.arrays))
+    dispatches_before = chunkexec.CHUNK_DISPATCHES_TOTAL.get()
+    early_before = chunkexec.CHUNK_EARLY_TERMINATIONS_TOTAL.get()
+    chunked = execute_plan_chunked(plan, 10, list(plan.arrays),
+                                   span=POSTING_PAD)
+    assert chunked is not None
+    # top-K identical to the fused result, with FEWER chunks dispatched
+    np.testing.assert_array_equal(np.asarray(fused["sort_values"]),
+                                  np.asarray(chunked["sort_values"]))
+    np.testing.assert_array_equal(np.asarray(fused["doc_ids"]),
+                                  np.asarray(chunked["doc_ids"]))
+    assert chunkexec.CHUNK_EARLY_TERMINATIONS_TOTAL.get() > early_before
+    assert (chunkexec.CHUNK_DISPATCHES_TOTAL.get() - dispatches_before
+            < n_chunks)
+    # the skipped chunks' matches never ran; the count is the exact
+    # host-side df, not a truncation artifact
+    assert int(chunked["count"]) == plan.count_override
+
+
+# --- cancellation ----------------------------------------------------------
+
+class _CancelAtBoundary:
+    """Chaos shim: flips the token the first time the scan reaches a chunk
+    boundary (the cancel is then observed at the NEXT boundary)."""
+
+    def __init__(self, token):
+        self.token = token
+        self.fired = False
+
+    def perturb(self, operation):
+        if operation == "kernel.chunk_yield" and not self.fired:
+            self.fired = True
+            self.token.cancel("test cancel")
+
+
+def test_cancel_mid_scan_returns_partial(big_reader):
+    plan = lower_request(Term("body", "alpha"), MAPPER, big_reader, [])
+    mode, total, _ = chunkexec.chunk_mode(plan)
+    assert total > 2 * POSTING_PAD, "need >= 3 chunks"
+    token = CancellationToken()
+    with cancel_scope(token):
+        result = execute_plan_chunked(
+            plan, 10, list(plan.arrays), span=POSTING_PAD,
+            fault_injector=_CancelAtBoundary(token))
+    assert result is not None and result.get("partial") is True
+    # the partial is whatever the completed chunks merged: a valid,
+    # decodable prefix of the scan, not garbage
+    assert int(result["count"]) > 0
+    assert np.asarray(result["sort_values"]).shape[0] <= 10
+
+
+def test_cancel_before_any_chunk_raises(big_reader):
+    plan = lower_request(Term("body", "alpha"), MAPPER, big_reader, [])
+    token = CancellationToken()
+    token.cancel("early")
+    # boundary checks run from the SECOND chunk on; chunk one executes,
+    # boundary two observes the cancel with partials disabled -> typed error
+    CHUNKING.set(partial_on_cancel=False)
+    try:
+        with cancel_scope(token):
+            with pytest.raises(CancelledQuery):
+                execute_plan_chunked(plan, 10, list(plan.arrays),
+                                     span=POSTING_PAD)
+    finally:
+        CHUNKING.set(partial_on_cancel=True)
+
+
+def test_cancelled_query_stops_within_one_boundary(big_reader):
+    # acceptance: cancelling mid-flight stops the scan at the NEXT chunk
+    # boundary — later chunks never dispatch
+    plan = lower_request(Term("body", "alpha"), MAPPER, big_reader, [])
+    _, total, _ = chunkexec.chunk_mode(plan)
+    n_chunks = len(chunkexec.chunk_spans(total, POSTING_PAD, POSTING_PAD))
+    assert n_chunks >= 3
+    token = CancellationToken()
+    counting = _CancelAtBoundary(token)
+    with cancel_scope(token):
+        result = execute_plan_chunked(plan, 10, list(plan.arrays),
+                                      span=POSTING_PAD,
+                                      fault_injector=counting)
+    assert result.get("partial") is True
+    # cancel fired at boundary 1 (before chunk 2); observed at boundary 2:
+    # exactly two chunks' counts were merged, not all n_chunks
+    full = executor.execute_plan(plan, 10, list(plan.arrays))
+    assert int(result["count"]) < int(full["count"])
+
+
+# --- preemption ------------------------------------------------------------
+
+def _trip_overload():
+    OVERLOAD.configure(enabled=True, target_wait_secs=0.01)
+    for _ in range(20):
+        OVERLOAD.note_wait(1.0)
+    assert OVERLOAD.shed_floor() > 0
+
+
+def _clear_overload():
+    OVERLOAD.reset()
+    OVERLOAD.configure(enabled=False, target_wait_secs=0.5)
+
+
+def test_preempt_gate_yields_only_under_ladder_and_higher_class():
+    assert not PREEMPT_GATE.should_yield(0)  # calm ladder: never yield
+    _trip_overload()
+    try:
+        assert not PREEMPT_GATE.should_yield(0)  # nobody higher running
+        with PREEMPT_GATE.running(2):
+            assert PREEMPT_GATE.should_yield(0)
+            assert PREEMPT_GATE.should_yield(1)
+            assert not PREEMPT_GATE.should_yield(2)  # own class: no yield
+        assert not PREEMPT_GATE.should_yield(0)
+    finally:
+        _clear_overload()
+
+
+def test_background_scan_parks_while_interactive_runs(big_reader):
+    """Preemption fairness: a background chunked scan under a tripped
+    ladder parks at its boundary while an interactive query is active,
+    resumes when it finishes, and still returns the exact fused result."""
+    from quickwit_tpu.tenancy.context import TenantContext, tenant_scope
+    plan = lower_request(Term("body", "alpha"), MAPPER, big_reader, [])
+    fused = executor.execute_plan(plan, 10, list(plan.arrays))
+    preempts_before = chunkexec.PREEMPT_TOTAL.get()
+    _trip_overload()
+    release = threading.Event()
+
+    def interactive():
+        with PREEMPT_GATE.running(2):
+            release.wait(5.0)
+
+    thread = threading.Thread(target=interactive, daemon=True)
+    thread.start()
+    try:
+        while not PREEMPT_GATE.should_yield(0):
+            time.sleep(0.005)
+        # let the scan park once, then clear the way mid-wait
+        threading.Timer(0.15, release.set).start()
+        with tenant_scope(TenantContext.for_class("bg", "background")):
+            result = execute_plan_chunked(plan, 10, list(plan.arrays),
+                                          span=POSTING_PAD)
+    finally:
+        release.set()
+        thread.join(timeout=5.0)
+        _clear_overload()
+    _assert_identical(fused, result)
+    assert chunkexec.PREEMPT_TOTAL.get() > preempts_before
+
+
+def test_parked_state_registry_caps_and_evicts():
+    registry = ParkedStateRegistry(tenant_cap_bytes=1000)
+    first = registry.park("t1", 600)
+    second = registry.park("t1", 600)   # over the tenant cap: evicts first
+    assert first.evicted and not second.evicted
+    assert registry.parked_bytes() == 600
+    registry.release(second)
+    assert registry.parked_bytes() == 0
+    registry.release(first)  # releasing an evicted ticket is a no-op
+    assert registry.parked_bytes() == 0
+
+
+# --- batcher cancellation (the shed-before-readback gap) -------------------
+
+def test_batcher_follower_cancel_unblocks_promptly(big_reader):
+    """Regression: a rider cancelled while waiting on the batch leader used
+    to sit out the FULL wait (its deadline plus slack) before erroring.
+    With the cancel-aware wait it unblocks within one poll slice."""
+    plan = lower_request(Term("body", "alpha"), MAPPER, big_reader, [])
+    k = 10
+    batcher = QueryBatcher()
+    key = (plan.signature(k), tuple(plan.array_keys), "split")
+    # a stuck convoy: its leader never dispatches, so our rider waits
+    from quickwit_tpu.search.batcher import _Pending
+    batcher._queues[key] = [_Pending(plan.scalars)]
+    token = CancellationToken()
+    threading.Timer(0.1, lambda: token.cancel("user gave up")).start()
+    t0 = time.monotonic()
+    with deadline_scope(Deadline.after(30.0)), cancel_scope(token):
+        with pytest.raises(CancelledQuery):
+            batcher.execute(plan, k, list(plan.arrays), split_key="split")
+    elapsed = time.monotonic() - t0
+    assert elapsed < 5.0, f"cancelled rider still waited {elapsed:.1f}s"
+
+
+def test_batcher_rejects_pre_cancelled_rider(big_reader):
+    plan = lower_request(Term("body", "alpha"), MAPPER, big_reader, [])
+    batcher = QueryBatcher()
+    token = CancellationToken()
+    token.cancel("already dead")
+    with cancel_scope(token):
+        with pytest.raises(CancelledQuery):
+            batcher.execute(plan, 10, list(plan.arrays), split_key="s")
+
+
+def test_batcher_leader_sheds_cancelled_rider(big_reader):
+    """The convoy leader drops cancelled riders at dispatch time: they get
+    a typed CancelledQuery, live riders still get real results."""
+    plan = lower_request(Term("body", "alpha"), MAPPER, big_reader, [])
+    k = 10
+    batcher = QueryBatcher()
+    dead_token = CancellationToken()
+    results = {}
+
+    def rider(name, token):
+        try:
+            scope = cancel_scope(token) if token is not None else None
+            if scope is not None:
+                with scope:
+                    results[name] = batcher.execute(
+                        plan, k, list(plan.arrays), split_key="s")
+            else:
+                results[name] = batcher.execute(
+                    plan, k, list(plan.arrays), split_key="s")
+        except Exception as exc:  # noqa: BLE001 - recorded for asserts
+            results[name] = exc
+
+    # enqueue the doomed rider as a follower behind a held dispatch lock,
+    # cancel it, then let the leader dispatch for the live one
+    from quickwit_tpu.search.batcher import _Pending, _PriorityLock
+    key = (plan.signature(k), tuple(plan.array_keys), "s")
+    entry = batcher._dispatch_locks.setdefault(key, [_PriorityLock(), 1])
+    entry[0].acquire()  # hold: the leader blocks before dispatching
+    leader = threading.Thread(target=rider, args=("live", None), daemon=True)
+    leader.start()
+    deadline = time.monotonic() + 5.0
+    while key not in batcher._queues and time.monotonic() < deadline:
+        time.sleep(0.005)
+    with cancel_scope(dead_token):
+        batcher._queues[key].append(
+            _Pending(plan.scalars, None, None, dead_token))
+    doomed = batcher._queues[key][-1]
+    dead_token.cancel("rider cancelled in flight")
+    entry[0].release()
+    leader.join(timeout=10.0)
+    assert not isinstance(results.get("live"), Exception)
+    assert int(results["live"]["count"]) > 0
+    assert doomed.event.is_set()
+    assert isinstance(doomed.error, CancelledQuery)
+
+
+# --- adaptive sizing -------------------------------------------------------
+
+def test_chunk_sizer_targets_boundary_interval():
+    sizer = chunkexec._ChunkSizer()
+    assert sizer.span_for("dense", DOC_PAD) is None  # cold: fused path
+    # 1ms per 1024 docs -> ~10ms target wants ~10240 docs, DOC_PAD aligned
+    sizer.observe("dense", 1024, 0.001)
+    span = sizer.span_for("dense", DOC_PAD)
+    assert span is not None and span % DOC_PAD == 0
+    assert 4 * DOC_PAD <= span <= 16 * DOC_PAD
+    # slower observations shrink the span toward the target
+    for _ in range(32):
+        sizer.observe("dense", 1024, 0.1)
+    assert sizer.span_for("dense", DOC_PAD) == DOC_PAD
+
+
+def test_chunk_spans_alignment():
+    assert chunkexec.chunk_spans(2048, 1024, 1024) == [(0, 1024), (1024, 2048)]
+    assert chunkexec.chunk_spans(1100, 128, 128) == [
+        (lo, min(lo + 128, 1100)) for lo in range(0, 1100, 128)]
+    # sub-align spans clamp up to one alignment unit
+    assert chunkexec.chunk_spans(256, 1, 128) == [(0, 128), (128, 256)]

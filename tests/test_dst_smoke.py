@@ -1,6 +1,6 @@
 """Tier-1 DST gate: a small seed sweep of the smoke scenario must pass
 every invariant, deterministically, in simulated time. The full-scale
-mixed-scenario sweep (200 seeds, all eight invariants) rides behind the
+mixed-scenario sweep (200 seeds, the full invariant set) rides behind the
 `slow` marker; CI tiers that run chaos also re-run it there."""
 
 from __future__ import annotations
@@ -25,7 +25,7 @@ def test_smoke_sweep_passes_all_invariants():
 
 def test_mixed_scenario_exercises_full_invariant_set():
     scenario = SCENARIOS["mixed"]
-    assert len(scenario.invariants) == 8
+    assert len(scenario.invariants) == 9
     result = run_scenario(scenario, seed=0,
                           break_publish=False, break_wal=False)
     assert result.ok, [v.to_dict() for v in result.violations]

@@ -291,6 +291,69 @@ def test_impact_metrics_expose_with_strict_grammar():
         before["qw_impact_prefix_cutoffs_total"] == 1
 
 
+def test_chunked_kernel_metrics_expose_with_strict_grammar():
+    """The resumable-chunked-scan families (search/chunkexec.py) plus the
+    REST cancel counter must ride the strict exposition: five counters, one
+    gauge, and one histogram announce HELP/TYPE and their samples parse.
+    Metrics are process-global, so assert on before/after deltas."""
+    from quickwit_tpu.observability.metrics import (
+        CHUNK_BOUNDARY_SECONDS, CHUNK_DISPATCHES_TOTAL,
+        CHUNK_EARLY_TERMINATIONS_TOTAL, CHUNK_RESTARTS_TOTAL,
+        PREEMPT_PARKED_BYTES, PREEMPT_TOTAL, SEARCH_CANCEL_TOTAL,
+    )
+    counter_names = ("qw_chunk_dispatches_total",
+                     "qw_chunk_restarts_total",
+                     "qw_chunk_early_terminations_total",
+                     "qw_preempt_total",
+                     "qw_search_cancel_total")
+
+    def snapshot():
+        parsed = parse_exposition(METRICS.expose_text())
+        return {name: sum(parsed.get(name, {}).values())
+                for name in counter_names}
+
+    before = snapshot()
+    # one boundary-controlled query: 3 chunk dispatches, one restart after
+    # a parked-state eviction, then early termination on the bound
+    CHUNK_DISPATCHES_TOTAL.inc(3)
+    CHUNK_RESTARTS_TOTAL.inc()
+    CHUNK_EARLY_TERMINATIONS_TOTAL.inc()
+    CHUNK_BOUNDARY_SECONDS.observe(0.008)
+    CHUNK_BOUNDARY_SECONDS.observe(0.012)
+    # one preemption that parked 4 KiB of carried state, then released it
+    PREEMPT_TOTAL.inc()
+    PREEMPT_PARKED_BYTES.add(4096.0)
+    PREEMPT_PARKED_BYTES.add(-4096.0)
+    # one accepted REST DELETE cancellation
+    SEARCH_CANCEL_TOTAL.inc()
+
+    text = METRICS.expose_text()
+    parsed = parse_exposition(text)
+    after = snapshot()
+    for name in counter_names:
+        assert name in parsed, f"{name} missing from exposition"
+        assert f"# HELP {name} " in text
+        assert f"# TYPE {name} counter" in text
+    assert "# TYPE qw_preempt_parked_bytes gauge" in text
+    assert "# TYPE qw_chunk_boundary_seconds histogram" in text
+    assert after["qw_chunk_dispatches_total"] - \
+        before["qw_chunk_dispatches_total"] == 3
+    assert after["qw_chunk_restarts_total"] - \
+        before["qw_chunk_restarts_total"] == 1
+    assert after["qw_chunk_early_terminations_total"] - \
+        before["qw_chunk_early_terminations_total"] == 1
+    assert after["qw_preempt_total"] - before["qw_preempt_total"] == 1
+    assert after["qw_search_cancel_total"] - \
+        before["qw_search_cancel_total"] == 1
+    # the gauge sample reflects the net parked bytes (park fully released)
+    assert parsed["qw_preempt_parked_bytes"][()] == PREEMPT_PARKED_BYTES.get()
+    # the boundary histogram keeps the bucket invariant (+Inf == _count)
+    bucket = parsed["qw_chunk_boundary_seconds_bucket"]
+    inf = next(v for k, v in bucket.items() if dict(k).get("le") == "+Inf")
+    assert inf == parsed["qw_chunk_boundary_seconds_count"][()]
+    assert inf >= 2.0
+
+
 def test_hierarchical_cache_metrics_expose_with_strict_grammar():
     """Drive every hierarchical-cache tier (leaf response, term-absence
     predicate cache, predicate-mask, partial-agg) through a real hit, miss,

@@ -92,6 +92,12 @@ def test_pipelined_overlap_beats_sequential(s3_splits, monkeypatch):
 
     monkeypatch.setattr(executor_mod, "execute_plan", slow_execute)
     monkeypatch.setattr(leaf_mod, "execute_plan", slow_execute)
+    # the fake 250ms sleep is per execute_plan CALL: under chunked
+    # execution every chunk would pay it (and poison the adaptive sizer's
+    # latency profile for the rest of the process), which models nothing —
+    # this test measures staging/kernel overlap, so pin the fused path
+    from quickwit_tpu.search.chunkexec import CHUNKING
+    monkeypatch.setattr(CHUNKING, "enabled", False)
     server.latency_fn = lambda method, key: 0.1 if method == "GET" else 0.0
 
     t0 = time.monotonic()

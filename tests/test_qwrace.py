@@ -222,7 +222,7 @@ def test_planted_pool_race_found_and_shrunk(pool_sweep):
 def test_planted_threshold_race_found_and_shrunk(threshold_sweep):
     entry = threshold_sweep["violations"][0]
     assert entry["invariant"] == "data_race"
-    assert entry["seed"] == 1
+    assert entry["seed"] == 2
     details = entry["violation"]["details"]
     assert details["object"].startswith("ThresholdBox")
     assert details["field"] == "value"

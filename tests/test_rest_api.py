@@ -591,3 +591,45 @@ def test_es_search_after_string_sort(api):
         marker = page[-1]["sort"]
     assert len(seen) >= 100  # the whole corpus paged through
     assert seen == sorted(seen)  # ascending by term across pages
+
+
+def test_cancel_route_and_cancel_races_ahead(api):
+    """`DELETE /api/v1/search/<query_id>` cancels by caller-chosen id.
+
+    Cancelling an unknown/finished id is an idempotent no-op (the race
+    against completion is inherent), and a DELETE that lands after the
+    token registers but before the search runs is adopted by root.search:
+    the query comes back as a typed cancelled response with zero hits
+    instead of running to completion.
+    """
+    status, result = api.request("DELETE", "/api/v1/search/no-such-query")
+    assert status == 200
+    assert result == {"query_id": "no-such-query", "cancelled": False}
+
+    # a DELETE that lands while the query's token is registered but before
+    # the search runs: root.search adopts the already-cancelled token
+    from quickwit_tpu.common.deadline import CancellationToken
+    from quickwit_tpu.search.cancel import CANCEL_REGISTRY
+    qid = "rest-cancel-race"
+    CANCEL_REGISTRY.register(qid, CancellationToken())
+    status, result = api.request("DELETE", f"/api/v1/search/{qid}")
+    assert status == 200 and result["cancelled"] is True
+    status, result = api.request(
+        "GET", f"/api/v1/hdfs-logs/search?query=*&query_id={qid}")
+    assert status == 200
+    assert result.get("cancelled") is True
+    assert result["num_hits"] == 0 and result["hits"] == []
+
+    # the registry entry is consumed by the search: a fresh query reusing
+    # the id runs normally (last-writer-wins for retries)
+    status, result = api.request(
+        "GET", f"/api/v1/hdfs-logs/search?query=*&query_id={qid}&max_hits=3")
+    assert status == 200
+    # other module-scoped tests may have ingested extra docs; what matters
+    # is that the reused id runs to completion instead of staying cancelled
+    assert result.get("cancelled") is None and result["num_hits"] >= 100
+
+    # an index literally named "search" would keep its own routes:
+    # non-DELETE methods fall through to the search handlers
+    status, _ = api.request("GET", "/api/v1/search/anything")
+    assert status != 200

@@ -238,6 +238,21 @@ def build_corpus() -> list[ProgramSpec]:
                          mask_key="mask.qwir")
     single("single/v3/mask_override/k10", plan, 10)
 
+    # -- chunked leaf programs (search/chunkexec.py) ---------------------
+    # the resumable scan dispatches one compiled program per doc-block
+    # slab; every chunk of a scan shares ONE program per (mode, span) —
+    # chunk bounds and threshold updates ride scalar inputs, not traced
+    # constants — so the closure grows exactly one entry per chunk mode
+    from quickwit_tpu.index.format import DOC_PAD, POSTING_PAD
+    from quickwit_tpu.search import chunkexec
+    plan = lower_request(term, mapper, readers["v3big"], [])
+    single("chunked/v3big/term_posting/k10",
+           chunkexec.posting_chunk_plan(plan, 0, POSTING_PAD), 10)
+    plan = lower_request(match_all, mapper, readers["v3big"], [],
+                         sort_field="latency", sort_order="desc")
+    single("chunked/v3big/sort_col_dense/k5",
+           chunkexec.dense_chunk_plan(plan, 0, DOC_PAD), 5)
+
     # -- multi-query vmapped programs (one per batch bucket) -------------
     plan = lower_request(term, mapper, readers["v3"], [])
     for bucket in (2, 4):
