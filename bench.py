@@ -674,8 +674,8 @@ def _measure_single_split(request, mapper, reader, iters: int,
 
 def _measure_batch_otel(iters: int, full: bool = True) -> dict:
     """Config #5: duration percentiles across OTEL_SPLITS splits, executed
-    as ONE vmapped XLA program on the chip (the multi-chip structure is
-    exercised by dryrun_multichip on the virtual mesh)."""
+    as ONE vmapped XLA program on the chip (the multi-chip collective
+    version of this shape is scored by c13_multichip)."""
     import jax
     import jax.numpy as jnp
     from quickwit_tpu.common.uri import Uri
@@ -1563,7 +1563,38 @@ def _run_all(iters: int, with_device_loops: bool = True) -> dict:
         results["c12_preemption"] = _measure_preemption()
         print(f"# c12_preemption: "
               f"{json.dumps(results['c12_preemption'])}", file=sys.stderr)
+        c13 = _measure_multichip()
+        if c13 is not None:
+            results["c13_multichip"] = c13
+            print(f"# c13_multichip: {json.dumps(c13)}", file=sys.stderr)
     return results
+
+
+def _measure_multichip() -> "dict | None":
+    """Config #13: the collective root merge vs the host-merge twin at
+    1/2/4/8-device meshes — per-query host round-trips, readback bytes,
+    warm p50/p99, and device≡host bit-identity on the c1 and c5 shapes.
+
+    Runs `__graft_entry__.dryrun_multichip(8)` in a subprocess because the
+    device count must be forced before jax backend init (this process has
+    already initialized whatever platform the bench runs on) and parses
+    its MULTICHIP_SCORED scoreboard line."""
+    entry = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "__graft_entry__.py")
+    try:
+        run = subprocess.run(
+            [sys.executable, entry, "8"],
+            env={**os.environ, "QW_JAX_PLATFORM": "cpu"},
+            capture_output=True, timeout=1200)
+    except subprocess.TimeoutExpired:
+        print("# c13_multichip timed out; omitting", file=sys.stderr)
+        return None
+    for line in run.stdout.decode().splitlines():
+        if line.startswith("MULTICHIP_SCORED "):
+            return json.loads(line[len("MULTICHIP_SCORED "):])
+    print(f"# c13_multichip failed rc={run.returncode}: "
+          f"{run.stderr.decode()[-300:]}", file=sys.stderr)
+    return None
 
 
 def _cpu_reference() -> "dict | None":

@@ -450,3 +450,25 @@ PREEMPT_PARKED_BYTES = METRICS.gauge(
 SEARCH_CANCEL_TOTAL = METRICS.counter(
     "qw_search_cancel_total",
     "Explicit query cancellations accepted via the REST cancel surface")
+
+# --- multi-chip collective root merge (parallel/fanout.py mesh path) --------
+# One dispatch = one whole-query shard_map program: per-device split shards
+# score locally, exchange the running sort-value threshold (pmax), merge
+# top-K (all_gather + re-top-k) and mergeable agg states (psum/pmin/pmax)
+# on-mesh, and read back ONE packed scalar array.
+MESH_DISPATCHES_TOTAL = METRICS.counter(
+    "qw_mesh_dispatches_total",
+    "Whole-query collective programs dispatched over a device mesh")
+MESH_DEVICES = METRICS.gauge(
+    "qw_mesh_devices",
+    "Devices (splits axis x docs axis) of the most recent mesh dispatch")
+# Logical payload bytes, not wire bytes: each collective's operand size
+# summed once per dispatch (all_gather candidates + psum/pmin/pmax agg,
+# count, and certificate payloads + the threshold-exchange scalar). Wire
+# amplification is topology-dependent and deliberately out of scope.
+MESH_COLLECTIVE_BYTES_TOTAL = METRICS.counter(
+    "qw_mesh_collective_bytes_total",
+    "Logical payload bytes moved by on-mesh collectives per dispatch")
+MESH_THRESHOLD_EXCHANGE_ROUNDS_TOTAL = METRICS.counter(
+    "qw_mesh_threshold_exchange_rounds_total",
+    "Cross-device sort-threshold all-reduce (pmax) rounds executed")

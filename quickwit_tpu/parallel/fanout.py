@@ -392,6 +392,149 @@ def batch_fn(batch: SplitBatch, k: int, exact: bool = False):
     return fn
 
 
+def _mesh_axes(mesh: Mesh) -> tuple[str, Optional[str]]:
+    """(split_axis_name, doc_axis_name) of a fanout mesh. Axis names come
+    from the mesh itself (not hard-coded literals) so qwir's R4 planted-
+    defect fixtures can trace the SAME program builder over a mis-named
+    mesh and watch the rule fire."""
+    names = mesh.axis_names
+    return names[0], (names[1] if len(names) > 1 else None)
+
+
+def _usable_mesh(batch: SplitBatch, mesh: Optional[Mesh]) -> Optional[Mesh]:
+    """A mesh the batch can actually shard over, else None (single-device
+    host-merge degenerate). NamedSharding refuses ragged dimension-0
+    shards outright, so a split axis that does not divide the batch has
+    no partial fallback — the service's `device_mesh` only hands out
+    dividing axes; this guards direct `execute_batch`/staging callers."""
+    if mesh is None:
+        return None
+    split_ax, _doc_ax = _mesh_axes(mesh)
+    return mesh if batch.n_splits % mesh.shape[split_ax] == 0 else None
+
+
+def _merge_agg_collective(agg_out, split_ax: str):
+    """`_merge_agg_stack`'s collective twin: the local [local_n, ...] stack
+    reduces over axis 0 on each device, then the SAME per-leaf combiner
+    runs once more across the split mesh axis (psum / pmin / pmax), so the
+    merged states land replicated on every device — no host merge.
+
+    Exactness: counts, bucket tallies, and HLL registers are integral-
+    valued, so f64 reduction re-association cannot change them; float
+    metric sums reassociate across the device tree exactly like the host
+    `jnp.sum` already could across lanes (docs/multichip.md spells out the
+    contract the equivalence suite pins with integral fixtures)."""
+    from jax import lax
+
+    def red(path, leaf):
+        name = None
+        for p in reversed(path):
+            if hasattr(p, "key"):
+                name = p.key
+                break
+        if name == "min":
+            return lax.pmin(jnp.min(leaf, axis=0), split_ax)
+        if name in ("max", "hll"):  # HLL registers merge by max too
+            return lax.pmax(jnp.max(leaf, axis=0), split_ax)
+        if name == "stats":
+            # state vector [count, sum, sum_sq, min, max]: first three add
+            return jnp.concatenate([
+                lax.psum(jnp.sum(leaf[:, :3], axis=0), split_ax),
+                lax.pmin(jnp.min(leaf[:, 3:4], axis=0), split_ax),
+                lax.pmax(jnp.max(leaf[:, 4:5], axis=0), split_ax),
+            ])
+        return lax.psum(jnp.sum(leaf, axis=0), split_ax)
+    return jax.tree_util.tree_map_with_path(red, agg_out)
+
+
+def mesh_batch_fn(batch: SplitBatch, k: int, mesh: Mesh, exact: bool = False):
+    """The whole query as ONE explicitly-collective SPMD program
+    (shard_map): each device scores its split shard with the vmapped
+    per-split kernel, then the root merge — formerly host Python in
+    search/collector.py — runs on-mesh:
+
+      1. threshold exchange: each device's k-th best primary sort value is
+         all-reduce-max'd (`pmax`) across the split axis. The max of the
+         per-device k-th values lower-bounds the global k-th value (the
+         winning device already holds k candidates at or above it), so
+         every candidate STRICTLY below it is provably outside the global
+         top-K and is masked to -inf — the cross-device analogue of
+         ops/topk.apply_threshold_mask's `>=`-keeps-ties rule, composing
+         with the cross-chunk threshold the collector threads between
+         dispatches.
+      2. top-K merge: surviving candidates `all_gather` along the split
+         axis — device order equals split order under the P("splits")
+         input sharding, so the concatenation is split-major and
+         `lax.top_k`'s lowest-index tie-break reproduces the collector's
+         (key desc, split_id asc, doc asc) total order bit-for-bit, the
+         same argument as the host `batch_fn` merge (2-key sorts ride
+         `exact_topk_2key` over the gathered pairs).
+      3. agg + count reduce: mergeable agg states, hit counts, and the
+         guided-top-k certificate reduce via psum/pmin/pmax.
+
+    The doc mesh axis shards dense column storage at rest
+    (`batch_shardings`); compute replicates along it here, so collectives
+    bind only the split axis and every docs replica holds identical
+    results — out_specs are fully replicated. One dispatch, one packed
+    scalar readback."""
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+
+    template = batch.template
+    single_fn = executor_mod._build(template, k, exact)
+    split_ax, _doc_ax = _mesh_axes(mesh)
+    axis_splits = mesh.shape[split_ax]
+    if batch.n_splits % axis_splits:
+        raise ValueError(
+            f"n_splits={batch.n_splits} does not shard over the "
+            f"{axis_splits}-way {split_ax!r} mesh axis (pad the batch)")
+
+    def shard_body(arrays, scalars, num_docs):
+        results = jax.vmap(single_fn)(arrays, scalars, num_docs)
+        sort_vals, sort_vals2, doc_ids, hit_scores, counts, topk_safe, \
+            agg_out = results
+        total = lax.psum(jnp.sum(counts), split_ax)
+        # one certificate for the whole batch (see batch_fn): pmin is the
+        # cross-device jnp.min
+        safe = lax.pmin(jnp.min(topk_safe), split_ax)
+        merged = _merge_agg_collective(agg_out, split_ax)
+        if k == 0:  # count/agg-only: no candidates to exchange or gather
+            empty_i = jnp.zeros((0,), jnp.int32)
+            return (jnp.zeros((0,), sort_vals.dtype), None, empty_i, empty_i,
+                    jnp.zeros((0,), hit_scores.dtype), total, safe, merged)
+        flat = sort_vals.reshape(-1)          # [local_n * k], split-major
+        neg_inf = jnp.asarray(-jnp.inf, flat.dtype)
+        # -- threshold exchange (one pmax round per dispatch) ------------
+        local_kth = lax.top_k(flat, k)[0][k - 1]
+        threshold = lax.pmax(local_kth, split_ax)
+        keep = flat >= threshold              # >= keeps threshold ties
+        flat = jnp.where(keep, flat, neg_inf)
+        # -- split-axis gather + re-top-k --------------------------------
+        g_vals = lax.all_gather(flat, split_ax, axis=0, tiled=True)
+        g_ids = lax.all_gather(doc_ids.reshape(-1), split_ax,
+                               axis=0, tiled=True)
+        g_scores = lax.all_gather(hit_scores.reshape(-1), split_ax,
+                                  axis=0, tiled=True)
+        if sort_vals2 is None:
+            top_vals, pos = lax.top_k(g_vals, k)
+            top_vals2 = None
+        else:
+            flat2 = jnp.where(keep, sort_vals2.reshape(-1), neg_inf)
+            g_vals2 = lax.all_gather(flat2, split_ax, axis=0, tiled=True)
+            from ..ops import topk as topk_ops
+            top_vals, top_vals2, pos = topk_ops.exact_topk_2key(
+                g_vals, g_vals2, k)
+        split_idx = (pos // k).astype(jnp.int32)
+        return (top_vals, top_vals2, split_idx, g_ids[pos], g_scores[pos],
+                total, safe, merged)
+
+    in_arrays = tuple(P(split_ax) for _ in batch.arrays)
+    in_scalars = tuple(P(split_ax) for _ in batch.scalars)
+    return shard_map(shard_body, mesh=mesh,
+                     in_specs=(in_arrays, in_scalars, P(split_ax)),
+                     out_specs=P(), check_rep=False)
+
+
 def batch_cache_key(batch: SplitBatch, k: int, mesh: Optional[Mesh],
                     exact: bool = False) -> tuple:
     """The `_BATCH_JIT_CACHE` key `dispatch_batch` uses, post k-clamp —
@@ -407,13 +550,29 @@ def abstract_batch_program(batch: SplitBatch, k: int, exact: bool = False):
     minus the packed f64 readback concat) — abstract-traced over
     ShapeDtypeStructs, never compiled or executed, no mesh required.
 
-    The mesh variant jits the SAME closure with NamedShardings; GSPMD
-    inserts its collectives after this jaxpr, so collective-soundness
-    auditing (qwir R4) checks explicit shard_map/collective eqns here and
-    proves the named-axis contract on the declared ("splits", "docs")
-    axes."""
+    The mesh dispatch path no longer relies on GSPMD inference — it jits
+    the explicitly-collective `mesh_batch_fn`; use
+    `abstract_mesh_batch_program` to audit that one."""
     k = min(max(0, k), batch.num_docs_padded)
     fn = batch_fn(batch, k, exact)
+    arrays = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                   for a in batch.arrays)
+    scalars = tuple(jax.ShapeDtypeStruct(s.shape, s.dtype)
+                    for s in batch.scalars)
+    nd = jax.ShapeDtypeStruct(batch.num_docs.shape, batch.num_docs.dtype)
+    return jax.make_jaxpr(fn)(arrays, scalars, nd)
+
+
+def abstract_mesh_batch_program(batch: SplitBatch, k: int, mesh: Mesh,
+                                exact: bool = False):
+    """ClosedJaxpr of the collective whole-query program (`mesh_batch_fn`,
+    minus the packed f64 readback concat) — abstract-traced, never
+    compiled or executed. Unlike `abstract_batch_program`, the collectives
+    here are EXPLICIT eqns (shard_map + psum/pmax/pmin/all_gather), which
+    is what makes qwir R4's mesh-axis rule load-bearing: every collective
+    must bind axes declared by the program's ProgramSpec."""
+    k = min(max(0, k), batch.num_docs_padded)
+    fn = mesh_batch_fn(batch, k, mesh, exact)
     arrays = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
                    for a in batch.arrays)
     scalars = tuple(jax.ShapeDtypeStruct(s.shape, s.dtype)
@@ -432,28 +591,63 @@ QWIR_CERTIFIED_F64 = {
         "batch_fn's cross-split merge: lax.top_k / exact_topk_2key over "
         "the flattened [n_splits*k] per-split winners — bounded by fan-out "
         "times page size, never by corpus size."),
+    "shard_body": (
+        "mesh_batch_fn's on-mesh root merge: the same cross-split "
+        "re-top-k as batch_fn over the all_gather'd [n_splits*k] "
+        "threshold-surviving winners, plus the k-element threshold "
+        "exchange sort — bounded by fan-out times page size."),
 }
 
 
-def _donate_batch_inputs() -> bool:
+def _donate_batch_inputs(mesh: Optional[Mesh] = None) -> bool:
     """Donate the stacked batch arrays to the executor so XLA reuses their
     HBM as scratch: the stacks are per-request copies of the column data
     (the resident per-split arrays are NOT what is donated) and are
     invalidated after the dispatch that consumed them. CPU PJRT does not
-    implement donation and warns per compile, so gate on backend."""
-    return jax.default_backend() != "cpu"
+    implement donation and warns per compile, so gate on backend. Mesh
+    dispatches never donate: their staged tuples may alias mesh-resident
+    column stacks (`_stage_resident_stack`) that must survive the query —
+    and the decision is baked into the cached jit, which is keyed only on
+    (signature, n_splits, padded, mesh, exact), not on residency."""
+    return mesh is None and jax.default_backend() != "cpu"
+
+
+def _collective_payload_bytes(shaped, k: int, n_splits: int) -> int:
+    """Logical bytes the mesh program's collectives carry per dispatch
+    (`qw_mesh_collective_bytes_total` semantics): all_gather candidates +
+    the reduced agg/count/certificate leaves + the 8-byte threshold
+    exchange. `shaped` is the eval_shape output tree of `mesh_batch_fn`."""
+    has2 = shaped[1] is not None
+    gather = 0 if k == 0 else n_splits * k * (8 + (8 if has2 else 0) + 4 + 4)
+    reduced = sum(int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+                  for leaf in jax.tree_util.tree_leaves(shaped[-1]))
+    reduced += 4 + 8                        # total count + safe certificate
+    exchange = 0 if k == 0 else 8           # one pmax'd f64 scalar
+    return gather + reduced + exchange
 
 
 def _batch_executor(batch: SplitBatch, k: int, mesh: Optional[Mesh],
                     example_args, exact: bool = False):
-    """(jitted_packed_fn, treedef, spec): the merged result tree rides ONE
-    f64 device array so the readback is a single transfer (see
-    executor.py packed-readback rationale; exactness argument identical)."""
-    fn = batch_fn(batch, k, exact)
+    """(jitted_packed_fn, treedef, spec, meta): the merged result tree
+    rides ONE f64 device array so the readback is a single transfer (see
+    executor.py packed-readback rationale; exactness argument identical).
+
+    With a mesh, the jitted program is the explicitly-collective
+    `mesh_batch_fn` (the whole root merge on-device); without one it is
+    the host-degenerate `batch_fn`. Callers never reach here with a mesh
+    whose split axis does not divide the batch: `_usable_mesh` drops such
+    meshes to the single-device path at dispatch time (NamedSharding
+    rejects ragged dimension-0 shards at staging, so there is no partial
+    fallback to salvage)."""
+    collective = mesh is not None
+    fn = (mesh_batch_fn(batch, k, mesh, exact) if collective
+          else batch_fn(batch, k, exact))
     shaped = jax.eval_shape(fn, *example_args)
     treedef = jax.tree_util.tree_structure(shaped)
     spec = [(leaf.shape, leaf.dtype)
             for leaf in jax.tree_util.tree_leaves(shaped)]
+    meta = {"collective_bytes": _collective_payload_bytes(
+        shaped, k, batch.n_splits)} if collective else None
 
     def packed(arrays, scalars, num_docs):
         out = fn(arrays, scalars, num_docs)
@@ -461,21 +655,143 @@ def _batch_executor(batch: SplitBatch, k: int, mesh: Optional[Mesh],
                 for leaf in jax.tree_util.tree_leaves(out)]
         return jnp.concatenate(flat) if flat else jnp.zeros((0,))
 
-    donate = (0,) if _donate_batch_inputs() else ()
+    donate = (0,) if _donate_batch_inputs(mesh) else ()
     if mesh is None:
-        return jax.jit(packed, donate_argnums=donate), treedef, spec
+        return jax.jit(packed, donate_argnums=donate), treedef, spec, meta
     arrays_sh, scalars_sh, nd_sh = batch_shardings(batch, mesh)
     return (jax.jit(packed, in_shardings=(arrays_sh, scalars_sh, nd_sh),
                     donate_argnums=donate),
-            treedef, spec)
+            treedef, spec, meta)
 
 
-def stage_device_inputs(batch: SplitBatch, mesh: Optional[Mesh] = None):
+# Column-family slots are query-independent given the split set: packed
+# fast-field values, fieldnorms, and their zonemaps derive only from the
+# readers and the batch-uniform padded size (even batch-global ordinal
+# spaces: the terms dictionary union is over the SPLIT SET, not the
+# query). Postings ("pre."/"post.") and masks are query-shaped — for
+# format v3 threshold pushdown even re-sliced per threshold — so they
+# stream per request and are never stack-resident.
+_STACK_RESIDENT_PREFIXES = ("col.", "norm.")
+
+
+def stack_resident_slots(batch: SplitBatch) -> list[int]:
+    """Array slots eligible for the cross-query mesh-resident stack."""
+    return [slot for slot, key in enumerate(batch.template.array_keys)
+            if key.startswith(_STACK_RESIDENT_PREFIXES)]
+
+
+def per_device_bytes(batch: SplitBatch, mesh: Optional[Mesh],
+                     exclude_stack_resident: bool = False) -> int:
+    """The PER-DEVICE HBM footprint of the staged batch — what tenant-DRR
+    admission should pin when the stacks shard over a mesh. Dense column
+    slots divide across both axes (P("splits", "docs")); everything else
+    divides across the split axis only (`batch_shardings`). Without a
+    mesh this is the full single-device byte count the seed admitted.
+
+    `exclude_stack_resident` drops the column-family slots: when the
+    mesh-resident stack store is active those bytes are admitted under
+    the stack owner by `stage_device_inputs` (and stay resident after the
+    query), so admitting them under the per-request batch owner too would
+    double-pin warm queries."""
+    if mesh is None:
+        return sum(a.nbytes for a in batch.arrays)
+    split_ax, doc_ax = _mesh_axes(mesh)
+    n_sp = mesh.shape[split_ax]
+    n_doc = mesh.shape.get(doc_ax, 1) if doc_ax else 1
+    resident = set(stack_resident_slots(batch)) if exclude_stack_resident \
+        else set()
+    total = 0
+    for slot, (key, a) in enumerate(zip(batch.template.array_keys,
+                                        batch.arrays)):
+        if slot in resident:
+            continue
+        if key.startswith(("col.", "norm.")) \
+                and not key.endswith((".zmin", ".zmax")):
+            total += -(-a.nbytes // (n_sp * n_doc))
+        else:
+            total += -(-a.nbytes // n_sp)
+    total += sum(-(-s.nbytes // n_sp) for s in batch.scalars)
+    total += batch.num_docs.nbytes
+    return total
+
+
+def release_stack_pin(batch: SplitBatch, budget) -> None:
+    """Release the mesh-resident stack's admission pin taken by
+    `stage_device_inputs`. The default `release` leaves the bytes RESIDENT
+    (the owner carries `_device_array_cache`), so the stack survives for
+    the next warm query; LRU pressure evicts it through HbmBudget's
+    existing owner seam."""
+    pin = getattr(batch, "_mesh_stack_pin", None)
+    if pin is None:
+        return
+    batch._mesh_stack_pin = None
+    owner, admitted = pin
+    budget.release(owner, admitted)
+
+
+def _stage_resident_stack(batch: SplitBatch, mesh: Mesh, arrays_sh,
+                          store, budget) -> dict[int, Any]:
+    """Serve the column-family slots from (and populate) the cross-query
+    mesh-resident stack: slot → committed sharded device array. A warm
+    repeat query finds every column slot resident and uploads ZERO column
+    bytes to ANY chip; per-device byte accounting rides the existing
+    HbmBudget owner seam (admit under the stack owner, release-to-resident
+    after the query via `release_stack_pin`)."""
+    from ..search.residency import mesh_stack_id
+    split_ax, doc_ax = _mesh_axes(mesh)
+    n_sp = mesh.shape[split_ax]
+    n_doc = mesh.shape.get(doc_ax, 1) if doc_ax else 1
+    stack_id = mesh_stack_id(batch.split_ids, batch.num_docs_padded, mesh)
+    owner = store.columns_for(stack_id)
+    dcache = owner._device_array_cache
+    slots = stack_resident_slots(batch)
+    entries = []
+    for slot in slots:
+        key = batch.template.array_keys[slot]
+        arr = batch.arrays[slot]
+        # shape+dtype in the key: format-version packings (u8/u16 FOR
+        # lanes) and padding buckets must never alias
+        entries.append((slot, (key, arr.shape, str(arr.dtype))))
+    missing = [(slot, ck) for slot, ck in entries if ck not in dcache]
+    per_dev = 0
+    for slot, _ck in missing:
+        key = batch.template.array_keys[slot]
+        nbytes = batch.arrays[slot].nbytes
+        if key.endswith((".zmin", ".zmax")):
+            per_dev += -(-nbytes // n_sp)
+        else:
+            per_dev += -(-nbytes // (n_sp * n_doc))
+    admitted = budget.admit(owner, per_dev) if budget is not None else 0
+    try:
+        if missing:
+            for slot, ck in missing:
+                dcache[ck] = jax.device_put(batch.arrays[slot],
+                                            arrays_sh[slot])
+            store.note_upload(stack_id, per_dev, len(missing))
+            store.note_hits(len(slots) - len(missing), full=False)
+        elif slots:
+            store.note_hits(len(slots), full=True)
+        batch._mesh_stack_pin = (owner, admitted)
+        return {slot: dcache[ck] for slot, ck in entries}
+    except BaseException:
+        if budget is not None:
+            budget.release(owner, admitted, to_resident=False)
+        raise
+
+
+def stage_device_inputs(batch: SplitBatch, mesh: Optional[Mesh] = None,
+                        resident_store=None, budget=None):
     """Start the batch's host→device transfer (async under JAX dispatch)
     and cache the device arrays on the batch for repeat queries — keyed by
     mesh: arrays committed for one sharding must not feed an executor
     compiled for another. Callable from a prefetch thread so the transfer
-    overlaps the previous batch's kernel execution."""
+    overlaps the previous batch's kernel execution.
+
+    With a mesh and a resident store, column-family slots are served from
+    the cross-query mesh stack (`_stage_resident_stack`): only the
+    query-shaped slots (postings, scalars, doc counts) ride this request's
+    upload."""
+    mesh = _usable_mesh(batch, mesh)
     cache = getattr(batch, "_device_inputs", None)
     if cache is None:
         cache = batch._device_inputs = {}
@@ -490,7 +806,16 @@ def stage_device_inputs(batch: SplitBatch, mesh: Optional[Mesh] = None):
                 rec["stage"] = "batch"
         return dev
     if dev is None:
-        staging_bytes = (sum(a.nbytes for a in batch.arrays)
+        arrays_sh = scalars_sh = nd_sh = None
+        if mesh is not None:
+            arrays_sh, scalars_sh, nd_sh = batch_shardings(batch, mesh)
+        resident: dict[int, Any] = {}
+        if (mesh is not None and resident_store is not None
+                and getattr(resident_store, "enabled", False)):
+            resident = _stage_resident_stack(batch, mesh, arrays_sh,
+                                             resident_store, budget)
+        staging_bytes = (sum(a.nbytes for slot, a in enumerate(batch.arrays)
+                             if slot not in resident)
                          + sum(s.nbytes for s in batch.scalars)
                          + batch.num_docs.nbytes)
         # staging times the transfer DISPATCH (device_put is async;
@@ -501,8 +826,10 @@ def stage_device_inputs(batch: SplitBatch, mesh: Optional[Mesh] = None):
                 rec["bytes"] = staging_bytes
                 rec["stage"] = "batch"
             if mesh is not None:
-                arrays_sh, scalars_sh, nd_sh = batch_shardings(batch, mesh)
-                arrays = tuple(jax.device_put(batch.arrays, list(arrays_sh)))
+                arrays = tuple(
+                    resident[slot] if slot in resident
+                    else jax.device_put(a, arrays_sh[slot])
+                    for slot, a in enumerate(batch.arrays))
                 scalars = tuple(jax.device_put(batch.scalars,
                                                list(scalars_sh))) \
                     if batch.scalars else ()
@@ -518,37 +845,71 @@ def stage_device_inputs(batch: SplitBatch, mesh: Optional[Mesh] = None):
     return dev
 
 
-# Mesh programs contain cross-device collectives (the on-mesh merge's
-# psums/all-reduces). Two such programs enqueued concurrently from
-# different query threads can interleave their per-device rendezvous
+# Mesh programs contain cross-device collectives (the on-mesh root
+# merge's psums/all-reduces). Two such programs enqueued concurrently
+# from different query threads can interleave their per-device rendezvous
 # (thread A first on device 0, thread B first on device 1) and deadlock —
 # observed as 5s+ AllReduceParticipantData stalls under the soak suite's
 # 8-thread storm on the 8-fake-device CPU host platform. Enqueue is
 # therefore serialized; on real hardware the per-device streams then
-# execute programs in one consistent order and the enqueue itself is a
-# cheap async launch. The CPU host platform has NO ordered streams (a
-# shared thread pool with data-dependency ordering only), so there the
-# program must also COMPLETE before the lock releases. Single-device
-# dispatches (mesh is None) carry no collectives and take no lock.
+# execute programs in one consistent order, the enqueue itself is a cheap
+# async launch, and the lock releases immediately. The CPU host platform
+# has NO ordered streams (a shared thread pool with data-dependency
+# ordering only), so there the critical section must span enqueue →
+# completion: `_enqueue_batch` returns the still-held lock as a guard and
+# the caller releases it AFTER awaiting the program (`readback_batch`'s
+# device_get, or `abandon_dispatch` on the deadline-shed path) — the
+# blocking wait itself runs OUTSIDE any lexical lock scope, so waiters
+# queue on the guard, not on a device round-trip hidden inside a `with`
+# block. Single-device dispatches (mesh is None) carry no collectives and
+# take no lock.
 # qwlint: disable-next-line=QW008 - leaf lock by design: the critical
-# section is a jax enqueue (+ block_until_ready on CPU), never a seam
-# primitive, so the gated qwrace scheduler cannot preempt inside it and
-# instrumenting it would only serialize jax dispatch behind the token
+# section is a jax enqueue (hardware) or enqueue→completion (CPU host
+# platform), never a seam primitive, so the gated qwrace scheduler cannot
+# preempt inside it and instrumenting it would only serialize jax
+# dispatch behind the token
 _MESH_DISPATCH_LOCK = threading.Lock()
 
 
 def _enqueue_batch(ex, arrays, scalars, nd, mesh):
+    """Enqueue one batch program; returns (out, guard). `guard` is the
+    still-held `_MESH_DISPATCH_LOCK` on the CPU host platform (the caller
+    MUST hand it to `_finish_mesh_dispatch` once the program has been
+    awaited), None otherwise."""
     if mesh is None:
-        return ex(arrays, scalars, nd)
-    with _MESH_DISPATCH_LOCK:
+        return ex(arrays, scalars, nd), None
+    _MESH_DISPATCH_LOCK.acquire()
+    try:
         out = ex(arrays, scalars, nd)
-        if jax.default_backend() == "cpu":
-            # qwlint: disable-next-line=QW007 — the block IS the point: with
-            # no ordered streams on the CPU host platform, releasing the lock
-            # before the program completes re-opens the collective-rendezvous
-            # interleave deadlock this lock exists to prevent (see above)
+    except BaseException:
+        _MESH_DISPATCH_LOCK.release()
+        raise
+    if jax.default_backend() != "cpu":
+        _MESH_DISPATCH_LOCK.release()
+        return out, None
+    return out, _MESH_DISPATCH_LOCK
+
+
+def _finish_mesh_dispatch(guard, out=None) -> None:
+    """Complete the cross-procedural mesh-dispatch critical section: await
+    the program if the caller has not already (readback's `device_get`
+    subsumes the wait, so it passes out=None), then release the guard."""
+    if guard is None:
+        return
+    try:
+        if out is not None:
             jax.block_until_ready(out)
-        return out
+    finally:
+        guard.release()
+
+
+def abandon_dispatch(dispatched) -> None:
+    """Deadline-shed seam: the dispatch flew but nobody will await its
+    readback. The mesh-dispatch guard (CPU host platform) must still see
+    the program complete before the next collective program may enqueue;
+    device buffers die with their last reference."""
+    out, _treedef, _spec, _ctx, guard = dispatched
+    _finish_mesh_dispatch(guard, out)
 
 
 def dispatch_batch(batch: SplitBatch, request: SearchRequest,
@@ -563,6 +924,7 @@ def dispatch_batch(batch: SplitBatch, request: SearchRequest,
     # an enqueue nobody will read (the readback seam checks again)
     from ..common.deadline import check_cancelled
     check_cancelled("batch dispatch")
+    mesh = _usable_mesh(batch, mesh)
     # k=0 (count/agg-only): per-split executors skip keying/top-k and the
     # batch merge skips the cross-split top_k
     k = min(request.start_offset + request.max_hits, batch.num_docs_padded)
@@ -582,8 +944,8 @@ def dispatch_batch(batch: SplitBatch, request: SearchRequest,
             cached = _batch_executor(batch, k, mesh, (arrays, scalars, nd),
                                      exact)
             _BATCH_JIT_CACHE[key] = cached
-        ex, treedef, spec = cached
-        out = _enqueue_batch(ex, arrays, scalars, nd, mesh)
+        ex, treedef, spec, meta = cached
+        out, guard = _enqueue_batch(ex, arrays, scalars, nd, mesh)
     else:
         # Compile-vs-execute attribution (same lazy-jit approximation as
         # executor.dispatch_plan): on a batch-jit-cache MISS the first call
@@ -597,17 +959,31 @@ def dispatch_batch(batch: SplitBatch, request: SearchRequest,
                 cached = _batch_executor(batch, k, mesh,
                                          (arrays, scalars, nd), exact)
                 _BATCH_JIT_CACHE[key] = cached
-            ex, treedef, spec = cached
-            out = _enqueue_batch(ex, arrays, scalars, nd, mesh)
-    if _donate_batch_inputs():
-        # the stacked inputs were donated into this dispatch — drop the
-        # staging-cache entry so nothing touches the dead buffers
-        cache = getattr(batch, "_device_inputs", None)
-        if cache is not None:
-            cache.pop(mesh, None)
-    if hasattr(out, "copy_to_host_async"):
-        out.copy_to_host_async()
-    return out, treedef, spec, (batch, request, mesh, k)
+            ex, treedef, spec, meta = cached
+            out, guard = _enqueue_batch(ex, arrays, scalars, nd, mesh)
+    try:
+        if meta is not None:
+            from ..observability.metrics import (
+                MESH_COLLECTIVE_BYTES_TOTAL, MESH_DEVICES,
+                MESH_DISPATCHES_TOTAL, MESH_THRESHOLD_EXCHANGE_ROUNDS_TOTAL,
+            )
+            MESH_DISPATCHES_TOTAL.inc()
+            MESH_DEVICES.set(mesh.size)
+            MESH_COLLECTIVE_BYTES_TOTAL.inc(meta["collective_bytes"])
+            if k > 0:
+                MESH_THRESHOLD_EXCHANGE_ROUNDS_TOTAL.inc()
+        if _donate_batch_inputs(mesh):
+            # the stacked inputs were donated into this dispatch — drop the
+            # staging-cache entry so nothing touches the dead buffers
+            cache = getattr(batch, "_device_inputs", None)
+            if cache is not None:
+                cache.pop(mesh, None)
+        if hasattr(out, "copy_to_host_async"):
+            out.copy_to_host_async()
+    except BaseException:
+        _finish_mesh_dispatch(guard, out)
+        raise
+    return out, treedef, spec, (batch, request, mesh, k), guard
 
 
 def readback_batch(dispatched) -> LeafSearchResponse:
@@ -615,17 +991,31 @@ def readback_batch(dispatched) -> LeafSearchResponse:
     readback, unpack, host-decode the merged hits/aggs. A `safe == 0`
     guided-top-k certificate triggers one exact re-execution of the whole
     batch (see ops/topk.py:guided_topk)."""
-    out, treedef, spec, (batch, request, mesh, k) = dispatched
+    out, treedef, spec, (batch, request, mesh, k), guard = dispatched
     # the dispatch already flew; a cancel landing in between still saves
-    # the device->host transfer wait
+    # the device->host transfer wait (the mesh-dispatch guard must still
+    # observe completion before releasing — abandon, then re-raise)
     from ..common.deadline import check_cancelled
-    check_cancelled("batch readback")
+    try:
+        check_cancelled("batch readback")
+    except BaseException:
+        _finish_mesh_dispatch(guard, out)
+        raise
     profile = current_profile()
-    if profile is None:
-        packed = jax.device_get(out)
-    else:
-        with profile.phase(PHASE_EXECUTE, stage="readback"):
+    try:
+        if profile is None:
             packed = jax.device_get(out)
+        else:
+            with profile.phase(PHASE_EXECUTE, stage="readback"):
+                packed = jax.device_get(out)
+    except BaseException:
+        _finish_mesh_dispatch(guard, out)
+        raise
+    # device_get returned only after the program ran to completion — the
+    # cross-procedural critical section ends here, BEFORE any exact
+    # re-dispatch below re-enters _enqueue_batch (the lock is not
+    # re-entrant)
+    _finish_mesh_dispatch(guard)
     leaves = []
     offset = 0
     for shape, dtype in spec:

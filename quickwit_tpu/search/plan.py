@@ -242,7 +242,7 @@ class CompositeSourceExec:
     missing_bucket: bool = False
     after_slot: int = -1      # traced i32 scalar (plan.has_after only)
 
-    def sig(self) -> str:
+    def sig(self) -> str:  # qwlint: disable=QW001 - int() of a python bool dataclass field into the signature string; runs at plan-build time on host
         return (f"csrc({self.kind},{self.values_slot},{self.present_slot},"
                 f"{self.origin_slot},{self.interval_slot},"
                 f"{int(self.missing_bucket)},{self.after_slot})")
@@ -268,14 +268,14 @@ class CompositeAggExec:
     subs: tuple["BucketAggExec", ...] = ()
     host_info: Any = None     # per-source decode info (not jit-relevant)
 
-    def sig(self) -> str:
+    def sig(self) -> str:  # qwlint: disable=QW001 - int() of a python bool dataclass field into the signature string; runs at plan-build time on host
         return (f"cagg({self.size},{int(self.has_after)},"
                 + ",".join(s.sig() for s in self.sources) + ";"
                 + ",".join(m.sig() for m in self.metrics) + ";"
                 + ",".join(s.sig() for s in self.subs) + ")")
 
 
-def coerce_numeric_bound(field_type: FieldType, value: Any):
+def coerce_numeric_bound(field_type: FieldType, value: Any):  # qwlint: disable=QW001 - coerces user query-JSON bounds (python str/int/float); no device value can reach here
     """Numeric range-bound coercion shared by the leaf lowering
     (`_parse_bound`) and the root's zonemap pruning
     (`root.extract_numeric_constraints`) — the two MUST stay identical or
@@ -291,7 +291,7 @@ def coerce_numeric_bound(field_type: FieldType, value: Any):
     return parsed
 
 
-def aligned_origin(vmin, interval, offset=0):
+def aligned_origin(vmin, interval, offset=0):  # qwlint: disable=QW001 - float() of the np.floor host scalar over column min/max stats, pre-dispatch
     """ES bucket alignment shared by every histogram lowering (plain and
     composite): the bucket boundary k*interval + offset at or below vmin.
     Exact integer math for date micros, float for numeric histograms."""
@@ -408,7 +408,7 @@ class _Builder:
         self.scalars: list[np.ndarray] = []
         self._array_slots: dict[str, int] = {}
 
-    def add_array(self, key: str, fetch) -> int:
+    def add_array(self, key: str, fetch) -> int:  # qwlint: disable=QW001 - np.asarray stages host column data into the plan's jit-input tuple; columns are numpy by the reader contract
         """Deduplicated array slot; `fetch()` runs only on first use."""
         slot = self._array_slots.get(key)
         if slot is None:
@@ -418,7 +418,7 @@ class _Builder:
             self._array_slots[key] = slot
         return slot
 
-    def add_scalar(self, value, dtype) -> int:
+    def add_scalar(self, value, dtype) -> int:  # qwlint: disable=QW001 - np.asarray on python/numpy plan scalars being staged as jit inputs, pre-dispatch
         self.scalars.append(np.asarray(value, dtype=dtype))
         return len(self.scalars) - 1
 
@@ -629,7 +629,7 @@ class Lowering:
         return PPostings(ids_slot, tfs_slot, True, norm_slot, idf_slot,
                          avg_slot, impact_ordered=impact)
 
-    def _precomputed_node(self, key: str, ids: np.ndarray, freqs: np.ndarray,
+    def _precomputed_node(self, key: str, ids: np.ndarray, freqs: np.ndarray,  # qwlint: disable=QW001 - int() of the host-side document frequency from reader metadata when minting the idf scalar
                           field: str, scoring: bool, boost: float,
                           df_for_idf: int) -> Any:
         from ..index.format import POSTING_PAD, pad_to
@@ -700,7 +700,7 @@ class Lowering:
         zmax_slot = self.b.add_array(f"col.{field}.zmax", lambda: zm[1])
         return zmin_slot, zmax_slot
 
-    def _parse_bound(self, fm: FieldMapping, value: Any) -> Any:
+    def _parse_bound(self, fm: FieldMapping, value: Any) -> Any:  # qwlint: disable=QW001 - int() truncation of query-JSON bounds on host (mirrors coerce_numeric_bound)
         if fm.type is FieldType.DATETIME:
             return parse_datetime_to_micros(value, fm.input_formats) \
                 if not isinstance(value, (int, float)) or isinstance(value, bool) \
@@ -1027,7 +1027,7 @@ class Lowering:
         hi_slot = self.b.add_scalar(hi_ord, np.int32)
         return PRange(ord_slot, present_slot, lo_slot, hi_slot, True, True)
 
-    def _lower_range(self, ast: Q.Range, bounds_are_micros: bool = False) -> Any:
+    def _lower_range(self, ast: Q.Range, bounds_are_micros: bool = False) -> Any:  # qwlint: disable=QW001 - int() of host-coerced query bounds when choosing the packed fast path
         """`bounds_are_micros`: bounds on a datetime field are already in
         micros (request-level time filters) — skip input-format parsing."""
         fm = self._field(ast.field)
@@ -1089,7 +1089,7 @@ class Lowering:
         return PRange(values_slot, present_slot, lo_slot, hi_slot,
                       lo_incl, hi_incl, zmin_slot, zmax_slot)
 
-    def _packed_range_slots(self, field: str, fm: FieldMapping, lo_val,
+    def _packed_range_slots(self, field: str, fm: FieldMapping, lo_val,  # qwlint: disable=QW001 - int() of numpy packing metadata (bit widths, frame mins) from the column header, pre-dispatch
                             lo_incl: bool, hi_val, hi_incl: bool):
         """Narrow-integer fast path for range predicates over FOR-packed
         columns: bounds rebase host-side into the scaled delta domain
@@ -1133,7 +1133,7 @@ class Lowering:
         return PRange(values_slot, present_slot, lo_slot, hi_slot,
                       True, True, zmin_slot, zmax_slot)
 
-    def _s32_range_slots(self, field: str, fm: FieldMapping, lo_val,
+    def _s32_range_slots(self, field: str, fm: FieldMapping, lo_val,  # qwlint: disable=QW001 - int() of host query bounds snapped to the i32-seconds domain, pre-dispatch
                          lo_incl: bool, hi_val, hi_incl: bool):
         """i32-seconds fast path for datetime range filters (the range
         twin of the date_histogram s32 path): i64 compares are emulated
@@ -1257,7 +1257,7 @@ class Lowering:
             exec_ = dc_replace(exec_, subs=tuple(children))
         return exec_
 
-    def _lower_bucket_agg(self, spec: AggSpec,
+    def _lower_bucket_agg(self, spec: AggSpec,  # qwlint: disable=QW001 - int() of agg-spec JSON sizes/intervals and numpy column stats while sizing static bucket counts
                           override_key: Optional[str] = None) -> "BucketAggExec":
         override_key = override_key or spec.name
         if isinstance(spec, DateHistogramAgg):
@@ -1485,7 +1485,7 @@ class Lowering:
                        "metric_kinds": {m.name: m.kind
                                         for m in spec.sub_metrics}})
 
-    def _lower_composite_source(self, agg_name: str, src: CompositeSource,
+    def _lower_composite_source(self, agg_name: str, src: CompositeSource,  # qwlint: disable=QW001 - int()/float()/.item() decode split-local key metadata from host numpy column stats into the source spec
                                 has_after: bool, after_val,
                                 infos: list) -> CompositeSourceExec:
         fm = self._field(src.field)
@@ -1705,7 +1705,7 @@ class Lowering:
                           values2_slot=v2, present2_slot=p2)
 
 
-def ordinalize_numeric_column(reader: SplitReader, field: str):
+def ordinalize_numeric_column(reader: SplitReader, field: str):  # qwlint: disable=QW001 - .item() over host numpy uniques building the ordinal dictionary; reader columns are numpy, never device arrays
     """(ordinals, unique_values) of a numeric fast column, cached per reader
     (terms aggregations over numeric fields need a dictionary)."""
     cache_key = f"_ordinalized.{field}"
@@ -1812,7 +1812,7 @@ def lower_request(
                             search_after, sort_value_threshold)
 
 
-def _finish_lowering(
+def _finish_lowering(  # qwlint: disable=QW001 - float()/int() of search_after/threshold wire values (python scalars off the root merge) staged as plan scalars
     low: "Lowering",
     root: Any,
     reader: SplitReader,

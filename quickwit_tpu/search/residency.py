@@ -24,6 +24,18 @@ the executor a plain list of device-array references, so a concurrent
 notification to prove exactly that; injected errors are absorbed (an
 eviction-side fault must never fail an innocent query that merely
 triggered LRU pressure).
+
+Mesh-resident stacks (`mesh_stack_id`): the multi-chip collective root
+merge (parallel/fanout.py) stages STACKED column families — one
+[n_splits, padded] array per column slot, sharded over the
+("splits", "docs") mesh — whose content is query-independent given the
+split set. They ride this same store as synthetic "splits" keyed by
+`mesh_stack_id(...)`: the owner's `device_bytes` tracks the PER-DEVICE
+shard footprint (what each chip's HBM actually holds), admission and LRU
+eviction flow through the identical `HbmBudget` owner seam, and a warm
+multi-split query uploads zero column bytes to ANY chip
+(`qw_resident_staging_cache_hits_total` counts whole-stack hits just as
+it counts whole-plan hits on the per-split path).
 """
 
 from __future__ import annotations
@@ -56,6 +68,22 @@ RESIDENT_BYTES = METRICS.gauge(
 RESIDENT_READBACKS_SHED = METRICS.counter(
     "qw_resident_readbacks_shed_total",
     "Async readbacks skipped because every rider's deadline had expired")
+
+
+def mesh_stack_id(split_ids, num_docs_padded: int, mesh) -> str:
+    """Stable residency key for one mesh-stacked column set.
+
+    Identity is (ordered split set, padded doc count, mesh shape): batch
+    lanes are pinned to split_id order by the service, the padded size
+    fixes every stacked array's shape, and arrays committed for one mesh
+    sharding must never be fed to an executor compiled for another (the
+    same rule `stage_device_inputs` keys its per-request cache on). The
+    digest keeps the id bounded for wide fan-outs."""
+    import hashlib
+    ident = repr((tuple(split_ids), num_docs_padded,
+                  tuple(mesh.axis_names), tuple(mesh.devices.shape)))
+    digest = hashlib.blake2b(ident.encode(), digest_size=12).hexdigest()
+    return f"meshstack:{digest}"
 
 
 class _NotifyingCache(dict):

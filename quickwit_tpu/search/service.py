@@ -41,7 +41,8 @@ from ..observability.profile import (
 )
 from ..query.ast import MatchAll
 from ..parallel.fanout import (
-    build_batch, dispatch_batch, readback_batch, stage_device_inputs,
+    build_batch, dispatch_batch, per_device_bytes, readback_batch,
+    release_stack_pin, stage_device_inputs,
 )
 from ..storage.base import StorageResolver
 from ..tenancy.context import (
@@ -251,11 +252,17 @@ class SearcherContext:
         return self._offload_pool
 
     def device_mesh(self, n_splits: int):
-        """A ("splits", "docs") mesh sized to shard `n_splits` across this
-        host's accelerators, or None when the batch cannot shard — single
-        device, single split, or no axis size >1 divides the batch. The
-        None degenerate IS the seed single-device dispatch, so CPU tier-1
-        behavior is byte-identical."""
+        """A 2D ("splits", "docs") mesh sized to shard `n_splits` across
+        this host's accelerators, or None when the batch cannot shard —
+        single device, single split, or no axis size >1 divides the batch.
+        The None degenerate IS the seed single-device dispatch (host root
+        merge), kept as the explicit fallback path.
+
+        The splits axis takes the largest size ≤ ndev that divides the
+        batch; leftover devices fold into the docs axis (largest power of
+        two, so it always divides the DOC_PAD-aligned padded doc count) —
+        dense column shards then spread over splits × docs while compute
+        replicates along docs (parallel/fanout.mesh_batch_fn)."""
         import jax
         ndev = len(jax.devices())
         if ndev < 2 or n_splits < 2:
@@ -265,11 +272,14 @@ class SearcherContext:
             axis -= 1
         if axis < 2:
             return None
+        docs = 1
+        while docs * 2 * axis <= ndev:
+            docs *= 2
         with self._lock:
-            mesh = self._meshes.get(axis)
+            mesh = self._meshes.get((axis, docs))
             if mesh is None:
                 from ..parallel.fanout import make_mesh
-                mesh = self._meshes[axis] = make_mesh(axis)
+                mesh = self._meshes[(axis, docs)] = make_mesh(axis, docs)
             return mesh
 
     def has_warm_reader(self, split: SplitIdAndFooter) -> bool:
@@ -824,22 +834,39 @@ class SearchService:
                     absence_sink=self.context.predicate_cache
                     .record_term_absent,
                     sort_value_threshold=push_thr)
-                admitted = self.context.hbm_budget.admit(
-                    batch, sum(a.nbytes for a in batch.arrays))
                 # the mesh is fixed at staging time: arrays committed for
                 # one sharding must not feed an executor traced for another
                 mesh = self.context.device_mesh(batch.n_splits)
-                stage_device_inputs(batch, mesh)  # async transfer starts now
+                # per-DEVICE admission: each chip pins only its shard of
+                # the stacks; column-family bytes are admitted under the
+                # mesh-resident stack owner inside stage_device_inputs
+                # (and stay warm), so exclude them here when that store
+                # will take them
+                stack_store = (self.context.resident_store
+                               if mesh is not None else None)
+                admitted = self.context.hbm_budget.admit(
+                    batch, per_device_bytes(
+                        batch, mesh,
+                        exclude_stack_resident=(
+                            stack_store is not None
+                            and stack_store.enabled)))
+                stage_device_inputs(  # async transfer starts now
+                    batch, mesh, resident_store=stack_store,
+                    budget=self.context.hbm_budget)
                 return ("batch", run_group, (batch, admitted, mesh), extras)
             except (OverloadShed, TenantRateLimited):
                 # whole-query backpressure, not a split failure: falling
                 # back per split would just re-admit and shed again
                 if admitted is not None and batch is not None:
                     self.context.hbm_budget.release(batch, admitted)
+                if batch is not None:
+                    release_stack_pin(batch, self.context.hbm_budget)
                 raise
             except Exception as exc:  # noqa: BLE001 - fall back per split
                 if admitted is not None and batch is not None:
                     self.context.hbm_budget.release(batch, admitted)
+                if batch is not None:
+                    release_stack_pin(batch, self.context.hbm_budget)
                 logger.debug("batch path failed (%s); searching per split", exc)
         return ("per_split", run_group,
                 self._prepare_per_split(run_group, doc_mapper, search_request,
@@ -855,6 +882,7 @@ class SearchService:
         if kind == "batch":
             batch, admitted, _mesh = data
             self.context.hbm_budget.release(batch, admitted)
+            release_stack_pin(batch, self.context.hbm_budget)
 
     def _prepare_per_split(self, group, doc_mapper, search_request,
                            prune_ctx=None, sort_value_threshold=None):
@@ -1076,7 +1104,12 @@ class SearchService:
                 dispatched = dispatch_batch(batch, search_request, mesh)
                 deadline = current_deadline()
                 if deadline is not None and deadline.expired:
+                    from ..parallel.fanout import abandon_dispatch
                     from .residency import RESIDENT_READBACKS_SHED
+                    # the mesh-dispatch guard (CPU host platform) must
+                    # still observe program completion before the next
+                    # collective program may enqueue
+                    abandon_dispatch(dispatched)
                     RESIDENT_READBACKS_SHED.inc()
                     profile = current_profile()
                     if profile is not None:
@@ -1096,6 +1129,7 @@ class SearchService:
                 return
             except (OverloadShed, TenantRateLimited):
                 self.context.hbm_budget.release(batch, admitted)
+                admitted = None  # the finally below must not release twice
                 raise
             except Exception as exc:  # noqa: BLE001 - fall back per split
                 logger.debug("batch execute failed (%s); per split", exc)
@@ -1104,6 +1138,7 @@ class SearchService:
                 # still-pinned batch bytes
                 self.context.hbm_budget.release(batch, admitted)
                 admitted = None
+                release_stack_pin(batch, self.context.hbm_budget)
                 data = self._prepare_per_split(
                     group, doc_mapper, search_request, prune_ctx=prune_ctx,
                     sort_value_threshold=(threshold.get()
@@ -1112,6 +1147,9 @@ class SearchService:
             finally:
                 if admitted is not None:
                     self.context.hbm_budget.release(batch, admitted)
+                # idempotent: converts the stack pin to resident exactly
+                # once, whichever exit path ran first
+                release_stack_pin(batch, self.context.hbm_budget)
         self._execute_per_split(data, doc_mapper, search_request, collector,
                                 prune_ctx=prune_ctx, threshold=threshold,
                                 prune_stats=prune_stats)

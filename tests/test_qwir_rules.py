@@ -15,6 +15,7 @@ from tools.qwir.rules import (check_collectives, check_f64, check_hbm,
                               check_transfers)
 from tools.qwir.selftest import (planted_bad_collective, planted_f64_upcast,
                                  planted_hbm_blowup, planted_host_round_trip,
+                                 planted_mesh_axis_leak,
                                  planted_unbounded_bucket, run_self_test)
 
 
@@ -59,6 +60,19 @@ def test_r4_catches_collective_over_undeclared_axis():
 def test_r4_accepts_declared_axes():
     spec = planted_bad_collective()
     spec.mesh_axes = ("splits", "docs")
+    assert not _live(check_collectives(spec))
+
+
+def test_r4_catches_axis_leak_through_real_mesh_program():
+    """The production mesh_batch_fn traced over a misnamed mesh: every
+    collective in the root merge binds the undeclared axis and R4 must
+    flag it; renaming the declaration to match clears it (proving the
+    finding keys on the axis name, not on the program shape)."""
+    spec = planted_mesh_axis_leak()
+    hits = _live(check_collectives(spec))
+    assert hits and all(f.rule == "R4" for f in hits)
+    assert any("rows" in f.site for f in hits)
+    spec.mesh_axes = ("rows", "docs")
     assert not _live(check_collectives(spec))
 
 

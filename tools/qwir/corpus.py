@@ -293,6 +293,41 @@ def build_corpus() -> list[ProgramSpec]:
                        "aggs": {"lat_avg": {"avg": {"field": "latency"}}}}}),
                0, ("v3", "v3b"))
 
+    # -- collective mesh root-merge programs (parallel/fanout.py) --------
+    # the whole-query shard_map programs: per-shard scoring, the pmax
+    # threshold exchange, the all_gather + re-top-k merge, and the
+    # psum/pmin/pmax agg reduction are EXPLICIT collective eqns here —
+    # R4's mesh-axis rule audits every one against the declared
+    # ("splits", "docs") axes
+    def mesh_spec(name, request, k, split_keys, mesh):
+        rds = [readers[s] for s in split_keys]
+        batch = fanout.build_batch(request, mapper, rds, list(split_keys))
+        closed = fanout.abstract_mesh_batch_program(batch, k, mesh)
+        specs.append(ProgramSpec(
+            name=name, kind="mesh", closed=closed,
+            cache_key=fanout.batch_cache_key(batch, k, mesh=mesh),
+            doc_lanes=batch.num_docs_padded * batch.n_splits,
+            num_docs_padded=batch.num_docs_padded))
+
+    mesh21 = fanout.make_mesh(2, 1)
+    mesh22 = fanout.make_mesh(2, 2)
+    mesh_spec("mesh/v3/term/n2/2x1/k10",
+              SearchRequest(index_ids=["t"], query_ast=term, max_hits=10),
+              10, ("v3", "v3b"), mesh21)
+    mesh_spec("mesh/v3/sort_2key/n2/2x2/k5",
+              SearchRequest(index_ids=["t"], query_ast=match_all, max_hits=5,
+                            sort_fields=[SortField("latency", "desc"),
+                                         SortField("timestamp", "asc")]),
+              5, ("v3", "v3b"), mesh22)
+    mesh_spec("mesh/v3/aggs/n2/2x1/k0",
+              SearchRequest(
+                  index_ids=["t"], query_ast=match_all, max_hits=0,
+                  aggs={"per_hour": {
+                      "date_histogram": {"field": "timestamp",
+                                         "fixed_interval": "1h"},
+                      "aggs": {"lat_avg": {"avg": {"field": "latency"}}}}}),
+              0, ("v3", "v3b"), mesh21)
+
     # -- Tier-A predicate-mask fill kernel -------------------------------
     plan = lower_request(bool_range, mapper, readers["v3"], [],
                          sort_field="timestamp", sort_order="desc")

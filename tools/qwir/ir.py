@@ -154,8 +154,11 @@ def _stable_param(v) -> str:
         inner = ",".join(_stable_param(x) for x in v)
         return f"({inner})" if isinstance(v, tuple) else f"[{inner}]"
     if isinstance(v, dict):
-        return "{" + ",".join(f"{k}:{_stable_param(v[k])}"
-                              for k in sorted(map(str, v))) + "}"
+        # keys may be non-str (shard_map in/out_names map int → axis):
+        # order by stringified key but index with the original
+        items = sorted(v.items(), key=lambda kv: str(kv[0]))
+        return "{" + ",".join(f"{k}:{_stable_param(val)}"
+                              for k, val in items) + "}"
     if isinstance(v, np.dtype) or (isinstance(v, type)
                                    and issubclass(v, np.generic)):
         return str(np.dtype(v))

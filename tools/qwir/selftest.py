@@ -109,6 +109,41 @@ def planted_bad_collective() -> ToySpec:
                    mesh_axes=("splits",))
 
 
+def planted_mesh_axis_leak() -> ToySpec:
+    """An undeclared-axis psum through the PRODUCTION collective program
+    shape: `fanout.mesh_batch_fn` traced over a mesh whose split axis is
+    misnamed ("rows", "docs"). Every collective in the lowered root merge
+    — the pmax threshold exchange, the all_gather candidate exchange, the
+    psum agg/count reductions — then binds "rows", which the ProgramSpec
+    never declared. Catching this through the real builder (not a toy
+    body) is what keeps R4 load-bearing for the mesh root-merge programs
+    the corpus now pins."""
+    import jax
+    import numpy as np_
+    from jax.sharding import Mesh
+
+    import quickwit_tpu  # noqa: F401 — enables x64, matching production
+    from quickwit_tpu.parallel import fanout
+    from quickwit_tpu.query.ast import Term
+    from quickwit_tpu.search import SearchRequest
+
+    from .corpus import _build_reader, _docs, _mapper
+
+    mapper = _mapper()
+    readers = [_build_reader(mapper, _docs(64, seed=11), f"r4mesh{i}.split")
+               for i in range(2)]
+    request = SearchRequest(index_ids=["t"],
+                            query_ast=Term("body", "alpha"), max_hits=5)
+    batch = fanout.build_batch(request, mapper, readers, ["a", "b"])
+    bad_mesh = Mesh(np_.asarray(jax.devices()[:2]).reshape(2, 1),
+                    ("rows", "docs"))
+    return ToySpec(name="planted/mesh_axis_leak",
+                   closed=fanout.abstract_mesh_batch_program(batch, 5,
+                                                             bad_mesh),
+                   doc_lanes=batch.num_docs_padded * 2,
+                   num_docs_padded=batch.num_docs_padded)
+
+
 def planted_hbm_blowup() -> ToySpec:
     """A [docs, docs]-ish pairwise f64 temp: 2048×16384 f64 = 256 MiB live
     in one buffer — four DRR admission quanta for one query's scratch."""
@@ -174,6 +209,11 @@ def run_self_test() -> list[str]:
 
     spec = planted_bad_collective()
     expect("R4/bad_collective", check_collectives(spec), "R4", "docs")
+
+    spec = planted_mesh_axis_leak()
+    expect("R4/mesh_axis_leak", check_collectives(spec), "R4", "rows")
+    if check_transfers(spec):
+        failures.append("R4/mesh_axis_leak: tripped unrelated rules")
 
     spec = planted_hbm_blowup()
     expect("R5/hbm_blowup", check_hbm(spec), "R5", "peak:")
