@@ -32,6 +32,8 @@ import tempfile
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from ..common import sync
+from ..common.ctx import run_with_context
 from ..common.deadline import CancellationToken
 from ..common.faults import FaultInjector, FaultyMetastore, FaultyStorageResolver
 from ..control_plane.scheduler import IndexingScheduler, IndexingTask
@@ -51,7 +53,7 @@ from ..models.index_metadata import IndexConfig, IndexMetadata, SourceConfig
 from ..models.split_metadata import SplitState
 from ..offload.autoscaler import Autoscaler, WorkerLauncher
 from ..offload.pool import WorkerPool
-from ..query.ast import MatchAll
+from ..query.ast import MatchAll, Range, RangeBound
 from ..search import SearchRequest, SortField, leaf_search_single_split
 from ..search.cancel import CANCEL_REGISTRY
 from ..search.root import RootSearcher
@@ -603,6 +605,92 @@ class SimCluster:
                 "num_hits": int(resp.num_hits),
                 "had_splits": had_splits,
                 "registry_drained": CANCEL_REGISTRY.get(qid) is None}
+
+    def dashboard(self, index_id: str, max_hits: int, panels: int,
+                  cancel_panel: bool = False) -> dict[str, Any]:
+        """N concurrent shape-compatible panel searches through ONE root —
+        the workload the query batcher (search/batcher.py) stacks into a
+        single device dispatch. Panels share structure (Range on the "ts"
+        fast field, same sort + max_hits) but carry distinct window bounds,
+        so they are distinct queries under one group key. Each panel runs
+        cold+warm like `search` (the cache≡cold invariant audits every
+        lane); with `cancel_panel` one extra panel's handle is cancelled
+        up front, so the batcher sheds it AFTER group formation — the
+        masked-rider path, audited by cancel_responsiveness."""
+        alive = self.alive_nodes()
+        if not alive:
+            return {"error": "NoAliveNodes"}
+        root = self._make_root(alive)
+        t0_us = 1_600_000_000 * 1_000_000
+
+        def request_for(i: int, qid: Optional[str] = None) -> SearchRequest:
+            # distinct upper bound per panel: distinct query, same shape
+            window = Range(
+                "ts", lower=RangeBound(t0_us, True),
+                upper=RangeBound(t0_us + (i + 1) * 1_000 * 1_000_000, False))
+            return SearchRequest(
+                index_ids=[index_id], query_ast=window, max_hits=max_hits,
+                sort_fields=[SortField("ts", "desc")], query_id=qid)
+
+        panel_outs: list[Any] = [None] * panels
+
+        def run_panel(i: int) -> None:
+            outs: list[dict[str, Any]] = []
+            for _ in range(2):
+                try:
+                    resp = root.search(request_for(i))
+                except Exception as exc:  # noqa: BLE001 - typed outcome
+                    outs.append({"error": type(exc).__name__})
+                    continue
+                complete = (not resp.timed_out and not resp.errors
+                            and not resp.failed_splits)
+                outs.append({
+                    "ns": sorted(int(h.doc["n"]) for h in resp.hits),
+                    "num_hits": int(resp.num_hits),
+                    "complete": bool(complete),
+                })
+            panel_outs[i] = outs
+
+        cancelled_out: dict[str, Any] = {}
+        # same staleness as the root's own view (read before the threads
+        # start, so the result is independent of panel interleaving)
+        uid = self._uid(index_id)
+        had_splits = bool(self.nodes[alive[0]].metastore.list_splits(
+            ListSplitsQuery(index_uids=[uid],
+                            states=[SplitState.PUBLISHED])))
+
+        def run_cancelled(i: int) -> None:
+            qid = f"dst-dashboard-{next(self._cancel_seq)}"
+            token = CancellationToken()
+            CANCEL_REGISTRY.register(qid, token)
+            accepted = CANCEL_REGISTRY.cancel(qid, reason="dst dashboard shed")
+            try:
+                resp = root.search(request_for(i, qid=qid))
+            except Exception as exc:  # noqa: BLE001 - typed outcome
+                cancelled_out.update(
+                    error=type(exc).__name__,
+                    registry_drained=CANCEL_REGISTRY.get(qid) is None)
+                return
+            cancelled_out.update(
+                accepted=accepted, cancelled=bool(resp.cancelled),
+                num_hits=int(resp.num_hits), had_splits=had_splits,
+                registry_drained=CANCEL_REGISTRY.get(qid) is None)
+
+        threads = [sync.thread(target=run_with_context(run_panel),
+                               args=(i,), name=f"dashboard-panel-{i}")
+                   for i in range(panels)]
+        if cancel_panel:
+            threads.append(sync.thread(target=run_with_context(run_cancelled),
+                                       args=(panels,),
+                                       name="dashboard-shed"))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        result: dict[str, Any] = {"panels": panel_outs}
+        if cancel_panel:
+            result["cancelled_panel"] = cancelled_out
+        return result
 
     def merge(self, node_id: str, index_id: str) -> dict[str, Any]:
         node = self.nodes[node_id]

@@ -186,6 +186,9 @@ def _execute(cluster: SimCluster, op: dict[str, Any]) -> Any:
         return cluster.plan()
     if kind == "cancel":
         return cluster.cancel_search(op["index"], op["max_hits"])
+    if kind == "dashboard":
+        return cluster.dashboard(op["index"], op["max_hits"], op["panels"],
+                                 cancel_panel=op.get("cancel_panel", False))
     raise ValueError(f"unknown op kind: {kind!r}")
 
 

@@ -105,6 +105,15 @@ class InvariantChecker:
             self._check_plan(result, step)
         elif kind == "cancel":
             self._check_cancel(result, step)
+        elif kind == "dashboard":
+            # every concurrent panel is audited exactly like a standalone
+            # search (cold≡warm, tenant isolation, deadlines); the shed
+            # panel is audited like a standalone pre-cancelled query
+            for outs in (result.get("panels") or ()):
+                if outs is not None:
+                    self._check_search(op, outs, step, cluster)
+            if result.get("cancelled_panel") is not None:
+                self._check_cancel(result["cancelled_panel"], step)
 
     def _check_search(self, op: dict[str, Any], outs: list[dict[str, Any]],
                       step: int, cluster) -> None:

@@ -37,6 +37,11 @@ DEFAULT_WEIGHTS: dict[str, int] = {
     # pre-existing scenarios' op streams (and replay artifacts) stay
     # byte-identical — materialize() only draws kinds with weight > 0
     "cancel": 0,
+    # N concurrent shape-compatible panel searches through ONE node — the
+    # workload the query batcher stacks into a single device dispatch
+    # (search/batcher.py QueryGroupPlanner). Weight 0 by default for the
+    # same replay-stability reason as "cancel".
+    "dashboard": 0,
 }
 
 ALL_INVARIANTS = (
@@ -152,6 +157,16 @@ class Scenario:
                 ops.append({"kind": "cancel",
                             "index": rng.choice(self.indexes),
                             "max_hits": rng.choice((10, 100, 1000))})
+            elif kind == "dashboard":
+                # panels share structure (same sort/max_hits, Range on the
+                # timestamp fast field) but carry distinct window bounds:
+                # distinct queries, one group key. cancel_panel sheds one
+                # rider's handle up front — the post-formation masking path
+                ops.append({"kind": "dashboard",
+                            "index": rng.choice(self.indexes),
+                            "max_hits": rng.choice((10, 100)),
+                            "panels": rng.randint(2, 4),
+                            "cancel_panel": rng.random() < 0.3})
         return ops
 
     # --- (de)serialization -------------------------------------------------
@@ -237,7 +252,7 @@ SCENARIOS: dict[str, Scenario] = {
         offload=True, replication=False, sorted_searches=True,
         weights={"ingest": 8, "drain": 6, "search": 8, "merge": 1,
                  "kill": 0, "restart": 0, "autoscale": 2, "plan": 0,
-                 "cancel": 2},
+                 "cancel": 2, "dashboard": 2},
         invariants=("exactly_once_publish", "tenant_isolation",
                     "cache_cold_equivalence", "autoscaler_bounds",
                     "cancel_responsiveness"),

@@ -230,6 +230,30 @@ SEARCH_BATCHER_QUEUE_WAIT = METRICS.histogram(
     "qw_search_batcher_queue_wait_seconds",
     "Wait between a query entering the batcher and its dispatch starting")
 
+# --- query-group stacking (search/batcher.py QueryGroupPlanner) -----------
+# DISTINCT shape-compatible queries stacked into one device dispatch along
+# a query axis (ROADMAP item 2) — as opposed to the convoy counters above,
+# which cover riders of one identical plan. Reject reasons are a bounded
+# enum (plan_shape | group_full), never request-derived.
+QBATCH_GROUPS_TOTAL = METRICS.counter(
+    "qw_qbatch_groups_total",
+    "Query groups (>1 distinct queries) executed as one stacked dispatch")
+QBATCH_QUERIES_PER_DISPATCH = METRICS.histogram(
+    "qw_qbatch_queries_per_dispatch",
+    "Live query lanes per stacked group dispatch",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0))
+QBATCH_INCOMPATIBLE_TOTAL = METRICS.counter(
+    "qw_qbatch_incompatible_total",
+    "Queries that could not join an open group, by bounded reject reason")
+QBATCH_MASKED_RIDERS_TOTAL = METRICS.counter(
+    "qw_qbatch_masked_riders_total",
+    "Riders masked out of an already-formed group (validity lane zeroed) "
+    "instead of forcing a group rebuild")
+QBATCH_SHARED_BYTES_AVOIDED_TOTAL = METRICS.counter(
+    "qw_qbatch_shared_bytes_avoided_total",
+    "Operand bytes served once as broadcast slots instead of per-lane "
+    "copies in stacked group dispatches")
+
 # --- dynamic top-K split pruning (search/pruning.py) ----------------------
 # Splits never executed because their sort-value/score upper bound could
 # not beat the collector's Kth value (count_hits_exact=False).

@@ -39,6 +39,12 @@ from .metrics import SEARCH_PHASE_SECONDS
 PHASE_PLAN_BUILD = "plan_build"
 PHASE_ADMISSION_WAIT = "admission_wait"
 PHASE_BATCHER_QUEUE = "batcher_queue_wait"
+# group-formation wait: time a rider spent queued while a multi-QUERY
+# stacked group assembled around it (search/batcher.py QueryGroupPlanner);
+# recorded INSTEAD of batcher_queue_wait for riders that dispatched as part
+# of a group of distinct queries, so dashboards can attribute convoy wait
+# vs group-formation wait separately
+PHASE_QBATCH_GROUP = "qbatch_group_wait"
 PHASE_STORAGE_READ = "storage_read"
 PHASE_STAGING = "staging"
 # staging split by outcome (ROADMAP item 1 attribution): an upload that

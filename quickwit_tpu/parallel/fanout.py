@@ -1029,7 +1029,17 @@ def readback_batch(dispatched) -> LeafSearchResponse:
         executor_mod._note_guided_fallback()
         return execute_batch(batch, request, mesh, exact=True)
 
-    num_hits = int(total)
+    return _decode_merged(batch, k, top_vals, top_vals2, split_idx,
+                          doc_ids, scores, int(total), merged_aggs)
+
+
+def _decode_merged(batch: SplitBatch, k: int, top_vals, top_vals2,
+                   split_idx, doc_ids, scores, num_hits: int,
+                   merged_aggs) -> LeafSearchResponse:
+    """Host decode of one merged (cross-split) result into a
+    LeafSearchResponse — shared by the single-query batch readback and the
+    per-lane unpack of a stacked query-group readback (one lane's slice of
+    the [Q, ...] result is exactly one merged batch result)."""
     hits: list[PartialHit] = []
     sort_is_int = _sort_values_are_int(batch.doc_mapper, batch.sort_field)
     sort2_is_int = (_sort_values_are_int(batch.doc_mapper, batch.sort2_field)
@@ -1089,3 +1099,477 @@ def execute_batch(batch: SplitBatch, request: SearchRequest,
     """Run the batch (optionally mesh-sharded) and emit one merged
     LeafSearchResponse covering all splits."""
     return readback_batch(dispatch_batch(batch, request, mesh, exact))
+
+
+# --------------------------------------------------------------------------
+# query-axis × mesh composition (ROADMAP item 2 over item 6)
+#
+# N shape-compatible queries over the SAME split set execute as ONE mesh
+# program: a leading `queries` axis is vmapped INSIDE each device shard
+# (never a mesh axis — chips shard data, lanes share chips), operand slots
+# whose cache key agrees across the group broadcast once from the
+# mesh-resident column stack, query-shaped slots (postings, masks) gain a
+# [Q, n_splits, ...] leading dim sharded P(None, "splits"), and the on-mesh
+# root merge becomes per-query-lane collectives: the pmax threshold
+# exchange reduces a [Q] vector of per-lane k-th values, the all_gather
+# carries [Q, local_n*k] candidate tiles, and mergeable-agg states reduce
+# by query-id segments before the cross-device psum. A [Q] validity mask
+# rides as an operand, so a rider shed after group formation lane-zeroes
+# out of the packed readback without touching the compiled program.
+
+_GROUP_JIT_CACHE: dict[tuple, Any] = {}
+
+# Slot keys that may BROADCAST across query lanes: column families derive
+# only from the readers and the padded size, so equal keys over one split
+# set mean equal bytes (the same argument as the mesh-resident stack's
+# cache key). Posting/mask slots are query-shaped even when their keys
+# collide, so they always stack.
+_GROUP_SHARED_PREFIXES = ("col.", "norm.")
+
+
+def group_slot_split(batches: list) -> tuple[tuple[int, ...],
+                                             tuple[int, ...]]:
+    """(shared_slots, stacked_slots) for a query group: a slot broadcasts
+    when every lane carries the same array key AND the key is a
+    column-family key (content a pure function of the split set)."""
+    t0 = batches[0].template
+    shared, stacked = [], []
+    for slot, key in enumerate(t0.array_keys):
+        if key.startswith(_GROUP_SHARED_PREFIXES) and all(
+                b.template.array_keys[slot] == key for b in batches[1:]):
+            shared.append(slot)
+        else:
+            stacked.append(slot)
+    return tuple(shared), tuple(stacked)
+
+
+def _stack_group_operands(batches: list, stacked_slots) -> tuple:
+    """Host-side [Q, ...] stacking of the query-shaped operands. Stacked
+    slots pad their last dim to the group maximum (two terms' posting
+    lists rarely agree in length) using the SAME per-key pad fill the
+    split stacking uses, so pad lanes stay inert under every kernel."""
+    q = len(batches)
+    t0 = batches[0].template
+    stacked_arrays = []
+    for slot in stacked_slots:
+        per_q = [b.arrays[slot] for b in batches]
+        dtype = per_q[0].dtype
+        if any(a.dtype != dtype for a in per_q[1:]):
+            raise ValueError(
+                f"group slot {t0.array_keys[slot]!r} has non-uniform "
+                "dtypes across queries (incompatible column packings)")
+        max_len = max(a.shape[1] for a in per_q)
+        fill = _pad_fill(t0.array_keys[slot],
+                         batches[0].num_docs_padded, dtype)
+        out = np.full((q, per_q[0].shape[0], max_len), fill, dtype=dtype)
+        for i, a in enumerate(per_q):
+            out[i, :, : a.shape[1]] = a
+        stacked_arrays.append(out)
+    scalars_b = [np.stack([np.asarray(b.scalars[slot]) for b in batches])
+                 for slot in range(len(t0.scalars))]
+    return stacked_arrays, scalars_b
+
+
+def _assemble_group_slots(shared, lane_stacked, shared_slots,
+                          stacked_slots, num_slots) -> tuple:
+    slots: list = [None] * num_slots
+    for i, s in enumerate(shared_slots):
+        slots[s] = shared[i]
+    for i, s in enumerate(stacked_slots):
+        slots[s] = lane_stacked[i]
+    return tuple(slots)
+
+
+def _merge_agg_group_collective(agg_out, split_ax: str, q: int):
+    """`_merge_agg_collective`'s query-axis twin: leaves arrive
+    [Q, local_n, ...]; the local reduction runs as ONE query-id-segmented
+    device op over the flattened [Q*local_n, ...] rows
+    (ops/topk.segment_merge_by_query), then the per-leaf combiner crosses
+    the split mesh axis per lane (psum/pmin/pmax act elementwise over the
+    leading [Q] dim). Exactness: segment_sum accumulates rows in ascending
+    index order within each segment — the same left fold over local splits
+    the single-query merge performs."""
+    from jax import lax
+
+    from ..ops import topk as topk_ops
+
+    def red(path, leaf):
+        name = None
+        for p in reversed(path):
+            if hasattr(p, "key"):
+                name = p.key
+                break
+        local_n = leaf.shape[1]
+        flat = leaf.reshape((q * local_n,) + leaf.shape[2:])
+        qids = jnp.repeat(jnp.arange(q, dtype=jnp.int32), local_n)
+        if name == "min":
+            return lax.pmin(topk_ops.segment_merge_by_query(
+                flat, qids, q, "min"), split_ax)
+        if name in ("max", "hll"):  # HLL registers merge by max too
+            return lax.pmax(topk_ops.segment_merge_by_query(
+                flat, qids, q, "max"), split_ax)
+        if name == "stats":
+            # state vector [count, sum, sum_sq, min, max]: first three add
+            return jnp.concatenate([
+                lax.psum(topk_ops.segment_merge_by_query(
+                    flat[:, :3], qids, q, "sum"), split_ax),
+                lax.pmin(topk_ops.segment_merge_by_query(
+                    flat[:, 3:4], qids, q, "min"), split_ax),
+                lax.pmax(topk_ops.segment_merge_by_query(
+                    flat[:, 4:5], qids, q, "max"), split_ax),
+            ], axis=1)
+        # segment_sum keeps the operand dtype, but the solo merge's
+        # jnp.sum promotes integer accumulators (int32 counts → int64) —
+        # widen first so the stacked readback spec matches bit-for-bit
+        flat = flat.astype(jnp.zeros((), leaf.dtype).sum().dtype)
+        return lax.psum(topk_ops.segment_merge_by_query(
+            flat, qids, q, "sum"), split_ax)
+    return jax.tree_util.tree_map_with_path(red, agg_out)
+
+
+def group_fn(batches: list, k: int, exact: bool = False):
+    """Host-degenerate (no-mesh) stacked group closure: the query axis
+    vmaps the whole single-query merged-batch program (`batch_fn`), so
+    each lane runs bit-identically to its solo batch execution. Signature:
+    (shared_arrays, stacked_arrays, scalars_b, num_docs) → per-lane result
+    tree with leading [Q] dims."""
+    template = batches[0].template
+    shared_slots, stacked_slots = group_slot_split(batches)
+    num_slots = len(template.arrays)
+    base = batch_fn(batches[0], k, exact)
+
+    def fn(shared, stacked, scalars_b, num_docs):
+        def lane(lane_stacked, lane_scalars):
+            arrays = _assemble_group_slots(
+                shared, lane_stacked, shared_slots, stacked_slots,
+                num_slots)
+            return base(arrays, lane_scalars, num_docs)
+        return jax.vmap(lane)(tuple(stacked), tuple(scalars_b))
+
+    return fn
+
+
+def group_mesh_fn(batches: list, k: int, mesh: Mesh, exact: bool = False):
+    """The query group as ONE explicitly-collective SPMD program: the
+    stacked twin of `mesh_batch_fn` (same three merge steps, per query
+    lane — see that docstring for the exactness arguments; each reduces
+    elementwise over the leading [Q] dim, so lane q's merge consumes
+    exactly the operands its solo program would):
+
+      1. threshold exchange: [Q] per-lane k-th values, ONE pmax round.
+      2. top-K merge: [Q, local_n*k] candidates all_gather along the
+         split axis (axis=1, tiled — split-major per lane), then a
+         batched top-k; 2-key sorts ride `ops/topk.batched_topk_2key`.
+      3. agg + count reduce: query-id-segmented local merges, then
+         per-lane psum/pmin/pmax (`_merge_agg_group_collective`).
+    """
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+
+    template = batches[0].template
+    q = len(batches)
+    shared_slots, stacked_slots = group_slot_split(batches)
+    num_slots = len(template.arrays)
+    single_fn = executor_mod._build(template, k, exact)
+    split_ax, _doc_ax = _mesh_axes(mesh)
+    axis_splits = mesh.shape[split_ax]
+    if batches[0].n_splits % axis_splits:
+        raise ValueError(
+            f"n_splits={batches[0].n_splits} does not shard over the "
+            f"{axis_splits}-way {split_ax!r} mesh axis (pad the batch)")
+
+    def shard_body(shared, stacked, scalars_b, num_docs):
+        def lane(lane_stacked, lane_scalars):
+            arrays = _assemble_group_slots(
+                shared, lane_stacked, shared_slots, stacked_slots,
+                num_slots)
+            return jax.vmap(single_fn)(arrays, lane_scalars, num_docs)
+        results = jax.vmap(lane)(tuple(stacked), tuple(scalars_b))
+        sort_vals, sort_vals2, doc_ids, hit_scores, counts, topk_safe, \
+            agg_out = results
+        total = lax.psum(jnp.sum(counts, axis=1), split_ax)        # [Q]
+        safe = lax.pmin(jnp.min(topk_safe, axis=1), split_ax)      # [Q]
+        merged = _merge_agg_group_collective(agg_out, split_ax, q)
+        if k == 0:  # count/agg-only: no candidates to exchange or gather
+            empty_i = jnp.zeros((q, 0), jnp.int32)
+            return (jnp.zeros((q, 0), sort_vals.dtype), None, empty_i,
+                    empty_i, jnp.zeros((q, 0), hit_scores.dtype), total,
+                    safe, merged)
+        flat = sort_vals.reshape(q, -1)     # [Q, local_n*k], split-major
+        neg_inf = jnp.asarray(-jnp.inf, flat.dtype)
+        # -- threshold exchange: ONE pmax round carries all Q lanes ------
+        local_kth = lax.top_k(flat, k)[0][:, k - 1]
+        threshold = lax.pmax(local_kth, split_ax)                  # [Q]
+        keep = flat >= threshold[:, None]   # >= keeps threshold ties
+        flat = jnp.where(keep, flat, neg_inf)
+        # -- split-axis gather + per-lane re-top-k -----------------------
+        g_vals = lax.all_gather(flat, split_ax, axis=1, tiled=True)
+        g_ids = lax.all_gather(doc_ids.reshape(q, -1), split_ax,
+                               axis=1, tiled=True)
+        g_scores = lax.all_gather(hit_scores.reshape(q, -1), split_ax,
+                                  axis=1, tiled=True)
+        if sort_vals2 is None:
+            # lax.top_k is batched over leading dims: [Q, n*k] → [Q, k]
+            top_vals, pos = lax.top_k(g_vals, k)
+            top_vals2 = None
+        else:
+            flat2 = jnp.where(keep, sort_vals2.reshape(q, -1), neg_inf)
+            g_vals2 = lax.all_gather(flat2, split_ax, axis=1, tiled=True)
+            from ..ops import topk as topk_ops
+            top_vals, top_vals2, pos = topk_ops.batched_topk_2key(
+                g_vals, g_vals2, k)
+        split_idx = (pos // k).astype(jnp.int32)
+        return (top_vals, top_vals2, split_idx,
+                jnp.take_along_axis(g_ids, pos, axis=1),
+                jnp.take_along_axis(g_scores, pos, axis=1),
+                total, safe, merged)
+
+    in_shared = tuple(P(split_ax) for _ in shared_slots)
+    in_stacked = tuple(P(None, split_ax) for _ in stacked_slots)
+    in_scalars = tuple(P(None, split_ax) for _ in template.scalars)
+    return shard_map(shard_body, mesh=mesh,
+                     in_specs=(in_shared, in_stacked, in_scalars,
+                               P(split_ax)),
+                     out_specs=P(), check_rep=False)
+
+
+def group_cache_key(batches: list, k: int, mesh: Optional[Mesh] = None,
+                    exact: bool = False) -> tuple:
+    """The `_GROUP_JIT_CACHE` key `dispatch_query_group` uses, post
+    k-clamp — mirrored here for tools/qwir's compile-cache closure
+    certificate (must stay in lockstep with the key expression in
+    `dispatch_query_group`). The [Q] validity mask is an OPERAND, never
+    part of the key: shedding a rider does not recompile."""
+    b0 = batches[0]
+    k = min(k, b0.num_docs_padded)
+    _shared, stacked_slots = group_slot_split(batches)
+    return (b0.template.signature(k), len(batches), b0.n_splits,
+            b0.num_docs_padded, stacked_slots, mesh, exact)
+
+
+def _group_example_structs(batches: list, stacked_slots):
+    """ShapeDtypeStructs for (shared, stacked, scalars, num_docs) of the
+    group program — shared by the abstract qwir trace and eval_shape."""
+    b0 = batches[0]
+    shared_slots, _ = group_slot_split(batches)
+    stacked_arrays, scalars_b = _stack_group_operands(batches,
+                                                      stacked_slots)
+    shared = tuple(jax.ShapeDtypeStruct(b0.arrays[s].shape,
+                                        b0.arrays[s].dtype)
+                   for s in shared_slots)
+    stacked = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                    for a in stacked_arrays)
+    scalars = tuple(jax.ShapeDtypeStruct(s.shape, s.dtype)
+                    for s in scalars_b)
+    nd = jax.ShapeDtypeStruct(b0.num_docs.shape, b0.num_docs.dtype)
+    return shared, stacked, scalars, nd
+
+
+def abstract_group_mesh_program(batches: list, k: int, mesh: Mesh,
+                                exact: bool = False):
+    """ClosedJaxpr of the stacked query-group mesh program (`group_mesh_fn`,
+    minus the packed readback concat and validity mask) — abstract-traced,
+    never compiled or executed. The collectives are explicit eqns binding
+    the declared mesh axes, same as `abstract_mesh_batch_program`; the
+    query axis shows up as leading [Q] dims, NOT as a mesh axis."""
+    b0 = batches[0]
+    k = min(max(0, k), b0.num_docs_padded)
+    _shared_slots, stacked_slots = group_slot_split(batches)
+    fn = group_mesh_fn(batches, k, mesh, exact)
+    shared, stacked, scalars, nd = _group_example_structs(batches,
+                                                          stacked_slots)
+    return jax.make_jaxpr(fn)(shared, stacked, scalars, nd)
+
+
+def _group_executor(batches: list, k: int, mesh: Optional[Mesh],
+                    exact: bool = False):
+    """(jitted_packed_fn, treedef, spec): the group's result tree rides
+    ONE [Q, total] f64 device array — one transfer for all lanes — with
+    the [Q] validity mask zeroing shed lanes' rows (jnp.where, never
+    multiply: -inf × 0 is NaN)."""
+    q = len(batches)
+    _shared_slots, stacked_slots = group_slot_split(batches)
+    fn = (group_mesh_fn(batches, k, mesh, exact) if mesh is not None
+          else group_fn(batches, k, exact))
+    ex_shared, ex_stacked, ex_scalars, ex_nd = _group_example_structs(
+        batches, stacked_slots)
+    shaped = jax.eval_shape(fn, ex_shared, ex_stacked, ex_scalars, ex_nd)
+    treedef = jax.tree_util.tree_structure(shaped)
+    spec = [(leaf.shape, leaf.dtype)
+            for leaf in jax.tree_util.tree_leaves(shaped)]
+
+    def packed(shared, stacked, scalars_b, num_docs, valid):
+        out = fn(shared, stacked, scalars_b, num_docs)
+        flat = [leaf.reshape(q, -1).astype(jnp.float64)
+                for leaf in jax.tree_util.tree_leaves(out)]
+        packed_rows = jnp.concatenate(flat, axis=1) if flat \
+            else jnp.zeros((q, 0))
+        return jnp.where(valid[:, None], packed_rows, 0.0)
+
+    return jax.jit(packed), treedef, spec
+
+
+def dispatch_query_group(batches: list, request: SearchRequest,
+                         mesh: Optional[Mesh] = None, valid=None,
+                         exact: bool = False):
+    """Async half of a stacked query-group dispatch: N shape-compatible
+    queries (uniform template signature, same split set) enqueue as ONE
+    program. `valid` masks lanes shed after group formation; `None` means
+    all live. Returns the dispatched tuple for `readback_query_group`."""
+    from ..common.deadline import check_cancelled
+    check_cancelled("query-group dispatch")
+    b0 = batches[0]
+    q = len(batches)
+    sig0 = b0.template.signature(min(
+        request.start_offset + request.max_hits, b0.num_docs_padded))
+    for b in batches[1:]:
+        if b.split_ids != b0.split_ids:
+            raise ValueError("query group spans different split sets")
+    mesh = _usable_mesh(b0, mesh)
+    k = min(request.start_offset + request.max_hits, b0.num_docs_padded)
+    for b in batches[1:]:
+        if b.template.signature(k) != sig0:
+            raise ValueError(
+                "query group is not shape-compatible (template signatures "
+                "differ) — group by LoweredPlan.structure_digest upstream")
+    if valid is None:
+        valid = [True] * q
+    shared_slots, stacked_slots = group_slot_split(batches)
+    stacked_arrays, scalars_b = _stack_group_operands(batches,
+                                                      stacked_slots)
+    live = sum(1 for v in valid if v)
+    from ..observability.metrics import (
+        QBATCH_GROUPS_TOTAL, QBATCH_MASKED_RIDERS_TOTAL,
+        QBATCH_QUERIES_PER_DISPATCH, QBATCH_SHARED_BYTES_AVOIDED_TOTAL,
+    )
+    if live > 1:
+        QBATCH_GROUPS_TOTAL.inc()
+    QBATCH_QUERIES_PER_DISPATCH.observe(live)
+    if q - live:
+        QBATCH_MASKED_RIDERS_TOTAL.inc(q - live)
+    if live > 1 and shared_slots:
+        QBATCH_SHARED_BYTES_AVOIDED_TOTAL.inc(
+            sum(b0.arrays[s].nbytes for s in shared_slots) * (live - 1))
+    # staging: shared slots ride lane 0's staged batch inputs (and thus
+    # the mesh-resident column stack when one is active); stacked slots
+    # and scalars are per-group uploads
+    if mesh is not None:
+        arrays_sh, _scalars_sh, nd_sh = batch_shardings(b0, mesh)
+        from jax.sharding import NamedSharding
+        split_ax, _doc_ax = _mesh_axes(mesh)
+        shared_dev = tuple(jax.device_put(b0.arrays[s], arrays_sh[s])
+                           for s in shared_slots)
+        stacked_sh = NamedSharding(mesh, P(None, split_ax))
+        stacked_dev = tuple(jax.device_put(a, stacked_sh)
+                            for a in stacked_arrays)
+        scalars_dev = tuple(jax.device_put(s, stacked_sh)
+                            for s in scalars_b)
+        nd_dev = jax.device_put(b0.num_docs, nd_sh)
+    else:
+        moved = jax.device_put(
+            [b0.arrays[s] for s in shared_slots] + stacked_arrays
+            + scalars_b + [b0.num_docs])
+        shared_dev = tuple(moved[: len(shared_slots)])
+        stacked_dev = tuple(
+            moved[len(shared_slots): len(shared_slots) + len(stacked_arrays)])
+        scalars_dev = tuple(moved[len(shared_slots) + len(stacked_arrays):-1])
+        nd_dev = moved[-1]
+    valid_dev = jax.device_put(np.asarray(valid, dtype=bool))
+    # mirror: group_cache_key (qwir closure certificate lockstep)
+    key = (sig0, q, b0.n_splits, b0.num_docs_padded, stacked_slots, mesh,
+           exact)
+    cached = _GROUP_JIT_CACHE.get(key)
+    profile = current_profile()
+    if profile is not None:
+        profile.add("compile_cache_hits" if cached is not None
+                    else "compile_cache_misses")
+    ctx = profile.phase(PHASE_EXECUTE if cached is not None
+                        else PHASE_COMPILE, stage="dispatch_query_group") \
+        if profile is not None else None
+    try:
+        if ctx is not None:
+            ctx.__enter__()
+        if cached is None:
+            cached = _group_executor(batches, k, mesh, exact)
+            _GROUP_JIT_CACHE[key] = cached
+        ex, treedef, spec = cached
+        out, guard = _enqueue_batch(
+            lambda a, s, n: ex(shared_dev, stacked_dev, s, n, valid_dev),
+            None, scalars_dev, nd_dev, mesh)
+    finally:
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+    try:
+        if mesh is not None:
+            from ..observability.metrics import (
+                MESH_DEVICES, MESH_DISPATCHES_TOTAL,
+                MESH_THRESHOLD_EXCHANGE_ROUNDS_TOTAL,
+            )
+            MESH_DISPATCHES_TOTAL.inc()
+            MESH_DEVICES.set(mesh.size)
+            if k > 0:
+                # one pmax round still carries ALL Q lanes' thresholds
+                MESH_THRESHOLD_EXCHANGE_ROUNDS_TOTAL.inc()
+        if hasattr(out, "copy_to_host_async"):
+            out.copy_to_host_async()
+    except BaseException:
+        _finish_mesh_dispatch(guard, out)
+        raise
+    return out, treedef, spec, (list(batches), request, mesh, k,
+                                list(valid)), guard
+
+
+def readback_query_group(dispatched) -> list:
+    """Blocking half: ONE [Q, total] transfer, per-lane unpack + the same
+    merged-hit decode the single-query readback uses. Masked lanes return
+    None. A lane whose guided-top-k certificate reads unsafe re-runs as a
+    solo exact batch (per lane — an unsafe lane must not tax its
+    groupmates with a stacked re-dispatch)."""
+    out, treedef, spec, (batches, request, mesh, k, valid), guard = \
+        dispatched
+    from ..common.deadline import check_cancelled
+    try:
+        check_cancelled("query-group readback")
+        profile = current_profile()
+        if profile is None:
+            packed = jax.device_get(out)
+        else:
+            with profile.phase(PHASE_EXECUTE, stage="readback"):
+                packed = jax.device_get(out)
+    except BaseException:
+        _finish_mesh_dispatch(guard, out)
+        raise
+    _finish_mesh_dispatch(guard)
+    results: list = []
+    for lane, batch in enumerate(batches):
+        if not valid[lane]:
+            results.append(None)
+            continue
+        row = packed[lane]
+        leaves, offset = [], 0
+        for shape, dtype in spec:
+            lane_shape = shape[1:]
+            size = int(np.prod(lane_shape)) if lane_shape else 1
+            leaves.append(row[offset: offset + size]
+                          .astype(dtype).reshape(lane_shape))
+            offset += size
+        top_vals, top_vals2, split_idx, doc_ids, scores, total, safe, \
+            merged_aggs = jax.tree_util.tree_unflatten(treedef, leaves)
+        if float(safe) < 1.0:
+            executor_mod._note_guided_fallback()
+            results.append(execute_batch(batch, request, mesh, exact=True))
+            continue
+        results.append(_decode_merged(
+            batch, k, top_vals, top_vals2, split_idx, doc_ids, scores,
+            int(total), merged_aggs))
+    return results
+
+
+def execute_query_group(batches: list, request: SearchRequest,
+                        mesh: Optional[Mesh] = None,
+                        valid=None) -> list:
+    """Run N shape-compatible queries over one split set as ONE (optionally
+    mesh-collective) dispatch; returns one LeafSearchResponse per lane
+    (None for lanes masked by `valid`)."""
+    return readback_query_group(
+        dispatch_query_group(batches, request, mesh, valid=valid))

@@ -1,10 +1,28 @@
-"""Cross-query dispatch coalescing.
+"""Cross-query dispatch coalescing and device-side multi-query batching.
 
-Concurrent queries that lower to the SAME plan structure (signature) and
-the SAME device arrays on one split differ only in their traced scalars
-(term idf, range bounds, agg origins, markers). The batcher executes such
-queries as ONE vmapped XLA program via `executor.dispatch_plan_multi` —
-one dispatch round + one packed readback for the whole batch.
+Two grouping regimes share this module's convoy machinery:
+
+* Convoy coalescing (the seed behavior, and the whole behavior under
+  `QW_DISABLE_QBATCH`): concurrent queries that lower to the SAME plan
+  structure (signature) and the SAME device arrays on one split differ
+  only in their traced scalars (term idf, range bounds, agg origins,
+  markers). The batcher executes such queries as ONE vmapped XLA program
+  via `executor.dispatch_plan_multi` — one dispatch round + one packed
+  readback for the whole batch.
+
+* Query-axis stacking (ROADMAP item 2, default): the `QueryGroupPlanner`
+  widens the grouping key to the STRUCTURAL signature only — N DISTINCT
+  queries (different terms, filters, thresholds, sort markers) over one
+  split group together as long as their lowered plans share a structure
+  digest. The group executes as ONE stacked dispatch
+  (`executor.dispatch_plan_stacked`): operand slots whose cache key
+  agrees across the group broadcast from the ResidentColumnStore, the
+  rest gain a leading query axis, per-query scalars (including each
+  query's killing threshold) ride [Q] lane vectors, and a validity mask
+  lane-zeroes riders shed AFTER group formation — a late cancel or
+  deadline never rebuilds or recompiles the group. Groups compose with
+  chunked execution (`chunkexec.execute_group_chunked`: carried state
+  grows a query dim, per-query masks at chunk boundaries).
 
 Why this exists (measured; tools/profile_tunnel.py): each dispatch round
 through a remote-TPU transport costs a fixed wall-clock overhead that
@@ -28,6 +46,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import os
 import time
 from typing import Any, Optional
 
@@ -37,14 +56,18 @@ from ..common.deadline import (
     current_cancel_token, current_deadline,
 )
 from ..observability.metrics import (
+    QBATCH_GROUPS_TOTAL, QBATCH_INCOMPATIBLE_TOTAL,
+    QBATCH_MASKED_RIDERS_TOTAL, QBATCH_QUERIES_PER_DISPATCH,
     SEARCH_BATCHER_DISPATCHES_TOTAL, SEARCH_BATCHER_QUERIES_TOTAL,
     SEARCH_BATCHER_QUEUE_WAIT, SEARCH_BATCHER_RATIO, SEARCH_SHED_TOTAL,
 )
-from ..observability.profile import PHASE_BATCHER_QUEUE, current_profile
+from ..observability.profile import (
+    PHASE_BATCHER_QUEUE, PHASE_QBATCH_GROUP, current_profile,
+)
 from ..tenancy.context import effective_tenant
 from ..tenancy.overload import OVERLOAD, OverloadShed
 from ..tenancy.registry import GLOBAL_TENANCY
-from . import executor
+from . import chunkexec, executor
 
 # Extra follower wait beyond its own deadline: the leader may be setting the
 # event at this very moment — shedding exactly at expiry would discard a
@@ -55,6 +78,15 @@ _FOLLOWER_SLACK_SECS = 0.05
 # a mid-wait cancel is observed promptly instead of after the full batch
 # round-trip (the shed-before-readback gap).
 _CANCEL_POLL_SECS = 0.05
+
+
+def qbatch_enabled() -> bool:
+    """Query-axis stacking kill switch: `QW_DISABLE_QBATCH=1` restores the
+    convoy-only seed behavior byte for byte (grouping key, dispatch path,
+    and metrics all revert). Read per call so tests and operators can flip
+    it without rebuilding the batcher."""
+    return os.environ.get("QW_DISABLE_QBATCH", "").strip().lower() not in (
+        "1", "true", "yes", "on")
 
 
 class _PriorityLock:
@@ -89,12 +121,21 @@ class _PriorityLock:
 
 
 class _Pending:
-    __slots__ = ("scalars", "event", "result", "error", "deadline",
-                 "enqueued_at", "profile", "cancel")
+    __slots__ = ("plan", "arrays", "scalars", "tbox", "tenant", "event",
+                 "result", "error", "deadline", "enqueued_at", "profile",
+                 "cancel")
 
     def __init__(self, scalars, deadline: Optional[Deadline] = None,
-                 profile=None, cancel: Optional[CancellationToken] = None):
+                 profile=None, cancel: Optional[CancellationToken] = None,
+                 plan=None, arrays=None, tbox=None, tenant=None):
         self.scalars = scalars
+        # query-axis stacking: each rider carries its OWN lowered plan and
+        # staged device arrays (distinct queries in one group), plus its
+        # ThresholdBox for per-lane tightening in chunked group scans
+        self.plan = plan
+        self.arrays = arrays
+        self.tbox = tbox
+        self.tenant = tenant
         self.event = sync.event()
         self.result: Any = None
         self.error: Exception | None = None
@@ -109,13 +150,64 @@ class _Pending:
         self.cancel = cancel
 
 
+class QueryGroupPlanner:
+    """Grouping rules for query-axis stacking (docs/query-batching.md).
+
+    Buckets queued queries by the STRUCTURAL compatibility signature —
+    `plan.structure_digest(k)` covers node sigs, sort spec, agg shape,
+    array shapes/dtypes (and therefore the padding bucket and column
+    families), scalar dtypes, and threshold/search_after/rebase PRESENCE —
+    plus the split identity. Queries agreeing on that key stack into one
+    dispatch regardless of their terms, filter bounds, threshold values,
+    or sort markers; per-slot shared-vs-stacked operand placement is
+    decided later from array cache keys (executor.stacked_slot_split).
+
+    Also the accounting point for why queries did NOT stack: reject
+    reasons are a bounded enum (`plan_shape` — an open group exists for
+    the same split with a different structure; `group_full` — the open
+    group hit max_batch), exported as qw_qbatch_incompatible_total."""
+
+    def __init__(self, max_batch: int = 16):
+        self.max_batch = max_batch
+
+    @staticmethod
+    def key_for(plan, k: int, split_key, stacking: bool) -> tuple:
+        group_key = getattr(plan, "group_key", None)
+        if stacking and group_key is not None:
+            return group_key(k, split_key)
+        # convoy key (seed behavior): the key carries the plan's array
+        # cache keys, so queries sharing a dispatch are guaranteed to read
+        # the very same device arrays (two terms of equal posting shape
+        # lower to the same signature but DIFFERENT arrays — under the
+        # kill switch they must not share)
+        return (plan.signature(k), tuple(plan.array_keys), split_key)
+
+    @staticmethod
+    def note_reject(open_queues, key, stacking: bool) -> None:
+        """Called (under the batcher lock) when a query LEADS a fresh
+        queue: attribute why it could not join an existing group."""
+        if not stacking:
+            return
+        full = open_queues.get(key)
+        if full:
+            QBATCH_INCOMPATIBLE_TOTAL.inc(reason="group_full")
+            return
+        split_key = key[2]
+        if any(other[0] == "qb" and other[2] == split_key
+               and other != key for other in open_queues):
+            QBATCH_INCOMPATIBLE_TOTAL.inc(reason="plan_shape")
+
+
+
 class QueryBatcher:
-    """Groups concurrent same-(signature, arrays, split) queries into one
-    multi-query dispatch. Thread-safe; every caller blocks only for its
-    own result."""
+    """Groups concurrent compatible queries into one device dispatch —
+    same-plan convoys always, DISTINCT shape-compatible queries when
+    query-axis stacking is enabled. Thread-safe; every caller blocks only
+    for its own result."""
 
     def __init__(self, max_batch: int = 16, fault_injector=None):
         self.max_batch = max_batch
+        self.planner = QueryGroupPlanner(max_batch)
         self._lock = sync.lock("QueryBatcher._lock")
         sync.register_shared(self, "QueryBatcher")
         self._queues: dict[tuple, list[_Pending]] = {}
@@ -141,8 +233,8 @@ class QueryBatcher:
         """Block until the leader serves `me`, bounded by the rider's own
         deadline AND its cancel token. A rider without a token waits in one
         shot (the seed path); with one, the wait polls in short slices so a
-        mid-flight cancel costs at most one slice — previously a rider
-        cancelled between dispatch and readback still paid the full wait."""
+        mid-flight cancel is observed promptly instead of after the full
+        batch round-trip (the shed-before-readback gap)."""
         bounded = me.deadline is not None and me.deadline.bounded
         if me.cancel is None:
             if not bounded:
@@ -174,14 +266,18 @@ class QueryBatcher:
             if me.event.wait(slice_secs):
                 return
 
-    def execute(self, plan, k: int, device_arrays, split_key) -> dict[str, Any]:
+    def execute(self, plan, k: int, device_arrays, split_key,
+                threshold_box=None, fault_injector=None) -> dict[str, Any]:
         """Run one query, possibly riding a shared dispatch. `split_key`
-        must uniquely identify the split (reader identity); the key also
-        carries the plan's array cache keys, so queries sharing a dispatch
-        are guaranteed to read the very same device arrays (two terms of
-        equal posting shape lower to the same signature but DIFFERENT
-        arrays — they must not share)."""
-        key = (plan.signature(k), tuple(plan.array_keys), split_key)
+        must uniquely identify the split (reader identity). With stacking
+        enabled the grouping key is the structural digest — distinct
+        queries group; under `QW_DISABLE_QBATCH` the key also carries the
+        plan's array cache keys, restoring the convoy-only behavior.
+        `threshold_box`/`fault_injector` thread the chunked-execution
+        context through group dispatches (leaf.py routes through the
+        batcher BEFORE the chunked check when stacking is on)."""
+        stacking = qbatch_enabled()
+        key = self.planner.key_for(plan, k, split_key, stacking)
         tenant = effective_tenant()
         # overload checkpoint: under sustained queue-wait pressure the
         # lowest-priority tenants are bounced before taking a batch slot
@@ -194,7 +290,8 @@ class QueryBatcher:
             # already-cancelled queries never take a batch slot
             cancel.check("batcher enqueue")
         me = _Pending(plan.scalars, current_deadline(), current_profile(),
-                      cancel)
+                      cancel, plan=plan, arrays=device_arrays,
+                      tbox=threshold_box, tenant=tenant)
         my_queue = None
         with self._lock:
             sync.note_write(self, "queues")
@@ -207,6 +304,7 @@ class QueryBatcher:
                 # new (or full) queue: lead a FRESH list. A full previous
                 # list stays owned by its own leader (it is popped by
                 # identity below), so its followers are never orphaned.
+                self.planner.note_reject(self._queues, key, stacking)
                 my_queue = [me]
                 self._queues[key] = my_queue
                 entry = self._dispatch_locks.setdefault(
@@ -263,8 +361,12 @@ class QueryBatcher:
                                                    pending.cancel.reason)
                     pending.event.set()
                 readback_fn = None
+                readback_targets = alive
                 try:
                     if alive:
+                        grouped = stacking and len(batch) > 1
+                        phase = (PHASE_QBATCH_GROUP if grouped
+                                 else PHASE_BATCHER_QUEUE)
                         now = time.monotonic()
                         for pending in alive:
                             wait = now - pending.enqueued_at
@@ -272,7 +374,7 @@ class QueryBatcher:
                             OVERLOAD.note_wait(wait)
                             if pending.profile is not None:
                                 pending.profile.record_phase(
-                                    PHASE_BATCHER_QUEUE, wait,
+                                    phase, wait,
                                     start=pending.enqueued_at,
                                     riders=len(alive))
                         with self._lock:
@@ -282,15 +384,29 @@ class QueryBatcher:
                                 self.num_queries / self.num_dispatches)
                         if self.fault_injector is not None:
                             self.fault_injector.perturb("batcher.dispatch")
-                        if len(alive) == 1 and alive[0] is me:
+                        if len(batch) == 1 and alive[0] is me:
                             # lone query: nobody queues behind a convoy of
                             # one, so dispatch + readback run inline — the
-                            # seed path, byte-identical latency profile
-                            results = [executor.execute_plan(plan, k,
-                                                             device_arrays)]
-                            for pending, result in zip(alive, results):
-                                pending.result = result
-                                pending.event.set()
+                            # seed path. With stacking on the chunked check
+                            # moved from the leaf into here (leaf routes
+                            # through the batcher first), so the solo rider
+                            # keeps its resumable scan.
+                            result = None
+                            if stacking and getattr(plan, "root",
+                                                    None) is not None:
+                                result = chunkexec.maybe_execute_chunked(
+                                    plan, k, device_arrays,
+                                    threshold_box=threshold_box,
+                                    fault_injector=fault_injector)
+                            if result is None:
+                                result = executor.execute_plan(
+                                    plan, k, device_arrays)
+                            alive[0].result = result
+                            alive[0].event.set()
+                        elif grouped:
+                            readback_targets, readback_fn = \
+                                self._dispatch_group(
+                                    batch, alive, k, fault_injector)
                         else:
                             dispatched = executor.dispatch_plan_multi(
                                 plan, k, device_arrays,
@@ -334,7 +450,12 @@ class QueryBatcher:
                             pending.event.set()
                     else:
                         results = readback_fn()
-                        for pending, result in zip(alive, results):
+                        for pending, result in zip(readback_targets,
+                                                   results):
+                            if pending.event.is_set():
+                                # masked lane: its error was already fanned
+                                # at the shed point (result is None/zeroed)
+                                continue
                             if (pending.cancel is not None
                                     and pending.cancel.cancelled):
                                 # cancelled after dispatch: the batch still
@@ -343,6 +464,10 @@ class QueryBatcher:
                                 pending.error = CancelledQuery(
                                     "batched readback",
                                     pending.cancel.reason)
+                            elif isinstance(result, Exception):
+                                # per-lane typed outcome from a chunked
+                                # group scan (lane cancel/deadline)
+                                pending.error = result
                             else:
                                 pending.result = result
                             pending.event.set()
@@ -362,6 +487,48 @@ class QueryBatcher:
         if me.error is not None:
             raise me.error
         return me.result
+
+    def _dispatch_group(self, batch, alive, k, fault_injector):
+        """One stacked dispatch for a formed query group. Shed riders stay
+        IN the lane list with valid=False (masked, zeroed readback) so the
+        compiled program is keyed only by the group's structure and
+        bucket — launch count stays 1 whatever happens between formation
+        and launch. Returns (readback_targets, readback_fn); the chunked
+        composition reads back inside the scan, so its readback_fn just
+        hands the per-lane outcomes through."""
+        alive_set = set(id(p) for p in alive)
+        valid = [id(p) in alive_set for p in batch]
+        masked = len(batch) - len(alive)
+        # a masked rider keeps ITS OWN operands in the stacked program
+        # (identical shapes — that is the grouping invariant), so nothing
+        # about the compiled program changes when it is shed; a rider with
+        # no plan at all (test-planted sentinels) borrows a live donor's,
+        # its lane being zeroed either way
+        donor = alive[0]
+        plans = [p.plan if p.plan is not None else donor.plan
+                 for p in batch]
+        arrays_list = [p.arrays if p.arrays is not None else donor.arrays
+                       for p in batch]
+        if len(alive) > 1:
+            QBATCH_GROUPS_TOTAL.inc()
+        QBATCH_QUERIES_PER_DISPATCH.observe(len(alive))
+        if masked:
+            QBATCH_MASKED_RIDERS_TOTAL.inc(masked)
+        from .residency import note_group_shared_staging
+        note_group_shared_staging(plans, len(alive))
+        group_res = chunkexec.execute_group_chunked(
+            plans, k, arrays_list, valid=valid,
+            tboxes=[p.tbox for p in batch],
+            deadlines=[p.deadline for p in batch],
+            cancels=[p.cancel for p in batch],
+            tenants=[p.tenant for p in batch],
+            fault_injector=fault_injector)
+        if group_res is not None:
+            return batch, (lambda r=group_res: r)
+        dispatched = executor.dispatch_plan_stacked(
+            plans, k, arrays_list, valid=valid)
+        return batch, (lambda d=dispatched:
+                       executor.readback_plan_stacked(d))
 
 
 def _waiter_error(err: Exception) -> Exception:

@@ -1059,6 +1059,210 @@ def readback_plan_multi(dispatched) -> list[dict[str, Any]]:
     return results
 
 
+# --- stacked query-group dispatch (ROADMAP item 2) ---------------------------
+#
+# N DISTINCT queries that share one plan STRUCTURE (same signature: shapes,
+# agg tree, sort spec, threshold/search_after presence) over one split's
+# resident arrays execute as ONE XLA program: operand slots whose cache key
+# matches across every query (columns, norms, shared postings) stay a single
+# broadcast buffer served from the ResidentColumnStore; slots whose key
+# differs (per-query postings, predicate masks) are stacked into a leading
+# [Q] query axis AT TRACE TIME (jnp.stack inside the jitted body — the
+# stack fuses into the program, so the group still costs exactly one device
+# dispatch). Per-query scalars — including each query's killing threshold
+# from its own ThresholdBox (`plan.threshold_slot` becomes a [Q] lane
+# vector) — ride the same stacked scalar path as the convoy batcher, and a
+# [Q] validity mask zeroes the packed rows of lanes shed AFTER group
+# formation (cancel/deadline) without changing the program shape: masking a
+# rider never recompiles.
+
+_STACKED_CACHE: dict[tuple, tuple] = {}
+
+
+def stacked_slot_split(plans) -> tuple[tuple[int, ...], tuple[int, ...]]:
+    """Partition array slots into (shared, stacked) by per-slot cache-key
+    agreement across the group. Array keys are content-addressed within a
+    split (``col.ts``, ``post.body=alpha#…``, ``mask.<digest>``), and a
+    group is only formed over one split (the grouping key carries the
+    split identity), so key equality at a slot ⇒ the queries reference the
+    same staged device buffer ⇒ the slot broadcasts; disagreement ⇒ the
+    slot gets the leading query axis."""
+    keys0 = plans[0].array_keys
+    shared, stacked = [], []
+    for slot, key in enumerate(keys0):
+        if all(p.array_keys[slot] == key for p in plans[1:]):
+            shared.append(slot)
+        else:
+            stacked.append(slot)
+    return tuple(shared), tuple(stacked)
+
+
+# qwlint: disable-next-line=QW001 - np.asarray on host scalar tuples for
+# jax.eval_shape (trace-time, no data movement)
+def _get_packed_stacked_executor(plan: LoweredPlan, k: int, bucket: int,
+                                 stacked_slots: tuple[int, ...],
+                                 device_arrays, exact: bool = False):
+    key = (plan.signature(k), bucket, stacked_slots, exact)
+    cached = _STACKED_CACHE.get(key)
+    if cached is None:
+        fn = _build(plan, k, exact)
+        nslots = len(plan.arrays)
+        shared_slots = tuple(s for s in range(nslots)
+                             if s not in stacked_slots)
+        example_args = (tuple(device_arrays),
+                        tuple(np.asarray(s) for s in plan.scalars),
+                        np.int32(plan.num_docs))
+        shaped = jax.eval_shape(fn, *example_args)
+        treedef = jax.tree_util.tree_structure(shaped)
+        spec = [(leaf.shape, leaf.dtype)
+                for leaf in jax.tree_util.tree_leaves(shaped)]
+
+        def assemble(shared_arrays, lane_arrays):
+            arrays = [None] * nslots
+            for i, s in enumerate(shared_slots):
+                arrays[s] = shared_arrays[i]
+            for i, s in enumerate(stacked_slots):
+                arrays[s] = lane_arrays[i]
+            return tuple(arrays)
+
+        def stacked(shared_arrays, lane_stacks, scal_b, nd_b, valid_b):
+            st = tuple(jnp.stack(qs) for qs in lane_stacks)
+            out = jax.vmap(
+                lambda lane, s, n: fn(assemble(shared_arrays, lane), s, n),
+                in_axes=(0, 0, 0))(st, scal_b, nd_b)
+            flat = [leaf.reshape(leaf.shape[0], -1).astype(jnp.float64)
+                    for leaf in jax.tree_util.tree_leaves(out)]
+            packed = (jnp.concatenate(flat, axis=1) if flat
+                      else jnp.zeros((bucket, 0)))
+            # masked lanes zero via where, NOT multiply: sort lanes hold
+            # -inf pads and -inf * 0 is NaN
+            return jnp.where(valid_b[:, None], packed, 0.0)
+
+        cached = (jax.jit(stacked), treedef, spec)
+        _STACKED_CACHE[key] = cached
+    return cached
+
+
+# qwlint: disable-next-line=QW001 - host-side scalar staging (stack +
+# single device_put); asarray/.item() run on numpy inputs pre-upload
+def _device_group_scalars(plans, use_cache=True):
+    """Per-slot [Q] scalar stacks + per-lane num_docs for a query group —
+    each query contributes its OWN scalar values (threshold, search_after
+    markers, rebase scale/min), stacked into query-axis lane vectors and
+    moved in one batched H2D transfer. Shares `_MULTI_SCALAR_CACHE` with
+    the convoy path (same content-addressed key space)."""
+    batch = len(plans)
+    key = None
+    if use_cache:
+        key = ("group", tuple(p.num_docs for p in plans), batch,
+               tuple(tuple((s.dtype.str, s.item())
+                           for s in map(np.asarray, p.scalars))
+                     for p in plans))
+        cached = _MULTI_SCALAR_CACHE.get(key)
+        if cached is not None:
+            return cached
+    stacked = [np.stack([np.asarray(p.scalars[slot]) for p in plans])
+               for slot in range(len(plans[0].scalars))]
+    nd_b = np.asarray([p.num_docs for p in plans], np.int32)
+    moved = jax.device_put(stacked + [nd_b])
+    cached = (tuple(moved[:-1]), moved[-1])
+    if key is not None:
+        if len(_MULTI_SCALAR_CACHE) >= _MULTI_SCALAR_CACHE_CAP:
+            _MULTI_SCALAR_CACHE.pop(next(iter(_MULTI_SCALAR_CACHE)))
+        _MULTI_SCALAR_CACHE[key] = cached
+    return cached
+
+
+def dispatch_plan_stacked(plans, k: int, arrays_list, valid=None,
+                          cache_scalars: bool = True,
+                          exact: bool = False) -> tuple:
+    """Async dispatch of len(plans) shape-compatible DISTINCT queries as
+    ONE XLA program + ONE packed [Q, total] readback buffer. `plans[i]`
+    and `arrays_list[i]` are query i's lowered plan and staged device
+    arrays; all plans must share `signature(k)` (the QueryGroupPlanner
+    guarantees this). `valid[i] = False` masks lane i out of the readback
+    (zeroed row) without changing the compiled program — the late-shed
+    rider path. Lane count pads to a power-of-two bucket (surplus lanes
+    repeat the last query, pre-masked invalid)."""
+    base = plans[0]
+    k = max(0, min(k, base.num_docs_padded))
+    SEARCH_KERNEL_LAUNCHES_TOTAL.inc()
+    batch = len(plans)
+    bucket = _batch_bucket(batch)
+    if valid is None:
+        valid = [True] * batch
+    pad = bucket - batch
+    plans_b = list(plans) + [plans[-1]] * pad
+    arrays_b = list(arrays_list) + [arrays_list[-1]] * pad
+    valid_b = np.zeros(bucket, np.bool_)
+    valid_b[:batch] = list(valid)
+    shared_slots, stacked_slots = stacked_slot_split(plans_b)
+    scal_b, nd_b = _device_group_scalars(plans_b, use_cache=cache_scalars)
+    shared_arrays = tuple(arrays_b[0][s] for s in shared_slots)
+    lane_stacks = tuple(tuple(arrays_b[q][s] for q in range(bucket))
+                        for s in stacked_slots)
+    valid_dev = jax.device_put(valid_b)
+    profile = current_profile()
+    if profile is None:
+        executor, treedef, spec = _get_packed_stacked_executor(
+            base, k, bucket, stacked_slots, arrays_b[0], exact)
+        out = executor(shared_arrays, lane_stacks, scal_b, nd_b, valid_dev)
+    else:
+        hit = (base.signature(k), bucket, stacked_slots,
+               exact) in _STACKED_CACHE
+        profile.add("compile_cache_hits" if hit else "compile_cache_misses")
+        with profile.phase(PHASE_EXECUTE if hit else PHASE_COMPILE,
+                           stage="dispatch_stacked"):
+            executor, treedef, spec = _get_packed_stacked_executor(
+                base, k, bucket, stacked_slots, arrays_b[0], exact)
+            out = executor(shared_arrays, lane_stacks, scal_b, nd_b,
+                           valid_dev)
+    if hasattr(out, "copy_to_host_async"):
+        out.copy_to_host_async()
+    return out, treedef, spec, batch, (list(plans), k, list(arrays_list),
+                                       list(valid), cache_scalars)
+
+
+# qwlint: disable-next-line=QW001 - stacked variant of the sanctioned
+# packed-readback seam; one transfer for the whole query group
+def readback_plan_stacked(dispatched) -> list:
+    """ONE device→host transfer for the whole query group; per-lane
+    unpack. Masked lanes come back as None (their packed row was zeroed on
+    device). Valid lanes whose guided top-k screen reports `safe == 0`
+    are re-dispatched as one exact stacked group and spliced back in —
+    per-query tie-breaks therefore stay bit-identical to solo execution."""
+    packed, treedef, spec, batch, redispatch = dispatched
+    plans, k, arrays_list, valid, cache_scalars = redispatch
+    host = np.asarray(_profiled_device_get(packed))
+    results: list = []
+    unsafe_lanes = []
+    for lane in range(batch):
+        if not valid[lane]:
+            results.append(None)
+            continue
+        sort_vals, sort_vals2, doc_ids, hit_scores, count, topk_safe, \
+            agg_out = _unpack_result(host[lane], treedef, spec)
+        if float(topk_safe) < 1.0:
+            unsafe_lanes.append(lane)
+        results.append({
+            "sort_values": sort_vals,
+            "sort_values2": sort_vals2,
+            "doc_ids": doc_ids,
+            "scores": hit_scores,
+            "count": int(count),
+            "aggs": list(agg_out),
+        })
+    if unsafe_lanes:
+        _note_guided_fallback(len(unsafe_lanes))
+        exact = readback_plan_stacked(dispatch_plan_stacked(
+            [plans[lane] for lane in unsafe_lanes], k,
+            [arrays_list[lane] for lane in unsafe_lanes],
+            cache_scalars=cache_scalars, exact=True))
+        for lane, res in zip(unsafe_lanes, exact):
+            results[lane] = res
+    return results
+
+
 def dispatch_plan(plan: LoweredPlan, k: int,
                   device_arrays: list[jax.Array], exact: bool = False):
     """Async dispatch: returns (packed_device_array, treedef, spec, ...)
@@ -1150,8 +1354,9 @@ def executor_cache_size() -> int:
 # seam), with zero compilation, zero data movement, and zero devices
 # touched. The `*_cache_key` mirrors must stay in lockstep with the
 # dict-key expressions in `get_executor` / `_get_packed_executor` /
-# `_get_packed_multi_executor` / `compute_packed_mask` — the R1 closure
-# certificate is only a proof if the audited key IS the cache key.
+# `_get_packed_multi_executor` / `_get_packed_stacked_executor` /
+# `compute_packed_mask` — the R1 closure certificate is only a proof if
+# the audited key IS the cache key.
 
 def program_cache_key(plan: LoweredPlan, k: int, exact: bool = False) -> tuple:
     """The `_JIT_CACHE`/`_PACKED_CACHE` key for this plan, post k-clamp."""
@@ -1164,6 +1369,65 @@ def multi_program_cache_key(plan: LoweredPlan, k: int, batch: int,
     """The `_MULTI_CACHE` key (batch already bucketed by the caller)."""
     k = max(0, min(k, plan.num_docs_padded))
     return (plan.signature(k), batch, exact)
+
+
+def stacked_program_cache_key(plans, k: int, bucket=None,
+                              exact: bool = False) -> tuple:
+    """The `_STACKED_CACHE` key for a query group — MUST stay in lockstep
+    with the dict-key expression in `_get_packed_stacked_executor` (same
+    R1 lockstep contract as the other mirrors above)."""
+    base = plans[0]
+    k = max(0, min(k, base.num_docs_padded))
+    if bucket is None:
+        bucket = _batch_bucket(len(plans))
+    _, stacked_slots = stacked_slot_split(plans)
+    return (base.signature(k), bucket, stacked_slots, exact)
+
+
+def abstract_stacked_program(plans, k: int, bucket=None,
+                             exact: bool = False):
+    """ClosedJaxpr of the stacked query-group program for one batch bucket
+    (the closure `_get_packed_stacked_executor` jits, minus the packed f64
+    concat — audited separately as the sanctioned seam; the validity mask
+    is applied per-leaf so the zeroed-readback semantics stay in the
+    audited body)."""
+    base = plans[0]
+    k = max(0, min(k, base.num_docs_padded))
+    if bucket is None:
+        bucket = _batch_bucket(len(plans))
+    fn = _build(base, k, exact)
+    nslots = len(base.arrays)
+    shared_slots, stacked_slots = stacked_slot_split(plans)
+    arrays, scalars, _ = _abstract_inputs(base)
+    shared = tuple(arrays[s] for s in shared_slots)
+    lane_stacks = tuple(tuple(arrays[s] for _ in range(bucket))
+                        for s in stacked_slots)
+    scal_b = tuple(jax.ShapeDtypeStruct((bucket,) + s.shape, s.dtype)
+                   for s in scalars)
+    nd_b = jax.ShapeDtypeStruct((bucket,), np.int32)
+    valid_b = jax.ShapeDtypeStruct((bucket,), np.bool_)
+
+    def assemble(shared_arrays, lane_arrays):
+        out = [None] * nslots
+        for i, s in enumerate(shared_slots):
+            out[s] = shared_arrays[i]
+        for i, s in enumerate(stacked_slots):
+            out[s] = lane_arrays[i]
+        return tuple(out)
+
+    def stacked(shared_arrays, lane_stacks, scal_b, nd_b, valid_b):
+        st = tuple(jnp.stack(qs) for qs in lane_stacks)
+        out = jax.vmap(
+            lambda lane, s, n: fn(assemble(shared_arrays, lane), s, n),
+            in_axes=(0, 0, 0))(st, scal_b, nd_b)
+        return jax.tree_util.tree_map(
+            lambda leaf: jnp.where(
+                valid_b.reshape((bucket,) + (1,) * (leaf.ndim - 1)),
+                leaf, jnp.zeros_like(leaf)),
+            out)
+
+    return jax.make_jaxpr(stacked)(shared, lane_stacks, scal_b, nd_b,
+                                   valid_b)
 
 
 def mask_fill_cache_key(plan: LoweredPlan) -> tuple:

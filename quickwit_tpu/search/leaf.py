@@ -294,15 +294,27 @@ def execute_prepared_split(
     # fused splits register with the preempt gate too: their presence is
     # what tells a running chunked scan that interactive work is waiting
     with PREEMPT_GATE.running(effective_tenant().priority):
-        result = maybe_execute_chunked(plan, k, device_arrays,
-                                       threshold_box=threshold_box,
-                                       fault_injector=fault_injector)
-        if result is None:
-            if batcher is not None:
-                result = batcher.execute(plan, k, device_arrays,
-                                         split_key=id(reader))
-            else:
-                result = execute_plan(plan, k, device_arrays)
+        from .batcher import qbatch_enabled
+        if batcher is not None and qbatch_enabled():
+            # query-axis stacking: the batcher must see the query BEFORE
+            # the chunked check so distinct shape-compatible queries can
+            # group; solo riders and formed groups both keep resumable
+            # chunked semantics inside the batcher (execute_group_chunked
+            # / maybe_execute_chunked)
+            result = batcher.execute(plan, k, device_arrays,
+                                     split_key=id(reader),
+                                     threshold_box=threshold_box,
+                                     fault_injector=fault_injector)
+        else:
+            result = maybe_execute_chunked(plan, k, device_arrays,
+                                           threshold_box=threshold_box,
+                                           fault_injector=fault_injector)
+            if result is None:
+                if batcher is not None:
+                    result = batcher.execute(plan, k, device_arrays,
+                                             split_key=id(reader))
+                else:
+                    result = execute_plan(plan, k, device_arrays)
     # cancelled mid-scan with partial_on_cancel: keep the chunks already
     # merged, flag the split so the root's response carries cancelled=true
     # qwlint: disable-next-line=QW001 - "partial" is a host bool stamped by

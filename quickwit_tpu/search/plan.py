@@ -351,7 +351,11 @@ class LoweredPlan:
     # dynamic top-K threshold pushdown: traced f64 scalar (internal
     # higher-is-better key) masking sub-threshold docs before top_k. Like
     # search_after, only PRESENCE is static — the value rides a scalar slot
-    # so the compiled executable is reused across threshold values.
+    # so the compiled executable is reused across threshold values. Under
+    # a stacked multi-query dispatch (search/batcher.py QueryGroupPlanner)
+    # every scalar slot — this one included — widens to a [Q] lane vector:
+    # each query lane carries its OWN killing threshold, masked per lane
+    # inside the one compiled program (executor.dispatch_plan_stacked).
     threshold_slot: int = -1
     # FOR-packed value loads: array slot -> (scale_slot, min_slot) traced
     # scalars. Consumers that need actual values (sort keys, metric/bucket
@@ -398,6 +402,17 @@ class LoweredPlan:
         import hashlib
         return hashlib.blake2b(repr(self.signature(k)).encode(),
                                digest_size=16).hexdigest()
+
+    def group_key(self, k: int, split_key) -> tuple:
+        """Grouping key for device-side multi-query stacking: two queries
+        whose plans agree on this key are shape-compatible — same lowered
+        structure (node sigs, sort spec, agg shape, array shapes/dtypes,
+        scalar dtypes, threshold/search_after/rebase presence) over the
+        same split — and may stack as lanes of ONE compiled dispatch with
+        their terms/filters/thresholds riding stacked operands
+        (docs/query-batching.md). Deliberately WIDER than the convoy key
+        (which also pins `array_keys`): distinct queries are the point."""
+        return ("qb", self.structure_digest(k), split_key)
 
 
 class _Builder:

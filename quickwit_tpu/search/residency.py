@@ -70,6 +70,29 @@ RESIDENT_READBACKS_SHED = METRICS.counter(
     "Async readbacks skipped because every rider's deadline had expired")
 
 
+def note_group_shared_staging(plans, live_lanes: int) -> int:
+    """Residency accounting for a stacked multi-query dispatch
+    (search/batcher.py): operand slots whose cache key agrees across the
+    group are staged ONCE and broadcast to every lane — each such slot is
+    a (live_lanes - 1)-fold device_put the resident store did not have to
+    absorb. Records the avoided bytes under the qbatch family and the
+    per-column hit counters (the shared slots ARE resident-store serves:
+    identical keys alias the same staged buffer), returns the byte
+    count."""
+    if live_lanes <= 1 or not plans:
+        return 0
+    from .executor import stacked_slot_split
+    shared_slots, _stacked = stacked_slot_split(plans)
+    if not shared_slots:
+        return 0
+    nbytes = sum(plans[0].arrays[s].nbytes for s in shared_slots) \
+        * (live_lanes - 1)
+    from ..observability.metrics import QBATCH_SHARED_BYTES_AVOIDED_TOTAL
+    QBATCH_SHARED_BYTES_AVOIDED_TOTAL.inc(nbytes)
+    RESIDENT_COLUMN_HITS.inc(len(shared_slots) * (live_lanes - 1))
+    return nbytes
+
+
 def mesh_stack_id(split_ids, num_docs_padded: int, mesh) -> str:
     """Stable residency key for one mesh-stacked column set.
 

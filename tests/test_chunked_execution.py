@@ -470,9 +470,11 @@ def test_batcher_follower_cancel_unblocks_promptly(big_reader):
     plan = lower_request(Term("body", "alpha"), MAPPER, big_reader, [])
     k = 10
     batcher = QueryBatcher()
-    key = (plan.signature(k), tuple(plan.array_keys), "split")
+    from quickwit_tpu.search.batcher import _Pending, qbatch_enabled
+    # the batcher's own grouping key (structure digest under query-axis
+    # stacking, signature+array_keys under QW_DISABLE_QBATCH)
+    key = batcher.planner.key_for(plan, k, "split", qbatch_enabled())
     # a stuck convoy: its leader never dispatches, so our rider waits
-    from quickwit_tpu.search.batcher import _Pending
     batcher._queues[key] = [_Pending(plan.scalars)]
     token = CancellationToken()
     threading.Timer(0.1, lambda: token.cancel("user gave up")).start()
@@ -518,8 +520,10 @@ def test_batcher_leader_sheds_cancelled_rider(big_reader):
 
     # enqueue the doomed rider as a follower behind a held dispatch lock,
     # cancel it, then let the leader dispatch for the live one
-    from quickwit_tpu.search.batcher import _Pending, _PriorityLock
-    key = (plan.signature(k), tuple(plan.array_keys), "s")
+    from quickwit_tpu.search.batcher import (
+        _Pending, _PriorityLock, qbatch_enabled,
+    )
+    key = batcher.planner.key_for(plan, k, "s", qbatch_enabled())
     entry = batcher._dispatch_locks.setdefault(key, [_PriorityLock(), 1])
     entry[0].acquire()  # hold: the leader blocks before dispatching
     leader = threading.Thread(target=rider, args=("live", None), daemon=True)

@@ -78,6 +78,41 @@ def test_cli_list_json(capsys):
     assert "exactly_once_publish" in out["invariants"]
 
 
+def test_fanout_dashboard_op_forms_query_groups():
+    """The fanout scenario's dashboard op drives N concurrent
+    shape-compatible panel searches through one node's real batcher:
+    sweeping a few seeds must (a) actually materialize dashboard ops —
+    including at least one with a shed (pre-cancelled) panel, (b) pass the
+    cache≡cold / cancel-responsiveness audits on every panel lane, and
+    (c) form at least one multi-query group on the device (the counter
+    the whole feature exists to move)."""
+    from quickwit_tpu.observability.metrics import QBATCH_GROUPS_TOTAL
+
+    scenario = SCENARIOS["fanout"]
+    groups0 = QBATCH_GROUPS_TOTAL.get()
+    seen_dashboard = seen_shed = False
+    for seed in range(4):
+        ops = scenario.materialize(seed)
+        dash = [op for op in ops if op["kind"] == "dashboard"]
+        seen_dashboard = seen_dashboard or bool(dash)
+        seen_shed = seen_shed or any(op["cancel_panel"] for op in dash)
+        result = run_scenario(scenario, seed,
+                              break_publish=False, break_wal=False)
+        assert result.ok, [v.to_dict() for v in result.violations]
+        for ev in result.trace.events:
+            if ev["kind"] != "op" or ev["op"].get("kind") != "dashboard":
+                continue
+            out = ev["result"]
+            assert len(out["panels"]) == ev["op"]["panels"]
+            shed = out.get("cancelled_panel")
+            if shed is not None and "error" not in shed:
+                assert shed["registry_drained"] and not shed["num_hits"]
+    assert seen_dashboard, "fanout weights must draw dashboard ops"
+    assert seen_shed, "at least one dashboard must shed a panel"
+    assert QBATCH_GROUPS_TOTAL.get() - groups0 >= 1, \
+        "concurrent shape-compatible panels never formed a device group"
+
+
 @pytest.mark.slow
 def test_mixed_200_seed_sweep():
     """The acceptance sweep: 200 seeds of the mixed scenario — ingest with

@@ -354,6 +354,69 @@ def test_chunked_kernel_metrics_expose_with_strict_grammar():
     assert inf >= 2.0
 
 
+def test_qbatch_metrics_expose_with_strict_grammar():
+    """The device-side multi-query batching families (search/batcher.py,
+    search/executor.py stacked path) must ride the strict exposition:
+    four counters and the queries-per-dispatch histogram announce
+    HELP/TYPE, reject reasons stay the bounded enum, and the histogram
+    keeps +Inf == _count. Metrics are process-global, so assert on
+    before/after deltas."""
+    from quickwit_tpu.observability.metrics import (
+        QBATCH_GROUPS_TOTAL, QBATCH_INCOMPATIBLE_TOTAL,
+        QBATCH_MASKED_RIDERS_TOTAL, QBATCH_QUERIES_PER_DISPATCH,
+        QBATCH_SHARED_BYTES_AVOIDED_TOTAL,
+    )
+    counter_names = ("qw_qbatch_groups_total",
+                     "qw_qbatch_incompatible_total",
+                     "qw_qbatch_masked_riders_total",
+                     "qw_qbatch_shared_bytes_avoided_total")
+
+    def snapshot():
+        parsed = parse_exposition(METRICS.expose_text())
+        return {name: sum(parsed.get(name, {}).values())
+                for name in counter_names}
+
+    before = snapshot()
+    # one 4-wide group where one rider was shed post-formation (masked,
+    # 3 live lanes), sharing 8 KiB of broadcast column slots; plus two
+    # rejected joiners, one per bounded reason
+    QBATCH_GROUPS_TOTAL.inc()
+    QBATCH_QUERIES_PER_DISPATCH.observe(3.0)
+    QBATCH_MASKED_RIDERS_TOTAL.inc()
+    QBATCH_SHARED_BYTES_AVOIDED_TOTAL.inc(8192)
+    QBATCH_INCOMPATIBLE_TOTAL.inc(reason="plan_shape")
+    QBATCH_INCOMPATIBLE_TOTAL.inc(reason="group_full")
+
+    text = METRICS.expose_text()
+    parsed = parse_exposition(text)
+    after = snapshot()
+    for name in counter_names:
+        assert name in parsed, f"{name} missing from exposition"
+        assert f"# HELP {name} " in text
+        assert f"# TYPE {name} counter" in text
+    assert "# TYPE qw_qbatch_queries_per_dispatch histogram" in text
+    assert after["qw_qbatch_groups_total"] - \
+        before["qw_qbatch_groups_total"] == 1
+    assert after["qw_qbatch_masked_riders_total"] - \
+        before["qw_qbatch_masked_riders_total"] == 1
+    assert after["qw_qbatch_shared_bytes_avoided_total"] - \
+        before["qw_qbatch_shared_bytes_avoided_total"] == 8192
+    assert after["qw_qbatch_incompatible_total"] - \
+        before["qw_qbatch_incompatible_total"] == 2
+    # reject reasons are the bounded enum, never request-derived text
+    reasons = {dict(k).get("reason")
+               for k in parsed["qw_qbatch_incompatible_total"]}
+    assert reasons <= {"plan_shape", "group_full"}
+    # the width histogram keeps the bucket invariant (+Inf == _count)
+    bucket = parsed["qw_qbatch_queries_per_dispatch_bucket"]
+    inf = next(v for k, v in bucket.items() if dict(k).get("le") == "+Inf")
+    assert inf == parsed["qw_qbatch_queries_per_dispatch_count"][()]
+    assert inf >= 1.0
+    # the observed 3-lane group lands in the le=4 bucket
+    le4 = next(v for k, v in bucket.items() if dict(k).get("le") == "4")
+    assert le4 >= 1.0
+
+
 def test_hierarchical_cache_metrics_expose_with_strict_grammar():
     """Drive every hierarchical-cache tier (leaf response, term-absence
     predicate cache, predicate-mask, partial-agg) through a real hit, miss,

@@ -118,6 +118,28 @@ def test_r1_catches_cache_key_drift():
     assert [f.site for f in hits] == ["closure:cache_key"]
 
 
+def test_r1_catches_cache_key_drift_in_stacked_program():
+    """Key drift planted through the REAL stacked query-group programs:
+    if `executor.stacked_program_cache_key` (or `fanout.group_cache_key`)
+    stops mirroring what the dispatch path actually caches on — e.g. the
+    [Q] validity mask leaking into the key, which would force a
+    recompile whenever a rider is shed — R1 must flag exactly the
+    drifted stacked entry, not its neighbours."""
+    from tools.qwir.corpus import build_corpus
+    stacked = [s for s in build_corpus()
+               if s.name.startswith(("stacked/", "stacked_chunked/",
+                                     "group_mesh/"))]
+    assert len(stacked) == 3, "expected the three stacked corpus entries"
+    programs = describe_programs(stacked)
+    pinned = manifest_from_programs(programs)
+    drifted = {k: dict(v) for k, v in programs.items()}
+    target = "stacked/v3/term/q2/k10"
+    drifted[target]["cache_key"] = "f" * 32
+    hits = check_closure(drifted, pinned)
+    assert [f.site for f in hits] == ["closure:cache_key"]
+    assert hits[0].program == target
+
+
 def test_liveness_peak_counts_the_planted_temp():
     spec = planted_hbm_blowup()
     # the planted 2048x16384 f64 pairwise temp alone is 256 MiB

@@ -263,6 +263,35 @@ def build_corpus() -> list[ProgramSpec]:
             doc_lanes=plan.num_docs_padded * bucket,
             num_docs_padded=plan.num_docs_padded))
 
+    # -- stacked query-group programs (device-side multi-query batching) -
+    # DISTINCT shape-compatible queries as lanes of ONE program
+    # (search/batcher.py QueryGroupPlanner → executor.dispatch_plan_stacked):
+    # shared slots broadcast, query-shaped slots and scalars ride a leading
+    # [Q] axis, and the [Q] validity mask is an operand — same kind/rule
+    # treatment as the vmapped convoy programs ("multi")
+    from quickwit_tpu.query.ast import Term as _Term
+    sev_plans = [lower_request(_Term("severity_text", s), mapper,
+                               readers["v3"], []) for s in ("ERROR", "INFO")]
+    sev_sigs = {p.structure_digest(10) for p in sev_plans}
+    assert len(sev_sigs) == 1, "corpus stacked lanes must be shape-compatible"
+    closed = executor.abstract_stacked_program(sev_plans, 10)
+    specs.append(ProgramSpec(
+        name="stacked/v3/term/q2/k10", kind="multi", closed=closed,
+        cache_key=executor.stacked_program_cache_key(sev_plans, 10),
+        doc_lanes=sev_plans[0].num_docs_padded * 2,
+        num_docs_padded=sev_plans[0].num_docs_padded))
+    # stacked × chunked: the group scan dispatches chunk sub-plans of every
+    # lane as one stacked program per chunk (chunkexec.execute_group_chunked)
+    sev_chunks = [chunkexec.posting_chunk_plan(p, 0, POSTING_PAD)
+                  for p in sev_plans]
+    closed = executor.abstract_stacked_program(sev_chunks, 10)
+    specs.append(ProgramSpec(
+        name="stacked_chunked/v3/term_posting/q2/k10", kind="multi",
+        closed=closed,
+        cache_key=executor.stacked_program_cache_key(sev_chunks, 10),
+        doc_lanes=sev_chunks[0].num_docs_padded * 2,
+        num_docs_padded=sev_chunks[0].num_docs_padded))
+
     # -- fused multi-split batch programs (parallel/fanout.py) -----------
     from quickwit_tpu.search import SearchRequest, SortField
 
@@ -327,6 +356,40 @@ def build_corpus() -> list[ProgramSpec]:
                                          "fixed_interval": "1h"},
                       "aggs": {"lat_avg": {"avg": {"field": "latency"}}}}}),
               0, ("v3", "v3b"), mesh21)
+
+    # -- stacked query-group mesh program (query axis x splits x docs) ---
+    # Q distinct queries over the SAME split set fused into one shard_map
+    # dispatch: the query axis is vmapped inside every device shard, and
+    # the pmax threshold exchange / all_gather merge / segment agg
+    # reduction run per query lane — R4 audits the collectives against the
+    # same ("splits", "docs") axes as the single-query mesh programs.
+    # Range windows over the timestamp zonemap are shape-compatible by
+    # construction (scalar bounds only; no per-query array operands).
+    from quickwit_tpu.query.ast import Range as _Range, \
+        RangeBound as _RangeBound
+
+    def _window(lo_min, hi_min):
+        return _Range("timestamp",
+                      lower=_RangeBound((T0 + 60 * lo_min) * 10**6, True),
+                      upper=_RangeBound((T0 + 60 * hi_min) * 10**6, False))
+
+    group_batches = [
+        fanout.build_batch(
+            SearchRequest(index_ids=["t"], query_ast=_window(lo, hi),
+                          max_hits=10,
+                          sort_fields=[SortField("timestamp", "desc")]),
+            mapper, [readers["v3"], readers["v3b"]], ["v3", "v3b"])
+        for (lo, hi) in ((0, 120), (40, 200))]
+    group_sigs = {b.template.signature(10) for b in group_batches}
+    assert len(group_sigs) == 1, \
+        "corpus query-group lanes must be shape-compatible"
+    closed = fanout.abstract_group_mesh_program(group_batches, 10, mesh21)
+    specs.append(ProgramSpec(
+        name="group_mesh/v3/range/q2/n2/2x1/k10", kind="mesh", closed=closed,
+        cache_key=fanout.group_cache_key(group_batches, 10, mesh=mesh21),
+        doc_lanes=(group_batches[0].num_docs_padded
+                   * group_batches[0].n_splits * 2),
+        num_docs_padded=group_batches[0].num_docs_padded))
 
     # -- Tier-A predicate-mask fill kernel -------------------------------
     plan = lower_request(bool_range, mapper, readers["v3"], [],
