@@ -1629,6 +1629,70 @@ def _measure_query_batch(iters: int) -> dict:
     return out
 
 
+def _measure_flight_overhead(iters: int) -> dict:
+    """Config #15: flight-recorder overhead on the c1-class warm path.
+    One warm solo dispatch loop over a resident synthetic split, timed
+    with the recorder ON (every dispatch emits compile/launch/readback
+    events into the per-thread ring) and OFF (`FLIGHT.disable()`: emit is
+    one attribute check). Samples alternate on/off to cancel thermal and
+    cache drift; each sample times a small batch of executes. Scored
+    acceptance: warm p50 overhead < 2%."""
+    from quickwit_tpu.index.synthetic import HDFS_MAPPER
+    from quickwit_tpu.observability.flight import FLIGHT
+    from quickwit_tpu.query.ast import Term
+    from quickwit_tpu.search import executor as ex
+    from quickwit_tpu.search.leaf import prepare_single_split
+    from quickwit_tpu.search.models import SearchRequest
+
+    # the literal c1 workload (term + top-10 over the NUM_DOCS hdfs split,
+    # cached split bytes shared with the c1 run): the <2% bound is against
+    # the real warm path, not a toy corpus where the fixed per-dispatch
+    # emit cost would dominate
+    docs = int(os.environ.get("BENCH_FLIGHT_DOCS", NUM_DOCS))
+    k = 10
+    reader = _hdfs_reader(docs)
+    request = SearchRequest(
+        index_ids=["hdfs-logs"],
+        query_ast=Term("severity_text", "ERROR"), max_hits=k)
+    plan, arrays, _warm = prepare_single_split(
+        request, HDFS_MAPPER, reader, "f")
+    # warm: compile once, device arrays staged
+    ex.execute_plan(plan, k, arrays)
+    ex.execute_plan(plan, k, arrays)
+
+    samples = max(iters * 3, 30)
+    per_sample = 4
+    on_times, off_times = [], []
+    was_recording = FLIGHT.recording()
+    try:
+        for i in range(samples):
+            enabled = (i % 2 == 0)
+            (FLIGHT.enable if enabled else FLIGHT.disable)()
+            t0 = time.monotonic()
+            for _ in range(per_sample):
+                ex.execute_plan(plan, k, arrays)
+            (on_times if enabled else off_times).append(
+                (time.monotonic() - t0) / per_sample)
+    finally:
+        (FLIGHT.enable if was_recording else FLIGHT.disable)()
+    on50 = _percentile(on_times, 0.5)
+    off50 = _percentile(off_times, 0.5)
+    overhead_pct = (on50 - off50) / max(off50, 1e-9) * 100.0
+    stats = FLIGHT.stats()
+    assert stats["events"] > 0, "recorder captured nothing while enabled"
+    assert overhead_pct < 2.0, \
+        f"flight recorder warm overhead {overhead_pct:.2f}% >= 2%"
+    return {
+        "docs": docs,
+        "samples_per_mode": samples // 2,
+        "recording_p50_ms": round(on50 * 1000, 3),
+        "disabled_p50_ms": round(off50 * 1000, 3),
+        "warm_overhead_pct": round(overhead_pct, 2),
+        "events_buffered": stats["events"],
+        "e2e_ms": round(on50 * 1000, 3),
+    }
+
+
 def _run_all(iters: int, with_device_loops: bool = True) -> dict:
     results: dict = {}
     workloads = _workloads()
@@ -1673,6 +1737,11 @@ def _run_all(iters: int, with_device_loops: bool = True) -> dict:
         results["c14_query_batch"] = _measure_query_batch(max(3, iters // 3))
         print(f"# c14_query_batch: "
               f"{json.dumps(results['c14_query_batch'])}", file=sys.stderr)
+        results["c15_flight_recorder"] = _measure_flight_overhead(
+            max(3, iters // 3))
+        print(f"# c15_flight_recorder: "
+              f"{json.dumps(results['c15_flight_recorder'])}",
+              file=sys.stderr)
         c13 = _measure_multichip()
         if c13 is not None:
             results["c13_multichip"] = c13

@@ -297,6 +297,32 @@ def cmd_tool_extract_split(args) -> int:
     return 0
 
 
+def cmd_trace_export(args) -> int:
+    """Export the flight recorder as Chrome trace-event / Perfetto JSON —
+    from a running node's `/api/v1/developer/trace` endpoint when
+    `--endpoint` is given, else from this process's own recorder (useful
+    after an in-process repro or bench run)."""
+    if args.endpoint:
+        import urllib.request
+        base = (args.endpoint if "://" in args.endpoint
+                else f"http://{args.endpoint}")
+        url = base.rstrip("/") + "/api/v1/developer/trace"
+        if args.limit:
+            url += f"?limit={int(args.limit)}"
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            trace = json.loads(resp.read().decode("utf-8"))
+    else:
+        from .observability.flight import FLIGHT
+        trace = FLIGHT.to_chrome_trace(limit=args.limit or None)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(trace, f)
+        f.write("\n")
+    events = len(trace.get("traceEvents", []))
+    print(f"wrote {events} trace events to {args.out} "
+          f"(load in Perfetto / chrome://tracing)")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="quickwit-tpu",
@@ -379,6 +405,19 @@ def build_parser() -> argparse.ArgumentParser:
     split_mark.add_argument("--splits", required=True,
                             help="comma-separated split ids")
     split_mark.set_defaults(func=cmd_split_mark_for_deletion)
+
+    trace = sub.add_parser("trace", help="flight-recorder trace export")
+    trace_sub = trace.add_subparsers(dest="subcommand", required=True)
+    trace_export = trace_sub.add_parser(
+        "export", help="write the device timeline as Perfetto JSON")
+    trace_export.add_argument("--out", required=True,
+                              help="output path (e.g. trace.json)")
+    trace_export.add_argument("--endpoint", default=None,
+                              help="running node's REST host:port "
+                                   "(default: this process's recorder)")
+    trace_export.add_argument("--limit", type=int, default=0,
+                              help="max events (0 = everything buffered)")
+    trace_export.set_defaults(func=cmd_trace_export)
 
     tool = sub.add_parser("tool", help="maintenance tools")
     tool_sub = tool.add_subparsers(dest="subcommand", required=True)

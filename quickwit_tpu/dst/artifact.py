@@ -58,7 +58,8 @@ def make_artifact(scenario: Scenario, seed: int, ops: list[dict[str, Any]],
                   violation: Violation, trace: Trace,
                   break_publish: bool = False,
                   break_wal: bool = False,
-                  race: Any = None) -> dict[str, Any]:
+                  race: Any = None,
+                  flight_tail: Any = None) -> dict[str, Any]:
     # a FRESH injector's plan (cursors at zero): replay must start the
     # fault decision streams from the beginning, not where the run ended
     fault_plan = FaultInjector(seed, list(scenario.fault_rules)).to_plan()
@@ -77,6 +78,11 @@ def make_artifact(scenario: Scenario, seed: int, ops: list[dict[str, Any]],
         # the PCT controller config: with it, `dst replay` reconstructs
         # the race runtime and the schedule re-derives from the seed alone
         body["race"] = race.to_dict()
+    if flight_tail is not None:
+        # the repro run's flight-recorder timeline (op-thread ring, virtual
+        # timestamps): the shrunk artifact carries the device/runtime
+        # timeline of the failure, and replay re-derives it byte-identically
+        body["flight_tail"] = list(flight_tail)
     return finish_artifact(ARTIFACT_KIND, body)
 
 

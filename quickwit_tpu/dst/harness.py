@@ -28,6 +28,7 @@ from typing import Any, Optional
 
 from ..common.clock import FakeClock, use_clock, use_rng
 from ..common.faults import FaultInjector
+from ..observability.flight import FLIGHT
 from .artifact import make_artifact, save_artifact
 from .cluster import SimCluster
 from .invariants import InvariantChecker, Violation
@@ -52,6 +53,10 @@ class RunResult:
     ops: list[dict[str, Any]]
     violations: list[Violation]
     trace: Trace
+    # the op thread's flight-recorder timeline (FLIGHT.dst_tail()): virtual
+    # timestamps, no thread/span ids — byte-identical across replays of the
+    # same (scenario, seed, ops, fault plan)
+    flight_tail: list = dataclasses.field(default_factory=list)
 
     @property
     def digest(self) -> str:
@@ -113,6 +118,10 @@ def run_scenario(scenario: Scenario, seed: int,
     # runtime would be invisible to happens-before and yield false races
     with use_clock(clock), use_rng(rng), \
             (racer.activate() if racer is not None else nullcontext()):
+        # rebase the flight recorder on the just-installed FakeClock: the
+        # run's timeline starts at t=0 virtual and depends on nothing but
+        # the run inputs
+        FLIGHT.begin_run()
         network = SimNetwork(injector, seed, duplicate_probability=0.05)
         cluster = SimCluster(scenario, injector, network, clock,
                              break_publish=break_publish,
@@ -128,6 +137,10 @@ def run_scenario(scenario: Scenario, seed: int,
                     if racer is not None:
                         racer.before_op(step)
                     clock.advance(scenario.step_secs)
+                    # every op marks the op thread's ring, so even an
+                    # ingest/drain-only shrunk repro carries a timeline
+                    FLIGHT.emit("dst.op",
+                                attrs={"step": step, "kind": op["kind"]})
                     result = _execute(cluster, op)
                     trace.record("op", step=step,
                                  now=round(clock.monotonic(), 6),
@@ -162,7 +175,8 @@ def run_scenario(scenario: Scenario, seed: int,
                 racer.finalize()
             cluster.close()
     return RunResult(scenario=scenario, seed=seed, ops=ops,
-                     violations=checker.violations, trace=trace)
+                     violations=checker.violations, trace=trace,
+                     flight_tail=FLIGHT.dst_tail())
 
 
 def _execute(cluster: SimCluster, op: dict[str, Any]) -> Any:
@@ -300,7 +314,8 @@ def sweep(scenario: Scenario, seeds: int, start_seed: int = 0,
                            if repro.first_violation else violation)
         artifact = make_artifact(
             shrunk_scenario, seed, shrunk_ops, repro_violation, repro.trace,
-            break_publish=break_publish, break_wal=break_wal, race=race)
+            break_publish=break_publish, break_wal=break_wal, race=race,
+            flight_tail=repro.flight_tail)
         if artifacts_dir:
             os.makedirs(artifacts_dir, exist_ok=True)
             path = os.path.join(
@@ -339,7 +354,12 @@ def replay(artifact: dict[str, Any]) -> tuple[RunResult, bool]:
         break_publish=bool(flags.get("publish", False)),
         break_wal=bool(flags.get("wal", False)),
         race=race)
-    return result, result.digest == artifact["trace_digest"]
+    ok = result.digest == artifact["trace_digest"]
+    # artifacts that embed a flight-recorder tail must re-derive it
+    # byte-identically too — the runtime timeline is part of the repro
+    if "flight_tail" in artifact:
+        ok = ok and result.flight_tail == artifact["flight_tail"]
+    return result, ok
 
 
 def scenario_by_name(name: str) -> Scenario:

@@ -496,3 +496,38 @@ MESH_COLLECTIVE_BYTES_TOTAL = METRICS.counter(
 MESH_THRESHOLD_EXCHANGE_ROUNDS_TOTAL = METRICS.counter(
     "qw_mesh_threshold_exchange_rounds_total",
     "Cross-device sort-threshold all-reduce (pmax) rounds executed")
+
+# --- flight recorder (observability/flight.py) ------------------------------
+# The always-on device-timeline black box: typed lifecycle events from
+# every hot subsystem into bounded per-thread rings. `subsystem` is the
+# dotted-kind prefix (batcher, staging, compile, dispatch, chunk, mesh,
+# cache, drr, overload, cancel, query, ...) — a small closed vocabulary
+# fixed by the emit sites, never request-derived.
+FLIGHT_EVENTS_TOTAL = METRICS.counter(
+    "qw_flight_events_total",
+    "Flight-recorder events recorded, by emitting subsystem")
+FLIGHT_DROPPED_EVENTS = METRICS.gauge(
+    "qw_flight_dropped_events",
+    "Flight-recorder events overwritten by ring wrap (refreshed on export)")
+FLIGHT_THREADS = METRICS.gauge(
+    "qw_flight_threads",
+    "Threads that have registered a flight-recorder ring")
+FLIGHT_EXPORTS_TOTAL = METRICS.counter(
+    "qw_flight_exports_total",
+    "Chrome trace-event exports served (REST endpoint + CLI)")
+
+# --- per-tenant SLO burn accounting (observability/slo.py) ------------------
+# Per-priority-class latency objectives over the flight-recorder event
+# stream: every root query completion is judged against its class
+# objective; the burn rate is the windowed breach fraction over the class
+# error budget (burn > 1.0 means the budget is being spent faster than
+# the objective allows).
+SLO_QUERIES_TOTAL = METRICS.counter(
+    "qw_slo_queries_total",
+    "Root queries judged against their class SLO, by verdict (ok|breach)")
+SLO_BURN_RATE = METRICS.gauge(
+    "qw_slo_burn_rate",
+    "Windowed SLO burn rate per priority class (breach rate over budget)")
+SLO_OBJECTIVE_LATENCY_MS = METRICS.gauge(
+    "qw_slo_objective_latency_ms",
+    "Configured per-priority-class latency objective (milliseconds)")

@@ -17,9 +17,10 @@ from __future__ import annotations
 import html
 import sys
 import threading
-import time
 from collections import Counter
 from typing import Optional
+
+from ..common.clock import monotonic, sleep
 
 
 # serializes on-demand profiles (the REST endpoint takes it non-blocking)
@@ -47,11 +48,15 @@ def sample_stacks(duration_secs: float = 2.0, hz: float = 100.0,
                   ) -> Counter:
     """Counter of stack tuples (root→leaf) across all threads."""
     interval = 1.0 / max(hz, 1.0)
-    deadline = time.monotonic() + max(duration_secs, 0.0)
+    # clock seam: under the DST harness the sampling window runs on
+    # virtual time (a FakeClock sleep advances it), so a profile taken
+    # inside a simulated run neither stalls the scheduler nor burns wall
+    # clock; in production the seam is the real clock
+    deadline = monotonic() + max(duration_secs, 0.0)
     skip = set(exclude_thread_ids or ())
     skip.add(threading.get_ident())  # never profile the profiler
     counts: Counter = Counter()
-    while time.monotonic() < deadline:
+    while monotonic() < deadline:
         for thread_id, frame in sys._current_frames().items():
             if thread_id in skip:
                 continue
@@ -61,7 +66,7 @@ def sample_stacks(duration_secs: float = 2.0, hz: float = 100.0,
                 frame = frame.f_back
             if stack:
                 counts[tuple(reversed(stack))] += 1
-        time.sleep(interval)
+        sleep(interval)
     return counts
 
 

@@ -70,6 +70,13 @@ class SlowQueryLog:
     def record(self, entry: dict[str, Any]) -> None:
         entry = dict(entry)
         entry.setdefault("recorded_at", time.time())
+        # automatic flight-recorder tail capture: the slow query's device
+        # timeline (group formation, staging, dispatch, chunk boundaries,
+        # readback) rides along with its profile waterfall
+        query_id = entry.get("query_id")
+        if query_id and "flight" not in entry:
+            from .flight import FLIGHT
+            entry["flight"] = FLIGHT.tail_for_query(query_id)
         with self._lock:
             self._entries.append(entry)
         SEARCH_SLOWLOG_RECORDED_TOTAL.inc()

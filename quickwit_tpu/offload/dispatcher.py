@@ -41,6 +41,7 @@ from ..common import sync
 from ..common.clock import monotonic
 from ..common.ctx import run_with_context
 from ..common.deadline import Deadline, current_deadline
+from ..observability import flight
 from ..observability.metrics import (
     OFFLOAD_DISPATCHES_TOTAL, OFFLOAD_DISPATCH_SECONDS, OFFLOAD_HEDGES_TOTAL,
     OFFLOAD_QUEUE_DEPTH, OFFLOAD_RETRIES_TOTAL, OFFLOAD_SPLITS_TOTAL,
@@ -229,6 +230,12 @@ class OffloadDispatcher:
             latency = self._clock() - t0
             self.pool.note_result(worker_id, ok=error is None,
                                   latency_secs=latency)
+            if flight.recording():
+                flight.emit("offload.dispatch",
+                            attrs={"worker": worker_id, "kind": kind,
+                                   "ok": int(error is None),
+                                   "splits": len(task.splits),
+                                   "dur_ms": round(latency * 1000.0, 3)})
             with cv:
                 task.attempts_inflight -= 1
                 if error is None:

@@ -36,9 +36,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..common.clock import monotonic as _clock_monotonic
 from ..index.format import ZONEMAP_BLOCK
 from ..index.reader import SplitReader
 from ..models.doc_mapper import DocMapper
+from ..observability import flight
 from ..observability.profile import (
     PHASE_COMPILE, PHASE_EXECUTE, PHASE_PLAN_BUILD, PHASE_STAGING_CACHE_HIT,
     PHASE_STAGING_UPLOAD, PHASE_TOPK_MERGE, current_profile, profile_add,
@@ -804,6 +806,7 @@ def stage_device_inputs(batch: SplitBatch, mesh: Optional[Mesh] = None,
             if rec is not None:
                 rec["bytes"] = 0
                 rec["stage"] = "batch"
+        flight.emit("staging.resident_hit", attrs={"stage": "batch"})
         return dev
     if dev is None:
         arrays_sh = scalars_sh = nd_sh = None
@@ -841,6 +844,10 @@ def stage_device_inputs(batch: SplitBatch, mesh: Optional[Mesh] = None,
                 scalars = tuple(moved[len(batch.arrays):-1])
                 nd = moved[-1]
         profile_add("staging_bytes", staging_bytes)
+        if flight.recording():
+            flight.emit("staging.upload",
+                        attrs={"bytes": staging_bytes,
+                               "resident_slots": len(resident)})
         dev = cache[mesh] = (arrays, scalars, nd)
     return dev
 
@@ -939,6 +946,12 @@ def dispatch_batch(batch: SplitBatch, request: SearchRequest,
            batch.num_docs_padded, mesh, exact)
     profile = current_profile()
     cached = _BATCH_JIT_CACHE.get(key)
+    if flight.recording():
+        flight.emit("compile.hit" if cached is not None else "compile.miss",
+                    attrs={"path": "batch"})
+        flight.emit("dispatch.launch",
+                    attrs={"path": "batch", "splits": batch.n_splits,
+                           "mesh": mesh.size if mesh is not None else 0})
     if profile is None:
         if cached is None:
             cached = _batch_executor(batch, k, mesh, (arrays, scalars, nd),
@@ -972,6 +985,11 @@ def dispatch_batch(batch: SplitBatch, request: SearchRequest,
             MESH_COLLECTIVE_BYTES_TOTAL.inc(meta["collective_bytes"])
             if k > 0:
                 MESH_THRESHOLD_EXCHANGE_ROUNDS_TOTAL.inc()
+            if flight.recording():
+                flight.emit("mesh.collective",
+                            attrs={"devices": mesh.size,
+                                   "bytes": meta["collective_bytes"],
+                                   "threshold_exchange": int(k > 0)})
         if _donate_batch_inputs(mesh):
             # the stacked inputs were donated into this dispatch — drop the
             # staging-cache entry so nothing touches the dead buffers
@@ -1002,6 +1020,7 @@ def readback_batch(dispatched) -> LeafSearchResponse:
         _finish_mesh_dispatch(guard, out)
         raise
     profile = current_profile()
+    t0 = _clock_monotonic() if flight.recording() else 0.0
     try:
         if profile is None:
             packed = jax.device_get(out)
@@ -1011,6 +1030,10 @@ def readback_batch(dispatched) -> LeafSearchResponse:
     except BaseException:
         _finish_mesh_dispatch(guard, out)
         raise
+    if flight.recording():
+        flight.emit("dispatch.readback", attrs={
+            "path": "batch",
+            "dur_ms": round((_clock_monotonic() - t0) * 1000.0, 3)})
     # device_get returned only after the program ran to completion — the
     # cross-procedural critical section ends here, BEFORE any exact
     # re-dispatch below re-enters _enqueue_batch (the lock is not
@@ -1479,6 +1502,12 @@ def dispatch_query_group(batches: list, request: SearchRequest,
     key = (sig0, q, b0.n_splits, b0.num_docs_padded, stacked_slots, mesh,
            exact)
     cached = _GROUP_JIT_CACHE.get(key)
+    if flight.recording():
+        flight.emit("compile.hit" if cached is not None else "compile.miss",
+                    attrs={"path": "query_group"})
+        flight.emit("dispatch.launch",
+                    attrs={"path": "query_group", "lanes": q, "live": live,
+                           "mesh": mesh.size if mesh is not None else 0})
     profile = current_profile()
     if profile is not None:
         profile.add("compile_cache_hits" if cached is not None
@@ -1510,6 +1539,11 @@ def dispatch_query_group(batches: list, request: SearchRequest,
             if k > 0:
                 # one pmax round still carries ALL Q lanes' thresholds
                 MESH_THRESHOLD_EXCHANGE_ROUNDS_TOTAL.inc()
+            if flight.recording():
+                flight.emit("mesh.collective",
+                            attrs={"devices": mesh.size,
+                                   "path": "query_group",
+                                   "threshold_exchange": int(k > 0)})
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
     except BaseException:
@@ -1528,6 +1562,7 @@ def readback_query_group(dispatched) -> list:
     out, treedef, spec, (batches, request, mesh, k, valid), guard = \
         dispatched
     from ..common.deadline import check_cancelled
+    t0 = _clock_monotonic() if flight.recording() else 0.0
     try:
         check_cancelled("query-group readback")
         profile = current_profile()
@@ -1540,6 +1575,10 @@ def readback_query_group(dispatched) -> list:
         _finish_mesh_dispatch(guard, out)
         raise
     _finish_mesh_dispatch(guard)
+    if flight.recording():
+        flight.emit("dispatch.readback", attrs={
+            "path": "query_group",
+            "dur_ms": round((_clock_monotonic() - t0) * 1000.0, 3)})
     results: list = []
     for lane, batch in enumerate(batches):
         if not valid[lane]:

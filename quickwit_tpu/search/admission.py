@@ -39,7 +39,9 @@ import weakref
 from collections import OrderedDict
 
 from ..common import sync
+from ..common.clock import monotonic as _seam_monotonic
 from ..common.deadline import DeadlineExceeded, current_deadline
+from ..observability import flight
 from ..observability.metrics import SEARCH_SHED_TOTAL
 from ..observability.profile import PHASE_ADMISSION_WAIT, current_profile
 from ..tenancy.context import effective_tenant
@@ -87,6 +89,7 @@ class HbmBudget:
         tenant = effective_tenant()
         if query_deadline is not None and query_deadline.expired:
             SEARCH_SHED_TOTAL.inc(stage="admission")
+            flight.emit("admission.shed", attrs={"stage": "deadline"})
             if profile is not None:
                 profile.mark_partial("shed: HBM admission")
             raise DeadlineExceeded("HBM admission")
@@ -99,6 +102,9 @@ class HbmBudget:
             return 0
         if OVERLOAD.should_shed(tenant.priority):
             SEARCH_SHED_TOTAL.inc(stage="overload_admission")
+            flight.emit("admission.shed",
+                        attrs={"stage": "overload",
+                               "priority": tenant.priority})
             GLOBAL_TENANCY.note_shed(tenant.tenant_id, stage="admission")
             if profile is not None:
                 profile.mark_partial("shed: overload (admission)")
@@ -111,6 +117,9 @@ class HbmBudget:
                                query_deadline.clamp(timeout_secs))
         deadline = time.monotonic() + timeout_secs
         t_admit = time.monotonic()
+        # seam twin of t_admit: the flight event's wait must be virtual
+        # time under DST (byte-identical artifact tails), real time live
+        ft_admit = _seam_monotonic()
         try:
             with self._cond:
                 ticket = self._drr.enqueue(tenant.tenant_id, tenant.weight,
@@ -161,6 +170,12 @@ class HbmBudget:
         OVERLOAD.note_wait(wait)
         GLOBAL_TENANCY.note_admission_wait(tenant.tenant_id, wait)
         GLOBAL_TENANCY.note_staged_bytes(tenant.tenant_id, new_bytes)
+        if flight.recording():
+            # the DRR grant: this query reached its tenant sub-queue head
+            # and its bytes fit the budget
+            flight.emit("admission.grant", attrs={
+                "bytes": new_bytes,
+                "wait_ms": round((_seam_monotonic() - ft_admit) * 1000.0, 3)})
         if profile is not None:
             profile.record_phase(PHASE_ADMISSION_WAIT,
                                  wait, start=t_admit,

@@ -53,7 +53,8 @@ class PartialAggCache:
     def __init__(self, capacity_bytes: int = 32 << 20, fault_injector=None):
         self._cache = TenantPartitionedCache(
             capacity_bytes,
-            on_evict=AGG_CACHE_EVICTED_BYTES_TOTAL.inc)
+            on_evict=AGG_CACHE_EVICTED_BYTES_TOTAL.inc,
+            tier="partial_agg")
         self.fault_injector = fault_injector
 
     def _get(self, key: str) -> Optional[bytes]:

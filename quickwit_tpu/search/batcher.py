@@ -55,6 +55,7 @@ from ..common.deadline import (
     CancellationToken, CancelledQuery, Deadline, DeadlineExceeded,
     current_cancel_token, current_deadline,
 )
+from ..observability import flight
 from ..observability.metrics import (
     QBATCH_GROUPS_TOTAL, QBATCH_INCOMPATIBLE_TOTAL,
     QBATCH_MASKED_RIDERS_TOTAL, QBATCH_QUERIES_PER_DISPATCH,
@@ -342,6 +343,9 @@ class QueryBatcher:
                 now = time.monotonic()
                 for pending in expired:
                     SEARCH_SHED_TOTAL.inc(stage="batcher_dispatch")
+                    flight.emit("batcher.shed",
+                                query_id=(pending.profile.query_id
+                                          if pending.profile else ""))
                     if pending.profile is not None:
                         pending.profile.record_phase(
                             PHASE_BATCHER_QUEUE, now - pending.enqueued_at,
@@ -351,6 +355,9 @@ class QueryBatcher:
                     pending.event.set()
                 for pending in cancelled:
                     SEARCH_SHED_TOTAL.inc(stage="batcher_cancel")
+                    flight.emit("batcher.cancelled",
+                                query_id=(pending.profile.query_id
+                                          if pending.profile else ""))
                     if pending.profile is not None:
                         pending.profile.record_phase(
                             PHASE_BATCHER_QUEUE, now - pending.enqueued_at,
@@ -514,6 +521,20 @@ class QueryBatcher:
         QBATCH_QUERIES_PER_DISPATCH.observe(len(alive))
         if masked:
             QBATCH_MASKED_RIDERS_TOTAL.inc(masked)
+        # group context onto every rider's profile: a slow stacked query's
+        # slowlog entry names its group (size / lane / masked flag) so a
+        # p99 outlier is attributable to group formation, not just itself
+        for lane, pending in enumerate(batch):
+            if pending.profile is not None:
+                pending.profile.set_counter("qbatch_group_size",
+                                            float(len(batch)))
+                pending.profile.set_counter("qbatch_lane_index", float(lane))
+                pending.profile.set_counter("qbatch_masked",
+                                            0.0 if valid[lane] else 1.0)
+        if flight.recording():
+            flight.emit("batcher.group_formed",
+                        attrs={"lanes": len(batch), "alive": len(alive),
+                               "masked": masked})
         from .residency import note_group_shared_staging
         note_group_shared_staging(plans, len(alive))
         group_res = chunkexec.execute_group_chunked(

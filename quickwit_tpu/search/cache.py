@@ -106,7 +106,8 @@ class LeafSearchCache:
     def __init__(self, capacity_bytes: int = 64 << 20):
         self._cache = TenantPartitionedCache(
             capacity_bytes,
-            on_evict=LEAF_CACHE_EVICTED_BYTES_TOTAL.inc)
+            on_evict=LEAF_CACHE_EVICTED_BYTES_TOTAL.inc,
+            tier="leaf_response")
 
     def get(self, key: str) -> Optional[LeafSearchResponse]:
         raw = self._cache.get(key)

@@ -50,6 +50,9 @@ class QueryCancelRegistry:
         if token is None:
             return False
         token.cancel(reason)
+        from ..observability import flight
+        flight.emit("query.cancel", query_id=query_id,
+                    attrs={"reason": reason})
         return True
 
     def get(self, query_id: str) -> Optional[CancellationToken]:

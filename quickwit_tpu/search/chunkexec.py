@@ -68,6 +68,7 @@ from ..common.deadline import (
 )
 from ..common.faults import InjectedFault
 from ..index.format import DOC_PAD, POSTING_PAD, ZONEMAP_BLOCK
+from ..observability import flight
 from ..observability.metrics import (
     CHUNK_BOUNDARY_SECONDS, CHUNK_DISPATCHES_TOTAL,
     CHUNK_EARLY_TERMINATIONS_TOTAL, CHUNK_RESTARTS_TOTAL,
@@ -620,6 +621,9 @@ def _run_scan(plan, k, device_arrays, mode, spans, bounds, early_ok,
             now = clock.monotonic()
             CHUNK_BOUNDARY_SECONDS.observe(now - last_boundary)
             last_boundary = now
+            if flight.recording():
+                flight.emit("chunk.boundary",
+                            attrs={"index": index, "of": len(spans)})
             # (a) kill: explicit cancel, then deadline — mid-kernel at
             # chunk granularity, the whole point of the boundary
             if token is not None and token.cancelled:
@@ -640,6 +644,10 @@ def _run_scan(plan, k, device_arrays, mode, spans, bounds, early_ok,
             if PREEMPT_GATE.should_yield(tenant.priority):
                 PREEMPT_TOTAL.inc()
                 ticket = PARKED_STATES.park(tenant.tenant_id, state.nbytes())
+                if flight.recording():
+                    flight.emit("chunk.preempt_park",
+                                attrs={"bytes": state.nbytes(),
+                                       "priority": tenant.priority})
                 try:
                     if fault_injector is not None:
                         fault_injector.perturb("kernel.preempt_park")
@@ -654,13 +662,18 @@ def _run_scan(plan, k, device_arrays, mode, spans, bounds, early_ok,
                 if ticket.evicted:
                     # parked-state eviction under byte pressure: the
                     # resumed query has nothing to resume FROM
+                    flight.emit("chunk.preempt_evict")
                     raise _RestartScan()
+                flight.emit("chunk.preempt_resume")
             # (c) early termination + boundary threshold tightening
             kth = state.kth_value(k)
             if (early_ok and kth is not None and bounds is not None
                     and index < len(bounds)
                     and float(bounds[index:].max()) <= kth):
                 CHUNK_EARLY_TERMINATIONS_TOTAL.inc()
+                if flight.recording():
+                    flight.emit("chunk.early_term",
+                                attrs={"after": index, "of": len(spans)})
                 result = state.to_result(k)
                 # the remaining chunks' matches never ran: the exact count
                 # is the host-side impact-prefix override
@@ -809,6 +822,10 @@ def _run_group_scan(plans, k, arrays_list, mode, spans, bounds, early_ok,
             now = clock.monotonic()
             CHUNK_BOUNDARY_SECONDS.observe(now - last_boundary)
             last_boundary = now
+            if flight.recording():
+                flight.emit("chunk.boundary",
+                            attrs={"index": index, "of": len(spans),
+                                   "lanes": int(sum(live))})
             # (a) per-query kill masks: a cancelled/expired lane leaves
             # the dispatch via its validity lane — the group's program
             # shape never changes mid-scan
@@ -846,6 +863,11 @@ def _run_group_scan(plans, k, arrays_list, mode, spans, bounds, early_ok,
                 ticket = PARKED_STATES.park(
                     park_tenant.tenant_id,
                     sum(states[i].nbytes() for i in range(q) if live[i]))
+                if flight.recording():
+                    flight.emit("chunk.preempt_park",
+                                attrs={"bytes": ticket.nbytes,
+                                       "priority": park_tenant.priority,
+                                       "lanes": int(sum(live))})
                 try:
                     if fault_injector is not None:
                         fault_injector.perturb("kernel.preempt_park")
@@ -859,7 +881,9 @@ def _run_group_scan(plans, k, arrays_list, mode, spans, bounds, early_ok,
                 finally:
                     PARKED_STATES.release(ticket)
                 if ticket.evicted:
+                    flight.emit("chunk.preempt_evict")
                     raise _RestartScan()
+                flight.emit("chunk.preempt_resume")
             # (c) per-lane early termination + threshold tightening
             for i in range(q):
                 if not live[i]:
@@ -869,6 +893,9 @@ def _run_group_scan(plans, k, arrays_list, mode, spans, bounds, early_ok,
                         and index < len(bounds[i])
                         and float(bounds[i][index:].max()) <= kth):
                     CHUNK_EARLY_TERMINATIONS_TOTAL.inc()
+                    if flight.recording():
+                        flight.emit("chunk.early_term",
+                                    attrs={"after": index, "lane": i})
                     result = states[i].to_result(k)
                     result["count"] = plans[i].count_override
                     outcome[i] = result

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common.clock import monotonic as _clock_monotonic
 from ..index.format import ZONEMAP_BLOCK
 from ..observability.profile import (
     PHASE_COMPILE, PHASE_EXECUTE, current_profile,
@@ -32,6 +33,7 @@ from ..observability.profile import (
 from ..ops import aggs as agg_ops
 from ..ops import masks as mask_ops
 from ..ops import topk as topk_ops
+from ..observability import flight
 from ..observability.metrics import SEARCH_KERNEL_LAUNCHES_TOTAL
 from ..ops.bm25 import dequantize_block_bounds, score_postings
 from .plan import (
@@ -848,8 +850,9 @@ _PACKED_CACHE: dict[tuple, tuple] = {}
 
 
 def _get_packed_executor(plan: LoweredPlan, k: int, example_args,
-                         exact: bool = False):
-    key = (plan.signature(k), exact)
+                         exact: bool = False, key: tuple = None):
+    if key is None:
+        key = (plan.signature(k), exact)
     cached = _PACKED_CACHE.get(key)
     if cached is None:
         fn = _build(plan, k, exact)
@@ -916,8 +919,10 @@ def _batch_bucket(n: int) -> int:
 # qwlint: disable-next-line=QW001 - np.asarray on host scalar tuples for
 # jax.eval_shape (trace-time, no data movement)
 def _get_packed_multi_executor(plan: LoweredPlan, k: int, batch: int,
-                               device_arrays, exact: bool = False):
-    key = (plan.signature(k), batch, exact)
+                               device_arrays, exact: bool = False,
+                               key: tuple = None):
+    if key is None:
+        key = (plan.signature(k), batch, exact)
     cached = _MULTI_CACHE.get(key)
     if cached is None:
         fn = _build(plan, k, exact)
@@ -991,19 +996,29 @@ def dispatch_plan_multi(plan: LoweredPlan, k: int,
     scal_b, nd_b = _device_multi_scalars(plan, padded_sets,
                                          use_cache=cache_scalars)
     profile = current_profile()
+    recording = flight.recording()
+    # shared once-per-dispatch cache key (see dispatch_plan)
+    key = (plan.signature(k), bucket, exact) \
+        if (recording or profile is not None) else None
+    if recording:
+        hit = key in _MULTI_CACHE
+        flight.emit("compile.hit" if hit else "compile.miss",
+                    attrs={"path": "multi", "bucket": bucket})
+        flight.emit("dispatch.launch",
+                    attrs={"path": "multi", "lanes": batch})
     if profile is None:
         executor, treedef, spec = _get_packed_multi_executor(
-            plan, k, bucket, device_arrays, exact)
+            plan, k, bucket, device_arrays, exact, key=key)
         out = executor(tuple(device_arrays), scal_b, nd_b)
     else:
         # same lazy-jit attribution as dispatch_plan, keyed per batch
         # bucket (each bucket size compiles its own vmapped program)
-        hit = (plan.signature(k), bucket, exact) in _MULTI_CACHE
+        hit = key in _MULTI_CACHE
         profile.add("compile_cache_hits" if hit else "compile_cache_misses")
         with profile.phase(PHASE_EXECUTE if hit else PHASE_COMPILE,
                            stage="dispatch_multi"):
             executor, treedef, spec = _get_packed_multi_executor(
-                plan, k, bucket, device_arrays, exact)
+                plan, k, bucket, device_arrays, exact, key=key)
             out = executor(tuple(device_arrays), scal_b, nd_b)
     if hasattr(out, "copy_to_host_async"):
         out.copy_to_host_async()
@@ -1016,10 +1031,20 @@ def dispatch_plan_multi(plan: LoweredPlan, k: int,
 # readback stage (ROADMAP item 1 measures exactly this)
 def _profiled_device_get(packed):
     profile = current_profile()
-    if profile is None:
-        return jax.device_get(packed)
-    with profile.phase(PHASE_EXECUTE, stage="readback"):
-        return jax.device_get(packed)
+    if not flight.recording():
+        if profile is None:
+            return jax.device_get(packed)
+        with profile.phase(PHASE_EXECUTE, stage="readback"):
+            return jax.device_get(packed)
+    t0 = _clock_monotonic()
+    try:
+        if profile is None:
+            return jax.device_get(packed)
+        with profile.phase(PHASE_EXECUTE, stage="readback"):
+            return jax.device_get(packed)
+    finally:
+        flight.emit("dispatch.readback", attrs={
+            "dur_ms": round((_clock_monotonic() - t0) * 1000.0, 3)})
 
 
 # qwlint: disable-next-line=QW001 - batch variant of the sanctioned seam;
@@ -1101,8 +1126,10 @@ def stacked_slot_split(plans) -> tuple[tuple[int, ...], tuple[int, ...]]:
 # jax.eval_shape (trace-time, no data movement)
 def _get_packed_stacked_executor(plan: LoweredPlan, k: int, bucket: int,
                                  stacked_slots: tuple[int, ...],
-                                 device_arrays, exact: bool = False):
-    key = (plan.signature(k), bucket, stacked_slots, exact)
+                                 device_arrays, exact: bool = False,
+                                 key: tuple = None):
+    if key is None:
+        key = (plan.signature(k), bucket, stacked_slots, exact)
     cached = _STACKED_CACHE.get(key)
     if cached is None:
         fn = _build(plan, k, exact)
@@ -1203,18 +1230,27 @@ def dispatch_plan_stacked(plans, k: int, arrays_list, valid=None,
                         for s in stacked_slots)
     valid_dev = jax.device_put(valid_b)
     profile = current_profile()
+    recording = flight.recording()
+    # shared once-per-dispatch cache key (see dispatch_plan)
+    key = (base.signature(k), bucket, stacked_slots, exact) \
+        if (recording or profile is not None) else None
+    if recording:
+        f_hit = key in _STACKED_CACHE
+        flight.emit("compile.hit" if f_hit else "compile.miss",
+                    attrs={"path": "stacked", "bucket": bucket})
+        flight.emit("dispatch.launch",
+                    attrs={"path": "stacked", "lanes": batch})
     if profile is None:
         executor, treedef, spec = _get_packed_stacked_executor(
-            base, k, bucket, stacked_slots, arrays_b[0], exact)
+            base, k, bucket, stacked_slots, arrays_b[0], exact, key=key)
         out = executor(shared_arrays, lane_stacks, scal_b, nd_b, valid_dev)
     else:
-        hit = (base.signature(k), bucket, stacked_slots,
-               exact) in _STACKED_CACHE
+        hit = key in _STACKED_CACHE
         profile.add("compile_cache_hits" if hit else "compile_cache_misses")
         with profile.phase(PHASE_EXECUTE if hit else PHASE_COMPILE,
                            stage="dispatch_stacked"):
             executor, treedef, spec = _get_packed_stacked_executor(
-                base, k, bucket, stacked_slots, arrays_b[0], exact)
+                base, k, bucket, stacked_slots, arrays_b[0], exact, key=key)
             out = executor(shared_arrays, lane_stacks, scal_b, nd_b,
                            valid_dev)
     if hasattr(out, "copy_to_host_async"):
@@ -1276,8 +1312,20 @@ def dispatch_plan(plan: LoweredPlan, k: int,
     scalars, num_docs = _device_scalars(plan)
     args = (tuple(device_arrays), scalars, num_docs)
     profile = current_profile()
+    recording = flight.recording()
+    # plan.signature() walks the whole plan tree — compute the cache key
+    # at most once per dispatch and share it between the flight event, the
+    # profile attribution and the executor getter
+    key = (plan.signature(k), exact) \
+        if (recording or profile is not None) else None
+    if recording:
+        f_hit = key in _PACKED_CACHE
+        flight.emit("compile.hit" if f_hit else "compile.miss",
+                    attrs={"path": "solo"})
+        flight.emit("dispatch.launch", attrs={"path": "solo", "lanes": 1})
     if profile is None:
-        executor, treedef, spec = _get_packed_executor(plan, k, args, exact)
+        executor, treedef, spec = _get_packed_executor(plan, k, args, exact,
+                                                       key=key)
         out = executor(*args)
     else:
         # Compile-vs-execute attribution: jax.jit compiles lazily on first
@@ -1285,12 +1333,12 @@ def dispatch_plan(plan: LoweredPlan, k: int,
         # trace+XLA-compile (the dispatch itself is an async enqueue); on a
         # HIT it is a cheap enqueue counted toward execute. The
         # approximation is documented in docs/observability.md.
-        hit = (plan.signature(k), exact) in _PACKED_CACHE
+        hit = key in _PACKED_CACHE
         profile.add("compile_cache_hits" if hit else "compile_cache_misses")
         with profile.phase(PHASE_EXECUTE if hit else PHASE_COMPILE,
                            stage="dispatch"):
             executor, treedef, spec = _get_packed_executor(
-                plan, k, args, exact)
+                plan, k, args, exact, key=key)
             out = executor(*args)
     if hasattr(out, "copy_to_host_async"):
         out.copy_to_host_async()
@@ -1311,12 +1359,16 @@ def readback_plan_result(dispatched) -> dict[str, Any]:
     re-executed with the exact blockwise kernel before returning."""
     packed, treedef, spec, redispatch = dispatched
     profile = current_profile()
+    t0 = _clock_monotonic() if flight.recording() else 0.0
     if profile is None:
         host = jax.device_get(packed)
     else:
         # the blocking readback absorbs the device execution time
         with profile.phase(PHASE_EXECUTE, stage="readback"):
             host = jax.device_get(packed)
+    if flight.recording():
+        flight.emit("dispatch.readback", attrs={
+            "dur_ms": round((_clock_monotonic() - t0) * 1000.0, 3)})
     sort_vals, sort_vals2, doc_ids, hit_scores, count, topk_safe, agg_out = \
         _unpack_result(host, treedef, spec)
     if float(topk_safe) < 1.0:

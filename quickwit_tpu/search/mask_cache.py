@@ -50,7 +50,8 @@ class PredicateMaskCache:
     def __init__(self, capacity_bytes: int = 32 << 20, fault_injector=None):
         self._cache = TenantPartitionedCache(
             capacity_bytes,
-            on_evict=MASK_CACHE_EVICTED_BYTES_TOTAL.inc)
+            on_evict=MASK_CACHE_EVICTED_BYTES_TOTAL.inc,
+            tier="predicate_mask")
         self.fault_injector = fault_injector
 
     @staticmethod

@@ -379,15 +379,31 @@ class LoweredPlan:
     doc_base_slot: int = -1
 
     def signature(self, k: int) -> tuple:
+        # memoized per k: the signature is pure in the plan's static
+        # structure (scalar VALUES are deliberately excluded, only dtypes
+        # count), every mutation path goes through dataclasses.replace
+        # (fresh instance -> fresh memo), and the dispatch hot path asks
+        # for it up to three times per query (flight event, profile
+        # attribution, executor cache key)
+        memo = getattr(self, "_sig_memo", None)
+        if memo is None:
+            memo = {}
+            object.__setattr__(self, "_sig_memo", memo)
+        cached = memo.get(k)
+        if cached is not None:
+            return cached
         shapes = tuple((a.shape, str(a.dtype)) for a in self.arrays)
         scalar_dtypes = tuple(str(s.dtype) for s in self.scalars)
         agg_sig = ",".join(a.sig() for a in self.aggs)
         rebase_sig = tuple(sorted(
             (slot, slots) for slot, slots in self.rebase.items()))
-        return (self.root.sig(), self.sort.sig(), agg_sig, shapes, scalar_dtypes,
-                k, self.num_docs_padded, self.search_after_relation,
-                self.sa_value2_slot >= 0, self.threshold_slot >= 0, rebase_sig,
-                self.doc_base_slot >= 0)
+        sig = (self.root.sig(), self.sort.sig(), agg_sig, shapes,
+               scalar_dtypes, k, self.num_docs_padded,
+               self.search_after_relation, self.sa_value2_slot >= 0,
+               self.threshold_slot >= 0, rebase_sig,
+               self.doc_base_slot >= 0)
+        memo[k] = sig
+        return sig
 
     def structure_digest(self, k: int) -> str:
         """Stable hex digest of the compile-cache structure key.

@@ -45,6 +45,7 @@ import weakref
 from typing import Any, Optional
 
 from ..common.faults import InjectedFault
+from ..observability import flight
 from ..observability.metrics import METRICS
 from ..common import sync
 
@@ -179,6 +180,10 @@ class ResidentColumnStore:
     def note_upload(self, split_id: str, nbytes: int, columns: int) -> None:
         """Record a cold staging: `columns` columns, `nbytes` landed."""
         RESIDENT_COLUMN_MISSES.inc(columns)
+        if flight.recording():
+            flight.emit("staging.upload",
+                        attrs={"split": split_id, "bytes": nbytes,
+                               "columns": columns})
         with self._lock:
             cols = self._by_split.get(split_id)
             if cols is not None:
@@ -191,6 +196,9 @@ class ResidentColumnStore:
         warmup needed zero device_put (the warm-repeat-query proof)."""
         if columns:
             RESIDENT_COLUMN_HITS.inc(columns)
+            if flight.recording():
+                flight.emit("staging.resident_hit",
+                            attrs={"columns": columns, "full": int(full)})
         if full:
             RESIDENT_STAGING_CACHE_HITS.inc()
 
@@ -216,6 +224,9 @@ class ResidentColumnStore:
                 self._bytes -= freed
                 RESIDENT_BYTES.set(self._bytes)
             RESIDENT_EVICTIONS.inc()
+            if flight.recording():
+                flight.emit("staging.evict",
+                            attrs={"split": split_id, "bytes": freed})
             logger.info("resident columns evicted: split=%s bytes=%d",
                         split_id, freed)
 

@@ -26,6 +26,7 @@ from ..common.deadline import (
     CancellationToken, CancelledQuery, Deadline, DeadlineExceeded, QueryBudget,
     cancel_scope, deadline_scope, is_cancel_error, is_deadline_error,
 )
+from ..common.clock import monotonic as clock_monotonic
 from ..common.ctx import run_with_context
 from ..metastore.base import ListSplitsQuery, Metastore, MetastoreError
 from ..models.doc_mapper import DocMapper
@@ -38,6 +39,8 @@ from ..observability.profile import (
     PHASE_FETCH_DOCS, PHASE_ROOT_MERGE, QueryProfile, current_profile,
     profile_scope, profiled_phase,
 )
+from ..observability import flight
+from ..observability.slo import SLO_TRACKER
 from ..observability.slowlog import SLOW_QUERY_LOG
 from ..query import ast as Q
 from ..tenancy.context import current_tenant, tenant_scope
@@ -236,6 +239,14 @@ class RootSearcher:
                 cancel_token = raced
             CANCEL_REGISTRY.register(request.query_id, cancel_token)
         t0 = time.monotonic()
+        # flight-recorder bracket: timed on the clock seam so the recorded
+        # elapsed is virtual (deterministic) under DST and wall in prod
+        flight_t0 = clock_monotonic()
+        qid = profile.query_id if profile is not None \
+            else (request.query_id or "")
+        if flight.recording():
+            flight.emit("query.start", query_id=qid,
+                        attrs={"indexes": ",".join(request.index_ids)})
         try:
             with TRACER.span("root_search",
                              {"indexes": ",".join(request.index_ids)}):
@@ -253,18 +264,20 @@ class RootSearcher:
                             cancelled=True,
                         )
         except BaseException as exc:
+            if isinstance(exc, OverloadShed):
+                status = "shed"
+            elif isinstance(exc, TenantRateLimited):
+                status = "rejected"
+            elif is_deadline_error(str(exc)):
+                status = "timed_out"
+            elif is_cancel_error(str(exc)):
+                status = "cancelled"
+            else:
+                status = "error"
             if tenant is not None:
-                if isinstance(exc, OverloadShed):
-                    status = "shed"
-                elif isinstance(exc, TenantRateLimited):
-                    status = "rejected"
-                elif is_deadline_error(str(exc)):
-                    status = "timed_out"
-                elif is_cancel_error(str(exc)):
-                    status = "cancelled"
-                else:
-                    status = "error"
                 GLOBAL_TENANCY.note_query(tenant.tenant_id, status=status)
+            self._account_query_done(tenant, qid, status,
+                                     (clock_monotonic() - flight_t0) * 1000.0)
             if profile is not None:
                 profile.mark_partial(f"error: {exc}")
                 profile.finish(time.monotonic() - t0)
@@ -276,11 +289,12 @@ class RootSearcher:
                 CANCEL_REGISTRY.unregister(request.query_id, cancel_token)
         if response.timed_out:
             SEARCH_TIMED_OUT_TOTAL.inc()
+        status = ("cancelled" if response.cancelled
+                  else "timed_out" if response.timed_out else "ok")
         if tenant is not None:
-            GLOBAL_TENANCY.note_query(
-                tenant.tenant_id,
-                status=("cancelled" if response.cancelled
-                        else "timed_out" if response.timed_out else "ok"))
+            GLOBAL_TENANCY.note_query(tenant.tenant_id, status=status)
+        self._account_query_done(tenant, qid, status,
+                                 (clock_monotonic() - flight_t0) * 1000.0)
         if profile is not None:
             if response.timed_out:
                 profile.mark_partial("timed_out")
@@ -300,12 +314,41 @@ class RootSearcher:
         return response
 
     @staticmethod
+    def _account_query_done(tenant, qid: str, status: str,
+                            elapsed_ms: float) -> None:
+        """Completion bookkeeping shared by the success and error exits:
+        the `query.done` flight event and the per-class SLO judgement.
+        Cancelled queries are excluded from SLO burn — the client chose to
+        abandon them, the objective was not missed by the system."""
+        if flight.recording():
+            flight.emit("query.done", query_id=qid,
+                        attrs={"status": status,
+                               "elapsed_ms": round(elapsed_ms, 3)})
+        if status == "cancelled":
+            return
+        if tenant is not None:
+            cls = tenant.priority_class
+            label = GLOBAL_TENANCY.metric_label(tenant.tenant_id)
+        else:
+            cls = GLOBAL_TENANCY.default_class
+            label = "default"
+        SLO_TRACKER.note(cls, label, elapsed_ms, ok=status == "ok")
+
+    @staticmethod
     def _capture_slow_query(request: SearchRequest, profile,
                             timed_out: bool) -> None:
         elapsed_ms = profile.wall_ms or 0.0
         if not SLOW_QUERY_LOG.should_capture(elapsed_ms, timed_out):
             return
         tenant = current_tenant()
+        counters = profile.counters()
+        # PR-18 query-group context: a slow stacked query names its group
+        # so the outlier is attributable to formation/lane position
+        group = None
+        if "qbatch_group_size" in counters:
+            group = {"group_size": int(counters["qbatch_group_size"]),
+                     "lane_index": int(counters.get("qbatch_lane_index", 0)),
+                     "masked": bool(counters.get("qbatch_masked", 0.0))}
         SLOW_QUERY_LOG.record({
             "query_id": profile.query_id,
             "indexes": list(request.index_ids),
@@ -314,6 +357,7 @@ class RootSearcher:
             # which tenant's query this was: a noisy-neighbor hunt starts
             # by grouping the slowlog on this field
             **({"tenant": tenant.tenant_id} if tenant is not None else {}),
+            **({"query_group": group} if group is not None else {}),
             "profile": profile.to_dict(),
         })
 

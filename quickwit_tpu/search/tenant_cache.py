@@ -26,20 +26,36 @@ from __future__ import annotations
 from typing import Optional
 
 from ..common import sync
+from ..observability import flight
 from ..storage.cache import MemorySizedCache
 from ..tenancy.context import effective_tenant
 
 
 class TenantPartitionedCache:
-    """Byte-bounded LRU keyed (ambient tenant, key) with per-tenant quotas."""
+    """Byte-bounded LRU keyed (ambient tenant, key) with per-tenant quotas.
 
-    def __init__(self, capacity_bytes: int, on_evict=None):
+    `tier` names this cache in flight-recorder events (`cache.hit` /
+    `cache.fill` / `cache.evict` carry it as the `tier` attribute) — the
+    single instrumentation point for every tier that stores through the
+    facade (leaf response, predicate mask, partial agg)."""
+
+    def __init__(self, capacity_bytes: int, on_evict=None, tier: str = ""):
         self.capacity_bytes = capacity_bytes
+        self.tier = tier
         self._parts: dict[str, MemorySizedCache] = {}
         self._weights: dict[str, float] = {}
         self._lock = sync.lock("TenantPartitionedCache._lock")
         sync.register_shared(self, "TenantPartitionedCache")
-        self._on_evict = on_evict
+        self._on_evict = self._wrap_evict(on_evict) if tier else on_evict
+
+    def _wrap_evict(self, inner):
+        def _evict(nbytes: int) -> None:
+            if flight.recording():
+                flight.emit("cache.evict",
+                            attrs={"tier": self.tier, "bytes": nbytes})
+            if inner is not None:
+                inner(nbytes)
+        return _evict
 
     def _partition(self) -> MemorySizedCache:
         tenant = effective_tenant()
@@ -65,9 +81,16 @@ class TenantPartitionedCache:
                             * self._weights[tenant_id] / total))
 
     def get(self, key: str) -> Optional[bytes]:
-        return self._partition().get(key)
+        data = self._partition().get(key)
+        if self.tier and data is not None and flight.recording():
+            flight.emit("cache.hit",
+                        attrs={"tier": self.tier, "bytes": len(data)})
+        return data
 
     def put(self, key: str, data: bytes) -> None:
+        if self.tier and flight.recording():
+            flight.emit("cache.fill",
+                        attrs={"tier": self.tier, "bytes": len(data)})
         self._partition().put(key, data)
 
     def delete(self, key: str) -> None:

@@ -293,6 +293,10 @@ class RestServer:
         if path == "/health/readyz":
             return (200, True) if node.cluster.is_ready() else (503, False)
         if path == "/metrics":
+            # fold buffered flight-recorder counts into qw_flight_* first:
+            # emit() defers the labeled counter inc off the hot path
+            from ..observability.flight import FLIGHT
+            FLIGHT.flush_metrics()
             return 200, METRICS.expose_text()
         if path in ("/ui", "/ui/", "/") and method == "GET":
             from .ui import UI_HTML
@@ -411,8 +415,27 @@ class RestServer:
                                    f"({duration:g}s @ {hz:g}Hz)")
             return 200, ("__raw__", svg.encode(), "image/svg+xml")
         if path == "/api/v1/developer/tenants" and method == "GET":
-            # per-tenant config + live usage counters + overload state
-            return 200, GLOBAL_TENANCY.report()
+            # per-tenant config + live usage counters + overload state +
+            # SLO burn; ?scope=cluster merges every alive peer's and
+            # offload worker's report (tenancy/rollup.py)
+            if params.get("scope") == "cluster":
+                from ..tenancy.rollup import collect_cluster_tenant_report
+                return 200, collect_cluster_tenant_report(node)
+            from ..observability.slo import SLO_TRACKER
+            report = GLOBAL_TENANCY.report()
+            report["node_id"] = node.config.node_id
+            report["slo"] = SLO_TRACKER.report()
+            return 200, report
+        if path == "/api/v1/developer/trace" and method == "GET":
+            # flight-recorder export: the always-on device timeline as
+            # Chrome trace-event JSON (load into Perfetto / chrome://tracing;
+            # events carry query_id + tenant + OTLP span correlation)
+            from ..observability.flight import FLIGHT
+            limit = min(int(params.get("limit", 0) or 0), 1 << 20)
+            trace = FLIGHT.to_chrome_trace(
+                limit=limit or None,
+                process_name=f"quickwit-tpu:{node.config.node_id}")
+            return 200, trace
         if path == "/api/v1/developer/slowlog":
             # ring buffer of slow/shed/timed-out query profiles (role of the
             # reference's slow-query log). GET returns the buffer; POST with

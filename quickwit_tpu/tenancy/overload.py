@@ -52,6 +52,7 @@ class OverloadController:
         self._lock = sync.lock("OverloadController._lock")
         self._ewma = 0.0
         self._last_update = 0.0
+        self._last_floor = 0
 
     def configure(self, target_wait_secs=None, enabled=None,
                   alpha=None) -> None:
@@ -75,6 +76,19 @@ class OverloadController:
             self._ewma = (self.alpha * max(wait_secs, 0.0)
                           + (1.0 - self.alpha) * self._ewma)
             self._last_update = monotonic()
+        self._note_floor_transition()
+
+    def _note_floor_transition(self) -> None:
+        """Flight-record ladder rung changes (0 → shed background → shed
+        standard and back). Lazy import: flight → tenancy.context would
+        cycle at module scope through tenancy/__init__."""
+        floor = self.shed_floor()
+        if floor != self._last_floor:
+            from ..observability import flight
+            flight.emit("overload.ladder",
+                        attrs={"floor": floor, "from": self._last_floor,
+                               "severity": round(self.severity(), 4)})
+            self._last_floor = floor
 
     def severity(self) -> float:
         """Smoothed wait over target; 0 when disabled or idle. Staleness

@@ -122,3 +122,43 @@ def test_mixed_200_seed_sweep():
                     break_publish=False, break_wal=False)
     assert summary["ok"], summary["violations"]
     assert len(summary["passed"]) == 200
+
+
+def test_flight_tail_is_deterministic_and_virtual():
+    """The flight recorder rides every DST run: the calling-thread tail in
+    RunResult must be byte-identical across same-seed runs, timestamped in
+    virtual time rebased to t=0, and stripped of every nondeterministic
+    field (thread ids, span ids, per-process compile-cache state)."""
+    a = run_scenario(SCENARIOS["smoke"], seed=7,
+                     break_publish=False, break_wal=False)
+    b = run_scenario(SCENARIOS["smoke"], seed=7,
+                     break_publish=False, break_wal=False)
+    assert a.flight_tail, "DST run recorded no flight events"
+    assert a.flight_tail == b.flight_tail  # bytes, not just shape
+    kinds = {e["kind"] for e in a.flight_tail}
+    assert "dst.op" in kinds  # every scheduler op leaves a timeline mark
+    assert not any(k.startswith("compile.") for k in kinds)
+    for e in a.flight_tail:
+        assert "tid" not in e and "span" not in e
+        assert e["t_ms"] >= 0.0  # rebased: virtual time since begin_run
+
+
+def test_violation_artifact_embeds_flight_tail_and_replays(tmp_path):
+    """Artifacts from breaking runs (fanout + mixed) carry the runtime
+    timeline inside the digest-covered payload, and a fresh replay
+    re-derives it byte-identically — the repro file IS the black box."""
+    from quickwit_tpu.dst.artifact import load_artifact
+    from quickwit_tpu.dst.harness import replay
+    for name in ("mixed", "fanout"):
+        arts_dir = tmp_path / name
+        summary = sweep(SCENARIOS[name], seeds=3, break_publish=True,
+                        artifacts_dir=str(arts_dir))
+        assert summary["violations"], f"break_publish drew no blood ({name})"
+        files = sorted(arts_dir.glob("*.json"))
+        assert files, f"no artifact persisted for {name}"
+        artifact = load_artifact(str(files[0]))
+        tail = artifact["flight_tail"]
+        assert tail and all("t_ms" in e and "kind" in e for e in tail)
+        result, ok = replay(artifact)
+        assert ok, f"{name} artifact did not replay byte-identically"
+        assert result.flight_tail == tail

@@ -1,0 +1,375 @@
+"""Flight recorder: trace schema, attribution, slowlog capture, SLO burn,
+and the cluster tenant rollup.
+
+The acceptance bar (ISSUE 19): an induced slow query must be fully
+reconstructable from the exported Perfetto/Chrome trace alone — with
+query-id and tenant attribution — the slowlog entry must carry both the
+flight tail and the PR-18 query-group context, the `qw_slo_*` burn
+accounting must judge completions against per-class objectives, and the
+cluster rollup must merge per-node tenant reports without double-counting
+identity fields.
+"""
+
+import json
+
+import pytest
+
+from quickwit_tpu.common.clock import FakeClock, use_clock
+from quickwit_tpu.observability.flight import (
+    DEFAULT_CAPACITY, FLIGHT, FlightRecorder,
+)
+from quickwit_tpu.observability.profile import QueryProfile, profile_scope
+from quickwit_tpu.observability.slo import SloTracker
+from quickwit_tpu.observability.slowlog import SLOW_QUERY_LOG
+from quickwit_tpu.tenancy.rollup import merge_tenant_reports
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    FLIGHT.reset()
+    FLIGHT.enable()
+    yield
+    FLIGHT.reset()
+    FLIGHT.enable()
+
+
+# --- ring semantics --------------------------------------------------------
+
+def test_ring_bounds_memory_and_counts_drops():
+    rec = FlightRecorder(capacity_per_thread=16)
+    for i in range(40):
+        rec.emit("query.start", query_id=f"q{i}")
+    stats = rec.stats()
+    assert stats["events"] == 16          # bounded: ring capacity, not 40
+    assert stats["dropped"] >= 24         # overwritten events are counted
+    events = rec.events()
+    assert len(events) == 16
+    # overwrite-oldest: the survivors are the most recent emits, in order
+    assert [e["query_id"] for e in events] == [f"q{i}" for i in range(24, 40)]
+
+
+def test_disabled_emit_records_nothing():
+    rec = FlightRecorder(capacity_per_thread=16)
+    rec.disable()
+    assert not rec.recording()
+    rec.emit("query.start", query_id="q1")
+    assert rec.events() == []
+    rec.enable()
+    rec.emit("query.start", query_id="q2")
+    assert [e["query_id"] for e in rec.events()] == ["q2"]
+
+
+def test_default_capacity_env_shape():
+    assert DEFAULT_CAPACITY >= 16
+
+
+# --- attribution -----------------------------------------------------------
+
+def test_ambient_profile_and_tenant_attribution():
+    from quickwit_tpu.tenancy.context import TenantContext, tenant_scope
+    profile = QueryProfile(query_id="q-attr")
+    with profile_scope(profile), \
+            tenant_scope(TenantContext(tenant_id="acme",
+                                       priority_class="interactive")):
+        FLIGHT.emit("dispatch.launch", attrs={"path": "solo"})
+    (event,) = [e for e in FLIGHT.events() if e["kind"] == "dispatch.launch"]
+    assert event["query_id"] == "q-attr"   # resolved from the contextvars,
+    assert event["tenant"] == "acme"       # not threaded through the call
+
+
+def test_explicit_ids_win_over_ambient():
+    profile = QueryProfile(query_id="ambient")
+    with profile_scope(profile):
+        FLIGHT.emit("query.cancel", query_id="explicit")
+    (event,) = [e for e in FLIGHT.events() if e["kind"] == "query.cancel"]
+    assert event["query_id"] == "explicit"
+
+
+# --- Chrome trace-event schema --------------------------------------------
+
+def test_chrome_trace_schema():
+    FLIGHT.emit("query.start", query_id="q1", tenant="t1",
+                attrs={"indexes": "logs"})
+    FLIGHT.emit("dispatch.readback", query_id="q1",
+                attrs={"dur_ms": 1.25})
+    FLIGHT.emit("query.done", query_id="q1", attrs={"status": "ok"})
+    trace = FLIGHT.to_chrome_trace(process_name="qw-test")
+    # must round-trip as JSON (the REST endpoint serves exactly this)
+    trace = json.loads(json.dumps(trace))
+    assert trace["displayTimeUnit"] == "ms"
+    events = trace["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M"]
+    assert {"process_name", "thread_name"} <= {e["name"] for e in meta}
+    assert any(e["args"].get("name") == "qw-test" for e in meta)
+    body = [e for e in events if e["ph"] != "M"]
+    assert len(body) == 3
+    for e in body:
+        assert e["ph"] in ("i", "X")
+        assert isinstance(e["ts"], int) and e["ts"] >= 0   # microseconds
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert e["cat"] == e["name"].split(".", 1)[0]
+        assert e["args"]["query_id"] == "q1"
+    # same-thread events keep timeline order
+    ts = [e["ts"] for e in body]
+    assert ts == sorted(ts)
+    # a measured duration renders as a complete event, instants are
+    # thread-scoped
+    (complete,) = [e for e in body if e["name"] == "dispatch.readback"]
+    assert complete["ph"] == "X" and complete["dur"] == 1250
+    for e in body:
+        if e["ph"] == "i":
+            assert e["s"] == "t"
+    (start,) = [e for e in body if e["name"] == "query.start"]
+    assert start["args"]["tenant"] == "t1"
+    assert start["args"]["indexes"] == "logs"
+
+
+def test_trace_limit_keeps_most_recent():
+    for i in range(20):
+        FLIGHT.emit("chunk.boundary", query_id=f"q{i}")
+    trace = FLIGHT.to_chrome_trace(limit=5)
+    body = [e for e in trace["traceEvents"] if e["ph"] != "M"]
+    assert [e["args"]["query_id"] for e in body] == \
+        [f"q{i}" for i in range(15, 20)]
+
+
+# --- end-to-end: a real dispatch is reconstructable from the trace ---------
+
+def test_warm_dispatch_timeline_reconstructable():
+    """The executor hot path emits compile-cache, launch and readback
+    events that correlate by query id + tenant: the acceptance criterion
+    is that the exported trace ALONE names what the device did."""
+    import numpy as np
+    from quickwit_tpu.index.reader import SplitReader
+    from quickwit_tpu.index.synthetic import HDFS_MAPPER, synthetic_hdfs_split
+    from quickwit_tpu.query.ast import Term
+    from quickwit_tpu.search import executor as ex
+    from quickwit_tpu.search.leaf import prepare_single_split
+    from quickwit_tpu.search.models import SearchRequest
+    from quickwit_tpu.storage import StorageResolver
+    from quickwit_tpu.tenancy.context import TenantContext, tenant_scope
+
+    storage = StorageResolver.for_test().resolve("ram:///flight-test")
+    storage.put("t.split", synthetic_hdfs_split(2048, seed=99))
+    reader = SplitReader(storage, "t.split")
+    request = SearchRequest(index_ids=["hdfs-logs"],
+                            query_ast=Term("severity_text", "ERROR"),
+                            max_hits=5)
+    plan, arrays, _ = prepare_single_split(request, HDFS_MAPPER, reader, "t")
+    profile = QueryProfile(query_id="q-e2e")
+    with profile_scope(profile), \
+            tenant_scope(TenantContext(tenant_id="acme",
+                                       priority_class="standard")):
+        ex.execute_plan(plan, 5, arrays)   # cold: compiles
+        ex.execute_plan(plan, 5, arrays)   # warm: cache hit
+    trace = FLIGHT.to_chrome_trace()
+    mine = [e for e in trace["traceEvents"]
+            if e.get("args", {}).get("query_id") == "q-e2e"]
+    kinds = [e["name"] for e in mine]
+    assert "compile.miss" in kinds and "compile.hit" in kinds
+    assert kinds.count("dispatch.launch") == 2
+    readbacks = [e for e in mine if e["name"] == "dispatch.readback"]
+    assert len(readbacks) == 2
+    for e in readbacks:
+        assert e["ph"] == "X" and e["dur"] >= 1   # measured duration
+    assert all(e["args"]["tenant"] == "acme" for e in mine)
+
+
+# --- slowlog capture -------------------------------------------------------
+
+def test_slowlog_entry_carries_flight_tail():
+    SLOW_QUERY_LOG.clear()
+    FLIGHT.emit("query.start", query_id="q-slow")
+    FLIGHT.emit("dispatch.launch", query_id="q-slow",
+                attrs={"path": "solo"})
+    FLIGHT.emit("query.start", query_id="q-other")
+    SLOW_QUERY_LOG.record({"query_id": "q-slow", "elapsed_ms": 123.0})
+    try:
+        entry = SLOW_QUERY_LOG.entries()[-1]
+        tail = entry["flight"]
+        assert [e["kind"] for e in tail] == ["query.start",
+                                             "dispatch.launch"]
+        # only q-slow's events: the tail is filtered by query id
+        assert all(e["query_id"] == "q-slow" for e in tail)
+    finally:
+        SLOW_QUERY_LOG.clear()
+
+
+def test_slowlog_entry_names_query_group():
+    """Satellite regression: a slow stacked query's entry records the
+    PR-18 group context (size, lane, masked-rider flag) derived from the
+    batcher's profile counters."""
+    from quickwit_tpu.search.models import SearchRequest
+    from quickwit_tpu.search.root import RootSearcher
+    SLOW_QUERY_LOG.clear()
+    SLOW_QUERY_LOG.configure(0.0)   # every query is "slow"
+    try:
+        profile = QueryProfile(query_id="q-grouped")
+        profile.set_counter("qbatch_group_size", 4.0)
+        profile.set_counter("qbatch_lane_index", 2.0)
+        profile.set_counter("qbatch_masked", 1.0)
+        profile.finish(0.050)
+        request = SearchRequest(index_ids=["logs"], query_ast=None,
+                                max_hits=5)
+        RootSearcher._capture_slow_query(request, profile, timed_out=False)
+        entry = SLOW_QUERY_LOG.entries()[-1]
+        assert entry["query_group"] == {"group_size": 4, "lane_index": 2,
+                                        "masked": True}
+        # an un-batched query records no group context at all
+        solo = QueryProfile(query_id="q-solo")
+        solo.finish(0.050)
+        RootSearcher._capture_slow_query(request, solo, timed_out=False)
+        assert "query_group" not in SLOW_QUERY_LOG.entries()[-1]
+    finally:
+        SLOW_QUERY_LOG.configure(None)
+        SLOW_QUERY_LOG.clear()
+
+
+# --- DST determinism of the tail ------------------------------------------
+
+def test_dst_tail_strips_nondeterministic_fields():
+    clock = FakeClock()
+    with use_clock(clock):
+        FLIGHT.begin_run()
+        FLIGHT.emit("dst.op", attrs={"step": 0, "kind": "tick"})
+        clock.advance(0.5)
+        FLIGHT.emit("query.start", query_id="q1")
+        tail = FLIGHT.dst_tail()
+    assert [e["kind"] for e in tail] == ["dst.op", "query.start"]
+    # virtual time rebased to t=0 at begin_run
+    assert tail[0]["t_ms"] == 0.0
+    assert tail[1]["t_ms"] == 500.0
+    for e in tail:
+        assert "tid" not in e and "span" not in e
+
+
+def test_dst_tail_filters_compile_events():
+    # JIT executable caches are per-PROCESS state: hit-vs-miss reflects
+    # what earlier runs compiled, so compile.* cannot be part of a
+    # byte-identical replay tail
+    FLIGHT.begin_run()
+    FLIGHT.emit("compile.miss", attrs={"path": "solo"})
+    FLIGHT.emit("dispatch.launch", attrs={"path": "solo"})
+    tail = FLIGHT.dst_tail()
+    assert [e["kind"] for e in tail] == ["dispatch.launch"]
+
+
+# --- SLO burn accounting ---------------------------------------------------
+
+def test_slo_burn_rate_counts_breaches_against_budget():
+    clock = FakeClock()
+    with use_clock(clock):
+        tracker = SloTracker({"interactive": (100.0, 0.99)})
+        # 9 ok within objective, 1 breach -> breach fraction 0.1 over a
+        # 0.01 budget -> burn 10x
+        for _ in range(9):
+            tracker.note("interactive", "acme", 50.0, ok=True)
+        burn = tracker.note("interactive", "acme", 250.0, ok=True)
+    assert burn == pytest.approx(10.0)
+    report = tracker.report()
+    cls = report["classes"]["interactive"]
+    assert cls["window_total"] == 10 and cls["window_breached"] == 1
+    assert cls["burn_rate"] == pytest.approx(10.0)
+    assert report["tenants"]["acme"]["interactive"] == {
+        "total": 10, "breached": 1}
+
+
+def test_slo_failed_query_always_breaches():
+    clock = FakeClock()
+    with use_clock(clock):
+        tracker = SloTracker({"standard": (2000.0, 0.99)})
+        # fast but shed: still a breach (ok=False)
+        burn = tracker.note("standard", "acme", 1.0, ok=False)
+    assert burn > 0
+
+
+def test_slo_window_expires_old_buckets():
+    clock = FakeClock()
+    with use_clock(clock):
+        tracker = SloTracker({"standard": (2000.0, 0.99)})
+        tracker.note("standard", "acme", 5000.0, ok=True)   # breach
+        clock.advance(600.0)   # past the 5-minute window
+        tracker.note("standard", "acme", 1.0, ok=True)
+        cls = tracker.report()["classes"]["standard"]
+    assert cls["window_total"] == 1 and cls["window_breached"] == 0
+    # cumulative per-tenant counters do NOT expire
+    assert tracker.report()["tenants"]["acme"]["standard"]["total"] == 2
+
+
+# --- cluster tenant rollup -------------------------------------------------
+
+def _node_report(node_id, counters):
+    return {
+        "node_id": node_id,
+        "enabled": True,
+        "default_class": "standard",
+        "tenants": {
+            "acme": {"class": "interactive", "priority": 0, "weight": 4,
+                     "metric_label": "acme", "counters": dict(counters)},
+        },
+    }
+
+
+def test_rollup_merges_counters_and_keeps_identity():
+    merged = merge_tenant_reports([
+        _node_report("n0", {"queries": 10, "shed": 1}),
+        _node_report("n1", {"queries": 5, "shed": 0, "rejected": 2}),
+    ])
+    assert merged["scope"] == "cluster"
+    assert merged["nodes"] == ["n0", "n1"]
+    acme = merged["tenants"]["acme"]
+    assert acme["counters"]["queries"] == 15
+    assert acme["counters"]["shed"] == 1
+    assert acme["counters"]["rejected"] == 2
+    # identity fields come from the first node, never summed
+    assert acme["class"] == "interactive" and acme["weight"] == 4
+    assert acme["nodes"] == 2
+
+
+def test_rollup_single_node_and_disjoint_tenants():
+    r0 = _node_report("n0", {"queries": 1})
+    r1 = _node_report("n1", {"queries": 2})
+    r1["tenants"] = {"globex": r1["tenants"]["acme"]}
+    merged = merge_tenant_reports([r0, r1])
+    assert set(merged["tenants"]) == {"acme", "globex"}
+    assert merged["tenants"]["acme"]["nodes"] == 1
+    assert merged["tenants"]["globex"]["counters"]["queries"] == 2
+
+
+# --- REST + CLI export -----------------------------------------------------
+
+def test_trace_rest_endpoint_and_cluster_tenants():
+    from quickwit_tpu.serve.node import Node, NodeConfig
+    from quickwit_tpu.serve.rest import RestServer
+    from quickwit_tpu.storage import StorageResolver
+    node = Node(NodeConfig(node_id="flight-0", rest_port=0,
+                           metastore_uri="ram:///flight/metastore",
+                           default_index_root_uri="ram:///flight/idx"),
+                storage_resolver=StorageResolver.for_test())
+    server = RestServer(node)
+    FLIGHT.emit("query.start", query_id="q-rest")
+    status, trace = server.route("GET", "/api/v1/developer/trace", {}, b"")
+    assert status == 200
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "query.start" in names
+    assert any(e["ph"] == "M" and "flight-0" in str(e["args"].get("name"))
+               for e in trace["traceEvents"])
+    status, report = server.route(
+        "GET", "/api/v1/developer/tenants", {"scope": "cluster"}, b"")
+    assert status == 200
+    assert report["scope"] == "cluster"
+    assert report["nodes"] == ["flight-0"]
+    assert "slo" in report
+
+
+def test_cli_trace_export_writes_perfetto_json(tmp_path, capsys):
+    from quickwit_tpu.cli import main
+    FLIGHT.emit("query.start", query_id="q-cli")
+    out = tmp_path / "trace.json"
+    rc = main(["trace", "export", "--out", str(out)])
+    assert rc in (0, None)
+    trace = json.loads(out.read_text())
+    assert trace["displayTimeUnit"] == "ms"
+    assert any(e.get("name") == "query.start"
+               for e in trace["traceEvents"])
+    assert "Perfetto" in capsys.readouterr().out

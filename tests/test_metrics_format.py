@@ -522,3 +522,60 @@ def test_full_registry_exposition_parses():
                 assert value == parsed[family + "_count"][bare]
     assert not any(math.isnan(v)
                    for series in parsed.values() for v in series.values())
+
+
+def test_flight_metrics_exposition():
+    """`qw_flight_*`: emit() defers the labeled counter off the hot path,
+    so the exposition is only correct if the flush fold-in ran — this test
+    asserts both the strict text format AND that flush makes the counter
+    catch up with the rings exactly once (no double counting)."""
+    from quickwit_tpu.observability.flight import FLIGHT
+    from quickwit_tpu.observability.metrics import FLIGHT_EVENTS_TOTAL
+    FLIGHT.reset()
+    FLIGHT.enable()
+    before = FLIGHT_EVENTS_TOTAL.get(subsystem="dispatch")
+    FLIGHT.emit("dispatch.launch", attrs={"path": "solo"})
+    FLIGHT.emit("dispatch.readback", attrs={"dur_ms": 1.0})
+    FLIGHT.emit("chunk.boundary")
+    FLIGHT.flush_metrics()
+    FLIGHT.flush_metrics()   # idempotent: deltas, not totals
+    assert FLIGHT_EVENTS_TOTAL.get(subsystem="dispatch") == before + 2
+    FLIGHT.to_chrome_trace()  # drives qw_flight_exports_total
+    parsed = parse_exposition(METRICS.expose_text())
+    events = parsed["qw_flight_events_total"]
+    by_subsystem = {dict(k)["subsystem"]: v for k, v in events.items()}
+    assert by_subsystem.get("dispatch", 0) >= 2
+    assert by_subsystem.get("chunk", 0) >= 1
+    # subsystem labels are the dotted-kind prefixes: a closed vocabulary,
+    # never request-derived strings
+    assert all(s.isidentifier() for s in by_subsystem)
+    assert parsed["qw_flight_threads"][()] >= 1
+    assert parsed["qw_flight_exports_total"][()] >= 1
+    assert "qw_flight_dropped_events" in parsed
+    FLIGHT.reset()
+
+
+def test_slo_metrics_exposition():
+    """`qw_slo_*`: per-class objective gauge, per-class burn gauge, and
+    the per-tenant verdict counter all expose in strict format with the
+    label sets the alerting rules key on."""
+    from quickwit_tpu.common.clock import FakeClock, use_clock
+    from quickwit_tpu.observability.slo import SloTracker
+    with use_clock(FakeClock()):
+        tracker = SloTracker({"interactive": (100.0, 0.99)})
+        tracker.note("interactive", "acme", 50.0, ok=True)
+        tracker.note("interactive", "acme", 500.0, ok=True)  # breach
+    parsed = parse_exposition(METRICS.expose_text())
+    objective = parsed["qw_slo_objective_latency_ms"]
+    assert objective[
+        tuple(sorted({"priority_class": "interactive"}.items()))] == 100.0
+    burn = parsed["qw_slo_burn_rate"]
+    cls_key = tuple(sorted({"priority_class": "interactive"}.items()))
+    assert burn[cls_key] > 0
+    queries = parsed["qw_slo_queries_total"]
+    ok_key = tuple(sorted({"priority_class": "interactive",
+                           "tenant": "acme", "verdict": "ok"}.items()))
+    breach_key = tuple(sorted({"priority_class": "interactive",
+                               "tenant": "acme",
+                               "verdict": "breach"}.items()))
+    assert queries[ok_key] >= 1 and queries[breach_key] >= 1

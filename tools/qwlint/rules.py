@@ -434,6 +434,9 @@ _SIM_SCOPED_MODULES = (
     "quickwit_tpu/metastore/file_backed.py",
     "quickwit_tpu/models/index_metadata.py",
     "quickwit_tpu/models/split_metadata.py",
+    "quickwit_tpu/observability/flight.py",
+    "quickwit_tpu/observability/profiler.py",
+    "quickwit_tpu/observability/slo.py",
     "quickwit_tpu/offload/",
     "quickwit_tpu/tenancy/overload.py",
 )
